@@ -1,0 +1,23 @@
+"""Test configuration: force an 8-device virtual CPU platform so mesh /
+sharding tests exercise real multi-device code paths without TPU hardware
+(mirrors the reference's NumpyDevice-as-universal-fake strategy,
+``veles/tests/accelerated_test.py:47-80``)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_prng():
+    """Deterministic named streams per test (ref: multi_device re-seeds
+    between backends, accelerated_test.py:47-80)."""
+    from veles_tpu import prng
+    prng.seed_all(1234)
+    yield

@@ -1,0 +1,54 @@
+"""Config tree tests (mirrors reference ``veles/tests/test_config.py``)."""
+
+import pytest
+
+from veles_tpu.config import Config, root, update_from_arguments
+
+
+def test_autovivify():
+    cfg = Config("test")
+    cfg.a.b.c = 5
+    assert cfg.a.b.c == 5
+    assert cfg.a.path == "test.a"
+
+
+def test_update_deep_merge():
+    cfg = Config("test")
+    cfg.update({"x": {"y": 1, "z": 2}})
+    cfg.update({"x": {"y": 10}})
+    assert cfg.x.y == 10
+    assert cfg.x.z == 2
+
+
+def test_to_dict_roundtrip():
+    cfg = Config("test")
+    cfg.update({"a": {"b": 3}, "c": "s"})
+    assert cfg.to_dict() == {"a": {"b": 3}, "c": "s"}
+
+
+def test_protect():
+    cfg = Config("test")
+    cfg.key = 1
+    cfg.protect("key")
+    with pytest.raises(AttributeError):
+        cfg.key = 2
+
+
+def test_defaults_present():
+    assert root.common.engine.backend in ("auto", "tpu", "cpu", "numpy")
+    assert "datasets" in root.common.dirs.to_dict()
+
+
+def test_cli_overrides():
+    update_from_arguments(["root.common.test_override=41",
+                           'common.test_str=hello'])
+    assert root.common.test_override == 41
+    assert root.common.test_str == "hello"
+
+
+def test_contains_and_get():
+    cfg = Config("test")
+    cfg.a = 1
+    assert "a" in cfg
+    assert cfg.get("missing", 7) == 7
+    assert "missing" not in cfg  # get() must not vivify

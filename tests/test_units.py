@@ -1,0 +1,319 @@
+"""Core graph tests: gates, links, scheduling, loops.
+
+Mirrors reference ``veles/tests/test_units.py`` (gates/links) and
+``test_workflow.py`` coverage.
+"""
+
+import pickle
+
+import pytest
+
+from veles_tpu.dummy import DummyUnit, DummyWorkflow
+from veles_tpu.mutable import Bool
+from veles_tpu.plumbing import Repeater
+from veles_tpu.units import Unit
+
+
+def test_link_from_builds_edges():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    b = DummyUnit(wf, name="b")
+    b.link_from(a)
+    assert a in b.links_from
+    assert b in a.links_to
+
+
+def test_open_gate_requires_all_inputs():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf)
+    b = DummyUnit(wf)
+    c = DummyUnit(wf)
+    c.link_from(a, b)
+    assert not c.open_gate(a)
+    assert c.open_gate(b)          # both fired → open and reset
+    assert not c.open_gate(a)      # reset worked
+
+
+def test_linear_run():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    b = DummyUnit(wf, name="b")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    wf.end_point.link_from(b)
+    wf.initialize()
+    wf.run()
+    assert a.run_count == 1
+    assert b.run_count == 1
+    assert wf.stopped
+
+
+def test_diamond_runs_join_once():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    b1 = DummyUnit(wf, name="b1")
+    b2 = DummyUnit(wf, name="b2")
+    c = DummyUnit(wf, name="c")
+    a.link_from(wf.start_point)
+    b1.link_from(a)
+    b2.link_from(a)
+    c.link_from(b1, b2)
+    wf.end_point.link_from(c)
+    wf.initialize()
+    wf.run()
+    assert c.run_count == 1
+
+
+def test_gate_block_stops_propagation():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    b = DummyUnit(wf, name="b")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    wf.end_point.link_from(a)   # alternate path to finish
+    b.gate_block <<= True
+    wf.initialize()
+    wf.run()
+    assert b.run_count == 0
+
+
+def test_gate_skip_propagates_without_running():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    b = DummyUnit(wf, name="b")
+    c = DummyUnit(wf, name="c")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(b)
+    wf.end_point.link_from(c)
+    b.gate_skip <<= True
+    wf.initialize()
+    wf.run()
+    assert b.run_count == 0
+    assert c.run_count == 1
+
+
+def test_repeater_loop_with_decision_gate():
+    """The canonical training loop shape: repeater → body → decision;
+    decision's gate_block on the back edge ends the loop."""
+    wf = DummyWorkflow()
+    rep = Repeater(wf)
+    body = DummyUnit(wf, name="body")
+    complete = Bool(False)
+
+    class Decision(Unit):
+        def __init__(self, workflow, **kwargs):
+            super(Decision, self).__init__(workflow, **kwargs)
+            self.n = 0
+
+        def run(self):
+            nonlocal complete
+            self.n += 1
+            if self.n >= 5:
+                complete <<= True
+
+    dec = Decision(wf)
+    rep.link_from(wf.start_point)
+    body.link_from(rep)
+    dec.link_from(body)
+    rep.link_from(dec)             # back edge
+    rep.gate_block = complete      # loop exit
+    wf.end_point.link_from(dec)
+    wf.end_point.gate_block = ~complete
+    wf.initialize()
+    wf.run()
+    assert body.run_count == 5
+    assert wf.stopped
+
+
+def test_deep_loop_no_stack_overflow():
+    """10k iterations through the queue scheduler — would overflow a
+    recursive scheduler."""
+    wf = DummyWorkflow()
+    rep = Repeater(wf)
+    complete = Bool(False)
+
+    class Counter(Unit):
+        def __init__(self, workflow, **kwargs):
+            super(Counter, self).__init__(workflow, **kwargs)
+            self.n = 0
+
+        def run(self):
+            nonlocal complete
+            self.n += 1
+            if self.n >= 10000:
+                complete <<= True
+
+    cnt = Counter(wf)
+    rep.link_from(wf.start_point)
+    cnt.link_from(rep)
+    rep.link_from(cnt)
+    rep.gate_block = complete
+    wf.end_point.link_from(cnt)
+    wf.end_point.gate_block = ~complete
+    wf.initialize()
+    wf.run()
+    assert cnt.n == 10000
+
+
+def test_link_attrs_aliases_values():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    b = DummyUnit(wf, name="b")
+    a.output = 42
+    b.link_attrs(a, ("input", "output"))
+    assert b.input == 42
+    a.output = 43
+    assert b.input == 43
+
+
+def test_one_way_link_write_raises():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf)
+    b = DummyUnit(wf)
+    a.output = 1
+    b.link_attrs(a, ("input", "output"))
+    with pytest.raises(RuntimeError):
+        b.input = 99
+    assert b.input == 1     # alias intact
+
+
+def test_bool_expression_survives_pickle():
+    """Gate expressions stay live through snapshot/restore: flipping the
+    restored operand re-opens the restored gate."""
+    flag = Bool(False)
+    gate = ~flag
+    flag2, gate2 = pickle.loads(pickle.dumps((flag, gate)))
+    assert bool(gate2)
+    flag2 <<= True
+    assert not bool(gate2)
+
+
+def test_initialize_bug_not_masked_by_requeue():
+    """A genuine AttributeError inside initialize() surfaces immediately
+    instead of being retried as a missing-demand."""
+    wf = DummyWorkflow()
+    calls = []
+
+    class Buggy(Unit):
+        def initialize(self, **kwargs):
+            calls.append(1)
+            return self.no_such_attribute
+
+    Buggy(wf).link_from(wf.start_point)
+    with pytest.raises(AttributeError):
+        wf.initialize()
+    assert len(calls) == 1
+
+
+def test_apply_data_from_slave_length_mismatch():
+    wf = DummyWorkflow()
+    DummyUnit(wf, name="a").link_from(wf.start_point)
+    with pytest.raises(ValueError):
+        wf.apply_data_from_slave([None])   # 3 units (start/end/a), 1 entry
+
+
+def test_link_attrs_two_way():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf)
+    b = DummyUnit(wf)
+    a.output = 1
+    b.link_attrs(a, ("input", "output"), two_way=True)
+    b.input = 7
+    assert a.output == 7
+
+
+def test_demand_raises_on_missing():
+    wf = DummyWorkflow()
+
+    class Needy(Unit):
+        def __init__(self, workflow, **kwargs):
+            super(Needy, self).__init__(workflow, **kwargs)
+            self.demand("input")
+
+    needy = Needy(wf)
+    needy.link_from(wf.start_point)
+    wf.end_point.link_from(needy)
+    with pytest.raises(AttributeError):
+        wf.initialize()
+
+
+def test_demand_satisfied_by_link():
+    wf = DummyWorkflow()
+    producer = DummyUnit(wf)
+    producer.output = [1, 2]
+
+    class Needy(Unit):
+        def __init__(self, workflow, **kwargs):
+            super(Needy, self).__init__(workflow, **kwargs)
+            self.demand("input")
+
+    needy = Needy(wf)
+    needy.link_attrs(producer, ("input", "output"))
+    needy.link_from(wf.start_point)
+    wf.end_point.link_from(needy)
+    wf.initialize()
+
+
+def test_initialize_requeues_until_producer_ready():
+    """Partial-init requeue (ref workflow.py:329-336): a unit demanded attr
+    appears only after its producer's initialize()."""
+    wf = DummyWorkflow()
+
+    class Producer(Unit):
+        def initialize(self, **kwargs):
+            self.output = 99
+            super(Producer, self).initialize(**kwargs)
+
+    class Consumer(Unit):
+        def __init__(self, workflow, **kwargs):
+            super(Consumer, self).__init__(workflow, **kwargs)
+            self.demand("input")
+
+    prod = Producer(wf)
+    cons = Consumer(wf)
+    cons.link_attrs(prod, ("input", "output"))
+    # Reverse control order so naive one-pass init would fail:
+    cons.link_from(wf.start_point)
+    prod.link_from(cons)
+    wf.end_point.link_from(prod)
+    wf.initialize()
+    assert cons.input == 99
+
+
+def test_bool_expressions():
+    a = Bool(False)
+    b = Bool(True)
+    both = a & b
+    either = a | b
+    neither = ~either
+    assert not both and either and not neither
+    a <<= True
+    assert both and either and not neither
+
+
+def test_unit_pickles_without_transients():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="keepme")
+    a.payload = [1, 2, 3]
+    blob = pickle.dumps(a)
+    restored = pickle.loads(blob)
+    assert restored.name == "keepme"
+    assert restored.payload == [1, 2, 3]
+    assert hasattr(restored, "_gate_lock_")   # recreated by init_unpickled
+
+
+def test_workflow_checksum_stable():
+    wf1 = DummyWorkflow()
+    DummyUnit(wf1, name="x").link_from(wf1.start_point)
+    wf2 = DummyWorkflow()
+    DummyUnit(wf2, name="x").link_from(wf2.start_point)
+    assert wf1.checksum() == wf2.checksum()
+
+
+def test_generate_graph_dot():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    a.link_from(wf.start_point)
+    dot = wf.generate_graph()
+    assert dot.startswith("digraph") and "->" in dot

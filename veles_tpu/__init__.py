@@ -1,0 +1,30 @@
+"""veles_tpu — a TPU-native dataflow deep-learning platform.
+
+A ground-up re-design of the capabilities of the VELES platform
+(reference: cnxtech/veles) for TPU hardware: the execution substrate is
+JAX/XLA (jit/pjit over a `jax.sharding.Mesh`, Pallas kernels for hot ops)
+instead of eager OpenCL/CUDA kernel enqueues; the semantic model — a
+*workflow* graph of *units* with control gates and linked attributes, one
+workflow running unmodified in standalone / master / slave modes, fully
+checkpointable — is preserved.
+
+Layer map (mirrors reference SURVEY.md §1, re-architected TPU-first):
+  L0 ops/        Pallas kernels + jnp fallbacks (ref: ocl/ + cuda/ templates)
+  L1 backends/memory   Device registry + Vector over jax.Array (ref: veles/backends.py, memory.py)
+  L2 units/workflow    dataflow+controlflow core (ref: veles/units.py, workflow.py)
+  L3 loader/     datasets & minibatch serving (ref: veles/loader/)
+  L4 parallel/   mesh DP/TP via pjit + cross-slice job layer (ref: veles/server.py, client.py)
+  L5 services    snapshots, plotting, status, publishing (ref: veles/snapshotter.py etc.)
+  L6 genetics/ensemble  meta-workflows (ref: veles/genetics/, veles/ensemble/)
+  L7 __main__    CLI front-end (ref: veles/__main__.py)
+  L8 native/     C++ packaged-inference runtime (ref: libVeles/)
+"""
+
+__version__ = "0.1.0"
+__license__ = "Apache-2.0"
+
+from veles_tpu.config import root  # noqa: F401
+from veles_tpu.units import Unit, IUnit  # noqa: F401
+from veles_tpu.workflow import Workflow  # noqa: F401
+from veles_tpu.mutable import Bool  # noqa: F401
+from veles_tpu.plumbing import Repeater, StartPoint, EndPoint  # noqa: F401

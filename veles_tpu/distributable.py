@@ -1,0 +1,109 @@
+"""Pickling protocol and master–slave data-exchange interface.
+
+Parity target: reference ``veles/distributable.py`` —
+
+* ``Pickleable`` (``distributable.py:48``): attributes whose names end with
+  ``_`` are excluded from pickles; ``init_unpickled()`` recreates them after
+  construction *and* after unpickling.  This single convention is what makes
+  whole-workflow snapshots work: locks, device handles, compiled functions
+  and loggers all live in ``_``-suffixed slots.
+* ``Distributable`` (``distributable.py:136``): thread-safe wrappers around
+  the master/slave data methods with a deadlock watchdog (DEADLOCK_TIME,
+  ``:137``).
+* ``IDistributable`` (``distributable.py:222``): the 6-method contract every
+  unit implements to take part in distributed runs.
+
+TPU re-design notes: on-pod gradient exchange does NOT go through these
+methods (it is a ``psum`` inside the jitted step — see
+:mod:`veles_tpu.parallel`); they remain the contract for the *job-level*
+layer (ensembles, genetic optimization, elastic eval over DCN).
+"""
+
+import contextlib
+import threading
+
+from veles_tpu.logger import Logger
+
+
+class Pickleable(Logger):
+    """Base with the ``_``-suffix pickling convention."""
+
+    def __init__(self, **kwargs):
+        super(Pickleable, self).__init__(**kwargs)
+        self.init_unpickled()
+
+    def init_unpickled(self):
+        """Create/recreate all transient (``_``-suffixed) state.
+
+        Subclasses override and MUST call ``super().init_unpickled()``.
+        """
+        sup = super(Pickleable, self)
+        if hasattr(sup, "init_unpickled"):
+            sup.init_unpickled()
+        self._pickle_lock_ = threading.Lock()
+
+    def __getstate__(self):
+        with getattr(self, "_pickle_lock_", threading.Lock()):
+            state = {}
+            for key, value in self.__dict__.items():
+                if key.endswith("_"):
+                    continue
+                state[key] = value
+            return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.init_unpickled()
+
+
+class Distributable(Pickleable):
+    """Thread-safe data exchange with a deadlock watchdog
+    (ref ``distributable.py:136-205``)."""
+
+    DEADLOCK_TIME = 4.0
+
+    negotiates_on_connect = False
+
+    def init_unpickled(self):
+        super(Distributable, self).init_unpickled()
+        self._data_lock_ = threading.RLock()
+
+    @contextlib.contextmanager
+    def data_lock(self):
+        """Serialize master/slave data exchange on this unit with a
+        deadlock watchdog (ref ``distributable.py:136-205``).  The job
+        layer (:mod:`veles_tpu.parallel.server`) wraps every
+        ``generate_/apply_`` call in this; unit code touching the same
+        state from ``run()`` may take it too."""
+        if not self._data_lock_.acquire(timeout=self.DEADLOCK_TIME):
+            self.warning(
+                "possible deadlock in %s (> %.0f s waiting on data lock)",
+                type(self).__name__, self.DEADLOCK_TIME)
+            self._data_lock_.acquire()
+        try:
+            yield
+        finally:
+            self._data_lock_.release()
+
+    # -- IDistributable default (trivial) implementations ------------------
+    # (ref TriviallyDistributable distributable.py:284)
+    def generate_data_for_master(self):
+        """Return the payload a slave sends to the master after a job."""
+        return None
+
+    def generate_data_for_slave(self, slave=None):
+        """Master side: produce a job payload for ``slave``."""
+        return None
+
+    def apply_data_from_master(self, data):
+        """Slave side: install job payload before running."""
+
+    def apply_data_from_slave(self, data, slave=None):
+        """Master side: merge a slave's update."""
+
+    def drop_slave(self, slave=None):
+        """Master side: slave died — requeue its outstanding work."""
+
+
+class TriviallyDistributable(Distributable):
+    """Explicit marker for units with no distributed state."""

@@ -1,0 +1,52 @@
+"""Test fakes (ref ``veles/dummy.py``): ``DummyLauncher`` (``dummy.py:46``)
+lets units/workflows run standalone with no real launcher/reactor;
+``DummyWorkflow``/``DummyUnit`` (``dummy.py:101,123``) are minimal hosts."""
+
+from veles_tpu.logger import Logger
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Workflow
+
+
+class DummyLauncher(Logger):
+    """Fakes the Launcher interface units/workflows consult."""
+
+    def __init__(self, **kwargs):
+        super(DummyLauncher, self).__init__()
+        self.is_master = kwargs.get("is_master", False)
+        self.is_slave = kwargs.get("is_slave", False)
+        self.is_standalone = not (self.is_master or self.is_slave)
+        self.stopped = False
+        self.device = kwargs.get("device")
+
+    def add_ref(self, workflow):
+        self.workflow = workflow
+
+    def del_ref(self, workflow):
+        pass
+
+    def on_workflow_finished(self):
+        self.stopped = True
+
+    def stop(self):
+        self.stopped = True
+
+
+class DummyWorkflow(Workflow):
+    """A workflow pre-wired to a DummyLauncher."""
+
+    def __init__(self, **kwargs):
+        super(DummyWorkflow, self).__init__(None, **kwargs)
+        self.launcher = DummyLauncher(
+            is_master=kwargs.get("is_master", False),
+            is_slave=kwargs.get("is_slave", False))
+
+
+class DummyUnit(Unit):
+    """A unit that records whether it ran."""
+
+    def __init__(self, workflow=None, **kwargs):
+        super(DummyUnit, self).__init__(workflow, **kwargs)
+        self.run_count = 0
+
+    def run(self):
+        self.run_count += 1

@@ -1,0 +1,107 @@
+"""Class-scoped colored logging + event timeline.
+
+Parity target: reference ``veles/logger.py`` — per-class loggers with color
+(``logger.py:59+``), an ``event()`` timeline API (``logger.py:264-280``) and
+optional duplication of all records to an external sink (the reference used
+MongoDB, ``logger.py:292``; here the sink is a pluggable callable so the
+status server / metric writer can subscribe without a database dependency).
+"""
+
+import logging
+import sys
+import threading
+import time
+
+_COLORS = {
+    logging.DEBUG: "\033[37m",
+    logging.INFO: "\033[92m",
+    logging.WARNING: "\033[93m",
+    logging.ERROR: "\033[91m",
+    logging.CRITICAL: "\033[1;91m",
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record):
+        msg = super(_ColorFormatter, self).format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelno, "")
+            return "%s%s%s" % (color, msg, _RESET) if color else msg
+        return msg
+
+
+_configured = False
+_configure_lock = threading.Lock()
+
+
+def setup_logging(level=logging.INFO, debug_classes=()):
+    """Install the root handler once; per-class DEBUG like the reference's
+    ``--debug CLASS,...`` flag (``veles/__main__.py:833-835``)."""
+    global _configured
+    with _configure_lock:
+        if not _configured:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(_ColorFormatter(
+                "%(asctime)s %(levelname).1s %(name)s: %(message)s",
+                "%H:%M:%S"))
+            logging.getLogger().addHandler(handler)
+            _configured = True
+        logging.getLogger().setLevel(level)
+        for klass in debug_classes:
+            logging.getLogger(klass).setLevel(logging.DEBUG)
+
+
+class Logger(object):
+    """Mixin giving every object a logger named after its class and an
+    ``event()`` timeline channel."""
+
+    #: Pluggable event sinks: callables taking the event dict.
+    event_sinks = []
+
+    def __init__(self, **kwargs):
+        super(Logger, self).__init__()
+        self._logger_ = logging.getLogger(self.__class__.__name__)
+
+    @property
+    def logger(self):
+        return self._logger_
+
+    def init_unpickled(self):
+        # Logger objects are not pickleable; restore after unpickle
+        # (cooperates with Pickleable in distributable.py).
+        sup = super(Logger, self)
+        if hasattr(sup, "init_unpickled"):
+            sup.init_unpickled()
+        self._logger_ = logging.getLogger(self.__class__.__name__)
+
+    def debug(self, msg, *args):
+        self._logger_.debug(msg, *args)
+
+    def info(self, msg, *args):
+        self._logger_.info(msg, *args)
+
+    def warning(self, msg, *args):
+        self._logger_.warning(msg, *args)
+
+    def error(self, msg, *args):
+        self._logger_.error(msg, *args)
+
+    def exception(self, msg="", *args):
+        self._logger_.exception(msg, *args)
+
+    def event(self, name, phase, **kwargs):
+        """Record a timeline event (ref ``veles/logger.py:264-280``).
+
+        ``phase`` is ``"begin"``, ``"end"`` or ``"single"``; consumers (web
+        status, trace writer) subscribe via :attr:`event_sinks`.
+        """
+        record = {"name": name, "phase": phase, "time": time.time(),
+                  "instance": getattr(self, "name", self.__class__.__name__)}
+        record.update(kwargs)
+        for sink in Logger.event_sinks:
+            try:
+                sink(record)
+            except Exception:  # noqa: BLE001 - sinks must not kill the run
+                self._logger_.exception("event sink failed")
+        return record

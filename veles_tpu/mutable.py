@@ -1,0 +1,198 @@
+"""Mutable link primitives: gate expressions and attribute aliasing.
+
+Parity target: reference ``veles/mutable.py`` —
+
+* ``Bool`` (``mutable.py:44``): a mutable boolean cell supporting lazy
+  boolean *expressions* (``&``, ``|``, ``~``) whose value is recomputed from
+  the operands at read time, plus in-place rebinding with ``<<=``. Units use
+  these for gating (``gate_block``/``gate_skip``) so that flipping one
+  Decision flag re-gates the whole graph without re-linking.
+* ``LinkableAttribute`` (``mutable.py:219``): aliases an attribute of one
+  object to an attribute of another (optionally two-way), which is how
+  ``Unit.link_attrs`` implements the dataflow edges.
+"""
+
+def _op_and(a, b):
+    return a and b
+
+
+def _op_or(a, b):
+    return a or b
+
+
+def _op_xor(a, b):
+    return a != b
+
+
+def _op_not(a):
+    return not a
+
+
+def _op_truth(a):
+    return a
+
+
+class Bool(object):
+    """Mutable, composable boolean cell.
+
+    Expressions are built from module-level operator functions (not
+    lambdas) so they pickle: a snapshotted workflow keeps its gate
+    expressions live, with operand cell identity preserved by the pickle
+    memo (two gates sharing one Decision flag still share it on restore).
+    """
+
+    __slots__ = ("_value", "_expr")
+
+    def __init__(self, value=False):
+        if isinstance(value, Bool):
+            self._value = None
+            self._expr = (_op_truth, (value,))
+        else:
+            self._value = bool(value)
+            self._expr = None
+
+    # -- value protocol ----------------------------------------------------
+    def __bool__(self):
+        if self._expr is not None:
+            fn, operands = self._expr
+            return bool(fn(*[bool(op) for op in operands]))
+        return self._value
+
+    def __ilshift__(self, value):
+        """``b <<= x`` — rebind, preserving object identity so every gate
+        holding this cell sees the new value (ref ``mutable.py:100``)."""
+        if isinstance(value, Bool):
+            if value._expr is not None:
+                self._expr = value._expr
+                self._value = None
+            else:
+                self._expr = None
+                self._value = value._value
+        else:
+            self._expr = None
+            self._value = bool(value)
+        return self
+
+    # -- expression algebra -------------------------------------------------
+    def _compose(self, fn, other):
+        result = Bool()
+        result._expr = (fn, (self, other))
+        result._value = None
+        return result
+
+    def __and__(self, other):
+        return self._compose(_op_and, _coerce(other))
+
+    def __or__(self, other):
+        return self._compose(_op_or, _coerce(other))
+
+    def __xor__(self, other):
+        return self._compose(_op_xor, _coerce(other))
+
+    def __invert__(self):
+        result = Bool()
+        result._expr = (_op_not, (self,))
+        return result
+
+    __rand__ = __and__
+    __ror__ = __or__
+    __rxor__ = __xor__
+
+    def __repr__(self):
+        kind = "expr" if self._expr is not None else "value"
+        return "<Bool %s=%s at 0x%x>" % (kind, bool(self), id(self))
+
+    def __getstate__(self):
+        return (self._value, self._expr)
+
+    def __setstate__(self, state):
+        self._value, self._expr = state
+
+
+def _coerce(value):
+    return value if isinstance(value, Bool) else Bool(value)
+
+
+class LinkableAttribute(object):
+    """Alias ``obj.name`` to ``src.src_name`` (ref ``mutable.py:219``).
+
+    Installed as a *class-level* descriptor would leak across instances, so
+    like the reference we install per-instance via a shadow dict on the
+    target object: reads and writes are forwarded to the source object.
+    """
+
+    @staticmethod
+    def link(dst, dst_name, src, src_name, two_way=False):
+        links = dst.__dict__.setdefault("_linked_attrs_", {})
+        links[dst_name] = (src, src_name, two_way)
+        _install_forwarding(type(dst), dst_name)
+
+    @staticmethod
+    def unlink(dst, dst_name):
+        links = dst.__dict__.get("_linked_attrs_", {})
+        if dst_name in links:
+            src, src_name, _ = links.pop(dst_name)
+            # Materialize the current value locally.
+            dst.__dict__[dst_name] = getattr(src, src_name)
+
+
+class _Forward(object):
+    """Data descriptor forwarding instance attribute access through
+    ``_linked_attrs_`` when a link exists, else plain instance dict."""
+
+    __slots__ = ("name", "default", "has_default")
+
+    def __init__(self, name, default=None, has_default=False):
+        self.name = name
+        self.default = default
+        self.has_default = has_default
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        link = obj.__dict__.get("_linked_attrs_", {}).get(self.name)
+        if link is not None:
+            src, src_name, _ = link
+            return getattr(src, src_name)
+        try:
+            return obj.__dict__[self.name]
+        except KeyError:
+            if self.has_default:
+                return self.default
+            raise AttributeError(
+                "%r has no attribute %r" % (obj, self.name)) from None
+
+    def __set__(self, obj, value):
+        link = obj.__dict__.get("_linked_attrs_", {}).get(self.name)
+        if link is not None:
+            src, src_name, two_way = link
+            if two_way:
+                setattr(src, src_name, value)
+                return
+            # One-way link: the producer owns the value — fail loudly like
+            # the reference's assignment guard; use LinkableAttribute.unlink
+            # to materialize locally on purpose.
+            raise RuntimeError(
+                "attribute %r of %r is one-way linked from %r.%s; assigning "
+                "it would silently detach the dataflow edge — unlink first "
+                "or link with two_way=True" % (self.name, obj, src, src_name))
+        obj.__dict__[self.name] = value
+
+    def __delete__(self, obj):
+        obj.__dict__.get("_linked_attrs_", {}).pop(self.name, None)
+        obj.__dict__.pop(self.name, None)
+
+
+def _install_forwarding(cls, name):
+    sentinel = object()
+    current = getattr(cls, name, sentinel)
+    if isinstance(current, _Forward):
+        return
+    if isinstance(current, property):
+        raise ValueError(
+            "cannot link over property %s.%s" % (cls.__name__, name))
+    if current is not sentinel:
+        # Preserve the plain class-level default for unlinked instances.
+        setattr(cls, name, _Forward(name, default=current, has_default=True))
+    else:
+        setattr(cls, name, _Forward(name))

@@ -1,0 +1,69 @@
+"""Control plumbing pseudo-units (ref ``veles/plumbing.py``).
+
+``StartPoint`` (ref ``:44``) fires the graph; ``EndPoint`` (ref ``:60``)
+signals workflow completion; ``Repeater`` (ref ``:17``) is the loop anchor —
+it ignores its gate so the back-edge from the loop body re-fires it;
+``FireStarter`` (ref ``:92``) re-opens gates of selected units.
+"""
+
+from veles_tpu.units import Unit
+
+
+class Repeater(Unit):
+    """Loop anchor: ignores open_gate so any single incoming edge re-fires
+    the loop body (ref ``plumbing.py:17-41``)."""
+
+    hide_from_registry = False
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("view_group", "PLUMBING")
+        super(Repeater, self).__init__(workflow, **kwargs)
+        self.ignores_gate = True
+
+    def open_gate(self, src):
+        # Any one fired edge opens the gate (vs. the default ALL).
+        with self._gate_lock_:
+            for key in self.links_from:
+                self.links_from[key] = False
+            return True
+
+
+class StartPoint(Unit):
+    """The workflow's entry unit (ref ``plumbing.py:44-57``)."""
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("name", "Start")
+        super(StartPoint, self).__init__(workflow, **kwargs)
+
+
+class EndPoint(Unit):
+    """The workflow's exit unit: running it finishes the workflow
+    (ref ``plumbing.py:60-89``)."""
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("name", "End")
+        super(EndPoint, self).__init__(workflow, **kwargs)
+
+    def run(self):
+        wf = self.workflow
+        if wf is not None:
+            wf.on_workflow_finished()
+
+    def run_dependent(self):
+        # Terminal unit: nothing downstream.
+        pass
+
+
+class FireStarter(Unit):
+    """Re-arms the gates of its ``units`` set each time it runs
+    (ref ``plumbing.py:92-118``)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(FireStarter, self).__init__(workflow, **kwargs)
+        self.units = kwargs.get("units", [])
+
+    def run(self):
+        for unit in self.units:
+            with unit._gate_lock_:
+                for key in unit.links_from:
+                    unit.links_from[key] = False

@@ -1,0 +1,137 @@
+"""Named deterministic random streams over JAX threefry keys.
+
+Parity target: reference ``veles/prng/`` — named generators (``"master"``
+etc., ``random_generator.py:64``), seeding from file bytes or integers
+(``:106``), state pickling (``:93-99``), and a device-side uniform stream
+unit backed by xorshift1024* kernels (``prng/uniform.py:49``,
+``ocl/random.cl``).
+
+TPU re-design: the stream IS a ``jax.random`` key that is *split*, never
+reused — a counter-based design that stays deterministic under ``vmap`` /
+``pjit`` / retraces (the reference's mutable xorshift state cannot).  Each
+named generator also carries a mirrored ``numpy.random.Generator`` for
+host-side consumers (shuffling, loaders) so interpret-mode runs match.
+"""
+
+import hashlib
+import os
+import threading
+
+import numpy
+
+_streams = {}
+_lock = threading.Lock()
+
+
+class RandomGenerator(object):
+    """A named deterministic stream.
+
+    Holds a JAX PRNG key (split-on-demand) and a numpy Generator seeded from
+    the same entropy.  Pickleable: state is (seed, counter).
+    """
+
+    def __init__(self, name, seed=None):
+        self.name = name
+        self.seed(seed if seed is not None else 0x5eed)
+
+    # -- seeding -----------------------------------------------------------
+    def seed(self, seed):
+        """Seed from an int, bytes, or a file path (ref
+        ``random_generator.py:106`` accepts file contents /dev/urandom)."""
+        if isinstance(seed, str) and os.path.exists(seed):
+            with open(seed, "rb") as fin:
+                seed = fin.read(64)
+        if isinstance(seed, (bytes, bytearray)):
+            seed = int.from_bytes(
+                hashlib.sha256(bytes(seed)).digest()[:8], "little")
+        self._seed = int(seed) & 0xFFFFFFFFFFFFFFFF
+        self._counter = 0
+        self._numpy = numpy.random.Generator(
+            numpy.random.Philox(key=self._seed))
+        return self
+
+    # -- JAX side ----------------------------------------------------------
+    @property
+    def jax_seed(self):
+        return self._seed
+
+    def key(self):
+        """Return a fresh, never-before-returned JAX PRNG key.
+
+        Derivation is ``fold_in(key(seed), counter)`` — reproducible given
+        (seed, number of prior draws), stable across processes.
+        """
+        import jax
+        self._counter += 1
+        base = jax.random.key(self._seed)
+        return jax.random.fold_in(base, self._counter)
+
+    # -- numpy side (host consumers: shuffles, init fills) -----------------
+    @property
+    def numpy(self):
+        return self._numpy
+
+    def shuffle(self, arr):
+        self._counter += 1
+        self._numpy.shuffle(arr)
+
+    def permutation(self, n):
+        self._counter += 1
+        return self._numpy.permutation(n)
+
+    def fill_normal(self, arr, stddev=1.0, mean=0.0):
+        self._counter += 1
+        arr[...] = self._numpy.normal(
+            loc=mean, scale=stddev, size=arr.shape).astype(arr.dtype)
+
+    def fill_uniform(self, arr, low=-1.0, high=1.0):
+        self._counter += 1
+        arr[...] = self._numpy.uniform(
+            low=low, high=high, size=arr.shape).astype(arr.dtype)
+
+    def randint(self, low, high=None, size=None):
+        self._counter += 1
+        return self._numpy.integers(low, high, size=size)
+
+    # -- pickling ----------------------------------------------------------
+    def __getstate__(self):
+        # The exact Philox position (counter/buffer) rides along so a
+        # resumed run continues the identical numpy stream (ref
+        # ``random_generator.py:93-99`` pickles the mtrand state tuple).
+        return {"name": self.name, "seed": self._seed,
+                "counter": self._counter,
+                "numpy_state": self._numpy.bit_generator.state}
+
+    def __setstate__(self, state):
+        self.name = state["name"]
+        self._seed = state["seed"]
+        self._counter = state["counter"]
+        self._numpy = numpy.random.Generator(
+            numpy.random.Philox(key=self._seed))
+        self._numpy.bit_generator.state = state["numpy_state"]
+
+    def __repr__(self):
+        return "<RandomGenerator %r seed=%#x n=%d>" % (
+            self.name, self._seed, self._counter)
+
+
+def get(name="master"):
+    """The named-stream registry (ref ``prng/__init__.py`` ``get``)."""
+    with _lock:
+        stream = _streams.get(name)
+        if stream is None:
+            stream = _streams[name] = RandomGenerator(name)
+        return stream
+
+
+def seed_all(seed):
+    """Seed every existing stream plus the master, deterministically
+    differentiated by name hash (so streams stay independent)."""
+    with _lock:
+        names = set(_streams) | {"master"}
+        for name in names:
+            offset = int.from_bytes(
+                hashlib.sha256(name.encode()).digest()[:4], "little")
+            if name not in _streams:
+                _streams[name] = RandomGenerator(name)
+            _streams[name].seed(int(seed) + offset)

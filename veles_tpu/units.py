@@ -1,0 +1,270 @@
+"""Unit: the dataflow + controlflow node of a workflow graph.
+
+Parity target: reference ``veles/units.py`` —
+
+* ``IUnit`` protocol ``initialize()/run()/stop()`` (``units.py:59-77``);
+* control-flow edges via ``link_from`` (``units.py:554``) with gate
+  semantics ``open_gate`` / ``gate_block`` / ``gate_skip``
+  (``units.py:524-545, 281-305``): a unit runs when ALL of its incoming
+  control links have fired; ``gate_block`` suppresses run+propagation,
+  ``gate_skip`` suppresses run but propagates;
+* data edges via ``link_attrs`` (``units.py:638``) backed by
+  :class:`veles_tpu.mutable.LinkableAttribute`;
+* ``demand()`` declared-dependency checking (``units.py:682``);
+* per-unit wall-time accounting (``units.py:166-196``);
+* class auto-registration (``veles/unit_registry.py:51``).
+
+TPU re-design: the reference trampolines ``_check_gate_and_run`` through a
+Twisted thread pool (``units.py:496-505``) because each unit's ``run()``
+blocks on an eager OpenCL/CUDA queue.  Under JAX, device work is
+asynchronously dispatched and the host side is cheap, so the scheduler is an
+*iterative work queue* owned by the workflow: ``run_dependent`` enqueues
+ready units, the workflow loop pops-and-runs.  This is deterministic
+(stable, FIFO ordering), cannot blow the stack on million-iteration
+Repeater loops, and keeps the graph semantics bit-identical.  Host-blocking
+units (loaders doing disk IO, plotters) may opt into background execution
+via ``wants_thread = True``.
+"""
+
+import threading
+import time
+import weakref
+
+from veles_tpu.config import root
+from veles_tpu.distributable import Distributable
+from veles_tpu.mutable import Bool, LinkableAttribute
+
+
+class MissingDemandedAttributes(AttributeError):
+    """A demanded attribute is not yet available.  Distinct from plain
+    AttributeError so Workflow.initialize's partial-init requeue does not
+    mask genuine bugs inside unit ``initialize()`` bodies."""
+
+
+class UnitRegistry(type):
+    """Metaclass auto-registering every Unit subclass
+    (ref ``veles/unit_registry.py:51``)."""
+
+    units = {}
+    #: Optional name→class mapping used by MappedUnitRegistry clients
+    #: (package export/import, frontend generation).
+    mapped = {}
+
+    def __init__(cls, name, bases, namespace):
+        super(UnitRegistry, cls).__init__(name, bases, namespace)
+        if name != "Unit" and not namespace.get("hide_from_registry", False):
+            UnitRegistry.units[name] = cls
+            mapping = namespace.get("MAPPING")
+            if mapping:
+                UnitRegistry.mapped[mapping] = cls
+
+
+class IUnit(object):
+    """The unit contract (ref ``units.py:59-77``).  Documented here; duck
+    typing is verified by :meth:`Unit.verify_interface` at initialize time
+    (replacing the reference's zope.interface machinery,
+    ``veles/verified.py:45``)."""
+
+    def initialize(self, **kwargs):
+        """Allocate buffers / compile; may be re-called after re-linking."""
+
+    def run(self):
+        """Do one step of work."""
+
+    def stop(self):
+        """Called once when the workflow is shutting down."""
+
+
+class Unit(Distributable, metaclass=UnitRegistry):
+    """Dataflow+controlflow graph node."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.name = kwargs.get("name", self.__class__.__name__)
+        self.view_group = kwargs.get("view_group", "PLUMBING")
+        #: incoming control edges: {unit: fired?}
+        self.links_from = {}
+        #: outgoing control edges: {unit: True}
+        self.links_to = {}
+        self.gate_block = Bool(False)
+        self.gate_skip = Bool(False)
+        #: set by initialize(); cleared when links change
+        self._is_initialized = False
+        #: declared-required attribute names (ref demand units.py:682)
+        self._demanded = set()
+        self.ignores_gate = False
+        #: wants_thread: host-blocking units may run in the workflow's
+        #: background executor instead of the main scheduler loop.
+        self.wants_thread = False
+        #: accumulated run() wall-time (ref ``units.py:166-196`` kept the
+        #: equivalent in a class-level ``timers`` dict keyed by id; an
+        #: instance float avoids the id-reuse/leak hazard and pickles with
+        #: the unit so stats survive snapshots)
+        self.total_run_time = 0.0
+        super(Unit, self).__init__(**kwargs)
+        self._workflow_ref_ = None
+        if workflow is not None:
+            workflow.add_ref(self)
+
+    def init_unpickled(self):
+        super(Unit, self).init_unpickled()
+        self._gate_lock_ = threading.Lock()
+        self._run_lock_ = threading.Lock()
+
+    def __repr__(self):
+        return '<%s "%s">' % (self.__class__.__name__, self.name)
+
+    # -- workflow membership ----------------------------------------------
+    @property
+    def workflow(self):
+        return self._workflow_ref_() if self._workflow_ref_ is not None else None
+
+    @workflow.setter
+    def workflow(self, value):
+        self._workflow_ref_ = weakref.ref(value) if value is not None else None
+
+    @property
+    def is_initialized(self):
+        return self._is_initialized
+
+    @property
+    def is_master(self):
+        wf = self.workflow
+        return wf.is_master if wf is not None else False
+
+    @property
+    def is_slave(self):
+        wf = self.workflow
+        return wf.is_slave if wf is not None else False
+
+    @property
+    def is_standalone(self):
+        wf = self.workflow
+        return wf.is_standalone if wf is not None else True
+
+    # -- graph construction -------------------------------------------------
+    def link_from(self, *src_units):
+        """Add control edges ``src → self`` (ref ``units.py:554``)."""
+        for src in src_units:
+            self.links_from[src] = False
+            src.links_to[self] = True
+        self._is_initialized = False
+        return self
+
+    def unlink_from(self, *src_units):
+        for src in src_units:
+            self.links_from.pop(src, None)
+            src.links_to.pop(self, None)
+        return self
+
+    def unlink_all(self):
+        for src in list(self.links_from):
+            self.unlink_from(src)
+        for dst in list(self.links_to):
+            dst.unlink_from(self)
+        return self
+
+    def link_attrs(self, other, *names, two_way=False):
+        """Add data edges: alias ``self.<dst>`` to ``other.<src>``
+        (ref ``units.py:638``).  Each name is either a string (same name on
+        both sides) or a ``(dst_name, src_name)`` pair."""
+        for name in names:
+            if isinstance(name, tuple):
+                dst_name, src_name = name
+            else:
+                dst_name = src_name = name
+            LinkableAttribute.link(self, dst_name, other, src_name,
+                                   two_way=two_way)
+        return self
+
+    def demand(self, *names):
+        """Declare attributes that must be linked/set before initialize
+        (ref ``units.py:682``)."""
+        self._demanded.update(names)
+
+    # -- interface verification (replaces zope.interface, verified.py:45) --
+    def verify_interface(self):
+        missing = [n for n in self._demanded
+                   if getattr(self, n, None) is None]
+        if missing:
+            raise MissingDemandedAttributes(
+                "%r is missing demanded attributes: %s — link_attrs() them "
+                "from a producer unit" % (self, ", ".join(sorted(missing))))
+
+    # -- lifecycle ----------------------------------------------------------
+    def initialize(self, **kwargs):
+        self.verify_interface()
+        self._is_initialized = True
+
+    def run(self):
+        pass
+
+    def stop(self):
+        pass
+
+    # -- gate semantics (ref units.py:524-545, 782-803) ---------------------
+    def open_gate(self, src):
+        """Mark the edge from ``src`` as fired; return True when ALL
+        incoming edges have fired (and reset them)."""
+        with self._gate_lock_:
+            if src is not None and src in self.links_from:
+                self.links_from[src] = True
+            if not all(self.links_from.values()):
+                return False
+            for key in self.links_from:
+                self.links_from[key] = False
+            return True
+
+    def _check_gate_and_run(self, src):
+        """The hot loop body (ref ``units.py:782``)."""
+        if not self.open_gate(src) and not self.ignores_gate:
+            return
+        if bool(self.gate_block):
+            return
+        if not bool(self.gate_skip):
+            self.run_wrapped()
+        self.run_dependent()
+
+    def run_wrapped(self):
+        """run() with timing + stop-check (ref ``units.py:184-196``)."""
+        wf = self.workflow
+        if wf is not None and wf.stopped:
+            return
+        tic = time.time()
+        try:
+            self.run()
+        except Exception:
+            self.error("failed to run %r", self)
+            if wf is not None:
+                wf.on_unit_failed(self)
+            raise
+        finally:
+            elapsed = time.time() - tic
+            self.total_run_time += elapsed
+            if self.__class__.__name__ in root.common.get("timings", set()):
+                self.debug("%s ran in %.3f ms", self.name, elapsed * 1e3)
+
+    def run_dependent(self):
+        """Enqueue all downstream units on the workflow scheduler
+        (ref ``units.py:485-505``, re-designed as an iterative queue)."""
+        wf = self.workflow
+        if wf is None or wf.stopped:
+            return
+        for dst in self.links_to:
+            wf.schedule(dst, self)
+
+    # -- misc ---------------------------------------------------------------
+    @property
+    def run_time(self):
+        return self.total_run_time
+
+    def describe(self):
+        return {
+            "name": self.name,
+            "class": type(self).__name__,
+            "links_from": [u.name for u in self.links_from],
+            "links_to": [u.name for u in self.links_to],
+            "gate_block": bool(self.gate_block),
+            "gate_skip": bool(self.gate_skip),
+        }

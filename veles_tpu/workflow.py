@@ -1,0 +1,353 @@
+"""Workflow: the container unit executing a graph of units.
+
+Parity target: reference ``veles/workflow.py`` —
+
+* ``Workflow`` (``workflow.py:87``): unit container with
+  ``start_point``/``end_point``, per-unit ``add_ref`` registration
+  (``:402``), initialization in dependency order with partial-init requeue
+  (``:303-336``), run/stop lifecycle (``:351-377``), run-time statistics
+  (``:767-826``), result gathering (``:827-851``), content checksum
+  (``:852-866``), graphviz export (``:628``) and the master–slave job
+  protocol (``generate_data_for_slave`` ``:478``,
+  ``apply_data_from_slave`` ``:533``, ``do_job`` ``:558``).
+
+TPU re-design: execution is an iterative FIFO work-queue (see
+:mod:`veles_tpu.units` module docstring) — single-threaded and
+deterministic by default, with an optional background executor for
+host-blocking units.  Device work inside unit ``run()`` bodies is
+asynchronously dispatched by JAX, so the queue loop overlaps host
+scheduling with TPU compute naturally.
+"""
+
+import collections
+import hashlib
+import inspect
+import threading
+import time
+
+from veles_tpu.plumbing import EndPoint, StartPoint
+from veles_tpu.units import Unit
+
+
+class NoMoreJobs(Exception):
+    """Master has no further jobs for slaves (ref ``workflow.py:498``)."""
+
+
+class Workflow(Unit):
+    """Container unit holding and executing a unit graph."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, **kwargs):
+        self._units = []
+        self._sync_ = None
+        self.result_file = kwargs.get("result_file")
+        super(Workflow, self).__init__(workflow, **kwargs)
+        self._launcher = kwargs.get("launcher")
+        self.stopped = False
+        self._run_time = 0.0
+        self.start_point = StartPoint(self)
+        self.end_point = EndPoint(self)
+        self.negotiates_on_connect = True
+
+    def init_unpickled(self):
+        super(Workflow, self).init_unpickled()
+        self._queue_ = collections.deque()
+        self._queue_lock_ = threading.Lock()
+        self._finished_event_ = threading.Event()
+        self._job_callback_ = None
+
+    def __setstate__(self, state):
+        super(Workflow, self).__setstate__(state)
+        # workflow back-references are weakrefs (transient) — re-link.
+        for unit in self._units:
+            unit.workflow = self
+
+    # -- membership ---------------------------------------------------------
+    def add_ref(self, unit):
+        """Units self-register on construction (ref ``workflow.py:402``)."""
+        if unit is self:
+            raise ValueError("a workflow cannot contain itself")
+        if unit not in self._units:
+            self._units.append(unit)
+        unit.workflow = self
+
+    def del_ref(self, unit):
+        if unit in self._units:
+            self._units.remove(unit)
+
+    @property
+    def units(self):
+        return list(self._units)
+
+    def __iter__(self):
+        return iter(self._units)
+
+    def __len__(self):
+        return len(self._units)
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            for unit in self._units:
+                if unit.name == key:
+                    return unit
+            raise KeyError(key)
+        return self._units[key]
+
+    # -- mode flags ---------------------------------------------------------
+    @property
+    def launcher(self):
+        return self._launcher
+
+    @launcher.setter
+    def launcher(self, value):
+        self._launcher = value
+
+    @property
+    def is_master(self):
+        return getattr(self._launcher, "is_master", False)
+
+    @property
+    def is_slave(self):
+        return getattr(self._launcher, "is_slave", False)
+
+    @property
+    def is_standalone(self):
+        return getattr(self._launcher, "is_standalone", True)
+
+    # -- initialization ----------------------------------------------------
+    def units_in_dependency_order(self):
+        """BFS from start_point over control edges; unreachable units are
+        appended afterwards in insertion order (ref ``workflow.py:269``)."""
+        seen = []
+        seen_set = set()
+        frontier = collections.deque([self.start_point])
+        while frontier:
+            unit = frontier.popleft()
+            if id(unit) in seen_set:
+                continue
+            seen_set.add(id(unit))
+            seen.append(unit)
+            for dst in unit.links_to:
+                if id(dst) not in seen_set:
+                    frontier.append(dst)
+        for unit in self._units:
+            if id(unit) not in seen_set:
+                seen_set.add(id(unit))
+                seen.append(unit)
+        return seen
+
+    def initialize(self, device=None, **kwargs):
+        """Initialize all units in dependency order with partial-init
+        requeue (ref ``workflow.py:303-336``): a unit whose demanded
+        attributes are not yet produced is retried after its producers.
+        Only :class:`~veles_tpu.units.MissingDemandedAttributes` requeues —
+        each unit at most once per remaining peer — so genuine
+        AttributeError bugs in ``initialize()`` bodies surface immediately."""
+        from veles_tpu.units import MissingDemandedAttributes
+        self.device = device
+        pending = collections.deque(self.units_in_dependency_order())
+        retries = {}
+        limit = len(pending)
+        while pending:
+            unit = pending.popleft()
+            try:
+                if device is not None and _accepts_kwarg(
+                        unit.initialize, "device"):
+                    unit.initialize(device=device, **kwargs)
+                else:
+                    unit.initialize(**kwargs)
+            except MissingDemandedAttributes:
+                retries[id(unit)] = retries.get(id(unit), 0) + 1
+                if retries[id(unit)] > limit:
+                    raise
+                pending.append(unit)
+        self._is_initialized = True
+        self.stopped = False
+        return self
+
+    # -- execution ----------------------------------------------------------
+    def schedule(self, unit, src):
+        """Enqueue a gate check for ``unit`` triggered by ``src``."""
+        with self._queue_lock_:
+            self._queue_.append((unit, src))
+
+    def run(self):
+        """Run the graph to completion (ref ``workflow.py:351-377``).
+
+        The master never executes the graph body — job generation drives it
+        instead (ref ``workflow.py:350-354``)."""
+        if not self._is_initialized:
+            raise RuntimeError("initialize() the workflow before run()")
+        if self.is_master:
+            return
+        self.stopped = False
+        self._finished_event_.clear()
+        tic = time.time()
+        self.event("run", "begin")
+        self.schedule(self.start_point, None)
+        self._drain()
+        self._run_time += time.time() - tic
+        self.event("run", "end")
+
+    def _drain(self):
+        queue = self._queue_
+        while True:
+            with self._queue_lock_:
+                if not queue or self.stopped:
+                    break
+                unit, src = queue.popleft()
+            unit._check_gate_and_run(src)
+        with self._queue_lock_:
+            queue.clear()
+
+    def stop(self):
+        self.stopped = True
+        for unit in self._units:
+            unit.stop()
+
+    def on_workflow_finished(self):
+        self.stopped = True
+        self._finished_event_.set()
+        cb, self._job_callback_ = self._job_callback_, None
+        if cb is not None:
+            cb(self.generate_data_for_master())
+        if self.result_file:
+            self.write_results()
+
+    def on_unit_failed(self, unit):
+        self.warning("unit %r failed; stopping workflow", unit)
+        self.stopped = True
+        self._finished_event_.set()
+
+    @property
+    def run_time(self):
+        return self._run_time
+
+    # -- master/slave job protocol (ref workflow.py:478-617) ----------------
+    def generate_data_for_slave(self, slave=None):
+        """Per-unit payload list in dependency order; ``None`` entries for
+        units that only negotiate on connect (ref ``workflow.py:478-510``)."""
+        data = []
+        for unit in self.units_in_dependency_order():
+            if unit is self:
+                continue
+            data.append(unit.generate_data_for_slave(slave))
+        return data
+
+    def apply_data_from_master(self, data):
+        units = [u for u in self.units_in_dependency_order() if u is not self]
+        if len(data) != len(units):
+            raise ValueError(
+                "job payload has %d entries for %d units — master/slave "
+                "workflow checksum mismatch?" % (len(data), len(units)))
+        for unit, payload in zip(units, data):
+            if payload is not None:
+                unit.apply_data_from_master(payload)
+
+    def generate_data_for_master(self):
+        return [u.generate_data_for_master()
+                for u in self.units_in_dependency_order() if u is not self]
+
+    def apply_data_from_slave(self, data, slave=None):
+        units = [u for u in self.units_in_dependency_order() if u is not self]
+        if len(data) != len(units):
+            raise ValueError(
+                "update payload has %d entries for %d units — master/slave "
+                "workflow checksum mismatch?" % (len(data), len(units)))
+        for unit, payload in zip(units, data):
+            if payload is not None:
+                unit.apply_data_from_slave(payload, slave)
+
+    def drop_slave(self, slave=None):
+        for unit in self._units:
+            unit.drop_slave(slave)
+
+    def do_job(self, data, callback):
+        """Slave side: install payload, run, send update via ``callback``
+        (ref ``workflow.py:558-576``)."""
+        self.apply_data_from_master(data)
+        self._job_callback_ = callback
+        self.run()
+
+    # -- results / stats ----------------------------------------------------
+    def gather_results(self):
+        """Collect metrics from IResultProvider units
+        (ref ``workflow.py:827-851``)."""
+        results = {}
+        for unit in self._units:
+            get = getattr(unit, "get_metric_values", None)
+            if callable(get):
+                try:
+                    results.update(get())
+                except Exception:
+                    self.exception("result provider %r failed", unit)
+        return results
+
+    def write_results(self, path=None):
+        import json
+        path = path or self.result_file
+        if not path:
+            return
+
+        def _default(obj):
+            try:
+                return float(obj)
+            except (TypeError, ValueError):
+                return repr(obj)
+        with open(path, "w") as fout:
+            json.dump(self.gather_results(), fout, indent=2,
+                      default=_default)
+
+    def get_unit_run_time_stats(self):
+        """(unit, seconds) sorted descending (ref ``workflow.py:767-826``)."""
+        stats = [(unit, unit.run_time) for unit in self._units]
+        stats.sort(key=lambda pair: -pair[1])
+        return stats
+
+    def print_stats(self, top=10):
+        total = sum(t for _, t in self.get_unit_run_time_stats()) or 1e-12
+        self.info("unit run-time stats (top %d):", top)
+        for unit, seconds in self.get_unit_run_time_stats()[:top]:
+            self.info("  %6.2f%%  %8.3f s  %s",
+                      100.0 * seconds / total, seconds, unit.name)
+
+    # -- identity / export --------------------------------------------------
+    def checksum(self):
+        """Content-address the workflow definition so master and slave can
+        verify they run the same code (ref ``workflow.py:852-866``)."""
+        sha = hashlib.sha256()
+        for unit in self.units_in_dependency_order():
+            sha.update(type(unit).__name__.encode())
+            sha.update(unit.name.encode())
+            try:
+                sha.update(inspect.getsource(type(unit)).encode())
+            except (OSError, TypeError):
+                pass
+        return sha.hexdigest()
+
+    def generate_graph(self):
+        """DOT text of the control graph (ref ``workflow.py:628``)."""
+        lines = ["digraph %s {" % type(self).__name__.replace(" ", "_")]
+        idx = {id(u): "u%d" % i for i, u in enumerate(self._units)}
+        for unit in self._units:
+            lines.append('  %s [label="%s\\n%s"];' % (
+                idx[id(unit)], type(unit).__name__, unit.name))
+        for unit in self._units:
+            for dst in unit.links_to:
+                if id(dst) in idx:
+                    lines.append("  %s -> %s;" % (idx[id(unit)],
+                                                  idx[id(dst)]))
+        lines.append("}")
+        return "\n".join(lines)
+
+
+def _accepts_kwarg(fn, name):
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    if name in sig.parameters:
+        return True
+    return any(p.kind is inspect.Parameter.VAR_KEYWORD
+               for p in sig.parameters.values())
