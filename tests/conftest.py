@@ -5,11 +5,19 @@ sharding tests exercise real multi-device code paths without TPU hardware
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set (the session env may point at a real TPU via an "axon" tunnel
+# platform; tests must run on the virtual CPU mesh regardless).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# sitecustomize may have force-registered a TPU tunnel platform and set
+# jax_platforms behind the env var's back; override before backend init.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,85 @@
+"""Backend registry + device tests (ref ``veles/tests/`` backend coverage;
+runs on the virtual 8-device CPU mesh from conftest)."""
+
+import numpy
+import pytest
+
+from veles_tpu.backends import (
+    AutoDevice, BackendRegistry, CPUDevice, DeviceInfo, NumpyDevice,
+    TPUDevice, make_device)
+
+
+def test_registry_has_all_backends():
+    for name in ("tpu", "cpu", "numpy", "auto"):
+        assert name in BackendRegistry.backends
+
+
+def test_numpy_device_roundtrip():
+    dev = NumpyDevice()
+    assert dev.exists and dev.is_interpret
+    arr = numpy.arange(6, dtype=numpy.float32)
+    assert (dev.get(dev.put(arr)) == arr).all()
+
+
+def test_cpu_device_mesh():
+    dev = CPUDevice()
+    assert dev.exists
+    assert dev.num_devices == 8      # conftest forces 8 virtual devices
+    mesh = dev.mesh                  # default: data axis absorbs all
+    assert mesh.shape["data"] == 8
+
+
+def test_custom_mesh_axes():
+    dev = CPUDevice()
+    mesh = dev.make_mesh({"data": 2, "model": 4})
+    assert mesh.shape == {"data": 2, "model": 4}
+    mesh2 = dev.make_mesh({"data": -1, "model": 2})
+    assert mesh2.shape == {"data": 4, "model": 2}
+
+
+def test_cpu_put_get_sync():
+    dev = CPUDevice()
+    arr = numpy.random.rand(4, 4).astype(numpy.float32)
+    dev_arr = dev.put(arr)
+    dev.sync()
+    assert numpy.allclose(dev.get(dev_arr), arr)
+
+
+def test_auto_device_picks_best_existing():
+    dev = AutoDevice()
+    # No TPU under the forced-CPU test env → CPU (priority 20) wins
+    # over numpy (priority 10).
+    assert dev.BACKEND in ("tpu", "cpu")
+
+
+def test_make_device_by_name():
+    assert make_device("numpy").is_interpret
+    with pytest.raises(ValueError):
+        make_device("opencl")
+
+
+def test_tpu_device_absent_under_cpu_env():
+    dev = TPUDevice()
+    assert not dev.exists
+
+
+def test_device_pickle_roundtrip():
+    import pickle
+    dev = CPUDevice()
+    restored = pickle.loads(pickle.dumps(dev))
+    assert restored.exists
+    assert restored.num_devices == 8
+
+
+def test_device_info_db_roundtrip(tmp_path):
+    info = DeviceInfo("TPU v5e")
+    info.ratings = {"gemm": {"float32": {"time": 0.01,
+                                         "tiles": [256, 512, 256]}}}
+    path = str(tmp_path / "device_infos.json")
+    DeviceInfo.save_db({"TPU v5e": info}, path)
+    db = DeviceInfo.load_db(path)
+    assert db["TPU v5e"].get_kernel_tiles("gemm", "float32") == \
+        [256, 512, 256]
+    assert db["TPU v5e"].get_kernel_tiles("gemm", "bfloat16",
+                                          default=[128, 128, 128]) == \
+        [128, 128, 128]

@@ -1,0 +1,196 @@
+"""Golden tests for the kernel substrate vs numpy reference — mirrors the
+reference's ``test_ocl_blas.py`` / ``test_random.py`` strategy: every op
+checked against a plain numpy computation, and the Pallas path checked in
+interpret mode on CPU (the TPU hardware run is exercised by bench.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.ops import gemm, normalize, reduce as reduce_ops
+from veles_tpu.ops.join import join as join_op
+from veles_tpu.ops.gather import _gather_jnp, _gather_pallas, take_rows
+from veles_tpu.ops.random import dropout_mask, normal, uniform
+
+
+class TestMatmul:
+    def _golden(self, m, k, n, activation=None, bias=False, seed=0):
+        rng = numpy.random.default_rng(seed)
+        a = rng.standard_normal((m, k), dtype=numpy.float32)
+        b = rng.standard_normal((k, n), dtype=numpy.float32)
+        bv = rng.standard_normal(n, dtype=numpy.float32) if bias else None
+        ref = a @ b
+        if bias:
+            ref = ref + bv
+        if activation == "tanh":
+            ref = 1.7159 * numpy.tanh(0.6666 * ref)
+        elif activation == "strict_relu":
+            ref = numpy.maximum(ref, 0)
+        return a, b, bv, ref
+
+    def test_jnp_path(self):
+        a, b, bv, ref = self._golden(17, 33, 9, bias=True)
+        out = gemm.matmul(a, b, bv, use_pallas=False)
+        assert numpy.allclose(out, ref, atol=1e-4)
+
+    def test_pallas_interpret_matches(self):
+        a, b, bv, ref = self._golden(16, 128, 128, bias=True)
+        from veles_tpu.config import root
+        root.common.engine.interpret = True
+        try:
+            out = gemm.matmul(a, b, bv, use_pallas=True)
+        finally:
+            root.common.engine.interpret = False
+        assert numpy.allclose(out, ref, atol=1e-4)
+
+    def test_pallas_unaligned_shapes(self):
+        a, b, _, ref = self._golden(33, 70, 130)
+        from veles_tpu.config import root
+        root.common.engine.interpret = True
+        try:
+            out = gemm.matmul(a, b, use_pallas=True)
+        finally:
+            root.common.engine.interpret = False
+        assert numpy.allclose(out, ref, atol=1e-4)
+
+    def test_activation_fused(self):
+        a, b, bv, ref = self._golden(8, 16, 4, activation="tanh", bias=True)
+        out = gemm.matmul(a, b, bv, "tanh", use_pallas=False)
+        assert numpy.allclose(out, ref, atol=1e-4)
+
+    def test_grad_through_matmul(self):
+        """custom VJP: jax.grad through matmul matches numerical grad of
+        plain jnp composition."""
+        a = numpy.random.default_rng(1).standard_normal(
+            (4, 6)).astype(numpy.float32)
+        b = numpy.random.default_rng(2).standard_normal(
+            (6, 3)).astype(numpy.float32)
+
+        def loss_ours(a_, b_):
+            return jnp.sum(gemm.matmul(a_, b_, None, "tanh",
+                                       use_pallas=False) ** 2)
+
+        def loss_ref(a_, b_):
+            return jnp.sum((1.7159 * jnp.tanh(0.6666 * (a_ @ b_))) ** 2)
+
+        ga, gb = jax.grad(loss_ours, argnums=(0, 1))(a, b)
+        ra, rb = jax.grad(loss_ref, argnums=(0, 1))(a, b)
+        assert numpy.allclose(ga, ra, atol=1e-3)
+        assert numpy.allclose(gb, rb, atol=1e-3)
+
+    def test_grad_strict_relu(self):
+        a = numpy.random.default_rng(3).standard_normal(
+            (5, 7)).astype(numpy.float32)
+        b = numpy.random.default_rng(4).standard_normal(
+            (7, 2)).astype(numpy.float32)
+        ga = jax.grad(lambda a_: jnp.sum(gemm.matmul(
+            a_, b, None, "strict_relu", use_pallas=False)))(a)
+        ra = jax.grad(lambda a_: jnp.sum(
+            jnp.maximum(a_ @ b, 0)))(a)
+        assert numpy.allclose(ga, ra, atol=1e-4)
+
+    def test_bfloat16_inputs(self):
+        a, b, _, ref = self._golden(16, 32, 8)
+        out = gemm.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                          use_pallas=False)
+        assert out.dtype == jnp.bfloat16
+        assert numpy.allclose(numpy.asarray(out, numpy.float32), ref,
+                              atol=0.5, rtol=0.05)
+
+
+class TestReduce:
+    @pytest.mark.parametrize("axis", [0, 1])
+    @pytest.mark.parametrize("op", ["sum", "max", "min"])
+    def test_jnp(self, axis, op):
+        a = numpy.random.default_rng(0).standard_normal(
+            (37, 53)).astype(numpy.float32)
+        ref = getattr(numpy, op)(a, axis=axis)
+        out = reduce_ops.matrix_reduce(a, axis=axis, op=op,
+                                       use_pallas=False)
+        assert numpy.allclose(out, ref, atol=1e-4)
+
+    @pytest.mark.parametrize("axis", [0, 1])
+    def test_pallas_interpret(self, axis):
+        a = numpy.random.default_rng(1).standard_normal(
+            (24, 256)).astype(numpy.float32)
+        from veles_tpu.config import root
+        root.common.engine.interpret = True
+        try:
+            out = reduce_ops.matrix_reduce(a, axis=axis, use_pallas=True)
+        finally:
+            root.common.engine.interpret = False
+        assert numpy.allclose(out, a.sum(axis=axis), atol=1e-3)
+
+
+class TestGather:
+    def test_basic(self):
+        data = numpy.arange(40, dtype=numpy.float32).reshape(10, 4)
+        idx = numpy.array([3, 1, 7], dtype=numpy.int32)
+        out = take_rows(data, idx, use_pallas=False)
+        assert (numpy.asarray(out) == data[idx]).all()
+
+    def test_negative_index_zero_fill(self):
+        data = numpy.ones((5, 3), dtype=numpy.float32)
+        idx = numpy.array([0, -1, 2], dtype=numpy.int32)
+        out = numpy.asarray(take_rows(data, idx, use_pallas=False))
+        assert (out[1] == 0).all() and (out[0] == 1).all()
+
+    def test_pallas_interpret_matches_jnp(self):
+        data = numpy.random.default_rng(2).standard_normal(
+            (32, 128)).astype(numpy.float32)
+        idx = numpy.array([5, 0, 31, -1, 7], dtype=numpy.int32)
+        ref = numpy.asarray(_gather_jnp(jnp.asarray(data),
+                                        jnp.asarray(idx)))
+        out = numpy.asarray(_gather_pallas(jnp.asarray(data),
+                                           jnp.asarray(idx),
+                                           interpret=True))
+        assert numpy.allclose(out, ref)
+
+    def test_3d_data(self):
+        data = numpy.random.default_rng(3).standard_normal(
+            (6, 4, 5)).astype(numpy.float32)
+        idx = numpy.array([2, 4], dtype=numpy.int32)
+        out = numpy.asarray(take_rows(data, idx, use_pallas=False))
+        assert out.shape == (2, 4, 5)
+        assert numpy.allclose(out, data[idx])
+
+
+class TestRandomOps:
+    def test_uniform_range_and_determinism(self):
+        key = jax.random.key(42)
+        a = uniform(key, (1000,), low=-2.0, high=3.0)
+        b = uniform(key, (1000,), low=-2.0, high=3.0)
+        assert (numpy.asarray(a) == numpy.asarray(b)).all()
+        assert a.min() >= -2.0 and a.max() < 3.0
+
+    def test_normal_moments(self):
+        key = jax.random.key(7)
+        x = numpy.asarray(normal(key, (20000,), mean=1.0, stddev=2.0))
+        assert abs(x.mean() - 1.0) < 0.1
+        assert abs(x.std() - 2.0) < 0.1
+
+    def test_dropout_mask(self):
+        key = jax.random.key(0)
+        mask = numpy.asarray(dropout_mask(key, (10000,), 0.8))
+        kept = (mask > 0).mean()
+        assert 0.75 < kept < 0.85
+        assert numpy.allclose(mask[mask > 0], 1.0 / 0.8)
+
+
+class TestNormalizeJoin:
+    def test_mean_disp(self):
+        x = numpy.random.default_rng(0).standard_normal(
+            (8, 5)).astype(numpy.float32)
+        mean = x.mean(axis=0)
+        disp = 1.0 / (x.std(axis=0) + 1e-6)
+        out = numpy.asarray(normalize.mean_disp_normalize(
+            jnp.asarray(x), jnp.asarray(mean), jnp.asarray(disp)))
+        assert numpy.allclose(out, (x - mean) * disp, atol=1e-5)
+
+    def test_join_flattens_and_concats(self):
+        a = numpy.ones((4, 2, 3), dtype=numpy.float32)
+        b = numpy.zeros((4, 5), dtype=numpy.float32)
+        out = numpy.asarray(join_op([jnp.asarray(a), jnp.asarray(b)]))
+        assert out.shape == (4, 11)
+        assert (out[:, :6] == 1).all() and (out[:, 6:] == 0).all()

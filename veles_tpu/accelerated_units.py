@@ -1,0 +1,129 @@
+"""AcceleratedUnit: backend-dispatched compute units with a jit cache.
+
+Parity target: reference ``veles/accelerated_units.py`` —
+``AcceleratedUnit`` (``:130``) dispatches one of
+``numpy_run/ocl_run/cuda_run`` per attached device, builds + caches kernel
+programs (``build_program`` ``:298``, cache ``:605-674``) and optionally
+numba-JITs the numpy path (``:254-265``); ``AcceleratedWorkflow``
+(``:827``) owns the device.
+
+TPU re-design: the two backend methods are ``numpy_run`` (eager interpret
+— the debug path, pdb-able) and ``tpu_run`` (default: calls jitted pure
+functions over ``Vector.devmem`` arrays).  ``build_program``'s #define
+specialization + binary cache collapses into :meth:`AcceleratedUnit.jit`
+— XLA retraces per input shape and caches compiles; the unit-level cache
+table keyed by (fn, shapes) keeps retrace bookkeeping observable the way
+the reference's ``.cache`` dir was.
+"""
+
+import jax
+
+from veles_tpu.memory import Vector
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Workflow
+
+
+class AcceleratedUnit(Unit):
+    """Unit with per-backend execution paths."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(AcceleratedUnit, self).__init__(workflow, **kwargs)
+        self.device = None
+        self.intermittent = kwargs.get("intermittent", False)
+
+    def init_unpickled(self):
+        super(AcceleratedUnit, self).init_unpickled()
+        self._jit_cache_ = {}
+
+    # -- lifecycle ----------------------------------------------------------
+    def initialize(self, device=None, **kwargs):
+        """Attach the device and initialize all Vector attributes
+        (the reference scans the class hierarchy for backend interfaces,
+        ``accelerated_units.py:220-241``; here the contract is just the
+        two well-known method names)."""
+        if device is not None:
+            self.device = device
+        elif self.device is None:
+            wf = self.workflow
+            self.device = getattr(wf, "device", None)
+        super(AcceleratedUnit, self).initialize(**kwargs)
+        for vec in self._vectors():
+            vec.initialize(self.device)
+
+    def _vectors(self):
+        for value in self.__dict__.values():
+            if isinstance(value, Vector):
+                yield value
+
+    def init_vectors(self, *vectors):
+        for vec in vectors:
+            vec.initialize(self.device)
+
+    # -- dispatch -----------------------------------------------------------
+    @property
+    def is_interpret(self):
+        return self.device is None or self.device.is_interpret
+
+    def run(self):
+        if self.is_interpret:
+            return self.numpy_run()
+        return self.tpu_run()
+
+    def numpy_run(self):
+        raise NotImplementedError(
+            "%s defines no numpy_run" % type(self).__name__)
+
+    def tpu_run(self):
+        """Default: reuse the numpy path through the Vector coherence
+        protocol (correct but host-bound); compute units override with a
+        jitted body."""
+        return self.numpy_run()
+
+    # -- jit cache (replaces build_program/#define specialization) ----------
+    def jit(self, fn, static_argnums=(), donate_argnums=()):
+        """Compile-cache a pure function for this unit.
+
+        Keyed on the function *object* (never its name — same-named
+        closures must not alias); XLA handles per-shape retraces below
+        this.  Define the body once (module level or in ``initialize``)
+        rather than per call, or every call re-jits."""
+        key = (fn, tuple(static_argnums), tuple(donate_argnums))
+        cached = self._jit_cache_.get(key)
+        if cached is None:
+            cached = jax.jit(fn, static_argnums=static_argnums,
+                             donate_argnums=donate_argnums)
+            self._jit_cache_[key] = cached
+        return cached
+
+    @property
+    def compile_stats(self):
+        return {fn.__name__: getattr(jitted, "_cache_size",
+                                     lambda: None)()
+                for (fn, _, _), jitted in self._jit_cache_.items()}
+
+    def unmap_vectors(self, *vectors):
+        """Reference API compatibility (``accelerated_units.py:480``):
+        declare host edits finished on the given vectors."""
+        for vec in vectors:
+            vec.unmap()
+
+
+class AcceleratedWorkflow(Workflow):
+    """Workflow owning a device (ref ``accelerated_units.py:827``)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, **kwargs):
+        super(AcceleratedWorkflow, self).__init__(workflow, **kwargs)
+        self.device = kwargs.get("device")
+
+    def initialize(self, device=None, **kwargs):
+        if device is None:
+            device = self.device
+        if device is None:
+            from veles_tpu.backends import AutoDevice
+            device = AutoDevice()
+        return super(AcceleratedWorkflow, self).initialize(
+            device=device, **kwargs)

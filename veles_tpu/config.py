@@ -195,6 +195,9 @@ def update_from_arguments(pairs):
         parts = key.split(".")
         if parts[0] == "root":
             parts = parts[1:]
+        if not parts:
+            raise ValueError(
+                "override %r names no key below root" % pair)
         for part in parts[:-1]:
             node = getattr(node, part)
         setattr(node, parts[-1], value)

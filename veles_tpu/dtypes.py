@@ -1,0 +1,61 @@
+"""Dtype naming and policy (ref ``veles/opencl_types.py``).
+
+The reference maps numpy dtypes to OpenCL C type names and selects a
+"precision_type" float/double pair (``opencl_types.py:40-55``).  On TPU the
+interesting axis is float32 vs bfloat16 (MXU-native) with float32
+accumulation; float64 exists only for CPU debugging.
+"""
+
+import numpy
+
+try:
+    import ml_dtypes
+    bfloat16 = numpy.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    bfloat16 = numpy.dtype(numpy.float32)
+
+#: name → numpy dtype (superset of the reference's ``dtypes`` table)
+dtype_map = {
+    "float16": numpy.dtype(numpy.float16),
+    "bfloat16": bfloat16,
+    "float32": numpy.dtype(numpy.float32),
+    "float64": numpy.dtype(numpy.float64),
+    "int8": numpy.dtype(numpy.int8),
+    "uint8": numpy.dtype(numpy.uint8),
+    "int16": numpy.dtype(numpy.int16),
+    "int32": numpy.dtype(numpy.int32),
+    "int64": numpy.dtype(numpy.int64),
+    "bool": numpy.dtype(numpy.bool_),
+}
+
+
+def dtype_by_name(name):
+    try:
+        return dtype_map[str(name)]
+    except KeyError:
+        return numpy.dtype(name)
+
+
+def accumulation_dtype(compute):
+    """Accumulator for reductions/matmuls over ``compute`` operands: low
+    precision floats accumulate in float32 (the MXU does this natively);
+    everything else accumulates in itself."""
+    compute = numpy.dtype(compute) if not hasattr(compute, "itemsize") \
+        else compute
+    if compute in (dtype_map["float16"], dtype_map["bfloat16"]):
+        return dtype_map["float32"]
+    return compute
+
+
+#: minimum Pallas tile (sublane, lane) per dtype — TPU tiling constraint
+min_tile = {
+    "float32": (8, 128),
+    "bfloat16": (16, 128),
+    "int8": (32, 128),
+    "float16": (16, 128),
+}
+
+
+def tile_for(dtype):
+    return min_tile.get(str(numpy.dtype(dtype) if not hasattr(
+        dtype, "itemsize") else dtype), (8, 128))

@@ -1,0 +1,219 @@
+"""Vector: the universal buffer bridging host numpy and device HBM.
+
+Parity target: reference ``veles/memory.py`` — ``Array`` (``:110``): a
+numpy mirror + device buffer with an explicit
+``map_read/map_write/map_invalidate/unmap`` coherence protocol
+(``:371-383``), transparent device→host sync when pickling
+(``__getstate__`` ``:284-299``) and a ``Watcher`` accounting peak device
+allocation (``:56-107``).
+
+TPU re-design: the device buffer is a ``jax.Array``.  JAX arrays are
+immutable, so the mutable-buffer protocol becomes *generation tracking*:
+the Vector knows whether host or device holds the freshest data and
+converts lazily.  ``map_write → unmap`` round-trips still work (host edit
+then re-upload), but the idiomatic fast path for jitted units is
+``v.devmem`` in / reassign ``v.devmem`` out — no copies, donation-friendly.
+Pickling syncs device→host exactly like the reference, so whole-workflow
+snapshots capture weights regardless of where they live.
+"""
+
+import threading
+
+import numpy
+
+from veles_tpu.distributable import Pickleable
+
+
+class Watcher(object):
+    """Device-memory accounting (ref ``memory.py:56-107``)."""
+
+    lock = threading.Lock()
+    bytes_in_use = 0
+    peak_bytes = 0
+
+    @classmethod
+    def track(cls, nbytes):
+        with cls.lock:
+            cls.bytes_in_use += nbytes
+            cls.peak_bytes = max(cls.peak_bytes, cls.bytes_in_use)
+
+    @classmethod
+    def untrack(cls, nbytes):
+        with cls.lock:
+            cls.bytes_in_use -= nbytes
+
+    @classmethod
+    def reset(cls):
+        with cls.lock:
+            cls.bytes_in_use = 0
+            cls.peak_bytes = 0
+
+
+class Vector(Pickleable):
+    """Host-mirrored device buffer."""
+
+    def __init__(self, data=None):
+        super(Vector, self).__init__()
+        self._mem = None          # host numpy array (may be stale)
+        self._device = None
+        if data is not None:
+            self.reset(data)
+
+    def init_unpickled(self):
+        super(Vector, self).init_unpickled()
+        self._devmem_ = None       # jax.Array (transient)
+        self._host_fresh_ = True   # host copy up to date
+        self._dev_fresh_ = False   # device copy up to date
+        self._tracked_bytes_ = 0
+
+    # -- basic properties ---------------------------------------------------
+    def reset(self, data):
+        """Install new host contents (ref ``Array.reset`` semantics)."""
+        self._mem = numpy.ascontiguousarray(data) \
+            if data is not None else None
+        self._drop_devmem()
+        self._host_fresh_ = True
+        self._dev_fresh_ = False
+        return self
+
+    @property
+    def shape(self):
+        ref = self._devmem_ if self._devmem_ is not None else self._mem
+        return tuple(ref.shape) if ref is not None else None
+
+    @property
+    def size(self):
+        shape = self.shape
+        if shape is None:
+            return 0
+        return int(numpy.prod(shape)) if shape else 1
+
+    @property
+    def dtype(self):
+        ref = self._devmem_ if self._devmem_ is not None else self._mem
+        return numpy.dtype(str(ref.dtype)) if ref is not None else None
+
+    @property
+    def nbytes(self):
+        ref = self._devmem_ if self._devmem_ is not None else self._mem
+        if ref is None:
+            return 0
+        return int(numpy.prod(ref.shape)) * ref.dtype.itemsize
+
+    def __bool__(self):
+        return self.shape is not None
+
+    def __len__(self):
+        shape = self.shape
+        return shape[0] if shape else 0
+
+    def __repr__(self):
+        where = "dev" if (self._devmem_ is not None
+                          and not self._host_fresh_) else "host"
+        return "<Vector %s %s @%s>" % (self.shape, self.dtype, where)
+
+    # -- device attachment --------------------------------------------------
+    def initialize(self, device):
+        """Attach to a device; uploads lazily on first devmem access."""
+        self._device = device
+        return self
+
+    @property
+    def device(self):
+        return self._device
+
+    # -- the coherence protocol --------------------------------------------
+    @property
+    def mem(self):
+        """Host view.  Always safe to *read*; call :meth:`unmap` after
+        in-place writes to publish them to the device."""
+        self.map_read()
+        return self._mem
+
+    @mem.setter
+    def mem(self, value):
+        self.reset(value)
+
+    @property
+    def devmem(self):
+        """The ``jax.Array``; uploads the host copy if it is fresher."""
+        if self._device is None or self._device.is_interpret:
+            return self.mem
+        if self._devmem_ is None or not self._dev_fresh_:
+            if self._mem is None:
+                raise ValueError("empty Vector has no device memory")
+            self._set_devmem(self._device.put(self._mem))
+            self._dev_fresh_ = True   # host and device now agree
+        return self._devmem_
+
+    @devmem.setter
+    def devmem(self, value):
+        """Publish a new device array (the jitted-unit fast path)."""
+        if self._device is not None and self._device.is_interpret:
+            self._mem = numpy.asarray(value)
+            self._host_fresh_ = True
+            self._dev_fresh_ = False
+            return
+        self._set_devmem(value)
+        self._dev_fresh_ = True
+        self._host_fresh_ = False
+
+    def map_read(self):
+        """Ensure the host copy reflects device state (implicit D2H sync
+        point, ref ``memory.py:371``)."""
+        if not self._host_fresh_ and self._devmem_ is not None:
+            self._mem = numpy.asarray(self._devmem_)
+            self._host_fresh_ = True   # copies agree; device stays fresh
+        return self
+
+    def map_write(self):
+        """Declare intent to edit the host copy in place: next devmem
+        access re-uploads."""
+        self.map_read()
+        self._dev_fresh_ = False
+        return self
+
+    def map_invalidate(self):
+        """Declare the host copy garbage (device will be overwritten)."""
+        self._host_fresh_ = True
+        self._dev_fresh_ = False
+        self._drop_devmem()
+        return self
+
+    def unmap(self):
+        """Compatibility no-op: publishing host edits is what
+        :meth:`map_write` declares; the upload itself is lazy."""
+        return self
+
+    # -- pickling (snapshots) ----------------------------------------------
+    def __getstate__(self):
+        self.map_read()   # device → host sync (ref memory.py:284-299)
+        return super(Vector, self).__getstate__()
+
+    # -- helpers ------------------------------------------------------------
+    def _set_devmem(self, value):
+        if self._tracked_bytes_:
+            Watcher.untrack(self._tracked_bytes_)
+        self._devmem_ = value
+        self._tracked_bytes_ = (
+            int(numpy.prod(value.shape)) * value.dtype.itemsize
+            if value is not None and value.shape else 0)
+        if self._tracked_bytes_:
+            Watcher.track(self._tracked_bytes_)
+
+    def _drop_devmem(self):
+        if self._tracked_bytes_:
+            Watcher.untrack(self._tracked_bytes_)
+            self._tracked_bytes_ = 0
+        self._devmem_ = None
+
+    def __del__(self):
+        try:
+            self._drop_devmem()
+        except Exception:  # pragma: no cover - interpreter shutdown
+            pass
+
+
+#: Reference-compatible alias (the reference class is ``Array``,
+#: ``memory.py:110``; "Vector" is what Znicz unit attributes call theirs).
+Array = Vector

@@ -188,9 +188,10 @@ def _install_forwarding(cls, name):
     current = getattr(cls, name, sentinel)
     if isinstance(current, _Forward):
         return
-    if isinstance(current, property):
+    if isinstance(current, property) or callable(current):
         raise ValueError(
-            "cannot link over property %s.%s" % (cls.__name__, name))
+            "cannot link over existing class attribute %s.%s (%r) — pick a "
+            "different destination name" % (cls.__name__, name, current))
     if current is not sentinel:
         # Preserve the plain class-level default for unlinked instances.
         setattr(cls, name, _Forward(name, default=current, has_default=True))

@@ -1,0 +1,41 @@
+"""L0 kernel substrate: Pallas TPU kernels + jnp fallbacks.
+
+The TPU-native re-design of the reference's shared kernel templates
+(``ocl/`` + ``cuda/``, SURVEY §2.2):
+
+=====================  ==========================================
+reference template      this package
+=====================  ==========================================
+matrix_multiplication   :mod:`veles_tpu.ops.gemm` (Pallas tiled)
+matrix_reduce           :mod:`veles_tpu.ops.reduce`
+random (xorshift1024*)  :mod:`veles_tpu.ops.random` (TPU PRNG)
+fullbatch_loader        :mod:`veles_tpu.ops.gather`
+mean_disp_normalizer    :mod:`veles_tpu.ops.normalize`
+join.jcl (Jinja2)       :mod:`veles_tpu.ops.join`
+benchmark               :mod:`veles_tpu.ops.benchmark`
+=====================  ==========================================
+
+Every op has (a) a Pallas TPU kernel for the hot path and (b) a pure jnp
+fallback that XLA fuses — used on CPU, under interpret mode, and as the
+golden reference in tests.  Dispatch is by the current JAX default
+platform unless forced via ``use_pallas=``.
+"""
+
+from veles_tpu.ops.gemm import matmul  # noqa: F401
+from veles_tpu.ops.reduce import matrix_reduce  # noqa: F401
+from veles_tpu.ops.random import uniform, normal  # noqa: F401
+from veles_tpu.ops.gather import take_rows  # noqa: F401
+from veles_tpu.ops.normalize import mean_disp_normalize  # noqa: F401
+from veles_tpu.ops.join import join  # noqa: F401
+
+
+def on_tpu():
+    """True when the default JAX backend is a TPU (incl. tunnel
+    platforms whose devices report a TPU device_kind)."""
+    import jax
+    try:
+        dev = jax.devices()[0]
+    except RuntimeError:
+        return False
+    return "TPU" in getattr(dev, "device_kind", "").upper() \
+        or dev.platform == "tpu"

@@ -1,0 +1,112 @@
+"""Device rating + Pallas tile autotuner.
+
+Parity target: the reference's in-situ benchmark — 13 chained 4096×4096
+matmuls, min of 3 runs, producing the "computing power" rating used for
+master-side load balancing (``ocl/benchmark.cl:1-11``,
+``DeviceBenchmark`` ``accelerated_units.py:706-825``,
+``workflow.py:618-624``) — and the OpenCL block-size autotune that fills
+``devices/device_infos.json`` (``backends.py:623-744``).
+
+TPU re-design: the same chained-matmul rating (so powers are comparable
+across the fleet for job balancing) plus a tile search over the Pallas
+GEMM, persisted in the same DB schema.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.backends import DEVICE_INFOS_JSON, DeviceInfo
+from veles_tpu.ops.gemm import matmul
+
+BENCH_SIZE = 4096
+BENCH_CHAIN = 13
+
+#: candidate (bm, bk, bn) tiles — MXU-aligned sweep
+TILE_CANDIDATES = (
+    (128, 128, 128),
+    (128, 256, 128),
+    (256, 256, 256),
+    (256, 512, 256),
+    (512, 512, 512),
+    (512, 1024, 256),
+    (256, 1024, 512),
+)
+
+
+def estimate_device_power(device=None, size=BENCH_SIZE, chain=BENCH_CHAIN,
+                          runs=3, dtype=jnp.bfloat16, use_pallas=None):
+    """min-of-``runs`` wall time of ``chain`` chained size² matmuls →
+    (seconds, gflops) — the "computing power" number
+    (ref ``workflow.py:618-624``)."""
+    key = jax.random.key(0)
+    a = jax.random.normal(key, (size, size), jnp.float32).astype(dtype)
+    b = jnp.eye(size, dtype=dtype) * 1.0001
+
+    def chained(x, w):
+        for _ in range(chain):
+            x = matmul(x, w, use_pallas=use_pallas)
+        return x
+
+    fn = jax.jit(chained)
+    fn(a, b).block_until_ready()        # compile
+    best = float("inf")
+    for _ in range(runs):
+        tic = time.perf_counter()
+        fn(a, b).block_until_ready()
+        best = min(best, time.perf_counter() - tic)
+    gflops = 2.0 * chain * size ** 3 / best / 1e9
+    return best, gflops
+
+
+def autotune_gemm(shapes=((4096, 4096, 4096),), dtypes=("bfloat16",
+                                                        "float32"),
+                  candidates=TILE_CANDIDATES, runs=2, save=True,
+                  db_path=DEVICE_INFOS_JSON):
+    """Measure each tile candidate on the attached backend; store the best
+    per dtype in the DeviceInfo DB (ref ``_find_optimal_bs_vo``
+    ``backends.py:672``)."""
+    model = jax.devices()[0].device_kind
+    db = DeviceInfo.load_db(db_path)
+    info = db.setdefault(model, DeviceInfo(model))
+    for dtype_name in dtypes:
+        dtype = jnp.dtype(dtype_name)
+        best_time, best_tiles = float("inf"), None
+        for m, k, n in shapes:
+            a = jnp.ones((m, k), dtype)
+            b = jnp.ones((k, n), dtype)
+            for tiles in candidates:
+                try:
+                    fn = jax.jit(lambda x, y, t=tiles: matmul(
+                        x, y, tiles=t, use_pallas=True))
+                    fn(a, b).block_until_ready()
+                    tic = time.perf_counter()
+                    for _ in range(runs):
+                        fn(a, b).block_until_ready()
+                    elapsed = (time.perf_counter() - tic) / runs
+                except Exception:
+                    continue
+                if elapsed < best_time:
+                    best_time, best_tiles = elapsed, tiles
+        if best_tiles is not None:
+            info.ratings.setdefault("gemm", {})[dtype_name] = {
+                "time": best_time, "tiles": list(best_tiles)}
+    if save:
+        DeviceInfo.save_db(db, db_path)
+    return info
+
+
+def tiles_for_gemm(dtype, db_path=DEVICE_INFOS_JSON):
+    """Look up autotuned tiles for the current device, or None."""
+    try:
+        model = jax.devices()[0].device_kind
+    except RuntimeError:
+        return None
+    db = DeviceInfo.load_db(db_path)
+    info = db.get(model)
+    if info is None:
+        return None
+    tiles = info.get_kernel_tiles("gemm", numpy.dtype(str(dtype)))
+    return tuple(tiles) if tiles else None

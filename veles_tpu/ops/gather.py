@@ -1,0 +1,80 @@
+"""Minibatch gather: device-side sample selection by shuffled indices.
+
+Parity target: ``ocl/fullbatch_loader.cl:5-30`` /
+``cuda/fullbatch_loader.cu`` — gathers minibatch samples (and labels) from
+the device-resident full dataset by an index vector, zero-padding the tail
+of a short final batch.
+
+TPU re-design: the jnp path is ``jnp.take`` (XLA emits an efficient
+dynamic-gather); the Pallas path uses scalar-prefetched indices as the
+BlockSpec index map, so each sample row is DMA'd straight from the
+dataset in HBM into the output block — no materialized one-hot, no host
+round-trip for the epoch shuffle.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def take_rows(data, indices, use_pallas=None):
+    """``data[indices]`` along axis 0.  Negative indices (the reference's
+    "empty slot" marker for short batches) produce zero rows."""
+    if use_pallas is None:
+        from veles_tpu.config import root
+        from veles_tpu.ops import on_tpu
+        use_pallas = bool(root.common.engine.get("pallas_gather", False)) \
+            and on_tpu()
+    if use_pallas and data.ndim >= 2:
+        from veles_tpu.config import root
+        flat = data.reshape(data.shape[0], -1)
+        out = _gather_pallas(
+            flat, indices,
+            interpret=bool(root.common.engine.get("interpret", False)))
+        return out.reshape((indices.shape[0],) + data.shape[1:])
+    return _gather_jnp(data, indices)
+
+
+def _gather_jnp(data, indices):
+    taken = jnp.take(data, jnp.maximum(indices, 0), axis=0)
+    mask = (indices >= 0).reshape((-1,) + (1,) * (data.ndim - 1))
+    return jnp.where(mask, taken, 0)
+
+
+def _gather_kernel(idx_ref, data_ref, o_ref):
+    i = pl.program_id(0)
+    valid = idx_ref[i] >= 0
+
+    @pl.when(valid)
+    def _copy():
+        o_ref[:] = data_ref[:]
+
+    @pl.when(jnp.logical_not(valid))
+    def _zero():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _gather_pallas(data, indices, interpret=False):
+    n, f = data.shape
+    b = indices.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[
+            # the index map reads the prefetched indices: block row i of
+            # the output comes from dataset row indices[i]
+            pl.BlockSpec((1, f), lambda i, idx_ref: (jnp.maximum(
+                idx_ref[i], 0), 0)),
+        ],
+        out_specs=pl.BlockSpec((1, f), lambda i, idx_ref: (i, 0)),
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, f), data.dtype),
+        interpret=interpret,
+    )(jnp.asarray(indices, jnp.int32), data)

@@ -1,0 +1,18 @@
+"""Per-sample concatenation of N buffers (ref Jinja2-templated
+``ocl/join.jcl:12-39`` / ``cuda/join.jcu``, consumed by ``InputJoiner``
+``veles/input_joiner.py:49``).
+
+The reference generates an N-ary kernel signature per arity with Jinja2;
+under XLA ``jnp.concatenate`` already emits a single fused copy, so the
+"template" collapses to one call.  Inputs are flattened per-sample first
+(the reference's kernels operate on flat per-sample offsets).
+"""
+
+import jax.numpy as jnp
+
+
+def join(arrays, axis=1):
+    """Concatenate per-sample: each (B, ...) input is flattened to
+    (B, -1) then concatenated along features."""
+    flat = [a.reshape(a.shape[0], -1) for a in arrays]
+    return jnp.concatenate(flat, axis=axis)

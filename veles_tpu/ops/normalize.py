@@ -1,0 +1,17 @@
+"""Mean-dispersion normalization (ref ``ocl/mean_disp_normalizer.cl:1-20``
+and unit ``veles/mean_disp_normalizer.py:50``): ``(x - mean) * disp``
+elementwise, broadcast over the batch.
+
+Pure jnp: XLA fuses this into whatever consumes it (usually the first
+matmul), which is strictly better than the reference's standalone kernel
+— a separate Pallas kernel would force an extra HBM round-trip.
+"""
+
+import jax.numpy as jnp
+
+
+def mean_disp_normalize(x, mean, disp, dtype=None):
+    """x: (B, ...features); mean/disp: (...features)."""
+    out = (x.astype(jnp.float32) - mean.astype(jnp.float32)) \
+        * disp.astype(jnp.float32)
+    return out.astype(dtype or x.dtype)

@@ -20,6 +20,7 @@ import threading
 import numpy
 
 _streams = {}
+_base_seed = 0x5eed
 _lock = threading.Lock()
 
 
@@ -115,23 +116,34 @@ class RandomGenerator(object):
             self.name, self._seed, self._counter)
 
 
+def _derived_seed(name):
+    offset = int.from_bytes(
+        hashlib.sha256(name.encode()).digest()[:4], "little")
+    return _base_seed + offset
+
+
 def get(name="master"):
-    """The named-stream registry (ref ``prng/__init__.py`` ``get``)."""
+    """The named-stream registry (ref ``prng/__init__.py`` ``get``).
+
+    Streams created after :func:`seed_all` still derive from the global
+    base seed, so creation order never changes a stream's sequence."""
     with _lock:
         stream = _streams.get(name)
         if stream is None:
-            stream = _streams[name] = RandomGenerator(name)
+            stream = _streams[name] = RandomGenerator(
+                name, seed=_derived_seed(name))
         return stream
 
 
 def seed_all(seed):
-    """Seed every existing stream plus the master, deterministically
-    differentiated by name hash (so streams stay independent)."""
+    """Set the global base seed and (re)seed every stream,
+    deterministically differentiated by name hash so streams stay
+    independent."""
+    global _base_seed
     with _lock:
-        names = set(_streams) | {"master"}
-        for name in names:
-            offset = int.from_bytes(
-                hashlib.sha256(name.encode()).digest()[:4], "little")
-            if name not in _streams:
-                _streams[name] = RandomGenerator(name)
-            _streams[name].seed(int(seed) + offset)
+        _base_seed = int(seed)
+        for name, stream in _streams.items():
+            stream.seed(_derived_seed(name))
+        if "master" not in _streams:
+            _streams["master"] = RandomGenerator(
+                "master", seed=_derived_seed("master"))

@@ -111,6 +111,9 @@ class Unit(Distributable, metaclass=UnitRegistry):
         super(Unit, self).init_unpickled()
         self._gate_lock_ = threading.Lock()
         self._run_lock_ = threading.Lock()
+        if not hasattr(self, "_workflow_ref_"):
+            # standalone unpickle; Workflow.__setstate__ re-links members
+            self._workflow_ref_ = None
 
     def __repr__(self):
         return '<%s "%s">' % (self.__class__.__name__, self.name)

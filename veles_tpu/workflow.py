@@ -214,6 +214,9 @@ class Workflow(Unit):
             cb(self.generate_data_for_master())
         if self.result_file:
             self.write_results()
+        notify = getattr(self._launcher, "on_workflow_finished", None)
+        if notify is not None:
+            notify()
 
     def on_unit_failed(self, unit):
         self.warning("unit %r failed; stopping workflow", unit)
