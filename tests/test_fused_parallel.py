@@ -1,0 +1,169 @@
+"""Fused train step + mesh data parallelism (runs on the virtual
+8-device CPU mesh)."""
+
+import jax
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.parallel import data_parallel, make_mesh
+from veles_tpu.parallel.dp import shard_params
+from veles_tpu.znicz.fused import (
+    init_mlp_params, lower_workflow, make_eval_step, make_train_step,
+    mlp_apply, update_workflow, _specs_static)
+
+LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 4},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+]
+
+
+def _data(n=64, dim=12, classes=4, seed=0):
+    rng = numpy.random.default_rng(seed)
+    labels = (numpy.arange(n) % classes).astype(numpy.int32)
+    centers = rng.standard_normal((classes, dim)) * 3
+    x = (centers[labels] + rng.standard_normal((n, dim))).astype(
+        numpy.float32)
+    return x, labels
+
+
+def test_fused_step_learns():
+    prng.seed_all(0)
+    params = init_mlp_params(12, LAYERS)
+    step = jax.jit(make_train_step(LAYERS))
+    x, labels = _data()
+    first = None
+    for i in range(60):
+        params, metrics = step(params, x, labels)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first * 0.5
+    assert int(metrics["n_err"]) <= 5
+
+
+def test_fused_matches_eager_units():
+    """One fused step == one eager unit-graph step (same math)."""
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    x, labels = _data(n=32)
+
+    class L(FullBatchLoader):
+        def load_data(self):
+            self.original_data.mem = x
+            self.original_labels = list(int(v) for v in labels)
+            self.class_lengths[:] = [0, 0, 32]
+
+    prng.seed_all(7)
+    wf = StandardWorkflow(
+        None, loader_factory=lambda w: L(w, minibatch_size=32,
+                                         shuffle_limit=0),
+        layers=[{**s} for s in LAYERS],
+        decision_config={"max_epochs": 1})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=NumpyDevice())
+
+    params, step = lower_workflow(wf)
+    # eager one minibatch
+    wf.loader.run()
+    for fwd in wf.forwards:
+        fwd.run()
+    wf.evaluator.run()
+    for gdu in wf.gds:
+        gdu.run()
+    # fused one step on the same batch
+    mb_x = numpy.array(wf.loader.minibatch_data.mem)
+    mb_y = numpy.array(wf.loader.minibatch_labels.mem)
+    new_params, _ = jax.jit(step)(params, mb_x, mb_y)
+    for layer, fwd in zip(new_params, wf.forwards):
+        assert numpy.allclose(numpy.asarray(layer["w"]), fwd.weights.mem,
+                              atol=1e-4), fwd.name
+        assert numpy.allclose(numpy.asarray(layer["b"]), fwd.bias.mem,
+                              atol=1e-4), fwd.name
+    # write-back path
+    update_workflow(wf, new_params)
+    assert numpy.allclose(wf.forwards[0].weights.mem,
+                          numpy.asarray(new_params[0]["w"]))
+
+
+def test_data_parallel_8_devices_matches_single():
+    prng.seed_all(1)
+    params_a = init_mlp_params(12, LAYERS)
+    params_b = jax.tree.map(numpy.copy, params_a)
+    x, labels = _data(n=64)
+    step = make_train_step(LAYERS)
+    single = jax.jit(step)
+    mesh = make_mesh({"data": 8})
+    assert mesh.shape["data"] == 8
+    dp = data_parallel(step, mesh, params_a, donate_params=False)
+    for _ in range(3):
+        params_a, m_dp = dp(params_a, x, labels)
+        params_b, m_single = single(params_b, x, labels)
+    assert numpy.allclose(numpy.asarray(params_a[0]["w"]),
+                          numpy.asarray(params_b[0]["w"]), atol=1e-5)
+    assert int(m_dp["n_err"]) == int(m_single["n_err"])
+
+
+def test_dp_2x4_mesh_with_model_axis():
+    """data×model mesh: params sharded on the model axis (TP) still
+    produce the same training step results."""
+    from jax.sharding import PartitionSpec as P
+    prng.seed_all(2)
+    params = init_mlp_params(12, LAYERS)
+    reference = jax.tree.map(numpy.copy, params)
+    x, labels = _data(n=32)
+    mesh = make_mesh({"data": 2, "model": 4})
+
+    def rules(leaf):
+        # shard the hidden dimension of 2-D weights over 'model'
+        if getattr(leaf, "ndim", 0) == 2 and leaf.shape[1] % 4 == 0:
+            return P(None, "model")
+        return None
+
+    step = make_train_step(LAYERS)
+    dp = data_parallel(step, mesh, params, donate_params=False,
+                       param_rules=rules)
+    out_tp, m_tp = dp(params, x, labels)
+    out_ref, m_ref = jax.jit(step)(reference, x, labels)
+    assert numpy.allclose(numpy.asarray(out_tp[0]["w"]),
+                          numpy.asarray(out_ref[0]["w"]), atol=1e-5)
+    assert int(m_tp["n_err"]) == int(m_ref["n_err"])
+
+
+def test_shard_params_topology_change():
+    """Snapshot on one topology, reshard on another (§5.4 resume)."""
+    prng.seed_all(3)
+    params = init_mlp_params(12, LAYERS)
+    mesh8 = make_mesh({"data": 8})
+    placed = shard_params(params, mesh8)
+    mesh2 = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    replaced = shard_params(jax.tree.map(numpy.asarray, placed), mesh2)
+    assert numpy.allclose(numpy.asarray(replaced[0]["w"]),
+                          numpy.asarray(params[0]["w"]))
+
+
+def test_eval_step():
+    prng.seed_all(4)
+    params = init_mlp_params(12, LAYERS)
+    x, labels = _data(n=16)
+    ev = jax.jit(make_eval_step(LAYERS))
+    out = ev(params, x, labels)
+    assert 0 <= int(out["n_err"]) <= 16
+    assert int(out["n"]) == 16
+
+
+def test_graft_entry_contract():
+    import __graft_entry__ as ge
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (128, 10)
+    assert numpy.allclose(numpy.asarray(out).sum(axis=1), 1.0, atol=1e-3)
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as ge
+    ge.dryrun_multichip(8)

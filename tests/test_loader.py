@@ -1,0 +1,202 @@
+"""Loader layer tests (mirrors reference loader coverage: 3-set serving
+order, epoch flags, shuffling, failed-minibatch requeue, master–slave
+index distribution)."""
+
+import numpy
+import pytest
+
+from veles_tpu.backends import CPUDevice, NumpyDevice
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.loader import (
+    FullBatchLoader, FullBatchLoaderMSE, TEST, TRAIN, VALID)
+from veles_tpu.loader.base import Loader
+
+
+class SyntheticLoader(FullBatchLoader):
+    """10-class gaussian blobs: n_test/n_valid/n_train samples of dim."""
+
+    def __init__(self, workflow, n_test=20, n_valid=30, n_train=50, dim=8,
+                 n_classes=10, **kwargs):
+        self._sizes = (n_test, n_valid, n_train)
+        self._dim = dim
+        self._n_classes = n_classes
+        super(SyntheticLoader, self).__init__(workflow, **kwargs)
+
+    def load_data(self):
+        total = sum(self._sizes)
+        rng = numpy.random.default_rng(7)
+        labels = rng.integers(0, self._n_classes, total)
+        data = rng.standard_normal((total, self._dim)).astype(
+            numpy.float32) + labels[:, None]
+        self.original_data.mem = data
+        self.original_labels = list(labels)
+        self.class_lengths[:] = self._sizes
+
+
+def make_loader(device=None, **kwargs):
+    wf = DummyWorkflow()
+    wf.device = device or NumpyDevice()
+    loader = SyntheticLoader(wf, **kwargs)
+    loader.initialize(device=wf.device)
+    return loader
+
+
+def test_serving_order_test_valid_train():
+    loader = make_loader(minibatch_size=10)
+    classes = []
+    for _ in range(10):   # 100 samples / 10 = 10 minibatches per epoch
+        loader.run()
+        classes.append(loader.minibatch_class)
+    assert classes[:2] == [TEST, TEST]
+    assert classes[2:5] == [VALID] * 3
+    assert classes[5:] == [TRAIN] * 5
+
+
+def test_epoch_flags():
+    loader = make_loader(minibatch_size=10)
+    flags = []
+    for _ in range(10):
+        loader.run()
+        flags.append((bool(loader.last_minibatch),
+                      bool(loader.epoch_ended),
+                      bool(loader.train_ended)))
+    # last minibatch of each class sets last_minibatch
+    assert flags[1][0] and flags[4][0] and flags[9][0]
+    # epoch_ended on last VALID minibatch
+    assert flags[4][1]
+    # train_ended on last TRAIN minibatch
+    assert flags[9][2]
+    assert loader.epoch_number == 0
+    loader.run()
+    assert loader.epoch_number == 1
+    assert loader.minibatch_class == TEST
+
+
+def test_short_final_batch_padded():
+    loader = make_loader(minibatch_size=15)   # test set of 20 → 15 + 5
+    loader.run()
+    assert loader.minibatch_size == 15
+    loader.run()
+    assert loader.minibatch_size == 5
+    assert (loader.minibatch_indices.mem[5:] == -1).all()
+    assert (loader.minibatch_data.mem[5:] == 0).all()
+    assert (loader.minibatch_labels.mem[5:] == -1).all()
+
+
+def test_shuffle_changes_train_only():
+    loader = make_loader(minibatch_size=10, shuffle_limit=10)
+    before = loader.shuffled_indices.mem.copy()
+    # run a full epoch to trigger reshuffle at wrap
+    for _ in range(11):
+        loader.run()
+    after = loader.shuffled_indices.mem
+    assert (before[:50] == after[:50]).all()       # test+valid untouched
+    assert not (before[50:] == after[50:]).all()   # train reshuffled
+
+
+def test_shuffle_deterministic_by_prng():
+    from veles_tpu import prng
+    prng.seed_all(99)
+    a = make_loader(minibatch_size=10).shuffled_indices.mem.copy()
+    prng.seed_all(99)
+    b = make_loader(minibatch_size=10).shuffled_indices.mem.copy()
+    assert (a == b).all()
+
+
+def test_labels_mapped_and_data_gathered():
+    loader = make_loader(minibatch_size=100)
+    loader.run()
+    idx = loader.minibatch_indices.mem[:loader.minibatch_size]
+    data = loader.minibatch_data.mem[:loader.minibatch_size]
+    # normalization is 'none' → gathered rows equal originals
+    assert numpy.allclose(data, loader.original_data.mem[idx])
+    assert (loader.minibatch_labels.mem[:loader.minibatch_size] ==
+            numpy.asarray(loader.original_labels)[idx]).all()
+
+
+def test_device_resident_gather_matches_host():
+    host = make_loader(minibatch_size=25)
+    dev = make_loader(device=CPUDevice(), minibatch_size=25)
+    for _ in range(3):
+        host.run()
+        dev.run()
+    assert (host.minibatch_indices.mem == dev.minibatch_indices.mem).all()
+    assert numpy.allclose(host.minibatch_data.mem, dev.minibatch_data.mem)
+    assert (host.minibatch_labels.mem == dev.minibatch_labels.mem).all()
+
+
+def test_normalization_mean_disp():
+    loader = make_loader(minibatch_size=10,
+                         normalization_type="mean_disp")
+    # statistics fit on TRAIN span only
+    train = loader.original_data.mem[50:]
+    assert abs(float(train.mean(axis=0).mean())) < 1.0
+
+
+def test_master_slave_index_distribution():
+    master_loader = make_loader(minibatch_size=10)
+    master_loader.workflow.launcher.is_master = True
+    master_loader.workflow.launcher.is_standalone = False
+
+    slave_loader = make_loader(minibatch_size=10)
+    slave_loader.workflow.launcher.is_slave = True
+    slave_loader.workflow.launcher.is_standalone = False
+
+    job = master_loader.generate_data_for_slave(slave="s1")
+    assert job["minibatch_size"] == 10
+    slave_loader.apply_data_from_master(job)
+    slave_loader.run()
+    assert (slave_loader.minibatch_indices.mem[:10] ==
+            job["indices"]).all()
+    # master accounts the update
+    master_loader.apply_data_from_slave(True, slave="s1")
+    assert master_loader.pending_minibatches_count == 0
+
+
+def test_drop_slave_requeues():
+    loader = make_loader(minibatch_size=10)
+    loader.workflow.launcher.is_master = True
+    loader.workflow.launcher.is_standalone = False
+    job = loader.generate_data_for_slave(slave="dead")
+    assert loader.pending_minibatches_count == 1
+    loader.drop_slave(slave="dead")
+    assert loader.pending_minibatches_count == 0
+    assert loader.failed_minibatches
+    # next serve retries the failed minibatch
+    job2 = loader.generate_data_for_slave(slave="alive")
+    assert job2["minibatch_offset"] == job["minibatch_offset"]
+    assert loader.total_failed == 1
+
+
+def test_mse_loader_targets():
+    class SynthMSE(FullBatchLoaderMSE):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            self.original_data.mem = rng.standard_normal(
+                (30, 4)).astype(numpy.float32)
+            self.original_targets.mem = rng.standard_normal(
+                (30, 2)).astype(numpy.float32)
+            self.class_lengths[:] = [0, 10, 20]
+
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = SynthMSE(wf, minibatch_size=7)
+    loader.initialize(device=wf.device)
+    loader.run()
+    idx = loader.minibatch_indices.mem[:loader.minibatch_size]
+    assert numpy.allclose(loader.minibatch_targets.mem[:len(idx)],
+                          loader.original_targets.mem[idx])
+
+
+def test_pickle_resume_continues_serving():
+    import pickle
+    loader = make_loader(minibatch_size=10)
+    for _ in range(3):
+        loader.run()
+    blob = pickle.dumps(loader)
+    offset = loader.global_offset
+    restored = pickle.loads(blob)
+    restored.workflow = DummyWorkflow()
+    assert restored.global_offset == offset
+    restored.run()
+    assert restored.global_offset == offset + 10

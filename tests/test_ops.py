@@ -170,6 +170,15 @@ class TestRandomOps:
         assert abs(x.mean() - 1.0) < 0.1
         assert abs(x.std() - 2.0) < 0.1
 
+    def test_uniform_pallas_fallback_off_tpu(self):
+        from veles_tpu.ops.random import uniform_pallas
+        a = numpy.asarray(uniform_pallas(3, (256,), low=-1.0, high=1.0))
+        b = numpy.asarray(uniform_pallas(3, (256,), low=-1.0, high=1.0))
+        c = numpy.asarray(uniform_pallas(4, (256,), low=-1.0, high=1.0))
+        assert (a == b).all()
+        assert not (a == c).all()
+        assert a.min() >= -1.0 and a.max() < 1.0
+
     def test_dropout_mask(self):
         key = jax.random.key(0)
         mask = numpy.asarray(dropout_mask(key, (10000,), 0.8))

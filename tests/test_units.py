@@ -303,6 +303,22 @@ def test_unit_pickles_without_transients():
     assert hasattr(restored, "_gate_lock_")   # recreated by init_unpickled
 
 
+def test_links_forward_after_unpickle_in_fresh_process():
+    """Simulates unpickling in a process that never ran link(): the class
+    has no _Forward descriptor until init_unpickled reinstalls it."""
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    b = DummyUnit(wf, name="b")
+    a.output2 = 5
+    b.link_attrs(a, ("input2", "output2"))
+    blob = pickle.dumps(wf)
+    delattr(DummyUnit, "input2")      # fresh-process class state
+    restored = pickle.loads(blob)
+    ra, rb = restored["a"], restored["b"]
+    ra.output2 = 42
+    assert rb.input2 == 42            # forwarding reinstalled
+
+
 def test_workflow_checksum_stable():
     wf1 = DummyWorkflow()
     DummyUnit(wf1, name="x").link_from(wf1.start_point)

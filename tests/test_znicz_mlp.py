@@ -1,0 +1,159 @@
+"""End-to-end Znicz-equivalent MLP training (the minimum slice from
+SURVEY §7 stage 5: loader → all2all_tanh → softmax → evaluator →
+decision → gd chain, looping until complete)."""
+
+import numpy
+import pytest
+
+from veles_tpu.backends import CPUDevice, NumpyDevice
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+class BlobLoader(FullBatchLoader):
+    """Separable 10-class gaussian blobs in 64-d (a fast MNIST stand-in:
+    real-MNIST parity is gated by dataset availability, BASELINE.md)."""
+
+    def __init__(self, workflow, n_train=400, n_valid=100, dim=64,
+                 n_classes=10, **kwargs):
+        self._cfg = (n_train, n_valid, dim, n_classes)
+        super(BlobLoader, self).__init__(workflow, **kwargs)
+
+    def load_data(self):
+        n_train, n_valid, dim, n_classes = self._cfg
+        rng = numpy.random.default_rng(42)
+        total = n_train + n_valid
+        labels = numpy.tile(numpy.arange(n_classes),
+                            total // n_classes + 1)[:total]
+        centers = rng.standard_normal((n_classes, dim)) * 3.0
+        data = centers[labels] + rng.standard_normal((total, dim)) * 0.7
+        self.original_data.mem = data.astype(numpy.float32)
+        self.original_labels = list(int(x) for x in labels)
+        self.class_lengths[:] = [0, n_valid, n_train]
+
+
+LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 32},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 10},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+]
+
+
+def build(device, max_epochs=8, minibatch_size=50):
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: BlobLoader(
+            w, minibatch_size=minibatch_size),
+        layers=[{**spec} for spec in LAYERS],
+        decision_config={"max_epochs": max_epochs},
+    )
+    from veles_tpu.dummy import DummyLauncher
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=device)
+    return wf
+
+
+def test_graph_shape():
+    wf = build(NumpyDevice(), max_epochs=1)
+    assert len(wf.forwards) == 2
+    assert len(wf.gds) == 2
+    assert wf.forwards[0].weights.shape == (64, 32)
+    assert wf.forwards[1].weights.shape == (32, 10)
+    # gd chain reversed: gds[0] pairs the softmax layer
+    assert wf.gds[0].weights is not None
+    assert wf.gds[1].err_input is not None
+
+
+def test_training_converges_numpy():
+    from veles_tpu import prng
+    prng.seed_all(5)
+    wf = build(NumpyDevice(), max_epochs=8)
+    wf.run()
+    assert wf.stopped
+    assert wf.decision.best_n_err_pt < 10.0, \
+        "blobs are separable; expected <10%% err, got %.2f%%" % \
+        wf.decision.best_n_err_pt
+
+
+def test_training_converges_jit_and_matches_numpy():
+    """The jitted CPU path must converge like the numpy path (parity of
+    the two backends, ref accelerated_test.multi_device strategy)."""
+    from veles_tpu import prng
+    prng.seed_all(5)
+    wf_np = build(NumpyDevice(), max_epochs=4)
+    wf_np.run()
+    prng.seed_all(5)
+    wf_cpu = build(CPUDevice(), max_epochs=4)
+    wf_cpu.run()
+    # identical seeds → identical init; bf16-free CPU jit math ≈ numpy
+    assert abs(wf_cpu.decision.best_n_err_pt -
+               wf_np.decision.best_n_err_pt) < 3.0
+
+
+def test_forward_parity_numpy_vs_jit():
+    from veles_tpu import prng
+    prng.seed_all(11)
+    wf = build(NumpyDevice(), max_epochs=1)
+    loader = wf.loader
+    loader.run()
+    fwd = wf.forwards[0]
+    fwd.run()
+    out_numpy = numpy.array(fwd.output.mem)
+
+    prng.seed_all(11)
+    wf2 = build(CPUDevice(), max_epochs=1)
+    wf2.loader.run()
+    fwd2 = wf2.forwards[0]
+    fwd2.run()
+    out_jit = numpy.array(fwd2.output.mem)
+    assert numpy.allclose(out_numpy, out_jit, atol=1e-4)
+
+
+def test_gd_updates_weights_both_paths():
+    from veles_tpu import prng
+    for device in (NumpyDevice(), CPUDevice()):
+        prng.seed_all(3)
+        wf = build(device, max_epochs=1)
+        wf.loader.run()
+        while wf.loader.minibatch_class != 2:   # advance to TRAIN
+            wf.loader.run()
+        for fwd in wf.forwards:
+            fwd.run()
+        wf.evaluator.run()
+        before = numpy.array(wf.forwards[1].weights.mem)
+        wf.gds[0].run()
+        after = numpy.array(wf.forwards[1].weights.mem)
+        assert not numpy.allclose(before, after), device
+
+
+def test_results_and_stats():
+    wf = build(NumpyDevice(), max_epochs=2)
+    wf.run()
+    results = wf.gather_results()
+    assert "best_validation_error_pt" in results
+    assert "Total epochs" in results
+    stats = wf.get_unit_run_time_stats()
+    assert stats[0][1] >= 0
+
+
+def test_snapshot_mid_training_resumes(tmp_path):
+    """Whole-workflow pickle mid-loop; restored workflow continues
+    training (the §5.4 checkpoint/resume property)."""
+    import pickle
+    from veles_tpu import prng
+    prng.seed_all(5)
+    wf = build(NumpyDevice(), max_epochs=2)
+    wf.run()
+    first_err = wf.decision.best_n_err_pt
+    blob = pickle.dumps(wf)
+    restored = pickle.loads(blob)
+    from veles_tpu.dummy import DummyLauncher
+    restored.launcher = DummyLauncher()
+    restored.decision.max_epochs = 6
+    restored.decision.complete <<= False
+    restored.initialize(device=NumpyDevice())
+    restored.run()
+    assert restored.decision.best_n_err_pt <= first_err
+    assert restored.loader.epoch_number > 2

@@ -135,13 +135,12 @@ class Device(Pickleable, metaclass=BackendRegistry):
     # -- dtype policy -------------------------------------------------------
     @property
     def compute_dtype(self):
-        """Dtype for matmul/conv operands (bf16 keeps the MXU fed)."""
+        """Dtype for matmul/conv operands — set precision_type to
+        "bfloat16" to keep the MXU fed (precision_level is the separate
+        robustness knob, see config.py)."""
         from veles_tpu.dtypes import dtype_by_name
-        precision = root.common.engine.get("precision_type", "float32")
-        level = root.common.engine.get("precision_level", 0)
-        if level == 1 and self.BACKEND == "tpu":
-            return dtype_by_name("bfloat16")
-        return dtype_by_name(precision)
+        return dtype_by_name(
+            root.common.engine.get("precision_type", "float32"))
 
     @property
     def storage_dtype(self):

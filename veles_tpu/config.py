@@ -136,13 +136,13 @@ root.common.update({
     "engine": {
         # "tpu" | "cpu" | "numpy"; AutoDevice resolves by PRIORITY.
         "backend": "auto",
-        # Compute dtype policy: activations/weights dtype and accumulation.
-        # bfloat16 keeps the MXU fed; float32 accumulation is XLA default.
+        # Compute dtype for operands: "float32" or "bfloat16" (MXU-native).
         "precision_type": "float32",
-        # 0: plain bf16/f32; 1: f32 params + bf16 compute (mixed);
-        # 2: full f64-on-CPU debugging (reference precision levels were
-        # Kahan/multipartial sums — veles/config.py:246-249; on TPU the
-        # equivalent knob is accumulation dtype).
+        # Numerical-robustness knob, same direction as the reference's
+        # precision levels (0 fast, 1 Kahan, 2 multipartial —
+        # veles/config.py:246-249).  On TPU it selects MXU pass counts
+        # for float32 matmuls: 0 → DEFAULT (bf16 passes), 1 → HIGH
+        # (bf16_3x), 2 → HIGHEST (full f32).
         "precision_level": 0,
         "mesh": {
             # Logical mesh axes for pjit sharding; data-parallel by default.

@@ -1,0 +1,6 @@
+"""L3 data layer (ref ``veles/loader/``)."""
+
+from veles_tpu.loader.base import (  # noqa: F401
+    CLASS_NAME, Loader, LoaderError, TEST, TRAIN, VALID)
+from veles_tpu.loader.fullbatch import (  # noqa: F401
+    FullBatchLoader, FullBatchLoaderMSE)

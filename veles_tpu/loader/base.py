@@ -1,0 +1,434 @@
+"""Loader: 3-set dataset model and minibatch serving.
+
+Parity target: reference ``veles/loader/base.py`` — ``Loader`` (``:120``)
+with the ``ILoader`` contract ``load_data / create_minibatch_data /
+fill_minibatch`` (``:100-112``); TEST/VALID/TRAIN 3-set model over one
+concatenated index space (``:352-366``), per-epoch serving order
+test→validation→train with flags ``last_minibatch`` / ``epoch_ended`` /
+``train_ended`` (``:862-899``), train-set shuffling with ``shuffle_limit``
+(``:711-731``), the failed-minibatch retry queue + per-slave pending
+accounting that gives elastic fault tolerance (``:733-751``, ``:679-687``),
+label mapping, normalizer hookup (``analyze_dataset`` ``:755``), and
+master-side index distribution (``:631-687``).
+
+TPU re-design notes: serving stays a host-side unit (it is control flow);
+the device-side minibatch *fill* lives in
+:class:`veles_tpu.loader.fullbatch.FullBatchLoader` where the dataset is
+HBM-resident and gathering rides :func:`veles_tpu.ops.gather.take_rows`.
+For on-pod data parallelism the same index partitioning used for slaves
+feeds per-device shards (see :mod:`veles_tpu.parallel`).
+"""
+
+import collections
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.memory import Vector
+from veles_tpu.mutable import Bool
+from veles_tpu.normalization import normalizer_factory
+from veles_tpu.units import Unit
+
+TARGET = 3
+TRAIN = 2
+VALID = 1
+TEST = 0
+CLASS_NAME = ["test", "validation", "train"]
+
+INDEX_DTYPE = numpy.int32
+LABEL_DTYPE = numpy.int32
+
+
+class LoaderError(Exception):
+    pass
+
+
+class Loader(Unit):
+    """Base loader.  Subclasses implement ``load_data`` (fill
+    ``class_lengths``), ``create_minibatch_data`` (allocate
+    ``minibatch_data``) and ``fill_minibatch`` (fill data+raw labels for
+    ``minibatch_indices``)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.class_lengths = [0, 0, 0]
+        self.class_end_offsets = [0, 0, 0]
+        self._effective_class_end_offsets = [0, 0, 0]
+        self.max_minibatch_size = kwargs.get("minibatch_size", 100)
+        self.minibatch_class = TRAIN
+        self.minibatch_offset = 0
+        self.minibatch_size = 0
+        self.minibatch_data = Vector()
+        self.minibatch_labels = Vector()
+        self.minibatch_indices = Vector()
+        self.raw_minibatch_labels = []
+        self.labels_mapping = {}
+        self.shuffled_indices = Vector()
+        self.shuffle_limit = kwargs.get("shuffle_limit", 2 ** 31)
+        self.train_ratio = kwargs.get("train_ratio", 1.0)
+        self.testing = kwargs.get("testing", False)
+        self.global_offset = 0
+        self.samples_served = 0
+        self.epoch_number = 0
+        self.last_minibatch = Bool(False)
+        self.epoch_ended = Bool(False)
+        self.train_ended = Bool(False)
+        self.test_ended = Bool(False)
+        self.failed_minibatches = []
+        self._total_failed = 0
+        self._normalization_type = kwargs.get("normalization_type", "none")
+        self._normalization_parameters = kwargs.get(
+            "normalization_parameters", {})
+        self._prng_name = kwargs.get("prng_name", "loader")
+        super(Loader, self).__init__(workflow, **kwargs)
+        self._normalizer = None
+
+    def init_unpickled(self):
+        super(Loader, self).init_unpickled()
+        #: outstanding minibatches per consumer: {slave_id: [(off, size)]}
+        self.pending_minibatches_ = collections.defaultdict(list)
+
+    # -- configuration ------------------------------------------------------
+    @property
+    def prng(self):
+        return prng.get(self._prng_name)
+
+    @property
+    def normalizer(self):
+        if self._normalizer is None:
+            self._normalizer = normalizer_factory(
+                self._normalization_type, **self._normalization_parameters)
+        return self._normalizer
+
+    @property
+    def has_labels(self):
+        """Subclasses set ``_has_labels = True`` in ``load_data()`` when
+        the dataset is labeled (ref determines this from the minibatch
+        labels vector, ``base.py:258``)."""
+        return getattr(self, "_has_labels", False) \
+            or bool(self.labels_mapping)
+
+    @property
+    def total_samples(self):
+        return sum(self.class_lengths)
+
+    @property
+    def effective_total_samples(self):
+        return self._effective_class_end_offsets[TRAIN]
+
+    @property
+    def effective_class_end_offsets(self):
+        return self._effective_class_end_offsets
+
+    @property
+    def total_failed(self):
+        return self._total_failed
+
+    @property
+    def pending_minibatches_count(self):
+        return sum(len(v) for v in self.pending_minibatches_.values())
+
+    @property
+    def class_ended(self):
+        for offset in self.effective_class_end_offsets:
+            if self.global_offset == offset:
+                return True
+            if self.global_offset < offset:
+                return False
+        raise LoaderError(
+            "global_offset %d out of bounds %s" %
+            (self.global_offset, self.effective_class_end_offsets))
+
+    @property
+    def shape(self):
+        if not self.minibatch_data:
+            raise AttributeError("minibatch_data not yet allocated")
+        return self.minibatch_data.shape[1:]
+
+    # -- ILoader contract ---------------------------------------------------
+    def load_data(self):
+        raise NotImplementedError
+
+    def create_minibatch_data(self):
+        raise NotImplementedError
+
+    def fill_minibatch(self):
+        raise NotImplementedError
+
+    # -- lifecycle ----------------------------------------------------------
+    def initialize(self, **kwargs):
+        super(Loader, self).initialize(**kwargs)
+        if self.testing:
+            self.shuffle_limit = 0
+            self.global_offset = 0
+            del self.failed_minibatches[:]
+        self.load_data()
+        if sum(self.class_lengths) == 0:
+            raise LoaderError("there is no data to serve")
+        self._calc_class_end_offsets()
+        self.info(
+            "samples: test: %d, validation: %d, train: %d",
+            *self.class_lengths)
+        self.minibatch_labels.reset(numpy.zeros(
+            self.max_minibatch_size, dtype=LABEL_DTYPE))
+        self.raw_minibatch_labels = [None] * self.max_minibatch_size
+        self.minibatch_indices.reset(numpy.zeros(
+            self.max_minibatch_size, dtype=INDEX_DTYPE))
+        self.create_minibatch_data()
+        if not self.minibatch_data:
+            raise LoaderError(
+                "minibatch_data MUST be allocated in "
+                "create_minibatch_data()")
+        self.analyze_dataset()
+        self.shuffle()
+
+    def run(self):
+        """Serve one minibatch (standalone mode)."""
+        self.pending_minibatches_.pop(None, None)
+        self.serve_next_minibatch(None)
+        self._on_successful_serve()
+
+    # -- serving ------------------------------------------------------------
+    def shuffle(self):
+        """Shuffle the TRAIN span of the index space (ref ``:711-731``)."""
+        if not self.shuffled_indices:
+            self.shuffled_indices.mem = numpy.arange(
+                self.total_samples, dtype=INDEX_DTYPE)
+        if self.shuffle_limit <= 0 or self.class_lengths[TRAIN] == 0:
+            return
+        self.shuffle_limit -= 1
+        self.shuffled_indices.map_write()
+        self.prng.shuffle(
+            self.shuffled_indices.mem[self.class_end_offsets[VALID]:])
+
+    def class_index_by_sample_index(self, index):
+        for class_index, offset in enumerate(
+                self.effective_class_end_offsets):
+            if index < offset:
+                return class_index, offset - index
+        raise LoaderError("sample index %d out of range" % index)
+
+    def serve_next_minibatch(self, consumer_id):
+        """Pick the next (offset, size) — retrying failed minibatches
+        first — and fill data (ref ``:726-752``)."""
+        try:
+            minibatch_def = self.failed_minibatches.pop()
+        except IndexError:
+            minibatch_def = self._advance_global_offset()
+        minibatch_offset, minibatch_size = minibatch_def
+        self.pending_minibatches_[consumer_id].append(minibatch_def)
+        self.minibatch_offset, self.minibatch_size = minibatch_def
+        self._update_flags()
+
+        self.fill_indices(minibatch_offset - minibatch_size,
+                          minibatch_size)
+        if self.is_master:
+            return
+        self.fill_minibatch()
+        self.normalize_minibatch()
+        self.map_minibatch_labels()
+        if minibatch_size < self.max_minibatch_size:
+            self.pad_minibatch(minibatch_size)
+
+    def pad_minibatch(self, minibatch_size):
+        """Zero/-1-fill the tail of a short final batch.  Loaders whose
+        ``fill_minibatch`` already pads (device-side gather) override
+        with a no-op."""
+        self.minibatch_data.map_write()
+        self.minibatch_data.mem[minibatch_size:] = 0.0
+        if self.has_labels:
+            self.minibatch_labels.map_write()
+            self.minibatch_labels.mem[minibatch_size:] = -1
+        self.minibatch_indices.map_write()
+        self.minibatch_indices.mem[minibatch_size:] = -1
+
+    def fill_indices(self, start_offset, count):
+        """Copy the served span of shuffled indices into
+        ``minibatch_indices`` (ref ``:823-838``)."""
+        self.minibatch_indices.map_write()
+        self.shuffled_indices.map_read()
+        self.minibatch_indices.mem[:count] = \
+            self.shuffled_indices.mem[start_offset:start_offset + count]
+        return False
+
+    def normalize_minibatch(self):
+        self.normalizer.normalize(
+            self.minibatch_data.mem[:self.minibatch_size])
+        self.minibatch_data.map_write()
+
+    def map_minibatch_labels(self):
+        if not self.has_labels:
+            return
+        self.minibatch_labels.map_write()
+        for i, raw in enumerate(
+                self.raw_minibatch_labels[:self.minibatch_size]):
+            self.minibatch_labels.mem[i] = self.labels_mapping.get(raw, -1) \
+                if self.labels_mapping else raw
+
+    def _calc_class_end_offsets(self):
+        total = 0
+        for i, n in enumerate(self.class_lengths):
+            if not isinstance(n, (int, numpy.integer)):
+                raise TypeError("class_lengths must be integers")
+            total += n
+            self.class_end_offsets[i] = total
+        self._effective_class_end_offsets = list(self.class_end_offsets)
+        self._effective_class_end_offsets[TRAIN] -= int(
+            (1.0 - self.train_ratio) * self.class_lengths[TRAIN])
+
+    def _advance_global_offset(self):
+        """(ref ``:881-899``)"""
+        if self.is_slave:
+            return self.minibatch_offset, self.minibatch_size
+        if self.global_offset >= self.effective_total_samples:
+            self.global_offset = 0
+            self.epoch_number += 1
+            self.shuffle()
+        self.minibatch_class, remainder = self.class_index_by_sample_index(
+            self.global_offset)
+        minibatch_size = min(remainder, self.max_minibatch_size)
+        self.global_offset += minibatch_size
+        self.train_ended <<= \
+            self.global_offset >= self.effective_total_samples
+        self.test_ended <<= \
+            self.global_offset >= self.class_end_offsets[TEST]
+        return self.global_offset, minibatch_size
+
+    def _update_flags(self):
+        """(ref ``:862-879``)"""
+        if self.is_slave:
+            return
+        last_mb = (
+            self.class_ended and
+            (not self.pending_minibatches_count or not self.is_master) and
+            not self.failed_minibatches)
+        self.last_minibatch <<= last_mb
+        self.epoch_ended <<= last_mb and (
+            self.minibatch_class == VALID or
+            (self.minibatch_class == TEST and
+             self.class_lengths[TRAIN] == self.class_lengths[VALID] == 0) or
+            (self.minibatch_class == TEST and self.testing) or
+            (self.minibatch_class == TRAIN and
+             self.class_lengths[VALID] == 0))
+
+    def _on_successful_serve(self):
+        self.samples_served += self.minibatch_size
+        if self.last_minibatch:
+            self.debug(
+                "last minibatch of class %s served in epoch %d",
+                CLASS_NAME[self.minibatch_class], self.epoch_number)
+
+    # -- normalization analysis --------------------------------------------
+    def analyze_dataset(self):
+        """Stream the TRAIN set through the normalizer once
+        (ref ``:755-803``); also collects the label mapping when the
+        subclass provides raw labels."""
+        if self.class_lengths[TRAIN] == 0:
+            if not self.normalizer.is_initialized:
+                raise LoaderError(
+                    "no train samples and the normalizer is uninitialized; "
+                    "derive_from() an existing loader or set "
+                    "normalizer.state")
+            return
+        labels_seen = {}
+
+        def callback():
+            if self.has_labels and not self.labels_mapping:
+                for raw in self.raw_minibatch_labels[:self.minibatch_size]:
+                    if raw is not None and raw not in labels_seen:
+                        labels_seen[raw] = len(labels_seen)
+            self.normalizer.analyze(
+                self.minibatch_data.mem[:self.minibatch_size])
+
+        self._iterate_class(TRAIN, callback)
+        if self.has_labels and not self.labels_mapping and labels_seen:
+            # integer raw labels keep their numeric order
+            try:
+                ordered = sorted(labels_seen)
+            except TypeError:
+                ordered = list(labels_seen)
+            self.labels_mapping = {raw: i for i, raw in enumerate(ordered)}
+
+    def _iterate_class(self, class_index, fn):
+        if not self.shuffled_indices:
+            self.shuffled_indices.mem = numpy.arange(
+                self.total_samples, dtype=INDEX_DTYPE)
+        length = self.class_lengths[class_index]
+        start = self.class_end_offsets[class_index - 1] \
+            if class_index > 0 else 0
+        n_batches = int(numpy.ceil(length / self.max_minibatch_size))
+        for i in range(n_batches):
+            offset = i * self.max_minibatch_size
+            self.minibatch_size = min(self.max_minibatch_size,
+                                      length - offset)
+            self.minibatch_indices.map_write()
+            self.minibatch_indices.mem[:self.minibatch_size] = \
+                self.shuffled_indices.mem[
+                    start + offset:start + offset + self.minibatch_size]
+            self.fill_minibatch()
+            fn()
+
+    def derive_from(self, other):
+        """Reuse another loader's normalization statistics + label
+        mapping (ref ``:249``) — the test/inference-time path."""
+        self._normalization_type = other._normalization_type
+        self._normalization_parameters = other._normalization_parameters
+        self._normalizer = normalizer_factory(
+            self._normalization_type, **self._normalization_parameters)
+        self._normalizer.state = other.normalizer.state
+        self.labels_mapping = dict(other.labels_mapping)
+        return self
+
+    # -- distribution (ref :631-687) ---------------------------------------
+    def generate_data_for_master(self):
+        return True
+
+    def generate_data_for_slave(self, slave=None):
+        sid = getattr(slave, "id", slave)
+        self.serve_next_minibatch(sid)
+        data = {"indices": numpy.array(
+            self.minibatch_indices.mem[:self.minibatch_size])}
+        for attr in ("minibatch_class", "minibatch_size",
+                     "minibatch_offset", "epoch_number"):
+            data[attr] = getattr(self, attr)
+        return data
+
+    def apply_data_from_master(self, data):
+        for attr in ("minibatch_class", "minibatch_size",
+                     "minibatch_offset", "epoch_number"):
+            setattr(self, attr, data[attr])
+        self.last_minibatch <<= False
+        self.epoch_ended <<= False
+        self.train_ended <<= False
+        indices = data["indices"]
+        if indices.size != self.minibatch_size:
+            raise LoaderError("minibatch size mismatch in job payload")
+        if not self.shuffled_indices:
+            self.shuffled_indices.mem = numpy.arange(
+                self.total_samples, dtype=INDEX_DTYPE)
+        self.shuffled_indices.map_write()
+        self.shuffled_indices.mem[
+            self.minibatch_offset - self.minibatch_size:
+            self.minibatch_offset] = indices
+
+    def apply_data_from_slave(self, data, slave=None):
+        sid = getattr(slave, "id", slave)
+        if not self.pending_minibatches_.get(sid):
+            raise LoaderError("no pending minibatches for slave %r" % sid)
+        self.minibatch_offset, self.minibatch_size = \
+            self.pending_minibatches_[sid].pop()
+        self._on_successful_serve()
+
+    def drop_slave(self, slave=None):
+        sid = getattr(slave, "id", slave)
+        if sid in self.pending_minibatches_:
+            failed = self.pending_minibatches_.pop(sid)
+            self._total_failed += len(failed)
+            self.failed_minibatches.extend(failed)
+            self.info("requeued %d failed minibatches (total failed: %d)",
+                      len(failed), self._total_failed)
+
+    # -- results ------------------------------------------------------------
+    def get_metric_values(self):
+        return {"Total epochs": self.epoch_number}

@@ -1,0 +1,167 @@
+"""FullBatchLoader: whole dataset resident in memory (optionally on HBM).
+
+Parity target: reference ``veles/loader/fullbatch.py`` —
+``FullBatchLoader`` (``:79``) keeps ``original_data`` / ``original_labels``
+resident and fills minibatches on-device via the ``fullbatch_loader``
+gather kernel (``ocl/fullbatch_loader.cl:5-30``); ``FullBatchLoaderMSE``
+(``:563``) adds ``original_targets`` for regression.
+
+TPU re-design: the dataset Vectors live on HBM once (one upload), the
+minibatch fill is :func:`veles_tpu.ops.gather.take_rows` on the shuffled
+index slice — the jitted consumer (forward unit / fused train step) reads
+``minibatch_data.devmem`` so the gather fuses into the step and nothing
+round-trips to host during training.  Normalization is applied to the
+resident data once at initialize (the reference normalizes per-minibatch
+on host; one-shot is equivalent for stateless/TRAIN-fit normalizers and
+removes a per-step host pass).
+"""
+
+import numpy
+
+from veles_tpu.loader.base import Loader, LoaderError, TRAIN
+from veles_tpu.memory import Vector
+from veles_tpu.ops.gather import take_rows
+
+
+class FullBatchLoader(Loader):
+    """Subclasses implement ``load_data()`` filling ``original_data``,
+    ``original_labels`` (list or array) and ``class_lengths``."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.original_data = Vector()
+        self.original_labels = []
+        #: keep the dataset on device and gather there (default on)
+        self.store_in_device_memory = kwargs.get(
+            "store_in_device_memory", True)
+        super(FullBatchLoader, self).__init__(workflow, **kwargs)
+
+    @property
+    def has_labels(self):
+        return len(self.original_labels) > 0
+
+    def create_minibatch_data(self):
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + self.original_data.shape[1:],
+            dtype=self.original_data.dtype))
+
+    def initialize(self, device=None, **kwargs):
+        super(FullBatchLoader, self).initialize(**kwargs)
+        if device is not None:
+            self.device = device
+        else:
+            self.device = getattr(self.workflow, "device", None)
+        if len(self.original_data) != self.total_samples:
+            raise LoaderError(
+                "original_data has %d samples, class_lengths say %d" %
+                (len(self.original_data), self.total_samples))
+        if self.has_labels and \
+                len(self.original_labels) != self.total_samples:
+            raise LoaderError("original_labels length mismatch")
+        # One-shot normalization of the resident dataset (see module doc).
+        self.normalizer.normalize(self.original_data.mem)
+        self.original_data.map_write()
+        if self.has_labels:
+            mapped = [self.labels_mapping.get(raw, raw)
+                      for raw in self.original_labels]
+            self._mapped_labels = numpy.asarray(mapped, dtype=numpy.int32)
+        else:
+            self._mapped_labels = None
+        if self.device is not None and not self.device.is_interpret \
+                and self.store_in_device_memory:
+            self.original_data.initialize(self.device)
+            self.original_data.devmem  # upload once
+            self.minibatch_data.initialize(self.device)
+
+    def analyze_dataset(self):
+        """The dataset is fully resident: analyze directly instead of
+        streaming minibatches (faster, same statistics)."""
+        if self.class_lengths[TRAIN] == 0:
+            if not self.normalizer.is_initialized:
+                raise LoaderError(
+                    "no train samples and uninitialized normalizer")
+            return
+        start = self.class_end_offsets[TRAIN - 1]
+        self.normalizer.analyze(self.original_data.mem[start:])
+        if self.has_labels and not self.labels_mapping:
+            uniques = sorted(set(self.original_labels))
+            self.labels_mapping = {raw: i for i, raw in enumerate(uniques)}
+
+    def fill_minibatch(self):
+        """Gather the minibatch rows (device-side when resident)."""
+        count = self.minibatch_size
+        self.minibatch_indices.map_write()
+        self.minibatch_indices.mem[count:] = -1
+        indices = self.minibatch_indices.mem[:self.max_minibatch_size]
+        if self.device is not None and not self.device.is_interpret \
+                and self.store_in_device_memory:
+            self.minibatch_data.devmem = take_rows(
+                self.original_data.devmem, indices)
+        else:
+            self.minibatch_data.map_write()
+            data = self.original_data.mem
+            for i, idx in enumerate(indices):
+                self.minibatch_data.mem[i] = data[idx] if idx >= 0 else 0
+        if self.has_labels:
+            self.minibatch_labels.map_write()
+            labels = self._mapped_labels
+            for i, idx in enumerate(indices):
+                self.minibatch_labels.mem[i] = labels[idx] if idx >= 0 \
+                    else -1
+            for i, idx in enumerate(indices[:count]):
+                self.raw_minibatch_labels[i] = self.original_labels[idx] \
+                    if idx >= 0 else None
+
+    def pad_minibatch(self, minibatch_size):
+        """No-op: fill_minibatch gathers with -1 markers which zero/-1
+        fill the tail already."""
+
+    def normalize_minibatch(self):
+        """No-op: the resident dataset was normalized once at
+        initialize."""
+
+    def map_minibatch_labels(self):
+        """No-op: labels were mapped in fill_minibatch from the
+        pre-mapped resident array."""
+
+
+class FullBatchLoaderMSE(FullBatchLoader):
+    """Adds per-sample regression targets (ref ``fullbatch.py:563``)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.original_targets = Vector()
+        self.minibatch_targets = Vector()
+        super(FullBatchLoaderMSE, self).__init__(workflow, **kwargs)
+
+    def initialize(self, device=None, **kwargs):
+        super(FullBatchLoaderMSE, self).initialize(device=device, **kwargs)
+        if len(self.original_targets) != self.total_samples:
+            raise LoaderError("original_targets length mismatch")
+        self.minibatch_targets.reset(numpy.zeros(
+            (self.max_minibatch_size,) + self.original_targets.shape[1:],
+            dtype=self.original_targets.dtype))
+        if self.device is not None and not self.device.is_interpret \
+                and self.store_in_device_memory:
+            self.original_targets.initialize(self.device)
+            self.original_targets.devmem
+            self.minibatch_targets.initialize(self.device)
+
+    def fill_minibatch(self):
+        super(FullBatchLoaderMSE, self).fill_minibatch()
+        count = self.minibatch_size
+        self.minibatch_indices.map_read()
+        indices = self.minibatch_indices.mem[:self.max_minibatch_size].copy()
+        indices[count:] = -1
+        if self.device is not None and not self.device.is_interpret \
+                and self.store_in_device_memory:
+            self.minibatch_targets.devmem = take_rows(
+                self.original_targets.devmem, indices)
+        else:
+            self.minibatch_targets.map_write()
+            targets = self.original_targets.mem
+            for i, idx in enumerate(indices):
+                self.minibatch_targets.mem[i] = targets[idx] if idx >= 0 \
+                    else 0

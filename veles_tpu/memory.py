@@ -170,6 +170,9 @@ class Vector(Pickleable):
         """Declare intent to edit the host copy in place: next devmem
         access re-uploads."""
         self.map_read()
+        if self._mem is not None and not self._mem.flags.writeable:
+            # numpy views of jax arrays are read-only — materialize.
+            self._mem = numpy.array(self._mem)
         self._dev_fresh_ = False
         return self
 

@@ -123,13 +123,21 @@ class LinkableAttribute(object):
 
     @staticmethod
     def link(dst, dst_name, src, src_name, two_way=False):
-        links = dst.__dict__.setdefault("_linked_attrs_", {})
+        links = dst.__dict__.setdefault("_linked_attrs", {})
         links[dst_name] = (src, src_name, two_way)
         _install_forwarding(type(dst), dst_name)
 
     @staticmethod
+    def reinstall(obj):
+        """Re-install forwarding descriptors after unpickling in a fresh
+        process (class mutation from ``link()`` is process-local while
+        ``_linked_attrs`` pickles with the instance)."""
+        for name in obj.__dict__.get("_linked_attrs", {}):
+            _install_forwarding(type(obj), name)
+
+    @staticmethod
     def unlink(dst, dst_name):
-        links = dst.__dict__.get("_linked_attrs_", {})
+        links = dst.__dict__.get("_linked_attrs", {})
         if dst_name in links:
             src, src_name, _ = links.pop(dst_name)
             # Materialize the current value locally.
@@ -138,7 +146,7 @@ class LinkableAttribute(object):
 
 class _Forward(object):
     """Data descriptor forwarding instance attribute access through
-    ``_linked_attrs_`` when a link exists, else plain instance dict."""
+    ``_linked_attrs`` when a link exists, else plain instance dict."""
 
     __slots__ = ("name", "default", "has_default")
 
@@ -150,7 +158,7 @@ class _Forward(object):
     def __get__(self, obj, objtype=None):
         if obj is None:
             return self
-        link = obj.__dict__.get("_linked_attrs_", {}).get(self.name)
+        link = obj.__dict__.get("_linked_attrs", {}).get(self.name)
         if link is not None:
             src, src_name, _ = link
             return getattr(src, src_name)
@@ -163,7 +171,7 @@ class _Forward(object):
                 "%r has no attribute %r" % (obj, self.name)) from None
 
     def __set__(self, obj, value):
-        link = obj.__dict__.get("_linked_attrs_", {}).get(self.name)
+        link = obj.__dict__.get("_linked_attrs", {}).get(self.name)
         if link is not None:
             src, src_name, two_way = link
             if two_way:
@@ -179,7 +187,7 @@ class _Forward(object):
         obj.__dict__[self.name] = value
 
     def __delete__(self, obj):
-        obj.__dict__.get("_linked_attrs_", {}).pop(self.name, None)
+        obj.__dict__.get("_linked_attrs", {}).pop(self.name, None)
         obj.__dict__.pop(self.name, None)
 
 

@@ -73,10 +73,13 @@ def autotune_gemm(shapes=((4096, 4096, 4096),), dtypes=("bfloat16",
     info = db.setdefault(model, DeviceInfo(model))
     for dtype_name in dtypes:
         dtype = jnp.dtype(dtype_name)
-        best_time, best_tiles = float("inf"), None
+        # Aggregate flops-normalized time per candidate over ALL shapes —
+        # raw elapsed would let the smallest shape decide the winner.
+        totals = {}
         for m, k, n in shapes:
             a = jnp.ones((m, k), dtype)
             b = jnp.ones((k, n), dtype)
+            flops = 2.0 * m * k * n
             for tiles in candidates:
                 try:
                     fn = jax.jit(lambda x, y, t=tiles: matmul(
@@ -87,12 +90,16 @@ def autotune_gemm(shapes=((4096, 4096, 4096),), dtypes=("bfloat16",
                         fn(a, b).block_until_ready()
                     elapsed = (time.perf_counter() - tic) / runs
                 except Exception:
+                    totals.pop(tiles, None)
                     continue
-                if elapsed < best_time:
-                    best_time, best_tiles = elapsed, tiles
-        if best_tiles is not None:
+                if tiles in totals or (m, k, n) == shapes[0]:
+                    totals[tiles] = totals.get(tiles, 0.0) \
+                        + elapsed / flops
+        if totals:
+            best_tiles = min(totals, key=totals.get)
             info.ratings.setdefault("gemm", {})[dtype_name] = {
-                "time": best_time, "tiles": list(best_tiles)}
+                "sec_per_flop": totals[best_tiles] / len(shapes),
+                "tiles": list(best_tiles)}
     if save:
         DeviceInfo.save_db(db, db_path)
     return info

@@ -70,14 +70,11 @@ def _matmul_kernel(a_ref, b_ref, bias_ref, o_ref, acc_ref, *, n_k,
         o_ref[:] = acc.astype(o_ref.dtype)
 
 
+from veles_tpu.ops.util import pad_axis as _pad_to_impl, round_up
+
+
 def _pad_to(x, mult, axis):
-    size = x.shape[axis]
-    rem = size % mult
-    if rem == 0:
-        return x
-    pad = [(0, 0)] * x.ndim
-    pad[axis] = (0, mult - rem)
-    return jnp.pad(x, pad)
+    return _pad_to_impl(x, mult, axis)
 
 
 @functools.partial(jax.jit, static_argnames=("activation", "tiles",
@@ -89,8 +86,8 @@ def _matmul_pallas(a, b, bias, activation=None, tiles=None, out_dtype=None,
     assert k == k2, (a.shape, b.shape)
     out_dtype = out_dtype or a.dtype
     bm, bk, bn = tiles or DEFAULT_TILES
-    bm, bk, bn = min(bm, _round_up(m, 8)), min(bk, _round_up(k, 128)), \
-        min(bn, _round_up(n, 128))
+    bm, bk, bn = min(bm, round_up(m, 8)), min(bk, round_up(k, 128)), \
+        min(bn, round_up(n, 128))
     a_p = _pad_to(_pad_to(a, bm, 0), bk, 1)
     b_p = _pad_to(_pad_to(b, bk, 0), bn, 1)
     has_bias = bias is not None
@@ -117,10 +114,6 @@ def _matmul_pallas(a, b, bias, activation=None, tiles=None, out_dtype=None,
         interpret=interpret,
     )(a_p, b_p, bias_p)
     return out[:m, :n]
-
-
-def _round_up(x, mult):
-    return ((x + mult - 1) // mult) * mult
 
 
 def _matmul_jnp(a, b, bias, activation=None, out_dtype=None):
@@ -165,7 +158,9 @@ def _matmul_fwd(a, b, bias, activation, tiles, use_pallas):
             interpret=bool(root.common.engine.get("interpret", False)))
     else:
         out = _matmul_jnp(a, b, bias, activation=activation)
-    return out, (a, b, bias, out)
+    # linear backward never reads the output — don't pin it in residuals
+    saved_out = out if activation not in (None, "linear") else None
+    return out, (a, b, bias, saved_out)
 
 
 def _matmul_bwd(activation, tiles, use_pallas, residuals, g):

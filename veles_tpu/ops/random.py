@@ -34,8 +34,10 @@ def normal(key, shape, dtype=jnp.float32, mean=0.0, stddev=1.0):
 
 
 def _uniform_kernel(seed_ref, o_ref, *, low, high):
-    # Distinct seed per grid cell: fold the program id in.
-    pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+    # Distinct stream per grid cell: golden-ratio hash of the program id
+    # keeps (seed, block) pairs from colliding across *consecutive* seeds
+    # the way plain ``seed + i`` would.
+    pltpu.prng_seed(seed_ref[0] ^ (pl.program_id(0) * 0x9E3779B9))
     bits = pltpu.bitcast(pltpu.prng_random_bits(o_ref.shape), jnp.uint32)
     # 24 high bits → [0, 1) float32 (the reference maps its 64-bit output
     # the same way, ocl/random.cl:96-110)
@@ -43,13 +45,25 @@ def _uniform_kernel(seed_ref, o_ref, *, low, high):
     o_ref[:] = (u01 * (high - low) + low).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("shape", "dtype", "low", "high",
-                                    "interpret"))
-def uniform_pallas(seed, shape, dtype=jnp.float32, low=0.0, high=1.0,
-                   interpret=False):
+def uniform_pallas(seed, shape, dtype=jnp.float32, low=0.0, high=1.0):
     """Uniform fill via the TPU hardware PRNG.  ``seed`` is an int32
-    scalar array; same (seed, shape) → same bits."""
+    scalar; same (seed, shape) → same bits.
+
+    The hardware PRNG has no interpret-mode lowering, so off-TPU this
+    transparently falls back to threefry (different bits, same
+    distribution) — callers get one API everywhere."""
+    from veles_tpu.ops import on_tpu
+    if not on_tpu():
+        key = jax.random.fold_in(jax.random.key(0), jnp.asarray(
+            seed, jnp.int32))
+        return uniform(key, shape, dtype=dtype, low=low, high=high)
+    return _uniform_pallas_tpu(seed, shape, dtype, low, high)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("shape", "dtype", "low", "high"))
+def _uniform_pallas_tpu(seed, shape, dtype=jnp.float32, low=0.0,
+                        high=1.0):
     if len(shape) == 1:
         shape2 = (1, shape[0])
     else:
@@ -64,7 +78,6 @@ def uniform_pallas(seed, shape, dtype=jnp.float32, low=0.0, high=1.0,
         out_specs=pl.BlockSpec((bm,) + shape2[1:],
                                lambda i: (i,) + (0,) * (len(shape2) - 1)),
         out_shape=jax.ShapeDtypeStruct(shape2, dtype),
-        interpret=interpret,
     )(jnp.asarray(seed, jnp.int32).reshape(1))
     return out.reshape(shape)
 

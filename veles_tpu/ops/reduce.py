@@ -16,6 +16,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from veles_tpu.ops.util import pad_axis, round_up as _round_up
+
 
 def matrix_reduce(a, axis=0, op="sum", use_pallas=None):
     """Reduce a 2D matrix along ``axis`` (0: over rows → per-column
@@ -102,16 +104,6 @@ def _reduce_pallas(a, axis=0, op="sum", interpret=False):
     return out[:, 0]
 
 
-def _round_up(x, mult):
-    return ((x + mult - 1) // mult) * mult
-
-
 def _pad_value(a, mult, axis, op):
-    size = a.shape[axis]
-    rem = size % mult
-    if rem == 0:
-        return a
-    pad = [(0, 0)] * a.ndim
-    pad[axis] = (0, mult - rem)
     value = {"sum": 0.0, "max": -jnp.inf, "min": jnp.inf}[op]
-    return jnp.pad(a, pad, constant_values=value)
+    return pad_axis(a, mult, axis, value=value)

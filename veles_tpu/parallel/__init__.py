@@ -1,0 +1,20 @@
+"""L4 distribution layer, TPU-native.
+
+Replaces the reference's master–slave gradient path (SURVEY §2.4: pickled
+job payloads over ZeroMQ, master-side ``apply_data_from_slave`` weight
+merging, ``server.py``/``client.py``) with the BASELINE.json north star:
+
+* **on-pod**: synchronous data parallelism — the fused train step jitted
+  over a ``jax.sharding.Mesh`` with the batch sharded on the ``data``
+  axis and parameters replicated; XLA inserts the ICI all-reduce
+  (``psum``) where the reference mailed gradients through ZMQ
+  (:mod:`veles_tpu.parallel.dp`).
+* **cross-slice / DCN**: the reference's *job* model survives one level
+  up — whole training runs (GA members, ensemble models, elastic eval)
+  farmed to workers over a line-protocol control plane with
+  requeue-on-drop (:mod:`veles_tpu.parallel.jobs`).
+"""
+
+from veles_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh, replicated, shard_batch)
+from veles_tpu.parallel.dp import data_parallel  # noqa: F401

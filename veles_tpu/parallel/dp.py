@@ -1,0 +1,58 @@
+"""Synchronous data parallelism over the device mesh.
+
+The TPU-native replacement for the reference's gradient path (§3.2 of
+SURVEY: slaves pull jobs with weights, push updates; master merges).
+Here the whole train step is ONE jitted program over the mesh: batch
+sharded on ``data``, parameters replicated; XLA turns the gradient
+contractions into ``reduce_scatter``/``all_reduce`` over ICI.  The
+update happens inside the step, so parameters never leave HBM and no
+host master exists on the hot path.
+
+Also provides tensor-parallel param sharding rules (the mesh design
+gives TP "for free" — SURVEY §2.4 table) for models whose layers
+exceed a chip.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from veles_tpu.parallel.mesh import replicated
+
+
+def _params_sharding(params, mesh, rules=None):
+    """Sharding pytree for params.  ``rules``: optional callable
+    (path-free) mapping a leaf to a PartitionSpec; default replicate."""
+    def leaf_sharding(leaf):
+        if rules is not None:
+            spec = rules(leaf)
+            if spec is not None:
+                return NamedSharding(mesh, spec)
+        return replicated(mesh)
+    return jax.tree.map(leaf_sharding, params)
+
+
+def data_parallel(step_fn, mesh, params_example, donate_params=True,
+                  batch_axis="data", param_rules=None):
+    """Compile ``step(params, x, labels) -> (params, metrics)`` for the
+    mesh: x/labels sharded over ``batch_axis``, params replicated (or
+    sharded per ``param_rules`` for TP), metrics replicated.
+
+    The returned callable accepts ordinary (host or single-device)
+    arrays; jit moves them according to the shardings.
+    """
+    p_shard = _params_sharding(params_example, mesh, param_rules)
+    x_shard = NamedSharding(mesh, P(batch_axis))
+    return jax.jit(
+        step_fn,
+        in_shardings=(p_shard, x_shard, x_shard),
+        out_shardings=(p_shard, replicated(mesh)),
+        donate_argnums=(0,) if donate_params else (),
+    )
+
+
+def shard_params(params, mesh, param_rules=None):
+    """Place a params pytree onto the mesh eagerly (replicated or per
+    rules) — what a restored snapshot does before resuming on a
+    different topology (SURVEY §5.4 'resume with different topology')."""
+    shardings = _params_sharding(params, mesh, param_rules)
+    return jax.tree.map(jax.device_put, params, shardings)

@@ -1,0 +1,51 @@
+"""Mesh construction and sharding helpers.
+
+The logical axes follow the scaling-book convention: ``data`` (DP),
+``model`` (TP); pipeline/sequence axes are added by their consumers.
+An axis size of -1 absorbs all remaining devices (mirrors
+``TPUDevice.make_mesh``, :mod:`veles_tpu.backends`).
+"""
+
+import jax
+import numpy
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes=None, devices=None):
+    """axes: {name: size}; -1 absorbs the remainder."""
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes or {"data": -1})
+    fixed = 1
+    wild = None
+    for name, size in axes.items():
+        if size == -1:
+            wild = name
+        else:
+            fixed *= size
+    if wild is not None:
+        axes[wild] = max(1, len(devices) // fixed)
+    names = tuple(axes)
+    shape = tuple(axes[n] for n in names)
+    count = int(numpy.prod(shape))
+    if count > len(devices):
+        raise ValueError(
+            "mesh %r needs %d devices, have %d" % (axes, count,
+                                                   len(devices)))
+    grid = numpy.array(devices[:count]).reshape(shape)
+    return Mesh(grid, names)
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh, axis="data", ndim=2):
+    """Batch-dim sharding: first dim split over ``axis``."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def shard_model(mesh, dim, ndim=2, axis="model"):
+    """Tensor-parallel sharding of parameter dim ``dim``."""
+    spec = [None] * ndim
+    spec[dim] = axis
+    return NamedSharding(mesh, P(*spec))

@@ -28,7 +28,6 @@ via ``wants_thread = True``.
 
 import threading
 import time
-import weakref
 
 from veles_tpu.config import root
 from veles_tpu.distributable import Distributable
@@ -114,6 +113,8 @@ class Unit(Distributable, metaclass=UnitRegistry):
         if not hasattr(self, "_workflow_ref_"):
             # standalone unpickle; Workflow.__setstate__ re-links members
             self._workflow_ref_ = None
+        # data-link descriptors are class-level and process-local
+        LinkableAttribute.reinstall(self)
 
     def __repr__(self):
         return '<%s "%s">' % (self.__class__.__name__, self.name)
@@ -121,11 +122,14 @@ class Unit(Distributable, metaclass=UnitRegistry):
     # -- workflow membership ----------------------------------------------
     @property
     def workflow(self):
-        return self._workflow_ref_() if self._workflow_ref_ is not None else None
+        return self._workflow_ref_
 
     @workflow.setter
     def workflow(self, value):
-        self._workflow_ref_ = weakref.ref(value) if value is not None else None
+        # Strong ref (the workflow↔unit cycle is collectable); trailing
+        # underscore keeps it out of pickles — Workflow.__setstate__
+        # re-links its members.
+        self._workflow_ref_ = value
 
     @property
     def is_initialized(self):

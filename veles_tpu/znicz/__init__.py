@@ -1,0 +1,27 @@
+"""Znicz-equivalent neural-network unit library.
+
+The reference's NN layer library ("Znicz") is an empty submodule in the
+checkout; its unit families and exact class names are reconstructed from
+the platform docs (``manualrst_veles_workflow_parameters.rst:467-505`` —
+36 layer types; hyperparameters at ``:506-580``; model families at
+``manualrst_veles_algorithms.rst:18-137``).  SURVEY §2.7 is the inventory
+this package builds to.
+
+TPU re-design: forward units are thin hosts around pure jitted functions
+over ``Vector.devmem`` arrays (activations fused into the matmul/conv);
+gradient units reuse the same pure functions through JAX VJPs, so the
+hand-written backward math of the reference collapses to derivative
+formulas evaluated from forward outputs.  Chains of units can additionally
+be *fused* into one jitted train step (see
+:mod:`veles_tpu.znicz.fused`) — the form the benchmark and the
+data-parallel path run.
+"""
+
+from veles_tpu.znicz.all2all import (  # noqa: F401
+    All2All, All2AllRELU, All2AllSigmoid, All2AllSoftmax,
+    All2AllStrictRELU, All2AllTanh)
+from veles_tpu.znicz.gd import (  # noqa: F401
+    GradientDescent, GDRELU, GDSigmoid, GDSoftmax, GDStrictRELU, GDTanh)
+from veles_tpu.znicz.evaluator import (  # noqa: F401
+    EvaluatorMSE, EvaluatorSoftmax)
+from veles_tpu.znicz.decision import DecisionGD, DecisionMSE  # noqa: F401

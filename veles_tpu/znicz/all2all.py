@@ -1,0 +1,169 @@
+"""Fully-connected forward layers.
+
+Parity target: Znicz ``all2all.All2All{,Tanh,Sigmoid,RELU,StrictRELU,
+Softmax}`` (class registry in
+``manualrst_veles_workflow_parameters.rst:469-471``): ``output =
+activation(input·W + b)`` with Znicz's activation definitions (scaled tanh
+``1.7159·tanh(0.6666x)``, smooth RELU ``log(1+eˣ)``).
+
+TPU path: one fused call into :func:`veles_tpu.ops.gemm.matmul` — the
+activation rides the GEMM epilogue, input stays on HBM between layers.
+"""
+
+import numpy
+
+import veles_tpu.ops.gemm as gemm
+from veles_tpu.memory import Vector
+from veles_tpu.znicz.nn_units import ForwardBase
+
+
+class All2All(ForwardBase):
+    """Linear fully-connected layer (activation = identity)."""
+
+    MAPPING = "all2all"
+    ACTIVATION = None
+
+    def __init__(self, workflow, **kwargs):
+        super(All2All, self).__init__(workflow, **kwargs)
+        shape = kwargs.get("output_sample_shape", ())
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.output_sample_shape = tuple(shape)
+        self.output_samples_number = None
+
+    @property
+    def neurons_number(self):
+        return int(numpy.prod(self.output_sample_shape))
+
+    def initialize(self, device=None, **kwargs):
+        super(All2All, self).initialize(device=device, **kwargs)
+        n_input = int(numpy.prod(self.input.shape[1:]))
+        n_neurons = self.neurons_number
+        if not self.weights:
+            w = numpy.zeros((n_input, n_neurons), dtype=numpy.float32)
+            self.fill_array(w, self.weights_filling, self.weights_stddev)
+            self.weights.reset(w)
+        if self.include_bias and not self.bias:
+            b = numpy.zeros((n_neurons,), dtype=numpy.float32)
+            self.fill_array(b, self.bias_filling, self.bias_stddev)
+            self.bias.reset(b)
+        batch = self.input.shape[0]
+        self.output.reset(numpy.zeros(
+            (batch,) + self.output_sample_shape, dtype=numpy.float32))
+        self.init_vectors(self.weights, self.bias, self.output)
+
+    def _flat_input_host(self):
+        self.input.map_read()
+        return self.input.mem.reshape(len(self.input.mem), -1)
+
+    def numpy_run(self):
+        x = self._flat_input_host().astype(numpy.float32)
+        out = x @ self.weights.mem
+        if self.include_bias:
+            out = out + self.bias.mem
+        out = self.apply_activation_numpy(out)
+        self.output.map_invalidate()
+        self.output.mem = out.reshape(
+            (len(x),) + self.output_sample_shape)
+
+    def tpu_run(self):
+        x = self.input.devmem
+        x = x.reshape(x.shape[0], -1)
+        bias = self.bias.devmem if self.include_bias else None
+        out = gemm.matmul(x, self.weights.devmem, bias, self.ACTIVATION)
+        self.output.devmem = out.reshape(
+            (x.shape[0],) + self.output_sample_shape)
+
+    def apply_activation_numpy(self, v):
+        return v
+
+
+class All2AllTanh(All2All):
+    """Scaled tanh (docs: 1.7159·tanh(0.6666·x))."""
+
+    MAPPING = "all2all_tanh"
+    ACTIVATION = "tanh"
+    A = 1.7159
+    B = 0.6666
+
+    def apply_activation_numpy(self, v):
+        return self.A * numpy.tanh(self.B * v)
+
+
+class All2AllSigmoid(All2All):
+    MAPPING = "all2all_sigmoid"
+    ACTIVATION = "sigmoid"
+
+    def apply_activation_numpy(self, v):
+        return 1.0 / (1.0 + numpy.exp(-v))
+
+
+class All2AllRELU(All2All):
+    """Znicz smooth RELU: log(1 + eˣ)."""
+
+    MAPPING = "all2all_relu"
+    ACTIVATION = "relu"
+
+    def apply_activation_numpy(self, v):
+        return numpy.log(1.0 + numpy.exp(numpy.minimum(v, 30)))
+
+
+class All2AllStrictRELU(All2All):
+    MAPPING = "all2all_strict_relu"
+    ACTIVATION = "strict_relu"
+
+    def apply_activation_numpy(self, v):
+        return numpy.maximum(v, 0.0)
+
+
+class All2AllSoftmax(All2All):
+    """Linear layer + softmax; also exports ``max_idx`` (argmax per
+    sample) which the evaluator consumes (Znicz contract)."""
+
+    MAPPING = "softmax"
+    ACTIVATION = None
+
+    def __init__(self, workflow, **kwargs):
+        super(All2AllSoftmax, self).__init__(workflow, **kwargs)
+        self.max_idx = Vector()
+
+    def initialize(self, device=None, **kwargs):
+        super(All2AllSoftmax, self).initialize(device=device, **kwargs)
+        self.max_idx.reset(numpy.zeros(self.output.shape[0],
+                                       dtype=numpy.int32))
+        self.init_vectors(self.max_idx)
+
+    def numpy_run(self):
+        x = self._flat_input_host().astype(numpy.float32)
+        logits = x @ self.weights.mem
+        if self.include_bias:
+            logits = logits + self.bias.mem
+        m = logits.max(axis=1, keepdims=True)
+        e = numpy.exp(logits - m)
+        sm = e / e.sum(axis=1, keepdims=True)
+        self.output.map_invalidate()
+        self.output.mem = sm
+        self.max_idx.map_invalidate()
+        self.max_idx.mem = logits.argmax(axis=1).astype(numpy.int32)
+
+    def tpu_run(self):
+        import jax.numpy as jnp
+        x = self.input.devmem
+        x = x.reshape(x.shape[0], -1)
+        bias = self.bias.devmem if self.include_bias else None
+        logits = gemm.matmul(x, self.weights.devmem, bias, None)
+        sm = _softmax_jit(logits)
+        self.output.devmem = sm
+        self.max_idx.devmem = jnp.argmax(logits, axis=1).astype(jnp.int32)
+
+
+def _softmax(logits):
+    import jax.numpy as jnp
+    m = jnp.max(logits, axis=1, keepdims=True)
+    e = jnp.exp(logits - m)
+    return e / jnp.sum(e, axis=1, keepdims=True)
+
+
+import jax  # noqa: E402
+
+_softmax_jit = jax.jit(_softmax)

@@ -1,0 +1,161 @@
+"""Decision: epoch accounting, stop criteria, improvement tracking.
+
+Parity target: the Znicz ``decision.DecisionGD`` role in StandardWorkflow
+(``manualrst_veles_workflow_creation.rst:108-430``): accumulates per-class
+error over each epoch from the evaluator's minibatch stats, decides
+``improved`` (validation error beat the best so far), raises ``complete``
+when training should stop (``max_epochs`` reached or no improvement for
+``fail_iterations`` epochs), and exposes the flags the rest of the graph
+gates on (snapshotter fires on ``improved``; the repeater's back edge is
+blocked by ``complete``).
+"""
+
+import numpy
+
+from veles_tpu.loader.base import CLASS_NAME, TEST, TRAIN, VALID
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+
+class DecisionBase(Unit):
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(DecisionBase, self).__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.max_epochs = kwargs.get("max_epochs", None)
+        self.fail_iterations = kwargs.get("fail_iterations", 100)
+        self.complete = Bool(False)
+        self.improved = Bool(False)
+        self.snapshot_suffix = ""
+        # linked from loader:
+        self.minibatch_class = None
+        self.minibatch_size = None
+        self.last_minibatch = None    # Bool
+        self.epoch_ended = None       # Bool
+        self.epoch_number = None
+        self.class_lengths = None
+        self.demand("minibatch_class", "minibatch_size", "last_minibatch",
+                    "epoch_ended", "epoch_number", "class_lengths")
+
+    def link_from_loader(self, loader):
+        self.link_attrs(
+            loader, "minibatch_class", "minibatch_size", "last_minibatch",
+            "epoch_ended", "epoch_number", "class_lengths")
+        return self
+
+
+class DecisionGD(DecisionBase):
+    """Classification decision driven by ``EvaluatorSoftmax.n_err``."""
+
+    def __init__(self, workflow, **kwargs):
+        super(DecisionGD, self).__init__(workflow, **kwargs)
+        self.evaluator = None            # linked: reads n_err per batch
+        self.epoch_n_err = [0, 0, 0]     # per class, current epoch
+        self.epoch_samples = [0, 0, 0]
+        self.epoch_n_err_pt = [100.0, 100.0, 100.0]   # percent, last full
+        self.best_n_err_pt = 100.0
+        self.best_epoch = -1
+        self._epochs_without_improvement = 0
+        self.demand("evaluator")
+
+    def run(self):
+        cls = int(self.minibatch_class)
+        self.epoch_n_err[cls] += float(self.evaluator.n_err)
+        self.epoch_samples[cls] += int(self.minibatch_size)
+        if not bool(self.last_minibatch):
+            return
+        # end of one class's pass
+        if self.epoch_samples[cls]:
+            self.epoch_n_err_pt[cls] = \
+                100.0 * self.epoch_n_err[cls] / self.epoch_samples[cls]
+        self.info("epoch %d %s error: %.2f%% (%d/%d)",
+                  int(self.epoch_number), CLASS_NAME[cls],
+                  self.epoch_n_err_pt[cls], int(self.epoch_n_err[cls]),
+                  self.epoch_samples[cls])
+        validated = cls == VALID or (cls == TRAIN and
+                                     self.class_lengths[VALID] == 0)
+        if validated:
+            err_pt = self.epoch_n_err_pt[cls]
+            if err_pt < self.best_n_err_pt:
+                self.best_n_err_pt = err_pt
+                self.best_epoch = int(self.epoch_number)
+                self.improved <<= True
+                self.snapshot_suffix = "%.2fpt" % err_pt
+                self._epochs_without_improvement = 0
+            else:
+                self.improved <<= False
+                self._epochs_without_improvement += 1
+        if bool(self.epoch_ended):
+            self._on_epoch_ended()
+        self.epoch_n_err[cls] = 0
+        self.epoch_samples[cls] = 0
+
+    def _on_epoch_ended(self):
+        if self.max_epochs is not None and \
+                int(self.epoch_number) + 1 >= self.max_epochs:
+            self.info("max epochs (%d) reached", self.max_epochs)
+            self.complete <<= True
+        if self._epochs_without_improvement >= self.fail_iterations:
+            self.info("no improvement in %d epochs — stopping",
+                      self._epochs_without_improvement)
+            self.complete <<= True
+
+    def get_metric_values(self):
+        return {
+            "best_validation_error_pt": self.best_n_err_pt,
+            "best_epoch": self.best_epoch,
+            "errors_pt": {CLASS_NAME[i]: self.epoch_n_err_pt[i]
+                          for i in (TEST, VALID, TRAIN)},
+        }
+
+
+class DecisionMSE(DecisionBase):
+    """Regression decision driven by ``EvaluatorMSE.mse``."""
+
+    def __init__(self, workflow, **kwargs):
+        super(DecisionMSE, self).__init__(workflow, **kwargs)
+        self.evaluator = None
+        self.epoch_sum_mse = [0.0, 0.0, 0.0]
+        self.epoch_batches = [0, 0, 0]
+        self.epoch_mse = [numpy.inf, numpy.inf, numpy.inf]
+        self.best_mse = numpy.inf
+        self.best_epoch = -1
+        self._epochs_without_improvement = 0
+        self.demand("evaluator")
+
+    def run(self):
+        cls = int(self.minibatch_class)
+        self.epoch_sum_mse[cls] += float(self.evaluator.mse)
+        self.epoch_batches[cls] += 1
+        if not bool(self.last_minibatch):
+            return
+        if self.epoch_batches[cls]:
+            self.epoch_mse[cls] = \
+                self.epoch_sum_mse[cls] / self.epoch_batches[cls]
+        self.info("epoch %d %s rmse: %.4f", int(self.epoch_number),
+                  CLASS_NAME[cls], self.epoch_mse[cls])
+        validated = cls == VALID or (cls == TRAIN and
+                                     self.class_lengths[VALID] == 0)
+        if validated:
+            if self.epoch_mse[cls] < self.best_mse:
+                self.best_mse = self.epoch_mse[cls]
+                self.best_epoch = int(self.epoch_number)
+                self.improved <<= True
+                self.snapshot_suffix = "%.4frmse" % self.best_mse
+                self._epochs_without_improvement = 0
+            else:
+                self.improved <<= False
+                self._epochs_without_improvement += 1
+        if bool(self.epoch_ended):
+            if self.max_epochs is not None and \
+                    int(self.epoch_number) + 1 >= self.max_epochs:
+                self.complete <<= True
+            if self._epochs_without_improvement >= self.fail_iterations:
+                self.complete <<= True
+        self.epoch_sum_mse[cls] = 0.0
+        self.epoch_batches[cls] = 0
+
+    def get_metric_values(self):
+        return {"best_rmse": float(self.best_mse),
+                "best_epoch": self.best_epoch}

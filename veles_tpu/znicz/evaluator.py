@@ -1,0 +1,118 @@
+"""Evaluators: loss + error statistics between forward output and ground
+truth.
+
+Parity target: Znicz ``evaluator.EvaluatorSoftmax`` / ``EvaluatorMSE``
+(the Evaluator role in the StandardWorkflow contract,
+``manualrst_veles_workflow_creation.rst:108-430``): emit ``err_output``
+for the gradient chain and accumulate ``n_err`` / ``confusion_matrix`` /
+loss values the Decision unit reads per minibatch.
+"""
+
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Vector
+
+
+class EvaluatorBase(AcceleratedUnit):
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorBase, self).__init__(workflow, **kwargs)
+        self.view_group = "EVALUATOR"
+        self.output = None           # linked from forward
+        self.err_output = Vector()
+        self.batch_size = None       # linked from loader minibatch_size
+        self.max_samples_per_epoch = None
+        self.testing = kwargs.get("testing", False)
+        self.demand("output", "batch_size")
+
+    def initialize(self, device=None, **kwargs):
+        super(EvaluatorBase, self).initialize(device=device, **kwargs)
+        self.err_output.reset(numpy.zeros(self.output.shape,
+                                          dtype=numpy.float32))
+        self.err_output.initialize(self.device)
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Cross-entropy on softmax output: δ = (y − onehot(label)) and
+    ``n_err`` (mis-argmax count) per minibatch."""
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorSoftmax, self).__init__(workflow, **kwargs)
+        self.labels = None           # linked from loader minibatch_labels
+        self.max_idx = None          # linked from All2AllSoftmax
+        self.compute_confusion_matrix = kwargs.get(
+            "compute_confusion_matrix", True)
+        self.confusion_matrix = Vector()
+        self.n_err = 0               # errors in the last minibatch
+        self.loss = 0.0
+        self.demand("labels", "max_idx")
+
+    def initialize(self, device=None, **kwargs):
+        super(EvaluatorSoftmax, self).initialize(device=device, **kwargs)
+        n_classes = self.output.shape[1]
+        if self.compute_confusion_matrix:
+            self.confusion_matrix.reset(numpy.zeros(
+                (n_classes, n_classes), dtype=numpy.int64))
+
+    def run(self):
+        # Error statistics are host decisions (tiny); the δ fill is device
+        # math but the per-batch sizes are dynamic → keep host-side and
+        # publish via the Vector protocol.  The fused train step
+        # (znicz.fused) bypasses this unit entirely on the hot path.
+        self.output.map_read()
+        self.labels.map_read()
+        self.max_idx.map_read()
+        batch = int(self.batch_size)
+        out = self.output.mem[:batch]
+        labels = self.labels.mem[:batch]
+        valid = labels >= 0
+        err = numpy.array(out, dtype=numpy.float32)
+        idx = numpy.arange(batch)
+        err[idx[valid], labels[valid]] -= 1.0
+        err[~valid] = 0.0
+        self.err_output.map_invalidate()
+        full = numpy.zeros(self.err_output.shape, dtype=numpy.float32)
+        full[:batch] = err
+        self.err_output.mem = full
+        pred = self.max_idx.mem[:batch]
+        self.n_err = int((pred[valid] != labels[valid]).sum())
+        probs = out[idx[valid], labels[valid]]
+        self.loss = float(-numpy.log(numpy.maximum(probs, 1e-30)).mean()) \
+            if valid.any() else 0.0
+        if self.compute_confusion_matrix and self.confusion_matrix:
+            self.confusion_matrix.map_write()
+            numpy.add.at(self.confusion_matrix.mem,
+                         (labels[valid], pred[valid]), 1)
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """Mean-squared error against ``target`` (ref Znicz ``EvaluatorMSE``):
+    δ = (y − t), metrics = rmse per minibatch."""
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorMSE, self).__init__(workflow, **kwargs)
+        self.target = None           # linked from loader minibatch_targets
+        self.mse = 0.0
+        self.n_err = 0
+        self.root = kwargs.get("root", True)
+        self.demand("target")
+
+    def run(self):
+        self.output.map_read()
+        self.target.map_read()
+        batch = int(self.batch_size)
+        out = self.output.mem[:batch].reshape(batch, -1).astype(
+            numpy.float32)
+        target = self.target.mem[:batch].reshape(batch, -1).astype(
+            numpy.float32)
+        err = out - target
+        self.err_output.map_invalidate()
+        full = numpy.zeros(self.err_output.shape, dtype=numpy.float32)
+        full[:batch] = err.reshape((batch,) + self.err_output.shape[1:])
+        self.err_output.mem = full
+        per_sample = numpy.sqrt((err ** 2).mean(axis=1)) if self.root \
+            else (err ** 2).mean(axis=1)
+        self.mse = float(per_sample.mean())
+        self.n_err = self.mse

@@ -1,0 +1,195 @@
+"""Fused train step: a unit chain compiled into ONE jitted function.
+
+This resolves the hard part flagged in SURVEY §7: reconciling VELES's
+eager, per-unit, gate-driven execution with XLA's whole-program jit.  The
+unit graph (loader → forwards → evaluator → gds) stays the *semantic*
+model — debuggable eagerly via ``numpy_run``, unit-at-a-time via
+``tpu_run`` — while this module emits the *performance* form: the entire
+minibatch step (forward, loss, backward, momentum updates) as one XLA
+program with donated parameter buffers.  The math is identical to the
+GD units (same update rule, same Znicz activations), so eager and fused
+training produce the same trajectory.
+
+Works from the same layer-spec dicts StandardWorkflow consumes, so a
+workflow can be *lowered*: ``lower_workflow(wf)`` reads the live unit
+parameters into a pytree and returns a step function whose outputs are
+written back to the units on snapshot.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu import prng
+
+_ACT = {
+    None: lambda v: v,
+    "linear": lambda v: v,
+    "tanh": lambda v: 1.7159 * jnp.tanh(0.6666 * v),
+    "sigmoid": jax.nn.sigmoid,
+    "relu": lambda v: jnp.log1p(jnp.exp(jnp.minimum(v, 30.0))),
+    "strict_relu": lambda v: jnp.maximum(v, 0.0),
+}
+
+
+def init_mlp_params(input_dim, layer_specs, dtype=numpy.float32):
+    """Initialize a params pytree [{w, b, vw, vb}, ...] with the same
+    named-PRNG fills the forward units use."""
+    stream = prng.get("forward_init")
+    params = []
+    fan_in = input_dim
+    for spec in layer_specs:
+        n = int(numpy.prod(spec.get("->", {}).get("output_sample_shape")))
+        stddev = spec.get("->", {}).get("weights_stddev") or \
+            1.0 / numpy.sqrt(max(fan_in, 1))
+        w = numpy.zeros((fan_in, n), dtype=dtype)
+        b = numpy.zeros((n,), dtype=dtype)
+        filling = spec.get("->", {}).get("weights_filling", "uniform")
+        if filling == "gaussian":
+            stream.fill_normal(w, stddev=stddev)
+            stream.fill_normal(b, stddev=stddev)
+        else:
+            stream.fill_uniform(w, low=-stddev, high=stddev)
+            stream.fill_uniform(b, low=-stddev, high=stddev)
+        params.append({"w": w, "b": b, "vw": numpy.zeros_like(w),
+                       "vb": numpy.zeros_like(b)})
+        fan_in = n
+    return params
+
+
+def _specs_static(layer_specs):
+    """Reduce layer dicts to a hashable static form:
+    ((activation, lr, lr_b, decay, decay_b, moment, moment_b), ...)."""
+    from veles_tpu.znicz.standard_workflow import GD_PAIRS  # noqa: F401
+    from veles_tpu.units import UnitRegistry
+    out = []
+    for spec in layer_specs:
+        mapping = spec["type"]
+        klass = UnitRegistry.mapped.get(mapping)
+        activation = getattr(klass, "ACTIVATION", None) \
+            if klass is not None else None
+        is_softmax = mapping == "softmax"
+        bw = spec.get("<-", {})
+        lr = float(bw.get("learning_rate", 0.01))
+        out.append((
+            activation, is_softmax, lr,
+            float(bw.get("learning_rate_bias", lr)),
+            float(bw.get("weights_decay", 0.0)),
+            float(bw.get("weights_decay_bias", 0.0)),
+            float(bw.get("gradient_moment", 0.0)),
+            float(bw.get("gradient_moment_bias",
+                         bw.get("gradient_moment", 0.0))),
+        ))
+    return tuple(out)
+
+
+def mlp_apply(params, x, static_specs, compute_dtype=None):
+    """Pure forward pass; last softmax layer returns probabilities."""
+    h = x.reshape(x.shape[0], -1)
+    if compute_dtype is not None:
+        h = h.astype(compute_dtype)
+    for layer, (activation, is_softmax, *_rest) in zip(
+            params, static_specs):
+        w, b = layer["w"], layer["b"]
+        if compute_dtype is not None:
+            w, b = w.astype(compute_dtype), b.astype(compute_dtype)
+        z = jnp.dot(h, w, preferred_element_type=jnp.float32) + b
+        h = jax.nn.softmax(z, axis=-1) if is_softmax \
+            else _ACT[activation](z)
+    return h
+
+
+def make_train_step(layer_specs, loss="softmax", compute_dtype=None):
+    """Build ``step(params, x, labels) -> (params, metrics)``.
+
+    ``metrics`` = {"loss": mean loss, "n_err": int errors}.  The update
+    rule matches GradientDescentBase: v ← μv − α(g + λw); w ← w + v,
+    with gradients averaged over the batch.  ``compute_dtype=bfloat16``
+    casts matmul operands (MXU-native) with float32 params/accumulation.
+    """
+    static_specs = _specs_static(layer_specs)
+
+    def loss_fn(wb, x, labels):
+        params = [{"w": w, "b": b} for (w, b) in wb]
+        out = mlp_apply(params, x, static_specs,
+                        compute_dtype=compute_dtype)
+        valid = (labels >= 0)
+        denom = jnp.maximum(valid.sum(), 1)
+        if loss == "softmax":
+            logp = jnp.log(jnp.maximum(out, 1e-30))
+            picked = jnp.take_along_axis(
+                logp, jnp.maximum(labels, 0)[:, None], axis=1)[:, 0]
+            value = -(picked * valid).sum() / denom
+            n_err = ((jnp.argmax(out, axis=1) != labels) & valid).sum()
+        else:
+            err = (out - labels.reshape(out.shape)) ** 2
+            value = (err.mean(axis=1) * valid).sum() / denom
+            n_err = value
+        return value, (n_err, out)
+
+    def step(params, x, labels):
+        wb = tuple((layer["w"], layer["b"]) for layer in params)
+        vstate = tuple((layer["vw"], layer["vb"]) for layer in params)
+        (value, (n_err, _out)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(wb, x, labels)
+        new_params = []
+        for (w, b), (vw, vb), (gw, gb), spec in zip(
+                wb, vstate, grads, static_specs):
+            (_act, _sm, lr, lr_b, decay, decay_b, moment, moment_b) = spec
+            vw = moment * vw - lr * (gw + decay * w)
+            vb = moment_b * vb - lr_b * (gb + decay_b * b)
+            new_params.append({"w": w + vw, "b": b + vb,
+                               "vw": vw, "vb": vb})
+        return new_params, {"loss": value, "n_err": n_err}
+
+    return step
+
+
+def make_eval_step(layer_specs, loss="softmax", compute_dtype=None):
+    static_specs = _specs_static(layer_specs)
+
+    def evaluate(params, x, labels):
+        out = mlp_apply(params, x, static_specs,
+                        compute_dtype=compute_dtype)
+        valid = labels >= 0
+        n_err = ((jnp.argmax(out, axis=1) != labels) & valid).sum()
+        return {"n_err": n_err, "n": valid.sum()}
+
+    return evaluate
+
+
+# -- lowering a live StandardWorkflow ---------------------------------------
+
+def lower_workflow(wf):
+    """Read the live forward units' parameters into a pytree and return
+    (params, step_fn).  Writing back: ``update_workflow(wf, params)``."""
+    params = []
+    for fwd, gdu in zip(wf.forwards, reversed(wf.gds)):
+        fwd.weights.map_read()
+        fwd.bias.map_read()
+        params.append({
+            "w": numpy.array(fwd.weights.mem),
+            "b": numpy.array(fwd.bias.mem),
+            "vw": numpy.array(gdu.gradient_weights.mem)
+            if gdu.gradient_weights else numpy.zeros_like(fwd.weights.mem),
+            "vb": numpy.array(gdu.gradient_bias.mem)
+            if gdu.gradient_bias else numpy.zeros_like(fwd.bias.mem),
+        })
+    step = make_train_step(wf.layers)
+    return params, step
+
+
+def update_workflow(wf, params):
+    """Write fused-step parameters back into the unit graph (for
+    snapshots / switching back to eager mode)."""
+    for fwd, gdu, layer in zip(wf.forwards, reversed(wf.gds), params):
+        fwd.weights.map_write()
+        fwd.weights.mem[...] = numpy.asarray(layer["w"])
+        fwd.bias.map_write()
+        fwd.bias.mem[...] = numpy.asarray(layer["b"])
+        gdu.gradient_weights.map_write()
+        gdu.gradient_weights.mem[...] = numpy.asarray(layer["vw"])
+        gdu.gradient_bias.map_write()
+        gdu.gradient_bias.mem[...] = numpy.asarray(layer["vb"])

@@ -1,0 +1,155 @@
+"""Gradient-descent backward units for fully-connected layers.
+
+Parity target: Znicz ``gd.{GradientDescent,GDTanh,GDSigmoid,GDRELU,
+GDStrictRELU,GDSoftmax}`` (``manualrst_veles_workflow_parameters.rst:472``)
+with the backward ``<-`` hyperparameters (``:547-556``).
+
+Math (for ``y = act(x·W + b)``, incoming ``err_output = ∂L/∂y``):
+
+    δ = err_output ⊙ act'(y)          (act' from the *output*, Znicz-style)
+    ∂L/∂W = xᵀ·δ / B ;  ∂L/∂b = Σδ / B ;  err_input = δ·Wᵀ
+
+TPU path: one jitted function computes (δ, dW, db, err_input, new W/b/v)
+— two MXU matmuls plus fused elementwise; parameters are donated so the
+update is in-place on HBM.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.znicz.nn_units import GradientDescentBase
+
+_DERIVS = {
+    None: lambda y: jnp.ones_like(y),
+    "tanh": lambda y: y * y * (-0.388484177) + 1.14381894,
+    "sigmoid": lambda y: y * (1.0 - y),
+    "relu": lambda y: 1.0 - jnp.exp(-y),
+    "strict_relu": lambda y: (y > 0).astype(y.dtype),
+}
+
+_DERIVS_NUMPY = {
+    None: lambda y: 1.0,
+    "tanh": lambda y: y * y * (-0.388484177) + 1.14381894,
+    "sigmoid": lambda y: y * (1.0 - y),
+    "relu": lambda y: 1.0 - numpy.exp(-y),
+    "strict_relu": lambda y: (y > 0).astype(y.dtype),
+}
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "activation", "need_err_input", "has_bias"),
+    donate_argnums=(3, 4, 5, 6))
+def _gd_step(x, y, err_output, w, b, vw, vb, lr, lr_bias, decay,
+             decay_bias, moment, moment_bias, activation=None,
+             need_err_input=True, has_bias=True):
+    batch = x.shape[0]
+    delta = (err_output.astype(jnp.float32)
+             * _DERIVS[activation](y.astype(jnp.float32)))
+    x2 = x.reshape(batch, -1).astype(jnp.float32)
+    grad_w = jnp.dot(x2.T, delta,
+                     preferred_element_type=jnp.float32) / batch
+    # err_input uses the PRE-update weights (standard backprop; matches
+    # the fused jax.grad path bit-for-bit)
+    err_input = jnp.dot(delta, w.T, preferred_element_type=jnp.float32) \
+        if need_err_input else None
+    vw = moment * vw - lr * (grad_w + decay * w)
+    w = w + vw
+    if has_bias:
+        grad_b = jnp.sum(delta, axis=0) / batch
+        vb = moment_bias * vb - lr_bias * (grad_b + decay_bias * b)
+        b = b + vb
+    return w, b, vw, vb, err_input
+
+
+class GradientDescent(GradientDescentBase):
+    """Backward for plain All2All (identity activation)."""
+
+    MAPPING = "gd"
+    ACTIVATION = None
+
+    def numpy_run(self):
+        for v in (self.input, self.output, self.err_output, self.weights):
+            v.map_read()
+        batch = len(self.input.mem)
+        y = self.output.mem.reshape(batch, -1).astype(numpy.float32)
+        delta = self.err_output.mem.reshape(batch, -1).astype(
+            numpy.float32) * _DERIVS_NUMPY[self.ACTIVATION](y)
+        x = self.input.mem.reshape(batch, -1).astype(numpy.float32)
+        grad_w = x.T @ delta / batch
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            self.err_input.mem = (delta @ self.weights.mem.T).reshape(
+                self.input.shape).astype(numpy.float32)
+        self.weights.map_write()
+        self.gradient_weights.map_write()
+        self.apply_update_numpy(
+            self.weights.mem, grad_w, self.gradient_weights.mem,
+            self.learning_rate, self.weights_decay, self.gradient_moment)
+        if self.include_bias and self.bias:
+            grad_b = delta.sum(axis=0) / batch
+            self.bias.map_write()
+            self.gradient_bias.map_write()
+            self.apply_update_numpy(
+                self.bias.mem, grad_b, self.gradient_bias.mem,
+                self.learning_rate_bias, self.weights_decay_bias,
+                self.gradient_moment_bias)
+
+    def tpu_run(self):
+        has_bias = bool(self.include_bias and self.bias)
+        w, b, vw, vb, err_input = _gd_step(
+            self.input.devmem, self.output.devmem, self.err_output.devmem,
+            self.weights.devmem,
+            self.bias.devmem if has_bias else jnp.zeros((1,), jnp.float32),
+            self.gradient_weights.devmem,
+            self.gradient_bias.devmem if has_bias
+            else jnp.zeros((1,), jnp.float32),
+            self.learning_rate, self.learning_rate_bias,
+            self.weights_decay, self.weights_decay_bias,
+            self.gradient_moment, self.gradient_moment_bias,
+            activation=self.ACTIVATION,
+            need_err_input=self.need_err_input, has_bias=has_bias)
+        self.weights.devmem = w
+        self.gradient_weights.devmem = vw
+        if has_bias:
+            self.bias.devmem = b
+            self.gradient_bias.devmem = vb
+        if self.need_err_input:
+            self.err_input.devmem = err_input.reshape(self.input.shape)
+
+    def initialize(self, device=None, **kwargs):
+        super(GradientDescent, self).initialize(device=device, **kwargs)
+        if self.need_err_input and not self.err_input:
+            self.err_input.reset(numpy.zeros(self.input.shape,
+                                             dtype=numpy.float32))
+            self.err_input.initialize(self.device)
+
+
+class GDTanh(GradientDescent):
+    MAPPING = "gd_tanh"
+    ACTIVATION = "tanh"
+
+
+class GDSigmoid(GradientDescent):
+    MAPPING = "gd_sigmoid"
+    ACTIVATION = "sigmoid"
+
+
+class GDRELU(GradientDescent):
+    MAPPING = "gd_relu"
+    ACTIVATION = "relu"
+
+
+class GDStrictRELU(GradientDescent):
+    MAPPING = "gd_strict_relu"
+    ACTIVATION = "strict_relu"
+
+
+class GDSoftmax(GradientDescent):
+    """Softmax + cross-entropy: the evaluator already emits
+    δ = (softmax − target), so the activation derivative is identity."""
+
+    MAPPING = "gd_softmax"
+    ACTIVATION = None

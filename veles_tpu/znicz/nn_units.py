@@ -1,0 +1,146 @@
+"""Shared bases for forward and gradient-descent units.
+
+Reconstructs the Znicz ``nn_units.Forward`` / ``nn_units.GradientDescentBase``
+contracts from the platform docs: forward ``->`` parameters
+(weights_filling gaussian/uniform/constant, weights_stddev, output_sample_shape …)
+and backward ``<-`` parameters (learning_rate(_bias), weights_decay(_bias),
+gradient_moment(_bias)) — ``manualrst_veles_workflow_parameters.rst:506-580``.
+"""
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Vector
+
+
+class ForwardBase(AcceleratedUnit):
+    """Forward layer base: consumes ``input``, produces ``output``,
+    owns ``weights``/``bias``."""
+
+    hide_from_registry = True
+
+    MAPPING = None
+
+    def __init__(self, workflow, **kwargs):
+        super(ForwardBase, self).__init__(workflow, **kwargs)
+        self.view_group = "WORKER"
+        self.input = None
+        self.output = Vector()
+        self.weights = Vector()
+        self.bias = Vector()
+        self.include_bias = kwargs.get("include_bias", True)
+        self.weights_filling = kwargs.get("weights_filling", "uniform")
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+        self.bias_filling = kwargs.get("bias_filling", "uniform")
+        self.bias_stddev = kwargs.get("bias_stddev", None)
+        self.weights_transposed = kwargs.get("weights_transposed", False)
+        self.demand("input")
+
+    @property
+    def forward_prng(self):
+        return prng.get("forward_init")
+
+    def fill_array(self, array, filling, stddev):
+        """Weight init fillings per the docs (gaussian/uniform/constant)."""
+        if stddev is None:
+            fan_in = array.shape[0] if array.ndim > 1 else array.size
+            stddev = 1.0 / numpy.sqrt(max(fan_in, 1))
+        if filling == "gaussian":
+            self.forward_prng.fill_normal(array, stddev=stddev)
+        elif filling == "uniform":
+            self.forward_prng.fill_uniform(array, low=-stddev, high=stddev)
+        elif filling == "constant":
+            array[...] = stddev
+        else:
+            raise ValueError("unknown filling %r" % filling)
+
+    # subclasses: allocate weights/bias/output in initialize(), compute in
+    # numpy_run/tpu_run.
+
+    def generate_data_for_slave(self, slave=None):
+        """Weights ride to slaves with each job (async-DP semantics of the
+        reference, ``workflow.py:478``)."""
+        if not self.weights:
+            return None
+        payload = {"weights": numpy.array(self.weights.mem)}
+        if self.include_bias and self.bias:
+            payload["bias"] = numpy.array(self.bias.mem)
+        return payload
+
+    def apply_data_from_master(self, data):
+        if data is None:
+            return
+        self.weights.map_write()
+        self.weights.mem[...] = data["weights"]
+        if "bias" in data and self.bias:
+            self.bias.map_write()
+            self.bias.mem[...] = data["bias"]
+
+
+class GradientDescentBase(AcceleratedUnit):
+    """Backward layer base: consumes ``err_output`` (+ forward's saved
+    tensors), produces ``err_input`` and updates the forward unit's
+    parameters in place.
+
+    Update rule (docs ``:547-556``): with gradient g, weight decay λ,
+    momentum μ and learning rate α::
+
+        v ← μ·v − α·(g + λ·w);  w ← w + v
+    """
+
+    hide_from_registry = True
+
+    MAPPING = None
+
+    def __init__(self, workflow, **kwargs):
+        super(GradientDescentBase, self).__init__(workflow, **kwargs)
+        self.view_group = "TRAINER"
+        self.input = None
+        self.output = None
+        self.err_output = None
+        self.err_input = Vector()
+        self.weights = None
+        self.bias = None
+        self.learning_rate = kwargs.get("learning_rate", 0.01)
+        self.learning_rate_bias = kwargs.get(
+            "learning_rate_bias", kwargs.get("learning_rate", 0.01))
+        self.weights_decay = kwargs.get("weights_decay", 0.0)
+        self.weights_decay_bias = kwargs.get("weights_decay_bias", 0.0)
+        self.gradient_moment = kwargs.get("gradient_moment", 0.0)
+        self.gradient_moment_bias = kwargs.get(
+            "gradient_moment_bias", kwargs.get("gradient_moment", 0.0))
+        self.include_bias = kwargs.get("include_bias", True)
+        #: compute err_input (False for the first layer, saves a matmul)
+        self.need_err_input = kwargs.get("need_err_input", True)
+        self.gradient_weights = Vector()
+        self.gradient_bias = Vector()
+        self.demand("input", "err_output", "weights")
+
+    def setup_from_forward(self, forward):
+        """Wire the standard data links from the paired forward unit."""
+        self.link_attrs(forward, "input", "output", "weights")
+        if self.include_bias:
+            self.link_attrs(forward, "bias")
+        return self
+
+    def initialize(self, device=None, **kwargs):
+        super(GradientDescentBase, self).initialize(device=device, **kwargs)
+        if self.weights and not self.gradient_weights:
+            self.gradient_weights.reset(numpy.zeros_like(self.weights.mem))
+            self.gradient_weights.initialize(self.device)
+        if self.include_bias and self.bias and not self.gradient_bias:
+            self.gradient_bias.reset(numpy.zeros_like(self.bias.mem))
+            self.gradient_bias.initialize(self.device)
+
+    def apply_update_numpy(self, weights, grad, velocity, lr, decay,
+                           moment):
+        """SGD + momentum + L2, host path."""
+        full = grad + decay * weights
+        velocity[...] = moment * velocity - lr * full
+        weights += velocity
+
+    def generate_data_for_master(self):
+        """Slave → master: accumulated parameter *deltas* are what the
+        async master merges (ref ``apply_data_from_slave`` model)."""
+        return None

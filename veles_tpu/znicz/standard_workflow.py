@@ -1,0 +1,188 @@
+"""StandardWorkflow: the canonical training-graph builder.
+
+Parity target: Znicz ``StandardWorkflow`` with the documented linking
+contract (``manualrst_veles_workflow_creation.rst:108-430``)::
+
+    repeater → loader → forwards… → evaluator → decision → gds… ─┐
+        ▲                                                        │
+        └────────────────────── back edge ───────────────────────┘
+    decision --complete--> end_point ; gds gated off-TRAIN;
+    snapshotter/plotters hang off decision.improved
+
+Layer specs use the reference's config shape: a list of dicts with
+``type`` plus forward ``->`` and backward ``<-`` parameter groups
+(``manualrst_veles_workflow_parameters.rst:467-580``).
+"""
+
+from veles_tpu.accelerated_units import AcceleratedWorkflow
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.mutable import Bool
+from veles_tpu.plumbing import Repeater
+from veles_tpu.units import UnitRegistry
+from veles_tpu.znicz import all2all, gd  # noqa: F401 - populate registry
+from veles_tpu.znicz.decision import DecisionGD, DecisionMSE
+from veles_tpu.znicz.evaluator import EvaluatorMSE, EvaluatorSoftmax
+
+#: forward MAPPING → paired gradient MAPPING
+GD_PAIRS = {
+    "all2all": "gd",
+    "all2all_tanh": "gd_tanh",
+    "all2all_sigmoid": "gd_sigmoid",
+    "all2all_relu": "gd_relu",
+    "all2all_strict_relu": "gd_strict_relu",
+    "softmax": "gd_softmax",
+    "conv": "gd_conv",
+    "conv_tanh": "gd_conv_tanh",
+    "conv_sigmoid": "gd_conv_sigmoid",
+    "conv_relu": "gd_conv_relu",
+    "conv_strict_relu": "gd_conv_strict_relu",
+    "max_pooling": "gd_max_pooling",
+    "avg_pooling": "gd_avg_pooling",
+    "stochastic_pooling": "gd_stochastic_pooling",
+    "lrn": "gd_lrn",
+    "dropout": "gd_dropout",
+}
+
+
+class ClassSkipGate(Bool):
+    """True while the loader is NOT serving ``cls`` minibatches — used as
+    ``gate_skip`` so gradient units only run on TRAIN batches."""
+
+    __slots__ = ("loader", "cls")
+
+    def __init__(self, loader, cls=TRAIN):
+        super(ClassSkipGate, self).__init__(False)
+        self.loader = loader
+        self.cls = cls
+
+    def __bool__(self):
+        return int(self.loader.minibatch_class) != self.cls
+
+    def __getstate__(self):
+        return (self.loader, self.cls)
+
+    def __setstate__(self, state):
+        self.loader, self.cls = state
+        self._value = False
+        self._expr = None
+
+
+class StandardWorkflow(AcceleratedWorkflow):
+    """Builds the full training graph from ``layers`` config.
+
+    kwargs:
+      loader_factory: callable(workflow) → Loader (required)
+      layers: list of {"type": MAPPING, "->": {...}, "<-": {...}}
+      loss_function: "softmax" | "mse" (default from last layer type)
+      decision_config: dict for the Decision unit
+    """
+
+    def __init__(self, workflow=None, **kwargs):
+        self.layers = kwargs.pop("layers", [])
+        self.loss_function = kwargs.pop("loss_function", None)
+        self.decision_config = dict(kwargs.pop("decision_config", {}))
+        loader_factory = kwargs.pop("loader_factory")
+        super(StandardWorkflow, self).__init__(workflow, **kwargs)
+        self.repeater = Repeater(self)
+        self.loader = loader_factory(self)
+        self.forwards = []
+        self.gds = []
+        self.create_workflow()
+
+    # -- the link_* contract ------------------------------------------------
+    def create_workflow(self):
+        self.link_loader()
+        self.link_forwards()
+        self.link_evaluator()
+        self.link_decision()
+        self.link_gds()
+        self.link_loop_and_end()
+
+    def link_loader(self):
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+
+    def _make_unit(self, mapping, params):
+        try:
+            klass = UnitRegistry.mapped[mapping]
+        except KeyError:
+            raise ValueError(
+                "unknown layer type %r (registered: %s)" %
+                (mapping, ", ".join(sorted(UnitRegistry.mapped))))
+        return klass(self, **params)
+
+    def link_forwards(self):
+        prev = self.loader
+        prev_attr = "minibatch_data"
+        for spec in self.layers:
+            unit = self._make_unit(spec["type"], dict(spec.get("->", {})))
+            unit.link_from(prev if prev is self.loader else prev)
+            unit.link_attrs(prev, ("input", prev_attr))
+            self.forwards.append(unit)
+            prev = unit
+            prev_attr = "output"
+
+    def link_evaluator(self):
+        last = self.forwards[-1]
+        loss = self.loss_function or (
+            "softmax" if self.layers[-1]["type"] == "softmax" else "mse")
+        if loss == "softmax":
+            self.evaluator = EvaluatorSoftmax(self)
+            self.evaluator.link_attrs(last, "output", "max_idx")
+            self.evaluator.link_attrs(self.loader,
+                                      ("labels", "minibatch_labels"))
+        elif loss == "mse":
+            self.evaluator = EvaluatorMSE(self)
+            self.evaluator.link_attrs(last, "output")
+            self.evaluator.link_attrs(self.loader,
+                                      ("target", "minibatch_targets"))
+        else:
+            raise ValueError("unknown loss_function %r" % loss)
+        self.evaluator.link_attrs(self.loader,
+                                  ("batch_size", "minibatch_size"))
+        self.evaluator.link_from(self.forwards[-1])
+
+    def link_decision(self):
+        loss = self.loss_function or (
+            "softmax" if self.layers[-1]["type"] == "softmax" else "mse")
+        decision_class = DecisionGD if loss == "softmax" else DecisionMSE
+        self.decision = decision_class(self, **self.decision_config)
+        self.decision.link_from_loader(self.loader)
+        self.decision.evaluator = self.evaluator
+        self.decision.link_from(self.evaluator)
+
+    def link_gds(self):
+        """Backward chain in reverse layer order, gated to TRAIN batches
+        (ref contract: gds linked last-to-first from decision)."""
+        prev = self.decision
+        err_src = self.evaluator
+        err_attr = "err_output"
+        skip_gate = ClassSkipGate(self.loader, TRAIN)
+        for forward, spec in zip(reversed(self.forwards),
+                                 reversed(self.layers)):
+            mapping = GD_PAIRS[spec["type"]]
+            params = dict(spec.get("<-", {}))
+            if forward is self.forwards[0]:
+                params.setdefault("need_err_input", False)
+            unit = self._make_unit(mapping, params)
+            unit.setup_from_forward(forward)
+            unit.link_attrs(err_src, ("err_output", err_attr))
+            unit.gate_skip = skip_gate
+            unit.link_from(prev)
+            self.gds.append(unit)
+            prev = unit
+            err_src = unit
+            err_attr = "err_input"
+
+    def link_loop_and_end(self):
+        last_gd = self.gds[-1] if self.gds else self.decision
+        self.repeater.link_from(last_gd)
+        self.end_point.link_from(last_gd)
+        self.end_point.gate_block = ~self.decision.complete
+        self.repeater.gate_block = self.decision.complete
+
+    # -- results ------------------------------------------------------------
+    def gather_results(self):
+        results = super(StandardWorkflow, self).gather_results()
+        results.setdefault("checksum", self.checksum())
+        return results
