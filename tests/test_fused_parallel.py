@@ -90,6 +90,32 @@ def test_fused_matches_eager_units():
                           numpy.asarray(new_params[0]["w"]))
 
 
+def test_fused_short_batch_matches_eager_scaling():
+    """Padded short batch: fused gradients scale by padded length like
+    the eager units (valid-count scaling would overstep 1.5x)."""
+    prng.seed_all(9)
+    params = init_mlp_params(12, LAYERS)
+    step = jax.jit(make_train_step(LAYERS))
+    x, labels = _data(n=15)
+    x = numpy.vstack([x, numpy.zeros((5, 12), numpy.float32)])
+    labels = numpy.concatenate([labels,
+                                numpy.full(5, -1, numpy.int32)])
+    new_params, metrics = step(params, x, labels)
+    # manual check of output-layer bias grad scaling
+    static = _specs_static(LAYERS)
+    out = mlp_apply(params, x, static)
+    onehot = numpy.zeros((20, 4), numpy.float32)
+    for i, l in enumerate(labels[:15]):
+        onehot[i, l] = 1
+    delta = (numpy.asarray(out) - onehot)
+    delta[15:] = 0
+    grad_b = delta.sum(axis=0) / 20.0          # padded length, not 15
+    lr = LAYERS[-1]["<-"]["learning_rate"]
+    expect_b = numpy.asarray(params[-1]["b"]) - lr * grad_b
+    assert numpy.allclose(numpy.asarray(new_params[-1]["b"]), expect_b,
+                          atol=1e-5)
+
+
 def test_data_parallel_8_devices_matches_single():
     prng.seed_all(1)
     params_a = init_mlp_params(12, LAYERS)

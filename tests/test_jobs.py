@@ -1,0 +1,198 @@
+"""Master–slave job layer tests — single process, localhost, real ZMQ
+sockets (mirrors reference ``tests/test_network.py:52-140``: scripted
+workflows first, then a full distributed training run, then fault
+injection with requeue)."""
+
+import threading
+import time
+
+import numpy
+import pytest
+
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.parallel.jobs import JobClient, JobServer
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+class ScriptedMaster(object):
+    """Reference-style scripted workflow: N jobs, records updates."""
+
+    def __init__(self, n_jobs=5):
+        self.n_jobs = n_jobs
+        self.served = 0
+        self.updates = []
+        self.dropped = []
+
+    def checksum(self):
+        return "scripted-v1"
+
+    def generate_data_for_slave(self, slave):
+        if self.served >= self.n_jobs:
+            return None
+        self.served += 1
+        return {"job_number": self.served}
+
+    def apply_data_from_slave(self, data, slave):
+        self.updates.append((slave.id, data))
+
+    def drop_slave(self, slave):
+        self.dropped.append(slave.id)
+
+
+class ScriptedSlave(object):
+    def __init__(self, checksum="scripted-v1"):
+        self._checksum = checksum
+        self.jobs = []
+
+    def checksum(self):
+        return self._checksum
+
+    def do_job(self, data, callback):
+        self.jobs.append(data)
+        callback({"result": data["job_number"] * 10})
+
+
+def test_handshake_job_update_cycle():
+    master = ScriptedMaster(n_jobs=3)
+    server = JobServer(master).start()
+    try:
+        client = JobClient(ScriptedSlave(), server.endpoint)
+        client.handshake()
+        assert client.run()
+        client.close()
+        assert master.served == 3
+        assert len(master.updates) == 3
+        assert master.updates[0][1] == {"result": 10}
+    finally:
+        server.stop()
+
+
+def test_checksum_mismatch_rejected():
+    master = ScriptedMaster()
+    server = JobServer(master).start()
+    try:
+        client = JobClient(ScriptedSlave(checksum="other"),
+                           server.endpoint)
+        with pytest.raises(ConnectionError):
+            client.handshake()
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_two_slaves_share_jobs():
+    master = ScriptedMaster(n_jobs=10)
+    server = JobServer(master).start()
+    try:
+        clients = [JobClient(ScriptedSlave(), server.endpoint)
+                   for _ in range(2)]
+        threads = []
+        for client in clients:
+            client.handshake()
+            t = threading.Thread(target=client.run)
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join(20)
+        assert len(master.updates) == 10
+        workers = {sid for sid, _ in master.updates}
+        assert len(workers) == 2      # both actually worked
+        for client in clients:
+            client.close()
+    finally:
+        server.stop()
+
+
+def test_dead_slave_requeued():
+    """Slave dies mid-job (fault injection) → timeout reap → drop_slave →
+    master requeues (ref --slave-death-probability + loader requeue)."""
+    master = ScriptedMaster(n_jobs=3)
+    server = JobServer(master, slave_timeout=1.0,
+                       heartbeat_interval=0.3).start()
+    try:
+        dead = JobClient(ScriptedSlave(), server.endpoint,
+                         death_probability=1.0)
+        dead.handshake()
+        assert dead.run() is False      # died mid-job
+        deadline = time.time() + 5
+        while not master.dropped and time.time() < deadline:
+            time.sleep(0.1)
+        assert master.dropped
+    finally:
+        server.stop()
+
+
+# -- full distributed training (reference §3.2 flow) ------------------------
+
+class DistLoader(FullBatchLoader):
+    def load_data(self):
+        rng = numpy.random.default_rng(5)
+        n = 200
+        labels = (numpy.arange(n) % 5).astype(int)
+        centers = rng.standard_normal((5, 16)) * 3
+        self.original_data.mem = (
+            centers[labels] + rng.standard_normal((n, 16)) * 0.5
+        ).astype(numpy.float32)
+        self.original_labels = [int(v) for v in labels]
+        self.class_lengths[:] = [0, 50, 150]
+
+
+DIST_LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 12},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 5},
+     "<-": {"learning_rate": 0.05}},
+]
+
+
+def make_dist_wf(is_master=False, is_slave=False):
+    from veles_tpu import prng
+    prng.seed_all(21)
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: DistLoader(w, minibatch_size=25),
+        layers=[{**s} for s in DIST_LAYERS],
+        decision_config={"max_epochs": 3})
+    wf.launcher = DummyLauncher(is_master=is_master, is_slave=is_slave)
+    wf.initialize(device=NumpyDevice())
+    return wf
+
+
+def test_distributed_training_end_to_end():
+    master_wf = make_dist_wf(is_master=True)
+    slave_wf = make_dist_wf(is_slave=True)
+    assert master_wf.checksum() == slave_wf.checksum()
+    w_before = numpy.array(master_wf.forwards[0].weights.mem)
+
+    server = JobServer(master_wf).start()
+    try:
+        client = JobClient(slave_wf, server.endpoint)
+        client.handshake()
+        client.run(max_jobs=24)        # 3 epochs × 8 minibatches
+        client.close()
+        assert client.jobs_done > 0
+        w_after = numpy.array(master_wf.forwards[0].weights.mem)
+        assert not numpy.allclose(w_before, w_after), \
+            "slave deltas must reach master weights"
+        # master-side decision accounted distributed stats
+        assert master_wf.decision.epoch_samples != [0, 0, 0] or \
+            master_wf.decision.best_n_err_pt < 100.0
+    finally:
+        server.stop()
+
+
+def test_distributed_stop_on_complete():
+    master_wf = make_dist_wf(is_master=True)
+    slave_wf = make_dist_wf(is_slave=True)
+    master_wf.decision.complete <<= True    # already done
+    server = JobServer(master_wf).start()
+    try:
+        client = JobClient(slave_wf, server.endpoint)
+        client.handshake()
+        assert client.run() is True
+        assert client.jobs_done == 0        # no_more_jobs immediately
+        client.close()
+    finally:
+        server.stop()

@@ -168,6 +168,31 @@ def test_drop_slave_requeues():
     assert loader.total_failed == 1
 
 
+def test_retried_minibatch_keeps_its_class():
+    """A requeued failed minibatch ships with its own class even after
+    global_offset advanced into another class span."""
+    loader = make_loader(minibatch_size=10)
+    loader.workflow.launcher.is_master = True
+    loader.workflow.launcher.is_standalone = False
+    # advance into TRAIN span, give a TRAIN batch to a slave
+    for _ in range(5):
+        loader.generate_data_for_slave(slave="warm")
+        loader.apply_data_from_slave(True, slave="warm")
+    job = loader.generate_data_for_slave(slave="doomed")
+    assert job["minibatch_class"] == TRAIN
+    # wrap the offset into the next epoch's TEST span while the doomed
+    # slave still holds its TRAIN batch...
+    for _ in range(5):
+        loader.generate_data_for_slave(slave="warm")
+        loader.apply_data_from_slave(True, slave="warm")
+    assert loader.minibatch_class == TEST
+    # ...then it dies; the retry must ship as TRAIN, not current TEST
+    loader.drop_slave(slave="doomed")
+    retry = loader.generate_data_for_slave(slave="alive")
+    assert retry["minibatch_offset"] == job["minibatch_offset"]
+    assert retry["minibatch_class"] == TRAIN   # not the current TEST
+
+
 def test_mse_loader_targets():
     class SynthMSE(FullBatchLoaderMSE):
         def load_data(self):

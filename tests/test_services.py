@@ -1,0 +1,221 @@
+"""Service tests with live local servers on ephemeral ports (mirrors the
+reference strategy: test_web_status.py / test_restful.py run real
+tornado/twisted servers; golden-file plot tests)."""
+
+import json
+import os
+import pickle
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.dummy import DummyLauncher, DummyWorkflow
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.snapshotter import SnapshotterToFile, load_snapshot
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+class TinyLoader(FullBatchLoader):
+    def load_data(self):
+        rng = numpy.random.default_rng(3)
+        n = 80
+        labels = (numpy.arange(n) % 4).astype(int)
+        centers = rng.standard_normal((4, 8)) * 3
+        self.original_data.mem = (
+            centers[labels] + rng.standard_normal((n, 8)) * 0.5
+        ).astype(numpy.float32)
+        self.original_labels = [int(v) for v in labels]
+        self.class_lengths[:] = [0, 20, 60]
+
+
+LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+     "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 4},
+     "<-": {"learning_rate": 0.1}},
+]
+
+
+def make_wf(tmp_path, max_epochs=3, **kwargs):
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: TinyLoader(w, minibatch_size=20),
+        layers=[{**s} for s in LAYERS],
+        decision_config={"max_epochs": max_epochs},
+        **kwargs)
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=NumpyDevice())
+    return wf
+
+
+class TestSnapshotter:
+    @pytest.mark.parametrize("compression", ["", "gz", "bz2", "xz"])
+    def test_codec_roundtrip(self, tmp_path, compression):
+        wf = DummyWorkflow()
+        snap = SnapshotterToFile(wf, directory=str(tmp_path),
+                                 compression=compression,
+                                 time_interval=0.0)
+        wf.initialize()
+        snap.suffix = "t"
+        snap.export()
+        assert snap.destination and os.path.exists(snap.destination)
+        restored = load_snapshot(snap.destination)
+        assert type(restored).__name__ == "DummyWorkflow"
+
+    def test_current_symlink(self, tmp_path):
+        wf = DummyWorkflow()
+        snap = SnapshotterToFile(wf, directory=str(tmp_path),
+                                 compression="gz", time_interval=0.0)
+        wf.initialize()
+        snap.suffix = "one"
+        snap.export()
+        current = os.path.join(str(tmp_path),
+                               "veles_tpu_current.pickle.gz")
+        assert os.path.islink(current)
+        assert load_snapshot(current) is not None
+
+    def test_wired_into_standard_workflow(self, tmp_path):
+        wf = make_wf(tmp_path, snapshotter_config={
+            "directory": str(tmp_path), "time_interval": 0.0})
+        wf.run()
+        # improved at least once → snapshot written with metric suffix
+        assert wf.snapshotter.destination is not None
+        restored = load_snapshot(wf.snapshotter.destination)
+        assert restored.decision.best_n_err_pt == \
+            pytest.approx(wf.decision.best_n_err_pt)
+
+    def test_improved_flag_one_shot(self, tmp_path):
+        """The snapshotter clears Decision.improved after exporting, so
+        one improvement → exactly one snapshot."""
+        wf = make_wf(tmp_path, snapshotter_config={
+            "directory": str(tmp_path), "time_interval": 0.0})
+        exports = []
+        original = SnapshotterToFile.export
+        SnapshotterToFile.export = \
+            lambda self: (exports.append(1), original(self))
+        try:
+            wf.run()
+        finally:
+            SnapshotterToFile.export = original
+        improvements = wf.decision.best_epoch + 1  # epochs that improved
+        assert 0 < len(exports) <= max(improvements, 1) + 1
+        assert not bool(wf.decision.improved)
+
+    def test_full_training_resume_from_file(self, tmp_path):
+        wf = make_wf(tmp_path, max_epochs=2, snapshotter_config={
+            "directory": str(tmp_path), "time_interval": 0.0})
+        wf.run()
+        restored = load_snapshot(wf.snapshotter.destination)
+        restored.launcher = DummyLauncher()
+        restored.decision.complete <<= False
+        restored.decision.max_epochs = 4
+        restored.initialize(device=NumpyDevice())
+        restored.run()
+        assert restored.loader.epoch_number >= 2
+
+
+class TestPlotting:
+    def test_plotters_stream_to_client(self, tmp_path):
+        from veles_tpu.graphics_client import GraphicsClient
+        from veles_tpu.graphics_server import GraphicsServer
+        server = GraphicsServer.launch()
+        client = GraphicsClient(server.endpoint,
+                                output_dir=str(tmp_path))
+        import time
+        time.sleep(0.2)          # PUB/SUB slow-joiner
+        wf = make_wf(tmp_path, max_epochs=2, plotters_config={})
+        wf.run()
+        seen = 0
+        while client.process_one(500):
+            seen += 1
+            if seen > 200:
+                break
+        assert seen > 0
+        assert client.rendered > 0
+        pngs = [f for f in os.listdir(str(tmp_path))
+                if f.endswith(".png")]
+        assert pngs, "viewer rendered no files"
+        server.shutdown()
+        client.stop()
+
+    def test_plotter_pickle_self_contained(self, tmp_path):
+        from veles_tpu.plotting_units import AccumulatingPlotter, Plotter
+
+        class Unpicklable(object):
+            v = 1.5
+
+            def __reduce__(self):
+                raise TypeError("not picklable")
+
+        wf = DummyWorkflow()
+        plotter = AccumulatingPlotter(wf, label="x")
+        plotter.input = Unpicklable()
+        plotter.input_field = "v"
+        plotter.fill()
+        Plotter._plot_message_mode = True
+        try:
+            blob = pickle.dumps(plotter)   # input dropped in message mode
+        finally:
+            Plotter._plot_message_mode = False
+        clone = pickle.loads(blob)
+        assert clone.values == [1.5]
+        # snapshot mode keeps graph state (links_from survives)
+        plotter.input = None
+        blob2 = pickle.dumps(plotter)
+        assert pickle.loads(blob2).links_from is not None
+
+
+class TestWebStatus:
+    def test_status_roundtrip(self, tmp_path):
+        from veles_tpu.web_status import StatusNotifier, WebStatus
+        status = WebStatus(port=0).start()
+        wf = make_wf(tmp_path, max_epochs=1)
+        wf.run()
+        notifier = StatusNotifier(
+            "http://127.0.0.1:%d/update" % status.port, run_id="r1")
+        assert notifier.notify(wf)
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/status" % status.port) as resp:
+            data = json.loads(resp.read())
+        assert "r1" in data
+        assert data["r1"]["stopped"] is True
+        assert "best_validation_error_pt" in data["r1"]["results"]
+        status.stop()
+
+
+class TestRestful:
+    def test_inference_endpoint(self, tmp_path):
+        from veles_tpu.restful_api import RESTfulAPI
+        wf = make_wf(tmp_path, max_epochs=2)
+        wf.run()
+        api = RESTfulAPI(wf, port=0)
+        api.forwards = wf.forwards
+        api.initialize()
+        x = numpy.array(wf.loader.original_data.mem[:3])
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/service" % api.port,
+            data=json.dumps({"input": x.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            out = json.loads(resp.read())
+        result = numpy.asarray(out["result"])
+        assert result.shape == (3, 4)
+        assert numpy.allclose(result.sum(axis=1), 1.0, atol=1e-3)
+        # probe: malformed body → 400 with error json
+        bad = urllib.request.Request(
+            "http://127.0.0.1:%d/service" % api.port,
+            data=b"not json",
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(bad, timeout=5)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "error" in json.loads(e.read())
+        # training still works after serving (link restored)
+        wf.decision.complete <<= False
+        wf.decision.max_epochs = 3
+        wf.run()
+        api.stop()

@@ -1,0 +1,87 @@
+"""GraphicsClient: the detached viewer process.
+
+Parity target: reference ``veles/graphics_client.py:84`` — subscribes to
+the server's PUB socket, unpickles plotter units and renders them with
+matplotlib.  This image is headless, so the default backend is Agg
+rendering into PNG files under ``root.common.dirs.results`` (the
+reference's WebAgg browser option maps to the web-status server instead).
+
+Run detached:  ``python -m veles_tpu.graphics_client tcp://127.0.0.1:PORT``
+"""
+
+import os
+import pickle
+import sys
+import threading
+
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+
+
+class GraphicsClient(Logger):
+    def __init__(self, endpoint, output_dir=None):
+        super(GraphicsClient, self).__init__()
+        import zmq
+        self.endpoint = endpoint
+        self.output_dir = output_dir or root.common.dirs.get("results")
+        self._context = zmq.Context.instance()
+        self._socket = self._context.socket(zmq.SUB)
+        self._socket.connect(endpoint)
+        self._socket.setsockopt(zmq.SUBSCRIBE, b"")
+        self._stop = threading.Event()
+        self.rendered = 0
+
+    def process_one(self, timeout_ms=1000):
+        """Receive + render one plotter; returns True if one arrived."""
+        import zmq
+        if not self._socket.poll(timeout_ms):
+            return False
+        blob = self._socket.recv()
+        try:
+            plotter = pickle.loads(blob)
+        except Exception:
+            self.exception("undecodable plot message")
+            return True
+        self.render(plotter)
+        return True
+
+    def render(self, plotter):
+        import matplotlib
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+        fig, axes = plt.subplots(figsize=(6, 4))
+        try:
+            plotter.redraw(axes)
+            os.makedirs(self.output_dir, exist_ok=True)
+            path = os.path.join(
+                self.output_dir,
+                "%s.png" % plotter.name.replace(" ", "_"))
+            fig.savefig(path, dpi=80)
+            self.rendered += 1
+            self.debug("rendered %s", path)
+        except Exception:
+            self.exception("failed to render %r", plotter)
+        finally:
+            plt.close(fig)
+
+    def run(self):
+        while not self._stop.is_set():
+            self.process_one(200)
+
+    def stop(self):
+        self._stop.set()
+        self._socket.close(linger=0)
+
+
+def main(argv=None):
+    argv = argv or sys.argv[1:]
+    if not argv:
+        print("usage: python -m veles_tpu.graphics_client tcp://host:port")
+        return 1
+    client = GraphicsClient(argv[0])
+    client.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""GraphicsServer: broadcast pickled plotter units to detached viewers.
+
+Parity target: reference ``veles/graphics_server.py:65-140`` — ``Plotter``
+units pickle themselves onto a ZeroMQ PUB socket; one or more separate
+``GraphicsClient`` processes subscribe and render with matplotlib.  The
+endpoint set (inproc/ipc/epgm multicast) shrinks to tcp+ipc here (no PGM
+in this image); the architecture — viz never blocks training, viewers
+attach/detach at will — is preserved verbatim.
+"""
+
+import pickle
+import threading
+
+from veles_tpu.logger import Logger
+
+_instance_lock = threading.Lock()
+_instance = None
+
+
+class GraphicsServer(Logger):
+    """Singleton PUB endpoint (one per process, like the reference)."""
+
+    def __init__(self, port=0):
+        super(GraphicsServer, self).__init__()
+        import zmq
+        self._context = zmq.Context.instance()
+        self._socket = self._context.socket(zmq.PUB)
+        if port:
+            self._socket.bind("tcp://127.0.0.1:%d" % port)
+            self.port = port
+        else:
+            self.port = self._socket.bind_to_random_port("tcp://127.0.0.1")
+        self.endpoint = "tcp://127.0.0.1:%d" % self.port
+        self.info("graphics server on %s", self.endpoint)
+
+    @staticmethod
+    def launch(port=0):
+        global _instance
+        with _instance_lock:
+            if _instance is None:
+                _instance = GraphicsServer(port)
+            return _instance
+
+    @staticmethod
+    def instance():
+        return _instance
+
+    def enqueue(self, plotter):
+        """Publish one plotter snapshot (pickled, like the reference —
+        the viewer re-runs its ``redraw()``)."""
+        from veles_tpu.plotting_units import Plotter
+        Plotter._plot_message_mode = True
+        try:
+            blob = pickle.dumps(plotter, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:
+            self.exception("failed to pickle %r for plotting", plotter)
+            return
+        finally:
+            Plotter._plot_message_mode = False
+        self._socket.send(blob)
+
+    def shutdown(self):
+        global _instance
+        with _instance_lock:
+            self._socket.close(linger=0)
+            if _instance is self:
+                _instance = None

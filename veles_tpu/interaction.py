@@ -1,0 +1,27 @@
+"""Interactive shell unit (ref ``veles/interaction.py:49``): an
+in-workflow breakpoint — each run() drops into IPython (or code.interact)
+with the workflow in scope.  Gate it (``gate_skip``) to make it
+conditional; the reference's manhole backdoor maps to running with
+``python -i`` or attaching via the shell unit."""
+
+from veles_tpu.units import Unit
+
+
+class Shell(Unit):
+    def __init__(self, workflow, **kwargs):
+        super(Shell, self).__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.interactive = kwargs.get("interactive", True)
+
+    def run(self):
+        if not self.interactive:
+            return
+        banner = ("veles_tpu shell — `workflow` and `unit` are in scope; "
+                  "exit to continue the graph")
+        namespace = {"workflow": self.workflow, "unit": self}
+        try:
+            import IPython
+            IPython.embed(header=banner, user_ns=namespace)
+        except ImportError:
+            import code
+            code.interact(banner=banner, local=namespace)

@@ -212,14 +212,25 @@ class Loader(Unit):
     def serve_next_minibatch(self, consumer_id):
         """Pick the next (offset, size) — retrying failed minibatches
         first — and fill data (ref ``:726-752``)."""
+        retried = False
         try:
             minibatch_def = self.failed_minibatches.pop()
+            retried = True
         except IndexError:
             minibatch_def = self._advance_global_offset()
         minibatch_offset, minibatch_size = minibatch_def
         self.pending_minibatches_[consumer_id].append(minibatch_def)
         self.minibatch_offset, self.minibatch_size = minibatch_def
-        self._update_flags()
+        if retried:
+            # a requeued batch keeps ITS class, not whatever class the
+            # already-advanced global_offset is in; epoch flags were
+            # signaled when the batch was first advanced
+            self.minibatch_class, _ = self.class_index_by_sample_index(
+                minibatch_offset - minibatch_size)
+            self.last_minibatch <<= False
+            self.epoch_ended <<= False
+        else:
+            self._update_flags()
 
         self.fill_indices(minibatch_offset - minibatch_size,
                           minibatch_size)

@@ -36,8 +36,12 @@ def normal(key, shape, dtype=jnp.float32, mean=0.0, stddev=1.0):
 def _uniform_kernel(seed_ref, o_ref, *, low, high):
     # Distinct stream per grid cell: golden-ratio hash of the program id
     # keeps (seed, block) pairs from colliding across *consecutive* seeds
-    # the way plain ``seed + i`` would.
-    pltpu.prng_seed(seed_ref[0] ^ (pl.program_id(0) * 0x9E3779B9))
+    # the way plain ``seed + i`` would.  uint32 math — the constant
+    # overflows int32.
+    mixed = (pl.program_id(0).astype(jnp.uint32)
+             * jnp.uint32(0x9E3779B9)) \
+        ^ pltpu.bitcast(seed_ref[0], jnp.uint32)
+    pltpu.prng_seed(pltpu.bitcast(mixed, jnp.int32))
     bits = pltpu.bitcast(pltpu.prng_random_bits(o_ref.shape), jnp.uint32)
     # 24 high bits → [0, 1) float32 (the reference maps its 64-bit output
     # the same way, ocl/random.cl:96-110)

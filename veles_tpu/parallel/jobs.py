@@ -1,0 +1,295 @@
+"""Cross-slice job layer: elastic master–slave task distribution.
+
+Parity target: reference ``veles/server.py`` + ``veles/client.py`` —
+JSON control protocol with a per-slave FSM (``server.py:230-255``),
+ZeroMQ data plane with pickled job payloads (``server.py:62``,
+``client.py:63``), checksum handshake (``server.py:478-530``), per-slave
+power-based balancing (``:531-539``), hung-slave blacklisting
+(``:377-394``), requeue of a dead slave's work (``drop_slave`` →
+``loader/base.py:679-687``), and slaves joining/leaving mid-run.
+
+TPU re-design (SURVEY §5.8): gradients NEVER ride this layer — on-pod
+aggregation is the ``psum`` inside the jitted step
+(:mod:`veles_tpu.parallel.dp`).  What remains cross-slice is the *job*
+abstraction (GA members, ensemble models, eval shards, async-DP jobs
+over DCN), so control+data collapse onto one ZeroMQ ROUTER/DEALER pair
+(identity routing gives us the reference's per-slave channels; pickled
+frames keep payload parity).  Heartbeats replace Twisted's
+connection-loss callbacks for failure detection.
+
+Wire protocol (pickled dicts):
+  slave → master: {op: handshake|job_request|update|ping, id, ...}
+  master → slave: {op: welcome|reject|job|update_ack|no_more_jobs|pong}
+"""
+
+import pickle
+import threading
+import time
+import uuid
+
+from veles_tpu.logger import Logger
+
+HEARTBEAT_INTERVAL = 2.0
+SLAVE_TIMEOUT = 10.0
+
+
+class SlaveDescription(object):
+    """Master-side per-slave record (ref fysom FSM states collapse to
+    this state field: INIT→WORKING→DROPPED)."""
+
+    def __init__(self, sid, power=1.0):
+        self.id = sid
+        self.power = power
+        self.state = "INIT"
+        self.last_seen = time.time()
+        self.jobs_done = 0
+
+    def __repr__(self):
+        return "<Slave %s %s power=%.1f jobs=%d>" % (
+            self.id, self.state, self.power, self.jobs_done)
+
+
+class JobServer(Logger):
+    """Master: serves jobs from a workflow (or any object implementing
+    generate_data_for_slave / apply_data_from_slave / drop_slave /
+    checksum)."""
+
+    def __init__(self, workflow, port=0, host="127.0.0.1",
+                 slave_timeout=SLAVE_TIMEOUT,
+                 heartbeat_interval=HEARTBEAT_INTERVAL):
+        super(JobServer, self).__init__()
+        import zmq
+        self.workflow = workflow
+        self.slave_timeout = slave_timeout
+        self.heartbeat_interval = heartbeat_interval
+        self.slaves = {}
+        self.blacklist = set()
+        self._no_more_jobs = False
+        self.on_finished = None
+        self._context = zmq.Context.instance()
+        self._socket = self._context.socket(zmq.ROUTER)
+        if port:
+            self._socket.bind("tcp://%s:%d" % (host, port))
+            self.port = port
+        else:
+            self.port = self._socket.bind_to_random_port("tcp://%s" % host)
+        self.endpoint = "tcp://%s:%d" % (host, self.port)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self.info("job server on %s", self.endpoint)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="job-server")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5)
+        self._socket.close(linger=0)
+
+    @property
+    def finished(self):
+        return self._no_more_jobs and not any(
+            s.state == "WORKING" for s in self.slaves.values())
+
+    # -- main loop ----------------------------------------------------------
+    def _loop(self):
+        import zmq
+        poller = zmq.Poller()
+        poller.register(self._socket, zmq.POLLIN)
+        last_reap = time.time()
+        while not self._stop.is_set():
+            if poller.poll(200):
+                identity, blob = self._socket.recv_multipart()
+                try:
+                    msg = pickle.loads(blob)
+                except Exception:
+                    self.exception("undecodable message")
+                    continue
+                try:
+                    self._dispatch(identity, msg)
+                except Exception:
+                    self.exception("failed handling %r", msg.get("op"))
+            if time.time() - last_reap >= self.heartbeat_interval:
+                last_reap = time.time()
+                self._reap_dead_slaves()
+
+    def _send(self, identity, msg):
+        self._socket.send_multipart(
+            [identity, pickle.dumps(msg, pickle.HIGHEST_PROTOCOL)])
+
+    def _dispatch(self, identity, msg):
+        op = msg.get("op")
+        sid = msg.get("id")
+        slave = self.slaves.get(sid)
+        if slave is not None:
+            slave.last_seen = time.time()
+        if op == "handshake":
+            self._on_handshake(identity, msg)
+        elif slave is None or sid in self.blacklist:
+            self._send(identity, {"op": "reject", "reason": "unknown id"})
+        elif op == "ping":
+            self._send(identity, {"op": "pong"})
+        elif op == "job_request":
+            self._on_job_request(identity, slave)
+        elif op == "update":
+            self._on_update(identity, slave, msg)
+        elif op == "bye":
+            self.drop_slave(sid)
+
+    def _on_handshake(self, identity, msg):
+        """Checksum handshake (ref ``server.py:478-530``): reject slaves
+        running different workflow code."""
+        their_checksum = msg.get("checksum")
+        ours = self.workflow.checksum()
+        if their_checksum != ours:
+            self._send(identity, {
+                "op": "reject", "reason": "checksum mismatch"})
+            self.warning("rejected slave with checksum %s (ours %s)",
+                         str(their_checksum)[:12], ours[:12])
+            return
+        sid = msg.get("id") or uuid.uuid4().hex[:8]
+        slave = SlaveDescription(sid, power=float(msg.get("power", 1.0)))
+        slave.state = "WAIT"
+        with self._lock:
+            self.slaves[sid] = slave
+        self._send(identity, {"op": "welcome", "id": sid})
+        self.info("slave %s joined (power %.1f)", sid, slave.power)
+
+    def _on_job_request(self, identity, slave):
+        if self._no_more_jobs:
+            self._send(identity, {"op": "no_more_jobs"})
+            return
+        with self._lock:
+            try:
+                data = self.workflow.generate_data_for_slave(slave)
+            except StopIteration:
+                data = None
+        if data is None:
+            self._no_more_jobs = True
+            self._send(identity, {"op": "no_more_jobs"})
+            self._maybe_finish()
+            return
+        slave.state = "WORKING"
+        self._send(identity, {"op": "job", "data": data})
+
+    def _on_update(self, identity, slave, msg):
+        with self._lock:
+            try:
+                self.workflow.apply_data_from_slave(msg["data"], slave)
+                ok = 1
+            except Exception:
+                self.exception("bad update from %s", slave.id)
+                ok = 0
+        slave.state = "WAIT"
+        slave.jobs_done += 1
+        self._send(identity, {"op": "update_ack", "ok": ok})
+        self._maybe_finish()
+
+    def _reap_dead_slaves(self):
+        """Timeout-based failure detection (replaces Twisted
+        connectionLost, ref ``server.py:315-339``); zero-progress slaves
+        are blacklisted like the reference's hung-slave sweep
+        (``:377-394``)."""
+        now = time.time()
+        for sid, slave in list(self.slaves.items()):
+            if now - slave.last_seen > self.slave_timeout:
+                self.warning("slave %s timed out", sid)
+                if slave.jobs_done == 0:
+                    self.blacklist.add(sid)
+                self.drop_slave(sid)
+
+    def drop_slave(self, sid):
+        with self._lock:
+            slave = self.slaves.pop(sid, None)
+            if slave is None:
+                return
+            self.workflow.drop_slave(slave)
+        self.info("dropped slave %s (%d jobs done)", sid,
+                  slave.jobs_done)
+        self._maybe_finish()
+
+    def _maybe_finish(self):
+        if self.finished and self.on_finished is not None:
+            cb, self.on_finished = self.on_finished, None
+            cb()
+
+    def print_stats(self):
+        for slave in self.slaves.values():
+            self.info("  %r", slave)
+
+
+class JobClient(Logger):
+    """Slave: pulls jobs, runs them through ``workflow.do_job``, pushes
+    updates.  Reconnects with backoff; a mid-run join is just a late
+    handshake (elastic membership)."""
+
+    def __init__(self, workflow, endpoint, sid=None, power=None,
+                 death_probability=0.0):
+        super(JobClient, self).__init__()
+        import zmq
+        self.workflow = workflow
+        self.endpoint = endpoint
+        self.sid = sid or uuid.uuid4().hex[:8]
+        self.power = power if power is not None else 1.0
+        #: fault injection (ref --slave-death-probability client.py:303)
+        self.death_probability = death_probability
+        self._context = zmq.Context.instance()
+        self._socket = self._context.socket(zmq.DEALER)
+        self._socket.setsockopt(zmq.IDENTITY, self.sid.encode())
+        self._socket.connect(endpoint)
+        self.jobs_done = 0
+
+    def _rpc(self, msg, timeout_ms=5000):
+        import zmq
+        self._socket.send(pickle.dumps(msg, pickle.HIGHEST_PROTOCOL))
+        if not self._socket.poll(timeout_ms, zmq.POLLIN):
+            raise TimeoutError("no reply from master for %r" %
+                               msg.get("op"))
+        return pickle.loads(self._socket.recv())
+
+    def handshake(self):
+        reply = self._rpc({"op": "handshake", "id": self.sid,
+                           "power": self.power,
+                           "checksum": self.workflow.checksum()})
+        if reply["op"] != "welcome":
+            raise ConnectionError(
+                "master rejected us: %s" % reply.get("reason"))
+        self.sid = reply["id"]
+        return self
+
+    def run(self, max_jobs=None):
+        """Job loop: request → do_job → update, until no_more_jobs."""
+        import random as _random
+        while max_jobs is None or self.jobs_done < max_jobs:
+            reply = self._rpc({"op": "job_request", "id": self.sid})
+            if reply["op"] == "no_more_jobs":
+                break
+            if reply["op"] != "job":
+                raise ConnectionError("unexpected reply %r" % reply["op"])
+            if self.death_probability and \
+                    _random.random() < self.death_probability:
+                self.warning("fault injection: dying mid-job")
+                return False
+            result = [None]
+            self.workflow.do_job(
+                reply["data"], lambda out: result.__setitem__(0, out))
+            ack = self._rpc({"op": "update", "id": self.sid,
+                             "data": result[0]})
+            if not ack.get("ok"):
+                self.warning("master refused our update")
+            self.jobs_done += 1
+        return True
+
+    def close(self):
+        try:
+            self._socket.send(pickle.dumps(
+                {"op": "bye", "id": self.sid}))
+        except Exception:
+            pass
+        self._socket.close(linger=0)

@@ -1,0 +1,171 @@
+"""Plotter units: in-graph metric collectors streamed to detached viewers.
+
+Parity target: reference ``veles/plotter.py`` + ``veles/plotting_units.py``
+(``:52-822``): ``AccumulatingPlotter`` (error curves), ``MatrixPlotter``
+(confusion matrices), ``ImagePlotter``, ``Histogram``, ``SlaveStats``.
+Each ``run()`` snapshots linked values and publishes itself via
+:class:`veles_tpu.graphics_server.GraphicsServer`; ``redraw()`` is what a
+viewer process calls — units carry their own rendering code to the
+viewer, exactly the reference's design.
+"""
+
+import numpy
+
+from veles_tpu.units import Unit
+
+
+class Plotter(Unit):
+    """Base plotter: publish self on run."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(Plotter, self).__init__(workflow, **kwargs)
+        self.view_group = "PLOTTER"
+        self.clear_plot = False
+        self.redraw_plot = kwargs.get("redraw_plot", True)
+
+    def run(self):
+        self.fill()
+        from veles_tpu.graphics_server import GraphicsServer
+        server = GraphicsServer.instance()
+        if server is not None:
+            server.enqueue(self)
+
+    def fill(self):
+        """Snapshot linked values into plain attrs (so the pickle is
+        self-contained)."""
+
+    def redraw(self, axes):
+        """Render onto a matplotlib axes (called in the viewer)."""
+
+    #: set by GraphicsServer.enqueue while pickling a plot *message* —
+    #: workflow snapshots must keep the full graph state.
+    _plot_message_mode = False
+
+    def __getstate__(self):
+        """In plot-message mode, drop the graph-side refs (``input``,
+        links) so a PUB message carries only the snapshot taken by
+        fill() — the reference's plotters do the same to keep messages
+        small and viewer-decodable."""
+        state = super(Plotter, self).__getstate__()
+        if Plotter._plot_message_mode:
+            for key in ("input", "_linked_attrs", "links_from",
+                        "links_to"):
+                state.pop(key, None)
+        return state
+
+
+class AccumulatingPlotter(Plotter):
+    """Append one scalar per run; renders the series
+    (ref ``plotting_units.py:52``)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(AccumulatingPlotter, self).__init__(workflow, **kwargs)
+        self.input = None               # object to read from
+        self.input_field = kwargs.get("input_field")
+        self.label = kwargs.get("label", self.name)
+        self.fit_poly_power = kwargs.get("fit_poly_power", 0)
+        self.values = []
+        self.demand("input")
+
+    def fill(self):
+        value = getattr(self.input, self.input_field) \
+            if self.input_field else self.input
+        try:
+            self.values.append(float(value))
+        except (TypeError, ValueError):
+            pass
+
+    def redraw(self, axes):
+        axes.plot(self.values, label=self.label)
+        if self.fit_poly_power and len(self.values) > 3:
+            xs = numpy.arange(len(self.values))
+            coeffs = numpy.polyfit(xs, self.values, self.fit_poly_power)
+            axes.plot(xs, numpy.polyval(coeffs, xs), "--")
+        axes.set_title(self.label)
+        axes.legend()
+
+
+class MatrixPlotter(Plotter):
+    """Renders a matrix heat map — confusion matrices
+    (ref ``plotting_units.py:~300``)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(MatrixPlotter, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.input_field = kwargs.get("input_field")
+        self.matrix = None
+        self.demand("input")
+
+    def fill(self):
+        value = getattr(self.input, self.input_field) \
+            if self.input_field else self.input
+        mem = getattr(value, "mem", value)
+        if mem is not None:
+            self.matrix = numpy.array(mem)
+
+    def redraw(self, axes):
+        if self.matrix is None:
+            return
+        axes.imshow(self.matrix, interpolation="nearest", cmap="viridis")
+        axes.set_title(self.name)
+
+
+class ImagePlotter(Plotter):
+    """Renders sample images (ref ``plotting_units.py`` Image plotter)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(ImagePlotter, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.input_field = kwargs.get("input_field")
+        self.image = None
+        self.demand("input")
+
+    def fill(self):
+        value = getattr(self.input, self.input_field) \
+            if self.input_field else self.input
+        mem = getattr(value, "mem", value)
+        if mem is not None and len(mem):
+            self.image = numpy.array(mem[0])
+
+    def redraw(self, axes):
+        if self.image is None:
+            return
+        img = self.image
+        if img.ndim == 1:
+            side = int(numpy.sqrt(img.size))
+            if side * side == img.size:
+                img = img.reshape(side, side)
+            else:
+                img = img.reshape(1, -1)
+        axes.imshow(img.squeeze(), cmap="gray")
+        axes.set_title(self.name)
+
+
+class Histogram(Plotter):
+    """Value-distribution histogram (ref ``plotting_units.py``)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Histogram, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.input_field = kwargs.get("input_field")
+        self.n_bins = kwargs.get("n_bins", 50)
+        self.counts = None
+        self.edges = None
+        self.demand("input")
+
+    def fill(self):
+        value = getattr(self.input, self.input_field) \
+            if self.input_field else self.input
+        mem = getattr(value, "mem", value)
+        if mem is not None:
+            self.counts, self.edges = numpy.histogram(
+                numpy.asarray(mem).ravel(), bins=self.n_bins)
+
+    def redraw(self, axes):
+        if self.counts is None:
+            return
+        axes.bar(self.edges[:-1], self.counts,
+                 width=numpy.diff(self.edges))
+        axes.set_title(self.name)

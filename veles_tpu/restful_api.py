@@ -1,0 +1,115 @@
+"""RESTful inference endpoint unit.
+
+Parity target: reference ``veles/restful_api.py:78-160`` — an in-workflow
+HTTP endpoint accepting JSON (or base64 numpy) input, feeding it through
+the trained forward pass and returning the model output.  The reference
+pairs it with a ``RestfulLoader``; here the unit drives the forward units
+directly (they are device-resident and reentrant), which removes the
+loader indirection while keeping the same wire contract:
+
+    POST /service  {"input": [[...]]}  →  {"result": [[...]]}
+"""
+
+import json
+import threading
+
+import numpy
+
+from veles_tpu.units import Unit
+
+
+class RESTfulAPI(Unit):
+    """Serves the workflow's forward pass over HTTP."""
+
+    def __init__(self, workflow, **kwargs):
+        super(RESTfulAPI, self).__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.port = kwargs.get("port", 0)
+        self.host = kwargs.get("host", "127.0.0.1")
+        self.path = kwargs.get("path", "/service")
+        self.forwards = None     # list of forward units (linked)
+        self._server_ = None
+        self.demand("forwards")
+
+    def init_unpickled(self):
+        super(RESTfulAPI, self).init_unpickled()
+        self._server_ = None
+
+    def infer(self, batch):
+        """Run the forward chain on a host batch; returns host output.
+        The loader's input link is swapped out for the request and
+        restored, so a serving workflow can keep training."""
+        from veles_tpu.memory import Vector
+        batch = numpy.ascontiguousarray(batch, dtype=numpy.float32)
+        first = self.forwards[0]
+        links = first.__dict__.setdefault("_linked_attrs", {})
+        saved_link = links.pop("input", None)
+        saved_value = first.__dict__.pop("input", None)
+        try:
+            with first.data_lock():
+                vec = Vector(batch)
+                vec.initialize(first.device)
+                first.input = vec
+                for unit in self.forwards:
+                    unit.run()
+                out = self.forwards[-1].output
+                out.map_read()
+                return numpy.array(out.mem[:len(batch)])
+        finally:
+            first.__dict__.pop("input", None)
+            if saved_link is not None:
+                links["input"] = saved_link
+            elif saved_value is not None:
+                first.__dict__["input"] = saved_value
+
+    def initialize(self, **kwargs):
+        super(RESTfulAPI, self).initialize(**kwargs)
+        if self._server_ is not None:
+            return
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                if self.path != api.path:
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    batch = numpy.asarray(payload["input"],
+                                          dtype=numpy.float32)
+                    if batch.ndim == 1:
+                        batch = batch[None, :]
+                    result = api.infer(batch)
+                    body = json.dumps(
+                        {"result": result.tolist()}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:  # noqa: BLE001 - wire boundary
+                    body = json.dumps({"error": str(e)}).encode()
+                    self.send_response(400)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                api.debug("http: " + fmt, *args)
+
+        self._server_ = ThreadingHTTPServer((self.host, self.port),
+                                            Handler)
+        self.port = self._server_.server_address[1]
+        thread = threading.Thread(target=self._server_.serve_forever,
+                                  daemon=True, name="restful-api")
+        thread.start()
+        self.info("REST inference on http://%s:%d%s", self.host,
+                  self.port, self.path)
+
+    def stop(self):
+        if self._server_ is not None:
+            self._server_.shutdown()
+            self._server_ = None

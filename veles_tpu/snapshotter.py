@@ -1,0 +1,138 @@
+"""Snapshotter: periodic whole-workflow checkpoints with codecs + resume.
+
+Parity target: reference ``veles/snapshotter.py`` — ``SnapshotterBase``
+(``:84``) with interval/skip control and metric-named filenames
+(``:197-201``), ``SnapshotterToFile`` (``:360``) with gz/bz2/xz/snappy
+codecs (``:365-380``) and a ``_current`` symlink, size warning
+(``check_snapshot_size`` ``:203``), and ``-w/--snapshot`` resume incl.
+over HTTP (``veles/__main__.py:539-590``).
+
+TPU notes: the pickle path captures everything (units + Vectors synced
+device→host + PRNG positions + gate expressions), giving the reference's
+"resume in any mode/backend" property; re-attachment to a (different)
+device happens in ``initialize()`` after load.  snappy is absent in this
+image → codec table carries gz/bz2/xz/raw.
+"""
+
+import bz2
+import gzip
+import lzma
+import os
+import pickle
+import time
+
+from veles_tpu.config import root
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+CODECS = {
+    "": (lambda path: open(path, "wb"), lambda path: open(path, "rb")),
+    "gz": (lambda path: gzip.open(path, "wb", 6),
+           lambda path: gzip.open(path, "rb")),
+    "bz2": (lambda path: bz2.open(path, "wb", 6),
+            lambda path: bz2.open(path, "rb")),
+    "xz": (lambda path: lzma.open(path, "wb", preset=1),
+           lambda path: lzma.open(path, "rb")),
+}
+
+SIZE_WARNING_BYTES = 500 * 1024 * 1024
+
+
+class SnapshotterBase(Unit):
+    """Decides *when* to snapshot; subclasses decide *where*.
+
+    Links: ``suffix`` (usually from Decision.snapshot_suffix) names the
+    artifact; gate on Decision.improved to snapshot only on
+    best-so-far models (the StandardWorkflow wiring).
+    """
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(SnapshotterBase, self).__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.prefix = kwargs.get("prefix", "veles_tpu")
+        self.interval = kwargs.get("interval", 1)      # run()s per shot
+        self.time_interval = kwargs.get("time_interval", 1.0)  # seconds
+        self.suffix = None
+        self.destination = None        # last written artifact
+        self.skipped = Bool(False)
+        #: optional one-shot trigger Bool: cleared after each export so a
+        #: level-triggered gate (e.g. Decision.improved, which stays True
+        #: until the next validation) yields exactly one snapshot
+        self.reset_flag = None
+        self._run_counter = 0
+        self._last_time = 0.0
+
+    def run(self):
+        self._run_counter += 1
+        if self._run_counter % max(self.interval, 1) != 0:
+            self.skipped <<= True
+            return
+        now = time.time()
+        if now - self._last_time < self.time_interval:
+            self.skipped <<= True
+            return
+        self.skipped <<= False
+        self._last_time = now
+        self.export()
+        if self.reset_flag is not None:
+            self.reset_flag <<= False
+
+    def export(self):
+        raise NotImplementedError
+
+
+class SnapshotterToFile(SnapshotterBase):
+    """Pickle the owning workflow to
+    ``<dir>/<prefix>_<suffix>.<ext>.pickle`` + ``_current`` symlink."""
+
+    def __init__(self, workflow, **kwargs):
+        super(SnapshotterToFile, self).__init__(workflow, **kwargs)
+        self.directory = kwargs.get(
+            "directory", root.common.dirs.get("snapshots"))
+        self.compression = kwargs.get("compression", "gz")
+        if self.compression not in CODECS:
+            raise ValueError("unknown compression %r (have %s)" %
+                             (self.compression, sorted(CODECS)))
+
+    def export(self):
+        os.makedirs(self.directory, exist_ok=True)
+        suffix = self.suffix or time.strftime("%Y%m%d_%H%M%S")
+        ext = (".%s" % self.compression) if self.compression else ""
+        name = "%s_%s.pickle%s" % (self.prefix, suffix, ext)
+        path = os.path.join(self.directory, name)
+        opener = CODECS[self.compression][0]
+        with opener(path) as fout:
+            pickle.dump(self.workflow, fout,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+        size = os.path.getsize(path)
+        if size > SIZE_WARNING_BYTES:
+            self.warning("snapshot %s is %.1f MiB — consider trimming "
+                         "resident datasets before snapshotting "
+                         "(ref check_snapshot_size)", name, size / 2 ** 20)
+        self.destination = path
+        current = os.path.join(self.directory,
+                               "%s_current.pickle%s" % (self.prefix, ext))
+        try:
+            if os.path.islink(current) or os.path.exists(current):
+                os.unlink(current)
+            os.symlink(name, current)
+        except OSError:  # e.g. FS without symlinks
+            pass
+        self.info("snapshotted to %s (%.1f KiB)", path, size / 1024)
+
+    @staticmethod
+    def import_(path):
+        """Load a snapshot by path, auto-detecting the codec
+        (the ``-w`` resume path, ref ``__main__.py:539-590``)."""
+        ext = path.rsplit(".", 1)[-1]
+        codec = ext if ext in CODECS else ""
+        opener = CODECS[codec][1]
+        with opener(path) as fin:
+            return pickle.load(fin)
+
+
+def load_snapshot(path):
+    """Module-level resume helper."""
+    return SnapshotterToFile.import_(path)

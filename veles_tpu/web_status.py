@@ -1,0 +1,136 @@
+"""Web status server + master-side notifier.
+
+Parity target: reference ``veles/web_status.py`` (tornado ``WebServer``
+``:113``) + ``Launcher._notify_status`` (``launcher.py:852-886``): the
+master periodically POSTs a JSON blob (workflow name, state, slaves,
+metrics, event tail) to a status service; a browser (or curl) reads the
+aggregate.  The reference's MongoDB log store (TTL-GC'd,
+``web_status.py:158-190``) is replaced by a bounded in-memory ring — no
+database dependency, same API shape.
+"""
+
+import collections
+import json
+import threading
+import time
+
+from veles_tpu.logger import Logger
+
+
+class WebStatus(Logger):
+    """Tornado app: POST /update (JSON), GET /status[.json], GET /events."""
+
+    MAX_EVENTS = 2048
+
+    def __init__(self, host="127.0.0.1", port=0):
+        super(WebStatus, self).__init__()
+        import tornado.web
+        self.runs = {}
+        self.events = collections.deque(maxlen=self.MAX_EVENTS)
+        status = self
+
+        class UpdateHandler(tornado.web.RequestHandler):
+            def post(self):
+                data = json.loads(self.request.body or b"{}")
+                rid = data.get("id", "default")
+                data["received"] = time.time()
+                status.runs[rid] = data
+                for event in data.pop("events", []):
+                    status.events.append(event)
+                self.write({"ok": True})
+
+        class StatusHandler(tornado.web.RequestHandler):
+            def get(self):
+                self.set_header("Content-Type", "application/json")
+                self.write(json.dumps(status.runs, default=repr))
+
+        class EventsHandler(tornado.web.RequestHandler):
+            def get(self):
+                self.set_header("Content-Type", "application/json")
+                self.write(json.dumps(list(status.events), default=repr))
+
+        self._app = tornado.web.Application([
+            (r"/update", UpdateHandler),
+            (r"/status(?:\.json)?", StatusHandler),
+            (r"/events", EventsHandler),
+        ])
+        self._host = host
+        self._port = port
+        self._loop = None
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._port
+
+    def start(self):
+        """Run tornado in a daemon thread; resolves the ephemeral port
+        before returning."""
+        import asyncio
+        import tornado.httpserver
+        import tornado.netutil
+        sockets = tornado.netutil.bind_sockets(self._port, self._host)
+        self._port = sockets[0].getsockname()[1]
+        started = threading.Event()
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            server = tornado.httpserver.HTTPServer(self._app)
+            server.add_sockets(sockets)
+            started.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="web-status")
+        self._thread.start()
+        started.wait(5)
+        self.info("web status on http://%s:%d/status", self._host,
+                  self._port)
+        return self
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+class StatusNotifier(Logger):
+    """Master-side: periodically POST workflow state to a WebStatus
+    (ref ``Launcher._notify_status``)."""
+
+    def __init__(self, url, run_id="default"):
+        super(StatusNotifier, self).__init__()
+        self.url = url
+        self.run_id = run_id
+        #: event-sink ring drained on each notify
+        self.pending_events = collections.deque(maxlen=512)
+        Logger.event_sinks.append(self.pending_events.append)
+
+    def snapshot(self, workflow):
+        data = {
+            "id": self.run_id,
+            "workflow": type(workflow).__name__,
+            "stopped": bool(workflow.stopped),
+            "results": workflow.gather_results(),
+            "unit_times": [
+                (unit.name, round(seconds, 4))
+                for unit, seconds in
+                workflow.get_unit_run_time_stats()[:10]],
+            "events": list(self.pending_events),
+        }
+        self.pending_events.clear()
+        return data
+
+    def notify(self, workflow):
+        import urllib.request
+        body = json.dumps(self.snapshot(workflow), default=repr).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=2) as resp:
+                return resp.status == 200
+        except OSError as e:
+            self.warning("status notify failed: %s", e)
+            return False

@@ -35,14 +35,25 @@ class DecisionBase(Unit):
         self.epoch_ended = None       # Bool
         self.epoch_number = None
         self.class_lengths = None
+        self.effective_class_end_offsets = None
         self.demand("minibatch_class", "minibatch_size", "last_minibatch",
                     "epoch_ended", "epoch_number", "class_lengths")
 
     def link_from_loader(self, loader):
         self.link_attrs(
             loader, "minibatch_class", "minibatch_size", "last_minibatch",
-            "epoch_ended", "epoch_number", "class_lengths")
+            "epoch_ended", "epoch_number", "class_lengths",
+            "effective_class_end_offsets")
         return self
+
+    def effective_class_length(self, cls):
+        """Samples actually served per epoch for ``cls`` (differs from
+        class_lengths when train_ratio < 1)."""
+        offsets = self.effective_class_end_offsets
+        if offsets is None:
+            return self.class_lengths[cls]
+        start = offsets[cls - 1] if cls > 0 else 0
+        return offsets[cls] - start
 
 
 class DecisionGD(DecisionBase):
@@ -108,6 +119,46 @@ class DecisionGD(DecisionBase):
             "errors_pt": {CLASS_NAME[i]: self.epoch_n_err_pt[i]
                           for i in (TEST, VALID, TRAIN)},
         }
+
+    # -- distributed accounting (async job layer) ---------------------------
+    def generate_data_for_master(self):
+        """Slave → master: the job's error stats."""
+        return {"cls": int(self.minibatch_class),
+                "n_err": float(self.evaluator.n_err),
+                "size": int(self.minibatch_size)}
+
+    def apply_data_from_slave(self, data, slave=None):
+        """Master side: accumulate counts; a class's epoch closes when
+        its sample budget is reached (robust to async job completion
+        order, unlike flag forwarding)."""
+        if not data:
+            return
+        cls = data["cls"]
+        self.epoch_n_err[cls] += data["n_err"]
+        self.epoch_samples[cls] += data["size"]
+        length = self.effective_class_length(cls)
+        if length and self.epoch_samples[cls] >= length:
+            self.epoch_n_err_pt[cls] = \
+                100.0 * self.epoch_n_err[cls] / self.epoch_samples[cls]
+            self.info("epoch ~%d %s error: %.2f%% [distributed]",
+                      int(self.epoch_number), CLASS_NAME[cls],
+                      self.epoch_n_err_pt[cls])
+            validated = cls == VALID or (
+                cls == TRAIN and self.class_lengths[VALID] == 0)
+            if validated:
+                err_pt = self.epoch_n_err_pt[cls]
+                if err_pt < self.best_n_err_pt:
+                    self.best_n_err_pt = err_pt
+                    self.best_epoch = int(self.epoch_number)
+                    self.improved <<= True
+                    self.snapshot_suffix = "%.2fpt" % err_pt
+                    self._epochs_without_improvement = 0
+                else:
+                    self.improved <<= False
+                    self._epochs_without_improvement += 1
+                self._on_epoch_ended()
+            self.epoch_n_err[cls] = 0
+            self.epoch_samples[cls] = 0
 
 
 class DecisionMSE(DecisionBase):

@@ -116,23 +116,32 @@ def make_train_step(layer_specs, loss="softmax", compute_dtype=None):
         out = mlp_apply(params, x, static_specs,
                         compute_dtype=compute_dtype)
         valid = (labels >= 0)
-        denom = jnp.maximum(valid.sum(), 1)
+        # gradients scale by the PADDED batch length — identical to the
+        # eager GD units (gd.py divides by len(input); the evaluator
+        # zeroes padded rows) so fused and eager trajectories match on
+        # short final minibatches too
+        grad_denom = x.shape[0]
+        report_denom = jnp.maximum(valid.sum(), 1)
         if loss == "softmax":
             logp = jnp.log(jnp.maximum(out, 1e-30))
             picked = jnp.take_along_axis(
                 logp, jnp.maximum(labels, 0)[:, None], axis=1)[:, 0]
-            value = -(picked * valid).sum() / denom
+            total = -(picked * valid).sum()
+            value = total / grad_denom
+            report = total / report_denom
             n_err = ((jnp.argmax(out, axis=1) != labels) & valid).sum()
         else:
             err = (out - labels.reshape(out.shape)) ** 2
-            value = (err.mean(axis=1) * valid).sum() / denom
-            n_err = value
-        return value, (n_err, out)
+            total = (err.mean(axis=1) * valid).sum()
+            value = total / grad_denom
+            report = total / report_denom
+            n_err = report
+        return value, (n_err, report)
 
     def step(params, x, labels):
         wb = tuple((layer["w"], layer["b"]) for layer in params)
         vstate = tuple((layer["vw"], layer["vb"]) for layer in params)
-        (value, (n_err, _out)), grads = jax.value_and_grad(
+        (_value, (n_err, report)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(wb, x, labels)
         new_params = []
         for (w, b), (vw, vb), (gw, gb), spec in zip(
@@ -142,7 +151,7 @@ def make_train_step(layer_specs, loss="softmax", compute_dtype=None):
             vb = moment_b * vb - lr_b * (gb + decay_b * b)
             new_params.append({"w": w + vw, "b": b + vb,
                                "vw": vw, "vb": vb})
-        return new_params, {"loss": value, "n_err": n_err}
+        return new_params, {"loss": report, "n_err": n_err}
 
     return step
 

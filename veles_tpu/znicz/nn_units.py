@@ -76,6 +76,35 @@ class ForwardBase(AcceleratedUnit):
         if "bias" in data and self.bias:
             self.bias.map_write()
             self.bias.mem[...] = data["bias"]
+        # remember the job's starting point so the update we send back is
+        # a *delta* the master can merge additively (async DP: slaves
+        # compute on possibly-stale weights, master accumulates deltas —
+        # the reference's apply_data_from_slave consistency model)
+        self._job_start = {"weights": numpy.array(self.weights.mem)}
+        if "bias" in data and self.bias:
+            self._job_start["bias"] = numpy.array(self.bias.mem)
+
+    def generate_data_for_master(self):
+        start = getattr(self, "_job_start", None)
+        if start is None or not self.weights:
+            return None
+        self.weights.map_read()
+        payload = {"delta_weights":
+                   numpy.array(self.weights.mem) - start["weights"]}
+        if "bias" in start and self.bias:
+            self.bias.map_read()
+            payload["delta_bias"] = \
+                numpy.array(self.bias.mem) - start["bias"]
+        return payload
+
+    def apply_data_from_slave(self, data, slave=None):
+        if data is None:
+            return
+        self.weights.map_write()
+        self.weights.mem += data["delta_weights"]
+        if "delta_bias" in data and self.bias:
+            self.bias.map_write()
+            self.bias.mem += data["delta_bias"]
 
 
 class GradientDescentBase(AcceleratedUnit):

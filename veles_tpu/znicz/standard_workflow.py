@@ -81,12 +81,16 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.layers = kwargs.pop("layers", [])
         self.loss_function = kwargs.pop("loss_function", None)
         self.decision_config = dict(kwargs.pop("decision_config", {}))
+        self.snapshotter_config = kwargs.pop("snapshotter_config", None)
+        self.plotters_config = kwargs.pop("plotters_config", None)
         loader_factory = kwargs.pop("loader_factory")
         super(StandardWorkflow, self).__init__(workflow, **kwargs)
         self.repeater = Repeater(self)
         self.loader = loader_factory(self)
         self.forwards = []
         self.gds = []
+        self.snapshotter = None
+        self.plotters = []
         self.create_workflow()
 
     # -- the link_* contract ------------------------------------------------
@@ -95,6 +99,10 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.link_forwards()
         self.link_evaluator()
         self.link_decision()
+        if self.snapshotter_config is not None:
+            self.link_snapshotter()
+        if self.plotters_config is not None:
+            self.link_plotters()
         self.link_gds()
         self.link_loop_and_end()
 
@@ -116,7 +124,7 @@ class StandardWorkflow(AcceleratedWorkflow):
         prev_attr = "minibatch_data"
         for spec in self.layers:
             unit = self._make_unit(spec["type"], dict(spec.get("->", {})))
-            unit.link_from(prev if prev is self.loader else prev)
+            unit.link_from(prev)
             unit.link_attrs(prev, ("input", prev_attr))
             self.forwards.append(unit)
             prev = unit
@@ -151,6 +159,46 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.decision.evaluator = self.evaluator
         self.decision.link_from(self.evaluator)
 
+    def link_snapshotter(self):
+        """Snapshot on every improved validation error (the reference
+        wires Decision.improved exactly this way)."""
+        from veles_tpu.snapshotter import SnapshotterToFile
+        cfg = dict(self.snapshotter_config or {})
+        self.snapshotter = SnapshotterToFile(self, **cfg)
+        self.snapshotter.link_from(self.decision)
+        self.snapshotter.link_attrs(
+            self.decision, ("suffix", "snapshot_suffix"))
+        self.snapshotter.gate_skip = ~self.decision.improved
+        # one-shot: Decision.improved stays True until the next
+        # validation close — clear it after the snapshot lands so the
+        # best-model artifact is not overwritten by mid-epoch weights
+        self.snapshotter.reset_flag = self.decision.improved
+
+    def link_plotters(self):
+        """Default plotter set: error curve + confusion matrix
+        (ref StandardWorkflow link_error_plotter/link_conf_matrix)."""
+        from veles_tpu.plotting_units import (
+            AccumulatingPlotter, MatrixPlotter)
+        cfg = dict(self.plotters_config or {})
+        prev = self.decision
+        if cfg.get("error", True):
+            plotter = AccumulatingPlotter(
+                self, name="error_pt", input_field="best_n_err_pt"
+                if hasattr(self.decision, "best_n_err_pt") else "best_mse")
+            plotter.input = self.decision
+            plotter.link_from(prev)
+            plotter.gate_skip = ClassSkipGate(
+                self.loader, TRAIN)  # plot once per train pass
+            self.plotters.append(plotter)
+            prev = plotter
+        if cfg.get("confusion", True) and hasattr(
+                self.evaluator, "confusion_matrix"):
+            plotter = MatrixPlotter(self, name="confusion")
+            plotter.input = self.evaluator
+            plotter.input_field = "confusion_matrix"
+            plotter.link_from(prev)
+            self.plotters.append(plotter)
+
     def link_gds(self):
         """Backward chain in reverse layer order, gated to TRAIN batches
         (ref contract: gds linked last-to-first from decision)."""
@@ -176,10 +224,30 @@ class StandardWorkflow(AcceleratedWorkflow):
 
     def link_loop_and_end(self):
         last_gd = self.gds[-1] if self.gds else self.decision
+        self._loop_tail = last_gd
         self.repeater.link_from(last_gd)
         self.end_point.link_from(last_gd)
         self.end_point.gate_block = ~self.decision.complete
         self.repeater.gate_block = self.decision.complete
+
+    def initialize(self, device=None, **kwargs):
+        result = super(StandardWorkflow, self).initialize(
+            device=device, **kwargs)
+        if self.is_slave:
+            # A job = ONE pass of the graph (ref: slave runs the local
+            # graph once per job, §3.2): remove the training loop's back
+            # edge and open the end point unconditionally.
+            self.repeater.unlink_from(self._loop_tail)
+            self.end_point.gate_block = Bool(False)
+        return result
+
+    def generate_data_for_slave(self, slave=None):
+        """Master: stop serving jobs once Decision raises complete
+        (ref NoMoreJobs, ``workflow.py:498-500``)."""
+        if bool(self.decision.complete):
+            raise StopIteration
+        return super(StandardWorkflow, self).generate_data_for_slave(
+            slave)
 
     # -- results ------------------------------------------------------------
     def gather_results(self):
