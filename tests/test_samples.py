@@ -1,0 +1,129 @@
+"""Model-zoo sample workflows (shrunk configs, synthetic data)."""
+
+import numpy
+import pytest
+
+from veles_tpu.backends import CPUDevice, NumpyDevice
+
+
+def test_mnist_sample_trains():
+    from veles_tpu import prng
+    from veles_tpu.samples import mnist
+    prng.seed_all(1)
+    wf = mnist.create_workflow(device=NumpyDevice(), max_epochs=2,
+                               minibatch_size=500)
+    wf.run()
+    results = wf.gather_results()
+    assert results["best_validation_error_pt"] < 50.0
+
+
+def test_mnist_ae_sample_trains():
+    from veles_tpu import prng
+    from veles_tpu.samples import mnist_ae
+    prng.seed_all(2)
+    wf = mnist_ae.create_workflow(device=NumpyDevice(), max_epochs=2,
+                                  minibatch_size=500, hidden=32)
+    wf.run()
+    assert float(wf.decision.best_mse) < 0.6   # ref parity gate 0.5478
+
+
+def test_rbm_pretraining_reduces_reconstruction_error():
+    from veles_tpu import prng
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.memory import Vector
+    from veles_tpu.znicz.rbm import RBMTrainer
+    prng.seed_all(3)
+    rng = numpy.random.default_rng(0)
+    # binary-ish structured data
+    base = (rng.random((400, 64)) < 0.3).astype(numpy.float32)
+    wf = DummyWorkflow()
+    trainer = RBMTrainer(wf, n_hidden=32, learning_rate=0.5)
+    trainer.input = Vector(base[:100])
+    trainer.initialize(device=None)
+    first = None
+    for epoch in range(8):
+        for start in range(0, 400, 100):
+            trainer.input.reset(base[start:start + 100])
+            trainer.run()
+            if first is None:
+                first = trainer.recon_error
+    assert trainer.recon_error < first
+    features = trainer.transform(base[:10])
+    assert features.shape == (10, 32)
+    assert ((features >= 0) & (features <= 1)).all()
+
+
+def test_kohonen_sample_organizes():
+    from veles_tpu import prng
+    from veles_tpu.samples import kohonen
+    prng.seed_all(4)
+    wf = kohonen.create_workflow(device=CPUDevice(), shape=(6, 6),
+                                 max_epochs=6)
+    # untrained quantization error for comparison
+    wf.loader.original_data.map_read()
+    data = wf.loader.original_data.mem
+    before = wf.trainer.quantization_error(data)
+    wf.run()
+    after = wf.get_metric_values()["quantization_error"]
+    assert after < before * 0.5, (before, after)
+
+
+def test_cifar_sample_builds_and_steps():
+    """Full caffe-style stack builds and completes one epoch (synthetic
+    data, shrunk images would change shapes — use tiny epoch count)."""
+    from veles_tpu import prng
+    from veles_tpu.samples import cifar10
+    prng.seed_all(5)
+    wf = cifar10.create_workflow(device=CPUDevice(), max_epochs=2,
+                                 minibatch_size=250)
+    assert len(wf.forwards) == 8   # 3 conv + 3 pool + fc + softmax
+    wf.run()
+    assert wf.stopped
+    # a full epoch of all classes was accounted
+    assert wf.decision.epoch_n_err_pt[2] < 100.0
+
+
+def test_alexnet_fused_builds_and_steps():
+    """Shrunk-input AlexNet lowers to one fused step and trains."""
+    import jax
+    from veles_tpu import prng
+    from veles_tpu.samples import alexnet
+    prng.seed_all(6)
+    shrunk = [{**spec} for spec in alexnet.LAYERS]
+    # shrink fc widths and classes for the 8-device CPU mesh
+    shrunk[-3]["->"] = {**shrunk[-3]["->"], "output_sample_shape": 64}
+    shrunk[-1]["->"] = {**shrunk[-1]["->"], "output_sample_shape": 10}
+    params, step, eval_fn, apply_fn = alexnet.build_fused(
+        layers=shrunk, input_shape=(67, 67, 3))
+    x, labels = alexnet.synthetic_imagenet_batch(8)
+    import numpy as np
+    x = np.ascontiguousarray(x[:, :67, :67, :])
+    labels = labels % 10
+    params, metrics = step(params, x, labels)
+    jax.block_until_ready(params)
+    assert int(metrics["n_err"]) <= 8
+    out = apply_fn(params, x)
+    assert out.shape == (8, 10)
+
+
+def test_alexnet_fused_data_parallel_mesh():
+    from veles_tpu import prng
+    from veles_tpu.parallel import make_mesh
+    from veles_tpu.samples import alexnet
+    prng.seed_all(7)
+    layers = [
+        {"type": "conv_strict_relu",
+         "->": {"n_kernels": 4, "kx": 3, "ky": 3, "sliding": (2, 2)},
+         "<-": {"learning_rate": 0.01}},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "softmax", "->": {"output_sample_shape": 5},
+         "<-": {"learning_rate": 0.01}},
+    ]
+    mesh = make_mesh({"data": 8})
+    params, step, _eval, _apply = alexnet.build_fused(
+        mesh=mesh, layers=layers, input_shape=(16, 16, 3))
+    x, labels = alexnet.synthetic_imagenet_batch(16)
+    x = numpy.ascontiguousarray(x[:, :16, :16, :])
+    labels = labels % 5
+    params, metrics = step(params, x, labels)
+    assert 0 <= int(metrics["n_err"]) <= 16

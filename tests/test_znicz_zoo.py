@@ -1,0 +1,204 @@
+"""Conv/pooling/activation/LRN/dropout layer-zoo tests: golden checks vs
+hand-computed numpy and an end-to-end conv workflow (the CIFAR-style
+config from BASELINE.json.configs[1], shrunk)."""
+
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.backends import CPUDevice, NumpyDevice
+from veles_tpu.dummy import DummyLauncher, DummyWorkflow
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.znicz.activation import ForwardStrictRELU, ForwardTanh
+from veles_tpu.znicz.conv import Conv
+from veles_tpu.znicz.misc_units import Cutter, Deconv
+from veles_tpu.znicz.normalization_units import (
+    DropoutForward, LRNormalizerForward)
+from veles_tpu.znicz.pooling import (
+    AvgPooling, MaxAbsPooling, MaxPooling, StochasticPooling)
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+def test_conv_forward_golden():
+    """3x3 conv, stride 1, no padding vs naive numpy loops."""
+    rng = numpy.random.default_rng(0)
+    x = rng.standard_normal((2, 6, 6, 3)).astype(numpy.float32)
+    w = rng.standard_normal((3, 3, 3, 4)).astype(numpy.float32)
+    b = rng.standard_normal(4).astype(numpy.float32)
+    out = numpy.asarray(Conv.pure({"w": jnp.asarray(w),
+                                   "b": jnp.asarray(b)},
+                                  jnp.asarray(x)))
+    ref = numpy.zeros((2, 4, 4, 4), numpy.float32)
+    for n in range(2):
+        for i in range(4):
+            for j in range(4):
+                patch = x[n, i:i + 3, j:j + 3, :]
+                for k in range(4):
+                    ref[n, i, j, k] = (patch * w[:, :, :, k]).sum() + b[k]
+    assert numpy.allclose(out, ref, atol=1e-4)
+
+
+def test_conv_padding_and_stride():
+    x = jnp.ones((1, 8, 8, 1), jnp.float32)
+    w = jnp.ones((3, 3, 1, 2), jnp.float32)
+    out = Conv.pure({"w": w}, x, padding=(1, 1, 1, 1), sliding=(2, 2))
+    assert out.shape == (1, 4, 4, 2)
+    assert float(out[0, 1, 1, 0]) == 9.0     # interior window all-ones
+
+
+def test_pooling_golden():
+    x = numpy.arange(16, dtype=numpy.float32).reshape(1, 4, 4, 1)
+    mx = numpy.asarray(MaxPooling.pure({}, jnp.asarray(x), kind="max"))
+    av = numpy.asarray(AvgPooling.pure({}, jnp.asarray(x), kind="avg"))
+    assert mx.ravel().tolist() == [5, 7, 13, 15]
+    assert av.ravel().tolist() == [2.5, 4.5, 10.5, 12.5]
+
+
+def test_maxabs_pooling_keeps_sign():
+    x = numpy.array([[[[1.0], [-5.0]], [[2.0], [3.0]]]],
+                    dtype=numpy.float32)
+    out = numpy.asarray(MaxAbsPooling.pure({}, jnp.asarray(x), kx=2,
+                                           ky=2, sliding=(2, 2),
+                                           kind="maxabs"))
+    assert out.ravel().tolist() == [-5.0]    # |−5| biggest, sign kept
+
+
+def test_stochastic_pooling_seed_reproducible():
+    rng = numpy.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 4, 4, 3)), jnp.float32)
+    a = StochasticPooling.pure({"seed": numpy.int32(7)}, x,
+                               kind="stochastic")
+    b = StochasticPooling.pure({"seed": numpy.int32(7)}, x,
+                               kind="stochastic")
+    c = StochasticPooling.pure({"seed": numpy.int32(8)}, x,
+                               kind="stochastic")
+    assert (numpy.asarray(a) == numpy.asarray(b)).all()
+    assert not (numpy.asarray(a) == numpy.asarray(c)).all()
+    # every pooled value is an element of its source window
+    window = numpy.asarray(x[0, :2, :2, 0])
+    assert numpy.asarray(a)[0, 0, 0, 0] in window
+
+
+def test_lrn_golden():
+    x = numpy.random.default_rng(2).standard_normal(
+        (2, 3, 3, 8)).astype(numpy.float32)
+    out = numpy.asarray(LRNormalizerForward.pure(
+        {}, jnp.asarray(x), alpha=1e-4, beta=0.75, k=2.0, n=5))
+    # manual for channel 4 of one pixel
+    window = (x[0, 0, 0, 2:7] ** 2).sum()
+    ref = x[0, 0, 0, 4] / (2.0 + 1e-4 * window) ** 0.75
+    assert numpy.isclose(out[0, 0, 0, 4], ref, atol=1e-5)
+
+
+def test_activation_units_golden():
+    x = numpy.linspace(-2, 2, 12, dtype=numpy.float32).reshape(3, 4)
+    tanh = numpy.asarray(ForwardTanh.pure({}, jnp.asarray(x),
+                                          func="tanh"))
+    assert numpy.allclose(tanh, 1.7159 * numpy.tanh(0.6666 * x),
+                          atol=1e-5)
+    srelu = numpy.asarray(ForwardStrictRELU.pure(
+        {}, jnp.asarray(x), func="strict_relu"))
+    assert numpy.allclose(srelu, numpy.maximum(x, 0))
+
+
+def test_dropout_replay_and_forward_mode():
+    x = jnp.ones((4, 100), jnp.float32)
+    a = DropoutForward.pure({"seed": numpy.int32(3)}, x, keep=0.8)
+    b = DropoutForward.pure({"seed": numpy.int32(3)}, x, keep=0.8)
+    assert (numpy.asarray(a) == numpy.asarray(b)).all()
+    kept = (numpy.asarray(a) > 0).mean()
+    assert 0.7 < kept < 0.9
+    assert numpy.allclose(numpy.asarray(a)[numpy.asarray(a) > 0],
+                          1.0 / 0.8)
+
+
+def test_cutter_and_deconv_shapes():
+    x = jnp.ones((2, 8, 8, 3), jnp.float32)
+    cut = Cutter.pure({}, x, window=(2, 2, 4, 4))
+    assert cut.shape == (2, 4, 4, 3)
+    w = jnp.ones((2, 2, 3, 3), jnp.float32)   # (ky, kx, C_out, K_in)
+    up = Deconv.pure({"w": w}, jnp.ones((2, 4, 4, 3), jnp.float32),
+                     sliding=(2, 2))
+    assert up.shape == (2, 8, 8, 3)
+
+
+# -- end-to-end conv workflow ------------------------------------------------
+
+class TinyImageLoader(FullBatchLoader):
+    """4-class 12×12×3 synthetic images with class-dependent pattern."""
+
+    def load_data(self):
+        rng = numpy.random.default_rng(11)
+        n = 160
+        labels = (numpy.arange(n) % 4).astype(int)
+        x = rng.standard_normal((n, 12, 12, 3)).astype(
+            numpy.float32) * 0.3
+        for i, lbl in enumerate(labels):
+            x[i, lbl * 3:(lbl + 1) * 3, :, :] += 2.0
+        self.original_data.mem = x
+        self.original_labels = [int(v) for v in labels]
+        self.class_lengths[:] = [0, 40, 120]
+
+
+CONV_LAYERS = [
+    {"type": "conv_strict_relu",
+     "->": {"n_kernels": 8, "kx": 3, "ky": 3, "padding": 1,
+            "weights_filling": "gaussian"},
+     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9}},
+    {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+    {"type": "softmax", "->": {"output_sample_shape": 4},
+     "<-": {"learning_rate": 0.02, "gradient_moment": 0.9}},
+]
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, CPUDevice])
+def test_conv_workflow_trains(device_cls):
+    from veles_tpu import prng
+    prng.seed_all(13)
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: TinyImageLoader(w, minibatch_size=40),
+        layers=[{**s} for s in CONV_LAYERS],
+        decision_config={"max_epochs": 6})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=device_cls())
+    wf.run()
+    assert wf.decision.best_n_err_pt < 25.0, \
+        "conv net failed to learn striped blobs: %.1f%%" % \
+        wf.decision.best_n_err_pt
+
+
+def test_conv_gd_unit_updates_weights_and_reduces_loss():
+    """Drive Conv + GDConv units directly: weights move and the conv
+    unit's loss on a fixed batch drops over steps."""
+    from veles_tpu import prng
+    from veles_tpu.znicz.conv import ConvStrictRELU, GDConvStrictRELU
+    prng.seed_all(17)
+    wf = DummyWorkflow()
+    wf.device = CPUDevice()
+    rng = numpy.random.default_rng(5)
+    x = rng.standard_normal((8, 6, 6, 2)).astype(numpy.float32)
+    target = numpy.abs(
+        rng.standard_normal((8, 4, 4, 3))).astype(numpy.float32)
+
+    from veles_tpu.memory import Vector
+    conv = ConvStrictRELU(wf, n_kernels=3, kx=3, ky=3)
+    conv.input = Vector(x)
+    conv.initialize(device=wf.device)
+    gdc = GDConvStrictRELU(wf, learning_rate=0.3,
+                           gradient_moment=0.5)
+    gdc.setup_from_forward(conv)
+    err_vec = Vector(numpy.zeros_like(target))
+    gdc.err_output = err_vec
+    gdc.initialize(device=wf.device)
+
+    losses = []
+    for _ in range(30):
+        conv.run()
+        conv.output.map_read()
+        err = conv.output.mem - target
+        losses.append(float((err ** 2).mean()))
+        err_vec.map_write()
+        err_vec.mem[...] = 2 * err / err.size * err.shape[0]
+        gdc.run()
+    assert losses[-1] < losses[0] * 0.9

@@ -144,7 +144,11 @@ class JobServer(Logger):
 
     def _on_handshake(self, identity, msg):
         """Checksum handshake (ref ``server.py:478-530``): reject slaves
-        running different workflow code."""
+        running different workflow code or previously blacklisted ids."""
+        if msg.get("id") in self.blacklist:
+            self._send(identity, {"op": "reject",
+                                  "reason": "blacklisted"})
+            return
         their_checksum = msg.get("checksum")
         ours = self.workflow.checksum()
         if their_checksum != ours:
@@ -230,7 +234,8 @@ class JobClient(Logger):
     handshake (elastic membership)."""
 
     def __init__(self, workflow, endpoint, sid=None, power=None,
-                 death_probability=0.0):
+                 death_probability=0.0,
+                 heartbeat_interval=HEARTBEAT_INTERVAL):
         super(JobClient, self).__init__()
         import zmq
         self.workflow = workflow
@@ -239,19 +244,38 @@ class JobClient(Logger):
         self.power = power if power is not None else 1.0
         #: fault injection (ref --slave-death-probability client.py:303)
         self.death_probability = death_probability
+        self.heartbeat_interval = heartbeat_interval
         self._context = zmq.Context.instance()
         self._socket = self._context.socket(zmq.DEALER)
         self._socket.setsockopt(zmq.IDENTITY, self.sid.encode())
         self._socket.connect(endpoint)
+        #: zmq sockets are not thread-safe: the heartbeat thread and the
+        #: job loop share it under this lock
+        self._socket_lock = threading.Lock()
         self.jobs_done = 0
 
     def _rpc(self, msg, timeout_ms=5000):
         import zmq
-        self._socket.send(pickle.dumps(msg, pickle.HIGHEST_PROTOCOL))
-        if not self._socket.poll(timeout_ms, zmq.POLLIN):
-            raise TimeoutError("no reply from master for %r" %
-                               msg.get("op"))
-        return pickle.loads(self._socket.recv())
+        with self._socket_lock:
+            self._socket.send(pickle.dumps(msg, pickle.HIGHEST_PROTOCOL))
+            while True:
+                if not self._socket.poll(timeout_ms, zmq.POLLIN):
+                    raise TimeoutError("no reply from master for %r" %
+                                       msg.get("op"))
+                reply = pickle.loads(self._socket.recv())
+                if reply.get("op") != "pong" or msg.get("op") == "ping":
+                    return reply
+                # stale pong from a timed-out heartbeat — skip it
+
+    def _heartbeat_loop(self, stop_event):
+        """Keeps the master's last_seen fresh while a long job runs
+        (replaces the reference's Twisted connection liveness)."""
+        while not stop_event.wait(self.heartbeat_interval):
+            try:
+                self._rpc({"op": "ping", "id": self.sid},
+                          timeout_ms=2000)
+            except TimeoutError:
+                pass
 
     def handshake(self):
         reply = self._rpc({"op": "handshake", "id": self.sid,
@@ -277,8 +301,16 @@ class JobClient(Logger):
                 self.warning("fault injection: dying mid-job")
                 return False
             result = [None]
-            self.workflow.do_job(
-                reply["data"], lambda out: result.__setitem__(0, out))
+            stop_hb = threading.Event()
+            hb = threading.Thread(target=self._heartbeat_loop,
+                                  args=(stop_hb,), daemon=True)
+            hb.start()
+            try:
+                self.workflow.do_job(
+                    reply["data"], lambda out: result.__setitem__(0, out))
+            finally:
+                stop_hb.set()
+                hb.join(self.heartbeat_interval + 3)
             ack = self._rpc({"op": "update", "id": self.sid,
                              "data": result[0]})
             if not ack.get("ok"):

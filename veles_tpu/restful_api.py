@@ -42,11 +42,13 @@ class RESTfulAPI(Unit):
         from veles_tpu.memory import Vector
         batch = numpy.ascontiguousarray(batch, dtype=numpy.float32)
         first = self.forwards[0]
-        links = first.__dict__.setdefault("_linked_attrs", {})
-        saved_link = links.pop("input", None)
-        saved_value = first.__dict__.pop("input", None)
-        try:
-            with first.data_lock():
+        # the whole swap/run/restore is one critical section —
+        # ThreadingHTTPServer serves requests concurrently
+        with first.data_lock():
+            links = first.__dict__.setdefault("_linked_attrs", {})
+            saved_link = links.pop("input", None)
+            saved_value = first.__dict__.pop("input", None)
+            try:
                 vec = Vector(batch)
                 vec.initialize(first.device)
                 first.input = vec
@@ -55,12 +57,12 @@ class RESTfulAPI(Unit):
                 out = self.forwards[-1].output
                 out.map_read()
                 return numpy.array(out.mem[:len(batch)])
-        finally:
-            first.__dict__.pop("input", None)
-            if saved_link is not None:
-                links["input"] = saved_link
-            elif saved_value is not None:
-                first.__dict__["input"] = saved_value
+            finally:
+                first.__dict__.pop("input", None)
+                if saved_link is not None:
+                    links["input"] = saved_link
+                elif saved_value is not None:
+                    first.__dict__["input"] = saved_value
 
     def initialize(self, **kwargs):
         super(RESTfulAPI, self).initialize(**kwargs)

@@ -1,0 +1,14 @@
+"""Model zoo: the reference's published model families
+(``manualrst_veles_algorithms.rst:18-137``, BASELINE.json.configs) as
+workflow modules:
+
+* :mod:`veles_tpu.samples.mnist` — MnistSimple softmax MLP (784→100→10)
+* :mod:`veles_tpu.samples.cifar10` — caffe-style convnet
+* :mod:`veles_tpu.samples.mnist_ae` — autoencoder (+ RBM pretraining)
+* :mod:`veles_tpu.samples.alexnet` — AlexNet, data-parallel over a mesh
+* :mod:`veles_tpu.samples.kohonen` — Kohonen SOM
+
+Datasets load from ``root.common.dirs.datasets`` when present; otherwise
+each module synthesizes structured stand-in data (this image has no
+network egress), clearly labelled in the run log.
+"""

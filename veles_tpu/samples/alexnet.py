@@ -1,0 +1,119 @@
+"""AlexNet, data-parallel over the device mesh.
+
+Parity target: the reference's Znicz ImageNet AlexNet workflow
+(BASELINE.json north star: data-parallel over a pod at ≥4× single-V100
+wall-clock).  The stack follows Krizhevsky et al. 2012 (conv5 + fc3,
+LRN after conv1/conv2, dropout on fc) expressed as StandardWorkflow
+layer specs; training runs through the *fused* lowering
+(:mod:`veles_tpu.znicz.fused_graph`) jitted over the mesh with the batch
+sharded on the ``data`` axis — gradients all-reduce over ICI inside the
+step.
+
+ImageNet itself is not shipped; ``synthetic_imagenet_batch`` provides
+shape-true stand-in batches for benchmarking (images/sec is
+data-independent).
+"""
+
+import numpy
+
+LAYERS = [
+    {"type": "conv_strict_relu",
+     "->": {"n_kernels": 96, "kx": 11, "ky": 11, "sliding": (4, 4),
+            "weights_filling": "gaussian", "weights_stddev": 0.01},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
+            "weights_decay": 0.0005}},
+    {"type": "lrn", "->": {"alpha": 1e-4, "beta": 0.75, "n": 5,
+                           "k": 2.0}},
+    {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+    {"type": "conv_strict_relu",
+     "->": {"n_kernels": 256, "kx": 5, "ky": 5, "padding": 2,
+            "weights_filling": "gaussian", "weights_stddev": 0.01},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
+            "weights_decay": 0.0005}},
+    {"type": "lrn", "->": {"alpha": 1e-4, "beta": 0.75, "n": 5,
+                           "k": 2.0}},
+    {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+    {"type": "conv_strict_relu",
+     "->": {"n_kernels": 384, "kx": 3, "ky": 3, "padding": 1,
+            "weights_filling": "gaussian", "weights_stddev": 0.01},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
+            "weights_decay": 0.0005}},
+    {"type": "conv_strict_relu",
+     "->": {"n_kernels": 384, "kx": 3, "ky": 3, "padding": 1,
+            "weights_filling": "gaussian", "weights_stddev": 0.01},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
+            "weights_decay": 0.0005}},
+    {"type": "conv_strict_relu",
+     "->": {"n_kernels": 256, "kx": 3, "ky": 3, "padding": 1,
+            "weights_filling": "gaussian", "weights_stddev": 0.01},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
+            "weights_decay": 0.0005}},
+    {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+    {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+    {"type": "all2all_strict_relu",
+     "->": {"output_sample_shape": 4096, "weights_filling": "gaussian",
+            "weights_stddev": 0.005},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
+            "weights_decay": 0.0005}},
+    {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+    {"type": "all2all_strict_relu",
+     "->": {"output_sample_shape": 4096, "weights_filling": "gaussian",
+            "weights_stddev": 0.005},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
+            "weights_decay": 0.0005}},
+    {"type": "softmax",
+     "->": {"output_sample_shape": 1000, "weights_filling": "gaussian",
+            "weights_stddev": 0.01},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9,
+            "weights_decay": 0.0005}},
+]
+
+INPUT_SHAPE = (227, 227, 3)
+
+
+def synthetic_imagenet_batch(batch, seed=0):
+    rng = numpy.random.default_rng(seed)
+    x = rng.standard_normal((batch,) + INPUT_SHAPE).astype(numpy.float32)
+    labels = rng.integers(0, 1000, batch).astype(numpy.int32)
+    return x, labels
+
+
+def build_fused(batch=None, mesh=None, layers=None,
+                input_shape=INPUT_SHAPE):
+    """(params, jitted step) — single-device jit, or data-parallel over
+    ``mesh`` when given."""
+    import jax
+    from veles_tpu.znicz.fused_graph import lower_specs
+    params, step_fn, eval_fn, apply_fn = lower_specs(
+        layers or LAYERS, input_shape)
+    if mesh is not None:
+        from veles_tpu.parallel import data_parallel
+        step = data_parallel(step_fn, mesh, params)
+    else:
+        step = jax.jit(step_fn, donate_argnums=(0,))
+    return params, step, jax.jit(eval_fn), apply_fn
+
+
+def benchmark(batch=128, steps=10, mesh=None, layers=None,
+              input_shape=INPUT_SHAPE):
+    """images/sec of the fused AlexNet train step."""
+    import time
+
+    import jax
+    params, step, _eval, _apply = build_fused(
+        mesh=mesh, layers=layers, input_shape=input_shape)
+    x, labels = synthetic_imagenet_batch(batch)
+    params, _m = step(params, x, labels)       # compile
+    jax.block_until_ready(params)
+    tic = time.perf_counter()
+    for _ in range(steps):
+        params, metrics = step(params, x, labels)
+    jax.block_until_ready(params)
+    elapsed = time.perf_counter() - tic
+    return steps * batch / elapsed
+
+
+if __name__ == "__main__":
+    from veles_tpu.logger import setup_logging
+    setup_logging()
+    print("AlexNet fused: %.1f images/sec" % benchmark())

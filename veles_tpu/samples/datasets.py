@@ -1,0 +1,90 @@
+"""Dataset access for the sample workflows.
+
+Real data is loaded from ``root.common.dirs.datasets`` in the standard
+IDX (MNIST) / CIFAR-10 binary layouts when present (the reference's
+Downloader would fetch them; this image is egress-less, so presence is
+the operator's responsibility).  Otherwise structured synthetic
+stand-ins with the same shapes/classes are generated, so every sample
+workflow runs everywhere.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy
+
+from veles_tpu.config import root
+from veles_tpu.logger import setup_logging  # noqa: F401
+
+
+def _dataset_dir():
+    return root.common.dirs.get("datasets", ".")
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as fin:
+        magic, = struct.unpack(">I", fin.read(4))
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, fin.read(4 * ndim))
+        data = numpy.frombuffer(fin.read(), dtype=numpy.uint8)
+    return data.reshape(dims)
+
+
+def load_mnist():
+    """(train_x, train_y, test_x, test_y) floats in [0,1] / int labels,
+    or synthetic 28×28 10-class stand-ins."""
+    base = os.path.join(_dataset_dir(), "mnist")
+    names = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+             "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+    paths = []
+    for name in names:
+        for cand in (os.path.join(base, name),
+                     os.path.join(base, name + ".gz")):
+            if os.path.exists(cand):
+                paths.append(cand)
+                break
+    if len(paths) == 4:
+        tr_x = _read_idx(paths[0]).astype(numpy.float32) / 255.0
+        tr_y = _read_idx(paths[1]).astype(numpy.int64)
+        te_x = _read_idx(paths[2]).astype(numpy.float32) / 255.0
+        te_y = _read_idx(paths[3]).astype(numpy.int64)
+        return tr_x, tr_y, te_x, te_y, True
+    return _synthetic_images((28, 28), 10, 6000, 1000) + (False,)
+
+
+def load_cifar10():
+    base = os.path.join(_dataset_dir(), "cifar-10-batches-bin")
+    batches = [os.path.join(base, "data_batch_%d.bin" % i)
+               for i in range(1, 6)]
+    test = os.path.join(base, "test_batch.bin")
+    if all(os.path.exists(p) for p in batches + [test]):
+        def read(path):
+            raw = numpy.fromfile(path, dtype=numpy.uint8).reshape(
+                -1, 3073)
+            labels = raw[:, 0].astype(numpy.int64)
+            imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(
+                0, 2, 3, 1).astype(numpy.float32) / 255.0
+            return imgs, labels
+        xs, ys = zip(*[read(p) for p in batches])
+        te_x, te_y = read(test)
+        return (numpy.concatenate(xs), numpy.concatenate(ys),
+                te_x, te_y, True)
+    return _synthetic_images((32, 32, 3), 10, 5000, 1000) + (False,)
+
+
+def _synthetic_images(shape, n_classes, n_train, n_valid):
+    """Class-structured random images: per-class template + noise —
+    learnable but not trivial."""
+    rng = numpy.random.default_rng(1234)
+    total = n_train + n_valid
+    labels = rng.integers(0, n_classes, total)
+    templates = rng.standard_normal((n_classes,) + tuple(
+        shape if isinstance(shape, tuple) else (shape,))) * 1.5
+    x = (templates[labels]
+         + rng.standard_normal((total,) + templates.shape[1:])
+         ).astype(numpy.float32)
+    x = (x - x.min()) / (x.max() - x.min())
+    return (x[:n_train], labels[:n_train].astype(numpy.int64),
+            x[n_train:], labels[n_train:].astype(numpy.int64))

@@ -1,0 +1,103 @@
+"""Kohonen SOM workflow (BASELINE.json.configs[4]).
+
+Parity target: ``manualrst_veles_algorithms.rst:72-83`` — non-gradient
+training exercising the random + reduce substrate.
+"""
+
+import numpy
+
+from veles_tpu.backends import AutoDevice
+from veles_tpu.dummy import DummyLauncher, DummyWorkflow
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.mutable import Bool
+from veles_tpu.plumbing import Repeater
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Workflow
+from veles_tpu.znicz.kohonen import KohonenForward, KohonenTrainer
+
+
+class GaussiansLoader(FullBatchLoader):
+    """2-D gaussian mixture — the classic SOM demo dataset."""
+
+    def __init__(self, workflow, n_samples=1000, n_centers=6, **kwargs):
+        self._n_samples = n_samples
+        self._n_centers = n_centers
+        super(GaussiansLoader, self).__init__(workflow, **kwargs)
+
+    def load_data(self):
+        rng = numpy.random.default_rng(3)
+        centers = rng.uniform(-4, 4, (self._n_centers, 2))
+        idx = rng.integers(0, self._n_centers, self._n_samples)
+        self.original_data.mem = (
+            centers[idx] + rng.standard_normal((self._n_samples, 2))
+            * 0.3).astype(numpy.float32)
+        self.original_labels = []
+        self.class_lengths[:] = [0, 0, self._n_samples]
+
+
+class EpochCounter(Unit):
+    """Stops the SOM loop after max_epochs (no Decision needed — SOM has
+    no validation error)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(EpochCounter, self).__init__(workflow, **kwargs)
+        self.max_epochs = kwargs.get("max_epochs", 10)
+        self.complete = Bool(False)
+        self.epoch_number = None
+        self.demand("epoch_number")
+
+    def run(self):
+        if int(self.epoch_number) >= self.max_epochs:
+            self.complete <<= True
+
+
+class KohonenWorkflow(Workflow):
+    def __init__(self, workflow=None, shape=(8, 8), max_epochs=10,
+                 minibatch_size=100, loader_factory=None, **kwargs):
+        super(KohonenWorkflow, self).__init__(workflow, **kwargs)
+        self.repeater = Repeater(self)
+        self.loader = (loader_factory or GaussiansLoader)(self)
+        self.loader.max_minibatch_size = minibatch_size
+        self.trainer = KohonenTrainer(self, shape=shape)
+        self.forward = KohonenForward(self)
+        self.counter = EpochCounter(self, max_epochs=max_epochs)
+
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        self.trainer.link_from(self.loader)
+        self.trainer.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.forward.link_from(self.trainer)
+        self.forward.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.forward.link_attrs(self.trainer, "weights")
+        self.counter.link_from(self.forward)
+        self.counter.link_attrs(self.loader, "epoch_number")
+        self.repeater.link_from(self.counter)
+        self.end_point.link_from(self.counter)
+        self.end_point.gate_block = ~self.counter.complete
+        self.repeater.gate_block = self.counter.complete
+
+    def get_metric_values(self):
+        self.loader.original_data.map_read()
+        return {"quantization_error": self.trainer.quantization_error(
+            self.loader.original_data.mem)}
+
+
+def create_workflow(device=None, **kwargs):
+    wf = KohonenWorkflow(None, **kwargs)
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=device or AutoDevice())
+    return wf
+
+
+def main(**kwargs):
+    from veles_tpu.logger import setup_logging
+    setup_logging()
+    wf = create_workflow(**kwargs)
+    wf.run()
+    err = wf.get_metric_values()
+    print("SOM quantization error: %.4f" % err["quantization_error"])
+    return err
+
+
+if __name__ == "__main__":
+    main()

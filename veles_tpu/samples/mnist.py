@@ -1,0 +1,72 @@
+"""MnistSimple: the 784→100→10 softmax MLP.
+
+Parity target: the reference's flagship baseline
+(``manualrst_veles_algorithms.rst:24-35``: MNIST validation error
+1.48 %) and BASELINE.json.configs[0].
+"""
+
+import numpy
+
+from veles_tpu.backends import AutoDevice
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.samples.datasets import load_mnist
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 100},
+     "<-": {"learning_rate": 0.03, "gradient_moment": 0.9,
+            "weights_decay": 0.0005}},
+    {"type": "softmax", "->": {"output_sample_shape": 10},
+     "<-": {"learning_rate": 0.03, "gradient_moment": 0.9,
+            "weights_decay": 0.0005}},
+]
+
+
+class MnistLoader(FullBatchLoader):
+    def load_data(self):
+        tr_x, tr_y, te_x, te_y, real = load_mnist()
+        if not real:
+            self.warning("real MNIST not found under "
+                         "root.common.dirs.datasets — using synthetic "
+                         "stand-in data")
+        data = numpy.concatenate([te_x, tr_x]).reshape(-1, 784)
+        labels = numpy.concatenate([te_y, tr_y])
+        self.original_data.mem = numpy.ascontiguousarray(
+            data, dtype=numpy.float32)
+        self.original_labels = [int(v) for v in labels]
+        # reference split: validation = the t10k set
+        self.class_lengths[:] = [0, len(te_y), len(tr_y)]
+
+
+def create_workflow(device=None, max_epochs=25, minibatch_size=100,
+                    snapshot_dir=None, **kwargs):
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: MnistLoader(
+            w, minibatch_size=minibatch_size,
+            normalization_type=kwargs.pop("normalization_type", "none")),
+        layers=[{**spec} for spec in LAYERS],
+        decision_config={"max_epochs": max_epochs,
+                         "fail_iterations": kwargs.pop(
+                             "fail_iterations", 50)},
+        snapshotter_config={"directory": snapshot_dir,
+                            "prefix": "mnist"}
+        if snapshot_dir else None,
+        **kwargs)
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=device or AutoDevice())
+    return wf
+
+
+def main(**kwargs):
+    from veles_tpu.logger import setup_logging
+    setup_logging()
+    wf = create_workflow(**kwargs)
+    wf.run()
+    wf.print_stats()
+    return wf.gather_results()
+
+
+if __name__ == "__main__":
+    print(main())

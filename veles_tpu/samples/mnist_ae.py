@@ -1,0 +1,103 @@
+"""MNIST autoencoder with optional RBM pretraining.
+
+Parity target: ``manualrst_veles_algorithms.rst:57-70`` (MNIST AE
+validation RMSE 0.5478; RBM pretraining ``:85-100``) and
+BASELINE.json.configs[2].
+"""
+
+import numpy
+
+from veles_tpu.backends import AutoDevice
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.loader.fullbatch import FullBatchLoaderMSE
+from veles_tpu.samples.datasets import load_mnist
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+def make_layers(hidden=100, learning_rate=0.01):
+    return [
+        {"type": "all2all_sigmoid",
+         "->": {"output_sample_shape": hidden},
+         "<-": {"learning_rate": learning_rate,
+                "gradient_moment": 0.9}},
+        {"type": "all2all_sigmoid",
+         "->": {"output_sample_shape": 784},
+         "<-": {"learning_rate": learning_rate,
+                "gradient_moment": 0.9}},
+    ]
+
+
+class MnistAELoader(FullBatchLoaderMSE):
+    """Targets = inputs (reconstruction)."""
+
+    def load_data(self):
+        tr_x, tr_y, te_x, te_y, real = load_mnist()
+        if not real:
+            self.warning("real MNIST not found — synthetic stand-in")
+        data = numpy.concatenate([te_x, tr_x]).reshape(-1, 784)
+        data = numpy.ascontiguousarray(data, dtype=numpy.float32)
+        self.original_data.mem = data
+        self.original_targets.mem = data.copy()
+        self.original_labels = []
+        self.class_lengths[:] = [0, len(te_y), len(tr_y)]
+
+
+def pretrain_rbm(loader_data, hidden=100, epochs=3, batch=100):
+    """CD-1 pretraining pass over the train span; returns seeded layer
+    specs (the reference's RBM → AE fine-tune seam)."""
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.memory import Vector
+    from veles_tpu.znicz.rbm import RBMTrainer
+    wf = DummyWorkflow()
+    trainer = RBMTrainer(wf, n_hidden=hidden, learning_rate=0.1)
+    trainer.input = Vector(loader_data[:batch])
+    trainer.initialize(device=None)
+    n = len(loader_data)
+    for _ in range(epochs):
+        for start in range(0, n - batch + 1, batch):
+            trainer.input.reset(loader_data[start:start + batch])
+            trainer.run()
+    return trainer
+
+
+def create_workflow(device=None, max_epochs=15, minibatch_size=100,
+                    hidden=100, rbm_pretrain=False, **kwargs):
+    layers = make_layers(hidden=hidden)
+    loader_holder = {}
+
+    def factory(w):
+        loader = MnistAELoader(w, minibatch_size=minibatch_size)
+        loader_holder["loader"] = loader
+        return loader
+
+    if rbm_pretrain:
+        tr_x, _tr_y, _te_x, _te_y, _real = load_mnist()
+        trainer = pretrain_rbm(
+            tr_x.reshape(len(tr_x), -1)[:2000], hidden=hidden, epochs=1)
+        specs = trainer.to_autoencoder_specs()
+        for layer, seeded in zip(layers, specs):
+            layer["init"] = seeded["init"]
+
+    wf = StandardWorkflow(
+        None,
+        loader_factory=factory,
+        layers=layers,
+        loss_function="mse",
+        decision_config={"max_epochs": max_epochs},
+        **kwargs)
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=device or AutoDevice())
+    return wf
+
+
+def main(**kwargs):
+    from veles_tpu.logger import setup_logging
+    setup_logging()
+    wf = create_workflow(**kwargs)
+    wf.run()
+    wf.print_stats()
+    return wf.gather_results()
+
+
+if __name__ == "__main__":
+    print(main())

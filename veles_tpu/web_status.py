@@ -105,7 +105,22 @@ class StatusNotifier(Logger):
         self.run_id = run_id
         #: event-sink ring drained on each notify
         self.pending_events = collections.deque(maxlen=512)
-        Logger.event_sinks.append(self.pending_events.append)
+        self._sink = self.pending_events.append
+        Logger.event_sinks.append(self._sink)
+
+    def close(self):
+        """Unregister from the event stream (call when the run ends —
+        sinks are process-global)."""
+        try:
+            Logger.event_sinks.remove(self._sink)
+        except ValueError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     def snapshot(self, workflow):
         data = {

@@ -1,0 +1,110 @@
+"""Standalone activation units.
+
+Parity target: Znicz ``activation.Forward/Backward{Tanh,Sigmoid,RELU,
+StrictRELU,Log,TanhLog,SinCos,Mul}``
+(``manualrst_veles_workflow_parameters.rst:477-479``).  Forward and
+backward collapse to one pure function + :class:`GDViaVJP`.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.znicz.gd_base import GDViaVJP
+from veles_tpu.znicz.nn_units import ForwardBase
+
+_FUNCS = {
+    "tanh": lambda x, k: 1.7159 * jnp.tanh(0.6666 * x),
+    "sigmoid": lambda x, k: jax.nn.sigmoid(x),
+    "relu": lambda x, k: jnp.log1p(jnp.exp(jnp.minimum(x, 30.0))),
+    "strict_relu": lambda x, k: jnp.maximum(x, 0.0),
+    "log": lambda x, k: jnp.log(x + jnp.sqrt(x * x + 1.0)),
+    "tanhlog": lambda x, k: jnp.where(
+        jnp.abs(1.7159 * jnp.tanh(0.6666 * x)) <= 1.7159 * 0.6666,
+        1.7159 * jnp.tanh(0.6666 * x),
+        jnp.sign(x) * jnp.log(jnp.abs(x * 0.6666 * 1.7159) + 1.0)),
+    "sincos": lambda x, k: jnp.where(
+        (jnp.arange(x.shape[-1]) % 2)[None, :] == 1,
+        jnp.sin(x), jnp.cos(x)),
+    "mul": lambda x, k: x * k,
+}
+
+
+class ActivationForward(ForwardBase):
+    hide_from_registry = True
+    FUNC = None
+
+    def __init__(self, workflow, **kwargs):
+        super(ActivationForward, self).__init__(workflow, **kwargs)
+        self.include_bias = False
+        self.k = kwargs.get("k", 1.0)
+
+    def pure_config(self):
+        return {"func": self.FUNC, "k": self.k}
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("func", "k"))
+    def pure(params, x, func=None, k=1.0):
+        del params
+        return _FUNCS[func](x, k).astype(x.dtype)
+
+    def initialize(self, device=None, **kwargs):
+        super(ActivationForward, self).initialize(device=device, **kwargs)
+        self.output.reset(numpy.zeros(self.input.shape, numpy.float32))
+        self.init_vectors(self.output)
+
+    def numpy_run(self):
+        out = type(self).pure({}, jnp.asarray(self.input.mem),
+                              **self.pure_config())
+        self.output.map_invalidate()
+        self.output.mem = numpy.asarray(out)
+
+    def tpu_run(self):
+        self.output.devmem = type(self).pure(
+            {}, self.input.devmem, **self.pure_config())
+
+
+class ForwardTanh(ActivationForward):
+    MAPPING = "activation_tanh"
+    FUNC = "tanh"
+
+
+class ForwardSigmoid(ActivationForward):
+    MAPPING = "activation_sigmoid"
+    FUNC = "sigmoid"
+
+
+class ForwardRELU(ActivationForward):
+    MAPPING = "activation_relu"
+    FUNC = "relu"
+
+
+class ForwardStrictRELU(ActivationForward):
+    MAPPING = "activation_strict_relu"
+    FUNC = "strict_relu"
+
+
+class ForwardLog(ActivationForward):
+    MAPPING = "activation_log"
+    FUNC = "log"
+
+
+class ForwardTanhLog(ActivationForward):
+    MAPPING = "activation_tanhlog"
+    FUNC = "tanhlog"
+
+
+class ForwardSinCos(ActivationForward):
+    MAPPING = "activation_sincos"
+    FUNC = "sincos"
+
+
+class ForwardMul(ActivationForward):
+    MAPPING = "activation_mul"
+    FUNC = "mul"
+
+
+class BackwardActivation(GDViaVJP):
+    MAPPING = "gd_activation"

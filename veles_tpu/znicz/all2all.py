@@ -35,6 +35,25 @@ class All2All(ForwardBase):
     def neurons_number(self):
         return int(numpy.prod(self.output_sample_shape))
 
+    def pure_config(self):
+        return {"activation": self.ACTIVATION,
+                "is_softmax": isinstance(self, All2AllSoftmax)}
+
+    @staticmethod
+    def pure(params, x, activation=None, is_softmax=False):
+        """Pure functional form (feeds the fused lowering and GDViaVJP)."""
+        import jax
+        import jax.numpy as jnp
+        h = x.reshape(x.shape[0], -1)
+        z = jnp.dot(h, params["w"],
+                    preferred_element_type=jnp.float32)
+        if "b" in params:
+            z = z + params["b"]
+        if is_softmax:
+            return jax.nn.softmax(z, axis=-1).astype(x.dtype)
+        from veles_tpu.znicz.fused import _ACT
+        return _ACT[activation](z).astype(x.dtype)
+
     def initialize(self, device=None, **kwargs):
         super(All2All, self).initialize(device=device, **kwargs)
         n_input = int(numpy.prod(self.input.shape[1:]))

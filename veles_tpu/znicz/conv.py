@@ -1,0 +1,144 @@
+"""Convolutional forward layers.
+
+Parity target: Znicz ``conv.Conv{,Tanh,Sigmoid,RELU,StrictRELU}``
+(``manualrst_veles_workflow_parameters.rst:473``) with hyperparameters
+n_kernels, kx/ky, padding (4-tuple x_left, x_right, y_top, y_bottom),
+sliding (sx, sy), weights_filling/stddev (``:506-540``).
+
+TPU design: NHWC activations × HWIO weights through
+``lax.conv_general_dilated`` — the layout XLA:TPU natively tiles onto
+the MXU; activation fused by XLA into the conv epilogue.  The backward
+unit is :class:`veles_tpu.znicz.gd_base.GDViaVJP` (AD emits the
+transposed convs).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.memory import Vector
+from veles_tpu.znicz.all2all import _softmax_jit  # noqa: F401
+from veles_tpu.znicz.fused import _ACT
+from veles_tpu.znicz.gd_base import GDViaVJP
+from veles_tpu.znicz.nn_units import ForwardBase
+
+
+class Conv(ForwardBase):
+    """2-D convolution; input (B, H, W, C); weights (ky, kx, C, K)."""
+
+    MAPPING = "conv"
+    ACTIVATION = None
+
+    def __init__(self, workflow, **kwargs):
+        super(Conv, self).__init__(workflow, **kwargs)
+        self.n_kernels = kwargs["n_kernels"]
+        self.kx = kwargs["kx"]
+        self.ky = kwargs["ky"]
+        padding = kwargs.get("padding", (0, 0, 0, 0))
+        if isinstance(padding, int):
+            padding = (padding,) * 4
+        #: (left, right, top, bottom) like the reference
+        self.padding = tuple(padding)
+        self.sliding = tuple(kwargs.get("sliding", (1, 1)))
+
+    def pure_config(self):
+        return {"padding": self.padding, "sliding": self.sliding,
+                "activation": self.ACTIVATION}
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("padding", "sliding",
+                                                 "activation"))
+    def pure(params, x, padding=(0, 0, 0, 0), sliding=(1, 1),
+             activation=None):
+        left, right, top, bottom = padding
+        out = jax.lax.conv_general_dilated(
+            x, params["w"],
+            window_strides=sliding,
+            padding=((top, bottom), (left, right)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        if "b" in params:
+            out = out + params["b"]
+        return _ACT[activation](out).astype(x.dtype)
+
+    def output_shape_for(self, input_shape):
+        batch, h, w, _c = input_shape
+        left, right, top, bottom = self.padding
+        sx, sy = self.sliding
+        out_h = (h + top + bottom - self.ky) // sy + 1
+        out_w = (w + left + right - self.kx) // sx + 1
+        return (batch, out_h, out_w, self.n_kernels)
+
+    def initialize(self, device=None, **kwargs):
+        super(Conv, self).initialize(device=device, **kwargs)
+        c_in = self.input.shape[-1]
+        if not self.weights:
+            w = numpy.zeros((self.ky, self.kx, c_in, self.n_kernels),
+                            dtype=numpy.float32)
+            self.fill_array(w, self.weights_filling, self.weights_stddev
+                            or 1.0 / numpy.sqrt(self.kx * self.ky * c_in))
+            self.weights.reset(w)
+        if self.include_bias and not self.bias:
+            b = numpy.zeros((self.n_kernels,), dtype=numpy.float32)
+            self.fill_array(b, self.bias_filling, self.bias_stddev
+                            or 1.0 / numpy.sqrt(self.kx * self.ky * c_in))
+            self.bias.reset(b)
+        self.output.reset(numpy.zeros(
+            self.output_shape_for(self.input.shape), numpy.float32))
+        self.init_vectors(self.weights, self.bias, self.output)
+
+    def numpy_run(self):
+        # eager XLA-on-host execution (true-numpy conv would be a dead
+        # slow reimplementation; NumpyDevice semantics = eager+debuggable)
+        out = type(self).pure(self.pure_params(host=True),
+                              jnp.asarray(self.input.mem),
+                              **self.pure_config())
+        self.output.map_invalidate()
+        self.output.mem = numpy.asarray(out)
+
+    def tpu_run(self):
+        self.output.devmem = type(self).pure(
+            self.pure_params(host=False), self.input.devmem,
+            **self.pure_config())
+
+
+class ConvTanh(Conv):
+    MAPPING = "conv_tanh"
+    ACTIVATION = "tanh"
+
+
+class ConvSigmoid(Conv):
+    MAPPING = "conv_sigmoid"
+    ACTIVATION = "sigmoid"
+
+
+class ConvRELU(Conv):
+    MAPPING = "conv_relu"
+    ACTIVATION = "relu"
+
+
+class ConvStrictRELU(Conv):
+    MAPPING = "conv_strict_relu"
+    ACTIVATION = "strict_relu"
+
+
+class GDConv(GDViaVJP):
+    MAPPING = "gd_conv"
+
+
+class GDConvTanh(GDViaVJP):
+    MAPPING = "gd_conv_tanh"
+
+
+class GDConvSigmoid(GDViaVJP):
+    MAPPING = "gd_conv_sigmoid"
+
+
+class GDConvRELU(GDViaVJP):
+    MAPPING = "gd_conv_relu"
+
+
+class GDConvStrictRELU(GDViaVJP):
+    MAPPING = "gd_conv_strict_relu"

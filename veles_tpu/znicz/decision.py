@@ -76,7 +76,11 @@ class DecisionGD(DecisionBase):
         self.epoch_samples[cls] += int(self.minibatch_size)
         if not bool(self.last_minibatch):
             return
-        # end of one class's pass
+        self._close_class(cls, check_epoch_end=bool(self.epoch_ended))
+
+    def _close_class(self, cls, check_epoch_end):
+        """End-of-class accounting shared by the standalone path (run)
+        and the distributed path (apply_data_from_slave)."""
         if self.epoch_samples[cls]:
             self.epoch_n_err_pt[cls] = \
                 100.0 * self.epoch_n_err[cls] / self.epoch_samples[cls]
@@ -97,7 +101,7 @@ class DecisionGD(DecisionBase):
             else:
                 self.improved <<= False
                 self._epochs_without_improvement += 1
-        if bool(self.epoch_ended):
+        if check_epoch_end or (validated and self.is_master):
             self._on_epoch_ended()
         self.epoch_n_err[cls] = 0
         self.epoch_samples[cls] = 0
@@ -138,27 +142,9 @@ class DecisionGD(DecisionBase):
         self.epoch_samples[cls] += data["size"]
         length = self.effective_class_length(cls)
         if length and self.epoch_samples[cls] >= length:
-            self.epoch_n_err_pt[cls] = \
-                100.0 * self.epoch_n_err[cls] / self.epoch_samples[cls]
-            self.info("epoch ~%d %s error: %.2f%% [distributed]",
-                      int(self.epoch_number), CLASS_NAME[cls],
-                      self.epoch_n_err_pt[cls])
-            validated = cls == VALID or (
-                cls == TRAIN and self.class_lengths[VALID] == 0)
-            if validated:
-                err_pt = self.epoch_n_err_pt[cls]
-                if err_pt < self.best_n_err_pt:
-                    self.best_n_err_pt = err_pt
-                    self.best_epoch = int(self.epoch_number)
-                    self.improved <<= True
-                    self.snapshot_suffix = "%.2fpt" % err_pt
-                    self._epochs_without_improvement = 0
-                else:
-                    self.improved <<= False
-                    self._epochs_without_improvement += 1
-                self._on_epoch_ended()
-            self.epoch_n_err[cls] = 0
-            self.epoch_samples[cls] = 0
+            # a class's epoch closes when its sample budget is reached
+            # (robust to async job completion order)
+            self._close_class(cls, check_epoch_end=False)
 
 
 class DecisionMSE(DecisionBase):

@@ -1,0 +1,169 @@
+"""GDViaVJP: gradient units derived from the forward's pure function.
+
+The reference hand-writes every backward kernel (gd_conv, gd_pooling,
+…).  TPU-first, the backward IS ``jax.vjp`` of the forward's pure
+function — one jitted program per unit computing (param grads, err_input)
+with XLA choosing the transpose-conv/scatter kernels.  The momentum
+update rule stays exactly :class:`GradientDescentBase`'s.
+
+Forward units participating implement::
+
+    def pure_config(self):      # static kwargs for the pure fn
+    @staticmethod
+    def pure(params, x, **config):   # jit-able; params may be {}
+
+The activation chain rule, window overlaps, padding — all fall out of
+AD, which is what makes adding a layer type one function instead of a
+forward/backward pair.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.memory import Vector
+from veles_tpu.znicz.nn_units import GradientDescentBase
+
+
+class GDViaVJP(GradientDescentBase):
+    """Backward for any forward unit exposing ``pure``/``pure_config``."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(GDViaVJP, self).__init__(workflow, **kwargs)
+        self.forward = None
+        self.demand("forward")
+
+    def setup_from_forward(self, forward):
+        self.forward = forward
+        # weights/bias are (possibly still-empty) Vectors at graph
+        # construction time — link unconditionally; emptiness is decided
+        # at run time by has_params
+        self.link_attrs(forward, "input", "output", "weights")
+        if self.include_bias:
+            self.link_attrs(forward, "bias")
+        return self
+
+    @property
+    def has_params(self):
+        return bool(self.forward.weights)
+
+    def _collect_params(self, host=False):
+        return self.forward.pure_params(host=host)
+
+    def _step_fn(self):
+        """Build the pure backward+update step: VJP, then the momentum
+        rule applied ON DEVICE (no host round-trip per step)."""
+        config = self.forward.pure_config()
+        pure = type(self.forward).pure
+        need_err_input = self.need_err_input
+
+        def compute(params, vstate, x, err_output, hyper):
+            out, vjp = jax.vjp(
+                lambda p, inp: pure(p, inp, **config), params, x)
+            dparams, dx = vjp(err_output.astype(out.dtype))
+            batch = x.shape[0]
+            new_params, new_v = {}, {}
+            if "w" in params:
+                grad = dparams["w"] / batch
+                v = hyper["moment"] * vstate["w"] - hyper["lr"] * (
+                    grad + hyper["decay"] * params["w"])
+                new_params["w"] = params["w"] + v
+                new_v["w"] = v
+            if "b" in params:
+                grad = dparams["b"] / batch
+                v = hyper["moment_b"] * vstate["b"] - hyper["lr_b"] * (
+                    grad + hyper["decay_b"] * params["b"])
+                new_params["b"] = params["b"] + v
+                new_v["b"] = v
+            return new_params, new_v, (dx if need_err_input else None)
+
+        return compute
+
+    def _hyper(self):
+        return {"lr": self.learning_rate, "lr_b": self.learning_rate_bias,
+                "decay": self.weights_decay,
+                "decay_b": self.weights_decay_bias,
+                "moment": self.gradient_moment,
+                "moment_b": self.gradient_moment_bias}
+
+    def _collect_vstate(self, host=False):
+        if not self.has_params:
+            return {}
+        # lazy allocation: forward params may not have existed yet when
+        # initialize() ran (graph-order requeues)
+        if not self.gradient_weights:
+            self.gradient_weights.reset(
+                numpy.zeros_like(self.weights.mem))
+            self.gradient_weights.initialize(self.device)
+        if self.include_bias and self.forward.bias \
+                and not self.gradient_bias:
+            self.gradient_bias.reset(
+                numpy.zeros_like(self.forward.bias.mem))
+            self.gradient_bias.initialize(self.device)
+        vstate = {}
+        get = (lambda v: v.mem) if host else (lambda v: v.devmem)
+        vstate["w"] = get(self.gradient_weights)
+        if self.include_bias and self.forward.bias:
+            vstate["b"] = get(self.gradient_bias)
+        return vstate
+
+    def run(self):
+        """One backward step (jit path for both device kinds — XLA on
+        CPU is the NumpyDevice story for AD-derived units)."""
+        interpret = self.is_interpret
+        compute = self._step_fn() if interpret \
+            else self.jit(self._step_fn())
+        x = jnp.asarray(self.input.mem) if interpret \
+            else self.input.devmem
+        err_output = jnp.asarray(self.err_output.mem) if interpret \
+            else self.err_output.devmem
+        params = self._collect_params(host=interpret)
+        vstate = self._collect_vstate(host=interpret)
+        new_params, new_v, dx = compute(params, vstate, x, err_output,
+                                        self._hyper())
+        if self.has_params:
+            if interpret:
+                self.weights.map_write()
+                self.weights.mem[...] = numpy.asarray(new_params["w"])
+                self.gradient_weights.map_write()
+                self.gradient_weights.mem[...] = numpy.asarray(
+                    new_v["w"])
+                if "b" in new_params:
+                    self.forward.bias.map_write()
+                    self.forward.bias.mem[...] = numpy.asarray(
+                        new_params["b"])
+                    self.gradient_bias.map_write()
+                    self.gradient_bias.mem[...] = numpy.asarray(
+                        new_v["b"])
+            else:
+                self.weights.devmem = new_params["w"]
+                self.gradient_weights.devmem = new_v["w"]
+                if "b" in new_params:
+                    self.forward.bias.devmem = new_params["b"]
+                    self.gradient_bias.devmem = new_v["b"]
+        if self.need_err_input:
+            if interpret:
+                self.err_input.map_invalidate()
+                self.err_input.mem = numpy.asarray(
+                    dx, dtype=numpy.float32)
+            else:
+                self.err_input.devmem = dx
+
+    def initialize(self, device=None, **kwargs):
+        super(GDViaVJP, self).initialize(device=device, **kwargs)
+        if self.need_err_input and not self.err_input:
+            self.err_input.reset(numpy.zeros(self.input.shape,
+                                             dtype=numpy.float32))
+            self.err_input.initialize(self.device)
+
+    def verify_interface(self):
+        # weights may legitimately be an empty Vector for param-free
+        # layers; only forward/input/err_output are hard requirements
+        saved = self._demanded
+        self._demanded = saved - {"weights"}
+        try:
+            super(GDViaVJP, self).verify_interface()
+        finally:
+            self._demanded = saved

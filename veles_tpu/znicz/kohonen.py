@@ -1,0 +1,146 @@
+"""Kohonen self-organizing map units.
+
+Parity target: the reference's Kohonen model family
+(``manualrst_veles_algorithms.rst:72-83``: SOM with OpenCL+numpy
+backends, trainer + forward units; exercises the random + matrix_reduce
+kernel substrate without gradients).
+
+TPU design: one jitted step per minibatch — distance matrix via the MXU
+(‖x−w‖² expanded to x·wᵀ form), winner via argmin, neighborhood-weighted
+batch update via one more matmul.  Gaussian neighborhood shrinks with
+the standard exponential schedule.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Vector
+
+
+@functools.partial(jax.jit, static_argnames=("shape",))
+def _som_step(weights, grid, x, radius, learning_rate, shape):
+    """One batch SOM update.  weights: (N, D); grid: (N, 2) neuron
+    coordinates; x: (B, D)."""
+    # pairwise squared distances on the MXU: ‖x‖² − 2x·wᵀ + ‖w‖²
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    w2 = jnp.sum(weights * weights, axis=1)[None, :]
+    cross = jnp.dot(x, weights.T, preferred_element_type=jnp.float32)
+    dist = x2 - 2.0 * cross + w2                      # (B, N)
+    winners = jnp.argmin(dist, axis=1)                # (B,)
+    # neighborhood of each winner over the 2-D grid
+    wcoords = grid[winners]                           # (B, 2)
+    d2 = jnp.sum((grid[None, :, :] - wcoords[:, None, :]) ** 2, axis=2)
+    h = jnp.exp(-d2 / (2.0 * radius * radius))        # (B, N)
+    # batch update: w += lr * Σ_b h_bn (x_b − w_n) / Σ_b h_bn
+    num = jnp.dot(h.T, x, preferred_element_type=jnp.float32)
+    den = jnp.sum(h, axis=0)[:, None]
+    delta = num / jnp.maximum(den, 1e-8) - weights
+    new_weights = weights + learning_rate * delta * (den > 1e-8)
+    return new_weights, winners
+
+
+class KohonenForward(AcceleratedUnit):
+    """Maps samples to their best-matching unit index."""
+
+    def __init__(self, workflow, **kwargs):
+        super(KohonenForward, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.weights = None     # linked from trainer
+        self.output = Vector()
+        self.demand("input", "weights")
+
+    def initialize(self, device=None, **kwargs):
+        super(KohonenForward, self).initialize(device=device, **kwargs)
+        self.output.reset(numpy.zeros(self.input.shape[0],
+                                      dtype=numpy.int32))
+        self.init_vectors(self.output)
+
+    def run(self):
+        self.input.map_read()
+        self.weights.map_read()
+        x = self.input.mem.reshape(len(self.input.mem), -1)
+        w = self.weights.mem
+        dist = (x * x).sum(1)[:, None] - 2 * x @ w.T \
+            + (w * w).sum(1)[None, :]
+        self.output.map_invalidate()
+        self.output.mem = dist.argmin(axis=1).astype(numpy.int32)
+
+
+class KohonenTrainer(AcceleratedUnit):
+    """SOM trainer: owns the (sy·sx, D) codebook and the decay
+    schedules."""
+
+    def __init__(self, workflow, **kwargs):
+        super(KohonenTrainer, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.shape = tuple(kwargs.get("shape", (8, 8)))    # (sy, sx)
+        self.weights = Vector()
+        self.winners = Vector()
+        self.learning_rate = kwargs.get("learning_rate", 0.5)
+        self.sigma = kwargs.get("sigma", max(self.shape) / 2.0)
+        self.decay = kwargs.get("decay", 0.995)
+        self._step = 0
+        self.demand("input")
+
+    @property
+    def n_neurons(self):
+        return self.shape[0] * self.shape[1]
+
+    def initialize(self, device=None, **kwargs):
+        super(KohonenTrainer, self).initialize(device=device, **kwargs)
+        dim = int(numpy.prod(self.input.shape[1:]))
+        if not self.weights:
+            w = numpy.zeros((self.n_neurons, dim), dtype=numpy.float32)
+            prng.get("kohonen").fill_uniform(w, -0.5, 0.5)
+            self.weights.reset(w)
+        ys, xs = numpy.meshgrid(numpy.arange(self.shape[0]),
+                                numpy.arange(self.shape[1]),
+                                indexing="ij")
+        self._grid = numpy.stack(
+            [ys.ravel(), xs.ravel()], axis=1).astype(numpy.float32)
+        self.winners.reset(numpy.zeros(self.input.shape[0],
+                                       dtype=numpy.int32))
+        self.init_vectors(self.weights, self.winners)
+
+    @property
+    def current_radius(self):
+        return max(self.sigma * (self.decay ** self._step), 0.5)
+
+    @property
+    def current_learning_rate(self):
+        return max(self.learning_rate * (self.decay ** self._step), 0.01)
+
+    def run(self):
+        x = self.input.mem if self.is_interpret else self.input.devmem
+        x = jnp.asarray(x).reshape(x.shape[0], -1)
+        w = jnp.asarray(self.weights.mem) if self.is_interpret \
+            else self.weights.devmem
+        new_w, winners = _som_step(
+            w, jnp.asarray(self._grid), x,
+            jnp.float32(self.current_radius),
+            jnp.float32(self.current_learning_rate), self.shape)
+        if self.is_interpret:
+            self.weights.map_write()
+            self.weights.mem[...] = numpy.asarray(new_w)
+            self.winners.map_invalidate()
+            self.winners.mem = numpy.asarray(winners, dtype=numpy.int32)
+        else:
+            self.weights.devmem = new_w
+            self.winners.devmem = winners.astype(jnp.int32)
+        self._step += 1
+
+    def quantization_error(self, x):
+        """Mean distance of samples to their BMU (the SOM quality
+        metric)."""
+        x = numpy.asarray(x).reshape(len(x), -1)
+        self.weights.map_read()
+        w = self.weights.mem
+        dist = (x * x).sum(1)[:, None] - 2 * x @ w.T \
+            + (w * w).sum(1)[None, :]
+        return float(numpy.sqrt(numpy.maximum(
+            dist.min(axis=1), 0)).mean())

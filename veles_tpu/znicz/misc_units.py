@@ -1,0 +1,228 @@
+"""Remaining Znicz layer types: deconv, cutter, channel split/merge,
+resizable all2all, RProp, zero-filling.
+
+Parity targets (``manualrst_veles_workflow_parameters.rst:482-505``):
+``deconv.Deconv``/``gd_deconv.GDDeconv``, ``cutter.Cutter/GDCutter``,
+``channel_splitting.ChannelSplitter/Merger``,
+``resizable_all2all.ResizableAll2All``, ``rprop_all2all.RPropAll2All``,
+``weights_zerofilling.ZeroFiller``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.znicz.all2all import All2All
+from veles_tpu.znicz.fused import _ACT
+from veles_tpu.znicz.gd_base import GDViaVJP
+from veles_tpu.znicz.nn_units import ForwardBase
+from veles_tpu.units import Unit
+
+
+class Deconv(ForwardBase):
+    """Transposed convolution (ref ``deconv.Deconv``): upsamples input
+    (B, H, W, K) back to (B, H·sy, W·sx, C) with weights shared with the
+    paired Conv (ky, kx, C, K)."""
+
+    MAPPING = "deconv"
+    ACTIVATION = None
+
+    def __init__(self, workflow, **kwargs):
+        super(Deconv, self).__init__(workflow, **kwargs)
+        self.n_kernels = kwargs["n_kernels"]
+        self.kx = kwargs["kx"]
+        self.ky = kwargs["ky"]
+        padding = kwargs.get("padding", (0, 0, 0, 0))
+        if isinstance(padding, int):
+            padding = (padding,) * 4
+        self.padding = tuple(padding)
+        self.sliding = tuple(kwargs.get("sliding", (1, 1)))
+        self.output_channels = kwargs.get("output_channels")
+
+    def pure_config(self):
+        return {"padding": self.padding, "sliding": self.sliding,
+                "activation": self.ACTIVATION}
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("padding", "sliding",
+                                                 "activation"))
+    def pure(params, x, padding=(0, 0, 0, 0), sliding=(1, 1),
+             activation=None):
+        left, right, top, bottom = padding
+        ky, kx = params["w"].shape[0], params["w"].shape[1]
+        # `padding` means the FORWARD conv's padding being undone:
+        # out = (in-1)*stride + k - pad; jax's explicit transpose pads
+        # are offset by k-1
+        pad = ((ky - 1 - top, ky - 1 - bottom),
+               (kx - 1 - left, kx - 1 - right))
+        out = jax.lax.conv_transpose(
+            x, params["w"], strides=sliding, padding=pad,
+            dimension_numbers=("NHWC", "HWOI", "NHWC"),
+            preferred_element_type=jnp.float32)
+        return _ACT[activation](out).astype(x.dtype)
+
+    def initialize(self, device=None, **kwargs):
+        super(Deconv, self).initialize(device=device, **kwargs)
+        self.include_bias = False
+        c_out = self.output_channels or self.input.shape[-1]
+        if not self.weights:
+            w = numpy.zeros((self.ky, self.kx, c_out, self.n_kernels),
+                            dtype=numpy.float32)
+            self.fill_array(
+                w, self.weights_filling, self.weights_stddev or
+                1.0 / numpy.sqrt(self.kx * self.ky * self.n_kernels))
+            self.weights.reset(w)
+        sample = type(self).pure(
+            {"w": jnp.asarray(self.weights.mem)},
+            jnp.zeros((1,) + self.input.shape[1:], jnp.float32),
+            **self.pure_config())
+        self.output.reset(numpy.zeros(
+            (self.input.shape[0],) + tuple(sample.shape[1:]),
+            numpy.float32))
+        self.init_vectors(self.weights, self.output)
+
+    def numpy_run(self):
+        out = type(self).pure(self.pure_params(host=True),
+                              jnp.asarray(self.input.mem),
+                              **self.pure_config())
+        self.output.map_invalidate()
+        self.output.mem = numpy.asarray(out)
+
+    def tpu_run(self):
+        self.output.devmem = type(self).pure(
+            self.pure_params(host=False), self.input.devmem,
+            **self.pure_config())
+
+
+class GDDeconv(GDViaVJP):
+    MAPPING = "gd_deconv"
+
+
+class Cutter(ForwardBase):
+    """Crops a spatial window (ref ``cutter.Cutter``): (y, x, h, w)."""
+
+    MAPPING = "cutter"
+
+    def __init__(self, workflow, **kwargs):
+        super(Cutter, self).__init__(workflow, **kwargs)
+        self.include_bias = False
+        self.window = tuple(kwargs.get("window"))   # (y, x, h, w)
+
+    def pure_config(self):
+        return {"window": self.window}
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("window",))
+    def pure(params, x, window=None):
+        del params
+        y, xo, h, w = window
+        return x[:, y:y + h, xo:xo + w, :]
+
+    def initialize(self, device=None, **kwargs):
+        super(Cutter, self).initialize(device=device, **kwargs)
+        _y, _x, h, w = self.window
+        batch, _, _, c = self.input.shape
+        self.output.reset(numpy.zeros((batch, h, w, c), numpy.float32))
+        self.init_vectors(self.output)
+
+    def numpy_run(self):
+        out = type(self).pure({}, jnp.asarray(self.input.mem),
+                              **self.pure_config())
+        self.output.map_invalidate()
+        self.output.mem = numpy.asarray(out)
+
+    def tpu_run(self):
+        self.output.devmem = type(self).pure(
+            {}, self.input.devmem, **self.pure_config())
+
+
+class GDCutter(GDViaVJP):
+    MAPPING = "gd_cutter"
+
+
+class ChannelSplitter(Unit):
+    """(B, H, W, C) → list of per-channel (B, H, W) planes
+    (ref ``channel_splitting.ChannelSplitter``)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(ChannelSplitter, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.outputs = []
+        self.demand("input")
+
+    def run(self):
+        mem = getattr(self.input, "mem", self.input)
+        self.outputs = [numpy.ascontiguousarray(mem[..., i])
+                        for i in range(mem.shape[-1])]
+
+
+class ChannelMerger(Unit):
+    """Inverse of ChannelSplitter."""
+
+    def __init__(self, workflow, **kwargs):
+        super(ChannelMerger, self).__init__(workflow, **kwargs)
+        self.inputs = None
+        self.output = None
+        self.demand("inputs")
+
+    def run(self):
+        self.output = numpy.stack(
+            [getattr(p, "mem", p) for p in self.inputs], axis=-1)
+
+
+class ResizableAll2All(All2All):
+    """All2All whose output width can be changed between initializations
+    (ref ``resizable_all2all.ResizableAll2All``): existing rows/columns
+    of the weight matrix are preserved on resize."""
+
+    MAPPING = "resizable_all2all"
+
+    def resize(self, new_neurons):
+        old_w = numpy.array(self.weights.mem) if self.weights else None
+        old_b = numpy.array(self.bias.mem) if self.bias else None
+        self.output_sample_shape = (int(new_neurons),)
+        if old_w is not None:
+            w = numpy.zeros((old_w.shape[0], new_neurons),
+                            dtype=numpy.float32)
+            self.fill_array(w, self.weights_filling, self.weights_stddev)
+            keep = min(old_w.shape[1], new_neurons)
+            w[:, :keep] = old_w[:, :keep]
+            self.weights.reset(w)
+        if old_b is not None:
+            b = numpy.zeros((new_neurons,), dtype=numpy.float32)
+            keep = min(len(old_b), new_neurons)
+            b[:keep] = old_b[:keep]
+            self.bias.reset(b)
+        self._is_initialized = False
+        return self
+
+
+class RPropAll2All(All2All):
+    """All2All trained with resilient propagation (ref
+    ``rprop_all2all.RPropAll2All``): the paired GD unit uses sign-based
+    per-weight step sizes instead of the learning rate."""
+
+    MAPPING = "rprop_all2all"
+
+
+class ZeroFiller(Unit):
+    """Zeroes a configurable block of a layer's weights every run
+    (ref ``weights_zerofilling.ZeroFiller`` — used to enforce sparsity
+    masks)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(ZeroFiller, self).__init__(workflow, **kwargs)
+        self.target_unit = None
+        self.mask = kwargs.get("mask")
+        self.demand("target_unit")
+
+    def run(self):
+        weights = self.target_unit.weights
+        if not weights:
+            return
+        if self.mask is None:
+            return
+        weights.map_write()
+        weights.mem[...] *= self.mask

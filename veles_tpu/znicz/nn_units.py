@@ -58,6 +58,18 @@ class ForwardBase(AcceleratedUnit):
     # subclasses: allocate weights/bias/output in initialize(), compute in
     # numpy_run/tpu_run.
 
+    def pure_params(self, host=False):
+        """Params pytree fed to the unit's pure function (and to its
+        GDViaVJP backward — overridden by units that thread extra traced
+        state, e.g. stochastic pooling's per-step seed)."""
+        params = {}
+        if self.weights:
+            params["w"] = self.weights.mem if host \
+                else self.weights.devmem
+        if self.include_bias and self.bias:
+            params["b"] = self.bias.mem if host else self.bias.devmem
+        return params
+
     def generate_data_for_slave(self, slave=None):
         """Weights ride to slaves with each job (async-DP semantics of the
         reference, ``workflow.py:478``)."""

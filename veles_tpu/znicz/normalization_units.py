@@ -1,0 +1,154 @@
+"""Local response normalization (LRN) + dropout units.
+
+Parity targets: Znicz ``normalization.LRNormalizerForward/Backward``
+(α/β/k/n hyperparameters, ``manualrst_veles_workflow_parameters.rst:480``)
+and ``dropout.Dropout{Forward,Backward}`` (``:481``).
+
+Dropout is the canonical case for counter-based device RNG (SURVEY §7
+hard parts): the mask is derived from (named stream seed, step counter)
+so it is reproducible under jit and across snapshot/resume, and the
+backward replays the identical mask by reusing the step's seed — no mask
+buffer round-trips HBM.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.mutable import Bool
+from veles_tpu.znicz.gd_base import GDViaVJP
+from veles_tpu.znicz.nn_units import ForwardBase
+
+
+class LRNormalizerForward(ForwardBase):
+    """Across-channel LRN: x / (k + α·Σ_{n window} x²)^β."""
+
+    MAPPING = "lrn"
+
+    def __init__(self, workflow, **kwargs):
+        super(LRNormalizerForward, self).__init__(workflow, **kwargs)
+        self.include_bias = False
+        self.alpha = kwargs.get("alpha", 1e-4)
+        self.beta = kwargs.get("beta", 0.75)
+        self.k = kwargs.get("k", 2.0)
+        self.n = kwargs.get("n", 5)
+
+    def pure_config(self):
+        return {"alpha": self.alpha, "beta": self.beta, "k": self.k,
+                "n": self.n}
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("alpha", "beta", "k",
+                                                 "n"))
+    def pure(params, x, alpha=1e-4, beta=0.75, k=2.0, n=5):
+        del params
+        half = n // 2
+        sq = x * x
+        # sum over a window of n channels (last axis)
+        pads = [(0, 0)] * (x.ndim - 1) + [(half, n - 1 - half)]
+        padded = jnp.pad(sq, pads)
+        window = jnp.zeros_like(x)
+        for i in range(n):
+            window = window + jax.lax.slice_in_dim(
+                padded, i, i + x.shape[-1], axis=x.ndim - 1)
+        return (x / (k + alpha * window) ** beta).astype(x.dtype)
+
+    def initialize(self, device=None, **kwargs):
+        super(LRNormalizerForward, self).initialize(device=device,
+                                                    **kwargs)
+        self.output.reset(numpy.zeros(self.input.shape, numpy.float32))
+        self.init_vectors(self.output)
+
+    def numpy_run(self):
+        out = type(self).pure({}, jnp.asarray(self.input.mem),
+                              **self.pure_config())
+        self.output.map_invalidate()
+        self.output.mem = numpy.asarray(out)
+
+    def tpu_run(self):
+        self.output.devmem = type(self).pure(
+            {}, self.input.devmem, **self.pure_config())
+
+
+class LRNormalizerBackward(GDViaVJP):
+    MAPPING = "gd_lrn"
+
+
+class DropoutForward(ForwardBase):
+    """Inverted dropout; identity when ``forward_mode`` (validation/test
+    batches — StandardWorkflow gates this via the loader class)."""
+
+    MAPPING = "dropout"
+
+    def __init__(self, workflow, **kwargs):
+        super(DropoutForward, self).__init__(workflow, **kwargs)
+        self.include_bias = False
+        self.dropout_ratio = kwargs.get("dropout_ratio", 0.5)
+        #: identity passthrough (set True off-TRAIN)
+        self.forward_mode = Bool(False)
+
+    def pure_config(self):
+        return {"keep": 1.0 - self.dropout_ratio}
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("keep",))
+    def pure(params, x, keep=0.5):
+        key = jax.random.key(
+            jax.lax.stop_gradient(params["seed"]).astype(jnp.uint32))
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    def pure_params(self, host=False):
+        return {"seed": numpy.int32(getattr(self, "_last_seed", 0))}
+
+    def initialize(self, device=None, **kwargs):
+        super(DropoutForward, self).initialize(device=device, **kwargs)
+        self.output.reset(numpy.zeros(self.input.shape, numpy.float32))
+        self.init_vectors(self.output)
+
+    def _run_impl(self, host):
+        if bool(self.forward_mode):
+            if host:
+                self.output.map_invalidate()
+                self.output.mem = numpy.array(self.input.mem)
+            else:
+                self.output.devmem = self.input.devmem
+            return
+        self._last_seed = int(prng.get("dropout").randint(0, 2 ** 31))
+        x = jnp.asarray(self.input.mem) if host else self.input.devmem
+        out = type(self).pure(self.pure_params(host=host), x,
+                              **self.pure_config())
+        if host:
+            self.output.map_invalidate()
+            self.output.mem = numpy.asarray(out)
+        else:
+            self.output.devmem = out
+
+    def numpy_run(self):
+        self._run_impl(host=True)
+
+    def tpu_run(self):
+        self._run_impl(host=False)
+
+
+class DropoutBackward(GDViaVJP):
+    """Replays the forward mask via the shared seed param."""
+
+    MAPPING = "gd_dropout"
+
+    def run(self):
+        forward = self.forward
+        if bool(getattr(forward, "forward_mode", False)):
+            # identity passthrough
+            if self.need_err_input:
+                if self.is_interpret:
+                    self.err_input.map_invalidate()
+                    self.err_input.mem = numpy.array(
+                        self.err_output.mem)
+                else:
+                    self.err_input.devmem = self.err_output.devmem
+            return
+        super(DropoutBackward, self).run()

@@ -1,0 +1,159 @@
+"""Pooling layers + their gradients.
+
+Parity target: Znicz ``pooling.{Max,MaxAbs,Avg,Stochastic,
+StochasticAbs}Pooling`` ↔ ``gd_pooling.*``
+(``manualrst_veles_workflow_parameters.rst:474-476``) with kx/ky/sliding.
+
+TPU design: ``lax.reduce_window`` (max/avg) — its VJP is exactly the
+reference's scatter-based backward, emitted by AD.  Stochastic pooling
+samples a window element with probability ∝ value (Zeiler & Fergus),
+reproducibly via a counter-based key; its ABS variants pool by |x| but
+output x (MaxAbs semantics).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.znicz.gd_base import GDViaVJP
+from veles_tpu.znicz.nn_units import ForwardBase
+
+
+class PoolingBase(ForwardBase):
+    hide_from_registry = True
+    #: "max" | "maxabs" | "avg" | "stochastic" | "stochasticabs"
+    KIND = None
+
+    def __init__(self, workflow, **kwargs):
+        super(PoolingBase, self).__init__(workflow, **kwargs)
+        self.kx = kwargs.get("kx", 2)
+        self.ky = kwargs.get("ky", 2)
+        self.sliding = tuple(kwargs.get("sliding", (self.kx, self.ky)))
+        self.include_bias = False
+
+    def pure_config(self):
+        return {"kx": self.kx, "ky": self.ky, "sliding": self.sliding,
+                "kind": self.KIND}
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("kx", "ky", "sliding",
+                                                 "kind"))
+    def pure(params, x, kx=2, ky=2, sliding=(2, 2), kind="max"):
+        window = (1, ky, kx, 1)
+        strides = (1, sliding[1], sliding[0], 1)
+        if kind == "avg":
+            summed = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, window, strides, "VALID")
+            return summed / (kx * ky)
+        if kind == "max":
+            return jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, window, strides, "VALID")
+        # maxabs / stochastic variants: explicit window patches
+        # (b, out_h, out_w, ky*kx, c), selection along the window axis
+        b, h, w, c = x.shape
+        out_h = (h - ky) // sliding[1] + 1
+        out_w = (w - kx) // sliding[0] + 1
+        row = (jnp.arange(out_h) * sliding[1])[:, None] \
+            + jnp.arange(ky)[None, :]                      # (out_h, ky)
+        col = (jnp.arange(out_w) * sliding[0])[:, None] \
+            + jnp.arange(kx)[None, :]                      # (out_w, kx)
+        patches = x[:, row[:, None, :, None],
+                    col[None, :, None, :], :]   # (b, out_h, out_w, ky, kx, c)
+        patches = patches.reshape(b, out_h, out_w, ky * kx, c)
+        magnitude = jnp.abs(patches)
+        if kind == "maxabs":
+            sel = jnp.argmax(magnitude, axis=3, keepdims=True)
+            return jnp.take_along_axis(patches, sel, axis=3)[..., 0, :]
+        # stochastic (Zeiler & Fergus): sample ∝ |value| per window;
+        # the seed is a TRACED param so forward and its VJP backward use
+        # the same routing without retracing per step
+        key = jax.random.key(
+            jax.lax.stop_gradient(params["seed"]).astype(jnp.uint32))
+        probs = magnitude / jnp.maximum(
+            magnitude.sum(axis=3, keepdims=True), 1e-12)
+        cum = jnp.cumsum(probs, axis=3)
+        u = jax.random.uniform(key, (b, out_h, out_w, 1, c))
+        sel = jnp.argmax(cum >= u, axis=3, keepdims=True)
+        chosen = jnp.take_along_axis(patches, sel, axis=3)[..., 0, :]
+        if kind == "stochasticabs":
+            return jnp.abs(chosen)
+        return chosen
+
+    def output_shape_for(self, input_shape):
+        batch, h, w, c = input_shape
+        out_h = (h - self.ky) // self.sliding[1] + 1
+        out_w = (w - self.kx) // self.sliding[0] + 1
+        return (batch, out_h, out_w, c)
+
+    def initialize(self, device=None, **kwargs):
+        super(PoolingBase, self).initialize(device=device, **kwargs)
+        self.output.reset(numpy.zeros(
+            self.output_shape_for(self.input.shape), numpy.float32))
+        self.init_vectors(self.output)
+
+    def pure_params(self, host=False):
+        params = super(PoolingBase, self).pure_params(host=host)
+        if self.KIND in ("stochastic", "stochasticabs"):
+            # reuse the seed drawn by the latest forward so the backward
+            # replays the identical selection
+            params["seed"] = numpy.int32(getattr(self, "_last_seed", 0))
+        return params
+
+    def _draw_seed(self):
+        if self.KIND in ("stochastic", "stochasticabs"):
+            self._last_seed = int(
+                prng.get("stochastic_pooling").randint(0, 2 ** 31))
+
+    def numpy_run(self):
+        self._draw_seed()
+        out = type(self).pure(self.pure_params(host=True),
+                              jnp.asarray(self.input.mem),
+                              **self.pure_config())
+        self.output.map_invalidate()
+        self.output.mem = numpy.asarray(out)
+
+    def tpu_run(self):
+        self._draw_seed()
+        self.output.devmem = type(self).pure(
+            self.pure_params(host=False), self.input.devmem,
+            **self.pure_config())
+
+
+class MaxPooling(PoolingBase):
+    MAPPING = "max_pooling"
+    KIND = "max"
+
+
+class MaxAbsPooling(PoolingBase):
+    MAPPING = "maxabs_pooling"
+    KIND = "maxabs"
+
+
+class AvgPooling(PoolingBase):
+    MAPPING = "avg_pooling"
+    KIND = "avg"
+
+
+class StochasticPooling(PoolingBase):
+    MAPPING = "stochastic_pooling"
+    KIND = "stochastic"
+
+
+class StochasticAbsPooling(PoolingBase):
+    MAPPING = "stochasticabs_pooling"
+    KIND = "stochasticabs"
+
+
+class GDPooling(GDViaVJP):
+    MAPPING = "gd_max_pooling"
+
+
+class GDAvgPooling(GDViaVJP):
+    MAPPING = "gd_avg_pooling"
+
+
+class GDStochasticPooling(GDViaVJP):
+    MAPPING = "gd_stochastic_pooling"

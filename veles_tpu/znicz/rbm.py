@@ -1,0 +1,129 @@
+"""Restricted Boltzmann machine units (CD-k pretraining).
+
+Parity target: the reference's RBM model family
+(``manualrst_veles_algorithms.rst:85-100``: numpy-backend RBM for MNIST
+AE pretraining).
+
+TPU design: one jitted contrastive-divergence step (two matmuls per
+Gibbs half-step, counter-based Bernoulli sampling), parameters updated
+in-device.  Stacked RBMs pretrain an autoencoder which
+``to_autoencoder_layers`` converts into All2All layer specs.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Vector
+
+
+@functools.partial(jax.jit, static_argnames=("cd_k",))
+def _cd_step(w, vbias, hbias, v0, seed, lr, cd_k=1):
+    """CD-k update.  v0: (B, V) in [0,1]; returns new params + recon
+    error."""
+    key = jax.random.key(seed.astype(jnp.uint32))
+
+    def sample(p, k):
+        return jax.random.bernoulli(k, p).astype(jnp.float32)
+
+    def hprob(v):
+        return jax.nn.sigmoid(
+            jnp.dot(v, w, preferred_element_type=jnp.float32) + hbias)
+
+    def vprob(h):
+        return jax.nn.sigmoid(
+            jnp.dot(h, w.T, preferred_element_type=jnp.float32) + vbias)
+
+    h0 = hprob(v0)
+    key, k0 = jax.random.split(key)
+    h = sample(h0, k0)
+    v = v0
+    for i in range(cd_k):
+        v = vprob(h)
+        hp = hprob(v)
+        key, ki = jax.random.split(key)
+        h = sample(hp, ki)
+    batch = v0.shape[0]
+    dw = (jnp.dot(v0.T, h0, preferred_element_type=jnp.float32)
+          - jnp.dot(v.T, hp, preferred_element_type=jnp.float32)) / batch
+    dvb = jnp.mean(v0 - v, axis=0)
+    dhb = jnp.mean(h0 - hp, axis=0)
+    recon = jnp.sqrt(jnp.mean((v0 - v) ** 2))
+    return w + lr * dw, vbias + lr * dvb, hbias + lr * dhb, recon
+
+
+class RBMTrainer(AcceleratedUnit):
+    """Single-layer Bernoulli RBM trained by CD-k."""
+
+    def __init__(self, workflow, **kwargs):
+        super(RBMTrainer, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.n_hidden = kwargs.get("n_hidden", 128)
+        self.cd_k = kwargs.get("cd_k", 1)
+        self.learning_rate = kwargs.get("learning_rate", 0.1)
+        self.weights = Vector()
+        self.vbias = Vector()
+        self.hbias = Vector()
+        self.recon_error = numpy.inf
+        self.demand("input")
+
+    def initialize(self, device=None, **kwargs):
+        super(RBMTrainer, self).initialize(device=device, **kwargs)
+        dim = int(numpy.prod(self.input.shape[1:]))
+        if not self.weights:
+            w = numpy.zeros((dim, self.n_hidden), dtype=numpy.float32)
+            prng.get("rbm").fill_normal(w, stddev=0.01)
+            self.weights.reset(w)
+            self.vbias.reset(numpy.zeros(dim, numpy.float32))
+            self.hbias.reset(numpy.zeros(self.n_hidden, numpy.float32))
+        self.init_vectors(self.weights, self.vbias, self.hbias)
+
+    def run(self):
+        host = self.is_interpret
+        get = (lambda v: jnp.asarray(v.mem)) if host \
+            else (lambda v: v.devmem)
+        x = get(self.input).reshape(self.input.shape[0], -1)
+        seed = jnp.int32(prng.get("rbm").randint(0, 2 ** 31))
+        w, vb, hb, recon = _cd_step(
+            get(self.weights), get(self.vbias), get(self.hbias), x,
+            seed, jnp.float32(self.learning_rate), cd_k=self.cd_k)
+        if host:
+            for vec, val in ((self.weights, w), (self.vbias, vb),
+                             (self.hbias, hb)):
+                vec.map_write()
+                vec.mem[...] = numpy.asarray(val)
+        else:
+            self.weights.devmem = w
+            self.vbias.devmem = vb
+            self.hbias.devmem = hb
+        self.recon_error = float(recon)
+
+    def transform(self, x):
+        """Hidden-unit probabilities for ``x`` (the feature extractor)."""
+        self.weights.map_read()
+        self.hbias.map_read()
+        flat = numpy.asarray(x).reshape(len(x), -1)
+        act = flat @ self.weights.mem + self.hbias.mem
+        return 1.0 / (1.0 + numpy.exp(-act))
+
+    def to_autoencoder_specs(self, learning_rate=0.01):
+        """Encoder+decoder All2All layer specs initialized from the RBM
+        (the pretraining → fine-tuning seam of the reference's MNIST AE
+        flow)."""
+        return [
+            {"type": "all2all_sigmoid",
+             "->": {"output_sample_shape": self.n_hidden},
+             "<-": {"learning_rate": learning_rate},
+             "init": {"weights": numpy.array(self.weights.mem),
+                      "bias": numpy.array(self.hbias.mem)}},
+            {"type": "all2all_sigmoid",
+             "->": {"output_sample_shape":
+                    int(numpy.prod(self.input.shape[1:]))},
+             "<-": {"learning_rate": learning_rate},
+             "init": {"weights": numpy.array(self.weights.mem.T),
+                      "bias": numpy.array(self.vbias.mem)}},
+        ]

@@ -19,7 +19,9 @@ from veles_tpu.loader.base import TRAIN
 from veles_tpu.mutable import Bool
 from veles_tpu.plumbing import Repeater
 from veles_tpu.units import UnitRegistry
-from veles_tpu.znicz import all2all, gd  # noqa: F401 - populate registry
+from veles_tpu.znicz import (  # noqa: F401 - populate the unit registry
+    activation, all2all, conv, gd, misc_units, normalization_units,
+    pooling)
 from veles_tpu.znicz.decision import DecisionGD, DecisionMSE
 from veles_tpu.znicz.evaluator import EvaluatorMSE, EvaluatorSoftmax
 
@@ -30,6 +32,7 @@ GD_PAIRS = {
     "all2all_sigmoid": "gd_sigmoid",
     "all2all_relu": "gd_relu",
     "all2all_strict_relu": "gd_strict_relu",
+    "resizable_all2all": "gd",
     "softmax": "gd_softmax",
     "conv": "gd_conv",
     "conv_tanh": "gd_conv_tanh",
@@ -37,10 +40,22 @@ GD_PAIRS = {
     "conv_relu": "gd_conv_relu",
     "conv_strict_relu": "gd_conv_strict_relu",
     "max_pooling": "gd_max_pooling",
+    "maxabs_pooling": "gd_max_pooling",
     "avg_pooling": "gd_avg_pooling",
     "stochastic_pooling": "gd_stochastic_pooling",
+    "stochasticabs_pooling": "gd_stochastic_pooling",
     "lrn": "gd_lrn",
     "dropout": "gd_dropout",
+    "deconv": "gd_deconv",
+    "cutter": "gd_cutter",
+    "activation_tanh": "gd_activation",
+    "activation_sigmoid": "gd_activation",
+    "activation_relu": "gd_activation",
+    "activation_strict_relu": "gd_activation",
+    "activation_log": "gd_activation",
+    "activation_tanhlog": "gd_activation",
+    "activation_sincos": "gd_activation",
+    "activation_mul": "gd_activation",
 }
 
 
@@ -122,10 +137,21 @@ class StandardWorkflow(AcceleratedWorkflow):
     def link_forwards(self):
         prev = self.loader
         prev_attr = "minibatch_data"
+        from veles_tpu.znicz.normalization_units import DropoutForward
         for spec in self.layers:
             unit = self._make_unit(spec["type"], dict(spec.get("->", {})))
             unit.link_from(prev)
             unit.link_attrs(prev, ("input", prev_attr))
+            if isinstance(unit, DropoutForward):
+                # dropout is identity off-TRAIN (validation/test batches)
+                unit.forward_mode = ClassSkipGate(self.loader, TRAIN)
+            init = spec.get("init")
+            if init:
+                # pre-seeded parameters (e.g. RBM pretraining) — the
+                # forward's initialize() keeps existing weights
+                unit.weights.reset(init["weights"])
+                if "bias" in init:
+                    unit.bias.reset(init["bias"])
             self.forwards.append(unit)
             prev = unit
             prev_attr = "output"
