@@ -9,8 +9,9 @@ throughput with tuned fp32 CUDA kernels (the reference's own OpenCL
 backend was measured-era slower); the driver-defined target is v5e-8 ≥
 4× single-V100-ocl, i.e. vs_baseline ≥ 0.5 per chip.
 
-Falls back to the MNIST784 MLP fused-vs-eager ratio if AlexNet cannot
-run (e.g. insufficient HBM on a shared chip).
+Falls back to reporting raw MNIST784 MLP fused train throughput
+(vs_baseline null — no published reference number for that path) if
+AlexNet cannot run (e.g. insufficient HBM on a shared chip).
 """
 
 import json

@@ -1,0 +1,225 @@
+"""CLI layer tests: cmdline registry, Launcher modes, __main__ plumbing
+(ref test strategy: ``test_launcher.py`` runs master+slave in ONE process
+against localhost, SURVEY §4)."""
+
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from veles_tpu.cmdline import make_parser, register_arguments
+from veles_tpu.config import root
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.launcher import Launcher, _split_endpoint
+from veles_tpu.units import Unit
+
+
+def test_parser_core_flags():
+    parser = make_parser()
+    args, _ = parser.parse_known_args(
+        ["veles_tpu.samples.mnist", "-d", "numpy", "--test",
+         "root.common.engine.backend=numpy"])
+    assert args.workflow == "veles_tpu.samples.mnist"
+    assert args.device == "numpy"
+    assert args.test
+
+
+def test_parser_contributor_registry():
+    saw = []
+
+    def contribute(parser):
+        saw.append(True)
+        parser.add_argument("--test-contrib-flag", default="x")
+
+    register_arguments(contribute)
+    parser = make_parser()
+    args, _ = parser.parse_known_args(["w"])
+    assert saw and args.test_contrib_flag == "x"
+
+
+def test_split_endpoint():
+    assert _split_endpoint("1.2.3.4:5000") == ("1.2.3.4", 5000)
+    assert _split_endpoint(":5000") == ("127.0.0.1", 5000)
+    assert _split_endpoint("5000") == ("127.0.0.1", 5000)
+
+
+class _CountingUnit(Unit):
+    def __init__(self, workflow, **kwargs):
+        super(_CountingUnit, self).__init__(workflow, **kwargs)
+        self.runs = 0
+
+    def run(self):
+        self.runs += 1
+
+
+def _tiny_workflow():
+    wf = DummyWorkflow()
+    unit = _CountingUnit(wf)
+    unit.link_from(wf.start_point)
+    wf.end_point.link_from(unit)
+    return wf, unit
+
+
+def test_launcher_standalone_runs_workflow():
+    wf, unit = _tiny_workflow()
+    launcher = Launcher(wf, device="numpy")
+    assert launcher.is_standalone and wf.launcher is launcher
+    assert launcher.workflow is wf  # add_ref via the launcher setter
+    launcher.initialize()
+    launcher.run()
+    assert unit.runs == 1
+    status = launcher.status()
+    assert status["mode"] == "standalone" and status["stopped"]
+    json.loads(launcher.status_json())
+
+
+def test_launcher_master_slave_exclusive():
+    with pytest.raises(ValueError):
+        Launcher(listen=":5000", master_address="h:6000")
+
+
+def test_launcher_modes():
+    assert Launcher(listen=":0").is_master
+    assert Launcher(master_address="h:1").is_slave
+
+
+def test_main_runs_sample_module(tmp_path):
+    """python -m veles_tpu veles_tpu.samples.mnist -d numpy with a tiny
+    config (synthetic data, 1 epoch)."""
+    from veles_tpu.__main__ import Main
+    result_file = str(tmp_path / "result.json")
+    main = Main([
+        "veles_tpu.samples.mnist", "-d", "numpy",
+        "--result-file", result_file,
+    ])
+    args = main._parse()
+    assert args.workflow == "veles_tpu.samples.mnist"
+    main._setup_logging()
+    main._seed_random()
+    main._apply_config()
+    # construct but don't run 25 epochs: dry-run init only
+    main.args.dry_run = "init"
+    main.module = main._load_module(main.args.workflow)
+    wf = main.module.create_workflow(
+        launcher=Launcher(device="numpy"), max_epochs=1,
+        minibatch_size=50)
+    assert not getattr(wf, "_is_initialized", False)
+    wf.launcher.initialize()
+    assert wf._is_initialized
+
+
+def test_main_dry_run_init(tmp_path):
+    from veles_tpu.__main__ import Main
+    graph = str(tmp_path / "graph.dot")
+    rc = Main(["veles_tpu.samples.mnist", "-d", "numpy",
+               "--dry-run", "init", "--workflow-graph", graph]).run()
+    assert rc == 0
+    assert os.path.exists(graph)
+    assert "digraph" in open(graph).read()
+
+
+def test_main_loads_workflow_from_file(tmp_path):
+    """A user workflow .py file using the create_workflow convention."""
+    from veles_tpu.__main__ import Main
+    wf_file = tmp_path / "wf.py"
+    wf_file.write_text("""
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.units import Unit
+
+class Probe(Unit):
+    ran = False
+    def run(self):
+        Probe.ran = True
+
+def create_workflow(launcher=None, **kwargs):
+    wf = DummyWorkflow()
+    if launcher is not None:
+        wf.launcher = launcher
+    probe = Probe(wf)
+    probe.link_from(wf.start_point)
+    wf.end_point.link_from(probe)
+    return wf
+""")
+    rc = Main([str(wf_file), "-d", "numpy"]).run()
+    assert rc == 0
+    mod = sys.modules["wf"]
+    assert mod.Probe.ran
+
+
+def test_main_seed_from_file(tmp_path):
+    from veles_tpu.__main__ import Main
+    from veles_tpu import prng
+    seed_file = tmp_path / "seed.bin"
+    seed_file.write_bytes(bytes(range(64)))
+    main = Main(["w", "-r", "%s:uint32:16" % seed_file])
+    main._parse()
+    main._seed_random()
+    a = prng.get("master").randint(0, 1 << 30)
+    main._seed_random()
+    assert prng.get("master").randint(0, 1 << 30) == a
+
+
+def test_main_config_overrides(tmp_path):
+    from veles_tpu.__main__ import Main
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text("root.common.test_marker = 41\n")
+    main = Main(["w", str(cfg), "root.common.test_marker2=42"])
+    main._parse()
+    main._apply_config()
+    assert root.common.test_marker == 41
+    assert root.common.test_marker2 == 42
+
+
+def test_master_slave_end_to_end():
+    """Launcher-level master+slave in one process (ref
+    test_launcher.py:104 testConnectivity)."""
+    from veles_tpu.parallel.jobs import JobServer, JobClient
+
+    class JobWorkflow(object):
+        """Scripted generate_/apply_ methods (ref test_network.py:52)."""
+
+        def __init__(self):
+            self.jobs = list(range(5))
+            self.updates = []
+
+        @staticmethod
+        def checksum():
+            return "tiny"
+
+        def generate_data_for_slave(self, slave=None):
+            from veles_tpu.workflow import NoMoreJobs
+            if not self.jobs:
+                raise NoMoreJobs()
+            return self.jobs.pop()
+
+        def apply_data_from_slave(self, data, slave=None):
+            self.updates.append(data)
+
+        def drop_slave(self, slave=None):
+            pass
+
+    class SlaveWorkflow(object):
+        @staticmethod
+        def checksum():
+            return "tiny"
+
+        def do_job(self, data, callback):
+            callback(data * 10)
+
+    master_wf = JobWorkflow()
+    server = JobServer(master_wf).start()
+    try:
+        slave_wf = SlaveWorkflow()
+        client = JobClient(slave_wf, server.endpoint)
+        client.handshake()
+        client.run()
+        client.close()
+        deadline = 50
+        while not server.finished and deadline:
+            threading.Event().wait(0.1)
+            deadline -= 1
+        assert sorted(master_wf.updates) == [0, 10, 20, 30, 40]
+    finally:
+        server.stop()

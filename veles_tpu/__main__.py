@@ -1,0 +1,242 @@
+"""``python -m veles_tpu <workflow> [<config>] [key=value ...]`` — the
+framework entry point (ref ``veles/__main__.py:136-859``).
+
+Call sequence mirrors SURVEY §3.1: parse args → seed named PRNGs →
+load workflow module (file, dotted module, or snapshot) → exec config
+file against ``root.*`` → apply ``key=value`` overrides → construct
+Launcher + workflow → initialize → run.
+
+Workflow module conventions supported:
+
+- ``run(load, main)`` — the reference convention
+  (``__main__.py:716-799``): the module calls ``load(WorkflowClass,
+  **kwargs)`` to construct and ``main(**kwargs)`` to initialize+run.
+- ``create_workflow(device=..., **kwargs) -> workflow`` — the native
+  convention used by :mod:`veles_tpu.samples`.
+"""
+
+import importlib
+import importlib.util
+import logging
+import os
+import runpy
+import sys
+
+from veles_tpu import prng
+from veles_tpu.cmdline import make_parser
+from veles_tpu.config import (
+    apply_site_config, root, update_from_arguments)
+from veles_tpu.launcher import Launcher
+from veles_tpu.logger import Logger
+
+
+class Main(Logger):
+    """One CLI invocation (ref ``Main`` ``__main__.py:136``)."""
+
+    def __init__(self, argv=None):
+        super(Main, self).__init__()
+        self.argv = list(sys.argv[1:] if argv is None else argv)
+        self.args = None
+        self.launcher = None
+        self.workflow = None
+        self.module = None
+
+    # -- setup --------------------------------------------------------------
+    def _parse(self):
+        parser = make_parser()
+        args, extra = parser.parse_known_args(self.argv)
+        # argparse puts stray key=value positionals into `extra` or
+        # `config`; sort them out (ref __main__.py:474-482).
+        overrides = list(args.overrides)
+        for item in extra:
+            if "=" in item and not item.startswith("-"):
+                overrides.append(item)
+            else:
+                parser.error("unrecognized argument: %s" % item)
+        if args.config and "=" in args.config and \
+                not os.path.exists(args.config):
+            overrides.insert(0, args.config)
+            args.config = None
+        args.overrides = overrides
+        self.args = args
+        return args
+
+    def _setup_logging(self):
+        level = getattr(logging, self.args.verbosity.upper())
+        logging.basicConfig(level=level)
+        logging.getLogger().setLevel(level)
+        for name in filter(None, self.args.debug.split(",")):
+            logging.getLogger(name).setLevel(logging.DEBUG)
+
+    def _seed_random(self):
+        """Seed every named stream (ref ``__main__.py:483-538``)."""
+        spec = self.args.random_seed
+        if spec is None:
+            prng.seed_all(1234)
+            return
+        try:
+            prng.seed_all(int(spec))
+            return
+        except ValueError:
+            pass
+        # path[:dtype[:count]] — read seed bytes from a file
+        # (ref random_generator.py:106: /dev/urandom support).
+        parts = spec.split(":")
+        path, dtype, count = (
+            parts[0],
+            parts[1] if len(parts) > 1 else "uint32",
+            int(parts[2]) if len(parts) > 2 else 16)
+        import numpy
+        with open(path, "rb") as fin:
+            raw = numpy.frombuffer(
+                fin.read(numpy.dtype(dtype).itemsize * count),
+                dtype=dtype, count=count)
+        prng.seed_all(int(numpy.sum(raw.astype(numpy.uint64)) %
+                          (2 ** 31)))
+
+    def _apply_config(self):
+        """Exec the config file then CLI overrides against ``root``
+        (ref ``__main__.py:426-482``)."""
+        apply_site_config()
+        if self.args.config:
+            with open(self.args.config, "r") as fin:
+                code = compile(fin.read(), self.args.config, "exec")
+            exec(code, {"root": root})
+        if self.args.overrides:
+            update_from_arguments(self.args.overrides)
+
+    # -- model loading ------------------------------------------------------
+    def _load_module(self, spec):
+        """Import a workflow module from a file path or dotted name
+        (ref ``_load_model`` ``__main__.py:396-425``)."""
+        if os.path.exists(spec):
+            name = os.path.splitext(os.path.basename(spec))[0]
+            modspec = importlib.util.spec_from_file_location(name, spec)
+            module = importlib.util.module_from_spec(modspec)
+            sys.modules[name] = module
+            modspec.loader.exec_module(module)
+            return module
+        return importlib.import_module(spec)
+
+    def _construct(self):
+        """Build launcher + workflow from the module or a snapshot."""
+        launcher_kwargs = {
+            "listen": self.args.listen,
+            "master_address": self.args.master_address,
+            "device": self.args.device,
+            "testing": self.args.test,
+            "graphics": self.args.graphics,
+            "web_status": self.args.web_status,
+        }
+        if self.args.snapshot:
+            from veles_tpu.snapshotter import load_snapshot
+            self.workflow = load_snapshot(self.args.snapshot)
+            self.launcher = Launcher(self.workflow, **launcher_kwargs)
+            self.info("resumed workflow from %s", self.args.snapshot)
+            return
+        if not self.args.workflow:
+            raise SystemExit("no workflow given (and no --snapshot)")
+        self.module = self._load_module(self.args.workflow)
+        if hasattr(self.module, "run"):
+            self._construct_via_run(launcher_kwargs)
+        elif hasattr(self.module, "create_workflow"):
+            self.launcher = Launcher(**launcher_kwargs)
+            self.workflow = self.module.create_workflow(
+                launcher=self.launcher)
+            if self.workflow.launcher is not self.launcher:
+                self.workflow.launcher = self.launcher
+        else:
+            raise SystemExit(
+                "workflow module %r defines neither run(load, main) nor "
+                "create_workflow(...)" % self.args.workflow)
+
+    def _construct_via_run(self, launcher_kwargs):
+        """The reference convention: module.run(load, main)
+        (``__main__.py:591-715``)."""
+        main_self = self
+
+        def load(workflow_class, **kwargs):
+            main_self.launcher = Launcher(**launcher_kwargs)
+            main_self.workflow = workflow_class(
+                main_self.launcher, **kwargs)
+            return main_self.workflow, None
+
+        def main(**kwargs):
+            main_self.launcher.initialize(**kwargs)
+            if not main_self.args.dry_run:
+                main_self.launcher.run()
+
+        self.module.run(load, main)
+
+    # -- run ----------------------------------------------------------------
+    def run(self):
+        args = self._parse()
+        self._setup_logging()
+        self._seed_random()
+        self._apply_config()
+        if args.optimize:
+            return self._run_optimization()
+        if args.ensemble_train or args.ensemble_test:
+            return self._run_ensemble()
+        self._construct()
+        if args.result_file:
+            self.workflow.result_file = args.result_file
+        if self.workflow is not None and \
+                not getattr(self.workflow, "_is_initialized", False) \
+                and self.launcher is not None:
+            self.launcher.initialize()
+        if args.workflow_graph and self.workflow is not None:
+            with open(args.workflow_graph, "w") as fout:
+                fout.write(self.workflow.generate_graph())
+            self.info("wrote workflow graph to %s", args.workflow_graph)
+        if args.dry_run:
+            self.info("dry run (%s) complete", args.dry_run)
+            return 0
+        if self.module is None or not hasattr(self.module, "run"):
+            # run() convention already ran inside _construct_via_run
+            self.launcher.run()
+        if args.result_file and self.workflow is not None:
+            self.workflow.write_results(args.result_file)
+        return 0
+
+    def _run_optimization(self):
+        """--optimize SIZE[:GENERATIONS] (ref ``__main__.py:334``)."""
+        from veles_tpu.genetics import GeneticsOptimizer
+        size, _, generations = self.args.optimize.partition(":")
+        optimizer = GeneticsOptimizer(
+            workflow_spec=self.args.workflow,
+            config_file=self.args.config,
+            population_size=int(size),
+            generations=int(generations) if generations else None,
+            result_file=self.args.result_file or None)
+        best = optimizer.run()
+        self.info("best config: %s fitness=%s", best.config_overrides,
+                  best.fitness)
+        return 0
+
+    def _run_ensemble(self):
+        from veles_tpu.ensemble import (
+            EnsembleModelManager, EnsembleTestManager)
+        if self.args.ensemble_train:
+            n, _, ratio = self.args.ensemble_train.partition(":")
+            manager = EnsembleModelManager(
+                workflow_spec=self.args.workflow,
+                config_file=self.args.config,
+                size=int(n), train_ratio=float(ratio or 1.0),
+                result_file=self.args.result_file or None)
+        else:
+            manager = EnsembleTestManager(
+                workflow_spec=self.args.workflow,
+                config_file=self.args.config,
+                input_file=self.args.ensemble_test,
+                result_file=self.args.result_file or None)
+        manager.run()
+        return 0
+
+
+def __run__():
+    sys.exit(Main().run())
+
+
+if __name__ == "__main__":
+    __run__()

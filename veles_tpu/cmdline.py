@@ -1,0 +1,104 @@
+"""Distributed argument registry (ref ``veles/cmdline.py:61-232``).
+
+The reference lets any class with the ``CommandLineArgumentsRegistry``
+metaclass contribute an ``init_parser`` to one global argparse parser, so
+the CLI surface is assembled from the components that are actually in the
+process.  We keep that shape: components call :func:`register_arguments`
+(or use the :class:`CommandLineArgumentsRegistry` metaclass and define a
+static ``init_parser(parser)``), and :func:`make_parser` folds every
+contribution into one parser.
+"""
+
+import argparse
+
+#: Registered contributor callables ``f(parser) -> None``.
+_CONTRIBUTORS = []
+_SEEN = set()
+
+
+def register_arguments(contributor):
+    """Register an ``init_parser``-style contributor (idempotent)."""
+    key = getattr(contributor, "__qualname__", None) or id(contributor)
+    if key in _SEEN:
+        return contributor
+    _SEEN.add(key)
+    _CONTRIBUTORS.append(contributor)
+    return contributor
+
+
+class CommandLineArgumentsRegistry(type):
+    """Metaclass mirror of ``cmdline.py:61``: classes defining
+    ``init_parser(parser)`` auto-contribute it at class-creation time."""
+
+    def __init__(cls, name, bases, namespace):
+        super(CommandLineArgumentsRegistry, cls).__init__(
+            name, bases, namespace)
+        init_parser = namespace.get("init_parser")
+        if init_parser is not None:
+            fn = init_parser.__func__ if isinstance(
+                init_parser, staticmethod) else init_parser
+            register_arguments(fn)
+
+
+def make_parser(prog="veles_tpu", description=None):
+    """Build the composite parser: core args + every registered
+    contributor (ref ``cmdline.py:125-232``)."""
+    parser = argparse.ArgumentParser(
+        prog=prog,
+        description=description or
+        "TPU-native VELES: run a workflow standalone, as master, or as "
+        "slave.",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument(
+        "workflow", nargs="?", default=None,
+        help="workflow python file or dotted module "
+             "(e.g. veles_tpu.samples.mnist)")
+    parser.add_argument(
+        "config", nargs="?", default=None,
+        help="optional config python file exec'd against root.*")
+    parser.add_argument(
+        "overrides", nargs="*", default=[], metavar="key=value",
+        help="dotted root.* config overrides, JSON-parsed values")
+    parser.add_argument(
+        "-v", "--verbosity", default="info",
+        choices=["debug", "info", "warning", "error"],
+        help="log level")
+    parser.add_argument(
+        "--debug", default="", metavar="CLASS,...",
+        help="comma-separated class names forced to DEBUG "
+             "(ref __main__.py:833-835)")
+    parser.add_argument(
+        "-r", "--random-seed", default=None,
+        help="seed for the named PRNG streams (int, or path[:dtype:count] "
+             "to a seed file; ref prng/random_generator.py:106)")
+    parser.add_argument(
+        "-w", "--snapshot", default="",
+        help="resume from a snapshot file (ref __main__.py:539-590)")
+    parser.add_argument(
+        "--test", action="store_true",
+        help="run in evaluation mode instead of training")
+    parser.add_argument(
+        "--result-file", default="",
+        help="write gathered IResultProvider results JSON here "
+             "(ref workflow.py:827-851)")
+    parser.add_argument(
+        "--dry-run", default="", choices=["", "init", "exec"],
+        help="'init': construct+initialize only; 'exec': also compile "
+             "the fused step without running epochs")
+    parser.add_argument(
+        "--workflow-graph", default="",
+        help="write the unit graph in DOT format to this path "
+             "(ref workflow.py:628)")
+    parser.add_argument(
+        "--optimize", default="", metavar="SIZE[:GENERATIONS]",
+        help="genetic hyperparameter optimization over config Tuneables "
+             "(ref cmdline.py:183-190)")
+    parser.add_argument(
+        "--ensemble-train", default="", metavar="N:RATIO",
+        help="train an ensemble of N models on RATIO-sized train subsets")
+    parser.add_argument(
+        "--ensemble-test", default="", metavar="INPUT_JSON",
+        help="evaluate a trained ensemble listed in INPUT_JSON")
+    for contribute in list(_CONTRIBUTORS):
+        contribute(parser)
+    return parser

@@ -1,0 +1,219 @@
+"""Launcher: mode selection and workflow lifecycle (ref
+``veles/launcher.py:100-906``).
+
+The reference's Launcher owns the Twisted reactor, picks
+standalone/master/slave from ``-l``/``-m`` flags (``launcher.py:333-356``),
+boots graphics + web status, selects the device, initializes the workflow
+and runs it.  The TPU re-design needs no reactor: ``Workflow.run`` is a
+synchronous drain loop, the distributed layer is the threaded ZeroMQ job
+server/client (:mod:`veles_tpu.parallel.jobs`), and on-pod data
+parallelism lives *inside* the jitted step — so the Launcher here is the
+thin conductor the units consult (``is_master``/``is_slave``/
+``is_standalone``/``device``/``stop``), not an event loop.
+"""
+
+import json
+import os
+import threading
+import time
+
+from veles_tpu.cmdline import CommandLineArgumentsRegistry
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+
+
+class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
+    """Conducts one workflow run in one of three modes
+    (ref ``manualrst_veles_modes.rst:4-23``):
+
+    - **standalone** (default): initialize device + workflow, run to
+      completion in this process.
+    - **master** (``listen`` address given): never executes the graph
+      body; serves jobs to slaves via :class:`JobServer`
+      (ref ``workflow.py:350-354``).
+    - **slave** (``master_address`` given): connects a
+      :class:`JobClient` and executes jobs until the master says
+      ``no_more_jobs``.
+    """
+
+    def __init__(self, workflow=None, **kwargs):
+        super(Launcher, self).__init__()
+        self.listen = kwargs.get("listen", "")
+        self.master_address = kwargs.get("master_address", "")
+        if self.listen and self.master_address:
+            raise ValueError("cannot be both master (listen) and slave "
+                             "(master_address)")
+        self.device_spec = kwargs.get("device",
+                                      root.common.engine.get("backend",
+                                                             "auto"))
+        self.testing = kwargs.get("testing", False)
+        self.web_status_enabled = kwargs.get("web_status", False)
+        self.graphics_enabled = kwargs.get("graphics", False)
+        self.stopped = False
+        self.device = None
+        self.workflow = None
+        self._server = None
+        self._client = None
+        self._web_status = None
+        self._graphics = None
+        self._start_time = None
+        if workflow is not None:
+            workflow.launcher = self
+
+    @staticmethod
+    def init_parser(parser):
+        group = parser.add_argument_group("launcher")
+        group.add_argument(
+            "-l", "--listen", default="", metavar="HOST:PORT",
+            help="run as MASTER, listening for slaves here "
+                 "(ref launcher.py:194-268)")
+        group.add_argument(
+            "-m", "--master-address", default="", metavar="HOST:PORT",
+            help="run as SLAVE of this master")
+        group.add_argument(
+            "-d", "--device", default="auto",
+            help="backend: auto | tpu | cpu | numpy "
+                 "(ref backends.py:352)")
+        group.add_argument(
+            "-p", "--graphics", action="store_true",
+            help="launch the detached plotting client")
+        group.add_argument(
+            "--web-status", action="store_true",
+            help="start the web status server (ref web_status.py:113)")
+
+    # -- mode flags (consulted by Workflow/units) ---------------------------
+    @property
+    def is_master(self):
+        return bool(self.listen)
+
+    @property
+    def is_slave(self):
+        return bool(self.master_address)
+
+    @property
+    def is_standalone(self):
+        return not (self.is_master or self.is_slave)
+
+    @property
+    def mode(self):
+        return ("master" if self.is_master else
+                "slave" if self.is_slave else "standalone")
+
+    # -- workflow registration (Workflow.launcher setter calls these) -------
+    def add_ref(self, workflow):
+        self.workflow = workflow
+
+    def del_ref(self, workflow):
+        if self.workflow is workflow:
+            self.workflow = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def initialize(self, **kwargs):
+        """Pick the device, boot services, initialize the workflow in
+        dependency order (ref ``launcher.py:431-524``).  The master holds
+        canonical state but never runs kernels, so it gets the cheap
+        numpy device (ref: master never calls ``run()``,
+        ``workflow.py:350-354``)."""
+        if self.workflow is None:
+            raise RuntimeError("no workflow attached to this launcher")
+        from veles_tpu.backends import Device
+        spec = "numpy" if self.is_master else self.device_spec
+        self.device = kwargs.pop("device", None) or Device.create(spec)
+        self.info("%s mode; device=%s", self.mode, self.device)
+        if self.graphics_enabled and not self.is_master:
+            from veles_tpu.graphics_server import GraphicsServer
+            self._graphics = GraphicsServer.launch()
+        if self.web_status_enabled:
+            from veles_tpu.web_status import WebStatus
+            self._web_status = WebStatus(
+                host=root.common.web.host, port=root.common.web.port)
+            self._web_status.start()
+        self.workflow.initialize(device=self.device, **kwargs)
+        return self
+
+    def run(self):
+        """Run to completion in the selected mode and return the
+        workflow (ref ``launcher.py:550-616``)."""
+        self._start_time = time.time()
+        try:
+            if self.is_master:
+                self._run_master()
+            elif self.is_slave:
+                self._run_slave()
+            else:
+                self.workflow.run()
+        finally:
+            self.stopped = True
+            self._teardown()
+        return self.workflow
+
+    def _run_master(self):
+        from veles_tpu.parallel.jobs import JobServer
+        host, port = _split_endpoint(self.listen)
+        self._server = JobServer(self.workflow, port=port, host=host)
+        finished = threading.Event()
+        self._server.on_finished = finished.set
+        self._server.start()
+        self.info("master serving jobs on %s", self._server.endpoint)
+        while not finished.is_set() and not self.stopped:
+            finished.wait(0.2)
+        self._server.print_stats()
+        self._server.stop()
+
+    def _run_slave(self):
+        from veles_tpu.parallel.jobs import JobClient
+        host, port = _split_endpoint(self.master_address)
+        self._client = JobClient(
+            self.workflow, "tcp://%s:%d" % (host, port))
+        self._client.handshake()
+        self._client.run()
+        self._client.close()
+
+    def stop(self):
+        self.stopped = True
+        if self.workflow is not None:
+            self.workflow.stop()
+        if self._server is not None:
+            self._server.stop()
+
+    def on_workflow_finished(self):
+        self.stopped = True
+
+    def _teardown(self):
+        if self._web_status is not None:
+            self._web_status.stop()
+        if self._graphics is not None:
+            self._graphics.stop()
+        if self.workflow is not None and self._start_time is not None:
+            self.info("workflow finished in %.1f s (%s mode)",
+                      time.time() - self._start_time, self.mode)
+            stats = self.workflow.get_unit_run_time_stats()
+            if stats:
+                self.workflow.print_stats()
+
+    # -- status payload (ref launcher.py:852-886) ---------------------------
+    def status(self):
+        wf = self.workflow
+        return {
+            "mode": self.mode,
+            "stopped": self.stopped,
+            "device": str(self.device),
+            "workflow": type(wf).__name__ if wf is not None else None,
+            "slaves": ([s.__dict__.copy()
+                        for s in self._server.slaves.values()]
+                       if self._server is not None else []),
+            "uptime": (time.time() - self._start_time
+                       if self._start_time else 0.0),
+            "pid": os.getpid(),
+        }
+
+    def status_json(self):
+        return json.dumps(self.status(), default=str)
+
+
+def _split_endpoint(spec):
+    """'host:port' | ':port' | 'port' → (host, int(port))."""
+    host, sep, port = str(spec).rpartition(":")
+    if not sep:
+        host = ""
+    return host or "127.0.0.1", int(port)

@@ -169,10 +169,11 @@ class JobServer(Logger):
         if self._no_more_jobs:
             self._send(identity, {"op": "no_more_jobs"})
             return
+        from veles_tpu.workflow import NoMoreJobs
         with self._lock:
             try:
                 data = self.workflow.generate_data_for_slave(slave)
-            except StopIteration:
+            except (StopIteration, NoMoreJobs):
                 data = None
         if data is None:
             self._no_more_jobs = True

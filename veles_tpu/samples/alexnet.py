@@ -78,8 +78,7 @@ def synthetic_imagenet_batch(batch, seed=0):
     return x, labels
 
 
-def build_fused(batch=None, mesh=None, layers=None,
-                input_shape=INPUT_SHAPE):
+def build_fused(mesh=None, layers=None, input_shape=INPUT_SHAPE):
     """(params, jitted step) — single-device jit, or data-parallel over
     ``mesh`` when given."""
     import jax

@@ -68,8 +68,10 @@ def create_workflow(device=None, max_epochs=40, minibatch_size=100,
         layers=[{**spec} for spec in (layers or LAYERS)],
         decision_config={"max_epochs": max_epochs},
         **kwargs)
-    wf.launcher = DummyLauncher()
-    wf.initialize(device=device or AutoDevice())
+    launcher = kwargs.pop("launcher", None)
+    wf.launcher = launcher if launcher is not None else DummyLauncher()
+    if launcher is None:
+        wf.initialize(device=device or AutoDevice())
     return wf
 
 

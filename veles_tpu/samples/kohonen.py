@@ -84,8 +84,10 @@ class KohonenWorkflow(Workflow):
 
 def create_workflow(device=None, **kwargs):
     wf = KohonenWorkflow(None, **kwargs)
-    wf.launcher = DummyLauncher()
-    wf.initialize(device=device or AutoDevice())
+    launcher = kwargs.pop("launcher", None)
+    wf.launcher = launcher if launcher is not None else DummyLauncher()
+    if launcher is None:
+        wf.initialize(device=device or AutoDevice())
     return wf
 
 

@@ -54,8 +54,10 @@ def create_workflow(device=None, max_epochs=25, minibatch_size=100,
                             "prefix": "mnist"}
         if snapshot_dir else None,
         **kwargs)
-    wf.launcher = DummyLauncher()
-    wf.initialize(device=device or AutoDevice())
+    launcher = kwargs.pop("launcher", None)
+    wf.launcher = launcher if launcher is not None else DummyLauncher()
+    if launcher is None:
+        wf.initialize(device=device or AutoDevice())
     return wf
 
 

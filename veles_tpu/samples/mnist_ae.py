@@ -85,8 +85,10 @@ def create_workflow(device=None, max_epochs=15, minibatch_size=100,
         loss_function="mse",
         decision_config={"max_epochs": max_epochs},
         **kwargs)
-    wf.launcher = DummyLauncher()
-    wf.initialize(device=device or AutoDevice())
+    launcher = kwargs.pop("launcher", None)
+    wf.launcher = launcher if launcher is not None else DummyLauncher()
+    if launcher is None:
+        wf.initialize(device=device or AutoDevice())
     return wf
 
 

@@ -43,7 +43,9 @@ class Workflow(Unit):
         self._sync_ = None
         self.result_file = kwargs.get("result_file")
         super(Workflow, self).__init__(workflow, **kwargs)
-        self._launcher = kwargs.get("launcher")
+        self._launcher = None
+        if kwargs.get("launcher") is not None:
+            self.launcher = kwargs["launcher"]  # setter → add_ref
         self.stopped = False
         self._run_time = 0.0
         self.start_point = StartPoint(self)
@@ -101,7 +103,16 @@ class Workflow(Unit):
 
     @launcher.setter
     def launcher(self, value):
+        old = getattr(self, "_launcher", None)
+        if old is not None and old is not value:
+            del_ref = getattr(old, "del_ref", None)
+            if del_ref is not None:
+                del_ref(self)
         self._launcher = value
+        if value is not None:
+            add_ref = getattr(value, "add_ref", None)
+            if add_ref is not None:
+                add_ref(self)
 
     @property
     def is_master(self):

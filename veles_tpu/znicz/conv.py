@@ -53,9 +53,10 @@ class Conv(ForwardBase):
     def pure(params, x, padding=(0, 0, 0, 0), sliding=(1, 1),
              activation=None):
         left, right, top, bottom = padding
+        # sliding is (x, y) like the reference; NHWC strides are (H, W)
         out = jax.lax.conv_general_dilated(
             x, params["w"],
-            window_strides=sliding,
+            window_strides=(sliding[1], sliding[0]),
             padding=((top, bottom), (left, right)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             preferred_element_type=jnp.float32)

@@ -61,6 +61,13 @@ def lower_specs(layer_specs, sample_shape, loss="softmax"):
             if "w" in state else None
         state["vb"] = numpy.zeros_like(state["b"]) \
             if "b" in state else None
+        if "seed" in state:
+            # fresh per-stage stream; step_fn then advances it every
+            # step so fused dropout/stochastic-pooling masks differ
+            # across iterations (the eager path draws per run() instead)
+            from veles_tpu import prng
+            state["seed"] = numpy.int32(
+                prng.get("dropout").randint(0, 2 ** 30))
         params.append(state)
         probe = unit.output
     del wf
@@ -127,6 +134,10 @@ def lower_specs(layer_specs, sample_shape, loss="softmax"):
                     gwb["b"] + hyper["decay_b"] * state["b"])
                 new_state["b"] = state["b"] + v
                 new_state["vb"] = v
+            if "seed" in state:
+                # advance the stage's mask stream (int32, wrap-safe)
+                new_state["seed"] = jnp.int32(
+                    (state["seed"] + 1) & 0x3fffffff)
             new_list.append(new_state)
         return new_list, {"loss": report, "n_err": n_err}
 

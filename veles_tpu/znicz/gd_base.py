@@ -35,6 +35,13 @@ class GDViaVJP(GradientDescentBase):
         self.forward = None
         self.demand("forward")
 
+    def init_unpickled(self):
+        super(GDViaVJP, self).init_unpickled()
+        # Built once per (unit, backend) — _step_fn returns a fresh
+        # closure, so rebuilding per run() would defeat the jit cache
+        # and recompile every training step.
+        self._compute_ = None
+
     def setup_from_forward(self, forward):
         self.forward = forward
         # weights/bias are (possibly still-empty) Vectors at graph
@@ -113,8 +120,10 @@ class GDViaVJP(GradientDescentBase):
         """One backward step (jit path for both device kinds — XLA on
         CPU is the NumpyDevice story for AD-derived units)."""
         interpret = self.is_interpret
-        compute = self._step_fn() if interpret \
-            else self.jit(self._step_fn())
+        if self._compute_ is None:
+            fn = self._step_fn()
+            self._compute_ = fn if interpret else self.jit(fn)
+        compute = self._compute_
         x = jnp.asarray(self.input.mem) if interpret \
             else self.input.devmem
         err_output = jnp.asarray(self.err_output.mem) if interpret \

@@ -57,8 +57,9 @@ class Deconv(ForwardBase):
         # are offset by k-1
         pad = ((ky - 1 - top, ky - 1 - bottom),
                (kx - 1 - left, kx - 1 - right))
+        # sliding is (x, y) like the reference; NHWC strides are (H, W)
         out = jax.lax.conv_transpose(
-            x, params["w"], strides=sliding, padding=pad,
+            x, params["w"], strides=(sliding[1], sliding[0]), padding=pad,
             dimension_numbers=("NHWC", "HWOI", "NHWC"),
             preferred_element_type=jnp.float32)
         return _ACT[activation](out).astype(x.dtype)
