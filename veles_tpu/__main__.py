@@ -201,7 +201,11 @@ class Main(Logger):
 
     def _run_optimization(self):
         """--optimize SIZE[:GENERATIONS] (ref ``__main__.py:334``)."""
-        from veles_tpu.genetics import GeneticsOptimizer
+        try:
+            from veles_tpu.genetics import GeneticsOptimizer
+        except ImportError:
+            raise SystemExit(
+                "--optimize requires veles_tpu.genetics")
         size, _, generations = self.args.optimize.partition(":")
         optimizer = GeneticsOptimizer(
             workflow_spec=self.args.workflow,
@@ -215,8 +219,12 @@ class Main(Logger):
         return 0
 
     def _run_ensemble(self):
-        from veles_tpu.ensemble import (
-            EnsembleModelManager, EnsembleTestManager)
+        try:
+            from veles_tpu.ensemble import (
+                EnsembleModelManager, EnsembleTestManager)
+        except ImportError:
+            raise SystemExit(
+                "--ensemble-* requires veles_tpu.ensemble")
         if self.args.ensemble_train:
             n, _, ratio = self.args.ensemble_train.partition(":")
             manager = EnsembleModelManager(

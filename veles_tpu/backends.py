@@ -152,18 +152,6 @@ class Device(Pickleable, metaclass=BackendRegistry):
     def __repr__(self):
         return "<%s model=%s>" % (type(self).__name__, self.model)
 
-    @staticmethod
-    def create(spec):
-        """Instantiate a backend by name (``--backend/-d`` parsing, ref
-        ``backends.py:352``): "auto" | "tpu" | "cpu" | "numpy"."""
-        name = (spec or "auto").lower()
-        klass = BackendRegistry.backends.get(name)
-        if klass is None:
-            raise ValueError(
-                "unknown backend %r (have: %s)" % (
-                    spec, ", ".join(sorted(BackendRegistry.backends))))
-        return klass()
-
 
 class _JaxDevice(Device):
     """Shared machinery for XLA-backed devices (TPU and CPU)."""

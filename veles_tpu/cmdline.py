@@ -18,7 +18,8 @@ _SEEN = set()
 
 def register_arguments(contributor):
     """Register an ``init_parser``-style contributor (idempotent)."""
-    key = getattr(contributor, "__qualname__", None) or id(contributor)
+    key = (getattr(contributor, "__module__", ""),
+           getattr(contributor, "__qualname__", None) or id(contributor))
     if key in _SEEN:
         return contributor
     _SEEN.add(key)
@@ -82,9 +83,9 @@ def make_parser(prog="veles_tpu", description=None):
         help="write gathered IResultProvider results JSON here "
              "(ref workflow.py:827-851)")
     parser.add_argument(
-        "--dry-run", default="", choices=["", "init", "exec"],
-        help="'init': construct+initialize only; 'exec': also compile "
-             "the fused step without running epochs")
+        "--dry-run", default="", choices=["", "init"],
+        help="construct + initialize the workflow, then exit without "
+             "training")
     parser.add_argument(
         "--workflow-graph", default="",
         help="write the unit graph in DOT format to this path "

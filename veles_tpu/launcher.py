@@ -43,9 +43,8 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         if self.listen and self.master_address:
             raise ValueError("cannot be both master (listen) and slave "
                              "(master_address)")
-        self.device_spec = kwargs.get("device",
-                                      root.common.engine.get("backend",
-                                                             "auto"))
+        # None → make_device falls back to root.common.engine.backend
+        self.device_spec = kwargs.get("device")
         self.testing = kwargs.get("testing", False)
         self.web_status_enabled = kwargs.get("web_status", False)
         self.graphics_enabled = kwargs.get("graphics", False)
@@ -71,9 +70,9 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "-m", "--master-address", default="", metavar="HOST:PORT",
             help="run as SLAVE of this master")
         group.add_argument(
-            "-d", "--device", default="auto",
-            help="backend: auto | tpu | cpu | numpy "
-                 "(ref backends.py:352)")
+            "-d", "--device", default=None,
+            help="backend: auto | tpu | cpu | numpy; default: "
+                 "root.common.engine.backend (ref backends.py:352)")
         group.add_argument(
             "-p", "--graphics", action="store_true",
             help="launch the detached plotting client")
@@ -116,9 +115,9 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         ``workflow.py:350-354``)."""
         if self.workflow is None:
             raise RuntimeError("no workflow attached to this launcher")
-        from veles_tpu.backends import Device
+        from veles_tpu.backends import make_device
         spec = "numpy" if self.is_master else self.device_spec
-        self.device = kwargs.pop("device", None) or Device.create(spec)
+        self.device = kwargs.pop("device", None) or make_device(spec)
         self.info("%s mode; device=%s", self.mode, self.device)
         if self.graphics_enabled and not self.is_master:
             from veles_tpu.graphics_server import GraphicsServer
@@ -183,7 +182,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         if self._web_status is not None:
             self._web_status.stop()
         if self._graphics is not None:
-            self._graphics.stop()
+            self._graphics.shutdown()
         if self.workflow is not None and self._start_time is not None:
             self.info("workflow finished in %.1f s (%s mode)",
                       time.time() - self._start_time, self.mode)

@@ -18,3 +18,6 @@ merging, ``server.py``/``client.py``) with the BASELINE.json north star:
 from veles_tpu.parallel.mesh import (  # noqa: F401
     make_mesh, replicated, shard_batch)
 from veles_tpu.parallel.dp import data_parallel  # noqa: F401
+from veles_tpu.parallel.ring import (  # noqa: F401
+    mha_reference, ring_attention, ulysses_attention)
+from veles_tpu.parallel.pp import pipeline_apply  # noqa: F401

@@ -1,0 +1,159 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no attention at all (SURVEY §5.7) — this is the
+beyond-parity capability the TPU build treats as first-class: long
+sequences are sharded over a ``seq`` mesh axis and attention runs
+either as
+
+* **ring attention** (:func:`ring_attention`): each device keeps its
+  query shard resident and streams every key/value shard past it around
+  the ICI ring with ``ppermute``, combining blocks with the
+  numerically-stable online-softmax (flash-attention) update.  Memory
+  per chip is O(S/n); comms overlap with the block matmuls under XLA's
+  latency-hiding scheduler.
+* **Ulysses** (:func:`ulysses_attention`): two ``all_to_all``s re-shard
+  activations seq-sharded → head-sharded, run dense local attention on
+  full sequences for the local head group, and shard back.  Cheaper at
+  moderate S (2 collectives instead of n-1 hops) but caps the seq-axis
+  size at the head count.
+
+Both are exact (== dense attention) — tested against
+:func:`mha_reference` on the virtual CPU mesh.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def mha_reference(q, k, v, causal=False, q_offset=0, k_offset=0):
+    """Dense multi-head attention, the golden reference.
+
+    Shapes: q [B, Sq, H, D], k/v [B, Sk, H, D] → [B, Sq, H, D].
+    ``q_offset``/``k_offset`` are the global positions of element 0 —
+    how causal masks stay correct when q/k are shards of a longer
+    sequence.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])[:, None]
+        kpos = k_offset + jnp.arange(k.shape[1])[None, :]
+        scores = jnp.where(qpos >= kpos, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _block_update(carry, q, k_blk, v_blk, mask):
+    """Online-softmax accumulation of one K/V block (the flash-attention
+    inner update)."""
+    o, m, l = carry
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask, scores, NEG_INF)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    # fully-masked rows: keep p exactly zero (exp(NEG_INF-NEG_INF)=1)
+    p = jnp.where(mask, p, 0.0)
+    l = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk)
+    o = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return o, m_new, l
+
+
+def _ring_attention_local(q, k, v, axis_name, causal):
+    """Body under shard_map: q/k/v are the local sequence shards."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    q_offset = idx * s_local
+
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full(q.shape[:2] + (q.shape[2],), NEG_INF,
+                 jnp.float32).transpose(0, 2, 1)      # [B, H, Sq]
+    l = jnp.zeros_like(m)
+    qpos = q_offset + jnp.arange(s_local)
+
+    def step(t, carry):
+        o, m, l, k_cur, v_cur = carry
+        # after t forward shifts, device idx holds block (idx - t) mod n
+        blk = (idx - t) % n
+        kpos = blk * s_local + jnp.arange(s_local)
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]        # [Sq, Sk]
+        else:
+            mask = jnp.ones((s_local, s_local), bool)
+        mask = jnp.broadcast_to(
+            mask[None, None], (q.shape[0], q.shape[2]) + mask.shape)
+        o, m, l = _block_update((o, m, l), q, k_cur, v_cur, mask)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return o, m, l, k_nxt, v_nxt
+
+    o, m, l, _k, _v = jax.lax.fori_loop(
+        0, n, step, (o, m, l, k, v), unroll=True)
+    l = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / l).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, causal=False, seq_axis="seq",
+                   batch_axis="data", head_axis=None):
+    """Exact attention over a ``seq``-sharded sequence.
+
+    q/k/v: GLOBAL [B, S, H, D] arrays (or tracers inside an enclosing
+    jit over the same mesh).  B is sharded over ``batch_axis``, S over
+    ``seq_axis``, and optionally H over ``head_axis`` (compose with TP).
+    """
+    spec = P(batch_axis, seq_axis, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=seq_axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def _ulysses_local(q, k, v, axis_name, causal):
+    """Body under shard_map: all-to-all seq-sharded → head-sharded,
+    dense local attention, all-to-all back."""
+    n = jax.lax.psum(1, axis_name)
+
+    def scatter_heads(x):
+        # [B, S/n, H, D] → [B, S, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    del n
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    out = mha_reference(qh, kh, vh, causal=causal)
+    return gather_heads(out)
+
+
+def ulysses_attention(q, k, v, mesh, causal=False, seq_axis="seq",
+                      batch_axis="data"):
+    """All-to-all sequence parallelism (Ulysses).  Requires
+    ``H % mesh.shape[seq_axis] == 0``."""
+    if q.shape[2] % mesh.shape[seq_axis]:
+        raise ValueError(
+            "ulysses needs heads (%d) divisible by seq axis (%d)"
+            % (q.shape[2], mesh.shape[seq_axis]))
+    spec = P(batch_axis, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=seq_axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

@@ -1,0 +1,53 @@
+"""Tensor parallelism helpers (Megatron-style sharding rules).
+
+TP on TPU is declarative: parameters get ``NamedSharding``s over the
+``model`` axis, activations get ``with_sharding_constraint`` hints, and
+GSPMD inserts the all-reduces the reference era hand-coded — column-
+parallel for the first matmul of a pair, row-parallel for the second,
+one ``psum`` at the row-parallel output (ridden on ICI).
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def column_parallel(ndim=2, axis="model"):
+    """Weight [in, out]: shard the OUTPUT features."""
+    spec = [None] * ndim
+    spec[-1] = axis
+    return P(*spec)
+
+
+def row_parallel(ndim=2, axis="model"):
+    """Weight [in, out]: shard the INPUT features (its input activation
+    arrives feature-sharded from a column-parallel producer)."""
+    spec = [None] * ndim
+    spec[0] = axis
+    return P(*spec)
+
+
+def constrain(x, mesh, *spec):
+    """Anchor an activation's layout (GSPMD hint)."""
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec)))
+
+
+def sharding_tree(params, mesh, rule):
+    """Build a NamedSharding pytree: ``rule(path, leaf) -> PartitionSpec
+    or None`` (None → replicate)."""
+    def make(path, leaf):
+        spec = rule(_path_str(path), leaf)
+        return NamedSharding(mesh, spec if spec is not None else P())
+    return jax.tree_util.tree_map_with_path(make, params)
+
+
+def _path_str(path):
+    out = []
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if key is None:
+            key = getattr(entry, "idx", None)
+        if key is None:
+            key = getattr(entry, "name", str(entry))
+        out.append(str(key))
+    return "/".join(out)

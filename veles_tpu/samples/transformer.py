@@ -1,0 +1,241 @@
+"""Decoder-only transformer LM — the long-context / multi-way-parallel
+flagship.
+
+The reference's layer zoo stops at LSTM-era units (SURVEY §5.7: no
+attention); this family is the TPU build's beyond-parity capability and
+the vehicle for the first-class parallelism requirements: one fused
+train step composing
+
+* **DP**  — batch on the ``data`` axis,
+* **TP**  — heads / MLP hidden on the ``model`` axis
+            (Megatron column→row pairs via GSPMD shardings),
+* **SP**  — sequence on the ``seq`` axis with exact
+            :func:`~veles_tpu.parallel.ring.ring_attention`
+            (flash-style online softmax + ``ppermute`` ring).
+
+Blocks are stacked on a leading layer axis and scanned (`lax.scan`) so
+compile time is O(1) in depth; `jax.checkpoint` on the block body
+rematerializes activations in backward (HBM-bound regime).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from veles_tpu.parallel.mesh import replicated
+from veles_tpu.parallel.ring import mha_reference, ring_attention
+
+CONFIG = {
+    "vocab": 32000, "dim": 1024, "heads": 16, "layers": 12,
+    "mlp_ratio": 4, "seq_len": 2048,
+}
+TINY = {
+    "vocab": 64, "dim": 32, "heads": 4, "layers": 2,
+    "mlp_ratio": 2, "seq_len": 16,
+}
+
+
+def init_params(cfg, seed=0, dtype=numpy.float32):
+    """Stacked-block GPT params (leading axis = layer for lax.scan)."""
+    rng = numpy.random.default_rng(seed)
+    d, h, L = cfg["dim"], cfg["heads"], cfg["layers"]
+    dh = d // h
+    f = cfg["mlp_ratio"] * d
+
+    def norm(*shape, scale):
+        return (rng.standard_normal(shape) * scale).astype(dtype)
+
+    return {
+        "embed": norm(cfg["vocab"], d, scale=0.02),
+        "pos": norm(cfg["seq_len"], d, scale=0.02),
+        "blocks": {
+            "ln1_g": numpy.ones((L, d), dtype), "ln1_b":
+                numpy.zeros((L, d), dtype),
+            "wqkv": norm(L, d, 3, h, dh, scale=1 / math.sqrt(d)),
+            "wo": norm(L, h, dh, d, scale=1 / math.sqrt(d) /
+                       math.sqrt(2 * L)),
+            "ln2_g": numpy.ones((L, d), dtype), "ln2_b":
+                numpy.zeros((L, d), dtype),
+            "w1": norm(L, d, f, scale=1 / math.sqrt(d)),
+            "b1": numpy.zeros((L, f), dtype),
+            "w2": norm(L, f, d, scale=1 / math.sqrt(f) /
+                       math.sqrt(2 * L)),
+            "b2": numpy.zeros((L, d), dtype),
+        },
+        "lnf_g": numpy.ones((d,), dtype),
+        "lnf_b": numpy.zeros((d,), dtype),
+    }
+
+
+def _layernorm(x, g, b):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+
+def _attend(q, k, v, mesh, seq_axis):
+    if mesh is not None and seq_axis and mesh.shape.get(seq_axis, 1) > 1:
+        return ring_attention(q, k, v, mesh, causal=True,
+                              seq_axis=seq_axis, batch_axis="data",
+                              head_axis="model"
+                              if mesh.shape.get("model", 1) > 1
+                              else None)
+    return mha_reference(q, k, v, causal=True)
+
+
+def _block(h, blk, mesh, seq_axis, compute_dtype):
+    """One pre-LN transformer block; wqkv [d,3,H,dh], wo [H,dh,d]."""
+    B, S, d = h.shape
+    x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
+    qkv = jnp.einsum("bsd,dchx->bschx", x.astype(compute_dtype),
+                     blk["wqkv"].astype(compute_dtype),
+                     preferred_element_type=jnp.float32)
+    if mesh is not None and mesh.shape.get("model", 1) > 1:
+        qkv = jax.lax.with_sharding_constraint(
+            qkv, NamedSharding(
+                mesh, P("data", seq_axis, None, "model", None)))
+    q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+    att = _attend(q.astype(compute_dtype), k.astype(compute_dtype),
+                  v.astype(compute_dtype), mesh, seq_axis)
+    proj = jnp.einsum("bshx,hxd->bsd", att.astype(compute_dtype),
+                      blk["wo"].astype(compute_dtype),
+                      preferred_element_type=jnp.float32)
+    h = h + proj.astype(h.dtype)
+    x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
+    up = x.astype(compute_dtype) @ blk["w1"].astype(compute_dtype) \
+        + blk["b1"]
+    if mesh is not None and mesh.shape.get("model", 1) > 1:
+        up = jax.lax.with_sharding_constraint(
+            up, NamedSharding(mesh, P("data", seq_axis, "model")))
+    act = jax.nn.gelu(up)
+    down = act.astype(compute_dtype) @ blk["w2"].astype(compute_dtype) \
+        + blk["b2"]
+    return h + down.astype(h.dtype)
+
+
+def apply_fn(params, tokens, cfg=None, mesh=None, seq_axis="seq",
+             compute_dtype=jnp.bfloat16, remat=True):
+    """tokens [B, S] int32 → logits [B, S, V]."""
+    h = params["embed"][tokens] + params["pos"][: tokens.shape[1]]
+    if mesh is not None:
+        h = jax.lax.with_sharding_constraint(
+            h, NamedSharding(mesh, P("data", seq_axis, None)))
+
+    body = functools.partial(_block, mesh=mesh, seq_axis=seq_axis,
+                             compute_dtype=compute_dtype)
+    if remat:
+        body = jax.checkpoint(body)
+
+    def scan_body(h, blk):
+        return body(h, blk), None
+
+    h, _ = jax.lax.scan(scan_body, h, params["blocks"])
+    h = _layernorm(h, params["lnf_g"], params["lnf_b"])
+    # weight-tied readout (embed^T) keeps the TINY config honest
+    logits = jnp.einsum("bsd,vd->bsv", h.astype(compute_dtype),
+                        params["embed"].astype(compute_dtype),
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+def make_train_step(cfg, mesh=None, seq_axis="seq", lr=3e-4,
+                    compute_dtype=jnp.bfloat16, remat=True):
+    """(params, opt_state, tokens) → next-token CE loss, SGD+momentum
+    update — one XLA program."""
+
+    def loss_fn(params, tokens):
+        logits = apply_fn(params, tokens, cfg, mesh=mesh,
+                          seq_axis=seq_axis,
+                          compute_dtype=compute_dtype, remat=remat)
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+        picked = jnp.take_along_axis(
+            logp, targets[..., None], axis=-1)[..., 0]
+        return -picked.mean()
+
+    def step(params, velocity, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        new_v = jax.tree.map(
+            lambda v, g: 0.9 * v - lr * g, velocity, grads)
+        new_p = jax.tree.map(lambda p, v: p + v, params, new_v)
+        return new_p, new_v, {"loss": loss}
+
+    return step
+
+
+def param_specs(params, seq_axis="seq"):
+    """PartitionSpec pytree: Megatron TP rules for the block weights
+    (qkv/up column-parallel on heads/hidden, out/down row-parallel),
+    everything else replicated."""
+    rules = {
+        "wqkv": P(None, None, None, "model", None),
+        "wo": P(None, "model", None, None),
+        "w1": P(None, None, "model"),
+        "b1": P(None, "model"),
+        "w2": P(None, "model", None),
+    }
+
+    def walk(tree, out):
+        for key, leaf in tree.items():
+            if isinstance(leaf, dict):
+                out[key] = {}
+                walk(leaf, out[key])
+            else:
+                out[key] = rules.get(key, P())
+        return out
+
+    return walk(params, {})
+
+
+def build_train(cfg=None, mesh=None, seq_axis="seq", lr=3e-4,
+                compute_dtype=jnp.bfloat16, remat=True, seed=0):
+    """(params, velocity, jitted step).  With a mesh: DP×TP×SP shardings
+    applied via in/out_shardings; without: plain single-device jit."""
+    cfg = cfg or CONFIG
+    params = init_params(cfg, seed=seed)
+    velocity = jax.tree.map(numpy.zeros_like, params)
+    step = make_train_step(cfg, mesh=mesh, seq_axis=seq_axis, lr=lr,
+                           compute_dtype=compute_dtype, remat=remat)
+    if mesh is None:
+        return params, velocity, jax.jit(step, donate_argnums=(0, 1))
+    specs = param_specs(params, seq_axis)
+    p_shard = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    tok_shard = NamedSharding(mesh, P("data", seq_axis))
+    jitted = jax.jit(
+        step,
+        in_shardings=(p_shard, p_shard, tok_shard),
+        out_shardings=(p_shard, p_shard, replicated(mesh)),
+        donate_argnums=(0, 1))
+    return params, velocity, jitted
+
+
+def synthetic_tokens(cfg, batch, seed=0):
+    rng = numpy.random.default_rng(seed)
+    return rng.integers(0, cfg["vocab"],
+                        (batch, cfg["seq_len"])).astype(numpy.int32)
+
+
+def benchmark(cfg=None, batch=8, steps=5, mesh=None, **kwargs):
+    """Tokens/sec of the fused LM train step."""
+    import time
+    cfg = cfg or CONFIG
+    params, vel, step = build_train(cfg, mesh=mesh, **kwargs)
+    tokens = synthetic_tokens(cfg, batch)
+    params, vel, _m = step(params, vel, tokens)        # compile
+    jax.block_until_ready(params)
+    tic = time.perf_counter()
+    for _ in range(steps):
+        params, vel, metrics = step(params, vel, tokens)
+    jax.block_until_ready(params)
+    elapsed = time.perf_counter() - tic
+    return steps * batch * cfg["seq_len"] / elapsed
+
+
+if __name__ == "__main__":
+    print("LM fused: %.0f tokens/sec" % benchmark())
