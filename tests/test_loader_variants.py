@@ -1,0 +1,274 @@
+"""Loader-variant coverage: image pipeline, format loaders, streaming,
+minibatch capture/replay, InputJoiner/Avatar/MeanDispNormalizer units,
+Downloader (mirrors reference tests: test_image_loader, test_hdf5,
+test_pickles, test_zmq_loader, test_input_joiner,
+test_mean_disp_normalizer)."""
+
+import gzip
+import json
+import os
+import pickle
+import tarfile
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.avatar import Avatar
+from veles_tpu.backends import CPUDevice, NumpyDevice
+from veles_tpu.downloader import Downloader
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.input_joiner import InputJoiner
+from veles_tpu.loader import (
+    AutoLabelFileImageLoader, FileFilter, FullBatchImageLoader,
+    HDF5Loader, InteractiveLoader, MinibatchesLoader, MinibatchesSaver,
+    PicklesLoader, RestfulLoader, TEST, TRAIN, VALID, ZeroMQLoader)
+from veles_tpu.mean_disp_normalizer import MeanDispNormalizer
+from veles_tpu.memory import Vector
+
+
+# -- fixtures ---------------------------------------------------------------
+def _write_images(tmp_path, per_class=3, classes=("cat", "dog"),
+                  size=(12, 10)):
+    from PIL import Image
+    rng = numpy.random.default_rng(3)
+    for cls in classes:
+        d = tmp_path / "train" / cls
+        d.mkdir(parents=True, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 255, (size[1], size[0], 3),
+                               dtype=numpy.uint8)
+            Image.fromarray(arr).save(d / ("img%02d.png" % i))
+    return str(tmp_path / "train")
+
+
+def test_file_filter(tmp_path):
+    (tmp_path / "a.png").write_bytes(b"")
+    (tmp_path / "b.txt").write_bytes(b"")
+    (tmp_path / "skip.png").write_bytes(b"")
+    ff = FileFilter(ignored_files=(r"skip.*",))
+    found = [os.path.basename(p) for p in ff.scan(str(tmp_path))]
+    assert found == ["a.png"]
+
+
+def test_auto_label_image_loader(tmp_path):
+    train_dir = _write_images(tmp_path)
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = AutoLabelFileImageLoader(
+        wf, train_paths=[train_dir], size=(8, 8), minibatch_size=4)
+    loader.initialize(device=wf.device)
+    assert loader.class_lengths[TRAIN] == 6
+    assert sorted(loader.labels_mapping) == ["cat", "dog"]
+    loader.run()
+    assert loader.minibatch_data.shape == (4, 8, 8, 3)
+    assert set(loader.minibatch_labels.mem[:loader.minibatch_size]) \
+        <= {0, 1}
+
+
+def test_image_loader_crop_mirror(tmp_path):
+    train_dir = _write_images(tmp_path, size=(16, 16))
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = AutoLabelFileImageLoader(
+        wf, train_paths=[train_dir], size=(16, 16), crop=(8, 6),
+        mirror=True, color_space="GRAY", minibatch_size=3)
+    loader.initialize(device=wf.device)
+    loader.run()
+    assert loader.minibatch_data.shape == (3, 6, 8, 1)
+
+
+def test_fullbatch_image_loader(tmp_path):
+    train_dir = _write_images(tmp_path)
+    wf = DummyWorkflow()
+    wf.device = CPUDevice()
+    loader = FullBatchImageLoader(
+        wf, train_paths=[train_dir], size=(8, 8), minibatch_size=4,
+        image_loader_class=AutoLabelFileImageLoader)
+    loader.initialize(device=wf.device)
+    assert loader.class_lengths[TRAIN] == 6
+    assert loader.original_data.shape == (6, 8, 8, 3)
+    loader.run()
+    assert loader.minibatch_size == 4
+
+
+def test_hdf5_loader(tmp_path):
+    h5py = pytest.importorskip("h5py")
+    rng = numpy.random.default_rng(5)
+    paths = {}
+    for name, n in (("train", 20), ("valid", 8)):
+        p = str(tmp_path / (name + ".h5"))
+        with h5py.File(p, "w") as f:
+            f["data"] = rng.standard_normal((n, 6)).astype("f4")
+            f["labels"] = rng.integers(0, 3, n)
+        paths[name] = p
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = HDF5Loader(wf, train_path=paths["train"],
+                        validation_path=paths["valid"],
+                        minibatch_size=5)
+    loader.initialize(device=wf.device)
+    assert loader.class_lengths == [0, 8, 20]
+    loader.run()
+    assert loader.minibatch_class == VALID
+
+
+def test_pickles_loader(tmp_path):
+    rng = numpy.random.default_rng(6)
+    p = str(tmp_path / "train.pickle")
+    with open(p, "wb") as f:
+        pickle.dump((rng.standard_normal((15, 4)).astype("f4"),
+                     list(rng.integers(0, 2, 15))), f)
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = PicklesLoader(wf, train_path=p, minibatch_size=6)
+    loader.initialize(device=wf.device)
+    assert loader.class_lengths == [0, 0, 15]
+    loader.run()
+    assert loader.minibatch_size == 6
+
+
+def test_minibatch_save_replay(tmp_path):
+    from tests.test_loader import SyntheticLoader
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    src = SyntheticLoader(wf, minibatch_size=10)
+    src.initialize(device=wf.device)
+    dump = str(tmp_path / "mb.gz")
+    saver = MinibatchesSaver(wf, file_name=dump)
+    saver.minibatch_data = src.minibatch_data
+    saver.minibatch_labels = src.minibatch_labels
+    saver.initialize()
+    for _ in range(10):   # one full epoch (100 samples / 10)
+        src.run()
+        saver.minibatch_class = src.minibatch_class
+        saver.minibatch_size = src.minibatch_size
+        saver.run()
+    saver.stop()
+
+    replay = MinibatchesLoader(wf, file_name=dump)
+    replay.initialize(device=wf.device)
+    assert replay.class_lengths == [20, 30, 50]
+    replay.run()
+    assert replay.minibatch_class == TEST
+    assert replay.minibatch_size == 10
+
+
+def test_interactive_loader():
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = InteractiveLoader(wf, sample_shape=(4,), minibatch_size=8)
+    loader.initialize(device=wf.device)
+    loader.feed(numpy.ones((3, 4)), labels=[0, 1, 0])
+    loader.run()
+    assert loader.minibatch_size == 3
+    assert loader.minibatch_class == TRAIN
+    assert list(loader.minibatch_labels.mem[:3]) == [0, 1, 0]
+    loader.end_epoch()
+    loader.run()
+    assert bool(loader.epoch_ended)
+    assert loader.epoch_number == 1
+
+
+def test_zmq_loader():
+    zmq = pytest.importorskip("zmq")
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = ZeroMQLoader(wf, sample_shape=(2,), minibatch_size=4)
+    loader.initialize(device=wf.device)
+    sock = zmq.Context.instance().socket(zmq.PUSH)
+    sock.connect("tcp://127.0.0.1:%d" % loader.port)
+    sock.send(pickle.dumps(
+        (numpy.full((2, 2), 3.0, numpy.float32), [1, 0])))
+    loader.run()
+    assert loader.minibatch_size == 2
+    assert loader.minibatch_data.mem[0, 0] == 3.0
+    sock.close(0)
+    loader.stop()
+
+
+def test_restful_loader():
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = RestfulLoader(wf, sample_shape=(3,), minibatch_size=4)
+    loader.initialize(device=wf.device)
+    body = json.dumps({"input": [[1, 2, 3]], "labels": [2]}).encode()
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d/feed" % loader.port, data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as resp:
+        assert json.load(resp)["ok"]
+    loader.run()
+    assert loader.minibatch_size == 1
+    assert list(loader.minibatch_data.mem[0]) == [1.0, 2.0, 3.0]
+    loader.stop()
+
+
+# -- units ------------------------------------------------------------------
+@pytest.mark.parametrize("device_class", [NumpyDevice, CPUDevice])
+def test_input_joiner(device_class):
+    wf = DummyWorkflow()
+    wf.device = device_class()
+    a = Vector(numpy.arange(6, dtype=numpy.float32).reshape(3, 2))
+    b = Vector(numpy.arange(12, dtype=numpy.float32).reshape(3, 2, 2))
+    joiner = InputJoiner(wf, inputs=[a, b])
+    joiner.initialize(device=wf.device)
+    joiner.run()
+    joiner.output.map_read()
+    assert joiner.output.shape == (3, 6)
+    numpy.testing.assert_allclose(joiner.output.mem[1],
+                                  [2, 3, 4, 5, 6, 7])
+
+
+def test_avatar():
+    from tests.test_loader import SyntheticLoader
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = SyntheticLoader(wf, minibatch_size=10)
+    loader.initialize(device=wf.device)
+    avatar = Avatar(wf, source=loader)
+    avatar.initialize()
+    loader.run()
+    avatar.run()
+    numpy.testing.assert_array_equal(
+        avatar.minibatch_data.mem, loader.minibatch_data.mem)
+    assert avatar.minibatch_class == loader.minibatch_class
+    # decoupling: producer advances, avatar keeps its copy
+    kept = numpy.array(avatar.minibatch_data.mem)
+    loader.run()
+    numpy.testing.assert_array_equal(avatar.minibatch_data.mem, kept)
+
+
+@pytest.mark.parametrize("device_class", [NumpyDevice, CPUDevice])
+def test_mean_disp_normalizer(device_class):
+    wf = DummyWorkflow()
+    wf.device = device_class()
+    rng = numpy.random.default_rng(9)
+    x = rng.standard_normal((5, 7)).astype(numpy.float32)
+    unit = MeanDispNormalizer(wf)
+    unit.input = Vector(x.copy())
+    unit.mean.mem = x.mean(axis=0)
+    unit.rdisp.mem = (1.0 / (x.max(axis=0) - x.min(axis=0))).astype(
+        numpy.float32)
+    unit.input.initialize(wf.device)
+    unit.initialize(device=wf.device)
+    unit.run()
+    unit.output.map_read()
+    expected = (x - x.mean(axis=0)) * unit.rdisp.mem
+    numpy.testing.assert_allclose(unit.output.mem, expected, rtol=1e-5)
+
+
+def test_downloader(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "data.txt").write_text("hello")
+    archive = tmp_path / "dataset.tar.gz"
+    with tarfile.open(archive, "w:gz") as tar:
+        tar.add(src / "data.txt", arcname="data.txt")
+    dest = tmp_path / "dest"
+    wf = DummyWorkflow()
+    unit = Downloader(wf, url="file://" + str(archive),
+                      directory=str(dest), files=["data.txt"])
+    unit.initialize()
+    assert (dest / "data.txt").read_text() == "hello"
+    assert unit.already_there

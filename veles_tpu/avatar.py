@@ -1,0 +1,67 @@
+"""Avatar: an in-workflow copy of a loader's served minibatch.
+
+Parity target: reference ``veles/avatar.py:22`` — ``Avatar.clone``
+(``:38``) snapshots the producer's minibatch attributes into its own
+Vectors so a consumer graph is decoupled from the producer graph (the
+producer may already be serving the *next* minibatch while consumers
+still read the previous one — the double-buffering seam in async mode).
+"""
+
+import numpy
+
+from veles_tpu.memory import Vector
+from veles_tpu.units import Unit
+
+#: attributes cloned by value
+SCALAR_ATTRS = ("minibatch_class", "minibatch_size", "minibatch_offset",
+                "epoch_number")
+#: Vector attributes cloned into own buffers
+VECTOR_ATTRS = ("minibatch_data", "minibatch_labels",
+                "minibatch_indices", "minibatch_targets")
+
+
+class Avatar(Unit):
+    """Link after a loader; consumers link to the avatar instead."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Avatar, self).__init__(workflow, **kwargs)
+        self.source = kwargs.get("source")   # the loader
+        self.minibatch_class = 0
+        self.minibatch_size = 0
+        self.minibatch_offset = 0
+        self.epoch_number = 0
+        for attr in VECTOR_ATTRS:
+            setattr(self, attr, Vector())
+        self.demand("source")
+
+    def initialize(self, **kwargs):
+        super(Avatar, self).initialize(**kwargs)
+        for attr in VECTOR_ATTRS:
+            src = getattr(self.source, attr, None)
+            if src is not None and src:
+                src.map_read()
+                getattr(self, attr).reset(numpy.array(src.mem))
+
+    def clone(self):
+        """Copy the source's current minibatch state (ref ``:38``)."""
+        for attr in SCALAR_ATTRS:
+            if hasattr(self.source, attr):
+                setattr(self, attr, getattr(self.source, attr))
+        for attr in VECTOR_ATTRS:
+            src = getattr(self.source, attr, None)
+            mine = getattr(self, attr)
+            if src is None or not src:
+                continue
+            if src.device is not None and not src.device.is_interpret:
+                # device path: reference the producer's immutable
+                # jax.Array — functional arrays need no copy
+                if mine.device is None:
+                    mine.initialize(src.device)
+                mine.devmem = src.devmem
+            else:
+                src.map_read()
+                mine.map_write()
+                mine.mem[...] = src.mem
+
+    def run(self):
+        self.clone()

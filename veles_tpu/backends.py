@@ -306,7 +306,7 @@ class AutoDevice(Device):
 def make_device(backend=None, **kwargs):
     """CLI-style backend selection (ref ``Device.init_parser``
     ``backends.py:352``): ``backend`` is "auto"/"tpu"/"cpu"/"numpy"."""
-    backend = backend or root.common.engine.get("backend", "auto")
+    backend = (backend or root.common.engine.get("backend", "auto")).lower()
     klass = BackendRegistry.backends.get(backend)
     if klass is None:
         raise ValueError(

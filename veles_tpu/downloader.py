@@ -1,0 +1,66 @@
+"""Downloader: dataset fetch + unpack unit.
+
+Parity target: reference ``veles/downloader.py:56`` — wget-based fetch
+of a dataset archive into ``root.common.dirs.datasets`` with unpacking;
+here urllib + tarfile/zipfile (no wget dependency), gated on the URL
+being reachable — this image has zero egress, so tests exercise
+``file://`` URLs and local archives.
+"""
+
+import os
+import shutil
+import tarfile
+import urllib.request
+import zipfile
+
+from veles_tpu.config import root
+from veles_tpu.units import Unit
+
+
+class Downloader(Unit):
+    """Fetches ``url`` into ``directory`` (default
+    ``root.common.dirs.datasets``) and unpacks archives; no-ops when
+    ``files`` already exist (ref ``:56`` semantics)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Downloader, self).__init__(workflow, **kwargs)
+        self.url = kwargs.get("url")
+        self.directory = kwargs.get(
+            "directory", root.common.dirs.datasets
+            if isinstance(root.common.dirs.datasets, str) else ".")
+        #: files whose presence means the dataset is already there
+        self.files = list(kwargs.get("files", ()))
+        self.demand("url")
+
+    @property
+    def already_there(self):
+        return self.files and all(
+            os.path.exists(os.path.join(self.directory, f))
+            for f in self.files)
+
+    def initialize(self, **kwargs):
+        super(Downloader, self).initialize(**kwargs)
+        if self.already_there:
+            self.debug("dataset already present in %s", self.directory)
+            return
+        os.makedirs(self.directory, exist_ok=True)
+        name = os.path.basename(self.url.rstrip("/")) or "download"
+        target = os.path.join(self.directory, name)
+        self.info("fetching %s -> %s", self.url, target)
+        with urllib.request.urlopen(self.url) as response, \
+                open(target, "wb") as fout:
+            shutil.copyfileobj(response, fout)
+        self.unpack(target)
+
+    def unpack(self, path):
+        if tarfile.is_tarfile(path):
+            with tarfile.open(path) as tar:
+                tar.extractall(self.directory, filter="data")
+            self.info("unpacked tar %s", path)
+        elif zipfile.is_zipfile(path):
+            with zipfile.ZipFile(path) as zf:
+                zf.extractall(self.directory)
+            self.info("unpacked zip %s", path)
+
+    def run(self):
+        pass

@@ -1,0 +1,67 @@
+"""InputJoiner: per-sample concatenation of several units' outputs.
+
+Parity target: reference ``veles/input_joiner.py:49`` — consumes N
+``Vector`` inputs of equal batch dimension and emits one (B, sum)
+buffer; the reference generates an N-ary OpenCL/CUDA kernel via the
+Jinja2 ``ocl/join.jcl:12-39`` template.
+
+TPU re-design: one :func:`veles_tpu.ops.join.join` call — XLA emits a
+single fused copy, no arity-templating needed.  The interpret path
+mirrors it with numpy.
+"""
+
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Vector
+from veles_tpu.ops.join import join
+
+
+class InputJoiner(AcceleratedUnit):
+    """``link_inputs(unit_a, "output", unit_b, "output", ...)`` then
+    read ``output``."""
+
+    def __init__(self, workflow, **kwargs):
+        super(InputJoiner, self).__init__(workflow, **kwargs)
+        self.inputs = list(kwargs.get("inputs", ()))
+        self.output = Vector()
+
+    def link_inputs(self, *pairs):
+        """pairs = unit1, attr1, unit2, attr2, ... — collect the named
+        Vectors lazily (they may not exist until those units
+        initialize)."""
+        if len(pairs) % 2:
+            raise ValueError("link_inputs takes (unit, attr) pairs")
+        self._input_links = list(zip(pairs[::2], pairs[1::2]))
+        return self
+
+    def _resolve_inputs(self):
+        for unit, attr in getattr(self, "_input_links", ()):
+            vec = getattr(unit, attr)
+            if vec not in self.inputs:
+                self.inputs.append(vec)
+
+    def initialize(self, device=None, **kwargs):
+        super(InputJoiner, self).initialize(device=device, **kwargs)
+        self._resolve_inputs()
+        if not self.inputs:
+            raise ValueError("InputJoiner has no inputs")
+        batch = self.inputs[0].shape[0]
+        width = 0
+        for vec in self.inputs:
+            if vec.shape[0] != batch:
+                raise ValueError("input batch dims differ: %s vs %s"
+                                 % (vec.shape, self.inputs[0].shape))
+            width += int(numpy.prod(vec.shape[1:]))
+        self.output.reset(numpy.zeros((batch, width), numpy.float32))
+        self.init_vectors(self.output, *self.inputs)
+
+    def numpy_run(self):
+        for vec in self.inputs:
+            vec.map_read()
+        self.output.map_invalidate()
+        flat = [v.mem.reshape(len(v.mem), -1) for v in self.inputs]
+        self.output.mem[...] = numpy.concatenate(flat, axis=1)
+
+    def tpu_run(self):
+        self.output.devmem = join([v.devmem for v in self.inputs])

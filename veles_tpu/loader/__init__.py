@@ -4,3 +4,12 @@ from veles_tpu.loader.base import (  # noqa: F401
     CLASS_NAME, Loader, LoaderError, TEST, TRAIN, VALID)
 from veles_tpu.loader.fullbatch import (  # noqa: F401
     FullBatchLoader, FullBatchLoaderMSE)
+from veles_tpu.loader.formats import (  # noqa: F401
+    HDF5Loader, PicklesLoader)
+from veles_tpu.loader.image import (  # noqa: F401
+    AutoLabelFileImageLoader, FileFilter, FileImageLoader,
+    FullBatchImageLoader, ImageLoader)
+from veles_tpu.loader.saver import (  # noqa: F401
+    MinibatchesLoader, MinibatchesSaver)
+from veles_tpu.loader.streaming import (  # noqa: F401
+    InteractiveLoader, RestfulLoader, StreamLoader, ZeroMQLoader)

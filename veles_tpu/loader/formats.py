@@ -1,0 +1,112 @@
+"""Format loaders: HDF5 and pickled datasets.
+
+Parity target: reference ``veles/loader/loader_hdf5.py`` (``HDF5Loader``
+``:48``/``:94``/``:125`` — per-set ``.h5`` files with ``data`` +
+``labels`` datasets) and ``veles/loader/pickles.py`` (``PicklesLoader``
+``:55``, ``PicklesImageFullBatchLoader`` ``:166`` — pickled ndarray
+blobs per class).  The LMDB / HDFS-text / libsndfile variants of the
+reference depend on services absent from this image; their role (bulk
+key-value and streaming ingestion) is covered by these two plus
+:mod:`veles_tpu.loader.streaming`.
+
+Both land the dataset in the HBM-resident :class:`FullBatchLoader`
+layout so the training path is identical to the synthetic/MNIST loaders.
+"""
+
+import pickle
+
+import numpy
+
+from veles_tpu.loader.base import LoaderError, TEST, TRAIN, VALID
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+
+class HDF5Loader(FullBatchLoader):
+    """``test_path`` / ``validation_path`` / ``train_path`` point to
+    ``.h5`` files each holding ``data`` (N, ...) and optionally
+    ``labels`` (N,) datasets (ref ``loader_hdf5.py:48-125``)."""
+
+    def __init__(self, workflow, **kwargs):
+        self.test_path = kwargs.get("test_path")
+        self.validation_path = kwargs.get("validation_path")
+        self.train_path = kwargs.get("train_path")
+        self.data_dataset = kwargs.get("data_dataset", "data")
+        self.labels_dataset = kwargs.get("labels_dataset", "labels")
+        super(HDF5Loader, self).__init__(workflow, **kwargs)
+
+    def load_data(self):
+        try:
+            import h5py
+        except ImportError:
+            raise LoaderError("h5py is required for HDF5Loader")
+        chunks, labels, lengths = [], [], [0, 0, 0]
+        has_labels = False
+        for class_index, path in ((TEST, self.test_path),
+                                  (VALID, self.validation_path),
+                                  (TRAIN, self.train_path)):
+            if not path:
+                continue
+            with h5py.File(path, "r") as fin:
+                data = numpy.asarray(fin[self.data_dataset],
+                                     dtype=numpy.float32)
+                chunks.append(data)
+                lengths[class_index] = len(data)
+                if self.labels_dataset in fin:
+                    labels.extend(numpy.asarray(
+                        fin[self.labels_dataset]).tolist())
+                    has_labels = True
+                else:
+                    labels.extend([None] * len(data))
+        if not chunks:
+            raise LoaderError("no HDF5 paths given")
+        self.original_data.mem = numpy.concatenate(chunks, axis=0)
+        if has_labels:
+            self.original_labels = labels
+        self.class_lengths[:] = lengths
+
+
+class PicklesLoader(FullBatchLoader):
+    """Per-class pickle files each holding ``(data, labels)`` or just
+    ``data`` (ref ``pickles.py:55``)."""
+
+    def __init__(self, workflow, **kwargs):
+        self.test_path = kwargs.get("test_path")
+        self.validation_path = kwargs.get("validation_path")
+        self.train_path = kwargs.get("train_path")
+        super(PicklesLoader, self).__init__(workflow, **kwargs)
+
+    @staticmethod
+    def _read(path):
+        with open(path, "rb") as fin:
+            blob = pickle.load(fin)
+        if isinstance(blob, tuple) and len(blob) == 2:
+            data, labels = blob
+        elif isinstance(blob, dict):
+            data, labels = blob["data"], blob.get("labels")
+        else:
+            data, labels = blob, None
+        data = numpy.asarray(data, dtype=numpy.float32)
+        return data, (None if labels is None else list(labels))
+
+    def load_data(self):
+        chunks, labels, lengths = [], [], [0, 0, 0]
+        has_labels = False
+        for class_index, path in ((TEST, self.test_path),
+                                  (VALID, self.validation_path),
+                                  (TRAIN, self.train_path)):
+            if not path:
+                continue
+            data, raw = self._read(path)
+            chunks.append(data)
+            lengths[class_index] = len(data)
+            if raw is not None:
+                labels.extend(raw)
+                has_labels = True
+            else:
+                labels.extend([None] * len(data))
+        if not chunks:
+            raise LoaderError("no pickle paths given")
+        self.original_data.mem = numpy.concatenate(chunks, axis=0)
+        if has_labels:
+            self.original_labels = labels
+        self.class_lengths[:] = lengths

@@ -63,7 +63,9 @@ class FullBatchLoader(Loader):
         self.normalizer.normalize(self.original_data.mem)
         self.original_data.map_write()
         if self.has_labels:
-            mapped = [self.labels_mapping.get(raw, raw)
+            # None = unlabeled sample (e.g. a split without labels) → -1
+            mapped = [-1 if raw is None
+                      else self.labels_mapping.get(raw, raw)
                       for raw in self.original_labels]
             self._mapped_labels = numpy.asarray(mapped, dtype=numpy.int32)
         else:
@@ -85,7 +87,8 @@ class FullBatchLoader(Loader):
         start = self.class_end_offsets[TRAIN - 1]
         self.normalizer.analyze(self.original_data.mem[start:])
         if self.has_labels and not self.labels_mapping:
-            uniques = sorted(set(self.original_labels))
+            uniques = sorted(set(
+                raw for raw in self.original_labels if raw is not None))
             self.labels_mapping = {raw: i for i, raw in enumerate(uniques)}
 
     def fill_minibatch(self):

@@ -1,0 +1,262 @@
+"""Image loading pipeline.
+
+Parity target: reference ``veles/loader/image.py`` (``ImageLoader``
+``:106`` — scale / crop / mirror / color-space handling with per-class
+key spaces), ``veles/loader/file_loader.py`` (``FileFilter`` ``:54`` —
+extension/regex directory scanning), ``veles/loader/file_image.py``
+(``FileImageLoader`` ``:150``, ``AutoLabelFileImageLoader`` ``:177`` —
+label = parent directory name) and ``veles/loader/fullbatch_image.py``
+(``FullBatchImageLoader`` ``:56`` — whole image set resident).
+
+TPU re-design notes: decode/resize/crop are host-side (PIL + numpy) just
+as the reference used PIL/jpeg4py — the TPU has no JPEG decoder; what
+changes is the hand-off: ``FullBatchImageLoader`` lands the decoded
+dataset in one HBM-resident Vector so the per-step gather fuses into the
+jitted train step (see :mod:`veles_tpu.loader.fullbatch`), while the
+on-the-fly :class:`FileImageLoader` fills pinned host minibatches that
+upload once per step.  Augmentation (mirror, random crop) uses the named
+"loader" PRNG stream so runs are reproducible and resumable.
+"""
+
+import os
+import re
+
+import numpy
+
+from veles_tpu.loader.base import Loader, LoaderError, TEST, TRAIN, VALID
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+MODE_COLOR_MAP = {
+    "1": "GRAY", "L": "GRAY", "P": "RGB", "RGB": "RGB", "RGBA": "RGBA",
+    "CMYK": "RGB", "YCbCr": "YCR_CB", "I": "GRAY", "F": "GRAY",
+}
+
+
+def _pil():
+    try:
+        from PIL import Image
+    except ImportError:
+        raise LoaderError(
+            "PIL is required for image loaders (pip install pillow)")
+    return Image
+
+
+class FileFilter(object):
+    """Directory scanner with extension + regex filters
+    (ref ``file_loader.py:54``)."""
+
+    DEFAULT_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif",
+                          ".tif", ".tiff", ".ppm", ".pgm")
+
+    def __init__(self, extensions=None, ignored_files=(),
+                 included_files=(".*",)):
+        self.extensions = tuple(
+            e.lower() for e in (extensions or self.DEFAULT_EXTENSIONS))
+        self.ignored_files = [re.compile(p) for p in ignored_files]
+        self.included_files = [re.compile(p) for p in included_files]
+
+    def matches(self, name):
+        if os.path.splitext(name)[1].lower() not in self.extensions:
+            return False
+        if any(p.match(name) for p in self.ignored_files):
+            return False
+        return any(p.match(name) for p in self.included_files)
+
+    def scan(self, path):
+        """Yield matching file paths under ``path`` (sorted, recursive)."""
+        if os.path.isfile(path):
+            if self.matches(os.path.basename(path)):
+                yield path
+            return
+        for root, dirs, files in os.walk(path):
+            dirs.sort()
+            for name in sorted(files):
+                if self.matches(name):
+                    yield os.path.join(root, name)
+
+
+class ImageLoader(Loader):
+    """On-the-fly image loader over per-class *keys* (usually file
+    paths).  Subclasses supply ``get_keys(class_index)`` and
+    ``load_key(key) -> ndarray`` (HWC uint8/float); this base handles
+    scale / crop / mirror / color conversion (ref ``image.py:106``).
+
+    Parameters (ref ``image.py`` ctor kwargs):
+      - ``size`` — (W, H) target; images are resized to it.
+      - ``scale`` — float uniform pre-scale before crop.
+      - ``crop`` — (W, H) random crop taken after scaling (TRAIN only;
+        center crop for TEST/VALID).
+      - ``mirror`` — random horizontal flip on TRAIN samples.
+      - ``color_space`` — "RGB" | "GRAY".
+      - ``normalization_type`` — as in :class:`Loader`.
+    """
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.size = tuple(kwargs.get("size", (32, 32)))
+        self.scale = kwargs.get("scale", 1.0)
+        self.crop = kwargs.get("crop")
+        self.mirror = kwargs.get("mirror", False)
+        self.color_space = kwargs.get("color_space", "RGB")
+        self.keys = [[], [], []]
+        self.labels = [[], [], []]
+        super(ImageLoader, self).__init__(workflow, **kwargs)
+
+    # -- subclass contract --------------------------------------------------
+    def get_keys(self, class_index):
+        raise NotImplementedError
+
+    def get_label(self, key, class_index):
+        """Default: unlabeled."""
+        return None
+
+    def load_key(self, key):
+        """Decode one image to an HWC numpy array."""
+        Image = _pil()
+        with Image.open(key) as img:
+            if self.color_space == "GRAY":
+                img = img.convert("L")
+            else:
+                img = img.convert("RGB")
+            return numpy.asarray(img)
+
+    # -- geometry -----------------------------------------------------------
+    @property
+    def channels(self):
+        return 1 if self.color_space == "GRAY" else 3
+
+    @property
+    def sample_shape(self):
+        wh = self.crop or self.size
+        return (wh[1], wh[0], self.channels)
+
+    def preprocess(self, image, train):
+        """scale → resize to ``size`` → crop → mirror → float32 HWC."""
+        Image = _pil()
+        if image.ndim == 2:
+            image = image[:, :, None]
+        size = self.size
+        if self.scale != 1.0:
+            size = (max(1, int(round(size[0] * self.scale))),
+                    max(1, int(round(size[1] * self.scale))))
+        if image.shape[1::-1] != size:
+            pil = Image.fromarray(image.squeeze(-1) if self.channels == 1
+                                  else image)
+            image = numpy.asarray(pil.resize(size, Image.BILINEAR))
+            if image.ndim == 2:
+                image = image[:, :, None]
+        if self.crop:
+            cw, ch = self.crop
+            h, w = image.shape[:2]
+            if ch > h or cw > w:
+                raise LoaderError("crop %s larger than image %s"
+                                  % ((cw, ch), (w, h)))
+            if train:
+                y = int(self.prng.randint(0, h - ch + 1))
+                x = int(self.prng.randint(0, w - cw + 1))
+            else:
+                y, x = (h - ch) // 2, (w - cw) // 2
+            image = image[y:y + ch, x:x + cw]
+        if self.mirror and train and self.prng.randint(0, 2):
+            image = image[:, ::-1]
+        return numpy.ascontiguousarray(image, dtype=numpy.float32)
+
+    # -- ILoader ------------------------------------------------------------
+    def load_data(self):
+        for class_index in (TEST, VALID, TRAIN):
+            keys = sorted(self.get_keys(class_index))
+            self.keys[class_index] = keys
+            self.labels[class_index] = [
+                self.get_label(key, class_index) for key in keys]
+            self.class_lengths[class_index] = len(keys)
+        self._flat_keys = sum(self.keys, [])
+        self._flat_labels = sum(self.labels, [])
+        self._has_labels = any(
+            label is not None for label in self._flat_labels)
+
+    def create_minibatch_data(self):
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + self.sample_shape,
+            dtype=numpy.float32))
+
+    def fill_minibatch(self):
+        self.minibatch_data.map_write()
+        self.minibatch_indices.map_read()
+        train = self.minibatch_class == TRAIN
+        for i, idx in enumerate(
+                self.minibatch_indices.mem[:self.minibatch_size]):
+            if idx < 0:
+                self.minibatch_data.mem[i] = 0
+                self.raw_minibatch_labels[i] = None
+                continue
+            image = self.load_key(self._flat_keys[idx])
+            self.minibatch_data.mem[i] = self.preprocess(image, train)
+            self.raw_minibatch_labels[i] = self._flat_labels[idx]
+
+
+class FileImageLoader(ImageLoader):
+    """Images from per-class directory lists
+    (ref ``file_image.py:150``): ``test_paths`` / ``validation_paths`` /
+    ``train_paths`` each a list of files or directories."""
+
+    def __init__(self, workflow, **kwargs):
+        self.test_paths = list(kwargs.get("test_paths", ()))
+        self.validation_paths = list(kwargs.get("validation_paths", ()))
+        self.train_paths = list(kwargs.get("train_paths", ()))
+        self.file_filter = kwargs.get("file_filter") or FileFilter(
+            extensions=kwargs.get("extensions"),
+            ignored_files=kwargs.get("ignored_files", ()),
+            included_files=kwargs.get("included_files", (".*",)))
+        super(FileImageLoader, self).__init__(workflow, **kwargs)
+
+    def get_keys(self, class_index):
+        paths = (self.test_paths, self.validation_paths,
+                 self.train_paths)[class_index]
+        keys = []
+        for path in paths:
+            keys.extend(self.file_filter.scan(path))
+        return keys
+
+
+class AutoLabelFileImageLoader(FileImageLoader):
+    """Label = name of the image's parent directory
+    (ref ``file_image.py:177``)."""
+
+    def get_label(self, key, class_index):
+        return os.path.basename(os.path.dirname(key))
+
+
+class FullBatchImageLoader(FullBatchLoader):
+    """Whole image set decoded once into the HBM-resident dataset
+    (ref ``fullbatch_image.py:56``): wraps any :class:`ImageLoader`
+    subclass's key space eagerly.  Use for datasets that fit in HBM —
+    the per-step path is then a pure device gather."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        # the embedded on-the-fly loader does the decode/preprocess work
+        self._image_loader_class = kwargs.pop(
+            "image_loader_class", FileImageLoader)
+        self._image_kwargs = dict(kwargs)
+        super(FullBatchImageLoader, self).__init__(workflow, **kwargs)
+
+    def load_data(self):
+        from veles_tpu.dummy import DummyWorkflow
+        sub = self._image_loader_class(
+            DummyWorkflow(), **self._image_kwargs)
+        sub.load_data()
+        total = sum(sub.class_lengths)
+        if total == 0:
+            raise LoaderError("no images found")
+        data = numpy.zeros((total,) + sub.sample_shape,
+                           dtype=numpy.float32)
+        labels = []
+        for i, key in enumerate(sub._flat_keys):
+            data[i] = sub.preprocess(sub.load_key(key), train=False)
+            labels.append(sub._flat_labels[i])
+        self.original_data.mem = data
+        if any(label is not None for label in labels):
+            self.original_labels = labels
+        self.class_lengths[:] = sub.class_lengths

@@ -21,3 +21,6 @@ from veles_tpu.parallel.dp import data_parallel  # noqa: F401
 from veles_tpu.parallel.ring import (  # noqa: F401
     mha_reference, ring_attention, ulysses_attention)
 from veles_tpu.parallel.pp import pipeline_apply  # noqa: F401
+from veles_tpu.parallel.tp import (  # noqa: F401
+    column_parallel, constrain, row_parallel, shard_dim, sharding_tree)
+from veles_tpu.parallel.moe import moe_mlp  # noqa: F401

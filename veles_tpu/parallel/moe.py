@@ -12,8 +12,6 @@ Composes with DP (batch axis) the usual way; the expert axis can alias
 the ``model`` axis on small meshes.
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -97,6 +95,9 @@ def moe_mlp(x, params, mesh, expert_axis="model", batch_axis="data",
         raise ValueError("experts %d not divisible by axis %d"
                          % (n_exp, n_dev))
     B, T, D = x.shape
+    if T % n_dev:
+        raise ValueError("sequence %d not divisible by expert axis %d"
+                         % (T, n_dev))
 
     def body(x2d, router_w, w1, b1, w2, b2):
         flat = x2d.reshape(-1, D)
@@ -106,11 +107,14 @@ def moe_mlp(x, params, mesh, expert_axis="model", batch_axis="data",
         return y.reshape(x2d.shape)
 
     espec = P(expert_axis)
+    # tokens are sharded over the expert axis too (sequence dim) —
+    # replicating them would make every expert device route and ship
+    # n_dev identical copies
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(batch_axis, None, None), P(None, None),
+        in_specs=(P(batch_axis, expert_axis, None), P(None, None),
                   espec, espec, espec, espec),
-        out_specs=P(batch_axis, None, None),
+        out_specs=P(batch_axis, expert_axis, None),
         check_vma=False)
     return fn(x, params["router"], params["w1"], params["b1"],
               params["w2"], params["b2"])

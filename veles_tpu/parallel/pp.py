@@ -37,7 +37,6 @@ def _pipeline_local(stage_params, x_stack, stage_fn, axis_name):
     n_micro = x_stack.shape[0]
     act0 = jnp.zeros_like(x_stack[0])
     outs0 = jnp.zeros_like(x_stack)
-    perm = None  # built lazily from n (static under shard_map)
 
     def tick(carry, t):
         act, outs = carry
@@ -54,7 +53,6 @@ def _pipeline_local(stage_params, x_stack, stage_fn, axis_name):
             y, axis_name, [(i, (i + 1) % n) for i in range(n)])
         return (act_next, outs), None
 
-    del perm
     (act, outs), _ = jax.lax.scan(
         tick, (act0, outs0), jnp.arange(n_micro + n - 1))
     del act

@@ -11,19 +11,24 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
+def shard_dim(ndim, dim, axis="model"):
+    """PartitionSpec sharding exactly ``dim`` of an ``ndim``-rank
+    weight over ``axis`` (the general rule column/row-parallel are
+    special cases of — e.g. attention weights shard their heads dim)."""
+    spec = [None] * ndim
+    spec[dim] = axis
+    return P(*spec)
+
+
 def column_parallel(ndim=2, axis="model"):
     """Weight [in, out]: shard the OUTPUT features."""
-    spec = [None] * ndim
-    spec[-1] = axis
-    return P(*spec)
+    return shard_dim(ndim, ndim - 1, axis)
 
 
 def row_parallel(ndim=2, axis="model"):
     """Weight [in, out]: shard the INPUT features (its input activation
     arrives feature-sharded from a column-parallel producer)."""
-    spec = [None] * ndim
-    spec[0] = axis
-    return P(*spec)
+    return shard_dim(ndim, 0, axis)
 
 
 def constrain(x, mesh, *spec):

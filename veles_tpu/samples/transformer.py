@@ -171,12 +171,13 @@ def param_specs(params, seq_axis="seq"):
     """PartitionSpec pytree: Megatron TP rules for the block weights
     (qkv/up column-parallel on heads/hidden, out/down row-parallel),
     everything else replicated."""
+    from veles_tpu.parallel import column_parallel, shard_dim
     rules = {
-        "wqkv": P(None, None, None, "model", None),
-        "wo": P(None, "model", None, None),
-        "w1": P(None, None, "model"),
-        "b1": P(None, "model"),
-        "w2": P(None, "model", None),
+        "wqkv": shard_dim(5, 3),      # heads: column-parallel attention
+        "wo": shard_dim(4, 1),        # heads in: row-parallel
+        "w1": column_parallel(3),
+        "b1": column_parallel(2),
+        "w2": shard_dim(3, 1),        # hidden in: row-parallel
     }
 
     def walk(tree, out):
