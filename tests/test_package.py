@@ -1,0 +1,183 @@
+"""Package export/round-trip tests (§2.8 seam): contents.json + npy in
+zip/tgz, fp16 precision, PackagedRunner golden vs the live units —
+mirrors the reference's packaged-model round-trip tests
+(libVeles/tests/workflow_loader.cc against mnist.zip/mnist.tar.gz)."""
+
+import json
+import zipfile
+
+import numpy
+import pytest
+
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.memory import Vector
+from veles_tpu.package import (
+    CONTENTS_NAME, PackagedRunner, export_package)
+from veles_tpu.znicz.all2all import All2AllSoftmax, All2AllTanh
+from veles_tpu.znicz.conv import ConvTanh
+from veles_tpu.znicz.normalization_units import LRNormalizerForward
+from veles_tpu.znicz.pooling import MaxPooling
+
+
+def _build_convnet(x):
+    """conv→pool→lrn→fc→softmax chain, run on NumpyDevice; returns
+    (forwards, golden_output)."""
+    wf = DummyWorkflow()
+    dev = NumpyDevice()
+    conv = ConvTanh(wf, n_kernels=4, kx=3, ky=3)
+    conv.input = Vector(x.copy())
+    conv.initialize(dev)
+    conv.numpy_run()
+    pool = MaxPooling(wf, kx=2, ky=2)
+    pool.input = conv.output
+    pool.initialize(dev)
+    pool.numpy_run()
+    lrn = LRNormalizerForward(wf)
+    lrn.input = pool.output
+    lrn.initialize(dev)
+    lrn.numpy_run()
+    fc = All2AllTanh(wf, output_sample_shape=(16,))
+    fc.input = lrn.output
+    fc.initialize(dev)
+    fc.numpy_run()
+    sm = All2AllSoftmax(wf, output_sample_shape=(10,))
+    sm.input = fc.output
+    sm.initialize(dev)
+    sm.numpy_run()
+    sm.output.map_read()
+    return [conv, pool, lrn, fc, sm], numpy.array(sm.output.mem)
+
+
+@pytest.fixture(scope="module")
+def convnet():
+    rng = numpy.random.default_rng(7)
+    x = rng.standard_normal((3, 8, 8, 2)).astype(numpy.float32)
+    forwards, golden = _build_convnet(x)
+    return x, forwards, golden
+
+
+def test_zip_round_trip(convnet, tmp_path):
+    x, forwards, golden = convnet
+    path = str(tmp_path / "model.zip")
+    contents = export_package(forwards, path)
+    assert contents["units"][0]["type"] == "conv_tanh"
+    runner = PackagedRunner(path)
+    out = runner.run(x)
+    assert out.shape == golden.shape
+    assert numpy.allclose(out, golden, atol=1e-4)
+    # probabilities sum to 1 (softmax tail)
+    assert numpy.allclose(out.sum(axis=-1), 1.0, atol=1e-5)
+
+
+def test_tgz_round_trip(convnet, tmp_path):
+    x, forwards, golden = convnet
+    path = str(tmp_path / "model.tar.gz")
+    export_package(forwards, path, with_stablehlo=False)
+    out = PackagedRunner(path).run(x)
+    assert numpy.allclose(out, golden, atol=1e-4)
+
+
+def test_fp16_precision(convnet, tmp_path):
+    x, forwards, golden = convnet
+    path = str(tmp_path / "model16.zip")
+    contents = export_package(forwards, path, precision=16,
+                              with_stablehlo=False)
+    assert contents["precision"] == 16
+    with zipfile.ZipFile(path) as z:
+        ref = contents["units"][0]["arrays"]["weights"]
+        arr = numpy.load(__import__("io").BytesIO(z.read(ref)))
+        assert arr.dtype == numpy.float16
+    out = PackagedRunner(path).run(x)
+    assert numpy.allclose(out, golden, atol=5e-2)
+
+
+def test_contents_schema(convnet, tmp_path):
+    x, forwards, _ = convnet
+    path = str(tmp_path / "model.zip")
+    export_package(forwards, path, with_stablehlo=False)
+    with zipfile.ZipFile(path) as z:
+        contents = json.loads(z.read(CONTENTS_NAME).decode())
+    assert contents["format_version"] == 1
+    assert contents["input_shape"] == list(x.shape)
+    types = [u["type"] for u in contents["units"]]
+    assert types == ["conv_tanh", "max_pooling", "lrn", "all2all_tanh",
+                     "softmax"]
+    # every array ref resolves
+    with zipfile.ZipFile(path) as z:
+        names = set(z.namelist())
+    for unit in contents["units"]:
+        for ref in unit["arrays"].values():
+            assert ref in names
+
+
+def test_stablehlo_export(convnet, tmp_path):
+    x, forwards, golden = convnet
+    path = str(tmp_path / "model_hlo.zip")
+    contents = export_package(forwards, path, with_stablehlo=True)
+    if "stablehlo" not in contents:
+        pytest.skip("jax.export unavailable for this chain")
+    with zipfile.ZipFile(path) as z:
+        blob = z.read(contents["stablehlo"])
+    assert len(blob) > 100
+    # deserialize + run through jax.export to prove the artifact is live
+    from jax import export as jax_export
+    rerun = jax_export.deserialize(bytearray(blob))
+    out = numpy.asarray(rerun.call(x))
+    assert numpy.allclose(out, golden, atol=1e-4)
+
+
+def test_mean_disp_round_trip(tmp_path):
+    """MeanDispNormalizer packages as 'mean_disp' with rdisp → disp."""
+    from veles_tpu.mean_disp_normalizer import MeanDispNormalizer
+    wf = DummyWorkflow()
+    dev = NumpyDevice()
+    rng = numpy.random.default_rng(5)
+    x = rng.standard_normal((4, 6)).astype(numpy.float32)
+    unit = MeanDispNormalizer(wf)
+    unit.input = Vector(x.copy())
+    unit.mean = Vector(rng.standard_normal(6).astype(numpy.float32))
+    unit.rdisp = Vector((rng.random(6) + 0.5).astype(numpy.float32))
+    unit.initialize(dev)
+    unit.numpy_run()
+    unit.output.map_read()
+    golden = numpy.array(unit.output.mem)
+    path = str(tmp_path / "md.zip")
+    export_package([unit], path, with_stablehlo=False)
+    out = PackagedRunner(path).run(x)
+    assert numpy.allclose(out, golden, atol=1e-5)
+
+
+def test_checksum_detects_corruption(convnet, tmp_path):
+    import io as _io
+    x, forwards, _ = convnet
+    path = str(tmp_path / "model.zip")
+    export_package(forwards, path, with_stablehlo=False)
+    with zipfile.ZipFile(path) as z:
+        files = {n: z.read(n) for n in z.namelist()}
+    victim = next(n for n in files if n.endswith(".npy"))
+    files[victim] = files[victim][:-4] + b"\x00\x00\x00\x01"
+    with pytest.raises(ValueError, match="checksum"):
+        PackagedRunner(files)
+
+
+def test_mlp_workflow_method(tmp_path):
+    """Workflow.package_export API parity (ref workflow.py:868)."""
+    wf = DummyWorkflow()
+    dev = NumpyDevice()
+    rng = numpy.random.default_rng(3)
+    x = rng.standard_normal((4, 20)).astype(numpy.float32)
+    fc = All2AllTanh(wf, output_sample_shape=(8,))
+    fc.input = Vector(x.copy())
+    fc.initialize(dev)
+    fc.numpy_run()
+    sm = All2AllSoftmax(wf, output_sample_shape=(5,))
+    sm.input = fc.output
+    sm.initialize(dev)
+    sm.numpy_run()
+    sm.output.map_read()
+    wf.forwards = [fc, sm]
+    path = str(tmp_path / "mlp.zip")
+    wf.package_export(path, with_stablehlo=False)
+    out = PackagedRunner(path).run(x)
+    assert numpy.allclose(out, numpy.array(sm.output.mem), atol=1e-5)

@@ -128,7 +128,15 @@ class ImageLoader(Loader):
 
     @property
     def sample_shape(self):
-        wh = self.crop or self.size
+        if self.crop:
+            wh = self.crop
+        elif self.scale != 1.0:
+            # no crop: preprocess() resizes to size*scale — the buffer
+            # must match the scaled geometry
+            wh = (max(1, int(round(self.size[0] * self.scale))),
+                  max(1, int(round(self.size[1] * self.scale))))
+        else:
+            wh = self.size
         return (wh[1], wh[0], self.channels)
 
     def preprocess(self, image, train):

@@ -186,14 +186,20 @@ class ZeroMQLoader(StreamLoader):
                         blob = sock.recv()
                     except Exception:
                         return
-                    item = pickle.loads(blob)
-                    if item in (END_OF_EPOCH, END_OF_STREAM):
-                        self.queue_.put(item)
-                        if item == END_OF_STREAM:
-                            return
-                    else:
-                        data, labels = item
-                        self.feed(data, labels)
+                    try:
+                        item = pickle.loads(blob)
+                        if item in (END_OF_EPOCH, END_OF_STREAM):
+                            self.queue_.put(item)
+                            if item == END_OF_STREAM:
+                                return
+                        else:
+                            data, labels = item
+                            self.feed(data, labels)
+                    except Exception:
+                        # a malformed/oversized payload must not kill
+                        # the pump (the consumer would hang on queue_
+                        # forever); drop the batch and keep serving
+                        self.exception("dropping malformed ZMQ batch")
             finally:
                 sock.close(0)
                 self._zmq_socket_ = None
@@ -281,4 +287,5 @@ class RestfulLoader(StreamLoader):
     def stop(self):
         if self._server_ is not None:
             self._server_.shutdown()
+            self._server_.server_close()  # release the bound port now
             self._server_ = None

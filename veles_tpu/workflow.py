@@ -340,6 +340,17 @@ class Workflow(Unit):
                 pass
         return sha.hexdigest()
 
+    def package_export(self, path, precision=32, with_stablehlo=True):
+        """Write a native-inference package (ref ``workflow.py:868-975``).
+
+        Requires the workflow (or a subclass) to expose ``forwards`` —
+        the forward units in execution order (StandardWorkflow does).
+        """
+        from veles_tpu.package import export_package
+        return export_package(self, path, precision=precision,
+                              with_stablehlo=with_stablehlo,
+                              name=self.name)
+
     def generate_graph(self):
         """DOT text of the control graph (ref ``workflow.py:628``)."""
         lines = ["digraph %s {" % type(self).__name__.replace(" ", "_")]
