@@ -1,0 +1,84 @@
+// C API for the native inference runtime (consumed from Python via
+// ctypes — pybind11 is not in this image; see veles_tpu/native.py).
+#include <cstring>
+#include <string>
+
+#include "workflow.h"
+
+using veles_native::NumElements;
+using veles_native::Workflow;
+
+namespace {
+
+void SetError(char* err, int errlen, const std::string& what) {
+  if (err && errlen > 0) {
+    std::strncpy(err, what.c_str(), errlen - 1);
+    err[errlen - 1] = '\0';
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle or nullptr (error text in err).
+void* veles_native_load(const char* path, char* err, int errlen) {
+  try {
+    return new Workflow(path);
+  } catch (const std::exception& e) {
+    SetError(err, errlen, e.what());
+    return nullptr;
+  }
+}
+
+int veles_native_initialize(void* handle, long long batch, char* err,
+                            int errlen) {
+  try {
+    static_cast<Workflow*>(handle)->Initialize(batch);
+    return 0;
+  } catch (const std::exception& e) {
+    SetError(err, errlen, e.what());
+    return -1;
+  }
+}
+
+// Writes the output shape into dims (capacity `cap`); returns rank
+// (or -1 on error / not initialized).
+int veles_native_output_shape(void* handle, long long* dims, int cap) {
+  try {
+    const auto& shape = static_cast<Workflow*>(handle)->output_shape();
+    if (static_cast<int>(shape.size()) > cap) return -1;
+    for (size_t i = 0; i < shape.size(); ++i) dims[i] = shape[i];
+    return static_cast<int>(shape.size());
+  } catch (...) {
+    return -1;
+  }
+}
+
+int veles_native_input_shape(void* handle, long long* dims, int cap) {
+  const auto& shape = static_cast<Workflow*>(handle)->input_shape();
+  if (static_cast<int>(shape.size()) > cap) return -1;
+  for (size_t i = 0; i < shape.size(); ++i) dims[i] = shape[i];
+  return static_cast<int>(shape.size());
+}
+
+long long veles_native_arena_floats(void* handle) {
+  return static_cast<Workflow*>(handle)->arena_floats();
+}
+
+int veles_native_run(void* handle, const float* input, float* output,
+                     char* err, int errlen) {
+  try {
+    static_cast<Workflow*>(handle)->Run(input, output);
+    return 0;
+  } catch (const std::exception& e) {
+    SetError(err, errlen, e.what());
+    return -1;
+  }
+}
+
+void veles_native_destroy(void* handle) {
+  delete static_cast<Workflow*>(handle);
+}
+
+}  // extern "C"

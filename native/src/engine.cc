@@ -1,0 +1,74 @@
+#include "engine.h"
+
+#include <algorithm>
+
+namespace veles_native {
+
+Engine::Engine(int workers) {
+  if (workers <= 0) {
+    workers = static_cast<int>(std::thread::hardware_concurrency());
+    if (workers <= 0) workers = 1;
+  }
+  threads_.reserve(workers);
+  for (int i = 0; i < workers; ++i)
+    threads_.emplace_back([this] { WorkerLoop(); });
+}
+
+Engine::~Engine() {
+  {
+    std::unique_lock<std::mutex> lock(mutex_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  for (auto& t : threads_) t.join();
+}
+
+void Engine::WorkerLoop() {
+  while (true) {
+    std::function<void()> fn;
+    {
+      std::unique_lock<std::mutex> lock(mutex_);
+      cv_.wait(lock, [this] { return stop_ || !queue_.empty(); });
+      if (stop_ && queue_.empty()) return;
+      fn = std::move(queue_.front());
+      queue_.pop();
+      ++active_;
+    }
+    fn();
+    {
+      std::unique_lock<std::mutex> lock(mutex_);
+      --active_;
+      if (queue_.empty() && active_ == 0) done_cv_.notify_all();
+    }
+  }
+}
+
+void Engine::Schedule(std::function<void()> fn) {
+  {
+    std::unique_lock<std::mutex> lock(mutex_);
+    queue_.push(std::move(fn));
+  }
+  cv_.notify_one();
+}
+
+void Engine::Wait() {
+  std::unique_lock<std::mutex> lock(mutex_);
+  done_cv_.wait(lock, [this] { return queue_.empty() && active_ == 0; });
+}
+
+void Engine::ParallelFor(
+    int64_t count, const std::function<void(int64_t, int64_t)>& body) {
+  int n = workers();
+  if (n <= 1 || count < 2) {
+    body(0, count);
+    return;
+  }
+  int64_t chunk = (count + n - 1) / n;
+  for (int64_t begin = 0; begin < count; begin += chunk) {
+    int64_t end = std::min(begin + chunk, count);
+    Schedule([&body, begin, end] { body(begin, end); });
+  }
+  Wait();
+}
+
+}  // namespace veles_native

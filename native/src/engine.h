@@ -1,0 +1,49 @@
+// Engine: thread-pool scheduler for unit execution.
+// Role parity: libVeles Engine (inc/veles/engine.h:43-60 — Schedule()
+// abstraction + finish callbacks) and its thread pool (src/thread_pool.h).
+// Adds ParallelFor, the primitive the compute units use to split batch
+// rows across workers.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace veles_native {
+
+class Engine {
+ public:
+  explicit Engine(int workers = 0);
+  ~Engine();
+
+  // Asynchronously runs `fn` on a worker (libVeles Engine::Schedule).
+  void Schedule(std::function<void()> fn);
+
+  // Blocks until every scheduled task has finished.
+  void Wait();
+
+  // Splits [0, count) into contiguous chunks across workers and blocks
+  // until all are done. Falls back to inline execution when the pool has
+  // a single worker or the range is tiny.
+  void ParallelFor(int64_t count,
+                   const std::function<void(int64_t, int64_t)>& body);
+
+  int workers() const { return static_cast<int>(threads_.size()); }
+
+ private:
+  void WorkerLoop();
+
+  std::vector<std::thread> threads_;
+  std::queue<std::function<void()>> queue_;
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  std::condition_variable done_cv_;
+  int active_ = 0;
+  bool stop_ = false;
+};
+
+}  // namespace veles_native

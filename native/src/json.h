@@ -1,0 +1,201 @@
+// Minimal recursive-descent JSON parser for package manifests.
+// Role parity: the reference's rapidjson consumer in libVeles
+// (src/main_file_loader.cc reads contents.json via rapidjson); vendoring
+// is avoided — the subset needed by contents.json is ~200 lines.
+#pragma once
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+class Json;
+using JsonPtr = std::shared_ptr<Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Type type = Type::Null;
+  bool boolean = false;
+  double number = 0.0;
+  std::string str;
+  std::vector<JsonPtr> array;
+  std::map<std::string, JsonPtr> object;
+
+  bool is_null() const { return type == Type::Null; }
+  double num() const {
+    if (type != Type::Number) throw std::runtime_error("json: not a number");
+    return number;
+  }
+  int64_t integer() const { return static_cast<int64_t>(num()); }
+  const std::string& string_value() const {
+    if (type != Type::String) throw std::runtime_error("json: not a string");
+    return str;
+  }
+  const JsonPtr& at(const std::string& key) const {
+    auto it = object.find(key);
+    if (it == object.end())
+      throw std::runtime_error("json: missing key " + key);
+    return it->second;
+  }
+  JsonPtr get(const std::string& key) const {
+    auto it = object.find(key);
+    return it == object.end() ? nullptr : it->second;
+  }
+  bool has(const std::string& key) const {
+    return object.count(key) != 0;
+  }
+};
+
+class JsonParser {
+ public:
+  static JsonPtr Parse(const std::string& text) {
+    JsonParser p(text);
+    JsonPtr v = p.ParseValue();
+    p.SkipWs();
+    if (p.pos_ != text.size())
+      throw std::runtime_error("json: trailing garbage");
+    return v;
+  }
+
+ private:
+  explicit JsonParser(const std::string& text) : text_(text) {}
+
+  const std::string& text_;
+  size_t pos_ = 0;
+
+  [[noreturn]] void Fail(const std::string& what) {
+    throw std::runtime_error("json: " + what + " at offset " +
+                             std::to_string(pos_));
+  }
+  char Peek() {
+    if (pos_ >= text_.size()) Fail("unexpected end");
+    return text_[pos_];
+  }
+  char Next() { char c = Peek(); ++pos_; return c; }
+  void SkipWs() {
+    while (pos_ < text_.size() &&
+           (text_[pos_] == ' ' || text_[pos_] == '\t' ||
+            text_[pos_] == '\n' || text_[pos_] == '\r'))
+      ++pos_;
+  }
+  void Expect(char c) {
+    if (Next() != c) { --pos_; Fail(std::string("expected '") + c + "'"); }
+  }
+  bool Consume(const char* lit) {
+    size_t n = strlen(lit);
+    if (text_.compare(pos_, n, lit) == 0) { pos_ += n; return true; }
+    return false;
+  }
+
+  JsonPtr ParseValue() {
+    SkipWs();
+    auto v = std::make_shared<Json>();
+    char c = Peek();
+    if (c == '{') {
+      v->type = Json::Type::Object;
+      Next(); SkipWs();
+      if (Peek() == '}') { Next(); return v; }
+      while (true) {
+        SkipWs();
+        std::string key = ParseString();
+        SkipWs(); Expect(':');
+        v->object[key] = ParseValue();
+        SkipWs();
+        char d = Next();
+        if (d == '}') break;
+        if (d != ',') { --pos_; Fail("expected ',' or '}'"); }
+      }
+    } else if (c == '[') {
+      v->type = Json::Type::Array;
+      Next(); SkipWs();
+      if (Peek() == ']') { Next(); return v; }
+      while (true) {
+        v->array.push_back(ParseValue());
+        SkipWs();
+        char d = Next();
+        if (d == ']') break;
+        if (d != ',') { --pos_; Fail("expected ',' or ']'"); }
+      }
+    } else if (c == '"') {
+      v->type = Json::Type::String;
+      v->str = ParseString();
+    } else if (Consume("true")) {
+      v->type = Json::Type::Bool; v->boolean = true;
+    } else if (Consume("false")) {
+      v->type = Json::Type::Bool; v->boolean = false;
+    } else if (Consume("null")) {
+      v->type = Json::Type::Null;
+    } else {
+      v->type = Json::Type::Number;
+      size_t end = pos_;
+      while (end < text_.size() &&
+             (isdigit(static_cast<unsigned char>(text_[end])) ||
+              text_[end] == '-' || text_[end] == '+' || text_[end] == '.' ||
+              text_[end] == 'e' || text_[end] == 'E'))
+        ++end;
+      if (end == pos_) Fail("invalid value");
+      v->number = std::stod(text_.substr(pos_, end - pos_));
+      pos_ = end;
+    }
+    return v;
+  }
+
+  std::string ParseString() {
+    Expect('"');
+    std::string out;
+    while (true) {
+      char c = Next();
+      if (c == '"') break;
+      if (c == '\\') {
+        char e = Next();
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            // contents.json is ASCII-safe; decode BMP codepoints to UTF-8
+            unsigned code = 0;
+            for (int i = 0; i < 4; ++i) {
+              char h = Next();
+              code <<= 4;
+              if (h >= '0' && h <= '9') code |= h - '0';
+              else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+              else Fail("bad \\u escape");
+            }
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: Fail("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  }
+};
+
+}  // namespace veles_native

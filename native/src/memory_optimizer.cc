@@ -1,0 +1,39 @@
+#include "memory_optimizer.h"
+
+#include <algorithm>
+
+namespace veles_native {
+
+int64_t MemoryOptimizer::Optimize(std::vector<MemoryNode>* nodes) {
+  std::vector<size_t> order(nodes->size());
+  for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+  std::sort(order.begin(), order.end(), [&](size_t a, size_t b) {
+    return (*nodes)[a].size > (*nodes)[b].size;
+  });
+
+  int64_t total = 0;
+  for (size_t idx : order) {
+    MemoryNode& node = (*nodes)[idx];
+    // collect space intervals already taken by time-overlapping nodes
+    std::vector<std::pair<int64_t, int64_t>> taken;
+    for (const MemoryNode& other : *nodes) {
+      if (&other == &node || other.offset < 0) continue;
+      bool time_overlap = !(other.time_end < node.time_start ||
+                            node.time_end < other.time_start);
+      if (time_overlap)
+        taken.emplace_back(other.offset, other.offset + other.size);
+    }
+    std::sort(taken.begin(), taken.end());
+    // first-fit: earliest gap large enough
+    int64_t at = 0;
+    for (const auto& iv : taken) {
+      if (at + node.size <= iv.first) break;
+      at = std::max(at, iv.second);
+    }
+    node.offset = at;
+    total = std::max(total, at + node.size);
+  }
+  return total;
+}
+
+}  // namespace veles_native

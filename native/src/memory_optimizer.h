@@ -1,0 +1,28 @@
+// Liveness-interval buffer packing: assigns every intermediate buffer an
+// offset in one shared arena so that time-overlapping buffers never
+// overlap in space, minimizing the arena size.
+// Role parity: libVeles MemoryOptimizer (src/memory_optimizer.h:43-55,
+// src/memory_node.h) — interval-graph packing of unit scratch buffers;
+// Optimize() returns the total arena size.
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+namespace veles_native {
+
+struct MemoryNode {
+  int64_t size = 0;       // bytes (or any unit; offsets share it)
+  int time_start = 0;     // first step the buffer is live (inclusive)
+  int time_end = 0;       // last step the buffer is live (inclusive)
+  int64_t offset = -1;    // output: assigned arena offset
+};
+
+class MemoryOptimizer {
+ public:
+  // Assigns node offsets; returns total arena size. Greedy first-fit on
+  // size-descending order — optimal for chains, near-optimal for DAGs.
+  static int64_t Optimize(std::vector<MemoryNode>* nodes);
+};
+
+}  // namespace veles_native

@@ -1,0 +1,140 @@
+#include "npy.h"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace veles_native {
+
+namespace {
+
+float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h >> 15) & 1, exp = (h >> 10) & 0x1F, frac = h & 0x3FF;
+  uint32_t out;
+  if (exp == 0) {
+    if (frac == 0) {
+      out = sign << 31;
+    } else {  // subnormal: normalize
+      exp = 1;
+      while (!(frac & 0x400)) { frac <<= 1; --exp; }
+      frac &= 0x3FF;
+      out = (sign << 31) | ((exp + 112) << 23) | (frac << 13);
+    }
+  } else if (exp == 0x1F) {
+    out = (sign << 31) | 0x7F800000 | (frac << 13);
+  } else {
+    out = (sign << 31) | ((exp + 112) << 23) | (frac << 13);
+  }
+  float f;
+  std::memcpy(&f, &out, 4);
+  return f;
+}
+
+std::string HeaderField(const std::string& header, const std::string& key) {
+  size_t at = header.find("'" + key + "'");
+  if (at == std::string::npos)
+    throw std::runtime_error("npy: header missing " + key);
+  at = header.find(':', at);
+  size_t end = at + 1;
+  int depth = 0;
+  while (end < header.size()) {
+    char c = header[end];
+    if (c == '(' || c == '[') ++depth;
+    if (c == ')' || c == ']') --depth;
+    if ((c == ',' || c == '}') && depth <= 0) break;
+    ++end;
+  }
+  std::string value = header.substr(at + 1, end - at - 1);
+  // trim
+  while (!value.empty() && (value.front() == ' ' || value.front() == '\''))
+    value.erase(value.begin());
+  while (!value.empty() &&
+         (value.back() == ' ' || value.back() == '\'' || value.back() == ','))
+    value.pop_back();
+  return value;
+}
+
+}  // namespace
+
+NpyArray LoadNpy(const uint8_t* bytes, size_t len) {
+  if (len < 10 || std::memcmp(bytes, "\x93NUMPY", 6) != 0)
+    throw std::runtime_error("npy: bad magic");
+  uint8_t major = bytes[6];
+  size_t header_len, header_at;
+  if (major == 1) {
+    header_len = bytes[8] | (bytes[9] << 8);
+    header_at = 10;
+  } else {
+    if (len < 12) throw std::runtime_error("npy: truncated");
+    header_len = static_cast<size_t>(bytes[8]) | (bytes[9] << 8) |
+                 (static_cast<size_t>(bytes[10]) << 16) |
+                 (static_cast<size_t>(bytes[11]) << 24);
+    header_at = 12;
+  }
+  if (header_at + header_len > len)
+    throw std::runtime_error("npy: truncated header");
+  std::string header(reinterpret_cast<const char*>(bytes + header_at),
+                     header_len);
+  std::string descr = HeaderField(header, "descr");
+  std::string fortran = HeaderField(header, "fortran_order");
+  std::string shape_s = HeaderField(header, "shape");
+  if (fortran.find("True") != std::string::npos)
+    throw std::runtime_error("npy: fortran_order not supported");
+
+  NpyArray arr;
+  size_t p = shape_s.find('(');
+  size_t q = shape_s.find(')');
+  std::string dims = (p == std::string::npos)
+      ? shape_s : shape_s.substr(p + 1, q - p - 1);
+  size_t start = 0;
+  while (start < dims.size()) {
+    size_t comma = dims.find(',', start);
+    std::string tok = dims.substr(
+        start, comma == std::string::npos ? std::string::npos
+                                          : comma - start);
+    bool any_digit = false;
+    for (char c : tok) any_digit |= (c >= '0' && c <= '9');
+    if (any_digit) arr.shape.push_back(std::stoll(tok));
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  int64_t count = arr.size();
+  const uint8_t* payload = bytes + header_at + header_len;
+  size_t avail = len - header_at - header_len;
+  arr.data.resize(static_cast<size_t>(count));
+
+  auto need = [&](size_t itemsize) {
+    if (avail < static_cast<size_t>(count) * itemsize)
+      throw std::runtime_error("npy: truncated payload");
+  };
+  if (descr == "<f4") {
+    need(4);
+    std::memcpy(arr.data.data(), payload, count * 4);
+  } else if (descr == "<f2") {
+    need(2);
+    const uint16_t* h = reinterpret_cast<const uint16_t*>(payload);
+    for (int64_t i = 0; i < count; ++i) arr.data[i] = HalfToFloat(h[i]);
+  } else if (descr == "<f8") {
+    need(8);
+    const double* d = reinterpret_cast<const double*>(payload);
+    for (int64_t i = 0; i < count; ++i)
+      arr.data[i] = static_cast<float>(d[i]);
+  } else if (descr == "|u1") {
+    need(1);
+    for (int64_t i = 0; i < count; ++i) arr.data[i] = payload[i];
+  } else if (descr == "<i4") {
+    need(4);
+    const int32_t* d = reinterpret_cast<const int32_t*>(payload);
+    for (int64_t i = 0; i < count; ++i)
+      arr.data[i] = static_cast<float>(d[i]);
+  } else if (descr == "<i8") {
+    need(8);
+    const int64_t* d = reinterpret_cast<const int64_t*>(payload);
+    for (int64_t i = 0; i < count; ++i)
+      arr.data[i] = static_cast<float>(d[i]);
+  } else {
+    throw std::runtime_error("npy: unsupported dtype " + descr);
+  }
+  return arr;
+}
+
+}  // namespace veles_native

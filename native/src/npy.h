@@ -1,0 +1,29 @@
+// .npy reader: header parse (v1.0/v2.0), little-endian f4/f2, C order.
+// Role parity: libVeles NumpyArrayLoader
+// (inc/veles/numpy_array_loader.h, src/numpy_array_loader.cc) — dtype and
+// endianness checks, transposition rejection, aligned allocation.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+struct NpyArray {
+  std::vector<int64_t> shape;
+  std::vector<float> data;  // always widened to f32
+
+  int64_t size() const {
+    int64_t n = 1;
+    for (int64_t d : shape) n *= d;
+    return n;
+  }
+};
+
+// Parses a .npy blob. Accepts dtypes <f4, <f2 (fp16 packages), |u1, <i4,
+// <i8; everything is converted to float. Throws std::runtime_error on
+// fortran_order=True or foreign endianness.
+NpyArray LoadNpy(const uint8_t* bytes, size_t len);
+
+}  // namespace veles_native

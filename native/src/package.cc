@@ -1,0 +1,176 @@
+#include "package.h"
+
+#include <dirent.h>
+#include <sys/stat.h>
+#include <zlib.h>
+
+#include <cstring>
+#include <fstream>
+#include <stdexcept>
+
+namespace veles_native {
+
+namespace {
+
+std::vector<uint8_t> ReadFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  f.seekg(0, std::ios::end);
+  std::vector<uint8_t> out(static_cast<size_t>(f.tellg()));
+  f.seekg(0);
+  f.read(reinterpret_cast<char*>(out.data()), out.size());
+  return out;
+}
+
+uint32_t Le32(const uint8_t* p) {
+  return p[0] | (p[1] << 8) | (p[2] << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+uint16_t Le16(const uint8_t* p) { return p[0] | (p[1] << 8); }
+
+std::vector<uint8_t> InflateRaw(const uint8_t* src, size_t src_len,
+                                size_t dst_len) {
+  std::vector<uint8_t> out(dst_len);
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  if (inflateInit2(&zs, -15) != Z_OK)  // raw deflate (no zlib header)
+    throw std::runtime_error("zip: inflateInit2 failed");
+  zs.next_in = const_cast<uint8_t*>(src);
+  zs.avail_in = static_cast<uInt>(src_len);
+  zs.next_out = out.data();
+  zs.avail_out = static_cast<uInt>(dst_len);
+  int rc = inflate(&zs, Z_FINISH);
+  inflateEnd(&zs);
+  if (rc != Z_STREAM_END)
+    throw std::runtime_error("zip: inflate failed rc=" + std::to_string(rc));
+  return out;
+}
+
+}  // namespace
+
+FileMap ReadZip(const std::vector<uint8_t>& blob) {
+  // locate End Of Central Directory (scan back for PK\5\6)
+  if (blob.size() < 22) throw std::runtime_error("zip: too small");
+  size_t eocd = std::string::npos;
+  for (size_t i = blob.size() - 22; ; --i) {
+    if (blob[i] == 0x50 && blob[i + 1] == 0x4B && blob[i + 2] == 0x05 &&
+        blob[i + 3] == 0x06) {
+      eocd = i;
+      break;
+    }
+    if (i == 0 || blob.size() - i > 22 + 65536) break;
+  }
+  if (eocd == std::string::npos)
+    throw std::runtime_error("zip: no end-of-central-directory");
+  uint16_t entries = Le16(&blob[eocd + 10]);
+  uint32_t cd_at = Le32(&blob[eocd + 16]);
+
+  FileMap files;
+  size_t p = cd_at;
+  for (uint16_t e = 0; e < entries; ++e) {
+    if (p + 46 > blob.size() || Le32(&blob[p]) != 0x02014B50)
+      throw std::runtime_error("zip: bad central directory entry");
+    uint16_t method = Le16(&blob[p + 10]);
+    uint32_t csize = Le32(&blob[p + 20]);
+    uint32_t usize = Le32(&blob[p + 24]);
+    uint16_t name_len = Le16(&blob[p + 28]);
+    uint16_t extra_len = Le16(&blob[p + 30]);
+    uint16_t comment_len = Le16(&blob[p + 32]);
+    uint32_t local_at = Le32(&blob[p + 42]);
+    std::string name(reinterpret_cast<const char*>(&blob[p + 46]), name_len);
+    p += 46 + name_len + extra_len + comment_len;
+
+    // local header: its own name/extra lengths may differ from CD's
+    if (local_at + 30 > blob.size() || Le32(&blob[local_at]) != 0x04034B50)
+      throw std::runtime_error("zip: bad local header for " + name);
+    uint16_t lname = Le16(&blob[local_at + 26]);
+    uint16_t lextra = Le16(&blob[local_at + 28]);
+    size_t data_at = local_at + 30 + lname + lextra;
+    if (data_at + csize > blob.size())
+      throw std::runtime_error("zip: truncated data for " + name);
+    if (name.empty() || name.back() == '/') continue;  // directory entry
+    if (method == 0) {
+      files[name].assign(blob.begin() + data_at,
+                         blob.begin() + data_at + csize);
+    } else if (method == 8) {
+      files[name] = InflateRaw(&blob[data_at], csize, usize);
+    } else {
+      throw std::runtime_error("zip: unsupported method " +
+                               std::to_string(method) + " for " + name);
+    }
+  }
+  return files;
+}
+
+FileMap ReadTarGz(const std::string& path) {
+  gzFile gz = gzopen(path.c_str(), "rb");
+  if (!gz) throw std::runtime_error("cannot open " + path);
+  FileMap files;
+  uint8_t block[512];
+  while (true) {
+    int n = gzread(gz, block, 512);
+    if (n == 0) break;  // clean EOF
+    if (n != 512) { gzclose(gz); throw std::runtime_error("tar: short read"); }
+    bool all_zero = true;
+    for (int i = 0; i < 512; ++i) all_zero &= (block[i] == 0);
+    if (all_zero) continue;  // end-of-archive padding
+    char name[257] = {0};
+    std::memcpy(name, block, 100);
+    char prefix[156] = {0};
+    std::memcpy(prefix, block + 345, 155);
+    std::string full = prefix[0]
+        ? std::string(prefix) + "/" + name : std::string(name);
+    char size_field[13] = {0};
+    std::memcpy(size_field, block + 124, 12);
+    size_t size = std::strtoull(size_field, nullptr, 8);
+    char type = block[156];
+    std::vector<uint8_t> data(size);
+    size_t got = 0;
+    while (got < size) {
+      int r = gzread(gz, data.data() + got,
+                     static_cast<unsigned>(size - got));
+      if (r <= 0) { gzclose(gz); throw std::runtime_error("tar: truncated"); }
+      got += r;
+    }
+    size_t pad = (512 - size % 512) % 512;
+    if (pad) {
+      uint8_t skip[512];
+      if (gzread(gz, skip, static_cast<unsigned>(pad)) !=
+          static_cast<int>(pad)) {
+        gzclose(gz);
+        throw std::runtime_error("tar: bad padding");
+      }
+    }
+    if (type == '0' || type == 0) files[full] = std::move(data);
+  }
+  gzclose(gz);
+  return files;
+}
+
+FileMap LoadPackage(const std::string& path) {
+  struct stat st;
+  if (stat(path.c_str(), &st) == 0 && S_ISDIR(st.st_mode)) {
+    FileMap files;
+    DIR* dir = opendir(path.c_str());
+    if (!dir) throw std::runtime_error("cannot open dir " + path);
+    while (dirent* ent = readdir(dir)) {
+      std::string name = ent->d_name;
+      if (name == "." || name == "..") continue;
+      std::string full = path + "/" + name;
+      if (stat(full.c_str(), &st) == 0 && S_ISREG(st.st_mode))
+        files[name] = ReadFile(full);
+    }
+    closedir(dir);
+    return files;
+  }
+  auto ends_with = [&](const char* suffix) {
+    size_t n = strlen(suffix);
+    return path.size() >= n &&
+           path.compare(path.size() - n, n, suffix) == 0;
+  };
+  if (ends_with(".zip")) return ReadZip(ReadFile(path));
+  if (ends_with(".tar.gz") || ends_with(".tgz")) return ReadTarGz(path);
+  throw std::runtime_error("unknown package format: " + path);
+}
+
+}  // namespace veles_native

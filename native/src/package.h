@@ -1,0 +1,24 @@
+// Package reader: .zip (stored/deflate), .tar.gz and plain-directory
+// layouts into an in-memory file map.
+// Role parity: libVeles WorkflowArchive (src/workflow_archive.cc) which
+// wraps libarchive; here ZIP central-directory + tar formats are decoded
+// directly (deflate/gzip via zlib).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+using FileMap = std::map<std::string, std::vector<uint8_t>>;
+
+// Loads a package by path; dispatches on suffix (.zip → ZIP, .tar.gz/.tgz
+// → gzipped tar, anything that stats as a directory → per-file read).
+FileMap LoadPackage(const std::string& path);
+
+FileMap ReadZip(const std::vector<uint8_t>& blob);
+FileMap ReadTarGz(const std::string& path);
+
+}  // namespace veles_native

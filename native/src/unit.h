@@ -1,0 +1,83 @@
+// Unit: one inference-graph node.
+// Role parity: libVeles Unit (inc/veles/unit.h:105-190 — Run→Execute,
+// property assignment, output chaining) and UnitFactory
+// (inc/veles/unit_factory.h — static name→constructor registry).
+// The package's unit `type` string (veles_tpu/package.py MAPPING names)
+// keys the factory, replacing the reference's UUID scheme.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "engine.h"
+#include "json.h"
+#include "npy.h"
+
+namespace veles_native {
+
+using Shape = std::vector<int64_t>;
+
+inline int64_t NumElements(const Shape& shape) {
+  int64_t n = 1;
+  for (int64_t d : shape) n *= d;
+  return n;
+}
+
+class Unit {
+ public:
+  virtual ~Unit() = default;
+
+  // Consumes config + named arrays; computes and stores the output shape
+  // for `input_shape` (batch included as dim 0). Called once per batch
+  // geometry, before memory planning.
+  virtual void Initialize(const Json& config,
+                          std::map<std::string, NpyArray> arrays,
+                          const Shape& input_shape) = 0;
+
+  // Runs the forward computation `in` → `out` (dense f32, C-order,
+  // shapes as negotiated in Initialize). `scratch` points at this unit's
+  // arena slice of ScratchFloats() floats (nullptr when 0).
+  virtual void Execute(const float* in, float* out, float* scratch,
+                       Engine* engine) = 0;
+
+  // Scratch floats needed per execution (packed by MemoryOptimizer).
+  // `max_workers` = the engine's worker count: units that keep
+  // per-thread scratch size it accordingly.
+  virtual int64_t ScratchFloats(int max_workers) const {
+    (void)max_workers;
+    return 0;
+  }
+
+  const Shape& output_shape() const { return output_shape_; }
+  const Shape& input_shape() const { return input_shape_; }
+  const std::string& name() const { return name_; }
+  void set_name(const std::string& name) { name_ = name; }
+
+ protected:
+  Shape input_shape_;
+  Shape output_shape_;
+  std::string name_;
+};
+
+class UnitFactory {
+ public:
+  using Creator = std::function<std::unique_ptr<Unit>(const std::string&)>;
+
+  static UnitFactory& Instance();
+
+  void Register(const std::string& type, Creator creator);
+  std::unique_ptr<Unit> Create(const std::string& type) const;
+  std::vector<std::string> Types() const;
+
+ private:
+  std::map<std::string, Creator> creators_;
+};
+
+// Registers every built-in unit type (idempotent; called by Workflow).
+void RegisterStandardUnits();
+
+}  // namespace veles_native

@@ -1,0 +1,498 @@
+// Built-in forward units. Math mirrors veles_tpu/package.py's
+// PackagedRunner (the golden model) exactly: znicz activations
+// (tanh = 1.7159·tanh(0.6666x), relu = clipped softplus), im2col+sgemm
+// convolution, window pooling (stochastic → test-time expectation),
+// across-channel LRN, identity dropout, (x-mean)·disp normalization.
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstring>
+#include <stdexcept>
+
+#include "unit.h"
+
+namespace veles_native {
+
+namespace {
+
+// ---------------------------------------------------------------------
+// activations (znicz semantics, see veles_tpu/znicz/fused.py _ACT)
+
+enum class Act { kNone, kTanh, kSigmoid, kRelu, kStrictRelu };
+
+Act ParseAct(const Json& config) {
+  JsonPtr a = config.get("activation");
+  if (!a || a->is_null()) return Act::kNone;
+  const std::string& s = a->string_value();
+  if (s == "tanh") return Act::kTanh;
+  if (s == "sigmoid") return Act::kSigmoid;
+  if (s == "relu") return Act::kRelu;
+  if (s == "strict_relu") return Act::kStrictRelu;
+  if (s == "linear") return Act::kNone;
+  throw std::runtime_error("unknown activation " + s);
+}
+
+inline float ApplyAct(Act act, float z) {
+  switch (act) {
+    case Act::kNone: return z;
+    case Act::kTanh: return 1.7159f * std::tanh(0.6666f * z);
+    case Act::kSigmoid: return 1.0f / (1.0f + std::exp(-z));
+    case Act::kRelu: return std::log1p(std::exp(std::min(z, 30.0f)));
+    case Act::kStrictRelu: return std::max(z, 0.0f);
+  }
+  return z;
+}
+
+void ActRow(Act act, float* row, int64_t n) {
+  if (act == Act::kNone) return;
+  for (int64_t i = 0; i < n; ++i) row[i] = ApplyAct(act, row[i]);
+}
+
+// out[m,n] = x[m,k]·w[k,n] + b[n]; row-major, i-k-j loop order so the
+// inner loop streams both w and out rows.
+void Gemm(const float* x, const float* w, const float* b, float* out,
+          int64_t m, int64_t k, int64_t n, Engine* engine) {
+  engine->ParallelFor(m, [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      float* orow = out + i * n;
+      if (b) std::memcpy(orow, b, n * sizeof(float));
+      else std::memset(orow, 0, n * sizeof(float));
+      const float* xrow = x + i * k;
+      for (int64_t kk = 0; kk < k; ++kk) {
+        float xv = xrow[kk];
+        if (xv == 0.0f) continue;
+        const float* wrow = w + kk * n;
+        for (int64_t j = 0; j < n; ++j) orow[j] += xv * wrow[j];
+      }
+    }
+  });
+}
+
+Shape ShapeOf(const Json& config, const char* key) {
+  Shape s;
+  for (const auto& d : config.at(key)->array) s.push_back(d->integer());
+  return s;
+}
+
+// ---------------------------------------------------------------------
+
+class All2AllUnit : public Unit {
+ public:
+  void Initialize(const Json& config, std::map<std::string, NpyArray> arrays,
+                  const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    act_ = ParseAct(config);
+    softmax_ = config.has("is_softmax") && config.at("is_softmax")->boolean;
+    weights_ = std::move(arrays.at("weights"));
+    if (arrays.count("bias")) {
+      bias_ = std::move(arrays.at("bias"));
+      has_bias_ = true;
+    }
+    k_ = weights_.shape.at(0);
+    n_ = weights_.shape.at(1);
+    int64_t flat = 1;
+    for (size_t i = 1; i < input_shape.size(); ++i) flat *= input_shape[i];
+    if (flat != k_)
+      throw std::runtime_error(
+          "all2all: input " + std::to_string(flat) + " != weights rows " +
+          std::to_string(k_));
+    output_shape_ = {input_shape[0]};
+    for (const auto& d : config.at("output_sample_shape")->array)
+      output_shape_.push_back(d->integer());
+  }
+
+  void Execute(const float* in, float* out, float*, Engine* engine) override {
+    int64_t m = input_shape_[0];
+    Gemm(in, weights_.data.data(),
+         has_bias_ ? bias_.data.data() : nullptr, out, m, k_, n_, engine);
+    engine->ParallelFor(m, [&](int64_t begin, int64_t end) {
+      for (int64_t i = begin; i < end; ++i) {
+        float* row = out + i * n_;
+        if (softmax_) {
+          float mx = row[0];
+          for (int64_t j = 1; j < n_; ++j) mx = std::max(mx, row[j]);
+          float sum = 0.0f;
+          for (int64_t j = 0; j < n_; ++j) {
+            row[j] = std::exp(row[j] - mx);
+            sum += row[j];
+          }
+          for (int64_t j = 0; j < n_; ++j) row[j] /= sum;
+        } else {
+          ActRow(act_, row, n_);
+        }
+      }
+    });
+  }
+
+ private:
+  NpyArray weights_, bias_;
+  bool has_bias_ = false;
+  bool softmax_ = false;
+  Act act_ = Act::kNone;
+  int64_t k_ = 0, n_ = 0;
+};
+
+// input (B,H,W,C) × HWIO weights (ky,kx,C,K); padding (l,r,t,b),
+// sliding (sx,sy); im2col into scratch then one sgemm per batch chunk.
+class ConvUnit : public Unit {
+ public:
+  void Initialize(const Json& config, std::map<std::string, NpyArray> arrays,
+                  const Shape& input_shape) override {
+    if (input_shape.size() != 4)
+      throw std::runtime_error("conv: input must be rank 4 (NHWC)");
+    input_shape_ = input_shape;
+    act_ = ParseAct(config);
+    weights_ = std::move(arrays.at("weights"));
+    if (arrays.count("bias")) {
+      bias_ = std::move(arrays.at("bias"));
+      has_bias_ = true;
+    }
+    ky_ = weights_.shape.at(0);
+    kx_ = weights_.shape.at(1);
+    cin_ = weights_.shape.at(2);
+    k_ = weights_.shape.at(3);
+    Shape pad = ShapeOf(config, "padding");
+    left_ = pad[0]; right_ = pad[1]; top_ = pad[2]; bottom_ = pad[3];
+    Shape slide = ShapeOf(config, "sliding");
+    sx_ = slide[0]; sy_ = slide[1];
+    if (input_shape[3] != cin_)
+      throw std::runtime_error("conv: channel mismatch");
+    int64_t h = input_shape[1] + top_ + bottom_;
+    int64_t w = input_shape[2] + left_ + right_;
+    oh_ = (h - ky_) / sy_ + 1;
+    ow_ = (w - kx_) / sx_ + 1;
+    output_shape_ = {input_shape[0], oh_, ow_, k_};
+  }
+
+  int64_t ScratchFloats(int max_workers) const override {
+    // one im2col patch matrix (oh*ow, ky*kx*cin) per concurrent chunk;
+    // ParallelFor creates at most `workers` chunks, so `max_workers`
+    // slots can never be oversubscribed.
+    return oh_ * ow_ * ky_ * kx_ * cin_ * max_workers;
+  }
+
+  void Execute(const float* in, float* out, float* scratch,
+               Engine* engine) override {
+    int64_t batch = input_shape_[0];
+    int64_t h = input_shape_[1], w = input_shape_[2];
+    int64_t patch = ky_ * kx_ * cin_;
+    int64_t rows = oh_ * ow_;
+    std::atomic<int> slot_counter{0};
+    engine->ParallelFor(batch, [&](int64_t begin, int64_t end) {
+      int slot = slot_counter.fetch_add(1);
+      float* cols = scratch + slot * rows * patch;
+      for (int64_t b = begin; b < end; ++b) {
+        const float* img = in + b * h * w * cin_;
+        // im2col with implicit zero padding
+        for (int64_t oy = 0; oy < oh_; ++oy) {
+          for (int64_t ox = 0; ox < ow_; ++ox) {
+            float* dst = cols + (oy * ow_ + ox) * patch;
+            for (int64_t iy = 0; iy < ky_; ++iy) {
+              int64_t y = oy * sy_ + iy - top_;
+              for (int64_t ix = 0; ix < kx_; ++ix) {
+                int64_t x = ox * sx_ + ix - left_;
+                float* cell = dst + (iy * kx_ + ix) * cin_;
+                if (y < 0 || y >= h || x < 0 || x >= w) {
+                  std::memset(cell, 0, cin_ * sizeof(float));
+                } else {
+                  std::memcpy(cell, img + (y * w + x) * cin_,
+                              cin_ * sizeof(float));
+                }
+              }
+            }
+          }
+        }
+        // (rows, patch) × (patch, k) — weights HWIO are exactly
+        // row-major (ky·kx·cin, k)
+        float* dst = out + b * rows * k_;
+        for (int64_t r = 0; r < rows; ++r) {
+          float* orow = dst + r * k_;
+          if (has_bias_)
+            std::memcpy(orow, bias_.data.data(), k_ * sizeof(float));
+          else
+            std::memset(orow, 0, k_ * sizeof(float));
+          const float* crow = cols + r * patch;
+          for (int64_t p = 0; p < patch; ++p) {
+            float v = crow[p];
+            if (v == 0.0f) continue;
+            const float* wrow = weights_.data.data() + p * k_;
+            for (int64_t j = 0; j < k_; ++j) orow[j] += v * wrow[j];
+          }
+          ActRow(act_, orow, k_);
+        }
+      }
+    });
+  }
+
+ private:
+  NpyArray weights_, bias_;
+  bool has_bias_ = false;
+  Act act_ = Act::kNone;
+  int64_t ky_ = 0, kx_ = 0, cin_ = 0, k_ = 0;
+  int64_t left_ = 0, right_ = 0, top_ = 0, bottom_ = 0;
+  int64_t sx_ = 1, sy_ = 1;
+  int64_t oh_ = 0, ow_ = 0;
+};
+
+class PoolingUnit : public Unit {
+ public:
+  void Initialize(const Json& config, std::map<std::string, NpyArray>,
+                  const Shape& input_shape) override {
+    if (input_shape.size() != 4)
+      throw std::runtime_error("pooling: input must be rank 4 (NHWC)");
+    input_shape_ = input_shape;
+    kind_ = config.at("kind")->string_value();
+    kx_ = config.at("kx")->integer();
+    ky_ = config.at("ky")->integer();
+    Shape slide = ShapeOf(config, "sliding");
+    sx_ = slide[0]; sy_ = slide[1];
+    oh_ = (input_shape[1] - ky_) / sy_ + 1;
+    ow_ = (input_shape[2] - kx_) / sx_ + 1;
+    output_shape_ = {input_shape[0], oh_, ow_, input_shape[3]};
+  }
+
+  void Execute(const float* in, float* out, float*, Engine* engine) override {
+    int64_t batch = input_shape_[0];
+    int64_t h = input_shape_[1], w = input_shape_[2], c = input_shape_[3];
+    engine->ParallelFor(batch, [&](int64_t begin, int64_t end) {
+      std::vector<float> window(ky_ * kx_);
+      for (int64_t b = begin; b < end; ++b) {
+        const float* img = in + b * h * w * c;
+        float* dst = out + b * oh_ * ow_ * c;
+        for (int64_t oy = 0; oy < oh_; ++oy)
+          for (int64_t ox = 0; ox < ow_; ++ox)
+            for (int64_t ch = 0; ch < c; ++ch) {
+              int nw = 0;
+              for (int64_t iy = 0; iy < ky_; ++iy)
+                for (int64_t ix = 0; ix < kx_; ++ix)
+                  window[nw++] = img[((oy * sy_ + iy) * w +
+                                      ox * sx_ + ix) * c + ch];
+              dst[(oy * ow_ + ox) * c + ch] = Reduce(window);
+            }
+      }
+    });
+  }
+
+ private:
+  float Reduce(const std::vector<float>& window) const {
+    if (kind_ == "max")
+      return *std::max_element(window.begin(), window.end());
+    if (kind_ == "avg") {
+      float s = 0.0f;
+      for (float v : window) s += v;
+      return s / window.size();
+    }
+    if (kind_ == "maxabs") {
+      float best = window[0];
+      for (float v : window)
+        if (std::fabs(v) > std::fabs(best)) best = v;
+      return best;
+    }
+    // stochastic{,abs}: test-time expectation Σ pᵢ·xᵢ, pᵢ ∝ |xᵢ|
+    float mag_sum = 0.0f;
+    for (float v : window) mag_sum += std::fabs(v);
+    mag_sum = std::max(mag_sum, 1e-12f);
+    float acc = 0.0f;
+    bool abs_out = kind_ == "stochasticabs";
+    for (float v : window) {
+      float p = std::fabs(v) / mag_sum;
+      acc += p * (abs_out ? std::fabs(v) : v);
+    }
+    return acc;
+  }
+
+  std::string kind_;
+  int64_t kx_ = 2, ky_ = 2, sx_ = 2, sy_ = 2;
+  int64_t oh_ = 0, ow_ = 0;
+};
+
+// across-channel LRN: x / (k + α·Σ_{n-window} x²)^β  (last axis window)
+class LrnUnit : public Unit {
+ public:
+  void Initialize(const Json& config, std::map<std::string, NpyArray>,
+                  const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    output_shape_ = input_shape;
+    alpha_ = static_cast<float>(config.at("alpha")->num());
+    beta_ = static_cast<float>(config.at("beta")->num());
+    k_ = static_cast<float>(config.at("k")->num());
+    n_ = config.at("n")->integer();
+  }
+
+  void Execute(const float* in, float* out, float*, Engine* engine) override {
+    int64_t c = input_shape_.back();
+    int64_t rows = NumElements(input_shape_) / c;
+    int64_t half = n_ / 2;
+    engine->ParallelFor(rows, [&](int64_t begin, int64_t end) {
+      for (int64_t r = begin; r < end; ++r) {
+        const float* x = in + r * c;
+        float* y = out + r * c;
+        for (int64_t j = 0; j < c; ++j) {
+          float acc = 0.0f;
+          // window [j-half, j-half+n) clipped to [0, c)
+          for (int64_t d = 0; d < n_; ++d) {
+            int64_t idx = j - half + d;
+            if (idx >= 0 && idx < c) acc += x[idx] * x[idx];
+          }
+          y[j] = x[j] / std::pow(k_ + alpha_ * acc, beta_);
+        }
+      }
+    });
+  }
+
+ private:
+  float alpha_ = 1e-4f, beta_ = 0.75f, k_ = 2.0f;
+  int64_t n_ = 5;
+};
+
+class ActivationUnit : public Unit {
+ public:
+  void Initialize(const Json& config, std::map<std::string, NpyArray>,
+                  const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    output_shape_ = input_shape;
+    func_ = config.at("func")->string_value();
+    if (config.has("k")) k_ = static_cast<float>(config.at("k")->num());
+    static const char* known[] = {"tanh", "sigmoid", "relu",
+                                  "strict_relu", "log", "tanhlog",
+                                  "sincos", "mul"};
+    bool ok = false;
+    for (const char* f : known) ok |= (func_ == f);
+    if (!ok)  // validate here: Execute runs on pool threads where a
+              // throw would std::terminate
+      throw std::runtime_error("unknown func " + func_);
+  }
+
+  void Execute(const float* in, float* out, float*, Engine* engine) override {
+    int64_t total = NumElements(input_shape_);
+    int64_t last = input_shape_.back();
+    engine->ParallelFor(total, [&](int64_t begin, int64_t end) {
+      for (int64_t i = begin; i < end; ++i) {
+        float x = in[i];
+        if (func_ == "tanh") {
+          out[i] = 1.7159f * std::tanh(0.6666f * x);
+        } else if (func_ == "sigmoid") {
+          out[i] = 1.0f / (1.0f + std::exp(-x));
+        } else if (func_ == "relu") {
+          out[i] = std::log1p(std::exp(std::min(x, 30.0f)));
+        } else if (func_ == "strict_relu") {
+          out[i] = std::max(x, 0.0f);
+        } else if (func_ == "log") {
+          out[i] = std::log(x + std::sqrt(x * x + 1.0f));
+        } else if (func_ == "tanhlog") {
+          float t = 1.7159f * std::tanh(0.6666f * x);
+          out[i] = std::fabs(t) <= 1.7159f * 0.6666f
+              ? t
+              : std::copysign(
+                    std::log(std::fabs(x * 0.6666f * 1.7159f) + 1.0f), x);
+        } else if (func_ == "sincos") {
+          out[i] = (i % last) % 2 == 1 ? std::sin(x) : std::cos(x);
+        } else {  // "mul" (validated in Initialize)
+          out[i] = x * k_;
+        }
+      }
+    });
+  }
+
+ private:
+  std::string func_;
+  float k_ = 1.0f;
+};
+
+class DropoutUnit : public Unit {  // inference = identity
+ public:
+  void Initialize(const Json&, std::map<std::string, NpyArray>,
+                  const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    output_shape_ = input_shape;
+  }
+  void Execute(const float* in, float* out, float*, Engine*) override {
+    std::memcpy(out, in, NumElements(input_shape_) * sizeof(float));
+  }
+};
+
+class MeanDispUnit : public Unit {  // (x - mean) · disp
+ public:
+  void Initialize(const Json&, std::map<std::string, NpyArray> arrays,
+                  const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    output_shape_ = input_shape;
+    mean_ = std::move(arrays.at("mean"));
+    disp_ = std::move(arrays.at("disp"));
+    if (mean_.size() != disp_.size())
+      throw std::runtime_error("mean_disp: mean/disp size mismatch");
+    int64_t sample = NumElements(input_shape) / input_shape[0];
+    if (mean_.size() != sample)
+      throw std::runtime_error("mean_disp: sample size mismatch");
+  }
+
+  void Execute(const float* in, float* out, float*, Engine* engine) override {
+    int64_t batch = input_shape_[0];
+    int64_t sample = mean_.size();
+    engine->ParallelFor(batch, [&](int64_t begin, int64_t end) {
+      for (int64_t b = begin; b < end; ++b) {
+        const float* x = in + b * sample;
+        float* y = out + b * sample;
+        for (int64_t i = 0; i < sample; ++i)
+          y[i] = (x[i] - mean_.data[i]) * disp_.data[i];
+      }
+    });
+  }
+
+ private:
+  NpyArray mean_, disp_;
+};
+
+}  // namespace
+
+UnitFactory& UnitFactory::Instance() {
+  static UnitFactory factory;
+  return factory;
+}
+
+void UnitFactory::Register(const std::string& type, Creator creator) {
+  creators_[type] = std::move(creator);
+}
+
+std::unique_ptr<Unit> UnitFactory::Create(const std::string& type) const {
+  auto it = creators_.find(type);
+  if (it == creators_.end())
+    throw std::runtime_error("no unit registered for type " + type);
+  std::unique_ptr<Unit> unit = it->second(type);
+  unit->set_name(type);
+  return unit;
+}
+
+std::vector<std::string> UnitFactory::Types() const {
+  std::vector<std::string> out;
+  for (const auto& kv : creators_) out.push_back(kv.first);
+  return out;
+}
+
+void RegisterStandardUnits() {
+  UnitFactory& f = UnitFactory::Instance();
+  auto reg = [&f](std::initializer_list<const char*> names, auto maker) {
+    for (const char* n : names)
+      f.Register(n, [maker](const std::string&) -> std::unique_ptr<Unit> {
+        return maker();
+      });
+  };
+  reg({"all2all", "all2all_tanh", "all2all_sigmoid", "all2all_relu",
+       "all2all_strict_relu", "softmax"},
+      [] { return std::make_unique<All2AllUnit>(); });
+  reg({"conv", "conv_tanh", "conv_sigmoid", "conv_relu",
+       "conv_strict_relu"},
+      [] { return std::make_unique<ConvUnit>(); });
+  reg({"max_pooling", "maxabs_pooling", "avg_pooling",
+       "stochastic_pooling", "stochasticabs_pooling"},
+      [] { return std::make_unique<PoolingUnit>(); });
+  reg({"lrn"}, [] { return std::make_unique<LrnUnit>(); });
+  reg({"activation_tanh", "activation_sigmoid", "activation_relu",
+       "activation_strict_relu", "activation_log", "activation_tanhlog",
+       "activation_sincos", "activation_mul"},
+      [] { return std::make_unique<ActivationUnit>(); });
+  reg({"dropout"}, [] { return std::make_unique<DropoutUnit>(); });
+  reg({"mean_disp"}, [] { return std::make_unique<MeanDispUnit>(); });
+}
+
+}  // namespace veles_native

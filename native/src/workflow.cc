@@ -1,0 +1,112 @@
+#include "workflow.h"
+
+#include <cstring>
+#include <stdexcept>
+
+#include "json.h"
+#include "memory_optimizer.h"
+#include "npy.h"
+
+namespace veles_native {
+
+namespace {
+constexpr const char* kContents = "contents.json";
+}
+
+Workflow::Workflow(const std::string& path) : engine_(0) {
+  RegisterStandardUnits();
+  files_ = LoadPackage(path);
+  auto it = files_.find(kContents);
+  if (it == files_.end())
+    throw std::runtime_error("package has no contents.json");
+  contents_ = JsonParser::Parse(
+      std::string(it->second.begin(), it->second.end()));
+  if (contents_->at("format_version")->integer() != 1)
+    throw std::runtime_error("unsupported package format_version");
+  name_ = contents_->has("name")
+      ? contents_->at("name")->string_value() : "model";
+  JsonPtr ishape = contents_->get("input_shape");
+  if (ishape && !ishape->is_null())
+    for (const auto& d : ishape->array)
+      package_input_shape_.push_back(d->integer());
+}
+
+void Workflow::Initialize(int64_t batch) {
+  if (package_input_shape_.empty())
+    throw std::runtime_error("package has no input_shape");
+  input_shape_ = package_input_shape_;
+  input_shape_[0] = batch;
+
+  units_.clear();
+  Shape shape = input_shape_;
+  std::vector<MemoryNode> nodes;
+  // node 0: the input buffer, live from step 0 (copy-in) through step 1
+  // (the first unit's read); unit i's output node is produced at step
+  // i+1 and read at step i+2; unit scratch is live only during its step.
+  nodes.push_back({NumElements(shape), 0, 1, -1});
+  std::vector<size_t> out_node_of, scratch_node_of;
+
+  const auto& unit_list = contents_->at("units")->array;
+  int step = 1;
+  for (const auto& entry : unit_list) {
+    const std::string& type = entry->at("type")->string_value();
+    std::unique_ptr<Unit> unit = UnitFactory::Instance().Create(type);
+    if (entry->has("name"))
+      unit->set_name(entry->at("name")->string_value());
+    std::map<std::string, NpyArray> arrays;
+    for (const auto& kv : entry->at("arrays")->object) {
+      auto file = files_.find(kv.second->string_value());
+      if (file == files_.end())
+        throw std::runtime_error("missing array file " +
+                                 kv.second->string_value());
+      arrays[kv.first] = LoadNpy(file->second.data(), file->second.size());
+    }
+    unit->Initialize(*entry->at("config"), std::move(arrays), shape);
+    shape = unit->output_shape();
+
+    // output: written at `step`, read at `step+1` (next unit, or the
+    // final copy-out for the last unit)
+    out_node_of.push_back(nodes.size());
+    nodes.push_back({NumElements(shape), step, step + 1, -1});
+    int64_t scratch = unit->ScratchFloats(engine_.workers());
+    scratch_node_of.push_back(scratch ? nodes.size() : SIZE_MAX);
+    if (scratch) nodes.push_back({scratch, step, step, -1});
+    units_.push_back(std::move(unit));
+    ++step;
+  }
+
+  int64_t total = MemoryOptimizer::Optimize(&nodes);
+  arena_.assign(static_cast<size_t>(total), 0.0f);
+  input_buf_ = arena_.data() + nodes[0].offset;
+  unit_out_.clear();
+  unit_scratch_.clear();
+  for (size_t i = 0; i < units_.size(); ++i) {
+    unit_out_.push_back(arena_.data() + nodes[out_node_of[i]].offset);
+    unit_scratch_.push_back(
+        scratch_node_of[i] == SIZE_MAX
+            ? nullptr
+            : arena_.data() + nodes[scratch_node_of[i]].offset);
+  }
+}
+
+const Shape& Workflow::output_shape() const {
+  if (units_.empty())
+    throw std::runtime_error("workflow not initialized");
+  return units_.back()->output_shape();
+}
+
+void Workflow::Run(const float* input, float* output) {
+  if (units_.empty())
+    throw std::runtime_error("workflow not initialized");
+  std::memcpy(input_buf_, input,
+              NumElements(input_shape_) * sizeof(float));
+  const float* cur = input_buf_;
+  for (size_t i = 0; i < units_.size(); ++i) {
+    units_[i]->Execute(cur, unit_out_[i], unit_scratch_[i], &engine_);
+    cur = unit_out_[i];
+  }
+  std::memcpy(output, cur,
+              NumElements(output_shape()) * sizeof(float));
+}
+
+}  // namespace veles_native

@@ -1,0 +1,51 @@
+// Workflow: load a package, plan memory, run inference.
+// Role parity: libVeles WorkflowLoader (src/workflow_loader.cc:41-133 —
+// archive → unit creation → property assignment) + Workflow facade
+// (inc/veles/workflow.h:72-116 — Initialize(input)/Run()) with the
+// MemoryOptimizer arena pass from src/memory_optimizer.h:43-55.
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "engine.h"
+#include "package.h"
+#include "unit.h"
+
+namespace veles_native {
+
+class Workflow {
+ public:
+  // Loads + validates the package (contents.json checksum included).
+  explicit Workflow(const std::string& path);
+
+  // Builds units for a concrete batch size and packs the buffer arena.
+  // Must be called before Run; re-call to change the batch geometry.
+  void Initialize(int64_t batch);
+
+  // input: NumElements(input_shape()) floats; output buffer must hold
+  // NumElements(output_shape()) floats.
+  void Run(const float* input, float* output);
+
+  const Shape& input_shape() const { return input_shape_; }
+  const Shape& output_shape() const;
+  int64_t arena_floats() const { return arena_.size(); }
+  const std::string& name() const { return name_; }
+  size_t unit_count() const { return units_.size(); }
+
+ private:
+  FileMap files_;
+  JsonPtr contents_;
+  std::string name_;
+  Shape package_input_shape_;   // as exported (batch included)
+  Shape input_shape_;           // with the Initialize()-time batch
+  std::vector<std::unique_ptr<Unit>> units_;
+  std::vector<float*> unit_out_;      // arena pointer per unit output
+  std::vector<float*> unit_scratch_;  // arena pointer per unit scratch
+  float* input_buf_ = nullptr;
+  std::vector<float> arena_;
+  Engine engine_;
+};
+
+}  // namespace veles_native

@@ -1,0 +1,166 @@
+"""Native C++ runtime tests: packaged-model round trip through the
+ctypes bridge, compared against the Python golden runner — the TPU
+build's version of libVeles/tests (workflow_loader.cc,
+memory_optimizer.cc, numpy_array_loader.cc against mnist.zip fixtures).
+"""
+
+import numpy
+import pytest
+
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.memory import Vector
+from veles_tpu.package import PackagedRunner, export_package
+
+native = pytest.importorskip("veles_tpu.native")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    try:
+        return native.load_library()
+    except native.NativeError as e:
+        pytest.skip("native build unavailable: %s" % e)
+
+
+def _chain(units_spec, x):
+    """Builds + runs a unit chain on NumpyDevice; returns forwards."""
+    wf = DummyWorkflow()
+    dev = NumpyDevice()
+    forwards = []
+    inp = Vector(x.copy())
+    for ctor, kwargs in units_spec:
+        unit = ctor(wf, **kwargs)
+        unit.input = inp
+        unit.initialize(dev)
+        unit.numpy_run()
+        forwards.append(unit)
+        inp = unit.output
+    forwards[-1].output.map_read()
+    return forwards, numpy.array(forwards[-1].output.mem)
+
+
+def test_mlp_zip(lib, tmp_path):
+    from veles_tpu.znicz.all2all import All2AllSoftmax, All2AllTanh
+    rng = numpy.random.default_rng(0)
+    x = rng.standard_normal((8, 24)).astype(numpy.float32)
+    forwards, golden = _chain(
+        [(All2AllTanh, {"output_sample_shape": (16,)}),
+         (All2AllTanh, {"output_sample_shape": (12,)}),
+         (All2AllSoftmax, {"output_sample_shape": (5,)})], x)
+    path = str(tmp_path / "mlp.zip")
+    export_package(forwards, path, with_stablehlo=False)
+    with native.NativeWorkflow(path) as wf:
+        out = wf.run(x)
+        assert out.shape == golden.shape
+        assert numpy.allclose(out, golden, atol=1e-5)
+        assert numpy.allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_convnet_tgz(lib, tmp_path):
+    from veles_tpu.znicz.all2all import All2AllSoftmax
+    from veles_tpu.znicz.conv import ConvTanh
+    from veles_tpu.znicz.normalization_units import LRNormalizerForward
+    from veles_tpu.znicz.pooling import AvgPooling, MaxPooling
+    rng = numpy.random.default_rng(1)
+    x = rng.standard_normal((4, 12, 12, 3)).astype(numpy.float32)
+    forwards, golden = _chain(
+        [(ConvTanh, {"n_kernels": 5, "kx": 3, "ky": 3,
+                     "padding": (1, 1, 1, 1)}),
+         (MaxPooling, {"kx": 2, "ky": 2}),
+         (LRNormalizerForward, {}),
+         (ConvTanh, {"n_kernels": 4, "kx": 3, "ky": 3,
+                     "sliding": (2, 2)}),
+         (AvgPooling, {"kx": 2, "ky": 2}),
+         (All2AllSoftmax, {"output_sample_shape": (7,)})], x)
+    path = str(tmp_path / "conv.tar.gz")
+    export_package(forwards, path, with_stablehlo=False)
+    with native.NativeWorkflow(path) as wf:
+        out = wf.run(x)
+        # conv epsilon: im2col accumulation order differs from XLA
+        assert numpy.allclose(out, golden, atol=1e-3)
+
+
+def test_batch_reinitialize(lib, tmp_path):
+    """Changing batch size re-plans the arena (resume-like property)."""
+    from veles_tpu.znicz.all2all import All2AllTanh
+    rng = numpy.random.default_rng(2)
+    x8 = rng.standard_normal((8, 10)).astype(numpy.float32)
+    forwards, _ = _chain(
+        [(All2AllTanh, {"output_sample_shape": (6,)})], x8)
+    path = str(tmp_path / "m.zip")
+    export_package(forwards, path, with_stablehlo=False)
+    runner = PackagedRunner(path)
+    with native.NativeWorkflow(path) as wf:
+        for batch in (8, 3, 17):
+            xb = rng.standard_normal((batch, 10)).astype(numpy.float32)
+            assert numpy.allclose(wf.run(xb), runner.run(xb), atol=1e-5)
+
+
+def test_arena_packing(lib, tmp_path):
+    """MemoryOptimizer packs buffers: arena < sum of all buffers, and
+    ≥ the largest simultaneous pair (parity: memory_optimizer.cc)."""
+    from veles_tpu.znicz.all2all import All2AllTanh
+    rng = numpy.random.default_rng(3)
+    x = rng.standard_normal((4, 64)).astype(numpy.float32)
+    forwards, _ = _chain(
+        [(All2AllTanh, {"output_sample_shape": (64,)}),
+         (All2AllTanh, {"output_sample_shape": (64,)}),
+         (All2AllTanh, {"output_sample_shape": (64,)}),
+         (All2AllTanh, {"output_sample_shape": (64,)})], x)
+    path = str(tmp_path / "deep.zip")
+    export_package(forwards, path, with_stablehlo=False)
+    with native.NativeWorkflow(path) as wf:
+        wf.initialize(4)
+        buffers = 5 * 4 * 64  # input + 4 outputs, all (4, 64)
+        # pairwise liveness → 2 buffers' worth, never all 5
+        assert wf.arena_floats == 2 * 4 * 64
+        assert wf.arena_floats < buffers
+
+
+def test_activation_and_dropout_units(lib, tmp_path):
+    from veles_tpu.znicz.activation import ForwardSigmoid, ForwardTanh
+    from veles_tpu.znicz.normalization_units import DropoutForward
+    rng = numpy.random.default_rng(4)
+    x = rng.standard_normal((6, 9)).astype(numpy.float32)
+    wf = DummyWorkflow()
+    dev = NumpyDevice()
+    tanh = ForwardTanh(wf)
+    tanh.input = Vector(x.copy())
+    tanh.initialize(dev)
+    tanh.numpy_run()
+    drop = DropoutForward(wf, dropout_ratio=0.4)
+    drop.input = tanh.output
+    drop.forward_mode <<= True   # inference: identity
+    drop.initialize(dev)
+    drop.numpy_run()
+    sig = ForwardSigmoid(wf)
+    sig.input = drop.output
+    sig.initialize(dev)
+    sig.numpy_run()
+    sig.output.map_read()
+    golden = numpy.array(sig.output.mem)
+    path = str(tmp_path / "acts.zip")
+    export_package([tanh, drop, sig], path, with_stablehlo=False)
+    with native.NativeWorkflow(path) as nwf:
+        assert numpy.allclose(nwf.run(x), golden, atol=1e-5)
+
+
+def test_fp16_package(lib, tmp_path):
+    from veles_tpu.znicz.all2all import All2AllSoftmax
+    rng = numpy.random.default_rng(5)
+    x = rng.standard_normal((3, 15)).astype(numpy.float32)
+    forwards, golden = _chain(
+        [(All2AllSoftmax, {"output_sample_shape": (4,)})], x)
+    path = str(tmp_path / "m16.zip")
+    export_package(forwards, path, precision=16, with_stablehlo=False)
+    with native.NativeWorkflow(path) as wf:
+        assert numpy.allclose(wf.run(x), golden, atol=5e-2)
+
+
+def test_corrupt_package_raises(lib, tmp_path):
+    path = str(tmp_path / "junk.zip")
+    with open(path, "wb") as f:
+        f.write(b"this is not a zip")
+    with pytest.raises(native.NativeError):
+        native.NativeWorkflow(path)

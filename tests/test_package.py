@@ -143,7 +143,10 @@ def test_mean_disp_round_trip(tmp_path):
     unit.output.map_read()
     golden = numpy.array(unit.output.mem)
     path = str(tmp_path / "md.zip")
-    export_package([unit], path, with_stablehlo=False)
+    # default with_stablehlo=True: a chain without a jax pure form must
+    # still package (the StableHLO artifact is just skipped)
+    contents = export_package([unit], path)
+    assert "stablehlo" not in contents
     out = PackagedRunner(path).run(x)
     assert numpy.allclose(out, golden, atol=1e-5)
 

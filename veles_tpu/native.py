@@ -1,0 +1,158 @@
+"""ctypes bridge to the native C++ inference runtime (``native/``).
+
+Parity target: the reference's Python↔C++ seam — Python trains and
+``package_export``s, libVeles runs the forward pass natively
+(SURVEY §2.8).  pybind11 is not in this image, so the binding is a thin
+ctypes layer over the extern-C API in ``native/src/capi.cc``.
+
+``NativeWorkflow`` builds the shared library on first use (``make`` in
+``native/``) and caches it; set ``VELES_NATIVE_LIB`` to use a prebuilt
+.so instead.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_NAME = "libveles_native.so"
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class NativeError(RuntimeError):
+    pass
+
+
+def _build_library():
+    result = subprocess.run(
+        ["make", "-C", _NATIVE_DIR], capture_output=True, text=True)
+    if result.returncode != 0:
+        raise NativeError("native build failed:\n%s\n%s"
+                          % (result.stdout, result.stderr))
+    return os.path.join(_NATIVE_DIR, _LIB_NAME)
+
+
+def load_library(rebuild=False):
+    """Loads (building if needed) the native runtime library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None and not rebuild:
+            return _lib
+        path = os.environ.get("VELES_NATIVE_LIB")
+        if not path:
+            path = os.path.join(_NATIVE_DIR, _LIB_NAME)
+            if rebuild or not os.path.exists(path):
+                path = _build_library()
+        lib = ctypes.CDLL(path)
+        lib.veles_native_load.restype = ctypes.c_void_p
+        lib.veles_native_load.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.veles_native_initialize.restype = ctypes.c_int
+        lib.veles_native_initialize.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_char_p,
+            ctypes.c_int]
+        lib.veles_native_output_shape.restype = ctypes.c_int
+        lib.veles_native_output_shape.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int]
+        lib.veles_native_input_shape.restype = ctypes.c_int
+        lib.veles_native_input_shape.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int]
+        lib.veles_native_arena_floats.restype = ctypes.c_longlong
+        lib.veles_native_arena_floats.argtypes = [ctypes.c_void_p]
+        lib.veles_native_run.restype = ctypes.c_int
+        lib.veles_native_run.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_char_p,
+            ctypes.c_int]
+        lib.veles_native_destroy.restype = None
+        lib.veles_native_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeWorkflow(object):
+    """A loaded package running on the C++ runtime.
+
+    >>> wf = NativeWorkflow("model.zip")
+    >>> out = wf.run(x)              # batch taken from x
+    """
+
+    def __init__(self, path):
+        self._lib = load_library()
+        err = ctypes.create_string_buffer(1024)
+        handle = self._lib.veles_native_load(
+            path.encode(), err, len(err))
+        if not handle:
+            raise NativeError(err.value.decode() or "load failed")
+        self._handle = handle
+        self._batch = None
+
+    def initialize(self, batch):
+        err = ctypes.create_string_buffer(1024)
+        if self._lib.veles_native_initialize(
+                self._handle, batch, err, len(err)):
+            raise NativeError(err.value.decode() or "initialize failed")
+        self._batch = batch
+
+    @property
+    def input_shape(self):
+        dims = (ctypes.c_longlong * 16)()
+        rank = self._lib.veles_native_input_shape(self._handle, dims, 16)
+        if rank < 0:
+            raise NativeError("not initialized")
+        return tuple(dims[i] for i in range(rank))
+
+    @property
+    def output_shape(self):
+        dims = (ctypes.c_longlong * 16)()
+        rank = self._lib.veles_native_output_shape(self._handle, dims, 16)
+        if rank < 0:
+            raise NativeError("not initialized")
+        return tuple(dims[i] for i in range(rank))
+
+    @property
+    def arena_floats(self):
+        """Total packed-arena size (the MemoryOptimizer result)."""
+        return int(self._lib.veles_native_arena_floats(self._handle))
+
+    def run(self, x):
+        x = numpy.ascontiguousarray(x, numpy.float32)
+        if self._batch != x.shape[0]:
+            self.initialize(x.shape[0])
+        if tuple(x.shape) != self.input_shape:
+            raise NativeError("input shape %s != expected %s"
+                              % (x.shape, self.input_shape))
+        out = numpy.empty(self.output_shape, numpy.float32)
+        err = ctypes.create_string_buffer(1024)
+        rc = self._lib.veles_native_run(
+            self._handle,
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            err, len(err))
+        if rc:
+            raise NativeError(err.value.decode() or "run failed")
+        return out
+
+    def close(self):
+        if self._handle:
+            self._lib.veles_native_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -40,6 +40,9 @@ def _unit_export_entry(unit, array_refs):
     mapping = getattr(type(unit), "MAPPING", None)
     if mapping is None and type(unit).__name__ == "MeanDispNormalizer":
         mapping = "mean_disp"
+    if not mapping:
+        raise ValueError("unit %r (%s) is not packageable: no MAPPING"
+                         % (unit, type(unit).__name__))
     entry = {"type": mapping, "name": unit.name or mapping,
              "config": {}, "arrays": array_refs}
     if mapping.startswith("all2all") or mapping == "softmax":
@@ -103,18 +106,13 @@ def export_stablehlo(forwards, input_shape, dtype=numpy.float32):
     try:
         import jax
         from jax import export as jax_export
-    except Exception:
-        return None
-    fn = build_forward_fn(forwards)
-
-    def flat(x):
-        return fn(x)
-
-    try:
+        fn = build_forward_fn(forwards)
         spec = jax.ShapeDtypeStruct(tuple(input_shape), dtype)
-        exported = jax_export.export(jax.jit(flat))(spec)
+        exported = jax_export.export(jax.jit(fn))(spec)
         return exported.serialize()
     except Exception:
+        # units without a jax pure form (e.g. MeanDispNormalizer) or an
+        # unsupported chain: the interpretable package is still written
         return None
 
 
@@ -139,8 +137,6 @@ def build_forward_fn(forwards):
             steps.append(lambda x: x)  # inference: identity
             continue
         if mapping.endswith("pooling") and "stochastic" in mapping:
-            kind = "avg_of_probs"  # handled by runner below, not jax
-
             def step(x, p=params, c=cfg):
                 raise NotImplementedError(
                     "stochastic pooling has no jax test-time export")
@@ -306,8 +302,7 @@ def _np_conv(x, w, b, padding, sliding):
         for ix in range(kx):
             patch = x[:, iy:iy + oh * sy:sy, ix:ix + ow * sx:sx, :]
             cols[..., (iy * kx + ix) * cin:(iy * kx + ix + 1) * cin] = patch
-    out = cols.reshape(-1, ky * kx * cin) @ \
-        w.transpose(0, 1, 2, 3).reshape(ky * kx * cin, k)
+    out = cols.reshape(-1, ky * kx * cin) @ w.reshape(ky * kx * cin, k)
     out = out.reshape(bsz, oh, ow, k)
     if b is not None:
         out = out + b
