@@ -99,6 +99,12 @@ class All2AllUnit : public Unit {
     output_shape_ = {input_shape[0]};
     for (const auto& d : config.at("output_sample_shape")->array)
       output_shape_.push_back(d->integer());
+    // the output side is the memory-unsafe one: the arena slice is
+    // sized from output_shape_ but Gemm writes n_ floats per row
+    if (NumElements(output_shape_) != input_shape[0] * n_)
+      throw std::runtime_error(
+          "all2all: output_sample_shape product != weights cols " +
+          std::to_string(n_));
   }
 
   void Execute(const float* in, float* out, float*, Engine* engine) override {

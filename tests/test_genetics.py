@@ -1,0 +1,276 @@
+"""Genetics + ensemble meta-workflow tests (SURVEY §2.6): GA core
+convergence, Tuneable config scanning, optimizer modes (in-process and
+job-layer distributed), ensemble train/test aggregation."""
+
+import threading
+
+import numpy
+import pytest
+
+from veles_tpu.config import Config
+from veles_tpu.ensemble import EnsembleModelManager, EnsembleTestManager
+from veles_tpu.genetics import (
+    Choice, GeneSpec, GeneticsOptimizer, Population, Range,
+    decode_genome, fitness_from_results, scan_tuneables)
+
+
+def _cfg(tree):
+    cfg = Config("test_root")
+    cfg.update(tree)
+    return cfg
+
+
+class TestPopulation:
+    def test_converges_on_sphere(self):
+        """GA maximizes -(x-3)² - (y+1)² → optimum (3, -1)."""
+        specs = [GeneSpec(-10, 10), GeneSpec(-10, 10)]
+        pop = Population(specs, size=24, mutation_rate=0.2)
+        for _ in range(30):
+            for c in pop.pending:
+                x, y = c.genes
+                c.fitness = -((x - 3) ** 2 + (y + 1) ** 2)
+            pop.evolve()
+        best = pop.best
+        assert best.fitness > -0.5
+        assert abs(best.genes[0] - 3) < 1.0
+        assert abs(best.genes[1] + 1) < 1.0
+
+    @pytest.mark.parametrize("crossover", ["uniform", "one_point",
+                                           "two_point", "arithmetic"])
+    def test_crossover_kinds_respect_bounds(self, crossover):
+        specs = [GeneSpec(0, 1), GeneSpec(5, 10, is_int=True),
+                 GeneSpec(-2, 2)]
+        pop = Population(specs, size=8, crossover=crossover)
+        for c in pop.pending:
+            c.fitness = float(numpy.sum(c.genes))
+        pop.evolve()
+        for c in pop.chromosomes:
+            for spec, g in zip(specs, c.genes):
+                assert spec.min <= g <= spec.max
+            assert c.genes[1] == round(c.genes[1])   # int gene stays int
+
+    def test_tournament_selection(self):
+        specs = [GeneSpec(0, 1)]
+        pop = Population(specs, size=6, selection="tournament")
+        for c in pop.pending:
+            c.fitness = float(c.genes[0])
+        pop.evolve()
+        assert pop.generation == 1
+
+    def test_elitism_preserves_best(self):
+        specs = [GeneSpec(0, 100)]
+        pop = Population(specs, size=10, mutation_rate=1.0)
+        for c in pop.pending:
+            c.fitness = float(c.genes[0])
+        best_before = pop.best.genes[0]
+        pop.evolve()
+        assert any(c.genes[0] == best_before for c in pop.chromosomes)
+
+
+class TestTuneables:
+    def test_scan_and_decode(self):
+        cfg = _cfg({
+            "lr": Range(0.01, 0.001, 0.1),
+            "layers": {"hidden": Range(100, 10, 500)},
+            "act": Choice("tanh", "relu", "sigmoid"),
+            "fixed": 42,
+        })
+        tuneables = scan_tuneables(cfg)
+        paths = [p for p, _ in tuneables]
+        assert paths == ["act", "layers.hidden", "lr"]
+        values = decode_genome(tuneables, [0.0, 250.4, 0.05])
+        assert values["act"] == "tanh"
+        assert values["layers.hidden"] == 250
+        assert abs(values["lr"] - 0.05) < 1e-12
+
+    def test_int_range_detection(self):
+        assert Range(10, 1, 100).is_int
+        assert not Range(0.1, 0.0, 1.0).is_int
+        assert not Range(10, 1, 100.0).is_int
+
+    def test_fitness_from_results(self):
+        assert fitness_from_results({"fitness": 3.5}) == 3.5
+        assert fitness_from_results(
+            {"best_validation_error_pt": 2.0}) == -2.0
+        assert fitness_from_results({"accuracy": 0.9}) == 0.9
+        assert fitness_from_results({"x": 1.0}, fitness_key="x") == 1.0
+        with pytest.raises(ValueError):
+            fitness_from_results({"note": "text"})
+
+
+class TestGeneticsOptimizer:
+    def test_in_process_optimization(self):
+        cfg = _cfg({"x": Range(0.0, -5.0, 5.0),
+                    "y": Range(0.0, -5.0, 5.0)})
+
+        def evaluate(overrides):
+            return -((overrides["x"] - 2) ** 2 +
+                     (overrides["y"] + 2) ** 2)
+
+        opt = GeneticsOptimizer(population_size=16, generations=15,
+                                config=cfg, evaluate=evaluate)
+        best = opt.run()
+        assert best.fitness > -1.0
+        assert abs(best.config_overrides["x"] - 2) < 1.5
+        assert opt.evaluations >= 16
+
+    def test_requires_tuneables(self):
+        with pytest.raises(ValueError, match="no Tuneable"):
+            GeneticsOptimizer(config=_cfg({"a": 1}))
+
+    def test_result_file(self, tmp_path):
+        cfg = _cfg({"x": Range(0.5, 0.0, 1.0)})
+        path = str(tmp_path / "ga.json")
+        opt = GeneticsOptimizer(
+            population_size=4, generations=2, config=cfg,
+            evaluate=lambda o: o["x"], result_file=path)
+        best = opt.run()
+        import json
+        payload = json.load(open(path))
+        assert payload["fitness"] == best.fitness
+        assert "x" in payload["overrides"]
+
+    def test_distributed_over_job_layer(self):
+        """GA chromosomes as slave jobs through the real ZMQ job layer
+        (parity: optimization_workflow.py:186 + server/client FSM)."""
+        from veles_tpu.parallel.jobs import JobClient, JobServer
+        cfg = _cfg({"x": Range(0.0, -4.0, 4.0)})
+        opt = GeneticsOptimizer(population_size=6, generations=3,
+                                config=cfg, evaluate=None)
+        server = JobServer(opt).start()
+        port = server.port
+
+        class GAWorker:
+            def __init__(self):
+                self.jobs = 0
+
+            def checksum(self):
+                return opt.checksum()
+
+            def do_job(self, job, callback):
+                self.jobs += 1
+                x = job["overrides"]["x"]
+                callback({"fitness": -(x - 1.0) ** 2})
+
+        workers = [GAWorker() for _ in range(2)]
+        threads = []
+        clients = []
+        for worker in workers:
+            client = JobClient(worker, server.endpoint)
+            client.handshake()
+            clients.append(client)
+            t = threading.Thread(target=client.run)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=60)
+        for client in clients:
+            client.close()
+        server.stop()
+        assert opt.best is not None
+        assert opt.best.fitness <= 0.0
+        assert sum(w.jobs for w in workers) >= 6
+        assert opt.population.generation >= 2
+
+
+QUAD_WORKFLOW = '''
+"""Toy workflow: one unit computing (x-2)^2 from config (GA target)."""
+from veles_tpu.config import root
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Workflow
+
+
+class Quad(Unit):
+    def initialize(self, **kwargs):
+        pass
+
+    def run(self):
+        self.err = (float(root.quad.x) - 2.0) ** 2
+
+    def get_metric_values(self):
+        return {"err": self.err}
+
+
+def create_workflow(launcher=None):
+    wf = Workflow(launcher=launcher)
+    unit = Quad(wf)
+    unit.link_from(wf.start_point)
+    wf.end_point.link_from(unit)
+    return wf
+'''
+
+
+def test_subprocess_evaluation(tmp_path):
+    """Child `python -m veles_tpu` per chromosome with --result-file
+    read-back (ref optimization_workflow.py:268 _exec)."""
+    wf_path = tmp_path / "quad_workflow.py"
+    wf_path.write_text(QUAD_WORKFLOW)
+    cfg = _cfg({"quad": {"x": Range(0.0, -4.0, 4.0)}})
+    opt = GeneticsOptimizer(
+        population_size=3, generations=2, config=cfg,
+        workflow_spec=str(wf_path), extra_args=("-d", "numpy"))
+    best = opt.run()
+    assert best.fitness > -4.0          # fitness = -err
+    assert opt.evaluations >= 3
+    assert "quad.x" in best.config_overrides
+
+
+class TestEnsemble:
+    def test_train_and_test_in_process(self, tmp_path):
+        trained = []
+
+        def train(overrides):
+            trained.append(overrides)
+            return {"error": 1.0 / (1 + overrides["common.ensemble.index"])}
+
+        mgr = EnsembleModelManager(
+            size=4, train_ratio=0.8, evaluate=train,
+            result_file=str(tmp_path / "ens.json"))
+        listing = mgr.run()
+        assert len(listing["models"]) == 4
+        assert len({o["common.engine.seed"] for o in trained}) == 4
+        assert all(o["common.ensemble.train_ratio"] == 0.8
+                   for o in trained)
+
+        def test_member(overrides):
+            return {"n_err": 10 + overrides["common.ensemble.index"]}
+
+        tester = EnsembleTestManager(input_data=listing,
+                                     evaluate=test_member)
+        payload = tester.run()
+        assert payload["aggregate"]["n_err"] == 10 + (0 + 1 + 2 + 3) / 4
+
+    def test_loader_train_ratio_from_config(self):
+        """Loaders pick up root.common.ensemble.train_ratio (the manager
+        seam)."""
+        import numpy as np
+        from veles_tpu.config import root
+        from veles_tpu.backends import NumpyDevice
+        from veles_tpu.dummy import DummyWorkflow
+        from veles_tpu.loader.fullbatch import FullBatchLoader
+
+        class ArrayLoader(FullBatchLoader):
+            def load_data(self):
+                self.original_data.mem = np.zeros((100, 4), np.float32)
+                self.original_labels = [0] * 100
+                self.class_lengths[:] = (0, 0, 100)
+
+        root.common.ensemble.train_ratio = 0.5
+        try:
+            wf = DummyWorkflow()
+            loader = ArrayLoader(wf, minibatch_size=10)
+            loader.initialize(NumpyDevice())
+            assert loader.train_ratio == 0.5
+            # effective train size halved
+            assert loader._effective_class_end_offsets[2] - \
+                loader.class_end_offsets[1] == 50
+        finally:
+            root.common.ensemble.train_ratio = 1.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            EnsembleModelManager(size=0)
+        with pytest.raises(ValueError):
+            EnsembleModelManager(size=2, train_ratio=1.5)
+        with pytest.raises(ValueError):
+            EnsembleTestManager()

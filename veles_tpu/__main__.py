@@ -174,6 +174,11 @@ class Main(Logger):
         self._setup_logging()
         self._seed_random()
         self._apply_config()
+        # config may carry a seed (e.g. ensemble members get distinct
+        # streams via common.engine.seed); CLI --random-seed wins
+        cfg_seed = root.common.engine.get("seed", None)
+        if cfg_seed is not None and args.random_seed is None:
+            prng.seed_all(int(cfg_seed))
         if args.optimize:
             return self._run_optimization()
         if args.ensemble_train or args.ensemble_test:

@@ -1,0 +1,12 @@
+"""Ensembles: train N models on random train-subsets, test by voting.
+
+Parity target: reference ``veles/ensemble/`` — ``EnsembleModelManager``
+(``model_workflow.py:50``) spawning one child ``veles`` process per
+member (``base_workflow.py:135-150``) with ``train_ratio`` subsets
+(``loader/base.py:524``), and ``EnsembleTestManager``
+(``test_workflow.py:50``) evaluating the listing produced by training;
+results ride ``--result-file`` JSON (``workflow.py:827-851``).
+"""
+
+from veles_tpu.ensemble.manager import (     # noqa: F401
+    EnsembleModelManager, EnsembleTestManager)

@@ -1,0 +1,14 @@
+"""Genetic hyperparameter optimization (SURVEY §2.6).
+
+Reference: ``veles/genetics/`` — GA core (``core.py:371-430``),
+config-space markers (``config.py:45,110``), optimizer workflow
+(``optimization_workflow.py:70,298``).
+"""
+
+from veles_tpu.genetics.core import (        # noqa: F401
+    Chromosome, GeneSpec, Population)
+from veles_tpu.genetics.optimizer import (   # noqa: F401
+    GeneticsOptimizer, fitness_from_results)
+from veles_tpu.genetics.tune import (        # noqa: F401
+    Choice, Range, Tuneable, apply_values, decode_genome,
+    default_genome, scan_tuneables, specs_of)

@@ -1,0 +1,181 @@
+"""Genetic-algorithm core: chromosomes, selection, crossover, mutation.
+
+Parity target: reference ``veles/genetics/core.py`` — ``Population``
+(``:371-430``) with roulette/tournament selection and four crossover
+pipelines + mutation.  Genes are floats (optionally integer-rounded)
+inside per-gene [min, max] bounds; fitness is maximized.
+
+All randomness rides the named PRNG stream ``"genetics"``
+(:mod:`veles_tpu.prng`) so GA runs are reproducible and snapshottable.
+"""
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.logger import Logger
+
+
+class Chromosome(object):
+    """One candidate: genes (numpy float vector) + fitness (None until
+    evaluated; larger is better)."""
+
+    def __init__(self, genes):
+        self.genes = numpy.asarray(genes, numpy.float64)
+        self.fitness = None
+
+    def copy(self):
+        c = Chromosome(self.genes.copy())
+        c.fitness = self.fitness
+        return c
+
+    def __repr__(self):
+        return "<Chromosome %s fitness=%s>" % (
+            numpy.array2string(self.genes, precision=4), self.fitness)
+
+
+class GeneSpec(object):
+    """Bounds + integrality of one gene."""
+
+    def __init__(self, minimum, maximum, is_int=False):
+        if maximum < minimum:
+            raise ValueError("gene bounds inverted: [%s, %s]"
+                             % (minimum, maximum))
+        self.min = float(minimum)
+        self.max = float(maximum)
+        self.is_int = is_int
+
+    def clip(self, value):
+        v = min(max(float(value), self.min), self.max)
+        return float(round(v)) if self.is_int else v
+
+    def sample(self, rng):
+        return self.clip(self.min + (self.max - self.min) * rng.numpy.random())
+
+
+class Population(Logger):
+    """Fixed-size population evolved by select → crossover → mutate,
+    with elitism (the best chromosome always survives).
+
+    ``specs``: list of :class:`GeneSpec`.
+    """
+
+    def __init__(self, specs, size=20, crossover="uniform",
+                 selection="roulette", mutation_rate=0.1,
+                 mutation_sigma=0.15, tournament_k=3, elite=1):
+        super(Population, self).__init__()
+        if size < 2:
+            raise ValueError("population size must be >= 2")
+        self.specs = list(specs)
+        self.size = size
+        self.crossover_kind = crossover
+        self.selection_kind = selection
+        self.mutation_rate = mutation_rate
+        self.mutation_sigma = mutation_sigma
+        self.tournament_k = tournament_k
+        self.elite = elite
+        self.generation = 0
+        self.chromosomes = [
+            Chromosome([spec.sample(self.rng) for spec in self.specs])
+            for _ in range(size)]
+
+    @property
+    def rng(self):
+        return prng.get("genetics")
+
+    @property
+    def best(self):
+        scored = [c for c in self.chromosomes if c.fitness is not None]
+        return max(scored, key=lambda c: c.fitness) if scored else None
+
+    @property
+    def pending(self):
+        """Chromosomes awaiting evaluation."""
+        return [c for c in self.chromosomes if c.fitness is None]
+
+    # -- selection ----------------------------------------------------------
+    def _select(self):
+        if self.selection_kind == "tournament":
+            contenders = [
+                self.chromosomes[int(self.rng.randint(
+                    0, len(self.chromosomes)))]
+                for _ in range(self.tournament_k)]
+            return max(contenders, key=lambda c: c.fitness)
+        # roulette on fitness shifted to positive; non-finite fitnesses
+        # (failed evaluations report -inf) are floored to the worst
+        # finite value so they get zero weight instead of NaN-poisoning
+        # the whole distribution
+        fits = numpy.array([c.fitness for c in self.chromosomes],
+                           numpy.float64)
+        finite = fits[numpy.isfinite(fits)]
+        if finite.size == 0:
+            return self.chromosomes[
+                int(self.rng.randint(0, len(self.chromosomes)))]
+        fits = numpy.nan_to_num(fits, nan=finite.min(),
+                                posinf=finite.max(), neginf=finite.min())
+        shifted = fits - fits.min()
+        total = shifted.sum()
+        if total <= 0:
+            return self.chromosomes[
+                int(self.rng.randint(0, len(self.chromosomes)))]
+        probs = shifted / total
+        pick = self.rng.numpy.random()
+        acc = 0.0
+        for c, p in zip(self.chromosomes, probs):
+            acc += p
+            if pick <= acc:
+                return c
+        return self.chromosomes[-1]
+
+    # -- crossover ----------------------------------------------------------
+    def _crossover(self, a, b):
+        n = len(self.specs)
+        kind = self.crossover_kind
+        if kind == "uniform":
+            mask = numpy.array([self.rng.numpy.random() < 0.5
+                                for _ in range(n)])
+            genes = numpy.where(mask, a.genes, b.genes)
+        elif kind == "one_point":
+            point = int(self.rng.randint(1, max(n, 2)))
+            genes = numpy.concatenate([a.genes[:point], b.genes[point:]])
+        elif kind == "two_point":
+            p1 = int(self.rng.randint(1, max(n, 2)))
+            p2 = int(self.rng.randint(1, max(n, 2)))
+            p1, p2 = min(p1, p2), max(p1, p2)
+            genes = a.genes.copy()
+            genes[p1:p2] = b.genes[p1:p2]
+        elif kind == "arithmetic":
+            w = self.rng.numpy.random()
+            genes = w * a.genes + (1.0 - w) * b.genes
+        else:
+            raise ValueError("unknown crossover %r" % kind)
+        return Chromosome([spec.clip(g)
+                           for spec, g in zip(self.specs, genes)])
+
+    # -- mutation -----------------------------------------------------------
+    def _mutate(self, chromo):
+        for i, spec in enumerate(self.specs):
+            if self.rng.numpy.random() < self.mutation_rate:
+                span = spec.max - spec.min
+                chromo.genes[i] = spec.clip(
+                    chromo.genes[i] +
+                    self.rng.numpy.normal(0.0, self.mutation_sigma * span))
+        return chromo
+
+    # -- evolution ----------------------------------------------------------
+    def evolve(self):
+        """One generation step; every chromosome must be evaluated."""
+        if self.pending:
+            raise RuntimeError("%d chromosomes not evaluated yet"
+                               % len(self.pending))
+        ranked = sorted(self.chromosomes, key=lambda c: -c.fitness)
+        survivors = [c.copy() for c in ranked[:self.elite]]
+        while len(survivors) < self.size:
+            child = self._mutate(self._crossover(self._select(),
+                                                 self._select()))
+            child.fitness = None
+            survivors.append(child)
+        self.chromosomes = survivors
+        self.generation += 1
+        self.debug("generation %d: best=%s", self.generation,
+                   ranked[0].fitness)
+        return ranked[0]

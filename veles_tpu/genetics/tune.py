@@ -1,0 +1,116 @@
+"""Tuneable config markers: embed GA search ranges in the config tree.
+
+Parity target: reference ``veles/genetics/config.py`` — ``Tuneable``
+(``:45``) / ``Range`` (``:110``) wrappers placed directly on config
+values; the optimizer scans the tree for them and substitutes concrete
+values per chromosome.
+"""
+
+from veles_tpu.config import Config
+from veles_tpu.genetics.core import GeneSpec
+
+
+class Tuneable(object):
+    """Base marker: a config value the GA may vary."""
+
+    def spec(self):
+        raise NotImplementedError
+
+    def decode(self, gene):
+        """gene (float) → concrete config value."""
+        raise NotImplementedError
+
+
+class Range(Tuneable):
+    """Continuous (or integer) range [min, max] with a default."""
+
+    def __init__(self, default, minimum, maximum):
+        self.default = default
+        self.min = minimum
+        self.max = maximum
+        self.is_int = all(
+            isinstance(v, int) and not isinstance(v, bool)
+            for v in (default, minimum, maximum))
+
+    def spec(self):
+        return GeneSpec(self.min, self.max, is_int=self.is_int)
+
+    def decode(self, gene):
+        return int(round(gene)) if self.is_int else float(gene)
+
+    def __repr__(self):
+        return "Range(%r, %r, %r)" % (self.default, self.min, self.max)
+
+
+class Choice(Tuneable):
+    """Categorical choice encoded as an integer gene index."""
+
+    def __init__(self, default, *options):
+        if default not in options:
+            options = (default,) + options
+        self.options = list(options)
+        self.default = default
+
+    def spec(self):
+        return GeneSpec(0, len(self.options) - 1, is_int=True)
+
+    def decode(self, gene):
+        return self.options[int(round(gene))]
+
+    def __repr__(self):
+        return "Choice(%r, *%r)" % (self.default, self.options)
+
+
+def scan_tuneables(config):
+    """Walks a :class:`veles_tpu.config.Config` tree (or plain dict) and
+    returns sorted [(dotted_path, Tuneable)] for every marker found."""
+    found = []
+
+    def walk(node, path):
+        if isinstance(node, Config):
+            items = list(node)   # Config.__iter__ yields (key, value)
+        elif isinstance(node, dict):
+            items = list(node.items())
+        else:
+            return
+        for key, value in items:
+            sub = "%s.%s" % (path, key) if path else str(key)
+            if isinstance(value, Tuneable):
+                found.append((sub, value))
+            else:
+                walk(value, sub)
+
+    walk(config, "")
+    found.sort(key=lambda pair: pair[0])
+    return found
+
+
+def specs_of(tuneables):
+    return [t.spec() for _, t in tuneables]
+
+
+def decode_genome(tuneables, genes):
+    """genes → {dotted_path: concrete value}."""
+    return {path: t.decode(g)
+            for (path, t), g in zip(tuneables, genes)}
+
+
+def default_genome(tuneables):
+    """The genes encoding every Tuneable's default value."""
+    genes = []
+    for _, t in tuneables:
+        if isinstance(t, Choice):
+            genes.append(float(t.options.index(t.default)))
+        else:
+            genes.append(float(t.default))
+    return genes
+
+
+def apply_values(config, values):
+    """Writes {dotted_path: value} into a Config tree."""
+    for path, value in values.items():
+        node = config
+        parts = path.split(".")
+        for part in parts[:-1]:
+            node = getattr(node, part)
+        setattr(node, parts[-1], value)

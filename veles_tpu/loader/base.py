@@ -66,7 +66,14 @@ class Loader(Unit):
         self.labels_mapping = {}
         self.shuffled_indices = Vector()
         self.shuffle_limit = kwargs.get("shuffle_limit", 2 ** 31)
-        self.train_ratio = kwargs.get("train_ratio", 1.0)
+        # ensemble members train on a subset; the manager communicates
+        # the ratio via config (ref loader/base.py:524 train_ratio)
+        if "train_ratio" in kwargs:
+            self.train_ratio = kwargs["train_ratio"]
+        else:
+            from veles_tpu.config import root
+            self.train_ratio = float(
+                root.common.ensemble.get("train_ratio", 1.0) or 1.0)
         self.testing = kwargs.get("testing", False)
         self.global_offset = 0
         self.samples_served = 0

@@ -28,6 +28,22 @@ class NativeError(RuntimeError):
     pass
 
 
+def _stale(lib_path):
+    """True when the .so is missing or older than any native source."""
+    if not os.path.exists(lib_path):
+        return True
+    built = os.path.getmtime(lib_path)
+    src_dir = os.path.join(_NATIVE_DIR, "src")
+    try:
+        names = os.listdir(src_dir)
+    except OSError:
+        return False   # sources absent (prebuilt-only install)
+    return any(
+        name.endswith((".cc", ".h")) and
+        os.path.getmtime(os.path.join(src_dir, name)) > built
+        for name in names)
+
+
 def _build_library():
     result = subprocess.run(
         ["make", "-C", _NATIVE_DIR], capture_output=True, text=True)
@@ -46,7 +62,7 @@ def load_library(rebuild=False):
         path = os.environ.get("VELES_NATIVE_LIB")
         if not path:
             path = os.path.join(_NATIVE_DIR, _LIB_NAME)
-            if rebuild or not os.path.exists(path):
+            if rebuild or _stale(path):
                 path = _build_library()
         lib = ctypes.CDLL(path)
         lib.veles_native_load.restype = ctypes.c_void_p

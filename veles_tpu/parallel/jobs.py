@@ -169,10 +169,15 @@ class JobServer(Logger):
         if self._no_more_jobs:
             self._send(identity, {"op": "no_more_jobs"})
             return
-        from veles_tpu.workflow import NoMoreJobs
+        from veles_tpu.workflow import NoJobYet, NoMoreJobs
         with self._lock:
             try:
                 data = self.workflow.generate_data_for_slave(slave)
+            except NoJobYet:
+                # more jobs will appear (e.g. GA generation boundary):
+                # tell the slave to retry instead of quitting
+                self._send(identity, {"op": "wait"})
+                return
             except (StopIteration, NoMoreJobs):
                 data = None
         if data is None:
@@ -295,6 +300,9 @@ class JobClient(Logger):
             reply = self._rpc({"op": "job_request", "id": self.sid})
             if reply["op"] == "no_more_jobs":
                 break
+            if reply["op"] == "wait":
+                time.sleep(self.heartbeat_interval / 10.0)
+                continue
             if reply["op"] != "job":
                 raise ConnectionError("unexpected reply %r" % reply["op"])
             if self.death_probability and \

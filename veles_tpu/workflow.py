@@ -33,6 +33,12 @@ class NoMoreJobs(Exception):
     """Master has no further jobs for slaves (ref ``workflow.py:498``)."""
 
 
+class NoJobYet(Exception):
+    """Master has nothing to hand out *right now* but more jobs may
+    appear (e.g. a GA generation waiting on in-flight evaluations); the
+    slave should retry shortly instead of quitting."""
+
+
 class Workflow(Unit):
     """Container unit holding and executing a unit graph."""
 
