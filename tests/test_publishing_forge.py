@@ -1,0 +1,194 @@
+"""Publishing + Forge tests (SURVEY §2.5): report rendering across
+backends, and the model-hub round trip against a live local server on an
+ephemeral port (mirrors reference test_forge_server/test_forge_client)."""
+
+import json
+import os
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.forge import ForgeClient, ForgeError, ForgeServer
+from veles_tpu.publishing import (
+    Publisher, backend_names, get_backend)
+from veles_tpu.units import Unit
+
+
+class MetricUnit(Unit):
+    def initialize(self, **kwargs):
+        pass
+
+    def run(self):
+        pass
+
+    def get_metric_values(self):
+        return {"accuracy": 0.97, "n_err": 42}
+
+
+def _workflow():
+    wf = DummyWorkflow()
+    unit = MetricUnit(wf)
+    unit.link_from(wf.start_point)
+    wf.end_point.link_from(unit)
+    return wf
+
+
+class TestPublishing:
+    def test_backend_registry(self):
+        assert set(backend_names()) >= {"markdown", "html", "ipynb",
+                                        "confluence"}
+        with pytest.raises(ValueError, match="unknown"):
+            get_backend("pdfxx")
+
+    def test_publisher_writes_all_backends(self, tmp_path):
+        wf = _workflow()
+        pub = Publisher(wf, backends=("markdown", "html", "ipynb",
+                                      "confluence"),
+                        out_dir=str(tmp_path),
+                        description="Smoke-test report.")
+        pub.initialize()
+        pub.run()
+        assert len(pub.published) == 4
+        md = open(pub.published[0]).read()
+        assert "accuracy | 0.97" in md.replace("| accuracy | 0.97 |",
+                                               "accuracy | 0.97")
+        assert "Smoke-test report." in md
+        html = open(pub.published[1]).read()
+        assert "<td>accuracy</td><td>0.97</td>" in html
+        nb = json.load(open(pub.published[2]))
+        assert nb["nbformat"] == 4
+        assert any("accuracy" in "".join(c["source"])
+                   for c in nb["cells"])
+        confluence = open(pub.published[3]).read()
+        assert "||Metric||Value||" in confluence
+
+    def test_publisher_rejects_unknown_backend(self):
+        wf = _workflow()
+        pub = Publisher(wf, backends=("nope",))
+        with pytest.raises(ValueError):
+            pub.initialize()
+
+    def test_report_contains_graph_and_stats(self, tmp_path):
+        wf = _workflow()
+        wf.initialize()
+        wf.run()
+        pub = Publisher(wf, backends=("markdown",),
+                        out_dir=str(tmp_path))
+        info = pub.gather_info()
+        assert info["results"]["accuracy"] == 0.97
+        assert info["checksum"]
+        assert info["graph"] is None or "digraph" in info["graph"]
+
+
+@pytest.fixture
+def hub(tmp_path):
+    server = ForgeServer(str(tmp_path / "store"),
+                         tokens={"sekrit": "alice"}).start()
+    yield server
+    server.stop()
+
+
+def _make_package(tmp_path, name="m.zip"):
+    """A real exported package (manifest = contents.json)."""
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.memory import Vector
+    from veles_tpu.package import export_package
+    from veles_tpu.znicz.all2all import All2AllTanh
+    wf = DummyWorkflow()
+    fc = All2AllTanh(wf, output_sample_shape=(4,))
+    fc.input = Vector(numpy.zeros((2, 6), numpy.float32))
+    fc.initialize(NumpyDevice())
+    path = str(tmp_path / name)
+    export_package([fc], path, with_stablehlo=False)
+    return path
+
+
+class TestForge:
+    def test_upload_list_fetch_delete(self, hub, tmp_path):
+        pkg = _make_package(tmp_path)
+        client = ForgeClient(hub.endpoint, token="sekrit")
+        meta = client.upload("mnist-mlp", pkg)
+        assert meta["version"] == "v1"
+        assert meta["uploader"] == "alice"
+        assert meta["manifest"]["format_version"] == 1
+
+        listing = client.list()
+        assert [m["name"] for m in listing] == ["mnist-mlp"]
+        assert listing[0]["latest"] == "v1"
+
+        dest = str(tmp_path / "fetched.zip")
+        client.fetch("mnist-mlp", dest)
+        assert open(dest, "rb").read() == open(pkg, "rb").read()
+
+        # fetched package is loadable
+        from veles_tpu.package import PackagedRunner
+        runner = PackagedRunner(dest)
+        assert runner.contents["units"][0]["type"] == "all2all_tanh"
+
+        client.delete("mnist-mlp")
+        assert client.list() == []
+
+    def test_versioning(self, hub, tmp_path):
+        pkg = _make_package(tmp_path)
+        client = ForgeClient(hub.endpoint, token="sekrit")
+        client.upload("m", pkg)
+        client.upload("m", pkg, version="v2")
+        assert client.list()[0]["versions"] == ["v1", "v2"]
+        manifest = client.manifest("m", version="v1")
+        assert manifest["version"] == "v1"
+
+    def test_auth_required_for_writes(self, hub, tmp_path):
+        pkg = _make_package(tmp_path)
+        anon = ForgeClient(hub.endpoint)
+        with pytest.raises(ForgeError, match="token"):
+            anon.upload("m", pkg)
+        ForgeClient(hub.endpoint, token="sekrit").upload("m", pkg)
+        with pytest.raises(ForgeError, match="token"):
+            anon.delete("m")
+        # reads stay public
+        assert anon.list()[0]["name"] == "m"
+
+    def test_fetch_verifies_checksum(self, hub, tmp_path):
+        pkg = _make_package(tmp_path)
+        client = ForgeClient(hub.endpoint, token="sekrit")
+        client.upload("m", pkg)
+        # corrupt the stored package behind the server's back
+        mdir = os.path.join(hub.store.directory, "m")
+        victim = os.path.join(mdir, "v1.pkg")
+        blob = bytearray(open(victim, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(victim, "wb").write(bytes(blob))
+        with pytest.raises(ForgeError, match="checksum"):
+            client.fetch("m", str(tmp_path / "bad.zip"))
+
+    def test_path_traversal_rejected(self, hub, tmp_path):
+        """'..' and slash names must not escape the store directory."""
+        client = ForgeClient(hub.endpoint, token="sekrit")
+        pkg = _make_package(tmp_path)
+        for evil in ("..", ".", "a/b", "a%2Fb".replace("%2F", "/")):
+            with pytest.raises(ForgeError):
+                client.upload(evil, pkg)
+            with pytest.raises(ForgeError):
+                client.delete(evil)
+        # parent directory untouched
+        assert os.path.isdir(hub.store.directory)
+
+    def test_version_natural_order(self, hub, tmp_path):
+        """v10 sorts after v9; auto-versioning never collides."""
+        pkg = _make_package(tmp_path)
+        client = ForgeClient(hub.endpoint, token="sekrit")
+        for _ in range(11):
+            client.upload("m", pkg)
+        listing = client.list()[0]
+        assert listing["versions"][-2:] == ["v10", "v11"]
+        assert listing["latest"] == "v11"
+        # explicit version followed by auto must not overwrite
+        client.upload("n", pkg, version="v2")
+        meta = client.upload("n", pkg)
+        assert meta["version"] != "v2"
+
+    def test_missing_model_404(self, hub, tmp_path):
+        client = ForgeClient(hub.endpoint)
+        with pytest.raises(ForgeError, match="no such model"):
+            client.fetch("ghost", str(tmp_path / "x.zip"))

@@ -1,0 +1,101 @@
+"""Utility-script tests (SURVEY §2.5): snapshot diffing, frontend
+generation, forge CLI round trip."""
+
+import json
+import subprocess
+import sys
+
+import numpy
+import pytest
+
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.memory import Vector
+from veles_tpu.scripts.compare_snapshots import compare
+from veles_tpu.scripts.generate_frontend import generate
+from veles_tpu.units import Unit
+
+
+class WeightUnit(Unit):
+    def __init__(self, workflow, **kwargs):
+        super(WeightUnit, self).__init__(workflow, **kwargs)
+        self.weights = Vector()
+
+    def initialize(self, **kwargs):
+        pass
+
+    def run(self):
+        pass
+
+
+def _wf(scale):
+    wf = DummyWorkflow()
+    unit = WeightUnit(wf, name="W")
+    unit.weights.reset(numpy.full((3, 3), scale, numpy.float32))
+    unit.link_from(wf.start_point)
+    wf.end_point.link_from(unit)
+    return wf
+
+
+def test_compare_equal():
+    rows, worst = compare(_wf(1.0), _wf(1.0))
+    assert worst == 0.0
+    assert ("W.weights", "equal", 0.0) in rows
+
+
+def test_compare_different():
+    rows, worst = compare(_wf(1.0), _wf(2.0))
+    assert worst == 1.0
+    assert any(status == "DIFFERENT" for _, status, _ in rows)
+
+
+def test_compare_snapshot_files(tmp_path):
+    """End-to-end through real snapshot files + the CLI main()."""
+    from veles_tpu.scripts.compare_snapshots import main
+    from veles_tpu.snapshotter import save_snapshot
+    a, b = _wf(1.0), _wf(1.0)
+    pa = str(tmp_path / "a.snap.gz")
+    pb = str(tmp_path / "b.snap.gz")
+    save_snapshot(a, pa)
+    save_snapshot(b, pb)
+    assert main([pa, pb]) == 0
+
+
+def test_frontend_generation(tmp_path):
+    html = generate()
+    assert "<form" in html
+    assert "data-flag=\"--result-file\"" in html or \
+        "data-flag=\"--result-file" in html
+    assert "compose()" in html
+    # core positional + a sample of registered flags present
+    for flag in ("--listen", "--master-address", "--snapshot"):
+        assert flag in html, flag
+
+
+def test_forge_cli_round_trip(tmp_path):
+    from veles_tpu.forge import ForgeServer
+    from veles_tpu.scripts.forge_cli import main
+    from veles_tpu.package import export_package
+    from veles_tpu.znicz.all2all import All2AllTanh
+
+    wf = DummyWorkflow()
+    fc = All2AllTanh(wf, output_sample_shape=(3,))
+    fc.input = Vector(numpy.zeros((2, 5), numpy.float32))
+    fc.initialize(NumpyDevice())
+    pkg = str(tmp_path / "m.zip")
+    export_package([fc], pkg, with_stablehlo=False)
+
+    server = ForgeServer(str(tmp_path / "store"),
+                         tokens={"t": "u"}).start()
+    try:
+        assert main(["upload", "mlp", pkg, "--server", server.endpoint,
+                     "--token", "t"]) == 0
+        assert main(["list", "--server", server.endpoint]) == 0
+        dest = str(tmp_path / "out.zip")
+        assert main(["fetch", "mlp", dest,
+                     "--server", server.endpoint]) == 0
+        assert open(dest, "rb").read() == open(pkg, "rb").read()
+        assert main(["delete", "mlp", "--server", server.endpoint,
+                     "--token", "t"]) == 0
+    finally:
+        server.stop()

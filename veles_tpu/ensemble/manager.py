@@ -152,7 +152,19 @@ class EnsembleTestManager(_EnsembleBase):
             if self.evaluate is not None:
                 result = self.evaluate(overrides)
             else:
-                result = self._spawn(overrides, extra_args=("--test",))
+                # resume the member's trained snapshot (recorded by the
+                # Snapshotter's result metric); testing a fresh workflow
+                # would score random weights
+                snapshot = (member.get("results") or {}).get("snapshot")
+                extra = ("--test",)
+                if snapshot:
+                    extra += ("-w", snapshot)
+                else:
+                    self.warning(
+                        "member %s has no snapshot in its results — "
+                        "testing an untrained model (add a Snapshotter "
+                        "to the training workflow)", member["index"])
+                result = self._spawn(overrides, extra_args=extra)
             outputs.append({"index": member["index"], "results": result})
         payload = {"size": self.listing["size"], "tests": outputs,
                    "aggregate": self.aggregate(outputs)}

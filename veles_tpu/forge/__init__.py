@@ -1,0 +1,8 @@
+"""Forge: the model hub (SURVEY §2.5).
+
+Reference: ``veles/forge/`` — client verbs ``forge_client.py:101-396``,
+server ``forge_server.py:462`` (git-backed storage, tokens).
+"""
+
+from veles_tpu.forge.client import ForgeClient, ForgeError  # noqa: F401
+from veles_tpu.forge.server import ForgeServer, ForgeStore  # noqa: F401

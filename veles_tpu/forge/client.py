@@ -1,0 +1,87 @@
+"""Forge client: fetch/upload/list/delete model packages.
+
+Parity target: reference ``veles/forge/forge_client.py`` — ``fetch``
+(``:101``), ``upload`` (``:147``), ``list`` (``:298``), ``delete``
+(``:396``) against the hub, with manifest handling and checksum
+verification on fetch (the reference checked ``Workflow.checksum``,
+``workflow.py:852-866``).
+"""
+
+import hashlib
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from veles_tpu.logger import Logger
+
+
+class ForgeError(RuntimeError):
+    pass
+
+
+class ForgeClient(Logger):
+    def __init__(self, endpoint, token=None):
+        super(ForgeClient, self).__init__()
+        self.endpoint = endpoint.rstrip("/")
+        self.token = token
+
+    def _request(self, path, method="GET", data=None):
+        url = self.endpoint + path
+        req = urllib.request.Request(url, data=data, method=method)
+        if self.token:
+            req.add_header("X-Veles-Token", self.token)
+        if data is not None:
+            req.add_header("Content-Type", "application/octet-stream")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read().decode()).get("error")
+            except Exception:
+                detail = str(e)
+            raise ForgeError("%s %s failed: %s" % (method, path, detail))
+
+    # -- verbs (ref forge_client.py:101,147,298,396) ------------------------
+    def list(self):
+        return json.loads(self._request("/models").decode())
+
+    def upload(self, name, package_path, version=None):
+        with open(package_path, "rb") as fin:
+            blob = fin.read()
+        path = "/models/%s" % urllib.parse.quote(name, safe="")
+        if version:
+            path += "?version=%s" % urllib.parse.quote(version)
+        meta = json.loads(self._request(path, "POST", blob).decode())
+        self.info("uploaded %s %s (%d bytes, sha %s…)", name,
+                  meta["version"], meta["size"], meta["checksum"][:12])
+        return meta
+
+    def fetch(self, name, dest_path, version=None, verify=True):
+        path = "/models/%s" % urllib.parse.quote(name, safe="")
+        if version:
+            path += "?version=%s" % urllib.parse.quote(version)
+        blob = self._request(path)
+        if verify:
+            manifest = self.manifest(name, version)
+            expected = manifest.get("checksum")
+            actual = hashlib.sha256(blob).hexdigest()
+            if expected and actual != expected:
+                raise ForgeError(
+                    "checksum mismatch for %s: %s != %s"
+                    % (name, actual[:12], expected[:12]))
+        with open(dest_path, "wb") as fout:
+            fout.write(blob)
+        return dest_path
+
+    def manifest(self, name, version=None):
+        path = "/models/%s/manifest" % urllib.parse.quote(name, safe="")
+        if version:
+            path += "?version=%s" % urllib.parse.quote(version)
+        return json.loads(self._request(path).decode())
+
+    def delete(self, name):
+        self._request("/models/%s" % urllib.parse.quote(name, safe=""),
+                      "DELETE")
+        self.info("deleted %s", name)

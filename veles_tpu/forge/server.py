@@ -1,0 +1,263 @@
+"""Forge server: a model-hub HTTP service with versioned storage.
+
+Parity target: reference ``veles/forge/forge_server.py:462`` — Tornado
+server with git-backed package storage, per-user tokens and manifest
+handling.  TPU re-design: stdlib ``ThreadingHTTPServer`` (zero extra
+deps), content-addressed versioned directory storage (the git history
+role), token auth via a JSON file or an in-memory dict.
+
+REST surface (mirrors forge_client verbs fetch/upload/list/delete,
+``forge_client.py:101,147,298,396``):
+  GET    /models                     → JSON listing
+  GET    /models/<name>             → latest package bytes
+  GET    /models/<name>?version=V   → that version
+  GET    /models/<name>/manifest    → JSON manifest
+  POST   /models/<name>?version=V   → upload (X-Veles-Token required)
+  DELETE /models/<name>             → delete model (token required)
+"""
+
+import hashlib
+import io
+import json
+import os
+import threading
+import urllib.parse
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from veles_tpu.logger import Logger
+
+
+def _manifest_from_package(blob):
+    """Extracts contents.json from a .zip package blob (manifest role)."""
+    try:
+        with zipfile.ZipFile(io.BytesIO(blob)) as z:
+            return json.loads(z.read("contents.json").decode())
+    except Exception:
+        return {}
+
+
+class ForgeStore(object):
+    """Versioned model storage: ``<dir>/<name>/<version>.pkg`` +
+    ``manifest.json`` per version (content-addressed by sha256)."""
+
+    def __init__(self, directory):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def check_name(name):
+        """Reject path-traversal / unusable model names."""
+        if not name or name in (".", "..") or "/" in name or \
+                "\\" in name or "\x00" in name:
+            raise ValueError("invalid model name %r" % name)
+        return name
+
+    def _model_dir(self, name):
+        self.check_name(name)
+        safe = urllib.parse.quote(name, safe="")
+        if safe in (".", ".."):
+            raise ValueError("invalid model name %r" % name)
+        return os.path.join(self.directory, safe)
+
+    @staticmethod
+    def _version_key(version):
+        """Natural sort: v10 > v9 (digits compared numerically)."""
+        import re
+        return [int(tok) if tok.isdigit() else tok
+                for tok in re.split(r"(\d+)", version)]
+
+    def put(self, name, blob, version=None, uploader=None):
+        with self._lock:
+            mdir = self._model_dir(name)
+            os.makedirs(mdir, exist_ok=True)
+            checksum = hashlib.sha256(blob).hexdigest()
+            if version is None:
+                # next free number, collision-proof against explicit
+                # "vN" uploads (len()+1 could overwrite)
+                taken = {v for v in self.versions(name)}
+                n = len(taken) + 1
+                while "v%d" % n in taken:
+                    n += 1
+                version = "v%d" % n
+            if "/" in version or version in (".", ".."):
+                raise ValueError("invalid version %r" % version)
+            with open(os.path.join(mdir, version + ".pkg"), "wb") as f:
+                f.write(blob)
+            manifest = _manifest_from_package(blob)
+            meta = {"name": name, "version": version,
+                    "checksum": checksum, "size": len(blob),
+                    "uploader": uploader, "manifest": manifest}
+            with open(os.path.join(mdir, version + ".json"), "w") as f:
+                json.dump(meta, f, indent=1)
+            return meta
+
+    def versions(self, name):
+        mdir = self._model_dir(name)
+        if not os.path.isdir(mdir):
+            return []
+        return sorted(
+            (fname[:-4] for fname in os.listdir(mdir)
+             if fname.endswith(".pkg")), key=self._version_key)
+
+    def get(self, name, version=None):
+        versions = self.versions(name)
+        if not versions:
+            return None, None
+        version = version or versions[-1]
+        mdir = self._model_dir(name)
+        try:
+            with open(os.path.join(mdir, version + ".pkg"), "rb") as f:
+                blob = f.read()
+            with open(os.path.join(mdir, version + ".json"), "r") as f:
+                meta = json.load(f)
+            return blob, meta
+        except OSError:
+            return None, None
+
+    def delete(self, name):
+        with self._lock:
+            mdir = self._model_dir(name)
+            if not os.path.isdir(mdir):
+                return False
+            for fname in os.listdir(mdir):
+                os.unlink(os.path.join(mdir, fname))
+            os.rmdir(mdir)
+            return True
+
+    def listing(self):
+        out = []
+        for safe in sorted(os.listdir(self.directory)):
+            name = urllib.parse.unquote(safe)
+            versions = self.versions(name)
+            if not versions:
+                continue
+            _, meta = self.get(name)
+            out.append({"name": name, "versions": versions,
+                        "latest": versions[-1],
+                        "checksum": meta.get("checksum"),
+                        "size": meta.get("size")})
+        return out
+
+
+class ForgeServer(Logger):
+    """The hub service; ``tokens`` maps token → user name (uploads and
+    deletions require one; reads are public, like the reference)."""
+
+    def __init__(self, directory, tokens=None, host="127.0.0.1", port=0):
+        super(ForgeServer, self).__init__()
+        self.store = ForgeStore(directory)
+        self.tokens = dict(tokens or {})
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                server.debug("http: " + fmt, *args)
+
+            def _reply(self, code, payload, ctype="application/json"):
+                body = payload if isinstance(payload, bytes) else \
+                    json.dumps(payload, indent=1).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _auth(self):
+                token = self.headers.get("X-Veles-Token", "")
+                user = server.tokens.get(token)
+                if user is None:
+                    self._reply(401, {"error": "bad token"})
+                return user
+
+            def _parse(self):
+                parsed = urllib.parse.urlparse(self.path)
+                parts = [p for p in parsed.path.split("/") if p]
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                return parts, query
+
+            def _do_safely(self, fn):
+                try:
+                    fn()
+                except ValueError as e:   # bad model/version names
+                    self._reply(400, {"error": str(e)})
+
+            def do_GET(self):
+                self._do_safely(self._get)
+
+            def do_POST(self):
+                self._do_safely(self._post)
+
+            def do_DELETE(self):
+                self._do_safely(self._delete)
+
+            def _get(self):
+                parts, query = self._parse()
+                if parts == ["models"]:
+                    self._reply(200, server.store.listing())
+                    return
+                if len(parts) >= 2 and parts[0] == "models":
+                    name = urllib.parse.unquote(parts[1])
+                    blob, meta = server.store.get(
+                        name, query.get("version"))
+                    if blob is None:
+                        self._reply(404, {"error": "no such model"})
+                        return
+                    if len(parts) == 3 and parts[2] == "manifest":
+                        self._reply(200, meta)
+                    else:
+                        self._reply(200, blob,
+                                    "application/octet-stream")
+                    return
+                self._reply(404, {"error": "bad path"})
+
+            def _post(self):
+                parts, query = self._parse()
+                user = self._auth()
+                if user is None:
+                    return
+                if len(parts) == 2 and parts[0] == "models":
+                    name = urllib.parse.unquote(parts[1])
+                    length = int(self.headers.get("Content-Length", 0))
+                    blob = self.rfile.read(length)
+                    meta = server.store.put(
+                        name, blob, version=query.get("version"),
+                        uploader=user)
+                    self._reply(200, meta)
+                    return
+                self._reply(404, {"error": "bad path"})
+
+            def _delete(self):
+                parts, _ = self._parse()
+                user = self._auth()
+                if user is None:
+                    return
+                if len(parts) == 2 and parts[0] == "models":
+                    name = urllib.parse.unquote(parts[1])
+                    if server.store.delete(name):
+                        self._reply(200, {"deleted": name})
+                    else:
+                        self._reply(404, {"error": "no such model"})
+                    return
+                self._reply(404, {"error": "bad path"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.endpoint = "http://%s:%d" % (host, self.port)
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="forge-server")
+        self._thread.start()
+        self.info("forge server on %s (store: %s)", self.endpoint,
+                  self.store.directory)
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5)

@@ -85,6 +85,9 @@ class GeneticsOptimizer(Logger):
         self.result_file = result_file
         self.fitness_key = fitness_key
         self.extra_args = tuple(extra_args)
+        if generations is None and max_evaluations is None:
+            # `--optimize SIZE` without :GENERATIONS must terminate
+            generations = 10
         self.generations = generations
         self.max_evaluations = max_evaluations
         self.evaluations = 0

@@ -1,0 +1,13 @@
+"""Report publishing (SURVEY §2.5): Publisher unit + pluggable document
+backends (Markdown/HTML/IPYNB/Confluence markup).
+
+Reference: ``veles/publishing/`` — ``Publisher`` (``publisher.py:57``),
+backend registry (``registry.py:40``).
+"""
+
+from veles_tpu.publishing.backends import (     # noqa: F401
+    Backend, ConfluenceBackend, HtmlBackend, IpynbBackend,
+    MarkdownBackend)
+from veles_tpu.publishing.publisher import Publisher      # noqa: F401
+from veles_tpu.publishing.registry import (     # noqa: F401
+    backend_names, get_backend, register_backend)

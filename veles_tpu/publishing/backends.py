@@ -1,0 +1,181 @@
+"""Publishing backends: render a report-info dict to a document.
+
+Parity target: reference ``veles/publishing/*.py`` — Jinja2-templated
+Markdown/HTML/IPYNB/Confluence outputs (``publishing/registry.py:40``;
+``confluence.py:45``).  The PDF backend of the reference shelled out to
+LaTeX which is absent in this image, so HTML (printable) covers it; the
+Confluence backend emits wiki markup to a file instead of XML-RPC
+posting (zero egress), keeping the markup generation testable.
+"""
+
+import json
+
+import jinja2
+
+from veles_tpu.publishing.registry import register_backend
+
+_MD_TEMPLATE = jinja2.Template("""\
+# {{ name }} — training report
+
+{% if description %}{{ description }}
+
+{% endif %}\
+**Workflow checksum:** `{{ checksum }}`
+
+## Results
+{% if results %}\
+| Metric | Value |
+|---|---|
+{% for key, value in results | dictsort %}\
+| {{ key }} | {{ value }} |
+{% endfor %}\
+{% else %}_(no result providers)_
+{% endif %}
+## Unit run-time
+{% if stats %}\
+| Unit | Seconds | Share |
+|---|---|---|
+{% for name, seconds, share in stats %}\
+| {{ name }} | {{ "%.3f" | format(seconds) }} | {{ "%.1f" | format(share) }}% |
+{% endfor %}\
+{% endif %}
+## Configuration
+```
+{{ config | tojson(indent=1) }}
+```
+{% if graph %}
+## Workflow graph
+```dot
+{{ graph }}
+```
+{% endif %}\
+{% if plots %}
+## Plots
+{% for plot in plots %}![{{ plot }}]({{ plot }})
+{% endfor %}
+{% endif %}\
+""")
+
+_HTML_TEMPLATE = jinja2.Template("""\
+<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>{{ name }}</title>
+<style>
+body { font-family: sans-serif; margin: 2em; }
+table { border-collapse: collapse; }
+td, th { border: 1px solid #999; padding: 4px 10px; }
+pre { background: #f4f4f4; padding: 1em; overflow-x: auto; }
+</style></head><body>
+<h1>{{ name }} — training report</h1>
+{% if description %}<p>{{ description }}</p>{% endif %}
+<p><b>Workflow checksum:</b> <code>{{ checksum }}</code></p>
+<h2>Results</h2>
+{% if results %}<table><tr><th>Metric</th><th>Value</th></tr>
+{% for key, value in results | dictsort %}\
+<tr><td>{{ key }}</td><td>{{ value }}</td></tr>
+{% endfor %}</table>
+{% else %}<p><i>(no result providers)</i></p>{% endif %}
+<h2>Unit run-time</h2>
+<table><tr><th>Unit</th><th>Seconds</th><th>Share</th></tr>
+{% for name, seconds, share in stats %}\
+<tr><td>{{ name }}</td><td>{{ "%.3f" | format(seconds) }}</td>\
+<td>{{ "%.1f" | format(share) }}%</td></tr>
+{% endfor %}</table>
+<h2>Configuration</h2>
+<pre>{{ config | tojson(indent=1) }}</pre>
+{% if graph %}<h2>Workflow graph</h2><pre>{{ graph }}</pre>{% endif %}
+{% if plots %}<h2>Plots</h2>
+{% for plot in plots %}<img src="{{ plot }}" alt="{{ plot }}"/>
+{% endfor %}{% endif %}
+</body></html>
+""")
+
+_CONFLUENCE_TEMPLATE = jinja2.Template("""\
+h1. {{ name }} — training report
+{% if description %}{{ description }}{% endif %}
+*Workflow checksum:* {{ '{{' }}{{ checksum }}{{ '}}' }}
+h2. Results
+{% if results %}||Metric||Value||
+{% for key, value in results | dictsort %}\
+|{{ key }}|{{ value }}|
+{% endfor %}{% endif %}\
+h2. Unit run-time
+||Unit||Seconds||Share||
+{% for name, seconds, share in stats %}\
+|{{ name }}|{{ "%.3f" | format(seconds) }}|{{ "%.1f" | format(share) }}%|
+{% endfor %}\
+""")
+
+
+class Backend(object):
+    """Renders ``info`` (see ``Publisher.gather_info``) to ``path``."""
+
+    MAPPING = None
+    SUFFIX = None
+
+    def render(self, info):
+        raise NotImplementedError
+
+    def publish(self, info, path):
+        text = self.render(info)
+        with open(path, "w") as fout:
+            fout.write(text)
+        return path
+
+
+@register_backend
+class MarkdownBackend(Backend):
+    MAPPING = "markdown"
+    SUFFIX = ".md"
+
+    def render(self, info):
+        return _MD_TEMPLATE.render(**info)
+
+
+@register_backend
+class HtmlBackend(Backend):
+    MAPPING = "html"
+    SUFFIX = ".html"
+
+    def render(self, info):
+        return _HTML_TEMPLATE.render(**info)
+
+
+@register_backend
+class ConfluenceBackend(Backend):
+    MAPPING = "confluence"
+    SUFFIX = ".confluence"
+
+    def render(self, info):
+        return _CONFLUENCE_TEMPLATE.render(**info)
+
+
+@register_backend
+class IpynbBackend(Backend):
+    """Jupyter notebook with the report as cells (ref ipynb backend)."""
+
+    MAPPING = "ipynb"
+    SUFFIX = ".ipynb"
+
+    def render(self, info):
+        md = _MD_TEMPLATE.render(**info)
+        cells = [{
+            "cell_type": "markdown",
+            "metadata": {},
+            "source": md.splitlines(keepends=True),
+        }, {
+            "cell_type": "code",
+            "metadata": {},
+            "execution_count": None,
+            "outputs": [],
+            "source": [
+                "# the report's metrics as a dict\n",
+                "results = %s\n" % json.dumps(info.get("results", {}),
+                                              indent=1, default=str),
+            ],
+        }]
+        return json.dumps({
+            "cells": cells,
+            "metadata": {"language_info": {"name": "python"}},
+            "nbformat": 4,
+            "nbformat_minor": 5,
+        }, indent=1)

@@ -1,0 +1,81 @@
+"""Publisher unit: gathers results + graph + stats into a report.
+
+Parity target: reference ``veles/publishing/publisher.py:57`` — a unit
+linked at workflow end that collects ``IResultProvider`` metrics
+(``result_provider.py:41``), the workflow graph and plots, renders
+templates and hands off to registered backends.
+"""
+
+import json
+import os
+
+from veles_tpu.config import root
+from veles_tpu.units import Unit
+from veles_tpu.publishing.registry import get_backend
+
+
+def _jsonable(obj):
+    """Config trees carry sets/tuples/objects; reports need plain JSON."""
+    if isinstance(obj, (set, frozenset)):
+        return sorted(obj, key=str)
+    return repr(obj)
+
+
+class Publisher(Unit):
+    """Renders reports on run; link it before ``end_point``.
+
+    kwargs:
+      * ``backends``: iterable of backend names (default markdown+html)
+      * ``out_dir``: output directory (default root.common.dirs.user)
+      * ``description``: free-text report intro
+      * ``plots``: list of image paths to embed
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(Publisher, self).__init__(workflow, **kwargs)
+        self.backends = tuple(kwargs.get("backends",
+                                         ("markdown", "html")))
+        self.out_dir = kwargs.get("out_dir")
+        self.description = kwargs.get("description", "")
+        self.plots = list(kwargs.get("plots", ()))
+        self.published = []   # paths written by the last run
+
+    def initialize(self, device=None, **kwargs):
+        for name in self.backends:
+            get_backend(name)   # fail fast on typos
+
+    def gather_info(self):
+        wf = self.workflow
+        ranked = wf.get_unit_run_time_stats()
+        total = sum(seconds for _, seconds in ranked) or 1e-12
+        stats = [(unit.name, seconds, 100.0 * seconds / total)
+                 for unit, seconds in ranked if seconds > 0]
+        try:
+            graph = wf.generate_graph()
+        except Exception:
+            graph = None
+        return {
+            "name": wf.name,
+            "description": self.description,
+            "checksum": wf.checksum(),
+            "results": wf.gather_results(),
+            "stats": stats,
+            "config": json.loads(json.dumps(root.common.to_dict(),
+                                            default=_jsonable)),
+            "graph": graph,
+            "plots": self.plots,
+        }
+
+    def run(self):
+        info = self.gather_info()
+        out_dir = self.out_dir or root.common.dirs.get("user", ".")
+        os.makedirs(out_dir, exist_ok=True)
+        self.published = []
+        for name in self.backends:
+            backend = get_backend(name)()
+            path = os.path.join(
+                out_dir, "%s_report%s" % (self.workflow.name,
+                                          backend.SUFFIX))
+            backend.publish(info, path)
+            self.published.append(path)
+            self.info("published %s report to %s", name, path)

@@ -1,0 +1,30 @@
+"""Backend registry for report publishing.
+
+Parity target: reference ``veles/publishing/registry.py:40`` —
+``MappedObjectsRegistry`` metaclass mapping backend names to classes;
+here a module-level registry with a decorator keeps the same lookup
+contract without metaclass machinery.
+"""
+
+_BACKENDS = {}
+
+
+def register_backend(cls):
+    """Class decorator: registers ``cls.MAPPING`` → cls."""
+    name = getattr(cls, "MAPPING", None)
+    if not name:
+        raise ValueError("backend %r lacks MAPPING" % cls)
+    _BACKENDS[name] = cls
+    return cls
+
+
+def get_backend(name):
+    try:
+        return _BACKENDS[name]
+    except KeyError:
+        raise ValueError("unknown publishing backend %r (have: %s)"
+                         % (name, ", ".join(sorted(_BACKENDS))))
+
+
+def backend_names():
+    return sorted(_BACKENDS)

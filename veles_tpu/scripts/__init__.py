@@ -1,0 +1,3 @@
+"""Utility scripts (SURVEY §2.5): snapshot diffing, web-frontend
+generation, forge CLI — the reference's ``veles/scripts/`` equivalents.
+"""

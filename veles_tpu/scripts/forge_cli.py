@@ -1,0 +1,72 @@
+"""Forge command-line client (ref ``veles/scripts/update_forge.py`` and
+the ``forge`` console entry, ``setup.py:88-92``).
+
+Usage:
+  python -m veles_tpu.scripts.forge_cli list --server URL
+  python -m veles_tpu.scripts.forge_cli upload NAME PACKAGE --server URL --token T
+  python -m veles_tpu.scripts.forge_cli fetch NAME DEST --server URL
+  python -m veles_tpu.scripts.forge_cli delete NAME --server URL --token T
+  python -m veles_tpu.scripts.forge_cli serve DIR --port P --tokens T=user
+"""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="veles_tpu-forge")
+    sub = parser.add_subparsers(dest="verb", required=True)
+    for verb in ("list", "upload", "fetch", "delete", "serve"):
+        p = sub.add_parser(verb)
+        p.add_argument("--server", default="http://127.0.0.1:8180")
+        p.add_argument("--token", default=None)
+        if verb == "upload":
+            p.add_argument("name")
+            p.add_argument("package")
+            p.add_argument("--version", default=None)
+        elif verb == "fetch":
+            p.add_argument("name")
+            p.add_argument("dest")
+            p.add_argument("--version", default=None)
+        elif verb == "delete":
+            p.add_argument("name")
+        elif verb == "serve":
+            p.add_argument("directory")
+            p.add_argument("--port", type=int, default=8180)
+            p.add_argument("--tokens", nargs="*", default=(),
+                           metavar="TOKEN=USER")
+    args = parser.parse_args(argv)
+
+    if args.verb == "serve":
+        from veles_tpu.forge import ForgeServer
+        tokens = dict(pair.split("=", 1) for pair in args.tokens)
+        server = ForgeServer(args.directory, tokens=tokens,
+                             port=args.port).start()
+        print("forge server on %s — Ctrl-C to stop" % server.endpoint)
+        try:
+            import time
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            server.stop()
+        return 0
+
+    from veles_tpu.forge import ForgeClient
+    client = ForgeClient(args.server, token=args.token)
+    if args.verb == "list":
+        print(json.dumps(client.list(), indent=1))
+    elif args.verb == "upload":
+        print(json.dumps(client.upload(args.name, args.package,
+                                       version=args.version), indent=1))
+    elif args.verb == "fetch":
+        client.fetch(args.name, args.dest, version=args.version)
+        print("fetched %s → %s" % (args.name, args.dest))
+    elif args.verb == "delete":
+        client.delete(args.name)
+        print("deleted %s" % args.name)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

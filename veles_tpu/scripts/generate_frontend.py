@@ -1,0 +1,105 @@
+"""Generate an HTML frontend form from the CLI argument registry.
+
+Parity target: reference ``veles/scripts/generate_frontend.py`` — walks
+the ``CommandLineArgumentsRegistry`` parser and emits an HTML form whose
+inputs compose a ``veles`` command line (served by ``Main._open_frontend``
+``__main__.py:258-333``).
+
+Usage: ``python -m veles_tpu.scripts.generate_frontend [out.html]``
+"""
+
+import argparse
+import html
+import sys
+
+from veles_tpu.cmdline import make_parser
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>veles_tpu frontend</title>
+<style>
+body {{ font-family: sans-serif; margin: 2em; max-width: 60em; }}
+label {{ display: inline-block; min-width: 16em; font-weight: bold; }}
+.row {{ margin: 0.4em 0; }}
+.help {{ color: #666; font-size: 0.85em; margin-left: 16em; }}
+#cmdline {{ background: #f4f4f4; padding: 1em; font-family: monospace;
+            margin-top: 1.5em; white-space: pre-wrap; }}
+</style></head><body>
+<h1>veles_tpu launcher</h1>
+<form oninput="compose()" onchange="compose()">
+{rows}
+</form>
+<div id="cmdline">python -m veles_tpu</div>
+<script>
+function compose() {{
+  var parts = ["python -m veles_tpu"];
+  var fields = document.querySelectorAll("[data-flag]");
+  var positional = [];
+  fields.forEach(function(el) {{
+    var flag = el.getAttribute("data-flag");
+    if (el.type === "checkbox") {{
+      if (el.checked) parts.push(flag);
+    }} else if (el.value !== "" && el.value !== el.getAttribute(
+        "data-default")) {{
+      if (flag === "") positional.push(el.value);
+      else parts.push(flag + " " + el.value);
+    }} else if (flag === "" && el.value !== "") {{
+      positional.push(el.value);
+    }}
+  }});
+  document.getElementById("cmdline").textContent =
+      parts.concat(positional).join(" ");
+}}
+</script>
+</body></html>
+"""
+
+
+def _row(action):
+    name = action.option_strings[-1] if action.option_strings \
+        else action.dest
+    flag = action.option_strings[-1] if action.option_strings else ""
+    ident = "arg_%s" % action.dest
+    helptext = html.escape(action.help or "")
+    default = "" if action.default in (None, argparse.SUPPRESS) \
+        else html.escape(str(action.default))
+    if isinstance(action, (argparse._StoreTrueAction,
+                           argparse._StoreFalseAction)):
+        control = ('<input type="checkbox" id="%s" data-flag="%s"/>'
+                   % (ident, flag))
+    elif action.choices:
+        options = "".join('<option>%s</option>'
+                          % html.escape(str(c)) for c in action.choices)
+        control = ('<select id="%s" data-flag="%s" data-default="%s">'
+                   '<option value=""></option>%s</select>'
+                   % (ident, flag, default, options))
+    else:
+        control = ('<input type="text" id="%s" data-flag="%s" '
+                   'data-default="%s" placeholder="%s"/>'
+                   % (ident, flag, default, default))
+    return ('<div class="row"><label for="%s">%s</label>%s'
+            '<div class="help">%s</div></div>'
+            % (ident, html.escape(name), control, helptext))
+
+
+def generate():
+    # importing the components registers their arg contributions (the
+    # reference generated the form from whatever was in-process)
+    import veles_tpu.backends    # noqa: F401
+    import veles_tpu.launcher    # noqa: F401
+    parser = make_parser()
+    rows = [_row(action) for action in parser._actions
+            if not isinstance(action, argparse._HelpAction)]
+    return _PAGE.format(rows="\n".join(rows))
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    out = argv[0] if argv else "frontend.html"
+    with open(out, "w") as fout:
+        fout.write(generate())
+    print("wrote %s" % out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,6 +122,13 @@ class SnapshotterToFile(SnapshotterBase):
             pass
         self.info("snapshotted to %s (%.1f KiB)", path, size / 1024)
 
+    def get_metric_values(self):
+        """Publishes the snapshot path into result files so consumers
+        (e.g. EnsembleTestManager) can resume the trained model."""
+        if getattr(self, "destination", None):
+            return {"snapshot": self.destination}
+        return {}
+
     @staticmethod
     def import_(path):
         """Load a snapshot by path, auto-detecting the codec
@@ -136,3 +143,13 @@ class SnapshotterToFile(SnapshotterBase):
 def load_snapshot(path):
     """Module-level resume helper."""
     return SnapshotterToFile.import_(path)
+
+
+def save_snapshot(workflow, path):
+    """Module-level save helper; codec inferred from the path suffix."""
+    ext = path.rsplit(".", 1)[-1]
+    codec = ext if ext in CODECS else ""
+    opener = CODECS[codec][0]
+    with opener(path) as fout:
+        pickle.dump(workflow, fout, protocol=pickle.HIGHEST_PROTOCOL)
+    return path
