@@ -272,3 +272,55 @@ def test_downloader(tmp_path):
     unit.initialize()
     assert (dest / "data.txt").read_text() == "hello"
     assert unit.already_there
+
+
+def test_wav_loader(tmp_path):
+    """Stdlib-wave audio ingestion (libsndfile role, SURVEY §2.3)."""
+    import wave
+    import numpy
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.loader.formats import WavLoader
+
+    paths = []
+    for label in ("yes", "no"):
+        d = tmp_path / label
+        d.mkdir()
+        for i in range(3):
+            path = str(d / ("clip%d.wav" % i))
+            with wave.open(path, "wb") as w:
+                w.setnchannels(1)
+                w.setsampwidth(2)
+                w.setframerate(8000)
+                tone = (numpy.sin(numpy.arange(2000) * 0.1) *
+                        20000).astype("<i2")
+                w.writeframes(tone.tobytes())
+            paths.append(path)
+    wf = DummyWorkflow()
+    loader = WavLoader(wf, train_paths=paths, window=1024,
+                       minibatch_size=3)
+    loader.initialize(NumpyDevice())
+    assert loader.original_data.shape == (6, 1024)
+    assert sorted(set(loader.original_labels)) == ["no", "yes"]
+    assert float(numpy.abs(loader.original_data.mem).max()) <= 1.0
+
+
+def test_lmdb_loader_gated():
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.loader.base import LoaderError
+    from veles_tpu.loader.formats import LMDBLoader
+    loader = LMDBLoader(DummyWorkflow(), train_db="/nonexistent",
+                        minibatch_size=4)
+    with pytest.raises(LoaderError, match="lmdb"):
+        loader.load_data()
+
+
+def test_hdfs_loader_parses_lines():
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.loader.formats import HDFSTextLoader
+    loader = HDFSTextLoader(DummyWorkflow(),
+                            namenode="http://example:9870",
+                            minibatch_size=4)
+    rows, labels = loader._parse_lines("a\t1,2,3\nb\t4,5,6\n")
+    assert labels == ["a", "b"]
+    assert rows[1].tolist() == [4.0, 5.0, 6.0]

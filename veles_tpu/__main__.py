@@ -179,6 +179,12 @@ class Main(Logger):
         cfg_seed = root.common.engine.get("seed", None)
         if cfg_seed is not None and args.random_seed is None:
             prng.seed_all(int(cfg_seed))
+        if args.frontend:
+            from veles_tpu.scripts.generate_frontend import generate
+            with open(args.frontend, "w") as fout:
+                fout.write(generate())
+            self.info("wrote frontend form to %s", args.frontend)
+            return 0
         if args.optimize:
             return self._run_optimization()
         if args.ensemble_train or args.ensemble_test:

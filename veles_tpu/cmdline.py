@@ -100,6 +100,10 @@ def make_parser(prog="veles_tpu", description=None):
     parser.add_argument(
         "--ensemble-test", default="", metavar="INPUT_JSON",
         help="evaluate a trained ensemble listed in INPUT_JSON")
+    parser.add_argument(
+        "--frontend", default="", metavar="OUT_HTML",
+        help="generate the HTML command-composer form from the argument "
+             "registry and exit (ref scripts/generate_frontend.py)")
     for contribute in list(_CONTRIBUTORS):
         contribute(parser)
     return parser

@@ -59,15 +59,21 @@ class ForgeClient(Logger):
         return meta
 
     def fetch(self, name, dest_path, version=None, verify=True):
+        expected = None
+        if verify:
+            # resolve the manifest FIRST and pin its version for the
+            # blob request — otherwise a concurrent upload between the
+            # two requests makes the checksum spuriously mismatch
+            manifest = self.manifest(name, version)
+            expected = manifest.get("checksum")
+            version = version or manifest.get("version")
         path = "/models/%s" % urllib.parse.quote(name, safe="")
         if version:
             path += "?version=%s" % urllib.parse.quote(version)
         blob = self._request(path)
-        if verify:
-            manifest = self.manifest(name, version)
-            expected = manifest.get("checksum")
+        if expected:
             actual = hashlib.sha256(blob).hexdigest()
-            if expected and actual != expected:
+            if actual != expected:
                 raise ForgeError(
                     "checksum mismatch for %s: %s != %s"
                     % (name, actual[:12], expected[:12]))

@@ -81,8 +81,7 @@ class ForgeStore(object):
                 while "v%d" % n in taken:
                     n += 1
                 version = "v%d" % n
-            if "/" in version or version in (".", ".."):
-                raise ValueError("invalid version %r" % version)
+            self.check_version(version)
             with open(os.path.join(mdir, version + ".pkg"), "wb") as f:
                 f.write(blob)
             manifest = _manifest_from_package(blob)
@@ -101,7 +100,16 @@ class ForgeStore(object):
             (fname[:-4] for fname in os.listdir(mdir)
              if fname.endswith(".pkg")), key=self._version_key)
 
+    @staticmethod
+    def check_version(version):
+        if version is not None and (
+                "/" in version or "\\" in version or
+                version in (".", "..") or "\x00" in version):
+            raise ValueError("invalid version %r" % version)
+        return version
+
     def get(self, name, version=None):
+        self.check_version(version)
         versions = self.versions(name)
         if not versions:
             return None, None
@@ -110,11 +118,23 @@ class ForgeStore(object):
         try:
             with open(os.path.join(mdir, version + ".pkg"), "rb") as f:
                 blob = f.read()
-            with open(os.path.join(mdir, version + ".json"), "r") as f:
-                meta = json.load(f)
-            return blob, meta
+            return blob, self.meta(name, version)
         except OSError:
             return None, None
+
+    def meta(self, name, version=None):
+        """The small .json sidecar only (no package read)."""
+        self.check_version(version)
+        versions = self.versions(name)
+        if not versions:
+            return None
+        version = version or versions[-1]
+        try:
+            with open(os.path.join(self._model_dir(name),
+                                   version + ".json"), "r") as f:
+                return json.load(f)
+        except OSError:
+            return None
 
     def delete(self, name):
         with self._lock:
@@ -131,9 +151,14 @@ class ForgeStore(object):
         for safe in sorted(os.listdir(self.directory)):
             name = urllib.parse.unquote(safe)
             versions = self.versions(name)
+            # drop versions whose .json sidecar is missing (e.g. a crash
+            # between the non-atomic .pkg/.json writes) — one broken
+            # version must not take the whole listing down
+            versions = [v for v in versions
+                        if self.meta(name, v) is not None]
             if not versions:
                 continue
-            _, meta = self.get(name)
+            meta = self.meta(name, versions[-1])
             out.append({"name": name, "versions": versions,
                         "latest": versions[-1],
                         "checksum": meta.get("checksum"),
@@ -199,16 +224,20 @@ class ForgeServer(Logger):
                     return
                 if len(parts) >= 2 and parts[0] == "models":
                     name = urllib.parse.unquote(parts[1])
-                    blob, meta = server.store.get(
+                    if len(parts) == 3 and parts[2] == "manifest":
+                        meta = server.store.meta(
+                            name, query.get("version"))
+                        if meta is None:
+                            self._reply(404, {"error": "no such model"})
+                        else:
+                            self._reply(200, meta)
+                        return
+                    blob, _meta = server.store.get(
                         name, query.get("version"))
                     if blob is None:
                         self._reply(404, {"error": "no such model"})
                         return
-                    if len(parts) == 3 and parts[2] == "manifest":
-                        self._reply(200, meta)
-                    else:
-                        self._reply(200, blob,
-                                    "application/octet-stream")
+                    self._reply(200, blob, "application/octet-stream")
                     return
                 self._reply(404, {"error": "bad path"})
 

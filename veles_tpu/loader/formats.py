@@ -13,6 +13,7 @@ Both land the dataset in the HBM-resident :class:`FullBatchLoader`
 layout so the training path is identical to the synthetic/MNIST loaders.
 """
 
+import os
 import pickle
 
 import numpy
@@ -109,4 +110,162 @@ class PicklesLoader(FullBatchLoader):
         self.original_data.mem = numpy.concatenate(chunks, axis=0)
         if has_labels:
             self.original_labels = labels
+        self.class_lengths[:] = lengths
+
+
+class WavLoader(FullBatchLoader):
+    """Audio fullbatch loader over stdlib ``wave`` (the libsndfile role:
+    reference ``veles/loader/libsndfile{,_loader}.py``).
+
+    kwargs: ``{test,validation,train}_paths`` — lists of .wav files;
+    ``window`` — fixed sample count per clip (pad/trim); ``label_from``
+    — callable(path) → label (default: parent directory name).
+    """
+
+    def __init__(self, workflow, **kwargs):
+        self.test_paths = list(kwargs.pop("test_paths", ()))
+        self.validation_paths = list(kwargs.pop("validation_paths", ()))
+        self.train_paths = list(kwargs.pop("train_paths", ()))
+        self.window = int(kwargs.pop("window", 16384))
+        self.label_from = kwargs.pop(
+            "label_from",
+            lambda path: os.path.basename(os.path.dirname(path)))
+        super(WavLoader, self).__init__(workflow, **kwargs)
+
+    def _read_wav(self, path):
+        import wave
+        with wave.open(path, "rb") as w:
+            nchan = w.getnchannels()
+            width = w.getsampwidth()
+            frames = w.readframes(w.getnframes())
+        if width == 2:
+            pcm = numpy.frombuffer(frames, "<i2").astype(
+                numpy.float32) / 32768.0
+        elif width == 1:
+            pcm = (numpy.frombuffer(frames, numpy.uint8).astype(
+                numpy.float32) - 128.0) / 128.0
+        elif width == 4:
+            pcm = numpy.frombuffer(frames, "<i4").astype(
+                numpy.float32) / 2147483648.0
+        else:
+            raise LoaderError("unsupported sample width %d in %s"
+                              % (width, path))
+        if nchan > 1:                       # downmix to mono
+            pcm = pcm.reshape(-1, nchan).mean(axis=1)
+        if len(pcm) >= self.window:
+            pcm = pcm[:self.window]
+        else:
+            pcm = numpy.pad(pcm, (0, self.window - len(pcm)))
+        return pcm
+
+    def load_data(self):
+        chunks, labels = [], []
+        lengths = [0, 0, 0]
+        for class_index, paths in ((TEST, self.test_paths),
+                                   (VALID, self.validation_paths),
+                                   (TRAIN, self.train_paths)):
+            for path in paths:
+                chunks.append(self._read_wav(path))
+                labels.append(self.label_from(path))
+            lengths[class_index] = len(paths)
+        if not chunks:
+            raise LoaderError("no wav paths given")
+        self.original_data.mem = numpy.stack(chunks).astype(
+            numpy.float32)
+        self.original_labels = labels
+        self.class_lengths[:] = lengths
+
+
+class LMDBLoader(FullBatchLoader):
+    """Caffe-style LMDB key-value datasets (reference ``loader_lmdb``;
+    requires the ``lmdb`` package, absent from this image — the loader
+    fails with a clear error until it is installed).
+
+    kwargs: ``{test,validation,train}_db`` — LMDB directory paths whose
+    values are pickled ``(ndarray, label)`` records.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        self.test_db = kwargs.pop("test_db", None)
+        self.validation_db = kwargs.pop("validation_db", None)
+        self.train_db = kwargs.pop("train_db", None)
+        super(LMDBLoader, self).__init__(workflow, **kwargs)
+
+    def load_data(self):
+        try:
+            import lmdb
+        except ImportError:
+            raise LoaderError("lmdb package is required for LMDBLoader")
+        chunks, labels = [], []
+        lengths = [0, 0, 0]
+        for class_index, db_path in ((TEST, self.test_db),
+                                     (VALID, self.validation_db),
+                                     (TRAIN, self.train_db)):
+            if not db_path:
+                continue
+            env = lmdb.open(db_path, readonly=True, lock=False)
+            with env.begin() as txn:
+                for _key, value in txn.cursor():
+                    data, label = pickle.loads(value)
+                    chunks.append(numpy.asarray(data, numpy.float32))
+                    labels.append(label)
+                    lengths[class_index] += 1
+            env.close()
+        if not chunks:
+            raise LoaderError("no LMDB paths given")
+        self.original_data.mem = numpy.stack(chunks)
+        self.original_labels = labels
+        self.class_lengths[:] = lengths
+
+
+class HDFSTextLoader(FullBatchLoader):
+    """Line-record ingestion from HDFS over the WebHDFS REST API
+    (reference ``veles/loader/hdfs_loader.py:48`` used libhdfs; REST
+    needs no native client).  Each line: ``label<TAB>v1,v2,...``.
+
+    kwargs: ``namenode`` — ``http://host:port``; ``{test,validation,
+    train}_files`` — HDFS paths.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        self.namenode = kwargs.pop("namenode", None)
+        self.test_files = list(kwargs.pop("test_files", ()))
+        self.validation_files = list(kwargs.pop("validation_files", ()))
+        self.train_files = list(kwargs.pop("train_files", ()))
+        super(HDFSTextLoader, self).__init__(workflow, **kwargs)
+
+    def _fetch(self, path):
+        import urllib.request
+        url = "%s/webhdfs/v1%s?op=OPEN" % (self.namenode, path)
+        with urllib.request.urlopen(url, timeout=60) as resp:
+            return resp.read().decode()
+
+    def _parse_lines(self, text):
+        rows, labels = [], []
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            label, _, values = line.partition("\t")
+            rows.append(numpy.array(
+                [float(v) for v in values.split(",")], numpy.float32))
+            labels.append(label)
+        return rows, labels
+
+    def load_data(self):
+        if not self.namenode:
+            raise LoaderError("HDFSTextLoader requires namenode=")
+        chunks, labels = [], []
+        lengths = [0, 0, 0]
+        for class_index, paths in ((TEST, self.test_files),
+                                   (VALID, self.validation_files),
+                                   (TRAIN, self.train_files)):
+            for path in paths:
+                rows, raw = self._parse_lines(self._fetch(path))
+                chunks.extend(rows)
+                labels.extend(raw)
+                lengths[class_index] += len(rows)
+        if not chunks:
+            raise LoaderError("no HDFS paths given")
+        self.original_data.mem = numpy.stack(chunks)
+        self.original_labels = labels
         self.class_lengths[:] = lengths

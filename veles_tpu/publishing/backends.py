@@ -56,7 +56,8 @@ _MD_TEMPLATE = jinja2.Template("""\
 {% endif %}\
 """)
 
-_HTML_TEMPLATE = jinja2.Template("""\
+_HTML_TEMPLATE = jinja2.Environment(
+    autoescape=True).from_string("""\
 <!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>{{ name }}</title>
 <style>
@@ -169,8 +170,11 @@ class IpynbBackend(Backend):
             "outputs": [],
             "source": [
                 "# the report's metrics as a dict\n",
-                "results = %s\n" % json.dumps(info.get("results", {}),
-                                              indent=1, default=str),
+                "import json\n",
+                # JSON literals (true/null/NaN) are not Python — parse
+                # the payload instead of pasting it as a Python literal
+                "results = json.loads(r'''%s''')\n" % json.dumps(
+                    info.get("results", {}), default=str),
             ],
         }]
         return json.dumps({

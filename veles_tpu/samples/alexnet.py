@@ -78,13 +78,18 @@ def synthetic_imagenet_batch(batch, seed=0):
     return x, labels
 
 
-def build_fused(mesh=None, layers=None, input_shape=INPUT_SHAPE):
+def build_fused(mesh=None, layers=None, input_shape=INPUT_SHAPE,
+                compute_dtype=None):
     """(params, jitted step) — single-device jit, or data-parallel over
-    ``mesh`` when given."""
+    ``mesh`` when given.  ``compute_dtype="bfloat16"`` enables the
+    MXU-native mixed-precision mode (fp32 master weights)."""
     import jax
+    import jax.numpy as jnp
     from veles_tpu.znicz.fused_graph import lower_specs
+    if isinstance(compute_dtype, str):
+        compute_dtype = jnp.dtype(compute_dtype).type
     params, step_fn, eval_fn, apply_fn = lower_specs(
-        layers or LAYERS, input_shape)
+        layers or LAYERS, input_shape, compute_dtype=compute_dtype)
     if mesh is not None:
         from veles_tpu.parallel import data_parallel
         step = data_parallel(step_fn, mesh, params)
@@ -94,14 +99,18 @@ def build_fused(mesh=None, layers=None, input_shape=INPUT_SHAPE):
 
 
 def benchmark(batch=128, steps=10, mesh=None, layers=None,
-              input_shape=INPUT_SHAPE):
+              input_shape=INPUT_SHAPE, compute_dtype=None):
     """images/sec of the fused AlexNet train step."""
     import time
 
     import jax
     params, step, _eval, _apply = build_fused(
-        mesh=mesh, layers=layers, input_shape=input_shape)
+        mesh=mesh, layers=layers, input_shape=input_shape,
+        compute_dtype=compute_dtype)
     x, labels = synthetic_imagenet_batch(batch)
+    # pin the batch in HBM once: passing numpy would re-transfer it
+    # every step and measure the host link, not the train step
+    x, labels = jax.device_put(x), jax.device_put(labels)
     params, _m = step(params, x, labels)       # compile
     jax.block_until_ready(params)
     tic = time.perf_counter()

@@ -54,12 +54,16 @@ class Conv(ForwardBase):
              activation=None):
         left, right, top, bottom = padding
         # sliding is (x, y) like the reference; NHWC strides are (H, W)
+        # bf16 inputs: omit preferred_element_type — XLA:TPU already
+        # accumulates bf16 convs in fp32 on the MXU, and an explicit
+        # f32 output breaks the transposed conv in the VJP (dtype mix)
+        pref = jnp.float32 if x.dtype == jnp.float32 else None
         out = jax.lax.conv_general_dilated(
             x, params["w"],
             window_strides=(sliding[1], sliding[0]),
             padding=((top, bottom), (left, right)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=pref)
         if "b" in params:
             out = out + params["b"]
         return _ACT[activation](out).astype(x.dtype)

@@ -16,10 +16,15 @@ import numpy
 from veles_tpu.memory import Vector
 
 
-def lower_specs(layer_specs, sample_shape, loss="softmax"):
+def lower_specs(layer_specs, sample_shape, loss="softmax",
+                compute_dtype=None):
     """Build (params, step_fn, eval_fn, apply_fn) from layer specs.
 
     ``sample_shape``: one sample's shape (no batch dim).
+    ``compute_dtype``: optional forward/backward compute dtype (e.g.
+    ``jnp.bfloat16`` — the MXU-native mixed-precision mode: bf16
+    activations/weights in the matmuls/convs, fp32 accumulation via
+    ``preferred_element_type``, fp32 master weights + momentum).
     """
     from veles_tpu.dummy import DummyWorkflow
     from veles_tpu.units import UnitRegistry
@@ -89,13 +94,20 @@ def lower_specs(layer_specs, sample_shape, loss="softmax"):
         return h
 
     def loss_fn(wb_list, aux_list, x, labels):
-        h = x
+        if compute_dtype is not None:
+            h = jnp.asarray(x, compute_dtype)
+        else:
+            h = x
         for (pure, config, _hyper), wb, aux in zip(stages, wb_list,
                                                    aux_list):
-            p = dict(wb)
+            if compute_dtype is not None:
+                p = {k: jnp.asarray(v, compute_dtype)
+                     for k, v in wb.items()}
+            else:
+                p = dict(wb)
             p.update(aux)
             h = pure(p, h, **config)
-        out = h
+        out = jnp.asarray(h, jnp.float32)
         valid = labels >= 0 if loss == "softmax" \
             else jnp.ones(x.shape[0], bool)
         grad_denom = x.shape[0]

@@ -58,10 +58,12 @@ class Deconv(ForwardBase):
         pad = ((ky - 1 - top, ky - 1 - bottom),
                (kx - 1 - left, kx - 1 - right))
         # sliding is (x, y) like the reference; NHWC strides are (H, W)
+        # see Conv.pure: explicit f32 output breaks the VJP for bf16
+        pref = jnp.float32 if x.dtype == jnp.float32 else None
         out = jax.lax.conv_transpose(
             x, params["w"], strides=(sliding[1], sliding[0]), padding=pad,
             dimension_numbers=("NHWC", "HWOI", "NHWC"),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=pref)
         return _ACT[activation](out).astype(x.dtype)
 
     def initialize(self, device=None, **kwargs):
