@@ -1,0 +1,104 @@
+"""Orbax sharded-checkpoint tests (SURVEY §5.4): save on one mesh
+topology, restore on another; PRNG streams and loader cursor ride
+along."""
+
+import jax
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.checkpoint import TrainCheckpointer
+from veles_tpu.parallel import make_mesh
+
+P = jax.sharding.PartitionSpec
+
+
+def _state_on_mesh(mesh, spec):
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    w = jax.device_put(
+        numpy.arange(64, dtype=numpy.float32).reshape(8, 8), sharding)
+    return {"w": w, "vw": jax.device_put(
+        numpy.zeros((8, 8), numpy.float32), sharding),
+        "step_scale": jnp.float32(0.5)}
+
+
+def test_save_restore_same_mesh(tmp_path):
+    mesh = make_mesh({"data": 8})
+    state = _state_on_mesh(mesh, P("data", None))
+    ckpt = TrainCheckpointer(str(tmp_path / "ckpt"))
+    ckpt.save(3, state, loader_state={"epoch": 2, "offset": 40})
+    step, restored, loader = ckpt.restore(state)
+    assert step == 3
+    assert loader == {"epoch": 2, "offset": 40}
+    assert numpy.allclose(numpy.asarray(restored["w"]),
+                          numpy.asarray(state["w"]))
+    ckpt.close()
+
+
+def test_restore_on_different_topology(tmp_path):
+    """Save sharded over 8 devices, restore sharded over 2 — the
+    reference's resume-anywhere property at mesh level."""
+    mesh8 = make_mesh({"data": 8})
+    state8 = _state_on_mesh(mesh8, P("data", None))
+    ckpt = TrainCheckpointer(str(tmp_path / "ckpt"))
+    ckpt.save(1, state8)
+    ckpt.close()
+
+    mesh2 = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    abstract = {
+        "w": jax.ShapeDtypeStruct(
+            (8, 8), numpy.float32,
+            sharding=jax.sharding.NamedSharding(mesh2, P("data", None))),
+        "vw": jax.ShapeDtypeStruct(
+            (8, 8), numpy.float32,
+            sharding=jax.sharding.NamedSharding(mesh2, P(None, "data"))),
+        "step_scale": jax.ShapeDtypeStruct((), numpy.float32),
+    }
+    ckpt2 = TrainCheckpointer(str(tmp_path / "ckpt"))
+    step, restored, _loader = ckpt2.restore(abstract)
+    assert step == 1
+    assert numpy.allclose(numpy.asarray(restored["w"]),
+                          numpy.arange(64).reshape(8, 8))
+    # restored onto the NEW sharding
+    assert restored["w"].sharding.mesh.shape["data"] == 2
+    assert len(restored["w"].sharding.device_set) == 2
+    ckpt2.close()
+
+
+def test_prng_streams_resume(tmp_path):
+    prng.seed_all(777)
+    drawn_before = prng.get("dropout").randint(0, 1 << 30)
+    ckpt = TrainCheckpointer(str(tmp_path / "ckpt"))
+    ckpt.save(0, {"x": numpy.zeros(2, numpy.float32)})
+    # advance the stream past the checkpoint...
+    future = [int(prng.get("dropout").randint(0, 1 << 30))
+              for _ in range(3)]
+    # ...then clobber it and restore
+    prng.seed_all(123)
+    _step, _state, _loader = ckpt.restore(
+        {"x": numpy.zeros(2, numpy.float32)})
+    replay = [int(prng.get("dropout").randint(0, 1 << 30))
+              for _ in range(3)]
+    assert replay == future        # stream continues where it was saved
+    assert drawn_before is not None
+    ckpt.close()
+
+
+def test_latest_and_retention(tmp_path):
+    ckpt = TrainCheckpointer(str(tmp_path / "ckpt"), max_to_keep=2)
+    state = {"x": numpy.ones(4, numpy.float32)}
+    for step in (1, 2, 3):
+        ckpt.save(step, state)
+    assert ckpt.latest_step() == 3
+    # retention dropped step 1
+    with pytest.raises(Exception):
+        ckpt.restore(state, step=1)
+    ckpt.close()
+
+
+def test_empty_dir_raises(tmp_path):
+    ckpt = TrainCheckpointer(str(tmp_path / "empty"))
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore({"x": numpy.zeros(1, numpy.float32)})
+    ckpt.close()

@@ -171,6 +171,16 @@ class Main(Logger):
     # -- run ----------------------------------------------------------------
     def run(self):
         args = self._parse()
+        if args.device in ("numpy", "cpu"):
+            # a CPU-only run must not touch the TPU: a sitecustomize may
+            # pin a tunnel platform behind JAX_PLATFORMS' back, and
+            # backend init would then block on unreachable hardware
+            try:
+                import jax
+                if jax.config.jax_platforms != "cpu":
+                    jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
         self._setup_logging()
         self._seed_random()
         self._apply_config()
@@ -189,6 +199,21 @@ class Main(Logger):
             return self._run_optimization()
         if args.ensemble_train or args.ensemble_test:
             return self._run_ensemble()
+        if args.profile:
+            # device-level tracing around the whole run (the per-unit
+            # wall-time table remains in Workflow.print_stats)
+            import jax.profiler
+            jax.profiler.start_trace(args.profile)
+            self.info("jax.profiler trace → %s", args.profile)
+        try:
+            return self._run_constructed(args)
+        finally:
+            if args.profile:
+                import jax.profiler
+                jax.profiler.stop_trace()
+                self.info("profiler trace written to %s", args.profile)
+
+    def _run_constructed(self, args):
         self._construct()
         if args.result_file:
             self.workflow.result_file = args.result_file

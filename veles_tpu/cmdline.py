@@ -101,6 +101,11 @@ def make_parser(prog="veles_tpu", description=None):
         "--ensemble-test", default="", metavar="INPUT_JSON",
         help="evaluate a trained ensemble listed in INPUT_JSON")
     parser.add_argument(
+        "--profile", default="", metavar="TRACE_DIR",
+        help="record a jax.profiler trace of the run into TRACE_DIR "
+             "(view with TensorBoard / xprof; SURVEY §5.1 TPU "
+             "equivalent of per-unit timing)")
+    parser.add_argument(
         "--frontend", default="", metavar="OUT_HTML",
         help="generate the HTML command-composer form from the argument "
              "registry and exit (ref scripts/generate_frontend.py)")

@@ -150,19 +150,23 @@ class ForgeStore(object):
         out = []
         for safe in sorted(os.listdir(self.directory)):
             name = urllib.parse.unquote(safe)
-            versions = self.versions(name)
-            # drop versions whose .json sidecar is missing (e.g. a crash
-            # between the non-atomic .pkg/.json writes) — one broken
-            # version must not take the whole listing down
-            versions = [v for v in versions
-                        if self.meta(name, v) is not None]
-            if not versions:
+            # read each sidecar ONCE, dropping versions whose .json is
+            # missing (e.g. a crash between the non-atomic .pkg/.json
+            # writes) — one broken version must not take the listing
+            # down, and a concurrent delete must not either
+            metas = []
+            for v in self.versions(name):
+                meta = self.meta(name, v)
+                if meta is not None:
+                    metas.append((v, meta))
+            if not metas:
                 continue
-            meta = self.meta(name, versions[-1])
+            versions = [v for v, _ in metas]
+            latest = metas[-1][1]
             out.append({"name": name, "versions": versions,
                         "latest": versions[-1],
-                        "checksum": meta.get("checksum"),
-                        "size": meta.get("size")})
+                        "checksum": latest.get("checksum"),
+                        "size": latest.get("size")})
         return out
 
 

@@ -203,14 +203,25 @@ class LMDBLoader(FullBatchLoader):
                                      (TRAIN, self.train_db)):
             if not db_path:
                 continue
-            env = lmdb.open(db_path, readonly=True, lock=False)
-            with env.begin() as txn:
-                for _key, value in txn.cursor():
-                    data, label = pickle.loads(value)
-                    chunks.append(numpy.asarray(data, numpy.float32))
-                    labels.append(label)
-                    lengths[class_index] += 1
-            env.close()
+            try:
+                env = lmdb.open(db_path, readonly=True, lock=False)
+            except lmdb.Error as e:
+                raise LoaderError("cannot open lmdb %s: %s"
+                                  % (db_path, e))
+            try:
+                with env.begin() as txn:
+                    for _key, value in txn.cursor():
+                        data, label = pickle.loads(value)
+                        chunks.append(numpy.asarray(data,
+                                                    numpy.float32))
+                        labels.append(label)
+                        lengths[class_index] += 1
+            except (lmdb.Error, pickle.UnpicklingError,
+                    ValueError) as e:
+                raise LoaderError("bad lmdb record in %s: %s"
+                                  % (db_path, e))
+            finally:
+                env.close()
         if not chunks:
             raise LoaderError("no LMDB paths given")
         self.original_data.mem = numpy.stack(chunks)
@@ -240,14 +251,27 @@ class HDFSTextLoader(FullBatchLoader):
         with urllib.request.urlopen(url, timeout=60) as resp:
             return resp.read().decode()
 
-    def _parse_lines(self, text):
+    def _parse_lines(self, text, path="<memory>"):
         rows, labels = [], []
-        for line in text.splitlines():
+        for lineno, line in enumerate(text.splitlines(), 1):
             if not line.strip():
                 continue
-            label, _, values = line.partition("\t")
-            rows.append(numpy.array(
-                [float(v) for v in values.split(",")], numpy.float32))
+            label, tab, values = line.partition("\t")
+            if not tab:
+                raise LoaderError(
+                    "%s:%d: expected 'label<TAB>v1,v2,...', got %r"
+                    % (path, lineno, line[:60]))
+            try:
+                row = numpy.array([float(v) for v in values.split(",")],
+                                  numpy.float32)
+            except ValueError as e:
+                raise LoaderError("%s:%d: bad values: %s"
+                                  % (path, lineno, e))
+            if rows and row.shape != rows[0].shape:
+                raise LoaderError(
+                    "%s:%d: row has %d values, expected %d"
+                    % (path, lineno, row.size, rows[0].size))
+            rows.append(row)
             labels.append(label)
         return rows, labels
 
@@ -260,7 +284,13 @@ class HDFSTextLoader(FullBatchLoader):
                                    (VALID, self.validation_files),
                                    (TRAIN, self.train_files)):
             for path in paths:
-                rows, raw = self._parse_lines(self._fetch(path))
+                rows, raw = self._parse_lines(self._fetch(path), path)
+                if chunks and rows and \
+                        rows[0].shape != chunks[0].shape:
+                    raise LoaderError(
+                        "%s: rows have %d values but earlier files "
+                        "had %d" % (path, rows[0].size,
+                                    chunks[0].size))
                 chunks.extend(rows)
                 labels.extend(raw)
                 lengths[class_index] += len(rows)

@@ -171,10 +171,11 @@ class IpynbBackend(Backend):
             "source": [
                 "# the report's metrics as a dict\n",
                 "import json\n",
-                # JSON literals (true/null/NaN) are not Python — parse
-                # the payload instead of pasting it as a Python literal
-                "results = json.loads(r'''%s''')\n" % json.dumps(
-                    info.get("results", {}), default=str),
+                # JSON literals (true/null/NaN) are not Python, and raw
+                # triple-quoting breaks on quotes in values — embed the
+                # JSON text as a Python string literal via a second dump
+                "results = json.loads(%s)\n" % json.dumps(json.dumps(
+                    info.get("results", {}), default=str)),
             ],
         }]
         return json.dumps({
