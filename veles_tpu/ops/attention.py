@@ -1,0 +1,247 @@
+"""Flash attention: Pallas TPU kernel + memory-efficient VJP.
+
+The hot op of the transformer family (SURVEY §5.7 notes attention is
+beyond reference parity — this is the TPU build's flagship Pallas
+kernel).  Forward is a tiled online-softmax kernel: Q blocks stream
+through VMEM while K/V blocks arrive per grid step, so the (Sq, Sk)
+score matrix never materializes in HBM.  Backward recomputes
+probabilities blockwise from the saved log-sum-exp (the standard
+flash-attention trade: extra FLOPs for O(S) memory) with a
+``lax.scan`` the compiler pipelines.
+
+Layouts follow :mod:`veles_tpu.parallel.ring` — tensors are
+``(batch, seq, heads, head_dim)`` — so :func:`flash_attention` is a
+drop-in for its per-device block update, composing with ring/Ulysses
+sequence parallelism.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _round_up(x, mult):
+    return (x + mult - 1) // mult * mult
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                 acc_ref, m_ref, l_ref, *, n_k, scale, causal,
+                 block_q, block_k, seq_k):
+    """Grid: (batch*heads, q_blocks, k_blocks); K is the arbitrary
+    (sequential) dimension; running (acc, m, l) live in VMEM scratch."""
+    qi = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: skip blocks strictly above the diagonal
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= kk * block_k
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale       # (bq, d)
+        k = k_ref[0].astype(jnp.float32)               # (bk, d)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, bk)
+        k_pos = kk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        mask = k_pos < seq_k                           # key padding
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 0)
+            mask = mask & (k_pos <= q_pos)
+        scores = jnp.where(mask, scores, NEG_INF)
+
+        m_prev = m_ref[...]                            # (bq, 1)
+        m_cur = jnp.max(scores, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new)                    # (bq, bk)
+        alpha = jnp.exp(m_prev - m_new)                # (bq, 1)
+        l_ref[...] = l_ref[...] * alpha + p.sum(axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, d)
+        m_ref[...] = m_new
+
+    @pl.when(kk == n_k - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "block_q", "block_k", "interpret"))
+def _flash_fwd(q, k, v, causal=False, block_q=128, block_k=128,
+               interpret=False):
+    """(o, lse); inputs (b, s, h, d) — kernel works per (b·h) slice."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    bq = min(block_q, _round_up(sq, 8))
+    bk = min(block_k, _round_up(sk, 8))
+
+    def bhsd(x):   # (b, s, h, d) → (b·h, s_pad, d_pad)
+        x = jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
+        s_pad = _round_up(x.shape[1], max(bq, bk))
+        d_pad = _round_up(d, 128)
+        return jnp.pad(x, ((0, 0), (0, s_pad - x.shape[1]),
+                           (0, d_pad - d)))
+
+    q3, k3, v3 = bhsd(q), bhsd(k), bhsd(v)
+    sq_p, d_p = q3.shape[1], q3.shape[2]
+    sk_p = k3.shape[1]
+    n_q, n_k = sq_p // bq, sk_p // bk
+    grid = (b * h, n_q, n_k)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_attn_kernel, n_k=n_k, scale=scale,
+                          causal=causal, block_q=bq, block_k=bk,
+                          seq_k=sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d_p), lambda bh, qi, kk: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda bh, qi, kk: (bh, kk, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda bh, qi, kk: (bh, kk, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d_p), lambda bh, qi, kk: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi, kk: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq_p), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d_p), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3)
+    out = out[:, :sq, :d].reshape(b, h, sq, d)
+    return jnp.moveaxis(out, 1, 2), lse[:, :sq].reshape(b, h, sq)
+
+
+def _mha_jnp(q, k, v, causal):
+    """XLA-fused fallback (CPU / tiny shapes); returns (o, lse)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / (d ** 0.5)
+    if causal:
+        # start-aligned (k_pos <= q_pos) like the Pallas kernel, the
+        # blockwise VJP and mha_reference — NOT end-aligned tril
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None]
+        scores = jnp.where(mask, scores, NEG_INF)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)
+    probs = jnp.exp(scores - lse[..., None])
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype), lse
+
+
+def _bwd_blockwise(res, do, causal, block_k):
+    """Flash backward from saved (q, k, v, o, lse): scan over K blocks,
+    probabilities recomputed — O(S·block) memory."""
+    q, k, v, o, lse = res
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    do32 = do.astype(jnp.float32)
+    delta = jnp.einsum("bqhd,bqhd->bhq", do32,
+                       o.astype(jnp.float32))        # rowsum(do ⊙ o)
+
+    n_blocks = (sk + block_k - 1) // block_k
+    sk_pad = n_blocks * block_k
+    kp = jnp.pad(k, ((0, 0), (0, sk_pad - sk), (0, 0), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, sk_pad - sk), (0, 0), (0, 0)))
+
+    q_pos = jnp.arange(sq)
+
+    def one_block(carry, idx):
+        dq_acc, = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(kp, idx * block_k,
+                                             block_k, axis=1)
+        v_blk = jax.lax.dynamic_slice_in_dim(vp, idx * block_k,
+                                             block_k, axis=1)
+        k_pos = idx * block_k + jnp.arange(block_k)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                            preferred_element_type=jnp.float32) * scale
+        mask = (k_pos < sk)[None, None, None, :]
+        if causal:
+            mask = mask & (k_pos[None, None, None, :]
+                           <= q_pos[None, None, :, None])
+        p = jnp.where(mask, jnp.exp(scores - lse[..., None]), 0.0)
+        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do32,
+                        v_blk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds,
+                            k_blk.astype(jnp.float32))
+        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32))
+        return (dq_acc + dq_blk,), (dk_blk, dv_blk)
+
+    (dq,), (dk_blocks, dv_blocks) = jax.lax.scan(
+        one_block, (jnp.zeros(q.shape, jnp.float32),),
+        jnp.arange(n_blocks))
+    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(b, sk_pad, h, d)[:, :sk]
+    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(b, sk_pad, h, d)[:, :sk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
+                    use_pallas=None):
+    """Tiled attention ``softmax(q·kᵀ/√d)·v`` over (b, s, h, d) tensors.
+
+    ``use_pallas``: force the kernel choice; default auto — the Pallas
+    kernel on TPU, the XLA-fused fallback elsewhere.
+    """
+    o, _lse = _fwd_impl(q, k, v, causal, block_q, block_k, use_pallas)
+    return o
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _fwd_impl(q, k, v, causal, block_q, block_k, use_pallas):
+    pallas = use_pallas if use_pallas is not None else _on_tpu()
+    if pallas:
+        from veles_tpu.config import root
+        o, lse = _flash_fwd(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=bool(root.common.engine.get("interpret", False)))
+        return o, lse
+    return _mha_jnp(q, k, v, causal)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, use_pallas):
+    o, lse = _fwd_impl(q, k, v, causal, block_q, block_k, use_pallas)
+    # backward expects lse as (b, h, s)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, use_pallas, res, do):
+    return _bwd_blockwise(res, do, causal, block_k)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

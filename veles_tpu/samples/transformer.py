@@ -27,7 +27,7 @@ import numpy
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from veles_tpu.parallel.mesh import replicated
-from veles_tpu.parallel.ring import mha_reference, ring_attention
+from veles_tpu.parallel.ring import ring_attention
 
 CONFIG = {
     "vocab": 32000, "dim": 1024, "heads": 16, "layers": 12,
@@ -84,7 +84,24 @@ def _attend(q, k, v, mesh, seq_axis):
                               head_axis="model"
                               if mesh.shape.get("model", 1) > 1
                               else None)
-    return mha_reference(q, k, v, causal=True)
+    # single-shard sequence: the Pallas flash kernel on TPU (blockwise
+    # VJP), XLA-fused fallback elsewhere.  pallas_call has no GSPMD
+    # partitioning rule, so under a data/head-sharded mesh the kernel
+    # must run per-shard inside shard_map — otherwise XLA all-gathers
+    # the activations and every chip does the full attention.
+    from veles_tpu.ops.attention import flash_attention
+    if mesh is None:
+        return flash_attention(q, k, v, True)
+    from jax.experimental.shard_map import shard_map
+    data = "data" if mesh.shape.get("data", 1) > 1 else None
+    model = "model" if mesh.shape.get("model", 1) > 1 else None
+    if data is None and model is None:
+        return flash_attention(q, k, v, True)
+    spec = P(data, None, model, None)
+    return shard_map(
+        lambda q, k, v: flash_attention(q, k, v, True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)(q, k, v)
 
 
 def _block(h, blk, mesh, seq_axis, compute_dtype):
