@@ -41,6 +41,19 @@ def test_interpret_ragged_and_rect():
                           atol=2e-5)
 
 
+def test_interpret_mismatched_blocks():
+    """bq != bk with lengths that are multiples of neither: every
+    tensor must pad to its OWN block size (regression: shared padding
+    left trailing q rows unwritten / k blocks unvisited)."""
+    q, k, v = _qkv(sq=12, sk=12, d=8, seed=5)
+    ref = mha_reference(q, k, v)
+    for bq, bk in ((8, 12), (12, 8)):
+        out, _ = _flash_fwd(q, k, v, block_q=bq, block_k=bk,
+                            interpret=True)
+        assert numpy.allclose(numpy.asarray(out), numpy.asarray(ref),
+                              atol=2e-5), (bq, bk)
+
+
 def test_jnp_fallback_matches_reference():
     q, k, v = _qkv(seed=2)
     out, lse = _mha_jnp(q, k, v, causal=True)

@@ -198,3 +198,40 @@ def test_distributed_stop_on_complete():
         client.close()
     finally:
         server.stop()
+
+
+def test_prefetch_double_buffering():
+    """run_prefetch overlaps compute with the next job_request (ref
+    async mode _balance=2, server.py:262-281): the master must see at
+    least one job_request arriving while the slave is still WORKING."""
+    class RecordingMaster(ScriptedMaster):
+        def __init__(self, n_jobs):
+            super().__init__(n_jobs)
+            self.states_at_request = []
+
+        def generate_data_for_slave(self, slave):
+            self.states_at_request.append(slave.state)
+            return super().generate_data_for_slave(slave)
+
+    master = RecordingMaster(6)
+    server = JobServer(master).start()
+
+    class SlowSlave(ScriptedSlave):
+        def do_job(self, job, callback):
+            time.sleep(0.05)
+            self.jobs.append(job)
+            callback({"result": job["job_number"]})
+
+    try:
+        client = JobClient(SlowSlave(), server.endpoint)
+        client.handshake()
+        assert client.run_prefetch()
+        client.close()
+        assert master.served == 6
+        assert len(master.updates) == 6
+        assert sorted(u[1]["result"] for u in master.updates) == \
+            [1, 2, 3, 4, 5, 6]
+        # the overlap actually happened: requests arrived mid-compute
+        assert "WORKING" in master.states_at_request
+    finally:
+        server.stop()

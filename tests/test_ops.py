@@ -203,3 +203,52 @@ class TestNormalizeJoin:
         out = numpy.asarray(join_op([jnp.asarray(a), jnp.asarray(b)]))
         assert out.shape == (4, 11)
         assert (out[:, :6] == 1).all() and (out[:, 6:] == 0).all()
+
+
+class TestHog:
+    """HOG features (ref vendored external/hog.py)."""
+
+    def test_shapes_and_norm(self):
+        import numpy
+        from veles_tpu.ops.hog import hog, hog_batch
+        rng = numpy.random.default_rng(0)
+        img = rng.random((32, 32)).astype(numpy.float32)
+        feat = numpy.asarray(hog(img, orientations=9, cell=8, block=2))
+        # 4x4 cells → 3x3 blocks of 2x2x9
+        assert feat.shape == (3 * 3 * 2 * 2 * 9,)
+        # L2 block norm keeps every block at unit-ish energy
+        blocks = feat.reshape(9, 36)
+        norms = numpy.linalg.norm(blocks, axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+        batch = numpy.asarray(hog_batch(
+            rng.random((5, 32, 32, 3)).astype(numpy.float32)))
+        assert batch.shape == (5, 324)
+
+    def test_oriented_edges_dominate_expected_bin(self):
+        import numpy
+        from veles_tpu.ops.hog import hog
+        # x-ramp → horizontal gradient → angle 0 bin
+        img = numpy.tile(
+            numpy.arange(32, dtype=numpy.float32), (32, 1))
+        feat = numpy.asarray(hog(img, orientations=9, cell=8,
+                                 block=1))
+        hist = feat.reshape(-1, 9).sum(axis=0)
+        assert hist.argmax() == 0
+        # horizontal stripes → vertical gradient → π/2 bin (index 4)
+        feat_t = numpy.asarray(hog(img.T, orientations=9, cell=8,
+                                   block=1))
+        hist_t = feat_t.reshape(-1, 9).sum(axis=0)
+        assert hist_t.argmax() == 4
+
+    def test_gradients_flow(self):
+        import jax, numpy
+        import jax.numpy as jnp
+        from veles_tpu.ops.hog import hog
+        img = jnp.asarray(numpy.random.default_rng(1).random(
+            (16, 16)).astype(numpy.float32))
+        g = jax.grad(lambda im: hog(im).sum())(img)
+        assert numpy.isfinite(numpy.asarray(g)).all()
+        # flat regions (gx=gy=0) must not NaN-poison the gradient
+        flat = jnp.zeros((16, 16), jnp.float32).at[4:8, 4:8].set(1.0)
+        g2 = jax.grad(lambda im: hog(im).sum())(flat)
+        assert numpy.isfinite(numpy.asarray(g2)).all()

@@ -182,6 +182,10 @@ class Main(Logger):
             except Exception:
                 pass
         self._setup_logging()
+        if args.debug_nans:
+            import jax
+            jax.config.update("jax_debug_nans", True)
+            self.info("NaN checking enabled (jax_debug_nans)")
         self._seed_random()
         self._apply_config()
         # config may carry a seed (e.g. ensemble members get distinct

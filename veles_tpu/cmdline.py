@@ -101,6 +101,11 @@ def make_parser(prog="veles_tpu", description=None):
         "--ensemble-test", default="", metavar="INPUT_JSON",
         help="evaluate a trained ensemble listed in INPUT_JSON")
     parser.add_argument(
+        "--debug-nans", action="store_true",
+        help="enable jax_debug_nans: any NaN produced on device raises "
+             "at the emitting op (SURVEY §5.2's TPU 'sanitizer' — jit "
+             "purity makes data races moot; NaNs are what's left)")
+    parser.add_argument(
         "--profile", default="", metavar="TRACE_DIR",
         help="record a jax.profiler trace of the run into TRACE_DIR "
              "(view with TensorBoard / xprof; SURVEY §5.1 TPU "

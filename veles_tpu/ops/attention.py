@@ -94,14 +94,17 @@ def _flash_fwd(q, k, v, causal=False, block_q=128, block_k=128,
     bq = min(block_q, _round_up(sq, 8))
     bk = min(block_k, _round_up(sk, 8))
 
-    def bhsd(x):   # (b, s, h, d) → (b·h, s_pad, d_pad)
+    def bhsd(x, block):   # (b, s, h, d) → (b·h, s_pad, d_pad)
+        # each tensor pads to ITS OWN block multiple: padding q and k to
+        # a common multiple would leave trailing blocks unvisited when
+        # the smaller block size doesn't divide the padded length
         x = jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
-        s_pad = _round_up(x.shape[1], max(bq, bk))
+        s_pad = _round_up(x.shape[1], block)
         d_pad = _round_up(d, 128)
         return jnp.pad(x, ((0, 0), (0, s_pad - x.shape[1]),
                            (0, d_pad - d)))
 
-    q3, k3, v3 = bhsd(q), bhsd(k), bhsd(v)
+    q3, k3, v3 = bhsd(q, bq), bhsd(k, bk), bhsd(v, bk)
     sq_p, d_p = q3.shape[1], q3.shape[2]
     sk_p = k3.shape[1]
     n_q, n_k = sq_p // bq, sk_p // bk

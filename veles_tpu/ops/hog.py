@@ -1,0 +1,86 @@
+"""HOG (histogram of oriented gradients) features.
+
+Parity target: the reference's vendored ``veles/external/hog.py``
+(scikit-image lineage) used for classical feature extraction ahead of
+MLP workflows.  TPU re-design: pure jnp — gradients, soft binning and
+cell pooling express as reshapes + matmuls XLA fuses; jit/vmap-able so
+a loader can run it on device for the whole batch.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("orientations", "cell",
+                                             "block", "eps"))
+def hog(image, orientations=9, cell=8, block=2, eps=1e-6):
+    """HOG descriptor of one grayscale image (H, W) → flat features.
+
+    ``cell``: pixels per cell side; ``block``: cells per block side
+    (L2-normalized, sliding by one cell).  H and W are truncated to
+    whole cells like the reference implementation.
+    """
+    image = jnp.asarray(image, jnp.float32)
+    h, w = image.shape
+    # centered gradients (zero at the border, like external/hog.py)
+    gx = jnp.zeros_like(image).at[:, 1:-1].set(
+        image[:, 2:] - image[:, :-2])
+    gy = jnp.zeros_like(image).at[1:-1, :].set(
+        image[2:, :] - image[:-2, :])
+    # eps inside the sqrt and the double-where on arctan2 keep grads
+    # finite on flat regions (gx = gy = 0 would give 0/0 → NaN)
+    sq = gx * gx + gy * gy
+    magnitude = jnp.sqrt(sq + 1e-12)
+    flat_px = sq == 0.0
+    gx_safe = jnp.where(flat_px, 1.0, gx)
+    # unsigned orientation in [0, π)
+    angle = jnp.mod(jnp.where(flat_px, 0.0,
+                              jnp.arctan2(gy, gx_safe)), jnp.pi)
+
+    n_cy, n_cx = h // cell, w // cell
+    hy, wx = n_cy * cell, n_cx * cell
+    magnitude = magnitude[:hy, :wx]
+    angle = angle[:hy, :wx]
+
+    # soft-assign each pixel's magnitude to the two nearest bins
+    bin_width = jnp.pi / orientations
+    pos = angle / bin_width - 0.5
+    lo = jnp.floor(pos)
+    frac = pos - lo
+    lo_bin = jnp.mod(lo, orientations).astype(jnp.int32)
+    hi_bin = jnp.mod(lo + 1, orientations).astype(jnp.int32)
+    one_hot_lo = jax.nn.one_hot(lo_bin, orientations) * \
+        (magnitude * (1.0 - frac))[..., None]
+    one_hot_hi = jax.nn.one_hot(hi_bin, orientations) * \
+        (magnitude * frac)[..., None]
+    votes = one_hot_lo + one_hot_hi            # (hy, wx, orientations)
+
+    cells = votes.reshape(n_cy, cell, n_cx, cell, orientations) \
+        .sum(axis=(1, 3))                      # (n_cy, n_cx, o)
+
+    if n_cy < block or n_cx < block:   # image smaller than one block
+        blocks = cells[None, None]
+    else:
+        # block == 1 flows through here too: per-cell normalization,
+        # the reference semantics (a global normalize would lose
+        # illumination invariance)
+        n_by, n_bx = n_cy - block + 1, n_cx - block + 1
+        rows = jnp.arange(n_by)[:, None] + jnp.arange(block)[None, :]
+        cols = jnp.arange(n_bx)[:, None] + jnp.arange(block)[None, :]
+        blocks = cells[rows[:, None, :, None], cols[None, :, None, :]]
+        # (n_by, n_bx, block, block, o)
+    flat = blocks.reshape(blocks.shape[0], blocks.shape[1], -1)
+    norm = jnp.sqrt((flat * flat).sum(-1, keepdims=True) + eps * eps)
+    return (flat / norm).reshape(-1)
+
+
+def hog_batch(images, **kwargs):
+    """vmap'd HOG over (B, H, W) (grayscale) or (B, H, W, C) (channels
+    averaged first, like luminance pre-pooling)."""
+    images = jnp.asarray(images, jnp.float32)
+    if images.ndim == 4:
+        images = images.mean(axis=-1)
+    fn = functools.partial(hog, **kwargs)
+    return jax.vmap(fn)(images)

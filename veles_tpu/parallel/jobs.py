@@ -295,9 +295,28 @@ class JobClient(Logger):
 
     def run(self, max_jobs=None):
         """Job loop: request → do_job → update, until no_more_jobs."""
+        return self._run_loop(max_jobs, prefetch=False)
+
+    def run_prefetch(self, max_jobs=None):
+        """Async double-buffered loop (ref ``_balance=2``,
+        ``server.py:262-281`` + ``client.py:293-296``): the NEXT job is
+        requested while the current one computes, overlapping the
+        master's job generation with slave compute.
+
+        Only for masters that tolerate two in-flight jobs per slave
+        (DP-style index partitioning); per-slave single-slot
+        bookkeepers (GeneticsOptimizer, EnsembleModelManager) need the
+        plain :meth:`run`.
+        """
+        return self._run_loop(max_jobs, prefetch=True)
+
+    def _run_loop(self, max_jobs, prefetch):
         import random as _random
+        next_reply = None   # prefetched reply not yet processed
         while max_jobs is None or self.jobs_done < max_jobs:
-            reply = self._rpc({"op": "job_request", "id": self.sid})
+            reply = next_reply if next_reply is not None else \
+                self._rpc({"op": "job_request", "id": self.sid})
+            next_reply = None
             if reply["op"] == "no_more_jobs":
                 break
             if reply["op"] == "wait":
@@ -315,8 +334,41 @@ class JobClient(Logger):
                                   args=(stop_hb,), daemon=True)
             hb.start()
             try:
-                self.workflow.do_job(
-                    reply["data"], lambda out: result.__setitem__(0, out))
+                # don't prefetch past max_jobs — a job handed out on the
+                # final iteration would be silently dropped (the master
+                # counts it served but never gets an update)
+                want_prefetch = prefetch and (
+                    max_jobs is None or self.jobs_done + 1 < max_jobs)
+                if want_prefetch:
+                    # compute in a worker while the master generates the
+                    # next job — the double-buffer overlap
+                    error = []
+
+                    def compute():
+                        try:
+                            self.workflow.do_job(
+                                reply["data"],
+                                lambda out: result.__setitem__(0, out))
+                        except BaseException as e:
+                            error.append(e)
+
+                    worker = threading.Thread(target=compute)
+                    worker.start()
+                    try:
+                        # generation is EXPECTED to be slow here (the
+                        # overlap is the point) — allow a long wait
+                        next_reply = self._rpc(
+                            {"op": "job_request", "id": self.sid},
+                            timeout_ms=120000)
+                    except TimeoutError:
+                        next_reply = None   # retry next iteration
+                    worker.join()
+                    if error:
+                        raise error[0]
+                else:
+                    self.workflow.do_job(
+                        reply["data"],
+                        lambda out: result.__setitem__(0, out))
             finally:
                 stop_hb.set()
                 hb.join(self.heartbeat_interval + 3)
