@@ -103,18 +103,29 @@ class JobServer(Logger):
         poller = zmq.Poller()
         poller.register(self._socket, zmq.POLLIN)
         last_reap = time.time()
+        import zmq as _zmq
         while not self._stop.is_set():
             if poller.poll(200):
-                identity, blob = self._socket.recv_multipart()
-                try:
-                    msg = pickle.loads(blob)
-                except Exception:
-                    self.exception("undecodable message")
-                    continue
-                try:
-                    self._dispatch(identity, msg)
-                except Exception:
-                    self.exception("failed handling %r", msg.get("op"))
+                # drain EVERYTHING queued before reaping: a slow
+                # generate_data_for_slave stalls this loop, and pings
+                # that piled up meanwhile must refresh last_seen before
+                # the reaper judges those slaves dead
+                while True:
+                    try:
+                        identity, blob = self._socket.recv_multipart(
+                            flags=_zmq.NOBLOCK)
+                    except _zmq.Again:
+                        break
+                    try:
+                        msg = pickle.loads(blob)
+                    except Exception:
+                        self.exception("undecodable message")
+                        continue
+                    try:
+                        self._dispatch(identity, msg)
+                    except Exception:
+                        self.exception("failed handling %r",
+                                       msg.get("op"))
             if time.time() - last_reap >= self.heartbeat_interval:
                 last_reap = time.time()
                 self._reap_dead_slaves()
@@ -273,6 +284,31 @@ class JobClient(Logger):
                     return reply
                 # stale pong from a timed-out heartbeat — skip it
 
+    def _request_with_pings(self, msg, max_wait=600.0):
+        """Send one request and wait for its (non-pong) reply, emitting
+        pings while waiting.  Replies stay ordered per DEALER identity,
+        so the first non-pong reply IS the answer; abandoning early
+        would desync the stream — hence one generous overall cap that
+        treats the master as gone."""
+        import zmq
+        deadline = time.time() + max_wait
+        with self._socket_lock:
+            self._socket.send(pickle.dumps(msg, pickle.HIGHEST_PROTOCOL))
+            while True:
+                if self._socket.poll(
+                        int(self.heartbeat_interval * 1000), zmq.POLLIN):
+                    reply = pickle.loads(self._socket.recv())
+                    if reply.get("op") != "pong":
+                        return reply
+                    continue
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        "master silent for %.0fs during %r"
+                        % (max_wait, msg.get("op")))
+                self._socket.send(pickle.dumps(
+                    {"op": "ping", "id": self.sid},
+                    pickle.HIGHEST_PROTOCOL))
+
     def _heartbeat_loop(self, stop_event):
         """Keeps the master's last_seen fresh while a long job runs
         (replaces the reference's Twisted connection liveness)."""
@@ -354,14 +390,13 @@ class JobClient(Logger):
 
                     worker = threading.Thread(target=compute)
                     worker.start()
-                    try:
-                        # generation is EXPECTED to be slow here (the
-                        # overlap is the point) — allow a long wait
-                        next_reply = self._rpc(
-                            {"op": "job_request", "id": self.sid},
-                            timeout_ms=120000)
-                    except TimeoutError:
-                        next_reply = None   # retry next iteration
+                    # generation is EXPECTED to be slow here (the
+                    # overlap is the point); the wait pings from inside
+                    # the socket lock so the master keeps seeing us
+                    # alive while the external heartbeat thread is
+                    # locked out
+                    next_reply = self._request_with_pings(
+                        {"op": "job_request", "id": self.sid})
                     worker.join()
                     if error:
                         raise error[0]

@@ -88,3 +88,26 @@ def _synthetic_images(shape, n_classes, n_train, n_valid):
     x = (x - x.min()) / (x.max() - x.min())
     return (x[:n_train], labels[:n_train].astype(numpy.int64),
             x[n_train:], labels[n_train:].astype(numpy.int64))
+
+
+def load_stl10():
+    """STL-10 (96×96×3, 10 classes): binary layout from the official
+    distribution (`stl10_binary/{train,test}_{X,y}.bin`, uint8 CHW
+    column-major images, 1-based labels), else synthetic stand-ins."""
+    base = os.path.join(_dataset_dir(), "stl10_binary")
+    names = ("train_X.bin", "train_y.bin", "test_X.bin", "test_y.bin")
+    paths = [os.path.join(base, n) for n in names]
+    if all(os.path.exists(p) for p in paths):
+        def read_x(path):
+            raw = numpy.fromfile(path, dtype=numpy.uint8)
+            imgs = raw.reshape(-1, 3, 96, 96)
+            # official layout is column-major per channel → transpose
+            return imgs.transpose(0, 3, 2, 1).astype(
+                numpy.float32) / 255.0
+
+        def read_y(path):
+            return numpy.fromfile(path, dtype=numpy.uint8).astype(
+                numpy.int64) - 1
+        return (read_x(paths[0]), read_y(paths[1]),
+                read_x(paths[2]), read_y(paths[3]), True)
+    return _synthetic_images((96, 96, 3), 10, 1000, 800) + (False,)

@@ -27,19 +27,44 @@ def make_layers(hidden=100, learning_rate=0.01):
     ]
 
 
+def make_conv_layers(kernels=8, learning_rate=0.01):
+    """Conv-AE (ref "convolutional autoencoder" family,
+    ``manualrst_veles_algorithms.rst:56-70``): conv encoder + deconv
+    decoder sharing geometry."""
+    return [
+        {"type": "conv_tanh",
+         "->": {"n_kernels": kernels, "kx": 3, "ky": 3, "padding": 1},
+         "<-": {"learning_rate": learning_rate,
+                "gradient_moment": 0.9}},
+        {"type": "deconv",
+         "->": {"n_kernels": kernels, "kx": 3, "ky": 3, "padding": 1,
+                "output_channels": 1},
+         "<-": {"learning_rate": learning_rate,
+                "gradient_moment": 0.9}},
+    ]
+
+
 class MnistAELoader(FullBatchLoaderMSE):
     """Targets = inputs (reconstruction)."""
+
+    #: (784,) for the MLP AE; (28, 28, 1) for the conv AE
+    SAMPLE_SHAPE = (784,)
 
     def load_data(self):
         tr_x, tr_y, te_x, te_y, real = load_mnist()
         if not real:
             self.warning("real MNIST not found — synthetic stand-in")
-        data = numpy.concatenate([te_x, tr_x]).reshape(-1, 784)
+        data = numpy.concatenate([te_x, tr_x]).reshape(
+            (-1,) + self.SAMPLE_SHAPE)
         data = numpy.ascontiguousarray(data, dtype=numpy.float32)
         self.original_data.mem = data
         self.original_targets.mem = data.copy()
         self.original_labels = []
         self.class_lengths[:] = [0, len(te_y), len(tr_y)]
+
+
+class MnistConvAELoader(MnistAELoader):
+    SAMPLE_SHAPE = (28, 28, 1)
 
 
 def pretrain_rbm(loader_data, hidden=100, epochs=3, batch=100):
@@ -61,12 +86,14 @@ def pretrain_rbm(loader_data, hidden=100, epochs=3, batch=100):
 
 
 def create_workflow(device=None, max_epochs=15, minibatch_size=100,
-                    hidden=100, rbm_pretrain=False, **kwargs):
-    layers = make_layers(hidden=hidden)
+                    hidden=100, rbm_pretrain=False, conv=False,
+                    **kwargs):
+    layers = make_conv_layers() if conv else make_layers(hidden=hidden)
+    loader_class = MnistConvAELoader if conv else MnistAELoader
     loader_holder = {}
 
     def factory(w):
-        loader = MnistAELoader(w, minibatch_size=minibatch_size)
+        loader = loader_class(w, minibatch_size=minibatch_size)
         loader_holder["loader"] = loader
         return loader
 

@@ -1,0 +1,70 @@
+"""MNIST convnet (LeNet-style).
+
+Parity target: the reference's MNIST conv forge model
+(``manualrst_veles_example.rst:57`` — 0.73 % validation error snapshot)
+— conv/pool ×2 + fc + softmax over 28×28×1 images.
+"""
+
+import numpy
+
+from veles_tpu.backends import AutoDevice
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.samples.datasets import load_mnist
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+LAYERS = [
+    {"type": "conv_strict_relu",
+     "->": {"n_kernels": 20, "kx": 5, "ky": 5,
+            "weights_filling": "uniform"},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+    {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+    {"type": "conv_strict_relu",
+     "->": {"n_kernels": 50, "kx": 5, "ky": 5,
+            "weights_filling": "uniform"},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+    {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 500},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 10},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+]
+
+
+class MnistConvLoader(FullBatchLoader):
+    """Images kept 2-D (28, 28, 1) for the conv stack."""
+
+    def load_data(self):
+        tr_x, tr_y, te_x, te_y, real = load_mnist()
+        if not real:
+            self.warning("real MNIST not found — synthetic stand-in")
+        data = numpy.concatenate([te_x, tr_x]).reshape(-1, 28, 28, 1)
+        labels = numpy.concatenate([te_y, tr_y])
+        self.original_data.mem = numpy.ascontiguousarray(
+            data, dtype=numpy.float32)
+        self.original_labels = [int(v) for v in labels]
+        self.class_lengths[:] = [0, len(te_y), len(tr_y)]
+
+
+def create_workflow(device=None, max_epochs=25, minibatch_size=100,
+                    layers=None, **kwargs):
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: MnistConvLoader(
+            w, minibatch_size=minibatch_size),
+        layers=[{**spec} for spec in (layers or LAYERS)],
+        decision_config={"max_epochs": max_epochs},
+        **kwargs)
+    launcher = kwargs.pop("launcher", None)
+    wf.launcher = launcher if launcher is not None else DummyLauncher()
+    if launcher is None:
+        wf.initialize(device=device or AutoDevice())
+    return wf
+
+
+def main(**kwargs):
+    from veles_tpu.logger import setup_logging
+    setup_logging()
+    wf = create_workflow(**kwargs)
+    wf.run()
+    return wf

@@ -1,0 +1,85 @@
+"""STL-10 convnet.
+
+Parity target: ``manualrst_veles_algorithms.rst:51`` (validation error
+35.10 %) — the reference trained the same caffe-style conv stack on
+STL-10's 96×96 images.  Reuses the CIFAR machinery with a deeper
+pool ladder for the 3× larger geometry.
+"""
+
+import numpy
+
+from veles_tpu.backends import AutoDevice
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.samples.datasets import load_stl10
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+LAYERS = [
+    {"type": "conv_strict_relu",
+     "->": {"n_kernels": 32, "kx": 5, "ky": 5, "padding": 2,
+            "sliding": (2, 2), "weights_filling": "gaussian",
+            "weights_stddev": 0.01},
+     "<-": {"learning_rate": 0.001, "gradient_moment": 0.9,
+            "weights_decay": 0.004}},
+    {"type": "max_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+    {"type": "conv_strict_relu",
+     "->": {"n_kernels": 64, "kx": 5, "ky": 5, "padding": 2,
+            "weights_filling": "gaussian", "weights_stddev": 0.01},
+     "<-": {"learning_rate": 0.001, "gradient_moment": 0.9,
+            "weights_decay": 0.004}},
+    {"type": "avg_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+    {"type": "conv_strict_relu",
+     "->": {"n_kernels": 64, "kx": 3, "ky": 3, "padding": 1,
+            "weights_filling": "gaussian", "weights_stddev": 0.01},
+     "<-": {"learning_rate": 0.001, "gradient_moment": 0.9,
+            "weights_decay": 0.004}},
+    {"type": "avg_pooling", "->": {"kx": 3, "ky": 3, "sliding": (2, 2)}},
+    {"type": "all2all", "->": {"output_sample_shape": 128,
+                               "weights_filling": "gaussian",
+                               "weights_stddev": 0.1},
+     "<-": {"learning_rate": 0.001, "gradient_moment": 0.9,
+            "weights_decay": 0.03}},
+    {"type": "softmax", "->": {"output_sample_shape": 10,
+                               "weights_filling": "gaussian",
+                               "weights_stddev": 0.1},
+     "<-": {"learning_rate": 0.001, "gradient_moment": 0.9,
+            "weights_decay": 0.03}},
+]
+
+
+class Stl10Loader(FullBatchLoader):
+    def load_data(self):
+        tr_x, tr_y, te_x, te_y, real = load_stl10()
+        if not real:
+            self.warning("real STL-10 not found — synthetic stand-in")
+        data = numpy.concatenate([te_x, tr_x])
+        labels = numpy.concatenate([te_y, tr_y])
+        self.original_data.mem = numpy.ascontiguousarray(
+            data, dtype=numpy.float32)
+        self.original_labels = [int(v) for v in labels]
+        self.class_lengths[:] = [0, len(te_y), len(tr_y)]
+
+
+def create_workflow(device=None, max_epochs=40, minibatch_size=50,
+                    layers=None, **kwargs):
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: Stl10Loader(
+            w, minibatch_size=minibatch_size,
+            normalization_type="internal_mean"),
+        layers=[{**spec} for spec in (layers or LAYERS)],
+        decision_config={"max_epochs": max_epochs},
+        **kwargs)
+    launcher = kwargs.pop("launcher", None)
+    wf.launcher = launcher if launcher is not None else DummyLauncher()
+    if launcher is None:
+        wf.initialize(device=device or AutoDevice())
+    return wf
+
+
+def main(**kwargs):
+    from veles_tpu.logger import setup_logging
+    setup_logging()
+    wf = create_workflow(**kwargs)
+    wf.run()
+    return wf
