@@ -1,80 +1,299 @@
-"""Benchmark: prints ONE JSON line
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""Benchmark ladder: prints JSON lines
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``.
 
-Primary metric (BASELINE.json): Znicz ImageNet AlexNet images/sec/chip —
-the fused train step (forward+backward+update in one XLA program) on
-synthetic shape-true ImageNet batches.  ``vs_baseline`` compares against
-1500 images/sec, a generous estimate of single-V100 AlexNet *training*
-throughput with tuned fp32 CUDA kernels (the reference's own OpenCL
-backend was measured-era slower); the driver-defined target is v5e-8 ≥
-4× single-V100-ocl, i.e. vs_baseline ≥ 0.5 per chip.
+Designed to always leave a parsed line even under adversity (the round-1
+failure mode was a backend-init hang that produced nothing):
 
-Falls back to reporting raw MNIST784 MLP fused train throughput
-(vs_baseline null — no published reference number for that path) if
-AlexNet cannot run (e.g. insufficient HBM on a shared chip).
+1. **Backend probe first** — a tiny jit in a *subprocess* with a hard
+   timeout.  A dead/hung TPU tunnel is detected and killed, never hangs
+   the harness, and triggers a CPU fallback so a number still gets
+   recorded (tagged ``[cpu-fallback]``).
+2. **Cheapest-first ladder** — MNIST MLP → CIFAR-10 conv → AlexNet, each
+   stage its own subprocess with a wall-clock cap.  Each completed stage
+   prints its JSON line *immediately*, so an external timeout mid-ladder
+   still leaves the best completed result on stdout (last line = best).
+3. **MFU reported** alongside throughput: XLA's own
+   ``compiled.cost_analysis()`` flop count / measured step time / peak
+   bf16 FLOPs for the detected TPU generation.
+
+Headline metric (BASELINE.json): Znicz ImageNet AlexNet images/sec/chip
+on the fused train step (forward+backward+update in one XLA program,
+bf16 compute / fp32 master weights).  ``vs_baseline`` compares against
+1500 images/sec — a generous single-V100 AlexNet training throughput
+(the reference's own OpenCL backend was slower); driver target is
+v5e-8 ≥ 4× single-V100, i.e. vs_baseline ≥ 0.5 per chip.
+
+Env knobs: ``BENCH_BUDGET_SEC`` (default 480) total wall-clock budget;
+``BENCH_STAGES`` comma list to restrict stages.
+
+Reference discipline mirrored: the in-situ benchmark unit
+``/root/reference/veles/accelerated_units.py:706-825`` (min-of-N timed
+kernel chain rating the device) — here the "chain" is the real fused
+train step and the rating is images/sec + MFU.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import numpy
 
 V100_ALEXNET_IMG_PER_SEC = 1500.0
 
+# peak dense bf16 FLOP/s per *jax device* (v2/v3 devices are single
+# TensorCores = half a chip; v4+ are whole chips/megacores)
+_PEAK_BF16 = [
+    ("v6", 918e12),     # Trillium ("TPU v6 lite"/"TPU v6e")
+    ("v5p", 459e12),
+    ("v5", 197e12),     # "TPU v5 lite" / v5e
+    ("v4", 275e12),
+    ("v3", 61.5e12),
+    ("v2", 22.5e12),
+]
 
-def bench_alexnet():
-    from veles_tpu import prng
-    from veles_tpu.samples import alexnet
-    prng.seed_all(1234)
-    ips = alexnet.benchmark(batch=128, steps=10)
-    return {
-        "metric": "AlexNet fused train throughput per chip",
+
+def _peak_flops(device_kind):
+    kind = (device_kind or "").lower()
+    for tag, peak in _PEAK_BF16:
+        if tag in kind:
+            return peak
+    return None
+
+
+def _aot_compile(step_fn, *args):
+    """AOT-compile the train step ONCE (donated params) and return
+    (compiled_callable, flops_per_step|None) — the same executable serves
+    cost analysis and the timed loop, so each stage pays one compile."""
+    import jax
+    compiled = jax.jit(step_fn, donate_argnums=(0,)).lower(*args).compile()
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        flops = None
+    return compiled, flops
+
+
+def _timed_loop(step, params, x, labels, steps, min_seconds=2.0):
+    """Run batches of `steps` iterations until `min_seconds` of measured
+    work; return seconds per step."""
+    import jax
+    params, _ = step(params, x, labels)   # compile + warm
+    jax.block_until_ready(params)
+    total_steps = 0
+    tic = time.perf_counter()
+    while True:
+        for _ in range(steps):
+            params, _m = step(params, x, labels)
+        jax.block_until_ready(params)
+        total_steps += steps
+        elapsed = time.perf_counter() - tic
+        if elapsed >= min_seconds or total_steps >= 20 * steps:
+            return elapsed / total_steps
+
+
+# --------------------------------------------------------------------------
+# stages (run in child processes; each prints ONE json line on stdout)
+# --------------------------------------------------------------------------
+
+def stage_probe():
+    import jax
+    dev = jax.devices()[0]
+    import jax.numpy as jnp
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    print(json.dumps({"platform": dev.platform,
+                      "device_kind": dev.device_kind,
+                      "n_devices": jax.device_count()}))
+
+
+def _device_kind():
+    import jax
+    return jax.devices()[0].device_kind
+
+
+def _emit(metric, sec_per_step, batch, flops, vs=None):
+    ips = batch / sec_per_step
+    kind = _device_kind()
+    peak = _peak_flops(kind)
+    mfu = (flops / sec_per_step / peak) if (flops and peak) else None
+    print(json.dumps({
+        "metric": metric,
         "value": round(ips, 1),
         "unit": "images/sec",
-        "vs_baseline": round(ips / V100_ALEXNET_IMG_PER_SEC, 2),
-    }
+        "vs_baseline": (round(ips / vs, 3) if vs else None),
+        "mfu": (round(mfu, 4) if mfu is not None else None),
+        "sec_per_step": round(sec_per_step, 6),
+        "batch": batch,
+        "device_kind": kind,
+    }))
 
 
-def bench_mnist_mlp():
+def stage_mnist():
+    import numpy
+
     import jax
     from veles_tpu import prng
     from veles_tpu.znicz.fused import init_mlp_params, make_train_step
     from __graft_entry__ import MNIST_LAYERS
 
     prng.seed_all(1234)
-    batch, steps = 1024, 50
+    batch = 8192
     params = init_mlp_params(784, MNIST_LAYERS)
-    step = jax.jit(make_train_step(MNIST_LAYERS), donate_argnums=(0,))
     rng = numpy.random.default_rng(0)
-    x = rng.standard_normal((batch, 784)).astype(numpy.float32)
-    labels = rng.integers(0, 10, batch).astype(numpy.int32)
-    params = step(params, x, labels)[0]
-    jax.block_until_ready(params)
-    tic = time.perf_counter()
-    for _ in range(steps):
-        params, _metrics = step(params, x, labels)
-    jax.block_until_ready(params)
-    sps = steps * batch / (time.perf_counter() - tic)
-    return {
-        "metric": "MNIST784 MLP fused train throughput",
-        "value": round(sps, 1),
-        "unit": "samples/sec",
-        "vs_baseline": None,
-    }
+    x = jax.device_put(
+        rng.standard_normal((batch, 784)).astype(numpy.float32))
+    labels = jax.device_put(
+        rng.integers(0, 10, batch).astype(numpy.int32))
+    step, flops = _aot_compile(make_train_step(MNIST_LAYERS),
+                               params, x, labels)
+    sec = _timed_loop(step, params, x, labels, steps=50)
+    _emit("MNIST784 MLP fused train throughput", sec, batch, flops)
+
+
+def _conv_stage(metric, layers, input_shape, n_classes, batch, steps,
+                vs=None, compute_dtype="bfloat16"):
+    import numpy
+
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu import prng
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    prng.seed_all(1234)
+    params, step_fn, _eval, _apply = lower_specs(
+        layers, input_shape, compute_dtype=jnp.dtype(compute_dtype).type)
+    rng = numpy.random.default_rng(0)
+    x = jax.device_put(rng.standard_normal(
+        (batch,) + tuple(input_shape)).astype(numpy.float32))
+    labels = jax.device_put(
+        rng.integers(0, n_classes, batch).astype(numpy.int32))
+    step, flops = _aot_compile(step_fn, params, x, labels)
+    sec = _timed_loop(step, params, x, labels, steps=steps)
+    _emit(metric, sec, batch, flops, vs=vs)
+
+
+def stage_cifar():
+    from veles_tpu.samples import cifar10
+    _conv_stage("CIFAR-10 convnet fused train throughput",
+                cifar10.LAYERS, (32, 32, 3), 10, batch=1024, steps=20)
+
+
+def stage_alexnet():
+    from veles_tpu.samples import alexnet
+    _conv_stage(
+        "AlexNet fused train throughput per chip (bf16)",
+        alexnet.LAYERS, alexnet.INPUT_SHAPE, 1000, batch=256, steps=10,
+        vs=V100_ALEXNET_IMG_PER_SEC)
+
+
+STAGES = {
+    "probe": (stage_probe, 180),
+    "mnist": (stage_mnist, 150),
+    "cifar": (stage_cifar, 210),
+    "alexnet": (stage_alexnet, 330),
+}
+
+
+# --------------------------------------------------------------------------
+# parent orchestration
+# --------------------------------------------------------------------------
+
+def _run_stage(name, timeout, env=None):
+    """Run a ladder stage in a subprocess; returns (parsed_json|None,
+    reason).  ``env`` overrides os.environ; a value of None REMOVES the
+    variable (needed to truly disable a sitecustomize-registered TPU
+    tunnel platform, which overrides ``jax_platforms`` behind the env
+    var's back at interpreter start)."""
+    full_env = dict(os.environ)
+    if env:
+        for k, v in env.items():
+            if v is None:
+                full_env.pop(k, None)
+            else:
+                full_env[k] = v
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--stage", name],
+            capture_output=True, text=True, timeout=timeout, env=full_env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None, "timeout after %ds" % timeout
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-6:]
+        return None, "rc=%d: %s" % (proc.returncode, " | ".join(tail))
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            return json.loads(line), None
+        except ValueError:
+            continue
+    return None, "no json in stage output"
 
 
 def main():
-    try:
-        result = bench_alexnet()
-    except Exception:
-        import sys
-        import traceback
-        print("AlexNet benchmark failed — falling back to MNIST MLP:",
+    budget = float(os.environ.get("BENCH_BUDGET_SEC", "480"))
+    deadline = time.monotonic() + budget
+    only = os.environ.get("BENCH_STAGES")
+    only = ({s.strip() for s in only.split(",")} if only else None)
+    if only:
+        for s in only - set(STAGES):
+            print("BENCH_STAGES: unknown stage %r ignored" % s,
+                  file=sys.stderr)
+
+    def remaining():
+        return deadline - time.monotonic()
+
+    # 1. backend probe (subprocess — a hung TPU init cannot hang us)
+    env = {}
+    cap = min(STAGES["probe"][1], max(30.0, remaining()))
+    probe, err = _run_stage("probe", cap)
+    if probe is None:
+        print("probe failed (%s); falling back to CPU" % err,
               file=sys.stderr)
-        traceback.print_exc()
-        result = bench_mnist_mlp()
-    print(json.dumps(result))
+        env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
+        probe, err = _run_stage("probe", min(120, max(30.0, remaining())),
+                                env=env)
+        if probe is None:
+            print(json.dumps({
+                "metric": "benchmark unavailable (backend init failed)",
+                "value": 0.0, "unit": "images/sec", "vs_baseline": None,
+                "error": err}))
+            return
+    platform = probe.get("platform", "?")
+    # CPU fallback results are tagged so they are never mistaken for a
+    # TPU number
+    suffix = " [cpu-fallback]" if env else ""
+    print("probe ok: %s" % json.dumps(probe), file=sys.stderr)
+
+    printed_any = False
+    for name in ("mnist", "cifar", "alexnet"):
+        if only and name not in only:
+            continue
+        _fn, cap = STAGES[name]
+        if remaining() < 45:
+            print("budget exhausted before %s" % name, file=sys.stderr)
+            break
+        result, err = _run_stage(name, min(cap, remaining()), env=env)
+        if result is None:
+            print("stage %s failed: %s" % (name, err), file=sys.stderr)
+            continue
+        if suffix:
+            result["metric"] += suffix
+        # incremental: each completed stage immediately becomes the
+        # latest (= best-so-far) parsed line on stdout
+        print(json.dumps(result), flush=True)
+        printed_any = True
+    if not printed_any:
+        print(json.dumps({
+            "metric": "benchmark failed (no stage completed on %s)"
+                      % platform,
+            "value": 0.0, "unit": "images/sec", "vs_baseline": None}))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--stage":
+        STAGES[sys.argv[2]][0]()
+    else:
+        main()
