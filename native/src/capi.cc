@@ -56,10 +56,15 @@ int veles_native_output_shape(void* handle, long long* dims, int cap) {
 }
 
 int veles_native_input_shape(void* handle, long long* dims, int cap) {
-  const auto& shape = static_cast<Workflow*>(handle)->input_shape();
-  if (static_cast<int>(shape.size()) > cap) return -1;
-  for (size_t i = 0; i < shape.size(); ++i) dims[i] = shape[i];
-  return static_cast<int>(shape.size());
+  try {
+    const auto& shape = static_cast<Workflow*>(handle)->input_shape();
+    if (static_cast<int>(shape.size()) > cap) return -1;
+    for (size_t i = 0; i < shape.size(); ++i) dims[i] = shape[i];
+    return static_cast<int>(shape.size());
+  } catch (...) {
+    // exceptions must not cross the C ABI into a ctypes caller
+    return -1;
+  }
 }
 
 long long veles_native_arena_floats(void* handle) {

@@ -93,11 +93,29 @@ NpyArray LoadNpy(const uint8_t* bytes, size_t len) {
                                           : comma - start);
     bool any_digit = false;
     for (char c : tok) any_digit |= (c >= '0' && c <= '9');
-    if (any_digit) arr.shape.push_back(std::stoll(tok));
+    if (any_digit) {
+      int64_t dim = 0;
+      try {
+        dim = std::stoll(tok);
+      } catch (const std::exception&) {
+        throw std::runtime_error("npy: unparseable shape dim");
+      }
+      if (dim < 0) throw std::runtime_error("npy: negative shape dim");
+      arr.shape.push_back(dim);
+    }
     if (comma == std::string::npos) break;
     start = comma + 1;
   }
-  int64_t count = arr.size();
+  // Overflow-checked element count with an allocation cap: shape dims
+  // come from the (untrusted) package, and an overflowed product would
+  // be UB before resize() could even object.
+  constexpr int64_t kMaxElements = int64_t(1) << 31;  // 8 GiB of f32
+  int64_t count = 1;
+  for (int64_t d : arr.shape) {
+    if (d != 0 && count > kMaxElements / d)
+      throw std::runtime_error("npy: element count overflows cap");
+    count *= d;
+  }
   const uint8_t* payload = bytes + header_at + header_len;
   size_t avail = len - header_at - header_len;
   arr.data.resize(static_cast<size_t>(count));

@@ -28,8 +28,15 @@ uint32_t Le32(const uint8_t* p) {
 }
 uint16_t Le16(const uint8_t* p) { return p[0] | (p[1] << 8); }
 
+// Per-entry allocation cap: entry sizes come from untrusted package
+// headers; without a cap a crafted archive OOMs the runtime before any
+// content validation runs.
+constexpr size_t kMaxEntryBytes = size_t(1) << 30;  // 1 GiB
+
 std::vector<uint8_t> InflateRaw(const uint8_t* src, size_t src_len,
                                 size_t dst_len) {
+  if (dst_len > kMaxEntryBytes)
+    throw std::runtime_error("zip: entry exceeds allocation cap");
   std::vector<uint8_t> out(dst_len);
   z_stream zs;
   std::memset(&zs, 0, sizeof(zs));
@@ -123,6 +130,10 @@ FileMap ReadTarGz(const std::string& path) {
     char size_field[13] = {0};
     std::memcpy(size_field, block + 124, 12);
     size_t size = std::strtoull(size_field, nullptr, 8);
+    if (size > kMaxEntryBytes) {
+      gzclose(gz);
+      throw std::runtime_error("tar: entry exceeds allocation cap");
+    }
     char type = block[156];
     std::vector<uint8_t> data(size);
     size_t got = 0;

@@ -102,3 +102,19 @@ def test_empty_dir_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         ckpt.restore({"x": numpy.zeros(1, numpy.float32)})
     ckpt.close()
+
+
+def test_jsonify_roundtrip_typed_dict_keys():
+    """Loader/prng states keyed by ints (e.g. class-index offsets) must
+    survive the JSON round-trip with key types intact (ADVICE r1)."""
+    import json
+    from veles_tpu.checkpoint import _dejsonify, _jsonify
+
+    state = {2: [1, 2, 3], 0: (4, 5), "name": {"nested": {7: "x"}},
+             (1, 2): "tuple-key"}
+    wire = json.loads(json.dumps(_jsonify(state)))
+    back = _dejsonify(wire)
+    assert back[2] == [1, 2, 3]
+    assert back[0] == (4, 5)
+    assert back["name"]["nested"][7] == "x"
+    assert back[(1, 2)] == "tuple-key"

@@ -71,6 +71,9 @@ class TestSnapshotter:
         wf.initialize()
         snap.suffix = "one"
         snap.export()
+        # reading .destination joins the in-flight background write —
+        # the documented way to wait for the artifact (symlink included)
+        assert snap.destination
         current = os.path.join(str(tmp_path),
                                "veles_tpu_current.pickle.gz")
         assert os.path.islink(current)

@@ -333,3 +333,27 @@ def test_generate_graph_dot():
     a.link_from(wf.start_point)
     dot = wf.generate_graph()
     assert dot.startswith("digraph") and "->" in dot
+
+
+def test_checksum_distinguishes_workflows_and_fails_closed():
+    """Checksum hashes module file bytes + graph structure; a class with
+    no retrievable code raises instead of silently matching
+    (ref ``veles/workflow.py:852-866`` hashes the workflow file)."""
+    from veles_tpu.workflow import ChecksumError
+
+    wf1 = DummyWorkflow()
+    DummyUnit(wf1, name="a")
+    wf2 = DummyWorkflow()
+    DummyUnit(wf2, name="a")
+    DummyUnit(wf2, name="b")
+    assert wf1.checksum() != wf2.checksum()    # structure differs
+    assert wf1.checksum() == wf1.checksum()    # deterministic
+
+    ns = {}
+    exec("from veles_tpu.units import Unit\n"
+         "class ReplUnit(Unit):\n"
+         "    def run(self): pass\n", ns)
+    wf3 = DummyWorkflow()
+    ns["ReplUnit"](wf3, name="repl")
+    with pytest.raises(ChecksumError):
+        wf3.checksum()

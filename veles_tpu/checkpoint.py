@@ -127,9 +127,14 @@ class TrainCheckpointer(Logger):
 
 
 def _jsonify(obj):
-    """PRNG/loader states hold tuples + ndarrays; JSON round-trip them."""
+    """PRNG/loader states hold tuples + ndarrays; JSON round-trip them.
+    Dicts with non-string keys (e.g. loader state keyed by class index)
+    are encoded as item lists so the keys survive the round-trip typed."""
     if isinstance(obj, dict):
-        return {str(k): _jsonify(v) for k, v in obj.items()}
+        if all(isinstance(k, str) for k in obj):
+            return {str(k): _jsonify(v) for k, v in obj.items()}
+        return {"__items__": [[_jsonify(k), _jsonify(v)]
+                              for k, v in obj.items()]}
     if isinstance(obj, (list, tuple)):
         return {"__seq__": [_jsonify(v) for v in obj],
                 "__tuple__": isinstance(obj, tuple)}
@@ -156,5 +161,18 @@ def _dejsonify(obj):
         if "__bytes__" in obj:
             import base64
             return base64.b64decode(obj["__bytes__"])
+        if "__items__" in obj:
+            return {_hashable(_dejsonify(k)): _dejsonify(v)
+                    for k, v in obj["__items__"]}
         return {k: _dejsonify(v) for k, v in obj.items()}
     return obj
+
+
+def _hashable(key):
+    """Dejsonified dict keys: lists/ndarrays came back from tuple-typed
+    keys; make them hashable again."""
+    if isinstance(key, numpy.ndarray):
+        return tuple(key.tolist())
+    if isinstance(key, list):
+        return tuple(_hashable(k) for k in key)
+    return key

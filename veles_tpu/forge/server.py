@@ -17,6 +17,7 @@ REST surface (mirrors forge_client verbs fetch/upload/list/delete,
 """
 
 import hashlib
+import hmac
 import io
 import json
 import os
@@ -174,10 +175,12 @@ class ForgeServer(Logger):
     """The hub service; ``tokens`` maps token → user name (uploads and
     deletions require one; reads are public, like the reference)."""
 
-    def __init__(self, directory, tokens=None, host="127.0.0.1", port=0):
+    def __init__(self, directory, tokens=None, host="127.0.0.1", port=0,
+                 max_upload_bytes=512 * 1024 * 1024):
         super(ForgeServer, self).__init__()
         self.store = ForgeStore(directory)
         self.tokens = dict(tokens or {})
+        self.max_upload_bytes = int(max_upload_bytes)
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -195,7 +198,17 @@ class ForgeServer(Logger):
 
             def _auth(self):
                 token = self.headers.get("X-Veles-Token", "")
-                user = server.tokens.get(token)
+                # constant-time scan over all tokens — a dict lookup's
+                # timing leaks prefix-match length to a remote prober.
+                # Compare sha256 digests: fixed length, bytes-safe for
+                # non-ASCII header values (compare_digest would raise).
+                probe = hashlib.sha256(
+                    token.encode("latin-1", "replace")).digest()
+                user = None
+                for candidate, candidate_user in server.tokens.items():
+                    expected = hashlib.sha256(candidate.encode()).digest()
+                    if hmac.compare_digest(expected, probe):
+                        user = candidate_user
                 if user is None:
                     self._reply(401, {"error": "bad token"})
                 return user
@@ -253,6 +266,20 @@ class ForgeServer(Logger):
                 if len(parts) == 2 and parts[0] == "models":
                     name = urllib.parse.unquote(parts[1])
                     length = int(self.headers.get("Content-Length", 0))
+                    if length > server.max_upload_bytes:
+                        # drain a bounded slice so the client reads the
+                        # 413 instead of a connection reset, then close
+                        self.close_connection = True
+                        drained = 0
+                        while drained < min(length, 1 << 20):
+                            chunk = self.rfile.read(
+                                min(65536, length - drained))
+                            if not chunk:
+                                break
+                            drained += len(chunk)
+                        self._reply(413, {"error": "upload exceeds %d "
+                                          "bytes" % server.max_upload_bytes})
+                        return
                     blob = self.rfile.read(length)
                     meta = server.store.put(
                         name, blob, version=query.get("version"),

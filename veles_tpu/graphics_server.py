@@ -31,6 +31,8 @@ class GraphicsServer(Logger):
         else:
             self.port = self._socket.bind_to_random_port("tcp://127.0.0.1")
         self.endpoint = "tcp://127.0.0.1:%d" % self.port
+        import threading
+        self._send_lock = threading.Lock()
         self.info("graphics server on %s", self.endpoint)
 
     @staticmethod
@@ -45,19 +47,31 @@ class GraphicsServer(Logger):
     def instance():
         return _instance
 
-    def enqueue(self, plotter):
-        """Publish one plotter snapshot (pickled, like the reference —
-        the viewer re-runs its ``redraw()``)."""
+    def serialize(self, plotter):
+        """Pickle one plotter snapshot (caller's thread — must be the
+        scheduler thread so the capture is consistent); None on error."""
         from veles_tpu.plotting_units import Plotter
         Plotter._plot_message_mode = True
         try:
-            blob = pickle.dumps(plotter, protocol=pickle.HIGHEST_PROTOCOL)
+            return pickle.dumps(plotter, protocol=pickle.HIGHEST_PROTOCOL)
         except Exception:
             self.exception("failed to pickle %r for plotting", plotter)
-            return
+            return None
         finally:
             Plotter._plot_message_mode = False
-        self._socket.send(blob)
+
+    def send(self, blob):
+        """Publish a serialized snapshot (thread-safe: zmq sockets must
+        not be shared across threads without a guard)."""
+        with self._send_lock:
+            self._socket.send(blob)
+
+    def enqueue(self, plotter):
+        """Serialize + publish synchronously (viewer re-runs
+        ``redraw()``, like the reference)."""
+        blob = self.serialize(plotter)
+        if blob is not None:
+            self.send(blob)
 
     def shutdown(self):
         global _instance

@@ -75,6 +75,9 @@ class Loader(Unit):
             self.train_ratio = float(
                 root.common.ensemble.get("train_ratio", 1.0) or 1.0)
         self.testing = kwargs.get("testing", False)
+        #: overlap next-minibatch IO with downstream compute (needs a
+        #: subclass providing ``fill_minibatch_into``)
+        self.prefetch = kwargs.get("prefetch", False)
         self.global_offset = 0
         self.samples_served = 0
         self.epoch_number = 0
@@ -95,6 +98,8 @@ class Loader(Unit):
         super(Loader, self).init_unpickled()
         #: outstanding minibatches per consumer: {slave_id: [(off, size)]}
         self.pending_minibatches_ = collections.defaultdict(list)
+        self._prefetch_future_ = None
+        self._prefetch_def_ = None
 
     # -- configuration ------------------------------------------------------
     @property
@@ -163,6 +168,17 @@ class Loader(Unit):
     def fill_minibatch(self):
         raise NotImplementedError
 
+    #: True when the subclass provides a pure, thread-safe
+    #: ``fill_minibatch_into`` — enables :attr:`prefetch`
+    supports_prefetch = False
+
+    def fill_minibatch_into(self, indices, data_out, raw_labels_out):
+        """Pure fill: write samples for ``indices`` into the given numpy
+        buffers WITHOUT touching ``self.minibatch_*`` state.  Must be
+        safe to call from a background thread while downstream units
+        consume the previously served minibatch."""
+        raise NotImplementedError
+
     # -- lifecycle ----------------------------------------------------------
     def initialize(self, **kwargs):
         super(Loader, self).initialize(**kwargs)
@@ -195,6 +211,7 @@ class Loader(Unit):
         self.pending_minibatches_.pop(None, None)
         self.serve_next_minibatch(None)
         self._on_successful_serve()
+        self._start_prefetch()
 
     # -- serving ------------------------------------------------------------
     def shuffle(self):
@@ -243,7 +260,7 @@ class Loader(Unit):
                           minibatch_size)
         if self.is_master:
             return
-        self.fill_minibatch()
+        self._fill_current(minibatch_def)
         self.normalize_minibatch()
         self.map_minibatch_labels()
         if minibatch_size < self.max_minibatch_size:
@@ -329,6 +346,71 @@ class Loader(Unit):
             (self.minibatch_class == TEST and self.testing) or
             (self.minibatch_class == TRAIN and
              self.class_lengths[VALID] == 0))
+
+    # -- prefetch (double-buffered next-minibatch IO) -----------------------
+    def _peek_next_minibatch(self):
+        """The (offset, size) the NEXT standalone serve will pick, or
+        None when it cannot be predicted side-effect-free (retry queue
+        non-empty, epoch wrap pending — the wrap reshuffles — or
+        master/slave mode)."""
+        if (self.is_slave or self.is_master or self.failed_minibatches
+                or self.global_offset >= self.effective_total_samples):
+            return None
+        _cls, remainder = self.class_index_by_sample_index(
+            self.global_offset)
+        size = min(remainder, self.max_minibatch_size)
+        return self.global_offset + size, size
+
+    def _start_prefetch(self):
+        """Kick a background fill of the predicted next minibatch into
+        private buffers (the IO-overlap half of the reference's threaded
+        unit execution, ``veles/thread_pool.py:71``)."""
+        if not (self.prefetch and self.supports_prefetch):
+            return
+        nxt = self._peek_next_minibatch()
+        self._prefetch_def_ = nxt
+        if nxt is None:
+            return
+        offset, size = nxt
+        self.shuffled_indices.map_read()
+        indices = numpy.array(
+            self.shuffled_indices.mem[offset - size:offset])
+        data_out = numpy.zeros_like(self.minibatch_data.mem)
+        raw_labels = [None] * self.max_minibatch_size
+
+        def work():
+            self.fill_minibatch_into(indices, data_out[:size], raw_labels)
+            return data_out, raw_labels
+
+        from veles_tpu import thread_pool
+        self._prefetch_future_ = thread_pool.submit(work)
+
+    def _fill_current(self, minibatch_def):
+        """Use the prefetched buffers when they match the minibatch being
+        served; otherwise fall back to a synchronous fill."""
+        fut, self._prefetch_future_ = self._prefetch_future_, None
+        if fut is not None and self._prefetch_def_ == minibatch_def:
+            self._prefetch_def_ = None
+            try:
+                data, raw_labels = fut.result()
+            except Exception:
+                self.exception("prefetch failed — refilling synchronously")
+            else:
+                size = self.minibatch_size
+                self.minibatch_data.map_write()
+                self.minibatch_data.mem[:size] = data[:size]
+                self.raw_minibatch_labels[:] = raw_labels
+                return
+        elif fut is not None:
+            # stale prediction (retry/epoch wrap): wait it out so the
+            # synchronous fill never runs concurrently with it (shared
+            # file handles in the subclass), then discard
+            self._prefetch_def_ = None
+            try:
+                fut.result()
+            except Exception:
+                pass
+        self.fill_minibatch()
 
     def _on_successful_serve(self):
         self.samples_served += self.minibatch_size

@@ -161,7 +161,15 @@ class JobServer(Logger):
                                   "reason": "blacklisted"})
             return
         their_checksum = msg.get("checksum")
-        ours = self.workflow.checksum()
+        try:
+            ours = self.workflow.checksum()
+        except Exception as e:    # ChecksumError: fail closed, loudly
+            self._send(identity, {
+                "op": "reject",
+                "reason": "master cannot checksum its workflow: %s" % e})
+            self.error("cannot checksum own workflow — rejecting every "
+                       "slave: %s", e)
+            return
         if their_checksum != ours:
             self._send(identity, {
                 "op": "reject", "reason": "checksum mismatch"})
@@ -320,9 +328,15 @@ class JobClient(Logger):
                 pass
 
     def handshake(self):
+        try:
+            checksum = self.workflow.checksum()
+        except Exception as e:
+            raise ConnectionError(
+                "cannot checksum our workflow for the handshake (%s) — "
+                "slave workflows must be importable module code" % e) \
+                from e
         reply = self._rpc({"op": "handshake", "id": self.sid,
-                           "power": self.power,
-                           "checksum": self.workflow.checksum()})
+                           "power": self.power, "checksum": checksum})
         if reply["op"] != "welcome":
             raise ConnectionError(
                 "master rejected us: %s" % reply.get("reason"))

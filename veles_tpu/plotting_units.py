@@ -26,11 +26,19 @@ class Plotter(Unit):
         self.redraw_plot = kwargs.get("redraw_plot", True)
 
     def run(self):
+        # fill() + pickling happen ON the scheduler thread so the
+        # captured state is a consistent cut (a background fill would
+        # race the next train iteration and tear workflow snapshots);
+        # only the socket send goes to the pool.  Rendering itself
+        # already lives in the detached viewer process.
         self.fill()
         from veles_tpu.graphics_server import GraphicsServer
         server = GraphicsServer.instance()
         if server is not None:
-            server.enqueue(self)
+            blob = server.serialize(self)
+            if blob is not None:
+                from veles_tpu import thread_pool
+                thread_pool.submit(server.send, blob)
 
     def fill(self):
         """Snapshot linked values into plain attrs (so the pickle is

@@ -55,7 +55,7 @@ class SnapshotterBase(Unit):
         self.interval = kwargs.get("interval", 1)      # run()s per shot
         self.time_interval = kwargs.get("time_interval", 1.0)  # seconds
         self.suffix = None
-        self.destination = None        # last written artifact
+        self._destination = None       # last written artifact
         self.skipped = Bool(False)
         #: optional one-shot trigger Bool: cleared after each export so a
         #: level-triggered gate (e.g. Decision.improved, which stays True
@@ -82,6 +82,21 @@ class SnapshotterBase(Unit):
     def export(self):
         raise NotImplementedError
 
+    @property
+    def destination(self):
+        """Path of the last written artifact.  Reading it joins any
+        in-flight background write, so consumers always see a complete
+        file on disk."""
+        self._join_pending_write()
+        return self._destination
+
+    @destination.setter
+    def destination(self, value):
+        self._destination = value
+
+    def _join_pending_write(self):
+        pass
+
 
 class SnapshotterToFile(SnapshotterBase):
     """Pickle the owning workflow to
@@ -95,6 +110,14 @@ class SnapshotterToFile(SnapshotterBase):
         if self.compression not in CODECS:
             raise ValueError("unknown compression %r (have %s)" %
                              (self.compression, sorted(CODECS)))
+        #: compress+write on the host thread pool; the state capture
+        #: (pickle.dumps) stays synchronous at the gate point so the
+        #: snapshot is always a consistent cut of the workflow
+        self.background = kwargs.get("background", True)
+
+    def init_unpickled(self):
+        super(SnapshotterToFile, self).init_unpickled()
+        self._write_future_ = None
 
     def export(self):
         os.makedirs(self.directory, exist_ok=True)
@@ -102,16 +125,25 @@ class SnapshotterToFile(SnapshotterBase):
         ext = (".%s" % self.compression) if self.compression else ""
         name = "%s_%s.pickle%s" % (self.prefix, suffix, ext)
         path = os.path.join(self.directory, name)
+        data = pickle.dumps(self.workflow, protocol=pickle.HIGHEST_PROTOCOL)
+        self._join_pending_write()
+        self._destination = path
+        if self.background:
+            from veles_tpu import thread_pool
+            self._write_future_ = thread_pool.submit(
+                self._write, data, path, name, ext)
+        else:
+            self._write(data, path, name, ext)
+
+    def _write(self, data, path, name, ext):
         opener = CODECS[self.compression][0]
         with opener(path) as fout:
-            pickle.dump(self.workflow, fout,
-                        protocol=pickle.HIGHEST_PROTOCOL)
+            fout.write(data)
         size = os.path.getsize(path)
         if size > SIZE_WARNING_BYTES:
             self.warning("snapshot %s is %.1f MiB — consider trimming "
                          "resident datasets before snapshotting "
                          "(ref check_snapshot_size)", name, size / 2 ** 20)
-        self.destination = path
         current = os.path.join(self.directory,
                                "%s_current.pickle%s" % (self.prefix, ext))
         try:
@@ -121,6 +153,18 @@ class SnapshotterToFile(SnapshotterBase):
         except OSError:  # e.g. FS without symlinks
             pass
         self.info("snapshotted to %s (%.1f KiB)", path, size / 1024)
+
+    def _join_pending_write(self):
+        fut, self._write_future_ = self._write_future_, None
+        if fut is not None:
+            try:
+                fut.result()
+            except Exception:
+                self.exception("background snapshot write failed")
+
+    def stop(self):
+        self._join_pending_write()
+        super(SnapshotterToFile, self).stop()
 
     def get_metric_values(self):
         """Publishes the snapshot path into result files so consumers

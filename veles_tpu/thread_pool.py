@@ -1,0 +1,49 @@
+"""Host-side background executor for IO-bound units.
+
+Parity target: reference ``veles/thread_pool.py:71`` — a Twisted
+thread-pool subclass through which EVERY unit's ``run()`` was
+trampolined (``veles/units.py:496-505``), letting disk-IO loaders,
+plotters and the snapshotter overlap with device compute.
+
+TPU re-design: chains of device units fuse into jitted steps whose
+dispatch is already asynchronous, so only *host-blocking* work benefits
+from threads.  The workflow scheduler stays a deterministic FIFO queue;
+units that opt in with ``wants_thread = True`` (and loader prefetch /
+snapshotter writes) are executed on this shared
+:class:`~concurrent.futures.ThreadPoolExecutor` while the scheduler
+keeps draining units that are not control-downstream of them.
+"""
+
+import atexit
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+_lock = threading.Lock()
+_pool = None
+
+
+def get_pool():
+    """The process-wide background executor (lazily created; worker count
+    from ``root.common.engine.thread_pool_workers``, default 4)."""
+    global _pool
+    with _lock:
+        if _pool is None:
+            from veles_tpu.config import root
+            workers = root.common.engine.get("thread_pool_workers", 4)
+            _pool = ThreadPoolExecutor(
+                max_workers=int(workers) if workers else 4,
+                thread_name_prefix="veles-bg")
+            atexit.register(shutdown)
+        return _pool
+
+
+def submit(fn, *args, **kwargs):
+    return get_pool().submit(fn, *args, **kwargs)
+
+
+def shutdown(wait=True):
+    global _pool
+    with _lock:
+        pool, _pool = _pool, None
+    if pool is not None:
+        pool.shutdown(wait=wait)

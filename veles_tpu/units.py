@@ -229,8 +229,19 @@ class Unit(Distributable, metaclass=UnitRegistry):
             return
         if bool(self.gate_block):
             return
-        if not bool(self.gate_skip):
-            self.run_wrapped()
+        # Duplicate concurrent triggers are discarded, not queued —
+        # including their downstream propagation, exactly like the
+        # reference ("If previous run has not yet finished, discard
+        # notification", ``units.py:793-801``, which returns before
+        # run_dependent).  Only reachable when background (wants_thread)
+        # units fire the same unit from two threads.
+        if not self._run_lock_.acquire(blocking=False):
+            return
+        try:
+            if not bool(self.gate_skip):
+                self.run_wrapped()
+        finally:
+            self._run_lock_.release()
         self.run_dependent()
 
     def run_wrapped(self):

@@ -22,11 +22,17 @@ scheduling with TPU compute naturally.
 import collections
 import hashlib
 import inspect
+import os
 import threading
 import time
 
 from veles_tpu.plumbing import EndPoint, StartPoint
 from veles_tpu.units import Unit
+
+
+class ChecksumError(Exception):
+    """A unit's defining code cannot be content-addressed — master/slave
+    code-mismatch detection would be unsound, so checksum() fails closed."""
 
 
 class NoMoreJobs(Exception):
@@ -62,6 +68,8 @@ class Workflow(Unit):
         super(Workflow, self).init_unpickled()
         self._queue_ = collections.deque()
         self._queue_lock_ = threading.Lock()
+        self._queue_cond_ = threading.Condition(self._queue_lock_)
+        self._inflight_ = 0
         self._finished_event_ = threading.Event()
         self._job_callback_ = None
 
@@ -186,8 +194,9 @@ class Workflow(Unit):
     # -- execution ----------------------------------------------------------
     def schedule(self, unit, src):
         """Enqueue a gate check for ``unit`` triggered by ``src``."""
-        with self._queue_lock_:
+        with self._queue_cond_:
             self._queue_.append((unit, src))
+            self._queue_cond_.notify_all()
 
     def run(self):
         """Run the graph to completion (ref ``workflow.py:351-377``).
@@ -208,18 +217,55 @@ class Workflow(Unit):
         self.event("run", "end")
 
     def _drain(self):
+        """Pop-and-run until the queue is empty AND no background unit is
+        in flight.  ``wants_thread`` units execute on the shared host
+        thread pool (ref ``veles/units.py:496-505`` ran *every* unit
+        there); their downstream units are only scheduled from the
+        worker after ``run()`` completes, so control-graph ordering is
+        preserved — but units NOT downstream keep draining concurrently."""
         queue = self._queue_
+        cond = self._queue_cond_
         while True:
-            with self._queue_lock_:
-                if not queue or self.stopped:
+            with cond:
+                while not queue and self._inflight_ and not self.stopped:
+                    cond.wait(0.05)
+                if self.stopped or (not queue and not self._inflight_):
                     break
                 unit, src = queue.popleft()
-            unit._check_gate_and_run(src)
-        with self._queue_lock_:
+            if unit.wants_thread:
+                self._spawn(unit, src)
+            else:
+                unit._check_gate_and_run(src)
+        # join stragglers so run() returning means the graph is quiescent
+        with cond:
+            deadline = time.time() + 60.0
+            while self._inflight_:
+                if not cond.wait(0.5) and time.time() > deadline:
+                    self.warning("%d background unit(s) still running "
+                                 "60s after drain", self._inflight_)
+                    break
             queue.clear()
+
+    def _spawn(self, unit, src):
+        from veles_tpu import thread_pool
+        with self._queue_cond_:
+            self._inflight_ += 1
+        thread_pool.submit(self._run_background, unit, src)
+
+    def _run_background(self, unit, src):
+        try:
+            unit._check_gate_and_run(src)
+        except Exception:
+            self.exception("background unit %r failed", unit)
+        finally:
+            with self._queue_cond_:
+                self._inflight_ -= 1
+                self._queue_cond_.notify_all()
 
     def stop(self):
         self.stopped = True
+        with self._queue_cond_:
+            self._queue_cond_.notify_all()
         for unit in self._units:
             unit.stop()
 
@@ -335,15 +381,38 @@ class Workflow(Unit):
     # -- identity / export --------------------------------------------------
     def checksum(self):
         """Content-address the workflow definition so master and slave can
-        verify they run the same code (ref ``workflow.py:852-866``)."""
+        verify they run the same code (ref ``workflow.py:852-866``, which
+        hashes the workflow *file* bytes).
+
+        Hashes (a) the graph structure (class + unit names in dependency
+        order) and (b) the bytes of every module file defining a unit
+        class.  A unit whose code cannot be located (REPL/exec-defined
+        with no retrievable source) raises :class:`ChecksumError` —
+        failing closed instead of letting two different workflows
+        checksum equal."""
         sha = hashlib.sha256()
+        files = {}      # module name → file path (module name, not the
+        # path, goes into the hash: master and slave may hold the same
+        # code at different absolute install locations)
         for unit in self.units_in_dependency_order():
             sha.update(type(unit).__name__.encode())
             sha.update(unit.name.encode())
+            mod = inspect.getmodule(type(unit))
+            fname = getattr(mod, "__file__", None)
+            if fname and os.path.isfile(fname):
+                files[mod.__name__] = fname
+                continue
             try:
                 sha.update(inspect.getsource(type(unit)).encode())
             except (OSError, TypeError):
-                pass
+                raise ChecksumError(
+                    "cannot content-address %r (class %s: no module file "
+                    "and no retrievable source) — master/slave checksum "
+                    "would be unsound" % (unit, type(unit).__name__))
+        for modname in sorted(files):
+            sha.update(modname.encode())
+            with open(files[modname], "rb") as fin:
+                sha.update(fin.read())
         return sha.hexdigest()
 
     def package_export(self, path, precision=32, with_stablehlo=True):

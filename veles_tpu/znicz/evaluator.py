@@ -112,7 +112,10 @@ class EvaluatorMSE(EvaluatorBase):
         full = numpy.zeros(self.err_output.shape, dtype=numpy.float32)
         full[:batch] = err.reshape((batch,) + self.err_output.shape[1:])
         self.err_output.mem = full
-        per_sample = numpy.sqrt((err ** 2).mean(axis=1)) if self.root \
-            else (err ** 2).mean(axis=1)
+        # metric in float64: unnormalized activations overflow float32
+        # squares long before the gradient itself is invalid
+        err64 = err.astype(numpy.float64)
+        per_sample = numpy.sqrt((err64 ** 2).mean(axis=1)) if self.root \
+            else (err64 ** 2).mean(axis=1)
         self.mse = float(per_sample.mean())
         self.n_err = self.mse
