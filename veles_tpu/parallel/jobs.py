@@ -22,6 +22,7 @@ Wire protocol (pickled dicts):
   master → slave: {op: welcome|reject|job|update_ack|no_more_jobs|pong}
 """
 
+import collections
 import pickle
 import threading
 import time
@@ -43,10 +44,15 @@ class SlaveDescription(object):
         self.state = "INIT"
         self.last_seen = time.time()
         self.jobs_done = 0
+        #: jobs handed out but not yet updated — with prefetching slaves
+        #: two can be in flight; `finished` and drop-requeue key off this
+        #: count, not the single state field (ADVICE r1)
+        self.in_flight = 0
 
     def __repr__(self):
-        return "<Slave %s %s power=%.1f jobs=%d>" % (
-            self.id, self.state, self.power, self.jobs_done)
+        return "<Slave %s %s power=%.1f jobs=%d inflight=%d>" % (
+            self.id, self.state, self.power, self.jobs_done,
+            self.in_flight)
 
 
 class JobServer(Logger):
@@ -77,6 +83,9 @@ class JobServer(Logger):
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
+        #: outbound messages produced by worker threads; only the loop
+        #: thread touches the (thread-unsafe) ROUTER socket
+        self._outbox = collections.deque()
         self.info("job server on %s", self.endpoint)
 
     # -- lifecycle ----------------------------------------------------------
@@ -95,7 +104,7 @@ class JobServer(Logger):
     @property
     def finished(self):
         return self._no_more_jobs and not any(
-            s.state == "WORKING" for s in self.slaves.values())
+            s.in_flight for s in self.slaves.values())
 
     # -- main loop ----------------------------------------------------------
     def _loop(self):
@@ -105,7 +114,8 @@ class JobServer(Logger):
         last_reap = time.time()
         import zmq as _zmq
         while not self._stop.is_set():
-            if poller.poll(200):
+            self._drain_outbox()
+            if poller.poll(50 if self._outbox else 200):
                 # drain EVERYTHING queued before reaping: a slow
                 # generate_data_for_slave stalls this loop, and pings
                 # that piled up meanwhile must refresh last_seen before
@@ -126,13 +136,27 @@ class JobServer(Logger):
                     except Exception:
                         self.exception("failed handling %r",
                                        msg.get("op"))
+            self._drain_outbox()
             if time.time() - last_reap >= self.heartbeat_interval:
                 last_reap = time.time()
                 self._reap_dead_slaves()
 
+    def _drain_outbox(self):
+        while self._outbox:
+            identity, blob = self._outbox.popleft()
+            try:
+                self._socket.send_multipart([identity, blob])
+            except Exception:
+                self.exception("failed sending queued reply")
+
     def _send(self, identity, msg):
-        self._socket.send_multipart(
-            [identity, pickle.dumps(msg, pickle.HIGHEST_PROTOCOL)])
+        """Replies from the loop thread go straight out; worker threads
+        (job generation) enqueue — zmq sockets are not thread-safe."""
+        blob = pickle.dumps(msg, pickle.HIGHEST_PROTOCOL)
+        if threading.current_thread() is self._thread:
+            self._socket.send_multipart([identity, blob])
+        else:
+            self._outbox.append((identity, blob))
 
     def _dispatch(self, identity, msg):
         op = msg.get("op")
@@ -185,27 +209,50 @@ class JobServer(Logger):
         self.info("slave %s joined (power %.1f)", sid, slave.power)
 
     def _on_job_request(self, identity, slave):
+        """Job generation is offloaded to the host thread pool (ref
+        ``server.py:404-407`` deferToThreadPool): a slow
+        generate_data_for_slave (GA child evaluation, big index
+        partitions) must not stall heartbeat processing and job service
+        for every other slave on the ROUTER thread."""
         if self._no_more_jobs:
             self._send(identity, {"op": "no_more_jobs"})
             return
+        from veles_tpu import thread_pool
+        thread_pool.submit(self._generate_and_send, identity, slave)
+
+    def _generate_and_send(self, identity, slave):
         from veles_tpu.workflow import NoJobYet, NoMoreJobs
-        with self._lock:
-            try:
-                data = self.workflow.generate_data_for_slave(slave)
-            except NoJobYet:
-                # more jobs will appear (e.g. GA generation boundary):
-                # tell the slave to retry instead of quitting
-                self._send(identity, {"op": "wait"})
+        try:
+            with self._lock:
+                if self.slaves.get(slave.id) is not slave:
+                    # reaped while this request waited for a worker; a
+                    # job generated now would never be requeued on drop
+                    self._send(identity,
+                               {"op": "reject", "reason": "dropped"})
+                    return
+                if self._no_more_jobs:
+                    self._send(identity, {"op": "no_more_jobs"})
+                    return
+                try:
+                    data = self.workflow.generate_data_for_slave(slave)
+                except NoJobYet:
+                    # more jobs will appear (e.g. GA generation
+                    # boundary): the slave should retry, not quit
+                    self._send(identity, {"op": "wait"})
+                    return
+                except (StopIteration, NoMoreJobs):
+                    data = None
+                if data is not None:
+                    slave.in_flight += 1
+                    slave.state = "WORKING"
+            if data is None:
+                self._no_more_jobs = True
+                self._send(identity, {"op": "no_more_jobs"})
+                self._maybe_finish()
                 return
-            except (StopIteration, NoMoreJobs):
-                data = None
-        if data is None:
-            self._no_more_jobs = True
-            self._send(identity, {"op": "no_more_jobs"})
-            self._maybe_finish()
-            return
-        slave.state = "WORKING"
-        self._send(identity, {"op": "job", "data": data})
+            self._send(identity, {"op": "job", "data": data})
+        except Exception:
+            self.exception("job generation for %s failed", slave.id)
 
     def _on_update(self, identity, slave, msg):
         with self._lock:
@@ -215,7 +262,8 @@ class JobServer(Logger):
             except Exception:
                 self.exception("bad update from %s", slave.id)
                 ok = 0
-        slave.state = "WAIT"
+            slave.in_flight = max(0, slave.in_flight - 1)
+            slave.state = "WORKING" if slave.in_flight else "WAIT"
         slave.jobs_done += 1
         self._send(identity, {"op": "update_ack", "ok": ok})
         self._maybe_finish()
