@@ -60,38 +60,15 @@ def _peak_flops(device_kind):
     return None
 
 
-def _aot_compile(step_fn, *args):
-    """AOT-compile the train step ONCE (donated params) and return
-    (compiled_callable, flops_per_step|None) — the same executable serves
-    cost analysis and the timed loop, so each stage pays one compile."""
-    import jax
-    compiled = jax.jit(step_fn, donate_argnums=(0,)).lower(*args).compile()
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", 0.0)) or None
-    except Exception:
-        flops = None
-    return compiled, flops
-
-
-def _timed_loop(step, params, x, labels, steps, min_seconds=2.0):
-    """Run batches of `steps` iterations until `min_seconds` of measured
-    work; return seconds per step."""
-    import jax
-    params, _ = step(params, x, labels)   # compile + warm
-    jax.block_until_ready(params)
-    total_steps = 0
-    tic = time.perf_counter()
-    while True:
-        for _ in range(steps):
-            params, _m = step(params, x, labels)
-        jax.block_until_ready(params)
-        total_steps += steps
-        elapsed = time.perf_counter() - tic
-        if elapsed >= min_seconds or total_steps >= 20 * steps:
-            return elapsed / total_steps
+def _measure(step_fn, params, x, labels, steps, min_seconds=2.0):
+    """Honest (sec_per_step, flops_per_step): K steps looped INSIDE one
+    jitted program, synced by a host fetch of a result-derived probe,
+    fixed overhead cancelled by marginal timing.  block_until_ready is
+    never trusted (round-2 post-mortem: through the tunneled PJRT
+    transport it acks dispatch, not completion — see ops/timing.py)."""
+    from veles_tpu.ops.timing import measure_fused_step
+    return measure_fused_step(step_fn, params, x, labels, k=steps,
+                              min_seconds=min_seconds)
 
 
 # --------------------------------------------------------------------------
@@ -103,7 +80,8 @@ def stage_probe():
     dev = jax.devices()[0]
     import jax.numpy as jnp
     x = jnp.ones((256, 256), jnp.bfloat16)
-    jax.block_until_ready(jax.jit(lambda a: a @ a)(x))
+    y = jax.jit(lambda a: a @ a)(x)
+    assert float(jax.device_get(y[0, 0])) == 256.0  # real bytes, real sync
     print(json.dumps({"platform": dev.platform,
                       "device_kind": dev.device_kind,
                       "n_devices": jax.device_count()}))
@@ -114,16 +92,52 @@ def _device_kind():
     return jax.devices()[0].device_kind
 
 
+#: hard physics gates — a measurement outside these is a broken
+#: stopwatch, not a fast chip, and must NOT be published (round-2
+#: post-mortem: MFU 54.58 and vs_baseline 1177 went out unchecked)
+MAX_MFU = 1.0
+MAX_VS_BASELINE = 200.0
+
+
 def _emit(metric, sec_per_step, batch, flops, vs=None):
-    ips = batch / sec_per_step
     kind = _device_kind()
+    # no train step on any hardware completes in under a microsecond —
+    # catches broken stopwatches even where no peak-FLOPs entry exists
+    if sec_per_step <= 1e-6:
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": "images/sec",
+            "vs_baseline": None,
+            "error": "timing failed physics check: sec_per_step "
+                     "%.3e below plausibility floor" % sec_per_step,
+            "raw_sec_per_step": sec_per_step,
+            "device_kind": kind,
+        }))
+        return
+    ips = batch / sec_per_step
     peak = _peak_flops(kind)
     mfu = (flops / sec_per_step / peak) if (flops and peak) else None
+    vs_baseline = (ips / vs) if vs else None
+    problems = []
+    if mfu is not None and not (0.0 < mfu <= MAX_MFU):
+        problems.append("MFU %.4f outside (0, %.1f]" % (mfu, MAX_MFU))
+    if vs_baseline is not None and not (
+            0.0 < vs_baseline <= MAX_VS_BASELINE):
+        problems.append("vs_baseline %.1f outside (0, %.0f]"
+                        % (vs_baseline, MAX_VS_BASELINE))
+    if problems:
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": "images/sec",
+            "vs_baseline": None,
+            "error": "timing failed physics check: " + "; ".join(problems),
+            "raw_sec_per_step": sec_per_step, "raw_mfu": mfu,
+            "device_kind": kind,
+        }))
+        return
     print(json.dumps({
         "metric": metric,
         "value": round(ips, 1),
         "unit": "images/sec",
-        "vs_baseline": (round(ips / vs, 3) if vs else None),
+        "vs_baseline": (round(vs_baseline, 3) if vs_baseline else None),
         "mfu": (round(mfu, 4) if mfu is not None else None),
         "sec_per_step": round(sec_per_step, 6),
         "batch": batch,
@@ -147,9 +161,8 @@ def stage_mnist():
         rng.standard_normal((batch, 784)).astype(numpy.float32))
     labels = jax.device_put(
         rng.integers(0, 10, batch).astype(numpy.int32))
-    step, flops = _aot_compile(make_train_step(MNIST_LAYERS),
-                               params, x, labels)
-    sec = _timed_loop(step, params, x, labels, steps=50)
+    sec, flops = _measure(make_train_step(MNIST_LAYERS),
+                          params, x, labels, steps=100)
     _emit("MNIST784 MLP fused train throughput", sec, batch, flops)
 
 
@@ -170,8 +183,7 @@ def _conv_stage(metric, layers, input_shape, n_classes, batch, steps,
         (batch,) + tuple(input_shape)).astype(numpy.float32))
     labels = jax.device_put(
         rng.integers(0, n_classes, batch).astype(numpy.int32))
-    step, flops = _aot_compile(step_fn, params, x, labels)
-    sec = _timed_loop(step, params, x, labels, steps=steps)
+    sec, flops = _measure(step_fn, params, x, labels, steps=steps)
     _emit(metric, sec, batch, flops, vs=vs)
 
 

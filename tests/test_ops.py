@@ -252,3 +252,43 @@ class TestHog:
         flat = jnp.zeros((16, 16), jnp.float32).at[4:8, 4:8].set(1.0)
         g2 = jax.grad(lambda im: hog(im).sum())(flat)
         assert numpy.isfinite(numpy.asarray(g2)).all()
+
+
+def test_timing_multi_step_and_marginal():
+    """ops.timing: K-step in-program loop matches K sequential steps,
+    probe depends on params+metric, marginal timing returns sane
+    positive values (the round-2 stopwatch bug class)."""
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.ops.timing import (
+        host_fetch, make_multi_step, marginal_time, measure_fused_step)
+
+    def step(params, x, labels):
+        p = params["w"]
+        p = p + 0.25 * jnp.mean(x) + 0.001 * labels.sum()
+        return {"w": p}, {"loss": jnp.sum(p)}
+
+    params = {"w": jnp.zeros((4,), jnp.float32)}
+    x = jnp.ones((2, 4), jnp.float32)
+    labels = jnp.zeros((2,), jnp.int32)
+    multi = make_multi_step(step, 5)
+    out_params, probe = jax.jit(multi)(params, x, labels)
+    # 5 steps of +0.25 each
+    numpy.testing.assert_allclose(
+        host_fetch(out_params["w"]), numpy.full((4,), 1.25), rtol=1e-6)
+    vals = host_fetch(probe)
+    assert vals.shape == (2,)
+    assert numpy.isfinite(vals).all()
+
+    sec_per_step, flops = measure_fused_step(
+        step, params, x, labels, k=5, min_seconds=0.05, donate=False)
+    assert sec_per_step > 0
+
+    calls = []
+
+    def call(sync=False):
+        calls.append(sync)
+
+    per = marginal_time(call, min_seconds=0.01)
+    assert per > 0

@@ -223,3 +223,49 @@ def test_loader_prefetch_epoch_wrap_correctness():
     assert len(seen_on) == len(seen_off)
     for a, b in zip(seen_on, seen_off):
         numpy.testing.assert_array_equal(a, b)
+
+
+def test_drain_waits_for_background_not_gating_end_point():
+    """run() returning means quiescent: an in-flight background unit
+    that the end_point does NOT wait on is still joined before run()
+    returns (a unit not yet started when the workflow stops may
+    legitimately skip — the contract covers *running* units)."""
+    wf = DummyWorkflow()
+    bg = SleepUnit(wf, sleep=0.5, name="bg")
+    bg.wants_thread = True
+    # fg sleeps long enough that bg is definitely mid-run when the end
+    # point fires and sets stopped
+    fg = SleepUnit(wf, sleep=0.15, name="fg")
+    bg.link_from(wf.start_point)
+    fg.link_from(wf.start_point)
+    wf.end_point.link_from(fg)          # end point ignores bg entirely
+    wf.initialize()
+    tic = time.monotonic()
+    wf.run()
+    assert len(bg.run_times) == 1, "bg never started; race in test"
+    assert time.monotonic() - tic >= 0.45, \
+        "run() returned before the in-flight background unit finished"
+
+
+def test_drain_raises_on_wedged_background_unit():
+    """A running background unit outliving QUIESCENCE_TIMEOUT fails
+    run() loudly instead of silently violating the quiescence
+    contract."""
+    import pytest
+
+    wf = DummyWorkflow()
+    bg = SleepUnit(wf, sleep=1.2, name="bg")
+    bg.wants_thread = True
+    fg = SleepUnit(wf, sleep=0.1, name="fg")
+    bg.link_from(wf.start_point)
+    fg.link_from(wf.start_point)
+    wf.end_point.link_from(fg)
+    wf.initialize()
+    wf.QUIESCENCE_TIMEOUT = 0.2        # instance override for the test
+    try:
+        with pytest.raises(RuntimeError, match="not quiescent"):
+            wf.run()
+        assert len(bg.run_times) == 1
+    finally:
+        time.sleep(1.3)                # let the straggler drain out of
+        # the shared pool before other tests run

@@ -202,3 +202,35 @@ def test_conv_gd_unit_updates_weights_and_reduces_loss():
         err_vec.mem[...] = 2 * err / err.size * err.shape[0]
         gdc.run()
     assert losses[-1] < losses[0] * 0.9
+
+
+def test_fused_eval_skips_only_skip_at_eval_units():
+    """Fused eval drops layers via the explicit SKIP_AT_EVAL attribute
+    (dropout), NOT by introspecting config keys; stochastic pooling
+    (also seeded, no SKIP_AT_EVAL) must still run at eval."""
+    from veles_tpu import prng
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    assert DropoutForward.SKIP_AT_EVAL is True
+    assert not getattr(StochasticPooling, "SKIP_AT_EVAL", False)
+
+    prng.seed_all(7)
+    layers = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 8}},
+        {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+        {"type": "softmax", "->": {"output_sample_shape": 4}},
+    ]
+    params, _step, _eval, apply_fn = lower_specs(layers, (6,))
+    prng.seed_all(7)
+    params_nodrop, _s, _e, apply_nodrop = lower_specs(
+        [layers[0], layers[2]], (6,))
+    x = numpy.random.default_rng(0).standard_normal(
+        (3, 6)).astype(numpy.float32)
+    out = numpy.asarray(apply_fn(params, x, train=False))
+    # same weights (same seed + same init order for the two dense
+    # layers), dropout skipped → identical eval output
+    ref = numpy.asarray(apply_nodrop(params_nodrop, x, train=False))
+    numpy.testing.assert_allclose(out, ref, rtol=1e-6)
+    # train=True applies the mask → differs from eval
+    out_train = numpy.asarray(apply_fn(params, x, train=True))
+    assert not numpy.allclose(out, out_train)

@@ -12,14 +12,13 @@ across the fleet for job balancing) plus a tile search over the Pallas
 GEMM, persisted in the same DB schema.
 """
 
-import time
-
 import jax
 import jax.numpy as jnp
 import numpy
 
 from veles_tpu.backends import DEVICE_INFOS_JSON, DeviceInfo
 from veles_tpu.ops.gemm import matmul
+from veles_tpu.ops.timing import host_fetch, marginal_time
 
 BENCH_SIZE = 4096
 BENCH_CHAIN = 13
@@ -37,10 +36,16 @@ TILE_CANDIDATES = (
 
 
 def estimate_device_power(device=None, size=BENCH_SIZE, chain=BENCH_CHAIN,
-                          runs=3, dtype=jnp.bfloat16, use_pallas=None):
-    """min-of-``runs`` wall time of ``chain`` chained size² matmuls →
-    (seconds, gflops) — the "computing power" number
-    (ref ``workflow.py:618-624``)."""
+                          runs=3, dtype=jnp.bfloat16, use_pallas=None,
+                          min_seconds=0.5):
+    """Marginal wall time of ``chain`` chained size² matmuls (min of
+    ``runs`` measurements) → (seconds, gflops) — the "computing power"
+    number (ref ``workflow.py:618-624``).
+
+    Timing honesty (round-2 post-mortem, see ``ops/timing.py``): the
+    chain returns a scalar probe, sync is a host fetch of its bytes, and
+    the reported time is the *marginal* cost per chain call so dispatch
+    and fetch overhead cancel instead of dominating."""
     key = jax.random.key(0)
     a = jax.random.normal(key, (size, size), jnp.float32).astype(dtype)
     b = jnp.eye(size, dtype=dtype) * 1.0001
@@ -48,15 +53,21 @@ def estimate_device_power(device=None, size=BENCH_SIZE, chain=BENCH_CHAIN,
     def chained(x, w):
         for _ in range(chain):
             x = matmul(x, w, use_pallas=use_pallas)
-        return x
+        # full matrix stays a program output so XLA cannot sink a
+        # scalar slice through the dot chain and elide the work being
+        # timed; only the probe's bytes cross to the host
+        return x, x[0, 0].astype(jnp.float32)
 
     fn = jax.jit(chained)
-    fn(a, b).block_until_ready()        # compile
-    best = float("inf")
-    for _ in range(runs):
-        tic = time.perf_counter()
-        fn(a, b).block_until_ready()
-        best = min(best, time.perf_counter() - tic)
+    host_fetch(fn(a, b)[1])              # compile + warm
+
+    def call(sync=False):
+        _out, probe = fn(a, b)
+        if sync:
+            host_fetch(probe)
+
+    best = min(marginal_time(call, min_seconds=min_seconds)
+               for _ in range(max(runs, 1)))
     gflops = 2.0 * chain * size ** 3 / best / 1e9
     return best, gflops
 
@@ -82,13 +93,22 @@ def autotune_gemm(shapes=((4096, 4096, 4096),), dtypes=("bfloat16",
             flops = 2.0 * m * k * n
             for tiles in candidates:
                 try:
+                    # probe scalar + marginal timing: honest sync
+                    # through transports where block_until_ready lies
+                    # (see ops/timing.py)
                     fn = jax.jit(lambda x, y, t=tiles: matmul(
-                        x, y, tiles=t, use_pallas=True))
-                    fn(a, b).block_until_ready()
-                    tic = time.perf_counter()
-                    for _ in range(runs):
-                        fn(a, b).block_until_ready()
-                    elapsed = (time.perf_counter() - tic) / runs
+                        x, y, tiles=t, use_pallas=True)[0, 0]
+                        .astype(jnp.float32))
+                    host_fetch(fn(a, b))    # compile + warm
+
+                    def call(sync=False, _fn=fn):
+                        r = _fn(a, b)
+                        if sync:
+                            host_fetch(r)
+
+                    elapsed = min(
+                        marginal_time(call, min_seconds=0.25)
+                        for _ in range(max(runs, 1)))
                 except Exception:
                     totals.pop(tiles, None)
                     continue

@@ -1,0 +1,153 @@
+"""Trustworthy on-device timing.
+
+Round-2 post-mortem: through some PJRT transports (e.g. a tunneled
+remote-TPU plugin) ``jax.block_until_ready`` returns as soon as the
+*dispatch* is acknowledged, not when execution finishes — timing with it
+measures dispatch latency and produced physically impossible MFU > 1
+numbers.  Rules enforced here:
+
+1. **Synchronize by fetching real bytes.**  ``host_fetch`` does a
+   ``jax.device_get`` of a small array *derived from the result* — the
+   D2H copy cannot complete before the producing program does, whatever
+   the transport claims about readiness.
+2. **Amortize the round trip inside the program.**  ``make_multi_step``
+   loops K train steps inside ONE jitted program via ``lax.fori_loop``,
+   threading the params carry, and returns a probe vector that depends
+   on both the final metric and the final params — so the fetched bytes
+   prove the whole chain executed.
+3. **Cancel fixed overhead exactly.**  ``marginal_time`` times the work
+   at two different call counts and reports the *marginal* seconds per
+   call; the constant dispatch+fetch overhead (~tens of ms over a
+   tunnel) subtracts out instead of inflating short measurements.
+
+Reference discipline: the in-situ device benchmark
+``/root/reference/veles/accelerated_units.py:706-825`` (min-of-N timed
+kernel chain) and the ``--sync-run`` timing-accuracy note
+(``accelerated_units.py:294-297``).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+
+def host_fetch(x):
+    """Force true device synchronization by copying ``x``'s bytes to the
+    host.  Unlike ``block_until_ready`` this cannot be acked early: the
+    returned numpy values physically cannot exist before the program
+    that produces them has run."""
+    return numpy.asarray(jax.device_get(x))
+
+
+def _first_scalar(tree):
+    """A float32 scalar depending on the first array leaf of ``tree``."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = jnp.asarray(leaf)
+        return arr.astype(jnp.float32).ravel()[0]
+    return jnp.float32(0.0)
+
+
+def probe_of(params, metric):
+    """A small vector whose bytes depend on the final params AND the
+    final metric — stacked (not summed-with-*0, which an optimizer could
+    fold away) so neither dependency can be eliminated."""
+    return jnp.stack([_first_scalar(metric), _first_scalar(params)])
+
+
+def make_multi_step(step_fn, k):
+    """Wrap ``step_fn(params, x, labels) -> (params, metric)`` into a
+    function running ``k`` steps inside one XLA program.
+
+    The first step runs inline (establishing the carry structure, since
+    the metric pytree's shapes/dtypes are only known by tracing one
+    step); the remaining ``k-1`` run under ``lax.fori_loop``.  Returns
+    ``(params, probe)`` with ``probe`` from :func:`probe_of`.
+    """
+    if k < 1:
+        raise ValueError("k must be >= 1, got %d" % k)
+
+    def multi(params, x, labels):
+        carry = step_fn(params, x, labels)
+
+        def body(_i, carry):
+            p, _m = carry
+            return step_fn(p, x, labels)
+
+        params, metric = jax.lax.fori_loop(0, k - 1, body, carry)
+        return params, probe_of(params, metric)
+
+    return multi
+
+
+def marginal_time(call, min_seconds=2.0, max_calls=10000):
+    """Marginal seconds per ``call()``.
+
+    ``call`` must dispatch the work asynchronously; ``call(sync=True)``
+    must additionally block until everything dispatched so far has
+    truly finished (host fetch).  Times ``n1`` calls and ``n2 > n1``
+    calls (scaled so the long run spans ``min_seconds``) and returns
+    ``(t2 - t1) / (n2 - n1)`` — the fixed per-measurement overhead
+    cancels.
+    """
+    call(sync=True)                      # warm (compile paths already hot)
+
+    def run(n):
+        tic = time.perf_counter()
+        for _ in range(n - 1):
+            call()
+        call(sync=True)
+        return time.perf_counter() - tic
+
+    n1 = 1
+    for attempt in range(3):
+        t1 = run(n1)
+        per = max(t1 / n1, 1e-9)
+        n2 = int(min(max(n1 * 2, min_seconds / per), max_calls))
+        t2 = run(n2)
+        marginal = (t2 - t1) / (n2 - n1)
+        if marginal > 0:
+            return marginal
+        # t1 noise exceeded t2 — a failed measurement, never a result
+        # (clamping here once published 1.8e21 GFLOPs downstream);
+        # lengthen the long run and retry
+        min_seconds *= 2.0
+    raise RuntimeError(
+        "marginal_time: non-positive marginal (%.6fs over %d calls) "
+        "after 3 attempts — timing environment too noisy" % (
+            marginal, n2 - n1))
+
+
+def measure_fused_step(step_fn, params, x, labels, k=20, min_seconds=2.0,
+                       donate=True):
+    """Compile a K-step loop of ``step_fn`` once and measure honest
+    seconds per single step.
+
+    Returns ``(sec_per_step, flops_per_step)``; ``flops_per_step`` is
+    XLA's own cost analysis of the K-step program divided by K (None if
+    unavailable).
+    """
+    multi = make_multi_step(step_fn, k)
+    jitted = jax.jit(multi, donate_argnums=(0,) if donate else ())
+    compiled = jitted.lower(params, x, labels).compile()
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = (float(ca.get("flops", 0.0)) / k) or None
+    except Exception:
+        flops = None
+
+    state = {"params": params}
+
+    def call(sync=False):
+        state["params"], probe = compiled(state["params"], x, labels)
+        if sync:
+            vals = host_fetch(probe)
+            if not numpy.all(numpy.isfinite(vals)):
+                raise FloatingPointError(
+                    "non-finite probe during timing: %r" % (vals,))
+
+    sec_per_call = marginal_time(call, min_seconds=min_seconds)
+    return sec_per_call / k, flops

@@ -50,6 +50,11 @@ class Workflow(Unit):
 
     hide_from_registry = True
 
+    #: seconds _drain waits for in-flight background units before
+    #: raising — run() returning means the graph IS quiescent, never a
+    #: silent shrug (warnings escalate every 60 s until then)
+    QUIESCENCE_TIMEOUT = 600.0
+
     def __init__(self, workflow=None, **kwargs):
         self._units = []
         self._sync_ = None
@@ -236,14 +241,29 @@ class Workflow(Unit):
                 self._spawn(unit, src)
             else:
                 unit._check_gate_and_run(src)
-        # join stragglers so run() returning means the graph is quiescent
+        # join stragglers: run() returning MUST mean the graph is
+        # quiescent — a wedged background unit would otherwise race
+        # snapshot/teardown.  Escalate with warnings, then fail loudly
+        # instead of silently violating the contract.
         with cond:
-            deadline = time.time() + 60.0
+            start = time.time()
+            next_warn = 60.0
             while self._inflight_:
-                if not cond.wait(0.5) and time.time() > deadline:
-                    self.warning("%d background unit(s) still running "
-                                 "60s after drain", self._inflight_)
+                cond.wait(0.5)
+                if not self._inflight_:   # finished at the boundary
                     break
+                elapsed = time.time() - start
+                if elapsed >= self.QUIESCENCE_TIMEOUT:
+                    raise RuntimeError(
+                        "workflow not quiescent: %d background unit(s) "
+                        "still running %.0fs after drain" % (
+                            self._inflight_, elapsed))
+                if elapsed >= next_warn:
+                    self.warning(
+                        "%d background unit(s) still running %.0fs "
+                        "after drain; waiting (timeout %.0fs)",
+                        self._inflight_, elapsed, self.QUIESCENCE_TIMEOUT)
+                    next_warn += 60.0
             queue.clear()
 
     def _spawn(self, unit, src):

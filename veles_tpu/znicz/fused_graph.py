@@ -35,7 +35,7 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
     wf = DummyWorkflow()
     probe = Vector(numpy.zeros((2,) + tuple(sample_shape),
                                numpy.float32))
-    stages = []      # (pure_fn, config_dict, hyper_dict, has_params)
+    stages = []      # (pure_fn, config_dict, hyper_dict, skip_at_eval)
     params = []
     for spec in layer_specs:
         klass = UnitRegistry.mapped[spec["type"]]
@@ -60,7 +60,8 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
             "moment_b": float(bw.get("gradient_moment_bias",
                                      bw.get("gradient_moment", 0.0))),
         }
-        stages.append((type(unit).pure, unit.pure_config(), hyper))
+        stages.append((type(unit).pure, unit.pure_config(), hyper,
+                       bool(getattr(type(unit), "SKIP_AT_EVAL", False))))
         state = {k: v for k, v in layer_params.items()}
         state["vw"] = numpy.zeros_like(state["w"]) \
             if "w" in state else None
@@ -79,17 +80,15 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
 
     def apply_fn(params_list, x, train=False):
         h = x
-        for (pure, config, _hyper), state in zip(stages, params_list):
+        for (pure, config, _hyper, skip_at_eval), state in zip(
+                stages, params_list):
+            if skip_at_eval and not train:
+                # the unit declares itself identity at inference
+                # (e.g. inverted dropout) via SKIP_AT_EVAL — an explicit
+                # class attribute, not introspection of config keys
+                continue
             p = {k: v for k, v in state.items()
                  if k in ("w", "b", "seed")}
-            if "seed" in state and not train:
-                # dropout & friends: identity at eval handled by the
-                # unit; in fused form we emulate via keep=1 — simplest:
-                # skip the layer's randomness by seed=0 & rescale is NOT
-                # equivalent, so fused eval drops dropout layers
-                # entirely (standard inference-time behavior)
-                if pure.__name__ == "pure" and "keep" in config:
-                    continue
             h = pure(p, h, **config)
         return h
 
@@ -98,8 +97,8 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
             h = jnp.asarray(x, compute_dtype)
         else:
             h = x
-        for (pure, config, _hyper), wb, aux in zip(stages, wb_list,
-                                                   aux_list):
+        for (pure, config, _hyper, _skip), wb, aux in zip(stages, wb_list,
+                                                          aux_list):
             if compute_dtype is not None:
                 p = {k: jnp.asarray(v, compute_dtype)
                      for k, v in wb.items()}
@@ -133,8 +132,8 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
         (_v, (n_err, report)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(wb_list, aux_list, x, labels)
         new_list = []
-        for state, gwb, (_pure, _config, hyper) in zip(params_list,
-                                                       grads, stages):
+        for state, gwb, (_pure, _config, hyper, _skip) in zip(
+                params_list, grads, stages):
             new_state = dict(state)
             if "w" in gwb and state.get("w") is not None:
                 v = hyper["moment"] * state["vw"] - hyper["lr"] * (

@@ -82,6 +82,10 @@ class DropoutForward(ForwardBase):
     batches — StandardWorkflow gates this via the loader class)."""
 
     MAPPING = "dropout"
+    #: fused eval drops this layer entirely (inverted dropout ==
+    #: identity at inference); explicit attribute consumed by
+    #: fused_graph.apply_fn — NOT inferred from config keys
+    SKIP_AT_EVAL = True
 
     def __init__(self, workflow, **kwargs):
         super(DropoutForward, self).__init__(workflow, **kwargs)
