@@ -222,3 +222,87 @@ class TestRestful:
         wf.decision.max_epochs = 3
         wf.run()
         api.stop()
+
+
+class TestPlotterVariants:
+    """MultiHistogram / MaxMinPlotter / SlaveStats
+    (ref ``plotting_units.py:681,769,822``)."""
+
+    def _axes(self):
+        import matplotlib
+        matplotlib.use("Agg", force=True)
+        from matplotlib.figure import Figure
+        return Figure().add_subplot(1, 1, 1)
+
+    def test_multi_histogram(self):
+        from veles_tpu.plotting_units import MultiHistogram
+        wf = DummyWorkflow()
+        p = MultiHistogram(wf, hist_number=4, n_bars=10)
+        p.input = numpy.random.default_rng(0).standard_normal((6, 20))
+        p.fill()
+        assert p.counts.shape == (4, 10)
+        assert (p.counts.sum(axis=1) == 20).all()
+        p.redraw(self._axes())
+
+    def test_maxmin_plotter(self):
+        from veles_tpu.plotting_units import MaxMinPlotter
+        wf = DummyWorkflow()
+        p = MaxMinPlotter(wf)
+        p.input = numpy.array([1.0, -3.0, 2.0])
+        p.fill()
+        p.input = numpy.array([5.0, 0.0])
+        p.fill()
+        assert p.maxes == [2.0, 5.0]
+        assert p.mins == [-3.0, 0.0]
+        p.redraw(self._axes())
+
+    def test_slave_stats_rates(self):
+        import time as _time
+        from veles_tpu.plotting_units import SlaveStats
+
+        class FakeSlave(object):
+            def __init__(self, done):
+                self.state = "WORKING"
+                self.power = 100.0
+                self.jobs_done = done
+                self.in_flight = 1
+
+        class FakeServer(object):
+            slaves = {"s1": FakeSlave(0), "s2": FakeSlave(5)}
+
+        wf = DummyWorkflow()
+        p = SlaveStats(wf, server=FakeServer())
+        p.fill()                       # first fill: rate 0 (no history)
+        assert [r[5] for r in p.rows] == [0.0, 0.0]
+        FakeServer.slaves["s1"].jobs_done = 10
+        _time.sleep(0.05)
+        p.fill()
+        rates = {r[0]: r[5] for r in p.rows}
+        assert rates["s1"] > 0
+        assert rates["s2"] == 0.0
+        assert {r[0] for r in p.rows} == {"s1", "s2"}
+        p.redraw(self._axes())
+
+
+def test_load_snapshot_from_url(tmp_path):
+    """-w/--snapshot accepts an http URL (ref ``__main__.py:539-590``):
+    the snapshot is fetched and resumed exactly like a local file."""
+    import functools
+    import http.server
+    import threading
+
+    wf = make_wf(tmp_path, max_epochs=1)
+    wf.run()
+    from veles_tpu.snapshotter import save_snapshot
+    path = save_snapshot(wf, str(tmp_path / "wf_url.pickle"))
+    handler = functools.partial(
+        http.server.SimpleHTTPRequestHandler, directory=str(tmp_path))
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = "http://127.0.0.1:%d/wf_url.pickle" % httpd.server_port
+        restored = load_snapshot(url)
+        assert restored.checksum() == wf.checksum()
+    finally:
+        httpd.shutdown()

@@ -234,3 +234,103 @@ def test_fused_eval_skips_only_skip_at_eval_units():
     # train=True applies the mask → differs from eval
     out_train = numpy.asarray(apply_fn(params, x, train=True))
     assert not numpy.allclose(out, out_train)
+
+
+def test_depooling_round_trip_max():
+    """Depooling scatters each pooled value back to the exact argmax
+    position recorded by the paired pooling unit."""
+    from veles_tpu.znicz.pooling import Depooling
+
+    rng = numpy.random.default_rng(3)
+    x = rng.standard_normal((2, 4, 4, 3)).astype(numpy.float32)
+    wf = DummyWorkflow()
+    from veles_tpu.memory import Vector
+    pool = MaxPooling(wf, kx=2, ky=2, store_offsets=True)
+    pool.input = Vector(x)
+    pool.initialize(device=None)
+    pool.numpy_run()
+    depool = Depooling(wf, kx=2, ky=2)
+    depool.input = pool.output
+    depool.offsets = pool.output_offsets
+    depool.initialize(device=None)
+    depool.numpy_run()
+    out = depool.output.mem
+    assert out.shape == x.shape
+    # per window: out holds the max at its original position, 0 elsewhere
+    for b in range(2):
+        for i in range(2):
+            for j in range(2):
+                for c in range(3):
+                    win_x = x[b, 2*i:2*i+2, 2*j:2*j+2, c]
+                    win_o = out[b, 2*i:2*i+2, 2*j:2*j+2, c]
+                    assert numpy.count_nonzero(win_o) <= 1
+                    pos = numpy.unravel_index(win_x.argmax(),
+                                              win_x.shape)
+                    assert win_o[pos] == pytest.approx(win_x.max())
+                    # all other positions zeroed
+                    masked = win_o.copy()
+                    masked[pos] = 0.0
+                    assert not masked.any()
+
+
+def test_stochastic_pool_depool_unit_and_grad():
+    """Combined pool-depool: input-shaped output, one survivor per
+    window, and gradients flow through the combined pure (the unit is
+    usable inside fused chains)."""
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu import prng
+    from veles_tpu.memory import Vector
+    from veles_tpu.znicz.pooling import StochasticPoolingDepooling
+
+    prng.seed_all(5)
+    rng = numpy.random.default_rng(5)
+    x = rng.standard_normal((2, 4, 4, 3)).astype(numpy.float32)
+    wf = DummyWorkflow()
+    unit = StochasticPoolingDepooling(wf, kx=2, ky=2)
+    unit.input = Vector(x)
+    unit.initialize(device=None)
+    unit.numpy_run()
+    out = unit.output.mem
+    assert out.shape == x.shape
+    for b in range(2):
+        for i in range(2):
+            for j in range(2):
+                for c in range(3):
+                    win_o = out[b, 2*i:2*i+2, 2*j:2*j+2, c]
+                    win_x = x[b, 2*i:2*i+2, 2*j:2*j+2, c]
+                    nz = numpy.flatnonzero(win_o)
+                    assert len(nz) <= 1
+                    if len(nz):
+                        # survivor keeps its original value & position
+                        pos = numpy.unravel_index(nz[0], win_o.shape)
+                        assert win_o[pos] == pytest.approx(win_x[pos])
+    g = jax.grad(lambda a: jnp.sum(
+        StochasticPoolingDepooling.pure(
+            {"seed": jnp.int32(7)}, a, kx=2, ky=2, sliding=(2, 2),
+            kind="stochastic") ** 2))(jnp.asarray(x))
+    assert numpy.isfinite(numpy.asarray(g)).all()
+    assert numpy.count_nonzero(numpy.asarray(g)) > 0
+
+
+def test_conv_ae_with_pool_depool_trains():
+    """Conv-AE sample (conv → stochastic_pool_depool → deconv) builds a
+    fused step and reduces reconstruction loss."""
+    import jax.numpy as jnp
+
+    from veles_tpu import prng
+    from veles_tpu.znicz.fused_graph import lower_specs
+    from veles_tpu.samples.mnist_ae import make_conv_layers
+
+    prng.seed_all(11)
+    layers = make_conv_layers(kernels=4, learning_rate=0.05)
+    params, step, _eval, _apply = lower_specs(layers, (8, 8, 1),
+                                              loss="mse")
+    rng = numpy.random.default_rng(11)
+    x = rng.standard_normal((16, 8, 8, 1)).astype(numpy.float32)
+    losses = []
+    for _ in range(12):
+        params, m = step(params, x, x)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.8, losses

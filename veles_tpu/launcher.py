@@ -14,12 +14,63 @@ thin conductor the units consult (``is_master``/``is_slave``/
 
 import json
 import os
+import re
+import shlex
+import socket
+import subprocess
+import sys
 import threading
 import time
 
 from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
+
+
+def parse_nodes(specs):
+    """``host[:ssh_port][xN]`` specs → [(host, ssh_port, count)]
+    (ref node-spec parsing in ``launcher.py:194-268``).
+
+    The count may be glued to the port (``host:22x3``) or follow the
+    host as ``host *3`` / ``host x3`` — but never glued directly to a
+    bare hostname, where it would be ambiguous (``linux01`` is a host,
+    not ``linu`` × 1)."""
+    out = []
+    for spec in specs:
+        s = str(spec).strip()
+        count = 1
+        m = re.search(r"(?:\*|\s+x)\s*(\d+)$", s)
+        if m:
+            count = int(m.group(1))
+            s = s[:m.start()].rstrip()
+        host, sep, port_part = s.partition(":")
+        ssh_port = 22
+        if sep:
+            pm = re.match(r"^(\d+)(?:x(\d+))?$", port_part)
+            if not pm:
+                raise ValueError("bad node spec %r "
+                                 "(want host[:port][xN])" % (spec,))
+            ssh_port = int(pm.group(1))
+            if pm.group(2):
+                count = int(pm.group(2))
+        if not re.match(r"^[\w.\-]+$", host):
+            raise ValueError("bad node spec %r "
+                             "(want host[:port][xN])" % (spec,))
+        out.append((host, ssh_port, count))
+    return out
+
+
+def discover_nodes_from_yarn(rm_url):
+    """Node list from a YARN ResourceManager REST endpoint
+    (ref ``_discover_nodes_from_yarn`` ``launcher.py:887``): GET
+    ``<rm>/ws/v1/cluster/nodes``, keep RUNNING nodes' hostnames."""
+    import urllib.request
+    url = rm_url.rstrip("/") + "/ws/v1/cluster/nodes"
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        data = json.loads(resp.read())
+    nodes = (data.get("nodes") or {}).get("node") or []
+    return [n["nodeHostName"] for n in nodes
+            if n.get("state", "RUNNING") == "RUNNING"]
 
 
 class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
@@ -48,11 +99,32 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self.testing = kwargs.get("testing", False)
         self.web_status_enabled = kwargs.get("web_status", False)
         self.graphics_enabled = kwargs.get("graphics", False)
+        #: remote bootstrap (ref ``launch_remote_progs``
+        #: ``launcher.py:617-660``): node specs the master ssh-spawns
+        #: slaves onto; ``yarn`` URL adds discovered nodes
+        self.nodes = list(kwargs.get("nodes") or [])
+        if kwargs.get("yarn"):
+            self.nodes.extend(discover_nodes_from_yarn(kwargs["yarn"]))
+        #: template producing the remote-launch prefix; ``%(host)s`` /
+        #: ``%(port)d`` substituted per node (ref
+        #: ``--slave-launch-transform``).  The slave command is appended
+        #: as ONE argument (ssh semantics) — so ``sh -c`` exercises the
+        #: same path fully locally.
+        self.slave_launch_transform = kwargs.get(
+            "slave_launch_transform",
+            "ssh -o BatchMode=yes -p %(port)d %(host)s")
+        #: explicit slave command with ``%(master)s`` placeholder;
+        #: default: this process's argv with -l/--nodes swapped for -m
+        self.slave_command = kwargs.get("slave_command")
+        #: hostname remotes dial back to (default: this host's fqdn —
+        #: the bind address may be 0.0.0.0)
+        self.advertise_host = kwargs.get("advertise_host")
         self.stopped = False
         self.device = None
         self.workflow = None
         self._server = None
         self._client = None
+        self._spawned_ = []
         self._web_status = None
         self._graphics = None
         self._start_time = None
@@ -73,6 +145,19 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "-d", "--device", default=None,
             help="backend: auto | tpu | cpu | numpy; default: "
                  "root.common.engine.backend (ref backends.py:352)")
+        group.add_argument(
+            "-n", "--nodes", nargs="*", default=[],
+            metavar="HOST[:PORT][xN]",
+            help="ssh-spawn N slaves per host from the master "
+                 "(ref launcher.py:617-660)")
+        group.add_argument(
+            "--yarn", default=None, metavar="RM_URL",
+            help="discover slave nodes from a YARN ResourceManager "
+                 "(ref launcher.py:887)")
+        group.add_argument(
+            "--slave-launch-transform",
+            default="ssh -o BatchMode=yes -p %(port)d %(host)s",
+            help="remote-launch prefix template")
         group.add_argument(
             "-p", "--graphics", action="store_true",
             help="launch the detached plotting client")
@@ -154,10 +239,97 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self._server.on_finished = finished.set
         self._server.start()
         self.info("master serving jobs on %s", self._server.endpoint)
-        while not finished.is_set() and not self.stopped:
-            finished.wait(0.2)
-        self._server.print_stats()
-        self._server.stop()
+        try:
+            if self.nodes:
+                self._spawn_remote_slaves()
+            while not finished.is_set() and not self.stopped:
+                finished.wait(0.2)
+                if finished.is_set() or self.stopped:
+                    break
+                if (self._spawned_
+                        and all(p.poll() is not None
+                                for p in self._spawned_)
+                        and not self._server.slaves):
+                    # bootstrap-only cluster: every slave we spawned is
+                    # dead and nothing is connected — nobody is coming;
+                    # fail loudly instead of waiting forever
+                    raise RuntimeError(
+                        "all %d bootstrapped slaves exited (rc=%r) "
+                        "with none connected; run cannot finish" % (
+                            len(self._spawned_),
+                            [p.returncode for p in self._spawned_]))
+        finally:
+            self._server.print_stats()
+            self._server.stop()
+            self._reap_spawned()
+
+    # -- remote bootstrap (ref launch_remote_progs launcher.py:617-660) -----
+    def _master_endpoint(self):
+        """The endpoint remotes dial: the server's bound port on this
+        host's fqdn (the bind host may be 0.0.0.0/127.0.0.1)."""
+        _bhost, bport = _split_endpoint(self._server.endpoint
+                                        if self._server else self.listen)
+        return "%s:%d" % (self.advertise_host or socket.getfqdn(), bport)
+
+    def _build_slave_command(self):
+        if self.slave_command:
+            return self.slave_command % {
+                "master": self._master_endpoint()}
+        # default: re-run this process's command line as a slave
+        argv = [sys.executable] + list(sys.argv)
+        out, skip_one, skip_multi = [], False, False
+        for arg in argv:
+            if skip_one:
+                skip_one = False
+                continue
+            if skip_multi:
+                # --nodes is nargs='*': swallow values until the next
+                # option flag, exactly as argparse consumed them
+                if not arg.startswith("-"):
+                    continue
+                skip_multi = False
+            if arg in ("-l", "--listen", "--yarn"):
+                skip_one = True
+                continue
+            if arg in ("-n", "--nodes"):
+                skip_multi = True
+                continue
+            if arg.startswith(("--listen=", "--nodes=", "--yarn=")):
+                continue
+            out.append(arg)
+        out += ["-m", self._master_endpoint()]
+        return shlex.join(out)
+
+    def _spawn_remote_slaves(self):
+        cmd = self._build_slave_command()
+        for nhost, nport, count in parse_nodes(self.nodes):
+            prefix = shlex.split(self.slave_launch_transform
+                                 % {"host": nhost, "port": nport})
+            for i in range(count):
+                self.info("spawning slave %d/%d on %s: %s",
+                          i + 1, count, nhost, cmd)
+                # the command rides as ONE argument, exactly as ssh
+                # would pass it to the remote shell
+                self._spawned_.append(subprocess.Popen(prefix + [cmd]))
+
+    def _reap_spawned(self, timeout=10.0):
+        deadline = time.time() + timeout
+        for proc in self._spawned_:
+            try:
+                proc.wait(max(0.1, deadline - time.time()))
+                continue
+            except subprocess.TimeoutExpired:
+                self.warning("spawned slave pid %d did not exit; "
+                             "terminating", proc.pid)
+                proc.terminate()
+            try:
+                proc.wait(2.0)
+            except subprocess.TimeoutExpired:
+                self.warning("spawned slave pid %d ignored SIGTERM; "
+                             "killing", proc.pid)
+                proc.kill()
+                proc.wait(2.0)
+        self._spawned_ = []
 
     def _run_slave(self):
         from veles_tpu.parallel.jobs import JobClient

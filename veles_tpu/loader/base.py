@@ -95,11 +95,15 @@ class Loader(Unit):
         self._normalizer = None
 
     def init_unpickled(self):
+        import threading
         super(Loader, self).init_unpickled()
         #: outstanding minibatches per consumer: {slave_id: [(off, size)]}
         self.pending_minibatches_ = collections.defaultdict(list)
-        self._prefetch_future_ = None
-        self._prefetch_def_ = None
+        #: pending background fills: {(offset, size): Future}
+        self._prefetch_futures_ = {}
+        #: serializes fill_minibatch vs background fill_minibatch_into —
+        #: subclasses may share file handles between them
+        self._fill_lock_ = threading.Lock()
 
     # -- configuration ------------------------------------------------------
     @property
@@ -361,36 +365,70 @@ class Loader(Unit):
         size = min(remainder, self.max_minibatch_size)
         return self.global_offset + size, size
 
+    def _submit_fill(self, key, indices, size):
+        """Queue a background fill of ``indices`` into private buffers
+        under ``key`` (the (offset, size) the matching serve will
+        present).  ``_fill_lock_`` serializes against synchronous fills
+        (subclasses may share file handles)."""
+        data_out = numpy.zeros_like(self.minibatch_data.mem)
+        raw_labels = [None] * self.max_minibatch_size
+
+        def work():
+            with self._fill_lock_:
+                self.fill_minibatch_into(indices, data_out[:size],
+                                         raw_labels)
+            return data_out, raw_labels
+
+        from veles_tpu import thread_pool
+        self._prefetch_futures_[key] = thread_pool.submit(work)
+
     def _start_prefetch(self):
         """Kick a background fill of the predicted next minibatch into
         private buffers (the IO-overlap half of the reference's threaded
         unit execution, ``veles/thread_pool.py:71``)."""
         if not (self.prefetch and self.supports_prefetch):
             return
+        if self.is_slave or self.is_master:
+            # distributed prefetch is driven by prefetch_job_data (the
+            # next job's payload) — do NOT clobber its bookkeeping here
+            return
         nxt = self._peek_next_minibatch()
-        self._prefetch_def_ = nxt
         if nxt is None:
+            # unpredictable (retry queued / epoch wrap → reshuffle):
+            # anything buffered may be wrong for a same-offset later
+            # serve — drop it (the lock keeps still-running work safe)
+            self._prefetch_futures_.clear()
             return
         offset, size = nxt
         self.shuffled_indices.map_read()
         indices = numpy.array(
             self.shuffled_indices.mem[offset - size:offset])
-        data_out = numpy.zeros_like(self.minibatch_data.mem)
-        raw_labels = [None] * self.max_minibatch_size
+        self._submit_fill(nxt, indices, size)
 
-        def work():
-            self.fill_minibatch_into(indices, data_out[:size], raw_labels)
-            return data_out, raw_labels
-
-        from veles_tpu import thread_pool
-        self._prefetch_future_ = thread_pool.submit(work)
+    def prefetch_job_data(self, data):
+        """Slave-side IO overlap (the reference's async double-buffering
+        one level deeper, ``client.py:293-296``): the job client hands
+        us the NEXT job's loader payload while the CURRENT job still
+        computes; start filling those exact indices into private
+        buffers so ``apply_data_from_master`` + serve find them ready."""
+        if not (self.prefetch and self.supports_prefetch):
+            return
+        key = (int(data["minibatch_offset"]),
+               int(data["minibatch_size"]))
+        # ≤ 2 in flight (the job pipeline is 2-deep); an identical key
+        # keeps the OLDER future — jobs are served in order, so it
+        # matches first and the newer duplicate simply refills
+        if len(self._prefetch_futures_) >= 2 \
+                or key in self._prefetch_futures_:
+            return
+        self._submit_fill(key, numpy.array(data["indices"]), key[1])
 
     def _fill_current(self, minibatch_def):
         """Use the prefetched buffers when they match the minibatch being
         served; otherwise fall back to a synchronous fill."""
-        fut, self._prefetch_future_ = self._prefetch_future_, None
-        if fut is not None and self._prefetch_def_ == minibatch_def:
-            self._prefetch_def_ = None
+        key = (int(minibatch_def[0]), int(minibatch_def[1]))
+        fut = self._prefetch_futures_.pop(key, None)
+        if fut is not None:
             try:
                 data, raw_labels = fut.result()
             except Exception:
@@ -401,16 +439,13 @@ class Loader(Unit):
                 self.minibatch_data.mem[:size] = data[:size]
                 self.raw_minibatch_labels[:] = raw_labels
                 return
-        elif fut is not None:
-            # stale prediction (retry/epoch wrap): wait it out so the
-            # synchronous fill never runs concurrently with it (shared
-            # file handles in the subclass), then discard
-            self._prefetch_def_ = None
-            try:
-                fut.result()
-            except Exception:
-                pass
-        self.fill_minibatch()
+        if self._prefetch_futures_ and not self.is_slave:
+            # stale standalone predictions: drop (slave mode keeps the
+            # map — a mismatch there just means the future belongs to
+            # the NEXT job, racing the current serve)
+            self._prefetch_futures_.clear()
+        with self._fill_lock_:
+            self.fill_minibatch()
 
     def _on_successful_serve(self):
         self.samples_served += self.minibatch_size

@@ -86,6 +86,16 @@ class JobServer(Logger):
         #: outbound messages produced by worker threads; only the loop
         #: thread touches the (thread-unsafe) ROUTER socket
         self._outbox = collections.deque()
+        # inproc wake-up pair: a worker finishing job generation while
+        # the loop sits in poll() must not wait out the poll timeout —
+        # that 200 ms would be added to every offloaded reply's latency
+        wake_addr = "inproc://jobserver-wake-%x" % id(self)
+        self._wake_recv = self._context.socket(zmq.PAIR)
+        self._wake_recv.bind(wake_addr)
+        self._wake_send = self._context.socket(zmq.PAIR)
+        self._wake_send.connect(wake_addr)
+        self._wake_lock = threading.Lock()
+        self._wake_closed = False
         self.info("job server on %s", self.endpoint)
 
     # -- lifecycle ----------------------------------------------------------
@@ -97,9 +107,20 @@ class JobServer(Logger):
 
     def stop(self):
         self._stop.set()
+        with self._wake_lock:
+            try:
+                self._wake_send.send(b"", flags=1)  # NOBLOCK
+            except Exception:
+                pass
         if self._thread is not None:
             self._thread.join(5)
         self._socket.close(linger=0)
+        # close under the lock: a straggler worker thread may still be
+        # inside _send's wake path (zmq sockets are not thread-safe)
+        with self._wake_lock:
+            self._wake_closed = True
+            self._wake_send.close(linger=0)
+        self._wake_recv.close(linger=0)
 
     @property
     def finished(self):
@@ -111,11 +132,19 @@ class JobServer(Logger):
         import zmq
         poller = zmq.Poller()
         poller.register(self._socket, zmq.POLLIN)
+        poller.register(self._wake_recv, zmq.POLLIN)
         last_reap = time.time()
         import zmq as _zmq
         while not self._stop.is_set():
             self._drain_outbox()
             if poller.poll(50 if self._outbox else 200):
+                # swallow wake-up notifications (their only job was
+                # ending the poll early so the outbox drains now)
+                while True:
+                    try:
+                        self._wake_recv.recv(flags=_zmq.NOBLOCK)
+                    except _zmq.Again:
+                        break
                 # drain EVERYTHING queued before reaping: a slow
                 # generate_data_for_slave stalls this loop, and pings
                 # that piled up meanwhile must refresh last_seen before
@@ -157,6 +186,12 @@ class JobServer(Logger):
             self._socket.send_multipart([identity, blob])
         else:
             self._outbox.append((identity, blob))
+            with self._wake_lock:
+                if not self._wake_closed:
+                    try:
+                        self._wake_send.send(b"", flags=1)  # NOBLOCK
+                    except Exception:
+                        pass
 
     def _dispatch(self, identity, msg):
         op = msg.get("op")
@@ -459,6 +494,14 @@ class JobClient(Logger):
                     # locked out
                     next_reply = self._request_with_pings(
                         {"op": "job_request", "id": self.sid})
+                    if next_reply.get("op") == "job":
+                        # overlap the NEXT minibatch's IO with the rest
+                        # of the current compute (loader-side
+                        # double-buffering, ref client.py:293-296)
+                        prefetch_hook = getattr(
+                            self.workflow, "prefetch_job", None)
+                        if prefetch_hook is not None:
+                            prefetch_hook(next_reply["data"])
                     worker.join()
                     if error:
                         raise error[0]

@@ -177,3 +177,132 @@ class Histogram(Plotter):
         axes.bar(self.edges[:-1], self.counts,
                  width=numpy.diff(self.edges))
         axes.set_title(self.name)
+
+
+class MultiHistogram(Plotter):
+    """Per-row histograms of a 2D tensor — per-neuron weight
+    distributions (ref ``plotting_units.py:681``).  Rendered as one
+    heatmap (rows = neurons, cols = bins) instead of the reference's
+    subplot grid: a single-axes design that stays readable at
+    ``hist_number`` in the hundreds."""
+
+    def __init__(self, workflow, **kwargs):
+        super(MultiHistogram, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.input_field = kwargs.get("input_field")
+        self.hist_number = kwargs.get("hist_number", 16)
+        self.n_bars = kwargs.get("n_bars", 25)
+        self.counts = None          # (rows, n_bars)
+        self.lo = self.hi = None
+        self.demand("input")
+
+    def fill(self):
+        value = getattr(self.input, self.input_field) \
+            if self.input_field else self.input
+        mem = getattr(value, "mem", value)
+        if mem is None:
+            return
+        mat = numpy.asarray(mem)
+        mat = mat.reshape(mat.shape[0], -1) if mat.ndim > 1 \
+            else mat.reshape(1, -1)
+        rows = min(self.hist_number, mat.shape[0])
+        self.lo = float(mat.min())
+        self.hi = float(mat.max())
+        if self.hi <= self.lo:            # degenerate constant input
+            self.hi = self.lo + 1e-6
+        self.counts = numpy.stack([
+            numpy.histogram(mat[i], bins=self.n_bars,
+                            range=(self.lo, self.hi))[0]
+            for i in range(rows)])
+
+    def redraw(self, axes):
+        if self.counts is None:
+            return
+        axes.imshow(self.counts, aspect="auto", interpolation="nearest",
+                    cmap="magma",
+                    extent=(self.lo, self.hi, self.counts.shape[0], 0))
+        axes.set_xlabel("value")
+        axes.set_ylabel("row")
+        axes.set_title(self.name)
+
+
+class MaxMinPlotter(Plotter):
+    """Track max/min/mean of linked tensors over time
+    (ref ``TableMaxMin`` ``plotting_units.py:769`` — a table there; a
+    time series here, which also shows divergence trends)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(MaxMinPlotter, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.input_field = kwargs.get("input_field")
+        self.maxes = []
+        self.mins = []
+        self.means = []
+        self.demand("input")
+
+    def fill(self):
+        value = getattr(self.input, self.input_field) \
+            if self.input_field else self.input
+        mem = getattr(value, "mem", value)
+        if mem is None:
+            return
+        arr = numpy.asarray(mem)
+        if not arr.size:
+            return
+        self.maxes.append(float(arr.max()))
+        self.mins.append(float(arr.min()))
+        self.means.append(float(arr.mean()))
+
+    def redraw(self, axes):
+        if not self.maxes:
+            return
+        axes.plot(self.maxes, label="max")
+        axes.plot(self.means, label="mean")
+        axes.plot(self.mins, label="min")
+        axes.legend()
+        axes.set_title(self.name)
+
+
+class SlaveStats(Plotter):
+    """Per-slave job throughput in a distributed run
+    (ref ``SlaveStats`` ``plotting_units.py:822``): reads the job
+    server's live slave table (``SlaveDescription.jobs_done`` /
+    ``in_flight`` / ``power``) and plots jobs/sec per slave."""
+
+    def __init__(self, workflow, **kwargs):
+        super(SlaveStats, self).__init__(workflow, **kwargs)
+        self.server = kwargs.get("server")
+        self.rows = []               # [(sid, state, power, done, in_flight, rate)]
+        self._last_ = {}             # sid -> (monotonic, jobs_done)
+        self.demand("server")
+
+    def fill(self):
+        import time as _time
+        slaves = getattr(self.server, "slaves", {})
+        now = _time.monotonic()
+        rows = []
+        for sid, s in sorted(slaves.items()):
+            done = int(getattr(s, "jobs_done", 0))
+            prev_t, prev_done = self._last_.get(sid, (None, 0))
+            rate = ((done - prev_done) / (now - prev_t)) \
+                if prev_t is not None and now > prev_t else 0.0
+            self._last_[sid] = (now, done)
+            rows.append((str(sid), getattr(s, "state", "?"),
+                         float(getattr(s, "power", 0.0)), done,
+                         int(getattr(s, "in_flight", 0)), rate))
+        self.rows = rows
+
+    def redraw(self, axes):
+        if not self.rows:
+            return
+        sids = [r[0][:8] for r in self.rows]
+        rates = [r[5] for r in self.rows]
+        axes.bar(range(len(sids)), rates)
+        axes.set_xticks(range(len(sids)))
+        axes.set_xticklabels(sids, rotation=45)
+        axes.set_ylabel("jobs/sec")
+        for i, row in enumerate(self.rows):
+            axes.annotate("%s d=%d f=%d" % (row[1], row[3], row[4]),
+                          (i, rates[i]), fontsize=7,
+                          textcoords="offset points", xytext=(0, 3))
+        axes.set_title(self.name)

@@ -27,11 +27,12 @@ def make_layers(hidden=100, learning_rate=0.01):
     ]
 
 
-def make_conv_layers(kernels=8, learning_rate=0.01):
+def make_conv_layers(kernels=8, learning_rate=0.01, pool_depool=True):
     """Conv-AE (ref "convolutional autoencoder" family,
-    ``manualrst_veles_algorithms.rst:56-70``): conv encoder + deconv
-    decoder sharing geometry."""
-    return [
+    ``manualrst_veles_algorithms.rst:56-70``): conv encoder,
+    stochastic pool+depool bottleneck (ref
+    ``pooling.StochasticPoolingDepooling``), deconv decoder."""
+    layers = [
         {"type": "conv_tanh",
          "->": {"n_kernels": kernels, "kx": 3, "ky": 3, "padding": 1},
          "<-": {"learning_rate": learning_rate,
@@ -42,6 +43,10 @@ def make_conv_layers(kernels=8, learning_rate=0.01):
          "<-": {"learning_rate": learning_rate,
                 "gradient_moment": 0.9}},
     ]
+    if pool_depool:
+        layers.insert(1, {"type": "stochastic_pool_depool",
+                          "->": {"kx": 2, "ky": 2}})
+    return layers
 
 
 class MnistAELoader(FullBatchLoaderMSE):

@@ -185,7 +185,22 @@ class SnapshotterToFile(SnapshotterBase):
 
 
 def load_snapshot(path):
-    """Module-level resume helper."""
+    """Module-level resume helper.  Accepts a local path OR an
+    http(s):// URL (ref ``__main__.py:539-590`` ``_load_workflow``
+    resumes from URLs too): a URL is streamed to a temp file first so
+    the codec sniffing and pickling path stay identical."""
+    if path.startswith(("http://", "https://")):
+        import shutil
+        import tempfile
+        import urllib.request
+        suffix = "_" + path.rsplit("/", 1)[-1]
+        tmp = tempfile.NamedTemporaryFile(suffix=suffix, delete=False)
+        try:
+            with tmp, urllib.request.urlopen(path) as resp:
+                shutil.copyfileobj(resp, tmp)
+            return SnapshotterToFile.import_(tmp.name)
+        finally:
+            os.unlink(tmp.name)
     return SnapshotterToFile.import_(path)
 
 

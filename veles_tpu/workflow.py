@@ -331,6 +331,25 @@ class Workflow(Unit):
             if payload is not None:
                 unit.apply_data_from_master(payload)
 
+    def prefetch_job(self, data):
+        """Slave-side lookahead: offer the NEXT job's per-unit payloads
+        to units exposing ``prefetch_job_data`` (the loader starts its
+        minibatch IO) while the current job still computes.  Read-only
+        with respect to serving state — ``apply_data_from_master``
+        still happens when the job is actually processed."""
+        units = [u for u in self.units_in_dependency_order()
+                 if u is not self]
+        if len(data) != len(units):
+            return
+        for unit, payload in zip(units, data):
+            hook = getattr(unit, "prefetch_job_data", None)
+            if hook is not None and payload is not None:
+                try:
+                    hook(payload)
+                except Exception:
+                    self.exception("prefetch_job_data failed on %r",
+                                   unit)
+
     def generate_data_for_master(self):
         return [u.generate_data_for_master()
                 for u in self.units_in_dependency_order() if u is not self]

@@ -18,8 +18,60 @@ import jax.numpy as jnp
 import numpy
 
 from veles_tpu import prng
+from veles_tpu.memory import Vector
 from veles_tpu.znicz.gd_base import GDViaVJP
 from veles_tpu.znicz.nn_units import ForwardBase
+
+
+def _extract_patches(x, kx, ky, sliding):
+    """(b, out_h, out_w, ky*kx, c) window patches + output dims."""
+    b, h, w, c = x.shape
+    out_h = (h - ky) // sliding[1] + 1
+    out_w = (w - kx) // sliding[0] + 1
+    row = (jnp.arange(out_h) * sliding[1])[:, None] \
+        + jnp.arange(ky)[None, :]                      # (out_h, ky)
+    col = (jnp.arange(out_w) * sliding[0])[:, None] \
+        + jnp.arange(kx)[None, :]                      # (out_w, kx)
+    patches = x[:, row[:, None, :, None],
+                col[None, :, None, :], :]   # (b, out_h, out_w, ky, kx, c)
+    return patches.reshape(b, out_h, out_w, ky * kx, c), out_h, out_w
+
+
+def _select_window(patches, kind, params):
+    """Per-window element choice for the selective pooling kinds →
+    (chosen (b,oh,ow,c), sel index (b,oh,ow,1,c) in [0, ky*kx))."""
+    magnitude = jnp.abs(patches)
+    if kind in ("max", "maxabs"):
+        source = patches if kind == "max" else magnitude
+        sel = jnp.argmax(source, axis=3, keepdims=True)
+    else:  # stochastic / stochasticabs (Zeiler & Fergus)
+        key = jax.random.key(
+            jax.lax.stop_gradient(params["seed"]).astype(jnp.uint32))
+        probs = magnitude / jnp.maximum(
+            magnitude.sum(axis=3, keepdims=True), 1e-12)
+        cum = jnp.cumsum(probs, axis=3)
+        b, oh, ow, _k, c = patches.shape
+        u = jax.random.uniform(key, (b, oh, ow, 1, c))
+        sel = jnp.argmax(cum >= u, axis=3, keepdims=True)
+    chosen = jnp.take_along_axis(patches, sel, axis=3)[..., 0, :]
+    # maxabs selects by |x| but KEEPS the sign; only stochasticabs
+    # outputs the magnitude (matches the reference pair semantics)
+    if kind == "stochasticabs":
+        chosen = jnp.abs(chosen)
+    return chosen, sel
+
+
+def _scatter_windows(values, sel, kx, ky):
+    """Inverse of window selection for non-overlapping windows: place
+    each pooled value back at its recorded in-window offset ``sel``
+    (b, oh, ow, c), zeros elsewhere → (b, oh*ky, ow*kx, c)."""
+    b, oh, ow, c = values.shape
+    onehot = jax.nn.one_hot(sel, ky * kx, axis=3,
+                            dtype=values.dtype)      # (b, oh, ow, K, c)
+    spread = values[:, :, :, None, :] * onehot
+    spread = spread.reshape(b, oh, ow, ky, kx, c)
+    return spread.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, oh * ky, ow * kx, c)
 
 
 class PoolingBase(ForwardBase):
@@ -33,6 +85,10 @@ class PoolingBase(ForwardBase):
         self.ky = kwargs.get("ky", 2)
         self.sliding = tuple(kwargs.get("sliding", (self.kx, self.ky)))
         self.include_bias = False
+        #: record per-window selection indices for a downstream
+        #: Depooling unit (ref ``output_offsets``); selective kinds only
+        self.store_offsets = kwargs.get("store_offsets", False)
+        self.output_offsets = Vector()
 
     def pure_config(self):
         return {"kx": self.kx, "ky": self.ky, "sliding": self.sliding,
@@ -52,35 +108,27 @@ class PoolingBase(ForwardBase):
             return jax.lax.reduce_window(
                 x, -jnp.inf, jax.lax.max, window, strides, "VALID")
         # maxabs / stochastic variants: explicit window patches
-        # (b, out_h, out_w, ky*kx, c), selection along the window axis
-        b, h, w, c = x.shape
-        out_h = (h - ky) // sliding[1] + 1
-        out_w = (w - kx) // sliding[0] + 1
-        row = (jnp.arange(out_h) * sliding[1])[:, None] \
-            + jnp.arange(ky)[None, :]                      # (out_h, ky)
-        col = (jnp.arange(out_w) * sliding[0])[:, None] \
-            + jnp.arange(kx)[None, :]                      # (out_w, kx)
-        patches = x[:, row[:, None, :, None],
-                    col[None, :, None, :], :]   # (b, out_h, out_w, ky, kx, c)
-        patches = patches.reshape(b, out_h, out_w, ky * kx, c)
-        magnitude = jnp.abs(patches)
-        if kind == "maxabs":
-            sel = jnp.argmax(magnitude, axis=3, keepdims=True)
-            return jnp.take_along_axis(patches, sel, axis=3)[..., 0, :]
-        # stochastic (Zeiler & Fergus): sample ∝ |value| per window;
-        # the seed is a TRACED param so forward and its VJP backward use
-        # the same routing without retracing per step
-        key = jax.random.key(
-            jax.lax.stop_gradient(params["seed"]).astype(jnp.uint32))
-        probs = magnitude / jnp.maximum(
-            magnitude.sum(axis=3, keepdims=True), 1e-12)
-        cum = jnp.cumsum(probs, axis=3)
-        u = jax.random.uniform(key, (b, out_h, out_w, 1, c))
-        sel = jnp.argmax(cum >= u, axis=3, keepdims=True)
-        chosen = jnp.take_along_axis(patches, sel, axis=3)[..., 0, :]
-        if kind == "stochasticabs":
-            return jnp.abs(chosen)
+        # (b, out_h, out_w, ky*kx, c), selection along the window axis;
+        # the stochastic seed is a TRACED param so forward and its VJP
+        # backward use the same routing without retracing per step
+        patches, _oh, _ow = _extract_patches(x, kx, ky, sliding)
+        chosen, _sel = _select_window(patches, kind, params)
         return chosen
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("kx", "ky", "sliding",
+                                                 "kind"))
+    def pure_with_offsets(params, x, kx=2, ky=2, sliding=(2, 2),
+                          kind="max"):
+        """(pooled, offsets): like ``pure`` but also returns each
+        window's selected in-window index (b, oh, ow, c) int32 — the
+        reference's ``output_offsets`` consumed by Depooling
+        (``depooling.Depooling``).  Selective kinds only."""
+        if kind == "avg":
+            raise ValueError("avg pooling records no offsets")
+        patches, _oh, _ow = _extract_patches(x, kx, ky, sliding)
+        chosen, sel = _select_window(patches, kind, params)
+        return chosen, sel[..., 0, :].astype(jnp.int32)
 
     def output_shape_for(self, input_shape):
         batch, h, w, c = input_shape
@@ -90,9 +138,15 @@ class PoolingBase(ForwardBase):
 
     def initialize(self, device=None, **kwargs):
         super(PoolingBase, self).initialize(device=device, **kwargs)
-        self.output.reset(numpy.zeros(
-            self.output_shape_for(self.input.shape), numpy.float32))
+        out_shape = self.output_shape_for(self.input.shape)
+        self.output.reset(numpy.zeros(out_shape, numpy.float32))
         self.init_vectors(self.output)
+        if self.store_offsets:
+            if self.KIND == "avg":
+                raise ValueError("avg pooling records no offsets")
+            self.output_offsets.reset(numpy.zeros(out_shape,
+                                                  numpy.int32))
+            self.init_vectors(self.output_offsets)
 
     def pure_params(self, host=False):
         params = super(PoolingBase, self).pure_params(host=host)
@@ -109,17 +163,31 @@ class PoolingBase(ForwardBase):
 
     def numpy_run(self):
         self._draw_seed()
-        out = type(self).pure(self.pure_params(host=True),
-                              jnp.asarray(self.input.mem),
-                              **self.pure_config())
+        if self.store_offsets:
+            out, offs = type(self).pure_with_offsets(
+                self.pure_params(host=True),
+                jnp.asarray(self.input.mem), **self.pure_config())
+            self.output_offsets.map_invalidate()
+            self.output_offsets.mem = numpy.asarray(offs)
+        else:
+            out = type(self).pure(self.pure_params(host=True),
+                                  jnp.asarray(self.input.mem),
+                                  **self.pure_config())
         self.output.map_invalidate()
         self.output.mem = numpy.asarray(out)
 
     def tpu_run(self):
         self._draw_seed()
-        self.output.devmem = type(self).pure(
-            self.pure_params(host=False), self.input.devmem,
-            **self.pure_config())
+        if self.store_offsets:
+            out, offs = type(self).pure_with_offsets(
+                self.pure_params(host=False), self.input.devmem,
+                **self.pure_config())
+            self.output.devmem = out
+            self.output_offsets.devmem = offs
+        else:
+            self.output.devmem = type(self).pure(
+                self.pure_params(host=False), self.input.devmem,
+                **self.pure_config())
 
 
 class MaxPooling(PoolingBase):
@@ -144,6 +212,108 @@ class StochasticPooling(PoolingBase):
 
 class StochasticAbsPooling(PoolingBase):
     MAPPING = "stochasticabs_pooling"
+    KIND = "stochasticabs"
+
+
+class Depooling(ForwardBase):
+    """Scatter pooled values back to their recorded source positions —
+    the decoder half of a convolutional autoencoder (ref
+    ``depooling.Depooling``,
+    ``manualrst_veles_workflow_parameters.rst:477-480``; forward-only in
+    the reference too).
+
+    Link ``offsets`` from the paired pooling unit's ``output_offsets``
+    (created with ``store_offsets=True``).  Non-overlapping windows only
+    (``sliding == (kx, ky)``) — the configuration conv-AEs use; the
+    TPU-friendly scatter is then a one-hot spread + reshape instead of a
+    serial scatter kernel."""
+
+    MAPPING = "depooling"
+
+    def __init__(self, workflow, **kwargs):
+        super(Depooling, self).__init__(workflow, **kwargs)
+        self.kx = kwargs.get("kx", 2)
+        self.ky = kwargs.get("ky", 2)
+        self.sliding = tuple(kwargs.get("sliding", (self.kx, self.ky)))
+        if self.sliding != (self.kx, self.ky):
+            raise ValueError("depooling needs non-overlapping windows "
+                             "(sliding == (kx, ky)), got %r"
+                             % (self.sliding,))
+        self.include_bias = False
+        self.demand("offsets")
+
+    def pure_config(self):
+        return {"kx": self.kx, "ky": self.ky}
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("kx", "ky"))
+    def pure(params, x, kx=2, ky=2):
+        return _scatter_windows(x, params["offsets"], kx, ky)
+
+    def pure_params(self, host=False):
+        return {"offsets": self.offsets.mem if host
+                else self.offsets.devmem}
+
+    def initialize(self, device=None, **kwargs):
+        super(Depooling, self).initialize(device=device, **kwargs)
+        b, h, w, c = self.input.shape
+        self.output.reset(numpy.zeros(
+            (b, h * self.ky, w * self.kx, c), numpy.float32))
+        self.init_vectors(self.output)
+
+    def numpy_run(self):
+        out = type(self).pure(self.pure_params(host=True),
+                              jnp.asarray(self.input.mem),
+                              **self.pure_config())
+        self.output.map_invalidate()
+        self.output.mem = numpy.asarray(out)
+
+    def tpu_run(self):
+        self.output.devmem = type(self).pure(
+            self.pure_params(host=False), self.input.devmem,
+            **self.pure_config())
+
+
+class _PoolDepoolBase(PoolingBase):
+    """Pool + immediate depool in ONE unit (ref
+    ``pooling.StochasticPoolingDepooling`` /
+    ``StochasticAbsPoolingDepooling``): output has the input's spatial
+    shape, with only each window's sampled survivor kept.  Single
+    input → single output, so it composes into fused chains
+    (``fused_graph.lower_specs``) like any other layer."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(_PoolDepoolBase, self).__init__(workflow, **kwargs)
+        if self.sliding != (self.kx, self.ky):
+            raise ValueError("pool-depool needs non-overlapping "
+                             "windows (sliding == (kx, ky)), got %r"
+                             % (self.sliding,))
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("kx", "ky", "sliding",
+                                                 "kind"))
+    def pure(params, x, kx=2, ky=2, sliding=(2, 2), kind="stochastic"):
+        patches, _oh, _ow = _extract_patches(x, kx, ky, sliding)
+        chosen, sel = _select_window(patches, kind, params)
+        return _scatter_windows(chosen, sel[..., 0, :].astype(jnp.int32),
+                                kx, ky)
+
+    def output_shape_for(self, input_shape):
+        b, h, w, c = input_shape
+        out_h = (h - self.ky) // self.sliding[1] + 1
+        out_w = (w - self.kx) // self.sliding[0] + 1
+        return (b, out_h * self.ky, out_w * self.kx, c)
+
+
+class StochasticPoolingDepooling(_PoolDepoolBase):
+    MAPPING = "stochastic_pool_depool"
+    KIND = "stochastic"
+
+
+class StochasticAbsPoolingDepooling(_PoolDepoolBase):
+    MAPPING = "stochastic_abs_pool_depool"
     KIND = "stochasticabs"
 
 

@@ -44,6 +44,11 @@ GD_PAIRS = {
     "avg_pooling": "gd_avg_pooling",
     "stochastic_pooling": "gd_stochastic_pooling",
     "stochasticabs_pooling": "gd_stochastic_pooling",
+    # ref maps the combined pool-depool backward to GDMaxPooling
+    # (manualrst_veles_workflow_parameters.rst:472,503); ours is the
+    # generic VJP through the combined pure
+    "stochastic_pool_depool": "gd_stochastic_pooling",
+    "stochastic_abs_pool_depool": "gd_stochastic_pooling",
     "lrn": "gd_lrn",
     "dropout": "gd_dropout",
     "deconv": "gd_deconv",
