@@ -82,9 +82,20 @@ def stage_probe():
     x = jnp.ones((256, 256), jnp.bfloat16)
     y = jax.jit(lambda a: a @ a)(x)
     assert float(jax.device_get(y[0, 0])) == 256.0  # real bytes, real sync
+    try:
+        from veles_tpu.samples.datasets import (cifar10_available,
+                                                mnist_available)
+        datasets = {"mnist": mnist_available(),
+                    "cifar10": cifar10_available()}
+    except Exception:
+        datasets = {}
     print(json.dumps({"platform": dev.platform,
                       "device_kind": dev.device_kind,
-                      "n_devices": jax.device_count()}))
+                      "n_devices": jax.device_count(),
+                      # accuracy-parity gates (test_accuracy_parity.py)
+                      # need the real files; throughput stages use
+                      # synthetic batches either way
+                      "real_datasets_present": datasets}))
 
 
 def _device_kind():

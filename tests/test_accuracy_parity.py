@@ -1,0 +1,72 @@
+"""STRICT accuracy-parity gates vs the reference's published results
+(``manualrst_veles_algorithms.rst:31,50,69``; tabulated in BASELINE.md):
+
+- MNIST MnistSimple MLP: validation error ≤ 1.48 %
+- MNIST autoencoder: validation RMSE ≤ 0.5478
+- CIFAR-10 convnet: validation error ≤ 17.21 %
+
+These run ONLY when the real datasets are present — this image is
+egress-less, so the operator must place them under
+``root.common.dirs.datasets`` (default ``~/.veles_tpu/datasets``;
+override via the config tree or the VELES_DATASETS env var, which
+``samples.datasets`` honors everywhere):
+
+    <datasets>/mnist/{train,t10k}-{images-idx3,labels-idx1}-ubyte[.gz]
+    <datasets>/cifar-10-batches-bin/{data_batch_1..5,test_batch}.bin
+
+When the files are absent the tests SKIP (never silently pass on the
+synthetic stand-ins — those have their own, tighter bars in
+test_samples.py).
+"""
+
+import pytest
+
+from veles_tpu.samples.datasets import (
+    cifar10_available, mnist_available)
+
+needs_mnist = pytest.mark.skipif(
+    not mnist_available(),
+    reason="real MNIST IDX files not present under "
+           "root.common.dirs.datasets/mnist")
+needs_cifar = pytest.mark.skipif(
+    not cifar10_available(),
+    reason="real CIFAR-10 binary batches not present under "
+           "root.common.dirs.datasets/cifar-10-batches-bin")
+
+
+@needs_mnist
+def test_mnist_mlp_parity_1_48pct():
+    from veles_tpu import prng
+    from veles_tpu.samples import mnist
+    prng.seed_all(1234)
+    wf = mnist.create_workflow(max_epochs=25, minibatch_size=100)
+    wf.run()
+    err = wf.gather_results()["best_validation_error_pt"]
+    assert err <= 1.48, \
+        "MNIST parity gate failed: %.2f%% > 1.48%%" % err
+
+
+@needs_mnist
+def test_mnist_ae_parity_rmse_0_5478():
+    from veles_tpu import prng
+    from veles_tpu.samples import mnist_ae
+    prng.seed_all(1234)
+    wf = mnist_ae.create_workflow(max_epochs=15, minibatch_size=100)
+    wf.run()
+    # decision.best_mse IS the RMSE (logged/snapshotted as "rmse",
+    # decision.py:173-182)
+    rmse = float(wf.decision.best_mse)
+    assert rmse <= 0.5478, \
+        "MNIST-AE parity gate failed: rmse %.4f > 0.5478" % rmse
+
+
+@needs_cifar
+def test_cifar_convnet_parity_17_21pct():
+    from veles_tpu import prng
+    from veles_tpu.samples import cifar10
+    prng.seed_all(1234)
+    wf = cifar10.create_workflow(max_epochs=40, minibatch_size=100)
+    wf.run()
+    err = wf.decision.best_n_err_pt
+    assert err <= 17.21, \
+        "CIFAR-10 parity gate failed: %.2f%% > 17.21%%" % err

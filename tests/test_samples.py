@@ -6,6 +6,19 @@ import pytest
 from veles_tpu.backends import CPUDevice, NumpyDevice
 
 
+@pytest.fixture(autouse=True)
+def _pin_synthetic_data(tmp_path, monkeypatch):
+    """These bars were calibrated on the synthetic stand-ins; a machine
+    provisioned with real datasets (for test_accuracy_parity.py) must
+    not silently switch these short runs onto real data."""
+    from veles_tpu.config import root
+    monkeypatch.delenv("VELES_DATASETS", raising=False)
+    saved = root.common.dirs.get("datasets")
+    root.common.dirs.datasets = str(tmp_path / "no-datasets-here")
+    yield
+    root.common.dirs.datasets = saved
+
+
 def test_mnist_sample_trains():
     from veles_tpu import prng
     from veles_tpu.samples import mnist
@@ -14,7 +27,10 @@ def test_mnist_sample_trains():
                                minibatch_size=500)
     wf.run()
     results = wf.gather_results()
-    assert results["best_validation_error_pt"] < 50.0
+    # measured 25.0 % on the synthetic stand-in at this seed/config —
+    # the bar tracks actual achievement, not "anything beats chance"
+    # (real-data parity gates live in test_accuracy_parity.py)
+    assert results["best_validation_error_pt"] < 35.0
 
 
 def test_mnist_ae_sample_trains():
@@ -24,7 +40,9 @@ def test_mnist_ae_sample_trains():
     wf = mnist_ae.create_workflow(device=NumpyDevice(), max_epochs=2,
                                   minibatch_size=500, hidden=32)
     wf.run()
-    assert float(wf.decision.best_mse) < 0.6   # ref parity gate 0.5478
+    # measured 0.109 rmse on the synthetic stand-in (real-data 0.5478
+    # parity gate lives in test_accuracy_parity.py)
+    assert float(wf.decision.best_mse) < 0.2
 
 
 def test_rbm_pretraining_reduces_reconstruction_error():
@@ -79,8 +97,10 @@ def test_cifar_sample_builds_and_steps():
     assert len(wf.forwards) == 8   # 3 conv + 3 pool + fc + softmax
     wf.run()
     assert wf.stopped
-    # a full epoch of all classes was accounted
-    assert wf.decision.epoch_n_err_pt[2] < 100.0
+    # measured 90.3 % train error after 2 synthetic epochs (the deep
+    # stack is just starting to move) — bar requires genuine learning,
+    # not mere accounting; real-data gate in test_accuracy_parity.py
+    assert wf.decision.epoch_n_err_pt[2] < 93.0
 
 
 def test_alexnet_fused_builds_and_steps():
@@ -164,3 +184,47 @@ def test_mnist_conv_ae_short_training():
     # trains to something sane
     rmse = float(wf.decision.best_mse)
     assert 0.0 < rmse < 1.0
+
+
+def test_real_mnist_idx_path_parses(tmp_path):
+    """The real-data branch (gating test_accuracy_parity.py) reads the
+    IDX layout correctly: magic dims, gz variants, [0,1] scaling."""
+    import gzip
+    import struct
+
+    from veles_tpu.config import root
+    from veles_tpu.samples import datasets
+
+    base = tmp_path / "mnist"
+    base.mkdir()
+
+    def write_idx(name, arr, compress=False):
+        payload = struct.pack(">I", (0x08 << 8) | arr.ndim
+                              ) + struct.pack(
+            ">" + "I" * arr.ndim, *arr.shape) + arr.tobytes()
+        path = base / (name + (".gz" if compress else ""))
+        (gzip.open if compress else open)(str(path), "wb").write(payload)
+
+    rng = numpy.random.default_rng(0)
+    tr_x = rng.integers(0, 256, (6, 28, 28)).astype(numpy.uint8)
+    tr_y = rng.integers(0, 10, 6).astype(numpy.uint8)
+    te_x = rng.integers(0, 256, (4, 28, 28)).astype(numpy.uint8)
+    te_y = rng.integers(0, 10, 4).astype(numpy.uint8)
+    write_idx("train-images-idx3-ubyte", tr_x)
+    write_idx("train-labels-idx1-ubyte", tr_y, compress=True)  # mixed
+    write_idx("t10k-images-idx3-ubyte", te_x)
+    write_idx("t10k-labels-idx1-ubyte", te_y)
+
+    saved = root.common.dirs.get("datasets", ".")
+    root.common.dirs.datasets = str(tmp_path)
+    try:
+        assert datasets.mnist_available()
+        x1, y1, x2, y2, real = datasets.load_mnist()
+        assert real
+        numpy.testing.assert_allclose(x1, tr_x / 255.0)
+        numpy.testing.assert_array_equal(y1, tr_y)
+        numpy.testing.assert_allclose(x2, te_x / 255.0)
+        numpy.testing.assert_array_equal(y2, te_y)
+        assert not datasets.cifar10_available()
+    finally:
+        root.common.dirs.datasets = saved

@@ -275,8 +275,17 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         if self.slave_command:
             return self.slave_command % {
                 "master": self._master_endpoint()}
-        # default: re-run this process's command line as a slave
-        argv = [sys.executable] + list(sys.argv)
+        # default: re-run this process's command line as a slave.
+        # `python -m veles_tpu` runs show argv[0] as .../__main__.py —
+        # re-running that path directly would put the package dir (not
+        # the repo root) on sys.path and break `import veles_tpu` on
+        # non-installed checkouts; rebuild the -m form instead.
+        argv0 = list(sys.argv[:1])
+        if argv0 and os.path.basename(argv0[0]) == "__main__.py" and \
+                os.path.basename(os.path.dirname(
+                    os.path.abspath(argv0[0]))) == "veles_tpu":
+            argv0 = ["-m", "veles_tpu"]
+        argv = [sys.executable] + argv0 + list(sys.argv[1:])
         out, skip_one, skip_multi = [], False, False
         for arg in argv:
             if skip_one:

@@ -277,11 +277,19 @@ class SlaveStats(Plotter):
         self.demand("server")
 
     def fill(self):
+        import contextlib
         import time as _time
-        slaves = getattr(self.server, "slaves", {})
+        # snapshot under the server's lock — the loop thread mutates
+        # the dict as slaves join/leave mid-iteration otherwise
+        lock = getattr(self.server, "_lock", None)
+        with (lock if lock is not None else contextlib.nullcontext()):
+            items = sorted(getattr(self.server, "slaves", {}).items())
         now = _time.monotonic()
         rows = []
-        for sid, s in sorted(slaves.items()):
+        live = {sid for sid, _ in items}
+        for gone in set(self._last_) - live:
+            del self._last_[gone]
+        for sid, s in items:
             done = int(getattr(s, "jobs_done", 0))
             prev_t, prev_done = self._last_.get(sid, (None, 0))
             rate = ((done - prev_done) / (now - prev_t)) \

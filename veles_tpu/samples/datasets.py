@@ -19,7 +19,10 @@ from veles_tpu.logger import setup_logging  # noqa: F401
 
 
 def _dataset_dir():
-    return root.common.dirs.get("datasets", ".")
+    # VELES_DATASETS overrides everywhere (README documents it for the
+    # parity gates; bench.py's probe and the samples must agree)
+    return os.environ.get("VELES_DATASETS") \
+        or root.common.dirs.get("datasets", ".")
 
 
 def _read_idx(path):
@@ -32,9 +35,7 @@ def _read_idx(path):
     return data.reshape(dims)
 
 
-def load_mnist():
-    """(train_x, train_y, test_x, test_y) floats in [0,1] / int labels,
-    or synthetic 28×28 10-class stand-ins."""
+def _mnist_paths():
     base = os.path.join(_dataset_dir(), "mnist")
     names = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte",
              "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
@@ -45,7 +46,30 @@ def load_mnist():
             if os.path.exists(cand):
                 paths.append(cand)
                 break
-    if len(paths) == 4:
+    return paths if len(paths) == 4 else None
+
+
+def mnist_available():
+    """True when the real IDX files sit under
+    ``<root.common.dirs.datasets>/mnist/`` (path check only)."""
+    return _mnist_paths() is not None
+
+
+def cifar10_available():
+    """True when the real CIFAR-10 binary batches sit under
+    ``<root.common.dirs.datasets>/cifar-10-batches-bin/``."""
+    base = os.path.join(_dataset_dir(), "cifar-10-batches-bin")
+    batches = [os.path.join(base, "data_batch_%d.bin" % i)
+               for i in range(1, 6)]
+    return all(os.path.exists(p)
+               for p in batches + [os.path.join(base, "test_batch.bin")])
+
+
+def load_mnist():
+    """(train_x, train_y, test_x, test_y) floats in [0,1] / int labels,
+    or synthetic 28×28 10-class stand-ins."""
+    paths = _mnist_paths()
+    if paths:
         tr_x = _read_idx(paths[0]).astype(numpy.float32) / 255.0
         tr_y = _read_idx(paths[1]).astype(numpy.int64)
         te_x = _read_idx(paths[2]).astype(numpy.float32) / 255.0
