@@ -204,6 +204,69 @@ def stage_cifar():
                 cifar10.LAYERS, (32, 32, 3), 10, batch=1024, steps=20)
 
 
+def _e2e_loop(metric, loader, params, step, label_dtype="int32",
+              min_seconds=4.0, flops=None):
+    """Drive the REAL loader (shuffling, epoch bookkeeping,
+    device-resident gather, prefetch hooks) into the fused step and
+    measure whole-pipeline images/sec.  Long run + single final host
+    fetch: the fixed sync overhead amortizes instead of inflating.
+    The e2e number proves the input pipeline keeps up with the
+    synthetic-batch line (ref: the in-workflow benchmark unit,
+    ``/root/reference/veles/accelerated_units.py:706-825``)."""
+    import numpy as np
+
+    import jax
+    from veles_tpu.ops.timing import host_fetch, probe_of
+
+    def serve():
+        loader.run()
+        x = loader.minibatch_data.devmem
+        labels = jax.device_put(np.ascontiguousarray(
+            loader.minibatch_labels.mem.astype(label_dtype)))
+        return x, labels
+
+    x, labels = serve()                    # warm: compile + first fill
+    params, m = step(params, x, labels)
+    host_fetch(probe_of(params, m))
+    served = 0
+    tic = time.perf_counter()
+    while True:
+        x, labels = serve()
+        params, m = step(params, x, labels)
+        served += int(loader.minibatch_size)
+        if time.perf_counter() - tic >= min_seconds:
+            break
+    host_fetch(probe_of(params, m))        # real bytes end the clock
+    elapsed = time.perf_counter() - tic
+    _emit(metric, elapsed / (served / loader.max_minibatch_size),
+          loader.max_minibatch_size, flops)
+
+
+def stage_mnist_e2e():
+    """End-to-end framework stage: MnistSimple through the REAL
+    StandardWorkflow loader feeding the fused step."""
+    import jax
+    from veles_tpu import prng
+    from veles_tpu.samples import mnist
+    from veles_tpu.znicz.fused import lower_workflow
+
+    from veles_tpu.ops.timing import cost_flops
+
+    prng.seed_all(1234)
+    batch = 8192
+    wf = mnist.create_workflow(max_epochs=10 ** 6,
+                               minibatch_size=batch)
+    params, step_fn = lower_workflow(wf)
+    # ONE compile serves both the flops readout and the timed loop
+    compiled = jax.jit(step_fn, donate_argnums=(0,)).lower(
+        params, wf.loader.minibatch_data.mem,
+        wf.loader.minibatch_labels.mem.astype("int32")).compile()
+    params = jax.device_put(params)
+    _e2e_loop("MNIST784 MLP end-to-end workflow throughput "
+              "(loader+prefetch+fused step)", wf.loader, params,
+              compiled, flops=cost_flops(compiled))
+
+
 def stage_alexnet():
     from veles_tpu.samples import alexnet
     _conv_stage(
@@ -215,8 +278,9 @@ def stage_alexnet():
 STAGES = {
     "probe": (stage_probe, 180),
     "mnist": (stage_mnist, 150),
+    "mnist_e2e": (stage_mnist_e2e, 240),
     "cifar": (stage_cifar, 210),
-    "alexnet": (stage_alexnet, 330),
+    "alexnet": (stage_alexnet, 480),
 }
 
 
@@ -291,7 +355,7 @@ def main():
     print("probe ok: %s" % json.dumps(probe), file=sys.stderr)
 
     printed_any = False
-    for name in ("mnist", "cifar", "alexnet"):
+    for name in ("mnist", "mnist_e2e", "cifar", "alexnet"):
         if only and name not in only:
             continue
         _fn, cap = STAGES[name]

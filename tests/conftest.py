@@ -5,6 +5,11 @@ sharding tests exercise real multi-device code paths without TPU hardware
 
 import os
 
+# Preserved for tests that deliberately escape the CPU pin via a
+# subprocess (test_accuracy_parity.py trains on the real accelerator).
+ORIG_JAX_PLATFORMS = os.environ.get("JAX_PLATFORMS")
+ORIG_XLA_FLAGS = os.environ.get("XLA_FLAGS", "")
+
 # Hard-set (the session env may point at a real TPU via an "axon" tunnel
 # platform; tests must run on the virtual CPU mesh regardless).
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -29,3 +34,20 @@ def _seed_prng():
     from veles_tpu import prng
     prng.seed_all(1234)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _pin_synthetic_data(request, tmp_path, monkeypatch):
+    """Short sample runs everywhere in the suite were calibrated on the
+    synthetic stand-ins; a machine provisioned with real datasets (for
+    test_accuracy_parity.py, which opts out) must not silently switch
+    them onto real data."""
+    if request.module.__name__ == "test_accuracy_parity":
+        yield
+        return
+    from veles_tpu.config import root
+    monkeypatch.delenv("VELES_DATASETS", raising=False)
+    saved = root.common.dirs.get("datasets")
+    root.common.dirs.datasets = str(tmp_path / "no-datasets-here")
+    yield
+    root.common.dirs.datasets = saved

@@ -5,18 +5,8 @@ import pytest
 
 from veles_tpu.backends import CPUDevice, NumpyDevice
 
-
-@pytest.fixture(autouse=True)
-def _pin_synthetic_data(tmp_path, monkeypatch):
-    """These bars were calibrated on the synthetic stand-ins; a machine
-    provisioned with real datasets (for test_accuracy_parity.py) must
-    not silently switch these short runs onto real data."""
-    from veles_tpu.config import root
-    monkeypatch.delenv("VELES_DATASETS", raising=False)
-    saved = root.common.dirs.get("datasets")
-    root.common.dirs.datasets = str(tmp_path / "no-datasets-here")
-    yield
-    root.common.dirs.datasets = saved
+# the synthetic-data pin lives in conftest.py (_pin_synthetic_data,
+# suite-wide autouse) so every short sample run stays on the stand-ins
 
 
 def test_mnist_sample_trains():

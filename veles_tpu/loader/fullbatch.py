@@ -104,17 +104,25 @@ class FullBatchLoader(Loader):
         else:
             self.minibatch_data.map_write()
             data = self.original_data.mem
-            for i, idx in enumerate(indices):
-                self.minibatch_data.mem[i] = data[idx] if idx >= 0 else 0
+            idx = numpy.asarray(indices)
+            valid = idx >= 0
+            gathered = data[numpy.where(valid, idx, 0)]
+            mask = valid.reshape((-1,) + (1,) * (data.ndim - 1))
+            self.minibatch_data.mem[...] = numpy.where(mask, gathered, 0)
         if self.has_labels:
             self.minibatch_labels.map_write()
-            labels = self._mapped_labels
-            for i, idx in enumerate(indices):
-                self.minibatch_labels.mem[i] = labels[idx] if idx >= 0 \
-                    else -1
-            for i, idx in enumerate(indices[:count]):
-                self.raw_minibatch_labels[i] = self.original_labels[idx] \
-                    if idx >= 0 else None
+            idx = numpy.asarray(indices)
+            valid = idx >= 0
+            self.minibatch_labels.mem[...] = numpy.where(
+                valid, self._mapped_labels[numpy.where(valid, idx, 0)],
+                -1)
+            if not self.labels_mapping:
+                # raw labels only feed mapping analysis; per-step python
+                # loops here would host-bound the serving pipeline
+                for i, index in enumerate(indices[:count]):
+                    self.raw_minibatch_labels[i] = \
+                        self.original_labels[index] if index >= 0 \
+                        else None
 
     def pad_minibatch(self, minibatch_size):
         """No-op: fill_minibatch gathers with -1 markers which zero/-1
@@ -165,6 +173,9 @@ class FullBatchLoaderMSE(FullBatchLoader):
         else:
             self.minibatch_targets.map_write()
             targets = self.original_targets.mem
-            for i, idx in enumerate(indices):
-                self.minibatch_targets.mem[i] = targets[idx] if idx >= 0 \
-                    else 0
+            idx = numpy.asarray(indices)
+            valid = idx >= 0
+            gathered = targets[numpy.where(valid, idx, 0)]
+            mask = valid.reshape((-1,) + (1,) * (targets.ndim - 1))
+            self.minibatch_targets.mem[...] = numpy.where(
+                mask, gathered, 0)

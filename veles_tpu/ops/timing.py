@@ -56,6 +56,18 @@ def probe_of(params, metric):
     return jnp.stack([_first_scalar(metric), _first_scalar(params)])
 
 
+def cost_flops(compiled):
+    """Total FLOPs of a compiled executable per XLA's own cost
+    analysis, or None when unavailable."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
 def make_multi_step(step_fn, k):
     """Wrap ``step_fn(params, x, labels) -> (params, metric)`` into a
     function running ``k`` steps inside one XLA program.
@@ -131,13 +143,8 @@ def measure_fused_step(step_fn, params, x, labels, k=20, min_seconds=2.0,
     multi = make_multi_step(step_fn, k)
     jitted = jax.jit(multi, donate_argnums=(0,) if donate else ())
     compiled = jitted.lower(params, x, labels).compile()
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops = (float(ca.get("flops", 0.0)) / k) or None
-    except Exception:
-        flops = None
+    total = cost_flops(compiled)
+    flops = (total / k) if total else None
 
     state = {"params": params}
 

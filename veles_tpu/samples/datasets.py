@@ -55,14 +55,19 @@ def mnist_available():
     return _mnist_paths() is not None
 
 
-def cifar10_available():
-    """True when the real CIFAR-10 binary batches sit under
-    ``<root.common.dirs.datasets>/cifar-10-batches-bin/``."""
+def _cifar10_paths():
     base = os.path.join(_dataset_dir(), "cifar-10-batches-bin")
     batches = [os.path.join(base, "data_batch_%d.bin" % i)
                for i in range(1, 6)]
-    return all(os.path.exists(p)
-               for p in batches + [os.path.join(base, "test_batch.bin")])
+    test = os.path.join(base, "test_batch.bin")
+    return (batches, test) if all(
+        os.path.exists(p) for p in batches + [test]) else None
+
+
+def cifar10_available():
+    """True when the real CIFAR-10 binary batches sit under
+    ``<root.common.dirs.datasets>/cifar-10-batches-bin/``."""
+    return _cifar10_paths() is not None
 
 
 def load_mnist():
@@ -79,11 +84,9 @@ def load_mnist():
 
 
 def load_cifar10():
-    base = os.path.join(_dataset_dir(), "cifar-10-batches-bin")
-    batches = [os.path.join(base, "data_batch_%d.bin" % i)
-               for i in range(1, 6)]
-    test = os.path.join(base, "test_batch.bin")
-    if all(os.path.exists(p) for p in batches + [test]):
+    found = _cifar10_paths()
+    if found:
+        batches, test = found
         def read(path):
             raw = numpy.fromfile(path, dtype=numpy.uint8).reshape(
                 -1, 3073)
