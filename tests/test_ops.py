@@ -292,3 +292,101 @@ def test_timing_multi_step_and_marginal():
 
     per = marginal_time(call, min_seconds=0.01)
     assert per > 0
+
+
+def test_autotune_db_drives_dispatch(tmp_path, monkeypatch):
+    """The device-infos DB decides matmul dispatch: a committed entry
+    flips pallas on (with its tiles) or keeps XLA, per device
+    generation and dtype (ref devices/device_infos.json,
+    backends.py:623-744)."""
+    import json as _json
+
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.ops import benchmark, gemm
+    from veles_tpu.config import root
+
+    model = jax.devices()[0].device_kind
+    db_path = tmp_path / "device_infos.json"
+    db_path.write_text(_json.dumps({model: {"gemm": {
+        "float32": {"sec_per_flop": 1e-12, "backend": "pallas",
+                    "tiles": [256, 256, 256]},
+        "bfloat16": {"sec_per_flop": 1e-12, "backend": "xla",
+                     "tiles": None},
+    }}}))
+    monkeypatch.setattr(benchmark, "DEVICE_INFOS_JSON", str(db_path))
+    benchmark.gemm_choice.cache_clear()
+    try:
+        assert benchmark.gemm_choice(jnp.float32) == \
+            ("pallas", (256, 256, 256))
+        assert benchmark.gemm_choice(jnp.bfloat16) == ("xla", None)
+        assert benchmark.gemm_choice(jnp.float64) is None
+        assert benchmark.tiles_for_gemm(jnp.float32) == (256, 256, 256)
+        on_tpu = jax.devices()[0].platform == "tpu"
+        # dispatch honors the DB on TPU; CPU never picks pallas from it
+        on, tiles = gemm._dispatch(None, None, jnp.float32)
+        assert on == on_tpu
+        if on_tpu:
+            assert tiles == (256, 256, 256)   # DB tiles flow through
+        # explicitly forced pallas still uses the DB's measured tiles
+        root.common.engine.pallas_gemm = True
+        try:
+            on, tiles = gemm._dispatch(None, None, jnp.float32)
+            assert on == on_tpu
+            assert tiles == (256, 256, 256)
+        finally:
+            root.common.engine.pallas_gemm = None
+        # a caller's explicit tiles beat the DB's
+        assert gemm._dispatch(True, (128, 128, 128), jnp.float32) == \
+            (True, (128, 128, 128))
+        # legacy entries (no "backend" key) must NOT flip dispatch to
+        # pallas — their sweep never measured the XLA baseline
+        db = _json.loads(db_path.read_text())
+        db[model]["gemm"]["float32"].pop("backend")
+        db_path.write_text(_json.dumps(db))
+        benchmark.gemm_choice.cache_clear()
+        assert benchmark.gemm_choice(jnp.float32) == \
+            ("xla", (256, 256, 256))
+        # flash-attention reads its own kernel entry: blocks AND the
+        # backend verdict
+        db[model]["flash_attention"] = {"bfloat16": {
+            "sec_per_flop": 1e-12, "backend": "xla",
+            "tiles": None}}
+        db_path.write_text(_json.dumps(db))
+        benchmark.gemm_choice.cache_clear()
+        from veles_tpu.ops.attention import (
+            _resolve_backend, _resolve_blocks)
+        assert _resolve_backend(None, jnp.bfloat16) is False
+        assert _resolve_backend(True, jnp.bfloat16) is True
+        db[model]["flash_attention"]["bfloat16"] = {
+            "sec_per_flop": 1e-12, "backend": "pallas",
+            "tiles": [256, 512]}
+        db_path.write_text(_json.dumps(db))
+        benchmark.gemm_choice.cache_clear()
+        assert _resolve_blocks(None, None, jnp.bfloat16) == (256, 512)
+        assert _resolve_blocks(64, None, jnp.bfloat16) == (64, 512)
+        assert _resolve_blocks(None, None, jnp.float32) == (128, 128)
+        assert _resolve_backend(None, jnp.bfloat16) == on_tpu
+    finally:
+        benchmark.gemm_choice.cache_clear()
+
+
+def test_autotune_gemm_writes_db(tmp_path):
+    """The sweep itself (tiny shapes, CPU): produces a DB whose entry
+    has backend/tiles/sec_per_flop and that gemm_choice can read
+    back."""
+    import jax
+
+    from veles_tpu.ops import benchmark
+
+    info = benchmark.autotune_gemm(
+        shapes=((64, 64, 64),), dtypes=("float32",),
+        candidates=((64, 64, 64),), runs=1,
+        db_path=str(tmp_path / "db.json"))
+    entry = info.ratings["gemm"]["float32"]
+    assert entry["backend"] in ("pallas", "xla")
+    assert entry["sec_per_flop"] > 0
+    choice = benchmark.gemm_choice(
+        "float32", db_path=str(tmp_path / "db.json"))
+    assert choice is not None

@@ -208,12 +208,15 @@ def _bwd_blockwise(res, do, causal, block_k):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
+def flash_attention(q, k, v, causal=False, block_q=None, block_k=None,
                     use_pallas=None):
     """Tiled attention ``softmax(q·kᵀ/√d)·v`` over (b, s, h, d) tensors.
 
-    ``use_pallas``: force the kernel choice; default auto — the Pallas
-    kernel on TPU, the XLA-fused fallback elsewhere.
+    ``block_q``/``block_k`` default to the autotune DB's measured blocks
+    for this device generation (``ops.benchmark.gemm_choice`` with
+    kernel="flash_attention"), falling back to 128.  ``use_pallas``:
+    force the kernel choice; default auto — the Pallas kernel on TPU,
+    the XLA-fused fallback elsewhere.
     """
     o, _lse = _fwd_impl(q, k, v, causal, block_q, block_k, use_pallas)
     return o
@@ -226,8 +229,42 @@ def _on_tpu():
         return False
 
 
+def _db_choice(dtype):
+    try:
+        from veles_tpu.ops.benchmark import gemm_choice
+        return gemm_choice(dtype, kernel="flash_attention")
+    except Exception:
+        return None
+
+
+def _resolve_blocks(block_q, block_k, dtype):
+    """Caller-supplied blocks win; else the autotune DB's measured
+    blocks for this device generation; else 128s.  Trace-time only."""
+    if block_q is None or block_k is None:
+        choice = _db_choice(dtype)
+        db = choice[1] if choice else None
+        if db:
+            block_q = block_q or int(db[0])
+            block_k = block_k or int(db[1])
+    return block_q or 128, block_k or 128
+
+
+def _resolve_backend(use_pallas, dtype):
+    """Explicit arg > the autotune DB's measured winner for this
+    device generation > Pallas-on-TPU default."""
+    if use_pallas is not None:
+        return use_pallas
+    if not _on_tpu():
+        return False
+    choice = _db_choice(dtype)
+    if choice is not None:
+        return choice[0] == "pallas"
+    return True
+
+
 def _fwd_impl(q, k, v, causal, block_q, block_k, use_pallas):
-    pallas = use_pallas if use_pallas is not None else _on_tpu()
+    block_q, block_k = _resolve_blocks(block_q, block_k, q.dtype)
+    pallas = _resolve_backend(use_pallas, q.dtype)
     if pallas:
         from veles_tpu.config import root
         o, lse = _flash_fwd(
@@ -244,6 +281,7 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, use_pallas):
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, use_pallas, res, do):
+    _bq, block_k = _resolve_blocks(block_q, block_k, res[0].dtype)
     return _bwd_blockwise(res, do, causal, block_k)
 
 

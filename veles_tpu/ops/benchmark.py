@@ -12,6 +12,9 @@ across the fleet for job balancing) plus a tile search over the Pallas
 GEMM, persisted in the same DB schema.
 """
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy
@@ -75,13 +78,20 @@ def estimate_device_power(device=None, size=BENCH_SIZE, chain=BENCH_CHAIN,
 def autotune_gemm(shapes=((4096, 4096, 4096),), dtypes=("bfloat16",
                                                         "float32"),
                   candidates=TILE_CANDIDATES, runs=2, save=True,
-                  db_path=DEVICE_INFOS_JSON):
-    """Measure each tile candidate on the attached backend; store the best
-    per dtype in the DeviceInfo DB (ref ``_find_optimal_bs_vo``
-    ``backends.py:672``)."""
+                  db_path=None):
+    """Measure each Pallas tile candidate AND the plain-XLA dot on the
+    attached backend; store the winner per dtype in the DeviceInfo DB
+    (ref ``_find_optimal_bs_vo`` ``backends.py:672``).
+
+    The stored entry decides dispatch:
+    ``{"backend": "pallas"|"xla", "tiles": [...]|None, "sec_per_flop"}``
+    — consulted by :func:`gemm_choice` / ``ops.gemm.matmul``."""
+    db_path = db_path or DEVICE_INFOS_JSON
     model = jax.devices()[0].device_kind
     db = DeviceInfo.load_db(db_path)
     info = db.setdefault(model, DeviceInfo(model))
+    # None = the XLA baseline (jnp.dot path) competing with every tiling
+    all_candidates = tuple(candidates) + (None,)
     for dtype_name in dtypes:
         dtype = jnp.dtype(dtype_name)
         # Aggregate flops-normalized time per candidate over ALL shapes —
@@ -91,13 +101,14 @@ def autotune_gemm(shapes=((4096, 4096, 4096),), dtypes=("bfloat16",
             a = jnp.ones((m, k), dtype)
             b = jnp.ones((k, n), dtype)
             flops = 2.0 * m * k * n
-            for tiles in candidates:
+            for tiles in all_candidates:
                 try:
                     # probe scalar + marginal timing: honest sync
                     # through transports where block_until_ready lies
                     # (see ops/timing.py)
                     fn = jax.jit(lambda x, y, t=tiles: matmul(
-                        x, y, tiles=t, use_pallas=True)[0, 0]
+                        x, y, tiles=t,
+                        use_pallas=t is not None)[0, 0]
                         .astype(jnp.float32))
                     host_fetch(fn(a, b))    # compile + warm
 
@@ -116,24 +127,121 @@ def autotune_gemm(shapes=((4096, 4096, 4096),), dtypes=("bfloat16",
                     totals[tiles] = totals.get(tiles, 0.0) \
                         + elapsed / flops
         if totals:
-            best_tiles = min(totals, key=totals.get)
+            best = min(totals, key=totals.get)
             info.ratings.setdefault("gemm", {})[dtype_name] = {
-                "sec_per_flop": totals[best_tiles] / len(shapes),
-                "tiles": list(best_tiles)}
+                "sec_per_flop": totals[best] / len(shapes),
+                "backend": "xla" if best is None else "pallas",
+                "tiles": None if best is None else list(best)}
     if save:
         DeviceInfo.save_db(db, db_path)
+    gemm_choice.cache_clear()
     return info
 
 
-def tiles_for_gemm(dtype, db_path=DEVICE_INFOS_JSON):
-    """Look up autotuned tiles for the current device, or None."""
-    try:
-        model = jax.devices()[0].device_kind
-    except RuntimeError:
-        return None
+@functools.lru_cache(maxsize=64)
+def _choice_cached(kernel, model, dtype_name, db_path, _mtime):
     db = DeviceInfo.load_db(db_path)
     info = db.get(model)
     if info is None:
         return None
-    tiles = info.get_kernel_tiles("gemm", numpy.dtype(str(dtype)))
-    return tuple(tiles) if tiles else None
+    entry = info.ratings.get(kernel, {}).get(dtype_name)
+    if not entry:
+        return None
+    tiles = entry.get("tiles")
+    # entries written before the sweep included the XLA baseline carry
+    # no "backend": their tiles were only compared against other Pallas
+    # tilings, so they must NOT flip dispatch away from XLA — the tiles
+    # remain available for a config-forced Pallas run
+    return (entry.get("backend", "xla"),
+            tuple(tiles) if tiles else None)
+
+
+def gemm_choice(dtype, db_path=None, kernel="gemm"):
+    """Autotuned dispatch decision for the current device:
+    ``("pallas", (bm, bk, bn))`` / ``("xla", None)`` / ``None`` when the
+    DB has no entry for this device generation.  Cached on the DB
+    file's mtime so training steps never re-read JSON."""
+    db_path = db_path or DEVICE_INFOS_JSON
+    try:
+        model = jax.devices()[0].device_kind
+    except RuntimeError:
+        return None
+    try:
+        mtime = os.path.getmtime(db_path)
+    except OSError:
+        return None
+    return _choice_cached(kernel, model, numpy.dtype(dtype).name,
+                          db_path, mtime)
+
+
+gemm_choice.cache_clear = _choice_cached.cache_clear
+
+
+def tiles_for_gemm(dtype, db_path=None):
+    """Look up autotuned Pallas tiles for the current device, or None."""
+    choice = gemm_choice(dtype, db_path=db_path)
+    return choice[1] if choice else None
+
+
+#: (block_q, block_k) flash-attention sweep — VMEM-bounded MXU tilings
+ATTN_BLOCK_CANDIDATES = (
+    (128, 128), (128, 256), (256, 128), (256, 256),
+    (512, 256), (256, 512), (512, 512),
+)
+
+
+def autotune_flash_attention(shape=(4, 2048, 8, 128),
+                             dtypes=("bfloat16",),
+                             candidates=ATTN_BLOCK_CANDIDATES, runs=2,
+                             causal=True, save=True, db_path=None):
+    """Sweep flash-attention block sizes (plus the XLA-fused baseline)
+    on the attached chip; persist the winner under kernel
+    ``flash_attention`` so :func:`veles_tpu.ops.attention.flash_attention`
+    picks it up by default."""
+    from veles_tpu.ops.attention import flash_attention
+
+    db_path = db_path or DEVICE_INFOS_JSON
+    model = jax.devices()[0].device_kind
+    db = DeviceInfo.load_db(db_path)
+    info = db.setdefault(model, DeviceInfo(model))
+    b, s, h, d = shape
+    flops = 4.0 * b * h * s * s * d * (0.5 if causal else 1.0)
+    all_candidates = tuple(candidates) + (None,)   # None = XLA baseline
+    for dtype_name in dtypes:
+        dtype = jnp.dtype(dtype_name)
+        key = jax.random.key(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, shape, jnp.float32).astype(dtype)
+        k = jax.random.normal(kk, shape, jnp.float32).astype(dtype)
+        v = jax.random.normal(kv, shape, jnp.float32).astype(dtype)
+        totals = {}
+        for blocks in all_candidates:
+            try:
+                bq, bk = blocks if blocks else (None, None)
+                fn = jax.jit(lambda a, c, e, _bq=bq, _bk=bk,
+                             _p=blocks is not None: flash_attention(
+                                 a, c, e, causal=causal, block_q=_bq,
+                                 block_k=_bk, use_pallas=_p)
+                             [0, 0, 0, 0].astype(jnp.float32))
+                host_fetch(fn(q, k, v))          # compile + warm
+
+                def call(sync=False, _fn=fn):
+                    r = _fn(q, k, v)
+                    if sync:
+                        host_fetch(r)
+
+                totals[blocks] = min(
+                    marginal_time(call, min_seconds=0.25)
+                    for _ in range(max(runs, 1)))
+            except Exception:
+                totals.pop(blocks, None)
+        if totals:
+            best = min(totals, key=totals.get)
+            info.ratings.setdefault("flash_attention", {})[dtype_name] \
+                = {"sec_per_flop": totals[best] / flops,
+                   "backend": "xla" if best is None else "pallas",
+                   "tiles": None if best is None else list(best)}
+    if save:
+        DeviceInfo.save_db(db, db_path)
+    gemm_choice.cache_clear()
+    return info

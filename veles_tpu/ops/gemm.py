@@ -25,8 +25,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-#: default tiles — MXU-aligned; overridden by the autotune DB
-#: (measured on TPU v5e: 512³ ≈ 50 TFLOPs bf16, the best of the sweep)
+#: fallback tiles when neither the caller nor the autotune DB
+#: (``ops.benchmark.autotune_gemm`` → ``devices/device_infos.json``)
+#: supplies measured ones — MXU-aligned, nothing more
 DEFAULT_TILES = (512, 512, 512)   # (bm, bk, bn)
 
 
@@ -136,25 +137,37 @@ def matmul(a, b, bias=None, activation=None, tiles=None, use_pallas=None):
     return _matmul_fwd(a, b, bias, activation, tiles, use_pallas)[0]
 
 
-def _dispatch(use_pallas):
-    if use_pallas is None:
-        # Default OFF: measured on v5e, XLA's own GEMM slightly outruns the
-        # best Pallas tiling for plain matmuls (55 vs 50 TFLOPs bf16) and
-        # fuses the same epilogues — being TPU-first means letting XLA
-        # keep this op unless the autotune DB proves otherwise for a
-        # device generation (flip via root.common.engine.pallas_gemm).
-        from veles_tpu.config import root
-        from veles_tpu.ops import on_tpu
-        return bool(root.common.engine.get("pallas_gemm", False)) \
-            and on_tpu()
-    return use_pallas
+def _dispatch(use_pallas, tiles, dtype):
+    """(use_pallas_bool, tiles) for this call.  Priority: explicit
+    ``use_pallas`` arg > explicit ``root.common.engine.pallas_gemm``
+    config > the autotune DB's measured winner for this device
+    generation (``ops.benchmark.gemm_choice``) > XLA.  This runs at
+    TRACE time only (jit caches the result), so the DB lookup costs
+    nothing per step."""
+    from veles_tpu.ops.benchmark import gemm_choice
+    choice = None if use_pallas is False else gemm_choice(dtype)
+    db_tiles = choice[1] if choice else None
+    if use_pallas is not None:
+        # explicit choice still benefits from measured tiles
+        return use_pallas, tiles or db_tiles
+    from veles_tpu.config import root
+    from veles_tpu.ops import on_tpu
+    configured = root.common.engine.get("pallas_gemm", None)
+    if configured is not None:
+        return bool(configured) and on_tpu(), tiles or db_tiles
+    if not on_tpu() or choice is None:
+        # no measurement for this generation: XLA's GEMM is the safe
+        # default (run scripts/autotune.py on the chip to decide)
+        return False, tiles
+    return choice[0] == "pallas", tiles or db_tiles
 
 
 def _matmul_fwd(a, b, bias, activation, tiles, use_pallas):
-    if _dispatch(use_pallas):
+    pallas, eff_tiles = _dispatch(use_pallas, tiles, a.dtype)
+    if pallas:
         from veles_tpu.config import root
         out = _matmul_pallas(
-            a, b, bias, activation=activation, tiles=tiles,
+            a, b, bias, activation=activation, tiles=eff_tiles,
             interpret=bool(root.common.engine.get("interpret", False)))
     else:
         out = _matmul_jnp(a, b, bias, activation=activation)

@@ -1,0 +1,75 @@
+"""Fill the per-device-generation performance DB on the attached chip.
+
+One command on real hardware:
+
+    python -m veles_tpu.scripts.autotune [--db PATH] [--quick]
+
+runs the device-power rating (13-chain matmul, ref
+``accelerated_units.py:706-825``), the Pallas-vs-XLA GEMM tile sweep and
+the flash-attention block sweep, and persists the winners to
+``veles_tpu/devices/device_infos.json`` (ref
+``/root/reference/devices/device_infos.json``, filled by
+``backends.py:623-744``).  ``ops.gemm.matmul`` and
+``ops.attention.flash_attention`` consult the DB by default; commit the
+file so the whole fleet benefits.
+"""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--db", default=None,
+                        help="DB path (default: the packaged "
+                             "devices/device_infos.json)")
+    parser.add_argument("--quick", action="store_true",
+                        help="smaller shapes / fewer runs (smoke test)")
+    parser.add_argument("--skip-power", action="store_true")
+    parser.add_argument("--skip-gemm", action="store_true")
+    parser.add_argument("--skip-attention", action="store_true")
+    args = parser.parse_args(argv)
+
+    import jax
+    from veles_tpu.backends import DEVICE_INFOS_JSON, DeviceInfo
+    from veles_tpu.ops import benchmark
+
+    db_path = args.db or DEVICE_INFOS_JSON
+    model = jax.devices()[0].device_kind
+    print("autotuning on %r → %s" % (model, db_path), file=sys.stderr)
+
+    if not args.skip_power:
+        sec, gflops = benchmark.estimate_device_power(
+            size=1024 if args.quick else benchmark.BENCH_SIZE,
+            runs=1 if args.quick else 3)
+        db = DeviceInfo.load_db(db_path)
+        info = db.setdefault(model, DeviceInfo(model))
+        info.ratings["power"] = {"chain_seconds": sec, "gflops": gflops}
+        DeviceInfo.save_db(db, db_path)
+        print("power: %.4f s/chain = %.0f GFLOPs" % (sec, gflops),
+              file=sys.stderr)
+
+    if not args.skip_gemm:
+        shapes = ((1024, 1024, 1024),) if args.quick else \
+            ((4096, 4096, 4096), (8192, 2048, 4096))
+        info = benchmark.autotune_gemm(
+            shapes=shapes, runs=1 if args.quick else 2, db_path=db_path)
+        print("gemm: %s" % json.dumps(info.ratings.get("gemm", {})),
+              file=sys.stderr)
+
+    if not args.skip_attention:
+        shape = (2, 512, 4, 64) if args.quick else (4, 2048, 8, 128)
+        info = benchmark.autotune_flash_attention(
+            shape=shape, runs=1 if args.quick else 2, db_path=db_path)
+        print("flash_attention: %s" % json.dumps(
+            info.ratings.get("flash_attention", {})), file=sys.stderr)
+
+    db = DeviceInfo.load_db(db_path)
+    print(json.dumps({m: i.ratings for m, i in db.items()}, indent=2,
+                     sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
