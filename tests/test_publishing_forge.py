@@ -44,12 +44,12 @@ class TestPublishing:
     def test_publisher_writes_all_backends(self, tmp_path):
         wf = _workflow()
         pub = Publisher(wf, backends=("markdown", "html", "ipynb",
-                                      "confluence"),
+                                      "confluence", "pdf"),
                         out_dir=str(tmp_path),
                         description="Smoke-test report.")
         pub.initialize()
         pub.run()
-        assert len(pub.published) == 4
+        assert len(pub.published) == 5
         md = open(pub.published[0]).read()
         assert "accuracy | 0.97" in md.replace("| accuracy | 0.97 |",
                                                "accuracy | 0.97")
@@ -62,6 +62,9 @@ class TestPublishing:
                    for c in nb["cells"])
         confluence = open(pub.published[3]).read()
         assert "||Metric||Value||" in confluence
+        pdf = open(pub.published[4], "rb").read()
+        assert pdf.startswith(b"%PDF-")
+        assert len(pdf) > 1000
 
     def test_publisher_rejects_unknown_backend(self):
         wf = _workflow()

@@ -99,3 +99,93 @@ def test_forge_cli_round_trip(tmp_path):
                      "--token", "t"]) == 0
     finally:
         server.stop()
+
+
+class TestBBoxer:
+    """Image bbox labeling tool (ref ``veles/scripts/bboxer.py``):
+    selections persist as <image>.json sidecars; concurrent edits
+    conflict (403) unless overwritten."""
+
+    @staticmethod
+    def _start(tmp_path):
+        import asyncio
+        import threading
+
+        from veles_tpu.scripts.bboxer import make_app
+
+        # a tiny but valid PNG
+        png = bytes.fromhex(
+            "89504e470d0a1a0a0000000d49484452000000010000000108060000001f"
+            "15c4890000000d49444154789c6260606060000000050001a5f645400000"
+            "000049454e44ae426082")
+        (tmp_path / "img.png").write_bytes(png)
+        ready = threading.Event()
+        state = {}
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            import tornado.ioloop
+            app = make_app(str(tmp_path))
+            server = app.listen(0)
+            state["port"] = list(server._sockets.values())[0]\
+                .getsockname()[1]
+            state["ioloop"] = tornado.ioloop.IOLoop.current()
+            ready.set()
+            state["ioloop"].start()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert ready.wait(10)
+        return state
+
+    def test_sidecar_roundtrip_and_conflict(self, tmp_path):
+        import json as _json
+        import urllib.request
+        import urllib.error
+
+        state = self._start(tmp_path)
+        base = "http://127.0.0.1:%d" % state["port"]
+
+        def post(path, payload):
+            req = urllib.request.Request(
+                base + path, data=_json.dumps(payload).encode())
+            with urllib.request.urlopen(req) as resp:
+                return resp.read()
+
+        # empty selections initially
+        assert _json.loads(post("/selections", {"file": "img.png"})) \
+            == []
+        boxes = [{"x": 1, "y": 2, "w": 3, "h": 4, "label": "cat"}]
+        post("/update", {"file": "img.png", "selections": boxes,
+                         "overwrite": False})
+        assert _json.loads((tmp_path / "img.png.json").read_text()) \
+            == boxes
+        assert _json.loads(post("/selections", {"file": "img.png"})) \
+            == boxes
+        # conflicting non-overwrite update → 403
+        other = [{"x": 9, "y": 9, "w": 1, "h": 1, "label": "dog"}]
+        try:
+            post("/update", {"file": "img.png", "selections": other,
+                             "overwrite": False})
+            raise AssertionError("conflict not rejected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+        # overwrite wins
+        post("/update", {"file": "img.png", "selections": other,
+                         "overwrite": True})
+        assert _json.loads((tmp_path / "img.png.json").read_text()) \
+            == other
+        # path traversal rejected
+        try:
+            post("/selections", {"file": "../escape.png"})
+            raise AssertionError("traversal not rejected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+        # the index page lists the image and serves it back
+        with urllib.request.urlopen(base + "/") as resp:
+            page = resp.read().decode()
+        assert 'data-f="img.png"' in page   # clickable via delegation
+        with urllib.request.urlopen(base + "/image/img.png") as resp:
+            assert resp.read().startswith(b"\x89PNG")
+        state["ioloop"].add_callback(state["ioloop"].stop)

@@ -103,19 +103,23 @@ def autotune_gemm(shapes=((4096, 4096, 4096),), dtypes=("bfloat16",
             flops = 2.0 * m * k * n
             for tiles in all_candidates:
                 try:
-                    # probe scalar + marginal timing: honest sync
-                    # through transports where block_until_ready lies
-                    # (see ops/timing.py)
-                    fn = jax.jit(lambda x, y, t=tiles: matmul(
-                        x, y, tiles=t,
-                        use_pallas=t is not None)[0, 0]
-                        .astype(jnp.float32))
-                    host_fetch(fn(a, b))    # compile + warm
+                    # full product stays a program output so XLA cannot
+                    # sink the probe slice through the dot and elide the
+                    # baseline's work (same guard as
+                    # estimate_device_power); sync = host fetch of the
+                    # probe's bytes (see ops/timing.py)
+                    def work(x, y, t=tiles):
+                        out = matmul(x, y, tiles=t,
+                                     use_pallas=t is not None)
+                        return out, out[0, 0].astype(jnp.float32)
+
+                    fn = jax.jit(work)
+                    host_fetch(fn(a, b)[1])    # compile + warm
 
                     def call(sync=False, _fn=fn):
-                        r = _fn(a, b)
+                        _out, probe = _fn(a, b)
                         if sync:
-                            host_fetch(r)
+                            host_fetch(probe)
 
                     elapsed = min(
                         marginal_time(call, min_seconds=0.25)
@@ -218,17 +222,23 @@ def autotune_flash_attention(shape=(4, 2048, 8, 128),
         for blocks in all_candidates:
             try:
                 bq, bk = blocks if blocks else (None, None)
-                fn = jax.jit(lambda a, c, e, _bq=bq, _bk=bk,
-                             _p=blocks is not None: flash_attention(
-                                 a, c, e, causal=causal, block_q=_bq,
-                                 block_k=_bk, use_pallas=_p)
-                             [0, 0, 0, 0].astype(jnp.float32))
-                host_fetch(fn(q, k, v))          # compile + warm
+
+                # full output stays a program output so XLA cannot
+                # slice the baseline down to one attention row
+                def work(a, c, e, _bq=bq, _bk=bk,
+                         _p=blocks is not None):
+                    o = flash_attention(a, c, e, causal=causal,
+                                        block_q=_bq, block_k=_bk,
+                                        use_pallas=_p)
+                    return o, o[0, 0, 0, 0].astype(jnp.float32)
+
+                fn = jax.jit(work)
+                host_fetch(fn(q, k, v)[1])       # compile + warm
 
                 def call(sync=False, _fn=fn):
-                    r = _fn(q, k, v)
+                    _o, probe = _fn(q, k, v)
                     if sync:
-                        host_fetch(r)
+                        host_fetch(probe)
 
                 totals[blocks] = min(
                     marginal_time(call, min_seconds=0.25)

@@ -184,3 +184,37 @@ class IpynbBackend(Backend):
             "nbformat": 4,
             "nbformat_minor": 5,
         }, indent=1)
+
+
+@register_backend
+class PdfBackend(Backend):
+    """PDF via matplotlib's PdfPages (the reference shelled out to
+    LaTeX, absent in this image; matplotlib ships with the plotting
+    stack and renders everywhere)."""
+
+    MAPPING = "pdf"
+    SUFFIX = ".pdf"
+    LINES_PER_PAGE = 55
+
+    def render(self, info):
+        # the paginated source text; publish() turns it into PDF bytes
+        return _MD_TEMPLATE.render(**info)
+
+    def publish(self, info, path):
+        # PdfPages + Figure are backend-independent — no global
+        # matplotlib.use() switch that would break a host app's
+        # interactive backend
+        from matplotlib.backends.backend_pdf import PdfPages
+        from matplotlib.figure import Figure
+
+        lines = self.render(info).splitlines()
+        with PdfPages(path) as pdf:
+            for start in range(0, max(len(lines), 1),
+                               self.LINES_PER_PAGE):
+                fig = Figure(figsize=(8.27, 11.69))      # A4
+                fig.text(0.06, 0.97,
+                         "\n".join(lines[start:start +
+                                         self.LINES_PER_PAGE]),
+                         va="top", family="monospace", fontsize=8)
+                pdf.savefig(fig)
+        return path

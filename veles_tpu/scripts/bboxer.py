@@ -1,0 +1,190 @@
+"""Collaborative image bounding-box labeling tool.
+
+Parity target: ``/root/reference/veles/scripts/bboxer.py`` (tornado app:
+image browser + canvas bbox editor; selections persist as ``<image>.json``
+sidecars; concurrent-edit conflicts are rejected unless overwritten).
+
+Fresh TPU-repo design: same sidecar format and conflict semantics, no
+pyinotify/thumbnail-cache dependencies — images are listed per request
+and served directly (browsers scale them; datasets labeled here are
+typically small crops anyway).
+
+Run: ``python -m veles_tpu.scripts.bboxer --root DIR [--port 8090]``
+"""
+
+import argparse
+import json
+import os
+import sys
+
+IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>bboxer</title><style>
+body { font-family: sans-serif; margin: 1em; }
+#files a { display: block; }
+#wrap { position: relative; display: inline-block; }
+#img { max-width: 90vw; }
+canvas { position: absolute; left: 0; top: 0; cursor: crosshair; }
+</style></head><body>
+<h2>bboxer — %(nfiles)d images under %(root)s</h2>
+<div id="files">%(links)s</div>
+<div id="editor" style="display:none">
+  <p><b id="fname"></b>
+     <button onclick="save(false)">save</button>
+     <button onclick="save(true)">overwrite</button>
+     <button onclick="boxes.pop(); redraw()">undo box</button>
+     <input id="label" placeholder="label"></p>
+  <div id="wrap"><img id="img"><canvas id="cv"></canvas></div>
+</div>
+<script>
+let boxes = [], cur = null, drag = null;
+const img = document.getElementById("img"),
+      cv = document.getElementById("cv"),
+      ctx = cv.getContext("2d");
+document.getElementById("files").addEventListener("click", e => {
+  const f = e.target.dataset && e.target.dataset.f;
+  if (f) { e.preventDefault(); open_image(f); }
+});
+function open_image(f) {
+  cur = f;
+  document.getElementById("editor").style.display = "block";
+  document.getElementById("fname").textContent = f;
+  img.onload = () => {
+    cv.width = img.width; cv.height = img.height;
+    fetch("selections", {method: "POST",
+                         body: JSON.stringify({file: f})})
+      .then(r => r.json()).then(s => { boxes = s; redraw(); });
+  };
+  img.src = "image/" + encodeURIComponent(f);
+}
+function scale() { return img.naturalWidth / img.width; }
+function redraw() {
+  ctx.clearRect(0, 0, cv.width, cv.height);
+  ctx.strokeStyle = "#f00"; ctx.fillStyle = "#f00"; ctx.font = "12px sans-serif";
+  for (const b of boxes) {
+    const k = 1 / scale();
+    ctx.strokeRect(b.x * k, b.y * k, b.w * k, b.h * k);
+    ctx.fillText(b.label || "?", b.x * k + 2, b.y * k + 12);
+  }
+}
+cv.onmousedown = e => { drag = [e.offsetX, e.offsetY]; };
+cv.onmouseup = e => {
+  if (!drag) return;
+  const k = scale();
+  boxes.push({x: Math.min(drag[0], e.offsetX) * k,
+              y: Math.min(drag[1], e.offsetY) * k,
+              w: Math.abs(e.offsetX - drag[0]) * k,
+              h: Math.abs(e.offsetY - drag[1]) * k,
+              label: document.getElementById("label").value});
+  drag = null; redraw();
+};
+function save(overwrite) {
+  fetch("update", {method: "POST", body: JSON.stringify(
+    {file: cur, selections: boxes, overwrite: overwrite})})
+    .then(r => { if (!r.ok) alert("conflict: someone else labeled " +
+      "this image — reload or use overwrite"); });
+}
+</script></body></html>
+"""
+
+
+def sidecar(path):
+    return path + ".json"
+
+
+def make_app(root_dir):
+    import tornado.web
+
+    root_dir = os.path.abspath(root_dir)
+
+    def resolve(rel):
+        path = os.path.abspath(os.path.join(root_dir, rel))
+        if not path.startswith(root_dir + os.sep) and path != root_dir:
+            raise tornado.web.HTTPError(403)
+        return path
+
+    def list_images():
+        out = []
+        for base, _dirs, files in os.walk(root_dir):
+            for name in sorted(files):
+                if name.lower().endswith(IMAGE_EXTS):
+                    out.append(os.path.relpath(
+                        os.path.join(base, name), root_dir))
+        return out
+
+    class MainHandler(tornado.web.RequestHandler):
+        def get(self):
+            import html as _html
+            files = list_images()
+            # filenames ride in a data attribute (html-escaped, quote
+            # safe) — never interpolated into JS or raw markup
+            links = "".join(
+                '<a href="#" data-f="%s">%s%s</a>' % (
+                    _html.escape(f, quote=True), _html.escape(f),
+                    " ✓" if os.path.exists(sidecar(resolve(f)))
+                    else "")
+                for f in files)
+            self.write(_PAGE % {"nfiles": len(files),
+                                "root": _html.escape(root_dir),
+                                "links": links})
+
+    class ImageHandler(tornado.web.RequestHandler):
+        def get(self, rel):
+            path = resolve(rel)
+            if not os.path.exists(path):
+                raise tornado.web.HTTPError(404)
+            with open(path, "rb") as fin:
+                self.write(fin.read())
+
+    class SelectionsHandler(tornado.web.RequestHandler):
+        def post(self):
+            data = json.loads(self.request.body)
+            path = sidecar(resolve(data["file"]))
+            if os.access(path, os.R_OK):
+                with open(path, "r") as fin:
+                    self.write(fin.read())
+            else:
+                self.write("[]")
+            self.set_header("Content-Type", "application/json")
+
+    class UpdateHandler(tornado.web.RequestHandler):
+        def post(self):
+            data = json.loads(self.request.body)
+            path = sidecar(resolve(data["file"]))
+            if os.path.exists(path) and not data.get("overwrite"):
+                with open(path, "r") as fin:
+                    existing = json.load(fin)
+                if existing != data["selections"]:
+                    # collaborative conflict (ref UpdateHandler:
+                    # bboxer.py 403 semantics)
+                    raise tornado.web.HTTPError(403)
+            with open(path, "w") as fout:
+                json.dump(data["selections"], fout)
+            self.write({"ok": True})
+
+    return tornado.web.Application([
+        (r"/", MainHandler),
+        (r"/image/(.*)", ImageHandler),
+        (r"/selections", SelectionsHandler),
+        (r"/update", UpdateHandler),
+    ])
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--root", required=True,
+                        help="directory of images to label")
+    parser.add_argument("--port", type=int, default=8090)
+    args = parser.parse_args(argv)
+    import tornado.ioloop
+    app = make_app(args.root)
+    app.listen(args.port)
+    print("bboxer serving %s on http://127.0.0.1:%d/" % (
+        args.root, args.port), file=sys.stderr)
+    tornado.ioloop.IOLoop.current().start()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
