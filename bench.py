@@ -40,24 +40,9 @@ import time
 
 V100_ALEXNET_IMG_PER_SEC = 1500.0
 
-# peak dense bf16 FLOP/s per *jax device* (v2/v3 devices are single
-# TensorCores = half a chip; v4+ are whole chips/megacores)
-_PEAK_BF16 = [
-    ("v6", 918e12),     # Trillium ("TPU v6 lite"/"TPU v6e")
-    ("v5p", 459e12),
-    ("v5", 197e12),     # "TPU v5 lite" / v5e
-    ("v4", 275e12),
-    ("v3", 61.5e12),
-    ("v2", 22.5e12),
-]
-
-
 def _peak_flops(device_kind):
-    kind = (device_kind or "").lower()
-    for tag, peak in _PEAK_BF16:
-        if tag in kind:
-            return peak
-    return None
+    from veles_tpu.backends import peak_bf16_flops
+    return peak_bf16_flops(device_kind)
 
 
 def _measure(step_fn, params, x, labels, steps, min_seconds=2.0):

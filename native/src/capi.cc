@@ -3,6 +3,7 @@
 #include <cstring>
 #include <string>
 
+#include "logging.h"
 #include "workflow.h"
 
 using veles_native::NumElements;
@@ -20,6 +21,19 @@ void SetError(char* err, int errlen, const std::string& what) {
 }  // namespace
 
 extern "C" {
+
+// 0=debug 1=info 2=warning 3=error 4=off (ref eina-log domains; the
+// Python host mirrors veles_tpu.logger levels onto these)
+void veles_native_set_log_level(int level) {
+  if (level < 0 || level > 4) return;
+  veles_native::SetLogLevel(static_cast<veles_native::LogLevel>(level));
+}
+
+// cb(level, component, message); nullptr restores the stderr sink.
+void veles_native_set_log_callback(
+    void (*cb)(int, const char*, const char*)) {
+  veles_native::SetLogCallback(cb);
+}
 
 // Returns an opaque handle or nullptr (error text in err).
 void* veles_native_load(const char* path, char* err, int errlen) {

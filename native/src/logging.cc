@@ -1,0 +1,83 @@
+#include "logging.h"
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+
+namespace veles_native {
+
+namespace {
+
+LogLevel LevelFromEnv() {
+  const char* env = std::getenv("VELES_NATIVE_LOG");
+  if (!env) return kLogWarning;  // quiet by default, like a library
+  if (!std::strcmp(env, "debug")) return kLogDebug;
+  if (!std::strcmp(env, "info")) return kLogInfo;
+  if (!std::strcmp(env, "warning")) return kLogWarning;
+  if (!std::strcmp(env, "error")) return kLogError;
+  if (!std::strcmp(env, "off")) return kLogOff;
+  return kLogWarning;
+}
+
+LogLevel g_level = LevelFromEnv();
+LogCallback g_callback = nullptr;
+std::mutex g_mutex;
+
+const char* LevelName(LogLevel level) {
+  switch (level) {
+    case kLogDebug: return "D";
+    case kLogInfo: return "I";
+    case kLogWarning: return "W";
+    case kLogError: return "E";
+    default: return "?";
+  }
+}
+
+}  // namespace
+
+void SetLogLevel(LogLevel level) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  g_level = level;
+}
+
+LogLevel GetLogLevel() {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  return g_level;
+}
+
+void SetLogCallback(LogCallback cb) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  g_callback = cb;
+}
+
+void LogMessage(LogLevel level, const char* component, const char* fmt,
+                ...) {
+  LogCallback cb;
+  {
+    std::lock_guard<std::mutex> lock(g_mutex);
+    if (level < g_level) return;
+    cb = g_callback;
+  }
+  char message[1024];
+  va_list args;
+  va_start(args, fmt);
+  std::vsnprintf(message, sizeof(message), fmt, args);
+  va_end(args);
+  if (cb) {
+    cb(static_cast<int>(level), component, message);
+    return;
+  }
+  std::time_t now = std::time(nullptr);
+  std::tm tm_buf;
+  localtime_r(&now, &tm_buf);
+  char stamp[32];
+  std::strftime(stamp, sizeof(stamp), "%H:%M:%S", &tm_buf);
+  std::lock_guard<std::mutex> lock(g_mutex);
+  std::fprintf(stderr, "%s %s %s: %s\n", stamp, LevelName(level),
+               component, message);
+}
+
+}  // namespace veles_native

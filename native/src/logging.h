@@ -1,0 +1,46 @@
+// Leveled logging for the native inference runtime.
+//
+// Parity target: libVeles' eina-log macro layer
+// (/root/reference/libVeles/inc/veles/logger.h, src/logger.cc) —
+// re-designed as a ~100-line dependency-free logger: level from the
+// VELES_NATIVE_LOG env var (debug|info|warning|error|off), default
+// stderr sink with timestamp + component tag, and an installable
+// callback so the Python host (veles_tpu/native.py) can route messages
+// into its own Logger stack.
+#pragma once
+
+namespace veles_native {
+
+enum LogLevel {
+  kLogDebug = 0,
+  kLogInfo = 1,
+  kLogWarning = 2,
+  kLogError = 3,
+  kLogOff = 4,
+};
+
+// callback receives (level, component, formatted message)
+using LogCallback = void (*)(int level, const char* component,
+                             const char* message);
+
+void SetLogLevel(LogLevel level);
+LogLevel GetLogLevel();
+void SetLogCallback(LogCallback cb);  // nullptr restores stderr sink
+
+void LogMessage(LogLevel level, const char* component, const char* fmt,
+                ...) __attribute__((format(printf, 3, 4)));
+
+}  // namespace veles_native
+
+#define VN_DEBUG(comp, ...) \
+  ::veles_native::LogMessage(::veles_native::kLogDebug, comp, \
+                             __VA_ARGS__)
+#define VN_INFO(comp, ...) \
+  ::veles_native::LogMessage(::veles_native::kLogInfo, comp, \
+                             __VA_ARGS__)
+#define VN_WARNING(comp, ...) \
+  ::veles_native::LogMessage(::veles_native::kLogWarning, comp, \
+                             __VA_ARGS__)
+#define VN_ERROR(comp, ...) \
+  ::veles_native::LogMessage(::veles_native::kLogError, comp, \
+                             __VA_ARGS__)

@@ -1,5 +1,7 @@
 #include "package.h"
 
+#include "logging.h"
+
 #include <dirent.h>
 #include <sys/stat.h>
 #include <zlib.h>
@@ -159,6 +161,7 @@ FileMap ReadTarGz(const std::string& path) {
 }
 
 FileMap LoadPackage(const std::string& path) {
+  VN_DEBUG("package", "loading %s", path.c_str());
   struct stat st;
   if (stat(path.c_str(), &st) == 0 && S_ISDIR(st.st_mode)) {
     FileMap files;

@@ -4,6 +4,7 @@
 #include <stdexcept>
 
 #include "json.h"
+#include "logging.h"
 #include "memory_optimizer.h"
 #include "npy.h"
 
@@ -29,6 +30,8 @@ Workflow::Workflow(const std::string& path) : engine_(0) {
   if (ishape && !ishape->is_null())
     for (const auto& d : ishape->array)
       package_input_shape_.push_back(d->integer());
+  VN_INFO("workflow", "loaded package %s: model '%s', %zu files",
+          path.c_str(), name_.c_str(), files_.size());
 }
 
 void Workflow::Initialize(int64_t batch) {
@@ -76,6 +79,12 @@ void Workflow::Initialize(int64_t batch) {
   }
 
   int64_t total = MemoryOptimizer::Optimize(&nodes);
+  VN_INFO("workflow",
+          "initialized %zu units, batch %lld; arena %lld floats "
+          "(%.1f MB after liveness packing)",
+          units_.size(), static_cast<long long>(batch),
+          static_cast<long long>(total),
+          total * sizeof(float) / 1048576.0);
   arena_.assign(static_cast<size_t>(total), 0.0f);
   input_buf_ = arena_.data() + nodes[0].offset;
   unit_out_.clear();

@@ -164,3 +164,38 @@ def test_corrupt_package_raises(lib, tmp_path):
         f.write(b"this is not a zip")
     with pytest.raises(native.NativeError):
         native.NativeWorkflow(path)
+
+
+def test_native_logging_bridge(lib, tmp_path, caplog):
+    """Native-runtime log messages cross the ctypes seam into Python
+    logging with mapped levels (ref libVeles eina-log layer)."""
+    import logging as _logging
+
+    import veles_tpu.native as native
+    from veles_tpu.znicz.all2all import All2AllTanh
+
+    x = numpy.random.default_rng(0).standard_normal(
+        (4, 6)).astype(numpy.float32)
+    forwards, _golden = _chain(
+        [(All2AllTanh, {"output_sample_shape": (3,)})], x)
+    pkg = str(tmp_path / "log.zip")
+    export_package(forwards, pkg, with_stablehlo=False)
+    lib.veles_native_set_log_level(0)          # debug
+    with caplog.at_level(_logging.DEBUG, logger="native.workflow"):
+        wf = native.NativeWorkflow(pkg)
+        wf.initialize(4)
+    records = [r for r in caplog.records
+               if r.name.startswith("native.")]
+    assert any("loaded package" in r.message for r in records)
+    assert any("arena" in r.message and "units" in r.message
+               for r in records)
+    # raising the native threshold silences below-level messages at
+    # the source
+    lib.veles_native_set_log_level(3)          # error only
+    caplog.clear()
+    with caplog.at_level(_logging.DEBUG, logger="native.workflow"):
+        wf2 = native.NativeWorkflow(pkg)
+        wf2.initialize(4)
+    assert not [r for r in caplog.records
+                if r.name.startswith("native.")]
+    lib.veles_native_set_log_level(2)          # restore default

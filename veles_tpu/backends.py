@@ -34,6 +34,27 @@ from veles_tpu.distributable import Pickleable
 DEVICE_INFOS_JSON = os.path.join(
     os.path.dirname(__file__), "devices", "device_infos.json")
 
+#: peak dense bf16 FLOP/s per *jax device* (v2/v3 devices are single
+#: TensorCores = half a chip; v4+ are whole chips/megacores) — consumed
+#: by bench.py's MFU gate and scripts/profile_step.py
+PEAK_BF16_FLOPS = (
+    ("v6", 918e12),     # Trillium ("TPU v6 lite"/"TPU v6e")
+    ("v5p", 459e12),
+    ("v5", 197e12),     # "TPU v5 lite" / v5e
+    ("v4", 275e12),
+    ("v3", 61.5e12),
+    ("v2", 22.5e12),
+)
+
+
+def peak_bf16_flops(device_kind):
+    """Peak dense bf16 FLOP/s for a jax device kind, or None."""
+    kind = (device_kind or "").lower()
+    for tag, peak in PEAK_BF16_FLOPS:
+        if tag in kind:
+            return peak
+    return None
+
 
 class BackendRegistry(type):
     """name → Device class registry (ref ``backends.py:166``)."""

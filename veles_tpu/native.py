@@ -11,6 +11,7 @@ ctypes layer over the extern-C API in ``native/src/capi.cc``.
 """
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
@@ -89,8 +90,51 @@ def load_library(rebuild=False):
             ctypes.c_int]
         lib.veles_native_destroy.restype = None
         lib.veles_native_destroy.argtypes = [ctypes.c_void_p]
+        try:
+            lib.veles_native_set_log_level.restype = None
+            lib.veles_native_set_log_level.argtypes = [ctypes.c_int]
+            lib.veles_native_set_log_callback.restype = None
+            lib.veles_native_set_log_callback.argtypes = [LOG_CALLBACK]
+        except AttributeError:
+            pass       # prebuilt library predating the logging seam
+        else:
+            _install_log_bridge(lib)
         _lib = lib
         return _lib
+
+
+#: native log levels (logging.h): 0=debug 1=info 2=warning 3=error 4=off
+LOG_CALLBACK = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p,
+                                ctypes.c_char_p)
+_NATIVE_TO_PY = {0: logging.DEBUG, 1: logging.INFO, 2: logging.WARNING,
+                 3: logging.ERROR}
+_log_bridge_ref = None   # keep the CFUNCTYPE alive for process lifetime
+
+
+def _install_log_bridge(lib):
+    """Route native-runtime log messages into Python logging
+    (the libVeles eina-log ↔ host-logger seam, ref
+    ``libVeles/inc/veles/logger.h``)."""
+    global _log_bridge_ref
+
+    def bridge(level, component, message):
+        logging.getLogger("native.%s" % (component or b"?").decode()) \
+            .log(_NATIVE_TO_PY.get(level, logging.WARNING),
+                 "%s", (message or b"").decode(errors="replace"))
+
+    _log_bridge_ref = LOG_CALLBACK(bridge)
+    lib.veles_native_set_log_callback(_log_bridge_ref)
+    if os.environ.get("VELES_NATIVE_LOG"):
+        # the documented env var set the native threshold at library
+        # init — respect it
+        return
+    # otherwise mirror the "native" logger's effective threshold so
+    # disabled levels don't even cross the ctypes boundary
+    eff = logging.getLogger("native").getEffectiveLevel()
+    native = 0 if eff <= logging.DEBUG else \
+        1 if eff <= logging.INFO else \
+        2 if eff <= logging.WARNING else 3
+    lib.veles_native_set_log_level(native)
 
 
 class NativeWorkflow(object):

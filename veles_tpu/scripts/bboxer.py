@@ -131,16 +131,32 @@ def make_app(root_dir):
 
     class ImageHandler(tornado.web.RequestHandler):
         def get(self, rel):
+            # images only (never the .json sidecars or other files),
+            # with a real image Content-Type — tornado's text/html
+            # default would let attacker-authored sidecar content
+            # execute as a page in this origin
+            if not rel.lower().endswith(IMAGE_EXTS):
+                raise tornado.web.HTTPError(403)
             path = resolve(rel)
             if not os.path.exists(path):
                 raise tornado.web.HTTPError(404)
+            import mimetypes
+            ctype = mimetypes.guess_type(rel)[0] or \
+                "application/octet-stream"
+            self.set_header("Content-Type", ctype)
+            self.set_header("X-Content-Type-Options", "nosniff")
             with open(path, "rb") as fin:
                 self.write(fin.read())
+
+    def resolve_image(rel):
+        if not str(rel).lower().endswith(IMAGE_EXTS):
+            raise tornado.web.HTTPError(403)
+        return resolve(rel)
 
     class SelectionsHandler(tornado.web.RequestHandler):
         def post(self):
             data = json.loads(self.request.body)
-            path = sidecar(resolve(data["file"]))
+            path = sidecar(resolve_image(data["file"]))
             if os.access(path, os.R_OK):
                 with open(path, "r") as fin:
                     self.write(fin.read())
@@ -151,7 +167,7 @@ def make_app(root_dir):
     class UpdateHandler(tornado.web.RequestHandler):
         def post(self):
             data = json.loads(self.request.body)
-            path = sidecar(resolve(data["file"]))
+            path = sidecar(resolve_image(data["file"]))
             if os.path.exists(path) and not data.get("overwrite"):
                 with open(path, "r") as fin:
                     existing = json.load(fin)
@@ -176,12 +192,15 @@ def main(argv=None):
     parser.add_argument("--root", required=True,
                         help="directory of images to label")
     parser.add_argument("--port", type=int, default=8090)
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="bind address (0.0.0.0 for collaborative "
+                             "LAN labeling)")
     args = parser.parse_args(argv)
     import tornado.ioloop
     app = make_app(args.root)
-    app.listen(args.port)
-    print("bboxer serving %s on http://127.0.0.1:%d/" % (
-        args.root, args.port), file=sys.stderr)
+    app.listen(args.port, address=args.host)
+    print("bboxer serving %s on http://%s:%d/" % (
+        args.root, args.host, args.port), file=sys.stderr)
     tornado.ioloop.IOLoop.current().start()
     return 0
 

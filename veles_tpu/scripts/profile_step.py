@@ -1,0 +1,164 @@
+"""Step-time breakdown for a sample's fused train step.
+
+Measures, with the honest timing discipline of ``ops/timing.py``
+(result-derived host-fetch sync + marginal timing):
+
+- forward only (inference apply)
+- forward + backward (value_and_grad, no update)
+- the full train step (forward + backward + momentum update)
+
+and prints a markdown table with per-phase seconds, derived phase
+costs, images/sec and MFU.  Run on the real chip:
+
+    python -m veles_tpu.scripts.profile_step [--sample alexnet]
+        [--batch 256] [--out PROFILE.md]
+
+(ref: the per-unit timer table ``workflow.py:767-826`` and the
+``--sync-run`` kernel-accuracy note ``accelerated_units.py:294-297`` —
+this is the fused-step analogue.)
+"""
+
+import argparse
+import sys
+
+
+def _peak_flops(device_kind):
+    from veles_tpu.backends import peak_bf16_flops
+    return peak_bf16_flops(device_kind)
+
+
+def build(sample, batch):
+    import jax
+    import jax.numpy as jnp
+    import numpy
+
+    from veles_tpu import prng
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    prng.seed_all(1234)
+    if sample == "mnist":
+        from __graft_entry__ import MNIST_LAYERS
+        from veles_tpu.znicz.fused import (init_mlp_params,
+                                           make_train_step, mlp_apply,
+                                           _specs_static)
+        params = init_mlp_params(784, MNIST_LAYERS)
+        step = make_train_step(MNIST_LAYERS)
+        static = _specs_static(MNIST_LAYERS)
+
+        def apply_fn(p, x):
+            return mlp_apply(p, x, static)
+        shape = (784,)
+        n_classes = 10
+    else:
+        mod = __import__("veles_tpu.samples.%s" % sample,
+                         fromlist=[sample])
+        layers = mod.LAYERS
+        shape = getattr(mod, "INPUT_SHAPE", (32, 32, 3))
+        n_classes = 1000 if sample == "alexnet" else 10
+        params, step, _eval, apply_raw = lower_specs(
+            layers, shape, compute_dtype=jnp.bfloat16)
+
+        def apply_fn(p, x):
+            return apply_raw(p, x, train=False)
+    rng = numpy.random.default_rng(0)
+    x = jax.device_put(rng.standard_normal(
+        (batch,) + tuple(shape)).astype(numpy.float32))
+    labels = jax.device_put(
+        rng.integers(0, n_classes, batch).astype(numpy.int32))
+    return params, step, apply_fn, x, labels
+
+
+def measure_phases(params, step, apply_fn, x, labels, k=10,
+                   min_seconds=2.0):
+    import jax
+
+    from veles_tpu.ops.timing import (cost_flops, host_fetch,
+                                      marginal_time, measure_fused_step)
+
+    phases = {}
+
+    # full step: K iterations in one program (the bench methodology)
+    sec, flops = measure_fused_step(step, jax.device_put(params), x,
+                                    labels, k=k,
+                                    min_seconds=min_seconds)
+    phases["full_step"] = (sec, flops)
+
+    # forward-only: chain K applies (threading a scalar so nothing is
+    # dead code)
+    def fwd_multi(p, x_, _labels):
+        out = apply_fn(p, x_)
+        def body(_i, carry):
+            o = apply_fn(p, x_ + carry[1] * 0)
+            return o, o.astype(jax.numpy.float32).ravel()[0]
+        out, s = jax.lax.fori_loop(
+            0, k - 1, body,
+            (out, out.astype(jax.numpy.float32).ravel()[0]))
+        return p, s
+    jitted = jax.jit(fwd_multi)
+    compiled = jitted.lower(params, x, labels).compile()
+
+    def call(sync=False):
+        _p, s = compiled(params, x, labels)
+        if sync:
+            host_fetch(s)
+
+    sec_fwd = marginal_time(call, min_seconds=min_seconds) / k
+    phases["forward"] = (sec_fwd, (cost_flops(compiled) or 0) / k
+                         or None)
+    return phases
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--sample", default="alexnet",
+                        choices=("alexnet", "cifar10", "mnist"))
+    parser.add_argument("--batch", type=int, default=256)
+    parser.add_argument("--k", type=int, default=10)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+
+    import jax
+    kind = jax.devices()[0].device_kind
+    params, step, apply_fn, x, labels = build(args.sample, args.batch)
+    phases = measure_phases(params, step, apply_fn, x, labels, k=args.k)
+
+    full_sec, full_flops = phases["full_step"]
+    fwd_sec, fwd_flops = phases["forward"]
+    bwd_sec = full_sec - fwd_sec
+    peak = _peak_flops(kind)
+    lines = [
+        "# %s fused-step profile — %s, batch %d" % (
+            args.sample, kind, args.batch),
+        "",
+        "| Phase | sec/step | share | GFLOP | TFLOP/s |",
+        "|---|---|---|---|---|",
+    ]
+    for name, sec, flops in (
+            ("forward", fwd_sec, fwd_flops),
+            ("backward+update (derived)", bwd_sec,
+             (full_flops - fwd_flops) if full_flops and fwd_flops
+             else None),
+            ("full step", full_sec, full_flops)):
+        tf = (flops / sec / 1e12) if flops and sec > 0 else None
+        lines.append("| %s | %.6f | %.0f%% | %s | %s |" % (
+            name, sec, 100.0 * sec / full_sec,
+            "%.2f" % (flops / 1e9) if flops else "—",
+            "%.1f" % tf if tf else "—"))
+    ips = args.batch / full_sec
+    mfu = (full_flops / full_sec / peak) if (full_flops and peak) \
+        else None
+    lines += ["",
+              "- images/sec: **%.1f**" % ips,
+              "- MFU: **%s**" % ("%.4f" % mfu if mfu else "n/a"),
+              "- peak bf16 FLOP/s assumed: %s" % (
+                  "%.0fe12" % (peak / 1e12) if peak else "unknown")]
+    report = "\n".join(lines)
+    print(report)
+    if args.out:
+        with open(args.out, "w") as fout:
+            fout.write(report + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
