@@ -334,3 +334,95 @@ def test_conv_ae_with_pool_depool_trains():
         params, m = step(params, x, x)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_reference_layer_registry_complete():
+    """Every layer-type name the reference docs enumerate
+    (manualrst_veles_workflow_parameters.rst:467-505) resolves in the
+    registry, including the short doc spellings."""
+    from veles_tpu.units import UnitRegistry
+    from veles_tpu.znicz import misc_units  # noqa: F401
+
+    ref = ["all2all_tanh", "stochastic_abs_pool_depool",
+           "all2all_sigmoid", "activation_log", "avg_pooling",
+           "depooling", "channel_merger", "deconv",
+           "activation_tanhlog", "all2all_str", "activation_relu",
+           "maxabs_pooling", "rprop_all2all", "stochastic_pooling",
+           "conv_str", "channel_splitter", "activation_str",
+           "activation_tanh", "activation_sincos", "dropout", "cutter",
+           "conv_sigmoid", "max_pooling", "activation_mul", "conv",
+           "softmax", "all2all", "norm", "all2all_relu", "zero_filter",
+           "stochastic_abs_pooling", "conv_tanh",
+           "stochastic_pool_depool", "activation_sigmoid", "conv_relu"]
+    missing = [name for name in ref if name not in UnitRegistry.mapped]
+    assert not missing, missing
+
+
+def test_channel_splitter_merger_roundtrip():
+    """Two-tower grouping plumbing: split channels, process towers,
+    merge back (ref channel_splitting.*)."""
+    from veles_tpu.memory import Vector
+    from veles_tpu.znicz.misc_units import ChannelMerger, ChannelSplitter
+
+    rng = numpy.random.default_rng(2)
+    x = rng.standard_normal((2, 4, 4, 6)).astype(numpy.float32)
+    wf = DummyWorkflow()
+    lo = ChannelSplitter(wf, start=0, count=2)
+    hi = ChannelSplitter(wf, start=2)
+    for unit in (lo, hi):
+        unit.input = Vector(x)
+        unit.initialize(device=None)
+        unit.numpy_run()
+    assert lo.output.shape == (2, 4, 4, 2)
+    assert hi.output.shape == (2, 4, 4, 4)
+    numpy.testing.assert_array_equal(lo.output.mem, x[..., :2])
+    merger = ChannelMerger(wf).link_inputs(lo, "output", hi, "output")
+    merger.initialize()
+    merger.run()
+    numpy.testing.assert_array_equal(merger.output.mem, x)
+    with pytest.raises(ValueError):
+        bad = ChannelSplitter(wf, start=5, count=3)
+        bad.input = Vector(x)
+        bad.initialize(device=None)
+
+
+def test_zero_filler_mapped_and_masks():
+    from veles_tpu.memory import Vector
+    from veles_tpu.units import UnitRegistry
+    from veles_tpu.znicz.misc_units import ZeroFiller
+
+    assert UnitRegistry.mapped["zero_filter"] is ZeroFiller
+    wf = DummyWorkflow()
+
+    class Holder(object):
+        weights = Vector(numpy.ones((3, 3), numpy.float32))
+
+    zf = ZeroFiller(wf, mask=numpy.tril(numpy.ones((3, 3),
+                                                   numpy.float32)))
+    zf.target_unit = Holder()
+    zf.run()
+    numpy.testing.assert_array_equal(
+        Holder.weights.mem, numpy.tril(numpy.ones((3, 3))))
+
+
+def test_alias_layer_types_train_via_standard_workflow():
+    """Doc-spelling aliases build AND train (GD_PAIRS covers them)."""
+    from veles_tpu import prng
+    prng.seed_all(23)
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: TinyImageLoader(w, minibatch_size=40),
+        layers=[
+            {"type": "conv_str",
+             "->": {"n_kernels": 4, "kx": 3, "ky": 3, "padding": 1},
+             "<-": {"learning_rate": 0.02, "gradient_moment": 0.9}},
+            {"type": "norm", "->": {"n": 3}},
+            {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": {"learning_rate": 0.02}},
+        ],
+        decision_config={"max_epochs": 2})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    assert wf.decision.epoch_n_err_pt[1] < 100.0

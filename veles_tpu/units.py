@@ -56,6 +56,11 @@ class UnitRegistry(type):
             mapping = namespace.get("MAPPING")
             if mapping:
                 UnitRegistry.mapped[mapping] = cls
+            # reference-doc spellings (e.g. "conv_str", "norm") map to
+            # the same class (manualrst_veles_workflow_parameters.rst
+            # :467-505 uses both long and short names)
+            for alias in namespace.get("MAPPING_ALIASES", ()):
+                UnitRegistry.mapped[alias] = cls
 
 
 class IUnit(object):

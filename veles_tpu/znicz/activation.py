@@ -83,6 +83,7 @@ class ForwardRELU(ActivationForward):
 
 class ForwardStrictRELU(ActivationForward):
     MAPPING = "activation_strict_relu"
+    MAPPING_ALIASES = ("activation_str",)
     FUNC = "strict_relu"
 
 

@@ -129,6 +129,7 @@ class All2AllRELU(All2All):
 
 class All2AllStrictRELU(All2All):
     MAPPING = "all2all_strict_relu"
+    MAPPING_ALIASES = ("all2all_str",)
     ACTIVATION = "strict_relu"
 
     def apply_activation_numpy(self, v):

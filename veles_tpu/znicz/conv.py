@@ -126,6 +126,7 @@ class ConvRELU(Conv):
 
 class ConvStrictRELU(Conv):
     MAPPING = "conv_strict_relu"
+    MAPPING_ALIASES = ("conv_str",)
     ACTIVATION = "strict_relu"
 
 

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy
 
+from veles_tpu.accelerated_units import AcceleratedUnit
 from veles_tpu.znicz.all2all import All2All
 from veles_tpu.znicz.fused import _ACT
 from veles_tpu.znicz.gd_base import GDViaVJP
@@ -145,36 +146,6 @@ class GDCutter(GDViaVJP):
     MAPPING = "gd_cutter"
 
 
-class ChannelSplitter(Unit):
-    """(B, H, W, C) → list of per-channel (B, H, W) planes
-    (ref ``channel_splitting.ChannelSplitter``)."""
-
-    def __init__(self, workflow, **kwargs):
-        super(ChannelSplitter, self).__init__(workflow, **kwargs)
-        self.input = None
-        self.outputs = []
-        self.demand("input")
-
-    def run(self):
-        mem = getattr(self.input, "mem", self.input)
-        self.outputs = [numpy.ascontiguousarray(mem[..., i])
-                        for i in range(mem.shape[-1])]
-
-
-class ChannelMerger(Unit):
-    """Inverse of ChannelSplitter."""
-
-    def __init__(self, workflow, **kwargs):
-        super(ChannelMerger, self).__init__(workflow, **kwargs)
-        self.inputs = None
-        self.output = None
-        self.demand("inputs")
-
-    def run(self):
-        self.output = numpy.stack(
-            [getattr(p, "mem", p) for p in self.inputs], axis=-1)
-
-
 class ResizableAll2All(All2All):
     """All2All whose output width can be changed between initializations
     (ref ``resizable_all2all.ResizableAll2All``): existing rows/columns
@@ -215,6 +186,8 @@ class ZeroFiller(Unit):
     (ref ``weights_zerofilling.ZeroFiller`` — used to enforce sparsity
     masks)."""
 
+    MAPPING = "zero_filter"
+
     def __init__(self, workflow, **kwargs):
         super(ZeroFiller, self).__init__(workflow, **kwargs)
         self.target_unit = None
@@ -229,3 +202,107 @@ class ZeroFiller(Unit):
             return
         weights.map_write()
         weights.mem[...] *= self.mask
+
+
+class ChannelSplitter(ForwardBase):
+    """Select a contiguous channel slice of an NHWC tensor (ref
+    ``channel_splitting.ChannelSplitter`` — the reference used pairs of
+    these to express AlexNet's two-tower grouping; with XLA the same
+    graph shape composes the towers and fuses the slices away)."""
+
+    MAPPING = "channel_splitter"
+
+    def __init__(self, workflow, **kwargs):
+        super(ChannelSplitter, self).__init__(workflow, **kwargs)
+        self.include_bias = False
+        self.start = int(kwargs.get("start", 0))
+        self.count = kwargs.get("count")   # None = to the end
+
+    def pure_config(self):
+        return {"start": self.start, "count": self.count}
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("start", "count"))
+    def pure(params, x, start=0, count=None):
+        del params
+        stop = x.shape[-1] if count is None else start + count
+        return x[..., start:stop]
+
+    def initialize(self, device=None, **kwargs):
+        super(ChannelSplitter, self).initialize(device=device, **kwargs)
+        channels = self.input.shape[-1]
+        count = (channels - self.start) if self.count is None \
+            else self.count
+        if self.start < 0 or count <= 0 or \
+                self.start + count > channels:
+            raise ValueError(
+                "channel slice [%d:%d) outside %d channels" % (
+                    self.start, self.start + count, channels))
+        self.output.reset(numpy.zeros(
+            self.input.shape[:-1] + (count,), numpy.float32))
+        self.init_vectors(self.output)
+
+    def numpy_run(self):
+        out = type(self).pure({}, jnp.asarray(self.input.mem),
+                              **self.pure_config())
+        self.output.map_invalidate()
+        self.output.mem = numpy.asarray(out)
+
+    def tpu_run(self):
+        self.output.devmem = type(self).pure(
+            {}, self.input.devmem, **self.pure_config())
+
+
+class ChannelMerger(AcceleratedUnit):
+    """Concatenate several units' NHWC outputs along channels (ref
+    ``channel_splitting.ChannelMerger`` — the join of the two-tower
+    grouping).  ``link_inputs(unit_a, "output", unit_b, "output")``
+    like :class:`veles_tpu.input_joiner.InputJoiner`, but on the
+    channel axis with spatial shapes preserved; the device path stays
+    on HBM (no per-step host round trip)."""
+
+    MAPPING = "channel_merger"
+
+    def __init__(self, workflow, **kwargs):
+        from veles_tpu.memory import Vector
+        super(ChannelMerger, self).__init__(workflow, **kwargs)
+        self.inputs = list(kwargs.get("inputs", ()))
+        self.output = Vector()
+
+    def link_inputs(self, *pairs):
+        if len(pairs) % 2:
+            raise ValueError("link_inputs takes (unit, attr) pairs")
+        self._input_links = list(zip(pairs[::2], pairs[1::2]))
+        return self
+
+    def initialize(self, device=None, **kwargs):
+        super(ChannelMerger, self).initialize(device=device, **kwargs)
+        for unit, attr in getattr(self, "_input_links", ()):
+            vec = getattr(unit, attr)
+            if vec not in self.inputs:
+                self.inputs.append(vec)
+        if not self.inputs:
+            raise ValueError("ChannelMerger has no inputs")
+        lead = self.inputs[0].shape
+        channels = 0
+        for vec in self.inputs:
+            if vec.shape[:-1] != lead[:-1]:
+                raise ValueError(
+                    "spatial shapes differ: %s vs %s" % (vec.shape,
+                                                         lead))
+            channels += vec.shape[-1]
+        self.output.reset(numpy.zeros(lead[:-1] + (channels,),
+                                      numpy.float32))
+        self.init_vectors(self.output, *self.inputs)
+
+    def numpy_run(self):
+        self.output.map_invalidate()
+        mems = []
+        for vec in self.inputs:
+            vec.map_read()
+            mems.append(vec.mem)
+        self.output.mem = numpy.concatenate(mems, axis=-1)
+
+    def tpu_run(self):
+        self.output.devmem = jnp.concatenate(
+            [vec.devmem for vec in self.inputs], axis=-1)

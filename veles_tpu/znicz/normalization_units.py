@@ -28,6 +28,8 @@ class LRNormalizerForward(ForwardBase):
 
     MAPPING = "lrn"
 
+    MAPPING_ALIASES = ("norm",)
+
     def __init__(self, workflow, **kwargs):
         super(LRNormalizerForward, self).__init__(workflow, **kwargs)
         self.include_bias = False

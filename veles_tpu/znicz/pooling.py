@@ -212,6 +212,7 @@ class StochasticPooling(PoolingBase):
 
 class StochasticAbsPooling(PoolingBase):
     MAPPING = "stochasticabs_pooling"
+    MAPPING_ALIASES = ("stochastic_abs_pooling",)
     KIND = "stochasticabs"
 
 

@@ -51,6 +51,13 @@ GD_PAIRS = {
     "stochastic_abs_pool_depool": "gd_stochastic_pooling",
     "lrn": "gd_lrn",
     "dropout": "gd_dropout",
+    # reference-doc alias spellings (registered via MAPPING_ALIASES)
+    # pair with the same backwards as their canonical names
+    "all2all_str": "gd_strict_relu",
+    "conv_str": "gd_conv_strict_relu",
+    "activation_str": "gd_activation",
+    "norm": "gd_lrn",
+    "stochastic_abs_pooling": "gd_stochastic_pooling",
     "deconv": "gd_deconv",
     "cutter": "gd_cutter",
     "activation_tanh": "gd_activation",
