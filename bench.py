@@ -280,6 +280,15 @@ def _run_stage(name, timeout, env=None):
     tunnel platform, which overrides ``jax_platforms`` behind the env
     var's back at interpreter start)."""
     full_env = dict(os.environ)
+    # persistent XLA compilation cache: stage reruns (and future bench
+    # rounds on the same machine) skip the 20-40s first-compile cost
+    cache_dir = os.path.join(os.path.expanduser("~"), ".veles_tpu",
+                             "cache", "xla")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        full_env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    except OSError:
+        pass
     if env:
         for k, v in env.items():
             if v is None:

@@ -33,7 +33,7 @@ import pytest
 
 import conftest
 from veles_tpu.samples.datasets import (
-    cifar10_available, mnist_available)
+    cifar10_available, mnist_available, stl10_available)
 
 needs_mnist = pytest.mark.skipif(
     not mnist_available(),
@@ -108,3 +108,17 @@ def test_cifar_convnet_parity_17_21pct():
     err = _train("cifar10", epochs=40)["err_pt"]
     assert 0.0 <= err <= 17.21, \
         "CIFAR-10 parity gate failed: %.2f%% > 17.21%%" % err
+
+
+needs_stl10 = pytest.mark.skipif(
+    not stl10_available(),
+    reason="real STL-10 binaries not present under "
+           "root.common.dirs.datasets/stl10_binary")
+
+
+@needs_stl10
+def test_stl10_convnet_parity_35_10pct():
+    # ref manualrst_veles_algorithms.rst:51: STL-10 validation 35.10 %
+    err = _train("stl10", epochs=40)["err_pt"]
+    assert 0.0 <= err <= 35.10, \
+        "STL-10 parity gate failed: %.2f%% > 35.10%%" % err

@@ -235,3 +235,23 @@ def test_prefetch_double_buffering():
         assert "WORKING" in master.states_at_request
     finally:
         server.stop()
+
+
+def test_client_default_power_from_db(tmp_path, monkeypatch):
+    """Slaves advertise the autotune DB's measured device power when
+    present (ref client.py:309-312 power reporting)."""
+    import json
+
+    import jax
+
+    from veles_tpu import backends
+    from veles_tpu.parallel import jobs
+
+    model = jax.devices()[0].device_kind
+    db_path = tmp_path / "db.json"
+    db_path.write_text(json.dumps(
+        {model: {"power": {"chain_seconds": 0.01, "gflops": 123456.0}}}))
+    monkeypatch.setattr(backends, "DEVICE_INFOS_JSON", str(db_path))
+    assert jobs._default_power() == 123456.0
+    db_path.unlink()
+    assert jobs._default_power() == 1.0

@@ -336,6 +336,28 @@ class JobServer(Logger):
             self.info("  %r", slave)
 
 
+def _default_power():
+    """The slave's advertised computing power for master-side balancing
+    (ref ``client.py:309-312`` reports the device benchmark rating,
+    ``workflow.py:618-624``): the autotune DB's measured GFLOPs for this
+    device generation when present, else 1.0 (all slaves equal).  Never
+    measures inline — handshakes must not run a 13-chain matmul."""
+    try:
+        import jax
+
+        from veles_tpu import backends
+        model = jax.devices()[0].device_kind
+        info = backends.DeviceInfo.load_db(
+            backends.DEVICE_INFOS_JSON).get(model)
+        if info:
+            gflops = info.ratings.get("power", {}).get("gflops")
+            if gflops:
+                return float(gflops)
+    except Exception:
+        pass
+    return 1.0
+
+
 class JobClient(Logger):
     """Slave: pulls jobs, runs them through ``workflow.do_job``, pushes
     updates.  Reconnects with backoff; a mid-run join is just a late
@@ -349,7 +371,7 @@ class JobClient(Logger):
         self.workflow = workflow
         self.endpoint = endpoint
         self.sid = sid or uuid.uuid4().hex[:8]
-        self.power = power if power is not None else 1.0
+        self.power = power if power is not None else _default_power()
         #: fault injection (ref --slave-death-probability client.py:303)
         self.death_probability = death_probability
         self.heartbeat_interval = heartbeat_interval

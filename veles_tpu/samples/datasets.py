@@ -70,6 +70,19 @@ def cifar10_available():
     return _cifar10_paths() is not None
 
 
+def _stl10_paths():
+    base = os.path.join(_dataset_dir(), "stl10_binary")
+    names = ("train_X.bin", "train_y.bin", "test_X.bin", "test_y.bin")
+    paths = [os.path.join(base, n) for n in names]
+    return paths if all(os.path.exists(p) for p in paths) else None
+
+
+def stl10_available():
+    """True when the real STL-10 binaries sit under
+    ``<root.common.dirs.datasets>/stl10_binary/``."""
+    return _stl10_paths() is not None
+
+
 def load_mnist():
     """(train_x, train_y, test_x, test_y) floats in [0,1] / int labels,
     or synthetic 28×28 10-class stand-ins."""
@@ -121,10 +134,8 @@ def load_stl10():
     """STL-10 (96×96×3, 10 classes): binary layout from the official
     distribution (`stl10_binary/{train,test}_{X,y}.bin`, uint8 CHW
     column-major images, 1-based labels), else synthetic stand-ins."""
-    base = os.path.join(_dataset_dir(), "stl10_binary")
-    names = ("train_X.bin", "train_y.bin", "test_X.bin", "test_y.bin")
-    paths = [os.path.join(base, n) for n in names]
-    if all(os.path.exists(p) for p in paths):
+    paths = _stl10_paths()
+    if paths:
         def read_x(path):
             raw = numpy.fromfile(path, dtype=numpy.uint8)
             imgs = raw.reshape(-1, 3, 96, 96)

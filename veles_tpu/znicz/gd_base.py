@@ -176,3 +176,13 @@ class GDViaVJP(GradientDescentBase):
             super(GDViaVJP, self).verify_interface()
         finally:
             self._demanded = saved
+
+
+class GDGeneric(GDViaVJP):
+    """Registered generic backward for forward-only layer types whose
+    gradient is purely the VJP of their ``pure`` function (depooling,
+    channel splitting — the reference ships them forward-only and lets
+    the neighbouring GD units carry the error; here AD supplies the
+    exact transpose)."""
+
+    MAPPING = "gd_generic"

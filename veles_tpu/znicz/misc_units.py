@@ -276,6 +276,7 @@ class ChannelMerger(AcceleratedUnit):
         return self
 
     def initialize(self, device=None, **kwargs):
+        from veles_tpu.units import MissingDemandedAttributes
         super(ChannelMerger, self).initialize(device=device, **kwargs)
         for unit, attr in getattr(self, "_input_links", ()):
             vec = getattr(unit, attr)
@@ -283,6 +284,11 @@ class ChannelMerger(AcceleratedUnit):
                 self.inputs.append(vec)
         if not self.inputs:
             raise ValueError("ChannelMerger has no inputs")
+        if any(not vec for vec in self.inputs):
+            # producers not initialized yet — ask Workflow.initialize
+            # to requeue us after them (the demand-retry contract)
+            raise MissingDemandedAttributes(
+                "%r: input Vectors not yet allocated" % self.name)
         lead = self.inputs[0].shape
         channels = 0
         for vec in self.inputs:

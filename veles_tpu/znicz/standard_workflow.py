@@ -49,6 +49,9 @@ GD_PAIRS = {
     # generic VJP through the combined pure
     "stochastic_pool_depool": "gd_stochastic_pooling",
     "stochastic_abs_pool_depool": "gd_stochastic_pooling",
+    # forward-only layer types: backward is the pure function's VJP
+    "depooling": "gd_generic",
+    "channel_splitter": "gd_generic",
     "lrn": "gd_lrn",
     "dropout": "gd_dropout",
     # reference-doc alias spellings (registered via MAPPING_ALIASES)
@@ -146,11 +149,22 @@ class StandardWorkflow(AcceleratedWorkflow):
                 (mapping, ", ".join(sorted(UnitRegistry.mapped))))
         return klass(self, **params)
 
+    #: registered unit types that are NOT chainable layers — they have
+    #: no single input→output seam for link_forwards/link_gds
+    NON_LAYER_TYPES = frozenset({"zero_filter", "channel_merger"})
+
     def link_forwards(self):
         prev = self.loader
         prev_attr = "minibatch_data"
         from veles_tpu.znicz.normalization_units import DropoutForward
         for spec in self.layers:
+            if spec["type"] in self.NON_LAYER_TYPES:
+                raise ValueError(
+                    "%r is a service unit, not a chainable layer — "
+                    "construct it directly (e.g. ZeroFiller(wf, "
+                    "mask=...).target_unit = fwd; ChannelMerger(wf)"
+                    ".link_inputs(...)) instead of listing it in "
+                    "layers" % spec["type"])
             unit = self._make_unit(spec["type"], dict(spec.get("->", {})))
             unit.link_from(prev)
             unit.link_attrs(prev, ("input", prev_attr))
