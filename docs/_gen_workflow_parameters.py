@@ -1,0 +1,57 @@
+"""Regenerates docs/workflow_parameters.md from the live registry:
+
+    JAX_PLATFORMS=cpu python docs/_gen_workflow_parameters.py \
+        > docs/workflow_parameters.md
+"""
+from veles_tpu.units import UnitRegistry
+from veles_tpu.znicz import (  # noqa: F401 - populate the registry
+    activation, all2all, conv, misc_units, normalization_units,
+    pooling)
+
+print("""# Layer types and parameters
+
+(Parity topic: `manualrst_veles_workflow_parameters.rst:467-580`.
+Generated from the live registry — regenerate with
+`python docs/_gen_workflow_parameters.py > docs/workflow_parameters.md`.)
+
+Layer specs are dicts: `{"type": <mapping>, "->": {forward params},
+"<-": {backward params}}`.
+
+## Backward (`<-`) parameters — every trainable layer
+
+| Param | Meaning | Default |
+|---|---|---|
+| `learning_rate` | SGD step size | 0.01 |
+| `learning_rate_bias` | bias step size | = learning_rate |
+| `weights_decay` / `weights_decay_bias` | L2 coefficient | 0.0 |
+| `gradient_moment` / `gradient_moment_bias` | momentum | 0.0 |
+
+## Common forward (`->`) parameters
+
+| Param | Meaning |
+|---|---|
+| `output_sample_shape` | dense layer width |
+| `n_kernels`, `kx`, `ky`, `padding`, `sliding` | conv geometry |
+| `weights_filling` | `gaussian` / `uniform` / `constant` |
+| `weights_stddev` | init scale (default 1/sqrt(fan_in)) |
+| `dropout_ratio` | dropout probability |
+| `alpha`, `beta`, `k`, `n` | LRN hyperparameters |
+| `store_offsets` | pooling records offsets for Depooling |
+
+## Registered layer types
+
+| type | class | module |
+|---|---|---|""")
+for name in sorted(UnitRegistry.mapped):
+    cls = UnitRegistry.mapped[name]
+    mod = cls.__module__.replace("veles_tpu.", "")
+    print("| `%s` | %s | `%s` |" % (name, cls.__name__, mod))
+print("""
+Aliases (reference-doc short spellings) resolve to the same classes:
+`all2all_str`, `conv_str`, `activation_str`, `norm`,
+`stochastic_abs_pooling`.
+
+Forward-only types (`depooling`, `channel_splitter`, the combined
+pool-depools) pair with `gd_generic` — the exact VJP of their pure
+function.  `zero_filter` and `channel_merger` are service units
+constructed directly, not listed in `layers`.""")
