@@ -3,6 +3,12 @@
     JAX_PLATFORMS=cpu python docs/_gen_workflow_parameters.py \
         > docs/workflow_parameters.md
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 from veles_tpu.units import UnitRegistry
 from veles_tpu.znicz import (  # noqa: F401 - populate the registry
     activation, all2all, conv, misc_units, normalization_units,

@@ -306,3 +306,48 @@ def test_load_snapshot_from_url(tmp_path):
         assert restored.checksum() == wf.checksum()
     finally:
         httpd.shutdown()
+
+
+def test_manhole_stack_dump_and_repl(tmp_path):
+    """The debug backdoor (ref external/manhole + --manhole): SIGUSR1
+    dumps thread stacks; SIGUSR2 serves a socket REPL that evaluates
+    in the armed process."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    script = tmp_path / "armed.py"
+    script.write_text(
+        "import sys, time\n"
+        "from veles_tpu import manhole\n"
+        "manhole.install(namespace={'answer': 41})\n"
+        "print('ARMED', flush=True)\n"
+        "time.sleep(60)\n")
+    import veles_tpu
+    env_root = os.path.dirname(os.path.dirname(veles_tpu.__file__))
+    pythonpath = env_root + os.pathsep + os.environ.get("PYTHONPATH",
+                                                        "")
+    proc = subprocess.Popen(
+        [sys.executable, str(script)], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "PYTHONPATH": pythonpath,
+             "JAX_PLATFORMS": "cpu"})
+    try:
+        assert proc.stdout.readline().strip() == "ARMED"
+        # REPL: evaluate inside the process
+        from veles_tpu import manhole
+        transcript = manhole.connect(
+            proc.pid, commands=["answer + 1", "pid == %d" % proc.pid])
+        assert "42" in transcript
+        assert "True" in transcript
+        # stack dump: SIGUSR1 → faulthandler on stderr
+        os.kill(proc.pid, signal.SIGUSR1)
+        time.sleep(0.5)
+        proc.terminate()
+        _out, err = proc.communicate(timeout=10)
+        assert "Current thread" in err or "Thread" in err
+    finally:
+        if proc.poll() is None:
+            proc.kill()

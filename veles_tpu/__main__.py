@@ -182,6 +182,11 @@ class Main(Logger):
             except Exception:
                 pass
         self._setup_logging()
+        if args.manhole:
+            from veles_tpu import manhole
+            manhole.install(namespace={"main": self})
+            self.info("manhole armed: SIGUSR1 dumps stacks, SIGUSR2 "
+                      "serves a REPL (pid %d)", os.getpid())
         if args.debug_nans:
             import jax
             jax.config.update("jax_debug_nans", True)

@@ -101,6 +101,12 @@ def make_parser(prog="veles_tpu", description=None):
         "--ensemble-test", default="", metavar="INPUT_JSON",
         help="evaluate a trained ensemble listed in INPUT_JSON")
     parser.add_argument(
+        "--manhole", action="store_true",
+        help="arm the debug backdoor: SIGUSR1 dumps all thread stacks, "
+             "SIGUSR2 serves a REPL on a UNIX socket (attach with "
+             "python -m veles_tpu.manhole <pid>; ref --manhole "
+             "thread_pool.py:139)")
+    parser.add_argument(
         "--debug-nans", action="store_true",
         help="enable jax_debug_nans: any NaN produced on device raises "
              "at the emitting op (SURVEY §5.2's TPU 'sanitizer' — jit "

@@ -1,0 +1,242 @@
+"""Debug backdoor for live processes.
+
+Parity target: the reference's vendored manhole (``veles/external/
+manhole.py``, enabled via ``--manhole`` ``thread_pool.py:139``) — attach
+to a RUNNING training process without restarting it.
+
+TPU re-design, stdlib only:
+
+- ``SIGUSR1`` → dump every thread's stack to stderr (faulthandler) —
+  the first thing you want from a wedged run.
+- ``SIGUSR2`` → serve a line-oriented REPL on an abstract-namespace
+  UNIX socket ``\\0veles-manhole.<pid>``; connect with
+  ``python -m veles_tpu.manhole <pid>``.  Single connection at a time;
+  the socket only exists after the signal, so there is no always-open
+  backdoor.
+"""
+
+import code
+import io
+import logging
+import os
+import signal
+import socket
+import struct
+import sys
+import threading
+
+logger = logging.getLogger("manhole")
+
+
+def _peer_uid(conn):
+    """UID of the process on the other end (SO_PEERCRED)."""
+    creds = conn.getsockopt(socket.SOL_SOCKET, socket.SO_PEERCRED,
+                            struct.calcsize("3i"))
+    _pid, uid, _gid = struct.unpack("3i", creds)
+    return uid
+
+
+class _ThreadRoutedWriter:
+    """Delegates writes to a per-thread override, else the real stream —
+    so the REPL captures ONLY its own thread's output and concurrent
+    training threads keep printing to the console."""
+
+    def __init__(self, real):
+        self._real = real
+        self._local = threading.local()
+
+    def set_target(self, fobj):
+        self._local.target = fobj
+
+    def clear_target(self):
+        self._local.target = None
+
+    def __getattr__(self, name):
+        target = getattr(self._local, "target", None)
+        return getattr(target if target is not None else self._real,
+                       name)
+
+
+def _socket_addr(pid=None):
+    # abstract namespace: no filesystem entry to clean up; access
+    # control is SO_PEERCRED uid checks on BOTH ends (abstract names
+    # have no file permissions)
+    return "\0veles-manhole.%d" % (pid or os.getpid())
+
+
+class _SocketConsole(code.InteractiveConsole):
+    def __init__(self, conn, namespace):
+        super(_SocketConsole, self).__init__(locals=namespace)
+        self._file = conn.makefile("rw")
+
+    def write(self, data):
+        self._file.write(data)
+        self._file.flush()
+
+    def raw_input(self, prompt=""):
+        self.write(prompt)
+        line = self._file.readline()
+        if not line:
+            raise EOFError
+        return line.rstrip("\n")
+
+    def runcode(self, code_obj):
+        # route THIS thread's print()/tracebacks to the socket without
+        # touching other threads' stdout/stderr
+        with _routed_streams(self._file):
+            super(_SocketConsole, self).runcode(code_obj)
+        self._file.flush()
+
+
+_stream_lock = threading.Lock()
+
+
+class _routed_streams:
+    def __init__(self, fobj):
+        self._fobj = fobj
+
+    def __enter__(self):
+        with _stream_lock:
+            for name in ("stdout", "stderr"):
+                stream = getattr(sys, name)
+                if not isinstance(stream, _ThreadRoutedWriter):
+                    stream = _ThreadRoutedWriter(stream)
+                    setattr(sys, name, stream)
+                stream.set_target(self._fobj)
+
+    def __exit__(self, *exc):
+        for name in ("stdout", "stderr"):
+            stream = getattr(sys, name)
+            if isinstance(stream, _ThreadRoutedWriter):
+                stream.clear_target()
+
+
+def _serve_repl(namespace, accept_timeout=30.0):
+    server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        server.bind(_socket_addr())
+    except OSError as e:
+        logger.warning("manhole: cannot bind %r (%s) — an earlier REPL "
+                       "still listening, or the name is squatted",
+                       _socket_addr(), e)
+        return
+    server.listen(1)
+    # an unclaimed socket must not brick future SIGUSR2s — tear it
+    # down if nobody attaches promptly
+    server.settimeout(accept_timeout)
+    try:
+        conn, _ = server.accept()
+    except socket.timeout:
+        logger.warning("manhole: no client within %.0fs; closing",
+                       accept_timeout)
+        server.close()
+        return
+    try:
+        # code execution as this uid: only this uid may attach
+        uid = _peer_uid(conn)
+        if uid != os.getuid():
+            logger.error("manhole: rejecting peer uid %d", uid)
+            return
+        console = _SocketConsole(conn, dict(namespace or {},
+                                            pid=os.getpid()))
+        console.interact(
+            banner="veles_tpu manhole (pid %d) — ctrl-d detaches, the "
+                   "process keeps running" % os.getpid(),
+            exitmsg="detached")
+    except SystemExit:
+        pass
+    finally:
+        try:
+            conn.close()
+        finally:
+            server.close()
+
+
+_installed = False
+
+
+def install(namespace=None):
+    """Arm the backdoor signals (idempotent; main thread only —
+    call early, e.g. via the ``--manhole`` CLI flag)."""
+    global _installed
+    if _installed:
+        return
+    import faulthandler
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
+    def open_repl(_signum, _frame):
+        threading.Thread(target=_serve_repl, args=(namespace,),
+                         daemon=True, name="manhole").start()
+
+    signal.signal(signal.SIGUSR2, open_repl)
+    _installed = True
+
+
+def connect(pid, commands=None, timeout=10.0):
+    """Client side: signal the process and attach.  With ``commands``
+    (a list of source lines) runs them and returns the transcript;
+    otherwise bridges the socket to this terminal."""
+    os.kill(int(pid), signal.SIGUSR2)
+    deadline_sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    deadline_sock.settimeout(timeout)
+    import time
+    deadline = time.time() + timeout
+    while True:
+        try:
+            deadline_sock.connect(_socket_addr(int(pid)))
+            break
+        except (FileNotFoundError, ConnectionRefusedError):
+            if time.time() > deadline:
+                raise
+            time.sleep(0.05)
+    # the name is squattable by other users — refuse to talk to a
+    # server that is not our own uid
+    uid = _peer_uid(deadline_sock)
+    if uid != os.getuid():
+        deadline_sock.close()
+        raise PermissionError(
+            "manhole socket for pid %s is owned by uid %d, not us" % (
+                pid, uid))
+    # connection phase done: REPL commands may legitimately take longer
+    # than the connect timeout (the process is busy — that is WHY we
+    # are attaching)
+    deadline_sock.settimeout(None)
+    if commands is None:
+        _bridge(deadline_sock)
+        return None
+    out = io.StringIO()
+    fobj = deadline_sock.makefile("rw")
+    for line in list(commands) + [""]:
+        fobj.write(line + "\n")
+    fobj.flush()
+    deadline_sock.shutdown(socket.SHUT_WR)
+    for chunk in fobj:
+        out.write(chunk)
+    deadline_sock.close()
+    return out.getvalue()
+
+
+def _bridge(sock):     # pragma: no cover - interactive
+    fobj = sock.makefile("rw")
+    import selectors
+    sel = selectors.DefaultSelector()
+    sel.register(sock, selectors.EVENT_READ, "sock")
+    sel.register(sys.stdin, selectors.EVENT_READ, "stdin")
+    while True:
+        for key, _ in sel.select():
+            if key.data == "sock":
+                data = sock.recv(4096)
+                if not data:
+                    return
+                sys.stdout.write(data.decode(errors="replace"))
+                sys.stdout.flush()
+            else:
+                line = sys.stdin.readline()
+                if not line:
+                    return
+                fobj.write(line)
+                fobj.flush()
+
+
+if __name__ == "__main__":     # pragma: no cover - CLI entry
+    connect(sys.argv[1])
