@@ -351,3 +351,39 @@ def test_manhole_stack_dump_and_repl(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_snapshot_db_store_roundtrip(tmp_path):
+    """SQLite snapshot store (the reference's ODBC variant): export
+    rows, resume by id or latest via -w 'db://path#id'."""
+    from veles_tpu.snapshotter import SnapshotterToDB, load_snapshot
+
+    db = str(tmp_path / "snaps.sqlite")
+    wf = make_wf(tmp_path, max_epochs=1)
+    wf.run()
+    snap = SnapshotterToDB(wf, database=db, time_interval=0.0)
+    snap.export()
+    first = snap.destination
+    assert first.startswith("db://") and first.endswith("#1")
+    snap.suffix = "better"
+    snap.export()
+    restored = load_snapshot(first)
+    assert restored.checksum() == wf.checksum()
+    latest = load_snapshot("db://%s#latest" % db)
+    assert latest.checksum() == wf.checksum()
+    import pytest as _pytest
+    with _pytest.raises(KeyError):
+        load_snapshot("db://%s#99" % db)
+    # a '#' in the database path itself must not confuse parsing
+    import os as _os
+    weird_dir = tmp_path / "run#3"
+    weird_dir.mkdir()
+    db2 = str(weird_dir / "s.sqlite")
+    snap2 = SnapshotterToDB(wf, database=db2, time_interval=0.0)
+    snap2.export()
+    assert load_snapshot(snap2.destination).checksum() == wf.checksum()
+    assert load_snapshot("db://%s" % db2).checksum() == wf.checksum()
+    # resume from a typo'd path fails WITHOUT creating the file
+    with _pytest.raises(KeyError):
+        load_snapshot("db://%s.typo#latest" % db2)
+    assert not _os.path.exists(db2 + ".typo")

@@ -35,6 +35,15 @@ CODECS = {
            lambda path: lzma.open(path, "rb")),
 }
 
+#: in-memory (compress, decompress) pairs for blob stores — the same
+#: codec names as CODECS (level 6 like the file writers)
+BYTES_CODECS = {
+    "": (lambda b: b, lambda b: b),
+    "gz": (lambda b: gzip.compress(b, 6), gzip.decompress),
+    "bz2": (lambda b: bz2.compress(b, 6), bz2.decompress),
+    "xz": (lambda b: lzma.compress(b, preset=1), lzma.decompress),
+}
+
 SIZE_WARNING_BYTES = 500 * 1024 * 1024
 
 
@@ -96,6 +105,14 @@ class SnapshotterBase(Unit):
 
     def _join_pending_write(self):
         pass
+
+    def get_metric_values(self):
+        """Publishes the snapshot reference into result files so
+        consumers (e.g. EnsembleTestManager) can resume the trained
+        model."""
+        if getattr(self, "destination", None):
+            return {"snapshot": self.destination}
+        return {}
 
 
 class SnapshotterToFile(SnapshotterBase):
@@ -166,13 +183,6 @@ class SnapshotterToFile(SnapshotterBase):
         self._join_pending_write()
         super(SnapshotterToFile, self).stop()
 
-    def get_metric_values(self):
-        """Publishes the snapshot path into result files so consumers
-        (e.g. EnsembleTestManager) can resume the trained model."""
-        if getattr(self, "destination", None):
-            return {"snapshot": self.destination}
-        return {}
-
     @staticmethod
     def import_(path):
         """Load a snapshot by path, auto-detecting the codec
@@ -189,6 +199,8 @@ def load_snapshot(path):
     http(s):// URL (ref ``__main__.py:539-590`` ``_load_workflow``
     resumes from URLs too): a URL is streamed to a temp file first so
     the codec sniffing and pickling path stay identical."""
+    if path.startswith("db://"):
+        return SnapshotterToDB.import_(path)
     if path.startswith(("http://", "https://")):
         import shutil
         import tempfile
@@ -212,3 +224,118 @@ def save_snapshot(workflow, path):
     with opener(path) as fout:
         pickle.dump(workflow, fout, protocol=pickle.HIGHEST_PROTOCOL)
     return path
+
+
+class SnapshotterToDB(SnapshotterBase):
+    """Store snapshots as rows in a SQLite database (the reference's
+    ODBC variant, ``snapshotter.py:428+``, re-based on stdlib sqlite3 —
+    no driver setup, same "resume by id from a shared store" workflow).
+
+    Rows: (id, prefix, suffix, created, codec, blob).  Resume with
+    ``-w 'db://<database-path>#<id|latest>'``.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(SnapshotterToDB, self).__init__(workflow, **kwargs)
+        self.database = kwargs.get("database") or os.path.join(
+            root.common.dirs.get("snapshots", "."), "snapshots.sqlite")
+        self.compression = kwargs.get("compression", "gz")
+        if self.compression not in CODECS:
+            raise ValueError("unknown compression %r" % self.compression)
+
+    def init_unpickled(self):
+        super(SnapshotterToDB, self).init_unpickled()
+        self._write_future_ = None
+
+    @staticmethod
+    def _connect_rw(database):
+        import sqlite3
+        os.makedirs(os.path.dirname(os.path.abspath(database)),
+                    exist_ok=True)
+        conn = sqlite3.connect(database)
+        conn.execute(
+            "CREATE TABLE IF NOT EXISTS snapshots ("
+            "id INTEGER PRIMARY KEY AUTOINCREMENT, prefix TEXT, "
+            "suffix TEXT, created REAL, codec TEXT, blob BLOB)")
+        return conn
+
+    def export(self):
+        data = pickle.dumps(self.workflow,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        self._join_pending_write()
+        # destination is known up front except the rowid; the write
+        # (compress + INSERT) runs on the host pool like the file
+        # variant — the training loop must not stall on gzip
+        self._destination = None
+        from veles_tpu import thread_pool
+        self._write_future_ = thread_pool.submit(
+            self._write, data, self.compression, self.suffix or "")
+
+    def _write(self, data, codec, suffix):
+        blob = BYTES_CODECS[codec][0](data)
+        conn = self._connect_rw(self.database)
+        try:
+            with conn:
+                cur = conn.execute(
+                    "INSERT INTO snapshots (prefix, suffix, created, "
+                    "codec, blob) VALUES (?, ?, ?, ?, ?)",
+                    (self.prefix, suffix, time.time(), codec, blob))
+                rowid = cur.lastrowid
+        finally:
+            conn.close()
+        self._destination = "db://%s#%d" % (self.database, rowid)
+        self.info("snapshot stored as id %d in %s (%d bytes)",
+                  rowid, self.database, len(blob))
+
+    def _join_pending_write(self):
+        fut, self._write_future_ = self._write_future_, None
+        if fut is not None:
+            try:
+                fut.result()
+            except Exception:
+                self.exception("background snapshot insert failed")
+
+    def stop(self):
+        self._join_pending_write()
+        super(SnapshotterToDB, self).stop()
+
+    @classmethod
+    def import_(cls, spec):
+        """``db://<database>[#<id|latest>]`` → unpickled workflow.
+
+        Read-only: a wrong path fails with KeyError instead of
+        materializing an empty database.  The fragment must be a row
+        id or ``latest`` — ``#`` inside the database path itself is
+        handled by only honoring a valid trailing fragment."""
+        import re
+        import sqlite3
+        body = spec[len("db://"):]
+        database, sep, rowid = body.rpartition("#")
+        if not sep or not re.fullmatch(r"\d+|latest", rowid):
+            database, rowid = body, "latest"
+        if not os.path.exists(database):
+            raise KeyError("snapshot database %r does not exist"
+                           % database)
+        from urllib.parse import quote
+        # percent-encode: '#'/'?' in the path are URI metacharacters
+        conn = sqlite3.connect(
+            "file:%s?mode=ro" % quote(os.path.abspath(database),
+                                      safe="/"), uri=True)
+        try:
+            if rowid == "latest":
+                row = conn.execute(
+                    "SELECT codec, blob FROM snapshots "
+                    "ORDER BY id DESC LIMIT 1").fetchone()
+            else:
+                row = conn.execute(
+                    "SELECT codec, blob FROM snapshots WHERE id = ?",
+                    (int(rowid),)).fetchone()
+        except sqlite3.Error as e:
+            raise KeyError("cannot read snapshot db %r: %s"
+                           % (database, e))
+        finally:
+            conn.close()
+        if row is None:
+            raise KeyError("no snapshot %r in %s" % (rowid, database))
+        codec, blob = row
+        return pickle.loads(BYTES_CODECS[codec][1](blob))
