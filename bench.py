@@ -254,10 +254,11 @@ def stage_mnist_e2e():
 
 def stage_alexnet():
     from veles_tpu.samples import alexnet
+    batch = int(os.environ.get("BENCH_ALEXNET_BATCH", "256"))
     _conv_stage(
         "AlexNet fused train throughput per chip (bf16)",
-        alexnet.LAYERS, alexnet.INPUT_SHAPE, 1000, batch=256, steps=10,
-        vs=V100_ALEXNET_IMG_PER_SEC)
+        alexnet.LAYERS, alexnet.INPUT_SHAPE, 1000, batch=batch,
+        steps=10, vs=V100_ALEXNET_IMG_PER_SEC)
 
 
 STAGES = {
