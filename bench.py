@@ -262,7 +262,9 @@ def stage_alexnet():
 
 
 STAGES = {
-    "probe": (stage_probe, 180),
+    # healthy-tunnel probe = import + one 256² matmul compile (~40 s);
+    # 120 s caps the loss when the tunnel is wedged and hangs
+    "probe": (stage_probe, 120),
     "mnist": (stage_mnist, 150),
     "mnist_e2e": (stage_mnist_e2e, 240),
     "cifar": (stage_cifar, 210),
