@@ -45,15 +45,16 @@ def _peak_flops(device_kind):
     return peak_bf16_flops(device_kind)
 
 
-def _measure(step_fn, params, x, labels, steps, min_seconds=2.0):
-    """Honest (sec_per_step, flops_per_step): K steps looped INSIDE one
-    jitted program, synced by a host fetch of a result-derived probe,
-    fixed overhead cancelled by marginal timing.  block_until_ready is
-    never trusted (round-2 post-mortem: through the tunneled PJRT
-    transport it acks dispatch, not completion — see ops/timing.py)."""
+def _measure(step_fn, params, x, labels, steps):
+    """Honest (sec_per_step, flops_per_step): ONE compiled program
+    loops the step with a runtime trip count and is timed at two trip
+    counts; the marginal cancels per-program dispatch/fetch overhead
+    exactly.  block_until_ready is never trusted (round-2 post-mortem:
+    through the tunneled PJRT transport it acks dispatch, not
+    completion), and neither is timing across program launches
+    (round-3: it measured above chip peak — see ops/timing.py)."""
     from veles_tpu.ops.timing import measure_fused_step
-    return measure_fused_step(step_fn, params, x, labels, k=steps,
-                              min_seconds=min_seconds)
+    return measure_fused_step(step_fn, params, x, labels, k=steps)
 
 
 # --------------------------------------------------------------------------

@@ -281,8 +281,16 @@ def test_timing_multi_step_and_marginal():
     assert vals.shape == (2,)
     assert numpy.isfinite(vals).all()
 
+    # measurement needs a step with real work — a trivial step's
+    # marginal is pure dispatch jitter and can come out non-positive
+    def heavy_step(params, x, labels):
+        m = params["m"]
+        m = m + 1e-4 * (m @ m)
+        return {"m": m}, {"loss": jnp.sum(m)}
+
+    heavy = {"m": jnp.eye(512, dtype=jnp.float32) * 0.01}
     sec_per_step, flops = measure_fused_step(
-        step, params, x, labels, k=5, min_seconds=0.05, donate=False)
+        heavy_step, heavy, x, labels, k=5, donate=False)
     assert sec_per_step > 0
 
     calls = []
@@ -292,6 +300,86 @@ def test_timing_multi_step_and_marginal():
 
     per = marginal_time(call, min_seconds=0.01)
     assert per > 0
+
+
+def test_timing_inprogram_marginal_and_dynamic_k():
+    """Round-3 stopwatch: ONE compiled program timed at two runtime
+    trip counts (cross-launch timing measured above chip peak on the
+    tunneled transport); flops come from a loop program's cost = 2
+    steps, never total/K."""
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.ops.timing import (
+        host_fetch, inprogram_marginal, make_multi_step,
+        measure_fused_step)
+
+    def step(params, x, labels):
+        p = params["w"]
+        p = p + 0.25 * jnp.mean(x) + 0.001 * labels.sum()
+        return {"w": p}, {"loss": jnp.sum(p)}
+
+    params = {"w": jnp.zeros((4,), jnp.float32)}
+    x = jnp.ones((2, 4), jnp.float32)
+    labels = jnp.zeros((2,), jnp.int32)
+
+    # dynamic trip count: the SAME jitted multi runs 3 and 7 steps
+    multi = make_multi_step(step)
+    jitted = jax.jit(multi)
+    for n in (3, 7):
+        out_params, _probe = jitted(params, x, labels,
+                                    numpy.int32(n))
+        numpy.testing.assert_allclose(
+            host_fetch(out_params["w"]),
+            numpy.full((4,), 0.25 * n), rtol=1e-6)
+
+    # a unit with real work (a no-op unit's marginal is pure dispatch
+    # jitter and can come out non-positive on a loaded CI machine)
+    w = jnp.eye(128, dtype=jnp.float32) * 0.999
+
+    def unit(c):
+        return jnp.tanh(c @ w)
+
+    per = inprogram_marginal(unit, jnp.ones((128, 128), jnp.float32),
+                             k1=2, k2=64, target_signal=0.05)
+    assert per > 0
+
+    # measure_fused_step returns a positive marginal and flops of ONE
+    # step (the loop program's cost analysis counts its body once, so
+    # program total = inline first step + body = 2 steps).  The step
+    # must do real work: a trivial step's marginal is dispatch jitter.
+    def heavy_step(params, x, labels):
+        m = params["m"]
+        m = m + 1e-4 * (m @ m)
+        return {"m": m}, {"loss": jnp.sum(m)}
+
+    heavy = {"m": jnp.eye(512, dtype=jnp.float32) * 0.01}
+    sec_per_step, flops = measure_fused_step(heavy_step, heavy, x,
+                                             labels, k=8)
+    assert sec_per_step > 0
+    one_step = jax.jit(heavy_step).lower(heavy, x, labels).compile()
+    from veles_tpu.ops.timing import cost_flops
+    expect = cost_flops(one_step)
+    if expect and flops:
+        # probe/loop bookkeeping adds a handful of scalar flops
+        assert flops == pytest.approx(expect, rel=0.5)
+
+
+def test_peak_guard_rejects_faster_than_hardware(monkeypatch):
+    """A marginal implying more FLOPs than the chip's peak must be
+    re-measured and then refused, never recorded (the round-2 MFU-54
+    failure class)."""
+    from veles_tpu.ops import benchmark as B
+
+    monkeypatch.setattr("veles_tpu.backends.peak_bf16_flops",
+                        lambda kind: 100e12)
+    # 1e12 flops in 1e-3 s = 1000 TFLOPs >> 100 peak: reject
+    with pytest.raises(RuntimeError, match="exceeds"):
+        B._peak_guard(1e-3, 1e12, lambda: 1e-3, "test")
+    # 1e12 flops in 0.02 s = 50 TFLOPs < 100 peak: accepted unchanged
+    assert B._peak_guard(0.02, 1e12, lambda: 0.02, "test") == 0.02
+    # first reading absurd, re-measurement sane: keep the re-measured
+    assert B._peak_guard(1e-3, 1e12, lambda: 0.02, "test") == 0.02
 
 
 def test_autotune_db_drives_dispatch(tmp_path, monkeypatch):
