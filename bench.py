@@ -307,9 +307,7 @@ def _run_stage(name, timeout, env=None):
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=full_env,
         cwd=os.path.dirname(os.path.abspath(__file__)))
-    try:
-        out, errout = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
+    def reap():
         # SIGTERM first and give the JAX client a grace period to
         # release its chip claim — a SIGKILL mid-claim has been
         # observed to wedge the tunnel relay for hours
@@ -319,7 +317,17 @@ def _run_stage(name, timeout, env=None):
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.communicate()
+
+    try:
+        out, errout = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        reap()
         return None, "timeout after %ds" % timeout
+    except BaseException:
+        # ctrl-C etc. — don't leak a stage child still claiming the
+        # chip (subprocess.run's internal cleanup used to cover this)
+        reap()
+        raise
     if proc.returncode != 0:
         tail = (errout or "").strip().splitlines()[-6:]
         return None, "rc=%d: %s" % (proc.returncode, " | ".join(tail))

@@ -9,6 +9,7 @@ import pytest
 from veles_tpu.backends import CPUDevice, NumpyDevice
 from veles_tpu.dummy import DummyLauncher, DummyWorkflow
 from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.memory import Vector
 from veles_tpu.znicz.activation import ForwardStrictRELU, ForwardTanh
 from veles_tpu.znicz.conv import Conv
 from veles_tpu.znicz.misc_units import Cutter, Deconv
@@ -44,6 +45,66 @@ def test_conv_padding_and_stride():
     out = Conv.pure({"w": w}, x, padding=(1, 1, 1, 1), sliding=(2, 2))
     assert out.shape == (1, 4, 4, 2)
     assert float(out[0, 1, 1, 0]) == 9.0     # interior window all-ones
+
+
+def test_conv_space_to_depth_exact():
+    """The space-to-depth rewrite of a strided conv (s×s spatial phases
+    regrouped into input lanes — how a small-channel stride-4 conv like
+    AlexNet conv1 reaches MXU lane occupancy) is numerically exact,
+    gradients included, across kernel/stride/padding combinations."""
+    import jax
+
+    rng = numpy.random.default_rng(7)
+    cases = [
+        (227, 227, 3, 11, 11, 4, (0, 0, 0, 0)),   # AlexNet conv1
+        (32, 32, 3, 5, 5, 2, (2, 1, 2, 1)),       # asymmetric padding
+        (17, 19, 8, 3, 3, 3, (1, 1, 0, 2)),       # kernel < stride·2
+        (20, 20, 2, 7, 5, 5, (0, 0, 0, 0)),       # kernel < stride (kx)
+    ]
+    for h, wd, c, ky, kx, s, pad in cases:
+        x = rng.standard_normal((2, h, wd, c)).astype(numpy.float32)
+        w = rng.standard_normal((ky, kx, c, 16)).astype(numpy.float32)
+        b = rng.standard_normal(16).astype(numpy.float32)
+        p = {"w": jnp.asarray(w), "b": jnp.asarray(b)}
+        ref = Conv.pure(p, jnp.asarray(x), padding=pad, sliding=(s, s),
+                        s2d=False)
+        new = Conv.pure(p, jnp.asarray(x), padding=pad, sliding=(s, s),
+                        s2d=True)
+        assert ref.shape == new.shape
+        numpy.testing.assert_allclose(numpy.asarray(new),
+                                      numpy.asarray(ref), atol=1e-3)
+
+    def loss(p, x_, s2d):
+        return Conv.pure(p, x_, sliding=(4, 4), s2d=s2d).sum()
+
+    x = jnp.asarray(rng.standard_normal((2, 31, 31, 3))
+                    .astype(numpy.float32))
+    p = {"w": jnp.asarray(rng.standard_normal((11, 11, 3, 8))
+                          .astype(numpy.float32))}
+    g0 = jax.grad(loss)(p, x, False)["w"]
+    g1 = jax.grad(loss)(p, x, True)["w"]
+    numpy.testing.assert_allclose(numpy.asarray(g1), numpy.asarray(g0),
+                                  atol=1e-3)
+
+
+def test_conv_unit_enables_s2d_for_strided_small_channel():
+    """pure_config flips s2d on exactly when it pays: symmetric stride
+    > 1 and few input channels (the lanes it frees)."""
+    wf = DummyWorkflow()
+    unit = Conv(wf, n_kernels=96, kx=11, ky=11, sliding=(4, 4))
+    unit.input = Vector(numpy.zeros((2, 227, 227, 3), numpy.float32))
+    unit.initialize(device=None)
+    assert unit.pure_config()["s2d"] is True
+
+    unit2 = Conv(wf, n_kernels=8, kx=3, ky=3)          # stride 1
+    unit2.input = Vector(numpy.zeros((2, 8, 8, 3), numpy.float32))
+    unit2.initialize(device=None)
+    assert unit2.pure_config()["s2d"] is False
+
+    unit3 = Conv(wf, n_kernels=8, kx=5, ky=5, sliding=(2, 2))
+    unit3.input = Vector(numpy.zeros((2, 16, 16, 256), numpy.float32))
+    unit3.initialize(device=None)                      # wide input
+    assert unit3.pure_config()["s2d"] is False
 
 
 def test_pooling_golden():

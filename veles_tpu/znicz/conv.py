@@ -25,6 +25,42 @@ from veles_tpu.znicz.gd_base import GDViaVJP
 from veles_tpu.znicz.nn_units import ForwardBase
 
 
+def _s2d_conv(x, w, s, padding, pref):
+    """Stride-``s`` conv computed as a stride-1 conv over
+    space-to-depth-transformed input — numerically EXACT.
+
+    out[b,i,j,o] = Σ_{dy,dx,c} x[b, i·s+dy, j·s+dx, c]·w[dy,dx,c,o];
+    splitting dy = p·s+q (q<s) regroups the sum as a stride-1 conv
+    with kernel (⌈ky/s⌉, ⌈kx/s⌉) over channels (q, q', c) — the s×s
+    spatial phases become input lanes.  Weights are zero-padded to a
+    multiple of ``s`` and regrouped the same way, inside the program
+    (the stored layout stays (ky, kx, C, K); the regroup is a few KB).
+    """
+    left, right, top, bottom = padding
+    ky, kx, c, n_k = w.shape
+    x = jnp.pad(x, ((0, 0), (top, bottom), (left, right), (0, 0)))
+    b_, h, wd, _c = x.shape
+    out_h = (h - ky) // s + 1
+    out_w = (wd - kx) // s + 1
+    py, px = -(-ky // s), -(-kx // s)
+    # spatial dims up to a multiple of s (extra rows/cols only feed
+    # windows beyond out_h/out_w, cropped below)
+    hp, wp = -(-h // s) * s, -(-wd // s) * s
+    x = jnp.pad(x, ((0, 0), (0, hp - h), (0, wp - wd), (0, 0)))
+    x = x.reshape(b_, hp // s, s, wp // s, s, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)          # (B, Hb, Wb, q, q', C)
+    x = x.reshape(b_, hp // s, wp // s, s * s * c)
+    w = jnp.pad(w, ((0, py * s - ky), (0, px * s - kx), (0, 0), (0, 0)))
+    w = w.reshape(py, s, px, s, c, n_k)
+    w = w.transpose(0, 2, 1, 3, 4, 5)          # (p, p', q, q', C, K)
+    w = w.reshape(py, px, s * s * c, n_k)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=((0, 0), (0, 0)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=pref)
+    return out[:, :out_h, :out_w, :]
+
+
 class Conv(ForwardBase):
     """2-D convolution; input (B, H, W, C); weights (ky, kx, C, K)."""
 
@@ -44,26 +80,43 @@ class Conv(ForwardBase):
         self.sliding = tuple(kwargs.get("sliding", (1, 1)))
 
     def pure_config(self):
+        # space-to-depth rewrite for strided small-channel convs: a
+        # stride-s conv over C channels occupies C of the MXU's 128
+        # input lanes (AlexNet conv1: 3/128); regrouping s×s spatial
+        # blocks into channels is EXACT and turns it into a stride-1
+        # conv over C·s² lanes (3→48).  The backward pass becomes a
+        # stride-1 transposed conv, which tiles better too.
+        sx, sy = self.sliding
+        c_in = self.input.shape[-1] if self.input else None
+        s2d = bool(c_in and sx == sy and sx > 1 and
+                   c_in <= 32 and c_in * sx * sx <= 256)
         return {"padding": self.padding, "sliding": self.sliding,
-                "activation": self.ACTIVATION}
+                "activation": self.ACTIVATION, "s2d": s2d}
 
     @staticmethod
     @functools.partial(jax.jit, static_argnames=("padding", "sliding",
-                                                 "activation"))
+                                                 "activation", "s2d"))
     def pure(params, x, padding=(0, 0, 0, 0), sliding=(1, 1),
-             activation=None):
+             activation=None, s2d=False):
         left, right, top, bottom = padding
         # sliding is (x, y) like the reference; NHWC strides are (H, W)
         # bf16 inputs: omit preferred_element_type — XLA:TPU already
         # accumulates bf16 convs in fp32 on the MXU, and an explicit
         # f32 output breaks the transposed conv in the VJP (dtype mix)
         pref = jnp.float32 if x.dtype == jnp.float32 else None
-        out = jax.lax.conv_general_dilated(
-            x, params["w"],
-            window_strides=(sliding[1], sliding[0]),
-            padding=((top, bottom), (left, right)),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=pref)
+        if s2d:
+            if sliding[0] != sliding[1]:
+                raise ValueError(
+                    "s2d conv requires symmetric sliding, got %r"
+                    % (sliding,))
+            out = _s2d_conv(x, params["w"], sliding[0], padding, pref)
+        else:
+            out = jax.lax.conv_general_dilated(
+                x, params["w"],
+                window_strides=(sliding[1], sliding[0]),
+                padding=((top, bottom), (left, right)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=pref)
         if "b" in params:
             out = out + params["b"]
         return _ACT[activation](out).astype(x.dtype)
