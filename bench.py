@@ -253,6 +253,61 @@ def stage_mnist_e2e():
               compiled, flops=cost_flops(compiled))
 
 
+def stage_ae():
+    """MNIST autoencoder (BASELINE.json.configs[2]): 784→100→784
+    sigmoid MLP, MSE reconstruction loss, fused train step."""
+    import numpy
+
+    import jax
+    from veles_tpu import prng
+    from veles_tpu.samples.mnist_ae import make_layers
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    prng.seed_all(1234)
+    batch = 8192
+    params, step_fn, _eval, _apply = lower_specs(make_layers(), (784,),
+                                                 loss="mse")
+    rng = numpy.random.default_rng(0)
+    x = jax.device_put(
+        rng.standard_normal((batch, 784)).astype(numpy.float32))
+    sec, flops = _measure(step_fn, params, x, x, steps=100)
+    _emit("MNIST784 autoencoder fused train throughput", sec, batch,
+          flops)
+
+
+def stage_kohonen():
+    """Kohonen SOM (BASELINE.json.configs[4]): non-gradient training —
+    the random + matrix_reduce substrate.  32×32 map over 784-d data."""
+    import numpy
+
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu.ops.timing import inprogram_marginal
+    from veles_tpu.znicz.kohonen import _som_step
+
+    side, dim, batch = 32, 784, 4096
+    n = side * side
+    rng = numpy.random.default_rng(0)
+    weights = jax.device_put(
+        rng.standard_normal((n, dim)).astype(numpy.float32))
+    grid = jax.device_put(numpy.stack(numpy.meshgrid(
+        numpy.arange(side), numpy.arange(side)),
+        axis=-1).reshape(n, 2).astype(numpy.float32))
+    x = jax.device_put(
+        rng.standard_normal((batch, dim)).astype(numpy.float32))
+    radius = jnp.float32(side / 4.0)
+
+    def unit(w):
+        new_w, _winners = _som_step(w, grid, x, radius,
+                                    jnp.float32(0.1), (side, side))
+        return new_w
+    sec = inprogram_marginal(unit, weights, k1=2, k2=16)
+    # distance cross-term + neighborhood-weighted update matmuls
+    # dominate: 2·B·N·D each; elementwise terms ~B·N
+    flops = 4.0 * batch * n * dim + 10.0 * batch * n
+    _emit("Kohonen SOM 32x32 train throughput", sec, batch, flops)
+
+
 def stage_alexnet():
     from veles_tpu.samples import alexnet
     batch = int(os.environ.get("BENCH_ALEXNET_BATCH", "256"))
@@ -272,6 +327,8 @@ STAGES = {
     "mnist": (stage_mnist, 150),
     "mnist_e2e": (stage_mnist_e2e, 240),
     "cifar": (stage_cifar, 210),
+    "ae": (stage_ae, 150),
+    "kohonen": (stage_kohonen, 150),
     "alexnet": (stage_alexnet, 600),
 }
 
@@ -375,7 +432,9 @@ def main():
     print("probe ok: %s" % json.dumps(probe), file=sys.stderr)
 
     printed_any = False
-    for name in ("mnist", "mnist_e2e", "cifar", "alexnet"):
+    # alexnet LAST: the final parsed line is the headline metric
+    for name in ("mnist", "mnist_e2e", "cifar", "ae", "kohonen",
+                 "alexnet"):
         if only and name not in only:
             continue
         _fn, cap = STAGES[name]
