@@ -9,10 +9,13 @@ merging, ``server.py``/``client.py``) with the BASELINE.json north star:
   axis and parameters replicated; XLA inserts the ICI all-reduce
   (``psum``) where the reference mailed gradients through ZMQ
   (:mod:`veles_tpu.parallel.dp`).
-* **cross-slice / DCN**: the reference's *job* model survives one level
-  up — whole training runs (GA members, ensemble models, elastic eval)
-  farmed to workers over a line-protocol control plane with
-  requeue-on-drop (:mod:`veles_tpu.parallel.jobs`).
+* **cross-slice / DCN**: two paths.  Lockstep SPMD across hosts via
+  JAX's multi-controller runtime — one global mesh spanning processes,
+  collectives riding ICI in-slice and DCN across
+  (:mod:`veles_tpu.parallel.multihost`).  And the reference's *job*
+  model one level up — whole training runs (GA members, ensemble
+  models, elastic eval) farmed to workers over a line-protocol control
+  plane with requeue-on-drop (:mod:`veles_tpu.parallel.jobs`).
 """
 
 from veles_tpu.parallel.mesh import (  # noqa: F401
@@ -24,3 +27,4 @@ from veles_tpu.parallel.pp import pipeline_apply  # noqa: F401
 from veles_tpu.parallel.tp import (  # noqa: F401
     column_parallel, constrain, row_parallel, shard_dim, sharding_tree)
 from veles_tpu.parallel.moe import moe_mlp  # noqa: F401
+from veles_tpu.parallel import multihost  # noqa: F401

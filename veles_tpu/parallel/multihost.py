@@ -1,0 +1,117 @@
+"""Multi-host (multi-process) execution over DCN.
+
+Parity target: the reference scales one master + N slave processes over
+ZeroMQ to ~100 nodes (``manualrst_veles_distributed_training.rst:4``,
+``veles/server.py``/``client.py``).  That star topology ships pickled
+job payloads; gradients ride the job protocol.
+
+TPU re-design: JAX's native multi-controller model.  Every host runs
+the SAME program, :func:`initialize` joins them into one runtime
+(coordinator + N processes), and ``jax.devices()`` becomes the GLOBAL
+device list — a single :func:`veles_tpu.parallel.make_mesh` then spans
+hosts, and the collectives XLA inserts for the mesh ride ICI within a
+slice and DCN across slices.  No gradient bytes ever touch Python.
+The ZMQ job layer (:mod:`veles_tpu.parallel.jobs`) remains for
+ELASTIC work distribution (genetics/ensembles, heterogeneous fleets);
+this module is the flat SPMD path where all hosts step in lockstep.
+
+On real TPU pods ``jax.distributed.initialize()`` auto-detects all
+arguments from the TPU metadata; explicit arguments (or the
+``VELES_COORDINATOR`` / ``VELES_NUM_PROCS`` / ``VELES_PROC_ID`` env
+vars, which the ssh bootstrap in :mod:`veles_tpu.launcher` forwards)
+cover CPU/GPU fleets and tests.
+"""
+
+import os
+
+import jax
+import numpy
+
+_initialized = False
+
+
+def initialize(coordinator=None, num_processes=None, process_id=None,
+               local_device_ids=None):
+    """Join this process into the global JAX runtime.
+
+    Argument resolution order: explicit args > ``VELES_COORDINATOR`` /
+    ``VELES_NUM_PROCS`` / ``VELES_PROC_ID`` env vars > JAX
+    auto-detection (TPU pod metadata).  Idempotent.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator = coordinator or os.environ.get("VELES_COORDINATOR")
+    if num_processes is None and "VELES_NUM_PROCS" in os.environ:
+        num_processes = int(os.environ["VELES_NUM_PROCS"])
+    if process_id is None and "VELES_PROC_ID" in os.environ:
+        process_id = int(os.environ["VELES_PROC_ID"])
+    kwargs = {}
+    if coordinator:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+
+
+def process_index():
+    return jax.process_index()
+
+
+def process_count():
+    return jax.process_count()
+
+
+def is_coordinator():
+    """True on exactly one process — gate snapshot writes, plotting,
+    web status, publishing on this (orbax checkpointing is already
+    multi-host-aware and needs no gate)."""
+    return jax.process_index() == 0
+
+
+def from_host_local(local_batch, sharding, global_shape=None):
+    """Assemble a GLOBAL jax.Array from this host's local shard.
+
+    ``local_batch``: numpy array holding this process's rows (the
+    loader serves per-host shards — each host reads 1/``process_count``
+    of every global batch).  ``sharding``: a NamedSharding over the
+    global mesh (e.g. batch split on ``data``).  ``global_shape``
+    defaults to local rows × process_count along axis 0.
+
+    This is the host→device boundary of the multi-host train loop: the
+    returned array is addressable-shard-backed, so a pjit step over the
+    global mesh consumes it without any gather.
+    """
+    local_batch = numpy.ascontiguousarray(local_batch)
+    if global_shape is None:
+        global_shape = ((local_batch.shape[0] * jax.process_count(),)
+                        + tuple(local_batch.shape[1:]))
+    return jax.make_array_from_process_local_data(
+        sharding, local_batch, global_shape)
+
+
+def host_shard_range(n_samples):
+    """[start, stop) of this host's contiguous shard of ``n_samples`` —
+    how a loader decides which rows this process reads.
+
+    ``n_samples`` must divide evenly by the process count: uneven
+    shards cannot form one global array (``from_host_local``'s sharding
+    partitions the batch axis evenly, so ranks would disagree on the
+    global shape).  Pad or crop the global batch to a multiple of
+    ``process_count()`` — same rule as padding a batch to the ``data``
+    axis size on one host."""
+    n_procs = jax.process_count()
+    if n_samples % n_procs:
+        raise ValueError(
+            "global batch of %d rows does not divide evenly over %d "
+            "processes; pad/crop to a multiple (uneven host shards "
+            "cannot assemble into one global array)" % (n_samples,
+                                                        n_procs))
+    per = n_samples // n_procs
+    start = per * jax.process_index()
+    return start, start + per
