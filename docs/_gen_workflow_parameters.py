@@ -12,7 +12,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from veles_tpu.units import UnitRegistry
 from veles_tpu.znicz import (  # noqa: F401 - populate the registry
     activation, all2all, conv, misc_units, normalization_units,
-    pooling)
+    pooling, rnn)
 
 print("""# Layer types and parameters
 

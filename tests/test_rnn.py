@@ -1,0 +1,129 @@
+"""Recurrent family (znicz/rnn.py): golden LSTM math vs a hand-rolled
+numpy cell, scan shapes, VJP-backward training through both the fused
+lowering and the eager StandardWorkflow graph — the family the
+reference left 'in progress' (``manualrst_veles_algorithms.rst``)."""
+
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.backends import CPUDevice
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.memory import Vector
+from veles_tpu.znicz.rnn import LSTM, SimpleRNN
+
+
+def _sigmoid(z):
+    return 1.0 / (1.0 + numpy.exp(-z))
+
+
+def test_lstm_pure_golden_vs_numpy():
+    rng = numpy.random.default_rng(0)
+    b, t, d, h = 3, 5, 4, 6
+    x = rng.standard_normal((b, t, d)).astype(numpy.float32)
+    w = (rng.standard_normal((d + h, 4 * h)) * 0.3).astype(numpy.float32)
+    bias = (rng.standard_normal(4 * h) * 0.1).astype(numpy.float32)
+
+    out = numpy.asarray(LSTM.pure(
+        {"w": jnp.asarray(w), "b": jnp.asarray(bias)}, jnp.asarray(x),
+        hidden_units=h))
+
+    hh = numpy.zeros((b, h), numpy.float32)
+    cc = numpy.zeros((b, h), numpy.float32)
+    for step in range(t):
+        z = numpy.concatenate([x[:, step], hh], axis=1) @ w + bias
+        i, f, g, o = numpy.split(z, 4, axis=1)
+        cc = _sigmoid(f) * cc + _sigmoid(i) * numpy.tanh(g)
+        hh = _sigmoid(o) * numpy.tanh(cc)
+        numpy.testing.assert_allclose(out[:, step], hh, atol=1e-5)
+
+
+def test_lstm_shapes_and_last_only():
+    rng = numpy.random.default_rng(1)
+    x = rng.standard_normal((2, 7, 3)).astype(numpy.float32)
+    w = rng.standard_normal((3 + 5, 20)).astype(numpy.float32) * 0.2
+    p = {"w": jnp.asarray(w)}
+    full = LSTM.pure(p, jnp.asarray(x), hidden_units=5)
+    last = LSTM.pure(p, jnp.asarray(x), hidden_units=5, last_only=True)
+    assert full.shape == (2, 7, 5)
+    assert last.shape == (2, 5)
+    numpy.testing.assert_allclose(numpy.asarray(full[:, -1]),
+                                  numpy.asarray(last), atol=1e-6)
+
+
+def test_simple_rnn_golden():
+    rng = numpy.random.default_rng(2)
+    b, t, d, h = 2, 4, 3, 5
+    x = rng.standard_normal((b, t, d)).astype(numpy.float32)
+    w = (rng.standard_normal((d + h, h)) * 0.3).astype(numpy.float32)
+    out = numpy.asarray(SimpleRNN.pure(
+        {"w": jnp.asarray(w)}, jnp.asarray(x), hidden_units=h))
+    hh = numpy.zeros((b, h), numpy.float32)
+    for step in range(t):
+        hh = numpy.tanh(numpy.concatenate([x[:, step], hh], axis=1) @ w)
+        numpy.testing.assert_allclose(out[:, step], hh, atol=1e-5)
+
+
+def test_lstm_unit_initialize_and_forget_bias():
+    wf = DummyWorkflow()
+    unit = LSTM(wf, hidden_units=8, last_only=True)
+    unit.input = Vector(numpy.zeros((4, 6, 10), numpy.float32))
+    unit.initialize(device=None)
+    assert unit.weights.mem.shape == (18, 32)
+    assert unit.bias.mem.shape == (32,)
+    # forget-gate slice starts at +1 (remember by default)
+    assert numpy.allclose(unit.bias.mem[8:16], 1.0)
+    assert unit.output.shape == (4, 8)
+
+
+def test_lstm_fused_training_learns():
+    """Fused lowering: LSTM(last_only) -> softmax learns a sequence
+    task (which quarter of classes the FIRST timestep points at —
+    requires carrying state across all steps)."""
+    import jax
+
+    from veles_tpu import prng
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    prng.seed_all(1234)
+    layers = [
+        {"type": "lstm", "->": {"hidden_units": 32, "last_only": True},
+         "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+        {"type": "softmax", "->": {"output_sample_shape": 4},
+         "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+    ]
+    params, step_fn, _eval, _apply = lower_specs(layers, (6, 8))
+    rng = numpy.random.default_rng(0)
+    x = rng.standard_normal((256, 6, 8)).astype(numpy.float32)
+    labels = rng.integers(0, 4, 256).astype(numpy.int32)
+    # plant the signal at t=0 only: the scan must carry it to the end
+    x[numpy.arange(256), 0, labels.astype(int)] += 3.0
+    step = jax.jit(step_fn)
+    first = None
+    for _ in range(60):
+        params, metrics = step(params, x, labels)
+        if first is None:
+            first = float(metrics["loss"])
+    final_err = int(metrics["n_err"]) / 256.0
+    assert float(metrics["loss"]) < first * 0.5
+    assert final_err < 0.2
+
+
+def test_lstm_standard_workflow_trains():
+    """Eager graph path: StandardWorkflow links lstm -> softmax with
+    the generic VJP backward (GD_PAIRS['lstm'])."""
+    from veles_tpu.samples import mnist_rnn
+
+    wf = mnist_rnn.create_workflow(
+        device=CPUDevice(), max_epochs=2, minibatch_size=50,
+        layers=[
+            {"type": "lstm", "->": {"hidden_units": 16,
+                                    "last_only": True},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        ])
+    wf.run()
+    stats = wf.gather_results()
+    # learned *something* beyond chance on the synthetic set
+    assert stats["best_validation_error_pt"] < 85.0

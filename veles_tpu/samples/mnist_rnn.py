@@ -1,0 +1,64 @@
+"""MNIST row-sequence LSTM classifier.
+
+The recurrent model family the reference left "in progress"
+(``manualrst_veles_algorithms.rst:18-137``), completed: each 28×28
+image is read as a sequence of 28 rows (T=28, D=28) by an LSTM whose
+last hidden state feeds a softmax head — the classic sequential-MNIST
+benchmark shape.
+"""
+
+import numpy
+
+from veles_tpu.backends import AutoDevice
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.samples.datasets import load_mnist
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+LAYERS = [
+    {"type": "lstm",
+     "->": {"hidden_units": 128, "last_only": True,
+            "weights_filling": "uniform"},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 10},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+]
+
+
+class MnistRowsLoader(FullBatchLoader):
+    """Images served as (28, 28) row sequences."""
+
+    def load_data(self):
+        tr_x, tr_y, te_x, te_y, real = load_mnist()
+        if not real:
+            self.warning("real MNIST not found — synthetic stand-in")
+        data = numpy.concatenate([te_x, tr_x]).reshape(-1, 28, 28)
+        labels = numpy.concatenate([te_y, tr_y])
+        self.original_data.mem = numpy.ascontiguousarray(
+            data, dtype=numpy.float32)
+        self.original_labels = [int(v) for v in labels]
+        self.class_lengths[:] = [0, len(te_y), len(tr_y)]
+
+
+def create_workflow(device=None, max_epochs=10, minibatch_size=100,
+                    layers=None, **kwargs):
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: MnistRowsLoader(
+            w, minibatch_size=minibatch_size),
+        layers=[{**spec} for spec in (layers or LAYERS)],
+        decision_config={"max_epochs": max_epochs},
+        **kwargs)
+    launcher = kwargs.pop("launcher", None)
+    wf.launcher = launcher if launcher is not None else DummyLauncher()
+    if launcher is None:
+        wf.initialize(device=device or AutoDevice())
+    return wf
+
+
+def main(**kwargs):
+    from veles_tpu.logger import setup_logging
+    setup_logging()
+    wf = create_workflow(**kwargs)
+    wf.run()
+    return wf

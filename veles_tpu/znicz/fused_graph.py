@@ -30,7 +30,7 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
     from veles_tpu.units import UnitRegistry
     from veles_tpu.znicz import (  # noqa: F401 - populate the registry
         activation, all2all, conv, misc_units, normalization_units,
-        pooling)
+        pooling, rnn)
 
     wf = DummyWorkflow()
     probe = Vector(numpy.zeros((2,) + tuple(sample_shape),

@@ -1,0 +1,155 @@
+"""Recurrent layers: LSTM and simple RNN.
+
+Parity target: the reference lists "RNN/LSTM (in progress)" among its
+model families (``manualrst_veles_algorithms.rst:18-137``) — the
+recurrent family never shipped.  Completed here, TPU-first:
+
+- the whole sequence runs under ``lax.scan`` (ONE compiled program, no
+  per-timestep dispatch; XLA pipelines the loop on-chip);
+- the four LSTM gates are ONE fused matmul per step —
+  ``[x_t, h] @ W`` with ``W: (D+H, 4H)`` — so the MXU sees a single
+  large contraction instead of four thin ones;
+- the backward is ``jax.vjp`` through the scan (``GDViaVJP`` /
+  ``gd_generic``), which XLA turns into the reverse-time loop with the
+  standard rematerialization trade-offs (wrap the cell in
+  ``jax.checkpoint`` upstream if T·B·H outgrows HBM).
+
+Input ``(B, T, D)``; output ``(B, T, H)``, or ``(B, H)`` (the last
+hidden state) with ``last_only`` — the shape a classifier head wants.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.znicz.nn_units import ForwardBase
+
+
+class LSTM(ForwardBase):
+    """Long short-term memory layer (fused-gate scan).
+
+    ``->`` params: ``hidden_units`` (H), ``last_only`` (default False),
+    plus the standard weights_filling/weights_stddev.  The forget-gate
+    bias initializes to +1 (the standard remember-by-default trick);
+    the rest of the bias follows ``bias_filling``.
+    """
+
+    MAPPING = "lstm"
+    #: gate blocks in the stacked weight matrix (4 for LSTM's i,f,g,o)
+    GATES = 4
+
+    def __init__(self, workflow, **kwargs):
+        super(LSTM, self).__init__(workflow, **kwargs)
+        self.hidden_units = int(kwargs["hidden_units"])
+        self.last_only = bool(kwargs.get("last_only", False))
+        # recurrent bias defaults to zeros (+ the forget-gate offset),
+        # not the dense layers' small-uniform default
+        self.bias_filling = kwargs.get("bias_filling", "constant")
+        self.bias_stddev = kwargs.get("bias_stddev", 0.0)
+
+    def pure_config(self):
+        return {"hidden_units": self.hidden_units,
+                "last_only": self.last_only}
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("hidden_units",
+                                                 "last_only"))
+    def pure(params, x, hidden_units=None, last_only=False):
+        h_units = hidden_units
+        b_sz = x.shape[0]
+        w = params["w"]
+        bias = params.get("b")
+
+        def cell(carry, x_t):
+            h, c = carry
+            z = jnp.concatenate([x_t, h], axis=-1) @ w
+            if bias is not None:
+                z = z + bias
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+
+        zeros = jnp.zeros((b_sz, h_units), x.dtype)
+        (h_last, _c), ys = jax.lax.scan(
+            cell, (zeros, zeros), x.transpose(1, 0, 2))
+        if last_only:
+            return h_last
+        return ys.transpose(1, 0, 2)
+
+    def output_shape_for(self, input_shape):
+        batch, t, _d = input_shape
+        if self.last_only:
+            return (batch, self.hidden_units)
+        return (batch, t, self.hidden_units)
+
+    def _init_bias(self, b):
+        """LSTM: forget-gate slice starts at +1 (remember by default)."""
+        h = self.hidden_units
+        b[h:2 * h] += 1.0
+
+    def initialize(self, device=None, **kwargs):
+        super(LSTM, self).initialize(device=device, **kwargs)
+        d = self.input.shape[-1]
+        h = self.hidden_units
+        if not self.weights:
+            w = numpy.zeros((d + h, self.GATES * h),
+                            dtype=numpy.float32)
+            self.fill_array(w, self.weights_filling,
+                            self.weights_stddev)
+            self.weights.reset(w)
+        if self.include_bias and not self.bias:
+            b = numpy.zeros((self.GATES * h,), dtype=numpy.float32)
+            self.fill_array(b, self.bias_filling, self.bias_stddev)
+            self._init_bias(b)
+            self.bias.reset(b)
+        self.output.reset(numpy.zeros(
+            self.output_shape_for(self.input.shape), numpy.float32))
+        self.init_vectors(self.weights, self.bias, self.output)
+
+    def numpy_run(self):
+        out = type(self).pure(self.pure_params(host=True),
+                              jnp.asarray(self.input.mem),
+                              **self.pure_config())
+        self.output.map_invalidate()
+        self.output.mem = numpy.asarray(out)
+
+    def tpu_run(self):
+        self.output.devmem = type(self).pure(
+            self.pure_params(host=False), self.input.devmem,
+            **self.pure_config())
+
+
+class SimpleRNN(LSTM):
+    """Elman RNN: ``h_t = tanh([x_t, h] @ W + b)`` — same scan shape as
+    :class:`LSTM` with a quarter of the weights."""
+
+    MAPPING = "rnn"
+    GATES = 1
+
+    def _init_bias(self, b):
+        pass                        # no gate offsets
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnames=("hidden_units",
+                                                 "last_only"))
+    def pure(params, x, hidden_units=None, last_only=False):
+        b_sz = x.shape[0]
+        w = params["w"]
+        bias = params.get("b")
+
+        def cell(h, x_t):
+            z = jnp.concatenate([x_t, h], axis=-1) @ w
+            if bias is not None:
+                z = z + bias
+            h = jnp.tanh(z)
+            return h, h
+
+        zeros = jnp.zeros((b_sz, hidden_units), x.dtype)
+        h_last, ys = jax.lax.scan(cell, zeros, x.transpose(1, 0, 2))
+        if last_only:
+            return h_last
+        return ys.transpose(1, 0, 2)
+

@@ -21,7 +21,7 @@ from veles_tpu.plumbing import Repeater
 from veles_tpu.units import UnitRegistry
 from veles_tpu.znicz import (  # noqa: F401 - populate the unit registry
     activation, all2all, conv, gd, misc_units, normalization_units,
-    pooling)
+    pooling, rnn)
 from veles_tpu.znicz.decision import DecisionGD, DecisionMSE
 from veles_tpu.znicz.evaluator import EvaluatorMSE, EvaluatorSoftmax
 
@@ -52,6 +52,10 @@ GD_PAIRS = {
     # forward-only layer types: backward is the pure function's VJP
     "depooling": "gd_generic",
     "channel_splitter": "gd_generic",
+    # recurrent family ("in progress" in the reference, completed
+    # here): backward = VJP through the scan
+    "lstm": "gd_generic",
+    "rnn": "gd_generic",
     "lrn": "gd_lrn",
     "dropout": "gd_dropout",
     # reference-doc alias spellings (registered via MAPPING_ALIASES)
