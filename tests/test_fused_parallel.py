@@ -172,6 +172,55 @@ def test_shard_params_topology_change():
                           numpy.asarray(params[0]["w"]))
 
 
+def test_remat_matches_and_rematerializes():
+    """lower_specs(remat=...): numerically identical step, with the
+    checkpoint primitive actually present in the jaxpr (activations
+    recomputed in backward instead of held in HBM)."""
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    specs = [
+        {"type": "conv_tanh", "->": {"n_kernels": 4, "kx": 3, "ky": 3},
+         "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+         "<-": {"learning_rate": 0.01}},
+        {"type": "softmax", "->": {"output_sample_shape": 4},
+         "<-": {"learning_rate": 0.01}},
+    ]
+    rng = numpy.random.default_rng(5)
+    x = rng.standard_normal((8, 10, 10, 2)).astype(numpy.float32)
+    labels = (numpy.arange(8) % 4).astype(numpy.int32)
+
+    prng.seed_all(7)
+    params0, step0, _e, _a = lower_specs(specs, (10, 10, 2))
+    prng.seed_all(7)
+    params1, step1, _e, _a = lower_specs(specs, (10, 10, 2),
+                                         remat=True)
+    new0, m0 = step0(params0, x, labels)
+    new1, m1 = step1(params1, x, labels)
+    assert float(m0["loss"]) == pytest.approx(float(m1["loss"]),
+                                              rel=1e-6)
+    for s0, s1 in zip(new0, new1):
+        for key in s0:
+            if s0[key] is None:
+                continue
+            numpy.testing.assert_allclose(
+                numpy.asarray(s0[key]), numpy.asarray(s1[key]),
+                atol=1e-5)
+    # the checkpoint (remat) primitive is really in the program
+    jaxpr1 = jax.make_jaxpr(step1)(params1, x, labels)
+    jaxpr0 = jax.make_jaxpr(step0)(params0, x, labels)
+    assert "remat" in str(jaxpr1)
+    assert "remat" not in str(jaxpr0)
+
+    # per-layer opt-in: only the flagged layer is checkpointed
+    specs_one = [dict(s) for s in specs]
+    specs_one[0]["remat"] = True
+    prng.seed_all(7)
+    _p, step_one, _e2, _a2 = lower_specs(specs_one, (10, 10, 2))
+    assert "remat" in str(jax.make_jaxpr(step_one)(params1, x, labels))
+
+
 def test_eval_step():
     prng.seed_all(4)
     params = init_mlp_params(12, LAYERS)

@@ -9,6 +9,8 @@ is what AlexNet/CIFAR run under data parallelism — forward, loss,
 ``jax.grad`` backward and momentum updates in one XLA program.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy
@@ -16,8 +18,20 @@ import numpy
 from veles_tpu.memory import Vector
 
 
+def _remat_stage(pure, config):
+    """Wrap a stage's pure fn in ``jax.checkpoint`` with its static
+    config pre-bound; keeps the ``(params, x, **config)`` call shape
+    the lowering uses (the passed config is already baked in)."""
+    inner = jax.checkpoint(functools.partial(pure, **config))
+
+    def wrapped(params, x, **_config):
+        return inner(params, x)
+
+    return wrapped
+
+
 def lower_specs(layer_specs, sample_shape, loss="softmax",
-                compute_dtype=None):
+                compute_dtype=None, remat=False):
     """Build (params, step_fn, eval_fn, apply_fn) from layer specs.
 
     ``sample_shape``: one sample's shape (no batch dim).
@@ -25,6 +39,12 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
     ``jnp.bfloat16`` — the MXU-native mixed-precision mode: bf16
     activations/weights in the matmuls/convs, fp32 accumulation via
     ``preferred_element_type``, fp32 master weights + momentum).
+    ``remat``: rematerialize layer activations in the backward pass
+    (``jax.checkpoint`` around each layer) — trades one extra forward
+    per layer for not holding its activations in HBM, the standard
+    lever when deep stacks / long sequences outgrow the chip.  ``True``
+    applies to every layer; a per-layer ``{"remat": True}`` spec key
+    selects individually.
     """
     from veles_tpu.dummy import DummyWorkflow
     from veles_tpu.units import UnitRegistry
@@ -60,7 +80,12 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
             "moment_b": float(bw.get("gradient_moment_bias",
                                      bw.get("gradient_moment", 0.0))),
         }
-        stages.append((type(unit).pure, unit.pure_config(), hyper,
+        pure = type(unit).pure
+        if spec.get("remat", remat):
+            # static config is bound BEFORE checkpointing so the
+            # rematerialized callable is (params, x) -> out
+            pure = _remat_stage(pure, unit.pure_config())
+        stages.append((pure, unit.pure_config(), hyper,
                        bool(getattr(type(unit), "SKIP_AT_EVAL", False))))
         state = {k: v for k, v in layer_params.items()}
         state["vw"] = numpy.zeros_like(state["w"]) \
