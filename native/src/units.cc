@@ -449,6 +449,116 @@ class MeanDispUnit : public Unit {  // (x - mean) · disp
   NpyArray mean_, disp_;
 };
 
+// LSTM / simple RNN: scan over T, one fused-gate GEMM per step.
+// Weight layout matches veles_tpu/znicz/rnn.py: (D+H, G·H) with gate
+// order i,f,g,o (G=4) for LSTM, G=1 tanh cell for RNN.  Same math as
+// the package.py numpy golden runner (accumulation order differs, so
+// agreement is to f32 rounding, not bit-for-bit).
+class LstmUnit : public Unit {
+ public:
+  explicit LstmUnit(bool lstm) : lstm_(lstm) {}
+
+  void Initialize(const Json& config, std::map<std::string, NpyArray> arrays,
+                  const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    if (input_shape.size() != 3)
+      throw std::runtime_error("lstm/rnn: input must be (B, T, D)");
+    h_ = config.at("hidden_units")->integer();
+    if (h_ <= 0) throw std::runtime_error("lstm/rnn: hidden_units <= 0");
+    last_only_ =
+        config.has("last_only") && config.at("last_only")->boolean;
+    weights_ = std::move(arrays.at("weights"));
+    if (arrays.count("bias")) {
+      bias_ = std::move(arrays.at("bias"));
+      has_bias_ = true;
+    }
+    d_ = input_shape[2];
+    const int64_t gates = NumGates();
+    if (weights_.shape.size() != 2 || weights_.shape[0] != d_ + h_ ||
+        weights_.shape[1] != gates * h_)
+      throw std::runtime_error(
+          "lstm/rnn: weights must be (D+H, G*H), got (" +
+          std::to_string(weights_.shape.empty() ? 0 : weights_.shape[0]) +
+          ", " +
+          std::to_string(weights_.shape.size() < 2 ? 0
+                                                   : weights_.shape[1]) +
+          ")");
+    if (has_bias_ && NumElements(bias_.shape) != gates * h_)
+      throw std::runtime_error("lstm/rnn: bias must be (G*H,)");
+    if (last_only_)
+      output_shape_ = {input_shape[0], h_};
+    else
+      output_shape_ = {input_shape[0], input_shape[1], h_};
+  }
+
+  int64_t ScratchFloats(int) const override {
+    const int64_t b = input_shape_[0];
+    // concat (B, D+H) + gate pre-activations (B, G·H) + h + c (B, H)
+    return b * (d_ + h_) + b * NumGates() * h_ + 2 * b * h_;
+  }
+
+  void Execute(const float* in, float* out, float* scratch,
+               Engine* engine) override {
+    const int64_t b = input_shape_[0];
+    const int64_t t_len = input_shape_[1];
+    const int64_t gates = NumGates();
+    float* concat = scratch;                  // (B, D+H)
+    float* z = concat + b * (d_ + h_);        // (B, G·H)
+    float* h = z + b * gates * h_;            // (B, H)
+    float* c = h + b * h_;                    // (B, H)
+    std::memset(h, 0, static_cast<size_t>(b) * h_ * sizeof(float));
+    std::memset(c, 0, static_cast<size_t>(b) * h_ * sizeof(float));
+    for (int64_t t = 0; t < t_len; ++t) {
+      engine->ParallelFor(b, [&](int64_t begin, int64_t end) {
+        for (int64_t i = begin; i < end; ++i) {
+          float* row = concat + i * (d_ + h_);
+          std::memcpy(row, in + (i * t_len + t) * d_,
+                      static_cast<size_t>(d_) * sizeof(float));
+          std::memcpy(row + d_, h + i * h_,
+                      static_cast<size_t>(h_) * sizeof(float));
+        }
+      });
+      Gemm(concat, weights_.data.data(),
+           has_bias_ ? bias_.data.data() : nullptr, z, b, d_ + h_,
+           gates * h_, engine);
+      engine->ParallelFor(b, [&](int64_t begin, int64_t end) {
+        for (int64_t i = begin; i < end; ++i) {
+          const float* zrow = z + i * gates * h_;
+          float* hrow = h + i * h_;
+          float* crow = c + i * h_;
+          if (lstm_) {
+            for (int64_t j = 0; j < h_; ++j) {
+              const float ig = Sigmoid(zrow[j]);
+              const float fg = Sigmoid(zrow[h_ + j]);
+              const float gg = std::tanh(zrow[2 * h_ + j]);
+              const float og = Sigmoid(zrow[3 * h_ + j]);
+              crow[j] = fg * crow[j] + ig * gg;
+              hrow[j] = og * std::tanh(crow[j]);
+            }
+          } else {
+            for (int64_t j = 0; j < h_; ++j) hrow[j] = std::tanh(zrow[j]);
+          }
+          if (!last_only_)
+            std::memcpy(out + (i * t_len + t) * h_, hrow,
+                        static_cast<size_t>(h_) * sizeof(float));
+        }
+      });
+    }
+    if (last_only_)
+      std::memcpy(out, h, static_cast<size_t>(b) * h_ * sizeof(float));
+  }
+
+ private:
+  static float Sigmoid(float v) { return 1.0f / (1.0f + std::exp(-v)); }
+  int64_t NumGates() const { return lstm_ ? 4 : 1; }
+
+  NpyArray weights_, bias_;
+  bool has_bias_ = false;
+  bool lstm_ = true;
+  bool last_only_ = false;
+  int64_t d_ = 0, h_ = 0;
+};
+
 }  // namespace
 
 UnitFactory& UnitFactory::Instance() {
@@ -499,6 +609,8 @@ void RegisterStandardUnits() {
       [] { return std::make_unique<ActivationUnit>(); });
   reg({"dropout"}, [] { return std::make_unique<DropoutUnit>(); });
   reg({"mean_disp"}, [] { return std::make_unique<MeanDispUnit>(); });
+  reg({"lstm"}, [] { return std::make_unique<LstmUnit>(true); });
+  reg({"rnn"}, [] { return std::make_unique<LstmUnit>(false); });
 }
 
 }  // namespace veles_native

@@ -146,6 +146,47 @@ def test_activation_and_dropout_units(lib, tmp_path):
         assert numpy.allclose(nwf.run(x), golden, atol=1e-5)
 
 
+def test_lstm_package(lib, tmp_path):
+    """Recurrent family through the native engine: LSTM(last_only) →
+    softmax, vs the eager numpy chain AND the Python golden runner."""
+    from veles_tpu.znicz.all2all import All2AllSoftmax
+    from veles_tpu.znicz.rnn import LSTM
+
+    rng = numpy.random.default_rng(4)
+    x = rng.standard_normal((6, 9, 7)).astype(numpy.float32)
+    forwards, golden = _chain(
+        [(LSTM, {"hidden_units": 11, "last_only": True,
+                 "weights_filling": "gaussian"}),
+         (All2AllSoftmax, {"output_sample_shape": (5,)})], x)
+    path = str(tmp_path / "lstm.zip")
+    export_package(forwards, path, with_stablehlo=False)
+    runner = PackagedRunner(path)
+    numpy.testing.assert_allclose(runner.run(x), golden, atol=1e-5)
+    with native.NativeWorkflow(path) as wf:
+        out = wf.run(x)
+        assert out.shape == golden.shape
+        numpy.testing.assert_allclose(out, golden, atol=1e-4)
+
+
+def test_rnn_full_sequence_package(lib, tmp_path):
+    """Simple RNN emitting the full (B, T, H) sequence natively."""
+    from veles_tpu.znicz.rnn import SimpleRNN
+
+    rng = numpy.random.default_rng(5)
+    x = rng.standard_normal((3, 5, 4)).astype(numpy.float32)
+    forwards, golden = _chain(
+        [(SimpleRNN, {"hidden_units": 6,
+                      "weights_filling": "gaussian"})], x)
+    path = str(tmp_path / "rnn.zip")
+    export_package(forwards, path, with_stablehlo=False)
+    runner = PackagedRunner(path)
+    numpy.testing.assert_allclose(runner.run(x), golden, atol=1e-5)
+    with native.NativeWorkflow(path) as wf:
+        out = wf.run(x)
+        assert out.shape == golden.shape
+        numpy.testing.assert_allclose(out, golden, atol=1e-4)
+
+
 def test_fp16_package(lib, tmp_path):
     from veles_tpu.znicz.all2all import All2AllSoftmax
     rng = numpy.random.default_rng(5)

@@ -69,6 +69,10 @@ def _unit_export_entry(unit, array_refs):
         entry["config"].update(dropout_ratio=unit.dropout_ratio)
     elif mapping == "mean_disp":
         pass
+    elif mapping in ("lstm", "rnn"):
+        entry["config"].update(hidden_units=unit.hidden_units,
+                               last_only=bool(unit.last_only),
+                               include_bias=bool(unit.include_bias))
     else:
         raise ValueError("unit type %r is not packageable" % mapping)
     return entry
@@ -411,4 +415,31 @@ class PackagedRunner(object):
             return x
         if utype == "mean_disp":
             return (x - arrays["mean"]) * arrays["disp"]
+        if utype in ("lstm", "rnn"):
+            b, t, _d = x.shape
+            h_units = int(cfg["hidden_units"])
+            w = arrays["weights"]
+            bias = arrays.get("bias")
+
+            def sigmoid(z):
+                return 1.0 / (1.0 + numpy.exp(-z))
+
+            last_only = bool(cfg.get("last_only"))
+            hh = numpy.zeros((b, h_units), numpy.float32)
+            cc = numpy.zeros_like(hh) if utype == "lstm" else None
+            ys = None if last_only else numpy.empty(
+                (b, t, h_units), numpy.float32)
+            for step in range(t):
+                z = numpy.concatenate([x[:, step], hh], axis=1) @ w
+                if bias is not None:
+                    z = z + bias
+                if utype == "lstm":
+                    i, f, g, o = numpy.split(z, 4, axis=1)
+                    cc = sigmoid(f) * cc + sigmoid(i) * numpy.tanh(g)
+                    hh = sigmoid(o) * numpy.tanh(cc)
+                else:
+                    hh = numpy.tanh(z)
+                if ys is not None:
+                    ys[:, step] = hh
+            return hh if last_only else ys
         raise ValueError("unknown packaged unit type %r" % utype)
