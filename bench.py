@@ -308,6 +308,29 @@ def stage_kohonen():
     _emit("Kohonen SOM 32x32 train throughput", sec, batch, flops)
 
 
+def stage_lstm():
+    """Sequential-MNIST LSTM (the recurrent family): 28-step fused
+    scan, gates as one matmul per step, backward through the scan."""
+    import numpy
+
+    import jax
+    from veles_tpu import prng
+    from veles_tpu.samples.mnist_rnn import LAYERS
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    prng.seed_all(1234)
+    batch = 2048
+    params, step_fn, _eval, _apply = lower_specs(LAYERS, (28, 28))
+    rng = numpy.random.default_rng(0)
+    x = jax.device_put(
+        rng.standard_normal((batch, 28, 28)).astype(numpy.float32))
+    labels = jax.device_put(
+        rng.integers(0, 10, batch).astype(numpy.int32))
+    sec, flops = _measure(step_fn, params, x, labels, steps=50)
+    _emit("Sequential-MNIST LSTM fused train throughput", sec, batch,
+          flops)
+
+
 def stage_alexnet():
     from veles_tpu.samples import alexnet
     batch = int(os.environ.get("BENCH_ALEXNET_BATCH", "256"))
@@ -329,6 +352,7 @@ STAGES = {
     "cifar": (stage_cifar, 210),
     "ae": (stage_ae, 150),
     "kohonen": (stage_kohonen, 150),
+    "lstm": (stage_lstm, 180),
     "alexnet": (stage_alexnet, 600),
 }
 
@@ -437,7 +461,7 @@ def main():
     # it is still pending each optional stage only runs (and is only
     # allowed to hang) inside remaining() minus a headline reserve.
     ladder = [n for n in ("mnist", "mnist_e2e", "cifar", "ae",
-                          "kohonen", "alexnet")
+                          "kohonen", "lstm", "alexnet")
               if not only or n in only]
     for name in ladder:
         _fn, cap = STAGES[name]
