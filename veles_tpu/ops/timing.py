@@ -144,24 +144,45 @@ def inprogram_marginal(unit_fn, init_carry, k1=8, k2=64, repeats=3,
             best = min(best, time.perf_counter() - tic)
         return best
 
-    for attempt in range(max_retries + 1):
-        t1, t2 = timed(k1), timed(k2)
+    return _two_point_marginal(timed, k1, k2, target_signal, max_k,
+                               attempts=max_retries + 2,
+                               label="inprogram_marginal")
+
+
+def _two_point_marginal(timed, k1, k2, target_signal, max_k,
+                        attempts=4, label="two_point_marginal"):
+    """Shared widen/retry core of the two-trip-count stopwatch.
+
+    ``timed(n)`` = best-of-repeats wall seconds of ONE program doing
+    ``n`` work units.  Widens ``k2`` (no recompile — the trip count is
+    a runtime arg) until the signal ``(k2 - k1) * marginal`` reaches
+    ``target_signal``; doubles it when noise swamps the gap.  A
+    ``FloatingPointError`` from a widened run (weights gone non-finite
+    at the longer horizon) falls back to the last positive marginal,
+    which is still a valid measurement."""
+    best = None
+    t1 = timed(k1)      # deterministic short point: time it once
+    for _attempt in range(attempts):
+        try:
+            t2 = timed(k2)
+        except FloatingPointError:
+            if best is not None:
+                return best
+            raise
         marginal = (t2 - t1) / (k2 - k1)
         if marginal > 0:
+            best = marginal
             if (k2 - k1) * marginal >= target_signal or k2 >= max_k:
                 return marginal
             k2 = min(k1 + int(numpy.ceil(target_signal / marginal)),
                      max_k)
         else:
             k2 = min(k2 * 2, max_k)   # noise swamped the gap — widen it
-    # final attempt with whatever k2 the loop settled on
-    t1, t2 = timed(k1), timed(k2)
-    marginal = (t2 - t1) / (k2 - k1)
-    if marginal > 0:
-        return marginal
+    if best is not None:
+        return best
     raise RuntimeError(
-        "inprogram_marginal: non-positive marginal (%.6fs at k2=%d) — "
-        "timing environment too noisy" % (marginal, k2))
+        "%s: non-positive marginal (%.6fs at k2=%d) — timing "
+        "environment too noisy" % (label, marginal, k2))
 
 
 def marginal_time(call, min_seconds=2.0, max_calls=10000):
@@ -254,28 +275,10 @@ def measure_fused_step(step_fn, params, x, labels, k=20,
         return best
 
     host_fetch(compiled(params, x, labels, numpy.int32(k1))[1])  # warm
-    target = 0.5    # seconds of timing signal over the tunnel jitter
-    max_k2 = max(k2, 20 * k)   # widening cap: more steps = more weight
-    #                            drift on synthetic data (NaN risk)
-    marginal = None
-    for _attempt in range(3):
-        t1, t2 = timed(k1), timed(k2)
-        marginal = (t2 - t1) / (k2 - k1)
-        if marginal > 0:
-            signal = t2 - t1
-            if signal >= target or k2 >= max_k2:
-                return marginal, flops
-            new_k2 = min(k1 + int(numpy.ceil(target / marginal)),
-                         max_k2)
-            try:
-                t2b = timed(new_k2)
-            except FloatingPointError:
-                # weights went non-finite at the longer horizon — the
-                # unwidened marginal is still a valid measurement
-                return marginal, flops
-            m2 = (t2b - t1) / (new_k2 - k1)
-            return (m2 if m2 > 0 else marginal), flops
-        k2 = min(k2 * 2, max_k2)               # noise swamped the gap
-    raise RuntimeError(
-        "measure_fused_step: non-positive marginal (%.6fs at k2=%d) — "
-        "timing environment too noisy" % (marginal, k2))
+    # 0.5 s of signal over the tunnel jitter; widening capped at 20·k
+    # steps (more steps = more weight drift on synthetic data = NaN
+    # risk, which _two_point_marginal absorbs by falling back)
+    marginal = _two_point_marginal(timed, k1, k2, target_signal=0.5,
+                                   max_k=max(k2, 20 * k),
+                                   label="measure_fused_step")
+    return marginal, flops

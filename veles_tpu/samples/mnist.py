@@ -40,13 +40,13 @@ class MnistLoader(FullBatchLoader):
 
 
 def create_workflow(device=None, max_epochs=25, minibatch_size=100,
-                    snapshot_dir=None, **kwargs):
+                    snapshot_dir=None, layers=None, **kwargs):
     wf = StandardWorkflow(
         None,
         loader_factory=lambda w: MnistLoader(
             w, minibatch_size=minibatch_size,
             normalization_type=kwargs.pop("normalization_type", "none")),
-        layers=[{**spec} for spec in LAYERS],
+        layers=[{**spec} for spec in (layers or LAYERS)],
         decision_config={"max_epochs": max_epochs,
                          "fail_iterations": kwargs.pop(
                              "fail_iterations", 50)},

@@ -178,6 +178,95 @@ class GDViaVJP(GradientDescentBase):
             self._demanded = saved
 
 
+class GDRProp(GDViaVJP):
+    """Resilient propagation (iRprop−) backward for
+    :class:`veles_tpu.znicz.misc_units.RPropAll2All` (ref
+    ``rprop_all2all.RPropAll2All``).
+
+    Per-weight step sizes replace the learning rate: a step grows by
+    ``eta_plus`` while the gradient sign holds, shrinks by
+    ``eta_minus`` on a flip (and that update is skipped — iRprop−).
+    The whole rule runs on device; the unit's state Vector holds a
+    stacked ``(2,) + w.shape`` array of [step sizes, previous signs],
+    so the base class's writeback path needs no changes.
+
+    ``<-`` knobs: ``rprop_delta_init`` (0.1), ``rprop_eta_plus``
+    (1.2), ``rprop_eta_minus`` (0.5), ``rprop_delta_min`` (1e-6),
+    ``rprop_delta_max`` (50.0); ``weights_decay`` folds into the
+    gradient as usual.
+    """
+
+    MAPPING = "gd_rprop"
+
+    def __init__(self, workflow, **kwargs):
+        super(GDRProp, self).__init__(workflow, **kwargs)
+        self.delta_init = float(kwargs.get("rprop_delta_init", 0.1))
+        self.eta_plus = float(kwargs.get("rprop_eta_plus", 1.2))
+        self.eta_minus = float(kwargs.get("rprop_eta_minus", 0.5))
+        self.delta_min = float(kwargs.get("rprop_delta_min", 1e-6))
+        self.delta_max = float(kwargs.get("rprop_delta_max", 50.0))
+
+    def _restack(self, vec, param_shape):
+        """(Re)allocate ``vec`` as the stacked [delta, prev_sign]
+        state.  The base class pre-allocates a momentum-shaped zeros
+        buffer in initialize(); that must not be mistaken for state."""
+        if vec and vec.mem.shape == (2,) + tuple(param_shape):
+            return
+        state = numpy.zeros((2,) + tuple(param_shape),
+                            dtype=numpy.float32)
+        state[0] = self.delta_init
+        vec.reset(state)
+        vec.initialize(self.device)
+
+    def _collect_vstate(self, host=False):
+        if self.has_params:
+            self._restack(self.gradient_weights, self.weights.mem.shape)
+            if self.include_bias and self.forward.bias:
+                self._restack(self.gradient_bias,
+                              self.forward.bias.mem.shape)
+        return super(GDRProp, self)._collect_vstate(host=host)
+
+    def _step_fn(self):
+        config = self.forward.pure_config()
+        pure = type(self.forward).pure
+        need_err_input = self.need_err_input
+        eta_p, eta_m = self.eta_plus, self.eta_minus
+        d_min, d_max = self.delta_min, self.delta_max
+
+        def rprop(param, state, grad, decay):
+            grad = grad + decay * param
+            delta, prev_sign = state[0], state[1]
+            sign = jnp.sign(grad)
+            same = sign * prev_sign
+            delta = jnp.where(same > 0,
+                              jnp.minimum(delta * eta_p, d_max),
+                              jnp.where(same < 0,
+                                        jnp.maximum(delta * eta_m,
+                                                    d_min),
+                                        delta))
+            # iRprop−: a sign flip shrinks the step and SKIPS the move
+            eff = jnp.where(same < 0, 0.0, sign)
+            return param - eff * delta, jnp.stack([delta, eff])
+
+        def compute(params, vstate, x, err_output, hyper):
+            out, vjp = jax.vjp(
+                lambda p, inp: pure(p, inp, **config), params, x)
+            dparams, dx = vjp(err_output.astype(out.dtype))
+            batch = x.shape[0]
+            new_params, new_v = {}, {}
+            if "w" in params:
+                new_params["w"], new_v["w"] = rprop(
+                    params["w"], vstate["w"], dparams["w"] / batch,
+                    hyper["decay"])
+            if "b" in params:
+                new_params["b"], new_v["b"] = rprop(
+                    params["b"], vstate["b"], dparams["b"] / batch,
+                    hyper["decay_b"])
+            return new_params, new_v, (dx if need_err_input else None)
+
+        return compute
+
+
 class GDGeneric(GDViaVJP):
     """Registered generic backward for forward-only layer types whose
     gradient is purely the VJP of their ``pure`` function (depooling,

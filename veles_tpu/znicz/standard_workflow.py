@@ -33,6 +33,8 @@ GD_PAIRS = {
     "all2all_relu": "gd_relu",
     "all2all_strict_relu": "gd_strict_relu",
     "resizable_all2all": "gd",
+    # sign-based per-weight step sizes (iRprop−), ref rprop_all2all
+    "rprop_all2all": "gd_rprop",
     "softmax": "gd_softmax",
     "conv": "gd_conv",
     "conv_tanh": "gd_conv_tanh",
