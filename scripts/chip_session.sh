@@ -1,0 +1,58 @@
+#!/bin/bash
+# One serialized TPU session producing every hardware artifact of the
+# round: autotune DB -> bench ladder -> AlexNet profile -> s2d A/B.
+# Run from the repo root when the tunnel is up:
+#
+#     bash scripts/chip_session.sh [outdir]
+#
+# Everything is sequential (two JAX clients racing for the single chip
+# claim can wedge the tunnel relay — see ROUND3_NOTES.md), nothing here
+# kills a client mid-claim, and each step's log survives in $OUT.
+set -u
+OUT=${1:-chip_session_logs}
+mkdir -p "$OUT"
+
+note() { echo "[chip_session] $*" >&2; }
+
+note "1/4 autotune sweep (fills veles_tpu/devices/device_infos.json)"
+python -m veles_tpu.scripts.autotune >"$OUT/autotune.json" \
+    2>"$OUT/autotune.log"
+note "autotune rc=$? (DB: veles_tpu/devices/device_infos.json)"
+
+note "2/4 bench ladder"
+BENCH_BUDGET_SEC=${BENCH_BUDGET_SEC:-2400} python bench.py \
+    >"$OUT/bench.jsonl" 2>"$OUT/bench.log"
+note "bench rc=$? (lines: $(wc -l <"$OUT/bench.jsonl"))"
+
+note "3/4 AlexNet step profile -> PROFILE.md"
+python -m veles_tpu.scripts.profile_step --sample alexnet --batch 256 \
+    --out PROFILE.md >"$OUT/profile.log" 2>&1
+note "profile rc=$?"
+
+note "4/4 s2d conv A/B (substantiates the space-to-depth rewrite)"
+python - >"$OUT/s2d_ab.txt" 2>&1 <<'EOF'
+import jax, jax.numpy as jnp, numpy
+from veles_tpu.ops.timing import inprogram_marginal
+from veles_tpu.znicz.conv import Conv
+
+rng = numpy.random.default_rng(0)
+batch = 256
+x = jnp.asarray(rng.standard_normal((batch, 227, 227, 3)),
+                jnp.bfloat16)
+w = jnp.asarray(rng.standard_normal((11, 11, 3, 96)) * 0.01,
+                jnp.bfloat16)
+flops = 2.0 * batch * 55 * 55 * 96 * 11 * 11 * 3
+for s2d in (False, True):
+    def unit(carry, _s2d=s2d):
+        xx, s = carry
+        xx = jax.lax.dynamic_update_slice(
+            xx, (xx[0:1, 0:1, 0:1, 0:1] + (s * 1e-30).astype(xx.dtype)),
+            (0, 0, 0, 0))
+        o = Conv.pure({"w": w}, xx, sliding=(4, 4), s2d=_s2d)
+        return xx, jnp.sum(jnp.abs(o), dtype=jnp.float32)
+    sec = inprogram_marginal(unit, (x, jnp.float32(0.0)), k1=4, k2=32)
+    print("s2d=%s: %.3f ms/conv1, %.1f TFLOP/s effective"
+          % (s2d, sec * 1e3, flops / sec / 1e12))
+EOF
+note "s2d A/B rc=$? (see $OUT/s2d_ab.txt)"
+note "done — review $OUT, commit the DB and PROFILE.md"
