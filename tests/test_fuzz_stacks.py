@@ -1,0 +1,104 @@
+"""Fuzz the fused lowering over random-but-valid layer stacks.
+
+The reference's zoo is exercised by hand-picked configs; this sweeps
+the combination space (conv/pool/LRN/dropout stacks of random depth and
+geometry, dense tails, recurrent heads) and asserts every stack lowers,
+steps, and stays finite — the class of shape-inference and
+dtype-propagation bugs integration tests miss."""
+
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.znicz.fused_graph import lower_specs
+
+
+def _random_conv_stack(rng, h, w):
+    """Random conv/pool/lrn/dropout prefix that keeps spatial dims
+    >= 4, followed by a dense tail."""
+    layers = []
+    depth = int(rng.integers(1, 4))
+    for _ in range(depth):
+        kind = rng.choice(["conv", "pool", "lrn", "dropout"])
+        if kind == "conv" and min(h, w) >= 5:
+            k = int(rng.choice([3, 5]))
+            stride = int(rng.choice([1, 2]))
+            pad = int(rng.integers(0, 2))
+            layers.append({
+                "type": str(rng.choice(
+                    ["conv_tanh", "conv_strict_relu", "conv_sigmoid"])),
+                "->": {"n_kernels": int(rng.choice([4, 8])),
+                       "kx": k, "ky": k, "padding": pad,
+                       "sliding": (stride, stride)},
+                "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}})
+            h = (h + 2 * pad - k) // stride + 1
+            w = (w + 2 * pad - k) // stride + 1
+        elif kind == "pool" and min(h, w) >= 4:
+            layers.append({"type": str(rng.choice(
+                ["max_pooling", "avg_pooling", "maxabs_pooling"])),
+                "->": {"kx": 2, "ky": 2}})
+            h, w = (h - 2) // 2 + 1, (w - 2) // 2 + 1
+        elif kind == "lrn":
+            layers.append({"type": "lrn", "->": {}})
+        else:
+            layers.append({"type": "dropout",
+                           "->": {"dropout_ratio": 0.3}})
+        if min(h, w) < 4:
+            break
+    layers.append({
+        "type": "all2all_tanh",
+        "->": {"output_sample_shape": int(rng.choice([8, 16]))},
+        "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}})
+    layers.append({"type": "softmax", "->": {"output_sample_shape": 5},
+                   "<-": {"learning_rate": 0.01}})
+    return layers
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_conv_stack_lowers_and_steps(seed):
+    rng = numpy.random.default_rng(seed)
+    prng.seed_all(1000 + seed)
+    h = w = int(rng.choice([12, 17, 24]))
+    layers = _random_conv_stack(rng, h, w)
+    c = int(rng.choice([1, 3]))
+    dtype = jnp.bfloat16 if seed % 2 else None
+    params, step_fn, eval_fn, apply_fn = lower_specs(
+        layers, (h, w, c), compute_dtype=dtype)
+    x = rng.standard_normal((6, h, w, c)).astype(numpy.float32)
+    labels = (numpy.arange(6) % 5).astype(numpy.int32)
+    for _ in range(2):
+        params, metrics = step_fn(params, x, labels)
+    assert numpy.isfinite(float(metrics["loss"])), layers
+    assert 0 <= int(metrics["n_err"]) <= 6
+    ev = eval_fn(params, x, labels)
+    assert 0 <= int(ev["n_err"]) <= int(ev["n"])
+    out = apply_fn(params, x)
+    assert out.shape == (6, 5)
+    assert numpy.isfinite(numpy.asarray(out, numpy.float32)).all()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_recurrent_stack(seed):
+    rng = numpy.random.default_rng(100 + seed)
+    prng.seed_all(2000 + seed)
+    t, d = int(rng.choice([5, 9])), int(rng.choice([4, 8]))
+    layers = [
+        {"type": str(rng.choice(["lstm", "rnn"])),
+         "->": {"hidden_units": int(rng.choice([8, 16])),
+                "last_only": bool(seed % 2)},
+         "<-": {"learning_rate": 0.02, "gradient_moment": 0.9}},
+    ]
+    if not seed % 2:
+        # full-sequence output: stack a second recurrent layer on it
+        layers.append({"type": "lstm",
+                       "->": {"hidden_units": 8, "last_only": True},
+                       "<-": {"learning_rate": 0.02}})
+    layers.append({"type": "softmax", "->": {"output_sample_shape": 3},
+                   "<-": {"learning_rate": 0.02}})
+    params, step_fn, _eval, apply_fn = lower_specs(layers, (t, d))
+    x = rng.standard_normal((5, t, d)).astype(numpy.float32)
+    labels = (numpy.arange(5) % 3).astype(numpy.int32)
+    params, metrics = step_fn(params, x, labels)
+    assert numpy.isfinite(float(metrics["loss"]))
+    assert apply_fn(params, x).shape == (5, 3)
