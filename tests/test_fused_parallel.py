@@ -172,6 +172,57 @@ def test_shard_params_topology_change():
                           numpy.asarray(params[0]["w"]))
 
 
+@pytest.mark.parametrize("solver", ["adam", "rprop"])
+def test_fused_solver_selection_learns(solver):
+    """Per-layer 'solver' in the <- spec swaps the fused update rule;
+    both alternatives actually train."""
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    prng.seed_all(42)
+    knobs = {"solver": solver}
+    if solver == "rprop":
+        knobs["rprop_delta_init"] = 0.001
+    else:
+        knobs["learning_rate"] = 0.003
+    layers = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+         "<-": dict(knobs)},
+        {"type": "softmax", "->": {"output_sample_shape": 4},
+         "<-": dict(knobs)},
+    ]
+    params, step_fn, _e, _a = lower_specs(layers, (12,))
+    x, labels = _data(n=128)
+    first = None
+    for _ in range(40):
+        params, metrics = step_fn(params, x, labels)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first * 0.6
+    # solver state invariants
+    for state in params:
+        if state.get("w") is None:
+            continue
+        if solver == "adam":
+            assert int(state["t"]) == 40
+            assert state["sw"].shape == state["w"].shape
+            assert float(jax.numpy.min(state["sw"])) >= 0.0
+        else:
+            delta, prev = state["vw"][0], state["vw"][1]
+            assert float(jax.numpy.min(delta)) >= 1e-6
+            assert float(jax.numpy.max(delta)) <= 50.0
+            signs = numpy.unique(numpy.asarray(prev))
+            assert set(signs).issubset({-1.0, 0.0, 1.0})
+
+
+def test_fused_unknown_solver_rejected():
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    with pytest.raises(ValueError, match="unknown solver"):
+        lower_specs([{"type": "softmax",
+                      "->": {"output_sample_shape": 2},
+                      "<-": {"solver": "sgdfast"}}], (4,))
+
+
 def test_remat_matches_and_rematerializes():
     """lower_specs(remat=...): numerically identical step, with the
     checkpoint primitive actually present in the jaxpr (activations

@@ -45,6 +45,12 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
     lever when deep stacks / long sequences outgrow the chip.  ``True``
     applies to every layer; a per-layer ``{"remat": True}`` spec key
     selects individually.
+
+    Per-layer update rule via the ``<-`` key ``solver``: ``momentum``
+    (default, the reference's SGD+momentum), ``adam`` (decoupled
+    weight decay; ``adam_beta1/beta2/epsilon``), or ``rprop`` (iRprop−
+    with the same knobs as :class:`veles_tpu.znicz.gd_base.GDRProp`) —
+    the whole rule runs inside the one fused XLA program either way.
     """
     from veles_tpu.dummy import DummyWorkflow
     from veles_tpu.units import UnitRegistry
@@ -72,13 +78,28 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                         layer_params.items()}
         bw = spec.get("<-", {})
         lr = float(bw.get("learning_rate", 0.01))
+        solver = str(bw.get("solver", "momentum"))
+        if solver not in ("momentum", "adam", "rprop"):
+            raise ValueError("unknown solver %r (want momentum / adam "
+                             "/ rprop)" % solver)
         hyper = {
+            "solver": solver,
             "lr": lr, "lr_b": float(bw.get("learning_rate_bias", lr)),
             "decay": float(bw.get("weights_decay", 0.0)),
             "decay_b": float(bw.get("weights_decay_bias", 0.0)),
             "moment": float(bw.get("gradient_moment", 0.0)),
             "moment_b": float(bw.get("gradient_moment_bias",
                                      bw.get("gradient_moment", 0.0))),
+            # adam
+            "beta1": float(bw.get("adam_beta1", 0.9)),
+            "beta2": float(bw.get("adam_beta2", 0.999)),
+            "eps": float(bw.get("adam_epsilon", 1e-8)),
+            # rprop (iRprop−, same knobs as znicz.gd_base.GDRProp)
+            "delta_init": float(bw.get("rprop_delta_init", 0.1)),
+            "eta_plus": float(bw.get("rprop_eta_plus", 1.2)),
+            "eta_minus": float(bw.get("rprop_eta_minus", 0.5)),
+            "delta_min": float(bw.get("rprop_delta_min", 1e-6)),
+            "delta_max": float(bw.get("rprop_delta_max", 50.0)),
         }
         pure = type(unit).pure
         if spec.get("remat", remat):
@@ -88,10 +109,23 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
         stages.append((pure, unit.pure_config(), hyper,
                        bool(getattr(type(unit), "SKIP_AT_EVAL", False))))
         state = {k: v for k, v in layer_params.items()}
-        state["vw"] = numpy.zeros_like(state["w"]) \
-            if "w" in state else None
-        state["vb"] = numpy.zeros_like(state["b"]) \
-            if "b" in state else None
+
+        def _slot(key):
+            if key not in state or state[key] is None:
+                return None
+            if solver == "rprop":
+                # stacked [per-weight step sizes, previous signs]
+                s = numpy.zeros((2,) + state[key].shape,
+                                numpy.float32)
+                s[0] = hyper["delta_init"]
+                return s
+            return numpy.zeros_like(state[key])
+
+        state["vw"], state["vb"] = _slot("w"), _slot("b")
+        if solver == "adam":
+            # second moments + shared step counter (bias correction)
+            state["sw"], state["sb"] = _slot("w"), _slot("b")
+            state["t"] = numpy.int32(0)
         if "seed" in state:
             # fresh per-stage stream; step_fn then advances it every
             # step so fused dropout/stochastic-pooling masks differ
@@ -160,16 +194,40 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
         for state, gwb, (_pure, _config, hyper, _skip) in zip(
                 params_list, grads, stages):
             new_state = dict(state)
-            if "w" in gwb and state.get("w") is not None:
-                v = hyper["moment"] * state["vw"] - hyper["lr"] * (
-                    gwb["w"] + hyper["decay"] * state["w"])
-                new_state["w"] = state["w"] + v
-                new_state["vw"] = v
-            if "b" in gwb and state.get("b") is not None:
-                v = hyper["moment_b"] * state["vb"] - hyper["lr_b"] * (
-                    gwb["b"] + hyper["decay_b"] * state["b"])
-                new_state["b"] = state["b"] + v
-                new_state["vb"] = v
+            if hyper["solver"] == "adam" and (
+                    state.get("w") is not None
+                    or state.get("b") is not None):
+                new_state["t"] = state["t"] + 1
+            for key, vkey, skey, lr_k, dec_k, mom_k in (
+                    ("w", "vw", "sw", "lr", "decay", "moment"),
+                    ("b", "vb", "sb", "lr_b", "decay_b", "moment_b")):
+                if key not in gwb or state.get(key) is None:
+                    continue
+                grad = gwb[key]
+                if hyper["solver"] == "momentum":
+                    v = hyper[mom_k] * state[vkey] - hyper[lr_k] * (
+                        grad + hyper[dec_k] * state[key])
+                    new_state[key] = state[key] + v
+                    new_state[vkey] = v
+                elif hyper["solver"] == "adam":
+                    t = new_state["t"].astype(jnp.float32)
+                    m = hyper["beta1"] * state[vkey] \
+                        + (1.0 - hyper["beta1"]) * grad
+                    s2 = hyper["beta2"] * state[skey] \
+                        + (1.0 - hyper["beta2"]) * grad * grad
+                    m_hat = m / (1.0 - hyper["beta1"] ** t)
+                    s_hat = s2 / (1.0 - hyper["beta2"] ** t)
+                    step = m_hat / (jnp.sqrt(s_hat) + hyper["eps"])
+                    # decoupled (AdamW-style) weight decay
+                    new_state[key] = state[key] - hyper[lr_k] * (
+                        step + hyper[dec_k] * state[key])
+                    new_state[vkey], new_state[skey] = m, s2
+                else:                           # iRprop−
+                    from veles_tpu.znicz.gd_base import rprop_update
+                    new_state[key], new_state[vkey] = rprop_update(
+                        state[key], state[vkey], grad, hyper[dec_k],
+                        hyper["eta_plus"], hyper["eta_minus"],
+                        hyper["delta_min"], hyper["delta_max"])
             if "seed" in state:
                 # advance the stage's mask stream (int32, wrap-safe)
                 new_state["seed"] = jnp.int32(

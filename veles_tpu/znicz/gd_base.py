@@ -178,6 +178,30 @@ class GDViaVJP(GradientDescentBase):
             self._demanded = saved
 
 
+def rprop_update(param, state, grad, decay, eta_plus, eta_minus,
+                 delta_min, delta_max):
+    """One iRprop− update, shared by :class:`GDRProp` and the fused
+    lowering's ``solver="rprop"`` path.
+
+    ``state``: stacked ``(2,) + param.shape`` of [per-weight step
+    sizes, previous gradient signs].  Returns ``(new_param,
+    new_state)``; a sign flip shrinks the step and SKIPS the move
+    (the skipped sign is stored as 0, so the next step moves).
+    """
+    grad = grad + decay * param
+    delta, prev_sign = state[0], state[1]
+    sign = jnp.sign(grad)
+    same = sign * prev_sign
+    delta = jnp.where(same > 0,
+                      jnp.minimum(delta * eta_plus, delta_max),
+                      jnp.where(same < 0,
+                                jnp.maximum(delta * eta_minus,
+                                            delta_min),
+                                delta))
+    eff = jnp.where(same < 0, 0.0, sign)
+    return param - eff * delta, jnp.stack([delta, eff])
+
+
 class GDRProp(GDViaVJP):
     """Resilient propagation (iRprop−) backward for
     :class:`veles_tpu.znicz.misc_units.RPropAll2All` (ref
@@ -234,19 +258,8 @@ class GDRProp(GDViaVJP):
         d_min, d_max = self.delta_min, self.delta_max
 
         def rprop(param, state, grad, decay):
-            grad = grad + decay * param
-            delta, prev_sign = state[0], state[1]
-            sign = jnp.sign(grad)
-            same = sign * prev_sign
-            delta = jnp.where(same > 0,
-                              jnp.minimum(delta * eta_p, d_max),
-                              jnp.where(same < 0,
-                                        jnp.maximum(delta * eta_m,
-                                                    d_min),
-                                        delta))
-            # iRprop−: a sign flip shrinks the step and SKIPS the move
-            eff = jnp.where(same < 0, 0.0, sign)
-            return param - eff * delta, jnp.stack([delta, eff])
+            return rprop_update(param, state, grad, decay, eta_p,
+                                eta_m, d_min, d_max)
 
         def compute(params, vstate, x, err_output, hyper):
             out, vjp = jax.vjp(
