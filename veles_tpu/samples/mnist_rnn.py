@@ -15,6 +15,8 @@ from veles_tpu.loader.fullbatch import FullBatchLoader
 from veles_tpu.samples.datasets import load_mnist
 from veles_tpu.znicz.standard_workflow import StandardWorkflow
 
+INPUT_SHAPE = (28, 28)
+
 LAYERS = [
     {"type": "lstm",
      "->": {"hidden_units": 128, "last_only": True,

@@ -114,7 +114,8 @@ def measure_phases(params, step, apply_fn, x, labels, k=10,
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--sample", default="alexnet",
-                        choices=("alexnet", "cifar10", "mnist"))
+                        choices=("alexnet", "cifar10", "mnist",
+                                 "mnist_rnn", "stl10"))
     parser.add_argument("--batch", type=int, default=256)
     parser.add_argument("--k", type=int, default=10)
     parser.add_argument("--out", default=None)
