@@ -127,6 +127,38 @@ def test_stablehlo_export(convnet, tmp_path):
     assert numpy.allclose(out, golden, atol=1e-4)
 
 
+def test_stablehlo_export_lstm(tmp_path):
+    """The recurrent scan serializes through jax.export and replays
+    identically — the artifact any other StableHLO consumer gets."""
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.memory import Vector
+    from veles_tpu.znicz.rnn import LSTM
+
+    rng = numpy.random.default_rng(9)
+    x = rng.standard_normal((4, 7, 5)).astype(numpy.float32)
+    wf = DummyWorkflow()
+    unit = LSTM(wf, hidden_units=6, last_only=True,
+                weights_filling="gaussian")
+    unit.input = Vector(x.copy())
+    unit.initialize(NumpyDevice())
+    unit.numpy_run()
+    unit.output.map_read()
+    golden = numpy.array(unit.output.mem)
+
+    path = str(tmp_path / "lstm_hlo.zip")
+    contents = export_package([unit], path, with_stablehlo=True)
+    if "stablehlo" not in contents:
+        pytest.skip("jax.export unavailable for this chain")
+    with zipfile.ZipFile(path) as z:
+        blob = z.read(contents["stablehlo"])
+    from jax import export as jax_export
+    rerun = jax_export.deserialize(bytearray(blob))
+    out = numpy.asarray(rerun.call(x))
+    assert out.shape == golden.shape
+    assert numpy.allclose(out, golden, atol=1e-4)
+
+
 def test_mean_disp_round_trip(tmp_path):
     """MeanDispNormalizer packages as 'mean_disp' with rdisp → disp."""
     from veles_tpu.mean_disp_normalizer import MeanDispNormalizer
