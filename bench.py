@@ -331,6 +331,40 @@ def stage_lstm():
           flops)
 
 
+def stage_transformer():
+    """GPT-style LM train step on one chip (flash attention consults
+    the autotune DB; bf16 compute, remat on): the long-context
+    substrate's single-chip number.  Metric = tokens/sec."""
+    import numpy
+
+    import jax
+    from veles_tpu.samples import transformer
+
+    if os.environ.get("BENCH_LM_TINY"):      # CPU smoke of the path
+        cfg = dict(transformer.TINY, seq_len=64)
+    else:
+        cfg = {"vocab": 32000, "dim": 512, "heads": 8, "layers": 8,
+               "mlp_ratio": 4, "seq_len": 1024}
+    batch = int(os.environ.get("BENCH_LM_BATCH", "8"))
+    params = transformer.init_params(cfg, seed=0)
+    velocity = jax.tree.map(numpy.zeros_like, params)
+    raw_step = transformer.make_train_step(cfg)
+    tokens = jax.device_put(transformer.synthetic_tokens(cfg, batch))
+
+    def step(state, x, _labels):
+        p, v = state
+        p, v, metrics = raw_step(p, v, x)
+        return (p, v), metrics
+
+    labels = numpy.zeros((batch,), numpy.int32)
+    sec, flops = _measure(step, (params, velocity), tokens, labels,
+                          steps=12)
+    name = "GPT-512x8 LM fused train throughput (tokens basis)"
+    if os.environ.get("BENCH_LM_TINY"):
+        name += " [tiny-smoke]"
+    _emit(name, sec, batch * cfg["seq_len"], flops)
+
+
 def stage_alexnet():
     from veles_tpu.samples import alexnet
     batch = int(os.environ.get("BENCH_ALEXNET_BATCH", "256"))
@@ -353,6 +387,7 @@ STAGES = {
     "ae": (stage_ae, 150),
     "kohonen": (stage_kohonen, 150),
     "lstm": (stage_lstm, 180),
+    "transformer": (stage_transformer, 240),
     "alexnet": (stage_alexnet, 600),
 }
 
@@ -461,7 +496,8 @@ def main():
     # it is still pending each optional stage only runs (and is only
     # allowed to hang) inside remaining() minus a headline reserve.
     ladder = [n for n in ("mnist", "mnist_e2e", "cifar", "ae",
-                          "kohonen", "lstm", "alexnet")
+                          "kohonen", "lstm", "transformer",
+                          "alexnet")
               if not only or n in only]
     for name in ladder:
         _fn, cap = STAGES[name]
