@@ -8,10 +8,12 @@ failure mode was a backend-init hang that produced nothing):
    timeout.  A dead/hung TPU tunnel is detected and killed, never hangs
    the harness, and triggers a CPU fallback so a number still gets
    recorded (tagged ``[cpu-fallback]``).
-2. **Cheapest-first ladder** — MNIST MLP → CIFAR-10 conv → AlexNet, each
-   stage its own subprocess with a wall-clock cap.  Each completed stage
-   prints its JSON line *immediately*, so an external timeout mid-ladder
-   still leaves the best completed result on stdout (last line = best).
+2. **Cheapest-first ladder** — MNIST MLP → e2e workflow → CIFAR-10 conv
+   → MNIST AE → Kohonen SOM → LSTM → GPT LM → AlexNet (the headline,
+   always budget-protected), each stage its own subprocess with a
+   wall-clock cap.  Each completed stage prints its JSON line
+   *immediately*, so an external timeout mid-ladder still leaves the
+   best completed result on stdout (last line = best).
 3. **MFU reported** alongside throughput: XLA's own
    ``compiled.cost_analysis()`` flop count / measured step time / peak
    bf16 FLOPs for the detected TPU generation.
