@@ -14,6 +14,7 @@ exceed a chip.
 """
 
 import jax
+import numpy
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from veles_tpu.parallel.mesh import replicated
@@ -56,3 +57,33 @@ def shard_params(params, mesh, param_rules=None):
     different topology (SURVEY §5.4 'resume with different topology')."""
     shardings = _params_sharding(params, mesh, param_rules)
     return jax.tree.map(jax.device_put, params, shardings)
+
+
+def fsdp_rules(mesh, axis="data", min_elements=1024):
+    """``param_rules`` sharding every large-enough parameter over the
+    data axis — ZeRO-3/FSDP storage without new step code: each chip
+    holds ``1/axis_size`` of every weight, its momenta, and its solver
+    state, and XLA's GSPMD inserts the all-gather before a layer's
+    matmul and the reduce-scatter after its gradient.  Use with
+    :func:`data_parallel`/:func:`shard_params`; small leaves (biases,
+    counters) stay replicated — sharding them would cost more in
+    collective latency than the bytes saved.
+
+    Shards the first dimension divisible by the axis size (weights in
+    this framework lead with fan-in, which is usually the largest and
+    most divisible dim).
+    """
+    size = mesh.shape[axis]
+
+    def rules(leaf):
+        shape = numpy.shape(leaf)
+        if int(numpy.prod(shape, initial=1)) < min_elements:
+            return None
+        for dim, extent in enumerate(shape):
+            if extent % size == 0 and extent >= size:
+                spec = [None] * len(shape)
+                spec[dim] = axis
+                return P(*spec)
+        return None
+
+    return rules
