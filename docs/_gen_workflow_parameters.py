@@ -31,8 +31,11 @@ Layer specs are dicts: `{"type": <mapping>, "->": {forward params},
 | `learning_rate_bias` | bias step size | = learning_rate |
 | `weights_decay` / `weights_decay_bias` | L2 coefficient | 0.0 |
 | `gradient_moment` / `gradient_moment_bias` | momentum | 0.0 |
-| `solver` (fused lowering) | `momentum` / `adam` / `rprop` update rule | momentum |
+| `l1_vs_l2` (+ `_bias`) | regularization mix: 0 = L2 (λ·w), 1 = L1 (λ·sign w) | 0.0 |
+| `factor_ortho` | soft-orthogonality gradient factor·W·(WᵀW−I) | 0.0 |
+| `solver` (fused lowering) | `momentum` / `adam` / `adagrad` / `adadelta` / `rprop` update rule | momentum |
 | `adam_beta1` / `adam_beta2` / `adam_epsilon` | Adam moments (decoupled decay) | 0.9 / 0.999 / 1e-8 |
+| `adagrad_epsilon`, `adadelta_momentum` / `adadelta_epsilon` | adagrad/adadelta accumulators (adadelta: learning_rate=1) | 1e-6, 0.9 / 1e-6 |
 | `rprop_delta_init` / `rprop_eta_plus` / `rprop_eta_minus` / `rprop_delta_min` / `rprop_delta_max` | iRprop− step-size schedule | 0.1 / 1.2 / 0.5 / 1e-6 / 50 |
 
 ## Common forward (`->`) parameters

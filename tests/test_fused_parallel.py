@@ -210,7 +210,39 @@ def test_shard_params_topology_change():
                           numpy.asarray(params[0]["w"]))
 
 
-@pytest.mark.parametrize("solver", ["adam", "rprop"])
+def test_fused_regularizers_l1_and_ortho():
+    """l1_vs_l2 mixes sign(w) into the decay term; factor_ortho pushes
+    WᵀW toward I — both verified against hand-computed updates."""
+    import jax.numpy as jnp
+
+    from veles_tpu.znicz.fused_graph import lower_specs
+    from veles_tpu.znicz.gd_base import ortho_grad
+
+    # single linear layer, MSE loss, lr small: one step's weight change
+    # must equal -lr * (grad + decay*((1-l)w + l*sign(w)) + ortho)
+    w0 = numpy.array([[1.5, -0.5], [0.5, 2.0]], numpy.float32)
+    spec = [{"type": "all2all",
+             "->": {"output_sample_shape": 2, "include_bias": False},
+             "init": {"weights": w0},
+             "<-": {"learning_rate": 0.1, "weights_decay": 0.2,
+                    "l1_vs_l2": 0.7, "factor_ortho": 0.05}}]
+    prng.seed_all(5)
+    params, step_fn, _e, _a = lower_specs(spec, (2,), loss="mse")
+    x = numpy.array([[1.0, 0.0], [0.0, 1.0]], numpy.float32)
+    target = numpy.zeros((2, 2), numpy.float32)
+    new, _m = step_fn(params, x, target)
+
+    out = x @ w0
+    grad = x.T @ (out - target) / 2 / 2   # d(mean-over-dim MSE/2)/dW
+    reg = 0.2 * (0.3 * w0 + 0.7 * numpy.sign(w0))
+    ortho = numpy.asarray(ortho_grad(jnp.asarray(w0), 0.05))
+    expect = w0 - 0.1 * (grad + reg + ortho)
+    numpy.testing.assert_allclose(numpy.asarray(new[0]["w"]), expect,
+                                  atol=1e-5)
+
+
+@pytest.mark.parametrize("solver", ["adam", "rprop", "adagrad",
+                                    "adadelta"])
 def test_fused_solver_selection_learns(solver):
     """Per-layer 'solver' in the <- spec swaps the fused update rule;
     both alternatives actually train."""
@@ -220,6 +252,10 @@ def test_fused_solver_selection_learns(solver):
     knobs = {"solver": solver}
     if solver == "rprop":
         knobs["rprop_delta_init"] = 0.001
+    elif solver == "adadelta":
+        knobs["learning_rate"] = 1.0        # canonical adadelta scale
+    elif solver == "adagrad":
+        knobs["learning_rate"] = 0.05
     else:
         knobs["learning_rate"] = 0.003
     layers = [
@@ -244,6 +280,10 @@ def test_fused_solver_selection_learns(solver):
             assert int(state["t"]) == 40
             assert state["sw"].shape == state["w"].shape
             assert float(jax.numpy.min(state["sw"])) >= 0.0
+        elif solver in ("adagrad", "adadelta"):
+            # squared-gradient accumulator is nonnegative and grew
+            assert float(jax.numpy.min(state["sw"])) >= 0.0
+            assert float(jax.numpy.max(state["sw"])) > 0.0
         else:
             delta, prev = state["vw"][0], state["vw"][1]
             assert float(jax.numpy.min(delta)) >= 1e-6

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy
 
 from veles_tpu.memory import Vector
+from veles_tpu.znicz.gd_base import ortho_grad, reg_term, rprop_update
 
 
 def _remat_stage(pure, config):
@@ -48,9 +49,14 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
 
     Per-layer update rule via the ``<-`` key ``solver``: ``momentum``
     (default, the reference's SGD+momentum), ``adam`` (decoupled
-    weight decay; ``adam_beta1/beta2/epsilon``), or ``rprop`` (iRprop−
+    weight decay; ``adam_beta1/beta2/epsilon``), ``adagrad`` /
+    ``adadelta`` (the reference's documented solver knobs
+    ``adagrad_epsilon`` / ``adadelta_momentum`` / ``adadelta_epsilon``;
+    run adadelta with ``learning_rate`` 1.0), or ``rprop`` (iRprop−
     with the same knobs as :class:`veles_tpu.znicz.gd_base.GDRProp`) —
     the whole rule runs inside the one fused XLA program either way.
+    Regularization: ``weights_decay`` with the ``l1_vs_l2`` mix and the
+    ``factor_ortho`` soft-orthogonality term apply across solvers.
     """
     from veles_tpu.dummy import DummyWorkflow
     from veles_tpu.units import UnitRegistry
@@ -77,11 +83,14 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
         layer_params = {k: numpy.array(v) for k, v in
                         layer_params.items()}
         bw = spec.get("<-", {})
-        lr = float(bw.get("learning_rate", 0.01))
         solver = str(bw.get("solver", "momentum"))
-        if solver not in ("momentum", "adam", "rprop"):
+        if solver not in ("momentum", "adam", "rprop", "adagrad",
+                          "adadelta"):
             raise ValueError("unknown solver %r (want momentum / adam "
-                             "/ rprop)" % solver)
+                             "/ rprop / adagrad / adadelta)" % solver)
+        # adadelta's update is self-scaling; its canonical lr is 1.0
+        lr = float(bw.get("learning_rate",
+                          1.0 if solver == "adadelta" else 0.01))
         hyper = {
             "solver": solver,
             "lr": lr, "lr_b": float(bw.get("learning_rate_bias", lr)),
@@ -90,10 +99,22 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
             "moment": float(bw.get("gradient_moment", 0.0)),
             "moment_b": float(bw.get("gradient_moment_bias",
                                      bw.get("gradient_moment", 0.0))),
+            # regularization (ref docs :559-566): L1/L2 mix + soft
+            # orthogonality on the flattened weight
+            "l1": float(bw.get("l1_vs_l2", 0.0)),
+            "l1_b": float(bw.get("l1_vs_l2_bias",
+                                 bw.get("l1_vs_l2", 0.0))),
+            "factor_ortho": float(bw.get("factor_ortho", 0.0)),
             # adam
             "beta1": float(bw.get("adam_beta1", 0.9)),
             "beta2": float(bw.get("adam_beta2", 0.999)),
             "eps": float(bw.get("adam_epsilon", 1e-8)),
+            # adagrad / adadelta (ref docs list their knobs among the
+            # backward parameters: adagrad_epsilon, adadelta_momentum,
+            # adadelta_epsilon)
+            "adagrad_eps": float(bw.get("adagrad_epsilon", 1e-6)),
+            "adadelta_rho": float(bw.get("adadelta_momentum", 0.9)),
+            "adadelta_eps": float(bw.get("adadelta_epsilon", 1e-6)),
             # rprop (iRprop−, same knobs as znicz.gd_base.GDRProp)
             "delta_init": float(bw.get("rprop_delta_init", 0.1)),
             "eta_plus": float(bw.get("rprop_eta_plus", 1.2)),
@@ -121,11 +142,16 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                 return s
             return numpy.zeros_like(state[key])
 
-        state["vw"], state["vb"] = _slot("w"), _slot("b")
-        if solver == "adam":
-            # second moments + shared step counter (bias correction)
+        # vw/vb: momentum velocity, adam first moment, adadelta E[Δ²],
+        # rprop stacked state — adagrad needs no first slot
+        state["vw"], state["vb"] = (
+            (None, None) if solver == "adagrad"
+            else (_slot("w"), _slot("b")))
+        if solver in ("adam", "adagrad", "adadelta"):
+            # squared-gradient accumulators
             state["sw"], state["sb"] = _slot("w"), _slot("b")
-            state["t"] = numpy.int32(0)
+        if solver == "adam":
+            state["t"] = numpy.int32(0)   # bias-correction counter
         if "seed" in state:
             # fresh per-stage stream; step_fn then advances it every
             # step so fused dropout/stochastic-pooling masks differ
@@ -204,11 +230,34 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                 if key not in gwb or state.get(key) is None:
                     continue
                 grad = gwb[key]
+                l1 = hyper["l1"] if key == "w" else hyper["l1_b"]
+                if key == "w" and hyper["factor_ortho"]:
+                    grad = grad + ortho_grad(state[key],
+                                             hyper["factor_ortho"])
                 if hyper["solver"] == "momentum":
                     v = hyper[mom_k] * state[vkey] - hyper[lr_k] * (
-                        grad + hyper[dec_k] * state[key])
+                        grad + reg_term(state[key], hyper[dec_k], l1))
                     new_state[key] = state[key] + v
                     new_state[vkey] = v
+                elif hyper["solver"] == "adagrad":
+                    g = grad + reg_term(state[key], hyper[dec_k], l1)
+                    s2 = state[skey] + g * g
+                    new_state[key] = state[key] - hyper[lr_k] * g / (
+                        jnp.sqrt(s2) + hyper["adagrad_eps"])
+                    new_state[skey] = s2
+                elif hyper["solver"] == "adadelta":
+                    rho = hyper["adadelta_rho"]
+                    eps = hyper["adadelta_eps"]
+                    g = grad + reg_term(state[key], hyper[dec_k], l1)
+                    s2 = rho * state[skey] + (1.0 - rho) * g * g
+                    upd = -jnp.sqrt(state[vkey] + eps) \
+                        / jnp.sqrt(s2 + eps) * g
+                    # vw accumulates E[Δ²]; conventionally run with
+                    # learning_rate=1.0 (the lr is a plain scale here)
+                    new_state[key] = state[key] + hyper[lr_k] * upd
+                    new_state[vkey] = rho * state[vkey] \
+                        + (1.0 - rho) * upd * upd
+                    new_state[skey] = s2
                 elif hyper["solver"] == "adam":
                     t = new_state["t"].astype(jnp.float32)
                     m = hyper["beta1"] * state[vkey] \
@@ -218,14 +267,14 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                     m_hat = m / (1.0 - hyper["beta1"] ** t)
                     s_hat = s2 / (1.0 - hyper["beta2"] ** t)
                     step = m_hat / (jnp.sqrt(s_hat) + hyper["eps"])
-                    # decoupled (AdamW-style) weight decay
+                    # decoupled (AdamW-style) weight decay, l1/l2 mix
                     new_state[key] = state[key] - hyper[lr_k] * (
-                        step + hyper[dec_k] * state[key])
+                        step + reg_term(state[key], hyper[dec_k], l1))
                     new_state[vkey], new_state[skey] = m, s2
                 else:                           # iRprop−
-                    from veles_tpu.znicz.gd_base import rprop_update
+                    g = grad + reg_term(state[key], hyper[dec_k], l1)
                     new_state[key], new_state[vkey] = rprop_update(
-                        state[key], state[vkey], grad, hyper[dec_k],
+                        state[key], state[vkey], g,
                         hyper["eta_plus"], hyper["eta_minus"],
                         hyper["delta_min"], hyper["delta_max"])
             if "seed" in state:

@@ -65,6 +65,9 @@ class GDViaVJP(GradientDescentBase):
         config = self.forward.pure_config()
         pure = type(self.forward).pure
         need_err_input = self.need_err_input
+        # static at trace time (rebuilt with _compute_ on change)
+        l1, l1_b = self.l1_vs_l2, self.l1_vs_l2_bias
+        ortho = self.factor_ortho
 
         def compute(params, vstate, x, err_output, hyper):
             out, vjp = jax.vjp(
@@ -74,14 +77,17 @@ class GDViaVJP(GradientDescentBase):
             new_params, new_v = {}, {}
             if "w" in params:
                 grad = dparams["w"] / batch
+                if ortho:
+                    grad = grad + ortho_grad(params["w"], ortho)
                 v = hyper["moment"] * vstate["w"] - hyper["lr"] * (
-                    grad + hyper["decay"] * params["w"])
+                    grad + reg_term(params["w"], hyper["decay"], l1))
                 new_params["w"] = params["w"] + v
                 new_v["w"] = v
             if "b" in params:
                 grad = dparams["b"] / batch
                 v = hyper["moment_b"] * vstate["b"] - hyper["lr_b"] * (
-                    grad + hyper["decay_b"] * params["b"])
+                    grad + reg_term(params["b"], hyper["decay_b"],
+                                    l1_b))
                 new_params["b"] = params["b"] + v
                 new_v["b"] = v
             return new_params, new_v, (dx if need_err_input else None)
@@ -178,17 +184,38 @@ class GDViaVJP(GradientDescentBase):
             self._demanded = saved
 
 
-def rprop_update(param, state, grad, decay, eta_plus, eta_minus,
+def reg_term(param, decay, l1_vs_l2):
+    """The regularization gradient λ·((1−l)·w + l·sign(w)) — the
+    reference's ``l1_vs_l2`` mix (0 = pure L2, 1 = pure L1; docs
+    ``manualrst_veles_workflow_parameters.rst:559-566``)."""
+    if l1_vs_l2 == 0.0:
+        return decay * param
+    return decay * ((1.0 - l1_vs_l2) * param
+                    + l1_vs_l2 * jnp.sign(param))
+
+
+def ortho_grad(w, factor):
+    """Soft-orthogonality regularizer gradient (the reference's
+    ``factor_ortho``): penalty (factor/4)·‖WᵀW − I‖²_F over the weight
+    flattened to 2-D, gradient factor · W·(WᵀW − I)."""
+    m = w.reshape(-1, w.shape[-1])
+    g = m @ (m.T @ m - jnp.eye(m.shape[1], dtype=m.dtype))
+    return factor * g.reshape(w.shape)
+
+
+def rprop_update(param, state, grad, eta_plus, eta_minus,
                  delta_min, delta_max):
     """One iRprop− update, shared by :class:`GDRProp` and the fused
     lowering's ``solver="rprop"`` path.
 
-    ``state``: stacked ``(2,) + param.shape`` of [per-weight step
-    sizes, previous gradient signs].  Returns ``(new_param,
-    new_state)``; a sign flip shrinks the step and SKIPS the move
-    (the skipped sign is stored as 0, so the next step moves).
+    ``grad`` must already include any regularization term (callers add
+    :func:`reg_term` so the ``l1_vs_l2`` mix applies to rprop exactly
+    as to the other solvers).  ``state``: stacked ``(2,) +
+    param.shape`` of [per-weight step sizes, previous gradient signs].
+    Returns ``(new_param, new_state)``; a sign flip shrinks the step
+    and SKIPS the move (the skipped sign is stored as 0, so the next
+    step moves).
     """
-    grad = grad + decay * param
     delta, prev_sign = state[0], state[1]
     sign = jnp.sign(grad)
     same = sign * prev_sign
@@ -256,9 +283,12 @@ class GDRProp(GDViaVJP):
         need_err_input = self.need_err_input
         eta_p, eta_m = self.eta_plus, self.eta_minus
         d_min, d_max = self.delta_min, self.delta_max
+        l1, l1_b = self.l1_vs_l2, self.l1_vs_l2_bias
+        ortho = self.factor_ortho
 
-        def rprop(param, state, grad, decay):
-            return rprop_update(param, state, grad, decay, eta_p,
+        def rprop(param, state, grad, decay, l1_mix):
+            grad = grad + reg_term(param, decay, l1_mix)
+            return rprop_update(param, state, grad, eta_p,
                                 eta_m, d_min, d_max)
 
         def compute(params, vstate, x, err_output, hyper):
@@ -268,13 +298,15 @@ class GDRProp(GDViaVJP):
             batch = x.shape[0]
             new_params, new_v = {}, {}
             if "w" in params:
+                grad = dparams["w"] / batch
+                if ortho:
+                    grad = grad + ortho_grad(params["w"], ortho)
                 new_params["w"], new_v["w"] = rprop(
-                    params["w"], vstate["w"], dparams["w"] / batch,
-                    hyper["decay"])
+                    params["w"], vstate["w"], grad, hyper["decay"], l1)
             if "b" in params:
                 new_params["b"], new_v["b"] = rprop(
                     params["b"], vstate["b"], dparams["b"] / batch,
-                    hyper["decay_b"])
+                    hyper["decay_b"], l1_b)
             return new_params, new_v, (dx if need_err_input else None)
 
         return compute

@@ -151,6 +151,15 @@ class GradientDescentBase(AcceleratedUnit):
         self.gradient_moment = kwargs.get("gradient_moment", 0.0)
         self.gradient_moment_bias = kwargs.get(
             "gradient_moment_bias", kwargs.get("gradient_moment", 0.0))
+        #: regularization mix (docs ``:559-566``): 1.0 = pure L1
+        #: (λ·sign(w)), 0.0 = pure L2 (λ·w)
+        self.l1_vs_l2 = float(kwargs.get("l1_vs_l2", 0.0))
+        self.l1_vs_l2_bias = float(kwargs.get("l1_vs_l2_bias",
+                                              kwargs.get("l1_vs_l2",
+                                                         0.0)))
+        #: soft-orthogonality regularizer weight: the gradient gains
+        #: factor_ortho · W·(WᵀW − I) on flattened-to-2D weights
+        self.factor_ortho = float(kwargs.get("factor_ortho", 0.0))
         self.include_bias = kwargs.get("include_bias", True)
         #: compute err_input (False for the first layer, saves a matmul)
         self.need_err_input = kwargs.get("need_err_input", True)
