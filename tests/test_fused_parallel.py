@@ -292,6 +292,39 @@ def test_fused_solver_selection_learns(solver):
             assert set(signs).issubset({-1.0, 0.0, 1.0})
 
 
+def test_grad_accum_matches_full_batch():
+    """grad_accum=N (the reference's accumulate_gradient, as an
+    in-step scan over microbatches) produces the same update as the
+    full-batch step, with microbatch-sized activation memory."""
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    layers = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+         "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        {"type": "softmax", "->": {"output_sample_shape": 4},
+         "<-": {"learning_rate": 0.05}},
+    ]
+    prng.seed_all(9)
+    params_a, step_a, _e, _a = lower_specs(layers, (12,))
+    prng.seed_all(9)
+    params_b, step_b, _e2, _a2 = lower_specs(layers, (12,),
+                                             grad_accum=4)
+    x, labels = _data(n=64)
+    for _ in range(3):
+        params_a, m_a = step_a(params_a, x, labels)
+        params_b, m_b = step_b(params_b, x, labels)
+    assert int(m_a["n_err"]) == int(m_b["n_err"])
+    assert float(m_a["loss"]) == pytest.approx(float(m_b["loss"]),
+                                               rel=1e-5)
+    for sa, sb in zip(params_a, params_b):
+        numpy.testing.assert_allclose(numpy.asarray(sa["w"]),
+                                      numpy.asarray(sb["w"]),
+                                      atol=1e-5)
+
+    with pytest.raises(ValueError, match="not divisible"):
+        step_b(params_b, x[:30], labels[:30])
+
+
 def test_fused_unknown_solver_rejected():
     from veles_tpu.znicz.fused_graph import lower_specs
 

@@ -32,7 +32,7 @@ def _remat_stage(pure, config):
 
 
 def lower_specs(layer_specs, sample_shape, loss="softmax",
-                compute_dtype=None, remat=False):
+                compute_dtype=None, remat=False, grad_accum=1):
     """Build (params, step_fn, eval_fn, apply_fn) from layer specs.
 
     ``sample_shape``: one sample's shape (no batch dim).
@@ -57,7 +57,13 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
     the whole rule runs inside the one fused XLA program either way.
     Regularization: ``weights_decay`` with the ``l1_vs_l2`` mix and the
     ``factor_ortho`` soft-orthogonality term apply across solvers.
+
+    ``grad_accum``: the reference's ``accumulate_gradient`` — split the
+    batch into N microbatches scanned inside the step (activation HBM ∝
+    batch/N), average their gradients, apply ONE update.  Combine with
+    ``remat`` for the deepest memory cuts.
     """
+    grad_accum = max(int(grad_accum), 1)
     from veles_tpu.dummy import DummyWorkflow
     from veles_tpu.units import UnitRegistry
     from veles_tpu.znicz import (  # noqa: F401 - populate the registry
@@ -214,8 +220,42 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                          is not None} for s in params_list)
         aux_list = tuple({k: s[k] for k in ("seed",) if k in s}
                          for s in params_list)
-        (_v, (n_err, report)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(wb_list, aux_list, x, labels)
+        if grad_accum == 1:
+            (_v, (n_err, report)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(wb_list, aux_list, x, labels)
+        else:
+            # the reference's accumulate_gradient, TPU-first: the batch
+            # is split into grad_accum microbatches scanned INSIDE the
+            # step — activations exist for one microbatch at a time
+            # (HBM ∝ B/grad_accum), gradients average across chunks,
+            # ONE solver update applies at the end
+            batch = x.shape[0]
+            if batch % grad_accum:
+                raise ValueError(
+                    "batch %d not divisible by grad_accum %d"
+                    % (batch, grad_accum))
+            xs = x.reshape((grad_accum, batch // grad_accum)
+                           + x.shape[1:])
+            ls = labels.reshape((grad_accum, batch // grad_accum)
+                                + labels.shape[1:])
+
+            def body(carry, chunk):
+                acc, err_acc, loss_acc = carry
+                cx, cl = chunk
+                (_v, (n_err_c, report_c)), g = jax.value_and_grad(
+                    loss_fn, has_aux=True)(wb_list, aux_list, cx, cl)
+                acc = jax.tree.map(jnp.add, acc, g)
+                # float carry: softmax n_err is an int count, mse's is
+                # an RMSE — float accumulates both
+                return (acc, err_acc + n_err_c.astype(jnp.float32),
+                        loss_acc + report_c.astype(jnp.float32)), None
+
+            zeros = jax.tree.map(jnp.zeros_like, wb_list)
+            (gsum, n_err, loss_sum), _ = jax.lax.scan(
+                body, (zeros, jnp.float32(0.0), jnp.float32(0.0)),
+                (xs, ls))
+            grads = jax.tree.map(lambda g: g / grad_accum, gsum)
+            report = loss_sum / grad_accum
         new_list = []
         for state, gwb, (_pure, _config, hyper, _skip) in zip(
                 params_list, grads, stages):
