@@ -292,6 +292,53 @@ def test_fused_solver_selection_learns(solver):
             assert set(signs).issubset({-1.0, 0.0, 1.0})
 
 
+def test_standard_workflow_fused_mode_trains():
+    """StandardWorkflow(fused=True): the graph keeps the loader /
+    Decision / services, the math runs as ONE program per minibatch
+    (FusedTrainer), and weights sync back into the forward units."""
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist
+
+    prng.seed_all(1)
+    wf = mnist.create_workflow(device=CPUDevice(), max_epochs=2,
+                               minibatch_size=500, fused=True)
+    assert wf.fused_trainer is not None
+    assert wf.gds == []                  # no eager backward chain
+    # the trainer seeds from the units' REAL initialized weights (the
+    # forwards initialize after the trainer, hence the lazy build)
+    wf.forwards[0].weights.map_read()
+    w_init = numpy.array(wf.forwards[0].weights.mem)
+    assert not numpy.allclose(w_init, 0.0)
+    wf.fused_trainer._build()
+    numpy.testing.assert_allclose(
+        numpy.asarray(wf.fused_trainer._params_[0]["w"]), w_init,
+        atol=0)
+    wf.run()
+    results = wf.gather_results()
+    # same bar as the eager-mode sample test (measured 25 % there)
+    assert results["best_validation_error_pt"] < 35.0
+    # the trained parameters are visible in the unit graph
+    wf.forwards[0].weights.map_read()
+    w_unit = numpy.array(wf.forwards[0].weights.mem)
+    w_fused = numpy.asarray(wf.fused_trainer._params_[0]["w"])
+    numpy.testing.assert_allclose(w_unit, w_fused, atol=1e-6)
+
+
+def test_standard_workflow_fused_mse_trains():
+    """fused=True with an MSE stack (autoencoder shape): DecisionMSE
+    reads the trainer's mse metric."""
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist_ae
+
+    prng.seed_all(2)
+    wf = mnist_ae.create_workflow(device=CPUDevice(), max_epochs=2,
+                                  minibatch_size=500, fused=True)
+    wf.run()
+    results = wf.gather_results()
+    assert numpy.isfinite(results["best_rmse"])
+    assert float(wf.decision.best_mse) < numpy.inf
+
+
 def test_grad_accum_matches_full_batch():
     """grad_accum=N (the reference's accumulate_gradient, as an
     in-step scan over microbatches) produces the same update as the

@@ -256,6 +256,10 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                 (xs, ls))
             grads = jax.tree.map(lambda g: g / grad_accum, gsum)
             report = loss_sum / grad_accum
+            if loss == "mse":
+                # each chunk's "n_err" is an RMSE: average, don't sum
+                # (softmax error COUNTS do sum)
+                n_err = n_err / grad_accum
         new_list = []
         for state, gwb, (_pure, _config, hyper, _skip) in zip(
                 params_list, grads, stages):

@@ -1,0 +1,151 @@
+"""FusedTrainer: the whole layer stack as ONE unit in the graph.
+
+``StandardWorkflow(fused=True)`` replaces the eager per-unit train
+chain (forwards → evaluator → gds, one device dispatch per unit per
+minibatch) with this single unit running the fused lowering
+(:func:`veles_tpu.znicz.fused_graph.lower_specs`): forward, loss,
+backward, and the solver update execute as one XLA program per
+minibatch, while every graph service — loader scheduling, Decision
+epoch accounting, snapshotter, plotters, web status — keeps working
+unchanged.  The forward units still exist and hold the weights (the
+trainer seeds its params from them and syncs back every epoch and
+before snapshots), so export/packaging and eager debugging see live
+parameters.
+
+This is the TPU answer to the reference's per-unit OpenCL dispatch
+(SURVEY §3.1): the graph stays the coordination layer, the math leaves
+it.
+"""
+
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.loader.base import TRAIN
+
+
+class FusedTrainer(AcceleratedUnit):
+    """Runs lower_specs' step/eval for the workflow's layer stack.
+
+    Exposes ``n_err`` (softmax) / ``mse`` (MSE) after every run, so a
+    Decision unit can use it in place of the evaluator.
+    """
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(FusedTrainer, self).__init__(workflow, **kwargs)
+        self.view_group = "TRAINER"
+        self.layers = kwargs["layers"]
+        self.loss = kwargs.get("loss", "softmax")
+        self.compute_dtype = kwargs.get("compute_dtype")
+        self.grad_accum = int(kwargs.get("grad_accum", 1))
+        self.remat = bool(kwargs.get("remat", False))
+        self.loader = None
+        self.forwards = None
+        self.n_err = 0.0
+        self.mse = 0.0
+        self.loss_value = 0.0
+        self.demand("loader", "forwards")
+
+    def init_unpickled(self):
+        super(FusedTrainer, self).init_unpickled()
+        self._params_ = None          # device state; rebuilt on resume
+        self._step_ = None
+        self._eval_ = None
+
+    def _build(self):
+        import jax
+
+        from veles_tpu.znicz.fused_graph import lower_specs
+
+        specs = []
+        for spec, fwd in zip(self.layers, self.forwards):
+            spec = {k: v for k, v in spec.items()}
+            if fwd.weights:
+                fwd.weights.map_read()
+                init = {"weights": numpy.array(fwd.weights.mem)}
+                if fwd.bias:
+                    fwd.bias.map_read()
+                    init["bias"] = numpy.array(fwd.bias.mem)
+                spec["init"] = init
+            specs.append(spec)
+        sample_shape = tuple(self.loader.minibatch_data.shape[1:])
+        params, step_fn, eval_fn, _apply = lower_specs(
+            specs, sample_shape, loss=self.loss,
+            compute_dtype=self.compute_dtype, remat=self.remat,
+            grad_accum=self.grad_accum)
+        self._params_ = jax.device_put(params)
+        self._step_ = jax.jit(step_fn, donate_argnums=(0,))
+        self._eval_ = jax.jit(eval_fn)
+
+    def initialize(self, device=None, **kwargs):
+        super(FusedTrainer, self).initialize(device=device, **kwargs)
+        wf = self.workflow
+        if getattr(wf, "is_slave", False) or getattr(wf, "is_master",
+                                                     False):
+            raise NotImplementedError(
+                "fused mode covers standalone and SPMD multi-host "
+                "runs; the elastic master–slave job layer trains "
+                "through the eager unit chain (fused=False)")
+        # _build happens lazily on the first run(): the unchained
+        # forward units initialize AFTER this unit (they have no
+        # control links), and seeding must read their real weights
+
+    def _labels(self, n):
+        import jax
+
+        if self.loss == "mse":
+            self.loader.minibatch_targets.map_read()
+            return jax.device_put(numpy.ascontiguousarray(
+                self.loader.minibatch_targets.mem[:n], numpy.float32))
+        self.loader.minibatch_labels.map_read()
+        return jax.device_put(numpy.ascontiguousarray(
+            self.loader.minibatch_labels.mem[:n], numpy.int32))
+
+    def run(self):
+        if self._step_ is None:       # first run / snapshot resume
+            self._build()
+        # slice away the zero-padded tail of a short final batch: MSE
+        # has no validity mask, so padded rows would otherwise pull
+        # outputs toward zero targets (the eager EvaluatorMSE slices
+        # to batch_size the same way).  At most 2 distinct shapes ever
+        # compile (full + tail).
+        n = int(self.loader.minibatch_size)
+        train = int(self.loader.minibatch_class) == TRAIN
+        if train and self.grad_accum > 1 and n % self.grad_accum:
+            # a short tail batch must stay divisible into microbatches;
+            # round down (drops < grad_accum samples once per epoch)
+            n = max(n - n % self.grad_accum, 0) or n
+        x = self.loader.minibatch_data.devmem[:n]
+        labels = self._labels(n)
+        if train:
+            self._params_, metrics = self._step_(self._params_, x,
+                                                 labels)
+            err = float(metrics["n_err"])
+            self.loss_value = float(metrics["loss"])
+        else:
+            ev = self._eval_(self._params_, x, labels)
+            err = float(ev["n_err"] if self.loss != "mse"
+                        else ev["rmse"])
+        if self.loss == "mse":
+            self.mse = err
+        else:
+            self.n_err = err
+        if bool(self.loader.last_minibatch):
+            # epoch boundary: the unit graph (snapshotter, export,
+            # eager eval) sees the trained weights
+            self.sync_weights()
+
+    def sync_weights(self):
+        """Write the fused params back into the forward units."""
+        for fwd, state in zip(self.forwards, self._params_):
+            w = state.get("w")
+            if w is not None and fwd.weights:
+                fwd.weights.map_write()
+                fwd.weights.mem[...] = numpy.asarray(
+                    w, dtype=fwd.weights.mem.dtype)
+            b = state.get("b")
+            if b is not None and fwd.bias:
+                fwd.bias.map_write()
+                fwd.bias.mem[...] = numpy.asarray(
+                    b, dtype=fwd.bias.mem.dtype)

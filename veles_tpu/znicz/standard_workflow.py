@@ -119,12 +119,20 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.decision_config = dict(kwargs.pop("decision_config", {}))
         self.snapshotter_config = kwargs.pop("snapshotter_config", None)
         self.plotters_config = kwargs.pop("plotters_config", None)
+        #: fused=True: train through ONE jitted program per minibatch
+        #: (znicz.fused_unit.FusedTrainer) instead of the eager
+        #: per-unit chain; fused_config forwards lower_specs knobs
+        #: (compute_dtype, remat, grad_accum)
+        self.fused = bool(kwargs.pop("fused", False))
+        self.fused_config = dict(kwargs.pop("fused_config", {}))
+        self.fused_trainer = None
         loader_factory = kwargs.pop("loader_factory")
         super(StandardWorkflow, self).__init__(workflow, **kwargs)
         self.repeater = Repeater(self)
         self.loader = loader_factory(self)
         self.forwards = []
         self.gds = []
+        self.evaluator = None
         self.snapshotter = None
         self.plotters = []
         self.create_workflow()
@@ -132,6 +140,16 @@ class StandardWorkflow(AcceleratedWorkflow):
     # -- the link_* contract ------------------------------------------------
     def create_workflow(self):
         self.link_loader()
+        if self.fused:
+            self.link_forwards(chain=False)
+            self.link_fused_trainer()
+            self.link_decision()
+            if self.snapshotter_config is not None:
+                self.link_snapshotter()
+            if self.plotters_config is not None:
+                self.link_plotters()
+            self.link_loop_and_end()
+            return
         self.link_forwards()
         self.link_evaluator()
         self.link_decision()
@@ -159,7 +177,10 @@ class StandardWorkflow(AcceleratedWorkflow):
     #: no single input→output seam for link_forwards/link_gds
     NON_LAYER_TYPES = frozenset({"zero_filter", "channel_merger"})
 
-    def link_forwards(self):
+    def link_forwards(self, chain=True):
+        """Build the forward units; with ``chain=False`` (fused mode)
+        they are attr-linked for shape inference and weight storage but
+        stay OUT of the control graph — the FusedTrainer computes."""
         prev = self.loader
         prev_attr = "minibatch_data"
         from veles_tpu.znicz.normalization_units import DropoutForward
@@ -172,7 +193,8 @@ class StandardWorkflow(AcceleratedWorkflow):
                     ".link_inputs(...)) instead of listing it in "
                     "layers" % spec["type"])
             unit = self._make_unit(spec["type"], dict(spec.get("->", {})))
-            unit.link_from(prev)
+            if chain:
+                unit.link_from(prev)
             unit.link_attrs(prev, ("input", prev_attr))
             if isinstance(unit, DropoutForward):
                 # dropout is identity off-TRAIN (validation/test batches)
@@ -190,8 +212,7 @@ class StandardWorkflow(AcceleratedWorkflow):
 
     def link_evaluator(self):
         last = self.forwards[-1]
-        loss = self.loss_function or (
-            "softmax" if self.layers[-1]["type"] == "softmax" else "mse")
+        loss = self._loss_kind()
         if loss == "softmax":
             self.evaluator = EvaluatorSoftmax(self)
             self.evaluator.link_attrs(last, "output", "max_idx")
@@ -208,14 +229,29 @@ class StandardWorkflow(AcceleratedWorkflow):
                                   ("batch_size", "minibatch_size"))
         self.evaluator.link_from(self.forwards[-1])
 
-    def link_decision(self):
-        loss = self.loss_function or (
+    def _loss_kind(self):
+        return self.loss_function or (
             "softmax" if self.layers[-1]["type"] == "softmax" else "mse")
-        decision_class = DecisionGD if loss == "softmax" else DecisionMSE
+
+    def link_fused_trainer(self):
+        from veles_tpu.znicz.fused_unit import FusedTrainer
+        self.fused_trainer = FusedTrainer(
+            self, layers=[{**s} for s in self.layers],
+            loss=self._loss_kind(), **self.fused_config)
+        self.fused_trainer.loader = self.loader
+        self.fused_trainer.forwards = self.forwards
+        self.fused_trainer.link_from(self.loader)
+
+    def link_decision(self):
+        decision_class = DecisionGD if self._loss_kind() == "softmax" \
+            else DecisionMSE
         self.decision = decision_class(self, **self.decision_config)
         self.decision.link_from_loader(self.loader)
-        self.decision.evaluator = self.evaluator
-        self.decision.link_from(self.evaluator)
+        # in fused mode the trainer exposes the evaluator metrics
+        # (n_err / mse) itself
+        err_src = self.fused_trainer if self.fused else self.evaluator
+        self.decision.evaluator = err_src
+        self.decision.link_from(err_src)
 
     def link_snapshotter(self):
         """Snapshot on every improved validation error (the reference
