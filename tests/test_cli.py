@@ -110,6 +110,25 @@ def test_main_runs_sample_module(tmp_path):
     assert wf._is_initialized
 
 
+def test_main_fused_flag(tmp_path):
+    """--fused reaches create_workflow and builds the FusedTrainer
+    graph (no eager gd chain)."""
+    from veles_tpu.__main__ import Main
+    main = Main(["veles_tpu.samples.mnist", "-d", "numpy", "--fused"])
+    args = main._parse()
+    assert args.fused
+    main._setup_logging()
+    main._seed_random()
+    main._apply_config()
+    main.module = main._load_module(main.args.workflow)
+    extra = {"fused": True} if main.args.fused else {}
+    wf = main.module.create_workflow(
+        launcher=Launcher(device="numpy"), max_epochs=1,
+        minibatch_size=50, **extra)
+    assert wf.fused and wf.fused_trainer is not None
+    assert wf.gds == []
+
+
 def test_main_dry_run_init(tmp_path):
     from veles_tpu.__main__ import Main
     graph = str(tmp_path / "graph.dot")

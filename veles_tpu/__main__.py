@@ -141,8 +141,9 @@ class Main(Logger):
             self._construct_via_run(launcher_kwargs)
         elif hasattr(self.module, "create_workflow"):
             self.launcher = Launcher(**launcher_kwargs)
+            extra = {"fused": True} if self.args.fused else {}
             self.workflow = self.module.create_workflow(
-                launcher=self.launcher)
+                launcher=self.launcher, **extra)
             if self.workflow.launcher is not self.launcher:
                 self.workflow.launcher = self.launcher
         else:
@@ -157,6 +158,10 @@ class Main(Logger):
 
         def load(workflow_class, **kwargs):
             main_self.launcher = Launcher(**launcher_kwargs)
+            if main_self.args.fused:
+                # explicit opt-in only: non-StandardWorkflow classes
+                # need not accept the kwarg
+                kwargs.setdefault("fused", True)
             main_self.workflow = workflow_class(
                 main_self.launcher, **kwargs)
             return main_self.workflow, None

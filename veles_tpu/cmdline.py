@@ -79,6 +79,11 @@ def make_parser(prog="veles_tpu", description=None):
         "--test", action="store_true",
         help="run in evaluation mode instead of training")
     parser.add_argument(
+        "--fused", action="store_true",
+        help="train through the fused lowering: one XLA program per "
+             "minibatch (StandardWorkflow(fused=True); standalone/SPMD "
+             "modes)")
+    parser.add_argument(
         "--result-file", default="",
         help="write gathered IResultProvider results JSON here "
              "(ref workflow.py:827-851)")
