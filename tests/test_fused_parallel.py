@@ -341,6 +341,27 @@ def test_standard_workflow_fused_mse_trains():
     assert float(wf.decision.best_mse) < numpy.inf
 
 
+def test_standard_workflow_fused_mesh_dp():
+    """fused_config={'mesh_axes': ...}: the workflow's FusedTrainer
+    trains data-parallel over the 8-device mesh (the BASELINE
+    north-star AlexNet-DP shape, via the graph), optionally with FSDP
+    param storage."""
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist
+
+    prng.seed_all(1)
+    wf = mnist.create_workflow(
+        device=CPUDevice(), max_epochs=2, minibatch_size=500,
+        fused=True,
+        fused_config={"mesh_axes": {"data": -1}, "fsdp": True})
+    wf.run()
+    results = wf.gather_results()
+    assert results["best_validation_error_pt"] < 35.0
+    # params are mesh-sharded (FSDP): not fully replicated
+    w = wf.fused_trainer._params_[0]["w"]
+    assert not w.sharding.is_fully_replicated
+
+
 def test_grad_accum_matches_full_batch():
     """grad_accum=N (the reference's accumulate_gradient, as an
     in-step scan over microbatches) produces the same update as the

@@ -40,6 +40,12 @@ class FusedTrainer(AcceleratedUnit):
         self.compute_dtype = kwargs.get("compute_dtype")
         self.grad_accum = int(kwargs.get("grad_accum", 1))
         self.remat = bool(kwargs.get("remat", False))
+        #: {"data": -1} etc. — train over a device mesh: batch sharded
+        #: on "data", gradients all-reduced inside the step (the
+        #: BASELINE north-star AlexNet-DP path, via the workflow).
+        #: Optionally combine with fsdp=True for ZeRO param storage.
+        self.mesh_axes = kwargs.get("mesh_axes")
+        self.fsdp = bool(kwargs.get("fsdp", False))
         self.loader = None
         self.forwards = None
         self.n_err = 0.0
@@ -52,6 +58,9 @@ class FusedTrainer(AcceleratedUnit):
         self._params_ = None          # device state; rebuilt on resume
         self._step_ = None
         self._eval_ = None
+        self._train_divisor_ = 1
+        self._batch_shard_ = None
+        self._rep_shard_ = None
 
     def _build(self):
         import jax
@@ -74,9 +83,39 @@ class FusedTrainer(AcceleratedUnit):
             specs, sample_shape, loss=self.loss,
             compute_dtype=self.compute_dtype, remat=self.remat,
             grad_accum=self.grad_accum)
-        self._params_ = jax.device_put(params)
-        self._step_ = jax.jit(step_fn, donate_argnums=(0,))
-        self._eval_ = jax.jit(eval_fn)
+        self._train_divisor_ = max(self.grad_accum, 1)
+        if self.mesh_axes:
+            from veles_tpu.parallel import data_parallel, make_mesh
+            from veles_tpu.parallel.dp import fsdp_rules, shard_params
+            mesh = make_mesh(dict(self.mesh_axes))
+            rules = fsdp_rules(mesh) if self.fsdp else None
+            self._step_ = data_parallel(step_fn, mesh, params,
+                                        param_rules=rules)
+            self._params_ = shard_params(params, mesh,
+                                         param_rules=rules)
+            # eval: params keep their mesh shardings, the batch is
+            # replicated — correct for any (short) batch size, and
+            # evaluation is a sliver of the epoch
+            from jax.sharding import NamedSharding, PartitionSpec
+            from veles_tpu.parallel.dp import _params_sharding
+            from veles_tpu.parallel.mesh import replicated
+            self._eval_ = jax.jit(
+                eval_fn,
+                in_shardings=(_params_sharding(params, mesh, rules),
+                              replicated(mesh), replicated(mesh)),
+                out_shardings=replicated(mesh))
+            # device-committed loader arrays must be placed onto the
+            # mesh explicitly (jit with in_shardings refuses to
+            # reshard committed args)
+            self._batch_shard_ = NamedSharding(
+                mesh, PartitionSpec("data"))
+            self._rep_shard_ = replicated(mesh)
+            # train batches must also split evenly over the data axis
+            self._train_divisor_ *= int(mesh.shape["data"])
+        else:
+            self._params_ = jax.device_put(params)
+            self._step_ = jax.jit(step_fn, donate_argnums=(0,))
+            self._eval_ = jax.jit(eval_fn)
 
     def initialize(self, device=None, **kwargs):
         super(FusedTrainer, self).initialize(device=device, **kwargs)
@@ -112,12 +151,19 @@ class FusedTrainer(AcceleratedUnit):
         # compile (full + tail).
         n = int(self.loader.minibatch_size)
         train = int(self.loader.minibatch_class) == TRAIN
-        if train and self.grad_accum > 1 and n % self.grad_accum:
-            # a short tail batch must stay divisible into microbatches;
-            # round down (drops < grad_accum samples once per epoch)
-            n = max(n - n % self.grad_accum, 0) or n
+        div = self._train_divisor_
+        if train and div > 1 and n % div:
+            # a short tail batch must stay divisible into microbatches
+            # and over the data axis; round down (drops < div samples
+            # once per epoch)
+            n = max(n - n % div, 0) or n
         x = self.loader.minibatch_data.devmem[:n]
         labels = self._labels(n)
+        if self._batch_shard_ is not None:
+            import jax
+            shard = self._batch_shard_ if train else self._rep_shard_
+            x = jax.device_put(x, shard)
+            labels = jax.device_put(labels, shard)
         if train:
             self._params_, metrics = self._step_(self._params_, x,
                                                  labels)
