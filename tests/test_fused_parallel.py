@@ -341,6 +341,41 @@ def test_standard_workflow_fused_mse_trains():
     assert float(wf.decision.best_mse) < numpy.inf
 
 
+def test_standard_workflow_fused_snapshot_resume(tmp_path):
+    """A fused workflow pickles and resumes: the trainer's device
+    state is rebuilt from the unit weights it synced at epoch end, so
+    training continues from the trained parameters."""
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.samples import mnist
+    from veles_tpu.snapshotter import load_snapshot
+
+    prng.seed_all(1)
+    wf = mnist.create_workflow(
+        device=CPUDevice(), max_epochs=2, minibatch_size=500,
+        fused=True, snapshot_dir=str(tmp_path))
+    wf.run()
+    first_best = float(wf.decision.best_n_err_pt)
+    assert wf.snapshotter.destination is not None
+    wf.forwards[0].weights.map_read()
+    w_trained = numpy.array(wf.forwards[0].weights.mem)
+
+    restored = load_snapshot(wf.snapshotter.destination)
+    restored.launcher = DummyLauncher()
+    # the trainer's jitted state is deliberately not pickled
+    assert restored.fused_trainer._step_ is None
+    restored.forwards[0].weights.map_read()
+    numpy.testing.assert_allclose(
+        numpy.array(restored.forwards[0].weights.mem), w_trained)
+    restored.decision.complete <<= False
+    restored.decision.max_epochs = 3
+    restored.initialize(device=CPUDevice())
+    restored.run()
+    assert restored.loader.epoch_number >= 2
+    # resumed training did not regress below the snapshot's best
+    assert float(restored.decision.best_n_err_pt) <= first_best + 1e-6
+
+
 def test_standard_workflow_fused_mesh_dp():
     """fused_config={'mesh_axes': ...}: the workflow's FusedTrainer
     trains data-parallel over the 8-device mesh (the BASELINE
