@@ -559,6 +559,175 @@ class LstmUnit : public Unit {
   int64_t d_ = 0, h_ = 0;
 };
 
+// Transposed convolution (deconv): dilate the input by the stride,
+// pad with (k-1-p) per edge, correlate (no kernel flip) — the same
+// math as package.py's _np_deconv and lax.conv_transpose/HWOI.
+// Weights share the paired conv's (ky, kx, C, K) layout; no bias.
+class DeconvUnit : public Unit {
+ public:
+  void Initialize(const Json& config, std::map<std::string, NpyArray> arrays,
+                  const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    if (input_shape.size() != 4)
+      throw std::runtime_error("deconv: input must be (B, H, W, K)");
+    act_ = ParseAct(config);
+    weights_ = std::move(arrays.at("weights"));
+    if (weights_.shape.size() != 4)
+      throw std::runtime_error("deconv: weights must be (ky, kx, C, K)");
+    ky_ = weights_.shape[0];
+    kx_ = weights_.shape[1];
+    c_out_ = weights_.shape[2];
+    if (weights_.shape[3] != input_shape[3])
+      throw std::runtime_error("deconv: weights K != input channels");
+    Shape pad = ShapeOf(config, "padding");
+    left_ = pad[0]; right_ = pad[1]; top_ = pad[2]; bottom_ = pad[3];
+    Shape slide = ShapeOf(config, "sliding");
+    sx_ = slide[0]; sy_ = slide[1];
+    if (left_ < 0 || right_ < 0 || top_ < 0 || bottom_ < 0 ||
+        left_ >= kx_ || right_ >= kx_ || top_ >= ky_ || bottom_ >= ky_)
+      throw std::runtime_error(
+          "deconv: forward padding must be within [0, kernel) — "
+          "negative transpose pads (crops) are not supported");
+    hp_ = (input_shape[1] - 1) * sy_ + 1 + (ky_ - 1 - top_) +
+          (ky_ - 1 - bottom_);
+    wp_ = (input_shape[2] - 1) * sx_ + 1 + (kx_ - 1 - left_) +
+          (kx_ - 1 - right_);
+    if (hp_ < ky_ || wp_ < kx_)
+      throw std::runtime_error("deconv: padding exceeds kernel extent");
+    output_shape_ = {input_shape[0], hp_ - ky_ + 1, wp_ - kx_ + 1,
+                     c_out_};
+  }
+
+  int64_t ScratchFloats(int) const override {
+    // dilated+padded input, one batch sample at a time per worker is
+    // not needed: the buffer is shared, written disjointly per sample
+    return input_shape_[0] * hp_ * wp_ * input_shape_[3];
+  }
+
+  void Execute(const float* in, float* out, float* scratch,
+               Engine* engine) override {
+    const int64_t b = input_shape_[0], h = input_shape_[1],
+                  w = input_shape_[2], k = input_shape_[3];
+    const int64_t out_h = output_shape_[1], out_w = output_shape_[2];
+    const int64_t pt = ky_ - 1 - top_, pl = kx_ - 1 - left_;
+    std::memset(scratch, 0,
+                static_cast<size_t>(b) * hp_ * wp_ * k * sizeof(float));
+    engine->ParallelFor(b, [&](int64_t begin, int64_t end) {
+      for (int64_t i = begin; i < end; ++i)
+        for (int64_t ih = 0; ih < h; ++ih)
+          for (int64_t iw = 0; iw < w; ++iw)
+            std::memcpy(scratch + ((i * hp_ + pt + ih * sy_) * wp_ +
+                                   pl + iw * sx_) * k,
+                        in + ((i * h + ih) * w + iw) * k,
+                        static_cast<size_t>(k) * sizeof(float));
+    });
+    engine->ParallelFor(b * out_h, [&](int64_t begin, int64_t end) {
+      for (int64_t row = begin; row < end; ++row) {
+        const int64_t i = row / out_h, oh = row % out_h;
+        for (int64_t ow = 0; ow < out_w; ++ow) {
+          float* orow = out + ((i * out_h + oh) * out_w + ow) * c_out_;
+          std::memset(orow, 0,
+                      static_cast<size_t>(c_out_) * sizeof(float));
+          for (int64_t dy = 0; dy < ky_; ++dy)
+            for (int64_t dx = 0; dx < kx_; ++dx) {
+              const float* xrow = scratch +
+                  ((i * hp_ + oh + dy) * wp_ + ow + dx) * k;
+              const float* wrow = weights_.data.data() +
+                  (dy * kx_ + dx) * c_out_ * k;
+              for (int64_t c = 0; c < c_out_; ++c) {
+                float acc = 0.0f;
+                const float* wc = wrow + c * k;
+                for (int64_t kk = 0; kk < k; ++kk)
+                  acc += xrow[kk] * wc[kk];
+                orow[c] += acc;
+              }
+            }
+          ActRow(act_, orow, c_out_);
+        }
+      }
+    });
+  }
+
+ private:
+  NpyArray weights_;
+  Act act_ = Act::kNone;
+  int64_t ky_ = 0, kx_ = 0, c_out_ = 0;
+  int64_t left_ = 0, right_ = 0, top_ = 0, bottom_ = 0;
+  int64_t sx_ = 1, sy_ = 1;
+  int64_t hp_ = 0, wp_ = 0;
+};
+
+// Spatial crop (cutter): window (y, x, h, w).
+class CutterUnit : public Unit {
+ public:
+  void Initialize(const Json& config, std::map<std::string, NpyArray>,
+                  const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    if (input_shape.size() != 4)
+      throw std::runtime_error("cutter: input must be (B, H, W, C)");
+    const auto& win = config.at("window")->array;
+    y_ = win.at(0)->integer();
+    x_ = win.at(1)->integer();
+    h_ = win.at(2)->integer();
+    w_ = win.at(3)->integer();
+    if (y_ < 0 || x_ < 0 || y_ + h_ > input_shape[1] ||
+        x_ + w_ > input_shape[2])
+      throw std::runtime_error("cutter: window outside input");
+    output_shape_ = {input_shape[0], h_, w_, input_shape[3]};
+  }
+
+  void Execute(const float* in, float* out, float*,
+               Engine* engine) override {
+    const int64_t b = input_shape_[0], ih = input_shape_[1],
+                  iw = input_shape_[2], c = input_shape_[3];
+    engine->ParallelFor(b, [&](int64_t begin, int64_t end) {
+      for (int64_t i = begin; i < end; ++i)
+        for (int64_t r = 0; r < h_; ++r)
+          std::memcpy(out + ((i * h_ + r) * w_) * c,
+                      in + ((i * ih + y_ + r) * iw + x_) * c,
+                      static_cast<size_t>(w_) * c * sizeof(float));
+    });
+  }
+
+ private:
+  int64_t y_ = 0, x_ = 0, h_ = 0, w_ = 0;
+};
+
+// Contiguous channel slice (channel_splitter): start/count over the
+// trailing axis of an NHWC tensor.
+class ChannelSplitterUnit : public Unit {
+ public:
+  void Initialize(const Json& config, std::map<std::string, NpyArray>,
+                  const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    if (input_shape.empty())
+      throw std::runtime_error("channel_splitter: scalar input");
+    const int64_t channels = input_shape.back();
+    start_ = config.at("start")->integer();
+    count_ = config.has("count") && !config.at("count")->is_null()
+                 ? config.at("count")->integer()
+                 : channels - start_;
+    if (start_ < 0 || count_ <= 0 || start_ + count_ > channels)
+      throw std::runtime_error("channel_splitter: slice out of range");
+    output_shape_ = input_shape;
+    output_shape_.back() = count_;
+  }
+
+  void Execute(const float* in, float* out, float*,
+               Engine* engine) override {
+    const int64_t channels = input_shape_.back();
+    const int64_t rows = NumElements(input_shape_) / channels;
+    engine->ParallelFor(rows, [&](int64_t begin, int64_t end) {
+      for (int64_t r = begin; r < end; ++r)
+        std::memcpy(out + r * count_, in + r * channels + start_,
+                    static_cast<size_t>(count_) * sizeof(float));
+    });
+  }
+
+ private:
+  int64_t start_ = 0, count_ = 0;
+};
+
 }  // namespace
 
 UnitFactory& UnitFactory::Instance() {
@@ -611,6 +780,10 @@ void RegisterStandardUnits() {
   reg({"mean_disp"}, [] { return std::make_unique<MeanDispUnit>(); });
   reg({"lstm"}, [] { return std::make_unique<LstmUnit>(true); });
   reg({"rnn"}, [] { return std::make_unique<LstmUnit>(false); });
+  reg({"deconv"}, [] { return std::make_unique<DeconvUnit>(); });
+  reg({"cutter"}, [] { return std::make_unique<CutterUnit>(); });
+  reg({"channel_splitter"},
+      [] { return std::make_unique<ChannelSplitterUnit>(); });
 }
 
 }  // namespace veles_native

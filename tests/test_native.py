@@ -187,6 +187,51 @@ def test_rnn_full_sequence_package(lib, tmp_path):
         numpy.testing.assert_allclose(out, golden, atol=1e-4)
 
 
+def test_conv_autoencoder_package(lib, tmp_path):
+    """Conv-AE inference natively: conv encoder → deconv decoder
+    (transposed conv, stride 2) vs the eager chain and the Python
+    golden runner."""
+    from veles_tpu.znicz.conv import ConvTanh
+    from veles_tpu.znicz.misc_units import Deconv
+
+    rng = numpy.random.default_rng(6)
+    x = rng.standard_normal((3, 8, 8, 1)).astype(numpy.float32)
+    forwards, golden = _chain(
+        [(ConvTanh, {"n_kernels": 4, "kx": 3, "ky": 3, "padding": 1,
+                     "sliding": (2, 2),
+                     "weights_filling": "gaussian"}),
+         (Deconv, {"n_kernels": 4, "kx": 3, "ky": 3, "padding": 1,
+                   "sliding": (2, 2), "output_channels": 1,
+                   "weights_filling": "gaussian"})], x)
+    path = str(tmp_path / "convae.zip")
+    export_package(forwards, path, with_stablehlo=False)
+    runner = PackagedRunner(path)
+    numpy.testing.assert_allclose(runner.run(x), golden, atol=1e-4)
+    with native.NativeWorkflow(path) as wf:
+        out = wf.run(x)
+        assert out.shape == golden.shape
+        numpy.testing.assert_allclose(out, golden, atol=1e-4)
+
+
+def test_cutter_and_channel_splitter_package(lib, tmp_path):
+    """Spatial crop + channel slice natively vs the eager chain."""
+    from veles_tpu.znicz.misc_units import ChannelSplitter, Cutter
+
+    rng = numpy.random.default_rng(7)
+    x = rng.standard_normal((2, 9, 9, 6)).astype(numpy.float32)
+    forwards, golden = _chain(
+        [(Cutter, {"window": (2, 1, 5, 7)}),
+         (ChannelSplitter, {"start": 1, "count": 3})], x)
+    path = str(tmp_path / "slices.zip")
+    export_package(forwards, path, with_stablehlo=False)
+    runner = PackagedRunner(path)
+    numpy.testing.assert_allclose(runner.run(x), golden, atol=1e-6)
+    with native.NativeWorkflow(path) as wf:
+        out = wf.run(x)
+        assert out.shape == golden.shape
+        numpy.testing.assert_allclose(out, golden, atol=1e-5)
+
+
 def test_fp16_package(lib, tmp_path):
     from veles_tpu.znicz.all2all import All2AllSoftmax
     rng = numpy.random.default_rng(5)

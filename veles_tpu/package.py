@@ -73,6 +73,25 @@ def _unit_export_entry(unit, array_refs):
         entry["config"].update(hidden_units=unit.hidden_units,
                                last_only=bool(unit.last_only),
                                include_bias=bool(unit.include_bias))
+    elif mapping == "deconv":
+        # transposed conv shares the paired Conv's weight layout
+        # (ky, kx, C, K); its pure fn has no bias term
+        left, right, top, bottom = unit.padding
+        if not (0 <= left < unit.kx and 0 <= right < unit.kx
+                and 0 <= top < unit.ky and 0 <= bottom < unit.ky):
+            raise ValueError(
+                "deconv with forward padding >= kernel size is not "
+                "packageable (padding %r vs kernel (%d, %d))"
+                % (unit.padding, unit.kx, unit.ky))
+        entry["config"].update(
+            n_kernels=unit.n_kernels, kx=unit.kx, ky=unit.ky,
+            padding=list(unit.padding), sliding=list(unit.sliding),
+            activation=type(unit).ACTIVATION, include_bias=False)
+    elif mapping == "cutter":
+        entry["config"].update(window=list(unit.window))
+    elif mapping == "channel_splitter":
+        entry["config"].update(start=int(unit.start),
+                               count=unit.count)
     else:
         raise ValueError("unit type %r is not packageable" % mapping)
     return entry
@@ -292,6 +311,36 @@ def _np_softmax(z):
     return e / e.sum(axis=-1, keepdims=True)
 
 
+def _np_deconv(x, w, padding, sliding):
+    """Transposed conv matching ``znicz.misc_units.Deconv.pure``
+    (``lax.conv_transpose``, HWOI, no kernel flip): dilate the input by
+    the stride, pad with (k−1−p) per edge, then correlate."""
+    left, right, top, bottom = padding
+    sx, sy = sliding
+    b_sz, h, wd, _k = x.shape
+    ky, kx, c_out, _k2 = w.shape
+    hd, wdd = (h - 1) * sy + 1, (wd - 1) * sx + 1
+    pt, pb = ky - 1 - top, ky - 1 - bottom
+    pl, pr = kx - 1 - left, kx - 1 - right
+    if min(pt, pb, pl, pr) < 0:
+        # the export gate rejects these; a hand-built package must not
+        # silently flip numpy slices (eager conv_transpose would crop)
+        raise ValueError(
+            "deconv: forward padding %r >= kernel (%d, %d) is not "
+            "supported by the packaged runner" % (padding, kx, ky))
+    xp = numpy.zeros((b_sz, hd + pt + pb, wdd + pl + pr, x.shape[-1]),
+                     numpy.float32)
+    xp[:, pt:pt + hd:sy, pl:pl + wdd:sx, :] = x
+    out_h = xp.shape[1] - ky + 1
+    out_w = xp.shape[2] - kx + 1
+    out = numpy.zeros((b_sz, out_h, out_w, c_out), numpy.float32)
+    for dy in range(ky):
+        for dx in range(kx):
+            patch = xp[:, dy:dy + out_h, dx:dx + out_w, :]
+            out += patch @ w[dy, dx].T          # (…, K) @ (K, C)
+    return out
+
+
 def _np_conv(x, w, b, padding, sliding):
     left, right, top, bottom = padding
     sx, sy = sliding
@@ -415,6 +464,18 @@ class PackagedRunner(object):
             return x
         if utype == "mean_disp":
             return (x - arrays["mean"]) * arrays["disp"]
+        if utype == "deconv":
+            out = _np_deconv(x, arrays["weights"], cfg["padding"],
+                             cfg["sliding"])
+            return _np_act(cfg.get("activation"), out)
+        if utype == "cutter":
+            y, xo, h, w = cfg["window"]
+            return numpy.ascontiguousarray(x[:, y:y + h, xo:xo + w, :])
+        if utype == "channel_splitter":
+            start = int(cfg["start"])
+            count = cfg.get("count")
+            stop = x.shape[-1] if count is None else start + int(count)
+            return numpy.ascontiguousarray(x[..., start:stop])
         if utype in ("lstm", "rnn"):
             b, t, _d = x.shape
             h_units = int(cfg["hidden_units"])
