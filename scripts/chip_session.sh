@@ -24,6 +24,11 @@ BENCH_BUDGET_SEC=${BENCH_BUDGET_SEC:-2400} python bench.py \
     >"$OUT/bench.jsonl" 2>"$OUT/bench.log"
 note "bench rc=$? (lines: $(wc -l <"$OUT/bench.jsonl"))"
 
+note "2b/4 AlexNet batch sweep (256 vs 512)"
+BENCH_STAGES=alexnet BENCH_ALEXNET_BATCH=512 BENCH_BUDGET_SEC=900 \
+    python bench.py >"$OUT/alexnet_b512.jsonl" 2>"$OUT/alexnet_b512.log"
+note "alexnet b512 rc=$?"
+
 note "3/4 AlexNet step profile -> PROFILE.md"
 python -m veles_tpu.scripts.profile_step --sample alexnet --batch 256 \
     --out PROFILE.md >"$OUT/profile.log" 2>&1
