@@ -341,6 +341,33 @@ def test_standard_workflow_fused_mse_trains():
     assert float(wf.decision.best_mse) < numpy.inf
 
 
+def test_fused_workflow_deterministic():
+    """Two identically-seeded fused runs (incl. dropout's per-stage
+    seed streams) produce bit-identical weights — the reproducible-
+    randomness contract under jit."""
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist
+
+    def train_once():
+        prng.seed_all(77)
+        wf = mnist.create_workflow(
+            device=CPUDevice(), max_epochs=1, minibatch_size=500,
+            fused=True,
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 32},
+                 "<-": {"learning_rate": 0.03, "gradient_moment": 0.9}},
+                {"type": "dropout", "->": {"dropout_ratio": 0.3}},
+                {"type": "softmax", "->": {"output_sample_shape": 10},
+                 "<-": {"learning_rate": 0.03}},
+            ])
+        wf.run()
+        wf.forwards[0].weights.map_read()
+        return numpy.array(wf.forwards[0].weights.mem)
+
+    numpy.testing.assert_array_equal(train_once(), train_once())
+
+
 def test_standard_workflow_fused_snapshot_resume(tmp_path):
     """A fused workflow pickles and resumes: the trainer's device
     state is rebuilt from the unit weights it synced at epoch end, so
