@@ -523,11 +523,16 @@ def main():
     # earlier stages must never squeeze it out of the budget, so while
     # it is still pending each optional stage only runs (and is only
     # allowed to hang) inside remaining() minus a headline reserve.
-    ladder = [n for n in ("mnist", "mnist_e2e", "mnist_wf",
-                          "cifar", "ae",
-                          "kohonen", "lstm", "transformer",
-                          "alexnet")
-              if not only or n in only]
+    order = ("mnist", "mnist_e2e", "mnist_wf", "cifar", "ae",
+             "kohonen", "lstm", "transformer", "alexnet")
+    if env:
+        # CPU fallback (rehearsed with a wedged tunnel): the conv/LM
+        # heavies cannot finish on CPU inside their caps — skip them
+        # and end on the flagship MNIST number so the recorded last
+        # line is a real measurement, not the last stage to survive
+        order = ("mnist_e2e", "mnist_wf", "ae", "kohonen", "lstm",
+                 "mnist")
+    ladder = [n for n in order if not only or n in only]
     for name in ladder:
         _fn, cap = STAGES[name]
         reserve = 300 if name != "alexnet" and "alexnet" in ladder \
