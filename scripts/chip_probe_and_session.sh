@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-4 hardware front-loader. Probes the axon tunnel with NO kill
+# (killing a JAX client mid-claim wedges the relay for hours — see
+# ROUND3_NOTES.md), and the moment the chip answers, runs the full
+# chip_session.sh to produce every hardware artifact of the round.
+#
+#     nohup bash scripts/chip_probe_and_session.sh >chip_probe_r4.log 2>&1 &
+#
+# The probe is allowed to hang indefinitely; progress is visible in the
+# log timestamps. Nothing here ever sends SIGKILL to a JAX client.
+set -u
+cd "$(dirname "$0")/.."
+
+note() { echo "[probe $(date +%H:%M:%S)] $*"; }
+
+note "probing tunnel (patient, unkillable probe)"
+python - <<'EOF'
+import datetime
+import jax
+
+print("probe import done", datetime.datetime.now(), flush=True)
+devs = jax.devices()
+print("devices:", devs, flush=True)
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+y = (x @ x).sum()
+print("warm matmul:", float(y), datetime.datetime.now(), flush=True)
+EOF
+rc=$?
+note "probe rc=$rc"
+if [ "$rc" -ne 0 ]; then
+    note "tunnel down/wedged; not starting chip session"
+    exit "$rc"
+fi
+
+note "tunnel LIVE — starting chip_session"
+bash scripts/chip_session.sh chip_session_logs_r4
+note "chip_session done rc=$?"

@@ -350,8 +350,10 @@ def test_fused_workflow_deterministic():
 
     def train_once():
         prng.seed_all(77)
+        # 2 epochs: max_epochs=1 would stop after the initial eval
+        # pass with zero train steps, making the comparison vacuous
         wf = mnist.create_workflow(
-            device=CPUDevice(), max_epochs=1, minibatch_size=500,
+            device=CPUDevice(), max_epochs=2, minibatch_size=500,
             fused=True,
             layers=[
                 {"type": "all2all_tanh",
@@ -401,6 +403,41 @@ def test_standard_workflow_fused_snapshot_resume(tmp_path):
     assert restored.loader.epoch_number >= 2
     # resumed training did not regress below the snapshot's best
     assert float(restored.decision.best_n_err_pt) <= first_best + 1e-6
+
+
+def test_fused_snapshot_preserves_solver_state(tmp_path):
+    """Snapshotter resume continues with the SAME optimizer dynamics:
+    the momentum velocities pickled with the workflow are restored
+    into the rebuilt device state (parity with the eager path, where
+    the gradient Vectors live in the snapshot)."""
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.samples import mnist
+    from veles_tpu.snapshotter import load_snapshot
+
+    prng.seed_all(5)
+    # NB max_epochs=1 completes after the initial validation pass with
+    # zero train steps; 2 epochs = one real training epoch
+    wf = mnist.create_workflow(
+        device=CPUDevice(), max_epochs=2, minibatch_size=500,
+        fused=True, snapshot_dir=str(tmp_path))
+    wf.run()
+    v_orig = [numpy.asarray(st["vw"])
+              for st in wf.fused_trainer._params_ if "vw" in st]
+    assert v_orig and any(numpy.abs(v).max() > 0 for v in v_orig)
+
+    restored = load_snapshot(wf.snapshotter.destination)
+    restored.launcher = DummyLauncher()
+    assert restored.fused_trainer.solver_state is not None
+    restored.decision.complete <<= False
+    restored.decision.max_epochs = 2
+    restored.initialize(device=CPUDevice())
+    restored.fused_trainer._build()
+    v_rest = [numpy.asarray(st["vw"])
+              for st in restored.fused_trainer._params_ if "vw" in st]
+    assert len(v_rest) == len(v_orig)
+    for a, b in zip(v_orig, v_rest):
+        numpy.testing.assert_array_equal(b, a)
 
 
 def test_standard_workflow_fused_mesh_dp():
@@ -455,6 +492,29 @@ def test_grad_accum_matches_full_batch():
 
     with pytest.raises(ValueError, match="not divisible"):
         step_b(params_b, x[:30], labels[:30])
+
+
+def test_fused_tail_smaller_than_divisor_skips_step():
+    """A train tail batch SMALLER than grad_accum × data-axis (here:
+    6000 % 857 = 1 < grad_accum=4) must be skipped, not handed to the
+    traced step as an indivisible size (which raised mid-epoch)."""
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist
+
+    prng.seed_all(3)
+    # 2 epochs: max_epochs=1 stops at the initial eval close with zero
+    # train steps, so the tail path would never execute
+    wf = mnist.create_workflow(
+        device=CPUDevice(), max_epochs=2, minibatch_size=857,
+        fused=True, fused_config={"grad_accum": 4})
+    wf.run()                      # raised ValueError before the fix
+    results = wf.gather_results()
+    assert numpy.isfinite(results["best_validation_error_pt"])
+    # the epoch-boundary weight sync still happened
+    wf.forwards[0].weights.map_read()
+    numpy.testing.assert_allclose(
+        numpy.array(wf.forwards[0].weights.mem),
+        numpy.asarray(wf.fused_trainer._params_[0]["w"]), atol=1e-6)
 
 
 def test_fused_unknown_solver_rejected():

@@ -51,6 +51,12 @@ class FusedTrainer(AcceleratedUnit):
         self.n_err = 0.0
         self.mse = 0.0
         self.loss_value = 0.0
+        #: host copy of the full per-layer solver state (momentum
+        #: velocities, Adam moments/t, rprop deltas) captured at
+        #: pickle time so a Snapshotter resume continues with the same
+        #: optimizer dynamics — parity with the eager path, where the
+        #: gradient Vectors live in the snapshot.
+        self.solver_state = None
         self.demand("loader", "forwards")
 
     def init_unpickled(self):
@@ -61,6 +67,14 @@ class FusedTrainer(AcceleratedUnit):
         self._train_divisor_ = 1
         self._batch_shard_ = None
         self._rep_shard_ = None
+
+    def __getstate__(self):
+        state = super(FusedTrainer, self).__getstate__()
+        if self._params_ is not None:
+            import jax
+            state["solver_state"] = jax.tree_util.tree_map(
+                numpy.asarray, self._params_)
+        return state
 
     def _build(self):
         import jax
@@ -83,6 +97,7 @@ class FusedTrainer(AcceleratedUnit):
             specs, sample_shape, loss=self.loss,
             compute_dtype=self.compute_dtype, remat=self.remat,
             grad_accum=self.grad_accum)
+        params = self._restore_solver_state(params)
         self._train_divisor_ = max(self.grad_accum, 1)
         if self.mesh_axes:
             from veles_tpu.parallel import data_parallel, make_mesh
@@ -116,6 +131,25 @@ class FusedTrainer(AcceleratedUnit):
             self._params_ = jax.device_put(params)
             self._step_ = jax.jit(step_fn, donate_argnums=(0,))
             self._eval_ = jax.jit(eval_fn)
+
+    def _restore_solver_state(self, params):
+        """On snapshot resume, continue from the pickled solver state
+        (momentum/Adam/rprop dynamics) instead of a fresh optimizer."""
+        if self.solver_state is None:
+            return params
+        import jax
+
+        new_leaves, new_tree = jax.tree_util.tree_flatten(params)
+        sav_leaves, sav_tree = jax.tree_util.tree_flatten(
+            self.solver_state)
+        if new_tree != sav_tree or any(
+                numpy.shape(a) != numpy.shape(b)
+                for a, b in zip(new_leaves, sav_leaves)):
+            self.warning(
+                "pickled solver state does not match the rebuilt "
+                "layer stack — optimizer dynamics restart fresh")
+            return params
+        return jax.tree_util.tree_unflatten(new_tree, sav_leaves)
 
     def initialize(self, device=None, **kwargs):
         super(FusedTrainer, self).initialize(device=device, **kwargs)
@@ -156,7 +190,20 @@ class FusedTrainer(AcceleratedUnit):
             # a short tail batch must stay divisible into microbatches
             # and over the data axis; round down (drops < div samples
             # once per epoch)
-            n = max(n - n % div, 0) or n
+            n -= n % div
+            if n == 0:
+                # tail smaller than one microbatch × data-shard:
+                # nothing divisible to train on — skip the step
+                # entirely rather than hand the traced program an
+                # indivisible batch (at most once per epoch).  Zero
+                # the metrics: Decision adds them per minibatch, so
+                # stale values would double-count the previous batch.
+                self.n_err = 0.0
+                self.mse = 0.0
+                self.loss_value = 0.0
+                if bool(self.loader.last_minibatch):
+                    self.sync_weights()
+                return
         x = self.loader.minibatch_data.devmem[:n]
         labels = self._labels(n)
         if self._batch_shard_ is not None:
