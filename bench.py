@@ -26,7 +26,9 @@ bf16 compute / fp32 master weights).  ``vs_baseline`` compares against
 v5e-8 ≥ 4× single-V100, i.e. vs_baseline ≥ 0.5 per chip.
 
 Env knobs: ``BENCH_BUDGET_SEC`` (default 1200) total wall-clock budget;
-``BENCH_STAGES`` comma list to restrict stages.
+``BENCH_STAGES`` comma list to restrict stages; ``BENCH_FORCE_CPU``
+skips the TPU probe (local smokes must not race a serialized chip
+session for the tunnel claim).
 
 Reference discipline mirrored: the in-situ benchmark unit
 ``/root/reference/veles/accelerated_units.py:706-825`` (min-of-N timed
@@ -47,16 +49,19 @@ def _peak_flops(device_kind):
     return peak_bf16_flops(device_kind)
 
 
-def _measure(step_fn, params, x, labels, steps):
+def _measure(step_fn, params, x, labels, steps, flops_override=None):
     """Honest (sec_per_step, flops_per_step): ONE compiled program
     loops the step with a runtime trip count and is timed at two trip
     counts; the marginal cancels per-program dispatch/fetch overhead
     exactly.  block_until_ready is never trusted (round-2 post-mortem:
     through the tunneled PJRT transport it acks dispatch, not
     completion), and neither is timing across program launches
-    (round-3: it measured above chip peak — see ops/timing.py)."""
+    (round-3: it measured above chip peak — see ops/timing.py).
+    ``flops_override``: analytic count for steps whose inner lax.scan
+    bodies XLA's cost analysis counts only once (LSTM)."""
     from veles_tpu.ops.timing import measure_fused_step
-    return measure_fused_step(step_fn, params, x, labels, k=steps)
+    return measure_fused_step(step_fn, params, x, labels, k=steps,
+                              flops_override=flops_override)
 
 
 # --------------------------------------------------------------------------
@@ -353,7 +358,13 @@ def stage_lstm():
         rng.standard_normal((batch, 28, 28)).astype(numpy.float32))
     labels = jax.device_put(
         rng.integers(0, 10, batch).astype(numpy.int32))
-    sec, flops = _measure(step_fn, params, x, labels, steps=50)
+    # cost_analysis counts the 28-step sequence scan body ONCE —
+    # analytic FLOPs, or MFU underreports ~28×
+    from veles_tpu.znicz.rnn import lstm_train_flops
+    h = int(LAYERS[0]["->"]["hidden_units"])
+    flops_lstm = lstm_train_flops(batch, 28, 28, h, head_classes=10)
+    sec, flops = _measure(step_fn, params, x, labels, steps=50,
+                          flops_override=flops_lstm)
     _emit("Sequential-MNIST LSTM fused train throughput", sec, batch,
           flops)
 
@@ -384,8 +395,11 @@ def stage_transformer():
         return (p, v), metrics
 
     labels = numpy.zeros((batch,), numpy.int32)
-    sec, flops = _measure(step, (params, velocity), tokens, labels,
-                          steps=12)
+    # the blocks are scanned: cost analysis counts the body once, so
+    # FLOPs/MFU must come from the analytic closed form (~L× higher)
+    sec, flops = _measure(
+        step, (params, velocity), tokens, labels, steps=12,
+        flops_override=transformer.train_step_flops(cfg, batch))
     name = "GPT-512x8 LM fused train throughput (tokens basis)"
     if os.environ.get("BENCH_LM_TINY"):
         name += " [tiny-smoke]"
@@ -496,10 +510,14 @@ def main():
     def remaining():
         return deadline - time.monotonic()
 
-    # 1. backend probe (subprocess — a hung TPU init cannot hang us)
+    # 1. backend probe (subprocess — a hung TPU init cannot hang us).
+    # BENCH_FORCE_CPU skips the TPU attempt entirely — for local smokes
+    # while another (serialized) client owns the tunnel claim.
     env = {}
+    if os.environ.get("BENCH_FORCE_CPU"):
+        env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
     cap = min(STAGES["probe"][1], max(30.0, remaining()))
-    probe, err = _run_stage("probe", cap)
+    probe, err = _run_stage("probe", cap, env=env)
     if probe is None:
         print("probe failed (%s); falling back to CPU" % err,
               file=sys.stderr)
@@ -525,11 +543,13 @@ def main():
     # allowed to hang) inside remaining() minus a headline reserve.
     order = ("mnist", "mnist_e2e", "mnist_wf", "cifar", "ae",
              "kohonen", "lstm", "transformer", "alexnet")
-    if env:
+    if env and not only:
         # CPU fallback (rehearsed with a wedged tunnel): the conv/LM
         # heavies cannot finish on CPU inside their caps — skip them
         # and end on the flagship MNIST number so the recorded last
-        # line is a real measurement, not the last stage to survive
+        # line is a real measurement, not the last stage to survive.
+        # An explicit BENCH_STAGES selection overrides the skip (the
+        # operator asked for those stages, e.g. a tiny-config smoke).
         order = ("mnist_e2e", "mnist_wf", "ae", "kohonen", "lstm",
                  "mnist")
     ladder = [n for n in order if not only or n in only]

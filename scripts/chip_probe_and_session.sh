@@ -8,13 +8,14 @@
 #
 # The probe is allowed to hang indefinitely; progress is visible in the
 # log timestamps. Nothing here ever sends SIGKILL to a JAX client.
+# Exit status: probe rc if the tunnel is down, else chip_session's rc.
 set -u
 cd "$(dirname "$0")/.."
 
 note() { echo "[probe $(date +%H:%M:%S)] $*"; }
 
 note "probing tunnel (patient, unkillable probe)"
-python - <<'EOF'
+python - <<'PYEOF'
 import datetime
 import jax
 
@@ -25,7 +26,7 @@ import jax.numpy as jnp
 x = jnp.ones((256, 256), jnp.bfloat16)
 y = (x @ x).sum()
 print("warm matmul:", float(y), datetime.datetime.now(), flush=True)
-EOF
+PYEOF
 rc=$?
 note "probe rc=$rc"
 if [ "$rc" -ne 0 ]; then
@@ -35,4 +36,6 @@ fi
 
 note "tunnel LIVE — starting chip_session"
 bash scripts/chip_session.sh chip_session_logs_r4
-note "chip_session done rc=$?"
+rc=$?
+note "chip_session done rc=$rc"
+exit "$rc"

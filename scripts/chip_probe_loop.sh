@@ -1,0 +1,17 @@
+#!/bin/bash
+# Round-4 persistent tunnel watcher: loop the probe+session script
+# (which owns the never-SIGKILL tunnel discipline) until it succeeds.
+# Failures — tunnel down OR a session that died mid-way — back off
+# 10 min and retry the whole probe+session.
+set -u
+cd "$(dirname "$0")/.."
+
+note() { echo "[probe-loop $(date +%H:%M:%S)] $*"; }
+
+attempt=0
+until bash scripts/chip_probe_and_session.sh; do
+    attempt=$((attempt + 1))
+    note "attempt $attempt failed; retrying in 10 min"
+    sleep 600
+done
+note "chip session completed"

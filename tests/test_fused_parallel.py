@@ -494,6 +494,36 @@ def test_grad_accum_matches_full_batch():
         step_b(params_b, x[:30], labels[:30])
 
 
+def test_grad_accum_microbatches_draw_distinct_dropout_masks():
+    """Each microbatch in the grad-accum scan must draw its own
+    dropout mask.  Probe: duplicate a half-batch — if both microbatches
+    used the SAME mask, the grad_accum=2 update on the duplicated batch
+    would exactly equal the grad_accum=1 update on the half batch
+    (average of two identical gradients); distinct masks break that."""
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    layers = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+         "<-": {"learning_rate": 0.05}},
+        {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+        {"type": "softmax", "->": {"output_sample_shape": 4},
+         "<-": {"learning_rate": 0.05}},
+    ]
+    prng.seed_all(21)
+    params_half, step_half, _e, _a = lower_specs(layers, (12,))
+    prng.seed_all(21)            # identical init weights AND seeds
+    params_dup, step_dup, _e2, _a2 = lower_specs(layers, (12,),
+                                                 grad_accum=2)
+    x_half, l_half = _data(n=16)
+    x_dup = numpy.concatenate([x_half, x_half])
+    l_dup = numpy.concatenate([l_half, l_half])
+    params_half, _m = step_half(params_half, x_half, l_half)
+    params_dup, _m2 = step_dup(params_dup, x_dup, l_dup)
+    w_half = numpy.asarray(params_half[0]["w"])
+    w_dup = numpy.asarray(params_dup[0]["w"])
+    assert not numpy.allclose(w_half, w_dup, atol=1e-7)
+
+
 def test_fused_tail_smaller_than_divisor_skips_step():
     """A train tail batch SMALLER than grad_accum × data-axis (here:
     6000 % 857 = 1 < grad_accum=4) must be skipped, not handed to the

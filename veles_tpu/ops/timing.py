@@ -224,7 +224,8 @@ def marginal_time(call, min_seconds=2.0, max_calls=10000):
 
 
 def measure_fused_step(step_fn, params, x, labels, k=20,
-                       min_seconds=None, donate=False, repeats=3):
+                       min_seconds=None, donate=False, repeats=3,
+                       flops_override=None):
     """Measure honest seconds per single ``step_fn`` application.
 
     ONE program loops the step with a *runtime* trip count
@@ -241,9 +242,18 @@ def measure_fused_step(step_fn, params, x, labels, k=20,
     while-loop body ONCE regardless of trip count, so the program's
     total is the inline first step + the body = exactly two steps'
     FLOPs (dividing by K, as before round 3, under-reported FLOPs — and
-    MFU — by ~K/2×).  ``min_seconds`` is accepted for backward
-    compatibility and ignored: the two-trip-count marginal replaces
-    wall-clock budgeting.
+    MFU — by ~K/2×).
+
+    CAVEAT: the same counted-once rule applies to loops INSIDE the
+    step.  A step containing an inner ``lax.scan``/``while_loop`` (an
+    LSTM's T-step sequence scan, the grad-accum microbatch scan) has
+    its inner body counted once, so cost-analysis FLOPs — and the MFU
+    derived from them — underreport by roughly the inner trip count.
+    For such steps pass ``flops_override`` with an analytic per-step
+    count (e.g. :func:`veles_tpu.znicz.rnn.lstm_train_flops`); it is
+    returned as ``flops_per_step`` in place of the cost-analysis value.
+    ``min_seconds`` is accepted for backward compatibility and ignored:
+    the two-trip-count marginal replaces wall-clock budgeting.
     """
     if donate:
         raise ValueError(
@@ -255,8 +265,11 @@ def measure_fused_step(step_fn, params, x, labels, k=20,
     jitted = jax.jit(multi)
     compiled = jitted.lower(params, x, labels,
                             numpy.int32(k)).compile()
-    total = cost_flops(compiled)
-    flops = (total / 2.0) if total else None
+    if flops_override:
+        flops = float(flops_override)
+    else:
+        total = cost_flops(compiled)
+        flops = (total / 2.0) if total else None
 
     k1, k2 = max(1, k // 4), k
 

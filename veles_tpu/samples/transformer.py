@@ -233,6 +233,29 @@ def build_train(cfg=None, mesh=None, seq_axis="seq", lr=3e-4,
     return params, velocity, jitted
 
 
+def train_step_flops(cfg, batch):
+    """Analytic FLOPs of one LM train step (forward + backward + SGD
+    update ≈ 3× the forward matmuls — the standard MFU convention;
+    remat's forward recompute is deliberately NOT counted as useful
+    work).
+
+    Needed because :func:`apply_fn` scans the blocks: XLA's
+    ``cost_analysis()`` counts the ``lax.scan`` body ONCE regardless of
+    depth L, so compiled-cost FLOPs underreport by ~L (see the inner-
+    scan caveat on ``veles_tpu.ops.timing.measure_fused_step``).
+    Attention is counted causal-discounted (each token attends to ~S/2
+    keys, matching what the flash kernel actually computes)."""
+    d, L, S, V = cfg["dim"], cfg["layers"], cfg["seq_len"], cfg["vocab"]
+    f = cfg["mlp_ratio"] * d
+    per_token_layer = (
+        2.0 * d * 3 * d          # qkv projection
+        + 2.0 * S * d            # QK^T + AV, causal-averaged S/2 each
+        + 2.0 * d * d            # output projection
+        + 4.0 * d * f)           # mlp up + down
+    per_token = L * per_token_layer + 2.0 * d * V   # tied readout
+    return 3.0 * batch * S * per_token
+
+
 def synthetic_tokens(cfg, batch, seed=0):
     rng = numpy.random.default_rng(seed)
     return rng.integers(0, cfg["vocab"],

@@ -60,16 +60,30 @@ def build(sample, batch):
 
         def apply_fn(p, x):
             return apply_raw(p, x, train=False)
+    # recurrent samples: XLA cost analysis counts the T-step sequence
+    # scan body ONCE, so FLOPs must come from the analytic closed form
+    # (see measure_fused_step's inner-scan caveat)
+    flops_overrides = None
+    if sample == "mnist_rnn":
+        from veles_tpu.znicz.rnn import lstm_fwd_flops, lstm_train_flops
+        t, d = shape
+        h = int(layers[0]["->"]["hidden_units"])
+        flops_overrides = {
+            "full_step": lstm_train_flops(batch, t, d, h,
+                                          head_classes=n_classes),
+            "forward": lstm_fwd_flops(batch, t, d, h,
+                                      head_classes=n_classes),
+        }
     rng = numpy.random.default_rng(0)
     x = jax.device_put(rng.standard_normal(
         (batch,) + tuple(shape)).astype(numpy.float32))
     labels = jax.device_put(
         rng.integers(0, n_classes, batch).astype(numpy.int32))
-    return params, step, apply_fn, x, labels
+    return params, step, apply_fn, x, labels, flops_overrides
 
 
 def measure_phases(params, step, apply_fn, x, labels, k=10,
-                   min_seconds=None):
+                   min_seconds=None, flops_overrides=None):
     import jax
     import jax.numpy as jnp
 
@@ -77,11 +91,13 @@ def measure_phases(params, step, apply_fn, x, labels, k=10,
                                       measure_fused_step)
 
     phases = {}
+    overrides = flops_overrides or {}
 
     # full step: in-program two-trip-count marginal (the bench
     # methodology — see ops/timing.py round-3 notes)
-    sec, flops = measure_fused_step(step, jax.device_put(params), x,
-                                    labels, k=max(k, 8))
+    sec, flops = measure_fused_step(
+        step, jax.device_put(params), x, labels, k=max(k, 8),
+        flops_override=overrides.get("full_step"))
     phases["full_step"] = (sec, flops)
 
     # forward-only: the same in-program marginal over inference applies,
@@ -103,8 +119,11 @@ def measure_phases(params, step, apply_fn, x, labels, k=10,
     # flops of one apply: the loop program counts the body ONCE plus
     # the warmup inline iteration — both identical applies, so /2 via a
     # dedicated lowering is unnecessary; use a 1-apply compile instead
-    fwd1 = jax.jit(lambda a, b: apply_fn(a, b)).lower(params, x)
-    fwd_flops = cost_flops(fwd1.compile())
+    if overrides.get("forward"):
+        fwd_flops = overrides["forward"]
+    else:
+        fwd1 = jax.jit(lambda a, b: apply_fn(a, b)).lower(params, x)
+        fwd_flops = cost_flops(fwd1.compile())
     sec_fwd = inprogram_marginal(unit, (x, jnp.float32(0.0)),
                                  k1=2, k2=max(k, 8))
     phases["forward"] = (sec_fwd, fwd_flops)
@@ -123,8 +142,10 @@ def main(argv=None):
 
     import jax
     kind = jax.devices()[0].device_kind
-    params, step, apply_fn, x, labels = build(args.sample, args.batch)
-    phases = measure_phases(params, step, apply_fn, x, labels, k=args.k)
+    (params, step, apply_fn, x, labels,
+     flops_overrides) = build(args.sample, args.batch)
+    phases = measure_phases(params, step, apply_fn, x, labels,
+                            k=args.k, flops_overrides=flops_overrides)
 
     full_sec, full_flops = phases["full_step"]
     fwd_sec, fwd_flops = phases["forward"]

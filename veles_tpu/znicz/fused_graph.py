@@ -241,9 +241,19 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
 
             def body(carry, chunk):
                 acc, err_acc, loss_acc = carry
-                cx, cl = chunk
+                idx, cx, cl = chunk
+                # each microbatch draws DISTINCT dropout/stochastic-
+                # pool masks: fold the chunk index into every stage
+                # seed (golden-ratio-style odd stride keeps the
+                # streams disjoint from the +1 per-step seed advance)
+                aux_i = tuple(
+                    {k: (jnp.int32(
+                        (v + idx * jnp.int32(0x3504f325))
+                        & 0x3fffffff) if k == "seed" else v)
+                     for k, v in aux.items()}
+                    for aux in aux_list)
                 (_v, (n_err_c, report_c)), g = jax.value_and_grad(
-                    loss_fn, has_aux=True)(wb_list, aux_list, cx, cl)
+                    loss_fn, has_aux=True)(wb_list, aux_i, cx, cl)
                 acc = jax.tree.map(jnp.add, acc, g)
                 # float carry: softmax n_err is an int count, mse's is
                 # an RMSE — float accumulates both
@@ -253,7 +263,7 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
             zeros = jax.tree.map(jnp.zeros_like, wb_list)
             (gsum, n_err, loss_sum), _ = jax.lax.scan(
                 body, (zeros, jnp.float32(0.0), jnp.float32(0.0)),
-                (xs, ls))
+                (jnp.arange(grad_accum, dtype=jnp.int32), xs, ls))
             grads = jax.tree.map(lambda g: g / grad_accum, gsum)
             report = loss_sum / grad_accum
             if loss == "mse":

@@ -153,3 +153,29 @@ class SimpleRNN(LSTM):
             return h_last
         return ys.transpose(1, 0, 2)
 
+
+
+def lstm_fwd_flops(batch, t, d, h, gates=4, head_classes=0):
+    """Analytic FLOPs of one LSTM (``gates=4``) / SimpleRNN
+    (``gates=1``) forward pass over a ``(batch, t, d)`` input.
+
+    XLA's ``cost_analysis()`` counts a ``lax.scan`` body ONCE
+    regardless of trip count, so compiled-cost accounting underreports
+    a T-step recurrent forward by ~T.  The per-step gates matmul
+    ``[x_t, h] @ W`` with ``W: (d+h, gates*h)`` dominates; elementwise
+    gate math (~10 FLOPs/hidden unit) is included for honesty.
+    ``head_classes`` adds a dense classifier head on the last hidden
+    state."""
+    per_step = 2.0 * (d + h) * gates * h + 10.0 * h
+    return float(batch) * (t * per_step + 2.0 * h * head_classes)
+
+
+def lstm_train_flops(batch, t, d, h, gates=4, head_classes=0):
+    """Analytic FLOPs of one fused LSTM train step (forward + VJP
+    backward + update): backward through the scan costs ~2× the
+    forward matmuls, so train ≈ 3× forward (head included).
+
+    Pass as ``flops_override`` to
+    :func:`veles_tpu.ops.timing.measure_fused_step` — see the inner-
+    scan caveat there."""
+    return 3.0 * lstm_fwd_flops(batch, t, d, h, gates, head_classes)
