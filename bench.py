@@ -82,13 +82,22 @@ def stage_probe():
                     "cifar10": cifar10_available()}
     except Exception:
         datasets = {}
+    # accuracy parity is a SEPARATE claim from throughput parity —
+    # state it loudly so no reader mistakes one for the other
+    # (VERDICT r3 item 8)
+    if datasets and all(datasets.values()):
+        parity = ("data present - run tests/test_accuracy_parity.py "
+                  "for the strict gates")
+    else:
+        parity = "unproven (real datasets absent from this image)"
     print(json.dumps({"platform": dev.platform,
                       "device_kind": dev.device_kind,
                       "n_devices": jax.device_count(),
                       # accuracy-parity gates (test_accuracy_parity.py)
                       # need the real files; throughput stages use
                       # synthetic batches either way
-                      "real_datasets_present": datasets}))
+                      "real_datasets_present": datasets,
+                      "accuracy_parity": parity}))
 
 
 def _device_kind():
@@ -211,9 +220,15 @@ def stage_mnist_wf():
     tic = time.perf_counter()
     wf.run()                               # epochs 2-3, warm
     elapsed = time.perf_counter() - tic
-    samples = 2 * sum(int(n) for n in wf.loader.class_lengths)
-    _emit("MNIST784 full StandardWorkflow(fused) epoch throughput",
-          batch * elapsed / samples, batch, None)
+    # train-only images over the wall clock (which includes the eval
+    # passes): comparable to the fused synthetic-batch line — counting
+    # eval minibatches as served images made this neither a train
+    # throughput nor an epoch time (VERDICT r3 item 7)
+    from veles_tpu.loader.base import TRAIN
+    train_samples = 2 * int(wf.loader.class_lengths[TRAIN])
+    _emit("MNIST784 full StandardWorkflow(fused) train throughput "
+          "(epoch wall-clock incl. eval)",
+          batch * elapsed / train_samples, batch, None)
 
 
 def stage_cifar():

@@ -15,8 +15,11 @@ mkdir -p "$OUT"
 note() { echo "[chip_session] $*" >&2; }
 
 note "1/4 autotune sweep (fills veles_tpu/devices/device_infos.json)"
-python -m veles_tpu.scripts.autotune >"$OUT/autotune.json" \
-    2>"$OUT/autotune.log"
+# full candidate sweep over the production shape classes at precision
+# level 0, then a pruned pallas-vs-xla race at the Kahan/multipartial
+# levels 1,2 (entries keyed per (dtype, precision) — VERDICT r3 item 4)
+python -m veles_tpu.scripts.autotune --precision-levels 0,1,2 \
+    >"$OUT/autotune.json" 2>"$OUT/autotune.log"
 note "autotune rc=$? (DB: veles_tpu/devices/device_infos.json)"
 
 note "2/4 bench ladder"

@@ -365,6 +365,52 @@ def test_timing_inprogram_marginal_and_dynamic_k():
         assert flops == pytest.approx(expect, rel=0.5)
 
 
+def test_two_point_marginal_survives_short_point_stall():
+    """Round-4 hardening: a transient transport stall in the FIRST
+    short-point sample must not skew the marginal — the short point is
+    sampled twice up front (min wins) and its spread is recorded as
+    provenance.  Before the fix, the single contaminated t1 anchored
+    every widen/retry and the marginal converged to the wrong value."""
+    from veles_tpu.ops.timing import _two_point_marginal
+
+    true_per_unit = 1e-3
+    overhead = 0.05
+    calls = {"n": 0}
+
+    def timed(n):
+        calls["n"] += 1
+        t = overhead + n * true_per_unit
+        if calls["n"] == 1:           # stall hits only the first sample
+            t += 5.0
+        return t
+
+    stats = {}
+    m = _two_point_marginal(timed, 4, 32, target_signal=0.01,
+                            max_k=10000, stats=stats)
+    assert m == pytest.approx(true_per_unit, rel=1e-9)
+    assert stats["marginal"] == m
+    assert stats["t1_samples"] >= 2
+    assert stats["t1_rel_spread"] > 1.0   # the stall left a signature
+    assert stats["t1"] == pytest.approx(overhead + 4 * true_per_unit)
+    # provenance invariant: the recorded points reproduce the marginal
+    assert stats["marginal"] == pytest.approx(
+        (stats["t2"] - stats["t1"]) / (stats["k2"] - stats["k1"]))
+
+    # steady-noise convergence: every sample jitters ±20 %, the widen
+    # loop still lands within 25 % of truth (deterministic "noise")
+    seq = [1.2, 0.95, 1.1, 1.0, 0.9, 1.15, 1.05, 0.85, 1.0, 1.1]
+    calls2 = {"n": 0}
+
+    def noisy(n):
+        f = seq[calls2["n"] % len(seq)]
+        calls2["n"] += 1
+        return overhead + n * true_per_unit * f
+
+    m2 = _two_point_marginal(noisy, 4, 32, target_signal=0.05,
+                             max_k=10000)
+    assert m2 == pytest.approx(true_per_unit, rel=0.25)
+
+
 def test_peak_guard_rejects_faster_than_hardware(monkeypatch):
     """A marginal implying more FLOPs than the chip's peak must be
     re-measured and then refused, never recorded (the round-2 MFU-54
@@ -463,7 +509,8 @@ def test_autotune_db_drives_dispatch(tmp_path, monkeypatch):
 def test_autotune_gemm_writes_db(tmp_path):
     """The sweep itself (tiny shapes, CPU): produces a DB whose entry
     has backend/tiles/sec_per_flop and that gemm_choice can read
-    back."""
+    back — plus per-shape-class, per-precision gemm_v2 entries
+    carrying the stopwatch's noise signature (VERDICT r3 items 4/5)."""
     import jax
 
     from veles_tpu.ops import benchmark
@@ -471,10 +518,104 @@ def test_autotune_gemm_writes_db(tmp_path):
     info = benchmark.autotune_gemm(
         shapes=((64, 64, 64),), dtypes=("float32",),
         candidates=((64, 64, 64),), runs=1,
-        db_path=str(tmp_path / "db.json"))
+        db_path=str(tmp_path / "db.json"),
+        precision_levels=(0, 1))
     entry = info.ratings["gemm"]["float32"]
     assert entry["backend"] in ("pallas", "xla")
     assert entry["sec_per_flop"] > 0
     choice = benchmark.gemm_choice(
         "float32", db_path=str(tmp_path / "db.json"))
     assert choice is not None
+    # v2: one entry per measured precision level, classified by shape,
+    # with measurement provenance
+    v2 = info.ratings["gemm_v2"]["float32"]
+    cls = benchmark.classify_shape(64, 64, 64)
+    for lvl in ("p0", "p1"):
+        e = v2[lvl][cls]
+        assert e["backend"] in ("pallas", "xla")
+        assert e["sec_per_flop"] > 0
+        assert e["shape"] == [64, 64, 64]
+        assert "t1_rel_spread" in e
+
+
+def test_gemm_choice_respects_precision_and_shape_class(tmp_path,
+                                                        monkeypatch):
+    """Dispatch routing over the v2 DB: shape classes select their own
+    measured entry; a precision level with no measurement falls back
+    to XLA — NEVER to tiles raced under another precision's MXU pass
+    count (VERDICT r3 item 4)."""
+    import json as _json
+
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.config import root
+    from veles_tpu.ops import benchmark
+
+    def e(backend, tiles, shape):
+        return {"sec_per_flop": 1e-12, "backend": backend,
+                "tiles": tiles, "shape": shape, "t1_rel_spread": 0.02}
+
+    model = jax.devices()[0].device_kind
+    db_path = tmp_path / "device_infos.json"
+    db_path.write_text(_json.dumps({model: {
+        "gemm": {"float32": {"sec_per_flop": 1e-12,
+                             "backend": "pallas",
+                             "tiles": [512, 512, 512]}},
+        "gemm_v2": {"float32": {
+            "p0": {
+                "square_large": e("pallas", [256, 256, 256],
+                                  [4096, 4096, 4096]),
+                "tall_skinny": e("xla", None, [16384, 1024, 1024]),
+            },
+            "p2": {
+                "square_large": e("pallas", [128, 128, 128],
+                                  [4096, 4096, 4096]),
+            },
+        }},
+    }}))
+    monkeypatch.setattr(benchmark, "DEVICE_INFOS_JSON", str(db_path))
+    benchmark.gemm_choice.cache_clear()
+    try:
+        # p0: shape-class routing picks the class's own winner
+        assert benchmark.gemm_choice(
+            jnp.float32, shape=(4096, 4096, 4096)) == \
+            ("pallas", (256, 256, 256))
+        assert benchmark.gemm_choice(
+            jnp.float32, shape=(16384, 1024, 1024)) == ("xla", None)
+        # no shape info: square_large is the representative entry
+        assert benchmark.gemm_choice(jnp.float32) == \
+            ("pallas", (256, 256, 256))
+        root.common.engine.precision_level = 2
+        assert benchmark.gemm_choice(
+            jnp.float32, shape=(4096, 4096, 4096)) == \
+            ("pallas", (128, 128, 128))
+        # p1 was never measured: XLA (None), NOT the p0 tiles
+        root.common.engine.precision_level = 1
+        assert benchmark.gemm_choice(
+            jnp.float32, shape=(4096, 4096, 4096)) is None
+        # bfloat16 has neither v2 nor legacy rows at p1: still None
+        assert benchmark.gemm_choice(
+            jnp.bfloat16, shape=(4096, 4096, 4096)) is None
+        root.common.engine.precision_level = 0
+        # flash attention routes by sequence regime (flash_v2)
+        db = _json.loads(db_path.read_text())
+        db[model]["flash_attention_v2"] = {"bfloat16": {
+            "seq_2k": e("pallas", [256, 256], [4, 2048, 8, 128]),
+            "seq_8k": e("pallas", [512, 256], [1, 8192, 8, 128]),
+        }}
+        db_path.write_text(_json.dumps(db))
+        benchmark.gemm_choice.cache_clear()
+        assert benchmark.gemm_choice(
+            jnp.bfloat16, kernel="flash_attention",
+            shape=(4, 2048, 8, 128)) == ("pallas", (256, 256))
+        assert benchmark.gemm_choice(
+            jnp.bfloat16, kernel="flash_attention",
+            shape=(1, 8192, 8, 128)) == ("pallas", (512, 256))
+        # no shape: the canonical seq_2k regime represents the kernel
+        assert benchmark.gemm_choice(
+            jnp.bfloat16, kernel="flash_attention") == \
+            ("pallas", (256, 256))
+    finally:
+        root.common.engine.precision_level = 0
+        benchmark.gemm_choice.cache_clear()

@@ -209,3 +209,42 @@ def test_transformer_loss_decreases():
 def test_graft_entry_dryrun_all_modes():
     import __graft_entry__ as graft
     graft.dryrun_multichip(8)
+
+
+def test_real_shape_dryrun_leg_shardings():
+    """Pins the per-leg sharding specs of the real-dims multichip
+    dryruns (VERDICT r3 item 6) WITHOUT running the heavy steps:
+    fsdp_rules on the REAL LM parameter shapes must shard every big
+    weight (and momenta) over 'data' on a dim divisible by 8, and the
+    conv-DP contract keeps params replicated with the batch split."""
+    from jax.sharding import PartitionSpec as P
+
+    from veles_tpu.parallel.dp import fsdp_rules
+
+    mesh = make_mesh({"data": 8})
+    rules = fsdp_rules(mesh)
+    # real LM shapes (transformer.CONFIG: d=1024 L=12 V=32000 S=2048)
+    d, L, V, S, f = 1024, 12, 32000, 2048, 4096
+    expected = {
+        (V, d): P("data", None),            # embed: vocab dim
+        (S, d): P("data", None),            # pos
+        (L, d, 3, 16, 64): P(None, "data", None, None, None),  # wqkv
+        (L, 16, 64, d): P(None, "data", None, None),           # wo
+        (L, d, f): P(None, "data", None),   # w1
+        (L, f, d): P(None, "data", None),   # w2
+        (L, f): P(None, "data"),            # b1
+        (L, d): P(None, "data"),            # ln gains / b2
+    }
+    for shape, spec in expected.items():
+        got = rules(numpy.empty(shape, numpy.float32))
+        assert got == spec, (shape, got, spec)
+    # small leaves stay replicated (collective latency > bytes saved);
+    # a full d-vector (= min_elements) is big enough to shard
+    assert rules(numpy.empty((64,), numpy.float32)) is None
+    assert rules(numpy.empty((d,), numpy.float32)) == P("data")
+    # AlexNet conv-DP leg: params replicated, batch on 'data'
+    from veles_tpu.parallel import data_parallel
+    from veles_tpu.parallel.dp import _params_sharding
+    params = [{"w": numpy.empty((11, 11, 3, 96), numpy.float32)}]
+    shard = _params_sharding(params, mesh, None)
+    assert shard[0]["w"].is_fully_replicated

@@ -229,19 +229,22 @@ def _on_tpu():
         return False
 
 
-def _db_choice(dtype):
+def _db_choice(dtype, shape=None):
     try:
         from veles_tpu.ops.benchmark import gemm_choice
-        return gemm_choice(dtype, kernel="flash_attention")
+        return gemm_choice(dtype, kernel="flash_attention",
+                           shape=shape)
     except Exception:
         return None
 
 
-def _resolve_blocks(block_q, block_k, dtype):
+def _resolve_blocks(block_q, block_k, dtype, shape=None):
     """Caller-supplied blocks win; else the autotune DB's measured
-    blocks for this device generation; else 128s.  Trace-time only."""
+    blocks for this device generation — routed by the actual
+    (b, s, h, d) onto the measured sequence-regime classes
+    (``flash_attention_v2``); else 128s.  Trace-time only."""
     if block_q is None or block_k is None:
-        choice = _db_choice(dtype)
+        choice = _db_choice(dtype, shape)
         db = choice[1] if choice else None
         if db:
             block_q = block_q or int(db[0])
@@ -249,22 +252,23 @@ def _resolve_blocks(block_q, block_k, dtype):
     return block_q or 128, block_k or 128
 
 
-def _resolve_backend(use_pallas, dtype):
+def _resolve_backend(use_pallas, dtype, shape=None):
     """Explicit arg > the autotune DB's measured winner for this
-    device generation > Pallas-on-TPU default."""
+    device generation (per sequence regime) > Pallas-on-TPU default."""
     if use_pallas is not None:
         return use_pallas
     if not _on_tpu():
         return False
-    choice = _db_choice(dtype)
+    choice = _db_choice(dtype, shape)
     if choice is not None:
         return choice[0] == "pallas"
     return True
 
 
 def _fwd_impl(q, k, v, causal, block_q, block_k, use_pallas):
-    block_q, block_k = _resolve_blocks(block_q, block_k, q.dtype)
-    pallas = _resolve_backend(use_pallas, q.dtype)
+    block_q, block_k = _resolve_blocks(block_q, block_k, q.dtype,
+                                       q.shape)
+    pallas = _resolve_backend(use_pallas, q.dtype, q.shape)
     if pallas:
         from veles_tpu.config import root
         o, lse = _flash_fwd(
@@ -281,7 +285,8 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, use_pallas):
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, use_pallas, res, do):
-    _bq, block_k = _resolve_blocks(block_q, block_k, res[0].dtype)
+    _bq, block_k = _resolve_blocks(block_q, block_k, res[0].dtype,
+                                   res[0].shape)
     return _bwd_blockwise(res, do, causal, block_k)
 
 

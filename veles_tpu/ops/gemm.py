@@ -137,15 +137,17 @@ def matmul(a, b, bias=None, activation=None, tiles=None, use_pallas=None):
     return _matmul_fwd(a, b, bias, activation, tiles, use_pallas)[0]
 
 
-def _dispatch(use_pallas, tiles, dtype):
+def _dispatch(use_pallas, tiles, dtype, shape=None):
     """(use_pallas_bool, tiles) for this call.  Priority: explicit
     ``use_pallas`` arg > explicit ``root.common.engine.pallas_gemm``
     config > the autotune DB's measured winner for this device
-    generation (``ops.benchmark.gemm_choice``) > XLA.  This runs at
+    generation, shape class and precision level
+    (``ops.benchmark.gemm_choice``) > XLA.  This runs at
     TRACE time only (jit caches the result), so the DB lookup costs
     nothing per step."""
     from veles_tpu.ops.benchmark import gemm_choice
-    choice = None if use_pallas is False else gemm_choice(dtype)
+    choice = None if use_pallas is False else gemm_choice(dtype,
+                                                          shape=shape)
     db_tiles = choice[1] if choice else None
     if use_pallas is not None:
         # explicit choice still benefits from measured tiles
@@ -163,7 +165,8 @@ def _dispatch(use_pallas, tiles, dtype):
 
 
 def _matmul_fwd(a, b, bias, activation, tiles, use_pallas):
-    pallas, eff_tiles = _dispatch(use_pallas, tiles, a.dtype)
+    pallas, eff_tiles = _dispatch(use_pallas, tiles, a.dtype,
+                                  (a.shape[0], a.shape[1], b.shape[1]))
     if pallas:
         from veles_tpu.config import root
         out = _matmul_pallas(

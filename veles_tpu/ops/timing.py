@@ -100,7 +100,8 @@ def make_multi_step(step_fn, k=None):
 
 
 def inprogram_marginal(unit_fn, init_carry, k1=8, k2=64, repeats=3,
-                       max_retries=2, target_signal=0.25, max_k=100000):
+                       max_retries=2, target_signal=0.25, max_k=100000,
+                       stats=None):
     """Marginal seconds per ``unit_fn`` application, measured INSIDE one
     XLA program.
 
@@ -146,11 +147,12 @@ def inprogram_marginal(unit_fn, init_carry, k1=8, k2=64, repeats=3,
 
     return _two_point_marginal(timed, k1, k2, target_signal, max_k,
                                attempts=max_retries + 2,
-                               label="inprogram_marginal")
+                               label="inprogram_marginal", stats=stats)
 
 
 def _two_point_marginal(timed, k1, k2, target_signal, max_k,
-                        attempts=4, label="two_point_marginal"):
+                        attempts=4, label="two_point_marginal",
+                        stats=None):
     """Shared widen/retry core of the two-trip-count stopwatch.
 
     ``timed(n)`` = best-of-repeats wall seconds of ONE program doing
@@ -159,27 +161,54 @@ def _two_point_marginal(timed, k1, k2, target_signal, max_k,
     ``target_signal``; doubles it when noise swamps the gap.  A
     ``FloatingPointError`` from a widened run (weights gone non-finite
     at the longer horizon) falls back to the last positive marginal,
-    which is still a valid measurement."""
+    which is still a valid measurement.
+
+    The short point anchors EVERY marginal, so it is sampled twice up
+    front, re-timed on every retry, and always taken as the min — one
+    transient transport stall in a single ``t1`` sample would
+    otherwise skew all subsequent marginals (round-4 hardening).
+
+    ``stats``, when a dict, receives the measurement's provenance:
+    final ``k1/k2/t1/t2/marginal``, ``t1_samples`` count, and
+    ``t1_rel_spread`` = (max−min)/min over the short-point samples — a
+    noise signature persisted next to DB ratings so stale/noisy
+    entries are detectable."""
     best = None
-    t1 = timed(k1)      # deterministic short point: time it once
+    best_pt = None          # the exact (t1, t2, k2) that produced best
+    t1_samples = [timed(k1), timed(k1)]
+
+    def _record(marginal, pt):
+        if stats is not None:
+            t1_used, t2_used, k2_used = pt
+            lo, hi = min(t1_samples), max(t1_samples)
+            stats.update({
+                "k1": k1, "k2": k2_used, "t1": t1_used, "t2": t2_used,
+                "t1_samples": len(t1_samples),
+                "t1_rel_spread": ((hi - lo) / lo) if lo > 0 else None,
+                "marginal": marginal})
+        return marginal
+
     for _attempt in range(attempts):
+        if _attempt:
+            t1_samples.append(timed(k1))
+        t1 = min(t1_samples)
         try:
             t2 = timed(k2)
         except FloatingPointError:
             if best is not None:
-                return best
+                return _record(best, best_pt)
             raise
         marginal = (t2 - t1) / (k2 - k1)
         if marginal > 0:
-            best = marginal
+            best, best_pt = marginal, (t1, t2, k2)
             if (k2 - k1) * marginal >= target_signal or k2 >= max_k:
-                return marginal
+                return _record(marginal, best_pt)
             k2 = min(k1 + int(numpy.ceil(target_signal / marginal)),
                      max_k)
         else:
             k2 = min(k2 * 2, max_k)   # noise swamped the gap — widen it
     if best is not None:
-        return best
+        return _record(best, best_pt)
     raise RuntimeError(
         "%s: non-positive marginal (%.6fs at k2=%d) — timing "
         "environment too noisy" % (label, marginal, k2))
@@ -225,7 +254,7 @@ def marginal_time(call, min_seconds=2.0, max_calls=10000):
 
 def measure_fused_step(step_fn, params, x, labels, k=20,
                        min_seconds=None, donate=False, repeats=3,
-                       flops_override=None):
+                       flops_override=None, stats=None):
     """Measure honest seconds per single ``step_fn`` application.
 
     ONE program loops the step with a *runtime* trip count
@@ -293,5 +322,6 @@ def measure_fused_step(step_fn, params, x, labels, k=20,
     # risk, which _two_point_marginal absorbs by falling back)
     marginal = _two_point_marginal(timed, k1, k2, target_signal=0.5,
                                    max_k=max(k2, 20 * k),
-                                   label="measure_fused_step")
+                                   label="measure_fused_step",
+                                   stats=stats)
     return marginal, flops

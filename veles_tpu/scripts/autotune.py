@@ -26,6 +26,12 @@ def main(argv=None):
                              "devices/device_infos.json)")
     parser.add_argument("--quick", action="store_true",
                         help="smaller shapes / fewer runs (smoke test)")
+    parser.add_argument("--precision-levels", default="0",
+                        help="comma list of reference precision levels "
+                             "(config.py:246-249) to sweep; levels > 0 "
+                             "race a pruned candidate set (accuracy-"
+                             "first modes only need the pallas-vs-xla "
+                             "verdict)")
     parser.add_argument("--skip-power", action="store_true")
     parser.add_argument("--skip-gemm", action="store_true")
     parser.add_argument("--skip-attention", action="store_true")
@@ -40,19 +46,40 @@ def main(argv=None):
     print("autotuning on %r → %s" % (model, db_path), file=sys.stderr)
 
     if not args.skip_gemm:
-        shapes = ((1024, 1024, 1024),) if args.quick else \
-            ((4096, 4096, 4096), (8192, 2048, 4096))
-        info = benchmark.autotune_gemm(
-            shapes=shapes, runs=1 if args.quick else 2, db_path=db_path)
+        levels = tuple(int(s) for s in
+                       args.precision_levels.split(","))
+        base = [lvl for lvl in levels if lvl == 0]
+        high = [lvl for lvl in levels if lvl != 0]
+        shapes = ((1024, 1024, 1024),) if args.quick else None
+        if base:
+            # level 0: full candidate sweep over the production shape
+            # classes (SHAPE_CLASSES) — or the quick toy shape
+            info = benchmark.autotune_gemm(
+                shapes=shapes, runs=1 if args.quick else 2,
+                db_path=db_path)
+        if high:
+            pruned = ((256, 512, 256), (512, 512, 512),
+                      (512, 1024, 256))
+            info = benchmark.autotune_gemm(
+                shapes=shapes, runs=1 if args.quick else 2,
+                db_path=db_path, candidates=pruned,
+                precision_levels=tuple(high))
         print("gemm: %s" % json.dumps(info.ratings.get("gemm", {})),
               file=sys.stderr)
+        print("gemm_v2: %s" % json.dumps(
+            info.ratings.get("gemm_v2", {})), file=sys.stderr)
 
     if not args.skip_attention:
-        shape = (2, 512, 4, 64) if args.quick else (4, 2048, 8, 128)
+        # quick: one toy shape; full: every sequence regime in
+        # ATTN_SHAPE_CLASSES (round-3's DB held a single shape)
+        shape = (2, 512, 4, 64) if args.quick else None
         info = benchmark.autotune_flash_attention(
             shape=shape, runs=1 if args.quick else 2, db_path=db_path)
         print("flash_attention: %s" % json.dumps(
             info.ratings.get("flash_attention", {})), file=sys.stderr)
+        print("flash_attention_v2: %s" % json.dumps(
+            info.ratings.get("flash_attention_v2", {})),
+            file=sys.stderr)
 
     if not args.skip_power:
         # LAST, so the chain's matmul dispatch consults the sweep's
