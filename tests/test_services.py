@@ -387,3 +387,51 @@ def test_snapshot_db_store_roundtrip(tmp_path):
     with _pytest.raises(KeyError):
         load_snapshot("db://%s.typo#latest" % db2)
     assert not _os.path.exists(db2 + ".typo")
+
+
+def test_graphics_server_multicast_degrades_gracefully():
+    """The reference binds an epgm:// multicast plot endpoint
+    (graphics_server.py:100-110); ours accepts the same spec and MUST
+    NOT take training down when libzmq lacks OpenPGM or the group is
+    bad — tcp keeps publishing."""
+    from veles_tpu.graphics_server import GraphicsServer
+
+    server = GraphicsServer(multicast="epgm://127.0.0.1;239.192.1.1:5555")
+    try:
+        assert server.endpoint.startswith("tcp://")
+        assert server.endpoints[0] == server.endpoint
+        # whether or not the bind succeeded, the server works:
+        server.send(b"blob")
+    finally:
+        server._socket.close(linger=0)
+
+
+def test_sqlite_log_duplication_with_ttl_gc(tmp_path):
+    """Every log record mirrors into SQLite and expires by TTL — the
+    reference's MongoDB duplication + TTL index (logger.py:292)."""
+    import logging
+    import time as _time
+
+    from veles_tpu.logger import duplicate_logs_to_db
+
+    db = str(tmp_path / "logs.db")
+    handler = duplicate_logs_to_db(db, session="sess-a", ttl_days=1.0)
+    try:
+        log = logging.getLogger("TTLTest")
+        log.warning("watch this space")
+        log.error("and this one")
+        rows = handler.query(session="sess-a")
+        assert len(rows) == 2
+        assert rows[0][4] == "and this one"      # newest first
+        assert handler.query(min_level=logging.ERROR,
+                             session="sess-a")[0][2] == "TTLTest"
+        # TTL expiry: purge as if 2 days passed — everything goes
+        assert handler.purge(now=_time.time() + 2 * 86400) == 2
+        assert handler.query(session="sess-a") == []
+        # a second session's rows are isolated by the session column
+        log.warning("after purge")
+        assert len(handler.query(session="sess-a")) == 1
+        assert handler.query(session="other") == []
+    finally:
+        logging.getLogger().removeHandler(handler)
+        handler.close()

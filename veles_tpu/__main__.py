@@ -67,6 +67,9 @@ class Main(Logger):
         logging.getLogger().setLevel(level)
         for name in filter(None, self.args.debug.split(",")):
             logging.getLogger(name).setLevel(logging.DEBUG)
+        if getattr(self.args, "log_db", ""):
+            from veles_tpu.logger import duplicate_logs_to_db
+            self.log_db_handler = duplicate_logs_to_db(self.args.log_db)
 
     def _seed_random(self):
         """Seed every named stream (ref ``__main__.py:483-538``)."""

@@ -69,6 +69,11 @@ def make_parser(prog="veles_tpu", description=None):
         help="comma-separated class names forced to DEBUG "
              "(ref __main__.py:833-835)")
     parser.add_argument(
+        "--log-db", default="", metavar="PATH",
+        help="duplicate every log record into a TTL-expired SQLite DB "
+             "at PATH (the reference's --log-mongo duplication, "
+             "logger.py:292, without the database dependency)")
+    parser.add_argument(
         "-r", "--random-seed", default=None,
         help="seed for the named PRNG streams (int, or path[:dtype:count] "
              "to a seed file; ref prng/random_generator.py:106)")

@@ -3,9 +3,12 @@
 Parity target: reference ``veles/graphics_server.py:65-140`` — ``Plotter``
 units pickle themselves onto a ZeroMQ PUB socket; one or more separate
 ``GraphicsClient`` processes subscribe and render with matplotlib.  The
-endpoint set (inproc/ipc/epgm multicast) shrinks to tcp+ipc here (no PGM
-in this image); the architecture — viz never blocks training, viewers
-attach/detach at will — is preserved verbatim.
+reference additionally binds an ``epgm://`` multicast endpoint
+(``graphics_server.py:100-110``) so a whole lab can watch one training
+run; :class:`GraphicsServer` accepts the same via ``multicast=`` (ZeroMQ
+``epgm://interface;group:port`` / ``pgm://``), degrading gracefully when
+libzmq lacks OpenPGM — the tcp endpoint always works and viewers
+attach/detach at will without ever blocking training.
 """
 
 import pickle
@@ -20,7 +23,7 @@ _instance = None
 class GraphicsServer(Logger):
     """Singleton PUB endpoint (one per process, like the reference)."""
 
-    def __init__(self, port=0):
+    def __init__(self, port=0, multicast=None):
         super(GraphicsServer, self).__init__()
         import zmq
         self._context = zmq.Context.instance()
@@ -31,16 +34,33 @@ class GraphicsServer(Logger):
         else:
             self.port = self._socket.bind_to_random_port("tcp://127.0.0.1")
         self.endpoint = "tcp://127.0.0.1:%d" % self.port
+        self.endpoints = [self.endpoint]
+        if multicast is None:
+            from veles_tpu.config import root
+            multicast = root.common.graphics.get("multicast", None)
+        if multicast:
+            # the reference's lab-wide broadcast (epgm multicast);
+            # PUB sockets bind any number of transports, so this rides
+            # alongside tcp — and a libzmq built without OpenPGM (or a
+            # bad group spec) must never take training down
+            try:
+                self._socket.bind(multicast)
+                self.endpoints.append(multicast)
+                self.info("plot multicast on %s", multicast)
+            except Exception as exc:
+                self.warning(
+                    "multicast endpoint %s unavailable (%s) — "
+                    "continuing tcp-only", multicast, exc)
         import threading
         self._send_lock = threading.Lock()
         self.info("graphics server on %s", self.endpoint)
 
     @staticmethod
-    def launch(port=0):
+    def launch(port=0, multicast=None):
         global _instance
         with _instance_lock:
             if _instance is None:
-                _instance = GraphicsServer(port)
+                _instance = GraphicsServer(port, multicast=multicast)
             return _instance
 
     @staticmethod

@@ -2,9 +2,13 @@
 
 Parity target: reference ``veles/logger.py`` — per-class loggers with color
 (``logger.py:59+``), an ``event()`` timeline API (``logger.py:264-280``) and
-optional duplication of all records to an external sink (the reference used
-MongoDB, ``logger.py:292``; here the sink is a pluggable callable so the
-status server / metric writer can subscribe without a database dependency).
+optional duplication of all records to an external sink.  The reference
+duplicated into MongoDB with a TTL index garbage-collecting old records
+(``logger.py:292``, ``web_status.py:158-190``); here the sink is a
+pluggable callable, and :func:`duplicate_logs_to_db` provides the
+zero-dependency equivalent — every record mirrored into SQLite with the
+same TTL-expiry semantics (purged on open and periodically), queryable
+by session/logger/level for post-mortems and the status page.
 """
 
 import logging
@@ -105,3 +109,106 @@ class Logger(object):
             except Exception:  # noqa: BLE001 - sinks must not kill the run
                 self._logger_.exception("event sink failed")
         return record
+
+
+class SQLiteLogHandler(logging.Handler):
+    """Mirror every log record into a SQLite table with TTL expiry —
+    the reference's MongoDB duplication + TTL index
+    (``veles/logger.py:292``) without the database dependency.
+
+    Thread-safe (one connection guarded by the handler lock; SQLite
+    serializes writers anyway).  Old rows are purged on open and then
+    opportunistically every ``gc_every`` inserts, mirroring the TTL
+    index's background expiry.
+    """
+
+    def __init__(self, path, session=None, ttl_days=7.0, gc_every=500):
+        super(SQLiteLogHandler, self).__init__()
+        import os
+        import sqlite3
+        import uuid
+        self.path = path
+        self.session = session or uuid.uuid4().hex
+        self.ttl_seconds = float(ttl_days) * 86400.0
+        self.gc_every = int(gc_every)
+        self._since_gc = 0
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        # WAL + NORMAL: one fsync per checkpoint instead of per log
+        # record — the handler sits on the root-logger hot path
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS logs ("
+            " ts REAL, session TEXT, logger TEXT, level INTEGER,"
+            " message TEXT)")
+        self._conn.execute(
+            "CREATE INDEX IF NOT EXISTS logs_ts ON logs (ts)")
+        self._conn.execute(
+            "CREATE INDEX IF NOT EXISTS logs_session ON logs (session)")
+        self.purge()
+
+    def purge(self, now=None):
+        """Delete rows older than the TTL (the MongoDB TTL-index
+        equivalent); returns the number of expired rows."""
+        cutoff = (now if now is not None else time.time()) \
+            - self.ttl_seconds
+        with self._conn:
+            cur = self._conn.execute("DELETE FROM logs WHERE ts < ?",
+                                     (cutoff,))
+        return cur.rowcount
+
+    def emit(self, record):
+        try:
+            with self._conn:
+                self._conn.execute(
+                    "INSERT INTO logs VALUES (?, ?, ?, ?, ?)",
+                    (record.created, self.session, record.name,
+                     record.levelno, self.format(record)))
+            self._since_gc += 1
+            if self._since_gc >= self.gc_every:
+                self._since_gc = 0
+                self.purge()
+        except Exception:
+            self.handleError(record)
+
+    def query(self, session=None, logger=None, min_level=None,
+              limit=200):
+        """Recent records (newest first) for the status page /
+        post-mortem CLI — the reference's web-status log view."""
+        sql = "SELECT ts, session, logger, level, message FROM logs"
+        clauses, args = [], []
+        if session:
+            clauses.append("session = ?")
+            args.append(session)
+        if logger:
+            clauses.append("logger = ?")
+            args.append(logger)
+        if min_level is not None:
+            clauses.append("level >= ?")
+            args.append(int(min_level))
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        sql += " ORDER BY ts DESC LIMIT ?"
+        args.append(int(limit))
+        return list(self._conn.execute(sql, args))
+
+    def close(self):
+        try:
+            self._conn.close()
+        finally:
+            super(SQLiteLogHandler, self).close()
+
+
+def duplicate_logs_to_db(path, session=None, ttl_days=7.0,
+                         level=logging.DEBUG):
+    """Attach a :class:`SQLiteLogHandler` to the root logger (the
+    reference's ``--log-mongo addr`` duplication, ``logger.py:292``).
+    Returns the handler; call ``.close()`` (or keep it for
+    ``.query()``) when done."""
+    handler = SQLiteLogHandler(path, session=session, ttl_days=ttl_days)
+    handler.setLevel(level)
+    handler.setFormatter(logging.Formatter("%(message)s"))
+    logging.getLogger().addHandler(handler)
+    return handler

@@ -189,12 +189,15 @@ def _two_point_marginal(timed, k1, k2, target_signal, max_k,
         return marginal
 
     for _attempt in range(attempts):
-        if _attempt:
-            t1_samples.append(timed(k1))
-        t1 = min(t1_samples)
         try:
+            if _attempt:
+                # paranoid short point: re-time on retry, min wins
+                t1_samples.append(timed(k1))
+            t1 = min(t1_samples)
             t2 = timed(k2)
         except FloatingPointError:
+            # weights gone non-finite at a longer horizon (either
+            # point): the last positive marginal is still valid
             if best is not None:
                 return _record(best, best_pt)
             raise
