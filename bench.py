@@ -179,6 +179,34 @@ def stage_mnist():
     _emit("MNIST784 MLP fused train throughput", sec, batch, flops)
 
 
+def stage_mnist_bf16():
+    """bf16 compute (fp32 master weights): halves the HBM bytes of a
+    step the thin 784→100→10 matmul chain is bound by — the TPU-native
+    mixed-precision mode vs stage_mnist's f32 (the reference-comparable
+    line)."""
+    import numpy
+
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu import prng
+    from veles_tpu.znicz.fused import init_mlp_params, make_train_step
+    from __graft_entry__ import MNIST_LAYERS
+
+    prng.seed_all(1234)
+    batch = 8192
+    params = init_mlp_params(784, MNIST_LAYERS)
+    rng = numpy.random.default_rng(0)
+    x = jax.device_put(
+        rng.standard_normal((batch, 784)).astype(numpy.float32))
+    labels = jax.device_put(
+        rng.integers(0, 10, batch).astype(numpy.int32))
+    sec, flops = _measure(
+        make_train_step(MNIST_LAYERS, compute_dtype=jnp.bfloat16),
+        params, x, labels, steps=100)
+    _emit("MNIST784 MLP fused train throughput (bf16)", sec, batch,
+          flops)
+
+
 def _conv_stage(metric, layers, input_shape, n_classes, batch, steps,
                 vs=None, compute_dtype="bfloat16"):
     import numpy
@@ -438,6 +466,7 @@ STAGES = {
     # termination is graceful (SIGTERM + grace before SIGKILL)
     "probe": (stage_probe, 240),
     "mnist": (stage_mnist, 150),
+    "mnist_bf16": (stage_mnist_bf16, 150),
     "mnist_e2e": (stage_mnist_e2e, 240),
     "mnist_wf": (stage_mnist_wf, 240),
     "cifar": (stage_cifar, 210),
@@ -556,7 +585,8 @@ def main():
     # earlier stages must never squeeze it out of the budget, so while
     # it is still pending each optional stage only runs (and is only
     # allowed to hang) inside remaining() minus a headline reserve.
-    order = ("mnist", "mnist_e2e", "mnist_wf", "cifar", "ae",
+    order = ("mnist", "mnist_bf16", "mnist_e2e", "mnist_wf", "cifar",
+             "ae",
              "kohonen", "lstm", "transformer", "alexnet")
     if env and not only:
         # CPU fallback (rehearsed with a wedged tunnel): the conv/LM
