@@ -1,0 +1,102 @@
+"""Rollback parity (ref algorithms doc capability #11): best-state
+capture on improvement, restore + lr scaling on plateau — eager and
+fused."""
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+
+
+def _drive_epoch_close(wf, epoch, improved):
+    """Simulate the Decision's view of one epoch close."""
+    wf.loader.epoch_ended <<= True
+    wf.loader.epoch_number = epoch
+    wf.decision.best_epoch = epoch if improved else epoch - 1
+
+
+def test_eager_rollback_restores_weights_and_scales_lr():
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist
+
+    prng.seed_all(6)
+    wf = mnist.create_workflow(
+        device=CPUDevice(), max_epochs=1, minibatch_size=1000,
+        rollback_config={"fail_iterations": 2, "lr_factor": 0.5})
+    rb = wf.rollback
+    assert rb is not None and rb.trainer is None
+    lr0 = float(wf.gds[0].learning_rate)
+
+    # a new best is captured the moment it is DECLARED (validation
+    # close — before any further train pass mutates the weights),
+    # not at epoch end
+    wf.loader.epoch_ended <<= False
+    wf.loader.epoch_number = 0
+    wf.decision.best_epoch = 0
+    rb.run()                                  # captures the best state
+    wf.forwards[0].weights.map_read()
+    best_w = numpy.array(wf.forwards[0].weights.mem)
+    # the weights keep training AFTER the capture (same epoch): the
+    # snapshot must not follow them
+    wf.forwards[0].weights.map_write()
+    wf.forwards[0].weights.mem[...] += 5.0
+    _drive_epoch_close(wf, 0, improved=True)
+    rb.run()                                  # same best: no recapture
+
+    # training drifts away, then plateaus for 2 epochs
+    wf.forwards[0].weights.map_write()
+    wf.forwards[0].weights.mem[...] += 123.0
+    _drive_epoch_close(wf, 1, improved=False)
+    rb.run()
+    assert rb.rollbacks == 0                  # one bad epoch: no action
+    _drive_epoch_close(wf, 2, improved=False)
+    rb.run()
+    assert rb.rollbacks == 1
+    wf.forwards[0].weights.map_read()
+    numpy.testing.assert_array_equal(
+        numpy.array(wf.forwards[0].weights.mem), best_w)
+    assert float(wf.gds[0].learning_rate) == pytest.approx(lr0 * 0.5)
+
+    # a non-epoch-close run is a no-op
+    wf.loader.epoch_ended <<= False
+    rb.run()
+    assert rb.rollbacks == 1
+
+
+def test_fused_rollback_restores_solver_state_and_scales_lr():
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist
+
+    prng.seed_all(7)
+    wf = mnist.create_workflow(
+        device=CPUDevice(), max_epochs=1, minibatch_size=1000,
+        fused=True,
+        rollback_config={"fail_iterations": 1, "lr_factor": 0.5})
+    rb = wf.rollback
+    tr = wf.fused_trainer
+    assert rb.trainer is tr
+    tr._build()
+    lr0 = float(tr.layers[0]["<-"]["learning_rate"])
+    _drive_epoch_close(wf, 0, improved=True)
+    rb.run()                                  # fused capture
+    best = rb._best
+    assert best[0] == "fused"
+    best_w = numpy.array(best[1][0]["w"])
+
+    # drift the device state, then plateau
+    import jax
+    tr._params_ = jax.tree_util.tree_map(lambda a: a + 1.0,
+                                         tr._params_)
+    _drive_epoch_close(wf, 1, improved=False)
+    rb.run()
+    assert rb.rollbacks == 1
+    assert tr._step_ is None                  # rebuild pending
+    assert float(tr.layers[0]["<-"]["learning_rate"]) == \
+        pytest.approx(lr0 * 0.5)
+    tr._build()                               # restores the tree
+    numpy.testing.assert_array_equal(
+        numpy.asarray(tr._params_[0]["w"]), best_w)
+    # momentum velocities restored too (same tree)
+    numpy.testing.assert_array_equal(
+        numpy.asarray(tr._params_[0]["vw"]),
+        numpy.array(best[1][0]["vw"]))

@@ -435,3 +435,101 @@ def test_sqlite_log_duplication_with_ttl_gc(tmp_path):
     finally:
         logging.getLogger().removeHandler(handler)
         handler.close()
+
+
+def test_weights2d_grid_dense_and_conv():
+    """Weights2D (ref nn_plotting_units, knob: limit): dense columns
+    become square tiles, conv kernels become per-kernel tiles, packed
+    into a separator grid."""
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.plotting_units import Weights2D
+
+    wf = DummyWorkflow()
+    rng = numpy.random.default_rng(0)
+    p = Weights2D(wf, name="w", limit=6)
+    p.input = rng.standard_normal((16, 10)).astype(numpy.float32)
+    p.fill()
+    # 6 tiles of 4x4 in a 3x2 grid with 1-px separators
+    assert p.grid.shape == (2 * 5 - 1, 3 * 5 - 1)
+    assert p.grid.min() >= 0.0 and p.grid.max() <= 1.0
+
+    p_rgb = Weights2D(wf, name="wc", limit=4)
+    p_rgb.input = rng.standard_normal((5, 5, 3, 9)).astype(
+        numpy.float32)
+    p_rgb.fill()
+    assert p_rgb.grid.shape == (2 * 6 - 1, 2 * 6 - 1, 3)
+    # viewer round trip: redraw onto a real axes
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    fig, axes = plt.subplots()
+    p.redraw(axes)
+    p_rgb.redraw(axes)
+    plt.close(fig)
+
+
+def test_image_saver_writes_misclassified(tmp_path):
+    """ImageSaver (ref znicz.image_saver, knob: out_dirs): wrong
+    samples land as PNGs named epoch_truth_pred_<counter> in the
+    minibatch class's directory; a new epoch's first write resets the
+    gallery; names stay unique across minibatches."""
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.znicz.image_saver import ImageSaver
+
+    wf = DummyWorkflow()
+    dirs = [str(tmp_path / d) for d in ("test", "valid", "train")]
+    s = ImageSaver(wf, out_dirs=dirs, limit=10)
+    rng = numpy.random.default_rng(1)
+    s.input = rng.standard_normal((4, 784)).astype(numpy.float32)
+    s.labels = numpy.array([1, 2, 3, 4])
+    s.max_idx = numpy.array([1, 9, 3, 9])       # samples 1, 3 wrong
+    s.minibatch_class = 2                        # TRAIN
+    s.minibatch_size = 4
+    s.epoch_number = 7
+    s.run()
+    import os
+    names = sorted(os.listdir(dirs[2]))
+    assert names == ["7_2_9_00000.png", "7_4_9_00001.png"]
+    from PIL import Image
+    assert Image.open(os.path.join(dirs[2], names[0])).size == (28, 28)
+    # a SECOND minibatch of the same epoch with the same wrong slots
+    # must not overwrite — the per-gallery counter uniquifies
+    s.run()
+    assert len(os.listdir(dirs[2])) == 4
+
+    # next epoch: the gallery resets on its first write
+    s.epoch_number = 8
+    s.max_idx = numpy.array([1, 2, 3, 0])        # only sample 3 wrong
+    s.run()
+    assert sorted(os.listdir(dirs[2])) == ["8_4_0_00000.png"]
+    # out-of-range class index: silently no-op
+    s.minibatch_class = 5
+    s.run()
+
+
+def test_standard_workflow_image_saver_and_weights_plotter(tmp_path):
+    """End-to-end: StandardWorkflow wires the ImageSaver (after the
+    Decision) and the Weights2D plotter from their documented config
+    knobs; a real 2-epoch run produces mistake PNGs and a filled
+    weight grid."""
+    import os
+
+    from veles_tpu import prng
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.plotting_units import Weights2D
+    from veles_tpu.samples import mnist
+
+    prng.seed_all(8)
+    dirs = [str(tmp_path / d) for d in ("test", "valid", "train")]
+    wf = mnist.create_workflow(
+        device=CPUDevice(), max_epochs=2, minibatch_size=1000,
+        plotters_config={"weights": {"limit": 9}},
+        image_saver_config={"out_dirs": dirs, "limit": 5})
+    wf.run()
+    assert wf.image_saver is not None
+    # synthetic data + 1 training epoch: plenty of mistakes captured
+    assert len(os.listdir(dirs[1])) > 0       # validation mistakes
+    w2d = [p for p in wf.plotters if isinstance(p, Weights2D)]
+    assert len(w2d) == 1 and w2d[0].grid is not None
+    # 9 tiles of 28x28 -> 3x3 grid with separators
+    assert w2d[0].grid.shape == (3 * 29 - 1, 3 * 29 - 1)

@@ -151,6 +151,76 @@ class ImagePlotter(Plotter):
         axes.set_title(self.name)
 
 
+class Weights2D(Plotter):
+    """Weight matrices rendered as a tiled image grid — the
+    reference's ``veles.znicz.nn_plotting_units.Weights2D`` with its
+    documented ``limit`` knob
+    (``manualrst_veles_workflow_parameters.rst:688-700``).
+
+    ``input``: a weights Vector (or anything with ``.mem``).  Dense
+    weights lead with fan-in (``(in, out)``): each column becomes one
+    tile, reshaped square when the fan-in is a perfect square (e.g.
+    784 → 28×28).  Conv kernels (``(kh, kw, in, out)``): one tile per
+    output kernel, RGB when in==3, channel-mean otherwise.  Tiles are
+    min-max normalized individually and packed into a near-square grid
+    with 1-px separators.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(Weights2D, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.limit = int(kwargs.get("limit", 64))
+        self.grid = None
+        self.demand("input")
+
+    @staticmethod
+    def _tiles(w, limit):
+        if w.ndim == 4:                    # conv HWIO → per-kernel
+            t = numpy.transpose(w, (3, 0, 1, 2))[:limit]
+            if t.shape[-1] != 3:
+                t = t.mean(axis=-1)
+        else:
+            w2 = w.reshape(w.shape[0], -1) if w.ndim > 2 else w
+            t = w2.T[:limit]               # columns = neurons
+            side = int(numpy.sqrt(t.shape[1]))
+            if side * side == t.shape[1]:
+                t = t.reshape(-1, side, side)
+            else:
+                t = t.reshape(t.shape[0], 1, -1)
+        return t
+
+    def fill(self):
+        mem = getattr(self.input, "mem", self.input)
+        if mem is None:
+            return
+        tiles = self._tiles(numpy.array(mem, numpy.float32),
+                            self.limit)
+        lo = tiles.reshape(tiles.shape[0], -1).min(axis=1)
+        hi = tiles.reshape(tiles.shape[0], -1).max(axis=1)
+        span = numpy.maximum(hi - lo, 1e-12)
+        shape = (tiles.shape[0],) + (1,) * (tiles.ndim - 1)
+        tiles = (tiles - lo.reshape(shape)) / span.reshape(shape)
+        n = tiles.shape[0]
+        cols = int(numpy.ceil(numpy.sqrt(n)))
+        rows = int(numpy.ceil(n / cols))
+        th, tw = tiles.shape[1], tiles.shape[2]
+        extra = tiles.shape[3:]            # (3,) for RGB tiles
+        grid = numpy.ones((rows * (th + 1) - 1, cols * (tw + 1) - 1)
+                          + extra, numpy.float32)
+        for i in range(n):
+            r, c = divmod(i, cols)
+            grid[r * (th + 1):r * (th + 1) + th,
+                 c * (tw + 1):c * (tw + 1) + tw] = tiles[i]
+        self.grid = grid
+
+    def redraw(self, axes):
+        if self.grid is None:
+            return
+        axes.imshow(self.grid, interpolation="nearest",
+                    cmap=None if self.grid.ndim == 3 else "gray")
+        axes.set_title(self.name)
+
+
 class Histogram(Plotter):
     """Value-distribution histogram (ref ``plotting_units.py``)."""
 

@@ -32,7 +32,8 @@ def _remat_stage(pure, config):
 
 
 def lower_specs(layer_specs, sample_shape, loss="softmax",
-                compute_dtype=None, remat=False, grad_accum=1):
+                compute_dtype=None, remat=False, grad_accum=1,
+                lr_adjuster=None):
     """Build (params, step_fn, eval_fn, apply_fn) from layer specs.
 
     ``sample_shape``: one sample's shape (no batch dim).
@@ -62,8 +63,29 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
     batch into N microbatches scanned inside the step (activation HBM ∝
     batch/N), average their gradients, apply ONE update.  Combine with
     ``remat`` for the deepest memory cuts.
+
+    ``lr_adjuster``: the reference's LRAdjuster
+    (``manualrst_veles_workflow_parameters.rst:655-685``), evaluated
+    INSIDE the jitted step: ``{"lr_policy_name": "exp" | "fixed" |
+    "step_exp" | "inv" | "arbitrary_step", "lr_parameters": {...},
+    "bias_lr_policy_name": ..., "bias_lr_parameters": ...}``.  An int32
+    ``tick`` carried in each layer's state drives the schedule, so the
+    learning rate changes every step with NO retrace (bias policy
+    defaults to the weights policy).
     """
     grad_accum = max(int(grad_accum), 1)
+    w_policy = b_policy = None
+    if lr_adjuster:
+        from veles_tpu.znicz.lr_adjust import make_policy
+        w_policy = make_policy(lr_adjuster.get("lr_policy_name",
+                                               "fixed"),
+                               lr_adjuster.get("lr_parameters"))
+        b_policy = make_policy(
+            lr_adjuster.get("bias_lr_policy_name",
+                            lr_adjuster.get("lr_policy_name",
+                                            "fixed")),
+            lr_adjuster.get("bias_lr_parameters",
+                            lr_adjuster.get("lr_parameters")))
     from veles_tpu.dummy import DummyWorkflow
     from veles_tpu.units import UnitRegistry
     from veles_tpu.znicz import (  # noqa: F401 - populate the registry
@@ -158,6 +180,11 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
             state["sw"], state["sb"] = _slot("w"), _slot("b")
         if solver == "adam":
             state["t"] = numpy.int32(0)   # bias-correction counter
+        if w_policy is not None and (state.get("w") is not None
+                                     or state.get("b") is not None):
+            # lr-schedule step counter (only when a schedule is
+            # configured: keeps existing snapshots' tree structure)
+            state["tick"] = numpy.int32(0)
         if "seed" in state:
             # fresh per-stage stream; step_fn then advances it every
             # step so fused dropout/stochastic-pooling masks differ
@@ -284,19 +311,25 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                 if key not in gwb or state.get(key) is None:
                     continue
                 grad = gwb[key]
+                lr_eff = hyper[lr_k]
+                if "tick" in state:
+                    # the LRAdjuster schedule, traced on the in-state
+                    # step counter — lr changes per step, no retrace
+                    pol = w_policy if key == "w" else b_policy
+                    lr_eff = lr_eff * pol(state["tick"], xp=jnp)
                 l1 = hyper["l1"] if key == "w" else hyper["l1_b"]
                 if key == "w" and hyper["factor_ortho"]:
                     grad = grad + ortho_grad(state[key],
                                              hyper["factor_ortho"])
                 if hyper["solver"] == "momentum":
-                    v = hyper[mom_k] * state[vkey] - hyper[lr_k] * (
+                    v = hyper[mom_k] * state[vkey] - lr_eff * (
                         grad + reg_term(state[key], hyper[dec_k], l1))
                     new_state[key] = state[key] + v
                     new_state[vkey] = v
                 elif hyper["solver"] == "adagrad":
                     g = grad + reg_term(state[key], hyper[dec_k], l1)
                     s2 = state[skey] + g * g
-                    new_state[key] = state[key] - hyper[lr_k] * g / (
+                    new_state[key] = state[key] - lr_eff * g / (
                         jnp.sqrt(s2) + hyper["adagrad_eps"])
                     new_state[skey] = s2
                 elif hyper["solver"] == "adadelta":
@@ -308,7 +341,7 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                         / jnp.sqrt(s2 + eps) * g
                     # vw accumulates E[Δ²]; conventionally run with
                     # learning_rate=1.0 (the lr is a plain scale here)
-                    new_state[key] = state[key] + hyper[lr_k] * upd
+                    new_state[key] = state[key] + lr_eff * upd
                     new_state[vkey] = rho * state[vkey] \
                         + (1.0 - rho) * upd * upd
                     new_state[skey] = s2
@@ -322,7 +355,7 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                     s_hat = s2 / (1.0 - hyper["beta2"] ** t)
                     step = m_hat / (jnp.sqrt(s_hat) + hyper["eps"])
                     # decoupled (AdamW-style) weight decay, l1/l2 mix
-                    new_state[key] = state[key] - hyper[lr_k] * (
+                    new_state[key] = state[key] - lr_eff * (
                         step + reg_term(state[key], hyper[dec_k], l1))
                     new_state[vkey], new_state[skey] = m, s2
                 else:                           # iRprop−
@@ -335,6 +368,8 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                 # advance the stage's mask stream (int32, wrap-safe)
                 new_state["seed"] = jnp.int32(
                     (state["seed"] + 1) & 0x3fffffff)
+            if "tick" in state:
+                new_state["tick"] = state["tick"] + jnp.int32(1)
             new_list.append(new_state)
         return new_list, {"loss": report, "n_err": n_err}
 

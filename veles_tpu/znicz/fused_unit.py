@@ -35,11 +35,21 @@ class FusedTrainer(AcceleratedUnit):
     def __init__(self, workflow, **kwargs):
         super(FusedTrainer, self).__init__(workflow, **kwargs)
         self.view_group = "TRAINER"
-        self.layers = kwargs["layers"]
+        # copy each spec AND its nested "->"/"<-" dicts: rollback_to
+        # rescales learning rates in place, and the usual shallow
+        # [{**s}] copies share the nested dicts all the way up to
+        # module-level sample LAYERS (init arrays stay shared — they
+        # can be large and are never mutated here)
+        self.layers = [
+            {**s, **{k: dict(s[k]) for k in ("->", "<-") if k in s}}
+            for s in kwargs["layers"]]
         self.loss = kwargs.get("loss", "softmax")
         self.compute_dtype = kwargs.get("compute_dtype")
         self.grad_accum = int(kwargs.get("grad_accum", 1))
         self.remat = bool(kwargs.get("remat", False))
+        #: the reference's LRAdjuster config (policy names + params),
+        #: evaluated inside the jitted step — see lower_specs
+        self.lr_adjuster = kwargs.get("lr_adjuster")
         #: {"data": -1} etc. — train over a device mesh: batch sharded
         #: on "data", gradients all-reduced inside the step (the
         #: BASELINE north-star AlexNet-DP path, via the workflow).
@@ -96,7 +106,7 @@ class FusedTrainer(AcceleratedUnit):
         params, step_fn, eval_fn, _apply = lower_specs(
             specs, sample_shape, loss=self.loss,
             compute_dtype=self.compute_dtype, remat=self.remat,
-            grad_accum=self.grad_accum)
+            grad_accum=self.grad_accum, lr_adjuster=self.lr_adjuster)
         params = self._restore_solver_state(params)
         self._train_divisor_ = max(self.grad_accum, 1)
         if self.mesh_axes:
@@ -228,6 +238,33 @@ class FusedTrainer(AcceleratedUnit):
             # epoch boundary: the unit graph (snapshotter, export,
             # eager eval) sees the trained weights
             self.sync_weights()
+
+    def capture_state(self):
+        """Host copy of the full solver-state tree (weights, momenta,
+        Adam moments/t, rprop deltas, schedule ticks) — what
+        :class:`veles_tpu.znicz.rollback.Rollback` snapshots on every
+        improved epoch.  None before the first build."""
+        if self._params_ is None:
+            return None
+        import jax
+        return jax.tree_util.tree_map(numpy.asarray, self._params_)
+
+    def rollback_to(self, snap, lr_factor=1.0):
+        """Restore a :meth:`capture_state` tree and scale every
+        layer's learning rate; the jitted step rebuilds lazily (one
+        recompile per rollback event)."""
+        if lr_factor != 1.0:
+            for spec in self.layers:
+                bw = spec.setdefault("<-", {})
+                default = 1.0 if str(bw.get("solver", "")) \
+                    == "adadelta" else 0.01
+                bw["learning_rate"] = float(
+                    bw.get("learning_rate", default)) * lr_factor
+                if "learning_rate_bias" in bw:
+                    bw["learning_rate_bias"] = float(
+                        bw["learning_rate_bias"]) * lr_factor
+        self.solver_state = snap
+        self._step_ = None            # _build() restores the tree
 
     def sync_weights(self):
         """Write the fused params back into the forward units."""

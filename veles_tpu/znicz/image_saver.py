@@ -1,0 +1,93 @@
+"""ImageSaver: dump misclassified samples to disk for inspection.
+
+Parity target: ``veles.znicz.image_saver.ImageSaver`` with its
+documented ``out_dirs`` knob — one directory per sample class
+``[test, validation, train]``
+(``manualrst_veles_workflow_parameters.rst:688-700``).  Each minibatch,
+the samples the evaluator got wrong are written as PNGs named
+``<epoch>_<truth>_<predicted>_<n>.png`` into the minibatch class's
+directory; a directory is wiped when a new epoch first writes to it,
+so each gallery always holds the LATEST epoch's mistakes (stale
+mistakes never accumulate across epochs).
+"""
+
+import os
+
+import numpy
+
+from veles_tpu.units import Unit
+
+
+class ImageSaver(Unit):
+    """See module docstring.  Linked after the evaluator; demands
+    ``input`` (minibatch data Vector), ``labels``, ``max_idx`` (the
+    evaluator's argmax), and the loader counters."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.out_dirs = list(kwargs.pop("out_dirs", []))
+        self.limit = int(kwargs.pop("limit", 100))    # per dir/epoch
+        super(ImageSaver, self).__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.input = None
+        self.labels = None
+        self.max_idx = None
+        self.minibatch_class = None
+        self.minibatch_size = None
+        self.epoch_number = 0
+        self._saved = {}              # dir index → count this epoch
+        self._epoch_seen = {}         # dir index → epoch of its gallery
+        self.demand("input", "labels", "max_idx")
+
+    def _to_image(self, arr):
+        arr = numpy.asarray(arr, numpy.float32)
+        if arr.ndim == 1:
+            side = int(numpy.sqrt(arr.size))
+            arr = arr.reshape(side, side) if side * side == arr.size \
+                else arr.reshape(1, -1)
+        if arr.ndim == 3 and arr.shape[-1] == 1:
+            arr = arr[..., 0]
+        lo, hi = float(arr.min()), float(arr.max())
+        scaled = (arr - lo) / max(hi - lo, 1e-12) * 255.0
+        return scaled.astype(numpy.uint8)
+
+    def run(self):
+        cls = int(self.minibatch_class)
+        if cls >= len(self.out_dirs) or not self.out_dirs[cls]:
+            return
+        out_dir = self.out_dirs[cls]
+        epoch = int(self.epoch_number)
+        if self._epoch_seen.get(cls) != epoch:
+            # this gallery's first minibatch of a new epoch: wipe it so
+            # it holds only the latest epoch's mistakes (wiping on the
+            # latched Decision.improved flag would re-wipe every
+            # minibatch while the flag stays up)
+            self._epoch_seen[cls] = epoch
+            self._saved[cls] = 0
+            if os.path.isdir(out_dir):
+                for name in os.listdir(out_dir):
+                    if name.endswith(".png"):
+                        os.unlink(os.path.join(out_dir, name))
+        n = int(self.minibatch_size)
+        labels = numpy.asarray(getattr(self.labels, "mem",
+                                       self.labels))[:n]
+        preds = numpy.asarray(getattr(self.max_idx, "mem",
+                                      self.max_idx))[:n]
+        data = getattr(self.input, "mem", self.input)
+        wrong = numpy.nonzero(labels != preds)[0]
+        if not len(wrong):
+            return
+        os.makedirs(out_dir, exist_ok=True)
+        from PIL import Image
+        for idx in wrong:
+            count = self._saved.get(cls, 0)
+            if count >= self.limit:
+                return
+            img = self._to_image(data[idx])
+            # the trailing per-gallery counter keeps names unique
+            # across minibatches (a batch-local index would collide)
+            name = "%d_%d_%d_%05d.png" % (epoch, int(labels[idx]),
+                                          int(preds[idx]), count)
+            Image.fromarray(img).save(os.path.join(out_dir, name))
+            self._saved[cls] = count + 1

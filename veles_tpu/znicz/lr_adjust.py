@@ -1,0 +1,148 @@
+"""Learning-rate schedules: the reference's LRAdjuster.
+
+Parity target: ``veles.znicz.lr_adjust.LearningRateAdjust`` and its
+five documented policies (``manualrst_veles_workflow_parameters.rst:
+655-685``): ``exp``, ``fixed``, ``step_exp``, ``inv``,
+``arbitrary_step`` — configured separately for weights and bias, with
+``arbitrary_step`` taking ``lrs_with_lengths`` pairs of (multiplier,
+duration-in-minibatches).
+
+TPU re-design: each policy is a pure ``factor(t)`` callable (the
+multiplier applied to the configured base learning rate after ``t``
+train steps) that works BOTH on host ints (the eager
+:class:`LearningRateAdjust` unit mutating its gradient units'
+``learning_rate`` per minibatch, like the reference) and on traced
+``jnp`` scalars — so ``fused_graph.lower_specs(lr_adjuster=...)``
+evaluates the schedule INSIDE the one jitted train step from an int32
+tick carried in the layer state, changing the lr every step with no
+retrace.
+"""
+
+import numpy
+
+from veles_tpu.units import Unit
+
+
+class _Policy(object):
+    def __call__(self, t, xp=numpy):
+        raise NotImplementedError
+
+
+class FixedAdjustPolicy(_Policy):
+    """factor = 1 (the explicit no-op, ref ``FixedAjustPolicy``)."""
+
+    def __call__(self, t, xp=numpy):
+        return 1.0 + 0.0 * t        # keeps the traced dtype consistent
+
+
+class ExpPolicy(_Policy):
+    """factor = gamma^t."""
+
+    def __init__(self, gamma=0.9999):
+        self.gamma = float(gamma)
+
+    def __call__(self, t, xp=numpy):
+        return xp.power(self.gamma, t)
+
+
+class StepExpPolicy(_Policy):
+    """factor = gamma^(t // step): staircase exponential decay."""
+
+    def __init__(self, gamma=0.1, step=1000):
+        self.gamma = float(gamma)
+        self.step = int(step)
+
+    def __call__(self, t, xp=numpy):
+        return xp.power(self.gamma, t // self.step)
+
+
+class InvAdjustPolicy(_Policy):
+    """factor = (1 + gamma·t)^(-power) (Caffe's classic ``inv``)."""
+
+    def __init__(self, gamma=0.0001, power=0.75):
+        self.gamma = float(gamma)
+        self.power = float(power)
+
+    def __call__(self, t, xp=numpy):
+        return xp.power(1.0 + self.gamma * t, -self.power)
+
+
+class ArbitraryStepPolicy(_Policy):
+    """Piecewise-constant multipliers: ``lrs_with_lengths`` =
+    [(factor, n_steps), ...]; the last factor holds forever (the
+    reference examples end with a huge length for the same effect)."""
+
+    def __init__(self, lrs_with_lengths=((1.0, 1),)):
+        pairs = [(float(f), int(n)) for f, n in lrs_with_lengths]
+        if not pairs:
+            raise ValueError("lrs_with_lengths must be non-empty")
+        self.factors = numpy.array([f for f, _n in pairs],
+                                   numpy.float32)
+        self.bounds = numpy.cumsum([n for _f, n in pairs]).astype(
+            numpy.int64)
+
+    def __call__(self, t, xp=numpy):
+        factors = xp.asarray(self.factors)
+        bounds = xp.asarray(self.bounds)
+        idx = xp.minimum(xp.searchsorted(bounds, t, side="right"),
+                         len(self.factors) - 1)
+        return xp.take(factors, idx)
+
+
+POLICIES = {
+    "fixed": FixedAdjustPolicy,
+    "exp": ExpPolicy,
+    "step_exp": StepExpPolicy,
+    "inv": InvAdjustPolicy,
+    "arbitrary_step": ArbitraryStepPolicy,
+}
+
+
+def make_policy(name, params=None):
+    """Instantiate a policy by its documented name."""
+    try:
+        klass = POLICIES[name]
+    except KeyError:
+        raise ValueError("unknown lr policy %r (want one of %s)" % (
+            name, ", ".join(sorted(POLICIES))))
+    return klass(**dict(params or {}))
+
+
+class LearningRateAdjust(Unit):
+    """Eager-mode LRAdjuster: linked after the gradient chain, it
+    rescales every GD unit's ``learning_rate`` (and
+    ``learning_rate_bias``) each TRAIN minibatch per the configured
+    policies — the reference unit's exact role.  (Fused mode computes
+    the same schedules inside the jitted step; see
+    ``fused_graph.lower_specs(lr_adjuster=...)``.)"""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        name = kwargs.pop("lr_policy_name", "fixed")
+        params = kwargs.pop("lr_parameters", None)
+        self.lr_policy = make_policy(name, params)
+        # bias policy defaults to the WEIGHTS policy — the same
+        # contract as the fused path (lower_specs), so one config
+        # trains identically in both modes
+        self.bias_lr_policy = make_policy(
+            kwargs.pop("bias_lr_policy_name", name),
+            kwargs.pop("bias_lr_parameters", params))
+        super(LearningRateAdjust, self).__init__(workflow, **kwargs)
+        self.gds = []
+        self.t = 0
+        self._base = None          # [(lr, lr_bias)] captured on first run
+
+    def run(self):
+        if not self.gds:
+            return
+        if self._base is None:
+            self._base = [(float(gd.learning_rate),
+                           float(gd.learning_rate_bias))
+                          for gd in self.gds]
+        fw = float(self.lr_policy(self.t))
+        fb = float(self.bias_lr_policy(self.t))
+        for gd, (lr, lr_b) in zip(self.gds, self._base):
+            gd.learning_rate = lr * fw
+            gd.learning_rate_bias = lr_b * fb
+        self.t += 1

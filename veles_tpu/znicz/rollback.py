@@ -1,0 +1,121 @@
+"""Rollback: return to the best state when training plateaus.
+
+Parity target: the reference's documented capability #11
+(``manualrst_veles_algorithms.rst:164-166``): "It saves the best state
+and returns to it (if some iterations was not successfull) and changes
+learning rate".
+
+The unit watches the Decision at every epoch close: an improved
+validation result captures a host-side snapshot of the model state; a
+plateau of ``fail_iterations`` epochs restores that snapshot and
+multiplies every learning rate by ``lr_factor`` — in BOTH execution
+modes:
+
+- eager: the forward units' weight/bias Vectors are copied/restored
+  and the gradient units' ``learning_rate``(+bias) rescaled (the
+  LRAdjuster's captured base rates rescale too, so a schedule keeps
+  working after a rollback);
+- fused: the FusedTrainer's full solver-state tree (weights, momenta,
+  Adam moments, rprop deltas, schedule ticks) is captured via
+  :meth:`~veles_tpu.znicz.fused_unit.FusedTrainer.capture_state` and
+  restored with
+  :meth:`~veles_tpu.znicz.fused_unit.FusedTrainer.rollback_to`, which
+  rescales the baked-in learning rates and rebuilds the jitted step
+  (one recompile per rollback event — rare by construction).
+"""
+
+import numpy
+
+from veles_tpu.units import Unit
+
+
+class Rollback(Unit):
+    """Best-state keeper + plateau restorer (see module docstring)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.fail_iterations = int(kwargs.pop("fail_iterations", 5))
+        self.lr_factor = float(kwargs.pop("lr_factor", 0.5))
+        super(Rollback, self).__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.decision = None
+        self.forwards = []
+        self.gds = []
+        self.trainer = None           # fused mode
+        self.lr_adjuster = None
+        self.rollbacks = 0            # observability: times triggered
+        self._best = None
+        self._fails = 0
+        self._captured_epoch = -1
+        self.demand("decision")
+
+    def run(self):
+        d = self.decision
+        best = int(getattr(d, "best_epoch", -1))
+        if best == int(d.epoch_number) and best != self._captured_epoch:
+            # the validation close JUST declared a new best: capture
+            # immediately, while the weights are exactly those the
+            # validation evaluated (gds are TRAIN-gated, so eval
+            # minibatches did not touch them).  Waiting for epoch_ended
+            # would capture AFTER another TRAIN pass mutated them —
+            # restoring post-divergence weights instead of the best.
+            self._best = self._capture()
+            self._captured_epoch = best
+            self._fails = 0
+            return
+        if not bool(d.epoch_ended):
+            return
+        if best == int(d.epoch_number):
+            self._fails = 0
+            return
+        self._fails += 1
+        if self._best is not None and \
+                self._fails >= self.fail_iterations:
+            self.warning(
+                "plateau of %d epochs: rolling back to the epoch-%d "
+                "best and scaling learning rates by %g",
+                self._fails, best, self.lr_factor)
+            self._restore()
+            self.rollbacks += 1
+            self._fails = 0
+
+    # -- capture / restore --------------------------------------------------
+    def _capture(self):
+        if self.trainer is not None:
+            snap = self.trainer.capture_state()
+            if snap is not None:
+                return ("fused", snap)
+        snap = []
+        for fwd in self.forwards:
+            entry = {}
+            if fwd.weights:
+                fwd.weights.map_read()
+                entry["weights"] = numpy.array(fwd.weights.mem)
+            if fwd.bias:
+                fwd.bias.map_read()
+                entry["bias"] = numpy.array(fwd.bias.mem)
+            snap.append(entry)
+        return ("eager", snap)
+
+    def _restore(self):
+        kind, snap = self._best
+        if kind == "fused":
+            self.trainer.rollback_to(snap, lr_factor=self.lr_factor)
+            return
+        for fwd, entry in zip(self.forwards, snap):
+            if "weights" in entry and fwd.weights:
+                fwd.weights.map_write()
+                fwd.weights.mem[...] = entry["weights"]
+            if "bias" in entry and fwd.bias:
+                fwd.bias.map_write()
+                fwd.bias.mem[...] = entry["bias"]
+        for gd in self.gds:
+            gd.learning_rate = float(gd.learning_rate) * self.lr_factor
+            gd.learning_rate_bias = \
+                float(gd.learning_rate_bias) * self.lr_factor
+        adj = self.lr_adjuster
+        if adj is not None and adj._base is not None:
+            # keep any schedule consistent with the new base rates
+            adj._base = [(lr * self.lr_factor, lr_b * self.lr_factor)
+                         for lr, lr_b in adj._base]

@@ -126,6 +126,23 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.fused = bool(kwargs.pop("fused", False))
         self.fused_config = dict(kwargs.pop("fused_config", {}))
         self.fused_trainer = None
+        #: the reference's root.*.lr_adjuster config: policy names +
+        #: parameters (manualrst_veles_workflow_parameters.rst:655-685)
+        self.lr_adjuster_config = kwargs.pop("lr_adjuster_config", None)
+        self.lr_adjuster = None
+        #: the reference's Rollback capability (algorithms doc #11):
+        #: {"fail_iterations": N, "lr_factor": f}
+        self.rollback_config = kwargs.pop("rollback_config", None)
+        self.rollback = None
+        #: the reference's ImageSaver ({"out_dirs": [test, validation,
+        #: train], "limit": N}) — eager mode only (needs the
+        #: evaluator's per-sample max_idx)
+        self.image_saver_config = kwargs.pop("image_saver_config", None)
+        self.image_saver = None
+        if self.lr_adjuster_config and self.fused:
+            # fused mode evaluates the schedule inside the jitted step
+            self.fused_config.setdefault(
+                "lr_adjuster", dict(self.lr_adjuster_config))
         loader_factory = kwargs.pop("loader_factory")
         super(StandardWorkflow, self).__init__(workflow, **kwargs)
         self.repeater = Repeater(self)
@@ -141,6 +158,10 @@ class StandardWorkflow(AcceleratedWorkflow):
     def create_workflow(self):
         self.link_loader()
         if self.fused:
+            if self.image_saver_config is not None:
+                raise NotImplementedError(
+                    "image_saver needs the eager evaluator's "
+                    "per-sample max_idx; use fused=False")
             self.link_forwards(chain=False)
             self.link_fused_trainer()
             self.link_decision()
@@ -148,6 +169,8 @@ class StandardWorkflow(AcceleratedWorkflow):
                 self.link_snapshotter()
             if self.plotters_config is not None:
                 self.link_plotters()
+            if self.rollback_config is not None:
+                self.link_rollback()
             self.link_loop_and_end()
             return
         self.link_forwards()
@@ -157,8 +180,61 @@ class StandardWorkflow(AcceleratedWorkflow):
             self.link_snapshotter()
         if self.plotters_config is not None:
             self.link_plotters()
+        if self.image_saver_config is not None:
+            self.link_image_saver()
         self.link_gds()
+        if self.lr_adjuster_config:
+            self.link_lr_adjuster()
+        if self.rollback_config is not None:
+            self.link_rollback()
         self.link_loop_and_end()
+
+    def link_image_saver(self):
+        """Dump misclassified samples per minibatch (ref
+        ``veles.znicz.image_saver.ImageSaver``, documented ``out_dirs``
+        knob); each gallery resets itself when a new epoch first
+        writes to it."""
+        if self._loss_kind() != "softmax":
+            raise ValueError("image_saver needs classification "
+                             "(max_idx); loss is %r" % self._loss_kind())
+        from veles_tpu.znicz.image_saver import ImageSaver
+        self.image_saver = ImageSaver(
+            self, **dict(self.image_saver_config or {}))
+        s = self.image_saver
+        s.link_attrs(self.loader, ("input", "minibatch_data"),
+                     ("labels", "minibatch_labels"),
+                     "minibatch_class", "minibatch_size",
+                     "epoch_number")
+        s.link_attrs(self.evaluator, "max_idx")
+        s.link_from(self.decision)
+
+    def link_rollback(self):
+        """Best-state keeper + plateau restorer (ref algorithms doc
+        capability #11); linked after the Decision so it sees every
+        epoch close."""
+        from veles_tpu.znicz.rollback import Rollback
+        self.rollback = Rollback(self, **dict(self.rollback_config
+                                              or {}))
+        self.rollback.decision = self.decision
+        self.rollback.forwards = self.forwards
+        self.rollback.gds = self.gds
+        self.rollback.trainer = self.fused_trainer
+        self.rollback.lr_adjuster = self.lr_adjuster
+        self.rollback.link_from(self.decision)
+
+    def link_lr_adjuster(self):
+        """Insert the LRAdjuster after the gradient chain (the
+        reference's contract: ``link_gds`` first,
+        ``manualrst_veles_workflow_creation.rst:475-487``)."""
+        if not self.gds:
+            raise ValueError("link_lr_adjuster requires link_gds first")
+        from veles_tpu.znicz.lr_adjust import LearningRateAdjust
+        self.lr_adjuster = LearningRateAdjust(
+            self, **dict(self.lr_adjuster_config or {}))
+        self.lr_adjuster.gds = self.gds
+        self.lr_adjuster.link_from(self.gds[-1])
+        # schedules advance once per TRAIN minibatch
+        self.lr_adjuster.gate_skip = ClassSkipGate(self.loader, TRAIN)
 
     def link_loader(self):
         self.repeater.link_from(self.start_point)
@@ -292,6 +368,20 @@ class StandardWorkflow(AcceleratedWorkflow):
             plotter.input_field = "confusion_matrix"
             plotter.link_from(prev)
             self.plotters.append(plotter)
+            prev = plotter
+        if cfg.get("weights"):
+            # the reference's weights_plotter (Weights2D, knob: limit)
+            from veles_tpu.plotting_units import Weights2D
+            wcfg = cfg["weights"] if isinstance(cfg["weights"], dict) \
+                else {}
+            plotter = Weights2D(self, name="weights", **wcfg)
+            plotter.input = self.forwards[0].weights
+            plotter.link_from(prev)
+            # once per epoch: building + publishing the full tile grid
+            # per TRAIN minibatch would cost hundreds of redundant
+            # host-side packs/sends on the scheduler thread
+            plotter.gate_skip = ~self.loader.last_minibatch
+            self.plotters.append(plotter)
 
     def link_gds(self):
         """Backward chain in reverse layer order, gated to TRAIN batches
@@ -317,7 +407,8 @@ class StandardWorkflow(AcceleratedWorkflow):
             err_attr = "err_input"
 
     def link_loop_and_end(self):
-        last_gd = self.gds[-1] if self.gds else self.decision
+        last_gd = self.lr_adjuster if self.lr_adjuster is not None \
+            else (self.gds[-1] if self.gds else self.decision)
         self._loop_tail = last_gd
         self.repeater.link_from(last_gd)
         self.end_point.link_from(last_gd)
