@@ -138,8 +138,10 @@ class All2AllUnit : public Unit {
   int64_t k_ = 0, n_ = 0;
 };
 
-// input (B,H,W,C) × HWIO weights (ky,kx,C,K); padding (l,r,t,b),
-// sliding (sx,sy); im2col into scratch then one sgemm per batch chunk.
+// input (B,H,W,C) × HWIO weights (ky,kx,C/g,K); padding (l,r,t,b),
+// sliding (sx,sy), optional grouping g (AlexNet's grouped conv:
+// output block i reads input channel group i); im2col into scratch
+// then one sgemm per batch chunk per group.
 class ConvUnit : public Unit {
  public:
   void Initialize(const Json& config, std::map<std::string, NpyArray> arrays,
@@ -155,13 +157,16 @@ class ConvUnit : public Unit {
     }
     ky_ = weights_.shape.at(0);
     kx_ = weights_.shape.at(1);
-    cin_ = weights_.shape.at(2);
+    cin_ = weights_.shape.at(2);    // per-group fan-in
     k_ = weights_.shape.at(3);
     Shape pad = ShapeOf(config, "padding");
     left_ = pad[0]; right_ = pad[1]; top_ = pad[2]; bottom_ = pad[3];
     Shape slide = ShapeOf(config, "sliding");
     sx_ = slide[0]; sy_ = slide[1];
-    if (input_shape[3] != cin_)
+    if (config.has("grouping")) g_ = config.at("grouping")->integer();
+    if (g_ < 1 || k_ % g_)
+      throw std::runtime_error("conv: grouping must divide n_kernels");
+    if (input_shape[3] != cin_ * g_)
       throw std::runtime_error("conv: channel mismatch");
     int64_t h = input_shape[1] + top_ + bottom_;
     int64_t w = input_shape[2] + left_ + right_;
@@ -181,35 +186,16 @@ class ConvUnit : public Unit {
                Engine* engine) override {
     int64_t batch = input_shape_[0];
     int64_t h = input_shape_[1], w = input_shape_[2];
+    int64_t c_total = cin_ * g_;
     int64_t patch = ky_ * kx_ * cin_;
     int64_t rows = oh_ * ow_;
+    int64_t kpg = k_ / g_;              // kernels per group
     std::atomic<int> slot_counter{0};
     engine->ParallelFor(batch, [&](int64_t begin, int64_t end) {
       int slot = slot_counter.fetch_add(1);
       float* cols = scratch + slot * rows * patch;
       for (int64_t b = begin; b < end; ++b) {
-        const float* img = in + b * h * w * cin_;
-        // im2col with implicit zero padding
-        for (int64_t oy = 0; oy < oh_; ++oy) {
-          for (int64_t ox = 0; ox < ow_; ++ox) {
-            float* dst = cols + (oy * ow_ + ox) * patch;
-            for (int64_t iy = 0; iy < ky_; ++iy) {
-              int64_t y = oy * sy_ + iy - top_;
-              for (int64_t ix = 0; ix < kx_; ++ix) {
-                int64_t x = ox * sx_ + ix - left_;
-                float* cell = dst + (iy * kx_ + ix) * cin_;
-                if (y < 0 || y >= h || x < 0 || x >= w) {
-                  std::memset(cell, 0, cin_ * sizeof(float));
-                } else {
-                  std::memcpy(cell, img + (y * w + x) * cin_,
-                              cin_ * sizeof(float));
-                }
-              }
-            }
-          }
-        }
-        // (rows, patch) × (patch, k) — weights HWIO are exactly
-        // row-major (ky·kx·cin, k)
+        const float* img = in + b * h * w * c_total;
         float* dst = out + b * rows * k_;
         for (int64_t r = 0; r < rows; ++r) {
           float* orow = dst + r * k_;
@@ -217,15 +203,45 @@ class ConvUnit : public Unit {
             std::memcpy(orow, bias_.data.data(), k_ * sizeof(float));
           else
             std::memset(orow, 0, k_ * sizeof(float));
-          const float* crow = cols + r * patch;
-          for (int64_t p = 0; p < patch; ++p) {
-            float v = crow[p];
-            if (v == 0.0f) continue;
-            const float* wrow = weights_.data.data() + p * k_;
-            for (int64_t j = 0; j < k_; ++j) orow[j] += v * wrow[j];
-          }
-          ActRow(act_, orow, k_);
         }
+        for (int64_t gi = 0; gi < g_; ++gi) {
+          // im2col over this group's channel slice, implicit zero pad
+          const float* gimg = img + gi * cin_;
+          for (int64_t oy = 0; oy < oh_; ++oy) {
+            for (int64_t ox = 0; ox < ow_; ++ox) {
+              float* dstp = cols + (oy * ow_ + ox) * patch;
+              for (int64_t iy = 0; iy < ky_; ++iy) {
+                int64_t y = oy * sy_ + iy - top_;
+                for (int64_t ix = 0; ix < kx_; ++ix) {
+                  int64_t x = ox * sx_ + ix - left_;
+                  float* cell = dstp + (iy * kx_ + ix) * cin_;
+                  if (y < 0 || y >= h || x < 0 || x >= w) {
+                    std::memset(cell, 0, cin_ * sizeof(float));
+                  } else {
+                    std::memcpy(cell, gimg + (y * w + x) * c_total,
+                                cin_ * sizeof(float));
+                  }
+                }
+              }
+            }
+          }
+          // (rows, patch) × (patch, kpg) into output columns
+          // [gi·kpg, (gi+1)·kpg) — weights HWIO are row-major
+          // (ky·kx·cin, k) and output block gi owns that column slice
+          for (int64_t r = 0; r < rows; ++r) {
+            float* orow = dst + r * k_ + gi * kpg;
+            const float* crow = cols + r * patch;
+            for (int64_t p = 0; p < patch; ++p) {
+              float v = crow[p];
+              if (v == 0.0f) continue;
+              const float* wrow =
+                  weights_.data.data() + p * k_ + gi * kpg;
+              for (int64_t j = 0; j < kpg; ++j) orow[j] += v * wrow[j];
+            }
+          }
+        }
+        for (int64_t r = 0; r < rows; ++r)
+          ActRow(act_, dst + r * k_, k_);
       }
     });
   }
@@ -234,7 +250,7 @@ class ConvUnit : public Unit {
   NpyArray weights_, bias_;
   bool has_bias_ = false;
   Act act_ = Act::kNone;
-  int64_t ky_ = 0, kx_ = 0, cin_ = 0, k_ = 0;
+  int64_t ky_ = 0, kx_ = 0, cin_ = 0, k_ = 0, g_ = 1;
   int64_t left_ = 0, right_ = 0, top_ = 0, bottom_ = 0;
   int64_t sx_ = 1, sy_ = 1;
   int64_t oh_ = 0, ow_ = 0;

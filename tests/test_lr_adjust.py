@@ -84,6 +84,18 @@ def test_fused_schedule_matches_manual_lr():
                                   rtol=1e-6, atol=1e-7)
 
 
+def test_rprop_rejects_lr_adjuster():
+    """iRprop-'s per-weight deltas are self-adaptive: a configured
+    schedule would be silently dead, so lowering refuses it."""
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    with pytest.raises(ValueError, match="rprop"):
+        lower_specs(
+            [{"type": "softmax", "->": {"output_sample_shape": 4},
+              "<-": {"solver": "rprop"}}], (6,),
+            lr_adjuster={"lr_policy_name": "exp"})
+
+
 def test_eager_workflow_lr_adjuster():
     """StandardWorkflow(lr_adjuster_config=...): the unit rescales the
     gd units' learning_rate per TRAIN minibatch from the captured base,
@@ -98,6 +110,11 @@ def test_eager_workflow_lr_adjuster():
                             "lr_parameters": {"gamma": 0.5,
                                               "step": 3}})
     assert wf.lr_adjuster is not None
+    # the adjuster precedes the gd chain in control order, so TRAIN
+    # minibatch t trains with factor f(t) — same alignment as the
+    # fused in-step schedule (a post-gds link would lag one step)
+    assert wf.lr_adjuster in wf.gds[0].links_from
+    assert wf.decision in wf.lr_adjuster.links_from
     base = 0.03                              # the sample's configured lr
     wf.run()
     t = wf.lr_adjuster.t

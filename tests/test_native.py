@@ -285,3 +285,23 @@ def test_native_logging_bridge(lib, tmp_path, caplog):
     assert not [r for r in caplog.records
                 if r.name.startswith("native.")]
     lib.veles_native_set_log_level(2)          # restore default
+
+
+def test_grouped_conv_package(lib, tmp_path):
+    """The documented `grouping` knob survives export: XLA forward,
+    the package golden model, and the C++ engine agree on a grouped
+    conv stack (output block i reads input channel group i)."""
+    from veles_tpu.znicz.all2all import All2AllSoftmax
+    from veles_tpu.znicz.conv import ConvTanh
+    rng = numpy.random.default_rng(5)
+    x = rng.standard_normal((4, 10, 10, 6)).astype(numpy.float32)
+    forwards, golden = _chain(
+        [(ConvTanh, {"n_kernels": 8, "kx": 3, "ky": 3,
+                     "padding": (1, 1, 1, 1), "grouping": 2}),
+         (All2AllSoftmax, {"output_sample_shape": (5,)})], x)
+    assert forwards[0].weights.mem.shape == (3, 3, 3, 8)
+    path = str(tmp_path / "grouped.zip")
+    export_package(forwards, path, with_stablehlo=False)
+    with native.NativeWorkflow(path) as wf:
+        out = wf.run(x)
+        assert numpy.allclose(out, golden, atol=1e-3)

@@ -226,3 +226,22 @@ def test_znicz_activations_match_torch():
         numpy.testing.assert_allclose(
             numpy.asarray(ours), torch_fn(lin).numpy(), rtol=1e-5,
             atol=1e-5)
+
+
+def test_grouped_conv_matches_torch():
+    """The documented `grouping` knob (AlexNet's grouped convolution):
+    weights (kh, kw, C/g, K) against torch's groups=g."""
+    from veles_tpu.znicz.conv import Conv
+
+    g = 2
+    rng = numpy.random.default_rng(23)
+    x = rng.standard_normal((2, 9, 9, 8)).astype(numpy.float32)
+    w = (rng.standard_normal((3, 3, 8 // g, 6)) * 0.3).astype(
+        numpy.float32)
+    ours = Conv.pure({"w": w}, jnp.asarray(x), padding=(1, 1, 1, 1),
+                     grouping=g)
+    tw = torch.tensor(w).permute(3, 2, 0, 1)
+    theirs = torch.nn.functional.conv2d(_t(x), tw, padding=1, groups=g)
+    numpy.testing.assert_allclose(numpy.asarray(ours),
+                                  _from_t(theirs), rtol=1e-4,
+                                  atol=1e-5)

@@ -57,6 +57,8 @@ def _unit_export_entry(unit, array_refs):
             padding=list(unit.padding), sliding=list(unit.sliding),
             activation=type(unit).ACTIVATION,
             include_bias=bool(unit.include_bias))
+        if getattr(unit, "grouping", 1) != 1:
+            entry["config"]["grouping"] = int(unit.grouping)
     elif mapping.endswith("pooling"):
         entry["config"].update(kind=type(unit).KIND, kx=unit.kx,
                                ky=unit.ky, sliding=list(unit.sliding))
@@ -341,10 +343,21 @@ def _np_deconv(x, w, padding, sliding):
     return out
 
 
-def _np_conv(x, w, b, padding, sliding):
+def _np_conv(x, w, b, padding, sliding, grouping=1):
     left, right, top, bottom = padding
     sx, sy = sliding
-    ky, kx, cin, k = w.shape
+    ky, kx, cin, k = w.shape           # cin = per-group fan-in
+    if grouping > 1:
+        # output block i reads input channel group i (XLA's
+        # feature_group_count semantics; native runtime matches)
+        kpg = k // grouping
+        outs = [
+            _np_conv(x[..., gi * cin:(gi + 1) * cin],
+                     w[..., gi * kpg:(gi + 1) * kpg], None,
+                     padding, sliding)
+            for gi in range(grouping)]
+        out = numpy.concatenate(outs, axis=-1)
+        return out if b is None else out + b
     x = numpy.pad(x, ((0, 0), (top, bottom), (left, right), (0, 0)))
     bsz, h, ww, _ = x.shape
     oh = (h - ky) // sy + 1
@@ -450,7 +463,8 @@ class PackagedRunner(object):
             return z.reshape([len(x)] + list(cfg["output_sample_shape"]))
         if utype.startswith("conv"):
             out = _np_conv(x, arrays["weights"], arrays.get("bias"),
-                           cfg["padding"], cfg["sliding"])
+                           cfg["padding"], cfg["sliding"],
+                           cfg.get("grouping", 1))
             return _np_act(cfg.get("activation"), out)
         if utype.endswith("pooling"):
             return _np_pool(x, cfg["kind"], cfg["kx"], cfg["ky"],

@@ -1,0 +1,113 @@
+"""VGG-A (VGG-11), the reference's second listed ImageNet model.
+
+Parity target: ``manualrst_veles_algorithms.rst:159`` ("Last Models:
+AlexNet, VGG … imagenet_workflow_vgga_config.py").  The stack follows
+Simonyan & Zisserman 2014 configuration A: 8 conv layers (3×3,
+64→128→256×2→512×2→512×2, max-pool after each block) + fc4096×2 +
+softmax-1000, dropout on the fc layers — expressed as StandardWorkflow
+layer specs and trained through the fused lowering like
+:mod:`veles_tpu.samples.alexnet` (batch sharded on the mesh's ``data``
+axis, gradients all-reduced over ICI inside the step).
+
+ImageNet itself is not shipped; use
+:func:`veles_tpu.samples.alexnet.synthetic_imagenet_batch` with
+``shape=INPUT_SHAPE`` for shape-true benchmarking batches.
+"""
+
+import numpy
+
+_CONV_BW = {"learning_rate": 0.01, "gradient_moment": 0.9,
+            "weights_decay": 0.0005}
+
+
+def _conv(n_kernels):
+    return {"type": "conv_strict_relu",
+            "->": {"n_kernels": n_kernels, "kx": 3, "ky": 3,
+                   "padding": 1, "weights_filling": "gaussian",
+                   "weights_stddev": 0.01},
+            "<-": dict(_CONV_BW)}
+
+
+def _pool():
+    return {"type": "max_pooling",
+            "->": {"kx": 2, "ky": 2, "sliding": (2, 2)}}
+
+
+LAYERS = [
+    _conv(64), _pool(),
+    _conv(128), _pool(),
+    _conv(256), _conv(256), _pool(),
+    _conv(512), _conv(512), _pool(),
+    _conv(512), _conv(512), _pool(),
+    {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+    {"type": "all2all_strict_relu",
+     "->": {"output_sample_shape": 4096, "weights_filling": "gaussian",
+            "weights_stddev": 0.005},
+     "<-": dict(_CONV_BW)},
+    {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+    {"type": "all2all_strict_relu",
+     "->": {"output_sample_shape": 4096, "weights_filling": "gaussian",
+            "weights_stddev": 0.005},
+     "<-": dict(_CONV_BW)},
+    {"type": "softmax",
+     "->": {"output_sample_shape": 1000, "weights_filling": "gaussian",
+            "weights_stddev": 0.01},
+     "<-": dict(_CONV_BW)},
+]
+
+INPUT_SHAPE = (224, 224, 3)
+
+
+def build_fused(mesh=None, layers=None, input_shape=INPUT_SHAPE,
+                compute_dtype=None, remat=True, grad_accum=1):
+    """(params, jitted step, eval, apply) — single-device jit or
+    data-parallel over ``mesh``.  ``remat`` defaults ON: VGG's 224²×64
+    early activations are the HBM hog AlexNet doesn't have."""
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu.znicz.fused_graph import lower_specs
+    if isinstance(compute_dtype, str):
+        compute_dtype = jnp.dtype(compute_dtype).type
+    params, step_fn, eval_fn, apply_fn = lower_specs(
+        layers or LAYERS, input_shape, compute_dtype=compute_dtype,
+        remat=remat, grad_accum=grad_accum)
+    if mesh is not None:
+        from veles_tpu.parallel import data_parallel
+        step = data_parallel(step_fn, mesh, params)
+    else:
+        step = jax.jit(step_fn, donate_argnums=(0,))
+    return params, step, jax.jit(eval_fn), apply_fn
+
+
+def create_workflow(device=None, max_epochs=1, minibatch_size=32,
+                    layers=None, **kwargs):
+    """StandardWorkflow over synthetic shape-true data (ImageNet is
+    not shipped) — the graph-mode twin of :func:`build_fused`."""
+    from veles_tpu.backends import AutoDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class SyntheticImageNetLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(4)
+            n = kwargs.pop("n_samples", 256)
+            data = rng.standard_normal((n,) + INPUT_SHAPE).astype(
+                numpy.float32)
+            self.original_data.mem = data
+            self.original_labels = [int(v) for v in
+                                    rng.integers(0, 1000, n)]
+            self.class_lengths[:] = [0, n // 4, n - n // 4]
+
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: SyntheticImageNetLoader(
+            w, minibatch_size=minibatch_size),
+        layers=[{**spec} for spec in (layers or LAYERS)],
+        decision_config={"max_epochs": max_epochs},
+        **kwargs)
+    launcher = kwargs.pop("launcher", None)
+    wf.launcher = launcher if launcher is not None else DummyLauncher()
+    if launcher is None:
+        wf.initialize(device=device or AutoDevice())
+    return wf

@@ -3,7 +3,10 @@
 Parity target: Znicz ``conv.Conv{,Tanh,Sigmoid,RELU,StrictRELU}``
 (``manualrst_veles_workflow_parameters.rst:473``) with hyperparameters
 n_kernels, kx/ky, padding (4-tuple x_left, x_right, y_top, y_bottom),
-sliding (sx, sy), weights_filling/stddev (``:506-540``).
+sliding (sx, sy), weights_filling/stddev (``:506-540``) and
+``grouping`` (``:537`` — AlexNet's grouped convolution: in-channels
+and kernels split into g independent groups, mapped to XLA's native
+``feature_group_count``; weights are (ky, kx, C/g, K)).
 
 TPU design: NHWC activations × HWIO weights through
 ``lax.conv_general_dilated`` — the layout XLA:TPU natively tiles onto
@@ -78,6 +81,9 @@ class Conv(ForwardBase):
         #: (left, right, top, bottom) like the reference
         self.padding = tuple(padding)
         self.sliding = tuple(kwargs.get("sliding", (1, 1)))
+        #: documented knob #18: grouped convolution (g independent
+        #: channel groups; n_kernels and C both divisible by g)
+        self.grouping = int(kwargs.get("grouping", 1))
 
     def pure_config(self):
         # space-to-depth rewrite for strided small-channel convs: a
@@ -89,15 +95,18 @@ class Conv(ForwardBase):
         sx, sy = self.sliding
         c_in = self.input.shape[-1] if self.input else None
         s2d = bool(c_in and sx == sy and sx > 1 and
-                   c_in <= 32 and c_in * sx * sx <= 256)
+                   c_in <= 32 and c_in * sx * sx <= 256 and
+                   self.grouping == 1)
         return {"padding": self.padding, "sliding": self.sliding,
-                "activation": self.ACTIVATION, "s2d": s2d}
+                "activation": self.ACTIVATION, "s2d": s2d,
+                "grouping": self.grouping}
 
     @staticmethod
     @functools.partial(jax.jit, static_argnames=("padding", "sliding",
-                                                 "activation", "s2d"))
+                                                 "activation", "s2d",
+                                                 "grouping"))
     def pure(params, x, padding=(0, 0, 0, 0), sliding=(1, 1),
-             activation=None, s2d=False):
+             activation=None, s2d=False, grouping=1):
         left, right, top, bottom = padding
         # sliding is (x, y) like the reference; NHWC strides are (H, W)
         # bf16 inputs: omit preferred_element_type — XLA:TPU already
@@ -116,6 +125,7 @@ class Conv(ForwardBase):
                 window_strides=(sliding[1], sliding[0]),
                 padding=((top, bottom), (left, right)),
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=grouping,
                 preferred_element_type=pref)
         if "b" in params:
             out = out + params["b"]
@@ -132,6 +142,13 @@ class Conv(ForwardBase):
     def initialize(self, device=None, **kwargs):
         super(Conv, self).initialize(device=device, **kwargs)
         c_in = self.input.shape[-1]
+        if self.grouping > 1:
+            if c_in % self.grouping or self.n_kernels % self.grouping:
+                raise ValueError(
+                    "grouping %d must divide both in-channels %d and "
+                    "n_kernels %d" % (self.grouping, c_in,
+                                      self.n_kernels))
+            c_in //= self.grouping          # per-group fan-in
         if not self.weights:
             w = numpy.zeros((self.ky, self.kx, c_in, self.n_kernels),
                             dtype=numpy.float32)

@@ -31,6 +31,13 @@ def _remat_stage(pure, config):
     return wrapped
 
 
+def default_lr(solver):
+    """The canonical learning rate when a spec omits it — adadelta's
+    update is self-scaling, so its lr is a plain 1.0 gain.  The ONE
+    place this rule lives (rollback_to reads it too)."""
+    return 1.0 if str(solver) == "adadelta" else 0.01
+
+
 def lower_specs(layer_specs, sample_shape, loss="softmax",
                 compute_dtype=None, remat=False, grad_accum=1,
                 lr_adjuster=None):
@@ -116,9 +123,12 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                           "adadelta"):
             raise ValueError("unknown solver %r (want momentum / adam "
                              "/ rprop / adagrad / adadelta)" % solver)
-        # adadelta's update is self-scaling; its canonical lr is 1.0
-        lr = float(bw.get("learning_rate",
-                          1.0 if solver == "adadelta" else 0.01))
+        if w_policy is not None and solver == "rprop":
+            raise ValueError(
+                "lr_adjuster has no effect on the rprop solver (its "
+                "per-weight deltas are self-adaptive) — remove the "
+                "schedule or pick another solver for this layer")
+        lr = float(bw.get("learning_rate", default_lr(solver)))
         hyper = {
             "solver": solver,
             "lr": lr, "lr_b": float(bw.get("learning_rate_bias", lr)),

@@ -254,10 +254,10 @@ class FusedTrainer(AcceleratedUnit):
         layer's learning rate; the jitted step rebuilds lazily (one
         recompile per rollback event)."""
         if lr_factor != 1.0:
+            from veles_tpu.znicz.fused_graph import default_lr
             for spec in self.layers:
                 bw = spec.setdefault("<-", {})
-                default = 1.0 if str(bw.get("solver", "")) \
-                    == "adadelta" else 0.01
+                default = default_lr(bw.get("solver", "momentum"))
                 bw["learning_rate"] = float(
                     bw.get("learning_rate", default)) * lr_factor
                 if "learning_rate_bias" in bw:

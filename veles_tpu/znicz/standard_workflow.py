@@ -182,9 +182,9 @@ class StandardWorkflow(AcceleratedWorkflow):
             self.link_plotters()
         if self.image_saver_config is not None:
             self.link_image_saver()
-        self.link_gds()
         if self.lr_adjuster_config:
             self.link_lr_adjuster()
+        self.link_gds()
         if self.rollback_config is not None:
             self.link_rollback()
         self.link_loop_and_end()
@@ -223,16 +223,17 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.rollback.link_from(self.decision)
 
     def link_lr_adjuster(self):
-        """Insert the LRAdjuster after the gradient chain (the
-        reference's contract: ``link_gds`` first,
+        """Insert the LRAdjuster BEFORE the gradient chain in control
+        order, so TRAIN minibatch t trains with factor f(t) — exactly
+        the fused path's in-step schedule (a post-gds link would lag
+        every policy by one step).  It rescales the gd units that the
+        subsequent :meth:`link_gds` creates (the unit parity target:
         ``manualrst_veles_workflow_creation.rst:475-487``)."""
-        if not self.gds:
-            raise ValueError("link_lr_adjuster requires link_gds first")
         from veles_tpu.znicz.lr_adjust import LearningRateAdjust
         self.lr_adjuster = LearningRateAdjust(
             self, **dict(self.lr_adjuster_config or {}))
-        self.lr_adjuster.gds = self.gds
-        self.lr_adjuster.link_from(self.gds[-1])
+        self.lr_adjuster.gds = self.gds   # shared list, filled by link_gds
+        self.lr_adjuster.link_from(self.decision)
         # schedules advance once per TRAIN minibatch
         self.lr_adjuster.gate_skip = ClassSkipGate(self.loader, TRAIN)
 
@@ -385,8 +386,10 @@ class StandardWorkflow(AcceleratedWorkflow):
 
     def link_gds(self):
         """Backward chain in reverse layer order, gated to TRAIN batches
-        (ref contract: gds linked last-to-first from decision)."""
-        prev = self.decision
+        (ref contract: gds linked last-to-first from decision; an
+        LRAdjuster, when configured, slots in before the chain)."""
+        prev = self.lr_adjuster if self.lr_adjuster is not None \
+            else self.decision
         err_src = self.evaluator
         err_attr = "err_output"
         skip_gate = ClassSkipGate(self.loader, TRAIN)
@@ -407,8 +410,7 @@ class StandardWorkflow(AcceleratedWorkflow):
             err_attr = "err_input"
 
     def link_loop_and_end(self):
-        last_gd = self.lr_adjuster if self.lr_adjuster is not None \
-            else (self.gds[-1] if self.gds else self.decision)
+        last_gd = self.gds[-1] if self.gds else self.decision
         self._loop_tail = last_gd
         self.repeater.link_from(last_gd)
         self.end_point.link_from(last_gd)
