@@ -157,3 +157,112 @@ def test_snapshot_mid_training_resumes(tmp_path):
     restored.run()
     assert restored.decision.best_n_err_pt <= first_err
     assert restored.loader.epoch_number > 2
+
+
+def test_weights_transposed_storage():
+    """Documented knob #13 (weights_transposed): storage flips to
+    (neurons, fan-in).  Given exactly transposed weights, every
+    execution path — eager numpy (incl. the softmax override), pure,
+    the eager GD step, and the export's canonical layout — matches the
+    untransposed twin; default init derives its scale from the TRUE
+    fan-in, not the storage-leading axis."""
+    import jax.numpy as jnp
+
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.memory import Vector
+    from veles_tpu.package import _collect_arrays
+    from veles_tpu.znicz.all2all import All2AllSoftmax, All2AllTanh
+
+    wf = DummyWorkflow()
+    rng = numpy.random.default_rng(2)
+    x = rng.standard_normal((6, 100)).astype(numpy.float32)
+
+    a = All2AllTanh(wf, output_sample_shape=(4,))
+    a.input = Vector(x.copy())
+    a.initialize(device=None)
+    b = All2AllTanh(wf, output_sample_shape=(4,),
+                    weights_transposed=True)
+    b.input = Vector(x.copy())
+    b.initialize(device=None)
+    assert a.weights.mem.shape == (100, 4)
+    assert b.weights.mem.shape == (4, 100)
+    # default init scale comes from fan-in=100 in BOTH layouts (the
+    # uniform filling is bounded by 1/sqrt(fan_in), NOT 1/sqrt(4))
+    bound = 1.0 / numpy.sqrt(100) + 1e-6
+    assert numpy.abs(a.weights.mem).max() <= bound
+    assert numpy.abs(b.weights.mem).max() <= bound
+
+    # exactly transposed weights ⇒ identical numerics on every path
+    b.weights.map_write()
+    b.weights.mem[...] = a.weights.mem.T
+    b.bias.map_write()
+    b.bias.mem[...] = a.bias.mem
+    a.numpy_run()
+    b.numpy_run()
+    numpy.testing.assert_allclose(b.output.mem, a.output.mem,
+                                  rtol=1e-6)
+    out_p = All2AllTanh.pure({"w": jnp.asarray(b.weights.mem),
+                              "b": jnp.asarray(b.bias.mem)},
+                             jnp.asarray(x), activation="tanh",
+                             transposed=True)
+    numpy.testing.assert_allclose(numpy.asarray(out_p), a.output.mem,
+                                  rtol=1e-5, atol=1e-6)
+    # export normalizes to the canonical (fan-in, neurons) layout
+    arrays = _collect_arrays(b, 32)
+    numpy.testing.assert_allclose(arrays["weights"], a.weights.mem,
+                                  rtol=1e-6)
+
+    # the softmax subclass overrides numpy_run: same contract
+    sa = All2AllSoftmax(wf, output_sample_shape=(5,))
+    sa.input = Vector(x.copy())
+    sa.initialize(device=None)
+    sb = All2AllSoftmax(wf, output_sample_shape=(5,),
+                        weights_transposed=True)
+    sb.input = Vector(x.copy())
+    sb.initialize(device=None)
+    sb.weights.map_write()
+    sb.weights.mem[...] = sa.weights.mem.T
+    sb.bias.map_write()
+    sb.bias.mem[...] = sa.bias.mem
+    sa.numpy_run()
+    sb.numpy_run()
+    numpy.testing.assert_allclose(sb.output.mem, sa.output.mem,
+                                  rtol=1e-6)
+    numpy.testing.assert_array_equal(sb.max_idx.mem, sa.max_idx.mem)
+
+
+def test_weights_transposed_eager_training_matches():
+    """The eager GD chain handles transposed storage: a full
+    2-epoch StandardWorkflow run with weights_transposed=True trains
+    (and its first-layer weights stay the exact transpose of the
+    untransposed twin's, given identical seeding)."""
+    from veles_tpu import prng
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist
+
+    def run_once(transposed):
+        prng.seed_all(15)
+        layers = [
+            {"type": "all2all_tanh",
+             "->": {"output_sample_shape": 32,
+                    "weights_filling": "constant",
+                    "weights_stddev": 0.01,
+                    "weights_transposed": transposed},
+             "<-": {"learning_rate": 0.03, "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": {"learning_rate": 0.03}},
+        ]
+        wf = mnist.create_workflow(device=CPUDevice(), max_epochs=2,
+                                   minibatch_size=1000, layers=layers)
+        wf.run()
+        wf.forwards[0].weights.map_read()
+        return (numpy.array(wf.forwards[0].weights.mem),
+                float(wf.decision.best_n_err_pt))
+
+    w_std, err_std = run_once(False)
+    w_t, err_t = run_once(True)
+    assert w_std.shape == (784, 32) and w_t.shape == (32, 784)
+    # constant-filled identical starts ⇒ training keeps the exact
+    # transpose relation through the whole eager gd chain
+    numpy.testing.assert_allclose(w_t, w_std.T, rtol=1e-5, atol=1e-6)
+    assert err_t == pytest.approx(err_std, abs=1e-6)

@@ -559,3 +559,25 @@ def test_alias_layer_types_train_via_standard_workflow():
     wf.initialize(device=NumpyDevice())
     wf.run()
     assert wf.decision.epoch_n_err_pt[1] < 100.0
+
+
+def test_resizable_all2all_transposed_resize():
+    """resize() preserves rows in (neurons, fan-in) storage when
+    weights_transposed is set."""
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.memory import Vector
+    from veles_tpu.znicz.misc_units import ResizableAll2All
+
+    wf = DummyWorkflow()
+    u = ResizableAll2All(wf, output_sample_shape=(4,),
+                         weights_transposed=True)
+    u.input = Vector(numpy.zeros((2, 10), numpy.float32))
+    u.initialize(device=None)
+    assert u.weights.mem.shape == (4, 10)
+    old = numpy.array(u.weights.mem)
+    u.resize(6)
+    assert u.weights.mem.shape == (6, 10)
+    numpy.testing.assert_array_equal(u.weights.mem[:4], old)
+    u.resize(3)
+    assert u.weights.mem.shape == (3, 10)
+    numpy.testing.assert_array_equal(u.weights.mem, old[:3])

@@ -114,6 +114,13 @@ def _collect_arrays(unit, precision):
         out[name] = numpy.ascontiguousarray(vec.mem, dtype=dtype)
     if not getattr(unit, "include_bias", True):
         out.pop("bias", None)
+    if out.get("weights") is not None and \
+            getattr(unit, "weights_transposed", False) and \
+            out["weights"].ndim == 2:
+        # normalize to the package's canonical (fan-in, neurons)
+        # layout so the golden model and native engine never need the
+        # storage knob
+        out["weights"] = numpy.ascontiguousarray(out["weights"].T)
     return out
 
 

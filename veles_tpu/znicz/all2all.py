@@ -37,16 +37,22 @@ class All2All(ForwardBase):
 
     def pure_config(self):
         return {"activation": self.ACTIVATION,
-                "is_softmax": isinstance(self, All2AllSoftmax)}
+                "is_softmax": isinstance(self, All2AllSoftmax),
+                "transposed": bool(self.weights_transposed)}
 
     @staticmethod
-    def pure(params, x, activation=None, is_softmax=False):
+    def pure(params, x, activation=None, is_softmax=False,
+             transposed=False):
         """Pure functional form (feeds the fused lowering and GDViaVJP)."""
         import jax
         import jax.numpy as jnp
         h = x.reshape(x.shape[0], -1)
-        z = jnp.dot(h, params["w"],
-                    preferred_element_type=jnp.float32)
+        w = params["w"]
+        if transposed:
+            # documented knob weights_transposed: storage is
+            # (neurons, fan-in); XLA folds the transpose into the dot
+            w = w.T
+        z = jnp.dot(h, w, preferred_element_type=jnp.float32)
         if "b" in params:
             z = z + params["b"]
         if is_softmax:
@@ -59,8 +65,16 @@ class All2All(ForwardBase):
         n_input = int(numpy.prod(self.input.shape[1:]))
         n_neurons = self.neurons_number
         if not self.weights:
-            w = numpy.zeros((n_input, n_neurons), dtype=numpy.float32)
-            self.fill_array(w, self.weights_filling, self.weights_stddev)
+            shape = (n_neurons, n_input) if self.weights_transposed \
+                else (n_input, n_neurons)
+            w = numpy.zeros(shape, dtype=numpy.float32)
+            # explicit scale: the default derives from the TRUE fan-in,
+            # which is shape[1] in transposed storage (fill_array's
+            # shape[0] heuristic would use n_neurons — 14× too hot for
+            # a 784-in layer)
+            self.fill_array(w, self.weights_filling,
+                            self.weights_stddev
+                            or 1.0 / numpy.sqrt(max(n_input, 1)))
             self.weights.reset(w)
         if self.include_bias and not self.bias:
             b = numpy.zeros((n_neurons,), dtype=numpy.float32)
@@ -77,7 +91,8 @@ class All2All(ForwardBase):
 
     def numpy_run(self):
         x = self._flat_input_host().astype(numpy.float32)
-        out = x @ self.weights.mem
+        w = self.weights.mem
+        out = x @ (w.T if self.weights_transposed else w)
         if self.include_bias:
             out = out + self.bias.mem
         out = self.apply_activation_numpy(out)
@@ -89,7 +104,10 @@ class All2All(ForwardBase):
         x = self.input.devmem
         x = x.reshape(x.shape[0], -1)
         bias = self.bias.devmem if self.include_bias else None
-        out = gemm.matmul(x, self.weights.devmem, bias, self.ACTIVATION)
+        w = self.weights.devmem
+        if self.weights_transposed:
+            w = w.T
+        out = gemm.matmul(x, w, bias, self.ACTIVATION)
         self.output.devmem = out.reshape(
             (x.shape[0],) + self.output_sample_shape)
 
@@ -155,7 +173,8 @@ class All2AllSoftmax(All2All):
 
     def numpy_run(self):
         x = self._flat_input_host().astype(numpy.float32)
-        logits = x @ self.weights.mem
+        w = self.weights.mem
+        logits = x @ (w.T if self.weights_transposed else w)
         if self.include_bias:
             logits = logits + self.bias.mem
         m = logits.max(axis=1, keepdims=True)
@@ -171,7 +190,10 @@ class All2AllSoftmax(All2All):
         x = self.input.devmem
         x = x.reshape(x.shape[0], -1)
         bias = self.bias.devmem if self.include_bias else None
-        logits = gemm.matmul(x, self.weights.devmem, bias, None)
+        w = self.weights.devmem
+        if self.weights_transposed:
+            w = w.T
+        logits = gemm.matmul(x, w, bias, None)
         sm = _softmax_jit(logits)
         self.output.devmem = sm
         self.max_idx.devmem = jnp.argmax(logits, axis=1).astype(jnp.int32)

@@ -40,11 +40,11 @@ _DERIVS_NUMPY = {
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "activation", "need_err_input", "has_bias"),
+    "activation", "need_err_input", "has_bias", "transposed"),
     donate_argnums=(3, 4, 5, 6))
 def _gd_step(x, y, err_output, w, b, vw, vb, lr, lr_bias, decay,
              decay_bias, moment, moment_bias, activation=None,
-             need_err_input=True, has_bias=True):
+             need_err_input=True, has_bias=True, transposed=False):
     batch = x.shape[0]
     delta = (err_output.astype(jnp.float32)
              * _DERIVS[activation](y.astype(jnp.float32)))
@@ -52,9 +52,16 @@ def _gd_step(x, y, err_output, w, b, vw, vb, lr, lr_bias, decay,
     grad_w = jnp.dot(x2.T, delta,
                      preferred_element_type=jnp.float32) / batch
     # err_input uses the PRE-update weights (standard backprop; matches
-    # the fused jax.grad path bit-for-bit)
-    err_input = jnp.dot(delta, w.T, preferred_element_type=jnp.float32) \
-        if need_err_input else None
+    # the fused jax.grad path bit-for-bit).  transposed: weights are
+    # stored (neurons, fan-in) — delta·W is already err_input, and the
+    # gradient transposes into the storage layout.
+    if need_err_input:
+        err_input = jnp.dot(delta, w if transposed else w.T,
+                            preferred_element_type=jnp.float32)
+    else:
+        err_input = None
+    if transposed:
+        grad_w = grad_w.T
     vw = moment * vw - lr * (grad_w + decay * w)
     w = w + vw
     if has_bias:
@@ -78,10 +85,15 @@ class GradientDescent(GradientDescentBase):
         delta = self.err_output.mem.reshape(batch, -1).astype(
             numpy.float32) * _DERIVS_NUMPY[self.ACTIVATION](y)
         x = self.input.mem.reshape(batch, -1).astype(numpy.float32)
+        transposed = self.weights_transposed
         grad_w = x.T @ delta / batch
+        if transposed:
+            grad_w = grad_w.T        # storage layout (neurons, fan-in)
         if self.need_err_input:
+            w = self.weights.mem
             self.err_input.map_invalidate()
-            self.err_input.mem = (delta @ self.weights.mem.T).reshape(
+            self.err_input.mem = (
+                delta @ (w if transposed else w.T)).reshape(
                 self.input.shape).astype(numpy.float32)
         self.weights.map_write()
         self.gradient_weights.map_write()
@@ -110,7 +122,8 @@ class GradientDescent(GradientDescentBase):
             self.weights_decay, self.weights_decay_bias,
             self.gradient_moment, self.gradient_moment_bias,
             activation=self.ACTIVATION,
-            need_err_input=self.need_err_input, has_bias=has_bias)
+            need_err_input=self.need_err_input, has_bias=has_bias,
+            transposed=self.weights_transposed)
         self.weights.devmem = w
         self.gradient_weights.devmem = vw
         if has_bias:

@@ -158,11 +158,22 @@ class ResizableAll2All(All2All):
         old_b = numpy.array(self.bias.mem) if self.bias else None
         self.output_sample_shape = (int(new_neurons),)
         if old_w is not None:
-            w = numpy.zeros((old_w.shape[0], new_neurons),
-                            dtype=numpy.float32)
-            self.fill_array(w, self.weights_filling, self.weights_stddev)
-            keep = min(old_w.shape[1], new_neurons)
-            w[:, :keep] = old_w[:, :keep]
+            if self.weights_transposed:
+                # storage (neurons, fan-in): the neuron axis leads
+                w = numpy.zeros((new_neurons, old_w.shape[1]),
+                                dtype=numpy.float32)
+                self.fill_array(
+                    w, self.weights_filling, self.weights_stddev
+                    or 1.0 / numpy.sqrt(max(old_w.shape[1], 1)))
+                keep = min(old_w.shape[0], new_neurons)
+                w[:keep] = old_w[:keep]
+            else:
+                w = numpy.zeros((old_w.shape[0], new_neurons),
+                                dtype=numpy.float32)
+                self.fill_array(w, self.weights_filling,
+                                self.weights_stddev)
+                keep = min(old_w.shape[1], new_neurons)
+                w[:, :keep] = old_w[:, :keep]
             self.weights.reset(w)
         if old_b is not None:
             b = numpy.zeros((new_neurons,), dtype=numpy.float32)

@@ -163,16 +163,24 @@ class GradientDescentBase(AcceleratedUnit):
         self.include_bias = kwargs.get("include_bias", True)
         #: compute err_input (False for the first layer, saves a matmul)
         self.need_err_input = kwargs.get("need_err_input", True)
+        self.forward = None       # paired forward (setup_from_forward)
         self.gradient_weights = Vector()
         self.gradient_bias = Vector()
         self.demand("input", "err_output", "weights")
 
     def setup_from_forward(self, forward):
         """Wire the standard data links from the paired forward unit."""
+        self.forward = forward
         self.link_attrs(forward, "input", "output", "weights")
         if self.include_bias:
             self.link_attrs(forward, "bias")
         return self
+
+    @property
+    def weights_transposed(self):
+        """The paired forward's storage-layout knob (documented #13):
+        True when weights are stored (neurons, fan-in)."""
+        return bool(getattr(self.forward, "weights_transposed", False))
 
     def initialize(self, device=None, **kwargs):
         super(GradientDescentBase, self).initialize(device=device, **kwargs)
