@@ -225,3 +225,57 @@ def test_pickle_resume_continues_serving():
     assert restored.global_offset == offset
     restored.run()
     assert restored.global_offset == offset + 10
+
+
+def test_validation_ratio_carves_validation_from_train():
+    """LoaderWithValidationRatio parity: an all-train dataset with
+    validation_ratio in (0,1) yields a validation split at initialize,
+    and a full workflow validates on it."""
+    import pytest
+
+    from veles_tpu import prng
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.loader.base import LoaderError
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+    from veles_tpu.dummy import DummyLauncher
+
+    class AllTrainLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(3)
+            n = 400
+            self.original_data.mem = rng.standard_normal(
+                (n, 16)).astype(numpy.float32)
+            self.original_labels = [int(v) for v in
+                                    rng.integers(0, 4, n)]
+            self.class_lengths[:] = [0, 0, n]
+
+    prng.seed_all(12)
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: AllTrainLoader(
+            w, minibatch_size=50, validation_ratio=0.25),
+        layers=[{"type": "softmax", "->": {"output_sample_shape": 4},
+                 "<-": {"learning_rate": 0.05}}],
+        decision_config={"max_epochs": 2})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=CPUDevice())
+    assert wf.loader.class_lengths == [0, 100, 300]
+    wf.run()
+    assert float(wf.decision.best_n_err_pt) < 100.0
+    assert wf.decision.best_epoch >= 0   # validation actually closed
+
+    # out-of-range ratio is rejected loudly
+    class BadLoader(AllTrainLoader):
+        pass
+
+    wf2 = StandardWorkflow(
+        None,
+        loader_factory=lambda w: BadLoader(
+            w, minibatch_size=50, validation_ratio=1.5),
+        layers=[{"type": "softmax", "->": {"output_sample_shape": 4},
+                 "<-": {"learning_rate": 0.05}}],
+        decision_config={"max_epochs": 1})
+    wf2.launcher = DummyLauncher()
+    with pytest.raises(LoaderError, match="validation_ratio"):
+        wf2.initialize(device=CPUDevice())

@@ -74,6 +74,9 @@ class Loader(Unit):
             from veles_tpu.config import root
             self.train_ratio = float(
                 root.common.ensemble.get("train_ratio", 1.0) or 1.0)
+        #: LoaderWithValidationRatio (ref docs): a (0, 1) ratio carves
+        #: a validation set out of an all-train dataset at initialize
+        self.validation_ratio = kwargs.get("validation_ratio", None)
         self.testing = kwargs.get("testing", False)
         #: overlap next-minibatch IO with downstream compute (needs a
         #: subclass providing ``fill_minibatch_into``)
@@ -193,6 +196,25 @@ class Loader(Unit):
         self.load_data()
         if sum(self.class_lengths) == 0:
             raise LoaderError("there is no data to serve")
+        if self.validation_ratio is not None:
+            ratio = float(self.validation_ratio)
+            if not 0.0 < ratio < 1.0:
+                raise LoaderError(
+                    "validation_ratio must be in (0, 1), got %r"
+                    % self.validation_ratio)
+            if self.class_lengths[VALID] == 0 and \
+                    self.class_lengths[TRAIN] > 0:
+                # the reference's LoaderWithValidationRatio: the
+                # leading block of the train span becomes validation
+                # (classes are laid out [test | valid | train], so the
+                # carve keeps the index space contiguous)
+                k = int(self.class_lengths[TRAIN] * ratio)
+                if k > 0:
+                    self.class_lengths[VALID] = k
+                    self.class_lengths[TRAIN] -= k
+                    self.info(
+                        "extracted %d validation samples from train "
+                        "(validation_ratio %.3f)", k, ratio)
         self._calc_class_end_offsets()
         self.info(
             "samples: test: %d, validation: %d, train: %d",
