@@ -229,8 +229,8 @@ def test_pickle_resume_continues_serving():
 
 def test_validation_ratio_carves_validation_from_train():
     """LoaderWithValidationRatio parity: an all-train dataset with
-    validation_ratio in (0,1) yields a validation split at initialize,
-    and a full workflow validates on it."""
+    validation_ratio in (0,1) yields a RANDOM validation split at
+    initialize, and a full workflow validates on it."""
     import pytest
 
     from veles_tpu import prng
@@ -261,21 +261,19 @@ def test_validation_ratio_carves_validation_from_train():
     wf.launcher = DummyLauncher()
     wf.initialize(device=CPUDevice())
     assert wf.loader.class_lengths == [0, 100, 300]
+    # the carve is a RANDOM subset, not the leading block: the
+    # validation positions of the index space are a permutation
+    wf.loader.shuffled_indices.map_read()
+    valid_idx = numpy.array(wf.loader.shuffled_indices.mem[:100])
+    assert not numpy.array_equal(valid_idx, numpy.arange(100))
+    assert len(set(valid_idx.tolist())) == 100
     wf.run()
     assert float(wf.decision.best_n_err_pt) < 100.0
     assert wf.decision.best_epoch >= 0   # validation actually closed
 
-    # out-of-range ratio is rejected loudly
-    class BadLoader(AllTrainLoader):
-        pass
-
-    wf2 = StandardWorkflow(
-        None,
-        loader_factory=lambda w: BadLoader(
-            w, minibatch_size=50, validation_ratio=1.5),
-        layers=[{"type": "softmax", "->": {"output_sample_shape": 4},
-                 "<-": {"learning_rate": 0.05}}],
-        decision_config={"max_epochs": 1})
-    wf2.launcher = DummyLauncher()
-    with pytest.raises(LoaderError, match="validation_ratio"):
-        wf2.initialize(device=CPUDevice())
+    # bad ratios are rejected at CONSTRUCTION, before any data loads
+    from veles_tpu.dummy import DummyWorkflow
+    for bad in (1.5, 0.0, "25%"):
+        with pytest.raises(LoaderError, match="validation_ratio"):
+            AllTrainLoader(DummyWorkflow(), minibatch_size=50,
+                           validation_ratio=bad)

@@ -75,8 +75,21 @@ class Loader(Unit):
             self.train_ratio = float(
                 root.common.ensemble.get("train_ratio", 1.0) or 1.0)
         #: LoaderWithValidationRatio (ref docs): a (0, 1) ratio carves
-        #: a validation set out of an all-train dataset at initialize
-        self.validation_ratio = kwargs.get("validation_ratio", None)
+        #: a validation set out of an all-train dataset at initialize.
+        #: Validated HERE so a bad config fails before any data loads.
+        ratio = kwargs.get("validation_ratio", None)
+        if ratio is not None:
+            try:
+                ratio = float(ratio)
+            except (TypeError, ValueError):
+                raise LoaderError(
+                    "validation_ratio must be a number in (0, 1), "
+                    "got %r" % (kwargs["validation_ratio"],))
+            if not 0.0 < ratio < 1.0:
+                raise LoaderError(
+                    "validation_ratio must be in (0, 1), got %r"
+                    % ratio)
+        self.validation_ratio = ratio
         self.testing = kwargs.get("testing", False)
         #: overlap next-minibatch IO with downstream compute (needs a
         #: subclass providing ``fill_minibatch_into``)
@@ -196,25 +209,28 @@ class Loader(Unit):
         self.load_data()
         if sum(self.class_lengths) == 0:
             raise LoaderError("there is no data to serve")
-        if self.validation_ratio is not None:
-            ratio = float(self.validation_ratio)
-            if not 0.0 < ratio < 1.0:
-                raise LoaderError(
-                    "validation_ratio must be in (0, 1), got %r"
-                    % self.validation_ratio)
-            if self.class_lengths[VALID] == 0 and \
-                    self.class_lengths[TRAIN] > 0:
-                # the reference's LoaderWithValidationRatio: the
-                # leading block of the train span becomes validation
-                # (classes are laid out [test | valid | train], so the
-                # carve keeps the index space contiguous)
-                k = int(self.class_lengths[TRAIN] * ratio)
-                if k > 0:
-                    self.class_lengths[VALID] = k
-                    self.class_lengths[TRAIN] -= k
-                    self.info(
-                        "extracted %d validation samples from train "
-                        "(validation_ratio %.3f)", k, ratio)
+        if self.validation_ratio is not None and \
+                self.class_lengths[VALID] == 0 and \
+                self.class_lengths[TRAIN] > 0:
+            # the reference's LoaderWithValidationRatio: a RANDOM
+            # subset of the train span becomes validation.  The index
+            # space stays contiguous ([test | valid | train]); one
+            # prng permutation of the train span before the carve
+            # makes the leading block a random sample — a label-sorted
+            # dataset would otherwise send whole classes to validation
+            k = int(self.class_lengths[TRAIN] * self.validation_ratio)
+            if k > 0:
+                start = self.class_lengths[0] + self.class_lengths[VALID]
+                idx = numpy.arange(self.total_samples,
+                                   dtype=INDEX_DTYPE)
+                self.prng.shuffle(idx[start:])
+                self.shuffled_indices.mem = idx
+                self.class_lengths[VALID] = k
+                self.class_lengths[TRAIN] -= k
+                self.info(
+                    "extracted %d random validation samples from "
+                    "train (validation_ratio %.3f)", k,
+                    self.validation_ratio)
         self._calc_class_end_offsets()
         self.info(
             "samples: test: %d, validation: %d, train: %d",
