@@ -357,3 +357,31 @@ def test_checksum_distinguishes_workflows_and_fails_closed():
     ns["ReplUnit"](wf3, name="repl")
     with pytest.raises(ChecksumError):
         wf3.checksum()
+
+
+def test_force_numpy_pins_eager_path():
+    """Documented common unit param force_numpy: the unit stays on the
+    eager numpy path even with an accelerated device attached."""
+    import numpy
+
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.memory import Vector
+    from veles_tpu.znicz.all2all import All2AllTanh
+
+    wf = DummyWorkflow()
+    unit = All2AllTanh(wf, output_sample_shape=(4,), force_numpy=True)
+    unit.input = Vector(numpy.ones((2, 8), numpy.float32))
+    unit.initialize(device=CPUDevice())
+
+    called = {"tpu": 0}
+    orig = unit.tpu_run
+
+    def spy():
+        called["tpu"] += 1
+        return orig()
+
+    unit.tpu_run = spy
+    unit.run()
+    assert called["tpu"] == 0
+    assert unit.output.mem.shape == (2, 4)

@@ -32,6 +32,9 @@ class AcceleratedUnit(Unit):
         super(AcceleratedUnit, self).__init__(workflow, **kwargs)
         self.device = None
         self.intermittent = kwargs.get("intermittent", False)
+        #: documented common unit param: force the eager numpy path
+        #: regardless of the attached device (per-unit debugging)
+        self.force_numpy = bool(kwargs.get("force_numpy", False))
 
     def init_unpickled(self):
         super(AcceleratedUnit, self).init_unpickled()
@@ -67,7 +70,7 @@ class AcceleratedUnit(Unit):
         return self.device is None or self.device.is_interpret
 
     def run(self):
-        if self.is_interpret:
+        if self.force_numpy or self.is_interpret:
             return self.numpy_run()
         return self.tpu_run()
 
