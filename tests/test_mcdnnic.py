@@ -1,0 +1,95 @@
+"""mcdnnic topology strings — the documented second way to set
+topology (``manualrst_veles_workflow_parameters.rst:583-600``)."""
+
+import numpy
+import pytest
+
+from veles_tpu.znicz.mcdnnic import parse_topology
+
+
+def test_parse_documented_example():
+    shape, layers = parse_topology(
+        "12x256x256-32C4-MP2-64C4-MP3-32N-4N",
+        {"->": {"weights_filling": "uniform", "weights_stddev": 0.05},
+         "<-": {"learning_rate": 0.03, "gradient_moment": 0.9}})
+    assert shape == (256, 256, 12)
+    kinds = [ly["type"] for ly in layers]
+    assert kinds == ["conv_tanh", "max_pooling", "conv_tanh",
+                     "max_pooling", "all2all_tanh", "softmax"]
+    assert layers[0]["->"]["n_kernels"] == 32
+    assert layers[0]["->"]["kx"] == 4
+    assert layers[0]["->"]["weights_stddev"] == 0.05     # merged
+    assert layers[0]["<-"]["learning_rate"] == 0.03
+    # pooling receives the shared params too (the docs: "same for
+    # each layer"); its own structural keys still come from the token
+    assert layers[1]["->"]["kx"] == 2 and layers[1]["->"]["ky"] == 2
+    assert layers[1]["->"]["sliding"] == (2, 2)
+    assert layers[1]["->"]["weights_stddev"] == 0.05
+    assert layers[1]["<-"]["learning_rate"] == 0.03
+    assert layers[3]["->"]["sliding"] == (3, 3)
+    assert layers[4]["->"]["output_sample_shape"] == 32
+    assert layers[5]["->"]["output_sample_shape"] == 4
+    assert layers[5]["<-"]["gradient_moment"] == 0.9
+
+
+def test_parse_rejects_bad_strings():
+    with pytest.raises(ValueError, match="output layer"):
+        parse_topology("32C4-MP2")           # no trailing N layer
+    with pytest.raises(ValueError, match="unknown mcdnnic token"):
+        parse_topology("32C4-XX-4N")
+    with pytest.raises(ValueError, match="empty"):
+        parse_topology("")
+
+
+def test_parse_structure_beats_shared_parameters():
+    """A shared '->' key colliding with a structural key parsed from
+    the string must NOT override the string."""
+    _s, layers = parse_topology(
+        "32C4-64C4-4N", {"->": {"n_kernels": 16,
+                                "output_sample_shape": 99}})
+    assert layers[0]["->"]["n_kernels"] == 32
+    assert layers[1]["->"]["n_kernels"] == 64
+    assert layers[2]["->"]["output_sample_shape"] == 4
+
+
+def test_standard_workflow_from_mcdnnic_topology():
+    """A workflow built from the string trains end to end; giving both
+    layers and a topology is rejected."""
+    from veles_tpu import prng
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class TinyImages(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(6)
+            n = 300
+            self.original_data.mem = rng.standard_normal(
+                (n, 8, 8, 3)).astype(numpy.float32)
+            self.original_labels = [int(v) for v in
+                                    rng.integers(0, 5, n)]
+            self.class_lengths[:] = [0, n // 3, n - n // 3]
+
+    prng.seed_all(13)
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: TinyImages(w, minibatch_size=50),
+        mcdnnic_topology="3x8x8-8C3-MP2-16N-5N",
+        mcdnnic_parameters={"<-": {"learning_rate": 0.05,
+                                   "gradient_moment": 0.9}},
+        decision_config={"max_epochs": 2})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=CPUDevice())
+    assert [type(u).MAPPING for u in wf.forwards] == \
+        ["conv_tanh", "max_pooling", "all2all_tanh", "softmax"]
+    wf.run()
+    assert numpy.isfinite(float(wf.decision.best_n_err_pt))
+
+    with pytest.raises(ValueError, match="not both"):
+        StandardWorkflow(
+            None,
+            loader_factory=lambda w: TinyImages(w, minibatch_size=50),
+            layers=[{"type": "softmax",
+                     "->": {"output_sample_shape": 5}}],
+            mcdnnic_topology="8C3-5N")

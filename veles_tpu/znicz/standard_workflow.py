@@ -115,6 +115,19 @@ class StandardWorkflow(AcceleratedWorkflow):
 
     def __init__(self, workflow=None, **kwargs):
         self.layers = kwargs.pop("layers", [])
+        #: the documented "second way to set topology": an mcdnnic
+        #: string like "12x256x256-32C4-MP2-64C4-MP3-32N-4N", with
+        #: mcdnnic_parameters applied to every generated layer
+        #: (manualrst_veles_workflow_parameters.rst:583-600)
+        topology = kwargs.pop("mcdnnic_topology", None)
+        mcdnnic_parameters = kwargs.pop("mcdnnic_parameters", None)
+        if topology is not None:
+            if self.layers:
+                raise ValueError(
+                    "give either layers or mcdnnic_topology, not both")
+            from veles_tpu.znicz.mcdnnic import parse_topology
+            _shape, self.layers = parse_topology(topology,
+                                                 mcdnnic_parameters)
         self.loss_function = kwargs.pop("loss_function", None)
         self.decision_config = dict(kwargs.pop("decision_config", {}))
         self.snapshotter_config = kwargs.pop("snapshotter_config", None)
