@@ -242,3 +242,59 @@ def test_master_slave_end_to_end():
         assert sorted(master_wf.updates) == [0, 10, 20, 30, 40]
     finally:
         server.stop()
+
+
+def test_version_logo_dump_flags(capsys):
+    """--version prints-and-exits; --no-logo suppresses the banner;
+    --dump-config prints the root tree; --dry-run load stops before
+    construction; --dump-unit-attributes pretty elides arrays."""
+    from veles_tpu.__main__ import Main
+
+    assert Main(["--version"]).run() == 0
+    out = capsys.readouterr().out
+    assert "veles_tpu" in out and "jax" in out
+
+    rc = Main(["veles_tpu.samples.mnist", "--no-logo", "--dry-run",
+               "load", "--dump-config", "-d", "cpu"]).run()
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "common" in captured.out          # the config tree printed
+    assert "veles_tpu" not in captured.err   # banner suppressed
+
+    rc = Main(["veles_tpu.samples.mnist", "--no-logo", "--dry-run",
+               "init", "--dump-unit-attributes", "pretty",
+               "-d", "cpu"]).run()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "array" in out                    # big weights elided
+    assert "MnistLoader" in out or "loader" in out.lower()
+
+
+def test_visualize_initializes_without_running(tmp_path, capsys, monkeypatch):
+    """--visualize = initialize + graph into the snapshots dir, never
+    train (both workflow conventions consult dry_run)."""
+    from veles_tpu.__main__ import Main
+    from veles_tpu.config import root
+
+    monkeypatch.setattr(root.common.dirs, "snapshots", str(tmp_path),
+                        raising=False)
+    rc = Main(["veles_tpu.samples.mnist", "--no-logo", "--visualize",
+               "-d", "cpu"]).run()
+    assert rc == 0
+    path = tmp_path / "workflow_graph.dot"
+    assert path.exists()
+    assert "digraph" in path.read_text()
+
+
+def test_debug_pickle_names_unit_attribute(tmp_path):
+    """--debug-pickle walks container shapes real snapshots have: a
+    workflow whose UNIT holds an unpicklable attr is diagnosed down to
+    workflow._units[i].attr."""
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.snapshotter import diagnose_pickle
+
+    wf = DummyWorkflow()
+    wf.initialize()
+    list(wf)[0].evil_callback = lambda: None
+    lines = diagnose_pickle(wf, path="workflow")
+    assert any("evil_callback" in line for line in lines), lines

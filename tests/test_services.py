@@ -533,3 +533,25 @@ def test_standard_workflow_image_saver_and_weights_plotter(tmp_path):
     assert len(w2d) == 1 and w2d[0].grid is not None
     # 9 tiles of 28x28 -> 3x3 grid with separators
     assert w2d[0].grid.shape == (3 * 29 - 1, 3 * 29 - 1)
+
+
+def test_pickle_diagnostics_names_offending_attribute():
+    """--debug-pickle parity: a failed snapshot pickle is diagnosed
+    down to the attribute path that cannot pickle."""
+    from veles_tpu.snapshotter import diagnose_pickle
+
+    class Inner:
+        def __init__(self):
+            self.fine = 42
+            self.broken = lambda: None       # unpicklable
+
+    class Outer:
+        def __init__(self):
+            self.name = "ok"
+            self.child = Inner()
+
+    lines = diagnose_pickle(Outer(), path="wf")
+    assert any("wf.child.broken" in line for line in lines)
+    assert not any(".fine" in line or ".name" in line
+                   for line in lines)
+    assert diagnose_pickle({"a": 1}) == []

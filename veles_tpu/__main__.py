@@ -176,9 +176,63 @@ class Main(Logger):
 
         self.module.run(load, main)
 
+    @staticmethod
+    def _version_line():
+        import jax
+
+        import veles_tpu
+        return "veles_tpu %s (jax %s, %s)" % (
+            veles_tpu.__version__, jax.__version__,
+            "python %d.%d" % sys.version_info[:2])
+
+    def _dump_unit_attributes(self, mode):
+        """Print every unit's __dict__ after initialization
+        (ref ``--dump-unit-attributes``); ``pretty`` elides arrays."""
+        import numpy
+
+        for unit in self.workflow:
+            attrs = {}
+            for key, value in sorted(vars(unit).items()):
+                if key.endswith("_"):
+                    continue
+                if mode == "pretty" and isinstance(
+                        value, numpy.ndarray) and value.size > 16:
+                    value = "<%s array %s>" % (value.dtype,
+                                               "x".join(map(
+                                                   str, value.shape)))
+                attrs[key] = value
+            print("%s: %s" % (unit.name or type(unit).__name__, attrs))
+
+    def _daemonize(self):
+        """Double-fork into the background (ref ``-b``)."""
+        if os.fork() > 0:
+            os._exit(0)
+        os.setsid()
+        if os.fork() > 0:
+            os._exit(0)
+        devnull = os.open(os.devnull, os.O_RDWR)
+        for fd in (0, 1):
+            os.dup2(devnull, fd)
+        # keep stderr: logging still reaches the launch terminal's
+        # redirect target if any; daemons should pair this with
+        # --log-db for durable records
+        self.info("daemonized (pid %d)", os.getpid())
+
     # -- run ----------------------------------------------------------------
     def run(self):
         args = self._parse()
+        if args.version:
+            print(self._version_line())
+            return 0
+        if not args.no_logo:
+            print(self._version_line(), file=sys.stderr)
+        if args.background:
+            self._daemonize()
+        if args.visualize and not args.dry_run:
+            # "initialize but do not run" must hold for BOTH workflow
+            # conventions: run(load, main) modules consult dry_run
+            # inside main(), so set it rather than special-casing
+            args.dry_run = "init"
         if args.device in ("numpy", "cpu"):
             # a CPU-only run must not touch the TPU: a sitecustomize may
             # pin a tunnel platform behind JAX_PLATFORMS' back, and
@@ -199,6 +253,10 @@ class Main(Logger):
             import jax
             jax.config.update("jax_debug_nans", True)
             self.info("NaN checking enabled (jax_debug_nans)")
+        if args.debug_pickle:
+            from veles_tpu import snapshotter
+            snapshotter.DEBUG_PICKLE = True
+            self.info("pickle diagnostics enabled")
         self._seed_random()
         self._apply_config()
         # config may carry a seed (e.g. ensemble members get distinct
@@ -206,6 +264,11 @@ class Main(Logger):
         cfg_seed = root.common.engine.get("seed", None)
         if cfg_seed is not None and args.random_seed is None:
             prng.seed_all(int(cfg_seed))
+        if args.dump_config:
+            root.print_()
+        if args.dry_run == "load":
+            self.info("dry run (load) complete")
+            return 0
         if args.frontend:
             from veles_tpu.scripts.generate_frontend import generate
             with open(args.frontend, "w") as fout:
@@ -242,6 +305,26 @@ class Main(Logger):
             with open(args.workflow_graph, "w") as fout:
                 fout.write(self.workflow.generate_graph())
             self.info("wrote workflow graph to %s", args.workflow_graph)
+        if args.dump_unit_attributes != "no" and \
+                self.workflow is not None:
+            self._dump_unit_attributes(args.dump_unit_attributes)
+        if args.visualize and self.workflow is not None:
+            # initialize-only + graph written into the snapshots dir
+            # (the documented location); plotting endpoints only live
+            # as long as a process, so the advice is a fixed port —
+            # not a reattach promise that would dangle
+            out_dir = root.common.dirs.get("snapshots", ".")
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(out_dir, "workflow_graph.dot")
+            with open(path, "w") as fout:
+                fout.write(self.workflow.generate_graph())
+            self.info(
+                "visualize: graph at %s — not running.  For live "
+                "plots, run WITHOUT --visualize and attach "
+                "graphics_client to the GraphicsServer endpoint "
+                "printed at startup (pin it with "
+                "root.common.graphics.port)", path)
+            return 0
         if args.dry_run:
             self.info("dry run (%s) complete", args.dry_run)
             return 0

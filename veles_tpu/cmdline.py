@@ -74,6 +74,35 @@ def make_parser(prog="veles_tpu", description=None):
              "at PATH (the reference's --log-mongo duplication, "
              "logger.py:292, without the database dependency)")
     parser.add_argument(
+        "--version", action="store_true",
+        help="print version and backend info, then exit "
+             "(ref cmdline.py:143)")
+    parser.add_argument(
+        "--no-logo", action="store_true",
+        help="do not print the version banner at startup "
+             "(ref cmdline.py:139)")
+    parser.add_argument(
+        "--dump-config", action="store_true",
+        help="print the initial global configuration after applying "
+             "the config file and overrides (ref cmdline.py:169)")
+    parser.add_argument(
+        "--dump-unit-attributes", default="no",
+        choices=["no", "pretty", "all"],
+        help="print unit __dict__-s after workflow initialization; "
+             "\"pretty\" elides large arrays (ref cmdline.py:207)")
+    parser.add_argument(
+        "--visualize", action="store_true",
+        help="initialize but do not run; write the workflow graph "
+             "next to the snapshot dir and start the plotting "
+             "endpoint (ref cmdline.py:178)")
+    parser.add_argument(
+        "-b", "--background", action="store_true",
+        help="detach and run as a daemon (ref cmdline.py:228)")
+    parser.add_argument(
+        "--debug-pickle", action="store_true",
+        help="on a failed snapshot pickle, walk the workflow and name "
+             "the offending attribute (ref cmdline.py:158)")
+    parser.add_argument(
         "-r", "--random-seed", default=None,
         help="seed for the named PRNG streams (int, or path[:dtype:count] "
              "to a seed file; ref prng/random_generator.py:106)")
@@ -93,9 +122,11 @@ def make_parser(prog="veles_tpu", description=None):
         help="write gathered IResultProvider results JSON here "
              "(ref workflow.py:827-851)")
     parser.add_argument(
-        "--dry-run", default="", choices=["", "init"],
-        help="construct + initialize the workflow, then exit without "
-             "training")
+        "--dry-run", default="", choices=["", "load", "init"],
+        help="load: parse args + apply config, stop before "
+             "constructing the workflow; init: construct + initialize "
+             "the workflow, then exit without training "
+             "(ref cmdline.py:172 choices no/load/init/exec)")
     parser.add_argument(
         "--workflow-graph", default="",
         help="write the unit graph in DOT format to this path "

@@ -205,8 +205,13 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self.device = kwargs.pop("device", None) or make_device(spec)
         self.info("%s mode; device=%s", self.mode, self.device)
         if self.graphics_enabled and not self.is_master:
+            from veles_tpu.config import root
             from veles_tpu.graphics_server import GraphicsServer
-            self._graphics = GraphicsServer.launch()
+            # root.common.graphics.port pins the endpoint across runs
+            # (viewers keep their subscription); .multicast adds the
+            # reference's lab-wide epgm broadcast
+            self._graphics = GraphicsServer.launch(
+                port=int(root.common.graphics.get("port", 0) or 0))
         if self.web_status_enabled:
             from veles_tpu.web_status import WebStatus
             self._web_status = WebStatus(

@@ -142,7 +142,7 @@ class SnapshotterToFile(SnapshotterBase):
         ext = (".%s" % self.compression) if self.compression else ""
         name = "%s_%s.pickle%s" % (self.prefix, suffix, ext)
         path = os.path.join(self.directory, name)
-        data = pickle.dumps(self.workflow, protocol=pickle.HIGHEST_PROTOCOL)
+        data = checked_dumps(self.workflow, logger=self)
         self._join_pending_write()
         self._destination = path
         if self.background:
@@ -260,8 +260,7 @@ class SnapshotterToDB(SnapshotterBase):
         return conn
 
     def export(self):
-        data = pickle.dumps(self.workflow,
-                            protocol=pickle.HIGHEST_PROTOCOL)
+        data = checked_dumps(self.workflow, logger=self)
         self._join_pending_write()
         # destination is known up front except the rowid; the write
         # (compress + INSERT) runs on the host pool like the file
@@ -339,3 +338,65 @@ class SnapshotterToDB(SnapshotterBase):
             raise KeyError("no snapshot %r in %s" % (rowid, database))
         codec, blob = row
         return pickle.loads(BYTES_CODECS[codec][1](blob))
+
+
+#: --debug-pickle (ref cmdline.py:158 "Turn on pickle diagnostics"):
+#: when True, a failed snapshot pickle is diagnosed attribute by
+#: attribute so the log names the offending slot instead of a bare
+#: "cannot pickle" from somewhere inside the object graph.
+DEBUG_PICKLE = False
+
+
+def diagnose_pickle(obj, path="workflow", max_depth=4, _seen=None):
+    """Paths of the sub-attributes that fail to pickle.
+
+    Walks ``__getstate__``/``__dict__`` (honoring the framework's
+    ``_``-suffix exclusion convention) down to ``max_depth`` and
+    returns ``["path.attr: error", ...]`` for every leaf that cannot
+    be pickled on its own — the reference's ``--debug-pickle``
+    diagnostics."""
+    _seen = _seen if _seen is not None else set()
+    if id(obj) in _seen or max_depth < 0:
+        return []
+    _seen.add(id(obj))
+    try:
+        pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        return []
+    except Exception as exc:
+        problems = ["%s: %s" % (path, exc)]
+    children = []
+    if isinstance(obj, (list, tuple)):
+        # the shape real snapshots have: units live in a list
+        for i, value in enumerate(obj):
+            children.extend(diagnose_pickle(
+                value, "%s[%d]" % (path, i), max_depth - 1, _seen))
+    elif isinstance(obj, dict):
+        for key, value in sorted(obj.items(), key=lambda kv: repr(kv)):
+            children.extend(diagnose_pickle(
+                value, "%s[%r]" % (path, key), max_depth - 1, _seen))
+    else:
+        getstate = getattr(obj, "__getstate__", None)
+        try:
+            state = getstate() if callable(getstate) else vars(obj)
+        except Exception:
+            return problems
+        if not isinstance(state, dict):
+            return problems
+        for key, value in sorted(state.items(),
+                                 key=lambda kv: kv[0]):
+            children.extend(diagnose_pickle(
+                value, "%s.%s" % (path, key), max_depth - 1, _seen))
+    # when children pinpoint the failure, the parent line is noise
+    return children or problems
+
+
+def checked_dumps(obj, logger=None):
+    """pickle.dumps with optional --debug-pickle diagnostics."""
+    try:
+        return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception:
+        if DEBUG_PICKLE:
+            for line in diagnose_pickle(obj):
+                (logger.error if logger else print)(
+                    "pickle diagnostics: %s" % line)
+        raise
