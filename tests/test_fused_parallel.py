@@ -626,3 +626,50 @@ def test_graft_entry_contract():
 def test_dryrun_multichip_8():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
+
+
+def test_standard_workflow_fused_mesh_tp():
+    """fused_config={'mesh_axes': {'data': 2, 'model': 4}, 'tp': True}:
+    Megatron column-parallel weights through the workflow — each chip
+    holds 1/4 of every wide layer's neurons, batch splits on 'data',
+    and training still converges; tp+fsdp merge onto distinct dims."""
+    from jax.sharding import PartitionSpec as P
+
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist
+
+    prng.seed_all(22)
+    wf = mnist.create_workflow(
+        device=CPUDevice(), max_epochs=2, minibatch_size=500,
+        fused=True,
+        fused_config={"mesh_axes": {"data": 2, "model": 4},
+                      "tp": True})
+    wf.run()
+    results = wf.gather_results()
+    assert results["best_validation_error_pt"] < 35.0
+    w = wf.fused_trainer._params_[0]["w"]          # (784, 100)
+    assert not w.sharding.is_fully_replicated
+    assert w.sharding.spec == P(None, "model")
+    # momentum velocity shards with its weight
+    vw = wf.fused_trainer._params_[0]["vw"]
+    assert vw.sharding.spec == P(None, "model")
+
+    # tp+fsdp: contested dims resolve TP-first, FSDP takes the rest
+    prng.seed_all(22)
+    wf2 = mnist.create_workflow(
+        device=CPUDevice(), max_epochs=2, minibatch_size=500,
+        fused=True,
+        fused_config={"mesh_axes": {"data": 2, "model": 4},
+                      "tp": True, "fsdp": True})
+    wf2.run()
+    w2 = wf2.fused_trainer._params_[0]["w"]
+    assert w2.sharding.spec == P("data", "model")
+    assert numpy.isfinite(
+        wf2.gather_results()["best_validation_error_pt"])
+
+
+def test_tp_requires_model_axis():
+    from veles_tpu.parallel.dp import tp_rules
+
+    with pytest.raises(ValueError, match="model"):
+        tp_rules(make_mesh({"data": 8}))

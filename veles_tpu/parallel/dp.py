@@ -59,6 +59,38 @@ def shard_params(params, mesh, param_rules=None):
     return jax.tree.map(jax.device_put, params, shardings)
 
 
+def tp_rules(mesh, axis="model", min_elements=1024):
+    """``param_rules`` for Megatron-style tensor parallelism on fused
+    znicz stacks: every large-enough weight shards its LAST dimension
+    (the neuron/kernel axis — column parallel) over ``axis``, so each
+    chip holds and trains 1/axis_size of every layer's neurons; GSPMD
+    partitions the matmuls/convs and inserts the all-gathers where an
+    activation must be whole (SURVEY §2.4: TP is the mesh design's
+    value-add).  Solver slots shard along with their weights because
+    :func:`_params_sharding` applies rules per leaf; biases shard the
+    same way only when they clear ``min_elements`` — smaller ones
+    stay replicated (the collective would cost more than the bytes).
+    Combine with ``data_parallel(batch_axis="data")`` for DP×TP."""
+    if axis not in mesh.shape:
+        raise ValueError(
+            "tp_rules: mesh has no %r axis (mesh_axes must include "
+            "it, e.g. {'data': d, %r: m})" % (axis, axis))
+    size = mesh.shape[axis]
+
+    def rules(leaf):
+        shape = numpy.shape(leaf)
+        if not shape or \
+                int(numpy.prod(shape, initial=1)) < min_elements:
+            return None
+        if shape[-1] % size == 0 and shape[-1] >= size:
+            spec = [None] * len(shape)
+            spec[-1] = axis
+            return P(*spec)
+        return None
+
+    return rules
+
+
 def fsdp_rules(mesh, axis="data", min_elements=1024):
     """``param_rules`` sharding every large-enough parameter over the
     data axis — ZeRO-3/FSDP storage without new step code: each chip

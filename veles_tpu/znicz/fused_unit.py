@@ -53,9 +53,12 @@ class FusedTrainer(AcceleratedUnit):
         #: {"data": -1} etc. — train over a device mesh: batch sharded
         #: on "data", gradients all-reduced inside the step (the
         #: BASELINE north-star AlexNet-DP path, via the workflow).
-        #: Optionally combine with fsdp=True for ZeRO param storage.
+        #: Optionally combine with fsdp=True for ZeRO param storage
+        #: and/or tp=True for Megatron column-parallel weights over a
+        #: "model" axis (mesh_axes={"data": d, "model": m}).
         self.mesh_axes = kwargs.get("mesh_axes")
         self.fsdp = bool(kwargs.get("fsdp", False))
+        self.tp = bool(kwargs.get("tp", False))
         self.loader = None
         self.forwards = None
         self.n_err = 0.0
@@ -111,9 +114,10 @@ class FusedTrainer(AcceleratedUnit):
         self._train_divisor_ = max(self.grad_accum, 1)
         if self.mesh_axes:
             from veles_tpu.parallel import data_parallel, make_mesh
-            from veles_tpu.parallel.dp import fsdp_rules, shard_params
+            from veles_tpu.parallel.dp import (fsdp_rules, shard_params,
+                                               tp_rules)
             mesh = make_mesh(dict(self.mesh_axes))
-            rules = fsdp_rules(mesh) if self.fsdp else None
+            rules = self._make_rules(mesh, fsdp_rules, tp_rules)
             self._step_ = data_parallel(step_fn, mesh, params,
                                         param_rules=rules)
             self._params_ = shard_params(params, mesh,
@@ -141,6 +145,32 @@ class FusedTrainer(AcceleratedUnit):
             self._params_ = jax.device_put(params)
             self._step_ = jax.jit(step_fn, donate_argnums=(0,))
             self._eval_ = jax.jit(eval_fn)
+
+    def _make_rules(self, mesh, fsdp_rules, tp_rules):
+        """Param sharding rules for the configured mesh: TP (column-
+        parallel last dim on "model"), FSDP (first divisible dim on
+        "data"), or their merge — TP wins a contested dim, FSDP takes
+        any remaining one."""
+        if not (self.tp or self.fsdp):
+            return None
+        from jax.sharding import PartitionSpec as P
+        r_tp = tp_rules(mesh) if self.tp else None
+        r_fsdp = fsdp_rules(mesh) if self.fsdp else None
+
+        def rules(leaf):
+            spec_t = r_tp(leaf) if r_tp else None
+            spec_f = r_fsdp(leaf) if r_fsdp else None
+            if spec_t is None:
+                return spec_f
+            if spec_f is None:
+                return spec_t
+            merged = list(spec_t)
+            for dim, axis in enumerate(spec_f):
+                if axis is not None and merged[dim] is None:
+                    merged[dim] = axis
+            return P(*merged)
+
+        return rules
 
     def _restore_solver_state(self, params):
         """On snapshot resume, continue from the pickled solver state
