@@ -555,3 +555,27 @@ def test_pickle_diagnostics_names_offending_attribute():
     assert not any(".fine" in line or ".name" in line
                    for line in lines)
     assert diagnose_pickle({"a": 1}) == []
+
+
+def test_graphics_client_pdf_toggle(tmp_path):
+    """The documented SIGUSR2 PDF mode: toggling switches rendered
+    plot files from .png to .pdf."""
+    from veles_tpu.graphics_server import GraphicsServer
+    from veles_tpu.graphics_client import GraphicsClient
+    from veles_tpu.plotting_units import AccumulatingPlotter
+
+    server = GraphicsServer.launch()
+    client = GraphicsClient(server.endpoint, output_dir=str(tmp_path))
+    try:
+        wf = DummyWorkflow()
+        plotter = AccumulatingPlotter(wf, name="curve")
+        plotter.values = [1.0, 2.0]
+        client.render(plotter)
+        assert (tmp_path / "curve.png").exists()
+        client.toggle_pdf()
+        assert client.pdf_mode
+        client.render(plotter)
+        assert (tmp_path / "curve.pdf").exists()
+    finally:
+        client.stop()
+        server.shutdown()

@@ -266,3 +266,46 @@ def test_weights_transposed_eager_training_matches():
     # transpose relation through the whole eager gd chain
     numpy.testing.assert_allclose(w_t, w_std.T, rtol=1e-5, atol=1e-6)
     assert err_t == pytest.approx(err_std, abs=1e-6)
+
+
+def test_evaluator_mse_mean_knob():
+    """Documented evaluator knob `mean`: False selects sum-over-batch
+    gradient semantics (err_output pre-scaled by batch so the GD
+    units' /batch cancels); True (default) is unchanged."""
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.memory import Vector
+    from veles_tpu.znicz.evaluator import EvaluatorMSE
+
+    wf = DummyWorkflow()
+    rng = numpy.random.default_rng(4)
+    out = rng.standard_normal((5, 3)).astype(numpy.float32)
+    target = rng.standard_normal((5, 3)).astype(numpy.float32)
+
+    def build(**kw):
+        ev = EvaluatorMSE(wf, **kw)
+        ev.output = Vector(out.copy())
+        ev.target = Vector(target.copy())
+        ev.batch_size = 5
+        ev.err_output = Vector(numpy.zeros((5, 3), numpy.float32))
+        ev.run()
+        return ev
+
+    a = build()
+    b = build(mean=False)
+    numpy.testing.assert_allclose(a.err_output.mem, out - target,
+                                  rtol=1e-6)
+    numpy.testing.assert_allclose(b.err_output.mem,
+                                  (out - target) * 5.0, rtol=1e-6)
+    assert a.mse == pytest.approx(b.mse)     # the metric is unscaled
+
+    # short batch: the scale is the BUFFER row count (the GD units'
+    # divisor), so sum semantics hold for the epoch tail too
+    ev = EvaluatorMSE(wf, mean=False)
+    ev.output = Vector(out.copy())
+    ev.target = Vector(target.copy())
+    ev.batch_size = 5
+    ev.err_output = Vector(numpy.zeros((8, 3), numpy.float32))
+    ev.run()
+    numpy.testing.assert_allclose(ev.err_output.mem[:5],
+                                  (out - target) * 8.0, rtol=1e-6)
+    numpy.testing.assert_array_equal(ev.err_output.mem[5:], 0.0)

@@ -19,17 +19,26 @@ from veles_tpu.logger import Logger
 
 
 class GraphicsClient(Logger):
-    def __init__(self, endpoint, output_dir=None):
+    def __init__(self, endpoint, output_dir=None, pdf=False):
         super(GraphicsClient, self).__init__()
         import zmq
         self.endpoint = endpoint
         self.output_dir = output_dir or root.common.dirs.get("results")
+        #: PDF mode (ref graphics doc: SIGUSR2 toggles it at runtime)
+        self.pdf_mode = bool(pdf)
         self._context = zmq.Context.instance()
         self._socket = self._context.socket(zmq.SUB)
         self._socket.connect(endpoint)
         self._socket.setsockopt(zmq.SUBSCRIBE, b"")
         self._stop = threading.Event()
         self.rendered = 0
+
+    def toggle_pdf(self, *_signal_args):
+        """Flip PNG↔PDF output (the reference's ``killall -SIGUSR2``
+        feature, ``manualrst_veles_graphics.rst:36-40``)."""
+        self.pdf_mode = not self.pdf_mode
+        self.info("plot output switched to %s",
+                  "PDF" if self.pdf_mode else "PNG")
 
     def process_one(self, timeout_ms=1000):
         """Receive + render one plotter; returns True if one arrived."""
@@ -53,9 +62,10 @@ class GraphicsClient(Logger):
         try:
             plotter.redraw(axes)
             os.makedirs(self.output_dir, exist_ok=True)
+            ext = "pdf" if self.pdf_mode else "png"
             path = os.path.join(
                 self.output_dir,
-                "%s.png" % plotter.name.replace(" ", "_"))
+                "%s.%s" % (plotter.name.replace(" ", "_"), ext))
             fig.savefig(path, dpi=80)
             self.rendered += 1
             self.debug("rendered %s", path)
@@ -76,9 +86,15 @@ class GraphicsClient(Logger):
 def main(argv=None):
     argv = argv or sys.argv[1:]
     if not argv:
-        print("usage: python -m veles_tpu.graphics_client tcp://host:port")
+        print("usage: python -m veles_tpu.graphics_client "
+              "tcp://host:port [output_dir]")
         return 1
-    client = GraphicsClient(argv[0])
+    client = GraphicsClient(argv[0],
+                            output_dir=argv[1] if len(argv) > 1
+                            else None)
+    import signal
+    # the reference's runtime PDF toggle: killall -SIGUSR2
+    signal.signal(signal.SIGUSR2, client.toggle_pdf)
     client.run()
     return 0
 

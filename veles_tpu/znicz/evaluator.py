@@ -97,6 +97,11 @@ class EvaluatorMSE(EvaluatorBase):
         self.mse = 0.0
         self.n_err = 0
         self.root = kwargs.get("root", True)
+        #: documented knob `mean`: True (default) keeps mean-over-batch
+        #: gradient semantics (the GD units normalize by batch); False
+        #: selects sum-over-batch — err_output is pre-scaled by the
+        #: batch size so the downstream /batch cancels
+        self.mean = kwargs.get("mean", True)
         self.demand("target")
 
     def run(self):
@@ -110,7 +115,12 @@ class EvaluatorMSE(EvaluatorBase):
         err = out - target
         self.err_output.map_invalidate()
         full = numpy.zeros(self.err_output.shape, dtype=numpy.float32)
-        full[:batch] = err.reshape((batch,) + self.err_output.shape[1:])
+        # sum semantics must cancel the GD units' divisor, which is the
+        # FULL minibatch buffer row count (gd.py uses x.shape[0]), not
+        # the short-batch valid count
+        scale = 1.0 if self.mean else float(self.err_output.shape[0])
+        full[:batch] = (err * scale).reshape(
+            (batch,) + self.err_output.shape[1:])
         self.err_output.mem = full
         # metric in float64: unnormalized activations overflow float32
         # squares long before the gradient itself is invalid
