@@ -298,3 +298,11 @@ def test_debug_pickle_names_unit_attribute(tmp_path):
     list(wf)[0].evil_callback = lambda: None
     lines = diagnose_pickle(wf, path="workflow")
     assert any("evil_callback" in line for line in lines), lines
+
+
+def test_peak_memory_printer(capsys):
+    from veles_tpu.__main__ import Main
+
+    Main.print_peak_memory()
+    err = capsys.readouterr().err
+    assert "Peak resident memory" in err and "MiB" in err

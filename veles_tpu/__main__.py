@@ -30,6 +30,9 @@ from veles_tpu.launcher import Launcher
 from veles_tpu.logger import Logger
 
 
+_peak_printer_registered = False
+
+
 class Main(Logger):
     """One CLI invocation (ref ``Main`` ``__main__.py:136``)."""
 
@@ -177,6 +180,15 @@ class Main(Logger):
         self.module.run(load, main)
 
     @staticmethod
+    def print_peak_memory():
+        """Peak RSS line, registered atexit (ref startup step 7:
+        'Peak memory usage printer is registered on program exit')."""
+        import resource
+        peak_kib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        print("Peak resident memory: %.1f MiB" % (peak_kib / 1024.0),
+              file=sys.stderr)
+
+    @staticmethod
     def _version_line():
         import jax
 
@@ -226,6 +238,11 @@ class Main(Logger):
             return 0
         if not args.no_logo:
             print(self._version_line(), file=sys.stderr)
+        global _peak_printer_registered
+        if not _peak_printer_registered:
+            _peak_printer_registered = True
+            import atexit
+            atexit.register(self.print_peak_memory)
         if args.background:
             self._daemonize()
         if args.visualize and not args.dry_run:
