@@ -14,13 +14,17 @@ from veles_tpu import prng
 from veles_tpu.znicz.fused_graph import lower_specs
 
 
-def _random_conv_stack(rng, h, w):
-    """Random conv/pool/lrn/dropout prefix that keeps spatial dims
-    >= 4, followed by a dense tail."""
+def _random_conv_stack(rng, h, w,
+                       kinds=("conv", "pool", "lrn", "dropout"),
+                       max_depth=4):
+    """Random feature prefix from ``kinds`` that keeps spatial dims
+    >= 4, followed by a dense tail.  One shape-tracking implementation
+    serves both the lowering fuzz (all kinds) and the eager-vs-fused
+    equivalence fuzz (deterministic kinds only)."""
     layers = []
-    depth = int(rng.integers(1, 4))
+    depth = int(rng.integers(1, max_depth))
     for _ in range(depth):
-        kind = rng.choice(["conv", "pool", "lrn", "dropout"])
+        kind = rng.choice(list(kinds))
         if kind == "conv" and min(h, w) >= 5:
             k = int(rng.choice([3, 5]))
             stride = int(rng.choice([1, 2]))
@@ -41,7 +45,7 @@ def _random_conv_stack(rng, h, w):
             h, w = (h - 2) // 2 + 1, (w - 2) // 2 + 1
         elif kind == "lrn":
             layers.append({"type": "lrn", "->": {}})
-        else:
+        elif kind == "dropout":
             layers.append({"type": "dropout",
                            "->": {"dropout_ratio": 0.3}})
         if min(h, w) < 4:
@@ -51,7 +55,8 @@ def _random_conv_stack(rng, h, w):
         "->": {"output_sample_shape": int(rng.choice([8, 16]))},
         "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}})
     layers.append({"type": "softmax", "->": {"output_sample_shape": 5},
-                   "<-": {"learning_rate": 0.01}})
+                   "<-": {"learning_rate": 0.01,
+                          "gradient_moment": 0.9}})
     return layers
 
 
@@ -102,3 +107,105 @@ def test_random_recurrent_stack(seed):
     params, metrics = step_fn(params, x, labels)
     assert numpy.isfinite(float(metrics["loss"]))
     assert apply_fn(params, x).shape == (5, 3)
+
+
+#: a hand-picked deep chain guaranteeing the combinations random seeds
+#: might miss: conv_tanh → avg pool → strided conv → max pool → lrn
+_DEEP_DETERMINISTIC = [
+    {"type": "conv_tanh",
+     "->": {"n_kernels": 6, "kx": 3, "ky": 3, "padding": 1},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+    {"type": "avg_pooling", "->": {"kx": 2, "ky": 2}},
+    {"type": "conv_strict_relu",
+     "->": {"n_kernels": 8, "kx": 3, "ky": 3, "sliding": (2, 2)},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+    {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+    {"type": "lrn", "->": {}},
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 5},
+     "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+]
+
+
+@pytest.mark.parametrize("seed", list(range(10)) + ["deep"])
+def test_random_stack_fused_matches_eager(seed):
+    """Equivalence fuzz: ONE eager unit-graph train step (forwards →
+    evaluator → gd chain) equals ONE fused step for a random
+    deterministic conv/pool/lrn stack — the eager hand-rule math and
+    the fused jax.grad math must agree across the zoo's combination
+    space, not just on hand-picked configs."""
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    if seed == "deep":
+        rng = numpy.random.default_rng(999)
+        h = w = 14
+        layers = [dict(s) for s in _DEEP_DETERMINISTIC]
+        seed = -1
+    else:
+        rng = numpy.random.default_rng(1000 + seed)
+        h = w = int(rng.choice([10, 12, 14]))
+        layers = _random_conv_stack(rng, h, w,
+                                    kinds=("conv", "pool", "lrn"))
+    n = 24
+    data = rng.standard_normal((n, h, w, 3)).astype(numpy.float32)
+    labels = (numpy.arange(n) % 5).astype(numpy.int32)
+
+    class L(FullBatchLoader):
+        def load_data(self):
+            self.original_data.mem = data
+            self.original_labels = [int(v) for v in labels]
+            self.class_lengths[:] = [0, 0, n]
+
+    prng.seed_all(77 + seed)
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda win: L(win, minibatch_size=n,
+                                     shuffle_limit=0),
+        layers=[{**s} for s in layers],
+        decision_config={"max_epochs": 1})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=CPUDevice())
+
+    # capture initial weights BEFORE the eager step; the fused twin
+    # seeds from them
+    specs = []
+    for spec, fwd in zip(layers, wf.forwards):
+        spec = {k: v for k, v in spec.items()}
+        if fwd.weights:
+            fwd.weights.map_read()
+            init = {"weights": numpy.array(fwd.weights.mem)}
+            if fwd.bias:
+                fwd.bias.map_read()
+                init["bias"] = numpy.array(fwd.bias.mem)
+            spec["init"] = init
+        specs.append(spec)
+
+    wf.loader.run()                      # serves the single TRAIN batch
+    for fwd in wf.forwards:
+        fwd.run()
+    wf.evaluator.run()
+    for gdu in wf.gds:
+        gdu.run()
+
+    params, step_fn, _eval, _apply = lower_specs(specs, (h, w, 3))
+    mb_x = numpy.array(wf.loader.minibatch_data.mem)
+    mb_y = numpy.array(wf.loader.minibatch_labels.mem,
+                       dtype=numpy.int32)
+    import jax
+    new_params, _m = jax.jit(step_fn)(params, mb_x, mb_y)
+    for state, fwd in zip(new_params, wf.forwards):
+        if state.get("w") is None:
+            continue
+        fwd.weights.map_read()
+        numpy.testing.assert_allclose(
+            numpy.asarray(state["w"]), fwd.weights.mem, atol=2e-4,
+            err_msg="%s (seed %d, stack %s)" % (
+                fwd.name, seed, [ly["type"] for ly in layers]))
+        if state.get("b") is not None and fwd.bias:
+            fwd.bias.map_read()
+            numpy.testing.assert_allclose(
+                numpy.asarray(state["b"]), fwd.bias.mem, atol=2e-4)
