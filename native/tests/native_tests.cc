@@ -1,0 +1,183 @@
+// Component-level C++ tests for the native runtime — the libVeles
+// test discipline (googletest suites per component under
+// libVeles/tests/) without the gtest dependency: a plain CHECK macro,
+// one section per component, nonzero exit on any failure.
+//
+//     make -C native test
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../src/engine.h"
+#include "../src/json.h"
+#include "../src/memory_optimizer.h"
+#include "../src/npy.h"
+
+namespace {
+
+int failures = 0;
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,     \
+                   #cond);                                             \
+      ++failures;                                                      \
+    }                                                                  \
+  } while (0)
+
+#define CHECK_THROWS(expr)                                             \
+  do {                                                                 \
+    bool threw = false;                                                \
+    try {                                                              \
+      (void)(expr);                                                    \
+    } catch (const std::exception&) {                                  \
+      threw = true;                                                    \
+    }                                                                  \
+    CHECK(threw);                                                      \
+  } while (0)
+
+using veles_native::Engine;
+using veles_native::JsonParser;
+using veles_native::LoadNpy;
+using veles_native::MemoryNode;
+using veles_native::MemoryOptimizer;
+
+std::vector<uint8_t> MakeNpy(const std::string& descr,
+                             const std::string& shape,
+                             const void* payload, size_t payload_len,
+                             bool fortran = false) {
+  std::string header = "{'descr': '" + descr +
+                       "', 'fortran_order': " +
+                       (fortran ? "True" : "False") +
+                       ", 'shape': " + shape + ", }";
+  // pad so magic(6)+ver(2)+len(2)+header is a multiple of 16
+  size_t base = 6 + 2 + 2;
+  size_t total = base + header.size() + 1;
+  size_t padded = (total + 15) / 16 * 16;
+  header.append(padded - base - header.size() - 1, ' ');
+  header.push_back('\n');
+  std::vector<uint8_t> out;
+  const uint8_t magic[6] = {0x93, 'N', 'U', 'M', 'P', 'Y'};
+  out.insert(out.end(), magic, magic + 6);
+  out.push_back(1);
+  out.push_back(0);
+  uint16_t hlen = static_cast<uint16_t>(header.size());
+  out.push_back(hlen & 0xff);
+  out.push_back(hlen >> 8);
+  out.insert(out.end(), header.begin(), header.end());
+  const uint8_t* p = static_cast<const uint8_t*>(payload);
+  out.insert(out.end(), p, p + payload_len);
+  return out;
+}
+
+void TestNpy() {
+  float data[6] = {1.5f, -2.0f, 0.0f, 3.25f, 4.0f, -5.5f};
+  auto blob = MakeNpy("<f4", "(2, 3)", data, sizeof(data));
+  auto arr = LoadNpy(blob.data(), blob.size());
+  CHECK(arr.shape == std::vector<int64_t>({2, 3}));
+  CHECK(arr.size() == 6);
+  for (int i = 0; i < 6; ++i) CHECK(arr.data[i] == data[i]);
+
+  // fp16 widens to f32 (the precision=16 package path)
+  uint16_t half[2] = {0x3C00, 0xC000};  // 1.0, -2.0
+  auto blob16 = MakeNpy("<f2", "(2,)", half, sizeof(half));
+  auto arr16 = LoadNpy(blob16.data(), blob16.size());
+  CHECK(arr16.data[0] == 1.0f && arr16.data[1] == -2.0f);
+
+  // int and byte dtypes convert
+  int32_t ints[3] = {-7, 0, 42};
+  auto blobi = MakeNpy("<i4", "(3,)", ints, sizeof(ints));
+  auto arri = LoadNpy(blobi.data(), blobi.size());
+  CHECK(arri.data[0] == -7.0f && arri.data[2] == 42.0f);
+
+  // fortran order and foreign endianness are rejected loudly
+  auto fblob = MakeNpy("<f4", "(2, 3)", data, sizeof(data), true);
+  CHECK_THROWS(LoadNpy(fblob.data(), fblob.size()));
+  auto bblob = MakeNpy(">f4", "(2, 3)", data, sizeof(data));
+  CHECK_THROWS(LoadNpy(bblob.data(), bblob.size()));
+  // truncated payload
+  auto tblob = MakeNpy("<f4", "(2, 3)", data, sizeof(data) - 4);
+  CHECK_THROWS(LoadNpy(tblob.data(), tblob.size()));
+}
+
+void TestJson() {
+  auto v = JsonParser::Parse(
+      "{\"name\": \"mnist\", \"n\": -3.5, \"ok\": true, "
+      "\"null\": null, \"shape\": [1, 2, 3], "
+      "\"nested\": {\"k\": \"v\\n\"}}");
+  CHECK(v->at("name")->string_value() == "mnist");
+  CHECK(v->at("n")->number == -3.5);
+  CHECK(v->at("ok")->boolean);
+  CHECK(v->at("shape")->array.size() == 3);
+  CHECK(v->at("shape")->array[2]->integer() == 3);
+  CHECK(v->at("nested")->at("k")->string_value() == "v\n");
+  CHECK(v->has("name") && !v->has("absent"));
+  CHECK_THROWS(JsonParser::Parse("{\"unterminated\": "));
+}
+
+void TestMemoryOptimizer() {
+  // chain: A overlaps B, B overlaps C, A and C are disjoint in time —
+  // A and C may share space, B must not overlap either
+  std::vector<MemoryNode> nodes(3);
+  nodes[0] = {100, 0, 1, -1};
+  nodes[1] = {200, 1, 2, -1};
+  nodes[2] = {150, 2, 3, -1};
+  int64_t total = MemoryOptimizer::Optimize(&nodes);
+  CHECK(total <= 350);  // naive sum would be 450
+  for (size_t i = 0; i < nodes.size(); ++i) {
+    CHECK(nodes[i].offset >= 0);
+    CHECK(nodes[i].offset + nodes[i].size <= total);
+    for (size_t j = i + 1; j < nodes.size(); ++j) {
+      bool time_overlap = nodes[i].time_start <= nodes[j].time_end &&
+                          nodes[j].time_start <= nodes[i].time_end;
+      bool space_overlap =
+          nodes[i].offset < nodes[j].offset + nodes[j].size &&
+          nodes[j].offset < nodes[i].offset + nodes[i].size;
+      if (time_overlap) CHECK(!space_overlap);
+    }
+  }
+
+  // all-live-at-once degenerates to sum
+  std::vector<MemoryNode> dense(4);
+  for (int i = 0; i < 4; ++i) dense[i] = {64, 0, 9, -1};
+  CHECK(MemoryOptimizer::Optimize(&dense) == 256);
+}
+
+void TestEngine() {
+  Engine engine(4);
+  CHECK(engine.workers() >= 1);
+  std::vector<int> hits(1000, 0);
+  engine.ParallelFor(1000, [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) hits[i] += 1;
+  });
+  int64_t sum = 0;
+  for (int h : hits) sum += h;
+  CHECK(sum == 1000);  // every index exactly once
+
+  // Schedule + Wait: all tasks complete before Wait returns
+  std::vector<int> done(32, 0);
+  for (int i = 0; i < 32; ++i) {
+    engine.Schedule([&done, i] { done[i] = 1; });
+  }
+  engine.Wait();
+  for (int i = 0; i < 32; ++i) CHECK(done[i] == 1);
+}
+
+}  // namespace
+
+int main() {
+  TestNpy();
+  TestJson();
+  TestMemoryOptimizer();
+  TestEngine();
+  if (failures) {
+    std::fprintf(stderr, "%d native test check(s) FAILED\n", failures);
+    return 1;
+  }
+  std::printf("native tests OK\n");
+  return 0;
+}

@@ -305,3 +305,17 @@ def test_grouped_conv_package(lib, tmp_path):
     with native.NativeWorkflow(path) as wf:
         out = wf.run(x)
         assert numpy.allclose(out, golden, atol=1e-3)
+
+
+def test_cpp_component_tests(lib):
+    """The C++ component test binary (make -C native test): npy
+    parser, JSON, liveness packing, engine thread pool — the libVeles
+    per-component googletest discipline, dependency-free."""
+    import subprocess
+
+    from veles_tpu import native as native_mod
+    result = subprocess.run(
+        ["make", "-C", native_mod._NATIVE_DIR, "test"],
+        capture_output=True, text=True)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "native tests OK" in result.stdout
