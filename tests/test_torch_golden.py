@@ -245,3 +245,42 @@ def test_grouped_conv_matches_torch():
     numpy.testing.assert_allclose(numpy.asarray(ours),
                                   _from_t(theirs), rtol=1e-4,
                                   atol=1e-5)
+
+
+def test_deconv_matches_torch_and_adjoint_relation():
+    """Deconv vs torch.nn.functional.conv_transpose2d: our transposed
+    conv applies the stored (ky, kx, C, K) kernel WITHOUT the spatial
+    flip of torch's gradient convention, so the torch twin takes the
+    flipped kernel.  Equivalently, Deconv(·; w) is the exact adjoint
+    of Conv(·; flip(w)) — an equivalent parameterization (the filter
+    is learned; a flip re-parameterizes, it does not change the
+    function class), pinned here so the convention can never drift
+    silently between XLA, the package golden model, and the native
+    engine."""
+    from veles_tpu.znicz.conv import Conv
+    from veles_tpu.znicz.misc_units import Deconv
+
+    rng = numpy.random.default_rng(29)
+    B, H, W, K, C, k, s, p = 2, 5, 5, 4, 3, 3, 2, 1
+    x = rng.standard_normal((B, H, W, K)).astype(numpy.float32)
+    w = (rng.standard_normal((k, k, C, K)) * 0.3).astype(numpy.float32)
+    w_flip = numpy.ascontiguousarray(w[::-1, ::-1])
+
+    ours = numpy.asarray(Deconv.pure({"w": w}, jnp.asarray(x),
+                                     padding=(p, p, p, p),
+                                     sliding=(s, s)))
+    tw = torch.tensor(w_flip).permute(3, 2, 0, 1)
+    theirs = torch.nn.functional.conv_transpose2d(
+        _t(x), tw, stride=s, padding=p)
+    numpy.testing.assert_allclose(ours, _from_t(theirs), rtol=1e-4,
+                                  atol=1e-5)
+
+    # adjoint identity: <Conv(y; flip(w)), x> == <y, Deconv(x; w)>
+    y = rng.standard_normal(
+        (B,) + ours.shape[1:3] + (C,)).astype(numpy.float32)
+    conv_y = numpy.asarray(Conv.pure({"w": w_flip}, jnp.asarray(y),
+                                     padding=(p, p, p, p),
+                                     sliding=(s, s)))
+    lhs = float((conv_y * x).sum())
+    rhs = float((y * ours).sum())
+    assert lhs == pytest.approx(rhs, rel=1e-4)
