@@ -579,3 +579,44 @@ def test_graphics_client_pdf_toggle(tmp_path):
     finally:
         client.stop()
         server.shutdown()
+
+
+def test_immediate_and_autohistogram_plotters(tmp_path):
+    """ImmediatePlotter (N styled curves per run) and
+    AutoHistogramPlotter (Freedman-Diaconis bins) — the last two
+    reference plotter classes."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from veles_tpu.plotting_units import (AutoHistogramPlotter,
+                                          ImmediatePlotter)
+
+    wf = DummyWorkflow()
+
+    class Holder:
+        curve = numpy.linspace(0.0, 1.0, 20)
+
+    p = ImmediatePlotter(wf, name="imm", ylim=(0, 2))
+    p.inputs = [Holder(), [10.0, 20.0, 30.0]]
+    p.input_fields = ["curve", 1]
+    p.input_styles = ["k-"]
+    p.fill()
+    assert len(p.curves) == 2
+    assert p.curves[1][0] == 20.0            # int field indexes
+    fig, axes = plt.subplots()
+    p.redraw(axes)
+    plt.close(fig)
+
+    rng = numpy.random.default_rng(0)
+    h = AutoHistogramPlotter(wf, name="auto")
+    h.input = rng.standard_normal(4000).astype(numpy.float32)
+    h.fill()
+    assert h.counts is not None
+    assert len(h.counts) >= 3
+    assert h.counts.sum() == 4000
+    # constant data degrades to the 3-bin floor, not a crash
+    h2 = AutoHistogramPlotter(wf, name="flat")
+    h2.input = numpy.ones(64, numpy.float32)
+    h2.fill()
+    assert len(h2.counts) == 3

@@ -249,6 +249,74 @@ class Histogram(Plotter):
         axes.set_title(self.name)
 
 
+class ImmediatePlotter(Plotter):
+    """N named curves on one axes, refreshed every run
+    (ref ``plotting_units.py:480``): assign ``inputs`` /
+    ``input_fields`` / ``input_styles`` before initialize; an integer
+    field indexes a sequence input, a string reads an attribute."""
+
+    DEFAULT_STYLES = ["k-", "g-", "b-"]
+
+    def __init__(self, workflow, **kwargs):
+        super(ImmediatePlotter, self).__init__(workflow, **kwargs)
+        self.inputs = []
+        self.input_fields = []
+        self.input_styles = []
+        self.ylim = kwargs.get("ylim")
+        self.curves = None
+
+    def fill(self):
+        curves = []
+        for i, field in enumerate(self.input_fields):
+            source = self.inputs[i] if i < len(self.inputs) else None
+            value = None
+            if isinstance(field, int):
+                if source is not None and 0 <= field < len(source):
+                    value = source[field]
+            elif source is not None:
+                value = getattr(source, field, None)
+            value = getattr(value, "mem", value)
+            if value is not None:
+                curves.append(numpy.asarray(value, numpy.float64)
+                              .ravel())
+        self.curves = curves
+
+    def redraw(self, axes):
+        if not self.curves:
+            return
+        if self.ylim is not None:
+            axes.set_ylim(self.ylim[0], self.ylim[1])
+        for i, series in enumerate(self.curves):
+            style = self.input_styles[i] if i < len(self.input_styles) \
+                else self.DEFAULT_STYLES[i % len(self.DEFAULT_STYLES)]
+            axes.plot(series, style)
+        axes.set_title(self.name)
+
+
+class AutoHistogramPlotter(Histogram):
+    """Histogram with Freedman–Diaconis automatic binning
+    (ref ``plotting_units.py:629``): bin width 2·IQR·n^(−1/3),
+    at least 3 bins."""
+
+    def fill(self):
+        value = getattr(self.input, self.input_field) \
+            if self.input_field else self.input
+        mem = getattr(value, "mem", value)
+        if mem is None:
+            return
+        data = numpy.asarray(mem, numpy.float64).ravel()
+        if data.size < 2:
+            return
+        iqr = (numpy.percentile(data, 75) - numpy.percentile(data, 25))
+        span = float(data.max() - data.min())
+        if iqr <= 0 or span <= 0:
+            bins = 3
+        else:
+            width = 2.0 * iqr * data.size ** (-1.0 / 3.0)
+            bins = max(int(round(span / width)), 3)
+        self.counts, self.edges = numpy.histogram(data, bins=bins)
+
+
 class MultiHistogram(Plotter):
     """Per-row histograms of a 2D tensor — per-neuron weight
     distributions (ref ``plotting_units.py:681``).  Rendered as one
