@@ -274,3 +274,47 @@ class TestEnsemble:
             EnsembleModelManager(size=2, train_ratio=1.5)
         with pytest.raises(ValueError):
             EnsembleTestManager()
+
+
+class TestGeneticExampleSample:
+    def test_module_markers_and_in_process_fitness(self):
+        """The reference's GeneticExample pattern: Range markers at
+        module level, fitness via the IResultProvider contract."""
+        import importlib
+
+        from veles_tpu.config import root
+        from veles_tpu.genetics import tune
+        import veles_tpu.samples.genetic_example as ge
+        importlib.reload(ge)
+        assert isinstance(root.test.x, Range)
+        names = [t[0] for t in tune.scan_tuneables(root.test)]
+        assert set(names) == {"x", "y"}
+
+        # in-process evaluation through the workflow contract
+        root.test.x, root.test.y = 0.33, 0.27     # the exact optimum
+        try:
+            wf = ge.TestWorkflow()
+            from veles_tpu.dummy import DummyLauncher
+            wf.launcher = DummyLauncher()
+            wf.initialize()
+            wf.run()
+            results = wf.gather_results()
+            assert results["EvaluationFitness"] == pytest.approx(0.0)
+        finally:
+            root.test.x = Range(0.0, -1.0, 1.0)
+            root.test.y = Range(0.0, -1.0, 1.0)
+
+    def test_markers_never_clobber_child_overrides(self):
+        """In a GA child the CLI override lands BEFORE the module
+        import; re-importing must keep the chromosome's value."""
+        import importlib
+
+        from veles_tpu.config import root
+        import veles_tpu.samples.genetic_example as ge
+        root.test.x = 0.4242
+        try:
+            importlib.reload(ge)
+            assert float(root.test.x) == 0.4242     # not clobbered
+            assert isinstance(root.test.y, Range)   # re-planted
+        finally:
+            root.test.x = Range(0.0, -1.0, 1.0)

@@ -359,17 +359,41 @@ class Main(Logger):
         except ImportError:
             raise SystemExit(
                 "--optimize requires veles_tpu.genetics")
+        # tuneable Range/Choice markers may live at module level in the
+        # workflow itself (the reference's GeneticExample pattern):
+        # import it so the scan sees them — harmless when the markers
+        # come from the config file instead
+        try:
+            self._load_module(self.args.workflow)
+        except Exception:
+            self.warning("could not pre-import %r for the tuneable "
+                         "scan; relying on the config file",
+                         self.args.workflow)
         size, _, generations = self.args.optimize.partition(":")
         optimizer = GeneticsOptimizer(
             workflow_spec=self.args.workflow,
             config_file=self.args.config,
             population_size=int(size),
             generations=int(generations) if generations else None,
-            result_file=self.args.result_file or None)
+            result_file=self.args.result_file or None,
+            extra_args=self._child_args())
         best = optimizer.run()
         self.info("best config: %s fitness=%s", best.config_overrides,
                   best.fitness)
         return 0
+
+    def _child_args(self):
+        """CLI args every spawned child run (GA member, ensemble
+        member) must inherit: the device, --fused, and the parent's
+        key=value overrides — a child evaluating a config the user
+        never asked for would silently skew the search."""
+        extra = []
+        if getattr(self.args, "device", None):
+            extra += ["-d", self.args.device]
+        if self.args.fused:
+            extra.append("--fused")
+        extra += list(self.args.overrides)
+        return extra
 
     def _run_ensemble(self):
         try:
@@ -384,13 +408,15 @@ class Main(Logger):
                 workflow_spec=self.args.workflow,
                 config_file=self.args.config,
                 size=int(n), train_ratio=float(ratio or 1.0),
-                result_file=self.args.result_file or None)
+                result_file=self.args.result_file or None,
+                extra_args=self._child_args())
         else:
             manager = EnsembleTestManager(
                 workflow_spec=self.args.workflow,
                 config_file=self.args.config,
                 input_file=self.args.ensemble_test,
-                result_file=self.args.result_file or None)
+                result_file=self.args.result_file or None,
+                extra_args=self._child_args())
         manager.run()
         return 0
 

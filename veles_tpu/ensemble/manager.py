@@ -24,12 +24,14 @@ from veles_tpu.logger import Logger
 
 class _EnsembleBase(Logger):
     def __init__(self, workflow_spec=None, config_file=None,
-                 result_file=None, evaluate=None):
+                 result_file=None, evaluate=None, extra_args=()):
         super(_EnsembleBase, self).__init__()
         self.workflow_spec = workflow_spec
         self.config_file = config_file
         self.result_file = result_file
         self.evaluate = evaluate   # in-process hook (tests/embedding)
+        #: CLI args every member inherits (-d, --fused, overrides)
+        self.extra_args = tuple(extra_args)
 
     def _spawn(self, overrides, extra_args=()):
         """One child training/testing run; returns its results dict
@@ -42,6 +44,7 @@ class _EnsembleBase(Logger):
             if self.config_file:
                 cmd.append(self.config_file)
             cmd.append("--result-file=%s" % result_path)
+            cmd += list(self.extra_args)
             cmd += list(extra_args)
             cmd += ["%s=%s" % (path, json.dumps(value))
                     for path, value in overrides.items()]

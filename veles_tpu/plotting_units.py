@@ -233,13 +233,19 @@ class Histogram(Plotter):
         self.edges = None
         self.demand("input")
 
-    def fill(self):
+    def _input_data(self):
+        """The linked values as a flat float array, or None."""
         value = getattr(self.input, self.input_field) \
             if self.input_field else self.input
         mem = getattr(value, "mem", value)
-        if mem is not None:
-            self.counts, self.edges = numpy.histogram(
-                numpy.asarray(mem).ravel(), bins=self.n_bins)
+        return None if mem is None else \
+            numpy.asarray(mem, numpy.float64).ravel()
+
+    def fill(self):
+        data = self._input_data()
+        if data is not None:
+            self.counts, self.edges = numpy.histogram(data,
+                                                      bins=self.n_bins)
 
     def redraw(self, axes):
         if self.counts is None:
@@ -266,6 +272,8 @@ class ImmediatePlotter(Plotter):
         self.curves = None
 
     def fill(self):
+        # positional None placeholders keep curve i paired with
+        # style i even when an earlier field fails to resolve
         curves = []
         for i, field in enumerate(self.input_fields):
             source = self.inputs[i] if i < len(self.inputs) else None
@@ -276,9 +284,9 @@ class ImmediatePlotter(Plotter):
             elif source is not None:
                 value = getattr(source, field, None)
             value = getattr(value, "mem", value)
-            if value is not None:
-                curves.append(numpy.asarray(value, numpy.float64)
-                              .ravel())
+            curves.append(
+                numpy.asarray(value, numpy.float64).ravel()
+                if value is not None else None)
         self.curves = curves
 
     def redraw(self, axes):
@@ -287,6 +295,8 @@ class ImmediatePlotter(Plotter):
         if self.ylim is not None:
             axes.set_ylim(self.ylim[0], self.ylim[1])
         for i, series in enumerate(self.curves):
+            if series is None:
+                continue
             style = self.input_styles[i] if i < len(self.input_styles) \
                 else self.DEFAULT_STYLES[i % len(self.DEFAULT_STYLES)]
             axes.plot(series, style)
@@ -296,16 +306,14 @@ class ImmediatePlotter(Plotter):
 class AutoHistogramPlotter(Histogram):
     """Histogram with Freedman–Diaconis automatic binning
     (ref ``plotting_units.py:629``): bin width 2·IQR·n^(−1/3),
-    at least 3 bins."""
+    clamped to [3, 1000] bins (one far outlier would otherwise blow
+    the bin count — and the counts allocation — up by span/IQR)."""
+
+    MAX_BINS = 1000
 
     def fill(self):
-        value = getattr(self.input, self.input_field) \
-            if self.input_field else self.input
-        mem = getattr(value, "mem", value)
-        if mem is None:
-            return
-        data = numpy.asarray(mem, numpy.float64).ravel()
-        if data.size < 2:
+        data = self._input_data()
+        if data is None or data.size < 2:
             return
         iqr = (numpy.percentile(data, 75) - numpy.percentile(data, 25))
         span = float(data.max() - data.min())
@@ -313,7 +321,7 @@ class AutoHistogramPlotter(Histogram):
             bins = 3
         else:
             width = 2.0 * iqr * data.size ** (-1.0 / 3.0)
-            bins = max(int(round(span / width)), 3)
+            bins = min(max(int(round(span / width)), 3), self.MAX_BINS)
         self.counts, self.edges = numpy.histogram(data, bins=bins)
 
 

@@ -1,0 +1,75 @@
+"""Genetic-optimization example workflow.
+
+Parity target: the one sample shipped inside the reference tree,
+``veles/samples/GeneticExample/genetics.py`` — a minimal workflow whose
+single unit computes a fitness from config ``Range`` tuneables, driven
+by ``--optimize``:
+
+    python -m veles_tpu veles_tpu.samples.genetic_example --optimize 16:10
+
+The GA minimizes ``(x − 0.33)² · (y − 0.27)²`` over
+``root.test.x/y ∈ [−1, 1]`` (fitness = −value, more is better), exactly
+the reference example's objective.
+"""
+
+from veles_tpu.config import root
+from veles_tpu.genetics import Range
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Workflow
+
+
+def _install_tuneables():
+    """Plant the Range markers — but NEVER clobber values that are
+    already set: in a GA child process the CLI overrides
+    (``root.test.x=0.42``) are applied BEFORE this module is imported,
+    and re-installing the markers would erase the chromosome.  An
+    auto-vivified EMPTY Config node (someone merely READ the key)
+    counts as unset."""
+    from veles_tpu.config import Config
+    for key, marker in (("x", Range(0.0, -1.0, 1.0)),
+                        ("y", Range(0.0, -1.0, 1.0))):
+        current = root.test.get(key, None)
+        if current is None or (isinstance(current, Config)
+                               and not vars(current)):
+            setattr(root.test, key, marker)
+
+
+_install_tuneables()
+
+
+class Optimizer(Unit):
+    """Computes the fitness value from the decoded config tuneables
+    (the reference's ``IResultProvider`` contract: metric name
+    ``EvaluationFitness``)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Optimizer, self).__init__(workflow, **kwargs)
+        self.fitness = 0.0
+
+    def run(self):
+        x = float(root.test.x)
+        y = float(root.test.y)
+        value = (x - 0.33) ** 2 * (y - 0.27) ** 2
+        self.fitness = -value            # GA maximizes; we minimize
+
+    def get_metric_names(self):
+        return {"EvaluationFitness"}
+
+    def get_metric_values(self):
+        return {"EvaluationFitness": self.fitness}
+
+
+class TestWorkflow(Workflow):
+    """One fitness evaluation per run."""
+
+    def __init__(self, workflow=None, **kwargs):
+        super(TestWorkflow, self).__init__(workflow, **kwargs)
+        self.optimizer = Optimizer(self)
+        self.optimizer.link_from(self.start_point)
+        self.end_point.link_from(self.optimizer)
+
+
+def run(load, main):
+    """Reference entry-point convention (``run(load, main)``)."""
+    load(TestWorkflow)
+    main()
