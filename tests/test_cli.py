@@ -306,3 +306,13 @@ def test_peak_memory_printer(capsys):
     Main.print_peak_memory()
     err = capsys.readouterr().err
     assert "Peak resident memory" in err and "MiB" in err
+
+
+def test_html_help_writes_reference(capsys):
+    from veles_tpu.__main__ import Main
+
+    assert Main(["--html-help"]).run() == 0
+    out = capsys.readouterr().out
+    path = out.strip().rsplit(" ", 1)[-1]
+    html = open(path).read()
+    assert "--optimize" in html and "<" in html

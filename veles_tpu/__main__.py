@@ -236,6 +236,16 @@ class Main(Logger):
         if args.version:
             print(self._version_line())
             return 0
+        if args.html_help:
+            import tempfile
+
+            from veles_tpu.scripts.generate_frontend import generate
+            fd, path = tempfile.mkstemp(suffix=".html",
+                                        prefix="veles_tpu_help_")
+            with os.fdopen(fd, "w") as fout:
+                fout.write(generate())
+            print("argument reference written to %s" % path)
+            return 0
         if not args.no_logo:
             print(self._version_line(), file=sys.stderr)
         global _peak_printer_registered

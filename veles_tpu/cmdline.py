@@ -103,6 +103,11 @@ def make_parser(prog="veles_tpu", description=None):
         help="on a failed snapshot pickle, walk the workflow and name "
              "the offending attribute (ref cmdline.py:158)")
     parser.add_argument(
+        "--html-help", action="store_true",
+        help="write the full argument reference as an HTML page and "
+             "print its path (the reference opened it in a browser; "
+             "this image is headless — ref cmdline.py:146)")
+    parser.add_argument(
         "-r", "--random-seed", default=None,
         help="seed for the named PRNG streams (int, or path[:dtype:count] "
              "to a seed file; ref prng/random_generator.py:106)")
