@@ -596,7 +596,7 @@ def main():
         # An explicit BENCH_STAGES selection overrides the skip (the
         # operator asked for those stages, e.g. a tiny-config smoke).
         order = ("mnist_e2e", "mnist_wf", "ae", "kohonen", "lstm",
-                 "mnist")
+                 "mnist_bf16", "mnist")
     ladder = [n for n in order if not only or n in only]
     for name in ladder:
         _fn, cap = STAGES[name]
