@@ -449,6 +449,63 @@ def stage_transformer():
     _emit(name, sec, batch * cfg["seq_len"], flops)
 
 
+#: the reference DB's fastest recorded matmul: GTX TITAN, float,
+#: precision 0 — 0.1642 s for ONE 3001² matmul (``backends.py:672-731``
+#: stores dt/repeats of DeviceBenchmark(size=3001)), i.e. a measured
+#: rate of 2·3001³/0.1642 ≈ 329 GFLOP/s.  The one absolute throughput
+#: number the reference publishes (BASELINE.md row 8).
+TITAN_MATMUL_GFLOPS = 2.0 * 3001.0 ** 3 / 0.1642 / 1e9
+
+#: sustained-rate ratios vs a 2013 GPU decompose as ~42× hardware
+#: (197 TFLOP/s bf16 vs 4.7 TFLOP/s fp32 peak) × the software
+#: efficiency gap (TITAN measured 7 % of its peak through the OpenCL
+#: tiling; the chip sustains ~98 % through XLA) — so the honest ceiling
+#: is far above MAX_VS_BASELINE's throughput-ratio calibration
+MAX_POWER_RATIO = 5000.0
+
+
+def stage_power():
+    """The reference's OWN in-situ rating workload — the 13× chained
+    square matmul, min-of-runs (``accelerated_units.py:706-825``,
+    ``ocl/benchmark.cl:1-11``) — reported as a sustained GFLOP/s rate
+    and compared RATE-vs-RATE against the fastest entry in the
+    reference's shipped DB (GTX TITAN ≈ 329 GFLOP/s fp32; see
+    ``TITAN_MATMUL_GFLOPS``)."""
+    from veles_tpu.ops.benchmark import (BENCH_CHAIN, BENCH_SIZE,
+                                         estimate_device_power)
+
+    kind = _device_kind()
+    sec, gflops = estimate_device_power()
+    peak = _peak_flops(kind)
+    flops = 2.0 * BENCH_CHAIN * float(BENCH_SIZE) ** 3
+    if sec <= 0 or (peak and flops / sec > peak * 1.05):
+        print(json.dumps({
+            "metric": "Device power rating (13x4096^3 bf16 chain)",
+            "value": 0.0, "unit": "GFLOP/s", "vs_baseline": None,
+            "error": "timing failed physics check: %.3e s/chain"
+                     % sec, "device_kind": kind}))
+        return
+    vs = gflops / TITAN_MATMUL_GFLOPS
+    if not 0.0 < vs <= MAX_POWER_RATIO:
+        print(json.dumps({
+            "metric": "Device power rating (13x4096^3 bf16 chain)",
+            "value": 0.0, "unit": "GFLOP/s", "vs_baseline": None,
+            "error": "vs_baseline %.1f outside (0, %.0f]"
+                     % (vs, MAX_POWER_RATIO),
+            "device_kind": kind}))
+        return
+    print(json.dumps({
+        "metric": "Device power rating (13x4096^3 bf16 chain)",
+        "value": round(gflops, 1), "unit": "GFLOP/s",
+        "vs_baseline": round(vs, 2),
+        "sec_per_chain": round(sec, 6),
+        "baseline": "GTX TITAN float P0, 3001^2 matmul in 0.1642 s "
+                    "= %.0f GFLOP/s (reference devices/"
+                    "device_infos.json) — rate-vs-rate comparison"
+                    % TITAN_MATMUL_GFLOPS,
+        "device_kind": kind}))
+
+
 def stage_alexnet():
     from veles_tpu.samples import alexnet
     batch = int(os.environ.get("BENCH_ALEXNET_BATCH", "256"))
@@ -474,6 +531,7 @@ STAGES = {
     "kohonen": (stage_kohonen, 150),
     "lstm": (stage_lstm, 180),
     "transformer": (stage_transformer, 240),
+    "power": (stage_power, 240),
     "alexnet": (stage_alexnet, 600),
 }
 
@@ -587,7 +645,7 @@ def main():
     # allowed to hang) inside remaining() minus a headline reserve.
     order = ("mnist", "mnist_bf16", "mnist_e2e", "mnist_wf", "cifar",
              "ae",
-             "kohonen", "lstm", "transformer", "alexnet")
+             "kohonen", "lstm", "transformer", "power", "alexnet")
     if env and not only:
         # CPU fallback (rehearsed with a wedged tunnel): the conv/LM
         # heavies cannot finish on CPU inside their caps — skip them

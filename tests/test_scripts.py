@@ -189,3 +189,35 @@ class TestBBoxer:
         with urllib.request.urlopen(base + "/image/img.png") as resp:
             assert resp.read().startswith(b"\x89PNG")
         state["ioloop"].add_callback(state["ioloop"].stop)
+
+
+def test_bench_power_stage_vs_titan(monkeypatch, capsys):
+    """The power stage reports the reference-anchored chain-time ratio
+    (GTX TITAN float P0, 0.1642 s — the one absolute throughput number
+    the reference ships) and refuses physically impossible timings."""
+    import json
+
+    import bench
+
+    monkeypatch.setattr(bench, "_device_kind", lambda: "TPU v5 lite")
+    monkeypatch.setattr(bench, "_peak_flops", lambda kind: 197e12)
+    from veles_tpu.ops import benchmark as B
+
+    # healthy: ~9.3 ms/chain = ~193 TFLOP/s; TITAN's recorded matmul
+    # rate is 2*3001^3/0.1642 = 329 GFLOP/s -> rate ratio ~586
+    monkeypatch.setattr(B, "estimate_device_power",
+                        lambda: (0.00926, 192963.0))
+    bench.stage_power()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["vs_baseline"] == pytest.approx(
+        192963.0 / bench.TITAN_MATMUL_GFLOPS, rel=1e-3)
+    assert 500 < line["vs_baseline"] < 700
+    assert line["value"] == pytest.approx(192963.0)
+    assert "rate-vs-rate" in line["baseline"]
+
+    # faster than the chip's peak: refused, never published
+    monkeypatch.setattr(B, "estimate_device_power",
+                        lambda: (0.004, 447000.0))
+    bench.stage_power()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["vs_baseline"] is None and "physics" in line["error"]
