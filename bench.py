@@ -477,10 +477,13 @@ def stage_power():
     kind = _device_kind()
     sec, gflops = estimate_device_power()
     peak = _peak_flops(kind)
-    flops = 2.0 * BENCH_CHAIN * float(BENCH_SIZE) ** 3
-    if sec <= 0 or (peak and flops / sec > peak * 1.05):
+    label = ("Device power rating (%dx%d^3 bf16 chain)"
+             % (BENCH_CHAIN, BENCH_SIZE))
+    # gflops IS the chain's sustained rate for these same constants, so
+    # the physics gate needs no second flops derivation
+    if sec <= 0 or (peak and gflops * 1e9 > peak * 1.05):
         print(json.dumps({
-            "metric": "Device power rating (13x4096^3 bf16 chain)",
+            "metric": label,
             "value": 0.0, "unit": "GFLOP/s", "vs_baseline": None,
             "error": "timing failed physics check: %.3e s/chain"
                      % sec, "device_kind": kind}))
@@ -488,14 +491,14 @@ def stage_power():
     vs = gflops / TITAN_MATMUL_GFLOPS
     if not 0.0 < vs <= MAX_POWER_RATIO:
         print(json.dumps({
-            "metric": "Device power rating (13x4096^3 bf16 chain)",
+            "metric": label,
             "value": 0.0, "unit": "GFLOP/s", "vs_baseline": None,
             "error": "vs_baseline %.1f outside (0, %.0f]"
                      % (vs, MAX_POWER_RATIO),
             "device_kind": kind}))
         return
     print(json.dumps({
-        "metric": "Device power rating (13x4096^3 bf16 chain)",
+        "metric": label,
         "value": round(gflops, 1), "unit": "GFLOP/s",
         "vs_baseline": round(vs, 2),
         "sec_per_chain": round(sec, 6),
@@ -548,12 +551,12 @@ def _run_stage(name, timeout, env=None):
     var's back at interpreter start)."""
     full_env = dict(os.environ)
     # persistent XLA compilation cache: stage reruns (and future bench
-    # rounds on the same machine) skip the 20-40s first-compile cost
-    cache_dir = os.path.join(os.path.expanduser("~"), ".veles_tpu",
-                             "cache", "xla")
+    # rounds on the same machine) skip the minutes-long first compiles
+    from veles_tpu.backends import COMPILE_CACHE_DIR
     try:
-        os.makedirs(cache_dir, exist_ok=True)
-        full_env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+        os.makedirs(COMPILE_CACHE_DIR, exist_ok=True)
+        full_env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                            COMPILE_CACHE_DIR)
     except OSError:
         pass
     if env:
@@ -602,6 +605,20 @@ def _run_stage(name, timeout, env=None):
 def main():
     budget = float(os.environ.get("BENCH_BUDGET_SEC", "1200"))
     deadline = time.monotonic() + budget
+    # r4 live-window finding: chip claims + matmul compiles are fast
+    # (~1 min/stage) but CONV-model first compiles blow the default
+    # per-stage caps.  BENCH_TIMEOUT_SCALE stretches every stage cap
+    # (probe included — slow windows slow the claim too) and the
+    # headline reserve, without touching the calibrated defaults; the
+    # compile cache then makes re-runs cheap again.
+    try:
+        scale = float(os.environ.get("BENCH_TIMEOUT_SCALE", "1"))
+    except ValueError:
+        print("BENCH_TIMEOUT_SCALE: not a number, using 1",
+              file=sys.stderr)
+        scale = 1.0
+    if scale <= 0:
+        scale = 1.0
     only = os.environ.get("BENCH_STAGES")
     only = ({s.strip() for s in only.split(",")} if only else None)
     if only:
@@ -618,7 +635,7 @@ def main():
     env = {}
     if os.environ.get("BENCH_FORCE_CPU"):
         env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
-    cap = min(STAGES["probe"][1], max(30.0, remaining()))
+    cap = min(STAGES["probe"][1] * scale, max(30.0, remaining()))
     probe, err = _run_stage("probe", cap, env=env)
     if probe is None:
         print("probe failed (%s); falling back to CPU" % err,
@@ -658,8 +675,13 @@ def main():
     ladder = [n for n in order if not only or n in only]
     for name in ladder:
         _fn, cap = STAGES[name]
-        reserve = 300 if name != "alexnet" and "alexnet" in ladder \
-            else 0
+        cap *= scale
+        # the scaled reserve protects the AlexNet headline, but may
+        # never eat the whole budget of a small explicit-BENCH_STAGES
+        # run (e.g. the post-sweep re-bench) — cap it at 40 % so the
+        # other requested stages still get headroom
+        reserve = min(300 * scale, 0.4 * budget) \
+            if name != "alexnet" and "alexnet" in ladder else 0
         headroom = remaining() - reserve
         if headroom < 45:
             print("budget: skipping %s to protect the headline stage"

@@ -47,6 +47,41 @@ PEAK_BF16_FLOPS = (
 )
 
 
+_compile_cache_enabled = False
+
+#: one cache location for every tool (devices, bench parent, the chip
+#: session shell keeps a matching literal) — splitting it re-pays the
+#: minutes-long conv first-compiles the cache exists to avoid
+COMPILE_CACHE_DIR = os.path.join(os.path.expanduser("~"), ".veles_tpu",
+                                 "cache", "xla")
+
+
+def enable_compilation_cache():
+    """Point XLA's persistent executable cache at a per-user directory.
+
+    The TPU analogue of the reference's kernel binary cache keyed on
+    source SHA + defines (``accelerated_units.py:605-674``): conv-model
+    first compiles over the tunnel run for minutes, so every tool that
+    compiles through this framework (devices, the timing harness, the
+    autotuner, the profiler) shares one on-disk cache and pays each
+    compile once per machine.  ``JAX_COMPILATION_CACHE_DIR`` overrides
+    the location.  Safe to call any number of times, before or after
+    backend init (only programs compiled afterwards are cached).
+    """
+    global _compile_cache_enabled
+    if _compile_cache_enabled:
+        return
+    _compile_cache_enabled = True
+    path = (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            or COMPILE_CACHE_DIR)
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+    except (OSError, AttributeError, ValueError):
+        _compile_cache_enabled = False
+
+
 def peak_bf16_flops(device_kind):
     """Peak dense bf16 FLOP/s for a jax device kind, or None."""
     kind = (device_kind or "").lower()
@@ -181,6 +216,7 @@ class _JaxDevice(Device):
 
     def __init__(self, **kwargs):
         import jax
+        enable_compilation_cache()
         self._jax_devices = list(kwargs.pop("devices", ()))
         if not self._jax_devices:
             try:

@@ -141,6 +141,9 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     import jax
+
+    from veles_tpu.backends import enable_compilation_cache
+    enable_compilation_cache()
     kind = jax.devices()[0].device_kind
     (params, step, apply_fn, x, labels,
      flops_overrides) = build(args.sample, args.batch)
