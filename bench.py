@@ -543,12 +543,14 @@ STAGES = {
 # parent orchestration
 # --------------------------------------------------------------------------
 
-def _run_stage(name, timeout, env=None):
+def _run_stage(name, timeout, env=None, grace=300):
     """Run a ladder stage in a subprocess; returns (parsed_json|None,
     reason).  ``env`` overrides os.environ; a value of None REMOVES the
     variable (needed to truly disable a sitecustomize-registered TPU
     tunnel platform, which overrides ``jax_platforms`` behind the env
-    var's back at interpreter start)."""
+    var's back at interpreter start).  ``grace`` bounds the SIGTERM
+    wait on timeout — callers shrink it when the remaining budget is
+    earmarked for the headline stage."""
     full_env = dict(os.environ)
     # persistent XLA compilation cache: stage reruns (and future bench
     # rounds on the same machine) skip the minutes-long first compiles
@@ -571,12 +573,15 @@ def _run_stage(name, timeout, env=None):
         env=full_env,
         cwd=os.path.dirname(os.path.abspath(__file__)))
     def reap():
-        # SIGTERM first and give the JAX client a grace period to
-        # release its chip claim — a SIGKILL mid-claim has been
-        # observed to wedge the tunnel relay for hours
+        # SIGTERM first and give the JAX client a LONG grace period to
+        # release its chip claim: a client mid-compile takes minutes to
+        # unwind, and a SIGKILL mid-claim wedges the tunnel relay for
+        # hours (observed twice in r3; r4's first window died exactly
+        # this way when the alexnet stage was killed mid-compile).
+        # Losing 5 min of ladder beats losing the rest of the window.
         proc.terminate()
         try:
-            proc.communicate(timeout=20)
+            proc.communicate(timeout=max(20, grace))
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.communicate()
@@ -690,7 +695,12 @@ def main():
             if reserve:
                 continue
             break
-        result, err = _run_stage(name, min(cap, headroom), env=env)
+        # a reap after a timeout may only burn budget the reserve does
+        # NOT earmark for the headline stage
+        stage_cap = min(cap, headroom)
+        result, err = _run_stage(
+            name, stage_cap, env=env,
+            grace=min(300, max(20, headroom - stage_cap)))
         if result is None:
             print("stage %s failed: %s" % (name, err), file=sys.stderr)
             continue

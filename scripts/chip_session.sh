@@ -17,13 +17,23 @@ mkdir -p "$OUT"
 # truth: backends.COMPILE_CACHE_DIR): conv-model first compiles over
 # the tunnel run for minutes, pay each exactly once
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-$(python -c \
-    'from veles_tpu.backends import COMPILE_CACHE_DIR; print(COMPILE_CACHE_DIR)')}
+    'from veles_tpu.backends import COMPILE_CACHE_DIR; print(COMPILE_CACHE_DIR)' \
+    2>/dev/null || echo "$HOME/.veles_tpu/cache/xla")}
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 # r4 live-window calibration: conv stages need ~3-4x the default caps.
-# Budgets scale with it; float-safe (bash $((...)) is integer-only)
+# Budgets scale with it; float-safe (bash $((...)) is integer-only) and
+# garbage scale values fall back to the calibrated 4x, like bench.py's
+# own guard
 export BENCH_TIMEOUT_SCALE=${BENCH_TIMEOUT_SCALE:-4}
-scaled() { python -c "import sys; print(int(float(sys.argv[1]) * float(sys.argv[2])))" \
-    "$1" "$BENCH_TIMEOUT_SCALE"; }
+scaled() { python - "$1" "$BENCH_TIMEOUT_SCALE" <<'PY'
+import sys
+try:
+    s = float(sys.argv[2])
+except ValueError:
+    s = 4.0
+print(int(float(sys.argv[1]) * (s if s > 0 else 4.0)))
+PY
+}
 
 note() { echo "[chip_session $(date +%H:%M:%S)] $*" >&2; }
 

@@ -620,3 +620,107 @@ def test_immediate_and_autohistogram_plotters(tmp_path):
     h2.input = numpy.ones(64, numpy.float32)
     h2.fill()
     assert len(h2.counts) == 3
+
+
+def test_udp_multicast_frame_roundtrip():
+    """The stdlib multicast transport (the reference's epgm lab-wide
+    plot broadcast, ``graphics_server.py:100-110``, rebuilt over plain
+    UDP): single-chunk and multi-chunk frames survive the group."""
+    import pytest
+
+    from veles_tpu.multicast import CHUNK, McastReceiver, McastSender
+
+    endpoint = "udp://239.255.42.99:15995"
+    try:
+        recv = McastReceiver(endpoint, interface="127.0.0.1")
+        send = McastSender(endpoint, interface="127.0.0.1")
+    except OSError as exc:
+        pytest.skip("multicast unavailable in this sandbox: %s" % exc)
+    try:
+        send.send(b"small-frame")
+        got = recv.recv_frame(timeout=5.0)
+        if got is None:
+            pytest.skip("multicast datagrams not looped back here")
+        assert got == b"small-frame"
+        big = bytes(range(256)) * 1024  # 256 KiB -> 5 chunks
+        assert len(big) > 4 * CHUNK
+        send.send(big)
+        assert recv.recv_frame(timeout=5.0) == big
+    finally:
+        send.close()
+        recv.close()
+
+
+def test_udp_multicast_graphics_end_to_end(tmp_path):
+    """GraphicsServer publishes over udp:// alongside tcp and a
+    GraphicsClient subscribed to the group renders the plotter."""
+    import pytest
+
+    from veles_tpu.graphics_client import GraphicsClient
+    from veles_tpu.graphics_server import GraphicsServer
+    from veles_tpu.multicast import McastReceiver
+
+    endpoint = "udp://239.255.42.99:15996"
+    try:
+        probe = McastReceiver(endpoint, interface="127.0.0.1")
+    except OSError as exc:
+        pytest.skip("multicast unavailable in this sandbox: %s" % exc)
+    server = GraphicsServer(multicast=endpoint)
+    client = None
+    try:
+        if server._mcast is None:
+            pytest.skip("server could not open the multicast endpoint")
+        server._mcast._sock.setsockopt(
+            __import__("socket").IPPROTO_IP,
+            __import__("socket").IP_MULTICAST_IF,
+            __import__("socket").inet_aton("127.0.0.1"))
+        server.send(b"probe")
+        if probe.recv_frame(timeout=5.0) is None:
+            pytest.skip("multicast datagrams not looped back here")
+        client = GraphicsClient(endpoint, output_dir=str(tmp_path))
+
+        from veles_tpu.plotting_units import AccumulatingPlotter
+        from veles_tpu.dummy import DummyWorkflow
+        wf = DummyWorkflow()
+        plotter = AccumulatingPlotter(wf, name="mcast test")
+        plotter.input = 0.5
+        plotter.fill()
+        server.enqueue(plotter)
+        assert client.process_one(timeout_ms=5000)
+        assert client.rendered == 1
+    finally:
+        probe.close()
+        if client is not None:
+            client.stop()
+        server.shutdown()
+
+
+def test_udp_multicast_two_senders_do_not_interleave():
+    """Chunks are keyed by sender, so two publishers (the reference's
+    many-masters lab scenario) can share a group without corrupting
+    each other's frames — and a sender restart reusing frame ids with
+    a different chunk count starts a clean reassembly."""
+    import pytest
+
+    from veles_tpu.multicast import CHUNK, McastReceiver, McastSender
+
+    endpoint = "udp://127.0.0.1;239.255.42.99:16000"
+    try:
+        recv = McastReceiver(endpoint)
+        a = McastSender(endpoint)
+        b = McastSender(endpoint)
+    except OSError as exc:
+        pytest.skip("multicast unavailable in this sandbox: %s" % exc)
+    try:
+        frame_a = b"A" * (2 * CHUNK + 100)   # 3 chunks
+        frame_b = b"B" * (CHUNK + 100)       # 2 chunks, same frame_id=1
+        a.send(frame_a)
+        b.send(frame_b)
+        got = [recv.recv_frame(timeout=5.0) for _ in range(2)]
+        if got[0] is None:
+            pytest.skip("multicast datagrams not looped back here")
+        assert sorted(g for g in got if g) == sorted([frame_a, frame_b])
+    finally:
+        a.close()
+        b.close()
+        recv.close()

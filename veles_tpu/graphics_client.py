@@ -21,15 +21,23 @@ from veles_tpu.logger import Logger
 class GraphicsClient(Logger):
     def __init__(self, endpoint, output_dir=None, pdf=False):
         super(GraphicsClient, self).__init__()
-        import zmq
         self.endpoint = endpoint
         self.output_dir = output_dir or root.common.dirs.get("results")
         #: PDF mode (ref graphics doc: SIGUSR2 toggles it at runtime)
         self.pdf_mode = bool(pdf)
-        self._context = zmq.Context.instance()
-        self._socket = self._context.socket(zmq.SUB)
-        self._socket.connect(endpoint)
-        self._socket.setsockopt(zmq.SUBSCRIBE, b"")
+        if endpoint.startswith("udp://"):
+            # lab-wide multicast viewer (the reference's epgm
+            # subscriber role) — stdlib transport, no broker
+            from veles_tpu.multicast import McastReceiver
+            self._mcast = McastReceiver(endpoint)
+            self._socket = None
+        else:
+            import zmq
+            self._mcast = None
+            self._context = zmq.Context.instance()
+            self._socket = self._context.socket(zmq.SUB)
+            self._socket.connect(endpoint)
+            self._socket.setsockopt(zmq.SUBSCRIBE, b"")
         self._stop = threading.Event()
         self.rendered = 0
 
@@ -42,10 +50,14 @@ class GraphicsClient(Logger):
 
     def process_one(self, timeout_ms=1000):
         """Receive + render one plotter; returns True if one arrived."""
-        import zmq
-        if not self._socket.poll(timeout_ms):
-            return False
-        blob = self._socket.recv()
+        if self._mcast is not None:
+            blob = self._mcast.recv_frame(timeout=timeout_ms / 1000.0)
+            if blob is None:
+                return False
+        else:
+            if not self._socket.poll(timeout_ms):
+                return False
+            blob = self._socket.recv()
         try:
             plotter = pickle.loads(blob)
         except Exception:
@@ -80,7 +92,10 @@ class GraphicsClient(Logger):
 
     def stop(self):
         self._stop.set()
-        self._socket.close(linger=0)
+        if self._mcast is not None:
+            self._mcast.close()
+        else:
+            self._socket.close(linger=0)
 
 
 def main(argv=None):

@@ -5,10 +5,12 @@ units pickle themselves onto a ZeroMQ PUB socket; one or more separate
 ``GraphicsClient`` processes subscribe and render with matplotlib.  The
 reference additionally binds an ``epgm://`` multicast endpoint
 (``graphics_server.py:100-110``) so a whole lab can watch one training
-run; :class:`GraphicsServer` accepts the same via ``multicast=`` (ZeroMQ
-``epgm://interface;group:port`` / ``pgm://``), degrading gracefully when
-libzmq lacks OpenPGM — the tcp endpoint always works and viewers
-attach/detach at will without ever blocking training.
+run; :class:`GraphicsServer` accepts the same via ``multicast=``:
+``udp://GROUP:PORT`` uses the stdlib chunked-datagram transport
+(:mod:`veles_tpu.multicast` — always available), while ZeroMQ schemes
+(``epgm://interface;group:port`` / ``pgm://``) are passed to libzmq and
+degrade gracefully when it lacks OpenPGM — the tcp endpoint always
+works and viewers attach/detach at will without ever blocking training.
 """
 
 import pickle
@@ -38,13 +40,20 @@ class GraphicsServer(Logger):
         if multicast is None:
             from veles_tpu.config import root
             multicast = root.common.graphics.get("multicast", None)
+        self._mcast = None
         if multicast:
-            # the reference's lab-wide broadcast (epgm multicast);
-            # PUB sockets bind any number of transports, so this rides
-            # alongside tcp — and a libzmq built without OpenPGM (or a
-            # bad group spec) must never take training down
+            # the reference's lab-wide broadcast (epgm multicast,
+            # graphics_server.py:100-110).  udp://GROUP:PORT uses the
+            # stdlib chunked-datagram transport (multicast.py — always
+            # available); any other scheme is handed to libzmq (epgm
+            # works iff built with OpenPGM).  Either way a bad group
+            # spec must never take training down.
             try:
-                self._socket.bind(multicast)
+                if multicast.startswith("udp://"):
+                    from veles_tpu.multicast import McastSender
+                    self._mcast = McastSender(multicast)
+                else:
+                    self._socket.bind(multicast)
                 self.endpoints.append(multicast)
                 self.info("plot multicast on %s", multicast)
             except Exception as exc:
@@ -85,6 +94,27 @@ class GraphicsServer(Logger):
         not be shared across threads without a guard)."""
         with self._send_lock:
             self._socket.send(blob)
+            if self._mcast is not None:
+                # best-effort contract: a transient error (ENOBUFS
+                # under a datagram burst, an interface flap) drops ONE
+                # frame; only a persistent failure streak disables the
+                # transport for the run
+                try:
+                    self._mcast.send(blob)
+                    self._mcast_failures = 0
+                except OSError as exc:
+                    self._mcast_failures = getattr(
+                        self, "_mcast_failures", 0) + 1
+                    if self._mcast_failures >= 25:
+                        self.warning(
+                            "multicast send failed %d times in a row "
+                            "(%s) — disabling multicast",
+                            self._mcast_failures, exc)
+                        self._mcast.close()
+                        self._mcast = None
+                    elif self._mcast_failures == 1:
+                        self.warning("multicast send failed (%s) — "
+                                     "frame dropped", exc)
 
     def enqueue(self, plotter):
         """Serialize + publish synchronously (viewer re-runs
@@ -97,5 +127,8 @@ class GraphicsServer(Logger):
         global _instance
         with _instance_lock:
             self._socket.close(linger=0)
+            if self._mcast is not None:
+                self._mcast.close()
+                self._mcast = None
             if _instance is self:
                 _instance = None

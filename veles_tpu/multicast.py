@@ -1,0 +1,180 @@
+"""UDP-multicast plot transport: lab-wide broadcast with zero deps.
+
+Parity target: the reference binds an OpenPGM multicast endpoint on its
+plot PUB socket so any number of viewers across a LAN can watch one
+training run without per-viewer connections
+(``veles/graphics_server.py:100-110``, ``rndepgm://`` binds
+``txzmq/connection.py:589-612``).  libzmq in this image is built
+without OpenPGM, so ``epgm://`` binds fail; this module provides the
+same capability over plain UDP multicast from the stdlib — always
+available, viewers join/leave freely, and a lost datagram loses one
+plot frame, never training (the same best-effort contract PGM gave the
+reference).
+
+Endpoint syntax: ``udp://GROUP:PORT`` or ``udp://IFACE;GROUP:PORT``
+(the reference's ``epgm://interface;group:port`` shape) with GROUP an
+IPv4 multicast group (224.0.0.0/4) and IFACE the local address whose
+interface should carry the traffic, e.g.
+``udp://239.255.42.99:5005`` or ``udp://127.0.0.1;239.255.42.99:5005``.
+
+Wire format: pickled plot frames can exceed a UDP datagram, so each
+frame is chunked; every datagram is
+
+    b"VPLT" | frame_id u32 | chunk_idx u16 | n_chunks u16 | payload
+
+with network byte order.  The receiver reassembles per frame_id and
+drops stale partial frames — exactly the drop-late-frames semantics a
+live plot wants.
+"""
+
+import socket
+import struct
+import time
+
+MAGIC = b"VPLT"
+_HEADER = struct.Struct("!4sIHH")
+#: payload per datagram; total stays under the 65507 UDP maximum and
+#: within common default socket buffers
+CHUNK = 60000
+
+
+def parse_udp(endpoint):
+    """``udp://[IFACE;]GROUP:PORT`` -> (group, port, iface_or_None);
+    raises ValueError on anything else (callers fall back to other
+    transports)."""
+    if not endpoint.startswith("udp://"):
+        raise ValueError("not a udp:// endpoint: %r" % (endpoint,))
+    rest = endpoint[len("udp://"):]
+    iface, sep, tail = rest.partition(";")
+    if not sep:
+        iface, tail = None, rest
+    group, sep, port = tail.rpartition(":")
+    if not sep or not group:
+        raise ValueError("udp:// endpoint needs GROUP:PORT: %r"
+                         % (endpoint,))
+    port = int(port)
+    first = int(group.split(".", 1)[0])
+    if not 224 <= first <= 239:
+        raise ValueError("%r is not an IPv4 multicast group" % (group,))
+    return group, port, iface
+
+
+class McastSender(object):
+    """Chunking multicast publisher for one ``udp://`` endpoint."""
+
+    def __init__(self, endpoint, ttl=1, loop=True, interface=None):
+        self.group, self.port, ep_iface = parse_udp(endpoint)
+        interface = interface or ep_iface
+        self.endpoint = endpoint
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setsockopt(socket.IPPROTO_IP, socket.IP_MULTICAST_TTL,
+                              ttl)
+        # loop=True lets same-host viewers (and the tests) receive
+        self._sock.setsockopt(socket.IPPROTO_IP,
+                              socket.IP_MULTICAST_LOOP, 1 if loop else 0)
+        if interface:
+            self._sock.setsockopt(
+                socket.IPPROTO_IP, socket.IP_MULTICAST_IF,
+                socket.inet_aton(interface))
+        self._frame_id = 0
+
+    def send(self, blob):
+        """Broadcast one frame (any bytes); best-effort, never raises
+        into the training loop for transient network errors."""
+        self._frame_id = (self._frame_id + 1) & 0xFFFFFFFF
+        n_chunks = max(1, (len(blob) + CHUNK - 1) // CHUNK)
+        if n_chunks > 0xFFFF:
+            raise ValueError("frame too large for the chunk header "
+                             "(%d bytes)" % len(blob))
+        for idx in range(n_chunks):
+            part = blob[idx * CHUNK:(idx + 1) * CHUNK]
+            datagram = _HEADER.pack(MAGIC, self._frame_id, idx,
+                                    n_chunks) + part
+            self._sock.sendto(datagram, (self.group, self.port))
+
+    def close(self):
+        self._sock.close()
+
+
+class McastReceiver(object):
+    """Reassembling multicast subscriber for one ``udp://`` endpoint."""
+
+    def __init__(self, endpoint, interface=None):
+        self.group, self.port, ep_iface = parse_udp(endpoint)
+        interface = interface or ep_iface
+        self.endpoint = endpoint
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # a burst of chunked frames (60 KB datagrams back-to-back)
+        # overflows the default receive buffer — ask for 4 MB (the
+        # kernel clamps to rmem_max; partial grants still help)
+        try:
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                  4 << 20)
+        except OSError:
+            pass
+        self._sock.bind(("", self.port))
+        mreq = socket.inet_aton(self.group) + socket.inet_aton(
+            interface or "0.0.0.0")
+        self._sock.setsockopt(socket.IPPROTO_IP,
+                              socket.IP_ADD_MEMBERSHIP, mreq)
+        # partial frames keyed by (sender_addr, frame_id) so two
+        # publishers (or a restarted one) on the same group can never
+        # interleave chunks into one frame; value = (n_chunks, chunks)
+        self._partial = {}
+        #: bound on simultaneously-tracked partial frames — on a lossy
+        #: link where frames never complete this is the memory ceiling
+        #: (oldest-first eviction = drop-late-frames semantics)
+        self.max_partial = 64
+
+    def recv_frame(self, timeout=1.0):
+        """Return the next complete frame's bytes, or None on timeout.
+        Incomplete frames are evicted oldest-first once
+        :attr:`max_partial` distinct frames are in flight (late/lost
+        chunks = dropped plot, by design)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return None
+            self._sock.settimeout(left)
+            try:
+                datagram, sender = self._sock.recvfrom(
+                    CHUNK + _HEADER.size)
+            except socket.timeout:
+                return None
+            if len(datagram) < _HEADER.size:
+                continue
+            magic, frame_id, idx, n_chunks = _HEADER.unpack(
+                datagram[:_HEADER.size])
+            if magic != MAGIC or idx >= n_chunks:
+                continue
+            key = (sender, frame_id)
+            total, chunks = self._partial.get(key, (n_chunks, None))
+            if chunks is None or total != n_chunks:
+                # first chunk, or a frame_id reused with a different
+                # chunk count (sender restart): start clean
+                total, chunks = n_chunks, {}
+                self._partial[key] = (total, chunks)
+            chunks[idx] = datagram[_HEADER.size:]
+            if len(chunks) == total:
+                del self._partial[key]
+                # GC this sender's older partials: the stream has
+                # moved past them
+                for stale in [k for k in self._partial
+                              if k[0] == sender
+                              and (frame_id - k[1]) & 0x80000000 == 0]:
+                    del self._partial[stale]
+                return b"".join(chunks[i] for i in range(total))
+            while len(self._partial) > self.max_partial:
+                del self._partial[next(iter(self._partial))]
+
+    def close(self):
+        try:
+            mreq = socket.inet_aton(self.group) + socket.inet_aton(
+                "0.0.0.0")
+            self._sock.setsockopt(socket.IPPROTO_IP,
+                                  socket.IP_DROP_MEMBERSHIP, mreq)
+        except OSError:
+            pass
+        self._sock.close()
