@@ -207,6 +207,37 @@ def stage_mnist_bf16():
           flops)
 
 
+def stage_mnist_u8():
+    """Device-resident NATIVE-dtype dataset: x stays uint8 in HBM
+    (MNIST's storage dtype) and normalization fuses into the step
+    (``fused.mlp_apply input_norm``).  The step is HBM-bound and reads
+    x twice (forward + weight gradient), so quartering its bytes is the
+    single biggest lever on the flagship line — the TPU-first upgrade
+    of the reference's device-resident fullbatch data
+    (``loader/fullbatch.py:79``)."""
+    import numpy
+
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu import prng
+    from veles_tpu.znicz.fused import init_mlp_params, make_train_step
+    from __graft_entry__ import MNIST_LAYERS
+
+    prng.seed_all(1234)
+    batch = 8192
+    params = init_mlp_params(784, MNIST_LAYERS)
+    rng = numpy.random.default_rng(0)
+    x = jax.device_put(
+        rng.integers(0, 256, (batch, 784)).astype(numpy.uint8))
+    labels = jax.device_put(
+        rng.integers(0, 10, batch).astype(numpy.int32))
+    step = make_train_step(MNIST_LAYERS, compute_dtype=jnp.bfloat16,
+                           input_norm=(1.0 / 255.0, 0.0))
+    sec, flops = _measure(step, params, x, labels, steps=100)
+    _emit("MNIST784 MLP fused train throughput (u8-resident)", sec,
+          batch, flops)
+
+
 def _conv_stage(metric, layers, input_shape, n_classes, batch, steps,
                 vs=None, compute_dtype="bfloat16"):
     import numpy
@@ -325,6 +356,34 @@ def stage_mnist_e2e():
     params = jax.device_put(params)
     _e2e_loop("MNIST784 MLP end-to-end workflow throughput "
               "(loader+prefetch+fused step)", wf.loader, params,
+              compiled, flops=cost_flops(compiled))
+
+
+def stage_mnist_e2e_u8():
+    """End-to-end with the NATIVE-dtype resident dataset: the loader
+    keeps u8 pixels in HBM, gathers u8 minibatches, and the fused step
+    scales in-program (``MnistLoader(native_device_dtype=True)``).
+    Compare against the ``mnist_u8`` synthetic line the way
+    ``mnist_e2e`` compares against ``mnist``."""
+    import jax
+    from veles_tpu import prng
+    from veles_tpu.samples import mnist
+    from veles_tpu.znicz.fused import lower_workflow
+
+    from veles_tpu.ops.timing import cost_flops
+
+    prng.seed_all(1234)
+    batch = 8192
+    wf = mnist.create_workflow(max_epochs=10 ** 6,
+                               minibatch_size=batch, native=True,
+                               fused=True)
+    params, step_fn = lower_workflow(wf)
+    compiled = jax.jit(step_fn, donate_argnums=(0,)).lower(
+        params, wf.loader.minibatch_data.mem,
+        wf.loader.minibatch_labels.mem.astype("int32")).compile()
+    params = jax.device_put(params)
+    _e2e_loop("MNIST784 MLP end-to-end workflow throughput "
+              "(u8-resident loader + fused step)", wf.loader, params,
               compiled, flops=cost_flops(compiled))
 
 
@@ -527,7 +586,9 @@ STAGES = {
     "probe": (stage_probe, 240),
     "mnist": (stage_mnist, 150),
     "mnist_bf16": (stage_mnist_bf16, 150),
+    "mnist_u8": (stage_mnist_u8, 150),
     "mnist_e2e": (stage_mnist_e2e, 240),
+    "mnist_e2e_u8": (stage_mnist_e2e_u8, 240),
     "mnist_wf": (stage_mnist_wf, 240),
     "cifar": (stage_cifar, 210),
     "ae": (stage_ae, 150),
@@ -553,14 +614,20 @@ def _run_stage(name, timeout, env=None, grace=300):
     earmarked for the headline stage."""
     full_env = dict(os.environ)
     # persistent XLA compilation cache: stage reruns (and future bench
-    # rounds on the same machine) skip the minutes-long first compiles
-    from veles_tpu.backends import COMPILE_CACHE_DIR
-    try:
-        os.makedirs(COMPILE_CACHE_DIR, exist_ok=True)
-        full_env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                            COMPILE_CACHE_DIR)
-    except OSError:
-        pass
+    # rounds on the same machine) skip the minutes-long first compiles.
+    # TPU stages only — a cached AOT *CPU* executable can SIGILL when
+    # the machine-feature detection differs between runs, so cpu-pinned
+    # stages must not even inherit an operator-exported cache dir
+    if env and env.get("JAX_PLATFORMS") == "cpu":
+        full_env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    else:
+        from veles_tpu.backends import COMPILE_CACHE_DIR
+        try:
+            os.makedirs(COMPILE_CACHE_DIR, exist_ok=True)
+            full_env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                                COMPILE_CACHE_DIR)
+        except OSError:
+            pass
     if env:
         for k, v in env.items():
             if v is None:
@@ -665,7 +732,8 @@ def main():
     # earlier stages must never squeeze it out of the budget, so while
     # it is still pending each optional stage only runs (and is only
     # allowed to hang) inside remaining() minus a headline reserve.
-    order = ("mnist", "mnist_bf16", "mnist_e2e", "mnist_wf", "cifar",
+    order = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
+             "mnist_e2e_u8", "mnist_wf", "cifar",
              "ae",
              "kohonen", "lstm", "transformer", "power", "alexnet")
     if env and not only:
@@ -676,7 +744,7 @@ def main():
         # An explicit BENCH_STAGES selection overrides the skip (the
         # operator asked for those stages, e.g. a tiny-config smoke).
         order = ("mnist_e2e", "mnist_wf", "ae", "kohonen", "lstm",
-                 "mnist_bf16", "mnist")
+                 "mnist_u8", "mnist_bf16", "mnist")
     ladder = [n for n in order if not only or n in only]
     for name in ladder:
         _fn, cap = STAGES[name]

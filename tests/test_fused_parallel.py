@@ -673,3 +673,42 @@ def test_tp_requires_model_axis():
 
     with pytest.raises(ValueError, match="model"):
         tp_rules(make_mesh({"data": 8}))
+
+
+def test_fused_u8_input_norm_matches_f32_path():
+    """uint8-resident x + in-step normalization (mlp_apply input_norm)
+    trains identically to pre-normalized float32 x — the storage-dtype
+    change may not alter the trajectory."""
+    import numpy
+    import jax.numpy as jnp
+
+    from veles_tpu import prng
+    from veles_tpu.znicz.fused import init_mlp_params, make_train_step
+
+    layers = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 32},
+         "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        {"type": "softmax", "->": {"output_sample_shape": 10},
+         "<-": {"learning_rate": 0.05}},
+    ]
+    rng = numpy.random.default_rng(7)
+    xu8 = rng.integers(0, 256, (64, 49)).astype(numpy.uint8)
+    labels = rng.integers(0, 10, 64).astype(numpy.int32)
+    xf32 = (xu8.astype(numpy.float32) / 255.0) - 0.5
+
+    prng.seed_all(99)
+    p_f32 = init_mlp_params(49, layers)
+    prng.seed_all(99)
+    p_u8 = init_mlp_params(49, layers)
+
+    step_f32 = make_train_step(layers)
+    step_u8 = make_train_step(layers, input_norm=(1.0 / 255.0, -0.5))
+    for _ in range(5):
+        p_f32, m_f32 = step_f32(p_f32, jnp.asarray(xf32),
+                                jnp.asarray(labels))
+        p_u8, m_u8 = step_u8(p_u8, jnp.asarray(xu8),
+                             jnp.asarray(labels))
+    numpy.testing.assert_allclose(
+        numpy.asarray(p_f32[0]["w"]), numpy.asarray(p_u8[0]["w"]),
+        rtol=1e-5, atol=1e-6)
+    assert int(m_f32["n_err"]) == int(m_u8["n_err"])

@@ -324,3 +324,80 @@ def test_hdfs_loader_parses_lines():
     rows, labels = loader._parse_lines("a\t1,2,3\nb\t4,5,6\n")
     assert labels == ["a", "b"]
     assert rows[1].tolist() == [4.0, 5.0, 6.0]
+
+
+class TestNativeDeviceDtype:
+    """FullBatchLoader(native_device_dtype=True): the dataset stays in
+    its storage dtype on device; the fitted normalizer becomes the
+    fused step's input_norm (the TPU-first upgrade of the reference's
+    device-resident fullbatch data, ``loader/fullbatch.py:79``)."""
+
+    def test_affine_forms(self):
+        import numpy
+
+        from veles_tpu.normalization import normalizer_factory
+
+        n = normalizer_factory("scale", scale=1.0 / 255.0)
+        s, b = n.as_affine()
+        assert (s, b) == (1.0 / 255.0, 0.0)
+
+        n = normalizer_factory("range_linear", interval=(0, 1))
+        data = numpy.array([[0.0, 255.0]], numpy.float32)
+        n.analyze(data)
+        s, b = n.as_affine()
+        x = numpy.array([[51.0, 204.0]], numpy.float32)
+        want = x.copy()
+        n.normalize(want)
+        numpy.testing.assert_allclose(x * s + b, want, rtol=1e-6)
+
+        n = normalizer_factory("mean_disp")
+        data = numpy.arange(12, dtype=numpy.float32).reshape(3, 4)
+        n.analyze(data)
+        s, b = n.as_affine()
+        x = data.copy()
+        n.normalize(x)
+        numpy.testing.assert_allclose(data * s + b, x, atol=1e-6)
+
+        # per-sample linear is NOT sample-independent affine
+        assert normalizer_factory("linear").as_affine() is None
+
+    def test_native_requires_affine_normalizer(self):
+        import pytest
+
+        from veles_tpu.loader.base import LoaderError
+        from veles_tpu.samples import mnist
+
+        with pytest.raises((LoaderError, ValueError)):
+            mnist.create_workflow(
+                max_epochs=1, minibatch_size=64, native=True,
+                fused=True, normalization_type="exp")
+
+    def test_native_requires_fused(self):
+        import pytest
+
+        from veles_tpu.samples import mnist
+
+        with pytest.raises(ValueError, match="fused"):
+            mnist.create_workflow(max_epochs=1, minibatch_size=64,
+                                  native=True)
+
+    def test_native_u8_trains_like_f32(self):
+        import numpy
+
+        from veles_tpu import prng
+        from veles_tpu.samples import mnist
+
+        results = {}
+        for native in (False, True):
+            prng.seed_all(4321)
+            wf = mnist.create_workflow(max_epochs=2,
+                                       minibatch_size=512,
+                                       native=native, fused=True)
+            if native:
+                assert wf.loader.minibatch_data.mem.dtype == numpy.uint8
+                assert wf.loader.input_norm is not None
+            wf.run()
+            results[native] = wf.decision.epoch_n_err[1]
+        # same seed, same (synthetic) images up to u8 rounding: the two
+        # storage paths must land in the same accuracy neighborhood
+        assert results[True] <= results[False] * 1.25 + 10

@@ -56,7 +56,7 @@ COMPILE_CACHE_DIR = os.path.join(os.path.expanduser("~"), ".veles_tpu",
                                  "cache", "xla")
 
 
-def enable_compilation_cache():
+def enable_compilation_cache(platform=None):
     """Point XLA's persistent executable cache at a per-user directory.
 
     The TPU analogue of the reference's kernel binary cache keyed on
@@ -67,16 +67,28 @@ def enable_compilation_cache():
     compile once per machine.  ``JAX_COMPILATION_CACHE_DIR`` overrides
     the location.  Safe to call any number of times, before or after
     backend init (only programs compiled afterwards are cached).
+
+    Non-CPU platforms only: CPU compiles are cheap, and an AOT CPU
+    executable cached under one machine-feature detection can SIGILL
+    under another.  ``platform`` is the caller's RESOLVED platform
+    (e.g. ``jax.devices()[0].platform``) — prefer passing it; with
+    ``None`` only the *requested* ``jax_platforms`` string is checked,
+    which cannot see a silent CPU fallback.
     """
     global _compile_cache_enabled
     if _compile_cache_enabled:
+        return
+    if platform is not None and str(platform).lower() == "cpu":
         return
     _compile_cache_enabled = True
     path = (os.environ.get("JAX_COMPILATION_CACHE_DIR")
             or COMPILE_CACHE_DIR)
     try:
-        os.makedirs(path, exist_ok=True)
         import jax
+        if platform is None and "cpu" in str(
+                jax.config.jax_platforms or ""):
+            return
+        os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
     except (OSError, AttributeError, ValueError):
         _compile_cache_enabled = False
@@ -216,7 +228,7 @@ class _JaxDevice(Device):
 
     def __init__(self, **kwargs):
         import jax
-        enable_compilation_cache()
+        enable_compilation_cache(platform=self.PLATFORM)
         self._jax_devices = list(kwargs.pop("devices", ()))
         if not self._jax_devices:
             try:

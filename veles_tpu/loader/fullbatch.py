@@ -35,6 +35,18 @@ class FullBatchLoader(Loader):
         #: keep the dataset on device and gather there (default on)
         self.store_in_device_memory = kwargs.get(
             "store_in_device_memory", True)
+        #: keep the resident dataset in its NATIVE storage dtype (e.g.
+        #: uint8 pixels) and publish the fitted normalizer as an affine
+        #: ``input_norm=(scale, shift)`` for the fused train step
+        #: instead of materializing normalized float32.  An HBM-bound
+        #: step reads the batch twice (forward + weight gradient), so
+        #: u8 residency quarters its dominant traffic term.  Requires
+        #: an affine normalizer (``NormalizerBase.as_affine``).
+        self.native_device_dtype = kwargs.get(
+            "native_device_dtype", False)
+        #: (scale, shift) for the jitted consumer; None unless
+        #: native_device_dtype is active
+        self.input_norm = None
         super(FullBatchLoader, self).__init__(workflow, **kwargs)
 
     @property
@@ -59,9 +71,20 @@ class FullBatchLoader(Loader):
         if self.has_labels and \
                 len(self.original_labels) != self.total_samples:
             raise LoaderError("original_labels length mismatch")
-        # One-shot normalization of the resident dataset (see module doc).
-        self.normalizer.normalize(self.original_data.mem)
-        self.original_data.map_write()
+        if self.native_device_dtype:
+            # the normalizer stays symbolic: the fused step applies it
+            # in-program and the dataset keeps its storage dtype
+            self.input_norm = self.normalizer.as_affine()
+            if self.input_norm is None:
+                raise LoaderError(
+                    "native_device_dtype needs an affine normalizer "
+                    "(as_affine() returned None for %s)"
+                    % type(self.normalizer).__name__)
+        else:
+            # One-shot normalization of the resident dataset (see
+            # module doc).
+            self.normalizer.normalize(self.original_data.mem)
+            self.original_data.map_write()
         if self.has_labels:
             # None = unlabeled sample (e.g. a split without labels) → -1
             mapped = [-1 if raw is None

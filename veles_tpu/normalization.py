@@ -68,6 +68,19 @@ class NormalizerBase(object, metaclass=NormalizerRegistry):
     def denormalize(self, data):
         raise NotImplementedError
 
+    def as_affine(self):
+        """``(scale, shift)`` such that ``normalize(x) == x*scale +
+        shift`` for every sample, or None when this normalizer is not a
+        sample-independent affine map (per-sample linear, exp, ...).
+
+        Affine normalizers can be FUSED into a jitted train step
+        (``fused_graph.lower_specs input_norm``) so the dataset stays
+        device-resident in its native storage dtype — see
+        ``FullBatchLoader(native_device_dtype=True)``.  scale/shift may
+        be scalars or flat per-feature arrays.
+        """
+        return None
+
     def _require(self):
         if not self._initialized:
             raise RuntimeError(
@@ -95,6 +108,33 @@ class NoneNormalizer(StatelessNormalizer):
 
     def denormalize(self, data):
         pass
+
+    def as_affine(self):
+        return (1.0, 0.0)
+
+
+class ScaleNormalizer(StatelessNormalizer):
+    """Fixed multiplicative scale (e.g. ``1/255`` for byte images).
+
+    The affine form feeds ``FullBatchLoader(native_device_dtype=True)``
+    exactly: u8 pixels stay resident, the fused step multiplies
+    in-program, and the trajectory is bit-identical to pre-scaled
+    float32 data."""
+
+    MAPPING = "scale"
+
+    def __init__(self, scale=1.0 / 255.0, **kwargs):
+        self.scale = float(scale)
+        super(ScaleNormalizer, self).__init__(**kwargs)
+
+    def normalize(self, data):
+        data *= self.scale
+
+    def denormalize(self, data):
+        data /= self.scale
+
+    def as_affine(self):
+        return (self.scale, 0.0)
 
 
 class LinearNormalizer(StatelessNormalizer):
@@ -153,6 +193,12 @@ class RangeLinearNormalizer(NormalizerBase):
         span = (self.gmax - self.gmin) or 1.0
         data[...] = (data - lo) / (hi - lo) * span + self.gmin
 
+    def as_affine(self):
+        self._require()
+        lo, hi = self.interval
+        scale = (hi - lo) / ((self.gmax - self.gmin) or 1.0)
+        return (scale, lo - self.gmin * scale)
+
 
 class MeanDispersionNormalizer(NormalizerBase):
     """Per-feature ``(x - mean) / (max - min)`` accumulated over TRAIN
@@ -200,6 +246,10 @@ class MeanDispersionNormalizer(NormalizerBase):
     def denormalize(self, data):
         flat = data.reshape(len(data), -1)
         flat[...] = flat / self.disp + self.mean
+
+    def as_affine(self):
+        disp = self.disp
+        return (disp, -self.mean * disp)
 
 
 class ExponentNormalizer(StatelessNormalizer):
@@ -257,6 +307,10 @@ class PointwiseNormalizer(NormalizerBase):
         flat = data.reshape(len(data), -1)
         flat[...] = (flat - add) / numpy.where(mul != 0, mul, 1)
 
+    def as_affine(self):
+        self._require()
+        return self._coeffs()
+
 
 class ExternalMeanNormalizer(StatelessNormalizer):
     """Subtract a user-supplied mean array (ref ``:593``)."""
@@ -283,6 +337,10 @@ class ExternalMeanNormalizer(StatelessNormalizer):
         if self.scale != 1.0:
             flat /= self.scale
         flat += self.mean.reshape(1, -1)
+
+    def as_affine(self):
+        return (float(self.scale),
+                -self.mean.reshape(-1) * float(self.scale))
 
 
 class InternalMeanNormalizer(NormalizerBase):
@@ -316,3 +374,6 @@ class InternalMeanNormalizer(NormalizerBase):
     def denormalize(self, data):
         flat = data.reshape(len(data), -1)
         flat += self.mean
+
+    def as_affine(self):
+        return (1.0, -self.mean.reshape(-1))

@@ -83,17 +83,32 @@ def stl10_available():
     return _stl10_paths() is not None
 
 
-def load_mnist():
+def load_mnist(raw=False):
     """(train_x, train_y, test_x, test_y) floats in [0,1] / int labels,
-    or synthetic 28×28 10-class stand-ins."""
+    or synthetic 28×28 10-class stand-ins.  ``raw=True`` returns the
+    NATIVE uint8 pixels instead (for device-resident u8 datasets —
+    ``FullBatchLoader(native_device_dtype=True)``)."""
     paths = _mnist_paths()
     if paths:
-        tr_x = _read_idx(paths[0]).astype(numpy.float32) / 255.0
+        tr_x, te_x = _read_idx(paths[0]), _read_idx(paths[2])
+        if not raw:
+            tr_x = tr_x.astype(numpy.float32) / 255.0
+            te_x = te_x.astype(numpy.float32) / 255.0
         tr_y = _read_idx(paths[1]).astype(numpy.int64)
-        te_x = _read_idx(paths[2]).astype(numpy.float32) / 255.0
         te_y = _read_idx(paths[3]).astype(numpy.int64)
         return tr_x, tr_y, te_x, te_y, True
-    return _synthetic_images((28, 28), 10, 6000, 1000) + (False,)
+    tr_x, tr_y, te_x, te_y = _synthetic_images((28, 28), 10, 6000, 1000)
+    if raw:
+        # one byte mapping fit on TRAIN for both splits (a split-local
+        # min/max would scale train and validation pixels differently)
+        lo, hi = tr_x.min(), tr_x.max()
+
+        def to_u8(x):
+            return numpy.clip(
+                (x - lo) / max(hi - lo, 1e-6) * 255.0, 0,
+                255).astype(numpy.uint8)
+        tr_x, te_x = to_u8(tr_x), to_u8(te_x)
+    return tr_x, tr_y, te_x, te_y, False
 
 
 def load_cifar10():

@@ -25,27 +25,38 @@ LAYERS = [
 
 class MnistLoader(FullBatchLoader):
     def load_data(self):
-        tr_x, tr_y, te_x, te_y, real = load_mnist()
+        raw = self.native_device_dtype
+        tr_x, tr_y, te_x, te_y, real = load_mnist(raw=raw)
         if not real:
             self.warning("real MNIST not found under "
                          "root.common.dirs.datasets — using synthetic "
                          "stand-in data")
         data = numpy.concatenate([te_x, tr_x]).reshape(-1, 784)
         labels = numpy.concatenate([te_y, tr_y])
+        # native mode: u8 pixels stay resident; the scale normalizer
+        # is applied inside the fused step (input_norm) so the
+        # trajectory matches the pre-scaled float32 path exactly
         self.original_data.mem = numpy.ascontiguousarray(
-            data, dtype=numpy.float32)
+            data, dtype=numpy.uint8 if raw else numpy.float32)
         self.original_labels = [int(v) for v in labels]
         # reference split: validation = the t10k set
         self.class_lengths[:] = [0, len(te_y), len(tr_y)]
 
 
 def create_workflow(device=None, max_epochs=25, minibatch_size=100,
-                    snapshot_dir=None, layers=None, **kwargs):
+                    snapshot_dir=None, layers=None, native=False,
+                    **kwargs):
+    """``native=True``: uint8-resident dataset + in-step scaling
+    (requires ``fused=True``) — quarters the HBM bytes of the input
+    tensor the thin-MLP step is bound by."""
+    norm_default = "scale" if native else "none"
     wf = StandardWorkflow(
         None,
         loader_factory=lambda w: MnistLoader(
             w, minibatch_size=minibatch_size,
-            normalization_type=kwargs.pop("normalization_type", "none")),
+            native_device_dtype=native,
+            normalization_type=kwargs.pop("normalization_type",
+                                          norm_default)),
         layers=[{**spec} for spec in (layers or LAYERS)],
         decision_config={"max_epochs": max_epochs,
                          "fail_iterations": kwargs.pop(

@@ -37,10 +37,10 @@ def main(argv=None):
     parser.add_argument("--skip-attention", action="store_true")
     args = parser.parse_args(argv)
 
-    from veles_tpu.backends import enable_compilation_cache
-    enable_compilation_cache()
-
     import jax
+
+    from veles_tpu.backends import enable_compilation_cache
+    enable_compilation_cache(platform=jax.devices()[0].platform)
     from veles_tpu.backends import DEVICE_INFOS_JSON, DeviceInfo
     from veles_tpu.ops import benchmark
 

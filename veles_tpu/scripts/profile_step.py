@@ -143,7 +143,7 @@ def main(argv=None):
     import jax
 
     from veles_tpu.backends import enable_compilation_cache
-    enable_compilation_cache()
+    enable_compilation_cache(platform=jax.devices()[0].platform)
     kind = jax.devices()[0].device_kind
     (params, step, apply_fn, x, labels,
      flops_overrides) = build(args.sample, args.batch)

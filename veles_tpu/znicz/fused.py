@@ -85,9 +85,24 @@ def _specs_static(layer_specs):
     return tuple(out)
 
 
-def mlp_apply(params, x, static_specs, compute_dtype=None):
-    """Pure forward pass; last softmax layer returns probabilities."""
+def mlp_apply(params, x, static_specs, compute_dtype=None,
+              input_norm=None):
+    """Pure forward pass; last softmax layer returns probabilities.
+
+    ``input_norm=(scale, shift)`` normalizes INSIDE the jitted program
+    (``h*scale + shift``, fused by XLA into the first matmul's read).
+    The TPU-first counterpart of the reference's device-resident
+    fullbatch data (``loader/fullbatch.py:79``): the batch can stay in
+    its native storage dtype (MNIST = uint8) in HBM, quartering the
+    bytes of the one tensor a thin-MLP step reads twice (forward +
+    weight gradient) — the step is HBM-bound, so bytes are throughput.
+    """
     h = x.reshape(x.shape[0], -1)
+    if jnp.issubdtype(h.dtype, jnp.integer):
+        h = h.astype(compute_dtype or jnp.float32)
+    if input_norm is not None:
+        scale, shift = input_norm
+        h = h * jnp.asarray(scale, h.dtype) + jnp.asarray(shift, h.dtype)
     if compute_dtype is not None:
         h = h.astype(compute_dtype)
     for layer, (activation, is_softmax, *_rest) in zip(
@@ -101,20 +116,24 @@ def mlp_apply(params, x, static_specs, compute_dtype=None):
     return h
 
 
-def make_train_step(layer_specs, loss="softmax", compute_dtype=None):
+def make_train_step(layer_specs, loss="softmax", compute_dtype=None,
+                    input_norm=None):
     """Build ``step(params, x, labels) -> (params, metrics)``.
 
     ``metrics`` = {"loss": mean loss, "n_err": int errors}.  The update
     rule matches GradientDescentBase: v ← μv − α(g + λw); w ← w + v,
     with gradients averaged over the batch.  ``compute_dtype=bfloat16``
     casts matmul operands (MXU-native) with float32 params/accumulation.
+    ``input_norm=(scale, shift)``: see :func:`mlp_apply` — lets ``x``
+    stay in its native storage dtype (e.g. uint8 pixels) in HBM.
     """
     static_specs = _specs_static(layer_specs)
 
     def loss_fn(wb, x, labels):
         params = [{"w": w, "b": b} for (w, b) in wb]
         out = mlp_apply(params, x, static_specs,
-                        compute_dtype=compute_dtype)
+                        compute_dtype=compute_dtype,
+                        input_norm=input_norm)
         valid = (labels >= 0)
         # gradients scale by the PADDED batch length — identical to the
         # eager GD units (gd.py divides by len(input); the evaluator
@@ -156,12 +175,14 @@ def make_train_step(layer_specs, loss="softmax", compute_dtype=None):
     return step
 
 
-def make_eval_step(layer_specs, loss="softmax", compute_dtype=None):
+def make_eval_step(layer_specs, loss="softmax", compute_dtype=None,
+                   input_norm=None):
     static_specs = _specs_static(layer_specs)
 
     def evaluate(params, x, labels):
         out = mlp_apply(params, x, static_specs,
-                        compute_dtype=compute_dtype)
+                        compute_dtype=compute_dtype,
+                        input_norm=input_norm)
         valid = labels >= 0
         n_err = ((jnp.argmax(out, axis=1) != labels) & valid).sum()
         return {"n_err": n_err, "n": valid.sum()}
@@ -173,20 +194,32 @@ def make_eval_step(layer_specs, loss="softmax", compute_dtype=None):
 
 def lower_workflow(wf):
     """Read the live forward units' parameters into a pytree and return
-    (params, step_fn).  Writing back: ``update_workflow(wf, params)``."""
+    (params, step_fn).  Writing back: ``update_workflow(wf, params)``.
+
+    Works for eager workflows (momentum state from the GD units) and
+    fused ones (no GD units exist — ``StandardWorkflow.create_workflow``
+    returns before ``link_gds`` when ``fused=True``; fresh zero
+    momentum)."""
+    if not wf.forwards:
+        raise ValueError("workflow has no forward units to lower")
+    gds = list(reversed(wf.gds)) if wf.gds else [None] * len(wf.forwards)
     params = []
-    for fwd, gdu in zip(wf.forwards, reversed(wf.gds)):
+    for fwd, gdu in zip(wf.forwards, gds):
         fwd.weights.map_read()
         fwd.bias.map_read()
         params.append({
             "w": numpy.array(fwd.weights.mem),
             "b": numpy.array(fwd.bias.mem),
             "vw": numpy.array(gdu.gradient_weights.mem)
-            if gdu.gradient_weights else numpy.zeros_like(fwd.weights.mem),
+            if gdu is not None and gdu.gradient_weights
+            else numpy.zeros_like(fwd.weights.mem),
             "vb": numpy.array(gdu.gradient_bias.mem)
-            if gdu.gradient_bias else numpy.zeros_like(fwd.bias.mem),
+            if gdu is not None and gdu.gradient_bias
+            else numpy.zeros_like(fwd.bias.mem),
         })
-    step = make_train_step(wf.layers)
+    step = make_train_step(
+        wf.layers,
+        input_norm=getattr(wf.loader, "input_norm", None))
     return params, step
 
 

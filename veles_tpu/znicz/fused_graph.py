@@ -40,10 +40,18 @@ def default_lr(solver):
 
 def lower_specs(layer_specs, sample_shape, loss="softmax",
                 compute_dtype=None, remat=False, grad_accum=1,
-                lr_adjuster=None):
+                lr_adjuster=None, input_norm=None):
     """Build (params, step_fn, eval_fn, apply_fn) from layer specs.
 
     ``sample_shape``: one sample's shape (no batch dim).
+    ``input_norm=(scale, shift)``: affine normalization applied INSIDE
+    the jitted program (fused by XLA into the first layer's read), so
+    the batch may arrive in its native storage dtype — e.g. uint8
+    pixels resident in HBM, quartering the bytes of the tensor an
+    HBM-bound step reads twice (forward + weight gradient).  The
+    TPU-first counterpart of the reference's device-resident fullbatch
+    data (``loader/fullbatch.py:79``); scale/shift may be scalars or
+    per-feature arrays broadcastable against ``sample_shape``.
     ``compute_dtype``: optional forward/backward compute dtype (e.g.
     ``jnp.bfloat16`` — the MXU-native mixed-precision mode: bf16
     activations/weights in the matmuls/convs, fp32 accumulation via
@@ -206,8 +214,20 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
         probe = unit.output
     del wf
 
-    def apply_fn(params_list, x, train=False):
+    def _ingest(x):
+        """Entry cast + optional fused affine normalization (see
+        ``input_norm`` in the docstring)."""
         h = x
+        if jnp.issubdtype(h.dtype, jnp.integer):
+            h = h.astype(compute_dtype or jnp.float32)
+        if input_norm is not None:
+            scale, shift = input_norm
+            h = h * jnp.asarray(scale, h.dtype) \
+                + jnp.asarray(shift, h.dtype)
+        return h
+
+    def apply_fn(params_list, x, train=False):
+        h = _ingest(x)
         for (pure, config, _hyper, skip_at_eval), state in zip(
                 stages, params_list):
             if skip_at_eval and not train:
@@ -221,10 +241,9 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
         return h
 
     def loss_fn(wb_list, aux_list, x, labels):
+        h = _ingest(x)
         if compute_dtype is not None:
-            h = jnp.asarray(x, compute_dtype)
-        else:
-            h = x
+            h = jnp.asarray(h, compute_dtype)
         for (pure, config, _hyper, _skip), wb, aux in zip(stages, wb_list,
                                                           aux_list):
             if compute_dtype is not None:

@@ -109,7 +109,11 @@ class FusedTrainer(AcceleratedUnit):
         params, step_fn, eval_fn, _apply = lower_specs(
             specs, sample_shape, loss=self.loss,
             compute_dtype=self.compute_dtype, remat=self.remat,
-            grad_accum=self.grad_accum, lr_adjuster=self.lr_adjuster)
+            grad_accum=self.grad_accum, lr_adjuster=self.lr_adjuster,
+            # native-dtype resident datasets publish their fitted
+            # normalizer for in-step application
+            # (FullBatchLoader(native_device_dtype=True))
+            input_norm=getattr(self.loader, "input_norm", None))
         params = self._restore_solver_state(params)
         self._train_divisor_ = max(self.grad_accum, 1)
         if self.mesh_axes:

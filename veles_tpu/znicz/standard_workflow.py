@@ -186,6 +186,13 @@ class StandardWorkflow(AcceleratedWorkflow):
                 self.link_rollback()
             self.link_loop_and_end()
             return
+        if getattr(self.loader, "native_device_dtype", False):
+            # the eager forward units consume minibatch_data directly
+            # and have no in-step normalization hook — silent training
+            # on raw integers must never happen
+            raise ValueError(
+                "native_device_dtype loaders require fused=True (the "
+                "affine normalizer is applied inside the fused step)")
         self.link_forwards()
         self.link_evaluator()
         self.link_decision()
