@@ -110,12 +110,17 @@ def _banked_tpu_lines():
     for "no TPU numbers exist" (VERDICT r3 'missing' item 1)."""
     here = os.path.dirname(os.path.abspath(__file__))
     banked = []
-    for rel in ("chip_session_r4/bench.jsonl",
-                "chip_session_logs_r4/bench.jsonl",
-                "chip_session_logs_r4/bench_tuned.jsonl"):
+    rels = []
+    # the tracked evidence dir (scripts/collect_chip_session.py snapshots
+    # finished windows there, never overwriting) plus the live, still-
+    # gitignored session outdir
+    for d in ("chip_session_r4", "chip_session_logs_r4"):
+        full = os.path.join(here, d)
+        if os.path.isdir(full):
+            rels.extend(os.path.join(d, n) for n in sorted(os.listdir(full))
+                        if n.endswith(".jsonl"))
+    for rel in rels:
         path = os.path.join(here, rel)
-        if not os.path.exists(path):
-            continue
         try:
             with open(path) as fh:
                 lines = fh.readlines()

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Snapshot a finished chip-session output directory into the tracked
+evidence directory and print a README-ready summary.
+
+``scripts/chip_session.sh`` writes its per-step logs/artifacts into an
+output dir that is gitignored (``chip_session_logs*/``) so an aborted
+window never leaves half-written files in the history.  Once a window
+ends, this script copies everything worth committing into the tracked
+``chip_session_r4/`` evidence dir and prints a markdown table of every
+real-hardware line found, so the session can commit artifacts + README
+update in one review pass.
+
+Usage: python scripts/collect_chip_session.py [outdir] [evidence_dir]
+"""
+
+import json
+import os
+import shutil
+import sys
+
+
+def tpu_lines(path):
+    """Yield (record, line) for every real-hardware JSON line in a
+    .jsonl file; garbage lines cost only themselves."""
+    try:
+        with open(path) as fh:
+            lines = fh.readlines()
+    except OSError:
+        return
+    for line in lines:
+        try:
+            rec = json.loads(line.strip())
+            # same definition of "a real-hardware line" as bench.py's
+            # _banked_tpu_lines (case-insensitive on device_kind)
+            if "tpu" in (rec.get("device_kind") or "").lower():
+                yield rec, line
+        except Exception:
+            continue
+
+
+def main():
+    out = sys.argv[1] if len(sys.argv) > 1 else "chip_session_logs_r4"
+    evidence = sys.argv[2] if len(sys.argv) > 2 else "chip_session_r4"
+    if not os.path.isdir(out):
+        sys.exit("no such session dir: %s" % out)
+    os.makedirs(evidence, exist_ok=True)
+
+    copied = []
+    for name in sorted(os.listdir(out)):
+        src = os.path.join(out, name)
+        if not os.path.isfile(src):
+            continue
+        # NEVER overwrite earlier-window evidence: same-named files
+        # get a numeric suffix (bench.jsonl -> bench.2.jsonl), so
+        # window 2 can't clobber the banked window-1 lines bench.py's
+        # banked_tpu_lines provenance points at
+        stem, ext = os.path.splitext(name)
+        dst = os.path.join(evidence, name)
+        n = 2
+        while os.path.exists(dst):
+            dst = os.path.join(evidence, "%s.%d%s" % (stem, n, ext))
+            n += 1
+        shutil.copy2(src, dst)
+        copied.append(dst)
+    print("copied %d files %s -> %s" % (len(copied), out, evidence))
+
+    rows = []
+    for name in sorted(os.listdir(evidence)):
+        if not name.endswith(".jsonl"):
+            continue
+        for rec, _line in tpu_lines(os.path.join(evidence, name)):
+            rows.append((rec, name))
+    if not rows:
+        print("no real-hardware lines found")
+        return
+    print("\n| metric | value | unit | MFU | vs_baseline | source |")
+    print("|---|---|---|---|---|---|")
+    for rec, name in rows:
+        print("| %s | %s | %s | %s | %s | %s |" % (
+            rec.get("metric"),
+            ("%.4g" % rec["value"]) if isinstance(
+                rec.get("value"), (int, float)) else rec.get("value"),
+            rec.get("unit"),
+            rec.get("mfu", ""),
+            rec.get("vs_baseline", ""),
+            name))
+
+
+if __name__ == "__main__":
+    main()
