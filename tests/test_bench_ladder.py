@@ -8,6 +8,8 @@ being able to crash the run (VERDICT r3 'missing' item 1).
 """
 
 import io
+import os
+import sys
 import json
 import contextlib
 
@@ -145,3 +147,45 @@ def test_banked_lines_missing_files_is_empty(monkeypatch, tmp_path):
     monkeypatch.setattr(bench.os.path, "dirname",
                         lambda p: str(tmp_path))
     assert bench._banked_tpu_lines() == []
+
+
+# ---------------------------------------------------------------------------
+# scripts/collect_chip_session.py: evidence snapshots never clobber
+# ---------------------------------------------------------------------------
+
+def test_collector_never_overwrites_prior_window(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "collect_chip_session",
+        os.path.join(os.path.dirname(bench.__file__),
+                     "scripts", "collect_chip_session.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    out = tmp_path / "outdir"
+    out.mkdir()
+    (out / "bench.jsonl").write_text(json.dumps(
+        {"metric": "w2", "value": 2.0, "unit": "images/sec",
+         "device_kind": "tpu v5 lite"}) + "\n")  # lowercase kind counts
+    evidence = tmp_path / "evidence"
+    evidence.mkdir()
+    (evidence / "bench.jsonl").write_text(json.dumps(
+        {"metric": "w1", "value": 1.0, "unit": "images/sec",
+         "device_kind": "TPU v5 lite"}) + "\n")
+
+    argv = [sys.argv[0], str(out), str(evidence)]
+    old = sys.argv
+    sys.argv = argv
+    try:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            mod.main()
+    finally:
+        sys.argv = old
+    text = buf.getvalue()
+    # window 1 survives byte-for-byte, window 2 lands suffixed, and the
+    # table shows BOTH windows' lines
+    assert json.loads((evidence / "bench.jsonl").read_text())["metric"] \
+        == "w1"
+    assert (evidence / "bench.2.jsonl").exists()
+    assert "| w1 |" in text and "| w2 |" in text
