@@ -4,17 +4,25 @@
 Designed to always leave a parsed line even under adversity (the round-1
 failure mode was a backend-init hang that produced nothing):
 
-1. **Backend probe first** — a tiny jit in a *subprocess* with a hard
-   timeout.  A dead/hung TPU tunnel is detected and killed, never hangs
-   the harness, and triggers a CPU fallback so a number still gets
-   recorded (tagged ``[cpu-fallback]``).
-2. **Cheapest-first ladder** — MNIST MLP → e2e workflow → CIFAR-10 conv
-   → MNIST AE → Kohonen SOM → LSTM → GPT LM → AlexNet (the headline,
-   always budget-protected), each stage its own subprocess with a
-   wall-clock cap.  Each completed stage prints its JSON line
-   *immediately*, so an external timeout mid-ladder still leaves the
-   best completed result on stdout (last line = best).
-3. **MFU reported** alongside throughput: XLA's own
+1. **One claim for everything** — the whole ladder (probe + every
+   stage, the AlexNet profile and the s2d A/B included) runs in a
+   SINGLE child process that initializes the backend exactly once.
+   Live-window post-mortems (r4 windows 1 & 2) showed the tunnel relay
+   stops *granting* backend claims a few minutes into a window while
+   established clients keep working, so the earlier one-subprocess-
+   per-stage isolation burned the window on doomed re-claims.
+2. **Streaming parent** — the parent reads the child's JSON lines as
+   they are printed (child runs ``python -u``), so each completed
+   stage is banked immediately; a parent-side budget reap (SIGTERM +
+   long grace, never a mid-claim SIGKILL) cannot lose finished lines.
+   No probe line within the probe cap -> CPU fallback, per-stage
+   subprocesses, lines tagged ``[cpu-fallback]``.
+3. **Flagship-priority cold order** — on a cold compile cache the
+   AlexNet headline runs right after one cheap proving stage;
+   re-runs/extras follow (``_COLD_ORDER``).  The parent re-emits the
+   AlexNet line last: the driver parses the final line as the
+   round's headline metric.
+4. **MFU reported** alongside throughput: XLA's own
    ``compiled.cost_analysis()`` flop count / measured step time / peak
    bf16 FLOPs for the detected TPU generation.
 
@@ -90,15 +98,17 @@ def stage_probe():
                   "for the strict gates")
     else:
         parity = "unproven (real datasets absent from this image)"
-    print(json.dumps({"platform": dev.platform,
-                      "device_kind": dev.device_kind,
-                      "n_devices": jax.device_count(),
-                      # accuracy-parity gates (test_accuracy_parity.py)
-                      # need the real files; throughput stages use
-                      # synthetic batches either way
-                      "real_datasets_present": datasets,
-                      "accuracy_parity": parity,
-                      "banked_tpu_lines": _banked_tpu_lines()}))
+    probe = {"platform": dev.platform,
+             "device_kind": dev.device_kind,
+             "n_devices": jax.device_count(),
+             # accuracy-parity gates (test_accuracy_parity.py)
+             # need the real files; throughput stages use
+             # synthetic batches either way
+             "real_datasets_present": datasets,
+             "accuracy_parity": parity,
+             "banked_tpu_lines": _banked_tpu_lines()}
+    print(json.dumps(probe))
+    return probe
 
 
 def _banked_tpu_lines():
@@ -624,6 +634,70 @@ def stage_alexnet():
         steps=10, vs=V100_ALEXNET_IMG_PER_SEC)
 
 
+def stage_alexnet512():
+    """Batch sweep point: the same flagship at batch 512 (was
+    chip_session.sh step 2b; folded into the ladder so it rides the
+    SAME backend claim — see the one-claim design note up top)."""
+    from veles_tpu.samples import alexnet
+    _conv_stage(
+        "AlexNet fused train throughput per chip (bf16, batch 512)",
+        alexnet.LAYERS, alexnet.INPUT_SHAPE, 1000, batch=512,
+        steps=10, vs=V100_ALEXNET_IMG_PER_SEC)
+
+
+def stage_profile():
+    """AlexNet step-time breakdown -> PROFILE.md (was chip_session.sh
+    step 2).  The profiler's human-readable report goes to stdout and
+    is forwarded to stderr by the streaming parent; the JSON marker
+    line records that the artifact was produced on this device."""
+    from veles_tpu.scripts import profile_step
+    profile_step.main(["--sample", "alexnet", "--batch", "256",
+                       "--out", "PROFILE.md"])
+    print(json.dumps({
+        "metric": "AlexNet step profile artifact (PROFILE.md)",
+        "value": 1.0, "unit": "artifact", "vs_baseline": None,
+        "device_kind": _device_kind()}))
+
+
+def stage_s2d():
+    """Space-to-depth conv1 A/B (was chip_session.sh step 3): the same
+    stride-4 11x11 conv timed with and without the s2d rewrite, in one
+    program each via the in-program marginal stopwatch."""
+    import numpy
+
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu.ops.timing import inprogram_marginal
+    from veles_tpu.znicz.conv import Conv
+
+    rng = numpy.random.default_rng(0)
+    batch = 256
+    x = jnp.asarray(rng.standard_normal((batch, 227, 227, 3)),
+                    jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((11, 11, 3, 96)) * 0.01,
+                    jnp.bfloat16)
+    flops = 2.0 * batch * 55 * 55 * 96 * 11 * 11 * 3
+    secs = {}
+    for s2d in (False, True):
+        def unit(carry, _s2d=s2d):
+            xx, s = carry
+            xx = jax.lax.dynamic_update_slice(
+                xx, (xx[0:1, 0:1, 0:1, 0:1]
+                     + (s * 1e-30).astype(xx.dtype)), (0, 0, 0, 0))
+            out = Conv.pure({"w": w}, xx, sliding=(4, 4), s2d=_s2d)
+            return xx, jnp.sum(jnp.abs(out), dtype=jnp.float32)
+        secs[s2d] = inprogram_marginal(unit, (x, jnp.float32(0.0)),
+                                       k1=4, k2=32)
+    print(json.dumps({
+        "metric": "AlexNet conv1 space-to-depth speedup (A/B)",
+        "value": round(secs[False] / secs[True], 4), "unit": "x",
+        "vs_baseline": None,
+        "base_ms": round(secs[False] * 1e3, 4),
+        "s2d_ms": round(secs[True] * 1e3, 4),
+        "tflops_effective_s2d": round(flops / secs[True] / 1e12, 2),
+        "device_kind": _device_kind()}))
+
+
 STAGES = {
     # healthy-tunnel probe = import + one 256² matmul compile (~40 s,
     # but a chip claim right after another client exits can take much
@@ -644,7 +718,141 @@ STAGES = {
     "transformer": (stage_transformer, 240),
     "power": (stage_power, 240),
     "alexnet": (stage_alexnet, 600),
+    "alexnet512": (stage_alexnet512, 600),
+    "profile": (stage_profile, 600),
+    "s2d": (stage_s2d, 300),
 }
+
+
+#: Canonical full ladder (warm compile cache): cheap -> heavy, the
+#: AlexNet headline LAST so its line is the final one on stdout.
+_FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
+               "mnist_e2e_u8", "mnist_wf", "cifar", "ae", "kohonen",
+               "lstm", "transformer", "power", "s2d", "alexnet512",
+               "profile", "alexnet")
+
+#: Cold compile cache: the flagship right after the one cheap stage
+#: that proves the chip + stopwatch work.  Live-window post-mortems
+#: (r4 windows 1 & 2) showed the tunnel relay stops granting backend
+#: claims a few minutes into a window, so everything of value must be
+#: attempted EARLY and on ONE claim — MLP re-runs and extras come
+#: after the headline artifacts.
+_COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
+               "s2d", "alexnet512", "transformer", "lstm", "mnist_e2e",
+               "mnist_e2e_u8", "power", "cifar", "ae", "kohonen",
+               "mnist_wf")
+
+#: CPU fallback (rehearsed with a wedged tunnel): conv/LM heavies
+#: cannot finish on CPU inside their caps — end on the flagship MNIST
+#: number so the recorded last line is a real measurement.
+_CPU_ORDER = ("mnist_e2e", "mnist_wf", "ae", "kohonen", "lstm",
+              "mnist_u8", "mnist_bf16", "mnist")
+
+
+def _ladder_order(platform_tpu, cpu_fallback, warm, only=None):
+    """Pure stage-ordering policy (unit-tested directly)."""
+    if only is not None:
+        return tuple(n for n in _FULL_ORDER if n in only)
+    if cpu_fallback or not platform_tpu:
+        return _CPU_ORDER
+    return _FULL_ORDER if warm else _COLD_ORDER
+
+
+# --------------------------------------------------------------------------
+# one-claim ladder child
+# --------------------------------------------------------------------------
+
+def stage_ladder():
+    """Run the WHOLE ladder on ONE backend claim.
+
+    Live-window post-mortem (r4 windows 1 & 2): the axon tunnel relay
+    grants backend claims for only the first few minutes of a window —
+    stage #4-5's *subprocess* init then fails ``UNAVAILABLE`` while the
+    already-initialized clients keep working.  So stage isolation by
+    subprocess (one claim per stage) was exactly wrong on TPU: this
+    child claims once (the probe), then runs every stage in-process,
+    printing each JSON line immediately (the parent streams them, so
+    lines survive a parent-side timeout reap).
+    """
+    import signal
+
+    budget = float(os.environ.get("BENCH_BUDGET_SEC", "1200"))
+    deadline = time.monotonic() + budget
+    try:
+        scale = float(os.environ.get("BENCH_TIMEOUT_SCALE", "1"))
+    except ValueError:
+        scale = 1.0
+    if scale <= 0:
+        scale = 1.0
+    probe = stage_probe()                     # THE one backend claim
+    platform = probe.get("platform")
+    only = os.environ.get("BENCH_STAGES")
+    only = ({s.strip() for s in only.split(",")} if only else None)
+    warm = os.path.exists(os.path.join(_cache_dir(), ".alexnet_warm"))
+    order = _ladder_order(platform == "tpu", False, warm, only)
+
+    class _StageTimeout(Exception):
+        pass
+
+    def _alarm(_sig, _frame):
+        raise _StageTimeout()
+
+    # best-effort per-stage watchdog: a stage stuck in *Python* gets
+    # cut at its (scaled) cap so later stages — the warm order ends on
+    # the headline — still run.  A hang inside one blocking C call can
+    # defer the alarm until that call returns; the parent's whole-
+    # budget SIGTERM remains the backstop.  The cold-order flagship is
+    # exempt: its first compile IS the point and may take the window.
+    can_alarm = hasattr(signal, "SIGALRM")
+    if can_alarm:
+        signal.signal(signal.SIGALRM, _alarm)
+    dead = 0
+    for name in order:
+        remaining = deadline - time.monotonic()
+        if remaining < 45:
+            print("ladder: budget exhausted before %s" % name,
+                  file=sys.stderr)
+            break
+        cap = STAGES[name][1] * scale
+        if name == "alexnet" and not warm:
+            cap = remaining
+        try:
+            if can_alarm:
+                signal.alarm(max(1, int(min(cap, remaining))))
+            STAGES[name][0]()
+        except _StageTimeout:
+            print("ladder stage %s cut at its %ds cap" % (name, cap),
+                  file=sys.stderr)
+        except Exception as exc:
+            print("ladder stage %s failed: %r" % (name, exc),
+                  file=sys.stderr)
+            # an established client losing the backend fails FAST (no
+            # 25-min init) — two in a row means the window is gone
+            msg = str(exc)
+            if ("UNAVAILABLE" in msg or "DEADLINE_EXCEEDED" in msg
+                    or "unreachable" in msg):
+                dead += 1
+                if dead >= 2:
+                    print("ladder: backend looks dead; stopping",
+                          file=sys.stderr)
+                    break
+            else:
+                dead = 0
+        else:
+            dead = 0
+            if name == "alexnet" and platform == "tpu":
+                # conv programs proven cached -> future runs may take
+                # the full (warm) ladder
+                try:
+                    with open(os.path.join(_cache_dir(),
+                                           ".alexnet_warm"), "w") as fh:
+                        fh.write(probe.get("device_kind", "tpu"))
+                except OSError:
+                    pass
+        finally:
+            if can_alarm:
+                signal.alarm(0)
+    sys.stdout.flush()
 
 
 # --------------------------------------------------------------------------
@@ -728,15 +936,168 @@ def _run_stage(name, timeout, env=None, grace=300):
     return None, "no json in stage output"
 
 
+def _ladder_cmd():
+    """Child command for the one-claim ladder.  ``-u`` matters: the
+    child's lines must reach the streaming parent the moment they are
+    printed, so a parent-side reap can never lose a completed stage."""
+    return [sys.executable, "-u", os.path.abspath(__file__), "--ladder"]
+
+
+def _stream_ladder(budget, probe_cap):
+    """Spawn the one-claim ladder child, stream its stdout, and PRINT
+    every metric record immediately (flushed).
+
+    Returns ``(records, probe)`` — ``probe`` is None when no probe
+    line arrived inside ``probe_cap`` (tunnel down -> caller falls
+    back to CPU).  Non-JSON chatter (e.g. the profiler's report) is
+    forwarded to stderr.  On budget exhaustion the child gets SIGTERM
+    plus a long grace — a SIGKILL mid-claim wedges the tunnel relay
+    for hours (observed r3 twice, r4 once) — and the queue is drained
+    afterwards, so a line the child printed right at the deadline (or
+    during the grace) is still banked.
+    """
+    import queue
+    import threading
+
+    full_env = dict(os.environ)
+    try:
+        cache_dir = _cache_dir()
+        os.makedirs(cache_dir, exist_ok=True)
+        full_env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    except OSError:
+        pass
+    full_env["BENCH_BUDGET_SEC"] = str(budget)
+    proc = subprocess.Popen(
+        _ladder_cmd(), stdout=subprocess.PIPE, stderr=None, text=True,
+        env=full_env, cwd=os.path.dirname(os.path.abspath(__file__)))
+    lines = queue.Queue()
+
+    def pump():
+        for line in proc.stdout:
+            lines.put(line)
+        lines.put(None)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    records = []
+    state = {"probe": None}
+
+    def consume(line):
+        line = line.strip()
+        if not line:
+            return
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            print(line, file=sys.stderr)
+            return
+        if not isinstance(rec, dict):
+            print(line, file=sys.stderr)
+            return
+        if "platform" in rec and "metric" not in rec:
+            state["probe"] = rec
+            print("probe ok: %s" % json.dumps(rec), file=sys.stderr)
+            return
+        if "metric" not in rec:
+            print(line, file=sys.stderr)
+            return
+        if (state["probe"] or {}).get("platform") != "tpu":
+            # never let a non-TPU number pass as a TPU one
+            rec["metric"] += " [cpu-fallback]"
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    start = time.monotonic()
+    deadline = start + budget
+    probe_deadline = start + probe_cap
+    timed_out = False
+    while True:
+        now = time.monotonic()
+        cap = probe_deadline if state["probe"] is None else deadline
+        if now >= cap:
+            timed_out = True
+            break
+        try:
+            line = lines.get(timeout=min(cap - now, 5.0))
+        except queue.Empty:
+            continue
+        if line is None:
+            break
+        consume(line)
+    if timed_out:
+        print("ladder child %s; reaping (SIGTERM + grace)"
+              % ("produced no probe line in %ds" % probe_cap
+                 if state["probe"] is None else
+                 "hit the %ds budget" % budget),
+              file=sys.stderr)
+        proc.terminate()
+        try:
+            proc.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    proc.wait()
+    # drain everything the child managed to print before it exited —
+    # finished lines must survive the reap
+    while True:
+        try:
+            line = lines.get_nowait()
+        except queue.Empty:
+            break
+        if line is not None:
+            consume(line)
+    return records, state["probe"]
+
+
+def _cpu_fallback(deadline, scale, only):
+    """Per-stage-subprocess orchestration, CPU-pinned.  Subprocess
+    isolation is free on CPU (no tunnel claims) and protects against
+    a stage hanging past its cap."""
+    env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
+
+    def remaining():
+        return deadline - time.monotonic()
+
+    probe, err = _run_stage("probe", min(120, max(30.0, remaining())),
+                            env=env)
+    if probe is None:
+        print(json.dumps({
+            "metric": "benchmark unavailable (backend init failed)",
+            "value": 0.0, "unit": "images/sec", "vs_baseline": None,
+            "error": err}))
+        return
+    print("probe ok: %s" % json.dumps(probe), file=sys.stderr)
+    printed_any = False
+    for name in _ladder_order(False, True, False, only):
+        cap = STAGES[name][1] * scale
+        headroom = remaining()
+        if headroom < 45:
+            print("budget exhausted before %s" % name, file=sys.stderr)
+            break
+        result, err = _run_stage(name, min(cap, headroom), env=env)
+        if result is None:
+            print("stage %s failed: %s" % (name, err), file=sys.stderr)
+            continue
+        # tagged so a fallback line is never mistaken for a TPU number
+        result["metric"] += " [cpu-fallback]"
+        print(json.dumps(result), flush=True)
+        printed_any = True
+    if not printed_any:
+        print(json.dumps({
+            "metric": "benchmark failed (no stage completed on cpu)",
+            "value": 0.0, "unit": "images/sec", "vs_baseline": None}))
+
+
+#: stage_alexnet's exact metric string — the parent re-emits this
+#: record last so banked extras never displace the driver's headline
+HEADLINE_METRIC = "AlexNet fused train throughput per chip (bf16)"
+
+
 def main():
     budget = float(os.environ.get("BENCH_BUDGET_SEC", "1200"))
     deadline = time.monotonic() + budget
-    # r4 live-window finding: chip claims + matmul compiles are fast
-    # (~1 min/stage) but CONV-model first compiles blow the default
-    # per-stage caps.  BENCH_TIMEOUT_SCALE stretches every stage cap
-    # (probe included — slow windows slow the claim too) and the
-    # headline reserve, without touching the calibrated defaults; the
-    # compile cache then makes re-runs cheap again.
+    # BENCH_TIMEOUT_SCALE stretches the probe cap and the CPU-fallback
+    # stage caps (slow windows slow the claim too) without touching
+    # the calibrated defaults
     try:
         scale = float(os.environ.get("BENCH_TIMEOUT_SCALE", "1"))
     except ValueError:
@@ -752,151 +1113,37 @@ def main():
             print("BENCH_STAGES: unknown stage %r ignored" % s,
                   file=sys.stderr)
 
-    def remaining():
-        return deadline - time.monotonic()
-
-    # 1. backend probe (subprocess — a hung TPU init cannot hang us).
-    # BENCH_FORCE_CPU skips the TPU attempt entirely — for local smokes
-    # while another (serialized) client owns the tunnel claim.
-    env = {}
+    # BENCH_FORCE_CPU skips the TPU attempt entirely — for local
+    # smokes while another (serialized) client owns the tunnel claim.
     if os.environ.get("BENCH_FORCE_CPU"):
-        env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
-    cap = min(STAGES["probe"][1] * scale, max(30.0, remaining()))
-    probe, err = _run_stage("probe", cap, env=env)
-    if probe is None:
-        print("probe failed (%s); falling back to CPU" % err,
-              file=sys.stderr)
-        env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": None}
-        probe, err = _run_stage("probe", min(120, max(30.0, remaining())),
-                                env=env)
-        if probe is None:
-            print(json.dumps({
-                "metric": "benchmark unavailable (backend init failed)",
-                "value": 0.0, "unit": "images/sec", "vs_baseline": None,
-                "error": err}))
-            return
-    platform = probe.get("platform", "?")
-    # CPU fallback results are tagged so they are never mistaken for a
-    # TPU number
-    suffix = " [cpu-fallback]" if env else ""
-    print("probe ok: %s" % json.dumps(probe), file=sys.stderr)
+        _cpu_fallback(deadline, scale, only)
+        return
 
-    printed_any = False
-    # alexnet LAST: the final parsed line is the headline metric.  The
-    # earlier stages must never squeeze it out of the budget, so while
-    # it is still pending each optional stage only runs (and is only
-    # allowed to hang) inside remaining() minus a headline reserve.
-    order = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
-             "mnist_e2e_u8", "mnist_wf", "cifar",
-             "ae",
-             "kohonen", "lstm", "transformer", "power", "alexnet")
-    if env and not only:
-        # CPU fallback (rehearsed with a wedged tunnel): the conv/LM
-        # heavies cannot finish on CPU inside their caps — skip them
-        # and end on the flagship MNIST number so the recorded last
-        # line is a real measurement, not the last stage to survive.
-        # An explicit BENCH_STAGES selection overrides the skip (the
-        # operator asked for those stages, e.g. a tiny-config smoke).
-        order = ("mnist_e2e", "mnist_wf", "ae", "kohonen", "lstm",
-                 "mnist_u8", "mnist_bf16", "mnist")
-    cold_alexnet = False
-    if platform == "tpu" and not only and not env \
-            and budget < 3000 * scale:
-        # r4 live-window calibration: conv-model FIRST compiles exceed
-        # every default stage cap, so on a cold compile cache a
-        # default-budget run would burn its budget on doomed conv
-        # stages and time the AlexNet headline out.  Spend it on the
-        # lines that matter instead: the MLP ladder, then AlexNet with
-        # ALL remaining headroom.  "Warm" = a successful on-TPU
-        # AlexNet stage dropped the marker file (mere cache entries
-        # prove nothing — the probe itself caches a matmul).
-        if not os.path.exists(os.path.join(_cache_dir(),
-                                           ".alexnet_warm")):
-            print("cold compile cache + tight budget: flagship-priority"
-                  " ladder (conv first compiles need minutes each; run"
-                  " scripts/chip_session.sh to warm the cache for the"
-                  " full ladder)", file=sys.stderr)
-            # the headline first; if it lands with window to spare,
-            # keep banking the fast matmul-heavy stages (no cold conv
-            # compile) — transformer/lstm/e2e/power
-            order = ("mnist", "mnist_bf16", "mnist_u8", "alexnet",
-                     "transformer", "lstm", "mnist_e2e", "mnist_e2e_u8",
-                     "power")
-            cold_alexnet = True
-    ladder = [n for n in order if not only or n in only]
-    alexnet_pending = "alexnet" in ladder
-    headline_result = last_result = None
-    for name in ladder:
-        _fn, cap = STAGES[name]
-        cap *= scale
-        # the scaled reserve protects the AlexNet headline, but may
-        # never eat the whole budget of a small explicit-BENCH_STAGES
-        # run (e.g. the post-sweep re-bench) — cap it at 40 % so the
-        # other requested stages still get headroom
-        reserve = min(300 * scale, 0.4 * budget) \
-            if name != "alexnet" and alexnet_pending else 0
-        headroom = remaining() - reserve
-        if headroom < 45:
-            print("budget: skipping %s to protect the headline stage"
-                  % name if reserve else
-                  "budget exhausted before %s" % name, file=sys.stderr)
-            if reserve:
-                continue
-            break
-        # a reap after a timeout may only burn budget the reserve does
-        # NOT earmark for the headline stage
-        if name == "alexnet" and cold_alexnet:
-            # the remaining budget belongs to the cold headline compile
-            # (its 600 s default cap was calibrated warm) — MINUS a
-            # full SIGTERM grace, because a mid-compile SIGKILL wedges
-            # the tunnel relay for hours (observed r3 twice, r4 once)
-            cap = max(cap, headroom - 330)
-        stage_cap = min(cap, headroom)
-        result, err = _run_stage(
-            name, stage_cap, env=env,
-            grace=min(300, max(20, headroom - stage_cap)))
-        if name == "alexnet":
-            # win or lose, stop reserving: after a success the stages
-            # that follow the flagship in the ladder deserve the whole
-            # remaining window, and after a timeout the reserve would
-            # only protect a stage that already spent it
-            alexnet_pending = False
-            headline_result = result
-        if result is None:
-            print("stage %s failed: %s" % (name, err), file=sys.stderr)
-            continue
-        if name == "alexnet" and platform == "tpu" and not env \
-                and "error" not in result:
-            # a completed on-TPU AlexNet stage proves the conv
-            # programs are cached: future default-budget runs keep
-            # the full ladder (see the cold-cache check above)
-            try:
-                with open(os.path.join(_cache_dir(), ".alexnet_warm"),
-                          "w") as marker:
-                    marker.write(result.get("device_kind", "tpu"))
-            except OSError:
-                pass
-        if suffix:
-            result["metric"] += suffix
-        # incremental: each completed stage immediately becomes the
-        # latest (= best-so-far) parsed line on stdout
-        print(json.dumps(result), flush=True)
-        printed_any = True
-        last_result = result
-    if headline_result is not None and last_result is not headline_result:
-        # stages banked after the flagship must not displace it: the
-        # driver parses the LAST line as the round's headline metric,
-        # so re-emit the AlexNet result (duplicate line is deliberate)
-        print(json.dumps(headline_result), flush=True)
-    if not printed_any:
+    probe_cap = min(STAGES["probe"][1] * scale, max(30.0, budget))
+    records, probe = _stream_ladder(budget, probe_cap)
+    if probe is None and not records:
+        print("no probe line from the ladder child; falling back to "
+              "CPU", file=sys.stderr)
+        _cpu_fallback(deadline, scale, only)
+        return
+    headline = next((r for r in records
+                     if r.get("metric") == HEADLINE_METRIC
+                     and "error" not in r), None)
+    if headline is not None and records[-1] is not headline:
+        # the driver parses the LAST line as the round's headline
+        # metric (duplicate line is deliberate)
+        print(json.dumps(headline), flush=True)
+    if not records:
         print(json.dumps({
             "metric": "benchmark failed (no stage completed on %s)"
-                      % platform,
+                      % (probe or {}).get("platform", "?"),
             "value": 0.0, "unit": "images/sec", "vs_baseline": None}))
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 3 and sys.argv[1] == "--stage":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--ladder":
+        stage_ladder()
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--stage":
         STAGES[sys.argv[2]][0]()
     else:
         main()
