@@ -34,8 +34,8 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
-note "tunnel LIVE — starting chip_session"
-bash scripts/chip_session.sh chip_session_logs_r4
+note "tunnel LIVE — starting chip_session (v2: one claim per step)"
+bash scripts/chip_session_v2.sh chip_session_logs_r4
 rc=$?
 note "chip_session done rc=$rc"
 exit "$rc"

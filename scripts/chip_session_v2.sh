@@ -1,0 +1,79 @@
+#!/bin/bash
+# One serialized TPU session, MINIMUM backend claims — live-window
+# post-mortems (r4 windows 1 & 2) showed the tunnel relay stops
+# GRANTING claims a few minutes into a window while established
+# clients keep working, so every extra process = a doomed re-claim.
+#
+#     bash scripts/chip_session_v2.sh [outdir]
+#
+# 1/3  one-claim bench ladder — probe, MLP lines, the AlexNet headline
+#      (+ batch-512 sweep point), PROFILE.md, the s2d A/B, LM/LSTM/
+#      e2e/power — ALL inside a single child process (bench.py
+#      --ladder design).
+# 2/3  autotune sweep, precision levels 0,1,2 in ONE invocation.
+# 3/3  warm re-bench of the heavies with the fresh DB.
+#
+# Exit 0 only when the AlexNet headline landed on real hardware —
+# the probe loop keeps retrying windows until it does.  Nothing here
+# SIGKILLs a JAX client (a mid-claim kill wedges the relay for hours).
+set -u
+OUT=${1:-chip_session_logs}
+mkdir -p "$OUT"
+
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-$(python -c \
+    'from veles_tpu.backends import COMPILE_CACHE_DIR; print(COMPILE_CACHE_DIR)' \
+    2>/dev/null || echo "$HOME/.veles_tpu/cache/xla")}
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+# r4 live-window calibration: claims + conv first compiles over the
+# tunnel need ~4x the local caps
+export BENCH_TIMEOUT_SCALE=${BENCH_TIMEOUT_SCALE:-4}
+
+note() { echo "[chip_session $(date +%H:%M:%S)] $*" >&2; }
+
+headline_landed() {
+    python - "$@" <<'PY'
+import json
+import sys
+
+for path in sys.argv[1:]:
+    try:
+        lines = open(path).readlines()
+    except OSError:
+        continue
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if (rec.get("metric") ==
+                "AlexNet fused train throughput per chip (bf16)"
+                and "TPU" in (rec.get("device_kind") or "")):
+            sys.exit(0)
+sys.exit(1)
+PY
+}
+
+note "1/3 one-claim bench ladder (headline + PROFILE.md + s2d ride ONE claim)"
+BENCH_BUDGET_SEC=${BENCH_BUDGET_SEC:-6000} \
+    python bench.py >"$OUT/bench.jsonl" 2>"$OUT/bench.log"
+note "bench rc=$? (lines: $(wc -l <"$OUT/bench.jsonl"))"
+
+if ! headline_landed "$OUT/bench.jsonl"; then
+    note "AlexNet headline NOT banked — skipping the sweep so the"
+    note "probe loop retries the ladder at the next window"
+    exit 1
+fi
+
+note "2/3 autotune sweep (levels 0,1,2 + attention + power, one claim)"
+python -m veles_tpu.scripts.autotune --precision-levels 0,1,2 \
+    >"$OUT/autotune.json" 2>"$OUT/autotune.log"
+note "autotune rc=$? (DB: veles_tpu/devices/device_infos.json)"
+
+note "3/3 re-bench the heavies with the fresh per-shape-class DB"
+BENCH_STAGES=mnist,lstm,transformer,alexnet \
+    BENCH_BUDGET_SEC=3600 \
+    python bench.py >"$OUT/bench_tuned.jsonl" 2>"$OUT/bench_tuned.log"
+note "tuned re-bench rc=$? (lines: $(wc -l <"$OUT/bench_tuned.jsonl"))"
+note "done — run scripts/collect_chip_session.py $OUT to snapshot the"
+note "evidence, then commit chip_session_r4/, PROFILE.md and the DB"
+exit 0
