@@ -542,7 +542,10 @@ def stage_transformer():
     else:
         cfg = {"vocab": 32000, "dim": 512, "heads": 8, "layers": 8,
                "mlp_ratio": 4, "seq_len": 1024}
-    batch = int(os.environ.get("BENCH_LM_BATCH", "8"))
+    # batch 32 = 32k tokens/step: the chunked-CE readout (transformer.
+    # make_train_step ce_chunk) keeps logits memory at O(B·128·V), so
+    # the old full-[B,S,V]-logits batch ceiling no longer applies
+    batch = int(os.environ.get("BENCH_LM_BATCH", "32"))
     params = transformer.init_params(cfg, seed=0)
     velocity = jax.tree.map(numpy.zeros_like, params)
     raw_step = transformer.make_train_step(cfg)

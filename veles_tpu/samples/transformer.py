@@ -134,9 +134,9 @@ def _block(h, blk, mesh, seq_axis, compute_dtype):
     return h + down.astype(h.dtype)
 
 
-def apply_fn(params, tokens, cfg=None, mesh=None, seq_axis="seq",
-             compute_dtype=jnp.bfloat16, remat=True):
-    """tokens [B, S] int32 → logits [B, S, V]."""
+def hidden_fn(params, tokens, cfg=None, mesh=None, seq_axis="seq",
+              compute_dtype=jnp.bfloat16, remat=True):
+    """tokens [B, S] int32 → final-LN hidden states [B, S, d]."""
     h = params["embed"][tokens] + params["pos"][: tokens.shape[1]]
     if mesh is not None:
         h = jax.lax.with_sharding_constraint(
@@ -151,7 +151,14 @@ def apply_fn(params, tokens, cfg=None, mesh=None, seq_axis="seq",
         return body(h, blk), None
 
     h, _ = jax.lax.scan(scan_body, h, params["blocks"])
-    h = _layernorm(h, params["lnf_g"], params["lnf_b"])
+    return _layernorm(h, params["lnf_g"], params["lnf_b"])
+
+
+def apply_fn(params, tokens, cfg=None, mesh=None, seq_axis="seq",
+             compute_dtype=jnp.bfloat16, remat=True):
+    """tokens [B, S] int32 → logits [B, S, V]."""
+    h = hidden_fn(params, tokens, cfg, mesh=mesh, seq_axis=seq_axis,
+                  compute_dtype=compute_dtype, remat=remat)
     # weight-tied readout (embed^T) keeps the TINY config honest
     logits = jnp.einsum("bsd,vd->bsv", h.astype(compute_dtype),
                         params["embed"].astype(compute_dtype),
@@ -160,19 +167,76 @@ def apply_fn(params, tokens, cfg=None, mesh=None, seq_axis="seq",
 
 
 def make_train_step(cfg, mesh=None, seq_axis="seq", lr=3e-4,
-                    compute_dtype=jnp.bfloat16, remat=True):
+                    compute_dtype=jnp.bfloat16, remat=True,
+                    ce_chunk=128):
     """(params, opt_state, tokens) → next-token CE loss, SGD+momentum
-    update — one XLA program."""
+    update — one XLA program.
+
+    ``ce_chunk``: the cross-entropy never materializes the full
+    ``[B, S, V]`` logits (4.2 GB at B=32/S=1024/V=32k in f32); a
+    ``lax.scan`` over sequence chunks computes per-chunk logits +
+    logsumexp, so CE memory is O(B·chunk·V) and the readout matmul
+    stays MXU-sized.  The backward recomputes each chunk's logits —
+    the same trade remat already makes for the blocks.  ``ce_chunk=0``
+    keeps the plain full-logits path (the equivalence oracle in
+    tests/test_parallel.py)."""
+
+    # chunked CE serializes the readout over the scan axis, which a
+    # sequence-parallel mesh cannot shard — there the OLD path is the
+    # faster one (GSPMD shards the [B,S,V] readout along seq), so
+    # chunking applies only when the seq axis is unsharded
+    use_chunks = bool(ce_chunk) and (
+        mesh is None or mesh.shape.get(seq_axis, 1) <= 1)
 
     def loss_fn(params, tokens):
-        logits = apply_fn(params, tokens, cfg, mesh=mesh,
-                          seq_axis=seq_axis,
-                          compute_dtype=compute_dtype, remat=remat)
         targets = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-        picked = jnp.take_along_axis(
-            logp, targets[..., None], axis=-1)[..., 0]
-        return -picked.mean()
+        if not use_chunks:
+            logits = apply_fn(params, tokens, cfg, mesh=mesh,
+                              seq_axis=seq_axis,
+                              compute_dtype=compute_dtype, remat=remat)
+            logp = jax.nn.log_softmax(
+                logits[:, :-1].astype(jnp.float32))
+            picked = jnp.take_along_axis(
+                logp, targets[..., None], axis=-1)[..., 0]
+            return -picked.mean()
+        h = hidden_fn(params, tokens, cfg, mesh=mesh, seq_axis=seq_axis,
+                      compute_dtype=compute_dtype, remat=remat)
+        hs = h[:, :-1]
+        batch, n, _d = hs.shape
+        chunk = min(ce_chunk, n)
+        k = -(-n // chunk)
+        pad = k * chunk - n
+        hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
+        tg = jnp.pad(targets, ((0, 0), (0, pad)))
+        # [k, B, chunk, ...] so the scan carries only the running sum
+        hs = hs.reshape(batch, k, chunk, -1).transpose(1, 0, 2, 3)
+        tg = tg.reshape(batch, k, chunk).transpose(1, 0, 2)
+        valid = (jnp.arange(k * chunk) < n).reshape(k, chunk)
+        emb = params["embed"]
+
+        # checkpoint is what makes the chunking real: without it the
+        # forward scan stacks each chunk's softmax residual and the
+        # backward still carries the full [B, S-1, V] tensor (verified
+        # by jaxpr inspection); with it the backward recomputes each
+        # chunk's logits from [B, chunk, d]
+        @jax.checkpoint
+        def chunk_nll_sum(hc, tc, mask):
+            logits = jnp.einsum("bcd,vd->bcv",
+                                hc.astype(compute_dtype),
+                                emb.astype(compute_dtype),
+                                preferred_element_type=jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(
+                logits, tc[..., None], axis=-1)[..., 0]
+            return ((lse - picked) * mask).sum()
+
+        def chunk_nll(total, xs):
+            hc, tc, mask = xs
+            return total + chunk_nll_sum(hc, tc, mask), None
+
+        total, _ = jax.lax.scan(chunk_nll, jnp.float32(0.0),
+                                (hs, tg, valid))
+        return total / (batch * n)
 
     def step(params, velocity, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
@@ -210,14 +274,16 @@ def param_specs(params, seq_axis="seq"):
 
 
 def build_train(cfg=None, mesh=None, seq_axis="seq", lr=3e-4,
-                compute_dtype=jnp.bfloat16, remat=True, seed=0):
+                compute_dtype=jnp.bfloat16, remat=True, seed=0,
+                ce_chunk=128):
     """(params, velocity, jitted step).  With a mesh: DP×TP×SP shardings
     applied via in/out_shardings; without: plain single-device jit."""
     cfg = cfg or CONFIG
     params = init_params(cfg, seed=seed)
     velocity = jax.tree.map(numpy.zeros_like, params)
     step = make_train_step(cfg, mesh=mesh, seq_axis=seq_axis, lr=lr,
-                           compute_dtype=compute_dtype, remat=remat)
+                           compute_dtype=compute_dtype, remat=remat,
+                           ce_chunk=ce_chunk)
     if mesh is None:
         return params, velocity, jax.jit(step, donate_argnums=(0, 1))
     specs = param_specs(params, seq_axis)
