@@ -229,6 +229,45 @@ def test_transformer_chunked_ce_matches_full_logits():
                                       numpy.asarray(b), atol=1e-6)
 
 
+def test_transformer_chunked_ce_backward_stores_no_vocab_residual():
+    """The checkpoint inside the CE scan is what makes the chunking
+    real: without it the forward scan stacks each chunk's softmax
+    residual and the backward carries the full [*, *, *, V] tensor.
+    Guard: no intermediate in the grad jaxpr may have a stacked
+    4-D shape ending in the vocab dimension."""
+    from veles_tpu.samples import transformer as T
+    cfg = dict(T.TINY)
+    step = T.make_train_step(cfg, compute_dtype=jnp.float32, ce_chunk=4)
+    p0 = T.init_params(cfg, seed=0)
+    v0 = jax.tree.map(numpy.zeros_like, p0)
+    toks = T.synthetic_tokens(cfg, 4)
+    jaxpr = jax.make_jaxpr(step)(p0, v0, toks)
+
+    def shapes(jx, out):
+        for eqn in jx.eqns:
+            for var in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(var, "aval", None)
+                if aval is not None and hasattr(aval, "shape"):
+                    out.add(tuple(aval.shape))
+            for val in eqn.params.values():
+                inner = getattr(val, "jaxpr", None)
+                if inner is not None:
+                    shapes(inner, out)
+                if isinstance(val, (list, tuple)):
+                    for item in val:
+                        inner = getattr(item, "jaxpr", None)
+                        if inner is not None:
+                            shapes(inner, out)
+        return out
+
+    seen = shapes(jaxpr.jaxpr, set())
+    stacked_vocab = [s for s in seen
+                     if len(s) == 4 and s[-1] == cfg["vocab"]]
+    assert not stacked_vocab, (
+        "full-vocab residual stacked across CE chunks: %s" %
+        stacked_vocab)
+
+
 def test_transformer_mesh_chunked_ce_runs():
     """Chunked CE under a DP×TP mesh (seq unsharded -> chunking ON);
     a seq-sharded mesh falls back to the GSPMD-sharded full-logits
