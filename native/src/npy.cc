@@ -139,6 +139,13 @@ NpyArray LoadNpy(const uint8_t* bytes, size_t len) {
   } else if (descr == "|u1") {
     need(1);
     for (int64_t i = 0; i < count; ++i) arr.data[i] = payload[i];
+  } else if (descr == "|i1") {
+    // int8 quantized packages (precision=8): raw codes here; the
+    // workflow loader applies the per-channel ".scale" companions
+    need(1);
+    const int8_t* d = reinterpret_cast<const int8_t*>(payload);
+    for (int64_t i = 0; i < count; ++i)
+      arr.data[i] = static_cast<float>(d[i]);
   } else if (descr == "<i4") {
     need(4);
     const int32_t* d = reinterpret_cast<const int32_t*>(payload);

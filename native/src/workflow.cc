@@ -64,6 +64,36 @@ void Workflow::Initialize(int64_t batch) {
                                  kv.second->string_value());
       arrays[kv.first] = LoadNpy(file->second.data(), file->second.size());
     }
+    // int8 quantized packages (precision=8): a "<name>.scale"
+    // companion holds per-output-channel (last axis) float scales;
+    // dequantize at load so the units always see float weights —
+    // the exact rule of package.py's dequantize_arrays
+    for (auto it2 = arrays.begin(); it2 != arrays.end();) {
+      const std::string& key = it2->first;
+      static const std::string kSuffix = ".scale";
+      if (key.size() <= kSuffix.size() ||
+          key.compare(key.size() - kSuffix.size(), kSuffix.size(),
+                      kSuffix) != 0) {
+        ++it2;
+        continue;
+      }
+      std::string base = key.substr(0, key.size() - kSuffix.size());
+      auto tgt = arrays.find(base);
+      if (tgt != arrays.end()) {
+        const std::vector<float>& scale = it2->second.data;
+        std::vector<float>& data = tgt->second.data;
+        if (scale.empty() || tgt->second.shape.empty() ||
+            static_cast<size_t>(tgt->second.shape.back()) !=
+                scale.size() ||
+            data.size() % scale.size() != 0)
+          throw std::runtime_error("bad quantization scales for " +
+                                   base);
+        size_t c = scale.size();
+        for (size_t i = 0; i < data.size(); ++i)
+          data[i] *= scale[i % c];
+      }
+      it2 = arrays.erase(it2);
+    }
     unit->Initialize(*entry->at("config"), std::move(arrays), shape);
     shape = unit->output_shape();
 

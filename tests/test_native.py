@@ -57,6 +57,31 @@ def test_mlp_zip(lib, tmp_path):
         assert numpy.allclose(out.sum(axis=1), 1.0, atol=1e-5)
 
 
+def test_int8_package_native_matches_python_runner(lib, tmp_path):
+    """precision=8 packages: the C++ loader's per-channel dequantize
+    must agree with package.py's (identical dequantized weights ->
+    float-tolerance agreement), and the quantized predictions must
+    match the fp32 golden's argmax."""
+    from veles_tpu.znicz.all2all import All2AllSoftmax, All2AllTanh
+    from veles_tpu.znicz.conv import ConvTanh
+    from veles_tpu.znicz.pooling import MaxPooling
+    rng = numpy.random.default_rng(5)
+    x = rng.standard_normal((4, 10, 10, 2)).astype(numpy.float32)
+    forwards, golden = _chain(
+        [(ConvTanh, {"n_kernels": 6, "kx": 3, "ky": 3}),
+         (MaxPooling, {"kx": 2, "ky": 2}),
+         (All2AllTanh, {"output_sample_shape": (20,)}),
+         (All2AllSoftmax, {"output_sample_shape": (5,)})], x)
+    path = str(tmp_path / "mlp8.zip")
+    export_package(forwards, path, precision=8, with_stablehlo=False)
+    py_out = PackagedRunner(path).run(x)
+    with native.NativeWorkflow(path) as wf:
+        out = wf.run(x)
+        assert out.shape == py_out.shape
+        assert numpy.allclose(out, py_out, atol=1e-4)
+    assert (py_out.argmax(-1) == golden.argmax(-1)).all()
+
+
 def test_convnet_tgz(lib, tmp_path):
     from veles_tpu.znicz.all2all import All2AllSoftmax
     from veles_tpu.znicz.conv import ConvTanh

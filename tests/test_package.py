@@ -3,6 +3,7 @@ zip/tgz, fp16 precision, PackagedRunner golden vs the live units —
 mirrors the reference's packaged-model round-trip tests
 (libVeles/tests/workflow_loader.cc against mnist.zip/mnist.tar.gz)."""
 
+import io
 import json
 import zipfile
 
@@ -90,6 +91,51 @@ def test_fp16_precision(convnet, tmp_path):
         assert arr.dtype == numpy.float16
     out = PackagedRunner(path).run(x)
     assert numpy.allclose(out, golden, atol=5e-2)
+
+
+def test_int8_precision(convnet, tmp_path):
+    """precision=8: weights stored as per-output-channel symmetric
+    int8 + float scales; the runner dequantizes at load and the
+    predictions survive quantization."""
+    x, forwards, golden = convnet
+    path = str(tmp_path / "model8.zip")
+    contents = export_package(forwards, path, precision=8,
+                              with_stablehlo=False)
+    assert contents["precision"] == 8
+    with zipfile.ZipFile(path) as z:
+        arrays = contents["units"][0]["arrays"]
+        w = numpy.load(io.BytesIO(z.read(arrays["weights"])))
+        s = numpy.load(io.BytesIO(z.read(arrays["weights.scale"])))
+        assert w.dtype == numpy.int8
+        assert s.dtype == numpy.float32
+        assert s.shape == (w.shape[-1],)
+        assert numpy.abs(w).max() <= 127
+        # bias is NOT quantized
+        assert "bias.scale" not in arrays
+    out = PackagedRunner(path).run(x)
+    assert out.shape == golden.shape
+    assert numpy.allclose(out, golden, atol=1e-1)
+    assert (out.argmax(-1) == golden.argmax(-1)).all()
+    assert numpy.allclose(out.sum(axis=-1), 1.0, atol=1e-5)
+
+
+def test_int8_package_is_smaller(tmp_path):
+    """On a weight-dominated model the int8 package approaches 1/4 the
+    fp32 size (random weights don't deflate)."""
+    import os
+
+    rng = numpy.random.default_rng(11)
+    x = rng.standard_normal((2, 256)).astype(numpy.float32)
+    wf = DummyWorkflow()
+    fc = All2AllTanh(wf, output_sample_shape=(256,))
+    fc.input = Vector(x.copy())
+    fc.initialize(NumpyDevice())
+    fc.numpy_run()
+    p32 = str(tmp_path / "m32.zip")
+    p8 = str(tmp_path / "m8.zip")
+    export_package([fc], p32, with_stablehlo=False)
+    export_package([fc], p8, precision=8, with_stablehlo=False)
+    assert os.path.getsize(p8) < 0.4 * os.path.getsize(p32)
 
 
 def test_contents_schema(convnet, tmp_path):

@@ -99,8 +99,26 @@ def _unit_export_entry(unit, array_refs):
     return entry
 
 
+def _quantize_int8(arr):
+    """Per-output-channel symmetric int8: scale_j = max|w[..., j]|/127.
+    Returns (int8 array, float32 scales over the last axis)."""
+    flat = arr.reshape(-1, arr.shape[-1]) if arr.ndim > 1 \
+        else arr.reshape(1, -1)
+    scale = numpy.abs(flat).max(axis=0) / 127.0
+    scale = numpy.where(scale == 0, 1.0, scale).astype(numpy.float32)
+    q = numpy.clip(numpy.rint(arr / scale), -127, 127)
+    return q.astype(numpy.int8), scale
+
+
 def _collect_arrays(unit, precision):
-    """name → numpy array (host-synced, precision-cast) for one unit."""
+    """name → numpy array (host-synced, precision-cast) for one unit.
+
+    ``precision=8``: weights are per-output-channel symmetric int8
+    (scales stored alongside as ``weights.scale``); bias/mean/disp stay
+    float32 — the loaders (PackagedRunner and the native engine's
+    workflow loader) dequantize at load, so compute stays float and
+    the package is 4× smaller than fp32 (the same trade the fp16
+    packages make at 2×)."""
     dtype = numpy.float16 if precision == 16 else numpy.float32
     out = {}
     # rdisp is MeanDispNormalizer's reciprocal dispersion; packaged as
@@ -121,7 +139,34 @@ def _collect_arrays(unit, precision):
         # layout so the golden model and native engine never need the
         # storage knob
         out["weights"] = numpy.ascontiguousarray(out["weights"].T)
+    if precision == 8 and out.get("weights") is not None:
+        q, scale = _quantize_int8(out["weights"])
+        out["weights"] = q
+        out["weights.scale"] = scale
     return out
+
+
+def dequantize_arrays(arrays):
+    """Resolve ``<name>.scale`` companions in-place: the int8 payload
+    (already float-typed by the loader) is multiplied by its per-last-
+    axis scales and the companion entry removed.  Shared by
+    :class:`PackagedRunner`; the native engine applies the same rule in
+    C++ (``native/src/workflow.cc``)."""
+    for key in [k for k in arrays if k.endswith(".scale")]:
+        base = key[:-len(".scale")]
+        scale = arrays.pop(key)
+        if base not in arrays:
+            continue
+        arr = arrays[base]
+        if scale.size and arr.shape[-1] == scale.size:
+            # the multiply's f32 output buffer is the only copy made
+            arrays[base] = numpy.asarray(arr, numpy.float32) \
+                * numpy.asarray(scale, numpy.float32)
+        else:
+            raise ValueError(
+                "scale %r (%d entries) does not match %r last axis %r"
+                % (key, scale.size, base, arr.shape))
+    return arrays
 
 
 def _npy_bytes(array):
@@ -194,8 +239,8 @@ def export_package(workflow_or_forwards, path, precision=32,
     :class:`veles_tpu.znicz.standard_workflow.StandardWorkflow`) or an
     explicit list of forward units in execution order.
     """
-    if precision not in (16, 32):
-        raise ValueError("precision must be 16 or 32")
+    if precision not in (8, 16, 32):
+        raise ValueError("precision must be 8, 16 or 32")
     forwards = getattr(workflow_or_forwards, "forwards",
                        workflow_or_forwards)
     if not forwards:
@@ -445,6 +490,7 @@ class PackagedRunner(object):
                 name: numpy.load(io.BytesIO(files[ref]),
                                  allow_pickle=False).astype(numpy.float32)
                 for name, ref in entry["arrays"].items()}
+            dequantize_arrays(arrays)
             self.units.append((entry["type"], entry["config"], arrays))
 
     @property
