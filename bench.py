@@ -637,6 +637,59 @@ def stage_alexnet():
         steps=10, vs=V100_ALEXNET_IMG_PER_SEC)
 
 
+def stage_alexnet_e2e():
+    """AlexNet through the REAL framework data path (the conv leg of
+    VERDICT r3 item 3): a u8 ImageNet-shaped dataset resident in HBM,
+    the FullBatchLoader's device gather per minibatch, in-step scale
+    normalization, feeding the StandardWorkflow(fused=True) trainer's
+    own jitted bf16 step.  Compare images/sec against the synthetic-
+    batch ``alexnet`` line to see what the input pipeline costs."""
+    import numpy
+
+    import jax.numpy as jnp
+    from veles_tpu import prng
+    from veles_tpu.backends import AutoDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.samples import alexnet
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    shape = alexnet.INPUT_SHAPE
+    n_samples = int(os.environ.get("BENCH_ALEXNET_E2E_SAMPLES", "4096"))
+    if os.environ.get("BENCH_ALEXNET_E2E_TINY"):  # CPU smoke of the path
+        shape, n_samples = (67, 67, 3), 32
+
+    class SyntheticImageNetLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            self.original_data.mem = rng.integers(
+                0, 256, (n_samples,) + shape, dtype=numpy.uint8)
+            self.original_labels = [
+                int(v) for v in rng.integers(0, 1000, n_samples)]
+            self.class_lengths[:] = [0, 0, n_samples]
+
+    prng.seed_all(1234)
+    batch = int(os.environ.get("BENCH_ALEXNET_BATCH", "256"))
+    if os.environ.get("BENCH_ALEXNET_E2E_TINY"):
+        batch = 8
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: SyntheticImageNetLoader(
+            w, minibatch_size=batch, native_device_dtype=True,
+            normalization_type="scale"),
+        layers=[{**spec} for spec in alexnet.LAYERS],
+        decision_config={"max_epochs": 10 ** 6},
+        fused=True,
+        fused_config={"compute_dtype": jnp.bfloat16, "remat": True})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=AutoDevice())
+    trainer = wf.fused_trainer
+    trainer._build()
+    _e2e_loop("AlexNet end-to-end workflow throughput "
+              "(u8-resident loader+gather+fused bf16 step)",
+              wf.loader, trainer._params_, trainer._step_)
+
+
 def stage_alexnet512():
     """Batch sweep point: the same flagship at batch 512 (was
     chip_session.sh step 2b; folded into the ladder so it rides the
@@ -721,6 +774,7 @@ STAGES = {
     "transformer": (stage_transformer, 240),
     "power": (stage_power, 240),
     "alexnet": (stage_alexnet, 600),
+    "alexnet_e2e": (stage_alexnet_e2e, 450),
     "alexnet512": (stage_alexnet512, 600),
     "profile": (stage_profile, 600),
     "s2d": (stage_s2d, 300),
@@ -732,7 +786,7 @@ STAGES = {
 _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_e2e_u8", "mnist_wf", "cifar", "ae", "kohonen",
                "lstm", "transformer", "power", "s2d", "alexnet512",
-               "profile", "alexnet")
+               "alexnet_e2e", "profile", "alexnet")
 
 #: Cold compile cache: the flagship right after the one cheap stage
 #: that proves the chip + stopwatch work.  Live-window post-mortems
@@ -741,9 +795,9 @@ _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
 #: attempted EARLY and on ONE claim — MLP re-runs and extras come
 #: after the headline artifacts.
 _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
-               "s2d", "alexnet512", "transformer", "lstm", "mnist_e2e",
-               "mnist_e2e_u8", "power", "cifar", "ae", "kohonen",
-               "mnist_wf")
+               "s2d", "alexnet512", "alexnet_e2e", "transformer",
+               "lstm", "mnist_e2e", "mnist_e2e_u8", "power", "cifar",
+               "ae", "kohonen", "mnist_wf")
 
 #: CPU fallback (rehearsed with a wedged tunnel): conv/LM heavies
 #: cannot finish on CPU inside their caps — end on the flagship MNIST

@@ -22,7 +22,9 @@ Workflow::Workflow(const std::string& path) : engine_(0) {
     throw std::runtime_error("package has no contents.json");
   contents_ = JsonParser::Parse(
       std::string(it->second.begin(), it->second.end()));
-  if (contents_->at("format_version")->integer() != 1)
+  int64_t fmt = contents_->at("format_version")->integer();
+  // v2 = int8 quantized packages (this loader dequantizes at load)
+  if (fmt != 1 && fmt != 2)
     throw std::runtime_error("unsupported package format_version");
   name_ = contents_->has("name")
       ? contents_->at("name")->string_value() : "model";

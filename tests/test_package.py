@@ -99,9 +99,12 @@ def test_int8_precision(convnet, tmp_path):
     predictions survive quantization."""
     x, forwards, golden = convnet
     path = str(tmp_path / "model8.zip")
-    contents = export_package(forwards, path, precision=8,
-                              with_stablehlo=False)
+    contents = export_package(forwards, path, precision=8)
     assert contents["precision"] == 8
+    # int8 needs a dequantizing reader: pre-int8 readers fail closed
+    assert contents["format_version"] == 2
+    # no fp32 StableHLO blob riding along with quantized weights
+    assert "stablehlo" not in contents
     with zipfile.ZipFile(path) as z:
         arrays = contents["units"][0]["arrays"]
         w = numpy.load(io.BytesIO(z.read(arrays["weights"])))

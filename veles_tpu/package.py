@@ -28,6 +28,9 @@ import zipfile
 import numpy
 
 FORMAT_VERSION = 1
+#: int8 packages need a dequantizing reader — they declare version 2 so
+#: pre-int8 readers fail closed instead of silently using raw codes
+INT8_FORMAT_VERSION = 2
 STABLEHLO_NAME = "model.stablehlo"
 CONTENTS_NAME = "contents.json"
 
@@ -261,13 +264,19 @@ def export_package(workflow_or_forwards, path, precision=32,
     input_shape = list(forwards[0].input.shape) \
         if getattr(forwards[0], "input", None) is not None else None
     contents = {
-        "format_version": FORMAT_VERSION,
+        "format_version": INT8_FORMAT_VERSION
+        if precision == 8 else FORMAT_VERSION,
         "framework": "veles_tpu",
         "name": name or getattr(workflow_or_forwards, "name", "model"),
         "precision": precision,
         "input_shape": input_shape,
         "units": units,
     }
+    if precision == 8:
+        # the StableHLO blob would embed the live fp32 weights — a
+        # second, divergent weight set that also defeats the 4x size
+        # reduction; int8 packages are interpretable-units only
+        with_stablehlo = False
     if with_stablehlo and input_shape:
         blob = export_stablehlo(forwards, input_shape)
         if blob:
@@ -472,7 +481,8 @@ class PackagedRunner(object):
         files = path_or_files if isinstance(path_or_files, dict) \
             else _read_package(path_or_files)
         self.contents = json.loads(files[CONTENTS_NAME].decode())
-        if self.contents.get("format_version") != FORMAT_VERSION:
+        if self.contents.get("format_version") not in (
+                FORMAT_VERSION, INT8_FORMAT_VERSION):
             raise ValueError("unsupported package format %r"
                              % self.contents.get("format_version"))
         expected = self.contents.get("checksum")
