@@ -94,6 +94,13 @@ void TestNpy() {
   auto arri = LoadNpy(blobi.data(), blobi.size());
   CHECK(arri.data[0] == -7.0f && arri.data[2] == 42.0f);
 
+  // int8 quantized codes widen signed (the precision=8 package path)
+  int8_t codes[4] = {-127, -1, 0, 127};
+  auto blob8 = MakeNpy("|i1", "(4,)", codes, sizeof(codes));
+  auto arr8 = LoadNpy(blob8.data(), blob8.size());
+  CHECK(arr8.data[0] == -127.0f && arr8.data[1] == -1.0f &&
+        arr8.data[3] == 127.0f);
+
   // fortran order and foreign endianness are rejected loudly
   auto fblob = MakeNpy("<f4", "(2, 3)", data, sizeof(data), true);
   CHECK_THROWS(LoadNpy(fblob.data(), fblob.size()));
