@@ -637,6 +637,67 @@ def stage_alexnet():
         steps=10, vs=V100_ALEXNET_IMG_PER_SEC)
 
 
+def stage_native_infer():
+    """Native C++ engine serving throughput (HOST CPU, no Python/JAX
+    in the inference loop): the MNIST MLP exported as an int8 package
+    (precision=8, 1/4 the fp32 bytes) and executed by the libVeles-
+    equivalent runtime — the reference's C++ serving story, measured.
+    Deliberately labeled host-cpu so it can never be mistaken for a
+    chip number."""
+    import tempfile
+    import time as _time
+
+    import numpy
+
+    from veles_tpu import native
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.memory import Vector
+    from veles_tpu.package import export_package
+    from veles_tpu.znicz.all2all import All2AllSoftmax, All2AllTanh
+
+    rng = numpy.random.default_rng(0)
+    batch = 1024
+    x = rng.standard_normal((batch, 784)).astype(numpy.float32)
+    wf = DummyWorkflow()
+    dev = NumpyDevice()
+    fc = All2AllTanh(wf, output_sample_shape=(100,))
+    fc.input = Vector(x.copy())
+    fc.initialize(dev)
+    fc.numpy_run()
+    sm = All2AllSoftmax(wf, output_sample_shape=(10,))
+    sm.input = fc.output
+    sm.initialize(dev)
+    sm.numpy_run()
+    with tempfile.TemporaryDirectory() as tdir:
+        path = os.path.join(tdir, "mlp8.zip")
+        export_package([fc, sm], path, precision=8,
+                       with_stablehlo=False)
+        sm.output.map_read()
+        golden = numpy.array(sm.output.mem)
+        with native.NativeWorkflow(path) as nwf:
+            warm = nwf.run(x)                       # warm (arena init)
+            # never rate an engine with silently wrong numerics: the
+            # int8 predictions must match the fp32 golden's argmax
+            if (warm.argmax(-1) != golden.argmax(-1)).any():
+                raise RuntimeError(
+                    "native int8 predictions diverge from the fp32 "
+                    "golden — refusing to publish a throughput number")
+            k = 0
+            tic = _time.perf_counter()
+            while _time.perf_counter() - tic < 2.0:
+                nwf.run(x)
+                k += 1
+            elapsed = _time.perf_counter() - tic
+    print(json.dumps({
+        "metric": "MNIST784 MLP native C++ engine inference "
+                  "(int8 package)",
+        "value": round(batch * k / elapsed, 1), "unit": "images/sec",
+        "vs_baseline": None,
+        "sec_per_batch": round(elapsed / k, 6), "batch": batch,
+        "device_kind": "host-cpu (native engine)"}))
+
+
 def stage_alexnet_e2e():
     """AlexNet through the REAL framework data path (the conv leg of
     VERDICT r3 item 3): a u8 ImageNet-shaped dataset resident in HBM,
@@ -775,6 +836,7 @@ STAGES = {
     "power": (stage_power, 240),
     "alexnet": (stage_alexnet, 600),
     "alexnet_e2e": (stage_alexnet_e2e, 450),
+    "native_infer": (stage_native_infer, 180),
     "alexnet512": (stage_alexnet512, 600),
     "profile": (stage_profile, 600),
     "s2d": (stage_s2d, 300),
@@ -785,8 +847,8 @@ STAGES = {
 #: AlexNet headline LAST so its line is the final one on stdout.
 _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_e2e_u8", "mnist_wf", "cifar", "ae", "kohonen",
-               "lstm", "transformer", "power", "s2d", "alexnet512",
-               "alexnet_e2e", "profile", "alexnet")
+               "lstm", "transformer", "power", "native_infer", "s2d",
+               "alexnet512", "alexnet_e2e", "profile", "alexnet")
 
 #: Cold compile cache: the flagship right after the one cheap stage
 #: that proves the chip + stopwatch work.  Live-window post-mortems
@@ -796,14 +858,14 @@ _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
 #: after the headline artifacts.
 _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
                "s2d", "alexnet512", "alexnet_e2e", "transformer",
-               "lstm", "mnist_e2e", "mnist_e2e_u8", "power", "cifar",
-               "ae", "kohonen", "mnist_wf")
+               "lstm", "mnist_e2e", "mnist_e2e_u8", "power",
+               "native_infer", "cifar", "ae", "kohonen", "mnist_wf")
 
 #: CPU fallback (rehearsed with a wedged tunnel): conv/LM heavies
 #: cannot finish on CPU inside their caps — end on the flagship MNIST
 #: number so the recorded last line is a real measurement.
 _CPU_ORDER = ("mnist_e2e", "mnist_wf", "ae", "kohonen", "lstm",
-              "mnist_u8", "mnist_bf16", "mnist")
+              "native_infer", "mnist_u8", "mnist_bf16", "mnist")
 
 
 def _ladder_order(platform_tpu, cpu_fallback, warm, only=None):
