@@ -385,9 +385,20 @@ def test_standard_workflow_fused_snapshot_resume(tmp_path):
         fused=True, snapshot_dir=str(tmp_path))
     wf.run()
     first_best = float(wf.decision.best_n_err_pt)
+    # Decision triggered at least the first-improvement export
     assert wf.snapshotter.destination is not None
     wf.forwards[0].weights.map_read()
     w_trained = numpy.array(wf.forwards[0].weights.mem)
+
+    # the Decision-triggered snapshot is the BEST epoch's cut, which
+    # equals the final weights only when the last epoch improved — a
+    # numerics coin-flip XLA CPU thread availability can tip.  The
+    # equality leg uses an explicit operator export of the final state
+    # (the same public API), which is deterministic.
+    from veles_tpu.mutable import LinkableAttribute
+    LinkableAttribute.unlink(wf.snapshotter, "suffix")
+    wf.snapshotter.suffix = "final"
+    wf.snapshotter.export()
 
     restored = load_snapshot(wf.snapshotter.destination)
     restored.launcher = DummyLauncher()
@@ -425,6 +436,13 @@ def test_fused_snapshot_preserves_solver_state(tmp_path):
     v_orig = [numpy.asarray(st["vw"])
               for st in wf.fused_trainer._params_ if "vw" in st]
     assert v_orig and any(numpy.abs(v).max() > 0 for v in v_orig)
+
+    # explicit final export: the velocities compared below must be the
+    # FINAL ones, not the best-epoch ones (see the resume test above)
+    from veles_tpu.mutable import LinkableAttribute
+    LinkableAttribute.unlink(wf.snapshotter, "suffix")
+    wf.snapshotter.suffix = "final"
+    wf.snapshotter.export()
 
     restored = load_snapshot(wf.snapshotter.destination)
     restored.launcher = DummyLauncher()
