@@ -36,6 +36,32 @@ def build(sample, batch):
     from veles_tpu.znicz.fused_graph import lower_specs
 
     prng.seed_all(1234)
+    if sample == "transformer":
+        # the GPT LM (bench stage config).  Keep --batch <= 32: the
+        # chunked-CE live memory is O(batch * 128 * vocab) floats.
+        from veles_tpu.samples import transformer as T
+        cfg = {"vocab": 32000, "dim": 512, "heads": 8, "layers": 8,
+               "mlp_ratio": 4, "seq_len": 1024}
+        params0 = T.init_params(cfg, seed=0)
+        velocity = jax.tree.map(numpy.zeros_like, params0)
+        raw_step = T.make_train_step(cfg)
+
+        def step(state, x, _labels):
+            p, v = state
+            p, v, metrics = raw_step(p, v, x)
+            return (p, v), metrics
+
+        def apply_fn(state, x):
+            return T.apply_fn(state[0], x, cfg)
+
+        train_flops = T.train_step_flops(cfg, batch)
+        flops_overrides = {"full_step": train_flops,
+                           "forward": train_flops / 3.0}
+        x = jax.device_put(T.synthetic_tokens(cfg, batch))
+        labels = jax.device_put(
+            numpy.zeros((batch,), numpy.int32))
+        return ((params0, velocity), step, apply_fn, x, labels,
+                flops_overrides)
     if sample == "mnist":
         from __graft_entry__ import MNIST_LAYERS
         from veles_tpu.znicz.fused import (init_mlp_params,
@@ -134,7 +160,7 @@ def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--sample", default="alexnet",
                         choices=("alexnet", "cifar10", "mnist",
-                                 "mnist_rnn", "stl10"))
+                                 "mnist_rnn", "stl10", "transformer"))
     parser.add_argument("--batch", type=int, default=256)
     parser.add_argument("--k", type=int, default=10)
     parser.add_argument("--out", default=None)
