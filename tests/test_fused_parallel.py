@@ -785,3 +785,49 @@ def test_epoch_runner_rejects_tiny_dataset():
 
     with _pytest.raises(ValueError):
         epoch_runner(lambda p, x, y: (p, {}), n_samples=4, batch=8)
+
+
+def test_data_parallel_epoch_matches_single_device():
+    """One-program DP epoch over the 8-device mesh: the globally-
+    permuted sampling makes its result comparable to the single-device
+    epoch_runner with the same key — params agree to float tolerance,
+    while the dataset lives sharded over the data axis."""
+    import jax
+    import numpy
+    from veles_tpu.parallel.dp import data_parallel_epoch
+    from veles_tpu.parallel.mesh import make_mesh
+    from veles_tpu.znicz.fused_graph import epoch_runner, lower_specs
+
+    rng = numpy.random.default_rng(2)
+    n, batch = 64, 16
+    data = rng.integers(0, 256, (n, 12)).astype(numpy.uint8)
+    labels = rng.integers(0, 4, n).astype(numpy.int32)
+    specs = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 6},
+         "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        {"type": "softmax", "->": {"output_sample_shape": 4},
+         "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+    ]
+    params, step_fn, _e, _a = lower_specs(
+        specs, (12,),
+        input_norm=(numpy.float32(1 / 255.0), numpy.float32(0.0)))
+
+    key = jax.random.key(3)
+    single = jax.jit(epoch_runner(step_fn, n, batch))
+    p_single, m_single = single(params, data, labels, key)
+
+    mesh = make_mesh({"data": 8})
+    dp_epoch = data_parallel_epoch(step_fn, mesh, params, n, batch)
+    p_dp, m_dp = dp_epoch(params, data, labels, key)
+    for a, b in zip(jax.tree.leaves(p_single), jax.tree.leaves(p_dp)):
+        numpy.testing.assert_allclose(numpy.asarray(a),
+                                      numpy.asarray(b),
+                                      rtol=1e-5, atol=1e-6)
+    numpy.testing.assert_allclose(
+        numpy.asarray(m_single["loss"]), numpy.asarray(m_dp["loss"]),
+        rtol=1e-5, atol=1e-6)
+    # the dataset really was sharded over the mesh's data axis
+    placed = jax.device_put(
+        data, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("data")))
+    assert not placed.sharding.is_fully_replicated
