@@ -151,3 +151,44 @@ def data_parallel_epoch(step_fn, mesh, params_example, n_samples,
         in_shardings=(p_shard, d_shard, d_shard, None),
         out_shardings=(p_shard, replicated(mesh)),
         donate_argnums=(0,))
+
+
+def data_parallel_epoch_local(step_fn_reduced, mesh, n_local,
+                              batch_local, batch_axis="data"):
+    """The bandwidth-optimal distributed epoch: each data shard keeps
+    its OWN resident dataset slice and samples it locally (the
+    distributed-sampler rule) — minibatch data never crosses chips;
+    only the gradient ``pmean`` rides ICI.
+
+    ``step_fn_reduced`` must come from
+    ``lower_specs(..., grad_reduce_axis=batch_axis)`` so every shard
+    applies the identical globally-reduced update — parameters stay in
+    lockstep without ever being communicated.  Each shard folds its
+    ``axis_index`` into the epoch key, so shards draw disjoint
+    permutation streams of their local slices.
+
+    Compare :func:`data_parallel_epoch` (global permutation, identical
+    sampling to single-device at the cost of gather collectives).
+    Returns ``epoch_fn(params, data, labels, key)`` compiled for the
+    mesh; metrics are the globally-reduced per-minibatch values.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    from veles_tpu.znicz.fused_graph import epoch_runner
+
+    epoch_local = epoch_runner(step_fn_reduced, n_local, batch_local)
+
+    def run(params, data_local, labels_local, key):
+        shard = jax.lax.axis_index(batch_axis)
+        return epoch_local(params, data_local, labels_local,
+                           jax.random.fold_in(key, shard))
+
+    sharded = shard_map(
+        run, mesh=mesh,
+        in_specs=(P(), P(batch_axis), P(batch_axis), P()),
+        # params leave replicated BY CONSTRUCTION (pmean'd grads =>
+        # identical updates); metrics are globally reduced in-step.
+        # check_rep can't see through the collectives, hence False.
+        out_specs=(P(), P()),
+        check_rep=False)
+    return jax.jit(sharded, donate_argnums=(0,))

@@ -40,7 +40,8 @@ def default_lr(solver):
 
 def lower_specs(layer_specs, sample_shape, loss="softmax",
                 compute_dtype=None, remat=False, grad_accum=1,
-                lr_adjuster=None, input_norm=None):
+                lr_adjuster=None, input_norm=None,
+                grad_reduce_axis=None):
     """Build (params, step_fn, eval_fn, apply_fn) from layer specs.
 
     ``sample_shape``: one sample's shape (no batch dim).
@@ -326,6 +327,19 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                 # each chunk's "n_err" is an RMSE: average, don't sum
                 # (softmax error COUNTS do sum)
                 n_err = n_err / grad_accum
+        if grad_reduce_axis is not None:
+            # explicit-collective data parallelism (the shard_map
+            # path, e.g. parallel/dp.data_parallel_epoch_local): mean
+            # the per-shard mean-gradients — equal shard batches make
+            # that the global-batch gradient — and reduce the metrics
+            # so every shard applies the identical update and reports
+            # global numbers (softmax n_err is a count -> psum; mse's
+            # is an RMSE -> pmean; the loss report is a mean -> pmean)
+            grads = jax.lax.pmean(grads, grad_reduce_axis)
+            report = jax.lax.pmean(report, grad_reduce_axis)
+            n_err = (jax.lax.psum(n_err, grad_reduce_axis)
+                     if loss == "softmax"
+                     else jax.lax.pmean(n_err, grad_reduce_axis))
         new_list = []
         for state, gwb, (_pure, _config, hyper, _skip) in zip(
                 params_list, grads, stages):
