@@ -888,3 +888,57 @@ def test_data_parallel_epoch_local_matches_simulation():
     # final minibatch's globally-reduced error count matches too
     assert float(numpy.asarray(m_mesh["n_err"])[-1]) == \
         float(numpy.asarray(m_sim["n_err"]))
+
+
+def test_fused_epoch_mode_trains_and_keeps_decision_stream():
+    """fused_config={'epoch_mode': True}: the whole TRAIN epoch runs
+    as one program; Decision still receives a per-minibatch metric
+    stream and the workflow trains to the usual synthetic accuracy.
+    minibatch 512 does NOT divide the train set, so the dropped-tail
+    replay leg is exercised too."""
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist
+
+    prng.seed_all(1)
+    wf = mnist.create_workflow(
+        device=CPUDevice(), max_epochs=3, minibatch_size=512,
+        fused=True, fused_config={"epoch_mode": True})
+    assert wf.fused_trainer.epoch_mode
+    wf.run()
+    results = wf.gather_results()
+    assert results["best_validation_error_pt"] < 35.0
+    # the epoch program really was built and consumed
+    assert wf.fused_trainer._epoch_fn_ is not None
+    assert wf.fused_trainer.epoch_key_counter >= 2
+    # weights synced back into the unit graph at epoch boundaries
+    wf.forwards[0].weights.map_read()
+    import numpy as _np
+    assert float(_np.abs(wf.forwards[0].weights.mem).max()) > 0
+
+
+def test_fused_epoch_mode_rejects_mesh_and_mse():
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist, mnist_ae
+
+    prng.seed_all(2)
+    wf = mnist.create_workflow(
+        device=CPUDevice(), max_epochs=1, minibatch_size=500,
+        fused=True,
+        fused_config={"epoch_mode": True, "mesh_axes": {"data": -1}})
+    with pytest.raises(NotImplementedError):
+        wf.run()
+    # the MSE guard (autoencoder sample trains with loss="mse")
+    prng.seed_all(2)
+    wf2 = mnist_ae.create_workflow(
+        device=CPUDevice(), max_epochs=1, minibatch_size=500,
+        fused=True, fused_config={"epoch_mode": True})
+    with pytest.raises(NotImplementedError):
+        wf2.run()
+    # bagged runs (train_ratio) are per-minibatch-path only
+    prng.seed_all(2)
+    wf3 = mnist.create_workflow(
+        device=CPUDevice(), max_epochs=1, minibatch_size=500,
+        fused=True, fused_config={"epoch_mode": True})
+    wf3.loader.train_ratio = 0.5
+    with pytest.raises(NotImplementedError):
+        wf3.run()

@@ -59,6 +59,19 @@ class FusedTrainer(AcceleratedUnit):
         self.mesh_axes = kwargs.get("mesh_axes")
         self.fsdp = bool(kwargs.get("fsdp", False))
         self.tp = bool(kwargs.get("tp", False))
+        #: whole-epoch-in-one-program training
+        #: (fused_graph.epoch_runner): the device permutes/gathers the
+        #: resident TRAIN slice and scans the step inside ONE XLA
+        #: program — one dispatch + one metric fetch per epoch instead
+        #: of per minibatch.  Decision still sees a per-minibatch
+        #: metric stream (the stacked scan outputs are replayed one
+        #: call at a time).  Sampling uses the trainer's own device
+        #: PRNG stream, not the loader's host shuffle; the loader's
+        #: per-minibatch gather becomes redundant device work.
+        self.epoch_mode = bool(kwargs.get("epoch_mode", False))
+        #: picklable epoch-key counter: resume draws fresh epoch
+        #: permutation streams
+        self.epoch_key_counter = 0
         self.loader = None
         self.forwards = None
         self.n_err = 0.0
@@ -80,6 +93,12 @@ class FusedTrainer(AcceleratedUnit):
         self._train_divisor_ = 1
         self._batch_shard_ = None
         self._rep_shard_ = None
+        self._epoch_fn_ = None        # epoch_mode: jitted epoch program
+        self._epoch_data_ = None      # resident TRAIN slice (device)
+        self._epoch_labels_ = None
+        self._epoch_steps_ = 0        # full minibatches per epoch
+        self._epoch_queue_ = None     # stacked metrics being replayed
+        self._epoch_ptr_ = 0
 
     def __getstate__(self):
         state = super(FusedTrainer, self).__getstate__()
@@ -149,6 +168,48 @@ class FusedTrainer(AcceleratedUnit):
             self._params_ = jax.device_put(params)
             self._step_ = jax.jit(step_fn, donate_argnums=(0,))
             self._eval_ = jax.jit(eval_fn)
+        if self.epoch_mode:
+            if self.mesh_axes:
+                raise NotImplementedError(
+                    "epoch_mode currently runs single-device; the mesh "
+                    "compositions live in parallel.dp."
+                    "data_parallel_epoch[_local]")
+            if self.loss != "softmax":
+                raise NotImplementedError(
+                    "epoch_mode currently supports the softmax loss")
+            from veles_tpu.loader.fullbatch import FullBatchLoader
+            from veles_tpu.znicz.fused_graph import epoch_runner
+            loader = self.loader
+            if not isinstance(loader, FullBatchLoader):
+                raise NotImplementedError(
+                    "epoch_mode needs a resident FullBatchLoader "
+                    "dataset (got %s)" % type(loader).__name__)
+            if float(getattr(loader, "train_ratio", 1.0)) != 1.0:
+                raise NotImplementedError(
+                    "epoch_mode trains the full TRAIN slice; "
+                    "train_ratio=%s is not honored — use the "
+                    "per-minibatch path for bagged/ensemble runs"
+                    % loader.train_ratio)
+            n_train = int(loader.class_lengths[TRAIN])
+            batch = int(loader.max_minibatch_size)
+            if n_train < batch:
+                raise ValueError(
+                    "epoch_mode needs at least one full minibatch of "
+                    "train samples (%d < %d)" % (n_train, batch))
+            if batch % self._train_divisor_:
+                raise ValueError(
+                    "epoch_mode minibatch %d must divide by "
+                    "grad_accum (%d)" % (batch, self._train_divisor_))
+            start = int(loader.class_end_offsets[TRAIN - 1])
+            self._epoch_data_ = \
+                loader.original_data.devmem[start:start + n_train]
+            self._epoch_labels_ = jax.device_put(
+                numpy.ascontiguousarray(
+                    loader._mapped_labels[start:start + n_train]))
+            self._epoch_steps_ = n_train // batch
+            self._epoch_fn_ = jax.jit(epoch_runner(step_fn, n_train,
+                                                   batch),
+                                      donate_argnums=(0,))
 
     def _make_rules(self, mesh, fsdp_rules, tp_rules):
         """Param sharding rules for the configured mesh: TP (column-
@@ -229,6 +290,13 @@ class FusedTrainer(AcceleratedUnit):
         # compile (full + tail).
         n = int(self.loader.minibatch_size)
         train = int(self.loader.minibatch_class) == TRAIN
+        if train and self._epoch_fn_ is not None:
+            # whole-epoch program path: per-minibatch sizing/divisors
+            # do not apply (epoch_runner drops the short tail itself)
+            self._run_epoch_minibatch()
+            if bool(self.loader.last_minibatch):
+                self.sync_weights()
+            return
         div = self._train_divisor_
         if train and div > 1 and n % div:
             # a short tail batch must stay divisible into microbatches
@@ -272,6 +340,38 @@ class FusedTrainer(AcceleratedUnit):
             # epoch boundary: the unit graph (snapshotter, export,
             # eager eval) sees the trained weights
             self.sync_weights()
+
+    def _run_epoch_minibatch(self):
+        """epoch_mode: the FIRST train minibatch of an epoch runs the
+        whole epoch as one program; every train call (this one
+        included) replays one minibatch's metrics from the stacked
+        scan outputs, so Decision's per-minibatch accounting is
+        unchanged.  Loader minibatches beyond the full-batch count
+        (the short tail epoch_runner drops) report zero metrics — the
+        same rule as the indivisible-tail skip above."""
+        import jax
+
+        if self._epoch_queue_ is None:
+            key = jax.random.key(self.epoch_key_counter)
+            self.epoch_key_counter += 1
+            self._params_, stacked = self._epoch_fn_(
+                self._params_, self._epoch_data_, self._epoch_labels_,
+                key)
+            # ONE host fetch per epoch for the whole metric stream
+            self._epoch_queue_ = jax.tree_util.tree_map(numpy.asarray,
+                                                        stacked)
+            self._epoch_ptr_ = 0
+        if self._epoch_ptr_ < self._epoch_steps_:
+            i = self._epoch_ptr_
+            self._epoch_ptr_ += 1
+            self.n_err = float(self._epoch_queue_["n_err"][i])
+            self.loss_value = float(self._epoch_queue_["loss"][i])
+        else:                          # dropped short tail
+            self.n_err = 0.0
+            self.loss_value = 0.0
+        if bool(self.loader.last_minibatch):
+            # epoch boundary: the next train call starts a new epoch
+            self._epoch_queue_ = None
 
     def capture_state(self):
         """Host copy of the full solver-state tree (weights, momenta,
