@@ -193,6 +193,32 @@ def test_lstm_package(lib, tmp_path):
         numpy.testing.assert_allclose(out, golden, atol=1e-4)
 
 
+def test_int8_lstm_package(lib, tmp_path):
+    """int8 quantization on the recurrent family: LSTM weights
+    quantize per gate column ([in+h, 4h] last axis); native and Python
+    loaders dequantize identically, predictions track the fp32
+    golden."""
+    from veles_tpu.znicz.all2all import All2AllSoftmax
+    from veles_tpu.znicz.rnn import LSTM
+
+    rng = numpy.random.default_rng(6)
+    x = rng.standard_normal((6, 9, 7)).astype(numpy.float32)
+    forwards, golden = _chain(
+        [(LSTM, {"hidden_units": 11, "last_only": True,
+                 "weights_filling": "gaussian"}),
+         (All2AllSoftmax, {"output_sample_shape": (5,)})], x)
+    path = str(tmp_path / "lstm8.zip")
+    export_package(forwards, path, precision=8, with_stablehlo=False)
+    py_out = PackagedRunner(path).run(x)
+    with native.NativeWorkflow(path) as wf:
+        out = wf.run(x)
+        numpy.testing.assert_allclose(out, py_out, atol=1e-4)
+    # recurrence amplifies quantization error; the argmax must hold
+    # for (nearly) all of this small batch
+    flips = (py_out.argmax(-1) != golden.argmax(-1)).mean()
+    assert flips <= 1 / 6
+
+
 def test_rnn_full_sequence_package(lib, tmp_path):
     """Simple RNN emitting the full (B, T, H) sequence natively."""
     from veles_tpu.znicz.rnn import SimpleRNN
