@@ -942,3 +942,44 @@ def test_fused_epoch_mode_rejects_mesh_and_mse():
     wf3.loader.train_ratio = 0.5
     with pytest.raises(NotImplementedError):
         wf3.run()
+
+
+def test_data_parallel_epoch_with_tp_rules():
+    """DP×TP one-program epoch: epoch_runner's jit composition accepts
+    param_rules, so wide layers shard column-parallel over 'model'
+    while the epoch result still matches the single-device run."""
+    import jax
+    import numpy
+    from veles_tpu.parallel.dp import data_parallel_epoch, tp_rules
+    from veles_tpu.parallel.mesh import make_mesh
+    from veles_tpu.znicz.fused_graph import epoch_runner, lower_specs
+
+    rng = numpy.random.default_rng(9)
+    n, batch = 32, 8
+    data = rng.integers(0, 256, (n, 12)).astype(numpy.uint8)
+    labels = rng.integers(0, 4, n).astype(numpy.int32)
+    specs = [
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 64},
+         "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        {"type": "softmax", "->": {"output_sample_shape": 4},
+         "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+    ]
+    params, step_fn, _e, _a = lower_specs(
+        specs, (12,),
+        input_norm=(numpy.float32(1 / 255.0), numpy.float32(0.0)))
+    key = jax.random.key(5)
+    p_single, _m = jax.jit(epoch_runner(step_fn, n, batch))(
+        params, data, labels, key)
+
+    mesh = make_mesh({"data": 2, "model": 4})
+    rules = tp_rules(mesh, min_elements=64)
+    epoch_fn = data_parallel_epoch(step_fn, mesh, params, n, batch,
+                                   param_rules=rules)
+    p_mesh, _m2 = epoch_fn(params, data, labels, key)
+    # the wide layer's weight really is model-sharded
+    w0 = p_mesh[0]["w"]
+    assert not w0.sharding.is_fully_replicated
+    for a, b in zip(jax.tree.leaves(p_single), jax.tree.leaves(p_mesh)):
+        numpy.testing.assert_allclose(numpy.asarray(a),
+                                      numpy.asarray(b),
+                                      rtol=1e-4, atol=1e-5)
