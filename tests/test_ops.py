@@ -411,6 +411,43 @@ def test_two_point_marginal_survives_short_point_stall():
     assert m2 == pytest.approx(true_per_unit, rel=0.25)
 
 
+def test_timing_pins_operands_on_device():
+    """Round-4 window-3 post-mortem: host-resident numpy params (what
+    lower_specs returns) were re-uploaded on EVERY timed launch —
+    ~0.5 GB/launch for AlexNet over the tunnel, whose transfer jitter
+    swamped the marginal (bench said 141 ms/step; the device_put-ing
+    profiler measured 20.6 ms on the same claim).  The stopwatch must
+    device_put its operands once, so no implicit H2D transfer may
+    happen during timing — pinned with jax's transfer guard."""
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.ops.timing import inprogram_marginal, \
+        measure_fused_step
+
+    def heavy_step(params, x, labels):
+        m = params["m"]
+        m = m + 1e-4 * (m @ m)
+        return {"m": m}, {"loss": jnp.sum(m)}
+
+    heavy = {"m": numpy.eye(256, dtype=numpy.float32) * 0.01}
+    x = numpy.ones((2, 4), numpy.float32)
+    labels = numpy.zeros((2,), numpy.int32)
+    with jax.transfer_guard("disallow"):
+        sec, _flops = measure_fused_step(heavy_step, heavy, x, labels,
+                                         k=5)
+    assert sec > 0
+
+    def unit(c):
+        return c + 1e-4 * (c @ c)
+
+    with jax.transfer_guard("disallow"):
+        per = inprogram_marginal(
+            unit, numpy.eye(128, dtype=numpy.float32) * 0.01,
+            k1=2, k2=8, target_signal=0.0)
+    assert per > 0
+
+
 def test_peak_guard_rejects_faster_than_hardware(monkeypatch):
     """A marginal implying more FLOPs than the chip's peak must be
     re-measured and then refused, never recorded (the round-2 MFU-54

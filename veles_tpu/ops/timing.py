@@ -132,13 +132,16 @@ def inprogram_marginal(unit_fn, init_carry, k1=8, k2=64, repeats=3,
         carry = jax.lax.fori_loop(0, n, lambda _i, c: unit_fn(c), carry)
         return _first_scalar(carry)
 
+    # device-resident carry: host numpy leaves would be re-uploaded on
+    # every timed launch (see measure_fused_step's identical guard)
+    init_carry = jax.device_put(init_carry)
     compiled = jax.jit(prog).lower(
         init_carry, numpy.int32(k1)).compile()
-    host_fetch(compiled(init_carry, numpy.int32(k2)))     # warm
+    host_fetch(compiled(init_carry, jax.device_put(numpy.int32(k2))))
 
     def timed(n):
         best = float("inf")
-        arg = numpy.int32(n)
+        arg = jax.device_put(numpy.int32(n))
         for _ in range(repeats):
             tic = time.perf_counter()
             host_fetch(compiled(init_carry, arg))
@@ -293,6 +296,13 @@ def measure_fused_step(step_fn, params, x, labels, k=20,
             "two-trip-count timing, which re-runs the program from the "
             "same params buffers; pass donate=False")
     k = max(int(k), 2)
+    # Pin every operand on device BEFORE timing: host-resident numpy
+    # params (lower_specs returns them) would otherwise be re-uploaded
+    # on EVERY timed launch — ~0.5 GB/launch for AlexNet over the
+    # tunneled transport, whose multi-second transfer jitter swamps the
+    # two-point marginal (r4 window 3: bench said 141 ms/step while the
+    # device_put-ing profiler measured the same step at 20.6 ms).
+    params, x, labels = jax.device_put((params, x, labels))
     multi = make_multi_step(step_fn)          # dynamic trip count
     jitted = jax.jit(multi)
     compiled = jitted.lower(params, x, labels,
@@ -307,7 +317,7 @@ def measure_fused_step(step_fn, params, x, labels, k=20,
 
     def timed(n):
         best = float("inf")
-        arg = numpy.int32(n)
+        arg = jax.device_put(numpy.int32(n))
         for _ in range(repeats):
             tic = time.perf_counter()
             _p, probe = compiled(params, x, labels, arg)
@@ -319,7 +329,8 @@ def measure_fused_step(step_fn, params, x, labels, k=20,
             best = min(best, elapsed)
         return best
 
-    host_fetch(compiled(params, x, labels, numpy.int32(k1))[1])  # warm
+    host_fetch(compiled(params, x, labels,
+                        jax.device_put(numpy.int32(k1)))[1])     # warm
     # 0.5 s of signal over the tunnel jitter; widening capped at 20·k
     # steps (more steps = more weight drift on synthetic data = NaN
     # risk, which _two_point_marginal absorbs by falling back)
