@@ -169,19 +169,21 @@ MAX_MFU = 1.0
 MAX_VS_BASELINE = 200.0
 
 
-def _emit(metric, sec_per_step, batch, flops, vs=None):
+def _emit(metric, sec_per_step, batch, flops, vs=None, extra=None):
     kind = _device_kind()
     # no train step on any hardware completes in under a microsecond —
     # catches broken stopwatches even where no peak-FLOPs entry exists
     if sec_per_step <= 1e-6:
-        print(json.dumps({
+        rec = {
             "metric": metric, "value": 0.0, "unit": "images/sec",
             "vs_baseline": None,
             "error": "timing failed physics check: sec_per_step "
                      "%.3e below plausibility floor" % sec_per_step,
             "raw_sec_per_step": sec_per_step,
             "device_kind": kind,
-        }))
+        }
+        rec.update(extra or {})   # the diagnosis matters MOST here
+        print(json.dumps(rec))
         return
     ips = batch / sec_per_step
     peak = _peak_flops(kind)
@@ -195,15 +197,17 @@ def _emit(metric, sec_per_step, batch, flops, vs=None):
         problems.append("vs_baseline %.1f outside (0, %.0f]"
                         % (vs_baseline, MAX_VS_BASELINE))
     if problems:
-        print(json.dumps({
+        rec = {
             "metric": metric, "value": 0.0, "unit": "images/sec",
             "vs_baseline": None,
             "error": "timing failed physics check: " + "; ".join(problems),
             "raw_sec_per_step": sec_per_step, "raw_mfu": mfu,
             "device_kind": kind,
-        }))
+        }
+        rec.update(extra or {})   # the diagnosis matters MOST here
+        print(json.dumps(rec))
         return
-    print(json.dumps({
+    rec = {
         "metric": metric,
         "value": round(ips, 1),
         "unit": "images/sec",
@@ -212,7 +216,10 @@ def _emit(metric, sec_per_step, batch, flops, vs=None):
         "sec_per_step": round(sec_per_step, 6),
         "batch": batch,
         "device_kind": kind,
-    }))
+    }
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec))
 
 
 def stage_mnist():
@@ -386,28 +393,52 @@ def _e2e_loop(metric, loader, params, step, label_dtype="int32",
     import jax
     from veles_tpu.ops.timing import host_fetch, probe_of
 
+    host = {"serve": 0.0, "dispatch": 0.0}
+
     def serve():
+        tic = time.perf_counter()
         loader.run()
         x = loader.minibatch_data.devmem
         labels = jax.device_put(np.ascontiguousarray(
             loader.minibatch_labels.mem.astype(label_dtype)))
+        host["serve"] += time.perf_counter() - tic
         return x, labels
 
     x, labels = serve()                    # warm: compile + first fill
     params, m = step(params, x, labels)
     host_fetch(probe_of(params, m))
-    served = 0
+    host["serve"] = 0.0
+    served = iters = 0
     tic = time.perf_counter()
     while True:
         x, labels = serve()
+        t0 = time.perf_counter()
         params, m = step(params, x, labels)
+        host["dispatch"] += time.perf_counter() - t0
         served += int(loader.minibatch_size)
+        iters += 1
         if time.perf_counter() - tic >= min_seconds:
             break
+    t_drain = time.perf_counter()
     host_fetch(probe_of(params, m))        # real bytes end the clock
-    elapsed = time.perf_counter() - tic
-    _emit(metric, elapsed / (served / loader.max_minibatch_size),
-          loader.max_minibatch_size, flops)
+    now = time.perf_counter()
+    elapsed = now - tic
+    # throughput normalizes by equivalent FULL batches (short tails
+    # count pro-rata); the per-batch diagnostics divide by the ACTUAL
+    # loop iterations they were accumulated over
+    n_batches = served / loader.max_minibatch_size
+    # provenance: where the wall-clock went, so a pathological line
+    # (r4 window 3: alexnet_e2e at 24 s/step) carries its own
+    # diagnosis — host serve work vs step-dispatch blocking vs the
+    # final queue drain
+    _emit(metric, elapsed / n_batches,
+          loader.max_minibatch_size, flops, extra={
+              "batches_served": iters,
+              "host_serve_ms_per_batch": round(
+                  1e3 * host["serve"] / iters, 3),
+              "dispatch_ms_per_batch": round(
+                  1e3 * host["dispatch"] / iters, 3),
+              "drain_s": round(now - t_drain, 3)})
 
 
 def stage_mnist_e2e():
@@ -656,30 +687,15 @@ def stage_alexnet():
         steps=10, vs=V100_ALEXNET_IMG_PER_SEC)
 
 
-def stage_mnist_epoch():
-    """Whole-epoch-in-ONE-program MNIST (fused_graph.epoch_runner):
-    device-resident u8 dataset, in-program permutation + gather +
-    scale-normalize + train step via lax.scan — a single dispatch per
-    epoch, so the e2e number cannot be bounded by host round-trips
-    even over the tunneled transport.  Compare against ``mnist_u8``
-    (synthetic batch) and ``mnist_e2e_u8`` (host-driven loader)."""
-    import numpy
-
+def _epoch_loop(metric, step_fn, params, data, labels, n, batch):
+    """Shared one-program-epoch stopwatch: jit(epoch_runner) with
+    params donation, warm + real sync, then epochs paced by a per-epoch
+    metric fetch — the honest cost a Decision-style consumer pays each
+    epoch (async dispatch alone would enqueue thousands)."""
     import jax
-    from veles_tpu import prng
     from veles_tpu.ops.timing import host_fetch, probe_of
-    from veles_tpu.samples import mnist
-    from veles_tpu.znicz.fused_graph import epoch_runner, lower_specs
+    from veles_tpu.znicz.fused_graph import epoch_runner
 
-    prng.seed_all(1234)
-    n, batch = 65536, 8192
-    rng = numpy.random.default_rng(0)
-    data = jax.device_put(rng.integers(0, 256, (n, 784),
-                                       dtype=numpy.uint8))
-    labels = jax.device_put(rng.integers(0, 10, n).astype(numpy.int32))
-    params, step_fn, _e, _a = lower_specs(
-        mnist.LAYERS, (784,),
-        input_norm=(numpy.float32(1 / 255.0), numpy.float32(0.0)))
     steps = n // batch
     epoch_fn = jax.jit(epoch_runner(step_fn, n, batch),
                        donate_argnums=(0,))
@@ -691,18 +707,76 @@ def stage_mnist_epoch():
     while True:
         params, m = epoch_fn(params, data, labels,
                              jax.random.key(epochs + 1))
-        # per-epoch metric fetch: paces the loop on EXECUTED epochs
-        # (async dispatch alone would enqueue thousands) and charges
-        # the honest cost a Decision-style consumer pays each epoch
-        host_fetch(probe_of(m, m))
+        host_fetch(probe_of(m, m))   # paced on EXECUTED epochs
         epochs += 1
         if time.perf_counter() - tic >= 3.0:
             break
     host_fetch(probe_of(params, m))              # bytes end the clock
     elapsed = time.perf_counter() - tic
-    _emit("MNIST784 MLP one-program-epoch train throughput "
-          "(u8-resident, in-program permute+gather)",
-          elapsed / (epochs * steps), batch, None)
+    _emit(metric, elapsed / (epochs * steps), batch, None,
+          extra={"epochs_timed": epochs, "steps_per_epoch": steps})
+
+
+def stage_mnist_epoch():
+    """Whole-epoch-in-ONE-program MNIST (fused_graph.epoch_runner):
+    device-resident u8 dataset, in-program permutation + gather +
+    scale-normalize + train step via lax.scan — a single dispatch per
+    epoch, so the e2e number cannot be bounded by host round-trips
+    even over the tunneled transport.  Compare against ``mnist_u8``
+    (synthetic batch) and ``mnist_e2e_u8`` (host-driven loader)."""
+    import numpy
+
+    import jax
+    from veles_tpu import prng
+    from veles_tpu.samples import mnist
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    prng.seed_all(1234)
+    n, batch = 65536, 8192
+    rng = numpy.random.default_rng(0)
+    data = jax.device_put(rng.integers(0, 256, (n, 784),
+                                       dtype=numpy.uint8))
+    labels = jax.device_put(rng.integers(0, 10, n).astype(numpy.int32))
+    params, step_fn, _e, _a = lower_specs(
+        mnist.LAYERS, (784,),
+        input_norm=(numpy.float32(1 / 255.0), numpy.float32(0.0)))
+    _epoch_loop("MNIST784 MLP one-program-epoch train throughput "
+                "(u8-resident, in-program permute+gather)",
+                step_fn, params, data, labels, n, batch)
+
+
+def stage_alexnet_epoch():
+    """AlexNet whole-epoch-in-ONE-program (the conv leg of the
+    one-program-epoch design): u8 ImageNet-shaped dataset resident in
+    HBM, in-program permutation + gather + scale-normalize + bf16 fused
+    train step via ``lax.scan``.  One dispatch per epoch, so — unlike
+    ``alexnet_e2e``'s host-driven loop — per-dispatch transport latency
+    amortizes across the whole epoch."""
+    import numpy
+
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu import prng
+    from veles_tpu.samples import alexnet
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    prng.seed_all(1234)
+    shape = alexnet.INPUT_SHAPE
+    batch = int(os.environ.get("BENCH_ALEXNET_BATCH", "256"))
+    n = int(os.environ.get("BENCH_ALEXNET_EPOCH_SAMPLES", "4096"))
+    if os.environ.get("BENCH_ALEXNET_E2E_TINY"):  # CPU smoke of the path
+        shape, n, batch = (67, 67, 3), 64, 16
+    rng = numpy.random.default_rng(0)
+    data = jax.device_put(rng.integers(0, 256, (n,) + shape,
+                                       dtype=numpy.uint8))
+    labels = jax.device_put(
+        rng.integers(0, 1000, n).astype(numpy.int32))
+    params, step_fn, _e, _a = lower_specs(
+        alexnet.LAYERS, shape, compute_dtype=jnp.bfloat16, remat=True,
+        input_norm=(numpy.float32(1 / 255.0), numpy.float32(0.0)))
+    _epoch_loop("AlexNet one-program-epoch train throughput "
+                "(u8-resident, in-program permute+gather, bf16)",
+                step_fn, params, data, labels, n, batch)
 
 
 def stage_native_infer():
@@ -911,6 +985,7 @@ STAGES = {
     "power": (stage_power, 240),
     "alexnet": (stage_alexnet, 600),
     "alexnet_e2e": (stage_alexnet_e2e, 450),
+    "alexnet_epoch": (stage_alexnet_epoch, 450),
     "native_infer": (stage_native_infer, 180),
     "mnist_epoch": (stage_mnist_epoch, 180),
     "alexnet512": (stage_alexnet512, 600),
@@ -925,7 +1000,8 @@ _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "mnist_wf",
                "mnist_wf_epoch", "cifar", "ae", "kohonen",
                "lstm", "transformer", "power", "native_infer", "s2d",
-               "alexnet512", "alexnet_e2e", "profile", "alexnet")
+               "alexnet512", "alexnet_e2e", "alexnet_epoch",
+               "profile", "alexnet")
 
 #: Cold compile cache: the flagship right after the one cheap stage
 #: that proves the chip + stopwatch work.  Live-window post-mortems
@@ -934,10 +1010,10 @@ _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
 #: attempted EARLY and on ONE claim — MLP re-runs and extras come
 #: after the headline artifacts.
 _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
-               "s2d", "alexnet512", "alexnet_e2e", "transformer",
-               "lstm", "mnist_e2e", "mnist_e2e_u8", "mnist_epoch",
-               "power", "native_infer", "cifar", "ae", "kohonen",
-               "mnist_wf", "mnist_wf_epoch")
+               "s2d", "alexnet512", "alexnet_e2e", "alexnet_epoch",
+               "transformer", "lstm", "mnist_e2e", "mnist_e2e_u8",
+               "mnist_epoch", "power", "native_infer", "cifar", "ae",
+               "kohonen", "mnist_wf", "mnist_wf_epoch")
 
 #: CPU fallback (rehearsed with a wedged tunnel): conv/LM heavies
 #: cannot finish on CPU inside their caps — end on the flagship MNIST
