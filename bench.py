@@ -928,38 +928,20 @@ def stage_s2d():
     """Space-to-depth conv1 A/B (was chip_session.sh step 3): the same
     stride-4 11x11 conv timed with and without the s2d rewrite, in one
     program each via the in-program marginal stopwatch."""
-    import numpy
+    from veles_tpu.ops.benchmark import measure_s2d_ab
 
-    import jax
-    import jax.numpy as jnp
-    from veles_tpu.ops.timing import inprogram_marginal
-    from veles_tpu.znicz.conv import Conv
-
-    rng = numpy.random.default_rng(0)
     batch = 256
-    x = jnp.asarray(rng.standard_normal((batch, 227, 227, 3)),
-                    jnp.bfloat16)
-    w = jnp.asarray(rng.standard_normal((11, 11, 3, 96)) * 0.01,
-                    jnp.bfloat16)
     flops = 2.0 * batch * 55 * 55 * 96 * 11 * 11 * 3
-    secs = {}
-    for s2d in (False, True):
-        def unit(carry, _s2d=s2d):
-            xx, s = carry
-            xx = jax.lax.dynamic_update_slice(
-                xx, (xx[0:1, 0:1, 0:1, 0:1]
-                     + (s * 1e-30).astype(xx.dtype)), (0, 0, 0, 0))
-            out = Conv.pure({"w": w}, xx, sliding=(4, 4), s2d=_s2d)
-            return xx, jnp.sum(jnp.abs(out), dtype=jnp.float32)
-        secs[s2d] = inprogram_marginal(unit, (x, jnp.float32(0.0)),
-                                       k1=4, k2=32)
+    secs = measure_s2d_ab(batch=batch)
     print(json.dumps({
         "metric": "AlexNet conv1 space-to-depth speedup (A/B)",
-        "value": round(secs[False] / secs[True], 4), "unit": "x",
+        "value": round(secs["base_sec"] / secs["s2d_sec"], 4),
+        "unit": "x",
         "vs_baseline": None,
-        "base_ms": round(secs[False] * 1e3, 4),
-        "s2d_ms": round(secs[True] * 1e3, 4),
-        "tflops_effective_s2d": round(flops / secs[True] / 1e12, 2),
+        "base_ms": round(secs["base_sec"] * 1e3, 4),
+        "s2d_ms": round(secs["s2d_sec"] * 1e3, 4),
+        "tflops_effective_s2d": round(
+            flops / secs["s2d_sec"] / 1e12, 2),
         "device_kind": _device_kind()}))
 
 

@@ -107,6 +107,62 @@ def test_conv_unit_enables_s2d_for_strided_small_channel():
     assert unit3.pure_config()["s2d"] is False
 
 
+def test_conv_s2d_dispatch_measurement_outranks_heuristic(monkeypatch):
+    """The device DB's measured A/B (autotune_s2d) decides the rewrite
+    on eligible convs; ``root.common.engine.s2d_conv`` force-overrides
+    both; ineligible convs stay off regardless (r4 window 3: the
+    heuristic said s2d, the v5-lite chip said 0.51x)."""
+    from veles_tpu.config import root
+
+    def eligible_conv():
+        wf = DummyWorkflow()
+        unit = Conv(wf, n_kernels=96, kx=11, ky=11, sliding=(4, 4))
+        unit.input = Vector(numpy.zeros((2, 227, 227, 3),
+                                        numpy.float32))
+        unit.initialize(device=None)
+        return unit
+
+    # measured verdict wins over the heuristic
+    monkeypatch.setattr("veles_tpu.ops.benchmark.s2d_choice",
+                        lambda *a, **k: False)
+    assert eligible_conv().pure_config()["s2d"] is False
+    monkeypatch.setattr("veles_tpu.ops.benchmark.s2d_choice",
+                        lambda *a, **k: True)
+    assert eligible_conv().pure_config()["s2d"] is True
+    # config force outranks the measurement
+    monkeypatch.setattr("veles_tpu.ops.benchmark.s2d_choice",
+                        lambda *a, **k: True)
+    try:
+        root.common.engine.s2d_conv = False
+        assert eligible_conv().pure_config()["s2d"] is False
+        root.common.engine.s2d_conv = True
+        assert eligible_conv().pure_config()["s2d"] is True
+        # force-on never applies to an INELIGIBLE conv (stride 1)
+        wf = DummyWorkflow()
+        unit = Conv(wf, n_kernels=8, kx=3, ky=3)
+        unit.input = Vector(numpy.zeros((2, 8, 8, 3), numpy.float32))
+        unit.initialize(device=None)
+        assert unit.pure_config()["s2d"] is False
+    finally:
+        root.common.engine.s2d_conv = "auto"   # the absent default
+
+
+def test_autotune_s2d_writes_db_and_choice_reads_it(tmp_path):
+    """autotune_s2d persists the A/B winner; s2d_choice returns it for
+    the measured device generation and None for an unmeasured one."""
+    from veles_tpu.ops import benchmark as B
+
+    db_path = str(tmp_path / "dev.json")
+    info = B.autotune_s2d(batch=2, spatial=19, db_path=db_path)
+    entry = info.ratings["s2d_conv"]["bfloat16"]
+    assert isinstance(entry["enabled"], bool)
+    assert entry["base_ms"] > 0 and entry["s2d_ms"] > 0
+    assert entry["enabled"] == (entry["s2d_ms"] < entry["base_ms"])
+    assert B.s2d_choice(db_path=db_path) == entry["enabled"]
+    # unmeasured generation -> None (callers fall back to heuristic)
+    assert B.s2d_choice(db_path=str(tmp_path / "absent.json")) is None
+
+
 def test_pooling_golden():
     x = numpy.arange(16, dtype=numpy.float32).reshape(1, 4, 4, 1)
     mx = numpy.asarray(MaxPooling.pure({}, jnp.asarray(x), kind="max"))

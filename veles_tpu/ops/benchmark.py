@@ -278,6 +278,89 @@ def autotune_gemm(shapes=None, dtypes=("bfloat16", "float32"),
     return info
 
 
+def measure_s2d_ab(batch=256, spatial=227, dtype_name="bfloat16",
+                   k1=4, k2=32):
+    """Forward A/B of the AlexNet-conv1-shaped strided conv with and
+    without the space-to-depth rewrite, in-program marginal each.
+    Returns ``{"base_sec": ..., "s2d_sec": ...}``.  Iterations are
+    serialized by feeding a result scalar back into one input element
+    (hoisting/CSE defeat, same trick as the attention sweep)."""
+    from veles_tpu.znicz.conv import Conv
+
+    dtype = jnp.dtype(dtype_name)
+    rng = numpy.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, spatial, spatial, 3)),
+                    dtype)
+    w = jnp.asarray(rng.standard_normal((11, 11, 3, 96)) * 0.01, dtype)
+    secs = {}
+    for s2d in (False, True):
+        def unit(carry, _s2d=s2d):
+            xx, s = carry
+            xx = jax.lax.dynamic_update_slice(
+                xx, (xx[0:1, 0:1, 0:1, 0:1]
+                     + (s * 1e-30).astype(xx.dtype)), (0, 0, 0, 0))
+            out = Conv.pure({"w": w}, xx, sliding=(4, 4), s2d=_s2d)
+            return xx, jnp.sum(jnp.abs(out), dtype=jnp.float32)
+
+        secs[s2d] = inprogram_marginal(unit, (x, jnp.float32(0.0)),
+                                       k1=k1, k2=k2)
+    return {"base_sec": secs[False], "s2d_sec": secs[True]}
+
+
+def autotune_s2d(batch=256, spatial=227, dtype_name="bfloat16",
+                 save=True, db_path=None):
+    """Measure the space-to-depth conv rewrite A/B on the attached
+    chip and persist the winner under ``ratings["s2d_conv"]`` so
+    :meth:`veles_tpu.znicz.conv.Conv.pure_config` dispatches from a
+    measurement instead of the lane-occupancy heuristic (r4 window 3:
+    the heuristic said s2d, the chip said 0.51x)."""
+    db_path = db_path or DEVICE_INFOS_JSON
+    model = jax.devices()[0].device_kind
+    db = DeviceInfo.load_db(db_path)
+    info = db.setdefault(model, DeviceInfo(model))
+    secs = measure_s2d_ab(batch=batch, spatial=spatial,
+                          dtype_name=dtype_name)
+    info.ratings.setdefault("s2d_conv", {})[dtype_name] = {
+        "enabled": secs["s2d_sec"] < secs["base_sec"],
+        "base_ms": round(secs["base_sec"] * 1e3, 4),
+        "s2d_ms": round(secs["s2d_sec"] * 1e3, 4),
+        "shape": [batch, spatial, spatial, 3]}
+    if save:
+        DeviceInfo.save_db(db, db_path)
+    s2d_choice.cache_clear()
+    return info
+
+
+@functools.lru_cache(maxsize=16)
+def _s2d_cached(model, dtype_name, db_path, _mtime):
+    db = DeviceInfo.load_db(db_path)
+    info = db.get(model)
+    if info is None:
+        return None
+    entry = info.ratings.get("s2d_conv", {}).get(dtype_name)
+    return None if entry is None else bool(entry.get("enabled"))
+
+
+def s2d_choice(dtype_name="bfloat16", db_path=None):
+    """Measured space-to-depth verdict for the current device
+    generation: True/False from the DB's ``s2d_conv`` A/B entry, or
+    None when this device was never measured (callers fall back to
+    the heuristic).  Cached on the DB file's mtime."""
+    db_path = db_path or DEVICE_INFOS_JSON
+    try:
+        model = jax.devices()[0].device_kind
+    except RuntimeError:
+        return None
+    try:
+        mtime = os.path.getmtime(db_path)
+    except OSError:
+        return None
+    return _s2d_cached(model, dtype_name, db_path, mtime)
+
+
+s2d_choice.cache_clear = _s2d_cached.cache_clear
+
+
 @functools.lru_cache(maxsize=256)
 def _choice_cached(kernel, model, dtype_name, level, shape_cls,
                    db_path, _mtime):

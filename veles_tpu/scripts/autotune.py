@@ -35,6 +35,7 @@ def main(argv=None):
     parser.add_argument("--skip-power", action="store_true")
     parser.add_argument("--skip-gemm", action="store_true")
     parser.add_argument("--skip-attention", action="store_true")
+    parser.add_argument("--skip-s2d", action="store_true")
     args = parser.parse_args(argv)
 
     import jax
@@ -82,6 +83,22 @@ def main(argv=None):
             info.ratings.get("flash_attention", {})), file=sys.stderr)
         print("flash_attention_v2: %s" % json.dumps(
             info.ratings.get("flash_attention_v2", {})),
+            file=sys.stderr)
+
+    if not args.skip_s2d:
+        # conv1 space-to-depth A/B: Conv.pure_config dispatches the
+        # rewrite from this measurement (the heuristic said s2d on
+        # v5-lite; the chip said 0.51x — r4 window 3).  Quick mode
+        # measures a toy shape, so it must NOT overwrite the
+        # production verdict (the round-3 quick-pass-poisons-rating
+        # hazard class): measure + print only.
+        info = benchmark.autotune_s2d(
+            batch=32 if args.quick else 256,
+            spatial=67 if args.quick else 227, db_path=db_path,
+            save=not args.quick)
+        print("s2d_conv%s: %s" % (
+            " (quick, NOT saved)" if args.quick else "",
+            json.dumps(info.ratings.get("s2d_conv", {}))),
             file=sys.stderr)
 
     if not args.skip_power:

@@ -92,11 +92,43 @@ class Conv(ForwardBase):
         # blocks into channels is EXACT and turns it into a stride-1
         # conv over C·s² lanes (3→48).  The backward pass becomes a
         # stride-1 transposed conv, which tiles better too.
+        # Dispatch on ELIGIBLE convs: ``root.common.engine.s2d_conv``
+        # (True/False force) → the device DB's measured A/B
+        # (``autotune_s2d``) → the lane-occupancy heuristic.  On the
+        # v5-lite generation the measured A/B contradicts the
+        # heuristic (XLA's native strided conv won 2x), which is why
+        # a measurement outranks it.
         sx, sy = self.sliding
         c_in = self.input.shape[-1] if self.input else None
-        s2d = bool(c_in and sx == sy and sx > 1 and
-                   c_in <= 32 and c_in * sx * sx <= 256 and
-                   self.grouping == 1)
+        eligible = bool(c_in and sx == sy and sx > 1 and
+                        c_in <= 32 and c_in * sx * sx <= 256 and
+                        self.grouping == 1)
+        s2d = eligible
+        if eligible:
+            from veles_tpu.config import root
+            forced = root.common.engine.get("s2d_conv", "auto")
+            if isinstance(forced, bool):
+                s2d = forced
+            else:
+                # resolved ONCE per (shape, dtype): pure_config runs
+                # per minibatch on the eager path, and a DB rewrite
+                # mid-training must not flip the jitted config (that
+                # would force an XLA recompile between steps)
+                key = (c_in, sx, str(self.input.dtype))
+                if getattr(self, "_s2d_resolved_", None) is None or \
+                        self._s2d_resolved_[0] != key:
+                    from veles_tpu.ops.benchmark import s2d_choice
+                    dt = str(numpy.dtype(self.input.dtype))
+                    measured = s2d_choice(dtype_name=dt)
+                    if measured is None and dt != "bfloat16":
+                        # canonical fallback: the bf16 A/B (the fused
+                        # path computes convs in bf16 regardless of
+                        # the storage dtype)
+                        measured = s2d_choice()
+                    self._s2d_resolved_ = (key, measured)
+                measured = self._s2d_resolved_[1]
+                if measured is not None:
+                    s2d = measured
         return {"padding": self.padding, "sliding": self.sliding,
                 "activation": self.ACTIVATION, "s2d": s2d,
                 "grouping": self.grouping}
