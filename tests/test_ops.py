@@ -411,6 +411,53 @@ def test_two_point_marginal_survives_short_point_stall():
     assert m2 == pytest.approx(true_per_unit, rel=0.25)
 
 
+def test_autotune_gather_writes_db_and_take_rows_dispatches(
+        tmp_path, monkeypatch):
+    """autotune_gather persists the A/B winner (Pallas failures are a
+    recorded verdict, not a crash — on CPU the non-interpret Pallas
+    call fails, so XLA must win); take_rows dispatch order is config
+    force → DB verdict → XLA default."""
+    import jax.numpy as jnp
+
+    from veles_tpu.config import root
+    from veles_tpu.ops import benchmark as B
+    from veles_tpu.ops import gather as G
+
+    db_path = str(tmp_path / "dev.json")
+    info = B.autotune_gather(n=64, row=(9, 9, 3), batch=8,
+                             db_path=db_path)
+    entry = info.ratings["gather"]["uint8"]
+    assert entry["backend"] == "xla"       # CPU: pallas can't run
+    assert entry["xla_ms"] > 0
+    assert entry["pallas_ms"] is None and entry["pallas_error"]
+    assert B.gather_choice(db_path=db_path) is False
+    assert B.gather_choice(
+        db_path=str(tmp_path / "absent.json")) is None
+
+    # dispatch: DB verdict consulted only when config doesn't force
+    calls = []
+
+    def fake_choice(dtype_name="uint8", db_path=None):
+        calls.append(dtype_name)
+        return False
+
+    monkeypatch.setattr("veles_tpu.ops.benchmark.gather_choice",
+                        fake_choice)
+    data = jnp.zeros((4, 6), jnp.float32)
+    idx = jnp.asarray([1, -1], jnp.int32)
+    out = numpy.asarray(G.take_rows(data, idx))
+    assert out.shape == (2, 6) and calls   # DB was consulted
+    calls.clear()
+    try:
+        root.common.engine.pallas_gather = False
+        numpy.asarray(G.take_rows(data, idx))
+        assert not calls                   # config force skips the DB
+    finally:
+        # remove the key outright: leaving any value (even a
+        # pseudo-absent sentinel) would leak order-dependent state
+        root.common.engine.__dict__.pop("pallas_gather", None)
+
+
 def test_timing_pins_operands_on_device():
     """Round-4 window-3 post-mortem: host-resident numpy params (what
     lower_specs returns) were re-uploaded on EVERY timed launch —

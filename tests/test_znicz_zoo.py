@@ -144,7 +144,9 @@ def test_conv_s2d_dispatch_measurement_outranks_heuristic(monkeypatch):
         unit.initialize(device=None)
         assert unit.pure_config()["s2d"] is False
     finally:
-        root.common.engine.s2d_conv = "auto"   # the absent default
+        # remove the key outright (a sentinel value would leak
+        # order-dependent state to later config readers)
+        root.common.engine.__dict__.pop("s2d_conv", None)
 
 
 def test_autotune_s2d_writes_db_and_choice_reads_it(tmp_path):

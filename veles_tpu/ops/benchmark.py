@@ -331,21 +331,105 @@ def autotune_s2d(batch=256, spatial=227, dtype_name="bfloat16",
     return info
 
 
-@functools.lru_cache(maxsize=16)
-def _s2d_cached(model, dtype_name, db_path, _mtime):
+def measure_gather_ab(n=4096, row=(227, 227, 3), dtype_name="uint8",
+                      batch=256, k1=4, k2=64):
+    """A/B of the resident-dataset minibatch row gather: XLA's native
+    gather vs the Pallas scalar-prefetch DMA kernel, ImageNet-conv
+    shaped by default (the ~12 ms/step e2e-vs-synthetic gap of r4's
+    banked AlexNet ladder).  Returns ``{"xla_sec": ..., "pallas_sec":
+    sec | None, "pallas_error": str | None}`` — the Pallas kernel may
+    be unsupported for a shape/generation, which is a recorded verdict,
+    not a crash."""
+    from veles_tpu.ops.gather import _gather_jnp, _gather_pallas
+
+    dtype = jnp.dtype(dtype_name)
+    f = int(numpy.prod(row))
+    rng = numpy.random.default_rng(0)
+    # generate the FLAT (n, f) array directly in its storage dtype
+    # (an (n,)+row int64 intermediate would be ~5 GB host for the
+    # default ImageNet shape)
+    if dtype.kind in "ui":
+        flat = jnp.asarray(rng.integers(0, 256, (n, f),
+                                        dtype=numpy.uint8).astype(dtype))
+    else:
+        flat = jnp.asarray(
+            rng.random((n, f), dtype=numpy.float32).astype(dtype))
+    idx0 = jnp.asarray(rng.integers(0, n, batch), jnp.int32)
+
+    def run(fn):
+        def unit(carry):
+            idx, s = carry
+            # serialize iterations: the next gather's indices depend
+            # on the previous result's bytes
+            idx = (idx + (s * 0).astype(jnp.int32)) % n
+            out = fn(flat, idx)
+            # reduce the WHOLE output: a sliced probe would let XLA
+            # commute the slice into the gather and time a narrowed
+            # per-row fetch while the opaque Pallas arm moves full
+            # rows (the gemm sweep's round-2 guard, same hazard)
+            return idx, jnp.sum(jnp.abs(out.astype(jnp.float32)))
+
+        return inprogram_marginal(unit, (idx0, jnp.float32(0.0)),
+                                  k1=k1, k2=k2)
+
+    # both arms gather the same flat array and reduce the same full
+    # output, so the A/B isolates the gather backend itself
+    res = {"xla_sec": run(_gather_jnp), "pallas_sec": None,
+           "pallas_error": None}
+    try:
+        res["pallas_sec"] = run(lambda d, i: _gather_pallas(d, i))
+    except Exception as exc:   # unsupported shape/generation = verdict
+        res["pallas_error"] = "%s: %s" % (type(exc).__name__, exc)
+    return res
+
+
+def autotune_gather(n=4096, row=(227, 227, 3), dtype_name="uint8",
+                    batch=256, save=True, db_path=None):
+    """Measure the minibatch-gather A/B on the attached chip and
+    persist the winner under ``ratings["gather"]`` so
+    :func:`veles_tpu.ops.gather.take_rows` dispatches the resident-
+    dataset gather from a measurement."""
+    db_path = db_path or DEVICE_INFOS_JSON
+    model = jax.devices()[0].device_kind
+    db = DeviceInfo.load_db(db_path)
+    info = db.setdefault(model, DeviceInfo(model))
+    res = measure_gather_ab(n=n, row=row, dtype_name=dtype_name,
+                            batch=batch)
+    pallas_wins = (res["pallas_sec"] is not None
+                   and res["pallas_sec"] < res["xla_sec"])
+    entry = {
+        "backend": "pallas" if pallas_wins else "xla",
+        "xla_ms": round(res["xla_sec"] * 1e3, 4),
+        "pallas_ms": (None if res["pallas_sec"] is None
+                      else round(res["pallas_sec"] * 1e3, 4)),
+        "shape": [n] + list(row), "batch": batch}
+    if res["pallas_error"]:
+        entry["pallas_error"] = res["pallas_error"][:200]
+    info.ratings.setdefault("gather", {})[dtype_name] = entry
+    if save:
+        DeviceInfo.save_db(db, db_path)
+    gather_choice.cache_clear()
+    return info
+
+
+@functools.lru_cache(maxsize=64)
+def _verdict_cached(rating_key, model, dtype_name, db_path, _mtime):
     db = DeviceInfo.load_db(db_path)
     info = db.get(model)
     if info is None:
         return None
-    entry = info.ratings.get("s2d_conv", {}).get(dtype_name)
-    return None if entry is None else bool(entry.get("enabled"))
+    entry = info.ratings.get(rating_key, {}).get(dtype_name)
+    if entry is None:
+        return None            # unmeasured dtype: caller falls back
+    if rating_key == "s2d_conv":
+        return bool(entry.get("enabled"))
+    return entry.get("backend") == "pallas"
 
 
-def s2d_choice(dtype_name="bfloat16", db_path=None):
-    """Measured space-to-depth verdict for the current device
-    generation: True/False from the DB's ``s2d_conv`` A/B entry, or
-    None when this device was never measured (callers fall back to
-    the heuristic).  Cached on the DB file's mtime."""
+def _device_db_verdict(rating_key, dtype_name, db_path):
+    """Shared mtime-cached boolean-verdict reader for per-device A/B
+    entries (``s2d_conv``, ``gather``): True/False from the DB, or
+    None when this (device generation, dtype) was never measured."""
     db_path = db_path or DEVICE_INFOS_JSON
     try:
         model = jax.devices()[0].device_kind
@@ -355,10 +439,30 @@ def s2d_choice(dtype_name="bfloat16", db_path=None):
         mtime = os.path.getmtime(db_path)
     except OSError:
         return None
-    return _s2d_cached(model, dtype_name, db_path, mtime)
+    return _verdict_cached(rating_key, model, dtype_name, db_path,
+                           mtime)
 
 
-s2d_choice.cache_clear = _s2d_cached.cache_clear
+def gather_choice(dtype_name="uint8", db_path=None):
+    """Measured gather-backend verdict for the current device
+    generation: True (Pallas DMA) / False (XLA) from the DB's
+    ``gather`` A/B entry, or None when unmeasured (callers fall back
+    to the XLA path)."""
+    return _device_db_verdict("gather", dtype_name, db_path)
+
+
+gather_choice.cache_clear = _verdict_cached.cache_clear
+
+
+def s2d_choice(dtype_name="bfloat16", db_path=None):
+    """Measured space-to-depth verdict for the current device
+    generation: True/False from the DB's ``s2d_conv`` A/B entry, or
+    None when this device was never measured (callers fall back to
+    the heuristic)."""
+    return _device_db_verdict("s2d_conv", dtype_name, db_path)
+
+
+s2d_choice.cache_clear = _verdict_cached.cache_clear
 
 
 @functools.lru_cache(maxsize=256)

@@ -22,12 +22,22 @@ from jax.experimental.pallas import tpu as pltpu
 
 def take_rows(data, indices, use_pallas=None):
     """``data[indices]`` along axis 0.  Negative indices (the reference's
-    "empty slot" marker for short batches) produce zero rows."""
+    "empty slot" marker for short batches) produce zero rows.
+
+    Backend dispatch (when ``use_pallas`` is None):
+    ``root.common.engine.pallas_gather`` (True/False force) → the
+    device DB's measured A/B (``autotune_gather``) → the XLA path.
+    The Pallas DMA kernel only ever runs on TPU."""
     if use_pallas is None:
         from veles_tpu.config import root
         from veles_tpu.ops import on_tpu
-        use_pallas = bool(root.common.engine.get("pallas_gather", False)) \
-            and on_tpu()
+        forced = root.common.engine.get("pallas_gather", None)
+        if isinstance(forced, bool):
+            use_pallas = forced and on_tpu()
+        else:
+            from veles_tpu.ops.benchmark import gather_choice
+            measured = gather_choice(str(jnp.dtype(data.dtype)))
+            use_pallas = bool(measured) and on_tpu()
     if use_pallas and data.ndim >= 2:
         from veles_tpu.config import root
         flat = data.reshape(data.shape[0], -1)

@@ -36,6 +36,7 @@ def main(argv=None):
     parser.add_argument("--skip-gemm", action="store_true")
     parser.add_argument("--skip-attention", action="store_true")
     parser.add_argument("--skip-s2d", action="store_true")
+    parser.add_argument("--skip-gather", action="store_true")
     args = parser.parse_args(argv)
 
     import jax
@@ -99,6 +100,23 @@ def main(argv=None):
         print("s2d_conv%s: %s" % (
             " (quick, NOT saved)" if args.quick else "",
             json.dumps(info.ratings.get("s2d_conv", {}))),
+            file=sys.stderr)
+
+    if not args.skip_gather:
+        # resident-dataset minibatch gather A/B (XLA vs the Pallas
+        # DMA kernel): ~12 ms/step of the AlexNet e2e-vs-synthetic gap
+        # in r4's banked ladder is this gather.  Quick mode: measure +
+        # print only, never overwrite the production verdict.
+        dts = ("uint8",) if args.quick else ("uint8", "float32")
+        for dt in dts:   # u8 = the resident-native path; f32 = the
+            info = benchmark.autotune_gather(   # classic loader path
+                n=256 if args.quick else 4096,
+                row=(19, 19, 3) if args.quick else (227, 227, 3),
+                batch=32 if args.quick else 256, dtype_name=dt,
+                db_path=db_path, save=not args.quick)
+        print("gather%s: %s" % (
+            " (quick, NOT saved)" if args.quick else "",
+            json.dumps(info.ratings.get("gather", {}))),
             file=sys.stderr)
 
     if not args.skip_power:

@@ -460,7 +460,13 @@ def epoch_runner(step_fn, n_samples, batch):
         idx = perm[: steps * batch].reshape(steps, batch)
 
         def body(p, batch_idx):
-            return step_fn(p, data[batch_idx], labels[batch_idx])
+            # take_rows: the minibatch gather rides the same
+            # measured XLA-vs-Pallas dispatch as the host-driven
+            # loader path (ops/gather.py; indices here are always
+            # valid so the two backends are value-identical)
+            from veles_tpu.ops.gather import take_rows
+            return step_fn(p, take_rows(data, batch_idx),
+                           labels[batch_idx])
 
         return jax.lax.scan(body, params, idx)
 
