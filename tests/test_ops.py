@@ -434,11 +434,28 @@ def test_autotune_gather_writes_db_and_take_rows_dispatches(
     assert B.gather_choice(
         db_path=str(tmp_path / "absent.json")) is None
 
+    # a Pallas verdict transfers ONLY to the row size it was measured
+    # at (unmeasured shapes could fail at Mosaic compile time, beyond
+    # any fallback) — mismatched rows get XLA
+    import json as _json
+
+    import jax
+    pallas_db = str(tmp_path / "pallas.json")
+    model = jax.devices()[0].device_kind
+    with open(pallas_db, "w") as fout:
+        _json.dump({model: {"gather": {"uint8": {
+            "backend": "pallas", "xla_ms": 1.0, "pallas_ms": 0.5,
+            "shape": [64, 9, 9, 3], "batch": 8}}}}, fout)
+    assert B.gather_choice(db_path=pallas_db,
+                           row_elems=9 * 9 * 3) is True
+    assert B.gather_choice(db_path=pallas_db, row_elems=784) is False
+    assert B.gather_choice(db_path=pallas_db) is True  # no row info
+
     # dispatch: DB verdict consulted only when config doesn't force
     calls = []
 
-    def fake_choice(dtype_name="uint8", db_path=None):
-        calls.append(dtype_name)
+    def fake_choice(dtype_name="uint8", db_path=None, row_elems=None):
+        calls.append((dtype_name, row_elems))
         return False
 
     monkeypatch.setattr("veles_tpu.ops.benchmark.gather_choice",

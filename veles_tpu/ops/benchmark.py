@@ -423,7 +423,10 @@ def _verdict_cached(rating_key, model, dtype_name, db_path, _mtime):
         return None            # unmeasured dtype: caller falls back
     if rating_key == "s2d_conv":
         return bool(entry.get("enabled"))
-    return entry.get("backend") == "pallas"
+    # gather: the verdict plus the row size it was measured at
+    shape = entry.get("shape") or []
+    row_elems = int(numpy.prod(shape[1:])) if len(shape) > 1 else None
+    return (entry.get("backend") == "pallas", row_elems)
 
 
 def _device_db_verdict(rating_key, dtype_name, db_path):
@@ -443,12 +446,26 @@ def _device_db_verdict(rating_key, dtype_name, db_path):
                            mtime)
 
 
-def gather_choice(dtype_name="uint8", db_path=None):
+def gather_choice(dtype_name="uint8", db_path=None, row_elems=None):
     """Measured gather-backend verdict for the current device
     generation: True (Pallas DMA) / False (XLA) from the DB's
     ``gather`` A/B entry, or None when unmeasured (callers fall back
-    to the XLA path)."""
-    return _device_db_verdict("gather", dtype_name, db_path)
+    to the XLA path).
+
+    ``row_elems``: the caller's flattened row size.  A Pallas verdict
+    only transfers to the row size it was measured at — the kernel's
+    shape support (and its win) is not generic, and an unmeasured
+    shape that Mosaic rejects would fail at compile time of the
+    enclosing program, beyond any fallback — so a mismatch returns
+    False (XLA), never the measured True."""
+    verdict = _device_db_verdict("gather", dtype_name, db_path)
+    if verdict is None:
+        return None
+    is_pallas, measured_elems = verdict
+    if is_pallas and row_elems is not None \
+            and measured_elems not in (None, row_elems):
+        return False
+    return is_pallas
 
 
 gather_choice.cache_clear = _verdict_cached.cache_clear

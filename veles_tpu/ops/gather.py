@@ -16,6 +16,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -28,24 +29,49 @@ def take_rows(data, indices, use_pallas=None):
     ``root.common.engine.pallas_gather`` (True/False force) → the
     device DB's measured A/B (``autotune_gather``) → the XLA path.
     The Pallas DMA kernel only ever runs on TPU."""
-    if use_pallas is None:
+    auto = use_pallas is None
+    if auto:
         from veles_tpu.config import root
         from veles_tpu.ops import on_tpu
         forced = root.common.engine.get("pallas_gather", None)
         if isinstance(forced, bool):
             use_pallas = forced and on_tpu()
+            auto = False          # explicit config force: never mask
         else:
             from veles_tpu.ops.benchmark import gather_choice
-            measured = gather_choice(str(jnp.dtype(data.dtype)))
+            f = int(numpy.prod(data.shape[1:])) if data.ndim >= 2 \
+                else None
+            # the verdict only transfers to the ROW SIZE it was
+            # measured at: the kernel's shape support (and its win)
+            # is not generic, and a Mosaic rejection of an unmeasured
+            # shape would surface at COMPILE time of the enclosing
+            # program, far from any fallback
+            measured = gather_choice(str(jnp.dtype(data.dtype)),
+                                     row_elems=f)
             use_pallas = bool(measured) and on_tpu()
-    if use_pallas and data.ndim >= 2:
+    key = (data.shape[1:], str(jnp.dtype(data.dtype)))
+    if use_pallas and data.ndim >= 2 \
+            and (not auto or key not in _PALLAS_REJECTED):
         from veles_tpu.config import root
-        flat = data.reshape(data.shape[0], -1)
-        out = _gather_pallas(
-            flat, indices,
-            interpret=bool(root.common.engine.get("interpret", False)))
-        return out.reshape((indices.shape[0],) + data.shape[1:])
+        try:
+            flat = data.reshape(data.shape[0], -1)
+            out = _gather_pallas(
+                flat, indices,
+                interpret=bool(root.common.engine.get("interpret",
+                                                      False)))
+            return out.reshape((indices.shape[0],) + data.shape[1:])
+        except Exception:
+            if not auto:
+                raise     # forced callers want the kernel error
+            # auto-dispatch degrades to XLA, negative-cached per
+            # (row shape, dtype) so the retry cost is paid once
+            _PALLAS_REJECTED.add(key)
     return _gather_jnp(data, indices)
+
+
+#: (row shape, dtype) pairs the Pallas kernel rejected at trace time
+#: this process (auto-dispatch only; forced callers see the error)
+_PALLAS_REJECTED = set()
 
 
 @jax.jit

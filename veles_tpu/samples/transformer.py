@@ -107,30 +107,38 @@ def _attend(q, k, v, mesh, seq_axis):
 def _block(h, blk, mesh, seq_axis, compute_dtype):
     """One pre-LN transformer block; wqkv [d,3,H,dh], wo [H,dh,d]."""
     B, S, d = h.shape
+    # Mixed-precision discipline: every dot accumulates in f32 on the
+    # MXU (preferred_element_type) but its RESULT is stored back in
+    # compute_dtype immediately — the stored activations are what the
+    # backward pass (and the layer scan) keeps live, and f32 residuals
+    # at [B,S,4d] were exactly the 5x2 GB buffers that OOM'd the
+    # no-remat step on a 16 GB chip (r4 session 4 compile dump).
+    # Biases are cast too: a f32 bias add silently promotes the whole
+    # activation back to f32.
     x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
     qkv = jnp.einsum("bsd,dchx->bschx", x.astype(compute_dtype),
                      blk["wqkv"].astype(compute_dtype),
-                     preferred_element_type=jnp.float32)
+                     preferred_element_type=jnp.float32
+                     ).astype(compute_dtype)
     if mesh is not None and mesh.shape.get("model", 1) > 1:
         qkv = jax.lax.with_sharding_constraint(
             qkv, NamedSharding(
                 mesh, P("data", seq_axis, None, "model", None)))
     q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
-    att = _attend(q.astype(compute_dtype), k.astype(compute_dtype),
-                  v.astype(compute_dtype), mesh, seq_axis)
+    att = _attend(q, k, v, mesh, seq_axis)
     proj = jnp.einsum("bshx,hxd->bsd", att.astype(compute_dtype),
                       blk["wo"].astype(compute_dtype),
                       preferred_element_type=jnp.float32)
     h = h + proj.astype(h.dtype)
     x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
-    up = x.astype(compute_dtype) @ blk["w1"].astype(compute_dtype) \
-        + blk["b1"]
+    up = (x.astype(compute_dtype) @ blk["w1"].astype(compute_dtype)
+          + blk["b1"].astype(compute_dtype))
     if mesh is not None and mesh.shape.get("model", 1) > 1:
         up = jax.lax.with_sharding_constraint(
             up, NamedSharding(mesh, P("data", seq_axis, "model")))
     act = jax.nn.gelu(up)
-    down = act.astype(compute_dtype) @ blk["w2"].astype(compute_dtype) \
-        + blk["b2"]
+    down = (act @ blk["w2"].astype(compute_dtype)
+            + blk["b2"].astype(compute_dtype))
     return h + down.astype(h.dtype)
 
 

@@ -39,12 +39,19 @@ def build(sample, batch):
     if sample == "transformer":
         # the GPT LM (bench stage config).  Keep --batch <= 32: the
         # chunked-CE live memory is O(batch * 128 * vocab) floats.
+        # Honors the SAME BENCH_LM_REMAT / BENCH_LM_CE_CHUNK knobs as
+        # bench.py's transformer stage, so PROFILE_LM.md describes the
+        # exact program the banked LM line measured.
+        import os
         from veles_tpu.samples import transformer as T
         cfg = {"vocab": 32000, "dim": 512, "heads": 8, "layers": 8,
                "mlp_ratio": 4, "seq_len": 1024}
         params0 = T.init_params(cfg, seed=0)
         velocity = jax.tree.map(numpy.zeros_like, params0)
-        raw_step = T.make_train_step(cfg)
+        raw_step = T.make_train_step(
+            cfg,
+            remat=os.environ.get("BENCH_LM_REMAT", "0") == "1",
+            ce_chunk=int(os.environ.get("BENCH_LM_CE_CHUNK", "128")))
 
         def step(state, x, _labels):
             p, v = state
