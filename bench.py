@@ -945,7 +945,7 @@ def stage_profile():
     line records that the artifact was produced on this device."""
     from veles_tpu.scripts import profile_step
     profile_step.main(["--sample", "alexnet", "--batch", "256",
-                       "--out", "PROFILE.md"])
+                       "--per-layer", "--out", "PROFILE.md"])
     print(json.dumps({
         "metric": "AlexNet step profile artifact (PROFILE.md)",
         "value": 1.0, "unit": "artifact", "vs_baseline": None,

@@ -358,19 +358,27 @@ def measure_gather_ab(n=4096, row=(227, 227, 3), dtype_name="uint8",
 
     def run(fn):
         def unit(carry):
-            idx, s = carry
+            # the dataset rides the CARRY — closing over it would bake
+            # 633 MB into the program as a CONSTANT and the remote
+            # compile request then exceeds the relay's body limit
+            # (observed: HTTP 413 / 25-min hang, r4 session 4).  The
+            # serialized idx leads the tuple: the stopwatch's probe is
+            # derived from the FIRST carry leaf, and a probe on the
+            # pass-through dataset would let XLA DCE the whole loop.
+            idx, s, data_ = carry
             # serialize iterations: the next gather's indices depend
             # on the previous result's bytes
             idx = (idx + (s * 0).astype(jnp.int32)) % n
-            out = fn(flat, idx)
+            out = fn(data_, idx)
             # reduce the WHOLE output: a sliced probe would let XLA
             # commute the slice into the gather and time a narrowed
             # per-row fetch while the opaque Pallas arm moves full
             # rows (the gemm sweep's round-2 guard, same hazard)
-            return idx, jnp.sum(jnp.abs(out.astype(jnp.float32)))
+            return (idx, jnp.sum(jnp.abs(out.astype(jnp.float32))),
+                    data_)
 
-        return inprogram_marginal(unit, (idx0, jnp.float32(0.0)),
-                                  k1=k1, k2=k2)
+        return inprogram_marginal(
+            unit, (idx0, jnp.float32(0.0), flat), k1=k1, k2=k2)
 
     # both arms gather the same flat array and reduce the same full
     # output, so the A/B isolates the gather backend itself

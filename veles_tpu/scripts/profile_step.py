@@ -134,20 +134,10 @@ def measure_phases(params, step, apply_fn, x, labels, k=10,
     phases["full_step"] = (sec, flops)
 
     # forward-only: the same in-program marginal over inference applies,
-    # serialized by feeding a result scalar back into one input element
-    # so iterations cannot be hoisted or CSE'd
+    # serialized (see _serialized_forward_unit)
     dparams = jax.device_put(params)
-
-    def unit(carry):
-        x_, s = carry
-        lead = x_[(slice(0, 1),) * x_.ndim]
-        x_ = jax.lax.dynamic_update_slice(
-            x_, (lead + (s * 1e-30).astype(x_.dtype)),
-            (0,) * x_.ndim)
-        o = apply_fn(dparams, x_)
-        # abs-sum over the WHOLE output: a single-element probe would
-        # let XLA slice the forward pass down to batch row 0
-        return x_, jnp.sum(jnp.abs(o), dtype=jnp.float32)
+    unit = _serialized_forward_unit(lambda p, xx: apply_fn(p, xx),
+                                    dparams)
 
     # flops of one apply: the loop program counts the body ONCE plus
     # the warmup inline iteration — both identical applies, so /2 via a
@@ -163,6 +153,82 @@ def measure_phases(params, step, apply_fn, x, labels, k=10,
     return phases
 
 
+def _serialized_forward_unit(apply2, dparams):
+    """The forward-timing loop body shared by measure_phases and
+    measure_per_layer: iterations are serialized by feeding a result
+    scalar back into one input element (hoist/CSE defeat), and the
+    probe abs-sums the WHOLE output — a single-element probe would let
+    XLA slice the forward pass down to batch row 0."""
+    import jax
+    import jax.numpy as jnp
+
+    def unit(carry):
+        x_, s = carry
+        lead = x_[(slice(0, 1),) * x_.ndim]
+        x_ = jax.lax.dynamic_update_slice(
+            x_, (lead + (s * 1e-30).astype(x_.dtype)),
+            (0,) * x_.ndim)
+        o = apply2(dparams, x_)
+        return x_, jnp.sum(jnp.abs(o), dtype=jnp.float32)
+
+    return unit
+
+
+def measure_per_layer(sample, batch, k=8, full_forward=None):
+    """Forward seconds per LAYER, by timing each prefix of the layer
+    stack (prefix k minus prefix k-1) with the in-program marginal.
+    Layer-spec samples only (lower_specs; recurrent samples are
+    excluded by the caller — a prefix's cost-analysis FLOPs would
+    undercount their inner scan bodies).  Returns
+    ``[(label, sec, flops), ...]``; negative differences (two prefixes
+    within mutual noise) are clamped to 0.
+
+    ``full_forward``: the already-measured ``(sec, flops)`` of the
+    FULL forward (measure_phases), reused for the final prefix so the
+    whole stack is not re-timed and re-compiled.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy
+
+    from veles_tpu import prng
+    from veles_tpu.ops.timing import cost_flops, inprogram_marginal
+    from veles_tpu.znicz.fused_graph import lower_specs
+
+    mod = __import__("veles_tpu.samples.%s" % sample,
+                     fromlist=[sample])
+    layers = mod.LAYERS
+    shape = getattr(mod, "INPUT_SHAPE", (32, 32, 3))
+    rng = numpy.random.default_rng(0)
+    x = jax.device_put(rng.standard_normal(
+        (batch,) + tuple(shape)).astype(numpy.float32))
+
+    rows, prev_sec, prev_flops = [], 0.0, 0.0
+    for n_layers in range(1, len(layers) + 1):
+        if full_forward is not None and n_layers == len(layers):
+            sec, flops = full_forward
+            flops = flops or 0.0
+        else:
+            prng.seed_all(1234)
+            params, _s, _e, apply_raw = lower_specs(
+                layers[:n_layers], shape, compute_dtype=jnp.bfloat16)
+            dparams = jax.device_put(params)
+            unit = _serialized_forward_unit(
+                lambda p, xx, _a=apply_raw: _a(p, xx, train=False),
+                dparams)
+            sec = inprogram_marginal(unit, (x, jnp.float32(0.0)),
+                                     k1=2, k2=k)
+            flops = cost_flops(jax.jit(
+                lambda p, xx, _a=apply_raw: _a(p, xx, train=False)
+            ).lower(params, x).compile()) or 0.0
+        label = layers[n_layers - 1].get("type", "?")
+        rows.append(("%02d %s" % (n_layers, label),
+                     max(sec - prev_sec, 0.0),
+                     max(flops - prev_flops, 0.0)))
+        prev_sec, prev_flops = sec, flops
+    return rows
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--sample", default="alexnet",
@@ -171,6 +237,10 @@ def main(argv=None):
     parser.add_argument("--batch", type=int, default=256)
     parser.add_argument("--k", type=int, default=10)
     parser.add_argument("--out", default=None)
+    parser.add_argument("--per-layer", action="store_true",
+                        help="append a per-layer forward breakdown "
+                             "(prefix-difference timing; layer-spec "
+                             "samples only)")
     args = parser.parse_args(argv)
 
     import jax
@@ -213,6 +283,34 @@ def main(argv=None):
               "- MFU: **%s**" % ("%.4f" % mfu if mfu else "n/a"),
               "- peak bf16 FLOP/s assumed: %s" % (
                   "%.0fe12" % (peak / 1e12) if peak else "unknown")]
+    if args.per_layer:
+        if args.sample in ("mnist", "transformer", "mnist_rnn"):
+            # mnist/transformer are not layer-spec builds; mnist_rnn's
+            # inner T-step scan breaks prefix cost analysis (counted
+            # once — the same caveat build() fixes analytically)
+            lines += ["", "(per-layer breakdown: layer-spec samples "
+                          "only — skipped for %s)" % args.sample]
+        else:
+            rows = measure_per_layer(args.sample, args.batch,
+                                     k=max(args.k, 8),
+                                     full_forward=phases["forward"])
+            lines += ["", "## Per-layer forward (prefix-difference)",
+                      "",
+                      "(consecutive-prefix differences: rows at or "
+                      "below the stopwatch's noise floor print 0 and "
+                      "the first row absorbs the carry-update "
+                      "overhead — read ms-scale rows, not µs ones)",
+                      "",
+                      "| layer | sec | share | GFLOP | TFLOP/s |",
+                      "|---|---|---|---|---|"]
+            for label, sec, flops in rows:
+                tf = (flops / sec / 1e12) if flops and sec > 0 \
+                    else None
+                lines.append("| %s | %.6f | %.0f%% | %s | %s |" % (
+                    label, sec,
+                    (100.0 * sec / fwd_sec) if fwd_sec else 0.0,
+                    "%.2f" % (flops / 1e9) if flops else "—",
+                    "%.1f" % tf if tf else "—"))
     report = "\n".join(lines)
     print(report)
     if args.out:
