@@ -380,7 +380,7 @@ def stage_cifar():
 
 
 def _e2e_loop(metric, loader, params, step, label_dtype="int32",
-              min_seconds=4.0, flops=None):
+              min_seconds=4.0, flops=None, extra=None):
     """Drive the REAL loader (shuffling, epoch bookkeeping,
     device-resident gather, prefetch hooks) into the fused step and
     measure whole-pipeline images/sec.  Long run + single final host
@@ -432,13 +432,13 @@ def _e2e_loop(metric, loader, params, step, label_dtype="int32",
     # diagnosis — host serve work vs step-dispatch blocking vs the
     # final queue drain
     _emit(metric, elapsed / n_batches,
-          loader.max_minibatch_size, flops, extra={
+          loader.max_minibatch_size, flops, extra=dict({
               "batches_served": iters,
               "host_serve_ms_per_batch": round(
                   1e3 * host["serve"] / iters, 3),
               "dispatch_ms_per_batch": round(
                   1e3 * host["dispatch"] / iters, 3),
-              "drain_s": round(now - t_drain, 3)})
+              "drain_s": round(now - t_drain, 3)}, **(extra or {})))
 
 
 def stage_mnist_e2e():
@@ -715,7 +715,8 @@ def stage_alexnet():
         steps=10, vs=V100_ALEXNET_IMG_PER_SEC)
 
 
-def _epoch_loop(metric, step_fn, params, data, labels, n, batch):
+def _epoch_loop(metric, step_fn, params, data, labels, n, batch,
+                extra=None):
     """Shared one-program-epoch stopwatch: jit(epoch_runner) with
     params donation, warm + real sync, then epochs paced by a per-epoch
     metric fetch — the honest cost a Decision-style consumer pays each
@@ -742,7 +743,8 @@ def _epoch_loop(metric, step_fn, params, data, labels, n, batch):
     host_fetch(probe_of(params, m))              # bytes end the clock
     elapsed = time.perf_counter() - tic
     _emit(metric, elapsed / (epochs * steps), batch, None,
-          extra={"epochs_timed": epochs, "steps_per_epoch": steps})
+          extra=dict({"epochs_timed": epochs,
+                      "steps_per_epoch": steps}, **(extra or {})))
 
 
 def stage_mnist_epoch():
@@ -799,12 +801,33 @@ def stage_alexnet_epoch():
                                        dtype=numpy.uint8))
     labels = jax.device_put(
         rng.integers(0, 1000, n).astype(numpy.int32))
-    params, step_fn, _e, _a = lower_specs(
-        alexnet.LAYERS, shape, compute_dtype=jnp.bfloat16, remat=True,
-        input_norm=(numpy.float32(1 / 255.0), numpy.float32(0.0)))
-    _epoch_loop("AlexNet one-program-epoch train throughput "
-                "(u8-resident, in-program permute+gather, bf16)",
-                step_fn, params, data, labels, n, batch)
+    # remat OFF: batch-256 AlexNet activations fit this chip, and the
+    # ~30% forward recompute was most of the "e2e gap" vs the
+    # (remat-free) synthetic stage — apples to apples now.  Knob for
+    # generations/batches that need the memory back; OOM degrades to
+    # the remat build (exporting the knob so the later e2e stage in
+    # this child measures the same program — the LM-stage pattern).
+    remat = os.environ.get("BENCH_ALEXNET_REMAT", "0") == "1"
+
+    def run(remat_mode):
+        params, step_fn, _e, _a = lower_specs(
+            alexnet.LAYERS, shape, compute_dtype=jnp.bfloat16,
+            remat=remat_mode,
+            input_norm=(numpy.float32(1 / 255.0), numpy.float32(0.0)))
+        _epoch_loop("AlexNet one-program-epoch train throughput "
+                    "(u8-resident, in-program permute+gather, bf16)",
+                    step_fn, params, data, labels, n, batch,
+                    extra={"remat": remat_mode})
+
+    try:
+        run(remat)
+    except Exception as exc:
+        if remat:
+            raise
+        print("alexnet_epoch: remat-off failed (%s); retrying with "
+              "remat" % type(exc).__name__, file=sys.stderr)
+        os.environ["BENCH_ALEXNET_REMAT"] = "1"
+        run(True)
 
 
 def stage_native_infer():
@@ -909,22 +932,39 @@ def stage_alexnet_e2e():
     batch = int(os.environ.get("BENCH_ALEXNET_BATCH", "256"))
     if os.environ.get("BENCH_ALEXNET_E2E_TINY"):
         batch = 8
-    wf = StandardWorkflow(
-        None,
-        loader_factory=lambda w: SyntheticImageNetLoader(
-            w, minibatch_size=batch, native_device_dtype=True,
-            normalization_type="scale"),
-        layers=[{**spec} for spec in alexnet.LAYERS],
-        decision_config={"max_epochs": 10 ** 6},
-        fused=True,
-        fused_config={"compute_dtype": jnp.bfloat16, "remat": True})
-    wf.launcher = DummyLauncher()
-    wf.initialize(device=AutoDevice())
-    trainer = wf.fused_trainer
-    trainer._build()
-    _e2e_loop("AlexNet end-to-end workflow throughput "
-              "(u8-resident loader+gather+fused bf16 step)",
-              wf.loader, trainer._params_, trainer._step_)
+
+    def run(remat_mode):
+        wf = StandardWorkflow(
+            None,
+            loader_factory=lambda w: SyntheticImageNetLoader(
+                w, minibatch_size=batch, native_device_dtype=True,
+                normalization_type="scale"),
+            layers=[{**spec} for spec in alexnet.LAYERS],
+            decision_config={"max_epochs": 10 ** 6},
+            fused=True,
+            # remat off for apples-to-apples with the synthetic stage
+            # (see stage_alexnet_epoch's knob comment)
+            fused_config={"compute_dtype": jnp.bfloat16,
+                          "remat": remat_mode})
+        wf.launcher = DummyLauncher()
+        wf.initialize(device=AutoDevice())
+        trainer = wf.fused_trainer
+        trainer._build()
+        _e2e_loop("AlexNet end-to-end workflow throughput "
+                  "(u8-resident loader+gather+fused bf16 step)",
+                  wf.loader, trainer._params_, trainer._step_,
+                  extra={"remat": remat_mode})
+
+    remat = os.environ.get("BENCH_ALEXNET_REMAT", "0") == "1"
+    try:
+        run(remat)
+    except Exception as exc:
+        if remat:
+            raise
+        print("alexnet_e2e: remat-off failed (%s); retrying with "
+              "remat" % type(exc).__name__, file=sys.stderr)
+        os.environ["BENCH_ALEXNET_REMAT"] = "1"
+        run(True)
 
 
 def stage_alexnet512():
