@@ -292,6 +292,32 @@ def test_fused_solver_selection_learns(solver):
             assert set(signs).issubset({-1.0, 0.0, 1.0})
 
 
+def test_fused_step_compiles_exactly_once_across_calls():
+    """The trainer's params are COMMITTED device arrays: an
+    uncommitted input (plain device_put) plus the step's committed
+    output params would re-key the jit cache on the SECOND call and
+    recompile the entire step — observed as a 9.6-20 s first-loop
+    stall per chip session (r4 session 4 compile log)."""
+    import jax
+
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist
+
+    prng.seed_all(1)
+    wf = mnist.create_workflow(device=CPUDevice(), max_epochs=1,
+                               minibatch_size=500, fused=True)
+    wf.fused_trainer._build()
+    tr = wf.fused_trainer
+    x = jax.device_put(numpy.zeros((500, 784), numpy.float32))
+    labels = jax.device_put(numpy.zeros((500,), numpy.int32))
+    params, _m = tr._step_(tr._params_, x, labels)
+    assert tr._step_._cache_size() == 1
+    params, _m = tr._step_(params, x, labels)
+    params, _m = tr._step_(params, x, labels)
+    assert tr._step_._cache_size() == 1, \
+        "step retraced: params committed-ness must match its outputs"
+
+
 def test_standard_workflow_fused_mode_trains():
     """StandardWorkflow(fused=True): the graph keeps the loader /
     Decision / services, the math runs as ONE program per minibatch

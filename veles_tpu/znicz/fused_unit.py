@@ -165,7 +165,19 @@ class FusedTrainer(AcceleratedUnit):
             # train batches must also split evenly over the data axis
             self._train_divisor_ *= int(mesh.shape["data"])
         else:
-            self._params_ = jax.device_put(params)
+            # COMMITTED placement on the UNIT'S device: device_put
+            # with no device yields UNCOMMITTED arrays, while the
+            # step's OUTPUT params are committed — the second call
+            # then keys the jit cache differently and recompiles the
+            # whole step (observed as a 9.6-20 s first-loop stall on
+            # the tunneled chip, r4 session 4 compile log).  The
+            # unit's own device, not jax.devices()[0]: the loader's
+            # batches are committed there too (memory.py Vector).
+            if self.device is not None and \
+                    not self.device.is_interpret:
+                self._params_ = self.device.put(params)
+            else:
+                self._params_ = jax.device_put(params)
             self._step_ = jax.jit(step_fn, donate_argnums=(0,))
             self._eval_ = jax.jit(eval_fn)
         if self.epoch_mode:
