@@ -229,6 +229,66 @@ def test_transformer_chunked_ce_matches_full_logits():
                                       numpy.asarray(b), atol=1e-6)
 
 
+def test_transformer_chunked_ce_matches_full_logits_bf16():
+    """The same chunked-vs-full equivalence on the bf16 compute path —
+    the path the r4 dtype work actually changed (bf16 logits, bf16
+    backward cotangents).  Looser bars: the readout is bf16-rounded by
+    design (a deliberate precision trade, see apply_fn)."""
+    from veles_tpu.samples import transformer as T
+    cfg = dict(T.TINY)
+    toks = T.synthetic_tokens(cfg, 4)
+    full = T.make_train_step(cfg, compute_dtype=jnp.bfloat16,
+                             ce_chunk=0)
+    chunked = T.make_train_step(cfg, compute_dtype=jnp.bfloat16,
+                                ce_chunk=4)
+    p0 = T.init_params(cfg, seed=3)
+    v0 = jax.tree.map(numpy.zeros_like, p0)
+    pf, vf, mf = jax.jit(full)(p0, v0, toks)
+    pc, vc, mc = jax.jit(chunked)(p0, v0, toks)
+    assert numpy.isfinite(float(mf["loss"]))
+    assert float(mf["loss"]) == pytest.approx(float(mc["loss"]),
+                                              rel=2e-2)
+    for a, b in zip(jax.tree.leaves(pf), jax.tree.leaves(pc)):
+        numpy.testing.assert_allclose(numpy.asarray(a),
+                                      numpy.asarray(b), atol=5e-3)
+
+
+def test_flash_attention_backward_bf16_matches_f32_reference():
+    """The bf16-operand flash backward (r4: operands stay bf16 on the
+    MXU, f32 accumulation) must track the all-f32 backward within
+    bf16 resolution — pins the changed path, which the f32-pinned
+    attention tests never touch."""
+    from veles_tpu.ops.attention import flash_attention
+
+    rng = numpy.random.default_rng(7)
+    shp = (2, 64, 2, 32)
+    # the SAME bf16-representable values feed both paths, so the
+    # comparison isolates the backward's arithmetic (bf16 operands,
+    # f32 accumulation) from input quantization; smooth loss — an
+    # abs() loss flips cotangent signs wherever o crosses 0
+    q16, k16, v16 = (jnp.asarray(
+        rng.standard_normal(shp).astype(numpy.float32), jnp.bfloat16)
+        for _ in range(3))
+
+    def loss16(q, k, v):
+        o = flash_attention(q, k, v, True).astype(jnp.float32)
+        return jnp.sum(o * o)
+
+    def loss32(q, k, v):
+        o = flash_attention(q.astype(jnp.float32),
+                            k.astype(jnp.float32),
+                            v.astype(jnp.float32), True)
+        return jnp.sum(o * o)
+
+    g16 = jax.grad(loss16, argnums=(0, 1, 2))(q16, k16, v16)
+    g32 = jax.grad(loss32, argnums=(0, 1, 2))(q16, k16, v16)
+    for a, b in zip(g32, g16):
+        ref = numpy.asarray(a, dtype=numpy.float32)
+        got = numpy.asarray(b, dtype=numpy.float32)
+        denom = numpy.abs(ref).max() or 1.0
+        assert numpy.abs(got - ref).max() / denom < 0.03
+
+
 def test_transformer_chunked_ce_backward_stores_no_vocab_residual():
     """The checkpoint inside the CE scan is what makes the chunking
     real: without it the forward scan stacks each chunk's softmax

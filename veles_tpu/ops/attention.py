@@ -176,6 +176,13 @@ def _bwd_blockwise(res, do, causal, block_k):
 
     q_pos = jnp.arange(sq)
 
+    # matmul OPERANDS in the inputs' own dtype (bf16 stays bf16 on the
+    # MXU — all-f32 operands force the 3-pass f32 matmul mode, ~3x
+    # slower), accumulation in f32 via preferred_element_type; the
+    # softmax/rescale arithmetic (exp, lse, delta, ds) stays f32
+    mm = q.dtype
+    do_mm = do.astype(mm)
+
     def one_block(carry, idx):
         dq_acc, = carry
         k_blk = jax.lax.dynamic_slice_in_dim(kp, idx * block_k,
@@ -190,13 +197,16 @@ def _bwd_blockwise(res, do, causal, block_k):
             mask = mask & (k_pos[None, None, None, :]
                            <= q_pos[None, None, :, None])
         p = jnp.where(mask, jnp.exp(scores - lse[..., None]), 0.0)
-        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", do32,
-                        v_blk.astype(jnp.float32))
+        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p.astype(mm), do_mm,
+                            preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do_mm, v_blk,
+                        preferred_element_type=jnp.float32)
         ds = p * (dp - delta[..., None]) * scale
-        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds,
-                            k_blk.astype(jnp.float32))
-        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32))
+        ds_mm = ds.astype(mm)
+        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds_mm, k_blk,
+                            preferred_element_type=jnp.float32)
+        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds_mm, q,
+                            preferred_element_type=jnp.float32)
         return (dq_acc + dq_blk,), (dk_blk, dv_blk)
 
     (dq,), (dk_blocks, dv_blocks) = jax.lax.scan(

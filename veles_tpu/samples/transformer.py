@@ -115,11 +115,15 @@ def _block(h, blk, mesh, seq_axis, compute_dtype):
     # no-remat step on a 16 GB chip (r4 session 4 compile dump).
     # Biases are cast too: a f32 bias add silently promotes the whole
     # activation back to f32.
+    # No preferred_element_type=f32 on these dots: the MXU already
+    # accumulates bf16 operands in f32 internally, so a f32 OUTPUT
+    # (then downcast) buys no precision — but it makes every backward
+    # cotangent f32, and the VJP's f32xbf16 matmuls get promoted to
+    # the ~3x-slower all-f32 MXU mode.  bf16 outputs keep the whole
+    # backward on the fast path.
     x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
     qkv = jnp.einsum("bsd,dchx->bschx", x.astype(compute_dtype),
-                     blk["wqkv"].astype(compute_dtype),
-                     preferred_element_type=jnp.float32
-                     ).astype(compute_dtype)
+                     blk["wqkv"].astype(compute_dtype))
     if mesh is not None and mesh.shape.get("model", 1) > 1:
         qkv = jax.lax.with_sharding_constraint(
             qkv, NamedSharding(
@@ -127,8 +131,7 @@ def _block(h, blk, mesh, seq_axis, compute_dtype):
     q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
     att = _attend(q, k, v, mesh, seq_axis)
     proj = jnp.einsum("bshx,hxd->bsd", att.astype(compute_dtype),
-                      blk["wo"].astype(compute_dtype),
-                      preferred_element_type=jnp.float32)
+                      blk["wo"].astype(compute_dtype))
     h = h + proj.astype(h.dtype)
     x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
     up = (x.astype(compute_dtype) @ blk["w1"].astype(compute_dtype)
@@ -168,9 +171,15 @@ def apply_fn(params, tokens, cfg=None, mesh=None, seq_axis="seq",
     h = hidden_fn(params, tokens, cfg, mesh=mesh, seq_axis=seq_axis,
                   compute_dtype=compute_dtype, remat=remat)
     # weight-tied readout (embed^T) keeps the TINY config honest
+    # bf16 logits: unlike the qkv dot (which always downcast), this IS
+    # a deliberate precision trade — the readout's f32 accumulation is
+    # rounded to bf16 (~1e-2-nat per-token CE noise at V=32k), in
+    # exchange for bf16 cotangents through the two huge [*,V]x[V,d]
+    # backward matmuls (all-f32 promotion is ~3x slower on the MXU).
+    # The bf16 lm-head is standard practice at this scale; consumers
+    # upcast for the softmax math.
     logits = jnp.einsum("bsd,vd->bsv", h.astype(compute_dtype),
-                        params["embed"].astype(compute_dtype),
-                        preferred_element_type=jnp.float32)
+                        params["embed"].astype(compute_dtype))
     return logits
 
 
@@ -229,10 +238,14 @@ def make_train_step(cfg, mesh=None, seq_axis="seq", lr=3e-4,
         # chunk's logits from [B, chunk, d]
         @jax.checkpoint
         def chunk_nll_sum(hc, tc, mask):
+            # bf16 readout dot, f32 softmax math — the same deliberate
+            # precision trade as apply_fn's logits (bf16-rounded
+            # accumulation for a fast-bf16 backward); keeps the
+            # recompute-and-backward matmuls off the all-f32 path
             logits = jnp.einsum("bcd,vd->bcv",
                                 hc.astype(compute_dtype),
-                                emb.astype(compute_dtype),
-                                preferred_element_type=jnp.float32)
+                                emb.astype(compute_dtype)
+                                ).astype(jnp.float32)
             lse = jax.nn.logsumexp(logits, axis=-1)
             picked = jnp.take_along_axis(
                 logits, tc[..., None], axis=-1)[..., 0]
