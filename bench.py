@@ -105,8 +105,12 @@ def stage_probe():
              # need the real files; throughput stages use
              # synthetic batches either way
              "real_datasets_present": datasets,
-             "accuracy_parity": parity,
-             "banked_tpu_lines": _banked_tpu_lines()}
+             "accuracy_parity": parity}
+    banked, superseded = _banked_tpu_lines()
+    probe["banked_tpu_lines"] = banked
+    # older same-metric lines elided from the list above; the
+    # committed evidence files retain them
+    probe["banked_superseded_lines"] = superseded
     print(json.dumps(probe))
     return probe
 
@@ -117,18 +121,45 @@ def _banked_tpu_lines():
     the session commits them).  They are provenance, not measurements:
     if the tunnel is down when this bench runs, the judge can still
     find the hardware evidence instead of mistaking a cpu-fallback run
-    for "no TPU numbers exist" (VERDICT r3 'missing' item 1)."""
+    for "no TPU numbers exist" (VERDICT r3 'missing' item 1).
+
+    Per (metric, device kind), only the NEWEST banked line is listed:
+    earlier windows in the evidence dir include measurements from
+    before stopwatch/config fixes (the pre-device-pin AlexNet 1814
+    line, the inflated LM 309k line) and listing them next to their
+    corrected successors would make the provenance ambiguous.
+    Returns ``(lines, n_superseded)``; the evidence files retain every
+    elided line."""
     here = os.path.dirname(os.path.abspath(__file__))
-    banked = []
     rels = []
     # the tracked evidence dir (scripts/collect_chip_session.py snapshots
     # finished windows there, never overwriting) plus the live, still-
-    # gitignored session outdir
+    # gitignored session outdirs
     for d in ("chip_session_r4", "chip_session_logs_r4"):
         full = os.path.join(here, d)
         if os.path.isdir(full):
             rels.extend(os.path.join(d, n) for n in sorted(os.listdir(full))
                         if n.endswith(".jsonl"))
+    # oldest -> newest so the per-metric dict keeps the newest line.
+    # Ordering: the collector's numeric no-clobber suffix first
+    # ("name.jsonl" = 1, "name.2.jsonl" = 2, ...) — file mtime alone
+    # is useless in a fresh git checkout, where every tracked file
+    # gets the same checkout time — then mtime as the tie-break.
+    def _order(rel):
+        base = os.path.basename(rel)
+        parts = base.split(".")
+        num = 1
+        if len(parts) >= 3 and parts[-2].isdigit():
+            num = int(parts[-2])
+        try:
+            mtime = os.path.getmtime(os.path.join(here, rel))
+        except OSError:
+            mtime = 0.0
+        return (num, mtime)
+
+    rels.sort(key=_order)
+    newest = {}
+    total = 0
     for rel in rels:
         path = os.path.join(here, rel)
         try:
@@ -145,16 +176,18 @@ def _banked_tpu_lines():
             try:
                 rec = json.loads(line.strip())
                 kind = rec.get("device_kind") or ""
-                if "TPU" in kind or "tpu" in kind:
-                    banked.append({
+                if "tpu" in kind.lower():   # collector's definition
+                    total += 1
+                    newest[(rec.get("metric"), kind)] = {
                         "metric": rec.get("metric"),
                         "value": rec.get("value"),
                         "unit": rec.get("unit"),
                         "device_kind": kind,
-                        "source": rel})
+                        "source": rel}
             except Exception:
                 continue
-    return banked
+    banked = list(newest.values())
+    return banked, total - len(banked)
 
 
 def _device_kind():
@@ -383,6 +416,15 @@ def stage_cifar():
     from veles_tpu.samples import cifar10
     _conv_stage("CIFAR-10 convnet fused train throughput",
                 cifar10.LAYERS, (32, 32, 3), 10, batch=1024, steps=20)
+
+
+def stage_stl10():
+    """STL-10 convnet (96x96x3) — the last BASELINE.md config ladder
+    member without its own throughput line."""
+    from veles_tpu.samples import stl10
+    batch = int(os.environ.get("BENCH_STL10_BATCH", "256"))
+    _conv_stage("STL-10 convnet fused train throughput",
+                stl10.LAYERS, (96, 96, 3), 10, batch=batch, steps=12)
 
 
 def _e2e_loop(metric, loader, params, step, label_dtype="int32",
@@ -1086,6 +1128,7 @@ STAGES = {
     "mnist_wf": (stage_mnist_wf, 240),
     "mnist_wf_epoch": (stage_mnist_wf_epoch, 240),
     "cifar": (stage_cifar, 210),
+    "stl10": (stage_stl10, 240),
     "ae": (stage_ae, 150),
     "kohonen": (stage_kohonen, 150),
     "lstm": (stage_lstm, 180),
@@ -1107,7 +1150,7 @@ STAGES = {
 #: AlexNet headline LAST so its line is the final one on stdout.
 _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "mnist_wf",
-               "mnist_wf_epoch", "cifar", "ae", "kohonen",
+               "mnist_wf_epoch", "cifar", "stl10", "ae", "kohonen",
                "lstm", "transformer", "profile_lm", "power",
                "native_infer", "s2d", "alexnet512", "alexnet_e2e",
                "alexnet_epoch", "profile", "alexnet")
@@ -1122,7 +1165,8 @@ _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
                "s2d", "alexnet512", "alexnet_e2e", "alexnet_epoch",
                "transformer", "profile_lm", "lstm", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "power", "native_infer",
-               "cifar", "ae", "kohonen", "mnist_wf", "mnist_wf_epoch")
+               "cifar", "stl10", "ae", "kohonen", "mnist_wf",
+               "mnist_wf_epoch")
 
 #: CPU fallback (rehearsed with a wedged tunnel): conv/LM heavies
 #: cannot finish on CPU inside their caps — end on the flagship MNIST

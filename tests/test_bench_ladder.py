@@ -231,20 +231,51 @@ def test_banked_lines_survive_torn_and_garbage_records(monkeypatch,
     ]) + "\n")
     monkeypatch.setattr(bench.os.path, "dirname",
                         lambda p: str(tmp_path))
-    banked = bench._banked_tpu_lines()
-    metrics = [rec["metric"] for rec in banked]
+    banked, superseded = bench._banked_tpu_lines()
+    metrics = sorted(rec["metric"] for rec in banked)
     # garbage lines cost only themselves: the newest line AFTER the
     # torn one still surfaces, cpu lines are filtered out
-    assert metrics == ["old", "newest"]
+    assert metrics == ["newest", "old"]     # sorted()
+    assert superseded == 0
     assert all(rec["source"] == os.path.join("chip_session_r4",
                                              "bench.jsonl")
                for rec in banked)
 
 
+def test_banked_lines_newest_per_metric_wins(monkeypatch, tmp_path):
+    """Per (metric, device kind) only the NEWEST line (collector's
+    numeric suffix order — file mtimes are all equal in a fresh git
+    checkout) is surfaced; older same-metric lines are counted, not
+    listed.  Distinct device kinds never supersede each other."""
+    d = tmp_path / "chip_session_r4"
+    d.mkdir()
+    (d / "bench.jsonl").write_text(json.dumps(
+        {"metric": "headline", "value": 1814.0, "unit": "images/sec",
+         "device_kind": "TPU v5 lite"}) + "\n")
+    (d / "bench.2.jsonl").write_text("\n".join([
+        json.dumps({"metric": "headline", "value": 12441.0,
+                    "unit": "images/sec",
+                    "device_kind": "TPU v5 lite"}),
+        json.dumps({"metric": "headline", "value": 999.0,
+                    "unit": "images/sec", "device_kind": "Tpu v6"}),
+    ]) + "\n")
+    # identical checkout mtimes: order must come from the suffix
+    t = os.path.getmtime(str(d / "bench.jsonl"))
+    os.utime(str(d / "bench.2.jsonl"), (t, t))
+    monkeypatch.setattr(bench.os.path, "dirname",
+                        lambda p: str(tmp_path))
+    banked, superseded = bench._banked_tpu_lines()
+    by_kind = {rec["device_kind"]: rec for rec in banked}
+    assert by_kind["TPU v5 lite"]["value"] == 12441.0   # newest wins
+    assert by_kind["TPU v5 lite"]["source"].endswith("bench.2.jsonl")
+    assert by_kind["Tpu v6"]["value"] == 999.0  # mixed case, distinct
+    assert superseded == 1
+
+
 def test_banked_lines_missing_files_is_empty(monkeypatch, tmp_path):
     monkeypatch.setattr(bench.os.path, "dirname",
                         lambda p: str(tmp_path))
-    assert bench._banked_tpu_lines() == []
+    assert bench._banked_tpu_lines() == ([], 0)
 
 
 # ---------------------------------------------------------------------------
