@@ -99,6 +99,12 @@ def _gather_kernel(idx_ref, data_ref, o_ref):
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _gather_pallas(data, indices, interpret=False):
+    # The Mosaic lowering requires a block's last two dims to be
+    # divisible by (8, 128) OR equal to the array's dims.  A (1, f)
+    # block over (n, f) fails the sublane rule for any n > 1, so the
+    # data rides as (n, 1, f) with (1, 1, f) blocks — both trailing
+    # block dims then EQUAL the array dims, with no padding and no
+    # copy (the reshape is a view of the same HBM bytes).
     n, f = data.shape
     b = indices.shape[0]
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -107,14 +113,15 @@ def _gather_pallas(data, indices, interpret=False):
         in_specs=[
             # the index map reads the prefetched indices: block row i of
             # the output comes from dataset row indices[i]
-            pl.BlockSpec((1, f), lambda i, idx_ref: (jnp.maximum(
-                idx_ref[i], 0), 0)),
+            pl.BlockSpec((1, 1, f), lambda i, idx_ref: (jnp.maximum(
+                idx_ref[i], 0), 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, f), lambda i, idx_ref: (i, 0)),
+        out_specs=pl.BlockSpec((1, 1, f), lambda i, idx_ref: (i, 0, 0)),
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _gather_kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, f), data.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, 1, f), data.dtype),
         interpret=interpret,
-    )(jnp.asarray(indices, jnp.int32), data)
+    )(jnp.asarray(indices, jnp.int32), data.reshape(n, 1, f))
+    return out.reshape(b, f)
