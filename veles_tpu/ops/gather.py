@@ -28,14 +28,20 @@ def take_rows(data, indices, use_pallas=None):
     Backend dispatch (when ``use_pallas`` is None):
     ``root.common.engine.pallas_gather`` (True/False force) → the
     device DB's measured A/B (``autotune_gather``) → the XLA path.
-    The Pallas DMA kernel only ever runs on TPU."""
+    The compiled Pallas DMA kernel runs on TPU only; a config FORCE
+    additionally honors ``engine.interpret`` so CPU tests can pin the
+    in-scan composition through the Pallas interpreter."""
     auto = use_pallas is None
     if auto:
         from veles_tpu.config import root
         from veles_tpu.ops import on_tpu
         forced = root.common.engine.get("pallas_gather", None)
         if isinstance(forced, bool):
-            use_pallas = forced and on_tpu()
+            # a forced kernel also honors interpret mode (the Pallas
+            # interpreter runs on any backend — how CPU tests pin the
+            # in-scan composition the TPU path executes)
+            interp = bool(root.common.engine.get("interpret", False))
+            use_pallas = forced and (on_tpu() or interp)
             auto = False          # explicit config force: never mask
         else:
             from veles_tpu.ops.benchmark import gather_choice
