@@ -74,6 +74,11 @@ BENCH_STAGES=mnist,lstm,transformer,alexnet \
     BENCH_BUDGET_SEC=3600 \
     python bench.py >"$OUT/bench_tuned.jsonl" 2>"$OUT/bench_tuned.log"
 note "tuned re-bench rc=$? (lines: $(wc -l <"$OUT/bench_tuned.jsonl"))"
-note "done — run scripts/collect_chip_session.py $OUT to snapshot the"
-note "evidence, then commit chip_session_r4/, PROFILE.md and the DB"
+# snapshot into the tracked evidence dir immediately (no-clobber), so
+# a window that lands unattended still banks its artifacts; the
+# builder commits chip_session_r4/, PROFILE*.md and the DB afterwards
+python scripts/collect_chip_session.py "$OUT" >/dev/null 2>&1 \
+    || note "collector failed — snapshot manually"
+note "done — evidence snapshotted; commit chip_session_r4/,"
+note "PROFILE.md / PROFILE_LM.md and the refreshed device DB"
 exit 0
