@@ -55,6 +55,7 @@ PY
 
 note "1/3 one-claim bench ladder (headline + PROFILE.md + s2d ride ONE claim)"
 BENCH_BUDGET_SEC=${BENCH_BUDGET_SEC:-6000} \
+    BENCH_PER_LAYER=${BENCH_PER_LAYER:-1} \
     python bench.py >"$OUT/bench.jsonl" 2>"$OUT/bench.log"
 note "bench rc=$? (lines: $(wc -l <"$OUT/bench.jsonl"))"
 
