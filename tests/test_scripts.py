@@ -191,6 +191,67 @@ class TestBBoxer:
         state["ioloop"].add_callback(state["ioloop"].stop)
 
 
+def test_profile_step_per_layer_table(tmp_path):
+    """profile_step.measure_per_layer: one row per layer from prefix
+    differences; the final prefix REUSES the supplied full-forward
+    measurement (its flops land in the last row); a full-forward
+    SMALLER than the measured prefixes forces negative differences,
+    which the clamp must floor at zero."""
+    from veles_tpu.samples import cifar10
+    from veles_tpu.scripts import profile_step
+
+    rows = profile_step.measure_per_layer(
+        "cifar10", batch=4, k=4, full_forward=(0.5, 4.2e9))
+    assert len(rows) == len(cifar10.LAYERS)
+    # labels carry position + unit type
+    assert rows[0][0].startswith("01 conv")
+    assert all(sec >= 0.0 and flops >= 0.0 for _l, sec, flops in rows)
+    # the injected full-forward anchors the LAST row: its flops are
+    # the 4.2 GFLOP total minus the (tiny, batch-4) prefix-7 flops —
+    # a re-timing regression could not produce this value
+    assert rows[-1][2] > 4.0e9
+    # the injected 0.5 s dwarfs every CPU prefix: virtually all of it
+    # must surface in the final row (proves the reuse, not a re-time)
+    assert rows[-1][1] > 0.4
+
+    # full_forward SMALLER than the measured prefixes: the final
+    # difference goes negative and must be clamped to exactly 0
+    rows0 = profile_step.measure_per_layer(
+        "cifar10", batch=4, k=4, full_forward=(0.0, 0.0))
+    assert rows0[-1][1] == 0.0 and rows0[-1][2] == 0.0
+
+
+def test_profile_step_per_layer_report_rendering(tmp_path,
+                                                 monkeypatch):
+    """main(--per-layer) renders the table from measure_per_layer's
+    rows (sweep stubbed out — the sweep itself is covered above)."""
+    from veles_tpu.scripts import profile_step
+
+    monkeypatch.setattr(
+        profile_step, "measure_per_layer",
+        lambda sample, batch, k=8, full_forward=None: [
+            ("01 conv_strict_relu", 1e-3, 2.0e9),
+            ("02 max_pooling", 1e-4, 0.0)])
+    out = tmp_path / "P.md"
+    rc = profile_step.main(["--sample", "cifar10", "--batch", "4",
+                            "--k", "4", "--per-layer",
+                            "--out", str(out)])
+    assert rc == 0
+    text = out.read_text()
+    assert "Per-layer forward (prefix-difference)" in text
+    assert "01 conv_strict_relu" in text and "02 max_pooling" in text
+    # recurrent samples skip with a note instead of a wrong table
+    monkeypatch.setattr(
+        profile_step, "measure_per_layer",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError(
+            "per-layer must not run for recurrent samples")))
+    rc = profile_step.main(["--sample", "mnist_rnn", "--batch", "4",
+                            "--k", "4", "--per-layer",
+                            "--out", str(out)])
+    assert rc == 0
+    assert "skipped for mnist_rnn" in out.read_text()
+
+
 def test_bench_power_stage_vs_titan(monkeypatch, capsys):
     """The power stage reports the reference-anchored chain-time ratio
     (GTX TITAN float P0, 0.1642 s — the one absolute throughput number
