@@ -191,7 +191,7 @@ class TestBBoxer:
         state["ioloop"].add_callback(state["ioloop"].stop)
 
 
-def test_profile_step_per_layer_table(tmp_path):
+def test_profile_step_per_layer_table():
     """profile_step.measure_per_layer: one row per layer from prefix
     differences; the final prefix REUSES the supplied full-forward
     measurement (its flops land in the last row); a full-forward

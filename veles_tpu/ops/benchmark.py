@@ -307,6 +307,21 @@ def measure_s2d_ab(batch=256, spatial=227, dtype_name="bfloat16",
     return {"base_sec": secs[False], "s2d_sec": secs[True]}
 
 
+def _persist_ab_entry(rating_key, dtype_name, entry, save, db_path):
+    """Shared write path of the boolean-A/B autotunes (s2d, gather):
+    load the DB, set ``ratings[rating_key][dtype_name]``, save, and
+    invalidate the verdict cache."""
+    db_path = db_path or DEVICE_INFOS_JSON
+    model = jax.devices()[0].device_kind
+    db = DeviceInfo.load_db(db_path)
+    info = db.setdefault(model, DeviceInfo(model))
+    info.ratings.setdefault(rating_key, {})[dtype_name] = entry
+    if save:
+        DeviceInfo.save_db(db, db_path)
+    _verdict_cached.cache_clear()
+    return info
+
+
 def autotune_s2d(batch=256, spatial=227, dtype_name="bfloat16",
                  save=True, db_path=None):
     """Measure the space-to-depth conv rewrite A/B on the attached
@@ -314,21 +329,13 @@ def autotune_s2d(batch=256, spatial=227, dtype_name="bfloat16",
     :meth:`veles_tpu.znicz.conv.Conv.pure_config` dispatches from a
     measurement instead of the lane-occupancy heuristic (r4 window 3:
     the heuristic said s2d, the chip said 0.51x)."""
-    db_path = db_path or DEVICE_INFOS_JSON
-    model = jax.devices()[0].device_kind
-    db = DeviceInfo.load_db(db_path)
-    info = db.setdefault(model, DeviceInfo(model))
     secs = measure_s2d_ab(batch=batch, spatial=spatial,
                           dtype_name=dtype_name)
-    info.ratings.setdefault("s2d_conv", {})[dtype_name] = {
+    return _persist_ab_entry("s2d_conv", dtype_name, {
         "enabled": secs["s2d_sec"] < secs["base_sec"],
         "base_ms": round(secs["base_sec"] * 1e3, 4),
         "s2d_ms": round(secs["s2d_sec"] * 1e3, 4),
-        "shape": [batch, spatial, spatial, 3]}
-    if save:
-        DeviceInfo.save_db(db, db_path)
-    s2d_choice.cache_clear()
-    return info
+        "shape": [batch, spatial, spatial, 3]}, save, db_path)
 
 
 def measure_gather_ab(n=4096, row=(227, 227, 3), dtype_name="uint8",
@@ -397,10 +404,6 @@ def autotune_gather(n=4096, row=(227, 227, 3), dtype_name="uint8",
     persist the winner under ``ratings["gather"]`` so
     :func:`veles_tpu.ops.gather.take_rows` dispatches the resident-
     dataset gather from a measurement."""
-    db_path = db_path or DEVICE_INFOS_JSON
-    model = jax.devices()[0].device_kind
-    db = DeviceInfo.load_db(db_path)
-    info = db.setdefault(model, DeviceInfo(model))
     res = measure_gather_ab(n=n, row=row, dtype_name=dtype_name,
                             batch=batch)
     pallas_wins = (res["pallas_sec"] is not None
@@ -413,11 +416,8 @@ def autotune_gather(n=4096, row=(227, 227, 3), dtype_name="uint8",
         "shape": [n] + list(row), "batch": batch}
     if res["pallas_error"]:
         entry["pallas_error"] = res["pallas_error"][:200]
-    info.ratings.setdefault("gather", {})[dtype_name] = entry
-    if save:
-        DeviceInfo.save_db(db, db_path)
-    gather_choice.cache_clear()
-    return info
+    return _persist_ab_entry("gather", dtype_name, entry, save,
+                             db_path)
 
 
 @functools.lru_cache(maxsize=64)

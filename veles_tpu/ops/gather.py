@@ -31,9 +31,9 @@ def take_rows(data, indices, use_pallas=None):
     The compiled Pallas DMA kernel runs on TPU only; a config FORCE
     additionally honors ``engine.interpret`` so CPU tests can pin the
     in-scan composition through the Pallas interpreter."""
+    from veles_tpu.config import root   # deferred: import cycle
     auto = use_pallas is None
     if auto:
-        from veles_tpu.config import root
         from veles_tpu.ops import on_tpu
         forced = root.common.engine.get("pallas_gather", None)
         if isinstance(forced, bool):
@@ -58,7 +58,6 @@ def take_rows(data, indices, use_pallas=None):
     key = (data.shape[1:], str(jnp.dtype(data.dtype)))
     if use_pallas and data.ndim >= 2 \
             and (not auto or key not in _PALLAS_REJECTED):
-        from veles_tpu.config import root
         try:
             flat = data.reshape(data.shape[0], -1)
             out = _gather_pallas(
