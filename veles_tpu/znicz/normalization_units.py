@@ -56,7 +56,14 @@ class LRNormalizerForward(ForwardBase):
         for i in range(n):
             window = window + jax.lax.slice_in_dim(
                 padded, i, i + x.shape[-1], axis=x.ndim - 1)
-        return (x / (k + alpha * window) ** beta).astype(x.dtype)
+        t = k + alpha * window
+        if beta == 0.75:
+            # t^-0.75 = rsqrt(t) * rsqrt(sqrt(t)): two cheap VPU ops
+            # instead of the exp/log that a general pow lowers to —
+            # 0.75 is the reference's (and AlexNet's) default beta
+            inv = jax.lax.rsqrt(t) * jax.lax.rsqrt(jnp.sqrt(t))
+            return (x * inv).astype(x.dtype)
+        return (x / t ** beta).astype(x.dtype)
 
     def initialize(self, device=None, **kwargs):
         super(LRNormalizerForward, self).initialize(device=device,
