@@ -35,7 +35,7 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 note "tunnel LIVE — starting chip_session (v2: one claim per step)"
-bash scripts/chip_session_v2.sh chip_session_logs_r4
+bash scripts/chip_session_v2.sh "${CHIP_SESSION_OUT:-chip_session_logs_r5}"
 rc=$?
 note "chip_session done rc=$rc"
 exit "$rc"

@@ -471,7 +471,10 @@ def gather_choice(dtype_name="uint8", db_path=None, row_elems=None):
         return None
     is_pallas, measured_elems = verdict
     if is_pallas and row_elems is not None \
-            and measured_elems not in (None, row_elems):
+            and measured_elems != row_elems:
+        # a missing measured shape (legacy/hand-edited DB entry) is
+        # NON-transferable too: trusting it re-exposes the Mosaic
+        # compile-time failure this gate exists to prevent (ADVICE r4)
         return False
     return is_pallas
 
