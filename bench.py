@@ -461,7 +461,7 @@ def _conv_stage(metric, layers, input_shape, n_classes, batch, steps,
     _emit(metric, sec, batch, flops, vs=vs)
 
 
-def _wf_stage(metric, fused_config=None):
+def _wf_stage(metric, fused_config=None, sample=None):
     """The WHOLE framework path: StandardWorkflow(fused=True) — graph
     scheduling, loader epoch bookkeeping, Decision accounting, and the
     fused step — timed over full epochs via wf.run().  Every minibatch
@@ -477,9 +477,9 @@ def _wf_stage(metric, fused_config=None):
     # train steps, so the train-step (or epoch-program) compile would
     # land inside the timed region — warm through epoch 2 (the first
     # REAL train epoch) instead
-    wf = mnist.create_workflow(device=AutoDevice(), max_epochs=2,
-                               minibatch_size=batch, fused=True,
-                               fused_config=dict(fused_config or {}))
+    wf = (sample or mnist).create_workflow(
+        device=AutoDevice(), max_epochs=2, minibatch_size=batch,
+        fused=True, fused_config=dict(fused_config or {}))
     wf.run()                               # epochs 1-2: compiles included
     wf.decision.complete <<= False
     wf.decision.max_epochs = 4
@@ -509,6 +509,17 @@ def stage_mnist_wf_epoch():
     _wf_stage("MNIST784 full StandardWorkflow(fused, epoch_mode) "
               "train throughput (epoch wall-clock incl. eval)",
               fused_config={"epoch_mode": True})
+
+
+def stage_ae_wf_epoch():
+    """The AE family through the full framework path with epoch_mode:
+    StandardWorkflow(fused, epoch_mode) + MSE loss — the regression
+    epoch program gathers resident float TARGETS in-program (VERDICT
+    r4 item 5: AE epoch-mode bench stage)."""
+    from veles_tpu.samples import mnist_ae
+    _wf_stage("MNIST784-AE full StandardWorkflow(fused, epoch_mode, "
+              "mse) train throughput (epoch wall-clock incl. eval)",
+              fused_config={"epoch_mode": True}, sample=mnist_ae)
 
 
 def stage_cifar():
@@ -723,6 +734,21 @@ def stage_lstm():
                           flops_override=flops_lstm)
     _emit("Sequential-MNIST LSTM fused train throughput", sec, batch,
           flops)
+    # bf16 A/B: the f32 LSTM is HBM-bound at these shapes
+    # (docs/performance.md roofline) — halving the activation bytes is
+    # the one lever the roofline allows; measure it so the claim is a
+    # number, not a prediction.  Chip-only (or forced): doubling the
+    # stage's work would blow the CPU-fallback cap for a number that
+    # only means something on HBM.
+    if _device_kind().lower().find("tpu") >= 0 \
+            or os.environ.get("BENCH_LSTM_BF16") == "1":
+        import jax.numpy as jnp
+        params16, step16, _e16, _a16 = lower_specs(
+            LAYERS, (28, 28), compute_dtype=jnp.bfloat16)
+        sec16, _f = _measure(step16, params16, x, labels, steps=50,
+                             flops_override=flops_lstm)
+        _emit("Sequential-MNIST LSTM fused train throughput (bf16)",
+              sec16, batch, flops_lstm)
 
 
 def stage_transformer():
@@ -1236,6 +1262,7 @@ STAGES = {
     "mnist_e2e_u8": (stage_mnist_e2e_u8, 240),
     "mnist_wf": (stage_mnist_wf, 240),
     "mnist_wf_epoch": (stage_mnist_wf_epoch, 240),
+    "ae_wf_epoch": (stage_ae_wf_epoch, 240),
     "cifar": (stage_cifar, 210),
     "stl10": (stage_stl10, 240),
     "ae": (stage_ae, 150),
@@ -1259,7 +1286,8 @@ STAGES = {
 #: AlexNet headline LAST so its line is the final one on stdout.
 _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "mnist_wf",
-               "mnist_wf_epoch", "cifar", "stl10", "ae", "kohonen",
+               "mnist_wf_epoch", "ae_wf_epoch", "cifar", "stl10", "ae",
+               "kohonen",
                "lstm", "transformer", "profile_lm", "power",
                "native_infer", "s2d", "alexnet512", "alexnet_e2e",
                "alexnet_epoch", "profile", "alexnet")
@@ -1275,13 +1303,13 @@ _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
                "transformer", "profile_lm", "lstm", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "power", "native_infer",
                "cifar", "stl10", "ae", "kohonen", "mnist_wf",
-               "mnist_wf_epoch")
+               "mnist_wf_epoch", "ae_wf_epoch")
 
 #: CPU fallback (rehearsed with a wedged tunnel): conv/LM heavies
 #: cannot finish on CPU inside their caps — end on the flagship MNIST
 #: number so the recorded last line is a real measurement.
 _CPU_ORDER = ("mnist_e2e", "mnist_epoch", "mnist_wf",
-              "mnist_wf_epoch", "ae", "kohonen", "lstm",
+              "mnist_wf_epoch", "ae_wf_epoch", "ae", "kohonen", "lstm",
               "native_infer", "mnist_u8", "mnist_bf16", "mnist")
 
 

@@ -65,6 +65,17 @@ if ! headline_landed "$OUT/bench.jsonl"; then
     exit 1
 fi
 
+note "1b/3 per-layer profiles for the two unadjudicated MFU stages"
+# VERDICT r4 item 6: LSTM 0.115 / CIFAR 0.17 need a committed
+# per-stage artifact (fix or roofline); these two runs provide the
+# measured side of docs/performance.md's roofline notes
+python -m veles_tpu.scripts.profile_step --sample cifar10 \
+    --batch 1024 --per-layer --out PROFILE_CIFAR.md \
+    >>"$OUT/profile.log" 2>&1 || note "cifar profile failed"
+python -m veles_tpu.scripts.profile_step --sample mnist_rnn \
+    --batch 2048 --out PROFILE_LSTM.md \
+    >>"$OUT/profile.log" 2>&1 || note "lstm profile failed"
+
 note "2/3 autotune sweep (levels 0,1,2 + attention + power, one claim)"
 python -m veles_tpu.scripts.autotune --precision-levels 0,1,2 \
     >"$OUT/autotune.json" 2>"$OUT/autotune.log"

@@ -998,25 +998,55 @@ def test_fused_epoch_mode_trains_and_keeps_decision_stream():
     assert float(_np.abs(wf.forwards[0].weights.mem).max()) > 0
 
 
-def test_fused_epoch_mode_rejects_mesh_and_mse():
+def test_fused_epoch_mode_on_mesh():
+    """'One workflow, any mode' (ref manualrst_veles_distributed_
+    training.rst:14-16): StandardWorkflow(fused, epoch_mode,
+    mesh_axes) routes the whole-epoch program through
+    parallel.dp.data_parallel_epoch — batch sharded over the 8-device
+    CPU mesh, gradient all-reduce inside the one-dispatch epoch —
+    and still trains to the usual synthetic accuracy (VERDICT r4
+    next-round item 5)."""
     from veles_tpu.backends import CPUDevice
-    from veles_tpu.samples import mnist, mnist_ae
+    from veles_tpu.samples import mnist
 
-    prng.seed_all(2)
+    prng.seed_all(1)
     wf = mnist.create_workflow(
-        device=CPUDevice(), max_epochs=1, minibatch_size=500,
+        device=CPUDevice(), max_epochs=3, minibatch_size=512,
         fused=True,
         fused_config={"epoch_mode": True, "mesh_axes": {"data": -1}})
-    with pytest.raises(NotImplementedError):
-        wf.run()
-    # the MSE guard (autoencoder sample trains with loss="mse")
+    wf.run()
+    results = wf.gather_results()
+    assert results["best_validation_error_pt"] < 35.0
+    assert wf.fused_trainer._epoch_fn_ is not None
+    # the resident TRAIN slice really is sharded over the data axis
+    assert not wf.fused_trainer._epoch_data_.sharding \
+        .is_fully_replicated
+
+
+def test_fused_epoch_mode_mse_autoencoder():
+    """epoch_mode with the MSE loss (the AE family): the epoch
+    program gathers resident float targets and the per-minibatch
+    replay feeds Decision's mse stream (VERDICT r4 item 5)."""
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist_ae
+
     prng.seed_all(2)
-    wf2 = mnist_ae.create_workflow(
-        device=CPUDevice(), max_epochs=1, minibatch_size=500,
+    wf = mnist_ae.create_workflow(
+        device=CPUDevice(), max_epochs=2, minibatch_size=500,
         fused=True, fused_config={"epoch_mode": True})
-    with pytest.raises(NotImplementedError):
-        wf2.run()
+    wf.run()
+    assert wf.fused_trainer._epoch_fn_ is not None
+    assert wf.fused_trainer.epoch_key_counter >= 1
+    # the replay populated the mse metric (an RMSE, finite, nonzero)
+    results = wf.gather_results()
+    assert 0.0 < results["best_rmse"] < 10.0
+
+
+def test_fused_epoch_mode_rejects_train_ratio():
     # bagged runs (train_ratio) are per-minibatch-path only
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.samples import mnist
+
     prng.seed_all(2)
     wf3 = mnist.create_workflow(
         device=CPUDevice(), max_epochs=1, minibatch_size=500,

@@ -401,3 +401,177 @@ class TestNativeDeviceDtype:
         # same seed, same (synthetic) images up to u8 rounding: the two
         # storage paths must land in the same accuracy neighborhood
         assert results[True] <= results[False] * 1.25 + 10
+
+
+def test_image_loader_rotations_inflate_and_blend(tmp_path):
+    """rotations=(0, π/2): every key yields one sample per rotation
+    (ref image.py:311 samples_inflation); a 90° rotation of a solid
+    image stays solid, and rotation by π/4 exposes corners that must
+    blend the configured background color (ref image.py:316-368)."""
+    import math
+    from PIL import Image
+    from veles_tpu.loader.image import AutoLabelFileImageLoader
+
+    d = tmp_path / "train" / "solid"
+    d.mkdir(parents=True)
+    solid = numpy.full((12, 12, 3), 200, numpy.uint8)
+    Image.fromarray(solid).save(d / "img.png")
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = AutoLabelFileImageLoader(
+        wf, train_paths=[str(tmp_path / "train")], size=(12, 12),
+        rotations=(0.0, math.pi / 2), minibatch_size=2)
+    loader.initialize(device=wf.device)
+    assert loader.samples_inflation == 2
+    assert loader.class_lengths[TRAIN] == 2        # 1 key x 2 rotations
+    loader.run()
+    got = loader.minibatch_data.mem[:2]
+    # solid image: 0° and 90° are both the solid value everywhere
+    assert numpy.allclose(got, 200.0, atol=1.0)
+
+    # π/4 exposes corners -> background color blended in
+    loader2 = AutoLabelFileImageLoader(
+        wf, train_paths=[str(tmp_path / "train")], size=(12, 12),
+        rotations=(math.pi / 4,), background_color=(0, 0, 255),
+        minibatch_size=1)
+    loader2.initialize(device=wf.device)
+    loader2.run()
+    img = loader2.minibatch_data.mem[0]
+    assert img[0, 0, 2] > 200.0        # corner is (mostly) background
+    assert img[0, 0, 0] < 60.0
+    assert abs(img[6, 6, 0] - 200.0) < 2.0   # center untouched
+
+
+def test_image_loader_background_image_shape_validated(tmp_path):
+    import math
+    from PIL import Image
+    from veles_tpu.loader.image import AutoLabelFileImageLoader
+    from veles_tpu.loader.base import LoaderError
+
+    d = tmp_path / "train" / "c"
+    d.mkdir(parents=True)
+    Image.fromarray(numpy.zeros((8, 8, 3), numpy.uint8)).save(
+        d / "img.png")
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = AutoLabelFileImageLoader(
+        wf, train_paths=[str(tmp_path / "train")], size=(8, 8),
+        rotations=(math.pi / 4,),
+        background_image=numpy.zeros((4, 4, 3), numpy.float32),
+        minibatch_size=1)
+    with pytest.raises(LoaderError):
+        # the first minibatch fill happens inside initialize()
+        loader.initialize(device=wf.device)
+        loader.run()
+
+
+def test_image_loader_mse_targets(tmp_path):
+    """ImageLoaderMSE (ref image_mse.py:46): minibatch_targets carries
+    the clean target image aligned with each input sample."""
+    from PIL import Image
+    from veles_tpu.loader.image import ImageLoaderMSE
+
+    d = tmp_path / "imgs"
+    d.mkdir()
+    rng = numpy.random.default_rng(7)
+    arrays = {}
+    for i in range(3):
+        arr = rng.integers(0, 255, (10, 10, 3), numpy.uint8)
+        name = str(d / ("t%d.png" % i))
+        Image.fromarray(arr).save(name)
+        arrays[name] = arr
+
+    class NoisyLoader(ImageLoaderMSE):
+        hide_from_registry = True
+
+        def get_keys(self, class_index):
+            return list(arrays) if class_index == TRAIN else []
+
+        def load_key(self, key):          # corrupted input
+            return numpy.zeros((10, 10, 3), numpy.uint8)
+
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = NoisyLoader(wf, size=(10, 10), minibatch_size=3)
+    loader.initialize(device=wf.device)
+    loader.run()
+    n = loader.minibatch_size
+    assert numpy.allclose(loader.minibatch_data.mem[:n], 0.0)
+    # targets are the CLEAN decodes of the same keys
+    loader.minibatch_indices.map_read()
+    for i, idx in enumerate(loader.minibatch_indices.mem[:n]):
+        key_idx, _rot = loader._key_and_rotation(idx)
+        clean = arrays[loader._flat_keys[key_idx]]
+        assert numpy.allclose(loader.minibatch_targets.mem[i],
+                              clean.astype(numpy.float32))
+
+
+def test_fullbatch_image_loader_inflation_fills_all_rows(tmp_path):
+    """FullBatchImageLoader must decode one resident row per INFLATED
+    sample (key x rotation) with labels aligned — a fill keyed on the
+    keys alone left the rotated rows zero (code-review r5)."""
+    import math
+    from PIL import Image
+    from veles_tpu.loader.image import (AutoLabelFileImageLoader,
+                                        FullBatchImageLoader)
+
+    d = tmp_path / "train" / "solid"
+    d.mkdir(parents=True)
+    Image.fromarray(numpy.full((8, 8, 3), 150, numpy.uint8)).save(
+        d / "img.png")
+    wf = DummyWorkflow()
+    wf.device = CPUDevice()
+    loader = FullBatchImageLoader(
+        wf, train_paths=[str(tmp_path / "train")], size=(8, 8),
+        rotations=(0.0, math.pi / 2), minibatch_size=2,
+        image_loader_class=AutoLabelFileImageLoader)
+    loader.initialize(device=wf.device)
+    assert loader.class_lengths[TRAIN] == 2
+    data = numpy.asarray(loader.original_data.mem)
+    assert data.shape[0] == 2
+    # BOTH rows carry the decoded (solid) image — 90° of a solid
+    # square is the same solid square, never zeros
+    assert numpy.allclose(data[0], 150.0, atol=1.0)
+    assert numpy.allclose(data[1], 150.0, atol=1.0)
+    assert len(loader.original_labels) == 2
+
+
+def test_image_loader_mse_aligned_under_mirror(tmp_path):
+    """Input and target must replay the SAME random mirror/crop draws
+    (code-review r5): with mirror=True every train pair still
+    satisfies target == clean-transform(input) when load_key ==
+    load_target."""
+    from PIL import Image
+    from veles_tpu.loader.image import ImageLoaderMSE
+
+    d = tmp_path / "imgs"
+    d.mkdir()
+    rng = numpy.random.default_rng(11)
+    names = []
+    for i in range(4):
+        arr = rng.integers(0, 255, (8, 8, 3), numpy.uint8)
+        name = str(d / ("m%d.png" % i))
+        Image.fromarray(arr).save(name)
+        names.append(name)
+
+    class PassthroughMSE(ImageLoaderMSE):
+        hide_from_registry = True
+
+        def get_keys(self, class_index):
+            return names if class_index == TRAIN else []
+
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = PassthroughMSE(wf, size=(8, 8), mirror=True, crop=(4, 4),
+                            minibatch_size=4)
+    loader.initialize(device=wf.device)
+    # drive until a TRAIN minibatch (random augmentation active)
+    for _ in range(8):
+        loader.run()
+        if loader.minibatch_class == TRAIN and loader.minibatch_size:
+            break
+    n = loader.minibatch_size
+    # identical load_key/load_target + shared decisions => identical
+    # tensors, flip or not
+    assert numpy.allclose(loader.minibatch_data.mem[:n],
+                          loader.minibatch_targets.mem[:n])

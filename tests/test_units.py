@@ -385,3 +385,40 @@ def test_force_numpy_pins_eager_path():
     unit.run()
     assert called["tpu"] == 0
     assert unit.output.mem.shape == (2, 4)
+
+
+def test_unit_hot_reload_live_instance(tmp_path, monkeypatch):
+    """Unit.reload(): edit a unit's source mid-run, reload, and the
+    LIVE instance (state intact) executes the new method body (ref
+    units.py:672 xreload; re-designed on importlib + __class__
+    re-pointing)."""
+    import sys
+    import textwrap
+
+    monkeypatch.syspath_prepend(str(tmp_path))
+    mod = tmp_path / "hotreload_demo_unit.py"
+    mod.write_text(textwrap.dedent("""
+        from veles_tpu.units import Unit
+
+        class HotUnit(Unit):
+            hide_from_registry = True
+            def run(self):
+                self.result = "v1-" + self.tag
+    """))
+    import importlib
+    demo = importlib.import_module("hotreload_demo_unit")
+    try:
+        from veles_tpu.dummy import DummyWorkflow
+        wf = DummyWorkflow()
+        unit = demo.HotUnit(wf)
+        unit.tag = "state"         # live state must survive the patch
+        unit.run()
+        assert unit.result == "v1-state"
+        mod.write_text(mod.read_text().replace("v1-", "v2-"))
+        remapped = demo.HotUnit.reload()
+        assert remapped >= 1
+        unit.run()                 # same instance, new body
+        assert unit.result == "v2-state"
+        assert unit.tag == "state"
+    finally:
+        sys.modules.pop("hotreload_demo_unit", None)

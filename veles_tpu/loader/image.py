@@ -99,6 +99,19 @@ class ImageLoader(Loader):
         self.crop = kwargs.get("crop")
         self.mirror = kwargs.get("mirror", False)
         self.color_space = kwargs.get("color_space", "RGB")
+        #: tuple of angles in RADIANS; every key yields one sample per
+        #: rotation (the reference's samples_inflation,
+        #: ref ``image.py:294-312``) — (0.0,) = no inflation
+        rot = kwargs.get("rotations", (0.0,))
+        if not isinstance(rot, (tuple, list)) or not rot:
+            raise LoaderError("rotations must be a non-empty tuple "
+                              "of radians (got %r)" % (rot,))
+        self.rotations = tuple(float(r) for r in rot)
+        #: exposed-corner fill after rotation: an HWC array blended in
+        #: (ref ``image.py:316-341``) or a per-channel color tuple
+        #: (ref ``:344``); image wins when both are set, default zeros
+        self.background_image = kwargs.get("background_image")
+        self.background_color = kwargs.get("background_color")
         self.keys = [[], [], []]
         self.labels = [[], [], []]
         super(ImageLoader, self).__init__(workflow, **kwargs)
@@ -139,8 +152,67 @@ class ImageLoader(Loader):
             wh = self.size
         return (wh[1], wh[0], self.channels)
 
-    def preprocess(self, image, train):
-        """scale → resize to ``size`` → crop → mirror → float32 HWC."""
+    @property
+    def samples_inflation(self):
+        """Samples per source key: one per configured rotation (ref
+        ``image.py:311``; the reference also doubles for mirror=True —
+        here mirror stays a random TRAIN flip, not an inflation)."""
+        return len(self.rotations)
+
+    def _background(self, shape):
+        """HWC float32 fill for rotation-exposed corners."""
+        if self.background_image is not None:
+            bg = numpy.asarray(self.background_image, numpy.float32)
+            if bg.ndim == 2:
+                bg = bg[:, :, None]
+            if bg.shape != tuple(shape):
+                raise LoaderError(
+                    "background_image shape %s != rotated pre-crop "
+                    "image shape %s — rotation (and its background "
+                    "fill) happens BEFORE crop, so the background "
+                    "must match the resized geometry, not sample_shape"
+                    " (ref image.py:329 validates the same stage)"
+                    % (bg.shape, tuple(shape)))
+            return bg
+        if self.background_color is not None:
+            color = numpy.asarray(self.background_color, numpy.float32)
+            if color.size != shape[-1]:
+                raise LoaderError(
+                    "background_color %s must have %d channels"
+                    % (self.background_color, shape[-1]))
+            return numpy.broadcast_to(color, shape)
+        return numpy.zeros(shape, numpy.float32)
+
+    def _rotate(self, image, angle):
+        """Rotate an HWC array by ``angle`` radians about its center,
+        blending :meth:`_background` into the exposed corners (ref
+        ``image.py`` background_image/background_color semantics)."""
+        import math
+
+        Image = _pil()
+        degrees = math.degrees(angle)
+        pil = Image.fromarray(
+            image.squeeze(-1).astype(numpy.uint8)
+            if image.shape[-1] == 1 else image.astype(numpy.uint8))
+        rot = numpy.asarray(pil.rotate(degrees, Image.BILINEAR))
+        if rot.ndim == 2:
+            rot = rot[:, :, None]
+        # an all-opaque mask rotated the same way marks the exposed
+        # (out-of-frame) pixels exactly, including the anti-aliased rim
+        mask = numpy.asarray(Image.new("L", pil.size, 255)
+                             .rotate(degrees, Image.BILINEAR))
+        mask = (mask.astype(numpy.float32) / 255.0)[:, :, None]
+        bg = self._background(rot.shape)
+        return rot.astype(numpy.float32) * mask + bg * (1.0 - mask)
+
+    def preprocess(self, image, train, rotation=0.0, decisions=None):
+        """scale → resize to ``size`` → rotate (background-blended) →
+        crop → mirror → float32 HWC.
+
+        ``decisions``: a mutable dict capturing this call's random
+        augmentation draws (crop offset, mirror flag) so a SECOND
+        tensor — the MSE target — can replay them and stay
+        geometrically aligned with its input."""
         Image = _pil()
         if image.ndim == 2:
             image = image[:, :, None]
@@ -154,30 +226,46 @@ class ImageLoader(Loader):
             image = numpy.asarray(pil.resize(size, Image.BILINEAR))
             if image.ndim == 2:
                 image = image[:, :, None]
+        if rotation:
+            image = self._rotate(image, rotation)
         if self.crop:
             cw, ch = self.crop
             h, w = image.shape[:2]
             if ch > h or cw > w:
                 raise LoaderError("crop %s larger than image %s"
                                   % ((cw, ch), (w, h)))
-            if train:
+            if decisions is not None and "crop" in decisions:
+                y, x = decisions["crop"]
+            elif train:
                 y = int(self.prng.randint(0, h - ch + 1))
                 x = int(self.prng.randint(0, w - cw + 1))
             else:
                 y, x = (h - ch) // 2, (w - cw) // 2
+            if decisions is not None:
+                decisions["crop"] = (y, x)
             image = image[y:y + ch, x:x + cw]
-        if self.mirror and train and self.prng.randint(0, 2):
-            image = image[:, ::-1]
+        if self.mirror:
+            if decisions is not None and "mirror" in decisions:
+                flip = decisions["mirror"]
+            else:
+                flip = bool(train and self.prng.randint(0, 2))
+            if decisions is not None:
+                decisions["mirror"] = flip
+            if flip:
+                image = image[:, ::-1]
         return numpy.ascontiguousarray(image, dtype=numpy.float32)
 
     # -- ILoader ------------------------------------------------------------
     def load_data(self):
+        infl = self.samples_inflation
         for class_index in (TEST, VALID, TRAIN):
             keys = sorted(self.get_keys(class_index))
             self.keys[class_index] = keys
             self.labels[class_index] = [
                 self.get_label(key, class_index) for key in keys]
-            self.class_lengths[class_index] = len(keys)
+            # every key contributes one sample per rotation (ref
+            # ``image.py:630``: len(keys) * samples_inflation)
+            self.class_lengths[class_index] = len(keys) * infl
         self._flat_keys = sum(self.keys, [])
         self._flat_labels = sum(self.labels, [])
         self._has_labels = any(
@@ -187,6 +275,12 @@ class ImageLoader(Loader):
         self.minibatch_data.reset(numpy.zeros(
             (self.max_minibatch_size,) + self.sample_shape,
             dtype=numpy.float32))
+
+    def _key_and_rotation(self, idx):
+        """Global sample index → (flat key index, rotation angle) —
+        the reference's divmod decode (``image.py:766``)."""
+        key_idx, rot_idx = divmod(int(idx), self.samples_inflation)
+        return key_idx, self.rotations[rot_idx]
 
     def fill_minibatch(self):
         self.minibatch_data.map_write()
@@ -198,9 +292,11 @@ class ImageLoader(Loader):
                 self.minibatch_data.mem[i] = 0
                 self.raw_minibatch_labels[i] = None
                 continue
-            image = self.load_key(self._flat_keys[idx])
-            self.minibatch_data.mem[i] = self.preprocess(image, train)
-            self.raw_minibatch_labels[i] = self._flat_labels[idx]
+            key_idx, rotation = self._key_and_rotation(idx)
+            image = self.load_key(self._flat_keys[key_idx])
+            self.minibatch_data.mem[i] = self.preprocess(
+                image, train, rotation=rotation)
+            self.raw_minibatch_labels[i] = self._flat_labels[key_idx]
 
 
 class FileImageLoader(ImageLoader):
@@ -235,6 +331,72 @@ class AutoLabelFileImageLoader(FileImageLoader):
         return os.path.basename(os.path.dirname(key))
 
 
+class ImageLoaderMSE(ImageLoader):
+    """Image → target-image pairs for regression workflows (ref
+    ``image_mse.py:46`` ``ImageLoaderMSEMixin``/``ImageLoaderMSE``):
+    inputs come from the usual per-class key space; each sample's
+    TARGET image is :meth:`load_target` of :meth:`get_target_key` —
+    by default the input key itself (the denoising/reconstruction-AE
+    recipe, where :meth:`load_key` may corrupt and the target stays
+    clean).  Subclasses with separate target sets override
+    ``get_target_key`` to map a label to its target key (the
+    reference's ``target_label_map``).
+
+    Input and target share ONE set of augmentation draws per sample
+    (rotation, crop offset, mirror flag — the ``decisions`` replay in
+    :meth:`ImageLoader.preprocess`), so their geometry stays aligned
+    even under random TRAIN augmentation."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        from veles_tpu.memory import Vector
+        self.minibatch_targets = Vector()
+        super(ImageLoaderMSE, self).__init__(workflow, **kwargs)
+
+    def load_target(self, key):
+        """Decode the clean target for ``key``; default = the input
+        decode (override to read from a separate target set)."""
+        return ImageLoader.load_key(self, key)
+
+    def get_target_key(self, key, label):
+        """Input key/label → target key (ref ``target_label_map``,
+        ``image_mse.py:79``); default: identity."""
+        return key
+
+    def create_minibatch_data(self):
+        super(ImageLoaderMSE, self).create_minibatch_data()
+        self.minibatch_targets.reset(numpy.zeros(
+            (self.max_minibatch_size,) + self.sample_shape,
+            dtype=numpy.float32))
+
+    def fill_minibatch(self):
+        # joint fill (no super() delegate): each sample's input and
+        # target must replay the SAME random crop/mirror draws
+        self.minibatch_data.map_write()
+        self.minibatch_targets.map_write()
+        self.minibatch_indices.map_read()
+        train = self.minibatch_class == TRAIN
+        for i, idx in enumerate(
+                self.minibatch_indices.mem[:self.minibatch_size]):
+            if idx < 0:
+                self.minibatch_data.mem[i] = 0
+                self.minibatch_targets.mem[i] = 0
+                self.raw_minibatch_labels[i] = None
+                continue
+            key_idx, rotation = self._key_and_rotation(idx)
+            key = self._flat_keys[key_idx]
+            label = self._flat_labels[key_idx]
+            decisions = {}
+            self.minibatch_data.mem[i] = self.preprocess(
+                self.load_key(key), train, rotation=rotation,
+                decisions=decisions)
+            self.minibatch_targets.mem[i] = self.preprocess(
+                self.load_target(self.get_target_key(key, label)),
+                train, rotation=rotation, decisions=decisions)
+            self.raw_minibatch_labels[i] = label
+
+
 class FullBatchImageLoader(FullBatchLoader):
     """Whole image set decoded once into the HBM-resident dataset
     (ref ``fullbatch_image.py:56``): wraps any :class:`ImageLoader`
@@ -261,9 +423,17 @@ class FullBatchImageLoader(FullBatchLoader):
         data = numpy.zeros((total,) + sub.sample_shape,
                            dtype=numpy.float32)
         labels = []
-        for i, key in enumerate(sub._flat_keys):
-            data[i] = sub.preprocess(sub.load_key(key), train=False)
-            labels.append(sub._flat_labels[i])
+        # one resident row per INFLATED sample: the sub-loader's
+        # class_lengths already count len(keys) x samples_inflation,
+        # and each (key, rotation) pair gets its own decoded row +
+        # label (a fill keyed on _flat_keys alone left the inflated
+        # rows zero and the labels truncated — code-review r5)
+        for i in range(total):
+            key_idx, rotation = sub._key_and_rotation(i)
+            data[i] = sub.preprocess(sub.load_key(
+                sub._flat_keys[key_idx]), train=False,
+                rotation=rotation)
+            labels.append(sub._flat_labels[key_idx])
         self.original_data.mem = data
         if any(label is not None for label in labels):
             self.original_labels = labels

@@ -135,6 +135,7 @@ class FusedTrainer(AcceleratedUnit):
             input_norm=getattr(self.loader, "input_norm", None))
         params = self._restore_solver_state(params)
         self._train_divisor_ = max(self.grad_accum, 1)
+        mesh = rules = None
         if self.mesh_axes:
             from veles_tpu.parallel import data_parallel, make_mesh
             from veles_tpu.parallel.dp import (fsdp_rules, shard_params,
@@ -181,14 +182,6 @@ class FusedTrainer(AcceleratedUnit):
             self._step_ = jax.jit(step_fn, donate_argnums=(0,))
             self._eval_ = jax.jit(eval_fn)
         if self.epoch_mode:
-            if self.mesh_axes:
-                raise NotImplementedError(
-                    "epoch_mode currently runs single-device; the mesh "
-                    "compositions live in parallel.dp."
-                    "data_parallel_epoch[_local]")
-            if self.loss != "softmax":
-                raise NotImplementedError(
-                    "epoch_mode currently supports the softmax loss")
             from veles_tpu.loader.fullbatch import FullBatchLoader
             from veles_tpu.znicz.fused_graph import epoch_runner
             loader = self.loader
@@ -211,17 +204,41 @@ class FusedTrainer(AcceleratedUnit):
             if batch % self._train_divisor_:
                 raise ValueError(
                     "epoch_mode minibatch %d must divide by "
-                    "grad_accum (%d)" % (batch, self._train_divisor_))
+                    "grad_accum%s (%d)" % (
+                        batch, " x data-axis" if mesh else "",
+                        self._train_divisor_))
             start = int(loader.class_end_offsets[TRAIN - 1])
-            self._epoch_data_ = \
-                loader.original_data.devmem[start:start + n_train]
-            self._epoch_labels_ = jax.device_put(
-                numpy.ascontiguousarray(
+            data = loader.original_data.devmem[start:start + n_train]
+            if self.loss == "mse":
+                # regression epochs train toward the resident target
+                # rows (the AE family): same gather, float targets
+                labels = loader.original_targets.devmem[
+                    start:start + n_train]
+            else:
+                labels = jax.device_put(numpy.ascontiguousarray(
                     loader._mapped_labels[start:start + n_train]))
             self._epoch_steps_ = n_train // batch
-            self._epoch_fn_ = jax.jit(epoch_runner(step_fn, n_train,
-                                                   batch),
-                                      donate_argnums=(0,))
+            if mesh is not None:
+                # "one workflow, any mode": the mesh epoch is the
+                # global-permutation DP composition — sampling
+                # IDENTICAL to the single-device epoch program, GSPMD
+                # inserts the gather collectives + gradient
+                # all-reduce (parallel.dp.data_parallel_epoch; the
+                # r4 dryrun leg proves the composition compiles)
+                from jax.sharding import NamedSharding, PartitionSpec
+                from veles_tpu.parallel.dp import data_parallel_epoch
+                self._epoch_fn_ = data_parallel_epoch(
+                    step_fn, mesh, params, n_train, batch,
+                    param_rules=rules)
+                shard = NamedSharding(mesh, PartitionSpec("data"))
+                data = jax.device_put(data, shard)
+                labels = jax.device_put(labels, shard)
+            else:
+                self._epoch_fn_ = jax.jit(
+                    epoch_runner(step_fn, n_train, batch),
+                    donate_argnums=(0,))
+            self._epoch_data_ = data
+            self._epoch_labels_ = labels
 
     def _make_rules(self, mesh, fsdp_rules, tp_rules):
         """Param sharding rules for the configured mesh: TP (column-
@@ -376,11 +393,17 @@ class FusedTrainer(AcceleratedUnit):
         if self._epoch_ptr_ < self._epoch_steps_:
             i = self._epoch_ptr_
             self._epoch_ptr_ += 1
-            self.n_err = float(self._epoch_queue_["n_err"][i])
+            err = float(self._epoch_queue_["n_err"][i])
             self.loss_value = float(self._epoch_queue_["loss"][i])
         else:                          # dropped short tail
-            self.n_err = 0.0
+            err = 0.0
             self.loss_value = 0.0
+        # mse's "n_err" metric is the minibatch RMSE (fused_graph
+        # step metrics are uniform across losses)
+        if self.loss == "mse":
+            self.mse = err
+        else:
+            self.n_err = err
         if bool(self.loader.last_minibatch):
             # epoch boundary: the next train call starts a new epoch
             self._epoch_queue_ = None
