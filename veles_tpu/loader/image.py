@@ -191,19 +191,23 @@ class ImageLoader(Loader):
 
         Image = _pil()
         degrees = math.degrees(angle)
-        pil = Image.fromarray(
-            image.squeeze(-1).astype(numpy.uint8)
-            if image.shape[-1] == 1 else image.astype(numpy.uint8))
-        rot = numpy.asarray(pil.rotate(degrees, Image.BILINEAR))
-        if rot.ndim == 2:
-            rot = rot[:, :, None]
+        # per-channel float-mode rotation: load_key's contract allows
+        # float images (class docstring), and a uint8 round-trip would
+        # truncate them (a [0,1] image came back all zeros —
+        # code-review r5); mode "F" preserves any numeric range
+        img32 = numpy.asarray(image, numpy.float32)
+        rot = numpy.stack([
+            numpy.asarray(Image.fromarray(img32[:, :, c], "F")
+                          .rotate(degrees, Image.BILINEAR))
+            for c in range(img32.shape[-1])], axis=-1)
         # an all-opaque mask rotated the same way marks the exposed
         # (out-of-frame) pixels exactly, including the anti-aliased rim
-        mask = numpy.asarray(Image.new("L", pil.size, 255)
+        h, w = img32.shape[:2]
+        mask = numpy.asarray(Image.new("L", (w, h), 255)
                              .rotate(degrees, Image.BILINEAR))
         mask = (mask.astype(numpy.float32) / 255.0)[:, :, None]
         bg = self._background(rot.shape)
-        return rot.astype(numpy.float32) * mask + bg * (1.0 - mask)
+        return rot * mask + bg * (1.0 - mask)
 
     def preprocess(self, image, train, rotation=0.0, decisions=None):
         """scale → resize to ``size`` → rotate (background-blended) →

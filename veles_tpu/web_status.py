@@ -17,6 +17,15 @@ import time
 from veles_tpu.logger import Logger
 
 
+def _ui_asset(name):
+    """Read a packaged single-file UI page (veles_tpu/web/)."""
+    import os
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "web", name)
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
 class WebStatus(Logger):
     """Tornado app: POST /update (JSON), GET /status[.json], GET /events."""
 
@@ -49,10 +58,20 @@ class WebStatus(Logger):
                 self.set_header("Content-Type", "application/json")
                 self.write(json.dumps(list(status.events), default=repr))
 
+        class UIHandler(tornado.web.RequestHandler):
+            """The browser UI (ref ships a JS site under ``web/``): a
+            single self-contained page polling status.json/events."""
+
+            def get(self):
+                self.set_header("Content-Type",
+                                "text/html; charset=utf-8")
+                self.write(_ui_asset("status.html"))
+
         self._app = tornado.web.Application([
             (r"/update", UpdateHandler),
             (r"/status(?:\.json)?", StatusHandler),
             (r"/events", EventsHandler),
+            (r"/(?:ui)?", UIHandler),
         ])
         self._host = host
         self._port = port
