@@ -429,7 +429,7 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
     return params, step_fn, eval_fn, apply_fn
 
 
-def epoch_runner(step_fn, n_samples, batch):
+def epoch_runner(step_fn, n_samples, batch, shuffle=True):
     """Whole epoch in ONE XLA program: ``lax.scan`` over permuted
     minibatches gathered from the DEVICE-RESIDENT dataset inside the
     program.
@@ -456,7 +456,11 @@ def epoch_runner(step_fn, n_samples, batch):
         raise ValueError("dataset smaller than one minibatch")
 
     def epoch_fn(params, data, labels, key):
-        perm = jax.random.permutation(key, n_samples)
+        # shuffle=False: sequential (coalesced) minibatches — not for
+        # training (no sampling), but the A/B that isolates the cost
+        # of PERMUTED gather locality from the scan/step itself
+        perm = jax.random.permutation(key, n_samples) if shuffle \
+            else jnp.arange(n_samples)
         idx = perm[: steps * batch].reshape(steps, batch)
 
         def body(p, batch_idx):
