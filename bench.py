@@ -1364,7 +1364,8 @@ _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
 #: cannot finish on CPU inside their caps — end on the flagship MNIST
 #: number so the recorded last line is a real measurement.
 _CPU_ORDER = ("mnist_e2e", "mnist_epoch", "mnist_wf",
-              "mnist_wf_epoch", "ae_wf_epoch", "ae", "kohonen", "lstm",
+              "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager", "ae",
+              "kohonen", "lstm",
               "native_infer", "mnist_u8", "mnist_bf16", "mnist")
 
 

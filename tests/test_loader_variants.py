@@ -598,3 +598,32 @@ def test_rotation_preserves_float_images(tmp_path):
     out = loader.preprocess(loader.load_key("a"), train=False,
                             rotation=math.pi / 2)
     assert abs(float(out.mean()) - 0.5) < 1e-3
+
+
+def test_image_loader_add_sobel_channel(tmp_path):
+    """add_sobel appends a per-pixel Sobel gradient-magnitude channel
+    (ref image.py:484 intent): a vertical step edge yields zero
+    response in flat regions and a strong response at the edge."""
+    from PIL import Image
+    from veles_tpu.loader.image import AutoLabelFileImageLoader
+
+    d = tmp_path / "train" / "edge"
+    d.mkdir(parents=True)
+    arr = numpy.zeros((8, 8, 3), numpy.uint8)
+    arr[:, 4:] = 200          # vertical step edge at x=4
+    Image.fromarray(arr).save(d / "img.png")
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = AutoLabelFileImageLoader(
+        wf, train_paths=[str(tmp_path / "train")], size=(8, 8),
+        add_sobel=True, minibatch_size=1)
+    loader.initialize(device=wf.device)
+    assert loader.sample_shape == (8, 8, 4)
+    loader.run()
+    img = loader.minibatch_data.mem[0]
+    sob = img[:, :, 3]
+    assert float(sob[:, 0:2].max()) == 0.0      # flat left region
+    assert float(sob[:, 6:8].max()) == 0.0      # flat right region
+    assert float(sob[:, 3:5].min()) > 100.0     # edge response
+    # original channels untouched
+    assert numpy.allclose(img[:, :, :3], arr.astype(numpy.float32))

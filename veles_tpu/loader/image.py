@@ -112,6 +112,11 @@ class ImageLoader(Loader):
         #: (ref ``:344``); image wins when both are set, default zeros
         self.background_image = kwargs.get("background_image")
         self.background_color = kwargs.get("background_color")
+        #: append a Sobel gradient-magnitude channel (ref
+        #: ``image.py:484`` — intent re-implemented: the reference's
+        #: ``linalg.norm(sobel_xy)`` collapses to a SCALAR; here the
+        #: channel is the per-pixel magnitude)
+        self.add_sobel = bool(kwargs.get("add_sobel", False))
         self.keys = [[], [], []]
         self.labels = [[], [], []]
         super(ImageLoader, self).__init__(workflow, **kwargs)
@@ -137,6 +142,12 @@ class ImageLoader(Loader):
     # -- geometry -----------------------------------------------------------
     @property
     def channels(self):
+        base = 1 if self.color_space == "GRAY" else 3
+        return base + (1 if self.add_sobel else 0)
+
+    @property
+    def _decode_channels(self):
+        """Channels as decoded, before the appended Sobel plane."""
         return 1 if self.color_space == "GRAY" else 3
 
     @property
@@ -225,7 +236,8 @@ class ImageLoader(Loader):
             size = (max(1, int(round(size[0] * self.scale))),
                     max(1, int(round(size[1] * self.scale))))
         if image.shape[1::-1] != size:
-            pil = Image.fromarray(image.squeeze(-1) if self.channels == 1
+            pil = Image.fromarray(image.squeeze(-1)
+                                  if self._decode_channels == 1
                                   else image)
             image = numpy.asarray(pil.resize(size, Image.BILINEAR))
             if image.ndim == 2:
@@ -257,7 +269,26 @@ class ImageLoader(Loader):
                 decisions["mirror"] = flip
             if flip:
                 image = image[:, ::-1]
-        return numpy.ascontiguousarray(image, dtype=numpy.float32)
+        image = numpy.ascontiguousarray(image, dtype=numpy.float32)
+        if self.add_sobel:
+            image = numpy.concatenate(
+                [image, self._sobel_channel(image)], axis=-1)
+        return image
+
+    @staticmethod
+    def _sobel_channel(image):
+        """Per-pixel Sobel gradient magnitude of the luma, (H, W, 1)
+        float32 (ref ``image.py:484`` add_sobel_channel — intent, not
+        the scalar-norm bug).  Pure numpy: same-padded 3x3 separable
+        convolution."""
+        gray = image.mean(axis=-1)
+        p = numpy.pad(gray, 1, mode="edge")
+        # Gx = [1,0,-1] ⊗ [1,2,1]ᵀ ; Gy = Gxᵀ
+        smooth_y = p[:-2] + 2.0 * p[1:-1] + p[2:]      # vertical [1,2,1]
+        gx = smooth_y[:, :-2] - smooth_y[:, 2:]
+        smooth_x = p[:, :-2] + 2.0 * p[:, 1:-1] + p[:, 2:]
+        gy = smooth_x[:-2] - smooth_x[2:]
+        return numpy.hypot(gx, gy).astype(numpy.float32)[:, :, None]
 
     # -- ILoader ------------------------------------------------------------
     def load_data(self):
