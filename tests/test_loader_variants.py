@@ -627,3 +627,62 @@ def test_image_loader_add_sobel_channel(tmp_path):
     assert float(sob[:, 3:5].min()) > 100.0     # edge response
     # original channels untouched
     assert numpy.allclose(img[:, :, :3], arr.astype(numpy.float32))
+
+
+def test_image_loader_crop_number_inflation(tmp_path):
+    """crop_number (ref image.py ctor): further inflation — each
+    (key, rotation) yields crop_number random-crop samples."""
+    import math
+    from PIL import Image
+    from veles_tpu.loader.image import AutoLabelFileImageLoader
+    from veles_tpu.loader.base import LoaderError
+
+    d = tmp_path / "train" / "c"
+    d.mkdir(parents=True)
+    rng = numpy.random.default_rng(5)
+    Image.fromarray(rng.integers(0, 255, (16, 16, 3),
+                                 numpy.uint8)).save(d / "img.png")
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = AutoLabelFileImageLoader(
+        wf, train_paths=[str(tmp_path / "train")], size=(16, 16),
+        crop=(8, 8), crop_number=3, rotations=(0.0, math.pi / 2),
+        minibatch_size=6)
+    loader.initialize(device=wf.device)
+    assert loader.samples_inflation == 6          # 2 rot x 3 crops
+    assert loader.class_lengths[TRAIN] == 6       # 1 key x 6
+    loader.run()
+    assert loader.minibatch_data.shape == (6, 8, 8, 3)
+    # crop_number without crop is rejected
+    with pytest.raises(LoaderError):
+        AutoLabelFileImageLoader(
+            wf, train_paths=[str(tmp_path / "train")], size=(16, 16),
+            crop_number=2, minibatch_size=2)
+
+
+def test_fullbatch_crop_number_rows_are_distinct(tmp_path):
+    """crop_number in the FULL-BATCH path must decode DISTINCT
+    (anchored) crops per inflated sample, never crop_number copies of
+    the center crop (code-review r5)."""
+    from PIL import Image
+    from veles_tpu.loader.image import (AutoLabelFileImageLoader,
+                                        FullBatchImageLoader)
+
+    d = tmp_path / "train" / "c"
+    d.mkdir(parents=True)
+    rng = numpy.random.default_rng(9)
+    Image.fromarray(rng.integers(0, 255, (16, 16, 3),
+                                 numpy.uint8)).save(d / "img.png")
+    wf = DummyWorkflow()
+    wf.device = CPUDevice()
+    loader = FullBatchImageLoader(
+        wf, train_paths=[str(tmp_path / "train")], size=(16, 16),
+        crop=(8, 8), crop_number=5, minibatch_size=5,
+        image_loader_class=AutoLabelFileImageLoader)
+    loader.initialize(device=wf.device)
+    data = numpy.asarray(loader.original_data.mem)
+    assert data.shape == (5, 8, 8, 3)
+    # center + 4 corners of a random image: all five pairwise distinct
+    for i in range(5):
+        for j in range(i + 1, 5):
+            assert not numpy.array_equal(data[i], data[j]), (i, j)

@@ -117,6 +117,14 @@ class ImageLoader(Loader):
         #: ``linalg.norm(sobel_xy)`` collapses to a SCALAR; here the
         #: channel is the per-pixel magnitude)
         self.add_sobel = bool(kwargs.get("add_sobel", False))
+        #: random crops drawn per (key, rotation) sample — a further
+        #: inflation factor (ref ``image.py`` crop_number); needs
+        #: ``crop`` to mean anything beyond 1
+        self.crop_number = int(kwargs.get("crop_number", 1))
+        if self.crop_number < 1:
+            raise LoaderError("crop_number must be >= 1")
+        if self.crop_number > 1 and not kwargs.get("crop"):
+            raise LoaderError("crop_number > 1 requires crop=")
         self.keys = [[], [], []]
         self.labels = [[], [], []]
         super(ImageLoader, self).__init__(workflow, **kwargs)
@@ -165,10 +173,11 @@ class ImageLoader(Loader):
 
     @property
     def samples_inflation(self):
-        """Samples per source key: one per configured rotation (ref
-        ``image.py:311``; the reference also doubles for mirror=True —
-        here mirror stays a random TRAIN flip, not an inflation)."""
-        return len(self.rotations)
+        """Samples per source key: one per (rotation, crop draw) pair
+        (ref ``image.py:311``; the reference also doubles for
+        mirror=True — here mirror stays a random TRAIN flip, not an
+        inflation)."""
+        return len(self.rotations) * self.crop_number
 
     def _background(self, shape):
         """HWC float32 fill for rotation-exposed corners."""
@@ -220,14 +229,21 @@ class ImageLoader(Loader):
         bg = self._background(rot.shape)
         return rot * mask + bg * (1.0 - mask)
 
-    def preprocess(self, image, train, rotation=0.0, decisions=None):
+    def preprocess(self, image, train, rotation=0.0, decisions=None,
+                   crop_index=0):
         """scale → resize to ``size`` → rotate (background-blended) →
         crop → mirror → float32 HWC.
 
         ``decisions``: a mutable dict capturing this call's random
         augmentation draws (crop offset, mirror flag) so a SECOND
         tensor — the MSE target — can replay them and stay
-        geometrically aligned with its input."""
+        geometrically aligned with its input.
+
+        ``crop_index``: the inflated sample's crop sub-index; under
+        ``crop_number > 1`` non-train samples take the DETERMINISTIC
+        anchor for that index (center/corners/golden-walk — the
+        classic multi-crop eval) instead of crop_number identical
+        center crops."""
         Image = _pil()
         if image.ndim == 2:
             image = image[:, :, None]
@@ -255,6 +271,10 @@ class ImageLoader(Loader):
             elif train:
                 y = int(self.prng.randint(0, h - ch + 1))
                 x = int(self.prng.randint(0, w - cw + 1))
+            elif self.crop_number > 1:
+                ay, ax = self._crop_anchor(crop_index)
+                y = int(round(ay * (h - ch)))
+                x = int(round(ax * (w - cw)))
             else:
                 y, x = (h - ch) // 2, (w - cw) // 2
             if decisions is not None:
@@ -311,11 +331,32 @@ class ImageLoader(Loader):
             (self.max_minibatch_size,) + self.sample_shape,
             dtype=numpy.float32))
 
+    def _decode_index(self, idx):
+        """Global sample index → (flat key index, rotation angle,
+        crop sub-index) — the reference's divmod decode
+        (``image.py:766``), crop index fastest-varying."""
+        key_idx, sub = divmod(int(idx), self.samples_inflation)
+        rot_idx, crop_i = divmod(sub, self.crop_number)
+        return key_idx, self.rotations[rot_idx], crop_i
+
     def _key_and_rotation(self, idx):
-        """Global sample index → (flat key index, rotation angle) —
-        the reference's divmod decode (``image.py:766``)."""
-        key_idx, rot_idx = divmod(int(idx), self.samples_inflation)
-        return key_idx, self.rotations[rot_idx]
+        key_idx, rotation, _crop_i = self._decode_index(idx)
+        return key_idx, rotation
+
+    #: deterministic multi-crop anchors (fractions of the slack): the
+    #: classic center + 4-corner eval crops, then a golden-ratio walk
+    #: for larger crop_number — DIVERSE and reproducible, so eval (and
+    #: the full-batch resident decode) never stores crop_number copies
+    #: of one center crop (code-review r5)
+    _CROP_ANCHORS = ((0.5, 0.5), (0.0, 0.0), (0.0, 1.0), (1.0, 0.0),
+                     (1.0, 1.0))
+
+    def _crop_anchor(self, crop_i):
+        if crop_i < len(self._CROP_ANCHORS):
+            return self._CROP_ANCHORS[crop_i]
+        t = (crop_i * 0.6180339887498949) % 1.0
+        u = (crop_i * 0.7548776662466927) % 1.0
+        return t, u
 
     def fill_minibatch(self):
         self.minibatch_data.map_write()
@@ -327,10 +368,10 @@ class ImageLoader(Loader):
                 self.minibatch_data.mem[i] = 0
                 self.raw_minibatch_labels[i] = None
                 continue
-            key_idx, rotation = self._key_and_rotation(idx)
+            key_idx, rotation, crop_i = self._decode_index(idx)
             image = self.load_key(self._flat_keys[key_idx])
             self.minibatch_data.mem[i] = self.preprocess(
-                image, train, rotation=rotation)
+                image, train, rotation=rotation, crop_index=crop_i)
             self.raw_minibatch_labels[i] = self._flat_labels[key_idx]
 
 
@@ -419,16 +460,17 @@ class ImageLoaderMSE(ImageLoader):
                 self.minibatch_targets.mem[i] = 0
                 self.raw_minibatch_labels[i] = None
                 continue
-            key_idx, rotation = self._key_and_rotation(idx)
+            key_idx, rotation, crop_i = self._decode_index(idx)
             key = self._flat_keys[key_idx]
             label = self._flat_labels[key_idx]
             decisions = {}
             self.minibatch_data.mem[i] = self.preprocess(
                 self.load_key(key), train, rotation=rotation,
-                decisions=decisions)
+                decisions=decisions, crop_index=crop_i)
             self.minibatch_targets.mem[i] = self.preprocess(
                 self.load_target(self.get_target_key(key, label)),
-                train, rotation=rotation, decisions=decisions)
+                train, rotation=rotation, decisions=decisions,
+                crop_index=crop_i)
             self.raw_minibatch_labels[i] = label
 
 
@@ -464,10 +506,10 @@ class FullBatchImageLoader(FullBatchLoader):
         # label (a fill keyed on _flat_keys alone left the inflated
         # rows zero and the labels truncated — code-review r5)
         for i in range(total):
-            key_idx, rotation = sub._key_and_rotation(i)
+            key_idx, rotation, crop_i = sub._decode_index(i)
             data[i] = sub.preprocess(sub.load_key(
                 sub._flat_keys[key_idx]), train=False,
-                rotation=rotation)
+                rotation=rotation, crop_index=crop_i)
             labels.append(sub._flat_labels[key_idx])
         self.original_data.mem = data
         if any(label is not None for label in labels):
