@@ -8,7 +8,7 @@ from veles_tpu.loader.formats import (  # noqa: F401
     HDF5Loader, PicklesLoader)
 from veles_tpu.loader.image import (  # noqa: F401
     AutoLabelFileImageLoader, FileFilter, FileImageLoader,
-    FullBatchImageLoader, ImageLoader)
+    FullBatchImageLoader, ImageLoader, ImageLoaderMSE)
 from veles_tpu.loader.saver import (  # noqa: F401
     MinibatchesLoader, MinibatchesSaver)
 from veles_tpu.loader.streaming import (  # noqa: F401
