@@ -45,9 +45,12 @@ def _bhsd(x, b, h, d, block):
 
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                  acc_ref, m_ref, l_ref, *, n_k, scale, causal,
-                 block_q, block_k, seq_k):
+                 block_q, block_k, seq_k, q_off, k_off):
     """Grid: (batch*heads, q_blocks, k_blocks); K is the arbitrary
-    (sequential) dimension; running (acc, m, l) live in VMEM scratch."""
+    (sequential) dimension; running (acc, m, l) live in VMEM scratch.
+    ``q_off``/``k_off``: global positions of element 0 — causal masks
+    stay correct when q/k are shards of a longer (ring-distributed)
+    sequence; padding masks stay LOCAL."""
     qi = pl.program_id(1)
     kk = pl.program_id(2)
 
@@ -57,10 +60,10 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # causal: skip blocks strictly above the diagonal
+    # causal: skip blocks strictly above the (global) diagonal
     run = True
     if causal:
-        run = qi * block_q + block_q - 1 >= kk * block_k
+        run = q_off + qi * block_q + block_q - 1 >= k_off + kk * block_k
 
     @pl.when(run)
     def _step():
@@ -75,7 +78,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 0)
-            mask = mask & (k_pos <= q_pos)
+            mask = mask & (k_off + k_pos <= q_off + q_pos)
         scores = jnp.where(mask, scores, NEG_INF)
 
         m_prev = m_ref[...]                            # (bq, 1)
@@ -97,11 +100,32 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = (m_ref[...] + jnp.log(l))[:, 0]
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "causal", "block_q", "block_k", "interpret"))
+def _attn_kernel_dyn(offs_ref, *args, kernel, **kw):
+    """Scalar-prefetch wrapper: ring shards pass TRACED global offsets
+    (device-index-dependent), which cannot be closure constants — they
+    ride in as a prefetched (2,) int32 and the causal block-skip
+    becomes a runtime predicate."""
+    kernel(*args, q_off=offs_ref[0], k_off=offs_ref[1], **kw)
+
+
+def _static_offsets(q_offset, k_offset):
+    return isinstance(q_offset, int) and isinstance(k_offset, int)
+
+
+def _dyn_spec(spec):
+    """Same block routing, one extra (ignored) scalar-prefetch arg —
+    keeps the static and dynamic paths structurally identical."""
+    return pl.BlockSpec(
+        spec.block_shape,
+        lambda a, b_, c, offs, _m=spec.index_map: _m(a, b_, c))
+
+
 def _flash_fwd(q, k, v, causal=False, block_q=128, block_k=128,
-               interpret=False):
-    """(o, lse); inputs (b, s, h, d) — kernel works per (b·h) slice."""
+               interpret=False, q_offset=0, k_offset=0):
+    """(o, lse); inputs (b, s, h, d) — kernel works per (b·h) slice.
+    ``q_offset``/``k_offset``: global causal positions of element 0
+    (ring/sequence shards); python ints compile to the static
+    block-skip, traced scalars take the scalar-prefetch path."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale = 1.0 / (d ** 0.5)
@@ -115,40 +139,57 @@ def _flash_fwd(q, k, v, causal=False, block_q=128, block_k=128,
     n_q, n_k = sq_p // bq, sk_p // bk
     grid = (b * h, n_q, n_k)
 
-    out, lse = pl.pallas_call(
-        functools.partial(_attn_kernel, n_k=n_k, scale=scale,
-                          causal=causal, block_q=bq, block_k=bk,
-                          seq_k=sk),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d_p), lambda bh, qi, kk: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d_p), lambda bh, qi, kk: (bh, kk, 0)),
-            pl.BlockSpec((1, bk, d_p), lambda bh, qi, kk: (bh, kk, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bq, d_p), lambda bh, qi, kk: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, qi, kk: (bh, qi)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq_p), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, d_p), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(q3, k3, v3)
+    in_specs = [
+        pl.BlockSpec((1, bq, d_p), lambda bh, qi, kk: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, d_p), lambda bh, qi, kk: (bh, kk, 0)),
+        pl.BlockSpec((1, bk, d_p), lambda bh, qi, kk: (bh, kk, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, bq, d_p), lambda bh, qi, kk: (bh, qi, 0)),
+        pl.BlockSpec((1, bq), lambda bh, qi, kk: (bh, qi)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype),
+        jax.ShapeDtypeStruct((b * h, sq_p), jnp.float32),
+    ]
+    scratch = [
+        pltpu.VMEM((bq, d_p), jnp.float32),
+        pltpu.VMEM((bq, 1), jnp.float32),
+        pltpu.VMEM((bq, 1), jnp.float32),
+    ]
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    kw = dict(n_k=n_k, scale=scale, causal=causal, block_q=bq,
+              block_k=bk, seq_k=sk)
+    if _static_offsets(q_offset, k_offset):
+        out, lse = pl.pallas_call(
+            functools.partial(_attn_kernel, q_off=q_offset,
+                              k_off=k_offset, **kw),
+            grid=grid, in_specs=in_specs, out_specs=out_specs,
+            out_shape=out_shape, scratch_shapes=scratch,
+            compiler_params=params, interpret=interpret,
+        )(q3, k3, v3)
+    else:
+        offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                          jnp.asarray(k_offset, jnp.int32)])
+        out, lse = pl.pallas_call(
+            functools.partial(_attn_kernel_dyn, kernel=_attn_kernel,
+                              **kw),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=grid,
+                in_specs=[_dyn_spec(s) for s in in_specs],
+                out_specs=[_dyn_spec(s) for s in out_specs],
+                scratch_shapes=scratch),
+            out_shape=out_shape, compiler_params=params,
+            interpret=interpret,
+        )(offs, q3, k3, v3)
     out = out[:, :sq, :d].reshape(b, h, sq, d)
     return jnp.moveaxis(out, 1, 2), lse[:, :sq].reshape(b, h, sq)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, acc_ref, *, n_k, scale, causal, block_q,
-                   block_k, seq_k):
+                   block_k, seq_k, q_off, k_off):
     """dq: grid (b·h, q_blocks, k_blocks); K sequential; the running
     dq accumulator lives in VMEM scratch (the forward's layout)."""
     qi = pl.program_id(1)
@@ -160,9 +201,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:
-        # skip K blocks strictly above the diagonal — the 2x FLOP
-        # saving the XLA scan fallback cannot express
-        run = qi * block_q + block_q - 1 >= kk * block_k
+        # skip K blocks strictly above the (global) diagonal — the 2x
+        # FLOP saving the XLA scan fallback cannot express
+        run = q_off + qi * block_q + block_q - 1 >= k_off + kk * block_k
 
     @pl.when(run)
     def _step():
@@ -177,7 +218,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 0)
-            mask = mask & (k_pos <= q_pos)
+            mask = mask & (k_off + k_pos <= q_off + q_pos)
         p = jnp.where(mask, jnp.exp(scores - lse_ref[0][:, None]), 0.0)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -194,7 +235,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, n_q, scale,
-                    causal, block_q, block_k, seq_k):
+                    causal, block_q, block_k, seq_k, q_off, k_off):
     """dk/dv: grid (b·h, k_blocks, q_blocks); Q sequential; running
     (dk, dv) accumulators in VMEM scratch."""
     kk = pl.program_id(1)
@@ -207,7 +248,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:
-        run = qj * block_q + block_q - 1 >= kk * block_k
+        run = q_off + qj * block_q + block_q - 1 >= k_off + kk * block_k
 
     @pl.when(run)
     def _step():
@@ -223,7 +264,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             q_pos = qj * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 0)
-            mask = mask & (k_pos <= q_pos)
+            mask = mask & (k_off + k_pos <= q_off + q_pos)
         p = jnp.where(mask, jnp.exp(scores - lse_ref[0][:, None]), 0.0)
         p_mm = p.astype(q.dtype)
         dv_acc[...] += jax.lax.dot_general(
@@ -243,10 +284,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "causal", "block_q", "block_k", "interpret"))
 def _flash_bwd(q, k, v, o, lse, do, causal=False, block_q=128,
-               block_k=128, interpret=False):
+               block_k=128, interpret=False, q_offset=0, k_offset=0,
+               delta=None):
     """Pallas flash backward: (dq, dk, dv) from saved (q, k, v, o,
     lse).  Two kernels — dq streams K blocks per Q block; dk/dv
     streams Q blocks per K block — each shaped exactly like the
@@ -267,9 +307,11 @@ def _flash_bwd(q, k, v, o, lse, do, causal=False, block_q=128,
         return jnp.pad(x, ((0, 0), (0, s_pad - x.shape[1])))
 
     # delta = rowsum(do ⊙ o): one cheap bandwidth-bound pass outside
-    # the kernels (the standard flash-backward preprocessing)
-    delta = jnp.einsum("bqhd,bqhd->bhq", do.astype(jnp.float32),
-                       o.astype(jnp.float32))
+    # the kernels (the standard flash-backward preprocessing); ring
+    # callers precompute it ONCE for all n hops
+    if delta is None:
+        delta = jnp.einsum("bqhd,bqhd->bhq", do.astype(jnp.float32),
+                           o.astype(jnp.float32))
 
     q3 = _bhsd(q, b, h, d, bq)
     k3, v3 = _bhsd(k, b, h, d, bk), _bhsd(v, b, h, d, bk)
@@ -279,55 +321,85 @@ def _flash_bwd(q, k, v, o, lse, do, causal=False, block_q=128,
     sk_p = k3.shape[1]
     n_q, n_k = sq_p // bq, sk_p // bk
 
-    dq3 = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, n_k=n_k, scale=scale,
-                          causal=causal, block_q=bq, block_k=bk,
-                          seq_k=sk),
-        grid=(b * h, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, bq, d_p), lambda bh, qi, kk: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d_p), lambda bh, qi, kk: (bh, kk, 0)),
-            pl.BlockSpec((1, bk, d_p), lambda bh, qi, kk: (bh, kk, 0)),
-            pl.BlockSpec((1, bq, d_p), lambda bh, qi, kk: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, qi, kk: (bh, qi)),
-            pl.BlockSpec((1, bq), lambda bh, qi, kk: (bh, qi)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d_p),
-                               lambda bh, qi, kk: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d_p), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(q3, k3, v3, do3, lse2, delta2)
-
-    dk3, dv3 = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, n_q=n_q, scale=scale,
-                          causal=causal, block_q=bq, block_k=bk,
-                          seq_k=sk),
-        grid=(b * h, n_k, n_q),
-        in_specs=[
-            pl.BlockSpec((1, bq, d_p), lambda bh, kk, qj: (bh, qj, 0)),
-            pl.BlockSpec((1, bk, d_p), lambda bh, kk, qj: (bh, kk, 0)),
-            pl.BlockSpec((1, bk, d_p), lambda bh, kk, qj: (bh, kk, 0)),
-            pl.BlockSpec((1, bq, d_p), lambda bh, kk, qj: (bh, qj, 0)),
-            pl.BlockSpec((1, bq), lambda bh, kk, qj: (bh, qj)),
-            pl.BlockSpec((1, bq), lambda bh, kk, qj: (bh, qj)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bk, d_p), lambda bh, kk, qj: (bh, kk, 0)),
-            pl.BlockSpec((1, bk, d_p), lambda bh, kk, qj: (bh, kk, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk_p, d_p), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk_p, d_p), v.dtype),
-        ],
-        scratch_shapes=[pltpu.VMEM((bk, d_p), jnp.float32),
-                        pltpu.VMEM((bk, d_p), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(q3, k3, v3, do3, lse2, delta2)
+    dq_specs = [
+        pl.BlockSpec((1, bq, d_p), lambda bh, qi, kk: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, d_p), lambda bh, qi, kk: (bh, kk, 0)),
+        pl.BlockSpec((1, bk, d_p), lambda bh, qi, kk: (bh, kk, 0)),
+        pl.BlockSpec((1, bq, d_p), lambda bh, qi, kk: (bh, qi, 0)),
+        pl.BlockSpec((1, bq), lambda bh, qi, kk: (bh, qi)),
+        pl.BlockSpec((1, bq), lambda bh, qi, kk: (bh, qi)),
+    ]
+    dq_out_spec = pl.BlockSpec((1, bq, d_p),
+                               lambda bh, qi, kk: (bh, qi, 0))
+    dq_out_shape = jax.ShapeDtypeStruct((b * h, sq_p, d_p), q.dtype)
+    dq_scratch = [pltpu.VMEM((bq, d_p), jnp.float32)]
+    dkv_specs = [
+        pl.BlockSpec((1, bq, d_p), lambda bh, kk, qj: (bh, qj, 0)),
+        pl.BlockSpec((1, bk, d_p), lambda bh, kk, qj: (bh, kk, 0)),
+        pl.BlockSpec((1, bk, d_p), lambda bh, kk, qj: (bh, kk, 0)),
+        pl.BlockSpec((1, bq, d_p), lambda bh, kk, qj: (bh, qj, 0)),
+        pl.BlockSpec((1, bq), lambda bh, kk, qj: (bh, qj)),
+        pl.BlockSpec((1, bq), lambda bh, kk, qj: (bh, qj)),
+    ]
+    dkv_out_specs = [
+        pl.BlockSpec((1, bk, d_p), lambda bh, kk, qj: (bh, kk, 0)),
+        pl.BlockSpec((1, bk, d_p), lambda bh, kk, qj: (bh, kk, 0)),
+    ]
+    dkv_out_shape = [
+        jax.ShapeDtypeStruct((b * h, sk_p, d_p), k.dtype),
+        jax.ShapeDtypeStruct((b * h, sk_p, d_p), v.dtype),
+    ]
+    dkv_scratch = [pltpu.VMEM((bk, d_p), jnp.float32),
+                   pltpu.VMEM((bk, d_p), jnp.float32)]
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    dq_kw = dict(n_k=n_k, scale=scale, causal=causal, block_q=bq,
+                 block_k=bk, seq_k=sk)
+    dkv_kw = dict(n_q=n_q, scale=scale, causal=causal, block_q=bq,
+                  block_k=bk, seq_k=sk)
+    if _static_offsets(q_offset, k_offset):
+        dq3 = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, q_off=q_offset,
+                              k_off=k_offset, **dq_kw),
+            grid=(b * h, n_q, n_k), in_specs=dq_specs,
+            out_specs=dq_out_spec, out_shape=dq_out_shape,
+            scratch_shapes=dq_scratch, compiler_params=params,
+            interpret=interpret,
+        )(q3, k3, v3, do3, lse2, delta2)
+        dk3, dv3 = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, q_off=q_offset,
+                              k_off=k_offset, **dkv_kw),
+            grid=(b * h, n_k, n_q), in_specs=dkv_specs,
+            out_specs=dkv_out_specs, out_shape=dkv_out_shape,
+            scratch_shapes=dkv_scratch, compiler_params=params,
+            interpret=interpret,
+        )(q3, k3, v3, do3, lse2, delta2)
+    else:
+        offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                          jnp.asarray(k_offset, jnp.int32)])
+        _dyn = _dyn_spec
+        dq3 = pl.pallas_call(
+            functools.partial(_attn_kernel_dyn,
+                              kernel=_bwd_dq_kernel, **dq_kw),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=(b * h, n_q, n_k),
+                in_specs=[_dyn(s) for s in dq_specs],
+                out_specs=_dyn(dq_out_spec),
+                scratch_shapes=dq_scratch),
+            out_shape=dq_out_shape, compiler_params=params,
+            interpret=interpret,
+        )(offs, q3, k3, v3, do3, lse2, delta2)
+        dk3, dv3 = pl.pallas_call(
+            functools.partial(_attn_kernel_dyn,
+                              kernel=_bwd_dkv_kernel, **dkv_kw),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=(b * h, n_k, n_q),
+                in_specs=[_dyn(s) for s in dkv_specs],
+                out_specs=[_dyn(s) for s in dkv_out_specs],
+                scratch_shapes=dkv_scratch),
+            out_shape=dkv_out_shape, compiler_params=params,
+            interpret=interpret,
+        )(offs, q3, k3, v3, do3, lse2, delta2)
 
     def unsd(x3, s):      # (b·h, s_pad, d_pad) → (b, s, h, d)
         x = x3[:, :s, :d].reshape(b, h, s, d)
@@ -336,8 +408,10 @@ def _flash_bwd(q, k, v, o, lse, do, causal=False, block_q=128,
     return unsd(dq3, sq), unsd(dk3, sk), unsd(dv3, sk)
 
 
-def _mha_jnp(q, k, v, causal):
-    """XLA-fused fallback (CPU / tiny shapes); returns (o, lse)."""
+def _mha_jnp(q, k, v, causal, q_offset=0, k_offset=0):
+    """XLA-fused fallback (CPU / tiny shapes); returns (o, lse).
+    ``q_offset``/``k_offset``: global causal positions of element 0
+    (ring/sequence shards)."""
     d = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) / (d ** 0.5)
@@ -345,12 +419,44 @@ def _mha_jnp(q, k, v, causal):
         # start-aligned (k_pos <= q_pos) like the Pallas kernel, the
         # blockwise VJP and mha_reference — NOT end-aligned tril
         sq, sk = scores.shape[-2], scores.shape[-1]
-        mask = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None]
+        mask = (k_offset + jnp.arange(sk))[None, :] <= \
+            (q_offset + jnp.arange(sq))[:, None]
         scores = jnp.where(mask, scores, NEG_INF)
     lse = jax.scipy.special.logsumexp(scores, axis=-1)
     probs = jnp.exp(scores - lse[..., None])
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out.astype(q.dtype), lse
+
+
+def _bwd_dense_block(q, k_blk, v_blk, lse, do, delta, causal, q_off,
+                     k_off):
+    """Dense (un-tiled) flash backward of ONE K/V block against the
+    GLOBAL (lse, delta): the ring fallback's hop math, kept here next
+    to its siblings so the mm-dtype / f32-accumulation conventions
+    live in one module.  Returns (dq_blk, dk_blk, dv_blk)."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_off + jnp.arange(q.shape[1])[:, None]
+        kpos = k_off + jnp.arange(k_blk.shape[1])[None, :]
+        p = jnp.where(qpos >= kpos,
+                      jnp.exp(scores - lse[..., None]), 0.0)
+    else:
+        p = jnp.exp(scores - lse[..., None])
+    mm = q.dtype
+    do_mm = do.astype(mm)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p.astype(mm), do_mm,
+                    preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do_mm, v_blk,
+                    preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta[..., None]) * scale).astype(mm)
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk,
+                    preferred_element_type=jnp.float32)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q,
+                    preferred_element_type=jnp.float32)
+    return (dq.astype(q.dtype), dk.astype(k_blk.dtype),
+            dv.astype(v_blk.dtype))
 
 
 def _bwd_blockwise(res, do, causal, block_k):
