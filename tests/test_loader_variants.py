@@ -686,3 +686,42 @@ def test_fullbatch_crop_number_rows_are_distinct(tmp_path):
     for i in range(5):
         for j in range(i + 1, 5):
             assert not numpy.array_equal(data[i], data[j]), (i, j)
+
+
+def test_image_loader_all_options_compose(tmp_path):
+    """rotations x crop_number x mirror x add_sobel x background color
+    compose: shapes, inflation, and decode stay consistent and every
+    minibatch fill succeeds across a full epoch."""
+    import math
+    from PIL import Image
+    from veles_tpu.loader.image import AutoLabelFileImageLoader
+
+    rng = numpy.random.default_rng(13)
+    for cls in ("a", "b"):
+        d = tmp_path / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(2):
+            Image.fromarray(rng.integers(0, 255, (20, 20, 3),
+                                         numpy.uint8)).save(
+                d / ("x%d.png" % i))
+    wf = DummyWorkflow()
+    wf.device = NumpyDevice()
+    loader = AutoLabelFileImageLoader(
+        wf, train_paths=[str(tmp_path / "train")], size=(20, 20),
+        crop=(12, 12), crop_number=2, rotations=(0.0, math.pi / 4),
+        mirror=True, add_sobel=True, background_color=(8, 16, 32),
+        minibatch_size=8)
+    loader.initialize(device=wf.device)
+    assert loader.samples_inflation == 4        # 2 rot x 2 crops
+    assert loader.class_lengths[TRAIN] == 16    # 4 keys x 4
+    assert loader.sample_shape == (12, 12, 4)   # crop + sobel channel
+    seen = 0
+    for _ in range(40):
+        loader.run()
+        n = int(loader.minibatch_size)
+        assert loader.minibatch_data.mem[:n].shape[1:] == (12, 12, 4)
+        assert numpy.isfinite(loader.minibatch_data.mem[:n]).all()
+        seen += n
+        if bool(loader.epoch_ended):
+            break
+    assert seen >= 16                           # full epoch served
