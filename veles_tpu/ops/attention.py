@@ -1,4 +1,4 @@
-"""Flash attention: Pallas TPU kernel + memory-efficient VJP.
+"""Flash attention: Pallas TPU kernels (forward AND backward) + VJP.
 
 The hot op of the transformer family (SURVEY §5.7 notes attention is
 beyond reference parity — this is the TPU build's flagship Pallas
@@ -6,12 +6,18 @@ kernel).  Forward is a tiled online-softmax kernel: Q blocks stream
 through VMEM while K/V blocks arrive per grid step, so the (Sq, Sk)
 score matrix never materializes in HBM.  Backward recomputes
 probabilities blockwise from the saved log-sum-exp (the standard
-flash-attention trade: extra FLOPs for O(S) memory) with a
-``lax.scan`` the compiler pipelines.
+flash-attention trade: extra FLOPs for O(S) memory) via TWO Pallas
+kernels — dq streams K blocks per Q block; dk/dv streams Q blocks per
+K block — with causal block skipping and swept block sizes
+(``flash_attention_bwd_v2`` in the autotune DB); the pre-Pallas
+``lax.scan`` fallback (:func:`_bwd_blockwise`) remains the non-TPU
+path.  Both directions accept global causal offsets (static for the
+offset-0 flagship path, scalar-prefetched when traced) so the kernels
+serve as ring-attention hop blocks.
 
 Layouts follow :mod:`veles_tpu.parallel.ring` — tensors are
 ``(batch, seq, heads, head_dim)`` — so :func:`flash_attention` is a
-drop-in for its per-device block update, composing with ring/Ulysses
+drop-in for its per-hop block math, composing with ring/Ulysses
 sequence parallelism.
 """
 
