@@ -1285,6 +1285,63 @@ def stage_profile_lm():
         "device_kind": _device_kind()}))
 
 
+def stage_attn_bwd():
+    """Flash-attention BACKWARD A/B, isolated: the Pallas two-kernel
+    backward at several block sizes vs the XLA scan fallback, at the
+    LM stage's attention shape — the direct evidence for VERDICT r5
+    item 2 (the full-step LM line only shows the backward through a
+    25/75 blend).  Emits the best-Pallas-vs-XLA speedup + TFLOP/s."""
+    import jax.numpy as jnp
+    from veles_tpu.config import root
+    from veles_tpu.ops.benchmark import _sweep_attention_bwd_shape
+
+    tiny = bool(os.environ.get("BENCH_ATTN_TINY"))
+    if tiny:                # CPU smoke: interpret mode exercises the
+        shape = (1, 64, 2, 8)        # PALLAS leg too, not just XLA
+        cands = ((8, 8), None)
+        # the LM stage's attention shape, batch matched to the LM line
+        # this stage exists to explain
+    else:
+        batch = int(os.environ.get("BENCH_LM_BATCH", "32"))
+        shape = (batch, 1024, 8, 64)
+        cands = ((128, 128), (256, 256), (256, 512), (512, 256), None)
+    prior = root.common.engine.get("interpret", False)
+    if tiny:
+        root.common.engine.interpret = True
+    try:
+        out, flops = _sweep_attention_bwd_shape(
+            shape, jnp.bfloat16, cands, runs=2, causal=True,
+            dtype_name="bfloat16")
+    finally:
+        root.common.engine.interpret = prior
+    xla = out.get(None)
+    pallas = {c: v for c, v in out.items() if c is not None}
+    best = min(pallas, key=lambda c: pallas[c][0]) if pallas else None
+    best_sec = pallas[best][0] if best else None
+    rec = {
+        "metric": "flash-attention backward A/B (Pallas vs XLA scan)",
+        "value": round(xla[0] / best_sec, 4)
+                 if (xla and best_sec) else 0.0,
+        "unit": "x", "vs_baseline": None,
+        "shape": list(shape),
+        "pallas_blocks": list(best) if best else None,
+        "pallas_tflops": round(flops / best_sec / 1e12, 2)
+                          if best_sec else None,
+        "xla_scan_tflops": round(flops / xla[0] / 1e12, 2)
+                            if xla else None,
+        "device_kind": _device_kind()}
+    # a silently-failed leg must never read as a measured 0x: mark
+    # which legs actually ran (the sweep swallows per-candidate
+    # exceptions by design)
+    if not pallas and not xla:
+        rec["error"] = "no candidate completed"
+    elif not pallas:
+        rec["error"] = "pallas leg never completed (XLA-only)"
+    elif not xla:
+        rec["error"] = "xla leg never completed (Pallas-only)"
+    print(_dumps(rec))
+
+
 def stage_s2d():
     """Space-to-depth conv1 A/B (was chip_session.sh step 3): the same
     stride-4 11x11 conv timed with and without the s2d rewrite, in one
@@ -1339,6 +1396,7 @@ STAGES = {
     "profile": (stage_profile, 600),
     "profile_lm": (stage_profile_lm, 600),
     "s2d": (stage_s2d, 300),
+    "attn_bwd": (stage_attn_bwd, 400),
 }
 
 
@@ -1349,7 +1407,7 @@ _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
                "cifar", "stl10", "ae",
                "kohonen",
-               "lstm", "transformer", "profile_lm", "power",
+               "lstm", "transformer", "profile_lm", "attn_bwd", "power",
                "native_infer", "s2d", "alexnet512", "alexnet_e2e",
                "alexnet_epoch", "alexnet_epoch_ab", "profile", "alexnet")
 
@@ -1361,7 +1419,8 @@ _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
 #: after the headline artifacts.
 _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
                "s2d", "alexnet512", "alexnet_e2e", "alexnet_epoch",
-               "alexnet_epoch_ab", "transformer", "profile_lm", "lstm", "mnist_e2e",
+               "alexnet_epoch_ab", "transformer", "profile_lm", "attn_bwd",
+               "lstm", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "power", "native_infer",
                "cifar", "stl10", "ae", "kohonen", "mnist_wf",
                "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager")

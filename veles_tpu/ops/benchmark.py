@@ -699,10 +699,13 @@ def _sweep_attention_bwd_shape(shape, dtype, candidates, runs, causal,
             if _blocks is None:
                 dq, dk, dv = _bwd_blockwise(res, dd, causal, 128)
             else:
+                from veles_tpu.config import root
                 dq, dk, dv = _flash_bwd(
                     res[0], res[1], res[2], res[3], res[4], dd,
                     causal=causal, block_q=_blocks[0],
-                    block_k=_blocks[1])
+                    block_k=_blocks[1],
+                    interpret=bool(root.common.engine.get(
+                        "interpret", False)))
             return sum(jnp.sum(jnp.abs(g), dtype=jnp.float32)
                        for g in (dq, dk, dv))
 
