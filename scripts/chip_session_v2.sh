@@ -64,6 +64,12 @@ if ! headline_landed "$OUT/bench.jsonl"; then
     note "probe loop retries the ladder at the next window"
     exit 1
 fi
+# half-window insurance: bank the ladder into the TRACKED evidence dir
+# NOW — a tunnel death during autotune/re-bench must not cost the
+# already-measured headline (the collector never overwrites, so the
+# end-of-session snapshot below just adds suffixed copies of the rest)
+python scripts/collect_chip_session.py "$OUT" chip_session_r5 \
+    >/dev/null 2>&1 || note "mid-session collector failed"
 
 note "1b/3 per-layer profiles for the two unadjudicated MFU stages"
 # VERDICT r4 item 6: LSTM 0.115 / CIFAR 0.17 need a committed
