@@ -288,12 +288,18 @@ class FusedTrainer(AcceleratedUnit):
     def initialize(self, device=None, **kwargs):
         super(FusedTrainer, self).initialize(device=device, **kwargs)
         wf = self.workflow
-        if getattr(wf, "is_slave", False) or getattr(wf, "is_master",
-                                                     False):
+        if self.epoch_mode and getattr(wf, "is_slave", False):
             raise NotImplementedError(
-                "fused mode covers standalone and SPMD multi-host "
-                "runs; the elastic master–slave job layer trains "
-                "through the eager unit chain (fused=False)")
+                "epoch_mode trains a whole epoch in ONE program; the "
+                "elastic job layer distributes per-minibatch jobs — "
+                "use epoch_mode=False on slaves")
+        # Under the elastic master–slave layer the trainer otherwise
+        # works unchanged: each job's payload updates the forwards'
+        # Vectors, the workflow calls refresh_from_forwards() to
+        # install them into the built device params, and sync_weights()
+        # runs before the forwards compute their update deltas
+        # (StandardWorkflow.apply_data_from_master /
+        # generate_data_for_master).
         # _build happens lazily on the first run(): the unchained
         # forward units initialize AFTER this unit (they have no
         # control links), and seeding must read their real weights
@@ -435,8 +441,40 @@ class FusedTrainer(AcceleratedUnit):
         self.solver_state = snap
         self._step_ = None            # _build() restores the tree
 
+    def refresh_from_forwards(self):
+        """Overwrite the built device params' weight/bias leaves with
+        the forward units' (host) Vectors, keeping solver state
+        (momenta, Adam moments/t, rprop deltas, schedule ticks)
+        local — the async-DP consistency model: every job starts from
+        the master's weights while optimizer dynamics stay slave-side,
+        exactly like the eager chain's per-unit gradient Vectors (ref
+        ``veles/client.py:177-196`` job application).  A no-op before
+        the first build: ``_build`` seeds from the same Vectors
+        lazily."""
+        if self._params_ is None:
+            return
+        import jax
+
+        refreshed = []
+        for fwd, state in zip(self.forwards, self._params_):
+            state = dict(state)
+            for key, vec in (("w", fwd.weights), ("b", fwd.bias)):
+                old = state.get(key)
+                if old is None or not vec:
+                    continue
+                vec.map_read()
+                host = numpy.ascontiguousarray(vec.mem).astype(
+                    old.dtype, copy=False)
+                # the leaf's own sharding: committed single-device
+                # placement and mesh NamedShardings both round-trip
+                state[key] = jax.device_put(host, old.sharding)
+            refreshed.append(state)
+        self._params_ = refreshed
+
     def sync_weights(self):
         """Write the fused params back into the forward units."""
+        if self._params_ is None:
+            return
         for fwd, state in zip(self.forwards, self._params_):
             w = state.get("w")
             if w is not None and fwd.weights:

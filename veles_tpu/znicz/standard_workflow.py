@@ -456,6 +456,24 @@ class StandardWorkflow(AcceleratedWorkflow):
         return super(StandardWorkflow, self).generate_data_for_slave(
             slave)
 
+    def apply_data_from_master(self, data):
+        super(StandardWorkflow, self).apply_data_from_master(data)
+        if self.fused and self.fused_trainer is not None:
+            # the job's payload just updated the forwards' weight
+            # Vectors — install them into the built device params
+            # (solver state stays slave-local, like the eager path's
+            # gradient Vectors)
+            self.fused_trainer.refresh_from_forwards()
+
+    def generate_data_for_master(self):
+        if self.fused and self.fused_trainer is not None:
+            # update deltas are computed by the FORWARD units from
+            # their Vectors — push the trained device params back
+            # first (the per-unit payload order does not guarantee
+            # the trainer precedes the forwards)
+            self.fused_trainer.sync_weights()
+        return super(StandardWorkflow, self).generate_data_for_master()
+
     # -- results ------------------------------------------------------------
     def gather_results(self):
         from veles_tpu.workflow import ChecksumError
