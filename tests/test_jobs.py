@@ -149,14 +149,15 @@ DIST_LAYERS = [
 ]
 
 
-def make_dist_wf(is_master=False, is_slave=False, fused=False):
+def make_dist_wf(is_master=False, is_slave=False, fused=False,
+                 fused_config=None):
     from veles_tpu import prng
     prng.seed_all(21)
     wf = StandardWorkflow(
         None,
         loader_factory=lambda w: DistLoader(w, minibatch_size=25),
         layers=[{**s} for s in DIST_LAYERS],
-        fused=fused,
+        fused=fused, fused_config=fused_config or {},
         decision_config={"max_epochs": 3})
     wf.launcher = DummyLauncher(is_master=is_master, is_slave=is_slave)
     wf.initialize(device=NumpyDevice())
@@ -243,6 +244,41 @@ def test_fused_job_protocol_reseeds_and_syncs():
     state = slave_wf.fused_trainer.capture_state()
     numpy.testing.assert_allclose(
         numpy.asarray(state[0]["w"], numpy.float32), 0.123, atol=1e-6)
+
+
+def test_fused_pod_slice_slave_on_mesh():
+    """A slave that is a whole pod slice: its fused step shards the job
+    minibatch over the local device mesh (DP + grad all-reduce inside
+    the step) while the master stays plain — legal because the
+    handshake checksum hashes code + graph, not per-host config
+    (docs/distributed_training.md 'a slave is a whole pod slice')."""
+    master_wf = make_dist_wf(is_master=True, fused=True)
+    # data=5 divides the 25-sample job minibatch exactly: every train
+    # job runs the clean DP shard path, not the tail-rounding path
+    slave_wf = make_dist_wf(
+        is_slave=True, fused=True,
+        fused_config={"mesh_axes": {"data": 5}})
+    assert master_wf.checksum() == slave_wf.checksum()
+    w0 = numpy.array(master_wf.forwards[0].weights.mem)
+
+    for _ in range(8):                 # one epoch of jobs
+        updates = []
+        slave_wf.do_job(master_wf.generate_data_for_slave(None),
+                        updates.append)
+        master_wf.apply_data_from_slave(updates[0], None)
+    w1 = numpy.array(master_wf.forwards[0].weights.mem)
+    assert not numpy.allclose(w0, w1)
+    numpy.testing.assert_allclose(
+        w1, numpy.array(slave_wf.forwards[0].weights.mem),
+        rtol=1e-5, atol=1e-6)
+    # job payloads really entered the mesh-sharded params
+    master_wf.forwards[0].weights.map_write()
+    master_wf.forwards[0].weights.mem[...] = 0.25
+    slave_wf.apply_data_from_master(
+        master_wf.generate_data_for_slave(None))
+    state = slave_wf.fused_trainer.capture_state()
+    numpy.testing.assert_allclose(
+        numpy.asarray(state[0]["w"], numpy.float32), 0.25, atol=1e-6)
 
 
 def test_fused_epoch_mode_rejected_on_slave():
