@@ -281,6 +281,29 @@ def test_fused_pod_slice_slave_on_mesh():
         numpy.asarray(state[0]["w"], numpy.float32), 0.25, atol=1e-6)
 
 
+def test_fused_refresh_preserves_solver_state():
+    """refresh_from_forwards overwrites ONLY the w/b leaves: momentum
+    velocities accumulated across jobs stay slave-local (the async-DP
+    consistency model — optimizer dynamics live with the slave like
+    the eager chain's gradient Vectors)."""
+    master_wf = make_dist_wf(is_master=True, fused=True)
+    slave_wf = make_dist_wf(is_slave=True, fused=True)
+    for _ in range(8):                 # one epoch: builds + trains
+        slave_wf.do_job(master_wf.generate_data_for_slave(None),
+                        lambda update: None)
+    before = slave_wf.fused_trainer.capture_state()
+    assert numpy.abs(before[0]["vw"]).max() > 0, \
+        "momentum must have accumulated"
+    master_wf.forwards[0].weights.map_write()
+    master_wf.forwards[0].weights.mem[...] = 0.5
+    slave_wf.apply_data_from_master(
+        master_wf.generate_data_for_slave(None))
+    after = slave_wf.fused_trainer.capture_state()
+    numpy.testing.assert_array_equal(after[0]["vw"], before[0]["vw"])
+    numpy.testing.assert_allclose(
+        numpy.asarray(after[0]["w"], numpy.float32), 0.5, atol=1e-6)
+
+
 def test_fused_epoch_mode_rejected_on_slave():
     """Whole-epoch-in-one-program conflicts with per-minibatch jobs —
     fail closed (fused_unit.initialize guard)."""
