@@ -521,6 +521,78 @@ def stage_mnist_wf_eager():
               "throughput (epoch wall-clock incl. eval)", fused=False)
 
 
+def stage_mnist_wf_slave():
+    """The elastic job layer END-TO-END with a FUSED slave (round-5
+    capability: fused training under master–slave): master + slave in
+    ONE process over real localhost ZMQ sockets, per-minibatch jobs —
+    indices + weights out, update deltas back, double-buffered
+    (JobClient.run_prefetch).  Vs the ``mnist_wf`` line this prices
+    the whole job protocol: serve_next_minibatch, pickled payloads,
+    per-job weight install (refresh_from_forwards), delta extraction
+    and master-side merge."""
+    from veles_tpu import prng
+    from veles_tpu.backends import AutoDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.parallel.jobs import JobClient, JobServer
+    from veles_tpu.samples import mnist
+
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.loader.base import TRAIN
+
+    batch = 2048
+
+    def mk(device, **flags):
+        prng.seed_all(1234)
+        wf = mnist.create_workflow(
+            launcher=DummyLauncher(**flags), max_epochs=2,
+            minibatch_size=batch, fused=True)
+        wf.initialize(device=device)
+        return wf
+
+    # the master never runs kernels — NumpyDevice keeps the dataset
+    # out of HBM (per-host device config does not enter the checksum)
+    master = mk(NumpyDevice(), is_master=True)
+    slave = mk(AutoDevice(), is_slave=True)
+    server = JobServer(master).start()
+    try:
+        client = JobClient(slave, server.endpoint)
+        client.handshake()
+        client.run_prefetch()      # epochs 1-2: compiles included
+        client.close()
+    finally:
+        server.stop()
+    # the server latches no_more_jobs once Decision completes — fresh
+    # server+client for the warm timed epochs (the slave's jitted step
+    # and params stay warm); connect + checksum handshake are inside
+    # the timed window.  Prefetch blurs the epoch boundary by up to
+    # one in-flight job, so the denominator counts the train samples
+    # the master ACTUALLY merged during the window, not 2×epoch.
+    master.decision.complete <<= False
+    master.decision.max_epochs = 4
+    counted = {"train": 0}
+    inner_apply = master.decision.apply_data_from_slave
+
+    def counting_apply(data, slave=None):
+        if data and data.get("cls") == TRAIN:
+            counted["train"] += int(data.get("size", 0))
+        return inner_apply(data, slave)
+
+    master.decision.apply_data_from_slave = counting_apply
+    server = JobServer(master).start()
+    try:
+        tic = time.perf_counter()
+        client = JobClient(slave, server.endpoint)
+        client.handshake()
+        client.run_prefetch()      # epochs 3-4, warm
+        elapsed = time.perf_counter() - tic
+        client.close()
+    finally:
+        server.stop()
+    _emit("MNIST784 full StandardWorkflow(fused) master+slave jobs "
+          "throughput (epoch wall-clock incl. eval, localhost ZMQ)",
+          batch * elapsed / max(counted["train"], 1), batch, None)
+
+
 def stage_ae_wf_epoch():
     """The AE family through the full framework path with epoch_mode:
     StandardWorkflow(fused, epoch_mode) + MSE loss — the regression
@@ -1379,6 +1451,7 @@ STAGES = {
     "mnist_wf_epoch": (stage_mnist_wf_epoch, 240),
     "ae_wf_epoch": (stage_ae_wf_epoch, 240),
     "mnist_wf_eager": (stage_mnist_wf_eager, 300),
+    "mnist_wf_slave": (stage_mnist_wf_slave, 300),
     "cifar": (stage_cifar, 210),
     "stl10": (stage_stl10, 240),
     "ae": (stage_ae, 150),
@@ -1405,6 +1478,7 @@ STAGES = {
 _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "mnist_wf",
                "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
+               "mnist_wf_slave",
                "cifar", "stl10", "ae",
                "kohonen",
                "lstm", "transformer", "profile_lm", "attn_bwd", "power",
@@ -1423,13 +1497,15 @@ _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
                "lstm", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "power", "native_infer",
                "cifar", "stl10", "ae", "kohonen", "mnist_wf",
-               "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager")
+               "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
+               "mnist_wf_slave")
 
 #: CPU fallback (rehearsed with a wedged tunnel): conv/LM heavies
 #: cannot finish on CPU inside their caps — end on the flagship MNIST
 #: number so the recorded last line is a real measurement.
 _CPU_ORDER = ("mnist_e2e", "mnist_epoch", "mnist_wf",
-              "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager", "ae",
+              "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
+              "mnist_wf_slave", "ae",
               "kohonen", "lstm",
               "native_infer", "mnist_u8", "mnist_bf16", "mnist")
 
