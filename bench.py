@@ -236,21 +236,59 @@ def _banked_tpu_lines():
                 # provenance fields the judge reads alongside the
                 # value; absent keys stay absent
                 for k in ("vs_baseline", "mfu", "sec_per_step",
-                          "batch", "ts"):
+                          "batch", "ts", "batches_served"):
                     if k in rec:
                         out[k] = rec[k]
                 entries.append((key, out))
             except Exception:
                 continue
     entries.sort(key=lambda e: e[0])
+    # Sample-starved lines cannot supersede substantive measurements:
+    # a window dying mid-stage leaves e2e loops that served ONE batch
+    # at tunnel-RTT pace (r4 bench.7: 26.5 img/s, batches_served 1,
+    # dispatch 9.6 s/batch — vs bench.5's 7,924 over 2175 batches).
+    # Such a line measures the dying transport, not the framework —
+    # same class as the error records above, and diagnosed in-band by
+    # its own stage breakdown.  It canonicalizes only when no
+    # substantive line for the (metric, device kind) exists at all,
+    # and then carries an explicit low_confidence marker.
     newest = {}
+    starved = {}
     for _key, out in entries:
-        newest[(out["metric"], out["device_kind"])] = out
+        mkey = (out["metric"], out["device_kind"])
+        if _sample_starved(out):
+            starved[mkey] = out
+        else:
+            newest[mkey] = out
+    for mkey, out in starved.items():
+        if mkey not in newest:
+            out = dict(out)
+            out["low_confidence"] = True
+            newest[mkey] = out
     banked = list(newest.values())
     return banked, total - len(banked)
 
 
-def _emit_banked_tail(live_records):
+def _batch_tag(batch, default):
+    """Metric-name suffix for non-default batch sizes: every stage
+    that reads a batch env knob must key its metric by batch, or a
+    scaling-sweep line supersedes the canonical banked measurement
+    (code-review r5)."""
+    return "" if batch == default else " (batch %d)" % batch
+
+
+def _sample_starved(rec):
+    """True when the record's own stage diagnosis says it timed almost
+    nothing: <= 2 served batches means no steady-state interval ever
+    existed (the r4 pathological line served exactly 1).  The cutoff
+    is deliberately minimal — a congested-but-alive heavy loop serving
+    a handful of slow batches is a legitimate measurement and must
+    keep its power to supersede (code-review r5)."""
+    served = rec.get("batches_served")
+    return isinstance(served, (int, float)) and served <= 2
+
+
+def _emit_banked_tail(live_records, only=None):
     """When the run produced no LIVE TPU headline — tunnel down, or a
     window that died before the flagship stage — re-emit the newest
     banked hardware lines as real stdout *records*, tagged
@@ -264,14 +302,26 @@ def _emit_banked_tail(live_records):
 
     Returns ``(emitted_any, headline_emitted)``: the caller must only
     suppress its own trailing live-headline re-emit when a banked
-    HEADLINE record actually went out last."""
+    HEADLINE record actually went out last.
+
+    ``only``: restrict to the given metric names — the healthy-
+    headline path uses this to re-emit banked substantive lines just
+    for metrics whose live record this run was sample-starved
+    (code-review r5)."""
     live_tpu_metrics = {r.get("metric") for r in live_records
                         if "tpu" in (r.get("device_kind") or "").lower()
-                        and "error" not in r}
+                        and "error" not in r
+                        and not _sample_starved(r)}
     banked, _superseded = _banked_tpu_lines()
     headlines = []              # one per device kind is possible
     emitted = False
     for rec in banked:
+        if only is not None and (rec.get("metric") not in only
+                                 or rec.get("low_confidence")):
+            # the restricted (healthy-headline) path exists to surface
+            # BETTER evidence than the run's starved live line — a
+            # banked line that is itself starved is not that
+            continue
         if rec.get("metric") in live_tpu_metrics:
             continue            # a live line this run already covers it
         out = dict(rec)
@@ -621,7 +671,7 @@ def stage_stl10():
     # uses synthetic batches; STL-10 carries the label because its
     # BASELINE config is the one defined by a real dataset.
     _conv_stage("STL-10 convnet fused train throughput "
-                "(synthetic batch)",
+                "(synthetic batch)" + _batch_tag(batch, 256),
                 stl10.LAYERS, (96, 96, 3), 10, batch=batch, steps=12)
 
 
@@ -907,7 +957,8 @@ def stage_transformer():
         # profiling the config that WORKED
         os.environ["BENCH_LM_REMAT"] = "1"
         sec, flops = measure(True)
-    name = "GPT-512x8 LM fused train throughput (tokens basis)"
+    name = ("GPT-512x8 LM fused train throughput (tokens basis)"
+            + _batch_tag(batch, 32))
     if os.environ.get("BENCH_LM_TINY"):
         name += " [tiny-smoke]"
     _emit(name, sec, batch * cfg["seq_len"], flops,
@@ -977,9 +1028,16 @@ def stage_power():
 def stage_alexnet():
     from veles_tpu.samples import alexnet
     batch = int(os.environ.get("BENCH_ALEXNET_BATCH", "256"))
+    # non-default batches get their own metric name (matching the
+    # alexnet512 stage's convention) so a scaling point can never
+    # supersede the canonical batch-256 headline in the banked lines
+    if batch == 256:
+        name = "AlexNet fused train throughput per chip (bf16)"
+    else:
+        name = ("AlexNet fused train throughput per chip "
+                "(bf16, batch %d)" % batch)
     _conv_stage(
-        "AlexNet fused train throughput per chip (bf16)",
-        alexnet.LAYERS, alexnet.INPUT_SHAPE, 1000, batch=batch,
+        name, alexnet.LAYERS, alexnet.INPUT_SHAPE, 1000, batch=batch,
         steps=10, vs=V100_ALEXNET_IMG_PER_SEC)
 
 
@@ -1087,7 +1145,8 @@ def stage_alexnet_epoch():
             remat=remat_mode,
             input_norm=(numpy.float32(1 / 255.0), numpy.float32(0.0)))
         _epoch_loop("AlexNet one-program-epoch train throughput "
-                    "(u8-resident, in-program permute+gather, bf16)",
+                    "(u8-resident, in-program permute+gather, bf16)"
+                    + _batch_tag(batch, 256),
                     step_fn, params, data, labels, n, batch,
                     extra={"remat": remat_mode})
 
@@ -1147,7 +1206,8 @@ def stage_alexnet_epoch_ab():
         remat=remat,
         input_norm=(numpy.float32(1 / 255.0), numpy.float32(0.0)))
     _epoch_loop("AlexNet one-program-epoch train throughput "
-                "(sequential gather A/B leg, bf16)",
+                "(sequential gather A/B leg, bf16)"
+                + _batch_tag(batch, 256),
                 step_fn, params, data, labels, n, batch,
                 extra={"remat": remat, "shuffle": False},
                 shuffle=False)
@@ -1274,7 +1334,8 @@ def stage_alexnet_e2e():
         trainer = wf.fused_trainer
         trainer._build()
         _e2e_loop("AlexNet end-to-end workflow throughput "
-                  "(u8-resident loader+gather+fused bf16 step)",
+                  "(u8-resident loader+gather+fused bf16 step)"
+                  + _batch_tag(batch, 256),
                   wf.loader, trainer._params_, trainer._step_,
                   extra={"remat": remat_mode})
 
@@ -1369,6 +1430,7 @@ def stage_attn_bwd():
 
     tiny = bool(os.environ.get("BENCH_ATTN_TINY"))
     if tiny:                # CPU smoke: interpret mode exercises the
+        batch = 32          # keep the canonical un-suffixed metric
         shape = (1, 64, 2, 8)        # PALLAS leg too, not just XLA
         cands = ((8, 8), None)
         # the LM stage's attention shape, batch matched to the LM line
@@ -1391,7 +1453,8 @@ def stage_attn_bwd():
     best = min(pallas, key=lambda c: pallas[c][0]) if pallas else None
     best_sec = pallas[best][0] if best else None
     rec = {
-        "metric": "flash-attention backward A/B (Pallas vs XLA scan)",
+        "metric": "flash-attention backward A/B (Pallas vs XLA scan)"
+                  + _batch_tag(batch, 32),
         "value": round(xla[0] / best_sec, 4)
                  if (xla and best_sec) else 0.0,
         "unit": "x", "vs_baseline": None,
@@ -1894,6 +1957,7 @@ def main():
     live_tpu_headline = (headline is not None
                          and (probe or {}).get("platform") == "tpu")
     emitted_any = False
+    starved_covered = False
     if not live_tpu_headline:
         # partial/dead window or non-TPU platform: banked hardware
         # lines (AlexNet headline last) so the driver's parsed line is
@@ -1901,7 +1965,20 @@ def main():
         emitted_any, banked_headline = _emit_banked_tail(records)
         if banked_headline:
             headline = None     # the banked headline is already last
-    if headline is not None and records[-1] is not headline:
+    else:
+        # healthy headline but a stage's live line was sample-starved
+        # (window degraded mid-run): re-emit the banked substantive
+        # measurement for JUST those metrics, so the round's artifact
+        # never carries only a transport-death number while better
+        # hardware evidence exists (code-review r5)
+        starved_live = {r.get("metric") for r in records
+                        if "tpu" in (r.get("device_kind") or "").lower()
+                        and _sample_starved(r)}
+        if starved_live:
+            starved_covered, _ = _emit_banked_tail(records,
+                                                   only=starved_live)
+    if headline is not None and (starved_covered
+                                 or records[-1] is not headline):
         # the driver parses the LAST line as the round's headline
         # metric (duplicate line is deliberate)
         print(_dumps(headline), flush=True)

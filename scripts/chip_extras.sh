@@ -1,0 +1,39 @@
+#!/bin/bash
+# Post-session bonus measurements — run ONLY after chip_session_v2.sh
+# has banked the round's scripted artifacts.  Each invocation of
+# bench.py is one backend claim; the relay may refuse any of them
+# (claims are scarce outside the first minutes of a window), so every
+# leg is independent and a refusal only costs that leg.
+#
+#     bash scripts/chip_extras.sh [outdir]
+#
+# Legs (scaling points the scripted ladder doesn't cover):
+#   1. LM batch 64 (65k tokens/step) — does the swept flash backward
+#      hold its TFLOP/s when the per-step token count doubles?
+#   2. AlexNet batch 1024 — MXU saturation headroom above the
+#      256/512 ladder points.
+set -u
+OUT=${1:-chip_session_logs_r5}
+mkdir -p "$OUT"
+
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-$(python -c \
+    'from veles_tpu.backends import COMPILE_CACHE_DIR; print(COMPILE_CACHE_DIR)' \
+    2>/dev/null || echo "$HOME/.veles_tpu/cache/xla")}
+export BENCH_TIMEOUT_SCALE=${BENCH_TIMEOUT_SCALE:-4}
+
+note() { echo "[chip_extras $(date +%H:%M:%S)] $*" >&2; }
+
+note "leg 1: LM batch 64"
+BENCH_STAGES=transformer BENCH_LM_BATCH=64 BENCH_BUDGET_SEC=1500 \
+    python bench.py >"$OUT/extras_lm64.jsonl" 2>"$OUT/extras_lm64.log" \
+    || note "LM batch-64 leg failed (rc=$?)"
+
+note "leg 2: AlexNet batch 1024"
+BENCH_STAGES=alexnet BENCH_ALEXNET_BATCH=1024 BENCH_BUDGET_SEC=1500 \
+    python bench.py >"$OUT/extras_alexnet1024.jsonl" \
+    2>"$OUT/extras_alexnet1024.log" \
+    || note "AlexNet batch-1024 leg failed (rc=$?)"
+
+python scripts/collect_chip_session.py "$OUT" chip_session_r5 \
+    >/dev/null 2>&1 || note "collector failed — snapshot manually"
+note "done"

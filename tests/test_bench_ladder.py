@@ -155,6 +155,38 @@ def test_parent_streams_and_reemits_headline_last(monkeypatch, tmp_path):
     assert not any("[cpu-fallback]" in n for n in names)
 
 
+def test_parent_healthy_headline_starved_live_reemits_banked(
+        monkeypatch, tmp_path):
+    """Live headline landed but a later stage's live line was sample-
+    starved (window degraded mid-run): the banked substantive line
+    for JUST that metric re-emits, and the live headline is still the
+    driver-parsed LAST line (code-review r5)."""
+    monkeypatch.setattr(bench, "_banked_tpu_lines", lambda: ([
+        {"metric": "e2e", "value": 7923.6, "unit": "images/sec",
+         "batches_served": 2175, "device_kind": "TPU v5 lite",
+         "source": "chip_session_r4/bench.5.jsonl"},
+        {"metric": "unrelated-banked", "value": 1.0, "unit": "x",
+         "device_kind": "TPU v5 lite",
+         "source": "chip_session_r4/bench.5.jsonl"}], 0))
+    lines = _run_main(monkeypatch, tmp_path, """
+        import json
+        print(json.dumps({"platform": "tpu", "device_kind": "TPU x"}))
+        print(json.dumps({"metric":
+                          "AlexNet fused train throughput per chip (bf16)",
+                          "value": 12000.0, "unit": "images/sec",
+                          "device_kind": "TPU x"}))
+        print(json.dumps({"metric": "e2e", "value": 26.5,
+                          "unit": "images/sec", "batches_served": 1,
+                          "device_kind": "TPU x"}))
+    """)
+    names = [r["metric"] for r in lines]
+    banked = [r for r in lines if r.get("banked")]
+    # only the starved metric's banked line — not the whole tail
+    assert [r["metric"] for r in banked] == ["e2e"]
+    assert banked[0]["value"] == 7923.6
+    assert names[-1] == bench.HEADLINE_METRIC
+
+
 def test_parent_tags_non_tpu_ladder_lines(monkeypatch, tmp_path):
     # pin the banked tail: this fixture's cpu platform routes through
     # _emit_banked_tail, which must not read the real repo's evidence
@@ -335,6 +367,68 @@ def test_banked_lines_error_record_never_supersedes(monkeypatch,
     assert banked[0]["vs_baseline"] == 8.29     # provenance carried
     assert banked[0]["mfu"] == 0.39
     assert superseded == 1                      # counted, not listed
+
+
+def test_banked_lines_starved_sample_never_supersedes(monkeypatch,
+                                                      tmp_path):
+    """A line whose own stage diagnosis says it served almost no
+    batches (a window dying mid-stage leaves e2e loops timing ONE
+    batch at tunnel-RTT pace — r4 bench.7: 26.5 img/s, batches_served
+    1, dispatch 9.6 s) measures the dying transport, not the
+    framework: it must not canonicalize over a substantive older
+    measurement, but still surfaces when it is ALL there is."""
+    d = tmp_path / "chip_session_r4"
+    d.mkdir()
+    (d / "bench.jsonl").write_text(json.dumps(
+        {"metric": "e2e", "value": 7923.6, "unit": "images/sec",
+         "batches_served": 2175,
+         "device_kind": "TPU v5 lite"}) + "\n")
+    (d / "bench.2.jsonl").write_text("\n".join([
+        json.dumps({"metric": "e2e", "value": 26.5,
+                    "unit": "images/sec", "batches_served": 1,
+                    "device_kind": "TPU v5 lite"}),
+        json.dumps({"metric": "only-starved", "value": 3.0,
+                    "unit": "images/sec", "batches_served": 2,
+                    "device_kind": "TPU v5 lite"}),
+    ]) + "\n")
+    monkeypatch.setattr(bench.os.path, "dirname",
+                        lambda p: str(tmp_path))
+    banked, superseded = bench._banked_tpu_lines()
+    by_metric = {rec["metric"]: rec for rec in banked}
+    assert by_metric["e2e"]["value"] == 7923.6
+    assert by_metric["e2e"]["batches_served"] == 2175
+    # a starved line with no substantive sibling still surfaces,
+    # explicitly marked
+    assert by_metric["only-starved"]["value"] == 3.0
+    assert by_metric["only-starved"]["low_confidence"] is True
+    assert "low_confidence" not in by_metric["e2e"]
+    assert superseded == 1
+
+
+def test_emit_banked_tail_ignores_starved_live_coverage(monkeypatch,
+                                                        tmp_path,
+                                                        capsys):
+    """A live record that is itself sample-starved (the window died
+    mid-stage THIS run) must not count as live coverage — the banked
+    substantive line for that metric still re-emits, so the round's
+    stdout never carries only the transport-death number
+    (code-review r5)."""
+    d = tmp_path / "chip_session_r4"
+    d.mkdir()
+    (d / "bench.jsonl").write_text(json.dumps(
+        {"metric": "e2e", "value": 7923.6, "unit": "images/sec",
+         "batches_served": 2175,
+         "device_kind": "TPU v5 lite"}) + "\n")
+    monkeypatch.setattr(bench.os.path, "dirname",
+                        lambda p: str(tmp_path))
+    live = [{"metric": "e2e", "value": 26.5, "unit": "images/sec",
+             "batches_served": 1, "device_kind": "TPU v5 lite"}]
+    emitted, headline = bench._emit_banked_tail(live)
+    out = [json.loads(l) for l in
+           capsys.readouterr().out.strip().splitlines()]
+    assert emitted and not headline
+    assert any(r["metric"] == "e2e" and r["value"] == 7923.6
+               and r["banked"] is True for r in out)
 
 
 def test_emit_banked_tail_headline_last(monkeypatch, tmp_path,
