@@ -1,0 +1,176 @@
+#!/bin/bash
+# Round-5 follow-up watcher: the main session (chip_session_v2.sh)
+# exits 0 once the HEADLINE has landed — even when the relay refused
+# its later steps.  This loop keeps retrying the still-missing
+# artifacts at later windows until each has been produced on real
+# hardware:
+#   - autotune sweep (fresh per-shape DB incl. flash-backward blocks)
+#   - tuned re-bench of the heavies        (VERDICT r5 items 2 & 3)
+#   - attn_bwd + epoch sequential-gather A/Bs  (items 2 & 3 evidence)
+#   - per-layer LSTM/CIFAR profiles            (item 6)
+#
+#     nohup bash scripts/chip_followup_loop.sh >chip_followup_r5.log 2>&1 &
+#
+# Claim discipline unchanged: one python process per step, no SIGKILL,
+# 10-min backoff between attempts.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-chip_session_logs_r5}
+# tracked evidence target; tests MUST override with a scratch dir (a
+# rehearsal against the default once laundered a fake autotune.json
+# into the committed evidence — caught and reverted same session)
+EVD=${2:-chip_session_r5}
+mkdir -p "$OUT"
+
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-$(python -c \
+    'from veles_tpu.backends import COMPILE_CACHE_DIR; print(COMPILE_CACHE_DIR)' \
+    2>/dev/null || echo "$HOME/.veles_tpu/cache/xla")}
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+export BENCH_TIMEOUT_SCALE=${BENCH_TIMEOUT_SCALE:-4}
+
+note() { echo "[followup $(date +%H:%M:%S)] $*"; }
+
+# FOLLOWUP_DRY_RUN=1: print the would-run command instead of claiming
+# the backend — control-flow tests must NEVER touch the tunnel (a
+# killed mid-claim client can wedge the relay for hours)
+run_leg() {
+    if [ "${FOLLOWUP_DRY_RUN:-0}" = "1" ]; then
+        note "DRY: $*"
+        return 1
+    fi
+    "$@"
+}
+
+# unique output suffix per attempt: never truncate a prior attempt's
+# artifact, even across watcher restarts (code-review r5)
+stamp() { date +%m%d%H%M%S; }
+
+live_lines() {
+    # exit 0 when any of the given jsonl files holds a live (non-
+    # banked) real-hardware line for EVERY metric substring given
+    # after "--".  Case-insensitive "tpu", matching the shared
+    # predicate in bench.py/_banked_tpu_lines and
+    # collect_chip_session.tpu_lines (code-review r5).
+    python - "$@" <<'PY'
+import json
+import sys
+
+paths, needles = [], []
+bucket = paths
+for a in sys.argv[1:]:
+    if a == "--":
+        bucket = needles
+        continue
+    bucket.append(a)
+need = {n: False for n in needles}
+for path in paths:
+    try:
+        lines = open(path).readlines()
+    except OSError:
+        continue
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "tpu" not in (rec.get("device_kind") or "").lower():
+            continue
+        if rec.get("banked") or "error" in rec:
+            continue
+        m = rec.get("metric") or ""
+        for n in need:
+            if n in m:
+                need[n] = True
+sys.exit(0 if need and all(need.values()) else 1)
+PY
+}
+
+tuned_done() {
+    live_lines "$OUT"/bench_tuned*.jsonl -- "fused train throughput"
+}
+
+ab_done() {
+    live_lines "$OUT"/*.jsonl -- "flash-attention backward A/B" \
+        "sequential gather A/B"
+}
+
+autotune_done() {
+    # the dumped DB always contains every previously-measured device
+    # (incl. committed TPU entries) — only the report's _this_run
+    # provenance says what THIS sweep ran on (code-review r5)
+    python - "$OUT"/autotune*.json <<'PY'
+import json
+import sys
+
+for path in sys.argv[1:]:
+    try:
+        rep = json.load(open(path))
+    except (OSError, ValueError):
+        continue
+    kind = (rep.get("_this_run") or {}).get("device_kind") or ""
+    if "tpu" in kind.lower():
+        sys.exit(0)
+sys.exit(1)
+PY
+}
+
+profiles_done() {
+    # chip_session_v2 step 1b artifacts (VERDICT r5 item 6): per-layer
+    # profiles re-banked on the chip.  profile_step stamps the device
+    # kind in the .md header.
+    # case-sensitive: device kinds are "TPU ..."; a case-insensitive
+    # match would hit the substring in the word "ouTPUt"
+    grep -l "TPU" PROFILE_CIFAR.md >/dev/null 2>&1 \
+        && grep -l "TPU" PROFILE_LSTM.md >/dev/null 2>&1
+}
+
+attempt=0
+while true; do
+    if ! autotune_done; then
+        note "autotune artifact missing — attempting sweep"
+        s=$(stamp)
+        run_leg python -m veles_tpu.scripts.autotune \
+            --precision-levels 0,1,2 \
+            >"$OUT/autotune.$s.json" 2>"$OUT/autotune.$s.log" \
+            && note "autotune rc=0" || note "autotune failed"
+    fi
+    if ! profiles_done; then
+        note "per-layer profiles missing — attempting"
+        run_leg python -m veles_tpu.scripts.profile_step \
+            --sample cifar10 \
+            --batch 1024 --per-layer --out PROFILE_CIFAR.md \
+            >>"$OUT/profile_followup.log" 2>&1 \
+            || note "cifar profile failed"
+        run_leg python -m veles_tpu.scripts.profile_step \
+            --sample mnist_rnn \
+            --batch 2048 --out PROFILE_LSTM.md \
+            >>"$OUT/profile_followup.log" 2>&1 \
+            || note "lstm profile failed"
+    fi
+    if autotune_done && ! tuned_done; then
+        note "tuned re-bench missing — attempting"
+        s=$(stamp)
+        BENCH_STAGES=mnist,lstm,transformer,profile_lm,alexnet,alexnet_e2e,alexnet_epoch \
+            BENCH_BUDGET_SEC=3600 \
+            run_leg python bench.py >"$OUT/bench_tuned.$s.jsonl" \
+            2>"$OUT/bench_tuned.$s.log" \
+            && note "re-bench rc=0" || note "re-bench failed"
+    fi
+    if ! ab_done; then
+        note "A/B adjudication lines missing — attempting"
+        s=$(stamp)
+        BENCH_STAGES=attn_bwd,alexnet_epoch_ab BENCH_BUDGET_SEC=2400 \
+            run_leg python bench.py >"$OUT/bench_ab.$s.jsonl" \
+            2>"$OUT/bench_ab.$s.log" \
+            && note "A/B rc=0" || note "A/B failed"
+    fi
+    run_leg python scripts/collect_chip_session.py "$OUT" "$EVD" \
+        >/dev/null 2>&1 || true
+    if autotune_done && tuned_done && ab_done && profiles_done; then
+        note "all artifacts banked — done"
+        exit 0
+    fi
+    attempt=$((attempt + 1))
+    note "attempt $attempt incomplete; retrying in 10 min"
+    sleep 600
+done

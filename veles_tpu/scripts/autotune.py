@@ -17,6 +17,7 @@ file so the whole fleet benefits.
 import argparse
 import json
 import sys
+import time
 
 
 def main(argv=None):
@@ -152,8 +153,17 @@ def main(argv=None):
               file=sys.stderr)
 
     db = DeviceInfo.load_db(db_path)
-    print(json.dumps({m: i.ratings for m, i in db.items()}, indent=2,
-                     sort_keys=True))
+    report = {m: i.ratings for m, i in db.items()}
+    # in-band provenance for THIS run: the dumped DB always contains
+    # every previously-measured device (incl. TPU entries), so a
+    # watcher checking "did the sweep run on real hardware?" must read
+    # which device THIS invocation swept, not grep the whole report
+    # (code-review r5)
+    report["_this_run"] = {"device_kind": model,
+                           "ts": time.time(),
+                           "argv": (sys.argv[1:] if argv is None
+                                    else list(argv))}
+    print(json.dumps(report, indent=2, sort_keys=True))
     return 0
 
 
