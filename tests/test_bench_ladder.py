@@ -511,7 +511,7 @@ def test_parent_cpu_platform_banked_tail_without_headline(monkeypatch,
 # scripts/collect_chip_session.py: evidence snapshots never clobber
 # ---------------------------------------------------------------------------
 
-def test_collector_never_overwrites_prior_window(tmp_path):
+def _load_collector():
     import importlib.util
     spec = importlib.util.spec_from_file_location(
         "collect_chip_session",
@@ -519,6 +519,62 @@ def test_collector_never_overwrites_prior_window(tmp_path):
                      "scripts", "collect_chip_session.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def _run_collector(mod, out, evidence):
+    argv = [sys.argv[0], str(out), str(evidence)]
+    old = sys.argv
+    sys.argv = argv
+    try:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            mod.main()
+    finally:
+        sys.argv = old
+    return buf.getvalue()
+
+
+def test_collector_starved_and_banked_rows_not_current(tmp_path):
+    """EVIDENCE.md must agree with bench._banked_tpu_lines: a newer
+    sample-starved line or a banked echo can never be the row marked
+    current over a substantive measurement (same r4 26.5-img/s
+    incident, evidence-index side)."""
+    mod = _load_collector()
+    out = tmp_path / "outdir"
+    out.mkdir()
+    (out / "bench.jsonl").write_text("\n".join([
+        json.dumps({"metric": "e2e", "value": 7923.6,
+                    "unit": "images/sec", "batches_served": 2175,
+                    "device_kind": "TPU v5 lite", "ts": 100}),
+        json.dumps({"metric": "e2e", "value": 26.5,
+                    "unit": "images/sec", "batches_served": 1,
+                    "device_kind": "TPU v5 lite", "ts": 200}),
+        json.dumps({"metric": "e2e", "value": 26.5,
+                    "unit": "images/sec", "banked": True,
+                    "device_kind": "TPU v5 lite", "ts": 300}),
+        json.dumps({"metric": "only-starved", "value": 3.0,
+                    "unit": "images/sec", "batches_served": 2,
+                    "device_kind": "TPU v5 lite", "ts": 150}),
+    ]) + "\n")
+    evidence = tmp_path / "evidence"
+    text = _run_collector(mod, out, evidence)
+    rows = [l for l in text.splitlines() if l.startswith("| ")]
+    current = [l for l in rows if "**current**" in l]
+    # the substantive line is current; the newer starved line and the
+    # banked echo are explicitly non-quotable; the starved-only metric
+    # is current but flagged
+    assert any("7924" in l or "7923" in l for l in current)
+    assert not any("| 26.5 |" in l and "**current**" in l
+                   for l in rows)
+    assert any("sample-starved" in l and "| 26.5 |" in l for l in rows)
+    assert any("banked echo" in l for l in rows)
+    assert any("LOW CONFIDENCE" in l and "only-starved" in l
+               for l in current)
+
+
+def test_collector_never_overwrites_prior_window(tmp_path):
+    mod = _load_collector()
 
     out = tmp_path / "outdir"
     out.mkdir()
