@@ -48,15 +48,46 @@ void ActRow(Act act, float* row, int64_t n) {
   for (int64_t i = 0; i < n; ++i) row[i] = ApplyAct(act, row[i]);
 }
 
-// out[m,n] = x[m,k]·w[k,n] + b[n]; row-major, i-k-j loop order so the
-// inner loop streams both w and out rows.
+// out[m,n] = x[m,k]·w[k,n] + b[n]; row-major.  Four sample rows ride
+// each streamed w row (4x less L2 traffic on w, four independent FMA
+// chains for the vectorized j loop); per-element accumulation order
+// is unchanged vs the single-row loop, so results are bitwise
+// identical.  The all-zero skip keeps the post-ReLU sparsity win.
 void Gemm(const float* x, const float* w, const float* b, float* out,
           int64_t m, int64_t k, int64_t n, Engine* engine) {
   engine->ParallelFor(m, [&](int64_t begin, int64_t end) {
-    for (int64_t i = begin; i < end; ++i) {
-      float* orow = out + i * n;
+    auto init_row = [&](float* orow) {
       if (b) std::memcpy(orow, b, n * sizeof(float));
       else std::memset(orow, 0, n * sizeof(float));
+    };
+    int64_t i = begin;
+    for (; i + 4 <= end; i += 4) {
+      float* o0 = out + i * n;
+      float* o1 = o0 + n;
+      float* o2 = o1 + n;
+      float* o3 = o2 + n;
+      init_row(o0); init_row(o1); init_row(o2); init_row(o3);
+      const float* x0 = x + i * k;
+      const float* x1 = x0 + k;
+      const float* x2 = x1 + k;
+      const float* x3 = x2 + k;
+      for (int64_t kk = 0; kk < k; ++kk) {
+        float v0 = x0[kk], v1 = x1[kk], v2 = x2[kk], v3 = x3[kk];
+        if (v0 == 0.0f && v1 == 0.0f && v2 == 0.0f && v3 == 0.0f)
+          continue;
+        const float* wrow = w + kk * n;
+        for (int64_t j = 0; j < n; ++j) {
+          float wv = wrow[j];
+          o0[j] += v0 * wv;
+          o1[j] += v1 * wv;
+          o2[j] += v2 * wv;
+          o3[j] += v3 * wv;
+        }
+      }
+    }
+    for (; i < end; ++i) {
+      float* orow = out + i * n;
+      init_row(orow);
       const float* xrow = x + i * k;
       for (int64_t kk = 0; kk < k; ++kk) {
         float xv = xrow[kk];
