@@ -124,8 +124,22 @@ profiles_done() {
         && grep -l "TPU" PROFILE_LSTM.md >/dev/null 2>&1
 }
 
+state() {
+    # one char per done-check; used to collect evidence only when a
+    # cycle actually banked something new (a failed cycle's
+    # cpu-fallback artifacts would otherwise pile junk files into the
+    # tracked evidence dir every ~2h)
+    s=""
+    autotune_done && s="${s}A" || s="${s}-"
+    tuned_done && s="${s}T" || s="${s}-"
+    ab_done && s="${s}B" || s="${s}-"
+    profiles_done && s="${s}P" || s="${s}-"
+    echo "$s"
+}
+
 attempt=0
 while true; do
+    before=$(state)
     if ! autotune_done; then
         note "autotune artifact missing — attempting sweep"
         s=$(stamp)
@@ -164,8 +178,12 @@ while true; do
             2>"$OUT/bench_ab.$s.log" \
             && note "A/B rc=0" || note "A/B failed"
     fi
-    run_leg python scripts/collect_chip_session.py "$OUT" "$EVD" \
-        >/dev/null 2>&1 || true
+    after=$(state)
+    if [ "$after" != "$before" ]; then
+        note "state $before -> $after; collecting evidence"
+        run_leg python scripts/collect_chip_session.py "$OUT" "$EVD" \
+            >/dev/null 2>&1 || true
+    fi
     if autotune_done && tuned_done && ab_done && profiles_done; then
         note "all artifacts banked — done"
         exit 0
