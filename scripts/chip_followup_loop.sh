@@ -164,7 +164,8 @@ while true; do
     if autotune_done && ! tuned_done; then
         note "tuned re-bench missing — attempting"
         s=$(stamp)
-        BENCH_STAGES=mnist,lstm,transformer,profile_lm,alexnet,alexnet_e2e,alexnet_epoch \
+        BENCH_TPU_ONLY=1 \
+            BENCH_STAGES=mnist,lstm,transformer,profile_lm,alexnet,alexnet_e2e,alexnet_epoch \
             BENCH_BUDGET_SEC=3600 \
             run_leg python bench.py >"$OUT/bench_tuned.$s.jsonl" \
             2>"$OUT/bench_tuned.$s.log" \
@@ -173,7 +174,7 @@ while true; do
     if ! ab_done; then
         note "A/B adjudication lines missing — attempting"
         s=$(stamp)
-        BENCH_STAGES=attn_bwd,alexnet_epoch_ab BENCH_BUDGET_SEC=2400 \
+        BENCH_TPU_ONLY=1 BENCH_STAGES=attn_bwd,alexnet_epoch_ab BENCH_BUDGET_SEC=2400 \
             run_leg python bench.py >"$OUT/bench_ab.$s.jsonl" \
             2>"$OUT/bench_ab.$s.log" \
             && note "A/B rc=0" || note "A/B failed"

@@ -267,6 +267,38 @@ def test_parent_falls_back_to_cpu_without_probe(monkeypatch, tmp_path):
     assert "tpu" in lines[-1]["device_kind"].lower()
 
 
+def test_parent_tpu_only_skips_cpu_fallback(monkeypatch, tmp_path):
+    """BENCH_TPU_ONLY: a watcher hunting TPU windows has no use for
+    cpu-fallback lines — on a refused claim the run goes straight to
+    the banked tail (artifact shape preserved, hours of pointless CPU
+    ladder skipped)."""
+    monkeypatch.setattr(bench, "_stream_ladder",
+                        lambda budget, cap: ([], None))
+    cpu_calls = []
+    monkeypatch.setattr(
+        bench, "_run_stage",
+        lambda name, timeout, env=None, grace=300:
+        cpu_calls.append(name) or ({"metric": name, "value": 1.0,
+                                    "unit": "images/sec"}, None))
+    monkeypatch.setattr(bench, "_banked_tpu_lines", lambda: ([
+        {"metric": bench.HEADLINE_METRIC, "value": 12441.0,
+         "unit": "images/sec", "device_kind": "TPU v5 lite",
+         "source": "chip_session_r4/bench.5.jsonl"}], 0))
+    for var in ("BENCH_FORCE_CPU", "BENCH_STAGES",
+                "BENCH_TIMEOUT_SCALE"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("BENCH_TPU_ONLY", "1")
+    monkeypatch.setenv("BENCH_BUDGET_SEC", "600")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    lines = [json.loads(line) for line in
+             buf.getvalue().strip().splitlines()]
+    assert cpu_calls == []                     # no fallback stages ran
+    assert lines[-1]["metric"] == bench.HEADLINE_METRIC
+    assert lines[-1]["banked"] is True
+
+
 def test_stream_ladder_reaps_silent_child(monkeypatch, tmp_path):
     monkeypatch.setattr(bench, "_cache_dir", lambda: str(tmp_path / "xla"))
     monkeypatch.setattr(bench, "_ladder_cmd", lambda: _fake_child_cmd(
