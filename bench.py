@@ -256,7 +256,7 @@ def _banked_tpu_lines():
     starved = {}
     for _key, out in entries:
         mkey = (out["metric"], out["device_kind"])
-        if _sample_starved(out):
+        if sample_starved(out):
             starved[mkey] = out
         else:
             newest[mkey] = out
@@ -277,13 +277,18 @@ def _batch_tag(batch, default):
     return "" if batch == default else " (batch %d)" % batch
 
 
-def _sample_starved(rec):
+def sample_starved(rec):
     """True when the record's own stage diagnosis says it timed almost
     nothing: <= 2 served batches means no steady-state interval ever
     existed (the r4 pathological line served exactly 1).  The cutoff
     is deliberately minimal — a congested-but-alive heavy loop serving
     a handful of slow batches is a legitimate measurement and must
-    keep its power to supersede (code-review r5)."""
+    keep its power to supersede (code-review r5).
+
+    THE canonical predicate (public on purpose):
+    ``scripts/collect_chip_session.py`` and the watcher's
+    ``live_lines()`` (``scripts/chip_followup_loop.sh``) import this
+    instead of hand-copying the rule (ADVICE r5)."""
     served = rec.get("batches_served")
     return isinstance(served, (int, float)) and served <= 2
 
@@ -311,7 +316,7 @@ def _emit_banked_tail(live_records, only=None):
     live_tpu_metrics = {r.get("metric") for r in live_records
                         if "tpu" in (r.get("device_kind") or "").lower()
                         and "error" not in r
-                        and not _sample_starved(r)}
+                        and not sample_starved(r)}
     banked, _superseded = _banked_tpu_lines()
     headlines = []              # one per device kind is possible
     emitted = False
@@ -1977,7 +1982,7 @@ def main():
         # hardware evidence exists (code-review r5)
         starved_live = {r.get("metric") for r in records
                         if "tpu" in (r.get("device_kind") or "").lower()
-                        and _sample_starved(r)}
+                        and sample_starved(r)}
         if starved_live:
             starved_covered, _ = _emit_banked_tail(records,
                                                    only=starved_live)

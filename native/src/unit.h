@@ -63,6 +63,13 @@ class Unit {
   std::string name_;
 };
 
+// out[m,n] = x[m,k]·w[k,n] + b[n] (b may be null → zero init);
+// row-major, 4-row-blocked with a zero-value skip (units.cc).  Exposed
+// for the component tests, which pit the blocked / remainder /
+// zero-skip paths against a naive reference loop.
+void Gemm(const float* x, const float* w, const float* b, float* out,
+          int64_t m, int64_t k, int64_t n, Engine* engine);
+
 class UnitFactory {
  public:
   using Creator = std::function<std::unique_ptr<Unit>(const std::string&)>;

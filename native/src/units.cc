@@ -48,11 +48,15 @@ void ActRow(Act act, float* row, int64_t n) {
   for (int64_t i = 0; i < n; ++i) row[i] = ApplyAct(act, row[i]);
 }
 
+}  // namespace
+
 // out[m,n] = x[m,k]·w[k,n] + b[n]; row-major.  Four sample rows ride
 // each streamed w row (4x less L2 traffic on w, four independent FMA
 // chains for the vectorized j loop); per-element accumulation order
 // is unchanged vs the single-row loop, so results are bitwise
 // identical.  The all-zero skip keeps the post-ReLU sparsity win.
+// At namespace scope (declared in unit.h) so the component tests can
+// pit the blocked/remainder/zero-skip paths against a naive loop.
 void Gemm(const float* x, const float* w, const float* b, float* out,
           int64_t m, int64_t k, int64_t n, Engine* engine) {
   engine->ParallelFor(m, [&](int64_t begin, int64_t end) {
@@ -98,6 +102,8 @@ void Gemm(const float* x, const float* w, const float* b, float* out,
     }
   });
 }
+
+namespace {
 
 Shape ShapeOf(const Json& config, const char* key) {
   Shape s;

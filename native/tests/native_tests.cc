@@ -15,6 +15,7 @@
 #include "../src/json.h"
 #include "../src/memory_optimizer.h"
 #include "../src/npy.h"
+#include "../src/unit.h"
 
 namespace {
 
@@ -41,6 +42,7 @@ int failures = 0;
   } while (0)
 
 using veles_native::Engine;
+using veles_native::Gemm;
 using veles_native::JsonParser;
 using veles_native::LoadNpy;
 using veles_native::MemoryNode;
@@ -154,6 +156,62 @@ void TestMemoryOptimizer() {
   CHECK(MemoryOptimizer::Optimize(&dense) == 256);
 }
 
+void TestGemm() {
+  // The 4-row-blocked kernel vs a naive loop: m in 1..9 sweeps every
+  // blocked/remainder split (0..3 leftover rows), with and without
+  // bias, and with all-zero rows/entries to cover the zero-skip path.
+  // The kernel's per-element accumulation order matches the naive
+  // loop (documented in units.cc), so results must be exactly equal.
+  Engine engine(3);
+  const int64_t k = 7, n = 5;
+  uint32_t state = 0x2545f491u;
+  auto next = [&state]() {  // xorshift; values in roughly [-4, 4)
+    state ^= state << 13;
+    state ^= state >> 17;
+    state ^= state << 5;
+    return static_cast<float>(static_cast<int32_t>(state % 1024) - 512)
+           / 128.0f;
+  };
+  for (int64_t m = 1; m <= 9; ++m) {
+    for (int with_bias = 0; with_bias <= 1; ++with_bias) {
+      std::vector<float> x(m * k), w(k * n), b(n);
+      for (auto& v : x) v = next();
+      for (auto& v : w) v = next();
+      for (auto& v : b) v = next();
+      // all-zero rows exercise the skip in both the blocked path
+      // (rows 0..3) and the remainder path (last row)
+      for (int64_t kk = 0; kk < k; ++kk) x[0 * k + kk] = 0.0f;
+      if (m > 4)
+        for (int64_t kk = 0; kk < k; ++kk) x[(m - 1) * k + kk] = 0.0f;
+      if (m > 1) x[1 * k + 2] = 0.0f;  // scattered zero, live row
+      const float* bias = with_bias ? b.data() : nullptr;
+
+      std::vector<float> ref(m * n);
+      for (int64_t i = 0; i < m; ++i)
+        for (int64_t j = 0; j < n; ++j) {
+          float acc = bias ? bias[j] : 0.0f;
+          for (int64_t kk = 0; kk < k; ++kk)
+            acc += x[i * k + kk] * w[kk * n + j];
+          ref[i * n + j] = acc;
+        }
+
+      std::vector<float> out(m * n, -777.0f);
+      Gemm(x.data(), w.data(), bias, out.data(), m, k, n, &engine);
+      for (int64_t i = 0; i < m * n; ++i) CHECK(out[i] == ref[i]);
+    }
+  }
+
+  // all-zero input: output is exactly the bias (or zeros) everywhere
+  std::vector<float> xz(6 * k, 0.0f), w(k * n), b(n), out(6 * n, 1.0f);
+  for (auto& v : w) v = next();
+  for (auto& v : b) v = next();
+  Gemm(xz.data(), w.data(), b.data(), out.data(), 6, k, n, &engine);
+  for (int64_t i = 0; i < 6; ++i)
+    for (int64_t j = 0; j < n; ++j) CHECK(out[i * n + j] == b[j]);
+  Gemm(xz.data(), w.data(), nullptr, out.data(), 6, k, n, &engine);
+  for (int64_t i = 0; i < 6 * n; ++i) CHECK(out[i] == 0.0f);
+}
+
 void TestEngine() {
   Engine engine(4);
   CHECK(engine.workers() >= 1);
@@ -180,6 +238,7 @@ int main() {
   TestNpy();
   TestJson();
   TestMemoryOptimizer();
+  TestGemm();
   TestEngine();
   if (failures) {
     std::fprintf(stderr, "%d native test check(s) FAILED\n", failures);

@@ -18,6 +18,14 @@ import os
 import shutil
 import sys
 
+# the repo root (bench.py's home) — this script runs both as
+# `python scripts/collect_chip_session.py` (sys.path[0] = scripts/)
+# and via importlib from the tests
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bench import sample_starved  # noqa: E402 - the ONE predicate
+
 
 def tpu_lines(path):
     """Yield (record, line) for every real-hardware JSON line in a
@@ -123,18 +131,15 @@ def main():
     # starved lines (a dying window's ONE-batch e2e "measurement"
     # times the transport, not the framework) never supersede a
     # substantive measurement; a starved line is current only when it
-    # is all there is, flagged low-confidence.
-    def _starved(rec):
-        served = rec.get("batches_served")
-        return isinstance(served, (int, float)) and served <= 2
-
+    # is all there is, flagged low-confidence.  The predicate is
+    # bench.sample_starved — shared, not copied (ADVICE r5).
     newest = {}
     starved_newest = {}
     for i, (rec, name) in enumerate(rows):
         if "error" in rec or rec.get("banked"):
             continue
         key = (rec.get("metric"), rec.get("device_kind"))
-        if _starved(rec):
+        if sample_starved(rec):
             starved_newest[key] = i
         else:
             newest[key] = i
@@ -158,8 +163,8 @@ def main():
             status = "banked echo (provenance, not a measurement)"
         elif newest.get(key) == i:
             status = ("**current** (LOW CONFIDENCE: sample-starved)"
-                      if _starved(rec) else "**current**")
-        elif _starved(rec):
+                      if sample_starved(rec) else "**current**")
+        elif sample_starved(rec):
             j = newest.get(key)
             status = "sample-starved (times the transport, not the " \
                 "framework)%s" % ("; see %s" % rows[j][1]

@@ -1,17 +1,30 @@
-"""RESTful inference endpoint unit.
+"""RESTful inference endpoint unit — thin adapter over veles_tpu.serve.
 
-Parity target: reference ``veles/restful_api.py:78-160`` — an in-workflow
-HTTP endpoint accepting JSON (or base64 numpy) input, feeding it through
-the trained forward pass and returning the model output.  The reference
-pairs it with a ``RestfulLoader``; here the unit drives the forward units
-directly (they are device-resident and reentrant), which removes the
-loader indirection while keeping the same wire contract:
+Parity target: reference ``veles/restful_api.py:78-160`` — an
+in-workflow HTTP endpoint accepting JSON or base64 numpy input, feeding
+it through the trained forward pass and returning the model output.
+The wire contract is unchanged::
 
-    POST /service  {"input": [[...]]}  →  {"result": [[...]]}
+    POST /service  {"input": [[...]]}                    → {"result": [[...]]}
+    POST /service  {"input_b64": ..., "shape": [...]}    → {"result": [[...]]}
+
+Historically this unit ran one un-batched forward per HTTP request
+inside a per-request critical section (swapping the loader's input
+link in and out of the live forward chain).  It is now a thin adapter
+over :mod:`veles_tpu.serve`: the forward chain's pure functions are
+extracted once into an :class:`~veles_tpu.serve.engine.InferenceEngine`
+(AOT-warmed batch buckets, no steady-state recompiles) fronted by a
+:class:`~veles_tpu.serve.batcher.DynamicBatcher`, so concurrent
+requests coalesce into single device calls and the workflow's links
+are never touched — a serving workflow keeps training undisturbed,
+and requests see the live weights (the engine re-reads the forwards'
+params per device call).
+
+For standalone / multi-model / snapshot-fed serving use
+:class:`veles_tpu.serve.ServingServer` directly (docs/services.md
+§ Serving engine); this unit remains the one-liner for exposing a
+workflow you are training right now.
 """
-
-import json
-import threading
 
 import numpy
 
@@ -27,6 +40,23 @@ class RESTfulAPI(Unit):
         self.port = kwargs.get("port", 0)
         self.host = kwargs.get("host", "127.0.0.1")
         self.path = kwargs.get("path", "/service")
+        #: serving knobs forwarded to the engine/batcher (see
+        #: docs/services.md for the full table)
+        self.max_batch_size = kwargs.get("max_batch_size", 64)
+        self.max_wait_ms = kwargs.get("max_wait_ms", 2.0)
+        self.max_queue_rows = kwargs.get("max_queue_rows", 1024)
+        self.buckets = kwargs.get("buckets")
+        #: eager bucket warmup stalls initialize() for one XLA compile
+        #: per bucket — the in-workflow unit defaults to lazy compiles
+        #: (each bucket AOT-compiles on first use), matching the old
+        #: unit's instant start; standalone ServingServer deployments
+        #: default to warmup=True instead
+        self.warmup = kwargs.get("warmup", False)
+        #: live=True re-reads the forwards' weights per device call
+        #: (serve-while-training, the old unit's semantics); pass
+        #: live=False once training is done to skip the per-batch host
+        #: read + device upload of the whole param tree
+        self.live = kwargs.get("live", True)
         self.forwards = None     # list of forward units (linked)
         self._server_ = None
         self.demand("forwards")
@@ -35,83 +65,46 @@ class RESTfulAPI(Unit):
         super(RESTfulAPI, self).init_unpickled()
         self._server_ = None
 
+    @property
+    def engine(self):
+        """The serving engine (None before :meth:`initialize`)."""
+        return (self._server_.registry.get("default").engine
+                if self._server_ is not None else None)
+
+    @property
+    def metrics(self):
+        return self._server_.metrics if self._server_ is not None \
+            else None
+
     def infer(self, batch):
-        """Run the forward chain on a host batch; returns host output.
-        The loader's input link is swapped out for the request and
-        restored, so a serving workflow can keep training."""
-        from veles_tpu.memory import Vector
+        """Run the forward on a host batch; returns host output.
+        Pure-function path: the live units' links and state are not
+        touched, so a serving workflow can keep training."""
+        if self._server_ is None:
+            raise RuntimeError("initialize() the unit before infer()")
         batch = numpy.ascontiguousarray(batch, dtype=numpy.float32)
-        first = self.forwards[0]
-        # the whole swap/run/restore is one critical section —
-        # ThreadingHTTPServer serves requests concurrently
-        with first.data_lock():
-            links = first.__dict__.setdefault("_linked_attrs", {})
-            saved_link = links.pop("input", None)
-            saved_value = first.__dict__.pop("input", None)
-            try:
-                vec = Vector(batch)
-                vec.initialize(first.device)
-                first.input = vec
-                for unit in self.forwards:
-                    unit.run()
-                out = self.forwards[-1].output
-                out.map_read()
-                return numpy.array(out.mem[:len(batch)])
-            finally:
-                first.__dict__.pop("input", None)
-                if saved_link is not None:
-                    links["input"] = saved_link
-                elif saved_value is not None:
-                    first.__dict__["input"] = saved_value
+        return self._server_.registry.infer("default", batch)
 
     def initialize(self, **kwargs):
         super(RESTfulAPI, self).initialize(**kwargs)
         if self._server_ is not None:
             return
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-        api = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_POST(self):
-                if self.path != api.path:
-                    self.send_error(404)
-                    return
-                try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(length))
-                    batch = numpy.asarray(payload["input"],
-                                          dtype=numpy.float32)
-                    if batch.ndim == 1:
-                        batch = batch[None, :]
-                    result = api.infer(batch)
-                    body = json.dumps(
-                        {"result": result.tolist()}).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                except Exception as e:  # noqa: BLE001 - wire boundary
-                    body = json.dumps({"error": str(e)}).encode()
-                    self.send_response(400)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-
-            def log_message(self, fmt, *args):
-                api.debug("http: " + fmt, *args)
-
-        self._server_ = ThreadingHTTPServer((self.host, self.port),
-                                            Handler)
-        self.port = self._server_.server_address[1]
-        thread = threading.Thread(target=self._server_.serve_forever,
-                                  daemon=True, name="restful-api")
-        thread.start()
-        self.info("REST inference on http://%s:%d%s", self.host,
-                  self.port, self.path)
+        from veles_tpu.serve import InferenceEngine, ServingServer
+        engine = InferenceEngine.from_forwards(
+            self.forwards, live=self.live,
+            max_batch_size=self.max_batch_size, buckets=self.buckets)
+        self._server_ = ServingServer(
+            engine=engine, host=self.host, port=self.port,
+            path=self.path, warmup=self.warmup,
+            batcher_config={"max_wait_ms": self.max_wait_ms,
+                            "max_queue_rows": self.max_queue_rows})
+        self._server_.start()
+        self.port = self._server_.port
+        self.info("REST inference on http://%s:%d%s (buckets %s)",
+                  self.host, self.port, self.path,
+                  list(engine.buckets))
 
     def stop(self):
         if self._server_ is not None:
-            self._server_.shutdown()
+            self._server_.stop()
             self._server_ = None

@@ -153,16 +153,21 @@ def main(argv=None):
               file=sys.stderr)
 
     db = DeviceInfo.load_db(db_path)
-    report = {m: i.ratings for m, i in db.items()}
-    # in-band provenance for THIS run: the dumped DB always contains
-    # every previously-measured device (incl. TPU entries), so a
-    # watcher checking "did the sweep run on real hardware?" must read
-    # which device THIS invocation swept, not grep the whole report
-    # (code-review r5)
-    report["_this_run"] = {"device_kind": model,
-                           "ts": time.time(),
-                           "argv": (sys.argv[1:] if argv is None
-                                    else list(argv))}
+    # two-key envelope: the measured DB under "devices", run
+    # provenance under "_this_run" — NOT injected into the
+    # device-model namespace (a hypothetical device kind named
+    # "_this_run" aside, consumers iterating models must not need a
+    # skip-the-magic-key rule; ADVICE r5).  The dumped DB always
+    # contains every previously-measured device (incl. TPU entries),
+    # so a watcher checking "did the sweep run on real hardware?"
+    # reads _this_run, never greps the devices table (code-review r5).
+    report = {
+        "devices": {m: i.ratings for m, i in db.items()},
+        "_this_run": {"device_kind": model,
+                      "ts": time.time(),
+                      "argv": (sys.argv[1:] if argv is None
+                               else list(argv))},
+    }
     print(json.dumps(report, indent=2, sort_keys=True))
     return 0
 

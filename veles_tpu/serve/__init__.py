@@ -1,0 +1,41 @@
+"""veles_tpu.serve — dynamic-batching, AOT-compiled model serving.
+
+The inference half of the platform (the reference ships a dedicated
+runtime, libVeles, separate from the training core; SURVEY §0/§2.8).
+Pieces, each its own module:
+
+- :mod:`engine` — :class:`InferenceEngine`: the trained workflow's pure
+  forward (via ``fused_graph.lower_specs`` or the forward-unit chain),
+  params device-resident, a small set of power-of-two batch buckets
+  AOT-compiled up front so steady-state serving never recompiles.
+- :mod:`batcher` — :class:`DynamicBatcher`: coalesces concurrent
+  requests into one padded device call (``max_batch_size`` /
+  ``max_wait_ms``), fans results back out via per-request futures, and
+  sheds load (:class:`QueueFull` → HTTP 503) instead of stalling.
+- :mod:`registry` — :class:`ModelRegistry`: multiple named models,
+  versions loaded from :mod:`veles_tpu.snapshotter` files, hot-swapped
+  atomically (in-flight batches finish on the old version).
+- :mod:`server` — :class:`ServingServer`: threaded HTTP front-end with
+  the classic ``POST /service {"input": ...} → {"result": ...}`` wire
+  contract plus ``/healthz`` and a text ``/metrics`` endpoint.
+- :mod:`metrics` — :class:`ServingMetrics`: QPS, queue depth,
+  batch-fill ratio and latency percentiles, also publishable to the
+  existing :mod:`veles_tpu.web_status` service.
+- :mod:`wire` — request decoding (JSON lists or base64 numpy).
+
+``veles_tpu.restful_api.RESTfulAPI`` is a thin in-workflow adapter over
+these parts; new deployments should drive :class:`ServingServer`
+directly (see ``docs/services.md`` § Serving engine).
+"""
+
+from veles_tpu.serve.batcher import DynamicBatcher, QueueFull
+from veles_tpu.serve.engine import InferenceEngine
+from veles_tpu.serve.metrics import ServingMetrics
+from veles_tpu.serve.registry import ModelRegistry
+from veles_tpu.serve.server import ServingServer
+from veles_tpu.serve.wire import decode_input
+
+__all__ = [
+    "DynamicBatcher", "InferenceEngine", "ModelRegistry", "QueueFull",
+    "ServingMetrics", "ServingServer", "decode_input",
+]

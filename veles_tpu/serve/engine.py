@@ -1,0 +1,343 @@
+"""InferenceEngine: a trained model's pure forward, AOT-warmed.
+
+The serving counterpart of :func:`veles_tpu.znicz.fused_graph
+.lower_specs`: the training side lowers a workflow to one jitted train
+step; here the same lowering's ``apply_fn`` (or, for workflows built
+without layer specs, the forward units' own pure functions) becomes the
+single device call the platform serves from.
+
+Three properties production serving needs that the in-workflow
+``RESTfulAPI.infer`` critical section could not give:
+
+1. **Pure + reentrant** — no link swapping, no unit state, so any
+   number of batcher threads may hold a reference while a hot-swap
+   installs a successor engine.
+2. **Params device-resident** — weights are ``jax.device_put`` once at
+   construction, not re-uploaded per request.
+3. **No steady-state compiles** — a small set of power-of-two batch
+   *buckets* is AOT-compiled by :meth:`warmup` (``jit.lower(...)
+   .compile()``); every request batch is padded up to the nearest
+   bucket, so XLA sees only shapes it has already compiled.
+   :attr:`compile_count` exposes the exact number of compiles for
+   monitoring (and the no-recompile-after-warmup test gate).
+
+Bucket padding is value-safe for inference graphs: every serving
+forward here is row-independent (dense/conv/activation/softmax are
+per-sample; LRN normalizes across channels, not batch; dropout-style
+units declare ``SKIP_AT_EVAL``), so the padded rows cannot bleed into
+real rows and the sliced result is byte-identical to the un-batched
+forward — asserted in ``tests/test_serve.py``.
+"""
+
+import threading
+
+import numpy
+
+from veles_tpu.logger import Logger
+
+
+def _power_of_two_buckets(max_batch_size):
+    buckets = []
+    b = 1
+    while b < max_batch_size:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch_size)
+    return tuple(buckets)
+
+
+class InferenceEngine(Logger):
+    """Pure forward + device-resident params + AOT-warmed batch buckets.
+
+    ``apply_fn(params, x)`` must be traceable by ``jax.jit`` and
+    row-independent.  ``params`` is any pytree (the lowering's
+    per-layer list of dicts, stripped to inference keys).
+
+    ``params_source``: optional 0-arg callable returning fresh *host*
+    params — the serve-while-training mode the in-workflow adapter
+    uses: every device call re-installs the current weights, so a
+    training loop's progress is visible to clients without rebuilding
+    the engine (shapes must stay fixed; a topology change needs a new
+    engine + registry hot-swap).
+    """
+
+    def __init__(self, params, apply_fn, sample_shape,
+                 max_batch_size=64, buckets=None, params_source=None,
+                 **kwargs):
+        super(InferenceEngine, self).__init__(**kwargs)
+        import jax
+        self._jax = jax
+        self.sample_shape = tuple(int(d) for d in sample_shape)
+        self.max_batch_size = int(max_batch_size)
+        if self.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self.buckets = tuple(sorted(set(
+            int(b) for b in (buckets
+                             or _power_of_two_buckets(
+                                 self.max_batch_size)))))
+        if self.buckets[-1] != self.max_batch_size:
+            raise ValueError(
+                "largest bucket %d must equal max_batch_size %d"
+                % (self.buckets[-1], self.max_batch_size))
+        self.params_source = params_source
+        self._params = jax.device_put(params)
+        self._jit = jax.jit(apply_fn)
+        self._compiled = {}          # batch size -> AOT executable
+        self._compile_lock = threading.Lock()
+        self.compile_count = 0
+        self.infer_calls = 0         # device calls (monitoring)
+
+    # -- constructors -----------------------------------------------------
+    @classmethod
+    def from_workflow(cls, workflow, sample_shape=None, **kwargs):
+        """Engine from a (trained or snapshot-loaded) workflow.
+
+        Primary path: re-lower the workflow's layer specs through
+        ``fused_graph.lower_specs`` with the trained weights injected
+        as ``init``, and serve its ``apply_fn``.  Workflows built
+        without specs (hand-linked graphs) fall back to
+        :meth:`from_forwards` over their forward-unit chain.
+        """
+        fused_trainer = getattr(workflow, "fused_trainer", None)
+        if fused_trainer is not None:
+            # trained params live on the trainer's device tree; push
+            # them into the forwards' Vectors before reading
+            fused_trainer.sync_weights()
+        specs = getattr(workflow, "layers", None)
+        forwards = getattr(workflow, "forwards", None)
+        if not forwards:
+            raise ValueError("workflow has no forward units to serve")
+        if sample_shape is None:
+            sample_shape = cls._infer_sample_shape(workflow, forwards)
+        if not specs or len(specs) != len(forwards):
+            return cls.from_forwards(forwards,
+                                     sample_shape=sample_shape,
+                                     **kwargs)
+        from veles_tpu.znicz.fused_graph import lower_specs
+        lowered_specs = []
+        for spec, unit in zip(specs, forwards):
+            spec = {k: v for k, v in spec.items() if k != "init"}
+            init = {}
+            if unit.weights:
+                unit.weights.map_read()
+                init["weights"] = numpy.array(unit.weights.mem)
+            if getattr(unit, "bias", None) and unit.bias:
+                unit.bias.map_read()
+                init["bias"] = numpy.array(unit.bias.mem)
+            if init:
+                spec["init"] = init
+            lowered_specs.append(spec)
+        params, _step, _eval, apply_fn = lower_specs(
+            lowered_specs, sample_shape)
+        params = [
+            {k: v for k, v in state.items()
+             if k in ("w", "b", "seed") and v is not None}
+            for state in params]
+        return cls(params, lambda p, x: apply_fn(p, x, train=False),
+                   sample_shape, **kwargs)
+
+    @classmethod
+    def from_forwards(cls, forwards, sample_shape=None, live=False,
+                      **kwargs):
+        """Engine straight from live forward units (the fallback /
+        adapter path): compose each unit's ``pure`` with its static
+        ``pure_config``; params come from ``pure_params(host=True)``.
+
+        ``live=True`` keeps reading the units' weights on every device
+        call (serve-while-training, see ``params_source``).
+        """
+        forwards = list(forwards)
+        if not forwards:
+            raise ValueError("empty forward chain")
+        unservable = [u for u in forwards
+                      if not (callable(getattr(type(u), "pure", None))
+                              and callable(getattr(u, "pure_config",
+                                                   None))
+                              and callable(getattr(u, "pure_params",
+                                                   None)))]
+        if unservable:
+            raise ValueError(
+                "forward unit(s) %s lack the pure-function protocol "
+                "(a static `pure(params, x, **config)` plus "
+                "`pure_config()`/`pure_params()`) and cannot be "
+                "served by the batching engine — keep such workflows "
+                "on a custom serving path" %
+                ", ".join(type(u).__name__ for u in unservable))
+        stages = tuple(
+            (type(u).pure, dict(u.pure_config()),
+             bool(getattr(type(u), "SKIP_AT_EVAL", False)))
+            for u in forwards)
+
+        def read_params():
+            # the old RESTfulAPI critical section, kept: serialize the
+            # read against a concurrently-training thread (and the job
+            # layer's data exchange, which takes the same lock) —
+            # without it a mid-update map_read can mark a stale host
+            # copy fresh and serve pre-update weights forever
+            import contextlib
+            lock = getattr(forwards[0], "data_lock", None)
+            with lock() if lock is not None \
+                    else contextlib.nullcontext():
+                for u in forwards:
+                    # host copies may be stale after device training
+                    if getattr(u, "weights", None) and u.weights:
+                        u.weights.map_read()
+                    if getattr(u, "bias", None) and u.bias:
+                        u.bias.map_read()
+                return [dict(u.pure_params(host=True))
+                        for u in forwards]
+
+        def apply_fn(params_list, x):
+            h = x
+            for (pure, config, skip_at_eval), p in zip(stages,
+                                                       params_list):
+                if skip_at_eval:
+                    continue
+                h = pure(p, h, **config)
+            return h
+
+        if sample_shape is None:
+            sample_shape = cls._infer_sample_shape(None, forwards)
+        return cls(read_params(), apply_fn, sample_shape,
+                   params_source=read_params if live else None,
+                   **kwargs)
+
+    @classmethod
+    def from_snapshot(cls, path, **kwargs):
+        """Engine from a :mod:`veles_tpu.snapshotter` artifact (local
+        path, ``http(s)://`` URL or ``db://`` row)."""
+        from veles_tpu.snapshotter import load_snapshot
+        return cls.from_workflow(load_snapshot(path), **kwargs)
+
+    @staticmethod
+    def _infer_sample_shape(workflow, forwards):
+        first = forwards[0]
+        inp = getattr(first, "input", None)
+        shape = getattr(inp, "shape", None)
+        if shape and len(shape) > 1:
+            return tuple(shape[1:])
+        if workflow is not None:
+            loader = getattr(workflow, "loader", None)
+            data = getattr(loader, "minibatch_data", None)
+            shape = getattr(data, "shape", None)
+            if shape and len(shape) > 1:
+                return tuple(shape[1:])
+        raise ValueError(
+            "cannot infer sample_shape from the forward chain — pass "
+            "sample_shape=(...) explicitly")
+
+    # -- compilation ------------------------------------------------------
+    def _bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _executable(self, batch_size):
+        exe = self._compiled.get(batch_size)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._compiled.get(batch_size)
+            if exe is not None:
+                return exe
+            jax = self._jax
+            spec = jax.ShapeDtypeStruct(
+                (batch_size,) + self.sample_shape, numpy.float32)
+            params_spec = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._params)
+            exe = self._jit.lower(params_spec, spec).compile()
+            self.compile_count += 1
+            self.debug("compiled bucket %d (compile #%d)", batch_size,
+                       self.compile_count)
+            self._compiled[batch_size] = exe
+        return exe
+
+    def warmup(self):
+        """AOT-compile every bucket; returns self (chainable).  After
+        this, serving any batch size never triggers a compile."""
+        for b in self.buckets:
+            self._executable(b)
+        return self
+
+    def padded_capacity(self, n):
+        """Total bucket rows a batch of ``n`` occupies on the device
+        (splitting beyond ``max_batch_size`` included) — the
+        denominator of an honest batch-fill ratio."""
+        capacity = 0
+        while n > 0:
+            chunk = min(n, self.max_batch_size)
+            capacity += self._bucket_for(chunk)
+            n -= chunk
+        return capacity
+
+    def _out_struct(self):
+        """Cached (shape, dtype) of one bucket-1 output, via
+        ``jax.eval_shape`` — no device work."""
+        struct = getattr(self, "_out_struct_", None)
+        if struct is None:
+            jax = self._jax
+            spec = jax.ShapeDtypeStruct((1,) + self.sample_shape,
+                                        numpy.float32)
+            params_spec = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._params)
+            out = jax.eval_shape(self._jit, params_spec, spec)
+            struct = self._out_struct_ = (tuple(out.shape[1:]),
+                                          numpy.dtype(str(out.dtype)))
+        return struct
+
+    # -- serving ----------------------------------------------------------
+    def update_params(self, params):
+        """Install new host params (same tree structure/shapes).  The
+        swap is a single reference assignment: concurrent ``infer``
+        calls see either the old or the new tree, never a mix."""
+        self._params = self._jax.device_put(params)
+
+    def infer(self, batch):
+        """Host batch → host float32 outputs, same leading length.
+
+        Pads up to the nearest warmed bucket; batches beyond
+        ``max_batch_size`` are served in max-bucket chunks.
+        """
+        batch = numpy.ascontiguousarray(batch, dtype=numpy.float32)
+        if batch.ndim == 1:
+            batch = batch[None, :]
+        if batch.shape[1:] != self.sample_shape:
+            raise ValueError("sample shape %s does not match engine %s"
+                             % (batch.shape[1:], self.sample_shape))
+        n = len(batch)
+        if n == 0:
+            # statically known answer: no params refresh, no device call
+            shape, dtype = self._out_struct()
+            return numpy.zeros((0,) + shape, dtype)
+        if self.params_source is not None:
+            self.update_params(self.params_source())
+        pieces = []
+        for start in range(0, n, self.max_batch_size):
+            pieces.append(self._infer_chunk(
+                batch[start:start + self.max_batch_size]))
+        return pieces[0] if len(pieces) == 1 else \
+            numpy.concatenate(pieces)
+
+    def reference_forward(self, batch):
+        """The un-padded jitted forward at the batch's exact shape —
+        the verification oracle bucket padding is measured against
+        (``tests/test_serve.py`` asserts byte-identity).  Compiles per
+        exact shape, so this is NOT a serving path."""
+        batch = numpy.ascontiguousarray(batch, dtype=numpy.float32)
+        return numpy.asarray(self._jit(self._params, batch))
+
+    def _infer_chunk(self, chunk):
+        n = len(chunk)
+        bucket = self._bucket_for(n)
+        if n != bucket:
+            padded = numpy.zeros((bucket,) + self.sample_shape,
+                                 numpy.float32)
+            padded[:n] = chunk
+            chunk = padded
+        exe = self._executable(bucket)
+        self.infer_calls += 1
+        out = numpy.asarray(exe(self._params, chunk))
+        return out[:n]

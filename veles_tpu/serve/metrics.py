@@ -1,0 +1,213 @@
+"""Serving metrics: QPS, queue depth, batch-fill ratio, latency
+percentiles.
+
+One :class:`ServingMetrics` instance is shared by the batcher (batch
+stats, per-request latency) and the HTTP front-end (shed counts); it
+renders both a Prometheus-style text page (``GET /metrics``) and a JSON
+snapshot the existing :mod:`veles_tpu.web_status` service can ingest
+(``ServingServer.notify_status``).
+
+The histogram is fixed-boundary and log-spaced (60 µs … 60 s), so
+recording is O(1), lock-cheap and allocation-free; percentiles
+interpolate within the winning bucket — the standard serving-monitor
+trade (exactness of a full reservoir is not worth its churn at QPS).
+"""
+
+import bisect
+import collections
+import threading
+import time
+
+
+def _log_bounds(lo=6e-5, hi=60.0, per_decade=5):
+    bounds = []
+    value = lo
+    factor = 10.0 ** (1.0 / per_decade)
+    while value < hi:
+        bounds.append(value)
+        value *= factor
+    bounds.append(hi)
+    return bounds
+
+
+class LatencyHistogram(object):
+    """Fixed log-spaced buckets; thread-safe record + percentile."""
+
+    BOUNDS = _log_bounds()
+
+    def __init__(self):
+        self._counts = [0] * (len(self.BOUNDS) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def record(self, seconds):
+        idx = bisect.bisect_left(self.BOUNDS, seconds)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += seconds
+            self._n += 1
+
+    @property
+    def count(self):
+        return self._n
+
+    @property
+    def mean(self):
+        return self._sum / self._n if self._n else 0.0
+
+    def percentile(self, q):
+        """q in [0, 100] → seconds (interpolated inside the bucket)."""
+        with self._lock:
+            counts, n = list(self._counts), self._n
+        if not n:
+            return 0.0
+        target = q / 100.0 * n
+        seen = 0
+        for idx, c in enumerate(counts):
+            if seen + c >= target and c:
+                lo = self.BOUNDS[idx - 1] if idx else 0.0
+                hi = self.BOUNDS[idx] if idx < len(self.BOUNDS) \
+                    else self.BOUNDS[-1]
+                frac = (target - seen) / c
+                return lo + (hi - lo) * frac
+            seen += c
+        return self.BOUNDS[-1]
+
+
+class ServingMetrics(object):
+    """Aggregate serving counters + histograms (shared, thread-safe)."""
+
+    #: sliding QPS window (seconds)
+    QPS_WINDOW = 10.0
+
+    def __init__(self):
+        self.started = time.time()
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.rows_total = 0
+        self.errors_total = 0
+        self.shed_total = 0          # 503s (QueueFull)
+        self.batches_total = 0
+        self.batch_rows_total = 0
+        self.batch_capacity_total = 0
+        self.request_latency = LatencyHistogram()
+        self.batch_latency = LatencyHistogram()
+        self._recent = collections.deque(maxlen=65536)  # completion ts
+        #: gauge callables registered by owners (queue depth, model
+        #: count, compile count, ...) — read at snapshot time
+        self._gauges = {}
+
+    # -- recording --------------------------------------------------------
+    def observe_request(self, latency_s, rows=1, error=False):
+        with self._lock:
+            self.requests_total += 1
+            self.rows_total += rows
+            if error:
+                self.errors_total += 1
+            self._recent.append(time.time())
+        self.request_latency.record(latency_s)
+
+    def record_batch(self, rows, capacity, latency_s):
+        with self._lock:
+            self.batches_total += 1
+            self.batch_rows_total += rows
+            self.batch_capacity_total += capacity
+        self.batch_latency.record(latency_s)
+
+    def record_shed(self):
+        with self._lock:
+            self.shed_total += 1
+
+    def register_gauge(self, name, fn):
+        """Register a 0-arg callable polled at snapshot/render time."""
+        with self._lock:
+            self._gauges[name] = fn
+
+    def unregister_gauge(self, name):
+        """Drop a gauge (stopped registries/batchers must not leave
+        stale callables keeping dead engines alive)."""
+        with self._lock:
+            self._gauges.pop(name, None)
+
+    def _gauge_items(self):
+        with self._lock:   # a deploy may register mid-scrape
+            return list(self._gauges.items())
+
+    # -- reading ----------------------------------------------------------
+    def qps(self, window=None):
+        window = window or self.QPS_WINDOW
+        cutoff = time.time() - window
+        with self._lock:
+            n = sum(1 for t in self._recent if t >= cutoff)
+        return n / window
+
+    def batch_fill_ratio(self):
+        with self._lock:
+            if not self.batch_capacity_total:
+                return 0.0
+            return self.batch_rows_total / self.batch_capacity_total
+
+    def snapshot(self):
+        """JSON-ready dict — also the web_status payload shape."""
+        data = {
+            "uptime_sec": round(time.time() - self.started, 3),
+            "qps": round(self.qps(), 3),
+            "requests_total": self.requests_total,
+            "rows_total": self.rows_total,
+            "errors_total": self.errors_total,
+            "shed_total": self.shed_total,
+            "batches_total": self.batches_total,
+            "batch_fill_ratio": round(self.batch_fill_ratio(), 4),
+            "latency_ms": {
+                "mean": round(self.request_latency.mean * 1e3, 3),
+                "p50": round(self.request_latency.percentile(50) * 1e3,
+                             3),
+                "p95": round(self.request_latency.percentile(95) * 1e3,
+                             3),
+                "p99": round(self.request_latency.percentile(99) * 1e3,
+                             3),
+            },
+            "batch_latency_ms": {
+                "mean": round(self.batch_latency.mean * 1e3, 3),
+                "p50": round(self.batch_latency.percentile(50) * 1e3, 3),
+                "p95": round(self.batch_latency.percentile(95) * 1e3, 3),
+            },
+        }
+        for name, fn in self._gauge_items():
+            try:
+                data[name] = fn()
+            except Exception:
+                pass
+        return data
+
+    def render_text(self):
+        """Prometheus-style exposition (the ``/metrics`` page)."""
+        snap = self.snapshot()
+        lines = []
+
+        def emit(name, value, help_=None):
+            if help_:
+                lines.append("# HELP veles_serve_%s %s" % (name, help_))
+            lines.append("veles_serve_%s %s" % (name, value))
+
+        emit("uptime_seconds", snap["uptime_sec"])
+        emit("qps", snap["qps"],
+             "completed requests/sec over the last %ds window"
+             % int(self.QPS_WINDOW))
+        emit("requests_total", snap["requests_total"])
+        emit("rows_total", snap["rows_total"])
+        emit("errors_total", snap["errors_total"])
+        emit("shed_total", snap["shed_total"],
+             "requests rejected with 503 (queue full)")
+        emit("batches_total", snap["batches_total"])
+        emit("batch_fill_ratio", snap["batch_fill_ratio"],
+             "served rows / summed bucket capacity")
+        for key, value in snap["latency_ms"].items():
+            emit("request_latency_ms{quantile=\"%s\"}" % key, value)
+        for key, value in snap["batch_latency_ms"].items():
+            emit("batch_latency_ms{quantile=\"%s\"}" % key, value)
+        for name, _fn in self._gauge_items():
+            if name in snap:
+                emit(name, snap[name])
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,190 @@
+"""ModelRegistry: named models, snapshot loading, atomic hot swap.
+
+The multi-model layer of the serving engine: each name owns ONE
+:class:`~veles_tpu.serve.batcher.DynamicBatcher` (so queued requests
+survive a version change) and a current
+:class:`~veles_tpu.serve.engine.InferenceEngine`.  Deploying a new
+version is one attribute assignment on the batcher — requests already
+inside a device call finish on the old engine; every batch formed
+after the swap runs on the new one.  Old engines need no teardown
+(they are plain objects holding device arrays; the GC reclaims them
+once the last in-flight batch drops its reference).
+
+Versions come from anywhere an engine can be built: a live workflow, a
+forward-unit chain, or a :mod:`veles_tpu.snapshotter` artifact (local
+path / ``http(s)://`` URL / ``db://`` row) — the trained-model hand-off
+the Snapshotter side of the platform already produces.
+"""
+
+import threading
+import time
+
+from veles_tpu.logger import Logger
+from veles_tpu.serve.batcher import DynamicBatcher
+from veles_tpu.serve.engine import InferenceEngine
+
+
+class _Model(object):
+    """One served name: stable batcher + swappable engine + metadata."""
+
+    __slots__ = ("name", "batcher", "version", "deployed_at", "swaps",
+                 "source")
+
+    def __init__(self, name, batcher):
+        self.name = name
+        self.batcher = batcher
+        self.version = None
+        self.deployed_at = None
+        self.swaps = 0
+        self.source = None
+
+    @property
+    def engine(self):
+        return self.batcher.engine
+
+    def describe(self):
+        return {
+            "name": self.name,
+            "version": self.version,
+            "deployed_at": self.deployed_at,
+            "swaps": self.swaps,
+            "source": self.source,
+            "buckets": list(self.engine.buckets),
+            "compile_count": self.engine.compile_count,
+            "queue_depth": self.batcher.queue_depth(),
+        }
+
+
+class ModelRegistry(Logger):
+    """Name → model map with atomic deploy/swap (thread-safe)."""
+
+    def __init__(self, metrics=None, batcher_config=None, **kwargs):
+        super(ModelRegistry, self).__init__(**kwargs)
+        self.metrics = metrics
+        self.batcher_config = dict(batcher_config or {})
+        self._models = {}
+        self._lock = threading.Lock()
+        if metrics is not None:
+            metrics.register_gauge("models", lambda: len(self._models))
+
+    def attach_metrics(self, metrics):
+        """Adopt a metrics sink after construction (the ServingServer
+        path when it is handed a metrics-less registry): existing AND
+        future batchers record into it, so /metrics never silently
+        reports zeros under real traffic."""
+        self.metrics = metrics
+        metrics.register_gauge("models", lambda: len(self._models))
+        with self._lock:
+            for name, model in self._models.items():
+                model.batcher.metrics = metrics
+                metrics.register_gauge(
+                    'queue_depth{model="%s"}' % name,
+                    model.batcher.queue_depth)
+
+    def deploy(self, name, engine, version=None, source=None,
+               warmup=True, allow_reshape=False):
+        """Install ``engine`` as the current version of ``name``.
+
+        First deploy for a name creates its batcher; later deploys
+        hot-swap the engine atomically — in-flight batches finish on
+        the previous version, the queue is preserved.  ``warmup=True``
+        AOT-compiles the new engine's buckets BEFORE the swap, so the
+        first post-swap batch pays zero compile latency.
+
+        A swap that CHANGES the model's sample shape is refused unless
+        ``allow_reshape=True`` (queued old-shape requests cannot be
+        honored by the new engine — deploy a different topology under
+        a new name, or opt in and let those requests fail with a shape
+        error while new-shape traffic proceeds).
+        """
+        if warmup:
+            engine.warmup()
+        with self._lock:
+            model = self._models.get(name)
+            if model is None:
+                batcher = DynamicBatcher(
+                    engine, metrics=self.metrics,
+                    gauge_name='queue_depth{model="%s"}' % name,
+                    **self.batcher_config)
+                model = _Model(name, batcher)
+                self._models[name] = model
+            else:
+                old_shape = getattr(model.engine, "sample_shape", None)
+                new_shape = getattr(engine, "sample_shape", None)
+                if (not allow_reshape
+                        and old_shape is not None
+                        and new_shape is not None
+                        and tuple(old_shape) != tuple(new_shape)):
+                    raise ValueError(
+                        "hot swap of %r changes sample shape %s -> %s;"
+                        " deploy under a new name or pass "
+                        "allow_reshape=True" % (name, tuple(old_shape),
+                                                tuple(new_shape)))
+                model.batcher.engine = engine   # THE hot swap
+                model.swaps += 1
+            model.version = version if version is not None \
+                else (model.swaps + 1)
+            model.deployed_at = time.time()
+            model.source = source
+        self.info("deployed %s version %s%s", name, model.version,
+                  " (hot swap #%d)" % model.swaps if model.swaps
+                  else "")
+        return model
+
+    def load_snapshot(self, name, path, version=None, engine_config=None,
+                      warmup=True):
+        """Build an engine from a snapshot artifact and deploy it."""
+        engine = InferenceEngine.from_snapshot(
+            path, **dict(engine_config or {}))
+        return self.deploy(name, engine, version=version, source=path,
+                           warmup=warmup)
+
+    def load_workflow(self, name, workflow, version=None,
+                      engine_config=None, warmup=True):
+        engine = InferenceEngine.from_workflow(
+            workflow, **dict(engine_config or {}))
+        return self.deploy(name, engine, version=version,
+                           source=type(workflow).__name__,
+                           warmup=warmup)
+
+    def get(self, name):
+        model = self._models.get(name)
+        if model is None:
+            raise KeyError("no model %r (serving: %s)"
+                           % (name, ", ".join(sorted(self._models))
+                              or "<none>"))
+        return model
+
+    def __contains__(self, name):
+        return name in self._models
+
+    def names(self):
+        with self._lock:   # a first deploy may be inserting a key
+            return sorted(self._models)
+
+    def describe(self):
+        with self._lock:
+            models = dict(self._models)
+        return {name: model.describe()
+                for name, model in sorted(models.items())}
+
+    def submit(self, name, rows):
+        """Queue rows on ``name``'s batcher; returns the Future."""
+        return self.get(name).batcher.submit(rows)
+
+    def infer(self, name, rows, timeout=30.0):
+        return self.submit(name, rows).result(timeout)
+
+    def stop(self, drain=True):
+        with self._lock:
+            models, self._models = dict(self._models), {}
+        if self.metrics is not None:
+            # a shared sink outlives this registry: stale gauges would
+            # keep reporting dead models (and pin their engines' device
+            # params against GC)
+            self.metrics.unregister_gauge("models")
+            for name in models:
+                self.metrics.unregister_gauge(
+                    'queue_depth{model="%s"}' % name)
+        for model in models.values():
+            model.batcher.stop(drain=drain)

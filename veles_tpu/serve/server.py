@@ -1,0 +1,217 @@
+"""ServingServer: threaded HTTP front-end over the serving registry.
+
+Wire contract (unchanged from the in-workflow ``RESTfulAPI``)::
+
+    POST /service            {"input": [[...]]}  →  {"result": [[...]]}
+    POST /service/<model>    same, for a named registry entry
+
+plus the operational surface a production service needs:
+
+    GET /healthz   → {"status": "ok", "models": {...}}   (200/503)
+    GET /metrics   → text/plain Prometheus-style counters
+
+Requests may also carry base64 numpy input (``{"input_b64": ...,
+"shape": [...], "dtype": "float32"}`` — :mod:`veles_tpu.serve.wire`).
+Error mapping: malformed request → 400 with ``{"error": ...}``;
+unknown model → 404; full batch queue → **503 + Retry-After** (the
+batcher sheds load instead of queueing without bound).
+
+The handler thread only parses/serializes; all device work happens on
+the model's batcher worker, so N concurrent HTTP threads coalesce into
+bucket-sized device calls.
+"""
+
+import json
+import threading
+import time
+from concurrent.futures import TimeoutError as FuturesTimeout
+
+from veles_tpu.logger import Logger
+from veles_tpu.serve.batcher import QueueFull
+from veles_tpu.serve.metrics import ServingMetrics
+from veles_tpu.serve.registry import ModelRegistry
+from veles_tpu.serve.wire import decode_input
+
+DEFAULT_MODEL = "default"
+
+
+class ServingServer(Logger):
+    """HTTP front-end; owns (or shares) a registry + metrics."""
+
+    def __init__(self, registry=None, engine=None, host="127.0.0.1",
+                 port=0, path="/service", metrics=None,
+                 request_timeout=30.0, batcher_config=None,
+                 warmup=True, **kwargs):
+        super(ServingServer, self).__init__(**kwargs)
+        self.metrics = metrics or (registry.metrics if registry is not
+                                   None and registry.metrics is not None
+                                   else ServingMetrics())
+        if registry is None:
+            registry = ModelRegistry(metrics=self.metrics,
+                                     batcher_config=batcher_config)
+        else:
+            if registry.metrics is not self.metrics:
+                # a handed-in registry without (or with a different)
+                # sink: wire its batchers into THIS server's metrics
+                # so the /metrics page reflects actual traffic
+                registry.attach_metrics(self.metrics)
+            if batcher_config:
+                # applies to FUTURE deploys only — say so instead of
+                # silently dropping the knobs
+                registry.batcher_config = dict(batcher_config)
+                if registry.names():
+                    self.warning(
+                        "batcher_config applies to future deploys; "
+                        "already-deployed models (%s) keep their "
+                        "existing queue/batch knobs",
+                        ", ".join(registry.names()))
+        self.registry = registry
+        if engine is not None:
+            self.registry.deploy(DEFAULT_MODEL, engine, warmup=warmup)
+        self.host = host
+        self.port = port
+        self.path = path.rstrip("/") or "/service"
+        self.request_timeout = float(request_timeout)
+        self._httpd = None
+        self._thread = None
+
+    # -- request handling --------------------------------------------------
+    def _model_for(self, url_path):
+        """``/service`` → default model; ``/service/<name>`` → name."""
+        if url_path == self.path:
+            return self.registry.get(DEFAULT_MODEL)
+        prefix = self.path + "/"
+        if url_path.startswith(prefix):
+            return self.registry.get(url_path[len(prefix):])
+        raise LookupError("no route %r" % url_path)
+
+    def handle_predict(self, url_path, body):
+        """(status, payload dict) for one POST — transport-free core,
+        shared with tests and reusable behind other front-ends."""
+        try:
+            model = self._model_for(url_path)
+        except KeyError as e:         # registry miss (before its
+            return 404, {"error": e.args[0]}   # LookupError parent)
+        except LookupError as e:      # no such route
+            return 404, {"error": str(e)}
+        # captured BEFORE the device call: a concurrent hot swap must
+        # not relabel this result with the successor's version
+        version = model.version
+        try:
+            batch = decode_input(json.loads(body))
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        except Exception as e:  # malformed JSON etc.
+            return 400, {"error": "bad request: %s" % e}
+        try:
+            future = model.batcher.submit(batch)
+        except QueueFull as e:
+            return 503, {"error": str(e),
+                         "retry_after": QueueFull.retry_after}
+        except ValueError as e:       # sample-shape mismatch
+            return 400, {"error": str(e)}
+        try:
+            result = future.result(self.request_timeout)
+        except FuturesTimeout:
+            # give the batcher the chance to skip the abandoned
+            # request entirely (no device call for a client that is
+            # no longer listening); a started batch still finishes
+            future.cancel()
+            return 504, {"error": "inference timed out after %.1fs"
+                         % self.request_timeout}
+        except Exception as e:  # noqa: BLE001 - wire boundary
+            return 500, {"error": "inference failed: %s" % e}
+        return 200, {"result": result.tolist(),
+                     "model": model.name, "version": version}
+
+    def healthz(self):
+        ok = bool(self.registry.names())
+        return (200 if ok else 503), {
+            "status": "ok" if ok else "no models deployed",
+            "uptime_sec": round(time.time() - self.metrics.started, 3),
+            "models": self.registry.describe(),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._httpd is not None:
+            return self
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _reply(self, status, body, content_type):
+                self.send_response(status)
+                if status == 503 and b"retry_after" in body:
+                    self.send_header("Retry-After",
+                                     str(QueueFull.retry_after))
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, status, payload):
+                self._reply(status, json.dumps(payload).encode(),
+                            "application/json")
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    status, payload = server.handle_predict(
+                        self.path, body)
+                except Exception as e:  # noqa: BLE001 - wire boundary
+                    status, payload = 500, {"error": str(e)}
+                self._reply_json(status, payload)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply_json(*server.healthz())
+                elif self.path == "/metrics":
+                    self._reply(200,
+                                server.metrics.render_text().encode(),
+                                "text/plain; version=0.0.4")
+                else:
+                    self._reply_json(404, {"error": "no route %r"
+                                           % self.path})
+
+            def log_message(self, fmt, *args):
+                server.debug("http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="serve-http")
+        self._thread.start()
+        self.info("serving on http://%s:%d%s (models: %s)", self.host,
+                  self.port, self.path,
+                  ", ".join(self.registry.names()) or "<none>")
+        return self
+
+    def stop(self, drain=True, stop_registry=True):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if stop_registry:
+            self.registry.stop(drain=drain)
+
+    # -- web_status integration -------------------------------------------
+    def notify_status(self, url, run_id="serving"):
+        """POST the metrics snapshot + model table to a running
+        :class:`veles_tpu.web_status.WebStatus` ``/update`` endpoint,
+        so the one status page shows training AND serving."""
+        from veles_tpu.web_status import post_json
+        return post_json(url, {
+            "id": run_id,
+            "workflow": "ServingServer",
+            "stopped": self._httpd is None,
+            "results": {"serving": self.metrics.snapshot(),
+                        "models": self.registry.describe()},
+        }, logger=self)

@@ -17,6 +17,24 @@ import time
 from veles_tpu.logger import Logger
 
 
+def post_json(url, payload, timeout=2, logger=None):
+    """POST a JSON payload; True on HTTP 200, False (+ warning) on
+    socket errors.  The one wire helper behind StatusNotifier.notify
+    and ServingServer.notify_status."""
+    import urllib.request
+    body = json.dumps(payload, default=repr).encode()
+    req = urllib.request.Request(
+        url, data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status == 200
+    except OSError as e:
+        if logger is not None:
+            logger.warning("status notify failed: %s", e)
+        return False
+
+
 def _ui_asset(name):
     """Read a packaged single-file UI page (veles_tpu/web/)."""
     import os
@@ -157,14 +175,5 @@ class StatusNotifier(Logger):
         return data
 
     def notify(self, workflow):
-        import urllib.request
-        body = json.dumps(self.snapshot(workflow), default=repr).encode()
-        req = urllib.request.Request(
-            self.url, data=body,
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req, timeout=2) as resp:
-                return resp.status == 200
-        except OSError as e:
-            self.warning("status notify failed: %s", e)
-            return False
+        return post_json(self.url, self.snapshot(workflow),
+                         logger=self)
