@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Self-lint veles_tpu/ with the analyze lint pack (pass 3) — the same
+# invocation the tier-1 suite gates on (test_analyze.py::
+# test_lint_self_clean_tier1).  Extra args pass through, e.g.
+#   scripts/lint.sh --json
+#   scripts/lint.sh path/to/other/package
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m veles_tpu.analyze --lint "$@"

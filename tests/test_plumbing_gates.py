@@ -1,0 +1,112 @@
+"""Plumbing gate semantics: Repeater any-edge re-fire, FireStarter
+re-arming via the public reset_gate API, EndPoint terminality, and the
+gate-deadlock graph the doctor flags statically — at runtime the FIFO
+scheduler drains and returns WITHOUT finishing (silent
+non-termination), which is exactly why the static check exists."""
+
+from veles_tpu.analyze import check_graph
+from veles_tpu.dummy import DummyUnit, DummyWorkflow
+from veles_tpu.mutable import Bool
+from veles_tpu.plumbing import EndPoint, FireStarter, Repeater
+
+
+def test_reset_gate_clears_fired_edges():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    b = DummyUnit(wf, name="b")
+    c = DummyUnit(wf, name="c")
+    c.link_from(a, b)
+    assert not c.open_gate(a)           # partial fire
+    assert c.links_from[a] is True
+    c.reset_gate()
+    assert list(c.links_from.values()) == [False, False]
+    assert not c.open_gate(a)           # partial again, not leftover
+
+
+def test_repeater_refires_on_single_edge():
+    wf = DummyWorkflow()
+    rpt = Repeater(wf, name="rpt")
+    a = DummyUnit(wf, name="a")
+    b = DummyUnit(wf, name="b")
+    rpt.link_from(a, b)
+    # ANY one fired edge opens the gate, and the gate resets behind it
+    assert rpt.open_gate(a) is True
+    assert list(rpt.links_from.values()) == [False, False]
+    assert rpt.open_gate(b) is True
+    assert rpt.ignores_gate
+
+
+def test_repeater_anchored_loop_runs_to_termination():
+    wf = DummyWorkflow()
+    rpt = Repeater(wf, name="rpt")
+    body = DummyUnit(wf, name="body")
+    class Counter(DummyUnit):
+        def __init__(self, workflow, **kwargs):
+            super(Counter, self).__init__(workflow, **kwargs)
+            self.done = Bool(False)
+
+        def run(self):
+            super(Counter, self).run()
+            if self.run_count >= 3:
+                self.done <<= True
+
+    counter = Counter(wf, name="counter")
+    done = counter.done
+    rpt.link_from(wf.start_point)
+    body.link_from(rpt)
+    counter.link_from(body)
+    rpt.link_from(counter)              # back edge
+    wf.end_point.link_from(counter)
+    wf.end_point.gate_block = ~done
+    wf.initialize()
+    wf.run()
+    assert counter.run_count == 3
+    assert wf.stopped
+
+
+def test_firestarter_rearms_via_public_api():
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    b = DummyUnit(wf, name="b")
+    c = DummyUnit(wf, name="c")
+    c.link_from(a, b)
+    c.open_gate(a)                      # leave a half-fired gate
+    fs = FireStarter(wf, units=[c])
+    fs.run()
+    assert list(c.links_from.values()) == [False, False]
+    # and the lint pack proves FireStarter no longer reaches into
+    # _gate_lock_/links_from directly (test_analyze self-lint)
+
+
+def test_endpoint_is_terminal():
+    wf = DummyWorkflow()
+    stray = DummyUnit(wf, name="stray")
+    stray.link_from(wf.end_point)       # even with an outgoing edge...
+    wf.end_point.run_dependent()        # ...nothing is scheduled
+    assert len(wf._queue_) == 0
+    wf.end_point.run()
+    assert wf.stopped                   # running End finishes the flow
+
+
+def test_gate_deadlock_flagged_statically_and_never_finishes(
+        monkeypatch):
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    ghost = DummyUnit(wf, name="ghost")
+    joiner = DummyUnit(wf, name="joiner")
+    a.link_from(wf.start_point)
+    joiner.link_from(a, ghost)          # ghost can never fire
+    wf.end_point.link_from(joiner)
+
+    findings = [f for f in check_graph(wf) if f.rule == "V-G03"]
+    assert findings and findings[0].unit == "joiner"
+
+    # Runtime ground truth: the queue drains, run() returns, but the
+    # graph never finished — the silent hang the doctor catches.
+    # QUIESCENCE_TIMEOUT guards the drain in case a straggler wedges.
+    monkeypatch.setattr(type(wf), "QUIESCENCE_TIMEOUT", 5.0)
+    wf.initialize()
+    wf.run()
+    assert a.run_count == 1
+    assert joiner.run_count == 0        # gate never opened
+    assert not wf.stopped               # on_workflow_finished never ran

@@ -360,3 +360,19 @@ def test_slave_mode_minibatch_prefetch_overlaps_io():
     assert t_on < t_off - 3 * io_delay, \
         "slave prefetch gave no overlap (on=%.3fs off=%.3fs)" % (
             t_on, t_off)
+
+
+def test_atexit_registered_once_across_recreations(monkeypatch):
+    """Recreating the pool after shutdown() must not stack another
+    atexit handler each time (thread_pool.py registers once per
+    process)."""
+    from veles_tpu import thread_pool
+    calls = []
+    monkeypatch.setattr(thread_pool, "_atexit_registered", False)
+    monkeypatch.setattr(thread_pool.atexit, "register",
+                        lambda fn, *a, **kw: calls.append(fn))
+    thread_pool.shutdown()
+    for _ in range(3):
+        assert thread_pool.get_pool() is not None
+        thread_pool.shutdown()
+    assert calls == [thread_pool.shutdown]

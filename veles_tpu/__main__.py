@@ -173,6 +173,8 @@ class Main(Logger):
             return main_self.workflow, None
 
         def main(**kwargs):
+            if main_self.args.analyze:
+                return    # pre-flight wants the constructed graph only
             main_self.launcher.initialize(**kwargs)
             if not main_self.args.dry_run:
                 main_self.launcher.run()
@@ -322,6 +324,13 @@ class Main(Logger):
 
     def _run_constructed(self, args):
         self._construct()
+        if args.analyze:
+            if self.workflow is None:
+                raise SystemExit("--analyze: no workflow constructed")
+            from veles_tpu.analyze import analyze_workflow
+            report = analyze_workflow(self.workflow)
+            print(report.render_text())
+            return 1 if report.has_errors else 0
         if args.result_file:
             self.workflow.result_file = args.result_file
         if self.workflow is not None and \

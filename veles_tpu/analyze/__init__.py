@@ -1,0 +1,57 @@
+"""veles_tpu.analyze — pre-flight workflow doctor, JAX hazard
+analyzer, and project lint pack.
+
+Three static passes, zero device work:
+
+1. **Graph doctor** (:mod:`~veles_tpu.analyze.graph`) — structural
+   checks on a *constructed* workflow: dangling ``demand()`` names,
+   units unreachable from ``start_point``, gate deadlocks, cycles
+   without a Repeater, an unlinked ``end_point``, master/slave
+   payload-order fragility.
+2. **JAX hazard analyzer** (:mod:`~veles_tpu.analyze.shapes`) —
+   shape/dtype propagation through the forward chain (or
+   ``fused_graph.lower_specs``-style layer specs) with
+   ``jax.eval_shape`` only: shape/dtype mismatches, weak-type
+   promotion, non-power-of-two batch sizes that miss the serve
+   engine's AOT buckets, and host-device transfer hazards in ``run()``
+   bodies.
+3. **Lint pack** (:mod:`~veles_tpu.analyze.lint`) — AST rules over
+   ``veles_tpu/`` source itself (blocking IO in ``run()``, private
+   state access, gate/link API misuse); the tier-1 suite keeps the
+   package self-clean.
+
+Entry points: ``python -m veles_tpu.analyze`` (CLI), the launcher's
+``--analyze`` dry-run flag, and :meth:`veles_tpu.serve.registry
+.ModelRegistry.preflight` (load-time, failable via
+``root.common.serve.preflight``).
+"""
+
+from veles_tpu.analyze.findings import (  # noqa: F401
+    Finding, Report, rule_catalog)
+from veles_tpu.analyze.graph import check_graph  # noqa: F401
+from veles_tpu.analyze.lint import lint_paths  # noqa: F401
+from veles_tpu.analyze.shapes import check_shapes  # noqa: F401
+
+
+class PreflightError(Exception):
+    """A pre-flight analysis found errors and the configured policy is
+    ``fail`` — the rendered report rides in ``args[0]``, the
+    :class:`Report` in :attr:`report`."""
+
+    def __init__(self, report):
+        super(PreflightError, self).__init__(report.render_text())
+        self.report = report
+
+
+def analyze_workflow(workflow, passes=("graph", "shapes"),
+                     sample_shape=None, batch_size=None):
+    """Run the workflow-level passes (1–2) and return a
+    :class:`Report`.  The lint pack is repo-level, not workflow-level
+    — run it via :func:`lint_paths` or the CLI's ``--lint``."""
+    report = Report(passes=list(passes))
+    if "graph" in passes:
+        report.extend(check_graph(workflow))
+    if "shapes" in passes:
+        report.extend(check_shapes(workflow, sample_shape=sample_shape,
+                                   batch_size=batch_size))
+    return report

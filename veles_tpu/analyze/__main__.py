@@ -1,0 +1,131 @@
+"""``python -m veles_tpu.analyze <workflow module|snapshot>`` — the
+pre-flight CLI.
+
+Constructs the target workflow WITHOUT initializing it (no device
+buffers, no compiles), runs the graph doctor + JAX hazard analyzer,
+and exits non-zero when errors are found.  ``--lint`` runs the AST
+lint pack over source paths instead of (or in addition to) a
+workflow.
+
+Examples::
+
+    JAX_PLATFORMS=cpu python -m veles_tpu.analyze veles_tpu.samples.mnist
+    python -m veles_tpu.analyze snapshots/mnist_best.4.pickle --json
+    python -m veles_tpu.analyze --lint            # self-lint veles_tpu/
+    python -m veles_tpu.analyze --rules           # print the catalog
+"""
+
+import argparse
+import importlib
+import importlib.util
+import os
+import sys
+
+
+def make_parser():
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu.analyze",
+        description="Static pre-flight: workflow graph doctor + JAX "
+                    "hazard analyzer + project lint pack.")
+    parser.add_argument(
+        "target", nargs="?", default=None,
+        help="workflow python file, dotted module, or snapshot "
+             "artifact to doctor (constructed, never initialized)")
+    parser.add_argument(
+        "--lint", nargs="*", default=None, metavar="PATH",
+        help="run the lint pack over PATH(s); no PATH means the "
+             "installed veles_tpu package (self-lint)")
+    parser.add_argument(
+        "--sample-shape", default=None, metavar="D1,D2,...",
+        help="input sample shape override for shape propagation")
+    parser.add_argument(
+        "--batch-size", type=int, default=None,
+        help="batch size override for shape propagation and the "
+             "serve-bucket fit check")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the report as JSON instead of text")
+    parser.add_argument(
+        "--rules", action="store_true",
+        help="print the rule catalog and exit")
+    return parser
+
+
+def _load_module(spec):
+    if os.path.exists(spec):
+        name = os.path.splitext(os.path.basename(spec))[0]
+        modspec = importlib.util.spec_from_file_location(name, spec)
+        module = importlib.util.module_from_spec(modspec)
+        sys.modules[name] = module
+        modspec.loader.exec_module(module)
+        return module
+    return importlib.import_module(spec)
+
+
+def build_workflow(target):
+    """Target → constructed workflow: snapshot artifact, or a module
+    following either workflow convention (``create_workflow`` /
+    ``run(load, main)``) — construction only, never ``initialize``."""
+    if os.path.exists(target) and not target.endswith(".py"):
+        from veles_tpu.snapshotter import load_snapshot
+        return load_snapshot(target)
+    module = _load_module(target)
+    if hasattr(module, "create_workflow"):
+        return module.create_workflow()
+    if hasattr(module, "run"):
+        box = {}
+
+        def load(workflow_class, **kwargs):
+            box["workflow"] = workflow_class(None, **kwargs)
+            return box["workflow"], None
+
+        def main(**kwargs):
+            pass    # analysis wants the graph, not a run
+
+        module.run(load, main)
+        if "workflow" in box:
+            return box["workflow"]
+    raise SystemExit(
+        "cannot build a workflow from %r: not a snapshot, and the "
+        "module defines neither create_workflow(...) nor "
+        "run(load, main)" % target)
+
+
+def main(argv=None):
+    from veles_tpu.analyze import (
+        Report, analyze_workflow, lint_paths, rule_catalog)
+    args = make_parser().parse_args(argv)
+    if args.rules:
+        for rule_id, (severity, desc) in sorted(
+                rule_catalog().items()):
+            print("%-6s %-8s %s" % (rule_id, severity, desc))
+        return 0
+    if args.target is None and args.lint is None:
+        make_parser().print_usage(sys.stderr)
+        print("error: give a workflow target and/or --lint",
+              file=sys.stderr)
+        return 2
+
+    report = Report()
+    if args.target is not None:
+        sample_shape = None
+        if args.sample_shape:
+            sample_shape = tuple(
+                int(d) for d in args.sample_shape.split(",") if d)
+        workflow = build_workflow(args.target)
+        report = analyze_workflow(workflow, sample_shape=sample_shape,
+                                  batch_size=args.batch_size)
+    lint_findings = []
+    if args.lint is not None:
+        report.passes.append("lint")
+        lint_findings = lint_paths(args.lint or None)
+        report.extend(lint_findings)
+
+    print(report.to_json() if args.json else report.render_text())
+    # --lint is a gate: ANY lint finding is dirty (the rules are
+    # warning-severity by design, but "self-clean" means zero)
+    return 1 if report.has_errors or lint_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

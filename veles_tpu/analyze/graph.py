@@ -1,0 +1,220 @@
+"""Pass 1 — the graph doctor: structural checks on a *constructed*
+(not initialized) workflow.
+
+Every rule here catches a bug that today only surfaces deep inside
+``initialize()`` requeue loops or as a run that silently never
+terminates (the FIFO scheduler drains an un-openable gate's queue and
+``run()`` returns with ``stopped`` still False).  All checks are pure
+graph walks over ``links_from``/``links_to`` — no device, no
+initialization, no unit ``run()`` is touched.
+"""
+
+import inspect
+
+from veles_tpu.analyze.findings import Finding
+
+RULES = {
+    "V-G01": ("error",
+              "a demand()-ed attribute is neither link_attrs()-linked "
+              "nor set — initialize() would requeue forever and fail"),
+    "V-G02": ("warning",
+              "unit unreachable from start_point — "
+              "units_in_dependency_order silently appends it, so it "
+              "initializes but never runs"),
+    "V-G03": ("error",
+              "gate deadlock: an incoming control edge's source can "
+              "never fire, so the ALL-inputs gate never opens and the "
+              "graph never reaches end_point"),
+    "V-G04": ("error",
+              "cycle without a Repeater anchor: every member waits on "
+              "its predecessor's edge — the loop can never start"),
+    "V-G05": ("error",
+              "end_point has no live incoming control edge — the "
+              "workflow would never call on_workflow_finished"),
+    "V-G06": ("info",
+              "master/slave payload-order fragility: unreachable units "
+              "ride at the END of the per-unit payload list in "
+              "insertion order, so reordering constructor calls "
+              "silently breaks checksum-matched job payloads"),
+}
+
+
+def _location(unit):
+    """``file:line`` of the unit's class definition, best effort."""
+    try:
+        cls = type(unit)
+        path = inspect.getsourcefile(cls)
+        _, line = inspect.getsourcelines(cls)
+        return "%s:%d" % (path, line) if path else None
+    except (OSError, TypeError):
+        return None
+
+
+def _reachable(start):
+    seen = {}
+    frontier = [start]
+    while frontier:
+        unit = frontier.pop()
+        if id(unit) in seen:
+            continue
+        seen[id(unit)] = unit
+        frontier.extend(unit.links_to)
+    return seen
+
+
+def _sccs(units):
+    """Tarjan SCCs over ``links_to``, iterative (units may form long
+    chains; no recursion-limit surprises on generated graphs)."""
+    index = {}
+    lowlink = {}
+    on_stack = set()
+    stack = []
+    sccs = []
+    counter = [0]
+    for root in units:
+        if id(root) in index:
+            continue
+        work = [(root, iter(list(root.links_to)))]
+        index[id(root)] = lowlink[id(root)] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(id(root))
+        while work:
+            unit, edges = work[-1]
+            advanced = False
+            for dst in edges:
+                if id(dst) not in index:
+                    index[id(dst)] = lowlink[id(dst)] = counter[0]
+                    counter[0] += 1
+                    stack.append(dst)
+                    on_stack.add(id(dst))
+                    work.append((dst, iter(list(dst.links_to))))
+                    advanced = True
+                    break
+                if id(dst) in on_stack:
+                    lowlink[id(unit)] = min(lowlink[id(unit)],
+                                            index[id(dst)])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[id(parent)] = min(lowlink[id(parent)],
+                                          lowlink[id(unit)])
+            if lowlink[id(unit)] == index[id(unit)]:
+                scc = []
+                while True:
+                    member = stack.pop()
+                    on_stack.discard(id(member))
+                    scc.append(member)
+                    if member is unit:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+def check_graph(workflow):
+    """Run every graph-doctor rule; returns a list of Findings."""
+    findings = []
+    start = workflow.start_point
+    end = workflow.end_point
+    units = list(workflow.units)
+    reachable = _reachable(start)
+
+    # V-G01 — dangling demands (introspection hook on Unit).
+    for unit in units:
+        dangling = unit.unlinked_demands()
+        if dangling:
+            findings.append(Finding(
+                *_rule("V-G01"),
+                message="%r demands %s but nothing links or sets %s"
+                        % (unit, ", ".join(dangling),
+                           "it" if len(dangling) == 1 else "them"),
+                unit=unit.name, location=_location(unit),
+                fix="link_attrs() the missing name(s) from the "
+                    "producing unit, or set them before initialize()"))
+
+    # V-G05 — end point terminality.
+    if not end.links_from:
+        findings.append(Finding(
+            *_rule("V-G05"),
+            message="end_point has no incoming control edge; the graph "
+                    "would drain its queue and return without "
+                    "finishing",
+            unit=end.name,
+            fix="workflow.end_point.link_from(<last unit>)"))
+    elif id(end) not in reachable:
+        findings.append(Finding(
+            *_rule("V-G05"),
+            message="end_point is linked but unreachable from "
+                    "start_point — no path ever fires it",
+            unit=end.name,
+            fix="connect end_point's producers to the start-reachable "
+                "subgraph"))
+
+    # V-G02 — unreachable units (the silent append in
+    # units_in_dependency_order, workflow.py).
+    unreachable = [u for u in units
+                   if id(u) not in reachable and u is not start
+                   and u is not end]
+    for unit in unreachable:
+        findings.append(Finding(
+            *_rule("V-G02"),
+            message="%r is not reachable from start_point: it will be "
+                    "initialized but never scheduled" % (unit,),
+            unit=unit.name, location=_location(unit),
+            fix="link_from() it into the control graph, or remove it"))
+
+    # V-G03 — gate deadlock: a reachable ALL-gate unit with an edge
+    # whose source can never fire.
+    for unit in units:
+        if id(unit) not in reachable or unit.ignores_gate:
+            continue
+        for src in unit.links_from:
+            if id(src) not in reachable:
+                findings.append(Finding(
+                    *_rule("V-G03"),
+                    message="%r waits on edge from %r which can never "
+                            "fire (source unreachable from "
+                            "start_point); its ALL-inputs gate never "
+                            "opens" % (unit, src),
+                    unit=unit.name, location=_location(unit),
+                    fix="drop the dead edge (unlink_from) or wire %r "
+                        "into the graph" % (src,)))
+
+    # V-G04 — cycles lacking a Repeater (ignores_gate) anchor.
+    for scc in _sccs(list(reachable.values())):
+        cyclic = len(scc) > 1 or (scc and scc[0] in scc[0].links_to)
+        if not cyclic:
+            continue
+        if any(member.ignores_gate for member in scc):
+            continue
+        names = ", ".join(sorted(m.name for m in scc))
+        findings.append(Finding(
+            *_rule("V-G04"),
+            message="cycle {%s} has no Repeater: every member's "
+                    "ALL-inputs gate waits on the back edge, so the "
+                    "loop never starts" % names,
+            unit=scc[0].name,
+            fix="anchor the loop on a plumbing.Repeater (its gate "
+                "opens on ANY single edge)"))
+
+    # V-G06 — master/slave payload-order fragility.
+    if unreachable:
+        findings.append(Finding(
+            *_rule("V-G06"),
+            message="%d unreachable unit(s) (%s) ride at the end of "
+                    "generate_data_for_slave's payload list in "
+                    "insertion order — payload alignment depends on "
+                    "construction order, not the graph"
+                    % (len(unreachable),
+                       ", ".join(u.name for u in unreachable)),
+            fix="make every payload-bearing unit reachable so "
+                "dependency order pins its payload slot"))
+
+    return findings
+
+
+def _rule(rule_id):
+    severity, _desc = RULES[rule_id]
+    return severity, rule_id

@@ -1,0 +1,359 @@
+"""Pass 3 — project lint pack: AST rules over ``veles_tpu/`` itself.
+
+Unlike passes 1–2 (which inspect a live workflow object) this pass
+reads source files, so it runs in CI with no JAX import and no
+workflow construction.  The rules encode the platform's own
+scheduling/state contracts:
+
+* ``V-L01`` — blocking calls (``time.sleep``, subprocess, url fetches)
+  inside ``run()`` of a Unit that did not opt into ``wants_thread``:
+  such a unit stalls the single-threaded FIFO scheduler and every
+  device dispatch behind it.
+* ``V-L02`` — reaching into another object's trailing-underscore
+  private state (``_gate_lock_`` et al.): process-local internals that
+  neither pickle nor respect the owning unit's locking discipline.
+* ``V-L03`` — rebinding ``gate_block``/``gate_skip`` with a bare bool
+  literal: the attribute holds a shared :class:`~veles_tpu.mutable
+  .Bool` cell; plain ``= True`` replaces the cell and silently detaches
+  every gate expression built from it (use ``<<=``).
+* ``V-L04`` — mutating ``links_from``/``links_to`` outside the link
+  API (``link_from``/``unlink_from``/``unlink_all``/``reset_gate``/
+  ``open_gate``): gate-consistency is an invariant of those methods.
+
+A finding on a line containing ``analyze: ignore`` (optionally
+``analyze: ignore[V-Lxx]``) is suppressed.
+
+The tier-1 suite asserts this pass is CLEAN over ``veles_tpu/``
+(tests/test_analyze.py); ``scripts/lint.sh`` wraps the same invocation
+for local use.
+"""
+
+import ast
+import os
+
+from veles_tpu.analyze.findings import Finding
+
+RULES = {
+    "V-L00": ("warning",
+              "a scanned file cannot be read or parsed — the lint "
+              "pass has a blind spot there"),
+    "V-L01": ("warning",
+              "blocking IO / time.sleep in run() of a non-wants_thread "
+              "unit stalls the FIFO scheduler and all device dispatch "
+              "behind it"),
+    "V-L02": ("warning",
+              "direct access to another object's trailing-underscore "
+              "private state (_gate_lock_ etc.) bypasses the owner's "
+              "locking discipline"),
+    "V-L03": ("warning",
+              "assigning a bare bool literal to gate_block/gate_skip "
+              "replaces the shared mutable.Bool cell — gate "
+              "expressions built from it silently detach"),
+    "V-L04": ("warning",
+              "mutating links_from/links_to outside the link API "
+              "breaks gate-reset invariants"),
+}
+
+#: dotted call names that block the calling thread
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "urllib.request.urlopen", "requests.get", "requests.post",
+    "socket.create_connection",
+    "input",
+}
+
+#: methods allowed to touch links_from/links_to directly — the link
+#: API itself
+_LINK_API = {"link_from", "unlink_from", "unlink_all", "reset_gate",
+             "open_gate"}
+
+#: mutating dict methods on links_from/links_to that V-L04 flags
+_MUTATING_METHODS = {"clear", "pop", "popitem", "update", "setdefault"}
+
+
+def _rule(rule_id):
+    severity, _desc = RULES[rule_id]
+    return severity, rule_id
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_private_state(name):
+    """Trailing-underscore convention: ``_x_`` style process-local
+    state (not dunders)."""
+    return (len(name) > 2 and name.startswith("_")
+            and name.endswith("_") and not name.startswith("__")
+            and not name.endswith("__"))
+
+
+def _is_self(node):
+    return isinstance(node, ast.Name) and node.id in ("self", "cls")
+
+
+class _ModuleIndex(object):
+    """Phase-1 scan result for one file: classes (name → base names,
+    wants_thread opt-in, run() nodes) and import aliases."""
+
+    def __init__(self, path, tree, source_lines):
+        self.path = path
+        self.tree = tree
+        self.source_lines = source_lines
+        self.aliases = {}        # local name → dotted module
+        self.classes = {}        # class name → dict
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    # plain `import a.b` binds the name `a` and calls
+                    # spell the full dotted path already — only an
+                    # `as` alias needs rewriting
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = \
+                        "%s.%s" % (node.module, a.name)
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = {
+                    "bases": [b.split(".")[-1] for b in
+                              (_dotted(base) for base in node.bases)
+                              if b],
+                    "node": node,
+                    "wants_thread": _class_opts_into_thread(node),
+                }
+
+    def resolve_call(self, func_node):
+        """Dotted call name with the first segment de-aliased
+        (``np.asarray`` → ``numpy.asarray``)."""
+        name = _dotted(func_node)
+        if not name:
+            return None
+        head, sep, rest = name.partition(".")
+        target = self.aliases.get(head)
+        if target:
+            # "from time import sleep" → alias maps the call itself
+            return target + (sep + rest if rest else "")
+        return name
+
+
+def _class_opts_into_thread(class_node):
+    """True when the class body (or its __init__) sets
+    ``wants_thread = True``."""
+    for item in class_node.body:
+        if isinstance(item, ast.Assign):
+            for tgt in item.targets:
+                if isinstance(tgt, ast.Name) \
+                        and tgt.id == "wants_thread" \
+                        and isinstance(item.value, ast.Constant) \
+                        and item.value.value is True:
+                    return True
+        if isinstance(item, ast.FunctionDef) and item.name == "__init__":
+            for node in ast.walk(item):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and tgt.attr == "wants_thread" \
+                                and _is_self(tgt.value) \
+                                and isinstance(node.value,
+                                               ast.Constant) \
+                                and node.value.value is True:
+                            return True
+    return False
+
+
+def _unit_class_names(indexes):
+    """Transitive closure of classes deriving (textually) from Unit
+    across the whole scanned file set."""
+    bases = {}
+    for index in indexes:
+        for name, info in index.classes.items():
+            bases.setdefault(name, set()).update(info["bases"])
+    unit_like = {"Unit"}
+    changed = True
+    while changed:
+        changed = False
+        for name, base_set in bases.items():
+            if name not in unit_like and base_set & unit_like:
+                unit_like.add(name)
+                changed = True
+    return unit_like
+
+
+def _suppressed(index, lineno, rule_id):
+    try:
+        line = index.source_lines[lineno - 1]
+    except IndexError:
+        return False
+    marker = line.rsplit("#", 1)[-1] if "#" in line else ""
+    if "analyze: ignore" not in marker:
+        return False
+    bracket = marker.partition("analyze: ignore")[2].strip()
+    if bracket.startswith("["):
+        return rule_id in bracket[1:bracket.find("]")].split(",")
+    return True
+
+
+def _emit(findings, index, rule_id, node, message, fix=None,
+          unit=None):
+    if _suppressed(index, node.lineno, rule_id):
+        return
+    findings.append(Finding(
+        *_rule(rule_id), message=message, unit=unit,
+        location="%s:%d" % (index.path, node.lineno), fix=fix))
+
+
+def _check_blocking_run(findings, index, unit_like):
+    for cls_name, info in index.classes.items():
+        if cls_name not in unit_like or info["wants_thread"]:
+            continue
+        for item in info["node"].body:
+            if not (isinstance(item, ast.FunctionDef)
+                    and item.name == "run"):
+                continue
+            for node in ast.walk(item):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = index.resolve_call(node.func)
+                if name in _BLOCKING_CALLS:
+                    _emit(findings, index, "V-L01", node,
+                          "%s.run() calls %s() but the unit does not "
+                          "set wants_thread — the scheduler thread "
+                          "blocks" % (cls_name, name),
+                          fix="set self.wants_thread = True (runs on "
+                              "the background executor) or move the "
+                              "blocking work out of run()",
+                          unit=cls_name)
+
+
+def _check_private_access(findings, index):
+    for node in ast.walk(index.tree):
+        if isinstance(node, ast.Attribute) \
+                and _is_private_state(node.attr) \
+                and not _is_self(node.value):
+            _emit(findings, index, "V-L02", node,
+                  "access to %s through another object (%s) — "
+                  "trailing-underscore state is owner-private"
+                  % (node.attr, _dotted(node) or "<expr>"),
+                  fix="use the owner's public API (reset_gate(), "
+                      "describe(), unlinked_demands())")
+
+
+def _check_gate_literal(findings, index):
+    for node in ast.walk(index.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Constant)
+                and node.value.value in (True, False)):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) \
+                    and tgt.attr in ("gate_block", "gate_skip"):
+                _emit(findings, index, "V-L03", node,
+                      "%s = %r replaces the shared mutable.Bool cell"
+                      % (_dotted(tgt) or tgt.attr, node.value.value),
+                      fix="use `%s <<= %r` to flip the existing cell "
+                          "in place" % (tgt.attr, node.value.value))
+
+
+class _LinkMutationVisitor(ast.NodeVisitor):
+    """Tracks the enclosing function name so the link API itself is
+    exempt from V-L04."""
+
+    def __init__(self, findings, index):
+        self.findings = findings
+        self.index = index
+        self.func_stack = []
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _inside_link_api(self):
+        return bool(self.func_stack) and \
+            self.func_stack[-1] in _LINK_API
+
+    def _flag(self, node, what):
+        _emit(self.findings, self.index, "V-L04", node,
+              "%s mutated outside the link API" % what,
+              fix="go through link_from()/unlink_from()/reset_gate() — "
+                  "they keep gate bookkeeping consistent")
+
+    def visit_Assign(self, node):
+        if not self._inside_link_api():
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.value, ast.Attribute) \
+                        and tgt.value.attr in ("links_from",
+                                               "links_to"):
+                    self._flag(node, _dotted(tgt.value)
+                               or tgt.value.attr)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if not self._inside_link_api() \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATING_METHODS \
+                and isinstance(node.func.value, ast.Attribute) \
+                and node.func.value.attr in ("links_from", "links_to"):
+            self._flag(node, "%s.%s()" % (
+                _dotted(node.func.value) or node.func.value.attr,
+                node.func.attr))
+        self.generic_visit(node)
+
+
+def _iter_py_files(paths):
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for dirpath, dirnames, files in os.walk(path):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__"
+                           and not d.startswith(".")]
+            for fname in sorted(files):
+                if fname.endswith(".py"):
+                    yield os.path.join(dirpath, fname)
+
+
+def lint_paths(paths=None):
+    """Run every lint rule over ``paths`` (files or directories);
+    defaults to the installed ``veles_tpu`` package.  Returns a list
+    of Findings sorted by location."""
+    if not paths:
+        import veles_tpu
+        paths = [os.path.dirname(os.path.abspath(veles_tpu.__file__))]
+    indexes = []
+    findings = []
+    for fpath in _iter_py_files(paths):
+        try:
+            with open(fpath, "r") as fin:
+                source = fin.read()
+            tree = ast.parse(source, filename=fpath)
+        except (OSError, SyntaxError) as exc:
+            findings.append(Finding(
+                "warning", "V-L00",
+                "cannot parse %s: %s" % (fpath, exc)))
+            continue
+        indexes.append(_ModuleIndex(fpath, tree,
+                                    source.splitlines()))
+    unit_like = _unit_class_names(indexes)
+    for index in indexes:
+        _check_blocking_run(findings, index, unit_like)
+        _check_private_access(findings, index)
+        _check_gate_literal(findings, index)
+        _LinkMutationVisitor(findings, index).visit(index.tree)
+    findings.sort(key=lambda f: (f.location or "", f.rule))
+    return findings

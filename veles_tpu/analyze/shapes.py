@@ -1,0 +1,331 @@
+"""Pass 2 — JAX hazard analyzer: shape/dtype propagation with
+``jax.eval_shape`` ONLY (zero XLA compiles, zero device buffers).
+
+Walks the workflow's forward chain stage by stage — each stage is the
+unit's pure function (the same protocol the serve engine and the fused
+lowering consume, :func:`veles_tpu.serve.engine.forward_stages`) —
+feeding ``ShapeDtypeStruct``s through ``jax.eval_shape``.  Workflows
+built from layer specs (``workflow.layers``) whose units are not yet
+initialized are analyzed through probe units instantiated the way
+``fused_graph.lower_specs`` does: host-numpy weight init, still no
+compiles.
+
+On top of the propagation, an AST scan of each forward unit's
+``run()``/``tpu_run()`` body flags host-device transfer hazards
+(``np.asarray`` and friends on device values) — the silent
+synchronization points that serialize an otherwise async dispatch
+chain.
+"""
+
+import ast
+import inspect
+import textwrap
+
+import numpy
+
+from veles_tpu.analyze.findings import Finding
+
+RULES = {
+    "V-J00": ("info",
+              "forward chain not statically analyzable (no forwards, "
+              "no materialized params, or no layer specs) — shape "
+              "propagation skipped or stopped"),
+    "V-J01": ("error",
+              "shape mismatch between linked forward units: "
+              "jax.eval_shape fails or the batch dimension is folded"),
+    "V-J02": ("warning",
+              "silent dtype change between linked forward units — the "
+              "downstream unit computes in a precision nobody chose"),
+    "V-J03": ("warning",
+              "weak-type output: a python-scalar-derived value escapes "
+              "a stage, so downstream promotion depends on JAX "
+              "weak-type rules instead of declared dtypes"),
+    "V-J04": ("warning",
+              "batch size is not a power of two: the serve engine's "
+              "AOT buckets pad it up, wasting device rows on every "
+              "call"),
+    "V-J05": ("warning",
+              "host-device transfer hazard in a run() body: "
+              "np.asarray/jax.device_get/.block_until_ready on device "
+              "values forces a sync inside the hot loop"),
+}
+
+#: dotted call names that force a device→host sync
+_SYNC_CALLS = {
+    "numpy.asarray", "numpy.array", "np.asarray", "np.array",
+    "jax.device_get",
+}
+#: attribute-call tails that force a sync regardless of receiver
+_SYNC_METHODS = {"block_until_ready", "item"}
+
+
+def _rule(rule_id):
+    severity, _desc = RULES[rule_id]
+    return severity, rule_id
+
+
+def _call_name(func):
+    """Dotted name of a Call's func node (``numpy.asarray``,
+    ``self.output.block_until_ready``), or the bare method name
+    prefixed with ``.`` for non-name receivers (``f(x).item``)."""
+    parts = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if parts:
+        return "." + parts[0]
+    return None
+
+
+def _is_sync_call(name):
+    if not name:
+        return False
+    return name in _SYNC_CALLS or \
+        name.rsplit(".", 1)[-1] in _SYNC_METHODS
+
+
+_MODULE_INDEX_CACHE = {}
+
+
+def _module_index(path):
+    """Cached per-module import-alias index (reuses the lint pack's
+    resolver) so ``import numpy as onp; onp.asarray(...)`` still
+    matches _SYNC_CALLS."""
+    index = _MODULE_INDEX_CACHE.get(path)
+    if index is None and path not in _MODULE_INDEX_CACHE:
+        from veles_tpu.analyze.lint import _ModuleIndex
+        try:
+            with open(path, "r") as fin:
+                source = fin.read()
+            index = _ModuleIndex(path, ast.parse(source),
+                                 source.splitlines())
+        except (OSError, SyntaxError):
+            index = None
+        _MODULE_INDEX_CACHE[path] = index
+    return index
+
+
+def scan_transfer_hazards(unit):
+    """AST-scan ``run``/``tpu_run`` of ``unit``'s class for forced
+    host syncs; returns Findings (V-J05)."""
+    findings = []
+    cls = type(unit)
+    for meth_name in ("run", "tpu_run"):
+        meth = cls.__dict__.get(meth_name) or getattr(cls, meth_name,
+                                                      None)
+        if meth is None:
+            continue
+        func = getattr(meth, "__func__", meth)
+        if not callable(func) or getattr(func, "__qualname__",
+                                         "").startswith("Unit."):
+            continue
+        try:
+            src = textwrap.dedent(inspect.getsource(func))
+            path = inspect.getsourcefile(func)
+            base_line = func.__code__.co_firstlineno
+        except (OSError, TypeError):
+            continue
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            index = _module_index(path) if path else None
+            # alias-resolved first (import numpy as onp), raw dotted
+            # name as fallback (non-Name receivers like f(x).item())
+            name = (index.resolve_call(node.func) if index else None) \
+                or _call_name(node.func)
+            if not _is_sync_call(name):
+                continue
+            line = base_line + node.lineno - 1
+            findings.append(Finding(
+                *_rule("V-J05"),
+                message="%s.%s calls %s — a forced host sync inside "
+                        "the scheduler hot loop stalls async device "
+                        "dispatch"
+                        % (cls.__name__, meth_name,
+                           name.lstrip(".") + "()"),
+                unit=unit.name,
+                location="%s:%d" % (path, line) if path else None,
+                fix="keep device values device-resident (Vector devmem "
+                    "/ jitted chain); sync on epoch boundaries, not "
+                    "per run()"))
+    return findings
+
+
+def _host_params(unit):
+    """Best-effort host params pytree for a forward unit; ``None`` when
+    unavailable (uninitialized weights, protocol error)."""
+    getter = getattr(unit, "pure_params", None)
+    if not callable(getter):
+        return None
+    try:
+        return getter(host=True)
+    except Exception:
+        return None
+
+
+def _probe_forwards(layer_specs, sample_shape):
+    """Probe units from layer specs — THE ``lower_specs``
+    construction loop (host-numpy weight init, spec ``init`` weights
+    injected, no jit, no device buffers), shared so spec lowering and
+    spec analysis can never diverge.  Raises on a broken spec."""
+    from veles_tpu.znicz.fused_graph import probe_units
+    return probe_units(layer_specs, sample_shape)
+
+
+def check_shapes(workflow, sample_shape=None, batch_size=None):
+    """Run the JAX hazard pass; returns a list of Findings.
+
+    ``jax.eval_shape`` only — asserting zero compiles is part of the
+    test gate (tests/test_analyze.py).
+    """
+    findings = []
+    forwards = list(getattr(workflow, "forwards", None) or [])
+    specs = list(getattr(workflow, "layers", None) or [])
+
+    # V-J04 — serve-bucket fit of the declared batch size.
+    batch = batch_size or getattr(getattr(workflow, "loader", None),
+                                  "max_minibatch_size", None)
+    if batch:
+        batch = int(batch)
+        if batch & (batch - 1):
+            bucket = 1 << (batch - 1).bit_length()
+            findings.append(Finding(
+                *_rule("V-J04"),
+                message="batch size %d is not a power of two: the "
+                        "serve engine's AOT buckets pad every batch to "
+                        "%d (%.0f%% fill)"
+                        % (batch, bucket, 100.0 * batch / bucket),
+                fix="pick %d or %d so serving and training shapes "
+                    "coincide" % (bucket // 2, bucket)))
+    batch = batch or 1
+
+    # V-J05 — transfer hazards in the forward chain's run bodies.
+    for unit in forwards:
+        findings.extend(scan_transfer_hazards(unit))
+
+    if not forwards and not specs:
+        findings.append(Finding(
+            *_rule("V-J00"),
+            message="workflow exposes neither a forward chain nor "
+                    "layer specs; shape propagation skipped"))
+        return findings
+
+    if sample_shape is None:
+        # lazy one-way dependency: analyze → serve (the engine module
+        # holds the shared chain-entry-shape and stage definitions)
+        from veles_tpu.serve.engine import infer_sample_shape
+        sample_shape = infer_sample_shape(workflow, forwards)
+    if sample_shape is None:
+        findings.append(Finding(
+            *_rule("V-J00"),
+            message="cannot infer the input sample shape (no forward "
+                    "input, no loader buffer) — pass sample_shape"))
+        return findings
+    sample_shape = tuple(int(d) for d in sample_shape)
+
+    # Uninitialized spec-built workflows: analyze probe units
+    # instantiated exactly like the fused lowering would.
+    if specs and (not forwards
+                  or not getattr(forwards[0], "is_initialized", False)
+                  and _host_params(forwards[0]) in (None, {})):
+        try:
+            forwards = _probe_forwards(specs, sample_shape)
+        except Exception as exc:
+            findings.append(Finding(
+                *_rule("V-J01"),
+                message="layer specs do not lower: %s: %s"
+                        % (type(exc).__name__, exc),
+                fix="fix the failing layer spec (type/shape/kernel "
+                    "parameters)"))
+            return findings
+
+    import jax
+    from veles_tpu.serve.engine import forward_stages
+    try:
+        stages = forward_stages(forwards)
+    except ValueError as exc:
+        findings.append(Finding(
+            *_rule("V-J00"), message=str(exc)))
+        return findings
+
+    x = jax.ShapeDtypeStruct((int(batch),) + sample_shape,
+                             numpy.float32)
+    for unit, (pure, config, skip_at_eval) in zip(forwards, stages):
+        if skip_at_eval:
+            continue
+        params = _host_params(unit)
+        if params is None:
+            findings.append(Finding(
+                *_rule("V-J00"),
+                message="%r has no readable params; shape propagation "
+                        "stopped here" % (unit,),
+                unit=unit.name,
+                fix="initialize() the workflow (or provide layer "
+                    "specs) before analyzing shapes"))
+            break
+        try:
+            out = jax.eval_shape(
+                lambda p, xx: pure(p, xx, **config), params, x)
+        except Exception as exc:
+            weightless = not params and getattr(
+                unit, "weights", None) is not None \
+                and not unit.weights
+            if weightless:
+                findings.append(Finding(
+                    *_rule("V-J00"),
+                    message="%r's weights are not materialized; shape "
+                            "propagation stopped here" % (unit,),
+                    unit=unit.name,
+                    fix="initialize() the workflow or provide layer "
+                        "specs"))
+                break
+            findings.append(Finding(
+                *_rule("V-J01"),
+                message="forward chain breaks at %r: input %s %s → "
+                        "%s: %s"
+                        % (unit, x.dtype, tuple(x.shape),
+                           type(exc).__name__,
+                           str(exc).splitlines()[0] if str(exc)
+                           else ""),
+                unit=unit.name,
+                fix="make %r's weights/config match its upstream "
+                    "output shape" % (unit,)))
+            break
+        if out.shape[:1] != x.shape[:1]:
+            findings.append(Finding(
+                *_rule("V-J01"),
+                message="%r folds the batch dimension: %s → %s (row "
+                        "independence broken — serve bucket padding "
+                        "would corrupt results)"
+                        % (unit, tuple(x.shape), tuple(out.shape)),
+                unit=unit.name,
+                fix="keep axis 0 the batch axis through every forward "
+                    "unit"))
+            break
+        if out.dtype != x.dtype:
+            findings.append(Finding(
+                *_rule("V-J02"),
+                message="%r silently changes dtype %s → %s mid-chain"
+                        % (unit, x.dtype, out.dtype),
+                unit=unit.name,
+                fix="cast explicitly at the chain boundary (or declare "
+                    "compute_dtype in the fused lowering)"))
+        if getattr(out, "weak_type", False):
+            findings.append(Finding(
+                *_rule("V-J03"),
+                message="%r emits a weak-typed %s value (python-scalar "
+                        "promotion); downstream dtype now depends on "
+                        "JAX promotion rules" % (unit, out.dtype),
+                unit=unit.name,
+                fix="anchor constants with an explicit dtype, e.g. "
+                    "jnp.asarray(c, x.dtype)"))
+        x = jax.ShapeDtypeStruct(tuple(out.shape), out.dtype)
+    return findings

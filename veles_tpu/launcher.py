@@ -159,6 +159,12 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             default="ssh -o BatchMode=yes -p %(port)d %(host)s",
             help="remote-launch prefix template")
         group.add_argument(
+            "--analyze", action="store_true",
+            help="dry run: construct the workflow (no initialize, no "
+                 "device buffers), run the static pre-flight (graph "
+                 "doctor + JAX hazard analyzer) and exit non-zero on "
+                 "errors (see docs/analyze.md)")
+        group.add_argument(
             "-p", "--graphics", action="store_true",
             help="launch the detached plotting client")
         group.add_argument(

@@ -22,10 +22,8 @@ class Repeater(Unit):
 
     def open_gate(self, src):
         # Any one fired edge opens the gate (vs. the default ALL).
-        with self._gate_lock_:
-            for key in self.links_from:
-                self.links_from[key] = False
-            return True
+        self.reset_gate()
+        return True
 
 
 class StartPoint(Unit):
@@ -64,6 +62,4 @@ class FireStarter(Unit):
 
     def run(self):
         for unit in self.units:
-            with unit._gate_lock_:
-                for key in unit.links_from:
-                    unit.links_from[key] = False
+            unit.reset_gate()

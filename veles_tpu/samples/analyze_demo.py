@@ -1,0 +1,163 @@
+"""A deliberately broken workflow exercising the analyzer's rule
+catalog — ``python -m veles_tpu.analyze veles_tpu.samples.analyze_demo``
+reports every class of defect the pre-flight doctor exists to catch,
+without a single device buffer or XLA compile.
+
+Planted defects (rule IDs per docs/analyze.md):
+
+* ``V-G01`` — ``consumer`` demands ``labels``; nothing links or sets it.
+* ``V-G02`` — ``loader`` and ``ghost`` are never reachable from start.
+* ``V-G03`` — ``joiner`` waits on an edge from the unreachable
+  ``ghost``: its ALL-inputs gate can never open.
+* ``V-G04`` — ``cycle_a``/``cycle_b`` form a loop with no Repeater.
+* ``V-G05`` — ``end_point`` is never linked; the run never finishes.
+* ``V-G06`` — the unreachable units make master/slave payload order
+  depend on construction order.
+* ``V-J01`` — ``bad_dense`` carries weights for 32 inputs but its
+  upstream emits 64 features.
+* ``V-J02`` — ``cast`` silently downcasts the chain to bfloat16.
+* ``V-J03`` — ``fill`` emits a weak-typed python-scalar constant.
+* ``V-J04`` — the loader's batch size 48 misses the serve engine's
+  power-of-two AOT buckets.
+* ``V-J05`` — ``dense_in.run()`` forces a host sync via
+  ``numpy.asarray``.
+
+The units below are lint-clean on purpose: pass 3 (the lint pack) must
+stay green over ``veles_tpu/`` itself, including this file.
+"""
+
+import numpy
+
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Workflow
+
+
+class DemoForwardBase(Unit):
+    """Minimal pure-protocol forward unit (no Vector machinery): the
+    params are plain host arrays so every demo stage is statically
+    evaluable on a *constructed* workflow."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(DemoForwardBase, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.output = None
+
+    def pure_config(self):
+        return {}
+
+    def pure_params(self, host=False):
+        return {}
+
+
+class DemoDense(DemoForwardBase):
+    """Linear layer whose weight fan-in is fixed at construction — the
+    shape-mismatch seed."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, in_features, out_features, **kwargs):
+        super(DemoDense, self).__init__(workflow, **kwargs)
+        self._w = numpy.zeros((int(in_features), int(out_features)),
+                              numpy.float32)
+
+    def pure_params(self, host=False):
+        return {"w": self._w}
+
+    @staticmethod
+    def pure(params, x):
+        import jax.numpy as jnp
+        h = x.reshape(x.shape[0], -1)
+        return jnp.dot(h, params["w"],
+                       preferred_element_type=jnp.float32)
+
+    def run(self):
+        # V-J05 on purpose: numpy.asarray on the (device) forward
+        # output forces a host round-trip inside the hot loop.
+        self.output = numpy.asarray(
+            self.pure(self.pure_params(host=True), self.input))
+
+
+class DemoFill(DemoForwardBase):
+    """Emits a python-scalar-derived constant — weak-type seed."""
+
+    hide_from_registry = True
+
+    @staticmethod
+    def pure(params, x):
+        import jax.numpy as jnp
+        return jnp.full(x.shape, 0.5)
+
+
+class DemoCast(DemoForwardBase):
+    """Silently downcasts the chain to bfloat16 — dtype-change seed."""
+
+    hide_from_registry = True
+
+    @staticmethod
+    def pure(params, x):
+        import jax.numpy as jnp
+        return x.astype(jnp.bfloat16)
+
+
+class DemoLoader(Unit):
+    """Never linked into the control graph (unreachable seed) and
+    declares a batch size the serve buckets cannot hit exactly."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(DemoLoader, self).__init__(workflow, **kwargs)
+        self.max_minibatch_size = 48
+        self.minibatch_data = numpy.zeros((48, 784), numpy.float32)
+
+
+class DemoConsumer(Unit):
+    """Demands an attribute nobody produces — dangling-demand seed."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(DemoConsumer, self).__init__(workflow, **kwargs)
+        self.demand("labels")
+
+
+class BrokenDemoWorkflow(Workflow):
+    """See the module docstring for the planted-defect inventory."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, **kwargs):
+        super(BrokenDemoWorkflow, self).__init__(workflow, **kwargs)
+        self.loader = DemoLoader(self, name="loader")
+
+        dense_in = DemoDense(self, 784, 64, name="dense_in")
+        dense_in.input = self.loader.minibatch_data
+        fill = DemoFill(self, name="fill")
+        cast = DemoCast(self, name="cast")
+        bad_dense = DemoDense(self, 32, 10, name="bad_dense")
+        self.forwards = [dense_in, fill, cast, bad_dense]
+
+        dense_in.link_from(self.start_point)
+        fill.link_from(dense_in)
+        cast.link_from(fill)
+        bad_dense.link_from(cast)
+
+        consumer = DemoConsumer(self, name="consumer")
+        consumer.link_from(bad_dense)
+
+        ghost = Unit(self, name="ghost")
+        joiner = Unit(self, name="joiner")
+        joiner.link_from(consumer, ghost)
+
+        cycle_a = Unit(self, name="cycle_a")
+        cycle_b = Unit(self, name="cycle_b")
+        cycle_a.link_from(joiner)
+        cycle_b.link_from(cycle_a)
+        cycle_a.link_from(cycle_b)
+        # end_point deliberately left unlinked (V-G05)
+
+
+def create_workflow(**kwargs):
+    return BrokenDemoWorkflow(**kwargs)

@@ -36,6 +36,57 @@ import numpy
 from veles_tpu.logger import Logger
 
 
+def infer_sample_shape(workflow, forwards):
+    """One sample's shape from the first forward's ``input`` or the
+    loader's minibatch buffer; ``None`` when neither is declared.
+    Shared with the static analyzer (:mod:`veles_tpu.analyze.shapes`)
+    so serving and analysis agree on the chain's entry shape."""
+    first = forwards[0] if forwards else None
+    inp = getattr(first, "input", None)
+    shape = getattr(inp, "shape", None)
+    if shape and len(shape) > 1:
+        return tuple(shape[1:])
+    loader = getattr(workflow, "loader", None)
+    data = getattr(loader, "minibatch_data", None)
+    shape = getattr(data, "shape", None)
+    if shape and len(shape) > 1:
+        return tuple(shape[1:])
+    return None
+
+
+def forward_stages(forwards):
+    """Validate the pure-function protocol over a forward chain and
+    return its stages: ``[(pure_fn, static_config, skip_at_eval)]``.
+
+    The single definition of "servable" — :meth:`InferenceEngine
+    .from_forwards` builds its device call from these stages, and the
+    static analyzer (:mod:`veles_tpu.analyze.shapes`) propagates
+    ``jax.eval_shape`` structs through the very same triples, so the
+    two can never disagree about what the serving forward computes.
+    Raises ``ValueError`` naming the offending units otherwise.
+    """
+    forwards = list(forwards)
+    if not forwards:
+        raise ValueError("empty forward chain")
+    unservable = [u for u in forwards
+                  if not (callable(getattr(type(u), "pure", None))
+                          and callable(getattr(u, "pure_config", None))
+                          and callable(getattr(u, "pure_params",
+                                               None)))]
+    if unservable:
+        raise ValueError(
+            "forward unit(s) %s lack the pure-function protocol "
+            "(a static `pure(params, x, **config)` plus "
+            "`pure_config()`/`pure_params()`) and cannot be "
+            "served by the batching engine — keep such workflows "
+            "on a custom serving path" %
+            ", ".join(type(u).__name__ for u in unservable))
+    return tuple(
+        (type(u).pure, dict(u.pure_config()),
+         bool(getattr(type(u), "SKIP_AT_EVAL", False)))
+        for u in forwards)
+
+
 def _power_of_two_buckets(max_batch_size):
     buckets = []
     b = 1
@@ -147,26 +198,7 @@ class InferenceEngine(Logger):
         call (serve-while-training, see ``params_source``).
         """
         forwards = list(forwards)
-        if not forwards:
-            raise ValueError("empty forward chain")
-        unservable = [u for u in forwards
-                      if not (callable(getattr(type(u), "pure", None))
-                              and callable(getattr(u, "pure_config",
-                                                   None))
-                              and callable(getattr(u, "pure_params",
-                                                   None)))]
-        if unservable:
-            raise ValueError(
-                "forward unit(s) %s lack the pure-function protocol "
-                "(a static `pure(params, x, **config)` plus "
-                "`pure_config()`/`pure_params()`) and cannot be "
-                "served by the batching engine — keep such workflows "
-                "on a custom serving path" %
-                ", ".join(type(u).__name__ for u in unservable))
-        stages = tuple(
-            (type(u).pure, dict(u.pure_config()),
-             bool(getattr(type(u), "SKIP_AT_EVAL", False)))
-            for u in forwards)
+        stages = forward_stages(forwards)
 
         def read_params():
             # the old RESTfulAPI critical section, kept: serialize the
@@ -211,20 +243,12 @@ class InferenceEngine(Logger):
 
     @staticmethod
     def _infer_sample_shape(workflow, forwards):
-        first = forwards[0]
-        inp = getattr(first, "input", None)
-        shape = getattr(inp, "shape", None)
-        if shape and len(shape) > 1:
-            return tuple(shape[1:])
-        if workflow is not None:
-            loader = getattr(workflow, "loader", None)
-            data = getattr(loader, "minibatch_data", None)
-            shape = getattr(data, "shape", None)
-            if shape and len(shape) > 1:
-                return tuple(shape[1:])
-        raise ValueError(
-            "cannot infer sample_shape from the forward chain — pass "
-            "sample_shape=(...) explicitly")
+        shape = infer_sample_shape(workflow, forwards)
+        if shape is None:
+            raise ValueError(
+                "cannot infer sample_shape from the forward chain — "
+                "pass sample_shape=(...) explicitly")
+        return shape
 
     # -- compilation ------------------------------------------------------
     def _bucket_for(self, n):

@@ -19,6 +19,7 @@ the Snapshotter side of the platform already produces.
 import threading
 import time
 
+from veles_tpu.config import root
 from veles_tpu.logger import Logger
 from veles_tpu.serve.batcher import DynamicBatcher
 from veles_tpu.serve.engine import InferenceEngine
@@ -131,16 +132,63 @@ class ModelRegistry(Logger):
                   else "")
         return model
 
+    def preflight(self, workflow, name=None):
+        """Run analyzer passes 1–2 (graph doctor + JAX hazards) on a
+        workflow about to be served, per ``root.common.serve
+        .preflight``:
+
+        - ``"off"`` — skip entirely;
+        - ``"warn"`` (default) — log every finding, deploy anyway;
+        - ``"fail"`` — raise :class:`veles_tpu.analyze.PreflightError`
+          when the report contains errors (the serve counterpart of
+          the engine's warmup guarantee: refuse at load time, not at
+          the first request).
+
+        Returns the :class:`~veles_tpu.analyze.Report` (or ``None``
+        when off).
+        """
+        mode = str(root.common.serve.get("preflight",
+                                         "warn")).strip().lower()
+        if mode not in ("off", "no", "false", "0", "warn", "fail"):
+            # a typo'd fail-mode config must not silently downgrade
+            # to warn-and-deploy
+            raise ValueError(
+                "root.common.serve.preflight is %r — want off | warn "
+                "| fail" % mode)
+        if mode in ("off", "no", "false", "0"):
+            return None
+        from veles_tpu.analyze import PreflightError, analyze_workflow
+        report = analyze_workflow(workflow)
+        label = name or type(workflow).__name__
+        for finding in report:
+            log = {"error": self.error,
+                   "warning": self.warning}.get(finding.severity,
+                                                self.info)
+            log("preflight[%s]: %s", label, finding.render())
+        if report.has_errors and mode == "fail":
+            raise PreflightError(report)
+        if len(report):
+            counts = report.counts()
+            self.info("preflight[%s]: %d error(s), %d warning(s) "
+                      "(mode=%s)", label, counts["error"],
+                      counts["warning"], mode)
+        return report
+
     def load_snapshot(self, name, path, version=None, engine_config=None,
                       warmup=True):
-        """Build an engine from a snapshot artifact and deploy it."""
-        engine = InferenceEngine.from_snapshot(
-            path, **dict(engine_config or {}))
+        """Build an engine from a snapshot artifact and deploy it
+        (pre-flighted per ``root.common.serve.preflight``)."""
+        from veles_tpu.snapshotter import load_snapshot
+        workflow = load_snapshot(path)
+        self.preflight(workflow, name)
+        engine = InferenceEngine.from_workflow(
+            workflow, **dict(engine_config or {}))
         return self.deploy(name, engine, version=version, source=path,
                            warmup=warmup)
 
     def load_workflow(self, name, workflow, version=None,
                       engine_config=None, warmup=True):
+        self.preflight(workflow, name)
         engine = InferenceEngine.from_workflow(
             workflow, **dict(engine_config or {}))
         return self.deploy(name, engine, version=version,

@@ -20,12 +20,15 @@ from concurrent.futures import ThreadPoolExecutor
 
 _lock = threading.Lock()
 _pool = None
+#: atexit hook armed once per process — re-registering on every pool
+#: recreation after a shutdown() would stack duplicate handlers
+_atexit_registered = False
 
 
 def get_pool():
     """The process-wide background executor (lazily created; worker count
     from ``root.common.engine.thread_pool_workers``, default 4)."""
-    global _pool
+    global _pool, _atexit_registered
     with _lock:
         if _pool is None:
             from veles_tpu.config import root
@@ -33,7 +36,9 @@ def get_pool():
             _pool = ThreadPoolExecutor(
                 max_workers=int(workers) if workers else 4,
                 thread_name_prefix="veles-bg")
-            atexit.register(shutdown)
+            if not _atexit_registered:
+                _atexit_registered = True
+                atexit.register(shutdown)
         return _pool
 
 

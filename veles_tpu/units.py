@@ -196,6 +196,36 @@ class Unit(Distributable, metaclass=UnitRegistry):
         (ref ``units.py:682``)."""
         self._demanded.update(names)
 
+    # -- static introspection (consumed by veles_tpu.analyze) ---------------
+    def unlinked_demands(self):
+        """Demanded attribute names that are neither link_attrs()-linked
+        nor already set — what the graph doctor reports as V-G01 and
+        what initialize() would requeue on forever."""
+        linked = self.__dict__.get("_linked_attrs", {})
+        out = []
+        for name in sorted(self._demanded):
+            if name in linked:
+                continue    # producer may fill the value at init time
+            try:
+                if getattr(self, name) is not None:
+                    continue
+            except AttributeError:
+                pass
+            out.append(name)
+        return out
+
+    def gate_topology(self):
+        """Static gate picture: incoming/outgoing edge names, the gate
+        mode, and current gate expressions — describe() builds on it
+        and the graph doctor's report mirrors it."""
+        return {
+            "incoming": [u.name for u in self.links_from],
+            "outgoing": [u.name for u in self.links_to],
+            "ignores_gate": bool(self.ignores_gate),
+            "gate_block": bool(self.gate_block),
+            "gate_skip": bool(self.gate_skip),
+        }
+
     @classmethod
     def reload(cls):
         """Hot-patch this unit's class from its edited source file —
@@ -274,6 +304,18 @@ class Unit(Distributable, metaclass=UnitRegistry):
                 self.links_from[key] = False
             return True
 
+    def reset_gate(self):
+        """Re-arm this unit's gate: mark every incoming edge unfired.
+
+        The public face of the gate bookkeeping — FireStarter re-arms
+        loop members through it and Repeater's any-edge gate resets
+        through it, instead of either reaching into ``_gate_lock_``/
+        ``links_from`` directly (the lint pack's V-L02/V-L04 rules
+        enforce this)."""
+        with self._gate_lock_:
+            for key in self.links_from:
+                self.links_from[key] = False
+
     def _check_gate_and_run(self, src):
         """The hot loop body (ref ``units.py:782``)."""
         if not self.open_gate(src) and not self.ignores_gate:
@@ -329,11 +371,12 @@ class Unit(Distributable, metaclass=UnitRegistry):
         return self.total_run_time
 
     def describe(self):
+        topo = self.gate_topology()
         return {
             "name": self.name,
             "class": type(self).__name__,
-            "links_from": [u.name for u in self.links_from],
-            "links_to": [u.name for u in self.links_to],
-            "gate_block": bool(self.gate_block),
-            "gate_skip": bool(self.gate_skip),
+            "links_from": topo["incoming"],
+            "links_to": topo["outgoing"],
+            "gate_block": topo["gate_block"],
+            "gate_skip": topo["gate_skip"],
         }

@@ -161,10 +161,24 @@ class Workflow(Unit):
             for dst in unit.links_to:
                 if id(dst) not in seen_set:
                     frontier.append(dst)
+        appended = []
         for unit in self._units:
             if id(unit) not in seen_set:
                 seen_set.add(id(unit))
                 seen.append(unit)
+                appended.append(unit)
+        if appended and not getattr(self, "_warned_unreachable_",
+                                    False):
+            # one-time structured downgrade of the analyzer's V-G02
+            # finding: standalone runs see WHICH units silently ride
+            # in insertion order (master/slave payload fragility)
+            self._warned_unreachable_ = True
+            self.warning(
+                "V-G02: %d unit(s) unreachable from start_point, "
+                "appended in insertion order: %s — they initialize "
+                "but never run; `python -m veles_tpu.analyze` has the "
+                "full pre-flight report",
+                len(appended), ", ".join(u.name for u in appended))
         return seen
 
     def initialize(self, device=None, **kwargs):

@@ -38,6 +38,39 @@ def default_lr(solver):
     return 1.0 if str(solver) == "adadelta" else 0.01
 
 
+def probe_units(layer_specs, sample_shape):
+    """Instantiate + host-initialize one probe unit per layer spec:
+    numpy weight init, spec ``init`` weights injected, each unit's
+    ``output`` feeding the next unit's ``input`` — no jit, no device
+    buffers.  The construction half of :func:`lower_specs`, shared
+    with the static analyzer (:mod:`veles_tpu.analyze.shapes`) so spec
+    lowering and spec analysis can never diverge.  Raises on a broken
+    spec."""
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.units import UnitRegistry
+    from veles_tpu.znicz import (  # noqa: F401 - populate the registry
+        activation, all2all, conv, misc_units, normalization_units,
+        pooling, rnn)
+
+    wf = DummyWorkflow()
+    probe = Vector(numpy.zeros((2,) + tuple(sample_shape),
+                               numpy.float32))
+    units = []
+    for spec in layer_specs:
+        klass = UnitRegistry.mapped[spec["type"]]
+        unit = klass(wf, **dict(spec.get("->", {})))
+        unit.input = probe
+        unit.initialize(device=None)
+        init = spec.get("init")
+        if init:
+            unit.weights.reset(init["weights"])
+            if "bias" in init and unit.bias:
+                unit.bias.reset(init["bias"])
+        probe = unit.output
+        units.append(unit)
+    return units
+
+
 def lower_specs(layer_specs, sample_shape, loss="softmax",
                 compute_dtype=None, remat=False, grad_accum=1,
                 lr_adjuster=None, input_norm=None,
@@ -102,27 +135,10 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
                                             "fixed")),
             lr_adjuster.get("bias_lr_parameters",
                             lr_adjuster.get("lr_parameters")))
-    from veles_tpu.dummy import DummyWorkflow
-    from veles_tpu.units import UnitRegistry
-    from veles_tpu.znicz import (  # noqa: F401 - populate the registry
-        activation, all2all, conv, misc_units, normalization_units,
-        pooling, rnn)
-
-    wf = DummyWorkflow()
-    probe = Vector(numpy.zeros((2,) + tuple(sample_shape),
-                               numpy.float32))
+    units = probe_units(layer_specs, sample_shape)
     stages = []      # (pure_fn, config_dict, hyper_dict, skip_at_eval)
     params = []
-    for spec in layer_specs:
-        klass = UnitRegistry.mapped[spec["type"]]
-        unit = klass(wf, **dict(spec.get("->", {})))
-        unit.input = probe
-        unit.initialize(device=None)
-        init = spec.get("init")
-        if init:
-            unit.weights.reset(init["weights"])
-            if "bias" in init and unit.bias:
-                unit.bias.reset(init["bias"])
+    for spec, unit in zip(layer_specs, units):
         layer_params = unit.pure_params(host=True)
         layer_params = {k: numpy.array(v) for k, v in
                         layer_params.items()}
@@ -212,8 +228,6 @@ def lower_specs(layer_specs, sample_shape, loss="softmax",
             state["seed"] = numpy.int32(
                 prng.get("dropout").randint(0, 2 ** 30))
         params.append(state)
-        probe = unit.output
-    del wf
 
     def _ingest(x):
         """Entry cast + optional fused affine normalization (see
