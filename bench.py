@@ -516,12 +516,14 @@ def _conv_stage(metric, layers, input_shape, n_classes, batch, steps,
     _emit(metric, sec, batch, flops, vs=vs)
 
 
-def _wf_stage(metric, fused_config=None, sample=None, fused=True):
+def _wf_stage(metric, fused_config=None, sample=None, fused=True,
+              vs=None, extra=None):
     """The WHOLE framework path: StandardWorkflow(fused=True) — graph
     scheduling, loader epoch bookkeeping, Decision accounting, and the
     fused step — timed over full epochs via wf.run().  Every minibatch
     host-fetches its metrics (unless epoch_mode batches the fetches),
-    so the wall clock is honest by construction."""
+    so the wall clock is honest by construction.  Returns the measured
+    images/sec so ratio lines (eager vs fused) can chain stages."""
     from veles_tpu import prng
     from veles_tpu.backends import AutoDevice
     from veles_tpu.samples import mnist
@@ -547,12 +549,22 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True):
     # throughput nor an epoch time (VERDICT r3 item 7)
     from veles_tpu.loader.base import TRAIN
     train_samples = 2 * int(wf.loader.class_lengths[TRAIN])
-    _emit(metric, batch * elapsed / train_samples, batch, None)
+    sec_per_step = batch * elapsed / train_samples
+    _emit(metric, sec_per_step, batch, None, vs=vs, extra=extra)
+    return batch / sec_per_step
+
+
+#: fused mnist_wf images/sec from THIS ladder run — the eager stage's
+#: vs= denominator, so BENCH_*.json tracks the eager↔fused ratio per
+#: round instead of two unrelated absolutes (the whole ladder runs in
+#: one child process, mnist_wf before mnist_wf_eager in every order)
+_WF_FUSED_IPS = [None]
 
 
 def stage_mnist_wf():
-    _wf_stage("MNIST784 full StandardWorkflow(fused) train throughput "
-              "(epoch wall-clock incl. eval)")
+    _WF_FUSED_IPS[0] = _wf_stage(
+        "MNIST784 full StandardWorkflow(fused) train throughput "
+        "(epoch wall-clock incl. eval)")
 
 
 def stage_mnist_wf_epoch():
@@ -569,11 +581,23 @@ def stage_mnist_wf_epoch():
 def stage_mnist_wf_eager():
     """The EAGER unit-chain trainer (fused=False): what elastic
     master–slave jobs train through today (fused raises under the job
-    layer, fused_unit.py initialize).  This line quantifies the slave
-    throughput cost vs the mnist_wf fused line — VERDICT r4 weak
-    item 8 said nothing measured it."""
+    layer, fused_unit.py initialize).  Emits ``vs=`` the fused
+    ``mnist_wf`` line measured in the SAME ladder run, so the recorded
+    ``vs_baseline`` IS the eager↔fused throughput ratio the stitched
+    fast path (root.common.engine.stitch) is closing; re-measures the
+    fused twin in-process when BENCH_STAGES skipped ``mnist_wf``."""
+    fused_ips = _WF_FUSED_IPS[0]
+    if fused_ips is None:
+        fused_ips = _wf_stage(
+            "MNIST784 full StandardWorkflow(fused) train throughput "
+            "(epoch wall-clock incl. eval)")
+        _WF_FUSED_IPS[0] = fused_ips
+    from veles_tpu.config import root
     _wf_stage("MNIST784 full StandardWorkflow(eager unit chain) train "
-              "throughput (epoch wall-clock incl. eval)", fused=False)
+              "throughput (epoch wall-clock incl. eval)", fused=False,
+              vs=fused_ips,
+              extra={"stitch": root.common.engine.get("stitch", "on"),
+                     "vs_metric": "mnist_wf (fused, same run)"})
 
 
 def stage_mnist_wf_slave():

@@ -54,7 +54,11 @@ void ActRow(Act act, float* row, int64_t n) {
 // each streamed w row (4x less L2 traffic on w, four independent FMA
 // chains for the vectorized j loop); per-element accumulation order
 // is unchanged vs the single-row loop, so results are bitwise
-// identical.  The all-zero skip keeps the post-ReLU sparsity win.
+// identical.  The zero skip (post-ReLU sparsity win) applies PER ROW
+// even inside the 4-row block: a blocked `o += 0.0f * w` is NOT a
+// skip when w holds NaN/Inf (0·NaN = NaN), so rows with a zero ride a
+// per-row fallback while the common all-live case keeps the fused
+// 4-chain loop.
 // At namespace scope (declared in unit.h) so the component tests can
 // pit the blocked/remainder/zero-skip paths against a naive loop.
 void Gemm(const float* x, const float* w, const float* b, float* out,
@@ -77,16 +81,27 @@ void Gemm(const float* x, const float* w, const float* b, float* out,
       const float* x3 = x2 + k;
       for (int64_t kk = 0; kk < k; ++kk) {
         float v0 = x0[kk], v1 = x1[kk], v2 = x2[kk], v3 = x3[kk];
-        if (v0 == 0.0f && v1 == 0.0f && v2 == 0.0f && v3 == 0.0f)
-          continue;
+        bool z0 = v0 == 0.0f, z1 = v1 == 0.0f, z2 = v2 == 0.0f,
+             z3 = v3 == 0.0f;
+        if (z0 && z1 && z2 && z3) continue;
         const float* wrow = w + kk * n;
-        for (int64_t j = 0; j < n; ++j) {
-          float wv = wrow[j];
-          o0[j] += v0 * wv;
-          o1[j] += v1 * wv;
-          o2[j] += v2 * wv;
-          o3[j] += v3 * wv;
+        if (!z0 && !z1 && !z2 && !z3) {
+          // all four rows live: the vectorized 4-chain loop
+          for (int64_t j = 0; j < n; ++j) {
+            float wv = wrow[j];
+            o0[j] += v0 * wv;
+            o1[j] += v1 * wv;
+            o2[j] += v2 * wv;
+            o3[j] += v3 * wv;
+          }
+          continue;
         }
+        // mixed: skip exactly the zero rows (bitwise-identical to the
+        // single-row loop even for NaN/Inf weights)
+        if (!z0) for (int64_t j = 0; j < n; ++j) o0[j] += v0 * wrow[j];
+        if (!z1) for (int64_t j = 0; j < n; ++j) o1[j] += v1 * wrow[j];
+        if (!z2) for (int64_t j = 0; j < n; ++j) o2[j] += v2 * wrow[j];
+        if (!z3) for (int64_t j = 0; j < n; ++j) o3[j] += v3 * wrow[j];
       }
     }
     for (; i < end; ++i) {

@@ -8,6 +8,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <limits>
 #include <string>
 #include <vector>
 
@@ -198,6 +199,49 @@ void TestGemm() {
       std::vector<float> out(m * n, -777.0f);
       Gemm(x.data(), w.data(), bias, out.data(), m, k, n, &engine);
       for (int64_t i = 0; i < m * n; ++i) CHECK(out[i] == ref[i]);
+    }
+  }
+
+  // NaN/Inf weights: the per-row zero skip must hold INSIDE the 4-row
+  // block too — a blocked `o += 0.0f * NaN` would poison the row the
+  // single-row loop leaves clean.  Row 1 of the block is all-zero
+  // (skipped), rows 0/2/3 are live but zero exactly at the NaN/Inf
+  // weight rows, so every output must stay finite and bitwise equal
+  // to the naive per-row-skip loop.
+  {
+    const int64_t mm = 6;  // 4-row block + 2 remainder rows
+    std::vector<float> xn(mm * k), wn(k * n), bn(n);
+    for (auto& v : xn) v = next();
+    for (auto& v : wn) v = next();
+    for (auto& v : bn) v = next();
+    for (int64_t kk = 0; kk < k; ++kk) xn[1 * k + kk] = 0.0f;
+    for (int64_t i = 0; i < mm; ++i) {
+      xn[i * k + 2] = 0.0f;  // every row zero at the NaN weight row
+      xn[i * k + 4] = 0.0f;  // ...and at the Inf weight row
+    }
+    const float nan = std::nanf("");
+    const float inf = std::numeric_limits<float>::infinity();
+    for (int64_t j = 0; j < n; ++j) {
+      wn[2 * n + j] = nan;
+      wn[4 * n + j] = inf;
+    }
+    std::vector<float> refn(mm * n);
+    for (int64_t i = 0; i < mm; ++i)
+      for (int64_t j = 0; j < n; ++j) {
+        float acc = bn[j];
+        for (int64_t kk = 0; kk < k; ++kk) {
+          float xv = xn[i * k + kk];
+          if (xv == 0.0f) continue;  // the single-row skip rule
+          acc += xv * wn[kk * n + j];
+        }
+        refn[i * n + j] = acc;
+      }
+    std::vector<float> outn(mm * n, -777.0f);
+    Gemm(xn.data(), wn.data(), bn.data(), outn.data(), mm, k, n,
+         &engine);
+    for (int64_t i = 0; i < mm * n; ++i) {
+      CHECK(std::isfinite(outn[i]));
+      CHECK(outn[i] == refn[i]);
     }
   }
 

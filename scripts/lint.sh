@@ -1,9 +1,23 @@
 #!/usr/bin/env bash
 # Self-lint veles_tpu/ with the analyze lint pack (pass 3) — the same
 # invocation the tier-1 suite gates on (test_analyze.py::
-# test_lint_self_clean_tier1).  Extra args pass through, e.g.
+# test_lint_self_clean_tier1) — then run the workflow analyzer (graph
+# doctor + JAX hazard pass, V-J06 included) over the samples/ demo
+# modules that build a real training graph; warnings print, errors
+# fail.  samples/analyze_demo is deliberately broken (it exercises the
+# rule catalog) and is covered by test_analyze.py instead.
+# Extra args pass through to the lint invocation, e.g.
 #   scripts/lint.sh --json
 #   scripts/lint.sh path/to/other/package
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m veles_tpu.analyze --lint "$@"
+if [ "$#" -gt 0 ]; then
+  # passthrough mode (--json, explicit paths): keep the output pure —
+  # machine consumers parse it
+  exec env JAX_PLATFORMS=cpu python -m veles_tpu.analyze --lint "$@"
+fi
+env JAX_PLATFORMS=cpu python -m veles_tpu.analyze --lint
+for sample in veles_tpu.samples.mnist veles_tpu.samples.mnist_ae; do
+  echo "== analyze $sample =="
+  env JAX_PLATFORMS=cpu python -m veles_tpu.analyze "$sample"
+done

@@ -236,6 +236,72 @@ def test_shapes_batch_bucket_fit():
     assert "V-J04" not in rules_of(findings)
 
 
+def test_shapes_map_read_hot_loop_rule():
+    """V-J06: per-minibatch map_read()/map_write() Vector round-trips
+    are flagged in run()/tpu_run() of hot-loop units ONLY — numpy_run
+    is the declared interpret path, and a unit off the hot loop keeps
+    the plain V-J05 scan."""
+    from veles_tpu.analyze.shapes import scan_transfer_hazards
+
+    class CoherenceHappyUnit(Unit):
+        hide_from_registry = True
+
+        def run(self):
+            self.output.map_read()
+            self.weights.map_write()
+
+        def numpy_run(self):
+            self.output.map_read()      # legitimate: debug path
+
+    wf = DummyWorkflow()
+    unit = CoherenceHappyUnit(wf, name="coherence_happy")
+    hot = scan_transfer_hazards(unit, hot_loop=True)
+    assert rules_of(hot) == {"V-J06"}
+    assert len(hot) == 2                # run() only, not numpy_run()
+    assert not scan_transfer_hazards(unit)   # off the hot loop: clean
+
+
+def test_shapes_hot_loop_scan_covers_evaluator_and_gds():
+    """check_shapes scans the whole train hot loop (forwards +
+    evaluator + gd chain) — and the ported device-resident evaluators
+    leave a real eager workflow V-J06-clean."""
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class TinyLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            self.original_data.mem = rng.standard_normal(
+                (40, 8)).astype(numpy.float32)
+            self.original_labels = [int(i % 4) for i in range(40)]
+            self.class_lengths[:] = [0, 0, 40]
+
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: TinyLoader(w, minibatch_size=8),
+        layers=[{"type": "softmax",
+                 "->": {"output_sample_shape": 4}}],
+        decision_config={"max_epochs": 1})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=NumpyDevice())
+    findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
+    assert "V-J06" not in rules_of(findings), \
+        [f.render() for f in findings]
+
+    # a host-syncing unit planted on the gd chain IS flagged
+    class HostyGD(Unit):
+        hide_from_registry = True
+
+        def run(self):
+            self.err_output.map_read()
+
+    wf.gds.append(HostyGD(wf, name="hosty"))
+    findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
+    assert "V-J06" in rules_of(findings)
+
+
 # -- pass 3: lint pack ------------------------------------------------------
 
 def test_lint_self_clean_tier1():

@@ -14,6 +14,15 @@ specialization + binary cache collapses into :meth:`AcceleratedUnit.jit`
 — XLA retraces per input shape and caches compiles; the unit-level cache
 table keyed by (fn, shapes) keeps retrace bookkeeping observable the way
 the reference's ``.cache`` dir was.
+
+Scheduler fast path: a unit additionally exposing ``stitch_stage()``
+(a pure stage over its Vectors) can be fused with its neighbours into
+ONE XLA program per segment at ``Workflow.initialize()`` — see
+:mod:`veles_tpu.stitch` and ``docs/engine_fast_path.md``.  When a
+stitched workflow runs, the segment executes at the head unit's
+``run_wrapped`` and member ``tpu_run`` bodies are skipped for that
+pass; ``root.common.engine.stitch = off`` (or any direct ``run()``
+call) keeps the per-unit dispatch below.
 """
 
 import jax

@@ -48,6 +48,11 @@ RULES = {
               "host-device transfer hazard in a run() body: "
               "np.asarray/jax.device_get/.block_until_ready on device "
               "values forces a sync inside the hot loop"),
+    "V-J06": ("warning",
+              "per-minibatch map_read() host sync in the run() of a "
+              "unit on the train hot loop: the Vector coherence "
+              "round-trip (device fetch + host math + re-upload) "
+              "serializes JAX async dispatch every step"),
 }
 
 #: dotted call names that force a device→host sync
@@ -57,6 +62,10 @@ _SYNC_CALLS = {
 }
 #: attribute-call tails that force a sync regardless of receiver
 _SYNC_METHODS = {"block_until_ready", "item"}
+#: Vector-coherence method tails that force a device→host round-trip
+#: (V-J06; map_write implies map_read, map_invalidate implies a later
+#: re-upload of host bytes)
+_MAP_READ_METHODS = {"map_read", "map_write"}
 
 
 def _rule(rule_id):
@@ -109,9 +118,12 @@ def _module_index(path):
     return index
 
 
-def scan_transfer_hazards(unit):
+def scan_transfer_hazards(unit, hot_loop=False):
     """AST-scan ``run``/``tpu_run`` of ``unit``'s class for forced
-    host syncs; returns Findings (V-J05)."""
+    host syncs; returns Findings (V-J05, and V-J06 ``map_read``/
+    ``map_write`` coherence round-trips when ``hot_loop`` marks the
+    unit as part of the per-minibatch train chain).  ``numpy_run`` —
+    the declared interpret/debug path — is deliberately not scanned."""
     findings = []
     cls = type(unit)
     for meth_name in ("run", "tpu_run"):
@@ -141,9 +153,25 @@ def scan_transfer_hazards(unit):
             # name as fallback (non-Name receivers like f(x).item())
             name = (index.resolve_call(node.func) if index else None) \
                 or _call_name(node.func)
+            line = base_line + node.lineno - 1
+            if hot_loop and name \
+                    and name.rsplit(".", 1)[-1] in _MAP_READ_METHODS:
+                findings.append(Finding(
+                    *_rule("V-J06"),
+                    message="%s.%s calls %s per minibatch on the "
+                            "train hot loop — the Vector coherence "
+                            "round-trip stalls async dispatch every "
+                            "step"
+                            % (cls.__name__, meth_name,
+                               name.lstrip(".") + "()"),
+                    unit=unit.name,
+                    location="%s:%d" % (path, line) if path else None,
+                    fix="port the body to jitted device math over "
+                        "Vector.devmem (see znicz/evaluator.py) and "
+                        "defer metric fetches to epoch boundaries"))
+                continue
             if not _is_sync_call(name):
                 continue
-            line = base_line + node.lineno - 1
             findings.append(Finding(
                 *_rule("V-J05"),
                 message="%s.%s calls %s — a forced host sync inside "
@@ -207,9 +235,17 @@ def check_shapes(workflow, sample_shape=None, batch_size=None):
                     "coincide" % (bucket // 2, bucket)))
     batch = batch or 1
 
-    # V-J05 — transfer hazards in the forward chain's run bodies.
-    for unit in forwards:
-        findings.extend(scan_transfer_hazards(unit))
+    # V-J05/V-J06 — transfer hazards in the train hot loop's run
+    # bodies: the forward chain, plus the evaluator and GD chain when
+    # the workflow exposes them (every one of these runs per
+    # minibatch, so a map_read there is a per-step pipeline stall).
+    hot_units = list(forwards)
+    evaluator = getattr(workflow, "evaluator", None)
+    if evaluator is not None:
+        hot_units.append(evaluator)
+    hot_units.extend(getattr(workflow, "gds", None) or [])
+    for unit in hot_units:
+        findings.extend(scan_transfer_hazards(unit, hot_loop=True))
 
     if not forwards and not specs:
         findings.append(Finding(

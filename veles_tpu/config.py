@@ -148,6 +148,16 @@ root.common.update({
             # Logical mesh axes for pjit sharding; data-parallel by default.
             "axes": {"data": -1},   # -1 = all devices
         },
+        # Eager unit-chain fast path: stitch maximal runs of pure jitted
+        # units into ONE XLA program each at Workflow.initialize()
+        # ("on" | "off"; honored by Workflow.run() and the job-layer
+        # slave path — "off" restores the per-unit dispatch path).
+        "stitch": "on",
+        # Deferred-metric fetch cadence for the device-resident
+        # evaluators: 0 = one batched fetch per epoch/class boundary;
+        # K > 0 additionally flushes every K minibatches (bounds the
+        # async dispatch queue on very long epochs).
+        "metrics_every": 0,
         "interpret": False,         # run Pallas kernels in interpret mode
     },
     "thread_pool": {"max_workers": 8},

@@ -217,6 +217,27 @@ class Vector(Pickleable):
             pass
 
 
+def device_get_all(values):
+    """Fetch a mixed list of device scalars / arrays / host numbers in
+    ONE batched ``jax.device_get`` (a single transfer+sync instead of
+    one per value) — the deferred-metrics fetch the device-resident
+    evaluators rely on: per-minibatch metrics stay async device
+    scalars, and epoch accounting pays exactly one round-trip.
+
+    Host values (ints, floats, numpy) pass through untouched, so
+    callers may mix eager (interpret) and device metrics freely."""
+    device_idx = [i for i, v in enumerate(values)
+                  if not isinstance(v, (int, float, numpy.number))
+                  and not isinstance(v, numpy.ndarray)]
+    out = list(values)
+    if device_idx:
+        import jax
+        fetched = jax.device_get([values[i] for i in device_idx])
+        for i, val in zip(device_idx, fetched):
+            out[i] = val
+    return out
+
+
 #: Reference-compatible alias (the reference class is ``Array``,
 #: ``memory.py:110``; "Vector" is what Znicz unit attributes call theirs).
 Array = Vector

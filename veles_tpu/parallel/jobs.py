@@ -446,6 +446,19 @@ class JobClient(Logger):
             raise ConnectionError(
                 "master rejected us: %s" % reply.get("reason"))
         self.sid = reply["id"]
+        # the eager fast path on the job layer: surface what the
+        # per-job run() will actually dispatch — every job pays
+        # O(segments) programs, not O(units).  (Slave-mode graph
+        # surgery already re-stitched inside StandardWorkflow
+        # .initialize, so the report reflects the post-surgery chain.)
+        report = getattr(self.workflow, "stitch_report", None)
+        if report is not None:
+            info = report()
+            if info["segments"]:
+                self.info("stitched slave fast path: %d segment(s) "
+                          "per job (%s)", len(info["segments"]),
+                          "; ".join("+".join(names)
+                                    for names in info["segments"]))
         return self
 
     def run(self, max_jobs=None):

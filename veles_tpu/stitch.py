@@ -1,0 +1,352 @@
+"""Automatic segment stitching: the eager unit-chain fast path.
+
+The eager trainer — the only path the elastic master–slave job layer
+can use — historically dispatched one XLA program per unit per
+minibatch, plus a host round-trip in the evaluator.  This module closes
+that gap without changing graph semantics: at ``Workflow.initialize()``
+the linked unit chain is walked and every maximal contiguous run of
+*pure jitted* units (the forward chain, the GD chain) is compiled into
+ONE XLA program, dispatched as a single call when the run's first unit
+fires.  Impure/host units (Loader, Decision, plotters, stream units)
+stay as barriers; the gate protocol is untouched — a stitched member
+still opens its gate and propagates control normally, its ``run()``
+body just becomes a no-op because the segment head already computed it.
+
+Unit protocol: a unit opts in by returning a :class:`StitchStage` from
+``stitch_stage()`` (default ``None``).  A stage declares, by Vector
+identity, what it consumes, produces, which parameter buffers it reads
+and which it DONATES (updated in place on HBM, mirroring the eager GD
+units' ``donate_argnums``), plus host scalars fetched per call (traced,
+so an LRAdjuster changing learning rates never retraces) and device
+metrics (published back as async device scalars — fetched deferred, see
+``root.common.engine.metrics_every``).
+
+Segment eligibility (checked per chain link ``u → v``):
+
+* ``u.links_to == {v}`` and ``v.links_from == {u}`` — strictly linear
+  control flow inside the segment (head may have any fan-in, tail any
+  fan-out);
+* ``v`` neither ignores its gate nor wants a thread;
+* ``v.gate_block`` is a constant-False cell and ``v.gate_skip`` is
+  either constant-False or the SAME shared cell as the head's (the
+  GD chain's per-class skip gate), so a skipped head implies skipped
+  members and vice versa.
+
+``root.common.engine.stitch = off`` restores the seed per-unit
+execution path byte for byte (segments are simply not built).
+"""
+
+import jax
+
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+from veles_tpu.memory import Vector
+from veles_tpu.mutable import Bool
+
+
+def enabled():
+    """The config switch, read at call time so ``run()`` honors flips
+    between initialize and run."""
+    value = root.common.engine.get("stitch", "on")
+    if isinstance(value, str):
+        return value.lower() not in ("off", "0", "false", "no")
+    return bool(value)
+
+
+class StitchStage(object):
+    """One unit's contribution to a stitched program.
+
+    ``fn(tensors)`` is a pure jax-traceable callable receiving a dict
+    with every declared name (consumes + params + donated + scalars)
+    and returning a dict with every ``produces`` name, every ``donated``
+    name (the updated buffer) and every ``metrics`` name (device
+    scalars assigned onto the unit after the call).
+    """
+
+    __slots__ = ("unit", "fn", "consumes", "produces", "params",
+                 "donated", "scalars", "metrics")
+
+    def __init__(self, unit, fn, consumes=None, produces=None,
+                 params=None, donated=None, scalars=None, metrics=()):
+        self.unit = unit
+        self.fn = fn
+        self.consumes = dict(consumes or {})
+        self.produces = dict(produces or {})
+        self.params = dict(params or {})
+        self.donated = dict(donated or {})
+        #: callable → {name: python scalar}, fetched at every dispatch
+        self.scalars = scalars
+        self.metrics = tuple(metrics)
+
+    def vectors(self):
+        for group in (self.consumes, self.produces, self.params,
+                      self.donated):
+            for vec in group.values():
+                yield vec
+
+
+class StitchSegment(Logger):
+    """A maximal run of stitchable units compiled into one program."""
+
+    def __init__(self, units, stages):
+        super(StitchSegment, self).__init__()
+        self.units = list(units)
+        self.stages = list(stages)
+        self.head = self.units[0]
+        self.dispatches = 0
+        self._computed = set()
+        self._member_ids = frozenset(id(u) for u in self.units[1:])
+        self._build_plan()
+        self._jitted = jax.jit(self._program, donate_argnums=(2,))
+
+    @property
+    def names(self):
+        return [u.name for u in self.units]
+
+    def __repr__(self):
+        return "<StitchSegment %s>" % "+".join(self.names)
+
+    # -- plan ---------------------------------------------------------------
+    def _build_plan(self):
+        produced = {}                 # id(vec) -> producing stage index
+        input_vecs = []               # segment-external reads, ordered
+        input_ids = {}
+        ro_vecs, don_vecs = [], []
+        ro_slots, don_slots, scalar_slots = [], [], []
+        scalar_fetchers = []
+        metric_spec = []
+        for si, stage in enumerate(self.stages):
+            for vec in stage.consumes.values():
+                if id(vec) not in produced and id(vec) not in input_ids:
+                    input_ids[id(vec)] = len(input_vecs)
+                    input_vecs.append(vec)
+            ro_slots.append([])
+            for name, vec in sorted(stage.params.items()):
+                ro_slots[si].append((len(ro_vecs), name))
+                ro_vecs.append(vec)
+            don_slots.append([])
+            for name, vec in sorted(stage.donated.items()):
+                don_slots[si].append((len(don_vecs), name))
+                don_vecs.append(vec)
+            scalar_slots.append(None)
+            if stage.scalars is not None:
+                names = tuple(sorted(stage.scalars()))
+                base = sum(len(n) for _stage, n in scalar_fetchers)
+                scalar_slots[si] = [(base + i, n)
+                                    for i, n in enumerate(names)]
+                scalar_fetchers.append((stage, names))
+            for name, vec in stage.produces.items():
+                produced[id(vec)] = si
+            for name in stage.metrics:
+                metric_spec.append((stage.unit, name))
+        # Donation soundness: a donated buffer must be owned by exactly
+        # ONE stage and must not double as an env input / read-only
+        # param / produced value anywhere in the segment — the call
+        # would pass the same jax.Array as a donated leaf AND a live
+        # alias (donation invalidates the alias), or a later stage
+        # would read a stale pre-update buffer.  Reject loudly;
+        # build_segments falls back to per-unit dispatch.
+        don_ids = [id(vec) for vec in don_vecs]
+        aliased = (len(don_ids) != len(set(don_ids))
+                   or any(i in input_ids for i in don_ids)
+                   or any(i in produced for i in don_ids)
+                   or any(id(vec) in don_ids for vec in ro_vecs))
+        if aliased:
+            raise ValueError(
+                "segment %s aliases a donated Vector with another "
+                "read/write slot — not stitchable" % "+".join(
+                    u.name for u in self.units))
+        # publish EVERY produced vector: downstream host units (plotters,
+        # image saver, the next segment) read through Vector coherence
+        output_vecs, seen = [], set()
+        for stage in self.stages:
+            for vec in stage.produces.values():
+                if id(vec) not in seen:
+                    seen.add(id(vec))
+                    output_vecs.append(vec)
+        self._input_vecs = input_vecs
+        self._ro_vecs, self._don_vecs = ro_vecs, don_vecs
+        self._ro_slots, self._don_slots = ro_slots, don_slots
+        self._scalar_slots = scalar_slots
+        self._scalar_fetchers = scalar_fetchers
+        self._output_vecs = output_vecs
+        self._metric_spec = metric_spec
+
+    def _program(self, inputs, ro, don, scalars):
+        env = {id(vec): arr
+               for vec, arr in zip(self._input_vecs, inputs)}
+        new_don = list(don)
+        metrics = []
+        for si, stage in enumerate(self.stages):
+            tensors = {name: env[id(vec)]
+                       for name, vec in stage.consumes.items()}
+            for pos, name in self._ro_slots[si]:
+                tensors[name] = ro[pos]
+            for pos, name in self._don_slots[si]:
+                tensors[name] = don[pos]
+            if self._scalar_slots[si]:
+                for pos, name in self._scalar_slots[si]:
+                    tensors[name] = scalars[pos]
+            out = stage.fn(tensors)
+            for name, vec in stage.produces.items():
+                env[id(vec)] = out[name]
+            for pos, name in self._don_slots[si]:
+                new_don[pos] = out[name]
+            for name in stage.metrics:
+                metrics.append(out[name])
+        outputs = [env[id(vec)] for vec in self._output_vecs]
+        return outputs, new_don, metrics
+
+    # -- execution ----------------------------------------------------------
+    def execute(self):
+        """Dispatch the whole segment as one program and publish."""
+        inputs = tuple(vec.devmem for vec in self._input_vecs)
+        ro = tuple(vec.devmem for vec in self._ro_vecs)
+        don = tuple(vec.devmem for vec in self._don_vecs)
+        scalars = []
+        for stage, names in self._scalar_fetchers:
+            values = stage.scalars()
+            scalars.extend(float(values[n]) for n in names)
+        outputs, new_don, metrics = self._jitted(
+            inputs, ro, don, tuple(scalars))
+        for vec, arr in zip(self._output_vecs, outputs):
+            vec.devmem = arr
+        for vec, arr in zip(self._don_vecs, new_don):
+            vec.devmem = arr
+        for (unit, name), value in zip(self._metric_spec, metrics):
+            setattr(unit, name, value)
+        self.dispatches += 1
+        self._computed = set(self._member_ids)
+
+    def member_run(self, unit):
+        """The per-unit hook: the head dispatches the program, members
+        are no-ops for the pass the head computed.  A member firing
+        without a preceding head dispatch (out-of-band scheduling)
+        falls back to its own eager ``run()`` — correctness first."""
+        if unit is self.head:
+            self.execute()
+            return
+        if id(unit) in self._computed:
+            self._computed.discard(id(unit))
+            return
+        unit.run()
+
+    def reset_pass(self):
+        """Forget any half-consumed pass (an interrupted run left
+        members unconsumed): the next member firing without a fresh
+        head dispatch must take the eager fallback, not a stale
+        no-op.  Workflow.run() calls this before each drain."""
+        self._computed = set()
+
+    def detach(self):
+        for unit in self.units:
+            unit.attach_stitch_segment(None)
+
+
+# -- builders ---------------------------------------------------------------
+
+def _constant_false(cell):
+    """A plain Bool(False) with no expression: the gate can never flip
+    under this segment's feet."""
+    return (type(cell) is Bool and cell._expr is None
+            and cell._value is False)
+
+
+def _gate_compatible(head, unit):
+    if unit.ignores_gate or unit.wants_thread:
+        return False
+    if not _constant_false(unit.gate_block):
+        return False
+    return (_constant_false(unit.gate_skip)
+            or unit.gate_skip is head.gate_skip)
+
+
+def _stage_of(unit, cache):
+    if id(unit) not in cache:
+        maker = getattr(unit, "stitch_stage", None)
+        stage = None
+        if callable(maker):
+            try:
+                stage = maker()
+            except Exception:
+                unit.exception("stitch_stage() of %r failed; unit "
+                               "stays on the per-unit path", unit)
+                stage = None
+        cache[id(unit)] = stage
+    return cache[id(unit)]
+
+
+def _vectors_ready(stage, device):
+    for vec in stage.vectors():
+        if not isinstance(vec, Vector) or not vec:
+            return False
+        if vec.device is None:
+            vec.initialize(device)
+    return True
+
+
+def build_segments(workflow):
+    """Walk the control graph and return the list of compiled
+    :class:`StitchSegment`\\ s (empty when stitching is off, the device
+    is interpret/absent, or no chain qualifies).  Members get their
+    segment attached via the public ``Unit.attach_stitch_segment``."""
+    if not enabled():
+        return []
+    device = getattr(workflow, "device", None)
+    if device is None or getattr(device, "is_interpret", True):
+        return []
+    cache = {}
+    assigned = set()
+    segments = []
+    for unit in workflow.units_in_dependency_order():
+        if id(unit) in assigned or unit is workflow:
+            continue
+        head_stage = _stage_of(unit, cache)
+        if head_stage is None or unit.wants_thread \
+                or getattr(unit, "force_numpy", False):
+            continue
+        chain = [unit]
+        stages = [head_stage]
+        cur = unit
+        while True:
+            targets = list(cur.links_to)
+            if len(targets) != 1:
+                break
+            nxt = targets[0]
+            if id(nxt) in assigned or len(nxt.links_from) != 1 \
+                    or not _gate_compatible(unit, nxt):
+                break
+            stage = _stage_of(nxt, cache)
+            if stage is None:
+                break
+            chain.append(nxt)
+            stages.append(stage)
+            cur = nxt
+        if len(chain) < 2:
+            continue
+        blocked = [s.unit.name for s in stages
+                   if not _vectors_ready(s, device)]
+        if blocked:
+            workflow.info(
+                "not stitching %s: %s exposes an empty/unallocated "
+                "Vector (initialize() the unit first); chain stays on "
+                "per-unit dispatch",
+                "+".join(u.name for u in chain), ", ".join(blocked))
+            continue
+        try:
+            segment = StitchSegment(chain, stages)
+        except Exception:
+            workflow.exception(
+                "failed to stitch segment %s; falling back to "
+                "per-unit dispatch", [u.name for u in chain])
+            continue
+        for member in chain:
+            member.attach_stitch_segment(segment)
+            assigned.add(id(member))
+        segments.append(segment)
+    if segments:
+        workflow.info(
+            "stitched %d segment(s): %s",
+            len(segments),
+            "; ".join("+".join(s.names) for s in segments))
+    return segments

@@ -116,6 +116,9 @@ class Unit(Distributable, metaclass=UnitRegistry):
         super(Unit, self).init_unpickled()
         self._gate_lock_ = threading.Lock()
         self._run_lock_ = threading.Lock()
+        # stitched-segment membership is transient (segments hold jitted
+        # programs); Workflow.initialize rebuilds it after unpickling
+        self._stitch_segment_ = None
         if not hasattr(self, "_workflow_ref_"):
             # standalone unpickle; Workflow.__setstate__ re-links members
             self._workflow_ref_ = None
@@ -271,6 +274,23 @@ class Unit(Distributable, metaclass=UnitRegistry):
                     pass
         return remapped
 
+    # -- segment stitching (the eager fast path, veles_tpu.stitch) ----------
+    def stitch_stage(self):
+        """Return this unit's pure :class:`veles_tpu.stitch.StitchStage`
+        for segment stitching, or ``None`` (the default: the unit is a
+        barrier — host work, dynamic control, or no pure form)."""
+        return None
+
+    def attach_stitch_segment(self, segment):
+        """Public face of the segment-membership bookkeeping (the lint
+        pack's V-L02 rule keeps the builder from reaching into
+        ``_stitch_segment_`` directly)."""
+        self._stitch_segment_ = segment
+
+    @property
+    def stitch_segment(self):
+        return self._stitch_segment_
+
     # -- interface verification (replaces zope.interface, verified.py:45) --
     def verify_interface(self):
         missing = [n for n in self._demanded
@@ -338,13 +358,24 @@ class Unit(Distributable, metaclass=UnitRegistry):
         self.run_dependent()
 
     def run_wrapped(self):
-        """run() with timing + stop-check (ref ``units.py:184-196``)."""
+        """run() with timing + stop-check (ref ``units.py:184-196``).
+
+        When the workflow runs with segment stitching active, a
+        stitched unit executes through its segment here: the head
+        dispatches the whole fused program, members no-op for that
+        pass.  Direct ``unit.run()`` calls (tests, manual drives)
+        bypass this and keep the per-unit eager path."""
         wf = self.workflow
         if wf is not None and wf.stopped:
             return
+        segment = self._stitch_segment_
         tic = time.time()
         try:
-            self.run()
+            if segment is not None and wf is not None \
+                    and getattr(wf, "stitch_active", False):
+                segment.member_run(self)
+            else:
+                self.run()
         except Exception:
             self.error("failed to run %r", self)
             if wf is not None:

@@ -77,6 +77,14 @@ class Workflow(Unit):
         self._inflight_ = 0
         self._finished_event_ = threading.Event()
         self._job_callback_ = None
+        # stitched segments hold jitted programs → transient; rebuilt by
+        # initialize() (which re-runs after every unpickle-and-resume)
+        self._stitch_segments_ = []
+        self._stitch_active_ = False
+        #: was the switch on when segments were last (re)built?  run()
+        #: uses this to honor an off→on flip without re-walking the
+        #: graph on every call (slaves run() once per job)
+        self._stitch_built_enabled_ = False
 
     def __setstate__(self, state):
         super(Workflow, self).__setstate__(state)
@@ -208,7 +216,40 @@ class Workflow(Unit):
                 pending.append(unit)
         self._is_initialized = True
         self.stopped = False
+        self.rebuild_stitching()
         return self
+
+    # -- segment stitching (the eager fast path, veles_tpu.stitch) ----------
+    def rebuild_stitching(self):
+        """(Re)walk the unit chain and compile maximal runs of pure
+        jitted units into single XLA programs (see
+        :mod:`veles_tpu.stitch`).  Called at the end of
+        :meth:`initialize` and again after any graph surgery (e.g. the
+        slave-mode back-edge removal)."""
+        from veles_tpu import stitch
+        for segment in self._stitch_segments_:
+            segment.detach()
+        self._stitch_segments_ = stitch.build_segments(self)
+        self._stitch_built_enabled_ = stitch.enabled()
+        return self._stitch_segments_
+
+    @property
+    def stitch_active(self):
+        """True while run() executes with stitched segments live."""
+        return self._stitch_active_
+
+    def stitch_report(self):
+        """Observability: segment composition + dispatch counts (the
+        compile/dispatch-count tests and the job layer's slave log
+        read this)."""
+        from veles_tpu import stitch
+        return {
+            "enabled": stitch.enabled(),
+            "segments": [segment.names
+                         for segment in self._stitch_segments_],
+            "dispatches": sum(segment.dispatches
+                              for segment in self._stitch_segments_),
+        }
 
     # -- execution ----------------------------------------------------------
     def schedule(self, unit, src):
@@ -226,6 +267,20 @@ class Workflow(Unit):
             raise RuntimeError("initialize() the workflow before run()")
         if self.is_master:
             return
+        from veles_tpu import stitch
+        # honored per run in BOTH directions: off after initialize
+        # restores the per-unit path; on after an off-initialize builds
+        # the missed segments now (once — not a graph re-walk per job)
+        if stitch.enabled() and not self._stitch_segments_ \
+                and not self._stitch_built_enabled_:
+            self.rebuild_stitching()
+        self._stitch_active_ = (bool(self._stitch_segments_)
+                                and stitch.enabled())
+        for segment in self._stitch_segments_:
+            # an interrupted previous run may have left members
+            # unconsumed — stale pass state must not suppress the
+            # eager fallback
+            segment.reset_pass()
         self.stopped = False
         self._finished_event_.clear()
         tic = time.time()

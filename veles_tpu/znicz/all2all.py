@@ -198,6 +198,29 @@ class All2AllSoftmax(All2All):
         self.output.devmem = sm
         self.max_idx.devmem = jnp.argmax(logits, axis=1).astype(jnp.int32)
 
+    def stitch_stage(self):
+        """The softmax forward additionally publishes ``max_idx`` (the
+        evaluator's argmax input) from inside the stitched program."""
+        import jax.numpy as jnp
+        from veles_tpu.stitch import StitchStage
+        base = super(All2AllSoftmax, self).stitch_stage()
+        if base is None or not self.max_idx:
+            return base
+        inner = base.fn
+
+        def fn(t):
+            out = inner(t)
+            # argmax over the softmax equals argmax over the logits
+            # (strictly monotone per row), so max_idx needs no second
+            # matmul inside the program
+            out["max_idx"] = jnp.argmax(out["output"],
+                                        axis=1).astype(jnp.int32)
+            return out
+
+        base.fn = fn
+        base.produces["max_idx"] = self.max_idx
+        return base
+
 
 def _softmax(logits):
     import jax.numpy as jnp

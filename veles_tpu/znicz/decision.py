@@ -12,9 +12,14 @@ blocked by ``complete``).
 
 import numpy
 
+from veles_tpu.config import root
 from veles_tpu.loader.base import CLASS_NAME, TEST, TRAIN, VALID
 from veles_tpu.mutable import Bool
 from veles_tpu.units import Unit
+
+
+def _is_host_number(value):
+    return isinstance(value, (int, float, numpy.number))
 
 
 class DecisionBase(Unit):
@@ -38,6 +43,42 @@ class DecisionBase(Unit):
         self.effective_class_end_offsets = None
         self.demand("minibatch_class", "minibatch_size", "last_minibatch",
                     "epoch_ended", "epoch_number", "class_lengths")
+
+    def init_unpickled(self):
+        super(DecisionBase, self).init_unpickled()
+        # deferred per-class metric scalars from the device-resident
+        # evaluators: async jax arrays accumulated here, fetched in ONE
+        # batched device_get at class close (or every K minibatches) —
+        # transient by design: every flush point precedes a snapshot
+        self._pending_metrics_ = [[], [], []]
+
+    # -- deferred metric accounting (device-resident evaluators) ------------
+    def _accumulate_metric(self, sums, cls, value):
+        """Add a per-minibatch metric: host numbers apply immediately
+        (the seed behavior, and the fused trainer's path); device
+        scalars queue for a deferred batched fetch."""
+        if _is_host_number(value):
+            sums[cls] += float(value)
+            return
+        self._pending_metrics_[cls].append(value)
+        if self.is_slave:
+            # one job = one minibatch; the update payload fetches the
+            # metric right after anyway, so there is nothing to defer
+            # (and nothing to leak across ten thousand jobs)
+            self._flush_metrics(sums, cls)
+        else:
+            every = int(root.common.engine.get("metrics_every", 0) or 0)
+            if every > 0 and len(self._pending_metrics_[cls]) >= every:
+                self._flush_metrics(sums, cls)
+
+    def _flush_metrics(self, sums, cls):
+        pending = self._pending_metrics_[cls]
+        if not pending:
+            return
+        from veles_tpu.memory import device_get_all
+        sums[cls] += float(sum(float(v)
+                               for v in device_get_all(pending)))
+        del pending[:]
 
     def link_from_loader(self, loader):
         self.link_attrs(
@@ -72,10 +113,12 @@ class DecisionGD(DecisionBase):
 
     def run(self):
         cls = int(self.minibatch_class)
-        self.epoch_n_err[cls] += float(self.evaluator.n_err)
+        self._accumulate_metric(self.epoch_n_err, cls,
+                                self.evaluator.n_err)
         self.epoch_samples[cls] += int(self.minibatch_size)
         if not bool(self.last_minibatch):
             return
+        self._flush_metrics(self.epoch_n_err, cls)
         self._close_class(cls, check_epoch_end=bool(self.epoch_ended))
 
     def _close_class(self, cls, check_epoch_end):
@@ -163,10 +206,12 @@ class DecisionMSE(DecisionBase):
 
     def run(self):
         cls = int(self.minibatch_class)
-        self.epoch_sum_mse[cls] += float(self.evaluator.mse)
+        self._accumulate_metric(self.epoch_sum_mse, cls,
+                                self.evaluator.mse)
         self.epoch_batches[cls] += 1
         if not bool(self.last_minibatch):
             return
+        self._flush_metrics(self.epoch_sum_mse, cls)
         if self.epoch_batches[cls]:
             self.epoch_mse[cls] = \
                 self.epoch_sum_mse[cls] / self.epoch_batches[cls]

@@ -6,12 +6,76 @@ Parity target: Znicz ``evaluator.EvaluatorSoftmax`` / ``EvaluatorMSE``
 ``manualrst_veles_workflow_creation.rst:108-430``): emit ``err_output``
 for the gradient chain and accumulate ``n_err`` / ``confusion_matrix`` /
 loss values the Decision unit reads per minibatch.
+
+TPU re-design (the eager fast path): ``tpu_run`` is jitted device math
+over full padded buffers — validity masks come from the loader's ``-1``
+label padding (softmax) or the traced batch size (MSE), so one trace
+serves every batch size and ``err_output`` publishes via ``devmem``
+with NO host round-trip.  Metrics (``n_err``, ``loss``, ``mse``) become
+async device scalars the Decision unit accumulates and fetches
+DEFERRED (one batched ``jax.device_get`` per epoch/class close, or
+every ``root.common.engine.metrics_every`` minibatches), and the
+confusion matrix accumulates on device.  ``numpy_run`` keeps the seed
+host math as the interpret/debug path.
 """
 
+import jax
+import jax.numpy as jnp
 import numpy
 
 from veles_tpu.accelerated_units import AcceleratedUnit
 from veles_tpu.memory import Vector
+
+
+def _softmax_eval_math(out, labels, max_idx, confusion):
+    """δ = (y − onehot(label)) for valid rows, plus device metrics.
+
+    Rows with label < 0 (unlabeled samples AND the loader's short-batch
+    padding) are masked out of err/metrics — the device twin of the
+    host path's ``valid``/``[:batch]`` logic over padded buffers."""
+    out32 = out.astype(jnp.float32)
+    valid = labels >= 0
+    lbl = jnp.maximum(labels, 0)
+    onehot = jax.nn.one_hot(lbl, out.shape[1], dtype=jnp.float32)
+    err = jnp.where(valid[:, None], out32 - onehot, 0.0)
+    pred = max_idx.astype(labels.dtype)
+    n_err = ((pred != labels) & valid).sum()
+    probs = jnp.take_along_axis(out32, lbl[:, None], axis=1)[:, 0]
+    n_valid = valid.sum()
+    loss = jnp.where(
+        n_valid > 0,
+        -(jnp.log(jnp.maximum(probs, 1e-30))
+          * valid).sum() / jnp.maximum(n_valid, 1),
+        0.0)
+    if confusion is not None:
+        confusion = confusion.at[lbl, pred].add(
+            valid.astype(confusion.dtype))
+    return err, n_err, loss, confusion
+
+
+def _mse_eval_math(out, target, batch):
+    """δ = (y − t) for the first ``batch`` rows; squared-error metric
+    over those rows.  ``batch`` is a traced scalar so short epoch tails
+    reuse the same trace.
+
+    The host path squares in float64 because unnormalized activations
+    overflow float32 squares long before the gradient is invalid; TPUs
+    have no f64, so the device twin rescales per row by max|err| —
+    the normalized squares stay ≤ 1 and the rmse is exact for any err
+    the float32 BUFFER can hold (the un-rooted mse still saturates
+    when the true value exceeds float32 range, which f64 would not)."""
+    rows = out.shape[0]
+    out32 = out.reshape(rows, -1).astype(jnp.float32)
+    t32 = target.reshape(rows, -1).astype(jnp.float32)
+    valid = jnp.arange(rows) < batch
+    err = jnp.where(valid[:, None], out32 - t32, 0.0)
+    scale = jnp.maximum(jnp.max(jnp.abs(err), axis=1, keepdims=True),
+                        1e-30)
+    mean_sq_scaled = ((err / scale) ** 2).mean(axis=1)   # in [0, 1]
+    return err, mean_sq_scaled, scale[:, 0], valid
+
+
+_softmax_eval_step = jax.jit(_softmax_eval_math)
 
 
 class EvaluatorBase(AcceleratedUnit):
@@ -32,6 +96,15 @@ class EvaluatorBase(AcceleratedUnit):
         self.err_output.reset(numpy.zeros(self.output.shape,
                                           dtype=numpy.float32))
         self.err_output.initialize(self.device)
+
+    def _device_shapes_ok(self):
+        """The device path computes over the FULL padded buffers; a
+        hand-wired evaluator whose err_output disagrees with its
+        output buffer falls back to the host path."""
+        return (isinstance(self.output, Vector) and self.output
+                and self.err_output
+                and self.err_output.shape[0] == self.output.shape[0]
+                and self.err_output.size == self.output.size)
 
 
 class EvaluatorSoftmax(EvaluatorBase):
@@ -55,12 +128,11 @@ class EvaluatorSoftmax(EvaluatorBase):
         if self.compute_confusion_matrix:
             self.confusion_matrix.reset(numpy.zeros(
                 (n_classes, n_classes), dtype=numpy.int64))
+            self.confusion_matrix.initialize(self.device)
 
-    def run(self):
-        # Error statistics are host decisions (tiny); the δ fill is device
-        # math but the per-batch sizes are dynamic → keep host-side and
-        # publish via the Vector protocol.  The fused train step
-        # (znicz.fused) bypasses this unit entirely on the hot path.
+    def numpy_run(self):
+        # The interpret/debug path: host decisions over the valid
+        # prefix, published via the Vector protocol.
         self.output.map_read()
         self.labels.map_read()
         self.max_idx.map_read()
@@ -86,6 +158,55 @@ class EvaluatorSoftmax(EvaluatorBase):
             numpy.add.at(self.confusion_matrix.mem,
                          (labels[valid], pred[valid]), 1)
 
+    def tpu_run(self):
+        # Device math over the full padded buffers: err_output stays
+        # on HBM, n_err/loss stay async device scalars (fetched
+        # deferred by the Decision unit), confusion accumulates on
+        # device.  No map_read, no re-upload.
+        if not self._device_shapes_ok():
+            return self.numpy_run()
+        with_cm = bool(self.compute_confusion_matrix
+                       and self.confusion_matrix)
+        cm = self.confusion_matrix.devmem if with_cm else None
+        err, n_err, loss, cm = _softmax_eval_step(
+            self.output.devmem, self.labels.devmem,
+            self.max_idx.devmem, cm)
+        self.err_output.devmem = err
+        self.n_err = n_err
+        self.loss = loss
+        if with_cm:
+            self.confusion_matrix.devmem = cm
+
+    def stitch_stage(self):
+        """Fuse the δ/metric math into the forward segment's program
+        (the segment publishes err_output/max_idx Vectors and assigns
+        the metric device scalars after each dispatch)."""
+        from veles_tpu.stitch import StitchStage
+        if self.force_numpy or not self._device_shapes_ok() \
+                or not isinstance(self.labels, Vector) \
+                or not isinstance(self.max_idx, Vector):
+            return None
+        with_cm = bool(self.compute_confusion_matrix
+                       and self.confusion_matrix)
+
+        def fn(t):
+            err, n_err, loss, cm = _softmax_eval_math(
+                t["output"], t["labels"], t["max_idx"],
+                t.get("confusion"))
+            out = {"err_output": err, "n_err": n_err, "loss": loss}
+            if cm is not None:
+                out["confusion"] = cm
+            return out
+
+        return StitchStage(
+            self, fn,
+            consumes={"output": self.output, "labels": self.labels,
+                      "max_idx": self.max_idx},
+            produces={"err_output": self.err_output},
+            donated={"confusion": self.confusion_matrix} if with_cm
+            else None,
+            metrics=("n_err", "loss"))
+
 
 class EvaluatorMSE(EvaluatorBase):
     """Mean-squared error against ``target`` (ref Znicz ``EvaluatorMSE``):
@@ -104,7 +225,11 @@ class EvaluatorMSE(EvaluatorBase):
         self.mean = kwargs.get("mean", True)
         self.demand("target")
 
-    def run(self):
+    def init_unpickled(self):
+        super(EvaluatorMSE, self).init_unpickled()
+        self._mse_step_ = None
+
+    def numpy_run(self):
         self.output.map_read()
         self.target.map_read()
         batch = int(self.batch_size)
@@ -129,3 +254,46 @@ class EvaluatorMSE(EvaluatorBase):
             else (err64 ** 2).mean(axis=1)
         self.mse = float(per_sample.mean())
         self.n_err = self.mse
+
+    def _device_math(self, out, target, batch):
+        err, mean_sq_scaled, row_scale, valid = _mse_eval_math(
+            out, target, batch)
+        if self.root:
+            per_sample = row_scale * jnp.sqrt(mean_sq_scaled)
+        else:
+            per_sample = row_scale * row_scale * mean_sq_scaled
+        mse = (per_sample * valid).sum() / jnp.maximum(batch, 1)
+        scale = 1.0 if self.mean else float(self.err_output.shape[0])
+        err_full = (err * scale).reshape(self.err_output.shape)
+        return err_full, mse
+
+    def tpu_run(self):
+        if not self._device_shapes_ok() \
+                or not isinstance(self.target, Vector):
+            return self.numpy_run()
+        if self._mse_step_ is None:
+            self._mse_step_ = jax.jit(self._device_math)
+        err, mse = self._mse_step_(
+            self.output.devmem, self.target.devmem,
+            jnp.float32(int(self.batch_size)))
+        self.err_output.devmem = err
+        self.mse = mse
+        self.n_err = mse
+
+    def stitch_stage(self):
+        from veles_tpu.stitch import StitchStage
+        if self.force_numpy or not self._device_shapes_ok() \
+                or not isinstance(self.target, Vector):
+            return None
+
+        def fn(t):
+            err, mse = self._device_math(t["output"], t["target"],
+                                         t["batch"])
+            return {"err_output": err, "mse": mse, "n_err": mse}
+
+        return StitchStage(
+            self, fn,
+            consumes={"output": self.output, "target": self.target},
+            produces={"err_output": self.err_output},
+            scalars=lambda: {"batch": float(int(self.batch_size))},
+            metrics=("mse", "n_err"))

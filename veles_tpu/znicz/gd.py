@@ -39,12 +39,12 @@ _DERIVS_NUMPY = {
 }
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "activation", "need_err_input", "has_bias", "transposed"),
-    donate_argnums=(3, 4, 5, 6))
-def _gd_step(x, y, err_output, w, b, vw, vb, lr, lr_bias, decay,
+def _gd_math(x, y, err_output, w, b, vw, vb, lr, lr_bias, decay,
              decay_bias, moment, moment_bias, activation=None,
              need_err_input=True, has_bias=True, transposed=False):
+    """The jit-able GD body, shared by the per-unit ``_gd_step``
+    program and the stitched-segment stages (which trace it inline so a
+    whole GD chain is ONE XLA program)."""
     batch = x.shape[0]
     delta = (err_output.astype(jnp.float32)
              * _DERIVS[activation](y.astype(jnp.float32)))
@@ -69,6 +69,13 @@ def _gd_step(x, y, err_output, w, b, vw, vb, lr, lr_bias, decay,
         vb = moment_bias * vb - lr_bias * (grad_b + decay_bias * b)
         b = b + vb
     return w, b, vw, vb, err_input
+
+
+#: the per-unit eager program: parameters donated so the update is
+#: in-place on HBM
+_gd_step = functools.partial(jax.jit, static_argnames=(
+    "activation", "need_err_input", "has_bias", "transposed"),
+    donate_argnums=(3, 4, 5, 6))(_gd_math)
 
 
 class GradientDescent(GradientDescentBase):
@@ -138,6 +145,61 @@ class GradientDescent(GradientDescentBase):
             self.err_input.reset(numpy.zeros(self.input.shape,
                                              dtype=numpy.float32))
             self.err_input.initialize(self.device)
+
+    def stitch_stage(self):
+        """Stitched backward stage: the same ``_gd_math`` as the eager
+        program, traced inline so the whole GD chain fuses — weights /
+        bias / momentum Vectors are DONATED at the segment boundary
+        (in-place HBM update, mirroring ``_gd_step``'s donate_argnums)
+        and the hyper-parameters ride as traced scalars, so an
+        LRAdjuster rescaling them never retraces."""
+        from veles_tpu.memory import Vector as _Vector
+        from veles_tpu.stitch import StitchStage
+        if self.force_numpy or not isinstance(self.input, _Vector):
+            return None
+        has_bias = bool(self.include_bias and self.bias)
+        activation = self.ACTIVATION
+        need_err_input = self.need_err_input
+        transposed = self.weights_transposed
+        input_shape = tuple(self.input.shape)
+        unit = self
+
+        def fn(t):
+            placeholder = jnp.zeros((1,), jnp.float32)
+            w, b, vw, vb, err_input = _gd_math(
+                t["input"], t["output"], t["err_output"],
+                t["w"], t.get("b", placeholder),
+                t["vw"], t.get("vb", placeholder),
+                t["lr"], t["lr_b"], t["decay"], t["decay_b"],
+                t["moment"], t["moment_b"],
+                activation=activation, need_err_input=need_err_input,
+                has_bias=has_bias, transposed=transposed)
+            out = {"w": w, "vw": vw}
+            if has_bias:
+                out["b"], out["vb"] = b, vb
+            if need_err_input:
+                out["err_input"] = err_input.reshape(input_shape)
+            return out
+
+        donated = {"w": self.weights, "vw": self.gradient_weights}
+        if has_bias:
+            donated["b"] = self.bias
+            donated["vb"] = self.gradient_bias
+        return StitchStage(
+            self, fn,
+            consumes={"input": self.input, "output": self.output,
+                      "err_output": self.err_output},
+            produces={"err_input": self.err_input}
+            if need_err_input else None,
+            donated=donated,
+            scalars=lambda: {
+                "lr": unit.learning_rate,
+                "lr_b": unit.learning_rate_bias,
+                "decay": unit.weights_decay,
+                "decay_b": unit.weights_decay_bias,
+                "moment": unit.gradient_moment,
+                "moment_b": unit.gradient_moment_bias,
+            })
 
 
 class GDTanh(GradientDescent):

@@ -41,6 +41,7 @@ class GDViaVJP(GradientDescentBase):
         # closure, so rebuilding per run() would defeat the jit cache
         # and recompile every training step.
         self._compute_ = None
+        self._compute_np_ = None
 
     def setup_from_forward(self, forward):
         self.forward = forward
@@ -122,49 +123,51 @@ class GDViaVJP(GradientDescentBase):
             vstate["b"] = get(self.gradient_bias)
         return vstate
 
-    def run(self):
-        """One backward step (jit path for both device kinds — XLA on
-        CPU is the NumpyDevice story for AD-derived units)."""
-        interpret = self.is_interpret
-        if self._compute_ is None:
-            fn = self._step_fn()
-            self._compute_ = fn if interpret else self.jit(fn)
-        compute = self._compute_
-        x = jnp.asarray(self.input.mem) if interpret \
-            else self.input.devmem
-        err_output = jnp.asarray(self.err_output.mem) if interpret \
-            else self.err_output.devmem
-        params = self._collect_params(host=interpret)
-        vstate = self._collect_vstate(host=interpret)
-        new_params, new_v, dx = compute(params, vstate, x, err_output,
-                                        self._hyper())
+    def numpy_run(self):
+        """The interpret/debug backward: the same pure ``compute``
+        closure evaluated eagerly over host memory (XLA-free is not an
+        option for AD-derived units — jax tracing IS the math — but
+        nothing jits and every buffer stays host-side)."""
+        if self._compute_np_ is None:
+            self._compute_np_ = self._step_fn()
+        x = jnp.asarray(self.input.mem)
+        err_output = jnp.asarray(self.err_output.mem)
+        params = self._collect_params(host=True)
+        vstate = self._collect_vstate(host=True)
+        new_params, new_v, dx = self._compute_np_(
+            params, vstate, x, err_output, self._hyper())
         if self.has_params:
-            if interpret:
-                self.weights.map_write()
-                self.weights.mem[...] = numpy.asarray(new_params["w"])
-                self.gradient_weights.map_write()
-                self.gradient_weights.mem[...] = numpy.asarray(
-                    new_v["w"])
-                if "b" in new_params:
-                    self.forward.bias.map_write()
-                    self.forward.bias.mem[...] = numpy.asarray(
-                        new_params["b"])
-                    self.gradient_bias.map_write()
-                    self.gradient_bias.mem[...] = numpy.asarray(
-                        new_v["b"])
-            else:
-                self.weights.devmem = new_params["w"]
-                self.gradient_weights.devmem = new_v["w"]
-                if "b" in new_params:
-                    self.forward.bias.devmem = new_params["b"]
-                    self.gradient_bias.devmem = new_v["b"]
+            self.weights.map_write()
+            self.weights.mem[...] = numpy.asarray(new_params["w"])
+            self.gradient_weights.map_write()
+            self.gradient_weights.mem[...] = numpy.asarray(new_v["w"])
+            if "b" in new_params:
+                self.forward.bias.map_write()
+                self.forward.bias.mem[...] = numpy.asarray(
+                    new_params["b"])
+                self.gradient_bias.map_write()
+                self.gradient_bias.mem[...] = numpy.asarray(new_v["b"])
         if self.need_err_input:
-            if interpret:
-                self.err_input.map_invalidate()
-                self.err_input.mem = numpy.asarray(
-                    dx, dtype=numpy.float32)
-            else:
-                self.err_input.devmem = dx
+            self.err_input.map_invalidate()
+            self.err_input.mem = numpy.asarray(dx, dtype=numpy.float32)
+
+    def tpu_run(self):
+        """One jitted backward step over device-resident Vectors."""
+        if self._compute_ is None:
+            self._compute_ = self.jit(self._step_fn())
+        params = self._collect_params(host=False)
+        vstate = self._collect_vstate(host=False)
+        new_params, new_v, dx = self._compute_(
+            params, vstate, self.input.devmem, self.err_output.devmem,
+            self._hyper())
+        if self.has_params:
+            self.weights.devmem = new_params["w"]
+            self.gradient_weights.devmem = new_v["w"]
+            if "b" in new_params:
+                self.forward.bias.devmem = new_params["b"]
+                self.gradient_bias.devmem = new_v["b"]
+        if self.need_err_input:
+            self.err_input.devmem = dx
 
     def initialize(self, device=None, **kwargs):
         super(GDViaVJP, self).initialize(device=device, **kwargs)
@@ -172,6 +175,71 @@ class GDViaVJP(GradientDescentBase):
             self.err_input.reset(numpy.zeros(self.input.shape,
                                              dtype=numpy.float32))
             self.err_input.initialize(self.device)
+
+    def stitch_stage(self):
+        """Stitched backward stage: the VJP+update ``compute`` closure
+        traced inline into the segment program.  Forwards threading
+        extra traced state (dropout/stochastic-pooling seeds, whose
+        eager backward replays the forward's per-run draw) stay
+        barriers; parameter and solver-state Vectors are donated."""
+        from veles_tpu.memory import Vector as _Vector
+        from veles_tpu.stitch import StitchStage
+        if self.force_numpy or self.is_interpret \
+                or not isinstance(self.input, _Vector):
+            return None
+        try:
+            fparams = self.forward.pure_params(host=True)
+        except Exception:
+            return None
+        if any(key not in ("w", "b") for key in fparams):
+            return None
+        # force the lazy solver-state allocation (and GDRProp's state
+        # restack) so the Vectors exist to be declared
+        self._collect_vstate(host=True)
+        compute = self._step_fn()
+        has_w = "w" in fparams
+        has_b = "b" in fparams
+        need_err_input = self.need_err_input
+        input_shape = tuple(self.input.shape)
+        unit = self
+
+        def fn(t):
+            params, vstate = {}, {}
+            if has_w:
+                params["w"], vstate["w"] = t["w"], t["vw"]
+            if has_b:
+                params["b"], vstate["b"] = t["b"], t["vb"]
+            hyper = {key: t["h_" + key]
+                     for key in ("lr", "lr_b", "decay", "decay_b",
+                                 "moment", "moment_b")}
+            new_params, new_v, dx = compute(
+                params, vstate, t["input"], t["err_output"], hyper)
+            out = {}
+            if has_w:
+                out["w"], out["vw"] = new_params["w"], new_v["w"]
+            if has_b:
+                out["b"], out["vb"] = new_params["b"], new_v["b"]
+            if need_err_input:
+                out["err_input"] = dx.reshape(input_shape)
+            return out
+
+        donated = {}
+        if has_w:
+            donated["w"] = self.weights
+            donated["vw"] = self.gradient_weights
+        if has_b:
+            donated["b"] = self.forward.bias
+            donated["vb"] = self.gradient_bias
+        return StitchStage(
+            self, fn,
+            consumes={"input": self.input,
+                      "err_output": self.err_output},
+            produces={"err_input": self.err_input}
+            if need_err_input else None,
+            donated=donated,
+            scalars=lambda: {
+                "h_" + key: value
+                for key, value in unit._hyper().items()})
 
     def verify_interface(self):
         # weights may legitimately be an empty Vector for param-free

@@ -70,6 +70,45 @@ class ForwardBase(AcceleratedUnit):
             params["b"] = self.bias.mem if host else self.bias.devmem
         return params
 
+    def stitch_stage(self):
+        """Generic forward stage for segment stitching: the unit's
+        ``pure`` function over its w/b Vectors.  Units threading extra
+        traced state (a ``seed`` in ``pure_params`` — dropout,
+        stochastic pooling: their eager run() draws a FRESH stream
+        value per call, which a stitched replay would freeze) stay
+        barriers, as do dynamic-mode units."""
+        from veles_tpu.memory import Vector as _Vector
+        from veles_tpu.stitch import StitchStage
+        pure = getattr(type(self), "pure", None)
+        if pure is None or self.force_numpy \
+                or not isinstance(self.input, _Vector) \
+                or not self.input or not self.output:
+            return None
+        try:
+            host_params = self.pure_params(host=True)
+        except Exception:
+            return None
+        if any(key not in ("w", "b") for key in host_params):
+            return None
+        config = self.pure_config()
+        out_shape = tuple(self.output.shape)
+        param_keys = tuple(sorted(host_params))
+
+        def fn(t):
+            out = pure({k: t[k] for k in param_keys}, t["input"],
+                       **config)
+            return {"output": out.reshape(out_shape)}
+
+        params = {}
+        if "w" in param_keys:
+            params["w"] = self.weights
+        if "b" in param_keys:
+            params["b"] = self.bias
+        return StitchStage(self, fn,
+                           consumes={"input": self.input},
+                           produces={"output": self.output},
+                           params=params)
+
     def generate_data_for_slave(self, slave=None):
         """Weights ride to slaves with each job (async-DP semantics of the
         reference, ``workflow.py:478``)."""

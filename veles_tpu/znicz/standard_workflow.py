@@ -446,6 +446,9 @@ class StandardWorkflow(AcceleratedWorkflow):
             # edge and open the end point unconditionally.
             self.repeater.unlink_from(self._loop_tail)
             self.end_point.gate_block = Bool(False)
+            # graph surgery changed the chain — re-stitch so the slave's
+            # per-job run() dispatches the same O(segments) programs
+            self.rebuild_stitching()
         return result
 
     def generate_data_for_slave(self, slave=None):
