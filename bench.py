@@ -517,32 +517,49 @@ def _conv_stage(metric, layers, input_shape, n_classes, batch, steps,
 
 
 def _wf_stage(metric, fused_config=None, sample=None, fused=True,
-              vs=None, extra=None):
+              vs=None, extra=None, loader_mode=None):
     """The WHOLE framework path: StandardWorkflow(fused=True) — graph
     scheduling, loader epoch bookkeeping, Decision accounting, and the
     fused step — timed over full epochs via wf.run().  Every minibatch
     host-fetches its metrics (unless epoch_mode batches the fetches),
     so the wall clock is honest by construction.  Returns the measured
-    images/sec so ratio lines (eager vs fused) can chain stages."""
+    images/sec so ratio lines (eager vs fused) can chain stages.
+
+    ``loader_mode`` pins ``root.common.engine.loader`` for the stage
+    (the eager line runs "host" so its number stays the PR 3 baseline;
+    the devloader line runs "device").  Every record carries
+    ``h2d_bytes_per_step`` — Watcher-accounted host→device traffic per
+    train-equivalent step over the timed region — so BENCH_*.json
+    tracks transfer ELIMINATION, not just img/s."""
     from veles_tpu import prng
     from veles_tpu.backends import AutoDevice
+    from veles_tpu.config import root
+    from veles_tpu.memory import Watcher
     from veles_tpu.samples import mnist
 
-    prng.seed_all(1234)
-    batch = 2048
-    # max_epochs=1 ends after the initial validation pass with ZERO
-    # train steps, so the train-step (or epoch-program) compile would
-    # land inside the timed region — warm through epoch 2 (the first
-    # REAL train epoch) instead
-    wf = (sample or mnist).create_workflow(
-        device=AutoDevice(), max_epochs=2, minibatch_size=batch,
-        fused=fused, fused_config=dict(fused_config or {}))
-    wf.run()                               # epochs 1-2: compiles included
-    wf.decision.complete <<= False
-    wf.decision.max_epochs = 4
-    tic = time.perf_counter()
-    wf.run()                               # epochs 3-4, warm
-    elapsed = time.perf_counter() - tic
+    saved_loader = root.common.engine.get("loader", "auto")
+    if loader_mode is not None:
+        root.common.engine.loader = loader_mode
+    try:
+        prng.seed_all(1234)
+        batch = 2048
+        # max_epochs=1 ends after the initial validation pass with ZERO
+        # train steps, so the train-step (or epoch-program) compile would
+        # land inside the timed region — warm through epoch 2 (the first
+        # REAL train epoch) instead
+        wf = (sample or mnist).create_workflow(
+            device=AutoDevice(), max_epochs=2, minibatch_size=batch,
+            fused=fused, fused_config=dict(fused_config or {}))
+        wf.run()                           # epochs 1-2: compiles included
+        wf.decision.complete <<= False
+        wf.decision.max_epochs = 4
+        h2d_before = Watcher.h2d_bytes
+        tic = time.perf_counter()
+        wf.run()                           # epochs 3-4, warm
+        elapsed = time.perf_counter() - tic
+        h2d_delta = Watcher.h2d_bytes - h2d_before
+    finally:
+        root.common.engine.loader = saved_loader
     # train-only images over the wall clock (which includes the eval
     # passes): comparable to the fused synthetic-batch line — counting
     # eval minibatches as served images made this neither a train
@@ -550,6 +567,11 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
     from veles_tpu.loader.base import TRAIN
     train_samples = 2 * int(wf.loader.class_lengths[TRAIN])
     sec_per_step = batch * elapsed / train_samples
+    extra = dict(extra or {})
+    extra.setdefault("h2d_bytes_per_step",
+                     round(h2d_delta * batch / train_samples, 1))
+    if loader_mode is not None:
+        extra.setdefault("loader", loader_mode)
     _emit(metric, sec_per_step, batch, None, vs=vs, extra=extra)
     return batch / sec_per_step
 
@@ -578,6 +600,12 @@ def stage_mnist_wf_epoch():
               fused_config={"epoch_mode": True})
 
 
+#: eager (host-loader) mnist_wf_eager images/sec from THIS ladder run —
+#: the devloader stage's vs= denominator (same-run ratio line, like
+#: _WF_FUSED_IPS for the eager↔fused ratio)
+_WF_EAGER_IPS = [None]
+
+
 def stage_mnist_wf_eager():
     """The EAGER unit-chain trainer (fused=False): what elastic
     master–slave jobs train through today (fused raises under the job
@@ -585,7 +613,9 @@ def stage_mnist_wf_eager():
     ``mnist_wf`` line measured in the SAME ladder run, so the recorded
     ``vs_baseline`` IS the eager↔fused throughput ratio the stitched
     fast path (root.common.engine.stitch) is closing; re-measures the
-    fused twin in-process when BENCH_STAGES skipped ``mnist_wf``."""
+    fused twin in-process when BENCH_STAGES skipped ``mnist_wf``.
+    Pins ``engine.loader=host`` so the line stays the PR 3 baseline
+    the ``mnist_wf_eager_devloader`` stage compares against."""
     fused_ips = _WF_FUSED_IPS[0]
     if fused_ips is None:
         fused_ips = _wf_stage(
@@ -593,11 +623,34 @@ def stage_mnist_wf_eager():
             "(epoch wall-clock incl. eval)")
         _WF_FUSED_IPS[0] = fused_ips
     from veles_tpu.config import root
-    _wf_stage("MNIST784 full StandardWorkflow(eager unit chain) train "
-              "throughput (epoch wall-clock incl. eval)", fused=False,
-              vs=fused_ips,
+    _WF_EAGER_IPS[0] = _wf_stage(
+        "MNIST784 full StandardWorkflow(eager unit chain) train "
+        "throughput (epoch wall-clock incl. eval)", fused=False,
+        vs=fused_ips, loader_mode="host",
+        extra={"stitch": root.common.engine.get("stitch", "on"),
+               "vs_metric": "mnist_wf (fused, same run)"})
+
+
+def stage_mnist_wf_eager_devloader():
+    """The stitched eager trainer with the DEVICE-RESIDENT input
+    pipeline (``engine.loader=device``): the loader heads the first
+    stitched segment, minibatch selection is an in-program gather over
+    the HBM-resident dataset, and per-step H2D drops to zero (watch
+    ``h2d_bytes_per_step`` vs the eager line).  Emits ``vs=`` the
+    host-loader ``mnist_wf_eager`` line from the SAME ladder run, so
+    ``vs_baseline`` IS the input-pipeline speedup; re-measures the
+    eager twin in-process when BENCH_STAGES skipped it."""
+    eager_ips = _WF_EAGER_IPS[0]
+    if eager_ips is None:
+        stage_mnist_wf_eager()
+        eager_ips = _WF_EAGER_IPS[0]
+    from veles_tpu.config import root
+    _wf_stage("MNIST784 full StandardWorkflow(eager, device-resident "
+              "loader) train throughput (epoch wall-clock incl. eval)",
+              fused=False, vs=eager_ips, loader_mode="device",
               extra={"stitch": root.common.engine.get("stitch", "on"),
-                     "vs_metric": "mnist_wf (fused, same run)"})
+                     "vs_metric": "mnist_wf_eager (host loader, "
+                                  "same run)"})
 
 
 def stage_mnist_wf_slave():
@@ -1543,6 +1596,7 @@ STAGES = {
     "mnist_wf_epoch": (stage_mnist_wf_epoch, 240),
     "ae_wf_epoch": (stage_ae_wf_epoch, 240),
     "mnist_wf_eager": (stage_mnist_wf_eager, 300),
+    "mnist_wf_eager_devloader": (stage_mnist_wf_eager_devloader, 300),
     "mnist_wf_slave": (stage_mnist_wf_slave, 300),
     "cifar": (stage_cifar, 210),
     "stl10": (stage_stl10, 240),
@@ -1570,7 +1624,7 @@ STAGES = {
 _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "mnist_wf",
                "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
-               "mnist_wf_slave",
+               "mnist_wf_eager_devloader", "mnist_wf_slave",
                "cifar", "stl10", "ae",
                "kohonen",
                "lstm", "transformer", "profile_lm", "attn_bwd", "power",
@@ -1590,14 +1644,14 @@ _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
                "mnist_e2e_u8", "mnist_epoch", "power", "native_infer",
                "cifar", "stl10", "ae", "kohonen", "mnist_wf",
                "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
-               "mnist_wf_slave")
+               "mnist_wf_eager_devloader", "mnist_wf_slave")
 
 #: CPU fallback (rehearsed with a wedged tunnel): conv/LM heavies
 #: cannot finish on CPU inside their caps — end on the flagship MNIST
 #: number so the recorded last line is a real measurement.
 _CPU_ORDER = ("mnist_e2e", "mnist_epoch", "mnist_wf",
               "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
-              "mnist_wf_slave", "ae",
+              "mnist_wf_eager_devloader", "mnist_wf_slave", "ae",
               "kohonen", "lstm",
               "native_infer", "mnist_u8", "mnist_bf16", "mnist")
 

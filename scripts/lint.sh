@@ -17,7 +17,10 @@ if [ "$#" -gt 0 ]; then
   exec env JAX_PLATFORMS=cpu python -m veles_tpu.analyze --lint "$@"
 fi
 env JAX_PLATFORMS=cpu python -m veles_tpu.analyze --lint
-for sample in veles_tpu.samples.mnist veles_tpu.samples.mnist_ae; do
+# mnist_conv + cifar10 exercise the loader-headed stitch stage (the
+# device-resident input pipeline, V-J07) on conv-shaped workflows
+for sample in veles_tpu.samples.mnist veles_tpu.samples.mnist_ae \
+              veles_tpu.samples.mnist_conv veles_tpu.samples.cifar10; do
   echo "== analyze $sample =="
   env JAX_PLATFORMS=cpu python -m veles_tpu.analyze "$sample"
 done

@@ -302,6 +302,94 @@ def test_shapes_hot_loop_scan_covers_evaluator_and_gds():
     assert "V-J06" in rules_of(findings)
 
 
+def test_v_j07_device_put_in_hot_loop_run():
+    """V-J07 (b): explicit H2D transfers — jax.device_put or
+    <device>.put — inside hot-loop run()/tpu_run() bodies are flagged;
+    off the hot loop (and in numpy_run) they are not."""
+    from veles_tpu.analyze.shapes import scan_transfer_hazards
+
+    class UploadHappyUnit(Unit):
+        hide_from_registry = True
+
+        def run(self):
+            import jax
+            self.batch = jax.device_put(self.batch)
+
+        def tpu_run(self):
+            self.batch = self.device.put(self.batch)
+
+        def numpy_run(self):
+            import jax
+            self.batch = jax.device_put(self.batch)   # debug path
+
+    wf = DummyWorkflow()
+    unit = UploadHappyUnit(wf, name="upload_happy")
+    hot = scan_transfer_hazards(unit, hot_loop=True)
+    assert rules_of(hot) == {"V-J07"}
+    assert len(hot) == 2                 # run + tpu_run, not numpy_run
+    assert not scan_transfer_hazards(unit)   # off the hot loop: clean
+
+
+def _v_j07_workflow(device, loader_mode, **loader_kw):
+    from veles_tpu.backends import CPUDevice, NumpyDevice
+    from veles_tpu.config import root
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class TinyLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            self.original_data.mem = rng.standard_normal(
+                (40, 8)).astype(numpy.float32)
+            self.original_labels = [int(i % 4) for i in range(40)]
+            self.class_lengths[:] = [0, 0, 40]
+
+    root.common.engine.loader = loader_mode
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: TinyLoader(w, minibatch_size=8,
+                                            **loader_kw),
+        layers=[{"type": "softmax",
+                 "->": {"output_sample_shape": 4}}],
+        decision_config={"max_epochs": 1})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=CPUDevice() if device == "cpu"
+                  else NumpyDevice())
+    return wf
+
+
+def test_v_j07_host_filling_fullbatch_loader_flagged():
+    """V-J07 (a): an initialized FullBatch loader serving host-side on
+    a jit device is flagged; the engaged device fast path (auto) and
+    interpret devices stay quiet."""
+    from veles_tpu.config import root
+    saved = root.common.engine.get("loader", "auto")
+    try:
+        wf = _v_j07_workflow("cpu", "host")
+        findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
+        flagged = [f for f in findings if f.rule == "V-J07"]
+        assert flagged and flagged[0].unit == wf.loader.name
+
+        root.common.engine.loader = "auto"      # fast path engages
+        assert wf.loader.device_fast_path_active
+        findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
+        assert "V-J07" not in rules_of(findings)
+
+        wf_np = _v_j07_workflow("numpy", "host")   # interpret: quiet
+        findings = check_shapes(wf_np, sample_shape=(8,), batch_size=8)
+        assert "V-J07" not in rules_of(findings)
+
+        # structurally ineligible (dataset not resident): flipping the
+        # config could not engage the path — no misleading warning
+        wf_big = _v_j07_workflow("cpu", "auto",
+                                 store_in_device_memory=False)
+        findings = check_shapes(wf_big, sample_shape=(8,), batch_size=8)
+        assert "V-J07" not in rules_of(findings)
+    finally:
+        root.common.engine.loader = saved
+
+
 # -- pass 3: lint pack ------------------------------------------------------
 
 def test_lint_self_clean_tier1():

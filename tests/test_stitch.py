@@ -97,19 +97,39 @@ def test_segments_cover_forward_and_gd_chains(stitch_config):
     wf = build(CPUDevice())
     report = wf.stitch_report()
     assert report["enabled"]
-    # exactly two segments: [forwards..., evaluator] and [gd chain];
-    # loader / decision / plumbing stay barriers
+    # exactly two segments: [loader, forwards..., evaluator] (the
+    # device-resident input pipeline heads the first program) and
+    # [gd chain]; decision / plumbing stay barriers
     assert len(report["segments"]) == 2
-    fwd_names = [u.name for u in wf.forwards] + [wf.evaluator.name]
+    fwd_names = [wf.loader.name] + [u.name for u in wf.forwards] \
+        + [wf.evaluator.name]
     gd_names = [u.name for u in wf.gds]
     assert report["segments"][0] == fwd_names
     assert report["segments"][1] == gd_names
+    assert report["loader_headed"] == [True, False]
     flat = [n for names in report["segments"] for n in names]
     assert wf.decision.name not in flat
-    assert wf.loader.name not in flat
     # gd members share the head's TRAIN skip gate (the eligibility rule)
     head_gate = wf.gds[0].gate_skip
     assert all(gd.gate_skip is head_gate for gd in wf.gds)
+
+
+def test_loader_stays_barrier_under_host_mode(stitch_config):
+    """engine.loader=host restores the PR 3 segment shape: the loader
+    drops out of the first program and serves host-side."""
+    saved = root.common.engine.get("loader", "auto")
+    root.common.engine.loader = "host"
+    try:
+        wf = build(CPUDevice())
+        report = wf.stitch_report()
+        assert len(report["segments"]) == 2
+        assert report["segments"][0][0] == wf.forwards[0].name
+        assert report["loader_headed"] == [False, False]
+        wf.run()
+        assert wf.stopped
+        assert wf.stitch_report()["dispatches"] > 0
+    finally:
+        root.common.engine.loader = saved
 
 
 def test_stitch_on_flip_after_off_initialize_engages(stitch_config):
@@ -172,8 +192,8 @@ def test_dispatches_are_per_segment_not_per_unit(stitch_config,
     served = {"total": 0, "train": 0}
     orig_serve = type(wf.loader).serve_next_minibatch
 
-    def counting_serve(self, consumer):
-        orig_serve(self, consumer)
+    def counting_serve(self, consumer, **kwargs):
+        orig_serve(self, consumer, **kwargs)
         served["total"] += 1
         if int(self.minibatch_class) == TRAIN:
             served["train"] += 1

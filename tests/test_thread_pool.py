@@ -225,6 +225,134 @@ def test_loader_prefetch_epoch_wrap_correctness():
         numpy.testing.assert_array_equal(a, b)
 
 
+def test_prefetch_exception_propagates_and_recovers():
+    """A fill_minibatch_into that throws in the worker must not lose
+    the batch OR the exception: the failure surfaces at consume time
+    (logged) and the serve falls back to a synchronous fill — the
+    served stream stays identical to the no-prefetch run."""
+    fail_on = {3}
+
+    class FlakyLoader(SlowIOLoader):
+        def __init__(self, workflow, **kwargs):
+            super(FlakyLoader, self).__init__(workflow, **kwargs)
+            self.bg_calls = 0
+            self.failures = 0
+
+        def fill_minibatch_into(self, indices, data_out,
+                                raw_labels_out):
+            self.bg_calls += 1
+            if self.bg_calls in fail_on:
+                self.failures += 1
+                raise RuntimeError("synthetic IO failure")
+            super(FlakyLoader, self).fill_minibatch_into(
+                indices, data_out, raw_labels_out)
+
+    def run(prefetch, loader_cls):
+        from veles_tpu import prng
+        prng.seed_all(4321)
+        wf = DummyWorkflow()
+        loader = loader_cls(wf, io_delay=0.0, minibatch_size=16,
+                            prefetch=prefetch)
+        rep = Repeater(wf)
+        stop = Bool(False)
+        seen = []
+
+        class Trainer(DummyUnit):
+            def run(self):
+                nonlocal stop
+                super(Trainer, self).run()
+                time.sleep(0.01)    # let the flaky future resolve
+                seen.append(numpy.array(loader.minibatch_data.mem))
+                if loader.epoch_ended and loader.epoch_number >= 2:
+                    stop <<= True
+
+        trainer = Trainer(wf, name="trainer")
+        rep.link_from(wf.start_point)
+        loader.link_from(rep)
+        trainer.link_from(loader)
+        rep.link_from(trainer)
+        rep.gate_block = stop
+        wf.end_point.link_from(trainer)
+        wf.end_point.gate_block = ~stop
+        wf.initialize()
+        wf.run()
+        return seen, loader
+
+    seen_ref, _ = run(False, SlowIOLoader)
+    seen_flaky, loader = run(True, FlakyLoader)
+    assert loader.failures >= 1, "the failure injection never fired"
+    assert len(seen_flaky) == len(seen_ref)
+    for a, b in zip(seen_flaky, seen_ref):
+        numpy.testing.assert_array_equal(a, b)
+
+
+def test_no_stale_prefetch_after_reinitialize():
+    """initialize() reshuffles the index space — a background fill
+    buffered before the re-initialize must NOT be served afterwards
+    even when its (offset, size) key matches (the stale-buffer-reuse
+    hazard; initialize drops all in-flight fills)."""
+    from veles_tpu import prng
+
+    def serve_after_reinit(prefetch):
+        prng.seed_all(777)
+        wf = DummyWorkflow()
+        loader = SlowIOLoader(wf, io_delay=0.0, minibatch_size=16,
+                              prefetch=prefetch)
+        loader.link_from(wf.start_point)
+        wf.end_point.link_from(loader)
+        wf.initialize()
+        for _ in range(3):
+            loader.run()    # leaves a prefetched batch 4 in flight
+        assert not prefetch or loader._prefetch_futures_
+        loader.initialize()                # reshuffle: new epoch order
+        assert not loader._prefetch_futures_
+        loader.run()
+        return numpy.array(loader.minibatch_data.mem)
+
+    a = serve_after_reinit(prefetch=True)
+    b = serve_after_reinit(prefetch=False)
+    numpy.testing.assert_array_equal(a, b)
+
+
+def test_prefetch_ring_reuses_buffers_and_publishes_device():
+    """The staging ring allocates its slots ONCE (no per-fill
+    zeros_like churn) and, with a jit device attached, the worker's
+    upload lands as the published device copy — both Vector sides
+    fresh on a hit, nothing left for the consumer to transfer."""
+    from veles_tpu import prng
+    from veles_tpu.backends import CPUDevice
+
+    prng.seed_all(4321)
+    wf = DummyWorkflow()
+    wf.device = CPUDevice()
+    loader = SlowIOLoader(wf, io_delay=0.0, minibatch_size=16,
+                          prefetch=True)
+    loader.link_from(wf.start_point)
+    wf.end_point.link_from(loader)
+    wf.initialize(device=wf.device)
+    slot_ids = set()
+    orig_acquire = type(loader._staging()).acquire
+
+    def spy_acquire(self):
+        slot = orig_acquire(self)
+        slot_ids.add(id(slot))
+        return slot
+
+    type(loader._staging()).acquire = spy_acquire
+    try:
+        hits = 0
+        for _ in range(8):
+            loader.run()
+            time.sleep(0.02)        # let the background fill land
+            if loader.minibatch_data._dev_fresh_ \
+                    and loader.minibatch_data._host_fresh_:
+                hits += 1
+        assert hits >= 3, "prefetch hits never published device copies"
+        assert len(slot_ids) <= loader._staging().depth
+    finally:
+        type(loader._staging()).acquire = orig_acquire
+
+
 def test_drain_waits_for_background_not_gating_end_point():
     """run() returning means quiescent: an in-flight background unit
     that the end_point does NOT wait on is still joined before run()

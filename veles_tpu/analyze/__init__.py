@@ -13,8 +13,10 @@ Three static passes, zero device work:
    ``fused_graph.lower_specs``-style layer specs) with
    ``jax.eval_shape`` only: shape/dtype mismatches, weak-type
    promotion, non-power-of-two batch sizes that miss the serve
-   engine's AOT buckets, and host-device transfer hazards in ``run()``
-   bodies.
+   engine's AOT buckets, host-device transfer hazards in ``run()``
+   bodies, and per-step host input pipelines (a FullBatch loader
+   filling host-side where the device-resident fast path applies —
+   V-J07).
 3. **Lint pack** (:mod:`~veles_tpu.analyze.lint`) — AST rules over
    ``veles_tpu/`` source itself (blocking IO in ``run()``, private
    state access, gate/link API misuse); the tier-1 suite keeps the

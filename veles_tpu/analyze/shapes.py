@@ -53,6 +53,14 @@ RULES = {
               "unit on the train hot loop: the Vector coherence "
               "round-trip (device fetch + host math + re-upload) "
               "serializes JAX async dispatch every step"),
+    "V-J07": ("warning",
+              "per-step host input pipeline: a FullBatch-family "
+              "loader fills minibatches host-side although the "
+              "device-resident fast path (engine.loader=device) is "
+              "available for its class, or a hot-loop run()/tpu_run() "
+              "calls device_put outside the prefetch ring — per-step "
+              "H2D transfers the stitched in-program gather (or the "
+              "staging ring) would eliminate"),
 }
 
 #: dotted call names that force a device→host sync
@@ -66,6 +74,18 @@ _SYNC_METHODS = {"block_until_ready", "item"}
 #: (V-J06; map_write implies map_read, map_invalidate implies a later
 #: re-upload of host bytes)
 _MAP_READ_METHODS = {"map_read", "map_write"}
+
+
+def _is_device_put(name):
+    """``jax.device_put(...)`` or a ``<device>.put(...)`` method call —
+    the explicit H2D transfer V-J07 flags inside hot-loop run bodies
+    (the prefetch ring's background workers are, by construction, not
+    run()/tpu_run() bodies, so staged uploads never match here)."""
+    if not name:
+        return False
+    return (name == "jax.device_put"
+            or name.rsplit(".", 1)[-1] == "device_put"
+            or name.endswith("device.put"))
 
 
 def _rule(rule_id):
@@ -170,6 +190,22 @@ def scan_transfer_hazards(unit, hot_loop=False):
                         "Vector.devmem (see znicz/evaluator.py) and "
                         "defer metric fetches to epoch boundaries"))
                 continue
+            if hot_loop and _is_device_put(name):
+                findings.append(Finding(
+                    *_rule("V-J07"),
+                    message="%s.%s calls %s per minibatch on the "
+                            "train hot loop — an explicit H2D "
+                            "transfer outside the prefetch ring "
+                            "serializes every step on the upload"
+                            % (cls.__name__, meth_name,
+                               name.lstrip(".") + "()"),
+                    unit=unit.name,
+                    location="%s:%d" % (path, line) if path else None,
+                    fix="keep the batch device-resident (engine.loader"
+                        "=device in-program gather) or move the upload "
+                        "into the loader prefetch ring "
+                        "(fill_minibatch_into + StagingRing)"))
+                continue
             if not _is_sync_call(name):
                 continue
             findings.append(Finding(
@@ -246,6 +282,44 @@ def check_shapes(workflow, sample_shape=None, batch_size=None):
     hot_units.extend(getattr(workflow, "gds", None) or [])
     for unit in hot_units:
         findings.extend(scan_transfer_hazards(unit, hot_loop=True))
+
+    # V-J07 — per-step host input pipeline.  (a) the loader's own
+    # run()/tpu_run() body moving bytes H2D per minibatch (device_put
+    # outside the prefetch ring); (b) an INITIALIZED FullBatch-family
+    # loader serving host-filled minibatches on a jit device although
+    # the in-program gather (engine.loader=device, fused into the
+    # stitched first segment) is available for its class.  Interpret
+    # devices and uninitialized workflows stay quiet — there is no
+    # fast path to miss there.
+    loader = getattr(workflow, "loader", None)
+    if loader is not None:
+        findings.extend(f for f in scan_transfer_hazards(
+            loader, hot_loop=True) if f.rule == "V-J07")
+        device = getattr(loader, "device", None)
+        # fire only when flipping the CONFIG would actually engage the
+        # path: a loader that is structurally ineligible (dataset not
+        # resident — store_in_device_memory=False, e.g. bigger than
+        # HBM — or native-dtype fused input) would make the prescribed
+        # fix a no-op
+        if getattr(loader, "is_initialized", False) \
+                and device is not None \
+                and not getattr(device, "is_interpret", True) \
+                and hasattr(loader, "device_fast_path_active") \
+                and not loader.device_fast_path_active \
+                and getattr(loader, "store_in_device_memory", False) \
+                and not getattr(loader, "native_device_dtype", False):
+            findings.append(Finding(
+                *_rule("V-J07"),
+                message="loader %r fills minibatches host-side every "
+                        "step although the device-resident fast path "
+                        "is available for %s — each serve pays a host "
+                        "gather plus an H2D upload the stitched "
+                        "in-program gather eliminates"
+                        % (loader, type(loader).__name__),
+                unit=loader.name,
+                fix="set root.common.engine.loader=device (or leave "
+                    "auto with store_in_device_memory=True) so the "
+                    "loader heads the first stitched segment"))
 
     if not forwards and not specs:
         findings.append(Finding(

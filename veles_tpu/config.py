@@ -153,6 +153,15 @@ root.common.update({
         # ("on" | "off"; honored by Workflow.run() and the job-layer
         # slave path — "off" restores the per-unit dispatch path).
         "stitch": "on",
+        # Input pipeline for the eager/stitched trainer
+        # ("auto" | "device" | "host"):  "device" (and "auto" when a
+        # jit device is attached and the dataset is HBM-resident)
+        # heads the first stitched segment with the loader — minibatch
+        # selection becomes an in-program gather over the resident
+        # dataset, with ZERO per-step host fill / host→device bytes.
+        # "host" restores the seed per-step host fill.  Read at
+        # Workflow.initialize()/rebuild_stitching() time.
+        "loader": "auto",
         # Deferred-metric fetch cadence for the device-resident
         # evaluators: 0 = one batched fetch per epoch/class boundary;
         # K > 0 additionally flushes every K minibatches (bounds the

@@ -14,9 +14,21 @@ master-side index distribution (``:631-687``).
 TPU re-design notes: serving stays a host-side unit (it is control flow);
 the device-side minibatch *fill* lives in
 :class:`veles_tpu.loader.fullbatch.FullBatchLoader` where the dataset is
-HBM-resident and gathering rides :func:`veles_tpu.ops.gather.take_rows`.
+HBM-resident and gathering rides :func:`veles_tpu.ops.gather.take_rows`
+— or, on the stitched eager path, fuses into the first forward segment
+as an in-program gather (``root.common.engine.loader``, see
+:meth:`Loader.stitch_prelude` and ``docs/engine_fast_path.md``).
 For on-pod data parallelism the same index partitioning used for slaves
 feeds per-device shards (see :mod:`veles_tpu.parallel`).
+
+Loaders that cannot be fully resident (streaming/image) get a
+double-buffered async prefetch ring instead: a background worker runs
+``fill_minibatch_into`` for batch k+1 into a reusable
+:class:`veles_tpu.memory.StagingRing` buffer — normalize + label-map +
+pad included — and kicks a non-blocking host→device upload while the
+stitched segments for batch k execute; the serve thread just publishes
+the prepared pair (:meth:`veles_tpu.memory.Vector.publish`), releasing
+the previous device minibatch for allocator reuse.
 """
 
 import collections
@@ -107,6 +119,9 @@ class Loader(Unit):
         self._normalization_parameters = kwargs.get(
             "normalization_parameters", {})
         self._prng_name = kwargs.get("prng_name", "loader")
+        #: the attached device (captured at initialize; None/interpret
+        #: means host-only serving — no staging uploads)
+        self.device = None
         super(Loader, self).__init__(workflow, **kwargs)
         self._normalizer = None
 
@@ -120,6 +135,9 @@ class Loader(Unit):
         #: serializes fill_minibatch vs background fill_minibatch_into —
         #: subclasses may share file handles between them
         self._fill_lock_ = threading.Lock()
+        #: reusable staging buffers for the prefetch ring (lazy: needs
+        #: minibatch_data's shape, known after initialize)
+        self._staging_ring_ = None
 
     # -- configuration ------------------------------------------------------
     @property
@@ -202,6 +220,17 @@ class Loader(Unit):
     # -- lifecycle ----------------------------------------------------------
     def initialize(self, **kwargs):
         super(Loader, self).initialize(**kwargs)
+        device = kwargs.get("device", None)
+        if device is None:
+            device = getattr(self.workflow, "device", None)
+        if device is not None:
+            self.device = device
+        # a re-initialize reshuffles the index space: any buffered
+        # background fill belongs to the OLD shuffle and a later serve
+        # with a matching (offset, size) key would silently publish the
+        # stale buffer — drop everything in flight
+        self._prefetch_futures_.clear()
+        self._staging_ring_ = None
         if self.testing:
             self.shuffle_limit = 0
             self.global_offset = 0
@@ -255,6 +284,16 @@ class Loader(Unit):
         self._on_successful_serve()
         self._start_prefetch()
 
+    def stitch_prelude(self):
+        """Host half of a loader-headed stitched dispatch (the device
+        fast path): advance the serving state — offset/class, epoch
+        flags, retry + pending accounting, the index window — WITHOUT
+        filling any host minibatch buffer; the stitched segment
+        gathers the batch in-program from the resident dataset."""
+        self.pending_minibatches_.pop(None, None)
+        self.serve_next_minibatch(None, fill=False)
+        self._on_successful_serve()
+
     # -- serving ------------------------------------------------------------
     def shuffle(self):
         """Shuffle the TRAIN span of the index space (ref ``:711-731``)."""
@@ -275,9 +314,11 @@ class Loader(Unit):
                 return class_index, offset - index
         raise LoaderError("sample index %d out of range" % index)
 
-    def serve_next_minibatch(self, consumer_id):
+    def serve_next_minibatch(self, consumer_id, fill=True):
         """Pick the next (offset, size) — retrying failed minibatches
-        first — and fill data (ref ``:726-752``)."""
+        first — and fill data (ref ``:726-752``).  ``fill=False`` is the
+        loader-headed stitched dispatch: serving state advances but no
+        host buffer is touched — the segment gathers in-program."""
         retried = False
         try:
             minibatch_def = self.failed_minibatches.pop()
@@ -300,33 +341,40 @@ class Loader(Unit):
 
         self.fill_indices(minibatch_offset - minibatch_size,
                           minibatch_size)
-        if self.is_master:
+        if self.is_master or not fill:
             return
-        self._fill_current(minibatch_def)
+        if self._consume_prefetched(minibatch_def):
+            return      # fully prepared (normalized/mapped/padded)
+        with self._fill_lock_:
+            self.fill_minibatch()
         self.normalize_minibatch()
         self.map_minibatch_labels()
         if minibatch_size < self.max_minibatch_size:
             self.pad_minibatch(minibatch_size)
 
     def pad_minibatch(self, minibatch_size):
-        """Zero/-1-fill the tail of a short final batch.  Loaders whose
-        ``fill_minibatch`` already pads (device-side gather) override
-        with a no-op."""
+        """Zero/-1-fill the tail of a short final batch (indices are
+        already -1-padded by :meth:`fill_indices`).  Only ever called
+        for a SHORT batch — a full batch skips the tail ``map_write``
+        churn entirely.  Loaders whose ``fill_minibatch`` already pads
+        (device-side gather) override with a no-op."""
         self.minibatch_data.map_write()
         self.minibatch_data.mem[minibatch_size:] = 0.0
         if self.has_labels:
             self.minibatch_labels.map_write()
             self.minibatch_labels.mem[minibatch_size:] = -1
-        self.minibatch_indices.map_write()
-        self.minibatch_indices.mem[minibatch_size:] = -1
 
     def fill_indices(self, start_offset, count):
         """Copy the served span of shuffled indices into
-        ``minibatch_indices`` (ref ``:823-838``)."""
+        ``minibatch_indices`` (ref ``:823-838``); a short batch gets a
+        ``-1`` tail here so EVERY serving path (host fill, prefetch
+        ring, in-program device gather) sees sane empty-slot markers."""
         self.minibatch_indices.map_write()
         self.shuffled_indices.map_read()
         self.minibatch_indices.mem[:count] = \
             self.shuffled_indices.mem[start_offset:start_offset + count]
+        if count < self.max_minibatch_size:
+            self.minibatch_indices.mem[count:] = -1
         return False
 
     def normalize_minibatch(self):
@@ -338,9 +386,18 @@ class Loader(Unit):
         if not self.has_labels:
             return
         self.minibatch_labels.map_write()
-        for i, raw in enumerate(
-                self.raw_minibatch_labels[:self.minibatch_size]):
-            self.minibatch_labels.mem[i] = self.labels_mapping.get(raw, -1) \
+        self._map_labels_into(self.minibatch_labels.mem,
+                              self.raw_minibatch_labels,
+                              self.minibatch_size)
+
+    def _map_labels_into(self, labels_out, raw_labels, count):
+        """raw → mapped labels for the first ``count`` slots — the ONE
+        implementation both serving paths use (the synchronous
+        :meth:`map_minibatch_labels` and the prefetch ring's
+        :meth:`_prepare_staged`), so a hit and a miss can never map
+        differently."""
+        for i, raw in enumerate(raw_labels[:count]):
+            labels_out[i] = self.labels_mapping.get(raw, -1) \
                 if self.labels_mapping else raw
 
     def _calc_class_end_offsets(self):
@@ -403,19 +460,63 @@ class Loader(Unit):
         size = min(remainder, self.max_minibatch_size)
         return self.global_offset + size, size
 
+    def _staging(self):
+        """Lazy staging ring sized like ``minibatch_data`` (allocated
+        once; the worker fills slots in rotation).  Depth 3 = the ≤ 2
+        fills ever in flight (:meth:`prefetch_job_data`) plus the slot
+        the single consumer thread may still be publish-copying after
+        popping its future — a recycled slot is therefore never
+        refilled while it is being read."""
+        if self._staging_ring_ is None:
+            from veles_tpu.memory import StagingRing
+            self._staging_ring_ = StagingRing(
+                self.minibatch_data.shape, self.minibatch_data.dtype,
+                depth=3)
+        return self._staging_ring_
+
+    def _prepare_staged(self, data_out, labels_out, raw_labels, size):
+        """Worker-side minibatch prep: the normalize + label-map + pad
+        the serve thread used to pay AFTER the fill — done here so a
+        prefetch hit publishes a finished batch.  Label mapping is
+        shared with the sync path (:meth:`_map_labels_into`); a loader
+        that overrides :meth:`normalize_minibatch` or
+        :meth:`pad_minibatch` with non-default semantics must override
+        this too."""
+        self.normalizer.normalize(data_out[:size])
+        if size < self.max_minibatch_size:
+            data_out[size:] = 0.0
+        if self.has_labels:
+            self._map_labels_into(labels_out, raw_labels, size)
+
     def _submit_fill(self, key, indices, size):
-        """Queue a background fill of ``indices`` into private buffers
-        under ``key`` (the (offset, size) the matching serve will
-        present).  ``_fill_lock_`` serializes against synchronous fills
+        """Queue a background fill of ``indices`` into a staging-ring
+        slot under ``key`` (the (offset, size) the matching serve will
+        present).  The worker does the WHOLE prep — fill, normalize,
+        label-map, pad — then kicks a non-blocking device upload, so
+        the serve thread's share of a hit is one ``publish()``.
+        ``_fill_lock_`` serializes against synchronous fills
         (subclasses may share file handles)."""
-        data_out = numpy.zeros_like(self.minibatch_data.mem)
+        from veles_tpu.memory import StagingRing
+        data_out = self._staging().acquire()
+        labels_out = numpy.full(self.max_minibatch_size, -1,
+                                dtype=LABEL_DTYPE)
         raw_labels = [None] * self.max_minibatch_size
+        device = self.device
 
         def work():
+            # the WHOLE body under the fill lock: it serializes shared
+            # file handles AND ring-slot access — a dropped worker
+            # still prepping a recycled slot must never overlap a
+            # newer worker's fill of the same buffer
             with self._fill_lock_:
                 self.fill_minibatch_into(indices, data_out[:size],
                                          raw_labels)
-            return data_out, raw_labels
+                self._prepare_staged(data_out, labels_out, raw_labels,
+                                     size)
+                dev_data = StagingRing.upload(device, data_out)
+                dev_labels = StagingRing.upload(device, labels_out) \
+                    if self.has_labels else None
+            return data_out, labels_out, raw_labels, dev_data, dev_labels
 
         from veles_tpu import thread_pool
         self._prefetch_futures_[key] = thread_pool.submit(work)
@@ -461,29 +562,35 @@ class Loader(Unit):
             return
         self._submit_fill(key, numpy.array(data["indices"]), key[1])
 
-    def _fill_current(self, minibatch_def):
-        """Use the prefetched buffers when they match the minibatch being
-        served; otherwise fall back to a synchronous fill."""
+    def _consume_prefetched(self, minibatch_def):
+        """Publish the prepared staging pair when a background fill
+        matches the minibatch being served; ``False`` → the caller
+        falls back to the synchronous fill+prep path.  A worker
+        exception propagates here (never lost in the pool) and demotes
+        to the sync path with the full traceback logged."""
         key = (int(minibatch_def[0]), int(minibatch_def[1]))
         fut = self._prefetch_futures_.pop(key, None)
-        if fut is not None:
-            try:
-                data, raw_labels = fut.result()
-            except Exception:
-                self.exception("prefetch failed — refilling synchronously")
-            else:
-                size = self.minibatch_size
-                self.minibatch_data.map_write()
-                self.minibatch_data.mem[:size] = data[:size]
-                self.raw_minibatch_labels[:] = raw_labels
-                return
-        if self._prefetch_futures_ and not self.is_slave:
-            # stale standalone predictions: drop (slave mode keeps the
-            # map — a mismatch there just means the future belongs to
-            # the NEXT job, racing the current serve)
-            self._prefetch_futures_.clear()
-        with self._fill_lock_:
-            self.fill_minibatch()
+        if fut is None:
+            if self._prefetch_futures_ and not self.is_slave:
+                # stale standalone predictions: drop (slave mode keeps
+                # the map — a mismatch there just means the future
+                # belongs to the NEXT job, racing the current serve)
+                self._prefetch_futures_.clear()
+            return False
+        try:
+            data, labels, raw_labels, dev_data, dev_labels = fut.result()
+        except Exception:
+            self.exception("prefetch failed — refilling synchronously")
+            return False
+        # both representations land fresh: the host copy for host
+        # consumers, the already-uploaded device copy for the jitted
+        # chain — and the PREVIOUS device minibatch is released for
+        # allocator reuse (Vector.publish)
+        self.minibatch_data.publish(data, dev_data)
+        self.raw_minibatch_labels[:] = raw_labels
+        if self.has_labels:
+            self.minibatch_labels.publish(labels, dev_labels)
+        return True
 
     def _on_successful_serve(self):
         self.samples_served += self.minibatch_size

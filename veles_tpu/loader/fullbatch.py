@@ -14,12 +14,27 @@ round-trips to host during training.  Normalization is applied to the
 resident data once at initialize (the reference normalizes per-minibatch
 on host; one-shot is equivalent for stateless/TRAIN-fit normalizers and
 removes a per-step host pass).
+
+Stitched-eager device fast path (``root.common.engine.loader``,
+default ``auto``): when a jit device is attached the loader HEADS the
+first stitched segment — :meth:`FullBatchLoader.stitch_stage` keeps
+the serving bookkeeping as a host prelude and turns per-step minibatch
+selection into an in-program ``jnp.take`` over the device-resident
+shuffled-index buffer with traced ``minibatch_size`` masking.  The
+gather fuses into the first forward program, ``pad_minibatch`` /
+``normalize_minibatch`` stay no-ops, and a training step moves ZERO
+per-step host→device bytes (the index buffer re-uploads once per
+epoch shuffle; slaves re-use the resident dataset across jobs and
+``prefetch_job_data`` stages the next job's index span concurrently
+with the current compute).
 """
 
 import numpy
 
-from veles_tpu.loader.base import Loader, LoaderError, TRAIN
-from veles_tpu.memory import Vector
+from veles_tpu.config import root
+from veles_tpu.loader.base import (
+    INDEX_DTYPE, Loader, LoaderError, TRAIN)
+from veles_tpu.memory import StagingRing, Vector
 from veles_tpu.ops.gather import take_rows
 
 
@@ -47,11 +62,39 @@ class FullBatchLoader(Loader):
         #: (scale, shift) for the jitted consumer; None unless
         #: native_device_dtype is active
         self.input_norm = None
+        #: the pre-mapped labels as a device-residable Vector (int32),
+        #: built at initialize when the dataset is labeled
+        self.resident_labels = Vector()
         super(FullBatchLoader, self).__init__(workflow, **kwargs)
+
+    def init_unpickled(self):
+        super(FullBatchLoader, self).init_unpickled()
+        #: staged device index buffers for the NEXT job's span
+        #: (prefetch_job_data → apply_data_from_master hand-off):
+        #: {(offset, size): (new_host_indices, Future[device array])}
+        self._staged_indices_ = {}
 
     @property
     def has_labels(self):
         return len(self.original_labels) > 0
+
+    @property
+    def device_fast_path_active(self):
+        """True when minibatch selection can run as an in-program
+        gather over the HBM-resident dataset (the loader-headed
+        stitched segment).  Resolution of ``root.common.engine.loader``:
+        ``host`` disables; ``device``/``auto`` engage whenever a jit
+        device is attached, the dataset is resident and normalized
+        float (``native_device_dtype`` keeps its symbolic normalizer
+        for the fused path only)."""
+        mode = str(root.common.engine.get("loader", "auto")).lower()
+        if mode == "host":
+            return False
+        return (self.device is not None
+                and not self.device.is_interpret
+                and self.store_in_device_memory
+                and not self.native_device_dtype
+                and bool(self.original_data))
 
     def create_minibatch_data(self):
         self.minibatch_data.reset(numpy.zeros(
@@ -59,11 +102,9 @@ class FullBatchLoader(Loader):
             dtype=self.original_data.dtype))
 
     def initialize(self, device=None, **kwargs):
-        super(FullBatchLoader, self).initialize(**kwargs)
-        if device is not None:
-            self.device = device
-        else:
-            self.device = getattr(self.workflow, "device", None)
+        # device resolution (explicit arg → workflow.device) lives in
+        # ONE place: the base Loader.initialize
+        super(FullBatchLoader, self).initialize(device=device, **kwargs)
         if len(self.original_data) != self.total_samples:
             raise LoaderError(
                 "original_data has %d samples, class_lengths say %d" %
@@ -91,13 +132,17 @@ class FullBatchLoader(Loader):
                       else self.labels_mapping.get(raw, raw)
                       for raw in self.original_labels]
             self._mapped_labels = numpy.asarray(mapped, dtype=numpy.int32)
+            self.resident_labels.reset(self._mapped_labels)
         else:
             self._mapped_labels = None
+        self._staged_indices_.clear()
         if self.device is not None and not self.device.is_interpret \
                 and self.store_in_device_memory:
             self.original_data.initialize(self.device)
             self.original_data.devmem  # upload once
             self.minibatch_data.initialize(self.device)
+            if self.resident_labels:
+                self.resident_labels.initialize(self.device)
 
     def analyze_dataset(self):
         """The dataset is fully resident: analyze directly instead of
@@ -117,8 +162,13 @@ class FullBatchLoader(Loader):
     def fill_minibatch(self):
         """Gather the minibatch rows (device-side when resident)."""
         count = self.minibatch_size
-        self.minibatch_indices.map_write()
-        self.minibatch_indices.mem[count:] = -1
+        if count < self.max_minibatch_size:
+            # short batch: -1 the tail for DIRECT fill_minibatch
+            # callers (_iterate_class) — the serve path already did
+            # this in fill_indices.  A full batch has no tail: skip
+            # the write entirely (the fast-skip satellite)
+            self.minibatch_indices.map_write()
+            self.minibatch_indices.mem[count:] = -1
         indices = self.minibatch_indices.mem[:self.max_minibatch_size]
         if self.device is not None and not self.device.is_interpret \
                 and self.store_in_device_memory:
@@ -159,6 +209,126 @@ class FullBatchLoader(Loader):
         """No-op: labels were mapped in fill_minibatch from the
         pre-mapped resident array."""
 
+    # -- the loader-headed stitched segment (device fast path) --------------
+    def _device_stage_plan(self):
+        """``(name, source Vector, output Vector, pad value)`` rows the
+        in-program gather produces; :class:`FullBatchLoaderMSE` extends
+        with targets."""
+        plan = [("minibatch_data", self.original_data,
+                 self.minibatch_data, 0)]
+        if self.has_labels:
+            plan.append(("minibatch_labels", self.resident_labels,
+                         self.minibatch_labels, -1))
+        return plan
+
+    def stitch_stage(self):
+        """Head stage of the stitched eager chain: the host serving
+        bookkeeping rides as the segment prelude
+        (:meth:`veles_tpu.loader.base.Loader.stitch_prelude`) and the
+        fill becomes a masked ``jnp.take`` over the resident dataset —
+        the served span of the device-resident shuffled-index buffer is
+        selected by the traced (offset, size) scalars, so one trace
+        serves every batch of every class, short epoch tails included,
+        and the gather fuses into the first forward program."""
+        from veles_tpu.stitch import StitchStage
+        if not self.device_fast_path_active:
+            return None
+        import jax.numpy as jnp
+        max_mb = int(self.max_minibatch_size)
+        plan = self._device_stage_plan()
+        pads = {name: pad for name, _src, _out, pad in plan}
+
+        def fn(t):
+            offset = t["offset"].astype(jnp.int32)
+            size = t["size"].astype(jnp.int32)
+            pos = jnp.arange(max_mb, dtype=jnp.int32)
+            valid = pos < size
+            idx = jnp.take(t["indices"],
+                           jnp.where(valid, offset + pos, 0))
+            out = {}
+            for name in pads:
+                rows = jnp.take(t["src_" + name], idx, axis=0)
+                mask = valid.reshape((-1,) + (1,) * (rows.ndim - 1))
+                out[name] = jnp.where(mask, rows, pads[name])
+            return out
+
+        params = {"indices": self.shuffled_indices}
+        produces = {}
+        for name, src, out_vec, _pad in plan:
+            params["src_" + name] = src
+            produces[name] = out_vec
+        loader = self
+        return StitchStage(
+            self, fn, produces=produces, params=params,
+            # ints, not floats: the segment passes python ints through
+            # to the trace as int32, keeping offsets exact for
+            # datasets beyond 2**24 samples
+            scalars=lambda: {
+                "offset": int(loader.minibatch_offset
+                              - loader.minibatch_size),
+                "size": int(loader.minibatch_size)},
+            prelude=self.stitch_prelude)
+
+    # -- distribution: job-spanning residency -------------------------------
+    def prefetch_job_data(self, data):
+        """Slave-side lookahead on the device fast path: merge the NEXT
+        job's index span into a private copy of the shuffled-index
+        buffer and upload it in the background, so the next job's only
+        H2D bytes overlap the current job's compute (the dataset itself
+        never re-uploads — it is resident across jobs).  Host-path
+        loaders keep the base fill-prefetch ring; like that ring,
+        background staging is opt-in via the loader's ``prefetch``
+        flag — an operator who disabled prefetch gets no background
+        threads on ANY path."""
+        if not self.device_fast_path_active:
+            return super(FullBatchLoader, self).prefetch_job_data(data)
+        if not self.prefetch:
+            return
+        key = (int(data["minibatch_offset"]),
+               int(data["minibatch_size"]))
+        if self._staged_indices_:
+            # one staged span at a time: a second merge would snapshot
+            # shuffled_indices BEFORE the first span lands, so its
+            # buffer is stale by construction and apply_data_from_master
+            # would discard it anyway — don't pay the copy + upload
+            return
+        self.shuffled_indices.map_read()
+        merged = numpy.array(self.shuffled_indices.mem)
+        merged[key[0] - key[1]:key[0]] = numpy.asarray(
+            data["indices"], dtype=INDEX_DTYPE)
+        from veles_tpu import thread_pool
+        fut = thread_pool.submit(StagingRing.upload, self.device, merged)
+        self._staged_indices_[key] = (merged, fut)
+
+    def apply_data_from_master(self, data):
+        key = (int(data["minibatch_offset"]),
+               int(data["minibatch_size"]))
+        staged = self._staged_indices_.pop(key, None)
+        if self._staged_indices_:
+            # a miss (or pipeline reorder) means the remaining
+            # lookahead is stale — 2-deep job pipeline, same policy as
+            # the base prefetch ring
+            self._staged_indices_.clear()
+        if staged is None:
+            return super(FullBatchLoader, self).apply_data_from_master(
+                data)
+        for attr in ("minibatch_class", "minibatch_size",
+                     "minibatch_offset", "epoch_number"):
+            setattr(self, attr, data[attr])
+        self.last_minibatch <<= False
+        self.epoch_ended <<= False
+        self.train_ended <<= False
+        if numpy.asarray(data["indices"]).size != self.minibatch_size:
+            raise LoaderError("minibatch size mismatch in job payload")
+        merged, fut = staged
+        try:
+            dev = fut.result()
+        except Exception:
+            self.exception("staged index upload failed — re-uploading "
+                           "on demand")
+            dev = None
+        self.shuffled_indices.publish(merged, dev)
+
 
 class FullBatchLoaderMSE(FullBatchLoader):
     """Adds per-sample regression targets (ref ``fullbatch.py:563``)."""
@@ -169,6 +339,12 @@ class FullBatchLoaderMSE(FullBatchLoader):
         self.original_targets = Vector()
         self.minibatch_targets = Vector()
         super(FullBatchLoaderMSE, self).__init__(workflow, **kwargs)
+
+    def _device_stage_plan(self):
+        plan = super(FullBatchLoaderMSE, self)._device_stage_plan()
+        plan.append(("minibatch_targets", self.original_targets,
+                     self.minibatch_targets, 0))
+        return plan
 
     def initialize(self, device=None, **kwargs):
         super(FullBatchLoaderMSE, self).initialize(device=device, **kwargs)

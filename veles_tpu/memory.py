@@ -25,11 +25,21 @@ from veles_tpu.distributable import Pickleable
 
 
 class Watcher(object):
-    """Device-memory accounting (ref ``memory.py:56-107``)."""
+    """Device-memory accounting (ref ``memory.py:56-107``).
+
+    Besides the reference's peak-allocation bookkeeping, the Watcher
+    counts **host→device transfer traffic** (``h2d_bytes`` /
+    ``h2d_transfers``): every Vector upload and every staging-ring
+    upload reports here, so the bench ladder can record
+    ``h2d_bytes_per_step`` and the input-pipeline work (device-resident
+    gather, prefetch ring) shows up as eliminated transfer bytes, not
+    just img/s."""
 
     lock = threading.Lock()
     bytes_in_use = 0
     peak_bytes = 0
+    h2d_bytes = 0
+    h2d_transfers = 0
 
     @classmethod
     def track(cls, nbytes):
@@ -43,10 +53,18 @@ class Watcher(object):
             cls.bytes_in_use -= nbytes
 
     @classmethod
+    def track_h2d(cls, nbytes):
+        with cls.lock:
+            cls.h2d_bytes += int(nbytes)
+            cls.h2d_transfers += 1
+
+    @classmethod
     def reset(cls):
         with cls.lock:
             cls.bytes_in_use = 0
             cls.peak_bytes = 0
+            cls.h2d_bytes = 0
+            cls.h2d_transfers = 0
 
 
 class Vector(Pickleable):
@@ -143,6 +161,7 @@ class Vector(Pickleable):
             if self._mem is None:
                 raise ValueError("empty Vector has no device memory")
             self._set_devmem(self._device.put(self._mem))
+            Watcher.track_h2d(self._mem.nbytes)
             self._dev_fresh_ = True   # host and device now agree
         return self._devmem_
 
@@ -174,6 +193,32 @@ class Vector(Pickleable):
             # numpy views of jax arrays are read-only — materialize.
             self._mem = numpy.array(self._mem)
         self._dev_fresh_ = False
+        return self
+
+    def publish(self, host_array=None, device_array=None):
+        """Install matching host and device copies in ONE step — the
+        consume half of the prefetch staging ring: a background worker
+        prepared both representations (host fill + async upload), so
+        neither side needs a transfer here.  The previous device
+        minibatch is released first (its buffer returns to the
+        allocator — the donation analogue for a producer that cannot
+        alias into jit's donate_argnums).
+
+        Passing only ``host_array`` behaves like an in-place
+        ``map_write`` edit; passing both marks BOTH sides fresh."""
+        if host_array is not None:
+            if self._mem is None or self._mem.shape != host_array.shape \
+                    or not self._mem.flags.writeable:
+                self._mem = numpy.array(host_array)
+            else:
+                self._mem[...] = host_array
+            self._host_fresh_ = True
+            self._dev_fresh_ = False
+        if device_array is not None:
+            self._set_devmem(device_array)
+            self._dev_fresh_ = True
+            if host_array is None:
+                self._host_fresh_ = False
         return self
 
     def map_invalidate(self):
@@ -215,6 +260,51 @@ class Vector(Pickleable):
             self._drop_devmem()
         except Exception:  # pragma: no cover - interpreter shutdown
             pass
+
+
+class StagingRing(object):
+    """Double-buffered host staging for the loader prefetch ring.
+
+    A fixed ring of reusable host staging buffers (allocated ONCE —
+    the seed prefetch path allocated a fresh ``zeros_like`` per
+    background fill) plus a non-blocking upload helper: a background
+    worker ``acquire()``\\ s the next slot, fills/normalizes/pads in
+    place, then ``upload()``\\ s it so the device copy is in flight
+    while the consumer still computes on the previous minibatch.
+
+    Slot-reuse contract: a slot may be overwritten once ``depth``
+    newer acquisitions happened — the caller picks ``depth`` ≥ its
+    maximum fills-in-flight plus buffers still being read (the loader
+    ring uses 3: ≤ 2 in-flight fills + 1 slot the consumer may still
+    be publish-copying).
+    """
+
+    def __init__(self, shape, dtype, depth=2):
+        self.depth = int(depth)
+        self._slots = [numpy.zeros(shape, dtype=dtype)
+                       for _ in range(self.depth)]
+        self._pos = 0
+        self._lock = threading.Lock()
+
+    def acquire(self):
+        """Next reusable staging buffer (round-robin)."""
+        with self._lock:
+            slot = self._slots[self._pos]
+            self._pos = (self._pos + 1) % self.depth
+        return slot
+
+    @staticmethod
+    def upload(device, array):
+        """Kick a host→device copy of a staged buffer and return the
+        device array (``None`` when there is no jit device).  The put
+        runs on the CALLING (background) thread — the scheduler thread
+        never blocks on it — and the traffic is accounted so
+        ``h2d_bytes_per_step`` bench records see staged uploads too."""
+        if device is None or getattr(device, "is_interpret", True):
+            return None
+        out = device.put(array)
+        Watcher.track_h2d(array.nbytes)
+        return out
 
 
 def device_get_all(values):

@@ -459,6 +459,14 @@ class JobClient(Logger):
                           "per job (%s)", len(info["segments"]),
                           "; ".join("+".join(names)
                                     for names in info["segments"]))
+            if any(info.get("loader_headed", ())):
+                # the input pipeline is device-resident: the dataset
+                # uploads once and stays on HBM across EVERY job; only
+                # each job's index span moves (run_prefetch overlaps
+                # even that with the current compute)
+                self.info("device-resident loader: dataset stays on "
+                          "HBM across jobs; per-job H2D is the index "
+                          "span only")
         return self
 
     def run(self, max_jobs=None):
@@ -532,7 +540,9 @@ class JobClient(Logger):
                     if next_reply.get("op") == "job":
                         # overlap the NEXT minibatch's IO with the rest
                         # of the current compute (loader-side
-                        # double-buffering, ref client.py:293-296)
+                        # double-buffering, ref client.py:293-296;
+                        # device-resident loaders stage the next job's
+                        # index-span upload here instead of a fill)
                         prefetch_hook = getattr(
                             self.workflow, "prefetch_job", None)
                         if prefetch_hook is not None:

@@ -21,6 +21,17 @@ so an LRAdjuster changing learning rates never retraces) and device
 metrics (published back as async device scalars — fetched deferred, see
 ``root.common.engine.metrics_every``).
 
+Loader-headed segments: a stage may additionally carry a ``prelude`` —
+a host callable the segment runs *before* fetching scalars/inputs at
+every dispatch.  This is how the device-resident input pipeline fuses
+into the first forward program: ``FullBatchLoader.stitch_stage()``
+keeps the serving bookkeeping (offset advance, epoch flags, retry
+accounting) as the prelude and turns the minibatch fill into an
+in-program gather over the HBM-resident dataset, so
+``minibatch_data``/``minibatch_labels`` are produced directly on
+device with zero per-step host→device traffic (see
+``docs/engine_fast_path.md`` § Input pipeline).
+
 Segment eligibility (checked per chain link ``u → v``):
 
 * ``u.links_to == {v}`` and ``v.links_from == {u}`` — strictly linear
@@ -64,10 +75,11 @@ class StitchStage(object):
     """
 
     __slots__ = ("unit", "fn", "consumes", "produces", "params",
-                 "donated", "scalars", "metrics")
+                 "donated", "scalars", "metrics", "prelude")
 
     def __init__(self, unit, fn, consumes=None, produces=None,
-                 params=None, donated=None, scalars=None, metrics=()):
+                 params=None, donated=None, scalars=None, metrics=(),
+                 prelude=None):
         self.unit = unit
         self.fn = fn
         self.consumes = dict(consumes or {})
@@ -77,6 +89,9 @@ class StitchStage(object):
         #: callable → {name: python scalar}, fetched at every dispatch
         self.scalars = scalars
         self.metrics = tuple(metrics)
+        #: host callable run before every dispatch (serving bookkeeping
+        #: of a loader-headed segment); runs BEFORE scalars are fetched
+        self.prelude = prelude
 
     def vectors(self):
         for group in (self.consumes, self.produces, self.params,
@@ -197,16 +212,33 @@ class StitchSegment(Logger):
         outputs = [env[id(vec)] for vec in self._output_vecs]
         return outputs, new_don, metrics
 
+    @property
+    def has_prelude(self):
+        """True for loader-headed segments (a stage carries host
+        serving bookkeeping executed before each dispatch)."""
+        return any(stage.prelude is not None for stage in self.stages)
+
     # -- execution ----------------------------------------------------------
     def execute(self):
         """Dispatch the whole segment as one program and publish."""
+        # host preludes first (a loader head advances its serving state
+        # here — the scalars fetched below must see the NEW offsets)
+        for stage in self.stages:
+            if stage.prelude is not None:
+                stage.prelude()
         inputs = tuple(vec.devmem for vec in self._input_vecs)
         ro = tuple(vec.devmem for vec in self._ro_vecs)
         don = tuple(vec.devmem for vec in self._don_vecs)
         scalars = []
         for stage, names in self._scalar_fetchers:
             values = stage.scalars()
-            scalars.extend(float(values[n]) for n in names)
+            # ints stay ints: a python int traces as (weak) int32, so
+            # index-like scalars (the loader's offset/size) keep exact
+            # integer semantics — float32 would silently round offsets
+            # beyond 2**24.  Per-name types are stable across calls,
+            # so this never retraces.
+            scalars.extend(values[n] if isinstance(values[n], int)
+                           else float(values[n]) for n in names)
         outputs, new_don, metrics = self._jitted(
             inputs, ro, don, tuple(scalars))
         for vec, arr in zip(self._output_vecs, outputs):

@@ -241,12 +241,16 @@ class Workflow(Unit):
     def stitch_report(self):
         """Observability: segment composition + dispatch counts (the
         compile/dispatch-count tests and the job layer's slave log
-        read this)."""
+        read this).  ``loader_headed`` marks segments whose head runs a
+        host prelude — i.e. the device-resident input pipeline fused
+        the minibatch gather into that program."""
         from veles_tpu import stitch
         return {
             "enabled": stitch.enabled(),
             "segments": [segment.names
                          for segment in self._stitch_segments_],
+            "loader_headed": [segment.has_prelude
+                              for segment in self._stitch_segments_],
             "dispatches": sum(segment.dispatches
                               for segment in self._stitch_segments_),
         }

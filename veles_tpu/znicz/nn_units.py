@@ -122,11 +122,15 @@ class ForwardBase(AcceleratedUnit):
     def apply_data_from_master(self, data):
         if data is None:
             return
-        self.weights.map_write()
-        self.weights.mem[...] = data["weights"]
+        # whole-buffer install: reset() instead of map_write() — the
+        # job payload REPLACES the weights, so the map_write D2H fetch
+        # of the about-to-be-overwritten device values was a wasted
+        # per-layer-per-job sync (the job layer keeps everything else
+        # device-resident; see docs/engine_fast_path.md § Input
+        # pipeline, master–slave residency)
+        self.weights.reset(numpy.asarray(data["weights"]))
         if "bias" in data and self.bias:
-            self.bias.map_write()
-            self.bias.mem[...] = data["bias"]
+            self.bias.reset(numpy.asarray(data["bias"]))
         # remember the job's starting point so the update we send back is
         # a *delta* the master can merge additively (async DP: slaves
         # compute on possibly-stale weights, master accumulates deltas —
