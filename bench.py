@@ -528,18 +528,28 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
     ``loader_mode`` pins ``root.common.engine.loader`` for the stage
     (the eager line runs "host" so its number stays the PR 3 baseline;
     the devloader line runs "device").  Every record carries
-    ``h2d_bytes_per_step`` — Watcher-accounted host→device traffic per
-    train-equivalent step over the timed region — so BENCH_*.json
-    tracks transfer ELIMINATION, not just img/s."""
-    from veles_tpu import prng
+    ``h2d_bytes_per_step`` AND ``d2h_bytes_per_step`` —
+    Watcher-accounted transfer traffic per train-equivalent step over
+    the timed region, both directions — so BENCH_*.json tracks
+    transfer ELIMINATION, not just img/s; plus the timed region's
+    span counts from the trace recorder (``trace_dispatches`` =
+    stitched-segment programs dispatched, ``trace_compiles`` =
+    first-dispatch compiles — a nonzero value here means warmup leaked
+    into the timed region).  The recorder is force-enabled for the
+    stage (its per-event cost is a ring write, orders below the step
+    time); the ``engine.trace=off`` <1% criterion is about the
+    DEFAULT state and is asserted by tests, not this ladder."""
+    from veles_tpu import prng, trace
     from veles_tpu.backends import AutoDevice
     from veles_tpu.config import root
     from veles_tpu.memory import Watcher
     from veles_tpu.samples import mnist
 
     saved_loader = root.common.engine.get("loader", "auto")
+    saved_trace = root.common.engine.get("trace", "off")
     if loader_mode is not None:
         root.common.engine.loader = loader_mode
+    root.common.engine.trace = "on"    # initialize() → trace.configure
     try:
         prng.seed_all(1234)
         batch = 2048
@@ -554,12 +564,22 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
         wf.decision.complete <<= False
         wf.decision.max_epochs = 4
         h2d_before = Watcher.h2d_bytes
+        d2h_before = Watcher.d2h_bytes
+        dispatches_before = trace.recorder.count("segment", "dispatch")
+        compiles_before = trace.recorder.count("segment", "compile")
         tic = time.perf_counter()
         wf.run()                           # epochs 3-4, warm
         elapsed = time.perf_counter() - tic
         h2d_delta = Watcher.h2d_bytes - h2d_before
+        d2h_delta = Watcher.d2h_bytes - d2h_before
+        dispatches = trace.recorder.count("segment", "dispatch") \
+            - dispatches_before
+        compiles = trace.recorder.count("segment", "compile") \
+            - compiles_before
     finally:
         root.common.engine.loader = saved_loader
+        root.common.engine.trace = saved_trace
+        trace.configure()
     # train-only images over the wall clock (which includes the eval
     # passes): comparable to the fused synthetic-batch line — counting
     # eval minibatches as served images made this neither a train
@@ -570,6 +590,10 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
     extra = dict(extra or {})
     extra.setdefault("h2d_bytes_per_step",
                      round(h2d_delta * batch / train_samples, 1))
+    extra.setdefault("d2h_bytes_per_step",
+                     round(d2h_delta * batch / train_samples, 1))
+    extra.setdefault("trace_dispatches", dispatches)
+    extra.setdefault("trace_compiles", compiles)
     if loader_mode is not None:
         extra.setdefault("loader", loader_mode)
     _emit(metric, sec_per_step, batch, None, vs=vs, extra=extra)

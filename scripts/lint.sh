@@ -1,11 +1,13 @@
 #!/usr/bin/env bash
 # Self-lint veles_tpu/ with the analyze lint pack (pass 3) — the same
 # invocation the tier-1 suite gates on (test_analyze.py::
-# test_lint_self_clean_tier1) — then run the workflow analyzer (graph
-# doctor + JAX hazard pass, V-J06 included) over the samples/ demo
-# modules that build a real training graph; warnings print, errors
-# fail.  samples/analyze_demo is deliberately broken (it exercises the
-# rule catalog) and is covered by test_analyze.py instead.
+# test_lint_self_clean_tier1); the default path is the whole installed
+# package, so the veles_tpu/trace/ observability subsystem self-lints
+# here too.  Then run the workflow analyzer (graph doctor + JAX hazard
+# pass, V-J06/V-J08 included) over the samples/ demo modules that
+# build a real training graph; warnings print, errors fail.
+# samples/analyze_demo is deliberately broken (it exercises the rule
+# catalog) and is covered by test_analyze.py instead.
 # Extra args pass through to the lint invocation, e.g.
 #   scripts/lint.sh --json
 #   scripts/lint.sh path/to/other/package

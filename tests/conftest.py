@@ -37,6 +37,29 @@ def _seed_prng():
 
 
 @pytest.fixture(autouse=True)
+def _force_trace(request):
+    """The ``traced`` marker: force-enable the trace recorder around
+    the test — via the CONFIG knob (not by poking the recorder), so a
+    ``Workflow.initialize()`` inside the test (which re-reads the knob
+    through ``trace.configure()``) keeps it on.  The ring starts empty
+    and the default off-state is restored afterwards, so unmarked
+    tests see the stock single-attribute-check disabled path."""
+    if request.node.get_closest_marker("traced") is None:
+        yield
+        return
+    from veles_tpu import trace
+    from veles_tpu.config import root
+    saved = root.common.engine.get("trace", "off")
+    root.common.engine.trace = "on"
+    trace.recorder.clear()
+    trace.configure()
+    yield
+    root.common.engine.trace = saved
+    trace.configure()
+    trace.recorder.clear()
+
+
+@pytest.fixture(autouse=True)
 def _pin_synthetic_data(request, tmp_path, monkeypatch):
     """Short sample runs everywhere in the suite were calibrated on the
     synthetic stand-ins; a machine provisioned with real datasets (for

@@ -521,3 +521,76 @@ def test_main_analyze_flag(capsys):
     assert rc == 1
     out = capsys.readouterr().out
     assert "V-G05" in out and "V-J01" in out
+
+
+# -- V-J08: blocking host syncs on the hot loop -----------------------------
+
+def test_v_j08_blocking_sync_on_hot_loop():
+    """V-J08: the unconditionally-blocking syncs — jax.device_get,
+    .block_until_ready()/.item(), and float()/int() casts of jnp
+    expressions — escalate from the generic V-J05 on hot-loop
+    run()/tpu_run() bodies; host math (shape reads, python ints) and
+    numpy_run stay quiet, and OFF the hot loop the calls keep their
+    plain V-J05 classification."""
+    from veles_tpu.analyze.shapes import scan_transfer_hazards
+
+    class BlockyUnit(Unit):
+        hide_from_registry = True
+
+        def run(self):
+            import jax
+            self.loss_host = jax.device_get(self.loss)
+            self.err_output.devmem.block_until_ready()
+
+        def tpu_run(self):
+            import jax.numpy as jnp
+            self.mse = float(jnp.sqrt(self.acc))       # device scalar
+            self.n = int(self.output.devmem.sum())     # device scalar
+            # deferred-metrics-compatible host math stays clean:
+            self.scale = float(self.err_output.shape[0])
+            self.batch = int(self.batch_size)
+
+        def numpy_run(self):
+            import jax
+            return jax.device_get(self.loss)     # debug path: unscanned
+
+    wf = DummyWorkflow()
+    unit = BlockyUnit(wf, name="blocky")
+    hot = scan_transfer_hazards(unit, hot_loop=True)
+    assert rules_of(hot) == {"V-J08"}, [f.render() for f in hot]
+    assert len(hot) == 4
+    off = scan_transfer_hazards(unit)
+    assert rules_of(off) == {"V-J05"}, [f.render() for f in off]
+    assert len(off) == 2      # the float()/int() casts are hot-loop-only
+
+
+def test_v_j08_in_catalog_and_hot_scan_keeps_standard_units_clean():
+    """The rule is in the catalog (--rules), and the device-resident
+    evaluators' legitimate float(shape)/int(batch_size) host math does
+    not trip it — a real eager workflow stays V-J08-clean."""
+    assert "V-J08" in rule_catalog()
+
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class TinyLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            self.original_data.mem = rng.standard_normal(
+                (40, 8)).astype(numpy.float32)
+            self.original_labels = [int(i % 4) for i in range(40)]
+            self.class_lengths[:] = [0, 0, 40]
+
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: TinyLoader(w, minibatch_size=8),
+        layers=[{"type": "softmax",
+                 "->": {"output_sample_shape": 4}}],
+        decision_config={"max_epochs": 1})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=NumpyDevice())
+    findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
+    assert "V-J08" not in rules_of(findings), \
+        [f.render() for f in findings]

@@ -390,3 +390,39 @@ def test_client_default_power_from_db(tmp_path, monkeypatch):
     assert jobs._default_power() == 123456.0
     db_path.unlink()
     assert jobs._default_power() == 1.0
+
+
+def test_print_stats_reports_job_latency_percentiles(caplog):
+    """Satellite: per-slave job round-trip latency lands in the SHARED
+    LatencyHistogram (one implementation for serve and jobs) and
+    print_stats renders the percentile line."""
+    import logging
+
+    from veles_tpu import metrics as shared_metrics
+    from veles_tpu.serve import metrics as serve_metrics
+
+    # the lift: serve re-exports the shared class, no drifted copy
+    assert serve_metrics.LatencyHistogram \
+        is shared_metrics.LatencyHistogram
+
+    master = ScriptedMaster(n_jobs=4)
+    server = JobServer(master).start()
+    try:
+        client = JobClient(ScriptedSlave(), server.endpoint)
+        client.handshake()
+        assert client.run()
+        slave = server.slaves[client.sid]
+        assert isinstance(slave.latency,
+                          shared_metrics.LatencyHistogram)
+        assert slave.latency.count == 4
+        assert slave.latency.mean > 0
+        assert slave.latency.percentile(99) >= \
+            slave.latency.percentile(50) > 0
+        with caplog.at_level(logging.INFO):
+            server.print_stats()
+        lines = [r.getMessage() for r in caplog.records]
+        assert any("job latency" in line and "p95" in line
+                   for line in lines), lines
+        client.close()
+    finally:
+        server.stop()

@@ -1,0 +1,443 @@
+"""veles_tpu.trace — the unified tracing/observability subsystem.
+
+Recorder mechanics (ring wraparound keeps the newest spans, per-thread
+nesting, the disabled path's no-work contract), Chrome trace-event
+export schema, report totals matching the exported file, the
+summarizer CLI, the ``engine.trace`` knob — and the CI canary: a
+``traced``-marked stitched sample run asserting that ALL FIVE
+instrumented categories (segment, loader, h2d, serve, jobs) actually
+emit events, so a refactor can never silently detach the
+instrumentation."""
+
+import json
+import sys
+import threading
+import time
+
+import numpy
+import pytest
+
+from veles_tpu import trace
+from veles_tpu.config import root
+from veles_tpu.trace.core import TraceRecorder
+
+
+@pytest.fixture
+def live_trace():
+    """Enable the GLOBAL recorder directly (workflow-free tests that
+    must not depend on the config knob); restores the stock disabled
+    state."""
+    rec = trace.recorder
+    saved = (rec.enabled, rec.path, rec.role)
+    rec.clear()
+    rec.enabled = True
+    yield trace
+    rec.enabled, rec.path, rec.role = saved
+    rec.clear()
+
+
+# -- recorder mechanics ----------------------------------------------------
+
+def test_ring_wraparound_keeps_newest_spans():
+    rec = TraceRecorder(capacity=8)
+    rec.enabled = True
+    for i in range(20):
+        rec.record("X", "cat", "s%d" % i, i * 1000, 10)
+    events = rec.events()
+    assert len(events) == 8
+    assert [ev[2] for ev in events] == ["s%d" % i for i in range(12, 20)]
+    assert rec.dropped == 12
+    assert rec.recorded == 20
+    # the aggregate counters survive wraparound (bench reads these)
+    assert rec.count("cat") == 20
+    assert rec.count("cat", "s3") == 1          # wrapped out, still counted
+    assert rec.category_counts() == {"cat": 20}
+
+
+def test_thread_interleaved_spans_nest_per_thread(live_trace):
+    barrier = threading.Barrier(2)
+
+    def work(name):
+        barrier.wait()
+        with trace.span("test", "outer-" + name):
+            time.sleep(0.002)
+            with trace.span("test", "inner-" + name):
+                time.sleep(0.002)
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=work, args=(n,))
+               for n in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    by_tid = {}
+    for ph, cat, name, ts, dur, tid, _args, _role in \
+            trace.recorder.events():
+        if cat == "test":
+            by_tid.setdefault(tid, {})[name.split("-")[0]] = (ts,
+                                                             ts + dur)
+    assert len(by_tid) == 2
+    names = set()
+    for spans in by_tid.values():
+        assert set(spans) == {"outer", "inner"}
+        # context-manager spans nest strictly per thread: the inner
+        # interval lies inside the SAME thread's outer interval even
+        # though both threads interleave in the shared ring
+        assert spans["outer"][0] < spans["inner"][0]
+        assert spans["inner"][1] < spans["outer"][1]
+        names.update(spans)
+    assert names == {"outer", "inner"}
+
+
+def test_disabled_path_is_one_check_no_allocation_no_recording():
+    rec = trace.recorder
+    assert not rec.enabled, "tests must start with tracing off"
+    before = rec.recorded
+    # no allocation: EVERY disabled span() returns the one shared
+    # no-op singleton, whatever the arguments
+    assert trace.span("a", "b") is trace.span("c", "d", {"k": 1})
+    assert trace.span("a", "b") is trace.NULL_SPAN
+    # callable-count: the disabled span costs exactly three python
+    # calls (span(), NULL_SPAN.__enter__, NULL_SPAN.__exit__) — no
+    # timestamping, no locking, no ring access
+    calls = []
+
+    def prof(frame, event, arg):
+        if event == "call":
+            calls.append(frame.f_code.co_name)
+
+    sys.setprofile(prof)
+    try:
+        with trace.span("cat", "name"):
+            pass
+        trace.instant("cat", "name")
+        trace.counter("cat", "name", 1)
+        trace.complete("cat", "name", 0, 1)
+    finally:
+        sys.setprofile(None)
+    assert calls.count("span") == 1
+    assert len([c for c in calls
+                if c in ("span", "__enter__", "__exit__", "instant",
+                         "counter", "complete")]) == 6
+    assert len(calls) <= 8, calls     # nothing else ran underneath
+    assert rec.recorded == before     # and nothing was recorded
+
+
+# -- export / report -------------------------------------------------------
+
+def _record_sample_timeline():
+    with trace.span("segment", "dispatch", {"segment": "fwd+gd"}):
+        time.sleep(0.001)
+    with trace.span("segment", "dispatch", {"segment": "fwd+gd"}):
+        time.sleep(0.001)
+    with trace.span("loader", "serve_minibatch"):
+        pass
+    trace.instant("jobs", "heartbeat", {"gap_ms": 2.0}, role="master")
+    trace.counter("h2d", "h2d_bytes", 4096)
+    trace.complete("serve", "request", time.perf_counter_ns() - 10000,
+                   10000, {"rows": 3}, role="server")
+
+
+def test_chrome_export_is_schema_valid_trace_event_json(live_trace,
+                                                       tmp_path):
+    _record_sample_timeline()
+    path = trace.save(str(tmp_path / "t.json"))
+    with open(path) as fin:
+        payload = json.load(fin)
+    assert payload["displayTimeUnit"] == "ms"
+    events = payload["traceEvents"]
+    assert isinstance(events, list) and events
+    phases = set()
+    pids = set()
+    for ev in events:
+        # the trace-event schema: every record has a phase, a pid and
+        # a tid; named events have names; complete events have ts+dur
+        assert ev["ph"] in ("M", "X", "i", "C")
+        assert isinstance(ev["pid"], int)
+        assert "tid" in ev
+        phases.add(ev["ph"])
+        pids.add(ev["pid"])
+        if ev["ph"] == "M":
+            assert ev["name"] == "process_name"
+            assert ev["args"]["name"]
+            continue
+        assert ev["name"]
+        assert isinstance(ev["ts"], (int, float))
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+        if ev["ph"] == "i":
+            assert ev["s"] == "t"
+        if ev["ph"] == "C":
+            assert "value" in ev["args"]
+    assert phases == {"M", "X", "i", "C"}
+    # one pid per role: trainer + master + server were all recorded
+    roles = {ev["args"]["name"] for ev in events if ev["ph"] == "M"}
+    assert roles == {"trainer", "master", "server"}
+    assert len(pids) == 3
+
+
+def test_report_totals_match_the_exported_file(live_trace, tmp_path):
+    _record_sample_timeline()
+    live_summary = trace.summary()
+    live_report = trace.report_text()
+    path = trace.save(str(tmp_path / "t.json"))
+    file_events = trace.load(path)
+    assert trace.summary(file_events) == live_summary
+    assert trace.report_text(file_events) == live_report
+    # and the numbers are the recorded truth
+    assert live_summary["categories"]["segment"]["spans"] == 2
+    assert live_summary["segment"]["dispatches"] == 2
+    assert live_summary["segment"]["host_gap_ms"] >= 0
+    assert live_summary["counters"]["h2d_bytes"] == 4096
+
+
+def test_load_accepts_bare_array_trace_files(live_trace, tmp_path):
+    """Chrome traces come in two standard shapes: the object form this
+    module writes and a bare JSON array — load() takes both."""
+    _record_sample_timeline()
+    path = trace.save(str(tmp_path / "obj.json"))
+    with open(path) as fin:
+        events = json.load(fin)["traceEvents"]
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps(events))
+    assert trace.load(str(bare)) == trace.load(path)
+
+
+def test_summarizer_cli(live_trace, tmp_path, capsys):
+    import veles_tpu.trace.__main__ as cli
+    _record_sample_timeline()
+    path = trace.save(str(tmp_path / "t.json"))
+    assert cli.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "per-category totals" in out
+    assert "segment" in out and "dispatch" in out
+    assert cli.main([path, "--json"]) == 0
+    digest = json.loads(capsys.readouterr().out)
+    assert digest["categories"]["segment"]["spans"] == 2
+    assert cli.main([str(tmp_path / "missing.json")]) == 2
+
+
+def test_category_busy_is_interval_union_not_a_nested_sum(live_trace):
+    """Nested same-category spans (a serve request enclosing its
+    batched device call) must count ONCE in the category's busy_ms —
+    summing would report >100% utilization."""
+    with trace.span("serve", "request"):
+        with trace.span("serve", "batch_infer"):
+            time.sleep(0.003)
+    digest = trace.summary()
+    by_name = {item["name"]: item["total_ms"]
+               for item in digest["top_spans"]}
+    busy = digest["categories"]["serve"]["busy_ms"]
+    # union == the outer span alone, NOT outer + inner
+    assert busy < by_name["request"] + by_name["batch_infer"]
+    assert abs(busy - by_name["request"]) < 0.5
+
+
+def test_metrics_text_lines(live_trace):
+    _record_sample_timeline()
+    text = trace.metrics_text()
+    assert "veles_trace_recorded_total %d" % trace.recorder.recorded \
+        in text
+    assert 'veles_trace_events_total{cat="segment"} 2' in text
+    # the events_total family is labeled-only (no unlabeled sample
+    # that would double sum() under aggregation) and contiguous
+    samples = [l for l in text.splitlines()
+               if l.startswith("veles_trace_events_total")]
+    assert samples and all("{cat=" in l for l in samples)
+
+
+# -- the knob --------------------------------------------------------------
+
+def test_configure_knob_off_on_path(tmp_path):
+    rec = trace.recorder
+    saved = (rec.enabled, rec.path, root.common.engine.get("trace"))
+    try:
+        root.common.engine.trace = "off"
+        assert trace.configure() is False and rec.path is None
+        root.common.engine.trace = "on"
+        assert trace.configure() is True and rec.path is None
+        target = str(tmp_path / "run.json")
+        root.common.engine.trace = target
+        assert trace.configure() is True
+        assert rec.path == target
+    finally:
+        rec.enabled, rec.path = saved[0], saved[1]
+        root.common.engine.trace = saved[2]
+
+
+def test_workflow_initialize_honors_trace_knob():
+    from veles_tpu.workflow import Workflow
+    rec = trace.recorder
+    saved = (rec.enabled, rec.path, root.common.engine.get("trace"))
+    try:
+        root.common.engine.trace = "on"
+        Workflow(None).initialize()
+        assert trace.enabled()
+        root.common.engine.trace = "off"
+        Workflow(None).initialize()
+        assert not trace.enabled()
+    finally:
+        rec.enabled, rec.path = saved[0], saved[1]
+        root.common.engine.trace = saved[2]
+
+
+def test_device_trace_is_noop_on_cpu():
+    with trace.device_trace() as running:
+        assert not running      # CPU backend: the bridge stays off
+
+
+# -- the CI canary: five categories over a real stitched run ---------------
+
+def _build_stitched_workflow(minibatch_size=32):
+    from veles_tpu import prng
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class BlobLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(42)
+            n = 200
+            labels = numpy.tile(numpy.arange(10), n // 10)
+            centers = rng.standard_normal((10, 16)) * 3.0
+            self.original_data.mem = (
+                centers[labels]
+                + rng.standard_normal((n, 16)) * 0.7
+            ).astype(numpy.float32)
+            self.original_labels = [int(x) for x in labels]
+            self.class_lengths[:] = [0, 50, 150]
+
+    prng.seed_all(5)
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: BlobLoader(
+            w, minibatch_size=minibatch_size),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+                {"type": "softmax", "->": {"output_sample_shape": 10},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}}],
+        decision_config={"max_epochs": 2, "fail_iterations": 10 ** 6})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=CPUDevice())
+    return wf
+
+
+class _ScriptedMaster(object):
+    def __init__(self, n_jobs=3):
+        self.n_jobs = n_jobs
+        self.served = 0
+        self.updates = []
+
+    def checksum(self):
+        return "traced-v1"
+
+    def generate_data_for_slave(self, slave):
+        if self.served >= self.n_jobs:
+            return None
+        self.served += 1
+        return {"job_number": self.served}
+
+    def apply_data_from_slave(self, data, slave):
+        self.updates.append(data)
+
+    def drop_slave(self, slave):
+        pass
+
+
+class _ScriptedSlave(object):
+    def checksum(self):
+        return "traced-v1"
+
+    def do_job(self, data, callback):
+        callback({"result": data["job_number"]})
+
+
+@pytest.mark.traced
+def test_all_five_instrumented_categories_emit(tmp_path):
+    """The instrumentation canary (and the acceptance run): one traced
+    session covering the stitched trainer, the serving engine and the
+    master–slave job layer must emit events in EVERY category —
+    segment (stitched dispatches), loader (minibatch serving), h2d
+    (transfer counters), serve (request lifecycle) and jobs (job
+    lifecycle) — and the exported JSON must be a Perfetto-loadable
+    trace-event file whose report matches the live one.  A refactor
+    that detaches any hook fails here, not in production."""
+    from veles_tpu.parallel.jobs import JobClient, JobServer
+    from veles_tpu.serve.batcher import DynamicBatcher
+    from veles_tpu.serve.engine import InferenceEngine
+
+    assert trace.enabled(), "the traced marker must arm the recorder"
+
+    # trainer: stitched eager run → segment + loader + h2d
+    wf = _build_stitched_workflow()
+    assert trace.enabled(), \
+        "initialize() re-read the knob and must keep recording on"
+    wf.run()
+    assert wf.stitch_report()["dispatches"] > 0
+
+    # serving: engine + dynamic batcher → serve
+    engine = InferenceEngine.from_forwards(
+        wf.forwards, sample_shape=(16,), max_batch_size=8).warmup()
+    batcher = DynamicBatcher(engine, max_wait_ms=1.0)
+    try:
+        out = batcher.infer(numpy.zeros((3, 16), numpy.float32))
+        assert out.shape == (3, 10)
+    finally:
+        batcher.stop()
+
+    # job layer: scripted master–slave session over real ZMQ → jobs
+    master = _ScriptedMaster(n_jobs=3)
+    server = JobServer(master).start()
+    try:
+        client = JobClient(_ScriptedSlave(), server.endpoint)
+        client.handshake()
+        assert client.run()
+        client.close()
+    finally:
+        server.stop()
+    assert len(master.updates) == 3
+
+    counts = trace.recorder.category_counts()
+    for category in ("segment", "loader", "h2d", "serve", "jobs"):
+        assert counts.get(category, 0) > 0, \
+            "category %r emitted nothing: %r" % (category, counts)
+
+    # the export is Perfetto-loadable and agrees with the live report
+    live_summary = trace.summary()
+    path = trace.save(str(tmp_path / "session.json"))
+    file_events = trace.load(path)
+    assert trace.summary(file_events) == live_summary
+    span_cats = {ev["cat"] for ev in file_events if ev["ph"] == "X"}
+    assert {"segment", "loader", "serve", "jobs"} <= span_cats
+    counter_cats = {ev["cat"] for ev in file_events
+                    if ev["ph"] == "C"}
+    assert "h2d" in counter_cats
+    # per-role pids separated trainer, server, master and the slave
+    with open(path) as fin:
+        raw = json.load(fin)["traceEvents"]
+    roles = {ev["args"]["name"] for ev in raw if ev["ph"] == "M"}
+    assert {"trainer", "server", "master"} <= roles
+    assert any(role.startswith("slave-") for role in roles)
+    # the text report names every category
+    report = wf.trace_report()
+    for category in ("segment", "loader", "h2d", "serve", "jobs"):
+        assert category in report
+
+
+@pytest.mark.traced
+def test_traced_run_reports_d2h_accounting():
+    """The symmetric D2H satellite: a stitched run that fetches its
+    deferred metrics pays accounted device→host traffic, visible both
+    in Watcher.d2h_bytes and as the d2h_bytes counter track."""
+    from veles_tpu.memory import Watcher
+
+    before_bytes = Watcher.d2h_bytes
+    before_events = trace.recorder.count("h2d", "d2h_bytes")
+    wf = _build_stitched_workflow()
+    wf.run()
+    assert Watcher.d2h_bytes > before_bytes
+    assert trace.recorder.count("h2d", "d2h_bytes") > before_events

@@ -14,9 +14,11 @@ Three static passes, zero device work:
    ``jax.eval_shape`` only: shape/dtype mismatches, weak-type
    promotion, non-power-of-two batch sizes that miss the serve
    engine's AOT buckets, host-device transfer hazards in ``run()``
-   bodies, and per-step host input pipelines (a FullBatch loader
+   bodies, per-step host input pipelines (a FullBatch loader
    filling host-side where the device-resident fast path applies —
-   V-J07).
+   V-J07), and blocking host syncs on the train hot loop outside the
+   deferred-metrics protocol (``jax.device_get`` /
+   ``.block_until_ready()`` / ``float(<jnp expr>)`` — V-J08).
 3. **Lint pack** (:mod:`~veles_tpu.analyze.lint`) — AST rules over
    ``veles_tpu/`` source itself (blocking IO in ``run()``, private
    state access, gate/link API misuse); the tier-1 suite keeps the

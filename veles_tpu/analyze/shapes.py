@@ -61,6 +61,13 @@ RULES = {
               "calls device_put outside the prefetch ring — per-step "
               "H2D transfers the stitched in-program gather (or the "
               "staging ring) would eliminate"),
+    "V-J08": ("warning",
+              "blocking host sync on the train hot loop: "
+              "jax.device_get / .block_until_ready() / .item() / "
+              "float()/int() of a jnp expression inside "
+              "run()/tpu_run(), outside the deferred-metrics "
+              "protocol — every minibatch stalls on a device "
+              "round-trip the async dispatch queue was hiding"),
 }
 
 #: dotted call names that force a device→host sync
@@ -74,6 +81,31 @@ _SYNC_METHODS = {"block_until_ready", "item"}
 #: (V-J06; map_write implies map_read, map_invalidate implies a later
 #: re-upload of host bytes)
 _MAP_READ_METHODS = {"map_read", "map_write"}
+
+#: unconditionally-blocking syncs: on the HOT loop these escalate from
+#: the generic V-J05 transfer-hazard to V-J08 (the per-step stall the
+#: deferred-metrics protocol exists to avoid); numpy.asarray and
+#: friends stay V-J05 — they may be copying a host array
+_BLOCKING_SYNC_CALLS = {"jax.device_get"}
+_BLOCKING_SYNC_METHODS = {"block_until_ready", "item"}
+
+
+def _is_jnp_expr(node, index):
+    """Heuristic "this expression holds a device value": it reads a
+    Vector's ``.devmem`` or calls into ``jax.numpy`` (alias-resolved,
+    so ``import jax.numpy as jnp`` matches).  Host math — shapes,
+    python ints, linked scalars — stays out, keeping the evaluators'
+    legitimate ``float(self.err_output.shape[0])`` quiet."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "devmem":
+            return True
+        if isinstance(sub, ast.Call):
+            name = (index.resolve_call(sub.func) if index else None) \
+                or _call_name(sub.func)
+            if name and (name.startswith("jax.numpy.")
+                         or name.startswith("jnp.")):
+                return True
+    return False
 
 
 def _is_device_put(name):
@@ -140,10 +172,14 @@ def _module_index(path):
 
 def scan_transfer_hazards(unit, hot_loop=False):
     """AST-scan ``run``/``tpu_run`` of ``unit``'s class for forced
-    host syncs; returns Findings (V-J05, and V-J06 ``map_read``/
-    ``map_write`` coherence round-trips when ``hot_loop`` marks the
-    unit as part of the per-minibatch train chain).  ``numpy_run`` —
-    the declared interpret/debug path — is deliberately not scanned."""
+    host syncs; returns Findings (V-J05, and — when ``hot_loop`` marks
+    the unit as part of the per-minibatch train chain — V-J06
+    ``map_read``/``map_write`` coherence round-trips, V-J07 explicit
+    H2D uploads, and V-J08 unconditionally-blocking syncs:
+    ``jax.device_get``, ``.block_until_ready()``, ``.item()`` and
+    ``float()``/``int()`` casts of jnp expressions outside the
+    deferred-metrics protocol).  ``numpy_run`` — the declared
+    interpret/debug path — is deliberately not scanned."""
     findings = []
     cls = type(unit)
     for meth_name in ("run", "tpu_run"):
@@ -205,6 +241,38 @@ def scan_transfer_hazards(unit, hot_loop=False):
                         "=device in-program gather) or move the upload "
                         "into the loader prefetch ring "
                         "(fill_minibatch_into + StagingRing)"))
+                continue
+            blocking = name and (
+                name in _BLOCKING_SYNC_CALLS
+                or name.rsplit(".", 1)[-1] in _BLOCKING_SYNC_METHODS)
+            if not blocking and isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int") \
+                    and node.args \
+                    and _is_jnp_expr(node.args[0], index):
+                name = node.func.id
+                blocking = True
+            if hot_loop and blocking:
+                # escalate from the generic transfer-hazard V-J05: on
+                # the per-minibatch chain these calls stall the async
+                # dispatch queue EVERY step — the exact wait the
+                # deferred-metrics protocol (async device scalars +
+                # one batched device_get_all at the class boundary)
+                # exists to amortize
+                findings.append(Finding(
+                    *_rule("V-J08"),
+                    message="%s.%s calls %s per minibatch on the "
+                            "train hot loop — a blocking host sync "
+                            "outside the deferred-metrics protocol "
+                            "stalls async dispatch every step"
+                            % (cls.__name__, meth_name,
+                               name.lstrip(".") + "()"),
+                    unit=unit.name,
+                    location="%s:%d" % (path, line) if path else None,
+                    fix="keep metrics as async device scalars and "
+                        "fetch them once per epoch/class boundary in "
+                        "ONE batched memory.device_get_all (see "
+                        "znicz/decision.py); never float()/item() a "
+                        "jnp value mid-loop"))
                 continue
             if not _is_sync_call(name):
                 continue

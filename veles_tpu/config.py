@@ -167,6 +167,14 @@ root.common.update({
         # K > 0 additionally flushes every K minibatches (bounds the
         # async dispatch queue on very long epochs).
         "metrics_every": 0,
+        # Unified tracing (veles_tpu.trace): "off" (default — every
+        # hook is a single attribute check), "on" (record spans into
+        # the in-memory ring), or a *.json path (record AND write a
+        # Perfetto-loadable Chrome trace-event file at process exit).
+        # Read fresh at Workflow.initialize() via trace.configure().
+        "trace": "off",
+        # Trace ring capacity in events; wraparound keeps the newest.
+        "trace_capacity": 65536,
         "interpret": False,         # run Pallas kernels in interpret mode
     },
     "thread_pool": {"max_workers": 8},

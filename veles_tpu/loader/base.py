@@ -35,7 +35,7 @@ import collections
 
 import numpy
 
-from veles_tpu import prng
+from veles_tpu import prng, trace
 from veles_tpu.memory import Vector
 from veles_tpu.mutable import Bool
 from veles_tpu.normalization import normalizer_factory
@@ -319,38 +319,41 @@ class Loader(Unit):
         first — and fill data (ref ``:726-752``).  ``fill=False`` is the
         loader-headed stitched dispatch: serving state advances but no
         host buffer is touched — the segment gathers in-program."""
-        retried = False
-        try:
-            minibatch_def = self.failed_minibatches.pop()
-            retried = True
-        except IndexError:
-            minibatch_def = self._advance_global_offset()
-        minibatch_offset, minibatch_size = minibatch_def
-        self.pending_minibatches_[consumer_id].append(minibatch_def)
-        self.minibatch_offset, self.minibatch_size = minibatch_def
-        if retried:
-            # a requeued batch keeps ITS class, not whatever class the
-            # already-advanced global_offset is in; epoch flags were
-            # signaled when the batch was first advanced
-            self.minibatch_class, _ = self.class_index_by_sample_index(
-                minibatch_offset - minibatch_size)
-            self.last_minibatch <<= False
-            self.epoch_ended <<= False
-        else:
-            self._update_flags()
+        with trace.span("loader", "serve_minibatch"):
+            retried = False
+            try:
+                minibatch_def = self.failed_minibatches.pop()
+                retried = True
+            except IndexError:
+                minibatch_def = self._advance_global_offset()
+            minibatch_offset, minibatch_size = minibatch_def
+            self.pending_minibatches_[consumer_id].append(minibatch_def)
+            self.minibatch_offset, self.minibatch_size = minibatch_def
+            if retried:
+                # a requeued batch keeps ITS class, not whatever class
+                # the already-advanced global_offset is in; epoch flags
+                # were signaled when the batch was first advanced
+                self.minibatch_class, _ = \
+                    self.class_index_by_sample_index(
+                        minibatch_offset - minibatch_size)
+                self.last_minibatch <<= False
+                self.epoch_ended <<= False
+            else:
+                self._update_flags()
 
-        self.fill_indices(minibatch_offset - minibatch_size,
-                          minibatch_size)
-        if self.is_master or not fill:
-            return
-        if self._consume_prefetched(minibatch_def):
-            return      # fully prepared (normalized/mapped/padded)
-        with self._fill_lock_:
-            self.fill_minibatch()
-        self.normalize_minibatch()
-        self.map_minibatch_labels()
-        if minibatch_size < self.max_minibatch_size:
-            self.pad_minibatch(minibatch_size)
+            self.fill_indices(minibatch_offset - minibatch_size,
+                              minibatch_size)
+            if self.is_master or not fill:
+                return
+            if self._consume_prefetched(minibatch_def):
+                return      # fully prepared (normalized/mapped/padded)
+            with trace.span("loader", "sync_fill"):
+                with self._fill_lock_:
+                    self.fill_minibatch()
+                self.normalize_minibatch()
+                self.map_minibatch_labels()
+                if minibatch_size < self.max_minibatch_size:
+                    self.pad_minibatch(minibatch_size)
 
     def pad_minibatch(self, minibatch_size):
         """Zero/-1-fill the tail of a short final batch (indices are
@@ -508,14 +511,15 @@ class Loader(Unit):
             # file handles AND ring-slot access — a dropped worker
             # still prepping a recycled slot must never overlap a
             # newer worker's fill of the same buffer
-            with self._fill_lock_:
-                self.fill_minibatch_into(indices, data_out[:size],
-                                         raw_labels)
-                self._prepare_staged(data_out, labels_out, raw_labels,
-                                     size)
-                dev_data = StagingRing.upload(device, data_out)
-                dev_labels = StagingRing.upload(device, labels_out) \
-                    if self.has_labels else None
+            with trace.span("loader", "prefetch_fill"):
+                with self._fill_lock_:
+                    self.fill_minibatch_into(indices, data_out[:size],
+                                             raw_labels)
+                    self._prepare_staged(data_out, labels_out,
+                                         raw_labels, size)
+                    dev_data = StagingRing.upload(device, data_out)
+                    dev_labels = StagingRing.upload(device, labels_out) \
+                        if self.has_labels else None
             return data_out, labels_out, raw_labels, dev_data, dev_labels
 
         from veles_tpu import thread_pool
@@ -586,10 +590,11 @@ class Loader(Unit):
         # consumers, the already-uploaded device copy for the jitted
         # chain — and the PREVIOUS device minibatch is released for
         # allocator reuse (Vector.publish)
-        self.minibatch_data.publish(data, dev_data)
-        self.raw_minibatch_labels[:] = raw_labels
-        if self.has_labels:
-            self.minibatch_labels.publish(labels, dev_labels)
+        with trace.span("loader", "publish"):
+            self.minibatch_data.publish(data, dev_data)
+            self.raw_minibatch_labels[:] = raw_labels
+            if self.has_labels:
+                self.minibatch_labels.publish(labels, dev_labels)
         return True
 
     def _on_successful_serve(self):

@@ -21,6 +21,7 @@ import threading
 
 import numpy
 
+from veles_tpu import trace
 from veles_tpu.distributable import Pickleable
 
 
@@ -28,18 +29,25 @@ class Watcher(object):
     """Device-memory accounting (ref ``memory.py:56-107``).
 
     Besides the reference's peak-allocation bookkeeping, the Watcher
-    counts **host→device transfer traffic** (``h2d_bytes`` /
-    ``h2d_transfers``): every Vector upload and every staging-ring
-    upload reports here, so the bench ladder can record
-    ``h2d_bytes_per_step`` and the input-pipeline work (device-resident
-    gather, prefetch ring) shows up as eliminated transfer bytes, not
-    just img/s."""
+    counts **transfer traffic in both directions**: every Vector
+    upload and staging-ring upload reports ``h2d_bytes`` /
+    ``h2d_transfers``, and every device→host fetch (``map_read``
+    coherence syncs, the deferred-metrics ``device_get_all`` batch)
+    reports ``d2h_bytes`` / ``d2h_transfers`` — so the bench ladder
+    records ``h2d_bytes_per_step`` AND ``d2h_bytes_per_step`` and the
+    input-pipeline / deferred-metrics work shows up as eliminated
+    transfer bytes, not just img/s.  Each accounting call also samples
+    a ``veles_tpu.trace`` counter track ("h2d" category) when tracing
+    is on, so Perfetto shows the cumulative byte curves on the
+    timeline."""
 
     lock = threading.Lock()
     bytes_in_use = 0
     peak_bytes = 0
     h2d_bytes = 0
     h2d_transfers = 0
+    d2h_bytes = 0
+    d2h_transfers = 0
 
     @classmethod
     def track(cls, nbytes):
@@ -57,6 +65,16 @@ class Watcher(object):
         with cls.lock:
             cls.h2d_bytes += int(nbytes)
             cls.h2d_transfers += 1
+            total = cls.h2d_bytes
+        trace.counter("h2d", "h2d_bytes", total)
+
+    @classmethod
+    def track_d2h(cls, nbytes):
+        with cls.lock:
+            cls.d2h_bytes += int(nbytes)
+            cls.d2h_transfers += 1
+            total = cls.d2h_bytes
+        trace.counter("h2d", "d2h_bytes", total)
 
     @classmethod
     def reset(cls):
@@ -65,6 +83,8 @@ class Watcher(object):
             cls.peak_bytes = 0
             cls.h2d_bytes = 0
             cls.h2d_transfers = 0
+            cls.d2h_bytes = 0
+            cls.d2h_transfers = 0
 
 
 class Vector(Pickleable):
@@ -183,6 +203,7 @@ class Vector(Pickleable):
         if not self._host_fresh_ and self._devmem_ is not None:
             self._mem = numpy.asarray(self._devmem_)
             self._host_fresh_ = True   # copies agree; device stays fresh
+            Watcher.track_d2h(self._mem.nbytes)
         return self
 
     def map_write(self):
@@ -287,10 +308,13 @@ class StagingRing(object):
         self._lock = threading.Lock()
 
     def acquire(self):
-        """Next reusable staging buffer (round-robin)."""
-        with self._lock:
-            slot = self._slots[self._pos]
-            self._pos = (self._pos + 1) % self.depth
+        """Next reusable staging buffer (round-robin).  The span
+        covers the slot-lock wait — contention here means the ring is
+        too shallow for the fills in flight."""
+        with trace.span("loader", "ring_acquire"):
+            with self._lock:
+                slot = self._slots[self._pos]
+                self._pos = (self._pos + 1) % self.depth
         return slot
 
     @staticmethod
@@ -302,7 +326,8 @@ class StagingRing(object):
         ``h2d_bytes_per_step`` bench records see staged uploads too."""
         if device is None or getattr(device, "is_interpret", True):
             return None
-        out = device.put(array)
+        with trace.span("loader", "staging_upload"):
+            out = device.put(array)
         Watcher.track_h2d(array.nbytes)
         return out
 
@@ -323,6 +348,8 @@ def device_get_all(values):
     if device_idx:
         import jax
         fetched = jax.device_get([values[i] for i in device_idx])
+        Watcher.track_d2h(sum(getattr(v, "nbytes", 0)
+                              for v in fetched))
         for i, val in zip(device_idx, fetched):
             out[i] = val
     return out

@@ -28,7 +28,9 @@ import threading
 import time
 import uuid
 
+from veles_tpu import trace
 from veles_tpu.logger import Logger
+from veles_tpu.metrics import LatencyHistogram
 
 HEARTBEAT_INTERVAL = 2.0
 SLAVE_TIMEOUT = 10.0
@@ -48,6 +50,20 @@ class SlaveDescription(object):
         #: two can be in flight; `finished` and drop-requeue key off this
         #: count, not the single state field (ADVICE r1)
         self.in_flight = 0
+        #: job round-trip latency (send → update), the SAME histogram
+        #: the serving layer uses (veles_tpu.metrics) so the two
+        #: percentile columns are comparable; jobs are answered in
+        #: order per DEALER identity, so FIFO send-stamp matching is
+        #: exact even with two in flight
+        self.latency = LatencyHistogram()
+        self._sent_at = collections.deque()
+
+    def job_sent(self):
+        self._sent_at.append(time.time())
+
+    def job_updated(self):
+        if self._sent_at:
+            self.latency.record(time.time() - self._sent_at.popleft())
 
     def __repr__(self):
         return "<Slave %s %s power=%.1f jobs=%d inflight=%d>" % (
@@ -198,7 +214,18 @@ class JobServer(Logger):
         sid = msg.get("id")
         slave = self.slaves.get(sid)
         if slave is not None:
-            slave.last_seen = time.time()
+            now = time.time()
+            if op == "ping" and trace.enabled():
+                # heartbeat gap: how stale last_seen got before this
+                # ping — creeping gaps flag a slave wedged in compute
+                # (or a master loop stalled in job generation)
+                trace.instant(
+                    "jobs", "heartbeat",
+                    {"slave": sid,
+                     "gap_ms": round((now - slave.last_seen) * 1e3,
+                                     1)},
+                    role="master")
+            slave.last_seen = now
         if op == "handshake":
             self._on_handshake(identity, msg)
         elif slave is None or sid in self.blacklist:
@@ -269,7 +296,11 @@ class JobServer(Logger):
                     self._send(identity, {"op": "no_more_jobs"})
                     return
                 try:
-                    data = self.workflow.generate_data_for_slave(slave)
+                    with trace.span("jobs", "generate",
+                                    {"slave": slave.id},
+                                    role="master"):
+                        data = self.workflow.generate_data_for_slave(
+                            slave)
                 except NoJobYet:
                     # more jobs will appear (e.g. GA generation
                     # boundary): the slave should retry, not quit
@@ -285,6 +316,7 @@ class JobServer(Logger):
                 self._send(identity, {"op": "no_more_jobs"})
                 self._maybe_finish()
                 return
+            slave.job_sent()
             self._send(identity, {"op": "job", "data": data})
         except Exception:
             self.exception("job generation for %s failed", slave.id)
@@ -292,7 +324,10 @@ class JobServer(Logger):
     def _on_update(self, identity, slave, msg):
         with self._lock:
             try:
-                self.workflow.apply_data_from_slave(msg["data"], slave)
+                with trace.span("jobs", "apply_update",
+                                {"slave": slave.id}, role="master"):
+                    self.workflow.apply_data_from_slave(msg["data"],
+                                                        slave)
                 ok = 1
             except Exception:
                 self.exception("bad update from %s", slave.id)
@@ -300,6 +335,7 @@ class JobServer(Logger):
             slave.in_flight = max(0, slave.in_flight - 1)
             slave.state = "WORKING" if slave.in_flight else "WAIT"
         slave.jobs_done += 1
+        slave.job_updated()
         self._send(identity, {"op": "update_ack", "ok": ok})
         self._maybe_finish()
 
@@ -332,8 +368,22 @@ class JobServer(Logger):
             cb()
 
     def print_stats(self):
+        """Per-slave job table, now with round-trip latency
+        percentiles (send→update, the whole pipeline: generation
+        handoff + wire + slave compute + master apply) from the shared
+        :class:`veles_tpu.metrics.LatencyHistogram` — the same buckets
+        the serving layer reports, so the two columns compare."""
         for slave in self.slaves.values():
             self.info("  %r", slave)
+            hist = slave.latency
+            if hist.count:
+                self.info(
+                    "    job latency: n=%d mean=%.1f ms p50=%.1f ms "
+                    "p95=%.1f ms p99=%.1f ms",
+                    hist.count, hist.mean * 1e3,
+                    hist.percentile(50) * 1e3,
+                    hist.percentile(95) * 1e3,
+                    hist.percentile(99) * 1e3)
 
 
 def _default_power():
@@ -383,6 +433,11 @@ class JobClient(Logger):
         #: job loop share it under this lock
         self._socket_lock = threading.Lock()
         self.jobs_done = 0
+
+    @property
+    def trace_role(self):
+        """The per-slave pid label in exported traces."""
+        return "slave-%s" % self.sid
 
     def _rpc(self, msg, timeout_ms=5000):
         import zmq
@@ -490,8 +545,13 @@ class JobClient(Logger):
         import random as _random
         next_reply = None   # prefetched reply not yet processed
         while max_jobs is None or self.jobs_done < max_jobs:
-            reply = next_reply if next_reply is not None else \
-                self._rpc({"op": "job_request", "id": self.sid})
+            if next_reply is not None:
+                reply = next_reply
+            else:
+                with trace.span("jobs", "job_request",
+                                role=self.trace_role):
+                    reply = self._rpc({"op": "job_request",
+                                       "id": self.sid})
             next_reply = None
             if reply["op"] == "no_more_jobs":
                 break
@@ -522,9 +582,12 @@ class JobClient(Logger):
 
                     def compute():
                         try:
-                            self.workflow.do_job(
-                                reply["data"],
-                                lambda out: result.__setitem__(0, out))
+                            with trace.span("jobs", "do_job",
+                                            role=self.trace_role):
+                                self.workflow.do_job(
+                                    reply["data"],
+                                    lambda out: result.__setitem__(
+                                        0, out))
                         except BaseException as e:
                             error.append(e)
 
@@ -551,14 +614,17 @@ class JobClient(Logger):
                     if error:
                         raise error[0]
                 else:
-                    self.workflow.do_job(
-                        reply["data"],
-                        lambda out: result.__setitem__(0, out))
+                    with trace.span("jobs", "do_job",
+                                    role=self.trace_role):
+                        self.workflow.do_job(
+                            reply["data"],
+                            lambda out: result.__setitem__(0, out))
             finally:
                 stop_hb.set()
                 hb.join(self.heartbeat_interval + 3)
-            ack = self._rpc({"op": "update", "id": self.sid,
-                             "data": result[0]})
+            with trace.span("jobs", "update", role=self.trace_role):
+                ack = self._rpc({"op": "update", "id": self.sid,
+                                 "data": result[0]})
             if not ack.get("ok"):
                 self.warning("master refused our update")
             self.jobs_done += 1

@@ -32,6 +32,7 @@ from concurrent.futures import Future
 
 import numpy
 
+from veles_tpu import trace
 from veles_tpu.logger import Logger
 
 
@@ -108,6 +109,9 @@ class DynamicBatcher(Logger):
                 "request of %d rows exceeds the queue bound %d — "
                 "split the request or raise max_queue_rows"
                 % (len(rows), self.max_queue_rows))
+        if trace.enabled():
+            trace.instant("serve", "enqueue", {"rows": len(rows)},
+                          role="server")
         pending = _Pending(rows)
         with self._cond:
             if self._stopped:
@@ -184,7 +188,8 @@ class DynamicBatcher(Logger):
                     batch = taken[0].rows
                 else:
                     batch = numpy.concatenate([p.rows for p in taken])
-                out = engine.infer(batch)
+                with trace.span("serve", "batch_infer", role="server"):
+                    out = engine.infer(batch)
             except Exception as exc:  # noqa: BLE001 - fan the error out
                 self.warning("batched inference failed: %s", exc)
                 for pending in taken:
@@ -205,6 +210,7 @@ class DynamicBatcher(Logger):
                     else self.max_batch_size
                 self.metrics.record_batch(len(batch), capacity,
                                           done - tic)
+            traced = trace.enabled()
             offset = 0
             for pending in taken:
                 n = len(pending.rows)
@@ -213,6 +219,14 @@ class DynamicBatcher(Logger):
                 if self.metrics is not None:
                     self.metrics.observe_request(done - pending.enqueued,
                                                  rows=n)
+                if traced:
+                    # retroactive enqueue→reply span (same clock:
+                    # _Pending stamps time.perf_counter at submit)
+                    trace.complete(
+                        "serve", "request",
+                        int(pending.enqueued * 1e9),
+                        int((done - pending.enqueued) * 1e9),
+                        {"rows": n}, role="server")
 
     def stop(self, drain=True):
         """Stop the worker.  ``drain=True`` serves what is queued

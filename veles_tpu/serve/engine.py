@@ -33,6 +33,7 @@ import threading
 
 import numpy
 
+from veles_tpu import trace
 from veles_tpu.logger import Logger
 
 
@@ -271,7 +272,9 @@ class InferenceEngine(Logger):
             params_spec = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 self._params)
-            exe = self._jit.lower(params_spec, spec).compile()
+            with trace.span("serve", "compile_bucket",
+                            {"bucket": batch_size}, role="server"):
+                exe = self._jit.lower(params_spec, spec).compile()
             self.compile_count += 1
             self.debug("compiled bucket %d (compile #%d)", batch_size,
                        self.compile_count)
@@ -363,5 +366,6 @@ class InferenceEngine(Logger):
             chunk = padded
         exe = self._executable(bucket)
         self.infer_calls += 1
-        out = numpy.asarray(exe(self._params, chunk))
+        with trace.span("serve", "infer_chunk", role="server"):
+            out = numpy.asarray(exe(self._params, chunk))
         return out[:n]

@@ -171,8 +171,13 @@ class ServingServer(Logger):
                 if self.path == "/healthz":
                     self._reply_json(*server.healthz())
                 elif self.path == "/metrics":
-                    self._reply(200,
-                                server.metrics.render_text().encode(),
+                    body = server.metrics.render_text()
+                    from veles_tpu import trace
+                    if trace.enabled():
+                        # the trace's compact per-category counters
+                        # ride the same exposition page
+                        body += trace.metrics_text()
+                    self._reply(200, body.encode(),
                                 "text/plain; version=0.0.4")
                 else:
                     self._reply_json(404, {"error": "no route %r"
@@ -207,11 +212,15 @@ class ServingServer(Logger):
         """POST the metrics snapshot + model table to a running
         :class:`veles_tpu.web_status.WebStatus` ``/update`` endpoint,
         so the one status page shows training AND serving."""
+        from veles_tpu import trace
         from veles_tpu.web_status import post_json
-        return post_json(url, {
+        payload = {
             "id": run_id,
             "workflow": "ServingServer",
             "stopped": self._httpd is None,
             "results": {"serving": self.metrics.snapshot(),
                         "models": self.registry.describe()},
-        }, logger=self)
+        }
+        if trace.enabled():
+            payload["results"]["trace"] = trace.summary()
+        return post_json(url, payload, logger=self)

@@ -49,6 +49,7 @@ execution path byte for byte (segments are simply not built).
 
 import jax
 
+from veles_tpu import trace
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
 from veles_tpu.memory import Vector
@@ -113,6 +114,9 @@ class StitchSegment(Logger):
         self._member_ids = frozenset(id(u) for u in self.units[1:])
         self._build_plan()
         self._jitted = jax.jit(self._program, donate_argnums=(2,))
+        #: static span args, allocated once (the dispatch hot path
+        #: must not build a dict per call)
+        self._trace_args = {"segment": "+".join(self.names)}
 
     @property
     def names(self):
@@ -221,34 +225,50 @@ class StitchSegment(Logger):
     # -- execution ----------------------------------------------------------
     def execute(self):
         """Dispatch the whole segment as one program and publish."""
-        # host preludes first (a loader head advances its serving state
-        # here — the scalars fetched below must see the NEW offsets)
-        for stage in self.stages:
-            if stage.prelude is not None:
-                stage.prelude()
-        inputs = tuple(vec.devmem for vec in self._input_vecs)
-        ro = tuple(vec.devmem for vec in self._ro_vecs)
-        don = tuple(vec.devmem for vec in self._don_vecs)
-        scalars = []
-        for stage, names in self._scalar_fetchers:
-            values = stage.scalars()
-            # ints stay ints: a python int traces as (weak) int32, so
-            # index-like scalars (the loader's offset/size) keep exact
-            # integer semantics — float32 would silently round offsets
-            # beyond 2**24.  Per-name types are stable across calls,
-            # so this never retraces.
-            scalars.extend(values[n] if isinstance(values[n], int)
-                           else float(values[n]) for n in names)
-        outputs, new_don, metrics = self._jitted(
-            inputs, ro, don, tuple(scalars))
-        for vec, arr in zip(self._output_vecs, outputs):
-            vec.devmem = arr
-        for vec, arr in zip(self._don_vecs, new_don):
-            vec.devmem = arr
-        for (unit, name), value in zip(self._metric_spec, metrics):
-            setattr(unit, name, value)
-        self.dispatches += 1
-        self._computed = set(self._member_ids)
+        if self.dispatches == 0:
+            # the first dispatch pays the XLA trace+compile of the
+            # fused program; the instant marks it on the timeline so a
+            # report never mistakes warmup for steady state
+            trace.instant("segment", "compile", self._trace_args)
+        with trace.span("segment", "dispatch", self._trace_args):
+            # the nested host_prep span breaks out the host share of a
+            # turnaround (preludes + devmem gathering + scalar
+            # fetches) from the jitted call, so a prelude-heavy run is
+            # visible in the span leaderboard — the inter-dispatch
+            # "host gap" in trace_report() deliberately measures only
+            # the time BETWEEN turnarounds
+            with trace.span("segment", "host_prep", self._trace_args):
+                # host preludes first (a loader head advances its
+                # serving state here — the scalars fetched below must
+                # see the NEW offsets)
+                for stage in self.stages:
+                    if stage.prelude is not None:
+                        stage.prelude()
+                inputs = tuple(vec.devmem for vec in self._input_vecs)
+                ro = tuple(vec.devmem for vec in self._ro_vecs)
+                don = tuple(vec.devmem for vec in self._don_vecs)
+                scalars = []
+                for stage, names in self._scalar_fetchers:
+                    values = stage.scalars()
+                    # ints stay ints: a python int traces as (weak)
+                    # int32, so index-like scalars (the loader's
+                    # offset/size) keep exact integer semantics —
+                    # float32 would silently round offsets beyond
+                    # 2**24.  Per-name types are stable across calls,
+                    # so this never retraces.
+                    scalars.extend(
+                        values[n] if isinstance(values[n], int)
+                        else float(values[n]) for n in names)
+            outputs, new_don, metrics = self._jitted(
+                inputs, ro, don, tuple(scalars))
+            for vec, arr in zip(self._output_vecs, outputs):
+                vec.devmem = arr
+            for vec, arr in zip(self._don_vecs, new_don):
+                vec.devmem = arr
+            for (unit, name), value in zip(self._metric_spec, metrics):
+                setattr(unit, name, value)
+            self.dispatches += 1
+            self._computed = set(self._member_ids)
 
     def member_run(self, unit):
         """The per-unit hook: the head dispatches the program, members

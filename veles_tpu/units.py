@@ -30,6 +30,7 @@ import sys
 import threading
 import time
 
+from veles_tpu import trace
 from veles_tpu.config import root
 from veles_tpu.distributable import Distributable
 from veles_tpu.mutable import Bool, LinkableAttribute
@@ -373,9 +374,12 @@ class Unit(Distributable, metaclass=UnitRegistry):
         try:
             if segment is not None and wf is not None \
                     and getattr(wf, "stitch_active", False):
+                # the segment's own "segment" span covers the fused
+                # dispatch; member no-ops are not worth events
                 segment.member_run(self)
             else:
-                self.run()
+                with trace.span("unit", self.name):
+                    self.run()
         except Exception:
             self.error("failed to run %r", self)
             if wf is not None:

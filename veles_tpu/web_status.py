@@ -171,6 +171,11 @@ class StatusNotifier(Logger):
                 workflow.get_unit_run_time_stats()[:10]],
             "events": list(self.pending_events),
         }
+        from veles_tpu import trace
+        if trace.enabled():
+            # the compact where-did-the-step-go digest rides along
+            # (per-category totals, top spans, dispatch vs host gap)
+            data["trace"] = trace.summary()
         self.pending_events.clear()
         return data
 

@@ -196,7 +196,12 @@ class Workflow(Unit):
         Only :class:`~veles_tpu.units.MissingDemandedAttributes` requeues —
         each unit at most once per remaining peer — so genuine
         AttributeError bugs in ``initialize()`` bodies surface immediately."""
+        from veles_tpu import trace
         from veles_tpu.units import MissingDemandedAttributes
+        # honor the root.common.engine.trace knob per initialize (the
+        # natural "a run starts here" boundary — off stays a single
+        # attribute check in every hook)
+        trace.configure()
         self.device = device
         pending = collections.deque(self.units_in_dependency_order())
         retries = {}
@@ -226,11 +231,12 @@ class Workflow(Unit):
         :mod:`veles_tpu.stitch`).  Called at the end of
         :meth:`initialize` and again after any graph surgery (e.g. the
         slave-mode back-edge removal)."""
-        from veles_tpu import stitch
-        for segment in self._stitch_segments_:
-            segment.detach()
-        self._stitch_segments_ = stitch.build_segments(self)
-        self._stitch_built_enabled_ = stitch.enabled()
+        from veles_tpu import stitch, trace
+        with trace.span("segment", "rebuild_stitching"):
+            for segment in self._stitch_segments_:
+                segment.detach()
+            self._stitch_segments_ = stitch.build_segments(self)
+            self._stitch_built_enabled_ = stitch.enabled()
         return self._stitch_segments_
 
     @property
@@ -254,6 +260,16 @@ class Workflow(Unit):
             "dispatches": sum(segment.dispatches
                               for segment in self._stitch_segments_),
         }
+
+    def trace_report(self, top=10):
+        """Text summary of the in-memory trace ring (per-category
+        totals, top-K spans by total time, segment dispatch vs
+        host-gap split) — :func:`veles_tpu.trace.report_text` over the
+        process-wide recorder.  Enable recording with
+        ``root.common.engine.trace=on`` (or a ``.json`` path to also
+        get the Perfetto timeline)."""
+        from veles_tpu import trace
+        return trace.report_text(top=top)
 
     # -- execution ----------------------------------------------------------
     def schedule(self, unit, src):
