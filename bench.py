@@ -71,8 +71,11 @@ def _dumps(rec):
     return json.dumps(rec)
 
 def _peak_flops(device_kind):
-    from veles_tpu.backends import peak_bf16_flops
-    return peak_bf16_flops(device_kind)
+    # ONE peak-table resolution for the whole repo: the performance
+    # ledger owns it (prof.peak_flops), bench just forwards — a
+    # dtype-aware or multi-device peak change lands once
+    from veles_tpu import prof
+    return prof.peak_flops(device_kind)
 
 
 def _measure(step_fn, params, x, labels, steps, flops_override=None):
@@ -539,7 +542,7 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
     stage (its per-event cost is a ring write, orders below the step
     time); the ``engine.trace=off`` <1% criterion is about the
     DEFAULT state and is asserted by tests, not this ladder."""
-    from veles_tpu import prng, trace
+    from veles_tpu import prng, prof, trace
     from veles_tpu.backends import AutoDevice
     from veles_tpu.config import root
     from veles_tpu.memory import Watcher
@@ -567,6 +570,8 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
         d2h_before = Watcher.d2h_bytes
         dispatches_before = trace.recorder.count("segment", "dispatch")
         compiles_before = trace.recorder.count("segment", "compile")
+        flops_before = prof.ledger.flops_dispatched
+        recompiles_before = prof.ledger.recompiles
         tic = time.perf_counter()
         wf.run()                           # epochs 3-4, warm
         elapsed = time.perf_counter() - tic
@@ -576,6 +581,17 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
             - dispatches_before
         compiles = trace.recorder.count("segment", "compile") \
             - compiles_before
+        # performance-ledger columns: XLA-cost-analysis FLOPs
+        # dispatched over the timed wall clock vs the device peak
+        # (None where no peak entry exists — CPU fallback), recompile
+        # count (nonzero = the sentinel flagged a steady-state
+        # retrace inside the timed region), and absolute peak HBM
+        flops_delta = prof.ledger.flops_dispatched - flops_before
+        recompiles = prof.ledger.recompiles - recompiles_before
+        peak = _peak_flops(_device_kind())
+        wf_mfu = (round(flops_delta / elapsed / peak, 4)
+                  if peak and flops_delta else None)
+        peak_hbm = Watcher.peak_bytes
     finally:
         root.common.engine.loader = saved_loader
         root.common.engine.trace = saved_trace
@@ -594,6 +610,9 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
                      round(d2h_delta * batch / train_samples, 1))
     extra.setdefault("trace_dispatches", dispatches)
     extra.setdefault("trace_compiles", compiles)
+    extra.setdefault("mfu", wf_mfu)
+    extra.setdefault("peak_hbm_bytes", peak_hbm)
+    extra.setdefault("recompiles", recompiles)
     if loader_mode is not None:
         extra.setdefault("loader", loader_mode)
     _emit(metric, sec_per_step, batch, None, vs=vs, extra=extra)

@@ -20,9 +20,15 @@ if [ "$#" -gt 0 ]; then
 fi
 env JAX_PLATFORMS=cpu python -m veles_tpu.analyze --lint
 # mnist_conv + cifar10 exercise the loader-headed stitch stage (the
-# device-resident input pipeline, V-J07) on conv-shaped workflows
+# device-resident input pipeline, V-J07) on conv-shaped workflows;
+# the analyzer runs with the full rule set, V-J08/V-J09 included
 for sample in veles_tpu.samples.mnist veles_tpu.samples.mnist_ae \
               veles_tpu.samples.mnist_conv veles_tpu.samples.cifar10; do
   echo "== analyze $sample =="
   env JAX_PLATFORMS=cpu python -m veles_tpu.analyze "$sample"
 done
+# profiler smoke: a short stitched mnist run must leave non-zero
+# per-segment flops in the ledger, a parseable perf_report(), every
+# compile fingerprinted and ZERO steady-state recompiles
+echo "== prof smoke (veles_tpu.samples.mnist) =="
+env JAX_PLATFORMS=cpu python -m veles_tpu.prof --smoke veles_tpu.samples.mnist

@@ -594,3 +594,111 @@ def test_v_j08_in_catalog_and_hot_scan_keeps_standard_units_clean():
     findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
     assert "V-J08" not in rules_of(findings), \
         [f.render() for f in findings]
+
+
+# -- V-J09: retrace hazards on the hot loop ---------------------------------
+
+# a module-level jitted callable WITH static declarations: the V-J09
+# call-site scan resolves its static_argnames from this module's AST
+import functools  # noqa: E402
+
+import jax  # noqa: E402
+
+
+def _windowed(x, window=2, scale=1.0):
+    return x * scale + window
+
+
+_windowed_jit = jax.jit(_windowed, static_argnames=("window",))
+_windowed_partial = functools.partial(
+    jax.jit, static_argnames=("window",))(_windowed)
+
+
+def test_v_j09_retrace_hazards_on_hot_loop():
+    """V-J09: a jax.jit wrapper built per run() call (its compile
+    cache dies with the call) and static-declared kwargs fed
+    unhashable literals or per-call-computed values; the memoized
+    build-once idiom and bare self.attr static config stay quiet."""
+    from veles_tpu.analyze.shapes import scan_retrace_hazards
+
+    class RetraceUnit(Unit):
+        hide_from_registry = True
+
+        def run(self):
+            step = jax.jit(lambda x: x * self.scale)   # fresh per call
+            self.out = step(self.data)
+            # storing the RESULT on self does not memoize the wrapper
+            self.out2 = jax.jit(lambda x: x + self.k)(self.data)
+
+        def tpu_run(self):
+            # varying static: computed per call → retrace per value
+            self.out = _windowed_jit(self.data,
+                                     window=int(self.epoch))
+            # unhashable static: trace-time failure / retrace
+            self.out = _windowed_partial(self.data, window=[2, 3])
+
+    class CleanUnit(Unit):
+        hide_from_registry = True
+
+        def initialize(self, **kwargs):
+            pass
+
+        def run(self):
+            if getattr(self, "_step_", None) is None:
+                # memoized onto self: built once, cache survives
+                self._step_ = jax.jit(lambda x: x + 1)
+            self.out = self._step_(self.data)
+
+        def tpu_run(self):
+            # bare self.attr static config is THE stable idiom
+            # (activation/conv units); starred **config is not
+            # inspected either
+            self.out = _windowed_jit(self.data, window=self.window)
+            self.out = _windowed_jit(self.data, scale=float(self.k))
+
+    wf = DummyWorkflow()
+    hot = scan_retrace_hazards(RetraceUnit(wf, name="retrace"))
+    assert rules_of(hot) == {"V-J09"}, [f.render() for f in hot]
+    assert len(hot) == 4
+    messages = " | ".join(f.message for f in hot)
+    assert "jax.jit wrapper per call" in messages
+    assert "computed per call" in messages
+    assert "unhashable list literal" in messages
+    assert all(f.location for f in hot)
+    clean = scan_retrace_hazards(CleanUnit(wf, name="clean"))
+    assert clean == [], [f.render() for f in clean]
+
+
+def test_v_j09_in_catalog_and_real_workflows_stay_clean():
+    """The rule is in --rules, check_shapes wires it over the hot
+    chain + loader, and the standard znicz units (pure(**config)
+    forwarding, module-level jit) stay V-J09-silent."""
+    assert "V-J09" in rule_catalog()
+
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class TinyLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            self.original_data.mem = rng.standard_normal(
+                (40, 8)).astype(numpy.float32)
+            self.original_labels = [int(i % 4) for i in range(40)]
+            self.class_lengths[:] = [0, 0, 40]
+
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: TinyLoader(w, minibatch_size=8),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": 0.05}},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": 4}}],
+        decision_config={"max_epochs": 1})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=NumpyDevice())
+    findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
+    assert "V-J09" not in rules_of(findings), \
+        [f.render() for f in findings]

@@ -1,0 +1,540 @@
+"""veles_tpu.prof — the performance ledger.
+
+Cost accounting (per-segment flops/bytes from the compiled program +
+dispatch clocks → perf_report), the HBM residency ledger, the
+recompile sentinel (zero steady-state recompiles on a stitched epoch
+and on warmed serve buckets; a deliberately shape-unstable segment
+flags EXACTLY one retrace), the heartbeat watchdog, Prometheus
+histogram exposition, and the cluster merge (one clock-aligned
+Perfetto timeline + per-slave report from a scripted master–slave
+session)."""
+
+import json
+import logging
+import pickle
+import threading
+import time
+
+import numpy
+import pytest
+
+from veles_tpu import prof, trace
+from veles_tpu.config import root
+
+
+@pytest.fixture
+def live_trace():
+    """Enable the GLOBAL recorder directly; restores the stock
+    disabled state (same contract as tests/test_trace.py)."""
+    rec = trace.recorder
+    saved = (rec.enabled, rec.path, rec.role)
+    rec.clear()
+    rec.enabled = True
+    yield trace
+    rec.enabled, rec.path, rec.role = saved
+    rec.clear()
+
+
+@pytest.fixture(autouse=True)
+def _clean_sentinel():
+    """Each test sees an empty flagged-event list and the default
+    sentinel mode."""
+    prof.sentinel.reset()
+    saved = root.common.engine.get("recompile_sentinel", "warn")
+    yield
+    root.common.engine.recompile_sentinel = saved
+    prof.sentinel.reset()
+
+
+def _build_stitched_workflow(minibatch_size=32, max_epochs=2):
+    from veles_tpu import prng
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class BlobLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(42)
+            n = 200
+            labels = numpy.tile(numpy.arange(10), n // 10)
+            centers = rng.standard_normal((10, 16)) * 3.0
+            self.original_data.mem = (
+                centers[labels]
+                + rng.standard_normal((n, 16)) * 0.7
+            ).astype(numpy.float32)
+            self.original_labels = [int(x) for x in labels]
+            self.class_lengths[:] = [0, 50, 150]
+
+    prng.seed_all(5)
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: BlobLoader(
+            w, minibatch_size=minibatch_size),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+                {"type": "softmax", "->": {"output_sample_shape": 10},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}}],
+        decision_config={"max_epochs": max_epochs,
+                         "fail_iterations": 10 ** 6})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=CPUDevice())
+    return wf
+
+
+# -- cost accounting --------------------------------------------------------
+
+def test_cost_of_compiled_program():
+    import jax
+    compiled = jax.jit(lambda a, b: a @ b).lower(
+        numpy.ones((64, 64), numpy.float32),
+        numpy.ones((64, 64), numpy.float32)).compile()
+    cost = prof.cost_of(compiled)
+    assert cost["flops"] > 0
+    assert cost["bytes_accessed"] > 0
+    assert cost["arg_bytes"] == 2 * 64 * 64 * 4
+    assert cost["out_bytes"] == 64 * 64 * 4
+
+
+def test_stitched_epoch_cost_accounting_and_zero_recompiles():
+    """The acceptance run (and the recompile-sentinel gate): a
+    stitched epoch registers every segment with non-zero flops/bytes,
+    accumulates dispatch wall-time, and steady state never
+    recompiles."""
+    wf = _build_stitched_workflow()
+    # the ledger is process-wide and entries are keyed by segment
+    # name (other tests build the same blob workflow), so every
+    # assertion below is per-object or a delta around THIS run
+    recompiles_before = prof.ledger.recompiles
+    flops_before = prof.ledger.flops_dispatched
+    wf.run()
+    segments = [u.stitch_segment
+                for u in wf.units_in_dependency_order()
+                if getattr(u, "stitch_segment", None) is not None]
+    entries = {s.prof_entry.name: s.prof_entry for s in segments}
+    assert entries, "the blob workflow must stitch"
+    for segment in segments:
+        assert segment._compiled is not None, segment
+        assert segment.recompiles == 0, segment
+    for entry in entries.values():
+        assert entry.flops > 0, entry.name
+        assert entry.bytes_accessed > 0, entry.name
+        assert entry.dispatches > 0, entry.name
+        assert entry.dispatch_ns > 0, entry.name
+        assert entry.compiles >= 1, entry.name
+        assert entry.achieved_flops() > 0, entry.name
+    assert prof.ledger.recompiles == recompiles_before
+    assert prof.ledger.flops_dispatched > flops_before
+    assert prof.flagged == []
+    # the report shows flops / bytes / wall / achieved-FLOP/s per
+    # segment (CPU: no peak entry, so the MFU column honestly dashes)
+    report = wf.perf_report()
+    assert "performance ledger" in report
+    assert "stitched segments" in report
+    for name in entries:
+        assert name[:36] in report
+    assert "steady-state recompile(s)" in report
+    assert "no peak table entry" in report
+    summary = prof.summary()
+    row_names = {r["name"] for r in summary["entries"]}
+    assert set(entries) <= row_names
+    assert summary["totals"]["flops_dispatched"] > 0
+
+
+def test_mfu_reported_when_peak_entry_exists():
+    """MFU = achieved/peak when the device kind has a peak-table
+    entry; the summary row carries it."""
+    entry = prof.LedgerEntry("segment", "fake")
+    entry.cost = {"flops": 1e9, "bytes_accessed": 1.0,
+                  "arg_bytes": 0, "out_bytes": 0, "temp_bytes": 0}
+    entry.compiles = 1
+    entry.dispatches = 10
+    entry.dispatch_ns = int(1e8)        # 0.1 s for 10 dispatches
+    peak = prof.peak_flops("TPU v5 lite")
+    assert peak == 197e12
+    mfu = entry.mfu(peak)
+    assert mfu == pytest.approx(1e10 / 0.1 / peak)
+    assert entry.row(peak)["mfu"] == pytest.approx(mfu, abs=1e-6)
+    assert entry.row(None)["mfu"] is None      # CPU fallback
+
+
+def test_hbm_ledger_categories_and_vector_tags():
+    from veles_tpu.memory import Watcher
+    wf = _build_stitched_workflow(max_epochs=1)
+    # force the lazy uploads this test attributes (run() would)
+    wf.loader.minibatch_data.devmem
+    wf.forwards[0].weights.devmem
+    ledger = Watcher.hbm_ledger(top=10 ** 6)
+    # weights/bias upload as params, the resident dataset + shuffled
+    # indices as dataset, minibatch buffers as staging.  Assert over
+    # the live per-Vector registry, which is self-consistent — the
+    # aggregate counters are process-wide and other tests may
+    # Watcher.reset() them under still-live buffers.
+    live_by_cat = {}
+    for row in ledger["top_vectors"]:
+        live_by_cat[row["category"]] = \
+            live_by_cat.get(row["category"], 0) + row["nbytes"]
+    for cat in ("params", "dataset", "staging"):
+        assert cat in ledger["by_category"], cat
+        assert live_by_cat.get(cat, 0) > 0, cat
+    # per-Vector attribution of THIS workflow's buffers
+    live = Watcher._vectors
+    assert live[id(wf.forwards[0].weights)][3] == "params"
+    assert live[id(wf.loader.original_data)][3] == "dataset"
+    assert live[id(wf.loader.minibatch_data)][3] == "staging"
+    assert ledger["peak_bytes"] >= 0
+    assert ledger["top_vectors"], "per-Vector detail must be present"
+    # this workflow's resident blob dataset appears with its tag
+    assert {"shape": [200, 16], "dtype": "float32",
+            "nbytes": 200 * 16 * 4, "category": "dataset"} \
+        in ledger["top_vectors"]
+    # the tag itself survives pickling (snapshots keep attribution)
+    vec = wf.forwards[0].weights
+    assert vec.category == "params"
+    assert pickle.loads(pickle.dumps(vec)).category == "params"
+
+
+# -- the recompile sentinel -------------------------------------------------
+
+def _one_stage_segment(scalar_state):
+    """A minimal directly-constructed segment whose per-call scalar
+    comes from ``scalar_state['k']`` — flipping its python TYPE
+    between calls is exactly the silent retrace the sentinel exists
+    to catch."""
+    from veles_tpu.backends import CPUDevice
+    from veles_tpu.memory import Vector
+    from veles_tpu.stitch import StitchSegment, StitchStage
+
+    class StubUnit(object):
+        name = "stub"
+
+        def attach_stitch_segment(self, segment):
+            pass
+
+        def run(self):
+            raise AssertionError("eager fallback must not fire here")
+
+    device = CPUDevice()
+    vx = Vector(numpy.ones((4, 4), numpy.float32)).initialize(device)
+    vy = Vector(numpy.zeros((4, 4), numpy.float32)).initialize(device)
+    unit = StubUnit()
+    stage = StitchStage(
+        unit, lambda t: {"y": t["x"] * t["k"]},
+        consumes={"x": vx}, produces={"y": vy},
+        scalars=lambda: {"k": scalar_state["k"]})
+    return StitchSegment([unit], [stage]), vy
+
+
+def test_shape_unstable_segment_flags_exactly_one_retrace(live_trace):
+    """The deliberately-unstable unit: one scalar type flip = exactly
+    one flagged retrace event (trace instant + WARNING + ledger
+    count), and the dispatch still completes correctly."""
+    state = {"k": 2}
+    segment, vy = _one_stage_segment(state)
+    segment.execute()
+    assert segment.prof_entry.compiles == 1
+    assert segment.recompiles == 0
+    segment.execute()                    # same signature: no retrace
+    assert segment.recompiles == 0
+    state["k"] = 2.5                     # int -> float: signature drift
+    segment.execute()
+    assert segment.recompiles == 1
+    assert len(prof.flagged) == 1
+    assert "segment:stub" in prof.flagged[0]["site"]
+    assert "int" in prof.flagged[0]["detail"] \
+        and "float" in prof.flagged[0]["detail"]
+    assert trace.recorder.count("prof", "recompile") == 1
+    numpy.testing.assert_allclose(numpy.asarray(vy.devmem),
+                                  numpy.full((4, 4), 2.5))
+    # steady again at the new signature: still exactly one event
+    segment.execute()
+    assert segment.recompiles == 1
+    assert len(prof.flagged) == 1
+    # ALTERNATING back to a signature seen before swaps the cached
+    # executable — no recompile, no new flag (the jit-cache behavior
+    # the AOT path replaced), and the math stays right
+    state["k"] = 3
+    segment.execute()
+    assert segment.recompiles == 1
+    assert len(prof.flagged) == 1
+    assert segment.prof_entry.compiles == 2
+    numpy.testing.assert_allclose(numpy.asarray(vy.devmem),
+                                  numpy.full((4, 4), 3.0))
+
+
+def test_sentinel_strict_mode_raises_preflight_error():
+    from veles_tpu.analyze import PreflightError
+    root.common.engine.recompile_sentinel = "strict"
+    state = {"k": 1}
+    segment, _vy = _one_stage_segment(state)
+    segment.execute()
+    state["k"] = 1.5
+    with pytest.raises(PreflightError) as err:
+        segment.execute()
+    assert "V-P01" in str(err.value)
+    assert len(prof.flagged) == 1        # flagged BEFORE raising
+
+
+def test_warmed_serve_buckets_zero_steady_state_recompiles():
+    """warmup() promises zero steady-state compiles — the ledger and
+    sentinel hold it to that; serving within the warmed buckets never
+    flags, an out-of-warmup compile does."""
+    from veles_tpu.serve.engine import InferenceEngine
+    wf = _build_stitched_workflow(max_epochs=1)
+    engine = InferenceEngine.from_forwards(
+        wf.forwards, sample_shape=(16,), max_batch_size=8)
+    engine.warmup()
+    compile_count = engine.compile_count
+    recompiles_before = prof.ledger.recompiles
+    for n in (1, 2, 3, 5, 8, 7, 4):
+        out = engine.infer(numpy.zeros((n, 16), numpy.float32))
+        assert out.shape == (n, 10)
+    assert engine.compile_count == compile_count
+    assert prof.ledger.recompiles == recompiles_before
+    assert prof.flagged == []
+    # bucket entries carry cost + dispatch clocks
+    entries = [e for e in prof.ledger.entries("bucket")
+               if e.name.startswith(engine.prof_name)]
+    assert entries
+    assert all(e.flops > 0 for e in entries)
+    assert any(e.dispatches > 0 for e in entries)
+    # forcing a compile AFTER warmup is flagged as steady-state
+    engine.buckets = engine.buckets + (16,)
+    engine._executable(16)
+    assert prof.ledger.recompiles == recompiles_before + 1
+    assert len(prof.flagged) == 1
+    assert "bucket[16]" in prof.flagged[0]["site"]
+
+
+# -- serve /metrics ---------------------------------------------------------
+
+def test_latency_histogram_prometheus_exposition():
+    """Real histogram exposition: cumulative ``le`` buckets +
+    ``_sum``/``_count``, consistent with the recorded stream, while
+    the percentile text lines stay for the web status page."""
+    from veles_tpu.serve.metrics import ServingMetrics
+    metrics = ServingMetrics()
+    samples = [0.001, 0.004, 0.004, 0.02, 0.3]
+    for s in samples:
+        metrics.observe_request(s)
+    text = metrics.render_text()
+    lines = text.splitlines()
+    buckets = [ln for ln in lines if ln.startswith(
+        "veles_serve_request_latency_seconds_bucket")]
+    assert buckets[-1] == \
+        'veles_serve_request_latency_seconds_bucket{le="+Inf"} 5'
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts), "cumulative le buckets"
+    assert counts[0] == 0 and counts[-1] == len(samples)
+    sum_line = [ln for ln in lines if ln.startswith(
+        "veles_serve_request_latency_seconds_sum")][0]
+    assert float(sum_line.rsplit(" ", 1)[1]) == \
+        pytest.approx(sum(samples))
+    assert "veles_serve_request_latency_seconds_count 5" in lines
+    assert "# TYPE veles_serve_request_latency_seconds histogram" \
+        in lines
+    # every observation is <= some finite bound here, so the largest
+    # finite bucket already holds all five
+    assert counts[-2] == len(samples)
+    # the batch histogram family is present too, and the legacy
+    # percentile lines survive for web_status
+    assert any(ln.startswith(
+        "veles_serve_batch_latency_seconds_bucket") for ln in lines)
+    assert any('request_latency_ms{quantile="p99"}' in ln
+               for ln in lines)
+
+
+def test_prof_metrics_text_gauges():
+    from veles_tpu.memory import Watcher
+    _build_stitched_workflow(max_epochs=1)
+    text = prof.metrics_text()
+    assert "veles_prof_compiles_total" in text
+    assert "veles_prof_recompiles_total" in text
+    assert 'veles_prof_hbm_bytes{category="params"}' in text
+    assert ("veles_prof_hbm_peak_bytes %d" % Watcher.peak_bytes) \
+        in text
+
+
+# -- heartbeat watchdog -----------------------------------------------------
+
+class _ScriptedMaster(object):
+    def __init__(self, n_jobs=3):
+        self.n_jobs = n_jobs
+        self.served = 0
+        self.updates = []
+
+    def checksum(self):
+        return "prof-v1"
+
+    def generate_data_for_slave(self, slave):
+        if self.served >= self.n_jobs:
+            return None
+        self.served += 1
+        return {"job_number": self.served}
+
+    def apply_data_from_slave(self, data, slave):
+        self.updates.append(data)
+
+    def drop_slave(self, slave):
+        pass
+
+
+class _ScriptedSlave(object):
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def checksum(self):
+        return "prof-v1"
+
+    def do_job(self, data, callback):
+        if self.delay:
+            time.sleep(self.delay)
+        callback({"result": data["job_number"]})
+
+
+def test_heartbeat_watchdog_flags_stalled_slave(live_trace, caplog):
+    """heartbeat_warn_ms (default off): a scripted slave that
+    handshakes and then goes silent draws a WARNING + a
+    ``jobs:heartbeat_stall`` instant — once per excursion, well
+    before the hard timeout reaps it."""
+    from veles_tpu.parallel.jobs import JobClient, JobServer
+    saved = root.common.engine.get("heartbeat_warn_ms", None)
+    root.common.engine.heartbeat_warn_ms = 80
+    master = _ScriptedMaster()
+    server = JobServer(master, slave_timeout=30.0,
+                       heartbeat_interval=0.05).start()
+    try:
+        client = JobClient(_ScriptedSlave(), server.endpoint)
+        client.handshake()
+        # the slave now stalls: no pings, no job requests
+        with caplog.at_level(logging.WARNING):
+            time.sleep(0.6)
+        assert any("heartbeat stalled" in rec.message
+                   for rec in caplog.records)
+        # warned ONCE per excursion, not once per reaper tick
+        assert trace.recorder.count("jobs", "heartbeat_stall") == 1
+        assert server.slaves[client.sid].hb_warned
+        client.close()
+    finally:
+        server.stop()
+        root.common.engine.heartbeat_warn_ms = saved
+
+
+def test_heartbeat_watchdog_default_off(live_trace):
+    from veles_tpu.parallel.jobs import JobClient, JobServer
+    master = _ScriptedMaster()
+    server = JobServer(master, slave_timeout=30.0,
+                       heartbeat_interval=0.05).start()
+    try:
+        client = JobClient(_ScriptedSlave(), server.endpoint)
+        client.handshake()
+        time.sleep(0.3)
+        assert trace.recorder.count("jobs", "heartbeat_stall") == 0
+        client.close()
+    finally:
+        server.stop()
+
+
+# -- cluster merge ----------------------------------------------------------
+
+def _run_scripted_session(tmp_path, n_slaves=2, n_jobs=6):
+    """A scripted master–slave session over real ZMQ; every slave
+    ships its profile at end-of-run; returns the saved bundle path."""
+    from veles_tpu.parallel.jobs import JobClient, JobServer
+    master = _ScriptedMaster(n_jobs=n_jobs)
+    server = JobServer(master).start()
+    clients = [JobClient(_ScriptedSlave(delay=0.01 * (1 + 3 * i)),
+                         server.endpoint, sid="s%d" % i)
+               for i in range(n_slaves)]
+    try:
+        threads = []
+        for client in clients:
+            client.handshake()
+        for client in clients:
+            t = threading.Thread(target=client.run)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(30)
+        for client in clients:
+            client.close()
+        assert len(master.updates) == n_jobs
+        for client in clients:
+            assert client.sid in server.slave_profiles, \
+                "slave %s did not ship its profile" % client.sid
+        path = str(tmp_path / "session_profile.json")
+        # in-process session: master and slaves share ONE ring, so
+        # the master keeps only its own lanes in the bundle
+        server.save_session_profile(path, roles=("master",))
+        return path
+    finally:
+        server.stop()
+
+
+def test_cluster_merge_timeline_and_report(live_trace, tmp_path):
+    """The acceptance scenario: a scripted master–slave session
+    merges into ONE Perfetto-loadable timeline with master +
+    slave-<sid> tracks, and the cluster report prints per-slave MFU
+    and the straggler spread."""
+    bundle_path = _run_scripted_session(tmp_path)
+    bundle = prof.merge.load(bundle_path)
+    assert set(bundle["slaves"]) == {"s0", "s1"}
+    for slave_prof in bundle["slaves"].values():
+        assert slave_prof["events"], "shipped ring must not be empty"
+        assert "totals" in slave_prof["ledger"]
+        # in-process shipping keeps each slave to its own lanes
+        roles = {ev.get("role") for ev in slave_prof["events"]}
+        assert "master" not in roles
+    merged = prof.merge.merged_events(bundle)
+    ts = [ev["ts_us"] for ev in merged]
+    assert ts == sorted(ts)
+    out = prof.merge.save_merged(bundle,
+                                 str(tmp_path / "merged.json"))
+    with open(out) as fin:
+        payload = json.load(fin)
+    assert payload["traceEvents"], "Perfetto needs traceEvents"
+    roles = {ev["args"]["name"] for ev in payload["traceEvents"]
+             if ev.get("ph") == "M"}
+    assert "master" in roles
+    assert {"slave-s0", "slave-s1"} <= roles
+    report = prof.merge.cluster_report(bundle)
+    assert "slave-s0" in report and "slave-s1" in report
+    assert "mfu" in report
+    assert "straggler spread" in report
+    # the slow slave (3x the per-job delay) is named the straggler
+    assert "slowest slave-s1" in report
+    assert "aggregate peak HBM" in report
+
+
+@pytest.mark.traced
+def test_prof_cli_offline_and_merge(tmp_path, capsys):
+    """``python -m veles_tpu.prof``: a trace export renders the
+    per-segment ledger offline (cost rides the compile instants); a
+    session bundle renders the cluster report; ``merge`` writes the
+    combined timeline.  The ``traced`` marker arms recording through
+    the CONFIG knob so ``initialize()`` keeps it on."""
+    from veles_tpu.prof.__main__ import main
+    wf = _build_stitched_workflow()
+    wf.run()
+    trace_path = str(tmp_path / "run.json")
+    trace.save(trace_path)
+    assert main([trace_path]) == 0
+    out = capsys.readouterr().out
+    assert "performance ledger" in out
+    assert "stitched segments" in out
+    assert "e+" in out                    # non-zero flops rendered
+    assert "0 steady-state recompile(s)" in out
+    assert main([trace_path, "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)["entries"]
+    assert rows and all(r["flops"] > 0 for r in rows)
+    bundle_path = _run_scripted_session(tmp_path)
+    assert main([bundle_path]) == 0
+    assert "straggler spread" in capsys.readouterr().out
+    merged_path = str(tmp_path / "merged.json")
+    assert main(["merge", bundle_path, "-o", merged_path]) == 0
+    assert "merged timeline" in capsys.readouterr().out
+    with open(merged_path) as fin:
+        assert json.load(fin)["traceEvents"]
+    assert main([str(tmp_path / "nope.json")]) == 2

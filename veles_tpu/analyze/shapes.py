@@ -68,6 +68,15 @@ RULES = {
               "run()/tpu_run(), outside the deferred-metrics "
               "protocol — every minibatch stalls on a device "
               "round-trip the async dispatch queue was hiding"),
+    "V-J09": ("warning",
+              "retrace hazard on the train hot loop: a jax.jit "
+              "wrapper built inside run()/tpu_run() (a fresh compile "
+              "cache per call — closures over python scalars bake in "
+              "and every step retraces), or a static-declared "
+              "argument fed an unhashable literal / per-call-"
+              "computed value — XLA silently recompiles on every "
+              "new value; the prof recompile sentinel is this "
+              "check's runtime twin"),
 }
 
 #: dotted call names that force a device→host sync
@@ -170,17 +179,13 @@ def _module_index(path):
     return index
 
 
-def scan_transfer_hazards(unit, hot_loop=False):
-    """AST-scan ``run``/``tpu_run`` of ``unit``'s class for forced
-    host syncs; returns Findings (V-J05, and — when ``hot_loop`` marks
-    the unit as part of the per-minibatch train chain — V-J06
-    ``map_read``/``map_write`` coherence round-trips, V-J07 explicit
-    H2D uploads, and V-J08 unconditionally-blocking syncs:
-    ``jax.device_get``, ``.block_until_ready()``, ``.item()`` and
-    ``float()``/``int()`` casts of jnp expressions outside the
-    deferred-metrics protocol).  ``numpy_run`` — the declared
-    interpret/debug path — is deliberately not scanned."""
-    findings = []
+def _iter_hot_method_asts(unit):
+    """Yield ``(meth_name, tree, path, base_line, index)`` for the
+    ``run``/``tpu_run`` bodies of ``unit``'s class — the ONE
+    source-extraction preamble every hot-loop AST rule
+    (V-J05..V-J09) consumes, so the scanners can never diverge on
+    which methods they look at.  ``numpy_run`` — the declared
+    interpret/debug path — is deliberately not yielded."""
     cls = type(unit)
     for meth_name in ("run", "tpu_run"):
         meth = cls.__dict__.get(meth_name) or getattr(cls, meth_name,
@@ -201,10 +206,26 @@ def scan_transfer_hazards(unit, hot_loop=False):
             tree = ast.parse(src)
         except SyntaxError:
             continue
+        index = _module_index(path) if path else None
+        yield meth_name, tree, path, base_line, index
+
+
+def scan_transfer_hazards(unit, hot_loop=False):
+    """AST-scan ``run``/``tpu_run`` of ``unit``'s class for forced
+    host syncs; returns Findings (V-J05, and — when ``hot_loop`` marks
+    the unit as part of the per-minibatch train chain — V-J06
+    ``map_read``/``map_write`` coherence round-trips, V-J07 explicit
+    H2D uploads, and V-J08 unconditionally-blocking syncs:
+    ``jax.device_get``, ``.block_until_ready()``, ``.item()`` and
+    ``float()``/``int()`` casts of jnp expressions outside the
+    deferred-metrics protocol)."""
+    findings = []
+    cls = type(unit)
+    for meth_name, tree, path, base_line, index in \
+            _iter_hot_method_asts(unit):
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):
                 continue
-            index = _module_index(path) if path else None
             # alias-resolved first (import numpy as onp), raw dotted
             # name as fallback (non-Name receivers like f(x).item())
             name = (index.resolve_call(node.func) if index else None) \
@@ -291,6 +312,203 @@ def scan_transfer_hazards(unit, hot_loop=False):
     return findings
 
 
+def _jit_call_info(call, index):
+    """``(static_argnames, static_argnums)`` when ``call`` constructs
+    a ``jax.jit`` wrapper (directly or via ``functools.partial(
+    jax.jit, ...)``), else ``None``.  Only literal static declarations
+    are read — a computed declaration is out of static reach."""
+    name = (index.resolve_call(call.func) if index else None) \
+        or _call_name(call.func)
+    if name is None and isinstance(call.func, ast.Call):
+        # the applied-partial idiom:
+        # ``functools.partial(jax.jit, static_argnames=...)(f)`` —
+        # the wrapper's statics live on the inner partial call.  ONLY
+        # the partial form: ``jax.jit(f)(x)`` applies the wrapper
+        # immediately — there the CTOR is the inner call (flagged on
+        # its own walk), not this application
+        inner = (index.resolve_call(call.func.func) if index
+                 else None) or _call_name(call.func.func)
+        if inner == "functools.partial":
+            return _jit_call_info(call.func, index)
+        return None
+    if name == "functools.partial" and call.args:
+        first = call.args[0]
+        fname = (index.resolve_call(first) if index else None) \
+            or _call_name(first)
+        if fname != "jax.jit":
+            return None
+    elif name != "jax.jit":
+        return None
+    names, nums = set(), set()
+    for kw in call.keywords:
+        value = kw.value
+        if kw.arg == "static_argnames":
+            if isinstance(value, ast.Constant) \
+                    and isinstance(value.value, str):
+                names.add(value.value)
+            elif isinstance(value, (ast.Tuple, ast.List)):
+                names.update(e.value for e in value.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str))
+        elif kw.arg == "static_argnums":
+            if isinstance(value, ast.Constant) \
+                    and isinstance(value.value, int):
+                nums.add(value.value)
+            elif isinstance(value, (ast.Tuple, ast.List)):
+                nums.update(e.value for e in value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, int))
+    return names, nums
+
+
+_JIT_STATICS_CACHE = {}
+
+
+def _module_jit_statics(index):
+    """``{callable name: (static_argnames, static_argnums)}`` for
+    every jit wrapper DEFINED in the module: module-level
+    ``X = jax.jit(f, ...)`` assignments and ``@jax.jit`` /
+    ``@functools.partial(jax.jit, ...)``-decorated functions (class
+    methods included — call sites match on the attribute tail)."""
+    statics = _JIT_STATICS_CACHE.get(index.path)
+    if statics is not None:
+        return statics
+    statics = {}
+
+    def visit_body(body, in_class=False):
+        for node in body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                info = _jit_call_info(node.value, index)
+                if info is not None:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            statics[tgt.id] = info
+            elif isinstance(node, ast.ClassDef):
+                visit_body(node.body, in_class=True)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        info = _jit_call_info(dec, index)
+                        if info is not None:
+                            if in_class:
+                                # argnums count `self` for bound
+                                # methods but not for staticmethods
+                                # — call sites can't be shifted
+                                # reliably, so class-level defs keep
+                                # only their NAMED statics
+                                info = (info[0], set())
+                            statics[node.name] = info
+
+    visit_body(index.tree.body)
+    _JIT_STATICS_CACHE[index.path] = statics
+    return statics
+
+
+def _static_value_hazard(value):
+    """Why feeding ``value`` to a static parameter retraces (or
+    breaks), or ``None`` when it is the stable idiom.  Unhashable
+    literals (list/dict/set) raise at trace time or force a retrace;
+    a per-call-computed expression (a call, arithmetic) re-keys the
+    jit cache on every new value.  Bare names, ``self.attr`` config
+    reads and constants stay quiet — that is the activation/conv
+    units' stable-config idiom."""
+    if isinstance(value, (ast.List, ast.Dict, ast.Set)):
+        return "an unhashable %s literal" % type(value).__name__.lower()
+    if isinstance(value, (ast.Call, ast.BinOp, ast.UnaryOp,
+                          ast.IfExp, ast.ListComp, ast.GeneratorExp)):
+        return "a value computed per call"
+    return None
+
+
+def scan_retrace_hazards(unit):
+    """V-J09: AST-scan ``run``/``tpu_run`` of ``unit``'s class for
+    retrace hazards — ``jax.jit`` wrappers constructed per call
+    (unless memoized onto ``self``), and known static-declared
+    parameters fed unhashable literals or per-call-computed values.
+    Starred ``**config`` forwarding is not inspected (the standard
+    units' ``pure(**self.pure_config())`` idiom is shape-stable by
+    contract)."""
+    findings = []
+    cls = type(unit)
+    for meth_name, tree, path, base_line, index in \
+            _iter_hot_method_asts(unit):
+        statics = _module_jit_statics(index) if index else {}
+        # jit calls memoized onto self (the guarded
+        # `self._step_ = jax.jit(...)` build-once idiom) are fine:
+        # the wrapper — and its compile cache — survives across
+        # calls.  Only the assigned value ITSELF counts — in
+        # `self.out = jax.jit(f)(x)` the self-assignment stores the
+        # RESULT, the per-call wrapper inside is still the hazard
+        memoized = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self" for t in node.targets):
+                memoized.add(id(node.value))
+        inner_ctors = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) \
+                    or id(node) in inner_ctors:
+                continue
+            line = base_line + node.lineno - 1
+            location = "%s:%d" % (path, line) if path else None
+            info = _jit_call_info(node, index)
+            if info is not None:
+                if isinstance(node.func, ast.Call):
+                    # applied-partial: one finding for the whole
+                    # expression, not a second for the inner partial
+                    inner_ctors.add(id(node.func))
+                if id(node) in memoized:
+                    continue
+                findings.append(Finding(
+                    *_rule("V-J09"),
+                    message="%s.%s builds a jax.jit wrapper per call "
+                            "— its compile cache dies with it, so "
+                            "every step pays a fresh trace+compile "
+                            "(and any python scalar it closes over "
+                            "is baked in stale)"
+                            % (cls.__name__, meth_name),
+                    unit=unit.name, location=location,
+                    fix="build the jitted callable once (module "
+                        "level, or memoized onto self at first use) "
+                        "and pass varying scalars as traced args"))
+                continue
+            name = (index.resolve_call(node.func) if index else None) \
+                or _call_name(node.func)
+            if not name:
+                continue
+            info = statics.get(name) or statics.get(
+                name.rsplit(".", 1)[-1])
+            if not info:
+                continue
+            names, nums = info
+            hazards = [(kw.arg, _static_value_hazard(kw.value))
+                       for kw in node.keywords
+                       if kw.arg is not None and kw.arg in names]
+            hazards += [("argnum %d" % pos,
+                         _static_value_hazard(arg))
+                        for pos, arg in enumerate(node.args)
+                        if pos in nums]
+            for label, why in hazards:
+                if why is None:
+                    continue
+                findings.append(Finding(
+                    *_rule("V-J09"),
+                    message="%s.%s feeds static parameter %s of a "
+                            "jitted callable %s — the jit cache "
+                            "re-keys (or trace fails) on every new "
+                            "value, a silent per-step recompile"
+                            % (cls.__name__, meth_name, label, why),
+                    unit=unit.name, location=location,
+                    fix="pass varying values as traced args (drop "
+                        "them from static_argnames/static_argnums) "
+                        "and keep static config hashable and stable"))
+    return findings
+
+
 def _host_params(unit):
     """Best-effort host params pytree for a forward unit; ``None`` when
     unavailable (uninitialized weights, protocol error)."""
@@ -350,6 +568,9 @@ def check_shapes(workflow, sample_shape=None, batch_size=None):
     hot_units.extend(getattr(workflow, "gds", None) or [])
     for unit in hot_units:
         findings.extend(scan_transfer_hazards(unit, hot_loop=True))
+        # V-J09 — retrace hazards (per-call jit wrappers, unstable
+        # static args) on the same hot chain
+        findings.extend(scan_retrace_hazards(unit))
 
     # V-J07 — per-step host input pipeline.  (a) the loader's own
     # run()/tpu_run() body moving bytes H2D per minibatch (device_put
@@ -363,6 +584,7 @@ def check_shapes(workflow, sample_shape=None, batch_size=None):
     if loader is not None:
         findings.extend(f for f in scan_transfer_hazards(
             loader, hot_loop=True) if f.rule == "V-J07")
+        findings.extend(scan_retrace_hazards(loader))
         device = getattr(loader, "device", None)
         # fire only when flipping the CONFIG would actually engage the
         # path: a loader that is structurally ineligible (dataset not

@@ -71,12 +71,12 @@ class Loader(Unit):
         self.minibatch_class = TRAIN
         self.minibatch_offset = 0
         self.minibatch_size = 0
-        self.minibatch_data = Vector()
-        self.minibatch_labels = Vector()
-        self.minibatch_indices = Vector()
+        self.minibatch_data = Vector(category="staging")
+        self.minibatch_labels = Vector(category="staging")
+        self.minibatch_indices = Vector(category="staging")
         self.raw_minibatch_labels = []
         self.labels_mapping = {}
-        self.shuffled_indices = Vector()
+        self.shuffled_indices = Vector(category="dataset")
         self.shuffle_limit = kwargs.get("shuffle_limit", 2 ** 31)
         # ensemble members train on a subset; the manager communicates
         # the ratio via config (ref loader/base.py:524 train_ratio)

@@ -45,7 +45,7 @@ class FullBatchLoader(Loader):
     hide_from_registry = True
 
     def __init__(self, workflow, **kwargs):
-        self.original_data = Vector()
+        self.original_data = Vector(category="dataset")
         self.original_labels = []
         #: keep the dataset on device and gather there (default on)
         self.store_in_device_memory = kwargs.get(
@@ -64,7 +64,7 @@ class FullBatchLoader(Loader):
         self.input_norm = None
         #: the pre-mapped labels as a device-residable Vector (int32),
         #: built at initialize when the dataset is labeled
-        self.resident_labels = Vector()
+        self.resident_labels = Vector(category="dataset")
         super(FullBatchLoader, self).__init__(workflow, **kwargs)
 
     def init_unpickled(self):
@@ -336,8 +336,8 @@ class FullBatchLoaderMSE(FullBatchLoader):
     hide_from_registry = True
 
     def __init__(self, workflow, **kwargs):
-        self.original_targets = Vector()
-        self.minibatch_targets = Vector()
+        self.original_targets = Vector(category="dataset")
+        self.minibatch_targets = Vector(category="staging")
         super(FullBatchLoaderMSE, self).__init__(workflow, **kwargs)
 
     def _device_stage_plan(self):

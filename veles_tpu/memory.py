@@ -39,7 +39,15 @@ class Watcher(object):
     transfer bytes, not just img/s.  Each accounting call also samples
     a ``veles_tpu.trace`` counter track ("h2d" category) when tracing
     is on, so Perfetto shows the cumulative byte curves on the
-    timeline."""
+    timeline.
+
+    The Watcher is also the **live HBM ledger** behind
+    ``veles_tpu.prof``: tracked bytes carry a *category* (the
+    Vector's ``category`` tag — ``params`` / ``dataset`` / ``staging``
+    / ``kv`` / ``other``) with current + peak accounting per category,
+    and a per-Vector registry of resident buffers, so
+    ``perf_report()`` can say not just *how much* HBM is in use but
+    *whose* it is and what the headroom was."""
 
     lock = threading.Lock()
     bytes_in_use = 0
@@ -48,17 +56,58 @@ class Watcher(object):
     h2d_transfers = 0
     d2h_bytes = 0
     d2h_transfers = 0
+    #: per-category current/peak resident bytes ({category: int})
+    bytes_by_category = {}
+    peak_by_category = {}
+    #: id(owner) -> (shape, dtype str, nbytes, category) for every
+    #: live tracked device buffer — the per-Vector ledger detail
+    _vectors = {}
 
     @classmethod
-    def track(cls, nbytes):
+    def track(cls, nbytes, category=None, owner=None):
+        cat = category or "other"
         with cls.lock:
             cls.bytes_in_use += nbytes
             cls.peak_bytes = max(cls.peak_bytes, cls.bytes_in_use)
+            total = cls.bytes_by_category.get(cat, 0) + nbytes
+            cls.bytes_by_category[cat] = total
+            cls.peak_by_category[cat] = max(
+                cls.peak_by_category.get(cat, 0), total)
+            if owner is not None:
+                cls._vectors[id(owner)] = (
+                    getattr(owner, "shape", None),
+                    str(getattr(owner, "dtype", None)), nbytes, cat)
 
     @classmethod
-    def untrack(cls, nbytes):
+    def untrack(cls, nbytes, category=None, owner=None):
+        cat = category or "other"
         with cls.lock:
             cls.bytes_in_use -= nbytes
+            cls.bytes_by_category[cat] = \
+                cls.bytes_by_category.get(cat, 0) - nbytes
+            if owner is not None:
+                cls._vectors.pop(id(owner), None)
+
+    @classmethod
+    def hbm_ledger(cls, top=8):
+        """JSON-able residency snapshot: totals, per-category
+        current/peak, and the ``top`` biggest resident buffers."""
+        with cls.lock:
+            by_category = {
+                cat: {"bytes": cls.bytes_by_category.get(cat, 0),
+                      "peak": peak}
+                for cat, peak in cls.peak_by_category.items()}
+            vectors = sorted(cls._vectors.values(),
+                             key=lambda v: -v[2])[:top]
+        return {
+            "bytes_in_use": cls.bytes_in_use,
+            "peak_bytes": cls.peak_bytes,
+            "by_category": by_category,
+            "top_vectors": [
+                {"shape": list(shape) if shape else None,
+                 "dtype": dtype, "nbytes": nbytes, "category": cat}
+                for shape, dtype, nbytes, cat in vectors],
+        }
 
     @classmethod
     def track_h2d(cls, nbytes):
@@ -85,15 +134,25 @@ class Watcher(object):
             cls.h2d_transfers = 0
             cls.d2h_bytes = 0
             cls.d2h_transfers = 0
+            cls.bytes_by_category = {}
+            cls.peak_by_category = {}
+            cls._vectors = {}
 
 
 class Vector(Pickleable):
-    """Host-mirrored device buffer."""
+    """Host-mirrored device buffer.
 
-    def __init__(self, data=None):
+    ``category`` tags the buffer for the Watcher's HBM ledger
+    (``params`` / ``dataset`` / ``staging`` / ``kv``; ``None`` groups
+    under ``other``) — set it at construction (weights, resident
+    datasets and minibatch staging buffers already are), it rides
+    pickling and is read at device-upload time."""
+
+    def __init__(self, data=None, category=None):
         super(Vector, self).__init__()
         self._mem = None          # host numpy array (may be stale)
         self._device = None
+        self.category = category
         if data is not None:
             self.reset(data)
 
@@ -103,6 +162,11 @@ class Vector(Pickleable):
         self._host_fresh_ = True   # host copy up to date
         self._dev_fresh_ = False   # device copy up to date
         self._tracked_bytes_ = 0
+        self._tracked_category_ = None
+        # pre-category pickles (and bare __new__ construction paths)
+        # lack the attribute entirely
+        if not hasattr(self, "category"):
+            self.category = None
 
     # -- basic properties ---------------------------------------------------
     def reset(self, data):
@@ -262,17 +326,21 @@ class Vector(Pickleable):
     # -- helpers ------------------------------------------------------------
     def _set_devmem(self, value):
         if self._tracked_bytes_:
-            Watcher.untrack(self._tracked_bytes_)
+            Watcher.untrack(self._tracked_bytes_,
+                            self._tracked_category_, owner=self)
         self._devmem_ = value
         self._tracked_bytes_ = (
             int(numpy.prod(value.shape)) * value.dtype.itemsize
             if value is not None and value.shape else 0)
         if self._tracked_bytes_:
-            Watcher.track(self._tracked_bytes_)
+            self._tracked_category_ = getattr(self, "category", None)
+            Watcher.track(self._tracked_bytes_,
+                          self._tracked_category_, owner=self)
 
     def _drop_devmem(self):
         if self._tracked_bytes_:
-            Watcher.untrack(self._tracked_bytes_)
+            Watcher.untrack(self._tracked_bytes_,
+                            self._tracked_category_, owner=self)
             self._tracked_bytes_ = 0
         self._devmem_ = None
 

@@ -55,6 +55,22 @@ class LatencyHistogram(object):
     def mean(self):
         return self._sum / self._n if self._n else 0.0
 
+    def cumulative(self):
+        """Consistent snapshot for Prometheus histogram exposition:
+        ``(upper_bounds, cumulative_counts, sum_seconds, count)`` —
+        ``cumulative_counts[i]`` is the number of observations ≤
+        ``upper_bounds[i]`` (the ``le`` semantics); the final slot
+        beyond the last bound is the ``+Inf`` bucket, which by
+        construction equals ``count``."""
+        with self._lock:
+            counts = list(self._counts)
+            total, n = self._sum, self._n
+        running, cum = 0, []
+        for c in counts:
+            running += c
+            cum.append(running)
+        return list(self.BOUNDS), cum, total, n
+
     def percentile(self, q):
         """q in [0, 100] → seconds (interpolated inside the bucket)."""
         with self._lock:

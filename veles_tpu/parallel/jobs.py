@@ -57,6 +57,20 @@ class SlaveDescription(object):
         #: exact even with two in flight
         self.latency = LatencyHistogram()
         self._sent_at = collections.deque()
+        #: master_clock − slave_clock in ns, estimated from heartbeat
+        #: pings carrying the slave's perf_counter stamp; the MINIMUM
+        #: observed sample is kept (one-way latency only ever inflates
+        #: the measurement) — the cluster trace merge shifts this
+        #: slave's timestamps by it
+        self.clock_offset_ns = None
+        #: heartbeat-watchdog state: warned-once latch per excursion
+        self.hb_warned = False
+
+    def observe_clock(self, sent_ns, recv_ns):
+        measured = int(recv_ns) - int(sent_ns)
+        if self.clock_offset_ns is None \
+                or measured < self.clock_offset_ns:
+            self.clock_offset_ns = measured
 
     def job_sent(self):
         self._sent_at.append(time.time())
@@ -86,6 +100,10 @@ class JobServer(Logger):
         self.heartbeat_interval = heartbeat_interval
         self.slaves = {}
         self.blacklist = set()
+        #: sid -> {"events", "ledger", "offset_ns"} shipped by slaves
+        #: at end-of-run over the job wire (op "prof"); survives
+        #: drop_slave so save_session_profile sees finished slaves
+        self.slave_profiles = {}
         self._no_more_jobs = False
         self.on_finished = None
         self._context = zmq.Context.instance()
@@ -215,17 +233,28 @@ class JobServer(Logger):
         slave = self.slaves.get(sid)
         if slave is not None:
             now = time.time()
-            if op == "ping" and trace.enabled():
-                # heartbeat gap: how stale last_seen got before this
-                # ping — creeping gaps flag a slave wedged in compute
-                # (or a master loop stalled in job generation)
-                trace.instant(
-                    "jobs", "heartbeat",
-                    {"slave": sid,
-                     "gap_ms": round((now - slave.last_seen) * 1e3,
-                                     1)},
-                    role="master")
+            if op == "ping":
+                if trace.enabled():
+                    # heartbeat gap: how stale last_seen got before
+                    # this ping — creeping gaps flag a slave wedged in
+                    # compute (or a master loop stalled in generation)
+                    trace.instant(
+                        "jobs", "heartbeat",
+                        {"slave": sid,
+                         "gap_ms": round((now - slave.last_seen) * 1e3,
+                                         1)},
+                        role="master")
+                if "t_ns" in msg:
+                    # the ping carries the slave's perf_counter stamp:
+                    # the clock-offset estimate the cluster trace
+                    # merge aligns this slave's timeline with
+                    slave.observe_clock(msg["t_ns"],
+                                        time.perf_counter_ns())
             slave.last_seen = now
+            # ANY contact ends a heartbeat-stall excursion (a slave
+            # resuming with a pending update/job_request must re-arm
+            # the once-per-excursion watchdog, not just a ping)
+            slave.hb_warned = False
         if op == "handshake":
             self._on_handshake(identity, msg)
         elif slave is None or sid in self.blacklist:
@@ -236,6 +265,8 @@ class JobServer(Logger):
             self._on_job_request(identity, slave)
         elif op == "update":
             self._on_update(identity, slave, msg)
+        elif op == "prof":
+            self._on_prof(identity, slave, msg)
         elif op == "bye":
             self.drop_slave(sid)
 
@@ -339,18 +370,79 @@ class JobServer(Logger):
         self._send(identity, {"op": "update_ack", "ok": ok})
         self._maybe_finish()
 
+    def _on_prof(self, identity, slave, msg):
+        """A slave shipped its trace-ring export + ledger summary at
+        end-of-run (piggybacked on the job wire).  Stored with the
+        heartbeat-estimated clock offset so
+        :meth:`save_session_profile` writes a merge-ready bundle."""
+        self.slave_profiles[slave.id] = {
+            "events": msg.get("events") or [],
+            "ledger": msg.get("ledger") or {},
+            "offset_ns": slave.clock_offset_ns or 0,
+        }
+        self.info("slave %s shipped its performance profile "
+                  "(%d trace event(s))", slave.id,
+                  len(self.slave_profiles[slave.id]["events"]))
+        self._send(identity, {"op": "prof_ack"})
+
+    def save_session_profile(self, path, roles=None):
+        """Write the session-profile bundle (master trace + ledger,
+        every shipped slave profile + clock offset) for ``python -m
+        veles_tpu.prof merge``.  ``roles`` restricts the master's own
+        events to the given trace roles — in-process test sessions
+        share one ring with their slaves, so the master keeps only
+        its ``master`` lanes there; real multi-process masters keep
+        everything (default).  Call AFTER the slaves ``close()`` —
+        ``finished`` fires on the last update, one round-trip before
+        each slave ships its profile."""
+        import json
+
+        from veles_tpu import prof
+        from veles_tpu.trace import export
+        events = export.normalize()
+        if roles is not None:
+            events = [ev for ev in events if ev.get("role") in roles]
+        bundle = {
+            "kind": prof.merge.BUNDLE_KIND,
+            "master": {"events": events,
+                       "ledger": prof.ledger.summary()},
+            "slaves": dict(self.slave_profiles),
+        }
+        with open(path, "w") as fout:
+            json.dump(bundle, fout)
+        return path
+
     def _reap_dead_slaves(self):
         """Timeout-based failure detection (replaces Twisted
         connectionLost, ref ``server.py:315-339``); zero-progress slaves
         are blacklisted like the reference's hung-slave sweep
-        (``:377-394``)."""
+        (``:377-394``).  Before the hard timeout, the heartbeat
+        watchdog (``root.common.engine.heartbeat_warn_ms``, default
+        off) flags creeping gaps: WARNING + ``jobs:heartbeat_stall``
+        trace instant, once per excursion."""
+        from veles_tpu.config import root
+        warn_ms = root.common.engine.get("heartbeat_warn_ms", 0) or 0
         now = time.time()
         for sid, slave in list(self.slaves.items()):
-            if now - slave.last_seen > self.slave_timeout:
+            gap = now - slave.last_seen
+            if gap > self.slave_timeout:
                 self.warning("slave %s timed out", sid)
                 if slave.jobs_done == 0:
                     self.blacklist.add(sid)
                 self.drop_slave(sid)
+                continue
+            if warn_ms and gap * 1e3 > float(warn_ms) \
+                    and not slave.hb_warned:
+                slave.hb_warned = True
+                trace.instant("jobs", "heartbeat_stall",
+                              {"slave": sid,
+                               "gap_ms": round(gap * 1e3, 1)},
+                              role="master")
+                self.warning(
+                    "slave %s heartbeat stalled: %.0f ms since last "
+                    "contact (heartbeat_warn_ms=%s; hard timeout at "
+                    "%.0f ms)", sid, gap * 1e3, warn_ms,
+                    self.slave_timeout * 1e3)
 
     def drop_slave(self, sid):
         with self._lock:
@@ -474,7 +566,8 @@ class JobClient(Logger):
                         "master silent for %.0fs during %r"
                         % (max_wait, msg.get("op")))
                 self._socket.send(pickle.dumps(
-                    {"op": "ping", "id": self.sid},
+                    {"op": "ping", "id": self.sid,
+                     "t_ns": time.perf_counter_ns()},
                     pickle.HIGHEST_PROTOCOL))
 
     def _heartbeat_loop(self, stop_event):
@@ -482,7 +575,10 @@ class JobClient(Logger):
         (replaces the reference's Twisted connection liveness)."""
         while not stop_event.wait(self.heartbeat_interval):
             try:
-                self._rpc({"op": "ping", "id": self.sid},
+                # t_ns: our perf_counter stamp — the master's clock-
+                # offset estimate for the cluster trace merge
+                self._rpc({"op": "ping", "id": self.sid,
+                           "t_ns": time.perf_counter_ns()},
                           timeout_ms=2000)
             except TimeoutError:
                 pass
@@ -628,7 +724,45 @@ class JobClient(Logger):
             if not ack.get("ok"):
                 self.warning("master refused our update")
             self.jobs_done += 1
+        self._ship_profile()
         return True
+
+    def _ship_profile(self):
+        """End-of-run: ship our trace-ring export + performance-
+        ledger summary to the master over the job wire (op ``prof``)
+        so the cluster merge sees this slave's timeline without a
+        side channel.  Only when tracing is on; best-effort in two
+        documented ways: a master torn down the moment its last
+        update landed (launcher-driven ``on_finished`` → ``stop()``)
+        may miss the shipment — keep the server up until slaves
+        ``close()`` when you want the bundle — and a process hosting
+        SEVERAL slaves shares one ring/ledger, so default-role
+        (trainer) lanes and the ledger summary cannot be split
+        between them (real deployments run one slave per process;
+        the filter below is exact there)."""
+        if not trace.enabled():
+            return
+        from veles_tpu import prof
+        from veles_tpu.trace import export
+        own_role = self.trace_role
+        # in-process sessions share ONE ring with the master (tests,
+        # single-host mixed roles): ship only our own lanes — the
+        # default-role (trainer) spans our workflow recorded plus our
+        # explicit slave-<sid> job spans; a real separate-process
+        # slave owns everything it recorded anyway
+        events = [ev for ev in export.normalize()
+                  if ev.get("role") != "master"
+                  and (not str(ev.get("role") or "").startswith(
+                      "slave-") or ev.get("role") == own_role)]
+        try:
+            reply = self._rpc({"op": "prof", "id": self.sid,
+                               "events": events,
+                               "ledger": prof.ledger.summary()})
+            if reply.get("op") != "prof_ack":
+                self.warning("master did not ack our profile: %r",
+                             reply.get("op"))
+        except (TimeoutError, ConnectionError) as exc:
+            self.warning("could not ship profile to master: %s", exc)
 
     def close(self):
         try:

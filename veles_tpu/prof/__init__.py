@@ -1,0 +1,75 @@
+"""veles_tpu.prof — the performance ledger.
+
+PR 5's tracer answers *where did the step time go*; this package
+answers *how fast should this be*.  Three pillars, one measurement
+substrate the kernel layer (ROADMAP item 4) will be tuned and gated
+against:
+
+1. **Cost accounting** (:mod:`~veles_tpu.prof.ledger`) — every
+   compiled XLA program the platform dispatches (stitched segments,
+   AOT serve buckets) registers its ``cost_analysis()`` /
+   ``memory_analysis()`` profile and accumulates dispatch wall-time,
+   yielding per-program achieved FLOP/s and — against the per-device
+   peak table — MFU.  Surfaced as ``wf.perf_report()``, bench
+   ``_wf_stage`` columns (``mfu``, ``peak_hbm_bytes``,
+   ``recompiles``) and serve ``/metrics`` gauges.
+2. **Residency + recompile sentinel**
+   (:class:`veles_tpu.memory.Watcher`'s HBM ledger +
+   :mod:`~veles_tpu.prof.sentinel`) — per-category device-memory
+   attribution (params / dataset / staging / kv) with per-Vector
+   detail, and signature fingerprinting that flags any steady-state
+   retrace (WARNING by default, ``PreflightError`` under
+   ``root.common.engine.recompile_sentinel=strict``).
+3. **Cluster merge** (:mod:`~veles_tpu.prof.merge`) — slaves ship
+   their trace ring + ledger summary over the job wire, heartbeats
+   carry clock stamps, and ``python -m veles_tpu.prof merge`` aligns
+   everything into ONE Perfetto timeline plus a cluster report
+   (per-slave MFU, straggler spread, aggregate HBM).
+
+See ``docs/observability.md`` § Performance ledger.
+"""
+
+from veles_tpu.prof.ledger import (  # noqa: F401
+    CATEGORIES, DEFAULT_CATEGORY, LedgerEntry, PerfLedger, cost_of,
+    device_kind, entries_from_events, ledger, peak_flops,
+    report_from_events, report_text, span_cost_args)
+from veles_tpu.prof.sentinel import (  # noqa: F401
+    fingerprint, flag_recompile, flagged)
+from veles_tpu.prof import merge  # noqa: F401
+
+
+def summary():
+    """The live ledger digest (see :meth:`PerfLedger.summary`)."""
+    return ledger.summary()
+
+
+def metrics_text():
+    """Prometheus-style gauge lines for the serve ``/metrics`` page:
+    compile/recompile counters, dispatched flops, and the HBM ledger
+    by category.  Families stay contiguous (exposition contract)."""
+    from veles_tpu.memory import Watcher
+    hbm = Watcher.hbm_ledger()
+    lines = [
+        "# HELP veles_prof_compiles_total XLA programs compiled "
+        "(veles_tpu.prof ledger)",
+        "# TYPE veles_prof_compiles_total counter",
+        "veles_prof_compiles_total %d" % ledger.compile_events,
+        "# HELP veles_prof_recompiles_total steady-state recompiles "
+        "flagged by the sentinel",
+        "# TYPE veles_prof_recompiles_total counter",
+        "veles_prof_recompiles_total %d" % ledger.recompiles,
+        "# TYPE veles_prof_flops_dispatched_total counter",
+        "veles_prof_flops_dispatched_total %d"
+        % int(ledger.flops_dispatched),
+        "# HELP veles_prof_hbm_bytes device-resident bytes by ledger "
+        "category",
+        "# TYPE veles_prof_hbm_bytes gauge",
+    ]
+    for cat in CATEGORIES:
+        info = hbm["by_category"].get(cat)
+        if info:
+            lines.append('veles_prof_hbm_bytes{category="%s"} %d'
+                         % (cat, info["bytes"]))
+    lines.append("# TYPE veles_prof_hbm_peak_bytes gauge")
+    lines.append("veles_prof_hbm_peak_bytes %d" % hbm["peak_bytes"])
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,452 @@
+"""The performance ledger: per-program cost entries + dispatch clocks.
+
+One process-wide :class:`PerfLedger` mirrors the reference platform's
+autotuned kernel ratings DB (PAPER.md §L0/L1: per-``DeviceInfo`` ratings
+keyed by kernel/block-size) at the granularity this platform actually
+dispatches: **whole compiled XLA programs** — stitched segments
+(:mod:`veles_tpu.stitch`) and AOT serve buckets
+(:mod:`veles_tpu.serve.engine`).  Each compile point registers a
+:class:`LedgerEntry` holding the executable's own static cost profile
+(``compiled.cost_analysis()``: flops, bytes accessed;
+``memory_analysis()``: argument/output/temp bytes) and every dispatch
+adds one wall-clock turnaround, so the ledger can state *achieved*
+FLOP/s per program and — when the per-device peak table has an entry
+for the attached accelerator — MFU.  On CPU backends there is no peak
+entry, so entries honestly report flops/bytes/wall only (the ISSUE's
+"CPU fallback").
+
+Recording discipline matches :mod:`veles_tpu.trace`: dispatch
+accounting is two ``perf_counter_ns`` reads and integer adds on the
+already-dispatching thread — orders of magnitude below one XLA
+dispatch — and is therefore always on (no knob); compile registration
+happens at most a handful of times per process and may do real work
+(cost analysis, fingerprinting).
+
+Dispatch wall-time caveat (same one the trace span carries): a
+turnaround measures host dispatch-to-dispatch time.  Under JAX async
+dispatch a single turnaround can return before the device finishes,
+but back-to-back steady-state dispatches backpressure on the stream,
+so per-entry rates over many dispatches converge on device throughput
+— and warmup compiles are excluded by construction (the compile's own
+turnaround is recorded separately from steady dispatches).
+"""
+
+import threading
+
+#: HBM-ledger category a Vector carries when nobody tagged it
+DEFAULT_CATEGORY = "other"
+
+#: the attribution buckets the HBM ledger reports, in render order
+#: ("kv" is reserved for the serving KV cache, ROADMAP item 3)
+CATEGORIES = ("params", "dataset", "staging", "kv", DEFAULT_CATEGORY)
+
+
+def cost_of(compiled):
+    """Static cost profile of a compiled XLA executable: ``{"flops",
+    "bytes_accessed", "arg_bytes", "out_bytes", "temp_bytes"}`` —
+    every key present, missing analyses zeroed (some backends return
+    no cost model; the entry then reports dispatch clocks only)."""
+    cost = {"flops": 0.0, "bytes_accessed": 0.0,
+            "arg_bytes": 0, "out_bytes": 0, "temp_bytes": 0}
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        cost["flops"] = float(analysis.get("flops", 0.0) or 0.0)
+        cost["bytes_accessed"] = float(
+            analysis.get("bytes accessed", 0.0) or 0.0)
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        cost["arg_bytes"] = int(mem.argument_size_in_bytes)
+        cost["out_bytes"] = int(mem.output_size_in_bytes)
+        cost["temp_bytes"] = int(mem.temp_size_in_bytes)
+    except Exception:
+        pass
+    return cost
+
+
+def span_cost_args(compiled, base):
+    """The ONE schema for cost-bearing trace args at a compile point
+    (segment ``compile`` instants, serve ``compile_bucket`` spans):
+    ``base`` + flops / ``bytes`` / arg/out/temp bytes / peak_flops.
+    :func:`entries_from_events` parses these keys — both compile
+    points must emit through here or the offline report silently
+    loses half its entries.  Returns ``(cost_dict, span_args)``."""
+    cost = cost_of(compiled)
+    args = dict(base)
+    args.update(cost)
+    args["bytes"] = args.pop("bytes_accessed")
+    peak = peak_flops()
+    if peak:
+        args["peak_flops"] = peak
+    return cost, args
+
+
+def device_kind():
+    """The attached accelerator's device kind (``jax.devices()[0]``),
+    or ``None`` when no backend initializes."""
+    try:
+        import jax
+        return jax.devices()[0].device_kind
+    except Exception:
+        return None
+
+
+def peak_flops(kind=None):
+    """Per-device peak dense FLOP/s — the MFU denominator.  The table
+    is :data:`veles_tpu.backends.PEAK_BF16_FLOPS` (TPU generations);
+    CPU and unknown kinds return ``None`` so entries degrade to
+    flops/bytes-only reporting instead of inventing an MFU."""
+    from veles_tpu.backends import peak_bf16_flops
+    if kind is None:
+        kind = device_kind()
+    return peak_bf16_flops(kind) if kind else None
+
+
+class LedgerEntry(object):
+    """One compiled program's running cost account."""
+
+    __slots__ = ("kind", "name", "cost", "compiles", "recompiles",
+                 "dispatches", "dispatch_ns")
+
+    def __init__(self, kind, name):
+        self.kind = kind            # "segment" | "bucket"
+        self.name = name
+        self.cost = None            # cost_of() dict after first compile
+        self.compiles = 0
+        self.recompiles = 0         # compiles AFTER the first = retraces
+        self.dispatches = 0
+        self.dispatch_ns = 0
+
+    @property
+    def flops(self):
+        return self.cost["flops"] if self.cost else 0.0
+
+    @property
+    def bytes_accessed(self):
+        return self.cost["bytes_accessed"] if self.cost else 0.0
+
+    def achieved_flops(self):
+        """Achieved FLOP/s over all recorded dispatches (0 when the
+        entry has no flops or no timed dispatch)."""
+        if not self.dispatch_ns or not self.flops:
+            return 0.0
+        return self.flops * self.dispatches / (self.dispatch_ns / 1e9)
+
+    def mfu(self, peak):
+        if not peak:
+            return None
+        achieved = self.achieved_flops()
+        return achieved / peak if achieved else None
+
+    def row(self, peak):
+        """JSON-able summary row (the ``perf_report()`` line)."""
+        wall_ms = self.dispatch_ns / 1e6
+        mfu = self.mfu(peak)
+        return {
+            "kind": self.kind, "name": self.name,
+            "flops": self.flops, "bytes": self.bytes_accessed,
+            "temp_bytes": (self.cost or {}).get("temp_bytes", 0),
+            "compiles": self.compiles, "recompiles": self.recompiles,
+            "dispatches": self.dispatches,
+            "wall_ms": round(wall_ms, 3),
+            "achieved_flops": round(self.achieved_flops(), 1),
+            "mfu": round(mfu, 6) if mfu is not None else None,
+        }
+
+
+class PerfLedger(object):
+    """Process-wide registry of :class:`LedgerEntry`\\ s + totals.
+
+    ``flops_dispatched`` and ``recompiles`` are running counters bench
+    reads as deltas around a timed region (like the trace recorder's
+    wraparound-proof counts)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self.compile_events = 0
+        self.recompiles = 0
+        self.flops_dispatched = 0.0
+
+    def entry(self, kind, name):
+        key = (kind, name)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                entry = self._entries[key] = LedgerEntry(kind, name)
+        return entry
+
+    def entries(self, kind=None):
+        with self._lock:
+            items = list(self._entries.values())
+        if kind is not None:
+            items = [e for e in items if e.kind == kind]
+        return items
+
+    # -- recording ----------------------------------------------------------
+    def record_compile(self, entry, compiled=None, cost=None,
+                       steady=False):
+        """Register a compile on ``entry``.  The CALLER decides
+        ``steady`` — a rebuilt segment's (or redeployed engine's)
+        first compile is warmup, only a compile of an already-warmed
+        program is a steady-state recompile (the sentinel decides how
+        loudly to complain — this only counts)."""
+        if cost is None and compiled is not None:
+            cost = cost_of(compiled)
+        with self._lock:
+            entry.cost = cost or entry.cost
+            entry.compiles += 1
+            self.compile_events += 1
+            if steady:
+                entry.recompiles += 1
+                self.recompiles += 1
+        return steady
+
+    def record_dispatch(self, entry, dur_ns):
+        """The hot-path hook: one turnaround on ``entry``.  GIL-cheap
+        integer adds, no lock (single dispatching thread per entry;
+        totals tolerate the rare lost update)."""
+        entry.dispatches += 1
+        entry.dispatch_ns += int(dur_ns)
+        flops = entry.flops
+        if flops:
+            self.flops_dispatched += flops
+
+    # -- reading ------------------------------------------------------------
+    def summary(self):
+        """The JSON-able digest ``perf_report()`` renders and slaves
+        ship to the master over the job wire."""
+        from veles_tpu.memory import Watcher
+        kind = device_kind()
+        peak = peak_flops(kind)
+        rows = [entry.row(peak) for entry in self.entries()]
+        rows.sort(key=lambda r: (r["kind"], -r["wall_ms"], r["name"]))
+        dispatch_ns = sum(e.dispatch_ns for e in self.entries())
+        achieved = (self.flops_dispatched / (dispatch_ns / 1e9)
+                    if dispatch_ns else 0.0)
+        return {
+            "device_kind": kind,
+            "peak_flops": peak,
+            "entries": rows,
+            "totals": {
+                "compiles": self.compile_events,
+                "recompiles": self.recompiles,
+                "flops_dispatched": self.flops_dispatched,
+                "dispatch_ms": round(dispatch_ns / 1e6, 3),
+                "achieved_flops": round(achieved, 1),
+                "mfu": (round(achieved / peak, 6)
+                        if peak and achieved else None),
+            },
+            "hbm": Watcher.hbm_ledger(),
+        }
+
+    def reset(self):
+        with self._lock:
+            self._entries = {}
+            self.compile_events = 0
+            self.recompiles = 0
+            self.flops_dispatched = 0.0
+
+
+#: THE process-wide ledger every compile point and reporter shares
+ledger = PerfLedger()
+
+
+# -- offline reconstruction -------------------------------------------------
+
+def entries_from_events(events):
+    """Rebuild ledger-like rows from exported trace events — compile
+    instants/spans carry the cost profile in their args (``flops``,
+    ``bytes``, ``peak_flops``), dispatch spans carry the wall time —
+    so ``python -m veles_tpu.prof trace.json`` reports per-segment
+    MFU offline, no live process needed.  Returns ``(rows,
+    peak_flops)``."""
+    costs = {}          # (kind, name) -> {"flops", "bytes", ...}
+    clocks = {}         # (kind, name) -> [dispatches, dur_us]
+    compiles = {}
+    recompiles = {}     # steadiness is IN-BAND ("recompile" arg) —
+    # a rebuild_stitching re-walk legitimately compiles a same-named
+    # segment again and must not read as a steady-state retrace
+    compile_ts = {}     # (kind, name) -> [instant timestamps]
+
+    def _segment_key(args):
+        return ("segment", args.get("segment", "?"))
+
+    def _bucket_key(args):
+        # keyed per engine (the live ledger's entry name) so two
+        # engines' same-size buckets — a model reload — are not
+        # conflated into phantom recompiles
+        return ("bucket", "%s[b%s]" % (args.get("engine", "bucket"),
+                                       args["bucket"]))
+
+    # pass 1: compile events.  A separate pass on purpose — the clock
+    # pass excludes dispatch spans by compile containment, and a
+    # time-sorted input (the cluster merge sorts by ts_us) puts a
+    # span's exit record BEFORE the compile instant it contains.
+    peak = None
+    for ev in events:
+        args = ev.get("args") or {}
+        if ev["cat"] == "segment" and ev["name"] == "compile":
+            key = _segment_key(args)
+            compile_ts.setdefault(key, []).append(ev["ts_us"])
+        elif ev["cat"] == "serve" \
+                and ev["name"] == "compile_bucket" \
+                and "bucket" in args:
+            key = _bucket_key(args)
+        else:
+            continue
+        compiles[key] = compiles.get(key, 0) + 1
+        if args.get("recompile"):
+            recompiles[key] = recompiles.get(key, 0) + 1
+        if "flops" in args:
+            costs[key] = args
+        if args.get("peak_flops"):
+            peak = args["peak_flops"]
+    # pass 2: dispatch clocks
+    for ev in events:
+        if ev["ph"] != "X":
+            continue
+        args = ev.get("args") or {}
+        if ev["cat"] == "segment" and ev["name"] == "dispatch":
+            key = _segment_key(args)
+            # a dispatch span that CONTAINS a compile instant is the
+            # warmup turnaround (the AOT lower+compile runs inside
+            # it) — exclude it from the clock exactly like the live
+            # ledger does, or achieved-FLOP/s drowns in compile time
+            lo, hi = ev["ts_us"], ev["ts_us"] + ev["dur_us"]
+            if any(lo <= ts <= hi for ts in compile_ts.get(key, ())):
+                continue
+        elif ev["cat"] == "serve" and ev["name"] == "infer_chunk" \
+                and "bucket" in args:
+            key = _bucket_key(args)
+        else:
+            continue
+        n, dur = clocks.get(key, (0, 0.0))
+        clocks[key] = (n + 1, dur + ev["dur_us"])
+    rows = []
+    for key in sorted(set(costs) | set(clocks) | set(compiles)):
+        kind, name = key
+        args = costs.get(key, {})
+        n, dur_us = clocks.get(key, (0, 0.0))
+        flops = float(args.get("flops", 0.0) or 0.0)
+        achieved = (flops * n / (dur_us / 1e6)) if dur_us and flops \
+            else 0.0
+        rows.append({
+            "kind": kind, "name": name, "flops": flops,
+            "bytes": float(args.get("bytes", 0.0) or 0.0),
+            "temp_bytes": int(args.get("temp_bytes", 0) or 0),
+            "compiles": compiles.get(key, 0),
+            "recompiles": recompiles.get(key, 0),
+            "dispatches": n, "wall_ms": round(dur_us / 1e3, 3),
+            "achieved_flops": round(achieved, 1),
+            "mfu": (round(achieved / peak, 6)
+                    if peak and achieved else None),
+        })
+    return rows, peak
+
+
+# -- rendering --------------------------------------------------------------
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return ("%.1f %s" if unit != "B" else "%d %s") % (n, unit)
+        n /= 1024.0
+
+
+def _fmt_mfu(mfu):
+    return "%6.2f%%" % (100.0 * mfu) if mfu is not None else "      -"
+
+
+def render_rows(rows, peak, kind=None):
+    lines = []
+    for row in rows:
+        if kind is not None and row["kind"] != kind:
+            continue
+        lines.append(
+            "  %-36s %10.3e fl %9.3e B  %4dx %9.3f ms %10.1f MFLOP/s"
+            " %s%s"
+            % (row["name"][:36], row["flops"], row["bytes"],
+               row["dispatches"], row["wall_ms"],
+               row["achieved_flops"] / 1e6, _fmt_mfu(row["mfu"]),
+               ("  [%d recompile(s)]" % row["recompiles"])
+               if row["recompiles"] else ""))
+    return lines
+
+
+def report_text(summary_dict=None):
+    """The human ``perf_report()``: per-segment / per-bucket cost
+    rows, compile + recompile totals, and the HBM ledger."""
+    digest = summary_dict if summary_dict is not None \
+        else ledger.summary()
+    peak = digest.get("peak_flops")
+    kind = digest.get("device_kind")
+    head = "veles_tpu.prof performance ledger — device %s" % (
+        kind or "<none>")
+    head += (" (peak %.1f TFLOP/s bf16)" % (peak / 1e12) if peak
+             else " (no peak table entry: flops/bytes only, no MFU)")
+    lines = [head]
+    rows = digest.get("entries", [])
+    segments = [r for r in rows if r["kind"] == "segment"]
+    buckets = [r for r in rows if r["kind"] == "bucket"]
+    if segments:
+        lines.append("")
+        lines.append("stitched segments (per dispatch):")
+        lines.extend(render_rows(segments, peak))
+    if buckets:
+        lines.append("")
+        lines.append("serve buckets (per call):")
+        lines.extend(render_rows(buckets, peak))
+    if not rows:
+        lines.append("")
+        lines.append("  (no compiled programs registered — run a "
+                     "stitched workflow or warm a serve engine first)")
+    totals = digest.get("totals", {})
+    lines.append("")
+    lines.append(
+        "compiles: %d total, %d steady-state recompile(s)%s" % (
+            totals.get("compiles", 0), totals.get("recompiles", 0),
+            "" if not totals.get("recompiles")
+            else "  <-- investigate: steady state must not retrace"))
+    if totals.get("mfu") is not None:
+        lines.append("aggregate: %.3e FLOPs dispatched over %.3f ms "
+                     "-> MFU %.2f%%"
+                     % (totals.get("flops_dispatched", 0.0),
+                        totals.get("dispatch_ms", 0.0),
+                        100.0 * totals["mfu"]))
+    hbm = digest.get("hbm")
+    if hbm:
+        lines.append("")
+        lines.append("HBM ledger: %s in use, %s peak" % (
+            _fmt_bytes(hbm["bytes_in_use"]),
+            _fmt_bytes(hbm["peak_bytes"])))
+        for cat in CATEGORIES:
+            info = hbm["by_category"].get(cat)
+            if info and (info["bytes"] or info["peak"]):
+                lines.append("  %-8s %12s in use  %12s peak"
+                             % (cat, _fmt_bytes(info["bytes"]),
+                                _fmt_bytes(info["peak"])))
+        for vec in hbm.get("top_vectors", ()):
+            lines.append("    %-10s %-22s %s"
+                         % (vec["category"],
+                            "%s %s" % (vec["shape"], vec["dtype"]),
+                            _fmt_bytes(vec["nbytes"])))
+    return "\n".join(lines) + "\n"
+
+
+def report_from_events(events):
+    """Offline ``report_text`` over exported trace events (the
+    ``python -m veles_tpu.prof trace.json`` path)."""
+    rows, peak = entries_from_events(events)
+    compiles = sum(r["compiles"] for r in rows)
+    recompiles = sum(r["recompiles"] for r in rows)
+    return report_text({
+        "device_kind": None if peak is None else "(from trace)",
+        "peak_flops": peak,
+        "entries": rows,
+        "totals": {"compiles": compiles, "recompiles": recompiles},
+        "hbm": None,
+    })

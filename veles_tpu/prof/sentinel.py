@@ -1,0 +1,119 @@
+"""The recompile sentinel: fingerprint every trace/compile event and
+scream on steady-state retraces.
+
+A stray retrace on the stitched path (a Python scalar whose type
+flipped, a Vector silently reshaped, an unhashable static arg) used to
+show up only as an unexplained slow dispatch.  The sentinel closes
+that hole at the two compile points the platform has:
+
+* **stitched segments** — the first dispatch lowers + AOT-compiles the
+  fused program and fingerprints its abstract signature (shapes,
+  dtypes, weak-types, scalar kinds).  Every later dispatch runs the
+  AOT executable, which *enforces* the signature: a drifted call
+  raises instead of silently retracing, the sentinel flags it (trace
+  instant + WARNING, or :class:`veles_tpu.analyze.PreflightError`
+  under the strict knob), and the segment recompiles once so
+  correctness never depends on the knob.
+* **serve buckets** — :meth:`InferenceEngine.warmup` marks the engine
+  warmed; any bucket compile after that is by definition a
+  steady-state recompile and is flagged the same way.
+
+The knob: ``root.common.engine.recompile_sentinel = off | warn
+(default) | strict``.  ``warn`` logs + emits a ``prof:recompile``
+trace instant; ``strict`` additionally raises ``PreflightError`` (the
+CI posture: a retrace in a gated run is a bug, not a log line).
+"""
+
+import logging
+
+from veles_tpu import trace
+from veles_tpu.config import root
+
+#: the sentinel's rule id in flagged findings (the analyzer catalog's
+#: static V-J09 retrace-hazard rule is this check's compile-time twin)
+RULE = "V-P01"
+
+
+def mode():
+    """``off`` | ``warn`` | ``strict`` (default ``warn``)."""
+    value = str(root.common.engine.get("recompile_sentinel",
+                                       "warn")).lower()
+    return value if value in ("off", "warn", "strict") else "warn"
+
+
+def fingerprint(tree):
+    """Abstract signature of a call's argument pytree: per-leaf
+    ``(dtype, shape)`` for arrays, the python type name for scalars
+    (``int`` vs ``float`` IS a retrace), plus the tree structure.
+    Hashable and comparable across calls."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((str(dtype), tuple(shape)))
+        else:
+            sig.append(type(leaf).__name__)
+    return (str(treedef), tuple(sig))
+
+
+def diff(old, new):
+    """Human one-liner naming the first drifted leaf between two
+    fingerprints (the part of the WARNING someone actually reads)."""
+    if old is None:
+        return "no prior fingerprint"
+    if old[0] != new[0]:
+        return "argument tree structure changed"
+    for i, (a, b) in enumerate(zip(old[1], new[1])):
+        if a != b:
+            return "leaf %d changed %s -> %s" % (i, a, b)
+    if len(old[1]) != len(new[1]):
+        return "leaf count changed %d -> %d" % (len(old[1]),
+                                                len(new[1]))
+    return "signature identical (backend-forced recompile)"
+
+
+#: flagged steady-state recompiles this process, newest last:
+#: ``{"site", "detail"}`` dicts (tests and the smoke gate read this)
+flagged = []
+
+_logger = logging.getLogger("veles_tpu.prof")
+
+
+def flag_recompile(site, old_fp, new_fp, logger=None, detail=None):
+    """A steady-state recompile happened at ``site``.  Always records
+    (the ledger already counted it); ``warn``/``strict`` modes emit
+    the trace instant + WARNING; ``strict`` raises
+    :class:`~veles_tpu.analyze.PreflightError` AFTER flagging, so the
+    event is on the timeline either way.  ``detail`` overrides the
+    fingerprint diff (compile points without signature fingerprints —
+    the serve buckets — say what happened in their own words)."""
+    if detail is None:
+        detail = diff(old_fp, new_fp)
+    event = {"site": site, "detail": detail}
+    flagged.append(event)
+    if mode() == "off":
+        return
+    trace.instant("prof", "recompile", dict(event))
+    (logger or _logger).warning(
+        "%s: steady-state recompile at %s: %s — a warmed program "
+        "retraced; root.common.engine.recompile_sentinel=strict "
+        "turns this into an error", RULE, site, detail)
+    if mode() == "strict":
+        from veles_tpu.analyze import PreflightError
+        from veles_tpu.analyze.findings import Finding, Report
+        raise PreflightError(Report(
+            [Finding("error", RULE,
+                     "steady-state recompile at %s: %s"
+                     % (site, detail),
+                     fix="stabilize the call signature (pass varying "
+                         "python scalars as traced args, keep Vector "
+                         "shapes fixed after warmup)")],
+            passes=["prof.sentinel"]))
+
+
+def reset():
+    """Drop flagged events (test isolation)."""
+    del flagged[:]

@@ -29,12 +29,17 @@ real rows and the sliced result is byte-identical to the un-batched
 forward — asserted in ``tests/test_serve.py``.
 """
 
+import itertools
 import threading
+import time
 
 import numpy
 
-from veles_tpu import trace
+from veles_tpu import prof, trace
 from veles_tpu.logger import Logger
+
+#: per-process engine sequence for performance-ledger entry names
+_ENGINE_SEQ = itertools.count()
 
 
 def infer_sample_shape(workflow, forwards):
@@ -138,6 +143,12 @@ class InferenceEngine(Logger):
         self._compile_lock = threading.Lock()
         self.compile_count = 0
         self.infer_calls = 0         # device calls (monitoring)
+        #: performance-ledger identity + per-bucket entries
+        self.prof_name = "engine%d" % next(_ENGINE_SEQ)
+        self._prof_entries = {}      # batch size -> LedgerEntry
+        #: set by warmup(); a bucket compile after this is by
+        #: definition a steady-state recompile (the sentinel flags it)
+        self._warmed = False
 
     # -- constructors -----------------------------------------------------
     @classmethod
@@ -272,20 +283,55 @@ class InferenceEngine(Logger):
             params_spec = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 self._params)
-            with trace.span("serve", "compile_bucket",
-                            {"bucket": batch_size}, role="server"):
+            span_args = {"bucket": batch_size,
+                         "engine": self.prof_name}
+            with trace.span("serve", "compile_bucket", span_args,
+                            role="server"):
                 exe = self._jit.lower(params_spec, spec).compile()
+                # cost rides the span args (recorded at span exit) so
+                # an exported trace is a self-contained perf report —
+                # same schema as the segment compile instants
+                cost, new_args = prof.span_cost_args(exe, span_args)
+                span_args.update(new_args)
+                if self._warmed:
+                    # in-band steadiness for the offline report
+                    span_args["recompile"] = True
             self.compile_count += 1
+            entry = self._prof_entries.get(batch_size)
+            if entry is None:
+                entry = self._prof_entries[batch_size] = \
+                    prof.ledger.entry(
+                        "bucket", "%s[b%d]" % (self.prof_name,
+                                               batch_size))
+            prof.ledger.record_compile(entry, cost=cost,
+                                       steady=self._warmed)
             self.debug("compiled bucket %d (compile #%d)", batch_size,
                        self.compile_count)
+            # cache BEFORE the sentinel can raise: in strict mode the
+            # compile fails the request loudly exactly once — later
+            # requests serve from the cached executable instead of
+            # re-paying (and re-failing) a full XLA compile per call
             self._compiled[batch_size] = exe
+            if self._warmed:
+                # warmup() promised zero steady-state compiles — an
+                # unwarmed batch shape reached the engine
+                prof.flag_recompile(
+                    "serve:%s:bucket[%d]" % (self.prof_name,
+                                             batch_size),
+                    None, None, logger=self,
+                    detail="bucket %d compiled after warmup() — the "
+                           "batch reached a shape no warmed bucket "
+                           "covers" % batch_size)
         return exe
 
     def warmup(self):
         """AOT-compile every bucket; returns self (chainable).  After
-        this, serving any batch size never triggers a compile."""
+        this, serving any batch size never triggers a compile — and
+        the recompile sentinel holds the engine to it: any later
+        bucket compile is flagged as a steady-state recompile."""
         for b in self.buckets:
             self._executable(b)
+        self._warmed = True
         return self
 
     def padded_capacity(self, n):
@@ -366,6 +412,13 @@ class InferenceEngine(Logger):
             chunk = padded
         exe = self._executable(bucket)
         self.infer_calls += 1
-        with trace.span("serve", "infer_chunk", role="server"):
+        with trace.span("serve", "infer_chunk",
+                        {"bucket": bucket, "engine": self.prof_name},
+                        role="server"):
+            tic = time.perf_counter_ns()
             out = numpy.asarray(exe(self._params, chunk))
+            entry = self._prof_entries.get(bucket)
+            if entry is not None:
+                prof.ledger.record_dispatch(
+                    entry, time.perf_counter_ns() - tic)
         return out[:n]

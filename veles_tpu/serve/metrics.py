@@ -149,6 +149,9 @@ class ServingMetrics(object):
         emit("batches_total", snap["batches_total"])
         emit("batch_fill_ratio", snap["batch_fill_ratio"],
              "served rows / summed bucket capacity")
+        # the percentile text lines stay: the web status page (and
+        # humans) read them; Prometheus scrapers get the real
+        # histogram families below
         for key, value in snap["latency_ms"].items():
             emit("request_latency_ms{quantile=\"%s\"}" % key, value)
         for key, value in snap["batch_latency_ms"].items():
@@ -156,4 +159,29 @@ class ServingMetrics(object):
         for name, _fn in self._gauge_items():
             if name in snap:
                 emit(name, snap[name])
+        self._emit_histogram(lines, "request_latency_seconds",
+                             self.request_latency,
+                             "request enqueue->reply latency")
+        self._emit_histogram(lines, "batch_latency_seconds",
+                             self.batch_latency,
+                             "coalesced device-call latency")
         return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _emit_histogram(lines, name, hist, help_):
+        """Prometheus histogram exposition for a
+        :class:`~veles_tpu.metrics.LatencyHistogram`: cumulative
+        ``le``-labeled buckets + ``_sum``/``_count``, one contiguous
+        family (the exposition-format contract) — real quantile math
+        happens server-side (``histogram_quantile``) instead of
+        trusting our interpolated percentile lines."""
+        bounds, cum, total, count = hist.cumulative()
+        lines.append("# HELP veles_serve_%s %s" % (name, help_))
+        lines.append("# TYPE veles_serve_%s histogram" % name)
+        for bound, c in zip(bounds, cum):
+            lines.append('veles_serve_%s_bucket{le="%.6g"} %d'
+                         % (name, bound, c))
+        lines.append('veles_serve_%s_bucket{le="+Inf"} %d'
+                     % (name, count))
+        lines.append("veles_serve_%s_sum %.6f" % (name, total))
+        lines.append("veles_serve_%s_count %d" % (name, count))

@@ -172,7 +172,11 @@ class ServingServer(Logger):
                     self._reply_json(*server.healthz())
                 elif self.path == "/metrics":
                     body = server.metrics.render_text()
-                    from veles_tpu import trace
+                    from veles_tpu import prof, trace
+                    # performance-ledger gauges (compile/recompile
+                    # counters, HBM by category) are always cheap and
+                    # always on — the ledger has no knob
+                    body += prof.metrics_text()
                     if trace.enabled():
                         # the trace's compact per-category counters
                         # ride the same exposition page

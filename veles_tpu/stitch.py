@@ -47,9 +47,11 @@ Segment eligibility (checked per chain link ``u → v``):
 execution path byte for byte (segments are simply not built).
 """
 
+import time
+
 import jax
 
-from veles_tpu import trace
+from veles_tpu import prof, trace
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
 from veles_tpu.memory import Vector
@@ -114,6 +116,22 @@ class StitchSegment(Logger):
         self._member_ids = frozenset(id(u) for u in self.units[1:])
         self._build_plan()
         self._jitted = jax.jit(self._program, donate_argnums=(2,))
+        #: the AOT executable installed by the first dispatch; it
+        #: ENFORCES the traced signature, so a drifted call raises
+        #: (and the recompile sentinel flags it) instead of silently
+        #: retracing into an unexplained slow step
+        self._compiled = None
+        self._fingerprint = None
+        #: fingerprint -> executable, mirroring the jit cache the AOT
+        #: path replaced: a segment legitimately ALTERNATING between
+        #: known signatures swaps executables (flagged once when each
+        #: new signature first appeared) instead of recompiling — and
+        #: being re-flagged — on every flip
+        self._compiled_cache = {}
+        #: performance-ledger entry (veles_tpu.prof): cost_analysis
+        #: flops/bytes from the compiled program + dispatch clocks
+        self.prof_entry = prof.ledger.entry("segment",
+                                            "+".join(self.names))
         #: static span args, allocated once (the dispatch hot path
         #: must not build a dict per call)
         self._trace_args = {"segment": "+".join(self.names)}
@@ -217,19 +235,48 @@ class StitchSegment(Logger):
         return outputs, new_don, metrics
 
     @property
+    def recompiles(self):
+        """Steady-state recompiles of THIS segment's program (ledger
+        entry; the sentinel flags each one as it happens)."""
+        return self.prof_entry.recompiles
+
+    @property
     def has_prelude(self):
         """True for loader-headed segments (a stage carries host
         serving bookkeeping executed before each dispatch)."""
         return any(stage.prelude is not None for stage in self.stages)
 
+    # -- compilation --------------------------------------------------------
+    def _compile(self, args, steady=False):
+        """Lower + AOT-compile the fused program for ``args``'
+        signature, fingerprint it, and register the executable's cost
+        profile (``cost_analysis`` flops / bytes,
+        ``memory_analysis``) with the performance ledger.  The
+        ``compile`` instant carries the cost in its args so an
+        exported trace stays a self-contained perf report
+        (``python -m veles_tpu.prof run.json``)."""
+        lowered = self._jitted.lower(*args)
+        compiled = lowered.compile()
+        self._fingerprint = prof.fingerprint(args)
+        self._compiled = compiled
+        self._compiled_cache[self._fingerprint] = compiled
+        cost, span_args = prof.span_cost_args(compiled,
+                                              self._trace_args)
+        prof.ledger.record_compile(self.prof_entry, cost=cost,
+                                   steady=steady)
+        if steady:
+            # in-band steadiness: the offline report must not have to
+            # guess which compile events were legitimate warmup (a
+            # rebuild_stitching re-walk) vs flagged retraces
+            span_args["recompile"] = True
+        # the instant marks warmup (or a flagged retrace) on the
+        # timeline so a report never mistakes it for steady state
+        trace.instant("segment", "compile", span_args)
+        return compiled
+
     # -- execution ----------------------------------------------------------
     def execute(self):
         """Dispatch the whole segment as one program and publish."""
-        if self.dispatches == 0:
-            # the first dispatch pays the XLA trace+compile of the
-            # fused program; the instant marks it on the timeline so a
-            # report never mistakes warmup for steady state
-            trace.instant("segment", "compile", self._trace_args)
         with trace.span("segment", "dispatch", self._trace_args):
             # the nested host_prep span breaks out the host share of a
             # turnaround (preludes + devmem gathering + scalar
@@ -259,8 +306,47 @@ class StitchSegment(Logger):
                     scalars.extend(
                         values[n] if isinstance(values[n], int)
                         else float(values[n]) for n in names)
-            outputs, new_don, metrics = self._jitted(
-                inputs, ro, don, tuple(scalars))
+            args = (inputs, ro, don, tuple(scalars))
+            if self._compiled is None:
+                # first dispatch: trace+compile once, run the AOT
+                # executable from here on — it enforces the signature.
+                # The clock starts AFTER the compile: warmup must not
+                # pollute the entry's achieved-FLOP/s.
+                self._compile(args)
+                tic = time.perf_counter_ns()
+                outputs, new_don, metrics = self._compiled(*args)
+            else:
+                tic = time.perf_counter_ns()
+                try:
+                    outputs, new_don, metrics = self._compiled(*args)
+                except TypeError as exc:
+                    # the AOT executable rejected a drifted signature
+                    # — exactly the silent steady-state retrace the
+                    # jit path would have absorbed.  A signature seen
+                    # BEFORE swaps its cached executable back in
+                    # (alternation is not a recompile, and was
+                    # flagged when it first appeared); a NEW one
+                    # compiles + counts + flags (WARNING, or
+                    # PreflightError under the strict knob — raised
+                    # AFTER the ledger counted, so /metrics and bench
+                    # recompile columns never contradict the error).
+                    # Either way correctness never depends on the
+                    # sentinel mode; the donated buffers were not
+                    # consumed by the failed call.
+                    self.debug("retrace detail: %s", exc)
+                    old_fp = self._fingerprint
+                    fp = prof.fingerprint(args)
+                    cached = self._compiled_cache.get(fp)
+                    if cached is not None:
+                        self._compiled = cached
+                        self._fingerprint = fp
+                    else:
+                        self._compile(args, steady=True)
+                        prof.flag_recompile(
+                            "segment:%s" % "+".join(self.names),
+                            old_fp, fp, logger=self)
+                    tic = time.perf_counter_ns()
+                    outputs, new_don, metrics = self._compiled(*args)
             for vec, arr in zip(self._output_vecs, outputs):
                 vec.devmem = arr
             for vec, arr in zip(self._don_vecs, new_don):
@@ -268,6 +354,8 @@ class StitchSegment(Logger):
             for (unit, name), value in zip(self._metric_spec, metrics):
                 setattr(unit, name, value)
             self.dispatches += 1
+            prof.ledger.record_dispatch(
+                self.prof_entry, time.perf_counter_ns() - tic)
             self._computed = set(self._member_ids)
 
     def member_run(self, unit):

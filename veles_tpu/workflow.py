@@ -261,6 +261,17 @@ class Workflow(Unit):
                               for segment in self._stitch_segments_),
         }
 
+    def perf_report(self):
+        """Text summary of the performance ledger
+        (:mod:`veles_tpu.prof`): per-segment (and per-serve-bucket)
+        flops / bytes / dispatch wall-time / achieved FLOP/s — MFU
+        when the attached device has a peak-table entry — plus
+        compile/recompile totals and the per-category HBM ledger.
+        Always available (dispatch accounting has no knob); pair with
+        ``trace_report()`` for the where-did-the-time-go view."""
+        from veles_tpu import prof
+        return prof.report_text()
+
     def trace_report(self, top=10):
         """Text summary of the in-memory trace ring (per-category
         totals, top-K spans by total time, segment dispatch vs

@@ -27,8 +27,8 @@ class ForwardBase(AcceleratedUnit):
         self.view_group = "WORKER"
         self.input = None
         self.output = Vector()
-        self.weights = Vector()
-        self.bias = Vector()
+        self.weights = Vector(category="params")
+        self.bias = Vector(category="params")
         self.include_bias = kwargs.get("include_bias", True)
         self.weights_filling = kwargs.get("weights_filling", "uniform")
         self.weights_stddev = kwargs.get("weights_stddev", None)
@@ -207,8 +207,8 @@ class GradientDescentBase(AcceleratedUnit):
         #: compute err_input (False for the first layer, saves a matmul)
         self.need_err_input = kwargs.get("need_err_input", True)
         self.forward = None       # paired forward (setup_from_forward)
-        self.gradient_weights = Vector()
-        self.gradient_bias = Vector()
+        self.gradient_weights = Vector(category="params")
+        self.gradient_bias = Vector(category="params")
         self.demand("input", "err_output", "weights")
 
     def setup_from_forward(self, forward):
