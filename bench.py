@@ -542,7 +542,7 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
     stage (its per-event cost is a ring write, orders below the step
     time); the ``engine.trace=off`` <1% criterion is about the
     DEFAULT state and is asserted by tests, not this ladder."""
-    from veles_tpu import prng, prof, trace
+    from veles_tpu import chaos, prng, prof, trace
     from veles_tpu.backends import AutoDevice
     from veles_tpu.config import root
     from veles_tpu.memory import Watcher
@@ -572,6 +572,7 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
         compiles_before = trace.recorder.count("segment", "compile")
         flops_before = prof.ledger.flops_dispatched
         recompiles_before = prof.ledger.recompiles
+        faults_before = chaos.controller.faults_injected
         tic = time.perf_counter()
         wf.run()                           # epochs 3-4, warm
         elapsed = time.perf_counter() - tic
@@ -588,6 +589,12 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
         # retrace inside the timed region), and absolute peak HBM
         flops_delta = prof.ledger.flops_dispatched - flops_before
         recompiles = prof.ledger.recompiles - recompiles_before
+        # chaos injections inside the timed region: 0 on every normal
+        # run — a banked line from a fault-injection session can never
+        # be mistaken for a clean throughput sample (same intent as
+        # the sample_starved predicate)
+        faults_injected = chaos.controller.faults_injected \
+            - faults_before
         peak = _peak_flops(_device_kind())
         wf_mfu = (round(flops_delta / elapsed / peak, 4)
                   if peak and flops_delta else None)
@@ -613,6 +620,7 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
     extra.setdefault("mfu", wf_mfu)
     extra.setdefault("peak_hbm_bytes", peak_hbm)
     extra.setdefault("recompiles", recompiles)
+    extra.setdefault("faults_injected", faults_injected)
     if loader_mode is not None:
         extra.setdefault("loader", loader_mode)
     _emit(metric, sec_per_step, batch, None, vs=vs, extra=extra)

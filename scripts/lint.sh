@@ -32,3 +32,9 @@ done
 # compile fingerprinted and ZERO steady-state recompiles
 echo "== prof smoke (veles_tpu.samples.mnist) =="
 env JAX_PLATFORMS=cpu python -m veles_tpu.prof --smoke veles_tpu.samples.mnist
+# chaos smoke: a fixed-seed master–slave session over real ZMQ with an
+# injected slave death, a dropped job frame and a duplicated update
+# frame must COMPLETE — no hang (timeout-wrapped), every job applied
+# exactly once, dedup/requeue counters consistent (docs/robustness.md)
+echo "== chaos smoke (fault-injection gate) =="
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -m veles_tpu.chaos --smoke

@@ -1,0 +1,518 @@
+"""veles_tpu.chaos — deterministic fault injection + the robustness
+upgrades it gates: exactly-once update semantics (dedup, stale-
+generation rejection, lost-frame requeue), master crash-recovery
+(async checkpoints → kill → resume → slave rejoin), and the
+convergence-parity acceptance gate (docs/robustness.md)."""
+
+import threading
+import time
+
+import numpy
+import pytest
+
+from veles_tpu import chaos
+from veles_tpu.backends import NumpyDevice
+from veles_tpu.chaos.core import ChaosSchedule, Fault
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.parallel.jobs import (JobClient, JobServer,
+                                     SlaveDescription)
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    """Every test leaves the process-wide controller disarmed."""
+    yield
+    chaos.controller.disarm()
+
+
+@pytest.fixture
+def live_trace():
+    """Knob-based enabling (NOT poking the recorder): the workflows
+    built inside the test call initialize() → trace.configure(),
+    which re-reads the knob — a directly-enabled recorder would be
+    switched back off by the first make_wf()."""
+    from veles_tpu import trace
+    from veles_tpu.config import root
+    saved = root.common.engine.get("trace", "off")
+    root.common.engine.trace = "on"
+    trace.recorder.clear()
+    trace.configure()
+    yield trace
+    root.common.engine.trace = saved
+    trace.configure()
+    trace.recorder.clear()
+
+
+# -- the shared tiny distributed workflow (mirrors test_jobs.py) ------------
+
+class ChaosDistLoader(FullBatchLoader):
+    def load_data(self):
+        rng = numpy.random.default_rng(5)
+        n = 200
+        labels = (numpy.arange(n) % 5).astype(int)
+        centers = rng.standard_normal((5, 16)) * 3
+        self.original_data.mem = (
+            centers[labels] + rng.standard_normal((n, 16)) * 0.5
+        ).astype(numpy.float32)
+        self.original_labels = [int(v) for v in labels]
+        self.class_lengths[:] = [0, 50, 150]
+
+
+CHAOS_LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 12},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 5},
+     "<-": {"learning_rate": 0.05}},
+]
+
+
+def make_wf(is_master=False, is_slave=False, max_epochs=3):
+    from veles_tpu import prng
+    prng.seed_all(21)
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: ChaosDistLoader(w, minibatch_size=25),
+        layers=[{**s} for s in CHAOS_LAYERS],
+        decision_config={"max_epochs": max_epochs})
+    wf.launcher = DummyLauncher(is_master=is_master, is_slave=is_slave)
+    wf.initialize(device=NumpyDevice())
+    return wf
+
+
+def final_metrics(wf):
+    return {"best_n_err_pt": float(wf.decision.best_n_err_pt),
+            "best_epoch": int(wf.decision.best_epoch),
+            "epochs": int(wf.loader.epoch_number),
+            "complete": bool(wf.decision.complete)}
+
+
+def master_weights(wf):
+    wf.forwards[0].weights.map_read()
+    return numpy.array(wf.forwards[0].weights.mem)
+
+
+# -- fault model ------------------------------------------------------------
+
+def test_fault_validation_and_schedule_roundtrip():
+    with pytest.raises(ValueError):
+        Fault("master_send", "explode", nth=1)
+    with pytest.raises(ValueError):        # two selectors
+        Fault("master_send", "drop", nth=1, prob=0.5)
+    with pytest.raises(ValueError):        # no selector
+        Fault("master_send", "drop")
+    sched = ChaosSchedule([
+        {"site": "master_send", "action": "drop", "op": "job",
+         "nth": 2},
+        {"site": "slave_send", "action": "dup", "op": "update",
+         "every": 3, "count": 2},
+        {"site": "slave_job", "action": "slave_kill", "prob": 0.25},
+    ])
+    clone = ChaosSchedule.from_json(sched.to_json())
+    assert [f.to_dict() for f in clone] == [f.to_dict() for f in sched]
+    assert clone.faults[1].count == 2
+
+
+def test_deterministic_wire_decisions_given_seed():
+    """Two controllers with the same (seed, schedule) make IDENTICAL
+    decisions over the same call sequence — the replayability
+    contract."""
+    def decisions(ctl):
+        out = []
+        for i in range(200):
+            plan = ctl.wire("master_send", "job" if i % 3 else "update")
+            out.append((plan.deliveries, plan.corrupt,
+                        round(plan.delay_s, 6)))
+        return out
+
+    schedule = [{"site": "master_send", "action": "drop", "op": "job",
+                 "prob": 0.2},
+                {"site": "master_send", "action": "dup", "op": "update",
+                 "prob": 0.3}]
+    a = chaos.ChaosController()
+    a.arm(list(schedule), seed=99)
+    b = chaos.ChaosController()
+    b.arm(list(schedule), seed=99)
+    da, db = decisions(a), decisions(b)
+    assert da == db
+    assert any(p[0] == 0 for p in da), "seeded drops must have fired"
+    assert any(p[0] > 1 for p in da), "seeded dups must have fired"
+    c = chaos.ChaosController()
+    c.arm(list(schedule), seed=100)
+    assert decisions(c) != da, "a different seed is a different run"
+
+
+def test_nth_fires_exactly_once_and_partition_window():
+    ctl = chaos.ChaosController()
+    ctl.arm([{"site": "slave_send", "action": "drop", "op": "update",
+              "nth": 3}])
+    plans = [ctl.wire("slave_send", "update") for _ in range(6)]
+    assert [p.deliveries for p in plans] == [1, 1, 0, 1, 1, 1]
+    assert ctl.injected.get("drop") == 1
+
+    ctl.arm([{"site": "master_recv", "action": "partition", "nth": 1,
+              "duration_s": 0.2}])
+    assert ctl.wire("master_recv", "update").deliveries == 0
+    assert ctl.wire("master_recv", "ping").deliveries == 0, \
+        "an op-less partition swallows EVERY frame at the site"
+    assert ctl.wire("slave_send", "update").deliveries == 1, \
+        "other sites are unaffected"
+    time.sleep(0.25)
+    assert ctl.wire("master_recv", "update").deliveries == 1, \
+        "the window heals"
+
+
+def test_corrupt_bytes_breaks_pickle_deterministically():
+    import pickle
+    blob = pickle.dumps({"op": "update", "data": [1, 2, 3]})
+    mangled = chaos.ChaosController.corrupt_bytes(blob)
+    assert mangled == chaos.ChaosController.corrupt_bytes(blob)
+    assert mangled != blob
+
+
+# -- exactly-once updates (the dedup unit proof) -----------------------------
+
+def test_update_replay_applies_exactly_once():
+    """The acceptance unit-proof: replaying a captured update frame
+    twice changes the weights EXACTLY once."""
+    master_wf = make_wf(is_master=True)
+    slave_wf = make_wf(is_slave=True)
+    server = JobServer(master_wf)        # not started: direct dispatch
+    try:
+        slave = SlaveDescription("s1")
+        server.slaves["s1"] = slave
+        # burn through the two validation minibatches (their updates
+        # carry zero weight delta); job 3 is a TRAIN minibatch
+        for _ in range(2):
+            updates = []
+            slave_wf.do_job(master_wf.generate_data_for_slave(slave),
+                            updates.append)
+            master_wf.apply_data_from_slave(updates[0], slave)
+        with server._lock:
+            server._seq += 1
+            seq = server._seq
+            data = master_wf.generate_data_for_slave(slave)
+            slave.outstanding[seq] = time.time()
+        updates = []
+        slave_wf.do_job(data, updates.append)
+        msg = {"op": "update", "id": "s1", "data": updates[0],
+               "job": {"gen": server.generation, "epoch": 0,
+                       "seq": seq}, "req": 1}
+        w0 = master_weights(master_wf)
+        server._on_update(b"s1", slave, dict(msg))
+        w1 = master_weights(master_wf)
+        assert not numpy.array_equal(w0, w1), "first copy must apply"
+        server._on_update(b"s1", slave, dict(msg))   # captured replay
+        w2 = master_weights(master_wf)
+        numpy.testing.assert_array_equal(w1, w2)
+        server._on_update(b"s1", slave, dict(msg))   # and again
+        numpy.testing.assert_array_equal(w1, master_weights(master_wf))
+        assert server.dedup_dropped == 2
+        assert server._updates_applied == 1
+    finally:
+        server.stop()
+
+
+def test_stale_generation_update_rejected_and_logged(caplog):
+    """A pre-restart slave's update (older generation) is rejected,
+    logged and counted — never applied."""
+    import logging
+    master_wf = make_wf(is_master=True)
+    slave_wf = make_wf(is_slave=True)
+    server = JobServer(master_wf)
+    try:
+        slave = SlaveDescription("s1")
+        server.slaves["s1"] = slave
+        for _ in range(2):               # skip the validation jobs
+            updates = []
+            slave_wf.do_job(master_wf.generate_data_for_slave(slave),
+                            updates.append)
+            master_wf.apply_data_from_slave(updates[0], slave)
+        with server._lock:
+            server._seq += 1
+            seq = server._seq
+            data = master_wf.generate_data_for_slave(slave)
+            slave.outstanding[seq] = time.time()
+        updates = []
+        slave_wf.do_job(data, updates.append)
+        server.generation = 2            # "the master restarted"
+        w0 = master_weights(master_wf)
+        with caplog.at_level(logging.WARNING):
+            server._on_update(b"s1", slave, {
+                "op": "update", "id": "s1", "data": updates[0],
+                "job": {"gen": 1, "epoch": 0, "seq": seq}, "req": 1})
+        numpy.testing.assert_array_equal(w0, master_weights(master_wf))
+        assert server.stale_rejected == 1
+        assert server._updates_applied == 0
+        assert any("stale" in r.getMessage()
+                   for r in caplog.records), caplog.records
+        # the reply queued for the wire says stale, not ok
+        import pickle
+        acks = [pickle.loads(blob) for _ident, blob in server._outbox]
+        assert acks and acks[-1]["ok"] == 0 and acks[-1]["stale"] == 1
+    finally:
+        server.stop()
+
+
+def test_duplicated_update_frames_exact_parity():
+    """Chaos-parity, the EXACT half: a run whose only faults are
+    duplicated update frames finishes with final weights BITWISE equal
+    to the fault-free run — dedup makes duplication a provable no-op."""
+    def run_session(schedule=None):
+        if schedule is not None:
+            chaos.controller.arm(schedule, seed=11)
+        master_wf = make_wf(is_master=True)
+        slave_wf = make_wf(is_slave=True)
+        server = JobServer(master_wf).start()
+        try:
+            client = JobClient(slave_wf, server.endpoint,
+                               rpc_timeout_ms=2000)
+            client.handshake()
+            assert client.run() is True
+            client.close()
+        finally:
+            server.stop()
+            chaos.controller.disarm()
+        return master_wf, server
+
+    clean_wf, _clean_srv = run_session()
+    chaos_wf, chaos_srv = run_session([
+        {"site": "slave_send", "action": "dup", "op": "update",
+         "nth": 2},
+        {"site": "slave_send", "action": "dup", "op": "update",
+         "nth": 9, "count": 2},
+    ])
+    assert chaos_srv.dedup_dropped == 3, \
+        "1 + 2 extra copies must all be deduplicated"
+    numpy.testing.assert_array_equal(master_weights(clean_wf),
+                                     master_weights(chaos_wf))
+    assert final_metrics(clean_wf) == final_metrics(chaos_wf)
+
+
+def test_dropped_job_frame_requeued_session_completes():
+    """A job frame lost on the wire degrades to one requeued job: the
+    client times out, rejoins, the master requeues the lost seq via
+    the ``have`` reconciliation, and every job still applies exactly
+    once."""
+    from veles_tpu.chaos.__main__ import SmokeMaster, SmokeSlave
+    chaos.controller.arm([
+        {"site": "master_send", "action": "drop", "op": "job",
+         "nth": 2},
+    ], seed=3)
+    master = SmokeMaster(6)
+    server = JobServer(master, slave_timeout=6.0,
+                       heartbeat_interval=0.3).start()
+    try:
+        client = JobClient(SmokeSlave(), server.endpoint,
+                           rpc_timeout_ms=700, reconnect_max_wait=10.0)
+        client.handshake()
+        assert client.run() is True
+        client.close()
+    finally:
+        server.stop()
+    assert sorted(master.applied) == [1, 2, 3, 4, 5, 6]
+    assert server.lost_requeued >= 1
+    assert master.requeues >= 1
+
+
+def test_partition_heal_degrades_then_rejoins():
+    """A partitioned slave is reaped (its work requeued — the session
+    DEGRADES to fewer slaves rather than stalling); when the window
+    heals, the slave's next contact gets ``reject: unknown id`` and it
+    re-handshakes back in instead of dying — every job still applies
+    exactly once."""
+    from veles_tpu.chaos.__main__ import SmokeMaster, SmokeSlave
+    chaos.controller.arm([
+        # an op-less inbound partition swallowing frame 5 onward for
+        # 2.5 s: frame 5 is job 2's update (handshake, request, update,
+        # request, update), so the slave is holding an unacked job when
+        # the master goes deaf — the reaper must requeue it
+        {"site": "master_recv", "action": "partition", "nth": 5,
+         "duration_s": 2.5},
+    ], seed=5)
+    master = SmokeMaster(8)
+    server = JobServer(master, slave_timeout=1.0,
+                       heartbeat_interval=0.3).start()
+    try:
+        client = JobClient(SmokeSlave(), server.endpoint,
+                           rpc_timeout_ms=600,
+                           reconnect_max_wait=20.0)
+        client.handshake()
+        assert client.run() is True
+        client.close()
+    finally:
+        server.stop()
+    assert sorted(master.applied) == list(range(1, 9)), master.applied
+    assert master.requeues >= 1, \
+        "the reaped slave's in-flight work must have been requeued"
+    assert chaos.controller.injected.get("partition") == 1
+
+
+# -- master crash-recovery ---------------------------------------------------
+
+def test_capture_restore_train_state_roundtrip(tmp_path):
+    """Workflow checkpoint protocol: weights + loader cursor +
+    decision accounting survive a TrainCheckpointer round-trip into a
+    FRESH workflow (the restarted-master scenario, socket-free)."""
+    from veles_tpu.checkpoint import TrainCheckpointer
+    wf = make_wf(is_master=True)
+    # advance some real state
+    slave_wf = make_wf(is_slave=True)
+    slave = SlaveDescription("s1")
+    for _ in range(5):
+        updates = []
+        slave_wf.do_job(wf.generate_data_for_slave(slave), updates.append)
+        wf.apply_data_from_slave(updates[0], slave)
+    # one job handed out but never answered: in-flight at capture time
+    wf.generate_data_for_slave(slave)
+    wf.decision.best_n_err_pt = 12.5
+    wf.decision.best_epoch = 1
+    train, meta = wf.capture_train_state()
+    assert any("weights" in v for v in train.values())
+    ckpt = TrainCheckpointer(str(tmp_path / "ck"))
+    ckpt.save(5, train, meta)
+
+    fresh = make_wf(is_master=True)
+    assert not numpy.array_equal(master_weights(fresh),
+                                 master_weights(wf))
+    abstract, _ = fresh.capture_train_state()
+    step, train2, meta2 = ckpt.restore(abstract)
+    ckpt.close()
+    assert step == 5
+    fresh.restore_train_state(train2, meta2)
+    numpy.testing.assert_array_equal(master_weights(fresh),
+                                     master_weights(wf))
+    assert fresh.loader.epoch_number == wf.loader.epoch_number
+    assert fresh.loader.global_offset == wf.loader.global_offset
+    assert fresh.decision.best_n_err_pt == 12.5
+    assert fresh.decision.best_epoch == 1
+    # the drop-requeued minibatch came back as retriable work
+    assert fresh.loader.failed_minibatches, \
+        "pending/failed minibatches must survive the checkpoint"
+    numpy.testing.assert_array_equal(
+        numpy.array(fresh.loader.shuffled_indices.mem),
+        numpy.array(wf.loader.shuffled_indices.mem))
+
+
+def test_chaos_parity_gate(live_trace, tmp_path):
+    """THE acceptance gate: a seeded schedule with a slave kill, a
+    duplicated update frame and a dropped job frame, plus one master
+    kill-and-resume mid-run.  The session must COMPLETE, with final
+    eval metrics matching the fault-free run (the dedup'd duplicates
+    are exact no-ops by test_duplicated_update_frames_exact_parity;
+    the kill/requeue faults reorder minibatch application, so the
+    metric gate here is convergence parity on the same seeded task),
+    and the resume must restart within one checkpoint interval of the
+    kill.  Chaos instants, checkpoint spans and the resume marker all
+    land in the trace ring → merged Perfetto timeline."""
+    from veles_tpu import prof, trace
+
+    # ---- fault-free reference run
+    ref_master = make_wf(is_master=True)
+    ref_slave = make_wf(is_slave=True)
+    server = JobServer(ref_master).start()
+    try:
+        client = JobClient(ref_slave, server.endpoint,
+                           rpc_timeout_ms=2000)
+        client.handshake()
+        assert client.run() is True
+        client.close()
+    finally:
+        server.stop()
+    reference = final_metrics(ref_master)
+    assert reference["complete"]
+
+    # ---- chaos run
+    chaos.controller.arm([
+        {"site": "slave_job", "action": "slave_kill", "nth": 2},
+        {"site": "master_send", "action": "drop", "op": "job",
+         "nth": 5},
+        {"site": "slave_send", "action": "dup", "op": "update",
+         "nth": 4},
+    ], seed=7)
+    m1 = make_wf(is_master=True)
+    ckdir = str(tmp_path / "ck")
+    server1 = JobServer(m1, checkpoint_dir=ckdir, checkpoint_every=3,
+                        slave_timeout=5.0,
+                        heartbeat_interval=0.3).start()
+    port = server1.port
+    # slave A: scheduled to die holding its 2nd job
+    sA = make_wf(is_slave=True)
+    cA = JobClient(sA, server1.endpoint, rpc_timeout_ms=1200,
+                   reconnect_max_wait=10.0)
+    cA.handshake()
+    assert cA.run() is False, "the scheduled slave kill must fire"
+    cA.close()
+    # slave B: survives the master kill via reconnect backoff
+    sB = make_wf(is_slave=True)
+    cB = JobClient(sB, server1.endpoint, rpc_timeout_ms=1200,
+                   reconnect_max_wait=25.0)
+    cB.handshake()
+    done = []
+    runner = threading.Thread(target=lambda: done.append(cB.run()))
+    runner.start()
+    # wait for one completed checkpoint, then kill the master mid-run
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if server1._ckpt is not None and not server1._ckpt_busy.is_set() \
+                and server1._checkpointer().latest_step() is not None:
+            break
+        time.sleep(0.05)
+    ckpt_step = server1._checkpointer().latest_step()
+    assert ckpt_step is not None, "no checkpoint completed before kill"
+    killed_at = server1._updates_applied
+    server1.kill()
+
+    # "restarted process": a fresh master workflow resumes the latest
+    # checkpoint on the same endpoint
+    m2 = make_wf(is_master=True)
+    server2 = JobServer(m2, port=port, checkpoint_dir=ckdir,
+                        checkpoint_every=3, slave_timeout=5.0,
+                        heartbeat_interval=0.3)
+    resumed_step = server2.resume_from_checkpoint()
+    assert server2.generation == 2
+    # resume restarts within one checkpoint interval of the kill —
+    # plus one more interval for a trigger skipped while the previous
+    # async write was still in flight, plus the updates that landed
+    # between reading killed_at and the socket actually closing
+    assert killed_at - resumed_step <= 2 * 3 + 1, \
+        (killed_at, resumed_step)
+    server2.start()
+    try:
+        runner.join(120)
+        assert not runner.is_alive(), "chaos session hung"
+        assert done == [True], "surviving slave must finish the run"
+        cB.close()
+    finally:
+        server2.stop()
+        chaos.controller.disarm()
+
+    result = final_metrics(m2)
+    assert result["complete"], "the resumed session must run to the " \
+        "same stop criterion"
+    assert result["epochs"] >= reference["epochs"]
+    # convergence parity on the seeded 5-cluster task
+    assert abs(result["best_n_err_pt"] - reference["best_n_err_pt"]) \
+        <= 2.0, (result, reference)
+    # every scheduled fault actually fired…
+    injected = chaos.controller.snapshot()["injected"]
+    assert injected.get("slave_kill") == 1
+    assert injected.get("dup", 0) >= 1
+    assert injected.get("drop", 0) >= 1
+    # …and the exactly-once machinery saw the duplicate
+    assert server1.dedup_dropped + server2.dedup_dropped >= 1
+
+    # ---- observability: chaos + recovery events in the merged timeline
+    assert trace.recorder.count("jobs", "checkpoint") >= 1
+    assert trace.recorder.count("jobs", "resume") == 1
+    assert trace.recorder.count("chaos") >= 3
+    bundle_path = str(tmp_path / "chaos_session.json")
+    server2.save_session_profile(bundle_path, roles=("master",))
+    bundle = prof.merge.load(bundle_path)
+    merged = prof.merge.merged_events(bundle)
+    cats = {ev.get("cat") for ev in merged}
+    names = {(ev.get("cat"), ev.get("name")) for ev in merged}
+    assert "chaos" in cats, sorted(cats)
+    assert ("jobs", "resume") in names
+    assert ("jobs", "checkpoint") in names

@@ -372,6 +372,172 @@ def test_prefetch_double_buffering():
         server.stop()
 
 
+def test_stale_pong_skipped_before_later_rpc():
+    """Satellite regression (the comment in ``_rpc`` was untested): a
+    timed-out heartbeat's LATE pong arriving before a later rpc's
+    reply must be skipped without desyncing the DEALER stream — the
+    later rpc still gets ITS reply."""
+    import pickle
+
+    import zmq
+
+    context = zmq.Context.instance()
+    router = context.socket(zmq.ROUTER)
+    port = router.bind_to_random_port("tcp://127.0.0.1")
+    client = JobClient(ScriptedSlave(),
+                       "tcp://127.0.0.1:%d" % port)
+    try:
+        # heartbeat ping times out (master stalled, not dead)
+        with pytest.raises(TimeoutError):
+            client._rpc({"op": "ping", "id": client.sid},
+                        timeout_ms=200)
+        identity, blob = router.recv_multipart()
+        ping = pickle.loads(blob)
+        assert ping["op"] == "ping"
+        # ...then the master wakes up and answers the OLD ping
+        router.send_multipart([identity, pickle.dumps(
+            {"op": "pong", "req": ping.get("req")})])
+        time.sleep(0.1)
+
+        def master_side():
+            ident2, blob2 = router.recv_multipart()
+            request = pickle.loads(blob2)
+            assert request["op"] == "job_request"
+            router.send_multipart([ident2, pickle.dumps(
+                {"op": "job", "data": {"job_number": 1},
+                 "req": request.get("req")})])
+
+        t = threading.Thread(target=master_side)
+        t.start()
+        reply = client._rpc({"op": "job_request", "id": client.sid},
+                            timeout_ms=3000)
+        t.join(5)
+        # the stale pong was skipped; the stream stayed in sync
+        assert reply["op"] == "job"
+        assert reply["data"] == {"job_number": 1}
+    finally:
+        client.close()
+        router.close(linger=0)
+
+
+def test_orphan_reply_of_timed_out_rpc_skipped():
+    """The stale-pong rule generalized via the req echo: a late
+    NON-pong reply to a timed-out rpc must also be skipped, so a
+    retried request cannot consume its predecessor's answer."""
+    import pickle
+
+    import zmq
+
+    context = zmq.Context.instance()
+    router = context.socket(zmq.ROUTER)
+    port = router.bind_to_random_port("tcp://127.0.0.1")
+    client = JobClient(ScriptedSlave(),
+                       "tcp://127.0.0.1:%d" % port)
+    try:
+        with pytest.raises(TimeoutError):
+            client._rpc({"op": "job_request", "id": client.sid},
+                        timeout_ms=200)
+        identity, blob = router.recv_multipart()
+        first = pickle.loads(blob)
+        # the late answer to the TIMED-OUT request...
+        router.send_multipart([identity, pickle.dumps(
+            {"op": "job", "data": {"job_number": 1},
+             "req": first.get("req")})])
+        time.sleep(0.1)
+
+        def master_side():
+            ident2, blob2 = router.recv_multipart()
+            request = pickle.loads(blob2)
+            router.send_multipart([ident2, pickle.dumps(
+                {"op": "job", "data": {"job_number": 2},
+                 "req": request.get("req")})])
+
+        t = threading.Thread(target=master_side)
+        t.start()
+        reply = client._rpc({"op": "job_request", "id": client.sid},
+                            timeout_ms=3000)
+        t.join(5)
+        assert reply["data"] == {"job_number": 2}, \
+            "the retry must get ITS reply, not the orphan"
+    finally:
+        client.close()
+        router.close(linger=0)
+
+
+def test_zero_progress_slave_blacklisted_on_timeout():
+    """Satellite: a slave that joins, never completes a job and goes
+    silent is blacklisted when the reaper times it out (jobs.py
+    hung-slave sweep) — while a slave WITH progress is merely
+    dropped."""
+    master = ScriptedMaster(n_jobs=3)
+    server = JobServer(master, slave_timeout=0.6,
+                       heartbeat_interval=0.2).start()
+    productive = JobClient(ScriptedSlave(), server.endpoint)
+    hung = JobClient(ScriptedSlave(), server.endpoint)
+    try:
+        productive.handshake()
+        assert productive.run() is True        # 3 jobs done, then idle
+        hung.handshake()                       # joins, does NOTHING
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                (hung.sid not in server.blacklist
+                 or productive.sid in server.slaves):
+            time.sleep(0.05)
+        assert hung.sid in server.blacklist, \
+            "zero-progress slave must be blacklisted"
+        assert hung.sid in master.dropped
+        assert productive.sid not in server.blacklist, \
+            "a slave with jobs done is dropped, never blacklisted"
+        assert productive.sid in master.dropped
+    finally:
+        productive.close()
+        hung.close()
+        server.stop()
+
+
+def test_blacklisted_sid_rehandshake_rejected():
+    """Satellite (jobs.py:276 untested): a blacklisted sid's
+    re-handshake is rejected with reason="blacklisted" — it can never
+    rejoin, even with a matching checksum."""
+    master = ScriptedMaster(n_jobs=3)
+    server = JobServer(master, slave_timeout=0.5,
+                       heartbeat_interval=0.2).start()
+    try:
+        hung = JobClient(ScriptedSlave(), server.endpoint)
+        hung.handshake()
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                hung.sid not in server.blacklist:
+            time.sleep(0.05)
+        assert hung.sid in server.blacklist
+        hung.close()
+        retry = JobClient(ScriptedSlave(), server.endpoint,
+                          sid=hung.sid)
+        # same DEALER identity as the closed socket: the ROUTER may
+        # still drop replies routed at the dying connection for a
+        # moment — retry the handshake until the reject arrives
+        outcome = None
+        for _ in range(5):
+            try:
+                retry.handshake()
+                outcome = "accepted"
+                break
+            except ConnectionError as e:
+                outcome = str(e)
+                break
+            except TimeoutError:
+                time.sleep(0.3)
+        assert outcome is not None and "blacklisted" in outcome, outcome
+        retry.close()
+        # a FRESH sid still joins fine (the blacklist is per-id)
+        fresh = JobClient(ScriptedSlave(), server.endpoint)
+        fresh.handshake()
+        assert fresh.run() is True
+        fresh.close()
+    finally:
+        server.stop()
+
+
 def test_client_default_power_from_db(tmp_path, monkeypatch):
     """Slaves advertise the autotune DB's measured device power when
     present (ref client.py:309-312 power reporting)."""

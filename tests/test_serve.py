@@ -239,6 +239,64 @@ class TestBatcher:
         finally:
             batcher.stop()
 
+    def test_infer_deadline_fails_futures_typed(self):
+        """Satellite (chaos PR): a hung device call under
+        root.common.serve.infer_deadline_ms fails the batch's futures
+        with the typed InferDeadlineExceeded (→ HTTP 500) within the
+        deadline instead of blocking every queued client forever, and
+        the expiry lands in serve metrics."""
+        from veles_tpu.config import root
+        from veles_tpu.serve.batcher import InferDeadlineExceeded
+
+        engine = _StubEngine(max_batch_size=4, block=True)  # hangs
+        metrics = ServingMetrics()
+        saved = root.common.serve.get("infer_deadline_ms", 0)
+        root.common.serve.infer_deadline_ms = 150
+        batcher = DynamicBatcher(engine, max_wait_ms=1,
+                                 metrics=metrics)
+        try:
+            tic = time.perf_counter()
+            future = batcher.submit(numpy.ones((2, 4), numpy.float32))
+            with pytest.raises(InferDeadlineExceeded):
+                future.result(10)
+            elapsed = time.perf_counter() - tic
+            assert elapsed < 5, "must fail at the deadline, not hang"
+            assert metrics.deadline_expired_total == 1
+            assert metrics.errors_total == 1
+            snap = metrics.snapshot()
+            assert snap["deadline_expired_total"] == 1
+            assert "deadline_expired_total 1" in metrics.render_text()
+            # the worker survives: after the wedged call releases, a
+            # fresh request is served normally
+            engine.release.set()
+            out = batcher.infer(numpy.ones((1, 4), numpy.float32),
+                                timeout=10)
+            assert out.shape == (1, 4)
+        finally:
+            root.common.serve.infer_deadline_ms = saved
+            batcher.stop(drain=False)
+
+    def test_infer_deadline_off_keeps_direct_path(self):
+        """Knob off (the default): infer is called on the worker
+        thread directly — no thread-pool hop."""
+        from veles_tpu.config import root
+        assert float(root.common.serve.get("infer_deadline_ms", 0)) \
+            == 0
+        worker_threads = []
+
+        class _Recorder(_StubEngine):
+            def infer(self, batch):
+                worker_threads.append(threading.current_thread().name)
+                return super(_Recorder, self).infer(batch)
+
+        batcher = DynamicBatcher(_Recorder(max_batch_size=4),
+                                 max_wait_ms=1)
+        try:
+            batcher.infer(numpy.ones((1, 4), numpy.float32))
+            assert worker_threads == ["serve-batcher"]
+        finally:
+            batcher.stop()
+
     def test_timed_out_request_costs_no_device_call(self):
         engine = _StubEngine(max_batch_size=4, block=True)
         batcher = DynamicBatcher(engine, max_wait_ms=1)

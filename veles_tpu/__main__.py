@@ -133,6 +133,11 @@ class Main(Logger):
             "testing": self.args.test,
             "graphics": self.args.graphics,
             "web_status": self.args.web_status,
+            "checkpoint_dir": getattr(self.args, "checkpoint_dir",
+                                      None),
+            "checkpoint_every": getattr(self.args, "checkpoint_every",
+                                        None),
+            "resume": getattr(self.args, "resume", False),
         }
         if self.args.snapshot:
             from veles_tpu.snapshotter import load_snapshot

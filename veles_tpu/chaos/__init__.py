@@ -1,0 +1,12 @@
+"""veles_tpu.chaos — deterministic fault injection for the job layer.
+
+See :mod:`veles_tpu.chaos.core` for the fault model and knobs, and
+``docs/robustness.md`` for the failure-model table this package
+exercises.  ``python -m veles_tpu.chaos --smoke`` runs the CI gate: a
+seeded master–slave session with injected slave death + frame faults
+that must complete with consistent dedup accounting.
+"""
+
+from veles_tpu.chaos.core import (     # noqa: F401 - public API
+    PROCESS_ACTIONS, WIRE_ACTIONS, ChaosController, ChaosSchedule,
+    Fault, WirePlan, armed, configure, controller)

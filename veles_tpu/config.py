@@ -176,7 +176,36 @@ root.common.update({
         # Trace ring capacity in events; wraparound keeps the newest.
         "trace_capacity": 65536,
         "interpret": False,         # run Pallas kernels in interpret mode
+        # Master crash-recovery (veles_tpu.parallel.jobs.JobServer):
+        # "dir" non-empty → the master checkpoints the workflow's
+        # train state there (async TrainCheckpointer) every
+        # "every_jobs" applied updates AND at every epoch boundary;
+        # a restarted master resumes with `--resume` (launcher) /
+        # JobServer.resume_from_checkpoint().
+        "checkpoint": {"dir": "", "every_jobs": 0},
     },
+    # Deterministic fault injection (veles_tpu.chaos; read at
+    # chaos.configure() — the launcher calls it at initialize).  See
+    # docs/robustness.md for the fault model; "schedule" is a list of
+    # fault dicts (or a path to a JSON file of them), every run is
+    # replayable from (seed, schedule).
+    "chaos": {
+        "enabled": False,
+        "seed": 1234,
+        "schedule": [],
+        "drop_probability": 0.0,
+        "dup_probability": 0.0,
+        "delay_probability": 0.0,
+        "delay_ms": 50.0,
+        "corrupt_probability": 0.0,
+        # the reference's --slave-death-probability (client.py:303)
+        "slave_death_probability": 0.0,
+    },
+    # Serving robustness: a batched `infer` exceeding this deadline
+    # fails the batch's futures with serve.batcher.InferDeadlineExceeded
+    # (HTTP 500) instead of blocking every queued client forever.
+    # 0 = off (the direct, zero-overhead path).
+    "serve": {"infer_deadline_ms": 0},
     "thread_pool": {"max_workers": 8},
     "network_compression": "snappy",
     "timings": set(),

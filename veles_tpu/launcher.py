@@ -119,6 +119,11 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         #: hostname remotes dial back to (default: this host's fqdn —
         #: the bind address may be 0.0.0.0)
         self.advertise_host = kwargs.get("advertise_host")
+        #: master crash-recovery: checkpoint dir + cadence (fall back
+        #: to root.common.engine.checkpoint.*) and the --resume flag
+        self.checkpoint_dir = kwargs.get("checkpoint_dir")
+        self.checkpoint_every = kwargs.get("checkpoint_every")
+        self.resume = kwargs.get("resume", False)
         self.stopped = False
         self.device = None
         self.workflow = None
@@ -158,6 +163,19 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "--slave-launch-transform",
             default="ssh -o BatchMode=yes -p %(port)d %(host)s",
             help="remote-launch prefix template")
+        group.add_argument(
+            "--checkpoint-dir", default=None, metavar="DIR",
+            help="master mode: checkpoint the train state here "
+                 "(async, every --checkpoint-every jobs and at epoch "
+                 "boundaries; default root.common.engine.checkpoint)")
+        group.add_argument(
+            "--checkpoint-every", type=int, default=None,
+            metavar="K", help="checkpoint every K applied updates")
+        group.add_argument(
+            "--resume", action="store_true",
+            help="master mode: restore the latest checkpoint from "
+                 "--checkpoint-dir before serving jobs (crash "
+                 "recovery; see docs/robustness.md)")
         group.add_argument(
             "--analyze", action="store_true",
             help="dry run: construct the workflow (no initialize, no "
@@ -206,6 +224,11 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         ``workflow.py:350-354``)."""
         if self.workflow is None:
             raise RuntimeError("no workflow attached to this launcher")
+        # arm/disarm fault injection from root.common.chaos.* — the
+        # launcher is the knob-driven entry; tests and the chaos smoke
+        # arm the controller programmatically instead
+        from veles_tpu import chaos
+        chaos.configure()
         from veles_tpu.backends import make_device
         spec = "numpy" if self.is_master else self.device_spec
         self.device = kwargs.pop("device", None) or make_device(spec)
@@ -245,7 +268,11 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
     def _run_master(self):
         from veles_tpu.parallel.jobs import JobServer
         host, port = _split_endpoint(self.listen)
-        self._server = JobServer(self.workflow, port=port, host=host)
+        self._server = JobServer(self.workflow, port=port, host=host,
+                                 checkpoint_dir=self.checkpoint_dir,
+                                 checkpoint_every=self.checkpoint_every)
+        if self.resume:
+            self._server.resume_from_checkpoint()
         finished = threading.Event()
         self._server.on_finished = finished.set
         self._server.start()

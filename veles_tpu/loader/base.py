@@ -714,6 +714,55 @@ class Loader(Unit):
             self.info("requeued %d failed minibatches (total failed: %d)",
                       len(failed), self._total_failed)
 
+    # -- master crash-recovery (checkpoint protocol) -------------------------
+    def checkpoint_state(self):
+        """Serving-cursor snapshot for master crash-recovery: epoch,
+        global offset, the shuffled index permutation and the retry
+        queue.  In-flight (pending) minibatches are folded into the
+        retry queue — after a resume their slaves' updates are
+        stale-rejected by the job layer, so the work MUST be
+        re-served or those samples would silently vanish from the
+        epoch."""
+        state = {
+            "epoch_number": int(self.epoch_number),
+            "global_offset": int(self.global_offset),
+            "minibatch_class": int(self.minibatch_class or 0),
+            "samples_served": int(self.samples_served),
+            "failed": [(int(o), int(s))
+                       for o, s in self.failed_minibatches],
+            "pending": [(int(o), int(s))
+                        for defs in self.pending_minibatches_.values()
+                        for o, s in defs],
+        }
+        if self.shuffled_indices:
+            self.shuffled_indices.map_read()
+            state["shuffled_indices"] = numpy.array(
+                self.shuffled_indices.mem)
+        return state
+
+    def restore_checkpoint_state(self, state):
+        self.epoch_number = int(state.get("epoch_number", 0))
+        self.global_offset = int(state.get("global_offset", 0))
+        self.minibatch_class = int(state.get("minibatch_class", 0))
+        self.samples_served = int(state.get("samples_served", 0))
+        if state.get("shuffled_indices") is not None:
+            self.shuffled_indices.reset(numpy.asarray(
+                state["shuffled_indices"], dtype=INDEX_DTYPE))
+        requeue = [(int(o), int(s))
+                   for o, s in (state.get("failed") or ())]
+        requeue += [(int(o), int(s))
+                    for o, s in (state.get("pending") or ())]
+        self.failed_minibatches = requeue
+        self.pending_minibatches_.clear()
+        # epoch-edge flags recompute at the next serve
+        self.last_minibatch <<= False
+        self.epoch_ended <<= False
+        self.train_ended <<= False
+        if requeue:
+            self.info("resume requeued %d in-flight/failed "
+                      "minibatch(es) from the checkpoint",
+                      len(requeue))
+
     # -- results ------------------------------------------------------------
     def get_metric_values(self):
         return {"Total epochs": self.epoch_number}

@@ -20,20 +20,46 @@ connection-loss callbacks for failure detection.
 Wire protocol (pickled dicts):
   slave → master: {op: handshake|job_request|update|ping, id, ...}
   master → slave: {op: welcome|reject|job|update_ack|no_more_jobs|pong}
+
+Robustness semantics (docs/robustness.md):
+
+* every request carries a client-monotonic ``req`` echoed in its reply,
+  so a retried rpc can skip any orphan reply a timed-out predecessor
+  left in the DEALER stream (the stale-pong skip, generalized);
+* every job carries a monotonic id ``{gen, epoch, seq}`` echoed in its
+  update — the master applies each seq EXACTLY once (duplicated wire
+  frames and retried drop-after-apply updates are deduplicated), rejects
+  updates from an older generation (a pre-restart slave), and requeues
+  jobs whose frames were lost on the wire (the ``have`` list in each
+  job_request names what the slave actually holds);
+* the master optionally checkpoints the workflow's train state
+  (:class:`veles_tpu.checkpoint.TrainCheckpointer`) every K applied
+  updates / at epoch boundaries — asynchronously, off the ROUTER
+  thread — and a restarted master ``resume_from_checkpoint()``s with a
+  bumped generation; live slaves rejoin via :meth:`JobClient._reconnect`
+  (backoff re-handshake) and reconcile to the master's epoch/seq instead
+  of starting over;
+* fault injection (:mod:`veles_tpu.chaos`) wraps both the wire and the
+  process boundary at the sites marked below.
 """
 
 import collections
 import pickle
+import random
 import threading
 import time
 import uuid
 
-from veles_tpu import trace
+from veles_tpu import chaos, trace
 from veles_tpu.logger import Logger
 from veles_tpu.metrics import LatencyHistogram
 
 HEARTBEAT_INTERVAL = 2.0
 SLAVE_TIMEOUT = 10.0
+#: how many applied-update seqs the dedup set remembers (a replay can
+#: only arrive within a few round-trips of the original; this is ~3
+#: orders of magnitude above that)
+APPLIED_SEQ_WINDOW = 8192
 
 
 class SlaveDescription(object):
@@ -46,10 +72,12 @@ class SlaveDescription(object):
         self.state = "INIT"
         self.last_seen = time.time()
         self.jobs_done = 0
-        #: jobs handed out but not yet updated — with prefetching slaves
-        #: two can be in flight; `finished` and drop-requeue key off this
-        #: count, not the single state field (ADVICE r1)
-        self.in_flight = 0
+        #: jobs handed out but not yet updated, keyed by job seq →
+        #: hand-out time — with prefetching slaves two can be in
+        #: flight; `finished`, drop-requeue AND lost-frame detection
+        #: (the job_request ``have`` list) key off this map, not the
+        #: single state field (ADVICE r1)
+        self.outstanding = collections.OrderedDict()
         #: job round-trip latency (send → update), the SAME histogram
         #: the serving layer uses (veles_tpu.metrics) so the two
         #: percentile columns are comparable; jobs are answered in
@@ -65,6 +93,10 @@ class SlaveDescription(object):
         self.clock_offset_ns = None
         #: heartbeat-watchdog state: warned-once latch per excursion
         self.hb_warned = False
+
+    @property
+    def in_flight(self):
+        return len(self.outstanding)
 
     def observe_clock(self, sent_ns, recv_ns):
         measured = int(recv_ns) - int(sent_ns)
@@ -92,7 +124,8 @@ class JobServer(Logger):
 
     def __init__(self, workflow, port=0, host="127.0.0.1",
                  slave_timeout=SLAVE_TIMEOUT,
-                 heartbeat_interval=HEARTBEAT_INTERVAL):
+                 heartbeat_interval=HEARTBEAT_INTERVAL,
+                 checkpoint_dir=None, checkpoint_every=None):
         super(JobServer, self).__init__()
         import zmq
         self.workflow = workflow
@@ -100,6 +133,41 @@ class JobServer(Logger):
         self.heartbeat_interval = heartbeat_interval
         self.slaves = {}
         self.blacklist = set()
+        #: run generation: bumped by resume_from_checkpoint so updates
+        #: computed against a pre-restart master are recognizably stale
+        self.generation = 1
+        #: global monotonic job counter — the ``seq`` in every job id
+        self._seq = 0
+        #: seq → apply outcome (the ``ok`` acked) for every consumed
+        #: update — the exactly-once record, with its arrival-order
+        #: twin for O(1) window eviction.  Storing the outcome lets a
+        #: replay's ack echo the ORIGINAL result: a failed apply whose
+        #: ok:0 ack was lost must not morph into ok:1 on retry
+        self._applied = {}
+        self._applied_order = collections.deque()
+        #: exactly-once accounting (print_stats + the chaos smoke's
+        #: consistency check read these)
+        self.dedup_dropped = 0
+        self.stale_rejected = 0
+        self.lost_requeued = 0
+        self._updates_applied = 0
+        #: crash-recovery: async TrainCheckpointer checkpoints every
+        #: ``checkpoint_every`` applied updates and at epoch
+        #: boundaries; None args fall back to the
+        #: ``root.common.engine.checkpoint`` knobs
+        from veles_tpu.config import root
+        node = root.common.engine.get("checkpoint")
+        cfg = node.to_dict() if node else {}
+        if checkpoint_dir is None:
+            checkpoint_dir = cfg.get("dir") or None
+        if checkpoint_every is None:
+            checkpoint_every = int(cfg.get("every_jobs", 0) or 0)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every or 0)
+        self._ckpt = None
+        self._ckpt_busy = threading.Event()
+        self._last_ckpt_epoch = None
+        self.killed = False
         #: sid -> {"events", "ledger", "offset_ns"} shipped by slaves
         #: at end-of-run over the job wire (op "prof"); survives
         #: drop_slave so save_session_profile sees finished slaves
@@ -108,6 +176,11 @@ class JobServer(Logger):
         self.on_finished = None
         self._context = zmq.Context.instance()
         self._socket = self._context.socket(zmq.ROUTER)
+        # a slave process restarted with its old sid reconnects with a
+        # KNOWN identity on a NEW connection; without handover the
+        # ROUTER silently ignores the newcomer and its re-handshake
+        # (welcome or reject) can never be answered
+        self._socket.setsockopt(zmq.ROUTER_HANDOVER, 1)
         if port:
             self._socket.bind("tcp://%s:%d" % (host, port))
             self.port = port
@@ -170,6 +243,8 @@ class JobServer(Logger):
         last_reap = time.time()
         import zmq as _zmq
         while not self._stop.is_set():
+            if chaos.controller.armed and not self._chaos_tick():
+                return           # chaos master_kill: crash, no cleanup
             self._drain_outbox()
             if poller.poll(50 if self._outbox else 200):
                 # swallow wake-up notifications (their only job was
@@ -194,15 +269,51 @@ class JobServer(Logger):
                     except Exception:
                         self.exception("undecodable message")
                         continue
-                    try:
-                        self._dispatch(identity, msg)
-                    except Exception:
-                        self.exception("failed handling %r",
-                                       msg.get("op"))
+                    deliveries = 1
+                    if chaos.controller.armed:
+                        # chaos site master_recv: drop/dup/delay an
+                        # arriving frame (delay stalls the loop — the
+                        # same observable as a wedged master)
+                        plan = chaos.controller.wire(
+                            "master_recv", msg.get("op"),
+                            peer=msg.get("id"), role="master")
+                        if plan.delay_s:
+                            time.sleep(plan.delay_s)
+                        deliveries = 0 if plan.corrupt \
+                            else plan.deliveries
+                    for _ in range(deliveries):
+                        try:
+                            self._dispatch(identity, msg)
+                        except Exception:
+                            self.exception("failed handling %r",
+                                           msg.get("op"))
             self._drain_outbox()
             if time.time() - last_reap >= self.heartbeat_interval:
                 last_reap = time.time()
                 self._reap_dead_slaves()
+
+    def _chaos_tick(self):
+        """Process-boundary faults on the server loop.  Returns False
+        when the master was chaos-killed (the loop must vanish the way
+        a SIGKILL'd process would: socket closed, nothing drained)."""
+        fault = chaos.controller.process("master_tick", role="master")
+        if fault is None:
+            return True
+        if fault.action == "master_stall":
+            self.warning("chaos: master stalled for %.1f s",
+                         fault.duration_s)
+            time.sleep(fault.duration_s)
+            return True
+        if fault.action == "master_kill":
+            self.warning("chaos: master killed")
+            self.killed = True
+            self._stop.set()
+            try:
+                self._socket.close(linger=0)
+            except Exception:
+                pass
+            return False
+        return True
 
     def _drain_outbox(self):
         while self._outbox:
@@ -214,8 +325,18 @@ class JobServer(Logger):
 
     def _send(self, identity, msg):
         """Replies from the loop thread go straight out; worker threads
-        (job generation) enqueue — zmq sockets are not thread-safe."""
+        (job generation) enqueue — zmq sockets are not thread-safe.
+        Chaos site ``master_send``: a reply may be dropped, duplicated,
+        delayed or corrupted here."""
         blob = pickle.dumps(msg, pickle.HIGHEST_PROTOCOL)
+        if chaos.controller.armed:
+            chaos.controller.send_wire(
+                "master_send", msg.get("op"), blob,
+                lambda b: self._send_blob(identity, b), role="master")
+            return
+        self._send_blob(identity, blob)
+
+    def _send_blob(self, identity, blob):
         if threading.current_thread() is self._thread:
             self._socket.send_multipart([identity, blob])
         else:
@@ -230,6 +351,7 @@ class JobServer(Logger):
     def _dispatch(self, identity, msg):
         op = msg.get("op")
         sid = msg.get("id")
+        req = msg.get("req")
         slave = self.slaves.get(sid)
         if slave is not None:
             now = time.time()
@@ -257,39 +379,61 @@ class JobServer(Logger):
             slave.hb_warned = False
         if op == "handshake":
             self._on_handshake(identity, msg)
+        elif op == "bye":
+            # fire-and-forget farewell — NEVER answered: a reject sent
+            # to a reaped sid's bye would race a same-identity
+            # successor (ROUTER_HANDOVER) whose in-flight rpc could
+            # consume the req-less stray as its own reply
+            if slave is not None:
+                self.drop_slave(sid)
         elif slave is None or sid in self.blacklist:
-            self._send(identity, {"op": "reject", "reason": "unknown id"})
+            self._send(identity, {"op": "reject",
+                                  "reason": "unknown id", "req": req})
         elif op == "ping":
-            self._send(identity, {"op": "pong"})
+            self._send(identity, {"op": "pong", "req": req})
         elif op == "job_request":
-            self._on_job_request(identity, slave)
+            self._on_job_request(identity, slave, msg)
         elif op == "update":
             self._on_update(identity, slave, msg)
         elif op == "prof":
             self._on_prof(identity, slave, msg)
-        elif op == "bye":
-            self.drop_slave(sid)
+
+    def _master_epoch(self):
+        """The master workflow's current epoch (0 for scripted masters
+        with no loader) — stamped into job ids and the welcome reply so
+        a rejoining slave reconciles instead of starting over."""
+        try:
+            return int(getattr(getattr(self.workflow, "loader", None),
+                               "epoch_number", 0) or 0)
+        except Exception:
+            return 0
 
     def _on_handshake(self, identity, msg):
         """Checksum handshake (ref ``server.py:478-530``): reject slaves
-        running different workflow code or previously blacklisted ids."""
+        running different workflow code or previously blacklisted ids.
+        A re-handshake from a LIVE sid is a rejoin (partition healed,
+        master restarted): its outstanding jobs are requeued and the
+        welcome carries the master's {gen, epoch, seq} so the slave
+        reconciles to the current training position."""
+        req = msg.get("req")
         if msg.get("id") in self.blacklist:
             self._send(identity, {"op": "reject",
-                                  "reason": "blacklisted"})
+                                  "reason": "blacklisted", "req": req})
             return
         their_checksum = msg.get("checksum")
         try:
             ours = self.workflow.checksum()
         except Exception as e:    # ChecksumError: fail closed, loudly
             self._send(identity, {
-                "op": "reject",
+                "op": "reject", "req": req,
                 "reason": "master cannot checksum its workflow: %s" % e})
             self.error("cannot checksum own workflow — rejecting every "
                        "slave: %s", e)
             return
         if their_checksum != ours:
             self._send(identity, {
-                "op": "reject", "reason": "checksum mismatch"})
+                "op": "reject", "reason": "checksum mismatch",
+                "req": req})
             self.warning("rejected slave with checksum %s (ours %s)",
                          str(their_checksum)[:12], ours[:12])
             return
@@ -297,23 +441,97 @@ class JobServer(Logger):
         slave = SlaveDescription(sid, power=float(msg.get("power", 1.0)))
         slave.state = "WAIT"
         with self._lock:
+            previous = self.slaves.get(sid)
+            if previous is not None and previous.outstanding:
+                # rejoin with jobs in flight: the slave abandoned them
+                # (it re-handshakes only after losing the stream) —
+                # requeue so no minibatch is silently lost
+                try:
+                    self.workflow.drop_slave(previous)
+                except Exception:
+                    self.exception("requeue on rejoin of %s failed", sid)
+                self.lost_requeued += len(previous.outstanding)
+                self.info("slave %s re-joined with %d job(s) in "
+                          "flight — requeued", sid,
+                          len(previous.outstanding))
             self.slaves[sid] = slave
-        self._send(identity, {"op": "welcome", "id": sid})
-        self.info("slave %s joined (power %.1f)", sid, slave.power)
+        self._send(identity, {"op": "welcome", "id": sid, "req": req,
+                              "gen": self.generation,
+                              "epoch": self._master_epoch(),
+                              "seq": self._seq})
+        if trace.enabled():
+            trace.instant("jobs", "handshake",
+                          {"slave": sid, "gen": self.generation,
+                           "rejoin": previous is not None},
+                          role="master")
+        self.info("slave %s joined (power %.1f, generation %d)",
+                  sid, slave.power, self.generation)
 
-    def _on_job_request(self, identity, slave):
+    def _on_job_request(self, identity, slave, msg):
         """Job generation is offloaded to the host thread pool (ref
         ``server.py:404-407`` deferToThreadPool): a slow
         generate_data_for_slave (GA child evaluation, big index
         partitions) must not stall heartbeat processing and job service
-        for every other slave on the ROUTER thread."""
+        for every other slave on the ROUTER thread.
+
+        The request's ``have`` list names the seqs the slave actually
+        holds: any outstanding job NOT in it was lost on the wire (a
+        dropped ``job`` frame, a slave that timed out waiting) — so a
+        lost frame degrades to retried minibatches instead of a hung
+        epoch.  On ANY loss the slave's WHOLE outstanding set is
+        requeued, not just the lost seqs: the loader's per-slave
+        pending list is positional (no per-seq identity), so a partial
+        requeue would desynchronize it from our seq accounting — the
+        still-held jobs' updates are instead stale-rejected and their
+        minibatches re-served (wasted compute, never a double-apply)."""
+        req = msg.get("req")
+        have = msg.get("have")
+        if have is not None:
+            have_set = set(have)
+            with self._lock:
+                # under the lock: a duplicated request frame dispatches
+                # this while a pool worker's _generate_and_send inserts
+                # into outstanding
+                lost = [seq for seq in slave.outstanding
+                        if seq not in have_set]
+            if lost:
+                self._requeue_lost(slave, lost)
         if self._no_more_jobs:
-            self._send(identity, {"op": "no_more_jobs"})
+            self._send(identity, {"op": "no_more_jobs", "req": req})
             return
         from veles_tpu import thread_pool
-        thread_pool.submit(self._generate_and_send, identity, slave)
+        thread_pool.submit(self._generate_and_send, identity, slave,
+                           req)
 
-    def _generate_and_send(self, identity, slave):
+    def _requeue_lost(self, slave, lost):
+        with self._lock:
+            # clear EVERYTHING outstanding, not just the lost seqs:
+            # workflow.drop_slave requeues the loader's whole pending
+            # list for this sid (it has no per-seq identity), so the
+            # seq set must empty with it or the two go out of sync —
+            # still-held jobs become stale (their updates rejected,
+            # their minibatches re-served)
+            cleared = list(slave.outstanding)
+            slave.outstanding.clear()
+            try:
+                # unit-level requeue (the loader returns the pending
+                # minibatches to its retry queue) WITHOUT dropping the
+                # slave itself — it is alive and asking for work
+                self.workflow.drop_slave(slave)
+            except Exception:
+                self.exception("requeue of lost jobs for %s failed",
+                               slave.id)
+        self.lost_requeued += len(cleared)
+        trace.instant("jobs", "requeue_lost",
+                      {"slave": slave.id, "lost": list(lost),
+                       "requeued": cleared},
+                      role="master")
+        self.warning("slave %s lost %d job frame(s) on the wire "
+                     "(seq %s) — requeued all %d outstanding",
+                     slave.id, len(lost),
+                     ",".join(str(s) for s in lost), len(cleared))
+
+    def _generate_and_send(self, identity, slave, req=None):
         from veles_tpu.workflow import NoJobYet, NoMoreJobs
         try:
             with self._lock:
@@ -321,10 +539,12 @@ class JobServer(Logger):
                     # reaped while this request waited for a worker; a
                     # job generated now would never be requeued on drop
                     self._send(identity,
-                               {"op": "reject", "reason": "dropped"})
+                               {"op": "reject", "reason": "dropped",
+                                "req": req})
                     return
                 if self._no_more_jobs:
-                    self._send(identity, {"op": "no_more_jobs"})
+                    self._send(identity, {"op": "no_more_jobs",
+                                          "req": req})
                     return
                 try:
                     with trace.span("jobs", "generate",
@@ -335,25 +555,96 @@ class JobServer(Logger):
                 except NoJobYet:
                     # more jobs will appear (e.g. GA generation
                     # boundary): the slave should retry, not quit
-                    self._send(identity, {"op": "wait"})
+                    self._send(identity, {"op": "wait", "req": req})
                     return
                 except (StopIteration, NoMoreJobs):
                     data = None
                 if data is not None:
-                    slave.in_flight += 1
+                    self._seq += 1
+                    seq = self._seq
+                    slave.outstanding[seq] = time.time()
                     slave.state = "WORKING"
+                    job_id = {"gen": self.generation,
+                              "epoch": self._master_epoch(),
+                              "seq": seq}
             if data is None:
                 self._no_more_jobs = True
-                self._send(identity, {"op": "no_more_jobs"})
+                self._send(identity, {"op": "no_more_jobs",
+                                      "req": req})
                 self._maybe_finish()
                 return
             slave.job_sent()
-            self._send(identity, {"op": "job", "data": data})
-        except Exception:
+            self._send(identity, {"op": "job", "data": data,
+                                  "job": job_id, "req": req})
+        except Exception as exc:
             self.exception("job generation for %s failed", slave.id)
+            # answer the request: a silent swallow here would leave
+            # the slave timing out, re-handshaking (the master is
+            # alive, so that succeeds) and re-requesting forever — a
+            # livelock.  job_error fails the slave loudly instead
+            self._send(identity, {"op": "job_error", "req": req,
+                                  "error": "%s: %s"
+                                  % (type(exc).__name__, exc)})
 
     def _on_update(self, identity, slave, msg):
+        """Apply a slave's update EXACTLY ONCE.
+
+        Every update echoes its job id ``{gen, epoch, seq}``:
+
+        * an older ``gen`` is a pre-restart slave's update — rejected
+          (the restored train state already diverged from the state
+          that delta was computed against);
+        * a ``seq`` already in the applied set is a replay (duplicated
+          wire frame, or a drop-after-apply retry whose first copy DID
+          land) — acked ok but NOT re-applied, so replaying a captured
+          update frame N times changes the weights exactly once;
+        * a ``seq`` the master no longer has outstanding was requeued
+          (lost-frame detection) — the work happened against a
+          minibatch someone else will redo; rejected as stale.
+        """
+        req = msg.get("req")
+        job = msg.get("job")
         with self._lock:
+            seq = None
+            if job is not None:
+                gen = int(job.get("gen", 0))
+                seq = int(job.get("seq", 0))
+                if gen != self.generation:
+                    self.stale_rejected += 1
+                    trace.instant(
+                        "jobs", "stale_update",
+                        {"slave": slave.id, "gen": gen, "seq": seq,
+                         "current_gen": self.generation},
+                        role="master")
+                    self.warning(
+                        "rejected stale update from %s: generation %d "
+                        "(job epoch %s, seq %d) vs current generation "
+                        "%d — pre-restart work is discarded", slave.id,
+                        gen, job.get("epoch"), seq, self.generation)
+                    self._send(identity, {"op": "update_ack", "ok": 0,
+                                          "stale": 1, "req": req})
+                    return
+                if seq in self._applied:
+                    self.dedup_dropped += 1
+                    trace.instant("jobs", "dedup_update",
+                                  {"slave": slave.id, "seq": seq},
+                                  role="master")
+                    self.info("deduplicated replayed update seq %d "
+                              "from %s (already consumed, ok=%d)",
+                              seq, slave.id, self._applied[seq])
+                    self._send(identity,
+                               {"op": "update_ack",
+                                "ok": self._applied[seq], "dup": 1,
+                                "req": req})
+                    return
+                if seq not in slave.outstanding:
+                    self.stale_rejected += 1
+                    self.warning(
+                        "rejected update for unknown/requeued job seq "
+                        "%d from %s", seq, slave.id)
+                    self._send(identity, {"op": "update_ack", "ok": 0,
+                                          "stale": 1, "req": req})
+                    return
             try:
                 with trace.span("jobs", "apply_update",
                                 {"slave": slave.id}, role="master"):
@@ -363,11 +654,28 @@ class JobServer(Logger):
             except Exception:
                 self.exception("bad update from %s", slave.id)
                 ok = 0
-            slave.in_flight = max(0, slave.in_flight - 1)
-            slave.state = "WORKING" if slave.in_flight else "WAIT"
+            if seq is not None:
+                slave.outstanding.pop(seq, None)
+                # consumed either way: a failed apply must not be
+                # replayable into a half-applied double
+                self._applied[seq] = ok
+                self._applied_order.append(seq)
+                # evict the oldest entries — an evicted seq's replay
+                # still lands in the `not in slave.outstanding` stale
+                # branch above, so forgetting it can never double-apply
+                while len(self._applied_order) > APPLIED_SEQ_WINDOW:
+                    self._applied.pop(self._applied_order.popleft(),
+                                      None)
+            elif slave.outstanding:
+                # legacy id-less update: retire the oldest outstanding
+                slave.outstanding.popitem(last=False)
+            slave.state = "WORKING" if slave.outstanding else "WAIT"
+            self._updates_applied += 1
         slave.jobs_done += 1
         slave.job_updated()
-        self._send(identity, {"op": "update_ack", "ok": ok})
+        self._send(identity, {"op": "update_ack", "ok": ok,
+                              "req": req})
+        self._maybe_checkpoint()
         self._maybe_finish()
 
     def _on_prof(self, identity, slave, msg):
@@ -383,7 +691,7 @@ class JobServer(Logger):
         self.info("slave %s shipped its performance profile "
                   "(%d trace event(s))", slave.id,
                   len(self.slave_profiles[slave.id]["events"]))
-        self._send(identity, {"op": "prof_ack"})
+        self._send(identity, {"op": "prof_ack", "req": msg.get("req")})
 
     def save_session_profile(self, path, roles=None):
         """Write the session-profile bundle (master trace + ledger,
@@ -411,6 +719,126 @@ class JobServer(Logger):
         with open(path, "w") as fout:
             json.dump(bundle, fout)
         return path
+
+    # -- crash recovery -----------------------------------------------------
+    def _checkpointer(self):
+        if self._ckpt is None:
+            from veles_tpu.checkpoint import TrainCheckpointer
+            self._ckpt = TrainCheckpointer(self.checkpoint_dir)
+        return self._ckpt
+
+    def _maybe_checkpoint(self):
+        """Checkpoint trigger: every ``checkpoint_every`` applied
+        updates, plus every epoch boundary (detected as the master
+        epoch advancing between updates)."""
+        if not self.checkpoint_dir:
+            return
+        due = bool(self.checkpoint_every
+                   and self._updates_applied
+                   and self._updates_applied % self.checkpoint_every
+                   == 0)
+        epoch = self._master_epoch()
+        if self._last_ckpt_epoch is None:
+            self._last_ckpt_epoch = epoch
+        elif epoch != self._last_ckpt_epoch:
+            due = True
+        if due and self.checkpoint_async():
+            # the epoch trigger stays armed across a busy skip or a
+            # failed capture: _last_ckpt_epoch advances only once a
+            # write is actually in flight, so the next applied update
+            # retries — otherwise the epoch-only cadence
+            # (checkpoint_every=0) silently doubles its recovery
+            # window whenever a boundary lands mid-write
+            self._last_ckpt_epoch = epoch
+
+    def checkpoint_async(self):
+        """Non-blocking checkpoint: the train state is CAPTURED
+        synchronously under the server lock (numpy copies — consistent
+        by construction), then WRITTEN on the host thread pool so the
+        ROUTER loop never waits on Orbax I/O.  At most one write is in
+        flight; a trigger landing mid-write is skipped (the next one
+        covers it)."""
+        capture = getattr(self.workflow, "capture_train_state", None)
+        if capture is None or self._ckpt_busy.is_set():
+            return False
+        self._ckpt_busy.set()
+        try:
+            with self._lock:
+                train, meta = capture()
+                meta = dict(meta or {})
+                meta["__server__"] = {
+                    "generation": self.generation,
+                    "seq": self._seq,
+                    "updates_applied": self._updates_applied,
+                    "epoch": self._master_epoch(),
+                }
+                step = self._updates_applied
+        except Exception:
+            self._ckpt_busy.clear()
+            self.exception("train-state capture for checkpoint failed")
+            return False
+        from veles_tpu import thread_pool
+        thread_pool.submit(self._write_checkpoint, step, train, meta)
+        return True
+
+    def _write_checkpoint(self, step, train, meta):
+        try:
+            with trace.span("jobs", "checkpoint",
+                            {"step": step,
+                             "epoch": meta["__server__"]["epoch"]},
+                            role="master"):
+                self._checkpointer().save(step, train, meta)
+        except Exception:
+            self.exception("checkpoint write for step %d failed", step)
+        finally:
+            self._ckpt_busy.clear()
+
+    def resume_from_checkpoint(self, step=None):
+        """Master crash-recovery: restore the latest (or given)
+        checkpoint into the workflow, adopt its seq counter, and bump
+        the generation so any update computed against the pre-crash
+        master is recognizably stale.  Call BEFORE :meth:`start`."""
+        if not self.checkpoint_dir:
+            raise RuntimeError("no checkpoint_dir configured to "
+                               "resume from")
+        capture = getattr(self.workflow, "capture_train_state", None)
+        if capture is None:
+            raise RuntimeError(
+                "workflow %r does not implement the checkpoint "
+                "protocol (capture_train_state/restore_train_state)"
+                % type(self.workflow).__name__)
+        abstract, _meta_now = capture()
+        step, train, meta = self._checkpointer().restore(abstract,
+                                                         step=step)
+        meta = dict(meta or {})
+        srv = meta.pop("__server__", {})
+        self.workflow.restore_train_state(train, meta)
+        self.generation = int(srv.get("generation", self.generation)) \
+            + 1
+        self._seq = int(srv.get("seq", 0))
+        self._updates_applied = int(srv.get("updates_applied",
+                                            step or 0))
+        self._last_ckpt_epoch = self._master_epoch()
+        trace.instant("jobs", "resume",
+                      {"step": step, "generation": self.generation,
+                       "epoch": self._last_ckpt_epoch,
+                       "seq": self._seq},
+                      role="master")
+        self.info(
+            "resumed from checkpoint step %d (generation %d, epoch "
+            "%d, seq %d) — pre-restart updates will be rejected as "
+            "stale; live slaves rejoin via re-handshake", step,
+            self.generation, self._last_ckpt_epoch, self._seq)
+        return step
+
+    def kill(self):
+        """Abrupt-crash simulation (the chaos ``master_kill`` fault,
+        callable from tests): tear the server down with no graceful
+        drain, stats, or checkpoint — what a SIGKILL leaves behind.
+        Slaves see a silent endpoint and enter their reconnect
+        backoff."""
+        self.killed = True
+        self.stop()
 
     def _reap_dead_slaves(self):
         """Timeout-based failure detection (replaces Twisted
@@ -465,6 +893,13 @@ class JobServer(Logger):
         handoff + wire + slave compute + master apply) from the shared
         :class:`veles_tpu.metrics.LatencyHistogram` — the same buckets
         the serving layer reports, so the two columns compare."""
+        if self.dedup_dropped or self.stale_rejected \
+                or self.lost_requeued:
+            self.info(
+                "exactly-once accounting: %d duplicate update(s) "
+                "deduplicated, %d stale update(s) rejected, %d lost "
+                "job frame(s) requeued", self.dedup_dropped,
+                self.stale_rejected, self.lost_requeued)
         for slave in self.slaves.values():
             self.info("  %r", slave)
             hist = slave.latency
@@ -507,7 +942,8 @@ class JobClient(Logger):
 
     def __init__(self, workflow, endpoint, sid=None, power=None,
                  death_probability=0.0,
-                 heartbeat_interval=HEARTBEAT_INTERVAL):
+                 heartbeat_interval=HEARTBEAT_INTERVAL,
+                 reconnect_max_wait=30.0, rpc_timeout_ms=5000):
         super(JobClient, self).__init__()
         import zmq
         self.workflow = workflow
@@ -515,8 +951,20 @@ class JobClient(Logger):
         self.sid = sid or uuid.uuid4().hex[:8]
         self.power = power if power is not None else _default_power()
         #: fault injection (ref --slave-death-probability client.py:303)
+        #: — seeded from the chaos controller so even this legacy
+        #: knob's kills replay from the seed, and counted via
+        #: record_external so faults_injected never reads 0 while
+        #: deaths fire
         self.death_probability = death_probability
+        self._death_rng = random.Random(chaos.controller.seed)
         self.heartbeat_interval = heartbeat_interval
+        #: how long a silent/rejecting master is retried with backoff
+        #: before the slave gives up (master restarts take seconds;
+        #: the default rides out a kill + resume comfortably)
+        self.reconnect_max_wait = float(reconnect_max_wait)
+        #: default per-rpc reply timeout (tests/chaos sessions lower it
+        #: so fault recovery paths run in milliseconds, not seconds)
+        self.rpc_timeout_ms = int(rpc_timeout_ms)
         self._context = zmq.Context.instance()
         self._socket = self._context.socket(zmq.DEALER)
         self._socket.setsockopt(zmq.IDENTITY, self.sid.encode())
@@ -525,50 +973,137 @@ class JobClient(Logger):
         #: job loop share it under this lock
         self._socket_lock = threading.Lock()
         self.jobs_done = 0
+        #: the master's run generation from the last welcome — job ids
+        #: from an older generation are discarded after a rejoin
+        self.generation = None
+        #: job seqs received but not yet acked — the ``have`` list in
+        #: every job_request (the master requeues what we DON'T have)
+        self._in_hand = set()
+        #: client-monotonic request counter echoed in replies: lets a
+        #: retried rpc skip orphan replies of timed-out predecessors
+        self._req = 0
 
     @property
     def trace_role(self):
         """The per-slave pid label in exported traces."""
         return "slave-%s" % self.sid
 
-    def _rpc(self, msg, timeout_ms=5000):
+    def _next_req(self):
+        self._req += 1
+        return self._req
+
+    def _chaos_send(self, msg):
+        """Socket send with the ``slave_send`` chaos site applied:
+        the frame may be dropped (the rpc then times out — exercising
+        the retry paths), duplicated, delayed or corrupted."""
+        blob = pickle.dumps(msg, pickle.HIGHEST_PROTOCOL)
+        if chaos.controller.armed:
+            chaos.controller.send_wire(
+                "slave_send", msg.get("op"), blob, self._socket.send,
+                role=self.trace_role)
+            return
+        self._socket.send(blob)
+
+    def _chaos_recv_dropped(self, reply):
+        """``slave_recv`` chaos site: True when this arriving reply
+        must be treated as lost (the caller keeps polling and times
+        out, exactly as if the network ate it)."""
+        if not chaos.controller.armed:
+            return False
+        plan = chaos.controller.wire("slave_recv", reply.get("op"),
+                                     role=self.trace_role)
+        if plan.delay_s:
+            time.sleep(plan.delay_s)
+        # a reply corrupted on the receive side is a lost reply: the
+        # already-decoded dict cannot be bit-flipped, so corrupt
+        # degrades to drop and the injection count stays honest (dup
+        # is rejected for this site at schedule validation)
+        return plan.deliveries == 0 or plan.corrupt
+
+    def _recv_our_reply(self, req, sent_op, accept_reqless_reject=False):
+        """Drain ONE frame (caller polled first) and return it iff it
+        answers OUR request ``req``, else None.  The single reply
+        filter both receive loops share — skipping, in order:
+
+        * an undecodable frame (corrupted on the wire — a LOST frame,
+          not a slave crash; the master side logs and skips the same
+          way);
+        * a reply the ``slave_recv`` chaos site eats;
+        * a stale pong from a timed-out heartbeat (a pong is only an
+          answer when we actually sent a ping);
+        * any reply not echoing our req — an orphan answer to an rpc
+          that already timed out (master was stalled, not dead) or a
+          req-less stray routed at our identity.  Skipping those is
+          what keeps the DEALER stream in sync across retries.
+
+        ``accept_reqless_reject`` carves the one exception: a req-less
+        ``reject`` answers a bare keepalive ping — the master forgot
+        us after this request's reply was lost; the ping-waiting
+        caller consumes it to rejoin instead of waiting out its
+        deadline."""
+        try:
+            reply = pickle.loads(self._socket.recv())
+        except Exception:
+            self.warning("undecodable reply from master — treating "
+                         "as lost")
+            return None
+        if self._chaos_recv_dropped(reply):
+            return None
+        if reply.get("op") == "pong" and sent_op != "ping":
+            return None
+        if reply.get("req") != req and not (
+                accept_reqless_reject
+                and reply.get("op") == "reject"
+                and reply.get("req") is None):
+            return None
+        return reply
+
+    def _rpc(self, msg, timeout_ms=None):
         import zmq
+        if timeout_ms is None:
+            timeout_ms = self.rpc_timeout_ms
+        msg = dict(msg)
         with self._socket_lock:
-            self._socket.send(pickle.dumps(msg, pickle.HIGHEST_PROTOCOL))
+            # req allocated under the lock: the heartbeat thread rpcs
+            # concurrently with the job thread, and a duplicated req
+            # would let one rpc consume the other's reply
+            req = msg["req"] = self._next_req()
+            self._chaos_send(msg)
             while True:
                 if not self._socket.poll(timeout_ms, zmq.POLLIN):
                     raise TimeoutError("no reply from master for %r" %
                                        msg.get("op"))
-                reply = pickle.loads(self._socket.recv())
-                if reply.get("op") != "pong" or msg.get("op") == "ping":
+                reply = self._recv_our_reply(req, msg.get("op"))
+                if reply is not None:
                     return reply
-                # stale pong from a timed-out heartbeat — skip it
 
     def _request_with_pings(self, msg, max_wait=600.0):
-        """Send one request and wait for its (non-pong) reply, emitting
-        pings while waiting.  Replies stay ordered per DEALER identity,
-        so the first non-pong reply IS the answer; abandoning early
-        would desync the stream — hence one generous overall cap that
-        treats the master as gone."""
+        """Send one request and wait for its reply, emitting pings
+        while waiting.  Replies stay ordered per DEALER identity, so
+        the first non-pong, req-matching reply IS the answer;
+        abandoning early would desync the stream — hence one generous
+        overall cap that treats the master as gone."""
         import zmq
+        msg = dict(msg)
         deadline = time.time() + max_wait
         with self._socket_lock:
-            self._socket.send(pickle.dumps(msg, pickle.HIGHEST_PROTOCOL))
+            req = msg["req"] = self._next_req()
+            self._chaos_send(msg)
             while True:
                 if self._socket.poll(
                         int(self.heartbeat_interval * 1000), zmq.POLLIN):
-                    reply = pickle.loads(self._socket.recv())
-                    if reply.get("op") != "pong":
-                        return reply
-                    continue
+                    reply = self._recv_our_reply(
+                        req, msg.get("op"), accept_reqless_reject=True)
+                    if reply is None:
+                        continue
+                    return reply
                 if time.time() > deadline:
                     raise TimeoutError(
                         "master silent for %.0fs during %r"
                         % (max_wait, msg.get("op")))
-                self._socket.send(pickle.dumps(
+                self._chaos_send(
                     {"op": "ping", "id": self.sid,
-                     "t_ns": time.perf_counter_ns()},
-                    pickle.HIGHEST_PROTOCOL))
+                     "t_ns": time.perf_counter_ns()})
 
     def _heartbeat_loop(self, stop_event):
         """Keeps the master's last_seen fresh while a long job runs
@@ -597,6 +1132,23 @@ class JobClient(Logger):
             raise ConnectionError(
                 "master rejected us: %s" % reply.get("reason"))
         self.sid = reply["id"]
+        previous_gen, self.generation = self.generation, \
+            reply.get("gen")
+        if previous_gen is not None \
+                and self.generation != previous_gen:
+            # the master restarted and resumed: reconcile to ITS
+            # position instead of starting over — anything we still
+            # hold belongs to the dead generation
+            self.warning(
+                "master restarted (generation %s → %s): reconciled at "
+                "epoch %s, seq %s; discarding %d in-hand job(s)",
+                previous_gen, self.generation, reply.get("epoch"),
+                reply.get("seq"), len(self._in_hand))
+        self._in_hand.clear()
+        if reply.get("gen") is not None:
+            self.info("joined generation %s at epoch %s (master seq "
+                      "%s)", reply.get("gen"), reply.get("epoch"),
+                      reply.get("seq"))
         # the eager fast path on the job layer: surface what the
         # per-job run() will actually dispatch — every job pays
         # O(segments) programs, not O(units).  (Slave-mode graph
@@ -637,27 +1189,170 @@ class JobClient(Logger):
         """
         return self._run_loop(max_jobs, prefetch=True)
 
+    def _reconnect(self, why=""):
+        """Backoff re-handshake loop — the slave half of master
+        crash-recovery AND partition healing.  Retries until the
+        master answers (welcome → reconciled, True), permanently
+        rejects us (blacklisted → False), or ``reconnect_max_wait``
+        runs out (False)."""
+        deadline = time.time() + self.reconnect_max_wait
+        backoff = 0.2
+        self.warning("lost the master (%s) — re-handshaking with "
+                     "backoff for up to %.0f s", why or "silent",
+                     self.reconnect_max_wait)
+        while time.time() < deadline:
+            try:
+                self.handshake()
+            except ConnectionError as e:
+                if "blacklisted" in str(e):
+                    self.error("master blacklisted us — giving up: %s",
+                               e)
+                    return False
+                if "checksum" in str(e):
+                    # deterministic reject: a restarted master running
+                    # different workflow code will refuse this same
+                    # handshake every time — spinning out the backoff
+                    # window would only misreport it as 'unreachable'
+                    self.error("workflow checksum mismatch with the "
+                               "(restarted?) master — giving up: %s", e)
+                    return False
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+                continue
+            except (TimeoutError, OSError):
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+                continue
+            trace.instant("jobs", "rejoin",
+                          {"gen": self.generation, "why": why},
+                          role=self.trace_role)
+            return True
+        self.error("master unreachable for %.0f s — giving up",
+                   self.reconnect_max_wait)
+        return False
+
+    def _send_update_with_retry(self, data, job_id):
+        """Push one update with drop-after-apply safety: a lost ack is
+        retried with the SAME job id (master-side dedup makes the
+        replay provably harmless); a master that stays silent is
+        re-handshaked, and the update is discarded only when the
+        rejoin lands in a NEWER generation (the delta is stale by
+        construction then).  Returns the ack, or None when the master
+        is gone for good."""
+        msg = {"op": "update", "id": self.sid, "data": data}
+        if job_id:
+            msg["job"] = job_id
+        for attempt in range(3):
+            try:
+                with trace.span("jobs", "update",
+                                role=self.trace_role):
+                    ack = self._rpc(dict(msg))
+            except TimeoutError:
+                self.warning(
+                    "update ack lost (attempt %d/3) — re-sending the "
+                    "same job id (dedup makes the replay harmless)",
+                    attempt + 1)
+                continue
+            if ack.get("op") == "reject":
+                # master forgot us (restart without resume, partition
+                # heal after a reap): rejoin, then decide below
+                break
+            if not ack.get("ok"):
+                if ack.get("stale"):
+                    self.warning("master rejected our update as stale "
+                                 "(job %r)", job_id)
+                else:
+                    self.warning("master refused our update")
+            return ack
+        if not self._reconnect("no ack for our update"):
+            return None
+        if job_id and self.generation == job_id.get("gen"):
+            # same generation: the master was stalled, not replaced.
+            # The rejoin handshake requeued everything we had
+            # outstanding, so this resend can no longer be APPLIED —
+            # its one job is to distinguish applied-then-ack-lost
+            # (master dedups it → ok+dup, our work counted) from
+            # never-applied (stale reject; the requeued minibatch is
+            # recomputed, never double-applied)
+            try:
+                return self._rpc(dict(msg))
+            except TimeoutError:
+                return {"ok": 0}
+        self.warning("discarding update for job %r after rejoining "
+                     "generation %s", job_id, self.generation)
+        return {"ok": 0, "stale": 1}
+
     def _run_loop(self, max_jobs, prefetch):
-        import random as _random
         next_reply = None   # prefetched reply not yet processed
         while max_jobs is None or self.jobs_done < max_jobs:
             if next_reply is not None:
                 reply = next_reply
             else:
-                with trace.span("jobs", "job_request",
-                                role=self.trace_role):
-                    reply = self._rpc({"op": "job_request",
-                                       "id": self.sid})
+                try:
+                    with trace.span("jobs", "job_request",
+                                    role=self.trace_role):
+                        reply = self._rpc(
+                            {"op": "job_request", "id": self.sid,
+                             "have": sorted(self._in_hand)})
+                except TimeoutError:
+                    if not self._reconnect("silent on job_request"):
+                        return False
+                    continue
             next_reply = None
             if reply["op"] == "no_more_jobs":
                 break
             if reply["op"] == "wait":
                 time.sleep(self.heartbeat_interval / 10.0)
                 continue
+            if reply["op"] == "reject":
+                reason = reply.get("reason")
+                if reason == "blacklisted":
+                    self.error("master blacklisted us — giving up")
+                    return False
+                # "unknown id"/"dropped": the master forgot us (reaped
+                # during a partition that then healed, or restarted) —
+                # rejoin instead of dying, so a healed partition
+                # degrades to requeued work, not a lost slave
+                self.warning("master rejected us (%s) — re-handshaking",
+                             reason)
+                if not self._reconnect("rejected: %s" % reason):
+                    return False
+                continue
+            if reply["op"] == "job_error":
+                # the master is alive but cannot generate our job (a
+                # real exception, not NoJobYet): die loudly — a
+                # rejoin-and-retry here would livelock against a
+                # persistent master-side bug
+                raise ConnectionError(
+                    "master failed generating our job: %s"
+                    % reply.get("error"))
             if reply["op"] != "job":
                 raise ConnectionError("unexpected reply %r" % reply["op"])
+            job_id = reply.get("job") or {}
+            if job_id.get("seq") is not None:
+                self._in_hand.add(job_id["seq"])
+            if chaos.controller.armed:
+                # chaos process boundary: the slave holds a job now, so
+                # a kill/hang here exercises the master's reaper AND
+                # the requeue of in-flight work
+                fault = chaos.controller.process(
+                    "slave_job", role=self.trace_role)
+                if fault is not None:
+                    if fault.action == "slave_kill":
+                        self.warning("fault injection: dying mid-job "
+                                     "(chaos slave_kill)")
+                        return False
+                    if fault.action == "slave_hang":
+                        # a hang is WORSE than a death for the master:
+                        # no connection-loss event, just silence — the
+                        # reaper must time us out
+                        self.warning("fault injection: hanging %.1f s",
+                                     fault.duration_s)
+                        time.sleep(fault.duration_s)
             if self.death_probability and \
-                    _random.random() < self.death_probability:
+                    self._death_rng.random() < self.death_probability:
+                chaos.controller.record_external(
+                    "slave_kill", "slave_job", role=self.trace_role)
                 self.warning("fault injection: dying mid-job")
                 return False
             result = [None]
@@ -694,9 +1389,19 @@ class JobClient(Logger):
                     # the socket lock so the master keeps seeing us
                     # alive while the external heartbeat thread is
                     # locked out
-                    next_reply = self._request_with_pings(
-                        {"op": "job_request", "id": self.sid})
-                    if next_reply.get("op") == "job":
+                    try:
+                        next_reply = self._request_with_pings(
+                            {"op": "job_request", "id": self.sid,
+                             "have": sorted(self._in_hand)})
+                    except TimeoutError:
+                        # master gone mid-prefetch: finish the current
+                        # job; the update path below reconnects
+                        next_reply = None
+                    if next_reply is not None \
+                            and next_reply.get("op") == "job":
+                        nxt_id = next_reply.get("job") or {}
+                        if nxt_id.get("seq") is not None:
+                            self._in_hand.add(nxt_id["seq"])
                         # overlap the NEXT minibatch's IO with the rest
                         # of the current compute (loader-side
                         # double-buffering, ref client.py:293-296;
@@ -718,11 +1423,11 @@ class JobClient(Logger):
             finally:
                 stop_hb.set()
                 hb.join(self.heartbeat_interval + 3)
-            with trace.span("jobs", "update", role=self.trace_role):
-                ack = self._rpc({"op": "update", "id": self.sid,
-                                 "data": result[0]})
-            if not ack.get("ok"):
-                self.warning("master refused our update")
+            ack = self._send_update_with_retry(result[0], job_id)
+            if ack is None:
+                return False            # master is gone for good
+            if job_id.get("seq") is not None:
+                self._in_hand.discard(job_id["seq"])
             self.jobs_done += 1
         self._ship_profile()
         return True

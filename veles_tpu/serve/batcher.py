@@ -43,6 +43,14 @@ class QueueFull(RuntimeError):
     retry_after = 1
 
 
+class InferDeadlineExceeded(RuntimeError):
+    """A batched device call blew the ``root.common.serve
+    .infer_deadline_ms`` deadline: the batch's futures fail with THIS
+    typed error (the HTTP layer maps any future exception to 500), so
+    a hung device degrades to failed requests instead of a queue of
+    clients blocked forever behind a wedged worker."""
+
+
 class _Pending(object):
     __slots__ = ("rows", "future", "enqueued")
 
@@ -54,6 +62,12 @@ class _Pending(object):
 
 class DynamicBatcher(Logger):
     """Micro-batching queue in front of an :class:`InferenceEngine`."""
+
+    #: deadline-blown infer calls still wedged on the device before new
+    #: batches fail fast instead of spawning yet another worker — bounds
+    #: both thread/batch-memory pileup under sustained blowouts and the
+    #: number of concurrent engine.infer calls racing a wedged one
+    MAX_WEDGED_INFERS = 2
 
     def __init__(self, engine, max_batch_size=None, max_wait_ms=2.0,
                  max_queue_rows=1024, metrics=None, gauge_name=None,
@@ -71,6 +85,9 @@ class DynamicBatcher(Logger):
         self._queued_rows = 0
         self._cond = threading.Condition()
         self._stopped = False
+        #: finished-flags of abandoned deadline workers (worker-thread
+        #: private; pruned once their wedged infer finally returns)
+        self._wedged = []
         if metrics is not None:
             # gauge_name lets a multi-model registry give each
             # batcher its own gauge instead of the last deploy
@@ -165,6 +182,62 @@ class DynamicBatcher(Logger):
             self._queued_rows -= rows
             return taken
 
+    def _infer_bounded(self, engine, batch):
+        """One device call, optionally under the
+        ``root.common.serve.infer_deadline_ms`` deadline (re-read per
+        batch, so it can be armed on a live service).  0/off keeps the
+        direct zero-overhead call.  Armed, the call runs on a
+        per-batch DAEMON thread — not the shared host pool (which also
+        serves job generation and checkpoint writes and must never be
+        starved by a wedged device), and not a ThreadPoolExecutor
+        (whose non-daemon worker would be joined by the
+        concurrent.futures atexit hook, so one wedged call would hang
+        process shutdown forever).  A blown deadline raises
+        :class:`InferDeadlineExceeded` and ABANDONS the thread (the
+        wedged call cannot be cancelled — no device API aborts a
+        dispatched program); being a daemon it can never block exit,
+        and the next batch gets a fresh thread.  Abandoned calls are
+        BOUNDED: once :data:`MAX_WEDGED_INFERS` of them are still
+        wedged, further batches fail fast with the same typed error
+        instead of stacking more threads (and more captured batch
+        arrays, and more concurrent engine.infer calls) behind a
+        device that clearly isn't coming back."""
+        from veles_tpu.config import root
+        deadline_ms = float(
+            root.common.serve.get("infer_deadline_ms", 0) or 0)
+        if deadline_ms <= 0:
+            return engine.infer(batch)
+        self._wedged = [ev for ev in self._wedged if not ev.is_set()]
+        if len(self._wedged) >= self.MAX_WEDGED_INFERS:
+            raise InferDeadlineExceeded(
+                "%d earlier deadline-blown infer call(s) are still "
+                "wedged on the device — failing this batch of %d rows "
+                "fast instead of stacking another"
+                % (len(self._wedged), len(batch)))
+        outcome = {}
+        finished = threading.Event()
+
+        def _call():
+            try:
+                outcome["out"] = engine.infer(batch)
+            except BaseException as e:  # noqa: BLE001 - relayed below
+                outcome["exc"] = e
+            finally:
+                finished.set()
+
+        worker = threading.Thread(target=_call, daemon=True,
+                                  name="serve-infer-deadline")
+        worker.start()
+        if not finished.wait(deadline_ms / 1e3):
+            self._wedged.append(finished)
+            raise InferDeadlineExceeded(
+                "batched infer of %d rows exceeded the %.0f ms "
+                "deadline (root.common.serve.infer_deadline_ms)"
+                % (len(batch), deadline_ms)) from None
+        if "exc" in outcome:
+            raise outcome["exc"]
+        return outcome["out"]
+
     def _worker(self):
         while True:
             taken = self._take_batch()
@@ -189,9 +262,16 @@ class DynamicBatcher(Logger):
                 else:
                     batch = numpy.concatenate([p.rows for p in taken])
                 with trace.span("serve", "batch_infer", role="server"):
-                    out = engine.infer(batch)
+                    out = self._infer_bounded(engine, batch)
             except Exception as exc:  # noqa: BLE001 - fan the error out
                 self.warning("batched inference failed: %s", exc)
+                if self.metrics is not None and \
+                        isinstance(exc, InferDeadlineExceeded):
+                    self.metrics.record_deadline()
+                if trace.enabled() and \
+                        isinstance(exc, InferDeadlineExceeded):
+                    trace.instant("serve", "infer_deadline",
+                                  {"rows": len(batch)}, role="server")
                 for pending in taken:
                     pending.future.set_exception(exc)
                 if self.metrics is not None:

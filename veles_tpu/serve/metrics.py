@@ -34,6 +34,7 @@ class ServingMetrics(object):
         self.rows_total = 0
         self.errors_total = 0
         self.shed_total = 0          # 503s (QueueFull)
+        self.deadline_expired_total = 0   # 500s (InferDeadlineExceeded)
         self.batches_total = 0
         self.batch_rows_total = 0
         self.batch_capacity_total = 0
@@ -64,6 +65,12 @@ class ServingMetrics(object):
     def record_shed(self):
         with self._lock:
             self.shed_total += 1
+
+    def record_deadline(self):
+        """A batched infer blew root.common.serve.infer_deadline_ms —
+        its requests failed with 500 instead of hanging."""
+        with self._lock:
+            self.deadline_expired_total += 1
 
     def register_gauge(self, name, fn):
         """Register a 0-arg callable polled at snapshot/render time."""
@@ -103,6 +110,7 @@ class ServingMetrics(object):
             "rows_total": self.rows_total,
             "errors_total": self.errors_total,
             "shed_total": self.shed_total,
+            "deadline_expired_total": self.deadline_expired_total,
             "batches_total": self.batches_total,
             "batch_fill_ratio": round(self.batch_fill_ratio(), 4),
             "latency_ms": {
@@ -146,6 +154,8 @@ class ServingMetrics(object):
         emit("errors_total", snap["errors_total"])
         emit("shed_total", snap["shed_total"],
              "requests rejected with 503 (queue full)")
+        emit("deadline_expired_total", snap["deadline_expired_total"],
+             "batches failed with 500 (infer deadline exceeded)")
         emit("batches_total", snap["batches_total"])
         emit("batch_fill_ratio", snap["batch_fill_ratio"],
              "served rows / summed bucket capacity")

@@ -475,6 +475,79 @@ class Workflow(Unit):
         self._job_callback_ = callback
         self.run()
 
+    # -- master crash-recovery (checkpoint protocol) ------------------------
+    def _checkpoint_key(self, index, unit):
+        """Stable per-unit key: dependency-order index + sanitized
+        name.  The handshake checksum guarantees a restarted master
+        rebuilds the same graph, so the index is reproducible; the
+        name makes a mismatch loudly visible in the checkpoint dir."""
+        safe = "".join(c if c.isalnum() else "_" for c in unit.name)
+        return "u%03d_%s" % (index, safe)
+
+    def capture_train_state(self):
+        """Gather ``(train, meta)`` for a
+        :class:`veles_tpu.checkpoint.TrainCheckpointer` — the master
+        crash-recovery snapshot (docs/robustness.md).
+
+        Every unit exposing ``checkpoint_state()`` contributes a dict;
+        ndarray values go into the sharded ``train`` pytree, everything
+        else into the JSON ``meta`` side.  The split is reassembled in
+        :meth:`restore_train_state`, so units never see it."""
+        import numpy
+        train, meta = {}, {}
+        for i, unit in enumerate(self.units_in_dependency_order()):
+            if unit is self:
+                continue
+            hook = getattr(unit, "checkpoint_state", None)
+            if hook is None:
+                continue
+            try:
+                state = hook()
+            except Exception:
+                self.exception("checkpoint_state failed on %r", unit)
+                continue
+            if not state:
+                continue
+            key = self._checkpoint_key(i, unit)
+            arrays = {k: v for k, v in state.items()
+                      if isinstance(v, numpy.ndarray)}
+            small = {k: v for k, v in state.items()
+                     if not isinstance(v, numpy.ndarray)}
+            if arrays:
+                train[key] = arrays
+            if small:
+                meta[key] = small
+        return train, meta
+
+    def restore_train_state(self, train, meta):
+        """Install a checkpoint captured by :meth:`capture_train_state`
+        into this (freshly built and initialized) workflow: each
+        contributing unit's ``restore_checkpoint_state(state)`` gets
+        its reassembled dict back."""
+        train = train or {}
+        meta = meta or {}
+        restored = 0
+        for i, unit in enumerate(self.units_in_dependency_order()):
+            if unit is self:
+                continue
+            hook = getattr(unit, "restore_checkpoint_state", None)
+            if hook is None:
+                continue
+            key = self._checkpoint_key(i, unit)
+            state = {}
+            state.update(meta.get(key) or {})
+            state.update(train.get(key) or {})
+            if not state:
+                continue
+            try:
+                hook(state)
+                restored += 1
+            except Exception:
+                self.exception("restore_checkpoint_state failed on %r",
+                               unit)
+        self.info("restored checkpoint state into %d unit(s)", restored)
+        return restored
+
     # -- results / stats ----------------------------------------------------
     def gather_results(self):
         """Collect metrics from IResultProvider units

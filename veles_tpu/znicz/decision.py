@@ -96,9 +96,42 @@ class DecisionBase(Unit):
         start = offsets[cls - 1] if cls > 0 else 0
         return offsets[cls] - start
 
+    # -- master crash-recovery (checkpoint protocol) ------------------------
+    #: plain attributes snapshotted/restored verbatim; subclasses extend
+    CHECKPOINT_ATTRS = ("snapshot_suffix", "_epochs_without_improvement")
+
+    def checkpoint_state(self):
+        """Stop-criteria accounting for master crash-recovery: without
+        it a resumed master would forget its best epoch and improvement
+        streak and train past (or short of) the original stop point."""
+        state = {name: getattr(self, name)
+                 for name in self.CHECKPOINT_ATTRS if hasattr(self, name)}
+        state["complete"] = bool(self.complete)
+        state["improved"] = bool(self.improved)
+        return state
+
+    def restore_checkpoint_state(self, state):
+        for name in self.CHECKPOINT_ATTRS:
+            if name not in state:
+                continue
+            value = state[name]
+            current = getattr(self, name, None)
+            if isinstance(current, list) and \
+                    isinstance(value, (list, tuple)):
+                value = list(value)
+            setattr(self, name, value)
+        if "complete" in state:
+            self.complete <<= bool(state["complete"])
+        if "improved" in state:
+            self.improved <<= bool(state["improved"])
+
 
 class DecisionGD(DecisionBase):
     """Classification decision driven by ``EvaluatorSoftmax.n_err``."""
+
+    CHECKPOINT_ATTRS = DecisionBase.CHECKPOINT_ATTRS + (
+        "epoch_n_err", "epoch_samples", "epoch_n_err_pt",
+        "best_n_err_pt", "best_epoch")
 
     def __init__(self, workflow, **kwargs):
         super(DecisionGD, self).__init__(workflow, **kwargs)
@@ -192,6 +225,10 @@ class DecisionGD(DecisionBase):
 
 class DecisionMSE(DecisionBase):
     """Regression decision driven by ``EvaluatorMSE.mse``."""
+
+    CHECKPOINT_ATTRS = DecisionBase.CHECKPOINT_ATTRS + (
+        "epoch_sum_mse", "epoch_batches", "epoch_mse", "best_mse",
+        "best_epoch")
 
     def __init__(self, workflow, **kwargs):
         super(DecisionMSE, self).__init__(workflow, **kwargs)

@@ -161,6 +161,25 @@ class ForwardBase(AcceleratedUnit):
             self.bias.map_write()
             self.bias.mem += data["delta_bias"]
 
+    # -- master crash-recovery (checkpoint protocol) ------------------------
+    def checkpoint_state(self):
+        """The canonical trainable parameters — what a restarted
+        master must hold to keep merging slave deltas meaningfully."""
+        if not self.weights:
+            return None
+        self.weights.map_read()
+        state = {"weights": numpy.array(self.weights.mem)}
+        if self.include_bias and self.bias:
+            self.bias.map_read()
+            state["bias"] = numpy.array(self.bias.mem)
+        return state
+
+    def restore_checkpoint_state(self, state):
+        if "weights" in state:
+            self.weights.reset(numpy.asarray(state["weights"]))
+        if "bias" in state and self.bias:
+            self.bias.reset(numpy.asarray(state["bias"]))
+
 
 class GradientDescentBase(AcceleratedUnit):
     """Backward layer base: consumes ``err_output`` (+ forward's saved

@@ -477,6 +477,16 @@ class StandardWorkflow(AcceleratedWorkflow):
             self.fused_trainer.sync_weights()
         return super(StandardWorkflow, self).generate_data_for_master()
 
+    def restore_train_state(self, train, meta):
+        restored = super(StandardWorkflow, self).restore_train_state(
+            train, meta)
+        if self.fused and self.fused_trainer is not None:
+            # the checkpoint just replaced the forwards' weight
+            # Vectors — install them into the built device params,
+            # exactly like a job payload does
+            self.fused_trainer.refresh_from_forwards()
+        return restored
+
     # -- results ------------------------------------------------------------
     def gather_results(self):
         from veles_tpu.workflow import ChecksumError
