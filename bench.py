@@ -1098,6 +1098,103 @@ def stage_transformer():
           extra={"remat": remat, "ce_chunk": ce_chunk})
 
 
+def stage_transformer_gen():
+    """Generative serving closed loop (the veles_tpu.gen subsystem):
+    a seeded mixed-length request set pumped through the continuous-
+    batching scheduler, then the SAME workload through the pad-to-
+    slowest static batcher on a fresh engine — identical compiled
+    programs, so the ratio isolates iteration-level admission.
+    Metric = continuous tokens/sec; the record carries batch-fill %,
+    p99 time-to-first-token under the closed-loop load, the
+    vs-static speedup and the steady-state recompile count (must be
+    0 after warmup)."""
+    import numpy
+
+    import jax.numpy as jnp
+    from veles_tpu import prof
+    from veles_tpu.gen import (GenerativeEngine, GenerativeScheduler,
+                               TransformerGenModel, static_generate)
+    from veles_tpu.samples import transformer
+
+    kind = (_device_kind() or "").lower()
+    tiny = os.environ.get("BENCH_GEN_TINY") or "tpu" not in kind
+    if tiny:
+        cfg = dict(transformer.TINY, seq_len=128)
+        slots, max_seq, buckets = 4, 96, (8,)
+        n_requests, long_new, dtype = 48, 64, None
+    else:
+        cfg = {"vocab": 32000, "dim": 512, "heads": 8, "layers": 8,
+               "mlp_ratio": 4, "seq_len": 1024}
+        slots, max_seq, buckets = 8, 768, (32, 64, 128)
+        n_requests, long_new, dtype = 64, 512, jnp.bfloat16
+    rng = numpy.random.default_rng(0)
+    # the serving mix continuous batching exists for: mostly short
+    # interactive generations with a long-form request interleaved
+    # every slots-th — the static batcher pads each group to its
+    # long member, the continuous scheduler backfills the idle rows
+    workload = [
+        (rng.integers(0, cfg["vocab"],
+                      int(rng.integers(1, buckets[0] + 1))).tolist(),
+         long_new if i % slots == 0
+         else int(rng.integers(2, buckets[0] + 1)))
+        for i in range(n_requests)]
+
+    def build():
+        model = TransformerGenModel(
+            cfg, compute_dtype=dtype) if dtype else \
+            TransformerGenModel(cfg)
+        return GenerativeEngine(model, max_slots=slots,
+                                max_seq=max_seq,
+                                prefill_buckets=buckets,
+                                seed=0).warmup()
+
+    engine = build()
+    recompiles0 = prof.ledger.recompiles
+    scheduler = GenerativeScheduler(engine, name="bench")
+    futures = [scheduler.submit(toks, max_new)
+               for toks, max_new in workload]
+    tic = time.perf_counter()
+    scheduler.run_until_idle()
+    cont_sec = time.perf_counter() - tic
+    assert all(f.done() for f in futures)
+    cont_tokens = scheduler.tokens_total
+    recompiles = prof.ledger.recompiles - recompiles0
+    fill = scheduler.batch_fill()
+    ttft_p99_ms = scheduler.ttft.percentile(99) * 1e3
+    engine.close()
+
+    static_engine = build()
+    tic = time.perf_counter()
+    results, _steps = static_generate(static_engine, workload)
+    static_sec = time.perf_counter() - tic
+    static_tokens = sum(len(r) for r in results)
+    static_engine.close()
+
+    cont_tps = cont_tokens / cont_sec if cont_sec else 0.0
+    static_tps = static_tokens / static_sec if static_sec else 0.0
+    rec = {
+        "metric": "transformer generative serving, continuous "
+                  "batching (closed-loop mixed-length)"
+                  + (" [tiny-smoke]" if tiny else ""),
+        "value": round(cont_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "batch_fill": round(fill, 4),
+        "ttft_p99_ms": round(ttft_p99_ms, 2),
+        "vs_static_x": round(cont_tps / static_tps, 3)
+                       if static_tps else None,
+        "static_tokens_per_sec": round(static_tps, 1),
+        "recompiles": recompiles,
+        "slots": slots,
+        "requests": n_requests,
+        "device_kind": _device_kind()}
+    if recompiles:
+        rec["error"] = ("%d steady-state recompile(s) — the AOT "
+                        "bucket/decode plan missed the workload"
+                        % recompiles)
+    print(_dumps(rec))
+
+
 #: the reference DB's fastest recorded matmul: GTX TITAN, float,
 #: precision 0 — 0.1642 s for ONE 3001² matmul (``backends.py:672-731``
 #: stores dt/repeats of DeviceBenchmark(size=3001)), i.e. a measured
@@ -1655,6 +1752,7 @@ STAGES = {
     "kohonen": (stage_kohonen, 150),
     "lstm": (stage_lstm, 180),
     "transformer": (stage_transformer, 240),
+    "transformer_gen": (stage_transformer_gen, 300),
     "power": (stage_power, 240),
     "alexnet": (stage_alexnet, 600),
     "alexnet_e2e": (stage_alexnet_e2e, 450),
@@ -1678,7 +1776,8 @@ _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_wf_eager_devloader", "mnist_wf_slave",
                "cifar", "stl10", "ae",
                "kohonen",
-               "lstm", "transformer", "profile_lm", "attn_bwd", "power",
+               "lstm", "transformer", "transformer_gen", "profile_lm",
+               "attn_bwd", "power",
                "native_infer", "s2d", "alexnet512", "alexnet_e2e",
                "alexnet_epoch", "alexnet_epoch_ab", "profile", "alexnet")
 
@@ -1690,7 +1789,8 @@ _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
 #: after the headline artifacts.
 _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
                "s2d", "alexnet512", "alexnet_e2e", "alexnet_epoch",
-               "alexnet_epoch_ab", "transformer", "profile_lm", "attn_bwd",
+               "alexnet_epoch_ab", "transformer", "transformer_gen",
+               "profile_lm", "attn_bwd",
                "lstm", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "power", "native_infer",
                "cifar", "stl10", "ae", "kohonen", "mnist_wf",
@@ -1703,7 +1803,7 @@ _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
 _CPU_ORDER = ("mnist_e2e", "mnist_epoch", "mnist_wf",
               "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
               "mnist_wf_eager_devloader", "mnist_wf_slave", "ae",
-              "kohonen", "lstm",
+              "kohonen", "lstm", "transformer_gen",
               "native_infer", "mnist_u8", "mnist_bf16", "mnist")
 
 

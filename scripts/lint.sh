@@ -38,3 +38,9 @@ env JAX_PLATFORMS=cpu python -m veles_tpu.prof --smoke veles_tpu.samples.mnist
 # exactly once, dedup/requeue counters consistent (docs/robustness.md)
 echo "== chaos smoke (fault-injection gate) =="
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m veles_tpu.chaos --smoke
+# generative serving smoke: warmup must cover every prefill bucket +
+# the decode program, then a seeded mixed-length continuous-batching
+# session completes with ZERO steady-state compiles (the recompile
+# sentinel stays quiet) and every request at exactly its token budget
+echo "== gen smoke (generative serving gate) =="
+timeout -k 10 120 env JAX_PLATFORMS=cpu python -m veles_tpu.gen --smoke

@@ -273,3 +273,97 @@ def test_pallas_bwd_under_shard_map():
                               atol=5e-4), \
             float(numpy.abs(numpy.asarray(got) -
                             numpy.asarray(ref)).max())
+
+
+# -- decode fast path (q_len=1 against a masked KV buffer) -----------------
+
+def _decode_case(b=3, S=24, h=2, d=16, seed=11):
+    rng = numpy.random.default_rng(seed)
+    mk = lambda shape: jnp.asarray(
+        rng.standard_normal(shape).astype(numpy.float32))
+    return (mk((b, 1, h, d)), mk((b, S, h, d)), mk((b, S, h, d)))
+
+
+def test_decode_dense_matches_prefix_reference():
+    """The dense masked decode reference equals full attention over
+    each row's valid KV prefix — the oracle everything else chains
+    to."""
+    from veles_tpu.ops.attention import _decode_jnp, _mha_jnp
+    q, k, v = _decode_case()
+    lengths = [1, 13, 24]
+    out = _decode_jnp(q, k, v, jnp.asarray(lengths, jnp.int32))
+    for i, n in enumerate(lengths):
+        ref, _ = _mha_jnp(q[i:i + 1], k[i:i + 1, :n], v[i:i + 1, :n],
+                          causal=False)
+        assert numpy.allclose(numpy.asarray(out[i]),
+                              numpy.asarray(ref[0]), atol=1e-5), i
+
+
+def test_decode_pallas_interpret_matches_dense():
+    """Pallas decode kernel (interpret mode) vs the dense masked
+    reference: mixed lengths including a fully-masked tail block and
+    a full-cache row."""
+    from veles_tpu.ops.attention import _decode_jnp, _decode_pallas
+    q, k, v = _decode_case()
+    lengths = jnp.asarray([1, 13, 24], jnp.int32)
+    ref = _decode_jnp(q, k, v, lengths)
+    out = _decode_pallas(q, k, v, lengths, block_k=8, interpret=True)
+    assert out.shape == ref.shape
+    assert numpy.allclose(numpy.asarray(out), numpy.asarray(ref),
+                          atol=2e-5)
+
+
+def test_decode_pallas_ragged_shapes():
+    """Cache length not a block multiple, head dim off the 128 lane
+    boundary — per-tensor padding must stay masked."""
+    from veles_tpu.ops.attention import _decode_jnp, _decode_pallas
+    rng = numpy.random.default_rng(7)
+    mk = lambda shape: jnp.asarray(
+        rng.standard_normal(shape).astype(numpy.float32))
+    q, k, v = mk((2, 1, 3, 20)), mk((2, 29, 3, 20)), mk((2, 29, 3, 20))
+    lengths = jnp.asarray([7, 29], jnp.int32)
+    ref = _decode_jnp(q, k, v, lengths)
+    out = _decode_pallas(q, k, v, lengths, block_k=8, interpret=True)
+    assert numpy.allclose(numpy.asarray(out), numpy.asarray(ref),
+                          atol=2e-5)
+
+
+def test_decode_public_entry_squeezes_and_jits():
+    """decode_attention accepts (b, h, d) queries, returns the same
+    leading shape, and traces under jit with traced lengths (the
+    engine's fixed-shape decode program)."""
+    from veles_tpu.ops.attention import _decode_jnp, decode_attention
+    q, k, v = _decode_case(seed=3)
+    lengths = jnp.asarray([5, 9, 2], jnp.int32)
+    ref = _decode_jnp(q, k, v, lengths)
+    out3 = decode_attention(q[:, 0], k, v, lengths, use_pallas=False)
+    assert out3.shape == (3, 2, 16)
+    assert numpy.allclose(numpy.asarray(out3), numpy.asarray(ref[:, 0]),
+                          atol=1e-6)
+    jitted = jax.jit(lambda q, k, v, n: decode_attention(
+        q, k, v, n, use_pallas=False))
+    outj = jitted(q, k, v, lengths)
+    assert numpy.allclose(numpy.asarray(outj), numpy.asarray(ref),
+                          atol=1e-6)
+
+
+def test_decode_row_independence():
+    """A slot's output is bitwise independent of what other slots
+    hold — the property continuous batching's parity gate rests on."""
+    from veles_tpu.ops.attention import _decode_jnp
+    q, k, v = _decode_case(seed=19)
+    lengths = jnp.asarray([9, 4, 17], jnp.int32)
+    base = numpy.asarray(_decode_jnp(q, k, v, lengths))
+    # scramble every OTHER row's query and cache (valid and garbage)
+    rng = numpy.random.default_rng(23)
+    for i in range(3):
+        q2 = numpy.array(q)
+        k2 = numpy.array(k)
+        v2 = numpy.array(v)
+        others = [j for j in range(3) if j != i]
+        q2[others] = rng.standard_normal(q2[others].shape)
+        k2[others] = rng.standard_normal(k2[others].shape)
+        v2[others] = rng.standard_normal(v2[others].shape)
+        out = numpy.asarray(_decode_jnp(
+            jnp.asarray(q2), jnp.asarray(k2), jnp.asarray(v2), lengths))
+        assert (out[i] == base[i]).all(), i

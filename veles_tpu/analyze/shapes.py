@@ -77,6 +77,15 @@ RULES = {
               "computed value — XLA silently recompiles on every "
               "new value; the prof recompile sentinel is this "
               "check's runtime twin"),
+    "V-S01": ("error",
+              "generative serving preflight: the engine's slot-major "
+              "KV cache does not fit device HBM next to the params, "
+              "the slot/bucket plan is unservable (bucket beyond "
+              "max_seq, max_seq beyond the model's positional table, "
+              "zero slots), or the model is not causal — "
+              "autoregressive decode over a cache is meaningless "
+              "without a causal mask; checked at ModelRegistry"
+              ".deploy_generative time"),
 }
 
 #: dotted call names that force a device→host sync
@@ -729,3 +738,117 @@ def check_shapes(workflow, sample_shape=None, batch_size=None):
                     "jnp.asarray(c, x.dtype)"))
         x = jax.ShapeDtypeStruct(tuple(out.shape), out.dtype)
     return findings
+
+
+# -- V-S01: generative serving preflight ------------------------------------
+
+def check_generative(engine, hbm_bytes=None):
+    """Deploy-time plan check for a :class:`veles_tpu.gen.engine
+    .GenerativeEngine` (rule V-S01) — pure host arithmetic over the
+    engine's declared plan, no compiles, no device work.
+
+    Three failure families, one rule ID:
+
+    - **model shape** — a non-causal model cannot be decoded
+      autoregressively against a KV cache (every step would need the
+      future it has not generated);
+    - **slot/bucket plan** — buckets beyond ``max_seq``, ``max_seq``
+      beyond the model's positional table, or zero slots are
+      unservable by construction;
+    - **KV footprint** — cache + params must fit the device's HBM
+      (``hbm_bytes`` override for tests; the live table is
+      :func:`veles_tpu.backends.device_hbm_bytes`, and unknown/CPU
+      devices degrade to plan-sanity only).
+
+    Returns a :class:`~veles_tpu.analyze.findings.Report`;
+    ``ModelRegistry.deploy_generative`` maps its errors through
+    ``root.common.serve.preflight``.
+    """
+    from veles_tpu.analyze.findings import Report
+
+    findings = []
+    model = getattr(engine, "model", None)
+    if model is not None and not getattr(model, "causal", True):
+        findings.append(Finding(
+            *_rule("V-S01"),
+            message="model %s is not causal — autoregressive decode "
+                    "over a KV cache requires a causal mask"
+                    % type(model).__name__,
+            fix="serve this model through the request/response "
+                "engine (ModelRegistry.deploy), or make its "
+                "attention causal"))
+    max_slots = int(getattr(engine, "max_slots", 0) or 0)
+    max_seq = int(getattr(engine, "max_seq", 0) or 0)
+    buckets = tuple(getattr(engine, "prefill_buckets", ()) or ())
+    if max_slots < 1:
+        findings.append(Finding(
+            *_rule("V-S01"),
+            message="max_slots is %d — no KV slot can ever be "
+                    "admitted" % max_slots,
+            fix="configure at least one slot"))
+    if not buckets:
+        findings.append(Finding(
+            *_rule("V-S01"),
+            message="no prefill buckets declared — no prompt length "
+                    "is servable",
+            fix="declare at least one prefill bucket <= max_seq"))
+    elif buckets[-1] > max_seq:
+        findings.append(Finding(
+            *_rule("V-S01"),
+            message="largest prefill bucket %d exceeds max_seq %d — "
+                    "its prompts could never decode" % (buckets[-1],
+                                                        max_seq),
+            fix="drop buckets beyond max_seq (or raise max_seq)"))
+    seq_limit = int(getattr(model, "seq_limit", max_seq) or max_seq)
+    if max_seq > seq_limit:
+        findings.append(Finding(
+            *_rule("V-S01"),
+            message="max_seq %d exceeds the model's positional table "
+                    "%d — decode would index past the trained "
+                    "embeddings" % (max_seq, seq_limit),
+            fix="cap max_seq at the model's seq_len"))
+    if buckets and len(buckets) > 8:
+        findings.append(Finding(
+            "warning", "V-S01",
+            message="%d prefill buckets — every one is a warmed XLA "
+                    "program; a handful of powers of two usually "
+                    "covers the prompt distribution" % len(buckets),
+            fix="thin the bucket set"))
+
+    kv_bytes = int(getattr(engine, "kv_cache_bytes", 0) or 0)
+    params_bytes = 0
+    try:
+        import jax
+        params_bytes = sum(
+            int(leaf.size) * int(leaf.dtype.itemsize)
+            for leaf in jax.tree.leaves(getattr(engine, "_params",
+                                                None) or ())
+            if hasattr(leaf, "size"))
+    except Exception:
+        pass
+    if hbm_bytes is None:
+        from veles_tpu.backends import device_hbm_bytes
+        from veles_tpu.prof import device_kind
+        hbm_bytes = device_hbm_bytes(device_kind())
+    if hbm_bytes:
+        budget = 0.9 * float(hbm_bytes)   # runtime/temp headroom
+        if kv_bytes + params_bytes > budget:
+            findings.append(Finding(
+                *_rule("V-S01"),
+                message="KV cache %.2f GiB + params %.2f GiB exceed "
+                        "90%% of device HBM (%.1f GiB) — admission "
+                        "would OOM at the first full batch"
+                        % (kv_bytes / 2 ** 30, params_bytes / 2 ** 30,
+                           hbm_bytes / 2 ** 30),
+                fix="shrink max_slots/max_seq, shard the cache over "
+                    "more devices (mesh model axis), or serve a "
+                    "smaller model"))
+        elif kv_bytes > 0.5 * float(hbm_bytes):
+            findings.append(Finding(
+                "warning", "V-S01",
+                message="KV cache %.2f GiB is over half of device HBM "
+                        "(%.1f GiB) — params + activations share the "
+                        "rest" % (kv_bytes / 2 ** 30,
+                                  hbm_bytes / 2 ** 30),
+                fix="consider fewer slots or a shorter max_seq"))
+    return Report(findings, passes=["generative"])

@@ -46,6 +46,20 @@ PEAK_BF16_FLOPS = (
     ("v2", 22.5e12),
 )
 
+#: HBM bytes per *jax device* (same core-vs-chip granularity as the
+#: peak table: v2/v3 devices are single TensorCores owning half the
+#: chip's memory) — the generative preflight's KV-footprint budget
+#: (analyzer rule V-S01); CPU/unknown kinds return None and the check
+#: degrades to plan sanity only
+DEVICE_HBM_BYTES = (
+    ("v6", 32 << 30),
+    ("v5p", 95 << 30),
+    ("v5", 16 << 30),
+    ("v4", 32 << 30),
+    ("v3", 16 << 30),
+    ("v2", 8 << 30),
+)
+
 
 _compile_cache_enabled = False
 
@@ -100,6 +114,15 @@ def peak_bf16_flops(device_kind):
     for tag, peak in PEAK_BF16_FLOPS:
         if tag in kind:
             return peak
+    return None
+
+
+def device_hbm_bytes(device_kind):
+    """HBM bytes for a jax device kind, or None (CPU/unknown)."""
+    kind = (device_kind or "").lower()
+    for tag, nbytes in DEVICE_HBM_BYTES:
+        if tag in kind:
+            return nbytes
     return None
 
 

@@ -1,0 +1,41 @@
+"""veles_tpu.gen — continuously-batched generative serving.
+
+The autoregressive half of the serving stack (ROADMAP item 3): the
+request/response engine (:mod:`veles_tpu.serve`) answers one forward
+per request; this package serves token STREAMS from a device-resident
+KV cache with iteration-level scheduling.  Pieces:
+
+- :mod:`model` — the generative model protocol (prefill + one decode
+  step over a slot-major KV cache) and
+  :class:`~veles_tpu.gen.model.TransformerGenModel`, the adapter for
+  the ``samples/transformer.py`` LM family.
+- :mod:`engine` — :class:`~veles_tpu.gen.engine.GenerativeEngine`:
+  AOT-compiled prefill buckets + ONE fixed-shape decode program,
+  KV cache in the HBM ledger's ``kv`` category, tensor-parallel
+  sharded forward over a ``model``-axis mesh with transparent
+  single-device fallback.
+- :mod:`scheduler` — :class:`~veles_tpu.gen.scheduler
+  .GenerativeScheduler`: continuous batching (admit into open slots
+  every decode iteration, evict at finish, stream tokens per
+  request) and :func:`~veles_tpu.gen.scheduler.static_generate`, the
+  pad-to-slowest baseline it is benchmarked against.
+
+Deployment rides the existing registry
+(``ModelRegistry.deploy_generative`` — analyzer rule V-S01 preflights
+the KV footprint and model shape) and the HTTP front-end
+(``POST /generate[/<model>]``, optionally streaming ndjson).  See
+``docs/services.md`` § Generative serving.
+
+``python -m veles_tpu.gen --smoke`` is the CI gate: warmup, then a
+mixed-length closed-loop session with ZERO steady-state compiles.
+"""
+
+from veles_tpu.gen.engine import GenerativeEngine  # noqa: F401
+from veles_tpu.gen.model import TransformerGenModel  # noqa: F401
+from veles_tpu.gen.scheduler import (  # noqa: F401
+    GenerativeScheduler, static_generate)
+
+__all__ = [
+    "GenerativeEngine", "GenerativeScheduler", "TransformerGenModel",
+    "static_generate",
+]

@@ -1,0 +1,108 @@
+"""``python -m veles_tpu.gen --smoke`` — the generative serving gate.
+
+Wired into ``scripts/lint.sh`` next to the prof and chaos smokes: a
+tiny transformer engine must (1) warm every prefill bucket plus the
+decode program, (2) complete a seeded mixed-length continuous-batching
+session with ZERO steady-state compiles (the recompile sentinel stays
+quiet), and (3) resolve every request with exactly its budgeted token
+count.  Exit code 0 on success; any violation prints the failure and
+exits 1 — the same contract the serve engine's warmup gate enforces
+for the request/response path.
+"""
+
+import argparse
+import sys
+
+import numpy
+
+
+def make_parser():
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu.gen",
+        description="Generative serving smoke gate (warmup -> zero "
+                    "steady-state compiles -> mixed-length session).")
+    parser.add_argument("--smoke", action="store_true",
+                        help="run the CI smoke gate")
+    parser.add_argument("--slots", type=int, default=4)
+    parser.add_argument("--max-seq", type=int, default=48)
+    parser.add_argument("--requests", type=int, default=16)
+    parser.add_argument("--seed", type=int, default=0)
+    return parser
+
+
+def smoke(slots=4, max_seq=48, requests=16, seed=0):
+    import time
+
+    from veles_tpu import prof
+    from veles_tpu.gen import (GenerativeEngine, GenerativeScheduler,
+                               TransformerGenModel)
+    from veles_tpu.samples.transformer import TINY
+
+    cfg = dict(TINY, seq_len=max(64, max_seq))
+    model = TransformerGenModel(cfg)
+    engine = GenerativeEngine(model, max_slots=slots, max_seq=max_seq,
+                              prefill_buckets=(8, 16, 32), seed=seed)
+    engine.warmup()
+    warm_compiles = engine.compile_count
+    want_compiles = len(engine.prefill_buckets) + 1
+    if warm_compiles != want_compiles:
+        print("FAIL: warmup compiled %d programs, want %d"
+              % (warm_compiles, want_compiles))
+        return 1
+    recompiles_before = prof.ledger.recompiles
+
+    rng = numpy.random.default_rng(seed)
+    workload = [
+        (rng.integers(0, cfg["vocab"],
+                      int(rng.integers(1, 30))).tolist(),
+         int(rng.integers(1, 14)))
+        for _ in range(requests)]
+    scheduler = GenerativeScheduler(engine, name="smoke")
+    futures = [scheduler.submit(toks, max_new)
+               for toks, max_new in workload]
+    tic = time.perf_counter()
+    scheduler.run_until_idle()
+    elapsed = time.perf_counter() - tic
+
+    failed = 0
+    for future, (_toks, max_new) in zip(futures, workload):
+        if not future.done():
+            print("FAIL: request with budget %d never resolved"
+                  % max_new)
+            failed += 1
+            continue
+        got = future.result(0)
+        if len(got) != max_new:
+            print("FAIL: got %d tokens, budget %d" % (len(got),
+                                                      max_new))
+            failed += 1
+    if engine.compile_count != warm_compiles:
+        print("FAIL: %d steady-state compile(s) after warmup"
+              % (engine.compile_count - warm_compiles))
+        failed += 1
+    if prof.ledger.recompiles != recompiles_before:
+        print("FAIL: recompile sentinel flagged %d event(s)"
+              % (prof.ledger.recompiles - recompiles_before))
+        failed += 1
+    tokens = scheduler.tokens_total
+    print("gen smoke: %d requests, %d tokens in %.2fs "
+          "(%.1f tok/s), batch fill %.0f%%, %d compiles "
+          "(all warmup), 0 steady-state recompiles"
+          % (len(workload), tokens, elapsed,
+             tokens / elapsed if elapsed else 0.0,
+             100.0 * scheduler.batch_fill(), warm_compiles))
+    engine.close()
+    return 1 if failed else 0
+
+
+def main(argv=None):
+    args = make_parser().parse_args(argv)
+    if not args.smoke:
+        make_parser().print_help()
+        return 2
+    return smoke(slots=args.slots, max_seq=args.max_seq,
+                 requests=args.requests, seed=args.seed)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

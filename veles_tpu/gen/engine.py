@@ -1,0 +1,361 @@
+"""GenerativeEngine: AOT prefill buckets + ONE decode program over a
+slot-major, device-resident KV cache.
+
+The generative counterpart of :class:`veles_tpu.serve.engine
+.InferenceEngine` and the same compile discipline: a *small set* of
+prefill programs (one per prompt-length bucket) plus exactly one
+fixed-shape decode-step program are lowered and compiled up front
+(:meth:`warmup`), so steady-state serving — any interleaving of
+admissions and decode iterations — never triggers XLA.  The recompile
+sentinel holds the engine to it exactly like serve buckets: a compile
+after ``warmup()`` is flagged.
+
+The KV cache is ``{"k", "v"}: [layers, slots, max_seq, heads,
+head_dim]`` device arrays, donated through every program call (the
+cache never round-trips to host, and XLA updates it in place), and
+registered in the HBM ledger under the ``kv`` category reserved since
+the PR 6 residency work — ``wf.perf_report()`` / ``/metrics`` show the
+cache's exact footprint next to params/dataset/staging.
+
+Tensor parallelism is declarative (``parallel/tp.py`` rules): given a
+mesh with a ``model`` axis, block weights shard column→row, the KV
+cache shards over heads, and the SAME traced functions compile to a
+pjit'd program — no mesh (or a 1-sized model axis) falls back to
+single-device compilation transparently.
+"""
+
+import itertools
+import threading
+import time
+
+import numpy
+
+from veles_tpu import prof, trace
+from veles_tpu.logger import Logger
+
+#: per-process engine sequence for performance-ledger entry names
+_GEN_SEQ = itertools.count()
+
+
+def _power_of_two_buckets(lo, hi):
+    buckets, b = [], lo
+    while b < hi:
+        buckets.append(b)
+        b *= 2
+    buckets.append(hi)
+    return tuple(buckets)
+
+
+class GenerativeEngine(Logger):
+    """Slot-based generative inference over a protocol model
+    (:mod:`veles_tpu.gen.model`).
+
+    Host-side slot bookkeeping (lengths, last tokens, free list) lives
+    here; the scheduler (:mod:`veles_tpu.gen.scheduler`) decides WHEN
+    to admit and evict.  All device state is functional: every program
+    returns the successor cache and the engine swaps the reference, so
+    a failed dispatch can never leave a half-written cache visible.
+
+    Greedy sampling (argmax) happens inside the compiled programs —
+    tokens come back as int32 scalars, never logits, so a decode step
+    moves ``slots * 4`` bytes D2H and the parity gate is a bitwise
+    token comparison.
+    """
+
+    def __init__(self, model, params=None, *, max_slots=4,
+                 max_seq=None, prefill_buckets=None, mesh=None,
+                 eos_id=None, seed=0, **kwargs):
+        super(GenerativeEngine, self).__init__(**kwargs)
+        import jax
+        self._jax = jax
+        self.model = model
+        self.max_slots = int(max_slots)
+        if self.max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.max_seq = int(max_seq or model.seq_limit)
+        if self.max_seq < 2 or self.max_seq > model.seq_limit:
+            raise ValueError(
+                "max_seq %d out of range (2..%d, the model's "
+                "positional table)" % (self.max_seq, model.seq_limit))
+        self.prefill_buckets = tuple(sorted(set(
+            int(b) for b in (prefill_buckets
+                             or _power_of_two_buckets(
+                                 min(8, self.max_seq), self.max_seq)))))
+        if (self.prefill_buckets[0] < 1
+                or self.prefill_buckets[-1] > self.max_seq):
+            raise ValueError(
+                "prefill buckets %s must lie in 1..max_seq=%d"
+                % (self.prefill_buckets, self.max_seq))
+        self.eos_id = None if eos_id is None else int(eos_id)
+        # a mesh without a >1 model axis IS the single-device path
+        self.mesh = mesh if (mesh is not None and
+                             mesh.shape.get("model", 1) > 1) else None
+        if self.mesh is not None and \
+                model.heads % self.mesh.shape["model"]:
+            raise ValueError(
+                "model axis %d does not divide %d heads"
+                % (self.mesh.shape["model"], model.heads))
+
+        if params is None:
+            params = model.init_params(seed=seed)
+        self._shardings = self._build_shardings()
+        if self._shardings is None:
+            self._params = jax.device_put(params)
+            self._cache = model.init_cache(self.max_slots, self.max_seq)
+        else:
+            p_sh, c_sh = self._shardings[:2]
+            self._params = jax.device_put(params, p_sh)
+            self._cache = jax.tree.map(
+                lambda a, s: jax.device_put(a, s),
+                model.init_cache(self.max_slots, self.max_seq), c_sh)
+        #: the cache's exact footprint, held in the HBM ledger's kv
+        #: category for the engine's lifetime
+        self.kv_cache_bytes = model.cache_nbytes(self.max_slots,
+                                                 self.max_seq)
+        from veles_tpu.memory import Watcher
+        Watcher.track(self.kv_cache_bytes, "kv", owner=self)
+        self._kv_tracked = True
+
+        # host slot bookkeeping (single scheduler thread)
+        self.slot_len = numpy.zeros(self.max_slots, numpy.int32)
+        self.slot_token = numpy.zeros(self.max_slots, numpy.int32)
+        self.slot_active = numpy.zeros(self.max_slots, bool)
+        self._free = list(range(self.max_slots))
+
+        self._prefill_exe = {}
+        self._decode_exe = None
+        self._compile_lock = threading.Lock()
+        self.compile_count = 0
+        self.decode_calls = 0
+        self.prefill_calls = 0
+        self._warmed = False
+        self.prof_name = "gen%d" % next(_GEN_SEQ)
+        self._prof_entries = {}
+
+    # -- sharding ----------------------------------------------------------
+    def _build_shardings(self):
+        if self.mesh is None:
+            return None
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self.mesh
+
+        def named(spec_tree):
+            return jax.tree.map(
+                lambda s: NamedSharding(mesh, s), spec_tree,
+                is_leaf=lambda x: isinstance(x, P))
+
+        return (named(self.model.param_specs()),
+                named(self.model.cache_spec()),
+                NamedSharding(mesh, P()))
+
+    # -- compilation -------------------------------------------------------
+    def _struct_of(self, tree):
+        jax = self._jax
+        return jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+    def _compile(self, fn, args, kind, name, flops):
+        """Lower + AOT-compile ``fn`` at ``args``' shapes (cache
+        donated), register the ledger entry with the model's ANALYTIC
+        flops (the layer scan makes ``cost_analysis`` depth-blind),
+        and flag any post-warmup compile as a steady-state recompile —
+        the serve-bucket discipline."""
+        jax = self._jax
+        with self._compile_lock:
+            jit_kwargs = {"donate_argnums": (1,)}
+            if self._shardings is not None:
+                p_sh, c_sh, repl = self._shardings
+                extra = tuple(repl for _ in range(len(args) - 2))
+                jit_kwargs["in_shardings"] = (p_sh, c_sh) + extra
+                jit_kwargs["out_shardings"] = (c_sh, repl)
+            span_args = {"program": name, "engine": self.prof_name}
+            with trace.span("serve", "compile_gen", span_args,
+                            role="server"):
+                jitted = jax.jit(fn, **jit_kwargs)
+                exe = jitted.lower(*self._struct_of(args)).compile()
+                cost, new_args = prof.span_cost_args(exe, span_args)
+                cost["flops"] = float(flops)
+                new_args["flops"] = float(flops)
+                span_args.update(new_args)
+                if self._warmed:
+                    span_args["recompile"] = True
+            self.compile_count += 1
+            entry = self._prof_entries.get((kind, name))
+            if entry is None:
+                entry = self._prof_entries[(kind, name)] = \
+                    prof.ledger.entry(kind,
+                                      "%s[%s]" % (self.prof_name, name))
+            prof.ledger.record_compile(entry, cost=cost,
+                                       steady=self._warmed)
+            self.debug("compiled %s (compile #%d)", name,
+                       self.compile_count)
+            if self._warmed:
+                prof.flag_recompile(
+                    "gen:%s:%s" % (self.prof_name, name), None, None,
+                    logger=self,
+                    detail="%s compiled after warmup() — generative "
+                           "steady state must reuse the AOT programs"
+                           % name)
+        return exe, entry
+
+    def _prefill_executable(self, bucket):
+        exe = self._prefill_exe.get(bucket)
+        if exe is None:
+            jnp = self._jax.numpy
+            args = (self._params, self._cache,
+                    jnp.zeros((1, bucket), jnp.int32),
+                    jnp.int32(0), jnp.int32(1))
+            exe = self._prefill_exe[bucket] = self._compile(
+                self.model.prefill, args, "prefill", "p%d" % bucket,
+                self.model.prefill_flops(bucket))
+        return exe
+
+    def _decode_executable(self):
+        if self._decode_exe is None:
+            jnp = self._jax.numpy
+            args = (self._params, self._cache,
+                    jnp.zeros((self.max_slots,), jnp.int32),
+                    jnp.zeros((self.max_slots,), jnp.int32))
+            self._decode_exe = self._compile(
+                self.model.decode, args, "decode", "decode",
+                self.model.decode_flops(self.max_slots, self.max_seq))
+        return self._decode_exe
+
+    def warmup(self):
+        """AOT-compile the decode step and every prefill bucket;
+        afterwards ANY compile is a flagged steady-state recompile.
+        Returns self (chainable)."""
+        self._decode_executable()
+        for bucket in self.prefill_buckets:
+            self._prefill_executable(bucket)
+        self._warmed = True
+        return self
+
+    # -- slot accounting ---------------------------------------------------
+    def bucket_for(self, n):
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        raise ValueError(
+            "prompt of %d tokens exceeds the largest prefill bucket "
+            "%d" % (n, self.prefill_buckets[-1]))
+
+    @property
+    def free_slots(self):
+        return len(self._free)
+
+    def active_slots(self):
+        return int(self.slot_active.sum())
+
+    def occupancy(self):
+        return self.active_slots() / float(self.max_slots)
+
+    def release_slot(self, slot):
+        if not self.slot_active[slot]:
+            raise ValueError("slot %d is not active" % slot)
+        self.slot_active[slot] = False
+        self.slot_len[slot] = 0
+        # keep admission deterministic: the free list stays sorted so
+        # the same request mix always lands in the same slots
+        import bisect
+        bisect.insort(self._free, slot)
+
+    # -- serving -----------------------------------------------------------
+    def prefill(self, tokens):
+        """Admit one prompt into a free slot: returns ``(slot,
+        first_token)``.  Raises ``RuntimeError`` when no slot is free
+        (the scheduler checks ``free_slots`` first) and ``ValueError``
+        on an unservable prompt."""
+        jnp = self._jax.numpy
+        tokens = numpy.ascontiguousarray(tokens,
+                                         numpy.int32).ravel()
+        n = len(tokens)
+        if n < 1:
+            raise ValueError("empty prompt")
+        if n >= self.max_seq:
+            raise ValueError(
+                "prompt of %d tokens leaves no room to generate "
+                "(max_seq %d)" % (n, self.max_seq))
+        bucket = self.bucket_for(n)
+        if not self._free:
+            raise RuntimeError("no free slot (all %d busy)"
+                               % self.max_slots)
+        slot = self._free.pop(0)
+        padded = numpy.zeros(bucket, numpy.int32)
+        padded[:n] = tokens
+        exe, entry = self._prefill_executable(bucket)
+        self.prefill_calls += 1
+        with trace.span("gen", "prefill",
+                        {"bucket": bucket, "slot": slot, "len": n,
+                         "engine": self.prof_name}, role="server"):
+            tic = time.perf_counter_ns()
+            self._cache, tok = exe(self._params, self._cache,
+                                   jnp.asarray(padded[None]),
+                                   jnp.int32(slot), jnp.int32(n))
+            tok = int(tok)
+            prof.ledger.record_dispatch(
+                entry, time.perf_counter_ns() - tic, items=n)
+        self.slot_len[slot] = n
+        self.slot_token[slot] = tok
+        self.slot_active[slot] = True
+        return slot, tok
+
+    def decode_step(self):
+        """ONE fixed-shape decode iteration over every slot.  Returns
+        ``(tokens, active)`` host arrays — ``tokens[slot]`` is only
+        meaningful where ``active[slot]`` — or ``None`` when nothing
+        is active (no device call)."""
+        if not self.slot_active.any():
+            return None
+        jnp = self._jax.numpy
+        active = self.slot_active.copy()
+        if (self.slot_len[active] >= self.max_seq).any():
+            raise RuntimeError(
+                "active slot at max_seq %d — the scheduler must evict "
+                "full sequences before decoding" % self.max_seq)
+        positions = numpy.where(active, self.slot_len, 0
+                                ).astype(numpy.int32)
+        toks = numpy.where(active, self.slot_token, 0
+                           ).astype(numpy.int32)
+        exe, entry = self._decode_executable()
+        self.decode_calls += 1
+        n_active = int(active.sum())
+        with trace.span("gen", "decode",
+                        {"active": n_active, "engine": self.prof_name},
+                        role="server"):
+            tic = time.perf_counter_ns()
+            self._cache, out = exe(self._params, self._cache,
+                                   jnp.asarray(toks),
+                                   jnp.asarray(positions))
+            out = numpy.asarray(out)
+            prof.ledger.record_dispatch(
+                entry, time.perf_counter_ns() - tic, items=n_active)
+        self.slot_len[active] += 1
+        self.slot_token[active] = out[active]
+        return out, active
+
+    # -- lifecycle / introspection -----------------------------------------
+    def describe(self):
+        return {
+            "model": type(self.model).__name__,
+            "max_slots": self.max_slots,
+            "max_seq": self.max_seq,
+            "prefill_buckets": list(self.prefill_buckets),
+            "kv_cache_bytes": self.kv_cache_bytes,
+            "sharded": self.mesh is not None,
+            "compile_count": self.compile_count,
+            "active_slots": self.active_slots(),
+            "decode_calls": self.decode_calls,
+            "prefill_calls": self.prefill_calls,
+        }
+
+    def close(self):
+        """Release the KV cache (and its ledger hold).  Idempotent."""
+        if getattr(self, "_kv_tracked", False):
+            from veles_tpu.memory import Watcher
+            Watcher.untrack(self.kv_cache_bytes, "kv", owner=self)
+            self._kv_tracked = False
+        self._cache = None
+        self._prefill_exe = {}
+        self._decode_exe = None

@@ -1,0 +1,245 @@
+"""Generative model protocol + the decoder-only transformer adapter.
+
+The :class:`GenerativeEngine` compiles exactly two program families —
+per-bucket **prefill** and ONE fixed-shape **decode step** — against a
+model object exposing this protocol:
+
+- ``causal`` (bool), ``vocab``, ``seq_limit`` attributes;
+- ``init_params(seed)`` → host param pytree;
+- ``init_cache(slots, max_seq)`` → slot-major KV cache pytree
+  (``{"k": [L, slots, S, h, dh], "v": ...}``);
+- ``prefill(params, cache, tokens, slot, length)`` → ``(cache',
+  next_token)`` — run the prompt through the stack, write its K/V
+  into cache slot ``slot``, return the greedy next token;
+- ``decode(params, cache, tokens, positions)`` → ``(cache',
+  next_tokens)`` — ONE autoregressive step over every slot at once.
+
+Both functions must be jit-traceable with ``slot``/``length``/
+``positions`` as traced int32 values (fixed shapes → the engine's
+zero-steady-state-compile guarantee) and **row-independent across
+slots**: slot ``i``'s outputs may depend only on slot ``i``'s query
+and its valid cache prefix.  That independence is what makes
+continuous batching bit-exact against sequential decode (the parity
+gate in ``tests/test_gen.py``); :func:`veles_tpu.ops.attention
+.decode_attention` provides it for the attention read.
+
+:class:`TransformerGenModel` adapts the :mod:`veles_tpu.samples
+.transformer` parameter layout (stacked blocks, tied readout) so the
+LM the platform trains is the LM it serves.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.ops.attention import decode_attention, flash_attention
+
+
+def _layernorm(x, g, b):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+
+class TransformerGenModel(object):
+    """Decoder-only transformer (``samples/transformer.py`` params)
+    with a slot-major KV cache.
+
+    ``compute_dtype`` defaults to float32 — bit-exact greedy decode on
+    CPU and the parity tests' substrate; serving deployments on TPU
+    pass ``jnp.bfloat16``.  ``use_pallas`` forces the attention
+    backend (None = auto: Pallas on TPU, dense jnp elsewhere) — one
+    resolution at construction so every compiled program agrees.
+    """
+
+    causal = True
+
+    def __init__(self, cfg, compute_dtype=None, use_pallas=None):
+        self.cfg = dict(cfg)
+        self.vocab = int(cfg["vocab"])
+        self.dim = int(cfg["dim"])
+        self.heads = int(cfg["heads"])
+        self.layers = int(cfg["layers"])
+        if self.dim % self.heads:
+            raise ValueError("dim %d not divisible by heads %d"
+                             % (self.dim, self.heads))
+        self.head_dim = self.dim // self.heads
+        self.seq_limit = int(cfg["seq_len"])
+        self.compute_dtype = compute_dtype or jnp.float32
+        self.use_pallas = use_pallas
+
+    # -- params / cache ----------------------------------------------------
+    def init_params(self, seed=0):
+        from veles_tpu.samples.transformer import init_params
+        return init_params(self.cfg, seed=seed)
+
+    def cache_shape(self, slots, max_seq):
+        return (self.layers, int(slots), int(max_seq), self.heads,
+                self.head_dim)
+
+    def init_cache(self, slots, max_seq, dtype=None):
+        shape = self.cache_shape(slots, max_seq)
+        dtype = dtype or self.compute_dtype
+        return {"k": jnp.zeros(shape, dtype),
+                "v": jnp.zeros(shape, dtype)}
+
+    def cache_nbytes(self, slots, max_seq, dtype=None):
+        shape = self.cache_shape(slots, max_seq)
+        itemsize = jnp.dtype(dtype or self.compute_dtype).itemsize
+        return 2 * int(numpy.prod(shape)) * itemsize
+
+    # -- sharding rules (tensor parallelism over the model axis) -----------
+    def param_specs(self):
+        """PartitionSpec pytree: Megatron column→row pairs for the
+        block weights (same rules the training side's
+        ``transformer.param_specs`` applies), embed/pos/norms
+        replicated."""
+        from jax.sharding import PartitionSpec as P
+        from veles_tpu.parallel.tp import column_parallel, shard_dim
+        rules = {
+            "wqkv": shard_dim(5, 3),     # heads: column-parallel qkv
+            "wo": shard_dim(4, 1),       # heads in: row-parallel
+            "w1": column_parallel(3),
+            "b1": column_parallel(2),
+            "w2": shard_dim(3, 1),       # hidden in: row-parallel
+        }
+
+        def walk(tree):
+            return {key: walk(leaf) if isinstance(leaf, dict)
+                    else rules.get(key, P())
+                    for key, leaf in tree.items()}
+
+        return walk(self.init_params(seed=0))
+
+    def cache_spec(self):
+        """KV cache sharded over heads (dim 3 of [L, slots, S, h, dh])
+        — each model shard owns its heads' cache, matching the
+        column-parallel qkv that produces them (no resharding between
+        projection and cache write)."""
+        from jax.sharding import PartitionSpec as P
+        spec = P(None, None, None, "model", None)
+        return {"k": spec, "v": spec}
+
+    # -- forwards ----------------------------------------------------------
+    def _attend_prefill(self, q, k, v):
+        # the existing flash path: Pallas kernel on TPU (q_offset=0
+        # start-aligned causal mask), XLA-fused fallback elsewhere —
+        # resolved once via use_pallas so recompiles can't flip it
+        return flash_attention(q, k, v, True, None, None,
+                               self.use_pallas)
+
+    def prefill(self, params, cache, tokens, slot, length):
+        """tokens (1, bucket) int32 (zero-padded past ``length``),
+        ``slot``/``length`` traced int32 scalars → (cache', greedy
+        next token).  The causal mask makes the padded tail invisible
+        to position ``length - 1``, so the bucket shape never leaks
+        into the returned token; the tail's garbage K/V lands in the
+        cache but stays masked (and is progressively overwritten) by
+        the decode step's length mask."""
+        cd = self.compute_dtype
+        bucket = tokens.shape[1]
+        h = params["embed"][tokens] + params["pos"][:bucket]
+
+        def layer(h, xs):
+            blk, kc, vc = xs
+            x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
+            qkv = jnp.einsum("bsd,dchx->bschx", x.astype(cd),
+                             blk["wqkv"].astype(cd))
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            att = self._attend_prefill(q, k, v)
+            proj = jnp.einsum("bshx,hxd->bsd", att.astype(cd),
+                              blk["wo"].astype(cd))
+            h = h + proj.astype(h.dtype)
+            x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
+            up = (x.astype(cd) @ blk["w1"].astype(cd)
+                  + blk["b1"].astype(cd))
+            down = (jax.nn.gelu(up) @ blk["w2"].astype(cd)
+                    + blk["b2"].astype(cd))
+            h = h + down.astype(h.dtype)
+            kc = jax.lax.dynamic_update_slice(
+                kc, k[0].astype(kc.dtype)[None], (slot, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v[0].astype(vc.dtype)[None], (slot, 0, 0, 0))
+            return h, (kc, vc)
+
+        h, (ks, vs) = jax.lax.scan(
+            layer, h, (params["blocks"], cache["k"], cache["v"]))
+        h = _layernorm(h, params["lnf_g"], params["lnf_b"])
+        last = jax.lax.dynamic_slice_in_dim(h[0], length - 1, 1,
+                                            axis=0)[0]
+        logits = jnp.einsum("d,vd->v", last.astype(cd),
+                            params["embed"].astype(cd)
+                            ).astype(jnp.float32)
+        return ({"k": ks, "v": vs},
+                jnp.argmax(logits).astype(jnp.int32))
+
+    def decode(self, params, cache, tokens, positions):
+        """ONE decode step over every slot: tokens (slots,) int32 (each
+        slot's last token), positions (slots,) int32 (the cache index
+        this step writes = the slot's current length).  Inactive slots
+        ride along at position 0 computing garbage that the scheduler
+        discards — and that the next prefill overwrites — so the
+        program shape never changes with occupancy."""
+        cd = self.compute_dtype
+        slots = tokens.shape[0]
+        h = (params["embed"][tokens]
+             + params["pos"][positions])[:, None, :]   # (slots, 1, d)
+        idx = jnp.arange(slots)
+
+        def layer(h, xs):
+            blk, kc, vc = xs
+            x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
+            qkv = jnp.einsum("bsd,dchx->bschx", x.astype(cd),
+                             blk["wqkv"].astype(cd))
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            kc = kc.at[idx, positions].set(k[:, 0].astype(kc.dtype))
+            vc = vc.at[idx, positions].set(v[:, 0].astype(vc.dtype))
+            att = decode_attention(q, kc, vc, positions + 1,
+                                   use_pallas=self.use_pallas)
+            proj = jnp.einsum("bshx,hxd->bsd", att.astype(cd),
+                              blk["wo"].astype(cd))
+            h = h + proj.astype(h.dtype)
+            x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
+            up = (x.astype(cd) @ blk["w1"].astype(cd)
+                  + blk["b1"].astype(cd))
+            down = (jax.nn.gelu(up) @ blk["w2"].astype(cd)
+                    + blk["b2"].astype(cd))
+            h = h + down.astype(h.dtype)
+            return h, (kc, vc)
+
+        h, (ks, vs) = jax.lax.scan(
+            layer, h, (params["blocks"], cache["k"], cache["v"]))
+        h = _layernorm(h, params["lnf_g"], params["lnf_b"])
+        logits = jnp.einsum("bd,vd->bv", h[:, 0].astype(cd),
+                            params["embed"].astype(cd)
+                            ).astype(jnp.float32)
+        return ({"k": ks, "v": vs},
+                jnp.argmax(logits, axis=-1).astype(jnp.int32))
+
+    # -- analytic FLOPs (cost_analysis counts the layer scan once) ---------
+    def _per_token_layer_flops(self, attended):
+        d, f = self.dim, self.cfg["mlp_ratio"] * self.dim
+        return (2.0 * d * 3 * d          # qkv projection
+                + 4.0 * attended * d     # QK^T + AV over the read KV
+                + 2.0 * d * d            # output projection
+                + 4.0 * d * f)           # mlp up + down
+
+    def prefill_flops(self, bucket):
+        """Forward FLOPs of one bucket prefill (causal-discounted
+        attention, the ``train_step_flops`` convention) + one
+        readout."""
+        per_token = self.layers * self._per_token_layer_flops(
+            bucket / 2.0)
+        return bucket * per_token + 2.0 * self.dim * self.vocab
+
+    def decode_flops(self, slots, max_seq):
+        """FLOPs of one decode step: every slot reads its masked KV
+        buffer — counted at the full ``max_seq`` extent the dense
+        masked path actually computes (the Pallas kernel's block skip
+        makes this an upper bound on TPU)."""
+        per_token = (self.layers
+                     * self._per_token_layer_flops(float(max_seq))
+                     + 2.0 * self.dim * self.vocab)
+        return slots * per_token

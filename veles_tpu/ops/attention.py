@@ -30,6 +30,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+#: jax renamed TPUCompilerParams -> CompilerParams across releases;
+#: the decode path resolves whichever this jax ships (the training
+#: kernels above predate the rename and keep the new-name spelling)
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams", None)
+
 
 def _round_up(x, mult):
     return (x + mult - 1) // mult * mult
@@ -412,6 +418,144 @@ def _flash_bwd(q, k, v, o, lse, do, causal=False, block_q=128,
         return jnp.moveaxis(x, 1, 2)
 
     return unsd(dq3, sq), unsd(dk3, sk), unsd(dv3, sk)
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, n_k, scale, block_k,
+                   heads):
+    """Single-query decode step: grid (batch*heads, k_blocks); K is
+    the sequential dimension; the per-row KV length arrives scalar-
+    prefetched (``len_ref``, one int32 per *batch* row — heads share
+    it).  The q block is the forward kernel's layout padded to the
+    8-sublane minimum (row 0 is the real query; rows 1–7 compute
+    garbage that is sliced away), so the online-softmax scratch
+    discipline is identical to :func:`_attn_kernel`.  K blocks fully
+    beyond the row's length are skipped — the decode analogue of the
+    causal block skip, and where the win over a dense masked pass
+    comes from when the cache is long but the sequence is young."""
+    bh = pl.program_id(0)
+    kk = pl.program_id(1)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[bh // heads]
+    run = kk * block_k < length
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale       # (8, d)
+        k = k_ref[0].astype(jnp.float32)               # (bk, d)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (8, bk)
+        k_pos = kk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(k_pos < length, scores, NEG_INF)
+        m_prev = m_ref[...]
+        m_cur = jnp.max(scores, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + p.sum(axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(kk == n_k - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _decode_jnp(q, k, v, lengths):
+    """Dense masked reference for the decode step: q (b, 1, h, d)
+    against a (b, S, h, d) KV buffer where only the first
+    ``lengths[i]`` keys of row ``i`` are valid.  The oracle the Pallas
+    kernel is parity-tested against (``tests/test_attention.py``)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / (d ** 0.5)
+    mask = (jnp.arange(k.shape[1])[None, None, None, :]
+            < lengths[:, None, None, None])
+    scores = jnp.where(mask, scores, NEG_INF)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)
+    probs = jnp.exp(scores - lse[..., None])
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def _decode_pallas(q, k, v, lengths, block_k=128, interpret=False):
+    b, _sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    bk = min(block_k, _round_up(sk, 8))
+    q3 = _bhsd(q, b, h, d, 8)                   # (b·h, 8, d_p)
+    k3, v3 = _bhsd(k, b, h, d, bk), _bhsd(v, b, h, d, bk)
+    d_p = q3.shape[2]
+    n_k = k3.shape[1] // bk
+    grid = (b * h, n_k)
+    in_specs = [
+        pl.BlockSpec((1, 8, d_p), lambda bh, kk, lens: (bh, 0, 0)),
+        pl.BlockSpec((1, bk, d_p), lambda bh, kk, lens: (bh, kk, 0)),
+        pl.BlockSpec((1, bk, d_p), lambda bh, kk, lens: (bh, kk, 0)),
+    ]
+    out_spec = pl.BlockSpec((1, 8, d_p), lambda bh, kk, lens: (bh, 0, 0))
+    scratch = [
+        pltpu.VMEM((8, d_p), jnp.float32),
+        pltpu.VMEM((8, 1), jnp.float32),
+        pltpu.VMEM((8, 1), jnp.float32),
+    ]
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, n_k=n_k, scale=scale,
+                          block_k=bk, heads=h),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
+            out_specs=out_spec, scratch_shapes=scratch),
+        out_shape=jax.ShapeDtypeStruct((b * h, 8, d_p), q.dtype),
+        compiler_params=_COMPILER_PARAMS(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(lengths, jnp.int32), q3, k3, v3)
+    return jnp.moveaxis(out[:, :1, :d].reshape(b, h, 1, d), 1, 2)
+
+
+def decode_attention(q, k, v, lengths, block_k=None, use_pallas=None,
+                     interpret=None):
+    """Single-query (q_len = 1) attention against a masked KV buffer —
+    the generative decode step's hot op (:mod:`veles_tpu.gen`).
+
+    ``q``: (b, 1, h, d) or (b, h, d); ``k``/``v``: (b, S, h, d) cache
+    buffers whose tail beyond ``lengths[i]`` (int32, (b,), each ≥ 1)
+    is garbage and masked out; returns attention over the valid prefix
+    with q's leading shape.  Row ``i``'s output depends only on row
+    ``i``'s query and valid keys, so slots of a continuous batch can
+    never bleed into each other (the batching parity gate's
+    substrate).  TPU takes the Pallas kernel (lengths scalar-
+    prefetched, fully-masked K blocks skipped); elsewhere the dense
+    masked reference runs — both share the start-aligned mask
+    convention of the prefill flash path (``q_offset``/``k_offset``
+    there, ``lengths`` here)."""
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    lengths = jnp.asarray(lengths, jnp.int32)
+    pallas = use_pallas if use_pallas is not None else _on_tpu()
+    if pallas:
+        if interpret is None:
+            from veles_tpu.config import root
+            interpret = bool(root.common.engine.get("interpret", False))
+        out = _decode_pallas(q, k, v, lengths,
+                             block_k=block_k or 128,
+                             interpret=interpret)
+    else:
+        out = _decode_jnp(q, k, v, lengths)
+    return out[:, 0] if squeeze else out
 
 
 def _mha_jnp(q, k, v, causal, q_offset=0, k_offset=0):

@@ -109,16 +109,20 @@ class LedgerEntry(object):
     """One compiled program's running cost account."""
 
     __slots__ = ("kind", "name", "cost", "compiles", "recompiles",
-                 "dispatches", "dispatch_ns")
+                 "dispatches", "dispatch_ns", "items")
 
     def __init__(self, kind, name):
-        self.kind = kind            # "segment" | "bucket"
-        self.name = name
+        self.kind = kind            # "segment" | "bucket" | "prefill"
+        self.name = name            # | "decode"
         self.cost = None            # cost_of() dict after first compile
         self.compiles = 0
         self.recompiles = 0         # compiles AFTER the first = retraces
         self.dispatches = 0
         self.dispatch_ns = 0
+        #: useful work units served (generative entries: TOKENS — the
+        #: decode program runs all slots every step, so tokens, not
+        #: dispatches, are the per-token throughput denominator)
+        self.items = 0
 
     @property
     def flops(self):
@@ -141,11 +145,27 @@ class LedgerEntry(object):
         achieved = self.achieved_flops()
         return achieved / peak if achieved else None
 
+    def items_per_s(self):
+        """Tokens (items) per second of dispatch wall — the generative
+        entries' throughput line (0 when nothing was accounted)."""
+        if not self.dispatch_ns or not self.items:
+            return 0.0
+        return self.items / (self.dispatch_ns / 1e9)
+
+    def flops_per_item(self):
+        """Dispatched FLOPs per accounted token: the decode program
+        pays the FULL slots-wide step for every iteration, so this is
+        the honest per-token cost (it FALLS as batch fill rises —
+        continuous batching's win in one number)."""
+        if not self.items:
+            return 0.0
+        return self.flops * self.dispatches / self.items
+
     def row(self, peak):
         """JSON-able summary row (the ``perf_report()`` line)."""
         wall_ms = self.dispatch_ns / 1e6
         mfu = self.mfu(peak)
-        return {
+        row = {
             "kind": self.kind, "name": self.name,
             "flops": self.flops, "bytes": self.bytes_accessed,
             "temp_bytes": (self.cost or {}).get("temp_bytes", 0),
@@ -155,6 +175,11 @@ class LedgerEntry(object):
             "achieved_flops": round(self.achieved_flops(), 1),
             "mfu": round(mfu, 6) if mfu is not None else None,
         }
+        if self.items:
+            row["items"] = self.items
+            row["items_per_s"] = round(self.items_per_s(), 1)
+            row["flops_per_item"] = round(self.flops_per_item(), 1)
+        return row
 
 
 class PerfLedger(object):
@@ -205,12 +230,16 @@ class PerfLedger(object):
                 self.recompiles += 1
         return steady
 
-    def record_dispatch(self, entry, dur_ns):
+    def record_dispatch(self, entry, dur_ns, items=0):
         """The hot-path hook: one turnaround on ``entry``.  GIL-cheap
         integer adds, no lock (single dispatching thread per entry;
-        totals tolerate the rare lost update)."""
+        totals tolerate the rare lost update).  ``items``: useful work
+        units this dispatch served (generative entries pass tokens —
+        prompt tokens for prefill, active slots for a decode step)."""
         entry.dispatches += 1
         entry.dispatch_ns += int(dur_ns)
+        if items:
+            entry.items += int(items)
         flops = entry.flops
         if flops:
             self.flops_dispatched += flops
@@ -400,6 +429,17 @@ def report_text(summary_dict=None):
         lines.append("")
         lines.append("serve buckets (per call):")
         lines.extend(render_rows(buckets, peak))
+    gen_rows = [r for r in rows if r["kind"] in ("prefill", "decode")]
+    if gen_rows:
+        lines.append("")
+        lines.append("generative programs (per token):")
+        lines.extend(render_rows(gen_rows, peak))
+        for row in gen_rows:
+            if row.get("items"):
+                lines.append(
+                    "    %-34s %8d tok %10.1f tok/s %12.3e FLOPs/tok"
+                    % (row["name"][:34], row["items"],
+                       row["items_per_s"], row["flops_per_item"]))
     if not rows:
         lines.append("")
         lines.append("  (no compiled programs registered — run a "

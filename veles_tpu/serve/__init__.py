@@ -31,11 +31,12 @@ directly (see ``docs/services.md`` § Serving engine).
 from veles_tpu.serve.batcher import DynamicBatcher, QueueFull
 from veles_tpu.serve.engine import InferenceEngine
 from veles_tpu.serve.metrics import ServingMetrics
-from veles_tpu.serve.registry import ModelRegistry
+from veles_tpu.serve.registry import ModelRegistry, ReplicaSet
 from veles_tpu.serve.server import ServingServer
-from veles_tpu.serve.wire import decode_input
+from veles_tpu.serve.wire import decode_gen_request, decode_input
 
 __all__ = [
     "DynamicBatcher", "InferenceEngine", "ModelRegistry", "QueueFull",
-    "ServingMetrics", "ServingServer", "decode_input",
+    "ReplicaSet", "ServingMetrics", "ServingServer",
+    "decode_gen_request", "decode_input",
 ]

@@ -44,6 +44,10 @@ class ServingMetrics(object):
         #: gauge callables registered by owners (queue depth, model
         #: count, compile count, ...) — read at snapshot time
         self._gauges = {}
+        #: extra LatencyHistograms registered by owners (the gen
+        #: schedulers' TTFT), keyed (base_name, labels tuple) —
+        #: rendered as full Prometheus histogram families
+        self._histograms = {}
 
     # -- recording --------------------------------------------------------
     def observe_request(self, latency_s, rows=1, error=False):
@@ -82,6 +86,29 @@ class ServingMetrics(object):
         stale callables keeping dead engines alive)."""
         with self._lock:
             self._gauges.pop(name, None)
+
+    @staticmethod
+    def _hist_key(name, labels):
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def register_histogram(self, name, hist, help_="", labels=None):
+        """Register a :class:`~veles_tpu.metrics.LatencyHistogram`
+        for full Prometheus exposition on ``/metrics``.  ``labels``
+        (e.g. ``{"model": "default"}``) land INSIDE each sample
+        line's brace set next to ``le`` — the exposition-legal way to
+        give every generative model its own TTFT family without
+        mangling the metric name."""
+        with self._lock:
+            self._histograms[self._hist_key(name, labels)] = \
+                (hist, help_, dict(labels or {}))
+
+    def unregister_histogram(self, name, labels=None):
+        with self._lock:
+            self._histograms.pop(self._hist_key(name, labels), None)
+
+    def _histogram_items(self):
+        with self._lock:
+            return list(self._histograms.items())
 
     def _gauge_items(self):
         with self._lock:   # a deploy may register mid-scrape
@@ -175,10 +202,26 @@ class ServingMetrics(object):
         self._emit_histogram(lines, "batch_latency_seconds",
                              self.batch_latency,
                              "coalesced device-call latency")
+        # one HELP/TYPE per family with every label variant grouped
+        # under it — a second TYPE line for the same metric name is a
+        # Prometheus text-format parse error that kills the whole
+        # scrape, so per-model histograms must share one header
+        families = {}
+        for (name, _lbl), (hist, help_, labels) in sorted(
+                self._histogram_items()):
+            families.setdefault(name, []).append((hist, help_,
+                                                  labels))
+        for name, members in families.items():
+            lines.append("# HELP veles_serve_%s %s"
+                         % (name, members[0][1]))
+            lines.append("# TYPE veles_serve_%s histogram" % name)
+            for hist, _help, labels in members:
+                self._emit_histogram(lines, name, hist, None,
+                                     labels=labels)
         return "\n".join(lines) + "\n"
 
     @staticmethod
-    def _emit_histogram(lines, name, hist, help_):
+    def _emit_histogram(lines, name, hist, help_, labels=None):
         """Prometheus histogram exposition for a
         :class:`~veles_tpu.metrics.LatencyHistogram`: cumulative
         ``le``-labeled buckets + ``_sum``/``_count``, one contiguous
@@ -186,12 +229,19 @@ class ServingMetrics(object):
         happens server-side (``histogram_quantile``) instead of
         trusting our interpolated percentile lines."""
         bounds, cum, total, count = hist.cumulative()
-        lines.append("# HELP veles_serve_%s %s" % (name, help_))
-        lines.append("# TYPE veles_serve_%s histogram" % name)
+        prefix = "".join('%s="%s",' % (k, v) for k, v in
+                         sorted((labels or {}).items()))
+        suffix = ("{%s}" % prefix.rstrip(",")) if prefix else ""
+        if help_ is not None:   # None = caller already wrote the
+            lines.append("# HELP veles_serve_%s %s"  # family header
+                         % (name, help_))
+            lines.append("# TYPE veles_serve_%s histogram" % name)
         for bound, c in zip(bounds, cum):
-            lines.append('veles_serve_%s_bucket{le="%.6g"} %d'
-                         % (name, bound, c))
-        lines.append('veles_serve_%s_bucket{le="+Inf"} %d'
-                     % (name, count))
-        lines.append("veles_serve_%s_sum %.6f" % (name, total))
-        lines.append("veles_serve_%s_count %d" % (name, count))
+            lines.append('veles_serve_%s_bucket{%sle="%.6g"} %d'
+                         % (name, prefix, bound, c))
+        lines.append('veles_serve_%s_bucket{%sle="+Inf"} %d'
+                     % (name, prefix, count))
+        lines.append("veles_serve_%s_sum%s %.6f"
+                     % (name, suffix, total))
+        lines.append("veles_serve_%s_count%s %d"
+                     % (name, suffix, count))

@@ -1,4 +1,5 @@
-"""ModelRegistry: named models, snapshot loading, atomic hot swap.
+"""ModelRegistry: named models, snapshot loading, atomic hot swap,
+replica sets with weighted traffic split.
 
 The multi-model layer of the serving engine: each name owns ONE
 :class:`~veles_tpu.serve.batcher.DynamicBatcher` (so queued requests
@@ -10,10 +11,25 @@ after the swap runs on the new one.  Old engines need no teardown
 (they are plain objects holding device arrays; the GC reclaims them
 once the last in-flight batch drops its reference).
 
+On top of the atomic swap sit **replica sets**: a
+:class:`ReplicaSet` is engine-shaped (the batcher can't tell), but
+routes each batch to one member by smooth weighted round-robin —
+deterministic, proportional at every prefix, no RNG in the serving
+path.  ``deploy_canary`` is the two-member special case: the current
+engine keeps ``1 - weight`` of traffic, the candidate gets
+``weight``, and ``promote``/another ``deploy`` ends the experiment.
+``describe()`` reports every member's version, weight and served
+count, so a rollout dashboard (or a test) never reaches into
+privates.
+
 Versions come from anywhere an engine can be built: a live workflow, a
 forward-unit chain, or a :mod:`veles_tpu.snapshotter` artifact (local
 path / ``http(s)://`` URL / ``db://`` row) — the trained-model hand-off
-the Snapshotter side of the platform already produces.
+the Snapshotter side of the platform already produces.  Generative
+engines (:mod:`veles_tpu.gen`) deploy through
+:meth:`ModelRegistry.deploy_generative`, which swaps the batcher for a
+continuous-batching scheduler and preflights the KV-cache plan
+(analyzer rule V-S01).
 """
 
 import threading
@@ -25,11 +41,96 @@ from veles_tpu.serve.batcher import DynamicBatcher
 from veles_tpu.serve.engine import InferenceEngine
 
 
+class ReplicaSet(object):
+    """Engine-shaped weighted router over same-shape engines.
+
+    Members: ``[(engine, weight, version), ...]`` with positive
+    weights.  Selection is smooth weighted round-robin (the nginx
+    algorithm): each pick adds every member's weight to its running
+    credit, serves the max-credit member, and subtracts the weight
+    total — so a 3:1 split serves exactly 30/10 over any 40 calls and
+    interleaves rather than bursting.  The batcher worker is the only
+    caller of :meth:`infer`, so selection needs no lock; a lock still
+    guards it because ``ServingServer`` exposes describe() scrapes
+    concurrently.
+    """
+
+    def __init__(self, replicas):
+        members = []
+        for item in replicas:
+            engine, weight, version = item
+            weight = float(weight)
+            if weight <= 0:
+                raise ValueError("replica weight must be > 0, got %r"
+                                 % weight)
+            members.append({"engine": engine, "weight": weight,
+                            "version": version, "credit": 0.0,
+                            "served": 0})
+        if not members:
+            raise ValueError("empty replica set")
+        shapes = {tuple(m["engine"].sample_shape) for m in members}
+        if len(shapes) != 1:
+            raise ValueError(
+                "replica engines disagree on sample shape: %s"
+                % sorted(shapes))
+        self._members = members
+        self._total_weight = sum(m["weight"] for m in members)
+        self._lock = threading.Lock()
+
+    # -- engine protocol (what DynamicBatcher/_Model touch) ---------------
+    @property
+    def sample_shape(self):
+        return self._members[0]["engine"].sample_shape
+
+    @property
+    def max_batch_size(self):
+        return min(m["engine"].max_batch_size for m in self._members)
+
+    @property
+    def buckets(self):
+        return self._members[0]["engine"].buckets
+
+    @property
+    def compile_count(self):
+        return sum(m["engine"].compile_count for m in self._members)
+
+    def warmup(self):
+        for member in self._members:
+            member["engine"].warmup()
+        return self
+
+    def padded_capacity(self, n):
+        return self._members[0]["engine"].padded_capacity(n)
+
+    def _next(self):
+        with self._lock:
+            for member in self._members:
+                member["credit"] += member["weight"]
+            best = max(self._members, key=lambda m: m["credit"])
+            best["credit"] -= self._total_weight
+            best["served"] += 1
+            return best
+
+    def infer(self, batch):
+        return self._next()["engine"].infer(batch)
+
+    # -- introspection -----------------------------------------------------
+    def describe(self):
+        with self._lock:
+            return [{"version": m["version"],
+                     "weight": m["weight"],
+                     "served": m["served"],
+                     "engine": getattr(m["engine"], "prof_name", None)}
+                    for m in self._members]
+
+
 class _Model(object):
     """One served name: stable batcher + swappable engine + metadata."""
 
     __slots__ = ("name", "batcher", "version", "deployed_at", "swaps",
                  "source")
+
+    is_generative = False
 
     def __init__(self, name, batcher):
         self.name = name
@@ -44,7 +145,7 @@ class _Model(object):
         return self.batcher.engine
 
     def describe(self):
-        return {
+        info = {
             "name": self.name,
             "version": self.version,
             "deployed_at": self.deployed_at,
@@ -54,6 +155,44 @@ class _Model(object):
             "compile_count": self.engine.compile_count,
             "queue_depth": self.batcher.queue_depth(),
         }
+        if isinstance(self.engine, ReplicaSet):
+            # per-replica weights/versions/served counts — rollout
+            # dashboards and tests assert canary splits from here
+            # instead of reaching into privates
+            info["replicas"] = self.engine.describe()
+        return info
+
+
+class _GenModel(object):
+    """One served GENERATIVE name: engine + continuous scheduler."""
+
+    __slots__ = ("name", "scheduler", "version", "deployed_at",
+                 "source")
+
+    is_generative = True
+
+    def __init__(self, name, scheduler):
+        self.name = name
+        self.scheduler = scheduler
+        self.version = None
+        self.deployed_at = None
+        self.source = None
+
+    @property
+    def engine(self):
+        return self.scheduler.engine
+
+    def describe(self):
+        info = {
+            "name": self.name,
+            "version": self.version,
+            "deployed_at": self.deployed_at,
+            "source": self.source,
+            "generative": True,
+        }
+        info.update(self.engine.describe())
+        info.update(self.scheduler.describe())
+        return info
 
 
 class ModelRegistry(Logger):
@@ -77,6 +216,10 @@ class ModelRegistry(Logger):
         metrics.register_gauge("models", lambda: len(self._models))
         with self._lock:
             for name, model in self._models.items():
+                if model.is_generative:
+                    model.scheduler.metrics = metrics
+                    model.scheduler._register_gauges(metrics)
+                    continue
                 model.batcher.metrics = metrics
                 metrics.register_gauge(
                     'queue_depth{model="%s"}' % name,
@@ -102,6 +245,12 @@ class ModelRegistry(Logger):
             engine.warmup()
         with self._lock:
             model = self._models.get(name)
+            if model is not None and model.is_generative:
+                raise ValueError(
+                    "%r serves a generative model — undeploy it (or "
+                    "deploy_generative a successor) instead of hot-"
+                    "swapping a request/response engine over token "
+                    "streams" % name)
             if model is None:
                 batcher = DynamicBatcher(
                     engine, metrics=self.metrics,
@@ -131,6 +280,139 @@ class ModelRegistry(Logger):
                   " (hot swap #%d)" % model.swaps if model.swaps
                   else "")
         return model
+
+    def deploy_replica_set(self, name, replicas, version=None,
+                           source=None, warmup=True,
+                           allow_reshape=False):
+        """Deploy a weighted :class:`ReplicaSet` under ``name``.
+
+        ``replicas``: ``[(engine, weight), ...]`` or ``[(engine,
+        weight, version), ...]`` — weights are relative (3 and 1 split
+        75/25).  Same atomic swap as :meth:`deploy`: the set IS the
+        batcher's engine, so the split applies from the next batch
+        boundary and in-flight batches finish where they started.
+        """
+        normalized = []
+        for index, item in enumerate(replicas):
+            if len(item) == 2:
+                engine, weight = item
+                rep_version = index
+            else:
+                engine, weight, rep_version = item
+            normalized.append((engine, weight, rep_version))
+        replica_set = ReplicaSet(normalized)
+        return self.deploy(name, replica_set, version=version,
+                           source=source or "replica_set",
+                           warmup=warmup, allow_reshape=allow_reshape)
+
+    def deploy_canary(self, name, engine, weight=0.1, version=None,
+                      warmup=True):
+        """Split ``name``'s traffic: the CURRENT engine keeps
+        ``1 - weight``, the candidate gets ``weight``.  Promote by
+        deploying the candidate plainly (or widen the split with
+        another ``deploy_canary``).  The current engine may itself be
+        a ReplicaSet — the canary then rides on top of the set as one
+        member, which is almost never what a rollout means, so that
+        case is refused; finish one experiment before starting the
+        next."""
+        weight = float(weight)
+        if not 0.0 < weight < 1.0:
+            raise ValueError("canary weight must be in (0, 1), got %r"
+                             % weight)
+        model = self.get(name)
+        if model.is_generative:
+            raise ValueError("canary rollout of generative models is "
+                             "not supported yet")
+        stable = model.engine
+        if isinstance(stable, ReplicaSet):
+            raise ValueError(
+                "%r already serves a replica set — promote or undeploy "
+                "the running experiment before starting a new canary"
+                % name)
+        return self.deploy_replica_set(
+            name,
+            [(stable, 1.0 - weight, model.version),
+             (engine, weight, version
+              if version is not None else "canary")],
+            version=model.version, source="canary", warmup=warmup)
+
+    # -- generative deploys ------------------------------------------------
+    def preflight_generative(self, engine, name=None):
+        """Analyzer rule V-S01 at deploy time, honouring
+        ``root.common.serve.preflight`` exactly like the workflow
+        preflight: KV-cache footprint vs device HBM, slot/bucket plan
+        sanity, and non-causal-model rejection — refuse at the
+        registry, not at the first streamed token."""
+        mode = str(root.common.serve.get("preflight",
+                                         "warn")).strip().lower()
+        if mode not in ("off", "no", "false", "0", "warn", "fail"):
+            raise ValueError(
+                "root.common.serve.preflight is %r — want off | warn "
+                "| fail" % mode)
+        if mode in ("off", "no", "false", "0"):
+            return None
+        from veles_tpu.analyze import PreflightError
+        from veles_tpu.analyze.shapes import check_generative
+        report = check_generative(engine)
+        label = name or getattr(engine, "prof_name", "generative")
+        for finding in report:
+            log = {"error": self.error,
+                   "warning": self.warning}.get(finding.severity,
+                                                self.info)
+            log("preflight[%s]: %s", label, finding.render())
+        if report.has_errors and mode == "fail":
+            raise PreflightError(report)
+        return report
+
+    def deploy_generative(self, name, engine, version=None,
+                          source=None, warmup=True,
+                          scheduler_config=None):
+        """Install a :class:`veles_tpu.gen.engine.GenerativeEngine`
+        under ``name`` with its own continuous-batching scheduler
+        (started on a worker thread).  Redeploying a generative name
+        is a DRAIN swap: the old scheduler finishes its streams, its
+        engine releases the KV cache, then the successor takes over —
+        token streams cannot migrate between engines mid-request."""
+        self.preflight_generative(engine, name)
+        if warmup:
+            engine.warmup()
+        from veles_tpu.gen.scheduler import GenerativeScheduler
+        scheduler = GenerativeScheduler(
+            engine, metrics=self.metrics, name=name,
+            **dict(scheduler_config or {})).start()
+        with self._lock:
+            old = self._models.get(name)
+            if old is not None and not old.is_generative:
+                scheduler.stop(drain=False)
+                raise ValueError(
+                    "%r serves a request/response model — undeploy it "
+                    "before deploying a generative engine" % name)
+            model = _GenModel(name, scheduler)
+            model.version = version if version is not None else (
+                (old.version + 1)
+                if old is not None and
+                isinstance(old.version, int) else 1)
+            model.deployed_at = time.time()
+            model.source = source
+            self._models[name] = model
+        if old is not None:
+            old.scheduler.stop(drain=True)
+            old.engine.close()
+        self.info("deployed generative %s version %s (%d slots, "
+                  "buckets %s)", name, model.version,
+                  engine.max_slots, list(engine.prefill_buckets))
+        return model
+
+    def generate(self, name, tokens, max_new_tokens=16, timeout=120.0,
+                 on_token=None):
+        """Stream a generation on ``name``'s scheduler (blocking
+        convenience; returns the full token list)."""
+        model = self.get(name)
+        if not model.is_generative:
+            raise ValueError("%r is not a generative model" % name)
+        return model.scheduler.generate(tokens, max_new_tokens,
+                                        timeout=timeout,
+                                        on_token=on_token)
 
     def preflight(self, workflow, name=None):
         """Run analyzer passes 1–2 (graph doctor + JAX hazards) on a
@@ -206,6 +488,26 @@ class ModelRegistry(Logger):
     def __contains__(self, name):
         return name in self._models
 
+    def undeploy(self, name, drain=True):
+        """Remove ONE served name: stop its batcher (or generative
+        scheduler + engine), drop its gauges, free the entry — the
+        single-model counterpart of :meth:`stop`, and the remedy the
+        kind-mixup errors point at."""
+        with self._lock:
+            model = self._models.pop(name, None)
+        if model is None:
+            raise KeyError("no model %r" % name)
+        if self.metrics is not None and not model.is_generative:
+            self.metrics.unregister_gauge(
+                'queue_depth{model="%s"}' % name)
+        if model.is_generative:
+            model.scheduler.stop(drain=drain)
+            model.engine.close()
+        else:
+            model.batcher.stop(drain=drain)
+        self.info("undeployed %s", name)
+        return model
+
     def names(self):
         with self._lock:   # a first deploy may be inserting a key
             return sorted(self._models)
@@ -218,7 +520,12 @@ class ModelRegistry(Logger):
 
     def submit(self, name, rows):
         """Queue rows on ``name``'s batcher; returns the Future."""
-        return self.get(name).batcher.submit(rows)
+        model = self.get(name)
+        if model.is_generative:
+            raise ValueError(
+                "%r is generative — use generate()/the /generate "
+                "route, not the request/response path" % name)
+        return model.batcher.submit(rows)
 
     def infer(self, name, rows, timeout=30.0):
         return self.submit(name, rows).result(timeout)
@@ -235,4 +542,8 @@ class ModelRegistry(Logger):
                 self.metrics.unregister_gauge(
                     'queue_depth{model="%s"}' % name)
         for model in models.values():
-            model.batcher.stop(drain=drain)
+            if model.is_generative:
+                model.scheduler.stop(drain=drain)
+                model.engine.close()
+            else:
+                model.batcher.stop(drain=drain)

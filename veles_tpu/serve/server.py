@@ -22,6 +22,7 @@ bucket-sized device calls.
 """
 
 import json
+import queue as queue_module
 import threading
 import time
 from concurrent.futures import TimeoutError as FuturesTimeout
@@ -30,9 +31,13 @@ from veles_tpu.logger import Logger
 from veles_tpu.serve.batcher import QueueFull
 from veles_tpu.serve.metrics import ServingMetrics
 from veles_tpu.serve.registry import ModelRegistry
-from veles_tpu.serve.wire import decode_input
+from veles_tpu.serve.wire import decode_gen_request, decode_input
 
 DEFAULT_MODEL = "default"
+GENERATE_PATH = "/generate"
+
+#: the streaming queue's end-of-stream sentinel
+_STREAM_DONE = object()
 
 
 class ServingServer(Logger):
@@ -94,6 +99,11 @@ class ServingServer(Logger):
             return 404, {"error": e.args[0]}   # LookupError parent)
         except LookupError as e:      # no such route
             return 404, {"error": str(e)}
+        if model.is_generative:
+            return 400, {"error": "%r is a generative model — POST "
+                                  "%s/%s instead" % (model.name,
+                                                     GENERATE_PATH,
+                                                     model.name)}
         # captured BEFORE the device call: a concurrent hot swap must
         # not relabel this result with the successor's version
         version = model.version
@@ -123,6 +133,111 @@ class ServingServer(Logger):
             return 500, {"error": "inference failed: %s" % e}
         return 200, {"result": result.tolist(),
                      "model": model.name, "version": version}
+
+    def _gen_model_for(self, url_path):
+        """``/generate`` → default model; ``/generate/<name>`` →
+        name.  Raises KeyError/LookupError for the 404 mapping and
+        ValueError when the name is not generative."""
+        if url_path == GENERATE_PATH:
+            name = DEFAULT_MODEL
+        elif url_path.startswith(GENERATE_PATH + "/"):
+            name = url_path[len(GENERATE_PATH) + 1:]
+        else:
+            raise LookupError("no route %r" % url_path)
+        model = self.registry.get(name)
+        if not model.is_generative:
+            raise ValueError(
+                "%r is a request/response model — POST %s%s instead"
+                % (name, self.path,
+                   "" if name == DEFAULT_MODEL else "/" + name))
+        return model
+
+    def handle_generate(self, url_path, body, on_token=None):
+        """(status, payload dict) for one ``POST /generate`` — the
+        transport-free core (the streaming handler adds its ndjson
+        framing on top via ``on_token``)."""
+        try:
+            model = self._gen_model_for(url_path)
+        except KeyError as e:
+            return 404, {"error": e.args[0]}
+        except LookupError as e:
+            return 404, {"error": str(e)}
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        version = model.version
+        try:
+            tokens, max_new, _stream = decode_gen_request(
+                json.loads(body))
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        except Exception as e:  # malformed JSON etc.
+            return 400, {"error": "bad request: %s" % e}
+        try:
+            out = model.scheduler.generate(
+                tokens, max_new, timeout=self.request_timeout,
+                on_token=on_token)
+        except QueueFull as e:
+            return 503, {"error": str(e),
+                         "retry_after": QueueFull.retry_after}
+        except ValueError as e:       # unservable prompt/budget
+            return 400, {"error": str(e)}
+        except (FuturesTimeout, TimeoutError):
+            return 504, {"error": "generation timed out after %.1fs"
+                         % self.request_timeout}
+        except Exception as e:  # noqa: BLE001 - wire boundary
+            return 500, {"error": "generation failed: %s" % e}
+        return 200, {"tokens": [int(t) for t in out],
+                     "model": model.name, "version": version}
+
+    def stream_generate(self, url_path, body):
+        """Streaming variant: yields ndjson-encoded byte lines — one
+        ``{"token": t, "index": i}`` event per generated token the
+        moment the scheduler emits it, then a final ``{"done": true,
+        "tokens": [...]}`` document (or ``{"error": ...}``).  The
+        HTTP handler writes these through chunked transfer encoding;
+        the first yield is ``(status, first_line)`` so the handler
+        can still map early rejections to real status codes."""
+        events = queue_module.Queue()
+        emitted = [0]
+
+        def on_token(token):
+            index = emitted[0]
+            emitted[0] += 1
+            events.put({"token": int(token), "index": index})
+
+        outcome = {}
+
+        def run():
+            outcome["reply"] = self.handle_generate(url_path, body,
+                                                    on_token=on_token)
+            events.put(_STREAM_DONE)
+
+        worker = threading.Thread(target=run, daemon=True,
+                                  name="serve-gen-stream")
+        worker.start()
+        first = events.get()
+        if first is _STREAM_DONE:
+            # finished (or failed) before the first token
+            status, payload = outcome["reply"]
+            if status == 200:
+                payload = dict(payload, done=True)
+            yield status, (json.dumps(payload) + "\n").encode()
+            return
+        yield 200, (json.dumps(first) + "\n").encode()
+        while True:
+            event = events.get()
+            if event is _STREAM_DONE:
+                break
+            yield None, (json.dumps(event) + "\n").encode()
+        status, payload = outcome["reply"]
+        if status == 200:
+            payload = dict(payload, done=True)
+        else:
+            # the stream already committed a 200 — the error rides
+            # in-band as the final document
+            payload = {"error": payload.get("error", "failed"),
+                       "done": True}
+        yield None, (json.dumps(payload) + "\n").encode()
 
     def healthz(self):
         ok = bool(self.registry.names())
@@ -157,9 +272,64 @@ class ServingServer(Logger):
                 self._reply(status, json.dumps(payload).encode(),
                             "application/json")
 
+            def _stream_reply(self, body):
+                """Chunked ndjson token stream (``"stream": true``).
+                Handles its own errors: before the first chunk a
+                failure still maps to a clean JSON status; after the
+                headers are on the wire (a mid-stream disconnect, a
+                serialization failure) the ONLY safe move is dropping
+                the connection — a second send_response injected into
+                a half-written chunked body would corrupt the
+                stream."""
+                try:
+                    stream = server.stream_generate(self.path, body)
+                    status, first = next(stream)
+                except StopIteration:
+                    self._reply_json(500, {"error": "empty stream"})
+                    return
+                except Exception as e:  # noqa: BLE001 - pre-headers
+                    self._reply_json(500, {"error": str(e)})
+                    return
+                self.send_response(status)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                try:
+                    self.end_headers()
+
+                    def chunk(data):
+                        self.wfile.write(
+                            ("%x\r\n" % len(data)).encode()
+                            + data + b"\r\n")
+
+                    chunk(first)
+                    for _status, line in stream:
+                        chunk(line)
+                    self.wfile.write(b"0\r\n\r\n")
+                except Exception as e:  # noqa: BLE001 - mid-stream
+                    server.debug("generation stream aborted: %s", e)
+                    self.close_connection = True
+
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                if self.path == GENERATE_PATH or \
+                        self.path.startswith(GENERATE_PATH + "/"):
+                    try:
+                        wants_stream = bool(
+                            json.loads(body).get("stream"))
+                    except Exception:
+                        wants_stream = False   # 400s via the core
+                    if wants_stream:
+                        self._stream_reply(body)   # self-contained
+                        return
+                    try:
+                        status, payload = server.handle_generate(
+                            self.path, body)
+                    except Exception as e:  # noqa: BLE001 - wire edge
+                        status, payload = 500, {"error": str(e)}
+                    self._reply_json(status, payload)
+                    return
                 try:
                     status, payload = server.handle_predict(
                         self.path, body)

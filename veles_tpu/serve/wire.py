@@ -71,3 +71,46 @@ def decode_input(payload):
     if batch.ndim == 1:
         batch = batch[None, :]
     return numpy.ascontiguousarray(batch, dtype=numpy.float32)
+
+
+#: request caps the wire enforces before anything reaches a scheduler
+#: (the engine re-validates against ITS max_seq; these bound malice)
+MAX_PROMPT_TOKENS = 65536
+MAX_NEW_TOKENS = 65536
+
+
+def decode_gen_request(payload):
+    """Parsed JSON body of a ``POST /generate`` → ``(tokens,
+    max_new_tokens, stream)``.
+
+    - ``tokens``: non-empty list of non-negative ints (the prompt;
+      tokenization happens client-side — the serving tier moves
+      int32s, like the training tier);
+    - ``max_new_tokens``: positive int, default 16;
+    - ``stream``: bool, default False — True asks the HTTP layer for
+      ndjson token events instead of one final document.
+
+    Raises ``ValueError`` with a wire-safe message on any malformed
+    field — the HTTP layer maps it to 400.
+    """
+    if not isinstance(payload, dict):
+        raise ValueError("request body must be a JSON object")
+    tokens = payload.get("tokens")
+    if not isinstance(tokens, list) or not tokens:
+        raise ValueError("'tokens' must be a non-empty list of ints "
+                         "(the prompt token ids)")
+    if len(tokens) > MAX_PROMPT_TOKENS:
+        raise ValueError("prompt of %d tokens exceeds the wire cap %d"
+                         % (len(tokens), MAX_PROMPT_TOKENS))
+    if not all(isinstance(t, int) and not isinstance(t, bool)
+               and t >= 0 for t in tokens):
+        raise ValueError("'tokens' entries must be non-negative ints")
+    max_new = payload.get("max_new_tokens", 16)
+    if not isinstance(max_new, int) or isinstance(max_new, bool) \
+            or not 1 <= max_new <= MAX_NEW_TOKENS:
+        raise ValueError("'max_new_tokens' must be an int in 1..%d"
+                         % MAX_NEW_TOKENS)
+    stream = payload.get("stream", False)
+    if not isinstance(stream, bool):
+        raise ValueError("'stream' must be a boolean")
+    return numpy.asarray(tokens, numpy.int32), max_new, stream
