@@ -776,6 +776,112 @@ def stage_mnist_wf_slave():
           batch * elapsed / max(counted["train"], 1), batch, None)
 
 
+def stage_mnist_pod():
+    """One pod, one program (veles_tpu.pod): the stitched EAGER
+    trainer compiled over the whole local device mesh — dataset +
+    shuffled indices sharded on the ``data`` axis, params replicated,
+    gradient aggregation an in-program ``psum`` — vs the SAME-RUN
+    ZMQ master–slave eager session it replaces (per-minibatch jobs:
+    indices + weights out, update deltas back over localhost
+    sockets).  ``vs_baseline`` IS therefore the wire-elimination
+    speedup; ``psum_bytes_per_step`` prices what the gradients cost
+    on ICI instead (the ledger's analytic ring-all-reduce estimate).
+    The pod side trains through PodRuntime directly — the membership
+    control plane adds O(epochs) frames, nothing to a throughput
+    line.  On the virtual CPU mesh all shards share one host's cores,
+    so ``vs_baseline`` there prices partitioning overhead, not the
+    ICI win — the TPU line is the one that matters."""
+    import jax
+
+    from veles_tpu import prng, prof
+    from veles_tpu.backends import AutoDevice, NumpyDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.base import TRAIN
+    from veles_tpu.parallel.jobs import JobClient, JobServer
+    from veles_tpu.parallel.mesh import mesh_from_topology
+    from veles_tpu.pod import PodRuntime, train_epochs
+    from veles_tpu.samples import mnist
+
+    batch = 2048
+
+    def mk(device, **flags):
+        prng.seed_all(1234)
+        wf = mnist.create_workflow(
+            launcher=DummyLauncher(**flags), max_epochs=2,
+            minibatch_size=batch, fused=False)
+        wf.initialize(device=device)
+        return wf
+
+    # ---- the ZMQ per-minibatch baseline (eager, stitched slave)
+    master = mk(NumpyDevice(), is_master=True)
+    slave = mk(AutoDevice(), is_slave=True)
+    server = JobServer(master).start()
+    try:
+        client = JobClient(slave, server.endpoint)
+        client.handshake()
+        client.run_prefetch()      # epochs 1-2: compiles included
+        client.close()
+    finally:
+        server.stop()
+    master.decision.complete <<= False
+    master.decision.max_epochs = 4
+    counted = {"train": 0}
+    inner_apply = master.decision.apply_data_from_slave
+
+    def counting_apply(data, slave_desc=None):
+        if data and data.get("cls") == TRAIN:
+            counted["train"] += int(data.get("size", 0))
+        return inner_apply(data, slave_desc)
+
+    master.decision.apply_data_from_slave = counting_apply
+    server = JobServer(master).start()
+    try:
+        tic = time.perf_counter()
+        client = JobClient(slave, server.endpoint)
+        client.handshake()
+        client.run_prefetch()      # epochs 3-4, warm
+        zmq_elapsed = time.perf_counter() - tic
+        client.close()
+    finally:
+        server.stop()
+    zmq_ips = max(counted["train"], 1) / zmq_elapsed
+
+    # ---- the pod path: same eager stitched graph, ONE pjit'd
+    #      program per segment over every local device
+    wf = mk(AutoDevice())
+    pod = PodRuntime(wf, mesh=mesh_from_topology(
+        {"data": -1}, require=("data",)))
+    pod.install()
+    for _ in train_epochs(wf, 2):      # epochs 1-2: compiles included
+        pass
+    train_samples = 2 * int(wf.loader.class_lengths[TRAIN])
+    psum_before = prof.ledger.psum_bytes_moved
+    recompiles_before = prof.ledger.recompiles
+    tic = time.perf_counter()
+    for _ in train_epochs(wf, 4, already=2):   # epochs 3-4, warm
+        pass
+    elapsed = time.perf_counter() - tic
+    # per-step = the runtime's static estimate for ONE train
+    # minibatch (every sharded segment's ring-all-reduce bytes); the
+    # measured ledger delta also covers the eval-class dispatches
+    # inside the timed epochs, so it rides along as the total instead
+    # of being laundered into a per-train-step figure
+    _emit("MNIST784 full StandardWorkflow(eager, pod) one-program "
+          "train throughput (epoch wall-clock incl. eval, %d-shard "
+          "mesh)" % pod.shards,
+          batch * elapsed / train_samples, batch, None, vs=zmq_ips,
+          extra={"psum_bytes_per_step":
+                 pod.describe()["psum_bytes_per_step"],
+                 "psum_bytes_moved":
+                 prof.ledger.psum_bytes_moved - psum_before,
+                 "shards": pod.shards,
+                 "recompiles": prof.ledger.recompiles
+                 - recompiles_before,
+                 "devices": len(jax.devices()),
+                 "vs_metric": "ZMQ master+slave eager jobs "
+                              "(same run)"})
+
+
 def stage_ae_wf_epoch():
     """The AE family through the full framework path with epoch_mode:
     StandardWorkflow(fused, epoch_mode) + MSE loss — the regression
@@ -1746,6 +1852,7 @@ STAGES = {
     "mnist_wf_eager": (stage_mnist_wf_eager, 300),
     "mnist_wf_eager_devloader": (stage_mnist_wf_eager_devloader, 300),
     "mnist_wf_slave": (stage_mnist_wf_slave, 300),
+    "mnist_pod": (stage_mnist_pod, 420),
     "cifar": (stage_cifar, 210),
     "stl10": (stage_stl10, 240),
     "ae": (stage_ae, 150),
@@ -1773,7 +1880,7 @@ STAGES = {
 _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "mnist_wf",
                "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
-               "mnist_wf_eager_devloader", "mnist_wf_slave",
+               "mnist_wf_eager_devloader", "mnist_wf_slave", "mnist_pod",
                "cifar", "stl10", "ae",
                "kohonen",
                "lstm", "transformer", "transformer_gen", "profile_lm",
@@ -1795,14 +1902,16 @@ _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
                "mnist_e2e_u8", "mnist_epoch", "power", "native_infer",
                "cifar", "stl10", "ae", "kohonen", "mnist_wf",
                "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
-               "mnist_wf_eager_devloader", "mnist_wf_slave")
+               "mnist_wf_eager_devloader", "mnist_wf_slave",
+               "mnist_pod")
 
 #: CPU fallback (rehearsed with a wedged tunnel): conv/LM heavies
 #: cannot finish on CPU inside their caps — end on the flagship MNIST
 #: number so the recorded last line is a real measurement.
 _CPU_ORDER = ("mnist_e2e", "mnist_epoch", "mnist_wf",
               "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
-              "mnist_wf_eager_devloader", "mnist_wf_slave", "ae",
+              "mnist_wf_eager_devloader", "mnist_wf_slave",
+              "mnist_pod", "ae",
               "kohonen", "lstm", "transformer_gen",
               "native_infer", "mnist_u8", "mnist_bf16", "mnist")
 

@@ -44,3 +44,14 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m veles_tpu.chaos --smoke
 # sentinel stays quiet) and every request at exactly its token budget
 echo "== gen smoke (generative serving gate) =="
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m veles_tpu.gen --smoke
+# pod smoke: an 8-shard CPU session (one pod = one pjit'd stitched
+# program) must train the seeded sample to completion with ZERO
+# per-step gradient/update frames on the ZMQ wire (chaos wire-site
+# counters are the probe), zero steady-state recompiles, eval parity
+# with the single-device run, a chip-kill reshard mid-epoch (mesh
+# shrink + generation bump) and a byte-identical mesh-sharded
+# InferenceEngine — the V-P02 preflight runs inside install()
+echo "== pod smoke (one-pod-one-program gate) =="
+timeout -k 10 280 env JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m veles_tpu.pod --smoke

@@ -34,7 +34,8 @@ from veles_tpu.analyze.findings import (  # noqa: F401
     Finding, Report, rule_catalog)
 from veles_tpu.analyze.graph import check_graph  # noqa: F401
 from veles_tpu.analyze.lint import lint_paths  # noqa: F401
-from veles_tpu.analyze.shapes import check_shapes  # noqa: F401
+from veles_tpu.analyze.shapes import (  # noqa: F401
+    check_generative, check_pod, check_shapes)
 
 
 class PreflightError(Exception):

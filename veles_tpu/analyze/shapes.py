@@ -86,6 +86,14 @@ RULES = {
               "autoregressive decode over a cache is meaningless "
               "without a causal mask; checked at ModelRegistry"
               ".deploy_generative time"),
+    "V-P02": ("error",
+              "pod preflight: the global batch does not divide over "
+              "the mesh's data axis, per-shard residency (full "
+              "replicated params + the dataset/staging shard) "
+              "exceeds the device-HBM budget, or a stitched segment "
+              "carries no data-shardable tensor (it would replicate "
+              "its whole compute on every chip) — checked at "
+              "PodRuntime.install time, before any compile"),
 }
 
 #: dotted call names that force a device→host sync
@@ -852,3 +860,155 @@ def check_generative(engine, hbm_bytes=None):
                                   hbm_bytes / 2 ** 30),
                 fix="consider fewer slots or a shorter max_seq"))
     return Report(findings, passes=["generative"])
+
+
+# -- V-P02: pod preflight ---------------------------------------------------
+
+def check_pod(workflow, mesh, data_axis="data", hbm_bytes=None,
+              batch_size=None, param_rules=None):
+    """Install-time plan check for :class:`veles_tpu.pod.runtime
+    .PodRuntime` (rule V-P02) — pure host arithmetic over the
+    *initialized, stitched* workflow and the proposed mesh; no
+    compiles, no device work.  The one preflight the runtime, the pod
+    smoke and the lint.sh gate share.
+
+    Three failure families, one rule ID:
+
+    - **batch divisibility** — the global minibatch must divide over
+      the ``data`` axis or the per-shard batch tensors cannot be laid
+      out (and parity with the single-device run is gone);
+    - **per-shard residency** — a pod shard holds the replicated
+      parameter set (in full, unless ``param_rules`` — the same
+      callable handed to PodRuntime — shards a leaf, which then
+      counts at ``1/shards``) plus ``1/shards`` of the dataset and
+      staging buffers; against the V-S01 HBM budget (90 % of
+      :func:`veles_tpu.backends.device_hbm_bytes`, ``hbm_bytes``
+      override for tests; unknown/CPU devices degrade to
+      plan-sanity only);
+    - **non-shardable segments** — a stitched segment none of whose
+      tensors carry the batch (or dataset) dimension replicates its
+      whole compute on every chip; named BEFORE compile so the
+      operator learns which chain member to fix, not which program
+      mysteriously scaled at 1/N efficiency.
+    """
+    from veles_tpu.analyze.findings import Report
+    from veles_tpu.memory import Vector
+
+    findings = []
+    shards = int(dict(mesh.shape).get(data_axis, 1))
+    loader = getattr(workflow, "loader", None)
+    batch = int(batch_size
+                or getattr(loader, "max_minibatch_size", 0) or 0)
+    if shards < 1 or data_axis not in dict(mesh.shape):
+        findings.append(Finding(
+            *_rule("V-P02"),
+            message="mesh %r has no %r axis — pod data parallelism "
+                    "needs one" % (dict(mesh.shape), data_axis),
+            fix="build the mesh via parallel.mesh.mesh_from_topology"
+                "(require=('data',))"))
+        return Report(findings, passes=["pod"])
+    if batch and batch % shards:
+        findings.append(Finding(
+            *_rule("V-P02"),
+            message="global batch %d does not divide over %d data "
+                    "shard(s) (remainder %d)" % (batch, shards,
+                                                 batch % shards),
+            fix="pick a minibatch_size that is a multiple of the "
+                "data axis (or shrink the topology)"))
+
+    # per-shard residency, classified by THE shared sharding rule
+    # (veles_tpu.pod.runtime.spec_for_vector — lazy import, the pod
+    # package imports this module's check at install time): the
+    # estimate prices exactly the plan install() will apply, so
+    # param_rules (the documented fsdp/tp remedy) moves this check
+    # and a raising rule fails the preflight exactly like the install
+    from veles_tpu.pod.runtime import spec_for_vector
+    segments = list(getattr(workflow, "_stitch_segments_", ()))
+    params_bytes = 0
+    sharded_bytes = 0
+    seen = set()
+    for segment in segments:
+        don_ids = set(id(v) for v in segment._don_vecs)
+        for vec in (segment._input_vecs + segment._ro_vecs
+                    + segment._don_vecs + segment._output_vecs):
+            if not isinstance(vec, Vector) or id(vec) in seen:
+                continue
+            seen.add(id(vec))
+            spec = spec_for_vector(vec, batch, shards,
+                                   data_axis=data_axis,
+                                   param_rules=param_rules,
+                                   donated=id(vec) in don_ids)
+            if data_axis in tuple(spec):
+                sharded_bytes += int(vec.nbytes)
+            else:
+                params_bytes += int(vec.nbytes)
+            # an uneven resident dataset silently loses its sharding
+            # (spec_for_vector replicates it rather than crash the
+            # device_put) — name it here, before install
+            shape = vec.shape or ()
+            if getattr(vec, "category", None) == "dataset" and shape \
+                    and shards > 1 and shape[0] % shards:
+                findings.append(Finding(
+                    "warning", "V-P02",
+                    message="resident dataset buffer %s has %d rows "
+                            "— not divisible over %d data shards, so "
+                            "it replicates in FULL on every chip "
+                            "instead of sharding"
+                            % (shape, shape[0], shards),
+                    fix="pad or trim the dataset to a multiple of "
+                        "the data axis"))
+    if hbm_bytes is None:
+        from veles_tpu.backends import device_hbm_bytes
+        from veles_tpu.prof import device_kind
+        hbm_bytes = device_hbm_bytes(device_kind())
+    if hbm_bytes and segments:
+        budget = 0.9 * float(hbm_bytes)    # the V-S01 headroom rule
+        per_shard = params_bytes + sharded_bytes / max(1, shards)
+        if per_shard > budget:
+            findings.append(Finding(
+                *_rule("V-P02"),
+                message="per-shard residency %.2f GiB (params %.2f "
+                        "GiB replicated + dataset/staging %.2f GiB / "
+                        "%d shards) exceeds 90%% of device HBM "
+                        "(%.1f GiB)"
+                        % (per_shard / 2 ** 30,
+                           params_bytes / 2 ** 30,
+                           sharded_bytes / 2 ** 30, shards,
+                           hbm_bytes / 2 ** 30),
+                fix="shard params too (PodRuntime param_rules = "
+                    "parallel.dp.fsdp_rules(mesh)), spread over more "
+                    "chips, or shrink the resident dataset"))
+
+    # non-shardable segments, named before compile (same shared rule)
+    for segment in segments:
+        don_ids = set(id(v) for v in segment._don_vecs)
+        vecs = [v for v in (segment._input_vecs + segment._ro_vecs
+                            + segment._don_vecs
+                            + segment._output_vecs)
+                if isinstance(v, Vector)]
+        shardable = segment.has_prelude or any(
+            data_axis in tuple(spec_for_vector(
+                v, batch, shards, data_axis=data_axis,
+                param_rules=param_rules,
+                donated=id(v) in don_ids))
+            for v in vecs)
+        if not shardable:
+            findings.append(Finding(
+                "warning", "V-P02",
+                message="stitched segment %s carries no data-"
+                        "shardable tensor — it will replicate its "
+                        "whole compute on every one of the %d "
+                        "shard(s)"
+                        % ("+".join(segment.names), shards),
+                unit=segment.names[0],
+                fix="keep such chains off the pod path, or give the "
+                    "stage a batch-led tensor"))
+    if not segments:
+        findings.append(Finding(
+            "warning", "V-P02",
+            message="workflow has no stitched segments — PodRuntime"
+                    ".install would fail (stitch=off, interpret "
+                    "device, or no pure chains)",
+            fix="initialize on a jit device with "
+                "root.common.engine.stitch=on"))
+    return Report(findings, passes=["pod"])

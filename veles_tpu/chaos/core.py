@@ -29,6 +29,9 @@ site            meaning
 ``slave_recv``   a reply arriving at a :class:`JobClient`
 ``slave_job``    process-boundary check before each job's compute
 ``master_tick``  process-boundary check each server-loop iteration
+``pod_chip``     pod-runtime check before each sharded dispatch
+                 (``chip_kill`` → mesh shrink + reshard,
+                 :mod:`veles_tpu.pod`)
 ==============  ========================================================
 
 Knobs (``root.common.chaos.*``, read at :func:`configure` time —
@@ -64,9 +67,12 @@ from veles_tpu import trace
 
 #: wire actions a schedule entry (or probability knob) may request
 WIRE_ACTIONS = ("drop", "dup", "delay", "corrupt", "partition")
-#: process-boundary actions
+#: process-boundary actions (``chip_kill`` fires at the pod runtime's
+#: ``pod_chip`` site: one simulated chip drops out of the mesh, the
+#: pod reshards onto the survivors and bumps its generation —
+#: :meth:`veles_tpu.pod.runtime.PodRuntime.pre_dispatch`)
 PROCESS_ACTIONS = ("slave_kill", "slave_hang", "master_stall",
-                   "master_kill")
+                   "master_kill", "chip_kill")
 
 
 class Fault(object):
@@ -204,6 +210,12 @@ class ChaosController(object):
         self._partitions = {}
         #: per-action injected counts (the smoke's consistency record)
         self.injected = {}
+        #: (site, op) -> frames OBSERVED while armed, injected or not —
+        #: the wire-traffic probe the pod wire gate reads: arm an empty
+        #: schedule and these counters prove steady-state pod training
+        #: moves ZERO per-step gradient/update frames (control traffic
+        #: is O(heartbeats + epochs), not O(minibatches))
+        self.wire_frames = {}
         self.seed = 1234
 
     # -- configuration ------------------------------------------------------
@@ -235,6 +247,7 @@ class ChaosController(object):
             self._delay_ms = float(cfg.get("delay_ms", 50.0))
             self._partitions = {}
             self.injected = {}
+            self.wire_frames = {}
         return self
 
     def arm(self, schedule=None, seed=None):
@@ -255,6 +268,7 @@ class ChaosController(object):
             self._prob = {}
             self._partitions = {}
             self.injected = {}
+            self.wire_frames = {}
         return self
 
     def disarm(self):
@@ -288,11 +302,29 @@ class ChaosController(object):
         with self._lock:
             self._record(action, site, None, role=role)
 
+    def frames(self, site=None, op=None):
+        """Frames observed at the wire hooks while armed, filtered by
+        site and/or op — the traffic probe (counts traffic, injected
+        or clean; 0 when never armed)."""
+        with self._lock:
+            items = list(self.wire_frames.items())
+        total = 0
+        for (s, o), n in items:
+            if site is not None and s != site:
+                continue
+            if op is not None and o != op:
+                continue
+            total += n
+        return total
+
     def snapshot(self):
         with self._lock:
             return {"seed": self.seed,
                     "injected": dict(self.injected),
                     "faults_injected": self.faults_injected,
+                    "wire_frames": {"%s:%s" % k: n
+                                    for k, n in
+                                    self.wire_frames.items()},
                     "schedule": [f.to_dict() for f in self.schedule]}
 
     # -- wire hook ----------------------------------------------------------
@@ -302,6 +334,8 @@ class ChaosController(object):
         if not self.armed:
             return _CLEAN
         with self._lock:
+            key = (site, op)
+            self.wire_frames[key] = self.wire_frames.get(key, 0) + 1
             now = time.monotonic()
             # live partition window: every matching frame drops
             for (psite, pop), end in list(self._partitions.items()):

@@ -666,6 +666,14 @@ class Loader(Unit):
         return self
 
     # -- distribution (ref :631-687) ---------------------------------------
+    def resident_vectors(self):
+        """Dataset-category Vectors that stay device-resident for the
+        whole run — the buffers the pod runtime (:mod:`veles_tpu.pod`)
+        shards over its ``data`` axis and re-places on an elastic
+        reshard.  Base loaders expose the shuffled-index buffer;
+        FullBatch subclasses add the resident dataset/labels/targets."""
+        return [self.shuffled_indices]
+
     def generate_data_for_master(self):
         return True
 

@@ -269,6 +269,18 @@ class FullBatchLoader(Loader):
                 "size": int(loader.minibatch_size)},
             prelude=self.stitch_prelude)
 
+    def resident_vectors(self):
+        """The HBM-resident dataset family (pod sharding surface): the
+        raw sample rows, the pre-mapped labels and the shuffled-index
+        buffer — each sharded row-wise over the pod's ``data`` axis so
+        one chip holds ``1/shards`` of the dataset and the stitched
+        in-program gather partitions with it."""
+        vectors = super(FullBatchLoader, self).resident_vectors()
+        vectors.append(self.original_data)
+        if self.resident_labels:
+            vectors.append(self.resident_labels)
+        return vectors
+
     # -- distribution: job-spanning residency -------------------------------
     def prefetch_job_data(self, data):
         """Slave-side lookahead on the device fast path: merge the NEXT
@@ -345,6 +357,11 @@ class FullBatchLoaderMSE(FullBatchLoader):
         plan.append(("minibatch_targets", self.original_targets,
                      self.minibatch_targets, 0))
         return plan
+
+    def resident_vectors(self):
+        vectors = super(FullBatchLoaderMSE, self).resident_vectors()
+        vectors.append(self.original_targets)
+        return vectors
 
     def initialize(self, device=None, **kwargs):
         super(FullBatchLoaderMSE, self).initialize(device=device, **kwargs)

@@ -163,6 +163,9 @@ class Vector(Pickleable):
         self._dev_fresh_ = False   # device copy up to date
         self._tracked_bytes_ = 0
         self._tracked_category_ = None
+        #: pod-mesh placement (NamedSharding); process-local like the
+        #: device handle, installed by PodRuntime via set_sharding()
+        self._sharding_ = None
         # pre-category pickles (and bare __new__ construction paths)
         # lack the attribute entirely
         if not hasattr(self, "category"):
@@ -244,7 +247,16 @@ class Vector(Pickleable):
         if self._devmem_ is None or not self._dev_fresh_:
             if self._mem is None:
                 raise ValueError("empty Vector has no device memory")
-            self._set_devmem(self._device.put(self._mem))
+            if self._sharding_ is not None:
+                # pod placement: EVERY upload of this Vector (epoch
+                # reshuffles included) lands with its mesh sharding,
+                # so the AOT pod executables never see a drifted
+                # single-device array
+                import jax
+                self._set_devmem(jax.device_put(self._mem,
+                                                self._sharding_))
+            else:
+                self._set_devmem(self._device.put(self._mem))
             Watcher.track_h2d(self._mem.nbytes)
             self._dev_fresh_ = True   # host and device now agree
         return self._devmem_
@@ -304,6 +316,29 @@ class Vector(Pickleable):
             self._dev_fresh_ = True
             if host_array is None:
                 self._host_fresh_ = False
+        return self
+
+    @property
+    def sharding(self):
+        """The pinned pod-mesh placement (None = plain single-device
+        puts through ``device.put``)."""
+        return self._sharding_
+
+    def set_sharding(self, sharding):
+        """Pin (or clear, with None) this Vector's device placement to
+        a ``jax.sharding.Sharding`` — the pod runtime's reshard
+        primitive.  The freshest contents are preserved: a live device
+        copy syncs to host first, then the device side drops so the
+        next ``devmem`` access re-places it under the new sharding
+        (chip-kill reshard = set a smaller mesh's shardings and touch
+        ``devmem``).  No-op when the sharding is unchanged."""
+        if sharding is self._sharding_:
+            return self
+        if self._devmem_ is not None:
+            self.map_read()
+        self._sharding_ = sharding
+        self._drop_devmem()
+        self._dev_fresh_ = False
         return self
 
     def map_invalidate(self):

@@ -19,7 +19,8 @@ merging, ``server.py``/``client.py``) with the BASELINE.json north star:
 """
 
 from veles_tpu.parallel.mesh import (  # noqa: F401
-    make_mesh, replicated, shard_batch)
+    MeshTopologyError, make_mesh, mesh_from_topology, replicated,
+    shard_batch)
 from veles_tpu.parallel.dp import data_parallel  # noqa: F401
 from veles_tpu.parallel.ring import (  # noqa: F401
     mha_reference, ring_attention, ulysses_attention)

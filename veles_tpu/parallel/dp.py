@@ -11,6 +11,12 @@ host master exists on the hot path.
 Also provides tensor-parallel param sharding rules (the mesh design
 gives TP "for free" — SURVEY §2.4 table) for models whose layers
 exceed a chip.
+
+:func:`tp_rules` and :func:`fsdp_rules` double as the pod runtime's
+``param_rules`` (:class:`veles_tpu.pod.runtime.PodRuntime`): the same
+per-leaf PartitionSpec recipes shard the stitched eager trainer's
+parameter/solver Vectors when the V-P02 residency estimate says
+replication does not fit.
 """
 
 import jax

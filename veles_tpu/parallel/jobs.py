@@ -18,8 +18,17 @@ frames keep payload parity).  Heartbeats replace Twisted's
 connection-loss callbacks for failure detection.
 
 Wire protocol (pickled dicts):
-  slave → master: {op: handshake|job_request|update|ping, id, ...}
-  master → slave: {op: welcome|reject|job|update_ack|no_more_jobs|pong}
+  slave → master: {op: handshake|job_request|update|ping|pod_epoch, id}
+  master → slave: {op: welcome|reject|job|update_ack|no_more_jobs|pong
+                       |pod_epoch_ack}
+
+Pod mode (:mod:`veles_tpu.pod`): on a shared mesh this layer carries
+NO per-minibatch traffic — the master assigns *pod leases* (one job =
+one whole training assignment, :class:`veles_tpu.pod.membership
+.PodMaster`), gradients aggregate in-program over ICI, and what rides
+ZMQ is the control plane only: heartbeats, the per-epoch
+``pod_epoch`` Decision/checkpoint sync, elastic membership
+(drop_slave requeues the lease) and ONE final update per lease.
 
 Robustness semantics (docs/robustness.md):
 
@@ -395,6 +404,8 @@ class JobServer(Logger):
             self._on_job_request(identity, slave, msg)
         elif op == "update":
             self._on_update(identity, slave, msg)
+        elif op == "pod_epoch":
+            self._on_pod_epoch(identity, slave, msg)
         elif op == "prof":
             self._on_prof(identity, slave, msg)
 
@@ -677,6 +688,40 @@ class JobServer(Logger):
                               "req": req})
         self._maybe_checkpoint()
         self._maybe_finish()
+
+    def _on_pod_epoch(self, identity, slave, msg):
+        """Pod control plane (:mod:`veles_tpu.pod.membership`): one
+        frame per EPOCH, not per minibatch — a pod worker reports its
+        lease progress (epoch counter, eval metrics, its runtime's
+        generation after any elastic reshard) and the master answers
+        whether to stop (Decision sync).  Also a checkpoint trigger:
+        the master's epoch view advanced, so the ``checkpoint_every``
+        / epoch-boundary cadence gets its chance off the hot path.
+
+        Masters that are not pod-aware (no ``on_pod_epoch``) ack with
+        ``stop: 0`` so a mixed deployment degrades to worker-side
+        stopping instead of a protocol error."""
+        reply = {"op": "pod_epoch_ack", "req": msg.get("req"),
+                 "stop": 0}
+        hook = getattr(self.workflow, "on_pod_epoch", None)
+        if hook is not None:
+            try:
+                with self._lock:
+                    out = hook(msg, slave)
+                if out:
+                    reply.update(out)
+            except Exception:
+                self.exception("on_pod_epoch failed for %s", slave.id)
+        if trace.enabled():
+            trace.instant(
+                "jobs", "pod_epoch",
+                {"slave": slave.id, "epoch": msg.get("epoch"),
+                 "lease": msg.get("lease"),
+                 "pod_generation": msg.get("generation"),
+                 "stop": reply.get("stop", 0)},
+                role="master")
+        self._send(identity, reply)
+        self._maybe_checkpoint()
 
     def _on_prof(self, identity, slave, msg):
         """A slave shipped its trace-ring export + ledger summary at
@@ -1104,6 +1149,18 @@ class JobClient(Logger):
                 self._chaos_send(
                     {"op": "ping", "id": self.sid,
                      "t_ns": time.perf_counter_ns()})
+
+    def control(self, msg, timeout_ms=None):
+        """Public control-plane rpc: send one op dict (the ``id`` is
+        filled in) and return its reply — what the pod membership
+        layer's per-epoch sync rides instead of reaching into
+        :meth:`_rpc`.  Raises ``TimeoutError`` when the master stays
+        silent; callers decide between :meth:`_reconnect` and giving
+        up (the pod worker reconnects — its training state lives in
+        ITS HBM, not the master's)."""
+        msg = dict(msg)
+        msg.setdefault("id", self.sid)
+        return self._rpc(msg, timeout_ms=timeout_ms)
 
     def _heartbeat_loop(self, stop_event):
         """Keeps the master's last_seen fresh while a long job runs

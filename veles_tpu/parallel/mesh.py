@@ -4,11 +4,134 @@ The logical axes follow the scaling-book convention: ``data`` (DP),
 ``model`` (TP); pipeline/sequence axes are added by their consumers.
 An axis size of -1 absorbs all remaining devices (mirrors
 ``TPUDevice.make_mesh``, :mod:`veles_tpu.backends`).
+
+:func:`mesh_from_topology` is the knob-driven entry point
+(``root.common.engine.pod.topology``) the pod runtime, the gen engine
+and tests share, so none of them hand-rolls mesh construction — with
+typed errors (:class:`MeshTopologyError`) for non-divisible axis
+products and a transparent single-device fallback.
 """
 
 import jax
 import numpy
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class MeshTopologyError(ValueError):
+    """A requested topology cannot be laid out on the attached devices
+    (axis product does not divide the device count, unknown axis spec,
+    zero/negative size) — raised instead of silently training on fewer
+    chips than the operator asked for."""
+
+
+def _parse_topology(topology):
+    """Topology knob → ``{axis: size}``.  Accepted spellings:
+
+    * ``None`` / ``""`` / ``"auto"`` — all devices on the ``data`` axis;
+    * an int (or digit string) — that many ``data`` shards;
+    * ``"DxM"`` — ``{"data": D, "model": M}`` (either may be ``-1``);
+    * a dict ``{axis: size}`` (a Config node's ``to_dict()`` included).
+    """
+    if topology is None:
+        return {"data": -1}
+    if hasattr(topology, "to_dict"):
+        topology = topology.to_dict()
+    if isinstance(topology, dict):
+        if not topology:
+            return {"data": -1}
+        return {str(k): int(v) for k, v in topology.items()}
+    if isinstance(topology, int):
+        return {"data": int(topology)}
+    text = str(topology).strip().lower()
+    if text in ("", "auto"):
+        return {"data": -1}
+    parts = text.split("x")
+    try:
+        sizes = [int(p) for p in parts]
+    except ValueError:
+        raise MeshTopologyError(
+            "cannot parse pod topology %r — want an int, 'DxM', "
+            "'auto', or {axis: size}" % (topology,))
+    if len(sizes) == 1:
+        return {"data": sizes[0]}
+    if len(sizes) == 2:
+        return {"data": sizes[0], "model": sizes[1]}
+    raise MeshTopologyError(
+        "pod topology %r has %d axes — only data[xmodel] is "
+        "spellable as a string; pass {axis: size} for more"
+        % (topology, len(sizes)))
+
+
+def mesh_from_topology(topology=None, devices=None, require=None):
+    """Build the pod mesh from the ``root.common.engine.pod.topology``
+    knob (read fresh when ``topology`` is None) — THE mesh constructor
+    PodRuntime, the serving engines and the tests share.
+
+    Guarantees the loose :func:`make_mesh` does not:
+
+    * every axis size is validated (``0``/negative → typed error, at
+      most one ``-1`` wildcard);
+    * the axis product must DIVIDE the device count — ``{"data": 3}``
+      on 8 chips raises :class:`MeshTopologyError` naming the
+      remainder instead of silently mis-gridding; an explicit product
+      smaller than the device count is a deliberate sub-mesh (the
+      leading devices), a wildcard absorbs ``devices // fixed``;
+    * one attached device falls back to a transparent ``{"data": 1}``
+      mesh whatever the knob says — single-device development configs
+      run unchanged (``require`` axes are still present).
+
+    ``require``: axis names that must exist in the result (added with
+    size 1 when the topology omits them).
+    """
+    if topology is None:
+        from veles_tpu.config import root
+        node = root.common.engine.get("pod")
+        topology = node.get("topology") if node else None
+    axes = _parse_topology(topology)
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    for name in require or ():
+        axes.setdefault(name, 1)
+    if n <= 1:
+        # transparent single-device fallback: the caller's program
+        # compiles for a 1-sized mesh, which GSPMD lowers to the plain
+        # single-device executable
+        axes = {name: 1 for name in axes} or {"data": 1}
+        return Mesh(numpy.array(devices or jax.devices()[:1]).reshape(
+            [1] * len(axes)), tuple(axes))
+    wild = [name for name, size in axes.items() if size == -1]
+    if len(wild) > 1:
+        raise MeshTopologyError(
+            "pod topology %r has %d wildcard (-1) axes — at most one "
+            "can absorb the remainder" % (axes, len(wild)))
+    fixed = 1
+    for name, size in axes.items():
+        if size == -1:
+            continue
+        if size < 1:
+            raise MeshTopologyError(
+                "pod topology axis %r has size %d — sizes must be "
+                "positive (-1 = absorb remainder)" % (name, size))
+        fixed *= size
+    if wild:
+        if n % fixed:
+            raise MeshTopologyError(
+                "pod topology %r: fixed axis product %d does not "
+                "divide %d attached devices (remainder %d) — the "
+                "wildcard axis cannot absorb a fraction of a chip"
+                % (axes, fixed, n, n % fixed))
+        axes[wild[0]] = n // fixed
+    elif fixed > n or n % fixed:
+        raise MeshTopologyError(
+            "pod topology %r: axis product %d does not divide %d "
+            "attached devices (remainder %d) — match the attached "
+            "topology, pick a divisor sub-mesh, or spell an axis as "
+            "-1 to absorb the remainder"
+            % (axes, fixed, n, n % fixed if fixed <= n else fixed - n))
+    names = tuple(axes)
+    shape = tuple(axes[name] for name in names)
+    grid = numpy.array(devices[:int(numpy.prod(shape))]).reshape(shape)
+    return Mesh(grid, names)
 
 
 def make_mesh(axes=None, devices=None):

@@ -20,6 +20,13 @@ arguments from the TPU metadata; explicit arguments (or the
 ``VELES_COORDINATOR`` / ``VELES_NUM_PROCS`` / ``VELES_PROC_ID`` env
 vars, which the ssh bootstrap in :mod:`veles_tpu.launcher` forwards)
 cover CPU/GPU fleets and tests.
+
+The pod runtime composes here: :func:`initialize` first, then a
+:func:`veles_tpu.parallel.mesh.mesh_from_topology` mesh spans every
+host's devices and :class:`veles_tpu.pod.runtime.PodRuntime` compiles
+the stitched segments over it — one LEASE then covers a multi-host
+pod, with the collectives riding ICI in-slice and DCN across
+(ROADMAP item 2's pod-of-pods direction).
 """
 
 import os

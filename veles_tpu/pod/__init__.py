@@ -1,0 +1,19 @@
+"""veles_tpu.pod — one-pod-one-program training.
+
+The reference survey's explicit north star (PAPER.md §0: "ICI ``psum``
+replacing ZeroMQ gradient aggregation on-pod"), landed: slave jobs
+sharing a mesh become shards of ONE pjit'd stitched program
+(:class:`~veles_tpu.pod.runtime.PodRuntime`), and ZeroMQ is demoted to
+the cross-host control plane — pod leases, heartbeats, per-epoch
+Decision sync, checkpoint triggers and elastic membership
+(:mod:`~veles_tpu.pod.membership`).  Steady-state training moves ZERO
+gradient bytes over the wire; the chaos controller's wire-site frame
+counters are the proof (``python -m veles_tpu.pod --smoke``).
+
+See ``docs/distributed_training.md`` § Pod runtime.
+"""
+
+from veles_tpu.pod.membership import (  # noqa: F401
+    PodMaster, PodWorker, capture_params, eval_metrics,
+    install_params, train_epochs)
+from veles_tpu.pod.runtime import PodError, PodRuntime  # noqa: F401

@@ -1,0 +1,348 @@
+"""``python -m veles_tpu.pod`` — the one-pod-one-program CLI.
+
+``--smoke`` (the ``scripts/lint.sh`` CI gate; run under
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` for the 8-shard
+CPU session) trains a seeded sample THREE ways and gates the pod path
+on all of them:
+
+1. a single-device stitched reference run (the parity oracle);
+2. a full pod membership session over real localhost ZMQ — lease out,
+   per-epoch ``pod_epoch`` syncs, one final update — with the chaos
+   controller armed (empty schedule) so its wire-site frame counters
+   PROVE zero per-step gradient/update frames crossed the wire and the
+   control plane stayed O(heartbeats + epochs);
+3. a chaos session replaying the PR 7 style schedule on the pod path:
+   a chip kill mid-epoch (mesh shrink + reshard + generation bump), a
+   duplicated final-update frame (dedup'd) and a dropped lease frame
+   (lost-frame requeue) — completing with eval parity.
+
+Also asserted: zero steady-state recompiles (the reshard's recompile
+is a legitimate topology change, counted as warmup), the V-P02
+preflight clean, and a mesh-sharded
+:class:`veles_tpu.serve.engine.InferenceEngine` byte-identical to the
+single-device forward over the trained weights.
+"""
+
+import argparse
+import json
+import sys
+
+import numpy
+
+SMOKE_SEED = 20260804
+SMOKE_EPOCHS = 3
+SMOKE_BATCH = 64
+
+#: the seeded 5-cluster task every distributed gate in this repo
+#: trains (mirrors tests/test_chaos.py): 384 train + 128 validation
+#: 16-feature points around 5 class centers — converges in 2 epochs,
+#: compiles in seconds on the virtual CPU mesh
+SMOKE_LAYERS = [
+    {"type": "all2all_tanh", "->": {"output_sample_shape": 12},
+     "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+    {"type": "softmax", "->": {"output_sample_shape": 5},
+     "<-": {"learning_rate": 0.05}},
+]
+
+
+def make_workflow(max_epochs=SMOKE_EPOCHS, batch=SMOKE_BATCH,
+                  device=None, seed=21, is_master=False,
+                  is_slave=False):
+    """The smoke's stitched workflow over the seeded 5-cluster task.
+    Default standalone (pod workers train full epochs locally, so NO
+    slave-mode graph surgery); the launcher flags build the ZMQ
+    per-minibatch twins the parity tests compare against."""
+    from veles_tpu import prng
+    from veles_tpu.backends import AutoDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class PodSmokeLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(5)
+            n = 512
+            labels = (numpy.arange(n) % 5).astype(int)
+            centers = rng.standard_normal((5, 16)) * 3
+            self.original_data.mem = (
+                centers[labels]
+                + rng.standard_normal((n, 16)) * 0.5
+            ).astype(numpy.float32)
+            self.original_labels = [int(v) for v in labels]
+            self.class_lengths[:] = [0, 128, 384]
+
+    prng.seed_all(seed)
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: PodSmokeLoader(w,
+                                                minibatch_size=batch),
+        layers=[{**spec} for spec in SMOKE_LAYERS],
+        decision_config={"max_epochs": max_epochs})
+    wf.launcher = DummyLauncher(is_master=is_master,
+                                is_slave=is_slave)
+    wf.initialize(device=device or AutoDevice())
+    return wf
+
+
+def _reference_run(epochs):
+    """The single-device parity oracle, driven by the SAME epoch
+    stepper the pod worker uses (membership.train_epochs) so the two
+    trajectories compare like for like."""
+    from veles_tpu.pod import train_epochs
+    wf = make_workflow(max_epochs=epochs)
+    for _ in train_epochs(wf, epochs):
+        pass
+    return wf
+
+
+def _pod_session(epochs, schedule=None, seed=SMOKE_SEED, mesh=None):
+    """One full membership session over localhost ZMQ with chaos armed
+    (``schedule`` may be empty = counters only).  Returns
+    ``(master, server, worker, chaos_snapshot, survived)``."""
+    from veles_tpu import chaos
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.parallel.jobs import JobServer
+    from veles_tpu.pod import PodMaster, PodWorker
+
+    chaos.controller.arm(list(schedule or []), seed=seed)
+    # the master never dispatches kernels: NumpyDevice keeps its copy
+    # of the dataset off the mesh (per-host device config does not
+    # enter the checksum)
+    master_wf = make_workflow(max_epochs=epochs, device=NumpyDevice())
+    master = PodMaster(master_wf, pods=1, epochs=epochs)
+    server = JobServer(master, heartbeat_interval=0.4).start()
+    worker_wf = make_workflow(max_epochs=epochs)
+    worker = PodWorker(worker_wf, server.endpoint, mesh=mesh,
+                       rpc_timeout_ms=4000, reconnect_max_wait=10.0)
+    try:
+        survived = worker.run()
+    finally:
+        worker.close()
+        server.stop()
+        snap = chaos.controller.snapshot()
+        chaos.controller.disarm()
+    return master, server, worker, snap, survived
+
+
+def _metrics_close(a, b, tol=2.0):
+    """Eval parity: integer/flag fields equal, error-point fields
+    within ``tol`` (the in-program psum reorders float reductions, so
+    bitwise weight equality is not the contract — docs/
+    distributed_training.md § Numerics)."""
+    for key in set(a) & set(b):
+        va, vb = a[key], b[key]
+        if key == "complete":
+            if bool(va) != bool(vb):
+                return False
+        elif abs(float(va) - float(vb)) > tol:
+            return False
+    return True
+
+
+def _check_sharded_serving(wf, problems):
+    """Satellite gate: the request/response InferenceEngine accepts
+    the pod mesh and its pjit'd buckets answer byte-identically to
+    the single-device engine over the SAME trained weights."""
+    import jax
+
+    from veles_tpu.parallel.mesh import mesh_from_topology
+    from veles_tpu.serve.engine import InferenceEngine
+
+    if len(jax.devices()) < 2:
+        return
+    mesh = mesh_from_topology({"data": -1}, require=("data",))
+    batch = numpy.random.default_rng(7).standard_normal(
+        (8, 16)).astype(numpy.float32)
+    plain = InferenceEngine.from_workflow(wf, max_batch_size=8).warmup()
+    sharded = InferenceEngine.from_workflow(
+        wf, max_batch_size=8, mesh=mesh).warmup()
+    a = plain.infer(batch)
+    b = sharded.infer(batch)
+    if a.shape != b.shape or not numpy.array_equal(a, b):
+        problems.append(
+            "mesh-sharded InferenceEngine diverged from the "
+            "single-device forward (max |d|=%s)"
+            % (numpy.max(numpy.abs(a - b)) if a.shape == b.shape
+               else "shape"))
+
+
+def run_smoke(as_json=False, epochs=SMOKE_EPOCHS):
+    import jax
+
+    from veles_tpu import prof
+    from veles_tpu.pod import eval_metrics
+
+    problems = []
+    n_devices = len(jax.devices())
+    if n_devices < 2:
+        print("pod smoke: WARNING — %d device(s); run under "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+              "for the 8-shard gate (continuing on the 1-device "
+              "fallback)" % n_devices, file=sys.stderr)
+
+    # 1) single-device parity oracle
+    reference_wf = _reference_run(epochs)
+    reference = eval_metrics(reference_wf)
+    if not reference["complete"]:
+        problems.append("reference run did not complete")
+
+    # 2) clean pod session, chaos armed with an EMPTY schedule so the
+    #    wire-site counters record every frame without injecting
+    recompiles_before = prof.ledger.recompiles
+    master, server, worker, snap, survived = _pod_session(epochs)
+    shards = worker.runtime.shards if worker.runtime else 0
+    pod_metrics = (master.done.get("pod-0") or {}).get("metrics") or {}
+    frames = snap.get("wire_frames", {})
+
+    def count(op):
+        return sum(n for key, n in frames.items()
+                   if key == "master_recv:%s" % op)
+
+    update_frames = count("update")
+    job_frames = sum(n for key, n in frames.items()
+                     if key == "master_send:job")
+    epoch_frames = count("pod_epoch")
+    if not survived:
+        problems.append("clean pod session did not survive")
+    if not master.done:
+        problems.append("lease never finished")
+    # THE wire gate: one final update per lease, zero per-step
+    # gradient/update frames — steady state trained (epochs ×
+    # minibatches) steps but the wire saw O(heartbeats + epochs)
+    minibatches = epochs * (512 // SMOKE_BATCH)
+    if update_frames != 1:
+        problems.append(
+            "wire gate: %d update frame(s) on the wire (want exactly "
+            "1 — the final lease update)" % update_frames)
+    if epoch_frames > epochs + 1:
+        problems.append(
+            "wire gate: %d pod_epoch frames for %d epochs — the "
+            "control plane is not O(epochs)" % (epoch_frames, epochs))
+    if update_frames + job_frames >= minibatches:
+        problems.append(
+            "wire gate: %d data-plane frames vs %d minibatches — "
+            "per-step traffic survived"
+            % (update_frames + job_frames, minibatches))
+    if prof.ledger.recompiles - recompiles_before:
+        problems.append(
+            "%d steady-state recompile(s) during the clean pod "
+            "session" % (prof.ledger.recompiles - recompiles_before))
+    if shards != n_devices:
+        problems.append("pod ran %d shard(s) on %d devices"
+                        % (shards, n_devices))
+    if not _metrics_close(reference, pod_metrics):
+        problems.append(
+            "parity gate: pod metrics %r vs single-device %r"
+            % (pod_metrics, reference))
+
+    # 3) chaos session on the pod path: chip kill mid-epoch + dup'd
+    #    final update + dropped lease frame
+    chaos_schedule = [
+        {"site": "pod_chip", "action": "chip_kill", "nth": 3},
+        {"site": "slave_send", "action": "dup", "op": "update",
+         "nth": 1},
+        {"site": "master_send", "action": "drop", "op": "job",
+         "nth": 1},
+    ]
+    cmaster, cserver, cworker, csnap, csurvived = _pod_session(
+        epochs, schedule=chaos_schedule)
+    cmetrics = (cmaster.done.get("pod-0") or {}).get("metrics") or {}
+    if not csurvived or not cmaster.done:
+        problems.append("chaos pod session did not complete")
+    injected = csnap.get("injected", {})
+    if n_devices >= 2 and injected.get("chip_kill", 0) != 1:
+        problems.append("the scheduled chip kill never fired: %r"
+                        % injected)
+    if n_devices >= 2 and cworker.runtime \
+            and cworker.runtime.reshards != 1:
+        problems.append("chip kill did not reshard (reshards=%r)"
+                        % (cworker.runtime
+                           and cworker.runtime.reshards))
+    if n_devices >= 2 and cworker.runtime \
+            and cworker.runtime.generation != 2:
+        problems.append("reshard did not bump the generation")
+    if injected.get("drop", 0) and not (cserver.lost_requeued
+                                        or csurvived):
+        problems.append("dropped lease frame was never requeued")
+    if cserver.dedup_dropped < injected.get("dup", 0):
+        problems.append(
+            "dup'd final update slipped past dedup (%d < %d)"
+            % (cserver.dedup_dropped, injected.get("dup", 0)))
+    if not _metrics_close(reference, cmetrics):
+        problems.append(
+            "chaos parity gate: %r vs reference %r"
+            % (cmetrics, reference))
+
+    # 4) the mesh-sharded serving satellite, over the trained weights
+    try:
+        _check_sharded_serving(reference_wf, problems)
+    except Exception as exc:
+        problems.append("sharded InferenceEngine check raised: %s: %s"
+                        % (type(exc).__name__, exc))
+
+    pod_stats = (master.done.get("pod-0") or {}).get("pod") or {}
+    summary = {
+        "ok": not problems,
+        "devices": n_devices,
+        "shards": shards,
+        "epochs": epochs,
+        "update_frames": update_frames,
+        "pod_epoch_frames": epoch_frames,
+        "minibatches_trained": minibatches,
+        "psum_bytes_per_step": pod_stats.get("psum_bytes_per_step"),
+        "reshards_under_chaos": cworker.runtime.reshards
+        if cworker.runtime else None,
+        "chaos_injected": injected,
+        "reference_metrics": reference,
+        "pod_metrics": pod_metrics,
+        "problems": problems,
+    }
+    if as_json:
+        print(json.dumps(summary, indent=2, default=float))
+    else:
+        print("pod smoke: %d shard(s)/%d device(s), %d epoch(s), "
+              "%d update frame(s) on the wire for %d minibatches "
+              "trained, %s psum/step, chaos reshard gen=%s"
+              % (shards, n_devices, epochs, update_frames,
+                 minibatches, pod_stats.get("psum_bytes_per_step"),
+                 cworker.runtime.generation if cworker.runtime
+                 else "-"))
+        for problem in problems:
+            print("PROBLEM: %s" % problem)
+    return 0 if not problems else 1
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m veles_tpu.pod",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--smoke", action="store_true",
+                        help="run the CI one-pod-one-program gate")
+    parser.add_argument("--epochs", type=int, default=SMOKE_EPOCHS)
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable summary")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        # in-code watchdog on top of the caller's `timeout` wrapper —
+        # a hang IS a failure mode here (lease wait loops), never a
+        # silent stall
+        import signal
+
+        def _hang(signum, frame):
+            print("PROBLEM: pod smoke hung (watchdog)",
+                  file=sys.stderr)
+            import os
+            os._exit(3)
+        signal.signal(signal.SIGALRM, _hang)
+        signal.alarm(240)
+        try:
+            return run_smoke(as_json=args.json, epochs=args.epochs)
+        finally:
+            signal.alarm(0)
+    parser.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

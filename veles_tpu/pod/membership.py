@@ -1,0 +1,297 @@
+"""Pod membership: the control-plane split.
+
+With :class:`veles_tpu.pod.runtime.PodRuntime` aggregating gradients
+in-program, the ZMQ job layer stops carrying minibatches.  What
+remains — and what this module implements over the unchanged
+:class:`veles_tpu.parallel.jobs.JobServer` / ``JobClient`` machinery —
+is membership:
+
+* the master assigns **pod leases** instead of per-minibatch jobs: one
+  ``job`` frame carries a whole training assignment (epoch budget,
+  mesh topology, lease id); heartbeats keep the worker alive in the
+  master's reaper exactly as before;
+* a worker syncs once per EPOCH (op ``pod_epoch``): lease progress,
+  eval metrics, and its runtime's generation (bumped by any elastic
+  chip-kill reshard) go up; the Decision verdict (``stop``) comes
+  back; the master's checkpoint cadence triggers off the same frame;
+* ONE final ``update`` per lease ships the trained parameters + eval
+  metrics — deduplicated, generation-checked and requeue-safe by the
+  PR 7 exactly-once machinery, because it IS an ordinary job update;
+* elastic membership is the existing requeue path: a reaped or
+  re-handshaking worker's lease goes back on the queue
+  (``drop_slave``), and a worker re-granted a lease it already
+  progressed CONTINUES from its local epoch counter — its training
+  state never left its own HBM, so a master restart costs the pod
+  nothing but a re-handshake (the master-kill-and-resume story).
+
+Steady-state wire traffic is therefore O(heartbeats + epochs) — the
+chaos controller's wire-site frame counters are the proof the pod
+smoke and the acceptance tests assert on.
+"""
+
+import numpy
+
+from veles_tpu.logger import Logger
+from veles_tpu.parallel.mesh import mesh_from_topology
+from veles_tpu.pod.runtime import PodRuntime
+
+
+def capture_params(workflow):
+    """Host copies of the trained forward parameters, one dict per
+    forward unit — the final-update payload (and the master-side
+    install's input)."""
+    out = []
+    for unit in workflow.forwards:
+        entry = {}
+        if getattr(unit, "weights", None) and unit.weights:
+            unit.weights.map_read()
+            entry["weights"] = numpy.array(unit.weights.mem)
+        if getattr(unit, "bias", None) and unit.bias:
+            unit.bias.map_read()
+            entry["bias"] = numpy.array(unit.bias.mem)
+        out.append(entry)
+    return out
+
+
+def install_params(workflow, payload):
+    """Install a :func:`capture_params` payload into a workflow's
+    forward units (whole-buffer reset, the PR 4 fast install)."""
+    for unit, entry in zip(workflow.forwards, payload):
+        if "weights" in entry:
+            unit.weights.reset(entry["weights"])
+        if "bias" in entry and getattr(unit, "bias", None) is not None:
+            unit.bias.reset(entry["bias"])
+
+
+def eval_metrics(workflow):
+    """Decision-side eval summary (JSON-able) — the per-epoch sync
+    payload and the parity gate's comparison record."""
+    decision = workflow.decision
+    out = {"epochs": int(workflow.loader.epoch_number),
+           "complete": bool(decision.complete)}
+    for attr in ("best_n_err_pt", "best_epoch", "best_mse",
+                 "min_validation_n_err"):
+        value = getattr(decision, attr, None)
+        if value is not None:
+            out[attr] = float(value)
+    return out
+
+
+def train_epochs(workflow, epochs, already=0):
+    """Drive a standalone workflow epoch-by-epoch (generator yielding
+    the completed epoch number after each) — the ONE driver both the
+    pod worker and the parity references use, so "epoch boundary"
+    means the same thing on every side of a comparison.  ``already``
+    skips epochs a re-granted lease completed before a master
+    restart."""
+    decision = workflow.decision
+    for epoch in range(int(already), int(epochs)):
+        if int(workflow.loader.epoch_number) >= epoch + 1:
+            # this epoch already ran (resumed lease) — report only
+            yield epoch + 1
+            continue
+        decision.complete <<= False
+        decision.max_epochs = epoch + 1
+        workflow.run()
+        yield epoch + 1
+
+
+class PodMaster(Logger):
+    """The master-side workflow adapter a :class:`veles_tpu.parallel
+    .jobs.JobServer` serves: lease assignment, per-epoch Decision
+    sync, final-update installation, elastic requeue.
+
+    ``workflow``: the master's own (never-running) workflow — the
+    checksum anchor, the weight-install target, and the delegate for
+    the server's checkpoint protocol.  ``pods``: number of leases
+    (independent pod assignments) to hand out.  ``epochs``: the per-
+    lease epoch budget (default: the workflow Decision's
+    ``max_epochs``).  ``topology``: mesh topology shipped inside the
+    lease (None → each worker reads its own knob)."""
+
+    def __init__(self, workflow, pods=1, epochs=None, topology=None,
+                 **kwargs):
+        super(PodMaster, self).__init__(**kwargs)
+        self.workflow = workflow
+        self.epochs = int(epochs
+                          or getattr(workflow.decision, "max_epochs",
+                                     1))
+        self.topology = topology
+        self._queue = ["pod-%d" % i for i in range(int(pods))]
+        self._assigned = {}         # sid -> lease id
+        self.done = {}              # lease id -> final update payload
+        self.progress = {}          # lease id -> last pod_epoch msg
+        #: operator stop switch: the next epoch sync of every lease
+        #: answers stop=1 (Decision-level early stop across the pod)
+        self.stop_requested = False
+        self.total = int(pods)
+
+    # -- the JobServer workflow contract ------------------------------------
+    def checksum(self):
+        return self.workflow.checksum()
+
+    def generate_data_for_slave(self, slave):
+        from veles_tpu.workflow import NoJobYet
+        if self._queue:
+            lease_id = self._queue.pop(0)
+            self._assigned[slave.id] = lease_id
+            self.info("granting lease %s to %s (%d epoch(s))",
+                      lease_id, slave.id, self.epochs)
+            return {"pod_lease": {
+                "lease": lease_id, "epochs": self.epochs,
+                "topology": self.topology}}
+        if len(self.done) < self.total:
+            # every lease is out with a live worker: more work may
+            # still requeue (a reaped pod) — workers wait, not quit
+            raise NoJobYet
+        return None
+
+    def apply_data_from_slave(self, data, slave):
+        lease_id = data.get("lease")
+        self._assigned.pop(slave.id, None)
+        if data.get("params"):
+            install_params(self.workflow, data["params"])
+        self.done[lease_id] = data
+        self.info("lease %s finished: %r", lease_id,
+                  data.get("metrics"))
+
+    def drop_slave(self, slave):
+        """Elastic requeue: a dead/re-handshaking worker's unfinished
+        lease goes back on the queue for the next worker."""
+        lease_id = self._assigned.pop(slave.id, None)
+        if lease_id is not None and lease_id not in self.done:
+            self._queue.append(lease_id)
+            self.info("requeued lease %s from dropped worker %s",
+                      lease_id, slave.id)
+
+    def on_pod_epoch(self, msg, slave):
+        """The per-epoch Decision sync (see
+        :meth:`veles_tpu.parallel.jobs.JobServer._on_pod_epoch`)."""
+        lease_id = msg.get("lease")
+        self.progress[lease_id] = {
+            "epoch": int(msg.get("epoch", 0)),
+            "generation": int(msg.get("generation", 1)),
+            "shards": int(msg.get("shards", 1)),
+            "metrics": msg.get("metrics") or {},
+            "worker": slave.id,
+        }
+        stop = self.stop_requested \
+            or int(msg.get("epoch", 0)) >= self.epochs
+        return {"stop": int(bool(stop))}
+
+    # -- checkpoint protocol passthrough (master crash-recovery) ------------
+    def capture_train_state(self):
+        return self.workflow.capture_train_state()
+
+    def restore_train_state(self, train, meta):
+        return self.workflow.restore_train_state(train, meta)
+
+
+class PodWorker(Logger):
+    """The slave-side driver: ONE :class:`veles_tpu.parallel.jobs
+    .JobClient` whose single "job" is a pod lease.
+
+    The client's existing machinery supplies everything around the
+    lease: the heartbeat thread keeps the master's reaper quiet while
+    epochs run inside ``do_job``, ``_send_update_with_retry`` makes
+    the final update exactly-once, and ``_reconnect`` survives master
+    restarts — after which the re-granted lease resumes from this
+    worker's local epoch counter (the trained params never left its
+    HBM).
+
+    ``mesh`` overrides the lease/knob topology; ``param_rules``
+    forwards to :class:`PodRuntime` (TP/FSDP parameter sharding)."""
+
+    def __init__(self, workflow, endpoint, mesh=None, param_rules=None,
+                 sid=None, rpc_timeout_ms=5000, reconnect_max_wait=30.0,
+                 heartbeat_interval=None, **kwargs):
+        super(PodWorker, self).__init__(**kwargs)
+        from veles_tpu.parallel.jobs import (HEARTBEAT_INTERVAL,
+                                             JobClient)
+        self.workflow = workflow
+        self.mesh = mesh
+        self.param_rules = param_rules
+        self.runtime = None
+        #: lease id -> epochs completed locally (resume-on-regrant)
+        self._progress = {}
+        self.client = JobClient(
+            self, endpoint, sid=sid, rpc_timeout_ms=rpc_timeout_ms,
+            reconnect_max_wait=reconnect_max_wait,
+            heartbeat_interval=heartbeat_interval
+            if heartbeat_interval is not None else HEARTBEAT_INTERVAL)
+
+    # -- the JobClient workflow contract ------------------------------------
+    def checksum(self):
+        return self.workflow.checksum()
+
+    def do_job(self, data, callback):
+        """One job = one lease: install the runtime, train the epoch
+        budget with per-epoch syncs, answer with the final params +
+        metrics.  A lease this worker already progressed (master
+        restart → requeue → re-grant) resumes at its local counter."""
+        lease = data.get("pod_lease") or {}
+        lease_id = lease.get("lease", "pod-0")
+        epochs = int(lease.get("epochs") or 1)
+        self._ensure_runtime(lease)
+        already = self._progress.get(lease_id, 0)
+        if already:
+            self.info("lease %s re-granted at epoch %d/%d — resuming "
+                      "from the in-HBM state", lease_id, already,
+                      epochs)
+        for epoch in train_epochs(self.workflow, epochs,
+                                  already=already):
+            self._progress[lease_id] = epoch
+            if self._sync_epoch(lease_id, epoch):
+                self.info("master stopped lease %s at epoch %d",
+                          lease_id, epoch)
+                break
+        callback({
+            "lease": lease_id,
+            "params": capture_params(self.workflow),
+            "metrics": eval_metrics(self.workflow),
+            "pod": self.runtime.describe(),
+        })
+
+    def _ensure_runtime(self, lease):
+        if self.runtime is not None and self.runtime.installed:
+            return
+        mesh = self.mesh
+        if mesh is None and lease.get("topology") is not None:
+            mesh = mesh_from_topology(lease["topology"],
+                                      require=("data",))
+        self.runtime = PodRuntime(self.workflow, mesh=mesh,
+                                  param_rules=self.param_rules)
+        self.runtime.install()
+
+    def _sync_epoch(self, lease_id, epoch):
+        """One control-plane frame per epoch; a silent master is
+        re-handshaked ONCE and the sync retried — a master that stays
+        gone does not stall training (the pod is autonomous; the
+        final update's own retry/reconnect settles the books)."""
+        msg = {"op": "pod_epoch", "lease": lease_id, "epoch": epoch,
+               "generation": self.runtime.generation,
+               "shards": self.runtime.shards,
+               "metrics": eval_metrics(self.workflow)}
+        for attempt in (1, 2):
+            try:
+                reply = self.client.control(dict(msg))
+            except TimeoutError:
+                if attempt == 2 \
+                        or not self.client._reconnect("pod_epoch"):
+                    self.warning(
+                        "epoch %d sync unanswered — training on "
+                        "(the final update will reconcile)", epoch)
+                    return False
+                continue
+            return bool(reply.get("stop"))
+        return False
+
+    # -- lifecycle ----------------------------------------------------------
+    def run(self):
+        """Handshake and serve leases until ``no_more_jobs``; returns
+        the client's verdict (False = gave up / chaos-killed)."""
+        self.client.handshake()
+        return self.client.run()
+
+    def close(self):
+        self.client.close()

@@ -109,7 +109,8 @@ class LedgerEntry(object):
     """One compiled program's running cost account."""
 
     __slots__ = ("kind", "name", "cost", "compiles", "recompiles",
-                 "dispatches", "dispatch_ns", "items")
+                 "dispatches", "dispatch_ns", "items", "shards",
+                 "psum_bytes")
 
     def __init__(self, kind, name):
         self.kind = kind            # "segment" | "bucket" | "prefill"
@@ -123,6 +124,15 @@ class LedgerEntry(object):
         #: decode program runs all slots every step, so tokens, not
         #: dispatches, are the per-token throughput denominator)
         self.items = 0
+        #: the axis/shard dimension (veles_tpu.pod): how many mesh
+        #: shards execute this program in lockstep (1 = single device)
+        #: and the ICI bytes its in-program collectives move per
+        #: dispatch, accumulated — the psum twin of the Watcher's
+        #: h2d_bytes accounting (analytic ring-all-reduce estimate,
+        #: 2·(n−1)/n of the reduced buffers; XLA's cost model does
+        #: not expose collective traffic)
+        self.shards = 1
+        self.psum_bytes = 0
 
     @property
     def flops(self):
@@ -179,6 +189,12 @@ class LedgerEntry(object):
             row["items"] = self.items
             row["items_per_s"] = round(self.items_per_s(), 1)
             row["flops_per_item"] = round(self.flops_per_item(), 1)
+        if self.shards > 1 or self.psum_bytes:
+            row["shards"] = self.shards
+            row["psum_bytes"] = self.psum_bytes
+            row["psum_bytes_per_dispatch"] = round(
+                self.psum_bytes / self.dispatches, 1) \
+                if self.dispatches else 0
         return row
 
 
@@ -195,6 +211,9 @@ class PerfLedger(object):
         self.compile_events = 0
         self.recompiles = 0
         self.flops_dispatched = 0.0
+        #: running ICI collective traffic (bench reads deltas around a
+        #: timed region, like flops_dispatched)
+        self.psum_bytes_moved = 0
 
     def entry(self, kind, name):
         key = (kind, name)
@@ -230,16 +249,22 @@ class PerfLedger(object):
                 self.recompiles += 1
         return steady
 
-    def record_dispatch(self, entry, dur_ns, items=0):
+    def record_dispatch(self, entry, dur_ns, items=0, psum_bytes=0):
         """The hot-path hook: one turnaround on ``entry``.  GIL-cheap
         integer adds, no lock (single dispatching thread per entry;
         totals tolerate the rare lost update).  ``items``: useful work
         units this dispatch served (generative entries pass tokens —
-        prompt tokens for prefill, active slots for a decode step)."""
+        prompt tokens for prefill, active slots for a decode step).
+        ``psum_bytes``: ICI bytes this dispatch's in-program
+        collectives moved (pod segments pass their per-step gradient
+        all-reduce estimate)."""
         entry.dispatches += 1
         entry.dispatch_ns += int(dur_ns)
         if items:
             entry.items += int(items)
+        if psum_bytes:
+            entry.psum_bytes += int(psum_bytes)
+            self.psum_bytes_moved += int(psum_bytes)
         flops = entry.flops
         if flops:
             self.flops_dispatched += flops
@@ -264,6 +289,7 @@ class PerfLedger(object):
                 "compiles": self.compile_events,
                 "recompiles": self.recompiles,
                 "flops_dispatched": self.flops_dispatched,
+                "psum_bytes_moved": self.psum_bytes_moved,
                 "dispatch_ms": round(dispatch_ns / 1e6, 3),
                 "achieved_flops": round(achieved, 1),
                 "mfu": (round(achieved / peak, 6)
@@ -278,6 +304,7 @@ class PerfLedger(object):
             self.compile_events = 0
             self.recompiles = 0
             self.flops_dispatched = 0.0
+            self.psum_bytes_moved = 0
 
 
 #: THE process-wide ledger every compile point and reporter shares
@@ -425,6 +452,19 @@ def report_text(summary_dict=None):
         lines.append("")
         lines.append("stitched segments (per dispatch):")
         lines.extend(render_rows(segments, peak))
+        pod_rows = [r for r in segments if r.get("shards", 1) > 1]
+        if pod_rows:
+            # the pod-level line: one program over N mesh shards, with
+            # its ICI traffic next to the per-dispatch clocks (the
+            # h2d_bytes twin for the collective plane)
+            shards = max(r["shards"] for r in pod_rows)
+            total_psum = sum(r.get("psum_bytes", 0) for r in pod_rows)
+            dispatches = sum(r["dispatches"] for r in pod_rows) or 1
+            lines.append(
+                "  pod: %d shard(s) in lockstep, %s psum moved "
+                "(%s/dispatch)"
+                % (shards, _fmt_bytes(total_psum),
+                   _fmt_bytes(total_psum / dispatches)))
     if buckets:
         lines.append("")
         lines.append("serve buckets (per call):")

@@ -116,11 +116,21 @@ class InferenceEngine(Logger):
     training loop's progress is visible to clients without rebuilding
     the engine (shapes must stay fixed; a topology change needs a new
     engine + registry hot-swap).
+
+    ``mesh`` / ``param_specs``: the declarative mesh-sharded forward
+    the generative engine already has (ROADMAP item 3 tail), ported:
+    pass a ``jax.sharding.Mesh`` and the AOT buckets pjit over it —
+    params placed per ``param_specs`` (a PartitionSpec pytree matching
+    the params tree, or a callable ``leaf -> PartitionSpec | None``
+    like :func:`veles_tpu.parallel.dp.tp_rules`; ``None`` replicates),
+    request batches replicated like the gen engine's tokens.  A
+    ``None``/single-device mesh is the transparent fallback: the
+    single-device path is byte-identical (no pjit wrapper at all).
     """
 
     def __init__(self, params, apply_fn, sample_shape,
                  max_batch_size=64, buckets=None, params_source=None,
-                 **kwargs):
+                 mesh=None, param_specs=None, **kwargs):
         super(InferenceEngine, self).__init__(**kwargs)
         import jax
         self._jax = jax
@@ -137,8 +147,19 @@ class InferenceEngine(Logger):
                 "largest bucket %d must equal max_batch_size %d"
                 % (self.buckets[-1], self.max_batch_size))
         self.params_source = params_source
-        self._params = jax.device_put(params)
-        self._jit = jax.jit(apply_fn)
+        # a mesh without >1 device total IS the single-device path
+        self.mesh = mesh if (mesh is not None and
+                             int(numpy.prod(list(mesh.shape.values())
+                                            or [1])) > 1) else None
+        self._shardings = self._build_shardings(params, param_specs)
+        if self._shardings is None:
+            self._params = jax.device_put(params)
+            self._jit = jax.jit(apply_fn)
+        else:
+            p_sh, repl = self._shardings
+            self._params = jax.device_put(params, p_sh)
+            self._jit = jax.jit(apply_fn, in_shardings=(p_sh, repl),
+                                out_shardings=repl)
         self._compiled = {}          # batch size -> AOT executable
         self._compile_lock = threading.Lock()
         self.compile_count = 0
@@ -262,6 +283,30 @@ class InferenceEngine(Logger):
                 "pass sample_shape=(...) explicitly")
         return shape
 
+    # -- sharding ---------------------------------------------------------
+    def _build_shardings(self, params, param_specs):
+        """``(params_sharding_tree, replicated)`` over the mesh, or
+        ``None`` on the single-device path.  Same shape as the gen
+        engine's ``_build_shardings``: specs map per leaf, everything
+        unspecified replicates."""
+        if self.mesh is None:
+            return None
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+        if param_specs is None:
+            p_sh = jax.tree.map(lambda _leaf: repl, params)
+        elif callable(param_specs):
+            p_sh = jax.tree.map(
+                lambda leaf: NamedSharding(
+                    mesh, param_specs(leaf) or P()), params)
+        else:
+            p_sh = jax.tree.map(
+                lambda spec: NamedSharding(mesh, spec), param_specs,
+                is_leaf=lambda x: isinstance(x, P))
+        return p_sh, repl
+
     # -- compilation ------------------------------------------------------
     def _bucket_for(self, n):
         for b in self.buckets:
@@ -365,8 +410,13 @@ class InferenceEngine(Logger):
     def update_params(self, params):
         """Install new host params (same tree structure/shapes).  The
         swap is a single reference assignment: concurrent ``infer``
-        calls see either the old or the new tree, never a mix."""
-        self._params = self._jax.device_put(params)
+        calls see either the old or the new tree, never a mix.  On a
+        mesh the new tree lands with the engine's param shardings."""
+        if self._shardings is not None:
+            self._params = self._jax.device_put(params,
+                                                self._shardings[0])
+        else:
+            self._params = self._jax.device_put(params)
 
     def infer(self, batch):
         """Host batch → host float32 outputs, same leading length.

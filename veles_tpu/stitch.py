@@ -45,6 +45,13 @@ Segment eligibility (checked per chain link ``u → v``):
 
 ``root.common.engine.stitch = off`` restores the seed per-unit
 execution path byte for byte (segments are simply not built).
+
+Pod mode (:mod:`veles_tpu.pod`): a segment's fused program can be
+recompiled for a device mesh via :meth:`StitchSegment.set_shardings`
+— same plan, explicit in/out shardings, gradient aggregation becomes
+an in-program ``psum`` — with the bound :class:`~veles_tpu.pod
+.runtime.PodRuntime` consulted before every dispatch (elastic
+chip-kill reshard) and supplying the ledger's shard/psum columns.
 """
 
 import time
@@ -116,6 +123,11 @@ class StitchSegment(Logger):
         self._member_ids = frozenset(id(u) for u in self.units[1:])
         self._build_plan()
         self._jitted = jax.jit(self._program, donate_argnums=(2,))
+        #: pod binding (veles_tpu.pod.runtime.PodRuntime or None):
+        #: consulted before every dispatch (chip-kill / reshard hook),
+        #: supplies the shard count + per-dispatch psum-byte estimate
+        #: for the ledger's axis dimension and the per-shard lanes
+        self.pod = None
         #: the AOT executable installed by the first dispatch; it
         #: ENFORCES the traced signature, so a drifted call raises
         #: (and the recompile sentinel flags it) instead of silently
@@ -246,6 +258,33 @@ class StitchSegment(Logger):
         serving bookkeeping executed before each dispatch)."""
         return any(stage.prelude is not None for stage in self.stages)
 
+    # -- pod sharding (veles_tpu.pod) ---------------------------------------
+    def set_shardings(self, in_shardings, out_shardings):
+        """Rebuild the fused program's jit wrapper with explicit mesh
+        shardings (the pod runtime's one-pod-one-program install / a
+        chip-kill reshard).  The pytrees must match the ``_program``
+        signature: ``in_shardings = (inputs, ro, don, scalars-prefix)``
+        and ``out_shardings = (outputs, new_don, metrics)``.
+
+        Every AOT executable compiled for the OLD placement is
+        dropped: a resharded mesh is a new program by definition, and
+        the stale executables would reject (ValueError, not the
+        retrace TypeError) the newly-placed arguments."""
+        self._jitted = jax.jit(self._program, donate_argnums=(2,),
+                               in_shardings=in_shardings,
+                               out_shardings=out_shardings)
+        self._compiled = None
+        self._fingerprint = None
+        self._compiled_cache = {}
+
+    def clear_shardings(self):
+        """Back to the implicit single-device jit (pod uninstall)."""
+        self._jitted = jax.jit(self._program, donate_argnums=(2,))
+        self._compiled = None
+        self._fingerprint = None
+        self._compiled_cache = {}
+        self.pod = None
+
     # -- compilation --------------------------------------------------------
     def _compile(self, args, steady=False):
         """Lower + AOT-compile the fused program for ``args``'
@@ -277,6 +316,13 @@ class StitchSegment(Logger):
     # -- execution ----------------------------------------------------------
     def execute(self):
         """Dispatch the whole segment as one program and publish."""
+        if self.pod is not None:
+            # pod pre-dispatch: the chaos ``pod_chip`` site — a
+            # chip_kill here shrinks the mesh, reshards every resident
+            # buffer and swaps THIS segment's program before the args
+            # below are gathered, so the dispatch proceeds on the
+            # surviving chips from the last in-HBM-consistent step
+            self.pod.pre_dispatch(self)
         with trace.span("segment", "dispatch", self._trace_args):
             # the nested host_prep span breaks out the host share of a
             # turnaround (preludes + devmem gathering + scalar
@@ -354,8 +400,23 @@ class StitchSegment(Logger):
             for (unit, name), value in zip(self._metric_spec, metrics):
                 setattr(unit, name, value)
             self.dispatches += 1
+            toc = time.perf_counter_ns()
+            pod = self.pod
             prof.ledger.record_dispatch(
-                self.prof_entry, time.perf_counter_ns() - tic)
+                self.prof_entry, toc - tic,
+                psum_bytes=pod.segment_psum_bytes(self)
+                if pod is not None else 0)
+            if pod is not None and trace.enabled():
+                # per-shard lanes: the host turnaround mirrored onto
+                # one synthetic tid per mesh shard under the "pod"
+                # role, so the merged Perfetto timeline renders one
+                # pod as ONE pid with a lane per chip (host clocks —
+                # per-chip device timelines need the jax.profiler
+                # bridge, trace.device_trace())
+                for shard in range(pod.shards):
+                    trace.complete("pod", "shard_dispatch", tic,
+                                   toc - tic, self._trace_args,
+                                   role="pod", tid=shard)
             self._computed = set(self._member_ids)
 
     def member_run(self, unit):

@@ -113,9 +113,14 @@ class TraceRecorder(object):
 
     # -- recording (hot) ----------------------------------------------------
     def record(self, phase, cat, name, ts_ns, dur_ns, args=None,
-               role=None):
+               role=None, tid=None):
+        """``tid`` defaults to the recording thread's ident; an explicit
+        value labels synthetic lanes — the pod runtime's per-shard
+        dispatch spans use shard indices so one pod renders as ONE pid
+        with a lane per chip in Perfetto."""
         event = (phase, cat, name, ts_ns, dur_ns,
-                 threading.get_ident(), args, role or self.role)
+                 threading.get_ident() if tid is None else int(tid),
+                 args, role or self.role)
         key = (cat, name)
         with self._lock:
             self._ring[self._pos % self.capacity] = event
@@ -220,14 +225,17 @@ def counter(cat, name, value, role=None):
                {"value": value}, role)
 
 
-def complete(cat, name, begin_ns, dur_ns, args=None, role=None):
+def complete(cat, name, begin_ns, dur_ns, args=None, role=None,
+             tid=None):
     """Record a span retroactively from caller-held timestamps (the
     serve request lifecycle measures enqueue→reply with its own
-    ``perf_counter`` stamps — same clock as ``perf_counter_ns``)."""
+    ``perf_counter`` stamps — same clock as ``perf_counter_ns``).
+    ``tid`` labels a synthetic lane (pod per-shard spans)."""
     rec = recorder
     if not rec.enabled:
         return
-    rec.record("X", cat, name, int(begin_ns), int(dur_ns), args, role)
+    rec.record("X", cat, name, int(begin_ns), int(dur_ns), args, role,
+               tid=tid)
 
 
 def enabled():
