@@ -520,7 +520,7 @@ def _conv_stage(metric, layers, input_shape, n_classes, batch, steps,
 
 
 def _wf_stage(metric, fused_config=None, sample=None, fused=True,
-              vs=None, extra=None, loader_mode=None):
+              vs=None, extra=None, loader_mode=None, epoch_scan=None):
     """The WHOLE framework path: StandardWorkflow(fused=True) — graph
     scheduling, loader epoch bookkeeping, Decision accounting, and the
     fused step — timed over full epochs via wf.run().  Every minibatch
@@ -550,8 +550,11 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
 
     saved_loader = root.common.engine.get("loader", "auto")
     saved_trace = root.common.engine.get("trace", "off")
+    saved_scan = root.common.engine.get("epoch_scan", "off")
     if loader_mode is not None:
         root.common.engine.loader = loader_mode
+    if epoch_scan is not None:
+        root.common.engine.epoch_scan = epoch_scan
     root.common.engine.trace = "on"    # initialize() → trace.configure
     try:
         prng.seed_all(1234)
@@ -573,6 +576,11 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
         flops_before = prof.ledger.flops_dispatched
         recompiles_before = prof.ledger.recompiles
         faults_before = chaos.controller.faults_injected
+        # per-entry (dispatches, steps) snapshot: the steps_per_dispatch
+        # column (epoch-scan windows fold K steps into one dispatch;
+        # per-step entries count each dispatch as one step)
+        ledger_before = {(e.kind, e.name): (e.dispatches, e.steps)
+                         for e in prof.ledger.entries("segment")}
         tic = time.perf_counter()
         wf.run()                           # epochs 3-4, warm
         elapsed = time.perf_counter() - tic
@@ -599,9 +607,18 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
         wf_mfu = (round(flops_delta / elapsed / peak, 4)
                   if peak and flops_delta else None)
         peak_hbm = Watcher.peak_bytes
+        seg_dispatches = seg_steps = 0
+        for e in prof.ledger.entries("segment"):
+            d0, s0 = ledger_before.get((e.kind, e.name), (0, 0))
+            dd, sd = e.dispatches - d0, e.steps - s0
+            seg_dispatches += dd
+            seg_steps += sd if sd else dd
+        steps_per_dispatch = round(seg_steps / seg_dispatches, 2) \
+            if seg_dispatches else None
     finally:
         root.common.engine.loader = saved_loader
         root.common.engine.trace = saved_trace
+        root.common.engine.epoch_scan = saved_scan
         trace.configure()
     # train-only images over the wall clock (which includes the eval
     # passes): comparable to the fused synthetic-batch line — counting
@@ -621,8 +638,11 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
     extra.setdefault("peak_hbm_bytes", peak_hbm)
     extra.setdefault("recompiles", recompiles)
     extra.setdefault("faults_injected", faults_injected)
+    extra.setdefault("steps_per_dispatch", steps_per_dispatch)
     if loader_mode is not None:
         extra.setdefault("loader", loader_mode)
+    if epoch_scan is not None:
+        extra.setdefault("epoch_scan", epoch_scan)
     _emit(metric, sec_per_step, batch, None, vs=vs, extra=extra)
     return batch / sec_per_step
 
@@ -682,6 +702,13 @@ def stage_mnist_wf_eager():
                "vs_metric": "mnist_wf (fused, same run)"})
 
 
+#: per-step stitched devloader images/sec from THIS ladder run — the
+#: epoch-scan stage's vs= denominator (the true apples-to-apples:
+#: same device-resident loader, same stitched programs, only the
+#: K-step window folding differs)
+_WF_DEVLOADER_IPS = [None]
+
+
 def stage_mnist_wf_eager_devloader():
     """The stitched eager trainer with the DEVICE-RESIDENT input
     pipeline (``engine.loader=device``): the loader heads the first
@@ -696,12 +723,38 @@ def stage_mnist_wf_eager_devloader():
         stage_mnist_wf_eager()
         eager_ips = _WF_EAGER_IPS[0]
     from veles_tpu.config import root
-    _wf_stage("MNIST784 full StandardWorkflow(eager, device-resident "
-              "loader) train throughput (epoch wall-clock incl. eval)",
-              fused=False, vs=eager_ips, loader_mode="device",
-              extra={"stitch": root.common.engine.get("stitch", "on"),
-                     "vs_metric": "mnist_wf_eager (host loader, "
-                                  "same run)"})
+    _WF_DEVLOADER_IPS[0] = _wf_stage(
+        "MNIST784 full StandardWorkflow(eager, device-resident "
+        "loader) train throughput (epoch wall-clock incl. eval)",
+        fused=False, vs=eager_ips, loader_mode="device",
+        extra={"stitch": root.common.engine.get("stitch", "on"),
+               "vs_metric": "mnist_wf_eager (host loader, "
+                            "same run)"})
+
+
+def stage_mnist_wf_eager_epoch():
+    """One-dispatch epochs on the stitched eager trainer
+    (``engine.epoch_scan=auto``): K consecutive steps — the in-program
+    gather, the forward/evaluator chain AND the GD chain — fold into
+    ONE ``lax.scan`` dispatch with donated weight/momentum carry and
+    the Decision metric accumulated in-program, so a class pass is one
+    host dispatch.  Emits ``vs=`` the per-step stitched devloader line
+    from the SAME ladder run (identical programs, only the window
+    folding differs) — ``vs_baseline`` IS the host-dispatch-
+    elimination speedup the fused path's ``epoch_mode`` banked ~28%
+    for — plus the ``steps_per_dispatch`` ledger column; re-measures
+    the per-step twin in-process when BENCH_STAGES skipped it."""
+    devloader_ips = _WF_DEVLOADER_IPS[0]
+    if devloader_ips is None:
+        stage_mnist_wf_eager_devloader()
+        devloader_ips = _WF_DEVLOADER_IPS[0]
+    _wf_stage("MNIST784 full StandardWorkflow(eager, epoch-scan "
+              "windows) train throughput (epoch wall-clock incl. "
+              "eval)",
+              fused=False, vs=devloader_ips, loader_mode="device",
+              epoch_scan="auto",
+              extra={"vs_metric": "mnist_wf_eager_devloader "
+                                  "(per-step stitched, same run)"})
 
 
 def stage_mnist_wf_slave():
@@ -880,6 +933,81 @@ def stage_mnist_pod():
                  "devices": len(jax.devices()),
                  "vs_metric": "ZMQ master+slave eager jobs "
                               "(same run)"})
+
+
+def stage_mnist_pod_epoch():
+    """One-dispatch POD epochs: the PodRuntime-sharded stitched
+    trainer with ``engine.epoch_scan=auto`` — the K-step scan folds
+    into the pjit'd window program, gradient aggregation stays an
+    in-scan ``psum`` on the data axis, and a pod epoch is ONE dispatch
+    per class pass.  Self-baselined: the SAME warmed pod workflow is
+    timed per-step (knob off) then windowed (knob auto), so
+    ``vs_baseline`` IS the pod host-dispatch-elimination ratio;
+    ``dispatches_per_epoch`` records the trace-counted dispatch rate
+    of the windowed region (the pod smoke asserts the same bound in
+    CI)."""
+    import jax
+
+    from veles_tpu import prng, prof, trace
+    from veles_tpu.backends import AutoDevice
+    from veles_tpu.config import root
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.base import TRAIN
+    from veles_tpu.parallel.mesh import mesh_from_topology
+    from veles_tpu.pod import PodRuntime, train_epochs
+    from veles_tpu.samples import mnist
+
+    batch = 2048
+    saved_scan = root.common.engine.get("epoch_scan", "off")
+    saved_trace = root.common.engine.get("trace", "off")
+    root.common.engine.trace = "on"
+    try:
+        prng.seed_all(1234)
+        wf = mnist.create_workflow(
+            launcher=DummyLauncher(), max_epochs=2,
+            minibatch_size=batch, fused=False)
+        wf.initialize(device=AutoDevice())
+        pod = PodRuntime(wf, mesh=mesh_from_topology(
+            {"data": -1}, require=("data",)))
+        pod.install()
+        root.common.engine.epoch_scan = "off"
+        for _ in train_epochs(wf, 2):       # warm: compiles included
+            pass
+        train_samples = 2 * int(wf.loader.class_lengths[TRAIN])
+        tic = time.perf_counter()
+        for _ in train_epochs(wf, 4, already=2):    # per-step, warm
+            pass
+        per_step_ips = train_samples / (time.perf_counter() - tic)
+        root.common.engine.epoch_scan = "auto"
+        for _ in train_epochs(wf, 5, already=4):    # window compiles
+            pass
+        dispatches_before = trace.recorder.count("segment", "dispatch")
+        recompiles_before = prof.ledger.recompiles
+        psum_before = prof.ledger.psum_bytes_moved
+        tic = time.perf_counter()
+        for _ in train_epochs(wf, 7, already=5):    # windowed, warm
+            pass
+        elapsed = time.perf_counter() - tic
+        dispatches = trace.recorder.count("segment", "dispatch") \
+            - dispatches_before
+        _emit("MNIST784 full StandardWorkflow(eager, pod, epoch-scan "
+              "windows) one-dispatch-epoch train throughput (epoch "
+              "wall-clock incl. eval, %d-shard mesh)" % pod.shards,
+              batch * elapsed / train_samples, batch, None,
+              vs=per_step_ips,
+              extra={"dispatches_per_epoch": round(dispatches / 2, 1),
+                     "shards": pod.shards,
+                     "psum_bytes_moved":
+                     prof.ledger.psum_bytes_moved - psum_before,
+                     "recompiles": prof.ledger.recompiles
+                     - recompiles_before,
+                     "devices": len(jax.devices()),
+                     "vs_metric": "same pod workflow, per-step "
+                                  "stitched (same run)"})
+    finally:
+        root.common.engine.epoch_scan = saved_scan
+        root.common.engine.trace = saved_trace
+        trace.configure()
 
 
 def stage_ae_wf_epoch():
@@ -1851,8 +1979,10 @@ STAGES = {
     "ae_wf_epoch": (stage_ae_wf_epoch, 240),
     "mnist_wf_eager": (stage_mnist_wf_eager, 300),
     "mnist_wf_eager_devloader": (stage_mnist_wf_eager_devloader, 300),
+    "mnist_wf_eager_epoch": (stage_mnist_wf_eager_epoch, 300),
     "mnist_wf_slave": (stage_mnist_wf_slave, 300),
     "mnist_pod": (stage_mnist_pod, 420),
+    "mnist_pod_epoch": (stage_mnist_pod_epoch, 420),
     "cifar": (stage_cifar, 210),
     "stl10": (stage_stl10, 240),
     "ae": (stage_ae, 150),
@@ -1880,7 +2010,8 @@ STAGES = {
 _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "mnist_wf",
                "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
-               "mnist_wf_eager_devloader", "mnist_wf_slave", "mnist_pod",
+               "mnist_wf_eager_devloader", "mnist_wf_eager_epoch",
+               "mnist_wf_slave", "mnist_pod", "mnist_pod_epoch",
                "cifar", "stl10", "ae",
                "kohonen",
                "lstm", "transformer", "transformer_gen", "profile_lm",
@@ -1902,16 +2033,16 @@ _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
                "mnist_e2e_u8", "mnist_epoch", "power", "native_infer",
                "cifar", "stl10", "ae", "kohonen", "mnist_wf",
                "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
-               "mnist_wf_eager_devloader", "mnist_wf_slave",
-               "mnist_pod")
+               "mnist_wf_eager_devloader", "mnist_wf_eager_epoch",
+               "mnist_wf_slave", "mnist_pod", "mnist_pod_epoch")
 
 #: CPU fallback (rehearsed with a wedged tunnel): conv/LM heavies
 #: cannot finish on CPU inside their caps — end on the flagship MNIST
 #: number so the recorded last line is a real measurement.
 _CPU_ORDER = ("mnist_e2e", "mnist_epoch", "mnist_wf",
               "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
-              "mnist_wf_eager_devloader", "mnist_wf_slave",
-              "mnist_pod", "ae",
+              "mnist_wf_eager_devloader", "mnist_wf_eager_epoch",
+              "mnist_wf_slave", "mnist_pod", "mnist_pod_epoch", "ae",
               "kohonen", "lstm", "transformer_gen",
               "native_infer", "mnist_u8", "mnist_bf16", "mnist")
 

@@ -32,6 +32,14 @@ done
 # compile fingerprinted and ZERO steady-state recompiles
 echo "== prof smoke (veles_tpu.samples.mnist) =="
 env JAX_PLATFORMS=cpu python -m veles_tpu.prof --smoke veles_tpu.samples.mnist
+# epoch-scan smoke: a stitched mnist run under engine.epoch_scan=auto
+# must fold K steps per dispatch — host dispatches <= ceil(steps/K) +
+# one per class span in trace_report()'s host-gap split — with ZERO
+# steady-state recompiles and the V-J10 rule silent over the sample
+# workflow (docs/engine_fast_path.md § Epoch mode)
+echo "== epoch smoke (one-dispatch-epoch gate) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu \
+  python -m veles_tpu.epoch_scan --smoke veles_tpu.samples.mnist
 # chaos smoke: a fixed-seed master–slave session over real ZMQ with an
 # injected slave death, a dropped job frame and a duplicated update
 # frame must COMPLETE — no hang (timeout-wrapped), every job applied

@@ -702,3 +702,126 @@ def test_v_j09_in_catalog_and_real_workflows_stay_clean():
     findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
     assert "V-J09" not in rules_of(findings), \
         [f.render() for f in findings]
+
+
+# -- V-J10: host-sync hazards under an epoch-scan window --------------------
+
+def test_v_j10_stitch_stage_host_sync_flagged():
+    """V-J10: io_callback / jax.debug.print / device_get / .item()
+    inside a stitch_stage body would serialize (or break) the K-step
+    scan window; the pure-stage idiom stays silent."""
+    from veles_tpu.analyze.shapes import scan_epoch_scan_hazards
+
+    class CallbackStage(Unit):
+        hide_from_registry = True
+
+        def stitch_stage(self):
+            import jax.numpy as jnp
+
+            def fn(t):
+                jax.debug.print("step {}", t["x"])
+                jax.experimental.io_callback(print, None, t["x"])
+                host = jax.device_get(t["x"])
+                return {"y": jnp.asarray(host) + t["x"].item()}
+            return fn
+
+    class PureStage(Unit):
+        hide_from_registry = True
+
+        def stitch_stage(self):
+            import jax.numpy as jnp
+
+            def fn(t):
+                return {"y": jnp.tanh(t["x"])}
+            return fn
+
+    wf = DummyWorkflow()
+    hot = scan_epoch_scan_hazards(CallbackStage(wf, name="cb"))
+    assert rules_of(hot) == {"V-J10"}, [f.render() for f in hot]
+    assert len(hot) == 4
+    assert all(f.location for f in hot)
+    assert "serialize" in hot[0].message
+    clean = scan_epoch_scan_hazards(PureStage(wf, name="pure"))
+    assert clean == [], [f.render() for f in clean]
+
+
+def test_v_j10_decision_override_flagged_and_protocol_silent():
+    """V-J10's Decision half: with the epoch_scan knob SET, a
+    subclass overriding the per-step run() with host-only logic loses
+    the scan protocol marker and is flagged with the device-predicate
+    remedy; the stock DecisionGD / DecisionMSE (and a subclass that
+    re-opts in) stay silent — and with the knob off (the default) a
+    legacy host-logic Decision is not flagged at all (no warning
+    noise for a feature the run never enables)."""
+    from veles_tpu.analyze.shapes import scan_epoch_scan_hazards
+    from veles_tpu.config import root
+    from veles_tpu.znicz.decision import DecisionGD, DecisionMSE
+
+    wf = DummyWorkflow()
+
+    class HostOnlyDecision(DecisionGD):
+        hide_from_registry = True
+
+        def run(self):
+            self.epoch_n_err[0] += float(self.evaluator.n_err)
+
+    host_only = HostOnlyDecision(wf, name="host_only")
+    assert scan_epoch_scan_hazards(host_only) == []   # knob off
+    saved = root.common.engine.get("epoch_scan", "off")
+    root.common.engine.epoch_scan = "auto"
+    try:
+        flagged = scan_epoch_scan_hazards(host_only)
+        assert rules_of(flagged) == {"V-J10"}, \
+            [f.render() for f in flagged]
+        assert "device-predicate" in flagged[0].fix
+        for cls in (DecisionGD, DecisionMSE):
+            unit = cls(wf, name="stock_%s" % cls.__name__)
+            assert unit.scan_compatible
+            assert scan_epoch_scan_hazards(unit) == []
+
+        class ReoptedDecision(DecisionGD):
+            hide_from_registry = True
+
+            def run(self):
+                super(ReoptedDecision, self).run()
+
+        ReoptedDecision.run.scan_protocol = True
+        unit = ReoptedDecision(wf, name="reopted")
+        assert unit.scan_compatible
+        assert scan_epoch_scan_hazards(unit) == []
+    finally:
+        root.common.engine.epoch_scan = saved
+
+
+def test_v_j10_in_catalog_and_check_shapes_wiring():
+    """The rule is in --rules and check_shapes runs it over the hot
+    chain + loader + decision — the standard workflow stays silent."""
+    assert "V-J10" in rule_catalog()
+
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class TinyLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            self.original_data.mem = rng.standard_normal(
+                (40, 8)).astype(numpy.float32)
+            self.original_labels = [int(i % 4) for i in range(40)]
+            self.class_lengths[:] = [0, 0, 40]
+
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: TinyLoader(w, minibatch_size=8),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": 0.05}},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": 4}}],
+        decision_config={"max_epochs": 1})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=NumpyDevice())
+    findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
+    assert "V-J10" not in rules_of(findings), \
+        [f.render() for f in findings]

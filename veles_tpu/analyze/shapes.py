@@ -77,6 +77,16 @@ RULES = {
               "computed value — XLA silently recompiles on every "
               "new value; the prof recompile sentinel is this "
               "check's runtime twin"),
+    "V-J10": ("warning",
+              "host-sync hazard under an epoch-scan window: an "
+              "io_callback / host_callback / jax.pure_callback / "
+              "jax.debug.print / jax.device_get (or .item()/"
+              ".block_until_ready()) inside a stitch_stage() body "
+              "would serialize — or break outright — the K-step "
+              "lax.scan the stitched trainer folds steps into "
+              "(root.common.engine.epoch_scan); a Decision subclass "
+              "overriding the per-step run()/improved logic with "
+              "host-only code silently disables window absorption"),
     "V-S01": ("error",
               "generative serving preflight: the engine's slot-major "
               "KV cache does not fit device HBM next to the params, "
@@ -526,6 +536,98 @@ def scan_retrace_hazards(unit):
     return findings
 
 
+#: dotted-name tails that would serialize (or break) a K-step scan
+#: window when called from inside a stitch_stage body: host callbacks
+#: re-enter python per step, device_get/item/block force a sync the
+#: window exists to eliminate
+_SCAN_HOSTILE_TAILS = {
+    "io_callback", "host_callback", "pure_callback", "device_get",
+    "item", "block_until_ready",
+}
+_SCAN_HOSTILE_NAMES = {
+    "jax.debug.print", "jax.debug.callback", "jax.debug.breakpoint",
+    "jax.experimental.io_callback", "jax.pure_callback",
+    "jax.experimental.host_callback.call",
+    "jax.experimental.host_callback.id_tap",
+}
+
+
+def scan_epoch_scan_hazards(unit):
+    """V-J10: AST-scan ``stitch_stage()`` of ``unit``'s class for
+    host-sync calls that would serialize — or break under tracing —
+    the K-step ``lax.scan`` window the stitched trainer folds steps
+    into (``root.common.engine.epoch_scan``), plus the Decision half:
+    a :class:`~veles_tpu.znicz.decision.DecisionBase` subclass whose
+    overridden ``run()`` dropped the scan protocol marker (window
+    absorption silently disabled — the remedy is the device-predicate
+    protocol, ``docs/engine_fast_path.md`` § Epoch mode)."""
+    findings = []
+    cls = type(unit)
+    meth = cls.__dict__.get("stitch_stage") \
+        or getattr(cls, "stitch_stage", None)
+    func = getattr(meth, "__func__", meth)
+    if callable(func) and not getattr(
+            func, "__qualname__", "").startswith("Unit."):
+        try:
+            src = textwrap.dedent(inspect.getsource(func))
+            path = inspect.getsourcefile(func)
+            base_line = func.__code__.co_firstlineno
+            tree = ast.parse(src)
+        except (OSError, TypeError, SyntaxError):
+            tree = None
+        if tree is not None:
+            index = _module_index(path) if path else None
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = (index.resolve_call(node.func)
+                        if index else None) \
+                    or _call_name(node.func)
+                if not name:
+                    continue
+                tail = name.rsplit(".", 1)[-1]
+                if name not in _SCAN_HOSTILE_NAMES \
+                        and tail not in _SCAN_HOSTILE_TAILS:
+                    continue
+                line = base_line + node.lineno - 1
+                findings.append(Finding(
+                    *_rule("V-J10"),
+                    message="%s.stitch_stage calls %s — a host "
+                            "callback/sync inside a stitched stage "
+                            "body serializes (or fails to trace "
+                            "under) the K-step epoch-scan window"
+                            % (cls.__name__, name.lstrip(".") + "()"),
+                    unit=unit.name,
+                    location="%s:%d" % (path, line) if path else None,
+                    fix="keep stage bodies pure jax math; publish "
+                        "host-facing values as produced Vectors / "
+                        "device metrics and fetch them at window "
+                        "boundaries"))
+    # the Decision half: an overridden per-step run() without the
+    # protocol marker means epoch-scan windows silently fall back —
+    # flagged only when the knob is actually set (like V-J07 gates on
+    # the fast path being engageable): a legacy host-logic Decision in
+    # a run that never enables windows is not a hazard, just a unit
+    from veles_tpu import epoch_scan
+    from veles_tpu.znicz.decision import DecisionBase
+    if isinstance(unit, DecisionBase) and not unit.scan_compatible \
+            and epoch_scan.mode():
+        findings.append(Finding(
+            *_rule("V-J10"),
+            message="%s overrides the per-step Decision run() with "
+                    "host-only logic (or sets no SCAN_METRIC) — "
+                    "epoch-scan windows (engine.epoch_scan) silently "
+                    "fall back to per-step dispatch around it"
+            % cls.__name__,
+            unit=unit.name,
+            fix="implement the device-predicate protocol: set "
+                "SCAN_METRIC, keep run() accumulate-only (or "
+                "re-point <Sub>.run.scan_protocol = True after "
+                "matching scan_commit semantics), and express "
+                "stop/improved as device_predicate()"))
+    return findings
+
+
 def _host_params(unit):
     """Best-effort host params pytree for a forward unit; ``None`` when
     unavailable (uninitialized weights, protocol error)."""
@@ -588,6 +690,12 @@ def check_shapes(workflow, sample_shape=None, batch_size=None):
         # V-J09 — retrace hazards (per-call jit wrappers, unstable
         # static args) on the same hot chain
         findings.extend(scan_retrace_hazards(unit))
+        # V-J10 — host-sync hazards that would serialize an
+        # epoch-scan window folded over this chain
+        findings.extend(scan_epoch_scan_hazards(unit))
+    decision = getattr(workflow, "decision", None)
+    if decision is not None:
+        findings.extend(scan_epoch_scan_hazards(decision))
 
     # V-J07 — per-step host input pipeline.  (a) the loader's own
     # run()/tpu_run() body moving bytes H2D per minibatch (device_put
@@ -602,6 +710,7 @@ def check_shapes(workflow, sample_shape=None, batch_size=None):
         findings.extend(f for f in scan_transfer_hazards(
             loader, hot_loop=True) if f.rule == "V-J07")
         findings.extend(scan_retrace_hazards(loader))
+        findings.extend(scan_epoch_scan_hazards(loader))
         device = getattr(loader, "device", None)
         # fire only when flipping the CONFIG would actually engage the
         # path: a loader that is structurally ineligible (dataset not

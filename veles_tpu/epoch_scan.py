@@ -1,0 +1,777 @@
+"""One-dispatch epochs: a K-step ``lax.scan`` over stitched segments.
+
+The stitched fast path (:mod:`veles_tpu.stitch`) collapsed the eager
+trainer to one XLA dispatch per *segment* per minibatch; an epoch is
+still O(minibatches) host dispatches.  This module folds K consecutive
+training steps into ONE dispatch: the whole repeater cycle — the
+loader-headed forward/evaluator segment, the Decision's per-step
+metric accumulation, and (on TRAIN batches) the GD segment — becomes
+the body of a ``jax.lax.scan`` whose carry is
+
+* the **donated parameter/momentum buffers** (weights, biases,
+  momentum, the evaluator's confusion matrix) — updated in place on
+  HBM across all K steps exactly like K per-step dispatches would, and
+* the **deferred-metric accumulator** — the Decision's per-class
+  metric sum rides the program as one device scalar
+  (:meth:`~veles_tpu.znicz.decision.DecisionBase.scan_prior` /
+  ``scan_commit``), so an epoch's metric accounting costs one deferred
+  fetch instead of K.
+
+The PR 4 device-resident loader's traced ``(offset, size)`` gather
+lowers to in-scan index arithmetic: the per-step scalars every stage
+fetches (the loader's offset/size, the evaluator's batch, GD
+hyper-parameters) become stacked ``xs`` arrays indexed by the scan —
+one row per step, collected while the **window is served**: the host
+serving bookkeeping (offset advance, epoch flags, retry/pending
+accounting — the segment prelude) runs once per scan window, step by
+step in a tight host loop, BEFORE the single dispatch.
+
+Decision's stop/improved logic participates through the
+**device-predicate protocol**: when a window's final step closes a
+validated class, the Decision's :meth:`device_predicate` is evaluated
+in-program over the epoch's full metric accumulator and the verdict
+(``improved`` / ``stop``) is returned in the carry as async device
+booleans (``decision.scan_verdict``) — no mid-window host sync.  The
+host close (:meth:`DecisionGD._close_class`) stays authoritative and
+byte-compatible; the tests assert the two verdicts agree.
+
+Window boundaries: a window never crosses a class close (the step that
+raises ``last_minibatch`` ends it), never spans an epoch-wrap
+reshuffle, and is bounded by ``K`` — so every host-visible event
+(epoch flags, improved/complete flips, snapshot gating, checkpoint
+triggers) still happens at exactly the same global step as the
+per-step path.
+
+Knob: ``root.common.engine.epoch_scan = off | auto | <K>``.  ``off``
+(the default) restores the PR 3/PR 9 per-step stitched shapes byte for
+byte; ``auto`` picks K = ``root.common.engine.metrics_every`` when set
+(so mid-epoch metric flushes keep their cadence) else
+:data:`AUTO_WINDOW`; an integer pins K.  Eligibility is structural —
+the repeater cycle must consist exactly of the loader-headed segment,
+a scan-compatible Decision and the GD segment; anything else (host
+units in the loop, an LRAdjuster mutating per-step scalars, a Decision
+subclass with host-only logic — see analyzer rule V-J10) falls back to
+the per-step stitched path with an info log.
+
+Pod mode (:mod:`veles_tpu.pod`): the same window program compiles over
+the pod mesh with explicit shardings from the runtime's one
+per-Vector placement rule — gradient aggregation stays an in-scan
+``psum`` on the data axis, so a pod epoch is one dispatch per class
+pass and the PR 9 wire gate keeps exactly one final update frame.
+The chaos ``pod_chip`` site is consulted once per window; a chip-kill
+reshard invalidates every compiled window program (the recompile is
+counted warmup, not a steady-state retrace).
+"""
+
+import time
+
+import numpy
+
+from veles_tpu import prof, trace
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+from veles_tpu.stitch import EnforcedProgram
+
+#: ``auto`` window bound when ``metrics_every`` is unset: large enough
+#: that a class pass of any bench/test workload is one dispatch, small
+#: enough that the stacked per-step scalar rows stay trivial
+AUTO_WINDOW = 1024
+
+
+def mode():
+    """The ``root.common.engine.epoch_scan`` knob, read at call time
+    (like ``stitch.enabled``): 0 = off, else the window bound K."""
+    value = root.common.engine.get("epoch_scan", "off")
+    if isinstance(value, str):
+        value = value.strip().lower()
+        if value in ("off", "0", "false", "no", ""):
+            return 0
+        # the sibling knobs (stitch/trace) spell engagement "on" —
+        # accept the same family here rather than crash the hot loop
+        # on int("on")
+        if value in ("auto", "on", "true", "yes"):
+            every = int(root.common.engine.get("metrics_every", 0) or 0)
+            return every if every > 0 else AUTO_WINDOW
+        try:
+            return max(0, int(value))
+        except ValueError:
+            raise ValueError(
+                "root.common.engine.epoch_scan must be off|auto|<K>, "
+                "got %r" % value)
+    if value is True:
+        return AUTO_WINDOW
+    return max(0, int(value or 0))
+
+
+class ScanPlan(object):
+    """The combined straight-line plan of a window step: the stages of
+    the forward/evaluator segment (plus, for TRAIN windows, the GD
+    segment) resolved into carry / external / env slots.
+
+    Unlike :meth:`StitchSegment._build_plan`, a buffer that one stage
+    DONATES may be *read* by another (the forward reads the weights
+    the GD stage updates): every reference to a donated Vector
+    resolves to the carry's **current** value, so iteration ``i``'s
+    forward sees the weights iteration ``i-1``'s GD step wrote —
+    byte-compatible with K per-step dispatches."""
+
+    def __init__(self, stages):
+        self.stages = list(stages)
+        don_vecs, don_index = [], {}
+        for stage in self.stages:
+            for name, vec in sorted(stage.donated.items()):
+                if id(vec) in don_index:
+                    raise ValueError(
+                        "stage %s re-donates a Vector another stage "
+                        "already donates — not scannable"
+                        % stage.unit.name)
+                don_index[id(vec)] = len(don_vecs)
+                don_vecs.append(vec)
+        produced = {}
+        ext_vecs, ext_index = [], {}
+
+        def _ext(vec):
+            if id(vec) not in ext_index:
+                ext_index[id(vec)] = len(ext_vecs)
+                ext_vecs.append(vec)
+            return ext_index[id(vec)]
+
+        refs = []               # per stage: [(name, kind, key)]
+        don_slots = []          # per stage: [(pos, name)]
+        scalar_slots = []       # per stage: [(pos, name)] or None
+        scalar_fetchers = []    # [(stage, names)]
+        metric_spec = []
+        for si, stage in enumerate(self.stages):
+            stage_refs = []
+            for name, vec in stage.consumes.items():
+                if id(vec) in produced:
+                    stage_refs.append((name, "env", id(vec)))
+                elif id(vec) in don_index:
+                    stage_refs.append((name, "don", don_index[id(vec)]))
+                else:
+                    stage_refs.append((name, "ext", _ext(vec)))
+            for name, vec in sorted(stage.params.items()):
+                if id(vec) in produced:
+                    stage_refs.append((name, "env", id(vec)))
+                elif id(vec) in don_index:
+                    stage_refs.append((name, "don", don_index[id(vec)]))
+                else:
+                    stage_refs.append((name, "ext", _ext(vec)))
+            refs.append(stage_refs)
+            don_slots.append([(don_index[id(vec)], name)
+                              for name, vec in
+                              sorted(stage.donated.items())])
+            scalar_slots.append(None)
+            if stage.scalars is not None:
+                names = tuple(sorted(stage.scalars()))
+                base = sum(len(n) for _s, n in scalar_fetchers)
+                scalar_slots[si] = [(base + i, n)
+                                    for i, n in enumerate(names)]
+                scalar_fetchers.append((stage, names))
+            for name, vec in stage.produces.items():
+                if id(vec) in don_index:
+                    raise ValueError(
+                        "stage %s produces a Vector another stage "
+                        "donates — not scannable" % stage.unit.name)
+                if id(vec) in ext_index:
+                    # an earlier stage consumed this Vector before it
+                    # is produced — a cross-ITERATION dependency the
+                    # per-step path satisfies through Vector
+                    # coherence; a window would freeze the pre-window
+                    # value for all K steps
+                    raise ValueError(
+                        "stage %s produces a Vector an earlier stage "
+                        "consumed (cross-iteration dependency) — not "
+                        "scannable" % stage.unit.name)
+                produced[id(vec)] = si
+            for name in stage.metrics:
+                metric_spec.append((stage.unit, name))
+        # every produced Vector is published from the FINAL iteration
+        # (downstream host consumers read through Vector coherence at
+        # the window boundary, exactly the per-step contract)
+        out_vecs, seen = [], set()
+        for stage in self.stages:
+            for vec in stage.produces.values():
+                if id(vec) not in seen:
+                    seen.add(id(vec))
+                    out_vecs.append(vec)
+        self.don_vecs = don_vecs
+        self.ext_vecs = ext_vecs
+        self.out_vecs = out_vecs
+        self._refs = refs
+        self._don_slots = don_slots
+        self._scalar_slots = scalar_slots
+        self.scalar_fetchers = scalar_fetchers
+        self.metric_spec = metric_spec
+        self.n_scalars = sum(len(n) for _s, n in scalar_fetchers)
+
+    def fetch_scalars(self):
+        """One row of per-step scalar values, in slot order (called
+        after each window step is served, so loader-derived scalars —
+        offset/size/batch — read that step's state)."""
+        row = []
+        for stage, names in self.scalar_fetchers:
+            values = stage.scalars()
+            row.extend(values[n] for n in names)
+        return row
+
+    def step(self, don, ext, scal):
+        """One scan-body iteration: run every stage in sequence over
+        the carry; returns ``(new_don, outs, metrics)``."""
+        env = {}
+        new_don = list(don)
+        metrics = []
+        for si, stage in enumerate(self.stages):
+            tensors = {}
+            for name, kind, key in self._refs[si]:
+                if kind == "env":
+                    tensors[name] = env[key]
+                elif kind == "don":
+                    tensors[name] = new_don[key]
+                else:
+                    tensors[name] = ext[key]
+            for pos, name in self._don_slots[si]:
+                tensors[name] = new_don[pos]
+            if self._scalar_slots[si]:
+                for pos, name in self._scalar_slots[si]:
+                    tensors[name] = scal[pos]
+            out = stage.fn(tensors)
+            for name, vec in stage.produces.items():
+                env[id(vec)] = out[name]
+            for pos, name in self._don_slots[si]:
+                new_don[pos] = out[name]
+            for name in stage.metrics:
+                metrics.append(out[name])
+        outs = tuple(env[id(vec)] for vec in self.out_vecs)
+        return tuple(new_don), outs, tuple(metrics)
+
+
+class ScanProgram(Logger, EnforcedProgram):
+    """One compiled K-step window program (one per ``(kind, K,
+    verdict?)``), sharing the runner's per-kind ledger entry AND
+    :class:`StitchSegment`'s compile discipline (the
+    :class:`veles_tpu.stitch.EnforcedProgram` idiom): first dispatch
+    lowers + AOT-compiles (counted warmup), the executable enforces
+    the fingerprinted signature, and a drifted call recompiles once
+    and is flagged through the recompile sentinel — fingerprinted
+    separately from the per-step segment programs, so toggling the
+    knob never reads as a steady-state retrace."""
+
+    def _recompile_site(self):
+        return "epoch_scan:%s[K=%d]" % (self.name, self.k)
+
+    def __init__(self, plan, k, name, prof_entry, accum_index=None,
+                 predicate=None, pred_names=(), shardings=None):
+        super(ScanProgram, self).__init__()
+        self.plan = plan
+        self.k = int(k)
+        self.name = name
+        self.prof_entry = prof_entry
+        #: metric_spec index whose per-step values accumulate into the
+        #: carried deferred-metric scalar (None = no accumulator)
+        self.accum_index = accum_index
+        self.predicate = predicate
+        self.pred_names = tuple(pred_names)
+        self._trace_args = {"segment": name, "steps": self.k,
+                            "scan": True}
+        self._compiled = None
+        self._fingerprint = None
+        self._compiled_cache = {}
+        import jax
+        kwargs = {}
+        if shardings is not None:
+            kwargs["in_shardings"], kwargs["out_shardings"] = shardings
+        # donate the carry (params/momentum in place) AND the output
+        # placeholders (their pre-window values are dead: every
+        # iteration overwrites them before the final publish)
+        self._jitted = jax.jit(self._program, donate_argnums=(0, 1),
+                               **kwargs)
+
+    def _program(self, don, outs, ext, xs, prior, preds):
+        import jax
+        import jax.numpy as jnp
+
+        plan = self.plan
+
+        def body(carry, x):
+            cur_don, _outs = carry
+            new_don, new_outs, metrics = plan.step(cur_don, ext, x)
+            return (new_don, new_outs), metrics
+        (don_f, outs_f), met_ys = jax.lax.scan(
+            body, (don, outs), xs, length=self.k)
+        lasts = tuple(y[-1] for y in met_ys)
+        if self.accum_index is not None:
+            accum = prior + met_ys[self.accum_index].astype(
+                jnp.float32).sum()
+        else:
+            accum = prior
+        verdict = ()
+        if self.predicate is not None:
+            scal = {name: preds[i]
+                    for i, name in enumerate(self.pred_names)}
+            verdict = self.predicate(accum, scal)
+        return don_f, outs_f, lasts, accum, verdict
+
+    def _compile(self, args, steady=False):
+        lowered = self._jitted.lower(*args)
+        compiled = lowered.compile()
+        self._fingerprint = prof.fingerprint(args)
+        self._compiled = compiled
+        self._compiled_cache[self._fingerprint] = compiled
+        # XLA's cost model counts the scan BODY once, not ×K (verified
+        # against a jitted single step) — so the registered flops are
+        # per-STEP and the ledger's `steps` accounting supplies the K×
+        # (docs/observability.md § steps per dispatch); MFU therefore
+        # reflects K-step work without inflating K×.
+        cost, span_args = prof.span_cost_args(compiled,
+                                              self._trace_args)
+        prof.ledger.record_compile(self.prof_entry, cost=cost,
+                                   steady=steady)
+        if steady:
+            span_args["recompile"] = True
+        trace.instant("segment", "compile", span_args)
+        return compiled
+
+
+
+class EpochScanRunner(Logger):
+    """Binds to a stitched workflow's repeater cycle and, when the
+    knob allows, executes K-step windows in one dispatch each.  Built
+    by ``Workflow.rebuild_stitching()``; the loader-headed segment's
+    head consults :meth:`try_window` before every per-step dispatch,
+    so the knob is honored per window in both directions."""
+
+    def __init__(self, workflow):
+        super(EpochScanRunner, self).__init__()
+        self.workflow = workflow
+        self._programs = {}
+        self._plans = {}
+        self._entries = {}
+        self.windows = 0
+        self.steps = 0
+        self._structure = self._analyze()
+        if self._structure is not None:
+            self._structure["seg1"].epoch_runner = self
+
+    # -- eligibility --------------------------------------------------------
+    def _analyze(self):
+        """The structural eligibility check: the repeater cycle must
+        be exactly ``repeater → [loader+forwards+evaluator] →
+        decision → [gds] → repeater`` with a scan-compatible Decision
+        — any other unit in the loop (plotters, snapshotters firing
+        per step, an LRAdjuster mutating hyper-parameters) keeps the
+        per-step stitched path."""
+        from veles_tpu.loader.base import Loader
+        wf = self.workflow
+        why = None
+        seg1 = seg2 = decision = repeater = None
+        segments = list(getattr(wf, "_stitch_segments_", ()))
+        for segment in segments:
+            if segment.has_prelude and isinstance(segment.head, Loader):
+                seg1 = segment
+                break
+        if seg1 is None:
+            why = "no loader-headed stitched segment (needs " \
+                  "engine.loader=device and a resident FullBatch " \
+                  "dataset)"
+        if why is None:
+            tail = seg1.units[-1]
+            targets = list(tail.links_to)
+            if len(targets) != 1:
+                why = "segment tail %s fans out" % tail.name
+            else:
+                decision = targets[0]
+                if not getattr(decision, "scan_compatible", False):
+                    why = ("%s is not scan-compatible (override of "
+                           "the per-step run() without the device-"
+                           "predicate protocol — analyzer rule "
+                           "V-J10)" % decision.name)
+                elif getattr(decision, "evaluator", None) \
+                        is not tail:
+                    why = "decision does not read the segment tail"
+        if why is None:
+            targets = list(decision.links_to)
+            if len(targets) != 1:
+                why = ("units hang off %s in the training loop: %s"
+                       % (decision.name,
+                          ", ".join(u.name for u in targets)))
+            else:
+                head2 = targets[0]
+                seg2 = next((s for s in segments
+                             if s.head is head2), None)
+                if seg2 is None:
+                    why = "%s after the decision is not a stitched " \
+                          "segment head" % head2.name
+        if why is None:
+            from veles_tpu.stitch import _constant_false
+            if not _constant_false(seg2.head.gate_block):
+                why = "GD head %s has a dynamic gate_block" \
+                      % seg2.head.name
+            else:
+                tail2 = seg2.units[-1]
+                extras = [u for u in tail2.links_to
+                          if u is not wf.end_point]
+                repeater = extras[0] if len(extras) == 1 else None
+                if repeater is None or not getattr(
+                        repeater, "ignores_gate", False) \
+                        or list(repeater.links_to) != [seg1.head]:
+                    why = "GD tail does not close the loop on a " \
+                          "repeater feeding the loader"
+        if why is None:
+            loader = seg1.head
+            metric = getattr(decision, "SCAN_METRIC", None)
+            # the pair the window program will consume: the metric
+            # must come from the decision's OWN evaluator, not merely
+            # share its name with some other stage's metric
+            if not any(unit is decision.evaluator and name == metric
+                       for unit, name in self._metric_names(seg1)):
+                why = ("decision metric %r is not a stage metric of "
+                       "the decision's evaluator" % (metric,))
+            elif not getattr(loader, "device_fast_path_active",
+                             False):
+                why = "loader device fast path inactive"
+            elif any(stage.prelude is not None
+                     and stage.unit is not loader
+                     for segment in (seg1, seg2)
+                     for stage in segment.stages):
+                # window serving replays ONLY the loader's prelude
+                # (scan_window_step × K); a stage carrying other
+                # host-side per-step bookkeeping cannot be absorbed
+                why = "a non-loader stage carries a prelude"
+        if why is None:
+            # build both window plans eagerly: a stage graph the scan
+            # cannot fold (double donation, produced-after-consumed
+            # cross-iteration dependency) means per-step fallback, not
+            # a mid-window failure
+            try:
+                self._plans[False] = ScanPlan(list(seg1.stages))
+                self._plans[True] = ScanPlan(list(seg1.stages)
+                                             + list(seg2.stages))
+            except ValueError as exc:
+                why = "stages not scannable: %s" % exc
+        if why is not None:
+            self.reason = why
+            self.debug("epoch scan ineligible: %s", why)
+            return None
+        self.reason = None
+        return {"seg1": seg1, "seg2": seg2, "decision": decision,
+                "repeater": repeater, "loader": seg1.head}
+
+    @staticmethod
+    def _metric_names(segment):
+        out = []
+        for stage in segment.stages:
+            for name in stage.metrics:
+                out.append((stage.unit, name))
+        return out
+
+    @property
+    def eligible(self):
+        return self._structure is not None
+
+    def describe(self):
+        return {"eligible": self.eligible,
+                "reason": getattr(self, "reason", None),
+                "windows": self.windows, "steps": self.steps,
+                "programs": len(self._programs)}
+
+    def invalidate_programs(self):
+        """Drop every compiled window program (pod install / uninstall
+        / elastic reshard): the next window recompiles once against
+        the new placement — counted warmup, never flagged."""
+        self._programs = {}
+
+    def reset_pass(self):
+        """Forget any half-consumed window pass (an interrupted run
+        left the Decision's absorb flag armed) — the runner's twin of
+        ``StitchSegment.reset_pass``, called by ``Workflow.run()``
+        before each drain."""
+        if self._structure is not None:
+            self._structure["decision"].scan_reset()
+
+    # -- plan / program construction ----------------------------------------
+    def _plan(self, train):
+        plan = self._plans.get(train)
+        if plan is None:
+            s = self._structure
+            stages = list(s["seg1"].stages)
+            if train:
+                stages += list(s["seg2"].stages)
+            plan = self._plans[train] = ScanPlan(stages)
+        return plan
+
+    def _entry(self, train):
+        entry = self._entries.get(train)
+        if entry is None:
+            s = self._structure
+            names = list(s["seg1"].names)
+            if train:
+                names += s["seg2"].names
+            entry = prof.ledger.entry("segment",
+                                      "scan:" + "+".join(names))
+            self._entries[train] = entry
+        return entry
+
+    def _program_for(self, train, k, verdict):
+        key = (train, k, verdict)
+        program = self._programs.get(key)
+        if program is not None:
+            return program
+        s = self._structure
+        plan = self._plan(train)
+        decision = s["decision"]
+        metric = decision.SCAN_METRIC
+        accum_index = next(
+            i for i, (unit, name) in enumerate(plan.metric_spec)
+            if unit is decision.evaluator and name == metric)
+        predicate, pred_names = None, ()
+        if verdict:
+            predicate = decision.device_predicate()
+            pred_names = tuple(sorted(decision.predicate_scalars(
+                0, 0, 0)))
+        entry = self._entry(train)
+        name = entry.name
+        shardings = None
+        pod = s["seg1"].pod
+        if pod is not None:
+            shardings = pod.scan_shardings(plan, with_verdict=bool(
+                predicate is not None), n_pred=len(pred_names))
+        program = ScanProgram(
+            plan, k, name, entry, accum_index=accum_index,
+            predicate=predicate, pred_names=pred_names,
+            shardings=shardings)
+        self._programs[key] = program
+        return program
+
+    # -- window execution ---------------------------------------------------
+    def try_window(self, segment):
+        """Called by the loader-headed segment's head in place of a
+        per-step dispatch.  Returns False (caller falls back to the
+        per-step program) when the knob is off, the loader is
+        mid-retry, or the workflow runs under a job master; True after
+        executing one K-step window."""
+        k_max = mode()
+        if k_max < 1 or not self.eligible:
+            return False
+        # metrics_every bounds K even when the knob pins it explicitly
+        # — mid-epoch metric flushes keep their cadence (the window
+        # commit flushes at every K-step boundary, docs § Epoch mode)
+        every = int(root.common.engine.get("metrics_every", 0) or 0)
+        if every > 0:
+            k_max = min(k_max, every)
+        s = self._structure
+        loader = s["loader"]
+        if loader.failed_minibatches or loader.is_slave \
+                or loader.is_master:
+            return False
+        self._execute_window(k_max)
+        return True
+
+    def _serve_step(self, loader):
+        """One step of window serving — byte-identical host
+        bookkeeping to the per-step segment prelude
+        (:meth:`veles_tpu.loader.base.Loader.scan_window_step`)."""
+        loader.scan_window_step()
+
+    def _execute_window(self, k_max):
+        from veles_tpu.loader.base import TRAIN, VALID
+        s = self._structure
+        seg1, seg2 = s["seg1"], s["seg2"]
+        decision, loader = s["decision"], s["loader"]
+        pod = seg1.pod
+        if pod is not None:
+            # the chaos pod_chip site, once per window (a chip_kill
+            # reshards + invalidates every compiled window program
+            # before this window's arguments are gathered)
+            pod.pre_dispatch(seg1)
+            pod = seg1.pod
+        # -- serve the window: the host bookkeeping of K per-step
+        # preludes (offset advance, epoch flags, pending accounting)
+        # in one tight loop, collecting each step's traced scalars —
+        # this is the "once per scan window" host share
+        with trace.span("segment", "window_serve", None):
+            self._serve_step(loader)
+            cls = int(loader.minibatch_class)
+            # end the window exactly at the next metrics_every flush
+            # boundary: the per-step path flushes at step `every`, not
+            # at the first K multiple past it
+            budget = decision.scan_flush_budget(cls)
+            if budget is not None:
+                k_max = min(k_max, budget)
+            train = cls == TRAIN and not bool(seg2.head.gate_skip)
+            plan = self._plan(train)
+            rows = [plan.fetch_scalars()]
+            steps = [(int(loader.minibatch_offset),
+                      int(loader.minibatch_size))]
+            closed = bool(loader.last_minibatch)
+            while not closed and len(steps) < k_max \
+                    and not loader.failed_minibatches:
+                self._serve_step(loader)
+                rows.append(plan.fetch_scalars())
+                steps.append((int(loader.minibatch_offset),
+                              int(loader.minibatch_size)))
+                closed = bool(loader.last_minibatch)
+        k = len(steps)
+        samples = sum(size for _off, size in steps)
+        # -- verdict arming: only when the carried accumulator (+ the
+        # flushed host scalar) can cover the WHOLE epoch ------------
+        validated = closed and (
+            cls == VALID or (cls == TRAIN
+                             and decision.class_lengths[VALID] == 0))
+        verdict = validated \
+            and decision.device_predicate() is not None \
+            and decision.scan_verdict_ready(cls)
+        program = self._program_for(train, k, verdict)
+        entry = program.prof_entry
+        with trace.span("segment", "dispatch", program._trace_args):
+            with trace.span("segment", "host_prep",
+                            program._trace_args):
+                # stacked per-step scalars: ints stay int32 (exact
+                # offsets), everything else float32 — the in-scan
+                # twin of the per-step traced python scalars
+                xs = tuple(
+                    numpy.asarray(
+                        [row[i] for row in rows],
+                        dtype=numpy.int32 if all(
+                            isinstance(row[i], int) for row in rows)
+                        else numpy.float32)
+                    for i in range(plan.n_scalars))
+                don = tuple(vec.devmem for vec in plan.don_vecs)
+                outs = tuple(vec.devmem for vec in plan.out_vecs)
+                ext = tuple(vec.devmem for vec in plan.ext_vecs)
+                prior = decision.scan_prior(cls)
+                if prior is None:
+                    prior = numpy.float32(0.0)
+                preds = ()
+                if verdict:
+                    scal = decision.predicate_scalars(cls, k, samples)
+                    preds = tuple(float(scal[name])
+                                  for name in program.pred_names)
+            args = (don, outs, ext, xs, prior, preds)
+            (don_f, outs_f, lasts, accum, verd), tic = \
+                program._dispatch_enforced(args)
+            for vec, arr in zip(plan.out_vecs, outs_f):
+                vec.devmem = arr
+            for vec, arr in zip(plan.don_vecs, don_f):
+                vec.devmem = arr
+            for (unit, name), value in zip(plan.metric_spec, lasts):
+                setattr(unit, name, value)
+            decision.scan_commit(cls, accum, k, samples)
+            if verdict and verd:
+                decision.scan_verdict = dict(
+                    verd, cls=cls, epoch=int(loader.epoch_number),
+                    steps=k)
+            toc = time.perf_counter_ns()
+            psum = 0
+            if pod is not None:
+                entry.shards = pod.shards
+                psum = pod.segment_psum_bytes(seg1) * k
+                if train:
+                    psum += pod.segment_psum_bytes(seg2) * k
+            prof.ledger.record_dispatch(entry, toc - tic, steps=k,
+                                        psum_bytes=psum)
+            if pod is not None and trace.enabled():
+                for shard in range(pod.shards):
+                    trace.complete("pod", "shard_dispatch", tic,
+                                   toc - tic, program._trace_args,
+                                   role="pod", tid=shard)
+        # -- mark the graph pass absorbed ----------------------------
+        seg1.absorb_pass(include_head=False)
+        if train:
+            seg2.absorb_pass(include_head=True)
+        self.windows += 1
+        self.steps += k
+
+
+def build_runner(workflow):
+    """``Workflow.rebuild_stitching()`` hook: (re)build the runner for
+    a freshly stitched workflow.  Always returns a runner (its
+    ``eligible`` flag says whether windows can engage) so
+    ``stitch_report()`` can explain WHY the knob is not biting."""
+    return EpochScanRunner(workflow)
+
+
+# -- CI smoke (scripts/lint.sh) ---------------------------------------------
+
+def run_smoke(module_name="veles_tpu.samples.mnist"):
+    """The lint.sh epoch smoke: a stitched sample run under
+    ``epoch_scan=auto`` must (a) report host dispatches ≤
+    ceil(steps/K) + one per class pass in ``trace_report()``'s
+    host-gap split, (b) flag zero steady-state recompiles, and (c)
+    leave the analyzer's V-J10 rule silent over the workflow."""
+    import importlib
+    import math
+    import sys
+
+    from veles_tpu import prof as _prof, trace as _trace
+    saved = {k: root.common.engine.get(k, d) for k, d in (
+        ("trace", "off"), ("stitch", "on"), ("epoch_scan", "off"))}
+    root.common.engine.trace = "on"
+    root.common.engine.stitch = "on"
+    root.common.engine.epoch_scan = "auto"
+    try:
+        sample = importlib.import_module(module_name)
+        wf = sample.create_workflow(max_epochs=2, minibatch_size=500)
+        recompiles0 = _prof.ledger.recompiles
+        dispatches0 = _trace.recorder.count("segment", "dispatch")
+        wf.run()
+        runner = getattr(wf, "_epoch_runner_", None)
+        if runner is None or not runner.eligible or not runner.windows:
+            print("epoch smoke: FAIL — epoch-scan never engaged (%r)"
+                  % (runner and runner.describe()), file=sys.stderr)
+            return 1
+        dispatches = _trace.recorder.count("segment", "dispatch") \
+            - dispatches0
+        k = mode()
+        loader = wf.loader
+        spans = sum(1 for n in loader.class_lengths if n)
+        epochs = int(loader.epoch_number) + 1
+        steps = sum(math.ceil(n / loader.max_minibatch_size)
+                    for n in loader.class_lengths if n)
+        budget = epochs * sum(
+            math.ceil(math.ceil(n / loader.max_minibatch_size) / k)
+            for n in loader.class_lengths if n) + spans
+        if dispatches > budget:
+            print("epoch smoke: FAIL — %d host dispatches for %d "
+                  "steps/epoch x %d epoch(s) under K=%d (budget %d)"
+                  % (dispatches, steps, epochs, k, budget),
+                  file=sys.stderr)
+            return 1
+        if _prof.ledger.recompiles - recompiles0 or _prof.flagged:
+            print("epoch smoke: FAIL — steady-state recompile(s) "
+                  "under epoch_scan: %r" % (_prof.flagged,),
+                  file=sys.stderr)
+            return 1
+        from veles_tpu.analyze.shapes import scan_epoch_scan_hazards
+        findings = []
+        for unit in [wf.loader] + list(wf.forwards) \
+                + [wf.evaluator] + list(wf.gds) + [wf.decision]:
+            findings.extend(scan_epoch_scan_hazards(unit))
+        if findings:
+            print("epoch smoke: FAIL — V-J10 findings on the sample "
+                  "workflow: %s"
+                  % "; ".join(f.message for f in findings),
+                  file=sys.stderr)
+            return 1
+        report = wf.trace_report()
+        print(report)
+        print("epoch smoke: OK — %d window(s) covering %d step(s), "
+              "%d host dispatch(es) (budget %d), 0 recompiles"
+              % (runner.windows, runner.steps, dispatches, budget))
+        return 0
+    finally:
+        for key, value in saved.items():
+            setattr(root.common.engine, key, value)
+        _trace.configure()
+
+
+if __name__ == "__main__":  # pragma: no cover - thin CLI
+    import argparse
+    import sys
+    parser = argparse.ArgumentParser(prog="veles_tpu.epoch_scan")
+    parser.add_argument("--smoke", metavar="MODULE", nargs="?",
+                        const="veles_tpu.samples.mnist", default=None)
+    ns = parser.parse_args()
+    if ns.smoke:
+        sys.exit(run_smoke(ns.smoke))
+    parser.print_help()

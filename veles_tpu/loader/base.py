@@ -294,6 +294,16 @@ class Loader(Unit):
         self.serve_next_minibatch(None, fill=False)
         self._on_successful_serve()
 
+    def scan_window_step(self):
+        """One serving step of an epoch-scan window
+        (:mod:`veles_tpu.epoch_scan`): byte-identical bookkeeping to
+        :meth:`stitch_prelude`, called K times back-to-back while the
+        window is planned — the K per-step preludes collapsed into one
+        host loop before the single scan dispatch.  The served
+        ``(minibatch_offset, minibatch_size)`` pair becomes that
+        step's row of the scan's stacked index scalars."""
+        self.stitch_prelude()
+
     # -- serving ------------------------------------------------------------
     def shuffle(self):
         """Shuffle the TRAIN span of the index space (ref ``:711-731``)."""

@@ -27,6 +27,13 @@ per-step host→device bytes (the index buffer re-uploads once per
 epoch shuffle; slaves re-use the resident dataset across jobs and
 ``prefetch_job_data`` stages the next job's index span concurrently
 with the current compute).
+
+Epoch-scan windows (``root.common.engine.epoch_scan``) build on the
+same stage: the traced ``(offset, size)`` pair becomes one ROW of the
+window's stacked per-step index scalars, so K consecutive gathers
+lower to in-scan index arithmetic over the resident shuffled-index
+buffer and a whole class pass dispatches once
+(``docs/engine_fast_path.md`` § Epoch mode).
 """
 
 import numpy

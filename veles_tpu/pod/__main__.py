@@ -166,6 +166,66 @@ def _check_sharded_serving(wf, problems):
                else "shape"))
 
 
+def _epoch_scan_gate(epochs, reference, problems):
+    """The one-dispatch-per-epoch assertion: a PodRuntime-sharded
+    workflow under ``engine.epoch_scan=auto`` must train each epoch
+    in at most one scanned dispatch per non-empty class span (the
+    K-step window covers the whole pass), with zero steady-state
+    recompiles and eval parity with the single-device reference."""
+    from veles_tpu import prof, trace
+    from veles_tpu.config import root
+    from veles_tpu.parallel.mesh import mesh_from_topology
+    from veles_tpu.pod import PodRuntime, eval_metrics, train_epochs
+
+    saved_scan = root.common.engine.get("epoch_scan", "off")
+    saved_trace = root.common.engine.get("trace", "off")
+    saved_every = root.common.engine.get("metrics_every", 0)
+    root.common.engine.epoch_scan = "auto"
+    root.common.engine.trace = "on"
+    # an ambient metrics_every bounds K and would split each class
+    # pass into several windows — pin it off: this gate asserts the
+    # headline one-dispatch-per-pass bound, not a flush cadence
+    root.common.engine.metrics_every = 0
+    try:
+        wf = make_workflow(max_epochs=epochs)
+        runtime = PodRuntime(wf, mesh=mesh_from_topology(
+            {"data": -1}, require=("data",)))
+        runtime.install()
+        dispatches0 = trace.recorder.count("segment", "dispatch")
+        recompiles0 = prof.ledger.recompiles
+        for _ in train_epochs(wf, epochs):
+            pass
+        dispatches = trace.recorder.count("segment", "dispatch") \
+            - dispatches0
+        runner = getattr(wf, "_epoch_runner_", None)
+        spans = sum(1 for n in wf.loader.class_lengths if n)
+        budget = epochs * spans
+        if runner is None or not runner.windows:
+            problems.append(
+                "epoch-scan gate: windows never engaged on the pod "
+                "path (%r)" % (runner and runner.describe()))
+        if dispatches > budget:
+            problems.append(
+                "epoch-scan gate: %d dispatches for %d epochs x %d "
+                "class span(s) — an epoch is NOT one dispatch per "
+                "pass" % (dispatches, epochs, spans))
+        if prof.ledger.recompiles - recompiles0:
+            problems.append(
+                "epoch-scan gate: %d steady-state recompile(s) under "
+                "scan windows"
+                % (prof.ledger.recompiles - recompiles0))
+        if not _metrics_close(reference, eval_metrics(wf)):
+            problems.append(
+                "epoch-scan gate: windowed pod metrics %r diverged "
+                "from reference %r" % (eval_metrics(wf), reference))
+        return dispatches, runner.windows if runner else 0
+    finally:
+        root.common.engine.epoch_scan = saved_scan
+        root.common.engine.trace = saved_trace
+        root.common.engine.metrics_every = saved_every
+        trace.configure()
+
+
 def run_smoke(as_json=False, epochs=SMOKE_EPOCHS):
     import jax
 
@@ -235,6 +295,14 @@ def run_smoke(as_json=False, epochs=SMOKE_EPOCHS):
             "parity gate: pod metrics %r vs single-device %r"
             % (pod_metrics, reference))
 
+    # 2b) one-dispatch-per-epoch: the SAME pod path under
+    #     engine.epoch_scan=auto — a whole class pass must fold into
+    #     ONE scanned dispatch (so an epoch is one dispatch per
+    #     non-empty class), with zero steady-state recompiles and
+    #     eval parity against the reference
+    scan_dispatches, scan_windows = _epoch_scan_gate(
+        epochs, reference, problems)
+
     # 3) chaos session on the pod path: chip kill mid-epoch + dup'd
     #    final update + dropped lease frame
     chaos_schedule = [
@@ -290,6 +358,8 @@ def run_smoke(as_json=False, epochs=SMOKE_EPOCHS):
         "pod_epoch_frames": epoch_frames,
         "minibatches_trained": minibatches,
         "psum_bytes_per_step": pod_stats.get("psum_bytes_per_step"),
+        "epoch_scan_dispatches": scan_dispatches,
+        "epoch_scan_windows": scan_windows,
         "reshards_under_chaos": cworker.runtime.reshards
         if cworker.runtime else None,
         "chaos_injected": injected,
@@ -302,9 +372,11 @@ def run_smoke(as_json=False, epochs=SMOKE_EPOCHS):
     else:
         print("pod smoke: %d shard(s)/%d device(s), %d epoch(s), "
               "%d update frame(s) on the wire for %d minibatches "
-              "trained, %s psum/step, chaos reshard gen=%s"
+              "trained, %s psum/step, epoch-scan %d dispatch(es)/"
+              "%d window(s), chaos reshard gen=%s"
               % (shards, n_devices, epochs, update_frames,
                  minibatches, pod_stats.get("psum_bytes_per_step"),
+                 scan_dispatches, scan_windows,
                  cworker.runtime.generation if cworker.runtime
                  else "-"))
         for problem in problems:

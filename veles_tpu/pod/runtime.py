@@ -200,7 +200,16 @@ class PodRuntime(Logger):
         self._segments = []
         self._psum_bytes = {}
         self.installed = False
+        self._invalidate_scan()
         return self
+
+    def _invalidate_scan(self):
+        """Drop the workflow's compiled epoch-scan window programs (a
+        placement change is a new program by definition — the next
+        window recompiles once, counted warmup, never flagged)."""
+        runner = getattr(self.workflow, "_epoch_runner_", None)
+        if runner is not None:
+            runner.invalidate_programs()
 
     def _run_preflight(self):
         if self.preflight == "off":
@@ -294,8 +303,13 @@ class PodRuntime(Logger):
             self._psum_bytes[id(segment)] = \
                 self._segment_psum_estimate(segment)
             don_ids = set(id(v) for v in segment._don_vecs)
+            # output Vectors are pinned too: per-step programs only
+            # WRITE them (already mesh-placed), but an epoch-scan
+            # window passes them back in as carry placeholders — a
+            # single-device host re-upload would then reject against
+            # the window program's explicit shardings
             for vec in (segment._input_vecs + segment._ro_vecs
-                        + segment._don_vecs):
+                        + segment._don_vecs + segment._output_vecs):
                 if id(vec) in seen or not isinstance(vec, Vector):
                     continue
                 seen.add(id(vec))
@@ -316,11 +330,46 @@ class PodRuntime(Logger):
             if vec and vec.device is not None \
                     and not vec.device.is_interpret:
                 vec.devmem
+        # epoch-scan window programs compiled for the OLD placement
+        # (or none) must rebuild against this mesh
+        self._invalidate_scan()
 
     def segment_psum_bytes(self, segment):
         """Per-dispatch collective bytes for ``segment`` (the ledger
-        hook the stitched dispatch path calls)."""
+        hook the stitched dispatch path calls).  Per STEP: an
+        epoch-scan window multiplies by its K (every scanned step runs
+        the same in-program psum on the data axis)."""
         return self._psum_bytes.get(id(segment), 0)
+
+    def scan_shardings(self, plan, with_verdict=False, n_pred=0):
+        """Explicit mesh shardings for an epoch-scan window program
+        over ``plan`` (:class:`veles_tpu.epoch_scan.ScanPlan`) — the
+        SAME per-Vector rule as the per-step segment programs
+        (:func:`spec_for_vector`), so a window compiled over the pod
+        is the per-step pod program with the step loop folded in:
+        carry params/momentum replicate (or TP/FSDP-shard via
+        ``param_rules``), batch-shaped outputs and the resident
+        dataset shard the data axis, stacked per-step scalars / the
+        metric accumulator / the verdict replicate."""
+        from jax.sharding import PartitionSpec as P
+        rep = self._named(P())
+
+        def spec(vec, donated=False):
+            return self._named(self._spec_for(vec, donated=donated))
+
+        in_s = (tuple(spec(v, True) for v in plan.don_vecs),
+                tuple(spec(v) for v in plan.out_vecs),
+                tuple(spec(v) for v in plan.ext_vecs),
+                tuple(rep for _ in range(plan.n_scalars)),
+                rep,
+                tuple(rep for _ in range(n_pred)))
+        out_s = (tuple(spec(v, True) for v in plan.don_vecs),
+                 tuple(spec(v) for v in plan.out_vecs),
+                 tuple(rep for _ in plan.metric_spec),
+                 rep,
+                 {"improved": rep, "stop": rep} if with_verdict
+                 else ())
+        return in_s, out_s
 
     # -- elastic membership -------------------------------------------------
     def pre_dispatch(self, segment):

@@ -110,7 +110,7 @@ class LedgerEntry(object):
 
     __slots__ = ("kind", "name", "cost", "compiles", "recompiles",
                  "dispatches", "dispatch_ns", "items", "shards",
-                 "psum_bytes")
+                 "psum_bytes", "steps")
 
     def __init__(self, kind, name):
         self.kind = kind            # "segment" | "bucket" | "prefill"
@@ -120,6 +120,13 @@ class LedgerEntry(object):
         self.recompiles = 0         # compiles AFTER the first = retraces
         self.dispatches = 0
         self.dispatch_ns = 0
+        #: train steps folded into the recorded dispatches (epoch-scan
+        #: windows: one dispatch covers K steps).  0 = a per-step
+        #: program (each dispatch IS one step).  XLA's cost model
+        #: counts a `lax.scan` body once, so `cost["flops"]` stays
+        #: per-STEP and the K× rides here — MFU reflects K-step work
+        #: without inflating (or deflating) K×.
+        self.steps = 0
         #: useful work units served (generative entries: TOKENS — the
         #: decode program runs all slots every step, so tokens, not
         #: dispatches, are the per-token throughput denominator)
@@ -144,10 +151,13 @@ class LedgerEntry(object):
 
     def achieved_flops(self):
         """Achieved FLOP/s over all recorded dispatches (0 when the
-        entry has no flops or no timed dispatch)."""
+        entry has no flops or no timed dispatch).  Per-step work
+        units: a scanned entry multiplies by the K steps each
+        dispatch covered, not by the dispatch count."""
         if not self.dispatch_ns or not self.flops:
             return 0.0
-        return self.flops * self.dispatches / (self.dispatch_ns / 1e9)
+        units = self.steps if self.steps else self.dispatches
+        return self.flops * units / (self.dispatch_ns / 1e9)
 
     def mfu(self, peak):
         if not peak:
@@ -189,6 +199,12 @@ class LedgerEntry(object):
             row["items"] = self.items
             row["items_per_s"] = round(self.items_per_s(), 1)
             row["flops_per_item"] = round(self.flops_per_item(), 1)
+        if self.steps:
+            row["steps"] = self.steps
+            row["steps_per_dispatch"] = round(
+                self.steps / self.dispatches, 2) \
+                if self.dispatches else 0
+
         if self.shards > 1 or self.psum_bytes:
             row["shards"] = self.shards
             row["psum_bytes"] = self.psum_bytes
@@ -249,7 +265,8 @@ class PerfLedger(object):
                 self.recompiles += 1
         return steady
 
-    def record_dispatch(self, entry, dur_ns, items=0, psum_bytes=0):
+    def record_dispatch(self, entry, dur_ns, items=0, psum_bytes=0,
+                        steps=0):
         """The hot-path hook: one turnaround on ``entry``.  GIL-cheap
         integer adds, no lock (single dispatching thread per entry;
         totals tolerate the rare lost update).  ``items``: useful work
@@ -257,17 +274,21 @@ class PerfLedger(object):
         prompt tokens for prefill, active slots for a decode step).
         ``psum_bytes``: ICI bytes this dispatch's in-program
         collectives moved (pod segments pass their per-step gradient
-        all-reduce estimate)."""
+        all-reduce estimate).  ``steps``: train steps this ONE
+        dispatch covered (epoch-scan windows pass K; the entry's
+        per-step flops scale by it, not by the dispatch count)."""
         entry.dispatches += 1
         entry.dispatch_ns += int(dur_ns)
         if items:
             entry.items += int(items)
+        if steps:
+            entry.steps += int(steps)
         if psum_bytes:
             entry.psum_bytes += int(psum_bytes)
             self.psum_bytes_moved += int(psum_bytes)
         flops = entry.flops
         if flops:
-            self.flops_dispatched += flops
+            self.flops_dispatched += flops * (steps if steps else 1)
 
     # -- reading ------------------------------------------------------------
     def summary(self):
@@ -380,17 +401,22 @@ def entries_from_events(events):
             key = _bucket_key(args)
         else:
             continue
-        n, dur = clocks.get(key, (0, 0.0))
-        clocks[key] = (n + 1, dur + ev["dur_us"])
+        n, dur, steps = clocks.get(key, (0, 0.0, 0))
+        clocks[key] = (n + 1, dur + ev["dur_us"],
+                       steps + int(args.get("steps", 0) or 0))
     rows = []
     for key in sorted(set(costs) | set(clocks) | set(compiles)):
         kind, name = key
         args = costs.get(key, {})
-        n, dur_us = clocks.get(key, (0, 0.0))
+        n, dur_us, steps = clocks.get(key, (0, 0.0, 0))
         flops = float(args.get("flops", 0.0) or 0.0)
-        achieved = (flops * n / (dur_us / 1e6)) if dur_us and flops \
-            else 0.0
-        rows.append({
+        # scanned windows: the dispatch spans carry `steps` (K per
+        # window) and the compile cost is per-STEP — scale by steps,
+        # exactly like the live ledger
+        units = steps if steps else n
+        achieved = (flops * units / (dur_us / 1e6)) \
+            if dur_us and flops else 0.0
+        row = {
             "kind": kind, "name": name, "flops": flops,
             "bytes": float(args.get("bytes", 0.0) or 0.0),
             "temp_bytes": int(args.get("temp_bytes", 0) or 0),
@@ -400,7 +426,11 @@ def entries_from_events(events):
             "achieved_flops": round(achieved, 1),
             "mfu": (round(achieved / peak, 6)
                     if peak and achieved else None),
-        })
+        }
+        if steps:
+            row["steps"] = steps
+            row["steps_per_dispatch"] = round(steps / n, 2) if n else 0
+        rows.append(row)
     return rows, peak
 
 
@@ -424,10 +454,12 @@ def render_rows(rows, peak, kind=None):
             continue
         lines.append(
             "  %-36s %10.3e fl %9.3e B  %4dx %9.3f ms %10.1f MFLOP/s"
-            " %s%s"
+            " %s%s%s"
             % (row["name"][:36], row["flops"], row["bytes"],
                row["dispatches"], row["wall_ms"],
                row["achieved_flops"] / 1e6, _fmt_mfu(row["mfu"]),
+               ("  [%s steps/dispatch]" % row["steps_per_dispatch"])
+               if row.get("steps") else "",
                ("  [%d recompile(s)]" % row["recompiles"])
                if row["recompiles"] else ""))
     return lines

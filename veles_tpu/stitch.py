@@ -52,6 +52,13 @@ recompiled for a device mesh via :meth:`StitchSegment.set_shardings`
 an in-program ``psum`` — with the bound :class:`~veles_tpu.pod
 .runtime.PodRuntime` consulted before every dispatch (elastic
 chip-kill reshard) and supplying the ledger's shard/psum columns.
+
+Epoch mode (:mod:`veles_tpu.epoch_scan`): with
+``root.common.engine.epoch_scan`` set, a loader-headed segment's head
+hands whole K-step windows to the bound
+:class:`~veles_tpu.epoch_scan.EpochScanRunner` instead of dispatching
+per step — the segments' stages become a ``lax.scan`` body and this
+module's per-step programs stay the fallback (and the ``off`` shape).
 """
 
 import time
@@ -110,7 +117,58 @@ class StitchStage(object):
                 yield vec
 
 
-class StitchSegment(Logger):
+class EnforcedProgram(object):
+    """The AOT compile-and-enforce idiom shared by per-step segments
+    and epoch-scan window programs (:mod:`veles_tpu.epoch_scan`):
+    the host keeps ``_compiled`` / ``_fingerprint`` /
+    ``_compiled_cache`` and a ``_compile(args, steady=)`` that lowers,
+    AOT-compiles and registers the cost profile.  A drifted call
+    raises ``TypeError`` from the enforcing executable — exactly the
+    silent steady-state retrace the jit path would have absorbed.  A
+    signature seen BEFORE swaps its cached executable back in
+    (alternation is not a recompile, and was flagged when it first
+    appeared); a NEW one compiles + counts + flags (WARNING, or
+    PreflightError under the strict knob — raised AFTER the ledger
+    counted, so /metrics and bench recompile columns never contradict
+    the error).  Either way correctness never depends on the sentinel
+    mode; donated buffers were not consumed by the failed call."""
+
+    def _recompile_site(self):
+        """The site string a flagged steady-state recompile names."""
+        raise NotImplementedError
+
+    def _dispatch_enforced(self, args):
+        """Run the enforcing executable (compiling on first use and
+        recovering from signature drift).  Returns ``(result, tic)``
+        where ``tic`` was read right before whichever call succeeded,
+        so warmup/recovery compiles never pollute the dispatch
+        clock."""
+        if self._compiled is None:
+            # first dispatch: trace+compile once, run the AOT
+            # executable from here on — it enforces the signature
+            self._compile(args)
+            tic = time.perf_counter_ns()
+            return self._compiled(*args), tic
+        tic = time.perf_counter_ns()
+        try:
+            return self._compiled(*args), tic
+        except TypeError as exc:
+            self.debug("retrace detail: %s", exc)
+            old_fp = self._fingerprint
+            fp = prof.fingerprint(args)
+            cached = self._compiled_cache.get(fp)
+            if cached is not None:
+                self._compiled = cached
+                self._fingerprint = fp
+            else:
+                self._compile(args, steady=True)
+                prof.flag_recompile(self._recompile_site(), old_fp,
+                                    fp, logger=self)
+            tic = time.perf_counter_ns()
+            return self._compiled(*args), tic
+
+
+class StitchSegment(Logger, EnforcedProgram):
     """A maximal run of stitchable units compiled into one program."""
 
     def __init__(self, units, stages):
@@ -120,6 +178,13 @@ class StitchSegment(Logger):
         self.head = self.units[0]
         self.dispatches = 0
         self._computed = set()
+        self._head_absorbed_ = False
+        #: epoch-scan binding (veles_tpu.epoch_scan.EpochScanRunner or
+        #: None): a loader-headed segment consults it before every
+        #: per-step dispatch — when the epoch_scan knob allows, the
+        #: runner executes a whole K-step window in ONE dispatch and
+        #: absorbs this pass (head included for the GD segment)
+        self.epoch_runner = None
         self._member_ids = frozenset(id(u) for u in self.units[1:])
         self._build_plan()
         self._jitted = jax.jit(self._program, donate_argnums=(2,))
@@ -246,6 +311,9 @@ class StitchSegment(Logger):
         outputs = [env[id(vec)] for vec in self._output_vecs]
         return outputs, new_don, metrics
 
+    def _recompile_site(self):
+        return "segment:%s" % "+".join(self.names)
+
     @property
     def recompiles(self):
         """Steady-state recompiles of THIS segment's program (ledger
@@ -353,46 +421,8 @@ class StitchSegment(Logger):
                         values[n] if isinstance(values[n], int)
                         else float(values[n]) for n in names)
             args = (inputs, ro, don, tuple(scalars))
-            if self._compiled is None:
-                # first dispatch: trace+compile once, run the AOT
-                # executable from here on — it enforces the signature.
-                # The clock starts AFTER the compile: warmup must not
-                # pollute the entry's achieved-FLOP/s.
-                self._compile(args)
-                tic = time.perf_counter_ns()
-                outputs, new_don, metrics = self._compiled(*args)
-            else:
-                tic = time.perf_counter_ns()
-                try:
-                    outputs, new_don, metrics = self._compiled(*args)
-                except TypeError as exc:
-                    # the AOT executable rejected a drifted signature
-                    # — exactly the silent steady-state retrace the
-                    # jit path would have absorbed.  A signature seen
-                    # BEFORE swaps its cached executable back in
-                    # (alternation is not a recompile, and was
-                    # flagged when it first appeared); a NEW one
-                    # compiles + counts + flags (WARNING, or
-                    # PreflightError under the strict knob — raised
-                    # AFTER the ledger counted, so /metrics and bench
-                    # recompile columns never contradict the error).
-                    # Either way correctness never depends on the
-                    # sentinel mode; the donated buffers were not
-                    # consumed by the failed call.
-                    self.debug("retrace detail: %s", exc)
-                    old_fp = self._fingerprint
-                    fp = prof.fingerprint(args)
-                    cached = self._compiled_cache.get(fp)
-                    if cached is not None:
-                        self._compiled = cached
-                        self._fingerprint = fp
-                    else:
-                        self._compile(args, steady=True)
-                        prof.flag_recompile(
-                            "segment:%s" % "+".join(self.names),
-                            old_fp, fp, logger=self)
-                    tic = time.perf_counter_ns()
-                    outputs, new_don, metrics = self._compiled(*args)
+            (outputs, new_don, metrics), tic = \
+                self._dispatch_enforced(args)
             for vec, arr in zip(self._output_vecs, outputs):
                 vec.devmem = arr
             for vec, arr in zip(self._don_vecs, new_don):
@@ -425,6 +455,14 @@ class StitchSegment(Logger):
         without a preceding head dispatch (out-of-band scheduling)
         falls back to its own eager ``run()`` — correctness first."""
         if unit is self.head:
+            if self._head_absorbed_:
+                # an epoch-scan window already ran this segment's K
+                # steps in-program (absorb_pass(include_head=True))
+                self._head_absorbed_ = False
+                return
+            runner = self.epoch_runner
+            if runner is not None and runner.try_window(self):
+                return
             self.execute()
             return
         if id(unit) in self._computed:
@@ -432,12 +470,22 @@ class StitchSegment(Logger):
             return
         unit.run()
 
+    def absorb_pass(self, include_head=False):
+        """Mark one whole graph pass of this segment as computed by an
+        epoch-scan window: members no-op, and with ``include_head``
+        the head's next firing no-ops too (the GD segment, whose K
+        steps the window's scan body already ran)."""
+        self._computed = set(self._member_ids)
+        if include_head:
+            self._head_absorbed_ = True
+
     def reset_pass(self):
         """Forget any half-consumed pass (an interrupted run left
         members unconsumed): the next member firing without a fresh
         head dispatch must take the eager fallback, not a stale
         no-op.  Workflow.run() calls this before each drain."""
         self._computed = set()
+        self._head_absorbed_ = False
 
     def detach(self):
         for unit in self.units:

@@ -80,6 +80,7 @@ class Workflow(Unit):
         # stitched segments hold jitted programs → transient; rebuilt by
         # initialize() (which re-runs after every unpickle-and-resume)
         self._stitch_segments_ = []
+        self._epoch_runner_ = None
         self._stitch_active_ = False
         #: was the switch on when segments were last (re)built?  run()
         #: uses this to honor an off→on flip without re-walking the
@@ -237,6 +238,14 @@ class Workflow(Unit):
                 segment.detach()
             self._stitch_segments_ = stitch.build_segments(self)
             self._stitch_built_enabled_ = stitch.enabled()
+            # the epoch-scan runner rides the stitched shape: rebuilt
+            # with it so its cycle analysis and compiled K-step window
+            # programs can never outlive the segments they fold
+            if self._stitch_segments_:
+                from veles_tpu import epoch_scan
+                self._epoch_runner_ = epoch_scan.build_runner(self)
+            else:
+                self._epoch_runner_ = None
         return self._stitch_segments_
 
     @property
@@ -251,6 +260,7 @@ class Workflow(Unit):
         host prelude — i.e. the device-resident input pipeline fused
         the minibatch gather into that program."""
         from veles_tpu import stitch
+        runner = self._epoch_runner_
         return {
             "enabled": stitch.enabled(),
             "segments": [segment.names
@@ -259,6 +269,11 @@ class Workflow(Unit):
                               for segment in self._stitch_segments_],
             "dispatches": sum(segment.dispatches
                               for segment in self._stitch_segments_),
+            # the epoch-scan view: eligibility (with the blocking
+            # reason when not), windows executed and steps they
+            # covered — `dispatches` above stays the PER-STEP count
+            "epoch_scan": runner.describe() if runner is not None
+            else None,
         }
 
     def perf_report(self):
@@ -312,6 +327,11 @@ class Workflow(Unit):
             # unconsumed — stale pass state must not suppress the
             # eager fallback
             segment.reset_pass()
+        if self._epoch_runner_ is not None:
+            # same hazard, Decision half: a window dispatched but the
+            # decision never fired — its absorb flag must not skip a
+            # real minibatch on this run
+            self._epoch_runner_.reset_pass()
         self.stopped = False
         self._finished_event_.clear()
         tic = time.time()

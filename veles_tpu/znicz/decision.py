@@ -25,6 +25,13 @@ def _is_host_number(value):
 class DecisionBase(Unit):
     hide_from_registry = True
 
+    #: the evaluator metric attribute this Decision accumulates per
+    #: step — the epoch-scan window (:mod:`veles_tpu.epoch_scan`)
+    #: sums it in-program into the carried deferred-metric
+    #: accumulator.  ``None`` = the Decision does not support window
+    #: absorption (windows fall back to the per-step stitched path).
+    SCAN_METRIC = None
+
     def __init__(self, workflow, **kwargs):
         super(DecisionBase, self).__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
@@ -33,6 +40,11 @@ class DecisionBase(Unit):
         self.complete = Bool(False)
         self.improved = Bool(False)
         self.snapshot_suffix = ""
+        #: the last in-scan device verdict ({"improved", "stop"}
+        #: async device booleans + window metadata) a class-closing
+        #: epoch-scan window reported in its carry; the host close
+        #: below stays authoritative — tests assert the two agree
+        self.scan_verdict = None
         # linked from loader:
         self.minibatch_class = None
         self.minibatch_size = None
@@ -51,6 +63,15 @@ class DecisionBase(Unit):
         # batched device_get at class close (or every K minibatches) —
         # transient by design: every flush point precedes a snapshot
         self._pending_metrics_ = [[], [], []]
+        #: steps represented by the pending entries (an epoch-scan
+        #: window accumulator entry stands for K steps; per-step
+        #: entries for one) — the metrics_every cadence counts STEPS
+        self._pending_steps_ = [0, 0, 0]
+        #: per-class: the window accumulator this Decision last
+        #: committed (identity-matched against the pending tail so a
+        #: flush in between restarts the accumulator at zero)
+        self._scan_accums_ = [None, None, None]
+        self._scan_absorbed_ = False
 
     # -- deferred metric accounting (device-resident evaluators) ------------
     def _accumulate_metric(self, sums, cls, value):
@@ -61,6 +82,7 @@ class DecisionBase(Unit):
             sums[cls] += float(value)
             return
         self._pending_metrics_[cls].append(value)
+        self._pending_steps_[cls] += 1
         if self.is_slave:
             # one job = one minibatch; the update payload fetches the
             # metric right after anyway, so there is nothing to defer
@@ -68,17 +90,154 @@ class DecisionBase(Unit):
             self._flush_metrics(sums, cls)
         else:
             every = int(root.common.engine.get("metrics_every", 0) or 0)
-            if every > 0 and len(self._pending_metrics_[cls]) >= every:
+            if every > 0 and self._pending_steps_[cls] >= every:
                 self._flush_metrics(sums, cls)
 
     def _flush_metrics(self, sums, cls):
         pending = self._pending_metrics_[cls]
+        self._pending_steps_[cls] = 0
         if not pending:
             return
         from veles_tpu.memory import device_get_all
         sums[cls] += float(sum(float(v)
                                for v in device_get_all(pending)))
         del pending[:]
+
+    # -- the epoch-scan window protocol (veles_tpu.epoch_scan) --------------
+    @property
+    def scan_compatible(self):
+        """True when a K-step scan window may absorb this Decision's
+        per-step work.  Self-enforcing: a subclass that overrides
+        ``run()`` with host-only logic loses the protocol marker (and
+        the analyzer's V-J10 rule names the remedy); re-point
+        ``<Sub>.run.scan_protocol = True`` only after wiring
+        ``SCAN_METRIC`` / :meth:`scan_commit` semantics to match."""
+        return self.SCAN_METRIC is not None and getattr(
+            type(self).run, "scan_protocol", False)
+
+    def scan_prior(self, cls):
+        """The carried deferred-metric accumulator to seed the next
+        window with (an async device scalar), or ``None`` when the
+        epoch's accumulator was flushed (or never started) — the
+        window then starts a fresh one from 0."""
+        entry = self._scan_accums_[cls]
+        pending = self._pending_metrics_[cls]
+        if entry is not None and pending and pending[-1] is entry:
+            return entry
+        return None
+
+    def scan_commit(self, cls, accum, steps, samples):
+        """Install a window's metric accounting: the updated carry
+        accumulator REPLACES the previous window's pending entry (it
+        already folds it in — :meth:`scan_prior`), the sample/batch
+        counters advance by the whole window, and the ``metrics_every``
+        cadence sees all ``steps`` at once.  Marks the pass absorbed so
+        the per-step accumulation in ``run()`` does not double-count
+        the window's final step."""
+        pending = self._pending_metrics_[cls]
+        entry = self._scan_accums_[cls]
+        if entry is not None and pending and pending[-1] is entry:
+            pending[-1] = accum
+        else:
+            pending.append(accum)
+        self._scan_accums_[cls] = accum
+        self._pending_steps_[cls] += int(steps)
+        self._scan_bump(cls, int(steps), int(samples))
+        self._scan_absorbed_ = True
+        if not self.is_slave:
+            every = int(root.common.engine.get("metrics_every", 0)
+                        or 0)
+            if every > 0 and self._pending_steps_[cls] >= every:
+                self._flush_metrics(self._scan_sums(), cls)
+
+    def _consume_scan_window_(self):
+        absorbed, self._scan_absorbed_ = self._scan_absorbed_, False
+        return absorbed
+
+    def scan_flush_budget(self, cls):
+        """Steps until the next ``metrics_every`` flush for ``cls``
+        (``None`` = no mid-epoch cadence).  Windows bound their length
+        by it so a flush lands at exactly the same global step as the
+        per-step path — never overshooting to the next K multiple."""
+        every = int(root.common.engine.get("metrics_every", 0) or 0)
+        if every <= 0 or self.is_slave:
+            return None
+        return max(1, every - self._pending_steps_[cls] % every)
+
+    def scan_reset(self):
+        """Forget a half-consumed window pass (an interrupted run
+        dispatched a window but this unit never fired): the next
+        per-step ``run()`` must accumulate normally, not skip a real
+        minibatch.  The Decision twin of
+        :meth:`veles_tpu.stitch.StitchSegment.reset_pass` —
+        ``Workflow.run()`` calls both before each drain (via
+        :meth:`EpochScanRunner.reset_pass`)."""
+        self._scan_absorbed_ = False
+
+    def _scan_bump(self, cls, steps, samples):
+        """Advance the per-class sample/batch counters for an absorbed
+        window (subclass hook)."""
+        raise NotImplementedError
+
+    def _scan_sums(self):
+        """The per-class sums list :meth:`scan_commit` flushes into
+        (subclass hook)."""
+        raise NotImplementedError
+
+    def device_predicate(self):
+        """The device-predicate protocol: return a pure traced
+        ``fn(accum, scalars) -> {"improved", "stop"}`` (jnp booleans)
+        evaluated IN the scan program when a window's final step
+        closes a validated class — the stop verdict rides the carry
+        as async device scalars (``self.scan_verdict``) instead of
+        forcing a host sync.  ``accum`` is the carried deferred-metric
+        accumulator (everything since the last flush); the scalars
+        carry the already-FLUSHED host partial sum (``flushed``) so
+        the verdict covers the full epoch under any ``metrics_every``
+        cadence.  ``None`` (the default) skips the in-program verdict;
+        the host close logic is always authoritative either way."""
+        return None
+
+    def predicate_scalars(self, cls, steps, samples):
+        """Host numbers the device predicate needs, fetched fresh per
+        class-closing window (traced, so best-so-far updates never
+        retrace the window program)."""
+        return {}
+
+    # -- shared verdict math (ONE copy of the stop semantics) ---------------
+    def _stop_predicate(self, improved, s):
+        """The device twin of :meth:`_on_epoch_ended`, shared by every
+        Decision family so the stop semantics cannot diverge between
+        them: stop when not improved with the failure streak exhausted,
+        or when ``max_epochs`` is reached."""
+        import jax.numpy as jnp
+        return jnp.logical_or(
+            jnp.logical_and(jnp.logical_not(improved),
+                            s["ewi"] + 1.0 >= s["fail"]),
+            s["epoch"] + 1.0 >= s["max_epochs"])
+
+    def _stop_predicate_scalars(self):
+        """The host inputs :meth:`_stop_predicate` reads — the shared
+        half of every family's :meth:`predicate_scalars`."""
+        return {
+            "ewi": float(self._epochs_without_improvement),
+            "fail": float(self.fail_iterations),
+            "epoch": float(self.epoch_number or 0),
+            "max_epochs": float(self.max_epochs)
+            if self.max_epochs is not None else float("inf"),
+        }
+
+    def scan_verdict_ready(self, cls):
+        """True when the carried accumulator (plus the ``flushed``
+        host scalar) covers the WHOLE epoch for ``cls`` — i.e. the
+        pending list holds nothing but this runner's accumulator.  A
+        mid-epoch knob flip can leave per-step device scalars pending
+        next to it; their values are not reachable in-program without
+        a sync, so the window skips the verdict rather than report a
+        partial one."""
+        pending = self._pending_metrics_[cls]
+        return not pending or (len(pending) == 1
+                               and pending[0] is self._scan_accums_[cls])
 
     def link_from_loader(self, loader):
         self.link_attrs(
@@ -129,6 +288,8 @@ class DecisionBase(Unit):
 class DecisionGD(DecisionBase):
     """Classification decision driven by ``EvaluatorSoftmax.n_err``."""
 
+    SCAN_METRIC = "n_err"
+
     CHECKPOINT_ATTRS = DecisionBase.CHECKPOINT_ATTRS + (
         "epoch_n_err", "epoch_samples", "epoch_n_err_pt",
         "best_n_err_pt", "best_epoch")
@@ -146,13 +307,47 @@ class DecisionGD(DecisionBase):
 
     def run(self):
         cls = int(self.minibatch_class)
-        self._accumulate_metric(self.epoch_n_err, cls,
-                                self.evaluator.n_err)
-        self.epoch_samples[cls] += int(self.minibatch_size)
+        if not self._consume_scan_window_():
+            # an absorbed pass already accounted EVERY step of the
+            # scan window (scan_commit) — including this cycle's
+            self._accumulate_metric(self.epoch_n_err, cls,
+                                    self.evaluator.n_err)
+            self.epoch_samples[cls] += int(self.minibatch_size)
         if not bool(self.last_minibatch):
             return
         self._flush_metrics(self.epoch_n_err, cls)
         self._close_class(cls, check_epoch_end=bool(self.epoch_ended))
+
+    # -- epoch-scan window protocol -----------------------------------------
+    def _scan_bump(self, cls, steps, samples):
+        self.epoch_samples[cls] += samples
+
+    def _scan_sums(self):
+        return self.epoch_n_err
+
+    def device_predicate(self):
+        """In-scan stop/improved verdict over the epoch's error count:
+        the device twin of :meth:`_close_class` +
+        :meth:`_on_epoch_ended` for a validated class close.  The
+        epoch total = the carried accumulator + the already-flushed
+        host partial sum (``metrics_every`` mid-epoch flushes); the
+        stop half is the shared :meth:`_stop_predicate`."""
+        import jax.numpy as jnp
+        stop = self._stop_predicate
+
+        def fn(accum, s):
+            err_pt = 100.0 * (accum + s["flushed"]) \
+                / jnp.maximum(s["samples"], 1.0)
+            improved = err_pt < s["best"]
+            return {"improved": improved, "stop": stop(improved, s)}
+        return fn
+
+    def predicate_scalars(self, cls, steps, samples):
+        return dict(
+            self._stop_predicate_scalars(),
+            samples=float(self.epoch_samples[cls] + samples),
+            flushed=float(self.epoch_n_err[cls]),
+            best=float(self.best_n_err_pt))
 
     def _close_class(self, cls, check_epoch_end):
         """End-of-class accounting shared by the standalone path (run)
@@ -226,6 +421,8 @@ class DecisionGD(DecisionBase):
 class DecisionMSE(DecisionBase):
     """Regression decision driven by ``EvaluatorMSE.mse``."""
 
+    SCAN_METRIC = "mse"
+
     CHECKPOINT_ATTRS = DecisionBase.CHECKPOINT_ATTRS + (
         "epoch_sum_mse", "epoch_batches", "epoch_mse", "best_mse",
         "best_epoch")
@@ -243,9 +440,10 @@ class DecisionMSE(DecisionBase):
 
     def run(self):
         cls = int(self.minibatch_class)
-        self._accumulate_metric(self.epoch_sum_mse, cls,
-                                self.evaluator.mse)
-        self.epoch_batches[cls] += 1
+        if not self._consume_scan_window_():
+            self._accumulate_metric(self.epoch_sum_mse, cls,
+                                    self.evaluator.mse)
+            self.epoch_batches[cls] += 1
         if not bool(self.last_minibatch):
             return
         self._flush_metrics(self.epoch_sum_mse, cls)
@@ -275,6 +473,39 @@ class DecisionMSE(DecisionBase):
         self.epoch_sum_mse[cls] = 0.0
         self.epoch_batches[cls] = 0
 
+    # -- epoch-scan window protocol -----------------------------------------
+    def _scan_bump(self, cls, steps, samples):
+        self.epoch_batches[cls] += steps
+
+    def _scan_sums(self):
+        return self.epoch_sum_mse
+
+    def device_predicate(self):
+        import jax.numpy as jnp
+        stop = self._stop_predicate
+
+        def fn(accum, s):
+            mse = (accum + s["flushed"]) / jnp.maximum(s["batches"],
+                                                       1.0)
+            improved = mse < s["best"]
+            return {"improved": improved, "stop": stop(improved, s)}
+        return fn
+
+    def predicate_scalars(self, cls, steps, samples):
+        return dict(
+            self._stop_predicate_scalars(),
+            batches=float(self.epoch_batches[cls] + steps),
+            flushed=float(self.epoch_sum_mse[cls]),
+            best=float(self.best_mse))
+
     def get_metric_values(self):
         return {"best_rmse": float(self.best_mse),
                 "best_epoch": self.best_epoch}
+
+
+#: the scan-window protocol markers: these exact run() bodies are the
+#: per-step semantics scan_commit mirrors — a subclass overriding
+#: run() drops the marker (scan_compatible goes False, V-J10 points
+#: at the remedy) until it re-opts in deliberately
+DecisionGD.run.scan_protocol = True
+DecisionMSE.run.scan_protocol = True
