@@ -1428,6 +1428,103 @@ def stage_transformer_gen():
                         % recompiles)
     print(_dumps(rec))
 
+    # -- long-tail phase: paged KV vs the same-run contiguous line --
+    # mixed SHORT/LONG PROMPTS (not just budgets) — the mix paged KV
+    # exists for: contiguous reserves max_seq rows per admission, the
+    # pool pays per page; both engines run chunked admission over a
+    # shared seed so the only variable is the KV layout.  The pool is
+    # throttled to ~half the contiguous reservation so the preemption
+    # path shows up in the record (lossless — token parity holds).
+    if tiny:
+        block_size, long_prompt = 8, 24
+    else:
+        block_size, long_prompt = 16, 512
+    chunk = buckets[0]
+    rng = numpy.random.default_rng(1)
+    lt_new = min(long_new, max_seq - long_prompt - 1)
+    lt_workload = [
+        (rng.integers(0, cfg["vocab"],
+                      long_prompt if i % slots == 0
+                      else int(rng.integers(1, buckets[0] + 1))
+                      ).tolist(),
+         lt_new if i % slots == 0
+         else int(rng.integers(2, buckets[0] + 1)))
+        for i in range(n_requests)]
+    max_blocks = max_seq // block_size
+
+    def build_lt(kv, num_blocks=None):
+        model = TransformerGenModel(
+            cfg, compute_dtype=dtype) if dtype else \
+            TransformerGenModel(cfg)
+        return GenerativeEngine(
+            model, max_slots=slots, max_seq=max_seq,
+            prefill_buckets=buckets, seed=0, kv=kv,
+            block_size=block_size if kv == "paged" else None,
+            num_blocks=num_blocks, prefill_chunk=chunk).warmup()
+
+    def run_lt(engine):
+        scheduler = GenerativeScheduler(engine, name="bench-lt")
+        futures = [scheduler.submit(toks, max_new)
+                   for toks, max_new in lt_workload]
+        hbm_sum = hbm_n = peak_conc = 0
+        tic = time.perf_counter()
+        while scheduler.queue_depth() or scheduler.active_requests():
+            if scheduler.step() == 0:
+                break
+            per_req = engine.hbm_per_request_bytes()
+            if per_req:
+                hbm_sum += per_req
+                hbm_n += 1
+            peak_conc = max(peak_conc, scheduler.active_requests())
+        sec = time.perf_counter() - tic
+        tokens = [f.result(0) for f in futures]
+        out = (scheduler.tokens_total, sec,
+               hbm_sum // max(1, hbm_n), peak_conc,
+               engine.preemptions_total, tokens)
+        engine.close()
+        return out
+
+    recompiles0 = prof.ledger.recompiles
+    (ct_tokens, ct_sec, ct_hbm, ct_conc, _zero,
+     ct_streams) = run_lt(build_lt("contiguous"))
+    (pg_tokens, pg_sec, pg_hbm, pg_conc, pg_preempt,
+     pg_streams) = run_lt(build_lt(
+         "paged", num_blocks=slots * max_blocks // 2 + 1))
+    lt_recompiles = prof.ledger.recompiles - recompiles0
+    ct_tps = ct_tokens / ct_sec if ct_sec else 0.0
+    pg_tps = pg_tokens / pg_sec if pg_sec else 0.0
+    rec = {
+        "metric": "transformer generative serving, paged KV "
+                  "(long-tail mixed prompts)"
+                  + (" [tiny-smoke]" if tiny else ""),
+        "value": round(pg_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "kv": "paged",
+        "block_size": block_size,
+        "prefill_chunk": chunk,
+        "hbm_per_request_bytes": pg_hbm,
+        "preemptions": pg_preempt,
+        "max_concurrent": pg_conc,
+        "vs_contiguous_x": round(pg_tps / ct_tps, 3)
+                           if ct_tps else None,
+        "contiguous_tokens_per_sec": round(ct_tps, 1),
+        "contiguous_hbm_per_request_bytes": ct_hbm,
+        "contiguous_max_concurrent": ct_conc,
+        "token_parity": pg_streams == ct_streams,
+        "recompiles": lt_recompiles,
+        "slots": slots,
+        "requests": n_requests,
+        "device_kind": _device_kind()}
+    if not rec["token_parity"]:
+        rec["error"] = ("paged token streams diverge from the "
+                        "same-run contiguous line — the parity "
+                        "contract is bitwise")
+    if lt_recompiles:
+        rec["error"] = ("%d steady-state recompile(s) in the "
+                        "long-tail phase" % lt_recompiles)
+    print(_dumps(rec))
+
 
 #: the reference DB's fastest recorded matmul: GTX TITAN, float,
 #: precision 0 — 0.1642 s for ONE 3001² matmul (``backends.py:672-731``

@@ -49,9 +49,14 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m veles_tpu.chaos --smoke
 # generative serving smoke: warmup must cover every prefill bucket +
 # the decode program, then a seeded mixed-length continuous-batching
 # session completes with ZERO steady-state compiles (the recompile
-# sentinel stays quiet) and every request at exactly its token budget
-echo "== gen smoke (generative serving gate) =="
-timeout -k 10 120 env JAX_PLATFORMS=cpu python -m veles_tpu.gen --smoke
+# sentinel stays quiet) and every request at exactly its token budget;
+# a second PAGED session (block-pool KV, chunked prefill, pool sized
+# below the working set) must reproduce the contiguous token streams
+# EXACTLY while exercising and recovering >=1 pool-exhaustion
+# preemption — the lossless-preemption gate (docs/services.md § Paged
+# KV)
+echo "== gen smoke (generative serving + paged KV gate) =="
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m veles_tpu.gen --smoke
 # pod smoke: an 8-shard CPU session (one pod = one pjit'd stitched
 # program) must train the seeded sample to completion with ZERO
 # per-step gradient/update frames on the ZMQ wire (chaos wire-site

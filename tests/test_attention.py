@@ -367,3 +367,121 @@ def test_decode_row_independence():
         out = numpy.asarray(_decode_jnp(
             jnp.asarray(q2), jnp.asarray(k2), jnp.asarray(v2), lengths))
         assert (out[i] == base[i]).all(), i
+
+
+# -- paged decode (block-pool KV + block tables) ---------------------------
+
+def _paged_case(b=3, max_blocks=3, bs=8, h=2, d=16, seed=31):
+    """A contiguous decode case + its EXACT paged mirror: the same K/V
+    values scattered into a shuffled block pool with tables mapping
+    them back, a trash block 0 full of garbage, and unallocated table
+    entries pointing at it."""
+    rng = numpy.random.default_rng(seed)
+    S = max_blocks * bs
+    mk = lambda shape: rng.standard_normal(shape).astype(numpy.float32)
+    q, k, v = mk((b, 1, h, d)), mk((b, S, h, d)), mk((b, S, h, d))
+    num_blocks = b * max_blocks + 1
+    k_pool = mk((num_blocks, bs, h, d))      # garbage incl. trash
+    v_pool = mk((num_blocks, bs, h, d))
+    # deterministic shuffle of the allocatable ids over rows
+    ids = rng.permutation(numpy.arange(1, num_blocks))
+    tables = numpy.zeros((b, max_blocks), numpy.int32)
+    lengths = numpy.asarray([1, bs + 3, S], numpy.int32)
+    next_id = 0
+    for i in range(b):
+        n_blk = -(-int(lengths[i]) // bs)    # ceil
+        for j in range(n_blk):
+            bid = int(ids[next_id])
+            next_id += 1
+            tables[i, j] = bid
+            k_pool[bid] = k[i, j * bs:(j + 1) * bs]
+            v_pool[bid] = v[i, j * bs:(j + 1) * bs]
+    ja = jnp.asarray
+    return (ja(q), ja(k), ja(v), ja(k_pool), ja(v_pool),
+            jnp.asarray(tables), jnp.asarray(lengths))
+
+
+def test_paged_decode_dense_matches_contiguous_bitwise():
+    """The paged dense path (gather through the block tables) is
+    BITWISE identical to the contiguous dense decode at every valid
+    position — the substrate of the paged==contiguous engine parity
+    gate.  Garbage beyond lengths differs between the layouts on
+    purpose; the masked softmax must zero it out exactly."""
+    from veles_tpu.ops.attention import _decode_jnp, _paged_decode_jnp
+    q, k, v, k_pool, v_pool, tables, lengths = _paged_case()
+    ref = numpy.asarray(_decode_jnp(q, k, v, lengths))
+    out = numpy.asarray(_paged_decode_jnp(q, k_pool, v_pool, tables,
+                                          lengths))
+    assert (out == ref).all()
+
+
+def test_paged_decode_pallas_interpret_matches_dense():
+    """Paged Pallas kernel (interpret mode — the block table routes
+    each K/V page's DMA via scalar prefetch) vs the gather+dense
+    reference."""
+    from veles_tpu.ops.attention import (_paged_decode_jnp,
+                                         _paged_decode_pallas)
+    q, k, v, k_pool, v_pool, tables, lengths = _paged_case()
+    ref = _paged_decode_jnp(q, k_pool, v_pool, tables, lengths)
+    out = _paged_decode_pallas(q, k_pool, v_pool, tables, lengths,
+                               interpret=True)
+    assert numpy.allclose(numpy.asarray(out), numpy.asarray(ref),
+                          atol=1e-5), \
+        float(numpy.abs(numpy.asarray(out) -
+                        numpy.asarray(ref)).max())
+
+
+def test_paged_decode_pallas_rejects_misaligned_block_size():
+    from veles_tpu.ops.attention import _paged_decode_pallas
+    q, k, v, k_pool, v_pool, tables, lengths = _paged_case()
+    with pytest.raises(ValueError):
+        _paged_decode_pallas(q, k_pool[:, :5], v_pool[:, :5],
+                             tables, lengths, interpret=True)
+
+
+def test_paged_decode_public_entry_squeezes_and_jits():
+    """paged_decode_attention accepts (b, h, d) queries and jits with
+    traced tables/lengths — the fixed-shape paged decode program's
+    contract."""
+    from veles_tpu.ops.attention import (_paged_decode_jnp,
+                                         paged_decode_attention)
+    q, k, v, k_pool, v_pool, tables, lengths = _paged_case(seed=7)
+    ref = _paged_decode_jnp(q, k_pool, v_pool, tables, lengths)
+    out3 = paged_decode_attention(q[:, 0], k_pool, v_pool, tables,
+                                  lengths, use_pallas=False)
+    assert out3.shape == (q.shape[0], q.shape[2], q.shape[3])
+    assert (numpy.asarray(out3) == numpy.asarray(ref[:, 0])).all()
+    jitted = jax.jit(lambda q, kp, vp, t, n: paged_decode_attention(
+        q, kp, vp, t, n, use_pallas=False))
+    out = jitted(q, k_pool, v_pool, tables, lengths)
+    assert numpy.allclose(numpy.asarray(out), numpy.asarray(ref),
+                          atol=1e-6)
+
+
+# -- chunked prefill attention ---------------------------------------------
+
+def test_chunk_attention_matches_full_prefix():
+    """One chunk's offset-causal attention over the full cache buffer
+    equals the matching query rows of whole-prompt causal attention
+    over the written prefix — stale cache tail (beyond start+C)
+    hidden by the causal offset."""
+    from veles_tpu.ops.attention import _mha_jnp, chunk_attention
+    rng = numpy.random.default_rng(5)
+    S, C, start, h, d = 32, 8, 16, 2, 16
+    q_full = jnp.asarray(
+        rng.standard_normal((1, start + C, h, d)).astype(numpy.float32))
+    kv = rng.standard_normal((2, 1, S, h, d)).astype(numpy.float32)
+    kv[:, :, start + C:] = 1e3               # stale tail: must not leak
+    k, v = jnp.asarray(kv[0]), jnp.asarray(kv[1])
+    ref, _ = _mha_jnp(q_full[:, :start + C], k[:, :start + C],
+                      v[:, :start + C], causal=True)
+    out = chunk_attention(q_full[:, start:], k, v, start,
+                          use_pallas=False)
+    assert numpy.allclose(numpy.asarray(out),
+                          numpy.asarray(ref[:, start:]), atol=1e-5)
+    # traced start (the chunk program's fixed-shape contract)
+    jitted = jax.jit(lambda q, k, v, s: chunk_attention(
+        q, k, v, s, use_pallas=False))
+    out2 = jitted(q_full[:, start:], k, v, jnp.int32(start))
+    assert numpy.allclose(numpy.asarray(out2), numpy.asarray(out),
+                          atol=1e-6)

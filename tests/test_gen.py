@@ -705,3 +705,454 @@ def test_registry_undeploy_single_model():
     assert registry.names() == []
     assert not gen_engine._kv_tracked    # KV hold released
     registry.stop()
+
+
+# -- paged KV: THE parity gate extends --------------------------------------
+
+def test_paged_matches_contiguous_bitwise():
+    """The paged engine (block pool + tables) produces BITWISE the
+    contiguous engine's token streams — continuous, sequential and
+    static — for the seeded mixed-length set.  Allocation must not be
+    a numerics change."""
+    workload = mixed_workload(10)
+    engine = build_engine()
+    contiguous, _ = run_continuous(engine, workload)
+    engine.close()
+    engine = build_engine(kv="paged", block_size=8)
+    assert engine.describe()["kv"] == "paged"
+    paged, sched = run_continuous(engine, workload)
+    assert sched.batch_fill() > 0.5
+    assert engine.preemptions_total == 0     # full-capacity pool
+    engine.close()
+    assert paged == contiguous
+    engine = build_engine(kv="paged", block_size=8)
+    sequential, _ = run_sequential(engine, workload)
+    engine.close()
+    assert sequential == paged
+    engine = build_engine(kv="paged", block_size=8)
+    static, _steps = static_generate(engine, workload)
+    engine.close()
+    assert static == paged
+
+
+def test_paged_matches_contiguous_on_mesh():
+    """The same paged parity on the tensor-parallel engine: pool
+    sharded over heads, tables replicated."""
+    import jax
+    from veles_tpu.parallel.mesh import make_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    mesh = make_mesh({"model": 2})
+    workload = mixed_workload(6, seed=3, max_new_hi=7)
+    engine = build_engine(mesh=mesh, max_slots=2)
+    contiguous, _ = run_continuous(engine, workload)
+    engine.close()
+    engine = build_engine(mesh=mesh, max_slots=2, kv="paged",
+                          block_size=8)
+    assert engine.describe()["sharded"]
+    paged, _ = run_continuous(engine, workload)
+    engine.close()
+    assert paged == contiguous
+
+
+def test_paged_zero_steady_state_compiles():
+    from veles_tpu import prof
+    engine = build_engine(kv="paged", block_size=8, warm=False)
+    engine.warmup()
+    warm = engine.compile_count
+    assert warm == len(engine.prefill_buckets) + 1
+    recompiles = prof.ledger.recompiles
+    run_continuous(engine, mixed_workload(8, seed=1))
+    assert engine.compile_count == warm
+    assert prof.ledger.recompiles == recompiles
+    engine.close()
+
+
+def test_paged_pool_ledger_and_describe():
+    """Pool bytes (num_blocks x block_size pages, trash included)
+    ride the kv HBM ledger category exactly like the slot cache, and
+    describe() exposes the pool surface."""
+    from veles_tpu.memory import Watcher
+    before = Watcher.hbm_ledger()["by_category"].get(
+        "kv", {"bytes": 0})["bytes"]
+    engine = build_engine(kv="paged", block_size=8, warm=False)
+    assert engine.num_blocks == 3 * (48 // 8) + 1
+    assert engine.kv_cache_bytes == (
+        2 * CFG["layers"] * engine.num_blocks * 8 * CFG["heads"]
+        * (CFG["dim"] // CFG["heads"]) * 4)
+    ledger = Watcher.hbm_ledger()["by_category"]["kv"]
+    assert ledger["bytes"] == before + engine.kv_cache_bytes
+    info = engine.describe()
+    assert info["block_size"] == 8
+    assert info["blocks_total"] == engine.num_blocks - 1
+    assert info["blocks_free"] == info["blocks_total"]
+    assert info["preemptions_total"] == 0
+    engine.close()
+    assert Watcher.hbm_ledger()["by_category"]["kv"]["bytes"] == before
+
+
+def test_paged_rejects_misaligned_geometry():
+    with pytest.raises(ValueError):
+        build_engine(kv="paged", block_size=7, warm=False)   # 48 % 7
+    with pytest.raises(ValueError):
+        build_engine(kv="paged", block_size=8, num_blocks=4,
+                     warm=False)            # < one full sequence
+    with pytest.raises(ValueError):
+        build_engine(kv="nonsense", warm=False)
+    with pytest.raises(ValueError):
+        # a non-divisor chunk's padded final write would spill past
+        # the cache — rejected in BOTH kv modes
+        build_engine(prefill_chunk=32, warm=False)   # 48 % 32
+    with pytest.raises(ValueError):
+        build_engine(kv="paged", block_size=8, prefill_chunk=32,
+                     warm=False)
+
+
+def test_block_pool_deterministic_allocation():
+    """Lowest-id-first allocation, sorted release, the trash block
+    never handed out — the invariants the bitwise parity gate leans
+    on."""
+    from veles_tpu.gen import BlockPool, PoolExhausted
+    pool = BlockPool(slots=2, max_blocks=4, num_blocks=9,
+                     block_size=8)
+    ids = pool.admit(0, 17)                  # ceil(17/8) = 3 pages
+    assert ids == [1, 2, 3]
+    assert pool.tables[0].tolist() == [1, 2, 3, 0]
+    assert pool.admit(1, 4) == [4]
+    assert not pool.needs_append(0, 20)      # inside page 3
+    assert pool.needs_append(0, 24)
+    assert pool.append(0, 24) is True
+    assert pool.tables[0].tolist() == [1, 2, 3, 5]
+    assert pool.blocks_free == 3
+    with pytest.raises(ValueError):
+        pool.admit(1, 4)                     # slot 1 already owns
+    pool.release(0)
+    assert pool.blocks_free == 7
+    assert pool.tables[0].tolist() == [0, 0, 0, 0]
+    # freed pages come back lowest-first
+    assert pool.admit(0, 1) == [1]
+    exc = None
+    pool2 = BlockPool(slots=1, max_blocks=4, num_blocks=5,
+                      block_size=8)
+    try:
+        pool2.admit(0, 33)
+    except PoolExhausted as e:
+        exc = e
+    assert exc is not None and exc.needed == 5 and exc.free == 4
+
+
+def test_paged_pool_exhaustion_preempts_losslessly():
+    """THE preemption gate: a pool too small for the workload must
+    preempt (youngest first), requeue, and still produce streams
+    byte-identical to the uncontended run — deterministically across
+    repeats."""
+    workload = mixed_workload(10)
+    engine = build_engine(kv="paged", block_size=8)   # full pool
+    uncontended, _ = run_continuous(engine, workload)
+    engine.close()
+    runs = []
+    for _ in range(2):
+        engine = build_engine(kv="paged", block_size=8, num_blocks=9,
+                              prefill_chunk=8)
+        tokens, sched = run_continuous(engine, workload)
+        assert engine.preemptions_total >= 1
+        preemptions = engine.preemptions_total
+        engine.close()
+        runs.append((tokens, preemptions))
+    assert runs[0] == runs[1]                # deterministic
+    assert runs[0][0] == uncontended         # lossless
+
+
+def test_paged_admission_priced_by_pool_headroom():
+    """can_admit answers with ACTUAL pages, and the scheduler queues
+    (FIFO, head-of-line) instead of failing when the pool is full."""
+    engine = build_engine(kv="paged", block_size=8, num_blocks=9,
+                          buckets=(8, 16, 40))
+    # 8 usable pages; a 16-token prompt needs 2
+    assert engine.can_admit(16)
+    slot, _ = engine.prefill(list(range(1, 40)))     # 39 -> 5 pages
+    assert engine.blocks_free == 3
+    assert engine.can_admit(16)
+    assert not engine.can_admit(30)          # 4 pages > 3 free
+    from veles_tpu.gen import PoolExhausted
+    with pytest.raises(PoolExhausted):
+        engine.prefill(list(range(1, 31)))
+    assert engine.free_slots == 2            # failed admit freed slot
+    engine.release_slot(slot)
+    assert engine.blocks_free == 8
+    # through the scheduler: the queued request WAITS (FIFO) while the
+    # long resident holds the pool, then admits when pages free
+    scheduler = GenerativeScheduler(engine)
+    long_future = scheduler.submit(list(range(1, 40)), 4)
+    blocked = scheduler.submit(list(range(1, 31)), 2)
+    scheduler.step()                         # long in, blocked queued
+    assert not blocked.done()
+    assert scheduler.queue_depth() == 1
+    scheduler.run_until_idle()
+    assert len(long_future.result(0)) == 4
+    assert len(blocked.result(0)) == 2
+    engine.close()
+
+
+def test_chunked_prefill_matches_whole_prompt():
+    """Chunked admission (one chunk per step, contiguous AND paged)
+    reproduces the whole-prompt streams, with exactly TWO warmup
+    compiles (decode + the one chunk program)."""
+    from veles_tpu import prof
+    workload = mixed_workload(8, seed=4)
+    engine = build_engine()
+    whole, _ = run_continuous(engine, workload)
+    engine.close()
+    for kw in ({"prefill_chunk": 8},
+               {"prefill_chunk": 8, "kv": "paged", "block_size": 8}):
+        engine = build_engine(warm=False, **kw)
+        engine.warmup()
+        assert engine.compile_count == 2, kw
+        recompiles = prof.ledger.recompiles
+        chunked, _ = run_continuous(engine, workload)
+        assert engine.compile_count == 2, kw
+        assert prof.ledger.recompiles == recompiles
+        engine.close()
+        assert chunked == whole, kw
+
+
+def test_chunked_prefill_config_knobs():
+    """root.common.gen.kv / prefill_chunk drive the engine defaults;
+    explicit kwargs win."""
+    prior_kv = root.common.gen.get("kv", None)
+    prior_chunk = root.common.gen.get("prefill_chunk", None)
+    root.common.gen.kv = "paged"
+    root.common.gen.prefill_chunk = 7        # rounds up to a page
+    try:
+        engine = build_engine(warm=False, block_size=8)
+        assert engine.kv_mode == "paged"
+        assert engine.prefill_chunk == 8
+        engine.close()
+        with pytest.raises(ValueError):
+            # contiguous mode takes the raw knob: 48 % 7 -> rejected
+            build_engine(warm=False, kv="contiguous")
+        engine = build_engine(warm=False, kv="contiguous",
+                              prefill_chunk=6)   # kwarg wins
+        assert engine.kv_mode == "contiguous"
+        assert engine.prefill_chunk == 6
+        engine.close()
+    finally:
+        root.common.gen.kv = prior_kv or "contiguous"
+        if prior_chunk is None:
+            root.common.gen.prefill_chunk = None
+        else:
+            root.common.gen.prefill_chunk = prior_chunk
+
+
+def test_saturated_slot_evicts_via_finish_reason():
+    """The satellite fix: an active slot parked at max_seq no longer
+    crashes decode_step — the engine excludes it from the dispatch
+    and the scheduler routes it through the SHARED finish predicate
+    (reason "length"), in both kv modes."""
+    for kw in ({}, {"kv": "paged", "block_size": 8}):
+        engine = build_engine(**kw)
+        scheduler = GenerativeScheduler(engine)
+        doomed = scheduler.submit([1, 2, 3], 40)
+        survivor = scheduler.submit([4, 5], 3)
+        scheduler.step()                     # both admitted
+        # park the first slot at capacity (simulates the race the
+        # old engine answered with RuntimeError at engine.py:313);
+        # deterministic slot 0: the sorted free list admits in order
+        engine.slot_len[0] = engine.max_seq
+        out = engine.decode_step()           # no raise
+        assert out is not None and not out[1][0]
+        scheduler.step()
+        assert doomed.done()
+        assert doomed.result(0)              # resolved, not crashed
+        scheduler.run_until_idle()
+        assert survivor.result(0) and len(survivor.result(0)) == 3
+        assert engine.free_slots == engine.max_slots
+        engine.close()
+
+
+def test_paged_scheduler_gauges_on_metrics():
+    """The block-pool gauge surface: blocks total/free, preemptions
+    and per-request HBM next to the PR 8 gen gauges, registered and
+    unregistered with the scheduler."""
+    from veles_tpu.serve import ServingMetrics
+    metrics = ServingMetrics()
+    engine = build_engine(kv="paged", block_size=8)
+    scheduler = GenerativeScheduler(engine, metrics=metrics,
+                                    name="lm")
+    futures = [scheduler.submit(toks, max_new)
+               for toks, max_new in mixed_workload(5, seed=6)]
+    scheduler.run_until_idle()
+    assert all(f.done() for f in futures)
+    snap = metrics.snapshot()
+    assert snap['gen_blocks_total{model="lm"}'] == \
+        engine.blocks_total
+    assert snap['gen_blocks_free{model="lm"}'] == engine.blocks_total
+    assert snap['gen_preemptions_total{model="lm"}'] == 0
+    assert snap['gen_hbm_per_request_bytes{model="lm"}'] == 0
+    text = metrics.render_text()
+    assert 'veles_serve_gen_blocks_total{model="lm"}' in text
+    scheduler.stop(drain=False)
+    assert 'gen_blocks_total{model="lm"}' not in metrics.snapshot()
+    engine.close()
+    # contiguous engines still expose preemptions + per-request HBM
+    metrics2 = ServingMetrics()
+    engine = build_engine()
+    scheduler = GenerativeScheduler(engine, metrics=metrics2,
+                                    name="c")
+    snap = metrics2.snapshot()
+    assert snap['gen_preemptions_total{model="c"}'] == 0
+    assert 'gen_blocks_total{model="c"}' not in snap
+    scheduler.stop(drain=False)
+    engine.close()
+
+
+def test_vs01_paged_plan_checks():
+    """V-S01 learns the paged plan: sublane-hostile block sizes and
+    a pool below one sequence are errors; a pool below the observed
+    mix and bucket-capped requeue are warnings; pricing follows the
+    pool bytes."""
+    from veles_tpu.analyze.shapes import check_generative
+
+    def stub(**kw):
+        plan = _PlanStub(**{k: v for k, v in kw.items()
+                            if k in ("max_slots", "max_seq",
+                                     "prefill_buckets",
+                                     "kv_cache_bytes")})
+        plan.kv_mode = "paged"
+        plan.block_size = kw.get("block_size", 8)
+        plan.num_blocks = kw.get("num_blocks", 13)
+        plan.prefill_chunk = kw.get("prefill_chunk", 8)
+        return plan
+
+    assert not check_generative(stub(), hbm_bytes=1 << 30).has_errors
+    assert check_generative(stub(block_size=6)).has_errors   # < 8
+    assert check_generative(stub(block_size=10)).has_errors  # % 8
+    assert check_generative(
+        stub(block_size=32)).has_errors      # 48 % 32 != 0
+    assert check_generative(stub(num_blocks=4)).has_errors   # < 1 seq
+    assert check_generative(
+        stub(prefill_chunk=32)).has_errors   # chunk ∤ max_seq
+    report = check_generative(stub(num_blocks=7, max_slots=4),
+                              hbm_bytes=1 << 30)
+    assert not report.has_errors
+    assert any(f.severity == "warning" for f in report.findings)
+    # whole-prompt paged with buckets below max_seq: requeue warning
+    report = check_generative(stub(prefill_chunk=None),
+                              hbm_bytes=1 << 30)
+    assert any("requeue" in f.message for f in report.findings)
+
+
+def test_registry_deploys_paged_engine_end_to_end():
+    from veles_tpu.serve import ModelRegistry
+    registry = ModelRegistry()
+    engine = build_engine(kv="paged", block_size=8,
+                          prefill_chunk=8, warm=False)
+    registry.deploy_generative("lm", engine, version=1)
+    try:
+        info = registry.describe()["lm"]
+        assert info["kv"] == "paged"
+        assert info["blocks_total"] == engine.blocks_total
+        out = registry.generate("lm", [1, 2, 3], max_new_tokens=4)
+        assert len(out) == 4
+    finally:
+        registry.stop()
+    assert not engine._kv_tracked
+
+
+# -- the capacity + TTFT gate (paged acceptance) ----------------------------
+
+@pytest.mark.slow
+def test_paged_capacity_and_chunked_ttft_closed_loop():
+    """The paged mode's reason to exist, measured: (1) at EQUAL kv
+    HBM budget (ledger bytes, trash page included) the pool admits
+    >= 1.5x the concurrent sequences of the contiguous engine on a
+    short-sequence mix the contiguous engine must queue; (2) chunked
+    prefill cuts co-resident shorts' TTFT p99 vs whole-prompt
+    admission in the same setup — with bitwise token parity
+    throughout."""
+    import time
+
+    # (1) capacity at equal ledger budget: 2 contiguous slots x 96
+    # rows == 24 pages; the pool gets 24 usable (+1 trash, 4% over)
+    cfg = dict(TINY, seq_len=128)
+
+    def model():
+        return TransformerGenModel(cfg)
+
+    contiguous = GenerativeEngine(
+        model(), max_slots=2, max_seq=96, prefill_buckets=(8,),
+        seed=0).warmup()
+    paged = GenerativeEngine(
+        model(), max_slots=8, max_seq=96, prefill_buckets=(8,),
+        seed=0, kv="paged", block_size=8, num_blocks=25).warmup()
+    assert paged.kv_cache_bytes <= 1.05 * contiguous.kv_cache_bytes
+    rng = numpy.random.default_rng(2)
+    workload = [
+        (rng.integers(0, cfg["vocab"],
+                      int(rng.integers(1, 9))).tolist(),
+         int(rng.integers(4, 9)))
+        for _ in range(24)]
+
+    def run(engine):
+        scheduler = GenerativeScheduler(engine)
+        futures = [scheduler.submit(toks, max_new)
+                   for toks, max_new in workload]
+        peak = 0
+        while scheduler.queue_depth() or scheduler.active_requests():
+            if scheduler.step() == 0:
+                break
+            peak = max(peak, scheduler.active_requests())
+        tokens = [f.result(0) for f in futures]
+        engine.close()
+        return tokens, peak
+
+    cont_tokens, cont_peak = run(contiguous)
+    paged_tokens, paged_peak = run(paged)
+    assert paged_tokens == cont_tokens       # parity under pressure
+    assert paged_peak >= 1.5 * cont_peak, \
+        "paged admitted %d concurrent vs contiguous %d" \
+        % (paged_peak, cont_peak)
+
+    # (2) chunked prefill vs whole-prompt admission: one long prompt
+    # bursts in with three shorts; whole-prompt mode makes every
+    # short's first token wait for the 440-token prefill dispatch,
+    # the chunk cadence only for one 64-token chunk.  Big model so
+    # prefill compute dominates dispatch overhead; best-of-2 runs
+    # per mode absorbs CI timer noise.
+    big = {"vocab": 512, "dim": 256, "heads": 4, "layers": 4,
+           "mlp_ratio": 4, "seq_len": 512}
+
+    def ttft_run(chunk):
+        engine = GenerativeEngine(
+            TransformerGenModel(big), max_slots=4, max_seq=512,
+            prefill_buckets=(64, 448), seed=0, kv="paged",
+            block_size=32, prefill_chunk=chunk).warmup()
+        scheduler = GenerativeScheduler(engine)
+        rng = numpy.random.default_rng(3)
+        jobs = [(rng.integers(0, big["vocab"], 440).tolist(), 3)] + [
+            (rng.integers(0, big["vocab"],
+                          int(rng.integers(4, 33))).tolist(), 6)
+            for _ in range(3)]
+        first, futures = {}, []
+        for i, (toks, max_new) in enumerate(jobs):
+            t0 = time.perf_counter()
+
+            def cb(_tok, i=i, t0=t0):
+                if i not in first:
+                    first[i] = time.perf_counter() - t0
+
+            futures.append(scheduler.submit(toks, max_new,
+                                            on_token=cb))
+        scheduler.run_until_idle()
+        tokens = [f.result(0) for f in futures]
+        engine.close()
+        return tokens, max(first[i] for i in (1, 2, 3))
+
+    whole_tokens, whole_p99 = ttft_run(None)
+    chunk_tokens, chunk_p99 = ttft_run(64)
+    whole_p99 = min(whole_p99, ttft_run(None)[1])
+    chunk_p99 = min(chunk_p99, ttft_run(64)[1])
+    assert chunk_tokens == whole_tokens      # chunking is not numerics
+    assert chunk_p99 < whole_p99, \
+        "co-resident TTFT p99: chunked %.3fs vs whole-prompt %.3fs" \
+        % (chunk_p99, whole_p99)

@@ -19,6 +19,7 @@ chain.
 
 import ast
 import inspect
+import math
 import textwrap
 
 import numpy
@@ -859,12 +860,12 @@ def check_shapes(workflow, sample_shape=None, batch_size=None):
 
 # -- V-S01: generative serving preflight ------------------------------------
 
-def check_generative(engine, hbm_bytes=None):
+def check_generative(engine, hbm_bytes=None, mean_seq_len=None):
     """Deploy-time plan check for a :class:`veles_tpu.gen.engine
     .GenerativeEngine` (rule V-S01) — pure host arithmetic over the
     engine's declared plan, no compiles, no device work.
 
-    Three failure families, one rule ID:
+    Four failure families, one rule ID:
 
     - **model shape** — a non-causal model cannot be decoded
       autoregressively against a KV cache (every step would need the
@@ -872,10 +873,19 @@ def check_generative(engine, hbm_bytes=None):
     - **slot/bucket plan** — buckets beyond ``max_seq``, ``max_seq``
       beyond the model's positional table, or zero slots are
       unservable by construction;
-    - **KV footprint** — cache + params must fit the device's HBM
-      (``hbm_bytes`` override for tests; the live table is
-      :func:`veles_tpu.backends.device_hbm_bytes`, and unknown/CPU
-      devices degrade to plan-sanity only).
+    - **paged plan** — a ``block_size`` that breaks the decode
+      kernel's 8-sublane padding or does not divide ``max_seq`` (the
+      bitwise-parity alignment), a pool too small for ONE full
+      sequence (deadlock at the first long request), or — warning —
+      a pool that cannot hold ``max_slots`` sequences at the
+      observed-mix mean length (``mean_seq_len``, default
+      ``max_seq / 2``): admission is priced per page, so this plan
+      would preempt constantly instead of batching;
+    - **KV footprint** — the cache (``num_blocks × block_size`` pages
+      in paged mode, ``slots × max_seq`` rows contiguous) + params
+      must fit the device's HBM (``hbm_bytes`` override for tests;
+      the live table is :func:`veles_tpu.backends.device_hbm_bytes`,
+      and unknown/CPU devices degrade to plan-sanity only).
 
     Returns a :class:`~veles_tpu.analyze.findings.Report`;
     ``ModelRegistry.deploy_generative`` maps its errors through
@@ -931,6 +941,68 @@ def check_generative(engine, hbm_bytes=None):
                     "program; a handful of powers of two usually "
                     "covers the prompt distribution" % len(buckets),
             fix="thin the bucket set"))
+    chunk = getattr(engine, "prefill_chunk", None)
+    if chunk and max_seq % int(chunk):
+        findings.append(Finding(
+            *_rule("V-S01"),
+            message="prefill_chunk %d does not divide max_seq %d — "
+                    "the final chunk of a near-max_seq prompt would "
+                    "write past the cache" % (int(chunk), max_seq),
+            fix="pick prefill_chunk | max_seq"))
+
+    # paged plan: block geometry + pool capacity priced per page
+    if getattr(engine, "kv_mode", "contiguous") == "paged":
+        block_size = int(getattr(engine, "block_size", 0) or 0)
+        num_blocks = int(getattr(engine, "num_blocks", 0) or 0)
+        if block_size < 8 or block_size % 8:
+            findings.append(Finding(
+                *_rule("V-S01"),
+                message="block_size %d breaks the paged decode "
+                        "kernel's 8-sublane padding — K/V pages must "
+                        "tile the (8, 128) register layout"
+                        % block_size,
+                fix="use a block_size that is a multiple of 8"))
+        elif max_seq % block_size:
+            findings.append(Finding(
+                *_rule("V-S01"),
+                message="max_seq %d is not a multiple of block_size "
+                        "%d — the paged gather cannot mirror the "
+                        "contiguous cache bitwise (the parity gate's "
+                        "alignment)" % (max_seq, block_size),
+                fix="pick block_size | max_seq"))
+        usable = max(0, num_blocks - 1)      # block 0 is the trash sink
+        if block_size > 0 and usable * block_size < max_seq:
+            findings.append(Finding(
+                *_rule("V-S01"),
+                message="pool of %d usable pages (%d tokens) cannot "
+                        "hold ONE max_seq=%d sequence — the engine "
+                        "would deadlock at its first long request"
+                        % (usable, usable * block_size, max_seq),
+                fix="grow num_blocks past max_seq / block_size + 1"))
+        elif block_size > 0 and max_slots > 0:
+            mean_len = float(mean_seq_len or max_seq / 2.0)
+            need = max_slots * math.ceil(mean_len / block_size)
+            if usable < need:
+                findings.append(Finding(
+                    "warning", "V-S01",
+                    message="pool of %d usable pages holds fewer than "
+                            "%d slots x %.0f-token sequences (%d "
+                            "pages at the observed-mix mean) — "
+                            "admission is priced per page, so this "
+                            "plan preempts instead of batching"
+                            % (usable, max_slots, mean_len, need),
+                    fix="grow num_blocks (or admit fewer slots)"))
+        if chunk is None and buckets and buckets[-1] < max_seq:
+            findings.append(Finding(
+                "warning", "V-S01",
+                message="paged pool with whole-prompt prefill and "
+                        "largest bucket %d < max_seq %d — a preempted "
+                        "sequence's prefix can outgrow every bucket "
+                        "and become unservable on requeue"
+                        % (buckets[-1], max_seq),
+                fix="set root.common.gen.prefill_chunk (chunked "
+                    "admission serves any prefix) or bucket up to "
+                    "max_seq"))
 
     kv_bytes = int(getattr(engine, "kv_cache_bytes", 0) or 0)
     params_bytes = 0

@@ -19,6 +19,13 @@ KV cache with iteration-level scheduling.  Pieces:
   every decode iteration, evict at finish, stream tokens per
   request) and :func:`~veles_tpu.gen.scheduler.static_generate`, the
   pad-to-slowest baseline it is benchmarked against.
+- :mod:`paged` — the block-pool paged KV cache
+  (``root.common.gen.kv = "paged"``): a shared device page pool +
+  per-slot block tables replace the per-slot ``max_seq``
+  reservation, chunked prefill (``root.common.gen.prefill_chunk``)
+  interleaves admissions with decode steps, and pool exhaustion
+  preempts the youngest sequence losslessly.  See
+  ``docs/services.md`` § Paged KV.
 
 Deployment rides the existing registry
 (``ModelRegistry.deploy_generative`` — analyzer rule V-S01 preflights
@@ -32,10 +39,11 @@ mixed-length closed-loop session with ZERO steady-state compiles.
 
 from veles_tpu.gen.engine import GenerativeEngine  # noqa: F401
 from veles_tpu.gen.model import TransformerGenModel  # noqa: F401
+from veles_tpu.gen.paged import BlockPool, PoolExhausted  # noqa: F401
 from veles_tpu.gen.scheduler import (  # noqa: F401
     GenerativeScheduler, static_generate)
 
 __all__ = [
-    "GenerativeEngine", "GenerativeScheduler", "TransformerGenModel",
-    "static_generate",
+    "BlockPool", "GenerativeEngine", "GenerativeScheduler",
+    "PoolExhausted", "TransformerGenModel", "static_generate",
 ]

@@ -5,9 +5,13 @@ tiny transformer engine must (1) warm every prefill bucket plus the
 decode program, (2) complete a seeded mixed-length continuous-batching
 session with ZERO steady-state compiles (the recompile sentinel stays
 quiet), and (3) resolve every request with exactly its budgeted token
-count.  Exit code 0 on success; any violation prints the failure and
-exits 1 — the same contract the serve engine's warmup gate enforces
-for the request/response path.
+count.  A second PAGED session (block-pool KV + chunked prefill over a
+pool deliberately too small for the working set) must then reproduce
+the contiguous session's token streams EXACTLY while exercising and
+recovering at least one pool-exhaustion preemption — the lossless-
+preemption contract, gated in CI.  Exit code 0 on success; any
+violation prints the failure and exits 1 — the same contract the
+serve engine's warmup gate enforces for the request/response path.
 """
 
 import argparse
@@ -20,7 +24,8 @@ def make_parser():
     parser = argparse.ArgumentParser(
         prog="veles_tpu.gen",
         description="Generative serving smoke gate (warmup -> zero "
-                    "steady-state compiles -> mixed-length session).")
+                    "steady-state compiles -> mixed-length session "
+                    "-> paged parity + preemption session).")
     parser.add_argument("--smoke", action="store_true",
                         help="run the CI smoke gate")
     parser.add_argument("--slots", type=int, default=4)
@@ -30,68 +35,111 @@ def make_parser():
     return parser
 
 
-def smoke(slots=4, max_seq=48, requests=16, seed=0):
+def _session(engine, workload, name):
+    """Warm + pump one seeded session; returns (token_lists or None,
+    elapsed, scheduler, steady_compiles, sentinel_flags)."""
     import time
 
     from veles_tpu import prof
-    from veles_tpu.gen import (GenerativeEngine, GenerativeScheduler,
-                               TransformerGenModel)
+    from veles_tpu.gen import GenerativeScheduler
+
+    engine.warmup()
+    warm = engine.compile_count
+    recompiles_before = prof.ledger.recompiles
+    scheduler = GenerativeScheduler(engine, name=name)
+    futures = [scheduler.submit(toks, max_new)
+               for toks, max_new in workload]
+    tic = time.perf_counter()
+    scheduler.run_until_idle()
+    elapsed = time.perf_counter() - tic
+    results = [future.result(0) if future.done() else None
+               for future in futures]
+    return (results, elapsed, scheduler,
+            engine.compile_count - warm,
+            prof.ledger.recompiles - recompiles_before)
+
+
+def smoke(slots=4, max_seq=48, requests=16, seed=0):
+    from veles_tpu.gen import GenerativeEngine, TransformerGenModel
     from veles_tpu.samples.transformer import TINY
 
     cfg = dict(TINY, seq_len=max(64, max_seq))
-    model = TransformerGenModel(cfg)
-    engine = GenerativeEngine(model, max_slots=slots, max_seq=max_seq,
-                              prefill_buckets=(8, 16, 32), seed=seed)
-    engine.warmup()
-    warm_compiles = engine.compile_count
-    want_compiles = len(engine.prefill_buckets) + 1
-    if warm_compiles != want_compiles:
-        print("FAIL: warmup compiled %d programs, want %d"
-              % (warm_compiles, want_compiles))
-        return 1
-    recompiles_before = prof.ledger.recompiles
-
     rng = numpy.random.default_rng(seed)
     workload = [
         (rng.integers(0, cfg["vocab"],
                       int(rng.integers(1, 30))).tolist(),
          int(rng.integers(1, 14)))
         for _ in range(requests)]
-    scheduler = GenerativeScheduler(engine, name="smoke")
-    futures = [scheduler.submit(toks, max_new)
-               for toks, max_new in workload]
-    tic = time.perf_counter()
-    scheduler.run_until_idle()
-    elapsed = time.perf_counter() - tic
 
+    def check_session(results, steady, flagged, label):
+        failed = 0
+        for got, (_toks, max_new) in zip(results, workload):
+            if got is None:
+                print("FAIL[%s]: request with budget %d never "
+                      "resolved" % (label, max_new))
+                failed += 1
+            elif len(got) != max_new:
+                print("FAIL[%s]: got %d tokens, budget %d"
+                      % (label, len(got), max_new))
+                failed += 1
+        if steady:
+            print("FAIL[%s]: %d steady-state compile(s) after warmup"
+                  % (label, steady))
+            failed += 1
+        if flagged:
+            print("FAIL[%s]: recompile sentinel flagged %d event(s)"
+                  % (label, flagged))
+            failed += 1
+        return failed
+
+    # phase 1: the contiguous session (the PR 8 gate, unchanged)
+    engine = GenerativeEngine(
+        TransformerGenModel(cfg), max_slots=slots, max_seq=max_seq,
+        prefill_buckets=(8, 16, 32), seed=seed)
+    results, elapsed, scheduler, steady, flagged = _session(
+        engine, workload, "smoke")
     failed = 0
-    for future, (_toks, max_new) in zip(futures, workload):
-        if not future.done():
-            print("FAIL: request with budget %d never resolved"
-                  % max_new)
-            failed += 1
-            continue
-        got = future.result(0)
-        if len(got) != max_new:
-            print("FAIL: got %d tokens, budget %d" % (len(got),
-                                                      max_new))
-            failed += 1
-    if engine.compile_count != warm_compiles:
-        print("FAIL: %d steady-state compile(s) after warmup"
-              % (engine.compile_count - warm_compiles))
+    want_compiles = len(engine.prefill_buckets) + 1
+    if engine.compile_count - steady != want_compiles:
+        print("FAIL: warmup compiled %d programs, want %d"
+              % (engine.compile_count - steady, want_compiles))
         failed += 1
-    if prof.ledger.recompiles != recompiles_before:
-        print("FAIL: recompile sentinel flagged %d event(s)"
-              % (prof.ledger.recompiles - recompiles_before))
-        failed += 1
+    failed += check_session(results, steady, flagged, "contiguous")
     tokens = scheduler.tokens_total
     print("gen smoke: %d requests, %d tokens in %.2fs "
           "(%.1f tok/s), batch fill %.0f%%, %d compiles "
           "(all warmup), 0 steady-state recompiles"
           % (len(workload), tokens, elapsed,
              tokens / elapsed if elapsed else 0.0,
-             100.0 * scheduler.batch_fill(), warm_compiles))
+             100.0 * scheduler.batch_fill(), engine.compile_count))
     engine.close()
+
+    # phase 2: the PAGED gate — same workload through a block pool too
+    # small for the mix (preemption MUST fire and recover) with
+    # chunked prefill, bitwise-matching the contiguous streams
+    paged = GenerativeEngine(
+        TransformerGenModel(cfg), max_slots=slots, max_seq=max_seq,
+        prefill_buckets=(8, 16, 32), seed=seed, kv="paged",
+        block_size=8, num_blocks=2 * (max_seq // 8) + 1,
+        prefill_chunk=16)
+    presults, pelapsed, pscheduler, psteady, pflagged = _session(
+        paged, workload, "smoke-paged")
+    failed += check_session(presults, psteady, pflagged, "paged")
+    if presults != results:
+        print("FAIL[paged]: token streams diverge from the "
+              "contiguous session — the parity gate is bitwise")
+        failed += 1
+    if paged.preemptions_total < 1:
+        print("FAIL[paged]: pool sized for preemption but none "
+              "fired — the exhaustion path went unexercised")
+        failed += 1
+    print("gen smoke[paged]: %d requests, %d tokens in %.2fs, "
+          "%d/%d pages, %d preemption(s) recovered losslessly, "
+          "contiguous==paged parity ok, 0 steady-state recompiles"
+          % (len(workload), pscheduler.tokens_total, pelapsed,
+             paged.blocks_total - paged.blocks_free,
+             paged.blocks_total, paged.preemptions_total))
+    paged.close()
     return 1 if failed else 0
 
 

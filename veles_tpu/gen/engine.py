@@ -17,6 +17,20 @@ registered in the HBM ledger under the ``kv`` category reserved since
 the PR 6 residency work — ``wf.perf_report()`` / ``/metrics`` show the
 cache's exact footprint next to params/dataset/staging.
 
+``root.common.gen.kv = "paged"`` (or ``kv="paged"``) swaps the
+slot-major cache for the shared block pool of
+:mod:`veles_tpu.gen.paged` — ``[layers, num_blocks, block_size,
+heads, head_dim]`` plus per-slot block tables — with the SAME program
+discipline: the block append is fused into the one fixed-shape decode
+program (tables ride in as an input), per-bucket prefills scatter
+whole pages, and ``root.common.gen.prefill_chunk = C`` replaces the
+bucket prefills with ONE chunk program fed at the decode cadence so
+co-resident streams stop stalling behind whole-prompt admissions.
+Pool exhaustion surfaces as :class:`~veles_tpu.gen.paged
+.PoolExhausted`; the scheduler answers with deterministic
+youngest-first preemption (lossless — the requeued prefix replays
+bitwise under greedy decode).
+
 Tensor parallelism is declarative (``parallel/tp.py`` rules): given a
 mesh with a ``model`` axis, block weights shard column→row, the KV
 cache shards over heads, and the SAME traced functions compile to a
@@ -35,6 +49,10 @@ from veles_tpu.logger import Logger
 
 #: per-process engine sequence for performance-ledger entry names
 _GEN_SEQ = itertools.count()
+
+
+def _round_up(x, mult):
+    return (x + mult - 1) // mult * mult
 
 
 def _power_of_two_buckets(lo, hi):
@@ -64,9 +82,12 @@ class GenerativeEngine(Logger):
 
     def __init__(self, model, params=None, *, max_slots=4,
                  max_seq=None, prefill_buckets=None, mesh=None,
-                 eos_id=None, seed=0, **kwargs):
+                 eos_id=None, seed=0, kv=None, block_size=None,
+                 num_blocks=None, prefill_chunk=None, **kwargs):
         super(GenerativeEngine, self).__init__(**kwargs)
         import jax
+
+        from veles_tpu.config import root
         self._jax = jax
         self.model = model
         self.max_slots = int(max_slots)
@@ -77,10 +98,67 @@ class GenerativeEngine(Logger):
             raise ValueError(
                 "max_seq %d out of range (2..%d, the model's "
                 "positional table)" % (self.max_seq, model.seq_limit))
-        self.prefill_buckets = tuple(sorted(set(
+
+        # KV layout mode: worst-case contiguous slots (PR 8) or the
+        # shared block pool (veles_tpu.gen.paged)
+        gen_cfg = root.common.gen
+        self.kv_mode = str(kv or gen_cfg.get("kv", "contiguous"))
+        if self.kv_mode not in ("contiguous", "paged"):
+            raise ValueError(
+                "root.common.gen.kv must be 'contiguous' or 'paged', "
+                "got %r" % self.kv_mode)
+        chunk = prefill_chunk if prefill_chunk is not None \
+            else gen_cfg.get("prefill_chunk", None)
+        self.prefill_chunk = int(chunk) if chunk else None
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+
+        self._pool = None
+        self.block_size = None
+        self.num_blocks = None
+        if self.kv_mode == "paged":
+            from veles_tpu.gen.paged import BlockPool
+            self.block_size = int(block_size
+                                  or gen_cfg.get("block_size", 16))
+            if self.block_size < 1:
+                raise ValueError("block_size must be >= 1")
+            if self.max_seq % self.block_size:
+                # the gathered [max_blocks*BS] view must equal the
+                # contiguous [max_seq] layout EXACTLY, or the parity
+                # gate degrades from bitwise to approximate
+                raise ValueError(
+                    "max_seq %d is not a multiple of block_size %d — "
+                    "the paged gather could not mirror the contiguous "
+                    "cache bitwise" % (self.max_seq, self.block_size))
+            max_blocks = self.max_seq // self.block_size
+            self.num_blocks = int(
+                num_blocks or self.max_slots * max_blocks + 1)
+            self._pool = BlockPool(self.max_slots, max_blocks,
+                                   self.num_blocks, self.block_size)
+            if self.prefill_chunk is not None:
+                self.prefill_chunk = _round_up(self.prefill_chunk,
+                                               self.block_size)
+        if self.prefill_chunk is not None \
+                and self.max_seq % self.prefill_chunk:
+            # the final chunk of a near-max_seq prompt pads to a full
+            # chunk; a non-divisor would spill that padded write past
+            # the cache (clamped dynamic_update_slice = silent
+            # corruption) and break the paged chunk program's fixed
+            # chunk_ids shape
+            raise ValueError(
+                "prefill_chunk %d must divide max_seq %d"
+                % (self.prefill_chunk, self.max_seq))
+
+        buckets = tuple(sorted(set(
             int(b) for b in (prefill_buckets
                              or _power_of_two_buckets(
                                  min(8, self.max_seq), self.max_seq)))))
+        if self._pool is not None:
+            # bucket shapes scatter whole pages — round each up to the
+            # page size (the padded tail routes to the trash block)
+            buckets = tuple(sorted(set(
+                _round_up(b, self.block_size) for b in buckets)))
+        self.prefill_buckets = buckets
         if (self.prefill_buckets[0] < 1
                 or self.prefill_buckets[-1] > self.max_seq):
             raise ValueError(
@@ -99,19 +177,28 @@ class GenerativeEngine(Logger):
         if params is None:
             params = model.init_params(seed=seed)
         self._shardings = self._build_shardings()
+        if self._pool is not None:
+            cache = model.init_paged_cache(self.num_blocks,
+                                           self.block_size)
+        else:
+            cache = model.init_cache(self.max_slots, self.max_seq)
         if self._shardings is None:
             self._params = jax.device_put(params)
-            self._cache = model.init_cache(self.max_slots, self.max_seq)
+            self._cache = cache
         else:
             p_sh, c_sh = self._shardings[:2]
             self._params = jax.device_put(params, p_sh)
             self._cache = jax.tree.map(
-                lambda a, s: jax.device_put(a, s),
-                model.init_cache(self.max_slots, self.max_seq), c_sh)
-        #: the cache's exact footprint, held in the HBM ledger's kv
-        #: category for the engine's lifetime
-        self.kv_cache_bytes = model.cache_nbytes(self.max_slots,
-                                                 self.max_seq)
+                lambda a, s: jax.device_put(a, s), cache, c_sh)
+        #: the cache's exact footprint (pool bytes in paged mode),
+        #: held in the HBM ledger's kv category for the engine's
+        #: lifetime
+        if self._pool is not None:
+            self.kv_cache_bytes = model.paged_cache_nbytes(
+                self.num_blocks, self.block_size)
+        else:
+            self.kv_cache_bytes = model.cache_nbytes(self.max_slots,
+                                                     self.max_seq)
         from veles_tpu.memory import Watcher
         Watcher.track(self.kv_cache_bytes, "kv", owner=self)
         self._kv_tracked = True
@@ -121,13 +208,17 @@ class GenerativeEngine(Logger):
         self.slot_token = numpy.zeros(self.max_slots, numpy.int32)
         self.slot_active = numpy.zeros(self.max_slots, bool)
         self._free = list(range(self.max_slots))
+        #: slot -> in-flight chunked-prefill state
+        self._chunking = {}
 
         self._prefill_exe = {}
+        self._chunk_exe = None
         self._decode_exe = None
         self._compile_lock = threading.Lock()
         self.compile_count = 0
         self.decode_calls = 0
         self.prefill_calls = 0
+        self.preemptions_total = 0
         self._warmed = False
         self.prof_name = "gen%d" % next(_GEN_SEQ)
         self._prof_entries = {}
@@ -145,8 +236,10 @@ class GenerativeEngine(Logger):
                 lambda s: NamedSharding(mesh, s), spec_tree,
                 is_leaf=lambda x: isinstance(x, P))
 
+        cache_spec = self.model.paged_cache_spec() \
+            if self._pool is not None else self.model.cache_spec()
         return (named(self.model.param_specs()),
-                named(self.model.cache_spec()),
+                named(cache_spec),
                 NamedSharding(mesh, P()))
 
     # -- compilation -------------------------------------------------------
@@ -203,32 +296,83 @@ class GenerativeEngine(Logger):
         exe = self._prefill_exe.get(bucket)
         if exe is None:
             jnp = self._jax.numpy
-            args = (self._params, self._cache,
-                    jnp.zeros((1, bucket), jnp.int32),
-                    jnp.int32(0), jnp.int32(1))
+            if self._pool is not None:
+                args = (self._params, self._cache,
+                        jnp.zeros((1, bucket), jnp.int32),
+                        jnp.zeros((bucket // self.block_size,),
+                                  jnp.int32),
+                        jnp.int32(1))
+                fn = self.model.paged_prefill
+            else:
+                args = (self._params, self._cache,
+                        jnp.zeros((1, bucket), jnp.int32),
+                        jnp.int32(0), jnp.int32(1))
+                fn = self.model.prefill
             exe = self._prefill_exe[bucket] = self._compile(
-                self.model.prefill, args, "prefill", "p%d" % bucket,
+                fn, args, "prefill", "p%d" % bucket,
                 self.model.prefill_flops(bucket))
         return exe
+
+    def _chunk_executable(self):
+        """The ONE fixed-shape chunked-prefill program (per kv mode):
+        any prompt length feeds through it chunk by chunk, so chunked
+        admission adds exactly one compile to warmup regardless of the
+        prompt distribution."""
+        if self._chunk_exe is None:
+            jnp = self._jax.numpy
+            chunk = self.prefill_chunk
+            if self._pool is not None:
+                args = (self._params, self._cache,
+                        jnp.zeros((1, chunk), jnp.int32),
+                        jnp.zeros((chunk // self.block_size,),
+                                  jnp.int32),
+                        jnp.zeros((self._pool.max_blocks,), jnp.int32),
+                        jnp.int32(0), jnp.int32(1))
+                fn = self.model.paged_prefill_chunk
+            else:
+                args = (self._params, self._cache,
+                        jnp.zeros((1, chunk), jnp.int32),
+                        jnp.int32(0), jnp.int32(0), jnp.int32(1))
+                fn = self.model.prefill_chunk
+            self._chunk_exe = self._compile(
+                fn, args, "prefill", "chunk%d" % chunk,
+                self.model.prefill_chunk_flops(chunk, self.max_seq))
+        return self._chunk_exe
 
     def _decode_executable(self):
         if self._decode_exe is None:
             jnp = self._jax.numpy
-            args = (self._params, self._cache,
-                    jnp.zeros((self.max_slots,), jnp.int32),
-                    jnp.zeros((self.max_slots,), jnp.int32))
+            slots = self.max_slots
+            if self._pool is not None:
+                args = (self._params, self._cache,
+                        jnp.zeros((slots, self._pool.max_blocks),
+                                  jnp.int32),
+                        jnp.zeros((slots,), jnp.int32),
+                        jnp.zeros((slots,), jnp.int32),
+                        jnp.zeros((slots,), bool))
+                fn = self.model.paged_decode
+            else:
+                args = (self._params, self._cache,
+                        jnp.zeros((slots,), jnp.int32),
+                        jnp.zeros((slots,), jnp.int32),
+                        jnp.zeros((slots,), bool))
+                fn = self.model.decode
             self._decode_exe = self._compile(
-                self.model.decode, args, "decode", "decode",
-                self.model.decode_flops(self.max_slots, self.max_seq))
+                fn, args, "decode", "decode",
+                self.model.decode_flops(slots, self.max_seq))
         return self._decode_exe
 
     def warmup(self):
-        """AOT-compile the decode step and every prefill bucket;
-        afterwards ANY compile is a flagged steady-state recompile.
-        Returns self (chainable)."""
+        """AOT-compile the decode step and every admission program —
+        the per-bucket prefills, plus the one chunk program when
+        chunked prefill is on; afterwards ANY compile is a flagged
+        steady-state recompile.  Returns self (chainable)."""
         self._decode_executable()
-        for bucket in self.prefill_buckets:
-            self._prefill_executable(bucket)
+        if self.prefill_chunk is not None:
+            self._chunk_executable()
+        else:
+            for bucket in self.prefill_buckets:
+                self._prefill_executable(bucket)
         self._warmed = True
         return self
 
@@ -248,40 +392,135 @@ class GenerativeEngine(Logger):
     def active_slots(self):
         return int(self.slot_active.sum())
 
+    def prefilling_slots(self):
+        return len(self._chunking)
+
     def occupancy(self):
-        return self.active_slots() / float(self.max_slots)
+        return (self.active_slots() + len(self._chunking)) \
+            / float(self.max_slots)
 
-    def release_slot(self, slot):
-        if not self.slot_active[slot]:
-            raise ValueError("slot %d is not active" % slot)
-        self.slot_active[slot] = False
-        self.slot_len[slot] = 0
-        # keep admission deterministic: the free list stays sorted so
-        # the same request mix always lands in the same slots
-        import bisect
-        bisect.insort(self._free, slot)
-
-    # -- serving -----------------------------------------------------------
-    def prefill(self, tokens):
-        """Admit one prompt into a free slot: returns ``(slot,
-        first_token)``.  Raises ``RuntimeError`` when no slot is free
-        (the scheduler checks ``free_slots`` first) and ``ValueError``
-        on an unservable prompt."""
-        jnp = self._jax.numpy
-        tokens = numpy.ascontiguousarray(tokens,
-                                         numpy.int32).ravel()
-        n = len(tokens)
+    def _validate_prompt_len(self, n):
+        """The TWO guards every admission path shares (scheduler door
+        check, whole-bucket prefill, chunked admit) — single-sourced
+        so they can never diverge."""
+        n = int(n)
         if n < 1:
             raise ValueError("empty prompt")
         if n >= self.max_seq:
             raise ValueError(
                 "prompt of %d tokens leaves no room to generate "
                 "(max_seq %d)" % (n, self.max_seq))
+        return n
+
+    def check_prompt(self, n):
+        """Raise ``ValueError`` when a prompt of ``n`` tokens can
+        never be admitted — the scheduler's door check, shared by
+        every kv/prefill mode."""
+        n = self._validate_prompt_len(n)
+        if self._pool is not None and \
+                self._pool.blocks_for(n) > self._pool.blocks_total:
+            raise ValueError(
+                "prompt of %d tokens needs %d pages but the pool has "
+                "%d" % (n, self._pool.blocks_for(n),
+                        self._pool.blocks_total))
+        if self.prefill_chunk is None:
+            self.bucket_for(n)          # raises when over the buckets
+
+    def _appends_needed(self):
+        """Pages the CURRENT residents' next decode step will claim
+        (slots whose write position sits on a page boundary)."""
+        if self._pool is None:
+            return 0
+        return sum(
+            1 for slot in range(self.max_slots)
+            if self.slot_active[slot]
+            and self.slot_len[slot] < self.max_seq
+            and self._pool.needs_append(slot, int(self.slot_len[slot])))
+
+    def can_admit(self, n):
+        """True when a prompt (or preempted prefix) of ``n`` tokens is
+        admissible RIGHT NOW: a free slot, and — in paged mode — the
+        pool holding its pages ON TOP of the pages the residents'
+        next decode step claims.  Pricing admission under that
+        reservation keeps a tight pool from admit-preempt thrashing:
+        without it the head request's pages are immediately taken
+        back by the residents' appends, the youngest (= that head)
+        is preempted, re-admitted next step, and the cycle re-runs
+        its whole prefill once per resident token."""
+        if not self._free:
+            return False
+        if self._pool is not None:
+            need = self._pool.blocks_for(int(n))
+            if int(n) % self.block_size == 0:
+                # a prefix filling its pages exactly appends a fresh
+                # page on its FIRST decode step — price it now, or
+                # that admission is the next preemption victim
+                need += 1
+            return (need + self._appends_needed()
+                    <= self._pool.blocks_free)
+        return True
+
+    def release_slot(self, slot):
+        if slot in self._chunking:
+            # a chunked prefill abandoned mid-flight (scheduler stop
+            # or preemption): drop the chunk state with the pages
+            del self._chunking[slot]
+        elif not self.slot_active[slot]:
+            raise ValueError("slot %d is not active" % slot)
+        self.slot_active[slot] = False
+        self.slot_len[slot] = 0
+        if self._pool is not None:
+            self._pool.release(slot)
+        # keep admission deterministic: the free list stays sorted so
+        # the same request mix always lands in the same slots
+        import bisect
+        bisect.insort(self._free, slot)
+
+    def preempt(self, slot):
+        """Pool-exhaustion eviction: free the slot AND its pages
+        without finishing the request — the scheduler requeues the
+        sequence's tokens-so-far and greedy decode reproduces the
+        stream, so preemption is lossless."""
+        if not self.slot_active[slot] and slot not in self._chunking:
+            raise ValueError("slot %d is not occupied" % slot)
+        self.release_slot(slot)
+        self.preemptions_total += 1
+
+    def decode_block_deficit(self):
+        """How many pages the NEXT decode step needs beyond the free
+        list — the scheduler preempts until this reaches zero.  Always
+        0 in contiguous mode (capacity was reserved at admission)."""
+        if self._pool is None:
+            return 0
+        return max(0, self._appends_needed() - self._pool.blocks_free)
+
+    # -- serving -----------------------------------------------------------
+    def prefill(self, tokens):
+        """Admit one prompt into a free slot with ONE whole-bucket
+        dispatch: returns ``(slot, first_token)``.  Raises
+        ``RuntimeError`` when no slot is free (the scheduler checks
+        ``free_slots`` first), :class:`~veles_tpu.gen.paged
+        .PoolExhausted` when the pool cannot hold the prompt's pages,
+        and ``ValueError`` on an unservable prompt."""
+        jnp = self._jax.numpy
+        tokens = numpy.ascontiguousarray(tokens,
+                                         numpy.int32).ravel()
+        n = self._validate_prompt_len(len(tokens))
         bucket = self.bucket_for(n)
         if not self._free:
             raise RuntimeError("no free slot (all %d busy)"
                                % self.max_slots)
         slot = self._free.pop(0)
+        if self._pool is not None:
+            try:
+                ids = self._pool.admit(slot, n)
+            except Exception:
+                import bisect
+                bisect.insort(self._free, slot)
+                raise
+            block_ids = numpy.zeros(bucket // self.block_size,
+                                    numpy.int32)
+            block_ids[:len(ids)] = ids
         padded = numpy.zeros(bucket, numpy.int32)
         padded[:n] = tokens
         exe, entry = self._prefill_executable(bucket)
@@ -290,9 +529,15 @@ class GenerativeEngine(Logger):
                         {"bucket": bucket, "slot": slot, "len": n,
                          "engine": self.prof_name}, role="server"):
             tic = time.perf_counter_ns()
-            self._cache, tok = exe(self._params, self._cache,
-                                   jnp.asarray(padded[None]),
-                                   jnp.int32(slot), jnp.int32(n))
+            if self._pool is not None:
+                self._cache, tok = exe(self._params, self._cache,
+                                       jnp.asarray(padded[None]),
+                                       jnp.asarray(block_ids),
+                                       jnp.int32(n))
+            else:
+                self._cache, tok = exe(self._params, self._cache,
+                                       jnp.asarray(padded[None]),
+                                       jnp.int32(slot), jnp.int32(n))
             tok = int(tok)
             prof.ledger.record_dispatch(
                 entry, time.perf_counter_ns() - tic, items=n)
@@ -301,19 +546,100 @@ class GenerativeEngine(Logger):
         self.slot_active[slot] = True
         return slot, tok
 
+    def admit(self, tokens):
+        """The mode-agnostic admission door: whole-prompt engines
+        prefill in one dispatch and return ``(slot, first_token)``;
+        chunked engines claim the slot (and, paged, ALL the prompt's
+        pages — deterministic up-front pricing) and return ``(slot,
+        None)`` — the scheduler then pumps :meth:`prefill_step` once
+        per decode cadence until the first token arrives."""
+        if self.prefill_chunk is None:
+            return self.prefill(tokens)
+        tokens = numpy.ascontiguousarray(tokens,
+                                         numpy.int32).ravel()
+        n = self._validate_prompt_len(len(tokens))
+        if not self._free:
+            raise RuntimeError("no free slot (all %d busy)"
+                               % self.max_slots)
+        slot = self._free.pop(0)
+        if self._pool is not None:
+            try:
+                self._pool.admit(slot, n)
+            except Exception:
+                import bisect
+                bisect.insort(self._free, slot)
+                raise
+        chunk = self.prefill_chunk
+        padded = numpy.zeros(_round_up(n, chunk), numpy.int32)
+        padded[:n] = tokens
+        self._chunking[slot] = {"tokens": padded, "n": n, "done": 0}
+        return slot, None
+
+    def prefill_step(self, slot):
+        """Feed ONE chunk of the slot's pending prompt (fixed-shape
+        program, decode-step cadence).  Returns the first generated
+        token when the prompt completes, else ``None``."""
+        jnp = self._jax.numpy
+        state = self._chunking[slot]
+        chunk = self.prefill_chunk
+        start = state["done"]
+        chunk_len = min(chunk, state["n"] - start)
+        tokens = state["tokens"][start:start + chunk]
+        exe, entry = self._chunk_executable()
+        self.prefill_calls += 1
+        with trace.span("gen", "prefill_chunk",
+                        {"slot": slot, "start": start,
+                         "len": chunk_len, "engine": self.prof_name},
+                        role="server"):
+            tic = time.perf_counter_ns()
+            if self._pool is not None:
+                first = start // self.block_size
+                chunk_ids = self._pool.tables[
+                    slot, first:first + chunk // self.block_size]
+                self._cache, tok = exe(
+                    self._params, self._cache,
+                    jnp.asarray(tokens[None]),
+                    jnp.asarray(numpy.ascontiguousarray(chunk_ids)),
+                    jnp.asarray(self._pool.tables[slot]),
+                    jnp.int32(start), jnp.int32(chunk_len))
+            else:
+                self._cache, tok = exe(
+                    self._params, self._cache,
+                    jnp.asarray(tokens[None]), jnp.int32(slot),
+                    jnp.int32(start), jnp.int32(chunk_len))
+            prof.ledger.record_dispatch(
+                entry, time.perf_counter_ns() - tic, items=chunk_len)
+        state["done"] = start + chunk_len
+        if state["done"] < state["n"]:
+            return None
+        del self._chunking[slot]
+        tok = int(tok)
+        self.slot_len[slot] = state["n"]
+        self.slot_token[slot] = tok
+        self.slot_active[slot] = True
+        return tok
+
     def decode_step(self):
         """ONE fixed-shape decode iteration over every slot.  Returns
         ``(tokens, active)`` host arrays — ``tokens[slot]`` is only
         meaningful where ``active[slot]`` — or ``None`` when nothing
-        is active (no device call)."""
+        can decode (no device call).  Slots parked at ``max_seq`` are
+        EXCLUDED from the dispatch rather than raising: the scheduler
+        routes them through the shared ``finish_reason`` predicate and
+        evicts, in both kv modes."""
         if not self.slot_active.any():
             return None
         jnp = self._jax.numpy
-        active = self.slot_active.copy()
-        if (self.slot_len[active] >= self.max_seq).any():
-            raise RuntimeError(
-                "active slot at max_seq %d — the scheduler must evict "
-                "full sequences before decoding" % self.max_seq)
+        active = self.slot_active & (self.slot_len < self.max_seq)
+        if not active.any():
+            return None
+        if self._pool is not None:
+            # fused block append, host half: make sure every decoding
+            # row owns the page its write position lands in (raises
+            # PoolExhausted — the scheduler preempts first via
+            # decode_block_deficit, so this only fires on direct use)
+            for slot in numpy.nonzero(active)[0]:
+                self._pool.append(int(slot), int(self.slot_len[slot]))
         positions = numpy.where(active, self.slot_len, 0
                                 ).astype(numpy.int32)
         toks = numpy.where(active, self.slot_token, 0
@@ -325,9 +651,17 @@ class GenerativeEngine(Logger):
                         {"active": n_active, "engine": self.prof_name},
                         role="server"):
             tic = time.perf_counter_ns()
-            self._cache, out = exe(self._params, self._cache,
-                                   jnp.asarray(toks),
-                                   jnp.asarray(positions))
+            if self._pool is not None:
+                self._cache, out = exe(self._params, self._cache,
+                                       jnp.asarray(self._pool.tables),
+                                       jnp.asarray(toks),
+                                       jnp.asarray(positions),
+                                       jnp.asarray(active))
+            else:
+                self._cache, out = exe(self._params, self._cache,
+                                       jnp.asarray(toks),
+                                       jnp.asarray(positions),
+                                       jnp.asarray(active))
             out = numpy.asarray(out)
             prof.ledger.record_dispatch(
                 entry, time.perf_counter_ns() - tic, items=n_active)
@@ -336,19 +670,48 @@ class GenerativeEngine(Logger):
         return out, active
 
     # -- lifecycle / introspection -----------------------------------------
+    @property
+    def blocks_total(self):
+        return self._pool.blocks_total if self._pool else 0
+
+    @property
+    def blocks_free(self):
+        return self._pool.blocks_free if self._pool else 0
+
+    def hbm_per_request_bytes(self):
+        """KV bytes actually held per in-flight sequence — the
+        capacity metric the long-tail bench and /metrics report.
+        Contiguous mode reserves a full ``max_seq`` slice per slot at
+        admission; paged mode pays only for the pages in use."""
+        occupants = self.active_slots() + len(self._chunking)
+        if not occupants:
+            return 0
+        if self._pool is not None:
+            per_block = self.kv_cache_bytes // self.num_blocks
+            return self._pool.blocks_used * per_block // occupants
+        return self.kv_cache_bytes // self.max_slots
+
     def describe(self):
-        return {
+        info = {
             "model": type(self.model).__name__,
             "max_slots": self.max_slots,
             "max_seq": self.max_seq,
             "prefill_buckets": list(self.prefill_buckets),
             "kv_cache_bytes": self.kv_cache_bytes,
+            "kv": self.kv_mode,
+            "prefill_chunk": self.prefill_chunk,
             "sharded": self.mesh is not None,
             "compile_count": self.compile_count,
             "active_slots": self.active_slots(),
+            "prefilling_slots": len(self._chunking),
             "decode_calls": self.decode_calls,
             "prefill_calls": self.prefill_calls,
+            "preemptions_total": self.preemptions_total,
+            "hbm_per_request_bytes": self.hbm_per_request_bytes(),
         }
+        if self._pool is not None:
+            info.update(self._pool.describe())
+        return info
 
     def close(self):
         """Release the KV cache (and its ledger hold).  Idempotent."""
@@ -358,4 +721,5 @@ class GenerativeEngine(Logger):
             self._kv_tracked = False
         self._cache = None
         self._prefill_exe = {}
+        self._chunk_exe = None
         self._decode_exe = None

@@ -23,6 +23,18 @@ continuous batching bit-exact against sequential decode (the parity
 gate in ``tests/test_gen.py``); :func:`veles_tpu.ops.attention
 .decode_attention` provides it for the attention read.
 
+The PAGED half of the protocol (``veles_tpu.gen.paged``) mirrors the
+same four entry points over a shared block pool —
+``init_paged_cache(num_blocks, block_size)`` (``{"k", "v"}:
+[L, num_blocks, BS, h, dh]``), ``paged_prefill`` / ``paged_decode``
+(block-id scatter + table-gathered read, the append fused into the
+decode program), and the chunked-prefill pair ``prefill_chunk`` /
+``paged_prefill_chunk`` that feeds one fixed-shape chunk per decode
+cadence.  ``decode``/``paged_decode`` additionally take an ``active``
+mask: inactive slots' ride-along K/V writes are routed to a no-op
+(contiguous) or the trash block (paged), because a chunked prefill in
+flight owns its slot's cache while the slot is still decode-inactive.
+
 :class:`TransformerGenModel` adapts the :mod:`veles_tpu.samples
 .transformer` parameter layout (stacked blocks, tied readout) so the
 LM the platform trains is the LM it serves.
@@ -34,7 +46,9 @@ import jax
 import jax.numpy as jnp
 import numpy
 
-from veles_tpu.ops.attention import decode_attention, flash_attention
+from veles_tpu.ops.attention import (chunk_attention, decode_attention,
+                                     flash_attention,
+                                     paged_decode_attention)
 
 
 def _layernorm(x, g, b):
@@ -90,6 +104,22 @@ class TransformerGenModel(object):
         itemsize = jnp.dtype(dtype or self.compute_dtype).itemsize
         return 2 * int(numpy.prod(shape)) * itemsize
 
+    # -- paged cache (shared block pool + per-slot block tables) -----------
+    def paged_cache_shape(self, num_blocks, block_size):
+        return (self.layers, int(num_blocks), int(block_size),
+                self.heads, self.head_dim)
+
+    def init_paged_cache(self, num_blocks, block_size, dtype=None):
+        shape = self.paged_cache_shape(num_blocks, block_size)
+        dtype = dtype or self.compute_dtype
+        return {"k": jnp.zeros(shape, dtype),
+                "v": jnp.zeros(shape, dtype)}
+
+    def paged_cache_nbytes(self, num_blocks, block_size, dtype=None):
+        shape = self.paged_cache_shape(num_blocks, block_size)
+        itemsize = jnp.dtype(dtype or self.compute_dtype).itemsize
+        return 2 * int(numpy.prod(shape)) * itemsize
+
     # -- sharding rules (tensor parallelism over the model axis) -----------
     def param_specs(self):
         """PartitionSpec pytree: Megatron column→row pairs for the
@@ -122,6 +152,15 @@ class TransformerGenModel(object):
         spec = P(None, None, None, "model", None)
         return {"k": spec, "v": spec}
 
+    def paged_cache_spec(self):
+        """The block pool shards over heads exactly like the slot-major
+        cache — dim 3 of [L, num_blocks, BS, h, dh] — so each model
+        shard owns its heads' pages and the block tables stay
+        replicated host-mirrorable int32."""
+        from jax.sharding import PartitionSpec as P
+        spec = P(None, None, None, "model", None)
+        return {"k": spec, "v": spec}
+
     # -- forwards ----------------------------------------------------------
     def _attend_prefill(self, q, k, v):
         # the existing flash path: Pallas kernel on TPU (q_offset=0
@@ -129,6 +168,59 @@ class TransformerGenModel(object):
         # resolved once via use_pallas so recompiles can't flip it
         return flash_attention(q, k, v, True, None, None,
                                self.use_pallas)
+
+    def _run_layers(self, params, cache, h, kv_hook):
+        """Scan the block stack with the ONE shared layer body.
+        ``kv_hook(kc, vc, q, k, v) -> (kc', vc', att)`` is the only
+        thing the six entry points differ in — where this layer's K/V
+        land (slot slice, page scatter, chunk window) and what the
+        attention reads (the chunk itself, the masked cache, the
+        table-gathered pool).  One body means a layer-math change can
+        never desynchronize the paged==contiguous parity pair.
+        Returns ``(h_final_normed, cache')``."""
+        cd = self.compute_dtype
+
+        def layer(h, xs):
+            blk, kc, vc = xs
+            x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
+            qkv = jnp.einsum("bsd,dchx->bschx", x.astype(cd),
+                             blk["wqkv"].astype(cd))
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            kc, vc, att = kv_hook(kc, vc, q, k, v)
+            proj = jnp.einsum("bshx,hxd->bsd", att.astype(cd),
+                              blk["wo"].astype(cd))
+            h = h + proj.astype(h.dtype)
+            x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
+            up = (x.astype(cd) @ blk["w1"].astype(cd)
+                  + blk["b1"].astype(cd))
+            down = (jax.nn.gelu(up) @ blk["w2"].astype(cd)
+                    + blk["b2"].astype(cd))
+            h = h + down.astype(h.dtype)
+            return h, (kc, vc)
+
+        h, (ks, vs) = jax.lax.scan(
+            layer, h, (params["blocks"], cache["k"], cache["v"]))
+        return (_layernorm(h, params["lnf_g"], params["lnf_b"]),
+                {"k": ks, "v": vs})
+
+    def _greedy_at(self, params, h, index):
+        """h (1, S, d) -> the greedy token of row ``index`` (traced)
+        through the tied readout."""
+        cd = self.compute_dtype
+        last = jax.lax.dynamic_slice_in_dim(h[0], index, 1,
+                                            axis=0)[0]
+        logits = jnp.einsum("d,vd->v", last.astype(cd),
+                            params["embed"].astype(cd)
+                            ).astype(jnp.float32)
+        return jnp.argmax(logits).astype(jnp.int32)
+
+    def _greedy_rows(self, params, h):
+        """h (slots, 1, d) -> one greedy token per row."""
+        cd = self.compute_dtype
+        logits = jnp.einsum("bd,vd->bv", h[:, 0].astype(cd),
+                            params["embed"].astype(cd)
+                            ).astype(jnp.float32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def prefill(self, params, cache, tokens, slot, length):
         """tokens (1, bucket) int32 (zero-padded past ``length``),
@@ -138,85 +230,169 @@ class TransformerGenModel(object):
         into the returned token; the tail's garbage K/V lands in the
         cache but stays masked (and is progressively overwritten) by
         the decode step's length mask."""
-        cd = self.compute_dtype
         bucket = tokens.shape[1]
         h = params["embed"][tokens] + params["pos"][:bucket]
 
-        def layer(h, xs):
-            blk, kc, vc = xs
-            x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
-            qkv = jnp.einsum("bsd,dchx->bschx", x.astype(cd),
-                             blk["wqkv"].astype(cd))
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        def kv_hook(kc, vc, q, k, v):
             att = self._attend_prefill(q, k, v)
-            proj = jnp.einsum("bshx,hxd->bsd", att.astype(cd),
-                              blk["wo"].astype(cd))
-            h = h + proj.astype(h.dtype)
-            x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
-            up = (x.astype(cd) @ blk["w1"].astype(cd)
-                  + blk["b1"].astype(cd))
-            down = (jax.nn.gelu(up) @ blk["w2"].astype(cd)
-                    + blk["b2"].astype(cd))
-            h = h + down.astype(h.dtype)
             kc = jax.lax.dynamic_update_slice(
                 kc, k[0].astype(kc.dtype)[None], (slot, 0, 0, 0))
             vc = jax.lax.dynamic_update_slice(
                 vc, v[0].astype(vc.dtype)[None], (slot, 0, 0, 0))
-            return h, (kc, vc)
+            return kc, vc, att
 
-        h, (ks, vs) = jax.lax.scan(
-            layer, h, (params["blocks"], cache["k"], cache["v"]))
-        h = _layernorm(h, params["lnf_g"], params["lnf_b"])
-        last = jax.lax.dynamic_slice_in_dim(h[0], length - 1, 1,
-                                            axis=0)[0]
-        logits = jnp.einsum("d,vd->v", last.astype(cd),
-                            params["embed"].astype(cd)
-                            ).astype(jnp.float32)
-        return ({"k": ks, "v": vs},
-                jnp.argmax(logits).astype(jnp.int32))
+        h, cache = self._run_layers(params, cache, h, kv_hook)
+        return cache, self._greedy_at(params, h, length - 1)
 
-    def decode(self, params, cache, tokens, positions):
+    def decode(self, params, cache, tokens, positions, active):
         """ONE decode step over every slot: tokens (slots,) int32 (each
         slot's last token), positions (slots,) int32 (the cache index
-        this step writes = the slot's current length).  Inactive slots
-        ride along at position 0 computing garbage that the scheduler
-        discards — and that the next prefill overwrites — so the
-        program shape never changes with occupancy."""
-        cd = self.compute_dtype
+        this step writes = the slot's current length), active (slots,)
+        bool.  Inactive slots ride along at position 0 computing
+        garbage that the scheduler discards — the program shape never
+        changes with occupancy — but their KV WRITE is masked to a
+        no-op: a chunked prefill in flight owns its slot's cache row
+        while the slot is still decode-inactive, so an unmasked
+        ride-along write would corrupt position 0 of a live prompt."""
         slots = tokens.shape[0]
         h = (params["embed"][tokens]
              + params["pos"][positions])[:, None, :]   # (slots, 1, d)
         idx = jnp.arange(slots)
+        keep = active[:, None, None]
 
-        def layer(h, xs):
-            blk, kc, vc = xs
-            x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
-            qkv = jnp.einsum("bsd,dchx->bschx", x.astype(cd),
-                             blk["wqkv"].astype(cd))
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            kc = kc.at[idx, positions].set(k[:, 0].astype(kc.dtype))
-            vc = vc.at[idx, positions].set(v[:, 0].astype(vc.dtype))
+        def kv_hook(kc, vc, q, k, v):
+            kc = kc.at[idx, positions].set(
+                jnp.where(keep, k[:, 0].astype(kc.dtype),
+                          kc[idx, positions]))
+            vc = vc.at[idx, positions].set(
+                jnp.where(keep, v[:, 0].astype(vc.dtype),
+                          vc[idx, positions]))
             att = decode_attention(q, kc, vc, positions + 1,
                                    use_pallas=self.use_pallas)
-            proj = jnp.einsum("bshx,hxd->bsd", att.astype(cd),
-                              blk["wo"].astype(cd))
-            h = h + proj.astype(h.dtype)
-            x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
-            up = (x.astype(cd) @ blk["w1"].astype(cd)
-                  + blk["b1"].astype(cd))
-            down = (jax.nn.gelu(up) @ blk["w2"].astype(cd)
-                    + blk["b2"].astype(cd))
-            h = h + down.astype(h.dtype)
-            return h, (kc, vc)
+            return kc, vc, att
 
-        h, (ks, vs) = jax.lax.scan(
-            layer, h, (params["blocks"], cache["k"], cache["v"]))
-        h = _layernorm(h, params["lnf_g"], params["lnf_b"])
-        logits = jnp.einsum("bd,vd->bv", h[:, 0].astype(cd),
-                            params["embed"].astype(cd)
-                            ).astype(jnp.float32)
-        return ({"k": ks, "v": vs},
-                jnp.argmax(logits, axis=-1).astype(jnp.int32))
+        h, cache = self._run_layers(params, cache, h, kv_hook)
+        return cache, self._greedy_rows(params, h)
+
+    # -- paged forwards (block-pool cache, veles_tpu.gen.paged) ------------
+    def paged_prefill(self, params, cache, tokens, block_ids, length):
+        """Whole-prompt prefill into a PAGED pool: tokens (1, bucket)
+        int32 (bucket a multiple of block_size), block_ids
+        (bucket // block_size,) int32 — the prompt's allocated blocks
+        in position order, entries past its allocation pointing at
+        the trash block 0 so the bucket's garbage tail can never land
+        in another sequence's pages.  Same forward as :meth:`prefill`;
+        only the KV landing differs."""
+        bucket = tokens.shape[1]
+        n_blk = block_ids.shape[0]
+        bs = bucket // n_blk
+        h = params["embed"][tokens] + params["pos"][:bucket]
+
+        def kv_hook(kc, vc, q, k, v):
+            att = self._attend_prefill(q, k, v)
+            kc = kc.at[block_ids].set(
+                k[0].astype(kc.dtype).reshape(
+                    n_blk, bs, self.heads, self.head_dim))
+            vc = vc.at[block_ids].set(
+                v[0].astype(vc.dtype).reshape(
+                    n_blk, bs, self.heads, self.head_dim))
+            return kc, vc, att
+
+        h, cache = self._run_layers(params, cache, h, kv_hook)
+        return cache, self._greedy_at(params, h, length - 1)
+
+    def paged_decode(self, params, cache, tables, tokens, positions,
+                     active):
+        """ONE decode step over every slot against the PAGED pool:
+        tables (slots, max_blocks) int32 block tables, the rest as
+        :meth:`decode`.  The block APPEND is fused into this program
+        — position ``p`` scatters into page ``tables[slot, p // BS]``
+        at offset ``p % BS`` (inactive slots route to the trash
+        block), and the attention read gathers through the table, so
+        one fixed-shape dispatch per step survives any allocation
+        state."""
+        slots = tokens.shape[0]
+        bs = cache["k"].shape[2]               # [L, NB, BS, h, dh]
+        h = (params["embed"][tokens]
+             + params["pos"][positions])[:, None, :]   # (slots, 1, d)
+        idx = jnp.arange(slots)
+        blk_idx = jnp.where(active, tables[idx, positions // bs], 0)
+        blk_off = jnp.where(active, positions % bs, 0)
+
+        def kv_hook(kc, vc, q, k, v):
+            kc = kc.at[blk_idx, blk_off].set(k[:, 0].astype(kc.dtype))
+            vc = vc.at[blk_idx, blk_off].set(v[:, 0].astype(vc.dtype))
+            att = paged_decode_attention(q, kc, vc, tables,
+                                         positions + 1,
+                                         use_pallas=self.use_pallas)
+            return kc, vc, att
+
+        h, cache = self._run_layers(params, cache, h, kv_hook)
+        return cache, self._greedy_rows(params, h)
+
+    # -- chunked prefill (one chunk per decode-step cadence) ---------------
+    def prefill_chunk(self, params, cache, tokens, slot, start,
+                      chunk_len):
+        """ONE chunk of a prompt through the CONTIGUOUS cache: tokens
+        (1, C) int32 (zero-padded past ``chunk_len`` on the final
+        chunk), writes K/V at [slot, start:start+C), attends the
+        chunk's queries causally against the slot's full cache row
+        (keys ≥ start+C are masked by the causal offset), returns
+        (cache', token) — the token is the greedy continuation and is
+        meaningful on the final chunk only."""
+        chunk = tokens.shape[1]
+        pos = jax.lax.dynamic_slice_in_dim(params["pos"], start, chunk)
+        h = params["embed"][tokens] + pos
+
+        def kv_hook(kc, vc, q, k, v):
+            kc = jax.lax.dynamic_update_slice(
+                kc, k[0].astype(kc.dtype)[None], (slot, start, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v[0].astype(vc.dtype)[None], (slot, start, 0, 0))
+            kf = jax.lax.dynamic_slice(
+                kc, (slot, 0, 0, 0), (1,) + kc.shape[1:])
+            vf = jax.lax.dynamic_slice(
+                vc, (slot, 0, 0, 0), (1,) + vc.shape[1:])
+            att = chunk_attention(q, kf, vf, start,
+                                  use_pallas=self.use_pallas)
+            return kc, vc, att
+
+        h, cache = self._run_layers(params, cache, h, kv_hook)
+        return cache, self._greedy_at(params, h, chunk_len - 1)
+
+    def paged_prefill_chunk(self, params, cache, tokens, chunk_ids,
+                            table, start, chunk_len):
+        """ONE chunk of a prompt through the PAGED pool: chunk_ids
+        (C // block_size,) int32 — the pages covering [start,
+        start+C) (trash 0 past the allocation); table (max_blocks,)
+        int32 — the sequence's full block table for the attention
+        gather.  Semantics otherwise identical to
+        :meth:`prefill_chunk`."""
+        n_blk = chunk_ids.shape[0]
+        bs = cache["k"].shape[2]               # [L, NB, BS, h, dh]
+        chunk = tokens.shape[1]
+        pos = jax.lax.dynamic_slice_in_dim(params["pos"], start, chunk)
+        h = params["embed"][tokens] + pos
+
+        def kv_hook(kc, vc, q, k, v):
+            kc = kc.at[chunk_ids].set(
+                k[0].astype(kc.dtype).reshape(
+                    n_blk, bs, self.heads, self.head_dim))
+            vc = vc.at[chunk_ids].set(
+                v[0].astype(vc.dtype).reshape(
+                    n_blk, bs, self.heads, self.head_dim))
+
+            def gather(c):
+                g = c[table]               # (max_blocks, bs, h, dh)
+                return g.reshape(1, g.shape[0] * bs,
+                                 self.heads, self.head_dim)
+
+            att = chunk_attention(q, gather(kc), gather(vc), start,
+                                  use_pallas=self.use_pallas)
+            return kc, vc, att
+
+        h, cache = self._run_layers(params, cache, h, kv_hook)
+        return cache, self._greedy_at(params, h, chunk_len - 1)
 
     # -- analytic FLOPs (cost_analysis counts the layer scan once) ---------
     def _per_token_layer_flops(self, attended):
@@ -233,6 +409,15 @@ class TransformerGenModel(object):
         per_token = self.layers * self._per_token_layer_flops(
             bucket / 2.0)
         return bucket * per_token + 2.0 * self.dim * self.vocab
+
+    def prefill_chunk_flops(self, chunk, max_seq):
+        """Forward FLOPs of one prefill chunk: each chunk token
+        attends to its whole prefix — counted at the ``max_seq / 2``
+        mean extent (start is traced, so the analytic form can't see
+        it) + one readout."""
+        per_token = self.layers * self._per_token_layer_flops(
+            max_seq / 2.0)
+        return chunk * per_token + 2.0 * self.dim * self.vocab
 
     def decode_flops(self, slots, max_seq):
         """FLOPs of one decode step: every slot reads its masked KV

@@ -1,0 +1,166 @@
+"""Block-pool paged KV cache: the allocation layer under the
+generative engine (ROADMAP item 3a — vLLM's PagedAttention applied to
+the slot engine).
+
+The contiguous engine reserves ``max_seq`` KV rows per slot for the
+slot's whole lifetime; a long-tail serving mix therefore pays
+worst-case HBM for every admission.  The paged engine instead owns ONE
+shared device pool — ``{"k", "v"}: [layers, num_blocks, block_size,
+heads, head_dim]``, registered in the HBM ledger's ``kv`` category
+exactly like the slot-major cache — and maps each sequence onto pages
+through a per-slot **block table** (int32 ``[slots, max_blocks]``,
+host-mirrored here, shipped to the device as a decode-program input).
+A sequence of ``n`` tokens holds ``ceil(n / block_size)`` pages, so
+capacity is priced by the OBSERVED mix rather than the worst case —
+V-S01 re-prices admission accordingly.
+
+Determinism is load-bearing: the free list stays **sorted** and every
+allocation pops the lowest ids, so the same request mix always lands
+in the same pages in the same order and the paged==contiguous parity
+gate stays bitwise.  Block id 0 is the **trash block** — never
+allocated, never read unmasked.  Table entries past a sequence's
+allocation, bucket-tail garbage writes, and decode-inactive slots'
+ride-along writes all route there, which is what lets every program
+keep a single fixed shape regardless of allocation state.
+
+:class:`PoolExhausted` is the admission/append failure the scheduler
+turns into preemption: free the YOUNGEST sequence's pages, requeue it
+at the queue front with its tokens-so-far (greedy decode of the prefix
+reproduces the stream — preemption is lossless), and retry.
+"""
+
+import bisect
+import math
+
+import numpy
+
+
+class PoolExhausted(RuntimeError):
+    """No free block in the pool — the scheduler's cue to preempt the
+    youngest sequence (or the caller's to shed load)."""
+
+    def __init__(self, message, needed=1, free=0):
+        super(PoolExhausted, self).__init__(message)
+        self.needed = int(needed)
+        self.free = int(free)
+
+
+class BlockPool(object):
+    """Host-side page accounting for one paged engine: the sorted free
+    list, per-slot block ownership, and the host mirror of the device
+    block tables.  Single scheduler thread — no locking, same as the
+    engine's slot bookkeeping."""
+
+    #: block id 0 — the write sink for everything that must not land
+    #: in a live page; never allocated, never read unmasked
+    TRASH = 0
+
+    def __init__(self, slots, max_blocks, num_blocks, block_size):
+        self.slots = int(slots)
+        self.max_blocks = int(max_blocks)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        if self.num_blocks < self.max_blocks + 1:
+            raise ValueError(
+                "num_blocks %d cannot hold one full sequence "
+                "(%d blocks) plus the trash block — the pool would "
+                "deadlock on its first long request"
+                % (self.num_blocks, self.max_blocks))
+        #: sorted free list; id 0 (trash) is never a member
+        self._free = list(range(1, self.num_blocks))
+        #: slot -> [block ids] in position order
+        self._owned = {}
+        #: host mirror of the device block tables; entries past a
+        #: slot's allocation stay TRASH
+        self.tables = numpy.zeros((self.slots, self.max_blocks),
+                                  numpy.int32)
+
+    # -- capacity ----------------------------------------------------------
+    @property
+    def blocks_total(self):
+        return self.num_blocks - 1
+
+    @property
+    def blocks_free(self):
+        return len(self._free)
+
+    @property
+    def blocks_used(self):
+        return self.blocks_total - len(self._free)
+
+    def blocks_for(self, n_tokens):
+        return max(1, int(math.ceil(n_tokens / float(self.block_size))))
+
+    def can_fit(self, n_tokens):
+        return self.blocks_for(n_tokens) <= len(self._free)
+
+    # -- allocation (lowest-id-first: deterministic) -----------------------
+    def _pop(self, count, what):
+        if count > len(self._free):
+            raise PoolExhausted(
+                "block pool exhausted: %s needs %d page(s), %d free "
+                "of %d" % (what, count, len(self._free),
+                           self.blocks_total),
+                needed=count, free=len(self._free))
+        ids, self._free = self._free[:count], self._free[count:]
+        return ids
+
+    def admit(self, slot, n_tokens):
+        """Allocate the pages for a freshly admitted ``n_tokens``
+        prefix and fill the slot's table row.  Returns the block ids
+        (position order)."""
+        if slot in self._owned:
+            raise ValueError("slot %d already owns pages" % slot)
+        ids = self._pop(self.blocks_for(n_tokens),
+                        "admitting slot %d" % slot)
+        self._owned[slot] = ids
+        self.tables[slot, :len(ids)] = ids
+        return ids
+
+    def append(self, slot, position):
+        """Ensure the page holding ``position`` exists — the decode
+        append: a new page is allocated exactly when the position
+        crosses a block boundary.  Returns True when a page was
+        allocated."""
+        owned = self._owned.get(slot)
+        if owned is None:
+            raise ValueError("slot %d owns no pages" % slot)
+        index = position // self.block_size
+        if index < len(owned):
+            return False
+        if index != len(owned):
+            raise ValueError(
+                "append at position %d skips pages (slot %d owns %d)"
+                % (position, slot, len(owned)))
+        (bid,) = self._pop(1, "appending to slot %d" % slot)
+        owned.append(bid)
+        self.tables[slot, index] = bid
+        return True
+
+    def needs_append(self, slot, position):
+        """True when decoding at ``position`` requires a page the slot
+        does not own yet (the scheduler's preemption probe)."""
+        owned = self._owned.get(slot)
+        return owned is not None and \
+            position // self.block_size >= len(owned)
+
+    def release(self, slot):
+        """Return the slot's pages to the free list (sorted — the
+        deterministic-allocation invariant) and reset its table row.
+        Returns the number of pages freed."""
+        ids = self._owned.pop(slot, None)
+        if ids is None:
+            return 0
+        for bid in ids:
+            bisect.insort(self._free, bid)
+        self.tables[slot, :] = self.TRASH
+        return len(ids)
+
+    def describe(self):
+        return {
+            "block_size": self.block_size,
+            "blocks_total": self.blocks_total,
+            "blocks_free": self.blocks_free,
+            "blocks_used": self.blocks_used,
+            "max_blocks_per_slot": self.max_blocks,
+        }

@@ -17,6 +17,16 @@ overflow — the HTTP layer's 503 path, same as the request/response
 batcher).  Tokens stream per request through ``on_token`` callbacks
 the moment the device returns them; the request future resolves with
 the full greedy token list at eviction.
+
+Against a PAGED engine (``veles_tpu.gen.paged``) the same loop gains
+three moves: admission is priced by the pool's ACTUAL headroom
+(``engine.can_admit`` — FIFO, no overtaking the head), a chunked
+prefill feeds exactly one chunk per step so co-resident decodes keep
+their cadence during long admissions, and pool exhaustion preempts
+the YOUNGEST sequence — pages freed, request requeued at the front
+with its tokens-so-far; greedy decode of the prefix replays the
+stream, so the preempted request's final token list is byte-identical
+to an uncontended run.
 """
 
 import collections
@@ -35,7 +45,7 @@ from veles_tpu.serve.batcher import QueueFull
 class GenRequest(object):
     __slots__ = ("tokens", "max_new_tokens", "future", "on_token",
                  "submitted", "first_token_at", "generated", "slot",
-                 "finish_reason")
+                 "finish_reason", "admit_seq", "preemptions")
 
     def __init__(self, tokens, max_new_tokens, on_token=None):
         self.tokens = tokens
@@ -47,6 +57,19 @@ class GenRequest(object):
         self.generated = []
         self.slot = None
         self.finish_reason = None
+        #: admission stamp — preemption evicts the YOUNGEST (largest)
+        self.admit_seq = -1
+        self.preemptions = 0
+
+    def prefix(self):
+        """The tokens a (re-)admission must prefill: the prompt plus
+        everything generated before a preemption.  Greedy decode of
+        the prefix reproduces the stream, so requeueing is lossless."""
+        if not self.generated:
+            return self.tokens
+        return numpy.concatenate([
+            numpy.asarray(self.tokens, numpy.int32),
+            numpy.asarray(self.generated, numpy.int32)])
 
 
 def finish_reason(engine, n_generated, max_new_tokens, token, slot):
@@ -83,7 +106,8 @@ class GenerativeScheduler(Logger):
         self.max_queue = int(max_queue)
         self.metrics = metrics
         self._queue = collections.deque()
-        self._active = {}            # slot -> GenRequest
+        self._active = {}            # slot -> decoding GenRequest
+        self._prefilling = {}        # slot -> chunk-admitting request
         self._cond = threading.Condition()
         self._stopped = False
         self._thread = None
@@ -94,6 +118,7 @@ class GenerativeScheduler(Logger):
         self.shed_total = 0
         self.decode_steps = 0
         self.decode_slot_steps = 0   # active rows summed over steps
+        self._admit_counter = 0
         #: submit → first streamed token (the prefill turnaround +
         #: queue wait): the latency generative SLOs are written against
         self.ttft = LatencyHistogram()
@@ -116,15 +141,34 @@ class GenerativeScheduler(Logger):
         metrics.register_gauge(
             "gen_ttft_p99_ms" + label,
             lambda: round(self.ttft.percentile(99) * 1e3, 3))
+        # the block-pool surface: preemptions + bytes-per-sequence in
+        # every kv mode, pool fill only where a pool exists
+        metrics.register_gauge(
+            "gen_preemptions_total" + label,
+            lambda: self.engine.preemptions_total)
+        metrics.register_gauge(
+            "gen_hbm_per_request_bytes" + label,
+            self.engine.hbm_per_request_bytes)
+        if getattr(self.engine, "kv_mode", "contiguous") == "paged":
+            metrics.register_gauge(
+                "gen_blocks_total" + label,
+                lambda: self.engine.blocks_total)
+            metrics.register_gauge(
+                "gen_blocks_free" + label,
+                lambda: self.engine.blocks_free)
         metrics.register_histogram("gen_ttft_seconds", self.ttft,
                                    "submit -> first generated token",
                                    labels={"model": self.name})
 
     def _unregister_gauges(self, metrics):
         label = '{model="%s"}' % self.name
-        for gauge in ("gen_queue_depth", "gen_slot_occupancy",
-                      "gen_admitted_total", "gen_tokens_total",
-                      "gen_batch_fill", "gen_ttft_p99_ms"):
+        gauges = ["gen_queue_depth", "gen_slot_occupancy",
+                  "gen_admitted_total", "gen_tokens_total",
+                  "gen_batch_fill", "gen_ttft_p99_ms",
+                  "gen_preemptions_total", "gen_hbm_per_request_bytes"]
+        if getattr(self.engine, "kv_mode", "contiguous") == "paged":
+            gauges += ["gen_blocks_total", "gen_blocks_free"]
+        for gauge in gauges:
             metrics.unregister_gauge(gauge + label)
         metrics.unregister_histogram("gen_ttft_seconds",
                                      labels={"model": self.name})
@@ -141,7 +185,7 @@ class GenerativeScheduler(Logger):
         return len(self._queue)
 
     def active_requests(self):
-        return len(self._active)
+        return len(self._active) + len(self._prefilling)
 
     # -- client side -------------------------------------------------------
     def submit(self, tokens, max_new_tokens=16, on_token=None):
@@ -155,7 +199,7 @@ class GenerativeScheduler(Logger):
             raise ValueError("max_new_tokens must be >= 1")
         if len(tokens) < 1:
             raise ValueError("empty prompt")
-        self.engine.bucket_for(len(tokens))    # raises when oversized
+        self.engine.check_prompt(len(tokens))  # raises when oversized
         if len(tokens) + max_new_tokens - 1 >= self.engine.max_seq:
             raise ValueError(
                 "prompt %d + max_new_tokens %d exceeds the engine's "
@@ -230,37 +274,115 @@ class GenerativeScheduler(Logger):
                           role="server")
         request.future.set_result(list(request.generated))
 
-    def step(self):
-        """One iteration: admit into every open slot, then one decode
-        dispatch over the active set.  Returns the number of tokens
-        emitted (0 = idle)."""
-        admitted = []
+    def _preempt(self, request):
+        """Pool-exhaustion eviction of the YOUNGEST sequence: free its
+        slot + pages, requeue it at the queue FRONT with its
+        tokens-so-far (greedy decode of the prefix reproduces the
+        stream — lossless), deterministically."""
+        slot = request.slot
+        self.engine.preempt(slot)
+        self._active.pop(slot, None)
+        self._prefilling.pop(slot, None)
+        request.slot = None
+        request.preemptions += 1
+        if trace.enabled():
+            trace.instant("gen", "preempt",
+                          {"slot": slot,
+                           "generated": len(request.generated)},
+                          role="server")
         with self._cond:
-            free = self.engine.free_slots
-            while self._queue and len(admitted) < free:
-                admitted.append(self._queue.popleft())
+            self._queue.appendleft(request)
+
+    def step(self):
+        """One iteration: admit while the engine has REAL headroom
+        (slots, and pool pages in paged mode), feed at most one chunk
+        per pending chunked prefill, preempt the youngest sequence on
+        pool exhaustion, then one decode dispatch over the active set.
+        Returns the amount of work done — tokens emitted plus chunks
+        fed (0 = idle)."""
         emitted = 0
-        for request in admitted:
+        while True:
+            # pop-and-admit one at a time: every admission updates the
+            # slot free list AND the pool headroom before the next
+            # request is priced, so co-admissions can never jointly
+            # overflow what can_admit approved individually
+            with self._cond:
+                if not self._queue:
+                    break
+                head = self._queue[0]
+                if not self.engine.can_admit(len(head.prefix())):
+                    break          # FIFO: no overtaking the head
+                request = self._queue.popleft()
             try:
-                slot, token = self.engine.prefill(request.tokens)
+                slot, token = self.engine.admit(request.prefix())
             except Exception as exc:  # noqa: BLE001 - per-request
-                # a failed prefill must fail THIS request's future —
+                # a failed admission must fail THIS request's future —
                 # it already left the queue, so nobody else will; the
-                # other admitted requests still get their attempt
-                self.exception("prefill failed; failing the request")
+                # next queued request still gets its attempt
+                self.exception("admission failed; failing the request")
                 if not request.future.done():
                     request.future.set_exception(exc)
                 continue
             request.slot = slot
-            self._active[slot] = request
+            self._admit_counter += 1
+            request.admit_seq = self._admit_counter
             self.admitted_total += 1
             if trace.enabled():
                 trace.instant("gen", "admit",
                               {"slot": slot,
-                               "prompt": len(request.tokens)},
+                               "prompt": len(request.tokens),
+                               "resumed": bool(request.generated)},
                               role="server")
-            self._emit(request, token)     # may evict immediately
-            emitted += 1
+            if token is None:
+                self._prefilling[slot] = request
+            else:
+                self._active[slot] = request
+                self._emit(request, token)   # may evict immediately
+                emitted += 1
+        # chunked-prefill cadence: ONE chunk per pending prompt per
+        # step — co-resident decodes below never wait for a whole
+        # admission
+        for slot in sorted(self._prefilling):
+            request = self._prefilling[slot]
+            try:
+                token = self.engine.prefill_step(slot)
+            except Exception as exc:  # noqa: BLE001 - per-request
+                self.exception("prefill chunk failed; failing the "
+                               "request")
+                del self._prefilling[slot]
+                try:
+                    self.engine.release_slot(slot)
+                except Exception:
+                    pass
+                if not request.future.done():
+                    request.future.set_exception(exc)
+                continue
+            emitted += 1                     # progress, not idle
+            if token is not None:
+                del self._prefilling[slot]
+                self._active[slot] = request
+                self._emit(request, token)
+        # safety net for the max_seq edge: a saturated slot decodes
+        # nothing — route it through the SHARED finish predicate (both
+        # kv modes) instead of crashing the batch
+        for slot, request in list(self._active.items()):
+            if self.engine.slot_len[slot] >= self.engine.max_seq:
+                last = request.generated[-1] if request.generated \
+                    else int(self.engine.slot_token[slot])
+                reason = finish_reason(
+                    self.engine, len(request.generated),
+                    request.max_new_tokens, last, slot) or "length"
+                self._finish(request, reason)
+        # pool exhaustion: preempt the youngest decoding sequence
+        # until the next decode step's pages fit
+        while self.engine.decode_block_deficit() > 0:
+            victims = [r for r in self._active.values()]
+            if not victims:
+                raise RuntimeError(
+                    "block pool deficit with no preemptible sequence "
+                    "— pool smaller than one step's working set")
+            self._preempt(max(victims, key=lambda r: r.admit_seq))
+            emitted += 1                     # progress, not idle
         if self._active:
             result = self.engine.decode_step()
             if result is not None:
@@ -276,7 +398,7 @@ class GenerativeScheduler(Logger):
     def run_until_idle(self, max_steps=100000):
         """Pump until queue and slots drain (manual mode)."""
         steps = 0
-        while self._queue or self._active:
+        while self._queue or self._active or self._prefilling:
             if self.step() == 0:
                 break
             steps += 1
@@ -303,7 +425,8 @@ class GenerativeScheduler(Logger):
             with self._cond:
                 if self._stopped:
                     return
-                if not self._queue and not self._active:
+                if not self._queue and not self._active \
+                        and not self._prefilling:
                     self._cond.wait(0.05)
                     if self._stopped:
                         return
@@ -313,8 +436,11 @@ class GenerativeScheduler(Logger):
                 # fail the inhabitants rather than silently wedging
                 self.exception("scheduler step failed; failing active "
                                "requests")
-                for slot, request in list(self._active.items()):
-                    self._active.pop(slot, None)
+                occupants = list(self._active.items()) \
+                    + list(self._prefilling.items())
+                self._active.clear()
+                self._prefilling.clear()
+                for slot, request in occupants:
                     try:
                         self.engine.release_slot(slot)
                     except Exception:
@@ -331,7 +457,8 @@ class GenerativeScheduler(Logger):
             # let the worker empty the pipeline
             while True:
                 with self._cond:
-                    idle = not self._queue and not self._active
+                    idle = not self._queue and not self._active \
+                        and not self._prefilling
                 if idle:
                     break
                 time.sleep(0.005)
@@ -352,8 +479,10 @@ class GenerativeScheduler(Logger):
         # admission) fails LOUDLY now — a pending future against a
         # stopped scheduler would otherwise block its client for the
         # full request timeout
-        for slot, request in list(self._active.items()):
+        for slot, request in (list(self._active.items())
+                              + list(self._prefilling.items())):
             self._active.pop(slot, None)
+            self._prefilling.pop(slot, None)
             try:
                 self.engine.release_slot(slot)
             except Exception:
@@ -367,7 +496,7 @@ class GenerativeScheduler(Logger):
     def describe(self):
         return {
             "queue_depth": len(self._queue),
-            "active_requests": len(self._active),
+            "active_requests": self.active_requests(),
             "admitted_total": self.admitted_total,
             "finished_total": self.finished_total,
             "tokens_total": self.tokens_total,

@@ -558,6 +558,191 @@ def decode_attention(q, k, v, lengths, block_k=None, use_pallas=None,
     return out[:, 0] if squeeze else out
 
 
+def _gather_pool(pool, tables):
+    """(num_blocks, BS, h, d) pool + (b, max_blocks) int32 tables →
+    the (b, max_blocks·BS, h, d) contiguous VIEW of each sequence.
+    Table entries past a sequence's allocation point at the trash
+    block (id 0), whose garbage lands beyond ``lengths`` and is
+    masked — when ``max_blocks·BS`` equals the contiguous engine's
+    ``max_seq`` the gathered buffer is value-identical to the
+    slot-major cache at every valid position, which is what keeps the
+    paged==contiguous parity gate bitwise on the dense path."""
+    g = pool[tables]                       # (b, mb, BS, h, d)
+    b, mb, bs = g.shape[:3]
+    return g.reshape(b, mb * bs, g.shape[3], g.shape[4])
+
+
+def _paged_decode_jnp(q, k_pool, v_pool, tables, lengths):
+    """Dense masked reference for the PAGED decode step: gather the
+    block pool through the block tables into the contiguous layout,
+    then run the exact :func:`_decode_jnp` math.  The oracle the
+    paged Pallas kernel is parity-tested against."""
+    return _decode_jnp(q, _gather_pool(k_pool, tables),
+                       _gather_pool(v_pool, tables), lengths)
+
+
+def _paged_decode_kernel(len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, n_b, scale,
+                         block_size, heads):
+    """Paged decode step: grid (batch*heads, max_blocks); the KV
+    blocks arrive ALREADY ROUTED by the block table — the BlockSpec
+    index map reads the scalar-prefetched ``tab_ref`` to aim each
+    grid step's DMA at ``tables[row, kk]`` in the shared pool (the
+    PagedAttention gather, done by the memory system instead of an
+    HBM materialization).  Everything else is :func:`_decode_kernel`:
+    per-row lengths scalar-prefetched, online-softmax scratch, and
+    blocks fully past the row's length skipped (their table entries
+    point at the trash block; the DMA still lands but the compute
+    does not run)."""
+    bh = pl.program_id(0)
+    kk = pl.program_id(1)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[bh // heads]
+    run = kk * block_size < length
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale       # (8, d)
+        k = k_ref[0, 0].astype(jnp.float32)            # (BS, d)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (8, BS)
+        k_pos = kk * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(k_pos < length, scores, NEG_INF)
+        m_prev = m_ref[...]
+        m_cur = jnp.max(scores, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + p.sum(axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(kk == n_b - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_decode_pallas(q, k_pool, v_pool, tables, lengths,
+                         interpret=False):
+    b, _sq, h, d = q.shape
+    block_size = k_pool.shape[1]
+    if block_size % 8:
+        raise ValueError(
+            "paged block_size %d breaks the kernel's 8-sublane "
+            "padding — use a multiple of 8" % block_size)
+    n_b = tables.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    q3 = _bhsd(q, b, h, d, 8)                   # (b·h, 8, d_p)
+    d_p = q3.shape[2]
+    # pool → (num_blocks, h, BS, d_p): per-(b·h, block) DMA units
+    def pool4(x):
+        x = jnp.moveaxis(x, 2, 1)               # (NB, h, BS, d)
+        return jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, d_p - d)))
+    k4, v4 = pool4(k_pool), pool4(v_pool)
+    grid = (b * h, n_b)
+    in_specs = [
+        pl.BlockSpec((1, 8, d_p),
+                     lambda bh, kk, lens, tabs: (bh, 0, 0)),
+        pl.BlockSpec((1, 1, block_size, d_p),
+                     lambda bh, kk, lens, tabs:
+                     (tabs[bh // h, kk], bh % h, 0, 0)),
+        pl.BlockSpec((1, 1, block_size, d_p),
+                     lambda bh, kk, lens, tabs:
+                     (tabs[bh // h, kk], bh % h, 0, 0)),
+    ]
+    out_spec = pl.BlockSpec((1, 8, d_p),
+                            lambda bh, kk, lens, tabs: (bh, 0, 0))
+    scratch = [
+        pltpu.VMEM((8, d_p), jnp.float32),
+        pltpu.VMEM((8, 1), jnp.float32),
+        pltpu.VMEM((8, 1), jnp.float32),
+    ]
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, n_b=n_b, scale=scale,
+                          block_size=block_size, heads=h),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2, grid=grid, in_specs=in_specs,
+            out_specs=out_spec, scratch_shapes=scratch),
+        out_shape=jax.ShapeDtypeStruct((b * h, 8, d_p), q.dtype),
+        compiler_params=_COMPILER_PARAMS(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(lengths, jnp.int32),
+      jnp.asarray(tables, jnp.int32), q3, k4, v4)
+    return jnp.moveaxis(out[:, :1, :d].reshape(b, h, 1, d), 1, 2)
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, lengths,
+                           use_pallas=None, interpret=None):
+    """Single-query attention against a PAGED KV pool — the decode hot
+    op of ``veles_tpu.gen``'s block-pool cache (ROADMAP item 3a).
+
+    ``q``: (b, 1, h, d) or (b, h, d); ``k_pool``/``v_pool``:
+    (num_blocks, block_size, h, d) shared pools; ``tables``: (b,
+    max_blocks) int32 — row ``i``'s sequence lives in blocks
+    ``tables[i]`` in order, entries past its allocation pointing at
+    the trash block 0; ``lengths``: (b,) int32 valid token counts.
+    Same row-independence contract as :func:`decode_attention` (the
+    continuous-batching parity substrate).  TPU takes the paged
+    Pallas kernel — the block table rides in scalar-prefetched and
+    routes each K/V block's DMA, so the gather never materializes in
+    HBM; elsewhere an XLA gather + the dense masked reference runs,
+    value-identical to the contiguous cache path at every valid
+    position."""
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    lengths = jnp.asarray(lengths, jnp.int32)
+    tables = jnp.asarray(tables, jnp.int32)
+    pallas = use_pallas if use_pallas is not None else _on_tpu()
+    if pallas:
+        if interpret is None:
+            from veles_tpu.config import root
+            interpret = bool(root.common.engine.get("interpret", False))
+        out = _paged_decode_pallas(q, k_pool, v_pool, tables, lengths,
+                                   interpret=interpret)
+    else:
+        out = _paged_decode_jnp(q, k_pool, v_pool, tables, lengths)
+    return out[:, 0] if squeeze else out
+
+
+def chunk_attention(q, k, v, start, use_pallas=None, interpret=None):
+    """Causal attention of ONE prefill chunk against the sequence's
+    full KV buffer — the chunked-prefill hot op.  ``q``: (1, C, h, d)
+    chunk queries whose global positions are ``start + i`` (``start``
+    may be a traced int32 — the chunk program stays fixed-shape);
+    ``k``/``v``: (1, S, h, d) the sequence's cache buffer (chunk K/V
+    already written at [start, start+C)).  Keys at or beyond
+    ``start + C`` are hidden by the causal offset mask, so the stale
+    tail of the cache can never leak into a chunk.  TPU rides the
+    flash kernel's scalar-prefetched q_offset path; elsewhere the
+    XLA-fused reference."""
+    pallas = _resolve_backend(use_pallas, q.dtype, q.shape)
+    if pallas:
+        if interpret is None:
+            from veles_tpu.config import root
+            interpret = bool(root.common.engine.get("interpret", False))
+        o, _lse = _flash_fwd(q, k, v, causal=True,
+                             q_offset=jnp.asarray(start, jnp.int32),
+                             k_offset=jnp.asarray(0, jnp.int32),
+                             interpret=interpret)
+        return o
+    o, _lse = _mha_jnp(q, k, v, True, q_offset=start)
+    return o
+
+
 def _mha_jnp(q, k, v, causal, q_offset=0, k_offset=0):
     """XLA-fused fallback (CPU / tiny shapes); returns (o, lse).
     ``q_offset``/``k_offset``: global causal positions of element 0
