@@ -1397,6 +1397,49 @@ def stage_transformer_gen():
     ttft_p99_ms = scheduler.ttft.percentile(99) * 1e3
     engine.close()
 
+    # tracing-on replay of the SAME workload on a fresh engine: the
+    # observability tax banked next to tokens/s (the ISSUE 13 0.95x
+    # gate reads this ratio), plus the trace-DERIVED queue-wait p99 —
+    # measured from the scheduler's per-request queue_wait phase
+    # spans, not a histogram, so it prices exactly what a waterfall
+    # shows
+    from veles_tpu import obs, trace
+    from veles_tpu.config import root as _root
+    from veles_tpu.trace import export as trace_export
+    saved_trace = _root.common.engine.get("trace", "off")
+    _root.common.engine.trace = "on"
+    trace.configure()
+    trace.recorder.clear()
+    try:
+        traced_engine = build()
+        traced_scheduler = GenerativeScheduler(traced_engine,
+                                               name="bench-traced")
+        traced_futures = []
+        tic = time.perf_counter()
+        for toks, max_new in workload:
+            with obs.activate(obs.mint()):
+                traced_futures.append(
+                    traced_scheduler.submit(toks, max_new))
+        traced_scheduler.run_until_idle()
+        traced_sec = time.perf_counter() - tic
+        assert all(f.done() for f in traced_futures)
+        traced_tokens = traced_scheduler.tokens_total
+        waits = sorted(
+            ev["dur_us"] / 1e3 for ev in trace_export.normalize()
+            if ev["ph"] == "X" and ev["cat"] == "gen"
+            and ev["name"] == "queue_wait")
+        queue_wait_p99_ms = (
+            waits[min(len(waits) - 1, int(0.99 * len(waits)))]
+            if waits else None)
+        traced_engine.close()
+    finally:
+        # restore BEFORE later stages run: a failure here must not
+        # leave tracing armed under their timed regions
+        _root.common.engine.trace = saved_trace
+        trace.configure()
+        trace.recorder.clear()
+    traced_tps = traced_tokens / traced_sec if traced_sec else 0.0
+
     static_engine = build()
     tic = time.perf_counter()
     results, _steps = static_generate(static_engine, workload)
@@ -1415,6 +1458,12 @@ def stage_transformer_gen():
         "vs_baseline": None,
         "batch_fill": round(fill, 4),
         "ttft_p99_ms": round(ttft_p99_ms, 2),
+        "queue_wait_p99_ms": round(queue_wait_p99_ms, 3)
+                             if queue_wait_p99_ms is not None
+                             else None,
+        "tracing_overhead_x": round(traced_tps / cont_tps, 3)
+                              if cont_tps else None,
+        "tracing_on_tokens_per_sec": round(traced_tps, 1),
         "vs_static_x": round(cont_tps / static_tps, 3)
                        if static_tps else None,
         "static_tokens_per_sec": round(static_tps, 1),

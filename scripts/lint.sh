@@ -57,6 +57,17 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m veles_tpu.chaos --smoke
 # KV)
 echo "== gen smoke (generative serving + paged KV gate) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python -m veles_tpu.gen --smoke
+# obs smoke: the fleet-observability gate — with tracing off every
+# obs hook must be the PR 5 one-attribute-check no-op; then ONE
+# traced request must cross server -> scheduler -> engine -> a
+# scripted master/slave ZMQ session with its trace id in >=3 role
+# lanes of one prof-merged Perfetto timeline (flow arrows included),
+# the master scrape endpoint must serve the per-slave round-trip
+# histograms, and SLO evaluation over a synthetic breaching series
+# must fire exactly the expected multi-window burn alerts
+# (docs/observability.md § Request tracing & SLOs)
+echo "== obs smoke (request tracing + SLO gate) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m veles_tpu.obs --smoke
 # pod smoke: an 8-shard CPU session (one pod = one pjit'd stitched
 # program) must train the seeded sample to completion with ZERO
 # per-step gradient/update frames on the ZMQ wire (chaos wire-site

@@ -201,6 +201,23 @@ root.common.update({
         # the reference's --slave-death-probability (client.py:303)
         "slave_death_probability": 0.0,
     },
+    # Fleet observability (veles_tpu.obs).  "slo" declares windowed
+    # objectives per signal name: {"max"|"min": bound, "window_s",
+    # "fast_window_s", "target", "burn_threshold"} — evaluated by the
+    # serving SLO engine with multi-window burn rates; the three
+    # autoscaling signals (queue depth, batch fill, TTFT p99 burn
+    # rate) export on /metrics regardless.  "blackbox_dir" non-empty
+    # arms the flight recorder: fatal exits (unhandled exception,
+    # SIGTERM, chaos kills) dump the live trace ring + ledger summary
+    # there as a loadable post-mortem (obs.blackbox.load).
+    "obs": {
+        "slo": {
+            "ttft_p99_ms": {"max": 500.0, "window_s": 60.0,
+                            "fast_window_s": 5.0, "target": 0.99,
+                            "burn_threshold": 2.0},
+        },
+        "blackbox_dir": "",
+    },
     # Serving robustness: a batched `infer` exceeding this deadline
     # fails the batch's futures with serve.batcher.InferDeadlineExceeded
     # (HTTP 500) instead of blocking every queued client forever.

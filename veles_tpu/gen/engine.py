@@ -45,6 +45,7 @@ import time
 import numpy
 
 from veles_tpu import prof, trace
+from veles_tpu.obs import context as obs_context
 from veles_tpu.logger import Logger
 
 #: per-process engine sequence for performance-ledger entry names
@@ -210,6 +211,11 @@ class GenerativeEngine(Logger):
         self._free = list(range(self.max_slots))
         #: slot -> in-flight chunked-prefill state
         self._chunking = {}
+        #: slot -> occupant's distributed-trace id (None untraced) —
+        #: stamped at admission from the ambient obs context so the
+        #: shared decode dispatch span can name which requests each
+        #: device call served
+        self.slot_trace = [None] * self.max_slots
 
         self._prefill_exe = {}
         self._chunk_exe = None
@@ -469,6 +475,7 @@ class GenerativeEngine(Logger):
             raise ValueError("slot %d is not active" % slot)
         self.slot_active[slot] = False
         self.slot_len[slot] = 0
+        self.slot_trace[slot] = None
         if self._pool is not None:
             self._pool.release(slot)
         # keep admission deterministic: the free list stays sorted so
@@ -525,9 +532,11 @@ class GenerativeEngine(Logger):
         padded[:n] = tokens
         exe, entry = self._prefill_executable(bucket)
         self.prefill_calls += 1
+        self.slot_trace[slot] = obs_context.current_trace_id()
         with trace.span("gen", "prefill",
-                        {"bucket": bucket, "slot": slot, "len": n,
-                         "engine": self.prof_name}, role="server"):
+                        obs_context.tag(
+                            {"bucket": bucket, "slot": slot, "len": n,
+                             "engine": self.prof_name}), role="server"):
             tic = time.perf_counter_ns()
             if self._pool is not None:
                 self._cache, tok = exe(self._params, self._cache,
@@ -573,6 +582,7 @@ class GenerativeEngine(Logger):
         padded = numpy.zeros(_round_up(n, chunk), numpy.int32)
         padded[:n] = tokens
         self._chunking[slot] = {"tokens": padded, "n": n, "done": 0}
+        self.slot_trace[slot] = obs_context.current_trace_id()
         return slot, None
 
     def prefill_step(self, slot):
@@ -588,8 +598,10 @@ class GenerativeEngine(Logger):
         exe, entry = self._chunk_executable()
         self.prefill_calls += 1
         with trace.span("gen", "prefill_chunk",
-                        {"slot": slot, "start": start,
-                         "len": chunk_len, "engine": self.prof_name},
+                        obs_context.tag(
+                            {"slot": slot, "start": start,
+                             "len": chunk_len,
+                             "engine": self.prof_name}),
                         role="server"):
             tic = time.perf_counter_ns()
             if self._pool is not None:
@@ -647,9 +659,16 @@ class GenerativeEngine(Logger):
         exe, entry = self._decode_executable()
         self.decode_calls += 1
         n_active = int(active.sum())
-        with trace.span("gen", "decode",
-                        {"active": n_active, "engine": self.prof_name},
-                        role="server"):
+        decode_args = {"active": n_active, "engine": self.prof_name}
+        if trace.enabled():
+            # which requests this shared dispatch decoded — the decode
+            # half of every co-resident's waterfall, one span (plain
+            # loop: max_slots is small and this runs per decode step)
+            traces = sorted({t for s, t in enumerate(self.slot_trace)
+                             if t is not None and active[s]})
+            if traces:
+                decode_args["traces"] = traces
+        with trace.span("gen", "decode", decode_args, role="server"):
             tic = time.perf_counter_ns()
             if self._pool is not None:
                 self._cache, out = exe(self._params, self._cache,

@@ -39,15 +39,18 @@ import numpy
 from veles_tpu import trace
 from veles_tpu.logger import Logger
 from veles_tpu.metrics import LatencyHistogram
+from veles_tpu.obs import context as obs_context
 from veles_tpu.serve.batcher import QueueFull
 
 
 class GenRequest(object):
     __slots__ = ("tokens", "max_new_tokens", "future", "on_token",
                  "submitted", "first_token_at", "generated", "slot",
-                 "finish_reason", "admit_seq", "preemptions")
+                 "finish_reason", "admit_seq", "preemptions", "ctx",
+                 "queued_at", "admitted_at")
 
-    def __init__(self, tokens, max_new_tokens, on_token=None):
+    def __init__(self, tokens, max_new_tokens, on_token=None,
+                 ctx=None):
         self.tokens = tokens
         self.max_new_tokens = int(max_new_tokens)
         self.future = Future()
@@ -60,6 +63,21 @@ class GenRequest(object):
         #: admission stamp — preemption evicts the YOUNGEST (largest)
         self.admit_seq = -1
         self.preemptions = 0
+        #: distributed-trace context captured at submit (None when
+        #: tracing is off) — every span of this request's waterfall
+        #: carries its ids across the thread handoff
+        self.ctx = ctx
+        #: start of the CURRENT queue residence (submit, then each
+        #: preemption requeue) — the queue_wait phase span's begin
+        self.queued_at = self.submitted
+        self.admitted_at = None
+
+    def span_args(self, args=None):
+        """``args`` tagged with this request's trace identity (the
+        dict unchanged when untraced)."""
+        if self.ctx is None:
+            return args
+        return self.ctx.span_args(args)
 
     def prefix(self):
         """The tokens a (re-)admission must prefill: the prompt plus
@@ -205,7 +223,8 @@ class GenerativeScheduler(Logger):
                 "prompt %d + max_new_tokens %d exceeds the engine's "
                 "max_seq %d KV slot" % (len(tokens), max_new_tokens,
                                         self.engine.max_seq))
-        request = GenRequest(tokens, max_new_tokens, on_token)
+        request = GenRequest(tokens, max_new_tokens, on_token,
+                             ctx=obs_context.current())
         with self._cond:
             if self._stopped:
                 raise RuntimeError("scheduler is stopped")
@@ -220,8 +239,10 @@ class GenerativeScheduler(Logger):
             self._cond.notify()
         if trace.enabled():
             trace.instant("gen", "enqueue",
-                          {"prompt": len(tokens),
-                           "max_new": max_new_tokens}, role="server")
+                          request.span_args(
+                              {"prompt": len(tokens),
+                               "max_new": max_new_tokens}),
+                          role="server")
         return request.future
 
     def generate(self, tokens, max_new_tokens=16, timeout=120.0,
@@ -248,6 +269,18 @@ class GenerativeScheduler(Logger):
             request.first_token_at = time.perf_counter()
             self.ttft.record(request.first_token_at
                              - request.submitted)
+            if trace.enabled() and request.admitted_at is not None:
+                # the prefill phase of this request's waterfall:
+                # admission → first token (whole-bucket dispatch or
+                # the chunked cadence, whichever ran)
+                trace.complete(
+                    "gen", "prefill_phase",
+                    int(request.admitted_at * 1e9),
+                    int((request.first_token_at
+                         - request.admitted_at) * 1e9),
+                    request.span_args({"slot": request.slot,
+                                       "prompt": len(request.tokens)}),
+                    role="server")
         self.tokens_total += 1
         if request.on_token is not None:
             try:
@@ -268,10 +301,33 @@ class GenerativeScheduler(Logger):
         self._active.pop(request.slot, None)
         self.finished_total += 1
         if trace.enabled():
+            now = time.perf_counter()
             trace.instant("gen", "evict",
-                          {"slot": request.slot, "reason": reason,
-                           "tokens": len(request.generated)},
+                          request.span_args(
+                              {"slot": request.slot, "reason": reason,
+                               "tokens": len(request.generated)}),
                           role="server")
+            if request.first_token_at is not None \
+                    and now > request.first_token_at:
+                # the decode phase: first token → eviction
+                trace.complete(
+                    "gen", "decode_phase",
+                    int(request.first_token_at * 1e9),
+                    int((now - request.first_token_at) * 1e9),
+                    request.span_args({"slot": request.slot,
+                                       "tokens":
+                                       len(request.generated)}),
+                    role="server")
+            # the whole request: submit → resolution (encloses the
+            # queue_wait / prefill_phase / decode_phase spans)
+            trace.complete(
+                "gen", "request", int(request.submitted * 1e9),
+                int((now - request.submitted) * 1e9),
+                request.span_args({"reason": reason,
+                                   "tokens": len(request.generated),
+                                   "preemptions":
+                                   request.preemptions}),
+                role="server")
         request.future.set_result(list(request.generated))
 
     def _preempt(self, request):
@@ -285,10 +341,12 @@ class GenerativeScheduler(Logger):
         self._prefilling.pop(slot, None)
         request.slot = None
         request.preemptions += 1
+        request.queued_at = time.perf_counter()
         if trace.enabled():
             trace.instant("gen", "preempt",
-                          {"slot": slot,
-                           "generated": len(request.generated)},
+                          request.span_args(
+                              {"slot": slot,
+                               "generated": len(request.generated)}),
                           role="server")
         with self._cond:
             self._queue.appendleft(request)
@@ -314,7 +372,11 @@ class GenerativeScheduler(Logger):
                     break          # FIFO: no overtaking the head
                 request = self._queue.popleft()
             try:
-                slot, token = self.engine.admit(request.prefix())
+                # activate the request's trace context so the
+                # engine's own dispatch spans (prefill /
+                # prefill_chunk) carry its identity
+                with obs_context.activate(request.ctx):
+                    slot, token = self.engine.admit(request.prefix())
             except Exception as exc:  # noqa: BLE001 - per-request
                 # a failed admission must fail THIS request's future —
                 # it already left the queue, so nobody else will; the
@@ -324,15 +386,28 @@ class GenerativeScheduler(Logger):
                     request.future.set_exception(exc)
                 continue
             request.slot = slot
+            request.admitted_at = time.perf_counter()
             self._admit_counter += 1
             request.admit_seq = self._admit_counter
             self.admitted_total += 1
             if trace.enabled():
                 trace.instant("gen", "admit",
-                              {"slot": slot,
-                               "prompt": len(request.tokens),
-                               "resumed": bool(request.generated)},
+                              request.span_args(
+                                  {"slot": slot,
+                                   "prompt": len(request.tokens),
+                                   "resumed":
+                                   bool(request.generated)}),
                               role="server")
+                # the queue-wait phase: (re-)enqueue → admission
+                trace.complete(
+                    "gen", "queue_wait",
+                    int(request.queued_at * 1e9),
+                    int((request.admitted_at
+                         - request.queued_at) * 1e9),
+                    request.span_args({"slot": slot,
+                                       "resumed":
+                                       bool(request.generated)}),
+                    role="server")
             if token is None:
                 self._prefilling[slot] = request
             else:
@@ -345,7 +420,8 @@ class GenerativeScheduler(Logger):
         for slot in sorted(self._prefilling):
             request = self._prefilling[slot]
             try:
-                token = self.engine.prefill_step(slot)
+                with obs_context.activate(request.ctx):
+                    token = self.engine.prefill_step(slot)
             except Exception as exc:  # noqa: BLE001 - per-request
                 self.exception("prefill chunk failed; failing the "
                                "request")

@@ -229,6 +229,10 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         # arm the controller programmatically instead
         from veles_tpu import chaos
         chaos.configure()
+        # arm the observability plane's knobs the same way (currently
+        # the root.common.obs.blackbox_dir flight recorder)
+        from veles_tpu import obs
+        obs.configure()
         from veles_tpu.backends import make_device
         spec = "numpy" if self.is_master else self.device_spec
         self.device = kwargs.pop("device", None) or make_device(spec)

@@ -88,3 +88,32 @@ class LatencyHistogram(object):
                 return lo + (hi - lo) * frac
             seen += c
         return self.BOUNDS[-1]
+
+
+def emit_histogram(lines, name, hist, help_, labels=None):
+    """Append one :class:`LatencyHistogram`'s full Prometheus
+    histogram exposition under the FULL metric name ``name``:
+    cumulative ``le``-labeled buckets + ``_sum``/``_count``, one
+    contiguous family.  ``help_=None`` skips the HELP/TYPE header —
+    for callers grouping several label variants under one family
+    header (a second TYPE line for the same name is a text-format
+    parse error that kills the whole scrape).
+
+    This is THE one exposition implementation: the serving
+    ``/metrics`` page and the per-role scrape endpoints (the job
+    master's per-slave round-trip histograms) both render through it,
+    so every role's histogram families parse identically."""
+    bounds, cum, total, count = hist.cumulative()
+    prefix = "".join('%s="%s",' % (k, v) for k, v in
+                     sorted((labels or {}).items()))
+    suffix = ("{%s}" % prefix.rstrip(",")) if prefix else ""
+    if help_ is not None:
+        lines.append("# HELP %s %s" % (name, help_))
+        lines.append("# TYPE %s histogram" % name)
+    for bound, c in zip(bounds, cum):
+        lines.append('%s_bucket{%sle="%.6g"} %d'
+                     % (name, prefix, bound, c))
+    lines.append('%s_bucket{%sle="+Inf"} %d' % (name, prefix, count))
+    lines.append("%s_sum%s %.6f" % (name, suffix, total))
+    lines.append("%s_count%s %d" % (name, suffix, count))
+    return lines

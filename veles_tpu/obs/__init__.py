@@ -1,0 +1,53 @@
+"""veles_tpu.obs — the fleet observability plane.
+
+PR 5–6 built the process-local substrate (span ring, perf ledger);
+this package makes it FLEET-wide, the way the reference platform's
+always-on status plane was (every node fed the web status server,
+PAPER.md §0):
+
+1. **Distributed request tracing** (:mod:`~veles_tpu.obs.context`) —
+   a W3C-compatible trace context minted at the serving front door,
+   carried across thread handoffs on request objects and across the
+   ZMQ job wire in frame fields, so ``prof merge`` stitches ONE
+   cross-process waterfall per request (queue wait, batch-fill wait,
+   prefill chunks, decode steps, preemptions) with Perfetto flow
+   arrows between role lanes.
+2. **Per-role scrape endpoints** (:mod:`~veles_tpu.obs.scrape`) —
+   a tiny shared ``/metrics`` listener mounted on the job master
+   (per-slave latency histograms, heartbeat-stall counters,
+   exactly-once accounting), slaves and pod workers — every role
+   Prometheus-scrapeable, not just the serving server.
+3. **An SLO engine** (:mod:`~veles_tpu.obs.slo`) — fixed-capacity
+   time-series rings over the existing metric sources, windowed
+   objectives from ``root.common.obs.slo.*``, multi-window burn-rate
+   evaluation, and the three ROADMAP autoscaling signals (queue
+   depth, batch fill, TTFT p99 burn rate) exported on ``/metrics``
+   and in ``describe()``.
+4. **A flight recorder** (:mod:`~veles_tpu.obs.blackbox`) — fatal
+   exits dump the live trace ring + ledger summary to
+   ``root.common.obs.blackbox_dir`` as a loadable post-mortem.
+
+The disabled path keeps the PR 5 contract: with tracing off, every
+context hook is one attribute check returning a shared no-op.
+
+See ``docs/observability.md`` § Request tracing & SLOs.
+"""
+
+from veles_tpu.obs import blackbox, context, scrape, slo  # noqa: F401
+from veles_tpu.obs.context import (  # noqa: F401
+    NULL_CONTEXT, TraceContext, activate, current, current_trace_id,
+    ingress, mint, parse, role_lanes, set_process, spans_of, tag,
+    waterfall_text, wire_extract, wire_inject)
+from veles_tpu.obs.scrape import ScrapeServer, default_sources  # noqa: F401
+from veles_tpu.obs.slo import (  # noqa: F401
+    AUTOSCALING_SIGNALS, Objective, SeriesRing, SLOEngine,
+    standard_engine)
+
+
+def configure():
+    """Apply the ``root.common.obs.*`` knobs (re-read at the same
+    boundaries trace/chaos re-read theirs — ``Workflow.initialize``,
+    the launcher): currently arms the flight recorder when
+    ``blackbox_dir`` is set.  Tracing itself stays under the PR 5
+    ``root.common.engine.trace`` knob."""
+    return blackbox.configure()

@@ -1,0 +1,336 @@
+"""``python -m veles_tpu.obs --smoke`` — the fleet-observability gate.
+
+Wired into ``scripts/lint.sh`` next to the prof/chaos/gen/pod smokes.
+Four phases, each a hard gate:
+
+1. **Disabled-path contract** — with tracing off, every obs hook is
+   the PR 5 no-op: ``ingress`` returns ``None``, ``activate(None)``
+   is the shared singleton, ``tag``/``wire_inject`` hand their
+   argument back untouched.
+2. **End-to-end request identity** — ONE traced ``POST /generate``
+   (W3C ``traceparent`` in, echoed back out) must stamp its trace id
+   on spans from the HTTP server, the scheduler's phase spans
+   (queue_wait / prefill / decode) and the engine dispatch.
+3. **Cross-process stitch** — a scripted master–slave ZMQ session
+   under the same trace id, merged via ``prof merge``, must show the
+   id in ≥3 role lanes of ONE Perfetto timeline (server + master +
+   slave-<sid>), with flow events binding them; the master scrape
+   endpoint must serve the per-slave latency histograms.
+4. **SLO engine** — a synthetic breaching TTFT series must fire
+   EXACTLY the expected multi-window burn alerts (one raised edge,
+   co-declared healthy objectives silent, recovery clears it).
+
+Exit code 0 on success; any violation prints the failure and exits 1.
+"""
+
+import argparse
+import json
+import sys
+
+
+def make_parser():
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu.obs",
+        description="Fleet-observability smoke gate (request tracing "
+                    "-> cross-process merge -> scrape endpoints -> "
+                    "SLO burn alerts).")
+    parser.add_argument("--smoke", action="store_true",
+                        help="run the CI smoke gate")
+    return parser
+
+
+class _ScriptedMaster(object):
+    def __init__(self, n_jobs=3):
+        self.n_jobs = n_jobs
+        self.served = 0
+        self.updates = []
+
+    def checksum(self):
+        return "obs-smoke-v1"
+
+    def generate_data_for_slave(self, slave):
+        if self.served >= self.n_jobs:
+            return None
+        self.served += 1
+        return {"job_number": self.served}
+
+    def apply_data_from_slave(self, data, slave):
+        self.updates.append(data)
+
+    def drop_slave(self, slave):
+        pass
+
+
+class _ScriptedSlave(object):
+    def checksum(self):
+        return "obs-smoke-v1"
+
+    def do_job(self, data, callback):
+        callback({"result": data["job_number"]})
+
+
+def _check_disabled_path():
+    from veles_tpu import obs, trace
+    failed = 0
+    if trace.enabled():
+        print("FAIL[disabled]: tracing must start off")
+        return 1
+    if obs.ingress("00-" + "ab" * 16 + "-" + "cd" * 8 + "-01") \
+            is not None:
+        print("FAIL[disabled]: ingress must return None when "
+              "tracing is off")
+        failed += 1
+    if obs.activate(None) is not obs.NULL_CONTEXT:
+        print("FAIL[disabled]: activate(None) must be the shared "
+              "no-op singleton")
+        failed += 1
+    args = {"k": 1}
+    if obs.tag(args) is not args:
+        print("FAIL[disabled]: tag() must hand its argument back "
+              "untouched")
+        failed += 1
+    msg = {"op": "job"}
+    if obs.wire_inject(msg) is not msg or "tp" in msg:
+        print("FAIL[disabled]: wire_inject must not stamp disabled "
+              "frames")
+        failed += 1
+    if obs.current() is not None:
+        print("FAIL[disabled]: no current context when tracing is "
+              "off")
+        failed += 1
+    return failed
+
+
+def _traced_request(tmpdir):
+    """Phases 2+3: the traced request + the scripted ZMQ session,
+    one merged timeline.  Returns (failed, trace_id)."""
+    import urllib.request
+
+    from veles_tpu import obs, prof, trace
+    from veles_tpu.gen import GenerativeEngine, TransformerGenModel
+    from veles_tpu.parallel.jobs import JobClient, JobServer
+    from veles_tpu.samples.transformer import TINY
+    from veles_tpu.serve.registry import ModelRegistry
+    from veles_tpu.serve.server import ServingServer
+    from veles_tpu.trace import export
+
+    failed = 0
+    engine = GenerativeEngine(
+        TransformerGenModel(dict(TINY, seq_len=64)), max_slots=2,
+        max_seq=48, prefill_buckets=(8,), seed=0).warmup()
+    registry = ModelRegistry()
+    registry.deploy_generative("default", engine, warmup=False)
+    server = ServingServer(registry=registry).start()
+    inbound = obs.mint()
+    try:
+        req = urllib.request.Request(
+            "http://%s:%d/generate" % (server.host, server.port),
+            data=json.dumps({"tokens": [1, 2, 3],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": inbound.traceparent()})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            reply = json.loads(resp.read())
+            echoed = resp.headers.get("traceparent")
+    finally:
+        server.stop(stop_registry=False)
+    if len(reply.get("tokens", ())) != 4:
+        print("FAIL[request]: wanted 4 tokens, got %r" % reply)
+        failed += 1
+    trace_id = inbound.trace_id
+    if not echoed or trace_id not in echoed:
+        print("FAIL[request]: traceparent not echoed (got %r)"
+              % echoed)
+        failed += 1
+
+    # the request's in-process waterfall: server ingress span, the
+    # scheduler's phase spans, the engine dispatch
+    events = export.normalize()
+    cats_names = {(ev["cat"], ev["name"])
+                  for ev in obs.spans_of(events, trace_id)}
+    for want in (("serve", "http"), ("gen", "queue_wait"),
+                 ("gen", "prefill"), ("gen", "decode")):
+        if want not in cats_names:
+            print("FAIL[request]: span %s:%s missing from the "
+                  "request waterfall (have %s)"
+                  % (want[0], want[1], sorted(cats_names)))
+            failed += 1
+
+    # phase 3: the same trace id crosses the ZMQ job wire — a session
+    # context (process default) stamps every job the master mints
+    session_ctx = obs.parse(inbound.traceparent())
+    obs.set_process(session_ctx)
+    master = _ScriptedMaster(n_jobs=3)
+    job_server = JobServer(master).start()
+    scrape = job_server.start_scrape()
+    try:
+        client = JobClient(_ScriptedSlave(), job_server.endpoint)
+        client.handshake()
+        if not client.run():
+            print("FAIL[session]: scripted slave did not complete")
+            failed += 1
+        # scrape while the slave is still a member: its send->update
+        # round-trip histogram must render as a real Prometheus family
+        scrape_url = "http://%s:%d/metrics" % (scrape.host,
+                                               scrape.port)
+        with urllib.request.urlopen(scrape_url, timeout=10) as resp:
+            page = resp.read().decode()
+        for needle in ("veles_jobs_job_latency_seconds_bucket",
+                       "veles_jobs_heartbeat_stalls_total",
+                       "veles_jobs_updates_applied_total 3",
+                       "veles_prof_compiles_total"):
+            if needle not in page:
+                print("FAIL[scrape]: %r missing from the master "
+                      "scrape endpoint" % needle)
+                failed += 1
+        client.close()
+        bundle_path = tmpdir + "/session.json"
+        job_server.save_session_profile(bundle_path,
+                                        roles=("master", "server"))
+    finally:
+        obs.set_process(None)
+        job_server.stop()
+        registry.stop(drain=False)
+
+    bundle = prof.merge.load(bundle_path)
+    merged = prof.merge.merged_events(bundle)
+    lanes = obs.role_lanes(merged, trace_id)
+    if len(lanes) < 3:
+        print("FAIL[merge]: trace id in %d role lane(s), want >=3: %r"
+              % (len(lanes), sorted(lanes)))
+        failed += 1
+    if "master" not in lanes or "server" not in lanes \
+            or not any(r.startswith("slave-") for r in lanes):
+        print("FAIL[merge]: want server+master+slave lanes, got %r"
+              % sorted(lanes))
+        failed += 1
+    merged_path = tmpdir + "/merged.json"
+    prof.merge.save_merged(bundle, merged_path)
+    with open(merged_path) as fin:
+        raw = json.load(fin)["traceEvents"]
+    flows = [ev for ev in raw if ev.get("ph") in ("s", "t")
+             and ev.get("id") == trace_id]
+    if len(flows) < 3:
+        print("FAIL[merge]: %d flow event(s) for the trace, want the "
+              "cross-lane waterfall arrows" % len(flows))
+        failed += 1
+    print("obs smoke: trace %s in %d role lanes (%s), %d flow "
+          "arrows, master scrape ok"
+          % (trace_id[:8], len(lanes),
+             ", ".join(sorted(lanes)), len(flows)))
+    print(obs.waterfall_text(merged, trace_id).rstrip())
+    return failed, trace_id
+
+
+def _check_slo():
+    from veles_tpu.obs.slo import Objective, SLOEngine
+    failed = 0
+    engine = SLOEngine()
+    ttft = engine.add_signal("ttft_p99_ms", lambda: 0.0)
+    depth = engine.add_signal("queue_depth", lambda: 0.0)
+    engine.add_objective(Objective(
+        "ttft_p99_ms", 200.0, window_s=60.0, fast_window_s=5.0,
+        target=0.9, burn_threshold=2.0))
+    engine.add_objective(Objective(
+        "queue_depth", 100.0, window_s=60.0, fast_window_s=5.0,
+        target=0.9, burn_threshold=2.0))
+    now = 10000.0
+    for i in range(60):      # healthy minute, both signals
+        ttft.append(100.0, t=now - 60 + i)
+        depth.append(3.0, t=now - 60 + i)
+    results = {r["objective"]: r for r in engine.evaluate(now=now)}
+    if any(r["alerting"] for r in results.values()):
+        print("FAIL[slo]: healthy series must not alert: %r"
+              % results)
+        failed += 1
+    # breach: the last 30 s of TTFT blow the bound — slow-window
+    # compliance 0.5 -> burn 5.0, fast window all bad -> burn 10.0
+    now += 30
+    for i in range(30):
+        ttft.append(500.0, t=now - 30 + i)
+        depth.append(3.0, t=now - 30 + i)
+    results = {r["objective"]: r for r in engine.evaluate(now=now)}
+    ttft_res = [r for name, r in results.items()
+                if "ttft" in name][0]
+    depth_res = [r for name, r in results.items()
+                 if "queue_depth" in name][0]
+    if not ttft_res["alerting"]:
+        print("FAIL[slo]: breaching TTFT series must alert: %r"
+              % ttft_res)
+        failed += 1
+    if abs(ttft_res["fast_burn"] - 10.0) > 1e-6 \
+            or abs(ttft_res["slow_burn"] - 5.0) > 1e-6:
+        print("FAIL[slo]: burn rates off: fast %r (want 10.0), "
+              "slow %r (want 5.0)"
+              % (ttft_res["fast_burn"], ttft_res["slow_burn"]))
+        failed += 1
+    if depth_res["alerting"]:
+        print("FAIL[slo]: healthy queue-depth objective must stay "
+              "silent: %r" % depth_res)
+        failed += 1
+    if engine.alerts_total != 1:
+        print("FAIL[slo]: exactly one raised alert edge expected, "
+              "got %d" % engine.alerts_total)
+        failed += 1
+    engine.evaluate(now=now)   # still alerting: no second edge
+    if engine.alerts_total != 1:
+        print("FAIL[slo]: re-evaluation must not re-count a standing "
+              "alert (got %d)" % engine.alerts_total)
+        failed += 1
+    # recovery: a healthy minute clears it
+    now += 90
+    for i in range(60):
+        ttft.append(100.0, t=now - 60 + i)
+    results = {r["objective"]: r for r in engine.evaluate(now=now)}
+    if any(r["alerting"] for r in results.values()):
+        print("FAIL[slo]: recovered series must clear the alert")
+        failed += 1
+    text = engine.metrics_text(now=now)
+    for needle in ("veles_slo_queue_depth", "veles_slo_batch_fill",
+                   "veles_slo_ttft_p99_burn_rate",
+                   "veles_slo_alerts_total 1"):
+        if needle not in text:
+            print("FAIL[slo]: %r missing from metrics_text" % needle)
+            failed += 1
+    if not failed:
+        print("obs smoke[slo]: burn alerts fired exactly as "
+              "expected (fast 10.0x / slow 5.0x, 1 edge, recovery "
+              "clears)")
+    return failed
+
+
+def smoke():
+    import tempfile
+
+    from veles_tpu import trace
+    from veles_tpu.config import root
+
+    failed = _check_disabled_path()
+
+    saved = root.common.engine.get("trace", "off")
+    root.common.engine.trace = "on"
+    trace.configure()
+    trace.recorder.clear()
+    try:
+        with tempfile.TemporaryDirectory() as tmpdir:
+            req_failed, _tid = _traced_request(tmpdir)
+            failed += req_failed
+    finally:
+        root.common.engine.trace = saved
+        trace.configure()
+        trace.recorder.clear()
+
+    failed += _check_slo()
+    return 1 if failed else 0
+
+
+def main(argv=None):
+    args = make_parser().parse_args(argv)
+    if not args.smoke:
+        make_parser().print_help()
+        return 2
+    return smoke()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

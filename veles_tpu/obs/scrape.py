@@ -1,0 +1,145 @@
+"""Per-role scrape endpoints: every process Prometheus-scrapeable.
+
+The reference platform's signature operational surface was its
+always-on status plane — EVERY node fed the web status server
+(PAPER.md §0).  The TPU build's equivalent before this module was
+lopsided: only the serving HTTP server exposed ``/metrics``; the job
+master, the slaves and the pod workers had rich in-process state
+(per-slave latency histograms, exactly-once counters, the perf
+ledger, the trace ring) with no scrape surface at all.
+
+:class:`ScrapeServer` is the smallest fix that composes: a threaded
+HTTP listener serving ``GET /metrics`` (the concatenation of a list
+of text-producing sources, each guarded — one failing source must
+not blank the page for the rest) and ``GET /healthz``.  Every role
+mounts it with its own sources:
+
+* ``JobServer.start_scrape()`` — master: per-slave send→update
+  round-trip histograms, heartbeat-stall counters, exactly-once
+  accounting (+ the hosted workflow's own ``metrics_text`` when it
+  has one, which is how a :class:`~veles_tpu.pod.membership.PodMaster`
+  surfaces its lease table);
+* ``JobClient.start_scrape()`` — slaves / pod workers: job progress
+  plus the shared process-wide sources;
+* the process-wide base (:func:`default_sources`): the PR 6 perf
+  ledger gauges always, the trace category counters when tracing is
+  on, a declared :class:`~veles_tpu.obs.slo.SLOEngine` when given.
+
+The exposition text comes from the same renderers the serving
+``/metrics`` page uses (``veles_tpu.metrics.emit_histogram``,
+``prof.metrics_text``, ``trace.metrics_text``), so one Prometheus
+config scrapes every role with identical families.
+"""
+
+import json
+import threading
+
+from veles_tpu.logger import Logger
+
+
+def default_sources(slo=None, extra=()):
+    """The process-wide base every role shares: perf-ledger gauges
+    (always on — the ledger has no knob), trace counters when tracing
+    is enabled, an optional SLO engine (sampled per scrape), plus any
+    role-specific callables."""
+    from veles_tpu import prof, trace
+
+    sources = [prof.metrics_text]
+
+    def trace_source():
+        return trace.metrics_text() if trace.enabled() else ""
+
+    sources.append(trace_source)
+    if slo is not None:
+        def slo_source():
+            slo.sample()
+            return slo.metrics_text()
+
+        sources.append(slo_source)
+    sources.extend(extra)
+    return sources
+
+
+class ScrapeServer(Logger):
+    """Threaded ``/metrics`` + ``/healthz`` listener over a list of
+    text sources.  ``port=0`` binds an ephemeral port (read it back
+    from ``self.port`` after :meth:`start`)."""
+
+    def __init__(self, sources, host="127.0.0.1", port=0,
+                 role="process", **kwargs):
+        super(ScrapeServer, self).__init__(**kwargs)
+        self.sources = list(sources)
+        self.host = host
+        self.port = int(port)
+        self.role = str(role)
+        self._httpd = None
+        self._thread = None
+
+    def render(self):
+        """Concatenate every source, each guarded: a raising source
+        contributes a comment line naming itself instead of killing
+        the scrape (a half-closed engine mid-undeploy must degrade,
+        not 500)."""
+        parts = []
+        for source in self.sources:
+            try:
+                text = source()
+            except Exception as e:  # noqa: BLE001 - exposition edge
+                text = "# scrape source %s failed: %s\n" % (
+                    getattr(source, "__name__", source), e)
+            if text:
+                parts.append(text if text.endswith("\n")
+                             else text + "\n")
+        return "".join(parts)
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _reply(self, status, body, content_type):
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._reply(200, server.render().encode(),
+                                "text/plain; version=0.0.4")
+                elif self.path == "/healthz":
+                    self._reply(200, json.dumps(
+                        {"status": "ok",
+                         "role": server.role}).encode(),
+                        "application/json")
+                else:
+                    self._reply(404, json.dumps(
+                        {"error": "no route %r" % self.path}).encode(),
+                        "application/json")
+
+            def log_message(self, fmt, *args):
+                server.debug("scrape: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="obs-scrape-%s" % self.role)
+        self._thread.start()
+        self.info("%s scrape endpoint on http://%s:%d/metrics",
+                  self.role, self.host, self.port)
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

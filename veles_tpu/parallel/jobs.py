@@ -62,6 +62,8 @@ import uuid
 from veles_tpu import chaos, trace
 from veles_tpu.logger import Logger
 from veles_tpu.metrics import LatencyHistogram
+from veles_tpu.obs import blackbox
+from veles_tpu.obs import context as obs_context
 
 HEARTBEAT_INTERVAL = 2.0
 SLAVE_TIMEOUT = 10.0
@@ -160,6 +162,14 @@ class JobServer(Logger):
         self.stale_rejected = 0
         self.lost_requeued = 0
         self._updates_applied = 0
+        #: sid -> heartbeat-watchdog excursions (the WARNING +
+        #: jobs:heartbeat_stall instant, promoted to a real counter on
+        #: the master scrape endpoint); survives drop_slave so a
+        #: flapping slave's history outlives its record
+        self.heartbeat_stalls = collections.Counter()
+        #: the per-role Prometheus listener (obs.scrape), mounted by
+        #: start_scrape()
+        self._scrape = None
         #: crash-recovery: async TrainCheckpointer checkpoints every
         #: ``checkpoint_every`` applied updates and at epoch
         #: boundaries; None args fall back to the
@@ -230,6 +240,9 @@ class JobServer(Logger):
                 pass
         if self._thread is not None:
             self._thread.join(5)
+        if self._scrape is not None:
+            self._scrape.stop()
+            self._scrape = None
         self._socket.close(linger=0)
         # close under the lock: a straggler worker thread may still be
         # inside _send's wake path (zmq sockets are not thread-safe)
@@ -315,6 +328,10 @@ class JobServer(Logger):
             return True
         if fault.action == "master_kill":
             self.warning("chaos: master killed")
+            # flight recorder: a simulated SIGKILL must leave the same
+            # post-mortem a real one's handler would (no-op when
+            # root.common.obs.blackbox_dir is unset)
+            blackbox.dump("chaos master_kill")
             self.killed = True
             self._stop.set()
             try:
@@ -559,7 +576,8 @@ class JobServer(Logger):
                     return
                 try:
                     with trace.span("jobs", "generate",
-                                    {"slave": slave.id},
+                                    obs_context.tag(
+                                        {"slave": slave.id}),
                                     role="master"):
                         data = self.workflow.generate_data_for_slave(
                             slave)
@@ -585,8 +603,12 @@ class JobServer(Logger):
                 self._maybe_finish()
                 return
             slave.job_sent()
-            self._send(identity, {"op": "job", "data": data,
-                                  "job": job_id, "req": req})
+            # distributed tracing rides the job frame: the master's
+            # current/process context (a traced session's identity)
+            # parents everything the slave does with this job
+            self._send(identity, obs_context.wire_inject(
+                {"op": "job", "data": data, "job": job_id,
+                 "req": req}))
         except Exception as exc:
             self.exception("job generation for %s failed", slave.id)
             # answer the request: a silent swallow here would leave
@@ -656,9 +678,13 @@ class JobServer(Logger):
                     self._send(identity, {"op": "update_ack", "ok": 0,
                                           "stale": 1, "req": req})
                     return
+            update_ctx = obs_context.wire_extract(msg)
+            apply_args = {"slave": slave.id}
+            if update_ctx is not None:
+                apply_args = update_ctx.span_args(apply_args)
             try:
-                with trace.span("jobs", "apply_update",
-                                {"slave": slave.id}, role="master"):
+                with trace.span("jobs", "apply_update", apply_args,
+                                role="master"):
                     self.workflow.apply_data_from_slave(msg["data"],
                                                         slave)
                 ok = 1
@@ -713,13 +739,14 @@ class JobServer(Logger):
             except Exception:
                 self.exception("on_pod_epoch failed for %s", slave.id)
         if trace.enabled():
-            trace.instant(
-                "jobs", "pod_epoch",
-                {"slave": slave.id, "epoch": msg.get("epoch"),
-                 "lease": msg.get("lease"),
-                 "pod_generation": msg.get("generation"),
-                 "stop": reply.get("stop", 0)},
-                role="master")
+            args = {"slave": slave.id, "epoch": msg.get("epoch"),
+                    "lease": msg.get("lease"),
+                    "pod_generation": msg.get("generation"),
+                    "stop": reply.get("stop", 0)}
+            epoch_ctx = obs_context.wire_extract(msg)
+            if epoch_ctx is not None:
+                args = epoch_ctx.span_args(args)
+            trace.instant("jobs", "pod_epoch", args, role="master")
         self._send(identity, reply)
         self._maybe_checkpoint()
 
@@ -764,6 +791,91 @@ class JobServer(Logger):
         with open(path, "w") as fout:
             json.dump(bundle, fout)
         return path
+
+    # -- the master scrape endpoint ------------------------------------------
+    def metrics_text(self):
+        """The master's Prometheus exposition: exactly-once
+        accounting, per-slave progress, heartbeat-watchdog excursions
+        (`veles_jobs_heartbeat_stalls_total{slave=...}`) and the
+        PR 5 per-slave send→update round-trip histograms — previously
+        ``print_stats``-only — as REAL histogram families through the
+        shared renderer (:func:`veles_tpu.metrics.emit_histogram`),
+        same buckets as the serving layer so the two percentile
+        columns compare on one dashboard.  A hosted workflow with its
+        own ``metrics_text`` (a :class:`~veles_tpu.pod.membership
+        .PodMaster`'s lease table) is appended."""
+        from veles_tpu.metrics import emit_histogram
+        with self._lock:
+            slaves = sorted(self.slaves.values(),
+                            key=lambda s: s.id)
+            stalls = dict(self.heartbeat_stalls)
+        lines = [
+            "# HELP veles_jobs_slaves connected slaves",
+            "# TYPE veles_jobs_slaves gauge",
+            "veles_jobs_slaves %d" % len(slaves),
+            "# TYPE veles_jobs_generation gauge",
+            "veles_jobs_generation %d" % self.generation,
+            "# TYPE veles_jobs_updates_applied_total counter",
+            "veles_jobs_updates_applied_total %d"
+            % self._updates_applied,
+            "# HELP veles_jobs_dedup_dropped_total duplicated update "
+            "frames deduplicated (exactly-once accounting)",
+            "# TYPE veles_jobs_dedup_dropped_total counter",
+            "veles_jobs_dedup_dropped_total %d" % self.dedup_dropped,
+            "# TYPE veles_jobs_stale_rejected_total counter",
+            "veles_jobs_stale_rejected_total %d" % self.stale_rejected,
+            "# TYPE veles_jobs_lost_requeued_total counter",
+            "veles_jobs_lost_requeued_total %d" % self.lost_requeued,
+            "# HELP veles_jobs_heartbeat_stalls_total heartbeat-"
+            "watchdog excursions per slave "
+            "(root.common.engine.heartbeat_warn_ms)",
+            "# TYPE veles_jobs_heartbeat_stalls_total counter",
+        ]
+        for sid in sorted(stalls):
+            lines.append(
+                'veles_jobs_heartbeat_stalls_total{slave="%s"} %d'
+                % (sid, stalls[sid]))
+        lines.append("# TYPE veles_jobs_done_total counter")
+        for slave in slaves:
+            lines.append('veles_jobs_done_total{slave="%s"} %d'
+                         % (slave.id, slave.jobs_done))
+        lines.append("# TYPE veles_jobs_in_flight gauge")
+        for slave in slaves:
+            lines.append('veles_jobs_in_flight{slave="%s"} %d'
+                         % (slave.id, slave.in_flight))
+        # ONE family header with every slave's label variant grouped
+        # under it (a second TYPE line for the same name kills the
+        # whole scrape)
+        lines.append("# HELP veles_jobs_job_latency_seconds job "
+                     "send->update round-trip per slave (generation "
+                     "handoff + wire + slave compute + master apply)")
+        lines.append("# TYPE veles_jobs_job_latency_seconds histogram")
+        for slave in slaves:
+            if slave.latency.count:
+                emit_histogram(lines, "veles_jobs_job_latency_seconds",
+                               slave.latency, None,
+                               labels={"slave": slave.id})
+        text = "\n".join(lines) + "\n"
+        workflow_text = getattr(self.workflow, "metrics_text", None)
+        if workflow_text is not None:
+            try:
+                text += workflow_text()
+            except Exception:  # noqa: BLE001 - exposition edge
+                self.exception("hosted workflow metrics_text failed")
+        return text
+
+    def start_scrape(self, host="127.0.0.1", port=0):
+        """Mount the master's ``/metrics`` endpoint
+        (:class:`veles_tpu.obs.scrape.ScrapeServer`): this exposition
+        plus the process-wide base (perf-ledger gauges, trace
+        counters when tracing is on).  Idempotent; stopped with the
+        server."""
+        if self._scrape is None:
+            from veles_tpu.obs import scrape
+            self._scrape = scrape.ScrapeServer(
+                scrape.default_sources(extra=(self.metrics_text,)),
+                host=host, port=port, role="master").start()
+        return self._scrape
 
     # -- crash recovery -----------------------------------------------------
     def _checkpointer(self):
@@ -907,6 +1019,10 @@ class JobServer(Logger):
             if warn_ms and gap * 1e3 > float(warn_ms) \
                     and not slave.hb_warned:
                 slave.hb_warned = True
+                # once per excursion, same latch as the WARNING — the
+                # veles_jobs_heartbeat_stalls_total{slave=...} counter
+                # on the master scrape endpoint
+                self.heartbeat_stalls[sid] += 1
                 trace.instant("jobs", "heartbeat_stall",
                               {"slave": sid,
                                "gap_ms": round(gap * 1e3, 1)},
@@ -1027,6 +1143,9 @@ class JobClient(Logger):
         #: client-monotonic request counter echoed in replies: lets a
         #: retried rpc skip orphan replies of timed-out predecessors
         self._req = 0
+        #: the per-role Prometheus listener (obs.scrape), mounted by
+        #: start_scrape()
+        self._scrape = None
 
     @property
     def trace_role(self):
@@ -1288,20 +1407,26 @@ class JobClient(Logger):
                    self.reconnect_max_wait)
         return False
 
-    def _send_update_with_retry(self, data, job_id):
+    def _send_update_with_retry(self, data, job_id, ctx=None):
         """Push one update with drop-after-apply safety: a lost ack is
         retried with the SAME job id (master-side dedup makes the
         replay provably harmless); a master that stays silent is
         re-handshaked, and the update is discarded only when the
         rejoin lands in a NEWER generation (the delta is stale by
         construction then).  Returns the ack, or None when the master
-        is gone for good."""
+        is gone for good.  ``ctx`` (the job frame's trace context)
+        rides the update frame back so the master's apply span joins
+        the same request waterfall."""
         msg = {"op": "update", "id": self.sid, "data": data}
         if job_id:
             msg["job"] = job_id
+        if ctx is not None:
+            obs_context.wire_inject(msg, ctx)
         for attempt in range(3):
             try:
                 with trace.span("jobs", "update",
+                                ctx.span_args() if ctx is not None
+                                else None,
                                 role=self.trace_role):
                     ack = self._rpc(dict(msg))
             except TimeoutError:
@@ -1386,6 +1511,9 @@ class JobClient(Logger):
             if reply["op"] != "job":
                 raise ConnectionError("unexpected reply %r" % reply["op"])
             job_id = reply.get("job") or {}
+            # the job frame's distributed-trace context: this job's
+            # spans (and the update's) join the master's waterfall
+            job_ctx = obs_context.wire_extract(reply)
             if job_id.get("seq") is not None:
                 self._in_hand.add(job_id["seq"])
             if chaos.controller.armed:
@@ -1398,6 +1526,8 @@ class JobClient(Logger):
                     if fault.action == "slave_kill":
                         self.warning("fault injection: dying mid-job "
                                      "(chaos slave_kill)")
+                        blackbox.dump("chaos slave_kill",
+                                      extra={"slave": self.sid})
                         return False
                     if fault.action == "slave_hang":
                         # a hang is WORSE than a death for the master:
@@ -1411,6 +1541,8 @@ class JobClient(Logger):
                 chaos.controller.record_external(
                     "slave_kill", "slave_job", role=self.trace_role)
                 self.warning("fault injection: dying mid-job")
+                blackbox.dump("slave_death_probability kill",
+                              extra={"slave": self.sid})
                 return False
             result = [None]
             stop_hb = threading.Event()
@@ -1430,8 +1562,13 @@ class JobClient(Logger):
 
                     def compute():
                         try:
-                            with trace.span("jobs", "do_job",
-                                            role=self.trace_role):
+                            with obs_context.activate(job_ctx), \
+                                    trace.span(
+                                        "jobs", "do_job",
+                                        job_ctx.span_args()
+                                        if job_ctx is not None
+                                        else None,
+                                        role=self.trace_role):
                                 self.workflow.do_job(
                                     reply["data"],
                                     lambda out: result.__setitem__(
@@ -1472,15 +1609,20 @@ class JobClient(Logger):
                     if error:
                         raise error[0]
                 else:
-                    with trace.span("jobs", "do_job",
-                                    role=self.trace_role):
+                    with obs_context.activate(job_ctx), \
+                            trace.span("jobs", "do_job",
+                                       job_ctx.span_args()
+                                       if job_ctx is not None
+                                       else None,
+                                       role=self.trace_role):
                         self.workflow.do_job(
                             reply["data"],
                             lambda out: result.__setitem__(0, out))
             finally:
                 stop_hb.set()
                 hb.join(self.heartbeat_interval + 3)
-            ack = self._send_update_with_retry(result[0], job_id)
+            ack = self._send_update_with_retry(result[0], job_id,
+                                               job_ctx)
             if ack is None:
                 return False            # master is gone for good
             if job_id.get("seq") is not None:
@@ -1526,7 +1668,50 @@ class JobClient(Logger):
         except (TimeoutError, ConnectionError) as exc:
             self.warning("could not ship profile to master: %s", exc)
 
+    # -- the slave scrape endpoint -------------------------------------------
+    def metrics_text(self):
+        """The slave's Prometheus exposition: job progress and
+        membership state next to the process-wide base (perf ledger,
+        trace counters) the scrape server appends."""
+        lines = [
+            "# HELP veles_slave_jobs_done_total jobs completed by "
+            "this slave",
+            "# TYPE veles_slave_jobs_done_total counter",
+            "veles_slave_jobs_done_total %d" % self.jobs_done,
+            "# TYPE veles_slave_jobs_in_hand gauge",
+            "veles_slave_jobs_in_hand %d" % len(self._in_hand),
+            "# TYPE veles_slave_generation gauge",
+            "veles_slave_generation %d" % (self.generation or 0),
+        ]
+        return "\n".join(lines) + "\n"
+
+    def start_scrape(self, host="127.0.0.1", port=0,
+                     extra_sources=(), role=None):
+        """Mount this slave's ``/metrics`` endpoint — every role in
+        the fleet is Prometheus-scrapeable, not just the serving
+        server.  ``extra_sources``/``role`` let wrappers (the pod
+        worker) add their own exposition slices to the same mount.
+        Idempotent — but a second call with DIFFERENT extras gets the
+        existing endpoint unchanged, loudly.  Stopped by
+        :meth:`close`."""
+        if self._scrape is None:
+            from veles_tpu.obs import scrape
+            self._scrape = scrape.ScrapeServer(
+                scrape.default_sources(
+                    extra=(self.metrics_text,) + tuple(extra_sources)),
+                host=host, port=port,
+                role=role or self.trace_role).start()
+        elif extra_sources:
+            self.warning(
+                "scrape endpoint already mounted on port %d — the "
+                "extra sources of this call are NOT added; mount "
+                "once with every source", self._scrape.port)
+        return self._scrape
+
     def close(self):
+        if self._scrape is not None:
+            self._scrape.stop()
+            self._scrape = None
         try:
             self._socket.send(pickle.dumps(
                 {"op": "bye", "id": self.sid}))

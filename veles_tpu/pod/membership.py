@@ -32,6 +32,7 @@ smoke and the acceptance tests assert on.
 import numpy
 
 from veles_tpu.logger import Logger
+from veles_tpu.obs import context as obs_context
 from veles_tpu.parallel.mesh import mesh_from_topology
 from veles_tpu.pod.runtime import PodRuntime
 
@@ -186,6 +187,32 @@ class PodMaster(Logger):
     def restore_train_state(self, train, meta):
         return self.workflow.restore_train_state(train, meta)
 
+    # -- scrape surface (appended to the hosting JobServer's
+    # -- metrics_text by its workflow passthrough) ---------------------------
+    def metrics_text(self):
+        """The lease table as Prometheus gauges — the pod master's
+        slice of the master scrape endpoint."""
+        lines = [
+            "# HELP veles_pod_leases_queued pod leases waiting for a "
+            "worker",
+            "# TYPE veles_pod_leases_queued gauge",
+            "veles_pod_leases_queued %d" % len(self._queue),
+            "# TYPE veles_pod_leases_assigned gauge",
+            "veles_pod_leases_assigned %d" % len(self._assigned),
+            "# TYPE veles_pod_leases_done gauge",
+            "veles_pod_leases_done %d" % len(self.done),
+            "# TYPE veles_pod_leases_total gauge",
+            "veles_pod_leases_total %d" % self.total,
+            "# HELP veles_pod_lease_epoch last reported epoch per "
+            "lease",
+            "# TYPE veles_pod_lease_epoch gauge",
+        ]
+        for lease_id in sorted(self.progress):
+            lines.append('veles_pod_lease_epoch{lease="%s"} %d'
+                         % (lease_id,
+                            self.progress[lease_id].get("epoch", 0)))
+        return "\n".join(lines) + "\n"
+
 
 class PodWorker(Logger):
     """The slave-side driver: ONE :class:`veles_tpu.parallel.jobs
@@ -268,10 +295,14 @@ class PodWorker(Logger):
         re-handshaked ONCE and the sync retried — a master that stays
         gone does not stall training (the pod is autonomous; the
         final update's own retry/reconnect settles the books)."""
-        msg = {"op": "pod_epoch", "lease": lease_id, "epoch": epoch,
-               "generation": self.runtime.generation,
-               "shards": self.runtime.shards,
-               "metrics": eval_metrics(self.workflow)}
+        # the sync rides the lease's trace context (activated by the
+        # JobClient around do_job), so the master's pod_epoch instant
+        # lands in the same request waterfall
+        msg = obs_context.wire_inject(
+            {"op": "pod_epoch", "lease": lease_id, "epoch": epoch,
+             "generation": self.runtime.generation,
+             "shards": self.runtime.shards,
+             "metrics": eval_metrics(self.workflow)})
         for attempt in (1, 2):
             try:
                 reply = self.client.control(dict(msg))
@@ -285,6 +316,38 @@ class PodWorker(Logger):
                 continue
             return bool(reply.get("stop"))
         return False
+
+    # -- scrape surface ------------------------------------------------------
+    def metrics_text(self):
+        """The pod worker's slice of its scrape endpoint: runtime
+        shape and lease progress next to the JobClient's job gauges."""
+        runtime = self.runtime
+        lines = [
+            "# TYPE veles_pod_worker_shards gauge",
+            "veles_pod_worker_shards %d"
+            % (runtime.shards if runtime is not None else 0),
+            "# TYPE veles_pod_worker_generation gauge",
+            "veles_pod_worker_generation %d"
+            % (runtime.generation if runtime is not None else 0),
+            "# HELP veles_pod_worker_lease_epoch epochs completed "
+            "locally per lease",
+            "# TYPE veles_pod_worker_lease_epoch gauge",
+        ]
+        for lease_id in sorted(self._progress):
+            lines.append(
+                'veles_pod_worker_lease_epoch{lease="%s"} %d'
+                % (lease_id, self._progress[lease_id]))
+        return "\n".join(lines) + "\n"
+
+    def start_scrape(self, host="127.0.0.1", port=0):
+        """Mount this pod worker's ``/metrics`` endpoint: the
+        JobClient job gauges + the pod runtime shape + the shared
+        process-wide base — the worker role's scrape surface.  One
+        mount per process: if the client endpoint is already up, the
+        delegate warns and the pod gauges are NOT added."""
+        return self.client.start_scrape(
+            host=host, port=port, extra_sources=(self.metrics_text,),
+            role="pod-worker-%s" % self.client.sid)
 
     # -- lifecycle ----------------------------------------------------------
     def run(self):

@@ -52,12 +52,17 @@ class InferDeadlineExceeded(RuntimeError):
 
 
 class _Pending(object):
-    __slots__ = ("rows", "future", "enqueued")
+    __slots__ = ("rows", "future", "enqueued", "ctx")
 
-    def __init__(self, rows):
+    def __init__(self, rows, ctx=None):
         self.rows = rows
         self.future = Future()
         self.enqueued = time.perf_counter()
+        #: the submitting thread's distributed-trace context (None
+        #: when tracing is off) — the worker thread stamps it onto
+        #: this request's spans; request identity survives the
+        #: thread handoff this way
+        self.ctx = ctx
 
 
 class DynamicBatcher(Logger):
@@ -126,10 +131,15 @@ class DynamicBatcher(Logger):
                 "request of %d rows exceeds the queue bound %d — "
                 "split the request or raise max_queue_rows"
                 % (len(rows), self.max_queue_rows))
+        ctx = None
         if trace.enabled():
-            trace.instant("serve", "enqueue", {"rows": len(rows)},
-                          role="server")
-        pending = _Pending(rows)
+            from veles_tpu.obs import context as obs_context
+            ctx = obs_context.current()
+            args = {"rows": len(rows)}
+            if ctx is not None:
+                args = ctx.span_args(args)
+            trace.instant("serve", "enqueue", args, role="server")
+        pending = _Pending(rows, ctx)
         with self._cond:
             if self._stopped:
                 raise RuntimeError("batcher is stopped")
@@ -261,7 +271,16 @@ class DynamicBatcher(Logger):
                     batch = taken[0].rows
                 else:
                     batch = numpy.concatenate([p.rows for p in taken])
-                with trace.span("serve", "batch_infer", role="server"):
+                infer_args = None
+                if trace.enabled():
+                    # which requests this device call served — the
+                    # batch-fill-wait half of each one's waterfall
+                    traces = sorted({p.ctx.trace_id for p in taken
+                                     if p.ctx is not None})
+                    if traces:
+                        infer_args = {"traces": traces}
+                with trace.span("serve", "batch_infer", infer_args,
+                                role="server"):
                     out = self._infer_bounded(engine, batch)
             except Exception as exc:  # noqa: BLE001 - fan the error out
                 self.warning("batched inference failed: %s", exc)
@@ -302,11 +321,14 @@ class DynamicBatcher(Logger):
                 if traced:
                     # retroactive enqueue→reply span (same clock:
                     # _Pending stamps time.perf_counter at submit)
+                    args = {"rows": n}
+                    if pending.ctx is not None:
+                        args = pending.ctx.span_args(args)
                     trace.complete(
                         "serve", "request",
                         int(pending.enqueued * 1e9),
                         int((done - pending.enqueued) * 1e9),
-                        {"rows": n}, role="server")
+                        args, role="server")
 
     def stop(self, drain=True):
         """Stop the worker.  ``drain=True`` serves what is queued

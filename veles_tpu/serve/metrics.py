@@ -223,25 +223,13 @@ class ServingMetrics(object):
     @staticmethod
     def _emit_histogram(lines, name, hist, help_, labels=None):
         """Prometheus histogram exposition for a
-        :class:`~veles_tpu.metrics.LatencyHistogram`: cumulative
-        ``le``-labeled buckets + ``_sum``/``_count``, one contiguous
-        family (the exposition-format contract) — real quantile math
+        :class:`~veles_tpu.metrics.LatencyHistogram` under the
+        ``veles_serve_`` prefix — delegates to the ONE shared
+        renderer (:func:`veles_tpu.metrics.emit_histogram`), the same
+        one the per-role scrape endpoints use, so every role's
+        histogram families parse identically.  Real quantile math
         happens server-side (``histogram_quantile``) instead of
         trusting our interpolated percentile lines."""
-        bounds, cum, total, count = hist.cumulative()
-        prefix = "".join('%s="%s",' % (k, v) for k, v in
-                         sorted((labels or {}).items()))
-        suffix = ("{%s}" % prefix.rstrip(",")) if prefix else ""
-        if help_ is not None:   # None = caller already wrote the
-            lines.append("# HELP veles_serve_%s %s"  # family header
-                         % (name, help_))
-            lines.append("# TYPE veles_serve_%s histogram" % name)
-        for bound, c in zip(bounds, cum):
-            lines.append('veles_serve_%s_bucket{%sle="%.6g"} %d'
-                         % (name, prefix, bound, c))
-        lines.append('veles_serve_%s_bucket{%sle="+Inf"} %d'
-                     % (name, prefix, count))
-        lines.append("veles_serve_%s_sum%s %.6f"
-                     % (name, suffix, total))
-        lines.append("veles_serve_%s_count%s %d"
-                     % (name, suffix, count))
+        from veles_tpu.metrics import emit_histogram
+        emit_histogram(lines, "veles_serve_%s" % name, hist, help_,
+                       labels=labels)

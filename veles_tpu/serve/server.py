@@ -10,6 +10,18 @@ plus the operational surface a production service needs:
     GET /healthz   → {"status": "ok", "models": {...}}   (200/503)
     GET /metrics   → text/plain Prometheus-style counters
 
+Distributed tracing (:mod:`veles_tpu.obs.context`): when tracing is
+on, every POST mints (or, with an incoming W3C ``traceparent``
+header, continues) a trace context at this front door, activates it
+for the handler thread — the batcher/scheduler capture it at submit
+and stamp every downstream span with the trace id — and echoes the
+``traceparent`` back as a response header so callers can join their
+own spans to the served request's waterfall.  The serving SLO engine
+(:mod:`veles_tpu.obs.slo`) samples on every ``/metrics`` scrape and
+appends the autoscaling-signal gauges (queue depth, batch fill, TTFT
+p99 burn rate) + burn-rate evaluations to the page; ``/healthz``
+carries its ``describe()``.
+
 Requests may also carry base64 numpy input (``{"input_b64": ...,
 "shape": [...], "dtype": "float32"}`` — :mod:`veles_tpu.serve.wire`).
 Error mapping: malformed request → 400 with ``{"error": ...}``;
@@ -27,7 +39,10 @@ import threading
 import time
 from concurrent.futures import TimeoutError as FuturesTimeout
 
+from veles_tpu import trace
 from veles_tpu.logger import Logger
+from veles_tpu.obs import context as obs_context
+from veles_tpu.obs import slo as obs_slo
 from veles_tpu.serve.batcher import QueueFull
 from veles_tpu.serve.metrics import ServingMetrics
 from veles_tpu.serve.registry import ModelRegistry
@@ -71,6 +86,10 @@ class ServingServer(Logger):
                         "existing queue/batch knobs",
                         ", ".join(registry.names()))
         self.registry = registry
+        #: the serving SLO engine: rings over THIS server's metrics
+        #: gauges, objectives from root.common.obs.slo.*, sampled on
+        #: every /metrics scrape
+        self.slo = obs_slo.standard_engine(self.metrics)
         if engine is not None:
             self.registry.deploy(DEFAULT_MODEL, engine, warmup=warmup)
         self.host = host
@@ -245,7 +264,23 @@ class ServingServer(Logger):
             "status": "ok" if ok else "no models deployed",
             "uptime_sec": round(time.time() - self.metrics.started, 3),
             "models": self.registry.describe(),
+            "slo": self.slo.describe(),
         }
+
+    def metrics_page(self):
+        """The full ``/metrics`` exposition body — serving counters,
+        performance-ledger gauges (always on — the ledger has no
+        knob), trace category counters when tracing is on, and the
+        SLO engine's autoscaling signals + burn rates (sampled per
+        scrape — the Prometheus pull IS the sampling cadence)."""
+        from veles_tpu import prof
+        body = self.metrics.render_text()
+        body += prof.metrics_text()
+        if trace.enabled():
+            body += trace.metrics_text()
+        self.slo.sample()
+        body += self.slo.metrics_text()
+        return body
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -257,12 +292,18 @@ class ServingServer(Logger):
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            #: the request's trace context (set per POST when tracing
+            #: is on) — echoed as the traceparent response header
+            _trace_ctx = None
 
             def _reply(self, status, body, content_type):
                 self.send_response(status)
                 if status == 503 and b"retry_after" in body:
                     self.send_header("Retry-After",
                                      str(QueueFull.retry_after))
+                if self._trace_ctx is not None:
+                    self.send_header("traceparent",
+                                     self._trace_ctx.traceparent())
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -291,6 +332,9 @@ class ServingServer(Logger):
                     self._reply_json(500, {"error": str(e)})
                     return
                 self.send_response(status)
+                if self._trace_ctx is not None:
+                    self.send_header("traceparent",
+                                     self._trace_ctx.traceparent())
                 self.send_header("Content-Type",
                                  "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -313,6 +357,26 @@ class ServingServer(Logger):
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                # request-tracing ingress: continue an incoming W3C
+                # traceparent or mint a fresh context; None (tracing
+                # off) keeps the whole block a single attribute check.
+                # Reset per request — a keep-alive connection reuses
+                # this handler instance, and an untraced follow-up
+                # must not echo the previous request's header
+                self._trace_ctx = None
+                ctx = obs_context.ingress(
+                    self.headers.get("traceparent"))
+                if ctx is None:
+                    self._handle_post(body)
+                    return
+                self._trace_ctx = ctx
+                with obs_context.activate(ctx):
+                    with trace.span("serve", "http",
+                                    ctx.span_args({"path": self.path}),
+                                    role="server"):
+                        self._handle_post(body)
+
+            def _handle_post(self, body):
                 if self.path == GENERATE_PATH or \
                         self.path.startswith(GENERATE_PATH + "/"):
                     try:
@@ -338,20 +402,11 @@ class ServingServer(Logger):
                 self._reply_json(status, payload)
 
             def do_GET(self):
+                self._trace_ctx = None   # keep-alive reuse (see POST)
                 if self.path == "/healthz":
                     self._reply_json(*server.healthz())
                 elif self.path == "/metrics":
-                    body = server.metrics.render_text()
-                    from veles_tpu import prof, trace
-                    # performance-ledger gauges (compile/recompile
-                    # counters, HBM by category) are always cheap and
-                    # always on — the ledger has no knob
-                    body += prof.metrics_text()
-                    if trace.enabled():
-                        # the trace's compact per-category counters
-                        # ride the same exposition page
-                        body += trace.metrics_text()
-                    self._reply(200, body.encode(),
+                    self._reply(200, server.metrics_page().encode(),
                                 "text/plain; version=0.0.4")
                 else:
                     self._reply_json(404, {"error": "no route %r"
@@ -386,14 +441,14 @@ class ServingServer(Logger):
         """POST the metrics snapshot + model table to a running
         :class:`veles_tpu.web_status.WebStatus` ``/update`` endpoint,
         so the one status page shows training AND serving."""
-        from veles_tpu import trace
         from veles_tpu.web_status import post_json
         payload = {
             "id": run_id,
             "workflow": "ServingServer",
             "stopped": self._httpd is None,
             "results": {"serving": self.metrics.snapshot(),
-                        "models": self.registry.describe()},
+                        "models": self.registry.describe(),
+                        "slo": self.slo.describe()},
         }
         if trace.enabled():
             payload["results"]["trace"] = trace.summary()

@@ -60,7 +60,12 @@ def _role_pids(events):
 
 def chrome_events(events=None):
     """Normalized events → the Chrome ``traceEvents`` list (metadata
-    ``process_name`` records included)."""
+    ``process_name`` records included).  Spans tagged with a
+    distributed-trace identity (an ``args["trace"]`` id from
+    :mod:`veles_tpu.obs.context`) additionally emit **flow events**
+    (``ph: s/t``, one flow per trace id) so Perfetto draws the
+    request's waterfall arrows ACROSS role lanes — the cross-process
+    stitch a ``prof merge`` timeline renders per request."""
     events = normalize() if events is None else events
     pids = _role_pids(events)
     out = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
@@ -76,6 +81,28 @@ def chrome_events(events=None):
         if ev.get("args"):
             rec["args"] = dict(ev["args"])
         out.append(rec)
+    # flow derivation runs over a TIMESTAMP-sorted view: the ring
+    # holds spans in completion order (a request's enclosing span
+    # lands last with the earliest begin), and flow steps must walk
+    # forward in time or the waterfall arrows render backwards
+    flows = {}   # trace id -> steps emitted so far
+    tagged = sorted(
+        (ev for ev in events
+         if ev["ph"] == "X" and (ev.get("args") or {}).get("trace")),
+        key=lambda ev: ev["ts_us"])
+    for ev in tagged:
+        trace_id = ev["args"]["trace"]
+        seen = flows.setdefault(trace_id, [0])
+        # flow start on the trace's earliest tagged span, steps on
+        # every later one; binding is by enclosing slice, so each
+        # flow event lands just inside its span's interval
+        out.append({
+            "ph": "s" if seen[0] == 0 else "t",
+            "cat": "obs", "name": "request", "id": trace_id,
+            "pid": pids.get(ev.get("role") or "trainer", 1),
+            "tid": ev["tid"], "ts": ev["ts_us"],
+        })
+        seen[0] += 1
     return out
 
 
@@ -129,7 +156,11 @@ def load(path):
             role_of[ev.get("pid")] = ev.get("args", {}).get("name")
     out = []
     for ev in raw:
-        if ev.get("ph") == "M":
+        if ev.get("ph") in ("M", "s", "t", "f"):
+            # metadata and flow events are derived decoration:
+            # chrome_events regenerates flows from the spans' trace
+            # args on every export, so a load→report→save roundtrip
+            # stays equal to the ring that wrote it
             continue
         out.append({
             "ph": ev.get("ph"), "cat": ev.get("cat", ""),

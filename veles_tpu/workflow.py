@@ -198,11 +198,14 @@ class Workflow(Unit):
         each unit at most once per remaining peer — so genuine
         AttributeError bugs in ``initialize()`` bodies surface immediately."""
         from veles_tpu import trace
+        from veles_tpu.obs import blackbox
         from veles_tpu.units import MissingDemandedAttributes
         # honor the root.common.engine.trace knob per initialize (the
         # natural "a run starts here" boundary — off stays a single
-        # attribute check in every hook)
+        # attribute check in every hook); the flight-recorder knob
+        # (root.common.obs.blackbox_dir) arms at the same boundary
         trace.configure()
+        blackbox.configure()
         self.device = device
         pending = collections.deque(self.units_in_dependency_order())
         retries = {}
