@@ -520,7 +520,8 @@ def _conv_stage(metric, layers, input_shape, n_classes, batch, steps,
 
 
 def _wf_stage(metric, fused_config=None, sample=None, fused=True,
-              vs=None, extra=None, loader_mode=None, epoch_scan=None):
+              vs=None, extra=None, loader_mode=None, epoch_scan=None,
+              health=None):
     """The WHOLE framework path: StandardWorkflow(fused=True) — graph
     scheduling, loader epoch bookkeeping, Decision accounting, and the
     fused step — timed over full epochs via wf.run().  Every minibatch
@@ -551,10 +552,13 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
     saved_loader = root.common.engine.get("loader", "auto")
     saved_trace = root.common.engine.get("trace", "off")
     saved_scan = root.common.engine.get("epoch_scan", "off")
+    saved_health = root.common.engine.get("health", "off")
     if loader_mode is not None:
         root.common.engine.loader = loader_mode
     if epoch_scan is not None:
         root.common.engine.epoch_scan = epoch_scan
+    if health is not None:
+        root.common.engine.health = health
     root.common.engine.trace = "on"    # initialize() → trace.configure
     try:
         prng.seed_all(1234)
@@ -619,6 +623,7 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
         root.common.engine.loader = saved_loader
         root.common.engine.trace = saved_trace
         root.common.engine.epoch_scan = saved_scan
+        root.common.engine.health = saved_health
         trace.configure()
     # train-only images over the wall clock (which includes the eval
     # passes): comparable to the fused synthetic-batch line — counting
@@ -643,6 +648,8 @@ def _wf_stage(metric, fused_config=None, sample=None, fused=True,
         extra.setdefault("loader", loader_mode)
     if epoch_scan is not None:
         extra.setdefault("epoch_scan", epoch_scan)
+    if health is not None:
+        extra.setdefault("health", health)
     _emit(metric, sec_per_step, batch, None, vs=vs, extra=extra)
     return batch / sec_per_step
 
@@ -755,6 +762,31 @@ def stage_mnist_wf_eager_epoch():
               epoch_scan="auto",
               extra={"vs_metric": "mnist_wf_eager_devloader "
                                   "(per-step stitched, same run)"})
+
+
+def stage_mnist_wf_health():
+    """In-program health telemetry on the stitched devloader trainer
+    (``engine.health=on``, veles_tpu.watch): per-param-group
+    grad/weight/update norms + non-finite counts ride the SAME
+    stitched programs as extra deferred-metric outputs — ZERO extra
+    dispatches by construction.  Emits ``vs=`` the health-off
+    devloader line from the SAME ladder run, so ``vs_baseline`` IS
+    the telemetry overhead ratio (the acceptance line: ~1.0x), and
+    ``trace_dispatches`` must match the baseline's count exactly
+    (asserted by tests/test_watch.py; the bench line makes it visible
+    per round).  Re-measures the health-off twin in-process when
+    BENCH_STAGES skipped it."""
+    devloader_ips = _WF_DEVLOADER_IPS[0]
+    if devloader_ips is None:
+        stage_mnist_wf_eager_devloader()
+        devloader_ips = _WF_DEVLOADER_IPS[0]
+    _wf_stage("MNIST784 full StandardWorkflow(eager, device loader, "
+              "health telemetry) train throughput (epoch wall-clock "
+              "incl. eval)",
+              fused=False, vs=devloader_ips, loader_mode="device",
+              health="on",
+              extra={"vs_metric": "mnist_wf_eager_devloader "
+                                  "(health off, same run)"})
 
 
 def stage_mnist_wf_slave():
@@ -2126,6 +2158,7 @@ STAGES = {
     "mnist_wf_eager": (stage_mnist_wf_eager, 300),
     "mnist_wf_eager_devloader": (stage_mnist_wf_eager_devloader, 300),
     "mnist_wf_eager_epoch": (stage_mnist_wf_eager_epoch, 300),
+    "mnist_wf_health": (stage_mnist_wf_health, 300),
     "mnist_wf_slave": (stage_mnist_wf_slave, 300),
     "mnist_pod": (stage_mnist_pod, 420),
     "mnist_pod_epoch": (stage_mnist_pod_epoch, 420),
@@ -2157,6 +2190,7 @@ _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "mnist_wf",
                "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
                "mnist_wf_eager_devloader", "mnist_wf_eager_epoch",
+               "mnist_wf_health",
                "mnist_wf_slave", "mnist_pod", "mnist_pod_epoch",
                "cifar", "stl10", "ae",
                "kohonen",
@@ -2180,7 +2214,8 @@ _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
                "cifar", "stl10", "ae", "kohonen", "mnist_wf",
                "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
                "mnist_wf_eager_devloader", "mnist_wf_eager_epoch",
-               "mnist_wf_slave", "mnist_pod", "mnist_pod_epoch")
+               "mnist_wf_health", "mnist_wf_slave", "mnist_pod",
+               "mnist_pod_epoch")
 
 #: CPU fallback (rehearsed with a wedged tunnel): conv/LM heavies
 #: cannot finish on CPU inside their caps — end on the flagship MNIST
@@ -2188,6 +2223,7 @@ _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
 _CPU_ORDER = ("mnist_e2e", "mnist_epoch", "mnist_wf",
               "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
               "mnist_wf_eager_devloader", "mnist_wf_eager_epoch",
+              "mnist_wf_health",
               "mnist_wf_slave", "mnist_pod", "mnist_pod_epoch", "ae",
               "kohonen", "lstm", "transformer_gen",
               "native_infer", "mnist_u8", "mnist_bf16", "mnist")

@@ -21,7 +21,9 @@ fi
 env JAX_PLATFORMS=cpu python -m veles_tpu.analyze --lint
 # mnist_conv + cifar10 exercise the loader-headed stitch stage (the
 # device-resident input pipeline, V-J07) on conv-shaped workflows;
-# the analyzer runs with the full rule set, V-J08/V-J09 included
+# the analyzer runs with the full rule set, V-J08..V-J11 included
+# (V-J11: host-side finiteness probes — the samples must stay silent,
+# the in-program health knob being the prescribed remedy)
 for sample in veles_tpu.samples.mnist veles_tpu.samples.mnist_ae \
               veles_tpu.samples.mnist_conv veles_tpu.samples.cifar10; do
   echo "== analyze $sample =="
@@ -68,6 +70,23 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python -m veles_tpu.gen --smoke
 # (docs/observability.md § Request tracing & SLOs)
 echo "== obs smoke (request tracing + SLO gate) =="
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m veles_tpu.obs --smoke
+# watch smoke: the training-health + live-bus gate — one traced
+# stitched session under engine.health=on must publish >=4 distinct
+# event kinds (run/epoch/health/perf) consumed by a LIVE bus
+# subscriber with finite per-param-group stats; an injected NaN under
+# health=strict must raise a typed HealthError naming the poisoned
+# param group; and a record/replay ndjson roundtrip must reproduce
+# the session exactly (docs/observability.md § Training health &
+# live watch)
+echo "== watch smoke (training-health telemetry + live bus gate) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m veles_tpu.watch --smoke
+# bench_diff self-test: the perf-regression watchdog's comparator
+# validated against the banked BENCH_r0*.json envelope — banked vs
+# banked clean, synthetically degraded copies caught on every field,
+# cross-device lines skipped (the bench ladder is a GATE now, not an
+# archive: gate a fresh run with scripts/bench_diff.py --fresh)
+echo "== bench_diff self-test (perf-regression watchdog) =="
+python scripts/bench_diff.py --selftest
 # pod smoke: an 8-shard CPU session (one pod = one pjit'd stitched
 # program) must train the seeded sample to completion with ZERO
 # per-step gradient/update frames on the ZMQ wire (chaos wire-site

@@ -825,3 +825,155 @@ def test_v_j10_in_catalog_and_check_shapes_wiring():
     findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
     assert "V-J10" not in rules_of(findings), \
         [f.render() for f in findings]
+
+
+# -- V-J11: host-side finiteness probes -------------------------------------
+
+def test_v_j11_run_body_finiteness_probe_flagged():
+    """V-J11: np.isnan / jnp.isfinite in a hot-loop run()/tpu_run()
+    body is the per-step divergence poll the in-program health knob
+    replaces; a probe-free body stays silent."""
+    from veles_tpu.analyze.shapes import scan_finiteness_probes
+
+    class ProbingUnit(Unit):
+        hide_from_registry = True
+
+        def run(self):
+            if numpy.isnan(self.output.mem).any():
+                raise RuntimeError("diverged")
+
+        def tpu_run(self):
+            import jax.numpy as jnp
+            if jnp.isfinite(self.output.devmem).all().item() == 0:
+                raise RuntimeError("diverged")
+
+    class CleanUnit(Unit):
+        hide_from_registry = True
+
+        def run(self):
+            self.total += float(self.minibatch_size)
+
+        def tpu_run(self):
+            # in-program masking: the jnp verdict never reaches the
+            # host — legitimate device-side sanitization, not a probe
+            import jax.numpy as jnp
+            x = self.output.devmem
+            self.output.devmem = jnp.where(jnp.isfinite(x), x, 0.0)
+
+    class HostOnlyProbe(Unit):
+        hide_from_registry = True
+
+        def run(self):
+            # input sanitization over a plain host array: no Vector
+            # .mem/.devmem, no jnp — the health knob cannot replace
+            # this, so the rule stays silent
+            if numpy.isnan(self.raw_batch).any():
+                raise ValueError("bad input file")
+
+    wf = DummyWorkflow()
+    probe = ProbingUnit(wf, name="probe")
+    hot = scan_finiteness_probes(probe)
+    assert rules_of(hot) == {"V-J11"}, [f.render() for f in hot]
+    assert len(hot) == 2                       # run + tpu_run
+    assert all(f.location for f in hot)
+    assert "engine.health" in hot[0].fix
+    clean = scan_finiteness_probes(CleanUnit(wf, name="clean"))
+    assert clean == [], [f.render() for f in clean]
+    host_only = scan_finiteness_probes(HostOnlyProbe(wf, name="san"))
+    assert host_only == [], [f.render() for f in host_only]
+    # one finding per call site across rules: the synced finiteness
+    # verdict in tpu_run is V-J11's — the transfer-hazard pass cedes
+    # it (no V-J08/V-J05 duplicate for the same .item() node)
+    from veles_tpu.analyze.shapes import scan_transfer_hazards
+    transfer = scan_transfer_hazards(probe, hot_loop=True)
+    assert transfer == [], [f.render() for f in transfer]
+
+
+def test_v_j11_stitch_stage_synced_probe_flagged_pure_silent():
+    """V-J11's stitch_stage half: a jnp finiteness verdict SYNCED to
+    the host (float()/.item()) is flagged; the in-program
+    jnp.isfinite fold (exactly what the health instrumentation does)
+    stays silent."""
+    from veles_tpu.analyze.shapes import scan_finiteness_probes
+
+    class SyncedProbeStage(Unit):
+        hide_from_registry = True
+
+        def stitch_stage(self):
+            import jax.numpy as jnp
+
+            def fn(t):
+                if float(jnp.isnan(t["x"]).sum()) > 0:
+                    raise RuntimeError("diverged")
+                bad = jnp.isinf(t["x"]).any().item()
+                return {"y": t["x"], "bad": bad}
+            return fn
+
+    class InProgramStage(Unit):
+        hide_from_registry = True
+
+        def stitch_stage(self):
+            import jax.numpy as jnp
+
+            def fn(t):
+                count = jnp.sum(jnp.logical_not(
+                    jnp.isfinite(t["x"])))
+                # a traced jnp.asarray fold of a finiteness mask is
+                # pure in-program math — only the NUMPY-namespace
+                # array constructors are host syncs
+                mask = jnp.asarray(jnp.isfinite(t["x"]), jnp.float32)
+                return {"y": t["x"] * mask,
+                        "health_nonfinite": count}
+            return fn
+
+    wf = DummyWorkflow()
+    unit = SyncedProbeStage(wf, name="synced")
+    hot = scan_finiteness_probes(unit)
+    assert rules_of(hot) == {"V-J11"}, [f.render() for f in hot]
+    assert len(hot) == 2                       # float() + .item()
+    clean = scan_finiteness_probes(
+        InProgramStage(wf, name="inprog"))
+    assert clean == [], [f.render() for f in clean]
+    # one finding per call site across the rule pair: V-J10 cedes a
+    # synced-finiteness node to the more specific V-J11 (an .item()
+    # WITHOUT a finiteness verdict stays V-J10's — see the V-J10
+    # tests), so the combined pass never double-reports a line
+    from veles_tpu.analyze.shapes import scan_epoch_scan_hazards
+    both = scan_epoch_scan_hazards(unit) + hot
+    assert rules_of(both) == {"V-J11"}, [f.render() for f in both]
+    assert len(both) == 2
+
+
+def test_v_j11_in_catalog_and_hot_chain_silent():
+    """V-J11 is in --rules; check_shapes wires it over the hot chain
+    and the stock stitched MLP stays silent (the lint.sh sample gate's
+    contract)."""
+    assert "V-J11" in rule_catalog()
+
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class TinyLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            self.original_data.mem = rng.standard_normal(
+                (40, 8)).astype(numpy.float32)
+            self.original_labels = [int(i % 4) for i in range(40)]
+            self.class_lengths[:] = [0, 0, 40]
+
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: TinyLoader(w, minibatch_size=8),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": 0.05}},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": 4}}],
+        decision_config={"max_epochs": 1})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=NumpyDevice())
+    findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
+    assert "V-J11" not in rules_of(findings), \
+        [f.render() for f in findings]

@@ -88,6 +88,18 @@ RULES = {
               "(root.common.engine.epoch_scan); a Decision subclass "
               "overriding the per-step run()/improved logic with "
               "host-only code silently disables window absorption"),
+    "V-J11": ("warning",
+              "host-side finiteness probe on the train hot loop: "
+              "np.isnan/np.isinf/np.isfinite over device values in a "
+              "run()/tpu_run() body (or a jnp finiteness check "
+              "synced to the host via .item()/float()/device_get "
+              "inside a stitch_stage() body) pays a device round-"
+              "trip per step to learn what the in-program health "
+              "telemetry (root.common.engine.health=on|strict) "
+              "reports for free — per-param-group non-finite counts "
+              "ride the deferred-metrics fetch with zero extra "
+              "dispatches, and strict mode raises a typed "
+              "HealthError naming the first bad leaf"),
     "V-S01": ("error",
               "generative serving preflight: the engine's slot-major "
               "KV cache does not fit device HBM next to the params, "
@@ -300,6 +312,13 @@ def scan_transfer_hazards(unit, hot_loop=False):
                     and _is_jnp_expr(node.args[0], index):
                 name = node.func.id
                 blocking = True
+            if hot_loop and blocking \
+                    and _contains_finiteness_call(node, index):
+                # a blocking sync whose subtree is a FINITENESS
+                # verdict: the more specific V-J11 (run by check_shapes
+                # over the same hot chain) claims this node with the
+                # health-knob remedy — one finding per call site
+                continue
             if hot_loop and blocking:
                 # escalate from the generic transfer-hazard V-J05: on
                 # the per-minibatch chain these calls stall the async
@@ -553,6 +572,28 @@ _SCAN_HOSTILE_NAMES = {
 }
 
 
+def _stitch_stage_ast(unit):
+    """``(tree, path, base_line, index)`` for ``unit``'s class's
+    ``stitch_stage`` body, or ``None`` — the ONE source-extraction
+    preamble the stitch-stage AST rules (V-J10, V-J11) share, the
+    ``_iter_hot_method_asts`` twin for the stage protocol."""
+    cls = type(unit)
+    meth = cls.__dict__.get("stitch_stage") \
+        or getattr(cls, "stitch_stage", None)
+    func = getattr(meth, "__func__", meth)
+    if not callable(func) or getattr(
+            func, "__qualname__", "").startswith("Unit."):
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(func))
+        path = inspect.getsourcefile(func)
+        base_line = func.__code__.co_firstlineno
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return None
+    return tree, path, base_line, _module_index(path) if path else None
+
+
 def scan_epoch_scan_hazards(unit):
     """V-J10: AST-scan ``stitch_stage()`` of ``unit``'s class for
     host-sync calls that would serialize — or break under tracing —
@@ -564,46 +605,41 @@ def scan_epoch_scan_hazards(unit):
     protocol, ``docs/engine_fast_path.md`` § Epoch mode)."""
     findings = []
     cls = type(unit)
-    meth = cls.__dict__.get("stitch_stage") \
-        or getattr(cls, "stitch_stage", None)
-    func = getattr(meth, "__func__", meth)
-    if callable(func) and not getattr(
-            func, "__qualname__", "").startswith("Unit."):
-        try:
-            src = textwrap.dedent(inspect.getsource(func))
-            path = inspect.getsourcefile(func)
-            base_line = func.__code__.co_firstlineno
-            tree = ast.parse(src)
-        except (OSError, TypeError, SyntaxError):
-            tree = None
-        if tree is not None:
-            index = _module_index(path) if path else None
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                name = (index.resolve_call(node.func)
-                        if index else None) \
-                    or _call_name(node.func)
-                if not name:
-                    continue
-                tail = name.rsplit(".", 1)[-1]
-                if name not in _SCAN_HOSTILE_NAMES \
-                        and tail not in _SCAN_HOSTILE_TAILS:
-                    continue
-                line = base_line + node.lineno - 1
-                findings.append(Finding(
-                    *_rule("V-J10"),
-                    message="%s.stitch_stage calls %s — a host "
-                            "callback/sync inside a stitched stage "
-                            "body serializes (or fails to trace "
-                            "under) the K-step epoch-scan window"
-                            % (cls.__name__, name.lstrip(".") + "()"),
-                    unit=unit.name,
-                    location="%s:%d" % (path, line) if path else None,
-                    fix="keep stage bodies pure jax math; publish "
-                        "host-facing values as produced Vectors / "
-                        "device metrics and fetch them at window "
-                        "boundaries"))
+    extracted = _stitch_stage_ast(unit)
+    if extracted is not None:
+        tree, path, base_line, index = extracted
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = (index.resolve_call(node.func)
+                    if index else None) \
+                or _call_name(node.func)
+            if not name:
+                continue
+            tail = name.rsplit(".", 1)[-1]
+            if name not in _SCAN_HOSTILE_NAMES \
+                    and tail not in _SCAN_HOSTILE_TAILS:
+                continue
+            if tail in _PROBE_SYNC_TAILS \
+                    and _contains_finiteness_call(node, index):
+                # a synced FINITENESS verdict: the more specific
+                # V-J11 claims this exact node (with the health-knob
+                # remedy) — one finding per call site
+                continue
+            line = base_line + node.lineno - 1
+            findings.append(Finding(
+                *_rule("V-J10"),
+                message="%s.stitch_stage calls %s — a host "
+                        "callback/sync inside a stitched stage "
+                        "body serializes (or fails to trace "
+                        "under) the K-step epoch-scan window"
+                        % (cls.__name__, name.lstrip(".") + "()"),
+                unit=unit.name,
+                location="%s:%d" % (path, line) if path else None,
+                fix="keep stage bodies pure jax math; publish "
+                    "host-facing values as produced Vectors / "
+                    "device metrics and fetch them at window "
+                    "boundaries"))
     # the Decision half: an overridden per-step run() without the
     # protocol marker means epoch-scan windows silently fall back —
     # flagged only when the knob is actually set (like V-J07 gates on
@@ -626,6 +662,155 @@ def scan_epoch_scan_hazards(unit):
                 "re-point <Sub>.run.scan_protocol = True after "
                 "matching scan_commit semantics), and express "
                 "stop/improved as device_predicate()"))
+    return findings
+
+
+#: finiteness-probe call tails (any numpy/jnp namespace — the rule
+#: cares about WHERE the verdict is read, not which array library
+#: computed it)
+_FINITENESS_TAILS = {"isnan", "isinf", "isfinite", "isneginf",
+                     "isposinf"}
+#: call shapes that force the probe's verdict onto the host — tails
+#: that sync regardless of namespace; the numpy-namespace array
+#: constructors (host copies) are matched by FULL resolved name via
+#: _SYNC_CALLS so an in-program ``jnp.asarray`` fold (the rule's own
+#: documented remedy idiom) never false-positives
+_PROBE_SYNC_TAILS = {"item", "block_until_ready", "device_get"}
+
+
+def _contains_finiteness_call(node, index):
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = (index.resolve_call(sub.func) if index else None) \
+            or _call_name(sub.func)
+        if name and name.rsplit(".", 1)[-1] in _FINITENESS_TAILS:
+            return name.lstrip(".")
+    return None
+
+
+def _probe_reads_tracked_value(call, name, index):
+    """True when a finiteness probe reads a value the framework
+    tracks on (or mirrors from) the device: a ``jnp``/``jax.numpy``
+    probe is device math by construction; a numpy probe only counts
+    when its operand subtree touches a Vector (``.mem``/``.devmem``)
+    or a jnp expression.  A numpy probe over a plain host array
+    (input sanitization on freshly read bytes) is host-only work the
+    health knob cannot replace — it stays silent."""
+    if name and (name.startswith("jax.numpy.")
+                 or name.startswith("jnp.")):
+        return True
+    for sub in ast.walk(call):
+        if isinstance(sub, ast.Attribute) \
+                and sub.attr in ("mem", "devmem"):
+            return True
+        if isinstance(sub, ast.Call):
+            sub_name = (index.resolve_call(sub.func)
+                        if index else None) or _call_name(sub.func)
+            if sub_name and (sub_name.startswith("jax.numpy.")
+                             or sub_name.startswith("jnp.")):
+                return True
+    return False
+
+
+def scan_finiteness_probes(unit):
+    """V-J11: host-side finiteness probes on the train hot loop.
+
+    Two shapes, one remedy (the ``engine.health`` knob):
+
+    * a ``run()``/``tpu_run()`` body calling ``isnan``/``isinf``/
+      ``isfinite`` (numpy OR jnp — reading the verdict host-side
+      forces the sync either way) — the per-step "did my params
+      explode?" poll the in-program health counters replace;
+    * a ``stitch_stage()`` body where a jnp finiteness check is
+      SYNCED to the host (``.item()``, ``float()``/``int()``,
+      ``jax.device_get``, ``np.asarray``) — in-program
+      ``jnp.isfinite`` folded into the stage math is exactly what the
+      health instrumentation does and stays quiet."""
+    findings = []
+    cls = type(unit)
+    fix = ("set root.common.engine.health=on|strict: per-param-group "
+           "non-finite counts ride the stitched program's deferred "
+           "metrics (zero extra dispatches) and strict mode raises "
+           "HealthError naming the first bad leaf — delete the "
+           "per-step host probe")
+    for meth_name, tree, path, base_line, index in \
+            _iter_hot_method_asts(unit):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = (index.resolve_call(node.func) if index else None) \
+                or _call_name(node.func)
+            probed = None
+            if name and name.rsplit(".", 1)[-1] in _FINITENESS_TAILS \
+                    and not (name.startswith("jax.numpy.")
+                             or name.startswith("jnp.")):
+                # a NUMPY-namespace probe is host-side by
+                # construction — but only over a tracked value (a
+                # Vector .mem/.devmem or a jnp expression); plain
+                # host-array input sanitization stays silent
+                if _probe_reads_tracked_value(node, None, index):
+                    probed = name.lstrip(".")
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int", "bool") \
+                    and node.args:
+                # a jnp finiteness verdict is only a probe when READ
+                # host-side; bare jnp.isfinite masking (jnp.where
+                # sanitization) is legitimate in-program math
+                probed = _contains_finiteness_call(node.args[0],
+                                                   index)
+            elif name and (name.rsplit(".", 1)[-1]
+                           in _PROBE_SYNC_TAILS
+                           or name in _SYNC_CALLS):
+                probed = _contains_finiteness_call(node, index)
+            if probed is None:
+                continue
+            line = base_line + node.lineno - 1
+            findings.append(Finding(
+                *_rule("V-J11"),
+                message="%s.%s calls %s per minibatch on the train "
+                        "hot loop — a host-side finiteness probe "
+                        "syncing a tracked value every step for what "
+                        "the in-program health telemetry reports for "
+                        "free"
+                        % (cls.__name__, meth_name,
+                           probed + "()"),
+                unit=unit.name,
+                location="%s:%d" % (path, line) if path else None,
+                fix=fix))
+    extracted = _stitch_stage_ast(unit)
+    if extracted is not None:
+        tree, path, base_line, index = extracted
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = (index.resolve_call(node.func)
+                    if index else None) or _call_name(node.func)
+            probed = None
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int") \
+                    and node.args:
+                probed = _contains_finiteness_call(node.args[0],
+                                                   index)
+            elif name and (name.rsplit(".", 1)[-1]
+                           in _PROBE_SYNC_TAILS
+                           or name in _SYNC_CALLS):
+                probed = _contains_finiteness_call(node, index)
+            if probed is None:
+                continue
+            line = base_line + node.lineno - 1
+            findings.append(Finding(
+                *_rule("V-J11"),
+                message="%s.stitch_stage syncs a %s() verdict to "
+                        "the host — a finiteness probe inside a "
+                        "stitched stage body stalls (or breaks "
+                        "under an epoch-scan window) what the "
+                        "health instrumentation computes "
+                        "in-program"
+                        % (cls.__name__, probed),
+                unit=unit.name,
+                location="%s:%d" % (path, line) if path else None,
+                fix=fix))
     return findings
 
 
@@ -694,9 +879,13 @@ def check_shapes(workflow, sample_shape=None, batch_size=None):
         # V-J10 — host-sync hazards that would serialize an
         # epoch-scan window folded over this chain
         findings.extend(scan_epoch_scan_hazards(unit))
+        # V-J11 — host-side finiteness probes (the in-program health
+        # knob is the remedy)
+        findings.extend(scan_finiteness_probes(unit))
     decision = getattr(workflow, "decision", None)
     if decision is not None:
         findings.extend(scan_epoch_scan_hazards(decision))
+        findings.extend(scan_finiteness_probes(decision))
 
     # V-J07 — per-step host input pipeline.  (a) the loader's own
     # run()/tpu_run() body moving bytes H2D per minibatch (device_put
@@ -712,6 +901,7 @@ def check_shapes(workflow, sample_shape=None, batch_size=None):
             loader, hot_loop=True) if f.rule == "V-J07")
         findings.extend(scan_retrace_hazards(loader))
         findings.extend(scan_epoch_scan_hazards(loader))
+        findings.extend(scan_finiteness_probes(loader))
         device = getattr(loader, "device", None)
         # fire only when flipping the CONFIG would actually engage the
         # path: a loader that is structurally ineligible (dataset not

@@ -287,6 +287,15 @@ class ChaosController(object):
                 args["op"] = op
             args.update(extra)
             trace.instant("chaos", action, args, role=role)
+        from veles_tpu import watch
+        if watch.enabled():
+            # "role" on a bus event is the PUBLISHING process's role
+            # (stamped by the bus); the fault's target rides as
+            # target_role so a master-injected slave_kill is not
+            # misattributed to the master
+            watch.publish("chaos", dict(extra, action=action,
+                                        site=site, op=op,
+                                        target_role=role))
 
     @property
     def faults_injected(self):

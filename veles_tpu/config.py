@@ -175,6 +175,15 @@ root.common.update({
         "trace": "off",
         # Trace ring capacity in events; wraparound keeps the newest.
         "trace_capacity": 65536,
+        # In-program training-health telemetry (veles_tpu.watch):
+        # "off" (default — stitched programs byte-identical to an
+        # unwatched build), "on" (per-param-group grad/weight/update
+        # norms + non-finite counts ride the deferred-metrics fetch as
+        # device scalars, zero extra dispatches), "strict" (non-finite
+        # params raise watch.health.HealthError naming the first bad
+        # leaf at the window boundary).  Read at
+        # Workflow.initialize()/rebuild_stitching() time.
+        "health": "off",
         "interpret": False,         # run Pallas kernels in interpret mode
         # Master crash-recovery (veles_tpu.parallel.jobs.JobServer):
         # "dir" non-empty → the master checkpoints the workflow's
@@ -217,6 +226,22 @@ root.common.update({
                             "burn_threshold": 2.0},
         },
         "blackbox_dir": "",
+    },
+    # The live telemetry bus (veles_tpu.watch.bus): a non-empty
+    # "endpoint" (e.g. "tcp://127.0.0.1:9461", or ":0" for a random
+    # port) starts the drop-tolerant ZMQ PUB bus at
+    # Workflow.initialize(); workflows, Decision epoch closes,
+    # PodMaster/PodRuntime and the generative scheduler then publish
+    # JSON snapshots onto it.  "hwm" bounds the per-subscriber send
+    # queue (overflow drops frames — a slow viewer never backpressures
+    # training); "history" sizes the host-side ring the blackbox
+    # post-mortems embed; "conflate" opts into ZMQ keep-only-last wire
+    # semantics.  Watch live: python -m veles_tpu.watch <endpoint>.
+    "watch": {
+        "endpoint": "",
+        "hwm": 64,
+        "history": 256,
+        "conflate": False,
     },
     # Serving robustness: a batched `infer` exceeding this deadline
     # fails the batch's futures with serve.batcher.InferDeadlineExceeded

@@ -681,6 +681,12 @@ class EpochScanRunner(Logger):
             seg2.absorb_pass(include_head=True)
         self.windows += 1
         self.steps += k
+        if any(stage.health_spec is not None for stage in plan.stages):
+            # the window's K steps landed their health stats (final-
+            # iteration values — NaNs persist in donated params, so
+            # the window boundary IS the strict checkpoint)
+            from veles_tpu.watch import health as _health
+            _health.monitor.observe(steps=k, window=True)
 
 
 def build_runner(workflow):

@@ -191,6 +191,26 @@ class GenerativeScheduler(Logger):
         metrics.unregister_histogram("gen_ttft_seconds",
                                      labels={"model": self.name})
 
+    #: decode-step cadence of the telemetry-bus "serve" snapshots
+    WATCH_EVERY = 32
+
+    def watch_snapshot(self):
+        """The compact serving digest published onto the telemetry
+        bus every :data:`WATCH_EVERY` decode steps (and readable any
+        time): queue/slot pressure, throughput counters, TTFT."""
+        return {
+            "model": self.name,
+            "queue_depth": len(self._queue),
+            "active": len(self._active),
+            "prefilling": len(self._prefilling),
+            "batch_fill": round(self.batch_fill(), 4),
+            "admitted_total": self.admitted_total,
+            "finished_total": self.finished_total,
+            "tokens_total": self.tokens_total,
+            "preemptions_total": self.engine.preemptions_total,
+            "ttft_p99_ms": round(self.ttft.percentile(99) * 1e3, 3),
+        }
+
     def batch_fill(self):
         """Mean decode-row utilisation: active slots served per decode
         dispatch over the engine's slot capacity."""
@@ -359,6 +379,7 @@ class GenerativeScheduler(Logger):
         Returns the amount of work done — tokens emitted plus chunks
         fed (0 = idle)."""
         emitted = 0
+        decode_steps_before = self.decode_steps
         while True:
             # pop-and-admit one at a time: every admission updates the
             # slot free list AND the pool headroom before the next
@@ -469,6 +490,16 @@ class GenerativeScheduler(Logger):
                     if active[slot]:
                         self._emit(request, out[slot])
                         emitted += 1
+        from veles_tpu import watch
+        if watch.enabled() \
+                and self.decode_steps != decode_steps_before \
+                and self.decode_steps % self.WATCH_EVERY == 0:
+            # periodic serving snapshot onto the telemetry bus, only
+            # when a decode step actually advanced onto the cadence
+            # (prefill-only pumps must not republish every call) —
+            # NOBLOCK publish, so a dead dashboard never costs a
+            # decode step
+            watch.publish("serve", self.watch_snapshot())
         return emitted
 
     def run_until_idle(self, max_steps=100000):

@@ -9,6 +9,10 @@ fatal exit path dumps a compact JSON post-mortem there —
   them) and its wraparound-proof per-category counts,
 * the PR 6 perf-ledger summary (compiles, recompiles, per-program
   rows, HBM by category),
+* the ``veles_tpu.watch`` tail: the last cached training-health
+  snapshot (what the numerics looked like at death) and the newest
+  telemetry-bus events — so a chaos kill's flight record carries the
+  same live view an attached dashboard was seeing,
 * the role, pid, reason and wall-clock time of death —
 
 via three hooks: ``sys.excepthook`` (unhandled exception),
@@ -36,6 +40,10 @@ KIND = "veles_tpu.obs.blackbox"
 #: how many newest trace events a post-mortem keeps (bounds the file;
 #: the interesting events are the last ones by construction)
 MAX_EVENTS = 8192
+
+#: how many newest telemetry-bus events ride along in the "watch"
+#: block (bounded by the bus's own history ring anyway)
+MAX_BUS_EVENTS = 64
 
 _installed = [False]
 _prev_excepthook = [None]
@@ -77,6 +85,12 @@ def dump(reason, directory=None, extra=None):
             "event_counts": trace.recorder.category_counts(),
             "ledger": prof.summary(),
         }
+        from veles_tpu import watch
+        health = watch.last_health()
+        bus_events = watch.recent_events(MAX_BUS_EVENTS)
+        if health is not None or bus_events:
+            payload["watch"] = {"health": health,
+                                "events": bus_events}
         if extra:
             payload["extra"] = dict(extra)
         os.makedirs(directory, exist_ok=True)
@@ -86,7 +100,11 @@ def dump(reason, directory=None, extra=None):
                int(time.time() * 1e3)))
         tmp = path + ".tmp"
         with open(tmp, "w") as fout:
-            json.dump(payload, fout)
+            # default=repr: a single odd value anywhere in the
+            # payload (a provider-returned numpy scalar riding a bus
+            # event, an exotic ledger field) must degrade to its repr
+            # — never cost the whole flight record
+            json.dump(payload, fout, default=repr)
         os.replace(tmp, path)
         return path
     except Exception:  # pragma: no cover - the recorder must not crash

@@ -23,7 +23,9 @@ mounts it with its own sources:
   plus the shared process-wide sources;
 * the process-wide base (:func:`default_sources`): the PR 6 perf
   ledger gauges always, the trace category counters when tracing is
-  on, a declared :class:`~veles_tpu.obs.slo.SLOEngine` when given.
+  on, the ``veles_tpu.watch`` training-health gauges + bus counters
+  when that plane is armed, a declared
+  :class:`~veles_tpu.obs.slo.SLOEngine` when given.
 
 The exposition text comes from the same renderers the serving
 ``/metrics`` page uses (``veles_tpu.metrics.emit_histogram``,
@@ -50,6 +52,14 @@ def default_sources(slo=None, extra=()):
         return trace.metrics_text() if trace.enabled() else ""
 
     sources.append(trace_source)
+
+    def watch_source():
+        # the training-health gauges + telemetry-bus counters; empty
+        # when neither the health knob nor the bus is armed
+        from veles_tpu import watch
+        return watch.metrics_text()
+
+    sources.append(watch_source)
     if slo is not None:
         def slo_source():
             slo.sample()

@@ -32,6 +32,13 @@ class Plotter(Unit):
         # only the socket send goes to the pool.  Rendering itself
         # already lives in the detached viewer process.
         self.fill()
+        # the telemetry bus (veles_tpu.watch): every plotter doubles
+        # as a thin JSON publisher — the modern viewer surface; the
+        # pickled-matplotlib GraphicsServer below stays for legacy
+        # detached viewers.  Disabled path: one attribute check.
+        from veles_tpu import watch
+        if watch.enabled():
+            watch.publish("plot", self.plot_snapshot())
         from veles_tpu.graphics_server import GraphicsServer
         server = GraphicsServer.instance()
         if server is not None:
@@ -43,6 +50,13 @@ class Plotter(Unit):
     def fill(self):
         """Snapshot linked values into plain attrs (so the pickle is
         self-contained)."""
+
+    def plot_snapshot(self):
+        """The compact JSON-able digest this plotter publishes onto
+        the telemetry bus after every ``fill()`` — subclasses extend
+        with their latest readings (never the full series: bus frames
+        stay small by contract)."""
+        return {"plotter": self.name, "type": type(self).__name__}
 
     def redraw(self, axes):
         """Render onto a matplotlib axes (called in the viewer)."""
@@ -85,6 +99,14 @@ class AccumulatingPlotter(Plotter):
         except (TypeError, ValueError):
             pass
 
+    def plot_snapshot(self):
+        snap = super(AccumulatingPlotter, self).plot_snapshot()
+        snap["label"] = self.label
+        snap["n"] = len(self.values)
+        if self.values:
+            snap["last"] = self.values[-1]
+        return snap
+
     def redraw(self, axes):
         axes.plot(self.values, label=self.label)
         if self.fit_poly_power and len(self.values) > 3:
@@ -112,6 +134,15 @@ class MatrixPlotter(Plotter):
         mem = getattr(value, "mem", value)
         if mem is not None:
             self.matrix = numpy.array(mem)
+
+    def plot_snapshot(self):
+        snap = super(MatrixPlotter, self).plot_snapshot()
+        if self.matrix is not None:
+            snap["shape"] = list(self.matrix.shape)
+            snap["trace"] = float(numpy.trace(self.matrix)) \
+                if self.matrix.ndim == 2 else None
+            snap["total"] = float(self.matrix.sum())
+        return snap
 
     def redraw(self, axes):
         if self.matrix is None:
@@ -399,6 +430,14 @@ class MaxMinPlotter(Plotter):
         self.mins.append(float(arr.min()))
         self.means.append(float(arr.mean()))
 
+    def plot_snapshot(self):
+        snap = super(MaxMinPlotter, self).plot_snapshot()
+        if self.maxes:
+            snap["max"] = self.maxes[-1]
+            snap["min"] = self.mins[-1]
+            snap["mean"] = self.means[-1]
+        return snap
+
     def redraw(self, axes):
         if not self.maxes:
             return
@@ -445,6 +484,14 @@ class SlaveStats(Plotter):
                          float(getattr(s, "power", 0.0)), done,
                          int(getattr(s, "in_flight", 0)), rate))
         self.rows = rows
+
+    def plot_snapshot(self):
+        snap = super(SlaveStats, self).plot_snapshot()
+        snap["slaves"] = [
+            {"sid": sid, "state": state, "done": done,
+             "in_flight": in_flight, "jobs_per_sec": round(rate, 3)}
+            for sid, state, _power, done, in_flight, rate in self.rows]
+        return snap
 
     def redraw(self, axes):
         if not self.rows:

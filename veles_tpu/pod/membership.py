@@ -176,6 +176,12 @@ class PodMaster(Logger):
             "metrics": msg.get("metrics") or {},
             "worker": slave.id,
         }
+        from veles_tpu import watch
+        if watch.enabled():
+            watch.publish("pod_epoch", lease=lease_id,
+                          leases_queued=len(self._queue),
+                          leases_done=len(self.done),
+                          **self.progress[lease_id])
         stop = self.stop_requested \
             or int(msg.get("epoch", 0)) >= self.epochs
         return {"stop": int(bool(stop))}

@@ -438,6 +438,11 @@ class PodRuntime(Logger):
                       {"generation": self.generation,
                        "shards": self.shards,
                        "was": old_shards}, role="pod")
+        from veles_tpu import watch
+        if watch.enabled():
+            watch.publish("reshard", generation=self.generation,
+                          shards=self.shards, was=old_shards,
+                          reshards=self.reshards)
         self.warning(
             "pod resharded %d -> %d shard(s) (generation %d): "
             "dataset + params re-placed, %d segment program(s) "

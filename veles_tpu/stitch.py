@@ -92,11 +92,12 @@ class StitchStage(object):
     """
 
     __slots__ = ("unit", "fn", "consumes", "produces", "params",
-                 "donated", "scalars", "metrics", "prelude")
+                 "donated", "scalars", "metrics", "prelude", "health",
+                 "health_spec")
 
     def __init__(self, unit, fn, consumes=None, produces=None,
                  params=None, donated=None, scalars=None, metrics=(),
-                 prelude=None):
+                 prelude=None, health=None):
         self.unit = unit
         self.fn = fn
         self.consumes = dict(consumes or {})
@@ -109,6 +110,15 @@ class StitchStage(object):
         #: host callable run before every dispatch (serving bookkeeping
         #: of a loader-headed segment); runs BEFORE scalars are fetched
         self.prelude = prelude
+        #: optional traced ``(tensors, out) -> {grad_norm, weight_norm,
+        #: update_norm}`` declaring this stage's health stats — the
+        #: unit-specific half of the ``engine.health`` instrumentation
+        #: (veles_tpu.watch.health); stages without it get the generic
+        #: donated-pair norms, grad_norm omitted
+        self.health = health
+        #: the HealthGroup attached by watch.health.instrument_stages
+        #: (None on an uninstrumented build — i.e. health=off)
+        self.health_spec = None
 
     def vectors(self):
         for group in (self.consumes, self.produces, self.params,
@@ -186,6 +196,11 @@ class StitchSegment(Logger, EnforcedProgram):
         #: absorbs this pass (head included for the GD segment)
         self.epoch_runner = None
         self._member_ids = frozenset(id(u) for u in self.units[1:])
+        #: the health groups riding this program's metrics (non-empty
+        #: only when watch.health.instrument_stages ran over the
+        #: stages before compile — i.e. engine.health != off)
+        self._health_groups = [stage.health_spec for stage in stages
+                               if stage.health_spec is not None]
         self._build_plan()
         self._jitted = jax.jit(self._program, donate_argnums=(2,))
         #: pod binding (veles_tpu.pod.runtime.PodRuntime or None):
@@ -447,6 +462,12 @@ class StitchSegment(Logger, EnforcedProgram):
                     trace.complete("pod", "shard_dispatch", tic,
                                    toc - tic, self._trace_args,
                                    role="pod", tid=shard)
+            if self._health_groups:
+                # one instrumented dispatch = one train step's stats
+                # landed (strict mode fetches at its cadence there —
+                # a HealthError propagates out of this dispatch)
+                from veles_tpu.watch import health as _health
+                _health.monitor.observe(steps=1)
             self._computed = set(self._member_ids)
 
     def member_run(self, unit):
@@ -539,11 +560,21 @@ def build_segments(workflow):
     :class:`StitchSegment`\\ s (empty when stitching is off, the device
     is interpret/absent, or no chain qualifies).  Members get their
     segment attached via the public ``Unit.attach_stitch_segment``."""
+    from veles_tpu.watch import health as watch_health
+    # every (re)build owns the process-wide health monitor: disarm it
+    # FIRST, so a knob flip to off, a stitch-off rebuild or an
+    # interpret-device fallback can never leave the PREVIOUS build's
+    # groups armed (a stale strict monitor would read dead units'
+    # attrs — or raise — at the next Decision class close);
+    # monitor.install() below re-arms when this build instruments
+    watch_health.monitor.reset()
     if not enabled():
         return []
     device = getattr(workflow, "device", None)
     if device is None or getattr(device, "is_interpret", True):
         return []
+    health_mode = watch_health.health_mode()
+    health_groups = []
     cache = {}
     assigned = set()
     segments = []
@@ -582,6 +613,13 @@ def build_segments(workflow):
                 "per-unit dispatch",
                 "+".join(u.name for u in chain), ", ".join(blocked))
             continue
+        groups = []
+        if health_mode != "off":
+            # fold the health stats into the stage fns BEFORE the
+            # segment compiles — they become extra outputs of the same
+            # program (zero extra dispatches); health=off skips this
+            # entirely, leaving the build byte-identical
+            groups = watch_health.instrument_stages(stages)
         try:
             segment = StitchSegment(chain, stages)
         except Exception:
@@ -589,10 +627,17 @@ def build_segments(workflow):
                 "failed to stitch segment %s; falling back to "
                 "per-unit dispatch", [u.name for u in chain])
             continue
+        health_groups.extend(groups)
         for member in chain:
             member.attach_stitch_segment(segment)
             assigned.add(id(member))
         segments.append(segment)
+    if health_mode != "off" and health_groups:
+        watch_health.monitor.install(health_groups, health_mode)
+        workflow.info(
+            "health telemetry %s: %d param group(s): %s",
+            health_mode, len(health_groups),
+            ", ".join(g.name for g in health_groups))
     if segments:
         workflow.info(
             "stitched %d segment(s): %s",

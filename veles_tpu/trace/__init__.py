@@ -24,6 +24,10 @@ serve      request enqueue→reply, batched device calls, AOT bucket
            compiles (:mod:`veles_tpu.serve`)
 jobs       master job generate/apply, slave request/compute/update,
            heartbeat gaps (:mod:`veles_tpu.parallel.jobs`)
+watch      training-health boundary fetches: ``health_check``
+           (strict-mode non-finite sweep) and ``health_snapshot``
+           (full stat fetch) instants — the ONLY host syncs the
+           health telemetry ever adds (:mod:`veles_tpu.watch`)
 =========  ==========================================================
 
 The knob: ``root.common.engine.trace = off | on | <path.json>`` —

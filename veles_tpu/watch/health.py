@@ -1,0 +1,342 @@
+"""In-program training-health telemetry.
+
+PRs 10–12 made training *opaque by design*: a whole epoch is one
+``lax.scan`` dispatch, so a NaN'd gradient or an exploding update
+ratio produces no host-visible signal until the epoch closes — or
+ever.  This module folds compact numerics stats INTO the stitched
+segments' programs (:mod:`veles_tpu.stitch`) so they ride the existing
+deferred-metrics machinery as a handful of async device scalars —
+**zero extra dispatches, zero per-step host syncs**:
+
+* per **param group** (= per stitched stage that donates float
+  parameter/momentum buffers — each GD unit is one group):
+  ``grad_norm`` (the effective gradient incl. weight decay, recovered
+  in-program from the momentum update — declared by the stage's
+  ``health`` callable; the GD family provides it), ``weight_norm``,
+  ``update_norm``, ``update_ratio`` (‖update‖/‖weights‖) and a
+  **non-finite element count per donated leaf**;
+* the stats are extra *outputs of the already-dispatched program* —
+  published through the same ``StitchStage.metrics`` protocol the
+  Decision's deferred metrics use, so they are fetched in the same
+  batched ``device_get_all`` cadence and never add a dispatch;
+* under :class:`~veles_tpu.pod.runtime.PodRuntime` the window/segment
+  programs pin the stats' out-shardings replicated, so GSPMD inserts
+  the cross-shard reduction in-program — every shard reports the SAME
+  value (the psum'd-health agreement the pod tests assert).
+
+Knob: ``root.common.engine.health = off | on | strict`` — read at
+``Workflow.rebuild_stitching()`` time (the same boundary as the
+``stitch`` knob).  ``off`` (default) leaves every program **bitwise
+byte-identical** to the uninstrumented build; ``on`` collects; and
+``strict`` additionally fetches the per-leaf non-finite counts at
+window boundaries (every epoch-scan window; every
+``metrics_every``-or-:data:`STRICT_CHECK_EVERY` steps on the per-step
+path; every class close) and raises a typed :class:`HealthError`
+naming the **first non-finite parameter leaf** (stage order, then
+leaf name).  NaNs persist through momentum updates, so checking the
+latest values is sufficient — no per-step history is kept on device.
+
+The process-wide :data:`monitor` holds the latest device scalars and
+serves :meth:`HealthMonitor.snapshot` to the telemetry bus
+(:mod:`veles_tpu.watch.bus`), ``web_status`` pushes and the
+``obs.blackbox`` flight recorder.
+"""
+
+import numpy
+
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+
+#: strict-mode check cadence on the per-step path when
+#: ``root.common.engine.metrics_every`` is unset: one batched fetch of
+#: the non-finite leaf counts every this-many observed train steps
+STRICT_CHECK_EVERY = 32
+
+#: the declared-stat names a stage ``health`` callable may return
+#: (``update_ratio`` is derived in the wrapper, never declared)
+DECLARED_STATS = ("grad_norm", "weight_norm", "update_norm")
+
+
+def health_mode():
+    """The ``root.common.engine.health`` knob: ``off`` | ``on`` |
+    ``strict`` (read at ``rebuild_stitching`` time, like ``stitch``)."""
+    value = root.common.engine.get("health", "off")
+    if value is None:
+        return "off"
+    value = str(value).strip().lower()
+    if value in ("off", "0", "false", "no", ""):
+        return "off"
+    if value in ("on", "1", "true", "yes"):
+        return "on"
+    if value == "strict":
+        return "strict"
+    raise ValueError(
+        "root.common.engine.health must be off|on|strict, got %r"
+        % value)
+
+
+class HealthError(RuntimeError):
+    """Strict-mode verdict: a parameter leaf went non-finite.
+
+    ``leaf`` names the first bad leaf (``<unit>.<slot>``, stage order
+    then slot name), ``count`` its non-finite element count, ``step``
+    the observed train step at the failing boundary."""
+
+    def __init__(self, leaf, count, step):
+        self.leaf = leaf
+        self.count = int(count)
+        self.step = int(step)
+        super(HealthError, self).__init__(
+            "non-finite parameter leaf %r (%d element(s)) at train "
+            "step %d — the first bad param group; inspect "
+            "watch.health.monitor.snapshot() / lower the learning "
+            "rate (root.common.engine.health=strict)"
+            % (leaf, self.count, self.step))
+
+
+class HealthGroup(object):
+    """One instrumented param group (one donating stitched stage):
+    the unit the stats land on and the metric-attribute names to read
+    them back from (the stitched dispatch assigns them via the
+    standard ``StitchStage.metrics`` → ``setattr`` protocol)."""
+
+    __slots__ = ("unit", "name", "stats", "leaves")
+
+    def __init__(self, unit, stats, leaves):
+        self.unit = unit
+        self.name = unit.name
+        #: aggregate stats: {stat_name: metric_attr}
+        self.stats = dict(stats)
+        #: per-leaf non-finite counts: [(leaf_label, metric_attr)]
+        self.leaves = list(leaves)
+
+
+def _float_leaves(stage):
+    """The donated slots health instruments: float-dtype Vectors
+    (params/momentum), sorted by slot name.  Integer donations (the
+    evaluator's confusion matrix) are not param groups."""
+    out = []
+    for name in sorted(stage.donated):
+        vec = stage.donated[name]
+        dtype = getattr(vec, "dtype", None)
+        if dtype is None:
+            mem = getattr(vec, "mem", None)
+            dtype = getattr(mem, "dtype", None)
+        if dtype is not None and numpy.issubdtype(dtype,
+                                                  numpy.floating):
+            out.append(name)
+    return out
+
+
+def _wrap_stage_fn(fn, declared, leaves):
+    """The instrumented stage body: run the original ``fn``, then fold
+    the health stats over its donated outputs — pure traced jax math,
+    so the stats compile into the SAME program (and the same
+    ``lax.scan`` body under epoch mode)."""
+    def instrumented(t):
+        import jax.numpy as jnp
+        out = fn(t)
+        stats = {}
+        total = None
+        for leaf in leaves:
+            arr = out[leaf].astype(jnp.float32)
+            count = jnp.sum(jnp.logical_not(jnp.isfinite(arr)),
+                            dtype=jnp.int32)
+            stats["health_nf_" + leaf] = count
+            total = count if total is None else total + count
+        stats["health_nonfinite"] = total
+        if declared is not None:
+            extra = declared(t, out)
+        else:
+            # generic fallback for donating stages without a declared
+            # health callable: norms over (new, new-old) donated pairs
+            wsq = sum(jnp.sum(jnp.square(out[leaf].astype(
+                jnp.float32))) for leaf in leaves)
+            usq = sum(jnp.sum(jnp.square(
+                out[leaf].astype(jnp.float32)
+                - t[leaf].astype(jnp.float32))) for leaf in leaves)
+            extra = {"weight_norm": jnp.sqrt(wsq),
+                     "update_norm": jnp.sqrt(usq)}
+        for key in DECLARED_STATS:
+            if key in extra:
+                stats["health_" + key] = extra[key].astype(jnp.float32)
+        if "health_update_norm" in stats \
+                and "health_weight_norm" in stats:
+            stats["health_update_ratio"] = \
+                stats["health_update_norm"] \
+                / (stats["health_weight_norm"] + jnp.float32(1e-12))
+        out.update(stats)
+        return out
+    return instrumented
+
+
+def instrument_stages(stages):
+    """Fold health stats into every donating stage of one stitched
+    chain (called by :func:`veles_tpu.stitch.build_segments` before
+    the segment compiles, so the stats are part of the program from
+    its first trace).  Returns the list of :class:`HealthGroup`\\ s
+    created; mutates each instrumented stage in place (``fn`` wrapped,
+    ``metrics`` extended, ``health_spec`` attached).  Epoch-scan
+    window plans reuse the same stage objects, so windows inherit the
+    instrumentation with no extra work."""
+    groups = []
+    for stage in stages:
+        if getattr(stage, "health_spec", None) is not None:
+            # already instrumented (a failed segment construction left
+            # the wrapped stage in build_segments' cache and another
+            # chain picked it up) — re-wrapping would compute every
+            # stat twice; reuse the existing group
+            groups.append(stage.health_spec)
+            continue
+        leaves = _float_leaves(stage)
+        if not leaves:
+            continue
+        declared = getattr(stage, "health", None)
+        stage.fn = _wrap_stage_fn(stage.fn, declared, leaves)
+        names = ["health_nf_" + leaf for leaf in leaves]
+        names.append("health_nonfinite")
+        stat_names = list(DECLARED_STATS) if declared is not None \
+            else ["weight_norm", "update_norm"]
+        names.extend("health_" + s for s in stat_names)
+        names.append("health_update_ratio")
+        stage.metrics = tuple(stage.metrics) + tuple(names)
+        group = HealthGroup(
+            stage.unit,
+            stats=dict(
+                [(s, "health_" + s) for s in stat_names]
+                + [("update_ratio", "health_update_ratio"),
+                   ("nonfinite", "health_nonfinite")]),
+            leaves=[(leaf, "health_nf_" + leaf) for leaf in leaves])
+        stage.health_spec = group
+        groups.append(group)
+    return groups
+
+
+class HealthMonitor(Logger):
+    """The process-wide collector: latest per-group device scalars
+    (async — reading them costs nothing until a snapshot/check
+    fetches), the strict-mode cadence, and the host-side snapshot the
+    bus / web_status / blackbox consume.
+
+    (Re)armed by ``rebuild_stitching`` through :meth:`install`; one
+    training workflow per process owns it, like the trace recorder
+    and the perf ledger."""
+
+    def __init__(self, **kwargs):
+        super(HealthMonitor, self).__init__(**kwargs)
+        self.reset()
+
+    def reset(self):
+        self.groups = []
+        self.mode = "off"
+        #: observed train steps (GD-stage dispatches × their K)
+        self.steps = 0
+        #: strict-mode batched fetches performed
+        self.checks = 0
+        self._since_check = 0
+        #: the last HOST-side snapshot dict (what blackbox embeds)
+        self.last_snapshot = None
+
+    @property
+    def armed(self):
+        return bool(self.groups) and self.mode != "off"
+
+    def install(self, groups, mode):
+        """Arm for one freshly stitched workflow (its full group
+        list); resets the counters — a rebuild is a new run."""
+        self.reset()
+        self.groups = list(groups)
+        self.mode = mode
+
+    def describe(self):
+        return {"mode": self.mode, "groups": [g.name
+                                              for g in self.groups],
+                "steps": self.steps, "checks": self.checks}
+
+    def _check_every(self):
+        every = int(root.common.engine.get("metrics_every", 0) or 0)
+        return every if every > 0 else STRICT_CHECK_EVERY
+
+    def observe(self, steps=1, window=False):
+        """One instrumented dispatch landed ``steps`` train steps'
+        stats (K for an epoch-scan window).  Free unless strict mode
+        is due for a boundary check (every window; every
+        ``_check_every()`` steps on the per-step path)."""
+        self.steps += int(steps)
+        self._since_check += int(steps)
+        if self.mode != "strict":
+            return
+        if window or self._since_check >= self._check_every():
+            self.check()
+
+    def check(self):
+        """The strict boundary: ONE batched fetch of every group's
+        per-leaf non-finite counts (latest values — NaNs persist in
+        donated params, so latest is sufficient); raises
+        :class:`HealthError` naming the first bad leaf."""
+        self._since_check = 0
+        self.checks += 1
+        from veles_tpu import trace
+        from veles_tpu.memory import device_get_all
+        trace.instant("watch", "health_check", {"step": self.steps})
+        slots = [(group, leaf, attr)
+                 for group in self.groups
+                 for leaf, attr in group.leaves]
+        values = device_get_all(
+            [getattr(group.unit, attr, 0)
+             for group, _leaf, attr in slots])
+        for (group, leaf, _attr), value in zip(slots, values):
+            if int(value) > 0:
+                raise HealthError("%s.%s" % (group.name, leaf),
+                                  int(value), self.steps)
+
+    def maybe_snapshot(self):
+        """:meth:`snapshot` when armed, else ``None`` — the
+        unconditional call sites (Decision class close) use this so
+        ``health=off`` costs two attribute checks."""
+        if not self.armed:
+            return None
+        return self.snapshot()
+
+    def snapshot(self):
+        """Fetch every group's full stat set in ONE batched
+        ``device_get_all`` and return (and cache) the JSON-able
+        snapshot.  Strict mode also applies the non-finite verdict
+        here, so a class close never passes silently over a bad
+        leaf."""
+        from veles_tpu import trace
+        from veles_tpu.memory import device_get_all
+        trace.instant("watch", "health_snapshot",
+                      {"step": self.steps})
+        slots = []
+        for group in self.groups:
+            for stat, attr in sorted(group.stats.items()):
+                slots.append((group, "stat", stat, attr))
+            for leaf, attr in group.leaves:
+                slots.append((group, "leaf", leaf, attr))
+        values = device_get_all(
+            [getattr(group.unit, attr, 0)
+             for group, _kind, _name, attr in slots])
+        groups = {}
+        first_bad = None
+        for (group, kind, name, _attr), value in zip(slots, values):
+            entry = groups.setdefault(
+                group.name, {"leaves": {}})
+            if kind == "stat":
+                entry[name] = int(value) if name == "nonfinite" \
+                    else float(value)
+            else:
+                count = int(value)
+                entry["leaves"][name] = count
+                if count > 0 and first_bad is None:
+                    first_bad = ("%s.%s" % (group.name, name), count)
+        snap = {"mode": self.mode, "step": self.steps,
+                "groups": groups}
+        self.last_snapshot = snap
+        if self.mode == "strict" and first_bad is not None:
+            raise HealthError(first_bad[0], first_bad[1], self.steps)
+        return snap
+
+
+#: the process-wide monitor every instrumented dispatch reports to
+monitor = HealthMonitor()

@@ -171,11 +171,20 @@ class StatusNotifier(Logger):
                 workflow.get_unit_run_time_stats()[:10]],
             "events": list(self.pending_events),
         }
-        from veles_tpu import trace
+        from veles_tpu import trace, watch
         if trace.enabled():
             # the compact where-did-the-step-go digest rides along
             # (per-category totals, top spans, dispatch vs host gap)
             data["trace"] = trace.summary()
+        # the latest training-health block (veles_tpu.watch): cached
+        # by the Decision's class-close snapshot whenever the
+        # engine.health knob is armed — the status page shows the
+        # numerics next to the metrics
+        health = watch.last_health()
+        if health is not None:
+            data["health"] = health
+        if watch.enabled():
+            data["watch"] = watch.bus().describe()
         self.pending_events.clear()
         return data
 

@@ -197,15 +197,17 @@ class Workflow(Unit):
         Only :class:`~veles_tpu.units.MissingDemandedAttributes` requeues —
         each unit at most once per remaining peer — so genuine
         AttributeError bugs in ``initialize()`` bodies surface immediately."""
-        from veles_tpu import trace
+        from veles_tpu import trace, watch
         from veles_tpu.obs import blackbox
         from veles_tpu.units import MissingDemandedAttributes
         # honor the root.common.engine.trace knob per initialize (the
         # natural "a run starts here" boundary — off stays a single
         # attribute check in every hook); the flight-recorder knob
-        # (root.common.obs.blackbox_dir) arms at the same boundary
+        # (root.common.obs.blackbox_dir) and the telemetry-bus knob
+        # (root.common.watch.endpoint) arm at the same boundary
         trace.configure()
         blackbox.configure()
+        watch.configure()
         self.device = device
         pending = collections.deque(self.units_in_dependency_order())
         retries = {}
@@ -339,10 +341,42 @@ class Workflow(Unit):
         self._finished_event_.clear()
         tic = time.time()
         self.event("run", "begin")
+        from veles_tpu import watch
+        if watch.enabled():
+            watch.publish("run", phase="begin",
+                          workflow=type(self).__name__)
         self.schedule(self.start_point, None)
         self._drain()
         self._run_time += time.time() - tic
         self.event("run", "end")
+        if watch.enabled():
+            watch.publish("run", phase="end",
+                          workflow=type(self).__name__,
+                          run_time=round(self._run_time, 3),
+                          results=self.gather_results())
+            watch.publish("perf", self._perf_event())
+
+    def _perf_event(self):
+        """The compact perf digest a run's end publishes onto the
+        telemetry bus: ledger counters + the HBM ledger peak — the
+        live twin of ``perf_report()``'s headline numbers."""
+        from veles_tpu import prof
+        from veles_tpu.memory import Watcher
+        totals = prof.ledger.summary()["totals"]
+        hbm = Watcher.hbm_ledger()
+        event = {key: totals.get(key) for key in
+                 ("compiles", "recompiles", "flops_dispatched",
+                  "achieved_flops", "mfu", "psum_bytes_moved")}
+        event["hbm_peak_bytes"] = hbm.get("peak_bytes", 0)
+        event["hbm_bytes"] = {
+            cat: info["bytes"] for cat, info in
+            hbm.get("by_category", {}).items() if info}
+        report = self.stitch_report()
+        event["dispatches"] = report.get("dispatches", 0)
+        scan = report.get("epoch_scan") or {}
+        event["scan_windows"] = scan.get("windows", 0)
+        event["scan_steps"] = scan.get("steps", 0)
+        return event
 
     def _drain(self):
         """Pop-and-run until the queue is empty AND no background unit is

@@ -239,6 +239,28 @@ class DecisionBase(Unit):
         return not pending or (len(pending) == 1
                                and pending[0] is self._scan_accums_[cls])
 
+    def _publish_close(self, cls, metrics):
+        """Telemetry-bus hook every class close runs: one ``epoch``
+        event plus — when the ``engine.health`` knob is armed — one
+        batched health snapshot fetch (the class close is already a
+        host sync point, so the fetch amortizes into the existing
+        deferred-metrics flush) published as a ``health`` event and
+        cached for ``web_status``/blackbox.  Strict mode applies its
+        non-finite verdict inside ``snapshot()``, so a bad leaf never
+        survives a class close silently.  Disabled path: two attribute
+        checks."""
+        from veles_tpu import watch
+        snap = watch.monitor.maybe_snapshot()
+        if not watch.enabled():
+            return
+        if snap is not None:
+            watch.publish("health", snap)
+        watch.publish("epoch", dict(
+            metrics, cls=CLASS_NAME[cls],
+            epoch=int(self.epoch_number),
+            improved=bool(self.improved),
+            complete=bool(self.complete)))
+
     def link_from_loader(self, loader):
         self.link_attrs(
             loader, "minibatch_class", "minibatch_size", "last_minibatch",
@@ -374,6 +396,12 @@ class DecisionGD(DecisionBase):
                 self._epochs_without_improvement += 1
         if check_epoch_end or (validated and self.is_master):
             self._on_epoch_ended()
+        self._publish_close(cls, {
+            "n_err_pt": float(self.epoch_n_err_pt[cls]),
+            "n_err": float(self.epoch_n_err[cls]),
+            "samples": int(self.epoch_samples[cls]),
+            "best_n_err_pt": float(self.best_n_err_pt),
+            "best_epoch": int(self.best_epoch)})
         self.epoch_n_err[cls] = 0
         self.epoch_samples[cls] = 0
 
@@ -470,6 +498,11 @@ class DecisionMSE(DecisionBase):
                 self.complete <<= True
             if self._epochs_without_improvement >= self.fail_iterations:
                 self.complete <<= True
+        self._publish_close(cls, {
+            "mse": float(self.epoch_mse[cls]),
+            "batches": int(self.epoch_batches[cls]),
+            "best_mse": float(self.best_mse),
+            "best_epoch": int(self.best_epoch)})
         self.epoch_sum_mse[cls] = 0.0
         self.epoch_batches[cls] = 0
 

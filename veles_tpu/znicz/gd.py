@@ -185,6 +185,34 @@ class GradientDescent(GradientDescentBase):
         if has_bias:
             donated["b"] = self.bias
             donated["vb"] = self.gradient_bias
+
+        def health(t, out):
+            # the engine.health declared stats (veles_tpu.watch
+            # .health): the effective gradient (incl. weight decay)
+            # recovered from the momentum recurrence
+            # vw' = moment·vw − lr·(grad + decay·w), so a changing
+            # learning rate never needs a second backward pass; lr=0
+            # guards keep a frozen group's stats at zero instead of
+            # inf
+            def grad_sq(vnew, vold, lr, mom):
+                safe = jnp.where(lr != 0, lr, 1.0)
+                g = jnp.where(lr != 0, (mom * vold - vnew) / safe, 0.0)
+                return jnp.sum(jnp.square(g.astype(jnp.float32)))
+
+            gsq = grad_sq(out["vw"], t["vw"], t["lr"], t["moment"])
+            wsq = jnp.sum(jnp.square(out["w"].astype(jnp.float32)))
+            usq = jnp.sum(jnp.square(out["vw"].astype(jnp.float32)))
+            if has_bias:
+                gsq = gsq + grad_sq(out["vb"], t["vb"], t["lr_b"],
+                                    t["moment_b"])
+                wsq = wsq + jnp.sum(jnp.square(
+                    out["b"].astype(jnp.float32)))
+                usq = usq + jnp.sum(jnp.square(
+                    out["vb"].astype(jnp.float32)))
+            return {"grad_norm": jnp.sqrt(gsq),
+                    "weight_norm": jnp.sqrt(wsq),
+                    "update_norm": jnp.sqrt(usq)}
+
         return StitchStage(
             self, fn,
             consumes={"input": self.input, "output": self.output,
@@ -199,7 +227,8 @@ class GradientDescent(GradientDescentBase):
                 "decay_b": unit.weights_decay_bias,
                 "moment": unit.gradient_moment,
                 "moment_b": unit.gradient_moment_bias,
-            })
+            },
+            health=health)
 
 
 class GDTanh(GradientDescent):
