@@ -1606,6 +1606,85 @@ def stage_transformer_gen():
                         "long-tail phase" % lt_recompiles)
     print(_dumps(rec))
 
+    # -- int8 phase: weight-only quantized serving vs the SAME-RUN --
+    # float twin at the SAME compute dtype (bf16 on chip — so
+    # vs_bf16_x is the on-chip quantization win; f32 on the tiny/CPU
+    # path, where the column still isolates the int8 weights instead
+    # of conflating a compute-dtype mismatch).  Both engines run the
+    # phase-1 workload through the continuous scheduler;
+    # hbm_per_request_bytes (params amortized over occupants) is the
+    # capacity win — both regression-gated by scripts/bench_diff.py
+    # from round one.
+    def build_q(quantize):
+        # BOTH engines share the phase-1 compute dtype (bf16 on chip,
+        # f32 on the tiny/CPU path) so the ratio isolates the int8
+        # weights, never a compute-dtype mismatch
+        model = TransformerGenModel(
+            cfg, compute_dtype=dtype) if dtype else \
+            TransformerGenModel(cfg)
+        engine = GenerativeEngine(model, max_slots=slots,
+                                  max_seq=max_seq,
+                                  prefill_buckets=buckets, seed=0)
+        if quantize:
+            # a random-/lightly-trained bench model legitimately
+            # exceeds the 1e-2 production drift budget; the bench
+            # measures throughput, not accuracy, so gate loosely
+            engine.quantize_int8(calibration_tokens=workload[0][0],
+                                 tol=0.2)
+        return engine.warmup()
+
+    def run_q(engine):
+        scheduler = GenerativeScheduler(engine, name="bench-int8")
+        futures = [scheduler.submit(toks, max_new)
+                   for toks, max_new in workload]
+        hbm_sum = hbm_n = 0
+        tic = time.perf_counter()
+        while scheduler.queue_depth() or scheduler.active_requests():
+            if scheduler.step() == 0:
+                break
+            per_req = engine.hbm_per_request_bytes()
+            if per_req:
+                hbm_sum += per_req
+                hbm_n += 1
+        sec = time.perf_counter() - tic
+        assert all(f.done() for f in futures)
+        return (scheduler.tokens_total, sec,
+                hbm_sum // max(1, hbm_n))
+
+    recompiles0 = prof.ledger.recompiles
+    bf16_engine = build_q(False)
+    bf16_tokens, bf16_sec, _bf16_hbm = run_q(bf16_engine)
+    bf16_params = bf16_engine.params_nbytes
+    bf16_engine.close()
+    int8_engine = build_q(True)
+    q_tokens, q_sec, q_hbm = run_q(int8_engine)
+    q_params = int8_engine.params_nbytes
+    int8_engine.close()
+    q_recompiles = prof.ledger.recompiles - recompiles0
+    bf16_tps = bf16_tokens / bf16_sec if bf16_sec else 0.0
+    q_tps = q_tokens / q_sec if q_sec else 0.0
+    rec = {
+        "metric": "transformer generative serving, int8 quantized "
+                  "(weight-only)"
+                  + (" [tiny-smoke]" if tiny else ""),
+        "value": round(q_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "quantize": "int8",
+        "vs_bf16_x": round(q_tps / bf16_tps, 3) if bf16_tps else None,
+        "bf16_tokens_per_sec": round(bf16_tps, 1),
+        "hbm_per_request_bytes": q_hbm,
+        "params_bytes": q_params,
+        "params_vs_float_x": round(q_params / float(bf16_params), 3),
+        "recompiles": q_recompiles,
+        "slots": slots,
+        "requests": n_requests,
+        "device_kind": _device_kind()}
+    if q_recompiles:
+        rec["error"] = ("%d steady-state recompile(s) in the int8 "
+                        "phase" % q_recompiles)
+    print(_dumps(rec))
+
 
 #: the reference DB's fastest recorded matmul: GTX TITAN, float,
 #: precision 0 — 0.1642 s for ONE 3001² matmul (``backends.py:672-731``

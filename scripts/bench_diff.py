@@ -26,6 +26,10 @@ line; ``--ignore-device`` overrides):
   way).
 * ``steps_per_dispatch`` — lower than banked by more than the
   tolerance is a regression (the one-dispatch-epoch win eroding).
+* ``vs_bf16_x`` (higher-better) / ``hbm_per_request_bytes``
+  (lower-better) — the ``stage_transformer_gen`` int8 + long-tail
+  columns: the quantized-serving throughput win and the per-request
+  HBM footprint, gated so the int8 win is a number from round one.
 
 Usage::
 
@@ -71,8 +75,11 @@ def _is_rate_unit(unit):
 _COUNTERS = ("recompiles", "dispatches_per_epoch")
 
 #: soft fields beyond ``value`` compared with the relative tolerance
-_HIGHER_BETTER_FIELDS = ("mfu", "steps_per_dispatch")
-_LOWER_BETTER_FIELDS = ("sec_per_step",)
+#: (vs_bf16_x: the int8 serving win over the same-run bf16 engine;
+#: hbm_per_request_bytes: the paged/int8 capacity win — both from
+#: the stage_transformer_gen int8/long-tail records)
+_HIGHER_BETTER_FIELDS = ("mfu", "steps_per_dispatch", "vs_bf16_x")
+_LOWER_BETTER_FIELDS = ("sec_per_step", "hbm_per_request_bytes")
 
 
 def value_direction(record):

@@ -56,7 +56,11 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m veles_tpu.chaos --smoke
 # below the working set) must reproduce the contiguous token streams
 # EXACTLY while exercising and recovering >=1 pool-exhaustion
 # preemption — the lossless-preemption gate (docs/services.md § Paged
-# KV)
+# KV); a third INT8 session (deploy-time per-channel weight
+# quantization, the qgemm dequant-epilogue path) must complete the
+# same budgets with zero steady-state compiles, a params footprint
+# <=0.35x its float twin and the calibration drift gate green
+# (docs/services.md § Quantized serving)
 echo "== gen smoke (generative serving + paged KV gate) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python -m veles_tpu.gen --smoke
 # obs smoke: the fleet-observability gate — with tracing off every

@@ -1,0 +1,663 @@
+"""veles_tpu.quant + ops.qgemm — int8 serving tests.
+
+THE gates live here: interpret-mode parity of the Pallas int8 kernel
+against the dense-jnp dequant reference (bitwise where the grid is a
+single block, strict-tolerance across remainder tiles / shuffled
+scales / every fused activation), quantized-vs-float top-1 agreement
+≥99% on the mnist sample logits with a ≤0.35× params-category HBM
+ledger line, the PR 8/PR 11 continuous==sequential parity gates
+re-run green under ``quantize="int8"`` in BOTH kv modes with zero
+steady-state compiles, and the ``-m slow`` ≥1.2× tokens/s floor over
+the same-run bf16 engine on CPU JAX.
+"""
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu import prof, quant
+from veles_tpu.config import root
+from veles_tpu.memory import Watcher
+from veles_tpu.ops import qgemm
+
+
+@pytest.fixture
+def interpret():
+    saved = root.common.engine.get("interpret", False)
+    root.common.engine.interpret = True
+    yield
+    root.common.engine.interpret = saved
+
+
+def params_category_bytes():
+    return Watcher.hbm_ledger()["by_category"].get(
+        "params", {}).get("bytes", 0)
+
+
+# ---------------------------------------------------------------------------
+# the quantization walk
+# ---------------------------------------------------------------------------
+
+class TestQuantizeWalk:
+    def test_quantize_array_per_channel_shapes_and_error_bound(self):
+        rng = numpy.random.default_rng(0)
+        w = rng.standard_normal((96, 40)).astype(numpy.float32)
+        qw = quant.quantize_array(w, axes=(0,))
+        assert qw["q"].dtype == numpy.int8
+        assert qw["scale"].dtype == numpy.float32
+        assert qw["scale"].shape == (1, 40)       # keepdims broadcast
+        deq = quant.dequantize_array(qw)
+        # abs-max symmetric: per-channel error <= scale/2 (one rint)
+        err = numpy.abs(deq - w)
+        assert numpy.all(err <= qw["scale"] * 0.5 + 1e-7)
+        # the extreme element per channel is exactly representable
+        assert numpy.allclose(numpy.abs(deq).max(0),
+                              numpy.abs(w).max(0), rtol=1e-2)
+
+    def test_zero_channel_guard(self):
+        w = numpy.zeros((8, 4), numpy.float32)
+        qw = quant.quantize_array(w)
+        assert numpy.all(qw["q"] == 0)
+        assert numpy.all(qw["scale"] == 1.0)      # never 0/0
+
+    def test_stage_walk_quantizes_2d_w_only(self):
+        rng = numpy.random.default_rng(1)
+        stages = [
+            {"w": rng.standard_normal((8, 4)).astype(numpy.float32),
+             "b": numpy.ones(4, numpy.float32)},
+            {"w": rng.standard_normal((3, 3, 2, 5)).astype(
+                numpy.float32)},                  # conv kernel: float
+            {"seed": numpy.int32(7)},             # dropout: untouched
+        ]
+        out = quant.quantize_stage_params(stages)
+        assert quant.is_quantized_leaf(out[0]["w"])
+        assert out[0]["b"].dtype == numpy.float32     # bias kept f32
+        assert not quant.is_quantized_leaf(out[1]["w"])
+        assert out[1]["w"].dtype == numpy.float32
+        assert out[2]["seed"] == 7
+        assert quant.tree_is_quantized(out)
+        assert not quant.tree_is_quantized(stages)
+
+    def test_stage_walk_transposed_axis(self):
+        rng = numpy.random.default_rng(2)
+        w = rng.standard_normal((10, 6)).astype(numpy.float32)
+        # transposed storage (neurons, fan-in): canonicalized to
+        # (fan-in, neurons) at deploy — one scale per output neuron,
+        # and the serving kernel consumes q exactly as stored
+        out = quant.quantize_stage_params(
+            [{"w": w}], axes_list=[{"w": (1,)}])
+        assert out[0]["w"]["q"].shape == (6, 10)
+        assert out[0]["w"]["scale"].shape == (1, 10)
+        assert numpy.allclose(
+            quant.dequantize_array(out[0]["w"]), w.T, atol=1e-1)
+
+    def test_nothing_quantizable_is_typed_error(self):
+        with pytest.raises(quant.QuantizationError):
+            quant.quantize_stage_params([{"b": numpy.ones(
+                4, numpy.float32)}])
+
+    def test_tree_nbytes_prices_actual_dtypes(self):
+        w = numpy.ones((100, 10), numpy.float32)
+        fbytes = quant.tree_nbytes([{"w": w}])
+        qbytes = quant.tree_nbytes(quant.quantize_stage_params(
+            [{"w": w}]))
+        assert fbytes == 4000
+        assert qbytes == 1000 + 40        # int8 payload + f32 scales
+
+
+# ---------------------------------------------------------------------------
+# the Pallas kernel vs the dense-jnp dequant reference (interpret mode)
+# ---------------------------------------------------------------------------
+
+ACTIVATIONS = (None, "tanh", "sigmoid", "relu", "strict_relu", "gelu")
+
+
+class TestQGemmParity:
+    def test_single_block_bitwise(self, interpret):
+        """Grid = ONE block (aligned shapes, tiles cover everything):
+        the kernel's dot/scale/bias/activation sequence must be
+        BITWISE identical to the dense reference's."""
+        rng = numpy.random.default_rng(3)
+        a = jnp.asarray(rng.standard_normal((32, 128)),
+                        jnp.float32)
+        w = rng.standard_normal((128, 128)).astype(numpy.float32)
+        qw = quant.quantize_array(w, axes=(0,))
+        q = jnp.asarray(qw["q"])
+        scale = jnp.asarray(qw["scale"].reshape(-1))
+        bias = jnp.asarray(rng.standard_normal(128), jnp.float32)
+        for act in ACTIVATIONS:
+            ref = qgemm._qmatmul_jnp(a, q, scale, bias, act)
+            got = qgemm.qmatmul(a, q, scale, bias, act,
+                                use_pallas=True,
+                                tiles=(32, 128, 128))
+            assert numpy.asarray(ref).tobytes() == \
+                numpy.asarray(got).tobytes(), act
+
+    def test_remainder_tiles_and_shuffled_scales(self, interpret):
+        """M/N remainder tiles + a K split + permuted (non-monotone)
+        scales: strict tolerance vs the dense reference (CPU XLA dots
+        of different blocking are not ulp-identical), exact output
+        slicing, and the padded columns never leak."""
+        rng = numpy.random.default_rng(4)
+        a = jnp.asarray(rng.standard_normal((100, 300)), jnp.float32)
+        w = rng.standard_normal((300, 136)).astype(numpy.float32)
+        qw = quant.quantize_array(w, axes=(0,))
+        perm = rng.permutation(136)
+        q = jnp.asarray(qw["q"][:, perm])
+        scale = jnp.asarray(qw["scale"].reshape(-1)[perm])
+        bias = jnp.asarray(rng.standard_normal(136), jnp.float32)
+        for act in ACTIVATIONS:
+            ref = qgemm._qmatmul_jnp(a, q, scale, bias, act)
+            got = qgemm.qmatmul(a, q, scale, bias, act,
+                                use_pallas=True,
+                                tiles=(32, 128, 128))
+            assert got.shape == (100, 136)
+            assert numpy.allclose(numpy.asarray(got),
+                                  numpy.asarray(ref),
+                                  atol=2e-5), act
+
+    def test_no_bias_path(self, interpret):
+        rng = numpy.random.default_rng(5)
+        a = jnp.asarray(rng.standard_normal((16, 128)), jnp.float32)
+        qw = quant.quantize_array(
+            rng.standard_normal((128, 128)).astype(numpy.float32))
+        q, scale = jnp.asarray(qw["q"]), \
+            jnp.asarray(qw["scale"].reshape(-1))
+        ref = qgemm._qmatmul_jnp(a, q, scale, None, "relu")
+        got = qgemm.qmatmul(a, q, scale, None, "relu",
+                            use_pallas=True, tiles=(16, 128, 128))
+        assert numpy.asarray(ref).tobytes() == \
+            numpy.asarray(got).tobytes()
+
+    def test_dispatch_consults_gemm_int8_rating(self, tmp_path,
+                                                monkeypatch):
+        """The autotune DB's ``gemm_int8`` row decides the backend
+        and supplies the measured tiles, like ``ops.gemm.matmul``'s
+        own rows (on-TPU resolution forced for the assertion)."""
+        import json
+
+        from veles_tpu.ops import benchmark
+        db = {"FakeTPU v9": {"gemm_int8": {"float32": {
+            "backend": "pallas", "tiles": [64, 128, 128],
+            "sec_per_flop": 1e-12}}}}
+        path = tmp_path / "device_infos.json"
+        path.write_text(json.dumps(db))
+        monkeypatch.setattr(benchmark, "DEVICE_INFOS_JSON", str(path))
+
+        class _Dev:
+            device_kind = "FakeTPU v9"
+
+        monkeypatch.setattr(jax, "devices", lambda *a: [_Dev()])
+        import veles_tpu.ops as ops_pkg
+        monkeypatch.setattr(ops_pkg, "on_tpu", lambda: True)
+        benchmark.gemm_choice.cache_clear()
+        try:
+            use, tiles = qgemm._dispatch(None, None, numpy.float32,
+                                         (64, 128, 128))
+            assert use is True
+            assert tiles == (64, 128, 128)
+            # explicit False still wins over the DB
+            use, _ = qgemm._dispatch(False, None, numpy.float32)
+            assert use is False
+        finally:
+            benchmark.gemm_choice.cache_clear()
+
+    def test_autotune_gemm_int8_sweep_writes_rating(self, tmp_path):
+        """The sweep persists a consultable ``gemm_int8`` row on the
+        attached backend (CPU: the Pallas candidates fail to build and
+        the dense baseline wins — a recorded verdict, not a crash)."""
+        from veles_tpu.backends import DeviceInfo
+        from veles_tpu.ops.benchmark import autotune_gemm_int8
+        path = str(tmp_path / "db.json")
+        info = autotune_gemm_int8(shapes=((64, 64, 64),),
+                                  dtypes=("float32",), runs=1,
+                                  db_path=path)
+        entry = info.ratings["gemm_int8"]["float32"]
+        assert entry["backend"] in ("xla", "pallas")
+        assert entry["sec_per_flop"] > 0
+        reloaded = DeviceInfo.load_db(path)
+        assert any("gemm_int8" in i.ratings
+                   for i in reloaded.values())
+
+
+# ---------------------------------------------------------------------------
+# the calibration drift gate
+# ---------------------------------------------------------------------------
+
+class TestCalibrationGate:
+    def test_transformer_drift_error_names_worst_layer(self):
+        """Over-budget drift raises typed, NAMING the block weight
+        whose solo quantization drifts most — asserted against an
+        independent per-key re-measurement (the layernorm'd residual
+        stack renormalizes outliers, so the worst key is a property
+        of the network, not of where a test plants a spike)."""
+        from veles_tpu.gen import TransformerGenModel
+        from veles_tpu.samples.transformer import TINY
+        model = TransformerGenModel(dict(TINY, seq_len=32))
+        params = model.init_params(seed=0)
+        tokens = [1, 2, 3, 4]
+        with pytest.raises(quant.QuantizationError) as err:
+            quant.quantize_gen_params(model, params,
+                                      calibration_tokens=tokens)
+        assert err.value.drift > quant.DRIFT_TOL
+        ref = model.calibration_logits(params, tokens)
+        per_key = {
+            key: quant.relative_drift(
+                ref, model.calibration_logits(
+                    quant.quantize_transformer_params(params,
+                                                      only=key),
+                    tokens))
+            for key in quant.core.TRANSFORMER_BLOCK_AXES}
+        worst = max(per_key, key=per_key.get)
+        assert err.value.layer == "blocks.%s" % worst
+        assert err.value.drift == per_key[worst]
+
+    def test_explicit_tol_admits_noisy_model(self):
+        from veles_tpu.gen import TransformerGenModel
+        from veles_tpu.samples.transformer import TINY
+        model = TransformerGenModel(dict(TINY, seq_len=32))
+        params = model.init_params(seed=0)
+        qparams = quant.quantize_gen_params(
+            model, params, calibration_tokens=[1, 2, 3], tol=0.5)
+        assert quant.tree_is_quantized(qparams)
+
+    def test_serve_engine_blame_names_stage(self):
+        """Int8's real failure mode, caught and blamed: big in-channel
+        weights that CANCEL on the calibration inputs (rows ±1e5,
+        inputs with equal first two features), so the float output is
+        carried by small weights the shared abs-max scale rounds to
+        zero — drift ≈ 1 and the typed error names THAT stage."""
+        from veles_tpu.serve.engine import InferenceEngine
+        from veles_tpu.znicz.all2all import All2All
+        rng = numpy.random.default_rng(6)
+        w0 = numpy.eye(8, dtype=numpy.float32)
+        w1 = rng.standard_normal((8, 4)).astype(numpy.float32)
+        w1[0, :] = 1e5
+        w1[1, :] = -1e5
+
+        def apply_fn(params, x):
+            h = All2All.pure(params[0], x, activation="tanh")
+            return All2All.pure(params[1], h)
+
+        calibration = rng.standard_normal((4, 8)).astype(
+            numpy.float32)
+        calibration[:, 1] = calibration[:, 0]   # the ±1e5 rows cancel
+        engine = InferenceEngine([{"w": w0}, {"w": w1}], apply_fn,
+                                 sample_shape=(8,), max_batch_size=4)
+        try:
+            with pytest.raises(quant.QuantizationError) as err:
+                engine.quantize_int8(calibration=calibration)
+            assert err.value.layer == "stage[1].w"
+            assert err.value.drift > 0.5
+        finally:
+            engine.close()
+
+
+# ---------------------------------------------------------------------------
+# serve engine: mnist top-1 agreement + params-category ledger
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mnist_wf():
+    """The mnist sample (784→100→10, synthetic stand-in data), one
+    epoch on the numpy device — the acceptance gate's model."""
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.samples.mnist import create_workflow
+    wf = create_workflow(device=NumpyDevice(), max_epochs=1,
+                         minibatch_size=100)
+    wf.run()
+    return wf
+
+
+class TestServeQuantized:
+    def test_mnist_top1_agreement_and_params_ledger(self, mnist_wf):
+        """THE acceptance gate: int8 deploy of the mnist sample —
+        params-category ledger ≤0.35× the float line, top-1 agreement
+        ≥99% on the sample's logits, zero steady-state compiles."""
+        from veles_tpu.serve.engine import InferenceEngine
+        mnist_wf.loader.original_data.map_read()
+        rows = numpy.array(mnist_wf.loader.original_data.mem[:512],
+                           numpy.float32)
+
+        base = params_category_bytes()
+        fengine = InferenceEngine.from_workflow(mnist_wf,
+                                               max_batch_size=64)
+        float_bytes = params_category_bytes() - base
+        assert float_bytes == fengine.params_nbytes > 0
+        ref = fengine.infer(rows)
+
+        qengine = InferenceEngine.from_workflow(mnist_wf,
+                                                max_batch_size=64)
+        qengine.quantize_int8(calibration=rows[:64])
+        int8_bytes = qengine.params_nbytes
+        assert int8_bytes <= 0.35 * float_bytes
+        assert params_category_bytes() - base == \
+            float_bytes + int8_bytes
+        qengine.warmup()
+        warm = qengine.compile_count
+        recompiles = prof.ledger.recompiles
+        got = qengine.infer(rows)
+        assert qengine.compile_count == warm
+        assert prof.ledger.recompiles == recompiles
+        agreement = (ref.argmax(1) == got.argmax(1)).mean()
+        assert agreement >= 0.99
+        # close releases exactly this engine's ledger hold
+        qengine.close()
+        qengine.close()                      # idempotent
+        assert params_category_bytes() - base == float_bytes
+        fengine.close()
+        assert params_category_bytes() == base
+
+    def test_registry_deploy_int8_describe_and_undeploy(self,
+                                                        mnist_wf):
+        from veles_tpu.serve.engine import InferenceEngine
+        from veles_tpu.serve.registry import ModelRegistry
+        mnist_wf.loader.original_data.map_read()
+        rows = numpy.array(mnist_wf.loader.original_data.mem[:32],
+                           numpy.float32)
+        base = params_category_bytes()
+        registry = ModelRegistry()
+        engine = InferenceEngine.from_workflow(mnist_wf,
+                                               max_batch_size=16)
+        registry.deploy("mnist", engine, quantize="int8",
+                        calibration=rows)
+        info = registry.describe()["mnist"]
+        assert info["quantize"] == "int8"
+        assert info["params_bytes"] == engine.params_nbytes
+        out = registry.infer("mnist", rows)
+        assert out.shape == (32, 10)
+        registry.undeploy("mnist")
+        assert params_category_bytes() == base
+
+    def test_registry_quantize_knob_and_guards(self, mnist_wf):
+        from veles_tpu.serve.engine import InferenceEngine
+        from veles_tpu.serve.registry import ModelRegistry
+        registry = ModelRegistry()
+        saved = root.common.serve.get("quantize", "off")
+        try:
+            root.common.serve.quantize = "int8"
+            engine = InferenceEngine.from_workflow(mnist_wf,
+                                                   max_batch_size=8)
+            registry.deploy("knob", engine)
+            assert engine.quantized == "int8"
+            registry.undeploy("knob")
+            with pytest.raises(ValueError):
+                registry._resolve_quantize("int4")
+        finally:
+            root.common.serve.quantize = saved
+            registry.stop()
+
+    def test_quantize_after_warmup_refused(self, mnist_wf):
+        from veles_tpu.serve.engine import InferenceEngine
+        engine = InferenceEngine.from_workflow(mnist_wf,
+                                               max_batch_size=8)
+        try:
+            engine.warmup()
+            with pytest.raises(RuntimeError):
+                engine.quantize_int8()
+        finally:
+            engine.close()
+
+    def test_live_engine_refused(self, mnist_wf):
+        from veles_tpu.serve.engine import InferenceEngine
+        engine = InferenceEngine.from_forwards(
+            mnist_wf.forwards, live=True)
+        try:
+            with pytest.raises(ValueError):
+                engine.quantize_int8()
+        finally:
+            engine.close()
+
+    def test_replica_set_quantize_refused(self):
+        from veles_tpu.serve.engine import InferenceEngine
+        from veles_tpu.serve.registry import ModelRegistry
+        w = numpy.eye(4, dtype=numpy.float32)
+        engines = [InferenceEngine([{"w": w}],
+                                   lambda p, x: x @ p[0]["w"],
+                                   sample_shape=(4,),
+                                   max_batch_size=4)
+                   for _ in range(2)]
+        registry = ModelRegistry()
+        try:
+            with pytest.raises(ValueError):
+                registry.deploy_replica_set(
+                    "rs", [(engines[0], 1), (engines[1], 1)],
+                    quantize="int8")
+        finally:
+            for engine in engines:
+                engine.close()
+
+    def test_all2all_pure_routes_through_gemm_matmul(self):
+        """The satellite fix: the header's 'one fused call into
+        ops.gemm.matmul' promise now holds on the pure path (the
+        stitched/fused/serving forward), byte-identically off-TPU."""
+        from unittest import mock
+
+        import veles_tpu.ops.gemm as gemm
+        from veles_tpu.znicz.all2all import All2All
+        from veles_tpu.znicz.fused import _ACT
+        rng = numpy.random.default_rng(7)
+        x = jnp.asarray(rng.standard_normal((6, 8)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((8, 5)), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(5), jnp.float32)
+        with mock.patch.object(gemm, "matmul",
+                               wraps=gemm.matmul) as spy:
+            out = All2All.pure({"w": w, "b": b}, x,
+                               activation="tanh")
+            assert spy.call_count == 1
+        ref = _ACT["tanh"](
+            jnp.dot(x, w, preferred_element_type=jnp.float32) + b)
+        assert numpy.asarray(out).tobytes() == \
+            numpy.asarray(ref.astype(x.dtype)).tobytes()
+
+    def test_all2all_pure_quantized_leaf(self):
+        from veles_tpu.znicz.all2all import All2All
+        rng = numpy.random.default_rng(8)
+        x = jnp.asarray(rng.standard_normal((6, 8)), jnp.float32)
+        w = rng.standard_normal((8, 5)).astype(numpy.float32)
+        qw = quant.quantize_array(w, axes=(0,))
+        out = All2All.pure({"w": qw}, x, activation="strict_relu")
+        ref = numpy.maximum(
+            numpy.asarray(x) @ quant.dequantize_array(qw), 0.0)
+        assert numpy.allclose(numpy.asarray(out), ref, atol=1e-5)
+        # transposed storage: the deploy walk canonicalizes to
+        # (fan-in, out) — no per-call int8 transpose in the hot path
+        qt = quant.quantize_stage_params(
+            [{"w": w.T}], axes_list=[{"w": (1,)}])[0]["w"]
+        assert qt["q"].shape == w.shape           # canonical already
+        out_t = All2All.pure({"w": qt}, x, activation="strict_relu",
+                             transposed=True)
+        assert numpy.asarray(out_t).tobytes() == \
+            numpy.asarray(out).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# generative engine: the PR 8/PR 11 parity gates under int8
+# ---------------------------------------------------------------------------
+
+from veles_tpu.gen import (GenerativeEngine,  # noqa: E402
+                           GenerativeScheduler, TransformerGenModel)
+from veles_tpu.samples.transformer import TINY  # noqa: E402
+
+CFG = dict(TINY, seq_len=64)
+
+
+def build_gen(quantize=False, **kwargs):
+    engine = GenerativeEngine(
+        TransformerGenModel(CFG), max_slots=3, max_seq=48,
+        prefill_buckets=(8, 16), seed=0, **kwargs)
+    if quantize:
+        engine.quantize_int8()
+    return engine.warmup()
+
+
+def gen_workload(n=8, seed=0):
+    rng = numpy.random.default_rng(seed)
+    return [
+        (rng.integers(0, CFG["vocab"],
+                      int(rng.integers(1, 16))).tolist(),
+         int(rng.integers(1, 10)))
+        for _ in range(n)]
+
+
+def run_continuous(engine, workload):
+    scheduler = GenerativeScheduler(engine)
+    futures = [scheduler.submit(toks, max_new)
+               for toks, max_new in workload]
+    scheduler.run_until_idle()
+    return [f.result(0) for f in futures]
+
+
+def run_sequential(engine, workload):
+    scheduler = GenerativeScheduler(engine)
+    out = []
+    for toks, max_new in workload:
+        future = scheduler.submit(toks, max_new)
+        scheduler.run_until_idle()
+        out.append(future.result(0))
+    return out
+
+
+class TestGenQuantized:
+    def test_parity_gates_int8_both_kv_modes(self):
+        """THE PR 8/PR 11 gates under ``quantize="int8"``: continuous
+        == sequential bitwise on the contiguous engine, paged ==
+        contiguous bitwise, zero steady-state compiles throughout."""
+        workload = gen_workload()
+        recompiles = prof.ledger.recompiles
+        engine = build_gen(quantize=True)
+        warm = engine.compile_count
+        continuous = run_continuous(engine, workload)
+        assert engine.compile_count == warm
+        engine.close()
+        engine = build_gen(quantize=True)
+        sequential = run_sequential(engine, workload)
+        engine.close()
+        assert continuous == sequential
+        paged = build_gen(quantize=True, kv="paged", block_size=8,
+                          num_blocks=3 * 6 + 1, prefill_chunk=8)
+        paged_out = run_continuous(paged, workload)
+        paged.close()
+        assert paged_out == continuous
+        assert prof.ledger.recompiles == recompiles
+        # budgets honoured exactly (no eos in the TINY vocab run)
+        assert [len(t) for t in continuous] == \
+            [m for _, m in workload]
+
+    def test_quantize_describe_pricing_and_gauge(self):
+        kv_before = Watcher.hbm_ledger()["by_category"].get(
+            "kv", {}).get("bytes", 0)
+        fengine = build_gen()
+        float_bytes = fengine.params_nbytes
+        fengine.prefill(list(range(1, 6)))
+        float_hbm = fengine.hbm_per_request_bytes()
+        fengine.close()
+        engine = build_gen(quantize=True)
+        info = engine.describe()
+        assert info["quantize"] == "int8"
+        assert info["params_bytes"] == engine.params_nbytes \
+            < float_bytes
+        engine.prefill(list(range(1, 6)))
+        # the SLO-visible capacity metric reflects the int8 shrink
+        assert engine.hbm_per_request_bytes() < float_hbm
+        assert engine.hbm_per_request_bytes() > 0
+        engine.close()
+        assert Watcher.hbm_ledger()["by_category"]["kv"]["bytes"] \
+            == kv_before
+
+    def test_registry_deploy_generative_int8(self):
+        from veles_tpu.serve.registry import ModelRegistry
+        registry = ModelRegistry()
+        engine = GenerativeEngine(
+            TransformerGenModel(CFG), max_slots=2, max_seq=32,
+            prefill_buckets=(8,), seed=0)
+        registry.deploy_generative("lm", engine, quantize="int8",
+                                   calibration=None)
+        try:
+            assert engine.quantized == "int8"
+            info = registry.describe()["lm"]
+            assert info["quantize"] == "int8"
+            tokens = registry.generate("lm", [1, 2, 3],
+                                       max_new_tokens=4)
+            assert len(tokens) == 4
+        finally:
+            registry.stop()
+
+    def test_quantize_after_warmup_refused(self):
+        engine = build_gen()
+        try:
+            with pytest.raises(RuntimeError):
+                engine.quantize_int8()
+        finally:
+            engine.close()
+
+    def test_ledger_entries_carry_int8_peak_dtype(self, monkeypatch):
+        engine = build_gen(quantize=True)
+        try:
+            entries = list(engine._prof_entries.values())
+            assert entries
+            assert all(e.peak_dtype == "int8" for e in entries)
+            # the denominator swap: on a v5e the int8 peak is 2x bf16
+            # (sys.modules lookup: the prof PACKAGE shadows the
+            # ledger module attribute with the PerfLedger instance)
+            import sys
+            monkeypatch.setattr(
+                sys.modules["veles_tpu.prof.ledger"], "device_kind",
+                lambda: "TPU v5 lite")
+            entry = entries[0]
+            entry.dispatches, entry.dispatch_ns = 1, int(1e9)
+            bf16_peak = 197e12
+            assert entry._peak_for(bf16_peak) == 394e12
+            assert entry.row(bf16_peak)["peak_dtype"] == "int8"
+        finally:
+            engine.close()
+
+    def test_peak_int8_table(self):
+        from veles_tpu.backends import peak_int8_ops
+        assert peak_int8_ops("TPU v5 lite") == 394e12
+        assert peak_int8_ops("TPU v4") == 275e12
+        assert peak_int8_ops("cpu") is None
+
+
+@pytest.mark.slow
+def test_int8_tokens_per_sec_floor_vs_bf16():
+    """The acceptance floor: ≥1.2× tokens/s over the same-run bf16
+    engine on CPU JAX.  The win is the honest one int8 serving is FOR:
+    at these dims the decode step is weight-STREAMING bound (≈100 MB
+    of f32 block weights per step vs 25 MB int8), so moving a quarter
+    of the bytes beats the native-bf16 matmul path — measured a
+    stable ~1.26× on a single-core avx512_bf16 box (boxes where XLA
+    must emulate bf16 clear the floor by far more)."""
+    import time
+
+    cfg = {"vocab": 64, "dim": 1024, "heads": 8, "layers": 2,
+           "mlp_ratio": 4, "seq_len": 64}
+    rng = numpy.random.default_rng(0)
+    workload = [(rng.integers(0, cfg["vocab"], 8).tolist(), 24)
+                for _ in range(8)]
+
+    def tokens_per_sec(model, quantize=False):
+        engine = GenerativeEngine(model, max_slots=4, max_seq=48,
+                                  prefill_buckets=(16,), seed=0)
+        if quantize:
+            engine.quantize_int8()
+        engine.warmup()
+        best = 0.0
+        for _ in range(3):       # best-of-3: shrug off CI scheduler
+            scheduler = GenerativeScheduler(engine)   # noise
+            futures = [scheduler.submit(toks, max_new)
+                       for toks, max_new in workload]
+            tic = time.perf_counter()
+            scheduler.run_until_idle()
+            sec = time.perf_counter() - tic
+            assert all(f.done() for f in futures)
+            best = max(best, scheduler.tokens_total / sec)
+        engine.close()
+        return best
+
+    bf16 = tokens_per_sec(
+        TransformerGenModel(cfg, compute_dtype=jnp.bfloat16))
+    int8 = tokens_per_sec(TransformerGenModel(cfg), quantize=True)
+    assert int8 >= 1.2 * bf16, (int8, bf16)

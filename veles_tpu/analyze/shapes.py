@@ -1075,7 +1075,11 @@ def check_generative(engine, hbm_bytes=None, mean_seq_len=None):
       in paged mode, ``slots × max_seq`` rows contiguous) + params
       must fit the device's HBM (``hbm_bytes`` override for tests;
       the live table is :func:`veles_tpu.backends.device_hbm_bytes`,
-      and unknown/CPU devices degrade to plan-sanity only).
+      and unknown/CPU devices degrade to plan-sanity only).  Params
+      are priced from the ACTUAL leaves — an int8-quantized deploy
+      (``veles_tpu.quant``) counts one byte per weight element plus
+      its float scales, so quantizing is the remedy this check's
+      over-budget error can point at honestly.
 
     Returns a :class:`~veles_tpu.analyze.findings.Report`;
     ``ModelRegistry.deploy_generative`` maps its errors through

@@ -46,6 +46,19 @@ PEAK_BF16_FLOPS = (
     ("v2", 22.5e12),
 )
 
+#: peak dense int8 OP/s per *jax device* — the honest MFU denominator
+#: for the quantized serving programs (``veles_tpu.quant``): v5e/v5p/
+#: v6e double their bf16 rate at int8, v2–v4 have no int8 fast path
+#: (the MXU runs the same passes, so the bf16 number stands)
+PEAK_INT8_OPS = (
+    ("v6", 1836e12),
+    ("v5p", 918e12),
+    ("v5", 394e12),
+    ("v4", 275e12),
+    ("v3", 61.5e12),
+    ("v2", 22.5e12),
+)
+
 #: HBM bytes per *jax device* (same core-vs-chip granularity as the
 #: peak table: v2/v3 devices are single TensorCores owning half the
 #: chip's memory) — the generative preflight's KV-footprint budget
@@ -112,6 +125,15 @@ def peak_bf16_flops(device_kind):
     """Peak dense bf16 FLOP/s for a jax device kind, or None."""
     kind = (device_kind or "").lower()
     for tag, peak in PEAK_BF16_FLOPS:
+        if tag in kind:
+            return peak
+    return None
+
+
+def peak_int8_ops(device_kind):
+    """Peak dense int8 OP/s for a jax device kind, or None."""
+    kind = (device_kind or "").lower()
+    for tag, peak in PEAK_INT8_OPS:
         if tag in kind:
             return peak
     return None

@@ -9,7 +9,11 @@ count.  A second PAGED session (block-pool KV + chunked prefill over a
 pool deliberately too small for the working set) must then reproduce
 the contiguous session's token streams EXACTLY while exercising and
 recovering at least one pool-exhaustion preemption — the lossless-
-preemption contract, gated in CI.  Exit code 0 on success; any
+preemption contract, gated in CI.  A third INT8 session (deploy-time
+per-channel weight quantization, ``veles_tpu.quant``) must complete
+the same budgets with zero steady-state compiles, a params footprint
+≤0.35× its float twin, and the calibration drift gate green — the
+quantized-serving contract.  Exit code 0 on success; any
 violation prints the failure and exits 1 — the same contract the
 serve engine's warmup gate enforces for the request/response path.
 """
@@ -140,6 +144,52 @@ def smoke(slots=4, max_seq=48, requests=16, seed=0):
              paged.blocks_total - paged.blocks_free,
              paged.blocks_total, paged.preemptions_total))
     paged.close()
+
+    # phase 3: the INT8 gate — a deploy-time quantized engine
+    # (per-output-channel int8 weights, the qgemm dequant-epilogue
+    # path) against its OWN float twin on an MLP-heavy config (the
+    # TINY embed table would dominate the byte ratio): exact budgets,
+    # zero steady-state compiles, params footprint ≤0.35× the float
+    # deploy, and the calibration drift gate green at the explicit
+    # smoke tolerance (a random-init model's logits are near-uniform,
+    # so the production 1e-2 default is intentionally too strict)
+    cfg3 = dict(cfg, dim=64, mlp_ratio=4)
+
+    def build3():
+        return GenerativeEngine(
+            TransformerGenModel(cfg3), max_slots=slots,
+            max_seq=max_seq, prefill_buckets=(8, 16, 32), seed=seed)
+
+    fengine = build3()
+    float_bytes = fengine.params_nbytes
+    fresults, _fel, _fsch, fsteady, fflagged = _session(
+        fengine, workload, "smoke-int8-float")
+    failed += check_session(fresults, fsteady, fflagged, "int8-float")
+    fengine.close()
+    int8 = build3()
+    int8.quantize_int8(calibration_tokens=workload[0][0], tol=0.05)
+    iresults, ielapsed, ischeduler, isteady, iflagged = _session(
+        int8, workload, "smoke-int8")
+    failed += check_session(iresults, isteady, iflagged, "int8")
+    ratio = int8.params_nbytes / float(float_bytes)
+    if ratio > 0.35:
+        print("FAIL[int8]: params footprint %.2fx the float deploy "
+              "(budget 0.35x) — the int8 pricing is not real"
+              % ratio)
+        failed += 1
+    if int8.describe()["quantize"] != "int8":
+        print("FAIL[int8]: describe() does not surface the quant "
+              "mode")
+        failed += 1
+    agree = sum(a == b for ft, it in zip(fresults, iresults)
+                if ft and it for a, b in zip(ft, it))
+    total = sum(len(t) for t in fresults if t)
+    print("gen smoke[int8]: %d requests, %d tokens in %.2fs, params "
+          "%.2fx float, %d/%d tokens match the float session, "
+          "0 steady-state recompiles"
+          % (len(workload), ischeduler.tokens_total, ielapsed,
+             ratio, agree, total))
+    int8.close()
     return 1 if failed else 0
 
 

@@ -177,6 +177,15 @@ class GenerativeEngine(Logger):
 
         if params is None:
             params = model.init_params(seed=seed)
+        from veles_tpu.quant import tree_is_quantized
+        #: "int8" when the params tree carries veles_tpu.quant pairs
+        #: (constructor-injected or via quantize_int8()); None = float
+        self.quantized = "int8" if tree_is_quantized(params) else None
+        if self.quantized and self.mesh is not None:
+            raise ValueError(
+                "int8-quantized params cannot shard over a model-axis "
+                "mesh yet — serve the quantized deploy replicated (or "
+                "keep the TP deploy float)")
         self._shardings = self._build_shardings()
         if self._pool is not None:
             cache = model.init_paged_cache(self.num_blocks,
@@ -203,6 +212,13 @@ class GenerativeEngine(Logger):
         from veles_tpu.memory import Watcher
         Watcher.track(self.kv_cache_bytes, "kv", owner=self)
         self._kv_tracked = True
+        #: the params' ACTUAL device footprint (int8 leaves count one
+        #: byte) held in the HBM ledger's params category — the line
+        #: the ≤0.35× int8-vs-bf16 acceptance gate reads
+        from veles_tpu.quant import tree_nbytes
+        self.params_nbytes = tree_nbytes(self._params)
+        Watcher.track(self.params_nbytes, "params")
+        self._params_tracked = True
 
         # host slot bookkeeping (single scheduler thread)
         self.slot_len = numpy.zeros(self.max_slots, numpy.int32)
@@ -273,7 +289,8 @@ class GenerativeEngine(Logger):
                             role="server"):
                 jitted = jax.jit(fn, **jit_kwargs)
                 exe = jitted.lower(*self._struct_of(args)).compile()
-                cost, new_args = prof.span_cost_args(exe, span_args)
+                cost, new_args = prof.span_cost_args(
+                    exe, span_args, peak_dtype=self.quantized)
                 cost["flops"] = float(flops)
                 new_args["flops"] = float(flops)
                 span_args.update(new_args)
@@ -285,6 +302,10 @@ class GenerativeEngine(Logger):
                 entry = self._prof_entries[(kind, name)] = \
                     prof.ledger.entry(kind,
                                       "%s[%s]" % (self.prof_name, name))
+            if self.quantized:
+                # honest MFU denominator: the chip's int8 rate, not
+                # the bf16 table (backends.PEAK_INT8_OPS)
+                entry.peak_dtype = self.quantized
             prof.ledger.record_compile(entry, cost=cost,
                                        steady=self._warmed)
             self.debug("compiled %s (compile #%d)", name,
@@ -367,6 +388,47 @@ class GenerativeEngine(Logger):
                 fn, args, "decode", "decode",
                 self.model.decode_flops(slots, self.max_seq))
         return self._decode_exe
+
+    def quantize_int8(self, calibration_tokens=None, tol=None):
+        """Quantize the served params in place (per-output-channel
+        symmetric int8, :func:`veles_tpu.quant.quantize_gen_params`)
+        — the ``deploy_generative(..., quantize="int8")`` hook.  Must
+        run BEFORE :meth:`warmup` so every program compiles against
+        the quantized tree exactly once (the recompile sentinel's
+        zero-steady-state contract).  ``calibration_tokens`` arms the
+        drift gate: relative logit drift beyond ``tol`` (default
+        :data:`veles_tpu.quant.DRIFT_TOL`) raises a typed
+        :class:`~veles_tpu.quant.QuantizationError` naming the worst
+        block weight.  Returns self (chainable)."""
+        from veles_tpu import quant
+        if self._warmed or self.compile_count:
+            raise RuntimeError(
+                "quantize_int8 must run before warmup()/any compile — "
+                "a post-warmup dtype flip would recompile every "
+                "program in steady state")
+        if self.mesh is not None:
+            raise ValueError(
+                "int8-quantized params cannot shard over a model-axis "
+                "mesh yet — serve the quantized deploy replicated")
+        if self.quantized:
+            return self
+        import jax
+        host = jax.tree.map(numpy.asarray, self._params)
+        qparams = quant.quantize_gen_params(
+            self.model, host, calibration_tokens=calibration_tokens,
+            tol=quant.DRIFT_TOL if tol is None else tol)
+        self._params = jax.device_put(qparams)
+        self.quantized = "int8"
+        # re-price the ledger hold from the new (int8) leaves
+        from veles_tpu.memory import Watcher
+        if getattr(self, "_params_tracked", False):
+            Watcher.untrack(self.params_nbytes, "params")
+        self.params_nbytes = quant.tree_nbytes(self._params)
+        Watcher.track(self.params_nbytes, "params")
+        self._params_tracked = True
+        self.info("quantized params to int8 (%d bytes resident)",
+                  self.params_nbytes)
+        return self
 
     def warmup(self):
         """AOT-compile the decode step and every admission program —
@@ -698,17 +760,22 @@ class GenerativeEngine(Logger):
         return self._pool.blocks_free if self._pool else 0
 
     def hbm_per_request_bytes(self):
-        """KV bytes actually held per in-flight sequence — the
-        capacity metric the long-tail bench and /metrics report.
-        Contiguous mode reserves a full ``max_seq`` slice per slot at
-        admission; paged mode pays only for the pages in use."""
+        """HBM actually held per in-flight sequence — the capacity
+        metric the long-tail bench and /metrics report: the KV share
+        (contiguous mode reserves a full ``max_seq`` slice per slot
+        at admission; paged mode pays only for the pages in use) PLUS
+        the shared params footprint amortized over the occupants —
+        so an int8 deploy's 4× params shrink is visible to the PR 12
+        SLO samplers, not just to ``describe()``."""
         occupants = self.active_slots() + len(self._chunking)
         if not occupants:
             return 0
         if self._pool is not None:
             per_block = self.kv_cache_bytes // self.num_blocks
-            return self._pool.blocks_used * per_block // occupants
-        return self.kv_cache_bytes // self.max_slots
+            kv = self._pool.blocks_used * per_block // occupants
+        else:
+            kv = self.kv_cache_bytes // self.max_slots
+        return kv + self.params_nbytes // occupants
 
     def describe(self):
         info = {
@@ -718,6 +785,8 @@ class GenerativeEngine(Logger):
             "prefill_buckets": list(self.prefill_buckets),
             "kv_cache_bytes": self.kv_cache_bytes,
             "kv": self.kv_mode,
+            "quantize": self.quantized,
+            "params_bytes": self.params_nbytes,
             "prefill_chunk": self.prefill_chunk,
             "sharded": self.mesh is not None,
             "compile_count": self.compile_count,
@@ -734,10 +803,13 @@ class GenerativeEngine(Logger):
 
     def close(self):
         """Release the KV cache (and its ledger hold).  Idempotent."""
+        from veles_tpu.memory import Watcher
         if getattr(self, "_kv_tracked", False):
-            from veles_tpu.memory import Watcher
             Watcher.untrack(self.kv_cache_bytes, "kv", owner=self)
             self._kv_tracked = False
+        if getattr(self, "_params_tracked", False):
+            Watcher.untrack(self.params_nbytes, "params")
+            self._params_tracked = False
         self._cache = None
         self._prefill_exe = {}
         self._chunk_exe = None

@@ -169,6 +169,22 @@ class TransformerGenModel(object):
         return flash_attention(q, k, v, True, None, None,
                                self.use_pallas)
 
+    def _qmm(self, x2, qw, nc, bias=None, activation=None):
+        """One int8 block matmul over a quantized ``{"q", "scale"}``
+        leaf: the leaf's first ``nc`` axes are the contraction (K),
+        the rest flatten into output channels (N) — so the per-layer
+        slices of every stacked block weight reduce to the ONE 2D
+        :func:`veles_tpu.ops.qgemm.qmatmul` kernel (int8 weights
+        DMA'd as stored, dequant fused into the epilogue)."""
+        from veles_tpu.ops.qgemm import qmatmul
+        q = qw["q"]
+        k = 1
+        for dim in q.shape[:nc]:
+            k *= int(dim)
+        return qmatmul(x2, q.reshape(k, -1), qw["scale"].reshape(-1),
+                       bias, activation, use_pallas=self.use_pallas,
+                       out_dtype=x2.dtype)
+
     def _run_layers(self, params, cache, h, kv_hook):
         """Scan the block stack with the ONE shared layer body.
         ``kv_hook(kc, vc, q, k, v) -> (kc', vc', att)`` is the only
@@ -176,25 +192,67 @@ class TransformerGenModel(object):
         land (slot slice, page scatter, chunk window) and what the
         attention reads (the chunk itself, the masked cache, the
         table-gathered pool).  One body means a layer-math change can
-        never desynchronize the paged==contiguous parity pair.
+        never desynchronize the paged==contiguous parity pair — and
+        the int8 deploy rides the same body: a quantized block weight
+        (``veles_tpu.quant`` pair, detected per leaf at trace time)
+        routes its matmul through :meth:`_qmm` while the float path
+        stays byte-identical, so EVERY entry point (prefill, decode,
+        paged, chunked) serves quantized without its own fork.
         Returns ``(h_final_normed, cache')``."""
         cd = self.compute_dtype
 
         def layer(h, xs):
             blk, kc, vc = xs
+            b_, s_ = h.shape[0], h.shape[1]
             x = _layernorm(h, blk["ln1_g"], blk["ln1_b"])
-            qkv = jnp.einsum("bsd,dchx->bschx", x.astype(cd),
-                             blk["wqkv"].astype(cd))
+            if isinstance(blk["wqkv"], dict):
+                qkv = self._qmm(
+                    x.reshape(b_ * s_, -1).astype(cd),
+                    blk["wqkv"], 1).reshape(
+                        b_, s_, 3, self.heads, self.head_dim)
+            else:
+                qkv = jnp.einsum("bsd,dchx->bschx", x.astype(cd),
+                                 blk["wqkv"].astype(cd))
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
             kc, vc, att = kv_hook(kc, vc, q, k, v)
-            proj = jnp.einsum("bshx,hxd->bsd", att.astype(cd),
-                              blk["wo"].astype(cd))
+            if isinstance(blk["wo"], dict):
+                proj = self._qmm(
+                    att.reshape(b_ * s_, -1).astype(cd),
+                    blk["wo"], 2).reshape(b_, s_, -1)
+            else:
+                proj = jnp.einsum("bshx,hxd->bsd", att.astype(cd),
+                                  blk["wo"].astype(cd))
             h = h + proj.astype(h.dtype)
             x = _layernorm(h, blk["ln2_g"], blk["ln2_b"])
-            up = (x.astype(cd) @ blk["w1"].astype(cd)
-                  + blk["b1"].astype(cd))
-            down = (jax.nn.gelu(up) @ blk["w2"].astype(cd)
-                    + blk["b2"].astype(cd))
+            w1_q = isinstance(blk["w1"], dict)
+            w2_q = isinstance(blk["w2"], dict)
+            if w1_q or w2_q:
+                # bias + gelu fused into the up-projection epilogue,
+                # bias into the down-projection's — the whole MLP is
+                # two quantized dispatches.  The halves branch
+                # independently so the calibration blame probe (one
+                # key quantized at a time) traces cleanly.
+                x2 = x.reshape(b_ * s_, -1).astype(cd)
+                if w1_q:
+                    up_act = self._qmm(x2, blk["w1"], 1,
+                                       bias=blk["b1"].astype(cd),
+                                       activation="gelu")
+                else:
+                    up_act = jax.nn.gelu(
+                        x2 @ blk["w1"].astype(cd)
+                        + blk["b1"].astype(cd))
+                if w2_q:
+                    down = self._qmm(up_act, blk["w2"], 1,
+                                     bias=blk["b2"].astype(cd))
+                else:
+                    down = (up_act @ blk["w2"].astype(cd)
+                            + blk["b2"].astype(cd))
+                down = down.reshape(b_, s_, -1)
+            else:
+                up = (x.astype(cd) @ blk["w1"].astype(cd)
+                      + blk["b1"].astype(cd))
+                down = (jax.nn.gelu(up) @ blk["w2"].astype(cd)
+                        + blk["b2"].astype(cd))
             h = h + down.astype(h.dtype)
             return h, (kc, vc)
 
@@ -202,6 +260,29 @@ class TransformerGenModel(object):
             layer, h, (params["blocks"], cache["k"], cache["v"]))
         return (_layernorm(h, params["lnf_g"], params["lnf_b"]),
                 {"k": ks, "v": vs})
+
+    def calibration_logits(self, params, tokens):
+        """Last-position logits of ONE prompt through the same shared
+        ``_run_layers`` body the engine serves from — the float-vs-
+        int8 calibration probe (:func:`veles_tpu.quant
+        .quantize_gen_params` gates relative drift on it).  Uses a
+        throwaway single-slot cache; nothing is retained."""
+        tokens = jnp.asarray(tokens, jnp.int32).reshape(1, -1)
+        s = tokens.shape[1]
+        cd = self.compute_dtype
+        embed = jnp.asarray(params["embed"])
+        h = embed[tokens] + jnp.asarray(params["pos"])[:s]
+
+        def kv_hook(kc, vc, q, k, v):
+            return kc, vc, self._attend_prefill(q, k, v)
+
+        cache = {"k": jnp.zeros((self.layers, 1, 1, self.heads,
+                                 self.head_dim), cd),
+                 "v": jnp.zeros((self.layers, 1, 1, self.heads,
+                                 self.head_dim), cd)}
+        h, _cache = self._run_layers(params, cache, h, kv_hook)
+        return jnp.einsum("d,vd->v", h[0, -1].astype(cd),
+                          embed.astype(cd)).astype(jnp.float32)
 
     def _greedy_at(self, params, h, index):
         """h (1, S, d) -> the greedy token of row ``index`` (traced)

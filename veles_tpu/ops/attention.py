@@ -31,10 +31,10 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 #: jax renamed TPUCompilerParams -> CompilerParams across releases;
-#: the decode path resolves whichever this jax ships (the training
-#: kernels above predate the rename and keep the new-name spelling)
-_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) \
-    or getattr(pltpu, "TPUCompilerParams", None)
+#: the decode path resolves whichever this jax ships via the ONE
+#: shared shim (the training kernels above predate the rename and
+#: keep the new-name spelling)
+from veles_tpu.ops.util import COMPILER_PARAMS as _COMPILER_PARAMS
 
 
 def _round_up(x, mult):

@@ -278,6 +278,116 @@ def autotune_gemm(shapes=None, dtypes=("bfloat16", "float32"),
     return info
 
 
+def _sweep_qgemm_shape(m, k, n, dtype, candidates, runs, dtype_name):
+    """One (shape, dtype) int8-weight sweep: int8 weights + per-channel
+    scales stay fixed, the activation carries the serial dependency
+    (same hoisting/CSE defeat as ``_sweep_gemm_shape``).  Candidate
+    ``None`` = the dense-jnp dequant baseline (XLA) competing with
+    every Pallas tiling."""
+    from veles_tpu.ops.qgemm import qmatmul
+
+    key = jax.random.key(m + n)
+    ka, kb, ks = jax.random.split(key, 3)
+    a = jax.random.normal(ka, (m, k), jnp.float32).astype(dtype)
+    q = jax.random.randint(kb, (k, n), -127, 128, jnp.int8)
+    scale = (jax.random.uniform(ks, (n,), jnp.float32) + 0.5) / 127.0
+    flops = 2.0 * m * k * n
+    out = {}
+    for tiles in candidates:
+        try:
+            def unit(carry, t=tiles):
+                x, s = carry
+                x = jax.lax.dynamic_update_slice(
+                    x, (x[0:1, 0:1] +
+                        (s * 1e-30).astype(x.dtype)), (0, 0))
+                out_ = qmatmul(x, q, scale, None, None, tiles=t,
+                               use_pallas=t is not None)
+                return x, jnp.sum(jnp.abs(out_), dtype=jnp.float32)
+
+            init = (a, jnp.float32(0.0))
+            stats = {}
+
+            def run(_unit=unit, _init=init, _stats=stats):
+                return inprogram_marginal(_unit, _init, k1=4, k2=32,
+                                          repeats=max(runs, 2),
+                                          stats=_stats)
+
+            elapsed = _peak_guard(
+                run(), flops, run,
+                "autotune_gemm_int8 %s %s %s" % ((m, k, n),
+                                                 dtype_name, tiles))
+        except Exception:
+            continue
+        out[tiles] = (elapsed, stats.get("t1_rel_spread"))
+    return out, flops
+
+
+def autotune_gemm_int8(shapes=None, dtypes=("bfloat16", "float32"),
+                       candidates=TILE_CANDIDATES, runs=2, save=True,
+                       db_path=None, shape_classes=None):
+    """Race each Pallas tile candidate of the int8-weight GEMM
+    (:func:`veles_tpu.ops.qgemm.qmatmul`) against the dense dequant
+    baseline on the attached backend; persist the flops-normalized
+    aggregate winner under ``ratings["gemm_int8"][dtype]`` — the row
+    ``qmatmul``'s dispatch consults (``gemm_choice(...,
+    kernel="gemm_int8")``), exactly like ``ops.gemm.matmul`` reads
+    its own entries.  ``dtype`` keys the ACTIVATION dtype; the weight
+    side is int8 by construction.  The row is written AND served at
+    precision level 0 only (``_choice_cached`` refuses it at higher
+    levels), so the sweep PINS level 0 while racing — an ambient
+    level-1/2 config must not bake its MXU pass count into a level-0
+    verdict (the ``autotune_gemm`` cross-precision guard, same
+    hazard)."""
+    db_path = db_path or DEVICE_INFOS_JSON
+    model = jax.devices()[0].device_kind
+    db = DeviceInfo.load_db(db_path)
+    info = db.setdefault(model, DeviceInfo(model))
+    all_candidates = tuple(candidates) + (None,)
+    if shape_classes:
+        worklist = [(cls, tuple(s)) for cls, s in shape_classes.items()]
+    elif shapes:
+        worklist = [(classify_shape(*s), tuple(s)) for s in shapes]
+    else:
+        worklist = list(SHAPE_CLASSES.items())
+    from veles_tpu.config import root
+    orig_level = root.common.engine.get("precision_level", 0)
+    try:
+        root.common.engine.precision_level = 0
+        if orig_level != 0:
+            # the pass count is baked into jit caches at trace time
+            jax.clear_caches()
+        for dtype_name in dtypes:
+            dtype = jnp.dtype(dtype_name)
+            totals = {c: 0.0 for c in all_candidates}
+            shape_of = {}
+            for cls, (m, k, n) in worklist:
+                res, flops = _sweep_qgemm_shape(
+                    m, k, n, dtype, all_candidates, runs, dtype_name)
+                for cand in list(totals):
+                    if cand in res:
+                        totals[cand] += res[cand][0] / flops
+                        shape_of[cand] = [m, k, n]
+                    else:
+                        totals.pop(cand)
+            if not totals:
+                continue
+            best = min(totals, key=totals.get)
+            info.ratings.setdefault("gemm_int8", {})[dtype_name] = {
+                "sec_per_flop": totals[best] / len(worklist),
+                "backend": "xla" if best is None else "pallas",
+                "tiles": None if best is None else list(best),
+                "shape": shape_of.get(best)}
+    finally:
+        root.common.engine.precision_level = orig_level
+        if orig_level != 0:
+            # the caller's next trace must not reuse level-0 kernels
+            jax.clear_caches()
+    if save:
+        DeviceInfo.save_db(db, db_path)
+    gemm_choice.cache_clear()
+    return info
+
+
 def measure_s2d_ab(batch=256, spatial=227, dtype_name="bfloat16",
                    k1=4, k2=32):
     """Forward A/B of the AlexNet-conv1-shaped strided conv with and
@@ -514,6 +624,14 @@ def _choice_cached(kernel, model, dtype_name, level, shape_cls,
             # NEVER reuse precision-0 winners at a higher level: a
             # Kahan/multipartial user must not silently get tiles
             # raced under bf16 MXU passes — XLA is the safe default
+            return None
+    elif kernel == "gemm_int8":
+        # the int8 sweep races at level 0 (its MXU pass count reads
+        # the same _precision() knob as the float kernel); the same
+        # no-cross-precision-reuse rule applies — a HIGHEST-precision
+        # deploy falls back to the dense path rather than trusting a
+        # verdict raced under bf16 passes
+        if level != 0:
             return None
     elif kernel in ("flash_attention", "flash_attention_bwd"):
         v2 = info.ratings.get(kernel + "_v2", {}).get(

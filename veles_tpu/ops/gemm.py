@@ -45,7 +45,10 @@ _ACTIVATIONS = {
     "linear": lambda x: x,
     "tanh": lambda x: jnp.tanh(x * 0.6666) * 1.7159,  # Znicz scaled tanh
     "sigmoid": jax.nn.sigmoid,
-    "relu": lambda x: jnp.log(1.0 + jnp.exp(x)),      # Znicz smooth ReLU
+    # Znicz smooth ReLU — the clamped log1p form shared with
+    # znicz.fused._ACT and the numpy units (the naive log(1+exp(x))
+    # overflows to inf past x ≈ 88)
+    "relu": lambda x: jnp.log1p(jnp.exp(jnp.minimum(x, 30.0))),
     "strict_relu": lambda x: jnp.maximum(x, 0.0),
 }
 

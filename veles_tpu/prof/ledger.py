@@ -67,18 +67,21 @@ def cost_of(compiled):
     return cost
 
 
-def span_cost_args(compiled, base):
+def span_cost_args(compiled, base, peak_dtype=None):
     """The ONE schema for cost-bearing trace args at a compile point
     (segment ``compile`` instants, serve ``compile_bucket`` spans):
     ``base`` + flops / ``bytes`` / arg/out/temp bytes / peak_flops.
     :func:`entries_from_events` parses these keys — both compile
     points must emit through here or the offline report silently
-    loses half its entries.  Returns ``(cost_dict, span_args)``."""
+    loses half its entries.  ``peak_dtype="int8"`` stamps the
+    quantized-program denominator (``PEAK_INT8_OPS``) instead of the
+    bf16 peak, so offline reports reconstruct the same honest MFU.
+    Returns ``(cost_dict, span_args)``."""
     cost = cost_of(compiled)
     args = dict(base)
     args.update(cost)
     args["bytes"] = args.pop("bytes_accessed")
-    peak = peak_flops()
+    peak = peak_flops(dtype=peak_dtype)
     if peak:
         args["peak_flops"] = peak
     return cost, args
@@ -94,15 +97,21 @@ def device_kind():
         return None
 
 
-def peak_flops(kind=None):
+def peak_flops(kind=None, dtype=None):
     """Per-device peak dense FLOP/s — the MFU denominator.  The table
     is :data:`veles_tpu.backends.PEAK_BF16_FLOPS` (TPU generations);
+    ``dtype="int8"`` reads :data:`veles_tpu.backends.PEAK_INT8_OPS`
+    instead (the quantized serving programs' honest denominator).
     CPU and unknown kinds return ``None`` so entries degrade to
     flops/bytes-only reporting instead of inventing an MFU."""
-    from veles_tpu.backends import peak_bf16_flops
+    from veles_tpu.backends import peak_bf16_flops, peak_int8_ops
     if kind is None:
         kind = device_kind()
-    return peak_bf16_flops(kind) if kind else None
+    if not kind:
+        return None
+    if dtype == "int8":
+        return peak_int8_ops(kind)
+    return peak_bf16_flops(kind)
 
 
 class LedgerEntry(object):
@@ -110,7 +119,7 @@ class LedgerEntry(object):
 
     __slots__ = ("kind", "name", "cost", "compiles", "recompiles",
                  "dispatches", "dispatch_ns", "items", "shards",
-                 "psum_bytes", "steps")
+                 "psum_bytes", "steps", "peak_dtype")
 
     def __init__(self, kind, name):
         self.kind = kind            # "segment" | "bucket" | "prefill"
@@ -140,6 +149,11 @@ class LedgerEntry(object):
         #: not expose collective traffic)
         self.shards = 1
         self.psum_bytes = 0
+        #: MFU-denominator dtype: None = the session peak (bf16 table);
+        #: "int8" = PEAK_INT8_OPS — quantized serving programs set it
+        #: so their utilisation is judged against the rate the chip
+        #: can actually sustain at that width
+        self.peak_dtype = None
 
     @property
     def flops(self):
@@ -159,7 +173,15 @@ class LedgerEntry(object):
         units = self.steps if self.steps else self.dispatches
         return self.flops * units / (self.dispatch_ns / 1e9)
 
+    def _peak_for(self, peak):
+        """The denominator this entry is judged against: the session
+        peak unless the entry declares a dtype-specific one."""
+        if self.peak_dtype is not None and peak:
+            return peak_flops(dtype=self.peak_dtype) or peak
+        return peak
+
     def mfu(self, peak):
+        peak = self._peak_for(peak)
         if not peak:
             return None
         achieved = self.achieved_flops()
@@ -195,6 +217,8 @@ class LedgerEntry(object):
             "achieved_flops": round(self.achieved_flops(), 1),
             "mfu": round(mfu, 6) if mfu is not None else None,
         }
+        if self.peak_dtype:
+            row["peak_dtype"] = self.peak_dtype
         if self.items:
             row["items"] = self.items
             row["items_per_s"] = round(self.items_per_s(), 1)

@@ -1,0 +1,25 @@
+"""veles_tpu.quant — deploy-time int8 weight quantization.
+
+See :mod:`veles_tpu.quant.core` for the walk + calibration gate and
+:mod:`veles_tpu.ops.qgemm` for the Pallas serving kernel the pairs
+feed.  Deploy entry points: ``ModelRegistry.deploy(...,
+quantize="int8")`` / ``deploy_generative(..., quantize="int8")`` (or
+the ``root.common.serve.quantize`` knob).
+"""
+
+from veles_tpu.quant.core import (DRIFT_TOL, QuantizationError,
+                                  check_drift, dequantize_array,
+                                  is_quantized_leaf, quantize_array,
+                                  quantize_gen_params,
+                                  quantize_stage_params,
+                                  quantize_transformer_params,
+                                  relative_drift, tree_is_quantized,
+                                  tree_nbytes)
+
+__all__ = [
+    "DRIFT_TOL", "QuantizationError", "check_drift",
+    "dequantize_array", "is_quantized_leaf", "quantize_array",
+    "quantize_gen_params", "quantize_stage_params",
+    "quantize_transformer_params", "relative_drift",
+    "tree_is_quantized", "tree_nbytes",
+]

@@ -1,0 +1,235 @@
+"""Deploy-time weight quantization: per-output-channel symmetric int8.
+
+The deploy half of the int8 serving mode (ROADMAP items 4/5 — the
+native engine's int8 change banked +35%): a pytree walk that replaces
+each eligible float weight leaf with an ``{"q": int8, "scale":
+float32}`` pair — ``w ≈ q * scale`` with one scale per OUTPUT channel
+(abs-max calibration: ``scale = max|w| / 127`` over the contraction
+axes), biases / norms / embeddings kept float32.  Both serving engines
+consume the pair through :func:`veles_tpu.ops.qgemm.qmatmul`, whose
+epilogue applies the dequant after the int8 dot — so the stored form
+IS the served form and no dequantized copy ever lands in HBM.
+
+The quantized leaf is a plain dict (not a registered pytree class) on
+purpose: ``jax.device_put``, ``jax.tree`` walks, ``ShapeDtypeStruct``
+maps and the engines' sharding machinery all see two ordinary array
+leaves, and traced code branches on ``is_quantized_leaf`` at trace
+time (pytree structure is static under jit).
+
+**Calibration gate**: a layer whose dynamic range cannot survive 8
+bits (one giant outlier weight flattens every other channel's
+resolution) must fail at DEPLOY time, not as silent accuracy loss —
+``check_drift`` compares float vs quantized logits on a calibration
+batch and raises a typed :class:`QuantizationError` NAMING the worst
+layer when the relative drift exceeds ``tol`` (default 1e-2).
+"""
+
+import numpy
+
+
+#: relative logit drift a quantized deploy must stay within on its
+#: calibration batch (the ISSUE 15 acceptance rule)
+DRIFT_TOL = 1e-2
+
+#: contraction axes of the stacked transformer block weights
+#: (leading axis = layer): everything NOT reduced is an output
+#: channel, so each (layer, out-channel) pair owns one scale
+TRANSFORMER_BLOCK_AXES = {
+    "wqkv": (1,),        # [L, d, 3, h, dh] — contract d
+    "wo": (1, 2),        # [L, h, dh, d]   — contract (h, dh)
+    "w1": (1,),          # [L, d, f]       — contract d
+    "w2": (1,),          # [L, f, d]       — contract f
+}
+
+
+class QuantizationError(ValueError):
+    """A layer's dynamic range cannot hold the deploy's drift budget
+    (or the quantization request is structurally impossible).  Carries
+    ``layer`` (the offending leaf's name) and ``drift`` (the measured
+    relative logit drift) so deploy tooling can report precisely."""
+
+    def __init__(self, message, layer=None, drift=None):
+        super(QuantizationError, self).__init__(message)
+        self.layer = layer
+        self.drift = drift
+
+
+def quantize_array(w, axes=(0,)):
+    """One float weight → ``{"q": int8, "scale": float32}`` with
+    abs-max symmetric scales over the contraction ``axes`` (keepdims,
+    so ``q * scale`` broadcasts back to ``w``'s shape exactly)."""
+    w = numpy.asarray(w, numpy.float32)
+    amax = numpy.max(numpy.abs(w), axis=tuple(axes), keepdims=True)
+    scale = (amax / 127.0).astype(numpy.float32)
+    # all-zero channels (fresh bias-like rows): scale 1 keeps q = 0
+    scale = numpy.where(amax > 0, scale, numpy.float32(1.0))
+    q = numpy.clip(numpy.rint(w / scale), -127, 127)
+    return {"q": q.astype(numpy.int8), "scale": scale}
+
+
+def dequantize_array(qw, dtype=numpy.float32):
+    """``q * scale`` back to float — the reference reconstruction
+    (tests and the analyzer price against it; serving never calls
+    this: the dequant lives in the qgemm epilogue)."""
+    return (qw["q"].astype(numpy.float32)
+            * numpy.asarray(qw["scale"], numpy.float32)).astype(dtype)
+
+
+def is_quantized_leaf(leaf):
+    """True for the ``{"q", "scale"}`` pair this module emits."""
+    return (isinstance(leaf, dict) and "q" in leaf and "scale" in leaf
+            and len(leaf) == 2)
+
+
+def tree_is_quantized(params):
+    """True when any leaf-level dict in ``params`` is a quantized
+    pair (the engines' deploy-mode detector)."""
+    found = []
+
+    def walk(node):
+        if is_quantized_leaf(node):
+            found.append(True)
+            return
+        if isinstance(node, dict):
+            for child in node.values():
+                walk(child)
+        elif isinstance(node, (list, tuple)):
+            for child in node:
+                walk(child)
+
+    walk(params)
+    return bool(found)
+
+
+def tree_nbytes(params):
+    """Actual bytes of every array leaf — int8 leaves count one byte
+    per element, which is the whole point: the HBM ledger, V-S01 and
+    ``describe()`` price the deploy from THIS, not from an assumed
+    float width."""
+    import jax
+    return sum(
+        int(leaf.size) * int(numpy.dtype(leaf.dtype).itemsize)
+        for leaf in jax.tree.leaves(params) if hasattr(leaf, "size"))
+
+
+def relative_drift(ref, got):
+    """``||got - ref||₂ / ||ref||₂`` — the calibration drift metric
+    (scale-free; an L2 norm so one noisy near-zero logit cannot veto
+    a deploy whose decision surface moved by nothing)."""
+    ref = numpy.asarray(ref, numpy.float32).ravel()
+    got = numpy.asarray(got, numpy.float32).ravel()
+    denom = float(numpy.linalg.norm(ref)) or 1.0
+    return float(numpy.linalg.norm(got - ref)) / denom
+
+
+def check_drift(name, drift, tol=DRIFT_TOL, blame=None):
+    """Raise :class:`QuantizationError` when ``drift`` exceeds
+    ``tol``; ``blame()`` (optional) refines the offending layer name
+    by re-measuring with one layer quantized at a time."""
+    if drift <= tol:
+        return drift
+    layer = name
+    worst = drift
+    if blame is not None:
+        layer, worst = blame()
+    raise QuantizationError(
+        "int8 quantization drifts the calibration logits by %.4g "
+        "relative (budget %.4g) — layer %r's dynamic range does not "
+        "fit 8 bits; keep it float (or rescale its weights) and "
+        "redeploy" % (drift, tol, layer), layer=layer, drift=worst)
+
+
+# -- the two deploy walks ----------------------------------------------------
+
+def quantize_transformer_params(params, only=None):
+    """Quantize the stacked block matmul weights of a
+    :class:`~veles_tpu.gen.model.TransformerGenModel` params tree
+    (``TRANSFORMER_BLOCK_AXES``); embed / pos / norms / biases stay
+    float32.  ``only``: quantize a single key (the calibration
+    blame probe)."""
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    for key, axes in TRANSFORMER_BLOCK_AXES.items():
+        if key not in blocks or (only is not None and key != only):
+            continue
+        leaf = blocks[key]
+        if is_quantized_leaf(leaf):
+            continue
+        blocks[key] = quantize_array(numpy.asarray(leaf), axes)
+    out["blocks"] = blocks
+    return out
+
+
+def quantize_gen_params(model, params, calibration_tokens=None,
+                        tol=DRIFT_TOL):
+    """Deploy-time walk for the generative engine: quantize the block
+    weights, then (when a calibration prompt is given) gate the
+    relative logit drift of the model's OWN forward
+    (``calibration_logits`` runs the same shared ``_run_layers``
+    body the engine serves from) at ``tol`` — blame is per block
+    weight key."""
+    import jax
+    host = jax.tree.map(numpy.asarray, params)
+    qparams = quantize_transformer_params(host)
+    if calibration_tokens is not None:
+        ref = numpy.asarray(
+            model.calibration_logits(host, calibration_tokens))
+
+        def drift_of(tree):
+            return relative_drift(ref, model.calibration_logits(
+                tree, calibration_tokens))
+
+        def blame():
+            per_key = {
+                key: drift_of(quantize_transformer_params(host,
+                                                          only=key))
+                for key in TRANSFORMER_BLOCK_AXES
+                if key in host["blocks"]}
+            worst = max(per_key, key=per_key.get)
+            return "blocks.%s" % worst, per_key[worst]
+
+        check_drift("blocks", drift_of(qparams), tol, blame)
+    return qparams
+
+
+def quantize_stage_params(params_list, axes_list=None, only=None):
+    """Deploy-time walk for the serve engine's per-stage params (the
+    ``[{"w": ..., "b": ...}, ...]`` list both engine constructors
+    build): every 2D float ``"w"`` quantizes over its fan-in axis
+    (``axes_list[i]["w"]`` — ``(1,)`` for transposed storage, default
+    ``(0,)``); biases / seeds / conv kernels (non-2D) stay float.
+    ``only``: quantize a single stage index (the blame probe).
+
+    Transposed storage is CANONICALIZED here: a ``(1,)``-axes stage's
+    weight is transposed once to (fan-in, out) before quantizing, so
+    the serving kernel consumes ``q`` exactly as stored — a per-call
+    ``q.T`` in the hot path would materialize an int8 copy per
+    forward, re-paying the very HBM bytes the kernel exists to save.
+    Raises :class:`QuantizationError` when NOTHING is quantizable —
+    a silent float "int8 deploy" would misreport its footprint."""
+    out = []
+    hits = 0
+    for index, state in enumerate(params_list):
+        state = dict(state)
+        w = state.get("w")
+        eligible = (
+            w is not None and not is_quantized_leaf(w)
+            and getattr(w, "ndim", 0) == 2
+            and numpy.issubdtype(numpy.asarray(w).dtype,
+                                 numpy.floating))
+        if eligible and (only is None or only == index):
+            axes = (0,)
+            if axes_list is not None and index < len(axes_list):
+                axes = tuple((axes_list[index] or {}).get("w", (0,)))
+            w = numpy.asarray(w)
+            if axes == (1,):
+                w, axes = numpy.ascontiguousarray(w.T), (0,)
+            state["w"] = quantize_array(w, axes)
+            hits += 1
+        out.append(state)
+    if not hits:
+        raise QuantizationError(
+            "no quantizable weight leaf in the params list — every "
+            "stage is bias-only, already quantized, or non-2D; an "
+            "int8 deploy of this model would be a no-op lie")
+    return out

@@ -5,8 +5,10 @@ One command on real hardware:
     python -m veles_tpu.scripts.autotune [--db PATH] [--quick]
 
 runs the device-power rating (13-chain matmul, ref
-``accelerated_units.py:706-825``), the Pallas-vs-XLA GEMM tile sweep and
-the flash-attention block sweep, and persists the winners to
+``accelerated_units.py:706-825``), the Pallas-vs-XLA GEMM tile sweep,
+the int8-weight serving GEMM sweep (``ratings["gemm_int8"]``,
+``--skip-int8``) and the flash-attention block sweep, and persists the
+winners to
 ``veles_tpu/devices/device_infos.json`` (ref
 ``/root/reference/devices/device_infos.json``, filled by
 ``backends.py:623-744``).  ``ops.gemm.matmul`` and
@@ -35,6 +37,7 @@ def main(argv=None):
                              "verdict)")
     parser.add_argument("--skip-power", action="store_true")
     parser.add_argument("--skip-gemm", action="store_true")
+    parser.add_argument("--skip-int8", action="store_true")
     parser.add_argument("--skip-attention", action="store_true")
     parser.add_argument("--skip-s2d", action="store_true")
     parser.add_argument("--skip-gather", action="store_true")
@@ -74,6 +77,18 @@ def main(argv=None):
               file=sys.stderr)
         print("gemm_v2: %s" % json.dumps(
             info.ratings.get("gemm_v2", {})), file=sys.stderr)
+
+    if not args.skip_int8:
+        # int8-weight serving GEMM (veles_tpu.ops.qgemm): the Pallas
+        # dequant-epilogue kernel vs the dense dequant baseline —
+        # ratings["gemm_int8"] is the row qmatmul's dispatch consults
+        # for quantized deploys (ModelRegistry quantize="int8")
+        shapes = ((1024, 1024, 1024),) if args.quick else None
+        info = benchmark.autotune_gemm_int8(
+            shapes=shapes, runs=1 if args.quick else 2,
+            db_path=db_path)
+        print("gemm_int8: %s" % json.dumps(
+            info.ratings.get("gemm_int8", {})), file=sys.stderr)
 
     if not args.skip_attention:
         # quick: one toy shape; full: every sequence regime in

@@ -130,10 +130,16 @@ class InferenceEngine(Logger):
 
     def __init__(self, params, apply_fn, sample_shape,
                  max_batch_size=64, buckets=None, params_source=None,
-                 mesh=None, param_specs=None, **kwargs):
+                 mesh=None, param_specs=None, quant_axes=None,
+                 **kwargs):
         super(InferenceEngine, self).__init__(**kwargs)
         import jax
         self._jax = jax
+        #: per-stage quantization axes ({"w": (axis,)} aligned with
+        #: the params list) — the engine constructors derive them from
+        #: each unit's ``weights_transposed`` so ``quantize_int8``
+        #: reduces over the true fan-in axis
+        self._quant_axes = quant_axes
         self.sample_shape = tuple(int(d) for d in sample_shape)
         self.max_batch_size = int(max_batch_size)
         if self.max_batch_size < 1:
@@ -160,6 +166,17 @@ class InferenceEngine(Logger):
             self._params = jax.device_put(params, p_sh)
             self._jit = jax.jit(apply_fn, in_shardings=(p_sh, repl),
                                 out_shardings=repl)
+        from veles_tpu.quant import tree_is_quantized, tree_nbytes
+        #: "int8" after quantize_int8() (or when constructor-injected
+        #: params already carry veles_tpu.quant pairs); None = float
+        self.quantized = "int8" if tree_is_quantized(params) else None
+        #: actual device bytes of the served params (int8 leaves count
+        #: one byte) — held in the HBM ledger's params category until
+        #: close(); the int8-vs-float acceptance gate reads this line
+        from veles_tpu.memory import Watcher
+        self.params_nbytes = tree_nbytes(self._params)
+        Watcher.track(self.params_nbytes, "params")
+        self._params_tracked = True
         self._compiled = {}          # batch size -> AOT executable
         self._compile_lock = threading.Lock()
         self.compile_count = 0
@@ -217,6 +234,7 @@ class InferenceEngine(Logger):
             {k: v for k, v in state.items()
              if k in ("w", "b", "seed") and v is not None}
             for state in params]
+        kwargs.setdefault("quant_axes", cls._quant_axes_of(forwards))
         return cls(params, lambda p, x: apply_fn(p, x, train=False),
                    sample_shape, **kwargs)
 
@@ -263,9 +281,21 @@ class InferenceEngine(Logger):
 
         if sample_shape is None:
             sample_shape = cls._infer_sample_shape(None, forwards)
+        kwargs.setdefault("quant_axes", cls._quant_axes_of(forwards))
         return cls(read_params(), apply_fn, sample_shape,
                    params_source=read_params if live else None,
                    **kwargs)
+
+    @staticmethod
+    def _quant_axes_of(forwards):
+        """Per-stage quantization axes from the units' storage
+        orientation: transposed weights are (neurons, fan-in), so the
+        abs-max reduction runs over axis 1 there, axis 0 otherwise —
+        one scale per output neuron either way."""
+        return [
+            {"w": ((1,) if getattr(u, "weights_transposed", False)
+                   else (0,))}
+            for u in forwards]
 
     @classmethod
     def from_snapshot(cls, path, **kwargs):
@@ -336,7 +366,8 @@ class InferenceEngine(Logger):
                 # cost rides the span args (recorded at span exit) so
                 # an exported trace is a self-contained perf report —
                 # same schema as the segment compile instants
-                cost, new_args = prof.span_cost_args(exe, span_args)
+                cost, new_args = prof.span_cost_args(
+                    exe, span_args, peak_dtype=self.quantized)
                 span_args.update(new_args)
                 if self._warmed:
                     # in-band steadiness for the offline report
@@ -348,6 +379,10 @@ class InferenceEngine(Logger):
                     prof.ledger.entry(
                         "bucket", "%s[b%d]" % (self.prof_name,
                                                batch_size))
+            if self.quantized:
+                # honest MFU denominator for quantized buckets
+                # (backends.PEAK_INT8_OPS)
+                entry.peak_dtype = self.quantized
             prof.ledger.record_compile(entry, cost=cost,
                                        steady=self._warmed)
             self.debug("compiled bucket %d (compile #%d)", batch_size,
@@ -368,6 +403,102 @@ class InferenceEngine(Logger):
                            "batch reached a shape no warmed bucket "
                            "covers" % batch_size)
         return exe
+
+    def quantize_int8(self, calibration=None, tol=None):
+        """Quantize the served params in place (per-output-channel
+        symmetric int8 over each stage's 2D ``"w"``; biases and
+        non-2D kernels stay float) — the ``ModelRegistry.deploy(...,
+        quantize="int8")`` hook.  Must run BEFORE :meth:`warmup` so
+        every bucket compiles against the quantized tree exactly once
+        (the zero-steady-state-recompile contract).
+
+        ``calibration``: optional host batch; when given, the float
+        forward (``reference_forward``) is compared against the
+        quantized forward and a relative logit drift beyond ``tol``
+        (default :data:`veles_tpu.quant.DRIFT_TOL`) raises a typed
+        :class:`~veles_tpu.quant.QuantizationError` NAMING the stage
+        whose dynamic range does not fit 8 bits (per-stage blame
+        probe).  Only engines whose ``apply_fn`` routes through the
+        pure-function protocol (``from_workflow``/``from_forwards``)
+        can serve quantized pairs; ``params_source`` (live) engines
+        are refused — a float refresh would clash with the quantized
+        tree's structure.  Returns self (chainable)."""
+        from veles_tpu import quant
+        jax = self._jax
+        if self._warmed or self.compile_count:
+            raise RuntimeError(
+                "quantize_int8 must run before warmup()/any compile — "
+                "a post-warmup dtype flip would recompile every "
+                "bucket in steady state")
+        if self.params_source is not None:
+            raise ValueError(
+                "cannot quantize a live (params_source) engine — the "
+                "per-call float refresh would clash with the "
+                "quantized tree; deploy a frozen snapshot instead")
+        if self.mesh is not None:
+            raise ValueError(
+                "int8-quantized params cannot shard over a mesh yet — "
+                "serve the quantized deploy single-device/replicated")
+        if self.quantized:
+            return self
+        tol = quant.DRIFT_TOL if tol is None else tol
+        host = jax.tree.map(numpy.asarray, self._params)
+        qparams = quant.quantize_stage_params(host, self._quant_axes)
+        if calibration is not None:
+            calibration = numpy.ascontiguousarray(calibration,
+                                                  numpy.float32)
+            ref = self.reference_forward(calibration)
+
+            def drift_of(tree):
+                return quant.relative_drift(
+                    ref, numpy.asarray(self._jit(jax.device_put(tree),
+                                                 calibration)))
+
+            def blame():
+                per_stage = {
+                    index: drift_of(quant.quantize_stage_params(
+                        host, self._quant_axes, only=index))
+                    for index, state in enumerate(host)
+                    if quant.is_quantized_leaf(qparams[index]
+                                               .get("w"))}
+                worst = max(per_stage, key=per_stage.get)
+                return "stage[%d].w" % worst, per_stage[worst]
+
+            quant.check_drift("params", drift_of(qparams), tol, blame)
+        self._params = jax.device_put(qparams)
+        self.quantized = "int8"
+        self._out_struct_ = None
+        # re-price the ledger hold from the new (int8) leaves
+        from veles_tpu.memory import Watcher
+        if getattr(self, "_params_tracked", False):
+            Watcher.untrack(self.params_nbytes, "params")
+        self.params_nbytes = quant.tree_nbytes(self._params)
+        Watcher.track(self.params_nbytes, "params")
+        self._params_tracked = True
+        self.info("quantized params to int8 (%d bytes resident)",
+                  self.params_nbytes)
+        return self
+
+    def describe(self):
+        """Deploy surface (merged into ``_Model.describe()``): the
+        quant mode and the params' actual resident bytes next to the
+        compile/bucket plan."""
+        return {
+            "sample_shape": list(self.sample_shape),
+            "quantize": self.quantized,
+            "params_bytes": self.params_nbytes,
+            "sharded": self.mesh is not None,
+        }
+
+    def close(self):
+        """Release the params-category ledger hold (the device arrays
+        themselves are freed by GC once the last in-flight batch drops
+        its reference).  Idempotent — the registry calls this on
+        undeploy/stop and when a hot swap retires the engine."""
+        if getattr(self, "_params_tracked", False):
+            from veles_tpu.memory import Watcher
+            Watcher.untrack(self.params_nbytes, "params")
+            self._params_tracked = False
 
     def warmup(self):
         """AOT-compile every bucket; returns self (chainable).  After

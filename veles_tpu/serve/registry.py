@@ -155,6 +155,13 @@ class _Model(object):
             "compile_count": self.engine.compile_count,
             "queue_depth": self.batcher.queue_depth(),
         }
+        if not isinstance(self.engine, ReplicaSet) \
+                and hasattr(self.engine, "describe"):
+            # engine-level surface (quant mode, actual params bytes,
+            # sharding) — dashboards read the int8 win from here; a
+            # ReplicaSet's describe() is its per-member LIST and is
+            # reported under "replicas" below instead
+            info.update(self.engine.describe())
         if isinstance(self.engine, ReplicaSet):
             # per-replica weights/versions/served counts — rollout
             # dashboards and tests assert canary splits from here
@@ -225,8 +232,25 @@ class ModelRegistry(Logger):
                     'queue_depth{model="%s"}' % name,
                     model.batcher.queue_depth)
 
+    def _resolve_quantize(self, quantize):
+        """The deploy-time quant mode: the explicit argument, else the
+        ``root.common.serve.quantize`` knob (default off).  Returns
+        ``"int8"`` or ``None``; a typo'd mode raises instead of
+        silently deploying float."""
+        if quantize is None:
+            quantize = root.common.serve.get("quantize", "off")
+        mode = str(quantize).strip().lower()
+        if mode in ("off", "no", "false", "0", "none", ""):
+            return None
+        if mode != "int8":
+            raise ValueError(
+                "quantize mode %r — want off | int8 (the knob is "
+                "root.common.serve.quantize)" % (quantize,))
+        return "int8"
+
     def deploy(self, name, engine, version=None, source=None,
-               warmup=True, allow_reshape=False):
+               warmup=True, allow_reshape=False, quantize=None,
+               calibration=None):
         """Install ``engine`` as the current version of ``name``.
 
         First deploy for a name creates its batcher; later deploys
@@ -235,12 +259,33 @@ class ModelRegistry(Logger):
         AOT-compiles the new engine's buckets BEFORE the swap, so the
         first post-swap batch pays zero compile latency.
 
+        ``quantize="int8"`` (or the ``root.common.serve.quantize``
+        knob) quantizes the engine's params in place BEFORE warmup
+        (``InferenceEngine.quantize_int8`` — per-output-channel
+        symmetric int8, biases float), with ``calibration`` as the
+        optional drift-gate batch; replica sets must quantize their
+        member engines individually (the set itself is refused).
+
         A swap that CHANGES the model's sample shape is refused unless
         ``allow_reshape=True`` (queued old-shape requests cannot be
         honored by the new engine — deploy a different topology under
         a new name, or opt in and let those requests fail with a shape
         error while new-shape traffic proceeds).
         """
+        mode = self._resolve_quantize(quantize)
+        if mode and getattr(engine, "quantized", None) != mode:
+            if not hasattr(engine, "quantize_int8"):
+                if quantize is not None:
+                    raise ValueError(
+                        "%s cannot be quantized at deploy — quantize "
+                        "the member engines individually"
+                        % type(engine).__name__)
+                self.warning(
+                    "serve.quantize knob is on but %s has no "
+                    "quantize_int8 — deploying as-is",
+                    type(engine).__name__)
+            else:
+                engine.quantize_int8(calibration=calibration)
         if warmup:
             engine.warmup()
         with self._lock:
@@ -270,8 +315,10 @@ class ModelRegistry(Logger):
                         " deploy under a new name or pass "
                         "allow_reshape=True" % (name, tuple(old_shape),
                                                 tuple(new_shape)))
+                retired = model.batcher.engine
                 model.batcher.engine = engine   # THE hot swap
                 model.swaps += 1
+                self._retire_engine(retired, engine)
             model.version = version if version is not None \
                 else (model.swaps + 1)
             model.deployed_at = time.time()
@@ -281,9 +328,35 @@ class ModelRegistry(Logger):
                   else "")
         return model
 
+    @staticmethod
+    def _retire_engine(retired, successor):
+        """Drop a swapped-out engine's HBM-ledger hold (close() only
+        releases accounting; in-flight batches finish unharmed) —
+        UNLESS the successor still serves it (the canary path wraps
+        the stable engine inside the incoming ReplicaSet)."""
+        if retired is None or retired is successor:
+            return
+        members = []
+        if isinstance(successor, ReplicaSet):
+            members = [m["engine"] for m in successor._members]
+        if retired in members:
+            return
+        if isinstance(retired, ReplicaSet):
+            # promotion: the winning member survives inside successor
+            # deploys only if it IS the successor (checked above)
+            for member in retired._members:
+                if member["engine"] is not successor:
+                    ModelRegistry._retire_engine(member["engine"],
+                                                 successor)
+            return
+        close = getattr(retired, "close", None)
+        if close is not None:
+            close()
+
     def deploy_replica_set(self, name, replicas, version=None,
                            source=None, warmup=True,
-                           allow_reshape=False):
+                           allow_reshape=False, quantize=None,
+                           calibration=None):
         """Deploy a weighted :class:`ReplicaSet` under ``name``.
 
         ``replicas``: ``[(engine, weight), ...]`` or ``[(engine,
@@ -303,7 +376,8 @@ class ModelRegistry(Logger):
         replica_set = ReplicaSet(normalized)
         return self.deploy(name, replica_set, version=version,
                            source=source or "replica_set",
-                           warmup=warmup, allow_reshape=allow_reshape)
+                           warmup=warmup, allow_reshape=allow_reshape,
+                           quantize=quantize, calibration=calibration)
 
     def deploy_canary(self, name, engine, weight=0.1, version=None,
                       warmup=True):
@@ -366,13 +440,23 @@ class ModelRegistry(Logger):
 
     def deploy_generative(self, name, engine, version=None,
                           source=None, warmup=True,
-                          scheduler_config=None):
+                          scheduler_config=None, quantize=None,
+                          calibration=None):
         """Install a :class:`veles_tpu.gen.engine.GenerativeEngine`
         under ``name`` with its own continuous-batching scheduler
         (started on a worker thread).  Redeploying a generative name
         is a DRAIN swap: the old scheduler finishes its streams, its
         engine releases the KV cache, then the successor takes over —
-        token streams cannot migrate between engines mid-request."""
+        token streams cannot migrate between engines mid-request.
+
+        ``quantize="int8"`` (or the ``root.common.serve.quantize``
+        knob) quantizes the engine's params BEFORE the V-S01
+        preflight and warmup (``GenerativeEngine.quantize_int8``), so
+        the preflight prices the deploy from the actual int8 bytes;
+        ``calibration`` is the optional drift-gate token prompt."""
+        mode = self._resolve_quantize(quantize)
+        if mode and getattr(engine, "quantized", None) != mode:
+            engine.quantize_int8(calibration_tokens=calibration)
         self.preflight_generative(engine, name)
         if warmup:
             engine.warmup()
@@ -505,6 +589,7 @@ class ModelRegistry(Logger):
             model.engine.close()
         else:
             model.batcher.stop(drain=drain)
+            self._retire_engine(model.engine, None)
         self.info("undeployed %s", name)
         return model
 
@@ -547,3 +632,4 @@ class ModelRegistry(Logger):
                 model.engine.close()
             else:
                 model.batcher.stop(drain=drain)
+                self._retire_engine(model.engine, None)

@@ -8,6 +8,12 @@ activation(input·W + b)`` with Znicz's activation definitions (scaled tanh
 
 TPU path: one fused call into :func:`veles_tpu.ops.gemm.matmul` — the
 activation rides the GEMM epilogue, input stays on HBM between layers.
+Every entry point (``tpu_run``, the stitched stage, the fused lowering
+and the serving engines, all through :meth:`All2All.pure`) routes
+through that one call, so the autotune DB's measured tiles and the
+Pallas-vs-XLA verdict apply everywhere; int8-quantized deploys
+(:mod:`veles_tpu.quant`) swap in :func:`veles_tpu.ops.qgemm.qmatmul`
+per weight leaf.
 """
 
 import numpy
@@ -43,22 +49,47 @@ class All2All(ForwardBase):
     @staticmethod
     def pure(params, x, activation=None, is_softmax=False,
              transposed=False):
-        """Pure functional form (feeds the fused lowering and GDViaVJP)."""
+        """Pure functional form (feeds the fused lowering, GDViaVJP,
+        segment stitching AND the serving engine) — ONE fused call
+        into :func:`veles_tpu.ops.gemm.matmul` as the module header
+        promises: bias + activation ride the GEMM epilogue, tiles
+        come from the autotune DB, and off-TPU the dispatch resolves
+        to the byte-identical ``jnp.dot`` path (``_matmul_jnp``), so
+        the host/interpret numerics are unchanged.  An int8-quantized
+        weight (:mod:`veles_tpu.quant` pair) routes through
+        :func:`veles_tpu.ops.qgemm.qmatmul` instead — the serving
+        engines' deploy-time quantization reaches every All2All
+        stage through this one branch."""
         import jax
         import jax.numpy as jnp
         h = x.reshape(x.shape[0], -1)
         w = params["w"]
+        b = params.get("b")
+        if isinstance(w, dict):     # veles_tpu.quant {"q","scale"}
+            # always (fan-in, out): quantize_stage_params
+            # canonicalizes transposed storage at DEPLOY time, so the
+            # int8 operand feeds the kernel exactly as stored — no
+            # per-call transpose copy in the weight-bound hot path
+            from veles_tpu.ops import qgemm
+            q, scale = w["q"], w["scale"].reshape(-1)
+            z = qgemm.qmatmul(h, q, scale, b,
+                              None if is_softmax else activation,
+                              out_dtype=jnp.float32)
+            if is_softmax:
+                return jax.nn.softmax(z, axis=-1).astype(x.dtype)
+            return z.astype(x.dtype)
         if transposed:
             # documented knob weights_transposed: storage is
             # (neurons, fan-in); XLA folds the transpose into the dot
             w = w.T
-        z = jnp.dot(h, w, preferred_element_type=jnp.float32)
-        if "b" in params:
-            z = z + params["b"]
         if is_softmax:
+            # widen the stream first: matmul returns its A operand's
+            # dtype, and a bf16 round-trip on the LOGITS before the
+            # softmax would flip near-tie argmaxes vs the pre-matmul
+            # f32 path (f32 streams: a no-op, byte-identical)
+            z = gemm.matmul(h.astype(jnp.float32), w, b, None)
             return jax.nn.softmax(z, axis=-1).astype(x.dtype)
-        from veles_tpu.znicz.fused import _ACT
-        return _ACT[activation](z).astype(x.dtype)
+        return gemm.matmul(h, w, b, activation).astype(x.dtype)
 
     def initialize(self, device=None, **kwargs):
         super(All2All, self).initialize(device=device, **kwargs)
