@@ -1685,6 +1685,92 @@ def stage_transformer_gen():
                         "phase" % q_recompiles)
     print(_dumps(rec))
 
+    # -- disagg phase: 2-role fleet (prefill role shipping KV pages --
+    # over the job wire to decode replicas) vs the SAME bursty
+    # open-loop workload on ONE paged engine — the ratio prices
+    # disaggregation itself (wire + adoption overhead vs role
+    # isolation).  Emits sustained req/s, TTFT p99 against the 500 ms
+    # SLO, handoff bytes per request and the autoscaler's action
+    # count — all regression-gated by scripts/bench_diff.py.
+    from veles_tpu.fleet import Fleet
+
+    block = 8 if tiny else 16
+    paged_kw = dict(kv="paged", block_size=block,
+                    num_blocks=slots * (max_seq // block) + 1,
+                    prefill_chunk=buckets[0])
+
+    def build_paged():
+        model = TransformerGenModel(
+            cfg, compute_dtype=dtype) if dtype else \
+            TransformerGenModel(cfg)
+        return GenerativeEngine(model, max_slots=slots,
+                                max_seq=max_seq,
+                                prefill_buckets=buckets, seed=0,
+                                **paged_kw)
+
+    def pump_bursty(submit, tick=None):
+        """Open-loop: bursts of 8 with a think-time gap — the arrival
+        pattern disaggregation exists for (prefill spikes must not
+        stall in-flight decode)."""
+        futures = []
+        tic = time.perf_counter()
+        for start in range(0, len(workload), 8):
+            for toks, max_new in workload[start:start + 8]:
+                futures.append(submit(toks, max_new))
+            if tick is not None:
+                tick()
+            time.sleep(0.02)
+        for future in futures:
+            future.result(timeout=600.0)
+        return time.perf_counter() - tic
+
+    recompiles0 = prof.ledger.recompiles
+    single = build_paged().warmup()
+    s_sched = GenerativeScheduler(single, name="bench-single").start()
+    s_sec = pump_bursty(s_sched.submit)
+    s_ttft = s_sched.ttft.percentile(99) * 1e3
+    s_sched.stop()
+    single.close()
+    s_rps = n_requests / s_sec if s_sec else 0.0
+
+    fleet = Fleet(build_paged, decode_replicas=2, name="bench",
+                  max_queue=4096).start()
+    f_sec = pump_bursty(fleet.submit, tick=fleet.tick)
+    f_ttft = fleet.ttft_p99_ms()
+    actions = dict(fleet.autoscaler.actions_total)
+    handoff_bpr = fleet.handoff_bytes_total // max(
+        1, fleet.handoffs_total)
+    fleet.stop(drain=True)
+    fleet.close()
+    d_recompiles = prof.ledger.recompiles - recompiles0
+    f_rps = n_requests / f_sec if f_sec else 0.0
+    rec = {
+        "metric": "transformer generative serving, disaggregated "
+                  "prefill/decode fleet"
+                  + (" [tiny-smoke]" if tiny else ""),
+        "value": round(f_rps, 2),
+        "unit": "req/sec",
+        "vs_baseline": None,
+        "vs_single_engine_x": round(f_rps / s_rps, 3)
+        if s_rps else None,
+        "single_req_per_sec": round(s_rps, 2),
+        "ttft_p99_ms": round(f_ttft, 1),
+        "single_ttft_p99_ms": round(s_ttft, 1),
+        "ttft_slo_ms": 500.0,
+        "slo_met": bool(f_ttft <= 500.0),
+        "handoff_bytes_per_request": handoff_bpr,
+        "autoscaler_actions": int(sum(actions.values())),
+        "autoscaler_actions_by_kind": actions,
+        "decode_replicas": 2,
+        "recompiles": d_recompiles,
+        "slots": slots,
+        "requests": n_requests,
+        "device_kind": _device_kind()}
+    if d_recompiles:
+        rec["error"] = ("%d steady-state recompile(s) in the disagg "
+                        "phase" % d_recompiles)
+    print(_dumps(rec))
+
 
 #: the reference DB's fastest recorded matmul: GTX TITAN, float,
 #: precision 0 — 0.1642 s for ONE 3001² matmul (``backends.py:672-731``

@@ -77,9 +77,15 @@ _COUNTERS = ("recompiles", "dispatches_per_epoch")
 #: soft fields beyond ``value`` compared with the relative tolerance
 #: (vs_bf16_x: the int8 serving win over the same-run bf16 engine;
 #: hbm_per_request_bytes: the paged/int8 capacity win — both from
-#: the stage_transformer_gen int8/long-tail records)
+#: the stage_transformer_gen int8/long-tail records;
+#: ttft_p99_ms / handoff_bytes_per_request / autoscaler_actions: the
+#: disagg-fleet record — latency under the 500 ms SLO, wire cost per
+#: request, and control-loop churn are all regressions when they
+#: grow)
 _HIGHER_BETTER_FIELDS = ("mfu", "steps_per_dispatch", "vs_bf16_x")
-_LOWER_BETTER_FIELDS = ("sec_per_step", "hbm_per_request_bytes")
+_LOWER_BETTER_FIELDS = ("sec_per_step", "hbm_per_request_bytes",
+                        "ttft_p99_ms", "handoff_bytes_per_request",
+                        "autoscaler_actions")
 
 
 def value_direction(record):

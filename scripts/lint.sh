@@ -102,3 +102,14 @@ echo "== pod smoke (one-pod-one-program gate) =="
 timeout -k 10 280 env JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -m veles_tpu.pod --smoke
+# fleet smoke: the disaggregated-serving gate — a scripted 2-role
+# session (prefill role over the job wire, 2 decode replicas) must
+# resolve a seeded request set with EXACT token parity vs a
+# single-engine oracle while chaos drops one page-handoff frame
+# (exactly-once retry) and one job frame (have-list requeue), a
+# chaos-fired replica_drain scales down mid-stream losslessly, a
+# synthetic TTFT-p99 burn breach makes the autoscaler shift the
+# decode weights, and ZERO steady-state recompiles land on either
+# role (docs/services.md § Disaggregated serving)
+echo "== fleet smoke (disaggregated prefill/decode gate) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m veles_tpu.fleet --smoke

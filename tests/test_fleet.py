@@ -1,0 +1,372 @@
+"""veles_tpu.fleet — disaggregated prefill/decode serving tests.
+
+THE disaggregated parity gate lives here: a fleet session (prefill
+role shipping KV pages over the job wire to decode replicas) must
+produce BITWISE identical token streams to a single-engine oracle —
+including under an injected page-frame drop + dup, and across a
+chaos-timed mid-stream replica drain (prefix replay on a survivor).
+The autoscaler's closed loop is proven on a synthetic TTFT-p99 burn
+breach, and its hysteresis on a recovering/flapping series.
+"""
+
+import threading
+import time
+
+import numpy
+import pytest
+
+from veles_tpu import chaos, prof
+from veles_tpu.chaos import Fault
+from veles_tpu.fleet import Fleet, FleetAutoscaler
+from veles_tpu.gen import (GenerativeEngine, GenerativeScheduler,
+                           TransformerGenModel)
+from veles_tpu.samples.transformer import TINY
+
+CFG = dict(TINY, seq_len=64)
+
+
+def build_engine(seed=7, max_slots=3, num_blocks=19, **kwargs):
+    return GenerativeEngine(
+        TransformerGenModel(CFG), max_slots=max_slots, max_seq=48,
+        prefill_buckets=(8, 16), kv="paged", block_size=8,
+        num_blocks=num_blocks, prefill_chunk=8, seed=seed, **kwargs)
+
+
+def mixed_workload(n=8, seed=0, max_new_lo=6, max_new_hi=12):
+    rng = numpy.random.RandomState(seed)
+    return [
+        (rng.randint(1, CFG["vocab"],
+                     size=rng.randint(4, 20)).astype(numpy.int32),
+         int(rng.randint(max_new_lo, max_new_hi + 1)))
+        for _ in range(n)]
+
+
+def oracle_streams(workload):
+    engine = build_engine()
+    engine.warmup()
+    scheduler = GenerativeScheduler(engine, name="oracle")
+    futures = [scheduler.submit(toks, max_new)
+               for toks, max_new in workload]
+    scheduler.run_until_idle()
+    out = [f.result(0) for f in futures]
+    scheduler.stop()
+    engine.close()
+    return out
+
+
+@pytest.fixture
+def fleet():
+    built = Fleet(build_engine, decode_replicas=2, name="t",
+                  rpc_timeout_ms=600, heartbeat_interval=0.1,
+                  max_queue=64).start()
+    yield built
+    built.stop(drain=False)
+    built.close()
+    chaos.controller.disarm()
+
+
+class TestPageHandoff(object):
+    def test_export_adopt_bitwise_parity(self):
+        """Engine level: pages exported from one engine and adopted
+        into another continue the stream bitwise (same seed, fresh
+        BlockPool on the destination)."""
+        src = build_engine()
+        src.warm_handoff()
+        src.warmup()
+        dst = build_engine()
+        dst.warm_handoff()
+        dst.warmup()
+        prompt = numpy.arange(1, 12, dtype=numpy.int32)
+
+        sched = GenerativeScheduler(src, name="src")
+        want = sched.generate(prompt, 10)
+        from veles_tpu.gen.scheduler import GenRequest
+        job = GenRequest(prompt, 1, export_pages=True)
+        sched.submit_request(job)
+        sched.run_until_idle()
+        payload = job.export
+        assert payload is not None
+        assert payload["token"] == want[0]
+        assert len(payload["k"]) == src._pool.blocks_for(len(prompt))
+
+        slot, token = dst.adopt_sequence(payload)
+        got = [token]
+        while len(got) < 10:
+            tokens, active = dst.decode_step()
+            assert active[slot]
+            got.append(int(tokens[slot]))
+        assert got == want
+        sched.stop()
+        src.close()
+        dst.close()
+
+    def test_fleet_parity_under_page_drop_and_dup(self, fleet):
+        """The tier-1 disaggregated gate: fleet streams == oracle
+        streams with a page frame DROPPED (exactly-once retry) and a
+        page frame DUPLICATED (dedup) on the wire."""
+        workload = mixed_workload(n=8, seed=3)
+        expected = oracle_streams(workload)
+        chaos.controller.arm([
+            Fault(site="master_recv", action="drop", op="page", nth=1),
+            Fault(site="slave_send", action="dup", op="page", nth=3),
+        ], seed=3)
+        before = prof.ledger.recompiles
+        futures = [fleet.submit(toks, max_new)
+                   for toks, max_new in workload]
+        results = [f.result(timeout=120.0) for f in futures]
+        assert results == expected
+        assert fleet.handoffs_total == len(workload)
+        # the dup really crossed the wire and was consumed exactly once
+        assert chaos.controller.faults_injected >= 2
+        assert fleet._master.dedup_dropped >= 1
+        assert prof.ledger.recompiles == before
+
+    def test_job_frame_loss_requeues_prompt(self, fleet):
+        """A job frame lost master->slave must requeue the prompt
+        (have-list / rejoin machinery) and still resolve it."""
+        workload = mixed_workload(n=4, seed=5)
+        expected = oracle_streams(workload)
+        chaos.controller.arm([
+            Fault(site="master_send", action="drop", op="job", nth=2),
+        ], seed=5)
+        futures = [fleet.submit(toks, max_new)
+                   for toks, max_new in workload]
+        results = [f.result(timeout=120.0) for f in futures]
+        assert results == expected
+        assert fleet.requeued_total >= 1
+
+    def test_adoption_respects_pool_pricing(self, fleet):
+        """More concurrent streams than one replica's pool can hold:
+        the handoff admission lane must defer, not fail, and every
+        stream still resolves with parity."""
+        workload = mixed_workload(n=10, seed=11, max_new_lo=8,
+                                  max_new_hi=14)
+        expected = oracle_streams(workload)
+        futures = [fleet.submit(toks, max_new)
+                   for toks, max_new in workload]
+        results = [f.result(timeout=120.0) for f in futures]
+        assert results == expected
+
+
+class TestElasticity(object):
+    def test_drain_midstream_is_lossless(self, fleet):
+        """Chaos-timed scale-down: drain a replica while its streams
+        are mid-decode; every stream replays onto the survivor and
+        finishes bitwise-identical, zero steady recompiles."""
+        workload = mixed_workload(n=6, seed=9, max_new_lo=24,
+                                  max_new_hi=32)
+        expected = oracle_streams(workload)
+        before = prof.ledger.recompiles
+        futures = [fleet.submit(toks, max_new)
+                   for toks, max_new in workload]
+        # wait until decode replicas actually hold streams, then yank
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            if any(s.active_requests()
+                   for s in fleet.router.engines()):
+                break
+            time.sleep(0.005)
+        replayed = fleet.drain_replica()
+        results = [f.result(timeout=120.0) for f in futures]
+        assert results == expected
+        assert fleet.drains_total == 1
+        assert len(fleet.router) == 1
+        assert fleet.replayed_total == replayed
+        assert prof.ledger.recompiles == before
+
+    def test_chaos_replica_drain_via_tick(self, fleet):
+        """The chaos ``replica_drain`` process action drives the same
+        drain through ``Fleet.tick`` — and refuses to fire the fleet
+        down to zero replicas."""
+        chaos.controller.arm([
+            Fault(site="fleet_decode", action="replica_drain",
+                  every=1),
+        ], seed=1)
+        assert fleet.tick() == "chaos_drain"
+        assert len(fleet.router) == 1
+        # a second fault fires but the last replica is never drained
+        assert fleet.tick() != "chaos_drain"
+        assert len(fleet.router) == 1
+
+    def test_drain_refuses_last_replica(self, fleet):
+        fleet.drain_replica()
+        with pytest.raises(ValueError):
+            fleet.drain_replica()
+
+    def test_add_replica_grows_and_serves(self, fleet):
+        """Scale-up: a freshly warmed replica joins the router and
+        the fleet keeps its parity contract (growth compiles are
+        pre-steady, so the recompile gate stays green)."""
+        workload = mixed_workload(n=4, seed=13)
+        expected = oracle_streams(workload)
+        version = fleet.add_replica()
+        assert len(fleet.router) == 3
+        before = prof.ledger.recompiles
+        futures = [fleet.submit(toks, max_new)
+                   for toks, max_new in workload]
+        results = [f.result(timeout=120.0) for f in futures]
+        assert results == expected
+        assert prof.ledger.recompiles == before
+        assert version in [m["version"]
+                           for m in fleet.router.describe()]
+
+    def test_spill_serves_on_prefill_role(self, fleet):
+        """Spill credits route admissions end to end through the
+        prefill role — same tokens, zero page handoffs for them."""
+        workload = mixed_workload(n=3, seed=17)
+        expected = oracle_streams(workload)
+        fleet.spill(len(workload))
+        futures = [fleet.submit(toks, max_new)
+                   for toks, max_new in workload]
+        results = [f.result(timeout=120.0) for f in futures]
+        assert results == expected
+        assert fleet.spilled_total == len(workload)
+        assert fleet.handoffs_total == 0
+
+
+class _FleetStub(object):
+    """Action recorder standing in for a Fleet (the autoscaler only
+    touches this surface)."""
+
+    class _Router(object):
+        def __init__(self, stub, n):
+            self._stub = stub
+            self.n = n
+
+        def __len__(self):
+            return self.n
+
+        def engines(self):
+            class _E(object):
+                class engine(object):
+                    free_slots = 2
+            return [_E() for _ in range(self.n)]
+
+    def __init__(self, n=2):
+        self.router = self._Router(self, n)
+        self.actions = []
+
+    def set_weights(self, weights):
+        self.actions.append(("weight_shift", list(weights)))
+
+    def spill(self, n):
+        self.actions.append(("spill", n))
+
+    def add_replica(self, weight=1.0):
+        self.router.n += 1
+        self.actions.append(("grow", None))
+
+    def drain_replica(self, version=None):
+        self.router.n -= 1
+        self.actions.append(("shrink", None))
+        return 0
+
+
+class _ScriptedSLO(object):
+    """Deterministic signal source for hysteresis tests."""
+
+    def __init__(self, series):
+        self.series = list(series)
+        self.i = 0
+
+    def autoscaling_signals(self, now=None):
+        burn = self.series[min(self.i, len(self.series) - 1)]
+        self.i += 1
+        return {"queue_depth": 0.0, "batch_fill": 0.5,
+                "ttft_p99_burn_rate": burn}
+
+
+class TestAutoscaler(object):
+    def _scaler(self, series, n=2, **knobs):
+        stub = _FleetStub(n)
+        knobs.setdefault("breach_ticks", 2)
+        knobs.setdefault("recover_ticks", 3)
+        knobs.setdefault("cooldown_s", 5.0)
+        scaler = FleetAutoscaler(stub, _ScriptedSLO(series), **knobs)
+        return stub, scaler
+
+    def test_breach_must_hold_before_acting(self):
+        """One breached tick is noise; ``breach_ticks`` consecutive
+        breaches act — and the first rung is the weight shift."""
+        stub, scaler = self._scaler([5.0, 0.0, 5.0, 5.0])
+        t = 100.0
+        assert scaler.tick(now=t) is None          # breach #1
+        assert scaler.tick(now=t + 1) is None      # healthy resets
+        assert scaler.tick(now=t + 2) is None      # breach #1 again
+        assert scaler.tick(now=t + 3) == "weight_shift"
+        assert stub.actions == [("weight_shift", [3.0, 3.0])]
+
+    def test_escalation_ladder_and_cooldown(self):
+        """Sustained breach climbs weight_shift -> spill -> grow, one
+        rung per cooldown window; inside the window the scaler only
+        observes."""
+        stub, scaler = self._scaler([5.0] * 20, cooldown_s=10.0,
+                                    max_decode=3)
+        t = 100.0
+        assert scaler.tick(now=t) is None
+        assert scaler.tick(now=t + 1) == "weight_shift"
+        # cooldown: breaches keep arriving, nothing fires
+        assert scaler.tick(now=t + 2) is None
+        assert scaler.tick(now=t + 5) is None
+        assert scaler.tick(now=t + 12) == "spill"  # window over
+        assert scaler.tick(now=t + 13) is None
+        assert scaler.tick(now=t + 24) == "grow"
+        assert [a for a, _ in stub.actions] == \
+            ["weight_shift", "spill", "grow"]
+
+    def test_recovery_shrinks_after_sustained_health(self):
+        stub, scaler = self._scaler([5.0, 5.0] + [0.0] * 10,
+                                    cooldown_s=1.0)
+        t = 100.0
+        scaler.tick(now=t)
+        assert scaler.tick(now=t + 1) == "weight_shift"
+        got = [scaler.tick(now=t + 2 + i) for i in range(6)]
+        assert "shrink" in got
+        assert got.index("shrink") >= scaler.recover_ticks - 1
+        assert stub.router.n == 1
+        # at min_decode: sustained health never drains the last one
+        assert all(scaler.tick(now=t + 20 + i) is None
+                   for i in range(5))
+        assert stub.router.n == 1
+
+    def test_flapping_series_never_acts(self):
+        """The hysteresis contract: a breach/recover square wave
+        (period below both windows) takes ZERO actions."""
+        stub, scaler = self._scaler([5.0, 0.0] * 20)
+        for i in range(40):
+            assert scaler.tick(now=100.0 + i) is None
+        assert stub.actions == []
+        assert scaler.ticks_total == 40
+
+    def test_closed_loop_on_real_fleet(self, fleet):
+        """End to end: a synthetic TTFT-p99 burn breach through the
+        REAL SLO engine makes the REAL fleet shift weights, with the
+        action visible on the scrape."""
+        now = time.time() + 60.0
+        ring = fleet.slo.ring("ttft_p99_ms")
+        for i in range(30):
+            ring.append(900.0, t=now - 3.0 + i * 0.1)
+        actions = [fleet.tick(now=now + i * 0.5)
+                   for i in range(fleet.autoscaler.breach_ticks)]
+        assert actions[-1] == "weight_shift"
+        text = fleet.slo.metrics_text(now=now + 2.0)
+        assert 'veles_fleet_autoscaler_actions_total' \
+            '{action="weight_shift"} 1' in text
+        assert "veles_fleet_handoffs_total" in text
+
+
+class TestRegistryIntegration(object):
+    def test_deploy_fleet_serves_and_undeploys(self, fleet):
+        from veles_tpu.serve.registry import ModelRegistry
+        registry = ModelRegistry()
+        registry.deploy_fleet("disagg", fleet)
+        desc = registry.describe()["disagg"]
+        assert desc["disaggregated"] is True
+        workload = mixed_workload(n=2, seed=21)
+        expected = oracle_streams(workload)
+        got = [registry.generate("disagg", toks, max_new)
+               for toks, max_new in workload]
+        assert got == expected
+        with pytest.raises(ValueError):
+            registry.deploy_fleet("disagg", fleet)
+        registry.undeploy("disagg", drain=True)

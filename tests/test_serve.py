@@ -778,3 +778,120 @@ def test_dynamic_batching_3x_serial_throughput():
     assert batched_qps >= 3.0 * serial_qps, \
         "batched %.0f req/s < 3x serial %.0f req/s" % (batched_qps,
                                                        serial_qps)
+
+
+# ---------------------------------------------------------------------------
+# fleet-era satellites: WRR re-weighting, streaming Retry-After, and the
+# generative drain-swap ledger contract
+# ---------------------------------------------------------------------------
+
+class TestReplicaReweighting:
+    def test_set_weights_resets_credits_for_exact_split(self):
+        """A 3:1 -> 1:1 re-weight must split EXACTLY within one
+        rotation: stale credits (denominated in the old total) would
+        keep favouring the previously-starved member."""
+        from veles_tpu.serve.registry import ReplicaSet
+
+        class _E:
+            def __init__(self, tag):
+                self.tag = tag
+
+        a, b = _E("a"), _E("b")
+        router = ReplicaSet([(a, 3.0, 1), (b, 1.0, 2)])
+        first = [router.pick().tag for _ in range(40)]
+        assert first.count("a") == 30 and first.count("b") == 10
+        router.set_weights([1.0, 1.0])
+        # credits were reset: the very first rotation is already 1:1
+        assert sorted(router.pick().tag for _ in range(2)) == ["a", "b"]
+        rest = [router.pick().tag for _ in range(20)]
+        assert rest.count("a") == 10 and rest.count("b") == 10
+
+    def test_add_remove_replica_reshape_routing(self):
+        from veles_tpu.serve.registry import ReplicaSet
+
+        class _E:
+            def __init__(self, tag):
+                self.tag = tag
+
+        router = ReplicaSet([(_E("a"), 1.0, 1)])
+        with pytest.raises(ValueError):
+            router.remove_replica(1)          # never empty the set
+        router.add_replica(_E("b"), 1.0, version=2)
+        assert len(router) == 2
+        picks = [router.pick().tag for _ in range(10)]
+        assert picks.count("a") == picks.count("b") == 5
+        with pytest.raises(KeyError):
+            router.remove_replica(99)
+        removed = router.remove_replica(2)
+        assert removed.tag == "b"
+        assert len(router) == 1
+
+
+def _tiny_gen_engine(seed=0, **kwargs):
+    from veles_tpu.gen import GenerativeEngine, TransformerGenModel
+    from veles_tpu.samples.transformer import TINY
+    kwargs.setdefault("max_slots", 2)
+    kwargs.setdefault("max_seq", 32)
+    kwargs.setdefault("prefill_buckets", (8,))
+    return GenerativeEngine(TransformerGenModel(dict(TINY, seq_len=32)),
+                            seed=seed, **kwargs)
+
+
+class TestGenerativeServing:
+    def test_streaming_generate_queue_full_carries_retry_after(self):
+        """Satellite contract: the STREAMING /generate route's 503
+        shed must carry Retry-After just like the non-streaming
+        reply — clients key reconnect back-off off the header."""
+        registry = ModelRegistry()
+        registry.deploy_generative(
+            "lm", _tiny_gen_engine(), warmup=False,
+            scheduler_config={"max_queue": 0})
+        server = ServingServer(registry=registry, port=0).start()
+        try:
+            body = json.dumps({"tokens": [1, 2], "max_new_tokens": 4,
+                               "stream": True}).encode()
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/generate/lm" % server.port,
+                data=body, headers={"Content-Type":
+                                    "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=10)
+            assert err.value.code == 503
+            assert err.value.headers.get("Retry-After")
+            payload = json.loads(err.value.read())
+            assert payload["retry_after"] == QueueFull.retry_after
+        finally:
+            server.stop()
+
+    def test_generative_hot_swap_under_load_releases_v1_ledger(self):
+        """Drain swap: v1's in-flight streams finish on v1, new
+        requests land on v2, and v1's KV-cache ledger hold is
+        released exactly once (close() is idempotent)."""
+        from veles_tpu.memory import Watcher
+
+        registry = ModelRegistry()
+        v1 = _tiny_gen_engine(seed=1)
+        registry.deploy_generative("lm", v1, version=1)
+        v1_scheduler = registry.get("lm").scheduler
+        kv_before_swap = Watcher.bytes_by_category.get("kv", 0)
+        # in-flight load on v1 while the swap happens
+        futures = [v1_scheduler.submit([1 + i, 2], 12)
+                   for i in range(4)]
+        v2 = _tiny_gen_engine(seed=2)
+        registry.deploy_generative("lm", v2, version=2)
+        # the drain swap let every v1 stream finish with full budget
+        assert all(len(f.result(timeout=30)) == 12 for f in futures)
+        assert registry.get("lm").version == 2
+        assert registry.get("lm").scheduler is not v1_scheduler
+        # v1's KV hold left the ledger exactly once; v2's remains
+        kv_after = Watcher.bytes_by_category.get("kv", 0)
+        assert kv_after == kv_before_swap \
+            + v2.kv_cache_bytes - v1.kv_cache_bytes
+        v1.close()   # idempotent: a second close must not go negative
+        assert Watcher.bytes_by_category.get("kv", 0) == kv_after
+        # new requests land on v2 and serve
+        assert len(registry.generate("lm", [3, 4],
+                                     max_new_tokens=3)) == 3
+        registry.undeploy("lm")
+        assert Watcher.bytes_by_category.get("kv", 0) == \
+            kv_after - v2.kv_cache_bytes

@@ -70,9 +70,12 @@ WIRE_ACTIONS = ("drop", "dup", "delay", "corrupt", "partition")
 #: process-boundary actions (``chip_kill`` fires at the pod runtime's
 #: ``pod_chip`` site: one simulated chip drops out of the mesh, the
 #: pod reshards onto the survivors and bumps its generation —
-#: :meth:`veles_tpu.pod.runtime.PodRuntime.pre_dispatch`)
+#: :meth:`veles_tpu.pod.runtime.PodRuntime.pre_dispatch`;
+#: ``replica_drain`` fires at the fleet's ``fleet_decode`` site: one
+#: decode replica is drained mid-stream and its live requests replay
+#: onto the survivors — :meth:`veles_tpu.fleet.Fleet.tick`)
 PROCESS_ACTIONS = ("slave_kill", "slave_hang", "master_stall",
-                   "master_kill", "chip_kill")
+                   "master_kill", "chip_kill", "replica_drain")
 
 
 class Fault(object):

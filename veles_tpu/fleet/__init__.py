@@ -1,0 +1,22 @@
+"""veles_tpu.fleet — disaggregated prefill/decode serving with a
+closed-loop autoscaler.
+
+Three layers (docs/services.md § Disaggregated serving):
+
+* :class:`~veles_tpu.fleet.disagg.Fleet` — one prefill role shipping
+  finished KV pages over the job wire to a pool of decode replicas,
+  exactly-once, bitwise-parity with a single engine;
+* :class:`~veles_tpu.fleet.autoscaler.FleetAutoscaler` — consumes the
+  SLO engine's autoscaling signals and acts (weight shift / spill /
+  grow / shrink) with multi-window hysteresis;
+* lossless elasticity — :meth:`~veles_tpu.fleet.disagg.Fleet
+  .drain_replica` replays live streams onto survivors via prefix
+  re-prefill, so scale-down mid-stream loses zero tokens.
+
+Smoke: ``python -m veles_tpu.fleet --smoke``.
+"""
+
+from veles_tpu.fleet.autoscaler import ACTIONS, FleetAutoscaler
+from veles_tpu.fleet.disagg import Fleet
+
+__all__ = ["ACTIONS", "Fleet", "FleetAutoscaler"]

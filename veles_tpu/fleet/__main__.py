@@ -1,0 +1,177 @@
+"""``python -m veles_tpu.fleet --smoke`` — the disaggregated-serving
+gate.
+
+Wired into ``scripts/lint.sh`` next to the gen and chaos smokes.  A
+scripted two-role session (one prefill role over the job wire, two
+decode replicas behind the smooth-WRR router) must:
+
+1. resolve every request with EXACT token parity against a
+   single-engine oracle run of the same seeded workload;
+2. survive an injected page-handoff frame drop (the exactly-once
+   retry path) AND an injected job-frame drop (the have-list requeue
+   path) — at least one prompt provably requeued;
+3. survive a chaos-fired ``replica_drain`` mid-stream: live streams
+   replay onto the surviving replica via prefix re-prefill, losing
+   zero tokens;
+4. take at least one autoscaler ``weight_shift`` when a synthetic
+   TTFT-p99 burn breach holds for ``breach_ticks`` consecutive
+   ticks;
+5. finish with ZERO steady-state recompiles on either role.
+
+Exit code 0 on success; any violation prints ``FAIL[...]`` and
+exits 1.
+"""
+
+import argparse
+import sys
+import time
+
+import numpy
+
+
+def make_parser():
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu.fleet",
+        description="Disaggregated prefill/decode smoke gate "
+                    "(2-role parity -> chaos handoff loss -> "
+                    "mid-stream drain -> autoscaler closed loop).")
+    parser.add_argument("--smoke", action="store_true",
+                        help="run the CI smoke gate")
+    parser.add_argument("--requests", type=int, default=10)
+    parser.add_argument("--seed", type=int, default=0)
+    return parser
+
+
+def smoke(requests=10, seed=0):
+    from veles_tpu import chaos, prof
+    from veles_tpu.chaos import Fault
+    from veles_tpu.fleet import Fleet
+    from veles_tpu.gen import (GenerativeEngine, GenerativeScheduler,
+                               TransformerGenModel)
+    from veles_tpu.samples.transformer import TINY
+
+    failed = 0
+    cfg = dict(TINY, seq_len=64)
+
+    def build():
+        return GenerativeEngine(
+            TransformerGenModel(cfg), max_slots=3, max_seq=48,
+            prefill_buckets=(8, 16), kv="paged", block_size=8,
+            num_blocks=19, prefill_chunk=8, seed=7)
+
+    rng = numpy.random.RandomState(seed)
+    workload = []
+    for _ in range(requests):
+        prompt = rng.randint(1, cfg["vocab"],
+                             size=rng.randint(4, 20)).astype(numpy.int32)
+        workload.append((prompt, int(rng.randint(6, 13))))
+
+    # -- oracle: the same workload on ONE engine -----------------------
+    oracle = build()
+    oracle.warmup()
+    oracle_scheduler = GenerativeScheduler(oracle, name="smoke-oracle")
+    futures = [oracle_scheduler.submit(toks, max_new)
+               for toks, max_new in workload]
+    oracle_scheduler.run_until_idle()
+    expected = [future.result(0) for future in futures]
+    oracle_scheduler.stop()
+    oracle.close()
+
+    # -- the fleet, with the wire and the control loop under fire ------
+    chaos.controller.arm([
+        # first page result vanishes at the master: the slave's
+        # update retry must land it exactly once
+        Fault(site="master_recv", action="drop", op="page", nth=1),
+        # second job frame vanishes on the way out: the have-list /
+        # rejoin machinery must requeue the prompt
+        Fault(site="master_send", action="drop", op="job", nth=2),
+        # and one replica dies mid-stream, politely
+        Fault(site="fleet_decode", action="replica_drain", nth=1),
+    ], seed=seed)
+    recompiles_before = prof.ledger.recompiles
+    fleet = Fleet(build, decode_replicas=2, name="smoke",
+                  rpc_timeout_ms=600, heartbeat_interval=0.2,
+                  max_queue=64).start()
+    tic = time.perf_counter()
+    futures = [fleet.submit(toks, max_new)
+               for toks, max_new in workload]
+    time.sleep(0.3)
+    action = fleet.tick()           # the chaos replica_drain fires here
+    results = [future.result(timeout=120.0) for future in futures]
+    elapsed = time.perf_counter() - tic
+
+    mismatched = sum(got != want
+                     for got, want in zip(results, expected))
+    if mismatched:
+        print("FAIL[parity]: %d/%d streams diverge from the "
+              "single-engine oracle" % (mismatched, len(expected)))
+        failed += 1
+    if action != "chaos_drain" or fleet.drains_total < 1:
+        print("FAIL[drain]: chaos replica_drain did not fire "
+              "(action=%r, drains_total=%d)"
+              % (action, fleet.drains_total))
+        failed += 1
+    if len(fleet.router) != 1:
+        print("FAIL[drain]: expected 1 surviving replica, router has "
+              "%d" % len(fleet.router))
+        failed += 1
+    if fleet.handoffs_total < 1:
+        print("FAIL[handoff]: no page payloads crossed the wire")
+        failed += 1
+    if fleet.requeued_total < 1:
+        print("FAIL[requeue]: the dropped job frame did not requeue "
+              "its prompt (requeued_total=0)")
+        failed += 1
+    page_frames = chaos.controller.frames("master_recv", op="page")
+    if page_frames < 1:
+        print("FAIL[chaos]: no page frames observed at master_recv")
+        failed += 1
+    if chaos.controller.faults_injected < 2:
+        print("FAIL[chaos]: expected >=2 injected wire faults, got %d"
+              % chaos.controller.faults_injected)
+        failed += 1
+
+    # -- autoscaler closed loop: synthetic TTFT-p99 burn breach --------
+    scaler = fleet.autoscaler
+    future_now = time.time() + 60.0     # clear of any cooldown
+    ring = fleet.slo.ring("ttft_p99_ms")
+    for i in range(30):
+        ring.append(900.0, t=future_now - 3.0 + i * 0.1)
+    for i in range(scaler.breach_ticks):
+        action = fleet.tick(now=future_now + i * 0.5)
+    if action != "weight_shift" \
+            or scaler.actions_total["weight_shift"] < 1:
+        print("FAIL[autoscale]: sustained TTFT burn breach did not "
+              "shift weights (action=%r, totals=%r)"
+              % (action, scaler.actions_total))
+        failed += 1
+
+    fleet.stop(drain=True)
+    fleet.close()
+    chaos.controller.disarm()
+
+    steady = prof.ledger.recompiles - recompiles_before
+    if steady:
+        print("FAIL[recompile]: %d steady-state recompile(s) during "
+              "the fleet session" % steady)
+        failed += 1
+    print("fleet smoke: %d requests token-parity across 2 roles in "
+          "%.2fs (%d handoffs, %d bytes, %d requeued, %d drained, "
+          "%d replayed, autoscaler %r, %d steady recompiles)"
+          % (len(workload), elapsed, fleet.handoffs_total,
+             fleet.handoff_bytes_total, fleet.requeued_total,
+             fleet.drains_total, fleet.replayed_total,
+             scaler.actions_total, steady))
+    return 1 if failed else 0
+
+
+def main(argv=None):
+    args = make_parser().parse_args(argv)
+    if not args.smoke:
+        make_parser().print_help()
+        return 2
+    return smoke(requests=args.requests, seed=args.seed)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

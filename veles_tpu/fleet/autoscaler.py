@@ -1,0 +1,201 @@
+"""Closed-loop fleet autoscaler: SLO signals in, actions out.
+
+PR 12's SLO engine publishes the autoscaling triple (queue depth,
+batch fill, TTFT-p99 burn rate) — this class CLOSES the loop: each
+:meth:`tick` reads :meth:`~veles_tpu.obs.slo.SLOEngine
+.autoscaling_signals` and, when the fleet is provably unhealthy,
+ACTS on the :class:`~veles_tpu.fleet.disagg.Fleet`:
+
+* ``weight_shift`` — rebalance the decode router's smooth-WRR
+  weights toward free capacity (cheapest, first rung);
+* ``spill`` — grant spill credits so admissions bypass a saturated
+  decode pool and run end to end on the prefill role;
+* ``grow`` — add a decode replica (bounded by ``max_decode``);
+* ``shrink`` — drain a replica losslessly (bounded by
+  ``min_decode``) once the fleet has been healthy long enough.
+
+Hysteresis is multi-window and it is the POINT: a breach must hold
+for ``breach_ticks`` consecutive ticks before relief, health must
+hold for ``recover_ticks`` before shrink, the two counters reset
+each other, and every action starts a ``cooldown_s`` refractory
+period.  A flapping signal (breach/recover alternating) therefore
+never acts — the counters never reach their thresholds.
+
+Knobs come from ``root.common.fleet.*`` (ctor args override; see
+docs/services.md for the table).
+"""
+
+import threading
+import time
+
+from veles_tpu import trace
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+
+#: every action the ladder can emit, in escalation order (shrink is
+#: the recovery action) — the bench/metrics enumerate these
+ACTIONS = ("weight_shift", "spill", "grow", "shrink")
+
+
+class FleetAutoscaler(Logger):
+    """See module docstring.  One instance per fleet; :meth:`tick` is
+    safe from any thread (one action per tick, under a lock)."""
+
+    def __init__(self, fleet, slo, min_decode=None, max_decode=None,
+                 breach_ticks=None, recover_ticks=None, cooldown_s=None,
+                 queue_high=None, burn_threshold=None, spill_batch=None,
+                 **kwargs):
+        super(FleetAutoscaler, self).__init__(**kwargs)
+        cfg = root.common.fleet
+        self.fleet = fleet
+        self.slo = slo
+        self.min_decode = int(min_decode
+                              or cfg.get("min_decode", 1))
+        self.max_decode = int(max_decode
+                              or cfg.get("max_decode", 4))
+        self.breach_ticks = int(breach_ticks
+                                or cfg.get("breach_ticks", 2))
+        self.recover_ticks = int(recover_ticks
+                                 or cfg.get("recover_ticks", 6))
+        self.cooldown_s = float(
+            cooldown_s if cooldown_s is not None
+            else cfg.get("cooldown_s", 5.0))
+        self.queue_high = float(queue_high
+                                or cfg.get("queue_high", 8.0))
+        self.burn_threshold = float(burn_threshold
+                                    or cfg.get("burn_threshold", 2.0))
+        self.spill_batch = int(spill_batch
+                               or cfg.get("spill_batch", 4))
+        self._lock = threading.Lock()
+        self._breach_run = 0        # consecutive breached ticks
+        self._healthy_run = 0       # consecutive healthy ticks
+        self._escalation = 0        # rung of the relief ladder
+        self._last_action_at = None
+        self.ticks_total = 0
+        self.actions_total = {action: 0 for action in ACTIONS}
+        self.last_action = None
+        self.last_signals = {}
+
+    # -- the loop ----------------------------------------------------------
+    def tick(self, now=None):
+        """One control iteration.  Returns the action taken (one of
+        :data:`ACTIONS`) or ``None`` — most ticks are Nones; that is
+        hysteresis working."""
+        t = time.time() if now is None else float(now)
+        signals = self.slo.autoscaling_signals(now=now)
+        action = None
+        with self._lock:
+            self.ticks_total += 1
+            self.last_signals = signals
+            breached = (
+                signals["ttft_p99_burn_rate"] >= self.burn_threshold
+                or signals["queue_depth"] >= self.queue_high)
+            if breached:
+                self._breach_run += 1
+                self._healthy_run = 0
+            else:
+                self._healthy_run += 1
+                self._breach_run = 0
+            if self._last_action_at is not None \
+                    and t - self._last_action_at < self.cooldown_s:
+                return None         # refractory: observe, don't act
+            if breached and self._breach_run >= self.breach_ticks:
+                action = self._relieve()
+            elif not breached \
+                    and self._healthy_run >= self.recover_ticks:
+                action = self._relax()
+            if action is None:
+                return None
+            self._last_action_at = t
+            self._breach_run = 0
+            self._healthy_run = 0
+            self.actions_total[action] += 1
+            self.last_action = action
+        trace.instant("fleet", "autoscale",
+                      dict(signals, action=action,
+                           replicas=len(self.fleet.router)),
+                      role="server")
+        self.info("autoscale: %s (burn %.2f, queue %g, fill %g)",
+                  action, signals["ttft_p99_burn_rate"],
+                  signals["queue_depth"], signals["batch_fill"])
+        return action
+
+    def _relieve(self):
+        """The escalation ladder: each sustained breach inside the
+        same episode climbs one rung — rebalance first, then bypass
+        decode, then buy capacity."""
+        rung = self._escalation
+        self._escalation += 1
+        if rung == 0:
+            self.fleet.set_weights(self._capacity_weights())
+            return "weight_shift"
+        if rung == 1:
+            self.fleet.spill(self.spill_batch)
+            return "spill"
+        if len(self.fleet.router) < self.max_decode:
+            self.fleet.add_replica()
+            return "grow"
+        self.fleet.spill(self.spill_batch)
+        return "spill"
+
+    def _relax(self):
+        """Sustained health ends the episode; with spare replicas the
+        fleet shrinks one (a lossless drain)."""
+        self._escalation = 0
+        if len(self.fleet.router) > self.min_decode:
+            self.fleet.drain_replica()
+            return "shrink"
+        return None
+
+    def _capacity_weights(self):
+        """Weights proportional to each replica's free decode slots
+        (+1 smoothing so a full replica keeps a trickle — it will
+        free slots as streams finish)."""
+        return [float(s.engine.free_slots + 1)
+                for s in self.fleet.router.engines()]
+
+    # -- exposition --------------------------------------------------------
+    def describe(self):
+        with self._lock:
+            return {
+                "ticks_total": self.ticks_total,
+                "actions_total": dict(self.actions_total),
+                "last_action": self.last_action,
+                "last_signals": dict(self.last_signals),
+                "breach_run": self._breach_run,
+                "healthy_run": self._healthy_run,
+                "escalation": self._escalation,
+                "knobs": {
+                    "min_decode": self.min_decode,
+                    "max_decode": self.max_decode,
+                    "breach_ticks": self.breach_ticks,
+                    "recover_ticks": self.recover_ticks,
+                    "cooldown_s": self.cooldown_s,
+                    "queue_high": self.queue_high,
+                    "burn_threshold": self.burn_threshold,
+                    "spill_batch": self.spill_batch,
+                },
+            }
+
+    def metrics_lines(self):
+        """``veles_fleet_autoscaler_*`` exposition lines (joined into
+        the fleet's ``metrics_text``)."""
+        lines = [
+            "# HELP veles_fleet_autoscaler_actions_total autoscaler "
+            "actions taken, by action",
+            "# TYPE veles_fleet_autoscaler_actions_total counter",
+        ]
+        with self._lock:
+            for action in ACTIONS:
+                lines.append(
+                    'veles_fleet_autoscaler_actions_total'
+                    '{action="%s"} %d'
+                    % (action, self.actions_total[action]))
+            lines.extend([
+                "# HELP veles_fleet_autoscaler_ticks_total control "
+                "loop iterations",
+                "# TYPE veles_fleet_autoscaler_ticks_total counter",
+                "veles_fleet_autoscaler_ticks_total %d"
+                % self.ticks_total,
+            ])
+        return lines

@@ -1,0 +1,635 @@
+"""Disaggregated prefill/decode serving: the fleet.
+
+Prefill and decode are different machines pretending to be one
+(SURVEY §7.4): prefill is compute-bound (one big attention pass over
+the prompt), decode is memory-bound (one token per step against a
+growing KV cache).  Batching them into one engine makes each steal
+the other's latency budget — a long prompt admission stalls every
+in-flight decode step behind it.  The fleet splits the roles:
+
+* the **prefill role** is a :class:`~veles_tpu.parallel.jobs.JobClient`
+  slave whose jobs are prompts.  It runs each prompt through its own
+  chunked-prefill scheduler (``max_new_tokens=1``,
+  ``export_pages=True``) and ships the finished KV pages + first token
+  back over the job wire as a ``page`` update;
+* the **decode role** is a pool of paged engines behind a
+  :class:`~veles_tpu.serve.registry.ReplicaSet` smooth-WRR router.
+  A shipped page payload is adopted into the replica's own
+  :class:`~veles_tpu.gen.paged.BlockPool` (sorted-free-list admission,
+  so paged parity stays bitwise) and decode continues from the first
+  token with ZERO prompt recompute;
+* the **frontend** (this class) is the JobServer master: it owns the
+  request table, prices admission once at the front door, and
+  correlates prefill results back to live requests by ``rid``.
+
+Exactly-once rides the PR 7 wire machinery unchanged: page frames
+carry ``{gen, epoch, seq}`` ids, duplicated frames are deduplicated by
+the applied-seq window, lost job frames are detected by the slave's
+``have`` list and requeued through :meth:`_requeue_slave`.  On top of
+that the fleet keeps a per-request ``attempt`` counter: a page result
+whose attempt does not match the table's is a ghost of a requeued
+prefill and is dropped — drop/dup/kill during handoff never
+double-adopts and never loses a prompt.
+
+Lossless scale-down: :meth:`drain_replica` evicts every live request
+from one decode replica (:meth:`~veles_tpu.gen.scheduler
+.GenerativeScheduler.drain`) and replays each via
+``GenRequest.prefix()`` onto a survivor — greedy decode of the prefix
+reproduces the stream bitwise, so a chaos-timed drain mid-stream
+loses zero tokens.  The closed loop lives in
+:class:`veles_tpu.fleet.autoscaler.FleetAutoscaler`, fed by the PR 12
+SLO engine's :meth:`~veles_tpu.obs.slo.SLOEngine.autoscaling_signals`.
+
+Knobs: ``root.common.fleet.*`` (see docs/services.md).
+"""
+
+import collections
+import threading
+import time
+
+import numpy
+
+from veles_tpu import chaos, trace
+from veles_tpu.config import root
+from veles_tpu.fleet.autoscaler import FleetAutoscaler
+from veles_tpu.gen.scheduler import GenRequest, GenerativeScheduler
+from veles_tpu.logger import Logger
+from veles_tpu.obs import context as obs_context
+from veles_tpu.parallel.jobs import JobClient, JobServer
+from veles_tpu.serve.batcher import QueueFull
+from veles_tpu.serve.registry import ReplicaSet
+from veles_tpu.workflow import NoJobYet, NoMoreJobs
+
+#: decode/prefill scheduler queues are effectively unbounded — the
+#: fleet prices admission ONCE at its own front door (one shed point,
+#: one 503), so the inner schedulers must never shed independently
+_UNBOUNDED_QUEUE = 1 << 30
+
+
+class _FleetMaster(object):
+    """JobServer workflow adapter — the frontend side of the wire.
+
+    Jobs are prompts (``{"rid", "attempt", "prefix"}``); results come
+    back through :meth:`apply_pages_from_slave` (the ``page`` op's
+    landing pad) as ``{"rid", "attempt", "pages"}``.  Training-update
+    frames are a protocol violation on this wire."""
+
+    def __init__(self, fleet, wire_id):
+        self._fleet = fleet
+        self._wire_id = wire_id
+
+    def checksum(self):
+        return self._wire_id
+
+    def generate_data_for_slave(self, slave):
+        return self._fleet._next_prefill_job(slave)
+
+    def apply_pages_from_slave(self, data, slave):
+        self._fleet._pages_from_slave(data, slave)
+
+    def apply_data_from_slave(self, data, slave):
+        raise RuntimeError(
+            "fleet masters consume page frames, not training updates")
+
+    def drop_slave(self, slave):
+        self._fleet._requeue_slave(slave)
+
+
+class _PrefillRole(object):
+    """JobClient workflow adapter — the prefill side of the wire.
+
+    ``do_job`` turns a prompt into KV pages: a ``max_new_tokens=1``
+    request through the local chunked-prefill scheduler finishes at
+    its first token, and the ``export_pages`` hook captures the
+    slot's pages before release.  A failed/timed-out prefill ships
+    ``pages: None`` so the master requeues instead of hanging."""
+
+    def __init__(self, scheduler, wire_id, job_timeout=120.0):
+        self._scheduler = scheduler
+        self._wire_id = wire_id
+        self._job_timeout = float(job_timeout)
+
+    def checksum(self):
+        return self._wire_id
+
+    def do_job(self, data, update):
+        prefix = numpy.ascontiguousarray(data["prefix"], numpy.int32)
+        job = GenRequest(prefix, 1, export_pages=True,
+                         rid=data["rid"], ctx=obs_context.current())
+        pages = None
+        try:
+            self._scheduler.submit_request(job)
+            job.future.result(timeout=self._job_timeout)
+            pages = job.export
+        except Exception:
+            pages = None
+        update({"rid": data["rid"], "attempt": data["attempt"],
+                "pages": pages})
+
+
+class Fleet(Logger):
+    """A disaggregated serving fleet: one prefill role, N decode
+    replicas, one front door.
+
+    ``build_engine`` is a zero-arg factory returning a fresh paged +
+    chunked :class:`~veles_tpu.gen.engine.GenerativeEngine`; every
+    role (and every replica the autoscaler grows) is built through it
+    so configs stay identical and parity stays bitwise.  The fleet
+    exposes the registry's generative surface (``generate`` /
+    ``stop`` / ``close`` / ``describe``) so
+    :meth:`~veles_tpu.serve.registry.ModelRegistry.deploy_fleet`
+    serves it like any model.
+    """
+
+    def __init__(self, build_engine, decode_replicas=None, name="fleet",
+                 metrics=None, slo=None, max_queue=None,
+                 ttft_slo_ms=None, rpc_timeout_ms=None,
+                 heartbeat_interval=0.2, autoscaler=True, **kwargs):
+        super(Fleet, self).__init__(**kwargs)
+        cfg = root.common.fleet
+        self.name = str(name)
+        self._build_engine = build_engine
+        self.max_queue = int(max_queue or cfg.get("max_queue", 256))
+        n_decode = int(decode_replicas
+                       or cfg.get("decode_replicas", 2))
+        if n_decode < 1:
+            raise ValueError("decode_replicas must be >= 1")
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._closed = False
+        #: rid → live GenRequest; entries leave when the future
+        #: resolves (done-callback), so the table IS the in-flight set
+        self._requests = {}
+        self._attempt = {}
+        self._pending = collections.deque()
+        self._awaiting = set()          # rids shipped, pages not back
+        self._assigned = {}             # sid → set(rid) in flight
+        self._rid = 0
+        self._version = 0
+        self._spill_budget = 0
+        # counters (describe + the veles_fleet_* gauges)
+        self.shed_total = 0
+        self.spilled_total = 0
+        self.handoffs_total = 0
+        self.handoff_bytes_total = 0
+        self.requeued_total = 0
+        self.stale_pages = 0
+        self.replayed_total = 0
+        self.drains_total = 0
+        self.grows_total = 0
+        self.metrics = metrics
+        # -- roles --------------------------------------------------------
+        self._prefill = GenerativeScheduler(
+            self._warm(build_engine()), metrics=metrics,
+            name="%s-prefill" % self.name,
+            max_queue=_UNBOUNDED_QUEUE).start()
+        members = []
+        for _ in range(n_decode):
+            self._version += 1
+            members.append((self._new_decode(self._version), 1.0,
+                            self._version))
+        self.router = ReplicaSet(members)
+        # -- wire ---------------------------------------------------------
+        self._wire_id = "veles-fleet:%s:v1" % self.name
+        self._master = JobServer(_FleetMaster(self, self._wire_id))
+        self._client = None
+        self._slave_thread = None
+        self._rpc_timeout_ms = int(
+            rpc_timeout_ms or cfg.get("rpc_timeout_ms", 2000))
+        self._heartbeat_interval = float(heartbeat_interval)
+        # -- closed loop --------------------------------------------------
+        if slo is None:
+            from veles_tpu.obs.slo import Objective, SLOEngine
+            slo = SLOEngine()
+            slo.add_signal("queue_depth", self.queue_depth)
+            slo.add_signal("batch_fill", self.batch_fill)
+            slo.add_signal("ttft_p99_ms", self.ttft_p99_ms)
+            slo.add_objective(Objective(
+                "ttft_p99_ms",
+                float(ttft_slo_ms or cfg.get("ttft_slo_ms", 500.0)),
+                op="<",
+                window_s=float(cfg.get("slo_window_s", 60.0)),
+                fast_window_s=float(cfg.get("slo_fast_window_s", 5.0))))
+        self.slo = slo
+        self.slo.attach_exposition(self.metrics_text)
+        self.autoscaler = FleetAutoscaler(self, slo) if autoscaler \
+            else None
+
+    # -- construction ------------------------------------------------------
+    def _warm(self, engine):
+        if engine.kv_mode != "paged":
+            raise ValueError(
+                "the fleet requires kv='paged' engines — page handoff "
+                "ships BlockPool pages, got kv=%r" % engine.kv_mode)
+        if engine.prefill_chunk is None:
+            raise ValueError(
+                "the fleet requires chunked prefill (prefill_chunk=) — "
+                "drain replay re-prefills prefixes through the chunk "
+                "program")
+        # handoff programs compile BEFORE warmup() latches the steady
+        # flag: a fleet role's full program set is part of warmup, so
+        # steady-state recompiles stay zero
+        engine.warm_handoff()
+        engine.warmup()
+        return engine
+
+    def _new_decode(self, version):
+        return GenerativeScheduler(
+            self._warm(self._build_engine()), metrics=self.metrics,
+            name="%s-decode-v%d" % (self.name, version),
+            max_queue=_UNBOUNDED_QUEUE).start()
+
+    def start(self):
+        """Bring up the wire: start the master, connect the prefill
+        slave, and run its job loop on a daemon thread."""
+        self._master.start()
+        self._client = JobClient(
+            _PrefillRole(self._prefill, self._wire_id),
+            self._master.endpoint,
+            sid="%s-prefill" % self.name,
+            heartbeat_interval=self._heartbeat_interval,
+            rpc_timeout_ms=self._rpc_timeout_ms)
+        self._client.update_op = "page"
+        last = None
+        for _ in range(5):      # a chaos drop on the handshake frame
+            try:                # must not kill the bring-up
+                self._client.handshake()
+                break
+            except Exception as exc:
+                last = exc
+                time.sleep(0.1)
+        else:
+            raise RuntimeError("prefill role handshake failed: %s"
+                               % last)
+        self._slave_thread = threading.Thread(
+            target=self._slave_loop, name="%s-prefill-wire" % self.name,
+            daemon=True)
+        self._slave_thread.start()
+        self.info("fleet %s up: 1 prefill role, %d decode replica(s), "
+                  "wire %s", self.name, len(self.router),
+                  self._master.endpoint)
+        return self
+
+    def _slave_loop(self):
+        try:
+            self._client.run()
+        except Exception:
+            if not self._stopped:
+                self.exception("prefill role wire loop crashed")
+
+    # -- front door --------------------------------------------------------
+    def submit(self, tokens, max_new_tokens=16, on_token=None):
+        """Admit one prompt; returns a Future resolving to the full
+        greedy token list.  Sheds with :class:`QueueFull` at the fleet
+        queue bound — the ONE admission-control point."""
+        tokens = numpy.ascontiguousarray(tokens, numpy.int32).ravel()
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(tokens) < 1:
+            raise ValueError("empty prompt")
+        engine = self._prefill.engine   # all roles share one config
+        engine.check_prompt(len(tokens))
+        if len(tokens) + max_new_tokens - 1 >= engine.max_seq:
+            raise ValueError(
+                "prompt %d + max_new_tokens %d exceeds the fleet's "
+                "max_seq %d KV slot" % (len(tokens), max_new_tokens,
+                                        engine.max_seq))
+        request = GenRequest(tokens, max_new_tokens, on_token,
+                             ctx=obs_context.current())
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("fleet is stopped")
+            if len(self._pending) >= self.max_queue:
+                self.shed_total += 1
+                raise QueueFull(
+                    "fleet queue full (%d requests, limit %d)"
+                    % (len(self._pending), self.max_queue))
+            self._rid += 1
+            rid = request.rid = self._rid
+            self._requests[rid] = request
+            spill = self._spill_budget > 0
+            if spill:
+                self._spill_budget -= 1
+                self.spilled_total += 1
+            else:
+                self._attempt[rid] = 0
+                self._awaiting.add(rid)
+                self._pending.append(rid)
+        request.future.add_done_callback(
+            lambda _f, rid=rid: self._forget(rid))
+        if spill:
+            # decode is the bottleneck: serve this request end to end
+            # on the prefill role's engine instead of queueing pages
+            # behind a saturated decode pool
+            self._prefill.submit_request(request)
+        if trace.enabled():
+            trace.instant("fleet", "admit",
+                          request.span_args(
+                              {"rid": rid, "prompt": len(tokens),
+                               "max_new": max_new_tokens,
+                               "spill": spill}), role="server")
+        return request.future
+
+    def generate(self, tokens, max_new_tokens=16, timeout=120.0,
+                 on_token=None):
+        return self.submit(tokens, max_new_tokens,
+                           on_token=on_token).result(timeout)
+
+    def _forget(self, rid):
+        with self._lock:
+            self._requests.pop(rid, None)
+            self._attempt.pop(rid, None)
+            self._awaiting.discard(rid)
+
+    # -- wire callbacks (run under the JobServer lock) ---------------------
+    def _next_prefill_job(self, slave):
+        with self._lock:
+            if self._stopped and not self._pending:
+                raise NoMoreJobs()
+            while self._pending:
+                rid = self._pending.popleft()
+                request = self._requests.get(rid)
+                if request is None or rid not in self._awaiting:
+                    continue            # cancelled/failed before ship
+                self._assigned.setdefault(slave.id, set()).add(rid)
+                return {"rid": rid, "attempt": self._attempt[rid],
+                        "prefix": numpy.ascontiguousarray(
+                            request.prefix(), numpy.int32)}
+        raise NoJobYet()
+
+    def _pages_from_slave(self, data, slave):
+        rid = int(data["rid"])
+        attempt = int(data["attempt"])
+        with self._lock:
+            assigned = self._assigned.get(slave.id)
+            if assigned is not None:
+                assigned.discard(rid)
+            request = self._requests.get(rid)
+            if request is None or rid not in self._awaiting \
+                    or attempt != self._attempt.get(rid):
+                # a ghost: the rid finished, failed, or was requeued
+                # under a newer attempt while these pages were in
+                # flight — adopting them would double-apply
+                self.stale_pages += 1
+                return
+            pages = data.get("pages")
+            if pages is None:
+                # the prefill role could not produce pages (engine
+                # error/timeout): re-run the prompt, bumping the
+                # attempt so the failed try can never land late
+                self._attempt[rid] += 1
+                self._pending.append(rid)
+                self.requeued_total += 1
+                return
+            self._awaiting.discard(rid)
+            self.handoffs_total += 1
+            self.handoff_bytes_total += (int(pages["k"].nbytes)
+                                         + int(pages["v"].nbytes))
+        self._route_handoff(pages, request)
+
+    def _route_handoff(self, payload, request):
+        """Hand a page payload to a decode replica, smooth-WRR picked;
+        a replica that stopped between pick and submit is skipped for
+        a survivor, and a fully unroutable payload degrades to a
+        replay (recompute) — never a lost request."""
+        for _ in range(max(1, len(self.router))):
+            scheduler = self.router.pick()
+            try:
+                scheduler.submit_handoff(payload, request)
+                return
+            except RuntimeError:
+                continue
+        self._replay(request)
+
+    def _requeue_slave(self, slave):
+        """The wire detected lost frames / a dead or rejoining slave:
+        every rid it held goes back on the queue under a bumped
+        attempt (exactly-once: the old attempt's pages are ghosts)."""
+        with self._lock:
+            rids = self._assigned.pop(slave.id, set())
+            requeued = []
+            for rid in sorted(rids):
+                if rid in self._requests and rid in self._awaiting:
+                    self._attempt[rid] += 1
+                    self._pending.append(rid)
+                    self.requeued_total += 1
+                    requeued.append(rid)
+        if requeued:
+            trace.instant("fleet", "requeue",
+                          {"slave": slave.id, "rids": requeued},
+                          role="server")
+            self.warning("prefill role %s lost %d prompt(s) — "
+                         "requeued", slave.id, len(requeued))
+
+    # -- elasticity (the autoscaler's surface) -----------------------------
+    def _replay(self, request):
+        """Continue one evicted stream on a survivor: submit its
+        prefix for local (chunked) re-prefill.  Greedy decode of the
+        prefix reproduces the stream, so the replay is lossless."""
+        self.replayed_total += 1
+        try:
+            self.router.pick().submit_request(request)
+            return
+        except Exception:
+            pass
+        try:
+            # last resort: the prefill role serves it end to end
+            self._prefill.submit_request(request)
+        except Exception as exc:
+            if not request.future.done():
+                request.future.set_exception(exc)
+
+    def drain_replica(self, version=None):
+        """Lossless scale-down: remove one decode replica from the
+        router, evict its live requests, replay each onto a survivor,
+        then stop + close the drained engine.  Returns the number of
+        replayed requests.  Refuses to drain the last replica."""
+        members = self.router.describe()
+        if version is None:
+            version = members[-1]["version"]
+        scheduler = self.router.remove_replica(version)
+        moved = scheduler.drain()
+        for request in moved:
+            self._replay(request)
+        self.drains_total += 1
+        trace.instant("fleet", "drain_replica",
+                      {"fleet": self.name, "version": version,
+                       "replayed": len(moved)}, role="server")
+        self.info("drained decode replica v%s (%d stream(s) replayed)",
+                  version, len(moved))
+        scheduler.stop(drain=True)
+        scheduler.engine.close()
+        return len(moved)
+
+    def add_replica(self, weight=1.0):
+        """Grow the decode pool by one freshly built replica.  Its
+        warmup compiles are pre-steady by construction (the engine
+        warms before serving), so growth never counts as a
+        steady-state recompile."""
+        with self._lock:
+            self._version += 1
+            version = self._version
+        scheduler = self._new_decode(version)
+        self.router.add_replica(scheduler, weight, version=version)
+        self.grows_total += 1
+        trace.instant("fleet", "add_replica",
+                      {"fleet": self.name, "version": version,
+                       "weight": weight}, role="server")
+        return version
+
+    def set_weights(self, weights):
+        self.router.set_weights(weights)
+
+    def spill(self, n):
+        """Grant the front door ``n`` spill credits: the next ``n``
+        admissions bypass the handoff pipeline and run end to end on
+        the prefill role (decode is the bottleneck)."""
+        with self._lock:
+            self._spill_budget += int(n)
+
+    def tick(self, now=None):
+        """One control-loop iteration: sample the SLO signals, let
+        chaos fire a ``replica_drain`` at the ``fleet_decode`` site,
+        then run the autoscaler.  Returns the autoscaler's action (or
+        ``"chaos_drain"``) for the caller's log line."""
+        self.slo.sample(now)
+        fault = chaos.controller.process("fleet_decode", role="server")
+        if fault is not None and fault.action == "replica_drain" \
+                and len(self.router) > 1:
+            self.drain_replica()
+            return "chaos_drain"
+        if self.autoscaler is not None:
+            return self.autoscaler.tick(now)
+        return None
+
+    # -- signals -----------------------------------------------------------
+    def queue_depth(self):
+        """Requests queued anywhere in the fleet (front door + every
+        role's scheduler + pending handoffs).  Lock-free: sampled from
+        the SLO thread and from inside :meth:`submit`."""
+        depth = len(self._pending) + self._prefill.queue_depth()
+        for scheduler in self.router.engines():
+            depth += scheduler.queue_depth() + scheduler.handoff_depth()
+        return depth
+
+    def batch_fill(self):
+        fills = [s.batch_fill() for s in self.router.engines()]
+        return round(sum(fills) / len(fills), 4) if fills else 0.0
+
+    def ttft_p99_ms(self):
+        schedulers = self.router.engines() + [self._prefill]
+        return round(max(s.ttft.percentile(99) for s in schedulers)
+                     * 1e3, 3)
+
+    # -- lifecycle ---------------------------------------------------------
+    def stop(self, drain=True, timeout=120.0):
+        """Stop the fleet: refuse new admissions, optionally wait for
+        every in-flight request, retire the wire, then stop every
+        role's scheduler."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            if not drain:
+                self._pending.clear()
+                self._awaiting.clear()
+        if drain:
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                with self._lock:
+                    if not self._requests:
+                        break
+                time.sleep(0.01)
+        with self._lock:
+            self._pending.clear()   # unblocks NoMoreJobs for the wire
+            self._awaiting.clear()
+            leftovers = list(self._requests.values())
+        if self._slave_thread is not None:
+            self._slave_thread.join(timeout=15.0)
+            self._slave_thread = None
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        if self._master is not None:
+            self._master.stop()
+        self._prefill.stop(drain=drain)
+        for scheduler in self.router.engines():
+            scheduler.stop(drain=drain)
+        for request in leftovers:
+            if not request.future.done():
+                request.future.set_exception(
+                    RuntimeError("fleet stopped"))
+        self.info("fleet %s stopped", self.name)
+
+    def close(self):
+        self.stop(drain=False)
+        if self._closed:
+            return
+        self._closed = True
+        for scheduler in self.router.engines():
+            scheduler.engine.close()
+        self._prefill.engine.close()
+        if self._master is not None:
+            self._master = None
+
+    # -- exposition --------------------------------------------------------
+    def describe(self):
+        with self._lock:
+            desc = {
+                "name": self.name,
+                "pending": len(self._pending),
+                "in_flight": len(self._requests),
+                "shed_total": self.shed_total,
+                "spilled_total": self.spilled_total,
+                "handoffs_total": self.handoffs_total,
+                "handoff_bytes_total": self.handoff_bytes_total,
+                "requeued_total": self.requeued_total,
+                "stale_pages": self.stale_pages,
+                "replayed_total": self.replayed_total,
+                "drains_total": self.drains_total,
+                "grows_total": self.grows_total,
+            }
+        desc["prefill"] = self._prefill.describe()
+        desc["decode"] = self.router.describe()
+        master = self._master
+        if master is not None:
+            desc["wire"] = {
+                "dedup_dropped": master.dedup_dropped,
+                "stale_rejected": master.stale_rejected,
+                "lost_requeued": master.lost_requeued,
+            }
+        if self.autoscaler is not None:
+            desc["autoscaler"] = self.autoscaler.describe()
+        return desc
+
+    def metrics_text(self):
+        """``veles_fleet_*`` gauges, appended to the SLO engine's
+        scrape via ``attach_exposition`` — signal and action on one
+        endpoint."""
+        gauges = [
+            ("replicas", "decode replicas in the router",
+             len(self.router)),
+            ("handoffs_total", "page payloads shipped prefill->decode",
+             self.handoffs_total),
+            ("handoff_bytes_total", "page payload bytes shipped",
+             self.handoff_bytes_total),
+            ("requeued_total", "prefill jobs requeued (wire loss / "
+             "role failure)", self.requeued_total),
+            ("replayed_total", "streams replayed across replicas",
+             self.replayed_total),
+            ("drains_total", "decode replicas drained", self.drains_total),
+            ("spilled_total", "requests spilled to the prefill role",
+             self.spilled_total),
+            ("shed_total", "requests shed at the fleet front door",
+             self.shed_total),
+        ]
+        lines = []
+        for name, help_text, value in gauges:
+            full = "veles_fleet_%s" % name
+            lines.append("# HELP %s %s" % (full, help_text))
+            lines.append("# TYPE %s gauge" % full)
+            lines.append("%s %g" % (full, value))
+        if self.autoscaler is not None:
+            lines.extend(self.autoscaler.metrics_lines())
+        return "\n".join(lines) + "\n"

@@ -236,11 +236,15 @@ class GenerativeEngine(Logger):
         self._prefill_exe = {}
         self._chunk_exe = None
         self._decode_exe = None
+        self._page_out_exe = None
+        self._page_in_exe = None
         self._compile_lock = threading.Lock()
         self.compile_count = 0
         self.decode_calls = 0
         self.prefill_calls = 0
         self.preemptions_total = 0
+        self.exports_total = 0
+        self.adoptions_total = 0
         self._warmed = False
         self.prof_name = "gen%d" % next(_GEN_SEQ)
         self._prof_entries = {}
@@ -318,6 +322,75 @@ class GenerativeEngine(Logger):
                            "steady state must reuse the AOT programs"
                            % name)
         return exe, entry
+
+    def _compile_aux(self, fn, args, kind, name, donate=()):
+        """AOT-compile an auxiliary (non-forward) program — the page
+        I/O pair — under the same ledger/recompile discipline as
+        :meth:`_compile` but with CALLER-CHOSEN donation: ``page_out``
+        reads the live cache and must NOT donate it (donation would
+        invalidate the resident buffers), while ``page_in`` rewrites
+        it and donates like every forward program."""
+        jax = self._jax
+        with self._compile_lock:
+            span_args = {"program": name, "engine": self.prof_name}
+            with trace.span("serve", "compile_gen", span_args,
+                            role="server"):
+                jitted = jax.jit(fn, donate_argnums=tuple(donate))
+                exe = jitted.lower(*self._struct_of(args)).compile()
+                cost, new_args = prof.span_cost_args(
+                    exe, span_args, peak_dtype=self.quantized)
+                span_args.update(new_args)
+                if self._warmed:
+                    span_args["recompile"] = True
+            self.compile_count += 1
+            entry = self._prof_entries.get((kind, name))
+            if entry is None:
+                entry = self._prof_entries[(kind, name)] = \
+                    prof.ledger.entry(kind,
+                                      "%s[%s]" % (self.prof_name, name))
+            prof.ledger.record_compile(entry, cost=cost,
+                                       steady=self._warmed)
+            self.debug("compiled %s (compile #%d)", name,
+                       self.compile_count)
+            if self._warmed:
+                prof.flag_recompile(
+                    "gen:%s:%s" % (self.prof_name, name), None, None,
+                    logger=self,
+                    detail="%s compiled after warmup() — generative "
+                           "steady state must reuse the AOT programs"
+                           % name)
+        return exe, entry
+
+    def _page_out_executable(self):
+        """The page EXPORT program: copy one pool page's K/V out of
+        the live cache — fixed shape, cache NOT donated."""
+        if self._page_out_exe is None:
+            jnp = self._jax.numpy
+
+            def page_out(cache, bid):
+                return cache["k"][:, bid], cache["v"][:, bid]
+
+            self._page_out_exe = self._compile_aux(
+                page_out, (self._cache, jnp.int32(0)),
+                "handoff", "page_out")
+        return self._page_out_exe
+
+    def _page_in_executable(self):
+        """The page ADOPT program: write one shipped page's K/V into
+        a freshly allocated pool page (cache donated — in-place)."""
+        if self._page_in_exe is None:
+            jnp = self._jax.numpy
+            k = self._cache["k"]
+            page = jnp.zeros((k.shape[0],) + k.shape[2:], k.dtype)
+
+            def page_in(cache, k, v, bid):
+                return {"k": cache["k"].at[:, bid].set(k),
+                        "v": cache["v"].at[:, bid].set(v)}
+
+            self._page_in_exe = self._compile_aux(
+                page_in, (self._cache, page, page, jnp.int32(0)),
+                "handoff", "page_in", donate=(0,))
+        return self._page_in_exe
 
     def _prefill_executable(self, bucket):
         exe = self._prefill_exe.get(bucket)
@@ -442,6 +515,24 @@ class GenerativeEngine(Logger):
             for bucket in self.prefill_buckets:
                 self._prefill_executable(bucket)
         self._warmed = True
+        return self
+
+    def warm_handoff(self):
+        """AOT-compile the page export/adopt pair — the fleet handoff
+        programs.  Call alongside :meth:`warmup` (before serving) on
+        every role that ships or receives pages, or the first handoff
+        trips the steady-state recompile sentinel.  Paged mode only;
+        the handoff does not shard.  Returns self (chainable)."""
+        if self._pool is None:
+            raise ValueError(
+                "page handoff requires kv='paged' — the contiguous "
+                "engine has no pages to ship")
+        if self.mesh is not None:
+            raise ValueError(
+                "page handoff does not cross a model-axis mesh yet — "
+                "run fleet roles replicated")
+        self._page_out_executable()
+        self._page_in_executable()
         return self
 
     # -- slot accounting ---------------------------------------------------
@@ -750,6 +841,96 @@ class GenerativeEngine(Logger):
         self.slot_token[active] = out[active]
         return out, active
 
+    # -- fleet page handoff ------------------------------------------------
+    def export_slot(self, slot):
+        """Package an active slot's KV pages for the fleet handoff:
+        host copies of every owned page (position order, straight off
+        the sorted-free-list allocation) plus the slot's decode state.
+        The payload is engine-agnostic — any paged engine with the
+        same model config and ``block_size`` can adopt it and the
+        token stream stays bitwise-identical, because decode gathers
+        K/V through the block table and masks past ``n``.  The slot
+        itself is NOT released (the caller decides)."""
+        if self._pool is None:
+            raise ValueError("page export requires kv='paged'")
+        if not self.slot_active[slot]:
+            raise ValueError("slot %d is not active" % slot)
+        jnp = self._jax.numpy
+        exe, entry = self._page_out_executable()
+        ids = self._pool.owned(slot)
+        ks, vs = [], []
+        with trace.span("gen", "page_out",
+                        obs_context.tag(
+                            {"slot": slot, "pages": len(ids),
+                             "engine": self.prof_name}), role="server"):
+            tic = time.perf_counter_ns()
+            for bid in ids:
+                k, v = exe(self._cache, jnp.int32(bid))
+                ks.append(numpy.asarray(k))
+                vs.append(numpy.asarray(v))
+            prof.ledger.record_dispatch(
+                entry, time.perf_counter_ns() - tic, items=len(ids))
+        self.exports_total += 1
+        return {"n": int(self.slot_len[slot]),
+                "token": int(self.slot_token[slot]),
+                "block_size": self.block_size,
+                "k": numpy.stack(ks), "v": numpy.stack(vs)}
+
+    def adopt_sequence(self, payload):
+        """Admit a shipped sequence WITHOUT recomputing its prefill:
+        allocate pages off the sorted free list (deterministic, same
+        as any admission), write each shipped page in with the
+        donated fixed-shape ``page_in`` program, and install the slot
+        state so the next :meth:`decode_step` continues the stream.
+        Callers gate on :meth:`can_admit` with the payload's ``n`` —
+        the pricing is identical to a fresh admission.  Returns
+        ``(slot, first_token)`` like :meth:`prefill`."""
+        if self._pool is None:
+            raise ValueError("page adoption requires kv='paged'")
+        n = self._validate_prompt_len(int(payload["n"]))
+        if int(payload["block_size"]) != self.block_size:
+            raise ValueError(
+                "shipped pages use block_size %d, this engine uses "
+                "%d — fleet roles must agree"
+                % (int(payload["block_size"]), self.block_size))
+        k_pages = numpy.asarray(payload["k"])
+        v_pages = numpy.asarray(payload["v"])
+        need = self._pool.blocks_for(n)
+        if len(k_pages) != need or len(v_pages) != need:
+            raise ValueError(
+                "payload holds %d/%d pages but %d tokens need %d"
+                % (len(k_pages), len(v_pages), n, need))
+        if not self._free:
+            raise RuntimeError("no free slot (all %d busy)"
+                               % self.max_slots)
+        jnp = self._jax.numpy
+        exe, entry = self._page_in_executable()
+        slot = self._free.pop(0)
+        try:
+            ids = self._pool.admit(slot, n)
+        except Exception:
+            import bisect
+            bisect.insort(self._free, slot)
+            raise
+        with trace.span("gen", "page_in",
+                        obs_context.tag(
+                            {"slot": slot, "pages": len(ids), "len": n,
+                             "engine": self.prof_name}), role="server"):
+            tic = time.perf_counter_ns()
+            for i, bid in enumerate(ids):
+                self._cache = exe(self._cache,
+                                  jnp.asarray(k_pages[i]),
+                                  jnp.asarray(v_pages[i]),
+                                  jnp.int32(bid))
+            prof.ledger.record_dispatch(
+                entry, time.perf_counter_ns() - tic, items=len(ids))
+        self.slot_len[slot] = n
+        self.slot_token[slot] = int(payload["token"])
+        self.slot_active[slot] = True
+        self.slot_trace[slot] = obs_context.current_trace_id()
+        self.adoptions_total += 1
+        return slot, int(payload["token"])
+
     # -- lifecycle / introspection -----------------------------------------
     @property
     def blocks_total(self):
@@ -795,6 +976,8 @@ class GenerativeEngine(Logger):
             "decode_calls": self.decode_calls,
             "prefill_calls": self.prefill_calls,
             "preemptions_total": self.preemptions_total,
+            "exports_total": self.exports_total,
+            "adoptions_total": self.adoptions_total,
             "hbm_per_request_bytes": self.hbm_per_request_bytes(),
         }
         if self._pool is not None:
@@ -814,3 +997,5 @@ class GenerativeEngine(Logger):
         self._prefill_exe = {}
         self._chunk_exe = None
         self._decode_exe = None
+        self._page_out_exe = None
+        self._page_in_exe = None

@@ -137,6 +137,11 @@ class BlockPool(object):
         self.tables[slot, index] = bid
         return True
 
+    def owned(self, slot):
+        """The slot's page ids in position order (empty tuple when the
+        slot owns nothing) — the fleet handoff exports exactly these."""
+        return tuple(self._owned.get(slot, ()))
+
     def needs_append(self, slot, position):
         """True when decoding at ``position`` requires a page the slot
         does not own yet (the scheduler's preemption probe)."""

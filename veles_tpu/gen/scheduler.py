@@ -47,10 +47,11 @@ class GenRequest(object):
     __slots__ = ("tokens", "max_new_tokens", "future", "on_token",
                  "submitted", "first_token_at", "generated", "slot",
                  "finish_reason", "admit_seq", "preemptions", "ctx",
-                 "queued_at", "admitted_at")
+                 "queued_at", "admitted_at", "export_pages", "export",
+                 "rid")
 
     def __init__(self, tokens, max_new_tokens, on_token=None,
-                 ctx=None):
+                 ctx=None, export_pages=False, rid=None):
         self.tokens = tokens
         self.max_new_tokens = int(max_new_tokens)
         self.future = Future()
@@ -60,6 +61,15 @@ class GenRequest(object):
         self.generated = []
         self.slot = None
         self.finish_reason = None
+        #: fleet prefill role: export the slot's KV pages into
+        #: :attr:`export` at finish, BEFORE the slot is released —
+        #: with ``max_new_tokens=1`` this turns a request into a
+        #: prefill job whose result is a shippable page payload
+        self.export_pages = bool(export_pages)
+        self.export = None
+        #: fleet request id (opaque) — correlates the frontend's
+        #: exactly-once delivery across prefill/decode roles
+        self.rid = rid
         #: admission stamp — preemption evicts the YOUNGEST (largest)
         self.admit_seq = -1
         self.preemptions = 0
@@ -126,8 +136,12 @@ class GenerativeScheduler(Logger):
         self._queue = collections.deque()
         self._active = {}            # slot -> decoding GenRequest
         self._prefilling = {}        # slot -> chunk-admitting request
+        #: (payload, GenRequest) pairs awaiting page adoption — the
+        #: fleet decode role's admission lane (veles_tpu.fleet)
+        self._handoff = collections.deque()
         self._cond = threading.Condition()
         self._stopped = False
+        self._drain_future = None
         self._thread = None
         # counters the /metrics gauges read (single worker writes)
         self.admitted_total = 0
@@ -237,14 +251,31 @@ class GenerativeScheduler(Logger):
             raise ValueError("max_new_tokens must be >= 1")
         if len(tokens) < 1:
             raise ValueError("empty prompt")
-        self.engine.check_prompt(len(tokens))  # raises when oversized
-        if len(tokens) + max_new_tokens - 1 >= self.engine.max_seq:
-            raise ValueError(
-                "prompt %d + max_new_tokens %d exceeds the engine's "
-                "max_seq %d KV slot" % (len(tokens), max_new_tokens,
-                                        self.engine.max_seq))
         request = GenRequest(tokens, max_new_tokens, on_token,
                              ctx=obs_context.current())
+        return self.submit_request(request)
+
+    def submit_request(self, request):
+        """Enqueue a pre-built :class:`GenRequest` — the fleet's
+        drain-replay path (and what :meth:`submit` rides).  Validation
+        is written against the request's prefix and REMAINING budget,
+        which for a fresh request equals the classic prompt +
+        ``max_new_tokens`` check and for a replayed one admits exactly
+        the streams the original admission admitted (the prefix grew
+        by what the budget shrank)."""
+        prefix_len = len(request.prefix())
+        remaining = request.max_new_tokens - len(request.generated)
+        if remaining < 1:
+            raise ValueError(
+                "request has no remaining token budget (%d generated "
+                "of %d) — finished streams are not replayable"
+                % (len(request.generated), request.max_new_tokens))
+        self.engine.check_prompt(prefix_len)  # raises when oversized
+        if prefix_len + remaining - 1 >= self.engine.max_seq:
+            raise ValueError(
+                "prompt %d + max_new_tokens %d exceeds the engine's "
+                "max_seq %d KV slot" % (prefix_len, remaining,
+                                        self.engine.max_seq))
         with self._cond:
             if self._stopped:
                 raise RuntimeError("scheduler is stopped")
@@ -260,10 +291,73 @@ class GenerativeScheduler(Logger):
         if trace.enabled():
             trace.instant("gen", "enqueue",
                           request.span_args(
-                              {"prompt": len(tokens),
-                               "max_new": max_new_tokens}),
+                              {"prompt": len(request.tokens),
+                               "max_new": request.max_new_tokens,
+                               "resumed": bool(request.generated)}),
                           role="server")
         return request.future
+
+    def submit_handoff(self, payload, request):
+        """Enqueue a shipped page payload for adoption — the fleet
+        decode role's admission lane.  The request continues exactly
+        where the prefill role left it: the payload's first token is
+        emitted on adoption and decode takes over, no recompute.
+        Handoffs admit ahead of the prompt queue (their prefill is
+        already paid for)."""
+        if int(payload["n"]) != len(request.prefix()):
+            raise ValueError(
+                "payload carries %d tokens but the request's prefix "
+                "is %d" % (int(payload["n"]), len(request.prefix())))
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("scheduler is stopped")
+            self._handoff.append((payload, request))
+            self._cond.notify()
+        return request.future
+
+    def handoff_depth(self):
+        return len(self._handoff)
+
+    def drain(self, timeout=30.0):
+        """Evict EVERY live request — queued, pending handoff,
+        prefilling, and decoding — and return the list of
+        :class:`GenRequest` objects for replay on a surviving replica
+        (futures untouched, tokens-so-far kept: resubmitting each via
+        :meth:`submit_request` continues the streams losslessly, the
+        preemption mechanism applied across engines).  Runs on the
+        worker thread when one is live (the engine is single-owner);
+        synchronously otherwise."""
+        with self._cond:
+            if self._thread is None or self._stopped:
+                return self._drain_now()
+            future = self._drain_future = Future()
+            self._cond.notify()
+        return future.result(timeout)
+
+    def _drain_now(self):
+        """The drain body — MUST run on the thread that owns the
+        engine."""
+        evicted = []
+        for slot in sorted(set(self._prefilling) | set(self._active)):
+            request = self._prefilling.pop(slot, None) \
+                or self._active.pop(slot, None)
+            try:
+                self.engine.release_slot(slot)
+            except Exception:
+                pass
+            request.slot = None
+            request.queued_at = time.perf_counter()
+            evicted.append(request)
+        with self._cond:
+            evicted.extend(r for _, r in self._handoff)
+            self._handoff.clear()
+            evicted.extend(self._queue)
+            self._queue.clear()
+        if trace.enabled():
+            trace.instant("gen", "drain",
+                          {"model": self.name,
+                           "requests": len(evicted)}, role="server")
+        return evicted
 
     def generate(self, tokens, max_new_tokens=16, timeout=120.0,
                  on_token=None):
@@ -317,6 +411,16 @@ class GenerativeScheduler(Logger):
 
     def _finish(self, request, reason):
         request.finish_reason = reason
+        if request.export_pages:
+            # fleet prefill role: package the slot's KV pages before
+            # they go back to the pool — the job result the handoff
+            # ships (a failure leaves export=None; the fleet master
+            # re-runs the prefill rather than losing the request)
+            try:
+                request.export = self.engine.export_slot(request.slot)
+            except Exception:
+                self.exception("page export failed; the fleet will "
+                               "re-run this prefill")
         self.engine.release_slot(request.slot)
         self._active.pop(request.slot, None)
         self.finished_total += 1
@@ -380,6 +484,53 @@ class GenerativeScheduler(Logger):
         fed (0 = idle)."""
         emitted = 0
         decode_steps_before = self.decode_steps
+        drain = None
+        with self._cond:
+            if self._drain_future is not None:
+                drain, self._drain_future = self._drain_future, None
+        if drain is not None:
+            # a drain request from another thread: evict everything on
+            # THIS thread (the engine's owner) and hand the requests
+            # back for replay
+            try:
+                drain.set_result(self._drain_now())
+            except Exception as exc:  # noqa: BLE001 - report, don't wedge
+                drain.set_exception(exc)
+            return 1                 # progress, not idle
+        # adopt shipped pages first: their prefill is already paid
+        # for, so a waiting handoff beats a queued prompt to the pool
+        while True:
+            with self._cond:
+                if not self._handoff:
+                    break
+                payload, request = self._handoff[0]
+                if not self.engine.can_admit(int(payload["n"])):
+                    break
+                self._handoff.popleft()
+            try:
+                with obs_context.activate(request.ctx):
+                    slot, token = self.engine.adopt_sequence(payload)
+            except Exception as exc:  # noqa: BLE001 - per-request
+                self.exception("page adoption failed; failing the "
+                               "request")
+                if not request.future.done():
+                    request.future.set_exception(exc)
+                continue
+            request.slot = slot
+            request.admitted_at = time.perf_counter()
+            self._admit_counter += 1
+            request.admit_seq = self._admit_counter
+            self.admitted_total += 1
+            if trace.enabled():
+                trace.instant("gen", "adopt",
+                              request.span_args(
+                                  {"slot": slot,
+                                   "prompt": len(request.tokens),
+                                   "pages": len(payload["k"])}),
+                              role="server")
+            self._active[slot] = request
+            self._emit(request, token)   # may evict immediately
+            emitted += 1
         while True:
             # pop-and-admit one at a time: every admission updates the
             # slot free list AND the pool headroom before the next
@@ -505,7 +656,8 @@ class GenerativeScheduler(Logger):
     def run_until_idle(self, max_steps=100000):
         """Pump until queue and slots drain (manual mode)."""
         steps = 0
-        while self._queue or self._active or self._prefilling:
+        while self._queue or self._active or self._prefilling \
+                or self._handoff:
             if self.step() == 0:
                 break
             steps += 1
@@ -533,7 +685,8 @@ class GenerativeScheduler(Logger):
                 if self._stopped:
                     return
                 if not self._queue and not self._active \
-                        and not self._prefilling:
+                        and not self._prefilling and not self._handoff \
+                        and self._drain_future is None:
                     self._cond.wait(0.05)
                     if self._stopped:
                         return
@@ -565,7 +718,7 @@ class GenerativeScheduler(Logger):
             while True:
                 with self._cond:
                     idle = not self._queue and not self._active \
-                        and not self._prefilling
+                        and not self._prefilling and not self._handoff
                 if idle:
                     break
                 time.sleep(0.005)
@@ -573,6 +726,8 @@ class GenerativeScheduler(Logger):
             self._stopped = True
             leftovers = list(self._queue)
             self._queue.clear()
+            leftovers += [r for _, r in self._handoff]
+            self._handoff.clear()
             self._cond.notify_all()
         for request in leftovers:
             if not request.future.done():

@@ -155,6 +155,9 @@ class SLOEngine(object):
         #: objective name -> alert state (for alerts_total edges)
         self._alerting = {}
         self.alerts_total = 0
+        #: extra exposition callable (the fleet autoscaler's gauges
+        #: ride the same scrape) — see :meth:`attach_exposition`
+        self._extra_text = None
         self._lock = threading.Lock()
 
     # -- declaration -------------------------------------------------------
@@ -170,6 +173,16 @@ class SLOEngine(object):
     def ring(self, name):
         entry = self._signals.get(name)
         return entry[1] if entry else None
+
+    def attach_exposition(self, fn):
+        """Append an extra exposition source to this engine's
+        ``/metrics`` text — the closed loop made visible: the fleet
+        autoscaler CONSUMES :meth:`autoscaling_signals` and publishes
+        its decisions (``veles_fleet_*`` gauges) back through the same
+        scrape, so one endpoint shows signal and action side by side.
+        ``fn`` returns exposition lines (or ``""``); a raising source
+        is skipped, never poisoning the scrape."""
+        self._extra_text = fn
 
     def add_objective(self, objective):
         if objective.signal not in self._signals:
@@ -364,6 +377,13 @@ class SLOEngine(object):
                                 1 if res["alerting"] else 0))
         lines.append("# TYPE veles_slo_alerts_total counter")
         lines.append("veles_slo_alerts_total %d" % self.alerts_total)
+        if self._extra_text is not None:
+            try:
+                extra = self._extra_text()
+            except Exception:
+                extra = ""
+            if extra:
+                lines.append(extra.rstrip("\n"))
         return "\n".join(lines) + "\n"
 
     def describe(self):

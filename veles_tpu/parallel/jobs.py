@@ -419,7 +419,11 @@ class JobServer(Logger):
             self._send(identity, {"op": "pong", "req": req})
         elif op == "job_request":
             self._on_job_request(identity, slave, msg)
-        elif op == "update":
+        elif op in ("update", "page"):
+            # "page" is the fleet's KV handoff: a different payload
+            # (page arrays + table row vs a training delta) riding the
+            # SAME exactly-once machinery — {gen, epoch, seq} dedup,
+            # stale rejection, drop-after-apply retries all hold
             self._on_update(identity, slave, msg)
         elif op == "pod_epoch":
             self._on_pod_epoch(identity, slave, msg)
@@ -682,11 +686,16 @@ class JobServer(Logger):
             apply_args = {"slave": slave.id}
             if update_ctx is not None:
                 apply_args = update_ctx.span_args(apply_args)
+            if msg.get("op") == "page":
+                apply_fn = self.workflow.apply_pages_from_slave
+                span_name = "apply_pages"
+            else:
+                apply_fn = self.workflow.apply_data_from_slave
+                span_name = "apply_update"
             try:
-                with trace.span("jobs", "apply_update", apply_args,
+                with trace.span("jobs", span_name, apply_args,
                                 role="master"):
-                    self.workflow.apply_data_from_slave(msg["data"],
-                                                        slave)
+                    apply_fn(msg["data"], slave)
                 ok = 1
             except Exception:
                 self.exception("bad update from %s", slave.id)
@@ -1143,6 +1152,11 @@ class JobClient(Logger):
         #: client-monotonic request counter echoed in replies: lets a
         #: retried rpc skip orphan replies of timed-out predecessors
         self._req = 0
+        #: the op every job result ships under — "update" (training
+        #: deltas) by default; the fleet prefill role sets "page" so
+        #: its results land in apply_pages_from_slave, riding the same
+        #: exactly-once retry/dedup path
+        self.update_op = "update"
         #: the per-role Prometheus listener (obs.scrape), mounted by
         #: start_scrape()
         self._scrape = None
@@ -1417,7 +1431,7 @@ class JobClient(Logger):
         is gone for good.  ``ctx`` (the job frame's trace context)
         rides the update frame back so the master's apply span joins
         the same request waterfall."""
-        msg = {"op": "update", "id": self.sid, "data": data}
+        msg = {"op": self.update_op, "id": self.sid, "data": data}
         if job_id:
             msg["job"] = job_id
         if ctx is not None:

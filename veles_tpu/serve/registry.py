@@ -68,8 +68,9 @@ class ReplicaSet(object):
                             "served": 0})
         if not members:
             raise ValueError("empty replica set")
-        shapes = {tuple(m["engine"].sample_shape) for m in members}
-        if len(shapes) != 1:
+        shapes = {tuple(m["engine"].sample_shape) for m in members
+                  if hasattr(m["engine"], "sample_shape")}
+        if len(shapes) > 1:
             raise ValueError(
                 "replica engines disagree on sample shape: %s"
                 % sorted(shapes))
@@ -113,6 +114,97 @@ class ReplicaSet(object):
 
     def infer(self, batch):
         return self._next()["engine"].infer(batch)
+
+    def pick(self):
+        """One smooth-WRR selection, returned as the member engine —
+        the routing surface for callers that dispatch themselves (the
+        fleet's decode router) instead of riding :meth:`infer`."""
+        return self._next()["engine"]
+
+    def engines(self):
+        """Snapshot of every member engine, construction order — lets
+        the fleet iterate its decode schedulers (drain, describe,
+        signal sampling) without reaching into the member dicts."""
+        with self._lock:
+            return [m["engine"] for m in self._members]
+
+    # -- reconfiguration (the autoscaler's surface) ------------------------
+    def set_weights(self, weights):
+        """Re-weight every member in place (positional, construction /
+        ``describe()`` order) and RESET the smooth-WRR credits: the
+        credits are denominated in the OLD weight total, so carrying
+        them across a re-weight skews the first rotation toward
+        whoever was owed traffic under the old split — a 3:1 → 1:1
+        shift must serve exactly 1:1 from the very next rotation."""
+        weights = [float(w) for w in weights]
+        if len(weights) != len(self._members):
+            raise ValueError(
+                "got %d weight(s) for %d member(s)"
+                % (len(weights), len(self._members)))
+        if any(w <= 0 for w in weights):
+            raise ValueError("replica weights must be > 0, got %r"
+                             % (weights,))
+        with self._lock:
+            for member, weight in zip(self._members, weights):
+                member["weight"] = weight
+                member["credit"] = 0.0
+            self._total_weight = sum(weights)
+
+    def add_replica(self, engine, weight=1.0, version=None):
+        """Grow the set by one member (scale-up).  Credits reset —
+        the new split starts clean, same reasoning as
+        :meth:`set_weights`."""
+        weight = float(weight)
+        if weight <= 0:
+            raise ValueError("replica weight must be > 0, got %r"
+                             % weight)
+        with self._lock:
+            if hasattr(engine, "sample_shape"):
+                shapes = {tuple(m["engine"].sample_shape)
+                          for m in self._members
+                          if hasattr(m["engine"], "sample_shape")}
+                if shapes and {tuple(engine.sample_shape)} != shapes:
+                    raise ValueError(
+                        "new replica's sample shape %s disagrees with "
+                        "the set's %s" % (tuple(engine.sample_shape),
+                                          sorted(shapes)))
+            if version is None:
+                version = len(self._members)
+            self._members.append({"engine": engine, "weight": weight,
+                                  "version": version, "credit": 0.0,
+                                  "served": 0})
+            for member in self._members:
+                member["credit"] = 0.0
+            self._total_weight = sum(m["weight"]
+                                     for m in self._members)
+        return version
+
+    def remove_replica(self, version):
+        """Shrink the set by the member deployed as ``version``
+        (scale-down) and return its engine — the caller drains it.
+        Refuses to empty the set."""
+        with self._lock:
+            if len(self._members) == 1:
+                raise ValueError(
+                    "cannot remove the last replica — undeploy the "
+                    "set instead")
+            for index, member in enumerate(self._members):
+                if member["version"] == version:
+                    break
+            else:
+                raise KeyError("no replica with version %r (have %s)"
+                               % (version,
+                                  [m["version"]
+                                   for m in self._members]))
+            removed = self._members.pop(index)
+            for member in self._members:
+                member["credit"] = 0.0
+            self._total_weight = sum(m["weight"]
+                                     for m in self._members)
+        return removed["engine"]
+
+    def __len__(self):
+        return len(self._members)
 
     # -- introspection -----------------------------------------------------
     def describe(self):
@@ -200,6 +292,49 @@ class _GenModel(object):
         info.update(self.engine.describe())
         info.update(self.scheduler.describe())
         return info
+
+
+class _FleetModel(object):
+    """One served DISAGGREGATED name: a :class:`veles_tpu.fleet.Fleet`
+    facade (prefill role + decode replica set + autoscaler) behind the
+    same registry surface as a generative model — ``scheduler`` and
+    ``engine`` both resolve to the fleet, whose ``generate`` /
+    ``stop`` / ``close`` line up with what the registry and server
+    already call."""
+
+    __slots__ = ("name", "fleet", "version", "deployed_at", "source")
+
+    is_generative = True
+
+    def __init__(self, name, fleet):
+        self.name = name
+        self.fleet = fleet
+        self.version = None
+        self.deployed_at = None
+        self.source = None
+
+    @property
+    def scheduler(self):
+        return self.fleet
+
+    @property
+    def engine(self):
+        return self.fleet
+
+    def describe(self):
+        info = {
+            "name": self.name,
+            "version": self.version,
+            "deployed_at": self.deployed_at,
+            "source": self.source,
+            "generative": True,
+            "disaggregated": True,
+        }
+        info.update(self.fleet.describe())
+        return info
+
+    def metrics_text(self):
+        return self.fleet.metrics_text()
 
 
 class ModelRegistry(Logger):
@@ -487,6 +622,28 @@ class ModelRegistry(Logger):
                   engine.max_slots, list(engine.prefill_buckets))
         return model
 
+    def deploy_fleet(self, name, fleet, version=None, source=None):
+        """Install a disaggregated :class:`veles_tpu.fleet.Fleet`
+        under ``name`` — the registry's serving surface (``generate``,
+        ``describe``, ``undeploy``) then routes through the fleet's
+        front end.  Fleets do not hot-swap in place (their members do,
+        via the autoscaler and ``Fleet.drain_replica``): deploying
+        over an existing name is refused."""
+        with self._lock:
+            old = self._models.get(name)
+            if old is not None:
+                raise ValueError(
+                    "%r is already served — a fleet swaps its MEMBERS "
+                    "(drain_replica/add_replica), not itself; undeploy "
+                    "first" % name)
+            model = _FleetModel(name, fleet)
+            model.version = version if version is not None else 1
+            model.deployed_at = time.time()
+            model.source = source or "fleet"
+            self._models[name] = model
+        self.info("deployed fleet %s version %s", name, model.version)
+        return model
+
     def generate(self, name, tokens, max_new_tokens=16, timeout=120.0,
                  on_token=None):
         """Stream a generation on ``name``'s scheduler (blocking
@@ -602,6 +759,26 @@ class ModelRegistry(Logger):
             models = dict(self._models)
         return {name: model.describe()
                 for name, model in sorted(models.items())}
+
+    def extra_metrics_text(self):
+        """Exposition lines contributed by deployed models themselves —
+        today that is the disaggregated fleet's ``veles_fleet_*``
+        gauges (``_FleetModel.metrics_text``), so the serving scrape
+        shows the autoscaler's signals and its actions on one
+        endpoint.  A raising source is skipped, never poisoning the
+        scrape."""
+        with self._lock:
+            models = list(self._models.values())
+        parts = []
+        for model in models:
+            fn = getattr(model, "metrics_text", None)
+            if fn is None:
+                continue
+            try:
+                parts.append(fn())
+            except Exception:
+                self.exception("metrics_text source failed")
+        return "".join(parts)
 
     def submit(self, name, rows):
         """Queue rows on ``name``'s batcher; returns the Future."""

@@ -280,6 +280,7 @@ class ServingServer(Logger):
             body += trace.metrics_text()
         self.slo.sample()
         body += self.slo.metrics_text()
+        body += self.registry.extra_metrics_text()
         return body
 
     # -- lifecycle ---------------------------------------------------------
@@ -332,6 +333,13 @@ class ServingServer(Logger):
                     self._reply_json(500, {"error": str(e)})
                     return
                 self.send_response(status)
+                if status == 503 and b"retry_after" in first:
+                    # the generative queue-full shed carries the same
+                    # back-off contract as the predict path's bounded
+                    # queue (PR 1): clients key reconnects off the
+                    # header, not the body
+                    self.send_header("Retry-After",
+                                     str(QueueFull.retry_after))
                 if self._trace_ctx is not None:
                     self.send_header("traceparent",
                                      self._trace_ctx.traceparent())
