@@ -113,3 +113,29 @@ timeout -k 10 280 env JAX_PLATFORMS=cpu \
 # role (docs/services.md § Disaggregated serving)
 echo "== fleet smoke (disaggregated prefill/decode gate) =="
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m veles_tpu.fleet --smoke
+# plan smoke: the static sharding planner must find a feasible plan
+# for both planner paths on a forced 8-device host — the mnist
+# workflow path (initialize-but-never-train pricing) and the
+# transformer params-pytree path (zero-alloc, Megatron module specs);
+# and a topology the batch/axes CANNOT divide must exit non-zero with
+# the V-P03 reasons named per candidate (docs/analyze.md § Planner)
+echo "== plan smoke (static sharding planner gate) =="
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m veles_tpu.analyze --plan veles_tpu.samples.mnist
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m veles_tpu.analyze --plan veles_tpu.samples.transformer
+if out=$(timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m veles_tpu.analyze --plan veles_tpu.samples.mnist \
+    --topology 3); then
+  echo "plan smoke: expected non-zero exit for --topology 3" >&2
+  exit 1
+fi
+echo "$out"
+case "$out" in
+  *V-P03*) : ;;
+  *) echo "plan smoke: V-P03 not named for the bad topology" >&2
+     exit 1 ;;
+esac

@@ -1,0 +1,107 @@
+"""Shared case matrix for the V-P02 / V-S01 pricing regression gate.
+
+The pricing-core refactor (analyze/pricing.py) must not move a single
+byte or word in either preflight's findings.  This module defines the
+case matrix ONCE; ``python tests/pricing_cases.py`` dumps the current
+reports to ``tests/fixtures/preflight_pricing.json`` (run against the
+pre-refactor tree to bank the oracle), and
+``tests/test_plan.py::test_pricing_refactor_fixture_parity`` replays
+the same matrix and asserts byte-identical JSON.
+
+Run under the conftest environment (JAX_PLATFORMS=cpu, 8 virtual
+devices) so the mesh cases see the same topology either way.
+"""
+
+import json
+import os
+
+
+class GenPlanStub(object):
+    """A plan-shaped object for check_generative (no device work) —
+    mirrors tests/test_gen.py::_PlanStub."""
+
+    def __init__(self, **kw):
+        class _Model(object):
+            causal = kw.pop("causal", True)
+            seq_limit = kw.pop("seq_limit", 64)
+        self.model = _Model()
+        self.max_slots = kw.pop("max_slots", 2)
+        self.max_seq = kw.pop("max_seq", 48)
+        self.prefill_buckets = kw.pop("prefill_buckets", (8, 16))
+        self.kv_cache_bytes = kw.pop("kv_cache_bytes", 1024)
+        self.kv_mode = kw.pop("kv_mode", "contiguous")
+        self.block_size = kw.pop("block_size", 16)
+        self.num_blocks = kw.pop("num_blocks", 16)
+        self.prefill_chunk = kw.pop("prefill_chunk", None)
+        assert not kw, kw
+
+
+#: check_generative cases: name -> (stub kwargs, check kwargs)
+GEN_CASES = {
+    "clean": ({}, {"hbm_bytes": 1 << 30}),
+    "not_causal": ({"causal": False}, {"hbm_bytes": 1 << 30}),
+    "no_slots": ({"max_slots": 0}, {"hbm_bytes": 1 << 30}),
+    "over_budget": ({"kv_cache_bytes": 1000}, {"hbm_bytes": 1000}),
+    "half_hbm_warn": ({"kv_cache_bytes": 600}, {"hbm_bytes": 1000}),
+    "cpu_degrade": ({}, {"hbm_bytes": None}),
+    "paged_bad_block": ({"kv_mode": "paged", "block_size": 10},
+                        {"hbm_bytes": 1 << 30}),
+    "paged_pool_small": ({"kv_mode": "paged", "num_blocks": 3},
+                         {"hbm_bytes": 1 << 30}),
+    "paged_mean_mix": ({"kv_mode": "paged", "num_blocks": 7,
+                        "max_slots": 4}, {"hbm_bytes": 1 << 30,
+                                          "mean_seq_len": 40}),
+}
+
+#: check_pod cases: name -> check_pod kwargs (workflow/mesh added by
+#: the driver; "unstitched" swaps in a NumpyDevice workflow)
+POD_CASES = {
+    "clean": {},
+    "bad_batch": {"batch_size": 60},
+    "tiny_hbm": {"hbm_bytes": 1024},
+    "tiny_hbm_fsdp": {"hbm_bytes": 1024, "param_rules": "fsdp"},
+    "mid_hbm": {"hbm_bytes": 1 << 16},
+    "no_data_axis": {"data_axis": "nope"},
+    "unstitched": {},
+}
+
+
+def run_cases():
+    """Case matrix -> {kind: {name: report-json-dict}} against the
+    CURRENT tree."""
+    from veles_tpu.analyze.shapes import check_generative, check_pod
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.parallel.dp import fsdp_rules
+    from veles_tpu.parallel.mesh import mesh_from_topology
+    from veles_tpu.pod.__main__ import make_workflow
+
+    out = {"gen": {}, "pod": {}}
+    for name, (stub_kw, check_kw) in sorted(GEN_CASES.items()):
+        report = check_generative(GenPlanStub(**stub_kw), **check_kw)
+        out["gen"][name] = json.loads(report.to_json())
+
+    mesh = mesh_from_topology("auto")
+    wf = make_workflow()
+    loose = make_workflow(device=NumpyDevice())
+    for name, kw in sorted(POD_CASES.items()):
+        kw = dict(kw)
+        target = loose if name == "unstitched" else wf
+        if kw.get("param_rules") == "fsdp":
+            kw["param_rules"] = fsdp_rules(mesh)
+        report = check_pod(target, mesh, **kw)
+        out["pod"][name] = json.loads(report.to_json())
+    return out
+
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "preflight_pricing.json")
+
+
+if __name__ == "__main__":
+    results = run_cases()
+    os.makedirs(os.path.dirname(FIXTURE), exist_ok=True)
+    with open(FIXTURE, "w") as fout:
+        json.dump(results, fout, indent=2, sort_keys=True)
+        fout.write("\n")
+    print("banked %d gen + %d pod cases -> %s"
+          % (len(results["gen"]), len(results["pod"]), FIXTURE))

@@ -1,0 +1,403 @@
+"""veles_tpu.analyze.plan + analyze.pricing — the static sharding
+planner and the shared pricing core.
+
+Gates here:
+
+* the pricing-core refactor moved ZERO bytes/words in the V-P02 pod
+  preflight and V-S01 serving preflight (fixture replay, byte-equal
+  JSON vs the pre-refactor oracle in
+  tests/fixtures/preflight_pricing.json — regenerate with
+  ``python tests/pricing_cases.py`` ONLY when a pricing change is
+  intended);
+* planner feasibility rules V-P03/V-P04/V-P05 and the ranked table;
+* ``PodRuntime(param_rules="auto")`` — bitwise weight parity with the
+  explicit-rules run the planner selects, zero steady-state
+  recompiles;
+* planner-vs-ledger: the predicted psum bytes and per-shard residency
+  track the live prof/Watcher ledgers within 10 % on the 8-way pod
+  smoke;
+* V-L05 knob registry and the ``--fail-on`` exit policy.
+"""
+
+import json
+
+import numpy
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import pricing_cases
+from veles_tpu import prof
+from veles_tpu.analyze import lint_paths
+from veles_tpu.analyze import plan as plan_mod
+from veles_tpu.analyze import pricing
+from veles_tpu.analyze.__main__ import main as analyze_main
+from veles_tpu.analyze.graph import check_graph, unreachable_units
+from veles_tpu.config import root
+from veles_tpu.dummy import DummyUnit, DummyWorkflow
+from veles_tpu.memory import Watcher
+from veles_tpu.parallel.mesh import mesh_from_topology
+from veles_tpu.pod import PodRuntime, train_epochs
+from veles_tpu.pod.__main__ import SMOKE_EPOCHS, make_workflow
+
+
+def final_weights(wf):
+    wf.forwards[0].weights.map_read()
+    return numpy.array(wf.forwards[0].weights.mem)
+
+
+# -- the refactor regression gate -------------------------------------------
+
+def test_pricing_refactor_fixture_parity():
+    """check_pod / check_generative reports are byte-identical to the
+    pre-refactor oracle across the whole case matrix."""
+    with open(pricing_cases.FIXTURE) as fin:
+        banked = json.load(fin)
+    now = pricing_cases.run_cases()
+    assert json.dumps(now, sort_keys=True) == \
+        json.dumps(banked, sort_keys=True), \
+        "preflight pricing drifted from the banked fixture"
+
+
+# -- pricing primitives ------------------------------------------------------
+
+def test_collective_formulas():
+    assert pricing.ring_all_reduce_bytes(1000, 8) == 1750
+    assert pricing.ring_all_reduce_bytes(1000, 1) == 0
+    assert pricing.ring_all_gather_bytes(1000, 8) == 875
+    assert pricing.ring_all_gather_bytes(1000, 1) == 0
+    assert pricing.pipeline_bubble(1, 8) == 0.0
+    assert pricing.pipeline_bubble(4, 16) == pytest.approx(3 / 19)
+
+
+def test_shard_factor_and_divisibility():
+    axes = {"data": 4, "model": 2}
+    assert pricing.shard_factor(P(), axes) == 1
+    assert pricing.shard_factor(P("data"), axes) == 4
+    assert pricing.shard_factor(P("data", "model"), axes) == 8
+    assert pricing.shard_factor(P(("data", "model")), axes) == 8
+    ok, dim, extent, size = pricing.spec_divisible(
+        (100, 10), P("data"), axes)
+    assert ok
+    ok, dim, extent, size = pricing.spec_divisible(
+        (7, 10), P("data"), axes)
+    assert (ok, dim, extent, size) == (False, 0, 7, 4)
+
+
+def test_hbm_budget_rule():
+    assert pricing.hbm_budget(None) is None
+    assert pricing.hbm_budget(0) is None
+    assert pricing.hbm_budget(1000) == 900.0
+
+
+# -- the planner: workflow path ---------------------------------------------
+
+def test_plan_workflow_ranked_table_and_winner():
+    wf = make_workflow()
+    res = plan_mod.plan_workflow(wf, topology="auto")
+    assert res.best is not None
+    # batch 64 divides 8 ways and the smoke params are tiny (below
+    # min_elements), so plain dp wins and the report is CLEAN even
+    # though individual candidates were rejected
+    assert res.best.name == "dp8"
+    assert not res.report.has_errors
+    names = [c.name for c in res.candidates]
+    assert "fsdp8" in names and "tp8" in names and "pp8" in names
+    table = res.render_table()
+    assert "winner dp8" in table and "infeasible" in table
+    data = res.to_dict()
+    json.dumps(data)    # JSON-able end to end
+    assert data["best"] == "dp8"
+    assert len(data["candidates"]) == len(res.candidates)
+    # rejected candidates carry their findings locally
+    tp8 = next(c for c in res.candidates if c.name == "tp8")
+    assert not tp8.feasible
+    assert tp8.findings[0].rule == "V-P03"
+
+
+def test_plan_workflow_bad_topology_names_v_p03():
+    wf = make_workflow()
+    res = plan_mod.plan_workflow(wf, topology=3)
+    assert res.best is None
+    assert res.report.has_errors
+    assert "V-P03" in res.report.rules()
+    # batch 64 % 3 != 0 is one of the named reasons
+    assert any("does not divide" in f.message
+               for f in res.report.findings)
+
+
+def test_plan_workflow_v_p04_when_nothing_fits():
+    wf = make_workflow()
+    res = plan_mod.plan_workflow(wf, topology="auto", hbm_bytes=1024)
+    assert res.best is None
+    assert "V-P04" in res.report.rules()
+    finding = next(f for f in res.report.findings
+                   if f.rule == "V-P04")
+    assert "smallest fix" in finding.message
+    assert finding.fix
+
+
+def test_v_p05_rule_shards_non_divisible_dim():
+    cand = plan_mod.Candidate("bad", {"data": 8}, "custom",
+                              param_rules=lambda leaf: P("data"))
+    n_sharded, sharded_bytes = plan_mod._check_rule_divisibility(
+        cand, [((7, 5), 140)])
+    assert not cand.feasible
+    assert cand.findings[0].rule == "V-P05"
+    assert "7 %% 8" in cand.findings[0].message.replace("% 8", "%% 8")
+
+
+# -- the planner: params-pytree (LM) path -----------------------------------
+
+def test_plan_params_transformer_megatron_specs():
+    from veles_tpu.samples import transformer as T
+    params = T.param_shapes(T.CONFIG)
+    res = plan_mod.plan_params(
+        params, topology="auto",
+        batch_bytes=8 * T.CONFIG["seq_len"] * 4,
+        activation_bytes=8 * T.CONFIG["seq_len"] * T.CONFIG["dim"] * 4,
+        param_spec_fn=T.param_specs)
+    assert res.best is not None
+    # the module's Megatron specs shard every big weight, so pure tp
+    # moves the least per step (no grad psum at data=1)
+    assert res.best.name == "tp8"
+    pp8 = next(c for c in res.candidates if c.name == "pp8")
+    assert pp8.feasible and pp8.skeleton and pp8.bubble > 0
+    # stacked blocks (leading L=12) stage-shard; embed stays whole
+    dp8 = next(c for c in res.candidates if c.name == "dp8")
+    assert dp8.feasible
+    assert dp8.psum_bytes > res.best.psum_bytes
+
+
+def test_transformer_param_shapes_matches_init():
+    import jax
+
+    from veles_tpu.samples import transformer as T
+    shapes = T.param_shapes(T.TINY)
+    params = T.init_params(T.TINY, seed=1)
+    flat_s = jax.tree.leaves(shapes)
+    flat_p = jax.tree.leaves(params)
+    assert jax.tree.structure(shapes) == jax.tree.structure(params)
+    for sds, leaf in zip(flat_s, flat_p):
+        assert tuple(sds.shape) == tuple(leaf.shape)
+        assert sds.dtype == leaf.dtype
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_plan_json_transformer(capsys):
+    rc = analyze_main(["--plan", "veles_tpu.samples.transformer",
+                       "--json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["best"] == "tp8"
+    assert data["candidates"]
+    assert data["report"]["counts"]["error"] == 0
+
+
+def test_cli_plan_bad_topology_exits_nonzero(capsys):
+    rc = analyze_main(["--plan", "veles_tpu.samples.mnist",
+                       "--topology", "3"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "V-P03" in out
+
+
+def test_cli_fail_on_policy(tmp_path, capsys):
+    bad = tmp_path / "phantom.py"
+    bad.write_text("from veles_tpu.config import root\n"
+                   "x = root.common.engine.not_a_knob\n")
+    assert analyze_main(["--lint", str(bad)]) == 1
+    capsys.readouterr()
+    # lint findings are warnings: --fail-on error passes them
+    assert analyze_main(["--lint", str(bad),
+                         "--fail-on", "error"]) == 0
+    capsys.readouterr()
+    assert analyze_main(["--lint", str(bad),
+                         "--fail-on", "warn"]) == 1
+    capsys.readouterr()
+
+
+# -- V-L05 knob registry -----------------------------------------------------
+
+def test_knob_registry_covers_the_package():
+    findings = [f for f in lint_paths()
+                if f.rule == "V-L05"]
+    assert findings == [], \
+        "undeclared knob reads: %s" % [f.message for f in findings]
+
+
+def test_knob_scanner_resolves_get_hops(tmp_path):
+    src = tmp_path / "knobby.py"
+    src.write_text(
+        "from veles_tpu.config import root\n"
+        "a = root.common.engine.get(\"pod\").get(\"topology\")\n"
+        "b = root.common.fleet.prefill_hosts\n"
+        "c = root.common.engine.mesh.axes.to_dict()\n"
+        "d = root.common.gen.kv.block_size\n"
+        "bad = root.common.engine.pod.warp_speed\n")
+    findings = [f for f in lint_paths([str(src)])
+                if f.rule == "V-L05"]
+    assert len(findings) == 1
+    assert "root.common.engine.pod.warp_speed" in findings[0].message
+
+
+def test_knob_table_renders_markdown():
+    from veles_tpu.analyze.knobs import render_knob_table
+    table = render_knob_table()
+    assert "| knob | description |" in table
+    assert "`root.common.engine.pod.param_rules`" in table
+    assert "`root.common.fleet.*`" in table
+
+
+# -- V-G02 shared detection helper ------------------------------------------
+
+def test_v_g02_warning_and_analyzer_agree(caplog):
+    # a stray unit: both the analyzer pass and the one-time workflow
+    # warning flag it, via the same helper
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    a.link_from(wf.start_point)
+    wf.end_point.link_from(a)
+    DummyUnit(wf, name="stray")
+    flagged = unreachable_units(wf.start_point, wf._units,
+                                exclude=(wf.end_point,))
+    assert [u.name for u in flagged] == ["stray"]
+    assert {f.unit for f in check_graph(wf)
+            if f.rule == "V-G02"} == {"stray"}
+    import logging
+    with caplog.at_level(logging.WARNING):
+        wf.units_in_dependency_order()
+    assert any("stray" in r.message for r in caplog.records
+               if "V-G02" in r.message)
+
+
+def test_v_g02_excludes_unreachable_end_point(caplog):
+    # end_point unreachable: appended for ordering but NOT flagged —
+    # V-G05 owns that failure mode (the two rules used to disagree)
+    wf = DummyWorkflow()
+    a = DummyUnit(wf, name="a")
+    a.link_from(wf.start_point)
+    assert unreachable_units(wf.start_point, wf._units,
+                             exclude=(wf.end_point,)) == []
+    assert not any(f.rule == "V-G02" for f in check_graph(wf))
+    import logging
+    with caplog.at_level(logging.WARNING):
+        order = wf.units_in_dependency_order()
+    assert wf.end_point in order
+    assert not any("V-G02" in r.message for r in caplog.records)
+
+
+# -- param_rules="auto" + planner-vs-ledger acceptance gates -----------------
+
+def test_auto_param_rules_bitwise_parity_and_ledger():
+    """THE gate: an 8-way pod run with ``param_rules="auto"`` is
+    bitwise-identical to the same run under the explicit rules the
+    planner selected, retraces nothing in steady state, and the
+    planner's psum/residency predictions track the live ledgers."""
+    mesh = mesh_from_topology("auto")
+
+    explicit_wf = make_workflow()
+    explicit_pod = PodRuntime(explicit_wf, mesh=mesh,
+                              param_rules=None)
+    explicit_pod.install()
+    for _ in train_epochs(explicit_wf, SMOKE_EPOCHS):
+        pass
+
+    watcher_before = dict(Watcher.bytes_by_category)
+    auto_wf = make_workflow()
+    auto_pod = PodRuntime(auto_wf, mesh=mesh_from_topology("auto"),
+                          param_rules="auto")
+    # the STATIC prediction: priced on the un-installed workflow
+    # (install placement may narrow host-f64 buffers to f32, which is
+    # exactly the drift the 10% ledger gate below absorbs)
+    batch = int(auto_wf.loader.max_minibatch_size)
+    pred = plan_mod.predicted_estimates(auto_wf, auto_pod.mesh,
+                                        param_rules=None)
+    pred_seg_by_name = {
+        "+".join(seg.names): pricing.segment_psum_bytes(
+            seg, batch, auto_pod.shards)
+        for seg in auto_wf._stitch_segments_}
+    auto_pod.install()
+    desc = auto_pod.describe()
+    # ledger baselines: prof entries are keyed by segment NAME and
+    # accumulate across the whole test session — gate on THIS run's
+    # delta, not the lifetime average
+    ledger_before = {
+        "+".join(seg.names): (seg.prof_entry.psum_bytes,
+                              seg.prof_entry.dispatches)
+        for seg in auto_wf._stitch_segments_}
+    assert desc["auto_plan"] == "dp8"
+    # the planner picked the same explicit rule (replicated) — the
+    # string resolved BEFORE any sharding was applied
+    assert auto_pod.param_rules is None
+    assert auto_pod.auto_plan["rule"] == "replicated"
+
+    stepper = train_epochs(auto_wf, SMOKE_EPOCHS)
+    next(stepper)                       # warmup epoch (compiles)
+    steady_recompiles = prof.ledger.recompiles
+    for _ in stepper:
+        pass
+    assert prof.ledger.recompiles == steady_recompiles, \
+        "auto plan must not retrace in steady state"
+
+    assert numpy.array_equal(final_weights(auto_wf),
+                             final_weights(explicit_wf)), \
+        "auto plan must be bitwise-identical to the explicit run"
+
+    # planner-vs-ledger: psum — the prediction and the runtime's
+    # describe() estimate share ONE formula over the same pre-install
+    # state, so they agree EXACTLY, and the live per-dispatch ledger
+    # accumulation tracks the prediction within 10%
+    assert pred.psum_bytes == desc["psum_bytes_per_step"]
+    checked = 0
+    for segment in auto_wf._stitch_segments_:
+        entry = segment.prof_entry
+        name = "+".join(segment.names)
+        psum0, disp0 = ledger_before[name]
+        d_psum = entry.psum_bytes - psum0
+        d_disp = entry.dispatches - disp0
+        if not d_disp or not d_psum:
+            continue
+        per_dispatch = d_psum / d_disp
+        pred_seg = pred_seg_by_name[name]
+        assert abs(per_dispatch - pred_seg) <= 0.1 * max(pred_seg, 1)
+        checked += 1
+    assert checked, "no live psum ledger entries to check against"
+
+    # planner-vs-ledger: residency — predicted resident bytes vs the
+    # Watcher allocations this workflow actually made once training
+    # realized every lazy buffer (within 10%; the Watcher ledger is
+    # what prof's digest reports as hbm)
+    watcher_after = dict(Watcher.bytes_by_category)
+    predicted_full = pred.replicated_bytes + pred.sharded_bytes
+    live_full = sum(max(0, watcher_after.get(cat, 0)
+                        - watcher_before.get(cat, 0))
+                    for cat in watcher_after)
+    assert live_full > 0
+    assert abs(predicted_full - live_full) <= 0.1 * live_full, \
+        (predicted_full, live_full)
+
+
+def test_param_rules_knob_spelling():
+    saved = root.common.engine.pod.get("param_rules")
+    root.common.engine.pod.param_rules = "auto"
+    try:
+        wf = DummyWorkflow()
+        wf.loader = None
+        pod = PodRuntime.__new__(PodRuntime)
+        # only exercise the knob read: construct against the smoke
+        # mesh with a throwaway workflow
+        mesh = mesh_from_topology("auto")
+        pod.__init__(wf, mesh=mesh)
+        assert pod.param_rules == "auto"
+    finally:
+        root.common.engine.pod.param_rules = saved
+
+
+def test_param_rules_rejects_unknown_mode():
+    wf = make_workflow()
+    pod = PodRuntime(wf, mesh=mesh_from_topology("auto"),
+                     param_rules="zebra")
+    from veles_tpu.pod import PodError
+    with pytest.raises(PodError):
+        pod.install()

@@ -13,6 +13,8 @@ Examples::
     python -m veles_tpu.analyze snapshots/mnist_best.4.pickle --json
     python -m veles_tpu.analyze --lint            # self-lint veles_tpu/
     python -m veles_tpu.analyze --rules           # print the catalog
+    python -m veles_tpu.analyze --plan veles_tpu.samples.mnist \
+        --topology auto                           # ranked plan table
 """
 
 import argparse
@@ -36,6 +38,26 @@ def make_parser():
         help="run the lint pack over PATH(s); no PATH means the "
              "installed veles_tpu package (self-lint)")
     parser.add_argument(
+        "--plan", action="store_true",
+        help="run the static sharding planner over the target: "
+             "enumerate dp/fsdp/tp/dp×tp/pp candidates for "
+             "--topology, price each (per-shard HBM by category + "
+             "collective bytes + pipeline bubble), print the ranked "
+             "table; exits non-zero when NO candidate is feasible "
+             "(V-P03/V-P04/V-P05)")
+    parser.add_argument(
+        "--topology", default="auto", metavar="auto|N|DxM",
+        help="device topology to plan for: 'auto' (the attached "
+             "devices), a device count N (planner picks the "
+             "factorization), or pinned axes like 4x2 "
+             "(data=4, model=2; a 3rd factor pins pipe)")
+    parser.add_argument(
+        "--fail-on", choices=("warn", "error"), default=None,
+        help="exit-code policy: 'error' gates on error findings "
+             "only; 'warn' gates on warnings too (lint findings are "
+             "warnings).  Default: errors, plus any lint finding "
+             "when --lint is given (self-clean gate)")
+    parser.add_argument(
         "--sample-shape", default=None, metavar="D1,D2,...",
         help="input sample shape override for shape propagation")
     parser.add_argument(
@@ -48,6 +70,11 @@ def make_parser():
     parser.add_argument(
         "--rules", action="store_true",
         help="print the rule catalog and exit")
+    parser.add_argument(
+        "--knobs", action="store_true",
+        help="print the root.common.* knob-index table (generated "
+             "from the V-L05 registry; docs/knobs.md is this output) "
+             "and exit")
     return parser
 
 
@@ -91,6 +118,49 @@ def build_workflow(target):
         "run(load, main)" % target)
 
 
+def _plan_target(args):
+    """``--plan``: module with ``param_shapes`` → the zero-alloc
+    params-pytree path; anything else → build + initialize the
+    workflow (the planner prices stitched-segment Vectors)."""
+    from veles_tpu.analyze import plan as plan_mod
+    module = None
+    if not (os.path.exists(args.target)
+            and not args.target.endswith(".py")):
+        module = _load_module(args.target)
+    if module is not None and hasattr(module, "param_shapes"):
+        cfg = dict(getattr(module, "CONFIG", None) or {})
+        params = module.param_shapes(cfg)
+        batch = int(args.batch_size or 8)
+        seq = int(cfg.get("seq_len", 1) or 1)
+        dim = int(cfg.get("dim", 1) or 1)
+        spec_fn = getattr(module, "param_specs", None)
+        return plan_mod.plan_params(
+            params, topology=args.topology,
+            batch_bytes=batch * seq * 4,
+            activation_bytes=batch * seq * dim * 4,
+            param_spec_fn=spec_fn)
+    workflow = build_workflow(args.target)
+    if not getattr(workflow, "_stitch_segments_", None):
+        from veles_tpu.backends import AutoDevice
+        from veles_tpu.dummy import DummyLauncher
+        if getattr(workflow, "launcher", None) is None:
+            workflow.launcher = DummyLauncher()
+        workflow.initialize(device=AutoDevice())
+    return plan_mod.plan_workflow(workflow, topology=args.topology,
+                                  batch_size=args.batch_size)
+
+
+def _gate(report, fail_on, lint_findings=()):
+    """Exit-code policy: default = errors + the --lint self-clean
+    rule; --fail-on narrows/widens it explicitly."""
+    if fail_on == "warn":
+        return any(f.severity in ("error", "warning")
+                   for f in report.findings) or bool(lint_findings)
+    if fail_on == "error":
+        return report.has_errors
+    return report.has_errors or bool(lint_findings)
+
+
 def main(argv=None):
     from veles_tpu.analyze import (
         Report, analyze_workflow, lint_paths, rule_catalog)
@@ -100,6 +170,22 @@ def main(argv=None):
                 rule_catalog().items()):
             print("%-6s %-8s %s" % (rule_id, severity, desc))
         return 0
+    if args.knobs:
+        from veles_tpu.analyze.knobs import render_knob_table
+        print(render_knob_table())
+        return 0
+    if args.plan:
+        if args.target is None:
+            print("error: --plan needs a workflow/module target",
+                  file=sys.stderr)
+            return 2
+        result = _plan_target(args)
+        if args.json:
+            import json
+            print(json.dumps(result.to_dict(), indent=2))
+        else:
+            print(result.render_table())
+        return 1 if _gate(result.report, args.fail_on) else 0
     if args.target is None and args.lint is None:
         make_parser().print_usage(sys.stderr)
         print("error: give a workflow target and/or --lint",
@@ -122,9 +208,10 @@ def main(argv=None):
         report.extend(lint_findings)
 
     print(report.to_json() if args.json else report.render_text())
-    # --lint is a gate: ANY lint finding is dirty (the rules are
-    # warning-severity by design, but "self-clean" means zero)
-    return 1 if report.has_errors or lint_findings else 0
+    # default --lint gate: ANY lint finding is dirty (the rules are
+    # warning-severity by design, but "self-clean" means zero);
+    # --fail-on overrides the policy explicitly
+    return 1 if _gate(report, args.fail_on, lint_findings) else 0
 
 
 if __name__ == "__main__":

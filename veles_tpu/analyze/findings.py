@@ -124,8 +124,8 @@ class Report(object):
 def rule_catalog():
     """The full rule catalog: ``{rule_id: (severity, description)}``,
     aggregated from every pass module (docs/analyze.md mirrors this)."""
-    from veles_tpu.analyze import graph, lint, shapes
+    from veles_tpu.analyze import graph, lint, plan, shapes
     catalog = {}
-    for mod in (graph, shapes, lint):
+    for mod in (graph, shapes, plan, lint):
         catalog.update(mod.RULES)
     return catalog

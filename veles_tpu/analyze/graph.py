@@ -62,6 +62,18 @@ def _reachable(start):
     return seen
 
 
+def unreachable_units(start, units, exclude=()):
+    """Units not reachable from ``start`` over control edges, minus
+    ``exclude`` — THE V-G02 detection, shared by the analyzer pass and
+    ``Workflow.units_in_dependency_order``'s one-time warning (the two
+    used to disagree on an appended-but-excluded end_point)."""
+    reachable = _reachable(start)
+    skip = set(id(u) for u in exclude)
+    skip.add(id(start))
+    return [u for u in units
+            if id(u) not in reachable and id(u) not in skip]
+
+
 def _sccs(units):
     """Tarjan SCCs over ``links_to``, iterative (units may form long
     chains; no recursion-limit surprises on generated graphs)."""
@@ -154,9 +166,7 @@ def check_graph(workflow):
 
     # V-G02 — unreachable units (the silent append in
     # units_in_dependency_order, workflow.py).
-    unreachable = [u for u in units
-                   if id(u) not in reachable and u is not start
-                   and u is not end]
+    unreachable = unreachable_units(start, units, exclude=(end,))
     for unit in unreachable:
         findings.append(Finding(
             *_rule("V-G02"),

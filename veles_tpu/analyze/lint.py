@@ -19,6 +19,9 @@ scheduling/state contracts:
 * ``V-L04`` — mutating ``links_from``/``links_to`` outside the link
   API (``link_from``/``unlink_from``/``unlink_all``/``reset_gate``/
   ``open_gate``): gate-consistency is an invariant of those methods.
+* ``V-L05`` — reading a ``root.common.*`` knob no module declares in
+  the knob registry (:mod:`veles_tpu.analyze.knobs`): the config tree
+  auto-vivifies, so a typo'd path silently reads an empty node.
 
 A finding on a line containing ``analyze: ignore`` (optionally
 ``analyze: ignore[V-Lxx]``) is suppressed.
@@ -31,6 +34,7 @@ for local use.
 import ast
 import os
 
+from veles_tpu.analyze import knobs as _knobs
 from veles_tpu.analyze.findings import Finding
 
 RULES = {
@@ -53,6 +57,9 @@ RULES = {
               "mutating links_from/links_to outside the link API "
               "breaks gate-reset invariants"),
 }
+# V-L05 lives with its registry (analyze/knobs.py); merged here so
+# _rule()/rule_catalog() see one lint-pack rule set
+RULES.update(_knobs.RULES)
 
 #: dotted call names that block the calling thread
 _BLOCKING_CALLS = {
@@ -314,6 +321,20 @@ class _LinkMutationVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _check_knob_reads(findings, index):
+    """V-L05: every maximal ``root.common.…`` read chain must be
+    covered by the knob registry (bidirectional prefix match)."""
+    for node, path in _knobs.iter_knob_reads(index.tree):
+        if not _knobs.declared(path):
+            _emit(findings, index, "V-L05", node,
+                  "read of undeclared knob %s — no registry entry "
+                  "covers it (the config tree auto-vivifies, so a "
+                  "typo'd path silently reads an empty node)" % path,
+                  fix="declare it in veles_tpu/analyze/knobs"
+                      ".KNOB_REGISTRY with a one-line description "
+                      "(docs/knobs.md is generated from the registry)")
+
+
 def _iter_py_files(paths):
     for path in paths:
         if os.path.isfile(path):
@@ -354,6 +375,7 @@ def lint_paths(paths=None):
         _check_blocking_run(findings, index, unit_like)
         _check_private_access(findings, index)
         _check_gate_literal(findings, index)
+        _check_knob_reads(findings, index)
         _LinkMutationVisitor(findings, index).visit(index.tree)
     findings.sort(key=lambda f: (f.location or "", f.rule))
     return findings

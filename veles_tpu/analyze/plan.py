@@ -1,0 +1,776 @@
+"""The static sharding planner — GSPMD/Alpa-style compile-time plan
+search over the pricing core.
+
+Given an initialized workflow (its stitched segments' Vectors are the
+probes) or a params pytree (``jax.ShapeDtypeStruct`` leaves — the LM
+path) and a device topology, enumerate candidate parallelism plans —
+
+* **dp** — batch on ``data``, params replicated (the pod default);
+* **fsdp** — dp + :func:`veles_tpu.parallel.dp.fsdp_rules` (ZeRO-3
+  storage: params/solver state sharded over ``data``);
+* **tp / dp×tp** — :func:`~veles_tpu.parallel.dp.tp_rules` (or the
+  module's own Megatron ``param_specs``) over a ``model`` axis, for
+  every factorization of the device count;
+* **pp skeletons** — stage-sharded pipeline layouts (params split over
+  a ``pipe`` axis, GPipe bubble term priced in).  Skeletons are
+  memory-plans only (the runtime cannot install them yet — ROADMAP
+  item 1), so they rank below fully-priced plans unless nothing else
+  fits the HBM budget.
+
+— price each one through :mod:`veles_tpu.analyze.pricing` (per-shard
+residency by category, ring all-reduce/all-gather bytes, bubble
+fraction), reject infeasible ones with typed findings, and emit a
+ranked plan table.
+
+Finding IDs (the :func:`~veles_tpu.analyze.findings.rule_catalog`
+rows):
+
+* **V-P03** — a candidate's batch/axis arithmetic does not divide
+  (global batch vs data shards, stages vs layers, or a model axis
+  that shards no parameter leaf);
+* **V-P04** — EVERY candidate exceeds the HBM budget; the finding
+  names the smallest fix (the best candidate and the device count at
+  which it would fit, or the structural remedy when no count fits);
+* **V-P05** — ``param_rules`` returns a spec that shards a
+  non-divisible parameter dim (the install would pad or reject; a
+  recipe never does this, a hand-written rule can).
+
+Ranking is analytic and deterministic: feasible-and-fits first,
+non-skeletons before pp skeletons, then ascending estimated per-step
+collective traffic (``psum + gathers + bubble × step-traffic proxy``),
+then fewer mesh axes.  Entry points: ``python -m veles_tpu.analyze
+--plan <module> --topology auto|N|DxM [--json]`` and
+``PodRuntime(param_rules="auto")`` (:func:`auto_param_rules` adopts
+the winner for the runtime's real mesh).
+"""
+
+import numpy
+
+from veles_tpu.analyze import pricing
+from veles_tpu.analyze.findings import Finding, Report
+
+RULES = {
+    "V-P03": ("error",
+              "plan candidate infeasible: the global batch, a mesh "
+              "axis, or the stage count does not divide (or a model "
+              "axis shards no parameter leaf)"),
+    "V-P04": ("error",
+              "every candidate plan exceeds the HBM budget — the "
+              "finding names the smallest fix (candidate + device "
+              "count, or the structural remedy)"),
+    "V-P05": ("error",
+              "param_rules shards a non-divisible parameter dim — the "
+              "spec would pad or reject at install time"),
+}
+
+#: microbatches a pp skeleton assumes per stage (the GPipe m=4s
+#: guideline: bubble (s-1)/(m+s-1) ≈ 20 %)
+PP_MICRO_PER_STAGE = 4
+
+
+def _rule(rule_id):
+    severity, _desc = RULES[rule_id]
+    return severity, rule_id
+
+
+class Candidate(object):
+    """One priced plan: mesh axes + param-sharding rule + estimates."""
+
+    __slots__ = ("name", "axes", "rule_desc", "param_rules",
+                 "skeleton", "feasible", "fits", "per_shard_bytes",
+                 "by_category", "psum_bytes", "gather_bytes", "bubble",
+                 "findings", "notes")
+
+    def __init__(self, name, axes, rule_desc, param_rules=None,
+                 skeleton=False):
+        self.name = name
+        self.axes = dict(axes)
+        self.rule_desc = rule_desc
+        self.param_rules = param_rules
+        self.skeleton = skeleton
+        self.feasible = True
+        self.fits = True
+        self.per_shard_bytes = 0
+        self.by_category = {}
+        self.psum_bytes = 0
+        self.gather_bytes = 0
+        self.bubble = 0.0
+        self.findings = []
+        self.notes = []
+
+    @property
+    def devices(self):
+        return int(numpy.prod([max(1, s) for s in self.axes.values()],
+                              initial=1))
+
+    @property
+    def collective_bytes(self):
+        return int(self.psum_bytes + self.gather_bytes)
+
+    def reject(self, rule_id, message, fix=None):
+        self.feasible = False
+        self.findings.append(Finding(
+            *_rule(rule_id), message="plan %s: %s" % (self.name,
+                                                      message),
+            fix=fix))
+
+    def sort_key(self, step_traffic):
+        return (not (self.feasible and self.fits), self.skeleton,
+                int(self.collective_bytes
+                    + self.bubble * step_traffic),
+                len([s for s in self.axes.values() if s > 1]))
+
+    def to_dict(self):
+        return {
+            "name": self.name,
+            "axes": self.axes,
+            "rule": self.rule_desc,
+            "skeleton": self.skeleton,
+            "feasible": self.feasible,
+            "fits_budget": self.fits,
+            "per_shard_bytes": int(self.per_shard_bytes),
+            "by_category": {k: int(v) for k, v
+                            in sorted(self.by_category.items())},
+            "psum_bytes_per_step": int(self.psum_bytes),
+            "gather_bytes_per_step": int(self.gather_bytes),
+            "bubble": round(self.bubble, 4),
+            "notes": list(self.notes),
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+
+class PlanResult(object):
+    """Ranked candidates + the (global) findings Report.
+
+    ``best`` is the top feasible-and-fitting candidate or ``None``;
+    the report carries findings only when the planner REJECTS overall
+    (no feasible candidate → the reasons; all over budget → V-P04),
+    so a table with a viable winner exits clean even though losing
+    candidates were rejected individually.
+    """
+
+    def __init__(self, candidates, report, budget, hbm_bytes, batch,
+                 topology):
+        self.candidates = candidates
+        self.report = report
+        self.budget = budget
+        self.hbm_bytes = hbm_bytes
+        self.batch = batch
+        self.topology = topology
+
+    @property
+    def best(self):
+        for cand in self.candidates:
+            if cand.feasible and cand.fits:
+                return cand
+        return None
+
+    def to_dict(self):
+        return {
+            "topology": self.topology,
+            "batch": self.batch,
+            "hbm_bytes": self.hbm_bytes,
+            "budget_bytes": int(self.budget) if self.budget else None,
+            "best": self.best.name if self.best else None,
+            "candidates": [c.to_dict() for c in self.candidates],
+            "report": {
+                "counts": self.report.counts(),
+                "rules": self.report.rules(),
+                "findings": [f.to_dict() for f in self.report.sorted()],
+            },
+        }
+
+    def render_table(self):
+        from veles_tpu.prof.ledger import _fmt_bytes
+        lines = ["plan: %d candidate(s) for topology %r, batch %d%s"
+                 % (len(self.candidates), self.topology, self.batch,
+                    (", budget %s" % _fmt_bytes(int(self.budget)))
+                    if self.budget else " (no HBM budget: plan-sanity "
+                    "only)")]
+        header = ("  %-12s %-16s %-14s %10s %10s %10s %7s  %s"
+                  % ("plan", "axes", "rule", "hbm/shard", "psum/step",
+                     "gather", "bubble", "verdict"))
+        lines.append(header)
+        for rank, cand in enumerate(self.candidates):
+            axes = "x".join("%s=%d" % (k, v)
+                            for k, v in cand.axes.items())
+            verdict = ("#%d" % (rank + 1)) if cand.feasible \
+                and cand.fits else ("over-budget" if cand.feasible
+                                    else "infeasible")
+            notes = "; ".join(
+                cand.notes + [f.message for f in cand.findings])
+            lines.append(
+                "  %-12s %-16s %-14s %10s %10s %10s %6.1f%%  %s%s"
+                % (cand.name, axes, cand.rule_desc,
+                   _fmt_bytes(int(cand.per_shard_bytes)),
+                   _fmt_bytes(int(cand.psum_bytes)),
+                   _fmt_bytes(int(cand.gather_bytes)),
+                   100.0 * cand.bubble, verdict,
+                   (" — " + notes) if notes else ""))
+        best = self.best
+        if best is not None:
+            lines.append(
+                "plan: winner %s (%s) — adopt with PodRuntime("
+                "param_rules=\"auto\") or root.common.engine.pod."
+                "param_rules=auto" % (best.name, best.rule_desc))
+        else:
+            lines.append("plan: NO feasible candidate — see findings")
+        if len(self.report):
+            lines.append(self.report.render_text())
+        return "\n".join(lines)
+
+
+# -- topology / candidate enumeration ---------------------------------------
+
+def _resolve_axes(topology, devices=None):
+    """Topology spelling → (n_devices, explicit_axes | None).
+
+    ``auto``/None → the attached device count, planner free to
+    factorize; an int → that many devices, planner free; ``DxM`` or a
+    dict → the operator pinned the axes (wildcards resolved against
+    the attached devices).
+    """
+    from veles_tpu.parallel.mesh import _parse_topology
+    axes = _parse_topology(topology)
+    pinned = not (topology is None or (isinstance(topology, str)
+                  and topology.strip().lower() in ("", "auto"))
+                  or isinstance(topology, int)
+                  or (isinstance(topology, str)
+                      and topology.strip().isdigit()))
+    wild = [k for k, v in axes.items() if v == -1]
+    if wild or devices is None:
+        if devices is None:
+            import jax
+            devices = len(jax.devices())
+        fixed = 1
+        for k, v in axes.items():
+            if v != -1:
+                fixed *= v
+        for k in wild:
+            axes[k] = max(1, int(devices) // fixed)
+    n = 1
+    for v in axes.values():
+        n *= max(1, int(v))
+    return n, (axes if pinned else None)
+
+
+def _factorizations(n):
+    """(d, m) pairs with d·m = n, m > 1 — the dp×tp / dp×pp grid."""
+    out = []
+    for m in range(2, n + 1):
+        if n % m == 0:
+            out.append((n // m, m))
+    return out
+
+
+def enumerate_candidates(n_devices, explicit_axes=None,
+                         tp_recipe=None, fsdp_recipe=None):
+    """The candidate set for ``n`` devices (or the pinned axes).
+
+    ``tp_recipe(axes)`` / ``fsdp_recipe(axes)`` build the param rule
+    for a candidate's abstract axes — injected so the workflow path
+    uses the :mod:`veles_tpu.parallel.dp` recipes and the params path
+    its pytree twins.
+    """
+    cands = []
+    if explicit_axes is not None:
+        d = int(explicit_axes.get("data", 1))
+        m = int(explicit_axes.get("model", 1))
+        s = int(explicit_axes.get("pipe", 1))
+        if s > 1:
+            cands.append(Candidate("pp%d" % s, explicit_axes,
+                                   "pipe(stage)", skeleton=True))
+        elif m > 1:
+            cands.append(Candidate(
+                "dp%dxtp%d" % (d, m), explicit_axes, "tp(model)",
+                tp_recipe(explicit_axes) if tp_recipe else None))
+        else:
+            cands.append(Candidate("dp%d" % d, explicit_axes,
+                                   "replicated"))
+            cands.append(Candidate(
+                "fsdp%d" % d, explicit_axes, "fsdp(data)",
+                fsdp_recipe(explicit_axes) if fsdp_recipe else None))
+        return cands
+    n = int(n_devices)
+    cands.append(Candidate("dp%d" % n, {"data": n}, "replicated"))
+    if n > 1:
+        axes = {"data": n}
+        cands.append(Candidate(
+            "fsdp%d" % n, axes, "fsdp(data)",
+            fsdp_recipe(axes) if fsdp_recipe else None))
+        for d, m in _factorizations(n):
+            axes = {"data": d, "model": m}
+            cands.append(Candidate(
+                ("tp%d" % m) if d == 1 else "dp%dxtp%d" % (d, m),
+                axes, "tp(model)",
+                tp_recipe(axes) if tp_recipe else None))
+        for d, s in _factorizations(n):
+            axes = {"data": d, "pipe": s}
+            cands.append(Candidate(
+                ("pp%d" % s) if d == 1 else "dp%dxpp%d" % (d, s),
+                axes, "pipe(stage)", skeleton=True))
+    return cands
+
+
+# -- the workflow path -------------------------------------------------------
+
+def _param_vec_shapes(workflow, batch):
+    """Unique (shape, nbytes) of every donated/params Vector a
+    stitched segment touches — the V-P05 probe set."""
+    from veles_tpu.memory import Vector
+    seen = {}
+    for segment in getattr(workflow, "_stitch_segments_", ()):
+        don_ids = set(id(v) for v in segment._don_vecs)
+        for vec in (segment._input_vecs + segment._ro_vecs
+                    + segment._don_vecs + segment._output_vecs):
+            if not isinstance(vec, Vector) or id(vec) in seen:
+                continue
+            if id(vec) in don_ids \
+                    or getattr(vec, "category", None) == "params":
+                seen[id(vec)] = (tuple(vec.shape or ()),
+                                 int(vec.nbytes))
+    return list(seen.values())
+
+
+def _activation_bytes(workflow, batch):
+    """Per-step batch-led output bytes (the TP gather proxy)."""
+    from veles_tpu.memory import Vector
+    total = 0
+    seen = set()
+    for segment in getattr(workflow, "_stitch_segments_", ()):
+        for vec in segment._output_vecs:
+            if not isinstance(vec, Vector) or id(vec) in seen:
+                continue
+            seen.add(id(vec))
+            shape = vec.shape or ()
+            if shape and shape[0] == batch:
+                total += int(vec.nbytes)
+    return total
+
+
+def _check_rule_divisibility(cand, param_shapes):
+    """Walk the rule over every param shape: V-P05 when it emits a
+    non-divisible spec, else ``(n_sharded, sharded_bytes)`` — how many
+    leaves (and how many FULL bytes) the rule actually shards."""
+    if cand.param_rules is None:
+        return 0, 0
+    n_sharded = 0
+    sharded_bytes = 0
+    for shape, nbytes in param_shapes:
+        if not shape:
+            continue
+        spec = cand.param_rules(pricing.leaf_stub(shape, numpy.int8))
+        if spec is None:
+            continue
+        ok, dim, extent, size = pricing.spec_divisible(
+            shape, spec, cand.axes)
+        if not ok:
+            cand.reject(
+                "V-P05",
+                "param_rules shards dim %d of %r (%d) over %d-way "
+                "axes — %d %% %d != 0, install would pad or reject"
+                % (dim, shape, extent, size, extent, size),
+                fix="make the rule skip non-divisible dims (the "
+                    "tp_rules/fsdp_rules recipes do) or pick a "
+                    "dividing axis size")
+            return n_sharded, sharded_bytes
+        if pricing.shard_factor(spec, cand.axes) > 1:
+            n_sharded += 1
+            sharded_bytes += int(nbytes)
+    return n_sharded, sharded_bytes
+
+
+def plan_workflow(workflow, topology="auto", devices=None,
+                  hbm_bytes=None, data_axis="data", batch_size=None,
+                  optimizer=None):
+    """Enumerate + price + rank candidate plans for an initialized,
+    stitched workflow.  Returns a :class:`PlanResult`."""
+    from veles_tpu.parallel.dp import fsdp_rules, tp_rules
+
+    loader = getattr(workflow, "loader", None)
+    batch = int(batch_size
+                or getattr(loader, "max_minibatch_size", 0) or 0)
+    n, explicit = _resolve_axes(topology, devices=devices)
+    segments = list(getattr(workflow, "_stitch_segments_", ()))
+    findings = []
+    if not segments:
+        findings.append(Finding(
+            *_rule("V-P03"),
+            message="workflow has no stitched segments — the planner "
+                    "prices stitched-segment Vectors (initialize on a "
+                    "jit device with root.common.engine.stitch=on)",
+            fix="initialize the workflow before planning"))
+        return PlanResult([], Report(findings, passes=["plan"]),
+                          None, hbm_bytes, batch, topology)
+
+    def tp_recipe(axes):
+        return tp_rules(pricing.abstract_mesh(axes))
+
+    def fsdp_recipe(axes):
+        return fsdp_rules(pricing.abstract_mesh(axes))
+
+    cands = enumerate_candidates(n, explicit, tp_recipe=tp_recipe,
+                                 fsdp_recipe=fsdp_recipe)
+    param_shapes = _param_vec_shapes(workflow, batch)
+    act_bytes = _activation_bytes(workflow, batch)
+    params_total = sum(nb for _s, nb in param_shapes)
+    n_layers = len(getattr(workflow, "forwards", ()) or ())
+    hbm_bytes = pricing.resolve_device_hbm(hbm_bytes)
+    budget = pricing.hbm_budget(hbm_bytes)
+
+    for cand in cands:
+        d = int(cand.axes.get(data_axis, 1))
+        if batch and d > 1 and batch % d:
+            cand.reject(
+                "V-P03",
+                "global batch %d does not divide over %d data "
+                "shard(s) (remainder %d)" % (batch, d, batch % d),
+                fix="pick a minibatch_size that is a multiple of the "
+                    "data axis (or a different factorization)")
+        n_sharded, sharded_param_bytes = _check_rule_divisibility(
+            cand, param_shapes)
+        model = int(cand.axes.get("model", 1))
+        if cand.feasible and model > 1 and not n_sharded:
+            cand.reject(
+                "V-P03",
+                "model axis %d shards no parameter leaf (every last "
+                "dim indivisible or below min_elements) — the axis "
+                "would replicate compute %d-fold" % (model, model),
+                fix="pick a model axis that divides a weight dim, or "
+                    "drop the tp candidate")
+        stages = int(cand.axes.get("pipe", 1))
+        if cand.feasible and stages > 1:
+            if n_layers and stages > n_layers:
+                cand.reject(
+                    "V-P03",
+                    "%d pipeline stage(s) exceed the %d forward "
+                    "layer(s) — a stage would own no layer"
+                    % (stages, n_layers),
+                    fix="cap the pipe axis at the layer count")
+            else:
+                cand.bubble = pricing.pipeline_bubble(
+                    stages, PP_MICRO_PER_STAGE * stages)
+                cand.notes.append(
+                    "skeleton: params/stage only, m=%d microbatches"
+                    % (PP_MICRO_PER_STAGE * stages))
+        if not cand.feasible:
+            continue
+        res = pricing.pod_residency(workflow, cand.axes, batch,
+                                    data_axis=data_axis,
+                                    param_rules=cand.param_rules)
+        per_shard = res.true_per_shard_bytes
+        by_cat = dict(res.by_category)
+        if stages > 1:
+            # stage-sharded params: each stage owns 1/stages of the
+            # replicated parameter set (the skeleton's memory claim)
+            saved = by_cat.get("params", 0) * (1.0 - 1.0 / stages)
+            by_cat["params"] = by_cat.get("params", 0) / stages
+            per_shard -= saved
+        cand.per_shard_bytes = per_shard
+        cand.by_category = by_cat
+        cand.psum_bytes = res.psum_bytes
+        if cand.rule_desc == "fsdp(data)" and n_sharded:
+            # FSDP re-materializes every sharded param per step:
+            # all-gather forward + the gradient's reduce-scatter ≈
+            # 2 × ring all-gather of the sharded bytes
+            cand.gather_bytes = 2 * pricing.ring_all_gather_bytes(
+                sharded_param_bytes, d)
+        if model > 1 and n_sharded:
+            # TP re-assembles activations at the sharded boundaries
+            cand.gather_bytes += 2 * pricing.ring_all_gather_bytes(
+                act_bytes, model)
+        if budget is not None and per_shard > budget:
+            cand.fits = False
+            cand.notes.append(
+                "per-shard %.2f GiB > budget %.2f GiB"
+                % (per_shard / 2 ** 30, budget / 2 ** 30))
+
+    step_traffic = 2 * params_total + act_bytes
+    cands.sort(key=lambda c: c.sort_key(step_traffic))
+    report = _global_findings(cands, budget, findings)
+    return PlanResult(cands, report, budget, hbm_bytes, batch,
+                      topology)
+
+
+def _global_findings(cands, budget, findings):
+    """The planner's overall verdict: clean when a winner exists,
+    else the rejection reasons (V-P03/V-P05) or V-P04."""
+    if any(c.feasible and c.fits for c in cands):
+        return Report(findings, passes=["plan"])
+    feasible = [c for c in cands if c.feasible]
+    if feasible and budget is not None:
+        best = min(feasible, key=lambda c: c.per_shard_bytes)
+        findings.append(Finding(
+            *_rule("V-P04"),
+            message="every candidate exceeds the HBM budget (best: "
+                    "%s at %.2f GiB/shard vs %.2f GiB) — smallest "
+                    "fix: %s"
+                    % (best.name, best.per_shard_bytes / 2 ** 30,
+                       budget / 2 ** 30, _smallest_fix(best, budget)),
+            fix=_smallest_fix(best, budget)))
+    else:
+        for cand in cands:
+            findings.extend(cand.findings)
+        if not cands:
+            findings.append(Finding(
+                *_rule("V-P03"),
+                message="no candidate plans could be enumerated for "
+                        "this topology",
+                fix="check the topology spelling (auto | N | DxM)"))
+    return Report(findings, passes=["plan"])
+
+
+def _smallest_fix(best, budget):
+    """Name the cheapest single change that makes ``best`` fit: for a
+    replicated plan whose params alone bust the budget, shard them;
+    otherwise the device count at which the sharded bytes amortize
+    under the budget; else the structural remedy."""
+    params = best.by_category.get("params", 0)
+    sharded_total = (best.per_shard_bytes - params) * best.devices
+    if best.rule_desc == "replicated":
+        if params > budget:
+            return ("shard params (param_rules=dp.fsdp_rules(mesh)): "
+                    "replicated params alone exceed the budget")
+        fixed, scaling = params, sharded_total
+    else:
+        fixed, scaling = 0, best.per_shard_bytes * best.devices
+    n = best.devices
+    while n <= 65536:
+        if fixed + scaling / n <= budget:
+            return "%s at %d devices fits" % (best.name, n)
+        n *= 2
+    return ("shrink the resident dataset / model or raise HBM — no "
+            "device count amortizes the replicated bytes")
+
+
+# -- the params-pytree (LM) path --------------------------------------------
+
+def plan_params(params, topology="auto", devices=None, batch_bytes=0,
+                optimizer_slots=1, hbm_bytes=None,
+                activation_bytes=0, param_spec_fn=None,
+                min_elements=1024):
+    """Plan over a params pytree (``ShapeDtypeStruct`` or array
+    leaves — the transformer/LM path, zero allocation).
+
+    ``optimizer_slots`` prices the solver state (1 = SGD momentum);
+    ``batch_bytes``/``activation_bytes`` price the dataset shard and
+    the TP gather proxy; ``param_spec_fn(params) -> spec pytree``
+    overrides the generic last-dim tp rule with the module's own
+    Megatron specs (:func:`veles_tpu.samples.transformer
+    .param_specs`).
+    """
+    import jax
+
+    leaves = [leaf for leaf in jax.tree.leaves(params)
+              if hasattr(leaf, "shape")]
+    shapes = [(tuple(leaf.shape), pricing.leaf_nbytes(leaf))
+              for leaf in leaves]
+    params_total = sum(nb for _s, nb in shapes)
+    n, explicit = _resolve_axes(topology, devices=devices)
+    hbm_bytes = pricing.resolve_device_hbm(hbm_bytes)
+    budget = pricing.hbm_budget(hbm_bytes)
+
+    spec_leaves = None
+    if param_spec_fn is not None:
+        from jax.sharding import PartitionSpec as P
+        spec_tree = param_spec_fn(params)
+        spec_leaves = jax.tree.leaves(
+            spec_tree, is_leaf=lambda x: isinstance(x, P))
+
+    def tp_recipe(axes):
+        if spec_leaves is not None:
+            # per-leaf module specs are applied positionally in the
+            # pricing loop below, not through a leaf callable
+            return "module-specs"
+        from veles_tpu.parallel.dp import tp_rules
+        return tp_rules(pricing.abstract_mesh(axes),
+                        min_elements=min_elements)
+
+    def fsdp_recipe(axes):
+        from veles_tpu.parallel.dp import fsdp_rules
+        return fsdp_rules(pricing.abstract_mesh(axes),
+                          min_elements=min_elements)
+
+    cands = enumerate_candidates(n, explicit, tp_recipe=tp_recipe,
+                                 fsdp_recipe=fsdp_recipe)
+    slots = 1 + max(0, int(optimizer_slots))
+
+    for cand in cands:
+        d = int(cand.axes.get("data", 1))
+        model = int(cand.axes.get("model", 1))
+        stages = int(cand.axes.get("pipe", 1))
+        # the LM batch divides by construction (tokens are resharded
+        # per step); stage-sharding needs a divisible leading axis
+        replicated = 0
+        sharded_per_shard = 0
+        sharded_total = 0
+        n_sharded = 0
+        for i, (shape, nbytes) in enumerate(shapes):
+            spec = None
+            if cand.param_rules == "module-specs":
+                spec = spec_leaves[i] if i < len(spec_leaves) else None
+                if spec is not None and not tuple(spec):
+                    spec = None
+                if spec is not None:
+                    # module specs name mesh axes symbolically; check
+                    # divisibility against this candidate's sizes
+                    ok, dim, extent, size = pricing.spec_divisible(
+                        shape, spec, cand.axes)
+                    if not ok:
+                        spec = None    # replicate what cannot shard
+            elif callable(cand.param_rules):
+                spec = cand.param_rules(pricing.leaf_stub(
+                    shape, numpy.int8))
+                if spec is not None:
+                    ok, dim, extent, size = pricing.spec_divisible(
+                        shape, spec, cand.axes)
+                    if not ok:
+                        cand.reject(
+                            "V-P05",
+                            "param_rules shards dim %d of %r (%d) "
+                            "over %d — %d %% %d != 0"
+                            % (dim, shape, extent, size, extent,
+                               size),
+                            fix="make the rule skip non-divisible "
+                                "dims")
+                        break
+            elif stages > 1 and len(shape) >= 2 \
+                    and shape[0] % stages == 0 \
+                    and int(numpy.prod(shape)) >= min_elements:
+                spec = ("pipe",)    # stage-sharded leading axis
+            factor = pricing.shard_factor(spec, cand.axes) \
+                if spec else 1
+            if factor > 1:
+                n_sharded += 1
+                sharded_total += nbytes * slots
+                sharded_per_shard += nbytes * slots / factor
+            else:
+                replicated += nbytes * slots
+        if not cand.feasible:
+            continue
+        if model > 1 and not n_sharded:
+            cand.reject(
+                "V-P03",
+                "model axis %d shards no parameter leaf" % model,
+                fix="pick a model axis that divides a weight dim")
+            continue
+        if stages > 1:
+            if not n_sharded:
+                cand.reject(
+                    "V-P03",
+                    "%d pipeline stage(s): no leaf has a leading dim "
+                    "divisible by the stage count" % stages,
+                    fix="stack the blocks on a leading layer axis "
+                        "divisible by pipe")
+                continue
+            cand.bubble = pricing.pipeline_bubble(
+                stages, PP_MICRO_PER_STAGE * stages)
+            cand.notes.append(
+                "skeleton: m=%d microbatches"
+                % (PP_MICRO_PER_STAGE * stages))
+        per_shard = (replicated + sharded_per_shard
+                     + float(batch_bytes) / max(1, d))
+        cand.per_shard_bytes = per_shard
+        cand.by_category = {
+            "params": (replicated + sharded_per_shard) / slots,
+            "optimizer": (replicated + sharded_per_shard)
+            * (slots - 1) / slots,
+            "dataset": float(batch_bytes) / max(1, d),
+        }
+        # grads of replicated params all-reduce over the data axis
+        cand.psum_bytes = pricing.ring_all_reduce_bytes(
+            replicated / slots, d)
+        if cand.rule_desc == "fsdp(data)" and n_sharded:
+            cand.gather_bytes = 2 * pricing.ring_all_gather_bytes(
+                sharded_total / slots, d)
+        if model > 1 and n_sharded:
+            cand.gather_bytes += 2 * pricing.ring_all_gather_bytes(
+                activation_bytes, model)
+        if budget is not None and per_shard > budget:
+            cand.fits = False
+            cand.notes.append(
+                "per-shard %.2f GiB > budget %.2f GiB"
+                % (per_shard / 2 ** 30, budget / 2 ** 30))
+
+    step_traffic = 2 * params_total + activation_bytes
+    cands.sort(key=lambda c: c.sort_key(step_traffic))
+    report = _global_findings(cands, budget, [])
+    return PlanResult(cands, report, budget, hbm_bytes,
+                      int(batch_bytes), topology)
+
+
+# -- the runtime adapter -----------------------------------------------------
+
+def auto_param_rules(workflow, mesh, data_axis="data",
+                     hbm_bytes=None):
+    """Pick the param-sharding rule for a REAL mesh —
+    ``PodRuntime(param_rules="auto")``'s selector.
+
+    Candidates are the rule choices over the runtime's fixed axes
+    (replicated / fsdp over ``data`` / tp over ``model`` when the
+    mesh has one >1), priced and ranked exactly like
+    :func:`plan_workflow`.  Returns ``(rules_callable_or_None,
+    name, candidate_dict)``; replication wins ties so a fitting pod
+    keeps the seed behavior bit-for-bit.
+    """
+    from veles_tpu.parallel.dp import fsdp_rules, tp_rules
+
+    axes = dict(mesh.shape)
+    batch = int(getattr(getattr(workflow, "loader", None),
+                        "max_minibatch_size", 0) or 0)
+    cands = [Candidate("dp%d" % axes.get(data_axis, 1), axes,
+                       "replicated")]
+    if int(axes.get(data_axis, 1)) > 1:
+        cands.append(Candidate("fsdp", axes, "fsdp(data)",
+                               fsdp_rules(mesh, axis=data_axis)))
+    if int(axes.get("model", 1)) > 1:
+        cands.append(Candidate("tp", axes, "tp(model)",
+                               tp_rules(mesh)))
+    param_shapes = _param_vec_shapes(workflow, batch)
+    act_bytes = _activation_bytes(workflow, batch)
+    params_total = sum(nb for _s, nb in param_shapes)
+    hbm_bytes = pricing.resolve_device_hbm(hbm_bytes)
+    budget = pricing.hbm_budget(hbm_bytes)
+    for cand in cands:
+        d = int(axes.get(data_axis, 1))
+        n_sharded, sharded_param_bytes = _check_rule_divisibility(
+            cand, param_shapes)
+        if not cand.feasible:
+            continue
+        res = pricing.pod_residency(workflow, axes, batch,
+                                    data_axis=data_axis,
+                                    param_rules=cand.param_rules)
+        cand.per_shard_bytes = res.true_per_shard_bytes
+        cand.by_category = dict(res.by_category)
+        cand.psum_bytes = res.psum_bytes
+        if cand.rule_desc == "fsdp(data)" and n_sharded:
+            cand.gather_bytes = 2 * pricing.ring_all_gather_bytes(
+                sharded_param_bytes, d)
+        if cand.rule_desc == "tp(model)" and n_sharded:
+            cand.gather_bytes = 2 * pricing.ring_all_gather_bytes(
+                act_bytes, int(axes.get("model", 1)))
+        if budget is not None \
+                and cand.per_shard_bytes > budget:
+            cand.fits = False
+    step_traffic = 2 * params_total + act_bytes
+    # stable sort: the replicated candidate is enumerated first and
+    # wins ties, keeping a fitting pod on the seed (bitwise) path
+    cands.sort(key=lambda c: c.sort_key(step_traffic))
+    winner = next((c for c in cands if c.feasible and c.fits),
+                  cands[0] if cands else None)
+    if winner is None:
+        return None, "replicated", {}
+    return winner.param_rules, winner.name, winner.to_dict()
+
+
+def predicted_estimates(workflow, mesh, data_axis="data",
+                        param_rules=None):
+    """The planner's (residency, psum) prediction for an installed
+    mesh — what the planner-vs-ledger gate compares against the live
+    prof ledger."""
+    batch = int(getattr(getattr(workflow, "loader", None),
+                        "max_minibatch_size", 0) or 0)
+    rules = None if isinstance(param_rules, str) else param_rules
+    return pricing.pod_residency(workflow, dict(mesh.shape), batch,
+                                 data_axis=data_axis,
+                                 param_rules=rules)

@@ -1,0 +1,312 @@
+"""THE pricing core — one set of HBM-residency and collective-traffic
+formulas shared by every static estimate in the platform.
+
+Before this module the per-shard residency arithmetic lived twice
+(V-P02 pod preflight and V-S01 serving preflight, both in
+:mod:`~veles_tpu.analyze.shapes`) and the ring all-reduce byte model a
+third time (:meth:`veles_tpu.pod.runtime.PodRuntime
+._segment_psum_estimate`).  Three copies of "what fits / what moves"
+can silently drift; this module is the single owner:
+
+* **budget** — :func:`hbm_budget`: 90 % of device HBM
+  (:data:`HEADROOM`), the one headroom rule training and serving
+  preflights share (``None`` HBM — CPU/unknown device — degrades every
+  consumer to plan-sanity only);
+* **bytes** — :func:`leaf_nbytes` / :func:`params_nbytes`: pytree
+  leaves priced at their ACTUAL width (an int8-quantized deploy counts
+  one byte per element plus its scales, never an assumed float);
+* **residency** — :func:`pod_residency`: per-shard HBM bytes by
+  category (params / optimizer state / dataset shards / staging)
+  classified through the shared
+  :func:`veles_tpu.pod.runtime.spec_for_vector` rule, so the estimate
+  prices exactly the plan ``PodRuntime.install()`` would apply;
+* **collectives** — :func:`ring_all_reduce_bytes` /
+  :func:`ring_all_gather_bytes` / :func:`pipeline_bubble`: the
+  analytic ring formulas the prof ledger's ``psum_bytes`` column
+  already carries (XLA's cost model does not expose collective
+  traffic) plus the GPipe bubble term the pp plan skeletons price
+  with.
+
+Everything here is pure host arithmetic — no device work, no compiles.
+The static planner (:mod:`~veles_tpu.analyze.plan`) prices every
+candidate through these functions and nothing else.
+"""
+
+import numpy
+
+#: The one headroom rule: plans may spend 90 % of HBM; the rest is
+#: runtime scratch (XLA temp buffers, infeed, collectives staging).
+HEADROOM = 0.9
+
+
+def resolve_device_hbm(hbm_bytes=None):
+    """``hbm_bytes`` override, else the live device table
+    (:func:`veles_tpu.backends.device_hbm_bytes` for
+    :func:`veles_tpu.prof.device_kind`); ``None`` for CPU/unknown."""
+    if hbm_bytes is not None:
+        return hbm_bytes
+    from veles_tpu.backends import device_hbm_bytes
+    from veles_tpu.prof import device_kind
+    return device_hbm_bytes(device_kind())
+
+
+def hbm_budget(hbm_bytes):
+    """The shared budget rule: ``HEADROOM × hbm_bytes``, or ``None``
+    when the device's HBM is unknown (plan-sanity-only mode)."""
+    if not hbm_bytes:
+        return None
+    return HEADROOM * float(hbm_bytes)
+
+
+def leaf_nbytes(leaf):
+    """Actual bytes of one pytree leaf (0 for non-arrays)."""
+    try:
+        return int(leaf.size) * int(leaf.dtype.itemsize)
+    except AttributeError:
+        return 0
+
+
+def params_nbytes(tree):
+    """Total actual bytes of a params pytree — the V-S01 params term:
+    quantized leaves count at their real width."""
+    import jax
+    return sum(leaf_nbytes(leaf) for leaf in jax.tree.leaves(tree)
+               if hasattr(leaf, "size"))
+
+
+def shard_factor(spec, axes):
+    """How many ways a PartitionSpec splits a buffer over ``axes``
+    (``{axis: size}``): the product of the named axes' sizes.  Entries
+    may be axis names or tuples of axis names (GSPMD spelling)."""
+    factor = 1
+    for entry in tuple(spec or ()):
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            if name is not None:
+                factor *= int(axes.get(name, 1))
+    return max(1, factor)
+
+
+def spec_divisible(shape, spec, axes):
+    """``(ok, dim, extent, size)`` — whether every sharded dim of
+    ``shape`` divides by its axes' size product (the V-P05 check: a
+    rule that shards a non-divisible dim would pad or reject at
+    install, never at preflight)."""
+    for dim, entry in enumerate(tuple(spec or ())):
+        if entry is None or dim >= len(shape):
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        size = 1
+        for name in names:
+            if name is not None:
+                size *= int(axes.get(name, 1))
+        if size > 1 and int(shape[dim]) % size:
+            return False, dim, int(shape[dim]), size
+    return True, None, None, None
+
+
+class Residency(object):
+    """Per-shard HBM residency of one plan, by category.
+
+    Two views of the same walk:
+
+    * the **V-P02 view** — ``replicated_bytes`` (spec does not name
+      the data axis: full on every data shard) + ``sharded_bytes``
+      (spec names it: split ``1/shards``), combined by
+      :attr:`per_shard_bytes`.  This is the historical preflight
+      arithmetic, preserved bit-for-bit;
+    * the **plan view** — ``by_category``: per-shard bytes keyed by
+      Vector category (``params`` / ``dataset`` / ``staging`` /
+      ``other``; donated solver state counts as ``params``), each
+      buffer divided by its FULL :func:`shard_factor` over every mesh
+      axis its spec names — what a chip actually holds under a
+      multi-axis (dp×tp/pp) plan; combined by
+      :attr:`true_per_shard_bytes`.
+
+    ``uneven_datasets`` lists ``(shape, rows)`` of resident dataset
+    buffers that silently replicate because their rows do not divide
+    the data axis.  ``psum_bytes`` is the analytic per-step gradient
+    all-reduce (ring formula over the donated replicated bytes).
+    """
+
+    __slots__ = ("shards", "replicated_bytes", "sharded_bytes",
+                 "by_category", "uneven_datasets", "psum_bytes")
+
+    def __init__(self, shards):
+        self.shards = max(1, int(shards))
+        self.replicated_bytes = 0
+        self.sharded_bytes = 0
+        self.by_category = {}
+        self.uneven_datasets = []
+        self.psum_bytes = 0
+
+    @property
+    def per_shard_bytes(self):
+        """The V-P02 arithmetic: replicated in full + sharded split
+        over the data axis."""
+        return self.replicated_bytes + self.sharded_bytes / self.shards
+
+    @property
+    def true_per_shard_bytes(self):
+        """The plan arithmetic: every buffer at ``1/shard_factor``
+        over ALL the axes its spec names."""
+        return sum(self.by_category.values())
+
+    def add(self, nbytes, category, data_sharded, factor):
+        nbytes = int(nbytes)
+        if data_sharded:
+            self.sharded_bytes += nbytes
+        else:
+            self.replicated_bytes += nbytes
+        cat = category or "other"
+        self.by_category[cat] = (self.by_category.get(cat, 0)
+                                 + nbytes / max(1, factor))
+
+    def to_dict(self):
+        return {
+            "shards": self.shards,
+            "per_shard_bytes": int(self.per_shard_bytes),
+            "replicated_bytes": int(self.replicated_bytes),
+            "sharded_bytes": int(self.sharded_bytes),
+            "psum_bytes": int(self.psum_bytes),
+            "by_category": {k: int(v) for k, v
+                            in sorted(self.by_category.items())},
+        }
+
+
+def pod_residency(workflow, axes, batch, data_axis="data",
+                  param_rules=None):
+    """Price an initialized, stitched workflow's per-shard residency
+    for a mesh of ``axes`` (``{axis: size}`` — a real mesh's
+    ``dict(mesh.shape)`` or a planner candidate's abstract shape).
+
+    Every Vector a stitched segment touches is classified ONCE through
+    :func:`veles_tpu.pod.runtime.spec_for_vector` — the same rule
+    ``install()`` applies — and priced at ``nbytes / shard_factor``.
+    A raising ``param_rules`` raises here, identically at preflight,
+    at plan time and at install.
+    """
+    from veles_tpu.memory import Vector
+    from veles_tpu.pod.runtime import spec_for_vector
+
+    shards = int(axes.get(data_axis, 1))
+    res = Residency(shards)
+    seen = set()
+    for segment in getattr(workflow, "_stitch_segments_", ()):
+        don_ids = set(id(v) for v in segment._don_vecs)
+        for vec in (segment._input_vecs + segment._ro_vecs
+                    + segment._don_vecs + segment._output_vecs):
+            if not isinstance(vec, Vector) or id(vec) in seen:
+                continue
+            seen.add(id(vec))
+            donated = id(vec) in don_ids
+            spec = spec_for_vector(vec, batch, shards,
+                                   data_axis=data_axis,
+                                   param_rules=param_rules,
+                                   donated=donated)
+            names = set()
+            for entry in tuple(spec):
+                names.update(entry if isinstance(entry, tuple)
+                             else (entry,))
+            category = getattr(vec, "category", None)
+            res.add(vec.nbytes, "params" if donated else category,
+                    data_axis in names, shard_factor(spec, axes))
+            shape = vec.shape or ()
+            if category == "dataset" and shape and shards > 1 \
+                    and shape[0] % shards:
+                res.uneven_datasets.append((tuple(shape), shape[0]))
+    # the analytic gradient all-reduce the ledger's psum column
+    # carries — summed with the runtime's own per-segment formula so
+    # the plan's prediction and the installed ledger cannot diverge
+    res.psum_bytes = sum(
+        segment_psum_bytes(segment, batch, shards,
+                           data_axis=data_axis,
+                           param_rules=param_rules)
+        for segment in getattr(workflow, "_stitch_segments_", ()))
+    return res
+
+
+def segment_psum_bytes(segment, batch, shards, data_axis="data",
+                       param_rules=None):
+    """Analytic per-dispatch ICI traffic of ONE stitched segment:
+    every donated buffer that replicates while the segment consumes
+    batch-sharded tensors is all-reduced in-program — the ring moves
+    ``2·(n−1)/n`` of the reduced bytes.  THE formula behind both
+    :meth:`veles_tpu.pod.runtime.PodRuntime._segment_psum_estimate`
+    (the prof ledger's ``psum_bytes`` column) and the planner's
+    prediction."""
+    from jax.sharding import PartitionSpec as P
+
+    from veles_tpu.pod.runtime import spec_for_vector
+    n = int(shards)
+    if n < 2:
+        return 0
+    consumes_batch = any(
+        (vec.shape or (0,))[0] == batch
+        for stage in segment.stages
+        for vec in stage.consumes.values())
+    # a loader-headed segment's gather also combines across shards
+    consumes_batch = consumes_batch or segment.has_prelude
+    if not consumes_batch:
+        return 0
+    reduced = 0
+    for vec in segment._don_vecs:
+        spec = spec_for_vector(vec, batch, n, data_axis=data_axis,
+                               param_rules=param_rules, donated=True)
+        if spec == P():
+            reduced += int(vec.nbytes)
+    return ring_all_reduce_bytes(reduced, n)
+
+
+# -- collective byte formulas ------------------------------------------------
+
+def ring_all_reduce_bytes(nbytes, n):
+    """Ring all-reduce moves ``2·(n−1)/n`` of the reduced bytes per
+    participant (reduce-scatter + all-gather) — the estimate the prof
+    ledger's ``psum_bytes`` column carries."""
+    n = int(n)
+    if n < 2:
+        return 0
+    return int(int(nbytes) * 2 * (n - 1) / n)
+
+
+def ring_all_gather_bytes(nbytes, n):
+    """Ring all-gather moves ``(n−1)/n`` of the gathered bytes per
+    participant — the per-step cost of FSDP re-materializing a sharded
+    parameter (and of a TP activation gather)."""
+    n = int(n)
+    if n < 2:
+        return 0
+    return int(int(nbytes) * (n - 1) / n)
+
+
+def pipeline_bubble(stages, microbatches):
+    """GPipe bubble fraction ``(s−1)/(m+s−1)`` — the fraction of every
+    step the pipeline's ramp-up/drain ticks idle each stage."""
+    stages = max(1, int(stages))
+    microbatches = max(1, int(microbatches))
+    return float(stages - 1) / float(microbatches + stages - 1)
+
+
+def abstract_mesh(axes):
+    """A shape-only stand-in accepted by the ``param_rules`` recipes
+    (:func:`veles_tpu.parallel.dp.tp_rules` / ``fsdp_rules`` read only
+    ``mesh.shape``) — lets the planner price topologies larger than
+    the attached device set."""
+    class _AbstractMesh(object):
+        __slots__ = ("shape",)
+
+        def __init__(self, shape):
+            self.shape = dict(shape)
+
+        def __repr__(self):
+            return "<AbstractMesh %r>" % (self.shape,)
+
+    return _AbstractMesh(axes)
+
+
+def leaf_stub(shape, dtype=None):
+    """A zero-alloc leaf stand-in for rule callables that only inspect
+    ``numpy.shape``/``size``/``dtype`` (what the recipes do)."""
+    return numpy.broadcast_to(
+        numpy.zeros((), dtype=dtype or numpy.float32), tuple(shape))

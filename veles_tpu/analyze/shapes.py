@@ -24,6 +24,7 @@ import textwrap
 
 import numpy
 
+from veles_tpu.analyze import pricing
 from veles_tpu.analyze.findings import Finding
 
 RULES = {
@@ -1201,20 +1202,13 @@ def check_generative(engine, hbm_bytes=None, mean_seq_len=None):
     kv_bytes = int(getattr(engine, "kv_cache_bytes", 0) or 0)
     params_bytes = 0
     try:
-        import jax
-        params_bytes = sum(
-            int(leaf.size) * int(leaf.dtype.itemsize)
-            for leaf in jax.tree.leaves(getattr(engine, "_params",
-                                                None) or ())
-            if hasattr(leaf, "size"))
+        params_bytes = pricing.params_nbytes(
+            getattr(engine, "_params", None) or ())
     except Exception:
         pass
-    if hbm_bytes is None:
-        from veles_tpu.backends import device_hbm_bytes
-        from veles_tpu.prof import device_kind
-        hbm_bytes = device_hbm_bytes(device_kind())
+    hbm_bytes = pricing.resolve_device_hbm(hbm_bytes)
     if hbm_bytes:
-        budget = 0.9 * float(hbm_bytes)   # runtime/temp headroom
+        budget = pricing.hbm_budget(hbm_bytes)
         if kv_bytes + params_bytes > budget:
             findings.append(Finding(
                 *_rule("V-S01"),
@@ -1291,54 +1285,35 @@ def check_pod(workflow, mesh, data_axis="data", hbm_bytes=None,
             fix="pick a minibatch_size that is a multiple of the "
                 "data axis (or shrink the topology)"))
 
-    # per-shard residency, classified by THE shared sharding rule
-    # (veles_tpu.pod.runtime.spec_for_vector — lazy import, the pod
-    # package imports this module's check at install time): the
-    # estimate prices exactly the plan install() will apply, so
-    # param_rules (the documented fsdp/tp remedy) moves this check
-    # and a raising rule fails the preflight exactly like the install
-    from veles_tpu.pod.runtime import spec_for_vector
+    # per-shard residency priced through the ONE pricing core
+    # (analyze.pricing.pod_residency — classified by the shared
+    # veles_tpu.pod.runtime.spec_for_vector rule): the estimate prices
+    # exactly the plan install() will apply, so param_rules (the
+    # documented fsdp/tp remedy) moves this check and a raising rule
+    # fails the preflight exactly like the install
     segments = list(getattr(workflow, "_stitch_segments_", ()))
-    params_bytes = 0
-    sharded_bytes = 0
-    seen = set()
-    for segment in segments:
-        don_ids = set(id(v) for v in segment._don_vecs)
-        for vec in (segment._input_vecs + segment._ro_vecs
-                    + segment._don_vecs + segment._output_vecs):
-            if not isinstance(vec, Vector) or id(vec) in seen:
-                continue
-            seen.add(id(vec))
-            spec = spec_for_vector(vec, batch, shards,
-                                   data_axis=data_axis,
-                                   param_rules=param_rules,
-                                   donated=id(vec) in don_ids)
-            if data_axis in tuple(spec):
-                sharded_bytes += int(vec.nbytes)
-            else:
-                params_bytes += int(vec.nbytes)
-            # an uneven resident dataset silently loses its sharding
-            # (spec_for_vector replicates it rather than crash the
-            # device_put) — name it here, before install
-            shape = vec.shape or ()
-            if getattr(vec, "category", None) == "dataset" and shape \
-                    and shards > 1 and shape[0] % shards:
-                findings.append(Finding(
-                    "warning", "V-P02",
-                    message="resident dataset buffer %s has %d rows "
-                            "— not divisible over %d data shards, so "
-                            "it replicates in FULL on every chip "
-                            "instead of sharding"
-                            % (shape, shape[0], shards),
-                    fix="pad or trim the dataset to a multiple of "
-                        "the data axis"))
-    if hbm_bytes is None:
-        from veles_tpu.backends import device_hbm_bytes
-        from veles_tpu.prof import device_kind
-        hbm_bytes = device_hbm_bytes(device_kind())
+    residency = pricing.pod_residency(workflow, dict(mesh.shape),
+                                      batch, data_axis=data_axis,
+                                      param_rules=param_rules)
+    params_bytes = residency.replicated_bytes
+    sharded_bytes = residency.sharded_bytes
+    # an uneven resident dataset silently loses its sharding
+    # (spec_for_vector replicates it rather than crash the
+    # device_put) — name it here, before install
+    for shape, rows in residency.uneven_datasets:
+        findings.append(Finding(
+            "warning", "V-P02",
+            message="resident dataset buffer %s has %d rows "
+                    "— not divisible over %d data shards, so "
+                    "it replicates in FULL on every chip "
+                    "instead of sharding"
+                    % (shape, rows, shards),
+            fix="pad or trim the dataset to a multiple of "
+                "the data axis"))
+    hbm_bytes = pricing.resolve_device_hbm(hbm_bytes)
     if hbm_bytes and segments:
-        budget = 0.9 * float(hbm_bytes)    # the V-S01 headroom rule
-        per_shard = params_bytes + sharded_bytes / max(1, shards)
+        budget = pricing.hbm_budget(hbm_bytes)
+        per_shard = residency.per_shard_bytes
         if per_shard > budget:
             findings.append(Finding(
                 *_rule("V-P02"),
@@ -1354,7 +1329,10 @@ def check_pod(workflow, mesh, data_axis="data", hbm_bytes=None,
                     "parallel.dp.fsdp_rules(mesh)), spread over more "
                     "chips, or shrink the resident dataset"))
 
-    # non-shardable segments, named before compile (same shared rule)
+    # non-shardable segments, named before compile (same shared rule —
+    # lazy import: the pod package imports this module's check at
+    # install time)
+    from veles_tpu.pod.runtime import spec_for_vector
     for segment in segments:
         don_ids = set(id(v) for v in segment._don_vecs)
         vecs = [v for v in (segment._input_vecs + segment._ro_vecs

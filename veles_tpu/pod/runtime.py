@@ -99,8 +99,11 @@ class PodRuntime(Logger):
     :func:`mesh_from_topology` (the ``root.common.engine.pod.topology``
     knob).  ``param_rules``: optional callable ``leaf_shape_array ->
     PartitionSpec | None`` applied to parameter/donated buffers (TP /
-    FSDP sharding); ``None`` → fully replicated.  ``data_axis`` names
-    the batch axis ("data").
+    FSDP sharding); ``None`` → fully replicated; ``"auto"`` → the
+    static planner (:func:`veles_tpu.analyze.plan.auto_param_rules`)
+    picks replicated/fsdp/tp for this mesh at ``install()`` (also
+    spellable as the ``root.common.engine.pod.param_rules`` knob).
+    ``data_axis`` names the batch axis ("data").
 
     ``preflight``: ``off`` | ``warn`` | ``fail`` — run the analyzer's
     V-P02 pod preflight at install (default: the
@@ -113,6 +116,16 @@ class PodRuntime(Logger):
         self.workflow = workflow
         self.data_axis = data_axis
         self.param_rules = param_rules
+        if param_rules is None:
+            node = root.common.engine.get("pod")
+            knob = node.get("param_rules") if node else None
+            if knob:
+                # a knob can only spell a mode ("auto"); callables
+                # come through the constructor
+                self.param_rules = str(knob)
+        #: the planner's winning candidate (dict) when param_rules
+        #: resolved via "auto" at install()
+        self.auto_plan = None
         self.mesh = mesh if mesh is not None else mesh_from_topology(
             require=(data_axis,))
         if data_axis not in self.mesh.shape:
@@ -157,6 +170,7 @@ class PodRuntime(Logger):
             "segments": [
                 "+".join(s.names) for s in self._segments],
             "psum_bytes_per_step": sum(self._psum_bytes.values()),
+            "auto_plan": (self.auto_plan or {}).get("name"),
         }
 
     # -- install ------------------------------------------------------------
@@ -178,6 +192,7 @@ class PodRuntime(Logger):
                 "global batch %d does not divide over %d data shards "
                 "— pick a batch a multiple of the data axis (or a "
                 "smaller topology)" % (batch, self.shards))
+        self._resolve_param_rules()
         self._run_preflight()
         self._segments = segments
         self._apply_shardings()
@@ -210,6 +225,35 @@ class PodRuntime(Logger):
         runner = getattr(self.workflow, "_epoch_runner_", None)
         if runner is not None:
             runner.invalidate_programs()
+
+    def _resolve_param_rules(self):
+        """A string ``param_rules`` is a mode: ``auto`` hands the
+        choice to the static planner (replicated / fsdp / tp over
+        THIS mesh, priced with the shared pricing core); the winner's
+        callable (or None) replaces the string before preflight and
+        sharding, so everything downstream sees an explicit rule —
+        same programs, same parity, zero extra recompiles."""
+        if not isinstance(self.param_rules, str):
+            return
+        mode = self.param_rules.strip().lower()
+        if mode in ("", "none", "off"):
+            self.param_rules = None
+            return
+        if mode != "auto":
+            raise PodError(
+                "unknown param_rules mode %r (None | callable | "
+                "'auto')" % (self.param_rules,))
+        from veles_tpu.analyze.plan import auto_param_rules
+        rules, name, row = auto_param_rules(
+            self.workflow, self.mesh, data_axis=self.data_axis)
+        self.param_rules = rules
+        self.auto_plan = row
+        self.info(
+            "pod auto plan: %s (%s) — predicted %s/shard, %s "
+            "psum/step",
+            name, row.get("rule", "?"),
+            _fmt_bytes(int(row.get("per_shard_bytes", 0))),
+            _fmt_bytes(int(row.get("psum_bytes_per_step", 0))))
 
     def _run_preflight(self):
         if self.preflight == "off":
@@ -263,25 +307,15 @@ class PodRuntime(Logger):
         all-reduced in-program — a ring moves ``2·(n−1)/n`` of the
         reduced bytes (XLA's cost model does not expose collective
         traffic, so the ledger carries this estimate, clearly labeled
-        next to the measured ``h2d_bytes``)."""
-        n = self.shards
-        if n < 2:
-            return 0
-        batch = int(self.workflow.loader.max_minibatch_size)
-        consumes_batch = any(
-            (vec.shape or (0,))[0] == batch
-            for stage in segment.stages
-            for vec in stage.consumes.values())
-        # a loader-headed segment's gather also combines across shards
-        consumes_batch = consumes_batch or segment.has_prelude
-        if not consumes_batch:
-            return 0
-        from jax.sharding import PartitionSpec as P
-        reduced = 0
-        for vec in segment._don_vecs:
-            if self._spec_for(vec, donated=True) == P():
-                reduced += int(vec.nbytes)
-        return int(reduced * 2 * (n - 1) / n)
+        next to the measured ``h2d_bytes``).  The formula lives in the
+        shared pricing core (:func:`veles_tpu.analyze.pricing
+        .segment_psum_bytes`), so the static planner's prediction and
+        this ledger entry cannot drift."""
+        from veles_tpu.analyze.pricing import segment_psum_bytes
+        return segment_psum_bytes(
+            segment, int(self.workflow.loader.max_minibatch_size),
+            self.shards, data_axis=self.data_axis,
+            param_rules=self.param_rules)
 
     def _apply_shardings(self):
         """Pin every plan Vector's placement and swap every segment's

@@ -39,36 +39,70 @@ TINY = {
 }
 
 
-def init_params(cfg, seed=0, dtype=numpy.float32):
-    """Stacked-block GPT params (leading axis = layer for lax.scan)."""
-    rng = numpy.random.default_rng(seed)
+def _shape_table(cfg):
+    """The one parameter-layout table: ``name -> (shape, init)`` with
+    ``init`` = ("randn", scale) | ("ones",) | ("zeros",).  Both
+    :func:`init_params` (allocates) and :func:`param_shapes` (the
+    static planner's zero-alloc probe) derive from it, so the layouts
+    cannot drift.  Entry order is load-bearing: it is the RNG draw
+    order of ``init_params``."""
     d, h, L = cfg["dim"], cfg["heads"], cfg["layers"]
     dh = d // h
     f = cfg["mlp_ratio"] * d
-
-    def norm(*shape, scale):
-        return (rng.standard_normal(shape) * scale).astype(dtype)
-
+    sq = math.sqrt
     return {
-        "embed": norm(cfg["vocab"], d, scale=0.02),
-        "pos": norm(cfg["seq_len"], d, scale=0.02),
+        "embed": ((cfg["vocab"], d), ("randn", 0.02)),
+        "pos": ((cfg["seq_len"], d), ("randn", 0.02)),
         "blocks": {
-            "ln1_g": numpy.ones((L, d), dtype), "ln1_b":
-                numpy.zeros((L, d), dtype),
-            "wqkv": norm(L, d, 3, h, dh, scale=1 / math.sqrt(d)),
-            "wo": norm(L, h, dh, d, scale=1 / math.sqrt(d) /
-                       math.sqrt(2 * L)),
-            "ln2_g": numpy.ones((L, d), dtype), "ln2_b":
-                numpy.zeros((L, d), dtype),
-            "w1": norm(L, d, f, scale=1 / math.sqrt(d)),
-            "b1": numpy.zeros((L, f), dtype),
-            "w2": norm(L, f, d, scale=1 / math.sqrt(f) /
-                       math.sqrt(2 * L)),
-            "b2": numpy.zeros((L, d), dtype),
+            "ln1_g": ((L, d), ("ones",)),
+            "ln1_b": ((L, d), ("zeros",)),
+            "wqkv": ((L, d, 3, h, dh), ("randn", 1 / sq(d))),
+            "wo": ((L, h, dh, d), ("randn", 1 / sq(d) / sq(2 * L))),
+            "ln2_g": ((L, d), ("ones",)),
+            "ln2_b": ((L, d), ("zeros",)),
+            "w1": ((L, d, f), ("randn", 1 / sq(d))),
+            "b1": ((L, f), ("zeros",)),
+            "w2": ((L, f, d), ("randn", 1 / sq(f) / sq(2 * L))),
+            "b2": ((L, d), ("zeros",)),
         },
-        "lnf_g": numpy.ones((d,), dtype),
-        "lnf_b": numpy.zeros((d,), dtype),
+        "lnf_g": ((d,), ("ones",)),
+        "lnf_b": ((d,), ("zeros",)),
     }
+
+
+def _build_params(table, make):
+    """Walk the shape table in INSERTION order (dict order is the RNG
+    draw order — ``jax.tree.map`` would sort keys and change seeds)."""
+    out = {}
+    for name, entry in table.items():
+        out[name] = (_build_params(entry, make)
+                     if isinstance(entry, dict) else make(entry))
+    return out
+
+
+def init_params(cfg, seed=0, dtype=numpy.float32):
+    """Stacked-block GPT params (leading axis = layer for lax.scan)."""
+    rng = numpy.random.default_rng(seed)
+
+    def make(entry):
+        shape, init = entry
+        if init[0] == "randn":
+            return (rng.standard_normal(shape)
+                    * init[1]).astype(dtype)
+        fn = numpy.ones if init[0] == "ones" else numpy.zeros
+        return fn(shape, dtype)
+
+    return _build_params(_shape_table(cfg), make)
+
+
+def param_shapes(cfg, dtype=numpy.float32):
+    """Zero-alloc :class:`jax.ShapeDtypeStruct` twin of
+    :func:`init_params` — what ``python -m veles_tpu.analyze --plan``
+    prices candidate dp/fsdp/tp/pp plans against (no RNG, no HBM)."""
+    dt = numpy.dtype(dtype)
+    return _build_params(
+        _shape_table(cfg),
+        lambda entry: jax.ShapeDtypeStruct(entry[0], dt))
 
 
 def _layernorm(x, g, b):

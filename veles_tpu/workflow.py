@@ -180,14 +180,24 @@ class Workflow(Unit):
                                     False):
             # one-time structured downgrade of the analyzer's V-G02
             # finding: standalone runs see WHICH units silently ride
-            # in insertion order (master/slave payload fragility)
+            # in insertion order (master/slave payload fragility).
+            # Same detection helper as the analyzer pass, so the two
+            # cannot disagree (an unreachable end_point is appended
+            # for ordering but excluded from the finding, both here
+            # and there).
             self._warned_unreachable_ = True
-            self.warning(
-                "V-G02: %d unit(s) unreachable from start_point, "
-                "appended in insertion order: %s — they initialize "
-                "but never run; `python -m veles_tpu.analyze` has the "
-                "full pre-flight report",
-                len(appended), ", ".join(u.name for u in appended))
+            from veles_tpu.analyze.graph import unreachable_units
+            flagged = unreachable_units(
+                self.start_point, self._units,
+                exclude=(self.end_point,))
+            if flagged:
+                self.warning(
+                    "V-G02: %d unit(s) unreachable from start_point, "
+                    "appended in insertion order: %s — they "
+                    "initialize but never run; `python -m "
+                    "veles_tpu.analyze` has the full pre-flight "
+                    "report",
+                    len(flagged), ", ".join(u.name for u in flagged))
         return seen
 
     def initialize(self, device=None, **kwargs):
