@@ -499,7 +499,7 @@ def stage_mnist_u8():
 
 
 def _conv_stage(metric, layers, input_shape, n_classes, batch, steps,
-                vs=None, compute_dtype="bfloat16"):
+                vs=None, compute_dtype="bfloat16", extra=None):
     import numpy
 
     import jax
@@ -516,7 +516,7 @@ def _conv_stage(metric, layers, input_shape, n_classes, batch, steps,
     labels = jax.device_put(
         rng.integers(0, n_classes, batch).astype(numpy.int32))
     sec, flops = _measure(step_fn, params, x, labels, steps=steps)
-    _emit(metric, sec, batch, flops, vs=vs)
+    _emit(metric, sec, batch, flops, vs=vs, extra=extra)
 
 
 def _wf_stage(metric, fused_config=None, sample=None, fused=True,
@@ -1364,6 +1364,101 @@ def stage_transformer():
           extra={"remat": remat, "ce_chunk": ce_chunk})
 
 
+def stage_transformer_lm_train():
+    """The MFU line: the fused-kernel LM train step (flash-attention
+    fwd+bwd custom_vjp + chunked CE) vs the SAME-RUN XLA-kernel
+    baseline — dense materialized attention (no custom_vjp, AD
+    rebuilds the [B,H,S,S] scores in the backward) + full-logits CE.
+    Both arms are measured in this process on this chip, so ``vs=`` is
+    a kernel-for-kernel ratio, not a cross-session absolute.  Emits
+    tokens/sec, MFU, steps_per_dispatch (the multi-step loop's trip
+    count — K steps ride one dispatch) and recompiles (jit cache
+    entries beyond the first across repeated same-shape calls)."""
+    import numpy
+
+    import jax
+    from veles_tpu.config import root
+    from veles_tpu.samples import transformer
+
+    # off-TPU the stage runs a thin LONG-SEQUENCE config: both arms
+    # are the dense fast path there (interpret-mode Pallas is not a
+    # throughput claim), so the A/B isolates what the fused step is
+    # FOR — the blockwise custom_vjp backward vs AD rebuilding the
+    # materialized [B,H,S,S] scores.  The crossover on CPU is S≈2-4k
+    # (below that the score matrix fits cache and recompute loses);
+    # measured ratios: 0.75x @ S=1k, 1.3x @ S=4-6k, 1.5x @ S=8k.
+    # S=6144 keeps the A/B inside the stage budget on one CPU core.
+    tiny = bool(os.environ.get("BENCH_LM_TINY")) \
+        or jax.default_backend() != "tpu"
+    if tiny:
+        cfg = {"vocab": 512, "dim": 64, "heads": 2, "layers": 1,
+               "mlp_ratio": 2,
+               "seq_len": int(os.environ.get("BENCH_LM_SEQ", "6144"))}
+        batch = int(os.environ.get("BENCH_LM_BATCH", "1"))
+    else:
+        cfg = {"vocab": 32000, "dim": 512, "heads": 8, "layers": 8,
+               "mlp_ratio": 4, "seq_len": 1024}
+        batch = int(os.environ.get("BENCH_LM_BATCH", "32"))
+    remat = os.environ.get("BENCH_LM_REMAT", "0") == "1"
+    ce_chunk = int(os.environ.get("BENCH_LM_CE_CHUNK", "128"))
+    steps = 4 if tiny else 12
+    params = transformer.init_params(cfg, seed=0)
+    velocity = jax.tree.map(numpy.zeros_like, params)
+    tokens = jax.device_put(transformer.synthetic_tokens(cfg, batch))
+    labels = numpy.zeros((batch,), numpy.int32)
+    flops = transformer.train_step_flops(cfg, batch)
+
+    def measure(kernels, chunk):
+        # the kernels knob is resolved at TRACE time (samples.
+        # transformer._attend, znicz.gd stage build), so each arm
+        # builds its own program under its own mode — nothing leaks
+        # across arms through a compile cache keyed only on shapes
+        saved = root.common.engine.get("kernels", "auto")
+        root.common.engine.kernels = kernels
+        try:
+            raw_step = transformer.make_train_step(
+                cfg, remat=remat, ce_chunk=chunk)
+
+            def step(state, x, _labels):
+                p, v = state
+                p, v, metrics = raw_step(p, v, x)
+                return (p, v), metrics
+
+            sec, _ = _measure(step, (params, velocity), tokens,
+                              labels, steps=steps,
+                              flops_override=flops)
+            # recompile probe: repeated same-shape dispatches of the
+            # plain jitted step must hit ONE cache entry — a weak-type
+            # flip or python-scalar bake-in would grow the cache
+            jitted = jax.jit(step)
+            state = (jax.device_put(params), jax.device_put(velocity))
+            for _ in range(3):
+                out_state, metrics = jitted(state, tokens, labels)
+            jax.block_until_ready(metrics)
+            recompiles = max(0, jitted._cache_size() - 1)
+        finally:
+            root.common.engine.kernels = saved
+        return sec, recompiles
+
+    base_sec, base_recompiles = measure("xla", 0)
+    sec, recompiles = measure(
+        str(root.common.engine.get("kernels", "auto")) if
+        str(root.common.engine.get("kernels", "auto")) != "xla"
+        else "auto", ce_chunk)
+    name = ("GPT-512x8 LM train step, fused kernels vs XLA baseline "
+            "(tokens basis)" + _batch_tag(batch, 32))
+    if tiny:
+        name += " [tiny-smoke]"
+    tokens_per_step = batch * cfg["seq_len"]
+    _emit(name, sec, tokens_per_step, flops,
+          vs=tokens_per_step / base_sec,
+          extra={"remat": remat, "ce_chunk": ce_chunk,
+                 "steps_per_dispatch": steps,
+                 "recompiles": recompiles + base_recompiles,
+                 "baseline_sec_per_step": round(base_sec, 6),
+                 "kernels": "fused-vs-xla"})
+
+
 def stage_transformer_gen():
     """Generative serving closed loop (the veles_tpu.gen subsystem):
     a seeded mixed-length request set pumped through the continuous-
@@ -1843,9 +1938,16 @@ def stage_alexnet():
     else:
         name = ("AlexNet fused train throughput per chip "
                 "(bf16, batch %d)" % batch)
+    # the kernels= column: which backward-kernel mode the run used
+    # (root.common.engine.kernels — the fused Pallas dW/db/dX family
+    # vs the dense XLA reference), so banked AlexNet lines are only
+    # ever compared against same-mode runs
+    from veles_tpu.config import root
     _conv_stage(
         name, alexnet.LAYERS, alexnet.INPUT_SHAPE, 1000, batch=batch,
-        steps=10, vs=V100_ALEXNET_IMG_PER_SEC)
+        steps=10, vs=V100_ALEXNET_IMG_PER_SEC,
+        extra={"kernels": str(root.common.engine.get("kernels",
+                                                     "auto"))})
 
 
 def _epoch_loop(metric, step_fn, params, data, labels, n, batch,
@@ -2333,6 +2435,7 @@ STAGES = {
     "kohonen": (stage_kohonen, 150),
     "lstm": (stage_lstm, 180),
     "transformer": (stage_transformer, 240),
+    "transformer_lm_train": (stage_transformer_lm_train, 400),
     "transformer_gen": (stage_transformer_gen, 300),
     "power": (stage_power, 240),
     "alexnet": (stage_alexnet, 600),
@@ -2359,7 +2462,8 @@ _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_wf_slave", "mnist_pod", "mnist_pod_epoch",
                "cifar", "stl10", "ae",
                "kohonen",
-               "lstm", "transformer", "transformer_gen", "profile_lm",
+               "lstm", "transformer", "transformer_lm_train",
+               "transformer_gen", "profile_lm",
                "attn_bwd", "power",
                "native_infer", "s2d", "alexnet512", "alexnet_e2e",
                "alexnet_epoch", "alexnet_epoch_ab", "profile", "alexnet")
@@ -2372,7 +2476,8 @@ _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
 #: after the headline artifacts.
 _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
                "s2d", "alexnet512", "alexnet_e2e", "alexnet_epoch",
-               "alexnet_epoch_ab", "transformer", "transformer_gen",
+               "alexnet_epoch_ab", "transformer",
+               "transformer_lm_train", "transformer_gen",
                "profile_lm", "attn_bwd",
                "lstm", "mnist_e2e",
                "mnist_e2e_u8", "mnist_epoch", "power", "native_infer",
@@ -2390,7 +2495,8 @@ _CPU_ORDER = ("mnist_e2e", "mnist_epoch", "mnist_wf",
               "mnist_wf_eager_devloader", "mnist_wf_eager_epoch",
               "mnist_wf_health",
               "mnist_wf_slave", "mnist_pod", "mnist_pod_epoch", "ae",
-              "kohonen", "lstm", "transformer_gen",
+              "kohonen", "lstm", "transformer_lm_train",
+              "transformer_gen",
               "native_infer", "mnist_u8", "mnist_bf16", "mnist")
 
 

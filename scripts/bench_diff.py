@@ -82,7 +82,12 @@ _COUNTERS = ("recompiles", "dispatches_per_epoch")
 #: disagg-fleet record — latency under the 500 ms SLO, wire cost per
 #: request, and control-loop churn are all regressions when they
 #: grow)
-_HIGHER_BETTER_FIELDS = ("mfu", "steps_per_dispatch", "vs_bf16_x")
+#: vs_baseline joins the higher-better set for the same-run A/B
+#: stages (transformer_lm_train: fused kernels over the XLA-kernel
+#: baseline measured in the SAME process — the ratio eroding means
+#: the fused path lost ground even if absolute throughput moved)
+_HIGHER_BETTER_FIELDS = ("mfu", "steps_per_dispatch", "vs_bf16_x",
+                         "vs_baseline")
 _LOWER_BETTER_FIELDS = ("sec_per_step", "hbm_per_request_bytes",
                         "ttft_p99_ms", "handoff_bytes_per_request",
                         "autoscaler_actions")

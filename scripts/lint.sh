@@ -84,6 +84,15 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python -m veles_tpu.obs --smoke
 # live watch)
 echo "== watch smoke (training-health telemetry + live bus gate) =="
 timeout -k 10 240 env JAX_PLATFORMS=cpu python -m veles_tpu.watch --smoke
+# ops smoke: the training-kernel gate — interpret-mode parity oracles
+# for every Pallas family (fused backward-GD incl. optimizer epilogue,
+# gather+normalize loader head, flash-attention fwd+bwd custom_vjp),
+# a toy autotune_gd sweep round-tripped through gemm_choice (stdout
+# envelope unwrap included), and a stitched run under
+# engine.kernels=pallas finishing with ZERO steady-state recompiles
+# (docs/engine_fast_path.md § Training kernels)
+echo "== ops smoke (kernel parity + autotune + zero-recompile gate) =="
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m veles_tpu.ops --smoke
 # bench_diff self-test: the perf-regression watchdog's comparator
 # validated against the banked BENCH_r0*.json envelope — banked vs
 # banked clean, synthetically degraded copies caught on every field,

@@ -977,3 +977,107 @@ def test_v_j11_in_catalog_and_hot_chain_silent():
     findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
     assert "V-J11" not in rules_of(findings), \
         [f.render() for f in findings]
+
+
+# -- V-J12: materialized O(S²) attention scores -----------------------------
+
+def test_v_j12_materialized_attention_flagged():
+    """V-J12: a softmax over an attention-shaped product (batched
+    einsum / q @ k.T / dot_general) in a hot-loop or stitch_stage body
+    is the O(S²) score materialization the flash kernel replaces —
+    both the direct-nesting and the two-statement idiom fire."""
+    from veles_tpu.analyze.shapes import scan_attention_materialization
+
+    class DenseAttention(Unit):
+        hide_from_registry = True
+
+        def tpu_run(self):
+            import jax
+            import jax.numpy as jnp
+            q, k, v = (self.q.devmem, self.k.devmem, self.v.devmem)
+            # two-statement idiom: scores assigned, then softmaxed
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * self.scale
+            p = jax.nn.softmax(scores, axis=-1)
+            self.output.devmem = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+        def stitch_stage(self):
+            import jax
+            import jax.numpy as jnp
+
+            def fn(t):
+                q, k, v = t["q"], t["k"], t["v"]
+                # direct nesting: softmax(q @ k.T)
+                p = jax.nn.softmax(
+                    jnp.matmul(q, k.swapaxes(-1, -2)) * 0.125,
+                    axis=-1)
+                return {"out": jnp.matmul(p, v)}
+            return fn
+
+    class ClassifierHead(Unit):
+        hide_from_registry = True
+
+        def tpu_run(self):
+            import jax
+            import jax.numpy as jnp
+            # activation×weight GEMM then softmax — the stock
+            # classifier-head idiom, NOT attention: stays silent
+            logits = jnp.dot(self.input.devmem, self.weights.devmem)
+            self.output.devmem = jax.nn.softmax(logits, axis=-1)
+
+    class NoSoftmax(Unit):
+        hide_from_registry = True
+
+        def tpu_run(self):
+            import jax.numpy as jnp
+            self.output.devmem = jnp.matmul(
+                self.q.devmem, self.k.devmem.swapaxes(-1, -2))
+
+    wf = DummyWorkflow()
+    dense = DenseAttention(wf, name="dense")
+    hot = scan_attention_materialization(dense)
+    assert rules_of(hot) == {"V-J12"}, [f.render() for f in hot]
+    assert len(hot) == 2                 # tpu_run + stitch_stage
+    assert all(f.location for f in hot)
+    assert "flash_attention" in hot[0].fix
+    head = scan_attention_materialization(
+        ClassifierHead(wf, name="head"))
+    assert head == [], [f.render() for f in head]
+    plain = scan_attention_materialization(
+        NoSoftmax(wf, name="plain"))
+    assert plain == [], [f.render() for f in plain]
+
+
+def test_v_j12_in_catalog_and_stock_samples_silent():
+    """V-J12 is in --rules; check_shapes wires it over the hot chain
+    and the stock stitched MLP (whose softmax head IS a softmax over
+    a GEMM product — the idiom the rule must NOT confuse with
+    attention) stays silent."""
+    assert "V-J12" in rule_catalog()
+
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+    class TinyLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            self.original_data.mem = rng.standard_normal(
+                (40, 8)).astype(numpy.float32)
+            self.original_labels = [int(i % 4) for i in range(40)]
+            self.class_lengths[:] = [0, 0, 40]
+
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: TinyLoader(w, minibatch_size=8),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": 0.05}},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": 4}}],
+        decision_config={"max_epochs": 1})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=NumpyDevice())
+    findings = check_shapes(wf, sample_shape=(8,), batch_size=8)
+    assert "V-J12" not in rules_of(findings), \
+        [f.render() for f in findings]

@@ -83,3 +83,51 @@ def test_device_info_db_roundtrip(tmp_path):
     assert db["TPU v5e"].get_kernel_tiles("gemm", "bfloat16",
                                           default=[128, 128, 128]) == \
         [128, 128, 128]
+
+
+def test_device_info_load_db_unwraps_autotune_envelope(tmp_path):
+    # scripts.autotune prints a {"devices": ..., "_this_run": ...}
+    # envelope; a DB file saved from that stdout must load as if it
+    # were the flat table, with _this_run treated as provenance only
+    import json
+    path = str(tmp_path / "device_infos.json")
+    envelope = {
+        "devices": {"TPU v5e": {"gemm": {"float32": {
+            "tiles": [256, 512, 256]}}}},
+        "_this_run": {"device_kind": "TPU v5e", "ts": 0.0, "argv": []},
+    }
+    with open(path, "w") as fout:
+        json.dump(envelope, fout)
+    db = DeviceInfo.load_db(path)
+    assert "_this_run" not in db
+    assert db["TPU v5e"].get_kernel_tiles("gemm", "float32") == \
+        [256, 512, 256]
+    # a flat DB that happens to contain a model named "devices" plus
+    # another real model is NOT an envelope and must load untouched
+    flat = {"devices": {"gemm": {}}, "TPU v4": {"gemm": {}}}
+    with open(path, "w") as fout:
+        json.dump(flat, fout)
+    assert set(DeviceInfo.load_db(path)) == {"devices", "TPU v4"}
+
+
+def test_autotune_sweep_merges_per_device_model(tmp_path):
+    # re-running a sweep on a SECOND device kind must not clobber the
+    # first's ratings, even when the DB file is a redirected stdout
+    # envelope (_this_run stays last-run-only, never a device entry)
+    import json
+
+    from veles_tpu.ops.benchmark import autotune_gd
+
+    path = str(tmp_path / "device_infos.json")
+    first = DeviceInfo("TPU v4")
+    first.ratings["gemm"] = {"float32": [256, 256, 256]}
+    with open(path, "w") as fout:
+        json.dump({"devices": {"TPU v4": first.ratings},
+                   "_this_run": {"device_kind": "TPU v4", "ts": 1.0}},
+                  fout)
+    autotune_gd(shape=(16, 128, 64), runs=1, db_path=path)
+    db = DeviceInfo.load_db(path)
+    assert "_this_run" not in db
+    assert db["TPU v4"].ratings["gemm"] == {"float32": [256, 256, 256]}
+    others = [m for m in db if m != "TPU v4"]
+    assert others and any("gd_v2" in db[m].ratings for m in others)

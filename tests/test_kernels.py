@@ -1,0 +1,277 @@
+"""The ``root.common.engine.kernels`` family acceptance gates
+(docs/engine_fast_path.md § Training kernels):
+
+1. interpret-mode PARITY ORACLES — the fused backward-GD Pallas kernel
+   (dW + optimizer epilogue / db / dX, every activation × both weight
+   storage layouts) against the dense ``znicz.gd._gd_math`` reference,
+   and the gather+normalize loader head against its jnp twin;
+2. END-TO-END parity — ``kernels=pallas`` must train to the same
+   weights as ``kernels=xla`` (documented interpret-mode tolerance)
+   with ZERO steady-state recompiles on every training path: the
+   stitched-eager per-step program, the folded ``epoch_scan`` window,
+   and the 8-device pod (one-pod-one-program pjit, on the conftest's
+   virtual CPU mesh);
+3. the CPU PERFORMANCE FLOOR (slow) — the fused LM train step of the
+   bench ladder must beat its same-run XLA baseline ≥1.2× in the
+   long-sequence regime where the materialized [B,H,S,S] attention
+   backward is bandwidth-bound (the fused kernels' raison d'être).
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu import prng, prof
+from veles_tpu.backends import CPUDevice
+from veles_tpu.config import root
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.ops.gemm import _GD_DERIVS, gd_fused_pallas
+from veles_tpu.znicz.gd import _gd_math
+from veles_tpu.znicz.standard_workflow import StandardWorkflow
+
+
+# ---------------------------------------------------------------------------
+# 1. interpret-mode parity oracles
+# ---------------------------------------------------------------------------
+
+_HP = (0.05, 0.05, 0.0005, 0.0, 0.9, 0.9)   # lr, lr_b, decay ×2, moment ×2
+
+
+@pytest.mark.parametrize("activation", sorted(_GD_DERIVS, key=str))
+@pytest.mark.parametrize("transposed", [False, True])
+def test_gd_fused_matches_dense_math(activation, transposed):
+    """One kernel call vs ``_gd_math``: every output (w, b, vw, vb,
+    err_input) within the documented interpret tolerance, on
+    deliberately tile-unaligned shapes."""
+    rng = numpy.random.default_rng(7)
+    batch, f, n = 24, 70, 50
+    x = jnp.asarray(rng.standard_normal((batch, f)), jnp.float32)
+    eo = jnp.asarray(rng.standard_normal((batch, n)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((batch, n)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal(
+        (n, f) if transposed else (f, n)), jnp.float32) * 0.1
+    b = jnp.asarray(rng.standard_normal((n,)), jnp.float32)
+    vw, vb = jnp.zeros_like(w), jnp.zeros_like(b)
+    ref = _gd_math(x, y, eo, w, b, vw, vb, *_HP,
+                   activation=activation, transposed=transposed)
+    got = gd_fused_pallas(x, y, eo, w, b, vw, vb, *_HP,
+                          activation=activation, transposed=transposed,
+                          tiles=(32, 32, 8), interpret=True)
+    for name, r, g in zip(("w", "b", "vw", "vb", "err_input"), ref,
+                          got):
+        numpy.testing.assert_allclose(
+            numpy.asarray(g), numpy.asarray(r), atol=5e-5, rtol=0,
+            err_msg="%s (activation=%s, transposed=%s)"
+                    % (name, activation, transposed))
+
+
+def test_gather_norm_interpret_matches_jnp():
+    """The loader head: u8 row gather + normalize, negative indices
+    zero-filled, both scalar and per-feature norms."""
+    from veles_tpu.ops.gather import (_gather_norm_jnp,
+                                      _gather_norm_pallas, _norm_row)
+    rng = numpy.random.default_rng(11)
+    data = jnp.asarray(rng.integers(0, 256, (37, 5, 3)), jnp.uint8)
+    idx = jnp.asarray([3, 36, -1, 0, 17, -1, 9, 2], jnp.int32)
+    feat = int(numpy.prod(data.shape[1:]))
+    for scale, shift in (
+            (1.0 / 255.0, 0.0),
+            (rng.standard_normal(feat).astype(numpy.float32),
+             rng.standard_normal(feat).astype(numpy.float32))):
+        ref = _gather_norm_jnp(data, idx,
+                               jnp.asarray(scale, jnp.float32),
+                               jnp.asarray(shift, jnp.float32))
+        got = _gather_norm_pallas(
+            data.reshape(data.shape[0], -1), idx,
+            _norm_row(scale, feat), _norm_row(shift, feat),
+            interpret=True).reshape(ref.shape)
+        numpy.testing.assert_allclose(numpy.asarray(got),
+                                      numpy.asarray(ref), atol=1e-6)
+        assert float(jnp.max(jnp.abs(got[jnp.asarray([2, 5])]))) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# 2. kernels=pallas end-to-end parity, zero steady-state recompiles
+# ---------------------------------------------------------------------------
+
+class BlobLoader(FullBatchLoader):
+    """Small separable blobs — enough steps per epoch to surface a
+    per-step retrace, small enough for interpret-mode Pallas."""
+
+    hide_from_registry = True
+
+    def load_data(self):
+        rng = numpy.random.default_rng(42)
+        n_train, n_valid, dim = 96, 32, 16
+        total = n_train + n_valid
+        labels = numpy.tile(numpy.arange(4), total // 4)[:total]
+        centers = rng.standard_normal((4, dim)) * 3.0
+        self.original_data.mem = (
+            centers[labels] + rng.standard_normal((total, dim)) * 0.5
+        ).astype(numpy.float32)
+        self.original_labels = [int(v) for v in labels]
+        self.class_lengths[:] = [0, n_valid, n_train]
+
+
+def _build(max_epochs=3):
+    prng.seed_all(5)
+    wf = StandardWorkflow(
+        None,
+        loader_factory=lambda w: BlobLoader(w, minibatch_size=16),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 12},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+                {"type": "softmax", "->": {"output_sample_shape": 4},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}}],
+        decision_config={"max_epochs": max_epochs})
+    wf.launcher = DummyLauncher()
+    wf.initialize(device=CPUDevice())
+    return wf
+
+
+def _params(wf):
+    out = []
+    for fwd in wf.forwards:
+        for vec in (fwd.weights, fwd.bias):
+            vec.map_read()
+            out.append(numpy.array(vec.mem))
+    return out
+
+
+@pytest.fixture
+def kernels_config():
+    saved = {k: root.common.engine.get(k, d) for k, d in (
+        ("kernels", "auto"), ("stitch", "on"), ("epoch_scan", "off"))}
+    yield root.common.engine
+    for key, value in saved.items():
+        setattr(root.common.engine, key, value)
+
+
+def _ab_run(kernels_config, epoch_scan):
+    """Train the xla arm then the pallas arm on the identical seeded
+    task; return both parameter sets and the pallas arm's recompile
+    delta."""
+    kernels_config.epoch_scan = epoch_scan
+    kernels_config.kernels = "xla"
+    wf = _build()
+    wf.run()
+    ref = _params(wf)
+
+    kernels_config.kernels = "pallas"
+    recompiles0 = prof.ledger.recompiles
+    wf = _build()
+    wf.run()
+    return ref, _params(wf), prof.ledger.recompiles - recompiles0
+
+
+def _assert_parity(ref, got):
+    # interpret-mode Pallas accumulates f32 like the dense arm; the
+    # residual drift over 3 epochs stays well under 1e-3
+    for i, (r, g) in enumerate(zip(ref, got)):
+        numpy.testing.assert_allclose(g, r, atol=1e-3, rtol=1e-3,
+                                      err_msg="param %d" % i)
+
+
+@pytest.mark.traced
+def test_pallas_matches_xla_stitched_eager(kernels_config):
+    ref, got, recompiled = _ab_run(kernels_config, epoch_scan="off")
+    _assert_parity(ref, got)
+    assert recompiled == 0, \
+        "kernels=pallas retraced the stitched per-step program"
+    assert prof.ledger.entries("segment"), \
+        "the pallas arm did not run stitched"
+
+
+@pytest.mark.traced
+def test_pallas_matches_xla_epoch_scan_window(kernels_config):
+    """The fused kernels are closure constants of the stage build, so
+    the K-step scan window folds them without retracing."""
+    ref, got, recompiled = _ab_run(kernels_config, epoch_scan="auto")
+    _assert_parity(ref, got)
+    assert recompiled == 0, \
+        "kernels=pallas retraced the epoch_scan window"
+
+
+@pytest.mark.traced
+def test_pallas_matches_xla_pod_8dev(kernels_config):
+    """One-pod-one-program on the conftest's forced 8-device CPU mesh:
+    kernels=pallas must reach the same eval verdicts and weights as
+    kernels=xla, with zero steady-state recompiles."""
+    from veles_tpu.parallel.mesh import mesh_from_topology
+    from veles_tpu.pod import PodRuntime, eval_metrics, train_epochs
+    from veles_tpu.pod.__main__ import make_workflow
+
+    def run(kernels):
+        kernels_config.kernels = kernels
+        wf = make_workflow(max_epochs=2)
+        pod = PodRuntime(wf, mesh=mesh_from_topology("auto"))
+        pod.install()
+        assert pod.shards == 8
+        for _ in train_epochs(wf, 2):
+            pass
+        wf.forwards[0].weights.map_read()
+        return (eval_metrics(wf),
+                numpy.array(wf.forwards[0].weights.mem))
+
+    ref_metrics, ref_w = run("xla")
+    recompiles0 = prof.ledger.recompiles
+    got_metrics, got_w = run("pallas")
+    assert prof.ledger.recompiles == recompiles0, \
+        "kernels=pallas retraced the pod program"
+    for key in ("complete", "epochs", "best_n_err_pt"):
+        assert got_metrics[key] == ref_metrics[key], \
+            (key, got_metrics, ref_metrics)
+    numpy.testing.assert_allclose(got_w, ref_w, atol=1e-3, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# 3. the CPU performance floor (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fused_lm_train_step_beats_xla_baseline_on_cpu():
+    """Acceptance floor: the bench ladder's fused LM train step ≥1.2×
+    its same-run XLA baseline on CPU.  Off-TPU both arms run the dense
+    fast path (interpret-mode Pallas is exempt from throughput
+    claims); the A/B isolates the blockwise flash-attention
+    custom_vjp backward + chunked CE against AD's materialized
+    [B,H,S,S] scores, pinned to S=8192 — deep in the regime where the
+    materialization is bandwidth-bound, so the ratio clears the floor
+    with margin over host-load noise (observed 1.32-1.56x).
+
+    Runs in a subprocess WITHOUT the conftest's 8-way virtual device
+    split — the split divides the host's intra-op threads, which
+    starves the compute-leaning blockwise arm and makes the timing
+    meaningless as a floor (the ladder itself never runs split)."""
+    import os
+    import subprocess
+    import sys
+
+    import conftest
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = conftest.ORIG_XLA_FLAGS
+    env["BENCH_LM_SEQ"] = "8192"
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import bench; bench.stage_transformer_lm_train()"],
+        capture_output=True, text=True, timeout=580, env=env,
+        cwd=repo_root)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    assert lines, "the LM train stage emitted no metric line"
+    rec = json.loads(lines[-1])
+    assert rec["kernels"] == "fused-vs-xla"
+    assert rec["recompiles"] == 0, rec
+    assert rec["vs_baseline"] >= 1.2, \
+        "fused LM train step below the 1.2x CPU floor: %r" % (rec,)

@@ -372,14 +372,50 @@ class TestNativeDeviceDtype:
                 max_epochs=1, minibatch_size=64, native=True,
                 fused=True, normalization_type="exp")
 
-    def test_native_requires_fused(self):
+    def test_native_requires_fused_when_stitch_off(self):
+        # fused=False + native is legal ONLY because the stitched
+        # gather+normalize head hands the forwards float32; with the
+        # stitched path disabled the old guard must still fire
         import pytest
 
+        from veles_tpu.config import root
         from veles_tpu.samples import mnist
 
-        with pytest.raises(ValueError, match="fused"):
-            mnist.create_workflow(max_epochs=1, minibatch_size=64,
-                                  native=True)
+        prior = root.common.engine.get("stitch", None)
+        root.common.engine.stitch = "off"
+        try:
+            with pytest.raises(ValueError, match="fused"):
+                mnist.create_workflow(max_epochs=1, minibatch_size=64,
+                                      native=True)
+        finally:
+            if prior is None:
+                root.common.engine.stitch = "on"
+            else:
+                root.common.engine.stitch = prior
+
+    def test_native_stitched_eager_trains_normalized(self):
+        # the gather+normalize head: fused=False + native rides the
+        # stitched device fast path — the first forward program sees
+        # normalized float32 while the resident dataset stays uint8
+        import numpy
+
+        from veles_tpu import prng
+        from veles_tpu.samples import mnist
+
+        prng.seed_all(4321)
+        wf = mnist.create_workflow(max_epochs=1, minibatch_size=512,
+                                   native=True)
+        loader = wf.loader
+        assert loader.original_data.mem.dtype == numpy.uint8
+        assert loader.input_norm is not None
+        assert loader.device_fast_path_active
+        assert loader.stitch_stage() is not None
+        wf.run()
+        # the stitched head published normalized float32 minibatches
+        mb = numpy.asarray(loader.minibatch_data.devmem)
+        assert mb.dtype == numpy.float32
+        assert float(numpy.abs(mb).max()) <= 1.5
+        assert wf.decision.epoch_n_err[1] < loader.class_lengths[1]
 
     def test_native_u8_trains_like_f32(self):
         import numpy

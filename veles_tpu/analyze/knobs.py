@@ -65,6 +65,10 @@ KNOB_REGISTRY = {
         "fail the run on steady-state recompiles (count after warmup)",
     "root.common.engine.checkpoint":
         "snapshot cadence/policy for the snapshotter",
+    "root.common.engine.kernels":
+        "training-kernel backend (auto | xla | pallas): the fused "
+        "backward-GD / flash-attention / gather family, resolved at "
+        "stage-build time (auto consults the autotune DB)",
     "root.common.engine.pallas_gemm":
         "use the Pallas GEMM kernel where shapes allow (on | off)",
     "root.common.engine.pallas_gather":

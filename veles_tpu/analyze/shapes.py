@@ -101,6 +101,15 @@ RULES = {
               "ride the deferred-metrics fetch with zero extra "
               "dispatches, and strict mode raises a typed "
               "HealthError naming the first bad leaf"),
+    "V-J12": ("warning",
+              "materialized attention on the train hot loop: a "
+              "run()/tpu_run()/stitch_stage() body softmaxes a "
+              "matmul/einsum product — the full [.., S, S] score "
+              "matrix lives in HBM (O(S²) memory and bandwidth, and "
+              "its backward materializes it again) where the flash-"
+              "attention kernel (ops.attention.flash_attention, "
+              "fwd+bwd jax.custom_vjp) streams the same attention "
+              "blockwise through VMEM"),
     "V-S01": ("error",
               "generative serving preflight: the engine's slot-major "
               "KV cache does not fit device HBM next to the params, "
@@ -815,6 +824,149 @@ def scan_finiteness_probes(unit):
     return findings
 
 
+def _subtree_transposes(node):
+    """True when ``node``'s subtree transposes something — ``.T``,
+    ``.mT``, ``transpose()``, ``swapaxes()`` — the K-operand shape of
+    a hand-built score product."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("T", "mT"):
+            return True
+        if isinstance(sub, ast.Call):
+            tail = _call_name(sub.func) or ""
+            if tail.rsplit(".", 1)[-1] in ("transpose", "swapaxes"):
+                return True
+    return False
+
+
+def _einsum_is_batched_product(call):
+    """True when an einsum subscript multiplies two BATCHED data
+    tensors — the inputs share a non-contracted (batch) axis that
+    survives into the output, e.g. ``bhqd,bhkd->bhqk``.  A
+    weight-product subscript (``bi,io->bo``) shares only the
+    contracted axis: weights never carry the batch dim, so this is
+    the AST-level line between attention scores and a linear layer."""
+    if not call.args or not isinstance(call.args[0], ast.Constant) \
+            or not isinstance(call.args[0].value, str):
+        return False
+    spec = call.args[0].value.replace(" ", "")
+    if "->" not in spec:
+        return False
+    ins, out = spec.split("->", 1)
+    operands = ins.split(",")
+    if len(operands) != 2:
+        return False
+    shared = set(operands[0]) & set(operands[1])
+    return bool((shared & set(out)) - {"."})
+
+
+def _matmul_expr_name(node, index):
+    """Dotted name of the first ATTENTION-SHAPED product in ``node``'s
+    subtree (``"@"`` for the operator form), or ``None``.
+
+    Deliberately conservative — only the score-product idioms fire:
+    a two-operand einsum whose inputs share a surviving batch axis
+    (``bhqd,bhkd->bhqk``); ``q @ k.T`` / ``matmul``/``dot`` with a
+    transposed operand; raw ``lax.dot_general`` (hand-built dimension
+    numbers).  A plain activation×weight GEMM (``matmul(x, w)``, the
+    classifier-head idiom — weights carry no batch dim and the layer
+    code pre-transposes storage outside the call) stays silent."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.BinOp) \
+                and isinstance(sub.op, ast.MatMult):
+            if _subtree_transposes(sub.left) \
+                    or _subtree_transposes(sub.right):
+                return "@"
+            continue
+        if not isinstance(sub, ast.Call):
+            continue
+        name = (index.resolve_call(sub.func) if index else None) \
+            or _call_name(sub.func)
+        if not name:
+            continue
+        tail = name.rsplit(".", 1)[-1]
+        if tail == "einsum" and _einsum_is_batched_product(sub):
+            return name.lstrip(".")
+        if tail == "dot_general":
+            return name.lstrip(".")
+        if tail in ("matmul", "dot") and any(
+                _subtree_transposes(a) for a in sub.args):
+            return name.lstrip(".")
+    return None
+
+
+def scan_attention_materialization(unit):
+    """V-J12: training-loop bodies that materialize the full O(S²)
+    attention score matrix — a ``softmax`` whose operand is (or was
+    assigned from) a matmul/einsum product — instead of routing
+    through the blockwise flash-attention kernel.
+
+    Two softmax shapes are recognized per body: direct nesting
+    (``softmax(q @ k.T)``) and the two-statement idiom
+    (``scores = einsum(...); p = softmax(scores)``) via a
+    single-function local-name dataflow.  A softmax over anything
+    else — a classifier head over logits, a sampling temperature —
+    stays silent, as does a body that never softmaxes."""
+    findings = []
+    cls = type(unit)
+    bodies = [("%s" % m, t, p, b, i)
+              for m, t, p, b, i in _iter_hot_method_asts(unit)]
+    extracted = _stitch_stage_ast(unit)
+    if extracted is not None:
+        tree, path, base_line, index = extracted
+        bodies.append(("stitch_stage", tree, path, base_line, index))
+    for meth_name, tree, path, base_line, index in bodies:
+        # local names assigned from a matmul-containing expression
+        score_names = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                prod = _matmul_expr_name(node.value, index)
+                if prod:
+                    score_names[node.targets[0].id] = prod
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = (index.resolve_call(node.func) if index else None) \
+                or _call_name(node.func)
+            if not name or name.rsplit(".", 1)[-1] != "softmax":
+                continue
+            prod = None
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                prod = _matmul_expr_name(arg, index)
+                if prod:
+                    break
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) \
+                            and sub.id in score_names:
+                        prod = score_names[sub.id]
+                        break
+                if prod:
+                    break
+            if prod is None:
+                continue
+            line = base_line + node.lineno - 1
+            findings.append(Finding(
+                *_rule("V-J12"),
+                message="%s.%s softmaxes a %s product — the full "
+                        "[.., S, S] attention score matrix is "
+                        "materialized in HBM every step (O(S²) "
+                        "memory, and the backward rebuilds it) where "
+                        "the flash-attention kernel streams it "
+                        "blockwise through VMEM"
+                        % (cls.__name__, meth_name,
+                           prod if prod == "@" else prod + "()"),
+                unit=unit.name,
+                location="%s:%d" % (path, line) if path else None,
+                fix="route the attention through veles_tpu.ops."
+                    "attention.flash_attention — its jax.custom_vjp "
+                    "covers the backward, root.common.engine.kernels "
+                    "keeps the XLA reference selectable, and the "
+                    "autotuned block sizes come from the device DB"))
+    return findings
+
+
 def _host_params(unit):
     """Best-effort host params pytree for a forward unit; ``None`` when
     unavailable (uninitialized weights, protocol error)."""
@@ -883,6 +1035,9 @@ def check_shapes(workflow, sample_shape=None, batch_size=None):
         # V-J11 — host-side finiteness probes (the in-program health
         # knob is the remedy)
         findings.extend(scan_finiteness_probes(unit))
+        # V-J12 — materialized O(S²) attention scores (the flash
+        # kernel is the remedy)
+        findings.extend(scan_attention_materialization(unit))
     decision = getattr(workflow, "decision", None)
     if decision is not None:
         findings.extend(scan_epoch_scan_hazards(decision))
@@ -907,15 +1062,15 @@ def check_shapes(workflow, sample_shape=None, batch_size=None):
         # fire only when flipping the CONFIG would actually engage the
         # path: a loader that is structurally ineligible (dataset not
         # resident — store_in_device_memory=False, e.g. bigger than
-        # HBM — or native-dtype fused input) would make the prescribed
-        # fix a no-op
+        # HBM) would make the prescribed fix a no-op.  native-dtype
+        # loaders are no longer excluded: the gather+normalize head
+        # (ops.gather.take_rows_norm) serves them on the same path.
         if getattr(loader, "is_initialized", False) \
                 and device is not None \
                 and not getattr(device, "is_interpret", True) \
                 and hasattr(loader, "device_fast_path_active") \
                 and not loader.device_fast_path_active \
-                and getattr(loader, "store_in_device_memory", False) \
-                and not getattr(loader, "native_device_dtype", False):
+                and getattr(loader, "store_in_device_memory", False):
             findings.append(Finding(
                 *_rule("V-J07"),
                 message="loader %r fills minibatches host-side every "

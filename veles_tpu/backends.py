@@ -180,6 +180,12 @@ class DeviceInfo(Pickleable):
             return {}
         with open(path, "r") as fin:
             raw = json.load(fin)
+        if (isinstance(raw, dict) and "devices" in raw
+                and set(raw) <= {"devices", "_this_run"}):
+            # scripts.autotune's stdout envelope ({"devices": ...,
+            # "_this_run": ...}) saved verbatim as a DB file — unwrap
+            # the devices table; _this_run is last-run provenance only
+            raw = raw["devices"]
         db = {}
         for model, ratings in raw.items():
             info = cls(model)

@@ -91,16 +91,17 @@ class FullBatchLoader(Loader):
         gather over the HBM-resident dataset (the loader-headed
         stitched segment).  Resolution of ``root.common.engine.loader``:
         ``host`` disables; ``device``/``auto`` engage whenever a jit
-        device is attached, the dataset is resident and normalized
-        float (``native_device_dtype`` keeps its symbolic normalizer
-        for the fused path only)."""
+        device is attached and the dataset is resident.  A
+        ``native_device_dtype`` loader rides the same path with the
+        gather+normalize HEAD (``ops.gather.take_rows_norm``): the raw
+        storage-dtype rows are read once and the first forward program
+        receives normalized float32."""
         mode = str(root.common.engine.get("loader", "auto")).lower()
         if mode == "host":
             return False
         return (self.device is not None
                 and not self.device.is_interpret
                 and self.store_in_device_memory
-                and not self.native_device_dtype
                 and bool(self.original_data))
 
     def create_minibatch_data(self):
@@ -236,14 +237,23 @@ class FullBatchLoader(Loader):
         the served span of the device-resident shuffled-index buffer is
         selected by the traced (offset, size) scalars, so one trace
         serves every batch of every class, short epoch tails included,
-        and the gather fuses into the first forward program."""
+        and the gather fuses into the first forward program.  With
+        ``native_device_dtype`` the data row instead goes through the
+        fused gather+normalize head
+        (:func:`veles_tpu.ops.gather.take_rows_norm`): the raw
+        storage-dtype bytes are read once and the segment's consumers
+        see normalized float32 — the affine normalizer never
+        materializes a float copy of the resident dataset."""
         from veles_tpu.stitch import StitchStage
         if not self.device_fast_path_active:
             return None
         import jax.numpy as jnp
+
+        from veles_tpu.ops.gather import take_rows_norm
         max_mb = int(self.max_minibatch_size)
         plan = self._device_stage_plan()
         pads = {name: pad for name, _src, _out, pad in plan}
+        norm = self.input_norm if self.native_device_dtype else None
 
         def fn(t):
             offset = t["offset"].astype(jnp.int32)
@@ -254,6 +264,14 @@ class FullBatchLoader(Loader):
                            jnp.where(valid, offset + pos, 0))
             out = {}
             for name in pads:
+                if norm is not None and name == "minibatch_data":
+                    # gather + affine normalize in one head kernel;
+                    # -1 rows zero AFTER the normalize, so the short-
+                    # batch padding contract (zeros) is unchanged
+                    out[name] = take_rows_norm(
+                        t["src_" + name],
+                        jnp.where(valid, idx, -1), norm)
+                    continue
                 rows = jnp.take(t["src_" + name], idx, axis=0)
                 mask = valid.reshape((-1,) + (1,) * (rows.ndim - 1))
                 out[name] = jnp.where(mask, rows, pads[name])

@@ -169,7 +169,7 @@ def _flash_fwd(q, k, v, causal=False, block_q=128, block_k=128,
         pltpu.VMEM((bq, 1), jnp.float32),
         pltpu.VMEM((bq, 1), jnp.float32),
     ]
-    params = pltpu.CompilerParams(
+    params = _COMPILER_PARAMS(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
     kw = dict(n_k=n_k, scale=scale, causal=causal, block_q=bq,
               block_k=bk, seq_k=sk)
@@ -363,7 +363,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal=False, block_q=128,
     ]
     dkv_scratch = [pltpu.VMEM((bk, d_p), jnp.float32),
                    pltpu.VMEM((bk, d_p), jnp.float32)]
-    params = pltpu.CompilerParams(
+    params = _COMPILER_PARAMS(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
     dq_kw = dict(n_k=n_k, scale=scale, causal=causal, block_q=bq,
                  block_k=bk, seq_k=sk)

@@ -639,6 +639,13 @@ def _choice_cached(kernel, model, dtype_name, level, shape_cls,
         if v2:
             entry = (v2.get(shape_cls) if shape_cls else None) \
                 or v2.get("seq_2k") or v2[sorted(v2)[0]]
+    elif kernel == "gd":
+        # fused backward-GD family: not precision-keyed (both arms
+        # accumulate f32 at default MXU precision by construction)
+        v2 = info.ratings.get("gd_v2", {}).get(dtype_name, {})
+        if v2:
+            entry = (v2.get(shape_cls) if shape_cls else None) \
+                or v2.get("fc_wide") or v2[sorted(v2)[0]]
     if entry is None:
         entry = info.ratings.get(kernel, {}).get(dtype_name)
     if not entry:
@@ -679,6 +686,8 @@ def gemm_choice(dtype, db_path=None, kernel="gemm", shape=None):
         shape_cls = None
     elif kernel.startswith("flash_attention"):
         shape_cls = classify_attn_shape(*shape)
+    elif kernel == "gd":
+        shape_cls = classify_gd_shape(*shape)
     else:
         shape_cls = classify_shape(*shape)
     return _choice_cached(kernel, model, numpy.dtype(dtype).name,
@@ -692,6 +701,145 @@ def tiles_for_gemm(dtype, db_path=None):
     """Look up autotuned Pallas tiles for the current device, or None."""
     choice = gemm_choice(dtype, db_path=db_path)
     return choice[1] if choice else None
+
+
+#: (bf, bn, bk) = (fan-in, neurons, batch) tile triples raced by
+#: :func:`autotune_gd` — bf/bn lane-aligned (128), bk sublane-aligned
+GD_TILE_CANDIDATES = (
+    (256, 256, 256), (512, 256, 256), (256, 512, 256),
+    (512, 512, 256), (128, 128, 512), (512, 512, 512),
+    (128, 256, 128),
+)
+
+#: fused-GD shape classes as (batch, fan_in, neurons) — the FC layers
+#: a stitched GD chain actually runs (AlexNet-ish fc6 / classifier head
+#: / thin-MLP hidden)
+GD_SHAPE_CLASSES = {
+    "fc_small": (128, 1024, 256),
+    "fc_wide": (128, 9216, 4096),
+    "fc_out": (128, 4096, 1000),
+}
+
+
+def classify_gd_shape(batch, f, n):
+    """Nearest :data:`GD_SHAPE_CLASSES` name in log space; the layer
+    dims dominate the tile choice, batch only weakly (it is the
+    sequential grid axis)."""
+    import math
+
+    def dist(rep):
+        return ((math.log2(max(int(f), 1)) - math.log2(rep[1])) ** 2
+                + (math.log2(max(int(n), 1)) - math.log2(rep[2])) ** 2
+                + 0.25 * (math.log2(max(int(batch), 1))
+                          - math.log2(rep[0])) ** 2)
+
+    return min(GD_SHAPE_CLASSES,
+               key=lambda c: dist(GD_SHAPE_CLASSES[c]))
+
+
+def _sweep_gd_shape(batch, f, n, dtype, candidates, runs, dtype_name):
+    """One (shape, dtype) fused-GD sweep: races the Pallas dW/db/dX +
+    epilogue family (``ops.gemm.gd_fused_pallas``) at each (bf, bn, bk)
+    against the dense reference (``znicz.gd._gd_math``, candidate
+    ``None``).  Returns ``({tiles: (sec, t1_rel_spread)}, flops)``."""
+    from veles_tpu.ops.gemm import gd_fused_pallas
+    from veles_tpu.znicz.gd import _gd_math
+
+    key = jax.random.key(f + n)
+    kx, ky, ke, kw, kv = jax.random.split(key, 5)
+    x = jax.random.normal(kx, (batch, f), jnp.float32).astype(dtype)
+    y = jax.random.normal(ky, (batch, n), jnp.float32).astype(dtype)
+    eo = jax.random.normal(ke, (batch, n), jnp.float32).astype(dtype)
+    w = jax.random.normal(kw, (f, n), jnp.float32) * 0.1
+    vw = jax.random.normal(kv, (f, n), jnp.float32) * 0.01
+    b = jnp.zeros((n,), jnp.float32)
+    vb = jnp.zeros((n,), jnp.float32)
+    hp = (0.01, 0.01, 0.0005, 0.0, 0.9, 0.9)
+    # dW (2BFN) + err_input (2BFN) + the elementwise epilogues
+    flops = 4.0 * batch * f * n
+    out = {}
+    for tiles in candidates:
+        try:
+            def unit(carry, t=tiles):
+                xx, s = carry
+                xx = jax.lax.dynamic_update_slice(
+                    xx, (xx[0:1, 0:1] +
+                         (s * 1e-30).astype(xx.dtype)), (0, 0))
+                fn = _gd_math if t is None else functools.partial(
+                    gd_fused_pallas, tiles=t)
+                w2, _b2, vw2, _vb2, err = fn(
+                    xx, y, eo, w, b, vw, vb, *hp, activation="tanh",
+                    need_err_input=True, has_bias=True)
+                # reduce over BOTH products so neither the update nor
+                # the err_input pass can be DCE'd out of either arm
+                return xx, (jnp.sum(jnp.abs(err), dtype=jnp.float32)
+                            + jnp.sum(jnp.abs(w2 + vw2),
+                                      dtype=jnp.float32))
+
+            init = (x, jnp.float32(0.0))
+            stats = {}
+
+            def run(_unit=unit, _init=init, _stats=stats):
+                return inprogram_marginal(_unit, _init, k1=4, k2=32,
+                                          repeats=max(runs, 2),
+                                          stats=_stats)
+
+            elapsed = _peak_guard(
+                run(), flops, run,
+                "autotune_gd %s %s %s" % ((batch, f, n), dtype_name,
+                                          tiles))
+        except Exception:
+            continue
+        out[tiles] = (elapsed, stats.get("t1_rel_spread"))
+    return out, flops
+
+
+def autotune_gd(shape=None, dtypes=("float32",),
+                candidates=GD_TILE_CANDIDATES, runs=2, save=True,
+                db_path=None, shape_classes=None):
+    """Sweep the fused backward-GD kernel family (dW+epilogue / db /
+    dX, ``ops.gemm.gd_fused_pallas``) against the dense ``_gd_math``
+    reference per :data:`GD_SHAPE_CLASSES` regime; persist winners
+    under ``gd_v2`` plus the legacy flat ``gd`` entry (the ``fc_wide``
+    canonical shape) consumed by ``ops.gemm.gd_kernel_choice`` when
+    ``root.common.engine.kernels=auto``.  Entries are not
+    precision-keyed: both arms accumulate float32 at default MXU
+    precision by construction (the dense reference sets no precision
+    either)."""
+    db_path = db_path or DEVICE_INFOS_JSON
+    model = jax.devices()[0].device_kind
+    db = DeviceInfo.load_db(db_path)
+    info = db.setdefault(model, DeviceInfo(model))
+    all_candidates = tuple(candidates) + (None,)   # None = dense _gd_math
+    if shape is not None:
+        worklist = [(classify_gd_shape(*shape), tuple(shape))]
+    else:
+        worklist = list((shape_classes or GD_SHAPE_CLASSES).items())
+    for dtype_name in dtypes:
+        dtype = jnp.dtype(dtype_name)
+        for cls, shp in worklist:
+            res, flops = _sweep_gd_shape(
+                shp[0], shp[1], shp[2], dtype, all_candidates, runs,
+                dtype_name)
+            if not res:
+                continue
+            best = min(res, key=lambda c: res[c][0])
+            sec, spread = res[best]
+            entry = {"sec_per_flop": sec / flops,
+                     "backend": "xla" if best is None else "pallas",
+                     "tiles": None if best is None else list(best),
+                     "shape": list(shp),
+                     "t1_rel_spread": spread}
+            (info.ratings.setdefault("gd_v2", {})
+             .setdefault(dtype_name, {}))[cls] = entry
+            if cls == "fc_wide" or len(worklist) == 1:
+                info.ratings.setdefault("gd", {})[dtype_name] = {
+                    k: entry[k] for k in
+                    ("sec_per_flop", "backend", "tiles")}
+    if save:
+        DeviceInfo.save_db(db, db_path)
+    gemm_choice.cache_clear()
+    return info
 
 
 #: (block_q, block_k) flash-attention sweep — VMEM-bounded MXU tilings

@@ -74,6 +74,118 @@ def take_rows(data, indices, use_pallas=None):
     return _gather_jnp(data, indices)
 
 
+def take_rows_norm(data, indices, norm, use_pallas=None):
+    """Fused gather + affine normalize: float32
+    ``data[indices]*scale + shift`` with negative indices producing
+    ZERO rows (masking applies AFTER the normalize, so a short batch's
+    padding stays 0 rather than ``shift``).
+
+    This is the fullbatch loader's native-dtype head: the dataset stays
+    resident in its storage dtype (e.g. uint8 pixels) and the first
+    forward program receives normalized float32 — the gather's DMA and
+    the normalizer's multiply-add are one kernel, so the raw bytes are
+    read exactly once.  ``norm`` is the loader's affine
+    ``(scale, shift)`` pair (``NormalizerBase.as_affine``): scalars or
+    flat per-feature arrays.  Dispatch mirrors :func:`take_rows` (the
+    gather A/B verdict transfers: the epilogue adds two VPU ops to a
+    DMA-bound kernel)."""
+    from veles_tpu.config import root   # deferred: import cycle
+    scale, shift = norm
+    auto = use_pallas is None
+    if auto:
+        from veles_tpu.ops import on_tpu
+        forced = root.common.engine.get("pallas_gather", None)
+        if isinstance(forced, bool):
+            interp = bool(root.common.engine.get("interpret", False))
+            use_pallas = forced and (on_tpu() or interp)
+            auto = False
+        else:
+            from veles_tpu.ops.benchmark import gather_choice
+            f = int(numpy.prod(data.shape[1:])) if data.ndim >= 2 \
+                else None
+            measured = gather_choice(str(jnp.dtype(data.dtype)),
+                                     row_elems=f)
+            use_pallas = bool(measured) and on_tpu()
+    key = ("norm", data.shape[1:], str(jnp.dtype(data.dtype)))
+    if use_pallas and data.ndim >= 2 \
+            and (not auto or key not in _PALLAS_REJECTED):
+        try:
+            flat = data.reshape(data.shape[0], -1)
+            f = flat.shape[1]
+            out = _gather_norm_pallas(
+                flat, indices,
+                _norm_row(scale, f), _norm_row(shift, f),
+                interpret=bool(root.common.engine.get("interpret",
+                                                      False)))
+            return out.reshape((indices.shape[0],) + data.shape[1:])
+        except Exception:
+            if not auto:
+                raise
+            _PALLAS_REJECTED.add(key)
+    return _gather_norm_jnp(data, indices,
+                            jnp.asarray(scale, jnp.float32),
+                            jnp.asarray(shift, jnp.float32))
+
+
+def _norm_row(v, f):
+    """scale/shift as a (1, f) float32 row the kernel broadcasts."""
+    v = jnp.asarray(v, jnp.float32)
+    return jnp.broadcast_to(v.reshape(1, -1), (1, f))
+
+
+@jax.jit
+def _gather_norm_jnp(data, indices, scale, shift):
+    taken = jnp.take(data, jnp.maximum(indices, 0), axis=0)
+    flat = taken.reshape(taken.shape[0], -1).astype(jnp.float32)
+    normed = (flat * scale.reshape(1, -1)
+              + shift.reshape(1, -1)).reshape(taken.shape)
+    mask = (indices >= 0).reshape((-1,) + (1,) * (data.ndim - 1))
+    return jnp.where(mask, normed, 0.0)
+
+
+def _gather_norm_kernel(idx_ref, data_ref, scale_ref, shift_ref, o_ref):
+    i = pl.program_id(0)
+    valid = idx_ref[i] >= 0
+
+    @pl.when(valid)
+    def _copy():
+        o_ref[:] = (data_ref[:].astype(jnp.float32)
+                    * scale_ref[:].reshape(1, 1, -1)
+                    + shift_ref[:].reshape(1, 1, -1))
+
+    @pl.when(jnp.logical_not(valid))
+    def _zero():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _gather_norm_pallas(data, indices, scale, shift, interpret=False):
+    # same (n, 1, f) / (1, 1, f) block trick as _gather_pallas (block
+    # dims equal to array dims sidestep the sublane rule); scale/shift
+    # ride as whole-array (1, f) operands every grid point maps to
+    n, f = data.shape
+    b = indices.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, 1, f), lambda i, idx_ref: (jnp.maximum(
+                idx_ref[i], 0), 0, 0)),
+            pl.BlockSpec((1, f), lambda i, idx_ref: (0, 0)),
+            pl.BlockSpec((1, f), lambda i, idx_ref: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, f), lambda i, idx_ref: (i, 0, 0)),
+    )
+    out = pl.pallas_call(
+        _gather_norm_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 1, f), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(indices, jnp.int32), data.reshape(n, 1, f),
+      scale, shift)
+    return out.reshape(b, f)
+
+
 #: (row shape, dtype) pairs the Pallas kernel rejected at trace time
 #: this process (auto-dispatch only; forced callers see the error)
 _PALLAS_REJECTED = set()

@@ -74,6 +74,7 @@ def _matmul_kernel(a_ref, b_ref, bias_ref, o_ref, acc_ref, *, n_k,
         o_ref[:] = acc.astype(o_ref.dtype)
 
 
+from veles_tpu.ops.util import COMPILER_PARAMS as _COMPILER_PARAMS
 from veles_tpu.ops.util import pad_axis as _pad_to_impl, round_up
 
 
@@ -113,7 +114,7 @@ def _matmul_pallas(a, b, bias, activation=None, tiles=None, out_dtype=None,
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(a_p, b_p, bias_p)
@@ -213,3 +214,281 @@ def _matmul_bwd(activation, tiles, use_pallas, residuals, g):
 
 
 matmul.defvjp(_matmul_fwd, _matmul_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused backward-pass GD kernels — dW / db / dX with the weight-decay +
+# momentum update folded into the dW epilogue, updating the DONATED
+# parameter buffers in place.  The dense reference is
+# ``znicz.gd._gd_math``; these kernels reproduce it block-tiled:
+#
+#     δ = err_output ⊙ act'(y)        (recomputed per block — cheaper
+#                                      than materializing (B, N) in HBM)
+#     dW = xᵀ·δ / B ;  v' = m·v − lr·(dW + λ·W) ;  W' = W + v'
+#     db = Σδ / B  (own small kernel) ;  err_input = δ·Wᵀ  (δ·W transposed)
+#
+# Hyper-parameters ride as a (1, 128) float32 VMEM operand (block ==
+# array dims, so no tiling constraint) because they are TRACED scalars
+# — an LRAdjuster rescaling them must not retrace, mirroring the
+# stitched `_gd_math` contract.
+# ---------------------------------------------------------------------------
+
+#: fallback (bf, bn, bk) = (fan-in, neurons, batch) tiles for the GD
+#: kernel family when the autotune DB (``ops.benchmark.autotune_gd``)
+#: has no measurement for this device generation
+GD_DEFAULT_TILES = (256, 256, 256)
+
+#: hp operand layout: [0]=lr [1]=lr_bias [2]=decay [3]=decay_bias
+#: [4]=moment [5]=moment_bias [6]=1/batch
+(_HP_LR, _HP_LR_B, _HP_DECAY, _HP_DECAY_B, _HP_MOM, _HP_MOM_B,
+ _HP_INVB) = range(7)
+
+#: activation derivatives from the *output* (Znicz convention) —
+#: duplicated from ``znicz.gd._DERIVS`` because ops must not import
+#: znicz (gd.py imports from here, not the reverse)
+_GD_DERIVS = {
+    None: lambda y: jnp.ones_like(y),
+    "tanh": lambda y: y * y * (-0.388484177) + 1.14381894,
+    "sigmoid": lambda y: y * (1.0 - y),
+    "relu": lambda y: 1.0 - jnp.exp(-y),
+    "strict_relu": lambda y: (y > 0).astype(y.dtype),
+}
+
+
+def _gd_delta(eo_ref, y_ref, activation):
+    return (eo_ref[:].astype(jnp.float32)
+            * _GD_DERIVS[activation](y_ref[:].astype(jnp.float32)))
+
+
+def _gd_dw_kernel(x_ref, eo_ref, y_ref, w_ref, vw_ref, hp_ref, w_out,
+                  vw_out, acc_ref, *, n_k, activation, transposed):
+    """Grid (F/bf, N/bn, B/bk); batch is the sequential axis.  The
+    weight/momentum blocks live in the STORAGE layout ((N, F) when
+    transposed) — the transpose is absorbed by swapping the dot operand
+    order, never by relaying out a block."""
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    delta = _gd_delta(eo_ref, y_ref, activation)
+    x = x_ref[:].astype(jnp.float32)
+    if transposed:
+        # storage (N, F): accumulate δᵀ·x directly in that layout
+        acc_ref[:] += jax.lax.dot_general(
+            delta, x, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        acc_ref[:] += jax.lax.dot_general(
+            x, delta, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _epilogue():
+        hp = hp_ref[:]
+        grad = acc_ref[:] * hp[0, _HP_INVB]
+        w = w_ref[:].astype(jnp.float32)
+        v_new = hp[0, _HP_MOM] * vw_ref[:].astype(jnp.float32) \
+            - hp[0, _HP_LR] * (grad + hp[0, _HP_DECAY] * w)
+        w_out[:] = (w + v_new).astype(w_out.dtype)
+        vw_out[:] = v_new.astype(vw_out.dtype)
+
+
+def _gd_db_kernel(eo_ref, y_ref, b_ref, vb_ref, hp_ref, b_out, vb_out,
+                  acc_ref, *, n_k, activation):
+    """Grid (N/bn, B/bk): the bias row accumulates Σδ over batch blocks
+    into a (1, bn) scratch, then applies the same fused update."""
+    kk = pl.program_id(1)
+
+    @pl.when(kk == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    delta = _gd_delta(eo_ref, y_ref, activation)
+    acc_ref[:] += jnp.sum(delta, axis=0, keepdims=True)
+
+    @pl.when(kk == n_k - 1)
+    def _epilogue():
+        hp = hp_ref[:]
+        grad = acc_ref[:] * hp[0, _HP_INVB]
+        b = b_ref[:].astype(jnp.float32)
+        v_new = hp[0, _HP_MOM_B] * vb_ref[:].astype(jnp.float32) \
+            - hp[0, _HP_LR_B] * (grad + hp[0, _HP_DECAY_B] * b)
+        b_out[:] = (b + v_new).astype(b_out.dtype)
+        vb_out[:] = v_new.astype(vb_out.dtype)
+
+
+def _gd_dx_kernel(eo_ref, y_ref, w_ref, o_ref, acc_ref, *, n_k,
+                  activation, transposed):
+    """Grid (B/bk, F/bf, N/bn): err_input = δ·Wᵀ (δ·W when the storage
+    is transposed) against the PRE-update weights — the caller passes
+    the original weight array, so standard backprop semantics hold even
+    though the dW kernel updates the same logical buffer."""
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    delta = _gd_delta(eo_ref, y_ref, activation)
+    w = w_ref[:].astype(jnp.float32)
+    contract = (((1,), (0,)), ((), ())) if transposed \
+        else (((1,), (1,)), ((), ()))
+    acc_ref[:] += jax.lax.dot_general(
+        delta, w, contract, preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _out():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def gd_fused_pallas(x, y, err_output, w, b, vw, vb, lr, lr_bias, decay,
+                    decay_bias, moment, moment_bias, activation=None,
+                    need_err_input=True, has_bias=True, transposed=False,
+                    tiles=None, interpret=False):
+    """Pallas twin of ``znicz.gd._gd_math`` — same positional signature,
+    same ``(w, b, vw, vb, err_input)`` returns (``b``/``vb`` pass
+    through untouched when ``has_bias`` is false, ``err_input`` is
+    ``None`` when not needed).  Numerics: float32 accumulation like the
+    reference, but block-tiled summation order, so parity vs the XLA
+    path is documented-tolerance (~1e-5 relative), not bitwise."""
+    batch = x.shape[0]
+    x2 = x.reshape(batch, -1)
+    eo = err_output.reshape(batch, -1)
+    y2 = y.reshape(batch, -1)
+    f, n = x2.shape[1], eo.shape[1]
+    bf, bn, bk = tiles or GD_DEFAULT_TILES
+    bf = min(bf, round_up(f, 128))
+    bn = min(bn, round_up(n, 128))
+    bk = min(bk, round_up(batch, 8))
+    x_p = _pad_to(_pad_to(x2, bk, 0), bf, 1)
+    eo_p = _pad_to(_pad_to(eo, bk, 0), bn, 1)
+    y_p = _pad_to(_pad_to(y2, bk, 0), bn, 1)
+    if transposed:
+        w_p = _pad_to(_pad_to(w, bn, 0), bf, 1)
+        vw_p = _pad_to(_pad_to(vw, bn, 0), bf, 1)
+        w_spec = pl.BlockSpec((bn, bf), lambda i, j, kk: (j, i))
+        acc_shape = (bn, bf)
+    else:
+        w_p = _pad_to(_pad_to(w, bf, 0), bn, 1)
+        vw_p = _pad_to(_pad_to(vw, bf, 0), bn, 1)
+        w_spec = pl.BlockSpec((bf, bn), lambda i, j, kk: (i, j))
+        acc_shape = (bf, bn)
+    bp, fp = x_p.shape
+    np_ = eo_p.shape[1]
+    n_kb = bp // bk
+    hp = jnp.zeros((1, 128), jnp.float32).at[0, :7].set(jnp.stack(
+        [jnp.asarray(v, jnp.float32) for v in
+         (lr, lr_bias, decay, decay_bias, moment, moment_bias)]
+        + [jnp.float32(1.0 / batch)]))
+    hp_spec = pl.BlockSpec((1, 128), lambda *_: (0, 0))
+
+    # err_input FIRST (traced order is irrelevant to XLA, but keeping
+    # the pre-update weight read textually before the aliased update
+    # makes the intent obvious)
+    if need_err_input:
+        err_input = pl.pallas_call(
+            functools.partial(_gd_dx_kernel, n_k=np_ // bn,
+                              activation=activation,
+                              transposed=transposed),
+            grid=(bp // bk, fp // bf, np_ // bn),
+            in_specs=[
+                pl.BlockSpec((bk, bn), lambda i, j, kk: (i, kk)),
+                pl.BlockSpec((bk, bn), lambda i, j, kk: (i, kk)),
+                pl.BlockSpec((bn, bf), lambda i, j, kk: (kk, j))
+                if transposed else
+                pl.BlockSpec((bf, bn), lambda i, j, kk: (j, kk)),
+            ],
+            out_specs=pl.BlockSpec((bk, bf), lambda i, j, kk: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((bp, fp), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((bk, bf), jnp.float32)],
+            compiler_params=_COMPILER_PARAMS(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+            interpret=interpret,
+        )(eo_p, y_p, w_p)[:batch, :f]
+    else:
+        err_input = None
+
+    w_new, vw_new = pl.pallas_call(
+        functools.partial(_gd_dw_kernel, n_k=n_kb,
+                          activation=activation, transposed=transposed),
+        grid=(fp // bf, np_ // bn, n_kb),
+        in_specs=[
+            pl.BlockSpec((bk, bf), lambda i, j, kk: (kk, i)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            w_spec, w_spec, hp_spec,
+        ],
+        out_specs=[w_spec, w_spec],
+        out_shape=[jax.ShapeDtypeStruct(w_p.shape, w.dtype),
+                   jax.ShapeDtypeStruct(vw_p.shape, vw.dtype)],
+        scratch_shapes=[pltpu.VMEM(acc_shape, jnp.float32)],
+        input_output_aliases={3: 0, 4: 1},
+        compiler_params=_COMPILER_PARAMS(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x_p, eo_p, y_p, w_p, vw_p, hp)
+    if transposed:
+        w_new, vw_new = w_new[:n, :f], vw_new[:n, :f]
+    else:
+        w_new, vw_new = w_new[:f, :n], vw_new[:f, :n]
+
+    if has_bias:
+        b_p = _pad_to(b.reshape(1, -1), bn, 1)
+        vb_p = _pad_to(vb.reshape(1, -1), bn, 1)
+        row = pl.BlockSpec((1, bn), lambda i, kk: (0, i))
+        b_new, vb_new = pl.pallas_call(
+            functools.partial(_gd_db_kernel, n_k=n_kb,
+                              activation=activation),
+            grid=(np_ // bn, n_kb),
+            in_specs=[
+                pl.BlockSpec((bk, bn), lambda i, kk: (kk, i)),
+                pl.BlockSpec((bk, bn), lambda i, kk: (kk, i)),
+                row, row,
+                pl.BlockSpec((1, 128), lambda i, kk: (0, 0)),
+            ],
+            out_specs=[row, row],
+            out_shape=[jax.ShapeDtypeStruct(b_p.shape, b.dtype),
+                       jax.ShapeDtypeStruct(vb_p.shape, vb.dtype)],
+            scratch_shapes=[pltpu.VMEM((1, bn), jnp.float32)],
+            input_output_aliases={2: 0, 3: 1},
+            compiler_params=_COMPILER_PARAMS(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(eo_p, y_p, b_p, vb_p, hp)
+        b_new = b_new[0, :n].reshape(b.shape)
+        vb_new = vb_new[0, :n].reshape(vb.shape)
+    else:
+        b_new, vb_new = b, vb
+    return w_new, b_new, vw_new, vb_new, err_input
+
+
+def gd_kernel_choice(dtype=jnp.float32, shape=None, db_path=None):
+    """Resolve the training-kernel backend for the fused GD stage —
+    ``(backend, tiles, interpret)``.
+
+    ``root.common.engine.kernels``: ``xla`` forces the dense reference
+    (``_gd_math``); ``pallas`` forces the fused kernels — compiled on
+    TPU, interpret-mode Pallas elsewhere (parity/debug; slow); ``auto``
+    (default) takes the autotune DB's measured winner on TPU
+    (``ops.benchmark.autotune_gd``) and the dense reference elsewhere.
+    Runs at stage-build/trace time only, so the DB lookup costs nothing
+    per step and the resolved backend never retraces."""
+    from veles_tpu.config import root
+    from veles_tpu.ops import on_tpu
+    mode = str(root.common.engine.get("kernels", "auto") or "auto")
+    tpu = on_tpu()
+    tiles = None
+    if tpu and mode != "xla":
+        from veles_tpu.ops.benchmark import gemm_choice
+        choice = gemm_choice(dtype, db_path, kernel="gd", shape=shape)
+        tiles = tuple(choice[1]) if choice and choice[1] else None
+        if mode != "pallas" and (choice is None or choice[0] != "pallas"):
+            return "xla", None, False
+    elif mode != "pallas":
+        return "xla", None, False
+    if mode == "xla":
+        return "xla", None, False
+    return "pallas", tiles, not tpu

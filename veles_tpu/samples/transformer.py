@@ -124,6 +124,16 @@ def _attend(q, k, v, mesh, seq_axis):
     # must run per-shard inside shard_map — otherwise XLA all-gathers
     # the activations and every chip does the full attention.
     from veles_tpu.ops.attention import flash_attention
+    from veles_tpu.config import root
+    if str(root.common.engine.get("kernels", "auto")).lower() == "xla" \
+            and mesh is None:
+        # the dense XLA reference WITHOUT the blockwise custom_vjp:
+        # AD materializes the [B,H,S,S] scores in the backward — the
+        # bench ladder's same-run baseline arm
+        # (stage_transformer_lm_train) and the escape hatch when the
+        # flash kernels are suspect
+        from veles_tpu.ops.attention import _mha_jnp
+        return _mha_jnp(q, k, v, True)[0]
     if mesh is None:
         return flash_attention(q, k, v, True)
     from jax.experimental.shard_map import shard_map

@@ -7,8 +7,9 @@ One command on real hardware:
 runs the device-power rating (13-chain matmul, ref
 ``accelerated_units.py:706-825``), the Pallas-vs-XLA GEMM tile sweep,
 the int8-weight serving GEMM sweep (``ratings["gemm_int8"]``,
-``--skip-int8``) and the flash-attention block sweep, and persists the
-winners to
+``--skip-int8``), the flash-attention block sweep and the fused
+backward-GD sweep (``ratings["gd_v2"]``, ``--skip-gd``), and persists
+the winners to
 ``veles_tpu/devices/device_infos.json`` (ref
 ``/root/reference/devices/device_infos.json``, filled by
 ``backends.py:623-744``).  ``ops.gemm.matmul`` and
@@ -39,6 +40,7 @@ def main(argv=None):
     parser.add_argument("--skip-gemm", action="store_true")
     parser.add_argument("--skip-int8", action="store_true")
     parser.add_argument("--skip-attention", action="store_true")
+    parser.add_argument("--skip-gd", action="store_true")
     parser.add_argument("--skip-s2d", action="store_true")
     parser.add_argument("--skip-gather", action="store_true")
     args = parser.parse_args(argv)
@@ -113,6 +115,22 @@ def main(argv=None):
             save=not args.quick)
         print("flash_attention_bwd_v2: %s" % json.dumps(
             info.ratings.get("flash_attention_bwd_v2", {})),
+            file=sys.stderr)
+
+    if not args.skip_gd:
+        # fused backward-GD family (dW+optimizer epilogue / db / dX,
+        # ops.gemm.gd_fused_pallas) vs the dense _gd_math reference —
+        # the winner is what znicz.gd consults when
+        # root.common.engine.kernels=auto.  Quick mode measures a toy
+        # shape: measure + print only, never overwrite production
+        # winners (the quick-pass-poisons-rating hazard class).
+        shape = (32, 512, 256) if args.quick else None
+        info = benchmark.autotune_gd(
+            shape=shape, runs=1 if args.quick else 2, db_path=db_path,
+            save=not args.quick)
+        print("gd_v2%s: %s" % (
+            " (quick, NOT saved)" if args.quick else "",
+            json.dumps(info.ratings.get("gd_v2", {}))),
             file=sys.stderr)
 
     if not args.skip_s2d:

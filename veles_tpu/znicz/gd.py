@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy
 
+from veles_tpu.ops.gemm import gd_fused_pallas, gd_kernel_choice
 from veles_tpu.znicz.nn_units import GradientDescentBase
 
 _DERIVS = {
@@ -77,6 +78,24 @@ _gd_step = functools.partial(jax.jit, static_argnames=(
     "activation", "need_err_input", "has_bias", "transposed"),
     donate_argnums=(3, 4, 5, 6))(_gd_math)
 
+#: eager twin of ``_gd_step`` over the fused Pallas kernel family
+#: (``ops.gemm.gd_fused_pallas``) — same donation contract, so the
+#: dW epilogue's in-place update really lands on the HBM buffers
+_gd_fused_step = functools.partial(jax.jit, static_argnames=(
+    "activation", "need_err_input", "has_bias", "transposed", "tiles",
+    "interpret"),
+    donate_argnums=(3, 4, 5, 6))(gd_fused_pallas)
+
+
+def _gd_backend(input_shape, err_shape):
+    """Resolve (backend, tiles, interpret) for this unit's shapes via
+    the ``root.common.engine.kernels`` knob + autotune DB — called at
+    stage-build / dispatch time, never inside a trace."""
+    batch = int(input_shape[0])
+    f = int(numpy.prod(input_shape[1:], dtype=numpy.int64))
+    n = int(numpy.prod(err_shape[1:], dtype=numpy.int64))
+    return gd_kernel_choice(jnp.float32, shape=(batch, f, n))
+
 
 class GradientDescent(GradientDescentBase):
     """Backward for plain All2All (identity activation)."""
@@ -118,7 +137,11 @@ class GradientDescent(GradientDescentBase):
 
     def tpu_run(self):
         has_bias = bool(self.include_bias and self.bias)
-        w, b, vw, vb, err_input = _gd_step(
+        backend, tiles, interp = _gd_backend(self.input.devmem.shape,
+                                             self.err_output.devmem.shape)
+        step = _gd_step if backend == "xla" else functools.partial(
+            _gd_fused_step, tiles=tiles, interpret=interp)
+        w, b, vw, vb, err_input = step(
             self.input.devmem, self.output.devmem, self.err_output.devmem,
             self.weights.devmem,
             self.bias.devmem if has_bias else jnp.zeros((1,), jnp.float32),
@@ -162,11 +185,19 @@ class GradientDescent(GradientDescentBase):
         need_err_input = self.need_err_input
         transposed = self.weights_transposed
         input_shape = tuple(self.input.shape)
+        # kernel backend resolved ONCE at stage build — a closure
+        # constant, so epoch_scan windows and PodRuntime shardings see
+        # a stable program (zero steady-state recompiles) and the
+        # psum/ledger accounting is untouched
+        backend, tiles, interp = _gd_backend(
+            input_shape, tuple(self.err_output.shape))
         unit = self
 
         def fn(t):
             placeholder = jnp.zeros((1,), jnp.float32)
-            w, b, vw, vb, err_input = _gd_math(
+            math = _gd_math if backend == "xla" else functools.partial(
+                gd_fused_pallas, tiles=tiles, interpret=interp)
+            w, b, vw, vb, err_input = math(
                 t["input"], t["output"], t["err_output"],
                 t["w"], t.get("b", placeholder),
                 t["vw"], t.get("vb", placeholder),

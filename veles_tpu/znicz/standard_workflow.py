@@ -187,12 +187,27 @@ class StandardWorkflow(AcceleratedWorkflow):
             self.link_loop_and_end()
             return
         if getattr(self.loader, "native_device_dtype", False):
-            # the eager forward units consume minibatch_data directly
-            # and have no in-step normalization hook — silent training
-            # on raw integers must never happen
-            raise ValueError(
-                "native_device_dtype loaders require fused=True (the "
-                "affine normalizer is applied inside the fused step)")
+            # eager forward units consume minibatch_data directly and
+            # have no in-step normalization hook — silent training on
+            # raw integers must never happen.  The stitched device fast
+            # path lifts this: its gather+normalize HEAD
+            # (FullBatchLoader.stitch_stage → ops.gather.take_rows_norm)
+            # hands the first forward normalized float32, so fused=False
+            # is legal whenever that head can engage.
+            from veles_tpu.config import root
+            eng = root.common.engine
+            stitched_norm = (
+                str(eng.get("stitch", "on")).lower()
+                not in ("off", "0", "false")
+                and str(eng.get("loader", "auto")).lower() != "host"
+                and not bool(eng.get("interpret", False)))
+            if not stitched_norm:
+                raise ValueError(
+                    "native_device_dtype loaders require fused=True or "
+                    "the stitched device fast path (engine.stitch=on, "
+                    "engine.loader!=host, no interpret mode): the "
+                    "affine normalizer is applied inside the fused "
+                    "step or the stitched gather+normalize head")
         self.link_forwards()
         self.link_evaluator()
         self.link_decision()
