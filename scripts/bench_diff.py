@@ -86,8 +86,14 @@ _COUNTERS = ("recompiles", "dispatches_per_epoch")
 #: stages (transformer_lm_train: fused kernels over the XLA-kernel
 #: baseline measured in the SAME process — the ratio eroding means
 #: the fused path lost ground even if absolute throughput moved)
+#: prefix_hit_rate / spec_accept_rate / vs_nonspec_x: the
+#: prefix-cache + speculative-decode record — pages served from the
+#: radix tree, drafted tokens the verify accepted, and the
+#: tokens/s win over the same-run plain paged line all regress when
+#: they fall
 _HIGHER_BETTER_FIELDS = ("mfu", "steps_per_dispatch", "vs_bf16_x",
-                         "vs_baseline")
+                         "vs_baseline", "prefix_hit_rate",
+                         "spec_accept_rate", "vs_nonspec_x")
 _LOWER_BETTER_FIELDS = ("sec_per_step", "hbm_per_request_bytes",
                         "ttft_p99_ms", "handoff_bytes_per_request",
                         "autoscaler_actions")

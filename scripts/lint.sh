@@ -60,7 +60,12 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m veles_tpu.chaos --smoke
 # quantization, the qgemm dequant-epilogue path) must complete the
 # same budgets with zero steady-state compiles, a params footprint
 # <=0.35x its float twin and the calibration drift gate green
-# (docs/services.md § Quantized serving)
+# (docs/services.md § Quantized serving); a fourth PREFIX+SPEC
+# session (radix prefix cache + n-gram speculative decode) must
+# bitwise-match a plain paged session on a shared-prefix workload
+# while actually sharing pages across live slots, evicting only
+# cache-only pages and accepting drafted tokens (docs/services.md
+# § Prefix cache & speculative decode)
 echo "== gen smoke (generative serving + paged KV gate) =="
 timeout -k 10 180 env JAX_PLATFORMS=cpu python -m veles_tpu.gen --smoke
 # obs smoke: the fleet-observability gate — with tracing off every

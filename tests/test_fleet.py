@@ -174,6 +174,43 @@ class TestElasticity(object):
         assert fleet.replayed_total == replayed
         assert prof.ledger.recompiles == before
 
+    def test_shared_prefix_streams_survive_drain(self):
+        """PR 19 interaction: with the radix cache on, co-resident
+        shared-prefix streams hold refcounted pages — export must not
+        ship a page another slot still references, adoption must
+        copy-on-adopt only the unshared tail, and a mid-decode drain
+        replays everything onto the survivor bitwise-intact."""
+        stem = [(i * 5 + 2) % CFG["vocab"] for i in range(13)]
+        workload = [
+            (numpy.asarray(stem + [30 + i], numpy.int32), 24)
+            for i in range(4)]
+        expected = oracle_streams(workload)
+        cached = Fleet(
+            lambda: build_engine(prefix_cache="on"),
+            decode_replicas=2, name="px", rpc_timeout_ms=600,
+            heartbeat_interval=0.1, max_queue=64).start()
+        try:
+            futures = [cached.submit(toks, max_new)
+                       for toks, max_new in workload]
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                if any(s.active_requests()
+                       for s in cached.router.engines()):
+                    break
+                time.sleep(0.005)
+            cached.drain_replica()
+            results = [f.result(timeout=120.0) for f in futures]
+            assert results == expected
+            assert len(cached.router) == 1
+            # the survivor really shares: every replayed stream
+            # re-derives the same stem, adopted copy-on-write
+            survivor = cached.router.engines()[0].engine
+            assert survivor.prefix_shared_pages_total >= 1
+            assert cached.handoffs_total >= len(workload)
+        finally:
+            cached.stop(drain=False)
+            cached.close()
+
     def test_chaos_replica_drain_via_tick(self, fleet):
         """The chaos ``replica_drain`` process action drives the same
         drain through ``Fleet.tick`` — and refuses to fire the fleet

@@ -1156,3 +1156,526 @@ def test_paged_capacity_and_chunked_ttft_closed_loop():
     assert chunk_p99 < whole_p99, \
         "co-resident TTFT p99: chunked %.3fs vs whole-prompt %.3fs" \
         % (chunk_p99, whole_p99)
+
+
+# -- prefix cache + speculative decode --------------------------------------
+
+def shared_workload(n=6, stem_len=13, max_new=6, first=20):
+    """n requests re-deriving one common stem — the agent-traffic
+    shape the radix cache exists for (stem pages shareable, one
+    distinct suffix token each)."""
+    stem = [(i * 7 + 3) % CFG["vocab"] for i in range(stem_len)]
+    return [(stem + [first + i], max_new) for i in range(n)]
+
+
+def spec_workload(n=4, max_new=10, first=30):
+    """Repetitive prompts the n-gram proposer can exploit."""
+    stem = (list(range(2, 10)) * 3)[:18]
+    return [(stem + [first + i], max_new) for i in range(n)]
+
+
+def test_block_pool_refcount_sharing():
+    """BlockPool refcount unit: admission over shared pages increfs
+    before allocating (with rollback), truncate/release decref
+    instead of free, and pages_saved prices the sharing."""
+    from veles_tpu.gen.paged import BlockPool, PoolExhausted
+    pool = BlockPool(slots=4, max_blocks=4, num_blocks=9,
+                     block_size=8)
+    owner = pool.admit(0, 17)                # pages 1, 2, 3
+    assert owner == [1, 2, 3]
+    assert [pool.refcount(b) for b in owner] == [1, 1, 1]
+    pool.incref(1)
+    pool.incref(2)                           # the cache registers two
+    assert pool.pages_saved() == 0           # registration != sharing
+    shared = pool.admit(1, 20, shared=(1, 2))
+    assert shared == [1, 2, 4]               # lowest-id-first suffix
+    assert pool.refcount(1) == 3 and pool.refcount(2) == 3
+    assert pool.pages_saved() == 2           # slot 1 skipped two pages
+    assert pool.blocks_used == 4             # 1, 2, 3, 4 — shared once
+    # truncate drops only the UNSHARED tail page
+    assert pool.truncate(1, 16) == 1         # one page off the table
+    assert pool.refcount(4) == 0             # freed for reuse
+    assert pool.refcount(1) == 3             # shared pages untouched
+    # release decrefs — the cache's ref keeps the pages alive
+    pool.release(0)
+    assert pool.refcount(3) == 0
+    assert pool.refcount(1) == 2 and pool.refcount(2) == 2
+    # rollback: an admit that cannot fit must not leak increfs
+    pool.admit(0, 32)                        # 4 pages
+    pool.admit(2, 16)                        # 2 pages: pool now full
+    with pytest.raises(PoolExhausted):
+        pool.admit(3, 24, shared=(1, 2))     # needs 1 fresh, has 0
+    assert pool.refcount(1) == 2 and pool.refcount(2) == 2
+
+
+def test_prefix_radix_tree_unit():
+    """PrefixCache unit: page-granular radix match capped at the last
+    FULL page, per-tag isolation, LRU-leaf eviction that never frees
+    a page with live slot refs, and reclaimable() accounting."""
+    from veles_tpu.gen.paged import BlockPool
+    from veles_tpu.gen.prefix import PrefixCache
+    pool = BlockPool(slots=2, max_blocks=8, num_blocks=17,
+                     block_size=4)
+    cache = PrefixCache(pool)
+    toks = list(range(100, 117))             # 17 tokens, 4 full pages
+    bids = pool.admit(0, 17)                 # pages 1..5
+    cache.insert(toks, bids[:4], tag="b0")
+    assert cache.match(toks, tag="b0") == bids[:4]
+    # the LAST token never matches: >= 1 suffix token stays unshared
+    assert cache.match(toks[:17], tag="b0") == bids[:4]
+    assert cache.match(toks[:9], tag="b0") == bids[:2]
+    assert cache.match(toks, tag="chunk8") == []     # tag isolation
+    diverged = toks[:6] + [999] + toks[7:]
+    assert cache.match(diverged, tag="b0") == bids[:1]
+    # live slot refs pin every page: eviction must free NOTHING
+    assert cache.cache_only_pages() == 0
+    assert cache.reclaimable() == 0
+    assert cache.evict(4) == 0
+    assert pool.refcount(bids[0]) == 2
+    # slot gone -> the whole chain is cache-only and reclaimable
+    pool.release(0)
+    assert cache.cache_only_pages() == 4
+    assert cache.reclaimable() == 4
+    assert cache.evict(2) == 2               # deepest leaves first
+    assert cache.match(toks, tag="b0") == bids[:2]
+    assert pool.refcount(bids[3]) == 0       # actually freed
+    cache.clear()
+    assert cache.match(toks, tag="b0") == []
+    assert pool.blocks_used == 0
+
+
+def test_prefix_cache_parity_and_sharing():
+    """THE prefix gate: prefix_cache=on produces BITWISE the plain
+    engine's streams — continuous, sequential, static, chunked — on a
+    shared-stem workload, while actually sharing pages (both kv
+    modes covered: the cached paged streams equal the contiguous
+    engine's)."""
+    workload = shared_workload(6)
+    engine = build_engine()                  # contiguous reference
+    contiguous, _ = run_continuous(engine, workload)
+    engine.close()
+    engine = build_engine(kv="paged", block_size=8)
+    plain, _ = run_continuous(engine, workload)
+    engine.close()
+    assert plain == contiguous
+    engine = build_engine(kv="paged", block_size=8,
+                          prefix_cache="on")
+    assert engine.describe()["prefix_cache"] == "on"
+    cached, _ = run_continuous(engine, workload)
+    assert engine.prefix_shared_pages_total >= 1
+    assert engine.prefix_hit_rate() > 0
+    engine.close()
+    assert cached == plain
+    engine = build_engine(kv="paged", block_size=8,
+                          prefix_cache="on")
+    sequential, _ = run_sequential(engine, workload)
+    assert engine.prefix_hit_rate() > 0      # every follower matched
+    engine.close()
+    assert sequential == plain
+    engine = build_engine(kv="paged", block_size=8,
+                          prefix_cache="on")
+    static, _steps = static_generate(engine, workload)
+    engine.close()
+    assert static == plain
+    # chunked admission: adopted chunks SKIP their prefill compute
+    engine = build_engine(kv="paged", block_size=8,
+                          prefix_cache="on", prefill_chunk=8)
+    chunked, _ = run_sequential(engine, workload)
+    assert engine.prefix_shared_pages_total >= 1
+    engine.close()
+    assert chunked == plain
+
+
+def test_prefix_cache_parity_on_mesh():
+    """The same prefix parity on the tensor-parallel engine."""
+    import jax
+    from veles_tpu.parallel.mesh import make_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    mesh = make_mesh({"model": 2})
+    workload = shared_workload(4, max_new=5)
+    engine = build_engine(mesh=mesh, max_slots=2, kv="paged",
+                          block_size=8)
+    plain, _ = run_continuous(engine, workload)
+    engine.close()
+    engine = build_engine(mesh=mesh, max_slots=2, kv="paged",
+                          block_size=8, prefix_cache="on")
+    assert engine.describe()["sharded"]
+    cached, _ = run_continuous(engine, workload)
+    assert engine.prefix_shared_pages_total >= 1
+    engine.close()
+    assert cached == plain
+
+
+def test_prefix_cache_parity_int8():
+    """Prefix sharing composes with the int8 deploy: quantized
+    engines with the cache on/off stream identically."""
+    workload = shared_workload(5)
+    streams = []
+    for kw in ({}, {"prefix_cache": "on"}):
+        engine = build_engine(kv="paged", block_size=8, warm=False,
+                              **kw)
+        engine.quantize_int8(calibration_tokens=workload[0][0])
+        engine.warmup()
+        tokens, _ = run_continuous(engine, workload)
+        if kw:
+            assert engine.prefix_shared_pages_total >= 1
+        engine.close()
+        streams.append(tokens)
+    assert streams[0] == streams[1]
+
+
+def test_prefix_cache_shrinks_kv_ledger():
+    """The capacity win, measured: concurrent shared-stem streams
+    peak at <= 0.6x the plain engine's pool pages (pages ARE the kv
+    ledger: kv_cache_bytes scales linearly in num_blocks), with
+    bitwise parity."""
+
+    def peak_run(engine, workload):
+        scheduler = GenerativeScheduler(engine)
+        futures = [scheduler.submit(toks, max_new)
+                   for toks, max_new in workload]
+        peak = 0
+        while scheduler.queue_depth() or scheduler.active_requests():
+            if scheduler.step() == 0:
+                break
+            peak = max(peak,
+                       engine.blocks_total - engine.blocks_free)
+        tokens = [f.result(0) for f in futures]
+        engine.close()
+        return tokens, peak
+
+    workload = shared_workload(3, stem_len=25, max_new=6)
+    plain, plain_peak = peak_run(
+        build_engine(kv="paged", block_size=8, buckets=(8, 16, 32)),
+        workload)
+    cached, cached_peak = peak_run(
+        build_engine(kv="paged", block_size=8, buckets=(8, 16, 32),
+                     prefix_cache="on"), workload)
+    assert cached == plain
+    assert plain_peak >= 3 * 4               # all three co-resident
+    assert cached_peak <= 0.6 * plain_peak, \
+        "shared-stem peak %d pages vs plain %d" \
+        % (cached_peak, plain_peak)
+
+
+def test_prefix_admission_prices_unshared_suffix():
+    """can_admit(n, tokens) charges only the unshared suffix, counts
+    cache-only pages as evictable headroom, and the pool's reclaimer
+    actually frees them mid-admission."""
+    workload = shared_workload(2, stem_len=25, max_new=4)
+    engine = build_engine(kv="paged", block_size=8,
+                          buckets=(8, 16, 32), num_blocks=7,
+                          prefix_cache="on")
+    prompt = workload[0][0]
+    slot, _token = engine.prefill(prompt)    # 4 of 6 usable pages
+    assert engine.blocks_free == 2
+    follower = workload[1][0]
+    assert not engine.can_admit(len(follower))          # 4 > 2 free
+    assert engine.can_admit(len(follower), follower)    # 3 shared
+    # release -> the stem goes cache-only: headroom for ANY prompt
+    engine.release_slot(slot)
+    assert engine.blocks_free == 3           # stem pages still held
+    fresh = list(range(40, 70))              # no shared prefix
+    assert engine.can_admit(len(fresh))      # 3 free + 3 reclaimable
+    slot2, _token = engine.prefill(fresh)    # reclaimer evicts a leaf
+    # eviction is LAZY (deepest LRU leaf first) and only as deep as
+    # the deficit: the stem chain lost exactly its last page
+    assert len(engine._prefix.match(
+        follower, engine._prefix_tag(len(follower)))) == 2
+    engine.release_slot(slot2)
+    engine.close()
+
+
+def test_speculative_matches_plain_bitwise():
+    """THE speculative gate: draft-then-verify greedy decode is
+    BITWISE plain decode in both kv modes — acceptance only changes
+    dispatch count, never tokens."""
+    workload = spec_workload()
+    for kw in ({}, {"kv": "paged", "block_size": 8}):
+        engine = build_engine(buckets=(8, 16, 32), **kw)
+        plain, _ = run_continuous(engine, workload)
+        engine.close()
+        engine = build_engine(buckets=(8, 16, 32),
+                              speculative="ngram", draft_k=4, **kw)
+        assert engine.describe()["speculative"] == "ngram"
+        spec, sched = run_continuous(engine, workload)
+        assert engine.spec_dispatches >= 1
+        assert engine.spec_accepted_total >= 1, \
+            "repetitive workload must accept something"
+        # fewer dispatches than tokens: speculation actually paid
+        assert sched.decode_steps < sum(m for _, m in workload)
+        engine.close()
+        assert spec == plain, kw
+
+
+def test_speculative_draft_model_parity():
+    """Model-based drafting through the registry: same bitwise gate,
+    draft quality only affects speed."""
+    from veles_tpu.gen import DRAFT_MODELS, register_draft_model
+    workload = spec_workload(3, max_new=8)
+    engine = build_engine(kv="paged", block_size=8,
+                          buckets=(8, 16, 32))
+    plain, _ = run_continuous(engine, workload)
+    engine.close()
+    register_draft_model("tiny-draft", TransformerGenModel(CFG))
+    try:
+        engine = build_engine(kv="paged", block_size=8,
+                              buckets=(8, 16, 32),
+                              speculative="tiny-draft", draft_k=3)
+        spec, _ = run_continuous(engine, workload)
+        assert engine.spec_dispatches >= 1
+        engine.close()
+    finally:
+        DRAFT_MODELS.pop("tiny-draft", None)
+    assert spec == plain
+
+
+def test_speculative_zero_acceptance_worst_case():
+    """Adversarial proposer wrong at EVERY position: the stream must
+    still be bitwise plain decode (row 0 of the verify program is
+    plain decode), at zero accepted drafts."""
+    workload = spec_workload(3, max_new=6)
+    engine = build_engine(kv="paged", block_size=8,
+                          buckets=(8, 16, 32))
+    plain, _ = run_continuous(engine, workload)
+    engine.close()
+    # oracle: prefix -> the token greedy decode emits next
+    wrong = {}
+    for (toks, _max_new), out in zip(workload, plain):
+        full = list(toks) + [int(t) for t in out]
+        for j in range(len(toks), len(full)):
+            wrong[tuple(full[:j])] = (full[j] + 1) % CFG["vocab"]
+
+    class _Adversary(object):
+        def propose(self, stream, k):
+            bad = wrong.get(tuple(int(t) for t in stream), 0)
+            return [bad] * int(k)
+
+    engine = build_engine(kv="paged", block_size=8,
+                          buckets=(8, 16, 32), speculative="ngram",
+                          draft_k=4)
+    engine.proposer = _Adversary()
+    spec, _ = run_continuous(engine, workload)
+    assert engine.spec_accepted_total == 0
+    assert engine.spec_dispatches >= 1
+    engine.close()
+    assert spec == plain
+
+
+def test_speculative_preempts_mid_draft_losslessly():
+    """Pool exhaustion during a speculative session: the youngest
+    stream is preempted (possibly mid-span), requeued with its
+    tokens-so-far, and every stream still finishes bitwise identical
+    to the uncontended run — deterministically across repeats."""
+    workload = spec_workload(6, max_new=12)
+    engine = build_engine(kv="paged", block_size=8,
+                          buckets=(8, 16, 32))
+    uncontended, _ = run_continuous(engine, workload)
+    engine.close()
+    runs = []
+    for _ in range(2):
+        engine = build_engine(kv="paged", block_size=8,
+                              buckets=(8, 16, 32), num_blocks=11,
+                              speculative="ngram", draft_k=4)
+        tokens, _ = run_continuous(engine, workload)
+        assert engine.preemptions_total >= 1
+        runs.append((tokens, engine.preemptions_total))
+        engine.close()
+    assert runs[0] == runs[1]                # deterministic
+    assert runs[0][0] == uncontended         # lossless
+
+
+def test_speculative_zero_steady_state_compiles():
+    """warmup() compiles the verify program next to the bucket and
+    decode programs; a full speculative session then compiles
+    NOTHING (sentinel-gated)."""
+    from veles_tpu import prof
+    engine = build_engine(kv="paged", block_size=8,
+                          buckets=(8, 16, 32), speculative="ngram",
+                          draft_k=4, prefix_cache="on", warm=False)
+    engine.warmup()
+    warm = engine.compile_count
+    assert warm == len(engine.prefill_buckets) + 2   # decode + verify
+    recompiles = prof.ledger.recompiles
+    spec, _ = run_continuous(
+        engine, spec_workload(4) + shared_workload(4, first=60))
+    assert engine.spec_dispatches >= 1
+    assert engine.compile_count == warm
+    assert prof.ledger.recompiles == recompiles
+    engine.close()
+
+
+def test_prefix_spec_gauges_on_metrics():
+    """gen_prefix_hit_rate / gen_spec_accept_rate /
+    gen_spec_tokens_per_dispatch register and unregister with the
+    scheduler and mirror describe()."""
+    from veles_tpu.serve import ServingMetrics
+    metrics = ServingMetrics()
+    engine = build_engine(kv="paged", block_size=8,
+                          buckets=(8, 16, 32), prefix_cache="on",
+                          speculative="ngram", draft_k=4)
+    scheduler = GenerativeScheduler(engine, metrics=metrics,
+                                    name="ps")
+    futures = [scheduler.submit(toks, max_new) for toks, max_new
+               in shared_workload(4) + spec_workload(3, first=60)]
+    scheduler.run_until_idle()
+    assert all(f.done() for f in futures)
+    snap = metrics.snapshot()                # gauges round to 4 places
+    assert snap['gen_prefix_hit_rate{model="ps"}'] == pytest.approx(
+        engine.prefix_hit_rate(), abs=1e-4)
+    assert snap['gen_spec_accept_rate{model="ps"}'] == pytest.approx(
+        engine.spec_accept_rate(), abs=1e-4)
+    assert snap['gen_spec_tokens_per_dispatch{model="ps"}'] == \
+        pytest.approx(engine.spec_tokens_per_dispatch(), abs=1e-4)
+    assert engine.spec_tokens_per_dispatch() >= 1.0
+    info = engine.describe()
+    assert info["prefix_cache"] == "on"
+    assert info["speculative"] == "ngram"
+    assert info["draft_k"] == 4
+    assert info["spec_dispatches"] == engine.spec_dispatches
+    assert info["prefix_pages"] >= 1
+    assert info["prefix_hits_pages_total"] >= 1
+    scheduler.stop(drain=False)
+    snap = metrics.snapshot()
+    assert 'gen_prefix_hit_rate{model="ps"}' not in snap
+    assert 'gen_spec_accept_rate{model="ps"}' not in snap
+    engine.close()
+    # plain engines don't grow the new gauges
+    metrics2 = ServingMetrics()
+    engine = build_engine(kv="paged", block_size=8)
+    scheduler = GenerativeScheduler(engine, metrics=metrics2,
+                                    name="p")
+    assert 'gen_prefix_hit_rate{model="p"}' not in \
+        metrics2.snapshot()
+    scheduler.stop(drain=False)
+    engine.close()
+
+
+def test_vs01_prefix_and_spec_checks():
+    """V-S01 learns the PR 19 surface: the mean-mix pool warning
+    credits observed page sharing, and a draft model proposing into
+    a different vocab is flagged before it silently zeroes
+    acceptance."""
+    from veles_tpu.analyze.shapes import check_generative
+    from veles_tpu.gen import DRAFT_MODELS, register_draft_model
+    # refcount-aware pricing: 8 usable pages price below the 9-page
+    # observed mix until sharing is credited
+    workload = shared_workload(3, stem_len=25, max_new=4)
+    engine = build_engine(kv="paged", block_size=8,
+                          buckets=(8, 16, 32), num_blocks=9,
+                          prefix_cache="on")
+    report = check_generative(engine, hbm_bytes=1 << 30)
+    assert any("preempts instead of batching" in f.message
+               for f in report.findings)    # fresh engine: no credit
+    s1, _t = engine.prefill(workload[0][0])
+    s2, _t = engine.prefill(workload[1][0])
+    report = check_generative(engine, hbm_bytes=1 << 30)
+    assert not any("preempts instead of batching" in f.message
+                   for f in report.findings), \
+        "3 shared stem pages must price the 9-page mix into 8 usable"
+    engine.release_slot(s1)
+    engine.release_slot(s2)
+    engine.close()
+    # draft-vocab mismatch: the silent-garbage failure mode
+    register_draft_model(
+        "bad-vocab", TransformerGenModel(dict(CFG,
+                                              vocab=2 * CFG["vocab"])))
+    try:
+        engine = build_engine(kv="paged", block_size=8,
+                              speculative="bad-vocab", draft_k=2,
+                              warm=False)
+        report = check_generative(engine, hbm_bytes=1 << 30)
+        assert any("vocab" in f.message and f.severity == "warning"
+                   for f in report.findings)
+        engine.close()
+    finally:
+        DRAFT_MODELS.pop("bad-vocab", None)
+
+
+# -- the compounding tokens/s gate (prefix + spec acceptance) ---------------
+
+@pytest.mark.slow
+def test_speculative_tokens_per_slot_closed_loop():
+    """The speculative mode's reason to exist, measured: >= 1.3x
+    decode tokens/s/slot with the n-gram proposer on a repetitive
+    workload, bitwise-identical streams, zero steady recompiles."""
+    import time
+    from veles_tpu import prof
+    big = {"vocab": 512, "dim": 256, "heads": 4, "layers": 4,
+           "mlp_ratio": 4, "seq_len": 512}
+    stem = ([5, 9, 13, 7] * 24)[:96]
+    workload = [(stem + [200 + i], 96) for i in range(2)]
+
+    def run(spec):
+        kw = {"speculative": "ngram", "draft_k": 5} if spec else {}
+        engine = GenerativeEngine(
+            TransformerGenModel(big), max_slots=2, max_seq=256,
+            prefill_buckets=(128,), seed=0, kv="paged",
+            block_size=16, **kw).warmup()
+        recompiles = prof.ledger.recompiles
+        scheduler = GenerativeScheduler(engine)
+        futures = [scheduler.submit(toks, max_new)
+                   for toks, max_new in workload]
+        tic = time.perf_counter()
+        scheduler.run_until_idle()
+        elapsed = time.perf_counter() - tic
+        tokens = [f.result(0) for f in futures]
+        assert prof.ledger.recompiles == recompiles
+        accept = engine.spec_accept_rate() if spec else 0.0
+        engine.close()
+        emitted = sum(len(t) for t in tokens)
+        return tokens, emitted / elapsed, accept
+
+    plain_tokens, plain_tps, _a = run(False)
+    plain_tps = max(plain_tps, run(False)[1])    # best-of-2 per mode
+    spec_tokens, spec_tps, accept = run(True)
+    spec_tps = max(spec_tps, run(True)[1])
+    assert spec_tokens == plain_tokens           # the equivalence gate
+    assert accept > 0.5, "repetitive stream must mostly accept"
+    assert spec_tps >= 1.3 * plain_tps, \
+        "speculative %.1f tok/s vs plain %.1f (%.2fx, accept %.2f)" \
+        % (spec_tps, plain_tps, spec_tps / plain_tps, accept)
+
+
+@pytest.mark.slow
+def test_prefix_capacity_closed_loop():
+    """The prefix cache's reason to exist, measured: at <= 0.7x the
+    KV-ledger bytes the cached pool holds >= 1.5x the concurrent
+    shared-prefix sequences of the plain paged engine, with bitwise
+    token parity and zero steady recompiles."""
+    from veles_tpu import prof
+    cfg = dict(TINY, seq_len=128)
+    stem = [(i * 11 + 5) % cfg["vocab"] for i in range(57)]
+    workload = [(stem + [100 + i], 6) for i in range(18)]
+
+    def run(prefix, num_blocks, max_slots):
+        engine = GenerativeEngine(
+            TransformerGenModel(cfg), max_slots=max_slots,
+            max_seq=96, prefill_buckets=(64,), seed=0, kv="paged",
+            block_size=8, num_blocks=num_blocks,
+            prefix_cache="on" if prefix else None).warmup()
+        recompiles = prof.ledger.recompiles
+        scheduler = GenerativeScheduler(engine)
+        futures = [scheduler.submit(toks, max_new)
+                   for toks, max_new in workload]
+        peak = 0
+        while scheduler.queue_depth() or scheduler.active_requests():
+            if scheduler.step() == 0:
+                break
+            peak = max(peak, scheduler.active_requests())
+        tokens = [f.result(0) for f in futures]
+        assert prof.ledger.recompiles == recompiles
+        bytes_ = engine.kv_cache_bytes
+        engine.close()
+        return tokens, peak, bytes_
+
+    # plain: 4 slots x 8 pages resident -> 33-page pool
+    plain_tokens, plain_peak, plain_bytes = run(False, 33, 4)
+    # cached: 0.7x the pool BYTES, yet room for 12 shared streams
+    cached_tokens, cached_peak, cached_bytes = run(True, 23, 12)
+    assert cached_bytes <= 0.7 * plain_bytes
+    assert cached_tokens == plain_tokens
+    assert cached_peak >= 1.5 * plain_peak, \
+        "cached held %d concurrent vs plain %d at 0.7x bytes" \
+        % (cached_peak, plain_peak)

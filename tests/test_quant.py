@@ -11,6 +11,8 @@ steady-state compiles, and the ``-m slow`` ≥1.2× tokens/s floor over
 the same-run bf16 engine on CPU JAX.
 """
 
+import gc
+
 import numpy
 import pytest
 
@@ -32,6 +34,9 @@ def interpret():
 
 
 def params_category_bytes():
+    # flush pending finalizers first: a buffer leaked by an earlier
+    # test releasing between two snapshots would skew the delta
+    gc.collect()
     return Watcher.hbm_ledger()["by_category"].get(
         "params", {}).get("bytes", 0)
 

@@ -114,6 +114,14 @@ KNOB_REGISTRY = {
     "root.common.gen.kv":
         "KV-cache config group (mode contiguous | paged, block_size, "
         "num_blocks)",
+    "root.common.gen.prefix_cache":
+        "radix prefix cache over the paged pool (off | on): "
+        "copy-on-write page sharing across shared-prefix admissions",
+    "root.common.gen.speculative":
+        "speculative decode proposer (off | ngram | a registered "
+        "draft-model name); emitted tokens stay bitwise plain-decode",
+    "root.common.gen.draft_k":
+        "speculative draft span per slot per verify dispatch (1-7)",
     # obs / watch — observability
     "root.common.obs.blackbox_dir":
         "flight-recorder (blackbox) output directory",

@@ -1332,15 +1332,26 @@ def check_generative(engine, hbm_bytes=None, mean_seq_len=None):
         elif block_size > 0 and max_slots > 0:
             mean_len = float(mean_seq_len or max_seq / 2.0)
             need = max_slots * math.ceil(mean_len / block_size)
-            if usable < need:
+            # refcount-aware pricing: a prefix-cached pool serves a
+            # shared page ONCE however many slots name it, so the
+            # OBSERVED sharing credit counts against the demand (a
+            # fresh engine has none and prices worst-case)
+            saved = 0
+            pool = getattr(engine, "_pool", None)
+            if getattr(engine, "prefix_cache", False) \
+                    and pool is not None:
+                saved = int(pool.pages_saved())
+            if usable + saved < need:
                 findings.append(Finding(
                     "warning", "V-S01",
                     message="pool of %d usable pages holds fewer than "
                             "%d slots x %.0f-token sequences (%d "
-                            "pages at the observed-mix mean) — "
+                            "pages at the observed-mix mean%s) — "
                             "admission is priced per page, so this "
                             "plan preempts instead of batching"
-                            % (usable, max_slots, mean_len, need),
+                            % (usable, max_slots, mean_len, need,
+                               ", %d credited to prefix sharing"
+                               % saved if saved else ""),
                     fix="grow num_blocks (or admit fewer slots)"))
         if chunk is None and buckets and buckets[-1] < max_seq:
             findings.append(Finding(
@@ -1353,6 +1364,25 @@ def check_generative(engine, hbm_bytes=None, mean_seq_len=None):
                 fix="set root.common.gen.prefill_chunk (chunked "
                     "admission serves any prefix) or bucket up to "
                     "max_seq"))
+
+    # speculative plan: a draft model proposing into a different token
+    # space never matches the target's greedy choices
+    proposer = getattr(engine, "proposer", None)
+    draft = getattr(proposer, "model", None)
+    if model is not None and draft is not None \
+            and int(getattr(draft, "vocab", 0) or 0) \
+            != int(getattr(model, "vocab", 0) or 0):
+        findings.append(Finding(
+            "warning", "V-S01",
+            message="draft model %r vocab %d != target vocab %d — "
+                    "proposals index a different token space, so "
+                    "speculative acceptance will collapse to zero "
+                    "(pure overhead)"
+                    % (getattr(engine, "speculative", "?"),
+                       int(getattr(draft, "vocab", 0) or 0),
+                       int(getattr(model, "vocab", 0) or 0)),
+            fix="register a draft model sharing the target's "
+                "tokenizer/vocab (or use speculative=\"ngram\")"))
 
     kv_bytes = int(getattr(engine, "kv_cache_bytes", 0) or 0)
     params_bytes = 0

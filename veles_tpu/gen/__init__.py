@@ -26,6 +26,16 @@ KV cache with iteration-level scheduling.  Pieces:
   interleaves admissions with decode steps, and pool exhaustion
   preempts the youngest sequence losslessly.  See
   ``docs/services.md`` § Paged KV.
+- :mod:`prefix` — the radix prefix cache
+  (``root.common.gen.prefix_cache = "on"``): refcounted
+  copy-on-write page sharing across admissions of a common prompt
+  prefix; admission prices only the unshared suffix and eviction is
+  LRU-leaf, never a referenced page.
+- speculative decode (``root.common.gen.speculative = "ngram"`` or a
+  registered draft model, ``root.common.gen.draft_k``): draft K
+  tokens per slot, verify them all in ONE fixed-shape dispatch,
+  accept greedily — the emitted stream stays BITWISE plain decode.
+  See ``docs/services.md`` § Prefix cache & speculative decode.
 
 Deployment rides the existing registry
 (``ModelRegistry.deploy_generative`` — analyzer rule V-S01 preflights
@@ -37,13 +47,18 @@ the KV footprint and model shape) and the HTTP front-end
 mixed-length closed-loop session with ZERO steady-state compiles.
 """
 
-from veles_tpu.gen.engine import GenerativeEngine  # noqa: F401
+from veles_tpu.gen.engine import (  # noqa: F401
+    DRAFT_MODELS, DraftModelProposer, GenerativeEngine, NGramProposer,
+    register_draft_model)
 from veles_tpu.gen.model import TransformerGenModel  # noqa: F401
 from veles_tpu.gen.paged import BlockPool, PoolExhausted  # noqa: F401
+from veles_tpu.gen.prefix import PrefixCache  # noqa: F401
 from veles_tpu.gen.scheduler import (  # noqa: F401
     GenerativeScheduler, static_generate)
 
 __all__ = [
-    "BlockPool", "GenerativeEngine", "GenerativeScheduler",
-    "PoolExhausted", "TransformerGenModel", "static_generate",
+    "BlockPool", "DRAFT_MODELS", "DraftModelProposer",
+    "GenerativeEngine", "GenerativeScheduler", "NGramProposer",
+    "PoolExhausted", "PrefixCache", "TransformerGenModel",
+    "register_draft_model", "static_generate",
 ]

@@ -13,9 +13,15 @@ preemption contract, gated in CI.  A third INT8 session (deploy-time
 per-channel weight quantization, ``veles_tpu.quant``) must complete
 the same budgets with zero steady-state compiles, a params footprint
 ≤0.35× its float twin, and the calibration drift gate green — the
-quantized-serving contract.  Exit code 0 on success; any
-violation prints the failure and exits 1 — the same contract the
-serve engine's warmup gate enforces for the request/response path.
+quantized-serving contract.  A fourth PREFIX+SPEC session (radix
+prefix cache + n-gram speculative decode over the paged pool) must
+reproduce a plain paged session's shared-prefix streams EXACTLY while
+actually sharing pages (≥1 page referenced by ≥2 co-resident slots),
+keeping refcounted pages out of eviction's reach, and accepting at
+least one drafted token — the compounding-serving contract.  Exit
+code 0 on success; any violation prints the failure and exits 1 — the
+same contract the serve engine's warmup gate enforces for the
+request/response path.
 """
 
 import argparse
@@ -75,9 +81,9 @@ def smoke(slots=4, max_seq=48, requests=16, seed=0):
          int(rng.integers(1, 14)))
         for _ in range(requests)]
 
-    def check_session(results, steady, flagged, label):
+    def check_session(results, steady, flagged, label, budgets=None):
         failed = 0
-        for got, (_toks, max_new) in zip(results, workload):
+        for got, (_toks, max_new) in zip(results, budgets or workload):
             if got is None:
                 print("FAIL[%s]: request with budget %d never "
                       "resolved" % (label, max_new))
@@ -190,6 +196,75 @@ def smoke(slots=4, max_seq=48, requests=16, seed=0):
           % (len(workload), ischeduler.tokens_total, ielapsed,
              ratio, agree, total))
     int8.close()
+
+    # phase 4: the PREFIX+SPEC gate — a shared-prefix workload (every
+    # prompt extends one common stem, the serving shape prefix caching
+    # exists for) through a radix-cached + n-gram-speculative paged
+    # engine, bitwise-matching a plain paged engine's streams while
+    # (a) at least one page is co-referenced by two live slots, (b) a
+    # full pool evicts ONLY cache-only pages, and (c) the verify
+    # dispatch accepts drafted tokens on the repetitive tail
+    stem = (list(range(2, 10)) * 2)[:12]
+    swork = [(stem + [11 + i] + stem[:4], 10) for i in range(slots)]
+
+    def build4(**kw):
+        return GenerativeEngine(
+            TransformerGenModel(cfg), max_slots=slots,
+            max_seq=max_seq, prefill_buckets=(8, 16, 32), seed=seed,
+            kv="paged", block_size=8, **kw)
+
+    plain4 = build4()
+    bresults, _bel, _bsch, bsteady, bflagged = _session(
+        plain4, swork, "smoke-spec-base")
+    failed += check_session(bresults, bsteady, bflagged, "spec-base",
+                            budgets=swork)
+    plain4.close()
+    spec4 = build4(prefix_cache="on", speculative="ngram", draft_k=4)
+    pool4 = spec4._pool
+    sresults, selapsed, sscheduler, ssteady, sflagged = _session(
+        spec4, swork, "smoke-spec")
+    failed += check_session(sresults, ssteady, sflagged, "prefix+spec",
+                            budgets=swork)
+    if sresults != bresults:
+        print("FAIL[prefix+spec]: token streams diverge from the "
+              "plain paged session — the parity gate is bitwise")
+        failed += 1
+    if spec4.prefix_shared_pages_total < 1:
+        print("FAIL[prefix+spec]: no admission adopted a cached page "
+              "— the radix tree went unexercised")
+        failed += 1
+    if spec4.spec_accepted_total < 1:
+        print("FAIL[prefix+spec]: the verify dispatch accepted no "
+              "drafted token on a repetitive workload")
+        failed += 1
+    # co-residency: two fresh admissions of the cached stem must name
+    # at least one COMMON physical page (copy-on-write sharing, live)
+    s1, _t1 = spec4.prefill(stem + [90])
+    s2, _t2 = spec4.prefill(stem + [91])
+    co_shared = set(pool4.owned(s1)) & set(pool4.owned(s2))
+    if not co_shared:
+        print("FAIL[prefix+spec]: two live admissions of the same "
+              "stem share no page")
+        failed += 1
+    # eviction safety: drain the cache against a full-pool deficit and
+    # confirm every page the two live slots reference survived
+    spec4._prefix.evict(pool4.blocks_total)
+    for slot in (s1, s2):
+        for bid in pool4.owned(slot):
+            if pool4.refcount(bid) < 1:
+                print("FAIL[prefix+spec]: eviction freed page %d out "
+                      "from under live slot %d" % (bid, slot))
+                failed += 1
+        spec4.release_slot(slot)
+    print("gen smoke[prefix+spec]: %d requests, %d tokens in %.2fs, "
+          "prefix hit rate %.0f%%, spec accept rate %.0f%% "
+          "(%.2f tok/dispatch), plain==prefix+spec parity ok, "
+          "0 steady-state recompiles"
+          % (len(swork), sscheduler.tokens_total, selapsed,
+             100.0 * spec4.prefix_hit_rate(),
+             100.0 * spec4.spec_accept_rate(),
+             spec4.spec_tokens_per_dispatch()))
+    spec4.close()
     return 1 if failed else 0
 
 

@@ -65,6 +65,95 @@ def _power_of_two_buckets(lo, hi):
     return tuple(buckets)
 
 
+#: registry of small draft models for model-based speculation —
+#: ``root.common.gen.speculative = <name>`` selects an entry; the
+#: int8 deploy of the served model is the natural candidate
+DRAFT_MODELS = {}
+
+
+def register_draft_model(name, model, params=None):
+    """Register a small GenModel as a speculative-decode proposer.
+    ``params`` (host tree) defaults to ``model.init_params(seed=0)``
+    at engine construction.  Returns ``model`` (chainable)."""
+    DRAFT_MODELS[str(name)] = (model, params)
+    return model
+
+
+class NGramProposer(object):
+    """Prompt-lookup drafting (training-free): propose the ``k``
+    tokens that FOLLOWED the most recent earlier occurrence of the
+    stream's longest matching suffix n-gram.  Pure host work, fully
+    deterministic, and strongest exactly where speculation pays —
+    repetitive/agentic streams re-deriving their own context.  A bad
+    proposal costs nothing but speed: the target verifies every
+    draft, so the output stream is bitwise plain greedy decode."""
+
+    name = "ngram"
+
+    def __init__(self, max_ngram=3, min_ngram=1):
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, stream, k):
+        toks = [int(t) for t in stream]
+        n = len(toks)
+        for g in range(min(self.max_ngram, n - 1),
+                       self.min_ngram - 1, -1):
+            suffix = toks[n - g:]
+            for start in range(n - g - 1, -1, -1):
+                if toks[start:start + g] == suffix:
+                    # copy forward through the VIRTUAL stream (the
+                    # draft extends it), so an overlapping match near
+                    # the end — a constant or short-period tail, the
+                    # best case — still yields k tokens, not the one
+                    # or two left before the stream ends
+                    cont, p = [], start + g
+                    for _ in range(int(k)):
+                        cont.append(toks[p] if p < n
+                                    else cont[p - n])
+                        p += 1
+                    return cont
+        return []
+
+
+class DraftModelProposer(object):
+    """Model-based drafting: ``k`` sequential greedy steps of a
+    REGISTERED small model over a fixed recent-token window — ONE
+    cache-less fixed-shape program compiled at warmup, so drafting is
+    stateless and preemption/handoff can never desynchronize a draft
+    cache.  Draft quality only affects tokens-per-dispatch; the
+    target's verify program owns correctness."""
+
+    def __init__(self, engine, name, model, params):
+        self.engine = engine
+        self.name = str(name)
+        self.model = model
+        #: draft context window — bounded so the draft forward stays
+        #: cheap relative to the target verify it feeds
+        self.window = int(min(32, model.seq_limit))
+        if params is None:
+            params = model.init_params(seed=0)
+        self.params = engine._jax.device_put(params)
+
+    def propose(self, stream, k):
+        exe, entry = self.engine._draft_executable()
+        jnp = self.engine._jax.numpy
+        toks = [int(t) for t in stream]
+        out = []
+        tic = time.perf_counter_ns()
+        for _ in range(int(k)):
+            win = toks[-self.window:]
+            padded = numpy.zeros(self.window, numpy.int32)
+            padded[:len(win)] = win
+            tok = int(exe(self.params, jnp.asarray(padded[None]),
+                          jnp.int32(len(win))))
+            out.append(tok)
+            toks.append(tok)
+        prof.ledger.record_dispatch(
+            entry, time.perf_counter_ns() - tic, items=len(out))
+        return out
+
+
 class GenerativeEngine(Logger):
     """Slot-based generative inference over a protocol model
     (:mod:`veles_tpu.gen.model`).
@@ -84,7 +173,9 @@ class GenerativeEngine(Logger):
     def __init__(self, model, params=None, *, max_slots=4,
                  max_seq=None, prefill_buckets=None, mesh=None,
                  eos_id=None, seed=0, kv=None, block_size=None,
-                 num_blocks=None, prefill_chunk=None, **kwargs):
+                 num_blocks=None, prefill_chunk=None,
+                 prefix_cache=None, speculative=None, draft_k=None,
+                 **kwargs):
         super(GenerativeEngine, self).__init__(**kwargs)
         import jax
 
@@ -114,6 +205,34 @@ class GenerativeEngine(Logger):
         if self.prefill_chunk is not None and self.prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
 
+        pc = prefix_cache if prefix_cache is not None \
+            else gen_cfg.get("prefix_cache", "off")
+        if pc in (True, "on"):
+            self.prefix_cache = True
+        elif pc in (False, None, "off"):
+            self.prefix_cache = False
+        else:
+            raise ValueError(
+                "root.common.gen.prefix_cache must be 'on' or 'off', "
+                "got %r" % (pc,))
+        if self.prefix_cache and self.kv_mode != "paged":
+            raise ValueError(
+                "prefix_cache requires kv='paged' — the contiguous "
+                "engine has no shareable pages")
+        spec = speculative if speculative is not None \
+            else gen_cfg.get("speculative", "off")
+        if spec in (False, None, "off"):
+            spec = None
+        self.speculative = None if spec is None else str(spec)
+        dk = draft_k if draft_k is not None \
+            else gen_cfg.get("draft_k", 4)
+        self.draft_k = int(dk)
+        if self.speculative is not None \
+                and not 1 <= self.draft_k <= 7:
+            raise ValueError(
+                "draft_k must be 1..7 (the K+1 verify query rows ride "
+                "one 8-sublane tile), got %d" % self.draft_k)
+
         self._pool = None
         self.block_size = None
         self.num_blocks = None
@@ -139,6 +258,10 @@ class GenerativeEngine(Logger):
             if self.prefill_chunk is not None:
                 self.prefill_chunk = _round_up(self.prefill_chunk,
                                                self.block_size)
+        self._prefix = None
+        if self.prefix_cache:
+            from veles_tpu.gen.prefix import PrefixCache
+            self._prefix = PrefixCache(self._pool)
         if self.prefill_chunk is not None \
                 and self.max_seq % self.prefill_chunk:
             # the final chunk of a near-max_seq prompt pads to a full
@@ -219,6 +342,7 @@ class GenerativeEngine(Logger):
         self.params_nbytes = tree_nbytes(self._params)
         Watcher.track(self.params_nbytes, "params")
         self._params_tracked = True
+        self._ledger_gen = Watcher.generation
 
         # host slot bookkeeping (single scheduler thread)
         self.slot_len = numpy.zeros(self.max_slots, numpy.int32)
@@ -233,9 +357,34 @@ class GenerativeEngine(Logger):
         #: device call served
         self.slot_trace = [None] * self.max_slots
 
+        #: the speculative proposer (None = plain decode): n-gram
+        #: prompt lookup, or a registered small draft model
+        self.proposer = None
+        if self.speculative == "ngram":
+            self.proposer = NGramProposer()
+        elif self.speculative is not None:
+            entry = DRAFT_MODELS.get(self.speculative)
+            if entry is None:
+                raise ValueError(
+                    "speculative=%r names no registered draft model "
+                    "(see register_draft_model) and is not 'ngram'"
+                    % self.speculative)
+            draft_model, draft_params = entry
+            if int(draft_model.vocab) != int(model.vocab):
+                self.warning(
+                    "draft model %r vocab %d != target vocab %d — "
+                    "proposals index a different token space, so "
+                    "acceptance will collapse to zero (V-S01 flags "
+                    "this at preflight)", self.speculative,
+                    draft_model.vocab, model.vocab)
+            self.proposer = DraftModelProposer(
+                self, self.speculative, draft_model, draft_params)
+
         self._prefill_exe = {}
         self._chunk_exe = None
         self._decode_exe = None
+        self._verify_exe = None
+        self._draft_exe = None
         self._page_out_exe = None
         self._page_in_exe = None
         self._compile_lock = threading.Lock()
@@ -245,6 +394,14 @@ class GenerativeEngine(Logger):
         self.preemptions_total = 0
         self.exports_total = 0
         self.adoptions_total = 0
+        # prefix-cache admission accounting (hit rate = shared/total)
+        self.prefix_pages_total = 0
+        self.prefix_shared_pages_total = 0
+        # speculative-decode accounting
+        self.spec_dispatches = 0
+        self.spec_drafted_total = 0
+        self.spec_accepted_total = 0
+        self.spec_tokens_total = 0
         self._warmed = False
         self.prof_name = "gen%d" % next(_GEN_SEQ)
         self._prof_entries = {}
@@ -462,6 +619,70 @@ class GenerativeEngine(Logger):
                 self.model.decode_flops(slots, self.max_seq))
         return self._decode_exe
 
+    def _verify_executable(self):
+        """The ONE fixed-shape speculative-verify program: every
+        slot's pending token + up to ``draft_k`` drafts scored in one
+        dispatch (per-slot real draft counts ride in as data, so
+        partial/empty drafts never change the shape)."""
+        if self._verify_exe is None:
+            jnp = self._jax.numpy
+            slots = self.max_slots
+            kp1 = self.draft_k + 1
+            if self._pool is not None:
+                args = (self._params, self._cache,
+                        jnp.zeros((slots, self._pool.max_blocks),
+                                  jnp.int32),
+                        jnp.zeros((slots, kp1), jnp.int32),
+                        jnp.zeros((slots,), jnp.int32),
+                        jnp.zeros((slots,), jnp.int32),
+                        jnp.zeros((slots,), bool))
+                fn = self.model.paged_verify
+            else:
+                args = (self._params, self._cache,
+                        jnp.zeros((slots, kp1), jnp.int32),
+                        jnp.zeros((slots,), jnp.int32),
+                        jnp.zeros((slots,), jnp.int32),
+                        jnp.zeros((slots,), bool))
+                fn = self.model.verify
+            self._verify_exe = self._compile(
+                fn, args, "decode", "verify%d" % self.draft_k,
+                self.model.verify_flops(slots, self.draft_k,
+                                        self.max_seq))
+        return self._verify_exe
+
+    def _draft_executable(self):
+        """The ONE fixed-shape draft program (model-based proposer
+        only): a cache-less windowed forward of the registered draft
+        model returning its greedy next token — called ``draft_k``
+        times per slot per drafting round."""
+        if self._draft_exe is None:
+            jnp = self._jax.numpy
+            proposer = self.proposer
+            model = proposer.model
+            window = proposer.window
+            cd = model.compute_dtype
+
+            def draft_next(params, tokens, length):
+                h = params["embed"][tokens] + params["pos"][:window]
+                cache = {
+                    "k": jnp.zeros((model.layers, 1, 1, model.heads,
+                                    model.head_dim), cd),
+                    "v": jnp.zeros((model.layers, 1, 1, model.heads,
+                                    model.head_dim), cd)}
+
+                def kv_hook(kc, vc, q, k, v):
+                    return kc, vc, model._attend_prefill(q, k, v)
+
+                h, _ = model._run_layers(params, cache, h, kv_hook)
+                return model._greedy_at(params, h, length - 1)
+
+            self._draft_exe = self._compile_aux(
+                draft_next,
+                (proposer.params,
+                 jnp.zeros((1, window), jnp.int32), jnp.int32(1)),
+                "draft", "draft_w%d" % window)
+        return self._draft_exe
+
     def quantize_int8(self, calibration_tokens=None, tol=None):
         """Quantize the served params in place (per-output-channel
         symmetric int8, :func:`veles_tpu.quant.quantize_gen_params`)
@@ -494,11 +715,14 @@ class GenerativeEngine(Logger):
         self.quantized = "int8"
         # re-price the ledger hold from the new (int8) leaves
         from veles_tpu.memory import Watcher
-        if getattr(self, "_params_tracked", False):
+        if (getattr(self, "_params_tracked", False)
+                and getattr(self, "_ledger_gen", 0)
+                == Watcher.generation):
             Watcher.untrack(self.params_nbytes, "params")
         self.params_nbytes = quant.tree_nbytes(self._params)
         Watcher.track(self.params_nbytes, "params")
         self._params_tracked = True
+        self._ledger_gen = Watcher.generation
         self.info("quantized params to int8 (%d bytes resident)",
                   self.params_nbytes)
         return self
@@ -514,6 +738,10 @@ class GenerativeEngine(Logger):
         else:
             for bucket in self.prefill_buckets:
                 self._prefill_executable(bucket)
+        if self.proposer is not None:
+            self._verify_executable()
+            if isinstance(self.proposer, DraftModelProposer):
+                self._draft_executable()
         self._warmed = True
         return self
 
@@ -596,7 +824,30 @@ class GenerativeEngine(Logger):
             and self.slot_len[slot] < self.max_seq
             and self._pool.needs_append(slot, int(self.slot_len[slot])))
 
-    def can_admit(self, n):
+    def _prefix_tag(self, n):
+        """Program-identity tag for prefix-cache entries: pages are
+        only shared between prefills the SAME compiled program wrote,
+        because XLA's reduction order is shape-dependent and a
+        cross-program page could differ in the last ulp — which a
+        co-resident's greedy argmax could amplify into a divergent
+        stream.  Chunked engines have one chunk program (full
+        sharing); whole-bucket engines tag by bucket."""
+        if self.prefill_chunk is not None:
+            return "chunk%d" % self.prefill_chunk
+        return "b%d" % self.bucket_for(n)
+
+    def _shared_usable(self, bids):
+        """Matched prefix pages an admission may actually adopt:
+        chunked prefill skips WHOLE chunks, so the adopted span
+        rounds down to a chunk boundary (whole-bucket mode adopts
+        every matched page — the prefix compute replays but its page
+        writes are trash-routed)."""
+        if self.prefill_chunk is not None:
+            per = self.prefill_chunk // self.block_size
+            return bids[:len(bids) // per * per]
+        return bids
+
+    def can_admit(self, n, tokens=None):
         """True when a prompt (or preempted prefix) of ``n`` tokens is
         admissible RIGHT NOW: a free slot, and — in paged mode — the
         pool holding its pages ON TOP of the pages the residents'
@@ -605,18 +856,39 @@ class GenerativeEngine(Logger):
         without it the head request's pages are immediately taken
         back by the residents' appends, the youngest (= that head)
         is preempted, re-admitted next step, and the cycle re-runs
-        its whole prefill once per resident token."""
+        its whole prefill once per resident token.
+
+        With the prefix cache on, pass ``tokens`` to price only the
+        UNSHARED suffix (cache hits cost no fresh pages) and to count
+        cache-only pages the LRU reclaimer would evict on demand as
+        headroom — a pool full of idle cached prefixes must not
+        refuse admissions it can serve."""
         if not self._free:
             return False
         if self._pool is not None:
-            need = self._pool.blocks_for(int(n))
-            if int(n) % self.block_size == 0:
+            n = int(n)
+            need = self._pool.blocks_for(n)
+            reclaimable = 0
+            if self._prefix is not None:
+                reclaimable = self._prefix.reclaimable()
+                if tokens is not None:
+                    bids = self._shared_usable(
+                        self._prefix.match(tokens,
+                                           self._prefix_tag(n)))
+                    need -= len(bids)
+                    # matched pages are adopted, not evicted — they
+                    # stop being reclaimable the moment we admit
+                    reclaimable -= sum(
+                        1 for bid in bids
+                        if self._pool.refcount(bid) == 1)
+                    reclaimable = max(0, reclaimable)
+            if n % self.block_size == 0:
                 # a prefix filling its pages exactly appends a fresh
                 # page on its FIRST decode step — price it now, or
                 # that admission is the next preemption victim
                 need += 1
             return (need + self._appends_needed()
-                    <= self._pool.blocks_free)
+                    <= self._pool.blocks_free + reclaimable)
         return True
 
     def release_slot(self, slot):
@@ -671,9 +943,14 @@ class GenerativeEngine(Logger):
             raise RuntimeError("no free slot (all %d busy)"
                                % self.max_slots)
         slot = self._free.pop(0)
+        shared, tag = [], None
         if self._pool is not None:
+            if self._prefix is not None:
+                tag = self._prefix_tag(n)
+                shared = self._shared_usable(
+                    self._prefix.match(tokens, tag))
             try:
-                ids = self._pool.admit(slot, n)
+                ids = self._pool.admit(slot, n, shared=shared)
             except Exception:
                 import bisect
                 bisect.insort(self._free, slot)
@@ -681,6 +958,18 @@ class GenerativeEngine(Logger):
             block_ids = numpy.zeros(bucket // self.block_size,
                                     numpy.int32)
             block_ids[:len(ids)] = ids
+            if shared:
+                # NEVER rewrite a shared page: its resident K/V came
+                # from the same program on the same prefix, but THIS
+                # dispatch's copy would overwrite what a co-resident
+                # slot is reading mid-flight — route those page
+                # writes to the trash block instead (the in-dispatch
+                # attention reads the chunk itself, not the cache, so
+                # the returned token is unchanged)
+                block_ids[:len(shared)] = self._pool.TRASH
+            if self._prefix is not None:
+                self.prefix_pages_total += len(ids)
+                self.prefix_shared_pages_total += len(shared)
         padded = numpy.zeros(bucket, numpy.int32)
         padded[:n] = tokens
         exe, entry = self._prefill_executable(bucket)
@@ -706,6 +995,14 @@ class GenerativeEngine(Logger):
         self.slot_len[slot] = n
         self.slot_token[slot] = tok
         self.slot_active[slot] = True
+        if self._prefix is not None:
+            # register every FULL prompt page now that its K/V is
+            # resident (full pages are immutable: decode writes start
+            # at position n, always a later page)
+            m = n // self.block_size
+            if m:
+                self._prefix.insert(tokens[:m * self.block_size],
+                                    self._pool.owned(slot)[:m], tag)
         return slot, tok
 
     def admit(self, tokens):
@@ -724,17 +1021,32 @@ class GenerativeEngine(Logger):
             raise RuntimeError("no free slot (all %d busy)"
                                % self.max_slots)
         slot = self._free.pop(0)
+        start0, shared, tag = 0, [], None
         if self._pool is not None:
+            if self._prefix is not None:
+                tag = self._prefix_tag(n)
+                shared = self._shared_usable(
+                    self._prefix.match(tokens, tag))
+                # chunked prefill SKIPS the shared prefix outright —
+                # chunks begin at the first unshared page (a chunk
+                # boundary, keeping every program shape fixed), so a
+                # hit saves the prefix's compute, not just its HBM
+                start0 = len(shared) * self.block_size
             try:
-                self._pool.admit(slot, n)
+                self._pool.admit(slot, n, shared=shared)
             except Exception:
                 import bisect
                 bisect.insort(self._free, slot)
                 raise
+            if self._prefix is not None:
+                self.prefix_pages_total += self._pool.blocks_for(n)
+                self.prefix_shared_pages_total += len(shared)
         chunk = self.prefill_chunk
-        padded = numpy.zeros(_round_up(n, chunk), numpy.int32)
+        padded = numpy.zeros(start0 + _round_up(n - start0, chunk),
+                             numpy.int32)
         padded[:n] = tokens
-        self._chunking[slot] = {"tokens": padded, "n": n, "done": 0}
+        self._chunking[slot] = {"tokens": padded, "n": n,
+                                "done": start0, "tag": tag}
         self.slot_trace[slot] = obs_context.current_trace_id()
         return slot, None
 
@@ -779,9 +1091,17 @@ class GenerativeEngine(Logger):
             return None
         del self._chunking[slot]
         tok = int(tok)
-        self.slot_len[slot] = state["n"]
+        n = state["n"]
+        self.slot_len[slot] = n
         self.slot_token[slot] = tok
         self.slot_active[slot] = True
+        if self._prefix is not None:
+            m = n // self.block_size
+            if m:
+                self._prefix.insert(
+                    state["tokens"][:m * self.block_size],
+                    self._pool.owned(slot)[:m],
+                    state.get("tag") or self._prefix_tag(n))
         return tok
 
     def decode_step(self):
@@ -840,6 +1160,130 @@ class GenerativeEngine(Logger):
         self.slot_len[active] += 1
         self.slot_token[active] = out[active]
         return out, active
+
+    # -- speculative decode (draft K, verify in one dispatch) --------------
+    def propose(self, stream):
+        """Draft up to ``draft_k`` continuation tokens for one slot's
+        full token stream (prompt + generated, last element = the
+        slot's pending token) via the configured proposer.  Empty
+        list = that slot degrades to plain decode this round."""
+        if self.proposer is None:
+            return []
+        return list(self.proposer.propose(
+            stream, self.draft_k))[:self.draft_k]
+
+    def spec_decode_step(self, proposals):
+        """ONE draft-then-verify iteration over every decoding slot:
+        ``proposals`` maps slot -> proposed draft tokens (each at
+        most ``draft_k``; missing or empty entries degrade that slot
+        to plain decode).  All slots verify in the ONE fixed-shape
+        AOT program; greedy acceptance emits, per slot, the drafted
+        prefix that matched the target's own greedy choices plus the
+        target's first divergent token — ``a + 1`` tokens that are
+        BITWISE the plain-decode stream, just earned in one dispatch.
+        Returns ``{slot: [tokens...]}`` (None when nothing decodes).
+        Draft spans shrink per-slot against ``max_seq`` and the
+        pool's headroom (after the residents' plain-decode appends
+        are reserved), so speculation never triggers a preemption
+        plain decode would not have."""
+        if self.proposer is None:
+            raise RuntimeError("speculative decode is off "
+                               "(root.common.gen.speculative)")
+        if not self.slot_active.any():
+            return None
+        active = self.slot_active & (self.slot_len < self.max_seq)
+        if not active.any():
+            return None
+        jnp = self._jax.numpy
+        kp1 = self.draft_k + 1
+        tokens = numpy.zeros((self.max_slots, kp1), numpy.int32)
+        drafts = numpy.zeros(self.max_slots, numpy.int32)
+        tokens[:, 0] = numpy.where(active, self.slot_token, 0)
+        order = [int(s) for s in numpy.nonzero(active)[0]]
+        budget = None
+        if self._pool is not None:
+            # reserve what PLAIN decode would claim for every slot
+            # first (the scheduler's preemption loop priced exactly
+            # that); drafts only spend what remains
+            base = 0
+            for slot in order:
+                base += max(0, int(self.slot_len[slot])
+                            // self.block_size + 1
+                            - len(self._pool.owned(slot)))
+            budget = self._pool.blocks_free - base
+        for slot in order:
+            p = int(self.slot_len[slot])
+            prop = list(proposals.get(slot, ()))[:self.draft_k]
+            # the span p..p+D writes D+1 positions; keep them all
+            # inside the slot's max_seq road
+            cap = self.max_seq - p - 1
+            if len(prop) > cap:
+                prop = prop[:max(0, cap)]
+            if self._pool is not None:
+                while True:
+                    extra = ((p + len(prop)) // self.block_size
+                             - p // self.block_size)
+                    if extra <= budget or not prop:
+                        break
+                    prop.pop()
+                budget -= extra
+            drafts[slot] = len(prop)
+            tokens[slot, 1:1 + len(prop)] = prop
+            self.spec_drafted_total += len(prop)
+        if self._pool is not None:
+            # host half of the fused append, draft-span sized: every
+            # page that positions p..p+D land in must exist before
+            # the dispatch scatters into it
+            for slot in order:
+                last = int(self.slot_len[slot]) + int(drafts[slot])
+                while len(self._pool.owned(slot)) \
+                        * self.block_size <= last:
+                    self._pool.append(
+                        slot, len(self._pool.owned(slot))
+                        * self.block_size)
+        positions = numpy.where(active, self.slot_len, 0
+                                ).astype(numpy.int32)
+        exe, entry = self._verify_executable()
+        self.decode_calls += 1
+        self.spec_dispatches += 1
+        span_args = {"active": len(order), "engine": self.prof_name,
+                     "draft_k": self.draft_k}
+        with trace.span("gen", "spec_verify", span_args,
+                        role="server"):
+            tic = time.perf_counter_ns()
+            if self._pool is not None:
+                self._cache, out = exe(
+                    self._params, self._cache,
+                    jnp.asarray(self._pool.tables),
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(drafts), jnp.asarray(active))
+            else:
+                self._cache, out = exe(
+                    self._params, self._cache,
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(drafts), jnp.asarray(active))
+            out = numpy.asarray(out)
+            prof.ledger.record_dispatch(
+                entry, time.perf_counter_ns() - tic,
+                items=len(order))
+        results = {}
+        for slot in order:
+            d = int(drafts[slot])
+            a = 0
+            while a < d and tokens[slot, a + 1] == out[slot, a]:
+                a += 1
+            emitted = [int(t) for t in out[slot, :a + 1]]
+            self.slot_len[slot] += a + 1
+            self.slot_token[slot] = emitted[-1]
+            if self._pool is not None:
+                # the rejected tail's pages go back (stale K/V beyond
+                # the new length is masked by every read; the PAGES
+                # must not leak)
+                self._pool.truncate(slot, int(self.slot_len[slot]))
+            self.spec_accepted_total += a
+            self.spec_tokens_total += a + 1
+            results[slot] = emitted
+        return results
 
     # -- fleet page handoff ------------------------------------------------
     def export_slot(self, slot):
@@ -906,29 +1350,55 @@ class GenerativeEngine(Logger):
         jnp = self._jax.numpy
         exe, entry = self._page_in_executable()
         slot = self._free.pop(0)
+        # copy-on-adopt: pages the prefix cache already holds for this
+        # token stream are adopted by REFERENCE — only the unshared
+        # tail ships through page_in
+        shared, tag, ptokens = [], None, payload.get("tokens")
+        prompt_n = int(payload.get("prompt_n", 0))
+        if self._prefix is not None and ptokens is not None \
+                and prompt_n:
+            ptokens = numpy.ascontiguousarray(
+                ptokens, numpy.int32).ravel()
+            tag = self._prefix_tag(prompt_n)
+            shared = self._shared_usable(
+                self._prefix.match(ptokens[:prompt_n], tag))
         try:
-            ids = self._pool.admit(slot, n)
+            ids = self._pool.admit(slot, n, shared=shared)
         except Exception:
             import bisect
             bisect.insort(self._free, slot)
             raise
+        self.prefix_pages_total += len(ids)
+        self.prefix_shared_pages_total += len(shared)
         with trace.span("gen", "page_in",
                         obs_context.tag(
                             {"slot": slot, "pages": len(ids), "len": n,
                              "engine": self.prof_name}), role="server"):
             tic = time.perf_counter_ns()
             for i, bid in enumerate(ids):
+                if i < len(shared):
+                    continue
                 self._cache = exe(self._cache,
                                   jnp.asarray(k_pages[i]),
                                   jnp.asarray(v_pages[i]),
                                   jnp.int32(bid))
             prof.ledger.record_dispatch(
-                entry, time.perf_counter_ns() - tic, items=len(ids))
+                entry, time.perf_counter_ns() - tic,
+                items=len(ids) - len(shared))
         self.slot_len[slot] = n
         self.slot_token[slot] = int(payload["token"])
         self.slot_active[slot] = True
         self.slot_trace[slot] = obs_context.current_trace_id()
         self.adoptions_total += 1
+        # register only the PROMPT's full pages: decode-written KV
+        # came from a different program than prefill and must never
+        # become shareable prefix
+        if self._prefix is not None and ptokens is not None \
+                and prompt_n:
+            m = prompt_n // self.block_size
+            if m:
+                self._prefix.insert(ptokens[:m * self.block_size],
+                                    ids[:m], tag)
         return slot, int(payload["token"])
 
     # -- lifecycle / introspection -----------------------------------------
@@ -953,10 +1423,38 @@ class GenerativeEngine(Logger):
             return 0
         if self._pool is not None:
             per_block = self.kv_cache_bytes // self.num_blocks
-            kv = self._pool.blocks_used * per_block // occupants
+            blocks = self._pool.blocks_used
+            if self._prefix is not None:
+                # pages ONLY the cache holds are speculative capacity,
+                # not per-request cost (a shared page is counted once
+                # by blocks_used already)
+                blocks -= self._prefix.cache_only_pages()
+            kv = blocks * per_block // occupants
         else:
             kv = self.kv_cache_bytes // self.max_slots
         return kv + self.params_nbytes // occupants
+
+    def prefix_hit_rate(self):
+        """Fraction of admitted pages served from the prefix cache
+        instead of prefill compute (0.0 with the cache off)."""
+        if not self.prefix_pages_total:
+            return 0.0
+        return self.prefix_shared_pages_total \
+            / float(self.prefix_pages_total)
+
+    def spec_accept_rate(self):
+        """Fraction of drafted tokens the verify dispatch accepted."""
+        if not self.spec_drafted_total:
+            return 0.0
+        return self.spec_accepted_total \
+            / float(self.spec_drafted_total)
+
+    def spec_tokens_per_dispatch(self):
+        """Tokens emitted per speculative verify dispatch — 1.0 is
+        plain-decode parity, anything above is the speedup lever."""
+        if not self.spec_dispatches:
+            return 0.0
+        return self.spec_tokens_total / float(self.spec_dispatches)
 
     def describe(self):
         info = {
@@ -979,7 +1477,21 @@ class GenerativeEngine(Logger):
             "exports_total": self.exports_total,
             "adoptions_total": self.adoptions_total,
             "hbm_per_request_bytes": self.hbm_per_request_bytes(),
+            "prefix_cache": "on" if self.prefix_cache else "off",
+            "speculative": self.speculative or "off",
         }
+        if self.speculative:
+            info["draft_k"] = self.draft_k
+            info["spec_dispatches"] = self.spec_dispatches
+            info["spec_drafted_total"] = self.spec_drafted_total
+            info["spec_accepted_total"] = self.spec_accepted_total
+            info["spec_accept_rate"] = round(
+                self.spec_accept_rate(), 4)
+            info["spec_tokens_per_dispatch"] = round(
+                self.spec_tokens_per_dispatch(), 4)
+        if self._prefix is not None:
+            info["prefix_hit_rate"] = round(self.prefix_hit_rate(), 4)
+            info.update(self._prefix.describe())
         if self._pool is not None:
             info.update(self._pool.describe())
         return info
@@ -987,11 +1499,17 @@ class GenerativeEngine(Logger):
     def close(self):
         """Release the KV cache (and its ledger hold).  Idempotent."""
         from veles_tpu.memory import Watcher
+        # releases are generation-guarded like Vector's: a
+        # Watcher.reset() since the holds were taken already wiped
+        # them, and re-releasing would drive the ledger negative
+        stale = getattr(self, "_ledger_gen", 0) != Watcher.generation
         if getattr(self, "_kv_tracked", False):
-            Watcher.untrack(self.kv_cache_bytes, "kv", owner=self)
+            if not stale:
+                Watcher.untrack(self.kv_cache_bytes, "kv", owner=self)
             self._kv_tracked = False
         if getattr(self, "_params_tracked", False):
-            Watcher.untrack(self.params_nbytes, "params")
+            if not stale:
+                Watcher.untrack(self.params_nbytes, "params")
             self._params_tracked = False
         self._cache = None
         self._prefill_exe = {}
@@ -999,3 +1517,5 @@ class GenerativeEngine(Logger):
         self._decode_exe = None
         self._page_out_exe = None
         self._page_in_exe = None
+        self._verify_exe = None
+        self._draft_exe = None

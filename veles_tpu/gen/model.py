@@ -48,7 +48,9 @@ import numpy
 
 from veles_tpu.ops.attention import (chunk_attention, decode_attention,
                                      flash_attention,
-                                     paged_decode_attention)
+                                     paged_decode_attention,
+                                     paged_verify_attention,
+                                     verify_attention)
 
 
 def _layernorm(x, g, b):
@@ -303,6 +305,17 @@ class TransformerGenModel(object):
                             ).astype(jnp.float32)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+    def _greedy_grid(self, params, h):
+        """h (slots, K+1, d) -> the greedy token of EVERY row — the
+        verify step's readout.  Per-(slot, row) the contraction is the
+        same tied-readout einsum as :meth:`_greedy_rows`, so row 0's
+        argmax is the plain decode token."""
+        cd = self.compute_dtype
+        logits = jnp.einsum("bsd,vd->bsv", h.astype(cd),
+                            params["embed"].astype(cd)
+                            ).astype(jnp.float32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
     def prefill(self, params, cache, tokens, slot, length):
         """tokens (1, bucket) int32 (zero-padded past ``length``),
         ``slot``/``length`` traced int32 scalars → (cache', greedy
@@ -411,6 +424,82 @@ class TransformerGenModel(object):
         h, cache = self._run_layers(params, cache, h, kv_hook)
         return cache, self._greedy_rows(params, h)
 
+    # -- speculative verify (K drafts scored in ONE dispatch) --------------
+    def verify(self, params, cache, tokens, positions, drafts,
+               active):
+        """Score a slot's pending token plus its K draft
+        continuations in ONE dispatch against the CONTIGUOUS cache:
+        tokens (slots, K+1) int32 — row 0 each slot's last emitted
+        token (exactly what :meth:`decode` would consume), rows 1..K
+        the proposer's drafts; positions (slots,) int32 — row 0's
+        write position (the slot's length); drafts (slots,) int32 —
+        how many draft rows are REAL for the slot (0..K, 0 degrades
+        to plain decode); active (slots,) bool.  K/V for rows ``j <=
+        drafts`` are written at ``positions + j``; rows beyond (and
+        inactive slots) re-write the old value — the contiguous twin
+        of the trash-block route.  Returns ``(cache', out)`` with
+        ``out`` (slots, K+1): ``out[s, j]`` is the greedy token after
+        the prefix plus ``tokens[s, :j+1]``, so accepting while
+        ``tokens[s, j+1] == out[s, j]`` reproduces plain greedy
+        decode bitwise — acceptance only changes how many of these
+        tokens were earned per dispatch."""
+        slots, kp1 = tokens.shape
+        offs = jnp.arange(kp1)
+        gpos = positions[:, None] + offs[None, :]     # (slots, K+1)
+        h = (params["embed"][tokens]
+             + params["pos"][jnp.clip(gpos, 0, self.seq_limit - 1)])
+        idx = jnp.arange(slots)
+        keep = active[:, None] & (offs[None, :] <= drafts[:, None])
+        # masked rows park at position 0 and write the OLD value back
+        # (positions >= 1 for live slots, so no live row collides)
+        safe = jnp.where(keep, gpos, 0)
+        rows = jnp.broadcast_to(idx[:, None], (slots, kp1))
+
+        def kv_hook(kc, vc, q, k, v):
+            kc = kc.at[rows, safe].set(
+                jnp.where(keep[..., None, None], k.astype(kc.dtype),
+                          kc[rows, safe]))
+            vc = vc.at[rows, safe].set(
+                jnp.where(keep[..., None, None], v.astype(vc.dtype),
+                          vc[rows, safe]))
+            att = verify_attention(q, kc, vc, positions + 1,
+                                   use_pallas=self.use_pallas)
+            return kc, vc, att
+
+        h, cache = self._run_layers(params, cache, h, kv_hook)
+        return cache, self._greedy_grid(params, h)
+
+    def paged_verify(self, params, cache, tables, tokens, positions,
+                     drafts, active):
+        """The PAGED twin of :meth:`verify`: K/V rows scatter through
+        the block tables exactly like :meth:`paged_decode`'s fused
+        append (the engine pre-allocates every page the draft span
+        touches), with rows past ``drafts`` — and inactive slots —
+        routed to the trash block, and the attention read gathered
+        through the tables with the staggered verify mask."""
+        slots, kp1 = tokens.shape
+        bs = cache["k"].shape[2]               # [L, NB, BS, h, dh]
+        offs = jnp.arange(kp1)
+        gpos = positions[:, None] + offs[None, :]     # (slots, K+1)
+        h = (params["embed"][tokens]
+             + params["pos"][jnp.clip(gpos, 0, self.seq_limit - 1)])
+        idx = jnp.arange(slots)
+        keep = active[:, None] & (offs[None, :] <= drafts[:, None])
+        safe = jnp.where(keep, gpos, 0)
+        blk_idx = jnp.where(keep, tables[idx[:, None], safe // bs], 0)
+        blk_off = jnp.where(keep, safe % bs, 0)
+
+        def kv_hook(kc, vc, q, k, v):
+            kc = kc.at[blk_idx, blk_off].set(k.astype(kc.dtype))
+            vc = vc.at[blk_idx, blk_off].set(v.astype(vc.dtype))
+            att = paged_verify_attention(q, kc, vc, tables,
+                                         positions + 1,
+                                         use_pallas=self.use_pallas)
+            return kc, vc, att
+
+        h, cache = self._run_layers(params, cache, h, kv_hook)
+        return cache, self._greedy_grid(params, h)
+
     # -- chunked prefill (one chunk per decode-step cadence) ---------------
     def prefill_chunk(self, params, cache, tokens, slot, start,
                       chunk_len):
@@ -499,6 +588,14 @@ class TransformerGenModel(object):
         per_token = self.layers * self._per_token_layer_flops(
             max_seq / 2.0)
         return chunk * per_token + 2.0 * self.dim * self.vocab
+
+    def verify_flops(self, slots, k, max_seq):
+        """FLOPs of one K-draft verify step: K+1 query rows per slot,
+        each reading the masked KV extent like a decode row."""
+        per_token = (self.layers
+                     * self._per_token_layer_flops(float(max_seq))
+                     + 2.0 * self.dim * self.vocab)
+        return slots * (k + 1.0) * per_token
 
     def decode_flops(self, slots, max_seq):
         """FLOPs of one decode step: every slot reads its masked KV

@@ -27,6 +27,16 @@ keep a single fixed shape regardless of allocation state.
 turns into preemption: free the YOUNGEST sequence's pages, requeue it
 at the queue front with its tokens-so-far (greedy decode of the prefix
 reproduces the stream — preemption is lossless), and retry.
+
+Pages are REFCOUNTED (PR 19): a full page's content is a pure
+function of its token prefix (the sorted-free-list determinism), so
+the radix prefix cache (:mod:`veles_tpu.gen.prefix`) can hand the
+same physical page to several slots' tables copy-on-write — a shared
+page carries one reference per slot table naming it plus one for the
+cache itself, and only returns to the free list when the LAST
+reference drops.  ``release``/``truncate`` therefore decrement
+instead of free; exclusive pages (refcount 1) behave exactly as
+before, so the refcounts are invisible to a prefix-cache-off engine.
 """
 
 import bisect
@@ -70,6 +80,13 @@ class BlockPool(object):
         self._free = list(range(1, self.num_blocks))
         #: slot -> [block ids] in position order
         self._owned = {}
+        #: block id -> live reference count (slot tables + the prefix
+        #: cache); absent = free.  Exclusive pages sit at 1.
+        self._refs = {}
+        #: optional ``reclaimer(need) -> freed`` hook the prefix cache
+        #: installs: called once when an allocation comes up short so
+        #: LRU cache leaves can be evicted before PoolExhausted fires
+        self.reclaimer = None
         #: host mirror of the device block tables; entries past a
         #: slot's allocation stay TRASH
         self.tables = numpy.zeros((self.slots, self.max_blocks),
@@ -94,8 +111,40 @@ class BlockPool(object):
     def can_fit(self, n_tokens):
         return self.blocks_for(n_tokens) <= len(self._free)
 
+    # -- refcounts (prefix sharing) ----------------------------------------
+    def refcount(self, bid):
+        return self._refs.get(bid, 0)
+
+    def incref(self, bid):
+        """One more reference to a LIVE page (a slot table or the
+        prefix cache adopting it)."""
+        if bid == self.TRASH:
+            raise ValueError("the trash block is never referenced")
+        if bid not in self._refs:
+            raise ValueError("block %d is free — cannot share it"
+                             % bid)
+        self._refs[bid] += 1
+
+    def decref(self, bid):
+        """Drop one reference; the page returns to the sorted free
+        list when the LAST reference drops.  Returns True when the
+        page was actually freed."""
+        refs = self._refs.get(bid)
+        if not refs:
+            raise ValueError("block %d has no live reference" % bid)
+        if refs > 1:
+            self._refs[bid] = refs - 1
+            return False
+        del self._refs[bid]
+        bisect.insort(self._free, bid)
+        return True
+
     # -- allocation (lowest-id-first: deterministic) -----------------------
     def _pop(self, count, what):
+        if count > len(self._free) and self.reclaimer is not None:
+            # one reclaim attempt: the prefix cache evicts LRU leaves
+            # whose only reference is its own, growing the free list
+            self.reclaimer(count - len(self._free))
         if count > len(self._free):
             raise PoolExhausted(
                 "block pool exhausted: %s needs %d page(s), %d free "
@@ -103,16 +152,39 @@ class BlockPool(object):
                            self.blocks_total),
                 needed=count, free=len(self._free))
         ids, self._free = self._free[:count], self._free[count:]
+        for bid in ids:
+            self._refs[bid] = 1
         return ids
 
-    def admit(self, slot, n_tokens):
+    def admit(self, slot, n_tokens, shared=()):
         """Allocate the pages for a freshly admitted ``n_tokens``
-        prefix and fill the slot's table row.  Returns the block ids
-        (position order)."""
+        prefix and fill the slot's table row.  ``shared`` (prefix-
+        cache hits, position order) are LIVE pages adopted by
+        reference — incref'd, never written by this slot — and only
+        the unshared suffix is allocated fresh.  Returns the block
+        ids (position order)."""
         if slot in self._owned:
             raise ValueError("slot %d already owns pages" % slot)
-        ids = self._pop(self.blocks_for(n_tokens),
-                        "admitting slot %d" % slot)
+        shared = list(shared)
+        need = self.blocks_for(n_tokens)
+        if len(shared) >= need:
+            raise ValueError(
+                "%d shared pages leave no exclusive tail page for %d "
+                "tokens — the write frontier must stay unshared"
+                % (len(shared), n_tokens))
+        # incref BEFORE popping: _pop may invoke the reclaimer, and a
+        # matched-but-not-yet-adopted cache page (refcount 1) would be
+        # fair game for eviction otherwise
+        for bid in shared:
+            self.incref(bid)
+        try:
+            ids = self._pop(need - len(shared),
+                            "admitting slot %d" % slot)
+        except PoolExhausted:
+            for bid in shared:
+                self.decref(bid)
+            raise
+        ids = shared + ids
         self._owned[slot] = ids
         self.tables[slot, :len(ids)] = ids
         return ids
@@ -149,23 +221,56 @@ class BlockPool(object):
         return owned is not None and \
             position // self.block_size >= len(owned)
 
+    def truncate(self, slot, n_tokens):
+        """Shrink the slot back to ``n_tokens`` — the speculative-
+        decode rollback: pages past ``blocks_for(n_tokens)`` drop one
+        reference (freed only when nothing else shares them) and their
+        table entries return to TRASH.  Returns the number of pages
+        dropped from the table."""
+        owned = self._owned.get(slot)
+        if owned is None:
+            raise ValueError("slot %d owns no pages" % slot)
+        keep = self.blocks_for(n_tokens)
+        if keep >= len(owned):
+            return 0
+        dropped = owned[keep:]
+        del owned[keep:]
+        for bid in dropped:
+            self.decref(bid)
+        self.tables[slot, keep:] = self.TRASH
+        return len(dropped)
+
     def release(self, slot):
-        """Return the slot's pages to the free list (sorted — the
-        deterministic-allocation invariant) and reset its table row.
-        Returns the number of pages freed."""
+        """Drop the slot's reference on every page it names (sorted
+        free list — the deterministic-allocation invariant) and reset
+        its table row.  Shared pages survive until their LAST
+        reference drops.  Returns the number of pages actually
+        freed."""
         ids = self._owned.pop(slot, None)
         if ids is None:
             return 0
+        freed = 0
         for bid in ids:
-            bisect.insort(self._free, bid)
+            freed += bool(self.decref(bid))
         self.tables[slot, :] = self.TRASH
-        return len(ids)
+        return freed
+
+    def pages_saved(self):
+        """Pages prefix sharing is currently saving: a shared page
+        always carries exactly ONE cache registration ref (sharing
+        only arises through the radix tree), so every reference past
+        slot+cache is a page some slot did NOT have to allocate
+        (V-S01's refcount-aware pricing credit)."""
+        return sum(refs - 2 for refs in self._refs.values()
+                   if refs > 2)
 
     def describe(self):
+        shared = sum(1 for refs in self._refs.values() if refs > 1)
         return {
             "block_size": self.block_size,
             "blocks_total": self.blocks_total,
             "blocks_free": self.blocks_free,
             "blocks_used": self.blocks_used,
+            "blocks_shared": shared,
             "max_blocks_per_slot": self.max_blocks,
         }

@@ -100,17 +100,24 @@ class GenRequest(object):
             numpy.asarray(self.generated, numpy.int32)])
 
 
-def finish_reason(engine, n_generated, max_new_tokens, token, slot):
+def finish_reason(engine, n_generated, max_new_tokens, token, slot,
+                  slot_len=None):
     """The ONE finish predicate continuous and static batching share
     (divergent semantics here would break the parity gate): ``"eos"``
     when the engine's eos token was produced, ``"length"`` at the
     request's token budget or a full KV slot (the sequence is out of
-    cache road even under its budget), else ``None``."""
+    cache road even under its budget), else ``None``.  ``slot_len``
+    overrides the engine's live counter — a speculative verify
+    advances the slot by the whole accepted span before its tokens
+    are emitted one by one, so intermediate emits pass the length AS
+    OF that token to keep the predicate bitwise-plain-decode."""
     if engine.eos_id is not None and token == engine.eos_id:
         return "eos"
     if n_generated >= max_new_tokens:
         return "length"
-    if engine.slot_len[slot] >= engine.max_seq:
+    if slot_len is None:
+        slot_len = engine.slot_len[slot]
+    if slot_len >= engine.max_seq:
         return "length"
     return None
 
@@ -188,6 +195,18 @@ class GenerativeScheduler(Logger):
             metrics.register_gauge(
                 "gen_blocks_free" + label,
                 lambda: self.engine.blocks_free)
+        if getattr(self.engine, "prefix_cache", False):
+            metrics.register_gauge(
+                "gen_prefix_hit_rate" + label,
+                lambda: round(self.engine.prefix_hit_rate(), 4))
+        if getattr(self.engine, "speculative", None):
+            metrics.register_gauge(
+                "gen_spec_accept_rate" + label,
+                lambda: round(self.engine.spec_accept_rate(), 4))
+            metrics.register_gauge(
+                "gen_spec_tokens_per_dispatch" + label,
+                lambda: round(
+                    self.engine.spec_tokens_per_dispatch(), 4))
         metrics.register_histogram("gen_ttft_seconds", self.ttft,
                                    "submit -> first generated token",
                                    labels={"model": self.name})
@@ -200,6 +219,11 @@ class GenerativeScheduler(Logger):
                   "gen_preemptions_total", "gen_hbm_per_request_bytes"]
         if getattr(self.engine, "kv_mode", "contiguous") == "paged":
             gauges += ["gen_blocks_total", "gen_blocks_free"]
+        if getattr(self.engine, "prefix_cache", False):
+            gauges += ["gen_prefix_hit_rate"]
+        if getattr(self.engine, "speculative", None):
+            gauges += ["gen_spec_accept_rate",
+                       "gen_spec_tokens_per_dispatch"]
         for gauge in gauges:
             metrics.unregister_gauge(gauge + label)
         metrics.unregister_histogram("gen_ttft_seconds",
@@ -377,7 +401,7 @@ class GenerativeScheduler(Logger):
         return future.result(0)
 
     # -- the scheduling iteration ------------------------------------------
-    def _emit(self, request, token):
+    def _emit(self, request, token, slot_len=None):
         request.generated.append(int(token))
         if request.first_token_at is None:
             request.first_token_at = time.perf_counter()
@@ -405,7 +429,7 @@ class GenerativeScheduler(Logger):
                 request.on_token = None
         reason = finish_reason(self.engine, len(request.generated),
                                request.max_new_tokens, int(token),
-                               request.slot)
+                               request.slot, slot_len=slot_len)
         if reason is not None:
             self._finish(request, reason)
 
@@ -418,6 +442,15 @@ class GenerativeScheduler(Logger):
             # re-runs the prefill rather than losing the request)
             try:
                 request.export = self.engine.export_slot(request.slot)
+                # ride the token stream + prompt length along so the
+                # adopting engine's prefix cache can copy-on-adopt the
+                # shared pages (prompt pages only — decode-written KV
+                # never becomes shareable prefix)
+                n = int(request.export["n"])
+                stream = numpy.asarray(request.prefix(), numpy.int32)
+                request.export["tokens"] = stream[:n]
+                request.export["prompt_n"] = min(
+                    len(request.tokens), n)
             except Exception:
                 self.exception("page export failed; the fleet will "
                                "re-run this prefill")
@@ -474,6 +507,43 @@ class GenerativeScheduler(Logger):
                           role="server")
         with self._cond:
             self._queue.appendleft(request)
+
+    def _spec_decode(self):
+        """One speculative draft-then-verify round over the active
+        set: collect proposals per slot, run the engine's single
+        verify dispatch, then emit each slot's accepted span ONE
+        token at a time through the shared finish predicate — the
+        emitted stream (and where it stops) is bitwise what plain
+        decode would have produced, just cheaper per token.  Returns
+        the number of tokens emitted."""
+        proposals = {}
+        for slot, request in self._active.items():
+            if self.engine.slot_len[slot] >= self.engine.max_seq:
+                continue
+            proposals[slot] = self.engine.propose(request.prefix())
+        result = self.engine.spec_decode_step(proposals)
+        if result is None:
+            return 0
+        emitted = 0
+        self.decode_steps += 1
+        self.decode_slot_steps += len(result)
+        for slot, tokens in sorted(result.items()):
+            request = self._active.get(slot)
+            if request is None:
+                continue
+            final_len = int(self.engine.slot_len[slot])
+            for j, token in enumerate(tokens):
+                # the slot length AS OF this token: the engine already
+                # advanced by the whole accepted span
+                effective = final_len - (len(tokens) - 1 - j)
+                self._emit(request, token, slot_len=effective)
+                emitted += 1
+                if request.finish_reason is not None:
+                    # eos/length mid-span: plain decode would have
+                    # stopped here too; the rest of the span is the
+                    # rejected-future tail and must not be emitted
+                    break
+        return emitted
 
     def step(self):
         """One iteration: admit while the engine has REAL headroom
@@ -540,7 +610,10 @@ class GenerativeScheduler(Logger):
                 if not self._queue:
                     break
                 head = self._queue[0]
-                if not self.engine.can_admit(len(head.prefix())):
+                # pass the tokens so prefix-cache hits (and evictable
+                # cache-only pages) count toward the pricing
+                if not self.engine.can_admit(len(head.prefix()),
+                                             head.prefix()):
                     break          # FIFO: no overtaking the head
                 request = self._queue.popleft()
             try:
@@ -632,15 +705,18 @@ class GenerativeScheduler(Logger):
             self._preempt(max(victims, key=lambda r: r.admit_seq))
             emitted += 1                     # progress, not idle
         if self._active:
-            result = self.engine.decode_step()
-            if result is not None:
-                out, active = result
-                self.decode_steps += 1
-                self.decode_slot_steps += int(active.sum())
-                for slot, request in list(self._active.items()):
-                    if active[slot]:
-                        self._emit(request, out[slot])
-                        emitted += 1
+            if getattr(self.engine, "proposer", None) is not None:
+                emitted += self._spec_decode()
+            else:
+                result = self.engine.decode_step()
+                if result is not None:
+                    out, active = result
+                    self.decode_steps += 1
+                    self.decode_slot_steps += int(active.sum())
+                    for slot, request in list(self._active.items()):
+                        if active[slot]:
+                            self._emit(request, out[slot])
+                            emitted += 1
         from veles_tpu import watch
         if watch.enabled() \
                 and self.decode_steps != decode_steps_before \

@@ -52,6 +52,10 @@ class Watcher(object):
     lock = threading.Lock()
     bytes_in_use = 0
     peak_bytes = 0
+    #: bumped by reset(); holds taken before the current generation
+    #: were already wiped from the ledger, so their releases must be
+    #: no-ops (GC can finalize a Vector long after a reset)
+    generation = 0
     h2d_bytes = 0
     h2d_transfers = 0
     d2h_bytes = 0
@@ -128,6 +132,7 @@ class Watcher(object):
     @classmethod
     def reset(cls):
         with cls.lock:
+            cls.generation += 1
             cls.bytes_in_use = 0
             cls.peak_bytes = 0
             cls.h2d_bytes = 0
@@ -163,6 +168,7 @@ class Vector(Pickleable):
         self._dev_fresh_ = False   # device copy up to date
         self._tracked_bytes_ = 0
         self._tracked_category_ = None
+        self._tracked_gen_ = 0
         #: pod-mesh placement (NamedSharding); process-local like the
         #: device handle, installed by PodRuntime via set_sharding()
         self._sharding_ = None
@@ -360,9 +366,7 @@ class Vector(Pickleable):
 
     # -- helpers ------------------------------------------------------------
     def _set_devmem(self, value):
-        if self._tracked_bytes_:
-            Watcher.untrack(self._tracked_bytes_,
-                            self._tracked_category_, owner=self)
+        self._untrack_devmem()
         self._devmem_ = value
         self._tracked_bytes_ = (
             int(numpy.prod(value.shape)) * value.dtype.itemsize
@@ -371,12 +375,20 @@ class Vector(Pickleable):
             self._tracked_category_ = getattr(self, "category", None)
             Watcher.track(self._tracked_bytes_,
                           self._tracked_category_, owner=self)
+            self._tracked_gen_ = Watcher.generation
+
+    def _untrack_devmem(self):
+        if self._tracked_bytes_:
+            # a Watcher.reset() since the hold was taken already
+            # wiped these bytes; releasing them again would drive
+            # the ledger (and its category) negative
+            if getattr(self, "_tracked_gen_", 0) == Watcher.generation:
+                Watcher.untrack(self._tracked_bytes_,
+                                self._tracked_category_, owner=self)
+            self._tracked_bytes_ = 0
 
     def _drop_devmem(self):
-        if self._tracked_bytes_:
-            Watcher.untrack(self._tracked_bytes_,
-                            self._tracked_category_, owner=self)
-            self._tracked_bytes_ = 0
+        self._untrack_devmem()
         self._devmem_ = None
 
     def __del__(self):

@@ -422,7 +422,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal=False, block_q=128,
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_ref, m_ref, l_ref, *, n_k, scale, block_k,
-                   heads):
+                   heads, row_step=0):
     """Single-query decode step: grid (batch*heads, k_blocks); K is
     the sequential dimension; the per-row KV length arrives scalar-
     prefetched (``len_ref``, one int32 per *batch* row — heads share
@@ -432,7 +432,13 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
     discipline is identical to :func:`_attn_kernel`.  K blocks fully
     beyond the row's length are skipped — the decode analogue of the
     causal block skip, and where the win over a dense masked pass
-    comes from when the cache is long but the sequence is young."""
+    comes from when the cache is long but the sequence is young.
+
+    ``row_step=1`` is the VERIFY variant (speculative decode): the 8
+    q sublanes are CONSECUTIVE positions of one sequence — row ``j``
+    writes at ``length - 1 + j`` and may read keys ``< length + j`` —
+    so the per-row mask staggers by the sublane index and one dispatch
+    prices K+1 draft tokens at one decode step's DMA traffic."""
     bh = pl.program_id(0)
     kk = pl.program_id(1)
 
@@ -443,7 +449,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     length = len_ref[bh // heads]
-    run = kk * block_k < length
+    run = kk * block_k < length + 7 * row_step
 
     @pl.when(run)
     def _step():
@@ -454,7 +460,9 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
             preferred_element_type=jnp.float32)        # (8, bk)
         k_pos = kk * block_k + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1)
-        scores = jnp.where(k_pos < length, scores, NEG_INF)
+        limit = length + row_step * jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0)
+        scores = jnp.where(k_pos < limit, scores, NEG_INF)
         m_prev = m_ref[...]
         m_cur = jnp.max(scores, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -490,10 +498,15 @@ def _decode_jnp(q, k, v, lengths):
     return out.astype(q.dtype)
 
 
-def _decode_pallas(q, k, v, lengths, block_k=128, interpret=False):
-    b, _sq, h, d = q.shape
-    sk = k.shape[1]
+def _decode_pallas(q, k, v, lengths, block_k=128, interpret=False,
+                   row_step=0):
+    b, sq, h, d = q.shape
+    if sq > 8:
+        raise ValueError(
+            "decode/verify q carries %d rows but the kernel's q tile "
+            "is one 8-sublane block — draft_k must stay <= 7" % sq)
     scale = 1.0 / (d ** 0.5)
+    sk = k.shape[1]
     bk = min(block_k, _round_up(sk, 8))
     q3 = _bhsd(q, b, h, d, 8)                   # (b·h, 8, d_p)
     k3, v3 = _bhsd(k, b, h, d, bk), _bhsd(v, b, h, d, bk)
@@ -513,7 +526,7 @@ def _decode_pallas(q, k, v, lengths, block_k=128, interpret=False):
     ]
     out = pl.pallas_call(
         functools.partial(_decode_kernel, n_k=n_k, scale=scale,
-                          block_k=bk, heads=h),
+                          block_k=bk, heads=h, row_step=row_step),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
             out_specs=out_spec, scratch_shapes=scratch),
@@ -522,7 +535,7 @@ def _decode_pallas(q, k, v, lengths, block_k=128, interpret=False):
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(jnp.asarray(lengths, jnp.int32), q3, k3, v3)
-    return jnp.moveaxis(out[:, :1, :d].reshape(b, h, 1, d), 1, 2)
+    return jnp.moveaxis(out[:, :sq, :d].reshape(b, h, sq, d), 1, 2)
 
 
 def decode_attention(q, k, v, lengths, block_k=None, use_pallas=None,
@@ -583,7 +596,7 @@ def _paged_decode_jnp(q, k_pool, v_pool, tables, lengths):
 
 def _paged_decode_kernel(len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
                          acc_ref, m_ref, l_ref, *, n_b, scale,
-                         block_size, heads):
+                         block_size, heads, row_step=0):
     """Paged decode step: grid (batch*heads, max_blocks); the KV
     blocks arrive ALREADY ROUTED by the block table — the BlockSpec
     index map reads the scalar-prefetched ``tab_ref`` to aim each
@@ -604,7 +617,7 @@ def _paged_decode_kernel(len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     length = len_ref[bh // heads]
-    run = kk * block_size < length
+    run = kk * block_size < length + 7 * row_step
 
     @pl.when(run)
     def _step():
@@ -615,7 +628,9 @@ def _paged_decode_kernel(len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
             preferred_element_type=jnp.float32)        # (8, BS)
         k_pos = kk * block_size + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1)
-        scores = jnp.where(k_pos < length, scores, NEG_INF)
+        limit = length + row_step * jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0)
+        scores = jnp.where(k_pos < limit, scores, NEG_INF)
         m_prev = m_ref[...]
         m_cur = jnp.max(scores, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -635,8 +650,12 @@ def _paged_decode_kernel(len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _paged_decode_pallas(q, k_pool, v_pool, tables, lengths,
-                         interpret=False):
-    b, _sq, h, d = q.shape
+                         interpret=False, row_step=0):
+    b, sq, h, d = q.shape
+    if sq > 8:
+        raise ValueError(
+            "decode/verify q carries %d rows but the kernel's q tile "
+            "is one 8-sublane block — draft_k must stay <= 7" % sq)
     block_size = k_pool.shape[1]
     if block_size % 8:
         raise ValueError(
@@ -671,7 +690,8 @@ def _paged_decode_pallas(q, k_pool, v_pool, tables, lengths,
     ]
     out = pl.pallas_call(
         functools.partial(_paged_decode_kernel, n_b=n_b, scale=scale,
-                          block_size=block_size, heads=h),
+                          block_size=block_size, heads=h,
+                          row_step=row_step),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2, grid=grid, in_specs=in_specs,
             out_specs=out_spec, scratch_shapes=scratch),
@@ -681,7 +701,7 @@ def _paged_decode_pallas(q, k_pool, v_pool, tables, lengths,
         interpret=interpret,
     )(jnp.asarray(lengths, jnp.int32),
       jnp.asarray(tables, jnp.int32), q3, k4, v4)
-    return jnp.moveaxis(out[:, :1, :d].reshape(b, h, 1, d), 1, 2)
+    return jnp.moveaxis(out[:, :sq, :d].reshape(b, h, sq, d), 1, 2)
 
 
 def paged_decode_attention(q, k_pool, v_pool, tables, lengths,
@@ -741,6 +761,79 @@ def chunk_attention(q, k, v, start, use_pallas=None, interpret=None):
         return o
     o, _lse = _mha_jnp(q, k, v, True, q_offset=start)
     return o
+
+
+def _verify_jnp(q, k, v, lengths):
+    """Dense masked reference for the K-token VERIFY step
+    (speculative decode): q (b, Kp1, h, d) — row ``j`` of sequence
+    ``i`` is the query at global position ``lengths[i] - 1 + j`` and
+    may read keys ``< lengths[i] + j`` (its own K/V is already
+    written, like the decode step's).  Row 0 is EXACTLY the plain
+    decode query — same einsum forms and mask arithmetic as
+    :func:`_decode_jnp`, the greedy-acceptance equivalence gate's
+    substrate."""
+    d = q.shape[-1]
+    kp1 = q.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / (d ** 0.5)
+    limits = (lengths[:, None] + jnp.arange(kp1)[None, :])
+    mask = (jnp.arange(k.shape[1])[None, None, None, :]
+            < limits[:, None, :, None])
+    scores = jnp.where(mask, scores, NEG_INF)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)
+    probs = jnp.exp(scores - lse[..., None])
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def verify_attention(q, k, v, lengths, block_k=None, use_pallas=None,
+                     interpret=None):
+    """K-token causal verify against a masked KV buffer — the
+    speculative-decode hot op (ROADMAP item 3b): ONE dispatch scores
+    a slot's current token plus its K draft continuations.
+
+    ``q``: (b, K+1, h, d) — row ``j`` of sequence ``i`` queries from
+    global position ``lengths[i] - 1 + j`` (K/V for all K+1 tokens
+    already written at [lengths-1, lengths+K)); ``k``/``v``: (b, S,
+    h, d) cache buffers; ``lengths``: (b,) int32 — the valid extent
+    INCLUDING row 0's token.  Row ``j`` reads keys ``< lengths[i] +
+    j``: the same mask plain decode would apply after accepting
+    ``j`` drafts, so greedy acceptance over the outputs is an exact
+    equivalence with plain decode.  TPU rides the decode kernel with
+    the per-sublane staggered mask (``row_step=1``); elsewhere the
+    dense masked reference.  K+1 must stay <= 8 (one q sublane
+    tile)."""
+    lengths = jnp.asarray(lengths, jnp.int32)
+    pallas = use_pallas if use_pallas is not None else _on_tpu()
+    if pallas:
+        if interpret is None:
+            from veles_tpu.config import root
+            interpret = bool(root.common.engine.get("interpret", False))
+        return _decode_pallas(q, k, v, lengths,
+                              block_k=block_k or 128,
+                              interpret=interpret, row_step=1)
+    return _verify_jnp(q, k, v, lengths)
+
+
+def paged_verify_attention(q, k_pool, v_pool, tables, lengths,
+                           use_pallas=None, interpret=None):
+    """The PAGED twin of :func:`verify_attention`: same staggered
+    per-row mask, KV gathered through the block tables (Pallas: the
+    table-routed BlockSpec DMA of the paged decode kernel; elsewhere
+    the XLA gather + dense reference).  Draft positions past a
+    sequence's allocation route their writes to the trash block
+    upstream, so the gathered garbage sits beyond every row's mask."""
+    lengths = jnp.asarray(lengths, jnp.int32)
+    tables = jnp.asarray(tables, jnp.int32)
+    pallas = use_pallas if use_pallas is not None else _on_tpu()
+    if pallas:
+        if interpret is None:
+            from veles_tpu.config import root
+            interpret = bool(root.common.engine.get("interpret", False))
+        return _paged_decode_pallas(q, k_pool, v_pool, tables, lengths,
+                                    interpret=interpret, row_step=1)
+    return _verify_jnp(q, _gather_pool(k_pool, tables),
+                       _gather_pool(v_pool, tables), lengths)
 
 
 def _mha_jnp(q, k, v, causal, q_offset=0, k_offset=0):

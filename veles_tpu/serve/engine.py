@@ -177,6 +177,7 @@ class InferenceEngine(Logger):
         self.params_nbytes = tree_nbytes(self._params)
         Watcher.track(self.params_nbytes, "params")
         self._params_tracked = True
+        self._ledger_gen = Watcher.generation
         self._compiled = {}          # batch size -> AOT executable
         self._compile_lock = threading.Lock()
         self.compile_count = 0
@@ -470,11 +471,14 @@ class InferenceEngine(Logger):
         self._out_struct_ = None
         # re-price the ledger hold from the new (int8) leaves
         from veles_tpu.memory import Watcher
-        if getattr(self, "_params_tracked", False):
+        if (getattr(self, "_params_tracked", False)
+                and getattr(self, "_ledger_gen", 0)
+                == Watcher.generation):
             Watcher.untrack(self.params_nbytes, "params")
         self.params_nbytes = quant.tree_nbytes(self._params)
         Watcher.track(self.params_nbytes, "params")
         self._params_tracked = True
+        self._ledger_gen = Watcher.generation
         self.info("quantized params to int8 (%d bytes resident)",
                   self.params_nbytes)
         return self
@@ -497,7 +501,12 @@ class InferenceEngine(Logger):
         undeploy/stop and when a hot swap retires the engine."""
         if getattr(self, "_params_tracked", False):
             from veles_tpu.memory import Watcher
-            Watcher.untrack(self.params_nbytes, "params")
+            # generation-guarded like Vector's release: a
+            # Watcher.reset() since the hold was taken already wiped
+            # it, and re-releasing would drive the ledger negative
+            if (getattr(self, "_ledger_gen", 0)
+                    == Watcher.generation):
+                Watcher.untrack(self.params_nbytes, "params")
             self._params_tracked = False
 
     def warmup(self):
