@@ -1042,6 +1042,212 @@ def stage_mnist_pod_epoch():
         trace.configure()
 
 
+def stage_mnist_pod_pp():
+    """Pipeline-parallel pod epochs: a homogeneous stacked-stage
+    model trained through :func:`veles_tpu.parallel.pp.pipeline_apply`
+    over a dp×pp mesh, each epoch ONE jitted scan over minibatches
+    (one dispatch per class pass), vs the SAME-RUN dp twin running the
+    identical stages as a sequential ``lax.scan`` with params
+    replicated — ``vs_baseline`` therefore prices what pipelining the
+    stages costs/buys on THIS device set (on the virtual CPU mesh the
+    bubble is pure overhead; on real chips the stage weights stop
+    being replicated).  ``bubble_fraction`` carries the analytic GPipe
+    ramp/drain idle share the planner prices, ``dispatches_per_epoch``
+    the host-dispatch bound the pod smoke asserts."""
+    import jax
+    import jax.numpy as jnp
+    import numpy
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from veles_tpu.analyze.pricing import pipeline_bubble
+    from veles_tpu.parallel.mesh import make_mesh, replicated
+    from veles_tpu.parallel.pp import pipeline_apply
+
+    n_dev = len(jax.devices())
+    stages = 4 if n_dev % 4 == 0 else 2
+    if n_dev < 2 * stages:
+        print(_dumps({
+            "metric": "MLP stacked-stage pipeline-parallel pod epoch "
+                      "train throughput",
+            "value": 0.0, "unit": "images/sec", "vs_baseline": None,
+            "error": "needs a dp×pp mesh: %d device(s) < %d"
+                     % (n_dev, 2 * stages),
+            "device_kind": _device_kind()}))
+        return
+    dim, batch, n_micro, steps_per_epoch, epochs = 128, 1024, 8, 16, 3
+    mesh = make_mesh({"data": n_dev // stages, "pipe": stages})
+    rng = numpy.random.default_rng(11)
+    params = {
+        "w": jnp.asarray(rng.standard_normal(
+            (stages, dim, dim)).astype(numpy.float32) * 0.3),
+        "b": jnp.zeros((stages, dim), numpy.float32),
+    }
+    pp_shard = {"w": NamedSharding(mesh, P("pipe", None, None)),
+                "b": NamedSharding(mesh, P("pipe", None))}
+    dp_shard = {"w": replicated(mesh), "b": replicated(mesh)}
+    data = jnp.asarray(rng.standard_normal(
+        (steps_per_epoch, batch, dim)).astype(numpy.float32))
+    target = jnp.asarray(rng.standard_normal(
+        (steps_per_epoch, batch, dim)).astype(numpy.float32))
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def seq_forward(p, x):
+        def body(h, leaf):
+            return stage_fn(leaf, h), None
+        h, _ = jax.lax.scan(body, x, p)
+        return h
+
+    def pp_forward(p, x):
+        return pipeline_apply(stage_fn, p, x, mesh, n_micro=n_micro,
+                              batch_axis="data")
+
+    def epoch_fn(forward, shard):
+        def loss_fn(p, x, y):
+            return ((forward(p, x) - y) ** 2).mean()
+
+        def step(p, xs):
+            x, y = xs
+            grads = jax.grad(loss_fn)(p, x, y)
+            return jax.tree.map(lambda a, g: a - 0.1 * g, p,
+                                grads), None
+
+        def epoch(p):
+            p, _ = jax.lax.scan(step, p, (data, target))
+            return p
+        # pinned in/out shardings: every epoch call lands on ONE
+        # compiled program — zero steady-state recompiles
+        return jax.jit(epoch, in_shardings=(shard,),
+                       out_shardings=shard)
+
+    seq_epoch = epoch_fn(seq_forward, dp_shard)
+    pp_epoch = epoch_fn(pp_forward, pp_shard)
+    p_seq = jax.device_put(params, dp_shard)
+    p_pp = jax.device_put(params, pp_shard)
+    p_seq = seq_epoch(p_seq)           # warm: compiles included
+    p_pp = pp_epoch(p_pp)
+    jax.block_until_ready((p_seq, p_pp))
+    tic = time.perf_counter()
+    for _ in range(epochs):
+        p_seq = seq_epoch(p_seq)
+    jax.block_until_ready(p_seq)
+    dp_ips = epochs * steps_per_epoch * batch \
+        / (time.perf_counter() - tic)
+    tic = time.perf_counter()
+    for _ in range(epochs):
+        p_pp = pp_epoch(p_pp)
+    jax.block_until_ready(p_pp)
+    elapsed = time.perf_counter() - tic
+    _emit("MLP stacked-stage pipeline-parallel pod epoch train "
+          "throughput (one-dispatch epochs, %dx%d dp×pp mesh)"
+          % (n_dev // stages, stages),
+          elapsed / (epochs * steps_per_epoch), batch, None,
+          vs=dp_ips,
+          extra={"dispatches_per_epoch": 1,
+                 "bubble_fraction": round(
+                     pipeline_bubble(stages, n_micro), 4),
+                 "stages": stages, "microbatches": n_micro,
+                 "shards": n_dev,
+                 "recompiles": (seq_epoch._cache_size() - 1)
+                 + (pp_epoch._cache_size() - 1),
+                 "devices": n_dev,
+                 "vs_metric": "same stages as a sequential dp scan, "
+                              "params replicated (same run)"})
+
+
+def stage_moe_pod():
+    """Expert-parallel pod steps: the switch-MoE sample routed by
+    ``all_to_all`` over a dp×ep mesh vs its SAME-RUN dense reference
+    (one-program jit, no mesh) — at the drop-free capacity
+    (``capacity_factor = n_experts``) the two are token-for-token
+    equal, so ``vs_baseline`` prices exactly what expert routing
+    costs/buys; ``all_to_all_bytes_per_step`` carries the analytic
+    exchange traffic the prof ledger's new column meters (tokens out
+    to their experts and back)."""
+    import jax
+    import numpy
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from veles_tpu.analyze.pricing import all_to_all_bytes
+    from veles_tpu.parallel.mesh import make_mesh
+    from veles_tpu.samples import moe
+
+    n_dev = len(jax.devices())
+    experts = 4
+    if n_dev < 2 * experts:
+        print(_dumps({
+            "metric": "Switch-MoE expert-parallel pod train "
+                      "throughput",
+            "value": 0.0, "unit": "images/sec", "vs_baseline": None,
+            "error": "needs a dp×ep mesh: %d device(s) < %d"
+                     % (n_dev, 2 * experts),
+            "device_kind": _device_kind()}))
+        return
+    cfg = {"vocab": 512, "dim": 64, "ffn": 128, "experts": experts,
+           "seq_len": 32}
+    batch, steps = 32, 10
+    mesh = make_mesh({"data": n_dev // experts, "expert": experts})
+    # correctness first: drop-free routing must match the dense
+    # reference token for token (the ep smoke leg's parity anchor)
+    params = moe.init_params(cfg, seed=1)
+    probe = moe.synthetic_tokens(cfg, 8, seed=2)
+    diff = float(numpy.abs(
+        numpy.asarray(moe.apply_fn(params, probe, cfg, mesh=None))
+        - numpy.asarray(moe.apply_fn(params, probe, cfg,
+                                     mesh=mesh))).max())
+    if diff > 1e-5:
+        print(_dumps({
+            "metric": "Switch-MoE expert-parallel pod train "
+                      "throughput",
+            "value": 0.0, "unit": "images/sec", "vs_baseline": None,
+            "error": "routed MoE diverged %.2e from the dense "
+                     "reference at drop-free capacity" % diff,
+            "device_kind": _device_kind()}))
+        return
+    tokens = moe.synthetic_tokens(cfg, batch, seed=3)
+
+    def timed(p, v, step, toks):
+        for _ in range(2):             # warm: compiles included
+            p, v, metrics = step(p, v, toks)
+        jax.block_until_ready(metrics["loss"])
+        warm_compiles = step._cache_size()
+        tic = time.perf_counter()
+        for _ in range(steps):
+            p, v, metrics = step(p, v, toks)
+        jax.block_until_ready(metrics["loss"])
+        return (time.perf_counter() - tic,
+                step._cache_size() - warm_compiles)
+
+    p, v, dense_step = moe.build_train(cfg, mesh=None, seed=1)
+    dense_elapsed, dense_rec = timed(p, v, dense_step, tokens)
+    dense_ips = steps * batch / dense_elapsed
+    p, v, ep_step = moe.build_train(cfg, mesh=mesh, seed=1)
+    shard = {name: NamedSharding(mesh, spec)
+             for name, spec in moe.param_specs(p).items()}
+    p = jax.device_put(p, shard)
+    v = jax.device_put(v, shard)
+    toks = jax.device_put(tokens,
+                          NamedSharding(mesh, P("data", "expert")))
+    elapsed, ep_rec = timed(p, v, ep_step, toks)
+    # the routed activation [B, T, D] crosses the expert axis out and
+    # back each step — the ledger's all_to_all column meters the same
+    act_bytes = batch * cfg["seq_len"] * cfg["dim"] * 4
+    _emit("Switch-MoE expert-parallel pod train throughput "
+          "(all_to_all routing, %dx%d dp×ep mesh, seq/sec)"
+          % (n_dev // experts, experts),
+          elapsed / steps, batch,
+          moe.train_step_flops(cfg, batch), vs=dense_ips,
+          extra={"all_to_all_bytes_per_step":
+                 all_to_all_bytes(act_bytes, experts),
+                 "experts": experts, "expert_shards": experts,
+                 "max_token_diff": diff,
+                 "recompiles": dense_rec + ep_rec,
+                 "devices": n_dev,
+                 "vs_metric": "dense MoE reference, one-program jit "
+                              "(same run)"})
+
+
 def stage_ae_wf_epoch():
     """The AE family through the full framework path with epoch_mode:
     StandardWorkflow(fused, epoch_mode) + MSE loss — the regression
@@ -2519,6 +2725,8 @@ STAGES = {
     "mnist_wf_slave": (stage_mnist_wf_slave, 300),
     "mnist_pod": (stage_mnist_pod, 420),
     "mnist_pod_epoch": (stage_mnist_pod_epoch, 420),
+    "mnist_pod_pp": (stage_mnist_pod_pp, 300),
+    "moe_pod": (stage_moe_pod, 300),
     "cifar": (stage_cifar, 210),
     "stl10": (stage_stl10, 240),
     "ae": (stage_ae, 150),
@@ -2550,6 +2758,7 @@ _FULL_ORDER = ("mnist", "mnist_bf16", "mnist_u8", "mnist_e2e",
                "mnist_wf_eager_devloader", "mnist_wf_eager_epoch",
                "mnist_wf_health",
                "mnist_wf_slave", "mnist_pod", "mnist_pod_epoch",
+               "mnist_pod_pp", "moe_pod",
                "cifar", "stl10", "ae",
                "kohonen",
                "lstm", "transformer", "transformer_lm_train",
@@ -2575,7 +2784,7 @@ _COLD_ORDER = ("mnist", "alexnet", "mnist_bf16", "mnist_u8", "profile",
                "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
                "mnist_wf_eager_devloader", "mnist_wf_eager_epoch",
                "mnist_wf_health", "mnist_wf_slave", "mnist_pod",
-               "mnist_pod_epoch")
+               "mnist_pod_epoch", "mnist_pod_pp", "moe_pod")
 
 #: CPU fallback (rehearsed with a wedged tunnel): conv/LM heavies
 #: cannot finish on CPU inside their caps — end on the flagship MNIST
@@ -2584,7 +2793,8 @@ _CPU_ORDER = ("mnist_e2e", "mnist_epoch", "mnist_wf",
               "mnist_wf_epoch", "ae_wf_epoch", "mnist_wf_eager",
               "mnist_wf_eager_devloader", "mnist_wf_eager_epoch",
               "mnist_wf_health",
-              "mnist_wf_slave", "mnist_pod", "mnist_pod_epoch", "ae",
+              "mnist_wf_slave", "mnist_pod", "mnist_pod_epoch",
+              "mnist_pod_pp", "moe_pod", "ae",
               "kohonen", "lstm", "transformer_lm_train",
               "transformer_gen",
               "native_infer", "mnist_u8", "mnist_bf16", "mnist")

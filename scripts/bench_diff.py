@@ -94,9 +94,14 @@ _COUNTERS = ("recompiles", "dispatches_per_epoch")
 _HIGHER_BETTER_FIELDS = ("mfu", "steps_per_dispatch", "vs_bf16_x",
                          "vs_baseline", "prefix_hit_rate",
                          "spec_accept_rate", "vs_nonspec_x")
+#: bubble_fraction / all_to_all_bytes_per_step: the pod pp/ep stages —
+#: the GPipe ramp/drain idle share and the per-step expert-exchange
+#: traffic are both pure cost; either growing means the pipeline
+#: schedule or the routing buffers regressed
 _LOWER_BETTER_FIELDS = ("sec_per_step", "hbm_per_request_bytes",
                         "ttft_p99_ms", "handoff_bytes_per_request",
-                        "autoscaler_actions")
+                        "autoscaler_actions", "bubble_fraction",
+                        "all_to_all_bytes_per_step")
 
 
 def value_direction(record):

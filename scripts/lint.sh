@@ -111,9 +111,16 @@ python scripts/bench_diff.py --selftest
 # counters are the probe), zero steady-state recompiles, eval parity
 # with the single-device run, a chip-kill reshard mid-epoch (mesh
 # shrink + generation bump) and a byte-identical mesh-sharded
-# InferenceEngine — the V-P02 preflight runs inside install()
-echo "== pod smoke (one-pod-one-program gate) =="
-timeout -k 10 280 env JAX_PLATFORMS=cpu \
+# InferenceEngine — the V-P02 preflight runs inside install().
+# Pod-of-pods legs ride the same gate: a pp leg (stacked stages
+# pipelined over dp×pp, one dispatch per class pass, bitwise forward
+# parity vs the dp twin), an ep leg (all_to_all-routed MoE, token
+# parity vs the dense reference at capacity >= n_experts), a
+# simulated 2-process multi-host session (the multihost test double)
+# asserting the one-update-frame wire gate + lockstep rank weights,
+# and a heartbeat device-loss reshard completing with eval parity
+echo "== pod smoke (one-pod-one-program + pod-of-pods gate) =="
+timeout -k 10 560 env JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -m veles_tpu.pod --smoke
 # fleet smoke: the disaggregated-serving gate — a scripted 2-role

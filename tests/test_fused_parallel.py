@@ -667,7 +667,10 @@ def test_graft_entry_contract():
     assert numpy.allclose(numpy.asarray(out).sum(axis=1), 1.0, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8():
+    # compiles the whole real-dims multichip ladder (~85 s on the
+    # virtual CPU mesh) — outside the tier-1 budget
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
 

@@ -160,3 +160,101 @@ def test_spmd_launch_boots_local_fleet(tmp_path):
     # both ranks were announced on stderr with their target host
     assert "rank 0 on localhost" in proc.stderr
     assert "rank 1 on localhost" in proc.stderr
+
+
+# -- in-process edge cases (the process_double test double) ------------------
+
+def test_host_shard_range_even_and_uneven():
+    from veles_tpu.parallel import multihost
+    from veles_tpu.parallel.multihost import MultiHostShardError
+    with multihost.process_double(3) as dbl:
+        # even split: typed refusal when the batch does not divide
+        import pytest
+        with pytest.raises(MultiHostShardError):
+            multihost.host_shard_range(10)
+        # uneven: the remainder lands on the LAST rank only
+        spans = []
+        for rank in range(3):
+            with dbl.rank(rank):
+                spans.append(multihost.host_shard_range(
+                    10, allow_uneven=True))
+        assert spans == [(0, 3), (3, 6), (6, 10)]
+        # spans tile [0, 10) with no overlap
+        assert spans[0][1] == spans[1][0] and spans[1][1] == spans[2][0]
+
+
+def test_from_host_local_single_process_identity():
+    """No coordinator, one process: from_host_local must be a plain
+    identity placement — the transparent single-host fallback the
+    pod-of-pods delegation contract rides on."""
+    import jax
+
+    from veles_tpu.parallel import multihost
+    from veles_tpu.parallel.mesh import make_mesh, shard_batch
+    assert not multihost.configured()
+    mesh = make_mesh({"data": -1})
+    local = numpy.arange(32, dtype=numpy.float32).reshape(16, 2)
+    out = multihost.from_host_local(local, shard_batch(mesh))
+    assert isinstance(out, jax.Array)
+    assert out.shape == (16, 2)
+    numpy.testing.assert_array_equal(numpy.asarray(out), local)
+
+
+def test_from_host_local_typed_error_on_indivisible_axis():
+    """The sharding's data axis must split evenly across processes —
+    a typed MultiHostShardError (a ValueError subclass, so legacy
+    handlers keep working)."""
+    import pytest
+
+    from veles_tpu.parallel import multihost
+    from veles_tpu.parallel.multihost import MultiHostShardError
+    from veles_tpu.parallel.mesh import make_mesh, shard_batch
+    mesh = make_mesh({"data": -1})          # 8 shards; 8 % 3 != 0
+    local = numpy.zeros((4, 2), numpy.float32)
+    with multihost.process_double(3):
+        with pytest.raises(MultiHostShardError) as err:
+            multihost.from_host_local(local, shard_batch(mesh),
+                                      global_shape=(12, 2))
+        assert issubclass(err.type, ValueError)
+
+
+def test_process_double_banks_shards_incrementally():
+    """Sequential rank simulation: each rank's from_host_local banks
+    its shard, the LAST rank's call returns the fully assembled
+    global — the invariant the pod smoke's 2-process leg drives."""
+    from veles_tpu.parallel import multihost
+    from veles_tpu.parallel.mesh import make_mesh, shard_batch
+    mesh = make_mesh({"data": -1})
+    full = numpy.arange(64, dtype=numpy.float32).reshape(16, 4)
+    with multihost.process_double(2) as dbl:
+        assert multihost.configured()
+        assert multihost.process_count() == 2
+        with dbl.rank(0):
+            assert multihost.is_coordinator()
+            partial = multihost.from_host_local(
+                full[:8], shard_batch(mesh), global_shape=(16, 4))
+            # rank 1 has not contributed yet: its rows are zero-padded
+            got = numpy.asarray(partial)
+            numpy.testing.assert_array_equal(got[:8], full[:8])
+            assert not got[8:].any()
+        with dbl.rank(1):
+            assert not multihost.is_coordinator()
+            out = multihost.from_host_local(
+                full[8:], shard_batch(mesh), global_shape=(16, 4))
+            numpy.testing.assert_array_equal(numpy.asarray(out), full)
+    assert not multihost.configured()
+
+
+def test_process_double_does_not_nest_and_checks_rank():
+    import pytest
+
+    from veles_tpu.parallel import multihost
+    with multihost.process_double(2) as dbl:
+        with pytest.raises(RuntimeError):
+            with multihost.process_double(2):
+                pass
+        with pytest.raises(ValueError):
+            with dbl.rank(2):
+                pass
+    with pytest.raises(ValueError):
+        multihost.process_double(0)

@@ -161,7 +161,9 @@ def test_plan_params_transformer_megatron_specs():
     # moves the least per step (no grad psum at data=1)
     assert res.best.name == "tp8"
     pp8 = next(c for c in res.candidates if c.name == "pp8")
-    assert pp8.feasible and pp8.skeleton and pp8.bubble > 0
+    # pp candidates are executable now (pp_rules stage-shards the
+    # stacked blocks), no longer skeleton-priced
+    assert pp8.feasible and not pp8.skeleton and pp8.bubble > 0
     # stacked blocks (leading L=12) stage-shard; embed stays whole
     dp8 = next(c for c in res.candidates if c.name == "dp8")
     assert dp8.feasible

@@ -460,3 +460,123 @@ def test_inference_engine_mesh_parity_and_fallback():
     assert fallback.mesh is None
     numpy.testing.assert_array_equal(plain.infer(batch),
                                      fallback.infer(batch))
+
+
+# -- pod-of-pods (multi-host pods, pp/ep rules, device loss) -----------------
+
+def test_multihost_pod_transparent_delegation():
+    """A single-process MultiHostPod IS its PodRuntime: same install/
+    uninstall lifecycle, describe() decorated with process topology,
+    host_range covering the whole dataset."""
+    from veles_tpu.pod import MultiHostPod
+    wf = make_workflow(max_epochs=1)
+    pod = MultiHostPod(wf)
+    assert pod.process_count == 1
+    assert pod.process_index == 0
+    assert pod.is_coordinator
+    assert pod.host_range(64) == (0, 64)
+    pod.install()
+    try:
+        assert pod.runtime.installed
+        desc = pod.describe()
+        assert desc["processes"] == 1
+        assert desc["process_index"] == 0
+        assert desc["coordinator"] is True
+        assert desc["shards"] == pod.runtime.shards
+        # assemble: identity placement on one process
+        local = numpy.zeros((16, 4), numpy.float32)
+        out = pod.assemble(local)
+        assert out.shape == (16, 4)
+    finally:
+        pod.uninstall()
+    assert not pod.runtime.installed
+
+
+def test_device_loss_detector_heartbeat_reshard(live_trace):
+    """A silent host is declared lost after ``timeout``: one
+    ``jobs:heartbeat_stall`` instant per host, ONE reshard dropping
+    its devices_per_host chips, no re-loss on the next poll."""
+    from veles_tpu.pod import DeviceLossDetector
+    wf = make_workflow(max_epochs=1)
+    runtime = PodRuntime(wf, mesh=mesh_from_topology(
+        {"data": -1}, require=("data",)))
+    runtime.install()
+    try:
+        clock = {"now": 100.0}
+        det = DeviceLossDetector(runtime, timeout=5.0,
+                                 devices_per_host=4,
+                                 clock=lambda: clock["now"])
+        det.beat("host-0")
+        det.beat("host-1")
+        assert det.hosts() == ["host-0", "host-1"]
+        assert det.poll() == []                 # everyone fresh
+        clock["now"] += 10.0
+        det.beat("host-0")                      # host-0 stays alive
+        gen = runtime.generation
+        shards = runtime.shards
+        stalls = live_trace.recorder.count("jobs", "heartbeat_stall")
+        assert det.poll() == ["host-1"]
+        assert det.stalls == 1
+        assert runtime.generation == gen + 1
+        assert runtime.shards == shards - 4
+        assert live_trace.recorder.count(
+            "jobs", "heartbeat_stall") == stalls + 1
+        # the lost host left the table: no repeated reshard
+        assert det.poll() == []
+        assert det.hosts() == ["host-0"]
+        assert runtime.generation == gen + 1
+    finally:
+        runtime.uninstall()
+
+
+def test_device_loss_detector_dispatch_failure():
+    """Typed classification: an UNAVAILABLE-style runtime error
+    reshards and returns True (retry); anything else returns False
+    (re-raise) and never touches the mesh."""
+    from veles_tpu.pod import DeviceLossDetector, is_device_loss
+    assert is_device_loss(RuntimeError("UNAVAILABLE: socket closed"))
+    assert is_device_loss(RuntimeError(
+        "device lost: slice health check failed"))
+    assert is_device_loss(RuntimeError("DEADLINE EXCEEDED waiting"))
+    assert not is_device_loss(RuntimeError("Invalid argument: dim 3"))
+    assert not is_device_loss(ValueError("unavailable"))
+    assert not is_device_loss(None)
+    wf = make_workflow(max_epochs=1)
+    runtime = PodRuntime(wf, mesh=mesh_from_topology(
+        {"data": -1}, require=("data",)))
+    runtime.install()
+    try:
+        det = DeviceLossDetector(runtime, devices_per_host=4)
+        gen = runtime.generation
+        assert not det.dispatch_failure(ValueError("shape mismatch"))
+        assert runtime.generation == gen
+        assert det.dispatch_failure(
+            RuntimeError("UNAVAILABLE: connection reset by peer"))
+        assert det.dispatch_losses == 1
+        assert runtime.generation == gen + 1
+    finally:
+        runtime.uninstall()
+
+
+def test_pp_ep_rules_shard_leading_dim():
+    """pp_rules/ep_rules: stage/expert-stacked leaves shard their
+    leading dim over the pipe/expert axis, everything else (scalars,
+    small leaves, indivisible leading dims) replicates."""
+    import pytest
+    from jax.sharding import PartitionSpec as P
+
+    from veles_tpu.parallel.dp import ep_rules, pp_rules
+    from veles_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh({"data": 2, "pipe": 4})
+    rules = pp_rules(mesh, min_elements=64)
+    assert rules(numpy.zeros((4, 32, 32))) == P("pipe", None, None)
+    assert rules(numpy.zeros((8, 64))) == P("pipe", None)
+    assert rules(numpy.zeros((3, 64, 64))) is None   # 3 % 4 != 0
+    assert rules(numpy.zeros((4, 2))) is None        # too small
+    assert rules(numpy.float32(0.5)) is None         # scalar
+    with pytest.raises(ValueError):
+        pp_rules(make_mesh({"data": -1}))            # no pipe axis
+    emesh = make_mesh({"data": 2, "expert": 4})
+    erules = ep_rules(emesh, min_elements=64)
+    assert erules(numpy.zeros((4, 16, 32))) == P("expert", None, None)
+    assert erules(numpy.zeros((4, 8))) is None       # below min_elements

@@ -84,12 +84,16 @@ KNOB_REGISTRY = {
     "root.common.engine.mesh.axes":
         "named mesh axes table ({name: size}) for make_mesh",
     "root.common.engine.pod.topology":
-        "pod mesh topology spelling (auto | N | DxM)",
+        "pod mesh topology spelling (auto | N | DxM | "
+        "axis=size[,axis=size] incl. pipeline/expert axes)",
     "root.common.engine.pod.preflight":
         "V-P02 pod preflight mode at install (off | warn | fail)",
     "root.common.engine.pod.param_rules":
         "pod param-sharding mode: auto = static planner picks "
-        "replicated/fsdp/tp for the mesh at install()",
+        "replicated/fsdp/tp/pp/ep for the mesh at install()",
+    "root.common.engine.pod.microbatches":
+        "pipeline microbatches per step for the pipe axis "
+        "(default: 4x the stage count)",
     # dirs — filesystem layout
     "root.common.dirs.datasets":
         "dataset root directory (MNIST et al. resolve under it)",

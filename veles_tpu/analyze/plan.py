@@ -264,22 +264,38 @@ def _factorizations(n):
 
 
 def enumerate_candidates(n_devices, explicit_axes=None,
-                         tp_recipe=None, fsdp_recipe=None):
+                         tp_recipe=None, fsdp_recipe=None,
+                         pp_recipe=None, ep_recipe=None):
     """The candidate set for ``n`` devices (or the pinned axes).
 
-    ``tp_recipe(axes)`` / ``fsdp_recipe(axes)`` build the param rule
-    for a candidate's abstract axes — injected so the workflow path
-    uses the :mod:`veles_tpu.parallel.dp` recipes and the params path
-    its pytree twins.
+    ``tp_recipe(axes)`` / ``fsdp_recipe(axes)`` / ``pp_recipe(axes)``
+    / ``ep_recipe(axes)`` build the param rule for a candidate's
+    abstract axes — injected so the workflow path uses the
+    :mod:`veles_tpu.parallel.dp` recipes and the params path its
+    pytree twins.  With a ``pp_recipe`` the pipeline candidates are
+    EXECUTABLE (the rule is the real
+    :func:`veles_tpu.parallel.dp.pp_rules` the runtime installs);
+    without one they stay skeletons ranked below executable plans by
+    construction.
     """
     cands = []
     if explicit_axes is not None:
         d = int(explicit_axes.get("data", 1))
         m = int(explicit_axes.get("model", 1))
         s = int(explicit_axes.get("pipe", 1))
+        e = int(explicit_axes.get("expert", 1))
         if s > 1:
-            cands.append(Candidate("pp%d" % s, explicit_axes,
-                                   "pipe(stage)", skeleton=True))
+            cands.append(Candidate(
+                ("pp%d" % s) if d == 1 else "dp%dxpp%d" % (d, s),
+                explicit_axes, "pipe(stage)",
+                pp_recipe(explicit_axes) if pp_recipe else None,
+                skeleton=pp_recipe is None))
+        elif e > 1:
+            cands.append(Candidate(
+                ("ep%d" % e) if d == 1 else "dp%dxep%d" % (d, e),
+                explicit_axes, "ep(expert)",
+                ep_recipe(explicit_axes) if ep_recipe else None,
+                skeleton=ep_recipe is None))
         elif m > 1:
             cands.append(Candidate(
                 "dp%dxtp%d" % (d, m), explicit_axes, "tp(model)",
@@ -308,7 +324,9 @@ def enumerate_candidates(n_devices, explicit_axes=None,
             axes = {"data": d, "pipe": s}
             cands.append(Candidate(
                 ("pp%d" % s) if d == 1 else "dp%dxpp%d" % (d, s),
-                axes, "pipe(stage)", skeleton=True))
+                axes, "pipe(stage)",
+                pp_recipe(axes) if pp_recipe else None,
+                skeleton=pp_recipe is None))
     return cands
 
 
@@ -385,7 +403,8 @@ def plan_workflow(workflow, topology="auto", devices=None,
                   optimizer=None):
     """Enumerate + price + rank candidate plans for an initialized,
     stitched workflow.  Returns a :class:`PlanResult`."""
-    from veles_tpu.parallel.dp import fsdp_rules, tp_rules
+    from veles_tpu.parallel.dp import (ep_rules, fsdp_rules, pp_rules,
+                                       tp_rules)
 
     loader = getattr(workflow, "loader", None)
     batch = int(batch_size
@@ -409,8 +428,16 @@ def plan_workflow(workflow, topology="auto", devices=None,
     def fsdp_recipe(axes):
         return fsdp_rules(pricing.abstract_mesh(axes))
 
+    def pp_recipe(axes):
+        return pp_rules(pricing.abstract_mesh(axes))
+
+    def ep_recipe(axes):
+        return ep_rules(pricing.abstract_mesh(axes))
+
     cands = enumerate_candidates(n, explicit, tp_recipe=tp_recipe,
-                                 fsdp_recipe=fsdp_recipe)
+                                 fsdp_recipe=fsdp_recipe,
+                                 pp_recipe=pp_recipe,
+                                 ep_recipe=ep_recipe)
     param_shapes = _param_vec_shapes(workflow, batch)
     act_bytes = _activation_bytes(workflow, batch)
     params_total = sum(nb for _s, nb in param_shapes)
@@ -447,12 +474,32 @@ def plan_workflow(workflow, topology="auto", devices=None,
                     "layer(s) — a stage would own no layer"
                     % (stages, n_layers),
                     fix="cap the pipe axis at the layer count")
+            elif cand.param_rules is not None and not n_sharded:
+                cand.reject(
+                    "V-P03",
+                    "pipe axis %d shards no parameter leaf (no "
+                    "stage-divisible leading dim above min_elements) "
+                    "— every stage would replicate the whole model"
+                    % stages,
+                    fix="stack the layers on a leading stage axis "
+                        "divisible by pipe, or drop the pp candidate")
             else:
                 cand.bubble = pricing.pipeline_bubble(
                     stages, PP_MICRO_PER_STAGE * stages)
                 cand.notes.append(
-                    "skeleton: params/stage only, m=%d microbatches"
+                    ("skeleton: params/stage only, m=%d microbatches"
+                     if cand.skeleton else "m=%d microbatches")
                     % (PP_MICRO_PER_STAGE * stages))
+        experts = int(cand.axes.get("expert", 1))
+        if cand.feasible and experts > 1 \
+                and cand.param_rules is not None and not n_sharded:
+            cand.reject(
+                "V-P03",
+                "expert axis %d shards no parameter leaf (no "
+                "expert-led stack above min_elements) — the axis "
+                "would replicate compute %d-fold" % (experts, experts),
+                fix="stack expert weights on a leading expert dim "
+                    "divisible by the axis, or drop the ep candidate")
         if not cand.feasible:
             continue
         res = pricing.pod_residency(workflow, cand.axes, batch,
@@ -460,9 +507,11 @@ def plan_workflow(workflow, topology="auto", devices=None,
                                     param_rules=cand.param_rules)
         per_shard = res.true_per_shard_bytes
         by_cat = dict(res.by_category)
-        if stages > 1:
+        if stages > 1 and cand.skeleton:
             # stage-sharded params: each stage owns 1/stages of the
-            # replicated parameter set (the skeleton's memory claim)
+            # replicated parameter set (the skeleton's memory claim;
+            # an executable pp candidate's rule already divided the
+            # stage-sharded leaves through pod_residency)
             saved = by_cat.get("params", 0) * (1.0 - 1.0 / stages)
             by_cat["params"] = by_cat.get("params", 0) / stages
             per_shard -= saved
@@ -479,6 +528,12 @@ def plan_workflow(workflow, topology="auto", devices=None,
             # TP re-assembles activations at the sharded boundaries
             cand.gather_bytes += 2 * pricing.ring_all_gather_bytes(
                 act_bytes, model)
+        if experts > 1 and n_sharded:
+            # expert dispatch exchanges the batch-led activations out
+            # to their experts and back (NOT a ring reduce — priced by
+            # the all_to_all formula, carried in the exchange column)
+            cand.gather_bytes += pricing.all_to_all_bytes(
+                act_bytes, experts)
         if budget is not None and per_shard > budget:
             cand.fits = False
             cand.notes.append(
@@ -591,8 +646,20 @@ def plan_params(params, topology="auto", devices=None, batch_bytes=0,
         return fsdp_rules(pricing.abstract_mesh(axes),
                           min_elements=min_elements)
 
+    def pp_recipe(axes):
+        from veles_tpu.parallel.dp import pp_rules
+        return pp_rules(pricing.abstract_mesh(axes),
+                        min_elements=min_elements)
+
+    def ep_recipe(axes):
+        from veles_tpu.parallel.dp import ep_rules
+        return ep_rules(pricing.abstract_mesh(axes),
+                        min_elements=min_elements)
+
     cands = enumerate_candidates(n, explicit, tp_recipe=tp_recipe,
-                                 fsdp_recipe=fsdp_recipe)
+                                 fsdp_recipe=fsdp_recipe,
+                                 pp_recipe=pp_recipe,
+                                 ep_recipe=ep_recipe)
     slots = 1 + max(0, int(optimizer_slots))
 
     for cand in cands:
@@ -666,7 +733,8 @@ def plan_params(params, topology="auto", devices=None, batch_bytes=0,
             cand.bubble = pricing.pipeline_bubble(
                 stages, PP_MICRO_PER_STAGE * stages)
             cand.notes.append(
-                "skeleton: m=%d microbatches"
+                ("skeleton: m=%d microbatches" if cand.skeleton
+                 else "m=%d microbatches")
                 % (PP_MICRO_PER_STAGE * stages))
         per_shard = (replicated + sharded_per_shard
                      + float(batch_bytes) / max(1, d))
@@ -707,13 +775,18 @@ def auto_param_rules(workflow, mesh, data_axis="data",
     ``PodRuntime(param_rules="auto")``'s selector.
 
     Candidates are the rule choices over the runtime's fixed axes
-    (replicated / fsdp over ``data`` / tp over ``model`` when the
-    mesh has one >1), priced and ranked exactly like
-    :func:`plan_workflow`.  Returns ``(rules_callable_or_None,
-    name, candidate_dict)``; replication wins ties so a fitting pod
-    keeps the seed behavior bit-for-bit.
+    (replicated / fsdp over ``data`` / tp over ``model`` / pp over
+    ``pipe`` / ep over ``expert`` when the mesh has one >1), priced
+    and ranked exactly like :func:`plan_workflow` — the pp/ep
+    candidates through the real V-P02 residency walk, not the
+    skeleton claim.  Returns ``(rules_callable_or_None, name,
+    candidate_dict)``; replication wins ties so a fitting pod keeps
+    the seed behavior bit-for-bit (a mesh with a ``pipe``/``expert``
+    axis its rule cannot use is rejected per candidate, like a tp
+    axis that shards nothing).
     """
-    from veles_tpu.parallel.dp import fsdp_rules, tp_rules
+    from veles_tpu.parallel.dp import (ep_rules, fsdp_rules, pp_rules,
+                                       tp_rules)
 
     axes = dict(mesh.shape)
     batch = int(getattr(getattr(workflow, "loader", None),
@@ -726,6 +799,14 @@ def auto_param_rules(workflow, mesh, data_axis="data",
     if int(axes.get("model", 1)) > 1:
         cands.append(Candidate("tp", axes, "tp(model)",
                                tp_rules(mesh)))
+    stages = int(axes.get("pipe", 1))
+    experts = int(axes.get("expert", 1))
+    if stages > 1:
+        cands.append(Candidate("pp", axes, "pipe(stage)",
+                               pp_rules(mesh)))
+    if experts > 1:
+        cands.append(Candidate("ep", axes, "ep(expert)",
+                               ep_rules(mesh)))
     param_shapes = _param_vec_shapes(workflow, batch)
     act_bytes = _activation_bytes(workflow, batch)
     params_total = sum(nb for _s, nb in param_shapes)
@@ -735,6 +816,34 @@ def auto_param_rules(workflow, mesh, data_axis="data",
         d = int(axes.get(data_axis, 1))
         n_sharded, sharded_param_bytes = _check_rule_divisibility(
             cand, param_shapes)
+        # a mesh axis is the operator's intent: a rule that leaves a
+        # >1 pipe/expert axis idle would replicate compute across it,
+        # so only the matching recipe competes on such a mesh (data-
+        # only meshes keep the seed tie-break: replicated first)
+        if stages > 1 and cand.rule_desc != "pipe(stage)":
+            cand.reject(
+                "V-P03",
+                "mesh has a %d-stage pipe axis this rule leaves idle"
+                % stages,
+                fix="use the pipe(stage) rule (or drop the axis)")
+        if experts > 1 and cand.rule_desc != "ep(expert)" \
+                and cand.feasible:
+            cand.reject(
+                "V-P03",
+                "mesh has a %d-way expert axis this rule leaves idle"
+                % experts,
+                fix="use the ep(expert) rule (or drop the axis)")
+        if cand.feasible and not n_sharded \
+                and cand.rule_desc in ("pipe(stage)", "ep(expert)"):
+            cand.reject(
+                "V-P03",
+                "%s rule shards no parameter leaf over this mesh — "
+                "the %s axis would replicate compute"
+                % (cand.rule_desc,
+                   "pipe" if cand.rule_desc == "pipe(stage)"
+                   else "expert"),
+                fix="stack the stage/expert weights on a divisible "
+                    "leading dim")
         if not cand.feasible:
             continue
         res = pricing.pod_residency(workflow, axes, batch,
@@ -749,6 +858,12 @@ def auto_param_rules(workflow, mesh, data_axis="data",
         if cand.rule_desc == "tp(model)" and n_sharded:
             cand.gather_bytes = 2 * pricing.ring_all_gather_bytes(
                 act_bytes, int(axes.get("model", 1)))
+        if cand.rule_desc == "pipe(stage)":
+            cand.bubble = pricing.pipeline_bubble(
+                stages, PP_MICRO_PER_STAGE * stages)
+        if cand.rule_desc == "ep(expert)" and n_sharded:
+            cand.gather_bytes = pricing.all_to_all_bytes(
+                act_bytes, experts)
         if budget is not None \
                 and cand.per_shard_bytes > budget:
             cand.fits = False

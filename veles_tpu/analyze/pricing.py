@@ -280,6 +280,38 @@ def ring_all_gather_bytes(nbytes, n):
     return int(int(nbytes) * (n - 1) / n)
 
 
+def all_to_all_bytes(nbytes, n):
+    """All-to-all moves ``(n−1)/n`` of the exchanged bytes per
+    participant and direction; expert dispatch crosses twice
+    (tokens out to their experts, results back), so the per-step
+    estimate is ``2·(n−1)/n`` — same magnitude as a ring all-reduce
+    but it is EXCHANGE traffic, not a reduction, which is why the
+    prof ledger carries it in its own ``all_to_all_bytes`` column."""
+    n = int(n)
+    if n < 2:
+        return 0
+    return int(int(nbytes) * 2 * (n - 1) / n)
+
+
+def segment_all_to_all_bytes(segment, batch, expert_shards):
+    """Analytic per-dispatch expert-dispatch traffic of ONE stitched
+    segment: every batch-led activation the segment's stages exchange
+    crosses the ``expert`` axis out and back.  Zero when the mesh has
+    no expert axis (>1)."""
+    n = int(expert_shards)
+    if n < 2:
+        return 0
+    moved = 0
+    seen = set()
+    for stage in segment.stages:
+        for vec in stage.consumes.values():
+            shape = vec.shape or ()
+            if shape and shape[0] == batch and id(vec) not in seen:
+                seen.add(id(vec))
+                moved += int(vec.nbytes)
+    return all_to_all_bytes(moved, n)
+
+
 def pipeline_bubble(stages, microbatches):
     """GPipe bubble fraction ``(s−1)/(m+s−1)`` — the fraction of every
     step the pipeline's ramp-up/drain ticks idle each stage."""

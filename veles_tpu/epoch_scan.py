@@ -662,14 +662,17 @@ class EpochScanRunner(Logger):
                     verd, cls=cls, epoch=int(loader.epoch_number),
                     steps=k)
             toc = time.perf_counter_ns()
-            psum = 0
+            psum = a2a = 0
             if pod is not None:
                 entry.shards = pod.shards
                 psum = pod.segment_psum_bytes(seg1) * k
+                a2a = pod.segment_all_to_all_bytes(seg1) * k
                 if train:
                     psum += pod.segment_psum_bytes(seg2) * k
+                    a2a += pod.segment_all_to_all_bytes(seg2) * k
             prof.ledger.record_dispatch(entry, toc - tic, steps=k,
-                                        psum_bytes=psum)
+                                        psum_bytes=psum,
+                                        all_to_all_bytes=a2a)
             if pod is not None and trace.enabled():
                 for shard in range(pod.shards):
                     trace.complete("pod", "shard_dispatch", tic,

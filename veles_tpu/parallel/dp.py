@@ -12,11 +12,13 @@ Also provides tensor-parallel param sharding rules (the mesh design
 gives TP "for free" — SURVEY §2.4 table) for models whose layers
 exceed a chip.
 
-:func:`tp_rules` and :func:`fsdp_rules` double as the pod runtime's
-``param_rules`` (:class:`veles_tpu.pod.runtime.PodRuntime`): the same
-per-leaf PartitionSpec recipes shard the stitched eager trainer's
+:func:`tp_rules`, :func:`fsdp_rules`, :func:`pp_rules` and
+:func:`ep_rules` double as the pod runtime's ``param_rules``
+(:class:`veles_tpu.pod.runtime.PodRuntime`): the same per-leaf
+PartitionSpec recipes shard the stitched eager trainer's
 parameter/solver Vectors when the V-P02 residency estimate says
-replication does not fit.
+replication does not fit (or the mesh carries a ``pipe``/``expert``
+axis the plan enumerated).
 """
 
 import jax
@@ -125,6 +127,52 @@ def fsdp_rules(mesh, axis="data", min_elements=1024):
         return None
 
     return rules
+
+
+def pp_rules(mesh, axis="pipe", min_elements=1024):
+    """``param_rules`` for pipeline-style STAGE sharding of stacked
+    parameters: every large-enough leaf whose LEADING dim divides the
+    ``axis`` size shards that dim over it, so each pipeline rank holds
+    only its own stages' weights (plus their solver slots, because the
+    pod runtime applies rules per leaf).  This is the storage half of
+    GPipe-style pipelining — the ``analyze/plan.py`` planners emit the
+    matching ``("pipe",)`` spec for scan-stacked blocks; the compute
+    half (the microbatch ring) is
+    :func:`veles_tpu.parallel.pp.pipeline_apply`, folded inside the
+    epoch-scan window by the pod runtime.  Leaves without a
+    stage-divisible leading dim (embeddings, output heads, scalars)
+    stay replicated.  Combine with a ``data`` axis for DP×PP."""
+    if axis not in mesh.shape:
+        raise ValueError(
+            "pp_rules: mesh has no %r axis (mesh_axes must include "
+            "it, e.g. {'data': d, %r: s})" % (axis, axis))
+    size = mesh.shape[axis]
+
+    def rules(leaf):
+        shape = numpy.shape(leaf)
+        if not shape or \
+                int(numpy.prod(shape, initial=1)) < min_elements:
+            return None
+        if shape[0] % size == 0 and shape[0] >= size:
+            spec = [None] * len(shape)
+            spec[0] = axis
+            return P(*spec)
+        return None
+
+    return rules
+
+
+def ep_rules(mesh, axis="expert", min_elements=1024):
+    """``param_rules`` for GShard-style expert parallelism: every
+    large-enough leaf whose LEADING dim divides the ``axis`` size
+    shards that dim over it — MoE parameter stacks lead with the
+    expert dim (``w1[E, D, F]``, ``b1[E, F]``, …,
+    :func:`veles_tpu.parallel.moe.moe_mlp`), so each expert shard
+    holds and trains only its own experts; token routing rides an
+    in-program ``all_to_all`` over the same axis.  Shared
+    (non-expert) leaves — the router, embeddings — stay replicated.
+    Combine with a ``data`` axis for DP×EP."""
+    return pp_rules(mesh, axis=axis, min_elements=min_elements)
 
 
 def data_parallel_epoch(step_fn, mesh, params_example, n_samples,

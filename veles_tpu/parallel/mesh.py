@@ -24,13 +24,24 @@ class MeshTopologyError(ValueError):
     chips than the operator asked for."""
 
 
+#: long-form axis spellings accepted in topology strings/dicts — the
+#: mesh axes themselves stay short (``pipe`` matches the planner's
+#: ``("pipe",)`` specs; ``expert`` is already canonical)
+_AXIS_ALIASES = {"pipeline": "pipe", "pp": "pipe", "ep": "expert",
+                 "tp": "model"}
+
+
 def _parse_topology(topology):
     """Topology knob → ``{axis: size}``.  Accepted spellings:
 
     * ``None`` / ``""`` / ``"auto"`` — all devices on the ``data`` axis;
     * an int (or digit string) — that many ``data`` shards;
     * ``"DxM"`` — ``{"data": D, "model": M}`` (either may be ``-1``);
-    * a dict ``{axis: size}`` (a Config node's ``to_dict()`` included).
+    * ``"data=2,pipeline=4"`` — comma-separated ``axis=size`` pairs
+      for any axes; ``pipeline``/``pp`` normalize to ``pipe``,
+      ``ep`` to ``expert``, ``tp`` to ``model``;
+    * a dict ``{axis: size}`` (a Config node's ``to_dict()`` included;
+      the same axis aliases apply).
     """
     if topology is None:
         return {"data": -1}
@@ -39,26 +50,40 @@ def _parse_topology(topology):
     if isinstance(topology, dict):
         if not topology:
             return {"data": -1}
-        return {str(k): int(v) for k, v in topology.items()}
+        return {_AXIS_ALIASES.get(str(k), str(k)): int(v)
+                for k, v in topology.items()}
     if isinstance(topology, int):
         return {"data": int(topology)}
     text = str(topology).strip().lower()
     if text in ("", "auto"):
         return {"data": -1}
+    if "=" in text:
+        axes = {}
+        for pair in text.split(","):
+            name, _, size = pair.partition("=")
+            name = _AXIS_ALIASES.get(name.strip(), name.strip())
+            try:
+                axes[name] = int(size)
+            except ValueError:
+                raise MeshTopologyError(
+                    "cannot parse pod topology %r — axis pair %r is "
+                    "not name=int" % (topology, pair))
+        return axes
     parts = text.split("x")
     try:
         sizes = [int(p) for p in parts]
     except ValueError:
         raise MeshTopologyError(
             "cannot parse pod topology %r — want an int, 'DxM', "
-            "'auto', or {axis: size}" % (topology,))
+            "'axis=size,…', 'auto', or {axis: size}" % (topology,))
     if len(sizes) == 1:
         return {"data": sizes[0]}
     if len(sizes) == 2:
         return {"data": sizes[0], "model": sizes[1]}
     raise MeshTopologyError(
         "pod topology %r has %d axes — only data[xmodel] is "
-        "spellable as a string; pass {axis: size} for more"
+        "spellable as an 'x' string; spell more axes as "
+        "'data=D,pipeline=S,expert=E' or pass {axis: size}"
         % (topology, len(sizes)))
 
 
@@ -132,6 +157,19 @@ def mesh_from_topology(topology=None, devices=None, require=None):
     shape = tuple(axes[name] for name in names)
     grid = numpy.array(devices[:int(numpy.prod(shape))]).reshape(shape)
     return Mesh(grid, names)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check=False):
+    """``jax.shard_map`` across JAX versions: the public alias where it
+    exists (``check_vma`` spelling), the experimental module otherwise
+    (``check_rep`` spelling) — the one wrapper the collective modules
+    (moe/pp/ring) share so none of them pins a JAX version."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map as fn
+    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=check)
 
 
 def make_mesh(axes=None, devices=None):

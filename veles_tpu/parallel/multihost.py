@@ -29,12 +29,97 @@ pod, with the collectives riding ICI in-slice and DCN across
 (ROADMAP item 2's pod-of-pods direction).
 """
 
+import contextlib
 import os
 
 import jax
 import numpy
 
 _initialized = False
+
+#: active :class:`process_double`, or None — module-level so the
+#: accessors below (and everything built on them: pods, loaders,
+#: smokes) see the simulated process set without plumbing
+_double = None
+
+
+class MultiHostShardError(ValueError):
+    """A host-local shard cannot participate in one global array —
+    the global batch does not divide over the processes, or the
+    sharding's data axis cannot split evenly across hosts.  Subclasses
+    ValueError so pre-existing ``except ValueError`` callers keep
+    working."""
+
+
+class process_double:
+    """Simulated multi-process session for tests/smokes on ONE real
+    process: ``with process_double(2) as dbl:`` makes
+    :func:`process_count` report 2 and :func:`initialize` a no-op;
+    ``with dbl.rank(i):`` runs a block as process ``i``.
+
+    Ranks run SEQUENTIALLY (real deployments run them in SPMD
+    lockstep), so :func:`from_host_local` assembles the global array
+    incrementally: each rank's call banks its shard, earlier ranks get
+    a zeros-padded partial global, and the LAST rank's call returns
+    the fully assembled array — tests drive every rank in order and
+    assert on the final return.  Shard banking is keyed by per-rank
+    call sequence, mirroring the SPMD rule that all hosts make the
+    same ``from_host_local`` calls in the same order.
+    """
+
+    def __init__(self, num_processes):
+        if num_processes < 1:
+            raise ValueError("process_double needs >= 1 processes")
+        self.num_processes = num_processes
+        self.current = 0
+        self._counters = [0] * num_processes
+        self._banked = {}        # call seq -> {rank: local numpy}
+
+    def __enter__(self):
+        global _double
+        if _double is not None:
+            raise RuntimeError("process_double does not nest")
+        _double = self
+        return self
+
+    def __exit__(self, *exc):
+        global _double
+        _double = None
+        return False
+
+    @contextlib.contextmanager
+    def rank(self, index):
+        """Run the with-block as simulated process ``index``."""
+        if not 0 <= index < self.num_processes:
+            raise ValueError("rank %d outside [0, %d)"
+                             % (index, self.num_processes))
+        prev, self.current = self.current, index
+        try:
+            yield
+        finally:
+            self.current = prev
+
+    def bank_shard(self, local_batch, global_shape):
+        """Bank the current rank's shard; return ``(global numpy,
+        complete)`` — zeros-padded until every rank contributed."""
+        seq = self._counters[self.current]
+        self._counters[self.current] += 1
+        slot = self._banked.setdefault(seq, {})
+        slot[self.current] = numpy.asarray(local_batch)
+        out = numpy.zeros(global_shape,
+                          dtype=numpy.asarray(local_batch).dtype)
+        offset = 0
+        for rank in range(self.num_processes):
+            shard = slot.get(rank)
+            if shard is not None:
+                out[offset:offset + shard.shape[0]] = shard
+                offset += shard.shape[0]
+            else:
+                # SPMD even-split assumption for the missing ranks;
+                # the final (possibly uneven) shard is always the
+                # last rank's, so earlier gaps are even-sized
+                offset += global_shape[0] // self.num_processes
+        return out, len(slot) == self.num_processes
 
 
 def initialize(coordinator=None, num_processes=None, process_id=None,
@@ -43,10 +128,11 @@ def initialize(coordinator=None, num_processes=None, process_id=None,
 
     Argument resolution order: explicit args > ``VELES_COORDINATOR`` /
     ``VELES_NUM_PROCS`` / ``VELES_PROC_ID`` env vars > JAX
-    auto-detection (TPU pod metadata).  Idempotent.
+    auto-detection (TPU pod metadata).  Idempotent; a no-op under an
+    active :class:`process_double` (the double IS the runtime then).
     """
     global _initialized
-    if _initialized:
+    if _initialized or _double is not None:
         return
     coordinator = coordinator or os.environ.get("VELES_COORDINATOR")
     if num_processes is None and "VELES_NUM_PROCS" in os.environ:
@@ -66,11 +152,27 @@ def initialize(coordinator=None, num_processes=None, process_id=None,
     _initialized = True
 
 
+def configured():
+    """True when a multi-process runtime is configured or already up —
+    an active :class:`process_double`, a completed :func:`initialize`,
+    or the bootstrap env vars.  :class:`veles_tpu.pod.pods
+    .MultiHostPod` gates its :func:`initialize` call on this, so a
+    plain single-process run never touches ``jax.distributed`` (which
+    refuses to start after the first computation)."""
+    return (_double is not None or _initialized
+            or "VELES_COORDINATOR" in os.environ
+            or "VELES_NUM_PROCS" in os.environ)
+
+
 def process_index():
+    if _double is not None:
+        return _double.current
     return jax.process_index()
 
 
 def process_count():
+    if _double is not None:
+        return _double.num_processes
     return jax.process_count()
 
 
@@ -78,7 +180,7 @@ def is_coordinator():
     """True on exactly one process — gate snapshot writes, plotting,
     web status, publishing on this (orbax checkpointing is already
     multi-host-aware and needs no gate)."""
-    return jax.process_index() == 0
+    return process_index() == 0
 
 
 def from_host_local(local_batch, sharding, global_shape=None):
@@ -95,30 +197,73 @@ def from_host_local(local_batch, sharding, global_shape=None):
     global mesh consumes it without any gather.
     """
     local_batch = numpy.ascontiguousarray(local_batch)
+    n_procs = process_count()
     if global_shape is None:
-        global_shape = ((local_batch.shape[0] * jax.process_count(),)
+        global_shape = ((local_batch.shape[0] * n_procs,)
                         + tuple(local_batch.shape[1:]))
+    _check_data_axis(sharding, n_procs)
+    if _double is not None:
+        # simulated multi-process: bank this rank's shard and place
+        # the (possibly partial) assembled global on the real devices
+        global_np, _complete = _double.bank_shard(local_batch,
+                                                  global_shape)
+        return jax.device_put(global_np, sharding)
+    if n_procs == 1 and not _initialized:
+        # non-distributed fallback: one process owns the whole global
+        # array — identity placement, no cross-host assembly machinery
+        return jax.device_put(
+            numpy.broadcast_to(local_batch, global_shape), sharding)
     return jax.make_array_from_process_local_data(
         sharding, local_batch, global_shape)
 
 
-def host_shard_range(n_samples):
+def _check_data_axis(sharding, n_procs):
+    """Typed guard: the sharding's leading (data) axis must split
+    evenly across processes — each host feeds whole device shards, so
+    the per-axis device count has to be a multiple of the process
+    count (or the axis unsharded/replicated)."""
+    if n_procs <= 1:
+        return
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if spec is None or mesh is None or not len(spec):
+        return
+    lead = spec[0]
+    if lead is None:
+        return
+    names = lead if isinstance(lead, tuple) else (lead,)
+    ax = 1
+    for name in names:
+        ax *= dict(mesh.shape)[name]
+    if ax % n_procs:
+        raise MultiHostShardError(
+            "sharding's data axis %r has %d shard(s) — not divisible "
+            "across %d processes; each host must feed a whole number "
+            "of device shards" % (names, ax, n_procs))
+
+
+def host_shard_range(n_samples, allow_uneven=False):
     """[start, stop) of this host's contiguous shard of ``n_samples`` —
     how a loader decides which rows this process reads.
 
-    ``n_samples`` must divide evenly by the process count: uneven
-    shards cannot form one global array (``from_host_local``'s sharding
-    partitions the batch axis evenly, so ranks would disagree on the
-    global shape).  Pad or crop the global batch to a multiple of
-    ``process_count()`` — same rule as padding a batch to the ``data``
-    axis size on one host."""
-    n_procs = jax.process_count()
-    if n_samples % n_procs:
-        raise ValueError(
+    By default ``n_samples`` must divide evenly by the process count:
+    uneven shards cannot form one global array (``from_host_local``'s
+    sharding partitions the batch axis evenly, so ranks would disagree
+    on the global shape).  Pad or crop the global batch to a multiple
+    of ``process_count()`` — same rule as padding a batch to the
+    ``data`` axis size on one host.  ``allow_uneven=True`` hands the
+    remainder to the LAST rank (callers then pass an explicit
+    ``global_shape`` to :func:`from_host_local`)."""
+    n_procs = process_count()
+    if n_samples % n_procs and not allow_uneven:
+        raise MultiHostShardError(
             "global batch of %d rows does not divide evenly over %d "
             "processes; pad/crop to a multiple (uneven host shards "
             "cannot assemble into one global array)" % (n_samples,
                                                         n_procs))
     per = n_samples // n_procs
-    start = per * jax.process_index()
-    return start, start + per
+    idx = process_index()
+    start = per * idx
+    stop = n_samples if (allow_uneven and idx == n_procs - 1) \
+        else start + per
+    return start, stop

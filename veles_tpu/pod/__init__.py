@@ -14,6 +14,8 @@ See ``docs/distributed_training.md`` § Pod runtime.
 """
 
 from veles_tpu.pod.membership import (  # noqa: F401
-    PodMaster, PodWorker, capture_params, eval_metrics,
-    install_params, train_epochs)
+    DeviceLossDetector, PodMaster, PodWorker, capture_params,
+    eval_metrics, install_params, is_device_loss, train_epochs)
+from veles_tpu.pod.pods import (  # noqa: F401
+    MultiHostPod, MultiHostPodWorker)
 from veles_tpu.pod.runtime import PodError, PodRuntime  # noqa: F401

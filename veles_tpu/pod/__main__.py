@@ -21,6 +21,24 @@ is a legitimate topology change, counted as warmup), the V-P02
 preflight clean, and a mesh-sharded
 :class:`veles_tpu.serve.engine.InferenceEngine` byte-identical to the
 single-device forward over the trained weights.
+
+Pod-of-pods legs (one per new parallelism axis):
+
+5. **pp** — stacked stages pipelined over ``dp×pp``
+   (:func:`~veles_tpu.parallel.pp.pipeline_apply`), each epoch ONE
+   jitted scan, forward bitwise vs the sequential dp twin and trained
+   weights within 5e-5;
+6. **ep** — the switch-MoE sample routed by ``all_to_all`` over
+   ``dp×ep``, token parity vs the dense reference at drop-free
+   capacity;
+7. **multihost** — a simulated 2-process session (the ``multihost``
+   test double): coordinator lease + frameless follower, exactly ONE
+   update frame per lease across hosts, single-process
+   :class:`~veles_tpu.pod.pods.MultiHostPod` byte-identical to
+   :class:`~veles_tpu.pod.runtime.PodRuntime`;
+8. **device loss** — a heartbeat-silent host mid-epoch reshards
+   (``jobs:heartbeat_stall`` + ``pod:reshard`` in the trace) and
+   training completes with eval parity.
 """
 
 import argparse
@@ -226,6 +244,356 @@ def _epoch_scan_gate(epochs, reference, problems):
         trace.configure()
 
 
+def _pp_gate(problems):
+    """Pipeline leg: a homogeneous stacked-stage model trained via
+    :func:`veles_tpu.parallel.pp.pipeline_apply` over a dp×pp mesh,
+    each epoch folded into ONE jitted scan over minibatches (one
+    dispatch per class pass), against a dp-only twin running the same
+    stages as a sequential ``lax.scan`` on the same data order:
+    forward bitwise-identical, trained weights within 5e-5 (microbatch
+    summation reorders gradient adds at float epsilon), and ZERO
+    steady-state recompiles (one compile per epoch program)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from veles_tpu.analyze.pricing import pipeline_bubble
+    from veles_tpu.parallel.mesh import make_mesh, replicated
+    from veles_tpu.parallel.pp import pipeline_apply
+
+    if len(jax.devices()) < 8:
+        return None
+    stages, dim, batch, n_micro, steps_per_epoch = 4, 16, 64, 8, 8
+    mesh = make_mesh({"data": 2, "pipe": stages})
+    rng = numpy.random.default_rng(11)
+    params = {
+        "w": jnp.asarray(rng.standard_normal(
+            (stages, dim, dim)).astype(numpy.float32) * 0.3),
+        "b": jnp.zeros((stages, dim), numpy.float32),
+    }
+    # the pp_rules placement: stacked stages shard their leading dim
+    # over ``pipe`` (each device holds its stage's weights); the dp
+    # twin replicates — pinning in/out shardings keeps every epoch
+    # call on ONE compiled program (zero steady-state recompiles)
+    pp_shard = {"w": NamedSharding(mesh, P("pipe", None, None)),
+                "b": NamedSharding(mesh, P("pipe", None))}
+    dp_shard = {"w": replicated(mesh), "b": replicated(mesh)}
+    data = jnp.asarray(rng.standard_normal(
+        (steps_per_epoch, batch, dim)).astype(numpy.float32))
+    target = jnp.asarray(rng.standard_normal(
+        (steps_per_epoch, batch, dim)).astype(numpy.float32))
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def seq_forward(p, x):
+        def body(h, leaf):
+            return stage_fn(leaf, h), None
+        h, _ = jax.lax.scan(
+            body, x, jax.tree.map(lambda l: l, p))
+        return h
+
+    def pp_forward(p, x):
+        return pipeline_apply(stage_fn, p, x, mesh, n_micro=n_micro,
+                              batch_axis="data")
+
+    # forward parity FIRST: same stages, same values, bit for bit
+    ref = jax.jit(seq_forward)(params, data[0])
+    pp = jax.jit(pp_forward)(params, data[0])
+    if not numpy.array_equal(numpy.asarray(ref), numpy.asarray(pp)):
+        problems.append(
+            "pp gate: pipeline_apply forward diverged bitwise from "
+            "the sequential stage scan (max |d|=%s)"
+            % numpy.abs(numpy.asarray(ref) - numpy.asarray(pp)).max())
+
+    def epoch_fn(forward, shard):
+        def loss_fn(p, x, y):
+            return ((forward(p, x) - y) ** 2).mean()
+
+        def step(p, xs):
+            x, y = xs
+            grads = jax.grad(loss_fn)(p, x, y)
+            return jax.tree.map(lambda a, g: a - 0.1 * g, p,
+                                grads), None
+
+        def epoch(p):
+            p, _ = jax.lax.scan(step, p, (data, target))
+            return p
+        return jax.jit(epoch, in_shardings=(shard,),
+                       out_shardings=shard)
+
+    seq_epoch = epoch_fn(seq_forward, dp_shard)
+    pp_epoch = epoch_fn(pp_forward, pp_shard)
+    p_seq = jax.device_put(params, dp_shard)
+    p_pp = jax.device_put(params, pp_shard)
+    for _ in range(SMOKE_EPOCHS):
+        p_seq = seq_epoch(p_seq)         # one dispatch per class pass
+        p_pp = pp_epoch(p_pp)
+    for key in params:
+        diff = numpy.abs(numpy.asarray(p_seq[key])
+                         - numpy.asarray(p_pp[key])).max()
+        if diff > 5e-5:
+            problems.append(
+                "pp gate: trained %r diverged %.2e (> 5e-5) from the "
+                "dp oracle on the same data order" % (key, diff))
+    for name, fn in (("dp", seq_epoch), ("pp", pp_epoch)):
+        if fn._cache_size() != 1:
+            problems.append(
+                "pp gate: %s epoch program compiled %d time(s) over "
+                "%d epochs — exactly one compile, zero steady-state "
+                "recompiles" % (name, fn._cache_size(), SMOKE_EPOCHS))
+    return {"stages": stages, "microbatches": n_micro,
+            "bubble_fraction": pipeline_bubble(stages, n_micro),
+            "epoch_dispatches": 1}
+
+
+def _ep_gate(problems):
+    """Expert leg: the switch-MoE sample routed by ``all_to_all`` over
+    a dp×ep mesh vs its dense reference — at the drop-free capacity
+    (``capacity_factor = n_experts``) top-1 routing loses no token, so
+    logits must match token-for-token; a few sharded train steps must
+    also run (and descend) without recompiling."""
+    import jax
+
+    from veles_tpu.parallel.mesh import make_mesh
+    from veles_tpu.samples import moe
+
+    if len(jax.devices()) < 8:
+        return None
+    cfg = dict(moe.TINY)
+    mesh = make_mesh({"data": 2, "expert": 4})
+    params = moe.init_params(cfg, seed=1)
+    tokens = moe.synthetic_tokens(cfg, 16, seed=2)
+    dense = numpy.asarray(moe.apply_fn(params, tokens, cfg, mesh=None))
+    routed = numpy.asarray(moe.apply_fn(params, tokens, cfg,
+                                        mesh=mesh))
+    diff = numpy.abs(dense - routed).max()
+    if diff > 1e-5:
+        problems.append(
+            "ep gate: routed MoE diverged %.2e from the dense "
+            "reference at drop-free capacity (want token parity)"
+            % diff)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    p, v, step = moe.build_train(cfg, mesh=mesh, seed=1)
+    shard = {name: NamedSharding(mesh, spec)
+             for name, spec in moe.param_specs(p).items()}
+    p = jax.device_put(p, shard)
+    v = jax.device_put(v, shard)
+    toks = jax.device_put(tokens,
+                          NamedSharding(mesh, P("data", "expert")))
+    losses = []
+    for _ in range(4):
+        p, v, metrics = step(p, v, toks)
+        losses.append(float(metrics["loss"]))
+    if not losses[-1] < losses[0]:
+        problems.append("ep gate: sharded MoE loss did not descend "
+                        "(%r)" % losses)
+    if step._cache_size() != 1:
+        problems.append(
+            "ep gate: %d compile(s) across %d identical steps — "
+            "exactly one compile, zero steady-state recompiles"
+            % (step._cache_size(), len(losses)))
+    return {"experts": cfg["experts"], "expert_shards": 4,
+            "max_token_diff": float(diff)}
+
+
+def _multihost_gate(epochs, problems):
+    """Multi-host leg, on one real process via the ``multihost``
+    test double:
+
+    * a single-process :class:`~veles_tpu.pod.pods.MultiHostPod` (no
+      coordinator) must train bitwise-identically to a plain
+      :class:`PodRuntime` — the transparent-delegation contract;
+    * a simulated 2-process session — rank 0 a full coordinator
+      :class:`~veles_tpu.pod.pods.MultiHostPodWorker` ZMQ lease, rank
+      1 a follower — must put exactly ONE update frame on the wire
+      (the follower owns no socket) and leave both ranks with
+      identical trained weights (lockstep SPMD, sequentially
+      simulated);
+    * :meth:`MultiHostPod.assemble` must rebuild the global batch from
+      per-rank host-local shards.
+    """
+    from veles_tpu import chaos
+    from veles_tpu.backends import NumpyDevice
+    from veles_tpu.parallel import multihost
+    from veles_tpu.parallel.jobs import JobServer
+    from veles_tpu.parallel.mesh import mesh_from_topology
+    from veles_tpu.pod import (MultiHostPod, MultiHostPodWorker,
+                               PodMaster, PodRuntime, capture_params,
+                               train_epochs)
+
+    # (a) single-process transparency: byte-identical to PodRuntime
+    wf_plain = make_workflow(max_epochs=epochs)
+    PodRuntime(wf_plain, mesh=mesh_from_topology(
+        {"data": -1}, require=("data",))).install()
+    for _ in train_epochs(wf_plain, epochs):
+        pass
+    wf_multi = make_workflow(max_epochs=epochs)
+    MultiHostPod(wf_multi).install()
+    for _ in train_epochs(wf_multi, epochs):
+        pass
+    for i, (a, b) in enumerate(zip(capture_params(wf_plain),
+                                   capture_params(wf_multi))):
+        for key in a:
+            if not numpy.array_equal(a[key], b[key]):
+                problems.append(
+                    "multihost gate: single-process MultiHostPod "
+                    "unit %d %r diverged from plain PodRuntime "
+                    "(must be byte-identical)" % (i, key))
+
+    # (b) 2-process session: coordinator lease + frameless follower
+    update_frames = -1
+    with multihost.process_double(2) as dbl:
+        chaos.controller.arm([], seed=SMOKE_SEED)
+        server = w0 = w1 = None
+        try:
+            master_wf = make_workflow(max_epochs=epochs,
+                                      device=NumpyDevice())
+            master = PodMaster(master_wf, pods=1, epochs=epochs)
+            server = JobServer(master,
+                               heartbeat_interval=0.4).start()
+            with dbl.rank(0):
+                wf0 = make_workflow(max_epochs=epochs)
+                w0 = MultiHostPodWorker(wf0, server.endpoint)
+                if not w0.pod.is_coordinator or w0.worker is None:
+                    problems.append("multihost gate: rank 0 did not "
+                                    "become the coordinator")
+                if not w0.run():
+                    problems.append("multihost gate: coordinator "
+                                    "session did not survive")
+            with dbl.rank(1):
+                wf1 = make_workflow(max_epochs=epochs)
+                w1 = MultiHostPodWorker(wf1, server.endpoint)
+                if w1.worker is not None:
+                    problems.append("multihost gate: rank 1 opened a "
+                                    "control-plane socket")
+                w1.run()
+            if not master.done:
+                problems.append("multihost gate: lease never "
+                                "finished")
+        finally:
+            for w in (w0, w1):
+                if w is not None:
+                    w.close()
+            if server is not None:
+                server.stop()
+            snap = chaos.controller.snapshot()
+            chaos.controller.disarm()
+        frames = snap.get("wire_frames", {})
+        update_frames = sum(n for key, n in frames.items()
+                            if key == "master_recv:update")
+        if update_frames != 1:
+            problems.append(
+                "multihost gate: %d update frame(s) across 2 "
+                "simulated hosts (want exactly 1 — the coordinator's "
+                "final lease update)" % update_frames)
+        for i, (a, b) in enumerate(zip(capture_params(wf0),
+                                       capture_params(wf1))):
+            for key in a:
+                if not numpy.array_equal(a[key], b[key]):
+                    problems.append(
+                        "multihost gate: rank weights diverged (unit "
+                        "%d %r) — lockstep ranks must train "
+                        "identically" % (i, key))
+
+        # (c) host-local shards -> one global array
+        full = numpy.arange(64, dtype=numpy.float32).reshape(16, 4)
+        start, stop = 0, 0
+        with dbl.rank(0):
+            lo, hi = w1.pod.host_range(len(full))
+            w1.pod.assemble(full[lo:hi])
+            start = lo
+        with dbl.rank(1):
+            lo, hi = w1.pod.host_range(len(full))
+            assembled = w1.pod.assemble(full[lo:hi])
+            stop = hi
+        if (start, stop) != (0, 16) or not numpy.array_equal(
+                numpy.asarray(assembled), full):
+            problems.append(
+                "multihost gate: host-local shards did not assemble "
+                "into the global batch (range %r)" % ((start, stop),))
+    return {"processes": 2, "update_frames": update_frames}
+
+
+def _device_loss_gate(epochs, reference, problems):
+    """Device-loss leg: a heartbeat-silent host declared lost MID-epoch
+    must reshard the runtime (generation bump, ``pod:reshard`` next to
+    ``jobs:heartbeat_stall`` in the trace) and training must still
+    complete with eval parity; the typed-error classifier must accept
+    device-loss spellings and reject program bugs."""
+    import jax
+
+    from veles_tpu import trace
+    from veles_tpu.config import root
+    from veles_tpu.parallel.mesh import mesh_from_topology
+    from veles_tpu.pod import (DeviceLossDetector, PodRuntime,
+                               eval_metrics, is_device_loss,
+                               train_epochs)
+
+    if len(jax.devices()) < 2:
+        return None
+    saved_trace = root.common.engine.get("trace", "off")
+    root.common.engine.trace = "on"
+    trace.configure()
+    try:
+        wf = make_workflow(max_epochs=epochs)
+        runtime = PodRuntime(wf, mesh=mesh_from_topology(
+            {"data": -1}, require=("data",)))
+        runtime.install()
+        clock = {"now": 0.0}
+        # the virtual 8-chip mesh as 2 hosts x 4 chips
+        detector = DeviceLossDetector(
+            runtime, timeout=5.0,
+            devices_per_host=max(1, len(runtime.devices) // 2),
+            clock=lambda: clock["now"])
+        detector.beat("host-1")
+        stalls0 = trace.recorder.count("jobs", "heartbeat_stall")
+        reshards0 = trace.recorder.count("pod", "reshard")
+        shards_before = runtime.shards
+        for epoch in train_epochs(wf, epochs):
+            if epoch == 1:
+                clock["now"] += 10.0       # host-1 goes silent …
+                detector.beat("host-0")    # … the survivor still beats
+                if detector.poll() != ["host-1"]:
+                    problems.append("device-loss gate: the silent "
+                                    "host was not declared lost")
+        if runtime.reshards != 1 or runtime.generation != 2:
+            problems.append(
+                "device-loss gate: heartbeat loss did not reshard "
+                "(reshards=%d generation=%d)"
+                % (runtime.reshards, runtime.generation))
+        if runtime.shards >= shards_before:
+            problems.append(
+                "device-loss gate: mesh did not shrink (%d -> %d)"
+                % (shards_before, runtime.shards))
+        if trace.recorder.count("jobs", "heartbeat_stall") \
+                - stalls0 != 1:
+            problems.append("device-loss gate: jobs:heartbeat_stall "
+                            "instant missing from the trace")
+        if trace.recorder.count("pod", "reshard") - reshards0 != 1:
+            problems.append("device-loss gate: pod:reshard instant "
+                            "missing from the trace")
+        if not _metrics_close(reference, eval_metrics(wf)):
+            problems.append(
+                "device-loss gate: post-loss metrics %r diverged "
+                "from reference %r" % (eval_metrics(wf), reference))
+        for exc, want in (
+                (RuntimeError("UNAVAILABLE: socket closed"), True),
+                (RuntimeError("device lost: slice health"), True),
+                (RuntimeError("Invalid argument: dot shape"), False),
+                (ValueError("batch mismatch"), False)):
+            if is_device_loss(exc) is not want:
+                problems.append(
+                    "device-loss gate: %r misclassified (want "
+                    "device_loss=%s)" % (exc, want))
+        return {"shards_before": shards_before,
+                "shards_after": runtime.shards,
+                "generation": runtime.generation}
+    finally:
+        root.common.engine.trace = saved_trace
+        trace.configure()
+
+
 def run_smoke(as_json=False, epochs=SMOKE_EPOCHS):
     import jax
 
@@ -348,6 +716,42 @@ def run_smoke(as_json=False, epochs=SMOKE_EPOCHS):
         problems.append("sharded InferenceEngine check raised: %s: %s"
                         % (type(exc).__name__, exc))
 
+    # 5) pipeline parallelism: stage-sharded stages, one dispatch per
+    #    class pass, bitwise forward parity vs the dp twin
+    try:
+        pp_stats = _pp_gate(problems)
+    except Exception as exc:
+        problems.append("pp gate raised: %s: %s"
+                        % (type(exc).__name__, exc))
+        pp_stats = None
+
+    # 6) expert parallelism: all_to_all-routed MoE, token parity vs
+    #    the dense reference at drop-free capacity
+    try:
+        ep_stats = _ep_gate(problems)
+    except Exception as exc:
+        problems.append("ep gate raised: %s: %s"
+                        % (type(exc).__name__, exc))
+        ep_stats = None
+
+    # 7) multi-host pod (simulated 2-process session): one update
+    #    frame per lease across hosts, single-process byte-identity
+    try:
+        mh_stats = _multihost_gate(epochs, problems)
+    except Exception as exc:
+        problems.append("multihost gate raised: %s: %s"
+                        % (type(exc).__name__, exc))
+        mh_stats = None
+
+    # 8) real device-loss detection: heartbeat stall -> reshard ->
+    #    completed training with eval parity
+    try:
+        loss_stats = _device_loss_gate(epochs, reference, problems)
+    except Exception as exc:
+        problems.append("device-loss gate raised: %s: %s"
+                        % (type(exc).__name__, exc))
+        loss_stats = None
+
     pod_stats = (master.done.get("pod-0") or {}).get("pod") or {}
     summary = {
         "ok": not problems,
@@ -363,6 +767,10 @@ def run_smoke(as_json=False, epochs=SMOKE_EPOCHS):
         "reshards_under_chaos": cworker.runtime.reshards
         if cworker.runtime else None,
         "chaos_injected": injected,
+        "pp": pp_stats,
+        "ep": ep_stats,
+        "multihost": mh_stats,
+        "device_loss": loss_stats,
         "reference_metrics": reference,
         "pod_metrics": pod_metrics,
         "problems": problems,
@@ -379,6 +787,9 @@ def run_smoke(as_json=False, epochs=SMOKE_EPOCHS):
                  scan_dispatches, scan_windows,
                  cworker.runtime.generation if cworker.runtime
                  else "-"))
+        print("pod smoke legs: pp=%r ep=%r multihost=%r "
+              "device_loss=%r" % (pp_stats, ep_stats, mh_stats,
+                                  loss_stats))
         for problem in problems:
             print("PROBLEM: %s" % problem)
     return 0 if not problems else 1
@@ -407,7 +818,7 @@ def main(argv=None):
             import os
             os._exit(3)
         signal.signal(signal.SIGALRM, _hang)
-        signal.alarm(240)
+        signal.alarm(480)
         try:
             return run_smoke(as_json=args.json, epochs=args.epochs)
         finally:

@@ -29,8 +29,11 @@ chaos controller's wire-site frame counters are the proof the pod
 smoke and the acceptance tests assert on.
 """
 
+import time
+
 import numpy
 
+from veles_tpu import trace
 from veles_tpu.logger import Logger
 from veles_tpu.obs import context as obs_context
 from veles_tpu.parallel.mesh import mesh_from_topology
@@ -95,6 +98,112 @@ def train_epochs(workflow, epochs, already=0):
         decision.max_epochs = epoch + 1
         workflow.run()
         yield epoch + 1
+
+
+#: message fragments of the typed XLA dispatch errors that mean a
+#: participating device/host is GONE (vs. a programming error, which
+#: must propagate): the gRPC status spellings the PJRT runtime uses
+#: for coordinator/peer loss plus the explicit device-loss wordings
+DEVICE_LOSS_MARKERS = ("unavailable", "device lost", "data loss",
+                       "deadline exceeded", "failed to connect",
+                       "connection reset", "socket closed",
+                       "halted", "slice health")
+
+
+def is_device_loss(exc):
+    """Classify an exception from a sharded dispatch: ``True`` when it
+    is a runtime/XLA error whose message names a lost device or peer
+    (an :data:`DEVICE_LOSS_MARKERS` fragment), ``False`` for anything
+    that looks like a program bug — those must propagate, not trigger
+    an elastic reshard that would silently mask them."""
+    if not isinstance(exc, Exception):
+        return False
+    name = type(exc).__name__
+    if not (isinstance(exc, RuntimeError)
+            or "XlaRuntimeError" in name or "JaxRuntimeError" in name):
+        return False
+    text = ("%s: %s" % (name, exc)).lower()
+    return any(marker in text for marker in DEVICE_LOSS_MARKERS)
+
+
+class DeviceLossDetector(Logger):
+    """Real device-loss detection feeding :meth:`PodRuntime.reshard`
+    — the production twin of the chaos ``pod_chip`` site.
+
+    Two independent signals, both landing on the SAME elastic path
+    (mesh shrink, generation bump — the membership layer's epoch sync
+    then reports the new generation upstream and the master's reaper/
+    requeue machinery re-grants the lease):
+
+    * **heartbeats** — co-hosts of a multi-host pod :meth:`beat` this
+      detector (the launcher's ssh keepalive, or the worker loop on
+      each epoch boundary); :meth:`poll` declares any host silent for
+      ``timeout`` seconds lost, emits the ``jobs:heartbeat_stall``
+      instant (the exact counter the scheduler's reaper publishes, so
+      one Perfetto query finds both) and resharding drops its
+      ``devices_per_host`` chips;
+    * **dispatch failures** — :meth:`dispatch_failure` classifies an
+      exception raised by a sharded dispatch through
+      :func:`is_device_loss`; a typed device-loss reshards and
+      returns True (caller retries the step), anything else returns
+      False (caller re-raises).
+
+    ``clock`` is injectable for tests (default ``time.monotonic``).
+    """
+
+    def __init__(self, runtime, timeout=5.0, devices_per_host=1,
+                 clock=None, **kwargs):
+        super(DeviceLossDetector, self).__init__(**kwargs)
+        self.runtime = runtime
+        self.timeout = float(timeout)
+        self.devices_per_host = max(1, int(devices_per_host))
+        self._clock = clock if clock is not None else time.monotonic
+        self._beats = {}          # host -> last beat timestamp
+        self.stalls = 0           # heartbeat losses declared
+        self.dispatch_losses = 0  # typed dispatch-failure losses
+
+    def beat(self, host, now=None):
+        """Record a liveness beat from ``host`` (any hashable id)."""
+        self._beats[host] = self._clock() if now is None else now
+
+    def hosts(self):
+        return sorted(self._beats)
+
+    def poll(self, now=None):
+        """Declare hosts silent for > ``timeout`` lost; reshard once
+        for all of them.  Returns the list of lost host ids."""
+        now = self._clock() if now is None else now
+        lost = [host for host, beat in self._beats.items()
+                if now - beat > self.timeout]
+        for host in lost:
+            gap = now - self._beats.pop(host)
+            self.stalls += 1
+            # the scheduler reaper's exact instant spelling
+            # (parallel/jobs.py), so the merged timeline shows the
+            # pod's host loss in the same lane family as slave stalls
+            trace.instant("jobs", "heartbeat_stall",
+                          {"slave": host, "gap_ms": round(gap * 1e3,
+                                                          1)},
+                          role="pod")
+            self.warning(
+                "pod host %r silent for %.1fs (timeout %.1fs) — "
+                "declaring its %d chip(s) lost", host, gap,
+                self.timeout, self.devices_per_host)
+        if lost:
+            self.runtime.reshard(
+                lost=self.devices_per_host * len(lost))
+        return lost
+
+    def dispatch_failure(self, exc):
+        """True = ``exc`` was a typed device loss and the pod
+        resharded (retry the dispatch); False = not ours, re-raise."""
+        if not is_device_loss(exc):
+            return False
+        self.dispatch_losses += 1
+        self.warning("sharded dispatch failed with a device-loss "
+                     "error (%s) — resharding", exc)
+        self.runtime.reshard(lost=self.devices_per_host)
+        return True
 
 
 class PodMaster(Logger):

@@ -1,0 +1,212 @@
+"""Pod-of-pods: ONE lease spanning every host of a multi-host pod.
+
+:class:`veles_tpu.pod.runtime.PodRuntime` composes with
+:mod:`veles_tpu.parallel.multihost` here: :func:`multihost.initialize`
+joins the processes into one JAX runtime, ``jax.devices()`` becomes the
+GLOBAL device list, and the same :func:`veles_tpu.parallel.mesh
+.mesh_from_topology` call every single-host pod makes now spans hosts —
+the collectives XLA inserts for the mesh ride ICI within a slice and
+DCN across slices (ROADMAP item 2's pod-of-pods direction, PAPERS.md's
+multi-slice scaling).  Three consequences fall out without new
+machinery:
+
+* **datasets load host-locally**: each process reads only its
+  :func:`~veles_tpu.parallel.multihost.host_shard_range` rows and
+  :meth:`MultiHostPod.assemble` turns them into one addressable-shard-
+  backed global array (:func:`~veles_tpu.parallel.multihost
+  .from_host_local`) — no host ever materializes the full batch;
+* **the epoch-scan window spans the slice**: the window program is
+  compiled once over the global mesh, so a whole class pass is still
+  ONE dispatch — now a multi-host dispatch — and the wire gate
+  (exactly one ``update`` frame per lease) holds unchanged because the
+  control plane never learned to carry gradients in the first place;
+* **a single-process run is byte-identical to a plain PodRuntime**:
+  with no coordinator configured :func:`multihost.initialize` no-ops,
+  the mesh is the same local mesh, and :class:`MultiHostPod` is a
+  transparent delegate — same programs, same bytes, same trace.
+
+Control plane: exactly ONE rank (the coordinator,
+:func:`multihost.is_coordinator`) speaks ZMQ.
+:class:`MultiHostPodWorker` runs the full
+:class:`~veles_tpu.pod.membership.PodWorker` session there — lease
+grant, per-epoch ``pod_epoch`` syncs, the single final ``update`` —
+while every other rank runs in **follower mode**: it executes the same
+SPMD dispatches in lockstep (that is what a global mesh means) but
+opens no socket and sends ZERO frames.  The chaos wire-site counters
+therefore read identically however many hosts the pod spans.
+
+Device loss: each rank :meth:`~MultiHostPod.beat`s on every epoch
+boundary; the coordinator's
+:class:`~veles_tpu.pod.membership.DeviceLossDetector` declares a
+silent host lost (``jobs:heartbeat_stall`` instant), reshards the
+runtime (generation bump) and the master's reaper/requeue machinery
+re-grants the lease — the same elastic path a chaos ``chip_kill``
+exercises on one host.
+"""
+
+from veles_tpu.logger import Logger
+from veles_tpu.parallel import multihost
+from veles_tpu.parallel.mesh import mesh_from_topology
+from veles_tpu.pod.membership import (DeviceLossDetector, PodWorker,
+                                      eval_metrics, train_epochs)
+from veles_tpu.pod.runtime import PodRuntime
+
+
+class MultiHostPod(Logger):
+    """One lease over every host's devices.
+
+    ``coordinator`` / ``num_processes`` / ``process_id`` forward to
+    :func:`multihost.initialize` (all None + single process → no-op:
+    the transparent single-host path).  ``mesh`` overrides the
+    knob/``topology`` mesh; either way the mesh is built AFTER
+    initialize, so it grids the global device list.
+    ``heartbeat_timeout`` configures the device-loss detector
+    (seconds of host silence before its chips are declared lost).
+    """
+
+    def __init__(self, workflow, mesh=None, topology=None,
+                 param_rules=None, data_axis="data", coordinator=None,
+                 num_processes=None, process_id=None,
+                 heartbeat_timeout=30.0, **kwargs):
+        super(MultiHostPod, self).__init__(**kwargs)
+        if coordinator or num_processes or process_id is not None \
+                or multihost.configured():
+            multihost.initialize(coordinator=coordinator,
+                                 num_processes=num_processes,
+                                 process_id=process_id)
+        self.workflow = workflow
+        if mesh is None:
+            mesh = mesh_from_topology(topology, require=(data_axis,))
+        #: the delegate — a single-process MultiHostPod IS this
+        #: runtime (byte-identical programs and placements)
+        self.runtime = PodRuntime(workflow, mesh=mesh,
+                                  param_rules=param_rules,
+                                  data_axis=data_axis)
+        devices_per_host = max(
+            1, len(self.runtime.devices) // self.process_count)
+        self.detector = DeviceLossDetector(
+            self.runtime, timeout=heartbeat_timeout,
+            devices_per_host=devices_per_host)
+
+    # -- process topology ----------------------------------------------------
+    @property
+    def process_index(self):
+        return multihost.process_index()
+
+    @property
+    def process_count(self):
+        return multihost.process_count()
+
+    @property
+    def is_coordinator(self):
+        return multihost.is_coordinator()
+
+    # -- runtime delegation --------------------------------------------------
+    def install(self):
+        if not self.runtime.installed:
+            self.runtime.install()
+        return self
+
+    def uninstall(self):
+        self.runtime.uninstall()
+        return self
+
+    def describe(self):
+        out = self.runtime.describe()
+        out["processes"] = self.process_count
+        out["process_index"] = self.process_index
+        out["coordinator"] = self.is_coordinator
+        return out
+
+    # -- the host->device data boundary --------------------------------------
+    def host_range(self, n_samples, allow_uneven=False):
+        """[start, stop) of THIS host's rows of an ``n_samples``-row
+        dataset (:func:`multihost.host_shard_range`) — what a loader
+        reads instead of the full set."""
+        return multihost.host_shard_range(n_samples,
+                                          allow_uneven=allow_uneven)
+
+    def assemble(self, local_batch, global_shape=None):
+        """This host's rows → one global jax.Array batch-sharded over
+        the pod mesh (:func:`multihost.from_host_local`; identity
+        placement on a single process).  The returned array feeds any
+        program this runtime compiled without a gather."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        ndim = getattr(local_batch, "ndim", 1)
+        sharding = NamedSharding(
+            self.runtime.mesh,
+            P(self.runtime.data_axis, *([None] * (ndim - 1))))
+        return multihost.from_host_local(local_batch, sharding,
+                                         global_shape=global_shape)
+
+    # -- liveness ------------------------------------------------------------
+    def beat(self, host=None, now=None):
+        """Record a liveness beat (default: this process) — workers
+        call this on every epoch boundary; the coordinator's
+        :meth:`poll` turns silence into a reshard."""
+        self.detector.beat(self.process_index if host is None
+                           else host, now=now)
+
+    def poll(self, now=None):
+        """Coordinator-side: declare silent hosts lost (reshard +
+        ``jobs:heartbeat_stall``).  No-op on followers — exactly one
+        rank may drive elastic membership."""
+        if not self.is_coordinator:
+            return []
+        return self.detector.poll(now=now)
+
+
+class MultiHostPodWorker(Logger):
+    """The multi-host worker: the coordinator rank runs a full
+    :class:`~veles_tpu.pod.membership.PodWorker` ZMQ session over the
+    shared :class:`MultiHostPod` runtime; every other rank runs in
+    follower mode — same epochs, same SPMD dispatches, ZERO frames.
+
+    ``epochs`` is the follower's local epoch budget (the coordinator's
+    comes inside the lease); default: the workflow Decision's
+    ``max_epochs`` — the same number the master defaults to, which is
+    what keeps lockstep ranks in lockstep.
+    """
+
+    def __init__(self, workflow, endpoint, pod=None, epochs=None,
+                 sid=None, **kwargs):
+        super(MultiHostPodWorker, self).__init__(**kwargs)
+        self.workflow = workflow
+        self.pod = pod if pod is not None else MultiHostPod(workflow)
+        self.epochs = int(epochs
+                          or getattr(workflow.decision, "max_epochs",
+                                     1))
+        self.worker = None
+        if self.pod.is_coordinator:
+            self.worker = PodWorker(
+                workflow, endpoint, mesh=self.pod.runtime.mesh,
+                param_rules=self.pod.runtime.param_rules, sid=sid)
+            # share the pod's runtime: _ensure_runtime sees it
+            # installed and never builds a second one
+            self.worker.runtime = self.pod.runtime
+
+    def run(self):
+        """Install (idempotent) and serve: the coordinator's JobClient
+        session, or the follower's frameless local epochs.  Returns
+        the coordinator verdict / True for a completed follower."""
+        self.pod.install()
+        self.pod.beat()
+        if self.worker is not None:
+            return self.worker.run()
+        return self._run_follower()
+
+    def _run_follower(self):
+        self.info(
+            "rank %d/%d: follower mode — training %d epoch(s) in "
+            "lockstep, no control-plane socket", self.pod.process_index,
+            self.pod.process_count, self.epochs)
+        for _epoch in train_epochs(self.workflow, self.epochs):
+            self.pod.beat()
+        return True
+
+    def metrics(self):
+        return eval_metrics(self.workflow)
+
+    def close(self):
+        if self.worker is not None:
+            self.worker.close()

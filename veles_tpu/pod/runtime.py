@@ -144,6 +144,15 @@ class PodRuntime(Logger):
         #: id(segment) -> analytic per-dispatch psum bytes (the ring
         #: all-reduce estimate over the segment's donated buffers)
         self._psum_bytes = {}
+        #: id(segment) -> analytic per-dispatch expert all_to_all
+        #: bytes (non-zero only under an ``expert`` mesh axis)
+        self._a2a_bytes = {}
+        #: pipeline microbatches per step (the
+        #: ``root.common.engine.pod.microbatches`` knob; default =
+        #: the planner's PP_MICRO_PER_STAGE × stage count)
+        node = root.common.engine.get("pod")
+        self.microbatches = int((node.get("microbatches") if node
+                                 else 0) or 0) or None
         self._segments = []
         self._sharded_vecs = []
         #: membership hook: called as on_reshard(runtime) after an
@@ -161,7 +170,24 @@ class PodRuntime(Logger):
     def devices(self):
         return [d for d in self.mesh.devices.flat]
 
+    @property
+    def pipe_stages(self):
+        """Pipeline stages on the ``pipe`` axis (1 = no pipelining)."""
+        return int(dict(self.mesh.shape).get("pipe", 1))
+
+    @property
+    def expert_shards(self):
+        """Expert shards on the ``expert`` axis (1 = dense)."""
+        return int(dict(self.mesh.shape).get("expert", 1))
+
+    def _microbatches(self):
+        if self.microbatches:
+            return int(self.microbatches)
+        from veles_tpu.analyze.plan import PP_MICRO_PER_STAGE
+        return PP_MICRO_PER_STAGE * self.pipe_stages
+
     def describe(self):
+        from veles_tpu.analyze.pricing import pipeline_bubble
         return {
             "shards": self.shards,
             "axes": dict(self.mesh.shape),
@@ -170,6 +196,10 @@ class PodRuntime(Logger):
             "segments": [
                 "+".join(s.names) for s in self._segments],
             "psum_bytes_per_step": sum(self._psum_bytes.values()),
+            "all_to_all_bytes_per_step": sum(self._a2a_bytes.values()),
+            "bubble_fraction": pipeline_bubble(self.pipe_stages,
+                                               self._microbatches())
+            if self.pipe_stages > 1 else 0.0,
             "auto_plan": (self.auto_plan or {}).get("name"),
         }
 
@@ -214,6 +244,7 @@ class PodRuntime(Logger):
         self._sharded_vecs = []
         self._segments = []
         self._psum_bytes = {}
+        self._a2a_bytes = {}
         self.installed = False
         self._invalidate_scan()
         return self
@@ -317,6 +348,17 @@ class PodRuntime(Logger):
             self.shards, data_axis=self.data_axis,
             param_rules=self.param_rules)
 
+    def _segment_a2a_estimate(self, segment):
+        """Analytic per-dispatch expert-dispatch traffic (zero without
+        an ``expert`` mesh axis) — the shared pricing-core formula
+        (:func:`veles_tpu.analyze.pricing.segment_all_to_all_bytes`),
+        carried in the ledger's ``all_to_all_bytes`` column next to
+        (never mixed into) the ring-reduce ``psum_bytes``."""
+        from veles_tpu.analyze.pricing import segment_all_to_all_bytes
+        return segment_all_to_all_bytes(
+            segment, int(self.workflow.loader.max_minibatch_size),
+            self.expert_shards)
+
     def _apply_shardings(self):
         """Pin every plan Vector's placement and swap every segment's
         jit wrapper — placements land eagerly so the first dispatch
@@ -325,6 +367,7 @@ class PodRuntime(Logger):
         # fresh estimates: a re-install after rebuild_stitching (or a
         # reshard) must not accumulate entries keyed by dead segments
         self._psum_bytes = {}
+        self._a2a_bytes = {}
         seen = set()
         sharded = []
         for segment in self._segments:
@@ -336,6 +379,8 @@ class PodRuntime(Logger):
             segment.prof_entry.shards = self.shards
             self._psum_bytes[id(segment)] = \
                 self._segment_psum_estimate(segment)
+            self._a2a_bytes[id(segment)] = \
+                self._segment_a2a_estimate(segment)
             don_ids = set(id(v) for v in segment._don_vecs)
             # output Vectors are pinned too: per-step programs only
             # WRITE them (already mesh-placed), but an epoch-scan
@@ -374,6 +419,12 @@ class PodRuntime(Logger):
         epoch-scan window multiplies by its K (every scanned step runs
         the same in-program psum on the data axis)."""
         return self._psum_bytes.get(id(segment), 0)
+
+    def segment_all_to_all_bytes(self, segment):
+        """Per-dispatch expert all_to_all bytes for ``segment`` — the
+        ledger hook twin of :meth:`segment_psum_bytes`; an epoch-scan
+        window multiplies by its K the same way."""
+        return self._a2a_bytes.get(id(segment), 0)
 
     def scan_shardings(self, plan, with_verdict=False, n_pred=0):
         """Explicit mesh shardings for an epoch-scan window program

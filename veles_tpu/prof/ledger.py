@@ -119,7 +119,8 @@ class LedgerEntry(object):
 
     __slots__ = ("kind", "name", "cost", "compiles", "recompiles",
                  "dispatches", "dispatch_ns", "items", "shards",
-                 "psum_bytes", "steps", "peak_dtype")
+                 "psum_bytes", "all_to_all_bytes", "steps",
+                 "peak_dtype")
 
     def __init__(self, kind, name):
         self.kind = kind            # "segment" | "bucket" | "prefill"
@@ -149,6 +150,10 @@ class LedgerEntry(object):
         #: not expose collective traffic)
         self.shards = 1
         self.psum_bytes = 0
+        #: expert-dispatch exchange traffic — all_to_all is NOT a ring
+        #: all-reduce, so it gets its own column (analytic 2·(n−1)/n
+        #: of the exchanged activations, out + back)
+        self.all_to_all_bytes = 0
         #: MFU-denominator dtype: None = the session peak (bf16 table);
         #: "int8" = PEAK_INT8_OPS — quantized serving programs set it
         #: so their utilisation is judged against the rate the chip
@@ -235,6 +240,11 @@ class LedgerEntry(object):
             row["psum_bytes_per_dispatch"] = round(
                 self.psum_bytes / self.dispatches, 1) \
                 if self.dispatches else 0
+        if self.all_to_all_bytes:
+            row["all_to_all_bytes"] = self.all_to_all_bytes
+            row["all_to_all_bytes_per_dispatch"] = round(
+                self.all_to_all_bytes / self.dispatches, 1) \
+                if self.dispatches else 0
         return row
 
 
@@ -252,8 +262,10 @@ class PerfLedger(object):
         self.recompiles = 0
         self.flops_dispatched = 0.0
         #: running ICI collective traffic (bench reads deltas around a
-        #: timed region, like flops_dispatched)
+        #: timed region, like flops_dispatched) — reductions and
+        #: expert exchanges kept apart (not the same collective)
         self.psum_bytes_moved = 0
+        self.all_to_all_bytes_moved = 0
 
     def entry(self, kind, name):
         key = (kind, name)
@@ -290,17 +302,19 @@ class PerfLedger(object):
         return steady
 
     def record_dispatch(self, entry, dur_ns, items=0, psum_bytes=0,
-                        steps=0):
+                        steps=0, all_to_all_bytes=0):
         """The hot-path hook: one turnaround on ``entry``.  GIL-cheap
         integer adds, no lock (single dispatching thread per entry;
         totals tolerate the rare lost update).  ``items``: useful work
         units this dispatch served (generative entries pass tokens —
         prompt tokens for prefill, active slots for a decode step).
         ``psum_bytes``: ICI bytes this dispatch's in-program
-        collectives moved (pod segments pass their per-step gradient
-        all-reduce estimate).  ``steps``: train steps this ONE
-        dispatch covered (epoch-scan windows pass K; the entry's
-        per-step flops scale by it, not by the dispatch count)."""
+        REDUCTION collectives moved (pod segments pass their per-step
+        gradient all-reduce estimate); ``all_to_all_bytes``: the
+        expert-dispatch EXCHANGE traffic, kept in its own column.
+        ``steps``: train steps this ONE dispatch covered (epoch-scan
+        windows pass K; the entry's per-step flops scale by it, not by
+        the dispatch count)."""
         entry.dispatches += 1
         entry.dispatch_ns += int(dur_ns)
         if items:
@@ -310,6 +324,9 @@ class PerfLedger(object):
         if psum_bytes:
             entry.psum_bytes += int(psum_bytes)
             self.psum_bytes_moved += int(psum_bytes)
+        if all_to_all_bytes:
+            entry.all_to_all_bytes += int(all_to_all_bytes)
+            self.all_to_all_bytes_moved += int(all_to_all_bytes)
         flops = entry.flops
         if flops:
             self.flops_dispatched += flops * (steps if steps else 1)
@@ -335,6 +352,7 @@ class PerfLedger(object):
                 "recompiles": self.recompiles,
                 "flops_dispatched": self.flops_dispatched,
                 "psum_bytes_moved": self.psum_bytes_moved,
+                "all_to_all_bytes_moved": self.all_to_all_bytes_moved,
                 "dispatch_ms": round(dispatch_ns / 1e6, 3),
                 "achieved_flops": round(achieved, 1),
                 "mfu": (round(achieved / peak, 6)
@@ -350,6 +368,7 @@ class PerfLedger(object):
             self.recompiles = 0
             self.flops_dispatched = 0.0
             self.psum_bytes_moved = 0
+            self.all_to_all_bytes_moved = 0
 
 
 #: THE process-wide ledger every compile point and reporter shares
@@ -516,11 +535,17 @@ def report_text(summary_dict=None):
             shards = max(r["shards"] for r in pod_rows)
             total_psum = sum(r.get("psum_bytes", 0) for r in pod_rows)
             dispatches = sum(r["dispatches"] for r in pod_rows) or 1
+            total_a2a = sum(r.get("all_to_all_bytes", 0)
+                            for r in pod_rows)
             lines.append(
                 "  pod: %d shard(s) in lockstep, %s psum moved "
-                "(%s/dispatch)"
+                "(%s/dispatch)%s"
                 % (shards, _fmt_bytes(total_psum),
-                   _fmt_bytes(total_psum / dispatches)))
+                   _fmt_bytes(total_psum / dispatches),
+                   "" if not total_a2a else
+                   ", %s all_to_all moved (%s/dispatch)"
+                   % (_fmt_bytes(total_a2a),
+                      _fmt_bytes(total_a2a / dispatches))))
     if buckets:
         lines.append("")
         lines.append("serve buckets (per call):")

@@ -1,0 +1,183 @@
+"""Switch-MoE token classifier — the expert-parallel flagship.
+
+Promotes :func:`veles_tpu.parallel.moe.moe_mlp` from a collective
+primitive to a trainable sample: embed → switch-MoE FFN (top-1 routed,
+``all_to_all`` over the ``expert`` mesh axis) → tied readout, with the
+transformer sample's stacked-table layout so the static planner can
+price it (:func:`param_shapes`) and the pod can shard it
+(:func:`param_specs` = the ``ep_rules`` leading-``E`` convention).
+
+Parity anchor: at ``capacity_factor >= n_experts`` top-1 routing can
+NEVER overflow a capacity buffer (each expert's buffer holds every
+token), so :func:`apply_fn` over the mesh is token-for-token equal to
+the dense :func:`~veles_tpu.parallel.moe.moe_reference` — the ep smoke
+leg and ``stage_moe_pod``'s correctness gate.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from veles_tpu.parallel.mesh import replicated
+from veles_tpu.parallel.moe import moe_mlp, moe_reference
+
+CONFIG = {
+    "vocab": 32000, "dim": 512, "ffn": 2048, "experts": 8,
+    "seq_len": 256,
+}
+TINY = {
+    "vocab": 64, "dim": 16, "ffn": 32, "experts": 4,
+    "seq_len": 8,
+}
+
+
+def _shape_table(cfg):
+    """``name -> (shape, init)`` — the one layout table both
+    :func:`init_params` and :func:`param_shapes` derive from (see
+    :func:`veles_tpu.samples.transformer._shape_table`; entry order is
+    the RNG draw order).  Expert-stacked leaves LEAD with E — the
+    ``ep_rules`` sharding convention."""
+    d, f, e = cfg["dim"], cfg["ffn"], cfg["experts"]
+    sq = math.sqrt
+    return {
+        "embed": ((cfg["vocab"], d), ("randn", 0.02)),
+        "router": ((d, e), ("randn", 1 / sq(d))),
+        "w1": ((e, d, f), ("randn", 1 / sq(d))),
+        "b1": ((e, f), ("zeros",)),
+        "w2": ((e, f, d), ("randn", 1 / sq(f))),
+        "b2": ((e, d), ("zeros",)),
+    }
+
+
+def init_params(cfg, seed=0, dtype=numpy.float32):
+    rng = numpy.random.default_rng(seed)
+    out = {}
+    for name, (shape, init) in _shape_table(cfg).items():
+        if init[0] == "randn":
+            out[name] = (rng.standard_normal(shape)
+                         * init[1]).astype(dtype)
+        else:
+            fn = numpy.ones if init[0] == "ones" else numpy.zeros
+            out[name] = fn(shape, dtype)
+    return out
+
+
+def param_shapes(cfg, dtype=numpy.float32):
+    """Zero-alloc planner probe (``--plan`` prices ep candidates
+    against these shapes without touching HBM)."""
+    dt = numpy.dtype(dtype)
+    return {name: jax.ShapeDtypeStruct(entry[0], dt)
+            for name, entry in _shape_table(cfg).items()}
+
+
+def moe_params(params):
+    """The :func:`moe_mlp` param sub-dict (everything but the
+    embedding)."""
+    return {k: params[k] for k in ("router", "w1", "b1", "w2", "b2")}
+
+
+def apply_fn(params, tokens, cfg, mesh=None, expert_axis="expert",
+             capacity_factor=None):
+    """tokens [B, T] int32 → logits [B, T, V].
+
+    With a mesh whose ``expert_axis`` is >1 the FFN routes by
+    ``all_to_all`` (:func:`moe_mlp`); otherwise the dense reference
+    runs — same math, so the two paths are the parity pair.
+    ``capacity_factor`` defaults to the drop-free bound
+    ``n_experts`` (see the module docstring)."""
+    if capacity_factor is None:
+        capacity_factor = float(cfg["experts"])
+    h = params["embed"][tokens]
+    mp = moe_params(params)
+    if mesh is not None and mesh.shape.get(expert_axis, 1) > 1:
+        y = moe_mlp(h, mp, mesh, expert_axis=expert_axis,
+                    capacity_factor=capacity_factor)
+    else:
+        y = moe_reference(h, mp)
+    h = h + y
+    return jnp.einsum("btd,vd->btv", h, params["embed"])
+
+
+def param_specs(params, expert_axis="expert"):
+    """PartitionSpec pytree: expert-stacked leaves shard their leading
+    E dim over ``expert_axis`` (each device holds its experts' FFN),
+    router/embedding replicate — exactly what
+    :func:`veles_tpu.parallel.dp.ep_rules` derives shape-blind."""
+    expert_led = {"w1", "b1", "w2", "b2"}
+    return {name: (P(expert_axis,
+                     *([None] * (leaf.ndim - 1)))
+                   if name in expert_led else P())
+            for name, leaf in params.items()}
+
+
+def make_train_step(cfg, mesh=None, expert_axis="expert", lr=1e-2,
+                    capacity_factor=None):
+    """(params, velocity, tokens) → next-token CE loss, SGD+momentum
+    update — one XLA program (the :mod:`~veles_tpu.samples.transformer`
+    step shape, MoE body)."""
+
+    def loss_fn(params, tokens):
+        logits = apply_fn(params, tokens, cfg, mesh=mesh,
+                          expert_axis=expert_axis,
+                          capacity_factor=capacity_factor)
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+        picked = jnp.take_along_axis(
+            logp, targets[..., None], axis=-1)[..., 0]
+        return -picked.mean()
+
+    def step(params, velocity, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        new_v = jax.tree.map(
+            lambda v, g: 0.9 * v - lr * g, velocity, grads)
+        new_p = jax.tree.map(lambda p, v: p + v, params, new_v)
+        return new_p, new_v, {"loss": loss}
+
+    return step
+
+
+def build_train(cfg=None, mesh=None, expert_axis="expert",
+                batch_axis="data", lr=1e-2, seed=0,
+                capacity_factor=None):
+    """(params, velocity, jitted step).  With a mesh: embeddings
+    replicate, expert stacks shard E, tokens shard the batch axis;
+    without: plain single-device jit (the dense reference)."""
+    cfg = cfg or CONFIG
+    params = init_params(cfg, seed=seed)
+    velocity = jax.tree.map(numpy.zeros_like, params)
+    step = make_train_step(cfg, mesh=mesh, expert_axis=expert_axis,
+                           lr=lr, capacity_factor=capacity_factor)
+    if mesh is None:
+        return params, velocity, jax.jit(step, donate_argnums=(0, 1))
+    specs = param_specs(params, expert_axis)
+    p_shard = {name: NamedSharding(mesh, spec)
+               for name, spec in specs.items()}
+    tok_shard = NamedSharding(mesh, P(batch_axis, expert_axis))
+    jitted = jax.jit(
+        step,
+        in_shardings=(p_shard, p_shard, tok_shard),
+        out_shardings=(p_shard, p_shard, replicated(mesh)),
+        donate_argnums=(0, 1))
+    return params, velocity, jitted
+
+
+def train_step_flops(cfg, batch):
+    """Analytic FLOPs of one MoE train step (fwd+bwd+update ≈ 3× the
+    forward matmuls).  Top-1 routing: each token visits ONE expert, so
+    the FFN term does not scale with E — that is the MoE bargain the
+    MFU gate prices."""
+    d, f, e, s, v = (cfg["dim"], cfg["ffn"], cfg["experts"],
+                     cfg["seq_len"], cfg["vocab"])
+    per_token = (2.0 * d * e          # router
+                 + 4.0 * d * f        # one expert's up + down
+                 + 2.0 * d * v)       # tied readout
+    return 3.0 * batch * s * per_token
+
+
+def synthetic_tokens(cfg, batch, seed=0):
+    rng = numpy.random.default_rng(seed)
+    return rng.integers(0, cfg["vocab"],
+                        (batch, cfg["seq_len"])).astype(numpy.int32)

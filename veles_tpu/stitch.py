@@ -450,6 +450,8 @@ class StitchSegment(Logger, EnforcedProgram):
             prof.ledger.record_dispatch(
                 self.prof_entry, toc - tic,
                 psum_bytes=pod.segment_psum_bytes(self)
+                if pod is not None else 0,
+                all_to_all_bytes=pod.segment_all_to_all_bytes(self)
                 if pod is not None else 0)
             if pod is not None and trace.enabled():
                 # per-shard lanes: the host turnaround mirrored onto
